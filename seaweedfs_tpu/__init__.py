@@ -1,0 +1,26 @@
+"""seaweedfs_tpu — a TPU-native distributed blob/object/file store.
+
+A from-scratch framework with the capabilities of seaweedfs/seaweedfs
+(Facebook Haystack-style blob store), re-designed TPU-first:
+
+- The erasure-coding (Reed-Solomon GF(2^8)) pipeline runs as batched
+  GF(2) bit-plane matmuls on the TPU MXU (JAX/XLA + Pallas), bit-exact
+  with the reference's klauspost/reedsolomon CPU path
+  (reference: weed/storage/erasure_coding/ec_context.go:45).
+- Multi-chip scaling uses jax.sharding.Mesh + shard_map with XLA
+  collectives over ICI, not NCCL/MPI translation.
+- The storage/cluster runtime (volume engine, master, filer, shell)
+  is Python/asyncio + a C++ native core for the hot CPU paths.
+
+Layer map mirrors SURVEY.md §1:
+  storage/   on-disk formats + volume engine        (weed/storage)
+  ec/        erasure-coding pipeline                (weed/storage/erasure_coding)
+  ops/       GF(256) math: numpy reference, XLA, Pallas kernels
+  parallel/  device-mesh sharding of the EC math
+  server/    master / volume server / filer         (weed/server, weed/topology)
+  client/    master client, assign/upload ops       (weed/wdclient, weed/operation)
+  shell/     operator command surface               (weed/shell)
+  utils/     config, metrics, logging               (weed/util, weed/stats, weed/glog)
+"""
+
+__version__ = "0.1.0"

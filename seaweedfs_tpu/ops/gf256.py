@@ -1,0 +1,288 @@
+"""GF(2^8) arithmetic and Reed-Solomon matrices, klauspost-compatible.
+
+This is the CPU/numpy *reference* implementation that every accelerated
+path (XLA bit-plane matmul, Pallas TPU kernel, C++ native) must match
+bit-for-bit.
+
+Compatibility target: klauspost/reedsolomon v1.14.1 with default options,
+as used by the reference at weed/storage/erasure_coding/ec_context.go:45
+(`reedsolomon.New(dataShards, parityShards)`), i.e.:
+
+- field GF(2^8) with primitive polynomial x^8+x^4+x^3+x^2+1 (0x11D),
+  generator element 2 (same log/exp tables as Backblaze JavaReedSolomon);
+- systematic generator matrix built from an extended Vandermonde matrix:
+  vm = vandermonde(totalShards, dataShards)
+  matrix = vm * inverse(vm[0:dataShards, 0:dataShards])
+  so the top k rows are the identity and the bottom m rows are the
+  parity coefficients.
+
+Because GF arithmetic is exact integer math, "bit-exact" reduces to
+(a) identical matrix construction and (b) correct field arithmetic —
+both are locked by golden vectors in tests/test_gf256.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+GF_POLY = 0x11D  # x^8 + x^4 + x^3 + x^2 + 1
+FIELD_SIZE = 256
+
+
+def _build_tables() -> tuple[np.ndarray, np.ndarray]:
+    exp = np.zeros(255, dtype=np.uint8)
+    log = np.zeros(256, dtype=np.int32)
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x <<= 1
+        if x & 0x100:
+            x ^= GF_POLY
+    return exp, log
+
+
+EXP_TABLE, LOG_TABLE = _build_tables()
+
+
+@functools.cache
+def _mul_table() -> np.ndarray:
+    """Full 256x256 GF multiplication table (64KB)."""
+    a = np.arange(256)
+    la = LOG_TABLE[a][:, None]  # (256,1)
+    lb = LOG_TABLE[a][None, :]  # (1,256)
+    prod = EXP_TABLE[(la + lb) % 255]
+    prod = prod.copy()
+    prod[0, :] = 0
+    prod[:, 0] = 0
+    return prod.astype(np.uint8)
+
+
+def gal_mul(a: int, b: int) -> int:
+    if a == 0 or b == 0:
+        return 0
+    return int(EXP_TABLE[(int(LOG_TABLE[a]) + int(LOG_TABLE[b])) % 255])
+
+
+def gal_div(a: int, b: int) -> int:
+    if b == 0:
+        raise ZeroDivisionError("GF(256) division by zero")
+    if a == 0:
+        return 0
+    return int(EXP_TABLE[(int(LOG_TABLE[a]) - int(LOG_TABLE[b])) % 255])
+
+
+def gal_exp(a: int, n: int) -> int:
+    """a**n in GF(256); matches klauspost galExp (a=0 -> 0, n=0 -> 1)."""
+    if n == 0:
+        return 1
+    if a == 0:
+        return 0
+    return int(EXP_TABLE[(int(LOG_TABLE[a]) * n) % 255])
+
+
+def gal_inverse(a: int) -> int:
+    if a == 0:
+        raise ZeroDivisionError("GF(256) inverse of zero")
+    return int(EXP_TABLE[(255 - int(LOG_TABLE[a])) % 255])
+
+
+# ---------------------------------------------------------------------------
+# Matrices over GF(256) — stored as 2D uint8 numpy arrays.
+# ---------------------------------------------------------------------------
+
+
+def identity_matrix(n: int) -> np.ndarray:
+    return np.eye(n, dtype=np.uint8)
+
+
+def vandermonde(rows: int, cols: int) -> np.ndarray:
+    """m[r][c] = r**c in GF(256) (klauspost vandermonde())."""
+    m = np.zeros((rows, cols), dtype=np.uint8)
+    for r in range(rows):
+        for c in range(cols):
+            m[r, c] = gal_exp(r, c)
+    return m
+
+
+def matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Matrix product over GF(256)."""
+    if a.shape[1] != b.shape[0]:
+        raise ValueError(f"shape mismatch {a.shape} @ {b.shape}")
+    mt = _mul_table()
+    # out[i,j] = XOR_k mul(a[i,k], b[k,j])
+    prods = mt[a[:, :, None], b[None, :, :]]  # (I,K,J)
+    return np.bitwise_xor.reduce(prods, axis=1)
+
+
+def invert(m: np.ndarray) -> np.ndarray:
+    """Gauss-Jordan inversion over GF(256); raises on singular input."""
+    n = m.shape[0]
+    if m.shape[0] != m.shape[1]:
+        raise ValueError("only square matrices can be inverted")
+    mt = _mul_table()
+    work = np.concatenate([m.astype(np.uint8), identity_matrix(n)], axis=1)
+    for col in range(n):
+        if work[col, col] == 0:
+            pivot = -1
+            for r in range(col + 1, n):
+                if work[r, col] != 0:
+                    pivot = r
+                    break
+            if pivot < 0:
+                raise np.linalg.LinAlgError("matrix is singular over GF(256)")
+            work[[col, pivot]] = work[[pivot, col]]
+        inv_pivot = gal_inverse(int(work[col, col]))
+        work[col] = mt[inv_pivot, work[col]]
+        for r in range(n):
+            if r != col and work[r, col] != 0:
+                work[r] ^= mt[int(work[r, col]), work[col]]
+    return work[:, n:].copy()
+
+
+def build_matrix(data_shards: int, total_shards: int) -> np.ndarray:
+    """Systematic RS generator matrix, klauspost buildMatrix() exactly.
+
+    Top `data_shards` rows are the identity; the remaining rows are the
+    parity coefficients.
+    """
+    vm = vandermonde(total_shards, data_shards)
+    top = vm[:data_shards, :data_shards]
+    return matmul(vm, invert(top))
+
+
+def parity_rows(data_shards: int, parity_shards: int) -> np.ndarray:
+    """The (parity_shards x data_shards) coefficient block."""
+    return build_matrix(data_shards, data_shards + parity_shards)[data_shards:]
+
+
+# ---------------------------------------------------------------------------
+# GF(2) bit-plane expansion: multiplying by a GF(256) constant is a linear
+# map over GF(2)^8, so an (m x k) GF(256) matrix expands to an
+# (8m x 8k) 0/1 matrix. byte-wise RS encode == bit-wise XOR matmul, which
+# the TPU runs as an integer matmul followed by &1 (ops/rs_jax.py).
+# Bit order: bit i (LSB=0) of output byte = XOR over inputs of
+# bitmatrix[8*row + i, 8*col + j] * (bit j of input byte).
+# ---------------------------------------------------------------------------
+
+
+def constant_bit_matrix(c: int) -> np.ndarray:
+    """8x8 GF(2) matrix of y = c*x: column j = bits of gal_mul(c, 1<<j)."""
+    m = np.zeros((8, 8), dtype=np.uint8)
+    for j in range(8):
+        prod = gal_mul(c, 1 << j)
+        for i in range(8):
+            m[i, j] = (prod >> i) & 1
+    return m
+
+
+def expand_bit_matrix(coeffs: np.ndarray) -> np.ndarray:
+    """(m x k) GF(256) matrix -> (8m x 8k) GF(2) matrix."""
+    m, k = coeffs.shape
+    out = np.zeros((8 * m, 8 * k), dtype=np.uint8)
+    for i in range(m):
+        for j in range(k):
+            out[8 * i : 8 * i + 8, 8 * j : 8 * j + 8] = constant_bit_matrix(
+                int(coeffs[i, j])
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Reference (numpy) Reed-Solomon codec.
+# ---------------------------------------------------------------------------
+
+
+def matrix_apply(coeffs: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """out[i] = XOR_j gf_mul(coeffs[i,j], data[j]); data is (k, n) uint8."""
+    mt = _mul_table()
+    k = coeffs.shape[1]
+    if data.shape[0] != k:
+        raise ValueError(f"coeffs expect {k} rows, got {data.shape[0]}")
+    out = np.zeros((coeffs.shape[0], data.shape[1]), dtype=np.uint8)
+    for i in range(coeffs.shape[0]):
+        acc = out[i]
+        for j in range(k):
+            c = int(coeffs[i, j])
+            if c == 0:
+                continue
+            if c == 1:
+                acc ^= data[j]
+            else:
+                acc ^= mt[c, data[j]]
+    return out
+
+
+class ReedSolomon:
+    """klauspost-equivalent RS codec over equal-length byte shards.
+
+    Mirrors the subset of github.com/klauspost/reedsolomon the reference
+    uses: Encode, Verify, Reconstruct, ReconstructData
+    (weed/storage/erasure_coding/ec_encoder.go + store_ec.go call sites).
+    """
+
+    def __init__(self, data_shards: int, parity_shards: int):
+        if data_shards <= 0 or parity_shards <= 0:
+            raise ValueError("shard counts must be positive")
+        if data_shards + parity_shards > 256:
+            raise ValueError("too many shards for GF(256)")
+        self.k = data_shards
+        self.m = parity_shards
+        self.n = data_shards + parity_shards
+        self.matrix = build_matrix(self.k, self.n)
+        self.parity = self.matrix[self.k :]
+
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        """(k, n_bytes) data -> (m, n_bytes) parity."""
+        return matrix_apply(self.parity, np.ascontiguousarray(data, dtype=np.uint8))
+
+    def verify(self, shards: np.ndarray) -> bool:
+        """shards is (k+m, n_bytes); True iff parity matches data."""
+        expect = self.encode(shards[: self.k])
+        return bool(np.array_equal(expect, shards[self.k :]))
+
+    def _decode_matrix(self, present: list[int]) -> np.ndarray:
+        """Inverse of the k x k submatrix for the first k present shards."""
+        rows = present[: self.k]
+        if len(rows) < self.k:
+            raise ValueError(
+                f"need at least {self.k} shards, have {len(present)}"
+            )
+        sub = self.matrix[rows, :]
+        return invert(sub)
+
+    def reconstruct(
+        self, shards: dict[int, np.ndarray], data_only: bool = False
+    ) -> dict[int, np.ndarray]:
+        """Recover missing shards from any >=k present ones.
+
+        `shards` maps shard index -> bytes for present shards. Returns a
+        dict of the recovered shards (data first, then parity unless
+        data_only). Mirrors klauspost Reconstruct/ReconstructData.
+        """
+        present = sorted(shards)
+        if len(present) < self.k:
+            raise ValueError(
+                f"need at least {self.k} shards, have {len(present)}"
+            )
+        missing_data = [i for i in range(self.k) if i not in shards]
+        missing_parity = [i for i in range(self.k, self.n) if i not in shards]
+        out: dict[int, np.ndarray] = {}
+        if missing_data:
+            dec = self._decode_matrix(present)
+            src = np.stack([shards[i] for i in present[: self.k]])
+            rows = dec[missing_data, :]
+            recovered = matrix_apply(rows, src)
+            for idx, row in zip(missing_data, recovered):
+                out[idx] = row
+        if missing_parity and not data_only:
+            full_data = np.stack(
+                [shards[i] if i in shards else out[i] for i in range(self.k)]
+            )
+            rows = self.parity[[i - self.k for i in missing_parity], :]
+            recovered = matrix_apply(rows, full_data)
+            for idx, row in zip(missing_parity, recovered):
+                out[idx] = row
+        return out

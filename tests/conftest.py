@@ -1,0 +1,24 @@
+"""Test harness: force an 8-device virtual CPU platform before jax imports.
+
+Multi-chip hardware is not available in CI; sharding tests run on a
+virtual 8-device CPU mesh (the driver separately dry-runs the multi-chip
+path via __graft_entry__.dryrun_multichip).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    import numpy as np
+
+    return np.random.default_rng(0x5EAD)

@@ -1,0 +1,182 @@
+"""Golden-vector + property tests for the GF(256) / RS reference core.
+
+Models the reference's ec_roundtrip_test.go and klauspost's galois_test.go
+(the multiplication golden values 3*4=12, 7*7=21, 23*45=41 are from the
+klauspost test suite for the 0x11D field).
+"""
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops import gf256
+from seaweedfs_tpu.ops.gf256 import ReedSolomon
+
+
+class TestField:
+    def test_exp_table_golden(self):
+        assert list(gf256.EXP_TABLE[:9]) == [1, 2, 4, 8, 16, 32, 64, 128, 29]
+        assert gf256.LOG_TABLE[29] == 8
+        assert gf256.EXP_TABLE[254] != 0
+
+    def test_mul_golden(self):
+        assert gf256.gal_mul(3, 4) == 12
+        assert gf256.gal_mul(7, 7) == 21
+        assert gf256.gal_mul(23, 45) == 41
+        assert gf256.gal_mul(0, 99) == 0
+        assert gf256.gal_mul(99, 0) == 0
+        assert gf256.gal_mul(1, 99) == 99
+
+    def test_mul_table_matches_scalar(self, rng):
+        mt = gf256._mul_table()
+        for _ in range(200):
+            a, b = int(rng.integers(256)), int(rng.integers(256))
+            assert mt[a, b] == gf256.gal_mul(a, b)
+
+    def test_field_axioms(self, rng):
+        for _ in range(100):
+            a, b, c = (int(x) for x in rng.integers(0, 256, size=3))
+            assert gf256.gal_mul(a, b) == gf256.gal_mul(b, a)
+            assert gf256.gal_mul(a, gf256.gal_mul(b, c)) == gf256.gal_mul(
+                gf256.gal_mul(a, b), c
+            )
+            assert gf256.gal_mul(a, b ^ c) == gf256.gal_mul(a, b) ^ gf256.gal_mul(a, c)
+
+    def test_inverse(self):
+        for a in range(1, 256):
+            assert gf256.gal_mul(a, gf256.gal_inverse(a)) == 1
+
+    def test_exp(self):
+        assert gf256.gal_exp(2, 8) == 29
+        assert gf256.gal_exp(0, 0) == 1
+        assert gf256.gal_exp(0, 5) == 0
+        assert gf256.gal_exp(7, 0) == 1
+
+
+class TestMatrix:
+    def test_vandermonde(self):
+        vm = gf256.vandermonde(4, 3)
+        assert vm[0].tolist() == [1, 0, 0]
+        assert vm[1].tolist() == [1, 1, 1]
+        assert vm[2].tolist() == [1, 2, 4]
+        assert vm[3].tolist() == [1, 3, 5]  # 3*3=5 in GF(0x11D)
+
+    def test_invert_roundtrip(self, rng):
+        for n in (1, 2, 5, 10):
+            # random invertible matrix via product of vandermonde rows
+            while True:
+                m = rng.integers(0, 256, size=(n, n)).astype(np.uint8)
+                try:
+                    inv = gf256.invert(m)
+                    break
+                except np.linalg.LinAlgError:
+                    continue
+            assert np.array_equal(gf256.matmul(m, inv), gf256.identity_matrix(n))
+
+    def test_singular_raises(self):
+        m = np.array([[1, 2], [1, 2]], dtype=np.uint8)
+        with pytest.raises(np.linalg.LinAlgError):
+            gf256.invert(m)
+
+    def test_build_matrix_systematic(self):
+        m = gf256.build_matrix(10, 14)
+        assert np.array_equal(m[:10], gf256.identity_matrix(10))
+        # parity coefficients are all nonzero for the Vandermonde-derived matrix
+        assert (m[10:] != 0).all()
+
+    def test_build_matrix_mds_10_4(self):
+        """Any k rows of the generator matrix must be invertible (MDS)."""
+        import itertools
+
+        m = gf256.build_matrix(10, 14)
+        rng = np.random.default_rng(1)
+        combos = list(itertools.combinations(range(14), 10))
+        picks = rng.choice(len(combos), size=50, replace=False)
+        for i in picks:
+            rows = list(combos[i])
+            gf256.invert(m[rows, :])  # must not raise
+
+    def test_build_matrix_deterministic(self):
+        a = gf256.build_matrix(10, 14)
+        b = gf256.build_matrix(10, 14)
+        assert np.array_equal(a, b)
+
+
+class TestBitMatrix:
+    def test_constant_bit_matrix_applies_mul(self, rng):
+        for _ in range(50):
+            c = int(rng.integers(256))
+            mc = gf256.constant_bit_matrix(c)
+            x = int(rng.integers(256))
+            xbits = np.array([(x >> j) & 1 for j in range(8)], dtype=np.uint8)
+            ybits = (mc @ xbits) % 2
+            y = int((ybits << np.arange(8)).sum())
+            assert y == gf256.gal_mul(c, x), (c, x)
+
+    def test_expand_bit_matrix_encode_equiv(self, rng):
+        k, m, n = 4, 2, 64
+        coeffs = gf256.parity_rows(k, m)
+        bm = gf256.expand_bit_matrix(coeffs)  # (16, 32)
+        data = rng.integers(0, 256, size=(k, n)).astype(np.uint8)
+        # bit-plane encode
+        dbits = ((data[:, None, :] >> np.arange(8)[None, :, None]) & 1).reshape(
+            8 * k, n
+        )
+        pbits = (bm.astype(np.int32) @ dbits.astype(np.int32)) % 2
+        parity = (
+            (pbits.reshape(m, 8, n) << np.arange(8)[None, :, None])
+            .sum(axis=1)
+            .astype(np.uint8)
+        )
+        assert np.array_equal(parity, gf256.matrix_apply(coeffs, data))
+
+
+class TestReedSolomon:
+    def test_encode_verify_roundtrip(self, rng):
+        rs = ReedSolomon(10, 4)
+        data = rng.integers(0, 256, size=(10, 1024)).astype(np.uint8)
+        parity = rs.encode(data)
+        shards = np.concatenate([data, parity])
+        assert rs.verify(shards)
+        shards[3, 100] ^= 1
+        assert not rs.verify(shards)
+
+    @pytest.mark.parametrize("missing", [[0], [13], [0, 13], [2, 7], [10, 11], [0, 5, 12, 13]])
+    def test_reconstruct(self, rng, missing):
+        rs = ReedSolomon(10, 4)
+        data = rng.integers(0, 256, size=(10, 512)).astype(np.uint8)
+        parity = rs.encode(data)
+        full = np.concatenate([data, parity])
+        present = {i: full[i] for i in range(14) if i not in missing}
+        out = rs.reconstruct(present)
+        assert sorted(out) == sorted(missing)
+        for i in missing:
+            assert np.array_equal(out[i], full[i]), f"shard {i} mismatch"
+
+    def test_reconstruct_data_only(self, rng):
+        rs = ReedSolomon(10, 4)
+        data = rng.integers(0, 256, size=(10, 128)).astype(np.uint8)
+        full = np.concatenate([data, rs.encode(data)])
+        present = {i: full[i] for i in range(14) if i not in (1, 12)}
+        out = rs.reconstruct(present, data_only=True)
+        assert list(out) == [1]
+        assert np.array_equal(out[1], full[1])
+
+    def test_too_few_shards(self, rng):
+        rs = ReedSolomon(4, 2)
+        data = rng.integers(0, 256, size=(4, 16)).astype(np.uint8)
+        full = np.concatenate([data, rs.encode(data)])
+        present = {i: full[i] for i in range(3)}
+        with pytest.raises(ValueError):
+            rs.reconstruct(present)
+
+    def test_custom_ratios(self, rng):
+        """Custom EC ratios are first-class in the reference (.vif EcShardConfig)."""
+        for k, m in [(3, 2), (9, 3), (5, 1), (12, 8)]:
+            rs = ReedSolomon(k, m)
+            data = rng.integers(0, 256, size=(k, 100)).astype(np.uint8)
+            full = np.concatenate([data, rs.encode(data)])
+            drop = set(np.random.default_rng(k * m).choice(k + m, size=min(m, k + m - k), replace=False).tolist())
+            present = {i: full[i] for i in range(k + m) if i not in drop}
+            out = rs.reconstruct(present)
+            for i in drop:
+                assert np.array_equal(out[i], full[i])
