@@ -1,0 +1,231 @@
+// seaweed_native — C++ hot-path core for the CPU side of the framework.
+//
+// Provides (C ABI, loaded via ctypes from seaweedfs_tpu/utils/native.py):
+//   - sn_crc32c:    CRC32C (Castagnoli), hardware-accelerated on SSE4.2
+//   - sn_rs_apply:  GF(2^8) matrix apply (Reed-Solomon encode/reconstruct)
+//                   using PSHUFB nibble tables (the same technique the
+//                   reference's klauspost/reedsolomon uses on amd64) with
+//                   a portable table fallback.
+//
+// This is the CPU fallback/baseline for the TPU Pallas kernel, and serves
+// the latency-sensitive single-interval EC read recovery path where a
+// device round-trip is not worth it (SURVEY.md "hard parts" (d)).
+//
+// Reference behavior being mirrored (not copied):
+//   weed/storage/erasure_coding/ec_encoder.go encodeDataOneBatch
+//   klauspost/reedsolomon galois arithmetic, poly 0x11D.
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+#if defined(__x86_64__)
+#include <immintrin.h>
+#endif
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// CRC32C
+// ---------------------------------------------------------------------------
+
+static uint32_t crc32c_table[8][256];
+static bool crc32c_table_init_done = false;
+
+static void crc32c_table_init() {
+    if (crc32c_table_init_done) return;
+    const uint32_t poly = 0x82F63B78u;
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t crc = i;
+        for (int j = 0; j < 8; j++) crc = (crc >> 1) ^ ((crc & 1) ? poly : 0);
+        crc32c_table[0][i] = crc;
+    }
+    for (int k = 1; k < 8; k++)
+        for (uint32_t i = 0; i < 256; i++)
+            crc32c_table[k][i] =
+                (crc32c_table[k - 1][i] >> 8) ^ crc32c_table[0][crc32c_table[k - 1][i] & 0xFF];
+    crc32c_table_init_done = true;
+}
+
+static uint32_t crc32c_sw(uint32_t crc, const uint8_t* p, size_t len) {
+    crc32c_table_init();
+    crc = ~crc;
+    while (len && ((uintptr_t)p & 7)) {
+        crc = (crc >> 8) ^ crc32c_table[0][(crc ^ *p++) & 0xFF];
+        len--;
+    }
+    while (len >= 8) {
+        uint64_t w;
+        memcpy(&w, p, 8);
+        w ^= crc;
+        crc = crc32c_table[7][w & 0xFF] ^ crc32c_table[6][(w >> 8) & 0xFF] ^
+              crc32c_table[5][(w >> 16) & 0xFF] ^ crc32c_table[4][(w >> 24) & 0xFF] ^
+              crc32c_table[3][(w >> 32) & 0xFF] ^ crc32c_table[2][(w >> 40) & 0xFF] ^
+              crc32c_table[1][(w >> 48) & 0xFF] ^ crc32c_table[0][(w >> 56) & 0xFF];
+        p += 8;
+        len -= 8;
+    }
+    while (len--) crc = (crc >> 8) ^ crc32c_table[0][(crc ^ *p++) & 0xFF];
+    return ~crc;
+}
+
+#if defined(__x86_64__)
+__attribute__((target("sse4.2")))
+static uint32_t crc32c_hw(uint32_t crc, const uint8_t* p, size_t len) {
+    crc = ~crc;
+    while (len && ((uintptr_t)p & 7)) {
+        crc = _mm_crc32_u8(crc, *p++);
+        len--;
+    }
+    while (len >= 8) {
+        uint64_t w;
+        memcpy(&w, p, 8);
+        crc = (uint32_t)_mm_crc32_u64(crc, w);
+        p += 8;
+        len -= 8;
+    }
+    while (len--) crc = _mm_crc32_u8(crc, *p++);
+    return ~crc;
+}
+#endif
+
+uint32_t sn_crc32c(uint32_t crc, const uint8_t* data, size_t len) {
+#if defined(__x86_64__)
+    if (__builtin_cpu_supports("sse4.2")) return crc32c_hw(crc, data, len);
+#endif
+    return crc32c_sw(crc, data, len);
+}
+
+// ---------------------------------------------------------------------------
+// GF(2^8) Reed-Solomon matrix apply
+// ---------------------------------------------------------------------------
+
+static uint8_t gf_mul_table[256][256];
+static uint8_t gf_nib_lo[256][16];  // low-nibble products per constant
+static uint8_t gf_nib_hi[256][16];  // high-nibble products per constant
+static bool gf_init_done = false;
+
+static uint8_t gf_mul_slow(uint8_t a, uint8_t b) {
+    uint16_t r = 0;
+    uint16_t aa = a;
+    for (int i = 0; i < 8; i++) {
+        if (b & (1 << i)) r ^= (uint16_t)(aa << i);
+    }
+    // reduce mod x^8+x^4+x^3+x^2+1 (0x11D)
+    for (int i = 15; i >= 8; i--) {
+        if (r & (1 << i)) r ^= (0x11D << (i - 8));
+    }
+    return (uint8_t)r;
+}
+
+static void gf_init() {
+    if (gf_init_done) return;
+    for (int a = 0; a < 256; a++)
+        for (int b = 0; b < 256; b++)
+            gf_mul_table[a][b] = gf_mul_slow((uint8_t)a, (uint8_t)b);
+    for (int c = 0; c < 256; c++) {
+        for (int n = 0; n < 16; n++) {
+            gf_nib_lo[c][n] = gf_mul_table[c][n];
+            gf_nib_hi[c][n] = gf_mul_table[c][n << 4];
+        }
+    }
+    gf_init_done = true;
+}
+
+// Portable scalar multiply-accumulate: out ^= c * in
+static void gf_mul_xor_scalar(uint8_t c, const uint8_t* in, uint8_t* out, size_t n) {
+    const uint8_t* t = gf_mul_table[c];
+    for (size_t i = 0; i < n; i++) out[i] ^= t[in[i]];
+}
+
+#if defined(__x86_64__)
+__attribute__((target("avx2")))
+static void gf_mul_xor_avx2(uint8_t c, const uint8_t* in, uint8_t* out, size_t n) {
+    __m256i lo = _mm256_broadcastsi128_si256(_mm_loadu_si128((const __m128i*)gf_nib_lo[c]));
+    __m256i hi = _mm256_broadcastsi128_si256(_mm_loadu_si128((const __m128i*)gf_nib_hi[c]));
+    __m256i mask = _mm256_set1_epi8(0x0F);
+    size_t i = 0;
+    for (; i + 32 <= n; i += 32) {
+        __m256i v = _mm256_loadu_si256((const __m256i*)(in + i));
+        __m256i vlo = _mm256_and_si256(v, mask);
+        __m256i vhi = _mm256_and_si256(_mm256_srli_epi64(v, 4), mask);
+        __m256i p = _mm256_xor_si256(_mm256_shuffle_epi8(lo, vlo), _mm256_shuffle_epi8(hi, vhi));
+        __m256i o = _mm256_loadu_si256((const __m256i*)(out + i));
+        _mm256_storeu_si256((__m256i*)(out + i), _mm256_xor_si256(o, p));
+    }
+    if (i < n) gf_mul_xor_scalar(c, in + i, out + i, n - i);
+}
+
+__attribute__((target("ssse3")))
+static void gf_mul_xor_ssse3(uint8_t c, const uint8_t* in, uint8_t* out, size_t n) {
+    __m128i lo = _mm_loadu_si128((const __m128i*)gf_nib_lo[c]);
+    __m128i hi = _mm_loadu_si128((const __m128i*)gf_nib_hi[c]);
+    __m128i mask = _mm_set1_epi8(0x0F);
+    size_t i = 0;
+    for (; i + 16 <= n; i += 16) {
+        __m128i v = _mm_loadu_si128((const __m128i*)(in + i));
+        __m128i vlo = _mm_and_si128(v, mask);
+        __m128i vhi = _mm_and_si128(_mm_srli_epi64(v, 4), mask);
+        __m128i p = _mm_xor_si128(_mm_shuffle_epi8(lo, vlo), _mm_shuffle_epi8(hi, vhi));
+        __m128i o = _mm_loadu_si128((const __m128i*)(out + i));
+        _mm_storeu_si128((__m128i*)(out + i), _mm_xor_si128(o, p));
+    }
+    if (i < n) gf_mul_xor_scalar(c, in + i, out + i, n - i);
+}
+#endif
+
+static void gf_mul_xor(uint8_t c, const uint8_t* in, uint8_t* out, size_t n) {
+#if defined(__x86_64__)
+    if (__builtin_cpu_supports("avx2")) { gf_mul_xor_avx2(c, in, out, n); return; }
+    if (__builtin_cpu_supports("ssse3")) { gf_mul_xor_ssse3(c, in, out, n); return; }
+#endif
+    gf_mul_xor_scalar(c, in, out, n);
+}
+
+static void xor_into(const uint8_t* in, uint8_t* out, size_t n) {
+    size_t i = 0;
+    for (; i + 8 <= n; i += 8) {
+        uint64_t a, b;
+        memcpy(&a, out + i, 8);
+        memcpy(&b, in + i, 8);
+        a ^= b;
+        memcpy(out + i, &a, 8);
+    }
+    for (; i < n; i++) out[i] ^= in[i];
+}
+
+// out[r] = XOR_j coeffs[r*in_rows+j] * data[j]   (rows are n-byte blocks)
+// data: in_rows contiguous rows of n bytes; out: out_rows rows of n bytes.
+void sn_rs_apply(const uint8_t* coeffs, int out_rows, int in_rows,
+                 const uint8_t* data, uint8_t* out, size_t n) {
+    gf_init();
+    for (int r = 0; r < out_rows; r++) {
+        uint8_t* dst = out + (size_t)r * n;
+        memset(dst, 0, n);
+        for (int j = 0; j < in_rows; j++) {
+            uint8_t c = coeffs[r * in_rows + j];
+            if (c == 0) continue;
+            const uint8_t* src = data + (size_t)j * n;
+            if (c == 1) {
+                xor_into(src, dst, n);
+            } else {
+                gf_mul_xor(c, src, dst, n);
+            }
+        }
+    }
+}
+
+uint8_t sn_gf_mul(uint8_t a, uint8_t b) {
+    gf_init();
+    return gf_mul_table[a][b];
+}
+
+int sn_has_avx2() {
+#if defined(__x86_64__)
+    return __builtin_cpu_supports("avx2") ? 1 : 0;
+#else
+    return 0;
+#endif
+}
+
+}  // extern "C"

@@ -104,14 +104,30 @@ class RSJax:
     ReconstructData, weed/storage/erasure_coding + store_ec.go).
     """
 
-    def __init__(self, data_shards: int, parity_shards: int):
+    def __init__(
+        self,
+        data_shards: int,
+        parity_shards: int,
+        impl: str = "xla",
+        interpret: bool = False,
+        tile_n: int | None = None,
+    ):
+        """impl: "xla" (portable) or "pallas" (fused TPU kernel,
+        1x HBM traffic; `interpret=True` runs it off-TPU for tests)."""
+        if impl not in ("xla", "pallas"):
+            raise ValueError(f"unknown impl {impl!r}")
         self.k = data_shards
         self.m = parity_shards
         self.n = data_shards + parity_shards
+        self.impl = impl
+        self.interpret = interpret
+        self.tile_n = tile_n
         self._ref = gf256.ReedSolomon(data_shards, parity_shards)
         self.matrix = self._ref.matrix
+        expand = bit_matrix_bitmajor if impl == "pallas" else bit_matrix
+        self._expand = expand
         self._parity_bits = jnp.asarray(
-            bit_matrix(self._ref.parity), dtype=_ACC_DTYPE
+            expand(self._ref.parity), dtype=_ACC_DTYPE
         )
         # Bounded: shard-loss patterns are diverse in a long-lived volume
         # server; each entry pins an (8m x 8k) device array.
@@ -122,12 +138,29 @@ class RSJax:
 
     # -- encode ------------------------------------------------------------
 
+    def _apply(self, bits: jax.Array, data: jax.Array, m_out: int) -> jax.Array:
+        if self.impl == "pallas":
+            from . import rs_pallas
+
+            kwargs = {}
+            if self.tile_n is not None:
+                kwargs["tile_n"] = self.tile_n
+            return rs_pallas.apply_bitmajor_pallas(
+                bits,
+                data,
+                k=int(data.shape[0]),
+                m=m_out,
+                interpret=self.interpret,
+                **kwargs,
+            )
+        return _apply_bits(bits, data)
+
     def encode(self, data) -> jax.Array:
         """(k, n) uint8 data shards -> (m, n) uint8 parity shards."""
         data = jnp.asarray(data, dtype=jnp.uint8)
         if data.shape[0] != self.k:
             raise ValueError(f"expected {self.k} data rows, got {data.shape[0]}")
-        return _apply_bits(self._parity_bits, data)
+        return self._apply(self._parity_bits, data, self.m)
 
     # -- reconstruct -------------------------------------------------------
 
@@ -141,7 +174,7 @@ class RSJax:
         sub = self.matrix[list(src_rows), :]
         inv = gf256.invert(sub)  # (k, k): src shards -> data shards
         want = gf256.matmul(self.matrix[list(out_rows), :], inv)
-        bits = jnp.asarray(bit_matrix(want), dtype=_ACC_DTYPE)
+        bits = jnp.asarray(self._expand(want), dtype=_ACC_DTYPE)
         self._decode_bits_cache[key] = bits
         if len(self._decode_bits_cache) > self._decode_cache_limit:
             self._decode_bits_cache.popitem(last=False)
@@ -159,7 +192,7 @@ class RSJax:
         src = present[: self.k]
         bits = self._rows_bits(missing, src)
         data = jnp.stack([jnp.asarray(shards[i], dtype=jnp.uint8) for i in src])
-        out = _apply_bits(bits, data)
+        out = self._apply(bits, data, len(missing))
         return {idx: out[i] for i, idx in enumerate(missing)}
 
     def verify(self, shards) -> bool:

@@ -1,0 +1,135 @@
+"""Fused Pallas TPU kernel for GF(2^8) Reed-Solomon bit-plane matmuls.
+
+The XLA path in ops/rs_jax.py materialises the (8k, n) bit expansion in
+HBM (~8x traffic). This kernel keeps the expansion in VMEM: each grid
+step DMAs a byte tile, unpacks the 8 bit-planes, runs 8 small MXU
+matmuls against contiguous column blocks of the *bit-major* matrix
+(ops/rs_jax.bit_matrix_bitmajor layout), packs the output bits back to
+bytes, and writes the parity tile — HBM traffic stays ~1x in + 1x out.
+
+Byte-packing trick (pack_width W in {1, 2, 4}): W consecutive bytes are
+processed as one uint(8W) lane. Plane j of a word is `(w >> j) & MASK`
+with MASK = 0x0101.. — each byte's bit j stays in its own byte lane.
+Matmul sums are <= 8k <= 2048 per byte lane, so no carries cross byte
+boundaries and the packed accumulator word holds each byte's exact sum.
+Parity bits come back out with `(acc & MASK) << i`. Everything is
+endian-agnostic because pack and unpack mirror each other.
+
+Exactness: f32 accumulators are exact for packed values < 2^24, which
+bounds W*8-bit words to W <= 2 (max sum 8k * 0x00010001 < 2^24 for
+k <= 16... actually 80 * 65537 ~ 5.2e6 << 2^24). W=4 requires integer
+matmul accumulation and is gated behind pack_width=4.
+
+Reference hot loop being replaced:
+weed/storage/erasure_coding/ec_encoder.go:427 (encodeDataOneBatch).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Default word-column tile (lanes of packed words). VMEM use is dominated
+# by the f32 planes/accumulator: ~ (8m + k) * TILE_N * 4B.
+TILE_N = 16384
+
+_WORD_DTYPES = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32}
+_MASKS = {1: 0x01, 2: 0x0101, 4: 0x01010101}
+
+
+def _rs_kernel(k: int, m: int, pack_width: int, b_ref, d_ref, out_ref):
+    """b_ref: (8m, 8k) f32 bit-major; d_ref: (k, TN) uintW words."""
+    # All integer work is int32: Mosaic lacks uint32<->f32 casts, and
+    # arithmetic right-shift is safe because the masked bit positions
+    # (0, 8, 16, 24) sit below any sign-extension for shifts <= 7.
+    mask = _MASKS[pack_width]
+    acc_dtype = jnp.int32 if pack_width == 4 else jnp.float32
+    d = d_ref[:].astype(jnp.int32)
+    acc = jnp.zeros((8 * m, d.shape[1]), dtype=acc_dtype)
+    for j in range(8):
+        plane = ((d >> j) & mask).astype(acc_dtype)
+        b_cols = b_ref[:, j * k : (j + 1) * k].astype(acc_dtype)
+        acc = acc + jax.lax.dot_general(
+            b_cols,
+            plane,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=acc_dtype,
+        )
+    acci = acc.astype(jnp.int32)
+    out = jnp.zeros((m, d.shape[1]), dtype=jnp.int32)
+    for i in range(8):
+        out = out | ((acci[i * m : (i + 1) * m] & mask) << i)
+    out_ref[:] = out.astype(_WORD_DTYPES[pack_width])
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "m", "tile_n", "pack_width", "interpret")
+)
+def apply_bitmajor_pallas(
+    b,
+    data,
+    *,
+    k: int,
+    m: int,
+    tile_n: int = TILE_N,
+    pack_width: int = 2,
+    interpret: bool = False,
+):
+    """(8m x 8k) bit-major GF(2) matrix applied to (k, n) uint8 -> (m, n).
+
+    n is padded to a tile multiple internally (RS of zero bytes is zero,
+    so padding never corrupts real columns).
+    """
+    if pack_width not in _WORD_DTYPES:
+        raise ValueError(f"pack_width must be 1, 2 or 4, got {pack_width}")
+    n = data.shape[1]
+    bytes_per_tile = tile_n * pack_width
+    pad = (-n) % bytes_per_tile
+    if pad:
+        data = jnp.pad(data, ((0, 0), (0, pad)))
+    n_padded = data.shape[1]
+    if pack_width > 1:
+        words = jax.lax.bitcast_convert_type(
+            data.reshape(k, n_padded // pack_width, pack_width),
+            _WORD_DTYPES[pack_width],
+        )
+    else:
+        words = data
+    grid = (words.shape[1] // tile_n,)
+    out_words = pl.pallas_call(
+        functools.partial(_rs_kernel, k, m, pack_width),
+        out_shape=jax.ShapeDtypeStruct((m, words.shape[1]), _WORD_DTYPES[pack_width]),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((8 * m, 8 * k), lambda i: (0, 0)),
+            pl.BlockSpec((k, tile_n), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((m, tile_n), lambda i: (0, i)),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * 8 * m * 8 * k * words.shape[1],
+            bytes_accessed=(k + m) * n_padded + 64 * m * k * 4,
+            transcendentals=0,
+        ),
+    )(b.astype(jnp.float32), words)
+    if pack_width > 1:
+        out = jax.lax.bitcast_convert_type(out_words, jnp.uint8).reshape(
+            m, n_padded
+        )
+    else:
+        out = out_words
+    return out[:, :n] if pad else out
+
+
+def is_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon") or any(
+            d.platform in ("tpu", "axon") for d in jax.devices()
+        )
+    except Exception:
+        return False

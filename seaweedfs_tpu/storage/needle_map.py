@@ -1,0 +1,195 @@
+"""Needle maps: needleId -> (offset, size) per volume.
+
+The reference offers in-memory, LevelDB and sorted-file impls behind
+`NeedleMapper` (weed/storage/needle_map.go:23). Here:
+
+- `MemoryNeedleMap`: dict-backed, rebuilt by replaying the .idx journal
+  (the reference's default for hot volumes).
+- `SortedFileNeedleMap`: binary search over a sealed, sorted .ecx-style
+  file (reference weed/storage/erasure_coding/ec_volume.go:501).
+- `MemDb`: numpy-backed builder used to convert a write-ordered .idx
+  into a sorted .ecx (reference ec_encoder.go:32-59).
+
+All on-disk entries are the 16-byte big-endian format from types.py.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, Optional
+
+import numpy as np
+
+from .types import (
+    NEEDLE_MAP_ENTRY_SIZE,
+    TOMBSTONE_FILE_SIZE,
+    NeedleValue,
+)
+
+
+def walk_index_file(path: str) -> Iterator[NeedleValue]:
+    """Yield idx entries in write order (reference weed/storage/idx)."""
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(NEEDLE_MAP_ENTRY_SIZE * 4096)
+            if not chunk:
+                return
+            usable = len(chunk) - (len(chunk) % NEEDLE_MAP_ENTRY_SIZE)
+            for i in range(0, usable, NEEDLE_MAP_ENTRY_SIZE):
+                yield NeedleValue.from_bytes(chunk[i : i + NEEDLE_MAP_ENTRY_SIZE])
+
+
+class MemoryNeedleMap:
+    """Write-through needle map: dict in memory + append-only .idx file."""
+
+    def __init__(self, idx_path: str):
+        self.idx_path = idx_path
+        self._map: dict[int, NeedleValue] = {}
+        self.file_counter = 0
+        self.deleted_counter = 0
+        self.deleted_bytes = 0
+        self._idx_file = None
+        if os.path.exists(idx_path):
+            for nv in walk_index_file(idx_path):
+                self._replay(nv)
+        self._idx_file = open(idx_path, "ab")
+
+    def _replay(self, nv: NeedleValue) -> None:
+        if nv.is_deleted:
+            old = self._map.pop(nv.needle_id, None)
+            if old is not None:
+                self.deleted_counter += 1
+                self.deleted_bytes += old.size
+        else:
+            self._log_put(nv)
+
+    def _log_put(self, nv: NeedleValue) -> None:
+        # Overwrites dead-record the previous copy in the .dat; count it
+        # as garbage so vacuum triggers (reference needle_map_metric.go
+        # logPut adds oldSize to the deletion counters).
+        old = self._map.get(nv.needle_id)
+        self._map[nv.needle_id] = nv
+        self.file_counter += 1
+        if old is not None and old.size > 0:
+            self.deleted_counter += 1
+            self.deleted_bytes += old.size
+
+    def put(self, needle_id: int, offset: int, size: int) -> None:
+        nv = NeedleValue(needle_id, offset, size)
+        self._log_put(nv)
+        self._idx_file.write(nv.to_bytes())
+
+    def delete(self, needle_id: int) -> int:
+        """Append a tombstone; returns freed byte count (0 if absent)."""
+        old = self._map.pop(needle_id, None)
+        self._idx_file.write(
+            NeedleValue(needle_id, 0, TOMBSTONE_FILE_SIZE).to_bytes()
+        )
+        if old is None:
+            return 0
+        self.deleted_counter += 1
+        self.deleted_bytes += old.size
+        return old.size
+
+    def get(self, needle_id: int) -> Optional[NeedleValue]:
+        return self._map.get(needle_id)
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def ascending_visit(self) -> Iterator[NeedleValue]:
+        for nid in sorted(self._map):
+            yield self._map[nid]
+
+    def flush(self) -> None:
+        if self._idx_file:
+            self._idx_file.flush()
+            os.fsync(self._idx_file.fileno())
+
+    def close(self) -> None:
+        if self._idx_file:
+            self._idx_file.flush()
+            self._idx_file.close()
+            self._idx_file = None
+
+
+class MemDb:
+    """In-memory id->entry store for index conversions (.idx -> .ecx)."""
+
+    def __init__(self):
+        self._map: dict[int, NeedleValue] = {}
+
+    def load_idx(self, idx_path: str) -> None:
+        for nv in walk_index_file(idx_path):
+            if nv.is_deleted:
+                self._map.pop(nv.needle_id, None)
+            else:
+                self._map[nv.needle_id] = nv
+
+    def put(self, nv: NeedleValue) -> None:
+        self._map[nv.needle_id] = nv
+
+    def ascending_visit(self) -> Iterator[NeedleValue]:
+        for nid in sorted(self._map):
+            yield self._map[nid]
+
+    def write_sorted_file(self, path: str) -> None:
+        """Write entries ascending by needleId, fsync'd (sealed .ecx)."""
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            for nv in self.ascending_visit():
+                f.write(nv.to_bytes())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        dirfd = os.open(os.path.dirname(os.path.abspath(path)) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+
+class SortedFileNeedleMap:
+    """Binary search over a sealed sorted index file (.ecx semantics).
+
+    A partial trailing record means corruption (reference
+    ec_decoder.go:152-156 treats it as fatal).
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        size = os.path.getsize(path)
+        if size % NEEDLE_MAP_ENTRY_SIZE != 0:
+            raise ValueError(f"{path}: corrupt sorted index (partial record)")
+        self.count = size // NEEDLE_MAP_ENTRY_SIZE
+        # Only the 8-byte id column stays resident for the binary search;
+        # full 16-byte entries are pread on demand, so a sealed index of
+        # tens of millions of needles costs 8B/needle of RAM, not 16B+file.
+        raw = np.fromfile(path, dtype=np.uint8).reshape(self.count, NEEDLE_MAP_ENTRY_SIZE)
+        self._ids = raw[:, :8].copy().view(">u8").reshape(self.count)
+        self._fd = os.open(path, os.O_RDONLY)
+
+    def _entry(self, i: int) -> NeedleValue:
+        b = os.pread(self._fd, NEEDLE_MAP_ENTRY_SIZE, i * NEEDLE_MAP_ENTRY_SIZE)
+        return NeedleValue.from_bytes(b)
+
+    def get(self, needle_id: int) -> Optional[NeedleValue]:
+        i = int(np.searchsorted(self._ids, needle_id))
+        if i >= self.count or int(self._ids[i]) != needle_id:
+            return None
+        return self._entry(i)
+
+    def ascending_visit(self) -> Iterator[NeedleValue]:
+        for i in range(self.count):
+            yield self._entry(i)
+
+    def close(self) -> None:
+        if self._fd >= 0:
+            os.close(self._fd)
+            self._fd = -1
+
+    def __len__(self) -> int:
+        return self.count

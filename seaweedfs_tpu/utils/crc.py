@@ -1,0 +1,88 @@
+"""CRC32C (Castagnoli) — needle checksums and the .ecsum bitrot sidecar.
+
+The reference uses CRC32-Castagnoli for both needle checksums and the
+per-shard-block bitrot sums (weed/storage/needle/crc.go,
+weed/storage/erasure_coding/ec_bitrot.go). Uses the C++ native core
+(native/libseaweed_native.so, hardware CRC32C when available) and falls
+back to a numpy slice-by-8 table implementation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+CASTAGNOLI_POLY = 0x82F63B78  # reflected
+
+
+def _make_tables(n: int = 8) -> np.ndarray:
+    t = np.zeros((n, 256), dtype=np.uint32)
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ (CASTAGNOLI_POLY if crc & 1 else 0)
+        t[0, i] = crc
+    for k in range(1, n):
+        for i in range(256):
+            t[k, i] = (t[k - 1, i] >> 8) ^ t[0, t[k - 1, i] & 0xFF]
+    return t
+
+
+_TABLES = _make_tables()
+
+
+def _crc32c_py(data: bytes | np.ndarray, crc: int = 0) -> int:
+    """Slice-by-8 in a python loop over 8-byte strides (fallback path)."""
+    buf = np.frombuffer(bytes(data), dtype=np.uint8)
+    crc = (~crc) & 0xFFFFFFFF
+    t = _TABLES
+    n = len(buf)
+    i = 0
+    # process unaligned prefix bytewise
+    while i < n and i % 8 != 0:
+        crc = (crc >> 8) ^ int(t[0, (crc ^ buf[i]) & 0xFF])
+        i += 1
+    n8 = (n - i) // 8
+    if n8:
+        words = buf[i : i + n8 * 8].reshape(n8, 8)
+        for row in words:
+            w = crc ^ int(row[0]) ^ (int(row[1]) << 8) ^ (int(row[2]) << 16) ^ (
+                int(row[3]) << 24
+            )
+            crc = (
+                int(t[7, w & 0xFF])
+                ^ int(t[6, (w >> 8) & 0xFF])
+                ^ int(t[5, (w >> 16) & 0xFF])
+                ^ int(t[4, (w >> 24) & 0xFF])
+                ^ int(t[3, int(row[4])])
+                ^ int(t[2, int(row[5])])
+                ^ int(t[1, int(row[6])])
+                ^ int(t[0, int(row[7])])
+            )
+        i += n8 * 8
+    while i < n:
+        crc = (crc >> 8) ^ int(t[0, (crc ^ int(buf[i])) & 0xFF])
+        i += 1
+    return (~crc) & 0xFFFFFFFF
+
+
+_native_crc = None
+
+
+def _load_native():
+    global _native_crc
+    if _native_crc is None:
+        try:
+            from . import native
+
+            _native_crc = native.crc32c
+        except Exception:
+            _native_crc = False
+    return _native_crc
+
+
+def crc32c(data, crc: int = 0) -> int:
+    """CRC32C of `data`, optionally continuing from a previous value."""
+    fn = _load_native()
+    if fn:
+        return fn(data, crc)
+    return _crc32c_py(data, crc)

@@ -1,0 +1,83 @@
+"""ctypes loader for the C++ native core (native/libseaweed_native.so).
+
+Builds on first use if the shared object is missing (make in native/).
+All callers must tolerate ImportError and fall back to pure Python —
+the native core is an accelerator, not a dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_SO_PATH = os.path.join(_NATIVE_DIR, "libseaweed_native.so")
+
+
+def _build() -> None:
+    subprocess.run(
+        ["make", "-s", "-C", _NATIVE_DIR], check=True, capture_output=True
+    )
+
+
+if not os.path.exists(_SO_PATH):
+    _build()
+
+_lib = ctypes.CDLL(_SO_PATH)
+
+_lib.sn_crc32c.restype = ctypes.c_uint32
+_lib.sn_crc32c.argtypes = [ctypes.c_uint32, ctypes.c_char_p, ctypes.c_size_t]
+_lib.sn_rs_apply.restype = None
+_lib.sn_rs_apply.argtypes = [
+    ctypes.c_char_p,
+    ctypes.c_int,
+    ctypes.c_int,
+    ctypes.c_void_p,
+    ctypes.c_void_p,
+    ctypes.c_size_t,
+]
+_lib.sn_gf_mul.restype = ctypes.c_uint8
+_lib.sn_gf_mul.argtypes = [ctypes.c_uint8, ctypes.c_uint8]
+_lib.sn_has_avx2.restype = ctypes.c_int
+
+
+def crc32c(data, crc: int = 0) -> int:
+    if isinstance(data, np.ndarray):
+        data = data.tobytes()
+    elif isinstance(data, (bytearray, memoryview)):
+        data = bytes(data)
+    return _lib.sn_crc32c(crc, data, len(data))
+
+
+def rs_apply(coeffs: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """out[r] = XOR_j gf_mul(coeffs[r,j], data[j]) over contiguous rows."""
+    coeffs = np.ascontiguousarray(coeffs, dtype=np.uint8)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    out_rows, in_rows = coeffs.shape
+    if data.shape[0] != in_rows:
+        raise ValueError(f"coeffs expect {in_rows} rows, got {data.shape[0]}")
+    n = data.shape[1]
+    out = np.empty((out_rows, n), dtype=np.uint8)
+    _lib.sn_rs_apply(
+        coeffs.tobytes(),
+        out_rows,
+        in_rows,
+        data.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p),
+        n,
+    )
+    return out
+
+
+def gf_mul(a: int, b: int) -> int:
+    return _lib.sn_gf_mul(a, b)
+
+
+def has_avx2() -> bool:
+    return bool(_lib.sn_has_avx2())

@@ -7,12 +7,22 @@ path via __graft_entry__.dryrun_multichip).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the session profile sets JAX_PLATFORMS=axon
+# (the real TPU tunnel); unit tests must stay on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon TPU plugin's sitecustomize imports jax at interpreter startup,
+# which freezes jax_platforms to "axon" before this file runs; if the TPU
+# relay is down, any backend init then hangs forever. Overriding the env
+# var is too late — update the live jax config instead.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,228 @@
+"""Storage engine tests: needle codec, needle maps, volume lifecycle.
+
+Modeled on the reference's storage-engine unit style (fabricated volume
+files, roundtrip + crash/corruption scenarios)."""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.storage.needle import (
+    CrcError,
+    Needle,
+    VERSION2,
+    VERSION3,
+)
+from seaweedfs_tpu.storage.needle_map import (
+    MemDb,
+    MemoryNeedleMap,
+    SortedFileNeedleMap,
+    walk_index_file,
+)
+from seaweedfs_tpu.storage.super_block import ReplicaPlacement, SuperBlock
+from seaweedfs_tpu.storage.types import NeedleValue, padded_record_size
+from seaweedfs_tpu.storage.volume import (
+    CookieMismatch,
+    NotFoundError,
+    ReadOnlyError,
+    Volume,
+)
+
+
+class TestNeedleCodec:
+    def test_roundtrip_minimal(self):
+        n = Needle(cookie=0xDEADBEEF, needle_id=0x1234, data=b"hello world")
+        for v in (VERSION2, VERSION3):
+            raw = n.to_bytes(v)
+            assert len(raw) % 8 == 0
+            m = Needle.from_bytes(raw, v)
+            assert m.cookie == n.cookie
+            assert m.needle_id == n.needle_id
+            assert m.data == b"hello world"
+
+    def test_roundtrip_all_fields(self):
+        n = Needle(cookie=7, needle_id=42, data=b"x" * 1000)
+        n.set_name(b"file.txt")
+        n.set_mime(b"text/plain")
+        n.set_last_modified(1700000000)
+        n.set_ttl(b"\x05m")
+        n.set_pairs(b'{"k":"v"}')
+        raw = n.to_bytes(VERSION3)
+        m = Needle.from_bytes(raw, VERSION3)
+        assert m.name == b"file.txt"
+        assert m.mime == b"text/plain"
+        assert m.last_modified == 1700000000
+        assert m.ttl == b"\x05m"
+        assert m.pairs == b'{"k":"v"}'
+        assert m.append_at_ns == n.append_at_ns
+        assert m.disk_size(VERSION3) == len(raw)
+
+    def test_crc_detects_corruption(self):
+        n = Needle(cookie=1, needle_id=2, data=b"payload-bytes")
+        raw = bytearray(n.to_bytes(VERSION3))
+        raw[20] ^= 0xFF  # flip a data byte
+        with pytest.raises(CrcError):
+            Needle.from_bytes(bytes(raw), VERSION3)
+
+    def test_empty_needle_is_tombstone_shaped(self):
+        n = Needle(cookie=0, needle_id=9)
+        raw = n.to_bytes(VERSION3)
+        _, nid, size = Needle.parse_header(raw)
+        assert nid == 9 and size == 0
+
+    def test_padding(self):
+        for ln in range(0, 40):
+            n = Needle(cookie=1, needle_id=1, data=b"a" * ln)
+            assert len(n.to_bytes(VERSION3)) % 8 == 0
+
+
+class TestSuperBlock:
+    def test_roundtrip(self):
+        sb = SuperBlock(
+            version=3,
+            replica_placement=ReplicaPlacement.parse("210"),
+            ttl=b"\x03h",
+            compaction_revision=7,
+        )
+        raw = sb.to_bytes()
+        assert len(raw) == 8
+        sb2 = SuperBlock.from_bytes(raw)
+        assert sb2.version == 3
+        assert str(sb2.replica_placement) == "210"
+        assert sb2.ttl == b"\x03h"
+        assert sb2.compaction_revision == 7
+
+    def test_replica_placement_copy_count(self):
+        assert ReplicaPlacement.parse("000").copy_count == 1
+        assert ReplicaPlacement.parse("001").copy_count == 2
+        assert ReplicaPlacement.parse("210").copy_count == 4
+
+
+class TestNeedleMaps:
+    def test_memory_map_replay(self, tmp_path):
+        idx = str(tmp_path / "1.idx")
+        m = MemoryNeedleMap(idx)
+        m.put(10, 1, 100)
+        m.put(20, 2, 200)
+        m.delete(10)
+        m.close()
+        m2 = MemoryNeedleMap(idx)
+        assert m2.get(10) is None
+        assert m2.get(20).size == 200
+        assert m2.deleted_counter == 1
+        m2.close()
+
+    def test_walk_index_file(self, tmp_path):
+        idx = str(tmp_path / "2.idx")
+        m = MemoryNeedleMap(idx)
+        for i in range(5):
+            m.put(i, i, i * 10)
+        m.close()
+        entries = list(walk_index_file(idx))
+        assert [e.needle_id for e in entries] == list(range(5))
+
+    def test_memdb_sorted_file(self, tmp_path):
+        db = MemDb()
+        for nid in (5, 1, 9, 3):
+            db.put(NeedleValue(nid, nid, nid * 2))
+        path = str(tmp_path / "x.ecx")
+        db.write_sorted_file(path)
+        sf = SortedFileNeedleMap(path)
+        assert len(sf) == 4
+        assert [e.needle_id for e in sf.ascending_visit()] == [1, 3, 5, 9]
+        assert sf.get(9).size == 18
+        assert sf.get(2) is None
+
+    def test_sorted_file_partial_record_fatal(self, tmp_path):
+        path = str(tmp_path / "bad.ecx")
+        with open(path, "wb") as f:
+            f.write(b"\x00" * 20)  # not a multiple of 16
+        with pytest.raises(ValueError):
+            SortedFileNeedleMap(path)
+
+
+class TestVolume:
+    def test_write_read_delete(self, tmp_path):
+        v = Volume(str(tmp_path), 1)
+        n = Needle(cookie=0xABCD, needle_id=100, data=b"blob-data")
+        v.write_needle(n)
+        got = v.read_needle(100)
+        assert got.data == b"blob-data"
+        with pytest.raises(CookieMismatch):
+            v.read_needle(100, cookie=0x9999)
+        assert v.read_needle(100, cookie=0xABCD).data == b"blob-data"
+        freed = v.delete_needle(100)
+        assert freed > 0
+        with pytest.raises(NotFoundError):
+            v.read_needle(100)
+        v.close()
+
+    def test_reload_replays_index(self, tmp_path):
+        v = Volume(str(tmp_path), 2)
+        for i in range(20):
+            v.write_needle(Needle(cookie=i, needle_id=i, data=bytes([i]) * 50))
+        v.delete_needle(7)
+        v.close()
+        v2 = Volume(str(tmp_path), 2, create=False)
+        assert v2.read_needle(5).data == bytes([5]) * 50
+        with pytest.raises(NotFoundError):
+            v2.read_needle(7)
+        assert v2.stat().deleted_count == 1
+        v2.close()
+
+    def test_overwrite_appends(self, tmp_path):
+        v = Volume(str(tmp_path), 3)
+        v.write_needle(Needle(cookie=1, needle_id=1, data=b"v1"))
+        size_after_first = v.size
+        v.write_needle(Needle(cookie=1, needle_id=1, data=b"v2-new"))
+        assert v.size > size_after_first
+        assert v.read_needle(1).data == b"v2-new"
+        v.close()
+
+    def test_readonly(self, tmp_path):
+        v = Volume(str(tmp_path), 4)
+        v.write_needle(Needle(cookie=1, needle_id=1, data=b"a"))
+        v.set_read_only()
+        with pytest.raises(ReadOnlyError):
+            v.write_needle(Needle(cookie=1, needle_id=2, data=b"b"))
+        with pytest.raises(ReadOnlyError):
+            v.delete_needle(1)
+        assert v.read_needle(1).data == b"a"
+        v.close()
+
+    def test_vacuum_reclaims_and_preserves(self, tmp_path):
+        v = Volume(str(tmp_path), 5)
+        keep = {}
+        for i in range(50):
+            data = os.urandom(100 + i)
+            v.write_needle(Needle(cookie=i, needle_id=i, data=data))
+            keep[i] = data
+        for i in range(0, 50, 2):
+            v.delete_needle(i)
+            del keep[i]
+        rev_before = v.super_block.compaction_revision
+        reclaimed = v.vacuum()
+        assert reclaimed > 0
+        assert v.super_block.compaction_revision == rev_before + 1
+        for i, data in keep.items():
+            assert v.read_needle(i).data == data
+        for i in range(0, 50, 2):
+            with pytest.raises(NotFoundError):
+                v.read_needle(i)
+        assert v.garbage_ratio() == 0.0
+        v.close()
+        # reload after vacuum
+        v2 = Volume(str(tmp_path), 5, create=False)
+        for i, data in keep.items():
+            assert v2.read_needle(i).data == data
+        v2.close()
+
+    def test_garbage_ratio(self, tmp_path):
+        v = Volume(str(tmp_path), 6)
+        v.write_needle(Needle(cookie=1, needle_id=1, data=b"z" * 1000))
+        assert v.garbage_ratio() == 0.0
+        v.delete_needle(1)
+        assert v.garbage_ratio() > 0.0
+        v.close()
