@@ -1,0 +1,33 @@
+"""Erasure-coding pipeline: the framework's north-star component.
+
+Encode/rebuild/decode/read with pluggable CPU (C++ SIMD) and TPU
+(JAX/Pallas bit-matmul) Reed-Solomon backends, bit-identical outputs.
+"""
+
+from .backend import CpuBackend, JaxBackend, get_backend
+from .bitrot import BitrotError, BitrotProtection, ShardChecksumBuilder
+from .context import (
+    BITROT_BLOCK_SIZE,
+    DATA_SHARDS,
+    DEFAULT_EC_CONTEXT,
+    LARGE_BLOCK_SIZE,
+    MAX_SHARD_COUNT,
+    PARITY_SHARDS,
+    SMALL_BLOCK_SIZE,
+    TOTAL_SHARDS,
+    ECContext,
+    ECError,
+)
+from .decoder import (
+    ec_decode_volume,
+    find_dat_file_size,
+    has_live_needles,
+    rebuild_ecx_file,
+    write_dat_file,
+    write_idx_from_ecx,
+)
+from .ec_volume import EcCookieMismatch, EcNotFoundError, EcVolume
+from .encoder import ec_encode_volume, write_ec_files, write_sorted_file_from_idx
+from .locate import Interval, locate_data
+from .rebuild import rebuild_ec_files
+from .volume_info import VolumeInfo

@@ -1,0 +1,185 @@
+"""Bitrot-protection sidecar: <base>.ecsum.
+
+Per shard, a CRC32C per 16 MiB block, computed in the same pass that
+writes the shard bytes. Self-checksummed header so a corrupt sidecar is
+detected rather than trusted (reference ec_bitrot.go:15-58; this build
+uses its own deterministic little-endian payload instead of protobuf).
+
+File layout:
+  [magic 'ECSU'(4, BE) | format_version=1 (u16 LE) | payload_len (u32 LE)
+   | payload_crc32c (u32 LE)] [payload]
+
+Payload (all LE):
+  block_size u32 | generation u64 | data_shards u8 | parity_shards u8
+  | uuid (16 raw bytes)
+  | per shard (total times): shard_size u64 | crc_count u32 | crcs u32...
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import uuid as uuid_mod
+from dataclasses import dataclass, field
+
+from ..utils.crc import crc32c
+from .context import BITROT_BLOCK_SIZE, ECContext, ECError
+
+MAGIC = 0x45435355  # "ECSU"
+FORMAT_VERSION = 1
+_HEADER = struct.Struct(">I")  # magic, big-endian like the reference
+_HEADER_REST = struct.Struct("<HII")  # version, payload_len, payload_crc
+
+
+class BitrotError(ECError):
+    pass
+
+
+class ShardChecksumBuilder:
+    """Rolling per-block CRC32C accumulator for one shard's byte stream."""
+
+    def __init__(self, block_size: int = BITROT_BLOCK_SIZE):
+        self.block_size = block_size
+        self.crcs: list[int] = []
+        self._crc = 0
+        self._filled = 0
+        self.total = 0
+
+    def write(self, data: bytes | memoryview) -> None:
+        data = memoryview(data)
+        self.total += len(data)
+        while len(data) > 0:
+            room = self.block_size - self._filled
+            take = min(room, len(data))
+            self._crc = crc32c(bytes(data[:take]), self._crc)
+            self._filled += take
+            data = data[take:]
+            if self._filled == self.block_size:
+                self.crcs.append(self._crc)
+                self._crc = 0
+                self._filled = 0
+
+    def finish(self) -> list[int]:
+        if self._filled > 0:
+            self.crcs.append(self._crc)
+            self._crc = 0
+            self._filled = 0
+        return self.crcs
+
+
+@dataclass
+class BitrotProtection:
+    """Decoded .ecsum contents."""
+
+    ctx: ECContext
+    block_size: int = BITROT_BLOCK_SIZE
+    generation: int = 0  # EncodeTsNs generation stamp
+    uuid: bytes = b"\x00" * 16
+    shard_sizes: list[int] = field(default_factory=list)
+    shard_crcs: list[list[int]] = field(default_factory=list)
+
+    @classmethod
+    def from_builders(
+        cls,
+        ctx: ECContext,
+        builders: list[ShardChecksumBuilder],
+        generation: int = 0,
+    ) -> "BitrotProtection":
+        if len(builders) != ctx.total:
+            raise BitrotError(f"expected {ctx.total} builders, got {len(builders)}")
+        return cls(
+            ctx=ctx,
+            block_size=builders[0].block_size,
+            generation=generation,
+            uuid=uuid_mod.uuid4().bytes,
+            shard_sizes=[b.total for b in builders],
+            shard_crcs=[b.finish() for b in builders],
+        )
+
+    # ---- serialization ----
+
+    def to_bytes(self) -> bytes:
+        parts = [
+            struct.pack(
+                "<IQBB",
+                self.block_size,
+                self.generation,
+                self.ctx.data_shards,
+                self.ctx.parity_shards,
+            ),
+            self.uuid,
+        ]
+        for size, crcs in zip(self.shard_sizes, self.shard_crcs):
+            parts.append(struct.pack("<QI", size, len(crcs)))
+            parts.append(struct.pack(f"<{len(crcs)}I", *crcs))
+        payload = b"".join(parts)
+        header = _HEADER.pack(MAGIC) + _HEADER_REST.pack(
+            FORMAT_VERSION, len(payload), crc32c(payload)
+        )
+        return header + payload
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "BitrotProtection":
+        hs = _HEADER.size + _HEADER_REST.size
+        if len(raw) < hs:
+            raise BitrotError("sidecar too short")
+        (magic,) = _HEADER.unpack(raw[: _HEADER.size])
+        version, plen, pcrc = _HEADER_REST.unpack(raw[_HEADER.size : hs])
+        if magic != MAGIC:
+            raise BitrotError(f"bad magic {magic:08x}")
+        if version != FORMAT_VERSION:
+            raise BitrotError(f"unsupported sidecar version {version}")
+        payload = raw[hs : hs + plen]
+        if len(payload) != plen:
+            raise BitrotError("truncated payload")
+        if crc32c(payload) != pcrc:
+            raise BitrotError("payload checksum mismatch")
+        try:
+            block_size, generation, k, m = struct.unpack("<IQBB", payload[:14])
+            uid = payload[14:30]
+            ctx = ECContext(k, m)
+            p = 30
+            sizes, crcs = [], []
+            for _ in range(ctx.total):
+                size, count = struct.unpack("<QI", payload[p : p + 12])
+                p += 12
+                row = list(struct.unpack(f"<{count}I", payload[p : p + 4 * count]))
+                p += 4 * count
+                sizes.append(size)
+                crcs.append(row)
+            if p != plen:
+                raise BitrotError("trailing bytes in payload")
+        except struct.error as e:
+            raise BitrotError(f"malformed payload: {e}") from None
+        return cls(ctx, block_size, generation, uid, sizes, crcs)
+
+    # ---- file io ----
+
+    def save(self, path: str) -> None:
+        from ..utils.fs import atomic_write
+
+        atomic_write(path, self.to_bytes())
+
+    @classmethod
+    def load(cls, path: str) -> "BitrotProtection":
+        with open(path, "rb") as f:
+            return cls.from_bytes(f.read())
+
+    # ---- verification ----
+
+    def verify_shard_file(self, path: str, shard_id: int) -> list[int]:
+        """-> list of mismatched block indices ([] = clean).
+
+        A size mismatch counts as every expected block mismatching
+        (truncation is corruption, reference fail-closed rule).
+        """
+        expected = self.shard_crcs[shard_id]
+        if os.path.getsize(path) != self.shard_sizes[shard_id]:
+            return list(range(max(len(expected), 1)))
+        bad = []
+        with open(path, "rb") as f:
+            for i, want in enumerate(expected):
+                block = f.read(self.block_size)
+                if crc32c(block) != want:
+                    bad.append(i)
+        return bad

@@ -1,0 +1,242 @@
+"""EC decode: shard files -> normal volume (.dat + .idx).
+
+Reference: weed/storage/erasure_coding/ec_decoder.go — .ecx+.ecj -> .idx
+(tombstones appended for journaled deletes), live extent from the max
+.ecx entry, de-striping honoring the encode-time layout, and crash-safe
+temp+fsync+rename+dir-fsync publication throughout.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator
+
+from ..storage.needle import footer_size
+from ..storage.super_block import SUPER_BLOCK_SIZE
+from ..utils.fs import fsync_dir as _fsync_dir
+from ..storage.types import (
+    NEEDLE_HEADER_SIZE,
+    NEEDLE_MAP_ENTRY_SIZE,
+    TOMBSTONE_FILE_SIZE,
+    NeedleValue,
+    actual_offset,
+    padded_record_size,
+)
+from .context import LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE, ECError
+from .volume_info import VolumeInfo
+
+
+def iterate_ecx(base: str) -> Iterator[NeedleValue]:
+    with open(base + ".ecx", "rb") as f:
+        while True:
+            b = f.read(NEEDLE_MAP_ENTRY_SIZE)
+            if not b:
+                return
+            if len(b) != NEEDLE_MAP_ENTRY_SIZE:
+                raise ECError(f"{base}.ecx: partial trailing record (corrupt)")
+            yield NeedleValue.from_bytes(b)
+
+
+def iterate_ecj(base: str) -> Iterator[int]:
+    path = base + ".ecj"
+    if not os.path.exists(path):
+        return
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(8)
+            if len(b) < 8:
+                return
+            yield struct.unpack(">Q", b)[0]
+
+
+def has_live_needles(base: str) -> bool:
+    """True if .ecx holds at least one non-deleted entry (reference
+    HasLiveNeedles; used by ec.decode to no-op fully-deleted volumes).
+    Runtime deletes live in .ecj until rebuild_ecx_file folds them in —
+    callers run that first, as the reference's decode RPC does."""
+    for nv in iterate_ecx(base):
+        if not nv.is_deleted:
+            return True
+    return False
+
+
+def rebuild_ecx_file(base: str) -> None:
+    """Fold the .ecj deletion journal into .ecx as in-place tombstones,
+    then drop the journal (reference RebuildEcxFile,
+    ec_volume_delete.go:103; run before decode and shard-set moves)."""
+    ecj = base + ".ecj"
+    if not os.path.exists(ecj):
+        return
+    size = os.path.getsize(base + ".ecx")
+    count = size // NEEDLE_MAP_ENTRY_SIZE
+    with open(base + ".ecx", "r+b") as f:
+
+        def search(nid: int) -> int:
+            lo, hi = 0, count
+            while lo < hi:
+                mid = (lo + hi) // 2
+                f.seek(mid * NEEDLE_MAP_ENTRY_SIZE)
+                entry = NeedleValue.from_bytes(f.read(NEEDLE_MAP_ENTRY_SIZE))
+                if entry.needle_id == nid:
+                    return mid
+                if entry.needle_id < nid:
+                    lo = mid + 1
+                else:
+                    hi = mid
+            return -1
+
+        for nid in iterate_ecj(base):
+            i = search(nid)
+            if i < 0:
+                continue
+            # size field lives after needleId(8) + offset(4)
+            f.seek(i * NEEDLE_MAP_ENTRY_SIZE + 12)
+            f.write(struct.pack(">i", TOMBSTONE_FILE_SIZE))
+        f.flush()
+        os.fsync(f.fileno())
+    os.unlink(ecj)
+    _fsync_dir(ecj)
+
+
+def record_actual_size(size: int, version: int) -> int:
+    """Full on-disk record length for an idx `size` (GetActualSize)."""
+    return padded_record_size(NEEDLE_HEADER_SIZE + size + footer_size(version))
+
+
+def write_idx_from_ecx(base: str) -> None:
+    """.ecx + .ecj -> .idx (sorted entries then journaled tombstones),
+    atomically published."""
+    idx_path = base + ".idx"
+    tmp = idx_path + ".tmp"
+    try:
+        with open(tmp, "wb") as out, open(base + ".ecx", "rb") as ecx:
+            while True:
+                chunk = ecx.read(1 << 20)
+                if not chunk:
+                    break
+                out.write(chunk)
+            for nid in iterate_ecj(base):
+                out.write(NeedleValue(nid, 0, TOMBSTONE_FILE_SIZE).to_bytes())
+            out.flush()
+            os.fsync(out.fileno())
+        os.replace(tmp, idx_path)
+        _fsync_dir(idx_path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def find_dat_file_size(base: str, version: int) -> int:
+    """Live data extent: max over live .ecx entries of record end; at
+    least the superblock (reference FindDatFileSize, issue #7748)."""
+    dat_size = SUPER_BLOCK_SIZE
+    for nv in iterate_ecx(base):
+        if nv.is_deleted:
+            continue
+        end = actual_offset(nv.offset) + record_actual_size(nv.size, version)
+        dat_size = max(dat_size, end)
+    return dat_size
+
+
+def write_dat_file(
+    base: str,
+    dat_file_size: int,
+    encoded_dat_file_size: int,
+    shard_paths: list[str],
+    large_block_size: int = LARGE_BLOCK_SIZE,
+    small_block_size: int = SMALL_BLOCK_SIZE,
+) -> None:
+    """De-stripe the k data shards back into base.dat (first
+    dat_file_size bytes). encoded_dat_file_size fixes the block layout;
+    pass 0 to infer it from the physical shard size (ambiguous when that
+    is an exact large-block multiple — then this fails closed, reference
+    writeDatFile)."""
+    if not shard_paths:
+        raise ECError("no data shard files")
+    k = len(shard_paths)
+
+    fds = [os.open(p, os.O_RDONLY) for p in shard_paths]
+    dat_path = base + ".dat"
+    tmp = dat_path + ".tmp"
+    try:
+        if encoded_dat_file_size <= 0:
+            shard_size = os.fstat(fds[0]).st_size
+            if (
+                shard_size % large_block_size == 0
+                and dat_file_size
+                > (shard_size // large_block_size - 1) * large_block_size * k
+            ):
+                raise ECError(
+                    f"shard size {shard_size} does not identify the block "
+                    f"layout; re-encode to record the dat size in .vif"
+                )
+            encoded_dat_file_size = k * shard_size
+        if dat_file_size > encoded_dat_file_size:
+            raise ECError(
+                f"dat size {dat_file_size} exceeds encoded size {encoded_dat_file_size}"
+            )
+
+        large_rows = encoded_dat_file_size // (k * large_block_size)
+        with open(tmp, "wb") as out:
+            remaining = dat_file_size
+            shard_off = 0
+            # Large rows, then small rows; within a row, shard order.
+            row = 0
+            while remaining > 0:
+                if row < large_rows:
+                    block = large_block_size
+                    off = row * large_block_size
+                else:
+                    block = small_block_size
+                    off = large_rows * large_block_size + (
+                        row - large_rows
+                    ) * small_block_size
+                for fd in fds:
+                    if remaining <= 0:
+                        break
+                    take = min(remaining, block)
+                    pos = 0
+                    while pos < take:
+                        got = os.pread(fd, min(1 << 20, take - pos), off + pos)
+                        if not got:
+                            raise ECError(f"short shard read at {off + pos}")
+                        out.write(got)
+                        pos += len(got)
+                    remaining -= take
+                row += 1
+            out.flush()
+            os.fsync(out.fileno())
+        os.replace(tmp, dat_path)
+        _fsync_dir(dat_path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    finally:
+        for fd in fds:
+            os.close(fd)
+
+
+def ec_decode_volume(base: str, ctx=None) -> bool:
+    """Shards -> normal volume. Returns False (no-op) when no live
+    needles remain. Layout and version come from the .vif."""
+    vi = VolumeInfo.maybe_load(base + ".vif") or VolumeInfo()
+    if ctx is None:
+        from .context import DEFAULT_EC_CONTEXT
+
+        ctx = vi.ec_ctx or DEFAULT_EC_CONTEXT
+    rebuild_ecx_file(base)
+    if not has_live_needles(base):
+        return False
+    write_idx_from_ecx(base)
+    dat_size = find_dat_file_size(base, vi.version)
+    shard_paths = [base + ctx.to_ext(i) for i in range(ctx.data_shards)]
+    missing = [p for p in shard_paths if not os.path.exists(p)]
+    if missing:
+        raise ECError(f"missing data shards for decode: {missing}")
+    write_dat_file(base, dat_size, vi.dat_file_size, shard_paths)
+    return True
+
+

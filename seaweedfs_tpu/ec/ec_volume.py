@@ -1,0 +1,186 @@
+"""EcVolume: serve reads from mounted EC shards, with on-the-fly
+Reed-Solomon recovery of intervals whose shard is absent.
+
+Reference: weed/storage/erasure_coding/ec_volume.go (sealed .ecx binary
+search :501, .ecj-backed deletion set :425-455) and store_ec.go
+ReadEcShardNeedle/:656-747 (recover-by-reconstruction read path). Remote
+shard fetch arrives with the cluster layer; here recovery uses whatever
+shards are on local disk.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..storage.needle import Needle
+from ..storage.needle_map import SortedFileNeedleMap
+from ..storage.types import actual_offset
+from .backend import RSBackend, get_backend
+from .context import DEFAULT_EC_CONTEXT, ECContext, ECError
+from .decoder import record_actual_size
+from .locate import locate_data
+from .volume_info import VolumeInfo
+
+
+class EcNotFoundError(ECError):
+    pass
+
+
+class EcCookieMismatch(ECError):
+    pass
+
+
+class EcVolume:
+    def __init__(
+        self,
+        directory: str,
+        volume_id: int,
+        collection: str = "",
+        backend_name: str = "auto",
+    ):
+        from ..storage.volume import Volume
+
+        self.volume_id = volume_id
+        self.collection = collection
+        self.base = Volume.base_file_name(directory, collection, volume_id)
+        self._lock = threading.RLock()
+
+        vi = VolumeInfo.maybe_load(self.base + ".vif") or VolumeInfo()
+        self.version = vi.version
+        self.ctx: ECContext = vi.ec_ctx or DEFAULT_EC_CONTEXT
+        self.encode_ts_ns = vi.encode_ts_ns  # generation fence
+
+        self._ecx = SortedFileNeedleMap(self.base + ".ecx")
+        self._deleted: set[int] = set()
+        self._ecj = open(self.base + ".ecj", "ab+")
+        self._ecj.seek(0)
+        while True:
+            b = self._ecj.read(8)
+            if len(b) < 8:
+                break
+            self._deleted.add(struct.unpack(">Q", b)[0])
+
+        self.shard_fds: dict[int, int] = {}
+        self._shard_size = 0
+        for i in range(self.ctx.total):
+            p = self.base + self.ctx.to_ext(i)
+            if os.path.exists(p):
+                self.shard_fds[i] = os.open(p, os.O_RDONLY)
+                self._shard_size = os.path.getsize(p)
+
+        # Authoritative layout from the encode-time .dat size; fallback
+        # for .vif-less volumes mirrors the reference's shard-size-1
+        # disambiguation (ec_volume.go LocateEcShardNeedleInterval).
+        if vi.dat_file_size > 0:
+            self._locate_shard_size = vi.dat_file_size // self.ctx.data_shards
+        else:
+            self._locate_shard_size = max(self._shard_size - 1, 0)
+
+        self.backend: RSBackend = get_backend(
+            backend_name, self.ctx.data_shards, self.ctx.parity_shards
+        )
+
+    # ------------------------------------------------------------- lookup
+
+    def find_needle(self, needle_id: int):
+        nv = self._ecx.get(needle_id)
+        if nv is None:
+            return None
+        if needle_id in self._deleted:
+            return None
+        return nv
+
+    def has_needle(self, needle_id: int) -> bool:
+        nv = self.find_needle(needle_id)
+        return nv is not None and not nv.is_deleted
+
+    # --------------------------------------------------------------- read
+
+    def read_needle(self, needle_id: int, cookie: Optional[int] = None) -> Needle:
+        with self._lock:
+            nv = self.find_needle(needle_id)
+            if nv is None or nv.is_deleted:
+                raise EcNotFoundError(f"needle {needle_id:x} not found")
+            raw = self._read_extent(
+                actual_offset(nv.offset), record_actual_size(nv.size, self.version)
+            )
+        n = Needle.from_bytes(raw, self.version)
+        if cookie is not None and n.cookie != cookie:
+            raise EcCookieMismatch(f"needle {needle_id:x} cookie mismatch")
+        return n
+
+    def _read_extent(self, offset: int, size: int) -> bytes:
+        parts = []
+        for iv in locate_data(
+            offset, size, self._locate_shard_size, self.ctx.data_shards
+        ):
+            shard_id, shard_off = iv.to_shard_and_offset(self.ctx.data_shards)
+            parts.append(self._read_shard_interval(shard_id, shard_off, iv.size))
+        return b"".join(parts)
+
+    def _read_shard_interval(self, shard_id: int, offset: int, size: int) -> bytes:
+        fd = self.shard_fds.get(shard_id)
+        if fd is not None:
+            got = os.pread(fd, size, offset)
+            if len(got) == size:
+                return got
+            # short read = truncated shard; fall through to recovery
+        return self._recover_interval(shard_id, offset, size)
+
+    def _recover_interval(self, shard_id: int, offset: int, size: int) -> bytes:
+        """On-the-fly RS decode of one interval from >=k sibling shards
+        (reference store_ec.go:656-747)."""
+        k = self.ctx.data_shards
+        sources: dict[int, np.ndarray] = {}
+        for i, fd in self.shard_fds.items():
+            if i == shard_id:
+                continue
+            got = os.pread(fd, size, offset)
+            if len(got) != size:
+                continue
+            sources[i] = np.frombuffer(got, dtype=np.uint8)
+            if len(sources) == k:
+                break
+        if len(sources) < k:
+            raise ECError(
+                f"shard {shard_id} unavailable and only {len(sources)} "
+                f"sibling shards readable (need {k})"
+            )
+        rec = self.backend.reconstruct(sources, want=[shard_id])
+        return np.asarray(rec[shard_id], dtype=np.uint8).tobytes()
+
+    # ------------------------------------------------------------- delete
+
+    def delete_needle(self, needle_id: int) -> int:
+        """Journal an EC tombstone (reference ec_volume_delete.go)."""
+        with self._lock:
+            nv = self._ecx.get(needle_id)
+            if nv is None or nv.is_deleted or needle_id in self._deleted:
+                return 0
+            self._ecj.write(struct.pack(">Q", needle_id))
+            self._ecj.flush()
+            os.fsync(self._ecj.fileno())
+            self._deleted.add(needle_id)
+            return nv.size
+
+    # -------------------------------------------------------------- state
+
+    @property
+    def shard_ids(self) -> list[int]:
+        return sorted(self.shard_fds)
+
+    def shard_size(self) -> int:
+        return self._shard_size
+
+    def close(self) -> None:
+        with self._lock:
+            for fd in self.shard_fds.values():
+                os.close(fd)
+            self.shard_fds.clear()
+            self._ecj.close()
+            self._ecx.close()

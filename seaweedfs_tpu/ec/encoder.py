@@ -1,0 +1,150 @@
+"""EC encode: volume (.dat + .idx) -> .ec00.. shards, .ecx, .ecsum, .vif.
+
+Reference pipeline: weed/storage/erasure_coding/ec_encoder.go
+(WriteEcFiles / encodeDatFile / encodeDataOneBatch) and the server RPC
+VolumeEcShardsGenerate (volume_grpc_erasure_coding.go:45), which writes
+the .ecx BEFORE the shards to close a write race, then persists .ecsum
+and .vif.
+
+TPU-first divergence: the reference feeds its SIMD encoder 256KB
+buffers; a device wants batches in the tens of MB. Because parity is
+columnwise-independent, any batch split of a stripe row produces
+bit-identical shards, so the backend is fed `batch_size` columns at a
+time (default 16 MiB per shard => 160 MiB device input at 10+4) and the
+shard files/CRC builders are appended chunk by chunk in offset order.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from ..storage.needle_map import MemDb
+from .backend import RSBackend, get_backend
+from .bitrot import BitrotProtection, ShardChecksumBuilder
+from .context import (
+    LARGE_BLOCK_SIZE,
+    SMALL_BLOCK_SIZE,
+    DEFAULT_EC_CONTEXT,
+    ECContext,
+    ECError,
+)
+from .volume_info import VolumeInfo
+
+DEFAULT_BATCH = 16 * 1024 * 1024
+
+
+def _pread_padded(fd: int, buf: np.ndarray, offset: int) -> None:
+    """Fill `buf` from fd at `offset`, zero-padding past EOF."""
+    got = os.pread(fd, len(buf), offset)
+    n = len(got)
+    buf[:n] = np.frombuffer(got, dtype=np.uint8)
+    if n < len(buf):
+        buf[n:] = 0
+
+
+def write_sorted_file_from_idx(base: str, ext: str = ".ecx") -> None:
+    """Convert write-ordered .idx -> sorted sealed index (reference
+    WriteSortedFileFromIdx, ec_encoder.go:32-59)."""
+    db = MemDb()
+    db.load_idx(base + ".idx")
+    db.write_sorted_file(base + ext)
+
+
+def write_ec_files(
+    base: str,
+    ctx: ECContext = DEFAULT_EC_CONTEXT,
+    backend: RSBackend | None = None,
+    batch_size: int = DEFAULT_BATCH,
+    large_block_size: int = LARGE_BLOCK_SIZE,
+    small_block_size: int = SMALL_BLOCK_SIZE,
+) -> BitrotProtection:
+    """Stripe+encode base.dat into base.ec00..; returns bitrot CRCs
+    accumulated during the same pass."""
+    if backend is None:
+        backend = get_backend("auto", ctx.data_shards, ctx.parity_shards)
+    k, total = ctx.data_shards, ctx.total
+
+    dat_fd = os.open(base + ".dat", os.O_RDONLY)
+    builders = [ShardChecksumBuilder() for _ in range(total)]
+    outputs: list = []
+    try:
+        for i in range(total):
+            outputs.append(open(base + ctx.to_ext(i), "wb"))
+        dat_size = os.fstat(dat_fd).st_size
+        large_row = large_block_size * k
+        small_row = small_block_size * k
+
+        def encode_row(row_offset: int, block_size: int) -> None:
+            batch = min(batch_size, block_size)
+            data = np.empty((k, batch), dtype=np.uint8)
+            for chunk_off in range(0, block_size, batch):
+                width = min(batch, block_size - chunk_off)
+                view = data[:, :width]
+                for i in range(k):
+                    _pread_padded(
+                        dat_fd, view[i], row_offset + i * block_size + chunk_off
+                    )
+                parity = np.asarray(backend.encode(view), dtype=np.uint8)
+                for i in range(total):
+                    chunk = view[i] if i < k else parity[i - k]
+                    b = chunk.tobytes()
+                    outputs[i].write(b)
+                    builders[i].write(b)
+
+        processed = 0
+        remaining = dat_size
+        while remaining >= large_row:
+            encode_row(processed, large_block_size)
+            processed += large_row
+            remaining -= large_row
+        while remaining > 0:
+            encode_row(processed, small_block_size)
+            processed += small_row
+            remaining -= small_row
+
+        for f in outputs:
+            f.flush()
+            os.fsync(f.fileno())
+    finally:
+        os.close(dat_fd)
+        for f in outputs:
+            f.close()
+    from ..utils.fs import fsync_dir
+
+    fsync_dir(base + ".dat")
+    return BitrotProtection.from_builders(ctx, builders)
+
+
+def ec_encode_volume(
+    base: str,
+    ctx: ECContext = DEFAULT_EC_CONTEXT,
+    backend: RSBackend | None = None,
+    batch_size: int = DEFAULT_BATCH,
+    version: int = 3,
+) -> VolumeInfo:
+    """Full encode of one volume's files (the server-side work of
+    VolumeEcShardsGenerate). Order matters: .ecx first (write-race
+    close, volume_grpc_erasure_coding.go:107-116), then shards, then
+    .ecsum + .vif."""
+    if not os.path.exists(base + ".dat"):
+        raise ECError(f"{base}.dat not found")
+    if not os.path.exists(base + ".idx"):
+        raise ECError(f"{base}.idx not found")
+
+    encode_ts_ns = time.time_ns()
+    write_sorted_file_from_idx(base)
+    prot = write_ec_files(base, ctx, backend, batch_size)
+    prot.generation = encode_ts_ns
+    prot.save(base + ".ecsum")
+
+    vi = VolumeInfo(
+        version=version,
+        ec_ctx=ctx,
+        dat_file_size=os.path.getsize(base + ".dat"),
+        encode_ts_ns=encode_ts_ns,
+    )
+    vi.save(base + ".vif")
+    return vi

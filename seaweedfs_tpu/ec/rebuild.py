@@ -1,0 +1,179 @@
+"""Rebuild missing EC shards from surviving ones.
+
+Reference: weed/storage/erasure_coding/ec_encoder.go generateMissingEcFiles
+(:147-379). The correctness envelope preserved here (the reference's
+accumulated bug-fix scar tissue, SURVEY.md hard part (c)):
+
+- bitrot sidecar verify-and-exclude: present-but-corrupt shards are
+  reclassified as missing and regenerated, never fed to Reed-Solomon;
+- fail-closed rules: malformed sidecar refuses; >parity mismatches means
+  the *sidecar* is suspect (wholesale-mismatch guard) and refuses;
+  fewer than k verified-good shards refuses;
+- regenerated shards are verified against the sidecar before publish;
+- temp file + fsync + atomic rename (+ dir fsync) publication; corrupt
+  originals replaced in place only after their replacement verifies.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .backend import RSBackend, get_backend
+from .bitrot import BitrotError, BitrotProtection, ShardChecksumBuilder
+from .context import DEFAULT_EC_CONTEXT, ECContext, ECError
+from .decoder import _fsync_dir
+from .encoder import DEFAULT_BATCH
+from .volume_info import VolumeInfo
+
+
+def rebuild_ec_files(
+    base: str,
+    ctx: ECContext | None = None,
+    backend: RSBackend | None = None,
+    unsafe_ignore_sidecar: bool = False,
+    batch_size: int = DEFAULT_BATCH,
+) -> list[int]:
+    """Regenerate missing/corrupt shard files; returns regenerated ids."""
+    # Sidecar first: it records the shard ratio too, which backs up the
+    # .vif for config resolution and cross-checks it.
+    prot: BitrotProtection | None = None
+    ecsum = base + ".ecsum"
+    if os.path.exists(ecsum):
+        try:
+            prot = BitrotProtection.load(ecsum)
+        except BitrotError as e:
+            if not unsafe_ignore_sidecar:
+                raise ECError(
+                    f"bitrot sidecar for {base} is malformed ({e}); refusing "
+                    f"to rebuild (pass unsafe_ignore_sidecar to override)"
+                ) from e
+            prot = None
+
+    if ctx is None:
+        vif_path = base + ".vif"
+        if os.path.exists(vif_path):
+            # .vif present but unreadable fails closed: silently falling
+            # back to 10+4 would rebuild a custom-ratio volume with the
+            # wrong layout (reference RebuildEcFiles).
+            vi = VolumeInfo.load(vif_path)
+            ctx = vi.ec_ctx
+        if ctx is None and prot is not None:
+            ctx = prot.ctx
+        if ctx is None:
+            ctx = DEFAULT_EC_CONTEXT
+    if prot is not None and prot.ctx != ctx:
+        if not unsafe_ignore_sidecar:
+            raise ECError(
+                f"bitrot sidecar for {base} records ratio {prot.ctx} but the "
+                f"volume config says {ctx}; refusing to rebuild"
+            )
+        prot = None
+    if backend is None:
+        backend = get_backend("auto", ctx.data_shards, ctx.parity_shards)
+
+    total, k = ctx.total, ctx.data_shards
+    present = [i for i in range(total) if os.path.exists(base + ctx.to_ext(i))]
+    missing = [i for i in range(total) if i not in present]
+
+    # --- bitrot verify-and-exclude ---------------------------------------
+    corrupt: list[int] = []
+    if prot is not None:
+        for i in present:
+            try:
+                bad = prot.verify_shard_file(base + ctx.to_ext(i), i)
+            except OSError:
+                bad = [0]  # unreadable = untrustworthy RS input
+            if bad:
+                corrupt.append(i)
+        if corrupt and not unsafe_ignore_sidecar:
+            if len(corrupt) > ctx.parity_shards:
+                raise ECError(
+                    f"bitrot sidecar suspect for {base}: {len(corrupt)}/"
+                    f"{len(present)} present shards mismatch (> parity "
+                    f"{ctx.parity_shards}); refusing to rebuild"
+                )
+            if len(present) - len(corrupt) < k:
+                raise ECError(
+                    f"bitrot: only {len(present) - len(corrupt)} verified-good "
+                    f"shards for {base}, need {k} data shards"
+                )
+            for i in corrupt:
+                present.remove(i)
+                missing.append(i)
+
+    if len(present) < k:
+        raise ECError(
+            f"not enough shards to rebuild {base}: found {len(present)}, "
+            f"need {k}, missing {sorted(missing)}"
+        )
+    if not missing:
+        return []
+
+    # --- reconstruct in batches ------------------------------------------
+    sizes = {i: os.path.getsize(base + ctx.to_ext(i)) for i in present}
+    shard_size = max(sizes.values())
+    short = [i for i, s in sizes.items() if s != shard_size]
+    if short:
+        raise ECError(f"present shards have unequal sizes: {sizes}")
+
+    src = sorted(present)[:k]
+    fds = {i: os.open(base + ctx.to_ext(i), os.O_RDONLY) for i in src}
+    tmp_paths = {i: base + ctx.to_ext(i) + ".rebuilding" for i in missing}
+    outs = {i: open(p, "wb") for i, p in tmp_paths.items()}
+    crc_block = prot.block_size if prot is not None else None
+    builders = {
+        i: ShardChecksumBuilder(crc_block) if crc_block else ShardChecksumBuilder()
+        for i in missing
+    }
+    try:
+        for off in range(0, shard_size, batch_size):
+            width = min(batch_size, shard_size - off)
+            block = {
+                i: np.frombuffer(os.pread(fds[i], width, off), dtype=np.uint8)
+                for i in src
+            }
+            if any(len(b) != width for b in block.values()):
+                raise ECError(f"short shard read at offset {off}")
+            rec = backend.reconstruct(block, want=missing)
+            for i in missing:
+                b = np.asarray(rec[i], dtype=np.uint8).tobytes()
+                outs[i].write(b)
+                builders[i].write(b)
+        for f in outs.values():
+            f.flush()
+            os.fsync(f.fileno())
+    except BaseException:
+        for f in outs.values():
+            f.close()
+        for p in tmp_paths.values():
+            if os.path.exists(p):
+                os.unlink(p)
+        raise
+    finally:
+        for fd in fds.values():
+            os.close(fd)
+
+    for f in outs.values():
+        f.close()
+
+    # --- verify regenerated shards against the sidecar (fail closed) -----
+    if prot is not None:
+        for i in missing:
+            if (
+                builders[i].total != prot.shard_sizes[i]
+                or builders[i].finish() != prot.shard_crcs[i]
+            ):
+                for p in tmp_paths.values():
+                    if os.path.exists(p):
+                        os.unlink(p)
+                raise ECError(
+                    f"regenerated shard {i} for {base} fails sidecar "
+                    f"verification; refusing to publish"
+                )
+
+    for i in missing:
+        os.replace(tmp_paths[i], base + ctx.to_ext(i))
+    _fsync_dir(base + ".dat")
+    return sorted(missing)

@@ -180,13 +180,24 @@ class RSJax:
             self._decode_bits_cache.popitem(last=False)
         return bits
 
-    def reconstruct(self, shards: dict[int, jax.Array], data_only: bool = False):
-        """Recover missing shards from any >=k present ones (device matmul)."""
+    def reconstruct(
+        self,
+        shards: dict[int, jax.Array],
+        data_only: bool = False,
+        want: list[int] | None = None,
+    ):
+        """Recover missing shards from any >=k present ones (device matmul).
+
+        `want` restricts the output to specific shard ids (fewer matrix
+        rows); default regenerates every missing shard."""
         present = tuple(sorted(shards))
         if len(present) < self.k:
             raise ValueError(f"need {self.k} shards, have {len(present)}")
-        last = self.k if data_only else self.n
-        missing = tuple(i for i in range(last) if i not in shards)
+        if want is not None:
+            targets = want
+        else:
+            targets = range(self.k if data_only else self.n)
+        missing = tuple(i for i in targets if i not in shards)
         if not missing:
             return {}
         src = present[: self.k]

@@ -142,11 +142,9 @@ class MemDb:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
-        dirfd = os.open(os.path.dirname(os.path.abspath(path)) or ".", os.O_RDONLY)
-        try:
-            os.fsync(dirfd)
-        finally:
-            os.close(dirfd)
+        from ..utils.fs import fsync_dir
+
+        fsync_dir(path)
 
     def __len__(self) -> int:
         return len(self._map)

@@ -25,6 +25,7 @@ from typing import Optional
 from .needle import CURRENT_VERSION, Needle, footer_size
 from .needle_map import MemoryNeedleMap
 from .super_block import SUPER_BLOCK_SIZE, ReplicaPlacement, SuperBlock
+from ..utils.fs import fsync_dir
 from .types import (
     NEEDLE_HEADER_SIZE,
     NEEDLE_PADDING_SIZE,
@@ -33,15 +34,6 @@ from .types import (
     padded_record_size,
     to_stored_offset,
 )
-
-
-def fsync_dir(path: str) -> None:
-    """fsync the directory containing `path` so renames survive power loss."""
-    dirfd = os.open(os.path.dirname(os.path.abspath(path)) or ".", os.O_RDONLY)
-    try:
-        os.fsync(dirfd)
-    finally:
-        os.close(dirfd)
 
 
 class VolumeError(Exception):

@@ -1,0 +1,32 @@
+"""Durability helpers: the fsync + atomic-rename + dir-fsync discipline
+the reference applies to every published artifact (ec_decoder.go:44-90,
+volume_vacuum.go:228)."""
+
+from __future__ import annotations
+
+import os
+
+
+def fsync_dir(path: str) -> None:
+    """fsync the directory containing `path` so renames survive power loss."""
+    dirfd = os.open(os.path.dirname(os.path.abspath(path)) or ".", os.O_RDONLY)
+    try:
+        os.fsync(dirfd)
+    finally:
+        os.close(dirfd)
+
+
+def atomic_write(path: str, data: bytes) -> None:
+    """Write-temp + fsync + rename + dir-fsync publication."""
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        fsync_dir(path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
