@@ -1,0 +1,119 @@
+"""Client-side master session: assign/lookup with a vid-location cache.
+
+Reference: weed/wdclient (MasterClient masterclient.go:483, vidMap
+vid_map.go:35) + weed/operation (assign_file_id.go:43).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+import grpc
+
+from ..pb import cluster_pb2 as pb
+from ..pb import rpc
+
+_CACHE_TTL = 10.0
+
+
+@dataclass
+class AssignResult:
+    fid: str
+    url: str
+    public_url: str
+    grpc_port: int
+    replicas: list
+
+
+class MasterClient:
+    def __init__(self, master: str = "localhost:9333"):
+        host, _, port = master.partition(":")
+        self.http_addr = master
+        self.grpc_addr = f"{host}:{int(port) + 10000}"
+        self._channel = grpc.insecure_channel(self.grpc_addr)
+        self._stub = rpc.master_stub(self._channel)
+        self._lock = threading.Lock()
+        self._vid_cache: dict[int, tuple[float, list[pb.Location]]] = {}
+        self._ec_cache: dict[int, tuple[float, dict[int, list[pb.Location]]]] = {}
+
+    def assign(
+        self, count: int = 1, collection: str = "", replication: str = ""
+    ) -> AssignResult:
+        resp = self._stub.Assign(
+            pb.AssignRequest(
+                count=count, collection=collection, replication=replication
+            ),
+            timeout=30,
+        )
+        if resp.error:
+            raise RuntimeError(f"assign: {resp.error}")
+        return AssignResult(
+            fid=resp.fid,
+            url=resp.location.url,
+            public_url=resp.location.public_url,
+            grpc_port=resp.location.grpc_port,
+            replicas=list(resp.replicas),
+        )
+
+    def lookup(self, vid: int, refresh: bool = False) -> list[pb.Location]:
+        now = time.time()
+        with self._lock:
+            hit = self._vid_cache.get(vid)
+            if hit and not refresh and now - hit[0] < _CACHE_TTL:
+                return hit[1]
+        resp = self._stub.LookupVolume(
+            pb.LookupVolumeRequest(volume_ids=[vid]), timeout=30
+        )
+        vl = resp.volume_locations[0]
+        if vl.error:
+            raise LookupError(vl.error)
+        locs = list(vl.locations)
+        with self._lock:
+            self._vid_cache[vid] = (now, locs)
+        return locs
+
+    def lookup_ec(self, vid: int, refresh: bool = False) -> dict[int, list[pb.Location]]:
+        now = time.time()
+        with self._lock:
+            hit = self._ec_cache.get(vid)
+            if hit and not refresh and now - hit[0] < _CACHE_TTL:
+                return hit[1]
+        resp = self._stub.LookupEcVolume(
+            pb.LookupEcVolumeRequest(volume_id=vid), timeout=30
+        )
+        if resp.error:
+            raise LookupError(resp.error)
+        out = {sl.shard_id: list(sl.locations) for sl in resp.shard_locations}
+        with self._lock:
+            self._ec_cache[vid] = (now, out)
+        return out
+
+    def topology(self) -> pb.TopologyResponse:
+        return self._stub.Topology(pb.TopologyRequest(), timeout=30)
+
+    def statistics(self) -> pb.StatisticsResponse:
+        return self._stub.Statistics(pb.StatisticsRequest(), timeout=30)
+
+    def grow(self, count: int = 1, collection: str = "", replication: str = "") -> list[int]:
+        resp = self._stub.VolumeGrow(
+            pb.VolumeGrowRequest(
+                count=count, collection=collection, replication=replication
+            ),
+            timeout=60,
+        )
+        return list(resp.volume_ids)
+
+    def collections(self) -> list[str]:
+        return list(
+            self._stub.CollectionList(pb.CollectionListRequest(), timeout=30).collections
+        )
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+def volume_channel(loc: pb.Location) -> grpc.Channel:
+    host = loc.url.split(":")[0]
+    return grpc.insecure_channel(f"{host}:{loc.grpc_port}")

@@ -1,0 +1,50 @@
+"""One-shot client operations: upload/read/delete blobs end to end.
+
+Reference: weed/operation (Uploader upload_content.go:163, SubmitFiles,
+DeleteFiles) — HTTP data plane against volume servers, gRPC to master.
+"""
+
+from __future__ import annotations
+
+import requests
+
+from ..storage.file_id import FileId
+from .master_client import MasterClient
+
+
+class Operations:
+    def __init__(self, master: str = "localhost:9333"):
+        self.master = MasterClient(master)
+        self._http = requests.Session()
+
+    def upload(
+        self,
+        data: bytes,
+        name: str = "",
+        mime: str = "",
+        collection: str = "",
+        replication: str = "",
+    ) -> str:
+        a = self.master.assign(collection=collection, replication=replication)
+        url = f"http://{a.url}/{a.fid}"
+        files = {"file": (name or "file", data, mime or "application/octet-stream")}
+        r = self._http.post(url, files=files, timeout=60)
+        r.raise_for_status()
+        return a.fid
+
+    def read(self, fid: str) -> bytes:
+        f = FileId.parse(fid)
+        for loc in self.master.lookup(f.volume_id):
+            r = self._http.get(f"http://{loc.url}/{fid}", timeout=60)
+            if r.status_code == 200:
+                return r.content
+        raise LookupError(f"fid {fid} unreadable on all locations")
+
+    def delete(self, fid: str) -> None:
+        f = FileId.parse(fid)
+        for loc in self.master.lookup(f.volume_id):
+            self._http.delete(f"http://{loc.url}/{fid}", timeout=60)
+            return
+
+    def close(self) -> None:
+        self.master.close()

@@ -42,7 +42,13 @@ class EcVolume:
         volume_id: int,
         collection: str = "",
         backend_name: str = "auto",
+        remote_reader=None,
     ):
+        """remote_reader(shard_id, offset, size, generation) -> bytes|None
+        lets the cluster layer serve shards held by peer servers
+        (reference store_ec.go:599 streaming VolumeEcShardRead; the
+        generation is the EncodeTsNs fence so a stale peer never answers);
+        recovery by local reconstruction remains the fallback."""
         from ..storage.volume import Volume
 
         self.volume_id = volume_id
@@ -84,6 +90,7 @@ class EcVolume:
         self.backend: RSBackend = get_backend(
             backend_name, self.ctx.data_shards, self.ctx.parity_shards
         )
+        self.remote_reader = remote_reader
 
     # ------------------------------------------------------------- lookup
 
@@ -104,11 +111,14 @@ class EcVolume:
     def read_needle(self, needle_id: int, cookie: Optional[int] = None) -> Needle:
         with self._lock:
             nv = self.find_needle(needle_id)
-            if nv is None or nv.is_deleted:
-                raise EcNotFoundError(f"needle {needle_id:x} not found")
-            raw = self._read_extent(
-                actual_offset(nv.offset), record_actual_size(nv.size, self.version)
-            )
+        if nv is None or nv.is_deleted:
+            raise EcNotFoundError(f"needle {needle_id:x} not found")
+        # Interval reads run OUTSIDE the volume lock: os.pread is
+        # thread-safe and a slow remote shard fetch must not serialize
+        # every other read of this volume.
+        raw = self._read_extent(
+            actual_offset(nv.offset), record_actual_size(nv.size, self.version)
+        )
         n = Needle.from_bytes(raw, self.version)
         if cookie is not None and n.cookie != cookie:
             raise EcCookieMismatch(f"needle {needle_id:x} cookie mismatch")
@@ -126,10 +136,17 @@ class EcVolume:
     def _read_shard_interval(self, shard_id: int, offset: int, size: int) -> bytes:
         fd = self.shard_fds.get(shard_id)
         if fd is not None:
-            got = os.pread(fd, size, offset)
+            try:
+                got = os.pread(fd, size, offset)
+            except OSError:  # racing unmount closed the fd
+                got = b""
             if len(got) == size:
                 return got
             # short read = truncated shard; fall through to recovery
+        if self.remote_reader is not None:
+            got = self.remote_reader(shard_id, offset, size, self.encode_ts_ns)
+            if got is not None and len(got) == size:
+                return got
         return self._recover_interval(shard_id, offset, size)
 
     def _recover_interval(self, shard_id: int, offset: int, size: int) -> bytes:
@@ -137,10 +154,13 @@ class EcVolume:
         (reference store_ec.go:656-747)."""
         k = self.ctx.data_shards
         sources: dict[int, np.ndarray] = {}
-        for i, fd in self.shard_fds.items():
+        for i, fd in list(self.shard_fds.items()):
             if i == shard_id:
                 continue
-            got = os.pread(fd, size, offset)
+            try:
+                got = os.pread(fd, size, offset)
+            except OSError:
+                continue
             if len(got) != size:
                 continue
             sources[i] = np.frombuffer(got, dtype=np.uint8)
@@ -176,6 +196,16 @@ class EcVolume:
 
     def shard_size(self) -> int:
         return self._shard_size
+
+    def unmount_shards(self, shard_ids: list[int]) -> int:
+        """Stop serving specific local shards (reference Unmount per
+        shard set); returns how many shards remain mounted."""
+        with self._lock:
+            for sid in shard_ids:
+                fd = self.shard_fds.pop(sid, None)
+                if fd is not None:
+                    os.close(fd)
+            return len(self.shard_fds)
 
     def close(self) -> None:
         with self._lock:

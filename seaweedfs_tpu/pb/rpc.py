@@ -1,0 +1,94 @@
+"""Hand-rolled gRPC service wiring (no grpc_tools codegen in this
+environment): a declarative method table per service, from which both
+server handlers and client stubs are built.
+
+Server impls are plain classes with one method per RPC (same names);
+streaming RPCs receive/return iterators, exactly like generated servicers.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from . import cluster_pb2 as pb
+
+UNARY = "unary_unary"
+SERVER_STREAM = "unary_stream"
+CLIENT_STREAM = "stream_unary"
+BIDI = "stream_stream"
+
+MASTER_SERVICE = "sw.Seaweed"
+VOLUME_SERVICE = "sw.VolumeServer"
+
+SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
+    MASTER_SERVICE: {
+        "SendHeartbeat": (BIDI, pb.Heartbeat, pb.HeartbeatResponse),
+        "Assign": (UNARY, pb.AssignRequest, pb.AssignResponse),
+        "LookupVolume": (UNARY, pb.LookupVolumeRequest, pb.LookupVolumeResponse),
+        "LookupEcVolume": (UNARY, pb.LookupEcVolumeRequest, pb.LookupEcVolumeResponse),
+        "Statistics": (UNARY, pb.StatisticsRequest, pb.StatisticsResponse),
+        "Topology": (UNARY, pb.TopologyRequest, pb.TopologyResponse),
+        "VolumeGrow": (UNARY, pb.VolumeGrowRequest, pb.VolumeGrowResponse),
+        "CollectionList": (UNARY, pb.CollectionListRequest, pb.CollectionListResponse),
+    },
+    VOLUME_SERVICE: {
+        "AllocateVolume": (UNARY, pb.AllocateVolumeRequest, pb.AllocateVolumeResponse),
+        "VolumeDelete": (UNARY, pb.VolumeCommandRequest, pb.VolumeCommandResponse),
+        "VolumeMarkReadonly": (UNARY, pb.VolumeCommandRequest, pb.VolumeCommandResponse),
+        "VolumeMarkWritable": (UNARY, pb.VolumeCommandRequest, pb.VolumeCommandResponse),
+        "VacuumVolume": (UNARY, pb.VacuumRequest, pb.VacuumResponse),
+        "WriteNeedle": (UNARY, pb.WriteNeedleRequest, pb.WriteNeedleResponse),
+        "ReadNeedle": (UNARY, pb.ReadNeedleRequest, pb.ReadNeedleResponse),
+        "DeleteNeedle": (UNARY, pb.DeleteNeedleRequest, pb.DeleteNeedleResponse),
+        "VolumeEcShardsGenerate": (UNARY, pb.EcShardsGenerateRequest, pb.EcShardsGenerateResponse),
+        "VolumeEcShardsRebuild": (UNARY, pb.EcShardsRebuildRequest, pb.EcShardsRebuildResponse),
+        "VolumeEcShardsCopy": (UNARY, pb.EcShardsCopyRequest, pb.EcShardsCopyResponse),
+        "VolumeEcShardsDelete": (UNARY, pb.EcShardsDeleteRequest, pb.EcShardsDeleteResponse),
+        "VolumeEcShardsMount": (UNARY, pb.EcShardsMountRequest, pb.EcShardsMountResponse),
+        "VolumeEcShardsUnmount": (UNARY, pb.EcShardsUnmountRequest, pb.EcShardsUnmountResponse),
+        "VolumeEcShardRead": (SERVER_STREAM, pb.EcShardReadRequest, pb.EcShardReadChunk),
+        "VolumeEcBlobDelete": (UNARY, pb.EcBlobDeleteRequest, pb.EcBlobDeleteResponse),
+        "VolumeEcShardsToVolume": (UNARY, pb.EcShardsToVolumeRequest, pb.EcShardsToVolumeResponse),
+        "CopyFile": (SERVER_STREAM, pb.CopyFileRequest, pb.CopyFileChunk),
+        "VolumeServerStatus": (UNARY, pb.VolumeServerStatusRequest, pb.VolumeServerStatusResponse),
+    },
+}
+
+
+def add_service(server: grpc.Server, service_name: str, impl: object) -> None:
+    methods = {}
+    for name, (kind, req_t, resp_t) in SERVICES[service_name].items():
+        handler_factory = getattr(grpc, f"{kind}_rpc_method_handler")
+        methods[name] = handler_factory(
+            getattr(impl, name),
+            request_deserializer=req_t.FromString,
+            response_serializer=resp_t.SerializeToString,
+        )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(service_name, methods),)
+    )
+
+
+class Stub:
+    """Client stub: one callable attribute per RPC."""
+
+    def __init__(self, channel: grpc.Channel, service_name: str):
+        for name, (kind, req_t, resp_t) in SERVICES[service_name].items():
+            factory = getattr(channel, kind)
+            setattr(
+                self,
+                name,
+                factory(
+                    f"/{service_name}/{name}",
+                    request_serializer=req_t.SerializeToString,
+                    response_deserializer=resp_t.FromString,
+                ),
+            )
+
+
+def master_stub(channel: grpc.Channel) -> Stub:
+    return Stub(channel, MASTER_SERVICE)
+
+
+def volume_stub(channel: grpc.Channel) -> Stub:
+    return Stub(channel, VOLUME_SERVICE)

@@ -1,0 +1,89 @@
+"""`python -m seaweedfs_tpu.server` — node launcher (weed-style).
+
+Subcommands: master | volume | server (all-in-one master + volume,
+reference `weed server` / `weed mini`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="seaweedfs_tpu.server")
+    sub = p.add_subparsers(dest="mode", required=True)
+
+    m = sub.add_parser("master")
+    m.add_argument("-ip", default="localhost")
+    m.add_argument("-port", type=int, default=9333)
+    m.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
+
+    v = sub.add_parser("volume")
+    v.add_argument("-ip", default="localhost")
+    v.add_argument("-port", type=int, default=8080)
+    v.add_argument("-dir", action="append", required=True)
+    v.add_argument("-master", default="localhost:9333")
+    v.add_argument("-max", type=int, default=8)
+    v.add_argument("-ec.backend", dest="ec_backend", default="auto")
+    v.add_argument("-dataCenter", default="")
+    v.add_argument("-rack", default="")
+
+    s = sub.add_parser("server")
+    s.add_argument("-ip", default="localhost")
+    s.add_argument("-masterPort", type=int, default=9333)
+    s.add_argument("-port", type=int, default=8080)
+    s.add_argument("-dir", action="append", required=True)
+    s.add_argument("-max", type=int, default=8)
+    s.add_argument("-ec.backend", dest="ec_backend", default="auto")
+
+    a = p.parse_args(argv)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *x: stop.set())
+    signal.signal(signal.SIGINT, lambda *x: stop.set())
+
+    servers = []
+    if a.mode in ("master", "server"):
+        from .master import MasterServer
+
+        port = a.port if a.mode == "master" else a.masterPort
+        limit = (
+            a.volumeSizeLimitMB * 1024 * 1024
+            if a.mode == "master"
+            else 30 * 1024**3
+        )
+        ms = MasterServer(ip=a.ip, port=port, volume_size_limit=limit)
+        ms.start()
+        servers.append(ms)
+        print(f"master listening on {a.ip}:{port} (grpc {ms.grpc_port})", flush=True)
+
+    if a.mode in ("volume", "server"):
+        from .volume_server import VolumeServer
+
+        master = (
+            a.master if a.mode == "volume" else f"{a.ip}:{a.masterPort}"
+        )
+        vs = VolumeServer(
+            directories=a.dir,
+            master=master,
+            ip=a.ip,
+            port=a.port,
+            max_volume_count=a.max,
+            ec_backend=a.ec_backend,
+            data_center=getattr(a, "dataCenter", ""),
+            rack=getattr(a, "rack", ""),
+        )
+        vs.start()
+        servers.append(vs)
+        print(f"volume server on {a.ip}:{a.port} (grpc {vs.grpc_port})", flush=True)
+
+    stop.wait()
+    for srv in servers:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

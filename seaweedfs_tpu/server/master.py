@@ -1,0 +1,286 @@
+"""Master server: heartbeat sink, fid assignment, volume/EC lookup,
+volume growth orchestration.
+
+Reference: weed/server/master_server.go (NewMasterServer :97),
+master_grpc_server.go:66 (SendHeartbeat), master_grpc_server_assign.go:50
+(Assign with growth), HTTP /dir/assign + /dir/lookup handlers. Raft HA
+comes later; this is the single-master mode `weed master` itself defaults
+to on one node.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent import futures
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import grpc
+
+from ..pb import cluster_pb2 as pb
+from ..pb import rpc
+from ..storage.file_id import FileId, new_cookie
+from .topology import DataNode, Topology
+
+
+class MasterService:
+    """gRPC servicer (method-per-RPC, see pb/rpc.py)."""
+
+    def __init__(self, topo: Topology):
+        self.topo = topo
+        self._grow_lock = threading.Lock()
+
+    # ------------------------------------------------------- heartbeats
+
+    def SendHeartbeat(self, request_iterator, context):
+        node: DataNode | None = None
+        token = object()
+        try:
+            for hb in request_iterator:
+                if node is None:
+                    node = self.topo.register_node(hb)
+                    node.owner_token = token
+                    self.topo.sync_registration(node, hb)
+                elif hb.volumes or hb.has_no_volumes or hb.ec_shards or hb.has_no_ec_shards:
+                    self.topo.sync_registration(node, hb)
+                else:
+                    self.topo.incremental_update(node, hb)
+                yield pb.HeartbeatResponse(
+                    volume_size_limit=self.topo.volume_size_limit
+                )
+        finally:
+            # stream closed = node gone (reference topology UnRegister on
+            # missed pulse); owner_token keeps a stale stream's cleanup
+            # from removing the node a replacement stream re-registered
+            if node is not None:
+                self.topo.unregister_node(node.node_id, owner_token=token)
+
+    # ----------------------------------------------------------- assign
+
+    def Assign(self, request: pb.AssignRequest, context) -> pb.AssignResponse:
+        count = max(int(request.count), 1)
+        picked = self.topo.pick_for_write(request.collection, request.replication)
+        if picked is None:
+            grown = self._grow(request.collection, request.replication)
+            if grown:
+                picked = self.topo.pick_for_write(
+                    request.collection, request.replication
+                )
+        if picked is None:
+            return pb.AssignResponse(error="no writable volumes and growth failed")
+        vid, holders = picked
+        fid = FileId(vid, self.topo.next_needle_id(), new_cookie())
+        return pb.AssignResponse(
+            fid=str(fid),
+            count=count,
+            location=holders[0].location(),
+            replicas=[n.location() for n in holders[1:]],
+        )
+
+    def _grow(self, collection: str, replication: str) -> list[int]:
+        """Allocate one new volume on planned targets (reference
+        VolumeGrowth.findEmptySlotsForOneVolume + AllocateVolume RPCs)."""
+        with self._grow_lock:
+            targets = self.topo.plan_growth(replication)
+            if not targets:
+                return []
+            vid = self.topo.next_volume_id()
+            ok = []
+            for node in targets:
+                try:
+                    with grpc.insecure_channel(f"{node.ip}:{node.grpc_port}") as ch:
+                        rpc.volume_stub(ch).AllocateVolume(
+                            pb.AllocateVolumeRequest(
+                                volume_id=vid,
+                                collection=collection,
+                                replication=replication,
+                            ),
+                            timeout=10,
+                        )
+                    ok.append(node)
+                except grpc.RpcError:
+                    continue
+            if not ok:
+                return []
+            # optimistic registration; the next heartbeat confirms
+            for node in ok:
+                node.volumes[vid] = pb.VolumeInfoMsg(
+                    id=vid,
+                    collection=collection,
+                    replica_placement=replication,
+                )
+            return [vid]
+
+    def VolumeGrow(self, request: pb.VolumeGrowRequest, context) -> pb.VolumeGrowResponse:
+        vids = []
+        for _ in range(max(int(request.count), 1)):
+            vids.extend(self._grow(request.collection, request.replication))
+        return pb.VolumeGrowResponse(volume_ids=vids)
+
+    # ----------------------------------------------------------- lookup
+
+    def LookupVolume(self, request, context) -> pb.LookupVolumeResponse:
+        out = []
+        for vid in request.volume_ids:
+            locs = self.topo.lookup(vid)
+            if not locs:
+                # EC volumes answer normal lookups too: any shard holder
+                ec = self.topo.lookup_ec(vid)
+                seen = {}
+                for ls in ec.values():
+                    for l in ls:
+                        seen[l.url] = l
+                locs = list(seen.values())
+            out.append(
+                pb.VolumeLocations(
+                    volume_id=vid,
+                    locations=locs,
+                    error="" if locs else f"volume {vid} not found",
+                )
+            )
+        return pb.LookupVolumeResponse(volume_locations=out)
+
+    def LookupEcVolume(self, request, context) -> pb.LookupEcVolumeResponse:
+        shard_locs = self.topo.lookup_ec(request.volume_id)
+        return pb.LookupEcVolumeResponse(
+            volume_id=request.volume_id,
+            shard_locations=[
+                pb.EcShardLocation(shard_id=sid, locations=locs)
+                for sid, locs in sorted(shard_locs.items())
+            ],
+            error="" if shard_locs else f"ec volume {request.volume_id} not found",
+        )
+
+    def Statistics(self, request, context) -> pb.StatisticsResponse:
+        return self.topo.statistics()
+
+    def Topology(self, request, context) -> pb.TopologyResponse:
+        return self.topo.to_proto()
+
+    def CollectionList(self, request, context) -> pb.CollectionListResponse:
+        return pb.CollectionListResponse(collections=self.topo.collections())
+
+
+class MasterServer:
+    """gRPC + HTTP front for one Topology."""
+
+    def __init__(
+        self,
+        ip: str = "localhost",
+        port: int = 9333,
+        grpc_port: int = 0,
+        volume_size_limit: int = 30 * 1024**3,
+    ):
+        self.ip = ip
+        self.port = port
+        self.grpc_port = grpc_port or (port + 10000)
+        self.topo = Topology(volume_size_limit=volume_size_limit)
+        self.service = MasterService(self.topo)
+
+        self._grpc = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
+        rpc.add_service(self._grpc, rpc.MASTER_SERVICE, self.service)
+        self._grpc.add_insecure_port(f"{ip}:{self.grpc_port}")
+
+        self._http = ThreadingHTTPServer((ip, port), self._handler_class())
+        self._http_thread = threading.Thread(
+            target=self._http.serve_forever, daemon=True
+        )
+
+    # ------------------------------------------------------------- http
+
+    def _handler_class(self):
+        master = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code: int, obj: dict) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                u = urlparse(self.path)
+                q = parse_qs(u.query)
+                if u.path == "/dir/assign":
+                    resp = master.service.Assign(
+                        pb.AssignRequest(
+                            count=int(q.get("count", ["1"])[0]),
+                            collection=q.get("collection", [""])[0],
+                            replication=q.get("replication", [""])[0],
+                        ),
+                        None,
+                    )
+                    if resp.error:
+                        self._json(500, {"error": resp.error})
+                    else:
+                        self._json(
+                            200,
+                            {
+                                "fid": resp.fid,
+                                "count": resp.count,
+                                "url": resp.location.url,
+                                "publicUrl": resp.location.public_url,
+                            },
+                        )
+                elif u.path == "/dir/lookup":
+                    vid = int(q.get("volumeId", ["0"])[0].split(",")[0])
+                    resp = master.service.LookupVolume(
+                        pb.LookupVolumeRequest(volume_ids=[vid]), None
+                    )
+                    vl = resp.volume_locations[0]
+                    if vl.error:
+                        self._json(404, {"error": vl.error})
+                    else:
+                        self._json(
+                            200,
+                            {
+                                "volumeId": str(vid),
+                                "locations": [
+                                    {"url": l.url, "publicUrl": l.public_url}
+                                    for l in vl.locations
+                                ],
+                            },
+                        )
+                elif u.path in ("/cluster/status", "/dir/status"):
+                    topo = master.topo.to_proto()
+                    self._json(
+                        200,
+                        {
+                            "IsLeader": True,
+                            "MaxVolumeId": topo.max_volume_id,
+                            "DataNodes": [
+                                {
+                                    "id": n.id,
+                                    "volumes": len(n.volumes),
+                                    "ecShards": len(n.ec_shards),
+                                }
+                                for n in topo.nodes
+                            ],
+                        },
+                    )
+                else:
+                    self._json(404, {"error": "not found"})
+
+            do_POST = do_GET
+
+        return Handler
+
+    # -------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._grpc.start()
+        self._http_thread.start()
+
+    def stop(self) -> None:
+        self._grpc.stop(grace=0.5)
+        self._http.shutdown()
+        self._http.server_close()
+
+    def wait(self) -> None:
+        self._grpc.wait_for_termination()

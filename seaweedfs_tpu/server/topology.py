@@ -1,0 +1,267 @@
+"""Master-side cluster topology: DataNodes, volume layouts, EC shard map.
+
+Reference: weed/topology (Topology topology.go:38, VolumeLayout
+volume_layout.go, growth volume_growth.go:98) collapsed to the
+single-DC/rack scale this round; the tree deepens when multi-rack
+placement lands. Registration comes from heartbeats
+(SyncDataNodeRegistration topology.go:579, incremental :632).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..pb import cluster_pb2 as pb
+
+
+@dataclass
+class DataNode:
+    node_id: str  # "ip:port"
+    ip: str
+    port: int
+    public_url: str
+    grpc_port: int
+    data_center: str = ""
+    rack: str = ""
+    max_volume_count: int = 8
+    volumes: dict[int, pb.VolumeInfoMsg] = field(default_factory=dict)
+    ec_shards: dict[int, pb.EcShardInfoMsg] = field(default_factory=dict)
+    last_seen: float = field(default_factory=time.time)
+    # identity of the heartbeat stream currently feeding this node; a
+    # stale stream's cleanup must not unregister a node a newer stream owns
+    owner_token: object = None
+
+    def location(self) -> pb.Location:
+        return pb.Location(
+            url=f"{self.ip}:{self.port}",
+            public_url=self.public_url,
+            grpc_port=self.grpc_port,
+            data_center=self.data_center,
+        )
+
+    def free_slots(self) -> int:
+        used = len(self.volumes) + (len(self.ec_shards) + 9) // 10
+        return max(self.max_volume_count - used, 0)
+
+
+class Topology:
+    def __init__(self, volume_size_limit: int = 30 * 1024**3, dead_after: float = 30.0):
+        self.volume_size_limit = volume_size_limit
+        self.dead_after = dead_after
+        self._lock = threading.RLock()
+        self.nodes: dict[str, DataNode] = {}
+        self.max_volume_id = 0
+        self._sequence = 0
+
+    # -------------------------------------------------------- heartbeats
+
+    def sync_registration(self, node: DataNode, hb: pb.Heartbeat) -> None:
+        """Full-list registration (first heartbeat / periodic refresh)."""
+        with self._lock:
+            # re-insert if a stale stream's cleanup raced us out
+            self.nodes.setdefault(node.node_id, node)
+            if hb.volumes or hb.has_no_volumes:
+                node.volumes = {v.id: v for v in hb.volumes}
+            if hb.ec_shards or hb.has_no_ec_shards:
+                node.ec_shards = {e.id: e for e in hb.ec_shards}
+            for v in node.volumes.values():
+                self.max_volume_id = max(self.max_volume_id, v.id)
+            node.last_seen = time.time()
+
+    def incremental_update(self, node: DataNode, hb: pb.Heartbeat) -> None:
+        with self._lock:
+            for v in hb.new_volumes:
+                node.volumes[v.id] = v
+                self.max_volume_id = max(self.max_volume_id, v.id)
+            for vid in hb.deleted_volumes:
+                node.volumes.pop(vid, None)
+            for e in hb.new_ec_shards:
+                cur = node.ec_shards.get(e.id)
+                if cur is not None:
+                    if e.generation < cur.generation:
+                        continue  # stale report loses to the newer generation
+                    if e.generation == cur.generation:
+                        e.shard_bits |= cur.shard_bits
+                node.ec_shards[e.id] = e
+            for e in hb.deleted_ec_shards:
+                cur = node.ec_shards.get(e.id)
+                if cur is None:
+                    continue
+                cur.shard_bits &= ~e.shard_bits
+                if cur.shard_bits == 0:
+                    node.ec_shards.pop(e.id, None)
+            node.last_seen = time.time()
+
+    def register_node(self, hb: pb.Heartbeat) -> DataNode:
+        with self._lock:
+            node_id = f"{hb.ip}:{hb.port}"
+            node = self.nodes.get(node_id)
+            if node is None:
+                node = DataNode(
+                    node_id=node_id,
+                    ip=hb.ip,
+                    port=hb.port,
+                    public_url=hb.public_url or node_id,
+                    grpc_port=hb.grpc_port,
+                    data_center=hb.data_center,
+                    rack=hb.rack,
+                    max_volume_count=int(hb.max_volume_count) or 8,
+                )
+                self.nodes[node_id] = node
+            if hb.max_volume_count:
+                node.max_volume_count = int(hb.max_volume_count)
+            return node
+
+    def unregister_node(self, node_id: str, owner_token: object = None) -> None:
+        """With `owner_token`, remove only if that stream still owns the
+        node (reconnect-race guard)."""
+        with self._lock:
+            node = self.nodes.get(node_id)
+            if node is None:
+                return
+            if owner_token is not None and node.owner_token is not owner_token:
+                return
+            self.nodes.pop(node_id, None)
+
+    def collections(self) -> list[str]:
+        with self._lock:
+            cols = set()
+            for n in self.nodes.values():
+                for v in n.volumes.values():
+                    cols.add(v.collection)
+                for e in n.ec_shards.values():
+                    cols.add(e.collection)
+            return sorted(cols)
+
+    def prune_dead(self) -> list[str]:
+        cutoff = time.time() - self.dead_after
+        with self._lock:
+            dead = [nid for nid, n in self.nodes.items() if n.last_seen < cutoff]
+            for nid in dead:
+                del self.nodes[nid]
+            return dead
+
+    # ------------------------------------------------------------ lookup
+
+    def lookup(self, vid: int) -> list[pb.Location]:
+        with self._lock:
+            return [
+                n.location() for n in self.nodes.values() if vid in n.volumes
+            ]
+
+    def lookup_ec(self, vid: int) -> dict[int, list[pb.Location]]:
+        """shard_id -> locations."""
+        with self._lock:
+            out: dict[int, list[pb.Location]] = {}
+            for n in self.nodes.values():
+                e = n.ec_shards.get(vid)
+                if e is None:
+                    continue
+                for sid in range(32):
+                    if e.shard_bits & (1 << sid):
+                        out.setdefault(sid, []).append(n.location())
+            return out
+
+    # ---------------------------------------------------- write planning
+
+    def next_needle_id(self) -> int:
+        with self._lock:
+            self._sequence += 1
+            return self._sequence
+
+    def next_volume_id(self) -> int:
+        with self._lock:
+            self.max_volume_id += 1
+            return self.max_volume_id
+
+    def writable_volumes(self, collection: str, replication: str) -> list[tuple[int, list[DataNode]]]:
+        """(vid, holders) for volumes writable under the given policy."""
+        copies = _replica_copies(replication)
+        with self._lock:
+            by_vid: dict[int, list[DataNode]] = {}
+            for n in self.nodes.values():
+                for v in n.volumes.values():
+                    if (
+                        v.collection == collection
+                        and not v.read_only
+                        and v.size < self.volume_size_limit
+                        and (not replication or v.replica_placement == replication)
+                    ):
+                        by_vid.setdefault(v.id, []).append(n)
+            return [
+                (vid, holders)
+                for vid, holders in sorted(by_vid.items())
+                if len(holders) >= copies
+            ]
+
+    def pick_for_write(
+        self, collection: str, replication: str
+    ) -> Optional[tuple[int, list[DataNode]]]:
+        candidates = self.writable_volumes(collection, replication)
+        if not candidates:
+            return None
+        return random.choice(candidates)
+
+    def plan_growth(self, replication: str) -> list[DataNode]:
+        """Pick target nodes for one new volume honoring the copy count
+        (placement constraints deepen with the topology tree)."""
+        copies = _replica_copies(replication)
+        with self._lock:
+            avail = sorted(
+                (n for n in self.nodes.values() if n.free_slots() > 0),
+                key=lambda n: -n.free_slots(),
+            )
+            if len(avail) < copies:
+                return []
+            return avail[:copies]
+
+    # ------------------------------------------------------------- stats
+
+    def statistics(self) -> pb.StatisticsResponse:
+        with self._lock:
+            vols = {v.id for n in self.nodes.values() for v in n.volumes.values()}
+            ecs = {e.id for n in self.nodes.values() for e in n.ec_shards.values()}
+            return pb.StatisticsResponse(
+                used_size=sum(
+                    v.size for n in self.nodes.values() for v in n.volumes.values()
+                ),
+                file_count=sum(
+                    v.file_count
+                    for n in self.nodes.values()
+                    for v in n.volumes.values()
+                ),
+                volume_count=len(vols),
+                ec_volume_count=len(ecs),
+                node_count=len(self.nodes),
+            )
+
+    def to_proto(self) -> pb.TopologyResponse:
+        with self._lock:
+            return pb.TopologyResponse(
+                max_volume_id=self.max_volume_id,
+                nodes=[
+                    pb.DataNodeInfo(
+                        id=n.node_id,
+                        location=n.location(),
+                        volumes=list(n.volumes.values()),
+                        ec_shards=list(n.ec_shards.values()),
+                        max_volume_count=n.max_volume_count,
+                        rack=n.rack,
+                        data_center=n.data_center,
+                    )
+                    for n in sorted(self.nodes.values(), key=lambda n: n.node_id)
+                ],
+            )
+
+
+def _replica_copies(replication: str) -> int:
+    """Replica placement 'XYZ' => 1 + sum of digits (copies across DC/
+    rack/server; reference super_block/replica_placement.go)."""
+    if not replication:
+        return 1
+    digits = [int(c) for c in replication if c.isdigit()]
+    return 1 + sum(digits[:3])

@@ -1,0 +1,765 @@
+"""Volume server: HTTP data plane + gRPC control/EC plane + heartbeats.
+
+Reference: weed/server/volume_server.go, HTTP handlers
+(volume_server_handlers_read.go:142 GetOrHeadHandler,
+_write.go:20 PostHandler -> topology.ReplicatedWrite store_replicate.go:32),
+gRPC EC RPCs (volume_grpc_erasure_coding.go), heartbeat stream
+(volume_grpc_client_to_master.go).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from concurrent import futures
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import grpc
+
+from ..ec import context as ec_context
+from ..ec.context import ECError
+from ..ec.decoder import ec_decode_volume
+from ..ec.encoder import ec_encode_volume
+from ..ec.rebuild import rebuild_ec_files
+from ..storage.file_id import FileId, FileIdError
+from ..storage.needle import CrcError, Needle
+from ..storage.store import Store
+from ..storage.volume import (
+    CookieMismatch,
+    NotFoundError,
+    ReadOnlyError,
+    Volume,
+    VolumeError,
+)
+from ..pb import cluster_pb2 as pb
+from ..pb import rpc
+
+_EC_STREAM_CHUNK = 256 * 1024
+
+
+def _shard_bits(ids) -> int:
+    bits = 0
+    for i in ids:
+        bits |= 1 << i
+    return bits
+
+
+class VolumeService:
+    """gRPC servicer over one Store."""
+
+    def __init__(self, server: "VolumeServer"):
+        self.server = server
+        self.store = server.store
+
+    # ------------------------------------------------------------ admin
+
+    def AllocateVolume(self, request, context):
+        self.store.allocate_volume(
+            request.volume_id,
+            collection=request.collection,
+            replica_placement=request.replication or "000",
+        )
+        self.server.notify_new_volume(request.volume_id)
+        return pb.AllocateVolumeResponse()
+
+    def VolumeDelete(self, request, context):
+        try:
+            self.store.delete_volume(request.volume_id)
+            self.server.notify_deleted_volume(request.volume_id)
+            return pb.VolumeCommandResponse()
+        except NotFoundError as e:
+            return pb.VolumeCommandResponse(error=str(e))
+
+    def VolumeMarkReadonly(self, request, context):
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            return pb.VolumeCommandResponse(error="not found")
+        v.set_read_only(True)
+        return pb.VolumeCommandResponse()
+
+    def VolumeMarkWritable(self, request, context):
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            return pb.VolumeCommandResponse(error="not found")
+        v.set_read_only(False)
+        return pb.VolumeCommandResponse()
+
+    def VacuumVolume(self, request, context):
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        ratio = v.garbage_ratio()
+        if request.garbage_threshold and ratio < request.garbage_threshold:
+            return pb.VacuumResponse(reclaimed_bytes=0, garbage_ratio=ratio)
+        reclaimed = v.vacuum()
+        return pb.VacuumResponse(reclaimed_bytes=reclaimed, garbage_ratio=ratio)
+
+    # --------------------------------------------------------------- io
+
+    def WriteNeedle(self, request, context):
+        n = Needle(
+            cookie=request.cookie,
+            needle_id=request.needle_id,
+            data=request.data,
+            flags=request.flags,
+        )
+        if request.name:
+            n.set_name(request.name.encode())
+        if request.mime:
+            n.set_mime(request.mime.encode())
+        try:
+            size = self.store.write_needle(request.volume_id, n)
+        except (NotFoundError, ReadOnlyError, VolumeError) as e:
+            return pb.WriteNeedleResponse(error=str(e))
+        if not request.is_replicate:
+            err = self.server.replicate_write(request)
+            if err:
+                return pb.WriteNeedleResponse(error=err)
+        return pb.WriteNeedleResponse(size=size)
+
+    def ReadNeedle(self, request, context):
+        try:
+            n = self.store.read_needle(
+                request.volume_id,
+                request.needle_id,
+                request.cookie or None,
+            )
+        except (NotFoundError, ECError) as e:
+            return pb.ReadNeedleResponse(error=f"not found: {e}")
+        except (CookieMismatch, CrcError) as e:
+            return pb.ReadNeedleResponse(error=str(e))
+        return pb.ReadNeedleResponse(
+            data=n.data,
+            name=n.name.decode(errors="replace"),
+            mime=n.mime.decode(errors="replace"),
+            last_modified=n.last_modified,
+        )
+
+    def DeleteNeedle(self, request, context):
+        try:
+            freed = self.store.delete_needle(request.volume_id, request.needle_id)
+        except NotFoundError as e:
+            return pb.DeleteNeedleResponse(error=str(e))
+        if not request.is_replicate:
+            self.server.replicate_delete(request)
+        return pb.DeleteNeedleResponse(freed_bytes=freed)
+
+    # ---------------------------------------------------------------- ec
+
+    def VolumeEcShardsGenerate(self, request, context):
+        """Reference volume_grpc_erasure_coding.go:45 — wipe stale EC
+        artifacts, mark the volume readonly, encode (ecx first), persist
+        sidecars."""
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        if request.collection and v.collection != request.collection:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, "collection mismatch")
+        base = v.dat_path[:-4]
+        for i in range(ec_context.MAX_SHARD_COUNT):
+            stale = base + f".ec{i:02d}"
+            if os.path.exists(stale):
+                os.unlink(stale)
+        v.set_read_only(True)
+        v.flush()
+        ctx = ec_context.ECContext(
+            request.data_shards or ec_context.DATA_SHARDS,
+            request.parity_shards or ec_context.PARITY_SHARDS,
+        )
+        from ..ec.backend import get_backend
+
+        backend = get_backend(
+            request.backend or self.server.store.ec_backend,
+            ctx.data_shards,
+            ctx.parity_shards,
+        )
+        vi = ec_encode_volume(base, ctx, backend)
+        return pb.EcShardsGenerateResponse(generation=vi.encode_ts_ns)
+
+    def VolumeEcShardsRebuild(self, request, context):
+        loc_base = self._ec_base(request.volume_id, request.collection)
+        if loc_base is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "ec volume not found")
+        from ..ec.backend import get_backend
+        from ..ec.volume_info import VolumeInfo
+
+        vi = VolumeInfo.maybe_load(loc_base + ".vif")
+        ctx = (vi.ec_ctx if vi else None) or ec_context.ECContext()
+        backend = get_backend(
+            request.backend or self.server.store.ec_backend,
+            ctx.data_shards,
+            ctx.parity_shards,
+        )
+        try:
+            rebuilt = rebuild_ec_files(loc_base, backend=backend)
+        except ECError as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        return pb.EcShardsRebuildResponse(rebuilt_shard_ids=rebuilt)
+
+    def VolumeEcShardsCopy(self, request, context):
+        """Pull shards (and index files) from a peer via CopyFile."""
+        loc = self.store._pick_location()
+        base = Volume.base_file_name(
+            loc.directory, request.collection, request.volume_id
+        )
+        exts = [f".ec{sid:02d}" for sid in request.shard_ids]
+        if request.copy_ecx:
+            exts.append(".ecx")
+        if request.copy_ecj:
+            exts.append(".ecj")
+        if request.copy_vif:
+            exts.append(".vif")
+        if request.copy_ecsum:
+            exts.append(".ecsum")
+        with grpc.insecure_channel(request.source_url) as ch:
+            stub = rpc.volume_stub(ch)
+            for ext in exts:
+                tmp = base + ext + ".copying"
+                try:
+                    with open(tmp, "wb") as f:
+                        for chunk in stub.CopyFile(
+                            pb.CopyFileRequest(
+                                volume_id=request.volume_id,
+                                collection=request.collection,
+                                ext=ext,
+                            )
+                        ):
+                            f.write(chunk.data)
+                        f.flush()
+                        os.fsync(f.fileno())
+                    os.replace(tmp, base + ext)
+                except grpc.RpcError as e:
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
+                    if ext == ".ecj":  # journal may legitimately not exist
+                        continue
+                    context.abort(
+                        grpc.StatusCode.UNAVAILABLE, f"copy {ext}: {e.details()}"
+                    )
+        return pb.EcShardsCopyResponse()
+
+    def VolumeEcShardsDelete(self, request, context):
+        for loc in self.store.locations:
+            base = Volume.base_file_name(
+                loc.directory, request.collection, request.volume_id
+            )
+            for sid in request.shard_ids:
+                p = base + f".ec{sid:02d}"
+                if os.path.exists(p):
+                    os.unlink(p)
+            # drop index files when no shards remain anywhere local
+            if not any(
+                os.path.exists(base + f".ec{i:02d}")
+                for i in range(ec_context.MAX_SHARD_COUNT)
+            ):
+                for ext in (".ecx", ".ecj", ".ecsum"):
+                    if os.path.exists(base + ext):
+                        os.unlink(base + ext)
+        self.server.notify_deleted_ec_shards(
+            request.volume_id, request.collection, list(request.shard_ids)
+        )
+        return pb.EcShardsDeleteResponse()
+
+    def VolumeEcShardsMount(self, request, context):
+        try:
+            ev = self.store.mount_ec_volume(request.volume_id, request.collection)
+        except NotFoundError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        self.server.notify_new_ec_shards(request.volume_id, request.collection)
+        return pb.EcShardsMountResponse()
+
+    def VolumeEcShardsUnmount(self, request, context):
+        self.store.unmount_ec_shards(request.volume_id, list(request.shard_ids))
+        return pb.EcShardsUnmountResponse()
+
+    def VolumeEcShardRead(self, request, context):
+        ev = self.store.find_ec_volume(request.volume_id)
+        if ev is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "ec volume not mounted")
+        if request.generation and ev.encode_ts_ns != request.generation:
+            # generation fence (reference store_ec.go:627)
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, "stale generation")
+        fd = ev.shard_fds.get(request.shard_id)
+        if fd is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "shard not local")
+        remaining = request.size
+        off = request.offset
+        while remaining > 0:
+            chunk = os.pread(fd, min(_EC_STREAM_CHUNK, remaining), off)
+            if not chunk:
+                break
+            yield pb.EcShardReadChunk(data=chunk)
+            off += len(chunk)
+            remaining -= len(chunk)
+
+    def VolumeEcBlobDelete(self, request, context):
+        ev = self.store.find_ec_volume(request.volume_id)
+        if ev is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "ec volume not mounted")
+        ev.delete_needle(request.needle_id)
+        return pb.EcBlobDeleteResponse()
+
+    def VolumeEcShardsToVolume(self, request, context):
+        base = self._ec_base(request.volume_id, request.collection)
+        if base is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "ec volume not found")
+        self.store.unmount_ec_volume(request.volume_id)
+        try:
+            ec_decode_volume(base)
+        except ECError as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        # register the decoded normal volume
+        for loc in self.store.locations:
+            if os.path.dirname(base + ".dat") == loc.directory.rstrip("/"):
+                loc.volumes[request.volume_id] = Volume(
+                    loc.directory,
+                    request.volume_id,
+                    collection=request.collection,
+                    create=False,
+                )
+                self.server.notify_new_volume(request.volume_id)
+                break
+        return pb.EcShardsToVolumeResponse()
+
+    def CopyFile(self, request, context):
+        base = self._ec_base(request.volume_id, request.collection, require=False)
+        path = (base or "") + request.ext
+        if base is None or not os.path.exists(path):
+            context.abort(grpc.StatusCode.NOT_FOUND, f"no {request.ext}")
+        stop = request.stop_offset or os.path.getsize(path)
+        with open(path, "rb") as f:
+            sent = 0
+            while sent < stop:
+                chunk = f.read(min(_EC_STREAM_CHUNK, stop - sent))
+                if not chunk:
+                    break
+                yield pb.CopyFileChunk(data=chunk)
+                sent += len(chunk)
+
+    def VolumeServerStatus(self, request, context):
+        st = self.store.status()
+        return pb.VolumeServerStatusResponse(
+            volumes=[
+                pb.VolumeInfoMsg(
+                    id=v["id"],
+                    collection=v["collection"],
+                    size=v["size"],
+                    file_count=v["file_count"],
+                    deleted_count=v["deleted_count"],
+                    deleted_bytes=v["deleted_bytes"],
+                    read_only=v["read_only"],
+                    replica_placement=v["replica_placement"],
+                    version=v["version"],
+                )
+                for v in st["volumes"]
+            ],
+            ec_shards=[
+                pb.EcShardInfoMsg(
+                    id=e["id"],
+                    collection=e["collection"],
+                    shard_bits=_shard_bits(e["shards"]),
+                    shard_size=e["shard_size"],
+                    data_shards=e["data_shards"],
+                    parity_shards=e["parity_shards"],
+                    generation=e["generation"],
+                )
+                for e in st["ec_volumes"]
+            ],
+        )
+
+    # ------------------------------------------------------------ helpers
+
+    def _ec_base(self, vid: int, collection: str, require: bool = True):
+        """Directory base for a volume's EC artifacts on this server."""
+        for loc in self.store.locations:
+            base = Volume.base_file_name(loc.directory, collection, vid)
+            if (
+                os.path.exists(base + ".ecx")
+                or os.path.exists(base + ".dat")
+                or any(
+                    os.path.exists(base + f".ec{i:02d}")
+                    for i in range(ec_context.MAX_SHARD_COUNT)
+                )
+            ):
+                return base
+        return None
+
+
+class VolumeServer:
+    def __init__(
+        self,
+        directories: list[str],
+        master: str = "localhost:9333",
+        ip: str = "localhost",
+        port: int = 8080,
+        grpc_port: int = 0,
+        max_volume_count: int = 8,
+        ec_backend: str = "auto",
+        data_center: str = "",
+        rack: str = "",
+    ):
+        self.ip = ip
+        self.port = port
+        self.grpc_port = grpc_port or (port + 10000)
+        self.master_addr = master
+        self.master_grpc_addr = self._master_grpc(master)
+        self.max_volume_count = max_volume_count
+        self.data_center = data_center
+        self.rack = rack
+        self._mc = None
+        self._mc_lock = threading.Lock()
+        self._peer_channels: dict[str, grpc.Channel] = {}
+        self.store = Store(
+            directories,
+            ip=ip,
+            port=port,
+            ec_backend=ec_backend,
+            ec_remote_reader_factory=self._remote_reader_factory,
+        )
+        self.service = VolumeService(self)
+
+        self._grpc = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
+        rpc.add_service(self._grpc, rpc.VOLUME_SERVICE, self.service)
+        self._grpc.add_insecure_port(f"{ip}:{self.grpc_port}")
+        self._http = ThreadingHTTPServer((ip, port), self._handler_class())
+        self._http_thread = threading.Thread(
+            target=self._http.serve_forever, daemon=True
+        )
+        self._hb_queue: "queue.Queue[pb.Heartbeat]" = queue.Queue()
+        self._hb_stop = threading.Event()
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
+
+    @staticmethod
+    def _master_grpc(master: str) -> str:
+        host, _, port = master.partition(":")
+        return f"{host}:{int(port) + 10000}"
+
+    # ----------------------------------------------------- remote shards
+
+    def _master_client(self):
+        """Lazy cached MasterClient (vid + EC lookup caches, persistent
+        channel) — one per server, shared by all EC volumes."""
+        with self._mc_lock:
+            if self._mc is None:
+                from ..client.master_client import MasterClient
+
+                self._mc = MasterClient(self.master_addr)
+            return self._mc
+
+    def _peer_stub(self, peer: str):
+        with self._mc_lock:
+            ch = self._peer_channels.get(peer)
+            if ch is None:
+                ch = grpc.insecure_channel(peer)
+                self._peer_channels[peer] = ch
+            return rpc.volume_stub(ch)
+
+    def _remote_reader_factory(self, vid: int, collection: str):
+        def read(shard_id: int, offset: int, size: int, generation: int):
+            try:
+                locs = self._master_client().lookup_ec(vid).get(shard_id, [])
+            except (LookupError, grpc.RpcError):
+                return None
+            my_url = f"{self.ip}:{self.grpc_port}"
+            for loc in locs:
+                peer = f"{loc.url.split(':')[0]}:{loc.grpc_port}"
+                if peer == my_url:
+                    continue
+                try:
+                    buf = b"".join(
+                        c.data
+                        for c in self._peer_stub(peer).VolumeEcShardRead(
+                            pb.EcShardReadRequest(
+                                volume_id=vid,
+                                shard_id=shard_id,
+                                offset=offset,
+                                size=size,
+                                generation=generation,
+                            ),
+                            timeout=30,
+                        )
+                    )
+                    if len(buf) == size:
+                        return buf
+                except grpc.RpcError:
+                    continue
+            return None
+
+        return read
+
+    # ------------------------------------------------------- replication
+
+    def _replica_locations(self, vid: int) -> list[pb.Location]:
+        try:
+            locs = self._master_client().lookup(vid)
+        except (LookupError, grpc.RpcError):
+            return []
+        me = f"{self.ip}:{self.port}"
+        return [l for l in locs if l.url != me]
+
+    def replicate_write(self, request: pb.WriteNeedleRequest) -> str:
+        """Synchronous fan-out to replica holders (reference
+        store_replicate.go:32 DistributedOperation)."""
+        errors = []
+        for loc in self._replica_locations(request.volume_id):
+            rep = pb.WriteNeedleRequest()
+            rep.CopyFrom(request)
+            rep.is_replicate = True
+            try:
+                r = self._peer_stub(
+                    f"{loc.url.split(':')[0]}:{loc.grpc_port}"
+                ).WriteNeedle(rep, timeout=30)
+                if r.error:
+                    errors.append(f"{loc.url}: {r.error}")
+            except grpc.RpcError as e:
+                errors.append(f"{loc.url}: {e.code().name}")
+        return "; ".join(errors)
+
+    def replicate_delete(self, request: pb.DeleteNeedleRequest) -> None:
+        for loc in self._replica_locations(request.volume_id):
+            rep = pb.DeleteNeedleRequest()
+            rep.CopyFrom(request)
+            rep.is_replicate = True
+            try:
+                self._peer_stub(
+                    f"{loc.url.split(':')[0]}:{loc.grpc_port}"
+                ).DeleteNeedle(rep, timeout=30)
+            except grpc.RpcError:
+                pass
+
+    # -------------------------------------------------------- heartbeats
+
+    def _full_heartbeat(self) -> pb.Heartbeat:
+        st = self.store.status()
+        return pb.Heartbeat(
+            ip=self.ip,
+            port=self.port,
+            public_url=self.store.public_url,
+            grpc_port=self.grpc_port,
+            max_volume_count=self.max_volume_count,
+            data_center=self.data_center,
+            rack=self.rack,
+            volumes=[
+                pb.VolumeInfoMsg(
+                    id=v["id"],
+                    collection=v["collection"],
+                    size=v["size"],
+                    file_count=v["file_count"],
+                    deleted_count=v["deleted_count"],
+                    deleted_bytes=v["deleted_bytes"],
+                    read_only=v["read_only"],
+                    replica_placement=v["replica_placement"],
+                    version=v["version"],
+                )
+                for v in st["volumes"]
+            ],
+            ec_shards=[
+                pb.EcShardInfoMsg(
+                    id=e["id"],
+                    collection=e["collection"],
+                    shard_bits=_shard_bits(e["shards"]),
+                    shard_size=e["shard_size"],
+                    data_shards=e["data_shards"],
+                    parity_shards=e["parity_shards"],
+                    generation=e["generation"],
+                )
+                for e in st["ec_volumes"]
+            ],
+            has_no_volumes=not st["volumes"],
+            has_no_ec_shards=not st["ec_volumes"],
+        )
+
+    def notify_new_volume(self, vid: int) -> None:
+        self._hb_queue.put(self._full_heartbeat())
+
+    def notify_deleted_volume(self, vid: int) -> None:
+        self._hb_queue.put(self._full_heartbeat())
+
+    def notify_new_ec_shards(self, vid: int, collection: str) -> None:
+        self._hb_queue.put(self._full_heartbeat())
+
+    def notify_deleted_ec_shards(self, vid: int, collection: str, sids) -> None:
+        self._hb_queue.put(self._full_heartbeat())
+
+    def _heartbeat_iter(self):
+        yield self._full_heartbeat()
+        last_full = time.time()
+        while not self._hb_stop.is_set():
+            try:
+                hb = self._hb_queue.get(timeout=2.0)
+                yield hb
+            except queue.Empty:
+                # periodic full refresh doubles as liveness pulse
+                yield self._full_heartbeat()
+                last_full = time.time()
+
+    def _heartbeat_loop(self):
+        while not self._hb_stop.is_set():
+            try:
+                with grpc.insecure_channel(self.master_grpc_addr) as ch:
+                    stream = rpc.master_stub(ch).SendHeartbeat(self._heartbeat_iter())
+                    for resp in stream:
+                        if self._hb_stop.is_set():
+                            return
+            except grpc.RpcError:
+                if self._hb_stop.wait(1.0):
+                    return
+
+    # -------------------------------------------------------------- http
+
+    def _handler_class(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _error(self, code: int, msg: str) -> None:
+                body = json.dumps({"error": msg}).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _fid(self):
+                path = urlparse(self.path).path.lstrip("/")
+                # accept "<vid>,<fid>" and "<vid>/<fid>"
+                return FileId.parse(path.replace("/", ","))
+
+            def do_GET(self):
+                u = urlparse(self.path)
+                if u.path == "/status":
+                    body = json.dumps(server.store.status()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                try:
+                    fid = self._fid()
+                except FileIdError as e:
+                    return self._error(400, str(e))
+                try:
+                    n = server.store.read_needle(
+                        fid.volume_id, fid.needle_id, fid.cookie
+                    )
+                except (NotFoundError, ECError) as e:
+                    return self._error(404, str(e))
+                except (CookieMismatch, CrcError) as e:
+                    return self._error(404, str(e))
+                self.send_response(200)
+                ctype = n.mime.decode() if n.mime else "application/octet-stream"
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(n.data)))
+                self.send_header("ETag", f'"{n.checksum:08x}"')
+                self.end_headers()
+                if self.command != "HEAD":
+                    self.wfile.write(n.data)
+
+            do_HEAD = do_GET
+
+            def do_POST(self):
+                u = urlparse(self.path)
+                q = parse_qs(u.query)
+                try:
+                    fid = self._fid()
+                except FileIdError as e:
+                    return self._error(400, str(e))
+                length = int(self.headers.get("Content-Length", "0"))
+                body = self.rfile.read(length)
+                name, mime, data = _parse_upload(self.headers, body)
+                req = pb.WriteNeedleRequest(
+                    volume_id=fid.volume_id,
+                    needle_id=fid.needle_id,
+                    cookie=fid.cookie,
+                    data=data,
+                    name=name,
+                    mime=mime,
+                    is_replicate=q.get("type", [""])[0] == "replicate",
+                )
+                resp = server.service.WriteNeedle(req, None)
+                if resp.error:
+                    return self._error(500, resp.error)
+                body = json.dumps({"name": name, "size": resp.size}).encode()
+                self.send_response(201)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_DELETE(self):
+                u = urlparse(self.path)
+                q = parse_qs(u.query)
+                try:
+                    fid = self._fid()
+                except FileIdError as e:
+                    return self._error(400, str(e))
+                resp = server.service.DeleteNeedle(
+                    pb.DeleteNeedleRequest(
+                        volume_id=fid.volume_id,
+                        needle_id=fid.needle_id,
+                        is_replicate=q.get("type", [""])[0] == "replicate",
+                    ),
+                    None,
+                )
+                if resp.error:
+                    return self._error(404, resp.error)
+                body = json.dumps({"size": resp.freed_bytes}).encode()
+                self.send_response(202)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        return Handler
+
+    # -------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._grpc.start()
+        self._http_thread.start()
+        self._hb_thread.start()
+
+    def stop(self) -> None:
+        self._hb_stop.set()
+        self._grpc.stop(grace=0.5)
+        self._http.shutdown()
+        self._http.server_close()
+        with self._mc_lock:
+            if self._mc is not None:
+                self._mc.close()
+            for ch in self._peer_channels.values():
+                ch.close()
+            self._peer_channels.clear()
+        self.store.close()
+
+
+def _parse_upload(headers, body: bytes) -> tuple[str, str, bytes]:
+    """multipart/form-data or raw body -> (name, mime, data)."""
+    ctype = headers.get("Content-Type", "")
+    if ctype.startswith("multipart/form-data"):
+        import email.parser
+        import email.policy
+
+        msg = email.parser.BytesParser(policy=email.policy.HTTP).parsebytes(
+            b"Content-Type: " + ctype.encode() + b"\r\n\r\n" + body
+        )
+        for part in msg.iter_parts():
+            data = part.get_payload(decode=True)
+            if data is None:
+                continue
+            return (
+                part.get_filename() or "",
+                part.get_content_type(),
+                data,
+            )
+        return "", "", b""
+    return "", ctype if ctype != "application/octet-stream" else "", body

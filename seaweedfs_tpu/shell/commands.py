@@ -1,0 +1,384 @@
+"""Shell commands: the ops surface (`weed shell` analog).
+
+Reference: weed/shell/commands.go + command_ec_encode.go:102 (doEcEncode
+pipeline: mark readonly -> generate -> mount -> delete source),
+command_ec_rebuild.go, command_ec_decode.go, volume.* family.
+
+Each command is a function(env, args) -> str; the registry drives both
+the REPL and one-shot `python -m seaweedfs_tpu.shell -c "..."`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+
+import grpc
+
+from ..client.master_client import MasterClient, volume_channel
+from ..pb import cluster_pb2 as pb
+from ..pb import rpc
+
+
+class ShellEnv:
+    def __init__(self, master: str = "localhost:9333"):
+        self.master_addr = master
+        self.master = MasterClient(master)
+
+    def close(self):
+        self.master.close()
+
+
+COMMANDS: dict[str, tuple] = {}
+
+
+def command(name: str, help_text: str):
+    def deco(fn):
+        COMMANDS[name] = (fn, help_text)
+        return fn
+
+    return deco
+
+
+def run_command(env: ShellEnv, line: str) -> str:
+    parts = shlex.split(line)
+    if not parts:
+        return ""
+    name, args = parts[0], parts[1:]
+    if name in ("help", "?"):
+        return "\n".join(
+            f"{n:28s} {h}" for n, (_, h) in sorted(COMMANDS.items())
+        )
+    entry = COMMANDS.get(name)
+    if entry is None:
+        return f"unknown command {name!r} (try `help`)"
+    try:
+        return entry[0](env, args)
+    except grpc.RpcError as e:
+        return f"error: {e.code().name}: {e.details()}"
+    except (LookupError, RuntimeError, OSError) as e:
+        return f"error: {e}"
+
+
+def _locate_volume(env: ShellEnv, vid: int) -> pb.Location:
+    locs = env.master.lookup(vid, refresh=True)
+    if not locs:
+        raise LookupError(f"volume {vid} has no locations")
+    return locs[0]
+
+
+def _volume_stub(loc: pb.Location):
+    ch = volume_channel(loc)
+    return ch, rpc.volume_stub(ch)
+
+
+# ----------------------------------------------------------------- cluster
+
+
+@command("cluster.status", "show nodes and volume/EC counts")
+def cluster_status(env: ShellEnv, args) -> str:
+    topo = env.master.topology()
+    lines = [f"max volume id: {topo.max_volume_id}"]
+    for n in topo.nodes:
+        lines.append(
+            f"  node {n.id} rack={n.rack or '-'} "
+            f"volumes={len(n.volumes)} ec={len(n.ec_shards)}"
+        )
+    return "\n".join(lines)
+
+
+@command("volume.list", "list volumes and EC shard sets per node")
+def volume_list(env: ShellEnv, args) -> str:
+    topo = env.master.topology()
+    lines = []
+    for n in topo.nodes:
+        lines.append(f"node {n.id}:")
+        for v in sorted(n.volumes, key=lambda v: v.id):
+            lines.append(
+                f"  volume {v.id} col={v.collection or '-'} size={v.size} "
+                f"files={v.file_count} del={v.deleted_count} "
+                f"{'RO' if v.read_only else 'RW'} rp={v.replica_placement}"
+            )
+        for e in sorted(n.ec_shards, key=lambda e: e.id):
+            shards = [i for i in range(32) if e.shard_bits & (1 << i)]
+            lines.append(
+                f"  ec {e.id} col={e.collection or '-'} shards={shards} "
+                f"{e.data_shards}+{e.parity_shards} gen={e.generation}"
+            )
+    return "\n".join(lines) or "no nodes"
+
+
+@command("volume.grow", "-count N [-collection c] [-replication xyz]")
+def volume_grow(env: ShellEnv, args) -> str:
+    p = argparse.ArgumentParser(prog="volume.grow")
+    p.add_argument("-count", type=int, default=1)
+    p.add_argument("-collection", default="")
+    p.add_argument("-replication", default="")
+    a = p.parse_args(args)
+    vids = env.master.grow(a.count, a.collection, a.replication)
+    return f"grew volumes: {vids}"
+
+
+@command("volume.vacuum", "-volumeId N [-garbageThreshold 0.3]")
+def volume_vacuum(env: ShellEnv, args) -> str:
+    p = argparse.ArgumentParser(prog="volume.vacuum")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-garbageThreshold", type=float, default=0.0)
+    a = p.parse_args(args)
+    out = []
+    for loc in env.master.lookup(a.volumeId, refresh=True):
+        ch, stub = _volume_stub(loc)
+        with ch:
+            r = stub.VacuumVolume(
+                pb.VacuumRequest(
+                    volume_id=a.volumeId, garbage_threshold=a.garbageThreshold
+                ),
+                timeout=600,
+            )
+        out.append(f"{loc.url}: reclaimed {r.reclaimed_bytes} (ratio {r.garbage_ratio:.2f})")
+    return "\n".join(out)
+
+
+@command("volume.delete", "-volumeId N")
+def volume_delete(env: ShellEnv, args) -> str:
+    p = argparse.ArgumentParser(prog="volume.delete")
+    p.add_argument("-volumeId", type=int, required=True)
+    a = p.parse_args(args)
+    out = []
+    for loc in env.master.lookup(a.volumeId, refresh=True):
+        ch, stub = _volume_stub(loc)
+        with ch:
+            r = stub.VolumeDelete(
+                pb.VolumeCommandRequest(volume_id=a.volumeId), timeout=60
+            )
+        out.append(f"{loc.url}: {r.error or 'deleted'}")
+    return "\n".join(out)
+
+
+@command("volume.mark", "-volumeId N -readonly|-writable")
+def volume_mark(env: ShellEnv, args) -> str:
+    p = argparse.ArgumentParser(prog="volume.mark")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-readonly", action="store_true")
+    p.add_argument("-writable", action="store_true")
+    a = p.parse_args(args)
+    out = []
+    for loc in env.master.lookup(a.volumeId, refresh=True):
+        ch, stub = _volume_stub(loc)
+        with ch:
+            req = pb.VolumeCommandRequest(volume_id=a.volumeId)
+            r = (
+                stub.VolumeMarkWritable(req, timeout=30)
+                if a.writable
+                else stub.VolumeMarkReadonly(req, timeout=30)
+            )
+        out.append(f"{loc.url}: {r.error or 'ok'}")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------- ec
+
+
+@command("ec.encode", "-volumeId N [-collection c] [-backend cpu|tpu|auto] [-keepSource]")
+def ec_encode(env: ShellEnv, args) -> str:
+    """Reference doEcEncode (command_ec_encode.go:346): mark replicas
+    readonly -> generate shards on one holder -> mount -> delete the
+    source volume replicas (unless -keepSource)."""
+    p = argparse.ArgumentParser(prog="ec.encode")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-backend", default="auto")
+    p.add_argument("-keepSource", action="store_true")
+    a = p.parse_args(args)
+
+    locs = env.master.lookup(a.volumeId, refresh=True)
+    if not locs:
+        return f"volume {a.volumeId} not found"
+    # 1. mark every replica readonly
+    for loc in locs:
+        ch, stub = _volume_stub(loc)
+        with ch:
+            stub.VolumeMarkReadonly(
+                pb.VolumeCommandRequest(volume_id=a.volumeId), timeout=30
+            )
+    # 2. generate on the first holder
+    gen_loc = locs[0]
+    ch, stub = _volume_stub(gen_loc)
+    with ch:
+        r = stub.VolumeEcShardsGenerate(
+            pb.EcShardsGenerateRequest(
+                volume_id=a.volumeId,
+                collection=a.collection,
+                backend=a.backend,
+            ),
+            timeout=3600,
+        )
+        generation = r.generation
+        # 3. mount all shards there
+        stub.VolumeEcShardsMount(
+            pb.EcShardsMountRequest(
+                volume_id=a.volumeId, collection=a.collection
+            ),
+            timeout=60,
+        )
+    # 4. delete source volume replicas
+    if not a.keepSource:
+        for loc in locs:
+            ch, stub = _volume_stub(loc)
+            with ch:
+                stub.VolumeDelete(
+                    pb.VolumeCommandRequest(volume_id=a.volumeId), timeout=60
+                )
+    return (
+        f"ec.encode volume {a.volumeId}: generation {generation} on "
+        f"{gen_loc.url}{' (source kept)' if a.keepSource else ''}"
+    )
+
+
+@command("ec.rebuild", "-volumeId N [-collection c] [-backend cpu|tpu|auto]")
+def ec_rebuild(env: ShellEnv, args) -> str:
+    p = argparse.ArgumentParser(prog="ec.rebuild")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-backend", default="")
+    a = p.parse_args(args)
+    shard_locs = env.master.lookup_ec(a.volumeId)
+    if not shard_locs:
+        return f"ec volume {a.volumeId} not found"
+    # rebuild on the node holding the most shards
+    by_url: dict[str, list[int]] = {}
+    loc_by_url = {}
+    for sid, locs in shard_locs.items():
+        for loc in locs:
+            by_url.setdefault(loc.url, []).append(sid)
+            loc_by_url[loc.url] = loc
+    url = max(by_url, key=lambda u: len(by_url[u]))
+    ch, stub = _volume_stub(loc_by_url[url])
+    with ch:
+        r = stub.VolumeEcShardsRebuild(
+            pb.EcShardsRebuildRequest(
+                volume_id=a.volumeId, collection=a.collection, backend=a.backend
+            ),
+            timeout=3600,
+        )
+        stub.VolumeEcShardsMount(
+            pb.EcShardsMountRequest(volume_id=a.volumeId, collection=a.collection),
+            timeout=60,
+        )
+    return f"rebuilt shards {list(r.rebuilt_shard_ids)} on {url}"
+
+
+@command("ec.decode", "-volumeId N [-collection c]")
+def ec_decode(env: ShellEnv, args) -> str:
+    """Collect all shards onto the node already holding the most, decode
+    there, then clean the EC artifacts off every node (reference
+    command_ec_decode.go: collectEcShards -> VolumeEcShardsToVolume ->
+    delete shards)."""
+    p = argparse.ArgumentParser(prog="ec.decode")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    a = p.parse_args(args)
+    shard_locs = env.master.lookup_ec(a.volumeId, refresh=True)
+    if not shard_locs:
+        return f"ec volume {a.volumeId} not found"
+    by_url: dict[str, set[int]] = {}
+    loc_by_url = {}
+    for sid, locs in shard_locs.items():
+        for loc in locs:
+            by_url.setdefault(loc.url, set()).add(sid)
+            loc_by_url[loc.url] = loc
+    target_url = max(by_url, key=lambda u: len(by_url[u]))
+    target = loc_by_url[target_url]
+    have = by_url[target_url]
+
+    ch, stub = _volume_stub(target)
+    with ch:
+        copied_index = False
+        for sid in sorted(shard_locs):
+            if sid in have:
+                continue
+            src = next(
+                l for l in shard_locs[sid] if l.url != target_url
+            )
+            stub.VolumeEcShardsCopy(
+                pb.EcShardsCopyRequest(
+                    volume_id=a.volumeId,
+                    collection=a.collection,
+                    shard_ids=[sid],
+                    source_url=f"{src.url.split(':')[0]}:{src.grpc_port}",
+                    copy_ecx=not copied_index and not have,
+                    copy_ecj=not copied_index and not have,
+                    copy_vif=not copied_index and not have,
+                    copy_ecsum=not copied_index and not have,
+                ),
+                timeout=3600,
+            )
+            copied_index = True
+        stub.VolumeEcShardsToVolume(
+            pb.EcShardsToVolumeRequest(
+                volume_id=a.volumeId, collection=a.collection
+            ),
+            timeout=3600,
+        )
+    # clean EC artifacts off the other nodes
+    all_sids = sorted(shard_locs)
+    for url, sids in by_url.items():
+        if url == target_url:
+            continue
+        ch, stub = _volume_stub(loc_by_url[url])
+        with ch:
+            stub.VolumeEcShardsUnmount(
+                pb.EcShardsUnmountRequest(volume_id=a.volumeId, shard_ids=all_sids),
+                timeout=60,
+            )
+            stub.VolumeEcShardsDelete(
+                pb.EcShardsDeleteRequest(
+                    volume_id=a.volumeId,
+                    collection=a.collection,
+                    shard_ids=all_sids,
+                ),
+                timeout=60,
+            )
+    return f"decoded ec volume {a.volumeId} back to a normal volume on {target_url}"
+
+
+# ------------------------------------------------------------------- blobs
+
+
+@command("upload", "upload a local file; prints fid")
+def upload(env: ShellEnv, args) -> str:
+    from ..client.operations import Operations
+
+    p = argparse.ArgumentParser(prog="upload")
+    p.add_argument("path")
+    p.add_argument("-collection", default="")
+    p.add_argument("-replication", default="")
+    a = p.parse_args(args)
+    ops = Operations(env.master_addr)
+    try:
+        with open(a.path, "rb") as f:
+            fid = ops.upload(
+                f.read(), name=a.path, collection=a.collection,
+                replication=a.replication,
+            )
+        return fid
+    finally:
+        ops.close()
+
+
+@command("download", "download -fid <fid> -o <path>")
+def download(env: ShellEnv, args) -> str:
+    from ..client.operations import Operations
+
+    p = argparse.ArgumentParser(prog="download")
+    p.add_argument("-fid", required=True)
+    p.add_argument("-o", required=True)
+    a = p.parse_args(args)
+    ops = Operations(env.master_addr)
+    try:
+        data = ops.read(a.fid)
+        with open(a.o, "wb") as f:
+            f.write(data)
+        return f"{len(data)} bytes -> {a.o}"
+    finally:
+        ops.close()

@@ -1,0 +1,278 @@
+"""Store: all volumes + EC volumes on one server, across disk locations.
+
+Reference: weed/storage/store.go:60 (Store), disk_location.go /
+disk_location_ec.go (per-directory volume discovery, EC siblings),
+heartbeat assembly (CollectHeartbeat, store_ec.go:137).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..ec.context import ECError
+from ..ec.ec_volume import EcVolume
+from .needle import Needle
+from .volume import NotFoundError, Volume, VolumeError
+
+_DAT_RE = re.compile(r"^(?:(?P<col>[^_]+)_)?(?P<vid>\d+)\.dat$")
+_ECX_RE = re.compile(r"^(?:(?P<col>[^_]+)_)?(?P<vid>\d+)\.ecx$")
+
+
+@dataclass
+class DiskLocation:
+    """One storage directory (the reference also tags disk type; one
+    default type here until tiering lands)."""
+
+    directory: str
+    max_volume_count: int = 0  # 0 = unlimited
+    volumes: dict[int, Volume] = field(default_factory=dict)
+    ec_volumes: dict[int, EcVolume] = field(default_factory=dict)
+
+    def load_existing(self, ec_backend: str = "auto", remote_reader_factory=None) -> None:
+        for name in sorted(os.listdir(self.directory)):
+            m = _DAT_RE.match(name)
+            if m:
+                vid = int(m.group("vid"))
+                col = m.group("col") or ""
+                try:
+                    self.volumes[vid] = Volume(
+                        self.directory, vid, collection=col, create=False
+                    )
+                except VolumeError:
+                    continue
+            m = _ECX_RE.match(name)
+            if m:
+                vid = int(m.group("vid"))
+                col = m.group("col") or ""
+                base = Volume.base_file_name(self.directory, col, vid)
+                # only mount when at least one shard is local
+                if any(
+                    os.path.exists(base + f".ec{i:02d}") for i in range(32)
+                ):
+                    try:
+                        self.ec_volumes[vid] = EcVolume(
+                            self.directory, vid, collection=col,
+                            backend_name=ec_backend,
+                            remote_reader=remote_reader_factory(vid, col)
+                            if remote_reader_factory
+                            else None,
+                        )
+                    except ECError:
+                        continue
+
+
+class Store:
+    def __init__(
+        self,
+        directories: list[str],
+        ip: str = "localhost",
+        port: int = 0,
+        public_url: str = "",
+        ec_backend: str = "auto",
+        ec_remote_reader_factory=None,
+    ):
+        self.ip = ip
+        self.port = port
+        self.public_url = public_url or f"{ip}:{port}"
+        self.ec_backend = ec_backend
+        self.ec_remote_reader_factory = ec_remote_reader_factory
+        self._lock = threading.RLock()
+        self.locations = [DiskLocation(d) for d in directories]
+        for loc in self.locations:
+            os.makedirs(loc.directory, exist_ok=True)
+            loc.load_existing(ec_backend, ec_remote_reader_factory)
+
+    # ----------------------------------------------------------- lookup
+
+    def find_volume(self, vid: int) -> Optional[Volume]:
+        for loc in self.locations:
+            v = loc.volumes.get(vid)
+            if v is not None:
+                return v
+        return None
+
+    def find_ec_volume(self, vid: int) -> Optional[EcVolume]:
+        for loc in self.locations:
+            ev = loc.ec_volumes.get(vid)
+            if ev is not None:
+                return ev
+        return None
+
+    def location_of(self, vid: int) -> Optional[DiskLocation]:
+        for loc in self.locations:
+            if vid in loc.volumes:
+                return loc
+        return None
+
+    def volume_ids(self) -> list[int]:
+        return sorted(vid for loc in self.locations for vid in loc.volumes)
+
+    def ec_volume_ids(self) -> list[int]:
+        return sorted(vid for loc in self.locations for vid in loc.ec_volumes)
+
+    # ----------------------------------------------------------- manage
+
+    def _pick_location(self) -> DiskLocation:
+        # fewest volumes first (the reference scores free slots per disk)
+        return min(self.locations, key=lambda l: len(l.volumes) + len(l.ec_volumes))
+
+    def allocate_volume(
+        self, vid: int, collection: str = "", replica_placement: str = "000"
+    ) -> Volume:
+        with self._lock:
+            if self.find_volume(vid) is not None:
+                raise VolumeError(f"volume {vid} already exists")
+            loc = self._pick_location()
+            v = Volume(
+                loc.directory,
+                vid,
+                collection=collection,
+                replica_placement=replica_placement,
+            )
+            loc.volumes[vid] = v
+            return v
+
+    def delete_volume(self, vid: int) -> None:
+        with self._lock:
+            for loc in self.locations:
+                v = loc.volumes.pop(vid, None)
+                if v is not None:
+                    v.close()
+                    base = v.dat_path[:-4]
+                    exts = [".dat", ".idx", ".cpd", ".cpx"]
+                    # .vif/.ecsum describe the EC artifacts too: keep them
+                    # while EC files coexist (reference Destroy behavior,
+                    # volume_destroy_ec_vif_test.go).
+                    has_ec = os.path.exists(base + ".ecx") or any(
+                        os.path.exists(base + f".ec{i:02d}") for i in range(32)
+                    )
+                    if not has_ec:
+                        exts += [".vif", ".ecsum"]
+                    for ext in exts:
+                        if os.path.exists(base + ext):
+                            os.unlink(base + ext)
+                    return
+        raise NotFoundError(f"volume {vid} not found")
+
+    def mount_ec_volume(self, vid: int, collection: str = "") -> EcVolume:
+        with self._lock:
+            ev = self.find_ec_volume(vid)
+            if ev is not None:
+                return ev
+            for loc in self.locations:
+                base = Volume.base_file_name(loc.directory, collection, vid)
+                if os.path.exists(base + ".ecx"):
+                    ev = EcVolume(
+                        loc.directory,
+                        vid,
+                        collection,
+                        backend_name=self.ec_backend,
+                        remote_reader=self.ec_remote_reader_factory(vid, collection)
+                        if self.ec_remote_reader_factory
+                        else None,
+                    )
+                    loc.ec_volumes[vid] = ev
+                    return ev
+        raise NotFoundError(f"ec volume {vid} not found in any location")
+
+    def unmount_ec_volume(self, vid: int) -> None:
+        with self._lock:
+            for loc in self.locations:
+                ev = loc.ec_volumes.pop(vid, None)
+                if ev is not None:
+                    ev.close()
+                    return
+
+    def unmount_ec_shards(self, vid: int, shard_ids: list[int]) -> None:
+        """Partial unmount: stop serving just these shards; the volume
+        stays mounted while any shard remains."""
+        if not shard_ids:
+            return self.unmount_ec_volume(vid)
+        with self._lock:
+            for loc in self.locations:
+                ev = loc.ec_volumes.get(vid)
+                if ev is None:
+                    continue
+                if ev.unmount_shards(shard_ids) == 0:
+                    loc.ec_volumes.pop(vid, None)
+                    ev.close()
+                return
+
+    # --------------------------------------------------------------- io
+
+    def write_needle(self, vid: int, n: Needle, fsync: bool = False) -> int:
+        v = self.find_volume(vid)
+        if v is None:
+            raise NotFoundError(f"volume {vid} not found")
+        _, size = v.write_needle(n, fsync=fsync)
+        return size
+
+    def read_needle(
+        self, vid: int, needle_id: int, cookie: Optional[int] = None
+    ) -> Needle:
+        v = self.find_volume(vid)
+        if v is not None:
+            return v.read_needle(needle_id, cookie)
+        ev = self.find_ec_volume(vid)
+        if ev is not None:
+            return ev.read_needle(needle_id, cookie)
+        raise NotFoundError(f"volume {vid} not found")
+
+    def delete_needle(self, vid: int, needle_id: int) -> int:
+        v = self.find_volume(vid)
+        if v is not None:
+            return v.delete_needle(needle_id)
+        ev = self.find_ec_volume(vid)
+        if ev is not None:
+            return ev.delete_needle(needle_id)
+        raise NotFoundError(f"volume {vid} not found")
+
+    # ---------------------------------------------------------- status
+
+    def status(self) -> dict:
+        vols = []
+        for loc in self.locations:
+            for vid, v in sorted(loc.volumes.items()):
+                st = v.stat()
+                vols.append(
+                    {
+                        "id": vid,
+                        "collection": st.collection,
+                        "size": st.size,
+                        "file_count": st.file_count,
+                        "deleted_count": st.deleted_count,
+                        "deleted_bytes": st.deleted_bytes,
+                        "read_only": st.read_only,
+                        "replica_placement": st.replica_placement,
+                        "version": st.version,
+                    }
+                )
+        ecs = []
+        for loc in self.locations:
+            for vid, ev in sorted(loc.ec_volumes.items()):
+                ecs.append(
+                    {
+                        "id": vid,
+                        "collection": ev.collection,
+                        "shards": ev.shard_ids,
+                        "shard_size": ev.shard_size(),
+                        "data_shards": ev.ctx.data_shards,
+                        "parity_shards": ev.ctx.parity_shards,
+                        "generation": ev.encode_ts_ns,
+                    }
+                )
+        return {"volumes": vols, "ec_volumes": ecs}
+
+    def close(self) -> None:
+        with self._lock:
+            for loc in self.locations:
+                for v in loc.volumes.values():
+                    v.close()
+                for ev in loc.ec_volumes.values():
+                    ev.close()
+                loc.volumes.clear()
+                loc.ec_volumes.clear()

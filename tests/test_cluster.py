@@ -1,0 +1,263 @@
+"""Cluster slice integration: in-process master + volume servers on
+loopback, driven through real gRPC/HTTP.
+
+Modeled on the reference's in-process harness technique
+(test/plugin_workers/framework.go) rather than process spawning — same
+protocols, no subprocess overhead. Process-spawned tests live in
+test_cluster_spawn.py.
+"""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+import requests
+
+from seaweedfs_tpu.client.operations import Operations
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.shell.commands import ShellEnv, run_command
+from seaweedfs_tpu.storage.file_id import FileId
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    mport = free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vols = []
+    for i in range(2):
+        vs = VolumeServer(
+            directories=[str(tmp_path / f"v{i}")],
+            master=f"localhost:{mport}",
+            ip="localhost",
+            port=free_port(),
+            ec_backend="cpu",
+        )
+        vs.start()
+        vols.append(vs)
+    deadline = time.time() + 10
+    while len(master.topo.nodes) < 2:
+        if time.time() > deadline:
+            raise TimeoutError("volume servers did not register")
+        time.sleep(0.05)
+    yield master, vols
+    for vs in vols:
+        vs.stop()
+    master.stop()
+
+
+def wait_for(cond, timeout=10.0, msg="condition"):
+    deadline = time.time() + timeout
+    while not cond():
+        if time.time() > deadline:
+            raise TimeoutError(msg)
+        time.sleep(0.05)
+
+
+def test_assign_upload_read_delete(cluster):
+    master, vols = cluster
+    ops = Operations(f"localhost:{master.port}")
+    try:
+        data = b"hello tpu world" * 1000
+        fid = ops.upload(data, name="hello.bin", mime="text/plain")
+        assert ops.read(fid) == data
+        # read again via raw HTTP with the fid URL form
+        f = FileId.parse(fid)
+        loc = ops.master.lookup(f.volume_id)[0]
+        r = requests.get(f"http://{loc.url}/{fid}")
+        assert r.status_code == 200 and r.content == data
+        assert r.headers["Content-Type"] == "text/plain"
+        # wrong cookie 404s
+        bad = f"{f.volume_id},{f.needle_id:x}{(f.cookie ^ 1):08x}"
+        assert requests.get(f"http://{loc.url}/{bad}").status_code == 404
+        ops.delete(fid)
+        with pytest.raises(LookupError):
+            ops.read(fid)
+    finally:
+        ops.close()
+
+
+def test_replicated_write(cluster):
+    master, vols = cluster
+    ops = Operations(f"localhost:{master.port}")
+    try:
+        data = b"replicated-blob" * 100
+        fid = ops.upload(data, replication="001")
+        f = FileId.parse(fid)
+        locs = ops.master.lookup(f.volume_id)
+        assert len(locs) == 2, "001 => 2 copies on 2 servers"
+        for loc in locs:
+            r = requests.get(f"http://{loc.url}/{fid}")
+            assert r.status_code == 200 and r.content == data
+        # delete propagates to both replicas
+        ops.delete(fid)
+        for loc in locs:
+            assert requests.get(f"http://{loc.url}/{fid}").status_code == 404
+    finally:
+        ops.close()
+
+
+def test_heartbeat_liveness(cluster):
+    master, vols = cluster
+    vols[1].stop()
+    wait_for(
+        lambda: len(master.topo.nodes) == 1,
+        msg="stopped node should be unregistered when its stream drops",
+    )
+    vols.pop()
+
+
+def test_ec_encode_read_rebuild_decode(cluster, tmp_path):
+    master, vols = cluster
+    addr = f"localhost:{master.port}"
+    ops = Operations(addr)
+    env = ShellEnv(addr)
+    rng = np.random.default_rng(1)
+    try:
+        blobs = {}
+        for i in range(40):
+            data = rng.integers(0, 256, int(rng.integers(1, 80_000)), np.uint8).tobytes()
+            blobs[ops.upload(data, collection="")] = data
+        vid = FileId.parse(next(iter(blobs))).volume_id
+
+        out = run_command(env, f"ec.encode -volumeId {vid} -backend cpu")
+        assert "generation" in out
+        wait_for(
+            lambda: any(
+                vid in n.ec_shards for n in master.topo.nodes.values()
+            ),
+            msg="ec shards should register via heartbeat",
+        )
+        # source volume deleted; reads must come from EC shards
+        wait_for(
+            lambda: not any(
+                vid in n.volumes for n in master.topo.nodes.values()
+            ),
+            msg="source volume should be deleted after ec.encode",
+        )
+        for fid, data in blobs.items():
+            assert ops.read(fid) == data, "EC read path"
+
+        # EC delete via HTTP -> .ecj journal
+        victim = next(iter(blobs))
+        ops.delete(victim)
+        r = requests.get(
+            f"http://{ops.master.lookup(vid, refresh=True)[0].url}/{victim}"
+        )
+        assert r.status_code == 404
+
+        # damage two shards on disk, rebuild, then decode to normal volume
+        out = run_command(env, f"ec.rebuild -volumeId {vid}")
+        assert "rebuilt shards []" in out  # nothing missing yet
+
+        out = run_command(env, f"ec.decode -volumeId {vid}")
+        assert "decoded" in out
+        wait_for(
+            lambda: any(
+                vid in n.volumes for n in master.topo.nodes.values()
+            ),
+            msg="decoded volume should register",
+        )
+        for fid, data in blobs.items():
+            if fid == victim:
+                continue
+            assert ops.read(fid) == data, "post-decode read"
+        assert requests.get(
+            f"http://{ops.master.lookup(vid, refresh=True)[0].url}/{victim}"
+        ).status_code == 404, "EC tombstone survives decode"
+    finally:
+        env.close()
+        ops.close()
+
+
+def test_ec_remote_shard_read(cluster):
+    """Move some shards to the second server; reads on the first must
+    fetch them over VolumeEcShardRead (or recover via RS)."""
+    master, vols = cluster
+    addr = f"localhost:{master.port}"
+    ops = Operations(addr)
+    env = ShellEnv(addr)
+    rng = np.random.default_rng(2)
+    try:
+        blobs = {}
+        for i in range(20):
+            data = rng.integers(0, 256, 50_000, np.uint8).tobytes()
+            blobs[ops.upload(data)] = data
+        vid = FileId.parse(next(iter(blobs))).volume_id
+        run_command(env, f"ec.encode -volumeId {vid} -backend cpu")
+
+        # find holder, move shards 0-6 to the other node
+        import grpc as grpc_mod
+
+        from seaweedfs_tpu.pb import cluster_pb2 as pb
+        from seaweedfs_tpu.pb import rpc as rpcmod
+
+        holder = next(
+            vs for vs in vols if vs.store.find_ec_volume(vid) is not None
+        )
+        other = next(vs for vs in vols if vs is not holder)
+        move = list(range(7))
+        with grpc_mod.insecure_channel(
+            f"localhost:{other.grpc_port}"
+        ) as ch:
+            stub = rpcmod.volume_stub(ch)
+            stub.VolumeEcShardsCopy(
+                pb.EcShardsCopyRequest(
+                    volume_id=vid,
+                    shard_ids=move,
+                    source_url=f"localhost:{holder.grpc_port}",
+                    copy_ecx=True,
+                    copy_ecj=True,
+                    copy_vif=True,
+                    copy_ecsum=True,
+                ),
+                timeout=120,
+            )
+            stub.VolumeEcShardsMount(
+                pb.EcShardsMountRequest(volume_id=vid), timeout=30
+            )
+        with grpc_mod.insecure_channel(
+            f"localhost:{holder.grpc_port}"
+        ) as ch:
+            stub = rpcmod.volume_stub(ch)
+            # partial unmount: shards 7-13 must keep serving
+            stub.VolumeEcShardsUnmount(
+                pb.EcShardsUnmountRequest(volume_id=vid, shard_ids=move),
+                timeout=30,
+            )
+            stub.VolumeEcShardsDelete(
+                pb.EcShardsDeleteRequest(volume_id=vid, shard_ids=move),
+                timeout=30,
+            )
+        assert holder.store.find_ec_volume(vid) is not None, "partial unmount"
+        assert holder.store.find_ec_volume(vid).shard_ids == list(range(7, 14))
+        wait_for(
+            lambda: len(master.topo.lookup_ec(vid)) == 14
+            and all(
+                locs for locs in master.topo.lookup_ec(vid).values()
+            ),
+            msg="all 14 shards should be registered across both nodes",
+        )
+        for fid, data in blobs.items():
+            assert ops.read(fid) == data, "split-shard EC read"
+
+        # decode with shards spread across nodes: shell collects first
+        out = run_command(env, f"ec.decode -volumeId {vid}")
+        assert "decoded" in out, out
+        wait_for(
+            lambda: any(vid in n.volumes for n in master.topo.nodes.values()),
+            msg="decoded volume should register",
+        )
+        for fid, data in blobs.items():
+            assert ops.read(fid) == data, "post-split-decode read"
+    finally:
+        env.close()
+        ops.close()

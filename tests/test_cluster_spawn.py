@@ -1,0 +1,122 @@
+"""Spawned-process cluster test: real `python -m seaweedfs_tpu.server`
+binaries on ephemeral ports, driven over HTTP + the shell CLI
+(reference technique: test/volume_server/framework/cluster.go).
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+import requests
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def spawned(tmp_path):
+    mport, vport = free_port(), free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "seaweedfs_tpu.server",
+            "server",
+            "-masterPort",
+            str(mport),
+            "-port",
+            str(vport),
+            "-dir",
+            str(tmp_path / "data"),
+            "-ec.backend",
+            "cpu",
+        ],
+        cwd=REPO,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    deadline = time.time() + 30
+    while True:
+        try:
+            r = requests.get(f"http://localhost:{mport}/cluster/status", timeout=1)
+            if r.ok and r.json()["DataNodes"]:
+                break
+        except requests.RequestException:
+            pass
+        if time.time() > deadline or proc.poll() is not None:
+            out = proc.stdout.read().decode(errors="replace") if proc.stdout else ""
+            proc.kill()
+            raise TimeoutError(f"server did not come up:\n{out}")
+        time.sleep(0.2)
+    yield mport, vport
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def shell(mport: int, cmd: str) -> str:
+    r = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "seaweedfs_tpu.shell",
+            "-master",
+            f"localhost:{mport}",
+            "-c",
+            cmd,
+        ],
+        cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert r.returncode == 0, r.stderr
+    return r.stdout.strip()
+
+
+def test_spawned_end_to_end(spawned):
+    mport, vport = spawned
+    # assign via master HTTP API
+    a = requests.get(f"http://localhost:{mport}/dir/assign").json()
+    assert "fid" in a, a
+    data = os.urandom(100_000)
+    r = requests.post(
+        f"http://{a['url']}/{a['fid']}", files={"file": ("x.bin", data)}
+    )
+    assert r.status_code == 201, r.text
+    lk = requests.get(
+        f"http://localhost:{mport}/dir/lookup?volumeId={a['fid'].split(',')[0]}"
+    ).json()
+    url = lk["locations"][0]["url"]
+    assert requests.get(f"http://{url}/{a['fid']}").content == data
+
+    # shell: list, ec.encode the volume, read through EC
+    vid = int(a["fid"].split(",")[0])
+    out = shell(mport, "volume.list")
+    assert f"volume {vid}" in out
+    out = shell(mport, f"ec.encode -volumeId {vid} -backend cpu")
+    assert "generation" in out
+    deadline = time.time() + 10
+    while True:
+        out = shell(mport, "volume.list")
+        if f"ec {vid}" in out:
+            break
+        assert time.time() < deadline, out
+        time.sleep(0.3)
+    assert requests.get(f"http://{url}/{a['fid']}").content == data
+    out = shell(mport, "cluster.status")
+    assert "node" in out
