@@ -1,0 +1,6 @@
+"""Filer: POSIX-ish metadata namespace over the blob store (layer 5)."""
+
+from .chunks import ChunkView, read_chunk_views, total_size, visible_intervals
+from .entry import Entry, new_entry, normalize_path, split_path
+from .filer import DEFAULT_CHUNK_SIZE, Filer, FilerError
+from .filer_store import FilerStore, MemoryStore, NotFound, SqliteStore

@@ -1,0 +1,307 @@
+"""Filer core: a POSIX-ish namespace over a FilerStore, with file
+content chunked across the blob store.
+
+Reference: weed/filer/filer.go (CreateEntry :217 with parent mkdirs),
+filer_deletion.go (async chunk GC), filer_rename.go (2-phase move),
+filer_server_handlers_write_upload.go:32 (chunked upload path).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import queue
+import threading
+import time
+from typing import Iterator, Optional
+
+from ..client.operations import Operations
+from ..filer.chunks import read_chunk_views, total_size
+from ..pb import filer_pb2 as fpb
+from .entry import Entry, new_entry, normalize_path, split_path
+from .filer_store import FilerStore, NotFound
+
+DEFAULT_CHUNK_SIZE = 4 * 1024 * 1024  # reference filer -maxMB default
+INLINE_LIMIT = 0  # small-content inlining threshold (0 = off for now)
+
+
+class FilerError(Exception):
+    pass
+
+
+class Filer:
+    def __init__(
+        self,
+        store: FilerStore,
+        master: str = "localhost:9333",
+        collection: str = "",
+        replication: str = "",
+        chunk_size: int = DEFAULT_CHUNK_SIZE,
+    ):
+        self.store = store
+        self.ops = Operations(master)
+        self.collection = collection
+        self.replication = replication
+        self.chunk_size = chunk_size
+        # async chunk GC (reference filer_deletion.go)
+        self._gc_queue: "queue.Queue[tuple[str, int]]" = queue.Queue()
+        self._gc_stop = threading.Event()
+        self._gc_thread = threading.Thread(target=self._gc_loop, daemon=True)
+        self._gc_thread.start()
+        self._listeners: list = []
+
+    # ------------------------------------------------------------- meta log
+
+    def subscribe(self, fn) -> None:
+        """fn(FullEventNotification) on every mutation."""
+        self._listeners.append(fn)
+
+    def _notify(
+        self,
+        directory: str,
+        old: Optional[Entry],
+        new: Optional[Entry],
+        delete_chunks: bool = False,
+    ) -> None:
+        if not self._listeners:
+            return
+        ev = fpb.FullEventNotification(directory=directory, ts_ns=time.time_ns())
+        if old is not None:
+            ev.event.old_entry.CopyFrom(old.to_proto())
+        if new is not None:
+            ev.event.new_entry.CopyFrom(new.to_proto())
+        ev.event.delete_chunks = delete_chunks
+        for fn in list(self._listeners):
+            try:
+                fn(ev)
+            except Exception:
+                pass
+
+    # ----------------------------------------------------------- namespace
+
+    def create_entry(self, entry: Entry, ensure_parents: bool = True) -> None:
+        if ensure_parents:
+            self._ensure_parents(entry.directory)
+        old = self._try_find(entry.directory, entry.name)
+        if old is not None and old.is_directory != entry.is_directory:
+            raise FilerError(
+                f"{entry.full_path}: type conflict with existing entry"
+            )
+        self.store.insert(entry)
+        self._notify(entry.directory, old, entry)
+
+    def _ensure_parents(self, directory: str) -> None:
+        directory = normalize_path(directory)
+        if directory == "/":
+            return
+        parts = directory.strip("/").split("/")
+        path = ""
+        for part in parts:
+            parent = path or "/"
+            path = f"{path}/{part}"
+            existing = self._try_find(parent, part)
+            if existing is None:
+                self.store.insert(new_entry(path, is_directory=True, mode=0o755))
+            elif not existing.is_directory:
+                raise FilerError(f"{path} exists and is not a directory")
+
+    def _try_find(self, directory: str, name: str) -> Optional[Entry]:
+        try:
+            return self.store.find(directory, name)
+        except NotFound:
+            return None
+
+    def find_entry(self, full_path: str) -> Entry:
+        directory, name = split_path(full_path)
+        if name == "":
+            root = Entry(directory="/", name="", is_directory=True)
+            root.attr.file_mode = 0o755
+            return root
+        return self.store.find(directory, name)
+
+    def exists(self, full_path: str) -> bool:
+        try:
+            self.find_entry(full_path)
+            return True
+        except NotFound:
+            return False
+
+    def list_entries(
+        self, directory: str, start_from: str = "", limit: int = 1024,
+        prefix: str = "",
+    ) -> Iterator[Entry]:
+        return self.store.list(
+            normalize_path(directory), start_from, limit, prefix
+        )
+
+    def delete_entry(
+        self, full_path: str, recursive: bool = False, gc_chunks: bool = True
+    ) -> None:
+        directory, name = split_path(full_path)
+        entry = self._try_find(directory, name)
+        if entry is None:
+            return
+        if entry.is_directory:
+            children = list(self.store.list(entry.full_path, limit=2))
+            if children and not recursive:
+                raise FilerError(f"{full_path} not empty")
+            for child in self.store.list(entry.full_path, limit=1_000_000):
+                self.delete_entry(
+                    child.full_path, recursive=True, gc_chunks=gc_chunks
+                )
+            self.store.delete_folder_children(entry.full_path)
+        self.store.delete(directory, name)
+        if gc_chunks and entry.chunks:
+            for c in entry.chunks:
+                self._gc_queue.put((c.fid, 0))
+        self._notify(directory, entry, None, delete_chunks=gc_chunks)
+
+    def rename(self, old_path: str, new_path: str) -> None:
+        """2-phase move (reference filer_rename.go): insert at the new
+        location, then remove the old key. Chunks move by reference.
+        An existing destination file is overwritten (chunks GC'd); a
+        destination directory is never clobbered."""
+        old_dir, old_name = split_path(old_path)
+        entry = self.store.find(old_dir, old_name)
+        dest = self._try_find(*split_path(new_path))
+        if dest is not None:
+            if dest.is_directory:
+                raise FilerError(f"{new_path} exists and is a directory")
+            if entry.is_directory:
+                raise FilerError(f"cannot rename directory over file {new_path}")
+            for c in dest.chunks:
+                self._gc_queue.put((c.fid, 0))
+        if entry.is_directory:
+            # move the whole subtree
+            for child in list(self.store.list(entry.full_path, limit=1_000_000)):
+                self.rename(
+                    child.full_path, f"{normalize_path(new_path)}/{child.name}"
+                )
+        new_dir, new_name = split_path(new_path)
+        self._ensure_parents(new_dir)
+        moved = Entry(
+            directory=new_dir,
+            name=new_name,
+            is_directory=entry.is_directory,
+            chunks=entry.chunks,
+            content=entry.content,
+        )
+        moved.attr.CopyFrom(entry.attr)
+        moved.extended = entry.extended
+        self.store.insert(moved)
+        self.store.delete(old_dir, old_name)
+        self._notify(old_dir, entry, None)
+        self._notify(new_dir, None, moved)
+
+    # -------------------------------------------------------------- content
+
+    def write_file(
+        self,
+        full_path: str,
+        data: bytes,
+        mime: str = "",
+        mode: int = 0o644,
+    ) -> Entry:
+        """Slice into chunk_size pieces, assign+upload each, create the
+        entry (reference uploadRequestToChunks)."""
+        full_path = normalize_path(full_path)
+        old = self._try_find(*split_path(full_path))
+        if old is not None and old.is_directory:
+            # fail BEFORE uploading chunks that create_entry would orphan
+            raise FilerError(f"{full_path}: type conflict with existing entry")
+        chunks = []
+        ts = time.time_ns()
+        for off in range(0, len(data), self.chunk_size) or [0]:
+            piece = data[off : off + self.chunk_size]
+            if not piece and off > 0:
+                break
+            fid = self.ops.upload(
+                piece,
+                name=full_path.rsplit("/", 1)[-1],
+                collection=self.collection,
+                replication=self.replication,
+            )
+            chunks.append(
+                fpb.FileChunk(
+                    fid=fid,
+                    offset=off,
+                    size=len(piece),
+                    modified_ts_ns=ts,
+                    etag=hashlib.md5(piece).hexdigest(),
+                )
+            )
+        entry = new_entry(full_path, mode=mode, mime=mime)
+        entry.chunks = chunks
+        entry.attr.file_size = len(data)
+        entry.attr.md5 = hashlib.md5(data).digest()
+        try:
+            self.create_entry(entry)
+        except BaseException:
+            # a losing race still must not leak the uploaded chunks
+            for c in chunks:
+                self._gc_queue.put((c.fid, 0))
+            raise
+        if old is not None and old.chunks:
+            for c in old.chunks:
+                self._gc_queue.put((c.fid, 0))
+        return entry
+
+    def read_file(
+        self, full_path: str, offset: int = 0, size: int = -1
+    ) -> bytes:
+        entry = self.find_entry(full_path)
+        return self.read_entry(entry, offset, size)
+
+    def read_entry(self, entry: Entry, offset: int = 0, size: int = -1) -> bytes:
+        if entry.is_directory:
+            raise FilerError(f"{entry.full_path} is a directory")
+        if entry.content:
+            end = len(entry.content) if size < 0 else offset + size
+            return entry.content[offset:end]
+        file_size = entry.file_size
+        if size < 0:
+            size = max(file_size - offset, 0)
+        size = min(size, max(file_size - offset, 0))
+        if size == 0:
+            return b""
+        buf = bytearray(size)
+        for view in read_chunk_views(entry.chunks, offset, size):
+            chunk_data = self.ops.read(view.fid)
+            piece = chunk_data[view.offset_in_chunk : view.offset_in_chunk + view.size]
+            lo = view.logical_offset - offset
+            buf[lo : lo + len(piece)] = piece
+        return bytes(buf)
+
+    # ------------------------------------------------------------------ gc
+
+    _GC_MAX_ATTEMPTS = 5
+
+    def _gc_loop(self) -> None:
+        while not self._gc_stop.is_set():
+            try:
+                fid, attempts = self._gc_queue.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            try:
+                self.ops.delete(fid)
+            except Exception:
+                # transient outage must not leak blobs: requeue with
+                # backoff (reference filer_deletion.go retries)
+                if attempts + 1 < self._GC_MAX_ATTEMPTS:
+                    t = threading.Timer(
+                        2.0 * (attempts + 1),
+                        self._gc_queue.put,
+                        args=((fid, attempts + 1),),
+                    )
+                    t.daemon = True
+                    t.start()
+
+    def flush_gc(self, timeout: float = 10.0) -> None:
+        deadline = time.time() + timeout
+        while not self._gc_queue.empty() and time.time() < deadline:
+            time.sleep(0.05)
+
+    def close(self) -> None:
+        self._gc_stop.set()
+        self._gc_thread.join(timeout=2)
+        self.ops.close()
+        self.store.close()

@@ -1,0 +1,194 @@
+"""FilerStore SPI + embedded backends.
+
+Reference: weed/filer/filerstore.go (insert/update/find/delete/list + KV)
+with ~25 pluggable backends; here sqlite (the reference's
+abstract_sql schema shape: directory + name + meta blob) and an
+in-memory dict store. More backends slot in behind the same SPI.
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+from typing import Iterator, Optional, Protocol
+
+from .entry import Entry
+
+
+class FilerStoreError(Exception):
+    pass
+
+
+class NotFound(FilerStoreError):
+    pass
+
+
+class FilerStore(Protocol):
+    def insert(self, entry: Entry) -> None: ...
+    def update(self, entry: Entry) -> None: ...
+    def find(self, directory: str, name: str) -> Entry: ...
+    def delete(self, directory: str, name: str) -> None: ...
+    def delete_folder_children(self, directory: str) -> None: ...
+    def list(
+        self, directory: str, start_from: str = "", limit: int = 1024,
+        prefix: str = "",
+    ) -> Iterator[Entry]: ...
+    def kv_put(self, key: bytes, value: bytes) -> None: ...
+    def kv_get(self, key: bytes) -> Optional[bytes]: ...
+    def close(self) -> None: ...
+
+
+class MemoryStore:
+    """Dict-backed store for tests and ephemeral filers."""
+
+    def __init__(self):
+        self._dirs: dict[str, dict[str, bytes]] = {}
+        self._kv: dict[bytes, bytes] = {}
+        self._lock = threading.RLock()
+
+    def insert(self, entry: Entry) -> None:
+        with self._lock:
+            self._dirs.setdefault(entry.directory, {})[entry.name] = entry.to_bytes()
+
+    update = insert
+
+    def find(self, directory: str, name: str) -> Entry:
+        with self._lock:
+            raw = self._dirs.get(directory, {}).get(name)
+        if raw is None:
+            raise NotFound(f"{directory}/{name}")
+        return Entry.from_bytes(directory, raw)
+
+    def delete(self, directory: str, name: str) -> None:
+        with self._lock:
+            self._dirs.get(directory, {}).pop(name, None)
+
+    def delete_folder_children(self, directory: str) -> None:
+        with self._lock:
+            prefix = directory if directory.endswith("/") else directory + "/"
+            for d in [d for d in self._dirs if d == directory or d.startswith(prefix)]:
+                del self._dirs[d]
+
+    def list(self, directory, start_from="", limit=1024, prefix=""):
+        with self._lock:
+            names = sorted(self._dirs.get(directory, {}))
+        n = 0
+        for name in names:
+            if name <= start_from if start_from else False:
+                continue
+            if prefix and not name.startswith(prefix):
+                continue
+            if n >= limit:
+                return
+            yield self.find(directory, name)
+            n += 1
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._kv[key] = value
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._kv.get(key)
+
+    def close(self) -> None:
+        pass
+
+
+class SqliteStore:
+    """SQLite-backed store (reference weed/filer/sqlite via abstract_sql:
+    one row per entry keyed (directory, name), meta = protobuf blob)."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+        self._local = threading.local()
+        self.path = path
+        con = self._con()
+        con.execute(
+            "CREATE TABLE IF NOT EXISTS filemeta ("
+            " directory TEXT NOT NULL,"
+            " name TEXT NOT NULL,"
+            " meta BLOB,"
+            " PRIMARY KEY (directory, name))"
+        )
+        con.execute(
+            "CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB)"
+        )
+        con.commit()
+
+    def _con(self) -> sqlite3.Connection:
+        con = getattr(self._local, "con", None)
+        if con is None:
+            con = sqlite3.connect(self.path, timeout=30)
+            con.execute("PRAGMA journal_mode=WAL")
+            con.execute("PRAGMA synchronous=NORMAL")
+            self._local.con = con
+        return con
+
+    def insert(self, entry: Entry) -> None:
+        con = self._con()
+        con.execute(
+            "INSERT OR REPLACE INTO filemeta (directory, name, meta) VALUES (?,?,?)",
+            (entry.directory, entry.name, entry.to_bytes()),
+        )
+        con.commit()
+
+    update = insert
+
+    def find(self, directory: str, name: str) -> Entry:
+        row = (
+            self._con()
+            .execute(
+                "SELECT meta FROM filemeta WHERE directory=? AND name=?",
+                (directory, name),
+            )
+            .fetchone()
+        )
+        if row is None:
+            raise NotFound(f"{directory}/{name}")
+        return Entry.from_bytes(directory, row[0])
+
+    def delete(self, directory: str, name: str) -> None:
+        con = self._con()
+        con.execute(
+            "DELETE FROM filemeta WHERE directory=? AND name=?", (directory, name)
+        )
+        con.commit()
+
+    def delete_folder_children(self, directory: str) -> None:
+        con = self._con()
+        prefix = directory if directory.endswith("/") else directory + "/"
+        con.execute(
+            "DELETE FROM filemeta WHERE directory=? OR directory LIKE ?",
+            (directory, prefix + "%"),
+        )
+        con.commit()
+
+    def list(self, directory, start_from="", limit=1024, prefix=""):
+        # prefix as a half-open range (LIKE is case-insensitive for
+        # ASCII and treats %/_ as wildcards — wrong for literal names)
+        sql = "SELECT name, meta FROM filemeta WHERE directory=? AND name>?"
+        params: list = [directory, start_from]
+        if prefix:
+            sql += " AND name>=? AND name<?"
+            params += [prefix, prefix + "\U0010ffff"]
+        sql += " ORDER BY name LIMIT ?"
+        params.append(limit)
+        for name, meta in self._con().execute(sql, params):
+            yield Entry.from_bytes(directory, meta)
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        con = self._con()
+        con.execute("INSERT OR REPLACE INTO kv (k, v) VALUES (?,?)", (key, value))
+        con.commit()
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        row = self._con().execute("SELECT v FROM kv WHERE k=?", (key,)).fetchone()
+        return row[0] if row else None
+
+    def close(self) -> None:
+        con = getattr(self._local, "con", None)
+        if con is not None:
+            con.close()
+            self._local.con = None

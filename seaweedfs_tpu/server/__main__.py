@@ -31,10 +31,20 @@ def main(argv=None) -> int:
     v.add_argument("-dataCenter", default="")
     v.add_argument("-rack", default="")
 
+    f = sub.add_parser("filer")
+    f.add_argument("-ip", default="localhost")
+    f.add_argument("-port", type=int, default=8888)
+    f.add_argument("-master", default="localhost:9333")
+    f.add_argument("-dir", default="./filerdb")
+    f.add_argument("-collection", default="")
+    f.add_argument("-replication", default="")
+
     s = sub.add_parser("server")
     s.add_argument("-ip", default="localhost")
     s.add_argument("-masterPort", type=int, default=9333)
     s.add_argument("-port", type=int, default=8080)
+    s.add_argument("-filerPort", type=int, default=8888)
+    s.add_argument("-filer", action="store_true", help="also run a filer")
     s.add_argument("-dir", action="append", required=True)
     s.add_argument("-max", type=int, default=8)
     s.add_argument("-ec.backend", dest="ec_backend", default="auto")
@@ -78,6 +88,29 @@ def main(argv=None) -> int:
         vs.start()
         servers.append(vs)
         print(f"volume server on {a.ip}:{a.port} (grpc {vs.grpc_port})", flush=True)
+
+    if a.mode == "filer" or (a.mode == "server" and a.filer):
+        import os
+
+        from ..filer.filer import Filer
+        from ..filer.filer_store import SqliteStore
+        from .filer_server import FilerServer
+
+        if a.mode == "filer":
+            master, fport, dbdir = a.master, a.port, a.dir
+        else:
+            master, fport = f"{a.ip}:{a.masterPort}", a.filerPort
+            dbdir = os.path.join(a.dir[0], "filerdb")
+        filer = Filer(
+            SqliteStore(os.path.join(dbdir, "filer.db")),
+            master=master,
+            collection=getattr(a, "collection", ""),
+            replication=getattr(a, "replication", ""),
+        )
+        fs = FilerServer(filer, ip=a.ip, port=fport)
+        fs.start()
+        servers.append(fs)
+        print(f"filer on {a.ip}:{fport}", flush=True)
 
     stop.wait()
     for srv in servers:

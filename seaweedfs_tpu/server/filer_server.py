@@ -1,0 +1,201 @@
+"""Filer HTTP server: file API over the Filer core.
+
+Reference: weed/server/filer_server_handlers_{read,write}.go — file
+CRUD at path URLs, JSON directory listings, mv.from rename, recursive
+delete. gRPC metadata API joins when the mount/S3 layers need it.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+from ..filer.entry import normalize_path
+from ..filer.filer import Filer, FilerError
+from ..filer.filer_store import NotFound
+
+
+class FilerServer:
+    def __init__(self, filer: Filer, ip: str = "localhost", port: int = 8888):
+        self.filer = filer
+        self.ip = ip
+        self.port = port
+        self._http = ThreadingHTTPServer((ip, port), self._handler_class())
+        self._thread = threading.Thread(target=self._http.serve_forever, daemon=True)
+
+    def _handler_class(self):
+        filer = self.filer
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _path(self) -> str:
+                return normalize_path(unquote(urlparse(self.path).path))
+
+            def _send(self, code: int, body: bytes, ctype="application/json"):
+                self.send_response(code)
+                if code == 204:  # RFC 9110: no body on 204
+                    self.end_headers()
+                    return
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if self.command != "HEAD":
+                    self.wfile.write(body)
+
+            def _json(self, code: int, obj):
+                self._send(code, json.dumps(obj).encode())
+
+            def do_GET(self):
+                q = parse_qs(urlparse(self.path).query)
+                path = self._path()
+                try:
+                    entry = filer.find_entry(path)
+                except NotFound:
+                    return self._json(404, {"error": f"{path} not found"})
+                if entry.is_directory:
+                    try:
+                        limit = int(q.get("limit", ["1024"])[0])
+                    except ValueError:
+                        limit = 1024
+                    last = q.get("lastFileName", [""])[0]
+                    entries = [
+                        {
+                            "FullPath": e.full_path,
+                            "IsDirectory": e.is_directory,
+                            "FileSize": e.file_size,
+                            "Mtime": e.attr.mtime,
+                            "Mime": e.attr.mime,
+                        }
+                        for e in filer.list_entries(path, start_from=last, limit=limit)
+                    ]
+                    return self._json(
+                        200,
+                        {
+                            "Path": path,
+                            "Entries": entries,
+                            "ShouldDisplayLoadMore": len(entries) >= limit,
+                        },
+                    )
+                total = entry.file_size
+                # HEAD never touches the data plane: size/type come from
+                # the metadata entry alone.
+                if self.command == "HEAD":
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        entry.attr.mime or "application/octet-stream",
+                    )
+                    self.send_header("Content-Length", str(total))
+                    self.send_header("Accept-Ranges", "bytes")
+                    if entry.attr.md5:
+                        self.send_header("ETag", f'"{entry.attr.md5.hex()}"')
+                    self.end_headers()
+                    return
+                # range requests; a malformed Range falls back to 200-full
+                offset, size = 0, -1
+                status = 200
+                rng = self.headers.get("Range", "")
+                if rng.startswith("bytes="):
+                    try:
+                        spec = rng[6:].split(",")[0]
+                        lo_s, _, hi_s = spec.partition("-")
+                        lo = int(lo_s) if lo_s else max(total - int(hi_s), 0)
+                        hi = int(hi_s) if hi_s and lo_s else total - 1
+                        if lo > hi or lo >= max(total, 1):
+                            body = b""
+                            self.send_response(416)
+                            self.send_header(
+                                "Content-Range", f"bytes */{total}"
+                            )
+                            self.send_header("Content-Length", "0")
+                            self.end_headers()
+                            return
+                        offset, size = lo, hi - lo + 1
+                        status = 206
+                    except ValueError:
+                        offset, size, status = 0, -1, 200
+                try:
+                    data = filer.read_entry(entry, offset, size)
+                except FilerError as e:
+                    return self._json(500, {"error": str(e)})
+                self.send_response(status)
+                self.send_header(
+                    "Content-Type", entry.attr.mime or "application/octet-stream"
+                )
+                self.send_header("Content-Length", str(len(data)))
+                if status == 206:
+                    self.send_header(
+                        "Content-Range", f"bytes {offset}-{offset + len(data) - 1}/{total}"
+                    )
+                self.send_header("Accept-Ranges", "bytes")
+                if entry.attr.md5:
+                    self.send_header("ETag", f'"{entry.attr.md5.hex()}"')
+                self.end_headers()
+                if self.command != "HEAD":
+                    self.wfile.write(data)
+
+            do_HEAD = do_GET
+
+            def _write(self):
+                u = urlparse(self.path)
+                q = parse_qs(u.query)
+                path = self._path()
+                if "mv.from" in q:
+                    src = normalize_path(q["mv.from"][0])
+                    try:
+                        filer.rename(src, path)
+                    except NotFound:
+                        return self._json(404, {"error": f"{src} not found"})
+                    except FilerError as e:
+                        return self._json(409, {"error": str(e)})
+                    return self._json(200, {"from": src, "to": path})
+                # trailing slash on the RAW url means mkdir (normalize_path
+                # strips it, so check the unnormalized form)
+                raw_is_dir = unquote(u.path).rstrip() not in ("", "/") and unquote(
+                    u.path
+                ).endswith("/")
+                if raw_is_dir or q.get("mkdir", [""])[0] == "true":
+                    from ..filer.entry import new_entry
+
+                    filer.create_entry(new_entry(path, is_directory=True, mode=0o755))
+                    return self._json(201, {"path": path})
+                length = int(self.headers.get("Content-Length", "0"))
+                body = self.rfile.read(length)
+                from .volume_server import _parse_upload
+
+                name, mime, data = _parse_upload(self.headers, body)
+                try:
+                    entry = filer.write_file(path, data, mime=mime)
+                except FilerError as e:
+                    return self._json(500, {"error": str(e)})
+                self._json(
+                    201, {"name": entry.name, "size": entry.file_size}
+                )
+
+            do_PUT = _write
+            do_POST = _write
+
+            def do_DELETE(self):
+                q = parse_qs(urlparse(self.path).query)
+                recursive = q.get("recursive", [""])[0] == "true"
+                try:
+                    filer.delete_entry(self._path(), recursive=recursive)
+                except FilerError as e:
+                    return self._json(409, {"error": str(e)})
+                self._json(204, {})
+
+        return Handler
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._http.shutdown()
+        self._http.server_close()
+        self.filer.close()

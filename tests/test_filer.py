@@ -1,0 +1,265 @@
+"""Filer tests: store backends, chunk interval resolution, namespace ops,
+and the chunked write/read path against a live in-process cluster.
+
+Reference models: weed/filer/filechunks_test.go (overlap resolution),
+filer store suites, filer_server handler tests.
+"""
+
+import socket
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.filer import (
+    Entry,
+    Filer,
+    FilerError,
+    MemoryStore,
+    NotFound,
+    SqliteStore,
+    new_entry,
+    read_chunk_views,
+    visible_intervals,
+)
+from seaweedfs_tpu.pb import filer_pb2 as fpb
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+# ------------------------------------------------------------------ stores
+
+
+@pytest.mark.parametrize("mk", [lambda p: MemoryStore(), lambda p: SqliteStore(str(p / "f.db"))])
+def test_store_crud_and_listing(tmp_path, mk):
+    st = mk(tmp_path)
+    for name in ("b", "a", "c", "sub"):
+        e = new_entry(f"/dir/{name}", is_directory=(name == "sub"))
+        st.insert(e)
+    assert st.find("/dir", "a").name == "a"
+    names = [e.name for e in st.list("/dir")]
+    assert names == ["a", "b", "c", "sub"]
+    # pagination
+    names = [e.name for e in st.list("/dir", start_from="a", limit=2)]
+    assert names == ["b", "c"]
+    # prefix
+    names = [e.name for e in st.list("/dir", prefix="s")]
+    assert names == ["sub"]
+    st.delete("/dir", "b")
+    with pytest.raises(NotFound):
+        st.find("/dir", "b")
+    # kv
+    st.kv_put(b"k1", b"v1")
+    assert st.kv_get(b"k1") == b"v1"
+    assert st.kv_get(b"nope") is None
+    st.close()
+
+
+def test_entry_codec_roundtrip():
+    e = new_entry("/a/b/file.txt", mime="text/plain")
+    e.chunks.append(fpb.FileChunk(fid="3,1ab", offset=0, size=100, modified_ts_ns=5))
+    e.extended["x-test"] = b"yes"
+    raw = e.to_bytes()
+    back = Entry.from_bytes("/a/b", raw)
+    assert back.full_path == "/a/b/file.txt"
+    assert back.chunks[0].fid == "3,1ab"
+    assert back.extended["x-test"] == b"yes"
+    assert back.attr.mime == "text/plain"
+
+
+# ------------------------------------------------------------------ chunks
+
+
+def _chunk(fid, offset, size, ts):
+    return fpb.FileChunk(fid=fid, offset=offset, size=size, modified_ts_ns=ts)
+
+
+def test_visible_intervals_overlap_resolution():
+    # later write wins over the overlapped region
+    chunks = [
+        _chunk("a", 0, 100, ts=1),
+        _chunk("b", 50, 100, ts=2),
+    ]
+    iv = visible_intervals(chunks)
+    assert [(s, e, c.fid) for s, e, c in iv] == [(0, 50, "a"), (50, 150, "b")]
+    # reversed times: the earlier-offset chunk is newer
+    chunks = [
+        _chunk("a", 0, 100, ts=2),
+        _chunk("b", 50, 100, ts=1),
+    ]
+    iv = visible_intervals(chunks)
+    assert [(s, e, c.fid) for s, e, c in iv] == [(0, 100, "a"), (100, 150, "b")]
+    # full overwrite hides the old chunk
+    chunks = [
+        _chunk("a", 10, 20, ts=1),
+        _chunk("b", 0, 100, ts=2),
+    ]
+    iv = visible_intervals(chunks)
+    assert [(s, e, c.fid) for s, e, c in iv] == [(0, 100, "b")]
+    # middle overwrite splits the old chunk
+    chunks = [
+        _chunk("a", 0, 100, ts=1),
+        _chunk("b", 40, 20, ts=2),
+    ]
+    iv = visible_intervals(chunks)
+    assert [(s, e, c.fid) for s, e, c in iv] == [
+        (0, 40, "a"),
+        (40, 60, "b"),
+        (60, 100, "a"),
+    ]
+
+
+def test_read_chunk_views_clipping():
+    chunks = [_chunk("a", 0, 100, 1), _chunk("b", 100, 100, 1)]
+    views = read_chunk_views(chunks, 90, 20)
+    assert [(v.fid, v.offset_in_chunk, v.size, v.logical_offset) for v in views] == [
+        ("a", 90, 10, 90),
+        ("b", 0, 10, 100),
+    ]
+
+
+# ------------------------------------------------------- cluster-backed
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    mport = free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path / "v")],
+        master=f"localhost:{mport}",
+        ip="localhost",
+        port=free_port(),
+        ec_backend="cpu",
+    )
+    vs.start()
+    deadline = time.time() + 10
+    while not master.topo.nodes:
+        assert time.time() < deadline
+        time.sleep(0.05)
+    yield mport
+    vs.stop()
+    master.stop()
+
+
+def test_filer_write_read_chunked(cluster, tmp_path):
+    f = Filer(
+        MemoryStore(), master=f"localhost:{cluster}", chunk_size=64 * 1024
+    )
+    try:
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        data = rng.integers(0, 256, 300_000, np.uint8).tobytes()  # 5 chunks
+        entry = f.write_file("/docs/report.bin", data, mime="application/x-bin")
+        assert len(entry.chunks) == 5
+        assert f.read_file("/docs/report.bin") == data
+        # ranged reads across chunk boundaries
+        assert f.read_file("/docs/report.bin", 60_000, 10_000) == data[60_000:70_000]
+        assert f.read_file("/docs/report.bin", 299_000, 5_000) == data[299_000:]
+        # parents auto-created
+        assert f.find_entry("/docs").is_directory
+        # overwrite GCs old chunks
+        old_fids = [c.fid for c in entry.chunks]
+        f.write_file("/docs/report.bin", b"tiny")
+        assert f.read_file("/docs/report.bin") == b"tiny"
+        f.flush_gc()
+        time.sleep(0.3)
+        for fid in old_fids:
+            with pytest.raises(LookupError):
+                f.ops.read(fid)
+        # rename
+        f.rename("/docs/report.bin", "/archive/2026/report.bin")
+        assert f.read_file("/archive/2026/report.bin") == b"tiny"
+        assert not f.exists("/docs/report.bin")
+        # delete dir recursively
+        f.delete_entry("/archive", recursive=True)
+        assert not f.exists("/archive/2026/report.bin")
+        with pytest.raises(FilerError):
+            f.create_entry(new_entry("/docs", is_directory=False))
+    finally:
+        f.close()
+
+
+def test_filer_http_server(cluster, tmp_path):
+    fport = free_port()
+    f = Filer(
+        SqliteStore(str(tmp_path / "fdb" / "filer.db")),
+        master=f"localhost:{cluster}",
+        chunk_size=32 * 1024,
+    )
+    srv = FilerServer(f, ip="localhost", port=fport)
+    srv.start()
+    base = f"http://localhost:{fport}"
+    try:
+        data = b"filer http payload " * 5000  # ~95KB -> 3 chunks
+        r = requests.post(f"{base}/media/x/y/file.txt", files={"file": ("file.txt", data, "text/plain")})
+        assert r.status_code == 201, r.text
+        r = requests.get(f"{base}/media/x/y/file.txt")
+        assert r.content == data and r.headers["Content-Type"] == "text/plain"
+        # range
+        r = requests.get(
+            f"{base}/media/x/y/file.txt", headers={"Range": "bytes=10-29"}
+        )
+        assert r.status_code == 206 and r.content == data[10:30]
+        # listing
+        r = requests.get(f"{base}/media/x/y")
+        assert r.json()["Entries"][0]["FullPath"] == "/media/x/y/file.txt"
+        # rename via mv.from
+        r = requests.post(f"{base}/media/renamed.txt?mv.from=/media/x/y/file.txt")
+        assert r.status_code == 200
+        assert requests.get(f"{base}/media/renamed.txt").content == data
+        assert requests.get(f"{base}/media/x/y/file.txt").status_code == 404
+        # HEAD serves metadata without touching the data plane
+        r = requests.head(f"{base}/media/renamed.txt")
+        assert r.status_code == 200
+        assert int(r.headers["Content-Length"]) == len(data)
+        # malformed Range degrades to full content; out-of-range -> 416
+        r = requests.get(
+            f"{base}/media/renamed.txt", headers={"Range": "bytes=abc-def"}
+        )
+        assert r.status_code == 200 and r.content == data
+        r = requests.get(
+            f"{base}/media/renamed.txt",
+            headers={"Range": f"bytes={len(data) + 10}-"},
+        )
+        assert r.status_code == 416
+        # mkdir via trailing slash
+        r = requests.post(f"{base}/media/emptydir/")
+        assert r.status_code == 201
+        assert requests.get(f"{base}/media/emptydir").json()["Entries"] == []
+        # rename onto a directory refuses
+        r = requests.post(f"{base}/media/emptydir?mv.from=/media/renamed.txt")
+        assert r.status_code == 409
+        # 204 on a keep-alive session must not desync the connection
+        s = requests.Session()
+        assert s.delete(f"{base}/media/emptydir").status_code == 204
+        assert s.get(f"{base}/media/renamed.txt").content == data
+        s.close()
+        # delete non-empty without recursive -> 409
+        r = requests.delete(f"{base}/media")
+        assert r.status_code == 409
+        r = requests.delete(f"{base}/media?recursive=true")
+        assert r.status_code == 204
+        assert requests.get(f"{base}/media/renamed.txt").status_code == 404
+    finally:
+        srv.stop()
+
+
+def test_sqlite_prefix_literal_matching(tmp_path):
+    st = SqliteStore(str(tmp_path / "p.db"))
+    for name in ("apple", "Apple", "a_b", "axb", "a%c"):
+        st.insert(new_entry(f"/d/{name}"))
+    assert [e.name for e in st.list("/d", prefix="a")] == ["a%c", "a_b", "apple", "axb"]
+    assert [e.name for e in st.list("/d", prefix="A")] == ["Apple"]
+    assert [e.name for e in st.list("/d", prefix="a_")] == ["a_b"]
+    assert [e.name for e in st.list("/d", prefix="a%")] == ["a%c"]
+    st.close()
