@@ -273,6 +273,11 @@ class Filer:
 
     # ------------------------------------------------------------------ gc
 
+    def gc_chunks(self, chunks) -> None:
+        """Enqueue chunk fids for async deletion on the volume servers."""
+        for c in chunks:
+            self._gc_queue.put((c.fid, 0))
+
     _GC_MAX_ATTEMPTS = 5
 
     def _gc_loop(self) -> None:

@@ -36,6 +36,7 @@ class FilerStore(Protocol):
     ) -> Iterator[Entry]: ...
     def kv_put(self, key: bytes, value: bytes) -> None: ...
     def kv_get(self, key: bytes) -> Optional[bytes]: ...
+    def kv_delete(self, key: bytes) -> None: ...
     def close(self) -> None: ...
 
 
@@ -91,6 +92,10 @@ class MemoryStore:
     def kv_get(self, key: bytes) -> Optional[bytes]:
         with self._lock:
             return self._kv.get(key)
+
+    def kv_delete(self, key: bytes) -> None:
+        with self._lock:
+            self._kv.pop(key, None)
 
     def close(self) -> None:
         pass
@@ -186,6 +191,11 @@ class SqliteStore:
     def kv_get(self, key: bytes) -> Optional[bytes]:
         row = self._con().execute("SELECT v FROM kv WHERE k=?", (key,)).fetchone()
         return row[0] if row else None
+
+    def kv_delete(self, key: bytes) -> None:
+        con = self._con()
+        con.execute("DELETE FROM kv WHERE k=?", (key,))
+        con.commit()
 
     def close(self) -> None:
         con = getattr(self._local, "con", None)

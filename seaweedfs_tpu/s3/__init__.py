@@ -1,0 +1,4 @@
+"""S3 REST gateway (layer 6) over the filer."""
+
+from .auth import Identity, IdentityStore, S3AuthError
+from .server import S3Server

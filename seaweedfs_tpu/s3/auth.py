@@ -1,0 +1,212 @@
+"""AWS Signature V4 verification.
+
+Reference: weed/s3api/auth_signature_v4.go — header-based AUTH
+(Authorization: AWS4-HMAC-SHA256 ...) and presigned-URL query auth.
+Chunked-upload (STREAMING-AWS4-HMAC-SHA256-PAYLOAD) joins later; the
+UNSIGNED-PAYLOAD and signed-payload forms are accepted.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import urllib.parse
+from dataclasses import dataclass
+from datetime import datetime, timedelta, timezone
+
+
+class S3AuthError(Exception):
+    def __init__(self, code: str, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+@dataclass
+class Identity:
+    name: str
+    access_key: str
+    secret_key: str
+    actions: tuple[str, ...] = ("Admin",)  # Admin|Read|Write|List|Tagging
+
+    def allows(self, action: str) -> bool:
+        return "Admin" in self.actions or action in self.actions
+
+
+class IdentityStore:
+    def __init__(self):
+        self._by_access_key: dict[str, Identity] = {}
+        self.allow_anonymous = True
+
+    def add(self, ident: Identity) -> None:
+        self._by_access_key[ident.access_key] = ident
+        self.allow_anonymous = False
+
+    def lookup(self, access_key: str) -> Identity | None:
+        return self._by_access_key.get(access_key)
+
+    @property
+    def empty(self) -> bool:
+        return not self._by_access_key
+
+
+def _sha256(b: bytes) -> str:
+    return hashlib.sha256(b).hexdigest()
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def signing_key(secret: str, date: str, region: str, service: str = "s3") -> bytes:
+    k = _hmac(("AWS4" + secret).encode(), date)
+    k = _hmac(k, region)
+    k = _hmac(k, service)
+    return _hmac(k, "aws4_request")
+
+
+def canonical_query(query: str, drop: str | None = None) -> str:
+    pairs = urllib.parse.parse_qsl(query, keep_blank_values=True)
+    if drop:
+        pairs = [(k, v) for k, v in pairs if k != drop]
+    enc = [
+        (
+            urllib.parse.quote(k, safe="-_.~"),
+            urllib.parse.quote(v, safe="-_.~"),
+        )
+        for k, v in pairs
+    ]
+    return "&".join(f"{k}={v}" for k, v in sorted(enc))
+
+
+def canonical_uri(path: str) -> str:
+    # S3 canonical URI: each path segment URI-encoded (but "/" kept)
+    return urllib.parse.quote(urllib.parse.unquote(path), safe="/-_.~")
+
+
+def verify_v4(
+    store: IdentityStore,
+    method: str,
+    path: str,
+    query: str,
+    headers,
+    payload_hash: str,
+) -> Identity:
+    """Validate the Authorization header; returns the caller identity."""
+    auth = headers.get("Authorization", "")
+    if not auth:
+        # presigned query auth
+        q = dict(urllib.parse.parse_qsl(query, keep_blank_values=True))
+        if "X-Amz-Signature" in q:
+            return _verify_presigned(store, method, path, query, headers, q)
+        raise S3AuthError("AccessDenied", "no credentials")
+    if not auth.startswith("AWS4-HMAC-SHA256 "):
+        raise S3AuthError("AccessDenied", "unsupported auth scheme")
+    fields = {}
+    for part in auth[len("AWS4-HMAC-SHA256 ") :].split(","):
+        k, _, v = part.strip().partition("=")
+        fields[k] = v
+    try:
+        cred = fields["Credential"]
+        signed_headers = fields["SignedHeaders"].split(";")
+        signature = fields["Signature"]
+        access_key, date, region, service, _ = cred.split("/")
+    except (KeyError, ValueError):
+        raise S3AuthError("AuthorizationHeaderMalformed", "bad Authorization") from None
+    ident = store.lookup(access_key)
+    if ident is None:
+        raise S3AuthError("InvalidAccessKeyId", f"unknown access key {access_key}")
+
+    amz_date = headers.get("x-amz-date", "") or headers.get("Date", "")
+    # freshness window (AWS allows 15 min of skew); without it a sniffed
+    # signed request replays forever
+    try:
+        t0 = datetime.strptime(amz_date, "%Y%m%dT%H%M%SZ").replace(
+            tzinfo=timezone.utc
+        )
+    except ValueError:
+        raise S3AuthError("AccessDenied", "malformed x-amz-date") from None
+    if abs((datetime.now(timezone.utc) - t0).total_seconds()) > 900:
+        raise S3AuthError("RequestTimeTooSkewed", "request time too skewed")
+    canonical_headers = "".join(
+        f"{h}:{' '.join((headers.get(h) or '').split())}\n" for h in signed_headers
+    )
+    creq = "\n".join(
+        [
+            method,
+            canonical_uri(path),
+            canonical_query(query),
+            canonical_headers,
+            ";".join(signed_headers),
+            payload_hash,
+        ]
+    )
+    sts = "\n".join(
+        [
+            "AWS4-HMAC-SHA256",
+            amz_date,
+            f"{date}/{region}/{service}/aws4_request",
+            _sha256(creq.encode()),
+        ]
+    )
+    want = hmac.new(
+        signing_key(ident.secret_key, date, region, service),
+        sts.encode(),
+        hashlib.sha256,
+    ).hexdigest()
+    if not hmac.compare_digest(want, signature):
+        raise S3AuthError("SignatureDoesNotMatch", "signature mismatch")
+    return ident
+
+
+def _verify_presigned(store, method, path, query, headers, q) -> Identity:
+    try:
+        cred = q["X-Amz-Credential"]
+        access_key, date, region, service, _ = cred.split("/")
+        signed_headers = q["X-Amz-SignedHeaders"].split(";")
+        signature = q["X-Amz-Signature"]
+        amz_date = q["X-Amz-Date"]
+        expires = int(q.get("X-Amz-Expires", "604800"))
+    except (KeyError, ValueError):
+        raise S3AuthError("AuthorizationQueryParametersError", "bad presign") from None
+    ident = store.lookup(access_key)
+    if ident is None:
+        raise S3AuthError("InvalidAccessKeyId", f"unknown access key {access_key}")
+    try:
+        t0 = datetime.strptime(amz_date, "%Y%m%dT%H%M%SZ").replace(
+            tzinfo=timezone.utc
+        )
+    except ValueError:
+        raise S3AuthError(
+            "AuthorizationQueryParametersError", "malformed X-Amz-Date"
+        ) from None
+    if datetime.now(timezone.utc) > t0 + timedelta(seconds=expires):
+        raise S3AuthError("AccessDenied", "request expired")
+    canonical_headers = "".join(
+        f"{h}:{' '.join((headers.get(h) or '').split())}\n" for h in signed_headers
+    )
+    creq = "\n".join(
+        [
+            method,
+            canonical_uri(path),
+            canonical_query(query, drop="X-Amz-Signature"),
+            canonical_headers,
+            ";".join(signed_headers),
+            "UNSIGNED-PAYLOAD",
+        ]
+    )
+    sts = "\n".join(
+        [
+            "AWS4-HMAC-SHA256",
+            amz_date,
+            f"{date}/{region}/{service}/aws4_request",
+            _sha256(creq.encode()),
+        ]
+    )
+    want = hmac.new(
+        signing_key(ident.secret_key, date, region, service),
+        sts.encode(),
+        hashlib.sha256,
+    ).hexdigest()
+    if not hmac.compare_digest(want, signature):
+        raise S3AuthError("SignatureDoesNotMatch", "signature mismatch")
+    return ident

@@ -1,0 +1,683 @@
+"""S3 REST gateway over the filer.
+
+Reference: weed/s3api (s3api_server.go routes, filer_multipart.go,
+s3api_object_handlers*.go). Buckets live at /buckets/<name> in the filer
+namespace; multipart parts are filer entries whose chunk lists are
+spliced (no data copy) on complete.
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import hashlib
+import json
+import threading
+import time
+import urllib.parse
+import uuid
+import xml.etree.ElementTree as ET
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..filer.entry import new_entry, normalize_path
+from ..filer.filer import Filer, FilerError
+from ..filer.filer_store import NotFound
+from ..pb import filer_pb2 as fpb
+from .auth import Identity, IdentityStore, S3AuthError, verify_v4
+
+BUCKETS_ROOT = "/buckets"
+UPLOADS_DIR = ".uploads"
+XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
+
+
+def _xml(root: ET.Element) -> bytes:
+    return b'<?xml version="1.0" encoding="UTF-8"?>' + ET.tostring(root)
+
+
+def _el(parent, tag, text=None):
+    e = ET.SubElement(parent, tag)
+    if text is not None:
+        e.text = str(text)
+    return e
+
+
+def _iso(ts: int) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(ts or 0))
+
+
+class S3Server:
+    def __init__(
+        self,
+        filer: Filer,
+        ip: str = "localhost",
+        port: int = 8333,
+        identities: IdentityStore | None = None,
+        region: str = "us-east-1",
+    ):
+        self.filer = filer
+        self.ip = ip
+        self.port = port
+        self.region = region
+        self.identities = identities or IdentityStore()
+        self._http = ThreadingHTTPServer((ip, port), self._handler_class())
+        self._thread = threading.Thread(target=self._http.serve_forever, daemon=True)
+        try:
+            self.filer.create_entry(
+                new_entry(BUCKETS_ROOT, is_directory=True, mode=0o755)
+            )
+        except FilerError:
+            pass
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._http.shutdown()
+        self._http.server_close()
+
+    # ------------------------------------------------------------ handler
+
+    def _handler_class(self):
+        srv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            # ---- plumbing ----
+
+            def _respond(self, code: int, body: bytes = b"", ctype="application/xml", extra=None):
+                self.send_response(code)
+                for k, v in (extra or {}).items():
+                    self.send_header(k, v)
+                if code == 204:
+                    self.end_headers()
+                    return
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if self.command != "HEAD" and body:
+                    self.wfile.write(body)
+
+            def _error(self, code: int, s3code: str, msg: str):
+                root = ET.Element("Error")
+                _el(root, "Code", s3code)
+                _el(root, "Message", msg)
+                _el(root, "Resource", urllib.parse.urlparse(self.path).path)
+                self._respond(code, _xml(root))
+
+            def _auth(self, payload: bytes | None = None) -> Identity | None:
+                if srv.identities.empty:
+                    return None  # open mode
+                u = urllib.parse.urlparse(self.path)
+                phash = self.headers.get(
+                    "x-amz-content-sha256", "UNSIGNED-PAYLOAD"
+                )
+                return verify_v4(
+                    srv.identities,
+                    self.command,
+                    u.path,
+                    u.query,
+                    self.headers,
+                    phash,
+                )
+
+            def _bucket_key(self):
+                u = urllib.parse.urlparse(self.path)
+                parts = urllib.parse.unquote(u.path).lstrip("/").split("/", 1)
+                bucket = parts[0]
+                key = parts[1] if len(parts) > 1 else ""
+                return bucket, key, dict(
+                    urllib.parse.parse_qsl(u.query, keep_blank_values=True)
+                )
+
+            def _read_body(self) -> bytes:
+                if self._body_read:
+                    return self._body_cache
+                n = int(self.headers.get("Content-Length", "0") or "0")
+                body = self.rfile.read(n)
+                self._body_read = True
+                # aws-chunked (streaming sigv4) transfer decoding
+                if "aws-chunked" in (
+                    self.headers.get("Content-Encoding", "")
+                ) or self.headers.get("x-amz-content-sha256", "").startswith(
+                    "STREAMING-"
+                ):
+                    body = _decode_aws_chunked(body)
+                self._body_cache = body
+                return body
+
+            # ---- dispatch ----
+
+            def _handle(self):
+                self._body_read = False
+                self._body_cache = b""
+                try:
+                    try:
+                        ident = self._auth()
+                    except S3AuthError as e:
+                        return self._error(403, e.code, str(e))
+                    bucket, key, q = self._bucket_key()
+                    m = self.command
+                    if ident is not None and not ident.allows(
+                        _required_action(m, bucket, key)
+                    ):
+                        return self._error(
+                            403, "AccessDenied", "identity lacks permission"
+                        )
+                    if bucket == "":
+                        if m in ("GET", "HEAD"):
+                            return self._list_buckets()
+                        return self._error(405, "MethodNotAllowed", m)
+                    if key == "":
+                        return self._bucket_op(bucket, q)
+                    return self._object_op(bucket, key, q)
+                except NotFound:
+                    return self._error(404, "NoSuchKey", "not found")
+                except FilerError as e:
+                    return self._error(409, "OperationAborted", str(e))
+                except (ValueError, ET.ParseError, binascii.Error) as e:
+                    return self._error(400, "InvalidArgument", str(e))
+                except BrokenPipeError:
+                    pass
+                finally:
+                    # drain any unread body so HTTP/1.1 keep-alive
+                    # connections stay in sync
+                    try:
+                        if not self._body_read:
+                            n = int(self.headers.get("Content-Length", "0") or "0")
+                            if n:
+                                self.rfile.read(n)
+                                self._body_read = True
+                    except (OSError, ValueError):
+                        pass
+
+            do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _handle
+
+            # ---- service ----
+
+            def _list_buckets(self):
+                root = ET.Element("ListAllMyBucketsResult", xmlns=XMLNS)
+                owner = _el(root, "Owner")
+                _el(owner, "ID", "seaweedfs_tpu")
+                buckets = _el(root, "Buckets")
+                try:
+                    for e in srv.filer.list_entries(BUCKETS_ROOT, limit=10_000):
+                        if not e.is_directory or e.name == UPLOADS_DIR:
+                            continue
+                        b = _el(buckets, "Bucket")
+                        _el(b, "Name", e.name)
+                        _el(b, "CreationDate", _iso(e.attr.crtime))
+                except NotFound:
+                    pass
+                self._respond(200, _xml(root))
+
+            # ---- bucket ----
+
+            def _bucket_op(self, bucket: str, q: dict):
+                path = f"{BUCKETS_ROOT}/{bucket}"
+                m = self.command
+                if m == "PUT":
+                    if srv.filer.exists(path):
+                        return self._error(
+                            409, "BucketAlreadyExists", bucket
+                        )
+                    srv.filer.create_entry(
+                        new_entry(path, is_directory=True, mode=0o755)
+                    )
+                    return self._respond(200, extra={"Location": "/" + bucket})
+                if m == "HEAD":
+                    if not srv.filer.exists(path):
+                        return self._error(404, "NoSuchBucket", bucket)
+                    return self._respond(200)
+                if m == "DELETE":
+                    if not srv.filer.exists(path):
+                        return self._error(404, "NoSuchBucket", bucket)
+                    children = list(srv.filer.list_entries(path, limit=2))
+                    if children:
+                        return self._error(409, "BucketNotEmpty", bucket)
+                    srv.filer.delete_entry(path, recursive=True)
+                    return self._respond(204)
+                if m == "POST" and "delete" in q:
+                    return self._delete_objects(bucket)
+                if m == "GET":
+                    if not srv.filer.exists(path):
+                        return self._error(404, "NoSuchBucket", bucket)
+                    if "location" in q:
+                        root = ET.Element("LocationConstraint", xmlns=XMLNS)
+                        root.text = srv.region
+                        return self._respond(200, _xml(root))
+                    if "uploads" in q:
+                        return self._list_uploads(bucket)
+                    return self._list_objects(bucket, q)
+                return self._error(405, "MethodNotAllowed", m)
+
+            def _list_objects(self, bucket: str, q: dict):
+                prefix = q.get("prefix", "")
+                delimiter = q.get("delimiter", "")
+                v2 = q.get("list-type") == "2"
+                max_keys = min(int(q.get("max-keys", "1000") or "1000"), 1000)
+                token = (
+                    q.get("continuation-token") or q.get("start-after") or ""
+                    if v2
+                    else q.get("marker", "")
+                )
+                if v2 and q.get("continuation-token"):
+                    token = base64.urlsafe_b64decode(
+                        q["continuation-token"].encode()
+                    ).decode()
+
+                contents, common, truncated, next_token = srv._walk_keys(
+                    bucket, prefix, delimiter, token, max_keys
+                )
+                root = ET.Element("ListBucketResult", xmlns=XMLNS)
+                _el(root, "Name", bucket)
+                _el(root, "Prefix", prefix)
+                if delimiter:
+                    _el(root, "Delimiter", delimiter)
+                _el(root, "MaxKeys", max_keys)
+                _el(root, "KeyCount", len(contents) + len(common))
+                _el(root, "IsTruncated", "true" if truncated else "false")
+                if v2 and truncated:
+                    _el(
+                        root,
+                        "NextContinuationToken",
+                        base64.urlsafe_b64encode(next_token.encode()).decode(),
+                    )
+                elif not v2:
+                    _el(root, "Marker", q.get("marker", ""))
+                    if truncated:
+                        _el(root, "NextMarker", next_token)
+                for key, entry in contents:
+                    c = _el(root, "Contents")
+                    _el(c, "Key", key)
+                    _el(c, "LastModified", _iso(entry.attr.mtime))
+                    _el(c, "ETag", f'"{_entry_etag(entry)}"')
+                    _el(c, "Size", entry.file_size)
+                    _el(c, "StorageClass", "STANDARD")
+                for p in sorted(common):
+                    cp = _el(root, "CommonPrefixes")
+                    _el(cp, "Prefix", p)
+                self._respond(200, _xml(root))
+
+            def _delete_objects(self, bucket: str):
+                body = self._read_body()
+                doc = ET.fromstring(body)
+                ns = ""
+                if doc.tag.startswith("{"):
+                    ns = doc.tag[: doc.tag.index("}") + 1]
+                quiet = (doc.findtext(f"{ns}Quiet") or "").lower() == "true"
+                root = ET.Element("DeleteResult", xmlns=XMLNS)
+                for obj in doc.findall(f"{ns}Object"):
+                    key = obj.findtext(f"{ns}Key") or ""
+                    try:
+                        srv.filer.delete_entry(
+                            f"{BUCKETS_ROOT}/{bucket}/{key}", recursive=True
+                        )
+                        if not quiet:
+                            d = _el(root, "Deleted")
+                            _el(d, "Key", key)
+                    except FilerError as e:
+                        er = _el(root, "Error")
+                        _el(er, "Key", key)
+                        _el(er, "Code", "InternalError")
+                        _el(er, "Message", str(e))
+                self._respond(200, _xml(root))
+
+            # ---- object ----
+
+            def _object_op(self, bucket: str, key: str, q: dict):
+                bpath = f"{BUCKETS_ROOT}/{bucket}"
+                if not srv.filer.exists(bpath):
+                    return self._error(404, "NoSuchBucket", bucket)
+                path = normalize_path(f"{bpath}/{key}")
+                m = self.command
+                if m == "POST" and "uploads" in q:
+                    return self._initiate_multipart(bucket, key)
+                if m == "PUT" and "partNumber" in q and "uploadId" in q:
+                    return self._upload_part(bucket, key, q)
+                if m == "POST" and "uploadId" in q:
+                    return self._complete_multipart(bucket, key, q)
+                if m == "DELETE" and "uploadId" in q:
+                    return self._abort_multipart(bucket, key, q)
+                if m == "GET" and "uploadId" in q:
+                    return self._list_parts(bucket, key, q)
+
+                if m == "PUT":
+                    src = self.headers.get("x-amz-copy-source", "")
+                    if src:
+                        return self._copy_object(bucket, key, src)
+                    data = self._read_body()
+                    entry = srv.filer.write_file(
+                        path,
+                        data,
+                        mime=self.headers.get("Content-Type", "")
+                        or "application/octet-stream",
+                    )
+                    etag = entry.attr.md5.hex()
+                    return self._respond(200, extra={"ETag": f'"{etag}"'})
+                if m in ("GET", "HEAD"):
+                    entry = srv.filer.find_entry(path)
+                    if entry.is_directory:
+                        return self._error(404, "NoSuchKey", key)
+                    total = entry.file_size
+                    headers = {
+                        "ETag": f'"{_entry_etag(entry)}"',
+                        "Last-Modified": time.strftime(
+                            "%a, %d %b %Y %H:%M:%S GMT",
+                            time.gmtime(entry.attr.mtime),
+                        ),
+                        "Accept-Ranges": "bytes",
+                    }
+                    ctype = entry.attr.mime or "application/octet-stream"
+                    if m == "HEAD":
+                        self.send_response(200)
+                        for k, v in headers.items():
+                            self.send_header(k, v)
+                        self.send_header("Content-Type", ctype)
+                        self.send_header("Content-Length", str(total))
+                        self.end_headers()
+                        return
+                    rng = self.headers.get("Range", "")
+                    offset, size, status = 0, -1, 200
+                    if rng.startswith("bytes="):
+                        try:
+                            lo_s, _, hi_s = rng[6:].split(",")[0].partition("-")
+                            lo = int(lo_s) if lo_s else max(total - int(hi_s), 0)
+                            hi = int(hi_s) if hi_s and lo_s else total - 1
+                            if lo > hi or lo >= max(total, 1):
+                                return self._respond(
+                                    416,
+                                    extra={"Content-Range": f"bytes */{total}"},
+                                )
+                            offset, size, status = lo, hi - lo + 1, 206
+                            headers["Content-Range"] = (
+                                f"bytes {lo}-{min(hi, total - 1)}/{total}"
+                            )
+                        except ValueError:
+                            pass
+                    data = srv.filer.read_entry(entry, offset, size)
+                    return self._respond(status, data, ctype, headers)
+                if m == "DELETE":
+                    srv.filer.delete_entry(path, recursive=False, gc_chunks=True)
+                    return self._respond(204)
+                return self._error(405, "MethodNotAllowed", m)
+
+            def _copy_object(self, bucket: str, key: str, src: str):
+                src = urllib.parse.unquote(src)
+                if not src.startswith("/"):
+                    src = "/" + src
+                src_path = normalize_path(f"{BUCKETS_ROOT}{src}")
+                entry = srv.filer.find_entry(src_path)
+                data = srv.filer.read_entry(entry)
+                dst = srv.filer.write_file(
+                    normalize_path(f"{BUCKETS_ROOT}/{bucket}/{key}"),
+                    data,
+                    mime=entry.attr.mime,
+                )
+                root = ET.Element("CopyObjectResult", xmlns=XMLNS)
+                _el(root, "ETag", f'"{dst.attr.md5.hex()}"')
+                _el(root, "LastModified", _iso(dst.attr.mtime))
+                self._respond(200, _xml(root))
+
+            # ---- multipart ----
+
+            def _initiate_multipart(self, bucket: str, key: str):
+                upload_id = uuid.uuid4().hex
+                meta_path = srv._upload_dir(bucket, upload_id)
+                e = new_entry(meta_path, is_directory=True, mode=0o755)
+                srv.filer.create_entry(e)
+                srv.filer.store.kv_put(
+                    f"upload/{upload_id}".encode(),
+                    json.dumps(
+                        {
+                            "bucket": bucket,
+                            "key": key,
+                            "mime": self.headers.get("Content-Type", ""),
+                        }
+                    ).encode(),
+                )
+                root = ET.Element("InitiateMultipartUploadResult", xmlns=XMLNS)
+                _el(root, "Bucket", bucket)
+                _el(root, "Key", key)
+                _el(root, "UploadId", upload_id)
+                self._respond(200, _xml(root))
+
+            def _upload_part(self, bucket: str, key: str, q: dict):
+                upload_id = q["uploadId"]
+                part = int(q["partNumber"])
+                if srv.filer.store.kv_get(f"upload/{upload_id}".encode()) is None:
+                    return self._error(404, "NoSuchUpload", upload_id)
+                data = self._read_body()
+                entry = srv.filer.write_file(
+                    f"{srv._upload_dir(bucket, upload_id)}/{part:05d}.part", data
+                )
+                self._respond(200, extra={"ETag": f'"{entry.attr.md5.hex()}"'})
+
+            def _complete_multipart(self, bucket: str, key: str, q: dict):
+                upload_id = q["uploadId"]
+                meta_raw = srv.filer.store.kv_get(f"upload/{upload_id}".encode())
+                if meta_raw is None:
+                    return self._error(404, "NoSuchUpload", upload_id)
+                meta = json.loads(meta_raw)
+                updir = srv._upload_dir(bucket, upload_id)
+                parts = sorted(
+                    (
+                        e
+                        for e in srv.filer.list_entries(updir, limit=10_000)
+                        if e.name.endswith(".part")
+                    ),
+                    key=lambda e: e.name,
+                )
+                # honor the client's part list when provided
+                body = self._read_body()
+                if body.strip():
+                    doc = ET.fromstring(body)
+                    ns = doc.tag[: doc.tag.index("}") + 1] if doc.tag.startswith("{") else ""
+                    wanted = {
+                        int(p.findtext(f"{ns}PartNumber") or "0")
+                        for p in doc.findall(f"{ns}Part")
+                    }
+                    if wanted:
+                        chosen = [
+                            e for e in parts if int(e.name.split(".")[0]) in wanted
+                        ]
+                        if len(chosen) != len(wanted):
+                            return self._error(
+                                400, "InvalidPart", "listed part missing"
+                            )
+                        parts = chosen
+                # splice chunk lists: no data copy (filer_multipart.go)
+                chunks, offset, md5s = [], 0, []
+                for p in parts:
+                    for c in p.chunks:
+                        nc = fpb.FileChunk()
+                        nc.CopyFrom(c)
+                        nc.offset = offset + c.offset
+                        chunks.append(nc)
+                    offset += p.file_size
+                    md5s.append(p.attr.md5)
+                final_path = normalize_path(f"{BUCKETS_ROOT}/{bucket}/{key}")
+                final = new_entry(final_path, mime=meta.get("mime", ""))
+                final.chunks = chunks
+                final.attr.file_size = offset
+                etag = hashlib.md5(b"".join(md5s)).hexdigest() + f"-{len(parts)}"
+                final.extended["s3-etag"] = etag.encode()
+                # an overwritten object's chunks must be GC'd (write_file
+                # does this for the simple-PUT path)
+                try:
+                    old = srv.filer.find_entry(final_path)
+                except NotFound:
+                    old = None
+                srv.filer.create_entry(final)
+                if old is not None and not old.is_directory:
+                    srv.filer.gc_chunks(old.chunks)
+                # drop part entries WITHOUT GC'ing chunks (now referenced
+                # by the final entry)
+                for p in parts:
+                    srv.filer.delete_entry(p.full_path, gc_chunks=False)
+                srv.filer.delete_entry(updir, recursive=True, gc_chunks=False)
+                srv.filer.store.kv_delete(f"upload/{upload_id}".encode())
+                root = ET.Element("CompleteMultipartUploadResult", xmlns=XMLNS)
+                _el(root, "Bucket", bucket)
+                _el(root, "Key", key)
+                _el(root, "ETag", f'"{etag}"')
+                self._respond(200, _xml(root))
+
+            def _abort_multipart(self, bucket: str, key: str, q: dict):
+                upload_id = q["uploadId"]
+                srv.filer.delete_entry(
+                    srv._upload_dir(bucket, upload_id), recursive=True
+                )
+                srv.filer.store.kv_delete(f"upload/{upload_id}".encode())
+                self._respond(204)
+
+            def _list_parts(self, bucket: str, key: str, q: dict):
+                upload_id = q["uploadId"]
+                updir = srv._upload_dir(bucket, upload_id)
+                if srv.filer.store.kv_get(
+                    f"upload/{upload_id}".encode()
+                ) is None or not srv.filer.exists(updir):
+                    return self._error(404, "NoSuchUpload", upload_id)
+                root = ET.Element("ListPartsResult", xmlns=XMLNS)
+                _el(root, "Bucket", bucket)
+                _el(root, "Key", key)
+                _el(root, "UploadId", upload_id)
+                try:
+                    for e in srv.filer.list_entries(updir, limit=10_000):
+                        if not e.name.endswith(".part"):
+                            continue
+                        p = _el(root, "Part")
+                        _el(p, "PartNumber", int(e.name.split(".")[0]))
+                        _el(p, "ETag", f'"{e.attr.md5.hex()}"')
+                        _el(p, "Size", e.file_size)
+                except NotFound:
+                    return self._error(404, "NoSuchUpload", upload_id)
+                self._respond(200, _xml(root))
+
+            def _list_uploads(self, bucket: str):
+                root = ET.Element("ListMultipartUploadsResult", xmlns=XMLNS)
+                _el(root, "Bucket", bucket)
+                updir = f"{BUCKETS_ROOT}/{UPLOADS_DIR}/{bucket}"
+                try:
+                    for e in srv.filer.list_entries(updir, limit=10_000):
+                        meta_raw = srv.filer.store.kv_get(
+                            f"upload/{e.name}".encode()
+                        )
+                        if meta_raw is None:
+                            continue
+                        meta = json.loads(meta_raw)
+                        u = _el(root, "Upload")
+                        _el(u, "Key", meta["key"])
+                        _el(u, "UploadId", e.name)
+                except NotFound:
+                    pass
+                self._respond(200, _xml(root))
+
+        return Handler
+
+    # -------------------------------------------------------------- walk
+
+    def _upload_dir(self, bucket: str, upload_id: str) -> str:
+        return f"{BUCKETS_ROOT}/{UPLOADS_DIR}/{bucket}/{upload_id}"
+
+    def _walk_keys(
+        self, bucket: str, prefix: str, delimiter: str, after: str, max_keys: int
+    ):
+        """Flat key listing with prefix/delimiter grouping.
+
+        DFS over the filer tree in sorted order (the namespace IS the
+        key space, reference s3api list semantics over the filer)."""
+        bpath = f"{BUCKETS_ROOT}/{bucket}"
+        contents: list = []
+        common: set[str] = set()
+        truncated = False
+        last_emitted = ""
+
+        def cap_reached() -> bool:
+            nonlocal truncated
+            if len(contents) + len(common) >= max_keys:
+                truncated = True
+                return True
+            return False
+
+        def dfs(dir_path: str, key_prefix: str) -> bool:
+            nonlocal last_emitted
+            for e in self.filer.list_entries(dir_path, limit=100_000):
+                key = key_prefix + e.name
+                if e.is_directory:
+                    sub = key + "/"
+                    # prune subtrees that cannot contain matching keys
+                    if prefix and not (
+                        sub.startswith(prefix) or prefix.startswith(sub)
+                    ):
+                        continue
+                    if delimiter == "/" and sub.startswith(prefix) and sub != prefix:
+                        cut = prefix + sub[len(prefix) :].split("/")[0] + "/"
+                        if after.startswith(cut):
+                            continue  # group already emitted on a prior page
+                        if cut <= after:
+                            continue
+                        if cut in common:
+                            continue
+                        if cap_reached():
+                            return False
+                        common.add(cut)
+                        last_emitted = cut
+                        continue
+                    if not dfs(e.full_path, sub):
+                        return False
+                else:
+                    if prefix and not key.startswith(prefix):
+                        continue
+                    if after and key <= after:
+                        continue
+                    if cap_reached():
+                        return False
+                    contents.append((key, e))
+                    last_emitted = key
+            return True
+
+        try:
+            dfs(bpath, "")
+        except NotFound:
+            pass
+        return contents, common, truncated, last_emitted
+
+
+def _required_action(method: str, bucket: str, key: str) -> str:
+    """Map a request to the coarse action model (reference
+    auth_credentials.go identity actions: Admin/Read/Write/List)."""
+    if key == "":
+        if method in ("GET", "HEAD"):
+            return "List"
+        if method == "POST":  # batch delete
+            return "Write"
+        return "Admin"  # bucket create/delete
+    return "Read" if method in ("GET", "HEAD") else "Write"
+
+
+def _entry_etag(entry) -> str:
+    s3etag = entry.extended.get("s3-etag")
+    if s3etag:
+        return s3etag.decode()
+    return entry.attr.md5.hex() if entry.attr.md5 else ""
+
+
+def _decode_aws_chunked(body: bytes) -> bytes:
+    """Strip aws-chunked framing (chunk-size;chunk-signature=...\r\n)."""
+    out = []
+    pos = 0
+    while pos < len(body):
+        nl = body.find(b"\r\n", pos)
+        if nl < 0:
+            break
+        header = body[pos:nl]
+        size = int(header.split(b";")[0], 16)
+        if size == 0:
+            break
+        out.append(body[nl + 2 : nl + 2 + size])
+        pos = nl + 2 + size + 2
+    return b"".join(out)

@@ -45,6 +45,10 @@ def main(argv=None) -> int:
     s.add_argument("-port", type=int, default=8080)
     s.add_argument("-filerPort", type=int, default=8888)
     s.add_argument("-filer", action="store_true", help="also run a filer")
+    s.add_argument("-s3", action="store_true", help="also run the S3 gateway")
+    s.add_argument("-s3Port", type=int, default=8333)
+    s.add_argument("-s3AccessKey", default="")
+    s.add_argument("-s3SecretKey", default="")
     s.add_argument("-dir", action="append", required=True)
     s.add_argument("-max", type=int, default=8)
     s.add_argument("-ec.backend", dest="ec_backend", default="auto")
@@ -89,7 +93,7 @@ def main(argv=None) -> int:
         servers.append(vs)
         print(f"volume server on {a.ip}:{a.port} (grpc {vs.grpc_port})", flush=True)
 
-    if a.mode == "filer" or (a.mode == "server" and a.filer):
+    if a.mode == "filer" or (a.mode == "server" and (a.filer or a.s3)):
         import os
 
         from ..filer.filer import Filer
@@ -111,6 +115,17 @@ def main(argv=None) -> int:
         fs.start()
         servers.append(fs)
         print(f"filer on {a.ip}:{fport}", flush=True)
+
+        if a.mode == "server" and a.s3:
+            from ..s3 import Identity, IdentityStore, S3Server
+
+            idents = IdentityStore()
+            if a.s3AccessKey:
+                idents.add(Identity("admin", a.s3AccessKey, a.s3SecretKey))
+            s3srv = S3Server(filer, ip=a.ip, port=a.s3Port, identities=idents)
+            s3srv.start()
+            servers.append(s3srv)
+            print(f"s3 gateway on {a.ip}:{a.s3Port}", flush=True)
 
     stop.wait()
     for srv in servers:
