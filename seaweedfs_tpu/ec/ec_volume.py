@@ -197,6 +197,19 @@ class EcVolume:
     def shard_size(self) -> int:
         return self._shard_size
 
+    def refresh_shards(self) -> list[int]:
+        """Pick up shard files that appeared on disk since mount (e.g.
+        just copied from a peer); returns the current shard ids."""
+        with self._lock:
+            for i in range(self.ctx.total):
+                if i in self.shard_fds:
+                    continue
+                p = self.base + self.ctx.to_ext(i)
+                if os.path.exists(p):
+                    self.shard_fds[i] = os.open(p, os.O_RDONLY)
+                    self._shard_size = max(self._shard_size, os.path.getsize(p))
+            return sorted(self.shard_fds)
+
     def unmount_shards(self, shard_ids: list[int]) -> int:
         """Stop serving specific local shards (reference Unmount per
         shard set); returns how many shards remain mounted."""

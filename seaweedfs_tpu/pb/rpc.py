@@ -34,6 +34,8 @@ SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
     VOLUME_SERVICE: {
         "AllocateVolume": (UNARY, pb.AllocateVolumeRequest, pb.AllocateVolumeResponse),
         "VolumeDelete": (UNARY, pb.VolumeCommandRequest, pb.VolumeCommandResponse),
+        "VolumeMount": (UNARY, pb.AllocateVolumeRequest, pb.VolumeCommandResponse),
+        "VolumeCopy": (UNARY, pb.EcShardsCopyRequest, pb.VolumeCommandResponse),
         "VolumeMarkReadonly": (UNARY, pb.VolumeCommandRequest, pb.VolumeCommandResponse),
         "VolumeMarkWritable": (UNARY, pb.VolumeCommandRequest, pb.VolumeCommandResponse),
         "VacuumVolume": (UNARY, pb.VacuumRequest, pb.VacuumResponse),

@@ -74,14 +74,21 @@ class FilerServer:
                         }
                         for e in filer.list_entries(path, start_from=last, limit=limit)
                     ]
-                    return self._json(
-                        200,
+                    body = json.dumps(
                         {
                             "Path": path,
                             "Entries": entries,
                             "ShouldDisplayLoadMore": len(entries) >= limit,
-                        },
-                    )
+                        }
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("X-Filer-Listing", "true")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    if self.command != "HEAD":
+                        self.wfile.write(body)
+                    return
                 total = entry.file_size
                 # HEAD never touches the data plane: size/type come from
                 # the metadata entry alone.

@@ -247,6 +247,15 @@ class MasterServer:
                                 ],
                             },
                         )
+                elif u.path == "/metrics":
+                    from ..utils.metrics import REGISTRY
+
+                    body = REGISTRY.render()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 elif u.path in ("/cluster/status", "/dir/status"):
                     topo = master.topo.to_proto()
                     self._json(

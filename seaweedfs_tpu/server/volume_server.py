@@ -37,6 +37,7 @@ from ..storage.volume import (
 )
 from ..pb import cluster_pb2 as pb
 from ..pb import rpc
+from ..utils import metrics as M
 
 _EC_STREAM_CHUNK = 256 * 1024
 
@@ -74,6 +75,68 @@ class VolumeService:
         except NotFoundError as e:
             return pb.VolumeCommandResponse(error=str(e))
 
+    def VolumeMount(self, request, context):
+        """Load an existing .dat/.idx pair from disk into the store
+        (used after VolumeCopy pulled the files from a peer)."""
+        try:
+            self.store.mount_volume(request.volume_id, request.collection)
+        except NotFoundError as e:
+            return pb.VolumeCommandResponse(error=str(e))
+        self.server.notify_new_volume(request.volume_id)
+        return pb.VolumeCommandResponse()
+
+    def VolumeCopy(self, request, context):
+        """Pull a whole volume (.dat + .idx + .vif) from a peer, then
+        load it (reference VolumeCopy volume_grpc_copy.go). All files
+        land as temps and publish together — a half-copied volume never
+        becomes loadable."""
+        if self.store.find_volume(request.volume_id) is not None:
+            return pb.VolumeCommandResponse(error="volume already here")
+        loc = self.store._pick_location()
+        base = Volume.base_file_name(
+            loc.directory, request.collection, request.volume_id
+        )
+        exts = (".dat", ".idx", ".vif")
+        tmps: dict[str, str] = {}
+        try:
+            with grpc.insecure_channel(request.source_url) as ch:
+                stub = rpc.volume_stub(ch)
+                for ext in exts:
+                    tmp = base + ext + ".copying"
+                    try:
+                        with open(tmp, "wb") as f:
+                            for chunk in stub.CopyFile(
+                                pb.CopyFileRequest(
+                                    volume_id=request.volume_id,
+                                    collection=request.collection,
+                                    ext=ext,
+                                )
+                            ):
+                                f.write(chunk.data)
+                            f.flush()
+                            os.fsync(f.fileno())
+                        tmps[ext] = tmp
+                    except grpc.RpcError as e:
+                        if os.path.exists(tmp):
+                            os.unlink(tmp)
+                        if ext == ".vif":  # optional sidecar
+                            continue
+                        raise RuntimeError(
+                            f"copy {ext}: {e.details()}"
+                        ) from None
+            for ext, tmp in tmps.items():
+                os.replace(tmp, base + ext)
+            tmps.clear()
+        except RuntimeError as e:
+            return pb.VolumeCommandResponse(error=str(e))
+        finally:
+            for tmp in tmps.values():
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+        self.store.mount_volume(request.volume_id, request.collection)
+        self.server.notify_new_volume(request.volume_id)
+        return pb.VolumeCommandResponse()
+
     def VolumeMarkReadonly(self, request, context):
         v = self.store.find_volume(request.volume_id)
         if v is None:
@@ -101,6 +164,14 @@ class VolumeService:
     # --------------------------------------------------------------- io
 
     def WriteNeedle(self, request, context):
+        with M.request_seconds.time(server="volume", op="write"):
+            resp = self._write_needle(request)
+        M.request_total.inc(
+            server="volume", op="write", code="err" if resp.error else "ok"
+        )
+        return resp
+
+    def _write_needle(self, request):
         n = Needle(
             cookie=request.cookie,
             needle_id=request.needle_id,
@@ -122,6 +193,14 @@ class VolumeService:
         return pb.WriteNeedleResponse(size=size)
 
     def ReadNeedle(self, request, context):
+        with M.request_seconds.time(server="volume", op="read"):
+            resp = self._read_needle(request)
+        M.request_total.inc(
+            server="volume", op="read", code="err" if resp.error else "ok"
+        )
+        return resp
+
+    def _read_needle(self, request):
         try:
             n = self.store.read_needle(
                 request.volume_id,
@@ -177,7 +256,12 @@ class VolumeService:
             ctx.data_shards,
             ctx.parity_shards,
         )
-        vi = ec_encode_volume(base, ctx, backend)
+        backend_name = request.backend or self.server.store.ec_backend
+        dat_size = os.path.getsize(base + ".dat")
+        with M.request_seconds.time(server="volume", op="ec_encode"):
+            vi = ec_encode_volume(base, ctx, backend)
+        M.ec_ops_total.inc(op="encode", backend=backend_name)
+        M.ec_bytes_total.inc(dat_size, op="encode", backend=backend_name)
         return pb.EcShardsGenerateResponse(generation=vi.encode_ts_ns)
 
     def VolumeEcShardsRebuild(self, request, context):
@@ -195,9 +279,13 @@ class VolumeService:
             ctx.parity_shards,
         )
         try:
-            rebuilt = rebuild_ec_files(loc_base, backend=backend)
+            with M.request_seconds.time(server="volume", op="ec_rebuild"):
+                rebuilt = rebuild_ec_files(loc_base, backend=backend)
         except ECError as e:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        M.ec_ops_total.inc(
+            op="rebuild", backend=request.backend or self.server.store.ec_backend
+        )
         return pb.EcShardsRebuildResponse(rebuilt_shard_ids=rebuilt)
 
     def VolumeEcShardsCopy(self, request, context):
@@ -313,16 +401,8 @@ class VolumeService:
         except ECError as e:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
         # register the decoded normal volume
-        for loc in self.store.locations:
-            if os.path.dirname(base + ".dat") == loc.directory.rstrip("/"):
-                loc.volumes[request.volume_id] = Volume(
-                    loc.directory,
-                    request.volume_id,
-                    collection=request.collection,
-                    create=False,
-                )
-                self.server.notify_new_volume(request.volume_id)
-                break
+        self.store.mount_volume(request.volume_id, request.collection)
+        self.server.notify_new_volume(request.volume_id)
         return pb.EcShardsToVolumeResponse()
 
     def CopyFile(self, request, context):
@@ -535,6 +615,19 @@ class VolumeServer:
 
     def _full_heartbeat(self) -> pb.Heartbeat:
         st = self.store.status()
+        # addr label keeps multi-server processes from clobbering each
+        # other on the shared registry
+        addr = self.store.public_url
+        M.volume_count.set(len(st["volumes"]), kind="normal", addr=addr)
+        M.volume_count.set(len(st["ec_volumes"]), kind="ec", addr=addr)
+        M.volume_bytes.set(
+            sum(v["size"] for v in st["volumes"]), kind="normal", addr=addr
+        )
+        M.volume_bytes.set(
+            sum(e["shard_size"] * len(e["shards"]) for e in st["ec_volumes"]),
+            kind="ec",
+            addr=addr,
+        )
         return pb.Heartbeat(
             ip=self.ip,
             port=self.port,
@@ -635,6 +728,16 @@ class VolumeServer:
 
             def do_GET(self):
                 u = urlparse(self.path)
+                if u.path == "/metrics":
+                    from ..utils.metrics import REGISTRY
+
+                    body = REGISTRY.render()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if u.path == "/status":
                     body = json.dumps(server.store.status()).encode()
                     self.send_response(200)

@@ -11,10 +11,11 @@ from .commands import ShellEnv, run_command
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="seaweedfs_tpu.shell")
     p.add_argument("-master", default="localhost:9333")
+    p.add_argument("-filer", default="localhost:8888")
     p.add_argument("-c", dest="command", default=None, help="run one command and exit")
     a = p.parse_args(argv)
 
-    env = ShellEnv(a.master)
+    env = ShellEnv(a.master, a.filer)
     try:
         if a.command:
             print(run_command(env, a.command))

@@ -21,8 +21,9 @@ from ..pb import rpc
 
 
 class ShellEnv:
-    def __init__(self, master: str = "localhost:9333"):
+    def __init__(self, master: str = "localhost:9333", filer: str = "localhost:8888"):
         self.master_addr = master
+        self.filer_addr = filer
         self.master = MasterClient(master)
 
     def close(self):
@@ -340,6 +341,264 @@ def ec_decode(env: ShellEnv, args) -> str:
                 timeout=60,
             )
     return f"decoded ec volume {a.volumeId} back to a normal volume on {target_url}"
+
+
+@command("volume.move", "-volumeId N -target host:grpcPort (move one volume)")
+def volume_move(env: ShellEnv, args) -> str:
+    """Copy to target, load there, delete at source (reference
+    volume.move: mark-readonly -> copy -> mount -> delete)."""
+    p = argparse.ArgumentParser(prog="volume.move")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-target", required=True, help="grpc address host:port")
+    p.add_argument("-collection", default="")
+    a = p.parse_args(args)
+    locs = env.master.lookup(a.volumeId, refresh=True)
+    if not locs:
+        return f"volume {a.volumeId} not found"
+    src = locs[0]
+    src_grpc = f"{src.url.split(':')[0]}:{src.grpc_port}"
+    if src_grpc == a.target:
+        return "volume already on target"
+    ch, stub = _volume_stub(src)
+    with ch:
+        stub.VolumeMarkReadonly(
+            pb.VolumeCommandRequest(volume_id=a.volumeId), timeout=30
+        )
+    try:
+        with grpc.insecure_channel(a.target) as ch2:
+            r = rpc.Stub(ch2, rpc.VOLUME_SERVICE).VolumeCopy(
+                pb.EcShardsCopyRequest(
+                    volume_id=a.volumeId,
+                    collection=a.collection,
+                    source_url=src_grpc,
+                ),
+                timeout=3600,
+            )
+        if r.error:
+            raise RuntimeError(f"copy failed: {r.error}")
+    except (grpc.RpcError, RuntimeError) as e:
+        # failed move must not strand the source readonly
+        ch, stub = _volume_stub(src)
+        with ch:
+            stub.VolumeMarkWritable(
+                pb.VolumeCommandRequest(volume_id=a.volumeId), timeout=30
+            )
+        detail = e.details() if isinstance(e, grpc.RpcError) else str(e)
+        return f"error: {detail} (source volume restored writable)"
+    ch, stub = _volume_stub(src)
+    with ch:
+        stub.VolumeDelete(pb.VolumeCommandRequest(volume_id=a.volumeId), timeout=60)
+    return f"moved volume {a.volumeId} {src.url} -> {a.target}"
+
+
+@command("volume.fix.replication", "re-replicate under-replicated volumes")
+def volume_fix_replication(env: ShellEnv, args) -> str:
+    p = argparse.ArgumentParser(prog="volume.fix.replication")
+    p.add_argument("-collection", default="")
+    a = p.parse_args(args)
+    topo = env.master.topology()
+    holders: dict[int, list] = {}
+    meta: dict[int, tuple] = {}
+    for n in topo.nodes:
+        for v in n.volumes:
+            holders.setdefault(v.id, []).append(n)
+            meta[v.id] = (v.collection, v.replica_placement)
+    from ..server.topology import _replica_copies
+
+    fixed = []
+    for vid, hs in sorted(holders.items()):
+        col, rp = meta[vid]
+        want = _replica_copies(rp)
+        if len(hs) >= want:
+            continue
+        candidates = [
+            n for n in topo.nodes if all(h.id != n.id for h in hs)
+        ]
+        src = hs[0]
+        src_grpc = f"{src.location.url.split(':')[0]}:{src.location.grpc_port}"
+        # freeze writes while the copy streams, restore after — a live
+        # append between the .dat and .idx copies would tear the replica
+        src_ch, src_stub = _volume_stub(src.location)
+        with src_ch:
+            src_stub.VolumeMarkReadonly(
+                pb.VolumeCommandRequest(volume_id=vid), timeout=30
+            )
+            try:
+                for n in candidates[: want - len(hs)]:
+                    with grpc.insecure_channel(
+                        f"{n.location.url.split(':')[0]}:{n.location.grpc_port}"
+                    ) as ch:
+                        r = rpc.Stub(ch, rpc.VOLUME_SERVICE).VolumeCopy(
+                            pb.EcShardsCopyRequest(
+                                volume_id=vid, collection=col, source_url=src_grpc
+                            ),
+                            timeout=3600,
+                        )
+                    if not r.error:
+                        fixed.append(f"volume {vid} -> {n.id}")
+            finally:
+                src_stub.VolumeMarkWritable(
+                    pb.VolumeCommandRequest(volume_id=vid), timeout=30
+                )
+    return "\n".join(fixed) or "all volumes sufficiently replicated"
+
+
+@command("ec.balance", "spread EC shards evenly across nodes")
+def ec_balance(env: ShellEnv, args) -> str:
+    """Even out shard counts per node (reference command_ec_common.go:60
+    balance algorithm, single-rack form: move shards from the most-loaded
+    node to the least-loaded until within one)."""
+    p = argparse.ArgumentParser(prog="ec.balance")
+    p.add_argument("-collection", default="")
+    a = p.parse_args(args)
+    topo = env.master.topology()
+    nodes = {n.id: n for n in topo.nodes}
+    if len(nodes) < 2:
+        return "nothing to balance (fewer than 2 nodes)"
+    # shard sets per node per volume; each volume keeps its own collection
+    load: dict[str, dict[int, list[int]]] = {nid: {} for nid in nodes}
+    vol_collection: dict[int, str] = {}
+    for n in topo.nodes:
+        for e in n.ec_shards:
+            if a.collection and e.collection != a.collection:
+                continue
+            sids = [i for i in range(32) if e.shard_bits & (1 << i)]
+            load[n.id][e.id] = sids
+            vol_collection[e.id] = e.collection
+    moves = []
+    for _ in range(256):
+        counts = {
+            nid: sum(len(s) for s in vols.values()) for nid, vols in load.items()
+        }
+        src_id = max(counts, key=counts.get)
+        dst_id = min(counts, key=counts.get)
+        if counts[src_id] - counts[dst_id] <= 1:
+            break
+        # pick a shard on src for a volume where dst holds fewest shards
+        vid, sids = max(
+            load[src_id].items(),
+            key=lambda kv: len(kv[1]) - len(load[dst_id].get(kv[0], [])),
+        )
+        sid = sids[0]
+        col = vol_collection.get(vid, "")
+        src_n, dst_n = nodes[src_id], nodes[dst_id]
+        src_grpc = f"{src_n.location.url.split(':')[0]}:{src_n.location.grpc_port}"
+        with grpc.insecure_channel(
+            f"{dst_n.location.url.split(':')[0]}:{dst_n.location.grpc_port}"
+        ) as ch:
+            stub = rpc.Stub(ch, rpc.VOLUME_SERVICE)
+            stub.VolumeEcShardsCopy(
+                pb.EcShardsCopyRequest(
+                    volume_id=vid,
+                    collection=col,
+                    shard_ids=[sid],
+                    source_url=src_grpc,
+                    copy_ecx=vid not in load[dst_id],
+                    copy_ecj=vid not in load[dst_id],
+                    copy_vif=vid not in load[dst_id],
+                    copy_ecsum=vid not in load[dst_id],
+                ),
+                timeout=3600,
+            )
+            stub.VolumeEcShardsMount(
+                pb.EcShardsMountRequest(volume_id=vid, collection=col),
+                timeout=60,
+            )
+        with grpc.insecure_channel(src_grpc) as ch:
+            stub = rpc.Stub(ch, rpc.VOLUME_SERVICE)
+            stub.VolumeEcShardsUnmount(
+                pb.EcShardsUnmountRequest(volume_id=vid, shard_ids=[sid]),
+                timeout=60,
+            )
+            stub.VolumeEcShardsDelete(
+                pb.EcShardsDeleteRequest(
+                    volume_id=vid, collection=col, shard_ids=[sid]
+                ),
+                timeout=60,
+            )
+        sids.remove(sid)
+        if not sids:
+            del load[src_id][vid]
+        load[dst_id].setdefault(vid, []).append(sid)
+        moves.append(f"ec {vid}.{sid:02d}: {src_id} -> {dst_id}")
+    return "\n".join(moves) or "already balanced"
+
+
+@command("collection.list", "list collections")
+def collection_list(env: ShellEnv, args) -> str:
+    return "\n".join(env.master.collections()) or "(none)"
+
+
+# ---------------------------------------------------------------------- fs
+
+
+def _filer_url(env: ShellEnv, path: str) -> str:
+    from urllib.parse import quote
+
+    if not path.startswith("/"):
+        path = "/" + path
+    return f"http://{env.filer_addr}{quote(path)}"
+
+
+@command("fs.ls", "fs.ls /path (filer listing)")
+def fs_ls(env: ShellEnv, args) -> str:
+    import requests as rq
+
+    path = args[0] if args else "/"
+    r = rq.get(_filer_url(env, path), timeout=30)
+    if r.status_code != 200:
+        return f"error: {r.text}"
+    # the filer marks real directory listings; a stored .json file must
+    # not be mistaken for one
+    if r.headers.get("X-Filer-Listing") != "true":
+        return f"{path}: file ({len(r.content)} bytes)"
+    body = r.json()
+    return "\n".join(
+        f"{'d' if e['IsDirectory'] else '-'} {e['FileSize']:>12} {e['FullPath']}"
+        for e in body.get("Entries", [])
+    ) or "(empty)"
+
+
+@command("fs.cat", "fs.cat /path")
+def fs_cat(env: ShellEnv, args) -> str:
+    import requests as rq
+
+    r = rq.get(_filer_url(env, args[0]), timeout=60)
+    if r.status_code != 200:
+        return f"error: {r.text}"
+    return r.content.decode(errors="replace")
+
+
+@command("fs.rm", "fs.rm [-r] /path")
+def fs_rm(env: ShellEnv, args) -> str:
+    import requests as rq
+
+    p = argparse.ArgumentParser(prog="fs.rm")
+    p.add_argument("-r", action="store_true")
+    p.add_argument("path")
+    a = p.parse_args(args)
+    r = rq.delete(
+        _filer_url(env, a.path) + ("?recursive=true" if a.r else ""), timeout=60
+    )
+    return "ok" if r.status_code in (200, 204) else f"error: {r.text}"
+
+
+@command("fs.mkdir", "fs.mkdir /path")
+def fs_mkdir(env: ShellEnv, args) -> str:
+    import requests as rq
+
+    r = rq.post(_filer_url(env, args[0]) + "?mkdir=true", timeout=30)
+    return "ok" if r.status_code == 201 else f"error: {r.text}"
+
+
+@command("fs.mv", "fs.mv /src /dst")
+def fs_mv(env: ShellEnv, args) -> str:
+    import requests as rq
+    from urllib.parse import quote
+
+    src, dst = args
+    r = rq.post(_filer_url(env, dst) + f"?mv.from={quote(src, safe='')}", timeout=60)
+    return "ok" if r.status_code == 200 else f"error: {r.text}"
 
 
 # ------------------------------------------------------------------- blobs

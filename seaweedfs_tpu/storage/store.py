@@ -158,10 +158,25 @@ class Store:
                     return
         raise NotFoundError(f"volume {vid} not found")
 
+    def mount_volume(self, vid: int, collection: str = "") -> Volume:
+        """Load an existing .dat/.idx pair from disk (post-copy/restart)."""
+        with self._lock:
+            v = self.find_volume(vid)
+            if v is not None:
+                return v
+            for loc in self.locations:
+                base = Volume.base_file_name(loc.directory, collection, vid)
+                if os.path.exists(base + ".dat"):
+                    v = Volume(loc.directory, vid, collection=collection, create=False)
+                    loc.volumes[vid] = v
+                    return v
+        raise NotFoundError(f"no volume files for {vid} in any location")
+
     def mount_ec_volume(self, vid: int, collection: str = "") -> EcVolume:
         with self._lock:
             ev = self.find_ec_volume(vid)
             if ev is not None:
+                ev.refresh_shards()  # pick up freshly copied shard files
                 return ev
             for loc in self.locations:
                 base = Volume.base_file_name(loc.directory, collection, vid)

@@ -1,0 +1,197 @@
+"""Minimal Prometheus-style metrics registry (text exposition format).
+
+Reference: weed/stats/metrics.go (~80 collectors over master/filer/
+volume/S3, pull via /metrics or push). Stdlib-only: counters, gauges,
+histograms with labels, rendered in the text format Prometheus scrapes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Iterable
+
+
+class _Metric:
+    def __init__(self, name: str, help_text: str, label_names: tuple[str, ...]):
+        self.name = name
+        self.help = help_text
+        self.label_names = label_names
+        self._lock = threading.Lock()
+
+
+class Counter(_Metric):
+    def __init__(self, name, help_text="", label_names=()):
+        super().__init__(name, help_text, tuple(label_names))
+        self._values: dict[tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def collect(self):
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} counter"
+        with self._lock:
+            for key, v in sorted(self._values.items()):
+                yield f"{self.name}{_fmt_labels(self.label_names, key)} {_num(v)}"
+
+
+class Gauge(_Metric):
+    def __init__(self, name, help_text="", label_names=(), fn: Callable | None = None):
+        super().__init__(name, help_text, tuple(label_names))
+        self._values: dict[tuple, float] = {}
+        self._fn = fn  # callback gauges sample at scrape time
+
+    def set(self, value: float, **labels) -> None:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        with self._lock:
+            self._values[key] = value
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+    def collect(self):
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} gauge"
+        if self._fn is not None:
+            try:
+                for labels, value in self._fn():
+                    key = tuple(labels.get(n, "") for n in self.label_names)
+                    yield f"{self.name}{_fmt_labels(self.label_names, key)} {_num(value)}"
+            except Exception:
+                pass
+            return
+        with self._lock:
+            for key, v in sorted(self._values.items()):
+                yield f"{self.name}{_fmt_labels(self.label_names, key)} {_num(v)}"
+
+
+DEFAULT_BUCKETS = (
+    0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0
+)
+
+
+class Histogram(_Metric):
+    def __init__(self, name, help_text="", label_names=(), buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help_text, tuple(label_names))
+        self.buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._totals: dict[tuple, int] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def time(self, **labels):
+        return _Timer(self, labels)
+
+    def collect(self):
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} histogram"
+        with self._lock:
+            for key in sorted(self._counts):
+                for i, b in enumerate(self.buckets):
+                    lbl = _fmt_labels(
+                        self.label_names + ("le",), key + (_num(b),)
+                    )
+                    yield f"{self.name}_bucket{lbl} {self._counts[key][i]}"
+                lbl = _fmt_labels(self.label_names + ("le",), key + ("+Inf",))
+                yield f"{self.name}_bucket{lbl} {self._totals[key]}"
+                base = _fmt_labels(self.label_names, key)
+                yield f"{self.name}_sum{base} {_num(self._sums[key])}"
+                yield f"{self.name}_count{base} {self._totals[key]}"
+
+
+class _Timer:
+    def __init__(self, hist: Histogram, labels: dict):
+        self.hist = hist
+        self.labels = labels
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(time.perf_counter() - self.t0, **self.labels)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: list = []
+        self._lock = threading.Lock()
+
+    def register(self, metric):
+        with self._lock:
+            self._metrics.append(metric)
+        return metric
+
+    def counter(self, name, help_text="", label_names=()):
+        return self.register(Counter(name, help_text, label_names))
+
+    def gauge(self, name, help_text="", label_names=(), fn=None):
+        return self.register(Gauge(name, help_text, label_names, fn))
+
+    def histogram(self, name, help_text="", label_names=(), buckets=DEFAULT_BUCKETS):
+        return self.register(Histogram(name, help_text, label_names, buckets))
+
+    def render(self) -> bytes:
+        lines: list[str] = []
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
+            lines.extend(m.collect())
+        return ("\n".join(lines) + "\n").encode()
+
+
+def _fmt_labels(names: Iterable[str], values: Iterable[str]) -> str:
+    pairs = [
+        f'{n}="{_escape(str(v))}"' for n, v in zip(names, values)
+    ]
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _num(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+# process-wide default registry (the reference's stats.Gather equivalent)
+REGISTRY = Registry()
+
+request_total = REGISTRY.counter(
+    "sw_request_total", "requests by server/op/code", ("server", "op", "code")
+)
+request_seconds = REGISTRY.histogram(
+    "sw_request_seconds", "request latency", ("server", "op")
+)
+volume_count = REGISTRY.gauge(
+    "sw_volumes", "volumes on this server", ("kind", "addr")
+)
+volume_bytes = REGISTRY.gauge(
+    "sw_volume_bytes", "bytes stored", ("kind", "addr")
+)
+ec_ops_total = REGISTRY.counter(
+    "sw_ec_ops_total", "EC operations", ("op", "backend")
+)
+ec_bytes_total = REGISTRY.counter(
+    "sw_ec_bytes_total", "bytes through the EC pipeline", ("op", "backend")
+)

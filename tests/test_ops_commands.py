@@ -1,0 +1,156 @@
+"""Ops-plane tests: volume.move, volume.fix.replication, ec.balance,
+/metrics endpoints (reference shell command tests + stats)."""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+import requests
+
+from seaweedfs_tpu.client.operations import Operations
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.shell.commands import ShellEnv, run_command
+from seaweedfs_tpu.storage.file_id import FileId
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    mport = free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vols = []
+    for i in range(2):
+        vs = VolumeServer(
+            directories=[str(tmp_path / f"v{i}")],
+            master=f"localhost:{mport}",
+            ip="localhost",
+            port=free_port(),
+            ec_backend="cpu",
+        )
+        vs.start()
+        vols.append(vs)
+    while len(master.topo.nodes) < 2:
+        time.sleep(0.05)
+    yield master, vols
+    for vs in vols:
+        vs.stop()
+    master.stop()
+
+
+def wait_for(cond, timeout=10.0, msg="condition"):
+    deadline = time.time() + timeout
+    while not cond():
+        if time.time() > deadline:
+            raise TimeoutError(msg)
+        time.sleep(0.05)
+
+
+def test_volume_move(cluster):
+    master, vols = cluster
+    addr = f"localhost:{master.port}"
+    ops = Operations(addr)
+    env = ShellEnv(addr)
+    try:
+        data = b"move me" * 1000
+        fid = ops.upload(data)
+        vid = FileId.parse(fid).volume_id
+        src = next(vs for vs in vols if vs.store.find_volume(vid) is not None)
+        dst = next(vs for vs in vols if vs is not src)
+        out = run_command(
+            env, f"volume.move -volumeId {vid} -target localhost:{dst.grpc_port}"
+        )
+        assert "moved" in out, out
+        wait_for(lambda: dst.store.find_volume(vid) is not None)
+        assert src.store.find_volume(vid) is None
+        wait_for(
+            lambda: [l.url for l in master.topo.lookup(vid)]
+            == [f"localhost:{dst.port}"]
+        )
+        assert ops.read(fid) == data
+    finally:
+        env.close()
+        ops.close()
+
+
+def test_fix_replication(cluster):
+    master, vols = cluster
+    addr = f"localhost:{master.port}"
+    ops = Operations(addr)
+    env = ShellEnv(addr)
+    try:
+        fid = ops.upload(b"replicate me", replication="001")
+        vid = FileId.parse(fid).volume_id
+        assert len(master.topo.lookup(vid)) == 2
+        # kill one replica
+        loser = next(vs for vs in vols if vs.store.find_volume(vid) is not None)
+        loser.store.delete_volume(vid)
+        loser.notify_deleted_volume(vid)
+        wait_for(lambda: len(master.topo.lookup(vid)) == 1)
+        out = run_command(env, "volume.fix.replication")
+        assert f"volume {vid}" in out, out
+        wait_for(lambda: len(master.topo.lookup(vid)) == 2)
+        assert ops.read(fid) == b"replicate me"
+    finally:
+        env.close()
+        ops.close()
+
+
+def test_ec_balance(cluster):
+    master, vols = cluster
+    addr = f"localhost:{master.port}"
+    ops = Operations(addr)
+    env = ShellEnv(addr)
+    rng = np.random.default_rng(3)
+    try:
+        blobs = {}
+        for _ in range(15):
+            d = rng.integers(0, 256, 40_000, np.uint8).tobytes()
+            blobs[ops.upload(d)] = d
+        vid = FileId.parse(next(iter(blobs))).volume_id
+        run_command(env, f"ec.encode -volumeId {vid} -backend cpu")
+        wait_for(
+            lambda: any(vid in n.ec_shards for n in master.topo.nodes.values())
+        )
+        out = run_command(env, "ec.balance")
+        assert "->" in out, out
+        wait_for(
+            lambda: sorted(
+                sum(
+                    len([i for i in range(32) if e.shard_bits & (1 << i)])
+                    for e in n.ec_shards.values()
+                )
+                for n in master.topo.nodes.values()
+            )
+            == [7, 7],
+            msg="shards should split 7/7",
+        )
+        for fid, d in blobs.items():
+            assert ops.read(fid) == d, "reads after balance"
+    finally:
+        env.close()
+        ops.close()
+
+
+def test_metrics_endpoints(cluster):
+    master, vols = cluster
+    ops = Operations(f"localhost:{master.port}")
+    try:
+        fid = ops.upload(b"metric fodder")
+        ops.read(fid)
+        r = requests.get(f"http://localhost:{vols[0].port}/metrics")
+        assert r.status_code == 200
+        text = r.text
+        assert "sw_request_total" in text
+        assert "sw_request_seconds_bucket" in text
+        r = requests.get(f"http://localhost:{master.port}/metrics")
+        assert r.status_code == 200
+    finally:
+        ops.close()
