@@ -53,6 +53,8 @@ SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
         "VolumeEcShardsToVolume": (UNARY, pb.EcShardsToVolumeRequest, pb.EcShardsToVolumeResponse),
         "CopyFile": (SERVER_STREAM, pb.CopyFileRequest, pb.CopyFileChunk),
         "VolumeServerStatus": (UNARY, pb.VolumeServerStatusRequest, pb.VolumeServerStatusResponse),
+        "ScrubVolume": (UNARY, pb.ScrubRequest, pb.ScrubResponse),
+        "ScrubEcVolume": (UNARY, pb.ScrubRequest, pb.ScrubResponse),
     },
 }
 

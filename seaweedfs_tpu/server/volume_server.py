@@ -420,6 +420,62 @@ class VolumeService:
                 yield pb.CopyFileChunk(data=chunk)
                 sent += len(chunk)
 
+    def ScrubVolume(self, request, context):
+        """CRC-verify every live needle (reference volume_grpc_scrub.go).
+        Reads go through the lock-free scan of the sealed portion; the
+        volume stays online."""
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            return pb.ScrubResponse(error="volume not found")
+        from ..storage.volume_scan import scan_volume_file
+
+        v.flush()
+        checked = 0
+        bad: list[int] = []
+        _, items = scan_volume_file(v.dat_path)
+        from ..storage.types import actual_offset
+
+        for item in items:
+            if item.body_size <= 0:
+                continue
+            nv = v.needle_map.get(item.needle.needle_id)
+            if nv is None or nv.is_deleted:
+                continue  # dead record, vacuum's problem
+            if actual_offset(nv.offset) != item.offset:
+                continue  # superseded copy; the live one is elsewhere
+            checked += 1
+            if not item.crc_ok:
+                bad.append(item.needle.needle_id)
+        return pb.ScrubResponse(checked=checked, bad_needles=bad)
+
+    def ScrubEcVolume(self, request, context):
+        """Verify local shards against the .ecsum bitrot sidecar
+        (reference ec_volume_scrub.go / store_ec_scrub.go)."""
+        base = self._ec_base(request.volume_id, request.collection)
+        if base is None:
+            return pb.ScrubResponse(error="ec volume not found")
+        from ..ec.bitrot import BitrotError, BitrotProtection
+
+        if not os.path.exists(base + ".ecsum"):
+            return pb.ScrubResponse(error="no bitrot sidecar")
+        try:
+            prot = BitrotProtection.load(base + ".ecsum")
+        except BitrotError as e:
+            return pb.ScrubResponse(error=f"sidecar unreadable: {e}")
+        checked = 0
+        bad: list[int] = []
+        for i in range(prot.ctx.total):
+            p = base + prot.ctx.to_ext(i)
+            if not os.path.exists(p):
+                continue
+            checked += 1
+            try:
+                if prot.verify_shard_file(p, i):
+                    bad.append(i)
+            except OSError:
+                bad.append(i)
+        return pb.ScrubResponse(checked=checked, bad_shards=bad)
+
     def VolumeServerStatus(self, request, context):
         st = self.store.status()
         return pb.VolumeServerStatusResponse(

@@ -524,6 +524,64 @@ def ec_balance(env: ShellEnv, args) -> str:
     return "\n".join(moves) or "already balanced"
 
 
+@command("volume.scrub", "-volumeId N (CRC-verify all live needles)")
+def volume_scrub(env: ShellEnv, args) -> str:
+    p = argparse.ArgumentParser(prog="volume.scrub")
+    p.add_argument("-volumeId", type=int, required=True)
+    a = p.parse_args(args)
+    locs = env.master.lookup(a.volumeId, refresh=True)
+    if not locs:
+        return f"volume {a.volumeId} not found"
+    out = []
+    for loc in locs:
+        ch, stub = _volume_stub(loc)
+        with ch:
+            r = stub.ScrubVolume(
+                pb.ScrubRequest(volume_id=a.volumeId), timeout=3600
+            )
+        if r.error:
+            out.append(f"{loc.url}: error: {r.error}")
+        else:
+            bad = list(r.bad_needles)
+            out.append(
+                f"{loc.url}: checked {r.checked} needles"
+                + (f", CORRUPT: {[hex(b) for b in bad]}" if bad else ", all clean")
+            )
+    return "\n".join(out)
+
+
+@command("ec.scrub", "-volumeId N [-collection c] (verify shards vs .ecsum)")
+def ec_scrub(env: ShellEnv, args) -> str:
+    p = argparse.ArgumentParser(prog="ec.scrub")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    a = p.parse_args(args)
+    shard_locs = env.master.lookup_ec(a.volumeId, refresh=True)
+    if not shard_locs:
+        return f"ec volume {a.volumeId} not found"
+    seen = {}
+    for locs in shard_locs.values():
+        for loc in locs:
+            seen[loc.url] = loc
+    out = []
+    for url, loc in sorted(seen.items()):
+        ch, stub = _volume_stub(loc)
+        with ch:
+            r = stub.ScrubEcVolume(
+                pb.ScrubRequest(volume_id=a.volumeId, collection=a.collection),
+                timeout=3600,
+            )
+        if r.error:
+            out.append(f"{url}: error: {r.error}")
+        else:
+            bad = list(r.bad_shards)
+            out.append(
+                f"{url}: checked {r.checked} shards"
+                + (f", BITROT in shards {bad}" if bad else ", all clean")
+            )
+    return "\n".join(out)
+
+
 @command("collection.list", "list collections")
 def collection_list(env: ShellEnv, args) -> str:
     return "\n".join(env.master.collections()) or "(none)"

@@ -1,0 +1,1 @@
+"""Offline volume tools (weed fix/export/compact equivalents)."""
