@@ -25,6 +25,7 @@ class AssignResult:
     public_url: str
     grpc_port: int
     replicas: list
+    jwt: str = ""
 
 
 class MasterClient:
@@ -55,6 +56,7 @@ class MasterClient:
             public_url=resp.location.public_url,
             grpc_port=resp.location.grpc_port,
             replicas=list(resp.replicas),
+            jwt=resp.jwt,
         )
 
     def lookup(self, vid: int, refresh: bool = False) -> list[pb.Location]:

@@ -13,9 +13,20 @@ from .master_client import MasterClient
 
 
 class Operations:
-    def __init__(self, master: str = "localhost:9333"):
+    def __init__(self, master: str = "localhost:9333", jwt_key: str = ""):
+        """jwt_key: shared write-authz signing key; trusted components
+        (filer, tools) self-sign tokens the way the reference's
+        security.toml-holding services do."""
         self.master = MasterClient(master)
+        self.jwt_key = jwt_key
         self._http = requests.Session()
+
+    def _auth_headers(self, token: str, fid: str) -> dict:
+        if not token and self.jwt_key:
+            from ..utils.security import sign_jwt
+
+            token = sign_jwt(self.jwt_key, fid)
+        return {"Authorization": f"Bearer {token}"} if token else {}
 
     def upload(
         self,
@@ -28,7 +39,9 @@ class Operations:
         a = self.master.assign(collection=collection, replication=replication)
         url = f"http://{a.url}/{a.fid}"
         files = {"file": (name or "file", data, mime or "application/octet-stream")}
-        r = self._http.post(url, files=files, timeout=60)
+        r = self._http.post(
+            url, files=files, timeout=60, headers=self._auth_headers(a.jwt, a.fid)
+        )
         r.raise_for_status()
         return a.fid
 
@@ -42,8 +55,17 @@ class Operations:
 
     def delete(self, fid: str) -> None:
         f = FileId.parse(fid)
+        canonical = str(f)  # tokens are scoped to the canonical fid form
         for loc in self.master.lookup(f.volume_id):
-            self._http.delete(f"http://{loc.url}/{fid}", timeout=60)
+            r = self._http.delete(
+                f"http://{loc.url}/{canonical}",
+                timeout=60,
+                headers=self._auth_headers("", canonical),
+            )
+            if r.status_code not in (200, 202, 204, 404):
+                raise RuntimeError(
+                    f"delete {canonical} on {loc.url}: HTTP {r.status_code} {r.text[:200]}"
+                )
             return
 
     def close(self) -> None:

@@ -36,9 +36,10 @@ class Filer:
         collection: str = "",
         replication: str = "",
         chunk_size: int = DEFAULT_CHUNK_SIZE,
+        jwt_key: str = "",
     ):
         self.store = store
-        self.ops = Operations(master)
+        self.ops = Operations(master, jwt_key=jwt_key)
         self.collection = collection
         self.replication = replication
         self.chunk_size = chunk_size

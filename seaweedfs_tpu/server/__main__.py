@@ -20,6 +20,7 @@ def main(argv=None) -> int:
     m.add_argument("-ip", default="localhost")
     m.add_argument("-port", type=int, default=9333)
     m.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
+    m.add_argument("-jwt.key", dest="jwt_key", default="")
 
     v = sub.add_parser("volume")
     v.add_argument("-ip", default="localhost")
@@ -30,6 +31,7 @@ def main(argv=None) -> int:
     v.add_argument("-ec.backend", dest="ec_backend", default="auto")
     v.add_argument("-dataCenter", default="")
     v.add_argument("-rack", default="")
+    v.add_argument("-jwt.key", dest="jwt_key", default="")
 
     f = sub.add_parser("filer")
     f.add_argument("-ip", default="localhost")
@@ -38,6 +40,7 @@ def main(argv=None) -> int:
     f.add_argument("-dir", default="./filerdb")
     f.add_argument("-collection", default="")
     f.add_argument("-replication", default="")
+    f.add_argument("-jwt.key", dest="jwt_key", default="")
 
     s = sub.add_parser("server")
     s.add_argument("-ip", default="localhost")
@@ -52,6 +55,7 @@ def main(argv=None) -> int:
     s.add_argument("-dir", action="append", required=True)
     s.add_argument("-max", type=int, default=8)
     s.add_argument("-ec.backend", dest="ec_backend", default="auto")
+    s.add_argument("-jwt.key", dest="jwt_key", default="")
 
     a = p.parse_args(argv)
     stop = threading.Event()
@@ -68,7 +72,10 @@ def main(argv=None) -> int:
             if a.mode == "master"
             else 30 * 1024**3
         )
-        ms = MasterServer(ip=a.ip, port=port, volume_size_limit=limit)
+        ms = MasterServer(
+            ip=a.ip, port=port, volume_size_limit=limit,
+            jwt_key=getattr(a, "jwt_key", ""),
+        )
         ms.start()
         servers.append(ms)
         print(f"master listening on {a.ip}:{port} (grpc {ms.grpc_port})", flush=True)
@@ -88,6 +95,7 @@ def main(argv=None) -> int:
             ec_backend=a.ec_backend,
             data_center=getattr(a, "dataCenter", ""),
             rack=getattr(a, "rack", ""),
+            jwt_key=getattr(a, "jwt_key", ""),
         )
         vs.start()
         servers.append(vs)
@@ -110,6 +118,7 @@ def main(argv=None) -> int:
             master=master,
             collection=getattr(a, "collection", ""),
             replication=getattr(a, "replication", ""),
+            jwt_key=getattr(a, "jwt_key", ""),
         )
         fs = FilerServer(filer, ip=a.ip, port=fport)
         fs.start()

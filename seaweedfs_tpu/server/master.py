@@ -27,8 +27,9 @@ from .topology import DataNode, Topology
 class MasterService:
     """gRPC servicer (method-per-RPC, see pb/rpc.py)."""
 
-    def __init__(self, topo: Topology):
+    def __init__(self, topo: Topology, jwt_key: str = ""):
         self.topo = topo
+        self.jwt_key = jwt_key
         self._grow_lock = threading.Lock()
 
     # ------------------------------------------------------- heartbeats
@@ -71,11 +72,17 @@ class MasterService:
             return pb.AssignResponse(error="no writable volumes and growth failed")
         vid, holders = picked
         fid = FileId(vid, self.topo.next_needle_id(), new_cookie())
+        token = ""
+        if self.jwt_key:
+            from ..utils.security import sign_jwt
+
+            token = sign_jwt(self.jwt_key, str(fid))
         return pb.AssignResponse(
             fid=str(fid),
             count=count,
             location=holders[0].location(),
             replicas=[n.location() for n in holders[1:]],
+            jwt=token,
         )
 
     def _grow(self, collection: str, replication: str) -> list[int]:
@@ -171,12 +178,13 @@ class MasterServer:
         port: int = 9333,
         grpc_port: int = 0,
         volume_size_limit: int = 30 * 1024**3,
+        jwt_key: str = "",
     ):
         self.ip = ip
         self.port = port
         self.grpc_port = grpc_port or (port + 10000)
         self.topo = Topology(volume_size_limit=volume_size_limit)
-        self.service = MasterService(self.topo)
+        self.service = MasterService(self.topo, jwt_key=jwt_key)
 
         self._grpc = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
         rpc.add_service(self._grpc, rpc.MASTER_SERVICE, self.service)
@@ -219,15 +227,15 @@ class MasterServer:
                     if resp.error:
                         self._json(500, {"error": resp.error})
                     else:
-                        self._json(
-                            200,
-                            {
-                                "fid": resp.fid,
-                                "count": resp.count,
-                                "url": resp.location.url,
-                                "publicUrl": resp.location.public_url,
-                            },
-                        )
+                        out = {
+                            "fid": resp.fid,
+                            "count": resp.count,
+                            "url": resp.location.url,
+                            "publicUrl": resp.location.public_url,
+                        }
+                        if resp.jwt:
+                            out["auth"] = resp.jwt
+                        self._json(200, out)
                 elif u.path == "/dir/lookup":
                     vid = int(q.get("volumeId", ["0"])[0].split(",")[0])
                     resp = master.service.LookupVolume(

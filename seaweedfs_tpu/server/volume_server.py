@@ -163,7 +163,31 @@ class VolumeService:
 
     # --------------------------------------------------------------- io
 
+    def _grpc_jwt_ok(self, context, vid: int, needle_id: int) -> bool:
+        """gRPC writes must not bypass the HTTP JWT gate: when the
+        cluster has a key, peer callers attach a self-signed token in
+        metadata. context None = internal call from the already-verified
+        HTTP handler."""
+        if not self.server.jwt_key or context is None:
+            return True
+        from ..storage.file_id import FileId
+        from ..utils.security import JwtError, verify_jwt
+
+        token = ""
+        for k, v in context.invocation_metadata():
+            if k == "authorization":
+                token = v[7:] if v.startswith("Bearer ") else v
+        try:
+            # needle-scoped tokens carry a cookie we don't know here;
+            # accept volume-scoped tokens (what peers sign)
+            verify_jwt(self.server.jwt_key, token, str(vid))
+            return True
+        except JwtError:
+            return False
+
     def WriteNeedle(self, request, context):
+        if not self._grpc_jwt_ok(context, request.volume_id, request.needle_id):
+            return pb.WriteNeedleResponse(error="unauthorized")
         with M.request_seconds.time(server="volume", op="write"):
             resp = self._write_needle(request)
         M.request_total.inc(
@@ -219,6 +243,8 @@ class VolumeService:
         )
 
     def DeleteNeedle(self, request, context):
+        if not self._grpc_jwt_ok(context, request.volume_id, request.needle_id):
+            return pb.DeleteNeedleResponse(error="unauthorized")
         try:
             freed = self.store.delete_needle(request.volume_id, request.needle_id)
         except NotFoundError as e:
@@ -537,7 +563,9 @@ class VolumeServer:
         ec_backend: str = "auto",
         data_center: str = "",
         rack: str = "",
+        jwt_key: str = "",
     ):
+        self.jwt_key = jwt_key
         self.ip = ip
         self.port = port
         self.grpc_port = grpc_port or (port + 10000)
@@ -637,10 +665,19 @@ class VolumeServer:
         me = f"{self.ip}:{self.port}"
         return [l for l in locs if l.url != me]
 
+    def _peer_metadata(self, vid: int):
+        """Peer-auth metadata for gRPC writes on a keyed cluster."""
+        if not self.jwt_key:
+            return None
+        from ..utils.security import sign_jwt
+
+        return (("authorization", f"Bearer {sign_jwt(self.jwt_key, str(vid))}"),)
+
     def replicate_write(self, request: pb.WriteNeedleRequest) -> str:
         """Synchronous fan-out to replica holders (reference
         store_replicate.go:32 DistributedOperation)."""
         errors = []
+        md = self._peer_metadata(request.volume_id)
         for loc in self._replica_locations(request.volume_id):
             rep = pb.WriteNeedleRequest()
             rep.CopyFrom(request)
@@ -648,7 +685,7 @@ class VolumeServer:
             try:
                 r = self._peer_stub(
                     f"{loc.url.split(':')[0]}:{loc.grpc_port}"
-                ).WriteNeedle(rep, timeout=30)
+                ).WriteNeedle(rep, timeout=30, metadata=md)
                 if r.error:
                     errors.append(f"{loc.url}: {r.error}")
             except grpc.RpcError as e:
@@ -656,6 +693,7 @@ class VolumeServer:
         return "; ".join(errors)
 
     def replicate_delete(self, request: pb.DeleteNeedleRequest) -> None:
+        md = self._peer_metadata(request.volume_id)
         for loc in self._replica_locations(request.volume_id):
             rep = pb.DeleteNeedleRequest()
             rep.CopyFrom(request)
@@ -663,7 +701,7 @@ class VolumeServer:
             try:
                 self._peer_stub(
                     f"{loc.url.split(':')[0]}:{loc.grpc_port}"
-                ).DeleteNeedle(rep, timeout=30)
+                ).DeleteNeedle(rep, timeout=30, metadata=md)
             except grpc.RpcError:
                 pass
 
@@ -782,6 +820,23 @@ class VolumeServer:
                 # accept "<vid>,<fid>" and "<vid>/<fid>"
                 return FileId.parse(path.replace("/", ","))
 
+            def _jwt_rejected(self, fid) -> bool:
+                """True (and 401 already sent) when the cluster has a
+                signing key and this request lacks a valid token
+                (reference maybeCheckJwtAuthorization)."""
+                if not server.jwt_key:
+                    return False
+                from ..utils.security import JwtError, verify_jwt
+
+                auth = self.headers.get("Authorization", "")
+                token = auth[7:] if auth.startswith("Bearer ") else ""
+                try:
+                    verify_jwt(server.jwt_key, token, str(fid))
+                    return False
+                except JwtError as e:
+                    self._error(401, f"unauthorized: {e}")
+                    return True
+
             def do_GET(self):
                 u = urlparse(self.path)
                 if u.path == "/metrics":
@@ -832,6 +887,8 @@ class VolumeServer:
                     fid = self._fid()
                 except FileIdError as e:
                     return self._error(400, str(e))
+                if self._jwt_rejected(fid):
+                    return
                 length = int(self.headers.get("Content-Length", "0"))
                 body = self.rfile.read(length)
                 name, mime, data = _parse_upload(self.headers, body)
@@ -861,6 +918,8 @@ class VolumeServer:
                     fid = self._fid()
                 except FileIdError as e:
                     return self._error(400, str(e))
+                if self._jwt_rejected(fid):
+                    return
                 resp = server.service.DeleteNeedle(
                     pb.DeleteNeedleRequest(
                         volume_id=fid.volume_id,
