@@ -1,0 +1,5 @@
+"""Message queue (layer 7): broker, partition logs, pub/sub client."""
+
+from .broker import MqBroker, MqBrokerServer, MqService
+from .client import MqClient
+from .log_buffer import PartitionLog, decode_records, encode_record

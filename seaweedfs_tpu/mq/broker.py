@@ -1,0 +1,375 @@
+"""MQ broker: topics -> partitions -> append logs, pub/sub over gRPC.
+
+Reference: weed/mq/broker (broker_grpc_pub.go/_sub.go) with filer-backed
+segment storage (weed/mq/logstore) and consumer-group offsets
+(weed/mq/offset). Partitioning: key-hash over a fixed partition count
+(ring-slicing arrives with multi-broker balancing).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+from concurrent import futures
+
+import grpc
+import requests
+
+from ..pb import mq_pb2 as mq
+from ..pb import rpc
+from .log_buffer import PartitionLog, decode_records
+
+TOPICS_ROOT = "/topics"
+
+
+class _TopicState:
+    def __init__(self, partition_count: int):
+        self.partition_count = partition_count
+        self.logs: dict[int, PartitionLog] = {}
+
+
+class MqBroker:
+    """Single-broker core; the service facade lives in MqService."""
+
+    def __init__(
+        self,
+        filer: str = "",
+        segment_records: int = 4096,
+    ):
+        """filer: host:port of a filer for durable segments/offsets;
+        empty = memory-only broker (bounded tails, no recovery)."""
+        self.filer = filer
+        self.segment_records = segment_records
+        self._topics: dict[tuple[str, str], _TopicState] = {}
+        self._offsets: dict[tuple, int] = {}  # (ns, topic, part, group)
+        self._lock = threading.RLock()
+        self._http = requests.Session()
+        if filer:
+            # startup-ordering tolerance: the filer may still be coming up
+            last_err = None
+            for attempt in range(10):
+                try:
+                    self._recover()
+                    break
+                except requests.RequestException as e:
+                    last_err = e
+                    time.sleep(min(0.5 * (attempt + 1), 3.0))
+            else:
+                raise RuntimeError(
+                    f"mq broker: filer {filer} unreachable during recovery: {last_err}"
+                )
+
+    # ------------------------------------------------------------ filer io
+
+    def _url(self, path: str) -> str:
+        return f"http://{self.filer}{path}"
+
+    def _seg_path(self, ns: str, name: str, part: int, seg: int) -> str:
+        return f"{TOPICS_ROOT}/{ns}/{name}/{part:04d}/seg-{seg:08d}.log"
+
+    def _put_file(self, path: str, data: bytes) -> None:
+        r = self._http.post(
+            self._url(path),
+            data=data,
+            headers={"Content-Type": "application/octet-stream"},
+            timeout=60,
+        )
+        r.raise_for_status()
+
+    def _get_file(self, path: str):
+        """File bytes, or None ONLY for not-found; a transient filer
+        error must raise — treating it as absence would recover a too-low
+        next_offset and overwrite records."""
+        r = self._http.get(self._url(path), timeout=60)
+        if r.status_code == 404:
+            return None
+        r.raise_for_status()
+        if r.headers.get("X-Filer-Listing") == "true":
+            return None  # a directory, not a file
+        return r.content
+
+    def _list_dir(self, path: str) -> list[dict]:
+        """Full listing, following pagination (the filer caps pages)."""
+        out: list[dict] = []
+        last = ""
+        while True:
+            r = self._http.get(
+                self._url(path),
+                params={"limit": "1024", "lastFileName": last},
+                timeout=30,
+            )
+            if r.status_code == 404:
+                return out
+            r.raise_for_status()
+            if r.headers.get("X-Filer-Listing") != "true":
+                return out
+            body = r.json()
+            entries = body.get("Entries", [])
+            out.extend(entries)
+            if not body.get("ShouldDisplayLoadMore") or not entries:
+                return out
+            last = entries[-1]["FullPath"].rsplit("/", 1)[-1]
+
+    # ------------------------------------------------------------ recovery
+
+    def _recover(self) -> None:
+        for ns_e in self._list_dir(TOPICS_ROOT):
+            if not ns_e["IsDirectory"]:
+                continue
+            ns = ns_e["FullPath"].rsplit("/", 1)[-1]
+            if ns.startswith("."):
+                continue
+            for t_e in self._list_dir(f"{TOPICS_ROOT}/{ns}"):
+                if not t_e["IsDirectory"]:
+                    continue
+                name = t_e["FullPath"].rsplit("/", 1)[-1]
+                conf = self._get_file(f"{TOPICS_ROOT}/{ns}/{name}/topic.conf")
+                if conf is None:
+                    continue
+                cfg = json.loads(conf)
+                st = _TopicState(int(cfg["partitionCount"]))
+                self._topics[(ns, name)] = st
+                for p in range(st.partition_count):
+                    st.logs[p] = self._make_log(ns, name, p, recover=True)
+                off = self._get_file(f"{TOPICS_ROOT}/{ns}/{name}/offsets.json")
+                if off:
+                    for k, v in json.loads(off).items():
+                        part_s, group = k.split("|", 1)
+                        self._offsets[(ns, name, int(part_s), group)] = v
+
+    def _make_log(self, ns: str, name: str, part: int, recover: bool = False) -> PartitionLog:
+        spill = None
+        load = None
+        if self.filer:
+            def spill(seg: int, raw: bytes, _ns=ns, _name=name, _p=part):
+                self._put_file(self._seg_path(_ns, _name, _p, seg), raw)
+
+            def load(seg: int, _ns=ns, _name=name, _p=part):
+                return self._get_file(self._seg_path(_ns, _name, _p, seg))
+
+        next_offset = earliest = 0
+        if recover and self.filer:
+            segs = sorted(
+                e["FullPath"]
+                for e in self._list_dir(f"{TOPICS_ROOT}/{ns}/{name}/{part:04d}")
+                if e["FullPath"].endswith(".log")
+            )
+            if segs:
+                first = self._get_file(segs[0])
+                last = self._get_file(segs[-1])
+                if first is not None:
+                    for off, *_ in decode_records(first):
+                        earliest = off
+                        break
+                if last is not None:
+                    for off, *_ in decode_records(last):
+                        next_offset = off + 1
+        return PartitionLog(
+            segment_records=self.segment_records,
+            spill=spill,
+            load=load,
+            next_offset=next_offset,
+            earliest_offset=earliest,
+        )
+
+    # ------------------------------------------------------------- topics
+
+    def configure_topic(self, ns: str, name: str, partitions: int) -> None:
+        with self._lock:
+            if (ns, name) in self._topics:
+                return
+            st = _TopicState(max(partitions, 1))
+            for p in range(st.partition_count):
+                st.logs[p] = self._make_log(ns, name, p)
+            self._topics[(ns, name)] = st
+            if self.filer:
+                self._put_file(
+                    f"{TOPICS_ROOT}/{ns}/{name}/topic.conf",
+                    json.dumps({"partitionCount": st.partition_count}).encode(),
+                )
+
+    def topic(self, ns: str, name: str) -> _TopicState:
+        st = self._topics.get((ns, name))
+        if st is None:
+            raise KeyError(f"topic {ns}/{name} not configured")
+        return st
+
+    def pick_partition(self, st: _TopicState, key: bytes, requested: int) -> int:
+        if requested >= 0:
+            return requested % st.partition_count
+        if not key:
+            return int(time.time_ns()) % st.partition_count
+        return int.from_bytes(
+            hashlib.md5(key).digest()[:4], "big"
+        ) % st.partition_count
+
+    # ------------------------------------------------------------- offsets
+
+    def list_topics(self) -> list[tuple[str, str, int]]:
+        with self._lock:
+            return sorted(
+                (ns, name, st.partition_count)
+                for (ns, name), st in self._topics.items()
+            )
+
+    def commit_offset(self, ns, name, part, group, offset) -> None:
+        # snapshot under the lock, persist outside it: one slow filer
+        # write must not stall every other MQ RPC
+        with self._lock:
+            self._offsets[(ns, name, part, group)] = offset
+            grouped = {
+                f"{p}|{g}": o
+                for (n2, t2, p, g), o in self._offsets.items()
+                if (n2, t2) == (ns, name)
+            }
+        if self.filer:
+            self._put_file(
+                f"{TOPICS_ROOT}/{ns}/{name}/offsets.json",
+                json.dumps(grouped).encode(),
+            )
+
+    def fetch_offset(self, ns, name, part, group) -> int:
+        with self._lock:
+            return self._offsets.get((ns, name, part, group), -1)
+
+    def flush(self) -> None:
+        with self._lock:
+            for st in self._topics.values():
+                for log in st.logs.values():
+                    log.flush()
+
+
+class MqService:
+    """gRPC servicer (method table in pb/rpc.py MQ_SERVICE)."""
+
+    def __init__(self, broker: MqBroker):
+        self.broker = broker
+
+    def ConfigureTopic(self, request, context):
+        t = request.topic
+        self.broker.configure_topic(
+            t.namespace or "default", t.name, request.partition_count
+        )
+        return mq.ConfigureTopicResponse()
+
+    def ListTopics(self, request, context):
+        return mq.ListTopicsResponse(
+            topics=[
+                mq.TopicInfo(
+                    topic=mq.Topic(namespace=ns, name=name),
+                    partition_count=count,
+                )
+                for ns, name, count in self.broker.list_topics()
+            ]
+        )
+
+    def Publish(self, request, context):
+        t = request.topic
+        try:
+            st = self.broker.topic(t.namespace or "default", t.name)
+        except KeyError as e:
+            return mq.PublishResponse(error=str(e))
+        part = self.broker.pick_partition(
+            st, request.message.key, request.partition
+        )
+        ts = request.message.ts_ns or time.time_ns()
+        off = st.logs[part].append(ts, request.message.key, request.message.value)
+        return mq.PublishResponse(offset=off, partition=part)
+
+    def Subscribe(self, request, context):
+        t = request.topic
+        try:
+            st = self.broker.topic(t.namespace or "default", t.name)
+        except KeyError:
+            context.abort(grpc.StatusCode.NOT_FOUND, "topic not configured")
+        part = request.partition % st.partition_count
+        log = st.logs[part]
+        if request.start_offset >= 0:
+            offset = request.start_offset
+        elif request.consumer_group and (
+            committed := self.broker.fetch_offset(
+                t.namespace or "default", t.name, part, request.consumer_group
+            )
+        ) >= 0:
+            offset = committed
+        else:
+            offset = log.next_offset  # tail
+        while context.is_active():
+            batch = log.read_from(offset)
+            for off, ts, key, value in batch:
+                yield mq.SubscribeRecord(
+                    message=mq.DataMessage(key=key, value=value, ts_ns=ts),
+                    offset=off,
+                    partition=part,
+                )
+                offset = off + 1
+            if not batch:
+                if not request.follow:
+                    yield mq.SubscribeRecord(end_of_stream=True, partition=part)
+                    return
+                log.wait_for(offset, timeout=1.0)
+
+    def CommitOffset(self, request, context):
+        t = request.topic
+        self.broker.commit_offset(
+            t.namespace or "default",
+            t.name,
+            request.partition,
+            request.consumer_group,
+            request.offset,
+        )
+        return mq.CommitOffsetResponse()
+
+    def FetchOffset(self, request, context):
+        t = request.topic
+        return mq.FetchOffsetResponse(
+            offset=self.broker.fetch_offset(
+                t.namespace or "default",
+                t.name,
+                request.partition,
+                request.consumer_group,
+            )
+        )
+
+    def PartitionInfo(self, request, context):
+        t = request.topic
+        try:
+            st = self.broker.topic(t.namespace or "default", t.name)
+        except KeyError:
+            context.abort(grpc.StatusCode.NOT_FOUND, "topic not configured")
+        return mq.PartitionInfoResponse(
+            partitions=[
+                mq.PartitionInfo(
+                    partition=p,
+                    earliest_offset=log.earliest_offset,
+                    next_offset=log.next_offset,
+                )
+                for p, log in sorted(st.logs.items())
+            ]
+        )
+
+
+class MqBrokerServer:
+    def __init__(
+        self,
+        ip: str = "localhost",
+        grpc_port: int = 17777,
+        filer: str = "",
+        segment_records: int = 4096,
+    ):
+        self.ip = ip
+        self.grpc_port = grpc_port
+        self.broker = MqBroker(filer=filer, segment_records=segment_records)
+        self.service = MqService(self.broker)
+        self._grpc = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
+        rpc.add_service(self._grpc, rpc.MQ_SERVICE, self.service)
+        self._grpc.add_insecure_port(f"{ip}:{grpc_port}")
+
+    def start(self) -> None:
+        self._grpc.start()
+
+    def stop(self) -> None:
+        self.broker.flush()
+        self._grpc.stop(grace=0.5)

@@ -1,0 +1,157 @@
+"""Per-partition append log: in-memory ring + sealed segment spill.
+
+Reference: weed/util/log_buffer (MQ's in-memory segmented log) +
+weed/mq/logstore (filer-backed segment files). Segments spill through a
+pluggable `spill(segment_index, records_bytes)` callback — the broker
+wires it to filer-backed storage; None keeps everything in memory.
+
+Record wire format inside a segment (LE): [len u32 | offset i64 |
+ts_ns i64 | key_len u16 | key | value]. Offsets are dense per partition.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from typing import Callable, Iterator, Optional
+
+_REC = struct.Struct("<IqqH")
+
+
+def encode_record(offset: int, ts_ns: int, key: bytes, value: bytes) -> bytes:
+    body_len = _REC.size - 4 + len(key) + len(value)
+    return _REC.pack(body_len, offset, ts_ns, len(key)) + key + value
+
+
+def decode_records(raw: bytes) -> Iterator[tuple[int, int, bytes, bytes]]:
+    pos = 0
+    while pos + 4 <= len(raw):
+        (body_len,) = struct.unpack_from("<I", raw, pos)
+        end = pos + 4 + body_len
+        if end > len(raw):
+            return
+        _, offset, ts_ns, key_len = _REC.unpack_from(raw, pos)
+        p = pos + _REC.size
+        key = raw[p : p + key_len]
+        value = raw[p + key_len : end]
+        yield offset, ts_ns, key, value
+        pos = end
+
+
+class PartitionLog:
+    """Dense-offset append log for one partition."""
+
+    def __init__(
+        self,
+        segment_records: int = 4096,
+        spill: Optional[Callable[[int, bytes], None]] = None,
+        load: Optional[Callable[[int], Optional[bytes]]] = None,
+        next_offset: int = 0,
+        earliest_offset: int = 0,
+    ):
+        self._lock = threading.Condition()
+        self.segment_records = segment_records
+        self._spill = spill
+        self._load = load
+        self.next_offset = next_offset
+        self.earliest_offset = earliest_offset
+        # live (unsealed) tail records: list of (offset, ts, key, value)
+        self._tail: list[tuple[int, int, bytes, bytes]] = []
+        self._tail_base = next_offset
+
+    # ------------------------------------------------------------ write
+
+    def append(self, ts_ns: int, key: bytes, value: bytes) -> int:
+        with self._lock:
+            off = self.next_offset
+            self._tail.append((off, ts_ns, key, value))
+            self.next_offset = off + 1
+            if len(self._tail) >= self.segment_records:
+                self._seal_locked()
+            self._lock.notify_all()
+            return off
+
+    def _seal_locked(self) -> None:
+        if not self._tail or self._spill is None:
+            if self._spill is None and len(self._tail) > self.segment_records * 4:
+                # memory-only mode: bound the tail by dropping the oldest
+                drop = len(self._tail) - self.segment_records * 4
+                self._tail = self._tail[drop:]
+                self._tail_base = self._tail[0][0]
+                self.earliest_offset = self._tail_base
+            return
+        # Spill runs under the partition lock: readers must never observe
+        # a cleared tail whose records have not yet landed in a segment.
+        # The cost (appends stall during a slow spill) is bounded by one
+        # segment per segment_records appends; async double-buffered
+        # spill is a later optimization.
+        # Every record lands in its offset-aligned segment, merging with
+        # previously spilled partial content — a flush mid-segment (e.g.
+        # broker shutdown) followed by post-restart appends must never
+        # overwrite earlier records in that slot.
+        groups: dict[int, list] = {}
+        for r in self._tail:
+            groups.setdefault(r[0] // self.segment_records, []).append(r)
+        for seg, recs in sorted(groups.items()):
+            raw = b"".join(encode_record(*r) for r in recs)
+            if recs[0][0] % self.segment_records != 0 and self._load is not None:
+                prev = self._load(seg)
+                if prev:
+                    # keep only records below our first (idempotent merge)
+                    kept = b"".join(
+                        encode_record(*pr)
+                        for pr in decode_records(prev)
+                        if pr[0] < recs[0][0]
+                    )
+                    raw = kept + raw
+            self._spill(seg, raw)
+        self._tail_base = self.next_offset
+        self._tail = []
+
+    def flush(self) -> None:
+        with self._lock:
+            self._seal_locked()
+
+    # ------------------------------------------------------------- read
+
+    def read_from(
+        self, offset: int, max_records: int = 1024
+    ) -> list[tuple[int, int, bytes, bytes]]:
+        """Records with offset >= `offset` (up to max_records); pulls
+        sealed segments through `load` when the tail has rotated past."""
+        with self._lock:
+            if offset >= self._tail_base:
+                start = 0
+                for i, r in enumerate(self._tail):
+                    if r[0] >= offset:
+                        start = i
+                        break
+                else:
+                    return []
+                return self._tail[start : start + max_records]
+            tail_snapshot = list(self._tail)
+        out: list[tuple[int, int, bytes, bytes]] = []
+        if self._load is not None:
+            seg = offset // self.segment_records
+            while len(out) < max_records:
+                raw = self._load(seg)
+                if raw is None:
+                    break
+                for rec in decode_records(raw):
+                    if rec[0] >= offset and len(out) < max_records:
+                        out.append(rec)
+                seg += 1
+                if out and out[-1][0] + 1 >= self._tail_base:
+                    break
+        for rec in tail_snapshot:
+            if rec[0] >= offset and len(out) < max_records:
+                if not out or rec[0] > out[-1][0]:
+                    out.append(rec)
+        return out
+
+    def wait_for(self, offset: int, timeout: float) -> bool:
+        """Block until next_offset > offset (new data) or timeout."""
+        with self._lock:
+            return self._lock.wait_for(
+                lambda: self.next_offset > offset, timeout=timeout
+            )

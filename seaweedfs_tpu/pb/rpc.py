@@ -11,6 +11,7 @@ from __future__ import annotations
 import grpc
 
 from . import cluster_pb2 as pb
+from . import mq_pb2 as mq
 
 UNARY = "unary_unary"
 SERVER_STREAM = "unary_stream"
@@ -19,6 +20,7 @@ BIDI = "stream_stream"
 
 MASTER_SERVICE = "sw.Seaweed"
 VOLUME_SERVICE = "sw.VolumeServer"
+MQ_SERVICE = "swmq.Messaging"
 
 SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
     MASTER_SERVICE: {
@@ -55,6 +57,15 @@ SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
         "VolumeServerStatus": (UNARY, pb.VolumeServerStatusRequest, pb.VolumeServerStatusResponse),
         "ScrubVolume": (UNARY, pb.ScrubRequest, pb.ScrubResponse),
         "ScrubEcVolume": (UNARY, pb.ScrubRequest, pb.ScrubResponse),
+    },
+    MQ_SERVICE: {
+        "ConfigureTopic": (UNARY, mq.ConfigureTopicRequest, mq.ConfigureTopicResponse),
+        "ListTopics": (UNARY, mq.ListTopicsRequest, mq.ListTopicsResponse),
+        "Publish": (UNARY, mq.PublishRequest, mq.PublishResponse),
+        "Subscribe": (SERVER_STREAM, mq.SubscribeRequest, mq.SubscribeRecord),
+        "CommitOffset": (UNARY, mq.CommitOffsetRequest, mq.CommitOffsetResponse),
+        "FetchOffset": (UNARY, mq.FetchOffsetRequest, mq.FetchOffsetResponse),
+        "PartitionInfo": (UNARY, mq.PartitionInfoRequest, mq.PartitionInfoResponse),
     },
 }
 
@@ -96,3 +107,7 @@ def master_stub(channel: grpc.Channel) -> Stub:
 
 def volume_stub(channel: grpc.Channel) -> Stub:
     return Stub(channel, VOLUME_SERVICE)
+
+
+def mq_stub(channel: grpc.Channel) -> Stub:
+    return Stub(channel, MQ_SERVICE)

@@ -42,6 +42,12 @@ def main(argv=None) -> int:
     f.add_argument("-replication", default="")
     f.add_argument("-jwt.key", dest="jwt_key", default="")
 
+    b = sub.add_parser("mq.broker")
+    b.add_argument("-ip", default="localhost")
+    b.add_argument("-port", type=int, default=17777)
+    b.add_argument("-filer", default="", help="filer host:port for durable segments")
+    b.add_argument("-segmentRecords", type=int, default=4096)
+
     s = sub.add_parser("server")
     s.add_argument("-ip", default="localhost")
     s.add_argument("-masterPort", type=int, default=9333)
@@ -63,6 +69,19 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, lambda *x: stop.set())
 
     servers = []
+    if a.mode == "mq.broker":
+        from ..mq.broker import MqBrokerServer
+
+        bs = MqBrokerServer(
+            ip=a.ip,
+            grpc_port=a.port,
+            filer=a.filer,
+            segment_records=a.segmentRecords,
+        )
+        bs.start()
+        servers.append(bs)
+        print(f"mq broker on {a.ip}:{a.port} (filer={a.filer or 'memory-only'})", flush=True)
+
     if a.mode in ("master", "server"):
         from .master import MasterServer
 
