@@ -40,11 +40,13 @@ class MasterClient:
         self._ec_cache: dict[int, tuple[float, dict[int, list[pb.Location]]]] = {}
 
     def assign(
-        self, count: int = 1, collection: str = "", replication: str = ""
+        self, count: int = 1, collection: str = "", replication: str = "",
+        ttl: str = "",
     ) -> AssignResult:
         resp = self._stub.Assign(
             pb.AssignRequest(
-                count=count, collection=collection, replication=replication
+                count=count, collection=collection, replication=replication,
+                ttl=ttl,
             ),
             timeout=30,
         )

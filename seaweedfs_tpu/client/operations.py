@@ -35,8 +35,11 @@ class Operations:
         mime: str = "",
         collection: str = "",
         replication: str = "",
+        ttl: str = "",
     ) -> str:
-        a = self.master.assign(collection=collection, replication=replication)
+        a = self.master.assign(
+            collection=collection, replication=replication, ttl=ttl
+        )
         url = f"http://{a.url}/{a.fid}"
         files = {"file": (name or "file", data, mime or "application/octet-stream")}
         r = self._http.post(

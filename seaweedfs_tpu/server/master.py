@@ -61,12 +61,22 @@ class MasterService:
 
     def Assign(self, request: pb.AssignRequest, context) -> pb.AssignResponse:
         count = max(int(request.count), 1)
-        picked = self.topo.pick_for_write(request.collection, request.replication)
+        # canonicalize ("90" -> "90m"): volume servers report canonical
+        # TTLs in heartbeats, and the layout buckets compare strings
+        from ..storage.ttl import TTL
+
+        try:
+            ttl = str(TTL.parse(request.ttl))
+        except ValueError as e:
+            return pb.AssignResponse(error=f"bad ttl: {e}")
+        picked = self.topo.pick_for_write(
+            request.collection, request.replication, ttl
+        )
         if picked is None:
-            grown = self._grow(request.collection, request.replication)
+            grown = self._grow(request.collection, request.replication, ttl)
             if grown:
                 picked = self.topo.pick_for_write(
-                    request.collection, request.replication
+                    request.collection, request.replication, ttl
                 )
         if picked is None:
             return pb.AssignResponse(error="no writable volumes and growth failed")
@@ -85,7 +95,7 @@ class MasterService:
             jwt=token,
         )
 
-    def _grow(self, collection: str, replication: str) -> list[int]:
+    def _grow(self, collection: str, replication: str, ttl: str = "") -> list[int]:
         """Allocate one new volume on planned targets (reference
         VolumeGrowth.findEmptySlotsForOneVolume + AllocateVolume RPCs)."""
         with self._grow_lock:
@@ -102,6 +112,7 @@ class MasterService:
                                 volume_id=vid,
                                 collection=collection,
                                 replication=replication,
+                                ttl=ttl,
                             ),
                             timeout=10,
                         )
@@ -116,13 +127,20 @@ class MasterService:
                     id=vid,
                     collection=collection,
                     replica_placement=replication,
+                    ttl=ttl,
                 )
             return [vid]
 
     def VolumeGrow(self, request: pb.VolumeGrowRequest, context) -> pb.VolumeGrowResponse:
+        from ..storage.ttl import TTL
+
+        try:
+            ttl = str(TTL.parse(request.ttl))
+        except ValueError:
+            return pb.VolumeGrowResponse()
         vids = []
         for _ in range(max(int(request.count), 1)):
-            vids.extend(self._grow(request.collection, request.replication))
+            vids.extend(self._grow(request.collection, request.replication, ttl))
         return pb.VolumeGrowResponse(volume_ids=vids)
 
     # ----------------------------------------------------------- lookup
@@ -179,12 +197,20 @@ class MasterServer:
         grpc_port: int = 0,
         volume_size_limit: int = 30 * 1024**3,
         jwt_key: str = "",
+        garbage_threshold: float = 0.3,
+        vacuum_interval: float = 60.0,
     ):
         self.ip = ip
         self.port = port
         self.grpc_port = grpc_port or (port + 10000)
         self.topo = Topology(volume_size_limit=volume_size_limit)
         self.service = MasterService(self.topo, jwt_key=jwt_key)
+        self.garbage_threshold = garbage_threshold
+        self.vacuum_interval = vacuum_interval
+        self._vacuum_stop = threading.Event()
+        self._vacuum_thread = threading.Thread(
+            target=self._vacuum_loop, daemon=True
+        )
 
         self._grpc = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
         rpc.add_service(self._grpc, rpc.MASTER_SERVICE, self.service)
@@ -221,6 +247,7 @@ class MasterServer:
                             count=int(q.get("count", ["1"])[0]),
                             collection=q.get("collection", [""])[0],
                             replication=q.get("replication", [""])[0],
+                            ttl=q.get("ttl", [""])[0],
                         ),
                         None,
                     )
@@ -288,13 +315,40 @@ class MasterServer:
 
         return Handler
 
+    # ----------------------------------------------------------- vacuum
+
+    def _vacuum_loop(self) -> None:
+        """Periodic garbage sweep (reference topology_vacuum.go): ask
+        every holder of a garbage-heavy volume to compact."""
+        while not self._vacuum_stop.wait(self.vacuum_interval):
+            self.vacuum_once()
+
+    def vacuum_once(self) -> list[int]:
+        vacuumed = []
+        for vid, ip, gport in self.topo.garbage_candidates(self.garbage_threshold):
+            try:
+                with grpc.insecure_channel(f"{ip}:{gport}") as ch:
+                    rpc.volume_stub(ch).VacuumVolume(
+                        pb.VacuumRequest(
+                            volume_id=vid,
+                            garbage_threshold=self.garbage_threshold,
+                        ),
+                        timeout=3600,
+                    )
+                vacuumed.append(vid)
+            except grpc.RpcError:
+                continue
+        return vacuumed
+
     # -------------------------------------------------------- lifecycle
 
     def start(self) -> None:
         self._grpc.start()
         self._http_thread.start()
+        self._vacuum_thread.start()
 
     def stop(self) -> None:
+        self._vacuum_stop.set()
         self._grpc.stop(grace=0.5)
         self._http.shutdown()
         self._http.server_close()

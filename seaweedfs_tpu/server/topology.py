@@ -178,8 +178,12 @@ class Topology:
             self.max_volume_id += 1
             return self.max_volume_id
 
-    def writable_volumes(self, collection: str, replication: str) -> list[tuple[int, list[DataNode]]]:
-        """(vid, holders) for volumes writable under the given policy."""
+    def writable_volumes(
+        self, collection: str, replication: str, ttl: str = ""
+    ) -> list[tuple[int, list[DataNode]]]:
+        """(vid, holders) for volumes writable under the given policy.
+        The (collection, replication, ttl) triple buckets volumes the way
+        the reference's VolumeLayout does."""
         copies = _replica_copies(replication)
         with self._lock:
             by_vid: dict[int, list[DataNode]] = {}
@@ -190,6 +194,7 @@ class Topology:
                         and not v.read_only
                         and v.size < self.volume_size_limit
                         and (not replication or v.replica_placement == replication)
+                        and v.ttl == (ttl or "")
                     ):
                         by_vid.setdefault(v.id, []).append(n)
             return [
@@ -199,9 +204,9 @@ class Topology:
             ]
 
     def pick_for_write(
-        self, collection: str, replication: str
+        self, collection: str, replication: str, ttl: str = ""
     ) -> Optional[tuple[int, list[DataNode]]]:
-        candidates = self.writable_volumes(collection, replication)
+        candidates = self.writable_volumes(collection, replication, ttl)
         if not candidates:
             return None
         return random.choice(candidates)
@@ -218,6 +223,18 @@ class Topology:
             if len(avail) < copies:
                 return []
             return avail[:copies]
+
+    def garbage_candidates(self, threshold: float) -> list[tuple[int, str, int]]:
+        """(vid, ip, grpc_port) of garbage-heavy writable volumes."""
+        with self._lock:
+            return [
+                (v.id, n.ip, n.grpc_port)
+                for n in self.nodes.values()
+                for v in n.volumes.values()
+                if v.size > 0
+                and not v.read_only
+                and v.deleted_bytes / max(v.size, 1) > threshold
+            ]
 
     # ------------------------------------------------------------- stats
 

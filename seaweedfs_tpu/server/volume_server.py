@@ -63,6 +63,7 @@ class VolumeService:
             request.volume_id,
             collection=request.collection,
             replica_placement=request.replication or "000",
+            ttl=request.ttl,
         )
         self.server.notify_new_volume(request.volume_id)
         return pb.AllocateVolumeResponse()
@@ -516,6 +517,7 @@ class VolumeService:
                     read_only=v["read_only"],
                     replica_placement=v["replica_placement"],
                     version=v["version"],
+                    ttl=v.get("ttl", ""),
                 )
                 for v in st["volumes"]
             ],
@@ -741,6 +743,7 @@ class VolumeServer:
                     read_only=v["read_only"],
                     replica_placement=v["replica_placement"],
                     version=v["version"],
+                    ttl=v.get("ttl", ""),
                 )
                 for v in st["volumes"]
             ],
@@ -780,7 +783,11 @@ class VolumeServer:
                 hb = self._hb_queue.get(timeout=2.0)
                 yield hb
             except queue.Empty:
-                # periodic full refresh doubles as liveness pulse
+                # periodic full refresh doubles as liveness pulse; also
+                # the reaper tick for expired TTL volumes
+                reaped = self.store.reap_expired_volumes()
+                if reaped:
+                    print(f"reaped expired TTL volumes: {reaped}", flush=True)
                 yield self._full_heartbeat()
                 last_full = time.time()
 

@@ -659,6 +659,66 @@ def fs_mv(env: ShellEnv, args) -> str:
     return "ok" if r.status_code == 200 else f"error: {r.text}"
 
 
+# ---------------------------------------------------------------------- mq
+
+
+@command("mq.topic.list", "[-broker host:port] list topics")
+def mq_topic_list(env: ShellEnv, args) -> str:
+    from ..mq import MqClient
+
+    p = argparse.ArgumentParser(prog="mq.topic.list")
+    p.add_argument("-broker", default="localhost:17777")
+    a = p.parse_args(args)
+    c = MqClient(a.broker)
+    try:
+        topics = c.topics()
+        return (
+            "\n".join(f"{ns}/{name}  partitions={n}" for ns, name, n in topics)
+            or "(no topics)"
+        )
+    finally:
+        c.close()
+
+
+@command("mq.topic.configure", "-topic name [-partitions N] [-broker ...]")
+def mq_topic_configure(env: ShellEnv, args) -> str:
+    from ..mq import MqClient
+
+    p = argparse.ArgumentParser(prog="mq.topic.configure")
+    p.add_argument("-broker", default="localhost:17777")
+    p.add_argument("-topic", required=True)
+    p.add_argument("-namespace", default="default")
+    p.add_argument("-partitions", type=int, default=4)
+    a = p.parse_args(args)
+    c = MqClient(a.broker)
+    try:
+        c.configure_topic(a.topic, a.partitions, a.namespace)
+        return f"configured {a.namespace}/{a.topic} with {a.partitions} partitions"
+    finally:
+        c.close()
+
+
+@command("mq.topic.describe", "-topic name [-broker ...] partition offsets")
+def mq_topic_describe(env: ShellEnv, args) -> str:
+    from ..mq import MqClient
+
+    p = argparse.ArgumentParser(prog="mq.topic.describe")
+    p.add_argument("-broker", default="localhost:17777")
+    p.add_argument("-topic", required=True)
+    p.add_argument("-namespace", default="default")
+    a = p.parse_args(args)
+    c = MqClient(a.broker)
+    try:
+        infos = c.partition_info(a.topic, a.namespace)
+        return "\n".join(
+            f"partition {pi.partition}: offsets [{pi.earliest_offset}, "
+            f"{pi.next_offset}) ({pi.next_offset - pi.earliest_offset} records)"
+            for pi in infos
+        )
+    finally:
+        c.close()
+
+
 # ------------------------------------------------------------------- blobs
 
 

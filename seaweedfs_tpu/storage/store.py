@@ -121,7 +121,11 @@ class Store:
         return min(self.locations, key=lambda l: len(l.volumes) + len(l.ec_volumes))
 
     def allocate_volume(
-        self, vid: int, collection: str = "", replica_placement: str = "000"
+        self,
+        vid: int,
+        collection: str = "",
+        replica_placement: str = "000",
+        ttl: str = "",
     ) -> Volume:
         with self._lock:
             if self.find_volume(vid) is not None:
@@ -132,9 +136,27 @@ class Store:
                 vid,
                 collection=collection,
                 replica_placement=replica_placement,
+                ttl=ttl,
             )
             loc.volumes[vid] = v
             return v
+
+    def reap_expired_volumes(self) -> list[int]:
+        """Delete TTL'd volumes idle past their window (reference
+        periodic expired-volume reaping)."""
+        with self._lock:
+            expired = [
+                vid
+                for loc in self.locations
+                for vid, v in loc.volumes.items()
+                if v.is_expired()
+            ]
+        for vid in expired:
+            try:
+                self.delete_volume(vid)
+            except NotFoundError:
+                pass
+        return expired
 
     def delete_volume(self, vid: int) -> None:
         with self._lock:
@@ -264,6 +286,7 @@ class Store:
                         "read_only": st.read_only,
                         "replica_placement": st.replica_placement,
                         "version": st.version,
+                        "ttl": str(v.ttl),
                     }
                 )
         ecs = []

@@ -19,10 +19,12 @@ from __future__ import annotations
 import os
 import struct
 import threading
+import time
 from dataclasses import dataclass
 from typing import Optional
 
 from .needle import CURRENT_VERSION, Needle, footer_size
+from .ttl import TTL
 from .needle_map import MemoryNeedleMap
 from .super_block import SUPER_BLOCK_SIZE, ReplicaPlacement, SuperBlock
 from ..utils.fs import fsync_dir
@@ -75,6 +77,7 @@ class Volume:
         replica_placement: str = "000",
         version: int = CURRENT_VERSION,
         create: bool = True,
+        ttl: str = "",
     ):
         self.volume_id = volume_id
         self.collection = collection
@@ -94,12 +97,17 @@ class Volume:
             self.super_block = SuperBlock(
                 version=version,
                 replica_placement=ReplicaPlacement.parse(replica_placement),
+                ttl=TTL.parse(ttl).to_bytes(),
             )
             with open(self.dat_path, "wb") as f:
                 f.write(self.super_block.to_bytes())
                 f.flush()
                 os.fsync(f.fileno())
         self.version = self.super_block.version
+        self.ttl = TTL.from_bytes(self.super_block.ttl)
+        # expiry clock for whole-volume reaping; reopen restarts the
+        # window (conservative: never reaps early)
+        self._last_write_ts = time.time()
         self.needle_map = MemoryNeedleMap(self.idx_path)
         self._dat = open(self.dat_path, "r+b")
         self._dat.seek(0, os.SEEK_END)
@@ -130,6 +138,8 @@ class Volume:
         with self._lock:
             if self.read_only:
                 raise ReadOnlyError(f"volume {self.volume_id} is read-only")
+            if self.ttl and not n.last_modified:
+                n.set_last_modified()  # expiry clock for TTL'd volumes
             raw = n.to_bytes(self.version)
             offset = self._append_at
             self._dat.seek(offset)
@@ -138,6 +148,7 @@ class Volume:
                 self._dat.flush()
                 os.fsync(self._dat.fileno())
             self._append_at = offset + len(raw)
+            self._last_write_ts = time.time()
             _, _, size = Needle.parse_header(raw)
             self.needle_map.put(n.needle_id, to_stored_offset(offset), size)
             return offset, size
@@ -153,6 +164,9 @@ class Volume:
             raise CookieMismatch(
                 f"needle {needle_id:x} cookie mismatch"
             )
+        if self.ttl and n.last_modified:
+            if self.ttl.expired(n.last_modified, time.time()):
+                raise NotFoundError(f"needle {needle_id:x} expired")
         return n
 
     def _pread_record(self, byte_offset: int, body_size: int) -> bytes:
@@ -205,6 +219,14 @@ class Volume:
             replica_placement=str(self.super_block.replica_placement),
             compaction_revision=self.super_block.compaction_revision,
         )
+
+    def is_expired(self) -> bool:
+        """Whole-volume expiry: TTL'd and idle past the TTL window
+        (reference expired() reaping of sealed TTL buckets). Uses the
+        in-memory last-write clock — file mtime lags buffered writes."""
+        if not self.ttl:
+            return False
+        return self._last_write_ts + self.ttl.seconds < time.time()
 
     def garbage_ratio(self) -> float:
         cs = self.content_size()

@@ -139,6 +139,36 @@ def test_ec_balance(cluster):
         ops.close()
 
 
+def test_master_auto_vacuum(cluster):
+    """Garbage-heavy volumes are compacted by the master's sweep
+    (reference topology_vacuum.go)."""
+    master, vols = cluster
+    ops = Operations(f"localhost:{master.port}")
+    try:
+        fids = [ops.upload(b"x" * 5000) for _ in range(10)]
+        vid = FileId.parse(fids[0]).volume_id
+        for fid in fids[:8]:
+            if FileId.parse(fid).volume_id == vid:
+                ops.delete(fid)
+        holder = next(vs for vs in vols if vs.store.find_volume(vid))
+        v = holder.store.find_volume(vid)
+        assert v.garbage_ratio() > 0.3
+        size_before = v.size
+        # push fresh stats to the master, then force one sweep
+        holder.notify_new_volume(vid)
+        wait_for(
+            lambda: any(
+                n.volumes.get(vid) is not None
+                and n.volumes[vid].deleted_bytes > 0
+                for n in master.topo.nodes.values()
+            )
+        )
+        assert vid in master.vacuum_once()
+        assert holder.store.find_volume(vid).size < size_before
+    finally:
+        ops.close()
+
+
 def test_metrics_endpoints(cluster):
     master, vols = cluster
     ops = Operations(f"localhost:{master.port}")

@@ -1,0 +1,105 @@
+"""TTL tests: encoding, volume expiry, ttl-bucketed assignment
+(reference weed/storage/needle/volume_ttl.go + TTL volume reaping)."""
+
+import os
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.ttl import TTL
+from seaweedfs_tpu.storage.volume import NotFoundError, Volume
+
+
+def test_ttl_parse_and_encode():
+    assert TTL.parse("") == TTL()
+    assert not TTL.parse("0")
+    for s, secs in [("5m", 300), ("2h", 7200), ("1d", 86400), ("1w", 7 * 86400)]:
+        t = TTL.parse(s)
+        assert t.seconds == secs and str(t) == s
+        assert TTL.from_bytes(t.to_bytes()) == t
+    assert TTL.parse("90").seconds == 90 * 60  # bare number = minutes
+    with pytest.raises(ValueError):
+        TTL.parse("5x")
+    with pytest.raises(ValueError):
+        TTL.parse("300m")  # count > 255
+
+
+def test_ttl_volume_read_expiry(tmp_path):
+    v = Volume(str(tmp_path), 2, ttl="1m")
+    assert v.ttl.seconds == 60
+    n = Needle(cookie=1, needle_id=1, data=b"short lived")
+    v.write_needle(n)
+    assert v.read_needle(1).data == b"short lived"
+    # a needle written 2 minutes ago is expired
+    old = Needle(cookie=2, needle_id=2, data=b"stale")
+    old.set_last_modified(int(time.time()) - 120)
+    v.write_needle(old)
+    with pytest.raises(NotFoundError, match="expired"):
+        v.read_needle(2)
+    v.close()
+    # ttl survives reopen via the superblock
+    v2 = Volume(str(tmp_path), 2, create=False)
+    assert str(v2.ttl) == "1m"
+    v2.close()
+
+
+def test_ttl_volume_reap(tmp_path):
+    from seaweedfs_tpu.storage.store import Store
+
+    st = Store([str(tmp_path)])
+    v = st.allocate_volume(4, ttl="1m")
+    v.write_needle(Needle(cookie=1, needle_id=1, data=b"x"))
+    v.flush()
+    assert st.reap_expired_volumes() == []  # fresh
+    v._last_write_ts = time.time() - 3600  # idle past the TTL window
+    assert st.reap_expired_volumes() == [4]
+    assert st.find_volume(4) is None
+    assert not os.path.exists(str(tmp_path / "4.dat"))
+    st.close()
+
+
+def test_ttl_bucketed_assignment(tmp_path):
+    """Assigns with different TTLs must land on different volumes
+    (reference VolumeLayout keyed by (collection, rp, ttl))."""
+    from seaweedfs_tpu.client.operations import Operations
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.storage.file_id import FileId
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            return s.getsockname()[1]
+
+    mport = free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path / "v")],
+        master=f"localhost:{mport}",
+        ip="localhost",
+        port=free_port(),
+        ec_backend="cpu",
+    )
+    vs.start()
+    while not master.topo.nodes:
+        time.sleep(0.05)
+    ops = Operations(f"localhost:{mport}")
+    try:
+        fid_plain = ops.upload(b"forever")
+        fid_ttl = ops.upload(b"ephemeral", ttl="1h")
+        vid_plain = FileId.parse(fid_plain).volume_id
+        vid_ttl = FileId.parse(fid_ttl).volume_id
+        assert vid_plain != vid_ttl, "TTL bucket must not share volumes"
+        v = vs.store.find_volume(vid_ttl)
+        assert str(v.ttl) == "1h"
+        # same-ttl assigns reuse the bucket
+        fid_ttl2 = ops.upload(b"ephemeral2", ttl="1h")
+        assert FileId.parse(fid_ttl2).volume_id == vid_ttl
+        assert ops.read(fid_ttl) == b"ephemeral"
+    finally:
+        ops.close()
+        vs.stop()
+        master.stop()
