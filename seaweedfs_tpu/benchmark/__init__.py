@@ -1,0 +1,1 @@
+"""Load generator CLI (weed benchmark analog)."""

@@ -1,0 +1,124 @@
+"""Load generator (reference `weed benchmark`, weed/command/benchmark.go):
+concurrent random writes then reads through the normal client path,
+reporting req/s, MB/s and latency percentiles.
+
+  python -m seaweedfs_tpu.benchmark -master host:9333 -n 1000 -size 1024 -c 16
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+import time
+
+import numpy as np
+
+from ..client.operations import Operations
+
+
+def percentiles(samples: list[float]) -> dict:
+    if not samples:
+        return {}
+    a = np.sort(np.asarray(samples))
+    return {
+        "p50": float(a[int(len(a) * 0.50)]),
+        "p90": float(a[int(len(a) * 0.90)]),
+        "p99": float(a[min(int(len(a) * 0.99), len(a) - 1)]),
+        "max": float(a[-1]),
+    }
+
+
+def run_phase(name: str, total: int, concurrency: int, work) -> None:
+    latencies: list[list[float]] = [[] for _ in range(concurrency)]
+    errors = [0] * concurrency
+    counter = {"next": 0}
+    lock = threading.Lock()
+
+    def worker(wid: int):
+        while True:
+            with lock:
+                i = counter["next"]
+                if i >= total:
+                    return
+                counter["next"] = i + 1
+            t0 = time.perf_counter()
+            try:
+                work(i)
+            except Exception:
+                errors[wid] += 1
+                continue
+            latencies[wid].append(time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(target=worker, args=(w,)) for w in range(concurrency)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    flat = [x for ws in latencies for x in ws]
+    ok = len(flat)
+    p = percentiles(flat)
+    print(
+        f"{name}: {ok}/{total} ok in {dt:.2f}s -> {ok / dt:.1f} req/s"
+        + (f", errors {sum(errors)}" if any(errors) else "")
+    )
+    if p:
+        print(
+            f"  latency ms: p50 {p['p50'] * 1000:.1f}  p90 {p['p90'] * 1000:.1f}"
+            f"  p99 {p['p99'] * 1000:.1f}  max {p['max'] * 1000:.1f}"
+        )
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="seaweedfs_tpu.benchmark")
+    p.add_argument("-master", default="localhost:9333")
+    p.add_argument("-n", type=int, default=1000, help="file count")
+    p.add_argument("-size", type=int, default=1024, help="bytes per file")
+    p.add_argument("-c", type=int, default=16, help="concurrency")
+    p.add_argument("-collection", default="")
+    p.add_argument("-replication", default="")
+    p.add_argument("-readRounds", type=int, default=1)
+    a = p.parse_args(argv)
+
+    rng = np.random.default_rng(0)
+    payload = rng.integers(0, 256, a.size, np.uint8).tobytes()
+    fids: list[str] = [""] * a.n
+    clients = [Operations(a.master) for _ in range(a.c)]
+    pool = {"next": 0}
+    lock = threading.Lock()
+
+    def client_for() -> Operations:
+        with lock:
+            i = pool["next"]
+            pool["next"] = (i + 1) % a.c
+        return clients[i]
+
+    def write(i: int):
+        fids[i] = client_for().upload(
+            payload, collection=a.collection, replication=a.replication
+        )
+
+    def read(i: int):
+        data = client_for().read(fids[i % a.n])
+        if len(data) != a.size:
+            raise RuntimeError("short read")
+
+    print(
+        f"benchmark: {a.n} x {a.size}B, concurrency {a.c}, master {a.master}"
+    )
+    run_phase("write", a.n, a.c, write)
+    mb = a.n * a.size / 1e6
+    for r in range(a.readRounds):
+        run_phase("read", a.n, a.c, read)
+    print(f"volume data written: {mb:.1f} MB")
+    for c in clients:
+        c.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

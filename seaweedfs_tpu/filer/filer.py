@@ -101,7 +101,9 @@ class Filer:
             path = f"{path}/{part}"
             existing = self._try_find(parent, part)
             if existing is None:
-                self.store.insert(new_entry(path, is_directory=True, mode=0o755))
+                made = new_entry(path, is_directory=True, mode=0o755)
+                self.store.insert(made)
+                self._notify(parent, None, made)
             elif not existing.is_directory:
                 raise FilerError(f"{path} exists and is not a directory")
 
@@ -161,6 +163,9 @@ class Filer:
         location, then remove the old key. Chunks move by reference.
         An existing destination file is overwritten (chunks GC'd); a
         destination directory is never clobbered."""
+        if normalize_path(old_path) == normalize_path(new_path):
+            # inserting-then-deleting the same key would destroy the entry
+            raise FilerError(f"rename source and destination are the same: {old_path}")
         old_dir, old_name = split_path(old_path)
         entry = self.store.find(old_dir, old_name)
         dest = self._try_find(*split_path(new_path))

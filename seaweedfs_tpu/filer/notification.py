@@ -1,0 +1,133 @@
+"""Filer event notification: publish mutations to external systems.
+
+Reference: weed/notification (configuration.go; Kafka/SQS/PubSub/webhook
+sinks) driven by the filer's meta-log events. Here: webhook (HTTP POST
+of the JSON-rendered event) and an MQ sink (publish to a topic on the
+framework's own broker) — both async with retry, never blocking the
+mutation path.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from typing import Optional
+
+import requests
+
+from ..pb import filer_pb2 as fpb
+
+
+def event_to_json(ev: fpb.FullEventNotification) -> dict:
+    def entry(e):
+        if not e.name and not e.is_directory:
+            return None
+        return {
+            "name": e.name,
+            "isDirectory": e.is_directory,
+            "size": max(
+                (c.offset + c.size for c in e.chunks), default=len(e.content)
+            ),
+            "chunks": len(e.chunks),
+        }
+
+    return {
+        "directory": ev.directory,
+        "tsNs": ev.ts_ns,
+        "oldEntry": entry(ev.event.old_entry),
+        "newEntry": entry(ev.event.new_entry),
+        "deleteChunks": ev.event.delete_chunks,
+    }
+
+
+class _AsyncNotifier:
+    """Bounded queue + delivery thread: the mutation path only ever
+    enqueues; a stalled sink can never block filer writes."""
+
+    def __init__(self, max_queue: int = 10_000, retries: int = 3):
+        self.retries = retries
+        self._q: "queue.Queue" = queue.Queue(maxsize=max_queue)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        self.dropped = 0
+        self.delivered = 0
+
+    def __call__(self, ev: fpb.FullEventNotification) -> None:
+        try:
+            self._q.put_nowait(event_to_json(ev))
+        except queue.Full:
+            self.dropped += 1
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                payload = self._q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            if self._deliver_with_retry(payload):
+                self.delivered += 1
+            else:
+                self.dropped += 1
+
+    def _deliver_with_retry(self, payload: dict) -> bool:
+        for attempt in range(self.retries):
+            try:
+                if self._deliver(payload):
+                    return True
+                return False  # permanent rejection: don't retry
+            except Exception:
+                self._stop.wait(0.5 * (attempt + 1))
+        return False
+
+    def _deliver(self, payload: dict) -> bool:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+
+
+class WebhookNotifier(_AsyncNotifier):
+    """POSTs each filer event to a URL."""
+
+    def __init__(self, url: str, max_queue: int = 10_000, retries: int = 3):
+        self.url = url
+        self._http = requests.Session()
+        super().__init__(max_queue, retries)
+
+    def _deliver(self, payload: dict) -> bool:
+        r = self._http.post(self.url, json=payload, timeout=10)
+        if r.status_code >= 500:
+            raise requests.HTTPError(f"{r.status_code}")  # transient: retry
+        return r.status_code < 400  # 4xx = permanent rejection
+
+
+class MqNotifier(_AsyncNotifier):
+    """Publishes events to a topic on the framework's MQ broker."""
+
+    def __init__(self, broker: str, topic: str = "filer-events", namespace: str = "default"):
+        from ..mq import MqClient
+
+        self.client = MqClient(broker)
+        self.topic = topic
+        self.namespace = namespace
+        try:
+            self.client.configure_topic(topic, partitions=4, namespace=namespace)
+        except Exception:
+            pass
+        super().__init__()
+
+    def _deliver(self, payload: dict) -> bool:
+        self.client.publish(
+            self.topic,
+            json.dumps(payload).encode(),
+            key=(payload.get("directory") or "").encode(),
+            namespace=self.namespace,
+        )
+        return True
+
+    def close(self) -> None:
+        super().close()
+        self.client.close()

@@ -41,6 +41,8 @@ def main(argv=None) -> int:
     f.add_argument("-collection", default="")
     f.add_argument("-replication", default="")
     f.add_argument("-jwt.key", dest="jwt_key", default="")
+    f.add_argument("-notify.webhook", dest="notify_webhook", default="")
+    f.add_argument("-notify.mq", dest="notify_mq", default="")
 
     b = sub.add_parser("mq.broker")
     b.add_argument("-ip", default="localhost")
@@ -62,6 +64,10 @@ def main(argv=None) -> int:
     s.add_argument("-max", type=int, default=8)
     s.add_argument("-ec.backend", dest="ec_backend", default="auto")
     s.add_argument("-jwt.key", dest="jwt_key", default="")
+    s.add_argument("-notify.webhook", dest="notify_webhook", default="")
+    s.add_argument("-notify.mq", dest="notify_mq", default="")
+    s.add_argument("-webdav", action="store_true", help="also run WebDAV")
+    s.add_argument("-webdavPort", type=int, default=7333)
 
     a = p.parse_args(argv)
     stop = threading.Event()
@@ -120,7 +126,9 @@ def main(argv=None) -> int:
         servers.append(vs)
         print(f"volume server on {a.ip}:{a.port} (grpc {vs.grpc_port})", flush=True)
 
-    if a.mode == "filer" or (a.mode == "server" and (a.filer or a.s3)):
+    if a.mode == "filer" or (
+        a.mode == "server" and (a.filer or a.s3 or a.webdav)
+    ):
         import os
 
         from ..filer.filer import Filer
@@ -139,6 +147,16 @@ def main(argv=None) -> int:
             replication=getattr(a, "replication", ""),
             jwt_key=getattr(a, "jwt_key", ""),
         )
+        if getattr(a, "notify_webhook", ""):
+            from ..filer.notification import WebhookNotifier
+
+            filer.subscribe(WebhookNotifier(a.notify_webhook))
+            print(f"filer events -> webhook {a.notify_webhook}", flush=True)
+        if getattr(a, "notify_mq", ""):
+            from ..filer.notification import MqNotifier
+
+            filer.subscribe(MqNotifier(a.notify_mq))
+            print(f"filer events -> mq {a.notify_mq}", flush=True)
         fs = FilerServer(filer, ip=a.ip, port=fport)
         fs.start()
         servers.append(fs)
@@ -154,6 +172,14 @@ def main(argv=None) -> int:
             s3srv.start()
             servers.append(s3srv)
             print(f"s3 gateway on {a.ip}:{a.s3Port}", flush=True)
+
+        if a.mode == "server" and getattr(a, "webdav", False):
+            from .webdav_server import WebDavServer
+
+            wd = WebDavServer(filer, ip=a.ip, port=a.webdavPort)
+            wd.start()
+            servers.append(wd)
+            print(f"webdav on {a.ip}:{a.webdavPort}", flush=True)
 
     stop.wait()
     for srv in servers:

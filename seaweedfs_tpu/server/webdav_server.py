@@ -1,0 +1,255 @@
+"""WebDAV gateway over the filer.
+
+Reference: weed/server/webdav_server.go (x/net/webdav over the filer).
+Class-2-less subset (no LOCK/UNLOCK): OPTIONS, PROPFIND depth 0/1,
+GET/HEAD/PUT/DELETE, MKCOL, MOVE, COPY — enough for davfs/cadaver/
+Finder-style clients.
+"""
+
+from __future__ import annotations
+
+import threading
+import xml.etree.ElementTree as ET
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import unquote, urlparse
+
+from ..filer.entry import Entry, new_entry, normalize_path
+from ..filer.filer import Filer, FilerError
+from ..filer.filer_store import NotFound
+
+DAV = "DAV:"
+ET.register_namespace("D", DAV)
+
+
+def _rfc1123(ts: int) -> str:
+    import time as _t
+
+    return _t.strftime("%a, %d %b %Y %H:%M:%S GMT", _t.gmtime(ts or 0))
+
+
+class WebDavServer:
+    def __init__(self, filer: Filer, ip: str = "localhost", port: int = 7333):
+        self.filer = filer
+        self.ip = ip
+        self.port = port
+        self._http = ThreadingHTTPServer((ip, port), self._handler_class())
+        self._thread = threading.Thread(target=self._http.serve_forever, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._http.shutdown()
+        self._http.server_close()
+
+    def _handler_class(self):
+        filer = self.filer
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _path(self) -> str:
+                return normalize_path(unquote(urlparse(self.path).path))
+
+            def _send(self, code: int, body: bytes = b"", ctype="application/xml; charset=utf-8", extra=None):
+                self.send_response(code)
+                for k, v in (extra or {}).items():
+                    self.send_header(k, v)
+                if code in (204, 201) and not body:
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body and self.command != "HEAD":
+                    self.wfile.write(body)
+
+            def _drain(self):
+                if "chunked" in (self.headers.get("Transfer-Encoding", "")).lower():
+                    # chunked bodies (Finder/davfs PUTs): read frames so
+                    # the keep-alive connection stays in sync
+                    parts = []
+                    while True:
+                        line = self.rfile.readline(1024).strip()
+                        try:
+                            size = int(line.split(b";")[0], 16)
+                        except ValueError:
+                            break
+                        if size == 0:
+                            self.rfile.readline(1024)  # trailing CRLF
+                            break
+                        parts.append(self.rfile.read(size))
+                        self.rfile.read(2)  # chunk CRLF
+                    return b"".join(parts)
+                n = int(self.headers.get("Content-Length", "0") or "0")
+                return self.rfile.read(n) if n else b""
+
+            # ----------------------------------------------------- verbs
+
+            def do_OPTIONS(self):
+                self._send(
+                    200,
+                    extra={
+                        "DAV": "1",
+                        "Allow": "OPTIONS, PROPFIND, GET, HEAD, PUT, DELETE, MKCOL, MOVE, COPY",
+                        "MS-Author-Via": "DAV",
+                    },
+                )
+
+            def do_PROPFIND(self):
+                self._drain()
+                path = self._path()
+                depth = self.headers.get("Depth", "1")
+                try:
+                    entry = filer.find_entry(path)
+                except NotFound:
+                    return self._send(404)
+                ms = ET.Element(f"{{{DAV}}}multistatus")
+                self._prop_response(ms, path, entry)
+                if entry.is_directory and depth != "0":
+                    for child in filer.list_entries(path, limit=10_000):
+                        self._prop_response(ms, child.full_path, child)
+                body = b'<?xml version="1.0" encoding="utf-8"?>' + ET.tostring(ms)
+                self._send(207, body)
+
+            def _prop_response(self, ms, path: str, entry: Entry):
+                from urllib.parse import quote
+
+                resp = ET.SubElement(ms, f"{{{DAV}}}response")
+                href = ET.SubElement(resp, f"{{{DAV}}}href")
+                href.text = quote(path) + (
+                    "/" if entry.is_directory and path != "/" else ""
+                )
+                stat = ET.SubElement(resp, f"{{{DAV}}}propstat")
+                prop = ET.SubElement(stat, f"{{{DAV}}}prop")
+                rt = ET.SubElement(prop, f"{{{DAV}}}resourcetype")
+                if entry.is_directory:
+                    ET.SubElement(rt, f"{{{DAV}}}collection")
+                else:
+                    ET.SubElement(prop, f"{{{DAV}}}getcontentlength").text = str(
+                        entry.file_size
+                    )
+                    ET.SubElement(prop, f"{{{DAV}}}getcontenttype").text = (
+                        entry.attr.mime or "application/octet-stream"
+                    )
+                ET.SubElement(prop, f"{{{DAV}}}getlastmodified").text = _rfc1123(
+                    entry.attr.mtime
+                )
+                ET.SubElement(prop, f"{{{DAV}}}displayname").text = entry.name
+                ET.SubElement(stat, f"{{{DAV}}}status").text = "HTTP/1.1 200 OK"
+
+            def do_GET(self):
+                path = self._path()
+                try:
+                    entry = filer.find_entry(path)
+                except NotFound:
+                    return self._send(404)
+                if entry.is_directory:
+                    return self._send(403)
+                data = b"" if self.command == "HEAD" else filer.read_entry(entry)
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", entry.attr.mime or "application/octet-stream"
+                )
+                self.send_header(
+                    "Content-Length",
+                    str(entry.file_size if self.command == "HEAD" else len(data)),
+                )
+                self.send_header("Last-Modified", _rfc1123(entry.attr.mtime))
+                self.end_headers()
+                if self.command != "HEAD":
+                    self.wfile.write(data)
+
+            do_HEAD = do_GET
+
+            def do_PUT(self):
+                data = self._drain()
+                try:
+                    filer.write_file(
+                        self._path(),
+                        data,
+                        mime=self.headers.get("Content-Type", ""),
+                    )
+                except FilerError:
+                    return self._send(409)
+                self._send(201)
+
+            def do_MKCOL(self):
+                self._drain()
+                path = self._path()
+                if filer.exists(path):
+                    return self._send(405)
+                try:
+                    filer.create_entry(new_entry(path, is_directory=True, mode=0o755))
+                except FilerError:
+                    return self._send(409)
+                self._send(201)
+
+            def do_DELETE(self):
+                path = self._path()
+                if not filer.exists(path):
+                    return self._send(404)
+                filer.delete_entry(path, recursive=True)
+                self._send(204)
+
+            def _dest(self) -> str | None:
+                dest = self.headers.get("Destination", "")
+                if not dest:
+                    return None
+                return normalize_path(unquote(urlparse(dest).path))
+
+            def _overwrite_blocked(self, dst: str) -> bool:
+                """RFC 4918: 'Overwrite: F' on an existing destination
+                must 412, never clobber."""
+                if self.headers.get("Overwrite", "T").upper() != "F":
+                    return False
+                if filer.exists(dst):
+                    self._send(412)
+                    return True
+                return False
+
+            def do_MOVE(self):
+                self._drain()
+                dst = self._dest()
+                if dst is None:
+                    return self._send(400)
+                src = self._path()
+                if src == dst:
+                    return self._send(403)  # RFC 4918: same resource
+                if self._overwrite_blocked(dst):
+                    return
+                try:
+                    filer.rename(src, dst)
+                except NotFound:
+                    return self._send(404)
+                except FilerError:
+                    return self._send(409)
+                self._send(201)
+
+            def do_COPY(self):
+                self._drain()
+                dst = self._dest()
+                if dst is None:
+                    return self._send(400)
+                if self._path() == dst:
+                    return self._send(403)
+                if self._overwrite_blocked(dst):
+                    return
+                try:
+                    entry = filer.find_entry(self._path())
+                    if entry.is_directory:
+                        return self._send(403)  # file copies only, for now
+                    filer.write_file(
+                        dst, filer.read_entry(entry), mime=entry.attr.mime
+                    )
+                except NotFound:
+                    return self._send(404)
+                except FilerError:
+                    return self._send(409)
+                self._send(201)
+
+        return Handler

@@ -1,0 +1,187 @@
+"""WebDAV gateway + filer notification + benchmark CLI tests."""
+
+import json
+import socket
+import threading
+import time
+import xml.etree.ElementTree as ET
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+import requests
+
+from seaweedfs_tpu.filer import Filer, MemoryStore
+from seaweedfs_tpu.filer.notification import MqNotifier, WebhookNotifier
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.server.webdav_server import WebDavServer
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("gw")
+    mport = free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp / "v")],
+        master=f"localhost:{mport}",
+        ip="localhost",
+        port=free_port(),
+        ec_backend="cpu",
+    )
+    vs.start()
+    while not master.topo.nodes:
+        time.sleep(0.05)
+    yield mport
+    vs.stop()
+    master.stop()
+
+
+def test_webdav_crud_and_propfind(cluster):
+    filer = Filer(MemoryStore(), master=f"localhost:{cluster}")
+    port = free_port()
+    srv = WebDavServer(filer, ip="localhost", port=port)
+    srv.start()
+    base = f"http://localhost:{port}"
+    try:
+        r = requests.request("OPTIONS", base + "/")
+        assert "PROPFIND" in r.headers["Allow"]
+        assert requests.request("MKCOL", f"{base}/docs").status_code == 201
+        data = b"dav content" * 1000
+        assert requests.put(f"{base}/docs/a.txt", data=data,
+                            headers={"Content-Type": "text/plain"}).status_code == 201
+        r = requests.get(f"{base}/docs/a.txt")
+        assert r.content == data
+        # PROPFIND depth 1 lists the collection
+        r = requests.request("PROPFIND", f"{base}/docs", headers={"Depth": "1"})
+        assert r.status_code == 207
+        root = ET.fromstring(r.content)
+        hrefs = [e.text for e in root.iter("{DAV:}href")]
+        assert "/docs/" in hrefs and "/docs/a.txt" in hrefs
+        sizes = [e.text for e in root.iter("{DAV:}getcontentlength")]
+        assert str(len(data)) in sizes
+        # MOVE
+        r = requests.request(
+            "MOVE", f"{base}/docs/a.txt",
+            headers={"Destination": f"{base}/docs/b.txt"},
+        )
+        assert r.status_code == 201
+        assert requests.get(f"{base}/docs/b.txt").content == data
+        assert requests.get(f"{base}/docs/a.txt").status_code == 404
+        # COPY
+        r = requests.request(
+            "COPY", f"{base}/docs/b.txt",
+            headers={"Destination": f"{base}/docs/c.txt"},
+        )
+        assert r.status_code == 201
+        assert requests.get(f"{base}/docs/c.txt").content == data
+        # same-path MOVE is forbidden and must not destroy the file
+        r = requests.request(
+            "MOVE", f"{base}/docs/b.txt",
+            headers={"Destination": f"{base}/docs/b.txt"},
+        )
+        assert r.status_code == 403
+        assert requests.get(f"{base}/docs/b.txt").content == data
+        # Overwrite: F protects an existing destination
+        r = requests.request(
+            "MOVE", f"{base}/docs/b.txt",
+            headers={"Destination": f"{base}/docs/c.txt", "Overwrite": "F"},
+        )
+        assert r.status_code == 412
+        assert requests.get(f"{base}/docs/c.txt").content == data
+        # chunked PUT (no Content-Length)
+        def gen():
+            yield b"chunked "
+            yield b"body"
+        r = requests.put(f"{base}/docs/chunked.txt", data=gen())
+        assert r.status_code == 201
+        assert requests.get(f"{base}/docs/chunked.txt").content == b"chunked body"
+        # percent-encoded hrefs for awkward names
+        requests.put(f"{base}/docs/a%20b%23c.txt", data=b"x")
+        r = requests.request("PROPFIND", f"{base}/docs", headers={"Depth": "1"})
+        assert "/docs/a%20b%23c.txt" in r.text
+        # DELETE collection
+        assert requests.delete(f"{base}/docs").status_code == 204
+        assert requests.get(f"{base}/docs/b.txt").status_code == 404
+    finally:
+        srv.stop()
+        filer.close()
+
+
+def test_webhook_notifier(cluster):
+    received = []
+
+    class Hook(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", "0"))
+            received.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    hport = free_port()
+    hook_srv = ThreadingHTTPServer(("localhost", hport), Hook)
+    threading.Thread(target=hook_srv.serve_forever, daemon=True).start()
+
+    filer = Filer(MemoryStore(), master=f"localhost:{cluster}")
+    notifier = WebhookNotifier(f"http://localhost:{hport}/events")
+    filer.subscribe(notifier)
+    try:
+        filer.write_file("/n/x.bin", b"notify me")
+        filer.delete_entry("/n/x.bin")
+        deadline = time.time() + 5
+        while len(received) < 3 and time.time() < deadline:  # mkdir + create + delete
+            time.sleep(0.05)
+        assert notifier.delivered >= 3
+        creates = [e for e in received if e["newEntry"] and e["newEntry"]["name"] == "x.bin"]
+        deletes = [e for e in received if e["oldEntry"] and not e["newEntry"]]
+        assert creates and deletes
+        assert creates[0]["directory"] == "/n"
+    finally:
+        notifier.close()
+        hook_srv.shutdown()
+        hook_srv.server_close()
+        filer.close()
+
+
+def test_mq_notifier(cluster):
+    from seaweedfs_tpu.mq import MqBrokerServer, MqClient
+
+    broker = MqBrokerServer(ip="localhost", grpc_port=free_port())
+    broker.start()
+    filer = Filer(MemoryStore(), master=f"localhost:{cluster}")
+    notifier = MqNotifier(f"localhost:{broker.grpc_port}")
+    filer.subscribe(notifier)
+    try:
+        filer.write_file("/mq/y.bin", b"event")
+        c = MqClient(f"localhost:{broker.grpc_port}")
+        events = []
+        for p in range(4):
+            for rec in c.subscribe("filer-events", p, start_offset=0):
+                events.append(json.loads(rec.message.value))
+        c.close()
+        assert any(
+            e["newEntry"] and e["newEntry"]["name"] == "y.bin" for e in events
+        )
+    finally:
+        notifier.close()
+        filer.close()
+        broker.stop()
+
+
+def test_benchmark_cli(cluster):
+    from seaweedfs_tpu.benchmark.__main__ import main as bench_main
+
+    assert bench_main(
+        ["-master", f"localhost:{cluster}", "-n", "40", "-size", "500", "-c", "4"]
+    ) == 0
