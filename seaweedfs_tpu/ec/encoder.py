@@ -77,33 +77,118 @@ def write_ec_files(
         large_row = large_block_size * k
         small_row = small_block_size * k
 
-        def encode_row(row_offset: int, block_size: int) -> None:
-            batch = min(batch_size, block_size)
-            data = np.empty((k, batch), dtype=np.uint8)
-            for chunk_off in range(0, block_size, batch):
-                width = min(batch, block_size - chunk_off)
-                view = data[:, :width]
-                for i in range(k):
-                    _pread_padded(
-                        dat_fd, view[i], row_offset + i * block_size + chunk_off
-                    )
-                parity = np.asarray(backend.encode(view), dtype=np.uint8)
-                for i in range(total):
-                    chunk = view[i] if i < k else parity[i - k]
-                    b = chunk.tobytes()
-                    outputs[i].write(b)
-                    builders[i].write(b)
+        # Row/chunk schedule: the hot loop is disk-bound (SURVEY.md hard
+        # part (b)), so reads, device encode, and shard writes run as a
+        # 3-stage pipeline with bounded queues — the device computes
+        # batch N while batch N+1 is read and batch N-1 is written.
+        def chunk_plan():
+            processed = 0
+            remaining = dat_size
+            while remaining >= large_row:
+                yield processed, large_block_size
+                processed += large_row
+                remaining -= large_row
+            while remaining > 0:
+                yield processed, small_block_size
+                processed += small_row
+                remaining -= small_row
 
-        processed = 0
-        remaining = dat_size
-        while remaining >= large_row:
-            encode_row(processed, large_block_size)
-            processed += large_row
-            remaining -= large_row
-        while remaining > 0:
-            encode_row(processed, small_block_size)
-            processed += small_row
-            remaining -= small_row
+        import queue as _queue
+        import threading as _threading
+
+        read_q: "_queue.Queue" = _queue.Queue(maxsize=2)
+        write_q: "_queue.Queue" = _queue.Queue(maxsize=2)
+        abort = _threading.Event()
+        errors: list[BaseException] = []
+
+        def _put(q, item) -> bool:
+            """Abort-aware put: never blocks forever on a full queue
+            whose consumer has stopped."""
+            while True:
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except _queue.Full:
+                    if abort.is_set():
+                        return False
+
+        def reader():
+            try:
+                for row_offset, block_size in chunk_plan():
+                    batch = min(batch_size, block_size)
+                    for chunk_off in range(0, block_size, batch):
+                        if abort.is_set():
+                            return
+                        width = min(batch, block_size - chunk_off)
+                        data = np.empty((k, width), dtype=np.uint8)
+                        for i in range(k):
+                            _pread_padded(
+                                dat_fd,
+                                data[i],
+                                row_offset + i * block_size + chunk_off,
+                            )
+                        if not _put(read_q, data):
+                            return
+            except BaseException as e:  # pragma: no cover - disk errors
+                errors.append(e)
+                abort.set()
+            finally:
+                _put(read_q, None)
+
+        def writer():
+            try:
+                while True:
+                    item = write_q.get()
+                    if item is None:
+                        return
+                    data, parity = item
+                    for i in range(total):
+                        b = (data[i] if i < k else parity[i - k]).tobytes()
+                        outputs[i].write(b)
+                        builders[i].write(b)
+            except BaseException as e:  # pragma: no cover - disk errors
+                errors.append(e)
+                abort.set()
+                while write_q.get() is not None:
+                    pass
+
+        rt = _threading.Thread(target=reader, daemon=True)
+        wt = _threading.Thread(target=writer, daemon=True)
+        rt.start()
+        wt.start()
+        try:
+            while True:
+                data = read_q.get()
+                if data is None or abort.is_set():
+                    break
+                parity = np.asarray(backend.encode(data), dtype=np.uint8)
+                if not _put(write_q, (data, parity)):
+                    break
+        except BaseException as e:
+            errors.append(e)
+        finally:
+            # Shutdown discipline: JOIN both threads before any fd is
+            # closed — a reader mid-pread on a closed (possibly reused)
+            # fd would read someone else's file. On error, abort stops
+            # the reader (its _put is abort-aware) and draining read_q
+            # unblocks an in-flight put. The writer always drains
+            # write_q until the None sentinel (its error path keeps
+            # consuming), so a BLOCKING put(None) never deadlocks and
+            # never drops queued batches on the happy path.
+            if errors:
+                abort.set()
+                try:
+                    while True:
+                        read_q.get_nowait()
+                except _queue.Empty:
+                    pass
+            write_q.put(None)
+            rt.join(timeout=60)
+            wt.join(timeout=60)
+            if rt.is_alive() or wt.is_alive():  # pragma: no cover
+                abort.set()
+        if errors:
+            raise errors[0]
 
         for f in outputs:
             f.flush()

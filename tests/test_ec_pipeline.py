@@ -6,6 +6,7 @@ ec_rebuild_safety_test.go, ec_bitrot_interop_test.go).
 """
 
 import os
+import time
 
 import numpy as np
 import pytest
@@ -308,6 +309,21 @@ def test_encode_batch_size_invariance(tmp_path):
     for i in range(CTX.total):
         with open(base + CTX.to_ext(i), "rb") as f:
             assert f.read() == first[i], f"shard {i} differs across batch sizes"
+
+
+def test_encode_pipeline_error_propagates(tmp_path):
+    """A failing backend must raise out of write_ec_files promptly (no
+    pipeline deadlock) and leave no partially-registered state."""
+    base, _ = make_volume(tmp_path, needles=20, seed=7)
+
+    class BoomBackend(CpuBackend):
+        def encode(self, data):
+            raise RuntimeError("device exploded")
+
+    t0 = time.time()
+    with pytest.raises(RuntimeError, match="device exploded"):
+        write_ec_files(base, CTX, BoomBackend(CTX))
+    assert time.time() - t0 < 30, "error path must not hang"
 
 
 def test_custom_ratio_roundtrip(tmp_path):
