@@ -1,0 +1,3 @@
+"""Cross-cluster replication (filer.sync analog)."""
+
+from .sync import FilerSync

@@ -1,0 +1,32 @@
+"""`python -m seaweedfs_tpu.replication` — continuous filer-to-filer sync.
+
+  python -m seaweedfs_tpu.replication -from hostA:8888 -to hostB:8888 \
+      [-path /buckets] [-state sync.state]
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+
+from .sync import FilerSync
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="seaweedfs_tpu.replication")
+    p.add_argument("-from", dest="source", required=True)
+    p.add_argument("-to", dest="target", required=True)
+    p.add_argument("-path", default="/")
+    p.add_argument("-state", default="filer.sync.state")
+    a = p.parse_args(argv)
+    sync = FilerSync(a.source, a.target, a.path, a.state)
+    signal.signal(signal.SIGTERM, lambda *x: sync.stop())
+    signal.signal(signal.SIGINT, lambda *x: sync.stop())
+    print(f"syncing {a.source}{a.path} -> {a.target}", flush=True)
+    sync.run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,200 @@
+"""Cross-cluster filer sync: replay one filer's namespace onto another.
+
+Reference: weed/command/filer_sync.go + weed/replication (replicator
+core + filersink) — event-driven continuous sync with an initial full
+copy. Content is re-uploaded through the target filer (fids are
+cluster-local, only bytes travel).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.parse
+
+import requests
+
+
+class FilerSync:
+    def __init__(
+        self,
+        source: str,
+        target: str,
+        path_prefix: str = "/",
+        state_file: str = "",
+        exclude_prefixes: tuple = ("/topics",),
+    ):
+        self.source = source
+        self.target = target
+        self.prefix = path_prefix.rstrip("/") or "/"
+        self.exclude = exclude_prefixes
+        self.state_file = state_file
+        self.watermark = 0
+        if state_file and os.path.exists(state_file):
+            try:
+                self.watermark = json.load(open(state_file))["sinceNs"]
+            except (ValueError, KeyError, OSError):
+                pass
+        self._http = requests.Session()
+        self._stop = threading.Event()
+        self.synced_files = 0
+        self.deleted_files = 0
+
+    # ------------------------------------------------------------ helpers
+
+    def _src(self, path: str) -> str:
+        return f"http://{self.source}{urllib.parse.quote(path)}"
+
+    def _dst(self, path: str) -> str:
+        return f"http://{self.target}{urllib.parse.quote(path)}"
+
+    @staticmethod
+    def _under(path: str, prefix: str) -> bool:
+        """Subtree membership with a path boundary: '/docs' covers
+        '/docs/x' but NOT '/docs-archive/x'."""
+        return path == prefix or path.startswith(prefix.rstrip("/") + "/")
+
+    def _in_scope(self, path: str) -> bool:
+        if any(self._under(path, x) for x in self.exclude):
+            return False
+        return self.prefix == "/" or self._under(path, self.prefix)
+
+    def _save_state(self) -> None:
+        if self.state_file:
+            with open(self.state_file, "w") as f:
+                json.dump({"sinceNs": self.watermark}, f)
+
+    # --------------------------------------------------------- full copy
+
+    def _list_all(self, d: str):
+        """Paginated directory listing (the filer caps pages at 1024)."""
+        last = ""
+        while True:
+            r = self._http.get(
+                self._src(d),
+                params={"limit": "1024", "lastFileName": last},
+                timeout=30,
+            )
+            if r.status_code != 200 or r.headers.get("X-Filer-Listing") != "true":
+                return
+            body = r.json()
+            entries = body.get("Entries", [])
+            yield from entries
+            if not body.get("ShouldDisplayLoadMore") or not entries:
+                return
+            last = entries[-1]["FullPath"].rsplit("/", 1)[-1]
+
+    def full_sync(self) -> int:
+        """Initial walk: copy every in-scope file source -> target."""
+        copied = 0
+        stack = [self.prefix if self.prefix != "/" else "/"]
+        while stack:
+            d = stack.pop()
+            for e in self._list_all(d):
+                path = e["FullPath"]
+                if not self._in_scope(path):
+                    continue
+                if e["IsDirectory"]:
+                    self._http.post(self._dst(path) + "?mkdir=true", timeout=30)
+                    stack.append(path)
+                else:
+                    if self._copy_file(path, e.get("Mime", "")):
+                        copied += 1
+        return copied
+
+    def _copy_file(self, path: str, mime: str) -> bool:
+        r = self._http.get(self._src(path), timeout=300)
+        if r.status_code != 200:
+            return False
+        put = self._http.post(
+            self._dst(path),
+            data=r.content,
+            headers={"Content-Type": mime or r.headers.get("Content-Type", "")},
+            timeout=300,
+        )
+        if put.ok:
+            self.synced_files += 1
+            return True
+        return False
+
+    # -------------------------------------------------------------- tail
+
+    def apply_event(self, ev: dict) -> None:
+        directory = ev.get("directory", "")
+        old, new = ev.get("oldEntry"), ev.get("newEntry")
+        if new:
+            path = f"{directory.rstrip('/')}/{new['name']}" if new["name"] else directory
+            if not self._in_scope(path):
+                return
+            if new["isDirectory"]:
+                self._http.post(self._dst(path) + "?mkdir=true", timeout=30)
+            else:
+                self._copy_file(path, "")
+        elif old:
+            path = f"{directory.rstrip('/')}/{old['name']}" if old["name"] else directory
+            if not self._in_scope(path):
+                return
+            r = self._http.delete(self._dst(path) + "?recursive=true", timeout=60)
+            if r.status_code in (200, 204):
+                self.deleted_files += 1
+
+    def _source_now_ns(self) -> int:
+        """The SOURCE filer's clock (watermarks must never mix clocks —
+        skew would skip events emitted during the full copy)."""
+        r = self._http.get(
+            f"http://{self.source}/~meta/tail",
+            params={"sinceNs": str(1 << 62), "waitSeconds": "0"},
+            timeout=30,
+        )
+        r.raise_for_status()
+        return int(r.json().get("nowNs", 0)) or time.time_ns()
+
+    def tail_once(self, wait_seconds: float = 10.0) -> int:
+        r = self._http.get(
+            f"http://{self.source}/~meta/tail",
+            params={
+                "sinceNs": str(self.watermark),
+                "waitSeconds": str(wait_seconds),
+            },
+            timeout=wait_seconds + 30,
+        )
+        r.raise_for_status()
+        body = r.json()
+        dropped_before = int(body.get("droppedBeforeTsNs", 0))
+        if 0 < self.watermark < dropped_before:
+            # events up to dropped_before were rotated away: deletions in
+            # the gap are unrecoverable from the log — full resync
+            # (reference SubscribeMetadata errors for the same reason)
+            print(
+                f"meta log gap (watermark {self.watermark} < dropped-before "
+                f"{dropped_before}); running full resync",
+                flush=True,
+            )
+            self.watermark = self._source_now_ns() - 1
+            self.full_sync()
+            self._save_state()
+            return 0
+        for ev in body.get("events", []):
+            self.apply_event(ev)
+            self.watermark = max(self.watermark, ev.get("tsNs", 0))
+        self._save_state()
+        return len(body.get("events", []))
+
+    def run(self) -> None:
+        if self.watermark == 0:
+            # watermark (in the SOURCE's clock) BEFORE the walk so events
+            # racing the copy replay afterwards
+            self.watermark = self._source_now_ns() - 1
+            n = self.full_sync()
+            print(f"initial sync: {n} files copied", flush=True)
+            self._save_state()
+        while not self._stop.is_set():
+            try:
+                self.tail_once()
+            except requests.RequestException:
+                self._stop.wait(2.0)
+
+    def stop(self) -> None:
+        self._stop.set()

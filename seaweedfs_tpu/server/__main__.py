@@ -157,7 +157,14 @@ def main(argv=None) -> int:
 
             filer.subscribe(MqNotifier(a.notify_mq))
             print(f"filer events -> mq {a.notify_mq}", flush=True)
-        fs = FilerServer(filer, ip=a.ip, port=fport)
+        from ..filer.meta_log import MetaLog
+
+        fs = FilerServer(
+            filer,
+            ip=a.ip,
+            port=fport,
+            meta_log=MetaLog(os.path.join(dbdir, "metalog")),
+        )
         fs.start()
         servers.append(fs)
         print(f"filer on {a.ip}:{fport}", flush=True)

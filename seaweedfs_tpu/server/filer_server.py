@@ -18,15 +18,28 @@ from ..filer.filer_store import NotFound
 
 
 class FilerServer:
-    def __init__(self, filer: Filer, ip: str = "localhost", port: int = 8888):
+    def __init__(
+        self,
+        filer: Filer,
+        ip: str = "localhost",
+        port: int = 8888,
+        meta_log=None,
+    ):
+        """meta_log: a filer.meta_log.MetaLog; when present it is
+        subscribed to the filer and served at GET /~meta/tail (the
+        SubscribeMetadata analog, long-poll JSON batches)."""
         self.filer = filer
         self.ip = ip
         self.port = port
+        self.meta_log = meta_log
+        if meta_log is not None:
+            filer.subscribe(meta_log)
         self._http = ThreadingHTTPServer((ip, port), self._handler_class())
         self._thread = threading.Thread(target=self._http.serve_forever, daemon=True)
 
     def _handler_class(self):
         filer = self.filer
+        server_ref = self
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -53,6 +66,8 @@ class FilerServer:
 
             def do_GET(self):
                 q = parse_qs(urlparse(self.path).query)
+                if urlparse(self.path).path == "/~meta/tail":
+                    return self._meta_tail(q)
                 path = self._path()
                 try:
                     entry = filer.find_entry(path)
@@ -149,6 +164,36 @@ class FilerServer:
 
             do_HEAD = do_GET
 
+            def _meta_tail(self, q):
+                """Long-poll metadata subscription: events after sinceNs,
+                blocking up to waitSeconds for fresh ones."""
+                srv_log = server_ref.meta_log
+                if srv_log is None:
+                    return self._json(404, {"error": "no metadata log"})
+                try:
+                    since = int(q.get("sinceNs", ["0"])[0])
+                    limit = int(q.get("limit", ["10000"])[0])
+                    wait_s = min(float(q.get("waitSeconds", ["0"])[0]), 60.0)
+                except ValueError:
+                    return self._json(400, {"error": "bad parameters"})
+                events = srv_log.read_since(since, limit)
+                if not events and wait_s > 0:
+                    srv_log.wait_for_events(since, timeout=wait_s)
+                    events = srv_log.read_since(since, limit)
+                last = events[-1]["tsNs"] if events else since
+                import time as _time
+
+                self._json(
+                    200,
+                    {
+                        "events": events,
+                        "lastTsNs": last,
+                        # gap detection + clock anchoring for subscribers
+                        "droppedBeforeTsNs": srv_log.dropped_before_ts,
+                        "nowNs": _time.time_ns(),
+                    },
+                )
+
             def _write(self):
                 u = urlparse(self.path)
                 q = parse_qs(u.query)
@@ -206,3 +251,5 @@ class FilerServer:
         self._http.shutdown()
         self._http.server_close()
         self.filer.close()
+        if self.meta_log is not None:
+            self.meta_log.close()

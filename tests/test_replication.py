@@ -1,0 +1,158 @@
+"""Metadata log + subscription + cross-cluster sync tests
+(reference filer meta log / SubscribeMetadata / filer.sync)."""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.filer import Filer, MemoryStore
+from seaweedfs_tpu.filer.meta_log import MetaLog
+from seaweedfs_tpu.pb import filer_pb2 as fpb
+from seaweedfs_tpu.replication import FilerSync
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def make_event(directory: str, name: str, ts_ns: int) -> fpb.FullEventNotification:
+    ev = fpb.FullEventNotification(directory=directory, ts_ns=ts_ns)
+    ev.event.new_entry.name = name
+    return ev
+
+
+def test_meta_log_append_read_rotation(tmp_path):
+    import seaweedfs_tpu.filer.meta_log as ml
+
+    log = MetaLog(str(tmp_path / "log"))
+    for i in range(1, 101):
+        log(make_event("/d", f"f{i}", ts_ns=i))
+    events = log.read_since(0)
+    assert len(events) == 100
+    assert [e["tsNs"] for e in events] == list(range(1, 101))
+    assert len(log.read_since(90)) == 10
+    # rotation: shrink the segment cap temporarily
+    old = ml.SEGMENT_BYTES
+    ml.SEGMENT_BYTES = 512
+    try:
+        for i in range(101, 161):
+            log(make_event("/d", f"f{i}", ts_ns=i))
+    finally:
+        ml.SEGMENT_BYTES = old
+    import os
+
+    assert any(f.startswith("meta-") for f in os.listdir(tmp_path / "log"))
+    # retention keeps a bounded contiguous suffix ending at the newest event
+    got = [e["tsNs"] for e in log.read_since(95)]
+    assert got == list(range(got[0], 161))
+    assert got[0] > 96, "old segments beyond retention are dropped"
+    log.close()
+
+
+def test_meta_log_wait(tmp_path):
+    log = MetaLog(str(tmp_path / "log"))
+    hit = []
+    t = threading.Thread(target=lambda: hit.append(log.wait_for_events(0, 5.0)))
+    t.start()
+    time.sleep(0.1)
+    log(make_event("/d", "x", ts_ns=time.time_ns()))
+    t.join(timeout=2)
+    assert hit == [True]
+    log.close()
+
+
+@pytest.fixture
+def two_clusters(tmp_path):
+    """Two independent single-node clusters, each with a filer."""
+    out = []
+    for i in range(2):
+        mport = free_port()
+        master = MasterServer(ip="localhost", port=mport)
+        master.start()
+        vs = VolumeServer(
+            directories=[str(tmp_path / f"c{i}v")],
+            master=f"localhost:{mport}",
+            ip="localhost",
+            port=free_port(),
+            ec_backend="cpu",
+        )
+        vs.start()
+        while not master.topo.nodes:
+            time.sleep(0.05)
+        filer = Filer(MemoryStore(), master=f"localhost:{mport}")
+        fport = free_port()
+        fsrv = FilerServer(
+            filer,
+            ip="localhost",
+            port=fport,
+            meta_log=MetaLog(str(tmp_path / f"c{i}meta")),
+        )
+        fsrv.start()
+        out.append((master, vs, filer, fsrv, fport))
+    yield out
+    for master, vs, filer, fsrv, _ in out:
+        fsrv.stop()
+        vs.stop()
+        master.stop()
+
+
+def test_meta_tail_endpoint(two_clusters):
+    _, _, _, _, fport = two_clusters[0]
+    base = f"http://localhost:{fport}"
+    r = requests.get(f"{base}/~meta/tail?sinceNs=0")
+    body = r.json()
+    assert body["events"] == [] and body["lastTsNs"] == 0
+    assert body["droppedBeforeTsNs"] == 0 and body["nowNs"] > 0
+    requests.post(f"{base}/a/b.txt", data=b"hello")
+    r = requests.get(f"{base}/~meta/tail?sinceNs=0")
+    body = r.json()
+    names = [
+        e["newEntry"]["name"] for e in body["events"] if e.get("newEntry")
+    ]
+    assert "b.txt" in names and "a" in names
+    # watermark pagination: nothing after lastTsNs
+    r2 = requests.get(f"{base}/~meta/tail?sinceNs={body['lastTsNs']}")
+    assert r2.json()["events"] == []
+
+
+def test_filer_sync_full_and_tail(two_clusters):
+    src = two_clusters[0][4]
+    dst = two_clusters[1][4]
+    sbase, dbase = f"http://localhost:{src}", f"http://localhost:{dst}"
+    # pre-existing state
+    requests.post(f"{sbase}/docs/one.txt", data=b"first")
+    requests.post(f"{sbase}/docs/sub/two.txt", data=b"second")
+
+    sync = FilerSync(f"localhost:{src}", f"localhost:{dst}")
+    sync.watermark = time.time_ns() - 1
+    assert sync.full_sync() == 2
+    assert requests.get(f"{dbase}/docs/one.txt").content == b"first"
+    assert requests.get(f"{dbase}/docs/sub/two.txt").content == b"second"
+
+    # live events: create, overwrite, delete
+    requests.post(f"{sbase}/docs/three.txt", data=b"third")
+    requests.post(f"{sbase}/docs/one.txt", data=b"first-v2")
+    requests.delete(f"{sbase}/docs/sub/two.txt")
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        sync.tail_once(wait_seconds=0.5)
+        if (
+            requests.get(f"{dbase}/docs/three.txt").status_code == 200
+            and requests.get(f"{dbase}/docs/one.txt").content == b"first-v2"
+            and requests.get(f"{dbase}/docs/sub/two.txt").status_code == 404
+        ):
+            break
+    assert requests.get(f"{dbase}/docs/three.txt").content == b"third"
+    assert requests.get(f"{dbase}/docs/one.txt").content == b"first-v2"
+    assert requests.get(f"{dbase}/docs/sub/two.txt").status_code == 404
+    # idempotent: re-tailing applies nothing new
+    assert sync.tail_once(wait_seconds=0.2) == 0
