@@ -1,0 +1,78 @@
+"""Thin filer HTTP helpers shared by every component that walks the
+namespace (sync daemon, MQ broker recovery, shell fs.* commands).
+
+Reference: weed/filer_client — the minimal accessor package gateways use.
+"""
+
+from __future__ import annotations
+
+import urllib.parse
+from typing import Iterator, Optional
+
+import requests
+
+
+class FilerListingError(requests.RequestException):
+    """Subclasses RequestException so callers with transient-retry
+    wrappers (e.g. the MQ broker's startup recovery) treat listing
+    failures as retryable."""
+
+
+def filer_url(filer: str, path: str) -> str:
+    if not path.startswith("/"):
+        path = "/" + path
+    return f"http://{filer}{urllib.parse.quote(path)}"
+
+
+def list_dir(
+    filer: str,
+    path: str,
+    session: Optional[requests.Session] = None,
+    strict: bool = False,
+) -> Iterator[dict]:
+    """Paginated directory listing (the filer caps pages at 1024).
+
+    strict=True raises FilerListingError when the path is missing or not
+    a directory — walkers that report success must not silently skip."""
+    http = session or requests
+    last = ""
+    while True:
+        r = http.get(
+            filer_url(filer, path),
+            params={"limit": "1024", "lastFileName": last},
+            timeout=30,
+        )
+        if r.status_code == 404:
+            if strict:
+                raise FilerListingError(f"{path}: not found")
+            return
+        if r.status_code != 200:
+            raise FilerListingError(f"{path}: HTTP {r.status_code}")
+        if r.headers.get("X-Filer-Listing") != "true":
+            if strict:
+                raise FilerListingError(f"{path}: not a directory")
+            return
+        body = r.json()
+        entries = body.get("Entries", [])
+        yield from entries
+        if not body.get("ShouldDisplayLoadMore") or not entries:
+            return
+        last = entries[-1]["FullPath"].rsplit("/", 1)[-1]
+
+
+def walk(
+    filer: str,
+    root: str,
+    session: Optional[requests.Session] = None,
+    strict: bool = False,
+) -> Iterator[dict]:
+    """Depth-first recursive walk yielding every entry under root."""
+    stack = [root]
+    first = True
+    while stack:
+        d = stack.pop()
+        for e in list_dir(filer, d, session, strict=strict and first):
+            yield e
+            if e["IsDirectory"]:
+                stack.append(e["FullPath"])
+        first = False

@@ -92,25 +92,9 @@ class MqBroker:
 
     def _list_dir(self, path: str) -> list[dict]:
         """Full listing, following pagination (the filer caps pages)."""
-        out: list[dict] = []
-        last = ""
-        while True:
-            r = self._http.get(
-                self._url(path),
-                params={"limit": "1024", "lastFileName": last},
-                timeout=30,
-            )
-            if r.status_code == 404:
-                return out
-            r.raise_for_status()
-            if r.headers.get("X-Filer-Listing") != "true":
-                return out
-            body = r.json()
-            entries = body.get("Entries", [])
-            out.extend(entries)
-            if not body.get("ShouldDisplayLoadMore") or not entries:
-                return out
-            last = entries[-1]["FullPath"].rsplit("/", 1)[-1]
+        from ..client.filer_client import list_dir
+
+        return list(list_dir(self.filer, path, session=self._http))
 
     # ------------------------------------------------------------ recovery
 

@@ -68,31 +68,15 @@ class FilerSync:
 
     # --------------------------------------------------------- full copy
 
-    def _list_all(self, d: str):
-        """Paginated directory listing (the filer caps pages at 1024)."""
-        last = ""
-        while True:
-            r = self._http.get(
-                self._src(d),
-                params={"limit": "1024", "lastFileName": last},
-                timeout=30,
-            )
-            if r.status_code != 200 or r.headers.get("X-Filer-Listing") != "true":
-                return
-            body = r.json()
-            entries = body.get("Entries", [])
-            yield from entries
-            if not body.get("ShouldDisplayLoadMore") or not entries:
-                return
-            last = entries[-1]["FullPath"].rsplit("/", 1)[-1]
-
     def full_sync(self) -> int:
         """Initial walk: copy every in-scope file source -> target."""
+        from ..client.filer_client import list_dir
+
         copied = 0
         stack = [self.prefix if self.prefix != "/" else "/"]
         while stack:
             d = stack.pop()
-            for e in self._list_all(d):
+            for e in list_dir(self.source, d, session=self._http):
                 path = e["FullPath"]
                 if not self._in_scope(path):
                     continue

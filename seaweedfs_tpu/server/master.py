@@ -11,6 +11,7 @@ to on one node.
 from __future__ import annotations
 
 import json
+import re
 import threading
 from concurrent import futures
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -22,6 +23,11 @@ from ..pb import cluster_pb2 as pb
 from ..pb import rpc
 from ..storage.file_id import FileId, new_cookie
 from .topology import DataNode, Topology
+
+
+# collections become file-name prefixes on volume servers: path
+# separators or control characters must never reach the storage layer
+_COLLECTION_RE = re.compile(r"^[A-Za-z0-9_.\-]*$")
 
 
 class MasterService:
@@ -65,6 +71,10 @@ class MasterService:
         # TTLs in heartbeats, and the layout buckets compare strings
         from ..storage.ttl import TTL
 
+        if not _COLLECTION_RE.match(request.collection):
+            return pb.AssignResponse(
+                error=f"invalid collection name {request.collection!r}"
+            )
         try:
             ttl = str(TTL.parse(request.ttl))
         except ValueError as e:
@@ -134,6 +144,8 @@ class MasterService:
     def VolumeGrow(self, request: pb.VolumeGrowRequest, context) -> pb.VolumeGrowResponse:
         from ..storage.ttl import TTL
 
+        if not _COLLECTION_RE.match(request.collection):
+            return pb.VolumeGrowResponse()
         try:
             ttl = str(TTL.parse(request.ttl))
         except ValueError:
@@ -291,6 +303,8 @@ class MasterServer:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif u.path in ("/", "/ui"):
+                    self._ui()
                 elif u.path in ("/cluster/status", "/dir/status"):
                     topo = master.topo.to_proto()
                     self._json(
@@ -310,6 +324,56 @@ class MasterServer:
                     )
                 else:
                     self._json(404, {"error": "not found"})
+
+            def _ui(self):
+                """Minimal admin status page (reference weed/admin dash,
+                server-rendered). Every interpolated string is escaped —
+                collection/replication/ttl arrive from clients."""
+                import html as _html
+
+                esc = _html.escape
+                topo = master.topo.to_proto()
+                stats = master.topo.statistics()
+                rows = []
+                for n in topo.nodes:
+                    vols = "".join(
+                        f"<tr><td>{v.id}</td><td>{esc(v.collection) or '-'}</td>"
+                        f"<td>{v.size:,}</td><td>{v.file_count}</td>"
+                        f"<td>{v.deleted_count}</td>"
+                        f"<td>{'RO' if v.read_only else 'RW'}</td>"
+                        f"<td>{esc(v.replica_placement)}</td><td>{esc(v.ttl) or '-'}</td></tr>"
+                        for v in sorted(n.volumes, key=lambda v: v.id)
+                    )
+                    ecs = "".join(
+                        f"<tr><td>ec {e.id}</td><td>{esc(e.collection) or '-'}</td>"
+                        f"<td colspan=2>shards {[i for i in range(32) if e.shard_bits & (1 << i)]}</td>"
+                        f"<td colspan=4>{e.data_shards}+{e.parity_shards} gen {e.generation}</td></tr>"
+                        for e in sorted(n.ec_shards, key=lambda e: e.id)
+                    )
+                    rows.append(
+                        f"<h3>{esc(n.id)} <small>rack={esc(n.rack) or '-'} dc={esc(n.data_center) or '-'}"
+                        f" slots={n.max_volume_count}</small></h3>"
+                        f"<table border=1 cellpadding=4 cellspacing=0>"
+                        f"<tr><th>vol</th><th>coll</th><th>size</th><th>files</th>"
+                        f"<th>del</th><th>mode</th><th>rp</th><th>ttl</th></tr>"
+                        f"{vols}{ecs}</table>"
+                    )
+                body = (
+                    "<html><head><title>seaweed-tpu master</title></head><body>"
+                    f"<h1>seaweed-tpu cluster</h1>"
+                    f"<p>nodes: {stats.node_count} &middot; volumes: "
+                    f"{stats.volume_count} &middot; ec volumes: {stats.ec_volume_count}"
+                    f" &middot; files: {stats.file_count} &middot; used: "
+                    f"{stats.used_size:,} bytes &middot; max volume id: "
+                    f"{topo.max_volume_id}</p>"
+                    + "".join(rows)
+                    + "</body></html>"
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
             do_POST = do_GET
 

@@ -649,6 +649,88 @@ def fs_mkdir(env: ShellEnv, args) -> str:
     return "ok" if r.status_code == 201 else f"error: {r.text}"
 
 
+@command("fs.meta.save", "fs.meta.save /path -o meta.jsonl (export filer metadata)")
+def fs_meta_save(env: ShellEnv, args) -> str:
+    """Walk the filer tree and export entry metadata as NDJSON
+    (reference fs.meta.save)."""
+    import json as _json
+
+    from ..client.filer_client import FilerListingError, walk
+
+    p = argparse.ArgumentParser(prog="fs.meta.save")
+    p.add_argument("path", nargs="?", default="/")
+    p.add_argument("-o", required=True)
+    a = p.parse_args(args)
+    count = 0
+    try:
+        with open(a.o, "w") as out:
+            for e in walk(env.filer_addr, a.path, strict=True):
+                out.write(_json.dumps(e, separators=(",", ":")) + "\n")
+                count += 1
+    except FilerListingError as e:
+        return f"error: {e}"
+    return f"saved {count} entries -> {a.o}"
+
+
+@command("fs.meta.load", "fs.meta.load meta.jsonl (recreate dirs; files need data)")
+def fs_meta_load(env: ShellEnv, args) -> str:
+    """Recreate the directory skeleton from a fs.meta.save export.
+    (File content lives in volumes; restoring bytes is filer.sync /
+    volume restore territory.)"""
+    import json as _json
+
+    import requests as rq
+
+    p = argparse.ArgumentParser(prog="fs.meta.load")
+    p.add_argument("file")
+    a = p.parse_args(args)
+    dirs = files = failed = 0
+    with open(a.file) as f:
+        for line in f:
+            e = _json.loads(line)
+            if e["IsDirectory"]:
+                r = rq.post(
+                    _filer_url(env, e["FullPath"]) + "?mkdir=true", timeout=30
+                )
+                if r.status_code == 201:
+                    dirs += 1
+                else:
+                    failed += 1
+            else:
+                files += 1
+    out = f"recreated {dirs} directories ({files} file entries listed)"
+    if failed:
+        out += f"; {failed} FAILED"
+    return out
+
+
+@command("volume.check.disk", "compare replicas of each volume and report divergence")
+def volume_check_disk(env: ShellEnv, args) -> str:
+    """Cross-replica consistency check (reference volume.check.disk):
+    flags replicas whose file counts / sizes disagree."""
+    topo = env.master.topology()
+    holders: dict[int, list] = {}
+    for n in topo.nodes:
+        for v in n.volumes:
+            holders.setdefault(v.id, []).append((n.id, v))
+    lines = []
+    for vid, hs in sorted(holders.items()):
+        if len(hs) < 2:
+            continue
+        sizes = {h[1].size for h in hs}
+        counts = {h[1].file_count for h in hs}
+        dels = {h[1].deleted_count for h in hs}
+        if len(sizes) > 1 or len(counts) > 1 or len(dels) > 1:
+            detail = "; ".join(
+                f"{nid}: size={v.size} files={v.file_count} del={v.deleted_count}"
+                for nid, v in hs
+            )
+            lines.append(f"volume {vid} DIVERGED: {detail}")
+        else:
+            lines.append(f"volume {vid}: {len(hs)} replicas consistent")
+    return "\n".join(lines) or "no replicated volumes"
+
+
 @command("fs.mv", "fs.mv /src /dst")
 def fs_mv(env: ShellEnv, args) -> str:
     import requests as rq

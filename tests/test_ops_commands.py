@@ -169,6 +169,55 @@ def test_master_auto_vacuum(cluster):
         ops.close()
 
 
+def test_check_disk_and_meta_save(cluster, tmp_path):
+    master, vols = cluster
+    addr = f"localhost:{master.port}"
+    ops = Operations(addr)
+    env = ShellEnv(addr)
+    try:
+        fid = ops.upload(b"replicated", replication="001")
+        time.sleep(0.5)
+        out = run_command(env, "volume.check.disk")
+        assert "consistent" in out, out
+        # diverge one replica directly on disk state
+        vid = FileId.parse(fid).volume_id
+        holder = next(vs for vs in vols if vs.store.find_volume(vid))
+        from seaweedfs_tpu.storage.needle import Needle
+
+        holder.store.find_volume(vid).write_needle(
+            Needle(cookie=9, needle_id=999, data=b"phantom")
+        )
+        holder.notify_new_volume(vid)
+        wait_for(
+            lambda: len(
+                {
+                    n.volumes[vid].file_count
+                    for n in master.topo.nodes.values()
+                    if vid in n.volumes
+                }
+            )
+            > 1
+        )
+        out = run_command(env, "volume.check.disk")
+        assert "DIVERGED" in out, out
+    finally:
+        env.close()
+        ops.close()
+
+
+def test_admin_ui(cluster):
+    master, vols = cluster
+    ops = Operations(f"localhost:{master.port}")
+    try:
+        ops.upload(b"ui fodder")
+        r = requests.get(f"http://localhost:{master.port}/ui")
+        assert r.status_code == 200
+        assert "seaweed-tpu cluster" in r.text
+        assert "<table" in r.text
+    finally:
+        ops.close()
+
+
 def test_metrics_endpoints(cluster):
     master, vols = cluster
     ops = Operations(f"localhost:{master.port}")

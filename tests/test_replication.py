@@ -124,6 +124,37 @@ def test_meta_tail_endpoint(two_clusters):
     assert r2.json()["events"] == []
 
 
+def test_fs_meta_save_load(two_clusters, tmp_path):
+    from seaweedfs_tpu.shell.commands import ShellEnv, run_command
+
+    master0 = two_clusters[0][0]
+    fport = two_clusters[0][4]
+    base = f"http://localhost:{fport}"
+    requests.post(f"{base}/tree/a/file1.txt", data=b"one")
+    requests.post(f"{base}/tree/b/c/file2.txt", data=b"two")
+    env = ShellEnv(f"localhost:{master0.port}", filer=f"localhost:{fport}")
+    try:
+        out = run_command(env, f"fs.meta.save /tree -o {tmp_path}/meta.jsonl")
+        assert "saved 5 entries" in out, out  # a, b, c + 2 files
+        # missing path errors instead of claiming success
+        out = run_command(env, f"fs.meta.save /nope -o {tmp_path}/x.jsonl")
+        assert "error" in out
+        # load recreates the directory skeleton on the second cluster
+        fport2 = two_clusters[1][4]
+        env2 = ShellEnv(
+            f"localhost:{two_clusters[1][0].port}", filer=f"localhost:{fport2}"
+        )
+        try:
+            out = run_command(env2, f"fs.meta.load {tmp_path}/meta.jsonl")
+            assert "recreated 3 directories" in out, out
+            r = requests.get(f"http://localhost:{fport2}/tree/b/c")
+            assert r.headers.get("X-Filer-Listing") == "true"
+        finally:
+            env2.close()
+    finally:
+        env.close()
+
+
 def test_filer_sync_full_and_tail(two_clusters):
     src = two_clusters[0][4]
     dst = two_clusters[1][4]
