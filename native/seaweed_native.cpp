@@ -220,6 +220,90 @@ uint8_t sn_gf_mul(uint8_t a, uint8_t b) {
     return gf_mul_table[a][b];
 }
 
+// ---------------------------------------------------------------------------
+// Volume .dat scanner: sequential needle walk with CRC verification.
+// Mirrors seaweedfs_tpu/storage/volume_scan.py (v2/v3 record layout);
+// used by the offline `fix` tool and online scrub for large volumes.
+// ---------------------------------------------------------------------------
+
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <fcntl.h>
+#include <unistd.h>
+
+static inline uint32_t be32(const uint8_t* p) {
+    return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+           ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+}
+
+static inline uint64_t be64(const uint8_t* p) {
+    return ((uint64_t)be32(p) << 32) | be32(p + 4);
+}
+
+// Scan `path`; fill parallel arrays (ids, stored offsets in 8-byte units,
+// body sizes, crc flags). Returns the record count, -1 on open/format
+// error, -2 if max_entries is too small.
+int64_t sn_scan_dat(const char* path, uint64_t* ids, uint32_t* offsets,
+                    int32_t* sizes, uint8_t* crc_ok, int64_t max_entries) {
+    crc32c_table_init();
+    int fd = open(path, O_RDONLY);
+    if (fd < 0) return -1;
+    struct stat st;
+    if (fstat(fd, &st) != 0 || st.st_size < 8) {
+        close(fd);
+        return -1;
+    }
+    size_t size = (size_t)st.st_size;
+    const uint8_t* buf =
+        (const uint8_t*)mmap(nullptr, size, PROT_READ, MAP_PRIVATE, fd, 0);
+    close(fd);
+    if (buf == MAP_FAILED) return -1;
+
+    uint8_t version = buf[0];
+    if (version != 2 && version != 3) {  // not a known volume format
+        munmap((void*)buf, size);
+        return -1;
+    }
+    size_t footer = 4 + (version == 3 ? 8 : 0);
+    int64_t count = 0;
+    size_t off = 8;  // superblock
+    while (off + 16 <= size) {
+        uint64_t nid = be64(buf + off + 4);
+        uint32_t body = be32(buf + off + 12);
+        size_t rec = 16 + (size_t)body + footer;
+        rec = (rec + 7) & ~(size_t)7;  // 8-byte padding
+        if (off + rec > size) break;   // truncated tail
+        if (count >= max_entries) {
+            munmap((void*)buf, size);
+            return -2;
+        }
+        uint8_t ok = 1;
+        if (body > 0) {
+            // body = [dataSize(4) | data | flags(1) | ...]; CRC covers data
+            if (body >= 5) {
+                uint32_t data_size = be32(buf + off + 16);
+                if ((size_t)data_size + 5 <= body) {
+                    uint32_t crc = sn_crc32c(0, buf + off + 20, data_size);
+                    uint32_t stored = be32(buf + off + 16 + body);
+                    ok = (crc == stored) ? 1 : 0;
+                } else {
+                    ok = 0;  // corrupt dataSize
+                }
+            } else {
+                ok = 0;
+            }
+        }
+        ids[count] = nid;
+        offsets[count] = (uint32_t)(off / 8);
+        sizes[count] = (int32_t)body;
+        crc_ok[count] = ok;
+        count++;
+        off += rec;
+    }
+    munmap((void*)buf, size);
+    return count;
+}
+
 int sn_has_avx2() {
 #if defined(__x86_64__)
     return __builtin_cpu_supports("avx2") ? 1 : 0;

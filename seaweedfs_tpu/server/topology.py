@@ -212,17 +212,70 @@ class Topology:
         return random.choice(candidates)
 
     def plan_growth(self, replication: str) -> list[DataNode]:
-        """Pick target nodes for one new volume honoring the copy count
-        (placement constraints deepen with the topology tree)."""
-        copies = _replica_copies(replication)
+        """Pick target nodes for one new volume honoring the replica
+        placement code XYZ: X copies on other data centers, Y on other
+        racks (same DC), Z on other servers of the same rack (reference
+        findEmptySlotsForOneVolume, volume_growth.go:192)."""
+        from ..storage.super_block import ReplicaPlacement
+
+        try:
+            rp = ReplicaPlacement.parse(replication or "000")
+        except ValueError:
+            return []
+        x, y, z = rp.diff_data_centers, rp.diff_racks, rp.same_rack
+
+        def distinct(nodes, key, count):
+            """One node per distinct key — each diff-DC/diff-rack copy
+            must land on a DIFFERENT DC/rack. None = unsatisfiable."""
+            if count == 0:
+                return []
+            picked, seen = [], set()
+            for n in nodes:
+                if key(n) in seen:
+                    continue
+                seen.add(key(n))
+                picked.append(n)
+                if len(picked) == count:
+                    return picked
+            return None
+
         with self._lock:
             avail = sorted(
                 (n for n in self.nodes.values() if n.free_slots() > 0),
                 key=lambda n: -n.free_slots(),
             )
-            if len(avail) < copies:
+            if len(avail) < 1 + x + y + z:
                 return []
-            return avail[:copies]
+            for primary in avail:
+                rest = [n for n in avail if n is not primary]
+                same_rack = [
+                    n
+                    for n in rest
+                    if n.rack == primary.rack
+                    and n.data_center == primary.data_center
+                ]
+                other_rack = distinct(
+                    (
+                        n
+                        for n in rest
+                        if n.rack != primary.rack
+                        and n.data_center == primary.data_center
+                    ),
+                    key=lambda n: n.rack,
+                    count=y,
+                )
+                other_dc = distinct(
+                    (n for n in rest if n.data_center != primary.data_center),
+                    key=lambda n: n.data_center,
+                    count=x,
+                )
+                if (
+                    len(same_rack) >= z
+                    and other_rack is not None
+                    and other_dc is not None
+                ):
+                    return [primary] + same_rack[:z] + other_rack + other_dc
+            return []
 
     def garbage_candidates(self, threshold: float) -> list[tuple[int, str, int]]:
         """(vid, ip, grpc_port) of garbage-heavy writable volumes."""

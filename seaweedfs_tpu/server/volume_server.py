@@ -454,25 +454,40 @@ class VolumeService:
         v = self.store.find_volume(request.volume_id)
         if v is None:
             return pb.ScrubResponse(error="volume not found")
-        from ..storage.volume_scan import scan_volume_file
-
         v.flush()
         checked = 0
         bad: list[int] = []
-        _, items = scan_volume_file(v.dat_path)
-        from ..storage.types import actual_offset
+        try:  # native mmap scanner (~3x the Python walk)
+            from ..utils import native
 
-        for item in items:
-            if item.body_size <= 0:
+            ids, offs, sizes, ok = native.scan_dat(v.dat_path)
+            # iterate the arrays directly: no boxed-list copies of a
+            # potentially many-million-record volume
+            records = (
+                (int(a), int(b), int(c), bool(d))
+                for a, b, c, d in zip(ids, offs, sizes, ok)
+            )
+        except Exception:  # .so missing AND unbuildable included
+            records = None
+        if records is None:
+            from ..storage.volume_scan import scan_volume_file
+
+            _, items = scan_volume_file(v.dat_path)
+            records = (
+                (i.needle.needle_id, i.offset // 8, i.body_size, i.crc_ok)
+                for i in items
+            )
+        for nid, stored_off, body_size, crc_ok in records:
+            if body_size <= 0:
                 continue
-            nv = v.needle_map.get(item.needle.needle_id)
+            nv = v.needle_map.get(nid)
             if nv is None or nv.is_deleted:
                 continue  # dead record, vacuum's problem
-            if actual_offset(nv.offset) != item.offset:
+            if nv.offset != stored_off:
                 continue  # superseded copy; the live one is elsewhere
             checked += 1
-            if not item.crc_ok:
-                bad.append(item.needle.needle_id)
+            if not crc_ok:
+                bad.append(nid)
         return pb.ScrubResponse(checked=checked, bad_needles=bad)
 
     def ScrubEcVolume(self, request, context):

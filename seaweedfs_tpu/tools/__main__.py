@@ -15,7 +15,7 @@ import tarfile
 import io
 
 
-from ..storage.types import NeedleValue, to_stored_offset
+from ..storage.types import NeedleValue
 from ..storage.volume import Volume
 from ..storage.volume_scan import scan_volume_file
 
@@ -29,21 +29,33 @@ def cmd_fix(a) -> int:
     puts, empty-body appends are delete markers)."""
     base = _base(a)
     live: dict[int, NeedleValue] = {}
-    _, items = scan_volume_file(base + ".dat")
     records = 0
-    for item in items:
-        if not item.crc_ok:
-            print(f"skip needle {item.needle.needle_id:x} at {item.offset}: bad crc")
+    scan = None
+    try:  # native mmap scanner when available
+        from ..utils import native
+
+        ids, offs, sizes, ok = native.scan_dat(base + ".dat")
+        scan = (
+            (int(a), int(b), int(c), bool(d))
+            for a, b, c, d in zip(ids, offs, sizes, ok)
+        )
+    except Exception:  # .so missing AND unbuildable included
+        pass
+    if scan is None:
+        _, items = scan_volume_file(base + ".dat")
+        scan = (
+            (i.needle.needle_id, i.offset // 8, i.body_size, i.crc_ok)
+            for i in items
+        )
+    for nid, stored_off, body_size, crc_ok in scan:
+        if not crc_ok:
+            print(f"skip needle {nid:x} at {stored_off * 8}: bad crc")
             continue
         records += 1
-        if item.body_size > 0:
-            live[item.needle.needle_id] = NeedleValue(
-                item.needle.needle_id,
-                to_stored_offset(item.offset),
-                item.body_size,
-            )
+        if body_size > 0:
+            live[nid] = NeedleValue(nid, stored_off, body_size)
         else:
-            live.pop(item.needle.needle_id, None)  # delete marker
+            live.pop(nid, None)  # delete marker
     # .idx is a replayable journal; a minimal rebuild carries only the
     # surviving entries, ascending
     with open(base + ".idx.tmp", "wb") as f:

@@ -45,6 +45,15 @@ _lib.sn_rs_apply.argtypes = [
 _lib.sn_gf_mul.restype = ctypes.c_uint8
 _lib.sn_gf_mul.argtypes = [ctypes.c_uint8, ctypes.c_uint8]
 _lib.sn_has_avx2.restype = ctypes.c_int
+_lib.sn_scan_dat.restype = ctypes.c_int64
+_lib.sn_scan_dat.argtypes = [
+    ctypes.c_char_p,
+    ctypes.c_void_p,
+    ctypes.c_void_p,
+    ctypes.c_void_p,
+    ctypes.c_void_p,
+    ctypes.c_int64,
+]
 
 
 def crc32c(data, crc: int = 0) -> int:
@@ -81,3 +90,28 @@ def gf_mul(a: int, b: int) -> int:
 
 def has_avx2() -> bool:
     return bool(_lib.sn_has_avx2())
+
+
+def scan_dat(path: str):
+    """Fast .dat scan: -> (ids u64, offsets u32 [8-byte units],
+    body_sizes i32, crc_ok u8) parallel arrays, append order.
+    Raises OSError on unreadable/short files."""
+    import os
+
+    size = os.path.getsize(path)
+    max_entries = max(size // 24 + 2, 16)  # min padded record is 24 bytes (v2 tombstone)
+    ids = np.empty(max_entries, dtype=np.uint64)
+    offsets = np.empty(max_entries, dtype=np.uint32)
+    sizes = np.empty(max_entries, dtype=np.int32)
+    crc_ok = np.empty(max_entries, dtype=np.uint8)
+    n = _lib.sn_scan_dat(
+        path.encode(),
+        ids.ctypes.data_as(ctypes.c_void_p),
+        offsets.ctypes.data_as(ctypes.c_void_p),
+        sizes.ctypes.data_as(ctypes.c_void_p),
+        crc_ok.ctypes.data_as(ctypes.c_void_p),
+        max_entries,
+    )
+    if n < 0:
+        raise OSError(f"sn_scan_dat({path}) failed: {n}")
+    return ids[:n], offsets[:n], sizes[:n], crc_ok[:n].astype(bool)

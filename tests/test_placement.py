@@ -1,0 +1,121 @@
+"""Replica placement tests: XYZ honoring rack/DC labels (reference
+volume_growth findEmptySlotsForOneVolume)."""
+
+from seaweedfs_tpu.pb import cluster_pb2 as pb
+from seaweedfs_tpu.server.topology import DataNode, Topology, _replica_copies
+
+
+def node(nid, rack="r1", dc="dc1", slots=8):
+    return DataNode(
+        node_id=nid,
+        ip="h" + nid,
+        port=1,
+        public_url=nid,
+        grpc_port=2,
+        rack=rack,
+        data_center=dc,
+        max_volume_count=slots,
+    )
+
+
+def build(topo, nodes):
+    for n in nodes:
+        topo.nodes[n.node_id] = n
+
+
+def test_replica_copies():
+    assert _replica_copies("") == 1
+    assert _replica_copies("000") == 1
+    assert _replica_copies("001") == 2
+    assert _replica_copies("010") == 2
+    assert _replica_copies("110") == 3
+
+
+def test_same_rack_placement():
+    topo = Topology()
+    build(topo, [node("a"), node("b"), node("c", rack="r2")])
+    got = topo.plan_growth("001")  # 1 extra copy same rack
+    assert len(got) == 2
+    assert got[0].rack == got[1].rack
+
+
+def test_cross_rack_placement():
+    topo = Topology()
+    build(topo, [node("a"), node("b", rack="r2"), node("c", rack="r2")])
+    got = topo.plan_growth("010")  # 1 copy on another rack
+    assert len(got) == 2
+    assert got[0].rack != got[1].rack
+    assert got[0].data_center == got[1].data_center
+
+
+def test_cross_dc_placement():
+    topo = Topology()
+    build(
+        topo,
+        [node("a"), node("b", dc="dc2", rack="r9"), node("c")],
+    )
+    got = topo.plan_growth("100")
+    assert len(got) == 2
+    assert got[0].data_center != got[1].data_center
+
+
+def test_combined_placement():
+    topo = Topology()
+    build(
+        topo,
+        [
+            node("a", rack="r1", dc="dc1"),
+            node("b", rack="r1", dc="dc1"),
+            node("c", rack="r2", dc="dc1"),
+            node("d", rack="r3", dc="dc2"),
+        ],
+    )
+    got = topo.plan_growth("111")  # 1 other-DC, 1 other-rack, 1 same-rack
+    assert len(got) == 4
+    primary = got[0]
+    racks = [(n.data_center, n.rack) for n in got]
+    assert sum(1 for dcr in racks if dcr == (primary.data_center, primary.rack)) == 2
+    assert sum(1 for n in got if n.data_center != primary.data_center) == 1
+    assert sum(
+        1
+        for n in got
+        if n.data_center == primary.data_center and n.rack != primary.rack
+    ) == 1
+
+
+def test_unsatisfiable_placement():
+    topo = Topology()
+    build(topo, [node("a"), node("b")])  # one rack, one dc
+    assert topo.plan_growth("010") == []  # needs another rack
+    assert topo.plan_growth("100") == []  # needs another dc
+    assert len(topo.plan_growth("001")) == 2
+
+
+def test_full_nodes_excluded():
+    topo = Topology()
+    a, b = node("a"), node("b", slots=0)
+    build(topo, [a, b])
+    b.volumes[1] = pb.VolumeInfoMsg(id=1)
+    assert topo.plan_growth("001") == []
+    assert topo.plan_growth("") == [a]
+
+
+def test_multi_dc_copies_land_on_distinct_dcs():
+    """X>=2 requires each diff-DC copy on a DIFFERENT data center."""
+    topo = Topology()
+    build(
+        topo,
+        [
+            node("a", dc="dc1"),
+            node("b", dc="dc2"),
+            node("c", dc="dc2"),
+            node("d", dc="dc3"),
+        ],
+    )
+    got = topo.plan_growth("200")
+    assert len(got) == 3
+    assert len({n.data_center for n in got}) == 3
+    # only two DCs available for X=2 extras when dc3 is removed
+    topo2 = Topology()
+    build(topo2, [node("a", dc="dc1"), node("b", dc="dc2"), node("c", dc="dc2")])
+    assert topo2.plan_growth("200") == []
