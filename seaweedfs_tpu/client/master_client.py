@@ -114,6 +114,15 @@ class MasterClient:
             self._stub.CollectionList(pb.CollectionListRequest(), timeout=30).collections
         )
 
+    def collection_delete(self, name: str) -> list[int]:
+        """Drop every volume of a collection (fast bucket delete)."""
+        resp = self._stub.CollectionDelete(
+            pb.CollectionDeleteRequest(name=name), timeout=120
+        )
+        if resp.error:
+            raise RuntimeError(resp.error)
+        return list(resp.deleted_volume_ids)
+
     def close(self) -> None:
         self._channel.close()
 

@@ -206,6 +206,7 @@ class Filer:
         data: bytes,
         mime: str = "",
         mode: int = 0o644,
+        collection: str | None = None,
     ) -> Entry:
         """Slice into chunk_size pieces, assign+upload each, create the
         entry (reference uploadRequestToChunks)."""
@@ -223,7 +224,7 @@ class Filer:
             fid = self.ops.upload(
                 piece,
                 name=full_path.rsplit("/", 1)[-1],
-                collection=self.collection,
+                collection=self.collection if collection is None else collection,
                 replication=self.replication,
             )
             chunks.append(

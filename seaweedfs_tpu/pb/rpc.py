@@ -32,6 +32,7 @@ SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
         "Topology": (UNARY, pb.TopologyRequest, pb.TopologyResponse),
         "VolumeGrow": (UNARY, pb.VolumeGrowRequest, pb.VolumeGrowResponse),
         "CollectionList": (UNARY, pb.CollectionListRequest, pb.CollectionListResponse),
+        "CollectionDelete": (UNARY, pb.CollectionDeleteRequest, pb.CollectionDeleteResponse),
     },
     VOLUME_SERVICE: {
         "AllocateVolume": (UNARY, pb.AllocateVolumeRequest, pb.AllocateVolumeResponse),

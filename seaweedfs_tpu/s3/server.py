@@ -29,6 +29,13 @@ BUCKETS_ROOT = "/buckets"
 UPLOADS_DIR = ".uploads"
 XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
 
+import re as _re
+
+# S3 bucket naming (subset): 2-63 chars, lowercase/digits/dot/hyphen,
+# starting and ending alphanumeric — also satisfies the master's
+# collection-name rules
+_BUCKET_RE = _re.compile(r"^[a-z0-9][a-z0-9.\-]{0,61}[a-z0-9]$")
+
 
 def _xml(root: ET.Element) -> bytes:
     return b'<?xml version="1.0" encoding="UTF-8"?>' + ET.tostring(root)
@@ -220,6 +227,11 @@ class S3Server:
                 path = f"{BUCKETS_ROOT}/{bucket}"
                 m = self.command
                 if m == "PUT":
+                    # bucket names double as volume collections: enforce
+                    # S3 naming up front so object uploads can't fail on
+                    # the master's collection validation later
+                    if not _BUCKET_RE.match(bucket):
+                        return self._error(400, "InvalidBucketName", bucket)
                     if srv.filer.exists(path):
                         return self._error(
                             409, "BucketAlreadyExists", bucket
@@ -239,6 +251,12 @@ class S3Server:
                     if children:
                         return self._error(409, "BucketNotEmpty", bucket)
                     srv.filer.delete_entry(path, recursive=True)
+                    # fast space reclaim: drop the bucket's collection
+                    # volumes cluster-wide (reference bucket=collection)
+                    try:
+                        srv.filer.ops.master.collection_delete(bucket)
+                    except Exception:
+                        pass
                     return self._respond(204)
                 if m == "POST" and "delete" in q:
                     return self._delete_objects(bucket)
@@ -355,6 +373,7 @@ class S3Server:
                         data,
                         mime=self.headers.get("Content-Type", "")
                         or "application/octet-stream",
+                        collection=bucket,
                     )
                     etag = entry.attr.md5.hex()
                     return self._respond(200, extra={"ETag": f'"{etag}"'})
@@ -416,6 +435,7 @@ class S3Server:
                     normalize_path(f"{BUCKETS_ROOT}/{bucket}/{key}"),
                     data,
                     mime=entry.attr.mime,
+                    collection=bucket,
                 )
                 root = ET.Element("CopyObjectResult", xmlns=XMLNS)
                 _el(root, "ETag", f'"{dst.attr.md5.hex()}"')
@@ -452,7 +472,9 @@ class S3Server:
                     return self._error(404, "NoSuchUpload", upload_id)
                 data = self._read_body()
                 entry = srv.filer.write_file(
-                    f"{srv._upload_dir(bucket, upload_id)}/{part:05d}.part", data
+                    f"{srv._upload_dir(bucket, upload_id)}/{part:05d}.part",
+                    data,
+                    collection=bucket,
                 )
                 self._respond(200, extra={"ETag": f'"{entry.attr.md5.hex()}"'})
 

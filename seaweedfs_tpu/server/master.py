@@ -198,6 +198,54 @@ class MasterService:
     def CollectionList(self, request, context) -> pb.CollectionListResponse:
         return pb.CollectionListResponse(collections=self.topo.collections())
 
+    def CollectionDelete(self, request, context) -> pb.CollectionDeleteResponse:
+        """Drop every volume AND EC shard set of a collection
+        cluster-wide — the fast bucket-delete path (reference
+        CollectionDelete: reclaims space without per-object tombstones).
+        Partial failures are reported, not swallowed: a skipped node's
+        volumes would resurrect on its next heartbeat."""
+        if not request.name:
+            return pb.CollectionDeleteResponse(
+                error="refusing to delete the default collection"
+            )
+        deleted = []
+        failures = []
+        for vid, ip, gport in self.topo.collection_volumes(request.name):
+            try:
+                with grpc.insecure_channel(f"{ip}:{gport}") as ch:
+                    r = rpc.volume_stub(ch).VolumeDelete(
+                        pb.VolumeCommandRequest(volume_id=vid), timeout=60
+                    )
+                if r.error:
+                    failures.append(f"volume {vid}@{ip}: {r.error}")
+                else:
+                    deleted.append(vid)
+            except grpc.RpcError as e:
+                failures.append(f"volume {vid}@{ip}: {e.code().name}")
+        for vid, ip, gport, sids in self.topo.collection_ec_shards(request.name):
+            try:
+                with grpc.insecure_channel(f"{ip}:{gport}") as ch:
+                    stub = rpc.volume_stub(ch)
+                    stub.VolumeEcShardsUnmount(
+                        pb.EcShardsUnmountRequest(volume_id=vid, shard_ids=sids),
+                        timeout=60,
+                    )
+                    stub.VolumeEcShardsDelete(
+                        pb.EcShardsDeleteRequest(
+                            volume_id=vid,
+                            collection=request.name,
+                            shard_ids=sids,
+                        ),
+                        timeout=60,
+                    )
+                deleted.append(vid)
+            except grpc.RpcError as e:
+                failures.append(f"ec {vid}@{ip}: {e.code().name}")
+        return pb.CollectionDeleteResponse(
+            deleted_volume_ids=sorted(set(deleted)),
+            error="; ".join(failures),
+        )
+
 
 class MasterServer:
     """gRPC + HTTP front for one Topology."""

@@ -277,6 +277,32 @@ class Topology:
                     return [primary] + same_rack[:z] + other_rack + other_dc
             return []
 
+    def collection_volumes(self, name: str) -> list[tuple[int, str, int]]:
+        """(vid, ip, grpc_port) of every normal volume in a collection."""
+        with self._lock:
+            return [
+                (v.id, n.ip, n.grpc_port)
+                for n in self.nodes.values()
+                for v in n.volumes.values()
+                if v.collection == name
+            ]
+
+    def collection_ec_shards(self, name: str) -> list[tuple[int, str, int, list[int]]]:
+        """(vid, ip, grpc_port, shard_ids) per holder for EC volumes of
+        a collection."""
+        with self._lock:
+            return [
+                (
+                    e.id,
+                    n.ip,
+                    n.grpc_port,
+                    [i for i in range(32) if e.shard_bits & (1 << i)],
+                )
+                for n in self.nodes.values()
+                for e in n.ec_shards.values()
+                if e.collection == name
+            ]
+
     def garbage_candidates(self, threshold: float) -> list[tuple[int, str, int]]:
         """(vid, ip, grpc_port) of garbage-heavy writable volumes."""
         with self._lock:

@@ -587,6 +587,15 @@ def collection_list(env: ShellEnv, args) -> str:
     return "\n".join(env.master.collections()) or "(none)"
 
 
+@command("collection.delete", "-collection name (drop all its volumes)")
+def collection_delete(env: ShellEnv, args) -> str:
+    p = argparse.ArgumentParser(prog="collection.delete")
+    p.add_argument("-collection", required=True)
+    a = p.parse_args(args)
+    vids = env.master.collection_delete(a.collection)
+    return f"deleted collection {a.collection!r}: volumes {vids}"
+
+
 # ---------------------------------------------------------------------- fs
 
 

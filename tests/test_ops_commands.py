@@ -176,9 +176,11 @@ def test_check_disk_and_meta_save(cluster, tmp_path):
     env = ShellEnv(addr)
     try:
         fid = ops.upload(b"replicated", replication="001")
-        time.sleep(0.5)
-        out = run_command(env, "volume.check.disk")
-        assert "consistent" in out, out
+        # replica stats converge via heartbeats; poll until consistent
+        wait_for(
+            lambda: "consistent" in run_command(env, "volume.check.disk"),
+            msg="replicas should converge to consistent",
+        )
         # diverge one replica directly on disk state
         vid = FileId.parse(fid).volume_id
         holder = next(vs for vs in vols if vs.store.find_volume(vid))

@@ -167,6 +167,29 @@ def test_multipart_upload(s3):
     assert xml_find_all(r.text, "Key") == ["large.bin"]
 
 
+def test_bucket_collection_lifecycle(s3, cluster):
+    """Objects land in a per-bucket collection; deleting the bucket
+    drops the collection's volumes cluster-wide (reference bucket
+    fast-delete)."""
+    import grpc as grpc_mod
+
+    from seaweedfs_tpu.client.master_client import MasterClient
+
+    requests.put(f"{s3}/colbkt")
+    requests.put(f"{s3}/colbkt/obj1", data=b"x" * 50_000)
+    mc = MasterClient(f"localhost:{cluster}")
+    try:
+        assert "colbkt" in mc.collections()
+        requests.delete(f"{s3}/colbkt/obj1")
+        assert requests.delete(f"{s3}/colbkt").status_code == 204
+        deadline = time.time() + 10
+        while "colbkt" in mc.collections():
+            assert time.time() < deadline, "collection volumes should be reaped"
+            time.sleep(0.2)
+    finally:
+        mc.close()
+
+
 def test_multipart_abort(s3):
     requests.put(f"{s3}/ab")
     r = requests.post(f"{s3}/ab/x?uploads")
