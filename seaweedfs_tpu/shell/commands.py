@@ -73,6 +73,18 @@ def _volume_stub(loc: pb.Location):
     return ch, rpc.volume_stub(ch)
 
 
+def _volume_holders(topo):
+    """{vid: [DataNodeInfo...]}, {vid: (collection, replica_placement)} —
+    the shared input for replication checks/repair."""
+    holders: dict[int, list] = {}
+    meta: dict[int, tuple] = {}
+    for n in topo.nodes:
+        for v in n.volumes:
+            holders.setdefault(v.id, []).append(n)
+            meta[v.id] = (v.collection, v.replica_placement)
+    return holders, meta
+
+
 # ----------------------------------------------------------------- cluster
 
 
@@ -180,60 +192,134 @@ def volume_mark(env: ShellEnv, args) -> str:
 # ---------------------------------------------------------------------- ec
 
 
-@command("ec.encode", "-volumeId N [-collection c] [-backend cpu|tpu|auto] [-keepSource]")
+@command(
+    "ec.encode",
+    "-volumeId N[,N2,...] [-collection c] [-backend cpu|tpu|auto] "
+    "[-keepSource] [-maxParallelization P]",
+)
 def ec_encode(env: ShellEnv, args) -> str:
     """Reference doEcEncode (command_ec_encode.go:346): mark replicas
     readonly -> generate shards on one holder -> mount -> delete the
-    source volume replicas (unless -keepSource)."""
+    source volume replicas (unless -keepSource). Multiple volumes encode
+    concurrently (the reference's -maxParallelization batches)."""
     p = argparse.ArgumentParser(prog="ec.encode")
-    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-volumeId", required=True, help="id or comma-separated ids")
     p.add_argument("-collection", default="")
     p.add_argument("-backend", default="auto")
     p.add_argument("-keepSource", action="store_true")
+    p.add_argument("-maxParallelization", type=int, default=4)
     a = p.parse_args(args)
+    try:
+        vids = [int(v) for v in a.volumeId.split(",") if v.strip()]
+    except ValueError:
+        return f"error: -volumeId wants an id or comma-separated ids, got {a.volumeId!r}"
+    # resolve each volume's collection from the topology: EC artifact
+    # paths are collection-prefixed on disk
+    topo = env.master.topology()
+    vol_collection = {
+        v.id: v.collection for n in topo.nodes for v in n.volumes
+    }
 
-    locs = env.master.lookup(a.volumeId, refresh=True)
-    if not locs:
-        return f"volume {a.volumeId} not found"
-    # 1. mark every replica readonly
-    for loc in locs:
-        ch, stub = _volume_stub(loc)
-        with ch:
-            stub.VolumeMarkReadonly(
-                pb.VolumeCommandRequest(volume_id=a.volumeId), timeout=30
-            )
-    # 2. generate on the first holder
-    gen_loc = locs[0]
-    ch, stub = _volume_stub(gen_loc)
-    with ch:
-        r = stub.VolumeEcShardsGenerate(
-            pb.EcShardsGenerateRequest(
-                volume_id=a.volumeId,
-                collection=a.collection,
-                backend=a.backend,
-            ),
-            timeout=3600,
-        )
-        generation = r.generation
-        # 3. mount all shards there
-        stub.VolumeEcShardsMount(
-            pb.EcShardsMountRequest(
-                volume_id=a.volumeId, collection=a.collection
-            ),
-            timeout=60,
-        )
-    # 4. delete source volume replicas
-    if not a.keepSource:
-        for loc in locs:
+    def encode_one(vid: int) -> str:
+        # one failing volume must not discard the batch's other results:
+        # destructive steps (readonly-mark, source delete) already ran
+        # for volumes that succeeded
+        try:
+            return _encode_one(vid)
+        except grpc.RpcError as e:
+            return f"volume {vid}: error: {e.code().name}: {e.details()}"
+        except (LookupError, RuntimeError, OSError) as e:
+            return f"volume {vid}: error: {e}"
+
+    def _encode_one(vid: int) -> str:
+        collection = a.collection or vol_collection.get(vid, "")
+        locs = env.master.lookup(vid, refresh=True)
+        if not locs:
+            return f"volume {vid}: not found"
+        for loc in locs:  # 1. freeze every replica
             ch, stub = _volume_stub(loc)
             with ch:
-                stub.VolumeDelete(
-                    pb.VolumeCommandRequest(volume_id=a.volumeId), timeout=60
+                stub.VolumeMarkReadonly(
+                    pb.VolumeCommandRequest(volume_id=vid), timeout=30
                 )
-    return (
-        f"ec.encode volume {a.volumeId}: generation {generation} on "
-        f"{gen_loc.url}{' (source kept)' if a.keepSource else ''}"
-    )
+        gen_loc = locs[0]
+        ch, stub = _volume_stub(gen_loc)
+        with ch:  # 2. generate + 3. mount on the first holder
+            r = stub.VolumeEcShardsGenerate(
+                pb.EcShardsGenerateRequest(
+                    volume_id=vid, collection=collection, backend=a.backend
+                ),
+                timeout=3600,
+            )
+            generation = r.generation
+            stub.VolumeEcShardsMount(
+                pb.EcShardsMountRequest(volume_id=vid, collection=collection),
+                timeout=60,
+            )
+        if not a.keepSource:  # 4. drop source replicas
+            for loc in locs:
+                ch, stub = _volume_stub(loc)
+                with ch:
+                    stub.VolumeDelete(
+                        pb.VolumeCommandRequest(volume_id=vid), timeout=60
+                    )
+        return (
+            f"volume {vid}: generation {generation} on {gen_loc.url}"
+            f"{' (source kept)' if a.keepSource else ''}"
+        )
+
+    if len(vids) == 1:
+        return "ec.encode " + encode_one(vids[0])
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=max(a.maxParallelization, 1)) as ex:
+        results = list(ex.map(encode_one, vids))
+    return "ec.encode\n" + "\n".join(results)
+
+
+@command("ec.check.replication", "verify every EC volume has a full shard set")
+def ec_check_replication(env: ShellEnv, args) -> str:
+    topo = env.master.topology()
+    by_vid: dict[int, tuple[set, int]] = {}
+    for n in topo.nodes:
+        for e in n.ec_shards:
+            sids, total = by_vid.get(e.id, (set(), 0))
+            sids = sids | {i for i in range(32) if e.shard_bits & (1 << i)}
+            by_vid[e.id] = (sids, e.data_shards + e.parity_shards or 14)
+    lines = []
+    for vid, (sids, total) in sorted(by_vid.items()):
+        missing = sorted(set(range(total)) - sids)
+        if missing:
+            lines.append(f"ec volume {vid}: MISSING shards {missing} (run ec.rebuild)")
+        else:
+            lines.append(f"ec volume {vid}: all {total} shards present")
+    return "\n".join(lines) or "no EC volumes"
+
+
+@command("cluster.check", "cluster health summary")
+def cluster_check(env: ShellEnv, args) -> str:
+    topo = env.master.topology()
+    stats = env.master.statistics()
+    lines = [
+        f"nodes: {stats.node_count}",
+        f"volumes: {stats.volume_count} ({stats.file_count} files, "
+        f"{stats.used_size:,} bytes)",
+        f"ec volumes: {stats.ec_volume_count}",
+    ]
+    problems = []
+    if stats.node_count == 0:
+        problems.append("no volume servers registered")
+    from ..server.topology import _replica_copies
+
+    holders, meta = _volume_holders(topo)
+    for vid, hs in sorted(holders.items()):
+        want = _replica_copies(meta[vid][1])
+        if len(hs) < want:
+            problems.append(
+                f"volume {vid} under-replicated: {len(hs)}/{want} copies"
+            )
+    lines += [f"PROBLEM: {x}" for x in problems] or ["all checks passed"]
+    return "\n".join(lines)
 
 
 @command("ec.rebuild", "-volumeId N [-collection c] [-backend cpu|tpu|auto]")
@@ -397,12 +483,7 @@ def volume_fix_replication(env: ShellEnv, args) -> str:
     p.add_argument("-collection", default="")
     a = p.parse_args(args)
     topo = env.master.topology()
-    holders: dict[int, list] = {}
-    meta: dict[int, tuple] = {}
-    for n in topo.nodes:
-        for v in n.volumes:
-            holders.setdefault(v.id, []).append(n)
-            meta[v.id] = (v.collection, v.replica_placement)
+    holders, meta = _volume_holders(topo)
     from ..server.topology import _replica_copies
 
     fixed = []

@@ -32,3 +32,36 @@ def rng():
     import numpy as np
 
     return np.random.default_rng(0x5EAD)
+
+
+# ---------------------------------------------------------------- ports
+
+_issued_ports: set[int] = set()
+
+
+def allocate_port() -> int:
+    """Ephemeral port that avoids previously issued ports AND their
+    +10000 shadows (servers bind grpc on port+10000)."""
+    import socket as _socket
+
+    while True:
+        with _socket.socket() as s:
+            s.bind(("localhost", 0))
+            p = s.getsockname()[1]
+        if p + 10000 > 65535:
+            continue  # grpc shadow would not be bindable
+        if (
+            p in _issued_ports
+            or (p + 10000) in _issued_ports
+            or (p - 10000) in _issued_ports
+        ):
+            continue
+        # the shadow must actually be free right now too
+        try:
+            with _socket.socket() as s2:
+                s2.bind(("localhost", p + 10000))
+        except OSError:
+            continue
+        _issued_ports.add(p)
+        _issued_ports.add(p + 10000)
+        return p

@@ -7,7 +7,6 @@ protocols, no subprocess overhead. Process-spawned tests live in
 test_cluster_spawn.py.
 """
 
-import socket
 import time
 
 import numpy as np
@@ -21,10 +20,7 @@ from seaweedfs_tpu.shell.commands import ShellEnv, run_command
 from seaweedfs_tpu.storage.file_id import FileId
 
 
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
+from conftest import allocate_port as free_port
 
 
 @pytest.fixture

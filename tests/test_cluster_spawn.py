@@ -5,7 +5,6 @@ binaries on ephemeral ports, driven over HTTP + the shell CLI
 
 import os
 import signal
-import socket
 import subprocess
 import sys
 import time
@@ -16,10 +15,7 @@ import requests
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
+from conftest import allocate_port as free_port
 
 
 @pytest.fixture

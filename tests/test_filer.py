@@ -5,7 +5,6 @@ Reference models: weed/filer/filechunks_test.go (overlap resolution),
 filer store suites, filer_server handler tests.
 """
 
-import socket
 import time
 
 import pytest
@@ -28,10 +27,7 @@ from seaweedfs_tpu.server.master import MasterServer
 from seaweedfs_tpu.server.volume_server import VolumeServer
 
 
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
+from conftest import allocate_port as free_port
 
 
 # ------------------------------------------------------------------ stores

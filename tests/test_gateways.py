@@ -1,7 +1,6 @@
 """WebDAV gateway + filer notification + benchmark CLI tests."""
 
 import json
-import socket
 import threading
 import time
 import xml.etree.ElementTree as ET
@@ -17,10 +16,7 @@ from seaweedfs_tpu.server.volume_server import VolumeServer
 from seaweedfs_tpu.server.webdav_server import WebDavServer
 
 
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
+from conftest import allocate_port as free_port
 
 
 @pytest.fixture(scope="module")

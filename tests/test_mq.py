@@ -3,7 +3,6 @@
 Reference models: weed/mq broker pub/sub suites and log_buffer tests.
 """
 
-import socket
 import threading
 import time
 
@@ -13,10 +12,7 @@ from seaweedfs_tpu.mq import MqBrokerServer, MqClient, PartitionLog
 from seaweedfs_tpu.mq.log_buffer import decode_records, encode_record
 
 
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
+from conftest import allocate_port as free_port
 
 
 # ---------------------------------------------------------------- log unit

@@ -1,7 +1,6 @@
 """Ops-plane tests: volume.move, volume.fix.replication, ec.balance,
 /metrics endpoints (reference shell command tests + stats)."""
 
-import socket
 import time
 
 import numpy as np
@@ -15,10 +14,7 @@ from seaweedfs_tpu.shell.commands import ShellEnv, run_command
 from seaweedfs_tpu.storage.file_id import FileId
 
 
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
+from conftest import allocate_port as free_port
 
 
 @pytest.fixture
@@ -202,6 +198,47 @@ def test_check_disk_and_meta_save(cluster, tmp_path):
         )
         out = run_command(env, "volume.check.disk")
         assert "DIVERGED" in out, out
+    finally:
+        env.close()
+        ops.close()
+
+
+def test_batched_ec_encode_and_checks(cluster):
+    master, vols = cluster
+    addr = f"localhost:{master.port}"
+    ops = Operations(addr)
+    env = ShellEnv(addr)
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    try:
+        blobs = {}
+        # force several volumes via distinct collections
+        for col in ("alpha", "beta", "gamma"):
+            for _ in range(4):
+                d = rng.integers(0, 256, 30_000, np.uint8).tobytes()
+                blobs[ops.upload(d, collection=col)] = d
+        vids = sorted({FileId.parse(f).volume_id for f in blobs})
+        assert len(vids) >= 3
+        out = run_command(
+            env,
+            "ec.encode -volumeId "
+            + ",".join(map(str, vids))
+            + " -backend cpu -maxParallelization 3",
+        )
+        assert out.count("generation") == len(vids), out
+        wait_for(
+            lambda: all(
+                any(v in n.ec_shards for n in master.topo.nodes.values())
+                for v in vids
+            )
+        )
+        for fid, d in blobs.items():
+            assert ops.read(fid) == d
+        out = run_command(env, "ec.check.replication")
+        assert out.count("all 14 shards present") == len(vids), out
+        out = run_command(env, "cluster.check")
+        assert "all checks passed" in out, out
     finally:
         env.close()
         ops.close()

@@ -2,7 +2,6 @@
 (reference filer meta log / SubscribeMetadata / filer.sync)."""
 
 import json
-import socket
 import threading
 import time
 
@@ -18,10 +17,7 @@ from seaweedfs_tpu.server.master import MasterServer
 from seaweedfs_tpu.server.volume_server import VolumeServer
 
 
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
+from conftest import allocate_port as free_port
 
 
 def make_event(directory: str, name: str, ts_ns: int) -> fpb.FullEventNotification:

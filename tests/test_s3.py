@@ -8,7 +8,6 @@ doubles as an independent check of the server's signing math).
 import datetime
 import hashlib
 import hmac
-import socket
 import time
 import urllib.parse
 import xml.etree.ElementTree as ET
@@ -22,10 +21,7 @@ from seaweedfs_tpu.server.master import MasterServer
 from seaweedfs_tpu.server.volume_server import VolumeServer
 
 
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
+from conftest import allocate_port as free_port
 
 
 @pytest.fixture(scope="module")

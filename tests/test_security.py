@@ -1,7 +1,6 @@
 """JWT write-authorization tests (reference weed/security/jwt.go +
 volume_server_handlers_write.go maybeCheckJwtAuthorization)."""
 
-import socket
 import time
 
 import pytest
@@ -13,10 +12,7 @@ from seaweedfs_tpu.server.volume_server import VolumeServer
 from seaweedfs_tpu.utils.security import JwtError, sign_jwt, verify_jwt
 
 
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
+from conftest import allocate_port as free_port
 
 
 def test_jwt_roundtrip():

@@ -3,7 +3,6 @@ volume_grpc_scrub)."""
 
 import io
 import os
-import socket
 import tarfile
 import time
 
@@ -85,10 +84,7 @@ def test_scrub_rpcs(tmp_path):
     from seaweedfs_tpu.shell.commands import ShellEnv, run_command
     from seaweedfs_tpu.storage.file_id import FileId
 
-    def free_port():
-        with socket.socket() as s:
-            s.bind(("localhost", 0))
-            return s.getsockname()[1]
+    from conftest import allocate_port as free_port
 
     mport = free_port()
     master = MasterServer(ip="localhost", port=mport)

@@ -2,7 +2,6 @@
 (reference weed/storage/needle/volume_ttl.go + TTL volume reaping)."""
 
 import os
-import socket
 import time
 
 import pytest
@@ -68,10 +67,7 @@ def test_ttl_bucketed_assignment(tmp_path):
     from seaweedfs_tpu.server.volume_server import VolumeServer
     from seaweedfs_tpu.storage.file_id import FileId
 
-    def free_port():
-        with socket.socket() as s:
-            s.bind(("localhost", 0))
-            return s.getsockname()[1]
+    from conftest import allocate_port as free_port
 
     mport = free_port()
     master = MasterServer(ip="localhost", port=mport)
