@@ -1,0 +1,1 @@
+"""FUSE mount gateway (layer 6): the filer namespace as a local filesystem."""

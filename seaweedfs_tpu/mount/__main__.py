@@ -1,0 +1,22 @@
+"""`python -m seaweedfs_tpu.mount -filer host:8888 -dir /mnt/weed`
+(reference `weed mount`). Foreground; unmount with fusermount -u."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="seaweedfs_tpu.mount")
+    p.add_argument("-filer", default="localhost:8888")
+    p.add_argument("-dir", required=True, help="mountpoint")
+    a = p.parse_args(argv)
+    from .weed_mount import run_mount
+
+    print(f"mounting filer {a.filer} at {a.dir}", flush=True)
+    return run_mount(a.filer, a.dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

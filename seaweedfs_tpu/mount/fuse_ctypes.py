@@ -1,0 +1,257 @@
+"""ctypes binding to libfuse 2.9 (high-level API).
+
+The image ships libfuse.so.2 but no Python binding, so this declares the
+FUSE 2.9 ABI surface directly: struct stat (x86_64 glibc layout),
+fuse_file_info, fuse_operations, and fuse_main_real. Only the operation
+slots the mount uses are populated; the rest stay NULL.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+
+_libfuse_path = (
+    ctypes.util.find_library("fuse") or "/usr/lib/x86_64-linux-gnu/libfuse.so.2"
+)
+libfuse = ctypes.CDLL(_libfuse_path)
+
+
+class Timespec(ctypes.Structure):
+    _fields_ = [("tv_sec", ctypes.c_long), ("tv_nsec", ctypes.c_long)]
+
+
+class Stat(ctypes.Structure):
+    """x86_64 glibc struct stat."""
+
+    _fields_ = [
+        ("st_dev", ctypes.c_uint64),
+        ("st_ino", ctypes.c_uint64),
+        ("st_nlink", ctypes.c_uint64),
+        ("st_mode", ctypes.c_uint32),
+        ("st_uid", ctypes.c_uint32),
+        ("st_gid", ctypes.c_uint32),
+        ("__pad0", ctypes.c_uint32),
+        ("st_rdev", ctypes.c_uint64),
+        ("st_size", ctypes.c_int64),
+        ("st_blksize", ctypes.c_int64),
+        ("st_blocks", ctypes.c_int64),
+        ("st_atim", Timespec),
+        ("st_mtim", Timespec),
+        ("st_ctim", Timespec),
+        ("__reserved", ctypes.c_int64 * 3),
+    ]
+
+
+class StatVfs(ctypes.Structure):
+    _fields_ = [
+        ("f_bsize", ctypes.c_ulong),
+        ("f_frsize", ctypes.c_ulong),
+        ("f_blocks", ctypes.c_uint64),
+        ("f_bfree", ctypes.c_uint64),
+        ("f_bavail", ctypes.c_uint64),
+        ("f_files", ctypes.c_uint64),
+        ("f_ffree", ctypes.c_uint64),
+        ("f_favail", ctypes.c_uint64),
+        ("f_fsid", ctypes.c_ulong),
+        ("f_flag", ctypes.c_ulong),
+        ("f_namemax", ctypes.c_ulong),
+        ("__spare", ctypes.c_int * 6),
+    ]
+
+
+class FuseFileInfo(ctypes.Structure):
+    _fields_ = [
+        ("flags", ctypes.c_int),
+        ("fh_old", ctypes.c_ulong),
+        ("writepage", ctypes.c_int),
+        ("flags_bits", ctypes.c_uint),
+        ("fh", ctypes.c_uint64),
+        ("lock_owner", ctypes.c_uint64),
+    ]
+
+
+# callback types
+GetattrT = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(Stat)
+)
+ReadlinkT = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t
+)
+MknodT = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint64
+)
+MkdirT = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, ctypes.c_uint32)
+PathT = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p)
+TwoPathT = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p)
+ChmodT = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, ctypes.c_uint32)
+ChownT = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint32
+)
+TruncateT = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, ctypes.c_int64)
+UtimeT = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, ctypes.c_void_p)
+OpenT = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(FuseFileInfo)
+)
+ReadT = ctypes.CFUNCTYPE(
+    ctypes.c_int,
+    ctypes.c_char_p,
+    ctypes.POINTER(ctypes.c_char),
+    ctypes.c_size_t,
+    ctypes.c_int64,
+    ctypes.POINTER(FuseFileInfo),
+)
+WriteT = ctypes.CFUNCTYPE(
+    ctypes.c_int,
+    ctypes.c_char_p,
+    ctypes.POINTER(ctypes.c_char),
+    ctypes.c_size_t,
+    ctypes.c_int64,
+    ctypes.POINTER(FuseFileInfo),
+)
+StatfsT = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(StatVfs)
+)
+FsyncT = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(FuseFileInfo)
+)
+SetxattrT = ctypes.CFUNCTYPE(
+    ctypes.c_int,
+    ctypes.c_char_p,
+    ctypes.c_char_p,
+    ctypes.c_char_p,
+    ctypes.c_size_t,
+    ctypes.c_int,
+)
+GetxattrT = ctypes.CFUNCTYPE(
+    ctypes.c_int,
+    ctypes.c_char_p,
+    ctypes.c_char_p,
+    ctypes.c_char_p,
+    ctypes.c_size_t,
+)
+ListxattrT = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t
+)
+FillDirT = ctypes.CFUNCTYPE(
+    ctypes.c_int,
+    ctypes.c_void_p,
+    ctypes.c_char_p,
+    ctypes.POINTER(Stat),
+    ctypes.c_int64,
+)
+ReaddirT = ctypes.CFUNCTYPE(
+    ctypes.c_int,
+    ctypes.c_char_p,
+    ctypes.c_void_p,
+    FillDirT,
+    ctypes.c_int64,
+    ctypes.POINTER(FuseFileInfo),
+)
+InitT = ctypes.CFUNCTYPE(ctypes.c_void_p, ctypes.c_void_p)
+DestroyT = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+AccessT = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, ctypes.c_int)
+CreateT = ctypes.CFUNCTYPE(
+    ctypes.c_int,
+    ctypes.c_char_p,
+    ctypes.c_uint32,
+    ctypes.POINTER(FuseFileInfo),
+)
+FtruncateT = ctypes.CFUNCTYPE(
+    ctypes.c_int,
+    ctypes.c_char_p,
+    ctypes.c_int64,
+    ctypes.POINTER(FuseFileInfo),
+)
+FgetattrT = ctypes.CFUNCTYPE(
+    ctypes.c_int,
+    ctypes.c_char_p,
+    ctypes.POINTER(Stat),
+    ctypes.POINTER(FuseFileInfo),
+)
+LockT = ctypes.CFUNCTYPE(
+    ctypes.c_int,
+    ctypes.c_char_p,
+    ctypes.POINTER(FuseFileInfo),
+    ctypes.c_int,
+    ctypes.c_void_p,
+)
+UtimensT = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(Timespec)
+)
+BmapT = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint64)
+)
+
+
+class FuseOperations(ctypes.Structure):
+    """struct fuse_operations, FUSE 2.9 ABI order."""
+
+    _fields_ = [
+        ("getattr", GetattrT),
+        ("readlink", ReadlinkT),
+        ("getdir", ctypes.c_void_p),  # deprecated
+        ("mknod", MknodT),
+        ("mkdir", MkdirT),
+        ("unlink", PathT),
+        ("rmdir", PathT),
+        ("symlink", TwoPathT),
+        ("rename", TwoPathT),
+        ("link", TwoPathT),
+        ("chmod", ChmodT),
+        ("chown", ChownT),
+        ("truncate", TruncateT),
+        ("utime", UtimeT),
+        ("open", OpenT),
+        ("read", ReadT),
+        ("write", WriteT),
+        ("statfs", StatfsT),
+        ("flush", OpenT),
+        ("release", OpenT),
+        ("fsync", FsyncT),
+        ("setxattr", SetxattrT),
+        ("getxattr", GetxattrT),
+        ("listxattr", ListxattrT),
+        ("removexattr", TwoPathT),
+        ("opendir", OpenT),
+        ("readdir", ReaddirT),
+        ("releasedir", OpenT),
+        ("fsyncdir", FsyncT),
+        ("init", InitT),
+        ("destroy", DestroyT),
+        ("access", AccessT),
+        ("create", CreateT),
+        ("ftruncate", FtruncateT),
+        ("fgetattr", FgetattrT),
+        ("lock", LockT),
+        ("utimens", UtimensT),
+        ("bmap", BmapT),
+        ("flags_word", ctypes.c_uint),  # nullpath_ok etc. bitfield
+        ("ioctl", ctypes.c_void_p),
+        ("poll", ctypes.c_void_p),
+        ("write_buf", ctypes.c_void_p),
+        ("read_buf", ctypes.c_void_p),
+        ("flock", ctypes.c_void_p),
+        ("fallocate", ctypes.c_void_p),
+    ]
+
+
+libfuse.fuse_main_real.restype = ctypes.c_int
+libfuse.fuse_main_real.argtypes = [
+    ctypes.c_int,
+    ctypes.POINTER(ctypes.c_char_p),
+    ctypes.POINTER(FuseOperations),
+    ctypes.c_size_t,
+    ctypes.c_void_p,
+]
+
+
+def fuse_main(mountpoint: str, ops: FuseOperations, foreground: bool = True) -> int:
+    """Run the libfuse main loop (single-threaded: Python callbacks)."""
+    args = [b"seaweedfs_tpu", mountpoint.encode(), b"-s"]
+    if foreground:
+        args.append(b"-f")
+    argv = (ctypes.c_char_p * len(args))(*args)
+    return libfuse.fuse_main_real(
+        len(args), argv, ctypes.byref(ops), ctypes.sizeof(ops), None
+    )

@@ -1,0 +1,115 @@
+"""FUSE mount tests (reference test/fuse_integration): real kernel mount
+of the filer namespace, exercised with plain os/file calls. Skipped
+where /dev/fuse or fusermount is unavailable."""
+
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+import pytest
+import requests
+
+from conftest import allocate_port as free_port
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists("/dev/fuse") or shutil.which("fusermount") is None,
+    reason="FUSE unavailable",
+)
+
+
+@pytest.fixture
+def mounted(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")
+    mport, fport = free_port(), free_port()
+    mnt = str(tmp_path / "mnt")
+    os.makedirs(mnt)
+    srv = mp = None
+    try:
+        srv = subprocess.Popen(
+            [
+                sys.executable, "-m", "seaweedfs_tpu.server", "server",
+                "-masterPort", str(mport), "-port", str(free_port()),
+                "-filerPort", str(fport), "-filer",
+                "-dir", str(tmp_path / "data"), "-ec.backend", "cpu",
+            ],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        deadline = time.time() + 40
+        while True:
+            try:
+                requests.get(f"http://localhost:{fport}/", timeout=1)
+                break
+            except requests.RequestException:
+                assert time.time() < deadline and srv.poll() is None
+                time.sleep(0.2)
+        mp = subprocess.Popen(
+            [
+                sys.executable, "-m", "seaweedfs_tpu.mount",
+                "-filer", f"localhost:{fport}", "-dir", mnt,
+            ],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        deadline = time.time() + 20
+        while not os.path.ismount(mnt):
+            if mp.poll() is not None:
+                pytest.skip(
+                    "mount failed (container restriction): "
+                    + mp.stdout.read().decode()[:300]
+                )
+            assert time.time() < deadline
+            time.sleep(0.2)
+        yield mnt, fport
+    finally:
+        # teardown must run even when setup skips/fails: a leaked server
+        # would hold its ports for the rest of the pytest run
+        if os.path.ismount(mnt):
+            subprocess.run(["fusermount", "-u", mnt], timeout=10)
+        if mp is not None:
+            try:
+                mp.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                mp.kill()
+        if srv is not None:
+            srv.terminate()
+            try:
+                srv.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                srv.kill()
+
+
+def test_mount_posix_ops(mounted):
+    mnt, fport = mounted
+    base = f"http://localhost:{fport}"
+    requests.post(f"{base}/seed/hello.txt", data=b"from http")
+
+    assert "seed" in os.listdir(mnt)
+    assert open(f"{mnt}/seed/hello.txt").read() == "from http"
+
+    os.makedirs(f"{mnt}/work/sub")
+    with open(f"{mnt}/work/sub/data.bin", "wb") as f:
+        f.write(b"B" * 70_000)
+    assert os.stat(f"{mnt}/work/sub/data.bin").st_size == 70_000
+    # visible via HTTP (write-through on close)
+    assert requests.get(f"{base}/work/sub/data.bin").content == b"B" * 70_000
+
+    os.rename(f"{mnt}/work/sub/data.bin", f"{mnt}/work/moved.bin")
+    assert requests.get(f"{base}/work/moved.bin").status_code == 200
+    with open(f"{mnt}/work/moved.bin", "r+b") as f:
+        f.seek(0, 2)
+        f.write(b"tail")
+    assert requests.get(f"{base}/work/moved.bin").content == b"B" * 70_000 + b"tail"
+
+    os.remove(f"{mnt}/work/moved.bin")
+    os.rmdir(f"{mnt}/work/sub")
+    assert requests.get(f"{base}/work/moved.bin").status_code == 404
+    # cp through the mount
+    subprocess.run(
+        ["cp", f"{mnt}/seed/hello.txt", f"{mnt}/seed/copy.txt"], check=True
+    )
+    assert requests.get(f"{base}/seed/copy.txt").content == b"from http"
