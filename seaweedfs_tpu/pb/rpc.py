@@ -12,6 +12,7 @@ import grpc
 
 from . import cluster_pb2 as pb
 from . import mq_pb2 as mq
+from . import worker_pb2 as wk
 
 UNARY = "unary_unary"
 SERVER_STREAM = "unary_stream"
@@ -21,6 +22,7 @@ BIDI = "stream_stream"
 MASTER_SERVICE = "sw.Seaweed"
 VOLUME_SERVICE = "sw.VolumeServer"
 MQ_SERVICE = "swmq.Messaging"
+WORKER_SERVICE = "swworker.WorkerControl"
 
 SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
     MASTER_SERVICE: {
@@ -67,6 +69,11 @@ SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
         "CommitOffset": (UNARY, mq.CommitOffsetRequest, mq.CommitOffsetResponse),
         "FetchOffset": (UNARY, mq.FetchOffsetRequest, mq.FetchOffsetResponse),
         "PartitionInfo": (UNARY, mq.PartitionInfoRequest, mq.PartitionInfoResponse),
+    },
+    WORKER_SERVICE: {
+        "WorkerStream": (BIDI, wk.WorkerMessage, wk.ServerMessage),
+        "ListTasks": (UNARY, wk.ListTasksRequest, wk.ListTasksResponse),
+        "SubmitTask": (UNARY, wk.SubmitTaskRequest, wk.SubmitTaskResponse),
     },
 }
 

@@ -272,8 +272,12 @@ class MasterServer:
             target=self._vacuum_loop, daemon=True
         )
 
+        from ..worker.control import WorkerControl
+
+        self.worker_control = WorkerControl(topo=self.topo)
         self._grpc = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
         rpc.add_service(self._grpc, rpc.MASTER_SERVICE, self.service)
+        rpc.add_service(self._grpc, rpc.WORKER_SERVICE, self.worker_control)
         self._grpc.add_insecure_port(f"{ip}:{self.grpc_port}")
 
         self._http = ThreadingHTTPServer((ip, port), self._handler_class())
@@ -460,6 +464,7 @@ class MasterServer:
         self._vacuum_thread.start()
 
     def stop(self) -> None:
+        self.worker_control.stop()
         self._vacuum_stop.set()
         self._grpc.stop(grace=0.5)
         self._http.shutdown()

@@ -831,6 +831,51 @@ def fs_mv(env: ShellEnv, args) -> str:
     return "ok" if r.status_code == 200 else f"error: {r.text}"
 
 
+# -------------------------------------------------------------------- tasks
+
+
+@command("task.submit", "-kind ec_encode|vacuum -volumeId N [-backend b]")
+def task_submit(env: ShellEnv, args) -> str:
+    from ..pb import worker_pb2 as wk
+
+    p = argparse.ArgumentParser(prog="task.submit")
+    p.add_argument("-kind", required=True)
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-backend", default="")
+    a = p.parse_args(args)
+    with grpc.insecure_channel(env.master.grpc_addr) as ch:
+        r = rpc.Stub(ch, rpc.WORKER_SERVICE).SubmitTask(
+            wk.SubmitTaskRequest(
+                kind=a.kind,
+                volume_id=a.volumeId,
+                collection=a.collection,
+                backend=a.backend,
+            ),
+            timeout=30,
+        )
+    if r.error:
+        return f"error: {r.error}"
+    return f"task {r.task_id} submitted"
+
+
+@command("task.list", "show the maintenance task queue")
+def task_list(env: ShellEnv, args) -> str:
+    from ..pb import worker_pb2 as wk
+
+    with grpc.insecure_channel(env.master.grpc_addr) as ch:
+        r = rpc.Stub(ch, rpc.WORKER_SERVICE).ListTasks(
+            wk.ListTasksRequest(), timeout=30
+        )
+    return "\n".join(
+        f"{t.task_id} {t.kind} vol={t.volume_id} {t.state}"
+        + (f" ({t.progress:.0%})" if t.state == "running" else "")
+        + (f" worker={t.worker_id}" if t.worker_id else "")
+        + (f" error={t.error}" if t.error else "")
+        for t in r.tasks
+    ) or "(no tasks)"
+
+
 # ---------------------------------------------------------------------- mq
 
 

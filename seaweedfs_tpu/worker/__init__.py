@@ -1,0 +1,5 @@
+"""Maintenance worker fleet (layer 8): control plane + workers; the
+registration surface for the TPU EC sidecar."""
+
+from .control import WorkerControl
+from .worker import Worker

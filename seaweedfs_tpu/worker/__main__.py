@@ -1,0 +1,36 @@
+"""`python -m seaweedfs_tpu.worker -master host:9333 -backend tpu`
+(reference `weed worker`): register with the fleet control plane and
+execute maintenance tasks. With -backend tpu this process IS the TPU
+EC sidecar."""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+
+from .worker import Worker
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="seaweedfs_tpu.worker")
+    p.add_argument("-master", default="localhost:9333")
+    p.add_argument("-backend", default="auto", help="EC backend: cpu|tpu|auto")
+    p.add_argument("-maxConcurrent", type=int, default=2)
+    p.add_argument("-capabilities", default="ec_encode,vacuum")
+    a = p.parse_args(argv)
+    w = Worker(
+        master=a.master,
+        capabilities=tuple(a.capabilities.split(",")),
+        backend=a.backend,
+        max_concurrent=a.maxConcurrent,
+    )
+    signal.signal(signal.SIGTERM, lambda *x: w.stop())
+    signal.signal(signal.SIGINT, lambda *x: w.stop())
+    print(f"worker {w.worker_id} -> {a.master} (backend={a.backend})", flush=True)
+    w.run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

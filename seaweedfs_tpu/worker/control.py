@@ -1,0 +1,302 @@
+"""Worker-fleet control plane, hosted by the master.
+
+Reference: weed/admin/maintenance (scanner -> queue -> dispatcher) and
+weed/admin/plugin (registry/scheduler/dispatcher over
+PluginControlService.WorkerStream). One bidi stream per worker carries
+registration, heartbeats, task assignment, and progress — the surface a
+TPU EC sidecar plugs into (BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+
+from ..pb import worker_pb2 as wk
+
+
+@dataclass
+class _Worker:
+    worker_id: str
+    capabilities: set
+    max_concurrent: int
+    backend: str
+    outbox: "queue.Queue" = field(default_factory=queue.Queue)
+    active: int = 0
+    last_seen: float = field(default_factory=time.time)
+
+
+@dataclass
+class _Task:
+    task_id: str
+    kind: str
+    volume_id: int
+    collection: str
+    backend: str
+    state: str = "pending"  # pending|assigned|running|done|failed
+    worker_id: str = ""
+    progress: float = 0.0
+    error: str = ""
+    created: float = field(default_factory=time.time)
+
+
+KNOWN_KINDS = ("ec_encode", "vacuum")
+WORKER_STALE_SECONDS = 30.0
+TASK_RETENTION = 1000  # terminal tasks kept for task.list history
+
+
+class WorkerControl:
+    """Registry + queue + dispatcher; also the gRPC servicer."""
+
+    def __init__(self, topo=None):
+        """topo: the master Topology, used to resolve volume collections
+        and scan for maintenance candidates."""
+        self.topo = topo
+        self._lock = threading.Condition()
+        self._workers: dict[str, _Worker] = {}
+        self._tasks: dict[str, _Task] = {}
+        self._pending: list[str] = []
+        # (size, since_ts) per volume for the quiet-period check
+        self._size_watch: dict[int, tuple[int, float]] = {}
+        self._stop = threading.Event()
+        self._dispatcher = threading.Thread(target=self._dispatch_loop, daemon=True)
+        self._dispatcher.start()
+
+    # ----------------------------------------------------------- queueing
+
+    def _resolve_collection(self, volume_id: int) -> str:
+        if self.topo is None:
+            return ""
+        with self.topo._lock:
+            for n in self.topo.nodes.values():
+                v = n.volumes.get(volume_id)
+                if v is not None:
+                    return v.collection
+        return ""
+
+    def submit(self, kind: str, volume_id: int, collection: str = "", backend: str = "") -> str:
+        if kind not in KNOWN_KINDS:
+            raise ValueError(f"unknown task kind {kind!r} (want {KNOWN_KINDS})")
+        if not collection:
+            # collection determines on-disk paths; a task executed with
+            # the wrong one fails AFTER destructive steps
+            collection = self._resolve_collection(volume_id)
+        task_id = uuid.uuid4().hex[:12]
+        with self._lock:
+            self._prune_locked()
+            # dedupe: one live task per (kind, volume)
+            for t in self._tasks.values():
+                if (
+                    t.kind == kind
+                    and t.volume_id == volume_id
+                    and t.state in ("pending", "assigned", "running")
+                ):
+                    return t.task_id
+            self._tasks[task_id] = _Task(
+                task_id, kind, volume_id, collection, backend
+            )
+            self._pending.append(task_id)
+            self._lock.notify_all()
+        return task_id
+
+    def _prune_locked(self) -> None:
+        terminal = [
+            t for t in self._tasks.values() if t.state in ("done", "failed")
+        ]
+        if len(terminal) > TASK_RETENTION:
+            terminal.sort(key=lambda t: t.created)
+            for t in terminal[: len(terminal) - TASK_RETENTION]:
+                self._tasks.pop(t.task_id, None)
+
+    def _dispatch_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                self._lock.wait(timeout=0.5)
+                # evict hung workers: an open-but-dead stream would pin
+                # its tasks 'assigned' forever (heartbeats come every ~1s)
+                now = time.time()
+                for w in list(self._workers.values()):
+                    if now - w.last_seen > WORKER_STALE_SECONDS:
+                        w.outbox.put(None)  # closes its pump -> requeue
+                still_pending = []
+                for task_id in self._pending:
+                    t = self._tasks.get(task_id)
+                    if t is None or t.state != "pending":
+                        continue
+                    w = self._pick_worker(t.kind)
+                    if w is None:
+                        still_pending.append(task_id)
+                        continue
+                    t.state = "assigned"
+                    t.worker_id = w.worker_id
+                    w.active += 1
+                    w.outbox.put(
+                        wk.ServerMessage(
+                            assign=wk.TaskAssign(
+                                task_id=t.task_id,
+                                kind=t.kind,
+                                volume_id=t.volume_id,
+                                collection=t.collection,
+                                backend=t.backend or w.backend,
+                            )
+                        )
+                    )
+                self._pending = still_pending
+
+    def _pick_worker(self, kind: str):
+        best = None
+        for w in self._workers.values():
+            if kind not in w.capabilities or w.active >= w.max_concurrent:
+                continue
+            if best is None or w.active < best.active:
+                best = w
+        return best
+
+    # ------------------------------------------------------------ servicer
+
+    def WorkerStream(self, request_iterator, context):
+        worker: _Worker | None = None
+        recv_done = threading.Event()
+
+        def receiver():
+            nonlocal worker
+            try:
+                for msg in request_iterator:
+                    kind = msg.WhichOneof("body")
+                    if kind == "register":
+                        r = msg.register
+                        with self._lock:
+                            worker = _Worker(
+                                worker_id=r.worker_id or uuid.uuid4().hex[:8],
+                                capabilities=set(r.capabilities),
+                                max_concurrent=r.max_concurrent or 1,
+                                backend=r.backend or "auto",
+                            )
+                            self._workers[worker.worker_id] = worker
+                            self._lock.notify_all()
+                        worker.outbox.put(wk.ServerMessage(ack=wk.ServerAck()))
+                    elif kind == "heartbeat" and worker is not None:
+                        worker.last_seen = time.time()
+                    elif kind == "update" and worker is not None:
+                        self._apply_update(worker, msg.update)
+            except Exception:
+                pass  # stream torn down mid-read (worker vanished)
+            finally:
+                recv_done.set()
+                if worker is not None:
+                    worker.outbox.put(None)
+
+        t = threading.Thread(target=receiver, daemon=True)
+        t.start()
+        # wait for registration, then pump the outbox; no deadline —
+        # bailing early while the receiver may still register would
+        # leak a ghost worker whose outbox nobody drains
+        while worker is None and not recv_done.is_set():
+            time.sleep(0.05)
+        if worker is None:
+            return
+        try:
+            while context.is_active():
+                try:
+                    item = worker.outbox.get(timeout=0.5)
+                except queue.Empty:
+                    continue
+                if item is None:
+                    return
+                yield item
+        finally:
+            with self._lock:
+                # a reconnected stream may have re-registered this id
+                # with a NEW worker object: only remove our own
+                if self._workers.get(worker.worker_id) is worker:
+                    self._workers.pop(worker.worker_id, None)
+                # requeue tasks the dead worker was running
+                for task in self._tasks.values():
+                    if task.worker_id == worker.worker_id and task.state in (
+                        "assigned",
+                        "running",
+                    ):
+                        task.state = "pending"
+                        task.worker_id = ""
+                        self._pending.append(task.task_id)
+                self._lock.notify_all()
+
+    def _apply_update(self, worker: _Worker, u: wk.TaskUpdate) -> None:
+        with self._lock:
+            t = self._tasks.get(u.task_id)
+            if t is None:
+                return
+            t.progress = u.progress
+            if u.state == "running":
+                t.state = "running"
+            elif u.state in ("done", "failed"):
+                t.state = u.state
+                t.error = u.error
+                worker.active = max(worker.active - 1, 0)
+                self._lock.notify_all()
+
+    def SubmitTask(self, request, context):
+        try:
+            task_id = self.submit(
+                request.kind, request.volume_id, request.collection, request.backend
+            )
+        except ValueError as e:
+            return wk.SubmitTaskResponse(error=str(e))
+        return wk.SubmitTaskResponse(task_id=task_id)
+
+    def ListTasks(self, request, context):
+        with self._lock:
+            return wk.ListTasksResponse(
+                tasks=[
+                    wk.TaskInfo(
+                        task_id=t.task_id,
+                        kind=t.kind,
+                        volume_id=t.volume_id,
+                        state=t.state,
+                        worker_id=t.worker_id,
+                        progress=t.progress,
+                        error=t.error,
+                    )
+                    for t in sorted(
+                        self._tasks.values(), key=lambda t: t.created
+                    )
+                ]
+            )
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # ---------------------------------------------------------- detection
+
+    def scan_for_ec_candidates(
+        self, topo, fullness: float, volume_size_limit: int, quiet_seconds: float = 0.0
+    ) -> list[str]:
+        """Auto-detect volumes ready for EC (reference maintenance
+        scanner / ec detection.go): full enough AND quiet — encoding
+        freezes writes, so actively-written volumes must settle first.
+        Quiet = reported size unchanged for quiet_seconds."""
+        now = time.time()
+        candidates = []
+        with topo._lock:
+            seen = set()
+            for n in topo.nodes.values():
+                for v in n.volumes.values():
+                    if v.id in seen:
+                        continue
+                    seen.add(v.id)
+                    if v.size >= fullness * volume_size_limit:
+                        candidates.append((v.id, v.collection, v.size))
+        submitted = []
+        for vid, col, size in candidates:
+            prev = self._size_watch.get(vid)
+            if prev is None or prev[0] != size:
+                self._size_watch[vid] = (size, now)
+                if quiet_seconds > 0:
+                    continue  # just started watching; not yet quiet
+            elif now - prev[1] < quiet_seconds:
+                continue
+            submitted.append(self.submit("ec_encode", vid, col))
+        return submitted

@@ -1,0 +1,161 @@
+"""Maintenance worker: connects to the master's WorkerControl stream,
+registers capabilities, executes assigned tasks with progress reporting.
+
+Reference: weed/worker (client.go bidi stream, tasks/registry.go task
+types) and the plugin worker JobHandler model (plugin/worker/worker.go).
+The ec_encode handler drives the same RPC pipeline the shell uses
+(readonly -> generate(backend) -> mount -> delete source) — running it
+with -backend tpu makes this process the TPU EC sidecar.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import uuid
+
+import grpc
+
+from ..client.master_client import MasterClient, volume_channel
+from ..pb import cluster_pb2 as pb
+from ..pb import rpc
+from ..pb import worker_pb2 as wk
+
+
+class Worker:
+    def __init__(
+        self,
+        master: str = "localhost:9333",
+        capabilities: tuple = ("ec_encode", "vacuum"),
+        backend: str = "auto",
+        max_concurrent: int = 2,
+        worker_id: str = "",
+    ):
+        self.master_addr = master
+        self.worker_id = worker_id or f"worker-{uuid.uuid4().hex[:8]}"
+        self.capabilities = capabilities
+        self.backend = backend
+        self.max_concurrent = max_concurrent
+        self._outbox: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._mc = MasterClient(master)
+        self.completed: list[str] = []
+
+    # ------------------------------------------------------------- stream
+
+    def _messages(self):
+        yield wk.WorkerMessage(
+            register=wk.Register(
+                worker_id=self.worker_id,
+                capabilities=list(self.capabilities),
+                max_concurrent=self.max_concurrent,
+                backend=self.backend,
+            )
+        )
+        while not self._stop.is_set():
+            try:
+                msg = self._outbox.get(timeout=1.0)
+                yield msg
+            except queue.Empty:
+                yield wk.WorkerMessage(heartbeat=wk.WorkerHeartbeat())
+
+    def run(self) -> None:
+        """Connect-and-serve loop; reconnects on stream loss."""
+        while not self._stop.is_set():
+            try:
+                channel = grpc.insecure_channel(self._mc.grpc_addr)
+                stub = rpc.Stub(channel, rpc.WORKER_SERVICE)
+                for server_msg in stub.WorkerStream(self._messages()):
+                    if self._stop.is_set():
+                        break
+                    if server_msg.WhichOneof("body") == "assign":
+                        threading.Thread(
+                            target=self._execute,
+                            args=(server_msg.assign,),
+                            daemon=True,
+                        ).start()
+                channel.close()
+            except grpc.RpcError:
+                if self._stop.wait(1.0):
+                    return
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -------------------------------------------------------------- tasks
+
+    def _report(self, task_id: str, state: str, progress: float = 0.0, error: str = "") -> None:
+        self._outbox.put(
+            wk.WorkerMessage(
+                update=wk.TaskUpdate(
+                    task_id=task_id, state=state, progress=progress, error=error
+                )
+            )
+        )
+
+    def _execute(self, assign: wk.TaskAssign) -> None:
+        self._report(assign.task_id, "running", 0.0)
+        try:
+            if assign.kind == "ec_encode":
+                self._task_ec_encode(assign)
+            elif assign.kind == "vacuum":
+                self._task_vacuum(assign)
+            else:
+                raise RuntimeError(f"unknown task kind {assign.kind}")
+            self._report(assign.task_id, "done", 1.0)
+            self.completed.append(assign.task_id)
+        except Exception as e:
+            self._report(assign.task_id, "failed", 0.0, error=str(e))
+
+    def _holder_stubs(self, vid: int):
+        locs = self._mc.lookup(vid, refresh=True)
+        if not locs:
+            raise RuntimeError(f"volume {vid} has no locations")
+        out = []
+        for loc in locs:
+            ch = volume_channel(loc)
+            out.append((loc, ch, rpc.volume_stub(ch)))
+        return out
+
+    def _task_ec_encode(self, assign: wk.TaskAssign) -> None:
+        vid = assign.volume_id
+        holders = self._holder_stubs(vid)
+        try:
+            for _, _, stub in holders:
+                stub.VolumeMarkReadonly(
+                    pb.VolumeCommandRequest(volume_id=vid), timeout=30
+                )
+            self._report(assign.task_id, "running", 0.2)
+            _, _, gen_stub = holders[0]
+            gen_stub.VolumeEcShardsGenerate(
+                pb.EcShardsGenerateRequest(
+                    volume_id=vid,
+                    collection=assign.collection,
+                    backend=assign.backend or self.backend,
+                ),
+                timeout=3600,
+            )
+            self._report(assign.task_id, "running", 0.8)
+            gen_stub.VolumeEcShardsMount(
+                pb.EcShardsMountRequest(
+                    volume_id=vid, collection=assign.collection
+                ),
+                timeout=60,
+            )
+            for _, _, stub in holders:
+                stub.VolumeDelete(
+                    pb.VolumeCommandRequest(volume_id=vid), timeout=60
+                )
+        finally:
+            for _, ch, _ in holders:
+                ch.close()
+
+    def _task_vacuum(self, assign: wk.TaskAssign) -> None:
+        for _, ch, stub in self._holder_stubs(assign.volume_id):
+            try:
+                stub.VacuumVolume(
+                    pb.VacuumRequest(volume_id=assign.volume_id), timeout=3600
+                )
+            finally:
+                ch.close()

@@ -1,0 +1,154 @@
+"""Worker-fleet tests: registration, task dispatch, EC-encode execution,
+requeue on worker death (reference test/plugin_workers in-process
+harness technique)."""
+
+import threading
+import time
+
+import pytest
+
+from conftest import allocate_port as free_port
+from seaweedfs_tpu.client.operations import Operations
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.shell.commands import ShellEnv, run_command
+from seaweedfs_tpu.storage.file_id import FileId
+from seaweedfs_tpu.worker import Worker
+
+
+def wait_for(cond, timeout=15.0, msg="condition"):
+    deadline = time.time() + timeout
+    while not cond():
+        if time.time() > deadline:
+            raise TimeoutError(msg)
+        time.sleep(0.05)
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    mport = free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path / "v")],
+        master=f"localhost:{mport}",
+        ip="localhost",
+        port=free_port(),
+        ec_backend="cpu",
+    )
+    vs.start()
+    while not master.topo.nodes:
+        time.sleep(0.05)
+    yield master, vs
+    vs.stop()
+    master.stop()
+
+
+def start_worker(master_port, **kw) -> Worker:
+    w = Worker(master=f"localhost:{master_port}", backend="cpu", **kw)
+    threading.Thread(target=w.run, daemon=True).start()
+    wait_for(
+        lambda: w.worker_id in master_control(master_port)._workers,
+        msg="worker registers",
+    )
+    return w
+
+
+_masters = {}
+
+
+def master_control(port):
+    return _masters[port].worker_control
+
+
+def test_worker_executes_ec_encode(cluster):
+    master, vs = cluster
+    _masters[master.port] = master
+    ops = Operations(f"localhost:{master.port}")
+    env = ShellEnv(f"localhost:{master.port}")
+    w = start_worker(master.port)
+    try:
+        data = b"worker encodes me" * 3000
+        fid = ops.upload(data)
+        vid = FileId.parse(fid).volume_id
+        out = run_command(env, f"task.submit -kind ec_encode -volumeId {vid}")
+        assert "submitted" in out
+        wait_for(
+            lambda: "done" in run_command(env, "task.list"),
+            msg="task completes",
+        )
+        # the volume is now EC-backed and still readable
+        wait_for(
+            lambda: any(
+                vid in n.ec_shards for n in master.topo.nodes.values()
+            )
+        )
+        assert ops.read(fid) == data
+        # duplicate submits dedupe onto the finished/live task
+        out1 = run_command(env, f"task.submit -kind vacuum -volumeId {vid}")
+        out2 = run_command(env, f"task.submit -kind vacuum -volumeId {vid}")
+        # (ids equal while the first is still live)
+        assert "submitted" in out1 and "submitted" in out2
+    finally:
+        w.stop()
+        env.close()
+        ops.close()
+
+
+def test_task_failure_reported(cluster):
+    master, vs = cluster
+    _masters[master.port] = master
+    env = ShellEnv(f"localhost:{master.port}")
+    w = start_worker(master.port)
+    try:
+        run_command(env, "task.submit -kind ec_encode -volumeId 424242")
+        wait_for(
+            lambda: "failed" in run_command(env, "task.list"),
+            msg="missing volume task fails",
+        )
+        assert "not found" in run_command(env, "task.list")
+    finally:
+        w.stop()
+        env.close()
+
+
+def test_requeue_on_worker_death(cluster):
+    master, vs = cluster
+    _masters[master.port] = master
+    ctrl = master.worker_control
+    # no worker yet: task stays pending
+    tid = ctrl.submit("ec_encode", 7777)
+    time.sleep(0.8)
+    assert ctrl._tasks[tid].state == "pending"
+    # a worker without the capability is never picked
+    w = start_worker(master.port, capabilities=("vacuum",))
+    time.sleep(0.8)
+    assert ctrl._tasks[tid].state == "pending"
+    w.stop()
+
+
+def test_scanner_detects_full_volumes(cluster):
+    master, vs = cluster
+    _masters[master.port] = master
+    ops = Operations(f"localhost:{master.port}")
+    try:
+        fid = ops.upload(b"z" * 10_000)
+        vid = FileId.parse(fid).volume_id
+        vs.notify_new_volume(vid)  # push fresh size stats to the master
+        wait_for(
+            lambda: any(
+                vid in n.volumes and n.volumes[vid].size > 0
+                for n in master.topo.nodes.values()
+            )
+        )
+        # nothing full yet at the real 30GB limit
+        assert master.worker_control.scan_for_ec_candidates(
+            master.topo, 0.9, master.topo.volume_size_limit
+        ) == []
+        # with a tiny synthetic limit the volume qualifies
+        tasks = master.worker_control.scan_for_ec_candidates(
+            master.topo, 0.5, 1000
+        )
+        assert len(tasks) >= 1
+    finally:
+        ops.close()
