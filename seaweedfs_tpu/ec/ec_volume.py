@@ -151,26 +151,52 @@ class EcVolume:
 
     def _recover_interval(self, shard_id: int, offset: int, size: int) -> bytes:
         """On-the-fly RS decode of one interval from >=k sibling shards
-        (reference store_ec.go:656-747)."""
+        (reference store_ec.go:656-747; like the reference, sibling
+        reads fan out in parallel — remote fetches dominate latency)."""
         k = self.ctx.data_shards
         sources: dict[int, np.ndarray] = {}
-        for i, fd in list(self.shard_fds.items()):
-            if i == shard_id:
-                continue
+        local = [(i, fd) for i, fd in self.shard_fds.items() if i != shard_id]
+        for i, fd in local:
             try:
                 got = os.pread(fd, size, offset)
             except OSError:
                 continue
-            if len(got) != size:
-                continue
-            sources[i] = np.frombuffer(got, dtype=np.uint8)
-            if len(sources) == k:
-                break
+            if len(got) == size:
+                sources[i] = np.frombuffer(got, dtype=np.uint8)
+                if len(sources) == k:
+                    break
+        if len(sources) < k and self.remote_reader is not None:
+            from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+
+            missing = [
+                i
+                for i in range(self.ctx.total)
+                if i != shard_id and i not in sources
+            ]
+
+            def fetch(i):
+                return i, self.remote_reader(i, offset, size, self.encode_ts_ns)
+
+            # stop as soon as k sources exist: one hung peer must not
+            # stall the read for the full RPC timeout
+            ex = ThreadPoolExecutor(max_workers=min(len(missing), 8))
+            try:
+                futures = {ex.submit(fetch, i) for i in missing}
+                while futures and len(sources) < k:
+                    done, futures = wait(futures, return_when=FIRST_COMPLETED)
+                    for f in done:
+                        i, got = f.result()
+                        if got is not None and len(got) == size:
+                            sources[i] = np.frombuffer(got, dtype=np.uint8)
+            finally:
+                ex.shutdown(wait=False, cancel_futures=True)
         if len(sources) < k:
             raise ECError(
                 f"shard {shard_id} unavailable and only {len(sources)} "
                 f"sibling shards readable (need {k})"
             )
+        if len(sources) > k:
+            sources = {i: sources[i] for i in sorted(sources)[:k]}
         rec = self.backend.reconstruct(sources, want=[shard_id])
         return np.asarray(rec[shard_id], dtype=np.uint8).tobytes()
 

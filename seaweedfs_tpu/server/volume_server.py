@@ -251,7 +251,20 @@ class VolumeService:
         except NotFoundError as e:
             return pb.DeleteNeedleResponse(error=str(e))
         if not request.is_replicate:
-            self.server.replicate_delete(request)
+            ev = self.store.find_ec_volume(request.volume_id)
+            if ev is not None:
+                # EC tombstones must reach every shard holder's .ecj
+                # (reference ec_volume_delete distribution), or a later
+                # decode/serve from another holder resurrects the blob
+                err = self.server.replicate_ec_delete(
+                    request.volume_id, ev.collection, request.needle_id
+                )
+                if err:
+                    return pb.DeleteNeedleResponse(
+                        freed_bytes=freed, error=err
+                    )
+            else:
+                self.server.replicate_delete(request)
         return pb.DeleteNeedleResponse(freed_bytes=freed)
 
     # ---------------------------------------------------------------- ec
@@ -412,6 +425,10 @@ class VolumeService:
             remaining -= len(chunk)
 
     def VolumeEcBlobDelete(self, request, context):
+        # a mutation: on keyed clusters it needs the same peer token the
+        # gRPC write path demands (fan-out attaches it)
+        if not self._grpc_jwt_ok(context, request.volume_id, request.needle_id):
+            context.abort(grpc.StatusCode.PERMISSION_DENIED, "unauthorized")
         ev = self.store.find_ec_volume(request.volume_id)
         if ev is None:
             context.abort(grpc.StatusCode.NOT_FOUND, "ec volume not mounted")
@@ -709,6 +726,41 @@ class VolumeServer:
                 errors.append(f"{loc.url}: {e.code().name}")
         return "; ".join(errors)
 
+    def replicate_ec_delete(self, vid: int, collection: str, needle_id: int) -> str:
+        """Journal the EC tombstone on every other shard holder. Returns
+        an error summary ('' = all holders reached) — a silently missed
+        holder would resurrect the blob, so failures must surface."""
+        try:
+            # fresh holder list: a balance move since the cached lookup
+            # would otherwise be missed entirely
+            shard_locs = self._master_client().lookup_ec(vid, refresh=True)
+        except (LookupError, grpc.RpcError) as e:
+            return f"ec tombstone fan-out: holder lookup failed: {e}"
+        me = f"{self.ip}:{self.port}"
+        md = self._peer_metadata(vid)
+        errors = []
+        seen = set()
+        for locs in shard_locs.values():
+            for loc in locs:
+                if loc.url == me or loc.url in seen:
+                    continue
+                seen.add(loc.url)
+                try:
+                    self._peer_stub(
+                        f"{loc.url.split(':')[0]}:{loc.grpc_port}"
+                    ).VolumeEcBlobDelete(
+                        pb.EcBlobDeleteRequest(
+                            volume_id=vid,
+                            collection=collection,
+                            needle_id=needle_id,
+                        ),
+                        timeout=30,
+                        metadata=md,
+                    )
+                except grpc.RpcError as e:
+                    errors.append(f"{loc.url}: {e.code().name}")
+        return "; ".join(errors)
+
     def replicate_delete(self, request: pb.DeleteNeedleRequest) -> None:
         md = self._peer_metadata(request.volume_id)
         for loc in self._replica_locations(request.volume_id):
@@ -891,14 +943,39 @@ class VolumeServer:
                     return self._error(404, str(e))
                 except (CookieMismatch, CrcError) as e:
                     return self._error(404, str(e))
-                self.send_response(200)
                 ctype = n.mime.decode() if n.mime else "application/octet-stream"
+                data = n.data
+                total = len(data)
+                status = 200
+                content_range = None
+                rng = self.headers.get("Range", "")
+                if rng.startswith("bytes=") and self.command != "HEAD":
+                    try:
+                        lo_s, _, hi_s = rng[6:].split(",")[0].partition("-")
+                        lo = int(lo_s) if lo_s else max(total - int(hi_s), 0)
+                        hi = int(hi_s) if hi_s and lo_s else total - 1
+                        if lo > hi or lo >= total:  # incl. any range on empty body
+                            self.send_response(416)
+                            self.send_header("Content-Range", f"bytes */{total}")
+                            self.send_header("Content-Length", "0")
+                            self.end_headers()
+                            return
+                        hi = min(hi, total - 1)
+                        data = data[lo : hi + 1]
+                        status = 206
+                        content_range = f"bytes {lo}-{hi}/{total}"
+                    except ValueError:
+                        pass  # malformed Range: serve the full body
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(n.data)))
+                self.send_header("Content-Length", str(len(data)))
+                self.send_header("Accept-Ranges", "bytes")
+                if content_range:
+                    self.send_header("Content-Range", content_range)
                 self.send_header("ETag", f'"{n.checksum:08x}"')
                 self.end_headers()
                 if self.command != "HEAD":
-                    self.wfile.write(n.data)
+                    self.wfile.write(data)
 
             do_HEAD = do_GET
 
@@ -951,7 +1028,8 @@ class VolumeServer:
                     None,
                 )
                 if resp.error:
-                    return self._error(404, resp.error)
+                    # freed locally but fan-out incomplete = 500, not 404
+                    return self._error(500 if resp.freed_bytes else 404, resp.error)
                 body = json.dumps({"size": resp.freed_bytes}).encode()
                 self.send_response(202)
                 self.send_header("Content-Type", "application/json")

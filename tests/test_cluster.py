@@ -101,6 +101,73 @@ def test_replicated_write(cluster):
         ops.close()
 
 
+def test_blob_range_reads(cluster):
+    master, vols = cluster
+    ops = Operations(f"localhost:{master.port}")
+    try:
+        data = bytes(range(256)) * 100
+        fid = ops.upload(data)
+        loc = ops.master.lookup(FileId.parse(fid).volume_id)[0]
+        r = requests.get(
+            f"http://{loc.url}/{fid}", headers={"Range": "bytes=100-299"}
+        )
+        assert r.status_code == 206 and r.content == data[100:300]
+        assert r.headers["Content-Range"] == f"bytes 100-299/{len(data)}"
+        r = requests.get(
+            f"http://{loc.url}/{fid}", headers={"Range": "bytes=-50"}
+        )
+        assert r.status_code == 206 and r.content == data[-50:]
+        r = requests.get(
+            f"http://{loc.url}/{fid}",
+            headers={"Range": f"bytes={len(data) + 1}-"},
+        )
+        assert r.status_code == 416
+    finally:
+        ops.close()
+
+
+def test_ec_delete_tombstone_fanout(cluster):
+    """Deleting a blob on one EC shard holder must tombstone it on every
+    holder — a decode or read served elsewhere must not resurrect it."""
+    master, vols = cluster
+    addr = f"localhost:{master.port}"
+    ops = Operations(addr)
+    env = ShellEnv(addr)
+    try:
+        blobs = {}
+        for i in range(12):
+            blobs[ops.upload(b"fanout-%d" % i * 500)] = None
+        vid = FileId.parse(next(iter(blobs))).volume_id
+        run_command(env, f"ec.encode -volumeId {vid} -backend cpu")
+        wait_for(
+            lambda: any(vid in n.ec_shards for n in master.topo.nodes.values())
+        )
+        # split shards across both nodes so two holders journal deletes
+        run_command(env, "ec.balance")
+        wait_for(
+            lambda: sum(
+                1 for n in master.topo.nodes.values() if vid in n.ec_shards
+            )
+            == 2
+        )
+        victim = next(iter(blobs))
+        ops.delete(victim)
+        time.sleep(0.5)
+        # every holder's EcVolume must consider the needle deleted
+        nid = FileId.parse(victim).needle_id
+        holders = [
+            vs for vs in vols if vs.store.find_ec_volume(vid) is not None
+        ]
+        assert len(holders) == 2
+        for vs in holders:
+            assert not vs.store.find_ec_volume(vid).has_needle(nid), (
+                f"tombstone missing on {vs.port}"
+            )
+    finally:
+        env.close()
+        ops.close()
+
+
 def test_heartbeat_liveness(cluster):
     master, vols = cluster
     vols[1].stop()
