@@ -49,6 +49,9 @@ class Filer:
         self._gc_thread = threading.Thread(target=self._gc_loop, daemon=True)
         self._gc_thread.start()
         self._listeners: list = []
+        # serializes metadata read-modify-write (tagging, xattr-style
+        # updates) against entry replacement
+        self._mutate_lock = threading.Lock()
 
     # ------------------------------------------------------------- meta log
 
@@ -82,13 +85,36 @@ class Filer:
     def create_entry(self, entry: Entry, ensure_parents: bool = True) -> None:
         if ensure_parents:
             self._ensure_parents(entry.directory)
-        old = self._try_find(entry.directory, entry.name)
-        if old is not None and old.is_directory != entry.is_directory:
-            raise FilerError(
-                f"{entry.full_path}: type conflict with existing entry"
-            )
-        self.store.insert(entry)
+        with self._mutate_lock:
+            old = self._try_find(entry.directory, entry.name)
+            if old is not None and old.is_directory != entry.is_directory:
+                raise FilerError(
+                    f"{entry.full_path}: type conflict with existing entry"
+                )
+            self.store.insert(entry)
         self._notify(entry.directory, old, entry)
+
+    def mutate_entry(self, full_path: str, fn) -> Entry:
+        """Read-modify-write an entry's metadata atomically w.r.t. other
+        metadata mutations, and notify subscribers. `fn(entry)` mutates
+        in place. A stale pre-read entry must never be written back —
+        that would revert a concurrent content overwrite."""
+        directory, name = split_path(full_path)
+        with self._mutate_lock:
+            entry = self.store.find(directory, name)
+            old = Entry(
+                directory=entry.directory,
+                name=entry.name,
+                is_directory=entry.is_directory,
+                chunks=list(entry.chunks),
+                content=entry.content,
+            )
+            old.attr.CopyFrom(entry.attr)
+            old.extended = dict(entry.extended)
+            fn(entry)
+            self.store.update(entry)
+        self._notify(directory, old, entry)
+        return entry
 
     def _ensure_parents(self, directory: str) -> None:
         directory = normalize_path(directory)

@@ -227,6 +227,12 @@ class S3Server:
                 path = f"{BUCKETS_ROOT}/{bucket}"
                 m = self.command
                 if m == "PUT":
+                    if "versioning" in q:
+                        # advertised off; enabling it is unimplemented —
+                        # never misroute into bucket creation
+                        return self._error(
+                            501, "NotImplemented", "bucket versioning"
+                        )
                     # bucket names double as volume collections: enforce
                     # S3 naming up front so object uploads can't fail on
                     # the master's collection validation later
@@ -266,6 +272,10 @@ class S3Server:
                     if "location" in q:
                         root = ET.Element("LocationConstraint", xmlns=XMLNS)
                         root.text = srv.region
+                        return self._respond(200, _xml(root))
+                    if "versioning" in q:
+                        # versioning is not implemented; report it off
+                        root = ET.Element("VersioningConfiguration", xmlns=XMLNS)
                         return self._respond(200, _xml(root))
                     if "uploads" in q:
                         return self._list_uploads(bucket)
@@ -363,6 +373,9 @@ class S3Server:
                 if m == "GET" and "uploadId" in q:
                     return self._list_parts(bucket, key, q)
 
+                if "tagging" in q:
+                    return self._object_tagging(bucket, key, path)
+
                 if m == "PUT":
                     src = self.headers.get("x-amz-copy-source", "")
                     if src:
@@ -421,6 +434,52 @@ class S3Server:
                     return self._respond(status, data, ctype, headers)
                 if m == "DELETE":
                     srv.filer.delete_entry(path, recursive=False, gc_chunks=True)
+                    return self._respond(204)
+                return self._error(405, "MethodNotAllowed", m)
+
+            def _object_tagging(self, bucket: str, key: str, path: str):
+                """Get/Put/DeleteObjectTagging: tags ride the entry's
+                extended attributes (reference s3api tagging handlers)."""
+                entry = srv.filer.find_entry(path)
+                if entry.is_directory:
+                    return self._error(404, "NoSuchKey", key)
+                m = self.command
+                if m == "GET":
+                    root = ET.Element("Tagging", xmlns=XMLNS)
+                    tagset = _el(root, "TagSet")
+                    raw = entry.extended.get("s3-tags", b"{}")
+                    for k2, v2 in sorted(json.loads(raw).items()):
+                        t = _el(tagset, "Tag")
+                        _el(t, "Key", k2)
+                        _el(t, "Value", v2)
+                    return self._respond(200, _xml(root))
+                if m == "PUT":
+                    doc = ET.fromstring(self._read_body())
+                    ns = doc.tag[: doc.tag.index("}") + 1] if doc.tag.startswith("{") else ""
+                    tags = {}
+                    for t in doc.iter(f"{ns}Tag"):
+                        k2 = t.findtext(f"{ns}Key") or ""
+                        # AWS rejects bad tag sets rather than storing a subset
+                        if not k2 or k2 in tags:
+                            return self._error(
+                                400, "InvalidTag", f"empty or duplicate key {k2!r}"
+                            )
+                        tags[k2] = t.findtext(f"{ns}Value") or ""
+                    if len(tags) > 10:
+                        return self._error(
+                            400, "BadRequest", "object tag set exceeds 10 tags"
+                        )
+                    srv.filer.mutate_entry(
+                        path,
+                        lambda e: e.extended.__setitem__(
+                            "s3-tags", json.dumps(tags, sort_keys=True).encode()
+                        ),
+                    )
+                    return self._respond(200)
+                if m == "DELETE":
+                    srv.filer.mutate_entry(
+                        path, lambda e: e.extended.pop("s3-tags", None)
+                    )
                     return self._respond(204)
                 return self._error(405, "MethodNotAllowed", m)
 

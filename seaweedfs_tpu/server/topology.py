@@ -49,13 +49,24 @@ class DataNode:
 
 
 class Topology:
-    def __init__(self, volume_size_limit: int = 30 * 1024**3, dead_after: float = 30.0):
+    def __init__(
+        self,
+        volume_size_limit: int = 30 * 1024**3,
+        dead_after: float = 30.0,
+        sequencer=None,
+    ):
         self.volume_size_limit = volume_size_limit
         self.dead_after = dead_after
         self._lock = threading.RLock()
         self.nodes: dict[str, DataNode] = {}
         self.max_volume_id = 0
-        self._sequence = 0
+        if sequencer is None:
+            # snowflake: needle ids must survive master restarts — a
+            # reused id would overwrite an existing blob in its volume
+            from ..utils.sequence import SnowflakeSequencer
+
+            sequencer = SnowflakeSequencer()
+        self._sequencer = sequencer
 
     # -------------------------------------------------------- heartbeats
 
@@ -169,9 +180,7 @@ class Topology:
     # ---------------------------------------------------- write planning
 
     def next_needle_id(self) -> int:
-        with self._lock:
-            self._sequence += 1
-            return self._sequence
+        return self._sequencer.next_id()
 
     def next_volume_id(self) -> int:
         with self._lock:

@@ -186,6 +186,40 @@ def test_bucket_collection_lifecycle(s3, cluster):
         mc.close()
 
 
+def test_object_tagging_and_versioning_status(s3):
+    requests.put(f"{s3}/tagb")
+    requests.put(f"{s3}/tagb/obj", data=b"tagged")
+    body = (
+        "<Tagging><TagSet>"
+        "<Tag><Key>env</Key><Value>prod</Value></Tag>"
+        "<Tag><Key>team</Key><Value>storage</Value></Tag>"
+        "</TagSet></Tagging>"
+    )
+    assert requests.put(f"{s3}/tagb/obj?tagging", data=body).status_code == 200
+    r = requests.get(f"{s3}/tagb/obj?tagging")
+    assert r.status_code == 200
+    assert xml_find_all(r.text, "Key") == ["env", "team"]
+    assert xml_find_all(r.text, "Value") == ["prod", "storage"]
+    # tags survive unrelated reads; delete clears them
+    assert requests.get(f"{s3}/tagb/obj").content == b"tagged"
+    assert requests.delete(f"{s3}/tagb/obj?tagging").status_code == 204
+    r = requests.get(f"{s3}/tagb/obj?tagging")
+    assert xml_find_all(r.text, "Key") == []
+    # invalid tag sets rejected outright, never stored partially
+    bad = "<Tagging><TagSet>" + "".join(
+        f"<Tag><Key>k{i}</Key><Value>v</Value></Tag>" for i in range(11)
+    ) + "</TagSet></Tagging>"
+    assert requests.put(f"{s3}/tagb/obj?tagging", data=bad).status_code == 400
+    # versioning reports unconfigured; enabling is 501, not misrouted
+    r = requests.get(f"{s3}/tagb?versioning")
+    assert r.status_code == 200 and "VersioningConfiguration" in r.text
+    r = requests.put(
+        f"{s3}/tagb?versioning",
+        data="<VersioningConfiguration><Status>Enabled</Status></VersioningConfiguration>",
+    )
+    assert r.status_code == 501
+
+
 def test_multipart_abort(s3):
     requests.put(f"{s3}/ab")
     r = requests.post(f"{s3}/ab/x?uploads")
