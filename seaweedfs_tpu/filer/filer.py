@@ -21,7 +21,8 @@ from .entry import Entry, new_entry, normalize_path, split_path
 from .filer_store import FilerStore, NotFound
 
 DEFAULT_CHUNK_SIZE = 4 * 1024 * 1024  # reference filer -maxMB default
-INLINE_LIMIT = 0  # small-content inlining threshold (0 = off for now)
+INLINE_LIMIT = 512  # small files live in the entry itself (reference
+# filer small-content inlining): no volume round-trip to read them
 
 
 class FilerError(Exception):
@@ -233,7 +234,10 @@ class Filer:
         mime: str = "",
         mode: int = 0o644,
         collection: str | None = None,
+        inline: bool = True,
     ) -> Entry:
+        """inline=False forces chunked storage even for tiny payloads —
+        chunk-splicing consumers (S3 multipart parts) require chunks."""
         """Slice into chunk_size pieces, assign+upload each, create the
         entry (reference uploadRequestToChunks)."""
         full_path = normalize_path(full_path)
@@ -241,6 +245,16 @@ class Filer:
         if old is not None and old.is_directory:
             # fail BEFORE uploading chunks that create_entry would orphan
             raise FilerError(f"{full_path}: type conflict with existing entry")
+        if inline and len(data) <= INLINE_LIMIT:
+            entry = new_entry(full_path, mode=mode, mime=mime)
+            entry.content = data
+            entry.attr.file_size = len(data)
+            entry.attr.md5 = hashlib.md5(data).digest()
+            self.create_entry(entry)
+            if old is not None and old.chunks:
+                for c in old.chunks:
+                    self._gc_queue.put((c.fid, 0))
+            return entry
         chunks = []
         ts = time.time_ns()
         for off in range(0, len(data), self.chunk_size) or [0]:

@@ -534,6 +534,7 @@ class S3Server:
                     f"{srv._upload_dir(bucket, upload_id)}/{part:05d}.part",
                     data,
                     collection=bucket,
+                    inline=False,  # completion splices chunk lists
                 )
                 self._respond(200, extra={"ETag": f'"{entry.attr.md5.hex()}"'})
 
@@ -573,6 +574,20 @@ class S3Server:
                 # splice chunk lists: no data copy (filer_multipart.go)
                 chunks, offset, md5s = [], 0, []
                 for p in parts:
+                    if p.content and not p.chunks:
+                        # a part stored inline (e.g. pre-inline=False
+                        # uploads) must become a chunk or its bytes
+                        # would vanish from the spliced object
+                        fid = srv.filer.ops.upload(
+                            p.content, collection=bucket
+                        )
+                        c0 = fpb.FileChunk(
+                            fid=fid,
+                            offset=0,
+                            size=len(p.content),
+                            modified_ts_ns=time.time_ns(),
+                        )
+                        p.chunks.append(c0)
                     for c in p.chunks:
                         nc = fpb.FileChunk()
                         nc.CopyFrom(c)

@@ -435,8 +435,11 @@ class MasterServer:
 
     def _vacuum_loop(self) -> None:
         """Periodic garbage sweep (reference topology_vacuum.go): ask
-        every holder of a garbage-heavy volume to compact."""
+        every holder of a garbage-heavy volume to compact. Doubles as
+        the dead-node sweeper for heartbeat streams that hung without
+        breaking (prune_dead was otherwise never invoked)."""
         while not self._vacuum_stop.wait(self.vacuum_interval):
+            self.topo.prune_dead()
             self.vacuum_once()
 
     def vacuum_once(self) -> list[int]:

@@ -9,10 +9,11 @@
 from __future__ import annotations
 
 import argparse
+import io
+import json
 import os
 import sys
 import tarfile
-import io
 
 
 from ..storage.types import NeedleValue
@@ -97,6 +98,71 @@ def cmd_compact(a) -> int:
     return 0
 
 
+def cmd_backup(a) -> int:
+    """Incremental volume backup (reference `weed backup`): .dat is
+    append-only, so each run copies only the new tail plus the current
+    .idx; the backup directory is itself a loadable volume directory."""
+
+    from ..storage.super_block import SUPER_BLOCK_SIZE, SuperBlock
+
+    src_base = _base(a)
+    os.makedirs(a.o, exist_ok=True)
+    name = os.path.basename(src_base)
+    dst_base = os.path.join(a.o, name)
+    state_path = dst_base + ".backup.state"
+    last = 0
+    last_rev = -1
+    if os.path.exists(state_path):
+        try:
+            st = json.load(open(state_path))
+            last = st["size"]
+            last_rev = st.get("revision", -1)
+        except (ValueError, KeyError, OSError):
+            last = 0
+    src_size = os.path.getsize(src_base + ".dat")
+    with open(src_base + ".dat", "rb") as f:
+        revision = SuperBlock.from_bytes(f.read(SUPER_BLOCK_SIZE)).compaction_revision
+    if last_rev != -1 and revision != last_rev:
+        # compaction shifted every offset — size alone can't detect it
+        # when post-vacuum writes regrow the file past the old size
+        print(
+            f"compaction revision changed ({last_rev} -> {revision}); "
+            "taking a fresh full backup"
+        )
+        last = 0
+    elif src_size < last:
+        print("source shrank; taking a fresh full backup")
+        last = 0
+    if not os.path.exists(dst_base + ".dat"):
+        last = 0  # stale state without a backup file: full copy
+    with open(src_base + ".dat", "rb") as src:
+        src.seek(last)
+        mode = "r+b" if last > 0 else "wb"
+        with open(dst_base + ".dat", mode) as dst:
+            dst.seek(last)
+            copied = 0
+            while True:
+                chunk = src.read(1 << 20)
+                if not chunk:
+                    break
+                dst.write(chunk)
+                copied += len(chunk)
+            dst.truncate(src_size)
+            dst.flush()
+            os.fsync(dst.fileno())
+    # .idx is small and replayable: copy whole
+    with open(src_base + ".idx", "rb") as f:
+        idx = f.read()
+    with open(dst_base + ".idx", "wb") as f:
+        f.write(idx)
+        f.flush()
+        os.fsync(f.fileno())
+    with open(state_path, "w") as f:
+        json.dump({"size": src_size, "revision": revision}, f)
+    print(f"backed up volume {a.volumeId}: +{copied} bytes (total {src_size})")
+    return 0
+
+
 def cmd_scan(a) -> int:
     base = _base(a)
     sb, items = scan_volume_file(base + ".dat")
@@ -120,12 +186,13 @@ def main(argv=None) -> int:
         ("export", cmd_export),
         ("compact", cmd_compact),
         ("scan", cmd_scan),
+        ("backup", cmd_backup),
     ):
         sp = sub.add_parser(name)
         sp.add_argument("-dir", required=True)
         sp.add_argument("-volumeId", type=int, required=True)
         sp.add_argument("-collection", default="")
-        if name == "export":
+        if name in ("export", "backup"):
             sp.add_argument("-o", required=True)
         sp.set_defaults(fn=fn)
     a = p.parse_args(argv)

@@ -185,6 +185,35 @@ def test_filer_write_read_chunked(cluster, tmp_path):
         f.close()
 
 
+def test_small_content_inlining(cluster):
+    f = Filer(MemoryStore(), master=f"localhost:{cluster}")
+    try:
+        e = f.write_file("/tiny/note.txt", b"inline me", mime="text/plain")
+        assert e.content == b"inline me" and not e.chunks
+        assert f.read_file("/tiny/note.txt") == b"inline me"
+        assert f.read_file("/tiny/note.txt", 2, 4) == b"line"
+        # growing past the limit switches to chunks
+        big = b"B" * 10_000
+        e2 = f.write_file("/tiny/note.txt", big)
+        assert e2.chunks and not e2.content
+        assert f.read_file("/tiny/note.txt") == big
+        # shrinking back inlines again and GCs the chunks
+        old_fids = [c.fid for c in e2.chunks]
+        f.write_file("/tiny/note.txt", b"small again")
+        assert f.read_file("/tiny/note.txt") == b"small again"
+        f.flush_gc()
+        import time as _t
+
+        _t.sleep(0.3)
+        import pytest as _pytest
+
+        for fid in old_fids:
+            with _pytest.raises(LookupError):
+                f.ops.read(fid)
+    finally:
+        f.close()
+
+
 def test_filer_http_server(cluster, tmp_path):
     fport = free_port()
     f = Filer(

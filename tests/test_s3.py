@@ -220,6 +220,23 @@ def test_object_tagging_and_versioning_status(s3):
     assert r.status_code == 501
 
 
+def test_multipart_with_tiny_part(s3):
+    """Parts at or below the filer inline threshold must still splice
+    into the completed object (regression: inlined parts vanished)."""
+    requests.put(f"{s3}/mptiny")
+    r = requests.post(f"{s3}/mptiny/t.bin?uploads")
+    upload_id = xml_find_all(r.text, "UploadId")[0]
+    parts = [b"X" * 100_000, b"tiny-tail"]  # part 2 is 9 bytes
+    for i, p in enumerate(parts, start=1):
+        assert requests.put(
+            f"{s3}/mptiny/t.bin?partNumber={i}&uploadId={upload_id}", data=p
+        ).status_code == 200
+    r = requests.post(f"{s3}/mptiny/t.bin?uploadId={upload_id}", data="<Complete/>")
+    assert r.status_code == 200
+    got = requests.get(f"{s3}/mptiny/t.bin")
+    assert got.content == b"".join(parts)
+
+
 def test_multipart_abort(s3):
     requests.put(f"{s3}/ab")
     r = requests.post(f"{s3}/ab/x?uploads")
@@ -327,6 +344,68 @@ def test_malformed_inputs_return_400(s3):
     assert r.status_code == 400
     r = requests.post(f"{s3}/bad?delete", data=b"<notxml")
     assert r.status_code == 400
+
+
+def presign_url(method, url, access_key, secret, expires=3600, region="us-east-1"):
+    u = urllib.parse.urlparse(url)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    date = now.strftime("%Y%m%d")
+    scope = f"{date}/{region}/s3/aws4_request"
+    q = {
+        "X-Amz-Algorithm": "AWS4-HMAC-SHA256",
+        "X-Amz-Credential": f"{access_key}/{scope}",
+        "X-Amz-Date": amz_date,
+        "X-Amz-Expires": str(expires),
+        "X-Amz-SignedHeaders": "host",
+    }
+    cq = "&".join(
+        f"{urllib.parse.quote(k, safe='-_.~')}={urllib.parse.quote(v, safe='-_.~')}"
+        for k, v in sorted(q.items())
+    )
+    creq = "\n".join(
+        [
+            method,
+            urllib.parse.quote(u.path or "/", safe="/-_.~"),
+            cq,
+            f"host:{u.netloc}\n",
+            "host",
+            "UNSIGNED-PAYLOAD",
+        ]
+    )
+    sts = "\n".join(
+        [
+            "AWS4-HMAC-SHA256",
+            amz_date,
+            scope,
+            hashlib.sha256(creq.encode()).hexdigest(),
+        ]
+    )
+
+    def h(key, msg):
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+    k = h(h(h(h(("AWS4" + secret).encode(), date), region), "s3"), "aws4_request")
+    sig = hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()
+    return f"{url}?{cq}&X-Amz-Signature={sig}"
+
+
+def test_presigned_urls(s3_signed):
+    base = s3_signed
+    h = sign_request("PUT", f"{base}/pres", "AKIDEXAMPLE", "secret123")
+    assert requests.put(f"{base}/pres", headers=h).status_code == 200
+    body = b"presigned content"
+    h = sign_request("PUT", f"{base}/pres/obj", "AKIDEXAMPLE", "secret123", body)
+    assert requests.put(f"{base}/pres/obj", data=body, headers=h).status_code == 200
+    # a presigned GET works with no Authorization header at all
+    url = presign_url("GET", f"{base}/pres/obj", "AKIDEXAMPLE", "secret123")
+    r = requests.get(url)
+    assert r.status_code == 200 and r.content == body
+    # tampered signature rejected
+    assert requests.get(url[:-4] + "beef").status_code == 403
+    # expired presign rejected
+    url = presign_url("GET", f"{base}/pres/obj", "AKIDEXAMPLE", "secret123", expires=-1)
+    assert requests.get(url).status_code == 403
 
 
 def test_sigv4_auth(s3_signed):

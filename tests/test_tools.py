@@ -75,6 +75,42 @@ def test_compact_tool(tmp_path):
     v.close()
 
 
+def test_incremental_backup(tmp_path):
+    bdir = str(tmp_path / "bk")
+    v = Volume(str(tmp_path), 11)
+    for i in range(1, 6):
+        v.write_needle(Needle(cookie=i, needle_id=i, data=bytes([i]) * 5000))
+    v.flush()
+    assert tools_main(
+        ["backup", "-dir", str(tmp_path), "-volumeId", "11", "-o", bdir]
+    ) == 0
+    # append more, delete one, backup incrementally
+    for i in range(6, 9):
+        v.write_needle(Needle(cookie=i, needle_id=i, data=bytes([i]) * 5000))
+    v.delete_needle(2)
+    v.flush()
+    assert tools_main(
+        ["backup", "-dir", str(tmp_path), "-volumeId", "11", "-o", bdir]
+    ) == 0
+    v.close()
+    # the backup dir is a loadable volume with identical live content
+    b = Volume(bdir, 11, create=False)
+    assert not b.has_needle(2)
+    for i in (1, 5, 8):
+        assert b.read_needle(i).data == bytes([i]) * 5000
+    b.close()
+    # post-vacuum source forces a clean full re-backup
+    v = Volume(str(tmp_path), 11, create=False)
+    v.vacuum()
+    v.close()
+    assert tools_main(
+        ["backup", "-dir", str(tmp_path), "-volumeId", "11", "-o", bdir]
+    ) == 0
+    b = Volume(bdir, 11, create=False)
+    assert b.read_needle(8).data == bytes([8]) * 5000
+    b.close()
+
+
 def test_scrub_rpcs(tmp_path):
     from seaweedfs_tpu.client.operations import Operations
     from seaweedfs_tpu.pb import cluster_pb2 as pb
