@@ -11,6 +11,10 @@ import signal
 import sys
 import threading
 
+from ..utils.glog import logger
+
+log = logger("launcher")
+
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="seaweedfs_tpu.server")
@@ -86,7 +90,7 @@ def main(argv=None) -> int:
         )
         bs.start()
         servers.append(bs)
-        print(f"mq broker on {a.ip}:{a.port} (filer={a.filer or 'memory-only'})", flush=True)
+        log.info("mq broker on %s:%s (filer=%s)", a.ip, a.port, a.filer or "memory-only")
 
     if a.mode in ("master", "server"):
         from .master import MasterServer
@@ -103,7 +107,7 @@ def main(argv=None) -> int:
         )
         ms.start()
         servers.append(ms)
-        print(f"master listening on {a.ip}:{port} (grpc {ms.grpc_port})", flush=True)
+        log.info("master listening on %s:%s (grpc %s)", a.ip, port, ms.grpc_port)
 
     if a.mode in ("volume", "server"):
         from .volume_server import VolumeServer
@@ -124,7 +128,7 @@ def main(argv=None) -> int:
         )
         vs.start()
         servers.append(vs)
-        print(f"volume server on {a.ip}:{a.port} (grpc {vs.grpc_port})", flush=True)
+        log.info("volume server on %s:%s (grpc %s)", a.ip, a.port, vs.grpc_port)
 
     if a.mode == "filer" or (
         a.mode == "server" and (a.filer or a.s3 or a.webdav)
@@ -151,12 +155,12 @@ def main(argv=None) -> int:
             from ..filer.notification import WebhookNotifier
 
             filer.subscribe(WebhookNotifier(a.notify_webhook))
-            print(f"filer events -> webhook {a.notify_webhook}", flush=True)
+            log.info("filer events -> webhook %s", a.notify_webhook)
         if getattr(a, "notify_mq", ""):
             from ..filer.notification import MqNotifier
 
             filer.subscribe(MqNotifier(a.notify_mq))
-            print(f"filer events -> mq {a.notify_mq}", flush=True)
+            log.info("filer events -> mq %s", a.notify_mq)
         from ..filer.meta_log import MetaLog
 
         fs = FilerServer(
@@ -167,7 +171,7 @@ def main(argv=None) -> int:
         )
         fs.start()
         servers.append(fs)
-        print(f"filer on {a.ip}:{fport}", flush=True)
+        log.info("filer on %s:%s", a.ip, fport)
 
         if a.mode == "server" and a.s3:
             from ..s3 import Identity, IdentityStore, S3Server
@@ -178,7 +182,7 @@ def main(argv=None) -> int:
             s3srv = S3Server(filer, ip=a.ip, port=a.s3Port, identities=idents)
             s3srv.start()
             servers.append(s3srv)
-            print(f"s3 gateway on {a.ip}:{a.s3Port}", flush=True)
+            log.info("s3 gateway on %s:%s", a.ip, a.s3Port)
 
         if a.mode == "server" and getattr(a, "webdav", False):
             from .webdav_server import WebDavServer
@@ -186,7 +190,7 @@ def main(argv=None) -> int:
             wd = WebDavServer(filer, ip=a.ip, port=a.webdavPort)
             wd.start()
             servers.append(wd)
-            print(f"webdav on {a.ip}:{a.webdavPort}", flush=True)
+            log.info("webdav on %s:%s", a.ip, a.webdavPort)
 
     stop.wait()
     for srv in servers:

@@ -104,6 +104,19 @@ class FilerServer:
                     if self.command != "HEAD":
                         self.wfile.write(body)
                     return
+                if q.get("chunks", [""])[0] == "true":
+                    # chunk manifest for fsck/ops tooling
+                    body = json.dumps(
+                        {"chunks": [c.fid for c in entry.chunks]}
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("X-Filer-Chunks", "true")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    if self.command != "HEAD":
+                        self.wfile.write(body)
+                    return
                 total = entry.file_size
                 # HEAD never touches the data plane: size/type come from
                 # the metadata entry alone.

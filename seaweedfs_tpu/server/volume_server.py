@@ -38,6 +38,9 @@ from ..storage.volume import (
 from ..pb import cluster_pb2 as pb
 from ..pb import rpc
 from ..utils import metrics as M
+from ..utils.glog import logger
+
+log = logger("volume")
 
 _EC_STREAM_CHUNK = 256 * 1024
 
@@ -854,7 +857,7 @@ class VolumeServer:
                 # the reaper tick for expired TTL volumes
                 reaped = self.store.reap_expired_volumes()
                 if reaped:
-                    print(f"reaped expired TTL volumes: {reaped}", flush=True)
+                    log.info("reaped expired TTL volumes: %s", reaped)
                 yield self._full_heartbeat()
                 last_full = time.time()
 

@@ -731,6 +731,126 @@ def fs_rm(env: ShellEnv, args) -> str:
     return "ok" if r.status_code in (200, 204) else f"error: {r.text}"
 
 
+@command("fs.tree", "fs.tree /path (recursive listing)")
+def fs_tree(env: ShellEnv, args) -> str:
+    from ..client.filer_client import FilerListingError, list_dir
+
+    root = args[0] if args else "/"
+    lines = [root]
+    # explicit pre-order work list: correct nesting without Python
+    # recursion limits on deep namespaces
+    work: list = [("dir", root, 1, True)]
+    try:
+        while work:
+            item = work.pop()
+            if item[0] == "line":
+                lines.append(item[1])
+                continue
+            _, path, depth, strict = item
+            sub: list = []
+            for e in list_dir(env.filer_addr, path, strict=strict):
+                name = e["FullPath"].rsplit("/", 1)[-1]
+                sub.append(
+                    ("line", "  " * depth + name + ("/" if e["IsDirectory"] else ""))
+                )
+                if e["IsDirectory"]:
+                    sub.append(("dir", e["FullPath"], depth + 1, False))
+            work.extend(reversed(sub))
+    except FilerListingError as e:
+        return f"error: {e}"
+    return "\n".join(lines)
+
+
+@command("fs.du", "fs.du /path (recursive size)")
+def fs_du(env: ShellEnv, args) -> str:
+    from ..client.filer_client import FilerListingError, walk
+
+    root = args[0] if args else "/"
+    total = files = dirs = 0
+    try:
+        for e in walk(env.filer_addr, root, strict=True):
+            if e["IsDirectory"]:
+                dirs += 1
+            else:
+                files += 1
+                total += e["FileSize"]
+    except FilerListingError as e:
+        return f"error: {e}"
+    return f"{total:,} bytes in {files} files, {dirs} directories under {root}"
+
+
+@command("volume.fsck", "cross-check filer chunk references against volumes")
+def volume_fsck(env: ShellEnv, args) -> str:
+    """Referential check (reference volume.fsck direction filer->volume):
+    every chunk a filer entry references must be readable on a volume.
+    (The reverse direction — unreferenced volume needles — is not
+    scanned: raw blob-API uploads are legitimately filer-less.)"""
+    from ..client.filer_client import FilerListingError, walk
+    from ..storage.file_id import FileId, FileIdError
+
+    p = argparse.ArgumentParser(prog="volume.fsck")
+    p.add_argument("-path", default="/")
+    a = p.parse_args(args)
+    referenced: dict[int, set] = {}
+    entries = 0
+    skipped = 0
+    import requests as rq
+
+    try:
+        for e in walk(env.filer_addr, a.path, strict=True):
+            if e["IsDirectory"]:
+                continue
+            entries += 1
+            r = rq.get(
+                _filer_url(env, e["FullPath"]),
+                params={"chunks": "true"},
+                timeout=30,
+            )
+            if r.headers.get("X-Filer-Chunks") != "true":
+                skipped += 1  # filer without the chunk-manifest endpoint
+                continue
+            for fid in r.json().get("chunks", []):
+                try:
+                    f = FileId.parse(fid)
+                except FileIdError:
+                    continue
+                referenced.setdefault(f.volume_id, set()).add(f.needle_id)
+    except FilerListingError as e:
+        return f"error: {e}"
+    broken = []
+    checked = 0
+    for vid, nids in sorted(referenced.items()):
+        try:
+            loc = _locate_volume(env, vid)
+        except LookupError:
+            broken.extend((vid, n, "volume has no locations") for n in nids)
+            continue
+        try:
+            ch, stub = _volume_stub(loc)
+            with ch:
+                for nid in nids:
+                    checked += 1
+                    r2 = stub.ReadNeedle(
+                        pb.ReadNeedleRequest(volume_id=vid, needle_id=nid),
+                        timeout=30,
+                    )
+                    if r2.error:
+                        broken.append((vid, nid, r2.error))
+        except grpc.RpcError as e:
+            # one dead server must not discard the rest of the scan
+            broken.extend(
+                (vid, n, f"holder unreachable: {e.code().name}") for n in nids
+            )
+    out = [f"fsck: {entries} entries, {checked} chunk references checked"]
+    if skipped:
+        out.append(f"WARNING: {skipped} entries skipped (no chunk manifest endpoint)")
+    if broken:
+        out += [f"BROKEN: volume {v} needle {n:x} ({why})" for v, n, why in broken]
+    else:
+        out.append("no broken chunk references")
+    return "\n".join(out)
+
+
 @command("fs.mkdir", "fs.mkdir /path")
 def fs_mkdir(env: ShellEnv, args) -> str:
     import requests as rq

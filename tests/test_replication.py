@@ -151,6 +151,37 @@ def test_fs_meta_save_load(two_clusters, tmp_path):
         env.close()
 
 
+def test_fs_tree_du_fsck(two_clusters, tmp_path):
+    from seaweedfs_tpu.shell.commands import ShellEnv, run_command
+
+    master0 = two_clusters[0][0]
+    fport = two_clusters[0][4]
+    base = f"http://localhost:{fport}"
+    requests.post(f"{base}/proj/src/a.py", data=b"x" * 4000)
+    requests.post(f"{base}/proj/src/lib/b.py", data=b"y" * 6000)
+    env = ShellEnv(f"localhost:{master0.port}", filer=f"localhost:{fport}")
+    try:
+        out = run_command(env, "fs.tree /proj")
+        assert "src/" in out and "a.py" in out and "b.py" in out
+        out = run_command(env, "fs.du /proj")
+        assert "10,000 bytes in 2 files" in out, out
+        out = run_command(env, "volume.fsck -path /proj")
+        assert "no broken chunk references" in out, out
+        # break a reference: delete the chunk blob behind a.py directly
+        r = requests.get(f"{base}/proj/src/a.py?chunks=true")
+        assert r.headers.get("X-Filer-Chunks") == "true"
+        fid = r.json()["chunks"][0]
+        vs = two_clusters[0][1]
+        from seaweedfs_tpu.storage.file_id import FileId
+
+        f = FileId.parse(fid)
+        vs.store.delete_needle(f.volume_id, f.needle_id)
+        out = run_command(env, "volume.fsck -path /proj")
+        assert "BROKEN" in out, out
+    finally:
+        env.close()
+
+
 def test_filer_sync_full_and_tail(two_clusters):
     src = two_clusters[0][4]
     dst = two_clusters[1][4]
