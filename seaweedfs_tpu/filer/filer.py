@@ -38,9 +38,15 @@ class Filer:
         replication: str = "",
         chunk_size: int = DEFAULT_CHUNK_SIZE,
         jwt_key: str = "",
+        chunk_cache_bytes: int = 64 * 1024 * 1024,
     ):
         self.store = store
         self.ops = Operations(master, jwt_key=jwt_key)
+        from ..utils.chunk_cache import ChunkCache
+
+        # read-path LRU (reference chunk_cache memory tier); fids are
+        # immutable so cached bytes can never go stale
+        self.chunk_cache = ChunkCache(chunk_cache_bytes)
         self.collection = collection
         self.replication = replication
         self.chunk_size = chunk_size
@@ -181,8 +187,7 @@ class Filer:
             self.store.delete_folder_children(entry.full_path)
         self.store.delete(directory, name)
         if gc_chunks and entry.chunks:
-            for c in entry.chunks:
-                self._gc_queue.put((c.fid, 0))
+            self.gc_chunks(entry.chunks)
         self._notify(directory, entry, None, delete_chunks=gc_chunks)
 
     def rename(self, old_path: str, new_path: str) -> None:
@@ -201,8 +206,7 @@ class Filer:
                 raise FilerError(f"{new_path} exists and is a directory")
             if entry.is_directory:
                 raise FilerError(f"cannot rename directory over file {new_path}")
-            for c in dest.chunks:
-                self._gc_queue.put((c.fid, 0))
+            self.gc_chunks(dest.chunks)
         if entry.is_directory:
             # move the whole subtree
             for child in list(self.store.list(entry.full_path, limit=1_000_000)):
@@ -252,8 +256,7 @@ class Filer:
             entry.attr.md5 = hashlib.md5(data).digest()
             self.create_entry(entry)
             if old is not None and old.chunks:
-                for c in old.chunks:
-                    self._gc_queue.put((c.fid, 0))
+                self.gc_chunks(old.chunks)
             return entry
         chunks = []
         ts = time.time_ns()
@@ -284,12 +287,10 @@ class Filer:
             self.create_entry(entry)
         except BaseException:
             # a losing race still must not leak the uploaded chunks
-            for c in chunks:
-                self._gc_queue.put((c.fid, 0))
+            self.gc_chunks(chunks)
             raise
         if old is not None and old.chunks:
-            for c in old.chunks:
-                self._gc_queue.put((c.fid, 0))
+            self.gc_chunks(old.chunks)
         return entry
 
     def read_file(
@@ -312,7 +313,13 @@ class Filer:
             return b""
         buf = bytearray(size)
         for view in read_chunk_views(entry.chunks, offset, size):
-            chunk_data = self.ops.read(view.fid)
+            chunk_data = self.chunk_cache.get(view.fid)
+            if chunk_data is None:
+                chunk_data = self.ops.read(view.fid)
+                # admit only modest chunks: one large streaming read must
+                # not flush the whole hot set out of the LRU
+                if len(chunk_data) <= self.chunk_cache.capacity // 8:
+                    self.chunk_cache.put(view.fid, chunk_data)
             piece = chunk_data[view.offset_in_chunk : view.offset_in_chunk + view.size]
             lo = view.logical_offset - offset
             buf[lo : lo + len(piece)] = piece
@@ -323,6 +330,7 @@ class Filer:
     def gc_chunks(self, chunks) -> None:
         """Enqueue chunk fids for async deletion on the volume servers."""
         for c in chunks:
+            self.chunk_cache.drop(c.fid)  # dead bytes must not pin the LRU
             self._gc_queue.put((c.fid, 0))
 
     _GC_MAX_ATTEMPTS = 5

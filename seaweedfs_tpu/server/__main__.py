@@ -25,6 +25,10 @@ def main(argv=None) -> int:
     m.add_argument("-port", type=int, default=9333)
     m.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
     m.add_argument("-jwt.key", dest="jwt_key", default="")
+    m.add_argument(
+        "-ec.autoFullness", dest="ec_auto", type=float, default=0.0,
+        help="auto-submit ec_encode for volumes at this fraction of the size limit (0=off)",
+    )
 
     v = sub.add_parser("volume")
     v.add_argument("-ip", default="localhost")
@@ -71,6 +75,10 @@ def main(argv=None) -> int:
     s.add_argument("-notify.webhook", dest="notify_webhook", default="")
     s.add_argument("-notify.mq", dest="notify_mq", default="")
     s.add_argument("-webdav", action="store_true", help="also run WebDAV")
+    s.add_argument(
+        "-ec.autoFullness", dest="ec_auto", type=float, default=0.0,
+        help="auto-submit ec_encode for volumes at this fraction of the size limit (0=off)",
+    )
     s.add_argument("-webdavPort", type=int, default=7333)
 
     a = p.parse_args(argv)
@@ -104,6 +112,7 @@ def main(argv=None) -> int:
         ms = MasterServer(
             ip=a.ip, port=port, volume_size_limit=limit,
             jwt_key=getattr(a, "jwt_key", ""),
+            ec_auto_fullness=getattr(a, "ec_auto", 0.0),
         )
         ms.start()
         servers.append(ms)

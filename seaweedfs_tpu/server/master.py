@@ -259,7 +259,13 @@ class MasterServer:
         jwt_key: str = "",
         garbage_threshold: float = 0.3,
         vacuum_interval: float = 60.0,
+        ec_auto_fullness: float = 0.0,
+        ec_quiet_seconds: float = 60.0,
     ):
+        """ec_auto_fullness > 0 turns on the maintenance scanner: volumes
+        at that fraction of the size limit (and write-quiet) get an
+        ec_encode task submitted for the worker fleet (reference admin
+        maintenance scanner)."""
         self.ip = ip
         self.port = port
         self.grpc_port = grpc_port or (port + 10000)
@@ -267,6 +273,8 @@ class MasterServer:
         self.service = MasterService(self.topo, jwt_key=jwt_key)
         self.garbage_threshold = garbage_threshold
         self.vacuum_interval = vacuum_interval
+        self.ec_auto_fullness = ec_auto_fullness
+        self.ec_quiet_seconds = ec_quiet_seconds
         self._vacuum_stop = threading.Event()
         self._vacuum_thread = threading.Thread(
             target=self._vacuum_loop, daemon=True
@@ -441,6 +449,13 @@ class MasterServer:
         while not self._vacuum_stop.wait(self.vacuum_interval):
             self.topo.prune_dead()
             self.vacuum_once()
+            if self.ec_auto_fullness > 0:
+                self.worker_control.scan_for_ec_candidates(
+                    self.topo,
+                    self.ec_auto_fullness,
+                    self.topo.volume_size_limit,
+                    quiet_seconds=self.ec_quiet_seconds,
+                )
 
     def vacuum_once(self) -> list[int]:
         vacuumed = []

@@ -1,0 +1,51 @@
+"""Byte-bounded LRU chunk cache (reference weed/util/chunk_cache, the
+memory tier). Chunk fids are immutable — a fid's bytes never change —
+so entries need no invalidation, only eviction."""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+
+class ChunkCache:
+    def __init__(self, capacity_bytes: int = 64 * 1024 * 1024):
+        self.capacity = capacity_bytes
+        self._lock = threading.Lock()
+        self._data: "OrderedDict[str, bytes]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, fid: str) -> bytes | None:
+        with self._lock:
+            val = self._data.get(fid)
+            if val is None:
+                self.misses += 1
+                return None
+            self._data.move_to_end(fid)
+            self.hits += 1
+            return val
+
+    def put(self, fid: str, data: bytes) -> None:
+        if len(data) > self.capacity:
+            return  # never let one chunk flush the whole cache
+        with self._lock:
+            old = self._data.pop(fid, None)
+            if old is not None:
+                self._bytes -= len(old)
+            self._data[fid] = data
+            self._bytes += len(data)
+            while self._bytes > self.capacity and self._data:
+                _, evicted = self._data.popitem(last=False)
+                self._bytes -= len(evicted)
+
+    def drop(self, fid: str) -> None:
+        with self._lock:
+            old = self._data.pop(fid, None)
+            if old is not None:
+                self._bytes -= len(old)
+
+    @property
+    def size_bytes(self) -> int:
+        return self._bytes

@@ -1,0 +1,107 @@
+"""Chunk cache tests + filer read-path integration + auto-EC scanner
+wiring (reference weed/util/chunk_cache, admin maintenance loop)."""
+
+import time
+
+from conftest import allocate_port as free_port
+from seaweedfs_tpu.utils.chunk_cache import ChunkCache
+
+
+def test_lru_eviction_and_bounds():
+    c = ChunkCache(capacity_bytes=1000)
+    c.put("a", b"x" * 400)
+    c.put("b", b"y" * 400)
+    assert c.get("a") == b"x" * 400  # refresh a
+    c.put("c", b"z" * 400)  # evicts b (LRU)
+    assert c.get("b") is None
+    assert c.get("a") is not None and c.get("c") is not None
+    assert c.size_bytes <= 1000
+    # oversized items are rejected, not cached
+    c.put("huge", b"q" * 2000)
+    assert c.get("huge") is None
+    # replacement updates accounting
+    c.put("a", b"small")
+    assert c.get("a") == b"small"
+    c.drop("a")
+    assert c.get("a") is None
+
+
+def test_filer_read_path_uses_cache(tmp_path):
+    from seaweedfs_tpu.filer import Filer, MemoryStore
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    mport = free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path / "v")],
+        master=f"localhost:{mport}",
+        ip="localhost",
+        port=free_port(),
+        ec_backend="cpu",
+    )
+    vs.start()
+    while not master.topo.nodes:
+        time.sleep(0.05)
+    f = Filer(MemoryStore(), master=f"localhost:{mport}", chunk_size=16 * 1024)
+    try:
+        data = bytes(range(256)) * 300  # ~75KB -> 5 chunks
+        f.write_file("/c/cached.bin", data)
+        assert f.read_file("/c/cached.bin") == data
+        misses_after_first = f.chunk_cache.misses
+        assert f.read_file("/c/cached.bin") == data
+        assert f.chunk_cache.misses == misses_after_first, "second read cached"
+        assert f.chunk_cache.hits >= 5
+    finally:
+        f.close()
+        vs.stop()
+        master.stop()
+
+
+def test_master_auto_ec_scanner(tmp_path):
+    from seaweedfs_tpu.client.operations import Operations
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.storage.file_id import FileId
+
+    mport = free_port()
+    master = MasterServer(
+        ip="localhost",
+        port=mport,
+        volume_size_limit=1000,  # tiny: any write crosses fullness
+        vacuum_interval=0.3,
+        ec_auto_fullness=0.5,
+        ec_quiet_seconds=0.0,
+    )
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path / "v")],
+        master=f"localhost:{mport}",
+        ip="localhost",
+        port=free_port(),
+        ec_backend="cpu",
+    )
+    vs.start()
+    while not master.topo.nodes:
+        time.sleep(0.05)
+    ops = Operations(f"localhost:{mport}")
+    try:
+        fid = ops.upload(b"F" * 5000)
+        vid = FileId.parse(fid).volume_id
+        vs.notify_new_volume(vid)
+        deadline = time.time() + 10
+        while True:
+            tasks = [
+                t
+                for t in master.worker_control._tasks.values()
+                if t.kind == "ec_encode" and t.volume_id == vid
+            ]
+            if tasks:
+                break
+            assert time.time() < deadline, "scanner should submit ec task"
+            time.sleep(0.1)
+    finally:
+        ops.close()
+        vs.stop()
+        master.stop()

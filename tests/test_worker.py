@@ -145,10 +145,16 @@ def test_scanner_detects_full_volumes(cluster):
         assert master.worker_control.scan_for_ec_candidates(
             master.topo, 0.9, master.topo.volume_size_limit
         ) == []
-        # with a tiny synthetic limit the volume qualifies
-        tasks = master.worker_control.scan_for_ec_candidates(
-            master.topo, 0.5, 1000
+        # with a tiny synthetic limit the volume qualifies (polled: the
+        # topology view can briefly lag the fresh heartbeat)
+        wait_for(
+            lambda: len(
+                master.worker_control.scan_for_ec_candidates(
+                    master.topo, 0.5, 1000
+                )
+            )
+            >= 1,
+            msg="scanner submits for the full volume",
         )
-        assert len(tasks) >= 1
     finally:
         ops.close()
