@@ -453,13 +453,20 @@ class VolumeService:
         return pb.EcShardsToVolumeResponse()
 
     def CopyFile(self, request, context):
+        """Stream a volume/EC file, optionally from start_offset — the
+        tail form backs incremental remote backup (reference
+        VolumeTailSender / VolumeIncrementalCopy)."""
         base = self._ec_base(request.volume_id, request.collection, require=False)
         path = (base or "") + request.ext
         if base is None or not os.path.exists(path):
             context.abort(grpc.StatusCode.NOT_FOUND, f"no {request.ext}")
+        v = self.store.find_volume(request.volume_id)
+        if v is not None and request.ext in (".dat", ".idx"):
+            v.flush()  # a tail read must see every acknowledged write
         stop = request.stop_offset or os.path.getsize(path)
         with open(path, "rb") as f:
-            sent = 0
+            sent = request.start_offset
+            f.seek(sent)
             while sent < stop:
                 chunk = f.read(min(_EC_STREAM_CHUNK, stop - sent))
                 if not chunk:

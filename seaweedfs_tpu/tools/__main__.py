@@ -98,10 +98,42 @@ def cmd_compact(a) -> int:
     return 0
 
 
+def _remote_reader(source: str, vid: int, collection: str):
+    """CopyFile-backed reader against a live volume server
+    ('host:grpcPort') for remote incremental backup."""
+    import grpc
+
+    from ..pb import cluster_pb2 as pb
+    from ..pb import rpc
+
+    channel = grpc.insecure_channel(source)
+    stub = rpc.volume_stub(channel)
+
+    def stream(ext: str, start: int = 0, stop: int = 0):
+        for c in stub.CopyFile(
+            pb.CopyFileRequest(
+                volume_id=vid,
+                collection=collection,
+                ext=ext,
+                start_offset=start,
+                stop_offset=stop,
+            ),
+            timeout=3600,
+        ):
+            yield c.data
+
+    def read(ext: str, start: int = 0, stop: int = 0) -> bytes:
+        return b"".join(stream(ext, start, stop))
+
+    return read, stream, channel
+
+
 def cmd_backup(a) -> int:
     """Incremental volume backup (reference `weed backup`): .dat is
     append-only, so each run copies only the new tail plus the current
-    .idx; the backup directory is itself a loadable volume directory."""
+    .idx; the backup directory is itself a loadable volume directory.
+    With -from host:grpcPort the source is a LIVE volume server
+    (VolumeTailSender analog) instead of local files."""
 
     from ..storage.super_block import SUPER_BLOCK_SIZE, SuperBlock
 
@@ -119,9 +151,46 @@ def cmd_backup(a) -> int:
             last_rev = st.get("revision", -1)
         except (ValueError, KeyError, OSError):
             last = 0
-    src_size = os.path.getsize(src_base + ".dat")
-    with open(src_base + ".dat", "rb") as f:
-        revision = SuperBlock.from_bytes(f.read(SUPER_BLOCK_SIZE)).compaction_revision
+    remote = getattr(a, "source", "")
+    channel = None
+    if remote:
+        import grpc as _grpc
+
+        from ..ec.decoder import record_actual_size
+        from ..storage.types import NEEDLE_MAP_ENTRY_SIZE, NeedleValue, actual_offset
+
+        read_remote, stream_remote, channel = _remote_reader(
+            remote, a.volumeId, a.collection
+        )
+        try:
+            header = read_remote(".dat", 0, SUPER_BLOCK_SIZE)
+        except _grpc.RpcError as e:
+            print(f"volume {a.volumeId} not readable on {remote}: {e.code().name}")
+            channel.close()
+            return 1
+        sb = SuperBlock.from_bytes(header)
+        revision = sb.compaction_revision
+        # snapshot the .idx FIRST and bound the .dat to the extent its
+        # entries cover: a write racing the backup must never leave idx
+        # entries pointing past the copied data
+        idx = read_remote(".idx")
+        src_size = SUPER_BLOCK_SIZE
+        for off in range(0, len(idx) - len(idx) % NEEDLE_MAP_ENTRY_SIZE,
+                         NEEDLE_MAP_ENTRY_SIZE):
+            nv = NeedleValue.from_bytes(idx[off : off + NEEDLE_MAP_ENTRY_SIZE])
+            if nv.is_deleted:
+                continue
+            src_size = max(
+                src_size,
+                actual_offset(nv.offset)
+                + record_actual_size(nv.size, sb.version),
+            )
+    else:
+        src_size = os.path.getsize(src_base + ".dat")
+        with open(src_base + ".dat", "rb") as f:
+            revision = SuperBlock.from_bytes(
+                f.read(SUPER_BLOCK_SIZE)
+            ).compaction_revision
     if last_rev != -1 and revision != last_rev:
         # compaction shifted every offset — size alone can't detect it
         # when post-vacuum writes regrow the file past the old size
@@ -135,28 +204,42 @@ def cmd_backup(a) -> int:
         last = 0
     if not os.path.exists(dst_base + ".dat"):
         last = 0  # stale state without a backup file: full copy
-    with open(src_base + ".dat", "rb") as src:
-        src.seek(last)
-        mode = "r+b" if last > 0 else "wb"
+    if last > src_size:
+        last = 0  # idx-bounded extent moved backwards: full copy
+    mode = "r+b" if last > 0 else "wb"
+    try:
         with open(dst_base + ".dat", mode) as dst:
             dst.seek(last)
             copied = 0
-            while True:
-                chunk = src.read(1 << 20)
-                if not chunk:
-                    break
-                dst.write(chunk)
-                copied += len(chunk)
+            if remote:
+                # streamed: a large volume must not be held in RAM
+                for chunk in stream_remote(".dat", last, src_size):
+                    dst.write(chunk)
+                    copied += len(chunk)
+            else:
+                with open(src_base + ".dat", "rb") as src:
+                    src.seek(last)
+                    while True:
+                        chunk = src.read(1 << 20)
+                        if not chunk:
+                            break
+                        dst.write(chunk)
+                        copied += len(chunk)
             dst.truncate(src_size)
             dst.flush()
             os.fsync(dst.fileno())
-    # .idx is small and replayable: copy whole
-    with open(src_base + ".idx", "rb") as f:
-        idx = f.read()
-    with open(dst_base + ".idx", "wb") as f:
-        f.write(idx)
-        f.flush()
-        os.fsync(f.fileno())
+        # .idx is small and replayable: copy whole (remote: the snapshot
+        # taken BEFORE the dat copy, so entries never outrun the data)
+        if not remote:
+            with open(src_base + ".idx", "rb") as f:
+                idx = f.read()
+        with open(dst_base + ".idx", "wb") as f:
+            f.write(idx)
+            f.flush()
+            os.fsync(f.fileno())
+    finally:
+        if channel is not None:
+            channel.close()
     with open(state_path, "w") as f:
         json.dump({"size": src_size, "revision": revision}, f)
     print(f"backed up volume {a.volumeId}: +{copied} bytes (total {src_size})")
@@ -194,6 +277,13 @@ def main(argv=None) -> int:
         sp.add_argument("-collection", default="")
         if name in ("export", "backup"):
             sp.add_argument("-o", required=True)
+        if name == "backup":
+            sp.add_argument(
+                "-from",
+                dest="source",
+                default="",
+                help="live volume server host:grpcPort (remote tail backup)",
+            )
         sp.set_defaults(fn=fn)
     a = p.parse_args(argv)
     return a.fn(a)

@@ -111,6 +111,56 @@ def test_incremental_backup(tmp_path):
     b.close()
 
 
+def test_remote_tail_backup(tmp_path):
+    """Incremental backup pulled from a LIVE volume server over gRPC
+    (VolumeTailSender analog)."""
+    from conftest import allocate_port as free_port
+    from seaweedfs_tpu.client.operations import Operations
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.storage.file_id import FileId
+
+    mport = free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path / "v")],
+        master=f"localhost:{mport}",
+        ip="localhost",
+        port=free_port(),
+        ec_backend="cpu",
+    )
+    vs.start()
+    while not master.topo.nodes:
+        time.sleep(0.05)
+    ops = Operations(f"localhost:{mport}")
+    bdir = str(tmp_path / "remote-bk")
+    try:
+        fids = [ops.upload(b"live-%d" % i * 400) for i in range(5)]
+        vid = FileId.parse(fids[0]).volume_id
+        args = [
+            "backup", "-dir", str(tmp_path / "ignored"), "-volumeId",
+            str(vid), "-o", bdir, "-from", f"localhost:{vs.grpc_port}",
+        ]
+        assert tools_main(args) == 0
+        size_after_first = os.path.getsize(f"{bdir}/{vid}.dat")
+        # live appends, then an incremental pull
+        fids += [ops.upload(b"tail-%d" % i * 400) for i in range(3)]
+        assert tools_main(args) == 0
+        assert os.path.getsize(f"{bdir}/{vid}.dat") > size_after_first
+        b = Volume(bdir, vid, create=False)
+        for fid in fids:
+            if FileId.parse(fid).volume_id != vid:
+                continue
+            n = b.read_needle(FileId.parse(fid).needle_id)
+            assert n.data.startswith((b"live-", b"tail-"))
+        b.close()
+    finally:
+        ops.close()
+        vs.stop()
+        master.stop()
+
+
 def test_scrub_rpcs(tmp_path):
     from seaweedfs_tpu.client.operations import Operations
     from seaweedfs_tpu.pb import cluster_pb2 as pb
