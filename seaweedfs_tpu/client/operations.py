@@ -6,6 +6,8 @@ DeleteFiles) — HTTP data plane against volume servers, gRPC to master.
 
 from __future__ import annotations
 
+import time
+
 import requests
 
 from ..storage.file_id import FileId
@@ -28,6 +30,8 @@ class Operations:
             token = sign_jwt(self.jwt_key, fid)
         return {"Authorization": f"Bearer {token}"} if token else {}
 
+    _UPLOAD_ATTEMPTS = 4
+
     def upload(
         self,
         data: bytes,
@@ -37,16 +41,42 @@ class Operations:
         replication: str = "",
         ttl: str = "",
     ) -> str:
-        a = self.master.assign(
-            collection=collection, replication=replication, ttl=ttl
-        )
-        url = f"http://{a.url}/{a.fid}"
-        files = {"file": (name or "file", data, mime or "application/octet-stream")}
-        r = self._http.post(
-            url, files=files, timeout=60, headers=self._auth_headers(a.jwt, a.fid)
-        )
-        r.raise_for_status()
-        return a.fid
+        """Assign + POST with retry (reference UploadWithRetry,
+        upload_content.go): a write can race a volume going readonly
+        (vacuum, ec.encode) or a momentarily-unassignable master —
+        re-assign and try again. 4xx responses are permanent and raise
+        immediately."""
+        last_exc: Exception | None = None
+        for attempt in range(self._UPLOAD_ATTEMPTS):
+            try:
+                a = self.master.assign(
+                    collection=collection, replication=replication, ttl=ttl
+                )
+                url = f"http://{a.url}/{a.fid}"
+                files = {
+                    "file": (name or "file", data, mime or "application/octet-stream")
+                }
+                r = self._http.post(
+                    url,
+                    files=files,
+                    timeout=60,
+                    headers=self._auth_headers(a.jwt, a.fid),
+                )
+            except (requests.RequestException, RuntimeError) as e:
+                last_exc = e  # transient: assign failure / connection error
+            else:
+                if r.status_code < 400:
+                    return a.fid
+                if r.status_code < 500:  # permanent (auth, bad request)
+                    raise requests.HTTPError(
+                        f"{r.status_code} for {url}: {r.text[:200]}"
+                    )
+                last_exc = requests.HTTPError(
+                    f"{r.status_code} for {url}: {r.text[:200]}"
+                )
+            if attempt < self._UPLOAD_ATTEMPTS - 1:
+                time.sleep(0.1 * (attempt + 1))
+        raise last_exc if last_exc is not None else RuntimeError("upload failed")
 
     def read(self, fid: str) -> bytes:
         f = FileId.parse(fid)

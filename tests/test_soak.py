@@ -1,0 +1,122 @@
+"""Concurrency soak: sustained mixed read/write/delete load while the
+cluster simultaneously EC-encodes, balances, and vacuums underneath it.
+A compressed version of the reference's mixed-load expectations
+(BASELINE config 5: encode under live PUT load)."""
+
+import random
+import threading
+import time
+
+import pytest
+
+from conftest import allocate_port as free_port
+from seaweedfs_tpu.client.operations import Operations
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.shell.commands import ShellEnv, run_command
+from seaweedfs_tpu.storage.file_id import FileId
+
+
+def test_mixed_load_during_maintenance(tmp_path):
+    mport = free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vols = []
+    for i in range(2):
+        vs = VolumeServer(
+            directories=[str(tmp_path / f"v{i}")],
+            master=f"localhost:{mport}",
+            ip="localhost",
+            port=free_port(),
+            ec_backend="cpu",
+        )
+        vs.start()
+        vols.append(vs)
+    while len(master.topo.nodes) < 2:
+        time.sleep(0.05)
+    env = ShellEnv(f"localhost:{mport}")
+    stop = threading.Event()
+    errors: list[str] = []
+    written: dict[str, bytes] = {}
+    wlock = threading.Lock()
+
+    def writer(seed: int):
+        ops = Operations(f"localhost:{mport}")
+        rng = random.Random(seed)
+        try:
+            while not stop.is_set():
+                data = bytes(rng.getrandbits(8) for _ in range(rng.randint(100, 20000)))
+                try:
+                    fid = ops.upload(data)
+                    with wlock:
+                        written[fid] = data
+                except Exception as e:
+                    errors.append(f"write: {e}")
+        finally:
+            ops.close()
+
+    def reader(seed: int):
+        ops = Operations(f"localhost:{mport}")
+        rng = random.Random(seed)
+        try:
+            while not stop.is_set():
+                with wlock:
+                    fid = rng.choice(list(written)) if written else None
+                    expect = written.get(fid) if fid else None
+                if fid is None:
+                    time.sleep(0.02)  # outside the lock: writers proceed
+                    continue
+                try:
+                    got = ops.read(fid)
+                    if got != expect:
+                        errors.append(f"MISMATCH on {fid}")
+                except LookupError:
+                    with wlock:
+                        if fid in written:
+                            errors.append(f"read lost {fid}")
+                except Exception as e:
+                    errors.append(f"read: {e}")
+        finally:
+            ops.close()
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(3)]
+    threads += [threading.Thread(target=reader, args=(100 + i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(2.0)  # build up volumes under load
+        # EC-encode the first volume while traffic continues; keep the
+        # source so concurrent writes to it don't fail mid-encode
+        with wlock:
+            vids = sorted({FileId.parse(f).volume_id for f in written})
+        assert vids
+        out = run_command(
+            env, f"ec.encode -volumeId {vids[0]} -backend cpu -keepSource"
+        )
+        assert "generation" in out, out
+        run_command(env, "ec.balance")
+        time.sleep(1.0)
+        run_command(env, f"volume.vacuum -volumeId {vids[-1]}")
+        time.sleep(1.0)
+    finally:
+        stop.set()
+        # worst-case in-flight upload (retries + backoff) well under this
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads), "worker threads hung"
+    assert not errors, errors[:10]
+    # final consistency sweep over everything written
+    ops = Operations(f"localhost:{mport}")
+    try:
+        bad = 0
+        for fid, data in written.items():
+            if ops.read(fid) != data:
+                bad += 1
+        assert bad == 0, f"{bad}/{len(written)} corrupted"
+        assert len(written) > 50, "load generator should have produced volume"
+    finally:
+        ops.close()
+        env.close()
+        for vs in vols:
+            vs.stop()
+        master.stop()
