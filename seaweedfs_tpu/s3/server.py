@@ -48,6 +48,11 @@ def _el(parent, tag, text=None):
     return e
 
 
+def _xml_ns(doc: ET.Element) -> str:
+    """'{ns}' prefix of a parsed document ('' when un-namespaced)."""
+    return doc.tag[: doc.tag.index("}") + 1] if doc.tag.startswith("{") else ""
+
+
 def _iso(ts: int) -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(ts or 0))
 
@@ -97,7 +102,8 @@ class S3Server:
 
             def _respond(self, code: int, body: bytes = b"", ctype="application/xml", extra=None):
                 self.send_response(code)
-                for k, v in (extra or {}).items():
+                merged = {**getattr(self, "_cors", {}), **(extra or {})}
+                for k, v in merged.items():
                     self.send_header(k, v)
                 if code == 204:
                     self.end_headers()
@@ -161,13 +167,22 @@ class S3Server:
             def _handle(self):
                 self._body_read = False
                 self._body_cache = b""
+                self._cors = {}
                 try:
+                    bucket, key, q = self._bucket_key()
+                    m = self.command
+                    if m == "OPTIONS":
+                        # browser preflights carry no Authorization by
+                        # spec: they must be evaluated BEFORE auth
+                        return self._preflight(bucket)
+                    if bucket and self.headers.get("Origin"):
+                        # every response (incl. errors and writes) needs
+                        # the allow-origin header or browsers block it
+                        self._cors = self._cors_response_headers(bucket)
                     try:
                         ident = self._auth()
                     except S3AuthError as e:
                         return self._error(403, e.code, str(e))
-                    bucket, key, q = self._bucket_key()
-                    m = self.command
                     if ident is not None and not ident.allows(
                         _required_action(m, bucket, key)
                     ):
@@ -201,7 +216,58 @@ class S3Server:
                     except (OSError, ValueError):
                         pass
 
-            do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _handle
+            do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = do_OPTIONS = _handle
+
+            # ---- cors ----
+
+            def _cors_rules(self, bucket: str) -> list[dict]:
+                raw = srv.filer.store.kv_get(f"cors-rules/{bucket}".encode())
+                if raw is None:
+                    return []
+                try:
+                    return json.loads(raw)
+                except ValueError:
+                    return []
+
+            def _match_cors(self, bucket: str, origin: str, method: str):
+                for rule in self._cors_rules(bucket):
+                    if method not in rule["methods"]:
+                        continue
+                    for o in rule["origins"]:
+                        if o == "*" or o == origin:
+                            return rule, o
+                return None, None
+
+            def _preflight(self, bucket: str):
+                origin = self.headers.get("Origin", "")
+                method = self.headers.get("Access-Control-Request-Method", "")
+                rule, matched = self._match_cors(bucket, origin, method)
+                if rule is None:
+                    return self._error(403, "AccessForbidden", "CORSResponse")
+                self._respond(
+                    200,
+                    extra={
+                        "Access-Control-Allow-Origin": "*" if matched == "*" else origin,
+                        "Access-Control-Allow-Methods": ", ".join(rule["methods"]),
+                        "Access-Control-Allow-Headers": ", ".join(
+                            rule["headers"]
+                        )
+                        or "*",
+                        "Access-Control-Max-Age": "3600",
+                    },
+                )
+
+            def _cors_response_headers(self, bucket: str) -> dict:
+                origin = self.headers.get("Origin", "")
+                if not origin:
+                    return {}
+                rule, matched = self._match_cors(bucket, origin, self.command)
+                if rule is None:
+                    return {}
+                return {
+                    "Access-Control-Allow-Origin": "*" if matched == "*" else origin,
+                    "Vary": "Origin",
+                }
 
             # ---- service ----
 
@@ -226,6 +292,46 @@ class S3Server:
             def _bucket_op(self, bucket: str, q: dict):
                 path = f"{BUCKETS_ROOT}/{bucket}"
                 m = self.command
+                if m == "PUT" and "cors" in q:
+                    if not srv.filer.exists(path):
+                        return self._error(404, "NoSuchBucket", bucket)
+                    body = self._read_body()
+                    try:
+                        doc = ET.fromstring(body)
+                    except ET.ParseError:
+                        return self._error(400, "MalformedXML", "cors config")
+                    ns = _xml_ns(doc)
+                    rules = []
+                    for rule in doc.iter(f"{ns}CORSRule"):
+                        rules.append(
+                            {
+                                "origins": [
+                                    e.text or ""
+                                    for e in rule.findall(f"{ns}AllowedOrigin")
+                                ],
+                                "methods": [
+                                    e.text or ""
+                                    for e in rule.findall(f"{ns}AllowedMethod")
+                                ],
+                                "headers": [
+                                    e.text or ""
+                                    for e in rule.findall(f"{ns}AllowedHeader")
+                                ],
+                            }
+                        )
+                    if not rules:
+                        return self._error(400, "MalformedXML", "no CORSRule")
+                    # parsed ONCE here; the hot read path loads JSON
+                    srv.filer.store.kv_put(f"cors/{bucket}".encode(), body)
+                    srv.filer.store.kv_put(
+                        f"cors-rules/{bucket}".encode(),
+                        json.dumps(rules).encode(),
+                    )
+                    return self._respond(200)
+                if m == "DELETE" and "cors" in q:
+                    srv.filer.store.kv_delete(f"cors/{bucket}".encode())
+                    srv.filer.store.kv_delete(f"cors-rules/{bucket}".encode())
+                    return self._respond(204)
                 if m == "PUT":
                     if "versioning" in q:
                         # advertised off; enabling it is unimplemented —
@@ -257,6 +363,10 @@ class S3Server:
                     if children:
                         return self._error(409, "BucketNotEmpty", bucket)
                     srv.filer.delete_entry(path, recursive=True)
+                    # a future bucket of the same name must not inherit
+                    # this one's CORS grants
+                    srv.filer.store.kv_delete(f"cors/{bucket}".encode())
+                    srv.filer.store.kv_delete(f"cors-rules/{bucket}".encode())
                     # fast space reclaim: drop the bucket's collection
                     # volumes cluster-wide (reference bucket=collection)
                     try:
@@ -273,6 +383,13 @@ class S3Server:
                         root = ET.Element("LocationConstraint", xmlns=XMLNS)
                         root.text = srv.region
                         return self._respond(200, _xml(root))
+                    if "cors" in q:
+                        raw = srv.filer.store.kv_get(f"cors/{bucket}".encode())
+                        if raw is None:
+                            return self._error(
+                                404, "NoSuchCORSConfiguration", bucket
+                            )
+                        return self._respond(200, raw)
                     if "versioning" in q:
                         # versioning is not implemented; report it off
                         root = ET.Element("VersioningConfiguration", xmlns=XMLNS)
@@ -333,9 +450,7 @@ class S3Server:
             def _delete_objects(self, bucket: str):
                 body = self._read_body()
                 doc = ET.fromstring(body)
-                ns = ""
-                if doc.tag.startswith("{"):
-                    ns = doc.tag[: doc.tag.index("}") + 1]
+                ns = _xml_ns(doc)
                 quiet = (doc.findtext(f"{ns}Quiet") or "").lower() == "true"
                 root = ET.Element("DeleteResult", xmlns=XMLNS)
                 for obj in doc.findall(f"{ns}Object"):
@@ -396,6 +511,7 @@ class S3Server:
                         return self._error(404, "NoSuchKey", key)
                     total = entry.file_size
                     headers = {
+                        **self._cors_response_headers(bucket),
                         "ETag": f'"{_entry_etag(entry)}"',
                         "Last-Modified": time.strftime(
                             "%a, %d %b %Y %H:%M:%S GMT",
@@ -455,7 +571,7 @@ class S3Server:
                     return self._respond(200, _xml(root))
                 if m == "PUT":
                     doc = ET.fromstring(self._read_body())
-                    ns = doc.tag[: doc.tag.index("}") + 1] if doc.tag.startswith("{") else ""
+                    ns = _xml_ns(doc)
                     tags = {}
                     for t in doc.iter(f"{ns}Tag"):
                         k2 = t.findtext(f"{ns}Key") or ""
@@ -557,7 +673,7 @@ class S3Server:
                 body = self._read_body()
                 if body.strip():
                     doc = ET.fromstring(body)
-                    ns = doc.tag[: doc.tag.index("}") + 1] if doc.tag.startswith("{") else ""
+                    ns = _xml_ns(doc)
                     wanted = {
                         int(p.findtext(f"{ns}PartNumber") or "0")
                         for p in doc.findall(f"{ns}Part")

@@ -220,6 +220,56 @@ def test_object_tagging_and_versioning_status(s3):
     assert r.status_code == 501
 
 
+def test_bucket_cors(s3):
+    requests.put(f"{s3}/corsb")
+    requests.put(f"{s3}/corsb/o", data=b"cors body")
+    # no config yet
+    assert requests.get(f"{s3}/corsb?cors").status_code == 404
+    cfg = (
+        "<CORSConfiguration><CORSRule>"
+        "<AllowedOrigin>https://app.example</AllowedOrigin>"
+        "<AllowedMethod>GET</AllowedMethod>"
+        "<AllowedHeader>x-custom</AllowedHeader>"
+        "</CORSRule></CORSConfiguration>"
+    )
+    assert requests.put(f"{s3}/corsb?cors", data=cfg).status_code == 200
+    assert "AllowedOrigin" in requests.get(f"{s3}/corsb?cors").text
+    # preflight allowed
+    r = requests.options(
+        f"{s3}/corsb/o",
+        headers={
+            "Origin": "https://app.example",
+            "Access-Control-Request-Method": "GET",
+        },
+    )
+    assert r.status_code == 200
+    assert r.headers["Access-Control-Allow-Origin"] == "https://app.example"
+    assert "GET" in r.headers["Access-Control-Allow-Methods"]
+    # preflight denied for other origins/methods
+    r = requests.options(
+        f"{s3}/corsb/o",
+        headers={"Origin": "https://evil", "Access-Control-Request-Method": "GET"},
+    )
+    assert r.status_code == 403
+    r = requests.options(
+        f"{s3}/corsb/o",
+        headers={
+            "Origin": "https://app.example",
+            "Access-Control-Request-Method": "DELETE",
+        },
+    )
+    assert r.status_code == 403
+    # actual GET carries the allow-origin header
+    r = requests.get(f"{s3}/corsb/o", headers={"Origin": "https://app.example"})
+    assert r.headers.get("Access-Control-Allow-Origin") == "https://app.example"
+    assert r.content == b"cors body"
+    # delete clears it
+    assert requests.delete(f"{s3}/corsb?cors").status_code == 204
+    assert requests.get(f"{s3}/corsb?cors").status_code == 404
+    # malformed config rejected
+    assert requests.put(f"{s3}/corsb?cors", data=b"<notxml").status_code == 400
+
+
 def test_multipart_with_tiny_part(s3):
     """Parts at or below the filer inline threshold must still splice
     into the completed object (regression: inlined parts vanished)."""
