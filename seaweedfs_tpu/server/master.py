@@ -418,6 +418,37 @@ class MasterServer:
                         f"<th>del</th><th>mode</th><th>rp</th><th>ttl</th></tr>"
                         f"{vols}{ecs}</table>"
                     )
+                # maintenance fleet panel (public snapshot: the UI must
+                # not depend on WorkerControl's locking internals)
+                worker_rows, task_rows = master.worker_control.snapshot()
+                workers = [
+                    f"<tr><td>{esc(w['worker_id'])}</td>"
+                    f"<td>{esc(','.join(w['capabilities']))}</td>"
+                    f"<td>{esc(w['backend'])}</td>"
+                    f"<td>{w['active']}/{w['max_concurrent']}</td></tr>"
+                    for w in worker_rows
+                ]
+                tasks = [
+                    f"<tr><td>{esc(t['task_id'])}</td><td>{esc(t['kind'])}</td>"
+                    f"<td>{t['volume_id']}</td><td>{esc(t['state'])}</td>"
+                    f"<td>{t['progress']:.0%}</td>"
+                    f"<td>{esc(t['worker_id']) or '-'}</td>"
+                    f"<td>{esc(t['error']) or '-'}</td></tr>"
+                    for t in sorted(task_rows, key=lambda t: -t["created"])[:50]
+                ]
+                fleet = (
+                    "<h2>maintenance fleet</h2>"
+                    "<table border=1 cellpadding=4 cellspacing=0>"
+                    "<tr><th>worker</th><th>capabilities</th><th>backend</th>"
+                    "<th>active</th></tr>"
+                    + ("".join(workers) or "<tr><td colspan=4>no workers</td></tr>")
+                    + "</table><br>"
+                    "<table border=1 cellpadding=4 cellspacing=0>"
+                    "<tr><th>task</th><th>kind</th><th>vol</th><th>state</th>"
+                    "<th>progress</th><th>worker</th><th>error</th></tr>"
+                    + ("".join(tasks) or "<tr><td colspan=7>no tasks</td></tr>")
+                    + "</table>"
+                )
                 body = (
                     "<html><head><title>seaweed-tpu master</title></head><body>"
                     f"<h1>seaweed-tpu cluster</h1>"
@@ -427,6 +458,7 @@ class MasterServer:
                     f"{stats.used_size:,} bytes &middot; max volume id: "
                     f"{topo.max_volume_id}</p>"
                     + "".join(rows)
+                    + fleet
                     + "</body></html>"
                 ).encode()
                 self.send_response(200)

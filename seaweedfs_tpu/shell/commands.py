@@ -546,13 +546,22 @@ def ec_balance(env: ShellEnv, args) -> str:
             sids = [i for i in range(32) if e.shard_bits & (1 << i)]
             load[n.id][e.id] = sids
             vol_collection[e.id] = e.collection
+    racks = {n.id: (n.data_center, n.rack) for n in topo.nodes}
     moves = []
     for _ in range(256):
         counts = {
             nid: sum(len(s) for s in vols.values()) for nid, vols in load.items()
         }
         src_id = max(counts, key=counts.get)
-        dst_id = min(counts, key=counts.get)
+        # least-loaded destination; ties broken toward a DIFFERENT rack
+        # than the source so shard loss domains spread (reference
+        # ec.balance racks-then-servers ordering)
+        min_count = min(counts.values())
+        candidates = [nid for nid, c in counts.items() if c == min_count]
+        dst_id = min(
+            candidates,
+            key=lambda nid: (racks.get(nid) == racks.get(src_id), nid),
+        )
         if counts[src_id] - counts[dst_id] <= 1:
             break
         # pick a shard on src for a volume where dst holds fewest shards

@@ -266,6 +266,35 @@ class WorkerControl:
                 ]
             )
 
+    def snapshot(self) -> tuple[list[dict], list[dict]]:
+        """(workers, tasks) rows for status UIs — the public view, so
+        consumers never touch the registry's locking internals."""
+        with self._lock:
+            workers = [
+                {
+                    "worker_id": w.worker_id,
+                    "capabilities": sorted(w.capabilities),
+                    "backend": w.backend,
+                    "active": w.active,
+                    "max_concurrent": w.max_concurrent,
+                }
+                for w in self._workers.values()
+            ]
+            tasks = [
+                {
+                    "task_id": t.task_id,
+                    "kind": t.kind,
+                    "volume_id": t.volume_id,
+                    "state": t.state,
+                    "progress": t.progress,
+                    "worker_id": t.worker_id,
+                    "error": t.error,
+                    "created": t.created,
+                }
+                for t in self._tasks.values()
+            ]
+        return workers, tasks
+
     def stop(self) -> None:
         self._stop.set()
 

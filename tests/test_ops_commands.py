@@ -249,10 +249,13 @@ def test_admin_ui(cluster):
     ops = Operations(f"localhost:{master.port}")
     try:
         ops.upload(b"ui fodder")
+        master.worker_control.submit("vacuum", 424242)
         r = requests.get(f"http://localhost:{master.port}/ui")
         assert r.status_code == 200
         assert "seaweed-tpu cluster" in r.text
         assert "<table" in r.text
+        assert "maintenance fleet" in r.text
+        assert "424242" in r.text  # queued task visible
     finally:
         ops.close()
 
