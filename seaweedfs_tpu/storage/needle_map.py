@@ -50,6 +50,14 @@ class MemoryNeedleMap:
         self.deleted_bytes = 0
         self._idx_file = None
         if os.path.exists(idx_path):
+            # a crash can tear the trailing entry; appending after a torn
+            # tail would skew EVERY later entry's alignment, so truncate
+            # to whole records before replay + reopen
+            size = os.path.getsize(idx_path)
+            torn = size % NEEDLE_MAP_ENTRY_SIZE
+            if torn:
+                with open(idx_path, "r+b") as f:
+                    f.truncate(size - torn)
             for nv in walk_index_file(idx_path):
                 self._replay(nv)
         self._idx_file = open(idx_path, "ab")
@@ -78,6 +86,9 @@ class MemoryNeedleMap:
         nv = NeedleValue(needle_id, offset, size)
         self._log_put(nv)
         self._idx_file.write(nv.to_bytes())
+        # to the kernel with every journal append: an acked write's index
+        # entry must survive SIGKILL (fsync is the caller's power-loss knob)
+        self._idx_file.flush()
 
     def delete(self, needle_id: int) -> int:
         """Append a tombstone; returns freed byte count (0 if absent)."""
@@ -85,6 +96,7 @@ class MemoryNeedleMap:
         self._idx_file.write(
             NeedleValue(needle_id, 0, TOMBSTONE_FILE_SIZE).to_bytes()
         )
+        self._idx_file.flush()
         if old is None:
             return 0
         self.deleted_counter += 1

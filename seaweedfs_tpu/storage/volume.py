@@ -144,13 +144,20 @@ class Volume:
             offset = self._append_at
             self._dat.seek(offset)
             self._dat.write(raw)
+            # ALWAYS hand the bytes to the kernel before acknowledging:
+            # an acked write must survive SIGKILL of this process (page
+            # cache). fsync additionally survives power loss.
+            self._dat.flush()
             if fsync:
-                self._dat.flush()
                 os.fsync(self._dat.fileno())
             self._append_at = offset + len(raw)
             self._last_write_ts = time.time()
             _, _, size = Needle.parse_header(raw)
             self.needle_map.put(n.needle_id, to_stored_offset(offset), size)
+            if fsync:
+                # power-loss durability covers the INDEX entry too:
+                # recovery replays only the .idx
+                self.needle_map.flush()
             return offset, size
 
     def read_needle(self, needle_id: int, cookie: Optional[int] = None) -> Needle:
@@ -185,6 +192,7 @@ class Volume:
             raw = tomb.to_bytes(self.version)
             self._dat.seek(self._append_at)
             self._dat.write(raw)
+            self._dat.flush()  # acked deletes survive SIGKILL too
             self._append_at += len(raw)
             return self.needle_map.delete(needle_id)
 
