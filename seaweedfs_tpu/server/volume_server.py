@@ -212,7 +212,7 @@ class VolumeService:
             n.set_mime(request.mime.encode())
         try:
             size = self.store.write_needle(request.volume_id, n)
-        except (NotFoundError, ReadOnlyError, VolumeError) as e:
+        except (NotFoundError, ReadOnlyError, VolumeError, ValueError, OSError) as e:
             return pb.WriteNeedleResponse(error=str(e))
         if not request.is_replicate:
             err = self.server.replicate_write(request)
@@ -237,7 +237,7 @@ class VolumeService:
             )
         except (NotFoundError, ECError) as e:
             return pb.ReadNeedleResponse(error=f"not found: {e}")
-        except (CookieMismatch, CrcError) as e:
+        except (CookieMismatch, CrcError, VolumeError, ValueError, OSError) as e:
             return pb.ReadNeedleResponse(error=str(e))
         return pb.ReadNeedleResponse(
             data=n.data,
@@ -253,6 +253,10 @@ class VolumeService:
             freed = self.store.delete_needle(request.volume_id, request.needle_id)
         except NotFoundError as e:
             return pb.DeleteNeedleResponse(error=str(e))
+        except (ECError, VolumeError, ValueError, OSError) as e:
+            # a volume mid-conversion/close must yield an error RESPONSE,
+            # never an escaped exception that aborts the connection
+            return pb.DeleteNeedleResponse(error=f"volume busy: {e}")
         if not request.is_replicate:
             ev = self.store.find_ec_volume(request.volume_id)
             if ev is not None:
@@ -953,6 +957,10 @@ class VolumeServer:
                     return self._error(404, str(e))
                 except (CookieMismatch, CrcError) as e:
                     return self._error(404, str(e))
+                except (VolumeError, ValueError, OSError) as e:
+                    # volume closed/converted mid-read: an error RESPONSE,
+                    # never a dropped connection
+                    return self._error(503, str(e))
                 ctype = n.mime.decode() if n.mime else "application/octet-stream"
                 data = n.data
                 total = len(data)
@@ -1038,8 +1046,16 @@ class VolumeServer:
                     None,
                 )
                 if resp.error:
-                    # freed locally but fan-out incomplete = 500, not 404
-                    return self._error(500 if resp.freed_bytes else 404, resp.error)
+                    if resp.freed_bytes:
+                        # freed locally but fan-out incomplete
+                        code = 500
+                    elif "not found" in resp.error:
+                        code = 404
+                    else:
+                        # transient (volume mid-conversion, IO): 503 so
+                        # clients retry instead of treating it as gone
+                        code = 503
+                    return self._error(code, resp.error)
                 body = json.dumps({"size": resp.freed_bytes}).encode()
                 self.send_response(202)
                 self.send_header("Content-Type", "application/json")
