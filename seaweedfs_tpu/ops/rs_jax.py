@@ -126,19 +126,24 @@ class RSJax:
         self.matrix = self._ref.matrix
         expand = bit_matrix_bitmajor if impl == "pallas" else bit_matrix
         self._expand = expand
-        self._parity_bits = jnp.asarray(
+        # numpy, not a device array: constructing an RSJax must not
+        # initialize the jax backend (a hung TPU relay would block the
+        # caller — e.g. __graft_entry__.entry() — before any watchdog
+        # can intervene). jit converts at call time; the matrix is tiny
+        # (8m x 8k floats), so the per-call transfer is noise.
+        self._parity_bits = np.asarray(
             expand(self._ref.parity), dtype=_ACC_DTYPE
         )
         # Bounded: shard-loss patterns are diverse in a long-lived volume
-        # server; each entry pins an (8m x 8k) device array.
-        self._decode_bits_cache: "collections.OrderedDict[tuple, jax.Array]" = (
+        # server; each entry pins an (8m x 8k) bit-matrix.
+        self._decode_bits_cache: "collections.OrderedDict[tuple, np.ndarray]" = (
             collections.OrderedDict()
         )
         self._decode_cache_limit = 64
 
     # -- encode ------------------------------------------------------------
 
-    def _apply(self, bits: jax.Array, data: jax.Array, m_out: int) -> jax.Array:
+    def _apply(self, bits: np.ndarray, data: jax.Array, m_out: int) -> jax.Array:
         if self.impl == "pallas":
             from . import rs_pallas
 
@@ -164,7 +169,7 @@ class RSJax:
 
     # -- reconstruct -------------------------------------------------------
 
-    def _rows_bits(self, out_rows: tuple[int, ...], src_rows: tuple[int, ...]) -> jax.Array:
+    def _rows_bits(self, out_rows: tuple[int, ...], src_rows: tuple[int, ...]) -> np.ndarray:
         """Bit-matrix mapping shards[src_rows] -> shards[out_rows]."""
         key = (out_rows, src_rows)
         cached = self._decode_bits_cache.get(key)
@@ -174,7 +179,7 @@ class RSJax:
         sub = self.matrix[list(src_rows), :]
         inv = gf256.invert(sub)  # (k, k): src shards -> data shards
         want = gf256.matmul(self.matrix[list(out_rows), :], inv)
-        bits = jnp.asarray(self._expand(want), dtype=_ACC_DTYPE)
+        bits = np.asarray(self._expand(want), dtype=_ACC_DTYPE)
         self._decode_bits_cache[key] = bits
         if len(self._decode_bits_cache) > self._decode_cache_limit:
             self._decode_bits_cache.popitem(last=False)

@@ -6,23 +6,21 @@ path via __graft_entry__.dryrun_multichip).
 """
 
 import os
+import pathlib
+import sys
 
 # Force, don't setdefault: the session profile sets JAX_PLATFORMS=axon
 # (the real TPU tunnel); unit tests must stay on the virtual CPU mesh.
+# Spawned-server subprocesses inherit this env and come up on CPU too.
 os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
 
-# The axon TPU plugin's sitecustomize imports jax at interpreter startup,
-# which freezes jax_platforms to "axon" before this file runs; if the TPU
-# relay is down, any backend init then hangs forever. Overriding the env
-# var is too late — update the live jax config instead.
-import jax  # noqa: E402
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+from __graft_entry__ import _force_virtual_cpu_mesh  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+# Sets XLA_FLAGS device count AND flips the live jax config (the axon
+# sitecustomize imports jax at interpreter startup, freezing the
+# env-derived platform default before this file runs).
+_force_virtual_cpu_mesh(8)
 
 import pytest  # noqa: E402
 
