@@ -5,8 +5,15 @@ writes the shard bytes. Self-checksummed header so a corrupt sidecar is
 detected rather than trusted (reference ec_bitrot.go:15-58; this build
 uses its own deterministic little-endian payload instead of protobuf).
 
+The magic is deliberately NOT the reference's 'ECSU': the payload is a
+different (non-protobuf) format, and a foreign reader that matched
+magic+version but failed to unmarshal would classify the generation
+BitrotInvalid (fail-closed, integrity alarms) instead of cleanly
+treating the sidecar as unknown. A distinct magic makes foreign readers
+reject it as "not my file" rather than "my file, corrupted".
+
 File layout:
-  [magic 'ECSU'(4, BE) | format_version=1 (u16 LE) | payload_len (u32 LE)
+  [magic 'SWTS'(4, BE) | format_version=1 (u16 LE) | payload_len (u32 LE)
    | payload_crc32c (u32 LE)] [payload]
 
 Payload (all LE):
@@ -25,7 +32,10 @@ from dataclasses import dataclass, field
 from ..utils.crc import crc32c
 from .context import BITROT_BLOCK_SIZE, ECContext, ECError
 
-MAGIC = 0x45435355  # "ECSU"
+MAGIC = 0x53575453  # "SWTS" — distinct from the reference's "ECSU"
+# Sidecars written by pre-rename builds of THIS codebase carry "ECSU"
+# around the same (non-protobuf) payload; keep reading them.
+_LEGACY_MAGIC = 0x45435355  # "ECSU"
 FORMAT_VERSION = 1
 _HEADER = struct.Struct(">I")  # magic, big-endian like the reference
 _HEADER_REST = struct.Struct("<HII")  # version, payload_len, payload_crc
@@ -125,7 +135,7 @@ class BitrotProtection:
             raise BitrotError("sidecar too short")
         (magic,) = _HEADER.unpack(raw[: _HEADER.size])
         version, plen, pcrc = _HEADER_REST.unpack(raw[_HEADER.size : hs])
-        if magic != MAGIC:
+        if magic not in (MAGIC, _LEGACY_MAGIC):
             raise BitrotError(f"bad magic {magic:08x}")
         if version != FORMAT_VERSION:
             raise BitrotError(f"unsupported sidecar version {version}")

@@ -165,9 +165,16 @@ def _verify_presigned(store, method, path, query, headers, q) -> Identity:
         signed_headers = q["X-Amz-SignedHeaders"].split(";")
         signature = q["X-Amz-Signature"]
         amz_date = q["X-Amz-Date"]
-        expires = int(q.get("X-Amz-Expires", "604800"))
+        expires = int(q["X-Amz-Expires"])
     except (KeyError, ValueError):
         raise S3AuthError("AuthorizationQueryParametersError", "bad presign") from None
+    # AWS rejects out-of-range expiries rather than clamping: a URL
+    # signed with a huge X-Amz-Expires must not be honored indefinitely.
+    if expires < 1 or expires > 604800:
+        raise S3AuthError(
+            "AuthorizationQueryParametersError",
+            "X-Amz-Expires must be between 1 and 604800 seconds",
+        )
     ident = store.lookup(access_key)
     if ident is None:
         raise S3AuthError("InvalidAccessKeyId", f"unknown access key {access_key}")

@@ -128,7 +128,7 @@ class S3Server:
                 phash = self.headers.get(
                     "x-amz-content-sha256", "UNSIGNED-PAYLOAD"
                 )
-                return verify_v4(
+                ident = verify_v4(
                     srv.identities,
                     self.command,
                     u.path,
@@ -136,6 +136,23 @@ class S3Server:
                     self.headers,
                     phash,
                 )
+                # Integrity-bind the signed x-amz-content-sha256 to the
+                # actual body: without this, a signed PUT body is
+                # malleable by an on-path attacker (the signature only
+                # covers the *claimed* hash).
+                if (
+                    ident is not None
+                    and "Authorization" in self.headers
+                    and phash != "UNSIGNED-PAYLOAD"
+                    and not phash.startswith("STREAMING-")
+                ):
+                    body = self._read_body()
+                    if hashlib.sha256(body).hexdigest() != phash.lower():
+                        raise S3AuthError(
+                            "XAmzContentSHA256Mismatch",
+                            "x-amz-content-sha256 does not match body",
+                        )
+                return ident
 
             def _bucket_key(self):
                 u = urllib.parse.urlparse(self.path)

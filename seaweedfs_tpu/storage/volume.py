@@ -16,6 +16,7 @@ Semantics preserved:
 
 from __future__ import annotations
 
+import contextlib
 import os
 import struct
 import threading
@@ -83,10 +84,16 @@ class Volume:
         self.collection = collection
         self.directory = directory
         self.read_only = False
+        # Poisoned by an unfinishable vacuum commit (half-swapped pair
+        # on disk): all IO refuses until the volume is reopened, at
+        # which point _reconcile_vacuum_marker heals from the durable
+        # marker + temps.
+        self.broken = False
         self._lock = threading.RLock()
         base = self.base_file_name(directory, collection, volume_id)
         self.dat_path = base + ".dat"
         self.idx_path = base + ".idx"
+        self._reconcile_vacuum_marker(base)
         exists = os.path.exists(self.dat_path)
         if not exists and not create:
             raise VolumeError(f"volume {volume_id} not found at {self.dat_path}")
@@ -118,6 +125,31 @@ class Volume:
         name = f"{collection}_{volume_id}" if collection else str(volume_id)
         return os.path.join(directory, name)
 
+    @staticmethod
+    def _reconcile_vacuum_marker(base: str) -> None:
+        """Heal a crashed/failed vacuum commit (volume_vacuum.go:316).
+
+        The commit marker `.cpm` is written (fsynced) after `.cpd`/`.cpx`
+        are durable and before the swaps. Marker present => the commit
+        point was passed: finish any remaining swap (idempotent; replace
+        order in vacuum() is dat-then-idx, so `.cpd` can never be the
+        one left behind alone). Marker absent => any temps are from a
+        compaction that never reached its commit point: abort them.
+        """
+        marker, cpd, cpx = base + ".cpm", base + ".cpd", base + ".cpx"
+        if os.path.exists(marker):
+            if os.path.exists(cpd):
+                os.replace(cpd, base + ".dat")
+            if os.path.exists(cpx):
+                os.replace(cpx, base + ".idx")
+            fsync_dir(base + ".dat")
+            os.unlink(marker)
+            fsync_dir(marker)
+        else:
+            for p in (cpd, cpx):
+                if os.path.exists(p):
+                    os.unlink(p)
+
     def _pad_tail(self) -> int:
         """Ensure the append offset is 8-byte aligned (crash padding)."""
         end = self._dat.tell()
@@ -136,6 +168,7 @@ class Volume:
         identical overwrites is NOT done; every write appends.
         """
         with self._lock:
+            self._check_not_broken()
             if self.read_only:
                 raise ReadOnlyError(f"volume {self.volume_id} is read-only")
             if self.ttl and not n.last_modified:
@@ -160,8 +193,16 @@ class Volume:
                 self.needle_map.flush()
             return offset, size
 
+    def _check_not_broken(self) -> None:
+        if self.broken:
+            raise VolumeError(
+                f"volume {self.volume_id} has a pending vacuum commit; "
+                "reopen to heal"
+            )
+
     def read_needle(self, needle_id: int, cookie: Optional[int] = None) -> Needle:
         with self._lock:
+            self._check_not_broken()
             nv = self.needle_map.get(needle_id)
             if nv is None or nv.is_deleted:
                 raise NotFoundError(f"needle {needle_id:x} not found")
@@ -183,6 +224,7 @@ class Volume:
     def delete_needle(self, needle_id: int) -> int:
         """Tombstone both .dat (empty needle append) and .idx."""
         with self._lock:
+            self._check_not_broken()
             if self.read_only:
                 raise ReadOnlyError(f"volume {self.volume_id} is read-only")
             nv = self.needle_map.get(needle_id)
@@ -264,6 +306,15 @@ class Volume:
         so no incremental catch-up pass is needed yet).
         """
         with self._lock:
+            self._check_not_broken()
+            if os.path.exists(self.dat_path[:-4] + ".cpm"):
+                # A durable commit marker means an earlier vacuum's swap
+                # is pending: truncating .cpd/.cpx now would let a crash
+                # reconcile partial garbage over the live pair.
+                raise VolumeError(
+                    f"volume {self.volume_id} has a pending vacuum "
+                    "commit; reopen to heal before vacuuming"
+                )
             was_ro = self.read_only
             self.read_only = True
             try:
@@ -276,6 +327,7 @@ class Volume:
                     ttl=self.super_block.ttl,
                     compaction_revision=self.super_block.compaction_revision + 1,
                 )
+                marker = self.dat_path[:-4] + ".cpm"
                 try:
                     with open(cpd, "wb") as df, open(cpx, "wb") as xf:
                         df.write(new_sb.to_bytes())
@@ -294,17 +346,57 @@ class Volume:
                         os.fsync(df.fileno())
                         xf.flush()
                         os.fsync(xf.fileno())
-                    # Atomic commit: close current handles, swap files in.
+                except BaseException:
+                    for tmp in (cpd, cpx):
+                        with contextlib.suppress(OSError):
+                            os.unlink(tmp)
+                    raise
+                # Commit point: once the marker is durable, the swap is
+                # completable by _reconcile_vacuum_marker (here on
+                # failure, or at next open after a crash). The closes
+                # are best-effort — the compacted pair no longer
+                # depends on the old handles.
+                with open(marker, "wb") as mf:
+                    mf.flush()
+                    os.fsync(mf.fileno())
+                fsync_dir(marker)
+                with contextlib.suppress(OSError):
                     self._dat.close()
+                with contextlib.suppress(OSError):
                     self.needle_map.close()
+                try:
                     os.replace(cpd, self.dat_path)
                     os.replace(cpx, self.idx_path)
                     fsync_dir(self.dat_path)
-                except BaseException:
-                    for tmp in (cpd, cpx):
-                        if os.path.exists(tmp):
-                            os.unlink(tmp)
-                    raise
+                except OSError:
+                    if os.path.exists(cpd):
+                        # .dat never swapped: the old pair is intact and
+                        # consistent — roll back and keep serving it.
+                        for p in (cpd, cpx, marker):
+                            with contextlib.suppress(OSError):
+                                os.unlink(p)
+                        self.needle_map = MemoryNeedleMap(self.idx_path)
+                        self._dat = open(self.dat_path, "r+b")
+                        self._dat.seek(0, os.SEEK_END)
+                        self._append_at = self._pad_tail()
+                        raise
+                    # .dat swapped: rollback is impossible, so the
+                    # commit MUST complete. Retry via the reconcile
+                    # path; if the disk still refuses, the marker +
+                    # temps stay behind and the next open heals — do
+                    # not reopen a diverged new-.dat/old-.idx pair,
+                    # and poison the object so no IO (or re-vacuum,
+                    # which would truncate the committed .cpx) can
+                    # touch it.
+                    try:
+                        self._reconcile_vacuum_marker(self.dat_path[:-4])
+                    except OSError:
+                        self.broken = True
+                        raise
+                else:
+                    with contextlib.suppress(OSError):
+                        os.unlink(marker)
+                        fsync_dir(marker)
                 self.super_block = new_sb
                 self.needle_map = MemoryNeedleMap(self.idx_path)
                 self._dat = open(self.dat_path, "r+b")
@@ -312,7 +404,8 @@ class Volume:
                 self._append_at = self._pad_tail()
                 return old_size - self.size
             finally:
-                self.read_only = was_ro
+                # a poisoned volume stays read-only until reopened
+                self.read_only = True if self.broken else was_ro
 
     def _record_disk_len(self, body_size: int) -> int:
         return padded_record_size(

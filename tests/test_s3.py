@@ -478,3 +478,53 @@ def test_sigv4_auth(s3_signed):
     # unknown access key
     h = sign_request("GET", f"{base}/secure/k", "NOBODY", "secret123")
     assert "InvalidAccessKeyId" in requests.get(f"{base}/secure/k", headers=h).text
+
+
+def test_presigned_expires_required_and_capped(s3_signed):
+    base = s3_signed
+    h = sign_request("PUT", f"{base}/prex", "AKIDEXAMPLE", "secret123")
+    assert requests.put(f"{base}/prex", headers=h).status_code == 200
+    body = b"capped"
+    h = sign_request("PUT", f"{base}/prex/obj", "AKIDEXAMPLE", "secret123", body)
+    assert requests.put(f"{base}/prex/obj", data=body, headers=h).status_code == 200
+
+    # over the 7-day AWS maximum: rejected even though correctly signed
+    url = presign_url(
+        "GET", f"{base}/prex/obj", "AKIDEXAMPLE", "secret123", expires=604801
+    )
+    r = requests.get(url)
+    assert r.status_code == 403 and "AuthorizationQueryParametersError" in r.text
+
+    # X-Amz-Expires stripped from an otherwise-valid URL: rejected, not
+    # defaulted to 7 days
+    url = presign_url("GET", f"{base}/prex/obj", "AKIDEXAMPLE", "secret123")
+    stripped = "&".join(
+        p for p in url.split("?", 1)[1].split("&")
+        if not p.startswith("X-Amz-Expires=")
+    )
+    r = requests.get(url.split("?", 1)[0] + "?" + stripped)
+    assert r.status_code == 403
+
+    # boundary value still works
+    url = presign_url(
+        "GET", f"{base}/prex/obj", "AKIDEXAMPLE", "secret123", expires=604800
+    )
+    r = requests.get(url)
+    assert r.status_code == 200 and r.content == body
+
+
+def test_sigv4_body_hash_binding(s3_signed):
+    """The signed x-amz-content-sha256 must match the actual body: a
+    tampered payload under a valid signature is rejected."""
+    base = s3_signed
+    h = sign_request("PUT", f"{base}/bind", "AKIDEXAMPLE", "secret123")
+    assert requests.put(f"{base}/bind", headers=h).status_code == 200
+
+    body = b"original payload"
+    h = sign_request("PUT", f"{base}/bind/obj", "AKIDEXAMPLE", "secret123", body)
+    # on-path attacker swaps the body, keeps headers+signature
+    r = requests.put(f"{base}/bind/obj", data=b"tampered payload", headers=h)
+    assert r.status_code == 403 and "Mismatch" in r.text
+    # untampered goes through
+    r = requests.put(f"{base}/bind/obj", data=body, headers=h)
+    assert r.status_code == 200

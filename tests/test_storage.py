@@ -28,6 +28,7 @@ from seaweedfs_tpu.storage.volume import (
     NotFoundError,
     ReadOnlyError,
     Volume,
+    VolumeError,
 )
 
 
@@ -226,3 +227,186 @@ class TestVolume:
         v.delete_needle(1)
         assert v.garbage_ratio() > 0.0
         v.close()
+
+
+class TestVacuumCommitFailure:
+    def test_volume_serves_after_failed_commit(self, tmp_path, monkeypatch):
+        """A failed .dat swap must leave the volume serving from the
+        pre-vacuum files, not with closed handles (503s until restart)."""
+        v = Volume(str(tmp_path), 7)
+        keep = {}
+        for i in range(20):
+            data = os.urandom(64 + i)
+            v.write_needle(Needle(cookie=i, needle_id=i, data=data))
+            keep[i] = data
+        for i in range(0, 20, 2):
+            v.delete_needle(i)
+            del keep[i]
+
+        real_replace = os.replace
+
+        def boom(src, dst):
+            if dst.endswith(".dat"):
+                raise OSError("simulated rename failure")
+            return real_replace(src, dst)
+
+        monkeypatch.setattr(os, "replace", boom)
+        with pytest.raises(OSError):
+            v.vacuum()
+        monkeypatch.undo()
+
+        # still serves reads AND writes from the old files
+        for i, data in keep.items():
+            assert v.read_needle(i).data == data
+        v.write_needle(Needle(cookie=99, needle_id=99, data=b"after-fail"))
+        assert v.read_needle(99).data == b"after-fail"
+        # no stale temp files left behind
+        assert not os.path.exists(v.dat_path[:-4] + ".cpd")
+        assert not os.path.exists(v.idx_path[:-4] + ".cpx")
+        # and a later vacuum succeeds
+        rev = v.super_block.compaction_revision
+        assert v.vacuum() > 0
+        assert v.super_block.compaction_revision == rev + 1
+        for i, data in keep.items():
+            assert v.read_needle(i).data == data
+        v.close()
+
+    def test_rolls_forward_when_idx_swap_fails(self, tmp_path, monkeypatch):
+        """If .dat swapped but .idx failed, the commit completes via the
+        marker reconcile (cpx is durable) so the pair never diverges —
+        and the vacuum reports success."""
+        v = Volume(str(tmp_path), 8)
+        keep = {}
+        for i in range(20):
+            data = os.urandom(64 + i)
+            v.write_needle(Needle(cookie=i, needle_id=i, data=data))
+            keep[i] = data
+        for i in range(0, 20, 2):
+            v.delete_needle(i)
+            del keep[i]
+
+        real_replace = os.replace
+        fail_once = {"armed": True}
+
+        def boom(src, dst):
+            if dst.endswith(".idx") and fail_once["armed"]:
+                fail_once["armed"] = False
+                raise OSError("simulated idx rename failure")
+            return real_replace(src, dst)
+
+        monkeypatch.setattr(os, "replace", boom)
+        reclaimed = v.vacuum()
+        monkeypatch.undo()
+        assert reclaimed > 0
+
+        # rolled forward: compacted pair is live and consistent
+        for i, data in keep.items():
+            assert v.read_needle(i).data == data
+        assert v.garbage_ratio() == 0.0
+        assert not os.path.exists(v.dat_path[:-4] + ".cpm")
+        v.close()
+        v2 = Volume(str(tmp_path), 8, create=False)
+        for i, data in keep.items():
+            assert v2.read_needle(i).data == data
+        v2.close()
+
+    def test_crash_between_swaps_heals_on_open(self, tmp_path):
+        """Marker + temps on disk (crash after the commit point, before
+        the swaps): the next open finishes the swap, so the compacted
+        pair — not the stale one — is served."""
+        import shutil
+
+        v = Volume(str(tmp_path), 9)
+        keep = {}
+        for i in range(20):
+            data = os.urandom(64 + i)
+            v.write_needle(Needle(cookie=i, needle_id=i, data=data))
+            keep[i] = data
+        for i in range(0, 20, 2):
+            v.delete_needle(i)
+            del keep[i]
+        v.close()
+        base = v.dat_path[:-4]
+
+        # Fabricate the committed-but-unswapped state: compact into a
+        # scratch dir, stage the results as .cpd/.cpx + marker next to
+        # the UNcompacted originals.
+        scratch = str(tmp_path / "scratch")
+        os.makedirs(scratch)
+        for ext in (".dat", ".idx"):
+            shutil.copy(base + ext, os.path.join(scratch, "9" + ext))
+        sv = Volume(scratch, 9, create=False)
+        assert sv.vacuum() > 0
+        sv.close()
+        shutil.copy(os.path.join(scratch, "9.dat"), base + ".cpd")
+        shutil.copy(os.path.join(scratch, "9.idx"), base + ".cpx")
+        with open(base + ".cpm", "wb"):
+            pass
+
+        v2 = Volume(str(tmp_path), 9, create=False)
+        for p in (".cpm", ".cpd", ".cpx"):
+            assert not os.path.exists(base + p)
+        assert v2.garbage_ratio() == 0.0  # the compacted pair won
+        for i, data in keep.items():
+            assert v2.read_needle(i).data == data
+        v2.close()
+
+    def test_stale_temps_without_marker_are_aborted(self, tmp_path):
+        """Temps with NO marker (crash before the commit point) are
+        discarded on open; the original pair keeps serving."""
+        v = Volume(str(tmp_path), 10)
+        v.write_needle(Needle(cookie=1, needle_id=1, data=b"keep me"))
+        v.close()
+        base = v.dat_path[:-4]
+        for ext in (".cpd", ".cpx"):
+            with open(base + ext, "wb") as f:
+                f.write(b"partial garbage")
+        v2 = Volume(str(tmp_path), 10, create=False)
+        assert not os.path.exists(base + ".cpd")
+        assert not os.path.exists(base + ".cpx")
+        assert v2.read_needle(1).data == b"keep me"
+        v2.close()
+
+    def test_unfinishable_commit_poisons_volume(self, tmp_path, monkeypatch):
+        """.dat swapped but .idx swap fails persistently: the object is
+        poisoned (clear VolumeError, no IO on the diverged pair) and a
+        reopen heals from the durable marker + cpx."""
+        v = Volume(str(tmp_path), 11)
+        keep = {}
+        for i in range(20):
+            data = os.urandom(64 + i)
+            v.write_needle(Needle(cookie=i, needle_id=i, data=data))
+            keep[i] = data
+        for i in range(0, 20, 2):
+            v.delete_needle(i)
+            del keep[i]
+
+        real_replace = os.replace
+
+        def boom(src, dst):
+            if dst.endswith(".idx"):
+                raise OSError("persistent idx rename failure")
+            return real_replace(src, dst)
+
+        monkeypatch.setattr(os, "replace", boom)
+        with pytest.raises(OSError):
+            v.vacuum()
+        monkeypatch.undo()
+
+        assert v.broken and v.read_only
+        with pytest.raises(VolumeError):
+            v.read_needle(1)
+        with pytest.raises(VolumeError):
+            v.write_needle(Needle(cookie=5, needle_id=55, data=b"no"))
+        with pytest.raises(VolumeError):
+            v.vacuum()
+        # marker + committed cpx survived for the heal
+        base = v.dat_path[:-4]
+        assert os.path.exists(base + ".cpm") and os.path.exists(base + ".cpx")
+
+        v2 = Volume(str(tmp_path), 11, create=False)
+        assert not os.path.exists(base + ".cpm")
+        assert v2.garbage_ratio() == 0.0  # compacted pair live
+        for i, data in keep.items():
+            assert v2.read_needle(i).data == data
+        v2.close()
