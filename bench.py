@@ -27,6 +27,14 @@ BLOCK = 32 << 20  # bytes per data shard => 320 MiB data per pass
 REPS = 3
 
 
+class _AllImplsFailed(RuntimeError):
+    """Every device impl errored at compile/run (device WAS reachable).
+
+    Distinct from generic RuntimeError so backend-init/device_put
+    failures propagate as device_error_rcN instead of being mislabeled
+    kernel_compile_failed."""
+
+
 def _cpu_encode_gbs(data: np.ndarray, coeffs: np.ndarray, threads: int) -> float:
     """Multi-threaded native AVX2 encode throughput (data bytes / s)."""
     from seaweedfs_tpu.utils import native
@@ -53,7 +61,8 @@ def _cpu_encode_gbs(data: np.ndarray, coeffs: np.ndarray, threads: int) -> float
     return data.nbytes / dt / 1e9
 
 
-def _device_encode_gbs(data: np.ndarray) -> tuple[float, str]:
+def _device_encode_gbs(data: np.ndarray) -> tuple[float, str, str, dict]:
+    """Returns (gbs, device_kind, impl_used, {impl: failure_repr})."""
     import jax
 
     # The axon sitecustomize freezes jax_platforms at interpreter startup,
@@ -66,21 +75,68 @@ def _device_encode_gbs(data: np.ndarray) -> tuple[float, str]:
 
     dev = jax.devices()[0]
     on_tpu = dev.platform not in ("cpu",)
-    rs = RSJax(K, M, impl="pallas" if on_tpu else "xla")
+    if not on_tpu:
+        # The XLA path materialises 8x f32 bit-planes; at the TPU-sized
+        # BLOCK that is ~10 GB — shrink so the CPU plumbing run finishes.
+        data = data[:, : 1 << 20]
+    # First real-TPU contact may reject a kernel at compile time (Mosaic
+    # tiling legality). Try most-fused first, degrade, and RECORD each
+    # failure so the bench line distinguishes "kernel failed to compile"
+    # from "relay unreachable".
+    impls = ["pallas", "pallas_aligned", "xla"] if on_tpu else ["xla"]
+    forced_impl = os.environ.get("SEAWEED_BENCH_IMPL")
+    if forced_impl:
+        impls = [forced_impl]
+    failures: dict[str, str] = {}
     ddata = jax.device_put(jax.numpy.asarray(data))
-    jax.block_until_ready(rs.encode(ddata))  # compile + warmup
-    t0 = time.perf_counter()
-    for _ in range(REPS):
+    for impl in impls:
+        try:
+            rs = RSJax(K, M, impl=impl)
+            jax.block_until_ready(rs.encode(ddata))  # compile + warmup
+        except Exception as e:  # noqa: BLE001 — diagnostic capture
+            failures[impl] = repr(e)[:300]
+            continue
+        if impl.startswith("pallas") and os.environ.get("SEAWEED_BENCH_AUTOTUNE"):
+            rs = _autotune_tile(RSJax, impl, rs, ddata, jax)
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            jax.block_until_ready(rs.encode(ddata))
+        dt = (time.perf_counter() - t0) / REPS
+        return data.nbytes / dt / 1e9, str(dev.device_kind), impl, failures
+    raise _AllImplsFailed(f"all device impls failed to compile/run: {failures}")
+
+
+def _autotune_tile(RSJax, impl: str, best_rs, ddata, jax):
+    """Opt-in (SEAWEED_BENCH_AUTOTUNE=1) tile sweep: each extra config
+    costs a compile, so the default driver run skips this."""
+    candidates = [4096, 8192, 16384] if impl == "pallas" else [2048, 4096, 8192]
+
+    def once(rs):
+        jax.block_until_ready(rs.encode(ddata))  # compile+warm
+        t0 = time.perf_counter()
         jax.block_until_ready(rs.encode(ddata))
-    dt = (time.perf_counter() - t0) / REPS
-    return data.nbytes / dt / 1e9, str(dev.device_kind)
+        return time.perf_counter() - t0
+
+    best_t = once(best_rs)
+    for tile in candidates:
+        try:
+            rs = RSJax(K, M, impl=impl, tile_n=tile)
+            t = once(rs)
+        except Exception:  # noqa: BLE001 — tuning candidates may not fit
+            continue
+        if t < best_t:
+            best_rs, best_t = rs, t
+    return best_rs
 
 
-def _device_phase() -> tuple[float, str] | str:
+def _device_phase() -> tuple[float, str, str, dict] | str:
     """Device measurement in a WATCHDOGGED subprocess (the child rebuilds
     the data from the shared seed): when the TPU relay is down, jax
     backend init hangs forever in C — an in-process attempt would hang
-    the whole benchmark run. Returns (gbs, kind) or a reason string."""
+    the whole benchmark run. Returns (gbs, kind, impl, failures) or a
+    reason string: "device_hung" = relay unreachable;
+    "kernel_compile_failed" = device reachable but every impl errored;
+    "device_error_rcN" = child died some other way."""
     import subprocess
 
     try:
@@ -102,7 +158,12 @@ def _device_phase() -> tuple[float, str] | str:
         if line.startswith("{"):
             try:
                 d = json.loads(line)
-                return d["gbs"], d["kind"]
+                if "error" in d:
+                    sys.stderr.write(
+                        "bench device phase: " + json.dumps(d) + "\n"
+                    )
+                    return d["error"]
+                return d["gbs"], d["kind"], d["impl"], d.get("failures", {})
             except (json.JSONDecodeError, KeyError):
                 continue
     # a fast nonzero exit is a device-path BUG, not an unreachable relay:
@@ -120,8 +181,25 @@ def main() -> None:
     data = rng.integers(0, 256, size=(K, BLOCK), dtype=np.uint8)
 
     if "--device-phase" in sys.argv:
-        dev_gbs, dev_kind = _device_encode_gbs(data)
-        print(json.dumps({"gbs": dev_gbs, "kind": dev_kind}))
+        try:
+            dev_gbs, dev_kind, impl, failures = _device_encode_gbs(data)
+        except _AllImplsFailed as e:
+            print(
+                json.dumps(
+                    {"error": "kernel_compile_failed", "detail": str(e)[:2000]}
+                )
+            )
+            return
+        print(
+            json.dumps(
+                {
+                    "gbs": dev_gbs,
+                    "kind": dev_kind,
+                    "impl": impl,
+                    "failures": failures,
+                }
+            )
+        )
         return
 
     from seaweedfs_tpu.ops import gf256
@@ -143,12 +221,18 @@ def main() -> None:
             )
         )
         return
-    dev_gbs, dev_kind = dev
+    dev_gbs, dev_kind, impl, failures = dev
+    if failures:
+        sys.stderr.write(
+            "bench: impls that failed before the winner: "
+            + json.dumps(failures)
+            + "\n"
+        )
 
     print(
         json.dumps(
             {
-                "metric": f"rs_10p4_encode_throughput[{dev_kind} vs {threads}-thread avx2 cpu]",
+                "metric": f"rs_10p4_encode_throughput[{dev_kind}/{impl} vs {threads}-thread avx2 cpu]",
                 "value": round(dev_gbs, 3),
                 "unit": "GB/s",
                 "vs_baseline": round(dev_gbs / cpu_gbs, 3),

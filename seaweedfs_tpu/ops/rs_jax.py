@@ -112,9 +112,11 @@ class RSJax:
         interpret: bool = False,
         tile_n: int | None = None,
     ):
-        """impl: "xla" (portable) or "pallas" (fused TPU kernel,
-        1x HBM traffic; `interpret=True` runs it off-TPU for tests)."""
-        if impl not in ("xla", "pallas"):
+        """impl: "xla" (portable), "pallas" (fused TPU kernel, compact
+        layout, 1x HBM traffic), or "pallas_aligned" (lane-aligned
+        Mosaic-conservative layout — see rs_pallas.py); `interpret=True`
+        runs the pallas kernels off-TPU for tests."""
+        if impl not in ("xla", "pallas", "pallas_aligned"):
             raise ValueError(f"unknown impl {impl!r}")
         self.k = data_shards
         self.m = parity_shards
@@ -124,7 +126,14 @@ class RSJax:
         self.tile_n = tile_n
         self._ref = gf256.ReedSolomon(data_shards, parity_shards)
         self.matrix = self._ref.matrix
-        expand = bit_matrix_bitmajor if impl == "pallas" else bit_matrix
+        if impl == "pallas":
+            expand = bit_matrix_bitmajor
+        elif impl == "pallas_aligned":
+            from . import rs_pallas
+
+            expand = rs_pallas.bit_matrix_planes
+        else:
+            expand = bit_matrix
         self._expand = expand
         # numpy, not a device array: constructing an RSJax must not
         # initialize the jax backend (a hung TPU relay would block the
@@ -144,13 +153,18 @@ class RSJax:
     # -- encode ------------------------------------------------------------
 
     def _apply(self, bits: np.ndarray, data: jax.Array, m_out: int) -> jax.Array:
-        if self.impl == "pallas":
+        if self.impl in ("pallas", "pallas_aligned"):
             from . import rs_pallas
 
             kwargs = {}
             if self.tile_n is not None:
                 kwargs["tile_n"] = self.tile_n
-            return rs_pallas.apply_bitmajor_pallas(
+            fn = (
+                rs_pallas.apply_planes_pallas
+                if self.impl == "pallas_aligned"
+                else rs_pallas.apply_bitmajor_pallas
+            )
+            return fn(
                 bits,
                 data,
                 k=int(data.shape[0]),

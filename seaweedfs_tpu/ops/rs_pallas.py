@@ -32,7 +32,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+from jax.experimental.pallas import tpu as pltpu  # noqa: F401  (memory spaces)
+
+from . import gf256
 
 # Default word-column tile (lanes of packed words). VMEM use is dominated
 # by the f32 planes/accumulator: ~ (8m + k) * TILE_N * 4B.
@@ -67,21 +69,23 @@ def _rs_kernel(k: int, m: int, pack_width: int, b_ref, d_ref, out_ref):
     out_ref[:] = out.astype(_WORD_DTYPES[pack_width])
 
 
-@functools.partial(
-    jax.jit, static_argnames=("k", "m", "tile_n", "pack_width", "interpret")
-)
-def apply_bitmajor_pallas(
+def _pallas_apply(
+    kernel,
     b,
     data,
     *,
     k: int,
-    m: int,
-    tile_n: int = TILE_N,
-    pack_width: int = 2,
-    interpret: bool = False,
+    out_rows: int,
+    keep_rows: int,
+    b_block: tuple,
+    tile_n: int,
+    pack_width: int,
+    interpret: bool,
 ):
-    """(8m x 8k) bit-major GF(2) matrix applied to (k, n) uint8 -> (m, n).
+    """Shared pad → pack-to-words → pallas_call → unpack scaffolding.
 
+    `out_rows` is the kernel's output block height (possibly padded);
+    `keep_rows` is how many real parity rows the caller gets back.
     n is padded to a tile multiple internally (RS of zero bytes is zero,
     so padding never corrupts real columns).
     """
@@ -101,29 +105,183 @@ def apply_bitmajor_pallas(
     else:
         words = data
     grid = (words.shape[1] // tile_n,)
+    zeros = (0,) * len(b_block)
     out_words = pl.pallas_call(
-        functools.partial(_rs_kernel, k, m, pack_width),
-        out_shape=jax.ShapeDtypeStruct((m, words.shape[1]), _WORD_DTYPES[pack_width]),
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(
+            (out_rows, words.shape[1]), _WORD_DTYPES[pack_width]
+        ),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((8 * m, 8 * k), lambda i: (0, 0)),
+            pl.BlockSpec(b_block, lambda i: zeros),
             pl.BlockSpec((k, tile_n), lambda i: (0, i)),
         ],
-        out_specs=pl.BlockSpec((m, tile_n), lambda i: (0, i)),
+        out_specs=pl.BlockSpec((out_rows, tile_n), lambda i: (0, i)),
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
-            flops=2 * 8 * m * 8 * k * words.shape[1],
-            bytes_accessed=(k + m) * n_padded + 64 * m * k * 4,
+            flops=2 * 8 * out_rows * 8 * k * words.shape[1],
+            bytes_accessed=(k + out_rows) * n_padded + 64 * out_rows * k * 4,
             transcendentals=0,
         ),
     )(b.astype(jnp.float32), words)
     if pack_width > 1:
         out = jax.lax.bitcast_convert_type(out_words, jnp.uint8).reshape(
-            m, n_padded
+            out_rows, n_padded
         )
     else:
         out = out_words
-    return out[:, :n] if pad else out
+    return out[:keep_rows, :n]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "m", "tile_n", "pack_width", "interpret")
+)
+def apply_bitmajor_pallas(
+    b,
+    data,
+    *,
+    k: int,
+    m: int,
+    tile_n: int = TILE_N,
+    pack_width: int = 2,
+    interpret: bool = False,
+):
+    """(8m x 8k) bit-major GF(2) matrix applied to (k, n) uint8 -> (m, n)."""
+    return _pallas_apply(
+        functools.partial(_rs_kernel, k, m, pack_width),
+        b,
+        data,
+        k=k,
+        out_rows=m,
+        keep_rows=m,
+        b_block=(8 * m, 8 * k),
+        tile_n=tile_n,
+        pack_width=pack_width,
+        interpret=interpret,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Lane-aligned variant.
+#
+# The compact kernel above slices the (8m, 8k) bit-matrix on the LANE
+# dimension at j*k offsets (k=10 for the default codec) and writes
+# (m=4, TN) output blocks — both below Mosaic's minimum tile shapes
+# ((8,128) f32 / (16,128) 16-bit / (32,128) 8-bit; see
+# /opt/skills/guides/pallas_guide.md "Tiling Constraints"). Interpret
+# mode accepts that; real-hardware Mosaic may not. This variant keeps
+# every lane dimension a multiple of 128 and never slices lanes:
+#
+# - the matrix is pre-transposed host-side into 8 per-input-bit planes
+#   bT[j] of shape (k, 8*m_pad), m_pad = ceil16(m), so the lane dim is
+#   8*m_pad (a 128 multiple) and the j-planes are indexed on the leading
+#   dim, not lane-sliced;
+# - each plane matmul contracts the SUBLANE dim of both operands
+#   (bT[j]: (k, 8*m_pad) x plane: (k, TN) -> (8*m_pad, TN)), so the odd
+#   k=10 only ever appears as a contraction length;
+# - the output block is (m_pad, TN) with m_pad padded to the out word
+#   dtype's min sublane count (32/16/8 for 8/16/32-bit words); the
+#   caller slices the m real rows off afterwards.
+#
+# Cost of alignment: the out write is m_pad/m wider than needed
+# (16 vs 4 rows for 10+4) — ~1.2x of the input bytes instead of 0.4x.
+# ---------------------------------------------------------------------------
+
+# Word-column tile for the aligned kernel. VMEM is dominated by the
+# (8*m_pad, TN) f32 accumulator: 128 * TN * 4B = 2 MiB at TN=4096.
+TILE_N_ALIGNED = 4096
+
+
+# Mosaic minimum sublane counts by word width (see the tiling table in
+# the pallas guide): the output block height must not go below these.
+_MIN_SUBLANES = {1: 32, 2: 16, 4: 8}
+
+
+def _aligned_m_pad(m: int, pack_width: int) -> int:
+    """Output rows padded to BOTH a 16 multiple (lane dim 8*m_pad must be
+    a 128 multiple) and the min sublane count of the out word dtype."""
+    gran = max(16, _MIN_SUBLANES[pack_width])
+    return ((m + gran - 1) // gran) * gran
+
+
+def bit_matrix_planes(coeffs: np.ndarray, pack_width: int = 2) -> np.ndarray:
+    """(m x k) GF(256) coeffs -> (8, k, 8*m_pad) f32 plane stack.
+
+    bT[j, c, i*m_pad + r] = bit (i) of gf_mul coefficient row r applied
+    to input-bit j of byte-column c — i.e. expand_bit_matrix's entry
+    [8r+i, 8c+j], padded so the lane dim is a multiple of 128 and the
+    kernel's (m_pad, TN) output block is sublane-legal for the word
+    dtype pack_width selects.
+    """
+    coeffs = np.asarray(coeffs, dtype=np.uint8)
+    m, k = coeffs.shape
+    m_pad = _aligned_m_pad(m, pack_width)
+    b = gf256.expand_bit_matrix(coeffs).reshape(m, 8, k, 8)  # [r, i, c, j]
+    out = np.zeros((8, k, 8, m_pad), dtype=np.float32)
+    out[:, :, :, :m] = b.transpose(3, 2, 1, 0)  # [j, c, i, r]
+    return out.reshape(8, k, 8 * m_pad)
+
+
+def _rs_kernel_aligned(k: int, m_pad: int, pack_width: int, b_ref, d_ref, out_ref):
+    """b_ref: (8, k, 8*m_pad) f32; d_ref: (k, TN) uintW -> (m_pad, TN)."""
+    mask = _MASKS[pack_width]
+    acc_dtype = jnp.int32 if pack_width == 4 else jnp.float32
+    d = d_ref[:].astype(jnp.int32)
+    acc = jnp.zeros((8 * m_pad, d.shape[1]), dtype=acc_dtype)
+    for j in range(8):
+        plane = ((d >> j) & mask).astype(acc_dtype)
+        acc = acc + jax.lax.dot_general(
+            b_ref[j].astype(acc_dtype),
+            plane,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=acc_dtype,
+        )
+    acci = acc.astype(jnp.int32)
+    out = jnp.zeros((m_pad, d.shape[1]), dtype=jnp.int32)
+    for i in range(8):
+        out = out | ((acci[i * m_pad : (i + 1) * m_pad] & mask) << i)
+    out_ref[:] = out.astype(_WORD_DTYPES[pack_width])
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "m", "tile_n", "pack_width", "interpret")
+)
+def apply_planes_pallas(
+    b_planes,
+    data,
+    *,
+    k: int,
+    m: int,
+    tile_n: int = TILE_N_ALIGNED,
+    pack_width: int = 2,
+    interpret: bool = False,
+):
+    """Aligned-layout twin of apply_bitmajor_pallas.
+
+    b_planes: (8, k, 8*m_pad) from bit_matrix_planes; data (k, n) uint8
+    -> (m, n) uint8.
+    """
+    if pack_width not in _WORD_DTYPES:
+        raise ValueError(f"pack_width must be 1, 2 or 4, got {pack_width}")
+    m_pad = b_planes.shape[2] // 8
+    if m_pad % _aligned_m_pad(1, pack_width):
+        raise ValueError(
+            f"b_planes m_pad={m_pad} is not sublane-legal for "
+            f"pack_width={pack_width}; build it with "
+            f"bit_matrix_planes(coeffs, pack_width={pack_width})"
+        )
+    return _pallas_apply(
+        functools.partial(_rs_kernel_aligned, k, m_pad, pack_width),
+        b_planes,
+        data,
+        k=k,
+        out_rows=m_pad,
+        keep_rows=m,
+        b_block=(8, k, 8 * m_pad),
+        tile_n=tile_n,
+        pack_width=pack_width,
+        interpret=interpret,
+    )
 
 
 def is_tpu() -> bool:

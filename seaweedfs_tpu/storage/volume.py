@@ -372,9 +372,15 @@ class Volume:
                     if os.path.exists(cpd):
                         # .dat never swapped: the old pair is intact and
                         # consistent — roll back and keep serving it.
+                        # The unlinks MUST be made durable: the marker
+                        # was fsync'd durable before the swap, so a
+                        # crash that resurrects it (+ temps) would make
+                        # the next open reconcile the stale compacted
+                        # pair over acked post-rollback writes.
                         for p in (cpd, cpx, marker):
                             with contextlib.suppress(OSError):
                                 os.unlink(p)
+                        fsync_dir(marker)
                         self.needle_map = MemoryNeedleMap(self.idx_path)
                         self._dat = open(self.dat_path, "r+b")
                         self._dat.seek(0, os.SEEK_END)

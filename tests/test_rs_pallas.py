@@ -63,3 +63,82 @@ def test_pallas_pad_edge(ref, rng):
             )
         )
         assert np.array_equal(got, ref42.encode(data)), n
+
+
+# ---------------------------------------------------------------- aligned
+
+
+@pytest.mark.parametrize("pack_width", [1, 2, 4])
+def test_aligned_encode_bit_exact(ref, rng, pack_width):
+    import jax.numpy as jnp
+
+    coeffs = gf256.parity_rows(10, 4)
+    planes = jnp.asarray(rs_pallas.bit_matrix_planes(coeffs, pack_width=pack_width))
+    data = rng.integers(0, 256, size=(10, 600)).astype(np.uint8)
+    got = np.asarray(
+        rs_pallas.apply_planes_pallas(
+            planes,
+            jnp.asarray(data),
+            k=10,
+            m=4,
+            tile_n=128,
+            pack_width=pack_width,
+            interpret=True,
+        )
+    )
+    assert np.array_equal(got, ref.encode(data))
+
+
+def test_aligned_rsjax_impl_roundtrip(ref, rng):
+    codec = rs_jax.RSJax(10, 4, impl="pallas_aligned", interpret=True, tile_n=128)
+    data = rng.integers(0, 256, size=(10, 512)).astype(np.uint8)
+    parity = np.asarray(codec.encode(data))
+    assert np.array_equal(parity, ref.encode(data))
+    full = np.concatenate([data, parity])
+    present = {i: full[i] for i in range(14) if i not in (0, 12)}
+    out = codec.reconstruct(present)
+    for i in (0, 12):
+        assert np.array_equal(np.asarray(out[i]), full[i])
+
+
+def test_aligned_pad_edge(rng):
+    import jax.numpy as jnp
+
+    for k, m in ((4, 2), (17, 5)):
+        refkm = ReedSolomon(k, m)
+        planes = jnp.asarray(rs_pallas.bit_matrix_planes(gf256.parity_rows(k, m)))
+        for n in (1, 255, 513):
+            data = rng.integers(0, 256, size=(k, n)).astype(np.uint8)
+            got = np.asarray(
+                rs_pallas.apply_planes_pallas(
+                    planes, jnp.asarray(data), k=k, m=m, tile_n=128,
+                    pack_width=2, interpret=True,
+                )
+            )
+            assert np.array_equal(got, refkm.encode(data)), (k, m, n)
+
+
+def test_aligned_lane_shapes():
+    """The whole point of the layout: every lane dim a 128 multiple and
+    the out block height sublane-legal for the chosen word width."""
+    for k, m in ((10, 4), (17, 5), (20, 12)):
+        for pw, min_rows in ((1, 32), (2, 16), (4, 16)):
+            planes = rs_pallas.bit_matrix_planes(
+                gf256.parity_rows(k, m), pack_width=pw
+            )
+            assert planes.shape[0] == 8 and planes.shape[1] == k
+            assert planes.shape[2] % 128 == 0
+            assert (planes.shape[2] // 8) % min_rows == 0
+
+
+def test_aligned_rejects_mismatched_planes():
+    """pack_width=1 needs 32-row blocks; planes built for 16 must be
+    refused, not silently fed to Mosaic."""
+    import jax.numpy as jnp
+
+    planes = rs_pallas.bit_matrix_planes(gf256.parity_rows(10, 4), pack_width=2)
+    data = jnp.zeros((10, 256), jnp.uint8)
+    with pytest.raises(ValueError, match="sublane-legal"):
+        rs_pallas.apply_planes_pallas(
+            planes, data, k=10, m=4, tile_n=128, pack_width=1, interpret=True
+        )
