@@ -270,6 +270,10 @@ def apply_planes_pallas(
             f"pack_width={pack_width}; build it with "
             f"bit_matrix_planes(coeffs, pack_width={pack_width})"
         )
+    if m > m_pad:
+        raise ValueError(
+            f"m={m} exceeds the {m_pad} rows b_planes encodes"
+        )
     return _pallas_apply(
         functools.partial(_rs_kernel_aligned, k, m_pad, pack_width),
         b_planes,
