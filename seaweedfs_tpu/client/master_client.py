@@ -1,13 +1,19 @@
-"""Client-side master session: assign/lookup with a vid-location cache.
+"""Client-side master session: assign/lookup with a streaming vid map.
 
-Reference: weed/wdclient (MasterClient masterclient.go:483, vidMap
-vid_map.go:35) + weed/operation (assign_file_id.go:43).
+Reference: weed/wdclient — MasterClient.KeepConnectedToMaster
+(masterclient.go:483) feeds a vidMap (vid_map.go:35) with location
+deltas so lookups are local and never stale-after-TTL; leader redirect
+(masterclient.go:223) re-homes the session when masters fail over.
+Unary lookups remain as the fallback while the stream is (re)connecting
+and for EC shard-level locations (the stream carries vid-level EC
+presence only).
 """
 
 from __future__ import annotations
 
 import threading
 import time
+import uuid
 from dataclasses import dataclass
 
 import grpc
@@ -28,30 +34,224 @@ class AssignResult:
     jwt: str = ""
 
 
+def _grpc_addr(master: str) -> str:
+    host, _, port = master.partition(":")
+    return f"{host}:{int(port) + 10000}"
+
+
 class MasterClient:
-    def __init__(self, master: str = "localhost:9333"):
-        host, _, port = master.partition(":")
-        self.http_addr = master
-        self.grpc_addr = f"{host}:{int(port) + 10000}"
-        self._channel = grpc.insecure_channel(self.grpc_addr)
-        self._stub = rpc.master_stub(self._channel)
+    def __init__(self, master: str = "localhost:9333", keepconnected: bool = True):
+        """`master` may be a comma-separated HA group
+        ("h1:9333,h2:9334,...")."""
+        self.masters = [m.strip() for m in master.split(",") if m.strip()]
+        self.http_addr = self.masters[0]
+        self._keep = keepconnected
         self._lock = threading.Lock()
+        self._channels: dict[str, grpc.Channel] = {}
+        self._leader = self.masters[0]
+        # unary fallback caches (TTL'd)
         self._vid_cache: dict[int, tuple[float, list[pb.Location]]] = {}
         self._ec_cache: dict[int, tuple[float, dict[int, list[pb.Location]]]] = {}
+        # stream-fed vid map: authoritative while the session is synced
+        self._vidmap: dict[int, dict[str, pb.Location]] = {}
+        self._ec_present: dict[int, set[str]] = {}
+        self._by_url: dict[str, set[int]] = {}
+        self._session_thread: threading.Thread | None = None
+        self._synced = threading.Event()
+        self._stop = threading.Event()
+
+    @property
+    def grpc_addr(self) -> str:
+        """gRPC address of the master this client currently considers
+        leader (shell/worker open ancillary channels here)."""
+        return _grpc_addr(self._leader)
+
+    # ------------------------------------------------------ connections
+
+    def _channel(self, master: str) -> grpc.Channel:
+        with self._lock:
+            ch = self._channels.get(master)
+            if ch is None:
+                ch = grpc.insecure_channel(_grpc_addr(master))
+                self._channels[master] = ch
+            return ch
+
+    def _raft_status(self, master: str) -> pb.RaftStatusResponse | None:
+        try:
+            return rpc.Stub(self._channel(master), rpc.RAFT_SERVICE).RaftStatus(
+                pb.RaftStatusRequest(), timeout=2
+            )
+        except grpc.RpcError:
+            return None
+
+    def _resolve_leader(self, skip: str | None = None) -> str:
+        hint: str | None = None
+        for m in self.masters:
+            if m == skip and len(self.masters) > 1:
+                continue
+            st = self._raft_status(m)
+            if st is None:
+                continue
+            if st.role == "leader":
+                self._leader = m
+                return m
+            if st.leader and hint is None:
+                hint = st.leader
+        # a follower's hint may be stale (a dead ex-leader): only trust
+        # it if that node itself claims leadership
+        if hint and hint != skip:
+            st = self._raft_status(hint)
+            if st is not None and st.role == "leader":
+                self._leader = hint
+                return hint
+        return self._leader
+
+    def _note_leader_hint(self, error: str) -> bool:
+        """Parse 'not leader; leader=X' app errors; True if redirected."""
+        if "leader=" in error:
+            hint = error.split("leader=", 1)[1].strip()
+            if hint:
+                self._leader = hint
+                return True
+        self._resolve_leader(skip=self._leader)
+        return True
+
+    def _leader_stub(self):
+        return rpc.master_stub(self._channel(self._leader))
+
+    def _with_leader(self, call, attempts: int = 4):
+        """Run `call(stub)`; on transport failure or not-leader error,
+        re-resolve and retry."""
+        last: Exception | None = None
+        for _ in range(attempts):
+            try:
+                return call(self._leader_stub())
+            except NotLeaderError as e:
+                last = e
+                self._note_leader_hint(str(e))
+                time.sleep(0.1)
+            except grpc.RpcError as e:
+                last = e
+                self._resolve_leader(skip=self._leader)
+                time.sleep(0.1)
+        raise last
+
+    # ---------------------------------------------------- keepconnected
+
+    def _ensure_session(self) -> None:
+        if not self._keep or self._session_thread is not None:
+            return
+        with self._lock:
+            if self._session_thread is not None:
+                return
+            self._session_thread = threading.Thread(
+                target=self._session_loop, daemon=True
+            )
+            self._session_thread.start()
+
+    def _session_loop(self) -> None:
+        client_id = f"wdclient-{uuid.uuid4().hex[:8]}"
+        while not self._stop.is_set():
+            target = self._leader
+            try:
+                stream = rpc.master_stub(self._channel(target)).KeepConnected(
+                    pb.KeepConnectedRequest(client_id=client_id),
+                    timeout=None,
+                )
+                with self._lock:
+                    self._vidmap.clear()
+                    self._ec_present.clear()
+                    self._by_url.clear()
+                for u in stream:
+                    if self._stop.is_set():
+                        return
+                    if u.leader:
+                        if u.leader == target:
+                            # snapshot-complete marker from the leader
+                            self._synced.set()
+                            continue
+                        self._synced.clear()
+                        self._leader = u.leader
+                        break
+                    self._apply_update(u)
+                else:
+                    # stream ended without redirect: re-resolve
+                    self._synced.clear()
+                    self._resolve_leader(skip=target)
+            except (grpc.RpcError, ValueError):
+                # ValueError = "cannot invoke RPC on closed channel"
+                # during close(); RpcError = broken stream
+                self._synced.clear()
+                if self._stop.is_set():
+                    return
+                self._resolve_leader(skip=target)
+            if self._stop.wait(0.3):
+                return
+
+    def _apply_update(self, u: pb.VolumeLocationUpdate) -> None:
+        with self._lock:
+            if u.server_gone:
+                for vid in self._by_url.pop(u.url, set()):
+                    held = self._vidmap.get(vid)
+                    if held:
+                        held.pop(u.url, None)
+                        if not held:
+                            del self._vidmap[vid]
+                    ec = self._ec_present.get(vid)
+                    if ec:
+                        ec.discard(u.url)
+                        if not ec:
+                            del self._ec_present[vid]
+                return
+            loc = pb.Location(
+                url=u.url, public_url=u.public_url, grpc_port=u.grpc_port
+            )
+            held = self._by_url.setdefault(u.url, set())
+            for vid in u.new_vids:
+                self._vidmap.setdefault(vid, {})[u.url] = loc
+                held.add(vid)
+            for vid in u.deleted_vids:
+                m = self._vidmap.get(vid)
+                if m:
+                    m.pop(u.url, None)
+                    if not m:
+                        del self._vidmap[vid]
+                held.discard(vid)
+            for vid in u.new_ec_vids:
+                self._ec_present.setdefault(vid, set()).add(u.url)
+                held.add(vid)
+            for vid in u.deleted_ec_vids:
+                ec = self._ec_present.get(vid)
+                if ec:
+                    ec.discard(u.url)
+                    if not ec:
+                        del self._ec_present[vid]
+
+    # ------------------------------------------------------------ assign
 
     def assign(
         self, count: int = 1, collection: str = "", replication: str = "",
         ttl: str = "",
     ) -> AssignResult:
-        resp = self._stub.Assign(
-            pb.AssignRequest(
-                count=count, collection=collection, replication=replication,
-                ttl=ttl,
-            ),
-            timeout=30,
-        )
-        if resp.error:
-            raise RuntimeError(f"assign: {resp.error}")
+        self._ensure_session()
+
+        def call(stub):
+            resp = stub.Assign(
+                pb.AssignRequest(
+                    count=count,
+                    collection=collection,
+                    replication=replication,
+                    ttl=ttl,
+                ),
+                timeout=30,
+            )
+            if resp.error:
+                if resp.error.startswith("not leader"):
+                    raise NotLeaderError(resp.error)
+                raise RuntimeError(f"assign: {resp.error}")
+            return resp
+
+        resp = self._with_leader(call)
         return AssignResult(
             fid=resp.fid,
             url=resp.location.url,
@@ -61,19 +261,35 @@ class MasterClient:
             jwt=resp.jwt,
         )
 
+    # ------------------------------------------------------------ lookup
+
     def lookup(self, vid: int, refresh: bool = False) -> list[pb.Location]:
+        self._ensure_session()
+        if self._synced.is_set() and not refresh:
+            with self._lock:
+                held = self._vidmap.get(vid)
+                if held:
+                    return list(held.values())
+            # fall through: a just-grown volume's delta may not have
+            # arrived yet — ask the master directly
         now = time.time()
         with self._lock:
             hit = self._vid_cache.get(vid)
             if hit and not refresh and now - hit[0] < _CACHE_TTL:
                 return hit[1]
-        resp = self._stub.LookupVolume(
-            pb.LookupVolumeRequest(volume_ids=[vid]), timeout=30
-        )
-        vl = resp.volume_locations[0]
-        if vl.error:
-            raise LookupError(vl.error)
-        locs = list(vl.locations)
+
+        def call(stub):
+            resp = stub.LookupVolume(
+                pb.LookupVolumeRequest(volume_ids=[vid]), timeout=30
+            )
+            vl = resp.volume_locations[0]
+            if vl.error:
+                if vl.error.startswith("not leader"):
+                    raise NotLeaderError(vl.error)
+                raise LookupError(vl.error)
+            return list(vl.locations)
+
+        locs = self._with_leader(call)
         with self._lock:
             self._vid_cache[vid] = (now, locs)
         return locs
@@ -84,47 +300,93 @@ class MasterClient:
             hit = self._ec_cache.get(vid)
             if hit and not refresh and now - hit[0] < _CACHE_TTL:
                 return hit[1]
-        resp = self._stub.LookupEcVolume(
-            pb.LookupEcVolumeRequest(volume_id=vid), timeout=30
-        )
-        if resp.error:
-            raise LookupError(resp.error)
-        out = {sl.shard_id: list(sl.locations) for sl in resp.shard_locations}
+
+        def call(stub):
+            resp = stub.LookupEcVolume(
+                pb.LookupEcVolumeRequest(volume_id=vid), timeout=30
+            )
+            if resp.error:
+                if resp.error.startswith("not leader"):
+                    raise NotLeaderError(resp.error)
+                raise LookupError(resp.error)
+            return {sl.shard_id: list(sl.locations) for sl in resp.shard_locations}
+
+        out = self._with_leader(call)
         with self._lock:
             self._ec_cache[vid] = (now, out)
         return out
 
+    # ------------------------------------------------------------- misc
+
     def topology(self) -> pb.TopologyResponse:
-        return self._stub.Topology(pb.TopologyRequest(), timeout=30)
+        return self._with_leader(
+            lambda s: s.Topology(pb.TopologyRequest(), timeout=30)
+        )
 
     def statistics(self) -> pb.StatisticsResponse:
-        return self._stub.Statistics(pb.StatisticsRequest(), timeout=30)
+        return self._with_leader(
+            lambda s: s.Statistics(pb.StatisticsRequest(), timeout=30)
+        )
+
+    def raft_status(self) -> pb.RaftStatusResponse:
+        """Status of the master this client considers leader."""
+        return rpc.Stub(self._channel(self._leader), rpc.RAFT_SERVICE).RaftStatus(
+            pb.RaftStatusRequest(), timeout=5
+        )
 
     def grow(self, count: int = 1, collection: str = "", replication: str = "") -> list[int]:
-        resp = self._stub.VolumeGrow(
-            pb.VolumeGrowRequest(
-                count=count, collection=collection, replication=replication
-            ),
-            timeout=60,
+        resp = self._with_leader(
+            lambda s: s.VolumeGrow(
+                pb.VolumeGrowRequest(
+                    count=count, collection=collection, replication=replication
+                ),
+                timeout=60,
+            )
         )
         return list(resp.volume_ids)
 
     def collections(self) -> list[str]:
         return list(
-            self._stub.CollectionList(pb.CollectionListRequest(), timeout=30).collections
+            self._with_leader(
+                lambda s: s.CollectionList(pb.CollectionListRequest(), timeout=30)
+            ).collections
         )
 
     def collection_delete(self, name: str) -> list[int]:
         """Drop every volume of a collection (fast bucket delete)."""
-        resp = self._stub.CollectionDelete(
-            pb.CollectionDeleteRequest(name=name), timeout=120
-        )
+
+        def call(stub):
+            resp = stub.CollectionDelete(
+                pb.CollectionDeleteRequest(name=name), timeout=120
+            )
+            if resp.error.startswith("not leader"):
+                raise NotLeaderError(resp.error)
+            return resp
+
+        resp = self._with_leader(call)
         if resp.error:
             raise RuntimeError(resp.error)
         return list(resp.deleted_volume_ids)
 
     def close(self) -> None:
-        self._channel.close()
+        self._stop.set()
+        # break any blocking stream first so the session thread exits,
+        # THEN clear the dict — otherwise the loop can re-create
+        # channels after close and leak them
+        with self._lock:
+            for ch in self._channels.values():
+                ch.close()
+        t = self._session_thread
+        if t is not None:
+            t.join(timeout=2)
+        with self._lock:
+            for ch in self._channels.values():
+                ch.close()
+            self._channels.clear()
+
+
+class NotLeaderError(Exception):
+    pass
 
 
 def volume_channel(loc: pb.Location) -> grpc.Channel:

@@ -23,6 +23,7 @@ MASTER_SERVICE = "sw.Seaweed"
 VOLUME_SERVICE = "sw.VolumeServer"
 MQ_SERVICE = "swmq.Messaging"
 WORKER_SERVICE = "swworker.WorkerControl"
+RAFT_SERVICE = "sw.Raft"
 
 SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
     MASTER_SERVICE: {
@@ -35,6 +36,7 @@ SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
         "VolumeGrow": (UNARY, pb.VolumeGrowRequest, pb.VolumeGrowResponse),
         "CollectionList": (UNARY, pb.CollectionListRequest, pb.CollectionListResponse),
         "CollectionDelete": (UNARY, pb.CollectionDeleteRequest, pb.CollectionDeleteResponse),
+        "KeepConnected": (SERVER_STREAM, pb.KeepConnectedRequest, pb.VolumeLocationUpdate),
     },
     VOLUME_SERVICE: {
         "AllocateVolume": (UNARY, pb.AllocateVolumeRequest, pb.AllocateVolumeResponse),
@@ -74,6 +76,11 @@ SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
         "WorkerStream": (BIDI, wk.WorkerMessage, wk.ServerMessage),
         "ListTasks": (UNARY, wk.ListTasksRequest, wk.ListTasksResponse),
         "SubmitTask": (UNARY, wk.SubmitTaskRequest, wk.SubmitTaskResponse),
+    },
+    RAFT_SERVICE: {
+        "RaftRequestVote": (UNARY, pb.RaftVoteRequest, pb.RaftVoteResponse),
+        "RaftAppendEntries": (UNARY, pb.RaftAppendRequest, pb.RaftAppendResponse),
+        "RaftStatus": (UNARY, pb.RaftStatusRequest, pb.RaftStatusResponse),
     },
 }
 

@@ -29,6 +29,14 @@ def main(argv=None) -> int:
         "-ec.autoFullness", dest="ec_auto", type=float, default=0.0,
         help="auto-submit ec_encode for volumes at this fraction of the size limit (0=off)",
     )
+    m.add_argument(
+        "-peers", default="",
+        help="comma-separated HA master group incl. this node (host:port,...)",
+    )
+    m.add_argument(
+        "-mdir", default="",
+        help="meta dir for the durable raft log (required for HA restarts)",
+    )
 
     v = sub.add_parser("volume")
     v.add_argument("-ip", default="localhost")
@@ -113,6 +121,8 @@ def main(argv=None) -> int:
             ip=a.ip, port=port, volume_size_limit=limit,
             jwt_key=getattr(a, "jwt_key", ""),
             ec_auto_fullness=getattr(a, "ec_auto", 0.0),
+            peers=getattr(a, "peers", "") or None,
+            meta_dir=getattr(a, "mdir", "") or None,
         )
         ms.start()
         servers.append(ms)

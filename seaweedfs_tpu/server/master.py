@@ -33,18 +33,37 @@ _COLLECTION_RE = re.compile(r"^[A-Za-z0-9_.\-]*$")
 class MasterService:
     """gRPC servicer (method-per-RPC, see pb/rpc.py)."""
 
-    def __init__(self, topo: Topology, jwt_key: str = ""):
+    def __init__(self, topo: Topology, jwt_key: str = "", raft=None):
         self.topo = topo
         self.jwt_key = jwt_key
+        self.raft = raft  # None = pre-raft single master (tests construct this)
         self._grow_lock = threading.Lock()
+        # volume-id allocation goes through raft when HA is on
+        self.alloc_volume_id = topo.next_volume_id
+
+    def _not_leader(self) -> str | None:
+        """None when this master may serve; otherwise the leader hint."""
+        if self.raft is None or self.raft.is_leader:
+            return None
+        return self.raft.leader or ""
 
     # ------------------------------------------------------- heartbeats
 
     def SendHeartbeat(self, request_iterator, context):
+        leader = self._not_leader()
+        if leader is not None:
+            # redirect: volume servers must feed the leader's topology
+            yield pb.HeartbeatResponse(leader=leader)
+            return
         node: DataNode | None = None
         token = object()
         try:
             for hb in request_iterator:
+                if self._not_leader() is not None:
+                    yield pb.HeartbeatResponse(
+                        leader=self.raft.leader or ""
+                    )
+                    return
                 if node is None:
                     node = self.topo.register_node(hb)
                     node.owner_token = token
@@ -63,9 +82,50 @@ class MasterService:
             if node is not None:
                 self.topo.unregister_node(node.node_id, owner_token=token)
 
+    # ---------------------------------------------------- keepconnected
+
+    def KeepConnected(self, request: pb.KeepConnectedRequest, context):
+        """Streaming vid-location session (reference masterclient.go:483):
+        full snapshot, then deltas; leader changes notify the client to
+        reconnect elsewhere."""
+        leader = self._not_leader()
+        if leader is not None:
+            yield pb.VolumeLocationUpdate(leader=leader)
+            return
+        import queue as _queue
+
+        q, snapshot = self.topo.subscribe()
+        try:
+            for u in snapshot:
+                yield u
+            if self.raft is not None:
+                # snapshot-complete marker: leader == the serving master
+                # tells the client its vid map is now authoritative
+                yield pb.VolumeLocationUpdate(leader=self.raft.node_id)
+            while context is None or context.is_active():
+                if q.overflowed:
+                    return  # delta lost: end stream, client re-syncs
+                try:
+                    u = q.get(timeout=1.0)
+                except _queue.Empty:
+                    if self._not_leader() is not None:
+                        yield pb.VolumeLocationUpdate(
+                            leader=self.raft.leader or ""
+                        )
+                        return
+                    continue
+                yield u
+                if u.leader:
+                    return  # stepped down: client reconnects to the leader
+        finally:
+            self.topo.unsubscribe(q)
+
     # ----------------------------------------------------------- assign
 
     def Assign(self, request: pb.AssignRequest, context) -> pb.AssignResponse:
+        leader = self._not_leader()
+        if leader is not None:
+            return pb.AssignResponse(error=f"not leader; leader={leader}")
         count = max(int(request.count), 1)
         # canonicalize ("90" -> "90m"): volume servers report canonical
         # TTLs in heartbeats, and the layout buckets compare strings
@@ -112,7 +172,7 @@ class MasterService:
             targets = self.topo.plan_growth(replication)
             if not targets:
                 return []
-            vid = self.topo.next_volume_id()
+            vid = self.alloc_volume_id()
             ok = []
             for node in targets:
                 try:
@@ -133,17 +193,22 @@ class MasterService:
                 return []
             # optimistic registration; the next heartbeat confirms
             for node in ok:
-                node.volumes[vid] = pb.VolumeInfoMsg(
-                    id=vid,
-                    collection=collection,
-                    replica_placement=replication,
-                    ttl=ttl,
+                self.topo.optimistic_add_volume(
+                    node,
+                    pb.VolumeInfoMsg(
+                        id=vid,
+                        collection=collection,
+                        replica_placement=replication,
+                        ttl=ttl,
+                    ),
                 )
             return [vid]
 
     def VolumeGrow(self, request: pb.VolumeGrowRequest, context) -> pb.VolumeGrowResponse:
         from ..storage.ttl import TTL
 
+        if self._not_leader() is not None:
+            return pb.VolumeGrowResponse()
         if not _COLLECTION_RE.match(request.collection):
             return pb.VolumeGrowResponse()
         try:
@@ -158,6 +223,18 @@ class MasterService:
     # ----------------------------------------------------------- lookup
 
     def LookupVolume(self, request, context) -> pb.LookupVolumeResponse:
+        leader = self._not_leader()
+        if leader is not None:
+            # follower topology is not authoritative (leader-only reads,
+            # reference topology.go:217)
+            return pb.LookupVolumeResponse(
+                volume_locations=[
+                    pb.VolumeLocations(
+                        volume_id=vid, error=f"not leader; leader={leader}"
+                    )
+                    for vid in request.volume_ids
+                ]
+            )
         out = []
         for vid in request.volume_ids:
             locs = self.topo.lookup(vid)
@@ -179,6 +256,12 @@ class MasterService:
         return pb.LookupVolumeResponse(volume_locations=out)
 
     def LookupEcVolume(self, request, context) -> pb.LookupEcVolumeResponse:
+        leader = self._not_leader()
+        if leader is not None:
+            return pb.LookupEcVolumeResponse(
+                volume_id=request.volume_id,
+                error=f"not leader; leader={leader}",
+            )
         shard_locs = self.topo.lookup_ec(request.volume_id)
         return pb.LookupEcVolumeResponse(
             volume_id=request.volume_id,
@@ -189,16 +272,36 @@ class MasterService:
             error="" if shard_locs else f"ec volume {request.volume_id} not found",
         )
 
+    def _abort_if_follower(self, context) -> None:
+        """Topology reads are leader-only (reference topology.go:217):
+        a follower's view is empty, not merely stale."""
+        leader = self._not_leader()
+        if leader is not None:
+            if context is not None:
+                context.abort(
+                    grpc.StatusCode.FAILED_PRECONDITION,
+                    f"not leader; leader={leader}",
+                )
+            raise RuntimeError(f"not leader; leader={leader}")
+
     def Statistics(self, request, context) -> pb.StatisticsResponse:
+        self._abort_if_follower(context)
         return self.topo.statistics()
 
     def Topology(self, request, context) -> pb.TopologyResponse:
+        self._abort_if_follower(context)
         return self.topo.to_proto()
 
     def CollectionList(self, request, context) -> pb.CollectionListResponse:
+        self._abort_if_follower(context)
         return pb.CollectionListResponse(collections=self.topo.collections())
 
     def CollectionDelete(self, request, context) -> pb.CollectionDeleteResponse:
+        leader = self._not_leader()
+        if leader is not None:
+            return pb.CollectionDeleteResponse(
+                error=f"not leader; leader={leader}"
+            )
         """Drop every volume AND EC shard set of a collection
         cluster-wide — the fast bucket-delete path (reference
         CollectionDelete: reclaims space without per-object tombstones).
@@ -261,16 +364,39 @@ class MasterServer:
         vacuum_interval: float = 60.0,
         ec_auto_fullness: float = 0.0,
         ec_quiet_seconds: float = 60.0,
+        peers: list[str] | str | None = None,
+        meta_dir: str | None = None,
+        election_timeout: tuple[float, float] = (0.4, 0.8),
     ):
         """ec_auto_fullness > 0 turns on the maintenance scanner: volumes
         at that fraction of the size limit (and write-quiet) get an
         ec_encode task submitted for the worker fleet (reference admin
-        maintenance scanner)."""
+        maintenance scanner).
+
+        `peers`: every master in the HA group (including this one), as
+        http host:port addresses — raft replicates the allocation state
+        across them (reference raft_hashicorp.go). Empty/None = classic
+        single master (instant self-leader)."""
         self.ip = ip
         self.port = port
         self.grpc_port = grpc_port or (port + 10000)
         self.topo = Topology(volume_size_limit=volume_size_limit)
-        self.service = MasterService(self.topo, jwt_key=jwt_key)
+
+        from .raft import NotLeader, RaftNode  # noqa: F401 (NotLeader re-export)
+
+        if isinstance(peers, str):
+            peers = [p.strip() for p in peers.split(",") if p.strip()]
+        self.node_id = f"{ip}:{port}"
+        self.raft = RaftNode(
+            node_id=self.node_id,
+            peers=list(peers or []),
+            state_dir=meta_dir,
+            apply_fn=self._raft_apply,
+            election_timeout=election_timeout,
+        )
+        self.raft.on_leader_change = self._on_leader_change
+        self.service = MasterService(self.topo, jwt_key=jwt_key, raft=self.raft)
+        self.service.alloc_volume_id = self._alloc_volume_id
         self.garbage_threshold = garbage_threshold
         self.vacuum_interval = vacuum_interval
         self.ec_auto_fullness = ec_auto_fullness
@@ -286,12 +412,33 @@ class MasterServer:
         self._grpc = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
         rpc.add_service(self._grpc, rpc.MASTER_SERVICE, self.service)
         rpc.add_service(self._grpc, rpc.WORKER_SERVICE, self.worker_control)
+        rpc.add_service(self._grpc, rpc.RAFT_SERVICE, self.raft)
         self._grpc.add_insecure_port(f"{ip}:{self.grpc_port}")
 
         self._http = ThreadingHTTPServer((ip, port), self._handler_class())
         self._http_thread = threading.Thread(
             target=self._http.serve_forever, daemon=True
         )
+
+    # --------------------------------------------------------------- ha
+
+    def _raft_apply(self, kind: str, value: int) -> int:
+        if kind == "alloc_volume_id":
+            return self.topo.apply_allocated_volume_id(value)
+        return 0
+
+    def _alloc_volume_id(self) -> int:
+        """Volume ids are allocated through the replicated log so a
+        failed-over leader can never reuse one (reference: raft-backed
+        max volume id)."""
+        return self.raft.propose("alloc_volume_id", self.topo.max_volume_id)
+
+    def _on_leader_change(self, leader: str) -> None:
+        self.topo.publish_leader(leader)
+
+    @property
+    def is_leader(self) -> bool:
+        return self.raft.is_leader
 
     # ------------------------------------------------------------- http
 
@@ -510,11 +657,13 @@ class MasterServer:
 
     def start(self) -> None:
         self._grpc.start()
+        self.raft.start()
         self._http_thread.start()
         self._vacuum_thread.start()
 
     def stop(self) -> None:
         self.worker_control.stop()
+        self.raft.stop()
         self._vacuum_stop.set()
         self._grpc.stop(grace=0.5)
         self._http.shutdown()

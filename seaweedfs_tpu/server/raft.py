@@ -1,0 +1,515 @@
+"""Raft consensus for master HA.
+
+Reference: weed/server/raft_hashicorp.go + raft_server.go — the
+reference replicates the max-volume-id/sequencer allocation state across
+masters and derives leadership for the topology (`Topo.IsLeader`,
+topology.go:245). This is an original, compact Raft (leader election,
+log replication, majority commit, durable term/vote/log) specialised to
+that small state machine; topology itself is NOT replicated — it is
+rebuilt from volume-server heartbeats on whichever master leads, exactly
+like the reference.
+
+State machine commands:
+  alloc_volume_id(value=hint) -> applied result max(state, hint) + 1
+  noop                        -> leader barrier entry on election
+
+Persistence: one JSON-lines file per node (term/vote records and log
+entries), fsynced on every durable mutation before any RPC response
+that promises it — the same discipline the storage engine uses.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+
+import grpc
+
+from ..pb import cluster_pb2 as pb
+from ..pb import rpc
+from ..utils.glog import logger
+
+log = logger("raft")
+
+FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+
+class RaftNode:
+    """One master's raft participant.
+
+    `node_id` / `peers` are the masters' HTTP host:port addresses (the
+    cluster-wide names); RPCs go to port+10000 like every other service.
+    `apply_fn(kind, value) -> result` runs under the node lock in log
+    order exactly once per committed entry.
+    """
+
+    def __init__(
+        self,
+        node_id: str,
+        peers: list[str],
+        state_dir: str | None = None,
+        apply_fn=None,
+        election_timeout: tuple[float, float] = (0.4, 0.8),
+        heartbeat_interval: float = 0.1,
+    ):
+        self.node_id = node_id
+        self.peers = [p for p in peers if p != node_id]
+        self.apply_fn = apply_fn or (lambda kind, value: 0)
+        self.election_timeout = election_timeout
+        self.heartbeat_interval = heartbeat_interval
+
+        self._lock = threading.RLock()
+        self._applied_cv = threading.Condition(self._lock)
+        self.role = FOLLOWER
+        self.current_term = 0
+        self.voted_for: str | None = None
+        self.log: list[pb.RaftEntry] = []  # index 1-based: log[i-1]
+        self.commit_index = 0
+        self.last_applied = 0
+        self.leader_id: str | None = None
+        self._apply_results: dict[int, int] = {}
+        # leader volatile state
+        self._next_index: dict[str, int] = {}
+        self._match_index: dict[str, int] = {}
+
+        self._state_path = (
+            os.path.join(state_dir, "raft.jsonl") if state_dir else None
+        )
+        self._state_file = None
+        self._load_state()
+
+        self._stop = threading.Event()
+        self._last_heard = time.monotonic()
+        self._last_broadcast = 0.0
+        self._repl_inflight: set[str] = set()
+        self._channels: dict[str, grpc.Channel] = {}
+        self._threads: list[threading.Thread] = []
+        # hook(leader_addr) fired whenever the known leader changes
+        # (election won, or a valid leader's first append) — the master
+        # uses it to notify KeepConnected sessions
+        self.on_leader_change = None
+
+    # ------------------------------------------------------- persistence
+
+    def _load_state(self) -> None:
+        if not self._state_path or not os.path.exists(self._state_path):
+            return
+        with open(self._state_path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    break  # torn tail from a crash: ignore the partial record
+                if rec["t"] == "term":
+                    self.current_term = rec["term"]
+                    self.voted_for = rec.get("voted_for")
+                elif rec["t"] == "entry":
+                    e = pb.RaftEntry(
+                        term=rec["term"],
+                        index=rec["index"],
+                        kind=rec["kind"],
+                        value=rec.get("value", 0),
+                    )
+                    # replace any conflicting suffix, then append
+                    del self.log[e.index - 1 :]
+                    self.log.append(e)
+                elif rec["t"] == "truncate":
+                    del self.log[rec["index"] - 1 :]
+
+    def _persist(self, rec: dict) -> None:
+        if not self._state_path:
+            return
+        if self._state_file is None:
+            self._state_file = open(self._state_path, "a", encoding="utf-8")
+        self._state_file.write(json.dumps(rec) + "\n")
+        self._state_file.flush()
+        os.fsync(self._state_file.fileno())
+
+    def _persist_term(self) -> None:
+        self._persist(
+            {"t": "term", "term": self.current_term, "voted_for": self.voted_for}
+        )
+
+    def _persist_entry(self, e: pb.RaftEntry) -> None:
+        self._persist(
+            {
+                "t": "entry",
+                "term": e.term,
+                "index": e.index,
+                "kind": e.kind,
+                "value": e.value,
+            }
+        )
+
+    # ------------------------------------------------------------ timers
+
+    def start(self) -> None:
+        if not self.peers:
+            # single-master deployment: degenerate raft, instant leader
+            with self._lock:
+                self.current_term += 1
+                self._become_leader_locked()
+        t = threading.Thread(target=self._ticker, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for ch in self._channels.values():
+            ch.close()
+        if self._state_file:
+            self._state_file.close()
+            self._state_file = None
+
+    def _election_deadline(self) -> float:
+        lo, hi = self.election_timeout
+        return random.uniform(lo, hi)
+
+    def _ticker(self) -> None:
+        deadline = self._election_deadline()
+        while not self._stop.wait(0.02):
+            with self._lock:
+                role = self.role
+            if role == LEADER:
+                if (
+                    time.monotonic() - self._last_broadcast
+                    >= self.heartbeat_interval
+                ):
+                    self._broadcast_append()
+            else:
+                if time.monotonic() - self._last_heard > deadline:
+                    deadline = self._election_deadline()
+                    self._run_election()
+
+    # ---------------------------------------------------------- election
+
+    def _run_election(self) -> None:
+        with self._lock:
+            if not self.peers:
+                return
+            self.role = CANDIDATE
+            self.current_term += 1
+            self.voted_for = self.node_id
+            self._set_leader_locked(None)  # the old leader timed out
+            self._persist_term()
+            term = self.current_term
+            last_idx = len(self.log)
+            last_term = self.log[-1].term if self.log else 0
+        self._last_heard = time.monotonic()
+        log.v(1, f"{self.node_id}: starting election term {term}")
+        votes = 1
+        req = pb.RaftVoteRequest(
+            term=term,
+            candidate_id=self.node_id,
+            last_log_index=last_idx,
+            last_log_term=last_term,
+        )
+        lock = threading.Lock()
+        done = threading.Event()
+
+        def ask(peer: str):
+            nonlocal votes
+            try:
+                resp = self._peer_stub(peer).RaftRequestVote(req, timeout=2)
+            except grpc.RpcError:
+                return
+            with self._lock:
+                if resp.term > self.current_term:
+                    self._step_down_locked(resp.term)
+                    done.set()
+                    return
+                if (
+                    resp.granted
+                    and self.role == CANDIDATE
+                    and self.current_term == term
+                ):
+                    with lock:
+                        votes += 1
+                        if votes > (len(self.peers) + 1) // 2:
+                            self._become_leader_locked()
+                            done.set()
+
+        threads = [
+            threading.Thread(target=ask, args=(p,), daemon=True)
+            for p in self.peers
+        ]
+        for t in threads:
+            t.start()
+        done.wait(timeout=2)
+
+    def _set_leader_locked(self, leader: str | None) -> None:
+        if leader == self.leader_id:
+            return
+        self.leader_id = leader
+        if self.on_leader_change and leader:
+            try:
+                self.on_leader_change(leader)
+            except Exception:  # noqa: BLE001 — a hook must not kill raft
+                pass
+
+    def _become_leader_locked(self) -> None:
+        if self.role == LEADER:
+            return
+        self.role = LEADER
+        self._set_leader_locked(self.node_id)
+        next_idx = len(self.log) + 1
+        for p in self.peers:
+            self._next_index[p] = next_idx
+            self._match_index[p] = 0
+        log.info(f"{self.node_id}: leader for term {self.current_term}")
+        # commit barrier: an entry from the current term must commit
+        # before earlier-term entries count as committed (Raft §5.4.2)
+        self._append_locked("noop", 0)
+        if not self.peers:
+            self._advance_commit_locked(len(self.log))
+
+    def _step_down_locked(self, term: int) -> None:
+        if term > self.current_term:
+            self.current_term = term
+            self.voted_for = None
+            self._persist_term()
+        if self.role != FOLLOWER:
+            log.info(f"{self.node_id}: stepping down (term {term})")
+        self.role = FOLLOWER
+        # whoever led before is no longer known-good; advertising a
+        # stale leader would bounce clients at a dead address
+        self._set_leader_locked(None)
+        self._last_heard = time.monotonic()
+
+    # --------------------------------------------------------------- log
+
+    def _append_locked(self, kind: str, value: int) -> int:
+        e = pb.RaftEntry(
+            term=self.current_term, index=len(self.log) + 1, kind=kind, value=value
+        )
+        self.log.append(e)
+        self._persist_entry(e)
+        return e.index
+
+    def propose(self, kind: str, value: int = 0, timeout: float = 10.0) -> int:
+        """Leader-only: append, replicate, wait for apply; returns the
+        state machine's result for the entry."""
+        with self._lock:
+            if self.role != LEADER:
+                raise NotLeader(self.leader_id)
+            term = self.current_term
+            idx = self._append_locked(kind, value)
+        self._broadcast_append()
+        deadline = time.monotonic() + timeout
+        with self._applied_cv:
+            while self.last_applied < idx:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"raft commit timeout at index {idx}")
+                self._applied_cv.wait(remaining)
+            # the entry at idx must still be OURS (a competing leader
+            # may have overwritten the uncommitted suffix)
+            if idx > len(self.log) or self.log[idx - 1].term != term:
+                raise NotLeader(self.leader_id)
+            return self._apply_results.get(idx, 0)
+
+    def _advance_commit_locked(self, new_commit: int) -> None:
+        new_commit = min(new_commit, len(self.log))
+        if new_commit <= self.commit_index:
+            return
+        self.commit_index = new_commit
+        while self.last_applied < self.commit_index:
+            e = self.log[self.last_applied]
+            self.last_applied += 1
+            result = self.apply_fn(e.kind, e.value)
+            self._apply_results[e.index] = int(result or 0)
+            if len(self._apply_results) > 4096:
+                for k in sorted(self._apply_results)[:2048]:
+                    del self._apply_results[k]
+        self._applied_cv.notify_all()
+
+    # ------------------------------------------------------- replication
+
+    def _peer_stub(self, peer: str):
+        ch = self._channels.get(peer)
+        if ch is None:
+            host, _, port = peer.partition(":")
+            ch = grpc.insecure_channel(f"{host}:{int(port) + 10000}")
+            self._channels[peer] = ch
+        return rpc.Stub(ch, rpc.RAFT_SERVICE)
+
+    def _broadcast_append(self) -> None:
+        self._last_broadcast = time.monotonic()
+        if not self.peers:
+            # single-node group: a majority of one is the leader itself
+            with self._lock:
+                if self.role == LEADER:
+                    self._advance_commit_locked(len(self.log))
+            return
+        # one replication in flight per peer: a slow/dead peer must not
+        # accumulate a new blocked thread per tick
+        with self._lock:
+            targets = [p for p in self.peers if p not in self._repl_inflight]
+            self._repl_inflight.update(targets)
+        for p in targets:
+            threading.Thread(
+                target=self._replicate_guarded, args=(p,), daemon=True
+            ).start()
+
+    def _replicate_guarded(self, peer: str) -> None:
+        try:
+            self._replicate_to(peer)
+        finally:
+            with self._lock:
+                self._repl_inflight.discard(peer)
+
+    def _replicate_to(self, peer: str) -> None:
+        with self._lock:
+            if self.role != LEADER:
+                return
+            term = self.current_term
+            next_idx = self._next_index.get(peer, len(self.log) + 1)
+            prev_idx = next_idx - 1
+            prev_term = self.log[prev_idx - 1].term if prev_idx >= 1 and prev_idx <= len(self.log) else 0
+            entries = self.log[next_idx - 1 :]
+            req = pb.RaftAppendRequest(
+                term=term,
+                leader_id=self.node_id,
+                prev_log_index=prev_idx,
+                prev_log_term=prev_term,
+                entries=entries,
+                leader_commit=self.commit_index,
+            )
+        try:
+            resp = self._peer_stub(peer).RaftAppendEntries(req, timeout=2)
+        except grpc.RpcError:
+            return
+        with self._lock:
+            if resp.term > self.current_term:
+                self._step_down_locked(resp.term)
+                return
+            if self.role != LEADER or self.current_term != term:
+                return
+            if resp.success:
+                self._match_index[peer] = max(
+                    self._match_index.get(peer, 0), resp.match_index
+                )
+                self._next_index[peer] = self._match_index[peer] + 1
+                # majority commit (count self)
+                for n in range(len(self.log), self.commit_index, -1):
+                    if self.log[n - 1].term != self.current_term:
+                        break  # only current-term entries commit by counting
+                    acks = 1 + sum(
+                        1 for p in self.peers if self._match_index.get(p, 0) >= n
+                    )
+                    if acks > (len(self.peers) + 1) // 2:
+                        self._advance_commit_locked(n)
+                        break
+            else:
+                # fast back-up using the follower's conflict hint
+                self._next_index[peer] = max(
+                    1, min(resp.conflict_index or (next_idx - 1), len(self.log) + 1)
+                )
+
+    # ------------------------------------------------------ RPC handlers
+
+    def RaftRequestVote(self, request: pb.RaftVoteRequest, context) -> pb.RaftVoteResponse:
+        with self._lock:
+            if request.term > self.current_term:
+                self._step_down_locked(request.term)
+            granted = False
+            if request.term == self.current_term and self.voted_for in (
+                None,
+                request.candidate_id,
+            ):
+                last_idx = len(self.log)
+                last_term = self.log[-1].term if self.log else 0
+                up_to_date = request.last_log_term > last_term or (
+                    request.last_log_term == last_term
+                    and request.last_log_index >= last_idx
+                )
+                if up_to_date:
+                    granted = True
+                    self.voted_for = request.candidate_id
+                    self._persist_term()
+                    self._last_heard = time.monotonic()
+            return pb.RaftVoteResponse(term=self.current_term, granted=granted)
+
+    def RaftAppendEntries(self, request: pb.RaftAppendRequest, context) -> pb.RaftAppendResponse:
+        with self._lock:
+            if request.term > self.current_term:
+                self._step_down_locked(request.term)
+            if request.term < self.current_term:
+                return pb.RaftAppendResponse(
+                    term=self.current_term, success=False
+                )
+            # valid leader for our term
+            self.role = FOLLOWER
+            self._set_leader_locked(request.leader_id)
+            self._last_heard = time.monotonic()
+            # log consistency check
+            if request.prev_log_index > len(self.log):
+                return pb.RaftAppendResponse(
+                    term=self.current_term,
+                    success=False,
+                    conflict_index=len(self.log) + 1,
+                )
+            if (
+                request.prev_log_index >= 1
+                and self.log[request.prev_log_index - 1].term
+                != request.prev_log_term
+            ):
+                bad_term = self.log[request.prev_log_index - 1].term
+                ci = request.prev_log_index
+                while ci > 1 and self.log[ci - 2].term == bad_term:
+                    ci -= 1
+                return pb.RaftAppendResponse(
+                    term=self.current_term, success=False, conflict_index=ci
+                )
+            # append / overwrite conflicts
+            for e in request.entries:
+                if e.index <= len(self.log):
+                    if self.log[e.index - 1].term == e.term:
+                        continue  # already have it
+                    del self.log[e.index - 1 :]
+                    self._persist({"t": "truncate", "index": e.index})
+                self.log.append(e)
+                self._persist_entry(e)
+            if request.leader_commit > self.commit_index:
+                self._advance_commit_locked(request.leader_commit)
+            return pb.RaftAppendResponse(
+                term=self.current_term,
+                success=True,
+                match_index=request.prev_log_index + len(request.entries),
+            )
+
+    def RaftStatus(self, request, context) -> pb.RaftStatusResponse:
+        with self._lock:
+            return pb.RaftStatusResponse(
+                node_id=self.node_id,
+                leader=self.leader_id or "",
+                term=self.current_term,
+                role=self.role,
+                peers=list(self.peers),
+                commit_index=self.commit_index,
+                applied_index=self.last_applied,
+            )
+
+    # ----------------------------------------------------------- queries
+
+    @property
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self.role == LEADER
+
+    @property
+    def leader(self) -> str | None:
+        with self._lock:
+            return self.leader_id
+
+
+class NotLeader(Exception):
+    def __init__(self, leader: str | None):
+        super().__init__(f"not the leader (try {leader or 'unknown'})")
+        self.leader = leader

@@ -9,6 +9,7 @@ placement lands. Registration comes from heartbeats
 
 from __future__ import annotations
 
+import queue
 import random
 import threading
 import time
@@ -67,6 +68,77 @@ class Topology:
 
             sequencer = SnowflakeSequencer()
         self._sequencer = sequencer
+        # KeepConnected subscribers: queues fed a VolumeLocationUpdate
+        # per topology change (reference master KeepConnected streaming)
+        self._subscribers: list[queue.Queue] = []
+
+    # ----------------------------------------------------- keepconnected
+
+    def subscribe(self) -> tuple[queue.Queue, list[pb.VolumeLocationUpdate]]:
+        """Register a KeepConnected session: returns (delta queue, full
+        snapshot — one update per node listing everything it holds)."""
+        with self._lock:
+            q: queue.Queue = queue.Queue(maxsize=4096)
+            q.overflowed = False
+            self._subscribers.append(q)
+            snapshot = [
+                pb.VolumeLocationUpdate(
+                    url=f"{n.ip}:{n.port}",
+                    public_url=n.public_url,
+                    grpc_port=n.grpc_port,
+                    new_vids=sorted(n.volumes),
+                    new_ec_vids=sorted(n.ec_shards),
+                )
+                for n in self.nodes.values()
+            ]
+            return q, snapshot
+
+    def unsubscribe(self, q: queue.Queue) -> None:
+        with self._lock:
+            if q in self._subscribers:
+                self._subscribers.remove(q)
+
+    def _publish_locked(self, update: pb.VolumeLocationUpdate) -> None:
+        for q in list(self._subscribers):
+            try:
+                q.put_nowait(update)
+            except queue.Full:
+                # a wedged client must NOT keep serving its now-stale
+                # map as authoritative: poison the session so the
+                # KeepConnected loop ends the stream and the client
+                # reconnects with a fresh snapshot
+                q.overflowed = True
+                self._subscribers.remove(q)
+
+    def publish_leader(self, leader: str) -> None:
+        """Push a leader-change notice to every session (clients
+        reconnect to the new leader)."""
+        with self._lock:
+            self._publish_locked(pb.VolumeLocationUpdate(leader=leader))
+
+    def _node_delta_locked(
+        self,
+        node: DataNode,
+        new_vids=(),
+        deleted_vids=(),
+        new_ec=(),
+        deleted_ec=(),
+        gone: bool = False,
+    ) -> None:
+        if not (new_vids or deleted_vids or new_ec or deleted_ec or gone):
+            return
+        self._publish_locked(
+            pb.VolumeLocationUpdate(
+                url=f"{node.ip}:{node.port}",
+                public_url=node.public_url,
+                grpc_port=node.grpc_port,
+                new_vids=sorted(new_vids),
+                deleted_vids=sorted(deleted_vids),
+                new_ec_vids=sorted(new_ec),
+                deleted_ec_vids=sorted(deleted_ec),
+                server_gone=gone,
+            )
+        )
 
     # -------------------------------------------------------- heartbeats
 
@@ -75,6 +147,8 @@ class Topology:
         with self._lock:
             # re-insert if a stale stream's cleanup raced us out
             self.nodes.setdefault(node.node_id, node)
+            old_vids = set(node.volumes)
+            old_ec = set(node.ec_shards)
             if hb.volumes or hb.has_no_volumes:
                 node.volumes = {v.id: v for v in hb.volumes}
             if hb.ec_shards or hb.has_no_ec_shards:
@@ -82,14 +156,26 @@ class Topology:
             for v in node.volumes.values():
                 self.max_volume_id = max(self.max_volume_id, v.id)
             node.last_seen = time.time()
+            self._node_delta_locked(
+                node,
+                new_vids=set(node.volumes) - old_vids,
+                deleted_vids=old_vids - set(node.volumes),
+                new_ec=set(node.ec_shards) - old_ec,
+                deleted_ec=old_ec - set(node.ec_shards),
+            )
 
     def incremental_update(self, node: DataNode, hb: pb.Heartbeat) -> None:
         with self._lock:
+            added_vids, removed_vids = set(), set()
+            added_ec, removed_ec = set(), set()
             for v in hb.new_volumes:
+                if v.id not in node.volumes:
+                    added_vids.add(v.id)
                 node.volumes[v.id] = v
                 self.max_volume_id = max(self.max_volume_id, v.id)
             for vid in hb.deleted_volumes:
-                node.volumes.pop(vid, None)
+                if node.volumes.pop(vid, None) is not None:
+                    removed_vids.add(vid)
             for e in hb.new_ec_shards:
                 cur = node.ec_shards.get(e.id)
                 if cur is not None:
@@ -97,6 +183,8 @@ class Topology:
                         continue  # stale report loses to the newer generation
                     if e.generation == cur.generation:
                         e.shard_bits |= cur.shard_bits
+                else:
+                    added_ec.add(e.id)
                 node.ec_shards[e.id] = e
             for e in hb.deleted_ec_shards:
                 cur = node.ec_shards.get(e.id)
@@ -105,7 +193,15 @@ class Topology:
                 cur.shard_bits &= ~e.shard_bits
                 if cur.shard_bits == 0:
                     node.ec_shards.pop(e.id, None)
+                    removed_ec.add(e.id)
             node.last_seen = time.time()
+            self._node_delta_locked(
+                node,
+                new_vids=added_vids,
+                deleted_vids=removed_vids,
+                new_ec=added_ec,
+                deleted_ec=removed_ec,
+            )
 
     def register_node(self, hb: pb.Heartbeat) -> DataNode:
         with self._lock:
@@ -137,6 +233,7 @@ class Topology:
             if owner_token is not None and node.owner_token is not owner_token:
                 return
             self.nodes.pop(node_id, None)
+            self._node_delta_locked(node, gone=True)
 
     def collections(self) -> list[str]:
         with self._lock:
@@ -153,7 +250,8 @@ class Topology:
         with self._lock:
             dead = [nid for nid, n in self.nodes.items() if n.last_seen < cutoff]
             for nid in dead:
-                del self.nodes[nid]
+                node = self.nodes.pop(nid)
+                self._node_delta_locked(node, gone=True)
             return dead
 
     # ------------------------------------------------------------ lookup
@@ -185,6 +283,25 @@ class Topology:
     def next_volume_id(self) -> int:
         with self._lock:
             self.max_volume_id += 1
+            return self.max_volume_id
+
+    def optimistic_add_volume(self, node: DataNode, vol: pb.VolumeInfoMsg) -> None:
+        """Register a just-allocated volume before its heartbeat
+        confirms it — and PUBLISH the delta, so KeepConnected sessions
+        learn new volumes without waiting a heartbeat period."""
+        with self._lock:
+            fresh = vol.id not in node.volumes
+            node.volumes[vol.id] = vol
+            self.max_volume_id = max(self.max_volume_id, vol.id)
+            if fresh:
+                self._node_delta_locked(node, new_vids=(vol.id,))
+
+    def apply_allocated_volume_id(self, hint: int) -> int:
+        """Raft state-machine apply: allocate past both the replicated
+        max and the heartbeat-observed max (`hint` is the proposer's
+        view; followers converge on the same value in log order)."""
+        with self._lock:
+            self.max_volume_id = max(self.max_volume_id, hint) + 1
             return self.max_volume_id
 
     def writable_volumes(

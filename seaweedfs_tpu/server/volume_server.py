@@ -617,8 +617,11 @@ class VolumeServer:
         self.ip = ip
         self.port = port
         self.grpc_port = grpc_port or (port + 10000)
+        # `master` may be a comma-separated HA group; heartbeats follow
+        # the raft leader via HeartbeatResponse.leader redirects
+        self.master_addrs = [m.strip() for m in master.split(",") if m.strip()]
         self.master_addr = master
-        self.master_grpc_addr = self._master_grpc(master)
+        self.master_grpc_addr = self._master_grpc(self.master_addrs[0])
         self.max_volume_count = max_volume_count
         self.data_center = data_center
         self.rack = rack
@@ -873,16 +876,33 @@ class VolumeServer:
                 last_full = time.time()
 
     def _heartbeat_loop(self):
+        target = self.master_addrs[0]
+        fail_idx = 0
         while not self._hb_stop.is_set():
+            redirect = None
             try:
-                with grpc.insecure_channel(self.master_grpc_addr) as ch:
+                with grpc.insecure_channel(self._master_grpc(target)) as ch:
                     stream = rpc.master_stub(ch).SendHeartbeat(self._heartbeat_iter())
                     for resp in stream:
                         if self._hb_stop.is_set():
                             return
+                        if resp.leader and resp.leader != target:
+                            # a follower answered: re-home to the leader
+                            redirect = resp.leader
+                            break
             except grpc.RpcError:
-                if self._hb_stop.wait(1.0):
-                    return
+                pass
+            if self._hb_stop.is_set():
+                return
+            if redirect:
+                target = redirect
+                continue  # reconnect immediately, no backoff
+            # stream broke or follower with no known leader: try the
+            # next configured master after a short pause
+            fail_idx += 1
+            target = self.master_addrs[fail_idx % len(self.master_addrs)]
+            if self._hb_stop.wait(1.0):
+                return
 
     # -------------------------------------------------------------- http
 
