@@ -368,6 +368,56 @@ class MasterClient:
             raise RuntimeError(resp.error)
         return list(resp.deleted_volume_ids)
 
+    # ------------------------------------------------------------- locks
+
+    def lock(
+        self, name: str, owner: str, ttl: float = 60.0, token: str = "",
+        wait: float = 0.0,
+    ) -> str:
+        """Acquire (or renew with `token`) the named cluster lease;
+        returns the token. Waits up to `wait` seconds for a busy lock.
+        Raises LockHeldError when it stays held."""
+        deadline = time.time() + wait
+
+        def call(stub):
+            resp = stub.AdminLock(
+                pb.LockRequest(
+                    name=name, owner=owner, ttl_seconds=ttl, token=token
+                ),
+                timeout=10,
+            )
+            if resp.error.startswith("not leader"):
+                raise NotLeaderError(resp.error)
+            return resp
+
+        while True:
+            resp = self._with_leader(call)
+            if resp.ok:
+                return resp.token
+            if time.time() >= deadline:
+                raise LockHeldError(name, resp.holder)
+            time.sleep(min(0.2, max(deadline - time.time(), 0.01)))
+
+    def unlock(self, name: str, token: str) -> bool:
+        def call(stub):
+            resp = stub.AdminUnlock(
+                pb.UnlockRequest(name=name, token=token), timeout=10
+            )
+            if resp.error.startswith("not leader"):
+                raise NotLeaderError(resp.error)
+            return resp
+
+        try:
+            return self._with_leader(call).ok
+        except grpc.RpcError:
+            return False  # lease expiry cleans up regardless
+
+    def lock_status(self) -> list[tuple[str, str, float]]:
+        resp = self._with_leader(
+            lambda s: s.AdminLockStatus(pb.LockStatusRequest(), timeout=10)
+        )
+        return [(r.name, r.owner, r.expires_ns / 1e9) for r in resp.locks]
+
     def close(self) -> None:
         self._stop.set()
         # break any blocking stream first so the session thread exits,
@@ -387,6 +437,13 @@ class MasterClient:
 
 class NotLeaderError(Exception):
     pass
+
+
+class LockHeldError(Exception):
+    def __init__(self, name: str, holder: str):
+        super().__init__(f"cluster lock {name!r} is held by {holder}")
+        self.name = name
+        self.holder = holder
 
 
 def volume_channel(loc: pb.Location) -> grpc.Channel:

@@ -153,12 +153,15 @@ def get_backend(name: str, data_shards: int, parity_shards: int) -> RSBackend:
     if name == "tpu":
         return JaxBackend(ctx)
     if name == "auto":
-        try:
-            import jax
+        # NEVER call jax.devices() in-process here: with a dead TPU
+        # relay the backend init hangs forever, wedging the volume
+        # server's first EC generate (and everything queued behind it).
+        from ..utils.devices import accelerator_available
 
-            if jax.devices()[0].platform != "cpu":
+        if accelerator_available():
+            try:
                 return JaxBackend(ctx)
-        except Exception:
-            pass
+            except Exception:
+                pass
         return CpuBackend(ctx)
     raise ECError(f"unknown EC backend {name!r} (want cpu|tpu|auto)")

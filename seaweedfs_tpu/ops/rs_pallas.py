@@ -288,10 +288,6 @@ def apply_planes_pallas(
     )
 
 
-def is_tpu() -> bool:
-    try:
-        return jax.default_backend() in ("tpu", "axon") or any(
-            d.platform in ("tpu", "axon") for d in jax.devices()
-        )
-    except Exception:
-        return False
+# NOTE: device-presence decisions live in utils/devices.py
+# (watchdogged subprocess probe) — an in-process jax.devices() call
+# hangs forever when the TPU relay is down.

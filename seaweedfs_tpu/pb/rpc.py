@@ -37,6 +37,9 @@ SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
         "CollectionList": (UNARY, pb.CollectionListRequest, pb.CollectionListResponse),
         "CollectionDelete": (UNARY, pb.CollectionDeleteRequest, pb.CollectionDeleteResponse),
         "KeepConnected": (SERVER_STREAM, pb.KeepConnectedRequest, pb.VolumeLocationUpdate),
+        "AdminLock": (UNARY, pb.LockRequest, pb.LockResponse),
+        "AdminUnlock": (UNARY, pb.UnlockRequest, pb.UnlockResponse),
+        "AdminLockStatus": (UNARY, pb.LockStatusRequest, pb.LockStatusResponse),
     },
     VOLUME_SERVICE: {
         "AllocateVolume": (UNARY, pb.AllocateVolumeRequest, pb.AllocateVolumeResponse),

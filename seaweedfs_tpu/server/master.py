@@ -34,10 +34,13 @@ class MasterService:
     """gRPC servicer (method-per-RPC, see pb/rpc.py)."""
 
     def __init__(self, topo: Topology, jwt_key: str = "", raft=None):
+        from .cluster_lock import LockManager
+
         self.topo = topo
         self.jwt_key = jwt_key
         self.raft = raft  # None = pre-raft single master (tests construct this)
         self._grow_lock = threading.Lock()
+        self.locks = LockManager()
         # volume-id allocation goes through raft when HA is on
         self.alloc_volume_id = topo.next_volume_id
 
@@ -119,6 +122,46 @@ class MasterService:
                     return  # stepped down: client reconnects to the leader
         finally:
             self.topo.unsubscribe(q)
+
+    # ------------------------------------------------------------ locks
+
+    def AdminLock(self, request: pb.LockRequest, context) -> pb.LockResponse:
+        leader = self._not_leader()
+        if leader is not None:
+            return pb.LockResponse(error=f"not leader; leader={leader}")
+        ok, token, holder, remaining = self.locks.acquire(
+            request.name,
+            request.owner,
+            request.ttl_seconds or 60.0,
+            request.token,
+        )
+        return pb.LockResponse(
+            ok=ok,
+            token=token,
+            holder=holder,
+            expires_ns=int(remaining * 1e9),
+            error="" if ok else f"held by {holder}",
+        )
+
+    def AdminUnlock(self, request: pb.UnlockRequest, context) -> pb.UnlockResponse:
+        leader = self._not_leader()
+        if leader is not None:
+            return pb.UnlockResponse(error=f"not leader; leader={leader}")
+        ok = self.locks.release(request.name, request.token)
+        return pb.UnlockResponse(
+            ok=ok, error="" if ok else "not held by this token"
+        )
+
+    def AdminLockStatus(self, request, context) -> pb.LockStatusResponse:
+        # leases live on the leader only: a deposed master's (stale,
+        # typically empty) table must not masquerade as cluster state
+        self._abort_if_follower(context)
+        return pb.LockStatusResponse(
+            locks=[
+                pb.LockRow(name=n, owner=o, expires_ns=int(r * 1e9))
+                for n, o, r in self.locks.status()
+            ]
+        )
 
     # ----------------------------------------------------------- assign
 

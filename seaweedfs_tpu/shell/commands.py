@@ -11,11 +11,17 @@ the REPL and one-shot `python -m seaweedfs_tpu.shell -c "..."`.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import shlex
+import uuid as _uuid
 
 import grpc
 
-from ..client.master_client import MasterClient, volume_channel
+from ..client.master_client import (
+    LockHeldError,
+    MasterClient,
+    volume_channel,
+)
 from ..pb import cluster_pb2 as pb
 from ..pb import rpc
 
@@ -25,16 +31,105 @@ class ShellEnv:
         self.master_addr = master
         self.filer_addr = filer
         self.master = MasterClient(master)
+        self.owner = f"shell-{_uuid.uuid4().hex[:8]}"
+        # how long mutating commands wait for a busy cluster lock
+        self.lock_wait = 10.0
+        # set by the explicit `lock` command: held across the session
+        self.admin_token = ""
+        # set while a mutating command auto-holds the admin lease
+        # (makes nested cluster_guard calls re-entrant)
+        self._auto_admin_token = ""
 
     def close(self):
+        if self.admin_token:
+            self.master.unlock("admin", self.admin_token)
+            self.admin_token = ""
         self.master.close()
+
+
+@contextlib.contextmanager
+def cluster_guard(env: ShellEnv, vids=(), ttl: float = 600.0, wait: float | None = None):
+    """Exclusive cluster lock for a mutating command (reference
+    confirmIsLocked): the global admin lease plus a per-volume lease for
+    every touched volume, so two shells — or a shell and the worker
+    fleet — cannot race destructive steps on the same volume. The admin
+    lease is auto-acquired per command unless the session holds it via
+    the `lock` command."""
+    import threading as _threading
+
+    if wait is None:
+        wait = env.lock_wait
+    held = env.admin_token or env._auto_admin_token
+    admin_tok = env.master.lock(
+        "admin", env.owner, ttl=ttl, token=held, wait=wait
+    )
+    outer = not held
+    if outer:
+        env._auto_admin_token = admin_tok
+    vol_toks: list[tuple[str, str]] = []
+    stop_renew = _threading.Event()
+
+    def _renew_loop():
+        # a command outliving its ttl must not silently lose mutual
+        # exclusion: renew all held leases at ttl/3 cadence (renewal
+        # never shortens a lease server-side)
+        while not stop_renew.wait(max(ttl / 3.0, 1.0)):
+            try:
+                env.master.lock(
+                    "admin", env.owner, ttl=ttl, token=admin_tok, wait=0
+                )
+                for name, tok in vol_toks:
+                    env.master.lock(name, env.owner, ttl=ttl, token=tok, wait=0)
+            except Exception:  # noqa: BLE001 — lease lost (e.g. failover)
+                return
+
+    try:
+        for vid in vids:
+            name = f"volume/{int(vid)}"
+            vol_toks.append(
+                (name, env.master.lock(name, env.owner, ttl=ttl, wait=wait))
+            )
+        _threading.Thread(target=_renew_loop, daemon=True).start()
+        yield
+    finally:
+        stop_renew.set()
+        for name, tok in vol_toks:
+            env.master.unlock(name, tok)
+        if outer:
+            env._auto_admin_token = ""
+            if not env.admin_token:
+                env.master.unlock("admin", admin_tok)
 
 
 COMMANDS: dict[str, tuple] = {}
 
 
-def command(name: str, help_text: str):
+def command(name: str, help_text: str, mutating: bool = False):
+    """`mutating=True` gates the command on the exclusive cluster admin
+    lease (reference confirmIsLocked) — two shells cannot interleave
+    destructive cluster operations."""
+
     def deco(fn):
+        if mutating:
+            import functools
+
+            @functools.wraps(fn)
+            def wrapped(env, args):
+                # the command's -volumeId targets get per-volume leases
+                # too, so worker tasks on those volumes cannot interleave
+                vids: list[int] = []
+                for i, tok in enumerate(args):
+                    if tok == "-volumeId" and i + 1 < len(args):
+                        vids = [
+                            int(v)
+                            for v in str(args[i + 1]).split(",")
+                            if v.strip().isdigit()
+                        ]
+                with cluster_guard(env, vids=vids):
+                    return fn(env, args)
+
+            COMMANDS[name] = (wrapped, help_text)
+            return fn
         COMMANDS[name] = (fn, help_text)
         return fn
 
@@ -57,7 +152,7 @@ def run_command(env: ShellEnv, line: str) -> str:
         return entry[0](env, args)
     except grpc.RpcError as e:
         return f"error: {e.code().name}: {e.details()}"
-    except (LookupError, RuntimeError, OSError) as e:
+    except (LookupError, LockHeldError, RuntimeError, OSError) as e:
         return f"error: {e}"
 
 
@@ -132,7 +227,7 @@ def volume_grow(env: ShellEnv, args) -> str:
     return f"grew volumes: {vids}"
 
 
-@command("volume.vacuum", "-volumeId N [-garbageThreshold 0.3]")
+@command("volume.vacuum", "-volumeId N [-garbageThreshold 0.3]", mutating=True)
 def volume_vacuum(env: ShellEnv, args) -> str:
     p = argparse.ArgumentParser(prog="volume.vacuum")
     p.add_argument("-volumeId", type=int, required=True)
@@ -152,7 +247,7 @@ def volume_vacuum(env: ShellEnv, args) -> str:
     return "\n".join(out)
 
 
-@command("volume.delete", "-volumeId N")
+@command("volume.delete", "-volumeId N", mutating=True)
 def volume_delete(env: ShellEnv, args) -> str:
     p = argparse.ArgumentParser(prog="volume.delete")
     p.add_argument("-volumeId", type=int, required=True)
@@ -168,7 +263,7 @@ def volume_delete(env: ShellEnv, args) -> str:
     return "\n".join(out)
 
 
-@command("volume.mark", "-volumeId N -readonly|-writable")
+@command("volume.mark", "-volumeId N -readonly|-writable", mutating=True)
 def volume_mark(env: ShellEnv, args) -> str:
     p = argparse.ArgumentParser(prog="volume.mark")
     p.add_argument("-volumeId", type=int, required=True)
@@ -196,6 +291,7 @@ def volume_mark(env: ShellEnv, args) -> str:
     "ec.encode",
     "-volumeId N[,N2,...] [-collection c] [-backend cpu|tpu|auto] "
     "[-keepSource] [-maxParallelization P]",
+    mutating=True,
 )
 def ec_encode(env: ShellEnv, args) -> str:
     """Reference doEcEncode (command_ec_encode.go:346): mark replicas
@@ -268,6 +364,7 @@ def ec_encode(env: ShellEnv, args) -> str:
             f"{' (source kept)' if a.keepSource else ''}"
         )
 
+    # admin + per-volume leases come from the mutating-command wrapper
     if len(vids) == 1:
         return "ec.encode " + encode_one(vids[0])
     from concurrent.futures import ThreadPoolExecutor
@@ -322,7 +419,7 @@ def cluster_check(env: ShellEnv, args) -> str:
     return "\n".join(lines)
 
 
-@command("ec.rebuild", "-volumeId N [-collection c] [-backend cpu|tpu|auto]")
+@command("ec.rebuild", "-volumeId N [-collection c] [-backend cpu|tpu|auto]", mutating=True)
 def ec_rebuild(env: ShellEnv, args) -> str:
     p = argparse.ArgumentParser(prog="ec.rebuild")
     p.add_argument("-volumeId", type=int, required=True)
@@ -355,7 +452,7 @@ def ec_rebuild(env: ShellEnv, args) -> str:
     return f"rebuilt shards {list(r.rebuilt_shard_ids)} on {url}"
 
 
-@command("ec.decode", "-volumeId N [-collection c]")
+@command("ec.decode", "-volumeId N [-collection c]", mutating=True)
 def ec_decode(env: ShellEnv, args) -> str:
     """Collect all shards onto the node already holding the most, decode
     there, then clean the EC artifacts off every node (reference
@@ -429,7 +526,7 @@ def ec_decode(env: ShellEnv, args) -> str:
     return f"decoded ec volume {a.volumeId} back to a normal volume on {target_url}"
 
 
-@command("volume.move", "-volumeId N -target host:grpcPort (move one volume)")
+@command("volume.move", "-volumeId N -target host:grpcPort (move one volume)", mutating=True)
 def volume_move(env: ShellEnv, args) -> str:
     """Copy to target, load there, delete at source (reference
     volume.move: mark-readonly -> copy -> mount -> delete)."""
@@ -477,7 +574,7 @@ def volume_move(env: ShellEnv, args) -> str:
     return f"moved volume {a.volumeId} {src.url} -> {a.target}"
 
 
-@command("volume.fix.replication", "re-replicate under-replicated volumes")
+@command("volume.fix.replication", "re-replicate under-replicated volumes", mutating=True)
 def volume_fix_replication(env: ShellEnv, args) -> str:
     p = argparse.ArgumentParser(prog="volume.fix.replication")
     p.add_argument("-collection", default="")
@@ -524,7 +621,7 @@ def volume_fix_replication(env: ShellEnv, args) -> str:
     return "\n".join(fixed) or "all volumes sufficiently replicated"
 
 
-@command("ec.balance", "spread EC shards evenly across nodes")
+@command("ec.balance", "spread EC shards evenly across nodes", mutating=True)
 def ec_balance(env: ShellEnv, args) -> str:
     """Even out shard counts per node (reference command_ec_common.go:60
     balance algorithm, single-rack form: move shards from the most-loaded
@@ -677,7 +774,7 @@ def collection_list(env: ShellEnv, args) -> str:
     return "\n".join(env.master.collections()) or "(none)"
 
 
-@command("collection.delete", "-collection name (drop all its volumes)")
+@command("collection.delete", "-collection name (drop all its volumes)", mutating=True)
 def collection_delete(env: ShellEnv, args) -> str:
     p = argparse.ArgumentParser(prog="collection.delete")
     p.add_argument("-collection", required=True)
@@ -1105,3 +1202,37 @@ def download(env: ShellEnv, args) -> str:
         return f"{len(data)} bytes -> {a.o}"
     finally:
         ops.close()
+
+
+# -------------------------------------------------------------------- lock
+
+
+@command("lock", "hold the exclusive cluster admin lease for this session")
+def lock_cmd(env: ShellEnv, args) -> str:
+    p = argparse.ArgumentParser(prog="lock")
+    p.add_argument("-ttl", type=float, default=600.0)
+    a = p.parse_args(args)
+    env.admin_token = env.master.lock(
+        "admin", env.owner, ttl=a.ttl, token=env.admin_token, wait=5.0
+    )
+    return f"locked as {env.owner} (ttl {a.ttl:.0f}s; renew with `lock`)"
+
+
+@command("unlock", "release this session's cluster admin lease")
+def unlock_cmd(env: ShellEnv, args) -> str:
+    if not env.admin_token:
+        return "not holding the admin lease"
+    ok = env.master.unlock("admin", env.admin_token)
+    env.admin_token = ""
+    return "unlocked" if ok else "lease already expired"
+
+
+@command("lock.status", "show live cluster leases")
+def lock_status_cmd(env: ShellEnv, args) -> str:
+    rows = env.master.lock_status()
+    if not rows:
+        return "no live leases"
+    return "\n".join(
+        f"{name:24s} {owner:24s} {remaining:6.1f}s left"
+        for name, owner, remaining in rows
+    )

@@ -1,0 +1,60 @@
+"""Watchdogged accelerator probing.
+
+jax backend init happens in C and NEVER times out: with a dead TPU
+relay as the default platform, the first `jax.devices()` call blocks the
+process forever. Every "is there a TPU?" decision in the framework must
+therefore go through this subprocess probe, which bounds the damage to
+a timeout and caches the verdict for the process lifetime.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+
+_lock = threading.Lock()
+_cached: int | None = None
+
+
+def probe_accelerators(timeout: float | None = None, refresh: bool = False) -> int:
+    """Number of non-CPU jax devices reachable right now (0 on hang or
+    error). Cached after the first call."""
+    global _cached
+    with _lock:
+        if _cached is not None and not refresh:
+            return _cached
+        if timeout is None:
+            try:
+                timeout = float(
+                    os.environ.get("SEAWEED_DEVICE_PROBE_TIMEOUT", "30")
+                )
+            except ValueError:
+                timeout = 30.0
+        code = (
+            "import jax;"
+            "print(len([d for d in jax.devices() if d.platform != 'cpu']))"
+        )
+        count = 0
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True,
+                text=True,
+                timeout=timeout,
+            )
+            for line in reversed(out.stdout.splitlines()):
+                try:
+                    count = int(line.strip())
+                    break
+                except ValueError:
+                    continue
+        except (subprocess.TimeoutExpired, OSError):
+            count = 0
+        _cached = count
+        return count
+
+
+def accelerator_available(timeout: float | None = None) -> bool:
+    return probe_accelerators(timeout) > 0

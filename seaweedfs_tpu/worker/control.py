@@ -41,6 +41,7 @@ class _Task:
     progress: float = 0.0
     error: str = ""
     created: float = field(default_factory=time.time)
+    attempts: int = 0
 
 
 KNOWN_KINDS = ("ec_encode", "vacuum")
@@ -233,8 +234,21 @@ class WorkerControl:
             if u.state == "running":
                 t.state = "running"
             elif u.state in ("done", "failed"):
-                t.state = u.state
-                t.error = u.error
+                if (
+                    u.state == "failed"
+                    and "cluster lock" in u.error
+                    and t.attempts < 5
+                ):
+                    # transient contention (a shell holds the volume
+                    # lease): requeue instead of terminal failure
+                    t.attempts += 1
+                    t.state = "pending"
+                    t.error = u.error
+                    t.worker_id = ""
+                    self._pending.append(t.task_id)
+                else:
+                    t.state = u.state
+                    t.error = u.error
                 worker.active = max(worker.active - 1, 0)
                 self._lock.notify_all()
 

@@ -96,7 +96,15 @@ class Worker:
 
     def _execute(self, assign: wk.TaskAssign) -> None:
         self._report(assign.task_id, "running", 0.0)
+        lock_name = f"volume/{assign.volume_id}"
+        token = ""
         try:
+            # per-volume cluster lease: a shell ec.encode on the same
+            # volume (which takes the same lease) cannot interleave with
+            # this task's destructive steps
+            token = self._mc.lock(
+                lock_name, self.worker_id, ttl=3600.0, wait=5.0
+            )
             if assign.kind == "ec_encode":
                 self._task_ec_encode(assign)
             elif assign.kind == "vacuum":
@@ -107,6 +115,9 @@ class Worker:
             self.completed.append(assign.task_id)
         except Exception as e:
             self._report(assign.task_id, "failed", 0.0, error=str(e))
+        finally:
+            if token:
+                self._mc.unlock(lock_name, token)
 
     def _holder_stubs(self, vid: int):
         locs = self._mc.lookup(vid, refresh=True)
