@@ -239,6 +239,7 @@ class Filer:
         mode: int = 0o644,
         collection: str | None = None,
         inline: bool = True,
+        extended: dict | None = None,
     ) -> Entry:
         """inline=False forces chunked storage even for tiny payloads —
         chunk-splicing consumers (S3 multipart parts) require chunks."""
@@ -251,6 +252,8 @@ class Filer:
             raise FilerError(f"{full_path}: type conflict with existing entry")
         if inline and len(data) <= INLINE_LIMIT:
             entry = new_entry(full_path, mode=mode, mime=mime)
+            if extended:
+                entry.extended.update(extended)
             entry.content = data
             entry.attr.file_size = len(data)
             entry.attr.md5 = hashlib.md5(data).digest()
@@ -280,6 +283,8 @@ class Filer:
                 )
             )
         entry = new_entry(full_path, mode=mode, mime=mime)
+        if extended:
+            entry.extended.update(extended)
         entry.chunks = chunks
         entry.attr.file_size = len(data)
         entry.attr.md5 = hashlib.md5(data).digest()

@@ -2,8 +2,9 @@
 
 Reference: weed/s3api/auth_signature_v4.go — header-based AUTH
 (Authorization: AWS4-HMAC-SHA256 ...) and presigned-URL query auth.
-Chunked-upload (STREAMING-AWS4-HMAC-SHA256-PAYLOAD) joins later; the
-UNSIGNED-PAYLOAD and signed-payload forms are accepted.
+Streaming chunked uploads (STREAMING-AWS4-HMAC-SHA256-PAYLOAD, per
+weed/s3api/chunked_reader_v4.go) are verified chunk-by-chunk using the
+SigningContext returned by verify_v4_ex.
 """
 
 from __future__ import annotations
@@ -83,6 +84,17 @@ def canonical_uri(path: str) -> str:
     return urllib.parse.quote(urllib.parse.unquote(path), safe="/-_.~")
 
 
+@dataclass
+class SigningContext:
+    """Everything needed to verify a chunk-signature chain (reference
+    chunked_reader_v4.go: seed signature + derived signing key)."""
+
+    signing_key: bytes
+    amz_date: str
+    scope: str  # date/region/service/aws4_request
+    seed_signature: str
+
+
 def verify_v4(
     store: IdentityStore,
     method: str,
@@ -91,13 +103,25 @@ def verify_v4(
     headers,
     payload_hash: str,
 ) -> Identity:
-    """Validate the Authorization header; returns the caller identity."""
+    return verify_v4_ex(store, method, path, query, headers, payload_hash)[0]
+
+
+def verify_v4_ex(
+    store: IdentityStore,
+    method: str,
+    path: str,
+    query: str,
+    headers,
+    payload_hash: str,
+) -> tuple[Identity, SigningContext | None]:
+    """Validate the Authorization header; returns the caller identity
+    plus the signing context (None for presigned-URL auth)."""
     auth = headers.get("Authorization", "")
     if not auth:
         # presigned query auth
         q = dict(urllib.parse.parse_qsl(query, keep_blank_values=True))
         if "X-Amz-Signature" in q:
-            return _verify_presigned(store, method, path, query, headers, q)
+            return _verify_presigned(store, method, path, query, headers, q), None
         raise S3AuthError("AccessDenied", "no credentials")
     if not auth.startswith("AWS4-HMAC-SHA256 "):
         raise S3AuthError("AccessDenied", "unsupported auth scheme")
@@ -148,14 +172,52 @@ def verify_v4(
             _sha256(creq.encode()),
         ]
     )
-    want = hmac.new(
-        signing_key(ident.secret_key, date, region, service),
-        sts.encode(),
-        hashlib.sha256,
-    ).hexdigest()
+    skey = signing_key(ident.secret_key, date, region, service)
+    want = hmac.new(skey, sts.encode(), hashlib.sha256).hexdigest()
     if not hmac.compare_digest(want, signature):
         raise S3AuthError("SignatureDoesNotMatch", "signature mismatch")
-    return ident
+    ctx = SigningContext(
+        signing_key=skey,
+        amz_date=amz_date,
+        scope=f"{date}/{region}/{service}/aws4_request",
+        seed_signature=signature,
+    )
+    return ident, ctx
+
+
+def verify_chunk_signature(
+    ctx: SigningContext, prev_signature: str, chunk: bytes
+) -> str:
+    """Expected signature of one aws-chunked frame (reference
+    chunked_reader_v4.go getChunkSignature)."""
+    sts = "\n".join(
+        [
+            "AWS4-HMAC-SHA256-PAYLOAD",
+            ctx.amz_date,
+            ctx.scope,
+            prev_signature,
+            _sha256(b""),
+            _sha256(chunk),
+        ]
+    )
+    return hmac.new(ctx.signing_key, sts.encode(), hashlib.sha256).hexdigest()
+
+
+def verify_trailer_signature(
+    ctx: SigningContext, prev_signature: str, trailer: bytes
+) -> str:
+    """Expected x-amz-trailer-signature over the canonical trailer
+    block (STREAMING-AWS4-HMAC-SHA256-PAYLOAD-TRAILER)."""
+    sts = "\n".join(
+        [
+            "AWS4-HMAC-SHA256-TRAILER",
+            ctx.amz_date,
+            ctx.scope,
+            prev_signature,
+            _sha256(trailer),
+        ]
+    )
+    return hmac.new(ctx.signing_key, sts.encode(), hashlib.sha256).hexdigest()
 
 
 def _verify_presigned(store, method, path, query, headers, q) -> Identity:

@@ -23,7 +23,20 @@ from ..filer.entry import new_entry, normalize_path
 from ..filer.filer import Filer, FilerError
 from ..filer.filer_store import NotFound
 from ..pb import filer_pb2 as fpb
-from .auth import Identity, IdentityStore, S3AuthError, verify_v4
+from .auth import Identity, IdentityStore, S3AuthError, verify_v4_ex
+from .chunked import decode_aws_chunked
+from . import versioning as vtag
+from .versioning import (
+    LockViolation,
+    archive_current,
+    check_deletable,
+    entry_vid,
+    is_delete_marker,
+    iter_versions,
+    new_version_id,
+    promote_latest,
+    versions_dir,
+)
 
 BUCKETS_ROOT = "/buckets"
 UPLOADS_DIR = ".uploads"
@@ -65,6 +78,7 @@ class S3Server:
         port: int = 8333,
         identities: IdentityStore | None = None,
         region: str = "us-east-1",
+        lifecycle_interval: float = 3600.0,
     ):
         self.filer = filer
         self.ip = ip
@@ -73,6 +87,12 @@ class S3Server:
         self.identities = identities or IdentityStore()
         self._http = ThreadingHTTPServer((ip, port), self._handler_class())
         self._thread = threading.Thread(target=self._http.serve_forever, daemon=True)
+        from .lifecycle import LifecycleScanner
+
+        self.lifecycle = LifecycleScanner(filer)
+        self._lc_interval = lifecycle_interval
+        self._lc_stop = threading.Event()
+        self._lc_thread = threading.Thread(target=self._lc_loop, daemon=True)
         try:
             self.filer.create_entry(
                 new_entry(BUCKETS_ROOT, is_directory=True, mode=0o755)
@@ -82,10 +102,20 @@ class S3Server:
 
     def start(self) -> None:
         self._thread.start()
+        if self._lc_interval > 0:
+            self._lc_thread.start()
 
     def stop(self) -> None:
+        self._lc_stop.set()
         self._http.shutdown()
         self._http.server_close()
+
+    def _lc_loop(self) -> None:
+        while not self._lc_stop.wait(self._lc_interval):
+            try:
+                self.lifecycle.run_once()
+            except Exception:
+                pass
 
     # ------------------------------------------------------------ handler
 
@@ -128,7 +158,7 @@ class S3Server:
                 phash = self.headers.get(
                     "x-amz-content-sha256", "UNSIGNED-PAYLOAD"
                 )
-                ident = verify_v4(
+                ident, self._sig_ctx = verify_v4_ex(
                     srv.identities,
                     self.command,
                     u.path,
@@ -169,13 +199,20 @@ class S3Server:
                 n = int(self.headers.get("Content-Length", "0") or "0")
                 body = self.rfile.read(n)
                 self._body_read = True
-                # aws-chunked (streaming sigv4) transfer decoding
-                if "aws-chunked" in (
+                # aws-chunked (streaming sigv4) transfer decoding; the
+                # signed form verifies the chunk-signature chain seeded
+                # by the Authorization signature (chunked_reader_v4.go)
+                phash = self.headers.get("x-amz-content-sha256", "")
+                if phash.startswith("STREAMING-AWS4-HMAC-SHA256-PAYLOAD"):
+                    # verify the chunk chain only when header auth
+                    # produced a signing context; open-mode and
+                    # presigned requests have no seed to chain from
+                    ctx = getattr(self, "_sig_ctx", None)
+                    body = decode_aws_chunked(body, ctx, signed=ctx is not None)
+                elif phash.startswith("STREAMING-") or "aws-chunked" in (
                     self.headers.get("Content-Encoding", "")
-                ) or self.headers.get("x-amz-content-sha256", "").startswith(
-                    "STREAMING-"
                 ):
-                    body = _decode_aws_chunked(body)
+                    body = decode_aws_chunked(body)
                 self._body_cache = body
                 return body
 
@@ -185,6 +222,7 @@ class S3Server:
                 self._body_read = False
                 self._body_cache = b""
                 self._cors = {}
+                self._sig_ctx = None
                 try:
                     bucket, key, q = self._bucket_key()
                     m = self.command
@@ -213,6 +251,10 @@ class S3Server:
                     if key == "":
                         return self._bucket_op(bucket, q)
                     return self._object_op(bucket, key, q)
+                except S3AuthError as e:
+                    # a handler reading a signed streaming body can hit
+                    # a chunk-signature failure after dispatch
+                    return self._error(403, e.code, str(e))
                 except NotFound:
                     return self._error(404, "NoSuchKey", "not found")
                 except FilerError as e:
@@ -349,13 +391,38 @@ class S3Server:
                     srv.filer.store.kv_delete(f"cors/{bucket}".encode())
                     srv.filer.store.kv_delete(f"cors-rules/{bucket}".encode())
                     return self._respond(204)
-                if m == "PUT":
-                    if "versioning" in q:
-                        # advertised off; enabling it is unimplemented —
-                        # never misroute into bucket creation
+                if m == "PUT" and "versioning" in q:
+                    if not srv.filer.exists(path):
+                        return self._error(404, "NoSuchBucket", bucket)
+                    doc = ET.fromstring(self._read_body())
+                    ns = _xml_ns(doc)
+                    status = doc.findtext(f"{ns}Status") or ""
+                    if status not in ("Enabled", "Suspended"):
                         return self._error(
-                            501, "NotImplemented", "bucket versioning"
+                            400, "MalformedXML", f"bad Status {status!r}"
                         )
+                    if status == "Suspended" and srv.lock_conf(bucket):
+                        # AWS: object-lock buckets cannot suspend versioning
+                        return self._error(
+                            409,
+                            "InvalidBucketState",
+                            "object lock requires versioning",
+                        )
+                    srv.filer.store.kv_put(
+                        f"versioning/{bucket}".encode(), status.encode()
+                    )
+                    return self._respond(200)
+                if m == "PUT" and "object-lock" in q:
+                    return self._put_object_lock_conf(bucket, path)
+                if m == "PUT" and "lifecycle" in q:
+                    return self._put_lifecycle(bucket, path)
+                if m == "DELETE" and "lifecycle" in q:
+                    srv.filer.store.kv_delete(f"lifecycle/{bucket}".encode())
+                    srv.filer.store.kv_delete(
+                        f"lifecycle-rules/{bucket}".encode()
+                    )
+                    return self._respond(204)
+                if m == "PUT":
                     # bucket names double as volume collections: enforce
                     # S3 naming up front so object uploads can't fail on
                     # the master's collection validation later
@@ -368,6 +435,20 @@ class S3Server:
                     srv.filer.create_entry(
                         new_entry(path, is_directory=True, mode=0o755)
                     )
+                    if (
+                        self.headers.get(
+                            "x-amz-bucket-object-lock-enabled", ""
+                        ).lower()
+                        == "true"
+                    ):
+                        # lock implies versioning (AWS invariant)
+                        srv.filer.store.kv_put(
+                            f"object-lock/{bucket}".encode(),
+                            json.dumps({"Enabled": True}).encode(),
+                        )
+                        srv.filer.store.kv_put(
+                            f"versioning/{bucket}".encode(), b"Enabled"
+                        )
                     return self._respond(200, extra={"Location": "/" + bucket})
                 if m == "HEAD":
                     if not srv.filer.exists(path):
@@ -408,9 +489,26 @@ class S3Server:
                             )
                         return self._respond(200, raw)
                     if "versioning" in q:
-                        # versioning is not implemented; report it off
                         root = ET.Element("VersioningConfiguration", xmlns=XMLNS)
+                        state = srv.bucket_versioning(bucket)
+                        if state:
+                            _el(root, "Status", state)
                         return self._respond(200, _xml(root))
+                    if "object-lock" in q:
+                        return self._get_object_lock_conf(bucket)
+                    if "lifecycle" in q:
+                        raw = srv.filer.store.kv_get(
+                            f"lifecycle/{bucket}".encode()
+                        )
+                        if raw is None:
+                            return self._error(
+                                404,
+                                "NoSuchLifecycleConfiguration",
+                                bucket,
+                            )
+                        return self._respond(200, raw)
+                    if "versions" in q:
+                        return self._list_object_versions(bucket, q)
                     if "uploads" in q:
                         return self._list_uploads(bucket)
                     return self._list_objects(bucket, q)
@@ -470,20 +568,194 @@ class S3Server:
                 ns = _xml_ns(doc)
                 quiet = (doc.findtext(f"{ns}Quiet") or "").lower() == "true"
                 root = ET.Element("DeleteResult", xmlns=XMLNS)
+                state = srv.bucket_versioning(bucket)
+                bypass = (
+                    self.headers.get(
+                        "x-amz-bypass-governance-retention", ""
+                    ).lower()
+                    == "true"
+                )
                 for obj in doc.findall(f"{ns}Object"):
                     key = obj.findtext(f"{ns}Key") or ""
+                    vid_param = obj.findtext(f"{ns}VersionId") or ""
+                    path = normalize_path(f"{BUCKETS_ROOT}/{bucket}/{key}")
                     try:
-                        srv.filer.delete_entry(
-                            f"{BUCKETS_ROOT}/{bucket}/{key}", recursive=True
-                        )
+                        marker_vid = ""
+                        if vid_param:
+                            try:
+                                cur = srv.filer.find_entry(path)
+                            except NotFound:
+                                cur = None
+                            if (
+                                cur is not None
+                                and not cur.is_directory
+                                and entry_vid(cur) == vid_param
+                            ):
+                                check_deletable(cur, bypass)
+                                srv.filer.delete_entry(path, gc_chunks=True)
+                                promote_latest(
+                                    srv.filer, BUCKETS_ROOT, bucket, key
+                                )
+                            else:
+                                vpath = f"{versions_dir(BUCKETS_ROOT, bucket, key)}/{vid_param}"
+                                try:
+                                    ve = srv.filer.find_entry(vpath)
+                                    check_deletable(ve, bypass)
+                                    srv.filer.delete_entry(
+                                        vpath, gc_chunks=True
+                                    )
+                                except NotFound:
+                                    pass
+                        elif state:
+                            archive_current(
+                                srv.filer, BUCKETS_ROOT, bucket, key
+                            )
+                            marker_vid = (
+                                new_version_id()
+                                if state == "Enabled"
+                                else vtag.NULL_VID
+                            )
+                            marker = new_entry(path)
+                            marker.extended[vtag.MARKER_KEY] = b"1"
+                            marker.extended[vtag.VID_KEY] = marker_vid.encode()
+                            srv.filer.create_entry(marker)
+                        else:
+                            srv.filer.delete_entry(path, recursive=True)
                         if not quiet:
                             d = _el(root, "Deleted")
                             _el(d, "Key", key)
+                            if vid_param:
+                                _el(d, "VersionId", vid_param)
+                            if marker_vid:
+                                _el(d, "DeleteMarker", "true")
+                                _el(d, "DeleteMarkerVersionId", marker_vid)
+                    except LockViolation as e:
+                        er = _el(root, "Error")
+                        _el(er, "Key", key)
+                        _el(er, "Code", "AccessDenied")
+                        _el(er, "Message", str(e))
                     except FilerError as e:
                         er = _el(root, "Error")
                         _el(er, "Key", key)
                         _el(er, "Code", "InternalError")
                         _el(er, "Message", str(e))
+                self._respond(200, _xml(root))
+
+            # ---- object lock / lifecycle / versions (bucket level) ----
+
+            def _put_object_lock_conf(self, bucket: str, path: str):
+                if not srv.filer.exists(path):
+                    return self._error(404, "NoSuchBucket", bucket)
+                doc = ET.fromstring(self._read_body())
+                ns = _xml_ns(doc)
+                if (doc.findtext(f"{ns}ObjectLockEnabled") or "") != "Enabled":
+                    return self._error(
+                        400, "MalformedXML", "ObjectLockEnabled must be Enabled"
+                    )
+                conf: dict = {"Enabled": True}
+                dr = doc.find(f"{ns}Rule/{ns}DefaultRetention")
+                if dr is not None:
+                    conf["DefaultRetention"] = {
+                        "Mode": dr.findtext(f"{ns}Mode") or "GOVERNANCE",
+                        "Days": int(dr.findtext(f"{ns}Days") or "0"),
+                        "Years": int(dr.findtext(f"{ns}Years") or "0"),
+                    }
+                srv.filer.store.kv_put(
+                    f"object-lock/{bucket}".encode(), json.dumps(conf).encode()
+                )
+                # lock requires versioning on
+                srv.filer.store.kv_put(
+                    f"versioning/{bucket}".encode(), b"Enabled"
+                )
+                return self._respond(200)
+
+            def _get_object_lock_conf(self, bucket: str):
+                conf = srv.lock_conf(bucket)
+                if conf is None:
+                    return self._error(
+                        404,
+                        "ObjectLockConfigurationNotFoundError",
+                        bucket,
+                    )
+                root = ET.Element("ObjectLockConfiguration", xmlns=XMLNS)
+                _el(root, "ObjectLockEnabled", "Enabled")
+                dr = conf.get("DefaultRetention")
+                if dr:
+                    rule = _el(root, "Rule")
+                    drel = _el(rule, "DefaultRetention")
+                    _el(drel, "Mode", dr.get("Mode", "GOVERNANCE"))
+                    if dr.get("Days"):
+                        _el(drel, "Days", dr["Days"])
+                    if dr.get("Years"):
+                        _el(drel, "Years", dr["Years"])
+                return self._respond(200, _xml(root))
+
+            def _put_lifecycle(self, bucket: str, path: str):
+                from .lifecycle import parse_lifecycle_xml
+
+                if not srv.filer.exists(path):
+                    return self._error(404, "NoSuchBucket", bucket)
+                body = self._read_body()
+                try:
+                    rules = parse_lifecycle_xml(body)
+                except ValueError as e:
+                    return self._error(400, "MalformedXML", str(e))
+                if not rules:
+                    return self._error(400, "MalformedXML", "no Rule")
+                srv.filer.store.kv_put(f"lifecycle/{bucket}".encode(), body)
+                srv.filer.store.kv_put(
+                    f"lifecycle-rules/{bucket}".encode(),
+                    json.dumps(rules).encode(),
+                )
+                return self._respond(200)
+
+            def _list_object_versions(self, bucket: str, q: dict):
+                prefix = q.get("prefix", "")
+                max_keys = min(int(q.get("max-keys", "1000") or "1000"), 1000)
+                contents, _, key_truncated, _ = srv._walk_keys(
+                    bucket, prefix, "", q.get("key-marker", ""), max_keys,
+                    include_markers=True,
+                )
+                root = ET.Element("ListVersionsResult", xmlns=XMLNS)
+                _el(root, "Name", bucket)
+                _el(root, "Prefix", prefix)
+                _el(root, "MaxKeys", max_keys)
+
+                elements: list = []
+
+                def emit(key, entry, latest: bool):
+                    tag = (
+                        "DeleteMarker" if is_delete_marker(entry) else "Version"
+                    )
+                    el = ET.Element(tag)
+                    _el(el, "Key", key)
+                    _el(el, "VersionId", entry_vid(entry))
+                    _el(el, "IsLatest", "true" if latest else "false")
+                    _el(el, "LastModified", _iso(entry.attr.mtime))
+                    if tag == "Version":
+                        _el(el, "ETag", f'"{_entry_etag(entry)}"')
+                        _el(el, "Size", entry.file_size)
+                        _el(el, "StorageClass", "STANDARD")
+                    elements.append(el)
+
+                # resume granularity is the key: emit whole keys until
+                # the version budget is spent, then signal truncation
+                truncated = key_truncated
+                next_marker = ""
+                for key, entry in contents:
+                    if len(elements) >= max_keys:
+                        truncated = True
+                        break
+                    emit(key, entry, True)
+                    for v in iter_versions(
+                        srv.filer, BUCKETS_ROOT, bucket, key
+                    ):
+                        emit(key, v, False)
+                    next_marker = key
+                _el(root, "IsTruncated", "true" if truncated else "false")
+                if truncated and next_marker:
+                    _el(root, "NextKeyMarker", next_marker)
+                root.extend(elements)
                 self._respond(200, _xml(root))
 
             # ---- object ----
@@ -507,25 +779,35 @@ class S3Server:
 
                 if "tagging" in q:
                     return self._object_tagging(bucket, key, path)
+                if "retention" in q:
+                    return self._object_retention(bucket, key, path, q)
+                if "legal-hold" in q:
+                    return self._object_legal_hold(bucket, key, path, q)
 
                 if m == "PUT":
                     src = self.headers.get("x-amz-copy-source", "")
                     if src:
                         return self._copy_object(bucket, key, src)
                     data = self._read_body()
-                    entry = srv.filer.write_file(
-                        path,
+                    ext = self._lock_headers_extended(bucket)
+                    entry, vid = srv.put_object(
+                        bucket,
+                        key,
                         data,
                         mime=self.headers.get("Content-Type", "")
                         or "application/octet-stream",
-                        collection=bucket,
+                        extra_extended=ext,
                     )
                     etag = entry.attr.md5.hex()
-                    return self._respond(200, extra={"ETag": f'"{etag}"'})
+                    extra = {"ETag": f'"{etag}"'}
+                    if vid:
+                        extra["x-amz-version-id"] = vid
+                    return self._respond(200, extra=extra)
                 if m in ("GET", "HEAD"):
-                    entry = srv.filer.find_entry(path)
-                    if entry.is_directory:
-                        return self._error(404, "NoSuchKey", key)
+                    vid_param = q.get("versionId", "")
+                    entry = self._resolve_version(bucket, key, path, vid_param)
+                    if entry is None:
+                        return  # _resolve_version responded
                     total = entry.file_size
                     headers = {
                         **self._cors_response_headers(bucket),
@@ -536,6 +818,14 @@ class S3Server:
                         ),
                         "Accept-Ranges": "bytes",
                     }
+                    if srv.bucket_versioning(bucket):
+                        headers["x-amz-version-id"] = entry_vid(entry)
+                    mode, until = vtag.get_retention(entry)
+                    if mode:
+                        headers["x-amz-object-lock-mode"] = mode
+                        headers["x-amz-object-lock-retain-until-date"] = (
+                            until.isoformat()
+                        )
                     ctype = entry.attr.mime or "application/octet-stream"
                     if m == "HEAD":
                         self.send_response(200)
@@ -566,8 +856,258 @@ class S3Server:
                     data = srv.filer.read_entry(entry, offset, size)
                     return self._respond(status, data, ctype, headers)
                 if m == "DELETE":
-                    srv.filer.delete_entry(path, recursive=False, gc_chunks=True)
-                    return self._respond(204)
+                    return self._delete_object(bucket, key, path, q)
+                return self._error(405, "MethodNotAllowed", m)
+
+            def _lock_headers_extended(self, bucket: str) -> dict:
+                """x-amz-object-lock-* request headers → extended attrs.
+
+                AWS rejects lock headers on buckets without an object
+                lock configuration (otherwise a bogus COMPLIANCE lock
+                could be stored with no API path to ever clear it)."""
+                mode = self.headers.get("x-amz-object-lock-mode", "")
+                until = self.headers.get(
+                    "x-amz-object-lock-retain-until-date", ""
+                )
+                hold = self.headers.get("x-amz-object-lock-legal-hold", "")
+                if not (mode or until or hold):
+                    return {}
+                if srv.lock_conf(bucket) is None:
+                    raise S3AuthError(
+                        "InvalidRequest",
+                        "bucket has no object lock configuration",
+                    )
+                ext: dict = {}
+                if mode or until:
+                    if mode not in ("GOVERNANCE", "COMPLIANCE") or not until:
+                        raise S3AuthError(
+                            "InvalidRequest", "malformed object-lock headers"
+                        )
+                    from datetime import datetime as _dt
+
+                    try:
+                        _dt.fromisoformat(until.replace("Z", "+00:00"))
+                    except ValueError:
+                        raise S3AuthError(
+                            "InvalidRequest", "bad retain-until date"
+                        ) from None
+                    ext[vtag.RETENTION_KEY] = json.dumps(
+                        {"Mode": mode, "RetainUntilDate": until}
+                    ).encode()
+                if hold:
+                    if hold not in ("ON", "OFF"):
+                        raise S3AuthError(
+                            "InvalidRequest", "bad legal hold status"
+                        )
+                    ext[vtag.LEGAL_HOLD_KEY] = hold.encode()
+                return ext
+
+            def _resolve_version(
+                self, bucket: str, key: str, path: str, vid_param: str
+            ):
+                """Entry for GET/HEAD honoring ?versionId; responds with
+                the right error itself and returns None on failure."""
+                if not vid_param:
+                    entry = srv.filer.find_entry(path)
+                    if entry.is_directory:
+                        self._error(404, "NoSuchKey", key)
+                        return None
+                    if is_delete_marker(entry):
+                        self._respond_marker_error(404, "NoSuchKey", key, entry)
+                        return None
+                    return entry
+                try:
+                    cur = srv.filer.find_entry(path)
+                    if not cur.is_directory and entry_vid(cur) == vid_param:
+                        entry = cur
+                    else:
+                        raise NotFound(key)
+                except NotFound:
+                    try:
+                        entry = srv.filer.find_entry(
+                            f"{versions_dir(BUCKETS_ROOT, bucket, key)}/{vid_param}"
+                        )
+                    except NotFound:
+                        self._error(404, "NoSuchVersion", vid_param)
+                        return None
+                if is_delete_marker(entry):
+                    # AWS: GET on a delete-marker version is 405
+                    self._respond_marker_error(
+                        405, "MethodNotAllowed", key, entry
+                    )
+                    return None
+                return entry
+
+            def _respond_marker_error(self, code, s3code, key, entry):
+                root = ET.Element("Error")
+                _el(root, "Code", s3code)
+                _el(root, "Message", "delete marker")
+                _el(root, "Resource", key)
+                self._respond(
+                    code,
+                    _xml(root),
+                    extra={
+                        "x-amz-delete-marker": "true",
+                        "x-amz-version-id": entry_vid(entry),
+                    },
+                )
+
+            def _delete_object(self, bucket: str, key: str, path: str, q: dict):
+                state = srv.bucket_versioning(bucket)
+                vid_param = q.get("versionId", "")
+                bypass = (
+                    self.headers.get(
+                        "x-amz-bypass-governance-retention", ""
+                    ).lower()
+                    == "true"
+                )
+                if vid_param:
+                    # permanent deletion of one version — lock-checked
+                    try:
+                        cur = srv.filer.find_entry(path)
+                    except NotFound:
+                        cur = None
+                    try:
+                        if (
+                            cur is not None
+                            and not cur.is_directory
+                            and entry_vid(cur) == vid_param
+                        ):
+                            check_deletable(cur, bypass)
+                            srv.filer.delete_entry(path, gc_chunks=True)
+                            promote_latest(srv.filer, BUCKETS_ROOT, bucket, key)
+                        else:
+                            vpath = f"{versions_dir(BUCKETS_ROOT, bucket, key)}/{vid_param}"
+                            ve = srv.filer.find_entry(vpath)
+                            check_deletable(ve, bypass)
+                            srv.filer.delete_entry(vpath, gc_chunks=True)
+                    except LockViolation as e:
+                        return self._error(403, "AccessDenied", str(e))
+                    except NotFound:
+                        pass  # deleting a missing version succeeds (AWS)
+                    return self._respond(
+                        204, extra={"x-amz-version-id": vid_param}
+                    )
+                if state:
+                    # versioned simple DELETE: add a delete marker
+                    archive_current(srv.filer, BUCKETS_ROOT, bucket, key)
+                    vid = (
+                        new_version_id()
+                        if state == "Enabled"
+                        else vtag.NULL_VID
+                    )
+                    marker = new_entry(path)
+                    marker.extended[vtag.MARKER_KEY] = b"1"
+                    marker.extended[vtag.VID_KEY] = vid.encode()
+                    srv.filer.create_entry(marker)
+                    return self._respond(
+                        204,
+                        extra={
+                            "x-amz-delete-marker": "true",
+                            "x-amz-version-id": vid,
+                        },
+                    )
+                srv.filer.delete_entry(path, recursive=False, gc_chunks=True)
+                return self._respond(204)
+
+            def _object_retention(self, bucket, key, path, q: dict):
+                target = self._resolve_version(
+                    bucket, key, path, q.get("versionId", "")
+                )
+                if target is None:
+                    return
+                m = self.command
+                if m == "GET":
+                    mode, until = vtag.get_retention(target)
+                    if not mode:
+                        return self._error(
+                            404,
+                            "NoSuchObjectLockConfiguration",
+                            key,
+                        )
+                    root = ET.Element("Retention", xmlns=XMLNS)
+                    _el(root, "Mode", mode)
+                    _el(root, "RetainUntilDate", until.isoformat())
+                    return self._respond(200, _xml(root))
+                if m == "PUT":
+                    if srv.lock_conf(bucket) is None:
+                        return self._error(
+                            400,
+                            "InvalidRequest",
+                            "bucket has no object lock configuration",
+                        )
+                    doc = ET.fromstring(self._read_body())
+                    ns = _xml_ns(doc)
+                    mode = doc.findtext(f"{ns}Mode") or ""
+                    until_s = doc.findtext(f"{ns}RetainUntilDate") or ""
+                    if mode not in ("GOVERNANCE", "COMPLIANCE") or not until_s:
+                        return self._error(400, "MalformedXML", "retention")
+                    from datetime import datetime as _dt
+
+                    new_until = _dt.fromisoformat(
+                        until_s.replace("Z", "+00:00")
+                    )
+                    old_mode, old_until = vtag.get_retention(target)
+                    bypass = (
+                        self.headers.get(
+                            "x-amz-bypass-governance-retention", ""
+                        ).lower()
+                        == "true"
+                    )
+                    # weakening an active lock needs bypass (GOVERNANCE)
+                    # and is never allowed for COMPLIANCE
+                    if old_mode and old_until and new_until < old_until:
+                        if old_mode == "COMPLIANCE" or not bypass:
+                            return self._error(
+                                403,
+                                "AccessDenied",
+                                "cannot shorten active retention",
+                            )
+                    srv.filer.mutate_entry(
+                        target.full_path,
+                        lambda e: e.extended.__setitem__(
+                            vtag.RETENTION_KEY,
+                            json.dumps(
+                                {
+                                    "Mode": mode,
+                                    "RetainUntilDate": new_until.isoformat(),
+                                }
+                            ).encode(),
+                        ),
+                    )
+                    return self._respond(200)
+                return self._error(405, "MethodNotAllowed", m)
+
+            def _object_legal_hold(self, bucket, key, path, q: dict):
+                target = self._resolve_version(
+                    bucket, key, path, q.get("versionId", "")
+                )
+                if target is None:
+                    return
+                m = self.command
+                if m == "GET":
+                    root = ET.Element("LegalHold", xmlns=XMLNS)
+                    _el(root, "Status", vtag.legal_hold(target))
+                    return self._respond(200, _xml(root))
+                if m == "PUT":
+                    if srv.lock_conf(bucket) is None:
+                        return self._error(
+                            400,
+                            "InvalidRequest",
+                            "bucket has no object lock configuration",
+                        )
+                    doc = ET.fromstring(self._read_body())
+                    ns = _xml_ns(doc)
+                    status = doc.findtext(f"{ns}Status") or ""
+                    if status not in ("ON", "OFF"):
+                        return self._error(400, "MalformedXML", "legal hold")
+                    srv.filer.mutate_entry(
+                        target.full_path,
+                        lambda e: e.extended.__setitem__(
+                            vtag.LEGAL_HOLD_KEY, status.encode()
+                        ),
+                    )
+                    return self._respond(200)
                 return self._error(405, "MethodNotAllowed", m)
 
             def _object_tagging(self, bucket: str, key: str, path: str):
@@ -618,21 +1158,28 @@ class S3Server:
 
             def _copy_object(self, bucket: str, key: str, src: str):
                 src = urllib.parse.unquote(src)
+                src_vid = ""
+                if "?versionId=" in src:
+                    src, _, src_vid = src.partition("?versionId=")
                 if not src.startswith("/"):
                     src = "/" + src
                 src_path = normalize_path(f"{BUCKETS_ROOT}{src}")
-                entry = srv.filer.find_entry(src_path)
+                if src_vid:
+                    sb, _, sk = src.lstrip("/").partition("/")
+                    entry = self._resolve_version(sb, sk, src_path, src_vid)
+                    if entry is None:
+                        return
+                else:
+                    entry = srv.filer.find_entry(src_path)
                 data = srv.filer.read_entry(entry)
-                dst = srv.filer.write_file(
-                    normalize_path(f"{BUCKETS_ROOT}/{bucket}/{key}"),
-                    data,
-                    mime=entry.attr.mime,
-                    collection=bucket,
+                dst, vid = srv.put_object(
+                    bucket, key, data, mime=entry.attr.mime
                 )
                 root = ET.Element("CopyObjectResult", xmlns=XMLNS)
                 _el(root, "ETag", f'"{dst.attr.md5.hex()}"')
                 _el(root, "LastModified", _iso(dst.attr.mtime))
-                self._respond(200, _xml(root))
+                extra = {"x-amz-version-id": vid} if vid else {}
+                self._respond(200, _xml(root), extra=extra)
 
             # ---- multipart ----
 
@@ -641,6 +1188,12 @@ class S3Server:
                 meta_path = srv._upload_dir(bucket, upload_id)
                 e = new_entry(meta_path, is_directory=True, mode=0o755)
                 srv.filer.create_entry(e)
+                # x-amz-object-lock-* headers arrive on the initiate
+                # request; they must stick to the completed object
+                lock_ext = {
+                    k2: v2.decode()
+                    for k2, v2 in self._lock_headers_extended(bucket).items()
+                }
                 srv.filer.store.kv_put(
                     f"upload/{upload_id}".encode(),
                     json.dumps(
@@ -648,6 +1201,7 @@ class S3Server:
                             "bucket": bucket,
                             "key": key,
                             "mime": self.headers.get("Content-Type", ""),
+                            "lock_ext": lock_ext,
                         }
                     ).encode(),
                 )
@@ -734,12 +1288,39 @@ class S3Server:
                 final.attr.file_size = offset
                 etag = hashlib.md5(b"".join(md5s)).hexdigest() + f"-{len(parts)}"
                 final.extended["s3-etag"] = etag.encode()
-                # an overwritten object's chunks must be GC'd (write_file
-                # does this for the simple-PUT path)
-                try:
-                    old = srv.filer.find_entry(final_path)
-                except NotFound:
-                    old = None
+                # bucket default retention applies to multipart objects
+                # too — large SDK uploads must not escape WORM
+                for k2, v2 in vtag.default_retention_extended(
+                    srv.lock_conf(bucket)
+                ).items():
+                    final.extended[k2] = v2
+                for k2, v2 in (meta.get("lock_ext") or {}).items():
+                    final.extended[k2] = v2.encode()
+                # versioning-aware finalize (mirrors srv.put_object)
+                state = srv.bucket_versioning(bucket)
+                vid = ""
+                old = None
+                if state == "Enabled":
+                    vid = new_version_id()
+                    final.extended[vtag.VID_KEY] = vid.encode()
+                    archive_current(srv.filer, BUCKETS_ROOT, bucket, key)
+                elif state == "Suspended":
+                    vid = vtag.NULL_VID
+                    try:
+                        cur = srv.filer.find_entry(final_path)
+                        if not cur.is_directory and entry_vid(cur) != vtag.NULL_VID:
+                            archive_current(srv.filer, BUCKETS_ROOT, bucket, key)
+                        elif not cur.is_directory:
+                            old = cur
+                    except NotFound:
+                        pass
+                else:
+                    # an overwritten object's chunks must be GC'd
+                    # (write_file does this for the simple-PUT path)
+                    try:
+                        old = srv.filer.find_entry(final_path)
+                    except NotFound:
+                        old = None
                 srv.filer.create_entry(final)
                 if old is not None and not old.is_directory:
                     srv.filer.gc_chunks(old.chunks)
@@ -753,7 +1334,11 @@ class S3Server:
                 _el(root, "Bucket", bucket)
                 _el(root, "Key", key)
                 _el(root, "ETag", f'"{etag}"')
-                self._respond(200, _xml(root))
+                self._respond(
+                    200,
+                    _xml(root),
+                    extra={"x-amz-version-id": vid} if vid else None,
+                )
 
             def _abort_multipart(self, bucket: str, key: str, q: dict):
                 upload_id = q["uploadId"]
@@ -807,13 +1392,76 @@ class S3Server:
 
         return Handler
 
+    # -------------------------------------------------------- versioning
+
+    def bucket_versioning(self, bucket: str) -> str:
+        """"" (never enabled) | "Enabled" | "Suspended"."""
+        raw = self.filer.store.kv_get(f"versioning/{bucket}".encode())
+        return raw.decode() if raw else ""
+
+    def lock_conf(self, bucket: str) -> dict | None:
+        raw = self.filer.store.kv_get(f"object-lock/{bucket}".encode())
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw)
+        except ValueError:
+            return None
+
+    def put_object(
+        self,
+        bucket: str,
+        key: str,
+        data: bytes,
+        mime: str = "",
+        extra_extended: dict | None = None,
+    ):
+        """Versioning-aware object write (reference
+        s3api_object_versioning.go putVersionedObject). Returns
+        (entry, version_id-or-None)."""
+        path = normalize_path(f"{BUCKETS_ROOT}/{bucket}/{key}")
+        state = self.bucket_versioning(bucket)
+        ext = dict(extra_extended or {})
+        ext.update(vtag.default_retention_extended(self.lock_conf(bucket)))
+        if state == "Enabled":
+            vid = new_version_id()
+            ext[vtag.VID_KEY] = vid.encode()
+            archive_current(self.filer, BUCKETS_ROOT, bucket, key)
+            entry = self.filer.write_file(
+                path, data, mime=mime, collection=bucket, extended=ext
+            )
+            return entry, vid
+        if state == "Suspended":
+            # the new object becomes the "null" version; an existing
+            # non-null current version is retained, a null one replaced
+            try:
+                cur = self.filer.find_entry(path)
+                if not cur.is_directory and entry_vid(cur) != vtag.NULL_VID:
+                    archive_current(self.filer, BUCKETS_ROOT, bucket, key)
+            except NotFound:
+                pass
+            entry = self.filer.write_file(
+                path, data, mime=mime, collection=bucket, extended=ext
+            )
+            return entry, vtag.NULL_VID
+        entry = self.filer.write_file(
+            path, data, mime=mime, collection=bucket, extended=ext or None
+        )
+        return entry, None
+
     # -------------------------------------------------------------- walk
 
     def _upload_dir(self, bucket: str, upload_id: str) -> str:
         return f"{BUCKETS_ROOT}/{UPLOADS_DIR}/{bucket}/{upload_id}"
 
     def _walk_keys(
-        self, bucket: str, prefix: str, delimiter: str, after: str, max_keys: int
+        self,
+        bucket: str,
+        prefix: str,
+        delimiter: str,
+        after: str,
+        max_keys: int,
+        include_markers: bool = False,
     ):
         """Flat key listing with prefix/delimiter grouping.
 
@@ -836,6 +1484,10 @@ class S3Server:
             nonlocal last_emitted
             for e in self.filer.list_entries(dir_path, limit=100_000):
                 key = key_prefix + e.name
+                if dir_path == bpath and e.name == vtag.VERSIONS_DIR:
+                    continue  # hidden noncurrent-version tree
+                if not include_markers and is_delete_marker(e):
+                    continue
                 if e.is_directory:
                     sub = key + "/"
                     # prune subtrees that cannot contain matching keys
@@ -895,18 +1547,3 @@ def _entry_etag(entry) -> str:
     return entry.attr.md5.hex() if entry.attr.md5 else ""
 
 
-def _decode_aws_chunked(body: bytes) -> bytes:
-    """Strip aws-chunked framing (chunk-size;chunk-signature=...\r\n)."""
-    out = []
-    pos = 0
-    while pos < len(body):
-        nl = body.find(b"\r\n", pos)
-        if nl < 0:
-            break
-        header = body[pos:nl]
-        size = int(header.split(b";")[0], 16)
-        if size == 0:
-            break
-        out.append(body[nl + 2 : nl + 2 + size])
-        pos = nl + 2 + size + 2
-    return b"".join(out)

@@ -101,6 +101,20 @@ def cluster_guard(env: ShellEnv, vids=(), ttl: float = 600.0, wait: float | None
                 env.master.unlock("admin", admin_tok)
 
 
+@contextlib.contextmanager
+def volume_lease(env: ShellEnv, vid: int, ttl: float = 600.0):
+    """Per-volume cluster lease for commands that discover their target
+    volumes at runtime (ec.balance, fix.replication, collection.delete):
+    the admin lease alone does not exclude the worker fleet, which holds
+    only volume/<vid> leases."""
+    name = f"volume/{int(vid)}"
+    tok = env.master.lock(name, env.owner, ttl=ttl, wait=env.lock_wait)
+    try:
+        yield
+    finally:
+        env.master.unlock(name, tok)
+
+
 COMMANDS: dict[str, tuple] = {}
 
 
@@ -596,28 +610,29 @@ def volume_fix_replication(env: ShellEnv, args) -> str:
         src_grpc = f"{src.location.url.split(':')[0]}:{src.location.grpc_port}"
         # freeze writes while the copy streams, restore after — a live
         # append between the .dat and .idx copies would tear the replica
-        src_ch, src_stub = _volume_stub(src.location)
-        with src_ch:
-            src_stub.VolumeMarkReadonly(
-                pb.VolumeCommandRequest(volume_id=vid), timeout=30
-            )
-            try:
-                for n in candidates[: want - len(hs)]:
-                    with grpc.insecure_channel(
-                        f"{n.location.url.split(':')[0]}:{n.location.grpc_port}"
-                    ) as ch:
-                        r = rpc.Stub(ch, rpc.VOLUME_SERVICE).VolumeCopy(
-                            pb.EcShardsCopyRequest(
-                                volume_id=vid, collection=col, source_url=src_grpc
-                            ),
-                            timeout=3600,
-                        )
-                    if not r.error:
-                        fixed.append(f"volume {vid} -> {n.id}")
-            finally:
-                src_stub.VolumeMarkWritable(
+        with volume_lease(env, vid):
+            src_ch, src_stub = _volume_stub(src.location)
+            with src_ch:
+                src_stub.VolumeMarkReadonly(
                     pb.VolumeCommandRequest(volume_id=vid), timeout=30
                 )
+                try:
+                    for n in candidates[: want - len(hs)]:
+                        with grpc.insecure_channel(
+                            f"{n.location.url.split(':')[0]}:{n.location.grpc_port}"
+                        ) as ch:
+                            r = rpc.Stub(ch, rpc.VOLUME_SERVICE).VolumeCopy(
+                                pb.EcShardsCopyRequest(
+                                    volume_id=vid, collection=col, source_url=src_grpc
+                                ),
+                                timeout=3600,
+                            )
+                        if not r.error:
+                            fixed.append(f"volume {vid} -> {n.id}")
+                finally:
+                    src_stub.VolumeMarkWritable(
+                        pb.VolumeCommandRequest(volume_id=vid), timeout=30
+                    )
     return "\n".join(fixed) or "all volumes sufficiently replicated"
 
 
@@ -670,39 +685,40 @@ def ec_balance(env: ShellEnv, args) -> str:
         col = vol_collection.get(vid, "")
         src_n, dst_n = nodes[src_id], nodes[dst_id]
         src_grpc = f"{src_n.location.url.split(':')[0]}:{src_n.location.grpc_port}"
-        with grpc.insecure_channel(
-            f"{dst_n.location.url.split(':')[0]}:{dst_n.location.grpc_port}"
-        ) as ch:
-            stub = rpc.Stub(ch, rpc.VOLUME_SERVICE)
-            stub.VolumeEcShardsCopy(
-                pb.EcShardsCopyRequest(
-                    volume_id=vid,
-                    collection=col,
-                    shard_ids=[sid],
-                    source_url=src_grpc,
-                    copy_ecx=vid not in load[dst_id],
-                    copy_ecj=vid not in load[dst_id],
-                    copy_vif=vid not in load[dst_id],
-                    copy_ecsum=vid not in load[dst_id],
-                ),
-                timeout=3600,
-            )
-            stub.VolumeEcShardsMount(
-                pb.EcShardsMountRequest(volume_id=vid, collection=col),
-                timeout=60,
-            )
-        with grpc.insecure_channel(src_grpc) as ch:
-            stub = rpc.Stub(ch, rpc.VOLUME_SERVICE)
-            stub.VolumeEcShardsUnmount(
-                pb.EcShardsUnmountRequest(volume_id=vid, shard_ids=[sid]),
-                timeout=60,
-            )
-            stub.VolumeEcShardsDelete(
-                pb.EcShardsDeleteRequest(
-                    volume_id=vid, collection=col, shard_ids=[sid]
-                ),
-                timeout=60,
-            )
+        with volume_lease(env, vid):
+            with grpc.insecure_channel(
+                f"{dst_n.location.url.split(':')[0]}:{dst_n.location.grpc_port}"
+            ) as ch:
+                stub = rpc.Stub(ch, rpc.VOLUME_SERVICE)
+                stub.VolumeEcShardsCopy(
+                    pb.EcShardsCopyRequest(
+                        volume_id=vid,
+                        collection=col,
+                        shard_ids=[sid],
+                        source_url=src_grpc,
+                        copy_ecx=vid not in load[dst_id],
+                        copy_ecj=vid not in load[dst_id],
+                        copy_vif=vid not in load[dst_id],
+                        copy_ecsum=vid not in load[dst_id],
+                    ),
+                    timeout=3600,
+                )
+                stub.VolumeEcShardsMount(
+                    pb.EcShardsMountRequest(volume_id=vid, collection=col),
+                    timeout=60,
+                )
+            with grpc.insecure_channel(src_grpc) as ch:
+                stub = rpc.Stub(ch, rpc.VOLUME_SERVICE)
+                stub.VolumeEcShardsUnmount(
+                    pb.EcShardsUnmountRequest(volume_id=vid, shard_ids=[sid]),
+                    timeout=60,
+                )
+                stub.VolumeEcShardsDelete(
+                    pb.EcShardsDeleteRequest(
+                        volume_id=vid, collection=col, shard_ids=[sid]
+                    ),
+                    timeout=60,
+                )
         sids.remove(sid)
         if not sids:
             del load[src_id][vid]
@@ -779,8 +795,28 @@ def collection_delete(env: ShellEnv, args) -> str:
     p = argparse.ArgumentParser(prog="collection.delete")
     p.add_argument("-collection", required=True)
     a = p.parse_args(args)
-    vids = env.master.collection_delete(a.collection)
-    return f"deleted collection {a.collection!r}: volumes {vids}"
+    # lease every volume of the collection first so a worker task
+    # (ec_encode/vacuum) can't be mid-flight on one while it vanishes
+    topo = env.master.topology()
+    vids = sorted(
+        {
+            v.id
+            for n in topo.nodes
+            for v in n.volumes
+            if v.collection == a.collection
+        }
+        | {
+            e.id
+            for n in topo.nodes
+            for e in n.ec_shards
+            if e.collection == a.collection
+        }
+    )
+    with contextlib.ExitStack() as stack:
+        for vid in vids:
+            stack.enter_context(volume_lease(env, vid))
+        deleted = env.master.collection_delete(a.collection)
+    return f"deleted collection {a.collection!r}: volumes {deleted}"
 
 
 # ---------------------------------------------------------------------- fs

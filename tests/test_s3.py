@@ -210,14 +210,17 @@ def test_object_tagging_and_versioning_status(s3):
         f"<Tag><Key>k{i}</Key><Value>v</Value></Tag>" for i in range(11)
     ) + "</TagSet></Tagging>"
     assert requests.put(f"{s3}/tagb/obj?tagging", data=bad).status_code == 400
-    # versioning reports unconfigured; enabling is 501, not misrouted
+    # versioning reports unconfigured until a status is set
     r = requests.get(f"{s3}/tagb?versioning")
     assert r.status_code == 200 and "VersioningConfiguration" in r.text
+    assert "<Status>" not in r.text
     r = requests.put(
         f"{s3}/tagb?versioning",
         data="<VersioningConfiguration><Status>Enabled</Status></VersioningConfiguration>",
     )
-    assert r.status_code == 501
+    assert r.status_code == 200
+    r = requests.get(f"{s3}/tagb?versioning")
+    assert "Enabled" in r.text
 
 
 def test_bucket_cors(s3):

