@@ -59,6 +59,13 @@ class Filer:
         # serializes metadata read-modify-write (tagging, xattr-style
         # updates) against entry replacement
         self._mutate_lock = threading.Lock()
+        # chunk-list size beyond which create_entry manifestizes
+        # (reference filechunk_manifest.go ManifestBatch)
+        self.manifest_threshold = 1000
+        # strictly-increasing event timestamps: subscription resume and
+        # LWW merge both break on equal tsNs (watermarks use strict >)
+        self._ts_lock = threading.Lock()
+        self._last_ts = 0
 
     # ------------------------------------------------------------- meta log
 
@@ -72,34 +79,86 @@ class Filer:
         old: Optional[Entry],
         new: Optional[Entry],
         delete_chunks: bool = False,
+        ts_ns: int = 0,
+        remote: bool = False,
     ) -> None:
         if not self._listeners:
             return
-        ev = fpb.FullEventNotification(directory=directory, ts_ns=time.time_ns())
+        ev = fpb.FullEventNotification(
+            directory=directory, ts_ns=ts_ns or self._next_ts()
+        )
         if old is not None:
             ev.event.old_entry.CopyFrom(old.to_proto())
         if new is not None:
             ev.event.new_entry.CopyFrom(new.to_proto())
         ev.event.delete_chunks = delete_chunks
+        ev.event.is_from_other_cluster = remote
         for fn in list(self._listeners):
             try:
                 fn(ev)
             except Exception:
                 pass
 
+    def _next_ts(self) -> int:
+        with self._ts_lock:
+            self._last_ts = max(self._last_ts + 1, time.time_ns())
+            return self._last_ts
+
+    def _stamp(self, entry: Entry) -> int:
+        """Nanosecond metadata timestamp persisted on the entry: the
+        multi-filer aggregator's last-writer-wins comparisons need finer
+        resolution than attr.mtime's seconds (meta_aggregator.py).
+        Strictly increasing per filer so no two events share a tsNs."""
+        ts = self._next_ts()
+        entry.extended["sw-mts"] = str(ts).encode()
+        return ts
+
+    @staticmethod
+    def meta_ts(entry: Optional[Entry]) -> int:
+        if entry is None:
+            return 0
+        raw = entry.extended.get("sw-mts")
+        if raw:
+            try:
+                return int(raw)
+            except ValueError:
+                pass
+        return entry.attr.mtime * 1_000_000_000
+
     # ----------------------------------------------------------- namespace
 
-    def create_entry(self, entry: Entry, ensure_parents: bool = True) -> None:
+    def create_entry(
+        self,
+        entry: Entry,
+        ensure_parents: bool = True,
+        collection: str | None = None,
+    ) -> None:
         if ensure_parents:
             self._ensure_parents(entry.directory)
+        if len(entry.chunks) > self.manifest_threshold:
+            # huge chunk lists become manifest blobs so every metadata
+            # read doesn't deserialize thousands of chunks
+            from .manifest import maybe_manifestize
+
+            col = self.collection if collection is None else collection
+            entry.chunks = maybe_manifestize(
+                lambda blob: self.ops.upload(
+                    blob, collection=col, replication=self.replication
+                ),
+                entry.chunks,
+                self.manifest_threshold,
+            )
         with self._mutate_lock:
+            # stamp inside the lock: racing writers must insert in the
+            # same order as their LWW timestamps or peers diverge
+            ts = self._stamp(entry)
             old = self._try_find(entry.directory, entry.name)
             if old is not None and old.is_directory != entry.is_directory:
                 raise FilerError(
                     f"{entry.full_path}: type conflict with existing entry"
                 )
             self.store.insert(entry)
-        self._notify(entry.directory, old, entry)
+        self._notify(entry.directory, old, entry, ts_ns=ts)
 
     def mutate_entry(self, full_path: str, fn) -> Entry:
         """Read-modify-write an entry's metadata atomically w.r.t. other
@@ -119,8 +178,9 @@ class Filer:
             old.attr.CopyFrom(entry.attr)
             old.extended = dict(entry.extended)
             fn(entry)
+            ts = self._stamp(entry)
             self.store.update(entry)
-        self._notify(directory, old, entry)
+        self._notify(directory, old, entry, ts_ns=ts)
         return entry
 
     def _ensure_parents(self, directory: str) -> None:
@@ -224,10 +284,60 @@ class Filer:
         )
         moved.attr.CopyFrom(entry.attr)
         moved.extended = entry.extended
+        # two distinct timestamps: a subscriber resuming between the
+        # delete and the create (strict > watermark) must not lose the
+        # create half of the rename
+        ts_del = self._next_ts()
+        ts_cre = self._stamp(moved)
         self.store.insert(moved)
         self.store.delete(old_dir, old_name)
-        self._notify(old_dir, entry, None)
-        self._notify(new_dir, None, moved)
+        self._notify(old_dir, entry, None, ts_ns=ts_del)
+        self._notify(new_dir, None, moved, ts_ns=ts_cre)
+
+    # ----------------------------------------------------------- multi-filer
+
+    def apply_remote_event(self, ev: fpb.FullEventNotification) -> bool:
+        """Apply a peer filer's metadata event to the local store
+        (MetaAggregator entry point; reference meta_aggregator.go).
+
+        Last-writer-wins: an event older than the local entry's
+        nanosecond meta timestamp is dropped, so two filers replaying
+        each other's logs converge on the newest write. Chunk GC is the
+        originating filer's job — a remote delete never touches blobs.
+        Returns True if the event mutated the local store."""
+        directory = ev.directory
+        new_p, old_p = ev.event.new_entry, ev.event.old_entry
+        has_new, has_old = bool(new_p.name), bool(old_p.name)
+        if has_new:
+            self._ensure_parents(directory)
+        with self._mutate_lock:
+            if has_new:
+                entry = Entry.from_proto(directory, new_p)
+                local = self._try_find(directory, entry.name)
+                if local is not None and self.meta_ts(local) >= ev.ts_ns:
+                    return False
+                if local is not None and local.is_directory != entry.is_directory:
+                    return False  # type conflict: keep local
+                self.store.insert(entry)
+                applied_old, applied_new = local, entry
+            elif has_old:
+                local = self._try_find(directory, old_p.name)
+                if local is None or self.meta_ts(local) > ev.ts_ns:
+                    return False
+                if local.is_directory:
+                    # remote recursive deletes arrive child-first; an
+                    # already-emptied dir deletes cleanly, a non-empty
+                    # one means local writes raced — keep it
+                    if list(self.store.list(local.full_path, limit=1)):
+                        return False
+                self.store.delete(directory, old_p.name)
+                applied_old, applied_new = local, None
+            else:
+                return False
+        self._notify(
+            directory, applied_old, applied_new, ts_ns=ev.ts_ns, remote=True
+        )
+        return True
 
     # -------------------------------------------------------------- content
 
@@ -316,8 +426,13 @@ class Filer:
         size = min(size, max(file_size - offset, 0))
         if size == 0:
             return b""
+        chunks = entry.chunks
+        from .manifest import has_manifests, resolve_manifests
+
+        if has_manifests(chunks):
+            chunks = resolve_manifests(self._read_chunk_cached, chunks)
         buf = bytearray(size)
-        for view in read_chunk_views(entry.chunks, offset, size):
+        for view in read_chunk_views(chunks, offset, size):
             chunk_data = self.chunk_cache.get(view.fid)
             if chunk_data is None:
                 chunk_data = self.ops.read(view.fid)
@@ -330,10 +445,33 @@ class Filer:
             buf[lo : lo + len(piece)] = piece
         return bytes(buf)
 
+    def _read_chunk_cached(self, fid: str) -> bytes:
+        data = self.chunk_cache.get(fid)
+        if data is None:
+            data = self.ops.read(fid)
+            if len(data) <= self.chunk_cache.capacity // 8:
+                self.chunk_cache.put(fid, data)
+        return data
+
+    def resolve_chunks(self, entry: Entry):
+        """Entry's chunk list with manifest chunks expanded (callers
+        that stream views themselves: mount, webdav)."""
+        from .manifest import has_manifests, resolve_manifests
+
+        if has_manifests(entry.chunks):
+            return resolve_manifests(self._read_chunk_cached, entry.chunks)
+        return entry.chunks
+
     # ------------------------------------------------------------------ gc
 
     def gc_chunks(self, chunks) -> None:
-        """Enqueue chunk fids for async deletion on the volume servers."""
+        """Enqueue chunk fids for async deletion on the volume servers.
+        Manifest chunks expand to their referenced chunks plus the
+        manifest blob itself."""
+        from .manifest import gc_expand, has_manifests
+
+        if has_manifests(chunks):
+            chunks = gc_expand(self.ops.read, chunks)
         for c in chunks:
             self.chunk_cache.drop(c.fid)  # dead bytes must not pin the LRU
             self._gc_queue.put((c.fid, 0))

@@ -1,0 +1,153 @@
+"""gRPC filer metadata service + streaming metadata subscription.
+
+Reference: weed/pb/filer.proto service SeaweedFiler (Lookup/List/
+Create/Update/Delete/AtomicRename, SubscribeMetadata at
+weed/server/filer_grpc_server_sub_meta.go). The mount layer, peer
+filers (MetaAggregator) and filer.sync all ride this surface; the HTTP
+file API stays the byte data plane.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import grpc
+
+from ..pb import filer_pb2 as fpb
+from ..pb import rpc
+from ..utils.glog import logger
+from .entry import Entry, normalize_path
+from .filer import Filer, FilerError
+from .filer_store import NotFound
+from .notification import json_to_event
+
+log = logger("filer.grpc")
+
+
+class FilerGrpcService:
+    """Servicer for rpc.FILER_SERVICE (hand-rolled table wiring)."""
+
+    def __init__(self, filer: Filer, meta_log=None):
+        self.filer = filer
+        self.meta_log = meta_log
+
+    # ------------------------------------------------------------ metadata
+
+    def LookupDirectoryEntry(self, request, context):
+        try:
+            e = self.filer.store.find(
+                normalize_path(request.directory), request.name
+            )
+        except NotFound:
+            return fpb.LookupEntryResponse(error="not found")
+        return fpb.LookupEntryResponse(entry=e.to_proto())
+
+    def ListEntries(self, request, context):
+        limit = request.limit or 1024
+        for e in self.filer.list_entries(
+            request.directory,
+            start_from=request.start_from,
+            limit=limit,
+            prefix=request.prefix,
+        ):
+            yield fpb.ListEntriesResponse(entry=e.to_proto())
+
+    def CreateEntry(self, request, context):
+        try:
+            entry = Entry.from_proto(
+                normalize_path(request.directory), request.entry
+            )
+            self.filer.create_entry(entry)
+        except FilerError as e:
+            return fpb.FilerOpResponse(error=str(e))
+        return fpb.FilerOpResponse()
+
+    def UpdateEntry(self, request, context):
+        directory = normalize_path(request.directory)
+        try:
+            self.filer.store.find(directory, request.entry.name)
+        except NotFound:
+            return fpb.FilerOpResponse(error="not found")
+        try:
+            entry = Entry.from_proto(directory, request.entry)
+            self.filer.create_entry(entry, ensure_parents=False)
+        except FilerError as e:
+            return fpb.FilerOpResponse(error=str(e))
+        return fpb.FilerOpResponse()
+
+    def DeleteEntry(self, request, context):
+        path = f"{normalize_path(request.directory)}/{request.name}"
+        try:
+            self.filer.delete_entry(
+                path,
+                recursive=request.is_recursive,
+                gc_chunks=request.is_delete_data,
+            )
+        except FilerError as e:
+            return fpb.FilerOpResponse(error=str(e))
+        return fpb.FilerOpResponse()
+
+    def AtomicRenameEntry(self, request, context):
+        try:
+            self.filer.rename(
+                f"{normalize_path(request.old_directory)}/{request.old_name}",
+                f"{normalize_path(request.new_directory)}/{request.new_name}",
+            )
+        except (FilerError, NotFound) as e:
+            return fpb.FilerOpResponse(error=str(e))
+        return fpb.FilerOpResponse()
+
+    def KvGet(self, request, context):
+        v = self.filer.store.kv_get(bytes(request.key))
+        if v is None:
+            return fpb.FilerKvGetResponse(found=False)
+        return fpb.FilerKvGetResponse(value=v, found=True)
+
+    def KvPut(self, request, context):
+        if request.value:
+            self.filer.store.kv_put(bytes(request.key), bytes(request.value))
+        else:
+            self.filer.store.kv_delete(bytes(request.key))
+        return fpb.FilerOpResponse()
+
+    # --------------------------------------------------------- subscription
+
+    def SubscribeMetadata(self, request, context):
+        """Long-lived event stream from the persisted meta log
+        (reference filer_grpc_server_sub_meta.go). Replays history from
+        since_ns, then follows live appends."""
+        if self.meta_log is None:
+            context.abort(
+                grpc.StatusCode.UNIMPLEMENTED, "filer runs without a meta log"
+            )
+        watermark = request.since_ns
+        prefix = request.path_prefix
+        while context.is_active():
+            records = self.meta_log.read_since(watermark, limit=1000)
+            for rec in records:
+                watermark = max(watermark, rec.get("tsNs", 0))
+                if request.local_only and rec.get("remote"):
+                    continue
+                if prefix and not (
+                    rec.get("directory", "").startswith(prefix.rstrip("/"))
+                    or prefix.rstrip("/").startswith(rec.get("directory", ""))
+                ):
+                    continue
+                ev = json_to_event(rec)
+                if ev is None:
+                    continue  # legacy record without full payload
+                yield ev
+            if not records:
+                self.meta_log.wait_for_events(watermark, timeout=1.0)
+
+
+def serve_filer_grpc(
+    filer: Filer, meta_log, ip: str, port: int
+) -> grpc.Server:
+    from concurrent import futures
+
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))
+    rpc.add_service(server, rpc.FILER_SERVICE, FilerGrpcService(filer, meta_log))
+    server.add_insecure_port(f"{ip}:{port}")
+    server.start()
+    return server

@@ -1,0 +1,76 @@
+"""MetaAggregator: converge this filer's namespace with its peers.
+
+Reference: weed/filer/meta_aggregator.go — each filer subscribes to
+every peer's metadata stream and merges the events. Here the merge
+applies peer events to the local store with last-writer-wins semantics
+(Filer.apply_remote_event); applied events are re-logged locally with
+is_from_other_cluster=true, and peer subscriptions request
+local_only=true, so events propagate exactly one hop in a full mesh —
+no echo loops, no relays needed.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import grpc
+
+from ..pb import filer_pb2 as fpb
+from ..pb import rpc
+from ..utils.glog import logger
+from .filer import Filer
+
+log = logger("filer.aggregator")
+
+
+class MetaAggregator:
+    def __init__(self, filer: Filer, peers: list[str], client_name: str = ""):
+        """peers: list of peer filer gRPC addresses (host:port)."""
+        self.filer = filer
+        self.peers = [p for p in peers if p]
+        self.client_name = client_name or "aggregator"
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        # per-peer replication watermark — reconnects resume, and
+        # replayed events below the watermark are skipped
+        self._watermark: dict[str, int] = {}
+        self.applied = 0
+
+    def start(self) -> None:
+        for peer in self.peers:
+            t = threading.Thread(
+                target=self._follow_peer, args=(peer,), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _follow_peer(self, peer: str) -> None:
+        while not self._stop.is_set():
+            try:
+                with grpc.insecure_channel(peer) as ch:
+                    stub = rpc.filer_stub(ch)
+                    stream = stub.SubscribeMetadata(
+                        fpb.SubscribeMetadataRequest(
+                            client_name=self.client_name,
+                            since_ns=self._watermark.get(peer, 0),
+                            local_only=True,
+                        )
+                    )
+                    for ev in stream:
+                        if self._stop.is_set():
+                            return
+                        if self.filer.apply_remote_event(ev):
+                            self.applied += 1
+                        self._watermark[peer] = max(
+                            self._watermark.get(peer, 0), ev.ts_ns
+                        )
+            except grpc.RpcError:
+                # peer down or restarting: retry with backoff, resuming
+                # from the watermark
+                self._stop.wait(1.0)
+            except Exception as e:  # noqa: BLE001
+                log.warning("peer %s: %s", peer, e)
+                self._stop.wait(1.0)

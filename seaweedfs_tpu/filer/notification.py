@@ -9,6 +9,7 @@ mutation path.
 
 from __future__ import annotations
 
+import base64
 import json
 import queue
 import threading
@@ -38,7 +39,23 @@ def event_to_json(ev: fpb.FullEventNotification) -> dict:
         "oldEntry": entry(ev.event.old_entry),
         "newEntry": entry(ev.event.new_entry),
         "deleteChunks": ev.event.delete_chunks,
+        "remote": ev.event.is_from_other_cluster,
+        # full-fidelity event for gRPC SubscribeMetadata + aggregation
+        # (the summary fields above stay cheap for the HTTP tail/sinks)
+        "pb": base64.b64encode(ev.SerializeToString()).decode(),
     }
+
+
+def json_to_event(rec: dict) -> Optional[fpb.FullEventNotification]:
+    """Rebuild the protobuf event from a meta-log record; None for
+    legacy records without the pb field."""
+    raw = rec.get("pb")
+    if not raw:
+        return None
+    try:
+        return fpb.FullEventNotification.FromString(base64.b64decode(raw))
+    except Exception:
+        return None
 
 
 class _AsyncNotifier:

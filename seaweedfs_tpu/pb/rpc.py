@@ -11,6 +11,7 @@ from __future__ import annotations
 import grpc
 
 from . import cluster_pb2 as pb
+from . import filer_pb2 as fpb
 from . import mq_pb2 as mq
 from . import worker_pb2 as wk
 
@@ -22,6 +23,7 @@ BIDI = "stream_stream"
 MASTER_SERVICE = "sw.Seaweed"
 VOLUME_SERVICE = "sw.VolumeServer"
 MQ_SERVICE = "swmq.Messaging"
+FILER_SERVICE = "swfiler.SeaweedFiler"
 WORKER_SERVICE = "swworker.WorkerControl"
 RAFT_SERVICE = "sw.Raft"
 
@@ -74,6 +76,17 @@ SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
         "CommitOffset": (UNARY, mq.CommitOffsetRequest, mq.CommitOffsetResponse),
         "FetchOffset": (UNARY, mq.FetchOffsetRequest, mq.FetchOffsetResponse),
         "PartitionInfo": (UNARY, mq.PartitionInfoRequest, mq.PartitionInfoResponse),
+    },
+    FILER_SERVICE: {
+        "LookupDirectoryEntry": (UNARY, fpb.LookupEntryRequest, fpb.LookupEntryResponse),
+        "ListEntries": (SERVER_STREAM, fpb.ListEntriesRequest, fpb.ListEntriesResponse),
+        "CreateEntry": (UNARY, fpb.CreateEntryRequest, fpb.FilerOpResponse),
+        "UpdateEntry": (UNARY, fpb.UpdateEntryRequest, fpb.FilerOpResponse),
+        "DeleteEntry": (UNARY, fpb.DeleteEntryRequest, fpb.FilerOpResponse),
+        "AtomicRenameEntry": (UNARY, fpb.AtomicRenameEntryRequest, fpb.FilerOpResponse),
+        "SubscribeMetadata": (SERVER_STREAM, fpb.SubscribeMetadataRequest, fpb.FullEventNotification),
+        "KvGet": (UNARY, fpb.FilerKvGetRequest, fpb.FilerKvGetResponse),
+        "KvPut": (UNARY, fpb.FilerKvPutRequest, fpb.FilerOpResponse),
     },
     WORKER_SERVICE: {
         "WorkerStream": (BIDI, wk.WorkerMessage, wk.ServerMessage),
@@ -129,3 +142,7 @@ def volume_stub(channel: grpc.Channel) -> Stub:
 
 def mq_stub(channel: grpc.Channel) -> Stub:
     return Stub(channel, MQ_SERVICE)
+
+
+def filer_stub(channel: grpc.Channel) -> Stub:
+    return Stub(channel, FILER_SERVICE)

@@ -18,7 +18,7 @@ import json
 import time
 import xml.etree.ElementTree as ET
 
-from ..filer.entry import new_entry, normalize_path
+from ..filer.entry import normalize_path
 from ..filer.filer_store import NotFound
 from ..utils.glog import logger
 from . import versioning as vtag
@@ -147,7 +147,7 @@ class LifecycleScanner:
     def _apply_bucket(
         self, bucket: str, rules: list[dict], now: float, stats: dict
     ) -> None:
-        versioned = bool(self._versioning(bucket))
+        versioned = self._versioning(bucket)  # "" | Enabled | Suspended
         active = [r for r in rules if r.get("Status") == "Enabled"]
         if not active:
             return
@@ -205,8 +205,11 @@ class LifecycleScanner:
         if "ExpirationDays" in rule:
             return entry_age_days(mtime, now) >= rule["ExpirationDays"]
         if "ExpirationDate" in rule:
+            import calendar
+
             try:
-                t = time.mktime(
+                # AWS dates are UTC instants, never server-local time
+                t = calendar.timegm(
                     time.strptime(rule["ExpirationDate"][:10], "%Y-%m-%d")
                 )
             except ValueError:
@@ -214,16 +217,14 @@ class LifecycleScanner:
             return now >= t
         return False
 
-    def _expire_current(self, bucket: str, key: str, versioned: bool) -> bool:
+    def _expire_current(self, bucket: str, key: str, versioned: str) -> bool:
         path = normalize_path(f"{BUCKETS_ROOT}/{bucket}/{key}")
         if versioned:
             # delete-marker semantics: the data stays reachable as a
             # noncurrent version until NoncurrentVersionExpiration
-            vtag.archive_current(self.filer, BUCKETS_ROOT, bucket, key)
-            marker = new_entry(path)
-            marker.extended[vtag.MARKER_KEY] = b"1"
-            marker.extended[vtag.VID_KEY] = vtag.new_version_id().encode()
-            self.filer.create_entry(marker)
+            vtag.write_delete_marker(
+                self.filer, BUCKETS_ROOT, bucket, key, versioned
+            )
             return True
         try:
             vtag.check_deletable(self.filer.find_entry(path))

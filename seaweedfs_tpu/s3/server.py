@@ -252,9 +252,17 @@ class S3Server:
                         return self._bucket_op(bucket, q)
                     return self._object_op(bucket, key, q)
                 except S3AuthError as e:
-                    # a handler reading a signed streaming body can hit
-                    # a chunk-signature failure after dispatch
-                    return self._error(403, e.code, str(e))
+                    # post-dispatch failures: chunk-signature errors are
+                    # auth (403); malformed/truncated bodies are client
+                    # errors (400, AWS semantics — SDKs treat 403 as a
+                    # credential failure and won't retry)
+                    code = (
+                        400
+                        if e.code
+                        in ("IncompleteBody", "InvalidRequest", "MalformedXML")
+                        else 403
+                    )
+                    return self._error(code, e.code, str(e))
                 except NotFound:
                     return self._error(404, "NoSuchKey", "not found")
                 except FilerError as e:
@@ -607,18 +615,9 @@ class S3Server:
                                 except NotFound:
                                     pass
                         elif state:
-                            archive_current(
-                                srv.filer, BUCKETS_ROOT, bucket, key
+                            marker_vid = vtag.write_delete_marker(
+                                srv.filer, BUCKETS_ROOT, bucket, key, state
                             )
-                            marker_vid = (
-                                new_version_id()
-                                if state == "Enabled"
-                                else vtag.NULL_VID
-                            )
-                            marker = new_entry(path)
-                            marker.extended[vtag.MARKER_KEY] = b"1"
-                            marker.extended[vtag.VID_KEY] = marker_vid.encode()
-                            srv.filer.create_entry(marker)
                         else:
                             srv.filer.delete_entry(path, recursive=True)
                         if not quiet:
@@ -990,16 +989,9 @@ class S3Server:
                     )
                 if state:
                     # versioned simple DELETE: add a delete marker
-                    archive_current(srv.filer, BUCKETS_ROOT, bucket, key)
-                    vid = (
-                        new_version_id()
-                        if state == "Enabled"
-                        else vtag.NULL_VID
+                    vid = vtag.write_delete_marker(
+                        srv.filer, BUCKETS_ROOT, bucket, key, state
                     )
-                    marker = new_entry(path)
-                    marker.extended[vtag.MARKER_KEY] = b"1"
-                    marker.extended[vtag.VID_KEY] = vid.encode()
-                    srv.filer.create_entry(marker)
                     return self._respond(
                         204,
                         extra={
@@ -1171,6 +1163,10 @@ class S3Server:
                         return
                 else:
                     entry = srv.filer.find_entry(src_path)
+                    if entry.is_directory or is_delete_marker(entry):
+                        # a versioned key behind a delete marker reads
+                        # as absent — copy must 404 like GET does
+                        return self._error(404, "NoSuchKey", src)
                 data = srv.filer.read_entry(entry)
                 dst, vid = srv.put_object(
                     bucket, key, data, mime=entry.attr.mime

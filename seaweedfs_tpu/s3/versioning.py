@@ -143,6 +143,24 @@ def iter_versions(filer, buckets_root: str, bucket: str, key: str):
             yield e
 
 
+def write_delete_marker(
+    filer, buckets_root: str, bucket: str, key: str, state: str
+) -> str:
+    """Archive the current version and leave a delete marker at the
+    normal path. Suspended buckets get the null version id (AWS
+    semantics); Enabled buckets a fresh one. Returns the marker vid."""
+    from ..filer.entry import new_entry
+
+    archive_current(filer, buckets_root, bucket, key)
+    vid = new_version_id() if state == "Enabled" else NULL_VID
+    path = normalize_path(f"{buckets_root}/{bucket}/{key}")
+    marker = new_entry(path)
+    marker.extended[MARKER_KEY] = b"1"
+    marker.extended[VID_KEY] = vid.encode()
+    filer.create_entry(marker)
+    return vid
+
+
 def promote_latest(filer, buckets_root: str, bucket: str, key: str) -> bool:
     """After the current version is removed, materialize the newest
     remaining version back at the normal path. Returns True if one was

@@ -59,6 +59,8 @@ def main(argv=None) -> int:
     f.add_argument("-jwt.key", dest="jwt_key", default="")
     f.add_argument("-notify.webhook", dest="notify_webhook", default="")
     f.add_argument("-notify.mq", dest="notify_mq", default="")
+    f.add_argument("-grpcPort", type=int, default=0, help="gRPC metadata API port (0 = port+10000)")
+    f.add_argument("-peers", default="", help="comma-separated peer filer gRPC addrs for multi-filer")
 
     b = sub.add_parser("mq.broker")
     b.add_argument("-ip", default="localhost")
@@ -182,15 +184,29 @@ def main(argv=None) -> int:
             log.info("filer events -> mq %s", a.notify_mq)
         from ..filer.meta_log import MetaLog
 
+        fgrpc = getattr(a, "grpcPort", 0) or fport + 10000
+        peers = [
+            p.strip()
+            for p in getattr(a, "peers", "").split(",")
+            if p.strip()
+        ]
         fs = FilerServer(
             filer,
             ip=a.ip,
             port=fport,
             meta_log=MetaLog(os.path.join(dbdir, "metalog")),
+            grpc_port=fgrpc,
+            peers=peers,
         )
         fs.start()
         servers.append(fs)
-        log.info("filer on %s:%s", a.ip, fport)
+        log.info(
+            "filer on %s:%s (grpc %s%s)",
+            a.ip,
+            fport,
+            fs.grpc_port,
+            f", peers={peers}" if peers else "",
+        )
 
         if a.mode == "server" and a.s3:
             from ..s3 import Identity, IdentityStore, S3Server

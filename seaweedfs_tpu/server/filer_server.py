@@ -24,10 +24,17 @@ class FilerServer:
         ip: str = "localhost",
         port: int = 8888,
         meta_log=None,
+        grpc_port: int = 0,
+        peers: list[str] | None = None,
     ):
         """meta_log: a filer.meta_log.MetaLog; when present it is
-        subscribed to the filer and served at GET /~meta/tail (the
-        SubscribeMetadata analog, long-poll JSON batches)."""
+        subscribed to the filer, served at GET /~meta/tail (long-poll
+        JSON batches) and over the gRPC SubscribeMetadata stream.
+
+        grpc_port: port for the SeaweedFiler gRPC service (0 = pick an
+        ephemeral port; exposed as .grpc_port).
+        peers: other filers' gRPC addresses — starts a MetaAggregator
+        that converges this store with theirs."""
         self.filer = filer
         self.ip = ip
         self.port = port
@@ -36,6 +43,26 @@ class FilerServer:
             filer.subscribe(meta_log)
         self._http = ThreadingHTTPServer((ip, port), self._handler_class())
         self._thread = threading.Thread(target=self._http.serve_forever, daemon=True)
+        # gRPC metadata service (reference weed/pb/filer.proto service)
+        from concurrent import futures as _futures
+
+        import grpc as _grpc
+
+        from ..filer.grpc_service import FilerGrpcService
+        from ..pb import rpc as _rpc
+
+        self._grpc = _grpc.server(_futures.ThreadPoolExecutor(max_workers=16))
+        _rpc.add_service(
+            self._grpc, _rpc.FILER_SERVICE, FilerGrpcService(filer, meta_log)
+        )
+        self.grpc_port = self._grpc.add_insecure_port(f"{ip}:{grpc_port}")
+        self.aggregator = None
+        if peers:
+            from ..filer.meta_aggregator import MetaAggregator
+
+            self.aggregator = MetaAggregator(
+                filer, peers, client_name=f"{ip}:{port}"
+            )
 
     def _handler_class(self):
         filer = self.filer
@@ -259,8 +286,14 @@ class FilerServer:
 
     def start(self) -> None:
         self._thread.start()
+        self._grpc.start()
+        if self.aggregator is not None:
+            self.aggregator.start()
 
     def stop(self) -> None:
+        if self.aggregator is not None:
+            self.aggregator.stop()
+        self._grpc.stop(grace=0.5)
         self._http.shutdown()
         self._http.server_close()
         self.filer.close()

