@@ -24,6 +24,10 @@ class VolumeInfo:
     ec_ctx: Optional[ECContext] = None
     dat_file_size: int = 0
     encode_ts_ns: int = 0
+    # cold-tier placement (reference VolumeInfo.files tier info,
+    # volume_tier.go): where the .dat lives when not on local disk
+    tier_url: str = ""
+    tier_size: int = 0
 
     def to_json(self) -> str:
         d: dict = {"version": self.version}
@@ -36,6 +40,9 @@ class VolumeInfo:
             d["datFileSize"] = self.dat_file_size
         if self.encode_ts_ns:
             d["encodeTsNs"] = self.encode_ts_ns
+        if self.tier_url:
+            d["tierUrl"] = self.tier_url
+            d["tierSize"] = self.tier_size
         return json.dumps(d, indent=2, sort_keys=True)
 
     @classmethod
@@ -49,6 +56,8 @@ class VolumeInfo:
             else None,
             dat_file_size=int(d.get("datFileSize", 0)),
             encode_ts_ns=int(d.get("encodeTsNs", 0)),
+            tier_url=d.get("tierUrl", ""),
+            tier_size=int(d.get("tierSize", 0)),
         )
 
     def save(self, path: str) -> None:

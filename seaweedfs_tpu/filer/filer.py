@@ -334,9 +334,11 @@ class Filer:
                 applied_old, applied_new = local, None
             else:
                 return False
-        self._notify(
-            directory, applied_old, applied_new, ts_ns=ev.ts_ns, remote=True
-        )
+        # re-log with a LOCAL timestamp: the meta log must stay
+        # monotonic (watermark resume + sealed-segment naming depend on
+        # it); the origin's LWW timestamp still rides the entry's
+        # sw-mts extended attr
+        self._notify(directory, applied_old, applied_new, remote=True)
         return True
 
     # -------------------------------------------------------------- content

@@ -9,19 +9,13 @@ file API stays the byte data plane.
 
 from __future__ import annotations
 
-import threading
-
 import grpc
 
 from ..pb import filer_pb2 as fpb
-from ..pb import rpc
-from ..utils.glog import logger
 from .entry import Entry, normalize_path
 from .filer import Filer, FilerError
 from .filer_store import NotFound
 from .notification import json_to_event
-
-log = logger("filer.grpc")
 
 
 class FilerGrpcService:
@@ -121,6 +115,16 @@ class FilerGrpcService:
                 grpc.StatusCode.UNIMPLEMENTED, "filer runs without a meta log"
             )
         watermark = request.since_ns
+        if 0 < watermark < self.meta_log.dropped_before_ts:
+            # events in (since_ns, dropped_before_ts] were rotated away:
+            # continuing silently would present a complete-looking but
+            # gapped stream (HTTP tail exposes droppedBeforeTsNs for the
+            # same reason)
+            context.abort(
+                grpc.StatusCode.OUT_OF_RANGE,
+                f"resync required: events before "
+                f"{self.meta_log.dropped_before_ts} were rotated away",
+            )
         prefix = request.path_prefix
         while context.is_active():
             records = self.meta_log.read_since(watermark, limit=1000)
@@ -141,13 +145,3 @@ class FilerGrpcService:
                 self.meta_log.wait_for_events(watermark, timeout=1.0)
 
 
-def serve_filer_grpc(
-    filer: Filer, meta_log, ip: str, port: int
-) -> grpc.Server:
-    from concurrent import futures
-
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))
-    rpc.add_service(server, rpc.FILER_SERVICE, FilerGrpcService(filer, meta_log))
-    server.add_insecure_port(f"{ip}:{port}")
-    server.start()
-    return server

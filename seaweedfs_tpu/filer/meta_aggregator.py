@@ -31,10 +31,28 @@ class MetaAggregator:
         self.client_name = client_name or "aggregator"
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
-        # per-peer replication watermark — reconnects resume, and
-        # replayed events below the watermark are skipped
+        # per-peer replication watermark, persisted in the filer KV so
+        # a restart resumes instead of replaying each peer's whole log
         self._watermark: dict[str, int] = {}
+        self._saved: dict[str, int] = {}
+        for p in self.peers:
+            raw = filer.store.kv_get(f"meta-agg/{p}".encode())
+            if raw:
+                try:
+                    self._watermark[p] = int(raw)
+                except ValueError:
+                    pass
         self.applied = 0
+
+    def _advance(self, peer: str, ts_ns: int) -> None:
+        cur = max(self._watermark.get(peer, 0), ts_ns)
+        self._watermark[peer] = cur
+        # throttled persistence: every second of log time is plenty
+        if cur - self._saved.get(peer, 0) > 1_000_000_000:
+            self.filer.store.kv_put(
+                f"meta-agg/{peer}".encode(), str(cur).encode()
+            )
+            self._saved[peer] = cur
 
     def start(self) -> None:
         for peer in self.peers:
@@ -46,6 +64,14 @@ class MetaAggregator:
 
     def stop(self) -> None:
         self._stop.set()
+        for p, ts in self._watermark.items():
+            if ts != self._saved.get(p):
+                try:
+                    self.filer.store.kv_put(
+                        f"meta-agg/{p}".encode(), str(ts).encode()
+                    )
+                except Exception:  # noqa: BLE001 — store may be closing
+                    pass
 
     def _follow_peer(self, peer: str) -> None:
         while not self._stop.is_set():
@@ -64,10 +90,20 @@ class MetaAggregator:
                             return
                         if self.filer.apply_remote_event(ev):
                             self.applied += 1
-                        self._watermark[peer] = max(
-                            self._watermark.get(peer, 0), ev.ts_ns
-                        )
-            except grpc.RpcError:
+                        self._advance(peer, ev.ts_ns)
+            except grpc.RpcError as e:
+                if e.code() == grpc.StatusCode.OUT_OF_RANGE:
+                    # our watermark predates the peer's log retention:
+                    # events were rotated away. Replay what remains —
+                    # LWW apply makes the replay idempotent; entries
+                    # mutated only inside the gap stay divergent until
+                    # the next write (full resync is filer.sync's job).
+                    log.warning(
+                        "peer %s rotated past our watermark %d; replaying",
+                        peer,
+                        self._watermark.get(peer, 0),
+                    )
+                    self._watermark[peer] = 0
                 # peer down or restarting: retry with backoff, resuming
                 # from the watermark
                 self._stop.wait(1.0)

@@ -77,7 +77,9 @@ class MetaLog:
         with self._lock:
             self._f.write(line)
             self._f.flush()
-            self.last_ts_ns = record["tsNs"]
+            # max(): a non-monotonic record must never roll the
+            # watermark (or sealed-segment name) backwards
+            self.last_ts_ns = max(self.last_ts_ns, record["tsNs"])
             if self._f.tell() > SEGMENT_BYTES:
                 self._rotate_locked()
             self._lock.notify_all()

@@ -67,6 +67,8 @@ SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
         "VolumeServerStatus": (UNARY, pb.VolumeServerStatusRequest, pb.VolumeServerStatusResponse),
         "ScrubVolume": (UNARY, pb.ScrubRequest, pb.ScrubResponse),
         "ScrubEcVolume": (UNARY, pb.ScrubRequest, pb.ScrubResponse),
+        "VolumeTierUpload": (UNARY, pb.TierRequest, pb.TierResponse),
+        "VolumeTierDownload": (UNARY, pb.TierRequest, pb.TierResponse),
     },
     MQ_SERVICE: {
         "ConfigureTopic": (UNARY, mq.ConfigureTopicRequest, mq.ConfigureTopicResponse),

@@ -478,6 +478,31 @@ class VolumeService:
                 yield pb.CopyFileChunk(data=chunk)
                 sent += len(chunk)
 
+    def VolumeTierUpload(self, request, context):
+        """Move a sealed volume's .dat to the cold tier (reference
+        volume_grpc_tier_upload.go); .idx stays local so lookups never
+        touch the backend."""
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            return pb.TierResponse(error="volume not found")
+        try:
+            moved = v.tier_upload(request.dest_url, keep_local=request.keep_local)
+        except Exception as e:  # noqa: BLE001 — surfaced to the caller
+            return pb.TierResponse(error=str(e))
+        return pb.TierResponse(moved_bytes=moved)
+
+    def VolumeTierDownload(self, request, context):
+        """Bring a cold-tiered .dat back onto local disk (reference
+        volume_grpc_tier_download.go)."""
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            return pb.TierResponse(error="volume not found")
+        try:
+            moved = v.tier_download(delete_remote=request.delete_remote)
+        except Exception as e:  # noqa: BLE001
+            return pb.TierResponse(error=str(e))
+        return pb.TierResponse(moved_bytes=moved)
+
     def ScrubVolume(self, request, context):
         """CRC-verify every live needle (reference volume_grpc_scrub.go).
         Reads go through the lock-free scan of the sealed portion; the

@@ -588,6 +588,63 @@ def volume_move(env: ShellEnv, args) -> str:
     return f"moved volume {a.volumeId} {src.url} -> {a.target}"
 
 
+@command(
+    "volume.tier.upload",
+    "-volumeId N -dest http://host/bucket/key (move sealed .dat to cold tier)",
+    mutating=True,
+)
+def volume_tier_upload(env: ShellEnv, args) -> str:
+    p = argparse.ArgumentParser(prog="volume.tier.upload")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-dest", required=True, help="S3-style object URL")
+    p.add_argument("-keepLocal", action="store_true")
+    a = p.parse_args(args)
+    loc = _locate_volume(env, a.volumeId)
+    ch, stub = _volume_stub(loc)
+    with ch:
+        stub.VolumeMarkReadonly(
+            pb.VolumeCommandRequest(volume_id=a.volumeId), timeout=30
+        )
+        r = stub.VolumeTierUpload(
+            pb.TierRequest(
+                volume_id=a.volumeId,
+                dest_url=a.dest,
+                keep_local=a.keepLocal,
+            ),
+            timeout=3600,
+        )
+    if r.error:
+        return f"error: {r.error}"
+    return (
+        f"volume {a.volumeId}: {r.moved_bytes:,} bytes -> {a.dest}"
+        f"{' (local copy kept)' if a.keepLocal else ''}"
+    )
+
+
+@command(
+    "volume.tier.download",
+    "-volumeId N [-deleteRemote] (bring cold .dat back to local disk)",
+    mutating=True,
+)
+def volume_tier_download(env: ShellEnv, args) -> str:
+    p = argparse.ArgumentParser(prog="volume.tier.download")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-deleteRemote", action="store_true")
+    a = p.parse_args(args)
+    loc = _locate_volume(env, a.volumeId)
+    ch, stub = _volume_stub(loc)
+    with ch:
+        r = stub.VolumeTierDownload(
+            pb.TierRequest(
+                volume_id=a.volumeId, delete_remote=a.deleteRemote
+            ),
+            timeout=3600,
+        )
+    if r.error:
+        return f"error: {r.error}"
+    return f"volume {a.volumeId}: {r.moved_bytes:,} bytes fetched from cold tier"
+
+
 @command("volume.fix.replication", "re-replicate under-replicated volumes", mutating=True)
 def volume_fix_replication(env: ShellEnv, args) -> str:
     p = argparse.ArgumentParser(prog="volume.fix.replication")

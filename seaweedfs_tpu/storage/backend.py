@@ -1,0 +1,176 @@
+"""Pluggable byte-store backends under a volume + tiering transfers.
+
+Reference: weed/storage/backend/backend.go (BackendStorageFile SPI with
+local-disk, mmap, S3 and rclone implementations) and the tiering RPCs
+weed/server/volume_grpc_tier_upload.go / tier_download.go: a sealed
+volume's .dat moves to an object store while the .idx stays local, and
+reads become ranged GETs against the cold tier.
+
+Here the remote backend speaks plain S3-style HTTP (PUT object, ranged
+GET) — which the framework's own S3 gateway serves, so a cluster can
+cold-tier onto itself or onto any S3-compatible endpoint.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import BinaryIO
+
+import requests
+
+
+class BackendError(Exception):
+    pass
+
+
+class BackendStorageFile:
+    """Read-side SPI a tiered Volume consumes (reference
+    backend.BackendStorageFile ReadAt/WriteAt/Truncate/Close/Name —
+    tiered volumes are sealed, so only the read surface is required)."""
+
+    name: str = ""
+
+    def read_at(self, offset: int, size: int) -> bytes:
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class DiskFile(BackendStorageFile):
+    """Local-file backend (the default hot tier)."""
+
+    def __init__(self, path: str):
+        self.name = path
+        self._f = open(path, "rb")
+
+    def read_at(self, offset: int, size: int) -> bytes:
+        self._f.seek(offset)
+        return self._f.read(size)
+
+    def size(self) -> int:
+        return os.fstat(self._f.fileno()).st_size
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class S3RemoteFile(BackendStorageFile):
+    """Ranged-GET reader against an S3-style object URL
+    (http://host:port/bucket/key)."""
+
+    def __init__(self, url: str, session: requests.Session | None = None):
+        self.name = url
+        self._http = session or requests.Session()
+        self._size: int | None = None
+
+    def read_at(self, offset: int, size: int) -> bytes:
+        if size <= 0:
+            return b""
+        r = self._http.get(
+            self.name,
+            headers={"Range": f"bytes={offset}-{offset + size - 1}"},
+            timeout=60,
+        )
+        if r.status_code not in (200, 206):
+            raise BackendError(
+                f"cold-tier read {self.name} [{offset}:{offset+size}]: "
+                f"HTTP {r.status_code}"
+            )
+        data = r.content
+        if r.status_code == 200:
+            # endpoint ignored Range: slice locally
+            data = data[offset : offset + size]
+        if len(data) < size:
+            raise BackendError(
+                f"cold-tier short read {self.name}: {len(data)} < {size}"
+            )
+        return data
+
+    def size(self) -> int:
+        if self._size is None:
+            r = self._http.head(self.name, timeout=30)
+            if r.status_code != 200:
+                raise BackendError(
+                    f"cold-tier stat {self.name}: HTTP {r.status_code}"
+                )
+            self._size = int(r.headers.get("Content-Length", "0"))
+        return self._size
+
+
+def open_backend_file(url: str) -> BackendStorageFile:
+    if url.startswith(("http://", "https://")):
+        return S3RemoteFile(url)
+    return DiskFile(url)
+
+
+# ------------------------------------------------------------- transfers
+
+_CHUNK = 8 * 1024 * 1024
+
+
+class _SizedReader:
+    """File-like wrapper with a known length: requests sends a plain
+    Content-Length body (a bare generator would make it emit
+    Transfer-Encoding: chunked ALONGSIDE the manual Content-Length —
+    a malformed request strict S3 endpoints reject)."""
+
+    def __init__(self, f: BinaryIO, size: int):
+        self._f = f
+        self._remaining = size
+        self._size = size
+
+    def __len__(self) -> int:
+        return self._size
+
+    def read(self, n: int = -1) -> bytes:
+        if self._remaining <= 0:
+            return b""
+        if n is None or n < 0:
+            n = self._remaining
+        piece = self._f.read(min(n, self._remaining, _CHUNK))
+        self._remaining -= len(piece)
+        return piece
+
+
+def put_object(url: str, src: BinaryIO, size: int) -> None:
+    """Streaming PUT of `size` bytes from `src` to an S3-style URL."""
+    r = requests.put(url, data=_SizedReader(src, size), timeout=3600)
+    if r.status_code >= 300:
+        raise BackendError(
+            f"cold-tier upload {url}: HTTP {r.status_code} {r.text[:200]}"
+        )
+
+
+def fetch_object(url: str, dest_path: str) -> int:
+    """Streaming GET of a cold object into a local file (durable:
+    written to a temp, fsynced, renamed)."""
+    from ..utils.fs import fsync_dir
+
+    tmp = dest_path + ".fetch"
+    n = 0
+    with requests.get(url, stream=True, timeout=3600) as r:
+        if r.status_code != 200:
+            raise BackendError(
+                f"cold-tier download {url}: HTTP {r.status_code}"
+            )
+        with open(tmp, "wb") as f:
+            for piece in r.iter_content(_CHUNK):
+                f.write(piece)
+                n += len(piece)
+            f.flush()
+            os.fsync(f.fileno())
+    os.replace(tmp, dest_path)
+    fsync_dir(dest_path)
+    return n
+
+
+def delete_object(url: str) -> None:
+    """Best-effort delete of a cold object (after tier.download)."""
+    try:
+        requests.delete(url, timeout=60)
+    except requests.RequestException:
+        pass

@@ -20,6 +20,7 @@ from .volume import NotFoundError, Volume, VolumeError
 
 _DAT_RE = re.compile(r"^(?:(?P<col>[^_]+)_)?(?P<vid>\d+)\.dat$")
 _ECX_RE = re.compile(r"^(?:(?P<col>[^_]+)_)?(?P<vid>\d+)\.ecx$")
+_VIF_RE = re.compile(r"^(?:(?P<col>[^_]+)_)?(?P<vid>\d+)\.vif$")
 
 
 @dataclass
@@ -34,8 +35,10 @@ class DiskLocation:
 
     def load_existing(self, ec_backend: str = "auto", remote_reader_factory=None) -> None:
         for name in sorted(os.listdir(self.directory)):
-            m = _DAT_RE.match(name)
-            if m:
+            m = _DAT_RE.match(name) or _VIF_RE.match(name)
+            # a .vif with no local .dat is a cold-tiered volume: it must
+            # still mount (Volume opens it in remote mode)
+            if m and int(m.group("vid")) not in self.volumes:
                 vid = int(m.group("vid"))
                 col = m.group("col") or ""
                 try:

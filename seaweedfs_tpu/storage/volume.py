@@ -93,8 +93,19 @@ class Volume:
         base = self.base_file_name(directory, collection, volume_id)
         self.dat_path = base + ".dat"
         self.idx_path = base + ".idx"
+        self.vif_path = base + ".vif"
+        self._remote = None  # BackendStorageFile when cold-tiered
         self._reconcile_vacuum_marker(base)
         exists = os.path.exists(self.dat_path)
+        if not exists:
+            # a .vif with tier info and no local .dat = cold-tiered
+            # volume: serve reads from the backend, .idx stays local
+            from ..ec.volume_info import VolumeInfo
+
+            vif = VolumeInfo.maybe_load(self.vif_path)
+            if vif is not None and vif.tier_url:
+                self._open_remote(vif)
+                return
         if not exists and not create:
             raise VolumeError(f"volume {volume_id} not found at {self.dat_path}")
         if exists:
@@ -119,6 +130,27 @@ class Volume:
         self._dat = open(self.dat_path, "r+b")
         self._dat.seek(0, os.SEEK_END)
         self._append_at = self._pad_tail()
+
+    def _open_remote(self, vif) -> None:
+        """Cold-tier mode: reads ride ranged GETs against the backend
+        (reference volume_tier.go LoadRemoteFile)."""
+        from .backend import open_backend_file
+
+        self._remote = open_backend_file(vif.tier_url)
+        self.super_block = SuperBlock.from_bytes(
+            self._remote.read_at(0, SUPER_BLOCK_SIZE)
+        )
+        self.version = self.super_block.version
+        self.ttl = TTL.from_bytes(self.super_block.ttl)
+        self._last_write_ts = time.time()
+        self.needle_map = MemoryNeedleMap(self.idx_path)
+        self._dat = None
+        self._append_at = vif.tier_size
+        self.read_only = True  # tiered volumes are sealed
+
+    @property
+    def is_tiered(self) -> bool:
+        return self._remote is not None
 
     @staticmethod
     def base_file_name(directory: str, collection: str, volume_id: int) -> str:
@@ -206,7 +238,16 @@ class Volume:
             nv = self.needle_map.get(needle_id)
             if nv is None or nv.is_deleted:
                 raise NotFoundError(f"needle {needle_id:x} not found")
-            raw = self._pread_record(actual_offset(nv.offset), nv.size)
+            remote = self._remote
+            if remote is None:
+                raw = self._pread_record(actual_offset(nv.offset), nv.size)
+        if remote is not None:
+            # cold-tier GET outside the lock: a 60s remote read must not
+            # serialize every other read of this volume behind it (the
+            # tiered volume is sealed, so the record can't move)
+            raw = remote.read_at(
+                actual_offset(nv.offset), self._record_disk_len(nv.size)
+            )
         n = Needle.from_bytes(raw, self.version)
         if cookie is not None and n.cookie != cookie:
             raise CookieMismatch(
@@ -218,6 +259,10 @@ class Volume:
         return n
 
     def _pread_record(self, byte_offset: int, body_size: int) -> bytes:
+        if self._dat is None:
+            return self._remote.read_at(
+                byte_offset, self._record_disk_len(body_size)
+            )
         self._dat.seek(byte_offset)
         return self._dat.read(self._record_disk_len(body_size))
 
@@ -253,6 +298,11 @@ class Volume:
 
     def set_read_only(self, ro: bool = True) -> None:
         with self._lock:
+            if self._remote is not None and not ro:
+                raise VolumeError(
+                    f"volume {self.volume_id} is cold-tiered; "
+                    "tier.download before making it writable"
+                )
             self.flush()
             self.read_only = ro
 
@@ -286,15 +336,99 @@ class Volume:
 
     def flush(self) -> None:
         with self._lock:
-            self._dat.flush()
-            os.fsync(self._dat.fileno())
+            if self._dat is not None:
+                self._dat.flush()
+                os.fsync(self._dat.fileno())
             self.needle_map.flush()
 
     def close(self) -> None:
         with self._lock:
             self.flush()
-            self._dat.close()
+            if self._dat is not None:
+                self._dat.close()
+            if self._remote is not None:
+                self._remote.close()
             self.needle_map.close()
+
+    # -------------------------------------------------------------- tiering
+
+    def tier_upload(self, dest_url: str, keep_local: bool = False) -> int:
+        """Move the sealed .dat to a cold backend; the .idx stays local
+        (reference volume_grpc_tier_upload.go). Returns bytes moved.
+
+        The network transfer runs OUTSIDE the volume lock — the volume
+        is sealed, so the .dat cannot change underneath it, and reads
+        keep flowing during a potentially hour-long upload."""
+        from ..ec.volume_info import VolumeInfo
+        from .backend import put_object
+
+        with self._lock:
+            self._check_not_broken()
+            if self._remote is not None:
+                raise VolumeError(f"volume {self.volume_id} already tiered")
+            if not self.read_only:
+                raise VolumeError(
+                    f"volume {self.volume_id} must be readonly to tier"
+                )
+            self.flush()
+            size = self._append_at
+        with open(self.dat_path, "rb") as f:  # unlocked: sealed volume
+            put_object(dest_url, f, size)
+        with self._lock:
+            if self._remote is not None or not self.read_only:
+                raise VolumeError(
+                    f"volume {self.volume_id} changed state during tiering"
+                )
+            vif = VolumeInfo.maybe_load(self.vif_path) or VolumeInfo(
+                version=self.version
+            )
+            vif.tier_url = dest_url
+            vif.tier_size = size
+            vif.save(self.vif_path)
+            if not keep_local:
+                self._dat.close()
+                os.unlink(self.dat_path)
+                fsync_dir(self.dat_path)
+                self.needle_map.close()
+                self._open_remote(vif)
+            return size
+
+    def tier_download(self, delete_remote: bool = False) -> int:
+        """Bring a cold-tiered .dat back to local disk (reference
+        volume_grpc_tier_download.go). Returns bytes fetched. The fetch
+        streams outside the lock (remote reads keep serving); only the
+        handle switchover is locked."""
+        from ..ec.volume_info import VolumeInfo
+        from .backend import delete_object, fetch_object
+
+        with self._lock:
+            if self._remote is None:
+                raise VolumeError(f"volume {self.volume_id} is not tiered")
+            vif = VolumeInfo.maybe_load(self.vif_path)
+            url = vif.tier_url if vif else self._remote.name
+        n = fetch_object(url, self.dat_path)  # unlocked: cold object is sealed
+        if vif and vif.tier_size and n != vif.tier_size:
+            os.unlink(self.dat_path)
+            raise VolumeError(
+                f"cold-tier download size mismatch: {n} != {vif.tier_size}"
+            )
+        with self._lock:
+            if self._remote is None:
+                return n  # raced another download: already local
+            # drop the reference without closing: an in-flight unlocked
+            # cold read may still be using the session
+            self._remote = None
+            if vif:
+                vif.tier_url, vif.tier_size = "", 0
+                vif.save(self.vif_path)
+            self.needle_map.close()
+            self.needle_map = MemoryNeedleMap(self.idx_path)
+            self._dat = open(self.dat_path, "r+b")
+            self._dat.seek(0, os.SEEK_END)
+            self._append_at = self._pad_tail()
+        if delete_remote:
+            delete_object(url)
+        return n
 
     # --------------------------------------------------------------- vacuum
 
@@ -307,6 +441,11 @@ class Volume:
         """
         with self._lock:
             self._check_not_broken()
+            if self._remote is not None:
+                raise VolumeError(
+                    f"volume {self.volume_id} is cold-tiered; "
+                    "tier.download before vacuuming"
+                )
             if os.path.exists(self.dat_path[:-4] + ".cpm"):
                 # A durable commit marker means an earlier vacuum's swap
                 # is pending: truncating .cpd/.cpx now would let a crash
