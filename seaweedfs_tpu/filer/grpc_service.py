@@ -91,6 +91,21 @@ class FilerGrpcService:
             return fpb.FilerOpResponse(error=str(e))
         return fpb.FilerOpResponse()
 
+    def AssignVolume(self, request, context):
+        """Proxy an assign to the filer's master so mounts can place
+        chunks without a master address (reference filer_pb
+        AssignVolume; used by the mount page writer)."""
+        try:
+            a = self.filer.ops.master.assign(
+                count=request.count or 1,
+                collection=request.collection or self.filer.collection,
+                replication=self.filer.replication,
+                ttl=request.ttl,
+            )
+        except Exception as e:  # noqa: BLE001 — surfaced to the client
+            return fpb.AssignVolumeResponse(error=str(e))
+        return fpb.AssignVolumeResponse(fid=a.fid, url=a.url, jwt=a.jwt)
+
     def KvGet(self, request, context):
         v = self.filer.store.kv_get(bytes(request.key))
         if v is None:

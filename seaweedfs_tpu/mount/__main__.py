@@ -10,12 +10,17 @@ import sys
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="seaweedfs_tpu.mount")
     p.add_argument("-filer", default="localhost:8888")
+    p.add_argument(
+        "-filerGrpc",
+        default="",
+        help="filer gRPC addr (default: HTTP port + 10000)",
+    )
     p.add_argument("-dir", required=True, help="mountpoint")
     a = p.parse_args(argv)
     from .weed_mount import run_mount
 
     print(f"mounting filer {a.filer} at {a.dir}", flush=True)
-    return run_mount(a.filer, a.dir)
+    return run_mount(a.filer, a.dir, filer_grpc=a.filerGrpc)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,108 @@
+"""Interval-merged dirty-page buffer for the FUSE mount.
+
+Reference: weed/mount/page_writer.go + dirty_pages_chunked.go — open
+files buffer written byte ranges as merged intervals; when the dirty
+set crosses a bound, sealed intervals are uploaded as chunks (placed
+via the filer's AssignVolume) instead of growing resident memory, so a
+write larger than RAM completes with flat RSS.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class PageBuffer:
+    """Sorted, non-overlapping, merged dirty intervals.
+
+    Not thread-safe — callers hold the handle lock.
+    """
+
+    def __init__(self):
+        # list[(offset, bytearray)] sorted by offset; adjacent or
+        # overlapping writes merge into one interval
+        self._iv: list[tuple[int, bytearray]] = []
+
+    @property
+    def total(self) -> int:
+        return sum(len(b) for _, b in self._iv)
+
+    @property
+    def extent(self) -> int:
+        """One past the last dirty byte (0 when clean)."""
+        if not self._iv:
+            return 0
+        off, buf = self._iv[-1]
+        return off + len(buf)
+
+    def write(self, offset: int, data: bytes) -> None:
+        if not data:
+            return
+        # fast path: sequential append to the last interval
+        if self._iv:
+            last_off, last_buf = self._iv[-1]
+            if offset == last_off + len(last_buf):
+                last_buf.extend(data)
+                return
+        merged = bytearray(data)
+        m_start = offset
+        keep: list[tuple[int, bytearray]] = []
+        for off, buf in self._iv:
+            end = off + len(buf)
+            if end < m_start or off > m_start + len(merged):
+                keep.append((off, buf))  # strictly disjoint, non-adjacent
+                continue
+            # overlapping or adjacent: fold into merged
+            new_start = min(m_start, off)
+            new_end = max(m_start + len(merged), end)
+            out = bytearray(new_end - new_start)
+            out[off - new_start : off - new_start + len(buf)] = buf
+            # the NEW data wins on overlap: copy it last
+            out[m_start - new_start : m_start - new_start + len(merged)] = merged
+            merged, m_start = out, new_start
+        keep.append((m_start, merged))
+        keep.sort(key=lambda t: t[0])
+        self._iv = keep
+
+    def read(self, offset: int, size: int) -> Optional[bytes]:
+        """The range's bytes if FULLY covered by one interval, else
+        None (caller falls back to a committed read)."""
+        for off, buf in self._iv:
+            if off <= offset and offset + size <= off + len(buf):
+                lo = offset - off
+                return bytes(buf[lo : lo + size])
+            if off > offset:
+                break
+        return None
+
+    def covers_any(self, offset: int, size: int) -> bool:
+        stop = offset + size
+        return any(
+            off < stop and off + len(buf) > offset for off, buf in self._iv
+        )
+
+    def truncate(self, length: int) -> None:
+        out = []
+        for off, buf in self._iv:
+            if off >= length:
+                continue
+            if off + len(buf) > length:
+                buf = buf[: length - off]
+            if buf:
+                out.append((off, buf))
+        self._iv = out
+
+    def drain(self) -> list[tuple[int, bytes]]:
+        """All intervals, clearing the buffer."""
+        out = [(off, bytes(buf)) for off, buf in self._iv]
+        self._iv = []
+        return out
+
+    def peek(self) -> list[tuple[int, bytes]]:
+        """All intervals without clearing (spill discards each one only
+        after its upload succeeds)."""
+        return [(off, bytes(buf)) for off, buf in self._iv]
+
+    def discard(self, offset: int) -> None:
+        """Drop the interval starting at `offset` (post-upload)."""
+        self._iv = [t for t in self._iv if t[0] != offset]

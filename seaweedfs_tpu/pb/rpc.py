@@ -87,6 +87,7 @@ SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
         "DeleteEntry": (UNARY, fpb.DeleteEntryRequest, fpb.FilerOpResponse),
         "AtomicRenameEntry": (UNARY, fpb.AtomicRenameEntryRequest, fpb.FilerOpResponse),
         "SubscribeMetadata": (SERVER_STREAM, fpb.SubscribeMetadataRequest, fpb.FullEventNotification),
+        "AssignVolume": (UNARY, fpb.AssignVolumeRequest, fpb.AssignVolumeResponse),
         "KvGet": (UNARY, fpb.FilerKvGetRequest, fpb.FilerKvGetResponse),
         "KvPut": (UNARY, fpb.FilerKvPutRequest, fpb.FilerOpResponse),
     },

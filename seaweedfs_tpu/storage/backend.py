@@ -150,7 +150,7 @@ def fetch_object(url: str, dest_path: str) -> int:
     written to a temp, fsynced, renamed)."""
     from ..utils.fs import fsync_dir
 
-    tmp = dest_path + ".fetch"
+    tmp = f"{dest_path}.fetch.{os.getpid()}.{os.urandom(4).hex()}"
     n = 0
     with requests.get(url, stream=True, timeout=3600) as r:
         if r.status_code != 200:

@@ -95,6 +95,7 @@ class Volume:
         self.idx_path = base + ".idx"
         self.vif_path = base + ".vif"
         self._remote = None  # BackendStorageFile when cold-tiered
+        self._tiering = False  # a tier transfer is in flight
         self._reconcile_vacuum_marker(base)
         exists = os.path.exists(self.dat_path)
         if not exists:
@@ -364,34 +365,43 @@ class Volume:
 
         with self._lock:
             self._check_not_broken()
+            if self._tiering:
+                raise VolumeError(
+                    f"volume {self.volume_id}: tier transfer in progress"
+                )
             if self._remote is not None:
                 raise VolumeError(f"volume {self.volume_id} already tiered")
             if not self.read_only:
                 raise VolumeError(
                     f"volume {self.volume_id} must be readonly to tier"
                 )
+            self._tiering = True
             self.flush()
             size = self._append_at
-        with open(self.dat_path, "rb") as f:  # unlocked: sealed volume
-            put_object(dest_url, f, size)
-        with self._lock:
-            if self._remote is not None or not self.read_only:
-                raise VolumeError(
-                    f"volume {self.volume_id} changed state during tiering"
+        try:
+            with open(self.dat_path, "rb") as f:  # unlocked: sealed volume
+                put_object(dest_url, f, size)
+            with self._lock:
+                if self._remote is not None or not self.read_only:
+                    raise VolumeError(
+                        f"volume {self.volume_id} changed state during tiering"
+                    )
+                vif = VolumeInfo.maybe_load(self.vif_path) or VolumeInfo(
+                    version=self.version
                 )
-            vif = VolumeInfo.maybe_load(self.vif_path) or VolumeInfo(
-                version=self.version
-            )
-            vif.tier_url = dest_url
-            vif.tier_size = size
-            vif.save(self.vif_path)
-            if not keep_local:
-                self._dat.close()
-                os.unlink(self.dat_path)
-                fsync_dir(self.dat_path)
-                self.needle_map.close()
-                self._open_remote(vif)
-            return size
+                vif.tier_url = dest_url
+                vif.tier_size = size
+                vif.save(self.vif_path)
+                if not keep_local:
+                    self._dat.close()
+                    os.unlink(self.dat_path)
+                    fsync_dir(self.dat_path)
+                    self.needle_map.close()
+                    self._open_remote(vif)
+                return size
+        finally:
+            with self._lock:
+                self._tiering = False
 
     def tier_download(self, delete_remote: bool = False) -> int:
         """Bring a cold-tiered .dat back to local disk (reference
@@ -402,30 +412,37 @@ class Volume:
         from .backend import delete_object, fetch_object
 
         with self._lock:
+            if self._tiering:
+                raise VolumeError(
+                    f"volume {self.volume_id}: tier transfer in progress"
+                )
             if self._remote is None:
                 raise VolumeError(f"volume {self.volume_id} is not tiered")
+            self._tiering = True
             vif = VolumeInfo.maybe_load(self.vif_path)
             url = vif.tier_url if vif else self._remote.name
-        n = fetch_object(url, self.dat_path)  # unlocked: cold object is sealed
-        if vif and vif.tier_size and n != vif.tier_size:
-            os.unlink(self.dat_path)
-            raise VolumeError(
-                f"cold-tier download size mismatch: {n} != {vif.tier_size}"
-            )
-        with self._lock:
-            if self._remote is None:
-                return n  # raced another download: already local
-            # drop the reference without closing: an in-flight unlocked
-            # cold read may still be using the session
-            self._remote = None
-            if vif:
-                vif.tier_url, vif.tier_size = "", 0
-                vif.save(self.vif_path)
-            self.needle_map.close()
-            self.needle_map = MemoryNeedleMap(self.idx_path)
-            self._dat = open(self.dat_path, "r+b")
-            self._dat.seek(0, os.SEEK_END)
-            self._append_at = self._pad_tail()
+        try:
+            n = fetch_object(url, self.dat_path)  # unlocked: cold object sealed
+            if vif and vif.tier_size and n != vif.tier_size:
+                os.unlink(self.dat_path)
+                raise VolumeError(
+                    f"cold-tier download size mismatch: {n} != {vif.tier_size}"
+                )
+            with self._lock:
+                # drop the reference without closing: an in-flight
+                # unlocked cold read may still be using the session
+                self._remote = None
+                if vif:
+                    vif.tier_url, vif.tier_size = "", 0
+                    vif.save(self.vif_path)
+                self.needle_map.close()
+                self.needle_map = MemoryNeedleMap(self.idx_path)
+                self._dat = open(self.dat_path, "r+b")
+                self._dat.seek(0, os.SEEK_END)
+                self._append_at = self._pad_tail()
+        finally:
+            with self._lock:
+                self._tiering = False
         if delete_remote:
             delete_object(url)
         return n

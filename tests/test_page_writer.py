@@ -1,0 +1,201 @@
+"""Chunked dirty-page writer tests (reference weed/mount/page_writer.go
++ dirty_pages_chunked.go): interval merging, chunk spill with bounded
+memory, commit over the filer gRPC service.
+
+Runs WITHOUT a kernel mount: FilerMount methods are driven directly
+with fake fuse_file_info objects, so these tests exercise the page
+writer everywhere (test_mount.py covers the kernel-mount path where
+/dev/fuse exists)."""
+
+import ctypes
+import time
+import types
+
+import pytest
+import requests
+
+from seaweedfs_tpu.filer import Filer, MemoryStore
+from seaweedfs_tpu.filer.meta_log import MetaLog
+from seaweedfs_tpu.mount.page_writer import PageBuffer
+from seaweedfs_tpu.mount.weed_mount import FilerMount
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+from conftest import allocate_port as free_port
+
+
+# ------------------------------------------------------------ PageBuffer
+
+
+def test_page_buffer_sequential_append():
+    pb = PageBuffer()
+    pb.write(0, b"aaaa")
+    pb.write(4, b"bbbb")
+    pb.write(8, b"cccc")
+    assert pb.drain() == [(0, b"aaaabbbbcccc")]
+
+
+def test_page_buffer_overlap_latest_wins():
+    pb = PageBuffer()
+    pb.write(0, b"xxxxxxxxxx")
+    pb.write(3, b"YYY")
+    assert pb.read(0, 10) == b"xxxYYYxxxx"
+    pb.write(8, b"ZZZZ")  # extends past the end
+    assert pb.total == 12
+    assert pb.read(0, 12) == b"xxxYYYxxZZZZ"
+
+
+def test_page_buffer_gap_and_merge():
+    pb = PageBuffer()
+    pb.write(0, b"aa")
+    pb.write(10, b"bb")
+    assert pb.total == 4
+    assert pb.read(0, 2) == b"aa" and pb.read(10, 2) == b"bb"
+    assert pb.read(0, 12) is None  # gap: not fully covered
+    assert pb.covers_any(1, 10)
+    pb.write(2, b"cccccccc")  # bridges the gap
+    assert pb.drain() == [(0, b"aaccccccccbb")]
+
+
+def test_page_buffer_truncate():
+    pb = PageBuffer()
+    pb.write(0, b"abcdef")
+    pb.write(10, b"ghij")
+    pb.truncate(12)
+    assert pb.read(10, 2) == b"gh"
+    pb.truncate(3)
+    assert pb.drain() == [(0, b"abc")]
+
+
+# ------------------------------------------------------ mount page writer
+
+
+@pytest.fixture(scope="module")
+def filer_stack(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("pwvol")
+    mport = free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp / "v")],
+        master=f"localhost:{mport}",
+        ip="localhost",
+        port=free_port(),
+        ec_backend="cpu",
+    )
+    vs.start()
+    while not master.topo.nodes:
+        time.sleep(0.05)
+    filer = Filer(MemoryStore(), master=f"localhost:{mport}", chunk_size=256 * 1024)
+    fs = FilerServer(
+        filer,
+        ip="localhost",
+        port=free_port(),
+        meta_log=MetaLog(str(tmp / "metalog")),
+        grpc_port=0,
+    )
+    fs.start()
+    yield fs
+    fs.stop()
+    vs.stop()
+    master.stop()
+
+
+def _fi():
+    return types.SimpleNamespace(contents=types.SimpleNamespace(fh=0))
+
+
+def _mount(fs) -> FilerMount:
+    return FilerMount(
+        f"localhost:{fs.port}", filer_grpc=f"localhost:{fs.grpc_port}"
+    )
+
+
+def _write(m, fi, path, offset, data):
+    buf = ctypes.create_string_buffer(bytes(data), len(data))
+    assert m.write(path, buf, len(data), offset, fi) == len(data)
+
+
+def _read(m, fi, path, offset, size):
+    buf = ctypes.create_string_buffer(size)
+    n = m.read(path, buf, size, offset, fi)
+    assert n >= 0, f"read errno {-n}"
+    return buf.raw[:n]
+
+
+def test_mount_write_spills_with_flat_memory(filer_stack):
+    """A 40MB sequential write with an 8MB flush bound keeps dirty
+    bytes bounded and round-trips byte-exact (the VERDICT item)."""
+    import seaweedfs_tpu.mount.weed_mount as wm
+
+    m = _mount(filer_stack)
+    fi = _fi()
+    assert m.create("/bigfile.bin", 0o644, fi) == 0
+    h = m._handles[fi.contents.fh]
+    total = 40 * 1024 * 1024
+    step = 1024 * 1024
+    peak_dirty = 0
+    chunkcount_before_close = None
+    for off in range(0, total, step):
+        block = bytes([(off // step) % 256]) * step
+        _write(m, fi, "/bigfile.bin", off, block)
+        peak_dirty = max(peak_dirty, h.pages.total)
+    chunkcount_before_close = len(h.chunks)
+    assert m.release("/bigfile.bin", fi) == 0
+    # bounded memory: dirty pages never exceeded the flush bound + one
+    # write, and most data had already spilled as chunks pre-close
+    assert peak_dirty <= wm.FLUSH_BYTES + step
+    assert chunkcount_before_close >= (total - wm.FLUSH_BYTES) // wm.CHUNK_SIZE
+    # committed entry is byte-exact
+    r = requests.get(f"http://localhost:{filer_stack.port}/bigfile.bin")
+    assert r.status_code == 200 and len(r.content) == total
+    for off in range(0, total, step):
+        assert r.content[off] == (off // step) % 256
+
+
+def test_mount_read_modify_write(filer_stack):
+    m = _mount(filer_stack)
+    fi = _fi()
+    assert m.create("/rmw.txt", 0o644, fi) == 0
+    _write(m, fi, "/rmw.txt", 0, b"hello world, page writer here")
+    assert m.release("/rmw.txt", fi) == 0
+    # reopen, patch the middle, read back through the dirty overlay
+    fi2 = _fi()
+    assert m.open("/rmw.txt", fi2) == 0
+    _write(m, fi2, "/rmw.txt", 6, b"WORLD")
+    assert _read(m, fi2, "/rmw.txt", 6, 5) == b"WORLD"
+    # read across dirty + committed regions forces a commit-then-read
+    assert _read(m, fi2, "/rmw.txt", 0, 29) == b"hello WORLD, page writer here"
+    assert m.release("/rmw.txt", fi2) == 0
+    r = requests.get(f"http://localhost:{filer_stack.port}/rmw.txt")
+    assert r.content == b"hello WORLD, page writer here"
+
+
+def test_mount_sparse_and_truncate(filer_stack):
+    m = _mount(filer_stack)
+    fi = _fi()
+    assert m.create("/sparse.bin", 0o644, fi) == 0
+    _write(m, fi, "/sparse.bin", 0, b"head")
+    _write(m, fi, "/sparse.bin", 1000, b"tail")
+    assert m.ftruncate("/sparse.bin", 1002, fi) == 0
+    assert m.release("/sparse.bin", fi) == 0
+    r = requests.get(f"http://localhost:{filer_stack.port}/sparse.bin")
+    assert len(r.content) == 1002
+    assert r.content[:4] == b"head"
+    assert r.content[4:1000] == b"\x00" * 996  # gap reads as zeros
+    assert r.content[1000:] == b"ta"
+
+
+def test_mount_shared_handle_refcount(filer_stack):
+    m = _mount(filer_stack)
+    fi1, fi2 = _fi(), _fi()
+    assert m.create("/shared.txt", 0o644, fi1) == 0
+    assert m.open("/shared.txt", fi2) == 0  # same live handle
+    _write(m, fi1, "/shared.txt", 0, b"via fd1")
+    assert _read(m, fi2, "/shared.txt", 0, 7) == b"via fd1"
+    assert m.release("/shared.txt", fi1) == 0
+    # still open via fd2: path stays visible
+    assert m._by_path.get("/shared.txt") is not None
+    assert m.release("/shared.txt", fi2) == 0
+    assert m._by_path.get("/shared.txt") is None
