@@ -1,0 +1,241 @@
+"""Rack-aware EC shard placement planning.
+
+Reference: weed/shell/command_ec_common.go:60-120 (the EcBalance
+algorithm description) and weed/storage/erasure_coding/ecbalancer/ —
+per collection: deduplicate shard copies, spread each volume's shards
+across racks (bounded by the per-rack average), then even them across
+servers within each rack, and finally flatten total per-server counts
+inside every rack.
+
+Pure planning: callers snapshot the cluster into NodeViews, get back an
+ordered list of Move/Drop operations, and execute them with their own
+RPC machinery (the shell's ec.balance does copy+mount / unmount+delete
+per move). Keeping the planner pure makes it testable against synthetic
+topologies the way the reference tests shell commands against fixture
+topology dumps.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+
+@dataclass
+class NodeView:
+    """One volume server as the planner sees it."""
+
+    id: str
+    rack: str = ""
+    data_center: str = ""
+    free_slots: int = 100
+    # vid -> set of shard ids held
+    shards: dict[int, set[int]] = field(default_factory=dict)
+
+    def shard_count(self) -> int:
+        return sum(len(s) for s in self.shards.values())
+
+    def rack_key(self) -> tuple[str, str]:
+        return (self.data_center, self.rack)
+
+
+@dataclass(frozen=True)
+class Move:
+    vid: int
+    shard_id: int
+    src: str
+    dst: str
+    reason: str
+
+
+@dataclass(frozen=True)
+class Drop:
+    """Delete a duplicate shard copy (dedupe)."""
+
+    vid: int
+    shard_id: int
+    node: str
+
+
+def plan_ec_balance(
+    nodes: list[NodeView], max_moves: int = 10_000
+) -> tuple[list[Drop], list[Move]]:
+    """Full balance pass: dedupe -> across racks -> within racks ->
+    per-rack total flattening. Mutates the NodeViews to reflect planned
+    operations so later stages see earlier decisions."""
+    by_id = {n.id: n for n in nodes}
+    drops = _plan_dedupe(nodes)
+    moves: list[Move] = []
+    moves += _plan_across_racks(nodes, by_id)
+    moves += _plan_within_racks(nodes, by_id)
+    moves += _plan_rack_totals(nodes, by_id)
+    return drops, moves[:max_moves]
+
+
+# ------------------------------------------------------------------ stages
+
+
+def _plan_dedupe(nodes: list[NodeView]) -> list[Drop]:
+    """A shard held by several servers keeps the copy on the
+    least-loaded holder; the rest are dropped
+    (doDeduplicateEcShards)."""
+    holders: dict[tuple[int, int], list[NodeView]] = defaultdict(list)
+    for n in nodes:
+        for vid, sids in n.shards.items():
+            for sid in sids:
+                holders[(vid, sid)].append(n)
+    drops: list[Drop] = []
+    for (vid, sid), hs in sorted(holders.items()):
+        if len(hs) <= 1:
+            continue
+        hs.sort(key=lambda n: (n.shard_count(), n.id))
+        for extra in hs[1:]:
+            drops.append(Drop(vid, sid, extra.id))
+            extra.shards[vid].discard(sid)
+    return drops
+
+
+def _racks(nodes: list[NodeView]) -> dict[tuple[str, str], list[NodeView]]:
+    racks: dict[tuple[str, str], list[NodeView]] = defaultdict(list)
+    for n in nodes:
+        racks[n.rack_key()].append(n)
+    return racks
+
+
+def _pick_dest_node(
+    candidates: list[NodeView], vid: int
+) -> NodeView | None:
+    """Score a destination server: fewest shards of THIS volume first
+    (spread the loss domain), then fewest total shards, then most free
+    slots (pickEcNodeToBalanceShardsInto)."""
+    best = None
+    for n in candidates:
+        if n.free_slots <= 0:
+            continue
+        key = (len(n.shards.get(vid, ())), n.shard_count(), -n.free_slots, n.id)
+        if best is None or key < best[0]:
+            best = (key, n)
+    return best[1] if best else None
+
+
+def _apply_move(m: Move, by_id: dict[str, NodeView]) -> None:
+    src, dst = by_id[m.src], by_id[m.dst]
+    src.shards[m.vid].discard(m.shard_id)
+    if not src.shards[m.vid]:
+        del src.shards[m.vid]
+    dst.shards.setdefault(m.vid, set()).add(m.shard_id)
+    src.free_slots += 1
+    dst.free_slots -= 1
+
+
+def _plan_across_racks(
+    nodes: list[NodeView], by_id: dict[str, NodeView]
+) -> list[Move]:
+    """Per volume: no rack may hold more than
+    ceil(total_shards / rack_count) shards (doBalanceEcShardsAcrossRacks)."""
+    moves: list[Move] = []
+    racks = _racks(nodes)
+    if len(racks) < 2:
+        return moves
+    vids = sorted({vid for n in nodes for vid in n.shards})
+    for vid in vids:
+        rack_shards: dict[tuple[str, str], list[tuple[str, int]]] = defaultdict(list)
+        for n in nodes:
+            for sid in sorted(n.shards.get(vid, ())):
+                rack_shards[n.rack_key()].append((n.id, sid))
+        total = sum(len(v) for v in rack_shards.values())
+        if total == 0:
+            continue
+        avg = -(-total // len(racks))  # ceil
+        for rk in sorted(rack_shards, key=lambda k: -len(rack_shards[k])):
+            overflow = rack_shards[rk][avg:]
+            for node_id, sid in overflow:
+                # destination rack: fewest shards of this volume, then
+                # most aggregate free slots (pickRackToBalanceShardsInto)
+                dest_rk = min(
+                    (k for k in racks if k != rk),
+                    key=lambda k: (
+                        sum(len(by_id[n.id].shards.get(vid, ())) for n in racks[k]),
+                        -sum(n.free_slots for n in racks[k]),
+                        k,
+                    ),
+                    default=None,
+                )
+                if dest_rk is None:
+                    continue
+                dest = _pick_dest_node(racks[dest_rk], vid)
+                if dest is None:
+                    continue
+                m = Move(vid, sid, node_id, dest.id, "across-racks")
+                _apply_move(m, by_id)
+                moves.append(m)
+    return moves
+
+
+def _plan_within_racks(
+    nodes: list[NodeView], by_id: dict[str, NodeView]
+) -> list[Move]:
+    """Per volume, per rack: spread that volume's shards evenly across
+    the rack's servers (doBalanceEcShardsWithinOneRack)."""
+    moves: list[Move] = []
+    for rk, members in sorted(_racks(nodes).items()):
+        if len(members) < 2:
+            continue
+        vids = sorted({vid for n in members for vid in n.shards})
+        for vid in vids:
+            held = [(n, sorted(n.shards.get(vid, ()))) for n in members]
+            total = sum(len(s) for _, s in held)
+            if total == 0:
+                continue
+            avg = -(-total // len(members))  # ceil
+            for n, sids in held:
+                for sid in sids[avg:]:
+                    candidates = [
+                        c
+                        for c in members
+                        if c is not n and len(c.shards.get(vid, ())) < avg
+                    ]
+                    dest = _pick_dest_node(candidates, vid)
+                    if dest is None:
+                        continue
+                    m = Move(vid, sid, n.id, dest.id, "within-rack")
+                    _apply_move(m, by_id)
+                    moves.append(m)
+    return moves
+
+
+def _plan_rack_totals(
+    nodes: list[NodeView], by_id: dict[str, NodeView]
+) -> list[Move]:
+    """Flatten TOTAL per-server shard counts inside each rack without
+    disturbing per-volume spread: only move a volume the destination
+    doesn't already hold (balanceEcRack)."""
+    moves: list[Move] = []
+    for rk, members in sorted(_racks(nodes).items()):
+        if len(members) < 2:
+            continue
+        total = sum(n.shard_count() for n in members)
+        avg = total / len(members)
+        for _ in range(256):
+            members_sorted = sorted(
+                members, key=lambda n: (n.shard_count(), n.id)
+            )
+            low, high = members_sorted[0], members_sorted[-1]
+            if not (
+                high.shard_count() > avg
+                and low.shard_count() + 1 <= avg
+            ):
+                break
+            movable = [
+                (vid, sid)
+                for vid, sids in sorted(high.shards.items())
+                for sid in sorted(sids)
+                if vid not in low.shards
+            ]
+            if not movable or low.free_slots <= 0:
+                break
+            vid, sid = movable[0]
+            m = Move(vid, sid, high.id, low.id, "rack-total")
+            _apply_move(m, by_id)
+            moves.append(m)
+    return moves

@@ -39,6 +39,7 @@ class _Handle:
         "chunks",
         "size",
         "base",
+        "trunc",
         "dirty",
         "refs",
         "lock",
@@ -50,6 +51,7 @@ class _Handle:
         self.chunks: list = []  # uploaded, not yet committed
         self.size = size  # logical file size incl. dirty writes
         self.base = base  # a committed entry exists on the filer
+        self.trunc = None  # lowest truncation point since last commit
         self.dirty = not base
         self.refs = 1
         self.lock = threading.Lock()
@@ -284,6 +286,21 @@ class FilerMount:
             )
             if not r.error:
                 base = r.entry
+                if h.trunc is not None:
+                    # truncation must clamp the BASE state: file_size
+                    # alone can't hide interior stale bytes (a shrink
+                    # followed by writes past the cut would otherwise
+                    # resurface old chunk data where POSIX wants zeros)
+                    base.content = base.content[: h.trunc]
+                    kept = []
+                    for c in base.chunks:
+                        if c.offset >= h.trunc:
+                            continue
+                        if c.offset + c.size > h.trunc:
+                            c.size = h.trunc - c.offset
+                        kept.append(c)
+                    del base.chunks[:]
+                    base.chunks.extend(kept)
                 if base.content and not h.chunks:
                     # tiny committed file: apply truncation to the
                     # inline bytes — read_entry serves content verbatim,
@@ -322,6 +339,7 @@ class FilerMount:
             raise OSError(errno.EIO, f"commit {h.path}: {r.error}")
         h.chunks = []
         h.base = True
+        h.trunc = None
         h.dirty = False
         self._invalidate(h.path)
 
@@ -431,13 +449,15 @@ class FilerMount:
     def _ftruncate_handle(self, h: _Handle, length: int) -> int:
         with h.lock:
             h.pages.truncate(length)
-            # chunks beyond the new length are clamped by file_size at
-            # read time; shrinking below base content is handled the
-            # same way (attr.file_size rules)
             h.chunks = [c for c in h.chunks if c.offset < length]
             for c in h.chunks:
                 if c.offset + c.size > length:
                     c.size = length - c.offset
+            if length < h.size:
+                # remember the lowest cut: commit clamps the BASE
+                # entry's chunks/content to it (stale interior bytes
+                # must never resurface after a shrink)
+                h.trunc = length if h.trunc is None else min(h.trunc, length)
             h.size = length
             h.dirty = True
         return 0

@@ -1,10 +1,12 @@
-"""Master-side cluster topology: DataNodes, volume layouts, EC shard map.
+"""Master-side cluster topology: DC → rack → DataNode tree, volume
+layouts, EC shard map.
 
 Reference: weed/topology (Topology topology.go:38, VolumeLayout
-volume_layout.go, growth volume_growth.go:98) collapsed to the
-single-DC/rack scale this round; the tree deepens when multi-rack
-placement lands. Registration comes from heartbeats
-(SyncDataNodeRegistration topology.go:579, incremental :632).
+volume_layout.go, growth volume_growth.go:98). Registration comes from
+heartbeats (SyncDataNodeRegistration topology.go:579, incremental
+:632); nodes live in a nested DataCenter/Rack tree with a flat id
+index alongside. Rack-aware EC placement planning lives in
+ec/placement.py.
 """
 
 from __future__ import annotations
@@ -49,6 +51,30 @@ class DataNode:
         return max(self.max_volume_count - used, 0)
 
 
+@dataclass
+class Rack:
+    """DC → rack → DataNode tree level (reference weed/topology Rack)."""
+
+    name: str
+    nodes: dict[str, DataNode] = field(default_factory=dict)
+
+    def free_slots(self) -> int:
+        return sum(n.free_slots() for n in self.nodes.values())
+
+
+@dataclass
+class DataCenter:
+    name: str
+    racks: dict[str, Rack] = field(default_factory=dict)
+
+    def free_slots(self) -> int:
+        return sum(r.free_slots() for r in self.racks.values())
+
+    def all_nodes(self):
+        for r in self.racks.values():
+            yield from r.nodes.values()
+
+
 class Topology:
     def __init__(
         self,
@@ -60,6 +86,10 @@ class Topology:
         self.dead_after = dead_after
         self._lock = threading.RLock()
         self.nodes: dict[str, DataNode] = {}
+        # nested tree view (reference Topology: DC -> rack -> node);
+        # self.nodes stays the flat id index into the same DataNode
+        # objects
+        self.data_centers: dict[str, DataCenter] = {}
         self.max_volume_id = 0
         if sequencer is None:
             # snowflake: needle ids must survive master restarts — a
@@ -219,9 +249,30 @@ class Topology:
                     max_volume_count=int(hb.max_volume_count) or 8,
                 )
                 self.nodes[node_id] = node
+                self._tree_add_locked(node)
             if hb.max_volume_count:
                 node.max_volume_count = int(hb.max_volume_count)
             return node
+
+    def _tree_add_locked(self, node: DataNode) -> None:
+        dc = self.data_centers.setdefault(
+            node.data_center, DataCenter(node.data_center)
+        )
+        rack = dc.racks.setdefault(node.rack, Rack(node.rack))
+        rack.nodes[node.node_id] = node
+
+    def _tree_remove_locked(self, node: DataNode) -> None:
+        dc = self.data_centers.get(node.data_center)
+        if dc is None:
+            return
+        rack = dc.racks.get(node.rack)
+        if rack is None:
+            return
+        rack.nodes.pop(node.node_id, None)
+        if not rack.nodes:
+            dc.racks.pop(node.rack, None)
+        if not dc.racks:
+            self.data_centers.pop(node.data_center, None)
 
     def unregister_node(self, node_id: str, owner_token: object = None) -> None:
         """With `owner_token`, remove only if that stream still owns the
@@ -233,6 +284,7 @@ class Topology:
             if owner_token is not None and node.owner_token is not owner_token:
                 return
             self.nodes.pop(node_id, None)
+            self._tree_remove_locked(node)
             self._node_delta_locked(node, gone=True)
 
     def collections(self) -> list[str]:
@@ -251,6 +303,7 @@ class Topology:
             dead = [nid for nid, n in self.nodes.items() if n.last_seen < cutoff]
             for nid in dead:
                 node = self.nodes.pop(nid)
+                self._tree_remove_locked(node)
                 self._node_delta_locked(node, gone=True)
             return dead
 
@@ -350,17 +403,23 @@ class Topology:
             return []
         x, y, z = rp.diff_data_centers, rp.diff_racks, rp.same_rack
 
-        def distinct(nodes, key, count):
-            """One node per distinct key — each diff-DC/diff-rack copy
-            must land on a DIFFERENT DC/rack. None = unsatisfiable."""
+        def pick_per_group(groups, count, exclude_key):
+            """One available node per distinct group — each diff-DC /
+            diff-rack copy must land on a DIFFERENT DC/rack. None =
+            unsatisfiable. Groups ordered by aggregate free slots."""
             if count == 0:
                 return []
-            picked, seen = [], set()
-            for n in nodes:
-                if key(n) in seen:
+            picked = []
+            for key, members in sorted(
+                groups.items(),
+                key=lambda kv: -sum(n.free_slots() for n in kv[1]),
+            ):
+                if key == exclude_key:
                     continue
-                seen.add(key(n))
-                picked.append(n)
+                avail = [n for n in members if n.free_slots() > 0]
+                if not avail:
+                    continue
+                picked.append(max(avail, key=lambda n: n.free_slots()))
                 if len(picked) == count:
                     return picked
             return None
@@ -373,27 +432,30 @@ class Topology:
             if len(avail) < 1 + x + y + z:
                 return []
             for primary in avail:
-                rest = [n for n in avail if n is not primary]
+                dc = self.data_centers.get(primary.data_center)
+                if dc is None:
+                    continue
+                rack = dc.racks.get(primary.rack)
                 same_rack = [
                     n
-                    for n in rest
-                    if n.rack == primary.rack
-                    and n.data_center == primary.data_center
+                    for n in (rack.nodes.values() if rack else ())
+                    if n is not primary and n.free_slots() > 0
                 ]
-                other_rack = distinct(
-                    (
-                        n
-                        for n in rest
-                        if n.rack != primary.rack
-                        and n.data_center == primary.data_center
-                    ),
-                    key=lambda n: n.rack,
+                other_rack = pick_per_group(
+                    {
+                        rk: list(r.nodes.values())
+                        for rk, r in dc.racks.items()
+                    },
                     count=y,
+                    exclude_key=primary.rack,
                 )
-                other_dc = distinct(
-                    (n for n in rest if n.data_center != primary.data_center),
-                    key=lambda n: n.data_center,
+                other_dc = pick_per_group(
+                    {
+                        dk: list(d.all_nodes())
+                        for dk, d in self.data_centers.items()
+                    },
                     count=x,
+                    exclude_key=primary.data_center,
                 )
                 if (
                     len(same_rack) >= z

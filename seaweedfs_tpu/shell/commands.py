@@ -693,95 +693,136 @@ def volume_fix_replication(env: ShellEnv, args) -> str:
     return "\n".join(fixed) or "all volumes sufficiently replicated"
 
 
-@command("ec.balance", "spread EC shards evenly across nodes", mutating=True)
+@command("ec.balance", "spread EC shards evenly across racks and nodes", mutating=True)
 def ec_balance(env: ShellEnv, args) -> str:
-    """Even out shard counts per node (reference command_ec_common.go:60
-    balance algorithm, single-rack form: move shards from the most-loaded
-    node to the least-loaded until within one)."""
+    """Rack-aware balance (reference command_ec_common.go:60 EcBalance):
+    dedupe shard copies, spread each volume across racks, even within
+    racks, then flatten per-rack totals — planned by ec/placement.py,
+    executed here as copy+mount / unmount+delete pairs."""
+    from ..ec.placement import NodeView, plan_ec_balance
+
     p = argparse.ArgumentParser(prog="ec.balance")
     p.add_argument("-collection", default="")
+    p.add_argument("-dryRun", action="store_true")
     a = p.parse_args(args)
     topo = env.master.topology()
     nodes = {n.id: n for n in topo.nodes}
     if len(nodes) < 2:
         return "nothing to balance (fewer than 2 nodes)"
-    # shard sets per node per volume; each volume keeps its own collection
-    load: dict[str, dict[int, list[int]]] = {nid: {} for nid in nodes}
     vol_collection: dict[int, str] = {}
+    views = []
     for n in topo.nodes:
+        shards: dict[int, set[int]] = {}
+        all_shards = 0  # every collection counts against capacity
         for e in n.ec_shards:
+            all_shards += bin(e.shard_bits).count("1")
             if a.collection and e.collection != a.collection:
                 continue
-            sids = [i for i in range(32) if e.shard_bits & (1 << i)]
-            load[n.id][e.id] = sids
+            shards[e.id] = {i for i in range(32) if e.shard_bits & (1 << i)}
             vol_collection[e.id] = e.collection
-    racks = {n.id: (n.data_center, n.rack) for n in topo.nodes}
-    moves = []
-    for _ in range(256):
-        counts = {
-            nid: sum(len(s) for s in vols.values()) for nid, vols in load.items()
-        }
-        src_id = max(counts, key=counts.get)
-        # least-loaded destination; ties broken toward a DIFFERENT rack
-        # than the source so shard loss domains spread (reference
-        # ec.balance racks-then-servers ordering)
-        min_count = min(counts.values())
-        candidates = [nid for nid, c in counts.items() if c == min_count]
-        dst_id = min(
-            candidates,
-            key=lambda nid: (racks.get(nid) == racks.get(src_id), nid),
+        views.append(
+            NodeView(
+                id=n.id,
+                rack=n.rack,
+                data_center=n.data_center,
+                # shard-granular capacity: unused volume slots x 10
+                # minus shards already placed (any collection)
+                free_slots=max(
+                    (int(n.max_volume_count or 8) - len(n.volumes)) * 10
+                    - all_shards,
+                    0,
+                ),
+                shards=shards,
+            )
         )
-        if counts[src_id] - counts[dst_id] <= 1:
-            break
-        # pick a shard on src for a volume where dst holds fewest shards
-        vid, sids = max(
-            load[src_id].items(),
-            key=lambda kv: len(kv[1]) - len(load[dst_id].get(kv[0], [])),
-        )
-        sid = sids[0]
-        col = vol_collection.get(vid, "")
-        src_n, dst_n = nodes[src_id], nodes[dst_id]
-        src_grpc = f"{src_n.location.url.split(':')[0]}:{src_n.location.grpc_port}"
-        with volume_lease(env, vid):
-            with grpc.insecure_channel(
-                f"{dst_n.location.url.split(':')[0]}:{dst_n.location.grpc_port}"
-            ) as ch:
-                stub = rpc.Stub(ch, rpc.VOLUME_SERVICE)
-                stub.VolumeEcShardsCopy(
-                    pb.EcShardsCopyRequest(
-                        volume_id=vid,
-                        collection=col,
-                        shard_ids=[sid],
-                        source_url=src_grpc,
-                        copy_ecx=vid not in load[dst_id],
-                        copy_ecj=vid not in load[dst_id],
-                        copy_vif=vid not in load[dst_id],
-                        copy_ecsum=vid not in load[dst_id],
-                    ),
-                    timeout=3600,
-                )
-                stub.VolumeEcShardsMount(
-                    pb.EcShardsMountRequest(volume_id=vid, collection=col),
-                    timeout=60,
-                )
-            with grpc.insecure_channel(src_grpc) as ch:
+    drops, moves = plan_ec_balance(views)
+    if a.dryRun:
+        return "\n".join(
+            [f"drop ec {d.vid}.{d.shard_id:02d} on {d.node}" for d in drops]
+            + [
+                f"move ec {m.vid}.{m.shard_id:02d}: {m.src} -> {m.dst} ({m.reason})"
+                for m in moves
+            ]
+        ) or "already balanced"
+
+    def _grpc_addr(nid: str) -> str:
+        n = nodes[nid]
+        return f"{n.location.url.split(':')[0]}:{n.location.grpc_port}"
+
+    out = []
+    for d in drops:
+        with volume_lease(env, d.vid):
+            with grpc.insecure_channel(_grpc_addr(d.node)) as ch:
                 stub = rpc.Stub(ch, rpc.VOLUME_SERVICE)
                 stub.VolumeEcShardsUnmount(
-                    pb.EcShardsUnmountRequest(volume_id=vid, shard_ids=[sid]),
+                    pb.EcShardsUnmountRequest(
+                        volume_id=d.vid, shard_ids=[d.shard_id]
+                    ),
                     timeout=60,
                 )
                 stub.VolumeEcShardsDelete(
                     pb.EcShardsDeleteRequest(
-                        volume_id=vid, collection=col, shard_ids=[sid]
+                        volume_id=d.vid,
+                        collection=vol_collection.get(d.vid, ""),
+                        shard_ids=[d.shard_id],
                     ),
                     timeout=60,
                 )
-        sids.remove(sid)
-        if not sids:
-            del load[src_id][vid]
-        load[dst_id].setdefault(vid, []).append(sid)
-        moves.append(f"ec {vid}.{sid:02d}: {src_id} -> {dst_id}")
-    return "\n".join(moves) or "already balanced"
+        out.append(f"dedupe ec {d.vid}.{d.shard_id:02d} on {d.node}")
+    # live per-(node, vid) shard counts: drops and move-sources remove
+    # entries (a node whose last shard left also lost its .ecx — the
+    # next copy TO it must bring the index files again)
+    shard_count: dict[tuple[str, int], int] = {}
+    for n in topo.nodes:
+        for e in n.ec_shards:
+            shard_count[(n.id, e.id)] = bin(e.shard_bits).count("1")
+    for d in drops:
+        k = (d.node, d.vid)
+        shard_count[k] = max(shard_count.get(k, 1) - 1, 0)
+    for m in moves:
+        col = vol_collection.get(m.vid, "")
+        first_on_dst = shard_count.get((m.dst, m.vid), 0) == 0
+        with volume_lease(env, m.vid):
+            with grpc.insecure_channel(_grpc_addr(m.dst)) as ch:
+                stub = rpc.Stub(ch, rpc.VOLUME_SERVICE)
+                stub.VolumeEcShardsCopy(
+                    pb.EcShardsCopyRequest(
+                        volume_id=m.vid,
+                        collection=col,
+                        shard_ids=[m.shard_id],
+                        source_url=_grpc_addr(m.src),
+                        copy_ecx=first_on_dst,
+                        copy_ecj=first_on_dst,
+                        copy_vif=first_on_dst,
+                        copy_ecsum=first_on_dst,
+                    ),
+                    timeout=3600,
+                )
+                stub.VolumeEcShardsMount(
+                    pb.EcShardsMountRequest(volume_id=m.vid, collection=col),
+                    timeout=60,
+                )
+            with grpc.insecure_channel(_grpc_addr(m.src)) as ch:
+                stub = rpc.Stub(ch, rpc.VOLUME_SERVICE)
+                stub.VolumeEcShardsUnmount(
+                    pb.EcShardsUnmountRequest(
+                        volume_id=m.vid, shard_ids=[m.shard_id]
+                    ),
+                    timeout=60,
+                )
+                stub.VolumeEcShardsDelete(
+                    pb.EcShardsDeleteRequest(
+                        volume_id=m.vid, collection=col, shard_ids=[m.shard_id]
+                    ),
+                    timeout=60,
+                )
+        shard_count[(m.dst, m.vid)] = shard_count.get((m.dst, m.vid), 0) + 1
+        ks = (m.src, m.vid)
+        shard_count[ks] = max(shard_count.get(ks, 1) - 1, 0)
+        out.append(
+            f"ec {m.vid}.{m.shard_id:02d}: {m.src} -> {m.dst} ({m.reason})"
+        )
+    return "\n".join(out) or "already balanced"
 
 
 @command("volume.scrub", "-volumeId N (CRC-verify all live needles)")

@@ -152,18 +152,26 @@ def fetch_object(url: str, dest_path: str) -> int:
 
     tmp = f"{dest_path}.fetch.{os.getpid()}.{os.urandom(4).hex()}"
     n = 0
-    with requests.get(url, stream=True, timeout=3600) as r:
-        if r.status_code != 200:
-            raise BackendError(
-                f"cold-tier download {url}: HTTP {r.status_code}"
-            )
-        with open(tmp, "wb") as f:
-            for piece in r.iter_content(_CHUNK):
-                f.write(piece)
-                n += len(piece)
-            f.flush()
-            os.fsync(f.fileno())
-    os.replace(tmp, dest_path)
+    try:
+        with requests.get(url, stream=True, timeout=3600) as r:
+            if r.status_code != 200:
+                raise BackendError(
+                    f"cold-tier download {url}: HTTP {r.status_code}"
+                )
+            with open(tmp, "wb") as f:
+                for piece in r.iter_content(_CHUNK):
+                    f.write(piece)
+                    n += len(piece)
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, dest_path)
+    except BaseException:
+        # a failed stream must not leak a partial multi-GB temp
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     fsync_dir(dest_path)
     return n
 

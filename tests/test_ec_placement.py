@@ -1,0 +1,142 @@
+"""Rack-aware EC placement planner tests (reference
+weed/shell/command_ec_*_test.go style: synthetic topologies in, move
+plans out) plus the nested DC/rack topology tree."""
+
+from seaweedfs_tpu.ec.placement import Drop, Move, NodeView, plan_ec_balance
+
+
+def _shards(vid, *sids):
+    return {vid: set(sids)}
+
+
+def test_dedupe_drops_extra_copies():
+    nodes = [
+        NodeView("a", rack="r1", shards={1: {0, 1}}),
+        NodeView("b", rack="r1", shards={1: {1, 2}}),  # shard 1 duplicated
+    ]
+    drops, moves = plan_ec_balance(nodes)
+    assert Drop(1, 1, "b") in drops or Drop(1, 1, "a") in drops
+    assert len(drops) == 1
+    # post-dedupe state holds exactly one copy of each shard
+    holders = [n for n in nodes if 1 in n.shards and 1 in n.shards[1]]
+    assert len(holders) == 1
+
+
+def test_shards_spread_across_racks_proportionally():
+    """All 14 shards start on one rack; three racks -> no rack may keep
+    more than ceil(14/3)=5."""
+    nodes = [
+        NodeView("a1", rack="r1", shards={7: set(range(14))}),
+        NodeView("a2", rack="r1"),
+        NodeView("b1", rack="r2"),
+        NodeView("b2", rack="r2"),
+        NodeView("c1", rack="r3"),
+    ]
+    drops, moves = plan_ec_balance(nodes)
+    assert not drops
+    per_rack = {}
+    for n in nodes:
+        per_rack[n.rack] = per_rack.get(n.rack, 0) + len(n.shards.get(7, ()))
+    assert sum(per_rack.values()) == 14
+    assert max(per_rack.values()) <= 5
+    assert min(per_rack.values()) >= 4  # 14 over 3 racks: 5/5/4
+    # within each rack, servers are even too
+    for n in nodes:
+        assert len(n.shards.get(7, ())) <= 5
+
+
+def test_destination_prefers_rack_with_fewest_volume_shards():
+    nodes = [
+        NodeView("src", rack="r1", shards={3: set(range(10))}),
+        NodeView("b", rack="r2", shards={3: {10, 11, 12, 13}}),
+        NodeView("c", rack="r3"),  # empty rack: must be preferred
+    ]
+    _, moves = plan_ec_balance(nodes)
+    to_c = [m for m in moves if m.dst == "c"]
+    assert to_c, "empty rack r3 must receive shards"
+    # r2 already holds 4 — overflow should flow to r3 first
+    first_dst = moves[0].dst
+    assert first_dst == "c"
+
+
+def test_within_rack_evening():
+    nodes = [
+        NodeView("a1", rack="r1", shards={5: {0, 1, 2, 3}}),
+        NodeView("a2", rack="r1"),
+    ]
+    _, moves = plan_ec_balance(nodes)
+    assert all(m.reason == "within-rack" for m in moves)
+    assert len(nodes[0].shards[5]) == 2 and len(nodes[1].shards[5]) == 2
+
+
+def test_rack_total_flattening_preserves_volume_spread():
+    """Totals inside a rack flatten by moving a volume the destination
+    does NOT hold (reference balanceEcRack)."""
+    nodes = [
+        NodeView("a1", rack="r1", shards={1: {0}, 2: {0}, 3: {0}, 4: {0}}),
+        NodeView("a2", rack="r1", shards={5: {0}}),
+    ]
+    _, moves = plan_ec_balance(nodes)
+    for m in moves:
+        assert m.reason == "rack-total"
+        assert m.vid != 5  # never stack a volume onto a holder
+    c1, c2 = nodes[0].shard_count(), nodes[1].shard_count()
+    assert abs(c1 - c2) <= 1
+
+
+def test_no_moves_when_balanced():
+    nodes = [
+        NodeView("a", rack="r1", shards={9: {0, 1, 2}}),
+        NodeView("b", rack="r2", shards={9: {3, 4, 5}}),
+        NodeView("c", rack="r3", shards={9: {6, 7}}),
+    ]
+    drops, moves = plan_ec_balance(nodes)
+    assert not drops and not moves
+
+
+def test_full_slots_are_skipped():
+    nodes = [
+        NodeView("a", rack="r1", shards={1: set(range(14))}),
+        NodeView("b", rack="r2", free_slots=0),
+    ]
+    _, moves = plan_ec_balance(nodes)
+    assert all(m.dst != "b" for m in moves)
+
+
+def test_multi_dc_racks_are_distinct():
+    """Same rack name in two DCs must count as two racks."""
+    nodes = [
+        NodeView("a", data_center="dc1", rack="r", shards={1: set(range(14))}),
+        NodeView("b", data_center="dc2", rack="r"),
+    ]
+    _, moves = plan_ec_balance(nodes)
+    assert any(m.dst == "b" for m in moves)
+    assert len(nodes[1].shards.get(1, ())) == 7
+
+
+# ------------------------------------------------------- topology tree
+
+
+def test_topology_tree_registration():
+    from seaweedfs_tpu.pb import cluster_pb2 as pb
+    from seaweedfs_tpu.server.topology import Topology
+
+    topo = Topology()
+    for ip, dc, rack in [
+        ("10.0.0.1", "dc1", "ra"),
+        ("10.0.0.2", "dc1", "ra"),
+        ("10.0.0.3", "dc1", "rb"),
+        ("10.0.0.4", "dc2", "ra"),
+    ]:
+        topo.register_node(
+            pb.Heartbeat(ip=ip, port=8080, data_center=dc, rack=rack)
+        )
+    assert sorted(topo.data_centers) == ["dc1", "dc2"]
+    assert sorted(topo.data_centers["dc1"].racks) == ["ra", "rb"]
+    assert len(topo.data_centers["dc1"].racks["ra"].nodes) == 2
+    assert len(list(topo.data_centers["dc2"].all_nodes())) == 1
+    # unregister prunes empty tree levels
+    topo.unregister_node("10.0.0.4:8080")
+    assert "dc2" not in topo.data_centers
+    topo.unregister_node("10.0.0.3:8080")
+    assert sorted(topo.data_centers["dc1"].racks) == ["ra"]

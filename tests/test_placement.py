@@ -21,6 +21,7 @@ def node(nid, rack="r1", dc="dc1", slots=8):
 def build(topo, nodes):
     for n in nodes:
         topo.nodes[n.node_id] = n
+        topo._tree_add_locked(n)  # plan_growth consults the DC/rack tree
 
 
 def test_replica_copies():
