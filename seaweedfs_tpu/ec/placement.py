@@ -150,20 +150,23 @@ def _plan_across_racks(
         for rk in sorted(rack_shards, key=lambda k: -len(rack_shards[k])):
             overflow = rack_shards[rk][avg:]
             for node_id, sid in overflow:
-                # destination rack: fewest shards of this volume, then
-                # most aggregate free slots (pickRackToBalanceShardsInto)
-                dest_rk = min(
+                # destination racks scored by fewest shards of this
+                # volume then aggregate free slots
+                # (pickRackToBalanceShardsInto); fall through to the
+                # next-best rack when the favorite has no capacity
+                ranked = sorted(
                     (k for k in racks if k != rk),
                     key=lambda k: (
                         sum(len(by_id[n.id].shards.get(vid, ())) for n in racks[k]),
                         -sum(n.free_slots for n in racks[k]),
                         k,
                     ),
-                    default=None,
                 )
-                if dest_rk is None:
-                    continue
-                dest = _pick_dest_node(racks[dest_rk], vid)
+                dest = None
+                for dest_rk in ranked:
+                    dest = _pick_dest_node(racks[dest_rk], vid)
+                    if dest is not None:
+                        break
                 if dest is None:
                     continue
                 m = Move(vid, sid, node_id, dest.id, "across-racks")

@@ -1,0 +1,7 @@
+"""IAM: policy engine + STS temporary credentials.
+
+Reference: weed/iam/policy (policy_engine.go), weed/iam/sts.
+"""
+
+from .policy import PolicyEngine, evaluate_policies  # noqa: F401
+from .sts import StsService  # noqa: F401

@@ -28,22 +28,44 @@ class Identity:
     access_key: str
     secret_key: str
     actions: tuple[str, ...] = ("Admin",)  # Admin|Read|Write|List|Tagging
+    # IAM policy documents (AWS JSON); when present they REPLACE the
+    # coarse action model for authorization (reference
+    # auth_credentials.go identity -> policy binding)
+    policies: tuple = ()
+    # STS temporary credentials carry a session token the request must
+    # echo in x-amz-security-token
+    session_token: str = ""
 
     def allows(self, action: str) -> bool:
         return "Admin" in self.actions or action in self.actions
 
 
 class IdentityStore:
-    def __init__(self):
+    def __init__(self, sts=None):
         self._by_access_key: dict[str, Identity] = {}
         self.allow_anonymous = True
+        self.sts = sts  # iam.StsService for temp-credential lookup
 
     def add(self, ident: Identity) -> None:
         self._by_access_key[ident.access_key] = ident
         self.allow_anonymous = False
 
     def lookup(self, access_key: str) -> Identity | None:
-        return self._by_access_key.get(access_key)
+        ident = self._by_access_key.get(access_key)
+        if ident is not None:
+            return ident
+        if self.sts is not None:
+            cred = self.sts.lookup(access_key)
+            if cred is not None:
+                return Identity(
+                    name=f"sts:{cred.role.name}",
+                    access_key=cred.access_key,
+                    secret_key=cred.secret_key,
+                    actions=(),
+                    policies=tuple(cred.role.policies),
+                    session_token=cred.session_token,
+                )
+        return None
 
     @property
     def empty(self) -> bool:
@@ -176,6 +198,10 @@ def verify_v4_ex(
     want = hmac.new(skey, sts.encode(), hashlib.sha256).hexdigest()
     if not hmac.compare_digest(want, signature):
         raise S3AuthError("SignatureDoesNotMatch", "signature mismatch")
+    if ident.session_token and not hmac.compare_digest(
+        headers.get("x-amz-security-token", "") or "", ident.session_token
+    ):
+        raise S3AuthError("InvalidToken", "missing or wrong session token")
     ctx = SigningContext(
         signing_key=skey,
         amz_date=amz_date,
@@ -278,4 +304,8 @@ def _verify_presigned(store, method, path, query, headers, q) -> Identity:
     ).hexdigest()
     if not hmac.compare_digest(want, signature):
         raise S3AuthError("SignatureDoesNotMatch", "signature mismatch")
+    if ident.session_token and not hmac.compare_digest(
+        q.get("X-Amz-Security-Token", ""), ident.session_token
+    ):
+        raise S3AuthError("InvalidToken", "missing or wrong session token")
     return ident

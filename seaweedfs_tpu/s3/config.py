@@ -1,0 +1,60 @@
+"""S3 gateway identity/role configuration.
+
+Reference: weed/s3api auth_credentials.go loading identities.json (the
+`-s3.config` flag) — names, key pairs, coarse actions, and IAM policy
+documents; plus STS roles.
+
+    {"identities": [
+        {"name": "admin", "accessKey": "AK", "secretKey": "SK",
+         "actions": ["Admin"]},
+        {"name": "ro", "accessKey": "AK2", "secretKey": "SK2",
+         "policies": [{"Version": "2012-10-17", "Statement": [...]}]}],
+     "roles": [
+        {"name": "uploader", "trusted": ["AK"],
+         "policies": [{"Statement": [...]}]}]}
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..iam.sts import Role, StsService
+from .auth import Identity, IdentityStore
+
+
+def load_s3_config(path: str) -> tuple[IdentityStore, StsService | None]:
+    with open(path) as f:
+        conf = json.load(f)
+    store = IdentityStore()
+    for ident in conf.get("identities", []):
+        store.add(
+            Identity(
+                name=ident.get("name", ident["accessKey"]),
+                access_key=ident["accessKey"],
+                secret_key=ident["secretKey"],
+                actions=tuple(ident.get("actions", ())) or (),
+                policies=tuple(ident.get("policies", ())),
+            )
+        )
+    sts = None
+    roles = conf.get("roles", [])
+    if roles and store.empty:
+        # roles without identities would leave the gateway in open mode
+        # (anonymous = admin) with STS credentials never verified —
+        # refuse the misconfiguration instead of silently ignoring it
+        raise ValueError(
+            f"{path}: 'roles' configured but no 'identities'; "
+            "an empty identity store runs the gateway in open mode"
+        )
+    if roles:
+        sts = StsService()
+        for r in roles:
+            sts.put_role(
+                Role(
+                    name=r["name"],
+                    policies=list(r.get("policies", [])),
+                    trusted=list(r.get("trusted", ["*"])),
+                )
+            )
+        store.sts = sts
+    return store, sts

@@ -79,12 +79,18 @@ class S3Server:
         identities: IdentityStore | None = None,
         region: str = "us-east-1",
         lifecycle_interval: float = 3600.0,
+        sts=None,
     ):
         self.filer = filer
         self.ip = ip
         self.port = port
         self.region = region
         self.identities = identities or IdentityStore()
+        # STS service (iam.StsService): AssumeRole on the service
+        # endpoint + temp-credential lookup during SigV4 auth
+        self.sts_service = sts
+        if sts is not None and self.identities.sts is None:
+            self.identities.sts = sts
         self._http = ThreadingHTTPServer((ip, port), self._handler_class())
         self._thread = threading.Thread(target=self._http.serve_forever, daemon=True)
         from .lifecycle import LifecycleScanner
@@ -238,12 +244,48 @@ class S3Server:
                         ident = self._auth()
                     except S3AuthError as e:
                         return self._error(403, e.code, str(e))
-                    if ident is not None and not ident.allows(
-                        _required_action(m, bucket, key)
-                    ):
-                        return self._error(
-                            403, "AccessDenied", "identity lacks permission"
+                    if bucket == "" and m == "POST":
+                        # STS rides the service endpoint (form POST
+                        # with Action=AssumeRole, reference weed/iamapi)
+                        form = dict(
+                            urllib.parse.parse_qsl(
+                                self._read_body().decode("utf-8", "replace")
+                            )
                         )
+                        if form.get("Action") == "AssumeRole":
+                            return self._sts_assume_role(ident, form)
+                        return self._error(405, "MethodNotAllowed", m)
+                    if ident is not None:
+                        if ident.policies:
+                            # full IAM policy evaluation (reference
+                            # policy_engine.go); replaces coarse actions
+                            from ..iam.policy import (
+                                evaluate_policies,
+                                s3_action_and_resource,
+                            )
+
+                            action, resource = s3_action_and_resource(
+                                m, bucket, key, q
+                            )
+                            pctx = {
+                                "aws:SourceIp": self.client_address[0],
+                                "aws:username": ident.name,
+                                "s3:prefix": q.get("prefix", ""),
+                            }
+                            if not evaluate_policies(
+                                list(ident.policies), action, resource, pctx
+                            ):
+                                return self._error(
+                                    403,
+                                    "AccessDenied",
+                                    f"{action} on {resource} denied by policy",
+                                )
+                        elif not ident.allows(
+                            _required_action(m, bucket, key)
+                        ):
+                            return self._error(
+                                403, "AccessDenied", "identity lacks permission"
+                            )
                     if bucket == "":
                         if m in ("GET", "HEAD"):
                             return self._list_buckets()
@@ -284,6 +326,63 @@ class S3Server:
                         pass
 
             do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = do_OPTIONS = _handle
+
+            # ---- sts ----
+
+            def _sts_assume_role(self, ident, form: dict):
+                if srv.sts_service is None:
+                    return self._error(400, "InvalidAction", "STS not configured")
+                if ident is None and not srv.identities.empty:
+                    return self._error(
+                        403, "AccessDenied", "anonymous cannot assume roles"
+                    )
+                role_name = (
+                    form.get("RoleArn", "").rsplit("/", 1)[-1]
+                    or form.get("RoleName", "")
+                )
+                caller_key = ident.access_key if ident else "anonymous"
+                caller_policies = (
+                    list(ident.policies) if ident and ident.policies else None
+                )
+                if (
+                    ident is not None
+                    and not ident.policies
+                    and not ident.allows("Admin")
+                ):
+                    return self._error(
+                        403, "AccessDenied", "identity cannot assume roles"
+                    )
+                try:
+                    cred = srv.sts_service.assume_role(
+                        caller_key,
+                        caller_policies,
+                        role_name,
+                        int(form.get("DurationSeconds", "3600") or "3600"),
+                    )
+                except PermissionError as e:
+                    return self._error(403, "AccessDenied", str(e))
+                except ValueError:
+                    return self._error(400, "InvalidParameterValue", "duration")
+                root = ET.Element(
+                    "AssumeRoleResponse",
+                    xmlns="https://sts.amazonaws.com/doc/2011-06-15/",
+                )
+                res = _el(root, "AssumeRoleResult")
+                c = _el(res, "Credentials")
+                _el(c, "AccessKeyId", cred.access_key)
+                _el(c, "SecretAccessKey", cred.secret_key)
+                _el(c, "SessionToken", cred.session_token)
+                _el(
+                    c,
+                    "Expiration",
+                    time.strftime(
+                        "%Y-%m-%dT%H:%M:%SZ", time.gmtime(cred.expires_at)
+                    ),
+                )
+                u = _el(res, "AssumedRoleUser")
+                _el(u, "Arn", cred.role.arn)
+                _el(u, "AssumedRoleId", f"{cred.access_key}:{role_name}")
+                self._respond(200, _xml(root))
 
             # ---- cors ----
 

@@ -77,6 +77,11 @@ def main(argv=None) -> int:
     s.add_argument("-s3", action="store_true", help="also run the S3 gateway")
     s.add_argument("-s3Port", type=int, default=8333)
     s.add_argument("-s3AccessKey", default="")
+    s.add_argument(
+        "-s3Config",
+        default="",
+        help="identities/roles JSON (reference -s3.config identities.json)",
+    )
     s.add_argument("-s3SecretKey", default="")
     s.add_argument("-dir", action="append", required=True)
     s.add_argument("-max", type=int, default=8)
@@ -211,10 +216,18 @@ def main(argv=None) -> int:
         if a.mode == "server" and a.s3:
             from ..s3 import Identity, IdentityStore, S3Server
 
-            idents = IdentityStore()
+            sts = None
+            if getattr(a, "s3Config", ""):
+                from ..s3.config import load_s3_config
+
+                idents, sts = load_s3_config(a.s3Config)
+            else:
+                idents = IdentityStore()
             if a.s3AccessKey:
                 idents.add(Identity("admin", a.s3AccessKey, a.s3SecretKey))
-            s3srv = S3Server(filer, ip=a.ip, port=a.s3Port, identities=idents)
+            s3srv = S3Server(
+                filer, ip=a.ip, port=a.s3Port, identities=idents, sts=sts
+            )
             s3srv.start()
             servers.append(s3srv)
             log.info("s3 gateway on %s:%s", a.ip, a.s3Port)

@@ -1,0 +1,414 @@
+"""IAM policy engine + STS tests.
+
+Reference models: weed/iam/policy/policy_engine_test.go (wildcards,
+deny-wins, conditions) and weed/iam/sts tests; gateway-level
+enforcement mirrors test/s3/iam.
+"""
+
+import datetime
+import hashlib
+import hmac
+import time
+import urllib.parse
+
+import pytest
+import requests
+
+from seaweedfs_tpu.filer import Filer, MemoryStore
+from seaweedfs_tpu.iam.policy import (
+    PolicyEngine,
+    evaluate_policies,
+    s3_action_and_resource,
+)
+from seaweedfs_tpu.iam.sts import Role, StsService
+from seaweedfs_tpu.s3 import Identity, IdentityStore, S3Server
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+from conftest import allocate_port as free_port
+
+REGION = "us-east-1"
+
+
+# --------------------------------------------------------- policy engine
+
+
+def _doc(*statements):
+    return {"Version": "2012-10-17", "Statement": list(statements)}
+
+
+def test_allow_with_wildcards():
+    doc = _doc(
+        {
+            "Effect": "Allow",
+            "Action": "s3:Get*",
+            "Resource": "arn:aws:s3:::logs/*",
+        }
+    )
+    assert evaluate_policies([doc], "s3:GetObject", "arn:aws:s3:::logs/a/b")
+    assert not evaluate_policies([doc], "s3:PutObject", "arn:aws:s3:::logs/a")
+    assert not evaluate_policies([doc], "s3:GetObject", "arn:aws:s3:::other/a")
+
+
+def test_explicit_deny_wins():
+    doc = _doc(
+        {"Effect": "Allow", "Action": "s3:*", "Resource": "*"},
+        {
+            "Effect": "Deny",
+            "Action": "s3:DeleteObject",
+            "Resource": "arn:aws:s3:::prod/*",
+        },
+    )
+    assert evaluate_policies([doc], "s3:DeleteObject", "arn:aws:s3:::dev/x")
+    assert not evaluate_policies([doc], "s3:DeleteObject", "arn:aws:s3:::prod/x")
+    # deny in ONE doc beats allow in another
+    allow_all = _doc({"Effect": "Allow", "Action": "*", "Resource": "*"})
+    deny = _doc({"Effect": "Deny", "Action": "s3:PutObject", "Resource": "*"})
+    assert not evaluate_policies([allow_all, deny], "s3:PutObject", "x")
+
+
+def test_implicit_deny():
+    assert not evaluate_policies([], "s3:GetObject", "arn:aws:s3:::b/k")
+    doc = _doc({"Effect": "Allow", "Action": "s3:GetObject", "Resource": "*"})
+    assert not evaluate_policies([doc], "s3:ListBucket", "arn:aws:s3:::b")
+
+
+def test_conditions():
+    doc = _doc(
+        {
+            "Effect": "Allow",
+            "Action": "s3:GetObject",
+            "Resource": "*",
+            "Condition": {"IpAddress": {"aws:SourceIp": "10.0.0.0/8"}},
+        }
+    )
+    assert evaluate_policies(
+        [doc], "s3:GetObject", "x", {"aws:SourceIp": "10.1.2.3"}
+    )
+    assert not evaluate_policies(
+        [doc], "s3:GetObject", "x", {"aws:SourceIp": "192.168.1.1"}
+    )
+    assert not evaluate_policies([doc], "s3:GetObject", "x", {})  # no context
+    like = _doc(
+        {
+            "Effect": "Allow",
+            "Action": "s3:ListBucket",
+            "Resource": "*",
+            "Condition": {"StringLike": {"s3:prefix": ["reports/*", ""]}},
+        }
+    )
+    assert evaluate_policies(
+        [like], "s3:ListBucket", "x", {"s3:prefix": "reports/2026"}
+    )
+    assert not evaluate_policies(
+        [like], "s3:ListBucket", "x", {"s3:prefix": "secrets/"}
+    )
+    # unknown condition operator fails closed
+    weird = _doc(
+        {
+            "Effect": "Allow",
+            "Action": "*",
+            "Resource": "*",
+            "Condition": {"QuantumEquals": {"x": "y"}},
+        }
+    )
+    assert not evaluate_policies([weird], "s3:GetObject", "x", {"x": "y"})
+
+
+def test_not_action_and_not_resource():
+    """The AWS read-only pattern: Deny everything that is NOT a read."""
+    doc = _doc(
+        {"Effect": "Allow", "Action": "s3:*", "Resource": "*"},
+        {"Effect": "Deny", "NotAction": ["s3:Get*", "s3:List*"], "Resource": "*"},
+    )
+    assert evaluate_policies([doc], "s3:GetObject", "arn:aws:s3:::b/k")
+    assert not evaluate_policies([doc], "s3:PutObject", "arn:aws:s3:::b/k")
+    assert not evaluate_policies([doc], "s3:DeleteObject", "arn:aws:s3:::b/k")
+    nr = _doc(
+        {
+            "Effect": "Allow",
+            "Action": "s3:GetObject",
+            "NotResource": "arn:aws:s3:::secret/*",
+        }
+    )
+    assert evaluate_policies([nr], "s3:GetObject", "arn:aws:s3:::open/x")
+    assert not evaluate_policies([nr], "s3:GetObject", "arn:aws:s3:::secret/x")
+
+
+def test_roles_only_config_rejected(tmp_path):
+    import json as _json
+
+    from seaweedfs_tpu.s3.config import load_s3_config
+
+    p = tmp_path / "conf.json"
+    p.write_text(_json.dumps({"roles": [{"name": "r", "policies": []}]}))
+    with pytest.raises(ValueError):
+        load_s3_config(str(p))
+
+
+def test_across_racks_falls_back_when_best_rack_full():
+    from seaweedfs_tpu.ec.placement import NodeView, plan_ec_balance
+
+    nodes = [
+        NodeView("a", rack="r1", shards={1: set(range(14))}),
+        NodeView("b", rack="r2", free_slots=0),  # favorite but full
+        NodeView("c", rack="r3", shards={1: set()}, free_slots=50),
+    ]
+    _, moves = plan_ec_balance(nodes)
+    assert any(m.dst == "c" for m in moves)
+    assert all(m.dst != "b" for m in moves)
+
+
+def test_policy_engine_registry():
+    eng = PolicyEngine()
+    eng.put_policy(
+        "ro", _doc({"Effect": "Allow", "Action": "s3:Get*", "Resource": "*"})
+    )
+    assert eng.is_allowed(["ro"], "s3:GetObject", "arn:aws:s3:::b/k")
+    assert not eng.is_allowed(["ro"], "s3:PutObject", "arn:aws:s3:::b/k")
+    assert not eng.is_allowed(["missing"], "s3:GetObject", "x")
+    assert eng.names() == ["ro"]
+
+
+def test_s3_action_mapping():
+    assert s3_action_and_resource("GET", "b", "k", {}) == (
+        "s3:GetObject",
+        "arn:aws:s3:::b/k",
+    )
+    assert s3_action_and_resource("PUT", "b", "", {}) == (
+        "s3:CreateBucket",
+        "arn:aws:s3:::b",
+    )
+    assert s3_action_and_resource("GET", "b", "", {"versions": ""})[0] == (
+        "s3:ListBucketVersions"
+    )
+    assert s3_action_and_resource("PUT", "b", "k", {"retention": ""})[0] == (
+        "s3:PutObjectRetention"
+    )
+    assert s3_action_and_resource("DELETE", "b", "k", {"versionId": "v"})[0] == (
+        "s3:DeleteObjectVersion"
+    )
+    assert s3_action_and_resource("GET", "", "", {})[0] == "s3:ListAllMyBuckets"
+
+
+# ------------------------------------------------------------------ STS
+
+
+def test_sts_assume_role_and_expiry():
+    sts = StsService()
+    sts.put_role(Role(name="uploader", policies=[_doc(
+        {"Effect": "Allow", "Action": "s3:PutObject", "Resource": "*"}
+    )]))
+    caller_pol = [_doc({"Effect": "Allow", "Action": "sts:AssumeRole", "Resource": "*"})]
+    cred = sts.assume_role("AKCALLER", caller_pol, "uploader", duration=900)
+    assert cred.access_key.startswith("ASIA")
+    assert sts.lookup(cred.access_key) is cred
+    # unknown role / denied caller
+    with pytest.raises(PermissionError):
+        sts.assume_role("AKCALLER", caller_pol, "nope")
+    with pytest.raises(PermissionError):
+        sts.assume_role("AKCALLER", [], "uploader")
+    # trusted principal gate
+    sts.put_role(Role(name="locked", trusted=["AKOTHER"]))
+    with pytest.raises(PermissionError):
+        sts.assume_role("AKCALLER", None, "locked")
+    # expiry reaps
+    cred.expires_at = time.time() - 1
+    assert sts.lookup(cred.access_key) is None
+
+
+# --------------------------------------------------------- gateway level
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("iamvol")
+    mport = free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp / "v")],
+        master=f"localhost:{mport}",
+        ip="localhost",
+        port=free_port(),
+        ec_backend="cpu",
+    )
+    vs.start()
+    while not master.topo.nodes:
+        time.sleep(0.05)
+    yield mport
+    vs.stop()
+    master.stop()
+
+
+def _sign(method, url, access_key, secret, body=b"", token=""):
+    u = urllib.parse.urlparse(url)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    date = now.strftime("%Y%m%d")
+    payload_hash = hashlib.sha256(body).hexdigest()
+    headers = {
+        "Host": u.netloc,
+        "x-amz-date": amz_date,
+        "x-amz-content-sha256": payload_hash,
+    }
+    if token:
+        headers["x-amz-security-token"] = token
+    signed = sorted(h.lower() for h in headers)
+    canon_headers = "".join(
+        f"{h}:{[v for k, v in headers.items() if k.lower() == h][0]}\n"
+        for h in signed
+    )
+    creq = "\n".join(
+        [
+            method,
+            u.path or "/",
+            "&".join(
+                f"{urllib.parse.quote(k, safe='-_.~')}={urllib.parse.quote(v, safe='-_.~')}"
+                for k, v in sorted(
+                    urllib.parse.parse_qsl(u.query, keep_blank_values=True)
+                )
+            ),
+            canon_headers,
+            ";".join(signed),
+            payload_hash,
+        ]
+    )
+    scope = f"{date}/{REGION}/s3/aws4_request"
+    sts_str = "\n".join(
+        [
+            "AWS4-HMAC-SHA256",
+            amz_date,
+            scope,
+            hashlib.sha256(creq.encode()).hexdigest(),
+        ]
+    )
+
+    def h(key, msg):
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+    k = h(("AWS4" + secret).encode(), date)
+    k = h(k, REGION)
+    k = h(k, "s3")
+    k = h(k, "aws4_request")
+    sig = hmac.new(k, sts_str.encode(), hashlib.sha256).hexdigest()
+    headers["Authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+        f"SignedHeaders={';'.join(signed)}, Signature={sig}"
+    )
+    return headers
+
+
+@pytest.fixture
+def iam_s3(cluster):
+    filer = Filer(MemoryStore(), master=f"localhost:{cluster}", chunk_size=64 * 1024)
+    ids = IdentityStore()
+    ids.add(Identity("boss", "AKBOSS", "bosssecret", actions=("Admin",)))
+    ids.add(
+        Identity(
+            "readonly",
+            "AKRO",
+            "rosecret",
+            actions=(),
+            policies=(
+                {
+                    "Version": "2012-10-17",
+                    "Statement": [
+                        {
+                            "Effect": "Allow",
+                            "Action": ["s3:GetObject", "s3:ListBucket"],
+                            "Resource": "arn:aws:s3:::pub*",
+                        }
+                    ],
+                },
+            ),
+        )
+    )
+    sts = StsService()
+    sts.put_role(
+        Role(
+            name="writer",
+            policies=[
+                {
+                    "Statement": [
+                        {
+                            "Effect": "Allow",
+                            "Action": ["s3:PutObject", "s3:GetObject",
+                                       "s3:CreateBucket"],
+                            "Resource": "*",
+                        }
+                    ]
+                }
+            ],
+        )
+    )
+    srv = S3Server(
+        filer, ip="localhost", port=free_port(), identities=ids,
+        lifecycle_interval=0, sts=sts,
+    )
+    srv.start()
+    yield f"http://localhost:{srv.port}"
+    srv.stop()
+    filer.close()
+
+
+def test_policy_enforcement_at_gateway(iam_s3):
+    url = iam_s3
+    # admin seeds a bucket + object
+    hh = _sign("PUT", f"{url}/pub", "AKBOSS", "bosssecret")
+    assert requests.put(f"{url}/pub", headers=hh).status_code == 200
+    hh = _sign("PUT", f"{url}/pub/doc", "AKBOSS", "bosssecret", body=b"data")
+    assert (
+        requests.put(f"{url}/pub/doc", headers=hh, data=b"data").status_code
+        == 200
+    )
+    # readonly identity can GET...
+    hh = _sign("GET", f"{url}/pub/doc", "AKRO", "rosecret")
+    assert requests.get(f"{url}/pub/doc", headers=hh).content == b"data"
+    # ...but not PUT (policy has no s3:PutObject)
+    hh = _sign("PUT", f"{url}/pub/new", "AKRO", "rosecret", body=b"x")
+    r = requests.put(f"{url}/pub/new", headers=hh, data=b"x")
+    assert r.status_code == 403 and "denied by policy" in r.text
+    # ...and not outside the pub* resource scope
+    hh = _sign("GET", f"{url}/private/doc", "AKRO", "rosecret")
+    assert requests.get(f"{url}/private/doc", headers=hh).status_code == 403
+
+
+def test_sts_flow_at_gateway(iam_s3):
+    url = iam_s3
+    # assume the writer role as the admin
+    body = urllib.parse.urlencode(
+        {
+            "Action": "AssumeRole",
+            "RoleArn": "arn:aws:iam:::role/writer",
+            "DurationSeconds": "900",
+        }
+    ).encode()
+    hh = _sign("POST", f"{url}/", "AKBOSS", "bosssecret", body=body)
+    r = requests.post(f"{url}/", headers=hh, data=body)
+    assert r.status_code == 200, r.text
+    import xml.etree.ElementTree as ET
+
+    doc = ET.fromstring(r.text)
+    ns = doc.tag[: doc.tag.index("}") + 1]
+    ak = doc.findtext(f".//{ns}AccessKeyId")
+    sk = doc.findtext(f".//{ns}SecretAccessKey")
+    token = doc.findtext(f".//{ns}SessionToken")
+    assert ak.startswith("ASIA")
+    # temp creds + session token can write
+    hh = _sign("PUT", f"{url}/stsbkt", ak, sk, token=token)
+    assert requests.put(f"{url}/stsbkt", headers=hh).status_code == 200
+    hh = _sign("PUT", f"{url}/stsbkt/obj", ak, sk, body=b"tmp", token=token)
+    assert (
+        requests.put(f"{url}/stsbkt/obj", headers=hh, data=b"tmp").status_code
+        == 200
+    )
+    # missing session token -> rejected even with the right signature
+    hh = _sign("PUT", f"{url}/stsbkt/obj2", ak, sk, body=b"x")
+    assert (
+        requests.put(f"{url}/stsbkt/obj2", headers=hh, data=b"x").status_code
+        == 403
+    )
+    # the role policy has no DeleteObject -> denied
+    hh = _sign("DELETE", f"{url}/stsbkt/obj", ak, sk, token=token)
+    assert requests.delete(f"{url}/stsbkt/obj", headers=hh).status_code == 403
