@@ -45,6 +45,12 @@ def main(argv=None) -> int:
     v.add_argument("-master", default="localhost:9333")
     v.add_argument("-max", type=int, default=8)
     v.add_argument("-ec.backend", dest="ec_backend", default="auto")
+    v.add_argument(
+        "-index",
+        default="memory",
+        choices=["memory", "sqlite"],
+        help="needle map kind (sqlite = durable, O(delta) restart)",
+    )
     v.add_argument("-dataCenter", default="")
     v.add_argument("-rack", default="")
     v.add_argument("-jwt.key", dest="jwt_key", default="")
@@ -151,6 +157,7 @@ def main(argv=None) -> int:
             data_center=getattr(a, "dataCenter", ""),
             rack=getattr(a, "rack", ""),
             jwt_key=getattr(a, "jwt_key", ""),
+            needle_map_kind=getattr(a, "index", "memory"),
         )
         vs.start()
         servers.append(vs)

@@ -637,6 +637,7 @@ class VolumeServer:
         data_center: str = "",
         rack: str = "",
         jwt_key: str = "",
+        needle_map_kind: str = "memory",
     ):
         self.jwt_key = jwt_key
         self.ip = ip
@@ -659,6 +660,7 @@ class VolumeServer:
             port=port,
             ec_backend=ec_backend,
             ec_remote_reader_factory=self._remote_reader_factory,
+            needle_map_kind=needle_map_kind,
         )
         self.service = VolumeService(self)
 

@@ -30,6 +30,7 @@ class DiskLocation:
 
     directory: str
     max_volume_count: int = 0  # 0 = unlimited
+    needle_map_kind: str = "memory"
     volumes: dict[int, Volume] = field(default_factory=dict)
     ec_volumes: dict[int, EcVolume] = field(default_factory=dict)
 
@@ -43,7 +44,8 @@ class DiskLocation:
                 col = m.group("col") or ""
                 try:
                     self.volumes[vid] = Volume(
-                        self.directory, vid, collection=col, create=False
+                        self.directory, vid, collection=col, create=False,
+                        needle_map_kind=self.needle_map_kind,
                     )
                 except VolumeError:
                     continue
@@ -77,14 +79,19 @@ class Store:
         public_url: str = "",
         ec_backend: str = "auto",
         ec_remote_reader_factory=None,
+        needle_map_kind: str = "memory",
     ):
         self.ip = ip
         self.port = port
         self.public_url = public_url or f"{ip}:{port}"
         self.ec_backend = ec_backend
         self.ec_remote_reader_factory = ec_remote_reader_factory
+        self.needle_map_kind = needle_map_kind
         self._lock = threading.RLock()
-        self.locations = [DiskLocation(d) for d in directories]
+        self.locations = [
+            DiskLocation(d, needle_map_kind=needle_map_kind)
+            for d in directories
+        ]
         for loc in self.locations:
             os.makedirs(loc.directory, exist_ok=True)
             loc.load_existing(ec_backend, ec_remote_reader_factory)
@@ -140,6 +147,7 @@ class Store:
                 collection=collection,
                 replica_placement=replica_placement,
                 ttl=ttl,
+                needle_map_kind=self.needle_map_kind,
             )
             loc.volumes[vid] = v
             return v
@@ -168,7 +176,10 @@ class Store:
                 if v is not None:
                     v.close()
                     base = v.dat_path[:-4]
-                    exts = [".dat", ".idx", ".cpd", ".cpx"]
+                    exts = [
+                        ".dat", ".idx", ".cpd", ".cpx",
+                        ".idx.ldb", ".idx.ldb-wal", ".idx.ldb-shm",
+                    ]
                     # .vif/.ecsum describe the EC artifacts too: keep them
                     # while EC files coexist (reference Destroy behavior,
                     # volume_destroy_ec_vif_test.go).
@@ -192,7 +203,10 @@ class Store:
             for loc in self.locations:
                 base = Volume.base_file_name(loc.directory, collection, vid)
                 if os.path.exists(base + ".dat"):
-                    v = Volume(loc.directory, vid, collection=collection, create=False)
+                    v = Volume(
+                        loc.directory, vid, collection=collection,
+                        create=False, needle_map_kind=self.needle_map_kind,
+                    )
                     loc.volumes[vid] = v
                     return v
         raise NotFoundError(f"no volume files for {vid} in any location")

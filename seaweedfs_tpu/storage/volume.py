@@ -79,10 +79,15 @@ class Volume:
         version: int = CURRENT_VERSION,
         create: bool = True,
         ttl: str = "",
+        needle_map_kind: str = "memory",
     ):
+        """needle_map_kind: "memory" (reference default — replay .idx
+        into RAM) or "sqlite" (LevelDB-class durable map: O(delta)
+        reopen, bounded RAM; reference needle_map_leveldb.go)."""
         self.volume_id = volume_id
         self.collection = collection
         self.directory = directory
+        self.needle_map_kind = needle_map_kind
         self.read_only = False
         # Poisoned by an unfinishable vacuum commit (half-swapped pair
         # on disk): all IO refuses until the volume is reopened, at
@@ -96,6 +101,8 @@ class Volume:
         self.vif_path = base + ".vif"
         self._remote = None  # BackendStorageFile when cold-tiered
         self._tiering = False  # a tier transfer is in flight
+        self._vacuuming = False  # a live vacuum is in flight
+        self._vacuum_ro_override = None  # set_read_only during vacuum
         self._reconcile_vacuum_marker(base)
         exists = os.path.exists(self.dat_path)
         if not exists:
@@ -127,7 +134,7 @@ class Volume:
         # expiry clock for whole-volume reaping; reopen restarts the
         # window (conservative: never reaps early)
         self._last_write_ts = time.time()
-        self.needle_map = MemoryNeedleMap(self.idx_path)
+        self.needle_map = self._new_map()
         self._dat = open(self.dat_path, "r+b")
         self._dat.seek(0, os.SEEK_END)
         self._append_at = self._pad_tail()
@@ -144,7 +151,7 @@ class Volume:
         self.version = self.super_block.version
         self.ttl = TTL.from_bytes(self.super_block.ttl)
         self._last_write_ts = time.time()
-        self.needle_map = MemoryNeedleMap(self.idx_path)
+        self.needle_map = self._new_map()
         self._dat = None
         self._append_at = vif.tier_size
         self.read_only = True  # tiered volumes are sealed
@@ -152,6 +159,16 @@ class Volume:
     @property
     def is_tiered(self) -> bool:
         return self._remote is not None
+
+    def _new_map(self):
+        if self.needle_map_kind == "sqlite":
+            from .needle_map import SqliteNeedleMap
+
+            return SqliteNeedleMap(
+                self.idx_path,
+                generation=self.super_block.compaction_revision,
+            )
+        return MemoryNeedleMap(self.idx_path)
 
     @staticmethod
     def base_file_name(directory: str, collection: str, volume_id: int) -> str:
@@ -304,6 +321,10 @@ class Volume:
                     f"volume {self.volume_id} is cold-tiered; "
                     "tier.download before making it writable"
                 )
+            if self._vacuuming:
+                # remember the operator's intent: vacuum's finally
+                # restores this instead of the pre-vacuum state
+                self._vacuum_ro_override = ro
             self.flush()
             self.read_only = ro
 
@@ -369,6 +390,10 @@ class Volume:
                 raise VolumeError(
                     f"volume {self.volume_id}: tier transfer in progress"
                 )
+            if self._vacuuming:
+                raise VolumeError(
+                    f"volume {self.volume_id}: vacuum in progress"
+                )
             if self._remote is not None:
                 raise VolumeError(f"volume {self.volume_id} already tiered")
             if not self.read_only:
@@ -416,6 +441,10 @@ class Volume:
                 raise VolumeError(
                     f"volume {self.volume_id}: tier transfer in progress"
                 )
+            if self._vacuuming:
+                raise VolumeError(
+                    f"volume {self.volume_id}: vacuum in progress"
+                )
             if self._remote is None:
                 raise VolumeError(f"volume {self.volume_id} is not tiered")
             self._tiering = True
@@ -436,7 +465,7 @@ class Volume:
                     vif.tier_url, vif.tier_size = "", 0
                     vif.save(self.vif_path)
                 self.needle_map.close()
-                self.needle_map = MemoryNeedleMap(self.idx_path)
+                self.needle_map = self._new_map()
                 self._dat = open(self.dat_path, "r+b")
                 self._dat.seek(0, os.SEEK_END)
                 self._append_at = self._pad_tail()
@@ -452,9 +481,11 @@ class Volume:
     def vacuum(self) -> int:
         """Compact: copy live needles to .cpd/.cpx, then atomically commit.
 
-        Returns bytes reclaimed. Mirrors volume_vacuum.go:74 CompactByVolumeData
-        + :162 CommitCompact (simplified: volume is locked during compaction,
-        so no incremental catch-up pass is needed yet).
+        Returns bytes reclaimed. Mirrors volume_vacuum.go:74
+        CompactByVolumeData + :162 CommitCompact: the volume stays
+        WRITABLE during the bulk copy; writes that land meanwhile are
+        caught up from the .idx journal tail (makeupDiff), with a brief
+        freeze only for the final sliver + the atomic swap.
         """
         with self._lock:
             self._check_not_broken()
@@ -471,42 +502,95 @@ class Volume:
                     f"volume {self.volume_id} has a pending vacuum "
                     "commit; reopen to heal before vacuuming"
                 )
-            was_ro = self.read_only
-            self.read_only = True
-            try:
-                old_size = self.size
-                cpd = self.dat_path[:-4] + ".cpd"
-                cpx = self.idx_path[:-4] + ".cpx"
-                new_sb = SuperBlock(
-                    version=self.super_block.version,
-                    replica_placement=self.super_block.replica_placement,
-                    ttl=self.super_block.ttl,
-                    compaction_revision=self.super_block.compaction_revision + 1,
+            if self._vacuuming:
+                raise VolumeError(
+                    f"volume {self.volume_id} vacuum already running"
                 )
-                marker = self.dat_path[:-4] + ".cpm"
-                try:
-                    with open(cpd, "wb") as df, open(cpx, "wb") as xf:
-                        df.write(new_sb.to_bytes())
-                        pos = df.tell()
-                        for nv in self.needle_map.ascending_visit():
-                            rec_len = self._record_disk_len(nv.size)
-                            raw = self._pread_record(actual_offset(nv.offset), nv.size)
-                            df.write(raw[:rec_len])
-                            xf.write(
-                                NeedleValue(
-                                    nv.needle_id, to_stored_offset(pos), nv.size
-                                ).to_bytes()
-                            )
-                            pos += rec_len
-                        df.flush()
-                        os.fsync(df.fileno())
-                        xf.flush()
-                        os.fsync(xf.fileno())
-                except BaseException:
-                    for tmp in (cpd, cpx):
-                        with contextlib.suppress(OSError):
-                            os.unlink(tmp)
-                    raise
+            if self._tiering:
+                # vacuum no longer holds the lock for its duration, so
+                # it must exclude tier transfers explicitly (and they
+                # check _vacuuming symmetrically)
+                raise VolumeError(
+                    f"volume {self.volume_id}: tier transfer in progress"
+                )
+            self._vacuuming = True
+            self._vacuum_ro_override = None  # set_read_only during vacuum
+            was_ro = self.read_only
+            # snapshot the live set + journal watermark while locked;
+            # the bulk copy then runs WITHOUT the lock and writes keep
+            # flowing (reference CompactByVolumeData : the volume stays
+            # writable; CommitCompact catches up from the .idx tail)
+            self.flush()
+            snapshot = list(self.needle_map.ascending_visit())
+            idx_watermark = os.path.getsize(self.idx_path)
+            old_size = self.size
+            new_sb = SuperBlock(
+                version=self.super_block.version,
+                replica_placement=self.super_block.replica_placement,
+                ttl=self.super_block.ttl,
+                compaction_revision=self.super_block.compaction_revision + 1,
+            )
+        cpd = self.dat_path[:-4] + ".cpd"
+        cpx = self.idx_path[:-4] + ".cpx"
+        marker = self.dat_path[:-4] + ".cpm"
+        try:
+            rfd = os.open(self.dat_path, os.O_RDONLY)
+            frozen = False
+            try:
+                with open(cpd, "wb") as df, open(cpx, "wb") as xf:
+                    df.write(new_sb.to_bytes())
+                    pos = df.tell()
+                    for nv in snapshot:  # phase 1: unlocked bulk copy
+                        rec_len = self._record_disk_len(nv.size)
+                        raw = os.pread(rfd, rec_len, actual_offset(nv.offset))
+                        df.write(raw)
+                        xf.write(
+                            NeedleValue(
+                                nv.needle_id, to_stored_offset(pos), nv.size
+                            ).to_bytes()
+                        )
+                        pos += rec_len
+                    # phase 2: replay the .idx tail written during the
+                    # copy (volume_vacuum.go makeupDiff catch-up); the
+                    # volume stays writable until the delta is small,
+                    # then freezes only for the final sliver
+                    rounds = 0
+                    while True:
+                        idx_end = os.path.getsize(self.idx_path)
+                        if idx_end == idx_watermark:
+                            if frozen:
+                                break
+                            with self._lock:
+                                self.flush()
+                                self.read_only = True
+                            frozen = True
+                            continue
+                        rounds += 1
+                        if not frozen and (
+                            idx_end - idx_watermark < 4096 or rounds > 16
+                        ):
+                            # small remaining delta (or a firehose
+                            # writer): freeze, drain, finish
+                            with self._lock:
+                                self.flush()
+                                self.read_only = True
+                            frozen = True
+                            idx_end = os.path.getsize(self.idx_path)
+                        pos, idx_watermark = self._replay_idx_tail(
+                            rfd, idx_watermark, idx_end, df, xf, pos
+                        )
+                    df.flush()
+                    os.fsync(df.fileno())
+                    xf.flush()
+                    os.fsync(xf.fileno())
+            except BaseException:
+                for tmp in (cpd, cpx):
+                    with contextlib.suppress(OSError):
+                        os.unlink(tmp)
+                raise
+            finally:
+                os.close(rfd)
+            with self._lock:
                 # Commit point: once the marker is durable, the swap is
                 # completable by _reconcile_vacuum_marker (here on
                 # failure, or at next open after a crash). The closes
@@ -537,7 +621,7 @@ class Volume:
                             with contextlib.suppress(OSError):
                                 os.unlink(p)
                         fsync_dir(marker)
-                        self.needle_map = MemoryNeedleMap(self.idx_path)
+                        self.needle_map = self._new_map()
                         self._dat = open(self.dat_path, "r+b")
                         self._dat.seek(0, os.SEEK_END)
                         self._append_at = self._pad_tail()
@@ -560,14 +644,64 @@ class Volume:
                         os.unlink(marker)
                         fsync_dir(marker)
                 self.super_block = new_sb
-                self.needle_map = MemoryNeedleMap(self.idx_path)
+                self.needle_map = self._new_map()
                 self._dat = open(self.dat_path, "r+b")
                 self._dat.seek(0, os.SEEK_END)
                 self._append_at = self._pad_tail()
-                return old_size - self.size
-            finally:
-                # a poisoned volume stays read-only until reopened
-                self.read_only = True if self.broken else was_ro
+                # writes accepted during the live vacuum inflate the
+                # new file; never report negative reclaim
+                return max(old_size - self.size, 0)
+        finally:
+            with self._lock:
+                self._vacuuming = False
+                if self.broken:
+                    # a poisoned volume stays read-only until reopened
+                    self.read_only = True
+                elif self._vacuum_ro_override is not None:
+                    # an operator's set_read_only during the unlocked
+                    # compaction window must not be clobbered
+                    self.read_only = self._vacuum_ro_override
+                else:
+                    self.read_only = was_ro
+                self._vacuum_ro_override = None
+
+    def _replay_idx_tail(
+        self, rfd: int, start: int, end: int, df, xf, pos: int
+    ) -> tuple[int, int]:
+        """Apply .idx entries in [start, end) to the compacted pair:
+        puts copy their .dat record, tombstones append a tombstone
+        needle. Returns (new cpd position, consumed idx offset) —
+        a torn trailing entry is left for the next round."""
+        from .types import NEEDLE_MAP_ENTRY_SIZE, TOMBSTONE_FILE_SIZE
+
+        with open(self.idx_path, "rb") as f:
+            f.seek(start)
+            raw = f.read(end - start)
+        usable = len(raw) - len(raw) % NEEDLE_MAP_ENTRY_SIZE
+        for i in range(0, usable, NEEDLE_MAP_ENTRY_SIZE):
+            nv = NeedleValue.from_bytes(raw[i : i + NEEDLE_MAP_ENTRY_SIZE])
+            if nv.is_deleted:
+                tomb = Needle(cookie=0, needle_id=nv.needle_id).to_bytes(
+                    self.version
+                )
+                df.write(tomb)
+                pos += len(tomb)
+                xf.write(
+                    NeedleValue(
+                        nv.needle_id, 0, TOMBSTONE_FILE_SIZE
+                    ).to_bytes()
+                )
+            else:
+                rec_len = self._record_disk_len(nv.size)
+                data = os.pread(rfd, rec_len, actual_offset(nv.offset))
+                df.write(data)
+                xf.write(
+                    NeedleValue(
+                        nv.needle_id, to_stored_offset(pos), nv.size
+                    ).to_bytes()
+                )
+                pos += rec_len
+        return pos, start + usable
 
     def _record_disk_len(self, body_size: int) -> int:
         return padded_record_size(

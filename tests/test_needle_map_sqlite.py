@@ -1,0 +1,195 @@
+"""SqliteNeedleMap (LevelDB-class durable map) + live-vacuum tests.
+
+Reference models: weed/storage/needle_map_leveldb.go (durable map with
+O(delta) reopen) and volume_vacuum.go:74-316 (compaction with live
+catch-up from the journal)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.needle_map import SqliteNeedleMap, walk_index_file
+from seaweedfs_tpu.storage.types import NeedleValue
+from seaweedfs_tpu.storage.volume import Volume
+
+
+# ---------------------------------------------------------- sqlite map
+
+
+def test_sqlite_map_basic(tmp_path):
+    idx = str(tmp_path / "1.idx")
+    m = SqliteNeedleMap(idx)
+    for i in range(1, 101):
+        m.put(i, offset=i * 8, size=100 + i)
+    assert m.get(50) == NeedleValue(50, 400, 150)
+    assert m.get(999) is None
+    assert m.delete(50) == 150
+    assert m.get(50) is None
+    assert m.deleted_counter == 1 and m.deleted_bytes == 150
+    assert len(m) == 99
+    ids = [nv.needle_id for nv in m.ascending_visit()]
+    assert ids == sorted(ids) and 50 not in ids
+    m.close()
+    # the .idx journal has every operation (still the wire format)
+    entries = list(walk_index_file(idx))
+    assert len(entries) == 101  # 100 puts + 1 tombstone
+
+
+def test_sqlite_map_reopen_is_o_delta(tmp_path):
+    idx = str(tmp_path / "2.idx")
+    m = SqliteNeedleMap(idx)
+    for i in range(1, 1001):
+        m.put(i, offset=i * 8, size=10)
+    m.flush()
+    watermark = os.path.getsize(idx)
+    m.close()
+    # append 5 more entries directly to the journal (simulating a crash
+    # after .idx writes but before the sqlite commit)
+    with open(idx, "ab") as f:
+        for i in range(2001, 2006):
+            f.write(NeedleValue(i, i * 8, 20).to_bytes())
+    m2 = SqliteNeedleMap(idx)
+    # only the tail was replayed: the stored watermark covered the rest
+    assert m2._meta("watermark") >= watermark
+    assert m2.get(500) == NeedleValue(500, 4000, 10)
+    assert m2.get(2003) == NeedleValue(2003, 2003 * 8, 20)
+    assert len(m2) == 1005
+    m2.close()
+
+
+def test_sqlite_map_generation_change_rebuilds(tmp_path):
+    idx = str(tmp_path / "3.idx")
+    m = SqliteNeedleMap(idx, generation=1)
+    m.put(1, 8, 10)
+    m.flush()
+    m.close()
+    # journal replaced by a vacuum (same size, new content, new gen)
+    with open(idx, "wb") as f:
+        f.write(NeedleValue(7, 16, 30).to_bytes())
+    m2 = SqliteNeedleMap(idx, generation=2)
+    assert m2.get(1) is None
+    assert m2.get(7) == NeedleValue(7, 16, 30)
+    m2.close()
+
+
+def test_volume_with_sqlite_map(tmp_path):
+    v = Volume(str(tmp_path), 11, needle_map_kind="sqlite")
+    payloads = {}
+    for i in range(1, 51):
+        data = bytes((i * 3 + j) % 256 for j in range(500))
+        v.write_needle(Needle(cookie=i, needle_id=i, data=data))
+        payloads[i] = data
+    v.delete_needle(10)
+    v.close()
+    v2 = Volume(str(tmp_path), 11, create=False, needle_map_kind="sqlite")
+    assert v2.read_needle(30).data == payloads[30]
+    assert not v2.has_needle(10)
+    # vacuum reclaims and the rebuilt sqlite map still serves
+    reclaimed = v2.vacuum()
+    assert reclaimed > 0
+    assert v2.read_needle(30).data == payloads[30]
+    assert not v2.has_needle(10)
+    v2.close()
+    v3 = Volume(str(tmp_path), 11, create=False, needle_map_kind="sqlite")
+    assert v3.read_needle(49).data == payloads[49]
+    v3.close()
+
+
+# ---------------------------------------------------------- live vacuum
+
+
+def test_vacuum_accepts_writes_during_compaction(tmp_path):
+    """The VERDICT item: vacuum no longer freezes the volume for the
+    whole compaction — writes landing mid-vacuum survive via the
+    journal catch-up."""
+    v = Volume(str(tmp_path), 21)
+    blob = b"z" * 2048
+    for i in range(1, 5001):
+        v.write_needle(Needle(cookie=1, needle_id=i, data=blob))
+    for i in range(1, 2500):  # ~50% garbage
+        v.delete_needle(i)
+
+    written_during: list[int] = []
+    rejected = 0
+    stop = threading.Event()
+
+    def writer():
+        nonlocal rejected
+        nid = 100_000
+        while not stop.is_set():
+            nid += 1
+            try:
+                v.write_needle(Needle(cookie=2, needle_id=nid, data=b"live-" + str(nid).encode()))
+                written_during.append(nid)
+            except Exception:
+                rejected += 1  # the brief freeze window
+                time.sleep(0.001)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    time.sleep(0.01)  # let the writer get going
+    reclaimed = v.vacuum()
+    stop.set()
+    t.join()
+
+    assert reclaimed > 0
+    # the volume accepted writes while vacuuming
+    assert len(written_during) > 0, "no write landed during vacuum"
+    # every mid-vacuum write survived the compaction commit
+    for nid in written_during:
+        assert v.read_needle(nid).data == b"live-" + str(nid).encode()
+    # old live needles survived, deleted ones are gone
+    assert v.read_needle(4000).data == blob
+    assert not v.has_needle(100)
+    # and everything still holds after a reopen (journal consistent)
+    v.close()
+    v2 = Volume(str(tmp_path), 21, create=False)
+    for nid in written_during[-5:]:
+        assert v2.read_needle(nid).data == b"live-" + str(nid).encode()
+    assert not v2.has_needle(100)
+    v2.close()
+
+
+def test_vacuum_catchup_applies_mid_vacuum_deletes(tmp_path):
+    """A delete issued during compaction must not resurrect on commit."""
+    v = Volume(str(tmp_path), 22)
+    for i in range(1, 2001):
+        v.write_needle(Needle(cookie=1, needle_id=i, data=b"d" * 1024))
+    v.delete_needle(1)  # some garbage so vacuum does work
+
+    deleted_mid: list[int] = []
+    stop = threading.Event()
+
+    def deleter():
+        nid = 1000
+        while not stop.is_set() and nid < 1050:
+            try:
+                v.delete_needle(nid)
+                deleted_mid.append(nid)
+                nid += 1
+            except Exception:
+                time.sleep(0.001)
+
+    t = threading.Thread(target=deleter)
+    t.start()
+    v.vacuum()
+    stop.set()
+    t.join()
+    for nid in deleted_mid:
+        assert not v.has_needle(nid), f"needle {nid} resurrected by vacuum"
+    assert v.read_needle(500).data == b"d" * 1024
+    v.close()
+
+
+def test_vacuum_still_readonly_volume_restored(tmp_path):
+    """A volume that was readonly before vacuum stays readonly after."""
+    v = Volume(str(tmp_path), 23)
+    v.write_needle(Needle(cookie=1, needle_id=1, data=b"x"))
+    v.delete_needle(1)
+    v.set_read_only(True)
+    v.vacuum()
+    assert v.read_only
+    v.close()
