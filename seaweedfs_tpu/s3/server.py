@@ -80,6 +80,7 @@ class S3Server:
         region: str = "us-east-1",
         lifecycle_interval: float = 3600.0,
         sts=None,
+        tls=None,
     ):
         self.filer = filer
         self.ip = ip
@@ -92,6 +93,9 @@ class S3Server:
         if sts is not None and self.identities.sts is None:
             self.identities.sts = sts
         self._http = ThreadingHTTPServer((ip, port), self._handler_class())
+        self.tls = tls
+        if tls is not None:
+            tls.wrap_server(self._http)
         self._thread = threading.Thread(target=self._http.serve_forever, daemon=True)
         from .lifecycle import LifecycleScanner
 

@@ -16,6 +16,31 @@ from ..utils.glog import logger
 log = logger("launcher")
 
 
+def _add_tls_flags(sp) -> None:
+    """Reference security.toml https.* keys as flags: a cert/key pair
+    turns the node's HTTP listener(s) into TLS listeners with hot
+    cert-reload (utils/tls.py)."""
+    sp.add_argument("-tls.cert", dest="tls_cert", default="")
+    sp.add_argument("-tls.key", dest="tls_key", default="")
+    sp.add_argument(
+        "-tls.ca", dest="tls_ca", default="",
+        help="when set, require and verify client certificates (mTLS)",
+    )
+
+
+def _tls_from(a):
+    if not getattr(a, "tls_cert", ""):
+        return None
+    from ..utils.tls import TlsConfig
+
+    return TlsConfig(
+        cert_file=a.tls_cert,
+        key_file=a.tls_key,
+        ca_file=a.tls_ca or None,
+        client_auth=bool(a.tls_ca),
+    )
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="seaweedfs_tpu.server")
     sub = p.add_subparsers(dest="mode", required=True)
@@ -37,6 +62,7 @@ def main(argv=None) -> int:
         "-mdir", default="",
         help="meta dir for the durable raft log (required for HA restarts)",
     )
+    _add_tls_flags(m)
 
     v = sub.add_parser("volume")
     v.add_argument("-ip", default="localhost")
@@ -54,6 +80,7 @@ def main(argv=None) -> int:
     v.add_argument("-dataCenter", default="")
     v.add_argument("-rack", default="")
     v.add_argument("-jwt.key", dest="jwt_key", default="")
+    _add_tls_flags(v)
 
     f = sub.add_parser("filer")
     f.add_argument("-ip", default="localhost")
@@ -67,6 +94,7 @@ def main(argv=None) -> int:
     f.add_argument("-notify.mq", dest="notify_mq", default="")
     f.add_argument("-grpcPort", type=int, default=0, help="gRPC metadata API port (0 = port+10000)")
     f.add_argument("-peers", default="", help="comma-separated peer filer gRPC addrs for multi-filer")
+    _add_tls_flags(f)
 
     b = sub.add_parser("mq.broker")
     b.add_argument("-ip", default="localhost")
@@ -101,6 +129,7 @@ def main(argv=None) -> int:
         help="auto-submit ec_encode for volumes at this fraction of the size limit (0=off)",
     )
     s.add_argument("-webdavPort", type=int, default=7333)
+    _add_tls_flags(s)
 
     a = p.parse_args(argv)
     stop = threading.Event()
@@ -136,6 +165,7 @@ def main(argv=None) -> int:
             ec_auto_fullness=getattr(a, "ec_auto", 0.0),
             peers=getattr(a, "peers", "") or None,
             meta_dir=getattr(a, "mdir", "") or None,
+            tls=_tls_from(a),
         )
         ms.start()
         servers.append(ms)
@@ -158,6 +188,7 @@ def main(argv=None) -> int:
             rack=getattr(a, "rack", ""),
             jwt_key=getattr(a, "jwt_key", ""),
             needle_map_kind=getattr(a, "index", "memory"),
+            tls=_tls_from(a),
         )
         vs.start()
         servers.append(vs)
@@ -209,6 +240,7 @@ def main(argv=None) -> int:
             meta_log=MetaLog(os.path.join(dbdir, "metalog")),
             grpc_port=fgrpc,
             peers=peers,
+            tls=_tls_from(a),
         )
         fs.start()
         servers.append(fs)
@@ -233,7 +265,8 @@ def main(argv=None) -> int:
             if a.s3AccessKey:
                 idents.add(Identity("admin", a.s3AccessKey, a.s3SecretKey))
             s3srv = S3Server(
-                filer, ip=a.ip, port=a.s3Port, identities=idents, sts=sts
+                filer, ip=a.ip, port=a.s3Port, identities=idents, sts=sts,
+                tls=_tls_from(a),
             )
             s3srv.start()
             servers.append(s3srv)
@@ -242,7 +275,9 @@ def main(argv=None) -> int:
         if a.mode == "server" and getattr(a, "webdav", False):
             from .webdav_server import WebDavServer
 
-            wd = WebDavServer(filer, ip=a.ip, port=a.webdavPort)
+            wd = WebDavServer(
+                filer, ip=a.ip, port=a.webdavPort, tls=_tls_from(a)
+            )
             wd.start()
             servers.append(wd)
             log.info("webdav on %s:%s", a.ip, a.webdavPort)

@@ -26,6 +26,7 @@ class FilerServer:
         meta_log=None,
         grpc_port: int = 0,
         peers: list[str] | None = None,
+        tls=None,
     ):
         """meta_log: a filer.meta_log.MetaLog; when present it is
         subscribed to the filer, served at GET /~meta/tail (long-poll
@@ -42,6 +43,9 @@ class FilerServer:
         if meta_log is not None:
             filer.subscribe(meta_log)
         self._http = ThreadingHTTPServer((ip, port), self._handler_class())
+        self.tls = tls
+        if tls is not None:
+            tls.wrap_server(self._http)
         self._thread = threading.Thread(target=self._http.serve_forever, daemon=True)
         # gRPC metadata service (reference weed/pb/filer.proto service)
         from concurrent import futures as _futures

@@ -410,6 +410,7 @@ class MasterServer:
         peers: list[str] | str | None = None,
         meta_dir: str | None = None,
         election_timeout: tuple[float, float] = (0.4, 0.8),
+        tls=None,
     ):
         """ec_auto_fullness > 0 turns on the maintenance scanner: volumes
         at that fraction of the size limit (and write-quiet) get an
@@ -459,6 +460,9 @@ class MasterServer:
         self._grpc.add_insecure_port(f"{ip}:{self.grpc_port}")
 
         self._http = ThreadingHTTPServer((ip, port), self._handler_class())
+        self.tls = tls
+        if tls is not None:
+            tls.wrap_server(self._http)
         self._http_thread = threading.Thread(
             target=self._http.serve_forever, daemon=True
         )

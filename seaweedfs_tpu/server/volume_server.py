@@ -638,6 +638,7 @@ class VolumeServer:
         rack: str = "",
         jwt_key: str = "",
         needle_map_kind: str = "memory",
+        tls=None,
     ):
         self.jwt_key = jwt_key
         self.ip = ip
@@ -668,6 +669,9 @@ class VolumeServer:
         rpc.add_service(self._grpc, rpc.VOLUME_SERVICE, self.service)
         self._grpc.add_insecure_port(f"{ip}:{self.grpc_port}")
         self._http = ThreadingHTTPServer((ip, port), self._handler_class())
+        self.tls = tls
+        if tls is not None:
+            tls.wrap_server(self._http)
         self._http_thread = threading.Thread(
             target=self._http.serve_forever, daemon=True
         )

@@ -28,11 +28,16 @@ def _rfc1123(ts: int) -> str:
 
 
 class WebDavServer:
-    def __init__(self, filer: Filer, ip: str = "localhost", port: int = 7333):
+    def __init__(
+        self, filer: Filer, ip: str = "localhost", port: int = 7333, tls=None
+    ):
         self.filer = filer
         self.ip = ip
         self.port = port
         self._http = ThreadingHTTPServer((ip, port), self._handler_class())
+        self.tls = tls
+        if tls is not None:
+            tls.wrap_server(self._http)
         self._thread = threading.Thread(target=self._http.serve_forever, daemon=True)
 
     def start(self) -> None:

@@ -331,6 +331,27 @@ class SqliteNeedleMap:
         for nid, off, size in rows:
             yield NeedleValue(int(nid), int(off), int(size))
 
+    def snapshot_batches(self, batch_size: int = 8192) -> Iterator[NeedleValue]:
+        """Memory-bounded ascending scan via keyset pagination: each
+        batch holds the op lock only briefly, so a live vacuum of a
+        large volume never materializes the whole map (the point of
+        this mapper). Rows added concurrently may appear (id > cursor)
+        — harmless: vacuum's .idx-tail replay re-copies them."""
+        last = -1
+        while True:
+            with self._op_lock:
+                self._commit_pending_locked()
+                rows = self._db.execute(
+                    "SELECT id, offset, size FROM needles"
+                    " WHERE id > ? ORDER BY id LIMIT ?",
+                    (last, batch_size),
+                ).fetchall()
+            if not rows:
+                return
+            for nid, off, size in rows:
+                yield NeedleValue(int(nid), int(off), int(size))
+            last = int(rows[-1][0])
+
     def flush(self) -> None:
         # the .idx journal IS the durability contract; a sqlite commit
         # per fsync'd write would defeat the FLUSH_EVERY batching (a

@@ -325,6 +325,12 @@ class Volume:
                 # remember the operator's intent: vacuum's finally
                 # restores this instead of the pre-vacuum state
                 self._vacuum_ro_override = ro
+                if not ro:
+                    # never un-freeze mid-vacuum: vacuum may be in its
+                    # final frozen drain, and a write acked after its
+                    # last .idx-tail check would be discarded by the
+                    # .cpd/.cpx swap; the override applies on finish
+                    return
             self.flush()
             self.read_only = ro
 
@@ -521,7 +527,13 @@ class Volume:
             # flowing (reference CompactByVolumeData : the volume stays
             # writable; CommitCompact catches up from the .idx tail)
             self.flush()
-            snapshot = list(self.needle_map.ascending_visit())
+            # sqlite maps offer a memory-bounded paginated scan; the
+            # memory map is O(live needles) resident anyway, so a list
+            # snapshot adds nothing to its footprint
+            snap_fn = getattr(self.needle_map, "snapshot_batches", None)
+            snapshot = (
+                snap_fn() if snap_fn else list(self.needle_map.ascending_visit())
+            )
             idx_watermark = os.path.getsize(self.idx_path)
             old_size = self.size
             new_sb = SuperBlock(
