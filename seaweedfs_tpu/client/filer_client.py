@@ -10,6 +10,7 @@ import urllib.parse
 from typing import Iterator, Optional
 
 import requests
+from ..utils.urls import service_url
 
 
 class FilerListingError(requests.RequestException):
@@ -21,7 +22,7 @@ class FilerListingError(requests.RequestException):
 def filer_url(filer: str, path: str) -> str:
     if not path.startswith("/"):
         path = "/" + path
-    return f"http://{filer}{urllib.parse.quote(path)}"
+    return service_url(filer, urllib.parse.quote(path))
 
 
 def list_dir(

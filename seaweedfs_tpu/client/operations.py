@@ -12,6 +12,21 @@ import requests
 
 from ..storage.file_id import FileId
 from .master_client import MasterClient
+from ..utils.urls import service_url
+
+
+class TracingSession(requests.Session):
+    """requests.Session that stamps the active X-Request-ID onto every
+    outgoing call, so one id follows client → filer → volume hops
+    (reference weed/util/request_id)."""
+
+    def request(self, method, url, **kw):  # type: ignore[override]
+        from ..utils import request_id
+
+        headers = dict(kw.get("headers") or {})
+        request_id.inject(headers)
+        kw["headers"] = headers
+        return super().request(method, url, **kw)
 
 
 class Operations:
@@ -21,7 +36,7 @@ class Operations:
         security.toml-holding services do."""
         self.master = MasterClient(master)
         self.jwt_key = jwt_key
-        self._http = requests.Session()
+        self._http = TracingSession()
 
     def _auth_headers(self, token: str, fid: str) -> dict:
         if not token and self.jwt_key:
@@ -52,7 +67,7 @@ class Operations:
                 a = self.master.assign(
                     collection=collection, replication=replication, ttl=ttl
                 )
-                url = f"http://{a.url}/{a.fid}"
+                url = service_url(a.url, f"/{a.fid}")
                 files = {
                     "file": (name or "file", data, mime or "application/octet-stream")
                 }
@@ -81,7 +96,7 @@ class Operations:
     def read(self, fid: str) -> bytes:
         f = FileId.parse(fid)
         for loc in self.master.lookup(f.volume_id):
-            r = self._http.get(f"http://{loc.url}/{fid}", timeout=60)
+            r = self._http.get(service_url(loc.url, f"/{fid}"), timeout=60)
             if r.status_code == 200:
                 return r.content
         raise LookupError(f"fid {fid} unreadable on all locations")
@@ -91,7 +106,7 @@ class Operations:
         canonical = str(f)  # tokens are scoped to the canonical fid form
         for loc in self.master.lookup(f.volume_id):
             r = self._http.delete(
-                f"http://{loc.url}/{canonical}",
+                service_url(loc.url, f"/{canonical}"),
                 timeout=60,
                 headers=self._auth_headers("", canonical),
             )

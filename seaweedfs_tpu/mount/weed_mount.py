@@ -26,6 +26,7 @@ from ..pb import filer_pb2 as fpb
 from ..pb import rpc
 from . import fuse_ctypes as fc
 from .page_writer import PageBuffer
+from ..utils.urls import service_url
 
 ATTR_TTL = 1.0
 FLUSH_BYTES = 8 * 1024 * 1024  # dirty bytes that trigger a chunk spill
@@ -243,7 +244,7 @@ class FilerMount:
             raise OSError(errno.EIO, f"assign: {a.error}")
         headers = {"Authorization": f"Bearer {a.jwt}"} if a.jwt else {}
         r = self._http.post(
-            f"http://{a.url}/{a.fid}",
+            service_url(a.url, f"/{a.fid}"),
             files={"file": ("chunk", piece, "application/octet-stream")},
             headers=headers,
             timeout=300,

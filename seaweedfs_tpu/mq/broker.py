@@ -20,6 +20,7 @@ import requests
 from ..pb import mq_pb2 as mq
 from ..pb import rpc
 from .log_buffer import PartitionLog, decode_records
+from ..utils.urls import service_url
 
 TOPICS_ROOT = "/topics"
 
@@ -64,7 +65,7 @@ class MqBroker:
     # ------------------------------------------------------------ filer io
 
     def _url(self, path: str) -> str:
-        return f"http://{self.filer}{path}"
+        return service_url(self.filer, path)
 
     def _seg_path(self, ns: str, name: str, part: int, seg: int) -> str:
         return f"{TOPICS_ROOT}/{ns}/{name}/{part:04d}/seg-{seg:08d}.log"

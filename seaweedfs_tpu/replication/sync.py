@@ -15,6 +15,7 @@ import time
 import urllib.parse
 
 import requests
+from ..utils.urls import service_url
 
 
 class FilerSync:
@@ -45,10 +46,10 @@ class FilerSync:
     # ------------------------------------------------------------ helpers
 
     def _src(self, path: str) -> str:
-        return f"http://{self.source}{urllib.parse.quote(path)}"
+        return service_url(self.source, urllib.parse.quote(path))
 
     def _dst(self, path: str) -> str:
-        return f"http://{self.target}{urllib.parse.quote(path)}"
+        return service_url(self.target, urllib.parse.quote(path))
 
     @staticmethod
     def _under(path: str, prefix: str) -> bool:
@@ -128,7 +129,7 @@ class FilerSync:
         """The SOURCE filer's clock (watermarks must never mix clocks —
         skew would skip events emitted during the full copy)."""
         r = self._http.get(
-            f"http://{self.source}/~meta/tail",
+            service_url(self.source, "/~meta/tail"),
             params={"sinceNs": str(1 << 62), "waitSeconds": "0"},
             timeout=30,
         )
@@ -137,7 +138,7 @@ class FilerSync:
 
     def tail_once(self, wait_seconds: float = 10.0) -> int:
         r = self._http.get(
-            f"http://{self.source}/~meta/tail",
+            service_url(self.source, "/~meta/tail"),
             params={
                 "sinceNs": str(self.watermark),
                 "waitSeconds": str(wait_seconds),

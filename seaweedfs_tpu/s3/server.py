@@ -132,7 +132,9 @@ class S3Server:
     def _handler_class(self):
         srv = self
 
-        class Handler(BaseHTTPRequestHandler):
+        from ..utils.request_id import RequestTracingMixin
+
+        class Handler(RequestTracingMixin, BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
             def log_message(self, *a):

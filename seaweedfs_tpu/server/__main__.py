@@ -7,6 +7,7 @@ reference `weed server` / `weed mini`).
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import sys
 import threading
@@ -61,6 +62,10 @@ def main(argv=None) -> int:
     m.add_argument(
         "-mdir", default="",
         help="meta dir for the durable raft log (required for HA restarts)",
+    )
+    m.add_argument(
+        "-telemetry.url", dest="telemetry_url", default="",
+        help="opt-in phone-home endpoint (leader posts count aggregates)",
     )
     _add_tls_flags(m)
 
@@ -131,7 +136,54 @@ def main(argv=None) -> int:
     s.add_argument("-webdavPort", type=int, default=7333)
     _add_tls_flags(s)
 
+    sc = sub.add_parser(
+        "scaffold", help="emit a commented config template (weed scaffold)"
+    )
+    sc.add_argument("-config", dest="config", default="security")
+    sc.add_argument(
+        "-output", default="",
+        help="directory to write <name>.toml into (default: stdout)",
+    )
+
     a = p.parse_args(argv)
+
+    if a.mode == "scaffold":
+        from ..utils.scaffold import scaffold
+
+        text = scaffold(a.config)
+        if a.output:
+            path = os.path.join(a.output, f"{a.config}.toml")
+            with open(path, "w") as fh:
+                fh.write(text)
+            print(path)
+        else:
+            print(text, end="")
+        return 0
+
+    # security.toml supplies defaults for flags the operator left unset
+    # (reference weed/util/config.go viper load; flags win)
+    from ..utils.config import load_config
+
+    sec = load_config("security")
+    if sec:
+        if not getattr(a, "jwt_key", ""):
+            a.jwt_key = sec.get_str("jwt.signing.key")
+        # per-field merge: an explicitly-passed -tls.ca must survive a
+        # security.toml that only sets cert/key (flags win field-wise)
+        for attr, key in (
+            ("tls_cert", "https.default.cert"),
+            ("tls_key", "https.default.key"),
+            ("tls_ca", "https.default.ca"),
+        ):
+            if hasattr(a, attr) and not getattr(a, attr):
+                setattr(a, attr, sec.get_str(key))
+    if getattr(a, "tls_cert", ""):
+        # internal hops (client→volume, filer→volume, replication) must
+        # speak https too, trusting the cluster CA (or the cert itself
+        # for single-cert self-signed setups)
+        from ..utils.urls import enable_https
+
+        enable_https(getattr(a, "tls_ca", "") or a.tls_cert)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *x: stop.set())
     signal.signal(signal.SIGINT, lambda *x: stop.set())
@@ -166,6 +218,7 @@ def main(argv=None) -> int:
             peers=getattr(a, "peers", "") or None,
             meta_dir=getattr(a, "mdir", "") or None,
             tls=_tls_from(a),
+            telemetry_url=getattr(a, "telemetry_url", ""),
         )
         ms.start()
         servers.append(ms)
@@ -197,8 +250,6 @@ def main(argv=None) -> int:
     if a.mode == "filer" or (
         a.mode == "server" and (a.filer or a.s3 or a.webdav)
     ):
-        import os
-
         from ..filer.filer import Filer
         from ..filer.filer_store import SqliteStore
         from .filer_server import FilerServer

@@ -72,7 +72,9 @@ class FilerServer:
         filer = self.filer
         server_ref = self
 
-        class Handler(BaseHTTPRequestHandler):
+        from ..utils.request_id import RequestTracingMixin
+
+        class Handler(RequestTracingMixin, BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
             def log_message(self, *a):

@@ -411,6 +411,7 @@ class MasterServer:
         meta_dir: str | None = None,
         election_timeout: tuple[float, float] = (0.4, 0.8),
         tls=None,
+        telemetry_url: str = "",
     ):
         """ec_auto_fullness > 0 turns on the maintenance scanner: volumes
         at that fraction of the size limit (and write-quiet) get an
@@ -467,6 +468,24 @@ class MasterServer:
             target=self._http.serve_forever, daemon=True
         )
 
+        # opt-in phone-home (reference weed/telemetry/collector.go:14):
+        # leader-only aggregate counts, never names or data
+        from ..utils.telemetry import TelemetryCollector
+
+        def _tele_stats() -> dict:
+            st = self.topo.statistics()
+            return {
+                "volume_count": st.volume_count,
+                "ec_volume_count": st.ec_volume_count,
+                "server_count": st.node_count,
+                "used_size": st.used_size,
+                "file_count": st.file_count,
+            }
+
+        self.telemetry = TelemetryCollector(
+            telemetry_url, _tele_stats, is_leader_fn=lambda: self.raft.is_leader
+        )
+
     # --------------------------------------------------------------- ha
 
     def _raft_apply(self, kind: str, value: int) -> int:
@@ -492,7 +511,9 @@ class MasterServer:
     def _handler_class(self):
         master = self
 
-        class Handler(BaseHTTPRequestHandler):
+        from ..utils.request_id import RequestTracingMixin
+
+        class Handler(RequestTracingMixin, BaseHTTPRequestHandler):
             def log_message(self, *a):
                 pass
 
@@ -507,6 +528,10 @@ class MasterServer:
             def do_GET(self):
                 u = urlparse(self.path)
                 q = parse_qs(u.query)
+                from ..utils.pprof import handle_debug_endpoint
+
+                if handle_debug_endpoint(self, u):
+                    return
                 if u.path == "/dir/assign":
                     resp = master.service.Assign(
                         pb.AssignRequest(
@@ -707,8 +732,10 @@ class MasterServer:
         self.raft.start()
         self._http_thread.start()
         self._vacuum_thread.start()
+        self.telemetry.start()
 
     def stop(self) -> None:
+        self.telemetry.stop()
         self.worker_control.stop()
         self.raft.stop()
         self._vacuum_stop.set()

@@ -940,7 +940,9 @@ class VolumeServer:
     def _handler_class(self):
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
+        from ..utils.request_id import RequestTracingMixin
+
+        class Handler(RequestTracingMixin, BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
             def log_message(self, *a):
@@ -978,6 +980,10 @@ class VolumeServer:
 
             def do_GET(self):
                 u = urlparse(self.path)
+                from ..utils.pprof import handle_debug_endpoint
+
+                if handle_debug_endpoint(self, u):
+                    return
                 if u.path == "/metrics":
                     from ..utils.metrics import REGISTRY
 
@@ -1014,6 +1020,28 @@ class VolumeServer:
                     return self._error(503, str(e))
                 ctype = n.mime.decode() if n.mime else "application/octet-stream"
                 data = n.data
+                # on-the-fly thumbnailing (reference weed/images,
+                # volume_server_handlers_read.go:362-421)
+                rq = parse_qs(u.query)
+                etag = f"{n.checksum:08x}"
+                if "width" in rq or "height" in rq:
+                    from ..utils.images import detect_format, resized
+
+                    try:
+                        rw = int(rq.get("width", ["0"])[0] or 0)
+                        rh = int(rq.get("height", ["0"])[0] or 0)
+                    except ValueError:
+                        rw = rh = 0  # malformed dims: serve the original
+                    rmode = rq.get("mode", [""])[0]
+                    out, _, _ = resized(data, rw, rh, rmode)
+                    if out is not data:
+                        data = out
+                        # re-encode may change the container (GIF→PNG)
+                        # and each variant needs its own cache key
+                        fmt = detect_format(data)
+                        if fmt:
+                            ctype = f"image/{fmt.lower()}"
+                        etag = f"{n.checksum:08x}-{rw}x{rh}{rmode}"
                 total = len(data)
                 status = 200
                 content_range = None
@@ -1041,7 +1069,7 @@ class VolumeServer:
                 self.send_header("Accept-Ranges", "bytes")
                 if content_range:
                     self.send_header("Content-Range", content_range)
-                self.send_header("ETag", f'"{n.checksum:08x}"')
+                self.send_header("ETag", f'"{etag}"')
                 self.end_headers()
                 if self.command != "HEAD":
                     self.wfile.write(data)

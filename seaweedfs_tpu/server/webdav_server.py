@@ -50,7 +50,9 @@ class WebDavServer:
     def _handler_class(self):
         filer = self.filer
 
-        class Handler(BaseHTTPRequestHandler):
+        from ..utils.request_id import RequestTracingMixin
+
+        class Handler(RequestTracingMixin, BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
             def log_message(self, *a):

@@ -24,6 +24,7 @@ from ..client.master_client import (
 )
 from ..pb import cluster_pb2 as pb
 from ..pb import rpc
+from ..utils.urls import service_url
 
 
 class ShellEnv:
@@ -925,7 +926,7 @@ def _filer_url(env: ShellEnv, path: str) -> str:
 
     if not path.startswith("/"):
         path = "/" + path
-    return f"http://{env.filer_addr}{quote(path)}"
+    return service_url(env.filer_addr, quote(path))
 
 
 @command("fs.ls", "fs.ls /path (filer listing)")

@@ -1,0 +1,68 @@
+"""TOML configuration files beside the CLI flags.
+
+Reference: weed/util/config.go:35-41 — viper loads `<name>.toml` from
+the working directory, `~/.seaweedfs/`, and `/etc/seaweedfs/` (first
+hit wins); `weed scaffold` emits commented templates
+(weed/command/scaffold/*.toml). Here the same search order is applied
+with stdlib tomllib, and `python -m seaweedfs_tpu.server scaffold`
+emits the templates in utils/scaffold.py.
+
+Flags still win: launchers consult the config only for keys whose flag
+was left at its default, mirroring the reference's precedence.
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from typing import Any
+
+CONFIG_DIRS = (".", "~/.seaweedfs_tpu", "/etc/seaweedfs_tpu")
+
+
+class Config:
+    """A parsed TOML file with viper-style dotted-key access."""
+
+    def __init__(self, data: dict | None, path: str | None = None):
+        self.data = data or {}
+        self.path = path
+
+    def __bool__(self) -> bool:
+        return bool(self.data)
+
+    def get(self, dotted: str, default: Any = None) -> Any:
+        node: Any = self.data
+        for part in dotted.split("."):
+            if not isinstance(node, dict) or part not in node:
+                return default
+            node = node[part]
+        return node
+
+    def get_str(self, dotted: str, default: str = "") -> str:
+        v = self.get(dotted, default)
+        return default if v is None else str(v)
+
+
+def find_config_file(name: str, dirs=CONFIG_DIRS) -> str | None:
+    for d in dirs:
+        path = os.path.join(os.path.expanduser(d), f"{name}.toml")
+        if os.path.isfile(path):
+            return path
+    return None
+
+
+def load_config(name: str, dirs=CONFIG_DIRS) -> Config:
+    """Load `<name>.toml` from the search path; empty Config if absent
+    or malformed (a bad config file must not take a node down — it is
+    reported and ignored, like viper's soft failure)."""
+    path = find_config_file(name, dirs)
+    if path is None:
+        return Config(None)
+    try:
+        with open(path, "rb") as f:
+            return Config(tomllib.load(f), path)
+    except (OSError, tomllib.TOMLDecodeError) as e:
+        from .glog import logger
+
+        logger("config").warning("ignoring %s: %s", path, e)
+        return Config(None)

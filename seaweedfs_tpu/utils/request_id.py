@@ -1,0 +1,61 @@
+"""X-Request-ID propagation.
+
+Reference: weed/util/request_id — every HTTP hop carries the id; the
+first server in the chain mints one. Stored in a contextvar so log
+lines and downstream client calls inside one request see it without
+threading it through signatures.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import uuid
+
+HEADER = "X-Request-ID"
+
+_current: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "request_id", default=""
+)
+
+
+def get() -> str:
+    return _current.get()
+
+
+def ensure(incoming: str | None = None) -> str:
+    """Adopt the caller's id or mint one; returns the active id."""
+    rid = incoming or uuid.uuid4().hex[:16]
+    _current.set(rid)
+    return rid
+
+
+def clear() -> None:
+    _current.set("")
+
+
+def inject(headers: dict) -> dict:
+    """Add the active id to outgoing request headers (no-op outside a
+    request context)."""
+    rid = get()
+    if rid:
+        headers[HEADER] = rid
+    return headers
+
+
+class RequestTracingMixin:
+    """Mix into a BaseHTTPRequestHandler (before it in the MRO): adopts
+    or mints the request id when headers are parsed and echoes it on
+    every response, so one id follows a request through
+    client → filer → volume hops and appears in each server's logs."""
+
+    def parse_request(self):  # type: ignore[override]
+        ok = super().parse_request()
+        if ok:
+            ensure(self.headers.get(HEADER))
+        return ok
+
+    def send_response(self, code, message=None):  # type: ignore[override]
+        super().send_response(code, message)
+        rid = get()
+        if rid:
+            self.send_header(HEADER, rid)

@@ -13,6 +13,7 @@ bootstrap) uses the `cryptography` package.
 
 from __future__ import annotations
 
+import contextlib
 import datetime
 import ipaddress
 import os
@@ -72,10 +73,28 @@ class TlsConfig:
                         pass  # keep serving the old cert on a bad rotate
 
     def wrap_server(self, httpd) -> None:
-        """Wrap an http.server socket; accept() then yields TLS sockets."""
-        httpd.socket = self.server_context().wrap_socket(
-            httpd.socket, server_side=True
-        )
+        """TLS-enable a ThreadingHTTPServer.
+
+        The handshake must NOT happen in the accept loop (a client that
+        connects and sends nothing would stall every other connection),
+        so the listening socket stays plain and each accepted socket is
+        wrapped in the per-connection thread (finish_request), under a
+        handshake timeout."""
+        ctx = self.server_context()
+        handler_cls = httpd.RequestHandlerClass
+
+        def finish_request(request, client_address):
+            request.settimeout(30.0)
+            try:
+                tls_sock = ctx.wrap_socket(request, server_side=True)
+                tls_sock.settimeout(None)
+            except (OSError, ssl.SSLError):
+                with contextlib.suppress(OSError):
+                    request.close()
+                return
+            handler_cls(tls_sock, client_address, httpd)
+
+        httpd.finish_request = finish_request
 
     # -- client side ----------------------------------------------------
     def client_context(self) -> ssl.SSLContext:

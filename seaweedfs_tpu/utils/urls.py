@@ -1,0 +1,36 @@
+"""Cluster-internal URL scheme selection.
+
+When a node group runs TLS (utils/tls.py), every internal hop —
+client → master, filer → volume, replica fan-out, shell → servers —
+must speak https and verify against the cluster CA. The reference
+threads this through security.toml-loaded gRPC/HTTP dialers; here one
+process-wide switch covers the hand-rolled HTTP data plane: callers
+build URLs via service_url() instead of hardcoding a scheme, and
+enable_https() points `requests` at the CA via REQUESTS_CA_BUNDLE
+(honored by every requests call in the process).
+"""
+
+from __future__ import annotations
+
+import os
+
+_scheme = "http"
+
+
+def enable_https(ca_file: str | None = None) -> None:
+    global _scheme
+    _scheme = "https"
+    if ca_file:
+        os.environ["REQUESTS_CA_BUNDLE"] = ca_file
+
+
+def scheme() -> str:
+    return _scheme
+
+
+def service_url(hostport: str, path: str = "") -> str:
+    """'host:port' (+ optional '/path') → full URL on the cluster
+    scheme. Pass-through when the caller already has a scheme."""
+    if hostport.startswith(("http://", "https://")):
+        return hostport + path
+    return f"{_scheme}://{hostport}{path}"

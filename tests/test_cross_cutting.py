@@ -1,0 +1,232 @@
+"""Cross-cutting subsystems: TOML config + scaffold, telemetry,
+image resizing, request-id tracing, pprof endpoints.
+
+References: weed/util/config.go (viper search path), weed/command/
+scaffold/, weed/telemetry/collector.go, weed/images/resizing.go,
+weed/util/request_id, weed/util/grace/pprof.go.
+"""
+
+import io
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from conftest import allocate_port
+
+# --------------------------------------------------------------- config
+
+
+def test_config_search_order_and_dotted_get(tmp_path):
+    from seaweedfs_tpu.utils.config import load_config
+
+    d1, d2 = tmp_path / "a", tmp_path / "b"
+    d1.mkdir(), d2.mkdir()
+    (d2 / "security.toml").write_text('[jwt.signing]\nkey = "from-b"\n')
+    cfg = load_config("security", dirs=(str(d1), str(d2)))
+    assert cfg.get_str("jwt.signing.key") == "from-b"
+    assert cfg.get("jwt.signing.expires_after_seconds", 10) == 10
+    # first hit wins
+    (d1 / "security.toml").write_text('[jwt.signing]\nkey = "from-a"\n')
+    assert (
+        load_config("security", dirs=(str(d1), str(d2))).get_str(
+            "jwt.signing.key"
+        )
+        == "from-a"
+    )
+    # malformed file -> empty config, not a crash
+    (d1 / "security.toml").write_text("[[[ not toml")
+    assert not load_config("security", dirs=(str(d1),))
+
+
+def test_scaffold_emits_parseable_toml(tmp_path):
+    import tomllib
+
+    from seaweedfs_tpu.server.__main__ import main
+    from seaweedfs_tpu.utils.scaffold import TEMPLATES, scaffold
+
+    for name in TEMPLATES:
+        tomllib.loads(scaffold(name))  # every template must parse
+    rc = main(["scaffold", "-config", "security", "-output", str(tmp_path)])
+    assert rc == 0
+    data = tomllib.load(open(tmp_path / "security.toml", "rb"))
+    assert "jwt" in data
+    with pytest.raises(KeyError):
+        scaffold("nonsense")
+
+
+# ------------------------------------------------------------ telemetry
+
+
+def test_telemetry_posts_only_from_leader():
+    from seaweedfs_tpu.utils.telemetry import TelemetryCollector
+
+    got = []
+
+    class Sink(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers["Content-Length"])
+            got.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    port = allocate_port()
+    httpd = HTTPServer(("127.0.0.1", port), Sink)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{port}/collect"
+        leader = [False]
+        tc = TelemetryCollector(
+            url,
+            lambda: {"volume_count": 3},
+            is_leader_fn=lambda: leader[0],
+        )
+        assert not tc.send_once()  # follower stays silent
+        assert got == []
+        leader[0] = True
+        assert tc.send_once()
+        assert got[0]["volume_count"] == 3
+        assert got[0]["cluster_id"] == tc.cluster_id
+        assert "/" in got[0]["os"]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# --------------------------------------------------------------- images
+
+
+def _png(w: int, h: int) -> bytes:
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.new("RGB", (w, h), (200, 10, 10)).save(buf, "PNG")
+    return buf.getvalue()
+
+
+def test_image_resize_modes():
+    from PIL import Image
+
+    from seaweedfs_tpu.utils.images import detect_format, resized
+
+    src = _png(100, 50)
+    assert detect_format(src) == "PNG"
+    out, w, h = resized(src, 50, 50)
+    assert (w, h) == (50, 25)  # aspect preserved
+    assert Image.open(io.BytesIO(out)).size == (50, 25)
+    out, w, h = resized(src, 40, 40, mode="fill")
+    assert Image.open(io.BytesIO(out)).size == (40, 40)  # exact crop
+    # default mode never upscales; fit does
+    out, w, h = resized(src, 400, 400)
+    assert Image.open(io.BytesIO(out)).size == (100, 50)
+    out, w, h = resized(src, 400, 400, mode="fit")
+    assert Image.open(io.BytesIO(out)).size == (400, 200)
+    # non-image bytes pass through untouched
+    blob = b"definitely not an image"
+    assert resized(blob, 10, 10)[0] == blob
+
+
+def test_volume_server_serves_thumbnails(spawned_cluster=None):
+    import requests
+
+    from seaweedfs_tpu.client.operations import Operations
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from PIL import Image
+    import tempfile
+
+    mport, vport = allocate_port(), allocate_port()
+    with tempfile.TemporaryDirectory() as td:
+        ms = MasterServer(ip="127.0.0.1", port=mport)
+        ms.start()
+        vs = VolumeServer(
+            directories=[td], master=f"127.0.0.1:{mport}",
+            ip="127.0.0.1", port=vport,
+        )
+        vs.start()
+        try:
+            ops = Operations(master=f"127.0.0.1:{mport}")
+            fid = ops.upload(_png(80, 40), name="pic.png")
+            url = ops.master.lookup(int(fid.split(",")[0]))[0].url
+            r = requests.get(f"http://{url}/{fid}?width=20", timeout=10)
+            assert r.status_code == 200
+            assert Image.open(io.BytesIO(r.content)).size == (20, 10)
+        finally:
+            vs.stop()
+            ms.stop()
+
+
+# ------------------------------------------------- request-id + pprof
+
+
+def test_request_id_and_pprof_on_master():
+    from seaweedfs_tpu.server.master import MasterServer
+
+    port = allocate_port()
+    ms = MasterServer(ip="127.0.0.1", port=port)
+    ms.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/dir/status",
+            headers={"X-Request-ID": "trace-me-123"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.headers.get("X-Request-ID") == "trace-me-123"
+        # absent: server mints one
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/dir/status", timeout=10
+        ) as r:
+            assert len(r.headers.get("X-Request-ID", "")) >= 8
+        # pprof: thread dump names this very request-handler thread
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/pprof/goroutine", timeout=10
+        ) as r:
+            dump = r.read().decode()
+        assert "thread" in dump and "do_GET" in dump
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/pprof/profile?seconds=0.3",
+            timeout=10,
+        ) as r:
+            prof = r.read().decode()
+        assert prof == "" or " " in prof.splitlines()[0]
+    finally:
+        ms.stop()
+
+
+def test_request_id_propagates_client_to_volume(tmp_path):
+    """One id across client → volume upload hop."""
+    import requests
+
+    from seaweedfs_tpu.client.operations import Operations
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.utils import request_id
+
+    mport, vport = allocate_port(), allocate_port()
+    ms = MasterServer(ip="127.0.0.1", port=mport)
+    ms.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path)], master=f"127.0.0.1:{mport}",
+        ip="127.0.0.1", port=vport,
+    )
+    vs.start()
+    try:
+        rid = request_id.ensure("e2e-0123456789ab")
+        ops = Operations(master=f"127.0.0.1:{mport}")
+        fid = ops.upload(b"traced payload", name="t.txt")
+        url = ops.master.lookup(int(fid.split(",")[0]))[0].url
+        r = requests.get(
+            f"http://{url}/{fid}", headers={"X-Request-ID": rid}, timeout=10
+        )
+        assert r.headers.get("X-Request-ID") == rid
+        assert r.content == b"traced payload"
+    finally:
+        request_id.clear()
+        vs.stop()
+        ms.stop()

@@ -61,6 +61,58 @@ def test_cert_hot_reload(tmp_path, certs):
         ms.stop()
 
 
+def test_stalled_client_does_not_block_listener(tmp_path, certs):
+    """A client that connects and never handshakes must not stall other
+    connections (the handshake runs per-connection, off the accept
+    loop)."""
+    import socket
+
+    port = free_port()
+    ms = MasterServer(ip="127.0.0.1", port=port, tls=certs)
+    ms.start()
+    stalled = socket.create_connection(("127.0.0.1", port), timeout=10)
+    try:
+        # with the stalled socket open and silent, a real client works
+        body = _get(
+            f"https://127.0.0.1:{port}/dir/status", certs.client_context()
+        )
+        assert body
+    finally:
+        stalled.close()
+        ms.stop()
+
+
+def test_cluster_internal_hops_over_https(tmp_path, certs, monkeypatch):
+    """enable_https() routes client→volume uploads/reads through https
+    (the service_url seam used by every internal hop)."""
+    from seaweedfs_tpu.client.operations import Operations
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.utils import urls
+
+    monkeypatch.setattr(urls, "_scheme", "http")  # restore after test
+    monkeypatch.setenv("REQUESTS_CA_BUNDLE", "")
+    urls.enable_https(certs.ca_file)
+    mport, vport = free_port(), free_port()
+    ms = MasterServer(ip="127.0.0.1", port=mport, tls=certs)
+    ms.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path / "v")],
+        master=f"127.0.0.1:{mport}",
+        ip="127.0.0.1",
+        port=vport,
+        tls=certs,
+    )
+    vs.start()
+    try:
+        ops = Operations(master=f"127.0.0.1:{mport}")
+        fid = ops.upload(b"tls payload", name="t.txt")
+        assert ops.read(fid) == b"tls payload"
+    finally:
+        vs.stop()
+        ms.stop()
+        urls._scheme = "http"
+
+
 def test_mutual_tls_requires_client_cert(tmp_path):
     dir_ = str(tmp_path / "mtls")
     server_cfg = generate_self_signed(dir_, name="server")
