@@ -175,6 +175,26 @@ class MqBroker:
                     json.dumps({"partitionCount": st.partition_count}).encode(),
                 )
 
+    def delete_topic(self, ns: str, name: str) -> None:
+        """Drop a topic: in-memory state AND its filer subtree
+        (topic.conf, offsets.json, segments) — otherwise a restart
+        resurrects the topic, and a re-created topic's offsets would
+        collide with stale segments."""
+        with self._lock:
+            self._topics.pop((ns, name), None)
+            self._offsets = {
+                k: v
+                for k, v in self._offsets.items()
+                if (k[0], k[1]) != (ns, name)
+            }
+        if self.filer:
+            r = self._http.delete(
+                self._url(f"{TOPICS_ROOT}/{ns}/{name}?recursive=true"),
+                timeout=60,
+            )
+            if r.status_code not in (204, 404):
+                r.raise_for_status()
+
     def topic(self, ns: str, name: str) -> _TopicState:
         st = self._topics.get((ns, name))
         if st is None:
@@ -343,7 +363,10 @@ class MqBrokerServer:
         grpc_port: int = 17777,
         filer: str = "",
         segment_records: int = 4096,
+        kafka_port: int = -1,
     ):
+        """kafka_port >= 0 also serves the Kafka wire protocol on that
+        port (0 = ephemeral; see .kafka.port)."""
         self.ip = ip
         self.grpc_port = grpc_port
         self.broker = MqBroker(filer=filer, segment_records=segment_records)
@@ -351,10 +374,19 @@ class MqBrokerServer:
         self._grpc = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
         rpc.add_service(self._grpc, rpc.MQ_SERVICE, self.service)
         self._grpc.add_insecure_port(f"{ip}:{grpc_port}")
+        self.kafka = None
+        if kafka_port >= 0:
+            from .kafka.gateway import KafkaGateway
+
+            self.kafka = KafkaGateway(self.broker, ip=ip, port=kafka_port)
 
     def start(self) -> None:
         self._grpc.start()
+        if self.kafka is not None:
+            self.kafka.start()
 
     def stop(self) -> None:
+        if self.kafka is not None:
+            self.kafka.stop()
         self.broker.flush()
         self._grpc.stop(grace=0.5)

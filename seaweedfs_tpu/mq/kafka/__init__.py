@@ -1,0 +1,12 @@
+"""Kafka wire-protocol gateway over the MQ broker.
+
+Reference: weed/mq/kafka (39k LoC) — protocol codec under protocol/,
+gateway server under gateway/, group coordination under consumer/.
+This package implements the wire subset real clients need: ApiVersions,
+Metadata, Produce/Fetch (record batches v2), ListOffsets, CreateTopics/
+DeleteTopics, FindCoordinator and the classic consumer-group protocol
+(JoinGroup/SyncGroup/Heartbeat/LeaveGroup/OffsetCommit/OffsetFetch),
+mapped onto the MqBroker partition logs.
+"""
+
+from .gateway import KafkaGateway  # noqa: F401

@@ -1,0 +1,790 @@
+"""The Kafka wire-protocol gateway server.
+
+Reference: weed/mq/kafka/gateway/server.go + protocol/ handlers — a TCP
+listener speaking the Kafka binary protocol, mapping topics onto the
+MqBroker's partition logs (namespace "kafka"). Kafka clients configure
+it as a single-broker cluster: this gateway is every partition's leader
+and every group's coordinator.
+
+Framing: i32 length | request header (api_key i16, api_version i16,
+correlation_id i32, client_id nullable-string) | body. Responses:
+i32 length | correlation_id i32 | body. Only non-flexible request
+versions are advertised (see _API_RANGES), so tagged fields never
+appear on the wire.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+
+from ...utils.glog import logger
+from . import protocol as kp
+from .groups import GroupCoordinator
+from .protocol import Reader, Writer
+from .records import Record, UnsupportedCompression, decode_batches, encode_batch
+
+log = logger("kafka")
+
+NAMESPACE = "kafka"
+
+# api_key -> (min_version, max_version) actually implemented
+_API_RANGES: dict[int, tuple[int, int]] = {
+    kp.PRODUCE: (3, 7),
+    kp.FETCH: (4, 5),
+    kp.LIST_OFFSETS: (0, 2),
+    kp.METADATA: (0, 5),
+    kp.OFFSET_COMMIT: (0, 3),
+    kp.OFFSET_FETCH: (0, 3),
+    kp.FIND_COORDINATOR: (0, 1),
+    kp.JOIN_GROUP: (0, 2),
+    kp.HEARTBEAT: (0, 1),
+    kp.LEAVE_GROUP: (0, 1),
+    kp.SYNC_GROUP: (0, 1),
+    kp.DESCRIBE_GROUPS: (0, 1),
+    kp.LIST_GROUPS: (0, 1),
+    kp.API_VERSIONS: (0, 2),
+    kp.CREATE_TOPICS: (0, 2),
+    kp.DELETE_TOPICS: (0, 1),
+}
+
+NODE_ID = 0
+
+
+class KafkaGateway:
+    def __init__(
+        self,
+        broker,
+        ip: str = "localhost",
+        port: int = 9092,
+        advertised_host: str | None = None,
+        auto_create_partitions: int = 1,
+    ):
+        self.broker = broker
+        self.ip = ip
+        self.advertised_host = advertised_host or ip
+        self.auto_create_partitions = auto_create_partitions
+        self.coordinator = GroupCoordinator()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((ip, port))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(64)
+        self._stop = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True
+        )
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._accept_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.coordinator.stop()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    # --------------------------------------------------------- connection
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while not self._stop.is_set():
+                head = self._read_exact(conn, 4)
+                if head is None:
+                    return
+                (size,) = struct.unpack(">i", head)
+                if size <= 0 or size > 64 * 1024 * 1024:
+                    return
+                frame = self._read_exact(conn, size)
+                if frame is None:
+                    return
+                resp = self._handle_frame(frame)
+                if resp is not None:
+                    conn.sendall(struct.pack(">i", len(resp)) + resp)
+        except (OSError, EOFError, ValueError) as e:
+            log.v(1).info("connection dropped: %s", e)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _read_exact(conn: socket.socket, n: int) -> bytes | None:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _handle_frame(self, frame: bytes) -> bytes | None:
+        r = Reader(frame)
+        api_key = r.i16()
+        api_version = r.i16()
+        correlation_id = r.i32()
+        r.nullable_string()  # client_id
+        out = Writer().i32(correlation_id)
+        lo_hi = _API_RANGES.get(api_key)
+        if lo_hi is None or not lo_hi[0] <= api_version <= lo_hi[1]:
+            # KIP-511: answer an out-of-range ApiVersions with a v0 body
+            # carrying UNSUPPORTED_VERSION + our ranges so the client
+            # can downgrade; other apis get the error-only body.
+            if api_key == kp.API_VERSIONS:
+                self._api_versions_body(out, 0, kp.UNSUPPORTED_VERSION)
+                return out.done()
+            out.i16(kp.UNSUPPORTED_VERSION)
+            return out.done()
+        handler = {
+            kp.API_VERSIONS: self._h_api_versions,
+            kp.METADATA: self._h_metadata,
+            kp.PRODUCE: self._h_produce,
+            kp.FETCH: self._h_fetch,
+            kp.LIST_OFFSETS: self._h_list_offsets,
+            kp.CREATE_TOPICS: self._h_create_topics,
+            kp.DELETE_TOPICS: self._h_delete_topics,
+            kp.FIND_COORDINATOR: self._h_find_coordinator,
+            kp.OFFSET_COMMIT: self._h_offset_commit,
+            kp.OFFSET_FETCH: self._h_offset_fetch,
+            kp.JOIN_GROUP: self._h_join_group,
+            kp.SYNC_GROUP: self._h_sync_group,
+            kp.HEARTBEAT: self._h_heartbeat,
+            kp.LEAVE_GROUP: self._h_leave_group,
+            kp.LIST_GROUPS: self._h_list_groups,
+            kp.DESCRIBE_GROUPS: self._h_describe_groups,
+        }[api_key]
+        body = handler(r, api_version)
+        if body is None:  # acks=0 produce: no response frame at all
+            return None
+        return out.raw(body).done()
+
+    # ------------------------------------------------------- topic helpers
+
+    def _log_for(self, topic: str, partition: int):
+        try:
+            st = self.broker.topic(NAMESPACE, topic)
+        except KeyError:
+            return None
+        return st.logs.get(partition)
+
+    def _partitions(self, topic: str) -> int:
+        try:
+            return self.broker.topic(NAMESPACE, topic).partition_count
+        except KeyError:
+            return -1
+
+    # ----------------------------------------------------------- handlers
+
+    def _api_versions_body(self, w: Writer, version: int, error: int) -> None:
+        w.i16(error)
+        w.array(
+            sorted(_API_RANGES.items()),
+            lambda ww, kv: ww.i16(kv[0]).i16(kv[1][0]).i16(kv[1][1]),
+        )
+        if version >= 1:
+            w.i32(0)  # throttle_time_ms
+
+    def _h_api_versions(self, r: Reader, v: int) -> bytes:
+        w = Writer()
+        self._api_versions_body(w, v, kp.NONE)
+        return w.done()
+
+    def _h_metadata(self, r: Reader, v: int) -> bytes:
+        n = r.i32()
+        wanted: list[str] | None
+        if n < 0:
+            wanted = None  # all topics
+        else:
+            wanted = [r.string() for _ in range(n)]
+        allow_auto = True
+        if v >= 4:
+            allow_auto = r.i8() != 0
+        existing = {
+            name
+            for ns, name, _c in self.broker.list_topics()
+            if ns == NAMESPACE
+        }
+        if wanted is None:
+            topics = sorted(existing)
+        else:
+            topics = wanted
+            if allow_auto:
+                for t in wanted:
+                    if t not in existing and _valid_topic(t):
+                        self.broker.configure_topic(
+                            NAMESPACE, t, self.auto_create_partitions
+                        )
+                        existing.add(t)
+        w = Writer()
+        if v >= 3:
+            w.i32(0)  # throttle
+        # brokers: just us
+        def broker_entry(ww: Writer, _):
+            ww.i32(NODE_ID).string(self.advertised_host).i32(self.port)
+            if v >= 1:
+                ww.nullable_string(None)  # rack
+
+        w.array([None], broker_entry)
+        if v >= 2:
+            w.nullable_string("seaweedfs-tpu-kafka")  # cluster_id
+        if v >= 1:
+            w.i32(NODE_ID)  # controller_id
+
+        def topic_entry(ww: Writer, name: str):
+            count = self._partitions(name)
+            if count < 0:
+                ww.i16(
+                    kp.INVALID_TOPIC_EXCEPTION
+                    if not _valid_topic(name)
+                    else kp.UNKNOWN_TOPIC_OR_PARTITION
+                )
+                ww.string(name)
+                if v >= 1:
+                    ww.i8(0)  # is_internal
+                ww.i32(0)  # empty partitions
+                return
+            ww.i16(kp.NONE).string(name)
+            if v >= 1:
+                ww.i8(0)
+
+            def part_entry(w3: Writer, p: int):
+                w3.i16(kp.NONE).i32(p).i32(NODE_ID)
+                w3.array([NODE_ID], lambda w4, nid: w4.i32(nid))  # replicas
+                w3.array([NODE_ID], lambda w4, nid: w4.i32(nid))  # isr
+                if v >= 5:
+                    w3.array([], lambda w4, nid: w4.i32(nid))  # offline
+
+            ww.array(list(range(count)), part_entry)
+
+        w.array(topics, topic_entry)
+        return w.done()
+
+    def _h_produce(self, r: Reader, v: int) -> bytes | None:
+        r.nullable_string()  # transactional_id (v3+)
+        acks = r.i16()
+        r.i32()  # timeout_ms
+        results: list[tuple[str, list[tuple[int, int, int]]]] = []
+        for _ in range(r.i32()):
+            topic = r.string()
+            parts: list[tuple[int, int, int]] = []  # (part, error, base)
+            for _p in range(r.i32()):
+                part = r.i32()
+                blob = r.nullable_bytes() or b""
+                plog = self._log_for(topic, part)
+                if plog is None:
+                    parts.append((part, kp.UNKNOWN_TOPIC_OR_PARTITION, -1))
+                    continue
+                try:
+                    records = decode_batches(blob)
+                except UnsupportedCompression:
+                    parts.append(
+                        (part, kp.UNSUPPORTED_COMPRESSION_TYPE, -1)
+                    )
+                    continue
+                except ValueError:
+                    parts.append((part, kp.CORRUPT_MESSAGE, -1))
+                    continue
+                base = -1
+                for rec in records:
+                    ts_ns = (
+                        rec.timestamp_ms * 1_000_000
+                        if rec.timestamp_ms
+                        else time.time_ns()
+                    )
+                    off = plog.append(
+                        ts_ns, _pack_null(rec.key), _pack_null(rec.value)
+                    )
+                    if base < 0:
+                        base = off
+                parts.append((part, kp.NONE, base))
+            results.append((topic, parts))
+        if acks == 0:
+            return None
+        w = Writer()
+
+        def topic_entry(ww: Writer, tp):
+            name, parts = tp
+            ww.string(name)
+
+            def part_entry(w3: Writer, pr):
+                part, err, base = pr
+                w3.i32(part).i16(err).i64(base)
+                if v >= 2:
+                    w3.i64(-1)  # log_append_time
+                if v >= 5:
+                    w3.i64(0)  # log_start_offset
+
+            ww.array(parts, part_entry)
+
+        w.array(results, topic_entry)
+        w.i32(0)  # throttle (v1+)
+        return w.done()
+
+    def _h_fetch(self, r: Reader, v: int) -> bytes:
+        r.i32()  # replica_id
+        max_wait_ms = r.i32()
+        r.i32()  # min_bytes
+        r.i32()  # max_bytes (v3+)
+        r.i8()  # isolation_level (v4+)
+        requests: list[tuple[str, list[tuple[int, int, int]]]] = []
+        for _ in range(r.i32()):
+            topic = r.string()
+            parts = []
+            for _p in range(r.i32()):
+                part = r.i32()
+                fetch_offset = r.i64()
+                if v >= 5:
+                    r.i64()  # log_start_offset
+                pmax = r.i32()
+                parts.append((part, fetch_offset, pmax))
+            requests.append((topic, parts))
+        # long-poll: when every requested partition is empty, wait for
+        # the first one to grow (bounded by max_wait)
+        deadline = time.monotonic() + max(max_wait_ms, 0) / 1000.0
+        while time.monotonic() < deadline:
+            any_data = False
+            for topic, parts in requests:
+                for part, off, _m in parts:
+                    plog = self._log_for(topic, part)
+                    if plog is not None and plog.next_offset > off:
+                        any_data = True
+                        break
+                if any_data:
+                    break
+            if any_data:
+                break
+            time.sleep(0.01)
+        w = Writer()
+        w.i32(0)  # throttle
+
+        def topic_entry(ww: Writer, tp):
+            name, parts = tp
+            ww.string(name)
+
+            def part_entry(w3: Writer, pr):
+                part, off, pmax = pr
+                plog = self._log_for(name, part)
+                if plog is None:
+                    w3.i32(part).i16(kp.UNKNOWN_TOPIC_OR_PARTITION)
+                    w3.i64(-1).i64(-1)
+                    if v >= 5:
+                        w3.i64(-1)
+                    w3.array([], lambda *_: None)
+                    w3.nullable_bytes(None)
+                    return
+                hw = plog.next_offset
+                if off > hw or (off < plog.earliest_offset):
+                    w3.i32(part).i16(kp.OFFSET_OUT_OF_RANGE)
+                    w3.i64(hw).i64(hw)
+                    if v >= 5:
+                        w3.i64(plog.earliest_offset)
+                    w3.array([], lambda *_: None)
+                    w3.nullable_bytes(None)
+                    return
+                recs = plog.read_from(off, max_records=1024)
+                batch = b""
+                if recs:
+                    if pmax > 0:
+                        # honor partition max_bytes, but always ship at
+                        # least one record so the consumer makes
+                        # progress (Kafka's oversized-first-batch rule)
+                        kept, size = [], 64  # batch header overhead
+                        for rec in recs:
+                            size += 16 + len(rec[2]) + len(rec[3])
+                            if kept and size > pmax:
+                                break
+                            kept.append(rec)
+                        recs = kept
+                    batch = encode_batch(
+                        [
+                            Record(
+                                key=_unpack_null(k),
+                                value=_unpack_null(val),
+                                timestamp_ms=ts // 1_000_000,
+                                offset=o,
+                            )
+                            for o, ts, k, val in recs
+                        ],
+                        base_offset=recs[0][0],
+                    )
+                w3.i32(part).i16(kp.NONE)
+                w3.i64(hw).i64(hw)  # high_watermark, last_stable
+                if v >= 5:
+                    w3.i64(plog.earliest_offset)
+                w3.array([], lambda *_: None)  # aborted_transactions
+                w3.nullable_bytes(batch if batch else None)
+
+            ww.array(parts, part_entry)
+
+        w.array(requests, topic_entry)
+        return w.done()
+
+    def _h_list_offsets(self, r: Reader, v: int) -> bytes:
+        r.i32()  # replica_id
+        if v >= 2:
+            r.i8()  # isolation
+        req = []
+        for _ in range(r.i32()):
+            topic = r.string()
+            parts = []
+            for _p in range(r.i32()):
+                part = r.i32()
+                ts = r.i64()
+                if v == 0:
+                    r.i32()  # max_num_offsets
+                parts.append((part, ts))
+            req.append((topic, parts))
+        w = Writer()
+        if v >= 2:
+            w.i32(0)  # throttle
+
+        def topic_entry(ww: Writer, tp):
+            name, parts = tp
+            ww.string(name)
+
+            def part_entry(w3: Writer, pt):
+                part, ts = pt
+                plog = self._log_for(name, part)
+                if plog is None:
+                    err, off = kp.UNKNOWN_TOPIC_OR_PARTITION, -1
+                elif ts == -1:  # latest
+                    err, off = kp.NONE, plog.next_offset
+                elif ts == -2:  # earliest
+                    err, off = kp.NONE, plog.earliest_offset
+                else:
+                    err, off = kp.NONE, _offset_for_time(plog, ts)
+                w3.i32(part).i16(err)
+                if v == 0:
+                    w3.array(
+                        [off] if off >= 0 else [],
+                        lambda w4, o: w4.i64(o),
+                    )
+                else:
+                    w3.i64(ts if err == kp.NONE else -1).i64(off)
+
+            ww.array(parts, part_entry)
+
+        w.array(req, topic_entry)
+        return w.done()
+
+    def _h_create_topics(self, r: Reader, v: int) -> bytes:
+        topics = []
+        for _ in range(r.i32()):
+            name = r.string()
+            num_partitions = r.i32()
+            r.i16()  # replication_factor
+            for _a in range(max(r.i32(), 0)):  # manual assignments
+                r.i32()
+                r.array(r.i32)
+            for _c in range(max(r.i32(), 0)):  # configs
+                r.string()
+                r.nullable_string()
+            topics.append((name, num_partitions))
+        r.i32()  # timeout
+        validate_only = v >= 1 and r.i8() != 0
+        existing = {
+            name
+            for ns, name, _c in self.broker.list_topics()
+            if ns == NAMESPACE
+        }
+        w = Writer()
+        if v >= 2:
+            w.i32(0)  # throttle
+
+        def entry(ww: Writer, tp):
+            name, count = tp
+            if not _valid_topic(name):
+                err = kp.INVALID_TOPIC_EXCEPTION
+            elif name in existing:
+                err = kp.TOPIC_ALREADY_EXISTS
+            else:
+                err = kp.NONE
+                if not validate_only:
+                    self.broker.configure_topic(
+                        NAMESPACE, name, max(count, 1)
+                    )
+            ww.string(name).i16(err)
+            if v >= 1:
+                ww.nullable_string(None)  # error_message
+
+        w.array(topics, entry)
+        return w.done()
+
+    def _h_delete_topics(self, r: Reader, v: int) -> bytes:
+        names = r.array(r.string)
+        r.i32()  # timeout
+        existing = {
+            name
+            for ns, name, _c in self.broker.list_topics()
+            if ns == NAMESPACE
+        }
+        w = Writer()
+        if v >= 1:
+            w.i32(0)
+
+        def entry(ww: Writer, name: str):
+            if name in existing:
+                self.broker.delete_topic(NAMESPACE, name)
+                ww.string(name).i16(kp.NONE)
+            else:
+                ww.string(name).i16(kp.UNKNOWN_TOPIC_OR_PARTITION)
+
+        w.array(names, entry)
+        return w.done()
+
+    def _h_find_coordinator(self, r: Reader, v: int) -> bytes:
+        r.string()  # key (group id)
+        if v >= 1:
+            r.i8()  # key_type
+        w = Writer()
+        if v >= 1:
+            w.i32(0)  # throttle
+        w.i16(kp.NONE)
+        if v >= 1:
+            w.nullable_string(None)  # error_message
+        w.i32(NODE_ID).string(self.advertised_host).i32(self.port)
+        return w.done()
+
+    # ------------------------------------------------- group offset apis
+
+    def _h_offset_commit(self, r: Reader, v: int) -> bytes:
+        group = r.string()
+        if v >= 1:
+            r.i32()  # generation
+            r.string()  # member
+        if v >= 2:
+            r.i64()  # retention_time
+        results = []
+        for _ in range(r.i32()):
+            topic = r.string()
+            parts = []
+            for _p in range(r.i32()):
+                part = r.i32()
+                offset = r.i64()
+                if v == 1:
+                    r.i64()  # commit timestamp
+                r.nullable_string()  # metadata
+                known = 0 <= part < max(self._partitions(topic), 0)
+                if known:
+                    self.broker.commit_offset(
+                        NAMESPACE, topic, part, group, offset
+                    )
+                    parts.append((part, kp.NONE))
+                else:
+                    parts.append((part, kp.UNKNOWN_TOPIC_OR_PARTITION))
+            results.append((topic, parts))
+        w = Writer()
+        if v >= 3:
+            w.i32(0)
+        w.array(
+            results,
+            lambda ww, tp: ww.string(tp[0]).array(
+                tp[1], lambda w3, pe: w3.i32(pe[0]).i16(pe[1])
+            ),
+        )
+        return w.done()
+
+    def _h_offset_fetch(self, r: Reader, v: int) -> bytes:
+        group = r.string()
+        req = []
+        n = r.i32()
+        if n >= 0:
+            for _ in range(n):
+                topic = r.string()
+                parts = r.array(r.i32)
+                req.append((topic, parts))
+        else:  # null = all topics with commits; serve configured topics
+            for ns, name, count in self.broker.list_topics():
+                if ns == NAMESPACE:
+                    req.append((name, list(range(count))))
+        w = Writer()
+        if v >= 3:
+            w.i32(0)
+
+        def topic_entry(ww: Writer, tp):
+            name, parts = tp
+            ww.string(name)
+
+            def part_entry(w3: Writer, part: int):
+                off = self.broker.fetch_offset(NAMESPACE, name, part, group)
+                w3.i32(part).i64(off).nullable_string(None).i16(kp.NONE)
+
+            ww.array(parts, part_entry)
+
+        w.array(req, topic_entry)
+        if v >= 2:
+            w.i16(kp.NONE)  # top-level error
+        return w.done()
+
+    # --------------------------------------------------- group membership
+
+    def _h_join_group(self, r: Reader, v: int) -> bytes:
+        group_id = r.string()
+        session_timeout = r.i32() / 1000.0
+        rebalance_timeout = session_timeout
+        if v >= 1:
+            rebalance_timeout = r.i32() / 1000.0
+        member_id = r.string()
+        protocol_type = r.string()
+        protocols = [
+            (p_name, p_meta)
+            for p_name, p_meta in (
+                (r.string(), r.bytes_()) for _ in range(r.i32())
+            )
+        ]
+        g = self.coordinator.group(group_id)
+        resp = g.join(
+            member_id,
+            client_id="",
+            protocol_type=protocol_type,
+            protocols=protocols,
+            session_timeout=max(session_timeout, 1.0),
+            rebalance_timeout=max(rebalance_timeout, 1.0),
+        )
+        w = Writer()
+        if v >= 2:
+            w.i32(0)  # throttle
+        if resp["error"] != kp.NONE:
+            w.i16(resp["error"]).i32(-1).string("").string("").string("")
+            w.array([], lambda *_: None)
+            return w.done()
+        w.i16(kp.NONE).i32(resp["generation"]).string(resp["protocol"])
+        w.string(resp["leader"]).string(resp["member_id"])
+        w.array(
+            resp["members"],
+            lambda ww, m: ww.string(m[0]).bytes_(m[1]),
+        )
+        return w.done()
+
+    def _h_sync_group(self, r: Reader, v: int) -> bytes:
+        group_id = r.string()
+        generation = r.i32()
+        member_id = r.string()
+        assignments = [
+            (mid, blob)
+            for mid, blob in (
+                (r.string(), r.bytes_()) for _ in range(r.i32())
+            )
+        ]
+        err, blob = self.coordinator.group(group_id).sync(
+            member_id, generation, assignments
+        )
+        w = Writer()
+        if v >= 1:
+            w.i32(0)
+        w.i16(err).bytes_(blob)
+        return w.done()
+
+    def _h_heartbeat(self, r: Reader, v: int) -> bytes:
+        group_id = r.string()
+        generation = r.i32()
+        member_id = r.string()
+        err = self.coordinator.group(group_id).heartbeat(
+            member_id, generation
+        )
+        w = Writer()
+        if v >= 1:
+            w.i32(0)
+        w.i16(err)
+        return w.done()
+
+    def _h_leave_group(self, r: Reader, v: int) -> bytes:
+        group_id = r.string()
+        member_id = r.string()
+        err = self.coordinator.group(group_id).leave(member_id)
+        w = Writer()
+        if v >= 1:
+            w.i32(0)
+        w.i16(err)
+        return w.done()
+
+    def _h_list_groups(self, r: Reader, v: int) -> bytes:
+        w = Writer()
+        if v >= 1:
+            w.i32(0)
+        w.i16(kp.NONE)
+        w.array(
+            self.coordinator.list_groups(),
+            lambda ww, g: ww.string(g[0]).string(g[1]),
+        )
+        return w.done()
+
+    def _h_describe_groups(self, r: Reader, v: int) -> bytes:
+        names = r.array(r.string)
+        w = Writer()
+        if v >= 1:
+            w.i32(0)
+
+        def entry(ww: Writer, name: str):
+            g = self.coordinator.group(name)
+            with g.lock:
+                ww.i16(kp.NONE).string(name).string(g.state)
+                ww.string(g.protocol_type).string(g.protocol_name)
+
+                def member_entry(w3: Writer, m):
+                    w3.string(m.member_id).string(m.client_id)
+                    w3.string("/127.0.0.1")
+                    w3.bytes_(g._metadata_for(m)).bytes_(m.assignment)
+
+                ww.array(list(g.members.values()), member_entry)
+
+        w.array(names, entry)
+        return w.done()
+
+
+def _pack_null(b: bytes | None) -> bytes:
+    """Kafka keys/values are nullable (a null value IS a compaction
+    tombstone) but the partition log stores plain bytes — a one-byte
+    flag preserves null vs empty. Only topics in the kafka namespace
+    use this framing."""
+    return b"\x00" if b is None else b"\x01" + b
+
+
+def _unpack_null(b: bytes) -> bytes | None:
+    if not b or b[0] == 0:
+        return None
+    return b[1:]
+
+
+def _valid_topic(name: str) -> bool:
+    return (
+        0 < len(name) <= 249
+        and name not in (".", "..")
+        and all(c.isalnum() or c in "._-" for c in name)
+    )
+
+
+def _offset_for_time(plog, ts_ms: int, scan_limit: int = 10_000) -> int:
+    """First offset whose timestamp >= ts_ms (bounded scan), -1 when
+    nothing qualifies."""
+    ts_ns = ts_ms * 1_000_000
+    off = plog.earliest_offset
+    scanned = 0
+    while scanned < scan_limit:
+        recs = plog.read_from(off, max_records=1024)
+        if not recs:
+            return -1
+        for o, rts, _k, _v in recs:
+            if rts >= ts_ns:
+                return o
+        scanned += len(recs)
+        off = recs[-1][0] + 1
+    return -1

@@ -14,12 +14,26 @@ from .sync import FilerSync
 
 
 def main(argv=None) -> int:
+    # replication.toml supplies source/sink defaults (scaffold template)
+    from ..utils.config import load_config
+
+    rcfg = load_config("replication")
     p = argparse.ArgumentParser(prog="seaweedfs_tpu.replication")
-    p.add_argument("-from", dest="source", required=True)
-    p.add_argument("-to", dest="target", required=True)
-    p.add_argument("-path", default="/")
+    p.add_argument(
+        "-from", dest="source",
+        default=rcfg.get_str("source.filer.address"),
+    )
+    p.add_argument(
+        "-to", dest="target",
+        default=rcfg.get_str("sink.filer.address"),
+    )
+    p.add_argument(
+        "-path", default=rcfg.get_str("sink.filer.directory", "/") or "/"
+    )
     p.add_argument("-state", default="filer.sync.state")
     a = p.parse_args(argv)
+    if not a.source or not a.target:
+        p.error("-from/-to required (or replication.toml source/sink)")
     sync = FilerSync(a.source, a.target, a.path, a.state)
     signal.signal(signal.SIGTERM, lambda *x: sync.stop())
     signal.signal(signal.SIGINT, lambda *x: sync.stop())

@@ -106,6 +106,13 @@ def main(argv=None) -> int:
     b.add_argument("-port", type=int, default=17777)
     b.add_argument("-filer", default="", help="filer host:port for durable segments")
     b.add_argument("-segmentRecords", type=int, default=4096)
+    b.add_argument(
+        "-kafkaPort", type=int, default=-1,
+        help="also speak the Kafka wire protocol on this port (-1 = off)",
+    )
+    # broker dials the filer: it needs the https switch from
+    # security.toml even though it has no HTTP listener of its own
+    _add_tls_flags(b)
 
     s = sub.add_parser("server")
     s.add_argument("-ip", default="localhost")
@@ -184,6 +191,58 @@ def main(argv=None) -> int:
         from ..utils.urls import enable_https
 
         enable_https(getattr(a, "tls_ca", "") or a.tls_cert)
+
+    # mode-specific TOML defaults, field-wise under flags (each file
+    # `scaffold` can emit is honored by the mode that owns it)
+    if a.mode in ("volume", "server"):
+        vcfg = load_config("volume")
+        if vcfg:
+            if getattr(a, "index", "memory") == "memory":
+                a.index = vcfg.get_str("volume.index", "memory") or "memory"
+            if a.ec_backend == "auto":
+                a.ec_backend = (
+                    vcfg.get_str("volume.ec_backend", "auto") or "auto"
+                )
+            if a.max == 8:
+                a.max = int(vcfg.get("volume.store.max_volumes", 8))
+    if a.mode in ("master", "server"):
+        mcfg = load_config("master")
+        if mcfg and getattr(a, "ec_auto", 0.0) == 0.0:
+            a.ec_auto = float(
+                mcfg.get("master.maintenance.ec_auto_fullness", 0.0)
+            )
+        a.garbage_threshold = float(
+            mcfg.get("master.vacuum.garbage_threshold", 0.3)
+        )
+        a.vacuum_interval = float(
+            mcfg.get("master.vacuum.interval_seconds", 60)
+        )
+    if a.mode in ("filer", "server"):
+        fcfg = load_config("filer")
+        if (
+            fcfg
+            and fcfg.get("sqlite.enabled")
+            and fcfg.get_str("sqlite.dbFile")
+            and getattr(a, "dir", None) in (None, "./filerdb")
+            and a.mode == "filer"
+        ):
+            a.dir = os.path.dirname(fcfg.get_str("sqlite.dbFile")) or "."
+        ncfg = load_config("notification")
+        if ncfg:
+            if not getattr(a, "notify_webhook", "") and ncfg.get(
+                "notification.webhook.enabled"
+            ):
+                a.notify_webhook = ncfg.get_str(
+                    "notification.webhook.endpoint"
+                )
+            if not getattr(a, "notify_mq", "") and ncfg.get(
+                "notification.mq.enabled"
+            ):
+                a.notify_mq = ncfg.get_str("notification.mq.broker")
+    if a.mode == "server" and getattr(a, "s3", False):
+        scfg = load_config("s3")
+        if scfg and not a.s3Config:
+            a.s3Config = scfg.get_str("s3.config")
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *x: stop.set())
     signal.signal(signal.SIGINT, lambda *x: stop.set())
@@ -197,10 +256,15 @@ def main(argv=None) -> int:
             grpc_port=a.port,
             filer=a.filer,
             segment_records=a.segmentRecords,
+            kafka_port=a.kafkaPort,
         )
         bs.start()
         servers.append(bs)
-        log.info("mq broker on %s:%s (filer=%s)", a.ip, a.port, a.filer or "memory-only")
+        log.info(
+            "mq broker on %s:%s (filer=%s%s)",
+            a.ip, a.port, a.filer or "memory-only",
+            f", kafka on :{bs.kafka.port}" if bs.kafka else "",
+        )
 
     if a.mode in ("master", "server"):
         from .master import MasterServer
@@ -219,6 +283,8 @@ def main(argv=None) -> int:
             meta_dir=getattr(a, "mdir", "") or None,
             tls=_tls_from(a),
             telemetry_url=getattr(a, "telemetry_url", ""),
+            garbage_threshold=getattr(a, "garbage_threshold", 0.3),
+            vacuum_interval=getattr(a, "vacuum_interval", 60.0),
         )
         ms.start()
         servers.append(ms)

@@ -1033,6 +1033,11 @@ class VolumeServer:
                     except ValueError:
                         rw = rh = 0  # malformed dims: serve the original
                     rmode = rq.get("mode", [""])[0]
+                    if rmode not in ("", "fit", "fill"):
+                        # whitelist: the value is echoed into the ETag
+                        # header, so arbitrary bytes would be header
+                        # injection (response splitting)
+                        rmode = ""
                     out, _, _ = resized(data, rw, rh, rmode)
                     if out is not data:
                         data = out

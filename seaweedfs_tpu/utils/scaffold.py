@@ -87,13 +87,14 @@ broker = "localhost:17777"
 topic = "filer-events"
 """,
     "replication": """\
-# replication.toml — cross-cluster sync (filer.sync daemon)
+# replication.toml — cross-cluster sync (filer.sync daemon),
+# consumed by `python -m seaweedfs_tpu.replication`
 [source.filer]
-grpcAddress = "localhost:18888"
+address = "localhost:8888"
 
 [sink.filer]
-grpcAddress = "localhost:28888"
-directory = "/backup"
+address = "localhost:28888"
+directory = "/"
 """,
 }
 

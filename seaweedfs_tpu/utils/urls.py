@@ -18,10 +18,33 @@ _scheme = "http"
 
 
 def enable_https(ca_file: str | None = None) -> None:
+    """Switch internal hops to https, trusting the cluster CA IN
+    ADDITION to the public roots — overwriting the trust store with
+    just the cluster CA would break every external https call (cloud
+    tier backends, webhooks)."""
     global _scheme
     _scheme = "https"
-    if ca_file:
-        os.environ["REQUESTS_CA_BUNDLE"] = ca_file
+    if not ca_file:
+        return
+    bundle = ca_file
+    try:
+        import tempfile
+
+        import certifi
+
+        with open(certifi.where(), "rb") as f:
+            roots = f.read()
+        with open(ca_file, "rb") as f:
+            cluster = f.read()
+        tmp = tempfile.NamedTemporaryFile(
+            mode="wb", suffix=".pem", prefix="sw-ca-", delete=False
+        )
+        tmp.write(roots + b"\n" + cluster)
+        tmp.close()
+        bundle = tmp.name
+    except Exception:
+        pass  # fall back to the cluster CA alone
+    os.environ["REQUESTS_CA_BUNDLE"] = bundle
 
 
 def scheme() -> str:
