@@ -364,9 +364,12 @@ class MqBrokerServer:
         filer: str = "",
         segment_records: int = 4096,
         kafka_port: int = -1,
+        pg_port: int = -1,
+        pg_users: dict[str, str] | None = None,
     ):
         """kafka_port >= 0 also serves the Kafka wire protocol on that
-        port (0 = ephemeral; see .kafka.port)."""
+        port; pg_port >= 0 serves PostgreSQL clients a SQL view over
+        the topics (0 = ephemeral; see .kafka.port / .pg.port)."""
         self.ip = ip
         self.grpc_port = grpc_port
         self.broker = MqBroker(filer=filer, segment_records=segment_records)
@@ -379,14 +382,26 @@ class MqBrokerServer:
             from .kafka.gateway import KafkaGateway
 
             self.kafka = KafkaGateway(self.broker, ip=ip, port=kafka_port)
+        self.pg = None
+        if pg_port >= 0:
+            from ..query.engine import QueryEngine
+            from ..query.pg_server import PgServer
+
+            self.pg = PgServer(
+                QueryEngine(self.broker), ip=ip, port=pg_port, users=pg_users
+            )
 
     def start(self) -> None:
         self._grpc.start()
         if self.kafka is not None:
             self.kafka.start()
+        if self.pg is not None:
+            self.pg.start()
 
     def stop(self) -> None:
         if self.kafka is not None:
             self.kafka.stop()
+        if self.pg is not None:
+            self.pg.stop()
         self.broker.flush()
         self._grpc.stop(grace=0.5)

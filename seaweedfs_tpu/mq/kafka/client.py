@@ -215,7 +215,7 @@ class KafkaClient:
         err = r.i16()
         hw = r.i64()
         r.i64()  # last_stable
-        r.array(lambda: (r.i64(), r.i64(), r.i64()))  # aborted txns
+        r.array(lambda: (r.i64(), r.i64()))  # aborted txns (pid, first_offset)
         blob = r.nullable_bytes()
         if err:
             raise KafkaError(err, "Fetch")

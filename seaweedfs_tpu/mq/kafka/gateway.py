@@ -210,8 +210,10 @@ class KafkaGateway:
     def _h_metadata(self, r: Reader, v: int) -> bytes:
         n = r.i32()
         wanted: list[str] | None
-        if n < 0:
-            wanted = None  # all topics
+        if n < 0 or (n == 0 and v == 0):
+            # null = all topics (v1+); v0 has no null encoding — an
+            # empty array is its only way to say "all topics"
+            wanted = None
         else:
             wanted = [r.string() for _ in range(n)]
         allow_auto = True
@@ -299,21 +301,28 @@ class KafkaGateway:
                         (part, kp.UNSUPPORTED_COMPRESSION_TYPE, -1)
                     )
                     continue
-                except ValueError:
+                except (ValueError, EOFError, struct.error):
+                    # a lying recordCount / truncated post-CRC section
+                    # must fail ONE partition, not the connection
                     parts.append((part, kp.CORRUPT_MESSAGE, -1))
                     continue
                 base = -1
-                for rec in records:
-                    ts_ns = (
-                        rec.timestamp_ms * 1_000_000
-                        if rec.timestamp_ms
-                        else time.time_ns()
+                if records:
+                    # one lock hold: offsets must be contiguous so the
+                    # client's baseOffset+index arithmetic holds under
+                    # concurrent producers
+                    base = plog.append_batch(
+                        [
+                            (
+                                rec.timestamp_ms * 1_000_000
+                                if rec.timestamp_ms
+                                else time.time_ns(),
+                                _pack_null(rec.key),
+                                _pack_null(rec.value),
+                            )
+                            for rec in records
+                        ]
                     )
-                    off = plog.append(
-                        ts_ns, _pack_null(rec.key), _pack_null(rec.value)
-                    )
-                    if base < 0:
-                        base = off
                 parts.append((part, kp.NONE, base))
             results.append((topic, parts))
         if acks == 0:
@@ -356,22 +365,28 @@ class KafkaGateway:
                 pmax = r.i32()
                 parts.append((part, fetch_offset, pmax))
             requests.append((topic, parts))
-        # long-poll: when every requested partition is empty, wait for
-        # the first one to grow (bounded by max_wait)
+        # long-poll: when every requested partition is empty, block on
+        # the log's condition (single-partition fetch, the common
+        # consumer shape) or poll coarsely for multi-partition fetches
         deadline = time.monotonic() + max(max_wait_ms, 0) / 1000.0
-        while time.monotonic() < deadline:
-            any_data = False
-            for topic, parts in requests:
-                for part, off, _m in parts:
-                    plog = self._log_for(topic, part)
-                    if plog is not None and plog.next_offset > off:
-                        any_data = True
-                        break
-                if any_data:
-                    break
-            if any_data:
-                break
-            time.sleep(0.01)
+        flat = [
+            (self._log_for(topic, part), off)
+            for topic, parts in requests
+            for part, off, _m in parts
+        ]
+        live = [(plog, off) for plog, off in flat if plog is not None]
+
+        def any_data() -> bool:
+            return any(plog.next_offset > off for plog, off in live)
+
+        if live and not any_data():
+            if len(live) == 1:
+                live[0][0].wait_for(
+                    live[0][1], timeout=max(deadline - time.monotonic(), 0)
+                )
+            else:
+                while time.monotonic() < deadline and not any_data():
+                    time.sleep(0.05)
         w = Writer()
         w.i32(0)  # throttle
 
@@ -684,9 +699,11 @@ class KafkaGateway:
                 (r.string(), r.bytes_()) for _ in range(r.i32())
             )
         ]
-        err, blob = self.coordinator.group(group_id).sync(
-            member_id, generation, assignments
-        )
+        g = self.coordinator.lookup(group_id)
+        if g is None:
+            err, blob = kp.UNKNOWN_MEMBER_ID, b""
+        else:
+            err, blob = g.sync(member_id, generation, assignments)
         w = Writer()
         if v >= 1:
             w.i32(0)
@@ -697,8 +714,11 @@ class KafkaGateway:
         group_id = r.string()
         generation = r.i32()
         member_id = r.string()
-        err = self.coordinator.group(group_id).heartbeat(
-            member_id, generation
+        g = self.coordinator.lookup(group_id)
+        err = (
+            kp.UNKNOWN_MEMBER_ID
+            if g is None
+            else g.heartbeat(member_id, generation)
         )
         w = Writer()
         if v >= 1:
@@ -709,7 +729,8 @@ class KafkaGateway:
     def _h_leave_group(self, r: Reader, v: int) -> bytes:
         group_id = r.string()
         member_id = r.string()
-        err = self.coordinator.group(group_id).leave(member_id)
+        g = self.coordinator.lookup(group_id)
+        err = kp.UNKNOWN_MEMBER_ID if g is None else g.leave(member_id)
         w = Writer()
         if v >= 1:
             w.i32(0)
@@ -734,7 +755,12 @@ class KafkaGateway:
             w.i32(0)
 
         def entry(ww: Writer, name: str):
-            g = self.coordinator.group(name)
+            g = self.coordinator.lookup(name)
+            if g is None:
+                ww.i16(kp.NONE).string(name).string("Dead")
+                ww.string("").string("")
+                ww.array([], lambda *_: None)
+                return
             with g.lock:
                 ww.i16(kp.NONE).string(name).string(g.state)
                 ww.string(g.protocol_type).string(g.protocol_name)

@@ -238,12 +238,19 @@ class GroupCoordinator:
         self._reaper.start()
 
     def group(self, group_id: str) -> Group:
+        """Get-or-create: only JoinGroup may instantiate a group."""
         with self._lock:
             g = self.groups.get(group_id)
             if g is None:
                 g = Group(group_id)
                 self.groups[group_id] = g
             return g
+
+    def lookup(self, group_id: str) -> Group | None:
+        """Non-creating lookup for heartbeat/sync/leave/describe — an
+        unknown group must not leak a Group object per probe."""
+        with self._lock:
+            return self.groups.get(group_id)
 
     def list_groups(self) -> list[tuple[str, str]]:
         with self._lock:
@@ -262,3 +269,12 @@ class GroupCoordinator:
                 groups = list(self.groups.values())
             for g in groups:
                 g.expire_dead_members()
+            # drop long-empty groups so probes/one-shot consumers don't
+            # grow the dict for the life of the process
+            with self._lock:
+                for gid in [
+                    gid
+                    for gid, g in self.groups.items()
+                    if g.state == EMPTY and not g.members
+                ]:
+                    del self.groups[gid]

@@ -71,6 +71,23 @@ class PartitionLog:
             self._lock.notify_all()
             return off
 
+    def append_batch(
+        self, records: list[tuple[int, bytes, bytes]]
+    ) -> int:
+        """Append [(ts_ns, key, value), ...] with CONTIGUOUS offsets
+        under one lock hold; returns the first offset. Kafka clients
+        compute record offsets as baseOffset + index-in-batch, so a
+        batch must never interleave with a concurrent producer's."""
+        with self._lock:
+            base = self.next_offset
+            for i, (ts_ns, key, value) in enumerate(records):
+                self._tail.append((base + i, ts_ns, key, value))
+            self.next_offset = base + len(records)
+            if len(self._tail) >= self.segment_records:
+                self._seal_locked()
+            self._lock.notify_all()
+            return base
+
     def _seal_locked(self) -> None:
         if not self._tail or self._spill is None:
             if self._spill is None and len(self._tail) > self.segment_records * 4:

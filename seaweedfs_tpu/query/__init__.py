@@ -1,0 +1,9 @@
+"""SQL over MQ topics + PostgreSQL wire server.
+
+Reference: weed/query/engine (engine.go:553 ExecuteSQL — SELECT /
+aggregations / WHERE pushdown over topic messages) and
+weed/server/postgres (a PostgreSQL 3.0 wire-protocol front end so
+psql/JDBC clients can query topics).
+"""
+
+from .engine import QueryEngine, QueryError  # noqa: F401

@@ -1,0 +1,568 @@
+"""SQL engine over MQ topics.
+
+Reference: weed/query/engine/engine.go:553 (ExecuteSQL) +
+hybrid_message_scanner.go — topics are tables; each record's
+JSON-decoded value supplies the columns, plus the system columns
+_key, _ts (ms), _offset, _partition. Statements:
+
+  SHOW TABLES
+  DESCRIBE <topic>
+  SELECT <*|cols|aggregates> FROM <topic>
+      [WHERE <expr>] [ORDER BY col [ASC|DESC]] [LIMIT n] [OFFSET n]
+
+Aggregates: COUNT(*) COUNT(col) SUM MIN MAX AVG; WHERE supports
+= != <> < <= > >= LIKE, AND/OR/NOT, parentheses, NULL literals.
+Values that are not JSON objects appear as a single _value column.
+
+The engine is deliberately a hand-rolled recursive-descent parser over
+a small grammar — the reference embeds a full cockroach SQL parser,
+which is out of proportion here; the surface above covers the
+reference's documented topic-query examples.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Any, Iterator
+
+NAMESPACES = ("kafka", "default")
+
+
+class QueryError(Exception):
+    pass
+
+
+# ------------------------------------------------------------ tokenizer
+
+_TOKEN_RE = re.compile(
+    r"""\s*(?:
+      (?P<num>-?\d+\.\d+|-?\d+)
+    | (?P<str>'(?:[^']|'')*')
+    | (?P<op><=|>=|<>|!=|=|<|>|\(|\)|\*|,|\.)
+    | (?P<word>[A-Za-z_][A-Za-z0-9_\-]*)
+    )""",
+    re.VERBOSE,
+)
+
+_KEYWORDS = {
+    "SELECT", "FROM", "WHERE", "LIMIT", "OFFSET", "AND", "OR", "NOT",
+    "LIKE", "SHOW", "TABLES", "TOPICS", "DESCRIBE", "DESC", "ASC",
+    "ORDER", "BY", "AS", "NULL", "IS", "TRUE", "FALSE",
+}
+
+
+@dataclass
+class Token:
+    kind: str  # num | str | op | word | kw | end
+    value: Any
+
+
+def tokenize(sql: str) -> list[Token]:
+    out: list[Token] = []
+    pos = 0
+    while pos < len(sql):
+        m = _TOKEN_RE.match(sql, pos)
+        if m is None:
+            rest = sql[pos:].strip()
+            if not rest or rest.startswith(";"):
+                break
+            raise QueryError(f"syntax error near {rest[:20]!r}")
+        pos = m.end()
+        if m.group("num") is not None:
+            n = m.group("num")
+            out.append(Token("num", float(n) if "." in n else int(n)))
+        elif m.group("str") is not None:
+            out.append(Token("str", m.group("str")[1:-1].replace("''", "'")))
+        elif m.group("op") is not None:
+            out.append(Token("op", m.group("op")))
+        else:
+            w = m.group("word")
+            if w.upper() in _KEYWORDS:
+                out.append(Token("kw", w.upper()))
+            else:
+                out.append(Token("word", w))
+    out.append(Token("end", None))
+    return out
+
+
+# --------------------------------------------------------------- parser
+
+
+@dataclass
+class Select:
+    columns: list  # ("col", name, alias) | ("agg", fn, arg, alias) | ("star",)
+    table: str
+    where: Any = None
+    order_by: tuple[str, bool] | None = None  # (col, descending)
+    limit: int = -1
+    offset: int = 0
+
+
+class _Parser:
+    def __init__(self, tokens: list[Token]):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self) -> Token:
+        return self.toks[self.i]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def expect_kw(self, kw: str) -> None:
+        t = self.next()
+        if t.kind != "kw" or t.value != kw:
+            raise QueryError(f"expected {kw}, got {t.value!r}")
+
+    def accept_kw(self, kw: str) -> bool:
+        if self.peek().kind == "kw" and self.peek().value == kw:
+            self.i += 1
+            return True
+        return False
+
+    def accept_op(self, op: str) -> bool:
+        if self.peek().kind == "op" and self.peek().value == op:
+            self.i += 1
+            return True
+        return False
+
+    def ident(self) -> str:
+        t = self.next()
+        if t.kind == "word":
+            return t.value
+        if t.kind == "kw":  # allow keywords as identifiers where safe
+            return t.value.lower()
+        raise QueryError(f"expected identifier, got {t.value!r}")
+
+    # ---- statements ----
+
+    def statement(self):
+        if self.accept_kw("SHOW"):
+            if self.accept_kw("TABLES") or self.accept_kw("TOPICS"):
+                return ("show_tables",)
+            raise QueryError("expected TABLES after SHOW")
+        if self.accept_kw("DESCRIBE") or self.accept_kw("DESC"):
+            return ("describe", self.ident())
+        if self.accept_kw("SELECT"):
+            return self.select()
+        raise QueryError(f"unsupported statement {self.peek().value!r}")
+
+    def select(self) -> Select:
+        cols = [self.select_item()]
+        while self.accept_op(","):
+            cols.append(self.select_item())
+        self.expect_kw("FROM")
+        table = self.ident()
+        sel = Select(columns=cols, table=table)
+        if self.accept_kw("WHERE"):
+            sel.where = self.expr()
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            col = self.ident()
+            desc = False
+            if self.accept_kw("DESC"):
+                desc = True
+            else:
+                self.accept_kw("ASC")
+            sel.order_by = (col, desc)
+        if self.accept_kw("LIMIT"):
+            sel.limit = int(self._num())
+        if self.accept_kw("OFFSET"):
+            sel.offset = int(self._num())
+        if self.peek().kind != "end":
+            raise QueryError(f"trailing input near {self.peek().value!r}")
+        return sel
+
+    def _num(self):
+        t = self.next()
+        if t.kind != "num":
+            raise QueryError(f"expected number, got {t.value!r}")
+        return t.value
+
+    def select_item(self):
+        if self.accept_op("*"):
+            return ("star",)
+        t = self.peek()
+        if (
+            t.kind in ("word", "kw")
+            and self.toks[self.i + 1].kind == "op"
+            and self.toks[self.i + 1].value == "("
+        ):
+            fn = self.ident().upper()
+            if fn not in ("COUNT", "SUM", "MIN", "MAX", "AVG"):
+                raise QueryError(f"unknown function {fn}")
+            self.accept_op("(")
+            arg = "*" if self.accept_op("*") else self.ident()
+            if not self.accept_op(")"):
+                raise QueryError("expected ) after aggregate")
+            alias = self.ident() if self.accept_kw("AS") else f"{fn.lower()}({arg})"
+            return ("agg", fn, arg, alias)
+        name = self.ident()
+        alias = self.ident() if self.accept_kw("AS") else name
+        return ("col", name, alias)
+
+    # ---- where expressions ----
+
+    def expr(self):
+        left = self.and_expr()
+        while self.accept_kw("OR"):
+            left = ("or", left, self.and_expr())
+        return left
+
+    def and_expr(self):
+        left = self.not_expr()
+        while self.accept_kw("AND"):
+            left = ("and", left, self.not_expr())
+        return left
+
+    def not_expr(self):
+        if self.accept_kw("NOT"):
+            return ("not", self.not_expr())
+        return self.comparison()
+
+    def comparison(self):
+        if self.accept_op("("):
+            e = self.expr()
+            if not self.accept_op(")"):
+                raise QueryError("expected )")
+            return e
+        col = self.ident()
+        if self.accept_kw("IS"):
+            neg = self.accept_kw("NOT")
+            self.expect_kw("NULL")
+            return ("isnull", col, neg)
+        if self.accept_kw("LIKE"):
+            t = self.next()
+            if t.kind != "str":
+                raise QueryError("LIKE needs a string pattern")
+            return ("like", col, t.value)
+        t = self.next()
+        if t.kind != "op" or t.value not in ("=", "!=", "<>", "<", "<=", ">", ">="):
+            raise QueryError(f"expected comparison operator, got {t.value!r}")
+        op = "!=" if t.value == "<>" else t.value
+        v = self.next()
+        if v.kind == "kw" and v.value in ("TRUE", "FALSE"):
+            value: Any = v.value == "TRUE"
+        elif v.kind == "kw" and v.value == "NULL":
+            value = None
+        elif v.kind in ("num", "str"):
+            value = v.value
+        else:
+            raise QueryError(f"expected literal, got {v.value!r}")
+        return ("cmp", op, col, value)
+
+
+def parse(sql: str):
+    return _Parser(tokenize(sql)).statement()
+
+
+# ------------------------------------------------------------- executor
+
+
+@dataclass
+class Result:
+    columns: list[str]
+    rows: list[list[Any]]
+    tag: str = "SELECT"
+
+
+def _like_to_match(pattern: str, s: str) -> bool:
+    # SQL LIKE: % = any run, _ = one char (translate to fnmatch)
+    translated = (
+        pattern.replace("\\", "\\\\")
+        .replace("*", "[*]")
+        .replace("?", "[?]")
+        .replace("%", "*")
+        .replace("_", "?")
+    )
+    return fnmatch.fnmatchcase(s, translated)
+
+
+def _cmp(op: str, a: Any, b: Any) -> bool:
+    if a is None or b is None:
+        return False  # SQL three-valued logic: NULL comparisons are false
+    try:
+        if op == "=":
+            return a == b
+        if op == "!=":
+            return a != b
+        if op == "<":
+            return a < b
+        if op == "<=":
+            return a <= b
+        if op == ">":
+            return a > b
+        if op == ">=":
+            return a >= b
+    except TypeError:
+        return False
+    return False
+
+
+class QueryEngine:
+    """Executes parsed statements against an MqBroker."""
+
+    def __init__(self, broker, scan_limit: int = 1_000_000):
+        self.broker = broker
+        self.scan_limit = scan_limit
+
+    # ---- table helpers ----
+
+    def _tables(self) -> list[tuple[str, str, int]]:
+        return [
+            (ns, name, count)
+            for ns, name, count in self.broker.list_topics()
+        ]
+
+    def _resolve(self, table: str) -> tuple[str, str, int]:
+        matches = [
+            (ns, name, c)
+            for ns, name, c in self._tables()
+            if name == table
+        ]
+        if not matches:
+            raise QueryError(f"unknown table {table!r}")
+        # prefer well-known namespaces deterministically
+        matches.sort(
+            key=lambda t: NAMESPACES.index(t[0])
+            if t[0] in NAMESPACES
+            else len(NAMESPACES)
+        )
+        return matches[0]
+
+    def _scan(self, ns: str, name: str, count: int) -> Iterator[dict]:
+        scanned = 0
+        st = self.broker.topic(ns, name)
+        # topics written through the Kafka gateway carry its one-byte
+        # null framing; native MQ topics store raw bytes
+        unwrap = _strip_null if ns == "kafka" else (lambda b: b)
+        for p in range(count):
+            plog = st.logs.get(p)
+            if plog is None:
+                continue
+            off = plog.earliest_offset
+            while scanned < self.scan_limit:
+                recs = plog.read_from(off, max_records=2048)
+                if not recs:
+                    break
+                for o, ts_ns, key, value in recs:
+                    scanned += 1
+                    row = {
+                        "_key": _maybe_text(unwrap(key)),
+                        "_ts": ts_ns // 1_000_000,
+                        "_offset": o,
+                        "_partition": p,
+                    }
+                    payload = unwrap(value)
+                    doc = None
+                    if payload:
+                        try:
+                            doc = json.loads(payload)
+                        except (ValueError, UnicodeDecodeError):
+                            doc = None
+                    if isinstance(doc, dict):
+                        row.update(doc)
+                    else:
+                        row["_value"] = _maybe_text(payload)
+                    yield row
+                off = recs[-1][0] + 1
+
+    # ---- execution ----
+
+    def execute(self, sql: str) -> Result:
+        stmt = parse(sql)
+        if isinstance(stmt, Select):
+            return self._execute_select(stmt)
+        if stmt[0] == "show_tables":
+            return Result(
+                columns=["namespace", "table", "partitions"],
+                rows=[[ns, n, c] for ns, n, c in self._tables()],
+                tag="SHOW",
+            )
+        if stmt[0] == "describe":
+            ns, name, count = self._resolve(stmt[1])
+            cols: dict[str, str] = {
+                "_key": "text",
+                "_ts": "bigint",
+                "_offset": "bigint",
+                "_partition": "int",
+            }
+            for i, row in enumerate(self._scan(ns, name, count)):
+                for k, v in row.items():
+                    cols.setdefault(k, _pg_type(v))
+                if i >= 100:  # column discovery sample
+                    break
+            return Result(
+                columns=["column", "type"],
+                rows=[[k, t] for k, t in cols.items()],
+                tag="DESCRIBE",
+            )
+        raise QueryError(f"unsupported statement {stmt[0]!r}")
+
+    def _execute_select(self, sel: Select) -> Result:
+        ns, name, count = self._resolve(sel.table)
+        rows = (
+            row
+            for row in self._scan(ns, name, count)
+            if sel.where is None or self._eval(sel.where, row)
+        )
+        is_agg = any(c[0] == "agg" for c in sel.columns)
+        if is_agg:
+            return self._aggregate(sel, rows)
+        out: list[dict] = []
+        # ORDER BY needs the full set; otherwise stream until limit
+        if sel.order_by is None and sel.limit >= 0:
+            take = sel.limit + sel.offset
+            for row in rows:
+                out.append(row)
+                if len(out) >= take:
+                    break
+        else:
+            out = list(rows)
+        if sel.order_by is not None:
+            col, descending = sel.order_by
+            out.sort(
+                key=lambda r: (r.get(col) is None, _sort_key(r.get(col))),
+                reverse=descending,
+            )
+        if sel.offset:
+            out = out[sel.offset :]
+        if sel.limit >= 0:
+            out = out[: sel.limit]
+        # column projection
+        if any(c[0] == "star" for c in sel.columns):
+            names: list[str] = []
+            for row in out:
+                for k in row:
+                    if k not in names:
+                        names.append(k)
+            if not names:
+                names = ["_key", "_ts", "_offset", "_partition", "_value"]
+        else:
+            names = [c[2] for c in sel.columns]
+        data = []
+        for row in out:
+            if any(c[0] == "star" for c in sel.columns):
+                data.append([row.get(n) for n in names])
+            else:
+                data.append(
+                    [row.get(c[1]) for c in sel.columns]
+                )
+        return Result(columns=names, rows=data)
+
+    def _aggregate(self, sel: Select, rows: Iterator[dict]) -> Result:
+        states: list[dict] = [
+            {"count": 0, "sum": 0.0, "min": None, "max": None}
+            for _ in sel.columns
+        ]
+        for row in rows:
+            for c, st in zip(sel.columns, states):
+                if c[0] != "agg":
+                    continue
+                _fn, fname, arg, _alias = c
+                v = None if arg == "*" else row.get(arg)
+                if arg != "*" and v is None:
+                    continue
+                st["count"] += 1
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    st["sum"] += v
+                    st["min"] = v if st["min"] is None else min(st["min"], v)
+                    st["max"] = v if st["max"] is None else max(st["max"], v)
+                elif v is not None:
+                    st["min"] = (
+                        v if st["min"] is None else min(st["min"], str(v))
+                    )
+                    st["max"] = (
+                        v if st["max"] is None else max(st["max"], str(v))
+                    )
+        out_row = []
+        names = []
+        for c, st in zip(sel.columns, states):
+            if c[0] != "agg":
+                raise QueryError(
+                    "mixing aggregates with plain columns needs GROUP BY"
+                )
+            _k, fname, arg, alias = c
+            names.append(alias)
+            if fname == "COUNT":
+                out_row.append(st["count"])
+            elif fname == "SUM":
+                out_row.append(st["sum"] if st["count"] else None)
+            elif fname == "AVG":
+                out_row.append(
+                    st["sum"] / st["count"] if st["count"] else None
+                )
+            elif fname == "MIN":
+                out_row.append(st["min"])
+            elif fname == "MAX":
+                out_row.append(st["max"])
+        return Result(columns=names, rows=[out_row])
+
+    def _eval(self, node, row: dict) -> bool:
+        kind = node[0]
+        if kind == "and":
+            return self._eval(node[1], row) and self._eval(node[2], row)
+        if kind == "or":
+            return self._eval(node[1], row) or self._eval(node[2], row)
+        if kind == "not":
+            return not self._eval(node[1], row)
+        if kind == "isnull":
+            isnull = row.get(node[1]) is None
+            return isnull != node[2]
+        if kind == "like":
+            v = row.get(node[1])
+            return isinstance(v, str) and _like_to_match(node[2], v)
+        if kind == "cmp":
+            _k, op, col, value = node
+            v = row.get(col)
+            if value is None:
+                return False
+            if (
+                isinstance(value, (int, float))
+                and isinstance(v, str)
+            ):
+                try:
+                    v = float(v)
+                except ValueError:
+                    return False
+            return _cmp(op, v, value)
+        raise QueryError(f"bad expression node {kind}")
+
+
+def _strip_null(b: bytes) -> bytes | None:
+    """Undo the Kafka gateway's null framing (gateway._pack_null)."""
+    if not b or b[0] == 0:
+        return None
+    return b[1:]
+
+
+def _maybe_text(b: bytes | None):
+    if b is None:
+        return None
+    try:
+        return b.decode("utf-8")
+    except UnicodeDecodeError:
+        return b.hex()
+
+
+def _sort_key(v: Any):
+    if isinstance(v, bool):
+        return (1, int(v))
+    if isinstance(v, (int, float)):
+        return (0, v)
+    return (2, str(v))
+
+
+def _pg_type(v: Any) -> str:
+    if isinstance(v, bool):
+        return "boolean"
+    if isinstance(v, int):
+        return "bigint"
+    if isinstance(v, float):
+        return "double precision"
+    return "text"

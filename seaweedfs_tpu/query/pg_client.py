@@ -1,0 +1,128 @@
+"""Minimal PostgreSQL v3 simple-protocol client (for tests/tools).
+
+Speaks exactly what psql speaks for simple queries: startup, optional
+cleartext password, 'Q', and parses RowDescription/DataRow/
+CommandComplete/ErrorResponse.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+
+class PgError(Exception):
+    def __init__(self, code: str, message: str):
+        self.code = code
+        super().__init__(f"{code}: {message}")
+
+
+class PgClient:
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        user: str = "sw",
+        password: str | None = None,
+        database: str = "topics",
+    ):
+        self._sock = socket.create_connection((host, port), timeout=30)
+        params = (
+            b"user\x00" + user.encode() + b"\x00"
+            b"database\x00" + database.encode() + b"\x00\x00"
+        )
+        startup = struct.pack(">ii", len(params) + 8, 196608) + params
+        self._sock.sendall(startup)
+        self.parameters: dict[str, str] = {}
+        while True:
+            t, payload = self._read()
+            if t == b"R":
+                (code,) = struct.unpack(">i", payload[:4])
+                if code == 0:
+                    continue
+                if code == 3:
+                    if password is None:
+                        raise PgError("28P01", "password required")
+                    self._send(b"p", password.encode() + b"\x00")
+                    continue
+                raise PgError("0A000", f"unsupported auth {code}")
+            if t == b"S":
+                k, v = payload.rstrip(b"\x00").split(b"\x00", 1)
+                self.parameters[k.decode()] = v.decode()
+            elif t == b"K":
+                pass
+            elif t == b"E":
+                raise self._parse_error(payload)
+            elif t == b"Z":
+                break
+
+    def close(self) -> None:
+        try:
+            self._sock.sendall(b"X" + struct.pack(">i", 4))
+            self._sock.close()
+        except OSError:
+            pass
+
+    def query(self, sql: str) -> tuple[list[str], list[list]]:
+        self._send(b"Q", sql.encode() + b"\x00")
+        columns: list[str] = []
+        rows: list[list] = []
+        err: PgError | None = None
+        while True:
+            t, payload = self._read()
+            if t == b"T":
+                (n,) = struct.unpack(">h", payload[:2])
+                pos = 2
+                columns = []
+                for _ in range(n):
+                    end = payload.index(b"\x00", pos)
+                    columns.append(payload[pos:end].decode())
+                    pos = end + 1 + 18  # fixed per-column fields
+            elif t == b"D":
+                (n,) = struct.unpack(">h", payload[:2])
+                pos = 2
+                row = []
+                for _ in range(n):
+                    (ln,) = struct.unpack(">i", payload[pos : pos + 4])
+                    pos += 4
+                    if ln < 0:
+                        row.append(None)
+                    else:
+                        row.append(payload[pos : pos + ln].decode())
+                        pos += ln
+                rows.append(row)
+            elif t == b"E":
+                err = self._parse_error(payload)
+            elif t in (b"C", b"I"):
+                pass
+            elif t == b"Z":
+                if err is not None:
+                    raise err
+                return columns, rows
+
+    def _send(self, t: bytes, payload: bytes) -> None:
+        self._sock.sendall(t + struct.pack(">i", len(payload) + 4) + payload)
+
+    def _read(self) -> tuple[bytes, bytes]:
+        t = self._read_exact(1)
+        (n,) = struct.unpack(">i", self._read_exact(4))
+        return t, self._read_exact(n - 4)
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise EOFError("server closed")
+            buf += chunk
+        return buf
+
+    @staticmethod
+    def _parse_error(payload: bytes) -> PgError:
+        code = msg = ""
+        for field in payload.split(b"\x00"):
+            if field.startswith(b"C"):
+                code = field[1:].decode()
+            elif field.startswith(b"M"):
+                msg = field[1:].decode()
+        return PgError(code or "XX000", msg or "unknown error")

@@ -1,0 +1,320 @@
+"""PostgreSQL 3.0 wire-protocol server fronting the SQL engine.
+
+Reference: weed/server/postgres/{server,protocol}.go — a PG front end
+so psql/JDBC/psycopg clients can query MQ topics. Implements the v3
+startup handshake (SSLRequest politely refused, trust or cleartext-
+password auth), the simple query protocol ('Q'), and enough of the
+extended protocol (Parse/Bind/Describe/Execute/Sync, no parameters)
+for drivers that refuse simple mode.
+
+Message framing: type byte + i32 length (incl. itself) + payload;
+the startup message has no type byte.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+from ..utils.glog import logger
+from .engine import QueryEngine, QueryError
+
+log = logger("pg")
+
+SSL_REQUEST_CODE = 80877103
+CANCEL_REQUEST_CODE = 80877102
+PROTOCOL_V3 = 196608
+
+# type OIDs
+OID_TEXT = 25
+OID_INT8 = 20
+OID_FLOAT8 = 701
+OID_BOOL = 16
+
+AUTH_OK = 0
+AUTH_CLEARTEXT = 3
+
+
+def _msg(type_byte: bytes, payload: bytes) -> bytes:
+    return type_byte + struct.pack(">i", len(payload) + 4) + payload
+
+
+def _cstr(s: str) -> bytes:
+    return s.encode() + b"\x00"
+
+
+class PgServer:
+    def __init__(
+        self,
+        engine: QueryEngine,
+        ip: str = "localhost",
+        port: int = 5432,
+        users: dict[str, str] | None = None,
+    ):
+        """users: name -> password. Empty/None = trust auth (any user)."""
+        self.engine = engine
+        self.users = users or {}
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((ip, port))
+        self.ip = ip
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(32)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _accept(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    # ----------------------------------------------------------- session
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            if not self._startup(conn):
+                return
+            self._session_loop(conn)
+        except (OSError, EOFError, struct.error):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _startup(self, conn: socket.socket) -> bool:
+        while True:
+            head = _read_exact(conn, 4)
+            (n,) = struct.unpack(">i", head)
+            body = _read_exact(conn, n - 4)
+            (code,) = struct.unpack(">i", body[:4])
+            if code == SSL_REQUEST_CODE:
+                conn.sendall(b"N")  # no TLS on this listener
+                continue
+            if code == CANCEL_REQUEST_CODE:
+                return False
+            if code != PROTOCOL_V3:
+                self._error(conn, "08P01", f"unsupported protocol {code}")
+                return False
+            params = _parse_kv(body[4:])
+            user = params.get("user", "")
+            break
+        if self.users:
+            conn.sendall(_msg(b"R", struct.pack(">i", AUTH_CLEARTEXT)))
+            t, payload = _read_message(conn)
+            if t != b"p":
+                return False
+            password = payload.rstrip(b"\x00").decode()
+            if self.users.get(user) != password:
+                self._error(conn, "28P01", f"password authentication failed for {user}")
+                return False
+        conn.sendall(_msg(b"R", struct.pack(">i", AUTH_OK)))
+        for k, v in (
+            ("server_version", "14.0 (seaweedfs-tpu)"),
+            ("client_encoding", "UTF8"),
+            ("DateStyle", "ISO"),
+            ("integer_datetimes", "on"),
+        ):
+            conn.sendall(_msg(b"S", _cstr(k) + _cstr(v)))
+        conn.sendall(_msg(b"K", struct.pack(">ii", 0, 0)))  # BackendKeyData
+        self._ready(conn)
+        return True
+
+    def _session_loop(self, conn: socket.socket) -> None:
+        # extended-protocol state: the last parsed statement
+        prepared: dict[str, str] = {}
+        portals: dict[str, str] = {}
+        while True:
+            t, payload = _read_message(conn)
+            if t == b"X":  # Terminate
+                return
+            if t == b"Q":
+                sql = payload.rstrip(b"\x00").decode()
+                self._run_simple(conn, sql)
+            elif t == b"P":  # Parse: name, query, param types
+                name, rest = _take_cstr(payload)
+                sql, _ = _take_cstr(rest)
+                prepared[name] = sql
+                conn.sendall(_msg(b"1", b""))  # ParseComplete
+            elif t == b"B":  # Bind: portal, statement, formats/params
+                portal, rest = _take_cstr(payload)
+                stmt, _ = _take_cstr(rest)
+                portals[portal] = prepared.get(stmt, "")
+                conn.sendall(_msg(b"2", b""))  # BindComplete
+            elif t == b"D":  # Describe
+                kind = payload[:1]
+                name, _ = _take_cstr(payload[1:])
+                sql = (
+                    portals.get(name, "")
+                    if kind == b"P"
+                    else prepared.get(name, "")
+                )
+                # NoData keeps drivers happy without pre-executing
+                conn.sendall(_msg(b"n", b""))
+            elif t == b"E":  # Execute: portal, row limit
+                portal, _rest = _take_cstr(payload)
+                sql = portals.get(portal, "")
+                self._run_extended(conn, sql)
+            elif t == b"S":  # Sync
+                self._ready(conn)
+            elif t == b"H":  # Flush
+                pass
+            else:
+                self._error(conn, "0A000", f"unsupported message {t!r}")
+                self._ready(conn)
+
+    # ---------------------------------------------------------- queries
+
+    def _run_simple(self, conn: socket.socket, sql: str) -> None:
+        sql = sql.strip().rstrip(";").strip()
+        if not sql:
+            conn.sendall(_msg(b"I", b""))  # EmptyQueryResponse
+            self._ready(conn)
+            return
+        lowered = sql.lower()
+        if lowered.startswith(("set ", "begin", "commit", "rollback")):
+            # session noise from drivers: accept silently
+            conn.sendall(_msg(b"C", _cstr("SET")))
+            self._ready(conn)
+            return
+        try:
+            res = self.engine.execute(sql)
+        except QueryError as e:
+            self._error(conn, "42601", str(e))
+            self._ready(conn)
+            return
+        self._send_result(conn, res)
+        self._ready(conn)
+
+    def _run_extended(self, conn: socket.socket, sql: str) -> None:
+        sql = sql.strip().rstrip(";").strip()
+        if not sql:
+            conn.sendall(_msg(b"I", b""))
+            return
+        try:
+            res = self.engine.execute(sql)
+        except QueryError as e:
+            self._error(conn, "42601", str(e))
+            return
+        self._send_result(conn, res)
+
+    def _send_result(self, conn: socket.socket, res) -> None:
+        # RowDescription
+        cols = b"".join(
+            _cstr(name)
+            + struct.pack(
+                ">ihihih",
+                0,  # table oid
+                0,  # column attr
+                _oid_for(res, i),
+                -1,  # type size (variable)
+                -1,  # type modifier
+                0,  # text format
+            )
+            for i, name in enumerate(res.columns)
+        )
+        conn.sendall(
+            _msg(b"T", struct.pack(">h", len(res.columns)) + cols)
+        )
+        for row in res.rows:
+            fields = []
+            for v in row:
+                if v is None:
+                    fields.append(struct.pack(">i", -1))
+                else:
+                    s = _render(v).encode()
+                    fields.append(struct.pack(">i", len(s)) + s)
+            conn.sendall(
+                _msg(b"D", struct.pack(">h", len(row)) + b"".join(fields))
+            )
+        conn.sendall(
+            _msg(b"C", _cstr(f"{res.tag} {len(res.rows)}"))
+        )
+
+    # ---------------------------------------------------------- helpers
+
+    def _ready(self, conn: socket.socket) -> None:
+        conn.sendall(_msg(b"Z", b"I"))
+
+    def _error(self, conn: socket.socket, code: str, message: str) -> None:
+        payload = (
+            b"S" + _cstr("ERROR")
+            + b"C" + _cstr(code)
+            + b"M" + _cstr(message)
+            + b"\x00"
+        )
+        conn.sendall(_msg(b"E", payload))
+
+
+def _oid_for(res, col_index: int) -> int:
+    for row in res.rows:
+        v = row[col_index]
+        if v is None:
+            continue
+        if isinstance(v, bool):
+            return OID_BOOL
+        if isinstance(v, int):
+            return OID_INT8
+        if isinstance(v, float):
+            return OID_FLOAT8
+        return OID_TEXT
+    return OID_TEXT
+
+
+def _render(v) -> str:
+    if isinstance(v, bool):
+        return "t" if v else "f"
+    if isinstance(v, float) and v == int(v):
+        return str(v)
+    if isinstance(v, (dict, list)):
+        import json
+
+        return json.dumps(v)
+    return str(v)
+
+
+def _read_exact(conn: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise EOFError("client closed")
+        buf += chunk
+    return buf
+
+
+def _read_message(conn: socket.socket) -> tuple[bytes, bytes]:
+    t = _read_exact(conn, 1)
+    (n,) = struct.unpack(">i", _read_exact(conn, 4))
+    return t, _read_exact(conn, n - 4)
+
+
+def _parse_kv(body: bytes) -> dict[str, str]:
+    parts = body.split(b"\x00")
+    out = {}
+    for i in range(0, len(parts) - 1, 2):
+        if parts[i]:
+            out[parts[i].decode()] = parts[i + 1].decode()
+    return out
+
+
+def _take_cstr(b: bytes) -> tuple[str, bytes]:
+    i = b.index(b"\x00")
+    return b[:i].decode(), b[i + 1 :]

@@ -52,7 +52,7 @@ def main(argv=None) -> int:
     m.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
     m.add_argument("-jwt.key", dest="jwt_key", default="")
     m.add_argument(
-        "-ec.autoFullness", dest="ec_auto", type=float, default=0.0,
+        "-ec.autoFullness", dest="ec_auto", type=float, default=None,
         help="auto-submit ec_encode for volumes at this fraction of the size limit (0=off)",
     )
     m.add_argument(
@@ -74,11 +74,11 @@ def main(argv=None) -> int:
     v.add_argument("-port", type=int, default=8080)
     v.add_argument("-dir", action="append", required=True)
     v.add_argument("-master", default="localhost:9333")
-    v.add_argument("-max", type=int, default=8)
-    v.add_argument("-ec.backend", dest="ec_backend", default="auto")
+    v.add_argument("-max", type=int, default=None)
+    v.add_argument("-ec.backend", dest="ec_backend", default=None)
     v.add_argument(
         "-index",
-        default="memory",
+        default=None,
         choices=["memory", "sqlite"],
         help="needle map kind (sqlite = durable, O(delta) restart)",
     )
@@ -91,7 +91,7 @@ def main(argv=None) -> int:
     f.add_argument("-ip", default="localhost")
     f.add_argument("-port", type=int, default=8888)
     f.add_argument("-master", default="localhost:9333")
-    f.add_argument("-dir", default="./filerdb")
+    f.add_argument("-dir", default=None)
     f.add_argument("-collection", default="")
     f.add_argument("-replication", default="")
     f.add_argument("-jwt.key", dest="jwt_key", default="")
@@ -109,6 +109,14 @@ def main(argv=None) -> int:
     b.add_argument(
         "-kafkaPort", type=int, default=-1,
         help="also speak the Kafka wire protocol on this port (-1 = off)",
+    )
+    b.add_argument(
+        "-pgPort", type=int, default=-1,
+        help="serve PostgreSQL clients a SQL view over topics (-1 = off)",
+    )
+    b.add_argument(
+        "-pgUser", default="",
+        help="user:password for PG auth (empty = trust)",
     )
     # broker dials the filer: it needs the https switch from
     # security.toml even though it has no HTTP listener of its own
@@ -130,14 +138,14 @@ def main(argv=None) -> int:
     )
     s.add_argument("-s3SecretKey", default="")
     s.add_argument("-dir", action="append", required=True)
-    s.add_argument("-max", type=int, default=8)
-    s.add_argument("-ec.backend", dest="ec_backend", default="auto")
+    s.add_argument("-max", type=int, default=None)
+    s.add_argument("-ec.backend", dest="ec_backend", default=None)
     s.add_argument("-jwt.key", dest="jwt_key", default="")
     s.add_argument("-notify.webhook", dest="notify_webhook", default="")
     s.add_argument("-notify.mq", dest="notify_mq", default="")
     s.add_argument("-webdav", action="store_true", help="also run WebDAV")
     s.add_argument(
-        "-ec.autoFullness", dest="ec_auto", type=float, default=0.0,
+        "-ec.autoFullness", dest="ec_auto", type=float, default=None,
         help="auto-submit ec_encode for volumes at this fraction of the size limit (0=off)",
     )
     s.add_argument("-webdavPort", type=int, default=7333)
@@ -192,22 +200,22 @@ def main(argv=None) -> int:
 
         enable_https(getattr(a, "tls_ca", "") or a.tls_cert)
 
-    # mode-specific TOML defaults, field-wise under flags (each file
-    # `scaffold` can emit is honored by the mode that owns it)
+    # mode-specific TOML defaults: a flag left unset parses as the None
+    # sentinel and is filled from config, then from the built-in
+    # default — an EXPLICIT flag always wins, even at the default value
     if a.mode in ("volume", "server"):
         vcfg = load_config("volume")
-        if vcfg:
-            if getattr(a, "index", "memory") == "memory":
-                a.index = vcfg.get_str("volume.index", "memory") or "memory"
-            if a.ec_backend == "auto":
-                a.ec_backend = (
-                    vcfg.get_str("volume.ec_backend", "auto") or "auto"
-                )
-            if a.max == 8:
-                a.max = int(vcfg.get("volume.store.max_volumes", 8))
+        if getattr(a, "index", None) is None:
+            a.index = vcfg.get_str("volume.index", "memory") or "memory"
+        if a.ec_backend is None:
+            a.ec_backend = (
+                vcfg.get_str("volume.ec_backend", "auto") or "auto"
+            )
+        if a.max is None:
+            a.max = int(vcfg.get("volume.store.max_volumes", 8))
     if a.mode in ("master", "server"):
         mcfg = load_config("master")
-        if mcfg and getattr(a, "ec_auto", 0.0) == 0.0:
+        if getattr(a, "ec_auto", None) is None:
             a.ec_auto = float(
                 mcfg.get("master.maintenance.ec_auto_fullness", 0.0)
             )
@@ -219,14 +227,13 @@ def main(argv=None) -> int:
         )
     if a.mode in ("filer", "server"):
         fcfg = load_config("filer")
-        if (
-            fcfg
-            and fcfg.get("sqlite.enabled")
-            and fcfg.get_str("sqlite.dbFile")
-            and getattr(a, "dir", None) in (None, "./filerdb")
-            and a.mode == "filer"
-        ):
-            a.dir = os.path.dirname(fcfg.get_str("sqlite.dbFile")) or "."
+        if getattr(a, "dir", None) is None and a.mode == "filer":
+            db = (
+                fcfg.get_str("sqlite.dbFile")
+                if fcfg.get("sqlite.enabled")
+                else ""
+            )
+            a.dir = (os.path.dirname(db) or ".") if db else "./filerdb"
         ncfg = load_config("notification")
         if ncfg:
             if not getattr(a, "notify_webhook", "") and ncfg.get(
@@ -251,19 +258,26 @@ def main(argv=None) -> int:
     if a.mode == "mq.broker":
         from ..mq.broker import MqBrokerServer
 
+        pg_users = None
+        if a.pgUser:
+            user, _, pw = a.pgUser.partition(":")
+            pg_users = {user: pw}
         bs = MqBrokerServer(
             ip=a.ip,
             grpc_port=a.port,
             filer=a.filer,
             segment_records=a.segmentRecords,
             kafka_port=a.kafkaPort,
+            pg_port=a.pgPort,
+            pg_users=pg_users,
         )
         bs.start()
         servers.append(bs)
         log.info(
-            "mq broker on %s:%s (filer=%s%s)",
+            "mq broker on %s:%s (filer=%s%s%s)",
             a.ip, a.port, a.filer or "memory-only",
             f", kafka on :{bs.kafka.port}" if bs.kafka else "",
+            f", pg on :{bs.pg.port}" if bs.pg else "",
         )
 
     if a.mode in ("master", "server"):
