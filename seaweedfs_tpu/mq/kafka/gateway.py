@@ -119,7 +119,7 @@ class KafkaGateway:
                 if resp is not None:
                     conn.sendall(struct.pack(">i", len(resp)) + resp)
         except (OSError, EOFError, ValueError) as e:
-            log.v(1).info("connection dropped: %s", e)
+            log.v(1, "connection dropped: %s", e)
         finally:
             try:
                 conn.close()
@@ -367,26 +367,30 @@ class KafkaGateway:
             requests.append((topic, parts))
         # long-poll: when every requested partition is empty, block on
         # the log's condition (single-partition fetch, the common
-        # consumer shape) or poll coarsely for multi-partition fetches
+        # consumer shape) or poll coarsely. Partitions are re-resolved
+        # each round: a fetch may race the topic's auto-creation, and
+        # returning early would make the client spin.
         deadline = time.monotonic() + max(max_wait_ms, 0) / 1000.0
-        flat = [
-            (self._log_for(topic, part), off)
+        wanted = [
+            (topic, part, off)
             for topic, parts in requests
             for part, off, _m in parts
         ]
-        live = [(plog, off) for plog, off in flat if plog is not None]
-
-        def any_data() -> bool:
-            return any(plog.next_offset > off for plog, off in live)
-
-        if live and not any_data():
-            if len(live) == 1:
-                live[0][0].wait_for(
-                    live[0][1], timeout=max(deadline - time.monotonic(), 0)
-                )
+        while True:
+            live = [
+                (plog, off)
+                for topic, part, off in wanted
+                if (plog := self._log_for(topic, part)) is not None
+            ]
+            if any(plog.next_offset > off for plog, off in live):
+                break
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            if len(live) == len(wanted) == 1:
+                live[0][0].wait_for(live[0][1], timeout=remaining)
             else:
-                while time.monotonic() < deadline and not any_data():
-                    time.sleep(0.05)
+                time.sleep(min(0.05, remaining))
         w = Writer()
         w.i32(0)  # throttle
 

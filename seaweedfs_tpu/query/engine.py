@@ -272,9 +272,10 @@ class Result:
 
 
 def _like_to_match(pattern: str, s: str) -> bool:
-    # SQL LIKE: % = any run, _ = one char (translate to fnmatch)
+    # SQL LIKE: % = any run, _ = one char; all other characters —
+    # including fnmatch's *, ?, [ metacharacters — are literals
     translated = (
-        pattern.replace("\\", "\\\\")
+        pattern.replace("[", "[[]")
         .replace("*", "[*]")
         .replace("?", "[?]")
         .replace("%", "*")
@@ -471,14 +472,21 @@ class QueryEngine:
                 st["count"] += 1
                 if isinstance(v, (int, float)) and not isinstance(v, bool):
                     st["sum"] += v
-                    st["min"] = v if st["min"] is None else min(st["min"], v)
-                    st["max"] = v if st["max"] is None else max(st["max"], v)
-                elif v is not None:
+                if v is not None:
+                    # mixed-type columns compare on a stable (kind,
+                    # value) key — MIN(5, "x") must not TypeError the
+                    # whole query
                     st["min"] = (
-                        v if st["min"] is None else min(st["min"], str(v))
+                        v
+                        if st["min"] is None
+                        or _sort_key(v) < _sort_key(st["min"])
+                        else st["min"]
                     )
                     st["max"] = (
-                        v if st["max"] is None else max(st["max"], str(v))
+                        v
+                        if st["max"] is None
+                        or _sort_key(v) > _sort_key(st["max"])
+                        else st["max"]
                     )
         out_row = []
         names = []

@@ -200,6 +200,10 @@ class PgServer:
             self._error(conn, "42601", str(e))
             self._ready(conn)
             return
+        except Exception as e:  # engine bug: error the query, keep session
+            self._error(conn, "XX000", f"internal error: {e}")
+            self._ready(conn)
+            return
         self._send_result(conn, res)
         self._ready(conn)
 
@@ -212,6 +216,9 @@ class PgServer:
             res = self.engine.execute(sql)
         except QueryError as e:
             self._error(conn, "42601", str(e))
+            return
+        except Exception as e:
+            self._error(conn, "XX000", f"internal error: {e}")
             return
         self._send_result(conn, res)
 

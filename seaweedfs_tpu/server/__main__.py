@@ -149,6 +149,12 @@ def main(argv=None) -> int:
         help="auto-submit ec_encode for volumes at this fraction of the size limit (0=off)",
     )
     s.add_argument("-webdavPort", type=int, default=7333)
+    s.add_argument("-sftp", action="store_true", help="also run the SFTP gateway")
+    s.add_argument("-sftpPort", type=int, default=2022)
+    s.add_argument(
+        "-sftpUser", action="append", default=[],
+        help="user:password[:home[:ro]] (repeatable)",
+    )
     _add_tls_flags(s)
 
     sc = sub.add_parser(
@@ -328,7 +334,7 @@ def main(argv=None) -> int:
         log.info("volume server on %s:%s (grpc %s)", a.ip, a.port, vs.grpc_port)
 
     if a.mode == "filer" or (
-        a.mode == "server" and (a.filer or a.s3 or a.webdav)
+        a.mode == "server" and (a.filer or a.s3 or a.webdav or a.sftp)
     ):
         from ..filer.filer import Filer
         from ..filer.filer_store import SqliteStore
@@ -402,6 +408,28 @@ def main(argv=None) -> int:
             s3srv.start()
             servers.append(s3srv)
             log.info("s3 gateway on %s:%s", a.ip, a.s3Port)
+
+        if a.mode == "server" and getattr(a, "sftp", False):
+            from ..sftpd import SftpServer
+            from ..sftpd.sftp_server import SftpUser
+
+            users = {}
+            for spec in a.sftpUser:
+                parts = spec.split(":")
+                if len(parts) < 2:
+                    continue
+                users[parts[0]] = SftpUser(
+                    name=parts[0],
+                    password=parts[1],
+                    home=parts[2] if len(parts) > 2 and parts[2] else "/",
+                    read_only=len(parts) > 3 and parts[3] == "ro",
+                )
+            sftp_srv = SftpServer(
+                filer, ip=a.ip, port=a.sftpPort, users=users
+            )
+            sftp_srv.start()
+            servers.append(sftp_srv)
+            log.info("sftp on %s:%s (%d users)", a.ip, a.sftpPort, len(users))
 
         if a.mode == "server" and getattr(a, "webdav", False):
             from .webdav_server import WebDavServer

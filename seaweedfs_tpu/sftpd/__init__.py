@@ -1,0 +1,10 @@
+"""SFTP gateway: an SSH server exposing the filer namespace.
+
+Reference: weed/sftpd (sftp_server.go) — SSH/SFTP over the filer with
+per-user permissions. The reference rides golang.org/x/crypto/ssh;
+here the SSH transport itself is implemented on the `cryptography`
+primitives (curve25519 kex, ed25519 host keys, aes128-ctr +
+hmac-sha2-256), plus an SFTP v3 subsystem.
+"""
+
+from .sftp_server import SftpServer  # noqa: F401

@@ -1,0 +1,296 @@
+"""Minimal SFTP v3 client over the in-repo SSH transport (for tests
+and tooling — the counterpart of sftp_server, in the role paramiko
+would play if it were available)."""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+from .sftp_server import (
+    FX_EOF,
+    FX_OK,
+    FXP_ATTRS,
+    FXP_CLOSE,
+    FXP_DATA,
+    FXP_HANDLE,
+    FXP_INIT,
+    FXP_LSTAT,
+    FXP_MKDIR,
+    FXP_NAME,
+    FXP_OPEN,
+    FXP_OPENDIR,
+    FXP_READ,
+    FXP_READDIR,
+    FXP_REALPATH,
+    FXP_REMOVE,
+    FXP_RENAME,
+    FXP_RMDIR,
+    FXP_STAT,
+    FXP_STATUS,
+    FXP_VERSION,
+    FXP_WRITE,
+    FXF_CREAT,
+    FXF_READ,
+    FXF_TRUNC,
+    FXF_WRITE,
+)
+from .ssh_transport import (
+    MSG_CHANNEL_CLOSE,
+    MSG_CHANNEL_DATA,
+    MSG_CHANNEL_EOF,
+    MSG_CHANNEL_OPEN,
+    MSG_CHANNEL_OPEN_CONFIRMATION,
+    MSG_CHANNEL_REQUEST,
+    MSG_CHANNEL_SUCCESS,
+    MSG_CHANNEL_WINDOW_ADJUST,
+    MSG_SERVICE_ACCEPT,
+    MSG_SERVICE_REQUEST,
+    MSG_USERAUTH_REQUEST,
+    MSG_USERAUTH_SUCCESS,
+    PacketReader,
+    SshError,
+    SshTransport,
+    sshstr,
+)
+
+
+class SftpStatusError(Exception):
+    def __init__(self, code: int, message: str):
+        self.code = code
+        super().__init__(f"sftp status {code}: {message}")
+
+
+class SftpClient:
+    def __init__(
+        self, host: str, port: int, user: str, password: str
+    ):
+        self._sock = socket.create_connection((host, port), timeout=30)
+        self.t = SshTransport(self._sock, server_side=False)
+        self.host_public_key = self.t.kex_client()
+        self._auth(user, password)
+        self._open_channel()
+        self._rid = 0
+        self._inbuf = b""
+        v = self._sftp_rpc(bytes([FXP_INIT]) + struct.pack(">I", 3))
+        if v[0] != FXP_VERSION:
+            raise SshError("no SFTP version response")
+
+    # ---- ssh plumbing ----
+
+    def _auth(self, user: str, password: str) -> None:
+        self.t.send_packet(
+            bytes([MSG_SERVICE_REQUEST]) + sshstr(b"ssh-userauth")
+        )
+        pkt = self.t.recv_msg()
+        if pkt[0] != MSG_SERVICE_ACCEPT:
+            raise SshError("service not accepted")
+        self.t.send_packet(
+            bytes([MSG_USERAUTH_REQUEST])
+            + sshstr(user.encode())
+            + sshstr(b"ssh-connection")
+            + sshstr(b"password")
+            + b"\x00"
+            + sshstr(password.encode())
+        )
+        pkt = self.t.recv_msg()
+        if pkt[0] != MSG_USERAUTH_SUCCESS:
+            raise SshError("authentication failed")
+
+    def _open_channel(self) -> None:
+        self.t.send_packet(
+            bytes([MSG_CHANNEL_OPEN])
+            + sshstr(b"session")
+            + struct.pack(">III", 0, 1 << 30, 1 << 15)
+        )
+        pkt = self.t.recv_msg()
+        if pkt[0] != MSG_CHANNEL_OPEN_CONFIRMATION:
+            raise SshError("channel open failed")
+        r = PacketReader(pkt[1:])
+        r.u32()  # our id echoed
+        self.peer = r.u32()
+        self.t.send_packet(
+            bytes([MSG_CHANNEL_REQUEST])
+            + struct.pack(">I", self.peer)
+            + sshstr(b"subsystem")
+            + b"\x01"
+            + sshstr(b"sftp")
+        )
+        pkt = self.t.recv_msg()
+        if pkt[0] != MSG_CHANNEL_SUCCESS:
+            raise SshError("sftp subsystem refused")
+
+    def _sftp_rpc(self, body: bytes) -> bytes:
+        self.t.send_packet(
+            bytes([MSG_CHANNEL_DATA])
+            + struct.pack(">I", self.peer)
+            + sshstr(struct.pack(">I", len(body)) + body)
+        )
+        while True:
+            if len(self._inbuf) >= 4:
+                (n,) = struct.unpack(">I", self._inbuf[:4])
+                if len(self._inbuf) >= 4 + n:
+                    resp = self._inbuf[4 : 4 + n]
+                    self._inbuf = self._inbuf[4 + n :]
+                    return resp
+            pkt = self.t.recv_msg()
+            if pkt[0] == MSG_CHANNEL_DATA:
+                r = PacketReader(pkt[1:])
+                r.u32()
+                self._inbuf += r.string()
+            elif pkt[0] in (MSG_CHANNEL_WINDOW_ADJUST, MSG_CHANNEL_EOF):
+                continue
+            elif pkt[0] == MSG_CHANNEL_CLOSE:
+                raise SshError("channel closed")
+
+    def _rpc(self, kind: int, payload: bytes) -> tuple[int, PacketReader]:
+        self._rid += 1
+        rid = self._rid
+        resp = self._sftp_rpc(
+            bytes([kind]) + struct.pack(">I", rid) + payload
+        )
+        r = PacketReader(resp[1:])
+        got = r.u32()
+        if got != rid:
+            raise SshError(f"request id mismatch {got} != {rid}")
+        return resp[0], r
+
+    @staticmethod
+    def _raise_status(r: PacketReader) -> None:
+        code = r.u32()
+        msg = r.string().decode()
+        if code not in (FX_OK,):
+            raise SftpStatusError(code, msg)
+
+    # ---- operations ----
+
+    def realpath(self, path: str) -> str:
+        kind, r = self._rpc(FXP_REALPATH, sshstr(path.encode()))
+        if kind != FXP_NAME:
+            self._raise_status(r)
+        r.u32()  # count
+        return r.string().decode()
+
+    def stat(self, path: str) -> dict:
+        kind, r = self._rpc(FXP_STAT, sshstr(path.encode()))
+        if kind != FXP_ATTRS:
+            self._raise_status(r)
+        return self._parse_attrs(r)
+
+    def listdir(self, path: str) -> list[str]:
+        kind, r = self._rpc(FXP_OPENDIR, sshstr(path.encode()))
+        if kind != FXP_HANDLE:
+            self._raise_status(r)
+        handle = r.string()
+        names: list[str] = []
+        try:
+            while True:
+                kind, r = self._rpc(FXP_READDIR, sshstr(handle))
+                if kind == FXP_STATUS:
+                    code = r.u32()
+                    if code == FX_EOF:
+                        break
+                    raise SftpStatusError(code, r.string().decode())
+                count = r.u32()
+                for _ in range(count):
+                    names.append(r.string().decode())
+                    r.string()  # longname
+                    self._parse_attrs(r)
+        finally:
+            self._rpc(FXP_CLOSE, sshstr(handle))
+        return names
+
+    def write_file(self, path: str, data: bytes, chunk: int = 32768) -> None:
+        kind, r = self._rpc(
+            FXP_OPEN,
+            sshstr(path.encode())
+            + struct.pack(">I", FXF_WRITE | FXF_CREAT | FXF_TRUNC)
+            + struct.pack(">I", 0),
+        )
+        if kind != FXP_HANDLE:
+            self._raise_status(r)
+        handle = r.string()
+        try:
+            for off in range(0, len(data), chunk) or [0]:
+                kind, r = self._rpc(
+                    FXP_WRITE,
+                    sshstr(handle)
+                    + struct.pack(">Q", off)
+                    + sshstr(data[off : off + chunk]),
+                )
+                self._raise_status(r)
+        finally:
+            kind, r = self._rpc(FXP_CLOSE, sshstr(handle))
+            self._raise_status(r)
+
+    def read_file(self, path: str, chunk: int = 32768) -> bytes:
+        kind, r = self._rpc(
+            FXP_OPEN,
+            sshstr(path.encode())
+            + struct.pack(">I", FXF_READ)
+            + struct.pack(">I", 0),
+        )
+        if kind != FXP_HANDLE:
+            self._raise_status(r)
+        handle = r.string()
+        out = b""
+        try:
+            while True:
+                kind, r = self._rpc(
+                    FXP_READ,
+                    sshstr(handle)
+                    + struct.pack(">Q", len(out))
+                    + struct.pack(">I", chunk),
+                )
+                if kind == FXP_STATUS:
+                    code = r.u32()
+                    if code == FX_EOF:
+                        break
+                    raise SftpStatusError(code, r.string().decode())
+                out += r.string()
+        finally:
+            self._rpc(FXP_CLOSE, sshstr(handle))
+        return out
+
+    def mkdir(self, path: str) -> None:
+        kind, r = self._rpc(
+            FXP_MKDIR, sshstr(path.encode()) + struct.pack(">I", 0)
+        )
+        self._raise_status(r)
+
+    def rmdir(self, path: str) -> None:
+        kind, r = self._rpc(FXP_RMDIR, sshstr(path.encode()))
+        self._raise_status(r)
+
+    def remove(self, path: str) -> None:
+        kind, r = self._rpc(FXP_REMOVE, sshstr(path.encode()))
+        self._raise_status(r)
+
+    def rename(self, old: str, new: str) -> None:
+        kind, r = self._rpc(
+            FXP_RENAME, sshstr(old.encode()) + sshstr(new.encode())
+        )
+        self._raise_status(r)
+
+    def close(self) -> None:
+        try:
+            self.t.send_packet(
+                bytes([MSG_CHANNEL_CLOSE]) + struct.pack(">I", self.peer)
+            )
+            self._sock.close()
+        except (OSError, SshError):
+            pass
+
+    @staticmethod
+    def _parse_attrs(r: PacketReader) -> dict:
+        flags = r.u32()
+        out: dict = {}
+        if flags & 0x01:
+            out["size"] = r.u64()
+        if flags & 0x02:
+            out["uid"], out["gid"] = r.u32(), r.u32()
+        if flags & 0x04:
+            out["permissions"] = r.u32()
+        if flags & 0x08:
+            out["atime"], out["mtime"] = r.u32(), r.u32()
+        return out

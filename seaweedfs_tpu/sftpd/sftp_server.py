@@ -1,0 +1,577 @@
+"""SSH server + SFTP v3 subsystem over the filer namespace.
+
+Reference: weed/sftpd/sftp_server.go + sftp_service.go — per-user
+password auth, a home-directory jail, optional read-only users, and
+the SFTP v3 operation set (open/read/write/close, opendir/readdir,
+stat/lstat/fstat, setstat, mkdir/rmdir/remove/rename, realpath).
+
+Writes accumulate per handle and publish to the filer on close (the
+gateway pattern WebDAV uses); reads stream straight through the
+filer's ranged read path.
+"""
+
+from __future__ import annotations
+
+import socket
+import stat as stat_mod
+import struct
+import threading
+import time
+from dataclasses import dataclass, field
+
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+from ..filer.entry import new_entry, normalize_path
+from ..filer.filer import Filer, FilerError
+from ..filer.filer_store import NotFound
+from ..utils.glog import logger
+from .ssh_transport import (
+    MSG_CHANNEL_CLOSE,
+    MSG_CHANNEL_DATA,
+    MSG_CHANNEL_EOF,
+    MSG_CHANNEL_FAILURE,
+    MSG_CHANNEL_OPEN,
+    MSG_CHANNEL_OPEN_CONFIRMATION,
+    MSG_CHANNEL_OPEN_FAILURE,
+    MSG_CHANNEL_REQUEST,
+    MSG_CHANNEL_SUCCESS,
+    MSG_CHANNEL_WINDOW_ADJUST,
+    MSG_KEXINIT,
+    MSG_SERVICE_ACCEPT,
+    MSG_SERVICE_REQUEST,
+    MSG_USERAUTH_FAILURE,
+    MSG_USERAUTH_REQUEST,
+    MSG_USERAUTH_SUCCESS,
+    PacketReader,
+    SshError,
+    SshTransport,
+    sshstr,
+)
+
+log = logger("sftpd")
+
+# SFTP v3 (draft-ietf-secsh-filexfer-02)
+FXP_INIT = 1
+FXP_VERSION = 2
+FXP_OPEN = 3
+FXP_CLOSE = 4
+FXP_READ = 5
+FXP_WRITE = 6
+FXP_LSTAT = 7
+FXP_FSTAT = 8
+FXP_SETSTAT = 9
+FXP_FSETSTAT = 10
+FXP_OPENDIR = 11
+FXP_READDIR = 12
+FXP_REMOVE = 13
+FXP_MKDIR = 14
+FXP_RMDIR = 15
+FXP_REALPATH = 16
+FXP_STAT = 17
+FXP_RENAME = 18
+FXP_STATUS = 101
+FXP_HANDLE = 102
+FXP_DATA = 103
+FXP_NAME = 104
+FXP_ATTRS = 105
+
+FX_OK = 0
+FX_EOF = 1
+FX_NO_SUCH_FILE = 2
+FX_PERMISSION_DENIED = 3
+FX_FAILURE = 4
+FX_OP_UNSUPPORTED = 8
+
+FXF_READ = 0x01
+FXF_WRITE = 0x02
+FXF_APPEND = 0x04
+FXF_CREAT = 0x08
+FXF_TRUNC = 0x10
+FXF_EXCL = 0x20
+
+ATTR_SIZE = 0x01
+ATTR_UIDGID = 0x02
+ATTR_PERMISSIONS = 0x04
+ATTR_ACMODTIME = 0x08
+
+
+@dataclass
+class SftpUser:
+    name: str
+    password: str
+    home: str = "/"
+    read_only: bool = False
+
+
+@dataclass
+class _Handle:
+    path: str
+    is_dir: bool = False
+    # file handles
+    writable: bool = False
+    append: bool = False
+    buffer: bytearray | None = None
+    entry: object = None
+    dirty: bool = False
+    # dir handles
+    listing: list | None = None
+    cursor: int = 0
+
+
+class SftpServer:
+    def __init__(
+        self,
+        filer: Filer,
+        ip: str = "localhost",
+        port: int = 2022,
+        users: dict[str, SftpUser] | None = None,
+        host_key: Ed25519PrivateKey | None = None,
+    ):
+        self.filer = filer
+        self.users = users or {}
+        self.host_key = host_key or Ed25519PrivateKey.generate()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((ip, port))
+        self.ip = ip
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(16)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept, daemon=True)
+
+    @property
+    def host_public_key(self) -> bytes:
+        return self.host_key.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _accept(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    # ---------------------------------------------------------- session
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            t = SshTransport(conn, server_side=True)
+            t.kex_server(self.host_key)
+            user = self._authenticate(t)
+            if user is None:
+                return
+            self._connection_loop(t, user)
+        except (SshError, OSError, EOFError) as e:
+            log.v(1, "sftp session ended: %s", e)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _authenticate(self, t: SshTransport) -> SftpUser | None:
+        pkt = t.recv_msg()
+        if pkt[0] != MSG_SERVICE_REQUEST:
+            raise SshError("expected SERVICE_REQUEST")
+        svc = PacketReader(pkt[1:]).string()
+        if svc != b"ssh-userauth":
+            raise SshError(f"unexpected service {svc!r}")
+        t.send_packet(bytes([MSG_SERVICE_ACCEPT]) + sshstr(svc))
+        for _attempt in range(8):
+            pkt = t.recv_msg()
+            if pkt[0] != MSG_USERAUTH_REQUEST:
+                raise SshError("expected USERAUTH_REQUEST")
+            r = PacketReader(pkt[1:])
+            username = r.string().decode()
+            r.string()  # service
+            method = r.string()
+            if method == b"password":
+                r.boolean()
+                password = r.string().decode()
+                u = self.users.get(username)
+                if u is not None and u.password == password:
+                    t.send_packet(bytes([MSG_USERAUTH_SUCCESS]))
+                    return u
+            # also advertises what we DO support on "none" probes
+            t.send_packet(
+                bytes([MSG_USERAUTH_FAILURE])
+                + sshstr(b"password")
+                + b"\x00"
+            )
+        return None
+
+    def _connection_loop(self, t: SshTransport, user: SftpUser) -> None:
+        channel_id = None
+        peer_channel = None
+        sftp = None
+        inbuf = b""
+        while True:
+            pkt = t.recv_msg()
+            kind = pkt[0]
+            r = PacketReader(pkt[1:])
+            if kind == MSG_KEXINIT:
+                # client-initiated re-key (OpenSSH: every few GB)
+                t.rekey_server(self.host_key, pkt)
+                continue
+            if kind == MSG_CHANNEL_OPEN:
+                ctype = r.string()
+                sender = r.u32()
+                r.u32()  # window
+                r.u32()  # max packet
+                if ctype != b"session" or channel_id is not None:
+                    t.send_packet(
+                        bytes([MSG_CHANNEL_OPEN_FAILURE])
+                        + struct.pack(">II", sender, 1)
+                        + sshstr(b"only one session channel")
+                        + sshstr(b"")
+                    )
+                    continue
+                channel_id, peer_channel = 0, sender
+                t.send_packet(
+                    bytes([MSG_CHANNEL_OPEN_CONFIRMATION])
+                    + struct.pack(
+                        ">IIII", sender, channel_id, 1 << 30, 1 << 15
+                    )
+                )
+            elif kind == MSG_CHANNEL_REQUEST:
+                r.u32()  # our channel
+                req = r.string()
+                want_reply = r.boolean()
+                ok = False
+                if req == b"subsystem" and r.string() == b"sftp":
+                    sftp = _SftpSession(self.filer, user)
+                    ok = True
+                if want_reply:
+                    t.send_packet(
+                        bytes(
+                            [MSG_CHANNEL_SUCCESS if ok else MSG_CHANNEL_FAILURE]
+                        )
+                        + struct.pack(">I", peer_channel)
+                    )
+            elif kind == MSG_CHANNEL_DATA:
+                r.u32()
+                data = r.string()
+                # replenish the flow-control window as we consume, or
+                # uploads stall once the initial grant is spent
+                t.send_packet(
+                    bytes([MSG_CHANNEL_WINDOW_ADJUST])
+                    + struct.pack(">II", peer_channel, len(data))
+                )
+                if sftp is None:
+                    continue
+                inbuf += data
+                out = b""
+                # sftp packets: u32 length + body
+                while len(inbuf) >= 4:
+                    (n,) = struct.unpack(">I", inbuf[:4])
+                    if len(inbuf) < 4 + n:
+                        break
+                    body = inbuf[4 : 4 + n]
+                    inbuf = inbuf[4 + n :]
+                    resp = sftp.handle(body)
+                    if resp is not None:
+                        out += struct.pack(">I", len(resp)) + resp
+                # chunk responses under the negotiated max packet size
+                for i in range(0, len(out), 1 << 15):
+                    t.send_packet(
+                        bytes([MSG_CHANNEL_DATA])
+                        + struct.pack(">I", peer_channel)
+                        + sshstr(out[i : i + (1 << 15)])
+                    )
+            elif kind == MSG_CHANNEL_WINDOW_ADJUST:
+                pass
+            elif kind == MSG_CHANNEL_EOF:
+                pass
+            elif kind == MSG_CHANNEL_CLOSE:
+                if sftp is not None:
+                    sftp.close_all()
+                t.send_packet(
+                    bytes([MSG_CHANNEL_CLOSE])
+                    + struct.pack(">I", peer_channel)
+                )
+                return
+
+
+class _SftpSession:
+    def __init__(self, filer: Filer, user: SftpUser):
+        self.filer = filer
+        self.user = user
+        self.handles: dict[bytes, _Handle] = {}
+        self._next = 0
+
+    # ---- path jail ----
+
+    def _resolve(self, raw: bytes) -> str:
+        import posixpath
+
+        p = raw.decode("utf-8", errors="replace")
+        if not p or p == ".":
+            p = "/"
+        if not p.startswith("/"):
+            p = "/" + p
+        # collapse ./.. INSIDE the client's view first ("/.." == "/"),
+        # then graft onto the home jail — dot segments can never climb
+        # above the jail root
+        p = posixpath.normpath(p)
+        full = normalize_path(self.user.home.rstrip("/") + p)
+        home = normalize_path(self.user.home)
+        if home != "/" and not (full == home or full.startswith(home + "/")):
+            full = home  # jailed: climbing out lands at home
+        return full
+
+    def _visible(self, full: str) -> str:
+        home = normalize_path(self.user.home)
+        if home == "/":
+            return full
+        if full == home:
+            return "/"
+        return full[len(home) :]
+
+    # ---- dispatch ----
+
+    def handle(self, body: bytes) -> bytes | None:
+        kind = body[0]
+        r = PacketReader(body[1:])
+        if kind == FXP_INIT:
+            return bytes([FXP_VERSION]) + struct.pack(">I", 3)
+        rid = r.u32()
+        try:
+            return self._dispatch(kind, rid, r)
+        except NotFound:
+            return self._status(rid, FX_NO_SUCH_FILE, "no such file")
+        except PermissionError as e:
+            return self._status(rid, FX_PERMISSION_DENIED, str(e))
+        except (FilerError, OSError, ValueError) as e:
+            return self._status(rid, FX_FAILURE, str(e))
+
+    def _dispatch(self, kind: int, rid: int, r: PacketReader) -> bytes:
+        if kind == FXP_REALPATH:
+            path = self._resolve(r.string())
+            vis = self._visible(path) or "/"
+            return (
+                bytes([FXP_NAME])
+                + struct.pack(">II", rid, 1)
+                + sshstr(vis.encode())
+                + sshstr(vis.encode())
+                + self._attrs_absent()
+            )
+        if kind == FXP_STAT or kind == FXP_LSTAT:
+            entry = self.filer.find_entry(self._resolve(r.string()))
+            return bytes([FXP_ATTRS]) + struct.pack(">I", rid) + self._attrs(entry)
+        if kind == FXP_FSTAT:
+            h = self.handles.get(r.string())
+            if h is None:
+                return self._status(rid, FX_FAILURE, "bad handle")
+            if h.buffer is not None:
+                attrs = (
+                    struct.pack(">I", ATTR_SIZE)
+                    + struct.pack(">Q", len(h.buffer))
+                )
+                return bytes([FXP_ATTRS]) + struct.pack(">I", rid) + attrs
+            entry = self.filer.find_entry(h.path)
+            return bytes([FXP_ATTRS]) + struct.pack(">I", rid) + self._attrs(entry)
+        if kind in (FXP_SETSTAT, FXP_FSETSTAT):
+            # attribute changes (chmod/utimes) are accepted and ignored,
+            # matching the reference's permissive default
+            return self._status(rid, FX_OK, "ok")
+        if kind == FXP_OPENDIR:
+            path = self._resolve(r.string())
+            entry = self.filer.find_entry(path)
+            if not entry.is_directory:
+                return self._status(rid, FX_FAILURE, "not a directory")
+            h = self._new_handle(
+                _Handle(
+                    path=path,
+                    is_dir=True,
+                    listing=list(self.filer.list_entries(path, limit=100_000)),
+                )
+            )
+            return bytes([FXP_HANDLE]) + struct.pack(">I", rid) + sshstr(h)
+        if kind == FXP_READDIR:
+            h = self.handles.get(r.string())
+            if h is None or not h.is_dir:
+                return self._status(rid, FX_FAILURE, "bad handle")
+            if h.cursor >= len(h.listing):
+                return self._status(rid, FX_EOF, "end of listing")
+            batch = h.listing[h.cursor : h.cursor + 100]
+            h.cursor += len(batch)
+            out = bytes([FXP_NAME]) + struct.pack(">II", rid, len(batch))
+            for e in batch:
+                name = e.name.encode()
+                out += sshstr(name) + sshstr(self._longname(e).encode())
+                out += self._attrs(e)
+            return out
+        if kind == FXP_OPEN:
+            return self._open(rid, r)
+        if kind == FXP_READ:
+            return self._read(rid, r)
+        if kind == FXP_WRITE:
+            return self._write(rid, r)
+        if kind == FXP_CLOSE:
+            return self._close(rid, r)
+        if kind == FXP_REMOVE:
+            self._check_writable()
+            path = self._resolve(r.string())
+            entry = self.filer.find_entry(path)
+            if entry.is_directory:
+                return self._status(rid, FX_FAILURE, "is a directory")
+            self.filer.delete_entry(path)
+            return self._status(rid, FX_OK, "removed")
+        if kind == FXP_MKDIR:
+            self._check_writable()
+            path = self._resolve(r.string())
+            self.filer.create_entry(
+                new_entry(path, is_directory=True, mode=0o755)
+            )
+            return self._status(rid, FX_OK, "created")
+        if kind == FXP_RMDIR:
+            self._check_writable()
+            path = self._resolve(r.string())
+            entry = self.filer.find_entry(path)
+            if not entry.is_directory:
+                return self._status(rid, FX_FAILURE, "not a directory")
+            self.filer.delete_entry(path)  # non-recursive: fails if non-empty
+            return self._status(rid, FX_OK, "removed")
+        if kind == FXP_RENAME:
+            self._check_writable()
+            old = self._resolve(r.string())
+            new = self._resolve(r.string())
+            self.filer.rename(old, new)
+            return self._status(rid, FX_OK, "renamed")
+        return self._status(rid, FX_OP_UNSUPPORTED, f"op {kind}")
+
+    # ---- file io ----
+
+    def _open(self, rid: int, r: PacketReader) -> bytes:
+        path = self._resolve(r.string())
+        pflags = r.u32()
+        writable = bool(pflags & (FXF_WRITE | FXF_APPEND))
+        if writable:
+            self._check_writable()
+        exists = self.filer.exists(path)
+        if writable and (pflags & FXF_EXCL) and exists:
+            return self._status(rid, FX_FAILURE, "exists")
+        if not writable and not exists:
+            return self._status(rid, FX_NO_SUCH_FILE, path)
+        h = _Handle(path=path, writable=writable)
+        if writable:
+            if exists and not (pflags & FXF_TRUNC):
+                entry = self.filer.find_entry(path)
+                h.buffer = bytearray(self.filer.read_entry(entry))
+            else:
+                h.buffer = bytearray()
+            h.append = bool(pflags & FXF_APPEND)
+        else:
+            h.entry = self.filer.find_entry(path)
+        return (
+            bytes([FXP_HANDLE])
+            + struct.pack(">I", rid)
+            + sshstr(self._new_handle(h))
+        )
+
+    def _read(self, rid: int, r: PacketReader) -> bytes:
+        h = self.handles.get(r.string())
+        offset = r.u64()
+        length = min(r.u32(), 1 << 20)
+        if h is None or h.is_dir:
+            return self._status(rid, FX_FAILURE, "bad handle")
+        if h.buffer is not None:
+            data = bytes(h.buffer[offset : offset + length])
+        else:
+            data = self.filer.read_entry(h.entry, offset=offset, size=length)
+        if not data:
+            return self._status(rid, FX_EOF, "eof")
+        return bytes([FXP_DATA]) + struct.pack(">I", rid) + sshstr(data)
+
+    def _write(self, rid: int, r: PacketReader) -> bytes:
+        h = self.handles.get(r.string())
+        offset = r.u64()
+        data = r.string()
+        if h is None or not h.writable or h.buffer is None:
+            return self._status(rid, FX_PERMISSION_DENIED, "not writable")
+        if h.append:
+            offset = len(h.buffer)
+        end = offset + len(data)
+        if end > len(h.buffer):
+            h.buffer.extend(b"\x00" * (end - len(h.buffer)))
+        h.buffer[offset:end] = data
+        h.dirty = True
+        return self._status(rid, FX_OK, "written")
+
+    def _close(self, rid: int, r: PacketReader) -> bytes:
+        hid = r.string()
+        h = self.handles.pop(hid, None)
+        if h is None:
+            return self._status(rid, FX_FAILURE, "bad handle")
+        if h.writable and h.buffer is not None and (h.dirty or not self.filer.exists(h.path)):
+            self.filer.write_file(h.path, bytes(h.buffer))
+        return self._status(rid, FX_OK, "closed")
+
+    def close_all(self) -> None:
+        for hid in list(self.handles):
+            h = self.handles.pop(hid)
+            if h.writable and h.buffer is not None and h.dirty:
+                try:
+                    self.filer.write_file(h.path, bytes(h.buffer))
+                except FilerError:
+                    pass
+
+    # ---- helpers ----
+
+    def _check_writable(self) -> None:
+        if self.user.read_only:
+            raise PermissionError(f"user {self.user.name} is read-only")
+
+    def _new_handle(self, h: _Handle) -> bytes:
+        hid = b"h%d" % self._next
+        self._next += 1
+        self.handles[hid] = h
+        return hid
+
+    @staticmethod
+    def _status(rid: int, code: int, msg: str) -> bytes:
+        return (
+            bytes([FXP_STATUS])
+            + struct.pack(">II", rid, code)
+            + sshstr(msg.encode())
+            + sshstr(b"en")
+        )
+
+    @staticmethod
+    def _attrs_absent() -> bytes:
+        return struct.pack(">I", 0)
+
+    @staticmethod
+    def _attrs(entry) -> bytes:
+        flags = ATTR_SIZE | ATTR_PERMISSIONS | ATTR_ACMODTIME
+        mode = entry.mode() or (0o755 if entry.is_directory else 0o644)
+        if entry.is_directory:
+            mode |= stat_mod.S_IFDIR
+        else:
+            mode |= stat_mod.S_IFREG
+        mtime = entry.attr.mtime or int(time.time())
+        return (
+            struct.pack(">I", flags)
+            + struct.pack(">Q", entry.file_size)
+            + struct.pack(">I", mode)
+            + struct.pack(">II", mtime, mtime)
+        )
+
+    def _longname(self, e) -> str:
+        kind = "d" if e.is_directory else "-"
+        return f"{kind}rw-r--r-- 1 sw sw {e.file_size:>10} Jan  1 00:00 {e.name}"

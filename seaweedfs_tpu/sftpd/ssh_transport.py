@@ -1,0 +1,450 @@
+"""SSH 2.0 transport (RFC 4253) — server and client roles.
+
+One algorithm suite, chosen for clean mappings onto `cryptography`
+primitives and universal client support:
+
+  kex        curve25519-sha256 (RFC 8731)
+  host key   ssh-ed25519
+  cipher     aes128-ctr (both directions)
+  mac        hmac-sha2-256
+  compression none
+
+The binary packet protocol, KEX, and key derivation follow RFC 4253;
+re-keying is answered if the peer asks but never initiated.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as hmac_mod
+import os
+import socket
+import struct
+
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+VERSION_STRING = b"SSH-2.0-seaweedfs_tpu_0.2"
+
+# message numbers (RFC 4253 / 4252 / 4254)
+MSG_DISCONNECT = 1
+MSG_IGNORE = 2
+MSG_UNIMPLEMENTED = 3
+MSG_DEBUG = 4
+MSG_SERVICE_REQUEST = 5
+MSG_SERVICE_ACCEPT = 6
+MSG_KEXINIT = 20
+MSG_NEWKEYS = 21
+MSG_KEX_ECDH_INIT = 30
+MSG_KEX_ECDH_REPLY = 31
+MSG_USERAUTH_REQUEST = 50
+MSG_USERAUTH_FAILURE = 51
+MSG_USERAUTH_SUCCESS = 52
+MSG_USERAUTH_BANNER = 53
+MSG_GLOBAL_REQUEST = 80
+MSG_REQUEST_SUCCESS = 81
+MSG_REQUEST_FAILURE = 82
+MSG_CHANNEL_OPEN = 90
+MSG_CHANNEL_OPEN_CONFIRMATION = 91
+MSG_CHANNEL_OPEN_FAILURE = 92
+MSG_CHANNEL_WINDOW_ADJUST = 93
+MSG_CHANNEL_DATA = 94
+MSG_CHANNEL_EXTENDED_DATA = 95
+MSG_CHANNEL_EOF = 96
+MSG_CHANNEL_CLOSE = 97
+MSG_CHANNEL_REQUEST = 98
+MSG_CHANNEL_SUCCESS = 99
+MSG_CHANNEL_FAILURE = 100
+
+KEX_ALG = b"curve25519-sha256"
+HOSTKEY_ALG = b"ssh-ed25519"
+CIPHER_ALG = b"aes128-ctr"
+MAC_ALG = b"hmac-sha2-256"
+COMP_ALG = b"none"
+
+
+class SshError(Exception):
+    pass
+
+
+# ------------------------------------------------------------- encoding
+
+
+def sshstr(b: bytes) -> bytes:
+    return struct.pack(">I", len(b)) + b
+
+
+def mpint(n: int) -> bytes:
+    if n == 0:
+        return sshstr(b"")
+    b = n.to_bytes((n.bit_length() + 8) // 8, "big")  # leading 0 if MSB set
+    return sshstr(b)
+
+
+def namelist(*names: bytes) -> bytes:
+    return sshstr(b",".join(names))
+
+
+class PacketReader:
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def byte(self) -> int:
+        v = self.buf[self.pos]
+        self.pos += 1
+        return v
+
+    def boolean(self) -> bool:
+        return self.byte() != 0
+
+    def u32(self) -> int:
+        (v,) = struct.unpack_from(">I", self.buf, self.pos)
+        self.pos += 4
+        return v
+
+    def u64(self) -> int:
+        (v,) = struct.unpack_from(">Q", self.buf, self.pos)
+        self.pos += 8
+        return v
+
+    def string(self) -> bytes:
+        n = self.u32()
+        s = self.buf[self.pos : self.pos + n]
+        self.pos += n
+        return s
+
+    def rest(self) -> bytes:
+        return self.buf[self.pos :]
+
+
+# ------------------------------------------------------------ transport
+
+
+class SshTransport:
+    """Packet layer over a connected socket; call kex_server()/
+    kex_client() immediately after construction."""
+
+    def __init__(self, sock: socket.socket, server_side: bool):
+        self.sock = sock
+        self.server_side = server_side
+        self._send_seq = 0
+        self._recv_seq = 0
+        self._send_cipher = None
+        self._recv_cipher = None
+        self._send_mac_key = b""
+        self._recv_mac_key = b""
+        self.session_id = b""
+        self._local_version = VERSION_STRING
+        self._remote_version = b""
+
+    # ---- version exchange ----
+
+    def exchange_versions(self) -> None:
+        self.sock.sendall(self._local_version + b"\r\n")
+        line = b""
+        while True:  # servers may send banner lines before the version
+            line = self._read_line()
+            if line.startswith(b"SSH-"):
+                break
+        self._remote_version = line
+        if not line.startswith((b"SSH-2.0-", b"SSH-1.99-")):
+            raise SshError(f"unsupported peer version {line!r}")
+
+    def _read_line(self) -> bytes:
+        out = b""
+        while not out.endswith(b"\n"):
+            c = self.sock.recv(1)
+            if not c:
+                raise SshError("peer closed during version exchange")
+            out += c
+            if len(out) > 1024:
+                raise SshError("version line too long")
+        return out.rstrip(b"\r\n")
+
+    # ---- binary packet protocol ----
+
+    def send_packet(self, payload: bytes) -> None:
+        block = 16 if self._send_cipher else 8
+        # padding so total (len+padlen+payload+pad) % block == 0, pad >= 4
+        pad_len = block - ((5 + len(payload)) % block)
+        if pad_len < 4:
+            pad_len += block
+        packet = (
+            struct.pack(">IB", 1 + len(payload) + pad_len, pad_len)
+            + payload
+            + os.urandom(pad_len)
+        )
+        if self._send_cipher is None:
+            self.sock.sendall(packet)
+        else:
+            mac = hmac_mod.new(
+                self._send_mac_key,
+                struct.pack(">I", self._send_seq) + packet,
+                hashlib.sha256,
+            ).digest()
+            self.sock.sendall(self._send_cipher.update(packet) + mac)
+        self._send_seq = (self._send_seq + 1) & 0xFFFFFFFF
+
+    def recv_packet(self) -> bytes:
+        if self._recv_cipher is None:
+            head = self._read_exact(4)
+            (n,) = struct.unpack(">I", head)
+            if n > 1024 * 1024:
+                raise SshError("packet too large")
+            body = self._read_exact(n)
+            pad = body[0]
+            payload = body[1 : n - pad]
+        else:
+            head = self._recv_cipher.update(self._read_exact(4))
+            (n,) = struct.unpack(">I", head)
+            if n > 1024 * 1024:
+                raise SshError("packet too large")
+            body = self._recv_cipher.update(self._read_exact(n))
+            mac = self._read_exact(32)
+            want = hmac_mod.new(
+                self._recv_mac_key,
+                struct.pack(">I", self._recv_seq) + head + body,
+                hashlib.sha256,
+            ).digest()
+            if not hmac_mod.compare_digest(mac, want):
+                raise SshError("MAC mismatch")
+            pad = body[0]
+            payload = body[1 : n - pad]
+        self._recv_seq = (self._recv_seq + 1) & 0xFFFFFFFF
+        return payload
+
+    def recv_msg(self) -> bytes:
+        """recv_packet, transparently handling IGNORE/DEBUG."""
+        while True:
+            p = self.recv_packet()
+            if not p:
+                continue
+            if p[0] in (MSG_IGNORE, MSG_DEBUG):
+                continue
+            if p[0] == MSG_UNIMPLEMENTED:
+                continue
+            if p[0] == MSG_DISCONNECT:
+                r = PacketReader(p[1:])
+                code = r.u32()
+                msg = r.string()
+                raise SshError(f"peer disconnected ({code}): {msg.decode(errors='replace')}")
+            return p
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise SshError("connection closed")
+            buf += chunk
+        return buf
+
+    # ---- KEXINIT ----
+
+    def _kexinit_payload(self) -> bytes:
+        return (
+            bytes([MSG_KEXINIT])
+            + os.urandom(16)
+            + namelist(KEX_ALG)
+            + namelist(HOSTKEY_ALG)
+            + namelist(CIPHER_ALG)
+            + namelist(CIPHER_ALG)
+            + namelist(MAC_ALG)
+            + namelist(MAC_ALG)
+            + namelist(COMP_ALG)
+            + namelist(COMP_ALG)
+            + namelist()  # languages c2s
+            + namelist()  # languages s2c
+            + b"\x00"  # first_kex_packet_follows
+            + struct.pack(">I", 0)
+        )
+
+    @staticmethod
+    def _check_kexinit(payload: bytes) -> None:
+        r = PacketReader(payload)
+        r.byte()
+        r.pos += 16  # cookie
+        lists = [r.string() for _ in range(10)]
+        for i, ours in enumerate(
+            (KEX_ALG, HOSTKEY_ALG, CIPHER_ALG, CIPHER_ALG, MAC_ALG, MAC_ALG,
+             COMP_ALG, COMP_ALG)
+        ):
+            if ours not in lists[i].split(b","):
+                raise SshError(
+                    f"no common algorithm (slot {i}): "
+                    f"peer offers {lists[i].decode()!r}"
+                )
+
+    # ---- key schedule ----
+
+    def _derive(self, K: int, H: bytes, letter: bytes, length: int) -> bytes:
+        out = hashlib.sha256(
+            mpint(K) + H + letter + self.session_id
+        ).digest()
+        while len(out) < length:
+            out += hashlib.sha256(mpint(K) + H + out).digest()
+        return out[:length]
+
+    def _activate(self, K: int, H: bytes) -> None:
+        if not self.session_id:
+            self.session_id = H
+        if self.server_side:
+            c2s_iv, s2c_iv = b"A", b"B"
+            c2s_key, s2c_key = b"C", b"D"
+            c2s_mac, s2c_mac = b"E", b"F"
+            recv_iv = self._derive(K, H, c2s_iv, 16)
+            recv_key = self._derive(K, H, c2s_key, 16)
+            self._recv_mac_key = self._derive(K, H, c2s_mac, 32)
+            send_iv = self._derive(K, H, s2c_iv, 16)
+            send_key = self._derive(K, H, s2c_key, 16)
+            self._send_mac_key = self._derive(K, H, s2c_mac, 32)
+        else:
+            send_iv = self._derive(K, H, b"A", 16)
+            send_key = self._derive(K, H, b"C", 16)
+            self._send_mac_key = self._derive(K, H, b"E", 32)
+            recv_iv = self._derive(K, H, b"B", 16)
+            recv_key = self._derive(K, H, b"D", 16)
+            self._recv_mac_key = self._derive(K, H, b"F", 32)
+        self._send_cipher = Cipher(
+            algorithms.AES(send_key), modes.CTR(send_iv)
+        ).encryptor()
+        self._recv_cipher = Cipher(
+            algorithms.AES(recv_key), modes.CTR(recv_iv)
+        ).decryptor()
+
+    # ---- server-side KEX ----
+
+    def kex_server(self, host_key: Ed25519PrivateKey) -> None:
+        self.exchange_versions()
+        I_S = self._kexinit_payload()
+        self.send_packet(I_S)
+        I_C = self.recv_msg()
+        if I_C[0] != MSG_KEXINIT:
+            raise SshError(f"expected KEXINIT, got {I_C[0]}")
+        self._kex_server_rounds(host_key, I_S, I_C)
+
+    def rekey_server(
+        self, host_key: Ed25519PrivateKey, their_kexinit: bytes
+    ) -> None:
+        """Answer a client-initiated re-key (OpenSSH re-keys every few
+        GB): same exchange as the initial KEX but the session id stays
+        pinned to the first H (RFC 4253 §7.2)."""
+        self._check_kexinit(their_kexinit)
+        I_S = self._kexinit_payload()
+        self.send_packet(I_S)
+        self._kex_server_rounds(host_key, I_S, their_kexinit)
+
+    def _kex_server_rounds(
+        self, host_key: Ed25519PrivateKey, I_S: bytes, I_C: bytes
+    ) -> None:
+        self._check_kexinit(I_C)
+        pkt = self.recv_msg()
+        if pkt[0] != MSG_KEX_ECDH_INIT:
+            raise SshError(f"expected KEX_ECDH_INIT, got {pkt[0]}")
+        q_c = PacketReader(pkt[1:]).string()
+        eph = X25519PrivateKey.generate()
+        q_s = eph.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+        shared = eph.exchange(X25519PublicKey.from_public_bytes(q_c))
+        K = int.from_bytes(shared, "big")
+        pub = host_key.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+        K_S = sshstr(HOSTKEY_ALG) + sshstr(pub)
+        H = hashlib.sha256(
+            sshstr(self._remote_version)
+            + sshstr(self._local_version)
+            + sshstr(I_C)
+            + sshstr(I_S)
+            + sshstr(K_S)
+            + sshstr(q_c)
+            + sshstr(q_s)
+            + mpint(K)
+        ).digest()
+        sig = sshstr(HOSTKEY_ALG) + sshstr(host_key.sign(H))
+        self.send_packet(
+            bytes([MSG_KEX_ECDH_REPLY])
+            + sshstr(K_S)
+            + sshstr(q_s)
+            + sshstr(sig)
+        )
+        self.send_packet(bytes([MSG_NEWKEYS]))
+        pkt = self.recv_msg()
+        if pkt[0] != MSG_NEWKEYS:
+            raise SshError(f"expected NEWKEYS, got {pkt[0]}")
+        self._activate(K, H)
+
+    # ---- client-side KEX ----
+
+    def kex_client(self) -> bytes:
+        """Returns the server's raw ed25519 host public key (for
+        known-hosts pinning by the caller)."""
+        self.exchange_versions()
+        I_C = self._kexinit_payload()
+        self.send_packet(I_C)
+        I_S = self.recv_msg()
+        if I_S[0] != MSG_KEXINIT:
+            raise SshError(f"expected KEXINIT, got {I_S[0]}")
+        return self._kex_client_rounds(I_C, I_S)
+
+    def rekey_client(self) -> bytes:
+        """Initiate a re-key mid-session (what OpenSSH does every few
+        GB); session id stays pinned to the first exchange hash."""
+        I_C = self._kexinit_payload()
+        self.send_packet(I_C)
+        I_S = self.recv_msg()
+        if I_S[0] != MSG_KEXINIT:
+            raise SshError(f"expected KEXINIT (rekey), got {I_S[0]}")
+        return self._kex_client_rounds(I_C, I_S)
+
+    def _kex_client_rounds(self, I_C: bytes, I_S: bytes) -> bytes:
+        self._check_kexinit(I_S)
+        eph = X25519PrivateKey.generate()
+        q_c = eph.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+        self.send_packet(bytes([MSG_KEX_ECDH_INIT]) + sshstr(q_c))
+        pkt = self.recv_msg()
+        if pkt[0] != MSG_KEX_ECDH_REPLY:
+            raise SshError(f"expected KEX_ECDH_REPLY, got {pkt[0]}")
+        r = PacketReader(pkt[1:])
+        K_S = r.string()
+        q_s = r.string()
+        sig_blob = r.string()
+        shared = eph.exchange(X25519PublicKey.from_public_bytes(q_s))
+        K = int.from_bytes(shared, "big")
+        H = hashlib.sha256(
+            sshstr(self._local_version)
+            + sshstr(self._remote_version)
+            + sshstr(I_C)
+            + sshstr(I_S)
+            + sshstr(K_S)
+            + sshstr(q_c)
+            + sshstr(q_s)
+            + mpint(K)
+        ).digest()
+        ks = PacketReader(K_S)
+        alg = ks.string()
+        if alg != HOSTKEY_ALG:
+            raise SshError(f"unexpected host key algorithm {alg!r}")
+        host_pub = ks.string()
+        sr = PacketReader(sig_blob)
+        if sr.string() != HOSTKEY_ALG:
+            raise SshError("bad signature algorithm")
+        Ed25519PublicKey.from_public_bytes(host_pub).verify(sr.string(), H)
+        self.send_packet(bytes([MSG_NEWKEYS]))
+        pkt = self.recv_msg()
+        if pkt[0] != MSG_NEWKEYS:
+            raise SshError(f"expected NEWKEYS, got {pkt[0]}")
+        self._activate(K, H)
+        return host_pub

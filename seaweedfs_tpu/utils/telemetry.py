@@ -74,7 +74,7 @@ class TelemetryCollector:
             with urllib.request.urlopen(req, timeout=10) as r:
                 return 200 <= r.status < 300
         except Exception as e:
-            log.v(1).info("telemetry post failed: %s", e)
+            log.v(1, "telemetry post failed: %s", e)
             return False
 
     def _loop(self) -> None:
