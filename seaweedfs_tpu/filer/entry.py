@@ -28,6 +28,11 @@ class Entry:
     attr: fpb.Attr = field(default_factory=fpb.Attr)
     extended: dict[str, bytes] = field(default_factory=dict)
     content: bytes = b""  # small-file inlining
+    # hardlinks (reference filer_hardlink.go): entries sharing one
+    # chunk list carry the same id; the live-name count lives in the
+    # store's KV so chunk GC runs only when the last name goes
+    hard_link_id: bytes = b""
+    hard_link_counter: int = 0
 
     @property
     def full_path(self) -> str:
@@ -57,6 +62,8 @@ class Entry:
             is_directory=self.is_directory,
             chunks=self.chunks,
             content=self.content,
+            hard_link_id=self.hard_link_id,
+            hard_link_counter=self.hard_link_counter,
         )
         e.attributes.CopyFrom(self.attr)
         for k, v in self.extended.items():
@@ -74,6 +81,8 @@ class Entry:
             is_directory=p.is_directory,
             chunks=list(p.chunks),
             content=p.content,
+            hard_link_id=p.hard_link_id,
+            hard_link_counter=p.hard_link_counter,
         )
         e.attr.CopyFrom(p.attributes)
         e.extended = dict(p.extended)

@@ -57,8 +57,9 @@ class Filer:
         self._gc_thread.start()
         self._listeners: list = []
         # serializes metadata read-modify-write (tagging, xattr-style
-        # updates) against entry replacement
-        self._mutate_lock = threading.Lock()
+        # updates) against entry replacement; reentrant so composite
+        # ops (recursive delete, hardlink) can nest the primitives
+        self._mutate_lock = threading.RLock()
         # chunk-list size beyond which create_entry manifestizes
         # (reference filechunk_manifest.go ManifestBatch)
         self.manifest_threshold = 1000
@@ -66,6 +67,10 @@ class Filer:
         # LWW merge both break on equal tsNs (watermarks use strict >)
         self._ts_lock = threading.Lock()
         self._last_ts = 0
+        # POSIX advisory byte-range locks (filer_grpc_server_posix_lock)
+        from .locks import PosixLockManager
+
+        self.lock_manager = PosixLockManager()
 
     # ------------------------------------------------------------- meta log
 
@@ -212,7 +217,22 @@ class Filer:
             root = Entry(directory="/", name="", is_directory=True)
             root.attr.file_mode = 0o755
             return root
-        return self.store.find(directory, name)
+        entry = self.store.find(directory, name)
+        if self._is_expired(entry):
+            # read-triggered expiry (reference filer TTL): the name
+            # vanishes and its chunks are reclaimed asynchronously
+            self.delete_entry(entry.full_path)
+            raise NotFound(entry.full_path)
+        return entry
+
+    @staticmethod
+    def _is_expired(entry: Entry) -> bool:
+        ttl = entry.attr.ttl_sec
+        return (
+            ttl > 0
+            and not entry.is_directory
+            and entry.attr.crtime + ttl <= int(time.time())
+        )
 
     def exists(self, full_path: str) -> bool:
         try:
@@ -225,30 +245,129 @@ class Filer:
         self, directory: str, start_from: str = "", limit: int = 1024,
         prefix: str = "",
     ) -> Iterator[Entry]:
-        return self.store.list(
-            normalize_path(directory), start_from, limit, prefix
-        )
+        """Yields up to `limit` LIVE entries: expired ones are reaped
+        and replaced by refetching past them, so a page of expired
+        names can never mask live entries behind it."""
+        directory = normalize_path(directory)
+        yielded = 0
+        cursor = start_from
+        while yielded < limit:
+            batch = list(self.store.list(directory, cursor, limit, prefix))
+            if not batch:
+                return
+            for e in batch:
+                if self._is_expired(e):
+                    self.delete_entry(e.full_path)
+                    continue
+                yield e
+                yielded += 1
+                if yielded >= limit:
+                    return
+            if len(batch) < limit:
+                return  # store exhausted
+            cursor = batch[-1].name
 
     def delete_entry(
         self, full_path: str, recursive: bool = False, gc_chunks: bool = True
     ) -> None:
-        directory, name = split_path(full_path)
-        entry = self._try_find(directory, name)
-        if entry is None:
-            return
-        if entry.is_directory:
-            children = list(self.store.list(entry.full_path, limit=2))
-            if children and not recursive:
-                raise FilerError(f"{full_path} not empty")
-            for child in self.store.list(entry.full_path, limit=1_000_000):
-                self.delete_entry(
-                    child.full_path, recursive=True, gc_chunks=gc_chunks
-                )
-            self.store.delete_folder_children(entry.full_path)
-        self.store.delete(directory, name)
-        if gc_chunks and entry.chunks:
-            self.gc_chunks(entry.chunks)
+        # the whole find→delete→release sequence runs under the
+        # (reentrant) mutate lock: two racing deletes of one hardlinked
+        # name must not double-decrement the shared counter
+        with self._mutate_lock:
+            directory, name = split_path(full_path)
+            entry = self._try_find(directory, name)
+            if entry is None:
+                return
+            if entry.is_directory:
+                children = list(self.store.list(entry.full_path, limit=2))
+                if children and not recursive:
+                    raise FilerError(f"{full_path} not empty")
+                for child in self.store.list(
+                    entry.full_path, limit=1_000_000
+                ):
+                    self.delete_entry(
+                        child.full_path, recursive=True, gc_chunks=gc_chunks
+                    )
+                self.store.delete_folder_children(entry.full_path)
+            self.store.delete(directory, name)
+            if gc_chunks:
+                self._release_entry_chunks(entry)
         self._notify(directory, entry, None, delete_chunks=gc_chunks)
+
+    def _release_entry_chunks(self, entry: Entry) -> None:
+        """GC an entry's chunks — unless other hardlink names still
+        reference them (reference filer_hardlink.go: counter in KV,
+        data reclaimed only with the last name)."""
+        if not entry.chunks:
+            return
+        if entry.hard_link_id:
+            key = b"hl:" + entry.hard_link_id
+            with self._mutate_lock:
+                n = int(self.store.kv_get(key) or b"1") - 1
+                if n > 0:
+                    self.store.kv_put(key, str(n).encode())
+                    return
+                self.store.kv_delete(key)
+        self.gc_chunks(entry.chunks)
+
+    def hard_link(self, src_path: str, dst_path: str) -> Entry:
+        """Create another name for src's content (filer_hardlink.go).
+        Both names share one chunk list; deleting either decrements the
+        shared KV counter and the chunks outlive all but the last."""
+        src_dir, src_name = split_path(src_path)
+        dst_dir, dst_name = split_path(dst_path)
+        notify: list = []
+        with self._mutate_lock:
+            src = self.store.find(src_dir, src_name)
+            if src.is_directory:
+                raise FilerError("cannot hardlink a directory")
+            if self._try_find(dst_dir, dst_name) is not None:
+                raise FilerError(f"{dst_path} exists")
+            # anything that can fail happens BEFORE the counter bump —
+            # a bumped counter with no inserted name would leak the
+            # chunks forever
+            self._ensure_parents(dst_dir)
+            if not src.hard_link_id:
+                import os as _os
+
+                old_src = Entry(
+                    directory=src.directory,
+                    name=src.name,
+                    chunks=list(src.chunks),
+                    content=src.content,
+                )
+                old_src.attr.CopyFrom(src.attr)
+                old_src.extended = dict(src.extended)
+                src.hard_link_id = _os.urandom(16)
+                src.hard_link_counter = 1
+                self.store.kv_put(b"hl:" + src.hard_link_id, b"1")
+                ts_src = self._stamp(src)
+                self.store.update(src)
+                # peers must learn src's hardlink marker or their
+                # delete path would GC the shared chunks
+                notify.append((src_dir, old_src, src, ts_src))
+            key = b"hl:" + src.hard_link_id
+            n = int(self.store.kv_get(key) or b"1") + 1
+            self.store.kv_put(key, str(n).encode())
+            dst = Entry(
+                directory=dst_dir,
+                name=dst_name,
+                chunks=list(src.chunks),
+                content=src.content,
+                hard_link_id=src.hard_link_id,
+                hard_link_counter=n,
+            )
+            dst.attr.CopyFrom(src.attr)
+            ts_dst = self._stamp(dst)
+            try:
+                self.store.insert(dst)
+            except BaseException:
+                self.store.kv_put(key, str(n - 1).encode())
+                raise
+            notify.append((dst_dir, None, dst, ts_dst))
+        for d, old, new, ts in notify:
+            self._notify(d, old, new, ts_ns=ts)
+        return dst
 
     def rename(self, old_path: str, new_path: str) -> None:
         """2-phase move (reference filer_rename.go): insert at the new
@@ -266,7 +385,7 @@ class Filer:
                 raise FilerError(f"{new_path} exists and is a directory")
             if entry.is_directory:
                 raise FilerError(f"cannot rename directory over file {new_path}")
-            self.gc_chunks(dest.chunks)
+            self._release_entry_chunks(dest)
         if entry.is_directory:
             # move the whole subtree
             for child in list(self.store.list(entry.full_path, limit=1_000_000)):
@@ -281,6 +400,8 @@ class Filer:
             is_directory=entry.is_directory,
             chunks=entry.chunks,
             content=entry.content,
+            hard_link_id=entry.hard_link_id,
+            hard_link_counter=entry.hard_link_counter,
         )
         moved.attr.CopyFrom(entry.attr)
         moved.extended = entry.extended
@@ -352,6 +473,7 @@ class Filer:
         collection: str | None = None,
         inline: bool = True,
         extended: dict | None = None,
+        ttl_sec: int = 0,
     ) -> Entry:
         """inline=False forces chunked storage even for tiny payloads —
         chunk-splicing consumers (S3 multipart parts) require chunks."""
@@ -364,14 +486,15 @@ class Filer:
             raise FilerError(f"{full_path}: type conflict with existing entry")
         if inline and len(data) <= INLINE_LIMIT:
             entry = new_entry(full_path, mode=mode, mime=mime)
+            entry.attr.ttl_sec = ttl_sec
             if extended:
                 entry.extended.update(extended)
             entry.content = data
             entry.attr.file_size = len(data)
             entry.attr.md5 = hashlib.md5(data).digest()
             self.create_entry(entry)
-            if old is not None and old.chunks:
-                self.gc_chunks(old.chunks)
+            if old is not None:
+                self._release_entry_chunks(old)
             return entry
         chunks = []
         ts = time.time_ns()
@@ -395,6 +518,7 @@ class Filer:
                 )
             )
         entry = new_entry(full_path, mode=mode, mime=mime)
+        entry.attr.ttl_sec = ttl_sec
         if extended:
             entry.extended.update(extended)
         entry.chunks = chunks
@@ -406,8 +530,8 @@ class Filer:
             # a losing race still must not leak the uploaded chunks
             self.gc_chunks(chunks)
             raise
-        if old is not None and old.chunks:
-            self.gc_chunks(old.chunks)
+        if old is not None:
+            self._release_entry_chunks(old)
         return entry
 
     def read_file(

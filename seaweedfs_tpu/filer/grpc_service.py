@@ -119,6 +119,39 @@ class FilerGrpcService:
             self.filer.store.kv_delete(bytes(request.key))
         return fpb.FilerOpResponse()
 
+    def LockRange(self, request, context):
+        """POSIX advisory locks (filer_grpc_server_posix_lock.go):
+        op 0 = lock, 1 = unlock, 2 = test, 3 = renew lease."""
+        lm = self.filer.lock_manager
+        lease = float(request.lease_seconds or 0)
+        if request.op == 0:
+            granted, who = lm.lock(
+                request.path,
+                request.owner,
+                request.start,
+                request.end,
+                exclusive=request.exclusive,
+                lease=lease,
+            )
+            return fpb.LockRangeResponse(granted=granted, conflict_owner=who)
+        if request.op == 1:
+            n = lm.unlock(
+                request.path, request.owner, request.start, request.end
+            )
+            return fpb.LockRangeResponse(granted=True, count=n)
+        if request.op == 2:
+            who = lm.test(
+                request.path,
+                request.start,
+                request.end,
+                exclusive=request.exclusive,
+            )
+            return fpb.LockRangeResponse(granted=not who, conflict_owner=who)
+        if request.op == 3:
+            n = lm.renew(request.path, request.owner, lease=lease)
+            return fpb.LockRangeResponse(granted=n > 0, count=n)
+        return fpb.LockRangeResponse(error=f"bad op {request.op}")
+
     # --------------------------------------------------------- subscription
 
     def SubscribeMetadata(self, request, context):

@@ -90,6 +90,7 @@ SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
         "AssignVolume": (UNARY, fpb.AssignVolumeRequest, fpb.AssignVolumeResponse),
         "KvGet": (UNARY, fpb.FilerKvGetRequest, fpb.FilerKvGetResponse),
         "KvPut": (UNARY, fpb.FilerKvPutRequest, fpb.FilerOpResponse),
+        "LockRange": (UNARY, fpb.LockRangeRequest, fpb.LockRangeResponse),
     },
     WORKER_SERVICE: {
         "WorkerStream": (BIDI, wk.WorkerMessage, wk.ServerMessage),

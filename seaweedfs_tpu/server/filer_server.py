@@ -60,6 +60,9 @@ class FilerServer:
             self._grpc, _rpc.FILER_SERVICE, FilerGrpcService(filer, meta_log)
         )
         self.grpc_port = self._grpc.add_insecure_port(f"{ip}:{grpc_port}")
+        from ..filer.tus import TusManager
+
+        self.tus = TusManager(filer)
         self.aggregator = None
         if peers:
             from ..filer.meta_aggregator import MetaAggregator
@@ -208,7 +211,70 @@ class FilerServer:
                 if self.command != "HEAD":
                     self.wfile.write(data)
 
-            do_HEAD = do_GET
+            def do_HEAD(self):
+                # TUS (resumable upload) offset probe
+                path = self._path()
+                if path.startswith("/.tus/") and "Tus-Resumable" in self.headers:
+                    from ..filer.tus import TusError
+
+                    try:
+                        state = server_ref.tus.head(path[len("/.tus/") :])
+                    except TusError as e:
+                        return self._tus_status(e.status)
+                    self.send_response(200)
+                    self.send_header("Tus-Resumable", "1.0.0")
+                    self.send_header("Upload-Offset", str(state["offset"]))
+                    self.send_header("Upload-Length", str(state["length"]))
+                    self.send_header("Cache-Control", "no-store")
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                return self.do_GET()
+
+            def _tus_status(self, code: int, offset: int | None = None):
+                self.send_response(code)
+                self.send_header("Tus-Resumable", "1.0.0")
+                if offset is not None:
+                    self.send_header("Upload-Offset", str(offset))
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def do_OPTIONS(self):
+                self.send_response(204)
+                self.send_header("Tus-Resumable", "1.0.0")
+                self.send_header("Tus-Version", "1.0.0")
+                self.send_header("Tus-Extension", "creation,termination")
+                self.send_header("Tus-Max-Size", str(1 << 40))
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def do_PATCH(self):
+                path = self._path()
+                # drain the body FIRST: a keep-alive connection must
+                # stay framed even when the request is rejected
+                try:
+                    n = int(self.headers.get("Content-Length", "0") or "0")
+                except ValueError:
+                    n = 0
+                body = self.rfile.read(n)
+                if not path.startswith("/.tus/"):
+                    return self._json(405, {"error": "PATCH is TUS-only"})
+                from ..filer.tus import TusError
+
+                try:
+                    offset = int(self.headers.get("Upload-Offset", "-1"))
+                    new_off = server_ref.tus.patch(
+                        path[len("/.tus/") :], offset, body
+                    )
+                except TusError as e:
+                    return self._tus_status(e.status)
+                except ValueError:
+                    return self._tus_status(400)
+                except FilerError:
+                    # e.g. the target path is a directory: surfaced as
+                    # an HTTP status, never a dropped connection
+                    return self._tus_status(409)
+                self._tus_status(204, offset=new_off)
 
             def _meta_tail(self, q):
                 """Long-poll metadata subscription: events after sinceNs,
@@ -244,6 +310,31 @@ class FilerServer:
                 u = urlparse(self.path)
                 q = parse_qs(u.query)
                 path = self._path()
+                if (
+                    self.command == "POST"
+                    and "Tus-Resumable" in self.headers
+                    and "Upload-Length" in self.headers
+                ):
+                    # TUS creation: the request path is the target.
+                    # Drain any body (creation-with-upload clients) so
+                    # the keep-alive stream stays framed.
+                    self.rfile.read(
+                        int(self.headers.get("Content-Length", "0") or "0")
+                    )
+                    from ..filer.tus import TusError
+
+                    try:
+                        upload_id = server_ref.tus.create(
+                            path, int(self.headers["Upload-Length"])
+                        )
+                    except (TusError, ValueError, FilerError):
+                        return self._tus_status(400)
+                    self.send_response(201)
+                    self.send_header("Tus-Resumable", "1.0.0")
+                    self.send_header("Location", f"/.tus/{upload_id}")
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
                 if "mv.from" in q:
                     src = normalize_path(q["mv.from"][0])
                     try:
@@ -268,8 +359,22 @@ class FilerServer:
                 from .volume_server import _parse_upload
 
                 name, mime, data = _parse_upload(self.headers, body)
+                ttl_sec = 0
+                if q.get("ttl", [""])[0]:
+                    spec = q["ttl"][0]
+                    mult = {"s": 1, "m": 60, "h": 3600, "d": 86400}.get(
+                        spec[-1], 0
+                    )
+                    try:
+                        ttl_sec = (
+                            int(spec[:-1]) * mult if mult else int(spec)
+                        )
+                    except ValueError:
+                        return self._json(400, {"error": f"bad ttl {spec!r}"})
                 try:
-                    entry = filer.write_file(path, data, mime=mime)
+                    entry = filer.write_file(
+                        path, data, mime=mime, ttl_sec=ttl_sec
+                    )
                 except FilerError as e:
                     return self._json(500, {"error": str(e)})
                 self._json(
@@ -280,10 +385,19 @@ class FilerServer:
             do_POST = _write
 
             def do_DELETE(self):
+                path = self._path()
+                if path.startswith("/.tus/") and "Tus-Resumable" in self.headers:
+                    from ..filer.tus import TusError
+
+                    try:
+                        server_ref.tus.terminate(path[len("/.tus/") :])
+                    except TusError as e:
+                        return self._tus_status(e.status)
+                    return self._tus_status(204)
                 q = parse_qs(urlparse(self.path).query)
                 recursive = q.get("recursive", [""])[0] == "true"
                 try:
-                    filer.delete_entry(self._path(), recursive=recursive)
+                    filer.delete_entry(path, recursive=recursive)
                 except FilerError as e:
                     return self._json(409, {"error": str(e)})
                 self._json(204, {})

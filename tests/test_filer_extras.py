@@ -1,0 +1,278 @@
+"""Filer extras: hardlinks, POSIX locks, per-entry TTL, TUS uploads.
+
+References: weed/filer/filer_hardlink.go,
+filer_grpc_server_posix_lock.go, filer TTL expiry,
+weed/server/filer_server_tus_*.go.
+"""
+
+import time
+
+import pytest
+import requests
+
+from conftest import allocate_port
+from seaweedfs_tpu.filer.filer import Filer, FilerError
+from seaweedfs_tpu.filer.filer_store import MemoryStore, NotFound
+from seaweedfs_tpu.filer.locks import PosixLockManager
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("fx")
+    mport = allocate_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp / "v")],
+        master=f"localhost:{mport}",
+        ip="localhost",
+        port=allocate_port(),
+    )
+    vs.start()
+    while not master.topo.nodes:
+        time.sleep(0.05)
+    yield mport
+    vs.stop()
+    master.stop()
+
+
+@pytest.fixture
+def filer(cluster):
+    f = Filer(MemoryStore(), master=f"localhost:{cluster}")
+    yield f
+    f.close()
+
+
+# ------------------------------------------------------------ hardlinks
+
+
+def test_hardlink_shares_content_until_last_name(filer):
+    data = b"H" * 10_000  # chunked, not inlined
+    filer.write_file("/a.bin", data)
+    filer.hard_link("/a.bin", "/b.bin")
+    a = filer.find_entry("/a.bin")
+    b = filer.find_entry("/b.bin")
+    assert a.hard_link_id and a.hard_link_id == b.hard_link_id
+    assert [c.fid for c in a.chunks] == [c.fid for c in b.chunks]
+    assert filer.read_entry(b) == data
+    # deleting one name keeps the content alive for the other
+    filer.delete_entry("/a.bin")
+    filer.flush_gc()
+    assert filer.read_entry(filer.find_entry("/b.bin")) == data
+    # deleting the last name reclaims the chunks
+    fid = b.chunks[0].fid
+    filer.delete_entry("/b.bin")
+    filer.flush_gc()
+    with pytest.raises(Exception):
+        filer.ops.read(fid)
+
+
+def test_hardlink_errors(filer):
+    filer.write_file("/src.txt", b"x" * 1000)
+    with pytest.raises(NotFound):
+        filer.hard_link("/nodir", "/dst")  # missing source
+    filer.hard_link("/src.txt", "/dst.txt")
+    with pytest.raises(FilerError):
+        filer.hard_link("/src.txt", "/dst.txt")  # destination exists
+    from seaweedfs_tpu.filer.entry import new_entry
+
+    filer.create_entry(new_entry("/adir", is_directory=True, mode=0o755))
+    with pytest.raises(FilerError):
+        filer.hard_link("/adir", "/dirlink")  # directory
+
+
+def test_hardlink_survives_rename(filer):
+    filer.write_file("/r1.bin", b"R" * 5000)
+    filer.hard_link("/r1.bin", "/r2.bin")
+    filer.rename("/r1.bin", "/moved.bin")
+    moved = filer.find_entry("/moved.bin")
+    assert moved.hard_link_id
+    filer.delete_entry("/moved.bin")
+    filer.flush_gc()
+    assert filer.read_entry(filer.find_entry("/r2.bin")) == b"R" * 5000
+
+
+# ---------------------------------------------------------- posix locks
+
+
+def test_posix_lock_semantics():
+    lm = PosixLockManager(default_lease=30)
+    ok, _ = lm.lock("/f", "alice", 0, 100, exclusive=True)
+    assert ok
+    # overlapping exclusive from another owner: denied
+    ok, who = lm.lock("/f", "bob", 50, 150, exclusive=True)
+    assert not ok and who == "alice"
+    # non-overlapping: granted
+    ok, _ = lm.lock("/f", "bob", 100, 200, exclusive=True)
+    assert ok
+    # shared locks coexist...
+    ok, _ = lm.lock("/g", "a", 0, 10, exclusive=False)
+    ok2, _ = lm.lock("/g", "b", 0, 10, exclusive=False)
+    assert ok and ok2
+    # ...but block an exclusive
+    ok, who = lm.lock("/g", "c", 0, 10, exclusive=True)
+    assert not ok and who in ("a", "b")
+    # same-owner relock replaces (upgrade in place)
+    ok, _ = lm.lock("/f", "alice", 0, 100, exclusive=False)
+    assert ok
+    ok, _ = lm.lock("/f", "carol", 0, 50, exclusive=False)
+    assert ok  # alice's range is now shared
+    # unlock releases
+    assert lm.unlock("/f", "alice", 0, 100) == 1
+    assert lm.test("/f", 0, 50, exclusive=False) == ""
+
+
+def test_posix_lock_lease_expiry():
+    lm = PosixLockManager(default_lease=0.15)
+    lm.lock("/lease", "gone-client", 0, 0, exclusive=True)
+    assert lm.test("/lease") == "gone-client"
+    time.sleep(0.2)
+    assert lm.test("/lease") == ""  # dead client cannot wedge the file
+    # renewal extends
+    lm.lock("/lease2", "alive", 0, 0, exclusive=True, lease=0.2)
+    time.sleep(0.12)
+    assert lm.renew("/lease2", "alive", lease=0.5) == 1
+    time.sleep(0.15)
+    assert lm.test("/lease2") == "alive"
+
+
+def test_lock_rpc_over_filer_grpc(filer):
+    import grpc
+
+    from seaweedfs_tpu.pb import filer_pb2 as fpb
+    from seaweedfs_tpu.pb import rpc
+
+    srv = FilerServer(filer, ip="localhost", port=allocate_port())
+    srv.start()
+    try:
+        chan = grpc.insecure_channel(f"localhost:{srv.grpc_port}")
+        stub = rpc.filer_stub(chan)
+        r = stub.LockRange(
+            fpb.LockRangeRequest(
+                path="/x", owner="m1", exclusive=True, op=0
+            )
+        )
+        assert r.granted
+        r = stub.LockRange(
+            fpb.LockRangeRequest(
+                path="/x", owner="m2", exclusive=True, op=0
+            )
+        )
+        assert not r.granted and r.conflict_owner == "m1"
+        r = stub.LockRange(
+            fpb.LockRangeRequest(path="/x", owner="m1", op=1)
+        )
+        assert r.granted and r.count == 1
+        r = stub.LockRange(
+            fpb.LockRangeRequest(
+                path="/x", owner="m2", exclusive=True, op=0
+            )
+        )
+        assert r.granted
+        chan.close()
+    finally:
+        srv.stop()
+
+
+# ----------------------------------------------------------- entry TTL
+
+
+def test_entry_ttl_expires_on_read(filer):
+    filer.write_file("/fleeting.txt", b"x" * 2000, ttl_sec=1)
+    assert filer.find_entry("/fleeting.txt").attr.ttl_sec == 1
+    # backdate creation instead of sleeping
+    def age(e):
+        e.attr.crtime -= 10
+
+    filer.mutate_entry("/fleeting.txt", age)
+    with pytest.raises(NotFound):
+        filer.find_entry("/fleeting.txt")
+    # the listing hides it too (and it is actually gone)
+    assert "fleeting.txt" not in [
+        e.name for e in filer.list_entries("/")
+    ]
+
+
+def test_entry_ttl_via_http(cluster, filer):
+    srv = FilerServer(filer, ip="localhost", port=allocate_port())
+    srv.start()
+    try:
+        base = f"http://localhost:{srv.port}"
+        r = requests.post(base + "/ttl.txt?ttl=1h", data=b"keeps", timeout=10)
+        assert r.status_code == 201
+        assert filer.find_entry("/ttl.txt").attr.ttl_sec == 3600
+        r = requests.post(base + "/ttl2.txt?ttl=oops", data=b"x", timeout=10)
+        assert r.status_code == 400
+    finally:
+        srv.stop()
+
+
+# ----------------------------------------------------------------- TUS
+
+
+def test_tus_resumable_upload(cluster, filer):
+    srv = FilerServer(filer, ip="localhost", port=allocate_port())
+    srv.start()
+    base = f"http://localhost:{srv.port}"
+    tus = {"Tus-Resumable": "1.0.0"}
+    try:
+        r = requests.options(base + "/", timeout=10)
+        assert r.headers["Tus-Version"] == "1.0.0"
+        assert "creation" in r.headers["Tus-Extension"]
+
+        payload = bytes(range(256)) * 300  # 76,800 bytes
+        r = requests.post(
+            base + "/uploads/final.bin",
+            headers={**tus, "Upload-Length": str(len(payload))},
+            timeout=10,
+        )
+        assert r.status_code == 201
+        loc = r.headers["Location"]
+        # patch in three chunks, with an offset probe between
+        third = len(payload) // 3
+        for i in range(3):
+            chunk = payload[i * third :] if i == 2 else payload[
+                i * third : (i + 1) * third
+            ]
+            head = requests.head(base + loc, headers=tus, timeout=10)
+            assert int(head.headers["Upload-Offset"]) == i * third
+            r = requests.patch(
+                base + loc,
+                headers={
+                    **tus,
+                    "Upload-Offset": str(i * third),
+                    "Content-Type": "application/offset+octet-stream",
+                },
+                data=chunk,
+                timeout=10,
+            )
+            assert r.status_code == 204, r.status_code
+        # completed: target exists, session gone
+        entry = filer.find_entry("/uploads/final.bin")
+        assert filer.read_entry(entry) == payload
+        r = requests.head(base + loc, headers=tus, timeout=10)
+        assert r.status_code == 404
+        # wrong offset is rejected with 409
+        r = requests.post(
+            base + "/uploads/x.bin",
+            headers={**tus, "Upload-Length": "10"},
+            timeout=10,
+        )
+        loc2 = r.headers["Location"]
+        r = requests.patch(
+            base + loc2,
+            headers={**tus, "Upload-Offset": "5"},
+            data=b"zzzzz",
+            timeout=10,
+        )
+        assert r.status_code == 409
+        # terminate aborts
+        r = requests.delete(base + loc2, headers=tus, timeout=10)
+        assert r.status_code == 204
+        r = requests.head(base + loc2, headers=tus, timeout=10)
+        assert r.status_code == 404
+    finally:
+        srv.stop()
