@@ -297,9 +297,9 @@ class Filer:
     def _release_entry_chunks(self, entry: Entry) -> None:
         """GC an entry's chunks — unless other hardlink names still
         reference them (reference filer_hardlink.go: counter in KV,
-        data reclaimed only with the last name)."""
-        if not entry.chunks:
-            return
+        data reclaimed only with the last name). The counter is
+        maintained even for chunk-less (inlined/remote) entries so hl:
+        rows never leak."""
         if entry.hard_link_id:
             key = b"hl:" + entry.hard_link_id
             with self._mutate_lock:
@@ -308,7 +308,8 @@ class Filer:
                     self.store.kv_put(key, str(n).encode())
                     return
                 self.store.kv_delete(key)
-        self.gc_chunks(entry.chunks)
+        if entry.chunks:
+            self.gc_chunks(entry.chunks)
 
     def hard_link(self, src_path: str, dst_path: str) -> Entry:
         """Create another name for src's content (filer_hardlink.go).
@@ -358,6 +359,10 @@ class Filer:
                 hard_link_counter=n,
             )
             dst.attr.CopyFrom(src.attr)
+            # extended attrs travel with the link: remote-mount markers
+            # (sw-remote) and user xattrs must survive, or the new name
+            # reads as empty ("sw-mts" is re-stamped below)
+            dst.extended = dict(src.extended)
             ts_dst = self._stamp(dst)
             try:
                 self.store.insert(dst)
@@ -546,6 +551,12 @@ class Filer:
         if entry.content:
             end = len(entry.content) if size < 0 else offset + size
             return entry.content[offset:end]
+        if not entry.chunks and "sw-remote" in entry.extended:
+            # lazy remote mount: stream through from the cloud object
+            # (reference read_remote.go); `remote.cache` pins it local
+            from ..remote.mount import read_remote
+
+            return read_remote(self, entry, offset=offset, size=size)
         file_size = entry.file_size
         if size < 0:
             size = max(file_size - offset, 0)

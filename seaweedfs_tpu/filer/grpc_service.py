@@ -145,6 +145,7 @@ class FilerGrpcService:
                 request.start,
                 request.end,
                 exclusive=request.exclusive,
+                owner=request.owner,
             )
             return fpb.LockRangeResponse(granted=not who, conflict_owner=who)
         if request.op == 3:

@@ -121,13 +121,21 @@ class PosixLockManager:
             return n
 
     def test(
-        self, path: str, start: int = 0, end: int = 0, exclusive: bool = True
+        self,
+        path: str,
+        start: int = 0,
+        end: int = 0,
+        exclusive: bool = True,
+        owner: str = "",
     ) -> str:
         """First conflicting owner for a hypothetical lock ('' = none) —
-        F_GETLK."""
+        F_GETLK. The caller's OWN locks never conflict (POSIX: a
+        process testing a range it holds must see it as lockable)."""
         end = end or MAX_END
         with self._lock:
             for r in self._alive(path):
+                if r.owner == owner:
+                    continue
                 if self._overlaps(start, end, r) and (exclusive or r.exclusive):
                     return r.owner
             return ""

@@ -32,6 +32,12 @@ class TusError(Exception):
 class TusManager:
     def __init__(self, filer: Filer):
         self.filer = filer
+        # serializes PATCH application per manager: a retried duplicate
+        # final PATCH must not double-run completion (which would GC
+        # the chunks the first completion's target references)
+        import threading
+
+        self._lock = threading.Lock()
 
     # ------------------------------------------------------------ state
 
@@ -49,6 +55,10 @@ class TusManager:
             state = json.loads(entry.extended.get("tus", b"{}"))
         except ValueError:
             raise TusError(500, "corrupt upload state") from None
+        if "offset" not in state or "length" not in state:
+            # an entry under /.tus that is not a session (e.g. the
+            # .parts directory) must 404, not KeyError the handler
+            raise TusError(404, "not an upload session")
         return entry, state
 
     # ------------------------------------------------------- operations
@@ -56,6 +66,14 @@ class TusManager:
     def create(self, target_path: str, length: int) -> str:
         if length < 0:
             raise TusError(400, "Upload-Length required")
+        try:
+            existing = self.filer.find_entry(target_path)
+            if existing.is_directory:
+                # refuse now, not at the final PATCH: a doomed upload
+                # should fail before any bytes move
+                raise TusError(409, f"{target_path} is a directory")
+        except NotFound:
+            pass
         upload_id = uuid.uuid4().hex
         entry = new_entry(self._session_path(upload_id), mode=0o600)
         entry.extended["tus"] = json.dumps(
@@ -70,25 +88,35 @@ class TusManager:
 
     def patch(self, upload_id: str, offset: int, data: bytes) -> int:
         """Returns the new offset; completes the upload when the final
-        byte lands."""
-        _entry, state = self._load(upload_id)
-        if offset != state["offset"]:
-            raise TusError(409, f"offset mismatch (have {state['offset']})")
-        if offset + len(data) > state["length"]:
-            raise TusError(413, "body exceeds Upload-Length")
-        if data:
-            # parts are forced to chunked storage: completion splices
-            # chunk lists, which inlined content does not have
-            self.filer.write_file(
-                f"{self._session_path(upload_id)}.parts/{offset:020d}",
-                data,
-                inline=False,
-            )
-            state["offset"] = offset + len(data)
-            self._store_state(upload_id, state)
-        if state["offset"] == state["length"]:
-            self._complete(upload_id, state)
-        return state["offset"]
+        byte lands. Serialized: concurrent duplicate PATCHes (client
+        retries) must not double-complete."""
+        with self._lock:
+            _entry, state = self._load(upload_id)
+            if offset != state["offset"]:
+                raise TusError(
+                    409, f"offset mismatch (have {state['offset']})"
+                )
+            if offset + len(data) > state["length"]:
+                raise TusError(413, "body exceeds Upload-Length")
+            new_offset = offset + len(data)
+            if data:
+                # parts are forced to chunked storage: completion
+                # splices chunk lists, which inlined content lacks
+                self.filer.write_file(
+                    f"{self._session_path(upload_id)}.parts/{offset:020d}",
+                    data,
+                    inline=False,
+                )
+            if new_offset == state["length"]:
+                # complete FIRST; only then persist/advance — a failed
+                # completion leaves the offset at the previous value so
+                # the client's retry re-lands the final part
+                state["offset"] = new_offset
+                self._complete(upload_id, state)
+            elif data:
+                state["offset"] = new_offset
+                self._store_state(upload_id, state)
+            return new_offset
 
     def terminate(self, upload_id: str) -> None:
         self._load(upload_id)  # 404 if unknown

@@ -1,0 +1,11 @@
+"""Remote (cloud) storage: client SPI, lazy remote mounts, sync sinks.
+
+Reference: weed/remote_storage (s3/gcs/azure client SPI + tracked sync
+offsets), weed/filer/read_remote.go + filer_lazy_remote*.go (cloud-
+backed directories with read-through caching), weed/replication/sink/
+s3sink. One concrete client here — S3-compatible with SigV4 — which
+covers the framework's own S3 gateway (cluster→cluster) and any
+S3-style endpoint.
+"""
+
+from .s3_client import RemoteS3Client, RemoteStorageError  # noqa: F401

@@ -1,0 +1,150 @@
+"""Lazy remote mounts: a filer directory backed by a cloud bucket.
+
+Reference: weed/filer/read_remote.go + filer_lazy_remote*.go and the
+shell's remote.configure/mount/cache/uncache/unmount commands —
+metadata is materialized at mount time (names, sizes, etags; no data),
+reads stream through from the remote on demand, and `cache` pins a
+file's bytes into local chunks (uncache drops them again).
+
+Storage conventions (all inside the filer itself, like the reference's
+filer-conf):
+  KV  remote.conf:<name>    -> JSON client config
+  KV  remote.mount:<dir>    -> JSON {remote, bucket, prefix}
+  entry.extended["sw-remote"] -> JSON {remote, bucket, key, size, etag}
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..filer.entry import Entry, new_entry, normalize_path
+from ..filer.filer import Filer, FilerError
+from .s3_client import RemoteS3Client
+
+REMOTE_ATTR = "sw-remote"
+
+
+def configure(filer: Filer, name: str, conf: dict) -> None:
+    """conf: {endpoint, access_key, secret_key, region}."""
+    filer.store.kv_put(f"remote.conf:{name}".encode(), json.dumps(conf).encode())
+
+
+def get_client(filer: Filer, name: str) -> RemoteS3Client:
+    raw = filer.store.kv_get(f"remote.conf:{name}".encode())
+    if raw is None:
+        raise FilerError(f"remote storage {name!r} not configured")
+    conf = json.loads(raw)
+    return RemoteS3Client(
+        endpoint=conf["endpoint"],
+        access_key=conf.get("access_key", ""),
+        secret_key=conf.get("secret_key", ""),
+        region=conf.get("region", "us-east-1"),
+    )
+
+
+def list_mounts(filer: Filer) -> dict[str, dict]:
+    out = {}
+    raw = filer.store.kv_get(b"remote.mounts")
+    if raw:
+        out = json.loads(raw)
+    return out
+
+
+def _save_mounts(filer: Filer, mounts: dict) -> None:
+    filer.store.kv_put(b"remote.mounts", json.dumps(mounts).encode())
+
+
+def mount(
+    filer: Filer, directory: str, remote_name: str, bucket: str, prefix: str = ""
+) -> int:
+    """Materialize the remote listing as entries under `directory`;
+    returns how many objects were mapped."""
+    directory = normalize_path(directory)
+    client = get_client(filer, remote_name)
+    mounts = list_mounts(filer)
+    if directory in mounts:
+        raise FilerError(f"{directory} is already a remote mount")
+    objs = client.list_objects(bucket, prefix)
+    n = 0
+    for obj in objs:
+        rel = obj.key[len(prefix) :].lstrip("/")
+        if not rel or rel.endswith("/"):
+            continue
+        path = f"{directory}/{rel}"
+        entry = new_entry(path, mode=0o644)
+        entry.attr.file_size = obj.size
+        entry.extended[REMOTE_ATTR] = json.dumps(
+            {
+                "remote": remote_name,
+                "bucket": bucket,
+                "key": obj.key,
+                "size": obj.size,
+                "etag": obj.etag,
+            }
+        ).encode()
+        filer.create_entry(entry)
+        n += 1
+    mounts[directory] = {
+        "remote": remote_name,
+        "bucket": bucket,
+        "prefix": prefix,
+    }
+    _save_mounts(filer, mounts)
+    return n
+
+
+def unmount(filer: Filer, directory: str) -> None:
+    directory = normalize_path(directory)
+    mounts = list_mounts(filer)
+    if directory not in mounts:
+        raise FilerError(f"{directory} is not a remote mount")
+    # local-cache chunks under the mount ARE reclaimed; remote data is
+    # untouched (the mount is a view)
+    filer.delete_entry(directory, recursive=True)
+    del mounts[directory]
+    _save_mounts(filer, mounts)
+
+
+def read_remote(
+    filer: Filer, entry: Entry, offset: int = 0, size: int = -1
+) -> bytes:
+    """Read-through for an uncached remote entry."""
+    meta = json.loads(entry.extended[REMOTE_ATTR])
+    client = get_client(filer, meta["remote"])
+    return client.get_object(
+        meta["bucket"], meta["key"], offset=offset, size=size
+    )
+
+
+def cache(filer: Filer, path: str) -> Entry:
+    """Pin a remote file's bytes into local chunks (remote.cache)."""
+    entry = filer.find_entry(path)
+    raw = entry.extended.get(REMOTE_ATTR)
+    if raw is None:
+        raise FilerError(f"{path} is not remote-mounted")
+    if entry.chunks or entry.content:
+        return entry  # already cached
+    data = read_remote(filer, entry)
+    cached = filer.write_file(
+        path, data, mime=entry.attr.mime, extended={REMOTE_ATTR: raw}
+    )
+    return cached
+
+
+def uncache(filer: Filer, path: str) -> Entry:
+    """Drop the local copy, keep the remote mapping (remote.uncache)."""
+    entry = filer.find_entry(path)
+    raw = entry.extended.get(REMOTE_ATTR)
+    if raw is None:
+        raise FilerError(f"{path} is not remote-mounted")
+    old_chunks = list(entry.chunks)
+
+    def strip(e: Entry) -> None:
+        e.chunks = []
+        e.content = b""
+        e.attr.file_size = json.loads(raw)["size"]
+
+    out = filer.mutate_entry(path, strip)
+    if old_chunks:
+        filer.gc_chunks(old_chunks)
+    return out

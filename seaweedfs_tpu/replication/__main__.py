@@ -31,10 +31,32 @@ def main(argv=None) -> int:
         "-path", default=rcfg.get_str("sink.filer.directory", "/") or "/"
     )
     p.add_argument("-state", default="filer.sync.state")
+    p.add_argument("-s3.accessKey", dest="s3_access", default="")
+    p.add_argument("-s3.secretKey", dest="s3_secret", default="")
     a = p.parse_args(argv)
     if not a.source or not a.target:
         p.error("-from/-to required (or replication.toml source/sink)")
-    sync = FilerSync(a.source, a.target, a.path, a.state)
+    if a.target.startswith("s3://"):
+        # cloud sink: -to s3://endpoint-host:port/bucket[/key-prefix]
+        from ..remote.s3_client import RemoteS3Client
+        from .s3_sink import S3Sink
+
+        rest = a.target[len("s3://") :]
+        host, _, bucket_path = rest.partition("/")
+        bucket, _, key_prefix = bucket_path.partition("/")
+        if not bucket:
+            p.error("s3 target needs s3://host:port/bucket[/prefix]")
+        client = RemoteS3Client(
+            endpoint=f"http://{host}",
+            access_key=a.s3_access,
+            secret_key=a.s3_secret,
+        )
+        sync = S3Sink(
+            a.source, client, bucket,
+            key_prefix=key_prefix, path_prefix=a.path, state_file=a.state,
+        )
+    else:
+        sync = FilerSync(a.source, a.target, a.path, a.state)
     signal.signal(signal.SIGTERM, lambda *x: sync.stop())
     signal.signal(signal.SIGINT, lambda *x: sync.stop())
     print(f"syncing {a.source}{a.path} -> {a.target}", flush=True)

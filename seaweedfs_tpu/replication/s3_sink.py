@@ -1,0 +1,181 @@
+"""Cloud sink: continuous filer → S3-compatible bucket replication.
+
+Reference: weed/replication/sink/s3sink — the same source plumbing as
+the filer→filer daemon (full walk, then meta-log tail with a persisted
+watermark) but the write side is a RemoteS3Client, so any filer subtree
+mirrors into a bucket/prefix on this framework's own S3 gateway or any
+S3 endpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.parse
+
+import requests
+
+from ..remote.s3_client import RemoteS3Client, RemoteStorageError
+from ..utils.glog import logger
+from ..utils.urls import service_url
+
+log = logger("s3sink")
+
+
+class S3Sink:
+    def __init__(
+        self,
+        source: str,
+        client: RemoteS3Client,
+        bucket: str,
+        key_prefix: str = "",
+        path_prefix: str = "/",
+        state_file: str = "",
+        exclude_prefixes: tuple = ("/topics", "/.tus", "/.uploads"),
+    ):
+        self.source = source
+        self.client = client
+        self.bucket = bucket
+        self.key_prefix = key_prefix.strip("/")
+        self.prefix = path_prefix.rstrip("/") or "/"
+        self.exclude = exclude_prefixes
+        self.state_file = state_file
+        self.watermark = 0
+        if state_file and os.path.exists(state_file):
+            try:
+                self.watermark = json.load(open(state_file))["sinceNs"]
+            except (ValueError, KeyError, OSError):
+                pass
+        self._http = requests.Session()
+        self._stop = threading.Event()
+        self.synced_files = 0
+        self.deleted_files = 0
+
+    # ------------------------------------------------------------ helpers
+
+    def _key(self, path: str) -> str:
+        rel = path
+        if self.prefix != "/" and path.startswith(self.prefix):
+            rel = path[len(self.prefix) :]
+        rel = rel.lstrip("/")
+        return f"{self.key_prefix}/{rel}".strip("/")
+
+    @staticmethod
+    def _under(path: str, prefix: str) -> bool:
+        return path == prefix or path.startswith(prefix.rstrip("/") + "/")
+
+    def _in_scope(self, path: str) -> bool:
+        if any(self._under(path, x) for x in self.exclude):
+            return False
+        return self.prefix == "/" or self._under(path, self.prefix)
+
+    def _save_state(self) -> None:
+        if self.state_file:
+            with open(self.state_file, "w") as f:
+                json.dump({"sinceNs": self.watermark}, f)
+
+    def _copy(self, path: str) -> bool:
+        r = self._http.get(
+            service_url(self.source, urllib.parse.quote(path)), timeout=300
+        )
+        if r.status_code != 200:
+            return False
+        try:
+            self.client.put_object(self.bucket, self._key(path), r.content)
+        except RemoteStorageError as e:
+            log.warning("put %s: %s", path, e)
+            return False
+        self.synced_files += 1
+        return True
+
+    # ------------------------------------------------------------- phases
+
+    def full_sync(self) -> int:
+        from ..client.filer_client import list_dir
+
+        self.client.ensure_bucket(self.bucket)
+        copied = 0
+        stack = [self.prefix]
+        while stack:
+            d = stack.pop()
+            for e in list_dir(self.source, d, session=self._http):
+                path = e["FullPath"]
+                if not self._in_scope(path):
+                    continue
+                if e["IsDirectory"]:
+                    stack.append(path)  # S3 has no directories
+                elif self._copy(path):
+                    copied += 1
+        return copied
+
+    def apply_event(self, ev: dict) -> None:
+        directory = ev.get("directory", "")
+        old, new = ev.get("oldEntry"), ev.get("newEntry")
+        if new:
+            path = (
+                f"{directory.rstrip('/')}/{new['name']}"
+                if new["name"]
+                else directory
+            )
+            if self._in_scope(path) and not new["isDirectory"]:
+                self._copy(path)
+        elif old:
+            path = (
+                f"{directory.rstrip('/')}/{old['name']}"
+                if old["name"]
+                else directory
+            )
+            if not self._in_scope(path):
+                return
+            try:
+                self.client.delete_object(self.bucket, self._key(path))
+                self.deleted_files += 1
+            except RemoteStorageError as e:
+                log.warning("delete %s: %s", path, e)
+
+    def tail_once(self, wait_seconds: float = 10.0) -> int:
+        r = self._http.get(
+            service_url(self.source, "/~meta/tail"),
+            params={
+                "sinceNs": str(self.watermark),
+                "waitSeconds": str(wait_seconds),
+            },
+            timeout=wait_seconds + 30,
+        )
+        r.raise_for_status()
+        payload = r.json()
+        events = payload.get("events", [])
+        for ev in events:
+            self.apply_event(ev)
+            self.watermark = max(self.watermark, int(ev.get("tsNs", 0)))
+        if events:
+            self._save_state()
+        return len(events)
+
+    def run(self) -> None:
+        if self.watermark == 0:
+            # watermark BEFORE the walk: events during the copy replay
+            self.watermark = self._source_now_ns()
+            n = self.full_sync()
+            log.info("initial copy: %d files -> s3://%s", n, self.bucket)
+            self._save_state()
+        while not self._stop.is_set():
+            try:
+                self.tail_once()
+            except (requests.RequestException, ValueError) as e:
+                log.warning("tail error: %s", e)
+                self._stop.wait(2.0)
+
+    def _source_now_ns(self) -> int:
+        r = self._http.get(
+            service_url(self.source, "/~meta/tail"),
+            params={"sinceNs": str(1 << 62), "waitSeconds": "0"},
+            timeout=30,
+        )
+        r.raise_for_status()
+        return int(r.json().get("nowNs", 0)) or time.time_ns()
+
+    def stop(self) -> None:
+        self._stop.set()

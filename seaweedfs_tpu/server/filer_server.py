@@ -306,10 +306,54 @@ class FilerServer:
                     },
                 )
 
+            def _remote_op(self, op: str):
+                """Remote-mount control plane (reference shell
+                remote.configure/mount/cache/uncache/unmount)."""
+                import json as _json
+
+                from ..remote import mount as rm
+
+                n = int(self.headers.get("Content-Length", "0") or "0")
+                try:
+                    body = _json.loads(self.rfile.read(n) or b"{}")
+                except ValueError:
+                    return self._json(400, {"error": "bad json"})
+                try:
+                    if op == "configure":
+                        rm.configure(filer, body.pop("name"), body)
+                        return self._json(200, {"configured": True})
+                    if op == "mount":
+                        n_objs = rm.mount(
+                            filer,
+                            body["dir"],
+                            body["remote"],
+                            body["bucket"],
+                            body.get("prefix", ""),
+                        )
+                        return self._json(200, {"mounted": n_objs})
+                    if op == "unmount":
+                        rm.unmount(filer, body["dir"])
+                        return self._json(200, {"unmounted": True})
+                    if op == "cache":
+                        e = rm.cache(filer, body["path"])
+                        return self._json(
+                            200, {"cached": True, "chunks": len(e.chunks)}
+                        )
+                    if op == "uncache":
+                        rm.uncache(filer, body["path"])
+                        return self._json(200, {"uncached": True})
+                except (FilerError, NotFound, KeyError) as e:
+                    return self._json(409, {"error": str(e)})
+                except Exception as e:  # remote endpoint failures
+                    return self._json(502, {"error": str(e)})
+                return self._json(404, {"error": f"unknown op {op}"})
+
             def _write(self):
                 u = urlparse(self.path)
                 q = parse_qs(u.query)
                 path = self._path()
+                if path.startswith("/~remote/") and self.command == "POST":
+                    return self._remote_op(path[len("/~remote/") :])
                 if (
                     self.command == "POST"
                     and "Tus-Resumable" in self.headers

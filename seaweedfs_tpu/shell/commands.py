@@ -1371,3 +1371,102 @@ def lock_status_cmd(env: ShellEnv, args) -> str:
         f"{name:24s} {owner:24s} {remaining:6.1f}s left"
         for name, owner, remaining in rows
     )
+
+
+# ------------------------------------------------------- remote storage
+
+
+def _remote_post(env: "ShellEnv", op: str, body: dict) -> str:
+    import json as _json
+
+    import requests as rq
+
+    r = rq.post(
+        service_url(env.filer_addr, f"/~remote/{op}"),
+        data=_json.dumps(body),
+        timeout=300,
+    )
+    try:
+        payload = r.json()
+    except ValueError:
+        payload = {"error": r.text[:200]}
+    if r.status_code != 200:
+        return f"error: {payload.get('error', r.status_code)}"
+    return ", ".join(f"{k}={v}" for k, v in payload.items())
+
+
+@command(
+    "remote.configure",
+    "-name n -endpoint http://host:port [-accessKey k -secretKey s -region r]",
+)
+def remote_configure(env: ShellEnv, args) -> str:
+    """Store an S3-compatible remote's credentials in the filer
+    (reference remote.configure)."""
+    p = argparse.ArgumentParser(prog="remote.configure")
+    p.add_argument("-name", required=True)
+    p.add_argument("-endpoint", required=True)
+    p.add_argument("-accessKey", default="")
+    p.add_argument("-secretKey", default="")
+    p.add_argument("-region", default="us-east-1")
+    a = p.parse_args(args)
+    return _remote_post(
+        env,
+        "configure",
+        {
+            "name": a.name,
+            "endpoint": a.endpoint,
+            "access_key": a.accessKey,
+            "secret_key": a.secretKey,
+            "region": a.region,
+        },
+    )
+
+
+@command(
+    "remote.mount",
+    "-dir /path -remote name -bucket b [-prefix p] (lazy cloud mount)",
+)
+def remote_mount(env: ShellEnv, args) -> str:
+    """Materialize a bucket listing as a filer directory; file bytes
+    stream through on read until remote.cache pins them
+    (reference remote.mount + filer_lazy_remote)."""
+    p = argparse.ArgumentParser(prog="remote.mount")
+    p.add_argument("-dir", required=True)
+    p.add_argument("-remote", required=True)
+    p.add_argument("-bucket", required=True)
+    p.add_argument("-prefix", default="")
+    a = p.parse_args(args)
+    return _remote_post(
+        env,
+        "mount",
+        {
+            "dir": a.dir,
+            "remote": a.remote,
+            "bucket": a.bucket,
+            "prefix": a.prefix,
+        },
+    )
+
+
+@command("remote.unmount", "-dir /path (drop the mount view; remote untouched)")
+def remote_unmount(env: ShellEnv, args) -> str:
+    p = argparse.ArgumentParser(prog="remote.unmount")
+    p.add_argument("-dir", required=True)
+    a = p.parse_args(args)
+    return _remote_post(env, "unmount", {"dir": a.dir})
+
+
+@command("remote.cache", "-path /file (pin remote bytes into local chunks)")
+def remote_cache(env: ShellEnv, args) -> str:
+    p = argparse.ArgumentParser(prog="remote.cache")
+    p.add_argument("-path", required=True)
+    a = p.parse_args(args)
+    return _remote_post(env, "cache", {"path": a.path})
+
+
+@command("remote.uncache", "-path /file (drop local copy, keep mapping)")
+def remote_uncache(env: ShellEnv, args) -> str:
+    p = argparse.ArgumentParser(prog="remote.uncache")
+    p.add_argument("-path", required=True)
+    a = p.parse_args(args)
+    return _remote_post(env, "uncache", {"path": a.path})

@@ -1,0 +1,275 @@
+"""Remote storage: SigV4 S3 client, lazy remote mounts, cloud sink.
+
+References: weed/remote_storage/s3, weed/filer/read_remote.go,
+weed/replication/sink/s3sink. The "cloud" in these tests is the
+framework's own S3 gateway — the client must interop with it through
+real SigV4-authenticated HTTP.
+"""
+
+import time
+
+import pytest
+import requests
+
+from conftest import allocate_port
+from seaweedfs_tpu.filer.filer import Filer
+from seaweedfs_tpu.filer.filer_store import MemoryStore, NotFound
+from seaweedfs_tpu.remote import RemoteS3Client, RemoteStorageError
+from seaweedfs_tpu.remote import mount as rm
+from seaweedfs_tpu.s3 import Identity, IdentityStore, S3Server
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+AK, SK = "remoteAK", "remoteSKsecret"
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    """master + volume + a 'cloud' (our own S3 gateway on its own
+    filer) + a local filer that will mount it."""
+    tmp = tmp_path_factory.mktemp("remote")
+    mport = allocate_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp / "v")],
+        master=f"localhost:{mport}",
+        ip="localhost",
+        port=allocate_port(),
+    )
+    vs.start()
+    while not master.topo.nodes:
+        time.sleep(0.05)
+    cloud_filer = Filer(MemoryStore(), master=f"localhost:{mport}")
+    idents = IdentityStore()
+    idents.add(Identity("remote-user", AK, SK))
+    s3 = S3Server(
+        cloud_filer,
+        ip="localhost",
+        port=allocate_port(),
+        identities=idents,
+        lifecycle_interval=0,
+    )
+    s3.start()
+    local_filer = Filer(MemoryStore(), master=f"localhost:{mport}")
+    yield {
+        "mport": mport,
+        "s3": s3,
+        "cloud_filer": cloud_filer,
+        "filer": local_filer,
+    }
+    s3.stop()
+    local_filer.close()
+    cloud_filer.close()
+    vs.stop()
+    master.stop()
+
+
+@pytest.fixture
+def client(stack):
+    return RemoteS3Client(
+        endpoint=f"http://localhost:{stack['s3'].port}",
+        access_key=AK,
+        secret_key=SK,
+    )
+
+
+def test_s3_client_sigv4_round_trip(stack, client):
+    client.ensure_bucket("cloud-data")
+    client.put_object("cloud-data", "a/b/hello.txt", b"hello remote")
+    assert client.get_object("cloud-data", "a/b/hello.txt") == b"hello remote"
+    # ranged read
+    assert client.get_object("cloud-data", "a/b/hello.txt", 6, 6) == b"remote"
+    objs = client.list_objects("cloud-data", prefix="a/")
+    assert [o.key for o in objs] == ["a/b/hello.txt"]
+    assert objs[0].size == 12
+    head = client.head_object("cloud-data", "a/b/hello.txt")
+    assert head.size == 12
+    assert client.head_object("cloud-data", "missing") is None
+    client.delete_object("cloud-data", "a/b/hello.txt")
+    assert client.list_objects("cloud-data", prefix="a/") == []
+    # a wrong secret is rejected by the gateway
+    bad = RemoteS3Client(
+        endpoint=f"http://localhost:{stack['s3'].port}",
+        access_key=AK,
+        secret_key="wrong",
+    )
+    with pytest.raises(RemoteStorageError):
+        bad.put_object("cloud-data", "x", b"y")
+
+
+def test_remote_mount_read_through_cache(stack, client):
+    filer = stack["filer"]
+    client.ensure_bucket("datasets")
+    blob = bytes(range(256)) * 64  # 16 KiB
+    client.put_object("datasets", "v1/model.bin", blob)
+    client.put_object("datasets", "v1/labels.txt", b"cat\ndog\n")
+    rm.configure(
+        filer,
+        "cloud",
+        {
+            "endpoint": f"http://localhost:{stack['s3'].port}",
+            "access_key": AK,
+            "secret_key": SK,
+        },
+    )
+    n = rm.mount(filer, "/mnt/data", "cloud", "datasets", prefix="v1")
+    assert n == 2
+    # metadata materialized, no data copied
+    e = filer.find_entry("/mnt/data/model.bin")
+    assert e.file_size == len(blob) and not e.chunks and not e.content
+    # read-through
+    assert filer.read_entry(e) == blob
+    assert filer.read_entry(e, offset=256, size=16) == blob[256:272]
+    # cache pins bytes locally; reads stop hitting the remote
+    rm.cache(filer, "/mnt/data/model.bin")
+    cached = filer.find_entry("/mnt/data/model.bin")
+    assert cached.chunks or cached.content
+    stack["s3"].stop()  # cloud goes dark
+    try:
+        assert filer.read_entry(cached) == blob
+        # uncached file now fails (proves reads really were remote)
+        lab = filer.find_entry("/mnt/data/labels.txt")
+        with pytest.raises(Exception):
+            filer.read_entry(lab)
+    finally:
+        stack["s3"]._http.server_activate  # noqa: B018 — keep ref
+        # restart the gateway for later tests
+        from seaweedfs_tpu.s3 import S3Server as _S3
+
+        new = _S3(
+            stack["cloud_filer"],
+            ip="localhost",
+            port=allocate_port(),
+            identities=stack["s3"].identities,
+            lifecycle_interval=0,
+        )
+        new.start()
+        stack["s3"] = new
+        client.endpoint = f"http://localhost:{new.port}"
+        rm.configure(
+            filer,
+            "cloud",
+            {
+                "endpoint": client.endpoint,
+                "access_key": AK,
+                "secret_key": SK,
+            },
+        )
+    # uncache drops local chunks; read-through works again
+    rm.uncache(filer, "/mnt/data/model.bin")
+    e = filer.find_entry("/mnt/data/model.bin")
+    assert not e.chunks and not e.content
+    assert e.file_size == len(blob)
+    assert filer.read_entry(e) == blob
+    # unmount removes the view, remote keeps the data
+    rm.unmount(filer, "/mnt/data")
+    with pytest.raises(NotFound):
+        filer.find_entry("/mnt/data/model.bin")
+    assert client.head_object("datasets", "v1/model.bin").size == len(blob)
+
+
+def test_remote_ops_via_http_and_shell(stack):
+    filer = stack["filer"]
+    client = RemoteS3Client(
+        endpoint=f"http://localhost:{stack['s3'].port}",
+        access_key=AK,
+        secret_key=SK,
+    )
+    client.ensure_bucket("shellbucket")
+    client.put_object("shellbucket", "f.txt", b"from the cloud")
+    srv = FilerServer(filer, ip="localhost", port=allocate_port())
+    srv.start()
+    try:
+        base = f"http://localhost:{srv.port}"
+        r = requests.post(
+            base + "/~remote/configure",
+            json={
+                "name": "c2",
+                "endpoint": f"http://localhost:{stack['s3'].port}",
+                "access_key": AK,
+                "secret_key": SK,
+            },
+            timeout=10,
+        )
+        assert r.status_code == 200
+        r = requests.post(
+            base + "/~remote/mount",
+            json={"dir": "/cloud2", "remote": "c2", "bucket": "shellbucket"},
+            timeout=30,
+        )
+        assert r.json()["mounted"] == 1
+        # file readable through the filer HTTP API (read-through)
+        assert (
+            requests.get(base + "/cloud2/f.txt", timeout=10).content
+            == b"from the cloud"
+        )
+        r = requests.post(
+            base + "/~remote/cache", json={"path": "/cloud2/f.txt"}, timeout=30
+        )
+        assert r.status_code == 200
+        r = requests.post(
+            base + "/~remote/unmount", json={"dir": "/cloud2"}, timeout=30
+        )
+        assert r.status_code == 200
+        # shell surface smoke: remote.* registered
+        from seaweedfs_tpu.shell.commands import COMMANDS
+
+        for name in (
+            "remote.configure",
+            "remote.mount",
+            "remote.cache",
+            "remote.uncache",
+            "remote.unmount",
+        ):
+            assert name in COMMANDS
+    finally:
+        srv.stop()
+
+
+def test_s3_sink_mirrors_filer_subtree(stack, tmp_path):
+    from seaweedfs_tpu.filer.meta_log import MetaLog
+    from seaweedfs_tpu.replication.s3_sink import S3Sink
+
+    filer = stack["filer"]
+    client = RemoteS3Client(
+        endpoint=f"http://localhost:{stack['s3'].port}",
+        access_key=AK,
+        secret_key=SK,
+    )
+    srv = FilerServer(
+        filer,
+        ip="localhost",
+        port=allocate_port(),
+        meta_log=MetaLog(str(tmp_path / "metalog")),
+    )
+    srv.start()
+    try:
+        filer.write_file("/backup/a.txt", b"alpha" * 500)
+        filer.write_file("/backup/sub/b.txt", b"beta")
+        filer.write_file("/other/c.txt", b"out of scope")
+        sink = S3Sink(
+            f"localhost:{srv.port}",
+            client,
+            "mirror",
+            path_prefix="/backup",
+        )
+        copied = sink.full_sync()
+        assert copied == 2
+        keys = {o.key for o in client.list_objects("mirror")}
+        assert keys == {"a.txt", "sub/b.txt"}
+        assert client.get_object("mirror", "a.txt") == b"alpha" * 500
+        # live tail: new write + delete propagate
+        sink.watermark = sink._source_now_ns()
+        filer.write_file("/backup/new.txt", b"fresh")
+        filer.delete_entry("/backup/a.txt")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            sink.tail_once(wait_seconds=1)
+            keys = {o.key for o in client.list_objects("mirror")}
+            if "new.txt" in keys and "a.txt" not in keys:
+                break
+        assert "new.txt" in keys and "a.txt" not in keys
+    finally:
+        srv.stop()
