@@ -19,8 +19,12 @@ import requests
 
 from ..pb import mq_pb2 as mq
 from ..pb import rpc
-from .log_buffer import PartitionLog, decode_records
+from ..utils.glog import logger
 from ..utils.urls import service_url
+from . import balancer as balancer_mod
+from .log_buffer import PartitionLog, decode_records
+
+mlog = logger("mq")
 
 TOPICS_ROOT = "/topics"
 
@@ -249,14 +253,96 @@ class MqBroker:
 class MqService:
     """gRPC servicer (method table in pb/rpc.py MQ_SERVICE)."""
 
-    def __init__(self, broker: MqBroker):
+    def __init__(self, broker: MqBroker, balancer=None):
         self.broker = broker
+        self.balancer = balancer
+
+    # ------------------------------------------------------ multi-broker
+
+    def BrokerStatus(self, request, context):
+        bal = self.balancer
+        return mq.BrokerStatusResponse(
+            address=bal.self_addr if bal else "",
+            peers=bal.peers if bal else [],
+            uptime_seconds=int(time.time() - bal.started_at) if bal else 0,
+        )
+
+    def LookupTopicBrokers(self, request, context):
+        t = request.topic
+        ns = t.namespace or "default"
+        try:
+            st = self.broker.topic(ns, t.name)
+        except KeyError as e:
+            return mq.LookupTopicBrokersResponse(error=str(e))
+        bal = self.balancer
+        if bal is None:
+            return mq.LookupTopicBrokersResponse(
+                assignments=[
+                    mq.BrokerPartitionAssignment(partition=p, leader="")
+                    for p in range(st.partition_count)
+                ]
+            )
+        return mq.LookupTopicBrokersResponse(
+            assignments=[
+                mq.BrokerPartitionAssignment(
+                    partition=p, leader=leader, follower=follower
+                )
+                for p, leader, follower in bal.assignments(
+                    ns, t.name, st.partition_count
+                )
+            ]
+        )
+
+    def FollowAppend(self, request, context):
+        """Leader → follower synchronous replication (reference
+        broker_grpc_pub_follow.go)."""
+        t = request.topic
+        ns = t.namespace or "default"
+        try:
+            st = self.broker.topic(ns, t.name)
+        except KeyError:
+            # follower that missed the configure broadcast lazily
+            # materializes the topic at the leader's partition count
+            self.broker.configure_topic(
+                ns, t.name, request.partition_count or 1
+            )
+            st = self.broker.topic(ns, t.name)
+        part = request.partition
+        plog = st.logs.get(part)
+        if plog is None:
+            return mq.FollowAppendResponse(error=f"partition {part} absent")
+        expected = plog.append_at(
+            request.offset,
+            request.message.ts_ns or time.time_ns(),
+            request.message.key,
+            request.message.value,
+        )
+        if expected <= request.offset:
+            # gap: this replica is missing [expected, offset); tell the
+            # leader so it backfills before re-sending
+            return mq.FollowAppendResponse(error=f"gap:{expected}")
+        return mq.FollowAppendResponse()
 
     def ConfigureTopic(self, request, context):
         t = request.topic
         self.broker.configure_topic(
             t.namespace or "default", t.name, request.partition_count
         )
+        # broadcast: every broker needs the topic state (any of them
+        # may lead or follow any partition)
+        bal = self.balancer
+        if bal is not None and not balancer_mod.is_forwarded(context):
+            for peer in bal.peers:
+                if peer == bal.self_addr:
+                    continue
+                try:
+                    bal.stub(peer).ConfigureTopic(
+                        request,
+                        metadata=balancer_mod.FWD_METADATA,
+                        timeout=5,
+                    )
+                except grpc.RpcError:
+                    pass  # down peers re-learn via FollowAppend/recovery
         return mq.ConfigureTopicResponse()
 
     def ListTopics(self, request, context):
@@ -272,26 +358,130 @@ class MqService:
 
     def Publish(self, request, context):
         t = request.topic
+        ns = t.namespace or "default"
         try:
-            st = self.broker.topic(t.namespace or "default", t.name)
+            st = self.broker.topic(ns, t.name)
         except KeyError as e:
             return mq.PublishResponse(error=str(e))
         part = self.broker.pick_partition(
             st, request.message.key, request.partition
         )
+        bal = self.balancer
+        # the Kafka gateway owns its namespace on its own broker (Kafka
+        # clients see a single-broker cluster); only native topics ride
+        # the balancer
+        balanced = (
+            bal is not None and not bal.single and ns != "kafka"
+        )
+        leader = follower = ""
+        if balanced:
+            leader, follower = bal.assignment(ns, t.name, part)
+        if (
+            balanced
+            and leader != bal.self_addr
+            and not balancer_mod.is_forwarded(context)
+        ):
+            # transparent forward: any broker accepts any publish
+            # (reference pub_balancer routing)
+            fwd = mq.PublishRequest(topic=request.topic, partition=part)
+            fwd.message.CopyFrom(request.message)
+            try:
+                return bal.stub(leader).Publish(
+                    fwd, metadata=balancer_mod.FWD_METADATA, timeout=10
+                )
+            except grpc.RpcError as e:
+                return mq.PublishResponse(
+                    error=f"forward to {leader}: {e.code()}"
+                )
         ts = request.message.ts_ns or time.time_ns()
         off = st.logs[part].append(ts, request.message.key, request.message.value)
+        if balanced and follower and follower != bal.self_addr:
+            self._replicate(request.topic, ns, st, part, off, ts,
+                            request.message, follower)
         return mq.PublishResponse(offset=off, partition=part)
+
+    def _replicate(
+        self, topic, ns: str, st, part: int, off: int, ts: int,
+        message, follower: str,
+    ) -> None:
+        """Sync-replicate one record; on a reported gap, backfill the
+        follower from this leader's log first (a rejoining follower
+        must never hold silent holes — they become lost acked records
+        at promotion)."""
+        def send(o: int, ts_ns: int, key: bytes, value: bytes) -> str:
+            fa = mq.FollowAppendRequest(
+                topic=topic,
+                partition=part,
+                offset=o,
+                partition_count=st.partition_count,
+                message=mq.DataMessage(key=key, value=value, ts_ns=ts_ns),
+            )
+            return bal_stub.FollowAppend(fa, timeout=10).error
+
+        bal_stub = self.balancer.stub(follower)
+        try:
+            err = send(off, ts, message.key, message.value)
+            if err.startswith("gap:"):
+                start = int(err[4:])
+                for o, rts, k, v in st.logs[part].read_from(
+                    start, max_records=off - start + 1
+                ):
+                    if o > off:
+                        break
+                    send(o, rts, k, v)
+        except (grpc.RpcError, ValueError) as e:
+            # availability over strictness: acked on the leader; the
+            # gap protocol repairs the replica on the next publish
+            mlog.warning(
+                "follow append %s/%s[%d]@%d -> %s failed: %s",
+                ns, topic.name, part, off, follower, e,
+            )
 
     def Subscribe(self, request, context):
         t = request.topic
+        ns = t.namespace or "default"
         try:
-            st = self.broker.topic(t.namespace or "default", t.name)
+            st = self.broker.topic(ns, t.name)
         except KeyError:
             context.abort(grpc.StatusCode.NOT_FOUND, "topic not configured")
         part = request.partition % st.partition_count
+        bal = self.balancer
+        resumed_at = -1
+        if (
+            bal is not None
+            and not bal.single
+            and ns != "kafka"
+            and not balancer_mod.is_forwarded(context)
+        ):
+            leader, follower = bal.assignment(ns, t.name, part)
+            if leader != bal.self_addr:
+                # proxy the stream from the partition's leader; on a
+                # mid-stream leader death, resume PAST what was already
+                # yielded (never re-deliver), and only from a broker
+                # actually holding a replica
+                last = -1
+                try:
+                    for rec in bal.stub(leader).Subscribe(
+                        request, metadata=balancer_mod.FWD_METADATA
+                    ):
+                        if not rec.end_of_stream:
+                            last = rec.offset
+                        yield rec
+                        if rec.end_of_stream:
+                            return
+                    return
+                except grpc.RpcError:
+                    if bal.self_addr not in (follower,):
+                        context.abort(
+                            grpc.StatusCode.UNAVAILABLE,
+                            f"leader {leader} unreachable and this "
+                            "broker holds no replica",
+                        )
+                    resumed_at = last + 1
         log = st.logs[part]
-        if request.start_offset >= 0:
+        if resumed_at >= 0:
+            offset = resumed_at
+        elif request.start_offset >= 0:
             offset = request.start_offset
         elif request.consumer_group and (
             committed := self.broker.fetch_offset(
@@ -316,25 +506,54 @@ class MqService:
                     return
                 log.wait_for(offset, timeout=1.0)
 
+    def _route_to_leader(self, ns: str, name: str, part: int, context):
+        """The partition leader to forward an offset op to, or None to
+        serve locally (single broker / kafka ns / already forwarded /
+        we ARE the leader)."""
+        bal = self.balancer
+        if (
+            bal is None
+            or bal.single
+            or ns == "kafka"
+            or balancer_mod.is_forwarded(context)
+        ):
+            return None
+        leader, _f = bal.assignment(ns, name, part)
+        return None if leader == bal.self_addr else leader
+
     def CommitOffset(self, request, context):
         t = request.topic
+        ns = t.namespace or "default"
+        # group offsets live with the partition leader (the broker
+        # Subscribe proxies to) — otherwise commits fragment per broker
+        leader = self._route_to_leader(ns, t.name, request.partition, context)
+        if leader is not None:
+            try:
+                return self.balancer.stub(leader).CommitOffset(
+                    request, metadata=balancer_mod.FWD_METADATA, timeout=10
+                )
+            except grpc.RpcError:
+                pass  # fall back to a local commit rather than losing it
         self.broker.commit_offset(
-            t.namespace or "default",
-            t.name,
-            request.partition,
-            request.consumer_group,
+            ns, t.name, request.partition, request.consumer_group,
             request.offset,
         )
         return mq.CommitOffsetResponse()
 
     def FetchOffset(self, request, context):
         t = request.topic
+        ns = t.namespace or "default"
+        leader = self._route_to_leader(ns, t.name, request.partition, context)
+        if leader is not None:
+            try:
+                return self.balancer.stub(leader).FetchOffset(
+                    request, metadata=balancer_mod.FWD_METADATA, timeout=10
+                )
+            except grpc.RpcError:
+                pass
         return mq.FetchOffsetResponse(
             offset=self.broker.fetch_offset(
-                t.namespace or "default",
-                t.name,
-                request.partition,
-                request.consumer_group,
+                ns, t.name, request.partition, request.consumer_group
             )
         )
 
@@ -366,14 +585,20 @@ class MqBrokerServer:
         kafka_port: int = -1,
         pg_port: int = -1,
         pg_users: dict[str, str] | None = None,
+        peers: list[str] | None = None,
     ):
         """kafka_port >= 0 also serves the Kafka wire protocol on that
         port; pg_port >= 0 serves PostgreSQL clients a SQL view over
-        the topics (0 = ephemeral; see .kafka.port / .pg.port)."""
+        the topics (0 = ephemeral; see .kafka.port / .pg.port).
+        peers: every broker's grpc host:port for multi-broker partition
+        balancing + follower replication."""
         self.ip = ip
         self.grpc_port = grpc_port
         self.broker = MqBroker(filer=filer, segment_records=segment_records)
-        self.service = MqService(self.broker)
+        self.balancer = balancer_mod.BrokerBalancer(
+            f"{ip}:{grpc_port}", list(peers or [])
+        )
+        self.service = MqService(self.broker, balancer=self.balancer)
         self._grpc = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
         rpc.add_service(self._grpc, rpc.MQ_SERVICE, self.service)
         self._grpc.add_insecure_port(f"{ip}:{grpc_port}")
@@ -393,12 +618,14 @@ class MqBrokerServer:
 
     def start(self) -> None:
         self._grpc.start()
+        self.balancer.start()
         if self.kafka is not None:
             self.kafka.start()
         if self.pg is not None:
             self.pg.start()
 
     def stop(self) -> None:
+        self.balancer.stop()
         if self.kafka is not None:
             self.kafka.stop()
         if self.pg is not None:

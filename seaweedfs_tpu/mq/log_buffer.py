@@ -71,6 +71,24 @@ class PartitionLog:
             self._lock.notify_all()
             return off
 
+    def append_at(self, offset: int, ts_ns: int, key: bytes, value: bytes) -> int:
+        """Follower-side append at a LEADER-assigned offset: replicas
+        mirror the leader's dense numbering. Duplicates are ignored; a
+        GAP is refused (returns the expected offset) so the leader can
+        backfill — a silently-accepted gap would surface as lost acked
+        records after a failover promotion."""
+        with self._lock:
+            if offset < self.next_offset:
+                return self.next_offset  # duplicate of a held record
+            if offset > self.next_offset:
+                return self.next_offset  # refuse: leader must backfill
+            self._tail.append((offset, ts_ns, key, value))
+            self.next_offset = offset + 1
+            if len(self._tail) >= self.segment_records:
+                self._seal_locked()
+            self._lock.notify_all()
+            return self.next_offset
+
     def append_batch(
         self, records: list[tuple[int, bytes, bytes]]
     ) -> int:

@@ -78,6 +78,9 @@ SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
         "CommitOffset": (UNARY, mq.CommitOffsetRequest, mq.CommitOffsetResponse),
         "FetchOffset": (UNARY, mq.FetchOffsetRequest, mq.FetchOffsetResponse),
         "PartitionInfo": (UNARY, mq.PartitionInfoRequest, mq.PartitionInfoResponse),
+        "BrokerStatus": (UNARY, mq.BrokerStatusRequest, mq.BrokerStatusResponse),
+        "LookupTopicBrokers": (UNARY, mq.LookupTopicBrokersRequest, mq.LookupTopicBrokersResponse),
+        "FollowAppend": (UNARY, mq.FollowAppendRequest, mq.FollowAppendResponse),
     },
     FILER_SERVICE: {
         "LookupDirectoryEntry": (UNARY, fpb.LookupEntryRequest, fpb.LookupEntryResponse),

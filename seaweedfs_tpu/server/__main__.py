@@ -118,6 +118,11 @@ def main(argv=None) -> int:
         "-pgUser", default="",
         help="user:password for PG auth (empty = trust)",
     )
+    b.add_argument(
+        "-peers", default="",
+        help="comma-separated broker group (grpc host:port,...) for "
+        "partition balancing + follower replication",
+    )
     # broker dials the filer: it needs the https switch from
     # security.toml even though it has no HTTP listener of its own
     _add_tls_flags(b)
@@ -276,6 +281,7 @@ def main(argv=None) -> int:
             kafka_port=a.kafkaPort,
             pg_port=a.pgPort,
             pg_users=pg_users,
+            peers=[p.strip() for p in a.peers.split(",") if p.strip()],
         )
         bs.start()
         servers.append(bs)

@@ -1,0 +1,261 @@
+"""Multi-broker MQ: partition balancing, transparent forwarding,
+follower replication, leader-death failover.
+
+Reference: weed/mq/pub_balancer + broker_grpc_pub_follow.go.
+"""
+
+import time
+
+import grpc
+import pytest
+
+from conftest import allocate_port
+from seaweedfs_tpu.mq.balancer import BrokerBalancer
+from seaweedfs_tpu.mq.broker import MqBrokerServer
+from seaweedfs_tpu.pb import mq_pb2 as mq
+from seaweedfs_tpu.pb import rpc
+
+
+def _stub(port: int):
+    return rpc.mq_stub(grpc.insecure_channel(f"localhost:{port}"))
+
+
+@pytest.fixture
+def trio():
+    ports = [allocate_port() for _ in range(3)]
+    peers = [f"localhost:{p}" for p in ports]
+    brokers = [
+        MqBrokerServer(
+            ip="localhost", grpc_port=p, peers=peers,
+        )
+        for p in ports
+    ]
+    for b in brokers:
+        b.balancer.ping_interval = 0.2
+        b.start()
+    yield brokers, ports
+    for b in brokers:
+        try:
+            b.stop()
+        except Exception:
+            pass
+
+
+def test_hrw_assignment_is_consistent_and_spread():
+    peers = ["h1:1", "h2:2", "h3:3"]
+    bals = [BrokerBalancer(p, peers) for p in peers]
+    a0 = bals[0].assignments("default", "t", 16)
+    for b in bals[1:]:
+        assert b.assignments("default", "t", 16) == a0
+    leaders = {leader for _p, leader, _f in a0}
+    assert len(leaders) >= 2, "HRW should spread partitions across brokers"
+    for _p, leader, follower in a0:
+        assert follower and follower != leader
+    # removing the leader promotes exactly the old follower
+    for p, leader, follower in a0:
+        survivor = BrokerBalancer(
+            "x:0", [b for b in peers if b != leader] + ["x:0"]
+        )
+        survivor._live = set(b for b in peers if b != leader)
+        new_leader, _nf = survivor.assignment("default", "t", p)
+        assert new_leader == follower
+
+
+def test_publish_forwarding_and_replication(trio):
+    brokers, ports = trio
+    stubs = [_stub(p) for p in ports]
+    stubs[0].ConfigureTopic(
+        mq.ConfigureTopicRequest(
+            topic=mq.Topic(name="spread"), partition_count=6
+        )
+    )
+    # configure broadcast: every broker knows the topic
+    for s in stubs:
+        topics = s.ListTopics(mq.ListTopicsRequest())
+        assert any(t.topic.name == "spread" for t in topics.topics)
+    # publish every partition through broker 0 only — forwarding must
+    # land each on its HRW leader
+    for part in range(6):
+        r = stubs[0].Publish(
+            mq.PublishRequest(
+                topic=mq.Topic(name="spread"),
+                partition=part,
+                message=mq.DataMessage(key=b"k", value=b"v%d" % part),
+            )
+        )
+        assert not r.error
+        assert r.offset == 0
+    lookup = stubs[1].LookupTopicBrokers(
+        mq.LookupTopicBrokersRequest(topic=mq.Topic(name="spread"))
+    )
+    assert len(lookup.assignments) == 6
+    by_part = {a.partition: a for a in lookup.assignments}
+    # each partition's record lives on its leader AND its follower
+    for part in range(6):
+        a = by_part[part]
+        leader_idx = ports.index(int(a.leader.rsplit(":", 1)[1]))
+        follower_idx = ports.index(int(a.follower.rsplit(":", 1)[1]))
+        for idx in (leader_idx, follower_idx):
+            st = brokers[idx].broker.topic("default", "spread")
+            recs = st.logs[part].read_from(0)
+            assert [v for _o, _t, _k, v in recs] == [b"v%d" % part], (
+                f"partition {part} missing on broker {idx}"
+            )
+        # and is absent from the third broker
+        third = ({0, 1, 2} - {leader_idx, follower_idx}).pop()
+        st = brokers[third].broker.topic("default", "spread")
+        assert st.logs[part].read_from(0) == []
+
+
+def test_subscribe_proxies_to_leader(trio):
+    brokers, ports = trio
+    stubs = [_stub(p) for p in ports]
+    stubs[0].ConfigureTopic(
+        mq.ConfigureTopicRequest(
+            topic=mq.Topic(name="sub"), partition_count=3
+        )
+    )
+    for i in range(9):
+        stubs[i % 3].Publish(
+            mq.PublishRequest(
+                topic=mq.Topic(name="sub"),
+                partition=i % 3,
+                message=mq.DataMessage(value=b"m%d" % i),
+            )
+        )
+    # subscribe to every partition through ONE broker; streams proxy
+    got = []
+    for part in range(3):
+        for rec in stubs[2].Subscribe(
+            mq.SubscribeRequest(
+                topic=mq.Topic(name="sub"), partition=part, start_offset=0
+            )
+        ):
+            if rec.end_of_stream:
+                break
+            got.append(rec.message.value)
+    assert sorted(got) == [b"m%d" % i for i in range(9)]
+
+
+def test_replica_gap_is_backfilled(trio):
+    """A follower that missed records (down/partitioned) reports the
+    gap and the leader backfills — silent holes would be lost acked
+    records after promotion."""
+    brokers, ports = trio
+    stubs = [_stub(p) for p in ports]
+    stubs[0].ConfigureTopic(
+        mq.ConfigureTopicRequest(
+            topic=mq.Topic(name="gap"), partition_count=1
+        )
+    )
+    lookup = stubs[0].LookupTopicBrokers(
+        mq.LookupTopicBrokersRequest(topic=mq.Topic(name="gap"))
+    )
+    a = lookup.assignments[0]
+    leader_idx = ports.index(int(a.leader.rsplit(":", 1)[1]))
+    follower_idx = ports.index(int(a.follower.rsplit(":", 1)[1]))
+    # simulate missed replication: append directly on the leader's log
+    st = brokers[leader_idx].broker.topic("default", "gap")
+    for i in range(5):
+        st.logs[0].append(1, b"", b"missed%d" % i)
+    # a normal publish now hits the follower with offset 5; the
+    # follower reports gap:0 and the leader must backfill 0..4
+    r = stubs[leader_idx].Publish(
+        mq.PublishRequest(
+            topic=mq.Topic(name="gap"),
+            partition=0,
+            message=mq.DataMessage(value=b"live"),
+        )
+    )
+    assert not r.error and r.offset == 5
+    fst = brokers[follower_idx].broker.topic("default", "gap")
+    recs = fst.logs[0].read_from(0)
+    assert [v for _o, _t, _k, v in recs] == [
+        b"missed0", b"missed1", b"missed2", b"missed3", b"missed4", b"live",
+    ]
+
+
+def test_consumer_offsets_route_to_leader(trio):
+    brokers, ports = trio
+    stubs = [_stub(p) for p in ports]
+    stubs[0].ConfigureTopic(
+        mq.ConfigureTopicRequest(
+            topic=mq.Topic(name="offs"), partition_count=1
+        )
+    )
+    # commit through one broker, fetch through another: same value
+    stubs[0].CommitOffset(
+        mq.CommitOffsetRequest(
+            topic=mq.Topic(name="offs"),
+            partition=0,
+            consumer_group="g",
+            offset=42,
+        )
+    )
+    for s in stubs:
+        r = s.FetchOffset(
+            mq.FetchOffsetRequest(
+                topic=mq.Topic(name="offs"), partition=0, consumer_group="g"
+            )
+        )
+        assert r.offset == 42
+
+
+def test_leader_death_failover_preserves_data(trio):
+    brokers, ports = trio
+    stubs = [_stub(p) for p in ports]
+    stubs[0].ConfigureTopic(
+        mq.ConfigureTopicRequest(
+            topic=mq.Topic(name="ha"), partition_count=1
+        )
+    )
+    lookup = stubs[0].LookupTopicBrokers(
+        mq.LookupTopicBrokersRequest(topic=mq.Topic(name="ha"))
+    )
+    leader = lookup.assignments[0].leader
+    follower = lookup.assignments[0].follower
+    leader_idx = ports.index(int(leader.rsplit(":", 1)[1]))
+    follower_idx = ports.index(int(follower.rsplit(":", 1)[1]))
+    for i in range(20):
+        r = stubs[leader_idx].Publish(
+            mq.PublishRequest(
+                topic=mq.Topic(name="ha"),
+                partition=0,
+                message=mq.DataMessage(value=b"ha%d" % i),
+            )
+        )
+        assert not r.error
+    # kill the leader
+    brokers[leader_idx].stop()
+    survivor = ({0, 1, 2} - {leader_idx}).pop()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        lookup = stubs[survivor].LookupTopicBrokers(
+            mq.LookupTopicBrokersRequest(topic=mq.Topic(name="ha"))
+        )
+        if lookup.assignments[0].leader == follower:
+            break
+        time.sleep(0.2)
+    assert lookup.assignments[0].leader == follower, (
+        "old follower should be promoted"
+    )
+    # all 20 records are served by the promoted follower
+    got = []
+    for rec in stubs[follower_idx].Subscribe(
+        mq.SubscribeRequest(
+            topic=mq.Topic(name="ha"), partition=0, start_offset=0
+        )
+    ):
+        if rec.end_of_stream:
+            break
+        got.append(rec.message.value)
+    assert got == [b"ha%d" % i for i in range(20)]
+    # and new publishes keep working through any surviving broker
+    r = stubs[survivor].Publish(
+        mq.PublishRequest(
+            topic=mq.Topic(name="ha"),
+            partition=0,
+            message=mq.DataMessage(value=b"post-failover"),
+        )
+    )
+    assert not r.error and r.offset == 20
