@@ -93,13 +93,67 @@ class Operations:
                 time.sleep(0.1 * (attempt + 1))
         raise last_exc if last_exc is not None else RuntimeError("upload failed")
 
-    def read(self, fid: str) -> bytes:
+    def read(self, fid: str, fast: bool = True) -> bytes:
         f = FileId.parse(fid)
         for loc in self.master.lookup(f.volume_id):
+            if fast:
+                data = self._try_fast_read(loc.url, fid)
+                if data is not None:
+                    return data
             r = self._http.get(service_url(loc.url, f"/{fid}"), timeout=60)
             if r.status_code == 200:
                 return r.content
         raise LookupError(f"fid {fid} unreadable on all locations")
+
+    _LOCAL_HOSTS = None  # lazily-computed set of this machine's names
+
+    @classmethod
+    def _is_local(cls, url: str) -> bool:
+        """Cheap locality check BEFORE paying a ?locate round trip —
+        remote reads must not eat an extra RTT per chunk."""
+        import socket as _socket
+
+        host = url.split("//")[-1].split(":")[0]
+        if cls._LOCAL_HOSTS is None:
+            names = {"localhost", "127.0.0.1", "::1"}
+            try:
+                hn = _socket.gethostname()
+                names.add(hn)
+                names.update(_socket.gethostbyname_ex(hn)[2])
+            except OSError:
+                pass
+            cls._LOCAL_HOSTS = names
+        return host in cls._LOCAL_HOSTS
+
+    def _try_fast_read(self, url: str, fid: str) -> bytes | None:
+        """Same-host bulk-read bypass (RDMA sidecar analog): resolve
+        the payload location over HTTP, then pull bytes through the
+        native Unix-socket sendfile server, CRC-verified. None = fall
+        back to HTTP (remote host, sidecar absent, EC volume, ...)."""
+        import os
+
+        if not self._is_local(url):
+            return None
+        if url in getattr(self, "_no_sidecar", set()):
+            return None
+        try:
+            r = self._http.get(
+                service_url(url, f"/{fid}?locate=true"), timeout=10
+            )
+            if r.status_code != 200:
+                return None
+            loc = r.json()
+            sock = loc.get("socket", "")
+            if not sock or not os.path.exists(sock):
+                # negative-cache: this server has no reachable sidecar,
+                # stop probing on every read
+                self.__dict__.setdefault("_no_sidecar", set()).add(url)
+                return None
+            from ..utils.fastread import read_fid_fast
+
+            return read_fid_fast(loc)
+        except Exception:
+            return None
 
     def delete(self, fid: str) -> None:
         f = FileId.parse(fid)

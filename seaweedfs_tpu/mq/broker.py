@@ -428,7 +428,16 @@ class MqService:
                 ):
                     if o > off:
                         break
-                    send(o, rts, k, v)
+                    err = send(o, rts, k, v)
+                    if err and not err.startswith("gap:"):
+                        break
+            if err and not err.startswith("gap:"):
+                # a non-gap refusal (partition absent, ...) is a replica
+                # hole no protocol will repair — it must be visible
+                mlog.warning(
+                    "follow append %s/%s[%d]@%d -> %s refused: %s",
+                    ns, topic.name, part, off, follower, err,
+                )
         except (grpc.RpcError, ValueError) as e:
             # availability over strictness: acked on the leader; the
             # gap protocol repairs the replica on the next publish
@@ -477,7 +486,11 @@ class MqService:
                             f"leader {leader} unreachable and this "
                             "broker holds no replica",
                         )
-                    resumed_at = last + 1
+                    if last >= 0:
+                        resumed_at = last + 1
+                    # else: nothing was delivered — fall through to the
+                    # normal offset resolution (start_offset/committed),
+                    # never to an unconditional 0
         log = st.logs[part]
         if resumed_at >= 0:
             offset = resumed_at
@@ -533,7 +546,13 @@ class MqService:
                     request, metadata=balancer_mod.FWD_METADATA, timeout=10
                 )
             except grpc.RpcError:
-                pass  # fall back to a local commit rather than losing it
+                # surface the failure: a silent local commit would be
+                # invisible to every future FetchOffset (which routes
+                # to the leader) — let the client retry instead
+                context.abort(
+                    grpc.StatusCode.UNAVAILABLE,
+                    f"offset leader {leader} unreachable",
+                )
         self.broker.commit_offset(
             ns, t.name, request.partition, request.consumer_group,
             request.offset,

@@ -665,6 +665,22 @@ class VolumeServer:
         )
         self.service = VolumeService(self)
 
+        # bulk-read fast path: a native Unix-socket sendfile server per
+        # disk location (the RDMA sidecar analog, SURVEY §2.10); local
+        # clients resolve ?locate=true then pull bytes kernel-to-kernel
+        self.fastread_sockets: dict[str, str] = {}
+        try:
+            from ..utils.fastread import start_server as _fr_start
+
+            for loc in self.store.locations:
+                sock = os.path.join(loc.directory, ".fastread.sock")
+                _fr_start(sock, loc.directory)
+                self.fastread_sockets[
+                    os.path.abspath(loc.directory)
+                ] = sock
+        except Exception as e:  # native toolchain absent: HTTP only
+            logger("volume").warning("fastread sidecar disabled: %s", e)
+
         self._grpc = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
         rpc.add_service(self._grpc, rpc.VOLUME_SERVICE, self.service)
         self._grpc.add_insecure_port(f"{ip}:{self.grpc_port}")
@@ -1006,6 +1022,42 @@ class VolumeServer:
                     fid = self._fid()
                 except FileIdError as e:
                     return self._error(400, str(e))
+                if parse_qs(u.query).get("locate", [""])[0] == "true":
+                    # control plane of the bulk-read fast path: where
+                    # the payload bytes live + which sidecar socket
+                    # serves them (utils/fastread.py)
+                    vol = server.store.find_volume(fid.volume_id)
+                    if vol is None:
+                        return self._error(404, "volume not here (or EC)")
+                    try:
+                        path, off, size, crc = vol.locate_payload(
+                            fid.needle_id, fid.cookie
+                        )
+                    except (NotFoundError, CookieMismatch) as e:
+                        return self._error(404, str(e))
+                    except VolumeError as e:
+                        return self._error(409, str(e))
+                    sock = ""
+                    apath = os.path.abspath(path)
+                    for d, s in server.fastread_sockets.items():
+                        if apath.startswith(d + os.sep):
+                            sock = s
+                            break
+                    body = json.dumps(
+                        {
+                            "path": apath,
+                            "offset": off,
+                            "size": size,
+                            "crc32c": crc,
+                            "socket": sock,
+                        }
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 try:
                     n = server.store.read_needle(
                         fid.volume_id, fid.needle_id, fid.cookie
@@ -1158,6 +1210,11 @@ class VolumeServer:
 
     def stop(self) -> None:
         self._hb_stop.set()
+        if self.fastread_sockets:
+            from ..utils.fastread import stop_server as _fr_stop
+
+            for sock in self.fastread_sockets.values():
+                _fr_stop(sock)
         self._grpc.stop(grace=0.5)
         self._http.shutdown()
         self._http.server_close()

@@ -301,6 +301,51 @@ class Volume:
             self._append_at += len(raw)
             return self.needle_map.delete(needle_id)
 
+    def locate_payload(
+        self, needle_id: int, cookie: Optional[int] = None
+    ) -> tuple[str, int, int, int]:
+        """(dat_path, absolute_offset, size, crc32c) of a needle's DATA
+        bytes — the control-plane half of the bulk-read fast path (the
+        RDMA sidecar analog): callers pull the range over the native
+        Unix-socket server and MUST verify the crc (the sidecar serves
+        raw ranges with no lock, so a vacuum commit between locate and
+        read, or a replayed locate against the wrong host, surfaces as
+        a checksum mismatch instead of silent wrong bytes). Tiered and
+        TTL'd volumes raise — they need the locked, validated path."""
+        with self._lock:
+            self._check_not_broken()
+            if self._remote is not None:
+                raise VolumeError(
+                    f"volume {self.volume_id} is cold-tiered"
+                )
+            if self.ttl:
+                # per-needle expiry lives in the body's optional fields;
+                # the HTTP path enforces it, so TTL volumes stay there
+                raise VolumeError(
+                    f"volume {self.volume_id} is TTL'd; use the HTTP path"
+                )
+            nv = self.needle_map.get(needle_id)
+            if nv is None or nv.is_deleted:
+                raise NotFoundError(f"needle {needle_id:x} not found")
+            base = actual_offset(nv.offset)
+            # header(16) + dataSize(4) prefix locates the payload
+            self._dat.seek(base)
+            head = self._dat.read(NEEDLE_HEADER_SIZE + 4)
+            n_cookie, _nid, body_size = Needle.parse_header(head)
+            crc = 0
+            if body_size > 0:
+                # the footer's crc32c sits right after the body
+                self._dat.seek(base + NEEDLE_HEADER_SIZE + body_size)
+                (crc,) = struct.unpack(">I", self._dat.read(4))
+        if cookie is not None and n_cookie != cookie:
+            raise CookieMismatch(f"needle {needle_id:x} cookie mismatch")
+        if body_size == 0:
+            return self.dat_path, base + NEEDLE_HEADER_SIZE, 0, 0
+        (data_size,) = struct.unpack(
+            ">I", head[NEEDLE_HEADER_SIZE : NEEDLE_HEADER_SIZE + 4]
+        )
+        return self.dat_path, base + NEEDLE_HEADER_SIZE + 4, data_size, crc
+
     def has_needle(self, needle_id: int) -> bool:
         nv = self.needle_map.get(needle_id)
         return nv is not None and not nv.is_deleted

@@ -1,0 +1,158 @@
+"""Bulk-read fast path (the RDMA-sidecar analog, SURVEY §2.10).
+
+Control plane: the volume server's `GET /<fid>?locate=true` returns
+{path, offset, size, socket} for a needle's payload. Data plane: this
+module's client sends (path, offset, size) over the C++ server's Unix
+socket (native/fastread.cpp) and the kernel sendfile()s the bytes —
+no HTTP framing, no Python server-side byte handling.
+
+Server side: start_server() runs the blocking C accept loop in a
+daemon thread (ctypes releases the GIL for the duration).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import socket
+import struct
+import subprocess
+import threading
+
+_SO_NAME = "libseaweed_fastread.so"
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+
+
+class FastReadError(Exception):
+    pass
+
+
+def _load_lib():
+    so = os.path.abspath(os.path.join(_NATIVE_DIR, _SO_NAME))
+    if not os.path.exists(so):
+        subprocess.run(
+            ["make", "-C", os.path.abspath(_NATIVE_DIR), _SO_NAME],
+            check=True,
+            capture_output=True,
+        )
+    lib = ctypes.CDLL(so)
+    lib.sn_fastread_serve.restype = ctypes.c_int
+    lib.sn_fastread_serve.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    return lib
+
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def lib():
+    global _lib
+    with _lib_lock:
+        if _lib is None:
+            _lib = _load_lib()
+        return _lib
+
+
+def start_server(socket_path: str, root_dir: str) -> threading.Thread:
+    """Serve `root_dir` on `socket_path` until stop_server()."""
+    l = lib()
+
+    def run() -> None:
+        rc = l.sn_fastread_serve(
+            socket_path.encode(), root_dir.encode()
+        )
+        if rc not in (0,):
+            from .glog import logger
+
+            logger("fastread").warning(
+                "server on %s exited rc=%d", socket_path, rc
+            )
+
+    t = threading.Thread(target=run, daemon=True, name="fastread")
+    t.start()
+    # wait for the socket to appear so callers can advertise it
+    for _ in range(100):
+        if os.path.exists(socket_path):
+            break
+        import time
+
+        time.sleep(0.01)
+    return t
+
+
+def stop_server(socket_path: str) -> None:
+    """Unlink the socket, then poke the accept loop so it notices."""
+    try:
+        os.unlink(socket_path)
+    except OSError:
+        return
+    try:
+        s = socket.socket(socket.AF_UNIX)
+        s.settimeout(0.5)
+        s.connect(socket_path)
+        s.close()
+    except OSError:
+        pass
+
+
+class FastReadClient:
+    """Persistent connection to a fast-read socket."""
+
+    def __init__(self, socket_path: str):
+        self.socket_path = socket_path
+        self._sock = socket.socket(socket.AF_UNIX)
+        self._sock.settimeout(30.0)
+        self._sock.connect(socket_path)
+        self._lock = threading.Lock()
+
+    def read(self, path: str, offset: int, size: int) -> bytes:
+        pb = path.encode()
+        req = struct.pack("<H", len(pb)) + pb + struct.pack("<QQ", offset, size)
+        with self._lock:
+            self._sock.sendall(req)
+            head = self._read_exact(9)
+            status = head[0]
+            (n,) = struct.unpack("<Q", head[1:])
+            body = self._read_exact(n)
+        if status != 0:
+            raise FastReadError(body.decode(errors="replace"))
+        return body
+
+    def _read_exact(self, n: int) -> bytes:
+        # recv_into a preallocated buffer: bytes-concatenation would be
+        # quadratic on multi-MB bodies and defeat the fast path
+        buf = bytearray(n)
+        view = memoryview(buf)
+        got = 0
+        while got < n:
+            r = self._sock.recv_into(view[got:], n - got)
+            if r == 0:
+                raise FastReadError("fastread server closed connection")
+            got += r
+        return bytes(buf)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def read_fid_fast(locate: dict) -> bytes:
+    """One-shot convenience: `locate` is the volume server's
+    ?locate=true JSON ({path, offset, size, crc32c, socket}). The CRC
+    is MANDATORY validation: the sidecar serves raw unlocked ranges, so
+    a vacuum racing the read — or a stale locate replayed against the
+    wrong host's sidecar — must fail loudly, never return wrong
+    bytes."""
+    c = FastReadClient(locate["socket"])
+    try:
+        data = c.read(locate["path"], locate["offset"], locate["size"])
+    finally:
+        c.close()
+    if locate["size"] > 0:
+        from .crc import crc32c
+
+        if crc32c(data) != locate.get("crc32c", -1):
+            raise FastReadError("payload checksum mismatch (stale locate?)")
+    return data

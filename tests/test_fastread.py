@@ -1,0 +1,138 @@
+"""Bulk-read fast path (native/fastread.cpp + utils/fastread.py) —
+the RDMA-sidecar analog (SURVEY §2.10).
+"""
+
+import os
+import time
+
+import pytest
+import requests
+
+from conftest import allocate_port
+from seaweedfs_tpu.client.operations import Operations
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.utils.fastread import (
+    FastReadClient,
+    FastReadError,
+    start_server,
+    stop_server,
+)
+
+
+def test_raw_server_round_trip_and_confinement(tmp_path):
+    root = tmp_path / "served"
+    root.mkdir()
+    blob = os.urandom(300_000)
+    (root / "vol.dat").write_bytes(blob)
+    secret = tmp_path / "secret.txt"
+    secret.write_bytes(b"never serve this")
+    sock = str(root / ".fr.sock")
+    start_server(sock, str(root))
+    try:
+        c = FastReadClient(sock)
+        assert c.read(str(root / "vol.dat"), 0, len(blob)) == blob
+        # ranged
+        assert c.read(str(root / "vol.dat"), 1000, 50) == blob[1000:1050]
+        # several requests on one connection
+        for off in (0, 7, 299_000):
+            assert c.read(str(root / "vol.dat"), off, 100) == blob[off : off + 100]
+        # range beyond EOF
+        with pytest.raises(FastReadError, match="EOF"):
+            c.read(str(root / "vol.dat"), len(blob) - 10, 100)
+        # root confinement: absolute path outside + traversal
+        c2 = FastReadClient(sock)
+        with pytest.raises(FastReadError, match="outside"):
+            c2.read(str(secret), 0, 10)
+        c3 = FastReadClient(sock)
+        with pytest.raises(FastReadError, match="outside|open"):
+            c3.read(str(root / ".." / "secret.txt"), 0, 10)
+        c.close(), c2.close(), c3.close()
+    finally:
+        stop_server(sock)
+
+
+def test_volume_server_locate_and_fast_read(tmp_path):
+    mport, vport = allocate_port(), allocate_port()
+    ms = MasterServer(ip="127.0.0.1", port=mport)
+    ms.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path / "v")],
+        master=f"127.0.0.1:{mport}",
+        ip="127.0.0.1",
+        port=vport,
+    )
+    vs.start()
+    try:
+        assert vs.fastread_sockets, "sidecar should be running"
+        ops = Operations(master=f"127.0.0.1:{mport}")
+        payload = os.urandom(200_000)
+        fid = ops.upload(payload, name="big.bin")
+        # locate control plane
+        url = ops.master.lookup(int(fid.split(",")[0]))[0].url
+        loc = requests.get(
+            f"http://{url}/{fid}?locate=true", timeout=10
+        ).json()
+        assert loc["size"] == len(payload)
+        assert loc["socket"] and os.path.exists(loc["socket"])
+        # raw bytes at (path, offset, size) must BE the payload
+        with open(loc["path"], "rb") as f:
+            f.seek(loc["offset"])
+            assert f.read(loc["size"]) == payload
+        # data plane through the sidecar
+        from seaweedfs_tpu.utils.fastread import read_fid_fast
+
+        assert read_fid_fast(loc) == payload
+        # the client's fast path end-to-end (and the fallback path)
+        assert ops.read(fid) == payload
+        assert ops.read(fid, fast=False) == payload
+        # wrong cookie is refused at locate time
+        vid, rest = fid.split(",", 1)
+        bad = f"{vid},{rest[:-4]}0000"
+        r = requests.get(f"http://{url}/{bad}?locate=true", timeout=10)
+        assert r.status_code == 404
+    finally:
+        vs.stop()
+        ms.stop()
+
+
+def test_fast_read_beats_http(tmp_path):
+    """Sanity perf check on a 16MB blob: the sendfile path should not
+    be slower than HTTP (usually much faster)."""
+    mport, vport = allocate_port(), allocate_port()
+    ms = MasterServer(ip="127.0.0.1", port=mport)
+    ms.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path / "v")],
+        master=f"127.0.0.1:{mport}",
+        ip="127.0.0.1",
+        port=vport,
+    )
+    vs.start()
+    try:
+        ops = Operations(master=f"127.0.0.1:{mport}")
+        payload = os.urandom(16 * 1024 * 1024)
+        fid = ops.upload(payload, name="bulk.bin")
+        url = ops.master.lookup(int(fid.split(",")[0]))[0].url
+        loc = requests.get(
+            f"http://{url}/{fid}?locate=true", timeout=10
+        ).json()
+        from seaweedfs_tpu.utils.fastread import FastReadClient
+
+        c = FastReadClient(loc["socket"])
+        c.read(loc["path"], loc["offset"], loc["size"])  # warm
+        t0 = time.perf_counter()
+        for _ in range(3):
+            assert len(c.read(loc["path"], loc["offset"], loc["size"])) == len(payload)
+        fast_t = (time.perf_counter() - t0) / 3
+        c.close()
+        requests.get(f"http://{url}/{fid}", timeout=30)  # warm
+        t0 = time.perf_counter()
+        for _ in range(3):
+            assert len(requests.get(f"http://{url}/{fid}", timeout=30).content) == len(payload)
+        http_t = (time.perf_counter() - t0) / 3
+        print(f"fastread {len(payload)/fast_t/1e6:.0f} MB/s vs http {len(payload)/http_t/1e6:.0f} MB/s")
+        assert fast_t < http_t * 1.5, (fast_t, http_t)
+    finally:
+        vs.stop()
+        ms.stop()
