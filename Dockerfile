@@ -1,0 +1,20 @@
+# seaweedfs-tpu node image: one image, every role selected by command
+# (reference docker/Dockerfile — `weed` single binary, role by args).
+FROM python:3.12-slim
+
+RUN apt-get update \
+ && apt-get install -y --no-install-recommends g++ make \
+ && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY native/ native/
+COPY seaweedfs_tpu/ seaweedfs_tpu/
+# jax is only needed for the TPU EC backend; the storage/gateway roles
+# run without it (ec.backend=cpu|native)
+RUN pip install --no-cache-dir requests grpcio protobuf numpy pillow cryptography \
+ && make -C native
+
+ENV PYTHONUNBUFFERED=1
+EXPOSE 9333 8080 8888 8333 2022 7333 17777
+ENTRYPOINT ["python", "-m", "seaweedfs_tpu.server"]
+CMD ["server", "-ip", "0.0.0.0", "-dir", "/data", "-filer"]

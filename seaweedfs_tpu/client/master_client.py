@@ -231,7 +231,7 @@ class MasterClient:
 
     def assign(
         self, count: int = 1, collection: str = "", replication: str = "",
-        ttl: str = "",
+        ttl: str = "", disk_type: str = "",
     ) -> AssignResult:
         self._ensure_session()
 
@@ -242,6 +242,7 @@ class MasterClient:
                     collection=collection,
                     replication=replication,
                     ttl=ttl,
+                    disk_type=disk_type,
                 ),
                 timeout=30,
             )

@@ -182,15 +182,31 @@ class MasterService:
             ttl = str(TTL.parse(request.ttl))
         except ValueError as e:
             return pb.AssignResponse(error=f"bad ttl: {e}")
+        dt = request.disk_type
         picked = self.topo.pick_for_write(
-            request.collection, request.replication, ttl
+            request.collection, request.replication, ttl, disk_type=dt
         )
         if picked is None:
-            grown = self._grow(request.collection, request.replication, ttl)
+            grown = self._grow(
+                request.collection, request.replication, ttl, disk_type=dt
+            )
             if grown:
                 picked = self.topo.pick_for_write(
-                    request.collection, request.replication, ttl
+                    request.collection, request.replication, ttl,
+                    disk_type=dt,
                 )
+        elif self.topo.all_crowded(
+            request.collection, request.replication, ttl, disk_type=dt
+        ):
+            # crowded-state proactive growth: serve THIS assign from
+            # the crowded volume but add capacity in the background so
+            # the bucket never runs dry (reference volume_layout.go)
+            threading.Thread(
+                target=self._grow,
+                args=(request.collection, request.replication, ttl),
+                kwargs={"disk_type": dt},
+                daemon=True,
+            ).start()
         if picked is None:
             return pb.AssignResponse(error="no writable volumes and growth failed")
         vid, holders = picked
@@ -208,7 +224,13 @@ class MasterService:
             jwt=token,
         )
 
-    def _grow(self, collection: str, replication: str, ttl: str = "") -> list[int]:
+    def _grow(
+        self,
+        collection: str,
+        replication: str,
+        ttl: str = "",
+        disk_type: str = "",
+    ) -> list[int]:
         """Allocate one new volume on planned targets (reference
         VolumeGrowth.findEmptySlotsForOneVolume + AllocateVolume RPCs)."""
         with self._grow_lock:
@@ -226,6 +248,7 @@ class MasterService:
                                 collection=collection,
                                 replication=replication,
                                 ttl=ttl,
+                                disk_type=disk_type,
                             ),
                             timeout=10,
                         )
@@ -243,6 +266,9 @@ class MasterService:
                         collection=collection,
                         replica_placement=replication,
                         ttl=ttl,
+                        # a typed grow must be typed in the layout too,
+                        # or the re-pick that follows filters it out
+                        disk_type=disk_type or "hdd",
                     ),
                 )
             return [vid]
@@ -539,6 +565,7 @@ class MasterServer:
                             collection=q.get("collection", [""])[0],
                             replication=q.get("replication", [""])[0],
                             ttl=q.get("ttl", [""])[0],
+                            disk_type=q.get("disk", [""])[0],
                         ),
                         None,
                     )

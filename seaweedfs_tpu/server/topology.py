@@ -357,12 +357,22 @@ class Topology:
             self.max_volume_id = max(self.max_volume_id, hint) + 1
             return self.max_volume_id
 
+    # a volume at this fraction of the size limit is "crowded": still
+    # writable, but the layout steers new writes elsewhere and asks for
+    # growth before the bucket fills (reference volume_layout.go
+    # crowded-state transitions)
+    CROWDED_FRACTION = 0.9
+
     def writable_volumes(
-        self, collection: str, replication: str, ttl: str = ""
+        self,
+        collection: str,
+        replication: str,
+        ttl: str = "",
+        disk_type: str = "",
     ) -> list[tuple[int, list[DataNode]]]:
         """(vid, holders) for volumes writable under the given policy.
-        The (collection, replication, ttl) triple buckets volumes the way
-        the reference's VolumeLayout does."""
+        The (collection, replication, ttl, diskType) tuple buckets
+        volumes the way the reference's VolumeLayout does."""
         copies = _replica_copies(replication)
         with self._lock:
             by_vid: dict[int, list[DataNode]] = {}
@@ -374,6 +384,10 @@ class Topology:
                         and v.size < self.volume_size_limit
                         and (not replication or v.replica_placement == replication)
                         and v.ttl == (ttl or "")
+                        and (
+                            not disk_type
+                            or (v.disk_type or "hdd") == disk_type
+                        )
                     ):
                         by_vid.setdefault(v.id, []).append(n)
             return [
@@ -382,13 +396,46 @@ class Topology:
                 if len(holders) >= copies
             ]
 
+    def _is_crowded(self, vid: int, holders: list[DataNode]) -> bool:
+        limit = self.volume_size_limit * self.CROWDED_FRACTION
+        return any(
+            n.volumes[vid].size >= limit for n in holders if vid in n.volumes
+        )
+
     def pick_for_write(
-        self, collection: str, replication: str, ttl: str = ""
+        self,
+        collection: str,
+        replication: str,
+        ttl: str = "",
+        disk_type: str = "",
     ) -> Optional[tuple[int, list[DataNode]]]:
-        candidates = self.writable_volumes(collection, replication, ttl)
+        candidates = self.writable_volumes(
+            collection, replication, ttl, disk_type
+        )
         if not candidates:
             return None
-        return random.choice(candidates)
+        roomy = [
+            c for c in candidates if not self._is_crowded(c[0], c[1])
+        ]
+        # crowded volumes are a last resort, not an equal choice
+        return random.choice(roomy or candidates)
+
+    def all_crowded(
+        self,
+        collection: str,
+        replication: str,
+        ttl: str = "",
+        disk_type: str = "",
+    ) -> bool:
+        """True when the bucket is writable only through crowded
+        volumes — the master's cue to grow BEFORE writes start
+        failing (reference crowded → grow transition)."""
+        candidates = self.writable_volumes(
+            collection, replication, ttl, disk_type
+        )
+        return bool(candidates) and all(
+            self._is_crowded(vid, holders) for vid, holders in candidates
+        )
 
     def plan_growth(self, replication: str) -> list[DataNode]:
         """Pick target nodes for one new volume honoring the replica

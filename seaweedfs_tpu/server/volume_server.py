@@ -67,6 +67,7 @@ class VolumeService:
             collection=request.collection,
             replica_placement=request.replication or "000",
             ttl=request.ttl,
+            disk_type=request.disk_type,
         )
         self.server.notify_new_volume(request.volume_id)
         return pb.AllocateVolumeResponse()
@@ -589,6 +590,7 @@ class VolumeService:
                     replica_placement=v["replica_placement"],
                     version=v["version"],
                     ttl=v.get("ttl", ""),
+                    disk_type=v.get("disk_type", "hdd"),
                 )
                 for v in st["volumes"]
             ],
@@ -875,6 +877,7 @@ class VolumeServer:
                     replica_placement=v["replica_placement"],
                     version=v["version"],
                     ttl=v.get("ttl", ""),
+                    disk_type=v.get("disk_type", "hdd"),
                 )
                 for v in st["volumes"]
             ],

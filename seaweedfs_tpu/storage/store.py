@@ -25,12 +25,13 @@ _VIF_RE = re.compile(r"^(?:(?P<col>[^_]+)_)?(?P<vid>\d+)\.vif$")
 
 @dataclass
 class DiskLocation:
-    """One storage directory (the reference also tags disk type; one
-    default type here until tiering lands)."""
+    """One storage directory, tagged with a disk type (reference
+    per-disk-type hdd/ssd DiskLocations, weed/storage/store.go)."""
 
     directory: str
     max_volume_count: int = 0  # 0 = unlimited
     needle_map_kind: str = "memory"
+    disk_type: str = "hdd"
     volumes: dict[int, Volume] = field(default_factory=dict)
     ec_volumes: dict[int, EcVolume] = field(default_factory=dict)
 
@@ -88,10 +89,20 @@ class Store:
         self.ec_remote_reader_factory = ec_remote_reader_factory
         self.needle_map_kind = needle_map_kind
         self._lock = threading.RLock()
-        self.locations = [
-            DiskLocation(d, needle_map_kind=needle_map_kind)
-            for d in directories
-        ]
+        # a directory spec may carry a type tag: "/data1:ssd"
+        # (reference -dir=/d1 -disk=ssd); bare paths default to hdd
+        self.locations = []
+        for d in directories:
+            dtype = "hdd"
+            if ":" in d:
+                path, _, tag = d.rpartition(":")
+                if tag and "/" not in tag:
+                    d, dtype = path, tag
+            self.locations.append(
+                DiskLocation(
+                    d, needle_map_kind=needle_map_kind, disk_type=dtype
+                )
+            )
         for loc in self.locations:
             os.makedirs(loc.directory, exist_ok=True)
             loc.load_existing(ec_backend, ec_remote_reader_factory)
@@ -126,7 +137,17 @@ class Store:
 
     # ----------------------------------------------------------- manage
 
-    def _pick_location(self) -> DiskLocation:
+    def _pick_location(self, disk_type: str = "") -> DiskLocation:
+        if disk_type:
+            typed = [l for l in self.locations if l.disk_type == disk_type]
+            if not typed:
+                raise VolumeError(f"no {disk_type!r} disk location here")
+            return min(
+                typed, key=lambda l: len(l.volumes) + len(l.ec_volumes)
+            )
+        return self._pick_any_location()
+
+    def _pick_any_location(self) -> DiskLocation:
         # fewest volumes first (the reference scores free slots per disk)
         return min(self.locations, key=lambda l: len(l.volumes) + len(l.ec_volumes))
 
@@ -136,11 +157,12 @@ class Store:
         collection: str = "",
         replica_placement: str = "000",
         ttl: str = "",
+        disk_type: str = "",
     ) -> Volume:
         with self._lock:
             if self.find_volume(vid) is not None:
                 raise VolumeError(f"volume {vid} already exists")
-            loc = self._pick_location()
+            loc = self._pick_location(disk_type)
             v = Volume(
                 loc.directory,
                 vid,
@@ -304,6 +326,7 @@ class Store:
                         "replica_placement": st.replica_placement,
                         "version": st.version,
                         "ttl": str(v.ttl),
+                        "disk_type": loc.disk_type,
                     }
                 )
         ecs = []

@@ -1,0 +1,130 @@
+"""Per-disk-type locations + crowded-state volume layout.
+
+References: weed/storage store per-disk-type DiskLocations,
+weed/topology/volume_layout.go crowded/full transitions.
+"""
+
+import time
+
+import pytest
+
+from conftest import allocate_port
+from seaweedfs_tpu.client.operations import Operations
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.storage.volume import VolumeError
+
+
+def test_store_disk_type_tagging_and_allocation(tmp_path):
+    st = Store(
+        [str(tmp_path / "hdd1"), f"{tmp_path}/fast:ssd"],
+        ip="localhost",
+        port=0,
+    )
+    types = {loc.disk_type for loc in st.locations}
+    assert types == {"hdd", "ssd"}
+    v_ssd = st.allocate_volume(1, disk_type="ssd")
+    assert "/fast/" in v_ssd.dat_path
+    v_any = st.allocate_volume(2)
+    assert v_any is not None
+    with pytest.raises(VolumeError, match="nvme"):
+        st.allocate_volume(3, disk_type="nvme")
+
+
+def test_assign_honors_disk_type(tmp_path):
+    mport = allocate_port()
+    ms = MasterServer(ip="localhost", port=mport)
+    ms.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path / "hdd"), f"{tmp_path}/ssd:ssd"],
+        master=f"localhost:{mport}",
+        ip="localhost",
+        port=allocate_port(),
+    )
+    vs.start()
+    try:
+        while not ms.topo.nodes:
+            time.sleep(0.05)
+        ops = Operations(master=f"localhost:{mport}")
+        a_ssd = ops.master.assign(disk_type="ssd")
+        vid_ssd = int(a_ssd.fid.split(",")[0])
+        vol = vs.store.find_volume(vid_ssd)
+        assert f"{tmp_path}/ssd/" in vol.dat_path
+        # heartbeats report the type; later typed assigns reuse it
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            node = next(iter(ms.topo.nodes.values()))
+            vmeta = node.volumes.get(vid_ssd)
+            if vmeta is not None and vmeta.disk_type == "ssd":
+                break
+            time.sleep(0.1)
+        assert vmeta.disk_type == "ssd"
+        a2 = ops.master.assign(disk_type="ssd")
+        assert int(a2.fid.split(",")[0]) == vid_ssd
+        # untyped assigns may land anywhere
+        a3 = ops.master.assign()
+        assert a3.fid
+    finally:
+        vs.stop()
+        ms.stop()
+
+
+def test_crowded_volumes_are_avoided_then_grown(tmp_path):
+    """pick_for_write prefers roomy volumes; when every candidate is
+    crowded, assignment still succeeds but growth kicks in."""
+    mport = allocate_port()
+    # tiny limit so a single write crowds the volume
+    ms = MasterServer(
+        ip="localhost", port=mport, volume_size_limit=64 * 1024
+    )
+    ms.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path / "v")],
+        master=f"localhost:{mport}",
+        ip="localhost",
+        port=allocate_port(),
+        max_volume_count=4,
+    )
+    vs.start()
+    try:
+        while not ms.topo.nodes:
+            time.sleep(0.05)
+        ops = Operations(master=f"localhost:{mport}")
+        fid1 = ops.upload(b"x" * 60 * 1024)  # crowds its volume
+        vid1 = int(fid1.split(",")[0])
+        # wait for the heartbeat to report the size
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            node = next(iter(ms.topo.nodes.values()))
+            v = node.volumes.get(vid1)
+            if v is not None and v.size >= 55 * 1024:
+                break
+            time.sleep(0.1)
+        assert ms.topo.all_crowded("", "")
+        assert ms.topo._is_crowded(
+            vid1, [next(iter(ms.topo.nodes.values()))]
+        )
+        # assigning against the crowded bucket still works AND triggers
+        # background growth; eventually a roomy volume appears and is
+        # preferred
+        ops.master.assign()
+        deadline = time.monotonic() + 10
+        grew = False
+        while time.monotonic() < deadline:
+            if len(vs.store.volume_ids()) > 1:
+                grew = True
+                break
+            ops.master.assign()
+            time.sleep(0.2)
+        assert grew, "crowded bucket should trigger proactive growth"
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            picked = ms.topo.pick_for_write("", "")
+            if picked and picked[0] != vid1:
+                break
+            time.sleep(0.1)
+        assert picked[0] != vid1, "roomy volume should be preferred"
+    finally:
+        vs.stop()
+        ms.stop()
