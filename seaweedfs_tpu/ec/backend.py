@@ -32,6 +32,23 @@ class RSBackend(Protocol):
         """(k, n) data -> (m, n) parity."""
         ...
 
+    # Async device pipeline hooks (overlap H2D / compute / D2H). On the
+    # CPU backend these degenerate to identity + synchronous encode, so
+    # the encoder pipeline is written once against this surface.
+    def to_device(self, data: np.ndarray):
+        """Stage host data toward the compute device (async when the
+        backend is a device; returns a handle encode_staged accepts)."""
+        ...
+
+    def encode_staged(self, staged):
+        """Dispatch encode on staged input; returns a result handle
+        WITHOUT waiting for completion."""
+        ...
+
+    def to_host(self, result) -> np.ndarray:
+        """Block until `result` is complete and return host uint8."""
+        ...
+
     def reconstruct(
         self, shards: dict[int, np.ndarray], want: list[int] | None = None
     ) -> dict[int, np.ndarray]:
@@ -86,6 +103,16 @@ class _BackendBase:
         k = self.ctx.data_shards
         return bool(np.array_equal(self.encode(shards[:k]), shards[k:]))
 
+    # Default (synchronous) pipeline hooks; device backends override.
+    def to_device(self, data: np.ndarray):
+        return data
+
+    def encode_staged(self, staged):
+        return self.encode(staged)
+
+    def to_host(self, result) -> np.ndarray:
+        return np.asarray(result, dtype=np.uint8)
+
 
 class CpuBackend(_BackendBase):
     """Native C++ SIMD GF(2^8); falls back to numpy tables if the .so
@@ -124,6 +151,21 @@ class JaxBackend(_BackendBase):
 
     def encode(self, data: np.ndarray) -> np.ndarray:
         return np.asarray(self._rs.encode(data))
+
+    # -- async pipeline: JAX dispatch is non-blocking, so staging batch
+    # N+1 while batch N computes (and N-1 drains to host) only requires
+    # NOT forcing np.asarray between the stages. The encoder's bounded
+    # queues provide the double-buffering window.
+    def to_device(self, data: np.ndarray):
+        import jax
+
+        return jax.device_put(np.ascontiguousarray(data, dtype=np.uint8))
+
+    def encode_staged(self, staged):
+        return self._rs.encode(staged)
+
+    def to_host(self, result) -> np.ndarray:
+        return np.asarray(result, dtype=np.uint8)
 
     def reconstruct(
         self, shards: dict[int, np.ndarray], want: list[int] | None = None

@@ -78,9 +78,10 @@ def write_ec_files(
         small_row = small_block_size * k
 
         # Row/chunk schedule: the hot loop is disk-bound (SURVEY.md hard
-        # part (b)), so reads, device encode, and shard writes run as a
-        # 3-stage pipeline with bounded queues — the device computes
-        # batch N while batch N+1 is read and batch N-1 is written.
+        # part (b)), so reads, H2D staging, device encode, and shard
+        # writes run as a 4-stage pipeline with bounded queues — the
+        # device computes batch N while batch N+1 is read/transferred
+        # and batch N-1 drains to host and disk.
         def chunk_plan():
             processed = 0
             remaining = dat_size
@@ -141,7 +142,11 @@ def write_ec_files(
                     item = write_q.get()
                     if item is None:
                         return
-                    data, parity = item
+                    data, parity_handle = item
+                    # Blocks until the device result is ready — while it
+                    # does, the main thread keeps dispatching H2D+encode
+                    # for the batches queued behind this one.
+                    parity = backend.to_host(parity_handle)
                     for i in range(total):
                         b = (data[i] if i < k else parity[i - k]).tobytes()
                         outputs[i].write(b)
@@ -157,12 +162,20 @@ def write_ec_files(
         rt.start()
         wt.start()
         try:
+            # 4 overlapped stages: disk read (reader thread) / H2D stage /
+            # device encode dispatch (both async, this thread) / D2H +
+            # shard write (writer thread, blocks in to_host). Device
+            # residency bound: up to 4 batches alive at once — one
+            # draining in to_host, two queued in write_q, one being
+            # dispatched here — so peak device memory is ~4x batch_size
+            # of input (+ m/k of that in outputs); callers raising
+            # batch_size must budget accordingly.
             while True:
                 data = read_q.get()
                 if data is None or abort.is_set():
                     break
-                parity = np.asarray(backend.encode(data), dtype=np.uint8)
-                if not _put(write_q, (data, parity)):
+                parity_handle = backend.encode_staged(backend.to_device(data))
+                if not _put(write_q, (data, parity_handle)):
                     break
         except BaseException as e:
             errors.append(e)

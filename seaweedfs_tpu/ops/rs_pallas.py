@@ -15,10 +15,17 @@ boundaries and the packed accumulator word holds each byte's exact sum.
 Parity bits come back out with `(acc & MASK) << i`. Everything is
 endian-agnostic because pack and unpack mirror each other.
 
-Exactness: f32 accumulators are exact for packed values < 2^24, which
-bounds W*8-bit words to W <= 2 (max sum 8k * 0x00010001 < 2^24 for
-k <= 16... actually 80 * 65537 ~ 5.2e6 << 2^24). W=4 requires integer
-matmul accumulation and is gated behind pack_width=4.
+Exactness — MEASURED ON REAL v5e HARDWARE, not just interpret mode:
+the MXU executes "f32" matmuls as bf16 passes (8-bit mantissa) unless
+precision=HIGHEST is requested. Packed pw=2 sums reach 80*0x0101=20560,
+which bf16 silently rounds — the low byte of every output word corrupts
+while interpret mode (true f32) passes. Consequences baked in here:
+
+- pack_width=1 (sums <= 8k <= 128, exact even in bf16) is the DEFAULT,
+  run as a single contraction-8k dot in int8 (exact integer MXU path,
+  ~3x the f32 j-loop throughput on v5e);
+- pack_width=2 f32 dots force precision=HIGHEST (exact, slower);
+- pack_width=4 would need >24-bit exact accumulation — rejected.
 
 Reference hot loop being replaced:
 weed/storage/erasure_coding/ec_encoder.go:427 (encodeDataOneBatch).
@@ -36,33 +43,58 @@ from jax.experimental.pallas import tpu as pltpu  # noqa: F401  (memory spaces)
 
 from . import gf256
 
-# Default word-column tile (lanes of packed words). VMEM use is dominated
-# by the f32 planes/accumulator: ~ (8m + k) * TILE_N * 4B.
-TILE_N = 16384
+# Default word-column tile. Measured sweet spot on v5e for the pw=1
+# int8 single-dot kernel (8192 beat 16384 by ~25%); VMEM use is
+# dominated by the (8k, TN) plane block + (8m, TN) accumulator.
+TILE_N = 8192
 
 _WORD_DTYPES = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32}
 _MASKS = {1: 0x01, 2: 0x0101, 4: 0x01010101}
 
 
 def _rs_kernel(k: int, m: int, pack_width: int, b_ref, d_ref, out_ref):
-    """b_ref: (8m, 8k) f32 bit-major; d_ref: (k, TN) uintW words."""
-    # All integer work is int32: Mosaic lacks uint32<->f32 casts, and
-    # arithmetic right-shift is safe because the masked bit positions
-    # (0, 8, 16, 24) sit below any sign-extension for shifts <= 7.
+    """b_ref: (8m, 8k) bit-major; d_ref: (k, TN) uintW words.
+
+    One contraction-(8k) dot per tile, not 8 contraction-k dots: the MXU
+    is weight-stationary, so contraction length is utilization (80/128
+    vs 10/128 for the default 10+4 codec — measured ~3x on v5e).
+
+    All integer lane work is int32: Mosaic lacks uint32<->f32 casts,
+    int8-domain shifts hang its remote compiler (observed on v5e), and
+    arithmetic right-shift is safe because the masked bit positions
+    (0, 8, 16, 24) sit below any sign-extension for shifts <= 7.
+    """
     mask = _MASKS[pack_width]
-    acc_dtype = jnp.int32 if pack_width == 4 else jnp.float32
-    d = d_ref[:].astype(jnp.int32)
-    acc = jnp.zeros((8 * m, d.shape[1]), dtype=acc_dtype)
-    for j in range(8):
-        plane = ((d >> j) & mask).astype(acc_dtype)
-        b_cols = b_ref[:, j * k : (j + 1) * k].astype(acc_dtype)
-        acc = acc + jax.lax.dot_general(
-            b_cols,
-            plane,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=acc_dtype,
+    if pack_width == 4:
+        raise NotImplementedError(
+            "pack_width=4 needs >24-bit exact matmul accumulation, which "
+            "the TPU MXU does not provide (int32 dots unsupported, f32 "
+            "dots are inexact past 2^24)"
         )
-    acci = acc.astype(jnp.int32)
+    d = d_ref[:].astype(jnp.int32)
+    planes = jnp.concatenate([(d >> j) & mask for j in range(8)], axis=0)
+    if pack_width == 1:
+        # 0/1 planes fit int8: exact integer MXU path, ~2x f32 rate.
+        acc = jax.lax.dot_general(
+            b_ref[:].astype(jnp.int8),
+            planes.astype(jnp.int8),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        acci = acc
+    else:
+        # Packed sums reach 8k * 0x0101 (~20k): exact only if the MXU
+        # really accumulates f32 — HIGHEST forces the multi-pass f32
+        # path (default precision runs bf16 passes and corrupts the low
+        # byte of every word; caught by the bit-exactness suite).
+        acc = jax.lax.dot_general(
+            b_ref[:].astype(jnp.float32),
+            planes.astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        acci = acc.astype(jnp.int32)
     out = jnp.zeros((m, d.shape[1]), dtype=jnp.int32)
     for i in range(8):
         out = out | ((acci[i * m : (i + 1) * m] & mask) << i)
@@ -143,7 +175,7 @@ def apply_bitmajor_pallas(
     k: int,
     m: int,
     tile_n: int = TILE_N,
-    pack_width: int = 2,
+    pack_width: int = 1,
     interpret: bool = False,
 ):
     """(8m x 8k) bit-major GF(2) matrix applied to (k, n) uint8 -> (m, n)."""
@@ -204,7 +236,7 @@ def _aligned_m_pad(m: int, pack_width: int) -> int:
     return ((m + gran - 1) // gran) * gran
 
 
-def bit_matrix_planes(coeffs: np.ndarray, pack_width: int = 2) -> np.ndarray:
+def bit_matrix_planes(coeffs: np.ndarray, pack_width: int = 1) -> np.ndarray:
     """(m x k) GF(256) coeffs -> (8, k, 8*m_pad) f32 plane stack.
 
     bT[j, c, i*m_pad + r] = bit (i) of gf_mul coefficient row r applied
@@ -223,20 +255,39 @@ def bit_matrix_planes(coeffs: np.ndarray, pack_width: int = 2) -> np.ndarray:
 
 
 def _rs_kernel_aligned(k: int, m_pad: int, pack_width: int, b_ref, d_ref, out_ref):
-    """b_ref: (8, k, 8*m_pad) f32; d_ref: (k, TN) uintW -> (m_pad, TN)."""
+    """b_ref: (8, k, 8*m_pad); d_ref: (k, TN) uintW -> (m_pad, TN).
+
+    Same single-contraction-(8k) + exactness rules as _rs_kernel (int8
+    dot for pw=1, f32 HIGHEST for pw=2): the planes are stacked on the
+    sublane axis and the j dimension of b collapses into the contraction.
+    """
     mask = _MASKS[pack_width]
-    acc_dtype = jnp.int32 if pack_width == 4 else jnp.float32
-    d = d_ref[:].astype(jnp.int32)
-    acc = jnp.zeros((8 * m_pad, d.shape[1]), dtype=acc_dtype)
-    for j in range(8):
-        plane = ((d >> j) & mask).astype(acc_dtype)
-        acc = acc + jax.lax.dot_general(
-            b_ref[j].astype(acc_dtype),
-            plane,
-            dimension_numbers=(((0,), (0,)), ((), ())),
-            preferred_element_type=acc_dtype,
+    if pack_width == 4:
+        raise NotImplementedError(
+            "pack_width=4 needs >24-bit exact matmul accumulation"
         )
-    acci = acc.astype(jnp.int32)
+    d = d_ref[:].astype(jnp.int32)
+    planes = jnp.concatenate([(d >> j) & mask for j in range(8)], axis=0)
+    b2 = b_ref[:].reshape(8 * k, 8 * m_pad)  # rows j*k+c match plane order
+    if pack_width == 1:
+        acc = jax.lax.dot_general(
+            b2.astype(jnp.int8),
+            planes.astype(jnp.int8),
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        acci = acc
+    else:
+        # Packed sums exceed 8 bits: the MXU's default bf16 passes would
+        # corrupt them — force the exact multi-pass f32 path.
+        acc = jax.lax.dot_general(
+            b2.astype(jnp.float32),
+            planes.astype(jnp.float32),
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        acci = acc.astype(jnp.int32)
     out = jnp.zeros((m_pad, d.shape[1]), dtype=jnp.int32)
     for i in range(8):
         out = out | ((acci[i * m_pad : (i + 1) * m_pad] & mask) << i)
@@ -253,7 +304,7 @@ def apply_planes_pallas(
     k: int,
     m: int,
     tile_n: int = TILE_N_ALIGNED,
-    pack_width: int = 2,
+    pack_width: int = 1,
     interpret: bool = False,
 ):
     """Aligned-layout twin of apply_bitmajor_pallas.
