@@ -1,0 +1,6 @@
+"""Admin web UI + maintenance plane (reference weed/admin: dash views,
+maintenance scanner/queue/worker dashboards, config editor)."""
+
+from .server import AdminServer
+
+__all__ = ["AdminServer"]

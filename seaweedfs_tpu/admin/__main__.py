@@ -1,0 +1,39 @@
+"""`python -m seaweedfs_tpu.admin -master host:9333 -port 23646`
+(reference `weed admin`): web dashboard + maintenance plane."""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from .server import AdminServer
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="seaweedfs_tpu.admin")
+    p.add_argument("-master", default="localhost:9333")
+    p.add_argument("-ip", default="localhost")
+    p.add_argument("-port", type=int, default=23646)
+    p.add_argument(
+        "-config",
+        default="admin_maintenance.json",
+        help="maintenance policy persistence path",
+    )
+    a = p.parse_args(argv)
+    srv = AdminServer(
+        master=a.master, ip=a.ip, port=a.port, config_path=a.config
+    )
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *x: stop.set())
+    signal.signal(signal.SIGINT, lambda *x: stop.set())
+    srv.start()
+    print(f"admin on http://{a.ip}:{a.port}/ -> master {a.master}", flush=True)
+    stop.wait()
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

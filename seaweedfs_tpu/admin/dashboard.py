@@ -1,0 +1,183 @@
+"""Single-page admin dashboard, served inline (no static-file tree).
+
+Functional equivalent of the reference's templ+HTMX admin views
+(weed/admin/dash, weed/admin/view): topology browser, maintenance
+queue + worker fleet, and a live config editor. Vanilla JS polling the
+JSON API — no build step, no external assets, works over curl-grade
+HTTP. Everything dynamic is rendered client-side from /api responses,
+so the page itself is static and cacheable.
+"""
+
+DASHBOARD_HTML = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>seaweed-tpu admin</title>
+<style>
+  body { font-family: system-ui, sans-serif; margin: 0; background: #f6f7f9; color: #1a202c; }
+  header { background: #1a2b3c; color: #fff; padding: 10px 24px; display: flex; align-items: baseline; gap: 16px; }
+  header h1 { font-size: 18px; margin: 0; }
+  header .sub { color: #9fb3c8; font-size: 13px; }
+  main { padding: 16px 24px; max-width: 1200px; }
+  h2 { font-size: 15px; border-bottom: 1px solid #d7dce2; padding-bottom: 4px; margin-top: 28px; }
+  table { border-collapse: collapse; width: 100%; font-size: 13px; background: #fff; }
+  th, td { border: 1px solid #e2e8f0; padding: 5px 8px; text-align: left; }
+  th { background: #edf2f7; font-weight: 600; }
+  .stat { display: inline-block; background: #fff; border: 1px solid #e2e8f0; border-radius: 6px;
+          padding: 8px 14px; margin: 4px 8px 4px 0; }
+  .stat b { display: block; font-size: 18px; }
+  .state-pending { color: #975a16; } .state-assigned { color: #2b6cb0; }
+  .state-running { color: #2b6cb0; font-weight: 600; }
+  .state-done { color: #276749; } .state-failed { color: #c53030; font-weight: 600; }
+  progress { width: 90px; height: 10px; }
+  .rack { margin-left: 16px; } .node { margin-left: 32px; margin-bottom: 10px; }
+  .dcname { font-weight: 600; margin-top: 10px; }
+  form.cfg label { display: inline-block; width: 220px; }
+  form.cfg input { width: 90px; margin: 2px 12px 2px 0; }
+  #cfgmsg { margin-left: 10px; font-size: 13px; }
+  .err { color: #c53030; } .ok { color: #276749; }
+  button { background: #2b6cb0; color: #fff; border: 0; border-radius: 4px; padding: 5px 14px; cursor: pointer; }
+</style>
+</head>
+<body>
+<header><h1>seaweed-tpu admin</h1><span class="sub" id="masteraddr"></span></header>
+<main>
+  <div id="stats"></div>
+
+  <h2>maintenance queue</h2>
+  <div>
+    <form id="submitform" style="margin-bottom:8px">
+      kind <select id="taskkind"><option>ec_encode</option><option>vacuum</option></select>
+      volume <input id="taskvol" size="6">
+      <button type="submit">submit task</button> <span id="submitmsg"></span>
+    </form>
+  </div>
+  <table id="tasks"><tr><th>task</th><th>kind</th><th>volume</th><th>state</th>
+    <th>progress</th><th>worker</th><th>error</th></tr></table>
+
+  <h2>worker fleet</h2>
+  <table id="workers"><tr><th>worker</th><th>capabilities</th><th>backend</th><th>load</th></tr></table>
+
+  <h2>maintenance config</h2>
+  <form class="cfg" id="cfgform">
+    <label>EC auto-encode fullness (0=off)</label><input name="ec_auto_fullness"><br>
+    <label>EC quiet seconds</label><input name="ec_quiet_seconds"><br>
+    <label>vacuum garbage threshold</label><input name="garbage_threshold"><br>
+    <label>vacuum interval seconds</label><input name="vacuum_interval_seconds"><br>
+    <button type="submit">apply &amp; persist</button><span id="cfgmsg"></span>
+  </form>
+
+  <h2>topology</h2>
+  <div id="topology"></div>
+</main>
+<script>
+const $ = (id) => document.getElementById(id);
+const esc = (s) => String(s ?? "").replace(/[&<>"]/g, c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[c]));
+
+async function getJSON(url) { const r = await fetch(url); return r.json(); }
+
+function renderStats(c) {
+  $("stats").innerHTML =
+    `<span class="stat"><b>${c.node_count}</b>volume servers</span>` +
+    `<span class="stat"><b>${c.volume_count}</b>volumes</span>` +
+    `<span class="stat"><b>${c.ec_volume_count}</b>EC volumes</span>` +
+    `<span class="stat"><b>${c.file_count}</b>files</span>` +
+    `<span class="stat"><b>${(c.used_size/1048576).toFixed(1)} MiB</b>used</span>` +
+    `<span class="stat"><b>${c.max_volume_id}</b>max volume id</span>`;
+}
+
+function renderTasks(tasks) {
+  const rows = tasks.map(t =>
+    `<tr><td>${esc(t.task_id)}</td><td>${esc(t.kind)}</td><td>${t.volume_id}</td>` +
+    `<td class="state-${esc(t.state)}">${esc(t.state)}</td>` +
+    `<td><progress max="1" value="${t.progress}"></progress> ${(t.progress*100).toFixed(0)}%</td>` +
+    `<td>${esc(t.worker_id) || "-"}</td><td>${esc(t.error) || "-"}</td></tr>`);
+  $("tasks").innerHTML =
+    `<tr><th>task</th><th>kind</th><th>volume</th><th>state</th><th>progress</th><th>worker</th><th>error</th></tr>` +
+    (rows.join("") || `<tr><td colspan="7">no tasks</td></tr>`);
+}
+
+function renderWorkers(ws) {
+  const rows = ws.map(w =>
+    `<tr><td>${esc(w.worker_id)}</td><td>${esc((w.capabilities||[]).join(", "))}</td>` +
+    `<td>${esc(w.backend)}</td><td>${w.active}/${w.max_concurrent}</td></tr>`);
+  $("workers").innerHTML =
+    `<tr><th>worker</th><th>capabilities</th><th>backend</th><th>load</th></tr>` +
+    (rows.join("") || `<tr><td colspan="4">no workers connected</td></tr>`);
+}
+
+function renderTopology(t) {
+  const byDC = {};
+  for (const n of t.nodes) {
+    const dc = n.data_center || "DefaultDataCenter", rack = n.rack || "DefaultRack";
+    ((byDC[dc] ??= {})[rack] ??= []).push(n);
+  }
+  let html = "";
+  for (const [dc, racks] of Object.entries(byDC)) {
+    html += `<div class="dcname">&#127970; ${esc(dc)}</div>`;
+    for (const [rack, nodes] of Object.entries(racks)) {
+      html += `<div class="rack">&#128230; ${esc(rack)}</div>`;
+      for (const n of nodes) {
+        const vols = n.volumes.map(v =>
+          `<tr><td>${v.id}</td><td>${esc(v.collection) || "-"}</td><td>${v.size.toLocaleString()}</td>` +
+          `<td>${v.file_count}</td><td>${v.read_only ? "RO" : "RW"}</td>` +
+          `<td>${esc(v.replica_placement)}</td><td>${esc(v.ttl) || "-"}</td></tr>`).join("");
+        const ecs = n.ec_shards.map(e =>
+          `<tr><td>ec ${e.id}</td><td>${esc(e.collection) || "-"}</td>` +
+          `<td colspan="3">shards [${e.shard_ids.join(", ")}]</td>` +
+          `<td colspan="2">${e.data_shards}+${e.parity_shards} gen ${e.generation}</td></tr>`).join("");
+        html += `<div class="node"><b>${esc(n.id)}</b> <small>slots ${n.max_volume_count}</small>` +
+          `<table><tr><th>vol</th><th>coll</th><th>size</th><th>files</th><th>mode</th><th>rp</th><th>ttl</th></tr>` +
+          (vols + ecs || `<tr><td colspan="7">empty</td></tr>`) + `</table></div>`;
+      }
+    }
+  }
+  $("topology").innerHTML = html || "<p>no volume servers registered</p>";
+}
+
+let cfgLoaded = false;
+async function refresh() {
+  try {
+    const [cluster, maint, topo] = await Promise.all([
+      getJSON("/api/cluster"), getJSON("/api/maintenance"), getJSON("/api/topology")]);
+    renderStats(cluster); renderTasks(maint.tasks); renderWorkers(maint.workers);
+    renderTopology(topo);
+    $("masteraddr").textContent = "master: " + cluster.master;
+    if (!cfgLoaded) {  // don't clobber a half-edited form on poll
+      for (const [k, v] of Object.entries(maint.config))
+        if ($("cfgform").elements[k]) $("cfgform").elements[k].value = v;
+      cfgLoaded = true;
+    }
+  } catch (e) { $("masteraddr").textContent = "refresh failed: " + e; }
+}
+
+$("cfgform").addEventListener("submit", async (ev) => {
+  ev.preventDefault();
+  const body = {};
+  for (const el of $("cfgform").elements)
+    if (el.name) body[el.name] = parseFloat(el.value);
+  const r = await fetch("/api/config", {method: "POST",
+    headers: {"Content-Type": "application/json"}, body: JSON.stringify(body)});
+  const out = await r.json();
+  $("cfgmsg").textContent = out.error ? out.error : "applied";
+  $("cfgmsg").className = out.error ? "err" : "ok";
+  cfgLoaded = false;
+});
+
+$("submitform").addEventListener("submit", async (ev) => {
+  ev.preventDefault();
+  const r = await fetch("/api/maintenance/submit", {method: "POST",
+    headers: {"Content-Type": "application/json"},
+    body: JSON.stringify({kind: $("taskkind").value, volume_id: parseInt($("taskvol").value)})});
+  const out = await r.json();
+  $("submitmsg").textContent = out.error ? out.error : ("queued " + out.task_id);
+  $("submitmsg").className = out.error ? "err" : "ok";
+  refresh();
+});
+
+refresh();
+setInterval(refresh, 2000);
+</script>
+</body>
+</html>
+"""

@@ -1,0 +1,310 @@
+"""Admin server: web dashboard + maintenance plane over the master.
+
+Reference: `weed admin` (weed/command/admin.go:196) — a standalone
+process serving the dash UI (weed/admin/dash), the maintenance system
+views (weed/admin/maintenance: scanner -> queue -> workers), and a
+config editor whose policies persist across restarts. Here the
+maintenance queue itself lives on the master (worker/control.py), so
+this server is a thin, stateless-except-config gRPC client in front of
+it — killing the admin never loses queue state.
+
+JSON API (the dashboard polls these; tests drive them directly):
+  GET  /api/cluster            cluster stats summary
+  GET  /api/topology           DC/rack/node/volume/EC tree
+  GET  /api/maintenance        {workers, tasks, config}
+  POST /api/maintenance/submit {kind, volume_id[, collection, backend]}
+  GET  /api/config             current maintenance policy
+  POST /api/config             apply + persist maintenance policy
+  GET  /healthz
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlparse
+
+import grpc
+
+from ..client.master_client import _grpc_addr
+from ..pb import cluster_pb2 as pb
+from ..pb import rpc
+from ..pb import worker_pb2 as wk
+from ..utils.glog import logger
+from .dashboard import DASHBOARD_HTML
+
+glog = logger("admin")
+
+CONFIG_FIELDS = (
+    "ec_auto_fullness",
+    "ec_quiet_seconds",
+    "garbage_threshold",
+    "vacuum_interval_seconds",
+)
+
+
+class AdminServer:
+    def __init__(
+        self,
+        master: str,
+        ip: str = "localhost",
+        port: int = 23646,
+        config_path: str | None = None,
+    ):
+        """config_path: where maintenance policy persists (JSON). On
+        start, a persisted policy is re-applied to the master — the
+        reference keeps admin config in the filer for the same reason:
+        the policy must survive both admin and master restarts."""
+        self.master = master
+        self.ip = ip
+        self.port = port
+        self.config_path = config_path
+        self._channel = grpc.insecure_channel(_grpc_addr(master))
+        self._master_stub = rpc.master_stub(self._channel)
+        self._worker_stub = rpc.worker_stub(self._channel)
+        self._http = ThreadingHTTPServer((ip, port), self._handler_class())
+        self._http_thread = threading.Thread(
+            target=self._http.serve_forever, daemon=True
+        )
+
+    # ------------------------------------------------------------ config
+
+    def _load_config(self) -> dict | None:
+        if not self.config_path or not os.path.exists(self.config_path):
+            return None
+        try:
+            with open(self.config_path) as f:
+                cfg = json.load(f)
+            return {k: float(cfg[k]) for k in CONFIG_FIELDS if k in cfg}
+        except (OSError, ValueError) as e:
+            glog.warning(f"admin: unreadable config {self.config_path}: {e}")
+            return None
+
+    def _persist_config(self, cfg: dict) -> None:
+        if not self.config_path:
+            return
+        tmp = self.config_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(cfg, f, indent=2)
+        os.replace(tmp, self.config_path)
+
+    def _push_config(self, cfg: dict) -> str:
+        """Apply to the master; returns error string ('' = ok)."""
+        resp = self._worker_stub.SetMaintenanceConfig(
+            wk.MaintenanceConfig(**cfg), timeout=10
+        )
+        return resp.error
+
+    def apply_persisted_config(self) -> None:
+        cfg = self._load_config()
+        if cfg:
+            try:
+                err = self._push_config(cfg)
+                if err:
+                    glog.warning(f"admin: persisted config rejected: {err}")
+            except grpc.RpcError as e:
+                glog.warning(
+                    f"admin: could not push persisted config: {e.code().name}"
+                )
+
+    # -------------------------------------------------------------- api
+
+    def _api_cluster(self) -> dict:
+        st = self._master_stub.Statistics(pb.StatisticsRequest(), timeout=10)
+        topo = self._master_stub.Topology(pb.TopologyRequest(), timeout=10)
+        return {
+            "master": self.master,
+            "node_count": st.node_count,
+            "volume_count": st.volume_count,
+            "ec_volume_count": st.ec_volume_count,
+            "file_count": st.file_count,
+            "used_size": st.used_size,
+            "max_volume_id": topo.max_volume_id,
+        }
+
+    def _api_topology(self) -> dict:
+        topo = self._master_stub.Topology(pb.TopologyRequest(), timeout=10)
+        return {
+            "max_volume_id": topo.max_volume_id,
+            "nodes": [
+                {
+                    "id": n.id,
+                    "rack": n.rack,
+                    "data_center": n.data_center,
+                    "max_volume_count": n.max_volume_count,
+                    "volumes": [
+                        {
+                            "id": v.id,
+                            "collection": v.collection,
+                            "size": v.size,
+                            "file_count": v.file_count,
+                            "deleted_count": v.deleted_count,
+                            "read_only": v.read_only,
+                            "replica_placement": v.replica_placement,
+                            "ttl": v.ttl,
+                        }
+                        for v in sorted(n.volumes, key=lambda v: v.id)
+                    ],
+                    "ec_shards": [
+                        {
+                            "id": e.id,
+                            "collection": e.collection,
+                            "shard_ids": [
+                                i for i in range(32) if e.shard_bits & (1 << i)
+                            ],
+                            "data_shards": e.data_shards,
+                            "parity_shards": e.parity_shards,
+                            "generation": e.generation,
+                        }
+                        for e in sorted(n.ec_shards, key=lambda e: e.id)
+                    ],
+                }
+                for n in topo.nodes
+            ],
+        }
+
+    def _api_maintenance(self) -> dict:
+        tasks = self._worker_stub.ListTasks(wk.ListTasksRequest(), timeout=10)
+        workers = self._worker_stub.ListWorkers(
+            wk.ListWorkersRequest(), timeout=10
+        )
+        cfg = self._worker_stub.GetMaintenanceConfig(
+            wk.GetMaintenanceConfigRequest(), timeout=10
+        )
+        return {
+            "tasks": [
+                {
+                    "task_id": t.task_id,
+                    "kind": t.kind,
+                    "volume_id": t.volume_id,
+                    "state": t.state,
+                    "worker_id": t.worker_id,
+                    "progress": t.progress,
+                    "error": t.error,
+                }
+                for t in tasks.tasks
+            ],
+            "workers": [
+                {
+                    "worker_id": w.worker_id,
+                    "capabilities": list(w.capabilities),
+                    "backend": w.backend,
+                    "active": w.active,
+                    "max_concurrent": w.max_concurrent,
+                }
+                for w in workers.workers
+            ],
+            "config": {k: getattr(cfg, k) for k in CONFIG_FIELDS},
+        }
+
+    def _api_get_config(self) -> dict:
+        cfg = self._worker_stub.GetMaintenanceConfig(
+            wk.GetMaintenanceConfigRequest(), timeout=10
+        )
+        return {k: getattr(cfg, k) for k in CONFIG_FIELDS}
+
+    def _api_submit(self, body: dict) -> dict:
+        resp = self._worker_stub.SubmitTask(
+            wk.SubmitTaskRequest(
+                kind=str(body.get("kind", "")),
+                volume_id=int(body.get("volume_id", 0)),
+                collection=str(body.get("collection", "")),
+                backend=str(body.get("backend", "")),
+            ),
+            timeout=10,
+        )
+        if resp.error:
+            return {"error": resp.error}
+        return {"task_id": resp.task_id}
+
+    def _api_set_config(self, body: dict) -> dict:
+        try:
+            cfg = {k: float(body[k]) for k in CONFIG_FIELDS}
+        except (KeyError, TypeError, ValueError) as e:
+            return {"error": f"config needs numeric {CONFIG_FIELDS}: {e}"}
+        err = self._push_config(cfg)
+        if err:
+            return {"error": err}
+        # persist only what the master accepted
+        self._persist_config(cfg)
+        return {"config": cfg}
+
+    # ------------------------------------------------------------- http
+
+    def _handler_class(self):
+        admin = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code: int, obj: dict) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _dispatch(self, path: str, body: dict | None) -> None:
+                try:
+                    if path in ("/", "/ui"):
+                        page = DASHBOARD_HTML.encode()
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type", "text/html; charset=utf-8"
+                        )
+                        self.send_header("Content-Length", str(len(page)))
+                        self.end_headers()
+                        self.wfile.write(page)
+                    elif path == "/healthz":
+                        self._json(200, {"ok": True})
+                    elif path == "/api/cluster":
+                        self._json(200, admin._api_cluster())
+                    elif path == "/api/topology":
+                        self._json(200, admin._api_topology())
+                    elif path == "/api/maintenance":
+                        self._json(200, admin._api_maintenance())
+                    elif path == "/api/config" and body is None:
+                        self._json(200, admin._api_get_config())
+                    elif path == "/api/config":
+                        out = admin._api_set_config(body)
+                        self._json(400 if "error" in out else 200, out)
+                    elif path == "/api/maintenance/submit" and body is not None:
+                        out = admin._api_submit(body)
+                        self._json(400 if "error" in out else 200, out)
+                    else:
+                        self._json(404, {"error": "not found"})
+                except grpc.RpcError as e:
+                    self._json(
+                        502,
+                        {"error": f"master unreachable: {e.code().name}"},
+                    )
+
+            def do_GET(self):
+                self._dispatch(urlparse(self.path).path, None)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0") or 0)
+                try:
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                except json.JSONDecodeError:
+                    self._json(400, {"error": "invalid JSON body"})
+                    return
+                self._dispatch(urlparse(self.path).path, body)
+
+        return Handler
+
+    # -------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._http_thread.start()
+        self.apply_persisted_config()
+        glog.info(f"admin server on http://{self.ip}:{self.port}/")
+
+    def stop(self) -> None:
+        self._http.shutdown()
+        self._http.server_close()
+        self._channel.close()

@@ -199,7 +199,17 @@ def write_ec_files(
             rt.join(timeout=60)
             wt.join(timeout=60)
             if rt.is_alive() or wt.is_alive():  # pragma: no cover
+                # A stuck thread (e.g. the writer wedged in a device
+                # to_host against a hung TPU relay) means the shard
+                # files are TRUNCATED but the CRC builders are
+                # self-consistent with the truncation — returning
+                # success here would publish undetectable data loss.
                 abort.set()
+                raise ECError(
+                    "ec encode pipeline thread did not finish "
+                    f"(reader alive={rt.is_alive()}, writer alive="
+                    f"{wt.is_alive()}); shards are incomplete"
+                )
         if errors:
             raise errors[0]
 

@@ -99,6 +99,9 @@ SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
         "WorkerStream": (BIDI, wk.WorkerMessage, wk.ServerMessage),
         "ListTasks": (UNARY, wk.ListTasksRequest, wk.ListTasksResponse),
         "SubmitTask": (UNARY, wk.SubmitTaskRequest, wk.SubmitTaskResponse),
+        "ListWorkers": (UNARY, wk.ListWorkersRequest, wk.ListWorkersResponse),
+        "GetMaintenanceConfig": (UNARY, wk.GetMaintenanceConfigRequest, wk.MaintenanceConfig),
+        "SetMaintenanceConfig": (UNARY, wk.MaintenanceConfig, wk.SetMaintenanceConfigResponse),
     },
     RAFT_SERVICE: {
         "RaftRequestVote": (UNARY, pb.RaftVoteRequest, pb.RaftVoteResponse),
@@ -153,3 +156,7 @@ def mq_stub(channel: grpc.Channel) -> Stub:
 
 def filer_stub(channel: grpc.Channel) -> Stub:
     return Stub(channel, FILER_SERVICE)
+
+
+def worker_stub(channel: grpc.Channel) -> Stub:
+    return Stub(channel, WORKER_SERVICE)

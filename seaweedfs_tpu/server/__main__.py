@@ -160,6 +160,11 @@ def main(argv=None) -> int:
         "-sftpUser", action="append", default=[],
         help="user:password[:home[:ro]] (repeatable)",
     )
+    s.add_argument(
+        "-admin", action="store_true",
+        help="also run the admin dashboard (reference `weed admin`)",
+    )
+    s.add_argument("-adminPort", type=int, default=23646)
     _add_tls_flags(s)
 
     sc = sub.add_parser(
@@ -338,6 +343,19 @@ def main(argv=None) -> int:
         vs.start()
         servers.append(vs)
         log.info("volume server on %s:%s (grpc %s)", a.ip, a.port, vs.grpc_port)
+
+    if a.mode == "server" and getattr(a, "admin", False):
+        from ..admin import AdminServer
+
+        adm = AdminServer(
+            master=f"{a.ip}:{a.masterPort}",
+            ip=a.ip,
+            port=a.adminPort,
+            config_path=os.path.join(a.dir[0], "admin_maintenance.json"),
+        )
+        adm.start()
+        servers.append(adm)
+        log.info("admin dashboard on %s:%s", a.ip, a.adminPort)
 
     if a.mode == "filer" or (
         a.mode == "server" and (a.filer or a.s3 or a.webdav or a.sftp)

@@ -479,7 +479,11 @@ class MasterServer:
 
         from ..worker.control import WorkerControl
 
-        self.worker_control = WorkerControl(topo=self.topo)
+        self.worker_control = WorkerControl(
+            topo=self.topo,
+            config_get=self._maintenance_config,
+            config_set=self._apply_maintenance_config,
+        )
         self._grpc = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
         rpc.add_service(self._grpc, rpc.MASTER_SERVICE, self.service)
         rpc.add_service(self._grpc, rpc.WORKER_SERVICE, self.worker_control)
@@ -716,6 +720,53 @@ class MasterServer:
             do_POST = do_GET
 
         return Handler
+
+    # ------------------------------------------------- maintenance config
+
+    def _maintenance_config(self) -> dict:
+        return {
+            "ec_auto_fullness": self.ec_auto_fullness,
+            "ec_quiet_seconds": self.ec_quiet_seconds,
+            "garbage_threshold": self.garbage_threshold,
+            "vacuum_interval_seconds": self.vacuum_interval,
+        }
+
+    def _apply_maintenance_config(self, cfg: dict) -> None:
+        """Live-apply tuned policy: every knob is re-read each loop
+        iteration, so no restart is needed. Validation here fails the
+        whole update — a half-applied policy is worse than none."""
+        import math
+
+        # isfinite first: NaN slips through comparison-based range
+        # checks ('quiet < 0' is False for NaN) and a NaN vacuum
+        # interval turns _vacuum_loop into a hot busy-spin.
+        for key in (
+            "ec_auto_fullness",
+            "ec_quiet_seconds",
+            "garbage_threshold",
+            "vacuum_interval_seconds",
+        ):
+            if not math.isfinite(cfg.get(key, 0.0)):
+                raise ValueError(f"{key} must be finite, got {cfg.get(key)}")
+        full = cfg.get("ec_auto_fullness", 0.0)
+        if not (0.0 <= full <= 1.0):
+            raise ValueError(f"ec_auto_fullness must be in [0,1], got {full}")
+        thresh = cfg.get("garbage_threshold", 0.0)
+        if not (0.0 < thresh <= 1.0):
+            raise ValueError(
+                f"garbage_threshold must be in (0,1], got {thresh}"
+            )
+        quiet = cfg.get("ec_quiet_seconds", 0.0)
+        interval = cfg.get("vacuum_interval_seconds", 0.0)
+        if quiet < 0 or interval <= 0:
+            raise ValueError(
+                "ec_quiet_seconds must be >=0 and "
+                f"vacuum_interval_seconds >0 (got {quiet}, {interval})"
+            )
+        self.ec_auto_fullness = full
+        self.ec_quiet_seconds = quiet
+        self.garbage_threshold = thresh
+        self.vacuum_interval = interval
 
     # ----------------------------------------------------------- vacuum
 

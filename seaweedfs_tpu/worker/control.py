@@ -52,10 +52,18 @@ TASK_RETENTION = 1000  # terminal tasks kept for task.list history
 class WorkerControl:
     """Registry + queue + dispatcher; also the gRPC servicer."""
 
-    def __init__(self, topo=None):
+    def __init__(self, topo=None, config_get=None, config_set=None):
         """topo: the master Topology, used to resolve volume collections
-        and scan for maintenance candidates."""
+        and scan for maintenance candidates.
+
+        config_get/config_set: callbacks the hosting master wires in so
+        the admin plane can read/tune maintenance policy over gRPC
+        (reference admin/maintenance config_schema.go). config_get() ->
+        dict of MaintenanceConfig fields; config_set(dict) applies them
+        live."""
         self.topo = topo
+        self.config_get = config_get
+        self.config_set = config_set
         self._lock = threading.Condition()
         self._workers: dict[str, _Worker] = {}
         self._tasks: dict[str, _Task] = {}
@@ -279,6 +287,48 @@ class WorkerControl:
                     )
                 ]
             )
+
+    def ListWorkers(self, request, context):
+        workers, _ = self.snapshot()
+        return wk.ListWorkersResponse(
+            workers=[
+                wk.WorkerInfo(
+                    worker_id=w["worker_id"],
+                    capabilities=w["capabilities"],
+                    backend=w["backend"],
+                    active=w["active"],
+                    max_concurrent=w["max_concurrent"],
+                )
+                for w in workers
+            ]
+        )
+
+    def GetMaintenanceConfig(self, request, context):
+        cfg = self.config_get() if self.config_get else {}
+        return wk.MaintenanceConfig(**cfg)
+
+    def SetMaintenanceConfig(self, request, context):
+        if self.config_set is None or self.config_get is None:
+            return wk.SetMaintenanceConfigResponse(
+                error="maintenance config not wired on this master"
+            )
+        # Read-modify-write: fields absent from the request keep their
+        # current value (proto3 optional presence) — a client tuning one
+        # knob must not silently zero the others.
+        cfg = dict(self.config_get())
+        for key in (
+            "ec_auto_fullness",
+            "ec_quiet_seconds",
+            "garbage_threshold",
+            "vacuum_interval_seconds",
+        ):
+            if request.HasField(key):
+                cfg[key] = getattr(request, key)
+        try:
+            self.config_set(cfg)
+        except ValueError as e:
+            return wk.SetMaintenanceConfigResponse(error=str(e))
+        return wk.SetMaintenanceConfigResponse()
 
     def snapshot(self) -> tuple[list[dict], list[dict]]:
         """(workers, tasks) rows for status UIs — the public view, so
