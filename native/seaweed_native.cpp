@@ -18,6 +18,11 @@
 #include <cstdint>
 #include <cstring>
 #include <cstddef>
+#include <cerrno>
+#include <thread>
+#include <vector>
+
+#include <unistd.h>
 
 #if defined(__x86_64__)
 #include <immintrin.h>
@@ -218,6 +223,125 @@ void sn_rs_apply(const uint8_t* coeffs, int out_rows, int in_rows,
 uint8_t sn_gf_mul(uint8_t a, uint8_t b) {
     gf_init();
     return gf_mul_table[a][b];
+}
+
+// Column-parallel sn_rs_apply: splits the n columns across `nthreads`
+// worker threads (parity is columnwise-independent, so any column split
+// is bit-exact). Callers via ctypes release the GIL for the whole call.
+void sn_rs_apply_mt(const uint8_t* coeffs, int out_rows, int in_rows,
+                    const uint8_t* data, uint8_t* out, size_t n,
+                    int nthreads) {
+    gf_init();
+    if (nthreads <= 1 || n < (1u << 16)) {
+        sn_rs_apply(coeffs, out_rows, in_rows, data, out, n);
+        return;
+    }
+    size_t chunk = (n + (size_t)nthreads - 1) / (size_t)nthreads;
+    chunk = (chunk + 63) & ~(size_t)63;  // cache-line align column splits
+    std::vector<std::thread> ts;
+    for (size_t lo = 0; lo < n; lo += chunk) {
+        size_t w = (lo + chunk <= n) ? chunk : (n - lo);
+        ts.emplace_back([=]() {
+            // Strided rows: copy each row slice into a contiguous scratch?
+            // No — sn_rs_apply reads rows at data + j*n; a column window
+            // needs per-row offsets, so inline the loop here instead.
+            for (int r = 0; r < out_rows; r++) {
+                uint8_t* dst = out + (size_t)r * n + lo;
+                memset(dst, 0, w);
+                for (int j = 0; j < in_rows; j++) {
+                    uint8_t c = coeffs[r * in_rows + j];
+                    if (c == 0) continue;
+                    const uint8_t* src = data + (size_t)j * n + lo;
+                    if (c == 1) xor_into(src, dst, w);
+                    else gf_mul_xor(c, src, dst, w);
+                }
+            }
+        });
+    }
+    for (auto& t : ts) t.join();
+}
+
+// ---------------------------------------------------------------------------
+// Fused shard append + rolling block-CRC32C (the EC encoder's write stage).
+// One call per batch replaces, per shard, a Python tobytes() copy + a
+// buffered write + a bytes-slicing CRC loop — the 87%-of-wall host overhead
+// measured in BENCH_r03. Mirrors the reference's single-pass encode+CRC
+// loop (weed/storage/erasure_coding/ec_encoder.go:427-461).
+// ---------------------------------------------------------------------------
+
+static int write_full(int fd, const uint8_t* p, size_t len) {
+    while (len) {
+        ssize_t w = write(fd, p, len);
+        if (w < 0) {
+            if (errno == EINTR) continue;
+            return -1;
+        }
+        p += w;
+        len -= (size_t)w;
+    }
+    return 0;
+}
+
+// Advance one shard's rolling block-CRC state over `len` bytes; completed
+// block CRCs append to out (capacity max_out). Returns count added, -1 on
+// overflow.
+static int roll_crc_blocks(uint32_t* crc, uint64_t* filled, uint32_t block_size,
+                           const uint8_t* p, size_t len, uint32_t* out,
+                           int32_t max_out) {
+    int added = 0;
+    while (len) {
+        size_t room = (size_t)block_size - (size_t)*filled;
+        size_t take = len < room ? len : room;
+        *crc = sn_crc32c(*crc, p, take);
+        *filled += take;
+        p += take;
+        len -= take;
+        if (*filled == block_size) {
+            if (added >= max_out) return -1;
+            out[added++] = *crc;
+            *crc = 0;
+            *filled = 0;
+        }
+    }
+    return added;
+}
+
+// Append `width` bytes from rows[i] to fds[i] and roll shard i's CRC state,
+// for all nrows shards, one worker thread per shard (CRC while the bytes
+// are cache-hot, then write(2) straight from the source buffer — no
+// intermediate copies). crc_state/filled_state persist across calls;
+// completed block CRCs land at out_crcs[i*max_out..], counts in
+// out_counts[i]. Returns 0, or -(i+1) for the first failed shard.
+int sn_shard_append(const int* fds, const uint8_t* const* rows, int nrows,
+                    size_t width, uint32_t block_size, uint32_t* crc_state,
+                    uint64_t* filled_state, uint32_t* out_crcs,
+                    int32_t* out_counts, int32_t max_out) {
+    crc32c_table_init();
+    std::vector<int> status((size_t)nrows, 0);
+    auto work = [&](int i) {
+        int added = roll_crc_blocks(&crc_state[i], &filled_state[i], block_size,
+                                    rows[i], width,
+                                    out_crcs + (size_t)i * (size_t)max_out,
+                                    max_out);
+        if (added < 0) {
+            out_counts[i] = 0;
+            status[i] = -1;
+            return;
+        }
+        out_counts[i] = added;
+        if (write_full(fds[i], rows[i], width) != 0) status[i] = -1;
+    };
+    if (nrows > 1 && std::thread::hardware_concurrency() > 1) {
+        std::vector<std::thread> ts;
+        ts.reserve((size_t)nrows);
+        for (int i = 0; i < nrows; i++) ts.emplace_back(work, i);
+        for (auto& t : ts) t.join();
+    } else {
+        for (int i = 0; i < nrows; i++) work(i);
+    }
+    for (int i = 0; i < nrows; i++)
+        if (status[i] != 0) return -(i + 1);
+    return 0;
 }
 
 // ---------------------------------------------------------------------------

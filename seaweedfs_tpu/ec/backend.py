@@ -118,17 +118,31 @@ class CpuBackend(_BackendBase):
     """Native C++ SIMD GF(2^8); falls back to numpy tables if the .so
     is unavailable."""
 
+    # Below this width, thread spawn overhead beats the win from
+    # splitting columns; single-interval read recovery stays 1-thread.
+    _MT_MIN_WIDTH = 1 << 20
+
     def __init__(self, ctx: ECContext):
         super().__init__(ctx)
         try:
             from ..utils import native
 
             self._apply_fn = native.rs_apply
+            self._apply_mt = getattr(native, "rs_apply_mt", None)
         except Exception:
             self._apply_fn = gf256.matrix_apply
+            self._apply_mt = None
 
     def apply(self, coeffs: np.ndarray, data: np.ndarray) -> np.ndarray:
-        return self._apply_fn(np.asarray(coeffs, np.uint8), np.asarray(data, np.uint8))
+        coeffs = np.asarray(coeffs, np.uint8)
+        data = np.asarray(data, np.uint8)
+        if (
+            self._apply_mt is not None
+            and data.ndim == 2
+            and data.shape[1] >= self._MT_MIN_WIDTH
+        ):
+            return self._apply_mt(coeffs, data)
+        return self._apply_fn(coeffs, data)
 
     def encode(self, data: np.ndarray) -> np.ndarray:
         return self.apply(self._ref.parity, data)

@@ -25,6 +25,7 @@ from ..storage.needle_map import MemDb
 from .backend import RSBackend, get_backend
 from .bitrot import BitrotProtection, ShardChecksumBuilder
 from .context import (
+    BITROT_BLOCK_SIZE,
     LARGE_BLOCK_SIZE,
     SMALL_BLOCK_SIZE,
     DEFAULT_EC_CONTEXT,
@@ -37,12 +38,109 @@ DEFAULT_BATCH = 16 * 1024 * 1024
 
 
 def _pread_padded(fd: int, buf: np.ndarray, offset: int) -> None:
-    """Fill `buf` from fd at `offset`, zero-padding past EOF."""
-    got = os.pread(fd, len(buf), offset)
-    n = len(got)
-    buf[:n] = np.frombuffer(got, dtype=np.uint8)
-    if n < len(buf):
-        buf[n:] = 0
+    """Fill `buf` from fd at `offset` IN PLACE (no intermediate bytes
+    object), zero-padding past EOF."""
+    mv = memoryview(buf)
+    filled = 0
+    want = len(buf)
+    while filled < want:
+        got = os.preadv(fd, [mv[filled:]], offset + filled)
+        if got == 0:
+            break
+        filled += got
+    if filled < want:
+        buf[filled:] = 0
+
+
+class _FusedShardSink:
+    """Write stage backed by the native fused append+CRC
+    (sn_shard_append): one GIL-releasing C++ call per batch, a worker
+    thread per shard, CRC32C rolled while the bytes are cache-hot,
+    write(2) straight from the source buffers — no tobytes()/slice
+    copies. This is what closes the BENCH_r03 finding that 87% of e2e
+    wall time was host-side overhead (reference equivalent: the single
+    fused encode+CRC loop in weed/storage/erasure_coding/ec_encoder.go)."""
+
+    def __init__(self, files: list, block_size: int = BITROT_BLOCK_SIZE):
+        from ..utils import native
+
+        self._native = native
+        self.fds = [f.fileno() for f in files]
+        n = len(files)
+        self.block_size = block_size
+        self.crc_state = np.zeros(n, np.uint32)
+        self.filled = np.zeros(n, np.uint64)
+        self.crcs: list[list[int]] = [[] for _ in range(n)]
+        self.sizes = [0] * n
+        self._out_counts = np.empty(n, np.int32)
+        self._out_crcs: np.ndarray | None = None
+
+    def append(self, data: np.ndarray, parity: np.ndarray) -> None:
+        # Row-pointer math below requires C-contiguous uint8 (no-op when
+        # already so, which the reader/backends guarantee).
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        parity = np.ascontiguousarray(parity, dtype=np.uint8)
+        width = data.shape[1]
+        if parity.shape[1] != width:
+            raise ECError(
+                f"parity width {parity.shape[1]} != data width {width}"
+            )
+        max_out = width // self.block_size + 2
+        if self._out_crcs is None or self._out_crcs.shape[1] < max_out:
+            self._out_crcs = np.empty((len(self.fds), max_out), np.uint32)
+        rows = [data.ctypes.data + i * width for i in range(data.shape[0])]
+        rows += [parity.ctypes.data + j * width for j in range(parity.shape[0])]
+        self._native.shard_append(
+            self.fds,
+            rows,
+            width,
+            self.block_size,
+            self.crc_state,
+            self.filled,
+            self._out_crcs,
+            self._out_counts,
+        )
+        for i in range(len(self.fds)):
+            c = int(self._out_counts[i])
+            if c:
+                self.crcs[i].extend(int(x) for x in self._out_crcs[i, :c])
+            self.sizes[i] += width
+
+    def finish(self, ctx: ECContext) -> BitrotProtection:
+        import uuid as _uuid
+
+        for i in range(len(self.fds)):
+            if self.filled[i]:
+                self.crcs[i].append(int(self.crc_state[i]))
+                self.filled[i] = 0
+                self.crc_state[i] = 0
+        return BitrotProtection(
+            ctx=ctx,
+            block_size=self.block_size,
+            uuid=_uuid.uuid4().bytes,
+            shard_sizes=list(self.sizes),
+            shard_crcs=[list(c) for c in self.crcs],
+        )
+
+
+class _PyShardSink:
+    """Pure-Python fallback write stage (native .so unavailable)."""
+
+    def __init__(self, files: list, block_size: int = BITROT_BLOCK_SIZE):
+        self.files = files
+        self.builders = [ShardChecksumBuilder(block_size) for _ in files]
+
+    def append(self, data: np.ndarray, parity: np.ndarray) -> None:
+        k = data.shape[0]
+        for i, f in enumerate(self.files):
+            b = (data[i] if i < k else parity[i - k]).tobytes()
+            mv = memoryview(b)
+            while mv:  # raw FileIO may short-write
+                mv = mv[f.write(mv) :]
+            self.builders[i].write(b)
+
+    def finish(self, ctx: ECContext) -> BitrotProtection:
+        return BitrotProtection.from_builders(ctx, self.builders)
 
 
 def write_sorted_file_from_idx(base: str, ext: str = ".ecx") -> None:
@@ -68,11 +166,17 @@ def write_ec_files(
     k, total = ctx.data_shards, ctx.total
 
     dat_fd = os.open(base + ".dat", os.O_RDONLY)
-    builders = [ShardChecksumBuilder() for _ in range(total)]
     outputs: list = []
     try:
         for i in range(total):
-            outputs.append(open(base + ctx.to_ext(i), "wb"))
+            # buffering=0: the fused native sink writes via raw fds; the
+            # Python fallback writes whole >=1MiB batches, where a
+            # userspace buffer adds a copy and saves nothing.
+            outputs.append(open(base + ctx.to_ext(i), "wb", buffering=0))
+        try:
+            sink: _FusedShardSink | _PyShardSink = _FusedShardSink(outputs)
+        except Exception:
+            sink = _PyShardSink(outputs)
         dat_size = os.fstat(dat_fd).st_size
         large_row = large_block_size * k
         small_row = small_block_size * k
@@ -146,11 +250,10 @@ def write_ec_files(
                     # Blocks until the device result is ready — while it
                     # does, the main thread keeps dispatching H2D+encode
                     # for the batches queued behind this one.
-                    parity = backend.to_host(parity_handle)
-                    for i in range(total):
-                        b = (data[i] if i < k else parity[i - k]).tobytes()
-                        outputs[i].write(b)
-                        builders[i].write(b)
+                    parity = np.ascontiguousarray(
+                        backend.to_host(parity_handle), dtype=np.uint8
+                    )
+                    sink.append(data, parity)
             except BaseException as e:  # pragma: no cover - disk errors
                 errors.append(e)
                 abort.set()
@@ -196,26 +299,37 @@ def write_ec_files(
                 except _queue.Empty:
                     pass
             write_q.put(None)
-            rt.join(timeout=60)
-            wt.join(timeout=60)
+            # Join bound: up to ~4 batches can still be draining (one in
+            # to_host, two queued, one dispatched); allow each 16 MiB/s
+            # of slow-disk write plus a fixed device-fetch allowance.
+            join_timeout = 60.0 + 4.0 * batch_size / (16 << 20)
+            rt.join(timeout=join_timeout)
+            wt.join(timeout=join_timeout)
             if rt.is_alive() or wt.is_alive():  # pragma: no cover
                 # A stuck thread (e.g. the writer wedged in a device
                 # to_host against a hung TPU relay) means the shard
                 # files are TRUNCATED but the CRC builders are
                 # self-consistent with the truncation — returning
                 # success here would publish undetectable data loss.
+                # Chain the root cause so it isn't masked.
                 abort.set()
                 raise ECError(
                     "ec encode pipeline thread did not finish "
                     f"(reader alive={rt.is_alive()}, writer alive="
                     f"{wt.is_alive()}); shards are incomplete"
-                )
+                ) from (errors[0] if errors else None)
         if errors:
             raise errors[0]
 
+        # Durability barrier. Flushes are issued in parallel: on a real
+        # disk array the 14 shard files' dirty pages drain concurrently
+        # instead of serializing 14 round-trips.
+        from concurrent.futures import ThreadPoolExecutor as _TPE
+
         for f in outputs:
             f.flush()
-            os.fsync(f.fileno())
+        with _TPE(max_workers=len(outputs)) as ex:
+            list(ex.map(lambda f: os.fsync(f.fileno()), outputs))
     finally:
         os.close(dat_fd)
         for f in outputs:
@@ -223,7 +337,7 @@ def write_ec_files(
     from ..utils.fs import fsync_dir
 
     fsync_dir(base + ".dat")
-    return BitrotProtection.from_builders(ctx, builders)
+    return sink.finish(ctx)
 
 
 def ec_encode_volume(

@@ -32,7 +32,7 @@ if not os.path.exists(_SO_PATH):
 _lib = ctypes.CDLL(_SO_PATH)
 
 _lib.sn_crc32c.restype = ctypes.c_uint32
-_lib.sn_crc32c.argtypes = [ctypes.c_uint32, ctypes.c_char_p, ctypes.c_size_t]
+_lib.sn_crc32c.argtypes = [ctypes.c_uint32, ctypes.c_void_p, ctypes.c_size_t]
 _lib.sn_rs_apply.restype = None
 _lib.sn_rs_apply.argtypes = [
     ctypes.c_char_p,
@@ -44,6 +44,29 @@ _lib.sn_rs_apply.argtypes = [
 ]
 _lib.sn_gf_mul.restype = ctypes.c_uint8
 _lib.sn_gf_mul.argtypes = [ctypes.c_uint8, ctypes.c_uint8]
+_lib.sn_rs_apply_mt.restype = None
+_lib.sn_rs_apply_mt.argtypes = [
+    ctypes.c_char_p,
+    ctypes.c_int,
+    ctypes.c_int,
+    ctypes.c_void_p,
+    ctypes.c_void_p,
+    ctypes.c_size_t,
+    ctypes.c_int,
+]
+_lib.sn_shard_append.restype = ctypes.c_int
+_lib.sn_shard_append.argtypes = [
+    ctypes.POINTER(ctypes.c_int),
+    ctypes.POINTER(ctypes.c_void_p),
+    ctypes.c_int,
+    ctypes.c_size_t,
+    ctypes.c_uint32,
+    ctypes.c_void_p,
+    ctypes.c_void_p,
+    ctypes.c_void_p,
+    ctypes.c_void_p,
+    ctypes.c_int32,
+]
 _lib.sn_has_avx2.restype = ctypes.c_int
 _lib.sn_scan_dat.restype = ctypes.c_int64
 _lib.sn_scan_dat.argtypes = [
@@ -57,11 +80,15 @@ _lib.sn_scan_dat.argtypes = [
 
 
 def crc32c(data, crc: int = 0) -> int:
-    if isinstance(data, np.ndarray):
-        data = data.tobytes()
-    elif isinstance(data, (bytearray, memoryview)):
-        data = bytes(data)
-    return _lib.sn_crc32c(crc, data, len(data))
+    """Zero-copy over bytes/ndarray/memoryview/bytearray (buffer protocol)."""
+    if isinstance(data, bytes):
+        return _lib.sn_crc32c(crc, data, len(data))
+    if not isinstance(data, np.ndarray):
+        data = np.frombuffer(data, dtype=np.uint8)  # zero-copy view
+    data = np.ascontiguousarray(data)
+    return _lib.sn_crc32c(
+        crc, ctypes.c_void_p(data.ctypes.data), data.nbytes
+    )
 
 
 def rs_apply(coeffs: np.ndarray, data: np.ndarray) -> np.ndarray:
@@ -82,6 +109,69 @@ def rs_apply(coeffs: np.ndarray, data: np.ndarray) -> np.ndarray:
         n,
     )
     return out
+
+
+def rs_apply_mt(coeffs: np.ndarray, data: np.ndarray, threads: int = 0) -> np.ndarray:
+    """rs_apply with columns split across `threads` workers (0 = all cores).
+    Bit-exact vs rs_apply: parity is columnwise-independent."""
+    coeffs = np.ascontiguousarray(coeffs, dtype=np.uint8)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    out_rows, in_rows = coeffs.shape
+    if data.shape[0] != in_rows:
+        raise ValueError(f"coeffs expect {in_rows} rows, got {data.shape[0]}")
+    if threads <= 0:
+        threads = os.cpu_count() or 1
+    n = data.shape[1]
+    out = np.empty((out_rows, n), dtype=np.uint8)
+    _lib.sn_rs_apply_mt(
+        coeffs.tobytes(),
+        out_rows,
+        in_rows,
+        data.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p),
+        n,
+        threads,
+    )
+    return out
+
+
+def shard_append(
+    fds: list[int],
+    row_ptrs: list[int],
+    width: int,
+    block_size: int,
+    crc_state: np.ndarray,
+    filled_state: np.ndarray,
+    out_crcs: np.ndarray,
+    out_counts: np.ndarray,
+) -> None:
+    """Fused batch append: write `width` bytes from row_ptrs[i] to fds[i]
+    and roll shard i's block-CRC32C state — one GIL-releasing call per
+    batch, a worker thread per shard, no Python-side copies.
+
+    crc_state (u32[n]) / filled_state (u64[n]) carry across calls;
+    completed block CRCs land in out_crcs (u32[n, max_out]) with counts
+    in out_counts (i32[n]). Raises OSError on any shard write failure.
+    """
+    n = len(fds)
+    assert len(row_ptrs) == n
+    assert crc_state.dtype == np.uint32 and filled_state.dtype == np.uint64
+    assert out_crcs.dtype == np.uint32 and out_crcs.flags.c_contiguous
+    assert out_counts.dtype == np.int32
+    rc = _lib.sn_shard_append(
+        (ctypes.c_int * n)(*fds),
+        (ctypes.c_void_p * n)(*row_ptrs),
+        n,
+        width,
+        block_size,
+        ctypes.c_void_p(crc_state.ctypes.data),
+        ctypes.c_void_p(filled_state.ctypes.data),
+        ctypes.c_void_p(out_crcs.ctypes.data),
+        ctypes.c_void_p(out_counts.ctypes.data),
+        out_crcs.shape[1],
+    )
+    if rc != 0:
+        raise OSError(f"sn_shard_append failed on shard {-rc - 1}")
 
 
 def gf_mul(a: int, b: int) -> int:
