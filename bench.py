@@ -39,9 +39,11 @@ import numpy as np
 
 K, M = 10, 4
 BLOCK = 32 << 20  # bytes per data shard => 320 MiB data per kernel pass
+SMALL_WIDTH = 1 << 22  # first-landing kernel stage: seconds, not minutes
 REPS = 3  # distinct input buffers, one per timed rep
 SEEDS = [0x5EAD + i for i in range(REPS)]
-VERIFY_WIDTHS = [1 << 20, 1 << 23, BLOCK]  # slice widths child may use
+# slice widths a kernel stage may use (CPU truth precomputed for each)
+VERIFY_WIDTHS = [1 << 20, SMALL_WIDTH, 1 << 23, BLOCK]
 
 # Advertised HBM bandwidth ceilings (GB/s) by device_kind substring.
 # Generous: used only to flag IMPOSSIBLE numbers, not to grade real ones.
@@ -161,15 +163,54 @@ def _cpu_e2e(base: str) -> tuple[float, list[list[int]], int]:
 
 
 # --------------------------------------------------------------------------
-# Device phase (watchdogged subprocess: a dead TPU relay hangs jax init
-# in C forever; the parent enforces a timeout around this child)
+# Device phase: INDEPENDENTLY WATCHDOGGED STAGES, each in its own
+# subprocess, each persisting its JSON fragment to disk the moment it
+# completes — a later hang can never erase earlier evidence. The known
+# failure mode (3 rounds of it) is a flaky axon relay that hangs jax
+# init in C forever; the probe stage retries with backoff to catch the
+# relay waking up, and every stage records its rc/duration/attempts
+# into the final line's `stages` trail.
 # --------------------------------------------------------------------------
+
+STAGE_TIMEOUTS = {
+    "probe": 150.0,
+    "kernel_small": 240.0,
+    "kernel_full": 300.0,
+    "e2e": 600.0,
+}
+STAGE_ATTEMPTS = {"probe": 3, "kernel_small": 2, "kernel_full": 1, "e2e": 1}
+STAGE_BACKOFF = 10.0  # seconds, grows linearly per retry
+
 
 class _AllImplsFailed(RuntimeError):
     pass
 
 
-def _device_kernel(expected: dict) -> dict:
+def _stage_probe() -> dict:
+    """Cheapest possible liveness check of the device path: jax init,
+    device list, one tiny executed op. Lands first so a later hang still
+    leaves the platform/device identity + init timing on record."""
+    import jax
+    import jax.numpy as jnp
+
+    t0 = time.perf_counter()
+    devs = jax.devices()
+    init_s = time.perf_counter() - t0
+    d = devs[0]
+    t0 = time.perf_counter()
+    val = int(np.asarray(jnp.arange(4096, dtype=jnp.int32).sum()))
+    tiny_s = time.perf_counter() - t0
+    return {
+        "platform": d.platform,
+        "kind": str(d.device_kind),
+        "n_devices": len(devs),
+        "init_s": round(init_s, 2),
+        "tiny_op_s": round(tiny_s, 2),
+        "tiny_ok": val == 4096 * 4095 // 2,
+    }
+
+
+def _device_kernel(expected: dict, width: int | None = None) -> dict:
     """Timed kernel micro-bench: distinct pre-staged inputs, CRC-verified
     outputs, and RELAY-PROOF timing.
 
@@ -189,7 +230,10 @@ def _device_kernel(expected: dict) -> dict:
 
     dev = jax.devices()[0]
     on_tpu = dev.platform not in ("cpu",)
-    width = BLOCK if on_tpu else 1 << 20
+    if width is None:
+        width = BLOCK if on_tpu else 1 << 20
+    if not on_tpu:
+        width = min(width, 1 << 20)
     impls = ["pallas", "pallas_aligned", "xla"] if on_tpu else ["xla"]
     forced_impl = os.environ.get("SEAWEED_BENCH_IMPL")
     if forced_impl:
@@ -323,7 +367,9 @@ def _device_e2e(base: str, expected_crcs: list[list[int]], dat_size: int) -> dic
     return result
 
 
-def _device_phase_child(workdir: str) -> None:
+def _stage_child(name: str, workdir: str) -> None:
+    """Run one device stage and persist its fragment ATOMICALLY before
+    exiting; the parent reads the file, never this process's stdout."""
     forced = os.environ.get("SEAWEED_BENCH_PLATFORM")
     if forced:
         import jax
@@ -333,70 +379,115 @@ def _device_phase_child(workdir: str) -> None:
     with open(os.path.join(workdir, "verify.json")) as f:
         verify = json.load(f)
     try:
-        result = _device_kernel(verify["kernel_crcs"])
-    except _AllImplsFailed as e:
-        print(json.dumps({"error": "kernel_compile_failed", "detail": str(e)[:2000]}))
-        return
-    if result["platform"] not in ("cpu",):
-        try:
-            result.update(
-                _device_e2e(
-                    verify["volume_base"],
-                    verify["shard_crcs"],
-                    verify["dat_size"],
-                )
+        if name == "probe":
+            result = _stage_probe()
+        elif name == "kernel_small":
+            result = _device_kernel(verify["kernel_crcs"], width=SMALL_WIDTH)
+        elif name == "kernel_full":
+            result = _device_kernel(verify["kernel_crcs"], width=BLOCK)
+        elif name == "e2e":
+            result = _device_e2e(
+                verify["volume_base"], verify["shard_crcs"], verify["dat_size"]
             )
-        except Exception as e:  # noqa: BLE001 — e2e failure is evidence too
-            result["e2e_error"] = repr(e)[:1000]
-    print(json.dumps(result))
+        else:
+            result = {"error": f"unknown stage {name}"}
+    except _AllImplsFailed as e:
+        result = {"error": "kernel_compile_failed", "detail": str(e)[:2000]}
+    except Exception as e:  # noqa: BLE001 — the failure IS the evidence
+        result = {"error": type(e).__name__, "detail": repr(e)[:2000]}
+    tmp = os.path.join(workdir, f".stage_{name}.tmp")
+    with open(tmp, "w") as f:
+        json.dump(result, f)
+    os.replace(tmp, os.path.join(workdir, f"stage_{name}.json"))
 
 
-def _device_phase(workdir: str) -> dict | str:
-    """Run the device work in a watchdogged subprocess. Returns the child's
-    result dict, or a reason string ("device_hung" = relay unreachable,
-    "kernel_compile_failed", "device_error_rcN")."""
+def _run_stage(name: str, workdir: str, remaining) -> dict:
+    """Run stage `name` in a watchdogged subprocess, retrying with
+    backoff. Returns the child's persisted fragment merged with the
+    parent-side attempt trail ({_rc, _s, _attempts})."""
     import subprocess
 
-    try:
-        timeout = float(os.environ.get("SEAWEED_BENCH_DEVICE_TIMEOUT", "900"))
-    except ValueError:
-        timeout = 900.0
-    try:
-        out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--device-phase", workdir],
-            capture_output=True,
-            text=True,
-            timeout=timeout,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
+    path = os.path.join(workdir, f"stage_{name}.json")
+    attempts = int(
+        os.environ.get(
+            f"SEAWEED_BENCH_{name.upper()}_ATTEMPTS", STAGE_ATTEMPTS[name]
         )
-    except subprocess.TimeoutExpired:
-        return "device_hung"
-    for line in out.stdout.splitlines():
-        if line.startswith("{"):
-            try:
-                d = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if "error" in d:
-                sys.stderr.write("bench device phase: " + json.dumps(d) + "\n")
-                return d["error"]
-            if "kernel_gbs" not in d:
-                continue  # brace-prefixed runtime log noise, not the result
-            return d
-    sys.stderr.write(
-        f"bench device phase failed (rc={out.returncode}):\n"
-        + out.stderr[-2000:]
-        + "\n"
     )
-    return f"device_error_rc{out.returncode}"
+    trail: list[dict] = []
+    for attempt in range(attempts):
+        budget = remaining()
+        timeout = min(STAGE_TIMEOUTS[name], budget)
+        if timeout < 20:
+            return {"skipped": "budget_exhausted", "_attempts": trail}
+        t0 = time.perf_counter()
+        rc: int | str
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--stage", name, workdir],
+                capture_output=True,
+                text=True,
+                timeout=timeout,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            rc = out.returncode
+            if out.stderr:
+                sys.stderr.write(
+                    f"bench[{name}#{attempt}] stderr: {out.stderr[-1500:]}\n"
+                )
+        except subprocess.TimeoutExpired:
+            rc = "timeout"
+        trail.append({"rc": rc, "s": round(time.perf_counter() - t0, 1)})
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    result = json.load(f)
+            except (OSError, json.JSONDecodeError) as e:
+                result = {"error": f"fragment_unreadable: {e!r}"}
+            if "error" in result and attempt + 1 < attempts:
+                # A fast in-child failure (e.g. relay refusing
+                # connections rather than hanging) deserves the same
+                # retry-with-backoff as a hang — the relay may wake.
+                trail[-1]["error"] = str(result["error"])[:200]
+                os.unlink(path)
+            else:
+                result["_attempts"] = trail
+                return result
+        if attempt + 1 < attempts:
+            backoff = min(STAGE_BACKOFF * (attempt + 1), max(remaining(), 0))
+            time.sleep(backoff)
+    return {
+        "error": "device_hung" if trail and trail[-1]["rc"] == "timeout" else "no_fragment",
+        "_attempts": trail,
+    }
 
 
 # --------------------------------------------------------------------------
 
+def _disk_write_gbs(workdir: str, nbytes: int = 256 << 20) -> float:
+    """Measured write+fsync ceiling of the bench volume's disk — context
+    for the e2e number: once host overhead is gone, e2e is bound by
+    min(disk, kernel) and the line should say which."""
+    path = os.path.join(workdir, "disk_probe.bin")
+    buf = np.random.default_rng(1).integers(0, 256, size=1 << 22, dtype=np.uint8)
+    b = buf.tobytes()
+    t0 = time.perf_counter()
+    with open(path, "wb") as f:
+        for _ in range(nbytes // len(b)):
+            f.write(b)
+        f.flush()
+        os.fsync(f.fileno())
+    dt = time.perf_counter() - t0
+    os.unlink(path)
+    return nbytes / dt / 1e9
+
+
 def main() -> None:
-    if "--device-phase" in sys.argv:
-        _device_phase_child(sys.argv[sys.argv.index("--device-phase") + 1])
+    if "--stage" in sys.argv:
+        i = sys.argv.index("--stage")
+        _stage_child(sys.argv[i + 1], sys.argv[i + 2])
         return
+
+    import signal
 
     from seaweedfs_tpu.ops import gf256
 
@@ -405,11 +496,38 @@ def main() -> None:
     volume_mb = int(os.environ.get("SEAWEED_BENCH_VOLUME_MB", "1024"))
 
     workdir = tempfile.mkdtemp(prefix="seaweed_bench_")
+
+    # Best-so-far line, kept current as evidence lands: if the driver
+    # kills the bench (its timeout, not ours) we still emit one valid
+    # JSON line on the way out instead of nothing.
+    best: dict = {
+        "metric": "ec_encode_e2e_10p4_cpu_fallback(incomplete)",
+        "value": 0.0,
+        "vs_baseline": 0.0,
+        "unit": "GB/s",
+    }
+    emitted = False
+
+    def _emit() -> None:
+        nonlocal emitted
+        if not emitted:
+            emitted = True
+            print(json.dumps(best))
+            sys.stdout.flush()
+
+    def _on_term(signum, frame):  # noqa: ARG001
+        best["metric"] += f"(killed_sig{signum})"
+        _emit()
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _on_term)
+
     try:
         # ---- CPU truth + baseline ---------------------------------------
         cpu_kernel = _cpu_kernel_gbs(_gen(SEEDS[0], BLOCK), coeffs, threads)
         kernel_crcs = _expected_kernel_crcs(coeffs)
         base = _fabricate_volume(workdir, volume_mb << 20)
+        disk_gbs = _disk_write_gbs(workdir)
         cpu_e2e, shard_crcs, dat_size = _cpu_e2e(base)
         _clear_shards(base)  # device phase re-encodes the same volume
 
@@ -424,93 +542,133 @@ def main() -> None:
                 f,
             )
 
-        dev = _device_phase(workdir)
         common = {
             "unit": "GB/s",
             "threads": threads,
             "volume_gib": round(dat_size / (1 << 30), 3),
             "cpu_e2e_gbs": round(cpu_e2e, 3),
             "cpu_kernel_gbs": round(cpu_kernel, 3),
+            # Honest derating context (north-star baseline is a 16-core
+            # host; this one has `threads`): linear-scaling estimate.
+            "cpu_kernel_16core_est_gbs": round(cpu_kernel / threads * 16, 3),
+            "disk_write_gbs": round(disk_gbs, 3),
         }
-        if isinstance(dev, str):  # unreachable/hung/errored: CPU-only line
-            print(
-                json.dumps(
-                    {
-                        "metric": f"ec_encode_e2e_10p4_cpu_fallback({dev})",
-                        "value": round(cpu_e2e, 3),
-                        "vs_baseline": 1.0,
-                        **common,
-                    }
-                )
-            )
-            return
+        best.update(
+            {
+                "metric": "ec_encode_e2e_10p4_cpu_fallback(device_pending)",
+                "value": round(cpu_e2e, 3),
+                "vs_baseline": 1.0,
+                **common,
+            }
+        )
 
-        if dev.get("failures"):
-            sys.stderr.write(
-                "bench: impls that failed before the winner: "
-                + json.dumps(dev["failures"])
-                + "\n"
-            )
+        # ---- device stages ----------------------------------------------
+        try:
+            budget = float(os.environ.get("SEAWEED_BENCH_DEVICE_TIMEOUT", "1200"))
+        except ValueError:
+            budget = 1200.0
+        deadline = time.monotonic() + budget
+        remaining = lambda: deadline - time.monotonic()  # noqa: E731
 
-        kind = dev.get("kind", "?")
-        extras = {
-            "kernel_gbs": round(dev.get("kernel_gbs", 0.0), 3),
-            "kernel_impl": dev.get("kernel_impl"),
-            "kernel_verified": dev.get("kernel_verified"),
-            "kernel_suspect": dev.get("kernel_suspect"),
-            "kernel_vs_cpu": round(dev.get("kernel_gbs", 0.0) / cpu_kernel, 3),
-            **common,
-        }
-        if "e2e_gbs" in dev:
-            if not dev.get("e2e_verified", False):
-                print(
-                    json.dumps(
-                        {
-                            "metric": f"ec_encode_e2e_10p4_MISMATCH[{kind}]",
-                            "value": 0.0,
-                            "vs_baseline": 0.0,
-                            **extras,
-                        }
-                    )
-                )
-                return
-            print(
-                json.dumps(
-                    {
-                        "metric": (
-                            f"ec_encode_e2e_10p4[{kind}/{dev.get('kernel_impl')}"
-                            f" vs {threads}-thread avx2 cpu, bit-exact]"
-                        ),
-                        "value": round(dev["e2e_gbs"], 3),
-                        "vs_baseline": round(dev["e2e_gbs"] / cpu_e2e, 3),
-                        "rebuild_volume_gbs": round(
-                            dev.get("rebuild_volume_gbs", 0.0), 3
-                        ),
-                        "rebuild_error": dev.get("rebuild_error"),
-                        **extras,
-                    }
-                )
-            )
-            return
-        # Device reachable but e2e unavailable (cpu platform child or e2e
-        # error): report the honest state — kernel number only, flagged.
-        reason = dev.get("e2e_error", f"platform={dev.get('platform')}")
-        print(
-            json.dumps(
+        stages: dict[str, dict] = {}
+        best["stages"] = stages
+
+        probe = _run_stage("probe", workdir, remaining)
+        stages["probe"] = probe
+        on_tpu = probe.get("platform") not in (None, "cpu")
+        kernel = None
+
+        if "platform" in probe:
+            ks = _run_stage("kernel_small", workdir, remaining)
+            stages["kernel_small"] = ks
+            if "kernel_gbs" in ks:
+                kernel = ks
+            if on_tpu and kernel is not None:
+                kf = _run_stage("kernel_full", workdir, remaining)
+                stages["kernel_full"] = kf
+                if "kernel_gbs" in kf:
+                    kernel = kf
+            if on_tpu:
+                e2e = _run_stage("e2e", workdir, remaining)
+                stages["e2e"] = e2e
+            else:
+                e2e = {"skipped": "cpu_platform"}
+        else:
+            e2e = {"skipped": "probe_failed"}
+
+        # ---- metric selection (best verified evidence wins) --------------
+        kind = probe.get("kind", "?")
+        if kernel is not None:
+            best.update(
                 {
-                    "metric": (
-                        f"rs_10p4_kernel_only[{kind}/{dev.get('kernel_impl')}]"
-                        f"(e2e_unavailable: {str(reason)[:120]})"
+                    "kernel_gbs": round(kernel.get("kernel_gbs", 0.0), 3),
+                    "kernel_impl": kernel.get("kernel_impl"),
+                    "kernel_verified": kernel.get("kernel_verified"),
+                    "kernel_suspect": kernel.get("kernel_suspect"),
+                    "kernel_width": kernel.get("kernel_width"),
+                    "kernel_vs_cpu": round(
+                        kernel.get("kernel_gbs", 0.0) / cpu_kernel, 3
                     ),
-                    "value": round(dev.get("kernel_gbs", 0.0), 3),
-                    "vs_baseline": round(
-                        dev.get("kernel_gbs", 0.0) / cpu_kernel, 3
+                    "kernel_vs_16core_est": round(
+                        kernel.get("kernel_gbs", 0.0)
+                        / (cpu_kernel / threads * 16),
+                        3,
                     ),
-                    **extras,
                 }
             )
-        )
+
+        if e2e.get("e2e_gbs") is not None and on_tpu:
+            impl = (kernel or {}).get("kernel_impl")
+            if not e2e.get("e2e_verified", False):
+                best.update(
+                    {
+                        "metric": f"ec_encode_e2e_10p4_MISMATCH[{kind}]",
+                        "value": 0.0,
+                        "vs_baseline": 0.0,
+                    }
+                )
+            else:
+                best.update(
+                    {
+                        "metric": (
+                            f"ec_encode_e2e_10p4[{kind}/{impl}"
+                            f" vs {threads}-thread avx2 cpu, bit-exact]"
+                        ),
+                        "value": round(e2e["e2e_gbs"], 3),
+                        "vs_baseline": round(e2e["e2e_gbs"] / cpu_e2e, 3),
+                        "rebuild_volume_gbs": round(
+                            e2e.get("rebuild_volume_gbs", 0.0), 3
+                        ),
+                        "rebuild_error": e2e.get("rebuild_error"),
+                    }
+                )
+        elif kernel is not None and on_tpu:
+            reason = e2e.get("error", e2e.get("skipped", "unavailable"))
+            best.update(
+                {
+                    "metric": (
+                        f"rs_10p4_kernel_only[{kind}/"
+                        f"{kernel.get('kernel_impl')}]"
+                        f"(e2e_unavailable: {str(reason)[:120]})"
+                    ),
+                    "value": round(kernel.get("kernel_gbs", 0.0), 3),
+                    "vs_baseline": round(
+                        kernel.get("kernel_gbs", 0.0) / cpu_kernel, 3
+                    ),
+                }
+            )
+        else:
+            reason = probe.get("error", probe.get("platform", "unknown"))
+            best.update(
+                {
+                    "metric": f"ec_encode_e2e_10p4_cpu_fallback({reason})",
+                    "value": round(cpu_e2e, 3),
+                    "vs_baseline": 1.0,
+                }
+            )
+        _emit()
     finally:
+        _emit()
         shutil.rmtree(workdir, ignore_errors=True)
 
 

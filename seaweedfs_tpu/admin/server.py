@@ -52,15 +52,22 @@ class AdminServer:
         ip: str = "localhost",
         port: int = 23646,
         config_path: str | None = None,
+        auth_token: str | None = None,
     ):
         """config_path: where maintenance policy persists (JSON). On
         start, a persisted policy is re-applied to the master — the
         reference keeps admin config in the filer for the same reason:
-        the policy must survive both admin and master restarts."""
+        the policy must survive both admin and master restarts.
+
+        auth_token: when set, every POST (task submission, config
+        editing) must carry `X-Admin-Token: <token>` — the analog of
+        the reference's adminUser/adminPassword option. GETs stay open
+        (read-only dashboards)."""
         self.master = master
         self.ip = ip
         self.port = port
         self.config_path = config_path
+        self.auth_token = auth_token
         self._channel = grpc.insecure_channel(_grpc_addr(master))
         self._master_stub = rpc.master_stub(self._channel)
         self._worker_stub = rpc.worker_stub(self._channel)
@@ -206,10 +213,20 @@ class AdminServer:
         return {k: getattr(cfg, k) for k in CONFIG_FIELDS}
 
     def _api_submit(self, body: dict) -> dict:
+        # The dashboard form sends volume_id: null for an empty field
+        # (parseInt NaN -> JSON null); reject it cleanly instead of
+        # crashing the handler with int(None).
+        raw_vid = body.get("volume_id")
+        if raw_vid is None:
+            return {"error": "volume_id is required"}
+        try:
+            volume_id = int(raw_vid)
+        except (TypeError, ValueError):
+            return {"error": f"volume_id must be an integer, got {raw_vid!r}"}
         resp = self._worker_stub.SubmitTask(
             wk.SubmitTaskRequest(
                 kind=str(body.get("kind", "")),
-                volume_id=int(body.get("volume_id", 0)),
+                volume_id=volume_id,
                 collection=str(body.get("collection", "")),
                 backend=str(body.get("backend", "")),
             ),
@@ -282,15 +299,26 @@ class AdminServer:
                         502,
                         {"error": f"master unreachable: {e.code().name}"},
                     )
+                except (TypeError, ValueError, KeyError) as e:
+                    # Malformed request bodies must produce a JSON 400,
+                    # not a dropped connection.
+                    self._json(400, {"error": f"bad request: {e!r}"})
 
             def do_GET(self):
                 self._dispatch(urlparse(self.path).path, None)
 
             def do_POST(self):
-                n = int(self.headers.get("Content-Length", "0") or 0)
+                import hmac
+
+                if admin.auth_token and not hmac.compare_digest(
+                    self.headers.get("X-Admin-Token", ""), admin.auth_token
+                ):
+                    self._json(401, {"error": "missing/invalid X-Admin-Token"})
+                    return
                 try:
+                    n = int(self.headers.get("Content-Length", "0") or 0)
                     body = json.loads(self.rfile.read(n) or b"{}")
-                except json.JSONDecodeError:
+                except (ValueError, json.JSONDecodeError):
                     self._json(400, {"error": "invalid JSON body"})
                     return
                 self._dispatch(urlparse(self.path).path, body)

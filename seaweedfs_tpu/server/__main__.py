@@ -165,6 +165,15 @@ def main(argv=None) -> int:
         help="also run the admin dashboard (reference `weed admin`)",
     )
     s.add_argument("-adminPort", type=int, default=23646)
+    s.add_argument(
+        "-adminIp", default="localhost",
+        help="admin dashboard bind address (default localhost: the "
+        "maintenance plane is unauthenticated unless -adminSecret is set)",
+    )
+    s.add_argument(
+        "-adminSecret", default="",
+        help="require X-Admin-Token on admin POSTs (reference adminPassword)",
+    )
     _add_tls_flags(s)
 
     sc = sub.add_parser(
@@ -349,13 +358,14 @@ def main(argv=None) -> int:
 
         adm = AdminServer(
             master=f"{a.ip}:{a.masterPort}",
-            ip=a.ip,
+            ip=a.adminIp,
             port=a.adminPort,
             config_path=os.path.join(a.dir[0], "admin_maintenance.json"),
+            auth_token=a.adminSecret or None,
         )
         adm.start()
         servers.append(adm)
-        log.info("admin dashboard on %s:%s", a.ip, a.adminPort)
+        log.info("admin dashboard on %s:%s", a.adminIp, a.adminPort)
 
     if a.mode == "filer" or (
         a.mode == "server" and (a.filer or a.s3 or a.webdav or a.sftp)

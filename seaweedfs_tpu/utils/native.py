@@ -26,7 +26,21 @@ def _build() -> None:
     )
 
 
-if not os.path.exists(_SO_PATH):
+def _stale() -> bool:
+    """Rebuild when sources are newer than the .so — a stale library
+    missing newly-added symbols would otherwise fail the whole module
+    import and silently disable ALL native acceleration."""
+    if not os.path.exists(_SO_PATH):
+        return True
+    so_mtime = os.path.getmtime(_SO_PATH)
+    for src in ("seaweed_native.cpp", "Makefile"):
+        p = os.path.join(_NATIVE_DIR, src)
+        if os.path.exists(p) and os.path.getmtime(p) > so_mtime:
+            return True
+    return False
+
+
+if _stale():
     _build()
 
 _lib = ctypes.CDLL(_SO_PATH)

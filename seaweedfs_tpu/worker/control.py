@@ -314,20 +314,23 @@ class WorkerControl:
             )
         # Read-modify-write: fields absent from the request keep their
         # current value (proto3 optional presence) — a client tuning one
-        # knob must not silently zero the others.
-        cfg = dict(self.config_get())
-        for key in (
-            "ec_auto_fullness",
-            "ec_quiet_seconds",
-            "garbage_threshold",
-            "vacuum_interval_seconds",
-        ):
-            if request.HasField(key):
-                cfg[key] = getattr(request, key)
-        try:
-            self.config_set(cfg)
-        except ValueError as e:
-            return wk.SetMaintenanceConfigResponse(error=str(e))
+        # knob must not silently zero the others. Held under the lock so
+        # two concurrent partial updates cannot interleave and drop one
+        # client's knob.
+        with self._lock:
+            cfg = dict(self.config_get())
+            for key in (
+                "ec_auto_fullness",
+                "ec_quiet_seconds",
+                "garbage_threshold",
+                "vacuum_interval_seconds",
+            ):
+                if request.HasField(key):
+                    cfg[key] = getattr(request, key)
+            try:
+                self.config_set(cfg)
+            except ValueError as e:
+                return wk.SetMaintenanceConfigResponse(error=str(e))
         return wk.SetMaintenanceConfigResponse()
 
     def snapshot(self) -> tuple[list[dict], list[dict]]:

@@ -235,3 +235,50 @@ def test_auto_ec_scanner_flow_through_admin(stack):
     finally:
         w.stop()
         ops.close()
+
+
+def test_malformed_submit_returns_json_400(stack):
+    """ADVICE r3: volume_id:null (dashboard empty field) must produce a
+    JSON 400, not a dropped connection."""
+    master, vs, admin, aport = stack
+    code, out = post(
+        aport, "/api/maintenance/submit", {"kind": "ec_encode", "volume_id": None}
+    )
+    assert code == 400 and "error" in out
+    code, out = post(
+        aport, "/api/maintenance/submit", {"kind": "ec_encode", "volume_id": "xyz"}
+    )
+    assert code == 400 and "volume_id" in out["error"]
+
+
+def test_admin_auth_token(stack, tmp_path):
+    """POSTs require X-Admin-Token when configured; GETs stay open."""
+    import urllib.request
+
+    master, vs, admin, aport = stack
+    port = free_port()
+    locked = AdminServer(
+        master=f"localhost:{master.port}",
+        port=port,
+        config_path=str(tmp_path / "m2.json"),
+        auth_token="s3cret",
+    )
+    locked.start()
+    try:
+        assert get(port, "/healthz")["ok"]  # GET open
+        code, out = post(port, "/api/maintenance/submit", {"kind": "x"})
+        assert code == 401
+        req = urllib.request.Request(
+            f"http://localhost:{port}/api/maintenance/submit",
+            data=json.dumps({"kind": "bogus", "volume_id": 1}).encode(),
+            headers={"X-Admin-Token": "s3cret"},
+            method="POST",
+        )
+        try:
+            resp = urllib.request.urlopen(req)
+            code = resp.status
+        except urllib.error.HTTPError as e:
+            code = e.code
+        assert code == 400  # authenticated, rejected for unknown kind
+    finally:
+        locked.stop()

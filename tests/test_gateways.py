@@ -161,14 +161,23 @@ def test_mq_notifier(cluster):
     try:
         filer.write_file("/mq/y.bin", b"event")
         c = MqClient(f"localhost:{broker.grpc_port}")
-        events = []
-        for p in range(4):
-            for rec in c.subscribe("filer-events", p, start_offset=0):
-                events.append(json.loads(rec.message.value))
+        # The notifier publishes asynchronously; poll with a deadline
+        # instead of a one-shot read (the one-shot raced delivery).
+        deadline = time.monotonic() + 10.0
+        found = False
+        while not found and time.monotonic() < deadline:
+            events = []
+            for p in range(4):
+                for rec in c.subscribe("filer-events", p, start_offset=0):
+                    events.append(json.loads(rec.message.value))
+            found = any(
+                e["newEntry"] and e["newEntry"]["name"] == "y.bin"
+                for e in events
+            )
+            if not found:
+                time.sleep(0.05)
         c.close()
-        assert any(
-            e["newEntry"] and e["newEntry"]["name"] == "y.bin" for e in events
-        )
+        assert found
     finally:
         notifier.close()
         filer.close()
