@@ -134,6 +134,31 @@ def _statement_matches(
     return _conditions_met(stmt.get("Condition"), context)
 
 
+def evaluate_policies_verdict(
+    policies: Iterable[dict],
+    action: str,
+    resource: str,
+    context: dict | None = None,
+) -> str | None:
+    """-> "deny" | "allow" | None (no matching statement). Explicit
+    Deny anywhere wins — callers combining identity and resource
+    policies need the three-way answer, because an identity explicit
+    Deny must override a resource-policy Allow (AWS evaluation
+    logic), which a boolean cannot express."""
+    context = context or {}
+    verdict: str | None = None
+    for doc in policies:
+        for stmt in _as_list(doc.get("Statement")):
+            if not _statement_matches(stmt, action, resource, context):
+                continue
+            effect = str(stmt.get("Effect", "")).lower()
+            if effect == "deny":
+                return "deny"
+            if effect == "allow":
+                verdict = "allow"
+    return verdict
+
+
 def evaluate_policies(
     policies: Iterable[dict],
     action: str,
@@ -142,18 +167,93 @@ def evaluate_policies(
 ) -> bool:
     """True iff the action on the resource is allowed: explicit Deny
     anywhere wins; otherwise at least one Allow must match."""
+    return evaluate_policies_verdict(policies, action, resource, context) == "allow"
+
+
+def _principal_matches(stmt: dict, principal_arn: str) -> bool:
+    """Bucket-policy Principal matching. Accepted shapes: "*",
+    {"AWS": "*"}, {"AWS": [arn,...]}; an arn pattern may use
+    wildcards. NotPrincipal inverts."""
+
+    def match(spec) -> bool:
+        if spec == "*":
+            return True
+        if isinstance(spec, dict):
+            spec = spec.get("AWS", [])
+        return any(
+            _wildcard_match(str(p), principal_arn) for p in _as_list(spec)
+        )
+
+    if "NotPrincipal" in stmt:
+        return not match(stmt["NotPrincipal"])
+    if "Principal" not in stmt:
+        return False  # resource policies require a principal
+    return match(stmt["Principal"])
+
+
+def evaluate_bucket_policy(
+    doc: dict,
+    action: str,
+    resource: str,
+    principal_arn: str,
+    context: dict | None = None,
+) -> str | None:
+    """Resource-based (bucket) policy evaluation -> "deny" | "allow" |
+    None (no matching statement). The caller combines this with
+    identity-based results per AWS rules: explicit deny anywhere wins;
+    a resource-policy allow suffices on its own (it can grant anonymous
+    principals)."""
     context = context or {}
-    allowed = False
-    for doc in policies:
-        for stmt in _as_list(doc.get("Statement")):
-            if not _statement_matches(stmt, action, resource, context):
-                continue
-            effect = str(stmt.get("Effect", "")).lower()
-            if effect == "deny":
-                return False
-            if effect == "allow":
-                allowed = True
-    return allowed
+    verdict: str | None = None
+    for stmt in _as_list(doc.get("Statement")):
+        if not _principal_matches(stmt, principal_arn):
+            continue
+        if not _statement_matches(stmt, action, resource, context):
+            continue
+        effect = str(stmt.get("Effect", "")).lower()
+        if effect == "deny":
+            return "deny"
+        if effect == "allow":
+            verdict = "allow"
+    return verdict
+
+
+def bucket_policy_is_public(doc: dict) -> bool:
+    """GetBucketPolicyStatus semantics: any Allow to Principal '*'
+    without restrictive conditions counts as public."""
+    for stmt in _as_list(doc.get("Statement")):
+        if str(stmt.get("Effect", "")).lower() != "allow":
+            continue
+        p = stmt.get("Principal")
+        if p == "*" or (isinstance(p, dict) and "*" in _as_list(p.get("AWS"))):
+            if not stmt.get("Condition"):
+                return True
+    return False
+
+
+def validate_bucket_policy(doc: dict, bucket: str) -> None:
+    """Structural validation at PutBucketPolicy time (reference
+    s3api_bucket_policy_handlers.go): statements must exist, carry
+    principals, and reference only this bucket's ARNs."""
+    stmts = _as_list(doc.get("Statement"))
+    if not stmts:
+        raise PolicyError("policy has no Statement")
+    for stmt in stmts:
+        if str(stmt.get("Effect", "")).lower() not in ("allow", "deny"):
+            raise PolicyError(f"bad Effect {stmt.get('Effect')!r}")
+        if "Principal" not in stmt and "NotPrincipal" not in stmt:
+            raise PolicyError("bucket policy statement missing Principal")
+        if "Action" not in stmt and "NotAction" not in stmt:
+            raise PolicyError("statement missing Action")
+        for r in _as_list(stmt.get("Resource")):
+            r = str(r)
+            if not (
+                r == f"arn:aws:s3:::{bucket}"
+                or r.startswith(f"arn:aws:s3:::{bucket}/")
+            ):
+                raise PolicyError(
+                    f"resource {r!r} does not match bucket {bucket!r}"
+                )
 
 
 class PolicyEngine:
@@ -226,6 +326,11 @@ def s3_action_and_resource(
                 else "s3:GetObjectLegalHold",
                 obj_arn,
             )
+        if "acl" in q:
+            return (
+                "s3:PutObjectAcl" if method == "PUT" else "s3:GetObjectAcl",
+                obj_arn,
+            )
         if method in ("GET", "HEAD"):
             if "uploadId" in q:
                 return "s3:ListMultipartUploadParts", obj_arn
@@ -242,6 +347,29 @@ def s3_action_and_resource(
             return "s3:DeleteObject", obj_arn
         return "s3:GetObject", obj_arn
     # bucket level
+    if "policy" in q or "policyStatus" in q:
+        return (
+            {
+                "GET": "s3:GetBucketPolicy",
+                "PUT": "s3:PutBucketPolicy",
+                "DELETE": "s3:DeleteBucketPolicy",
+            }.get(method, "s3:GetBucketPolicy"),
+            bucket_arn,
+        )
+    if "acl" in q:
+        return (
+            "s3:PutBucketAcl" if method == "PUT" else "s3:GetBucketAcl",
+            bucket_arn,
+        )
+    if "encryption" in q:
+        return (
+            {
+                "GET": "s3:GetEncryptionConfiguration",
+                "PUT": "s3:PutEncryptionConfiguration",
+                "DELETE": "s3:PutEncryptionConfiguration",
+            }.get(method, "s3:GetEncryptionConfiguration"),
+            bucket_arn,
+        )
     if "lifecycle" in q:
         return (
             "s3:PutLifecycleConfiguration"

@@ -25,6 +25,8 @@ from ..filer.filer_store import NotFound
 from ..pb import filer_pb2 as fpb
 from .auth import Identity, IdentityStore, S3AuthError, verify_v4_ex
 from .chunked import decode_aws_chunked
+from . import post_policy as ppol
+from . import sse
 from . import versioning as vtag
 from .versioning import (
     LockViolation,
@@ -92,6 +94,15 @@ class S3Server:
         self.sts_service = sts
         if sts is not None and self.identities.sts is None:
             self.identities.sts = sts
+        # SSE-S3 keyring: master key shared via the filer KV store so
+        # every gateway over the same filer can decrypt (KMS SPI:
+        # replace with an external provider via `sse_keyring=`).
+        try:
+            self.sse_keyring = sse.load_or_create_keyring(
+                filer.store.kv_get, filer.store.kv_put
+            )
+        except Exception:
+            self.sse_keyring = None
         self._http = ThreadingHTTPServer((ip, port), self._handler_class())
         self.tls = tls
         if tls is not None:
@@ -167,6 +178,12 @@ class S3Server:
                 if srv.identities.empty:
                     return None  # open mode
                 u = urllib.parse.urlparse(self.path)
+                if "Authorization" not in self.headers and "X-Amz-Signature" not in u.query:
+                    # No credentials at all: ANONYMOUS, not an auth
+                    # failure — bucket policies and public ACLs may
+                    # still grant access (evaluated in _handle).
+                    self._anonymous = True
+                    return None
                 phash = self.headers.get(
                     "x-amz-content-sha256", "UNSIGNED-PAYLOAD"
                 )
@@ -195,6 +212,67 @@ class S3Server:
                             "x-amz-content-sha256 does not match body",
                         )
                 return ident
+
+            def _authorize(
+                self, ident, m: str, bucket: str, key: str, q: dict
+            ) -> str | None:
+                """Combine identity policies, the bucket (resource)
+                policy, and canned ACLs per AWS evaluation logic:
+                explicit Deny ANYWHERE (identity or bucket policy)
+                wins; otherwise any applicable Allow grants; anonymous
+                callers need a resource grant (bucket policy Principal
+                "*" or a public canned ACL), and ACL grants cover only
+                data-plane actions. Returns an error message, or None
+                when authorized."""
+                from ..iam.policy import (
+                    evaluate_bucket_policy,
+                    evaluate_policies_verdict,
+                    s3_action_and_resource,
+                )
+
+                action, resource = s3_action_and_resource(m, bucket, key, q)
+                pctx = {
+                    "aws:SourceIp": self.client_address[0],
+                    "aws:username": ident.name if ident else "",
+                    "s3:prefix": q.get("prefix", ""),
+                }
+                bp_verdict = None
+                pdoc = srv.bucket_policy(bucket) if bucket else None
+                if pdoc is not None:
+                    principal = (
+                        f"arn:aws:iam:::user/{ident.name}" if ident else "*"
+                    )
+                    bp_verdict = evaluate_bucket_policy(
+                        pdoc, action, resource, principal, pctx
+                    )
+                    if bp_verdict == "deny":
+                        return f"{action} denied by bucket policy"
+                if self._anonymous:
+                    if not bucket:
+                        return "anonymous access denied"
+                    if bp_verdict == "allow":
+                        return None
+                    if srv.acl_allows_anonymous(bucket, key, action):
+                        return None
+                    return "anonymous access denied"
+                if ident is None:
+                    return None  # open mode
+                if ident.policies:
+                    iv = evaluate_policies_verdict(
+                        list(ident.policies), action, resource, pctx
+                    )
+                    # identity explicit Deny overrides a bucket-policy
+                    # Allow (deny anywhere wins)
+                    if iv == "deny":
+                        return f"{action} on {resource} denied by policy"
+                    if iv == "allow" or bp_verdict == "allow":
+                        return None
+                    return f"{action} on {resource} denied by policy"
+                if bp_verdict == "allow" or ident.allows(
+                    _required_action(m, bucket, key)
+                ):
+                    return None
+                return "identity lacks permission"
 
             def _bucket_key(self):
                 u = urllib.parse.urlparse(self.path)
@@ -235,6 +313,7 @@ class S3Server:
                 self._body_cache = b""
                 self._cors = {}
                 self._sig_ctx = None
+                self._anonymous = False
                 try:
                     bucket, key, q = self._bucket_key()
                     m = self.command
@@ -246,6 +325,19 @@ class S3Server:
                         # every response (incl. errors and writes) needs
                         # the allow-origin header or browsers block it
                         self._cors = self._cors_response_headers(bucket)
+                    if (
+                        m == "POST"
+                        and bucket
+                        and key == ""
+                        and "delete" not in q
+                        and self.headers.get("Content-Type", "").startswith(
+                            "multipart/form-data"
+                        )
+                    ):
+                        # POST-policy browser upload: authn is the
+                        # SigV4 signature over the policy document in
+                        # the form itself, not the Authorization header
+                        return self._post_policy_upload(bucket)
                     try:
                         ident = self._auth()
                     except S3AuthError as e:
@@ -261,37 +353,9 @@ class S3Server:
                         if form.get("Action") == "AssumeRole":
                             return self._sts_assume_role(ident, form)
                         return self._error(405, "MethodNotAllowed", m)
-                    if ident is not None:
-                        if ident.policies:
-                            # full IAM policy evaluation (reference
-                            # policy_engine.go); replaces coarse actions
-                            from ..iam.policy import (
-                                evaluate_policies,
-                                s3_action_and_resource,
-                            )
-
-                            action, resource = s3_action_and_resource(
-                                m, bucket, key, q
-                            )
-                            pctx = {
-                                "aws:SourceIp": self.client_address[0],
-                                "aws:username": ident.name,
-                                "s3:prefix": q.get("prefix", ""),
-                            }
-                            if not evaluate_policies(
-                                list(ident.policies), action, resource, pctx
-                            ):
-                                return self._error(
-                                    403,
-                                    "AccessDenied",
-                                    f"{action} on {resource} denied by policy",
-                                )
-                        elif not ident.allows(
-                            _required_action(m, bucket, key)
-                        ):
-                            return self._error(
-                                403, "AccessDenied", "identity lacks permission"
-                            )
+                    err = self._authorize(ident, m, bucket, key, q)
+                    if err is not None:
+                        return self._error(403, "AccessDenied", err)
                     if bucket == "":
                         if m in ("GET", "HEAD"):
                             return self._list_buckets()
@@ -299,6 +363,15 @@ class S3Server:
                     if key == "":
                         return self._bucket_op(bucket, q)
                     return self._object_op(bucket, key, q)
+                except sse.SseError as e:
+                    code = (
+                        403
+                        if e.code == "AccessDenied"
+                        else 500
+                        if e.code == "InternalError"
+                        else 400
+                    )
+                    return self._error(code, e.code, str(e))
                 except S3AuthError as e:
                     # post-dispatch failures: chunk-signature errors are
                     # auth (403); malformed/truncated bodies are client
@@ -307,7 +380,12 @@ class S3Server:
                     code = (
                         400
                         if e.code
-                        in ("IncompleteBody", "InvalidRequest", "MalformedXML")
+                        in (
+                            "IncompleteBody",
+                            "InvalidRequest",
+                            "MalformedXML",
+                            "InvalidArgument",
+                        )
                         else 403
                     )
                     return self._error(code, e.code, str(e))
@@ -529,6 +607,12 @@ class S3Server:
                     return self._put_object_lock_conf(bucket, path)
                 if m == "PUT" and "lifecycle" in q:
                     return self._put_lifecycle(bucket, path)
+                if "policy" in q or "policyStatus" in q:
+                    return self._bucket_policy_op(bucket, path, q)
+                if "encryption" in q:
+                    return self._bucket_encryption_op(bucket, path)
+                if "acl" in q:
+                    return self._bucket_acl_op(bucket, path)
                 if m == "DELETE" and "lifecycle" in q:
                     srv.filer.store.kv_delete(f"lifecycle/{bucket}".encode())
                     srv.filer.store.kv_delete(
@@ -575,9 +659,12 @@ class S3Server:
                         return self._error(409, "BucketNotEmpty", bucket)
                     srv.filer.delete_entry(path, recursive=True)
                     # a future bucket of the same name must not inherit
-                    # this one's CORS grants
+                    # this one's CORS/policy/ACL/encryption grants
                     srv.filer.store.kv_delete(f"cors/{bucket}".encode())
                     srv.filer.store.kv_delete(f"cors-rules/{bucket}".encode())
+                    srv.filer.store.kv_delete(f"policy/{bucket}".encode())
+                    srv.filer.store.kv_delete(f"acl/{bucket}".encode())
+                    srv.filer.store.kv_delete(f"encryption/{bucket}".encode())
                     # fast space reclaim: drop the bucket's collection
                     # volumes cluster-wide (reference bucket=collection)
                     try:
@@ -813,6 +900,251 @@ class S3Server:
                 )
                 return self._respond(200)
 
+            # ---- bucket policy / encryption / acl subresources ----
+
+            def _bucket_policy_op(self, bucket: str, path: str, q: dict):
+                if not srv.filer.exists(path):
+                    return self._error(404, "NoSuchBucket", bucket)
+                m = self.command
+                kv_key = f"policy/{bucket}".encode()
+                from ..iam.policy import (
+                    PolicyError,
+                    bucket_policy_is_public,
+                    validate_bucket_policy,
+                )
+
+                if m == "GET" and "policyStatus" in q:
+                    doc = srv.bucket_policy(bucket)
+                    if doc is None:
+                        return self._error(
+                            404, "NoSuchBucketPolicy", bucket
+                        )
+                    root = ET.Element("PolicyStatus", xmlns=XMLNS)
+                    _el(
+                        root,
+                        "IsPublic",
+                        "true" if bucket_policy_is_public(doc) else "false",
+                    )
+                    return self._respond(200, _xml(root))
+                if m == "GET":
+                    raw = srv.filer.store.kv_get(kv_key)
+                    if raw is None:
+                        return self._error(404, "NoSuchBucketPolicy", bucket)
+                    return self._respond(200, raw, ctype="application/json")
+                if m == "PUT":
+                    body = self._read_body()
+                    try:
+                        doc = json.loads(body)
+                        validate_bucket_policy(doc, bucket)
+                    except json.JSONDecodeError:
+                        return self._error(
+                            400, "MalformedPolicy", "policy is not JSON"
+                        )
+                    except PolicyError as e:
+                        return self._error(400, "MalformedPolicy", str(e))
+                    srv.filer.store.kv_put(kv_key, body)
+                    return self._respond(204)
+                if m == "DELETE":
+                    srv.filer.store.kv_delete(kv_key)
+                    return self._respond(204)
+                return self._error(405, "MethodNotAllowed", m)
+
+            def _bucket_encryption_op(self, bucket: str, path: str):
+                if not srv.filer.exists(path):
+                    return self._error(404, "NoSuchBucket", bucket)
+                m = self.command
+                kv_key = f"encryption/{bucket}".encode()
+                if m == "GET":
+                    algo = srv.bucket_default_encryption(bucket)
+                    if not algo:
+                        return self._error(
+                            404,
+                            "ServerSideEncryptionConfigurationNotFoundError",
+                            bucket,
+                        )
+                    root = ET.Element(
+                        "ServerSideEncryptionConfiguration", xmlns=XMLNS
+                    )
+                    rule = ET.SubElement(root, "Rule")
+                    dflt = ET.SubElement(
+                        rule, "ApplyServerSideEncryptionByDefault"
+                    )
+                    _el(dflt, "SSEAlgorithm", algo)
+                    return self._respond(200, _xml(root))
+                if m == "PUT":
+                    try:
+                        doc = ET.fromstring(self._read_body())
+                    except ET.ParseError:
+                        return self._error(400, "MalformedXML", "encryption config")
+                    ns = _xml_ns(doc)
+                    algo = doc.findtext(
+                        f".//{ns}ApplyServerSideEncryptionByDefault/{ns}SSEAlgorithm"
+                    ) or doc.findtext(f".//{ns}SSEAlgorithm")
+                    if algo not in ("AES256", "aws:kms"):
+                        return self._error(
+                            400, "MalformedXML", f"bad SSEAlgorithm {algo!r}"
+                        )
+                    srv.filer.store.kv_put(kv_key, b"AES256")
+                    return self._respond(200)
+                if m == "DELETE":
+                    srv.filer.store.kv_delete(kv_key)
+                    return self._respond(204)
+                return self._error(405, "MethodNotAllowed", m)
+
+            _CANNED_ACLS = (
+                "private",
+                "public-read",
+                "public-read-write",
+                "authenticated-read",
+                "bucket-owner-read",
+                "bucket-owner-full-control",
+            )
+
+            def _validate_canned_acl(self, acl: str) -> str:
+                if acl not in self._CANNED_ACLS:
+                    raise S3AuthError(
+                        "InvalidArgument", f"unknown canned acl {acl!r}"
+                    )
+                return acl
+
+            def _canned_acl_header(self) -> str | None:
+                """Validated x-amz-acl request header (None if absent)."""
+                acl = self.headers.get("x-amz-acl", "")
+                return self._validate_canned_acl(acl) if acl else None
+
+            def _acl_xml(self, acl: str) -> bytes:
+                root = ET.Element("AccessControlPolicy", xmlns=XMLNS)
+                owner = ET.SubElement(root, "Owner")
+                _el(owner, "ID", "seaweedfs")
+                grants = ET.SubElement(root, "AccessControlList")
+
+                def grant(grantee_uri: str | None, perm: str):
+                    g = ET.SubElement(grants, "Grant")
+                    ge = ET.SubElement(g, "Grantee")
+                    ge.set(
+                        "{http://www.w3.org/2001/XMLSchema-instance}type",
+                        "Group" if grantee_uri else "CanonicalUser",
+                    )
+                    if grantee_uri:
+                        _el(ge, "URI", grantee_uri)
+                    else:
+                        _el(ge, "ID", "seaweedfs")
+                    _el(g, "Permission", perm)
+
+                grant(None, "FULL_CONTROL")
+                AU = "http://acs.amazonaws.com/groups/global/AllUsers"
+                if acl in ("public-read", "public-read-write"):
+                    grant(AU, "READ")
+                if acl == "public-read-write":
+                    grant(AU, "WRITE")
+                if acl == "authenticated-read":
+                    grant(
+                        "http://acs.amazonaws.com/groups/global/AuthenticatedUsers",
+                        "READ",
+                    )
+                return _xml(root)
+
+            def _bucket_acl_op(self, bucket: str, path: str):
+                if not srv.filer.exists(path):
+                    return self._error(404, "NoSuchBucket", bucket)
+                m = self.command
+                if m == "GET":
+                    return self._respond(200, self._acl_xml(srv.bucket_acl(bucket)))
+                if m == "PUT":
+                    acl = self._canned_acl_header() or "private"
+                    srv.filer.store.kv_put(f"acl/{bucket}".encode(), acl.encode())
+                    return self._respond(200)
+                return self._error(405, "MethodNotAllowed", m)
+
+            def _object_acl_op(self, bucket: str, key: str, path: str):
+                try:
+                    entry = srv.filer.find_entry(path)
+                except NotFound:
+                    return self._error(404, "NoSuchKey", key)
+                m = self.command
+                if m == "GET":
+                    acl = (entry.extended.get("s3-acl") or b"private").decode()
+                    return self._respond(200, self._acl_xml(acl))
+                if m == "PUT":
+                    acl = self._canned_acl_header() or "private"
+                    srv.filer.mutate_entry(
+                        path,
+                        lambda e: e.extended.update({"s3-acl": acl.encode()}),
+                    )
+                    return self._respond(200)
+                return self._error(405, "MethodNotAllowed", m)
+
+            # ---- POST-policy browser uploads ----
+
+            def _post_policy_upload(self, bucket: str):
+                if not srv.filer.exists(f"{BUCKETS_ROOT}/{bucket}"):
+                    return self._error(404, "NoSuchBucket", bucket)
+                body = self._read_body()
+                ident = None
+                try:
+                    fields, file_bytes, filename = ppol.parse_multipart_form(
+                        body, self.headers.get("Content-Type", "")
+                    )
+                    key = fields.get("key", "")
+                    if not key:
+                        return self._error(
+                            400, "InvalidArgument", "POST form missing key"
+                        )
+                    key = key.replace("${filename}", filename)
+                    if not srv.identities.empty:
+                        ident = ppol.verify_post_signature(
+                            srv.identities, fields, srv.region
+                        )
+                        ppol.check_policy_document(
+                            fields, len(file_bytes), bucket, key
+                        )
+                except S3AuthError as e:
+                    code = 403 if e.code in (
+                        "AccessDenied",
+                        "SignatureDoesNotMatch",
+                        "InvalidAccessKeyId",
+                    ) else 400
+                    return self._error(code, e.code, str(e))
+                # Authentication is not authorization: the signer must
+                # also be ALLOWED to put this object (identity policies
+                # + bucket policy; a self-signed form from a read-only
+                # credential must not write).
+                err = self._authorize(ident, "PUT", bucket, key, {})
+                if err is not None:
+                    return self._error(403, "AccessDenied", err)
+                # SSE: explicit form header fields are not standard;
+                # bucket default encryption still applies
+                sse_algo = srv.bucket_default_encryption(bucket)
+                data, sse_ext, sse_hdrs = sse.encrypt_for_put(
+                    file_bytes, None, sse_algo, srv.sse_keyring
+                )
+                ext = dict(sse_ext)
+                acl = fields.get("acl", "")
+                if acl:
+                    self._validate_canned_acl(acl)
+                    ext["s3-acl"] = acl.encode()
+                entry, vid = srv.put_object(
+                    bucket,
+                    key,
+                    data,
+                    mime=fields.get("content-type", "")
+                    or "application/octet-stream",
+                    extra_extended=ext,
+                )
+                status = int(fields.get("success_action_status", "204") or 204)
+                if status not in (200, 201, 204):
+                    status = 204
+                extra = {"ETag": f'"{entry.attr.md5.hex()}"', **sse_hdrs}
+                if vid:
+                    extra["x-amz-version-id"] = vid
+                if status == 201:
+                    root = ET.Element("PostResponse")
+                    _el(root, "Bucket", bucket)
+                    _el(root, "Key", key)
+                    _el(root, "ETag", f'"{entry.attr.md5.hex()}"')
+                    return self._respond(201, _xml(root), extra=extra)
+                return self._respond(status, extra=extra)
+
             def _list_object_versions(self, bucket: str, q: dict):
                 prefix = q.get("prefix", "")
                 max_keys = min(int(q.get("max-keys", "1000") or "1000"), 1000)
@@ -887,6 +1219,8 @@ class S3Server:
                     return self._object_retention(bucket, key, path, q)
                 if "legal-hold" in q:
                     return self._object_legal_hold(bucket, key, path, q)
+                if "acl" in q:
+                    return self._object_acl_op(bucket, key, path)
 
                 if m == "PUT":
                     src = self.headers.get("x-amz-copy-source", "")
@@ -894,6 +1228,21 @@ class S3Server:
                         return self._copy_object(bucket, key, src)
                     data = self._read_body()
                     ext = self._lock_headers_extended(bucket)
+                    # server-side encryption: explicit SSE-C / SSE-S3
+                    # headers, else the bucket's default configuration
+                    ssec_key = sse.parse_customer_headers(self.headers)
+                    sse_algo = self.headers.get(
+                        "x-amz-server-side-encryption", ""
+                    )
+                    if ssec_key is None and not sse_algo:
+                        sse_algo = srv.bucket_default_encryption(bucket)
+                    data, sse_ext, sse_hdrs = sse.encrypt_for_put(
+                        data, ssec_key, sse_algo, srv.sse_keyring
+                    )
+                    ext.update(sse_ext)
+                    acl = self._canned_acl_header()
+                    if acl:
+                        ext["s3-acl"] = acl.encode()
                     entry, vid = srv.put_object(
                         bucket,
                         key,
@@ -903,7 +1252,7 @@ class S3Server:
                         extra_extended=ext,
                     )
                     etag = entry.attr.md5.hex()
-                    extra = {"ETag": f'"{etag}"'}
+                    extra = {"ETag": f'"{etag}"', **sse_hdrs}
                     if vid:
                         extra["x-amz-version-id"] = vid
                     return self._respond(200, extra=extra)
@@ -912,8 +1261,17 @@ class S3Server:
                     entry = self._resolve_version(bucket, key, path, vid_param)
                     if entry is None:
                         return  # _resolve_version responded
+                    # SSE: resolve the data key BEFORE emitting any
+                    # bytes (fail closed — never serve ciphertext), and
+                    # advertise the object's encryption in the response.
+                    sse_data_key = sse.decrypt_key_for_entry(
+                        entry,
+                        sse.parse_customer_headers(self.headers),
+                        srv.sse_keyring,
+                    )
                     total = entry.file_size
                     headers = {
+                        **sse.response_headers_for_entry(entry),
                         **self._cors_response_headers(bucket),
                         "ETag": f'"{_entry_etag(entry)}"',
                         "Last-Modified": time.strftime(
@@ -957,7 +1315,21 @@ class S3Server:
                             )
                         except ValueError:
                             pass
-                    data = srv.filer.read_entry(entry, offset, size)
+                    if sse_data_key is None:
+                        data = srv.filer.read_entry(entry, offset, size)
+                    else:
+                        # CTR seek: read from the 16-byte-aligned
+                        # offset, decrypt with the counter advanced,
+                        # drop the alignment prefix
+                        aligned = offset - offset % 16
+                        want = size if size < 0 else size + (offset - aligned)
+                        raw = srv.filer.read_entry(entry, aligned, want)
+                        iv = entry.extended.get(sse.SSE_IV_KEY) or b""
+                        data = sse.decrypt_range(
+                            sse_data_key, iv, raw, offset
+                        )
+                        if size >= 0:
+                            data = data[:size]
                     return self._respond(status, data, ctype, headers)
                 if m == "DELETE":
                     return self._delete_object(bucket, key, path, q)
@@ -1273,18 +1645,66 @@ class S3Server:
                         # as absent — copy must 404 like GET does
                         return self._error(404, "NoSuchKey", src)
                 data = srv.filer.read_entry(entry)
+                # decrypt the source (SSE-C via the x-amz-copy-source-*
+                # key headers; SSE-S3 via the keyring), then apply the
+                # destination's own encryption
+                src_key = sse.decrypt_key_for_entry(
+                    entry,
+                    sse.parse_customer_headers(
+                        self.headers, prefix=sse.COPY_CUSTOMER_PREFIX
+                    ),
+                    srv.sse_keyring,
+                )
+                if src_key is not None:
+                    data = sse.decrypt(
+                        src_key, entry.extended.get(sse.SSE_IV_KEY) or b"", data
+                    )
+                dst_ssec = sse.parse_customer_headers(self.headers)
+                dst_algo = self.headers.get("x-amz-server-side-encryption", "")
+                if dst_ssec is None and not dst_algo:
+                    dst_algo = srv.bucket_default_encryption(bucket)
+                data, sse_ext, sse_hdrs = sse.encrypt_for_put(
+                    data, dst_ssec, dst_algo, srv.sse_keyring
+                )
+                copy_ext = dict(sse_ext)
+                acl = self._canned_acl_header()
+                if acl:
+                    copy_ext["s3-acl"] = acl.encode()
                 dst, vid = srv.put_object(
-                    bucket, key, data, mime=entry.attr.mime
+                    bucket,
+                    key,
+                    data,
+                    mime=entry.attr.mime,
+                    extra_extended=copy_ext,
                 )
                 root = ET.Element("CopyObjectResult", xmlns=XMLNS)
                 _el(root, "ETag", f'"{dst.attr.md5.hex()}"')
                 _el(root, "LastModified", _iso(dst.attr.mtime))
-                extra = {"x-amz-version-id": vid} if vid else {}
+                extra = {**sse_hdrs}
+                if vid:
+                    extra["x-amz-version-id"] = vid
                 self._respond(200, _xml(root), extra=extra)
 
             # ---- multipart ----
 
             def _initiate_multipart(self, bucket: str, key: str):
+                if (
+                    sse.parse_customer_headers(self.headers) is not None
+                    or self.headers.get("x-amz-server-side-encryption")
+                    or srv.bucket_default_encryption(bucket)
+                ):
+                    # Documented divergence: SSE covers single-PUT,
+                    # POST-policy and copy; multipart would need
+                    # per-part IV tracking through chunk splicing
+                    # (reference SerializeSSECMetadata per chunk).
+                    # Buckets with DEFAULT encryption refuse multipart
+                    # too — silently storing plaintext in a bucket
+                    # configured for SSE would be worse than a 501.
+                    return self._error(
+                        501,
+                        "NotImplemented",
+                        "SSE with multipart upload is not supported",
+                    )
                 upload_id = uuid.uuid4().hex
                 meta_path = srv._upload_dir(bucket, upload_id)
                 e = new_entry(meta_path, is_directory=True, mode=0o755)
@@ -1494,6 +1914,55 @@ class S3Server:
         return Handler
 
     # -------------------------------------------------------- versioning
+
+    def bucket_policy(self, bucket: str) -> dict | None:
+        raw = self.filer.store.kv_get(f"policy/{bucket}".encode())
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError:
+            return None
+
+    def bucket_acl(self, bucket: str) -> str:
+        raw = self.filer.store.kv_get(f"acl/{bucket}".encode())
+        return raw.decode() if raw else "private"
+
+    def bucket_default_encryption(self, bucket: str) -> str:
+        """'' | 'AES256': bucket default applied to unencrypted PUTs."""
+        raw = self.filer.store.kv_get(f"encryption/{bucket}".encode())
+        return raw.decode() if raw else ""
+
+    # Canned ACLs grant DATA-PLANE actions only: never control-plane
+    # operations (policy/acl/encryption/lifecycle/bucket delete), which
+    # would let an anonymous caller escalate on a public-read-write
+    # bucket.
+    _ACL_READ_ACTIONS = frozenset(
+        {"s3:GetObject", "s3:GetObjectVersion", "s3:ListBucket"}
+    )
+    _ACL_WRITE_ACTIONS = frozenset({"s3:PutObject", "s3:DeleteObject"})
+
+    def acl_allows_anonymous(self, bucket: str, key: str, action: str) -> bool:
+        """Canned-ACL grant check for unauthenticated requests:
+        public-read(-write) on the bucket, or public-read on the object
+        itself (object ACL stored in entry.extended at PUT)."""
+        acl = self.bucket_acl(bucket)
+        if action in self._ACL_READ_ACTIONS:
+            if acl in ("public-read", "public-read-write"):
+                return True
+            if key:
+                try:
+                    entry = self.filer.find_entry(
+                        normalize_path(f"{BUCKETS_ROOT}/{bucket}/{key}")
+                    )
+                except NotFound:
+                    return False
+                oacl = (entry.extended.get("s3-acl") or b"").decode()
+                return oacl in ("public-read", "public-read-write")
+            return False
+        if action in self._ACL_WRITE_ACTIONS:
+            return acl == "public-read-write"
+        return False
 
     def bucket_versioning(self, bucket: str) -> str:
         """"" (never enabled) | "Enabled" | "Suspended"."""

@@ -1,0 +1,537 @@
+"""S3 server-side encryption (SSE-C / SSE-S3), bucket policies,
+POST-policy uploads, and canned ACLs.
+
+Reference surfaces: weed/s3api/s3_sse_c.go, weed/kms/,
+weed/s3api/s3api_bucket_policy_handlers.go,
+weed/s3api/s3api_object_handlers_postpolicy.go.
+"""
+
+import base64
+import datetime
+import hashlib
+import hmac
+import json
+import time
+
+import pytest
+import requests
+
+from conftest import allocate_port as free_port
+from seaweedfs_tpu.filer import Filer, MemoryStore
+from seaweedfs_tpu.s3 import Identity, IdentityStore, S3Server
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from test_s3 import sign_request
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("s3ssevol")
+    mport = free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp / "v")],
+        master=f"localhost:{mport}",
+        ip="localhost",
+        port=free_port(),
+        ec_backend="cpu",
+    )
+    vs.start()
+    while not master.topo.nodes:
+        time.sleep(0.05)
+    yield mport
+    vs.stop()
+    master.stop()
+
+
+@pytest.fixture
+def s3(cluster):
+    """Open-mode gateway (no identities)."""
+    filer = Filer(MemoryStore(), master=f"localhost:{cluster}", chunk_size=64 * 1024)
+    srv = S3Server(filer, ip="localhost", port=free_port())
+    srv.start()
+    yield f"http://localhost:{srv.port}", srv
+    srv.stop()
+    filer.close()
+
+
+@pytest.fixture
+def s3_two_users(cluster):
+    """Signed gateway with two identities: alice (admin) and bob
+    (read-only coarse actions)."""
+    filer = Filer(MemoryStore(), master=f"localhost:{cluster}", chunk_size=64 * 1024)
+    idents = IdentityStore()
+    idents.add(Identity("alice", "AKALICE", "alicesecret"))
+    idents.add(Identity("bob", "AKBOB", "bobsecret", actions=("Read", "List")))
+    srv = S3Server(filer, ip="localhost", port=free_port(), identities=idents)
+    srv.start()
+    yield f"http://localhost:{srv.port}", srv
+    srv.stop()
+    filer.close()
+
+
+def ssec_headers(key: bytes, prefix="x-amz-server-side-encryption-customer-"):
+    return {
+        prefix + "algorithm": "AES256",
+        prefix + "key": base64.b64encode(key).decode(),
+        prefix + "key-MD5": base64.b64encode(hashlib.md5(key).digest()).decode(),
+    }
+
+
+# ------------------------------------------------------------------ SSE-C
+
+
+def test_ssec_roundtrip_and_key_enforcement(s3):
+    url, srv = s3
+    requests.put(f"{url}/sec")
+    key = b"K" * 31 + b"1"
+    data = b"customer-encrypted payload " * 1000
+
+    r = requests.put(f"{url}/sec/obj", data=data, headers=ssec_headers(key))
+    assert r.status_code == 200
+    assert (
+        r.headers["x-amz-server-side-encryption-customer-algorithm"] == "AES256"
+    )
+
+    # GET without the key: fail closed
+    assert requests.get(f"{url}/sec/obj").status_code == 400
+    # GET with a wrong key: denied
+    wrong = b"W" * 32
+    assert (
+        requests.get(f"{url}/sec/obj", headers=ssec_headers(wrong)).status_code
+        == 403
+    )
+    # GET with the right key
+    r = requests.get(f"{url}/sec/obj", headers=ssec_headers(key))
+    assert r.status_code == 200 and r.content == data
+    # HEAD advertises the encryption
+    r = requests.head(f"{url}/sec/obj", headers=ssec_headers(key))
+    assert (
+        r.headers["x-amz-server-side-encryption-customer-algorithm"] == "AES256"
+    )
+
+    # ciphertext at rest differs from plaintext
+    entry = srv.filer.find_entry("/buckets/sec/obj")
+    assert srv.filer.read_entry(entry) != data
+
+    # range read decrypts mid-stream (unaligned offsets)
+    r = requests.get(
+        f"{url}/sec/obj",
+        headers={**ssec_headers(key), "Range": "bytes=1003-2010"},
+    )
+    assert r.status_code == 206 and r.content == data[1003:2011]
+
+
+def test_ssec_bad_key_md5_rejected(s3):
+    url, _ = s3
+    requests.put(f"{url}/sec2")
+    h = ssec_headers(b"K" * 32)
+    h["x-amz-server-side-encryption-customer-key-MD5"] = base64.b64encode(
+        hashlib.md5(b"other").digest()
+    ).decode()
+    r = requests.put(f"{url}/sec2/obj", data=b"x", headers=h)
+    assert r.status_code == 400
+
+
+# ------------------------------------------------------------------ SSE-S3
+
+
+def test_sse_s3_roundtrip(s3):
+    url, srv = s3
+    requests.put(f"{url}/managed")
+    data = b"keyring-encrypted " * 500
+    r = requests.put(
+        f"{url}/managed/obj",
+        data=data,
+        headers={"x-amz-server-side-encryption": "AES256"},
+    )
+    assert r.status_code == 200
+    assert r.headers["x-amz-server-side-encryption"] == "AES256"
+    # transparent decrypt on GET, header advertised
+    r = requests.get(f"{url}/managed/obj")
+    assert r.content == data
+    assert r.headers["x-amz-server-side-encryption"] == "AES256"
+    # at rest: ciphertext
+    entry = srv.filer.find_entry("/buckets/managed/obj")
+    assert srv.filer.read_entry(entry) != data
+    # range GET
+    r = requests.get(f"{url}/managed/obj", headers={"Range": "bytes=7-99"})
+    assert r.status_code == 206 and r.content == data[7:100]
+
+
+def test_bucket_default_encryption(s3):
+    url, srv = s3
+    requests.put(f"{url}/dflt")
+    conf = (
+        "<ServerSideEncryptionConfiguration><Rule>"
+        "<ApplyServerSideEncryptionByDefault><SSEAlgorithm>AES256"
+        "</SSEAlgorithm></ApplyServerSideEncryptionByDefault>"
+        "</Rule></ServerSideEncryptionConfiguration>"
+    )
+    assert requests.put(f"{url}/dflt?encryption", data=conf).status_code == 200
+    r = requests.get(f"{url}/dflt?encryption")
+    assert r.status_code == 200 and "AES256" in r.text
+    # plain PUT now encrypts at rest
+    data = b"default-encrypted"
+    requests.put(f"{url}/dflt/obj", data=data)
+    entry = srv.filer.find_entry("/buckets/dflt/obj")
+    assert srv.filer.read_entry(entry) != data
+    assert requests.get(f"{url}/dflt/obj").content == data
+    # delete the config: new PUTs are plaintext again
+    assert requests.delete(f"{url}/dflt?encryption").status_code == 204
+    assert requests.get(f"{url}/dflt?encryption").status_code == 404
+    requests.put(f"{url}/dflt/obj2", data=data)
+    e2 = srv.filer.find_entry("/buckets/dflt/obj2")
+    assert srv.filer.read_entry(e2) == data
+
+
+def test_sse_copy_reencrypts(s3):
+    url, srv = s3
+    requests.put(f"{url}/cpy")
+    key = b"C" * 32
+    data = b"copy me securely" * 100
+    requests.put(f"{url}/cpy/src", data=data, headers=ssec_headers(key))
+    # copy SSE-C source -> SSE-S3 destination
+    r = requests.put(
+        f"{url}/cpy/dst",
+        headers={
+            "x-amz-copy-source": "/cpy/src",
+            **ssec_headers(
+                key, prefix="x-amz-copy-source-server-side-encryption-customer-"
+            ),
+            "x-amz-server-side-encryption": "AES256",
+        },
+    )
+    assert r.status_code == 200
+    r = requests.get(f"{url}/cpy/dst")
+    assert r.content == data
+    assert r.headers["x-amz-server-side-encryption"] == "AES256"
+
+
+def test_sse_multipart_rejected(s3):
+    url, _ = s3
+    requests.put(f"{url}/mp")
+    r = requests.post(
+        f"{url}/mp/obj?uploads",
+        headers={"x-amz-server-side-encryption": "AES256"},
+    )
+    assert r.status_code == 501
+
+
+# ----------------------------------------------------------- bucket policy
+
+
+def _policy(bucket, effect="Allow", principal="*", actions=None, condition=None):
+    stmt = {
+        "Effect": effect,
+        "Principal": principal,
+        "Action": actions or ["s3:GetObject"],
+        "Resource": [f"arn:aws:s3:::{bucket}/*"],
+    }
+    if condition:
+        stmt["Condition"] = condition
+    return json.dumps({"Version": "2012-10-17", "Statement": [stmt]})
+
+
+def test_bucket_policy_crud_and_status(s3):
+    url, _ = s3
+    requests.put(f"{url}/polb")
+    assert requests.get(f"{url}/polb?policy").status_code == 404
+    assert (
+        requests.put(f"{url}/polb?policy", data=_policy("polb")).status_code
+        == 204
+    )
+    r = requests.get(f"{url}/polb?policy")
+    assert r.status_code == 200
+    assert json.loads(r.text)["Statement"][0]["Effect"] == "Allow"
+    r = requests.get(f"{url}/polb?policyStatus")
+    assert r.status_code == 200 and "<IsPublic>true</IsPublic>" in r.text
+    # policy for another bucket's ARN is rejected
+    assert (
+        requests.put(f"{url}/polb?policy", data=_policy("other")).status_code
+        == 400
+    )
+    assert requests.delete(f"{url}/polb?policy").status_code == 204
+    assert requests.get(f"{url}/polb?policy").status_code == 404
+
+
+def test_bucket_policy_grants_anonymous_read(s3_two_users):
+    url, _ = s3_two_users
+    h = sign_request("PUT", f"{url}/pub", "AKALICE", "alicesecret")
+    assert requests.put(f"{url}/pub", headers=h).status_code == 200
+    body = b"public object"
+    h = sign_request("PUT", f"{url}/pub/o.txt", "AKALICE", "alicesecret", body)
+    assert requests.put(f"{url}/pub/o.txt", data=body, headers=h).status_code == 200
+
+    # anonymous read denied before the policy
+    assert requests.get(f"{url}/pub/o.txt").status_code == 403
+    pol = _policy("pub")
+    h = sign_request(
+        "PUT", f"{url}/pub?policy", "AKALICE", "alicesecret", pol.encode()
+    )
+    assert (
+        requests.put(f"{url}/pub?policy", data=pol, headers=h).status_code
+        == 204
+    )
+    # now anonymous read succeeds; anonymous write still denied
+    assert requests.get(f"{url}/pub/o.txt").content == body
+    assert requests.put(f"{url}/pub/x", data=b"nope").status_code == 403
+
+
+def test_bucket_policy_denies_cross_identity(s3_two_users):
+    url, _ = s3_two_users
+    h = sign_request("PUT", f"{url}/denyb", "AKALICE", "alicesecret")
+    requests.put(f"{url}/denyb", headers=h)
+    body = b"secret"
+    h = sign_request("PUT", f"{url}/denyb/k", "AKALICE", "alicesecret", body)
+    requests.put(f"{url}/denyb/k", data=body, headers=h)
+
+    # bob (Read actions) can read before the deny
+    h = sign_request("GET", f"{url}/denyb/k", "AKBOB", "bobsecret")
+    assert requests.get(f"{url}/denyb/k", headers=h).status_code == 200
+
+    pol = _policy(
+        "denyb",
+        effect="Deny",
+        principal={"AWS": ["arn:aws:iam:::user/bob"]},
+        actions=["s3:GetObject"],
+    )
+    h = sign_request(
+        "PUT", f"{url}/denyb?policy", "AKALICE", "alicesecret", pol.encode()
+    )
+    assert (
+        requests.put(f"{url}/denyb?policy", data=pol, headers=h).status_code
+        == 204
+    )
+    # explicit bucket-policy Deny overrides bob's identity permissions
+    h = sign_request("GET", f"{url}/denyb/k", "AKBOB", "bobsecret")
+    assert requests.get(f"{url}/denyb/k", headers=h).status_code == 403
+    # alice is unaffected
+    h = sign_request("GET", f"{url}/denyb/k", "AKALICE", "alicesecret")
+    assert requests.get(f"{url}/denyb/k", headers=h).status_code == 200
+
+
+# ------------------------------------------------------------- POST policy
+
+
+def _post_form(url, bucket, key, data, access_key, secret, conditions=None,
+               expire_s=300, extra_fields=None, region="us-east-1"):
+    now = datetime.datetime.now(datetime.timezone.utc)
+    date = now.strftime("%Y%m%d")
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    cred = f"{access_key}/{date}/{region}/s3/aws4_request"
+    exp = (now + datetime.timedelta(seconds=expire_s)).strftime(
+        "%Y-%m-%dT%H:%M:%S.000Z"
+    )
+    policy = {
+        "expiration": exp,
+        "conditions": conditions
+        if conditions is not None
+        else [
+            {"bucket": bucket},
+            ["starts-with", "$key", ""],
+            {"x-amz-credential": cred},
+            {"x-amz-algorithm": "AWS4-HMAC-SHA256"},
+            {"x-amz-date": amz_date},
+        ],
+    }
+    policy_b64 = base64.b64encode(json.dumps(policy).encode()).decode()
+
+    def h(k, msg):
+        return hmac.new(k, msg.encode(), hashlib.sha256).digest()
+
+    sk = h(h(h(h(("AWS4" + secret).encode(), date), region), "s3"), "aws4_request")
+    sig = hmac.new(sk, policy_b64.encode(), hashlib.sha256).hexdigest()
+    fields = {
+        "key": key,
+        "policy": policy_b64,
+        "x-amz-algorithm": "AWS4-HMAC-SHA256",
+        "x-amz-credential": cred,
+        "x-amz-date": amz_date,
+        "x-amz-signature": sig,
+        **(extra_fields or {}),
+    }
+    return requests.post(
+        url + "/" + bucket, data=fields, files={"file": ("up.bin", data)}
+    )
+
+
+def test_post_policy_upload(s3_two_users):
+    url, srv = s3_two_users
+    h = sign_request("PUT", f"{url}/forms", "AKALICE", "alicesecret")
+    requests.put(f"{url}/forms", headers=h)
+
+    data = b"browser upload bytes"
+    r = _post_form(url, "forms", "up/${filename}", data, "AKALICE", "alicesecret")
+    assert r.status_code == 204, r.text
+    h = sign_request("GET", f"{url}/forms/up/up.bin", "AKALICE", "alicesecret")
+    assert requests.get(f"{url}/forms/up/up.bin", headers=h).content == data
+
+    # bad signature
+    r = _post_form(url, "forms", "k2", data, "AKALICE", "wrongsecret")
+    assert r.status_code == 403
+    # expired policy
+    r = _post_form(
+        url, "forms", "k3", data, "AKALICE", "alicesecret", expire_s=-10
+    )
+    assert r.status_code == 403
+    # content-length-range violation
+    r = _post_form(
+        url,
+        "forms",
+        "k4",
+        data,
+        "AKALICE",
+        "alicesecret",
+        conditions=[["content-length-range", 1, 4]],
+    )
+    assert r.status_code == 400
+    # success_action_status 201 returns the XML body
+    r = _post_form(
+        url,
+        "forms",
+        "k5",
+        data,
+        "AKALICE",
+        "alicesecret",
+        extra_fields={"success_action_status": "201"},
+    )
+    assert r.status_code == 201 and "<Key>k5</Key>" in r.text
+
+
+# -------------------------------------------------------------------- ACLs
+
+
+def test_canned_acls_public_read(s3_two_users):
+    url, _ = s3_two_users
+    h = sign_request("PUT", f"{url}/aclb", "AKALICE", "alicesecret")
+    requests.put(f"{url}/aclb", headers=h)
+    body = b"acl object"
+    h = sign_request("PUT", f"{url}/aclb/k", "AKALICE", "alicesecret", body)
+    requests.put(
+        f"{url}/aclb/k",
+        data=body,
+        headers={**h, "x-amz-acl": "public-read"},
+    )
+    # anonymous GET allowed by the object's canned ACL
+    assert requests.get(f"{url}/aclb/k").content == body
+    # GET ?acl renders the grants
+    h = sign_request("GET", f"{url}/aclb/k?acl", "AKALICE", "alicesecret")
+    r = requests.get(f"{url}/aclb/k?acl", headers=h)
+    assert r.status_code == 200 and "AllUsers" in r.text
+
+    # bucket-level public-read-write allows anonymous PUT
+    h = sign_request("PUT", f"{url}/aclb?acl", "AKALICE", "alicesecret")
+    assert (
+        requests.put(
+            f"{url}/aclb?acl",
+            headers={**h, "x-amz-acl": "public-read-write"},
+        ).status_code
+        == 200
+    )
+    assert requests.put(f"{url}/aclb/anon", data=b"w").status_code == 200
+    h = sign_request("GET", f"{url}/aclb?acl", "AKALICE", "alicesecret")
+    assert "AllUsers" in requests.get(f"{url}/aclb?acl", headers=h).text
+
+
+# ----------------------------------------------- review-finding regressions
+
+
+def test_acl_never_grants_anonymous_control_plane(s3_two_users):
+    """public-read-write grants data-plane only: anonymous bucket
+    delete / policy write / acl write must still be denied."""
+    url, _ = s3_two_users
+    h = sign_request("PUT", f"{url}/openb", "AKALICE", "alicesecret")
+    requests.put(f"{url}/openb", headers=h)
+    h = sign_request("PUT", f"{url}/openb?acl", "AKALICE", "alicesecret")
+    requests.put(
+        f"{url}/openb?acl", headers={**h, "x-amz-acl": "public-read-write"}
+    )
+    # data plane open
+    assert requests.put(f"{url}/openb/k", data=b"x").status_code == 200
+    assert requests.get(f"{url}/openb/k").content == b"x"
+    assert requests.delete(f"{url}/openb/k").status_code in (200, 204)
+    # control plane closed
+    assert requests.delete(f"{url}/openb").status_code == 403
+    assert (
+        requests.put(f"{url}/openb?policy", data=_policy("openb")).status_code
+        == 403
+    )
+    assert requests.put(
+        f"{url}/openb?acl", headers={"x-amz-acl": "private"}
+    ).status_code == 403
+    assert requests.get(f"{url}/openb?policy").status_code == 403
+
+
+def test_identity_deny_overrides_bucket_allow(s3_two_users):
+    """Explicit identity-policy Deny wins over a bucket-policy Allow."""
+    url, srv = s3_two_users
+    h = sign_request("PUT", f"{url}/ovr", "AKALICE", "alicesecret")
+    requests.put(f"{url}/ovr", headers=h)
+    pol = _policy("ovr", actions=["s3:*"])
+    h = sign_request(
+        "PUT", f"{url}/ovr?policy", "AKALICE", "alicesecret", pol.encode()
+    )
+    assert requests.put(f"{url}/ovr?policy", data=pol, headers=h).status_code == 204
+
+    from seaweedfs_tpu.s3 import Identity
+
+    srv.identities.add(
+        Identity(
+            "carol",
+            "AKCAROL",
+            "carolsecret",
+            policies=(
+                {
+                    "Statement": [
+                        {
+                            "Effect": "Deny",
+                            "Action": "s3:GetObject",
+                            "Resource": "arn:aws:s3:::ovr/*",
+                        }
+                    ]
+                },
+            ),
+        )
+    )
+    body = b"v"
+    h = sign_request("PUT", f"{url}/ovr/k", "AKALICE", "alicesecret", body)
+    requests.put(f"{url}/ovr/k", data=body, headers=h)
+    h = sign_request("GET", f"{url}/ovr/k", "AKCAROL", "carolsecret")
+    assert requests.get(f"{url}/ovr/k", headers=h).status_code == 403
+
+
+def test_post_policy_requires_write_permission(s3_two_users):
+    """A read-only credential signing its own POST policy must not be
+    able to write (authn != authz)."""
+    url, _ = s3_two_users
+    h = sign_request("PUT", f"{url}/ro", "AKALICE", "alicesecret")
+    requests.put(f"{url}/ro", headers=h)
+    r = _post_form(url, "ro", "sneak", b"data", "AKBOB", "bobsecret")
+    assert r.status_code == 403
+
+
+def test_post_policy_preserves_trailing_newlines(s3_two_users):
+    """Multipart parser must not strip payload CR/LF bytes."""
+    url, _ = s3_two_users
+    h = sign_request("PUT", f"{url}/nl", "AKALICE", "alicesecret")
+    requests.put(f"{url}/nl", headers=h)
+    data = b"line one\nline two\r\n\n"
+    r = _post_form(url, "nl", "text.txt", data, "AKALICE", "alicesecret")
+    assert r.status_code == 204
+    h = sign_request("GET", f"{url}/nl/text.txt", "AKALICE", "alicesecret")
+    assert requests.get(f"{url}/nl/text.txt", headers=h).content == data
+
+
+def test_multipart_rejected_on_default_encrypted_bucket(s3):
+    url, _ = s3
+    requests.put(f"{url}/mpenc")
+    conf = (
+        "<ServerSideEncryptionConfiguration><Rule>"
+        "<ApplyServerSideEncryptionByDefault><SSEAlgorithm>AES256"
+        "</SSEAlgorithm></ApplyServerSideEncryptionByDefault>"
+        "</Rule></ServerSideEncryptionConfiguration>"
+    )
+    requests.put(f"{url}/mpenc?encryption", data=conf)
+    assert requests.post(f"{url}/mpenc/big?uploads").status_code == 501
