@@ -34,7 +34,15 @@ class FilerGrpcService:
             )
         except NotFound:
             return fpb.LookupEntryResponse(error="not found")
-        return fpb.LookupEntryResponse(entry=e.to_proto())
+        proto = e.to_proto()
+        if e.hard_link_id:
+            # the per-entry counter is a snapshot from link time; the
+            # LIVE name count lives in the shared hl: KV row (mounts
+            # report it as st_nlink)
+            n = self.filer.store.kv_get(b"hl:" + e.hard_link_id)
+            if n is not None:
+                proto.hard_link_counter = int(n)
+        return fpb.LookupEntryResponse(entry=proto)
 
     def ListEntries(self, request, context):
         limit = request.limit or 1024
@@ -117,6 +125,18 @@ class FilerGrpcService:
             self.filer.store.kv_put(bytes(request.key), bytes(request.value))
         else:
             self.filer.store.kv_delete(bytes(request.key))
+        return fpb.FilerOpResponse()
+
+    def HardLink(self, request, context):
+        """Create another name for src's content (reference
+        filer_hardlink.go); FUSE link() rides this."""
+        try:
+            self.filer.hard_link(
+                normalize_path(request.src_path),
+                normalize_path(request.dst_path),
+            )
+        except (FilerError, NotFound) as e:
+            return fpb.FilerOpResponse(error=str(e))
         return fpb.FilerOpResponse()
 
     def LockRange(self, request, context):

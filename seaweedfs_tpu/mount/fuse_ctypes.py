@@ -76,8 +76,20 @@ GetattrT = ctypes.CFUNCTYPE(
     ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(Stat)
 )
 ReadlinkT = ctypes.CFUNCTYPE(
-    ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t
+    ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(ctypes.c_char), ctypes.c_size_t
 )
+
+
+class Flock(ctypes.Structure):
+    """x86_64 glibc struct flock (for the .lock callback)."""
+
+    _fields_ = [
+        ("l_type", ctypes.c_short),
+        ("l_whence", ctypes.c_short),
+        ("l_start", ctypes.c_int64),
+        ("l_len", ctypes.c_int64),
+        ("l_pid", ctypes.c_int32),
+    ]
 MknodT = ctypes.CFUNCTYPE(
     ctypes.c_int, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint64
 )
@@ -115,11 +127,14 @@ StatfsT = ctypes.CFUNCTYPE(
 FsyncT = ctypes.CFUNCTYPE(
     ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(FuseFileInfo)
 )
+# xattr value/output buffers are raw byte regions (values may contain
+# NULs; output buffers are written into) — POINTER(c_char), never
+# c_char_p which both truncates at NUL and is read-only.
 SetxattrT = ctypes.CFUNCTYPE(
     ctypes.c_int,
     ctypes.c_char_p,
     ctypes.c_char_p,
-    ctypes.c_char_p,
+    ctypes.POINTER(ctypes.c_char),
     ctypes.c_size_t,
     ctypes.c_int,
 )
@@ -127,11 +142,14 @@ GetxattrT = ctypes.CFUNCTYPE(
     ctypes.c_int,
     ctypes.c_char_p,
     ctypes.c_char_p,
-    ctypes.c_char_p,
+    ctypes.POINTER(ctypes.c_char),
     ctypes.c_size_t,
 )
 ListxattrT = ctypes.CFUNCTYPE(
-    ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t
+    ctypes.c_int,
+    ctypes.c_char_p,
+    ctypes.POINTER(ctypes.c_char),
+    ctypes.c_size_t,
 )
 FillDirT = ctypes.CFUNCTYPE(
     ctypes.c_int,

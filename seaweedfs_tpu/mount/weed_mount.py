@@ -31,6 +31,7 @@ from ..utils.urls import service_url
 ATTR_TTL = 1.0
 FLUSH_BYTES = 8 * 1024 * 1024  # dirty bytes that trigger a chunk spill
 CHUNK_SIZE = 4 * 1024 * 1024
+XATTR_PREFIX = "xattr-"  # extended-attr namespace in entry.extended
 
 
 class _Handle:
@@ -44,9 +45,10 @@ class _Handle:
         "dirty",
         "refs",
         "lock",
+        "mode",
     )
 
-    def __init__(self, path: str, size: int, base: bool):
+    def __init__(self, path: str, size: int, base: bool, mode: int = 0o644):
         self.path = path
         self.pages = PageBuffer()
         self.chunks: list = []  # uploaded, not yet committed
@@ -56,6 +58,7 @@ class _Handle:
         self.dirty = not base
         self.refs = 1
         self.lock = threading.Lock()
+        self.mode = mode  # create()-requested permission bits
 
 
 class FilerMount:
@@ -91,8 +94,13 @@ class FilerMount:
         return filer_url(self.filer, path)
 
     def _lookup(self, path: str) -> dict | None:
-        """-> {isDir, size, mtime}, None (absent), or raises OSError on
-        transient filer errors (must NOT be cached as a bogus file)."""
+        """-> {isDir, size, mtime, mode, uid, gid, symlink, nlink},
+        None (absent), or raises OSError on transient filer errors
+        (must NOT be cached as a bogus file). Rides the filer gRPC
+        LookupDirectoryEntry so the FULL attribute set (mode/uid/gid/
+        symlink/hardlink count) is visible — the HTTP HEAD this
+        replaced could only see size+mtime, which is why chmod/chown
+        used to be silent lies."""
         now = time.time()
         hit = self._attr_cache.get(path)
         if hit and now - hit[0] < ATTR_TTL:
@@ -100,30 +108,82 @@ class FilerMount:
         if path == "/":
             out = {"isDir": True, "size": 0, "mtime": int(now)}
         else:
-            r = self._http.head(self._url(path), timeout=10)
-            if r.status_code == 404:
+            r = self._grpc_lookup(path)
+            if r.error:
                 out = None
-            elif r.status_code != 200:
-                raise OSError(errno.EIO, f"filer HEAD {path}: {r.status_code}")
-            elif r.headers.get("X-Filer-Listing") == "true":
-                out = {"isDir": True, "size": 0, "mtime": int(now)}
             else:
-                mtime = int(now)
-                lm = r.headers.get("Last-Modified")
-                if lm:
-                    try:
-                        from email.utils import parsedate_to_datetime
-
-                        mtime = int(parsedate_to_datetime(lm).timestamp())
-                    except (ValueError, TypeError):
-                        pass
+                a = r.entry.attributes
+                size = a.file_size
+                if not size:
+                    size = len(r.entry.content) + sum(
+                        c.size for c in r.entry.chunks
+                    )
                 out = {
-                    "isDir": False,
-                    "size": int(r.headers.get("Content-Length", "0") or 0),
-                    "mtime": mtime,
+                    "isDir": r.entry.is_directory,
+                    "size": size,
+                    "mtime": a.mtime or int(now),
+                    "mode": a.file_mode,
+                    "uid": a.uid,
+                    "gid": a.gid,
+                    "symlink": a.symlink_target,
+                    "nlink": max(r.entry.hard_link_counter, 1),
+                    "xattrs": {
+                        k[len(XATTR_PREFIX) :]: bytes(v)
+                        for k, v in r.entry.extended.items()
+                        if k.startswith(XATTR_PREFIX)
+                    },
                 }
         self._attr_cache[path] = (now, out)
         return out
+
+    def _grpc_lookup(self, path: str):
+        """One LookupDirectoryEntry round-trip (shared by attr/xattr/
+        metadata paths so the directory-split + error mapping cannot
+        drift between copies)."""
+        directory, _, name = path.rpartition("/")
+        try:
+            return self._filer_stub().LookupDirectoryEntry(
+                fpb.LookupEntryRequest(directory=directory or "/", name=name),
+                timeout=10,
+            )
+        except Exception as e:  # noqa: BLE001 — grpc transport errors
+            raise OSError(errno.EIO, f"filer lookup {path}: {e}") from None
+
+    def _flush_open_handle(self, path: str) -> None:
+        """A created-but-unflushed file exists only as an open handle
+        (the filer learns about it at commit): metadata operations on
+        the path must publish it first or they ENOENT."""
+        h = self._by_path.get(path)
+        if h is not None:
+            with h.lock:
+                self._commit_locked(h)
+            self._invalidate(path)
+
+    def _mutate_attrs(self, path: str, fn) -> int:
+        """Read-modify-write an entry's metadata over gRPC; `fn(entry)`
+        mutates the proto in place (may return an errno to abort).
+
+        fsetattr-style sequences (cp -p: write, futimens, close) would
+        ENOENT on a created-but-unflushed file without the flush."""
+        self._flush_open_handle(path)
+        directory, _, name = path.rpartition("/")
+        directory = directory or "/"
+        stub = self._filer_stub()
+        r = self._grpc_lookup(path)
+        if r.error:
+            return -errno.ENOENT
+        entry = r.entry
+        rc = fn(entry)
+        if rc:
+            return rc
+        r2 = stub.UpdateEntry(
+            fpb.UpdateEntryRequest(directory=directory, entry=entry),
+            timeout=30,
+        )
+        if r2.error:
+            return -errno.EIO
+        self._invalidate(path)
+        return 0
 
     def _invalidate(self, path: str) -> None:
         self._attr_cache.pop(path, None)
@@ -151,25 +211,40 @@ class FilerMount:
     def getattr(self, path: str, st) -> int:
         h = self._by_path.get(path)
         if h is not None:
+            # Open handle: size/mtime come from the live handle, but
+            # persisted metadata (mode/uid/gid/nlink) must not degrade
+            # to hardcoded defaults while the file is merely open.
             with h.lock:
-                info = {
-                    "isDir": False,
-                    "size": h.size,
-                    "mtime": int(time.time()),
-                }
+                size, hmode, has_base = h.size, h.mode, h.base
+            info = None
+            if has_base:
+                try:
+                    info = self._lookup(path)
+                except OSError:
+                    info = None
+            if info is None:
+                info = {"isDir": False, "mode": hmode}
+            info = {**info, "size": size, "mtime": int(time.time())}
         else:
             info = self._lookup(path)
         if info is None:
             return -errno.ENOENT
         ctypes.memset(ctypes.byref(st.contents), 0, ctypes.sizeof(fc.Stat))
         s = st.contents
-        if info["isDir"]:
-            s.st_mode = stat_mod.S_IFDIR | 0o755
+        perm = info.get("mode", 0) & 0o7777
+        if info.get("symlink"):
+            s.st_mode = stat_mod.S_IFLNK | (perm or 0o777)
+            s.st_nlink = 1
+            s.st_size = len(info["symlink"])
+        elif info["isDir"]:
+            s.st_mode = stat_mod.S_IFDIR | (perm or 0o755)
             s.st_nlink = 2
         else:
-            s.st_mode = stat_mod.S_IFREG | 0o644
-            s.st_nlink = 1
+            s.st_mode = stat_mod.S_IFREG | (perm or 0o644)
+            s.st_nlink = info.get("nlink", 1)
             s.st_size = info["size"]
+        s.st_uid = info.get("uid", 0)
+        s.st_gid = info.get("gid", 0)
         s.st_mtim.tv_sec = info["mtime"]
         s.st_ctim.tv_sec = info["mtime"]
         s.st_blksize = 4096
@@ -227,7 +302,9 @@ class FilerMount:
         return 0
 
     def create(self, path: str, mode: int, fi) -> int:
-        fi.contents.fh = self._new_fh(_Handle(path, 0, base=False))
+        fi.contents.fh = self._new_fh(
+            _Handle(path, 0, base=False, mode=mode & 0o7777 or 0o644)
+        )
         self._invalidate(path)
         return 0
 
@@ -331,7 +408,7 @@ class FilerMount:
         entry.attributes.file_size = h.size
         entry.attributes.mtime = int(time.time())
         if not entry.attributes.file_mode:
-            entry.attributes.file_mode = stat_mod.S_IFREG | 0o644
+            entry.attributes.file_mode = stat_mod.S_IFREG | h.mode
         r = stub.CreateEntry(
             fpb.CreateEntryRequest(directory=directory, entry=entry),
             timeout=60,
@@ -484,9 +561,25 @@ class FilerMount:
         return 0 if r.status_code in (200, 204) else -errno.EIO
 
     def mkdir(self, path: str, mode: int) -> int:
-        r = self._http.post(self._url(path) + "?mkdir=true", timeout=30)
+        # gRPC CreateEntry (not the HTTP ?mkdir) so the requested mode
+        # bits persist. CreateEntry upserts, so existence must be
+        # checked first (fresh lookup, not the 1s attr cache, whose
+        # stale negative would let mkdir clobber a sibling mount's
+        # directory metadata).
+        if not self._grpc_lookup(path).error:
+            return -errno.EEXIST
+        directory, _, name = path.rpartition("/")
+        entry = fpb.Entry(name=name, is_directory=True)
+        entry.attributes.file_mode = stat_mod.S_IFDIR | (
+            mode & 0o7777 or 0o755
+        )
+        entry.attributes.mtime = int(time.time())
+        r = self._filer_stub().CreateEntry(
+            fpb.CreateEntryRequest(directory=directory or "/", entry=entry),
+            timeout=30,
+        )
         self._invalidate(path)
-        return 0 if r.status_code == 201 else -errno.EIO
+        return -errno.EIO if r.error else 0
 
     def rmdir(self, path: str) -> int:
         r = self._http.delete(self._url(path), timeout=60)
@@ -529,6 +622,223 @@ class FilerMount:
         s.f_files = s.f_ffree = 1 << 20
         s.f_namemax = 255
         return 0
+
+    # ------------------------------------------- POSIX metadata (persisted)
+
+    def chmod(self, path: str, mode: int) -> int:
+        """Persisted to the filer entry (reference weedfs_attr.go
+        Setattr) — the pre-r4 silent no-op lied to callers."""
+
+        def apply(e):
+            e.attributes.file_mode = (e.attributes.file_mode & ~0o7777) | (
+                mode & 0o7777
+            )
+
+        return self._mutate_attrs(path, apply)
+
+    def chown(self, path: str, uid: int, gid: int) -> int:
+        def apply(e):
+            if uid != 0xFFFFFFFF:  # -1 = leave unchanged
+                e.attributes.uid = uid
+            if gid != 0xFFFFFFFF:
+                e.attributes.gid = gid
+
+        return self._mutate_attrs(path, apply)
+
+    _UTIME_NOW = (1 << 30) - 1
+    _UTIME_OMIT = (1 << 30) - 2
+
+    def utimens(self, path: str, ts) -> int:
+        """ts = timespec[2] (atime, mtime); atime is not tracked (the
+        reference's filer model has no atime either)."""
+        if not ts:
+            mtime = int(time.time())
+        else:
+            spec = ts[1]
+            if spec.tv_nsec == self._UTIME_OMIT:
+                return 0
+            if spec.tv_nsec == self._UTIME_NOW:
+                mtime = int(time.time())
+            else:
+                mtime = spec.tv_sec
+
+        def apply(e):
+            e.attributes.mtime = mtime
+
+        return self._mutate_attrs(path, apply)
+
+    # ------------------------------------------------------------- xattrs
+
+    def setxattr(self, path: str, name: str, value: bytes, flags: int) -> int:
+        if name.startswith("system."):
+            # No POSIX-ACL support: accepting system.posix_acl_access
+            # as an opaque blob would make tools like `cp -p` believe
+            # permissions were applied (libacl only falls back to
+            # chmod on EOPNOTSUPP).
+            return -errno.EOPNOTSUPP
+        key = XATTR_PREFIX + name
+
+        def apply(e):
+            exists = key in e.extended
+            if flags & 0x1 and exists:  # XATTR_CREATE
+                return -errno.EEXIST
+            if flags & 0x2 and not exists:  # XATTR_REPLACE
+                return -errno.ENODATA
+            e.extended[key] = value
+
+        return self._mutate_attrs(path, apply)
+
+    def getxattr(self, path: str, name: str, buf, size: int) -> int:
+        if name.startswith("system."):
+            return -errno.EOPNOTSUPP
+        xattrs = self._xattr_map(path)
+        if xattrs is None:
+            return -errno.ENOENT
+        val = xattrs.get(name)
+        if val is None:
+            return -errno.ENODATA
+        if size == 0:
+            return len(val)
+        if size < len(val):
+            return -errno.ERANGE
+        ctypes.memmove(buf, val, len(val))
+        return len(val)
+
+    def listxattr(self, path: str, buf, size: int) -> int:
+        xattrs = self._xattr_map(path)
+        if xattrs is None:
+            return -errno.ENOENT
+        blob = b"".join(n.encode() + b"\x00" for n in sorted(xattrs))
+        if size == 0:
+            return len(blob)
+        if size < len(blob):
+            return -errno.ERANGE
+        ctypes.memmove(buf, blob, len(blob))
+        return len(blob)
+
+    def removexattr(self, path: str, name: str) -> int:
+        key = XATTR_PREFIX + name
+
+        def apply(e):
+            if key not in e.extended:
+                return -errno.ENODATA
+            del e.extended[key]
+
+        return self._mutate_attrs(path, apply)
+
+    def _xattr_map(self, path: str) -> dict | None:
+        """Object's xattrs via the (cached) attr lookup; flushes an
+        open uncommitted handle first so xattr reads on a fresh file
+        don't ENOENT."""
+        self._flush_open_handle(path)
+        info = self._lookup(path)
+        if info is None:
+            return None
+        return info.get("xattrs", {})
+
+    # -------------------------------------------------- symlink / hardlink
+
+    def symlink(self, target: str, linkpath: str) -> int:
+        # CreateEntry upserts: without this check a symlink over an
+        # existing entry would silently clobber it (orphaning chunks)
+        if not self._grpc_lookup(linkpath).error:
+            return -errno.EEXIST
+        directory, _, name = linkpath.rpartition("/")
+        entry = fpb.Entry(name=name)
+        entry.attributes.symlink_target = target
+        entry.attributes.file_mode = stat_mod.S_IFLNK | 0o777
+        entry.attributes.mtime = int(time.time())
+        r = self._filer_stub().CreateEntry(
+            fpb.CreateEntryRequest(directory=directory or "/", entry=entry),
+            timeout=30,
+        )
+        self._invalidate(linkpath)
+        return -errno.EIO if r.error else 0
+
+    def readlink(self, path: str, buf, size: int) -> int:
+        info = self._lookup(path)
+        if info is None:
+            return -errno.ENOENT
+        target = (info.get("symlink") or "").encode()
+        if not target:
+            return -errno.EINVAL
+        n = min(len(target), size - 1)
+        ctypes.memmove(buf, target, n)
+        buf[n] = b"\x00"
+        return 0
+
+    def link(self, src: str, dst: str) -> int:
+        self._flush_open_handle(src)
+        r = self._filer_stub().HardLink(
+            fpb.HardLinkRequest(src_path=src, dst_path=dst), timeout=30
+        )
+        self._invalidate(src)
+        self._invalidate(dst)
+        if r.error:
+            return -errno.ENOENT if "not found" in r.error else -errno.EIO
+        return 0
+
+    # -------------------------------------------------------- POSIX locks
+
+    # fcntl constants (x86_64)
+    _F_RDLCK, _F_WRLCK, _F_UNLCK = 0, 1, 2
+    _F_GETLK, _F_SETLK, _F_SETLKW = 5, 6, 7
+    _SETLKW_RETRY_S = 5.0  # bounded: the FUSE loop is single-threaded
+
+    def lock(self, path: str, fi, cmd: int, flp) -> int:
+        """fcntl byte-range locks routed to the filer lock service
+        (LockRange RPC, reference filer_grpc_server_posix_lock.go) so
+        locks coordinate ACROSS mounts of the same filer. F_SETLKW
+        polls with a bounded deadline instead of blocking the
+        single-threaded FUSE loop forever (documented divergence)."""
+        fl = ctypes.cast(flp, ctypes.POINTER(fc.Flock)).contents
+        owner = f"mnt-{id(self):x}-{fi.contents.lock_owner:x}"
+        start = max(fl.l_start, 0)
+        end = 0 if fl.l_len == 0 else start + fl.l_len
+        stub = self._filer_stub()
+
+        def call(op: int, exclusive: bool):
+            return stub.LockRange(
+                fpb.LockRangeRequest(
+                    path=path,
+                    owner=owner,
+                    start=start,
+                    end=end,
+                    exclusive=exclusive,
+                    op=op,
+                ),
+                timeout=10,
+            )
+
+        if cmd == self._F_GETLK:
+            r = call(2, fl.l_type == self._F_WRLCK)
+            if r.granted:
+                fl.l_type = self._F_UNLCK
+            else:
+                # The lock service reports only the conflicting owner,
+                # not its exact range/type: report the probed range as
+                # write-locked (conservative; pid unknowable across
+                # mounts).
+                fl.l_type = self._F_WRLCK
+                fl.l_whence = 0  # SEEK_SET
+                fl.l_pid = 0
+            return 0
+        if cmd in (self._F_SETLK, self._F_SETLKW):
+            if fl.l_type == self._F_UNLCK:
+                r = call(1, False)
+                return -errno.EIO if r.error else 0
+            exclusive = fl.l_type == self._F_WRLCK
+            deadline = time.time() + (
+                self._SETLKW_RETRY_S if cmd == self._F_SETLKW else 0
+            )
+            while True:
+                r = call(0, exclusive)
+                if r.granted:
+                    return 0
+                if time.time() >= deadline:
+                    return -errno.EAGAIN
+                time.sleep(0.05)
+        return -errno.EINVAL
 
 
 def build_operations(mount: FilerMount) -> fc.FuseOperations:
@@ -581,9 +891,45 @@ def build_operations(mount: FilerMount) -> fc.FuseOperations:
     )
     ops.statfs = wrap(fc.StatfsT, lambda p, sv: mount.statfs(p.decode(), sv))
     ops.access = wrap(fc.AccessT, lambda p, mask: 0)
-    ops.utimens = wrap(fc.UtimensT, lambda p, ts: 0)
-    ops.chmod = wrap(fc.ChmodT, lambda p, m: 0)
-    ops.chown = wrap(fc.ChownT, lambda p, u, g: 0)
+    ops.utimens = wrap(
+        fc.UtimensT, lambda p, ts: mount.utimens(p.decode(), ts)
+    )
+    ops.chmod = wrap(fc.ChmodT, lambda p, m: mount.chmod(p.decode(), m))
+    ops.chown = wrap(
+        fc.ChownT, lambda p, u, g: mount.chown(p.decode(), u, g)
+    )
+    ops.setxattr = wrap(
+        fc.SetxattrT,
+        lambda p, n, v, sz, fl: mount.setxattr(
+            p.decode(), n.decode(), ctypes.string_at(v, sz), fl
+        ),
+    )
+    ops.getxattr = wrap(
+        fc.GetxattrT,
+        lambda p, n, buf, sz: mount.getxattr(p.decode(), n.decode(), buf, sz),
+    )
+    ops.listxattr = wrap(
+        fc.ListxattrT,
+        lambda p, buf, sz: mount.listxattr(p.decode(), buf, sz),
+    )
+    ops.removexattr = wrap(
+        fc.TwoPathT,
+        lambda p, n: mount.removexattr(p.decode(), n.decode()),
+    )
+    ops.symlink = wrap(
+        fc.TwoPathT, lambda t, lp: mount.symlink(t.decode(), lp.decode())
+    )
+    ops.readlink = wrap(
+        fc.ReadlinkT,
+        lambda p, buf, sz: mount.readlink(p.decode(), buf, sz),
+    )
+    ops.link = wrap(
+        fc.TwoPathT, lambda a, b: mount.link(a.decode(), b.decode())
+    )
+    ops.lock = wrap(
+        fc.LockT,
+        lambda p, fi, cmd, flp: mount.lock(p.decode(), fi, cmd, flp),
+    )
     return ops
 
 

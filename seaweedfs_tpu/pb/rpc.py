@@ -94,6 +94,7 @@ SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
         "KvGet": (UNARY, fpb.FilerKvGetRequest, fpb.FilerKvGetResponse),
         "KvPut": (UNARY, fpb.FilerKvPutRequest, fpb.FilerOpResponse),
         "LockRange": (UNARY, fpb.LockRangeRequest, fpb.LockRangeResponse),
+        "HardLink": (UNARY, fpb.HardLinkRequest, fpb.FilerOpResponse),
     },
     WORKER_SERVICE: {
         "WorkerStream": (BIDI, wk.WorkerMessage, wk.ServerMessage),

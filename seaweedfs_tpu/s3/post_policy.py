@@ -123,6 +123,26 @@ def check_policy_document(
             return key
         return fields.get(name.lower(), "")
 
+    # AWS rule: every form field except x-amz-signature, file, policy
+    # and x-ignore-* MUST be covered by a condition — otherwise the
+    # holder of a signed form could append unauthorized fields (e.g.
+    # acl=public-read-write) the signer never approved.
+    covered: set[str] = {"bucket"}
+    for cond in doc.get("conditions", []):
+        if isinstance(cond, dict):
+            covered.update(k.lower() for k in cond)
+        elif isinstance(cond, list) and len(cond) == 3:
+            covered.add(str(cond[1]).lstrip("$").lower())
+    exempt = {"policy", "x-amz-signature", "file"}
+    for name in fields:
+        if name in exempt or name.startswith("x-ignore-"):
+            continue
+        if name not in covered:
+            raise S3AuthError(
+                "AccessDenied",
+                f"form field {name!r} is not covered by the policy",
+            )
+
     for cond in doc.get("conditions", []):
         if isinstance(cond, dict):
             for k, v in cond.items():

@@ -364,13 +364,11 @@ class S3Server:
                         return self._bucket_op(bucket, q)
                     return self._object_op(bucket, key, q)
                 except sse.SseError as e:
-                    code = (
-                        403
-                        if e.code == "AccessDenied"
-                        else 500
-                        if e.code == "InternalError"
-                        else 400
-                    )
+                    code = {
+                        "AccessDenied": 403,
+                        "InternalError": 500,
+                        "NotImplemented": 501,
+                    }.get(e.code, 400)
                     return self._error(code, e.code, str(e))
                 except S3AuthError as e:
                     # post-dispatch failures: chunk-signature errors are
@@ -980,7 +978,13 @@ class S3Server:
                     algo = doc.findtext(
                         f".//{ns}ApplyServerSideEncryptionByDefault/{ns}SSEAlgorithm"
                     ) or doc.findtext(f".//{ns}SSEAlgorithm")
-                    if algo not in ("AES256", "aws:kms"):
+                    if algo == "aws:kms":
+                        return self._error(
+                            501,
+                            "NotImplemented",
+                            "aws:kms requires an external KMS provider",
+                        )
+                    if algo != "AES256":
                         return self._error(
                             400, "MalformedXML", f"bad SSEAlgorithm {algo!r}"
                         )

@@ -198,7 +198,14 @@ def encrypt_for_put(
         }
         return ct, ext, hdrs
     if sse_algo:
-        if sse_algo not in ("AES256", "aws:kms"):
+        if sse_algo == "aws:kms":
+            # Honest 501 over silently downgrading to the local keyring
+            # and reporting AES256 (compliance tooling would believe
+            # KMS-wrapped keys are in use).
+            raise SseError(
+                "NotImplemented", "aws:kms requires an external KMS provider"
+            )
+        if sse_algo != "AES256":
             raise SseError(
                 "InvalidArgument",
                 f"unsupported x-amz-server-side-encryption {sse_algo!r}",

@@ -142,3 +142,113 @@ def test_mount_large_write_chunked(mounted):
     with open(f"{mnt}/big/stream.bin", "rb") as f:
         f.seek(5 * 1024 * 1024 + 123)
         assert f.read(4) == bytes([5 % 251]) * 4
+
+
+def test_mount_posix_metadata(mounted):
+    """pjdfstest-subset: chmod/chown/utimens persist (no more silent
+    no-ops), xattrs round-trip, symlink/readlink, hardlink."""
+    mnt, fport = mounted
+    os.makedirs(f"{mnt}/meta")
+    p = f"{mnt}/meta/f.txt"
+    with open(p, "w") as f:
+        f.write("hello meta")
+
+    # chmod persists and survives the attr-cache TTL
+    os.chmod(p, 0o640)
+    time.sleep(1.1)  # ATTR_TTL
+    assert (os.stat(p).st_mode & 0o7777) == 0o640
+
+    # utimens persists
+    os.utime(p, (1_600_000_000, 1_600_000_000))
+    time.sleep(1.1)
+    assert os.stat(p).st_mtime == 1_600_000_000
+
+    # chown persists in the entry metadata (we run unprivileged, so
+    # only verify via the filer metadata, chown to self never fails)
+    os.chown(p, os.getuid(), os.getgid())
+    meta = requests.get(
+        f"http://localhost:{fport}/meta/f.txt?chunks=true"
+    ).json()
+    assert meta.get("uid", os.getuid()) == os.getuid()
+
+    # xattr round trip incl. binary values and flags
+    os.setxattr(p, "user.color", b"blu\x00e")
+    os.setxattr(p, "user.shape", b"round")
+    assert os.getxattr(p, "user.color") == b"blu\x00e"
+    assert sorted(os.listxattr(p)) == ["user.color", "user.shape"]
+    with pytest.raises(OSError):  # XATTR_CREATE on existing
+        os.setxattr(p, "user.color", b"x", os.XATTR_CREATE)
+    with pytest.raises(OSError):  # XATTR_REPLACE on missing
+        os.setxattr(p, "user.nope", b"x", os.XATTR_REPLACE)
+    os.removexattr(p, "user.shape")
+    assert os.listxattr(p) == ["user.color"]
+    with pytest.raises(OSError):
+        os.getxattr(p, "user.shape")
+
+    # symlink / readlink
+    os.symlink("f.txt", f"{mnt}/meta/ln")
+    assert os.readlink(f"{mnt}/meta/ln") == "f.txt"
+    assert os.path.islink(f"{mnt}/meta/ln")
+    assert open(f"{mnt}/meta/ln").read() == "hello meta"
+
+    # hardlink: same content, nlink visible
+    os.link(p, f"{mnt}/meta/hard.txt")
+    assert open(f"{mnt}/meta/hard.txt").read() == "hello meta"
+    time.sleep(1.1)
+    assert os.stat(p).st_nlink >= 2
+
+    # create() mode honored
+    fd = os.open(f"{mnt}/meta/modefile", os.O_CREAT | os.O_WRONLY, 0o600)
+    os.write(fd, b"x")
+    os.close(fd)
+    time.sleep(1.1)
+    assert (os.stat(f"{mnt}/meta/modefile").st_mode & 0o7777) == 0o600
+
+
+def test_mount_posix_locks(mounted):
+    """fcntl byte-range locks ride the filer lock service: two
+    processes (this one and a subprocess) must conflict."""
+    import fcntl
+    import textwrap
+
+    mnt, _ = mounted
+    p = f"{mnt}/lockfile"
+    with open(p, "w") as f:
+        f.write("0123456789")
+
+    f1 = open(p, "r+b")
+    fcntl.lockf(f1, fcntl.LOCK_EX | fcntl.LOCK_NB, 4, 0)  # lock [0,4)
+
+    # another PROCESS must see the conflict (locks coordinate through
+    # the filer, not the local kernel)
+    probe = textwrap.dedent(f"""
+        import fcntl, sys
+        f = open({p!r}, "r+b")
+        try:
+            fcntl.lockf(f, fcntl.LOCK_EX | fcntl.LOCK_NB, 4, 0)
+            print("GRANTED")
+        except OSError:
+            print("BLOCKED")
+    """)
+    out = subprocess.run(
+        [sys.executable, "-c", probe], capture_output=True, text=True,
+        timeout=30,
+    )
+    assert "BLOCKED" in out.stdout, out.stdout + out.stderr
+
+    # a non-overlapping range is fine from the other process
+    probe2 = probe.replace("LOCK_NB, 4, 0", "LOCK_NB, 2, 6")
+    out = subprocess.run(
+        [sys.executable, "-c", probe2], capture_output=True, text=True,
+        timeout=30,
+    )
+    assert "GRANTED" in out.stdout, out.stdout + out.stderr
+
+    # unlock releases for other processes
+    fcntl.lockf(f1, fcntl.LOCK_UN, 4, 0)
+    out = subprocess.run(
+        [sys.executable, "-c", probe], capture_output=True, text=True,
+        timeout=30,
+    )
+    assert "GRANTED" in out.stdout, out.stdout + out.stderr
+    f1.close()

@@ -316,7 +316,8 @@ def test_bucket_policy_denies_cross_identity(s3_two_users):
 
 
 def _post_form(url, bucket, key, data, access_key, secret, conditions=None,
-               expire_s=300, extra_fields=None, region="us-east-1"):
+               expire_s=300, extra_fields=None, region="us-east-1",
+               cover_extras=True):
     now = datetime.datetime.now(datetime.timezone.utc)
     date = now.strftime("%Y%m%d")
     amz_date = now.strftime("%Y%m%dT%H%M%SZ")
@@ -324,17 +325,19 @@ def _post_form(url, bucket, key, data, access_key, secret, conditions=None,
     exp = (now + datetime.timedelta(seconds=expire_s)).strftime(
         "%Y-%m-%dT%H:%M:%S.000Z"
     )
+    # every submitted form field must be covered by a condition (the
+    # server enforces this); `conditions` adds EXTRA constraints and
+    # `extra_fields` are auto-covered with eq conditions
+    base_conditions = [
+        {"bucket": bucket},
+        ["starts-with", "$key", ""],
+        {"x-amz-credential": cred},
+        {"x-amz-algorithm": "AWS4-HMAC-SHA256"},
+        {"x-amz-date": amz_date},
+    ] + ([{k: v} for k, v in (extra_fields or {}).items()] if cover_extras else [])
     policy = {
         "expiration": exp,
-        "conditions": conditions
-        if conditions is not None
-        else [
-            {"bucket": bucket},
-            ["starts-with", "$key", ""],
-            {"x-amz-credential": cred},
-            {"x-amz-algorithm": "AWS4-HMAC-SHA256"},
-            {"x-amz-date": amz_date},
-        ],
+        "conditions": base_conditions + (conditions or []),
     }
     policy_b64 = base64.b64encode(json.dumps(policy).encode()).decode()
 
@@ -535,3 +538,23 @@ def test_multipart_rejected_on_default_encrypted_bucket(s3):
     )
     requests.put(f"{url}/mpenc?encryption", data=conf)
     assert requests.post(f"{url}/mpenc/big?uploads").status_code == 501
+
+
+def test_post_policy_rejects_uncovered_fields(s3_two_users):
+    """A form field the signed policy does not cover must be rejected:
+    otherwise the holder of a signed form could append e.g. an acl
+    grant the signer never authorized."""
+    url, _ = s3_two_users
+    h = sign_request("PUT", f"{url}/cov", "AKALICE", "alicesecret")
+    requests.put(f"{url}/cov", headers=h)
+    r = _post_form(
+        url, "cov", "k", b"data", "AKALICE", "alicesecret",
+        extra_fields={"acl": "public-read-write"}, cover_extras=False,
+    )
+    assert r.status_code == 403 and "not covered" in r.text
+    # the same field WITH a covering condition is accepted
+    r = _post_form(
+        url, "cov", "k", b"data", "AKALICE", "alicesecret",
+        extra_fields={"acl": "public-read"},
+    )
+    assert r.status_code == 204
