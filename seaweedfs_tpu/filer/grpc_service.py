@@ -129,13 +129,16 @@ class FilerGrpcService:
 
     def HardLink(self, request, context):
         """Create another name for src's content (reference
-        filer_hardlink.go); FUSE link() rides this."""
+        filer_hardlink.go); FUSE link() rides this. Error strings are
+        prefixed so clients can map them to errno."""
         try:
             self.filer.hard_link(
                 normalize_path(request.src_path),
                 normalize_path(request.dst_path),
             )
-        except (FilerError, NotFound) as e:
+        except NotFound as e:
+            return fpb.FilerOpResponse(error=f"not found: {e}")
+        except FilerError as e:
             return fpb.FilerOpResponse(error=str(e))
         return fpb.FilerOpResponse()
 

@@ -223,7 +223,9 @@ class FilerMount:
                 except OSError:
                     info = None
             if info is None:
-                info = {"isDir": False, "mode": hmode}
+                # carry the type bit so a legal 000-permission create
+                # is distinguishable from "no stored mode"
+                info = {"isDir": False, "mode": stat_mod.S_IFREG | hmode}
             info = {**info, "size": size, "mtime": int(time.time())}
         else:
             info = self._lookup(path)
@@ -231,16 +233,21 @@ class FilerMount:
             return -errno.ENOENT
         ctypes.memset(ctypes.byref(st.contents), 0, ctypes.sizeof(fc.Stat))
         s = st.contents
-        perm = info.get("mode", 0) & 0o7777
+        mode = info.get("mode", 0)
+        # mode==0 means "never stored" (proto3 default) — apply type
+        # defaults; a STORED mode keeps its exact permission bits, so a
+        # legal chmod 000 isn't silently reported as the default.
+        perm = mode & 0o7777
+        has_mode = mode != 0
         if info.get("symlink"):
-            s.st_mode = stat_mod.S_IFLNK | (perm or 0o777)
+            s.st_mode = stat_mod.S_IFLNK | (perm if has_mode else 0o777)
             s.st_nlink = 1
             s.st_size = len(info["symlink"])
         elif info["isDir"]:
-            s.st_mode = stat_mod.S_IFDIR | (perm or 0o755)
+            s.st_mode = stat_mod.S_IFDIR | (perm if has_mode else 0o755)
             s.st_nlink = 2
         else:
-            s.st_mode = stat_mod.S_IFREG | (perm or 0o644)
+            s.st_mode = stat_mod.S_IFREG | (perm if has_mode else 0o644)
             s.st_nlink = info.get("nlink", 1)
             s.st_size = info["size"]
         s.st_uid = info.get("uid", 0)
@@ -302,8 +309,9 @@ class FilerMount:
         return 0
 
     def create(self, path: str, mode: int, fi) -> int:
+        # mode 0 is a legal create permission; no `or 0o644` coercion
         fi.contents.fh = self._new_fh(
-            _Handle(path, 0, base=False, mode=mode & 0o7777 or 0o644)
+            _Handle(path, 0, base=False, mode=mode & 0o7777)
         )
         self._invalidate(path)
         return 0
@@ -570,9 +578,7 @@ class FilerMount:
             return -errno.EEXIST
         directory, _, name = path.rpartition("/")
         entry = fpb.Entry(name=name, is_directory=True)
-        entry.attributes.file_mode = stat_mod.S_IFDIR | (
-            mode & 0o7777 or 0o755
-        )
+        entry.attributes.file_mode = stat_mod.S_IFDIR | (mode & 0o7777)
         entry.attributes.mtime = int(time.time())
         r = self._filer_stub().CreateEntry(
             fpb.CreateEntryRequest(directory=directory or "/", entry=entry),
@@ -670,11 +676,11 @@ class FilerMount:
     # ------------------------------------------------------------- xattrs
 
     def setxattr(self, path: str, name: str, value: bytes, flags: int) -> int:
-        if name.startswith("system."):
-            # No POSIX-ACL support: accepting system.posix_acl_access
-            # as an opaque blob would make tools like `cp -p` believe
-            # permissions were applied (libacl only falls back to
-            # chmod on EOPNOTSUPP).
+        if name.startswith(("system.", "security.")):
+            # No POSIX-ACL/capability support: accepting
+            # system.posix_acl_access as an opaque blob would make
+            # tools like `cp -p` believe permissions were applied
+            # (libacl only falls back to chmod on EOPNOTSUPP).
             return -errno.EOPNOTSUPP
         key = XATTR_PREFIX + name
 
@@ -689,7 +695,10 @@ class FilerMount:
         return self._mutate_attrs(path, apply)
 
     def getxattr(self, path: str, name: str, buf, size: int) -> int:
-        if name.startswith("system."):
+        if name.startswith(("system.", "security.")):
+            # "security.capability" is probed by the kernel on EVERY
+            # write(2) (file_remove_privs); answering it from the filer
+            # would turn each write into a metadata round-trip.
             return -errno.EOPNOTSUPP
         xattrs = self._xattr_map(path)
         if xattrs is None:
@@ -727,10 +736,13 @@ class FilerMount:
         return self._mutate_attrs(path, apply)
 
     def _xattr_map(self, path: str) -> dict | None:
-        """Object's xattrs via the (cached) attr lookup; flushes an
-        open uncommitted handle first so xattr reads on a fresh file
-        don't ENOENT."""
-        self._flush_open_handle(path)
+        """Object's xattrs via the (cached) attr lookup. A READ must
+        never force-commit an open dirty handle (xattr probes arrive
+        mid-stream); a created-but-uncommitted file simply has no
+        xattrs yet."""
+        h = self._by_path.get(path)
+        if h is not None and not h.base:
+            return {}
         info = self._lookup(path)
         if info is None:
             return None
@@ -775,7 +787,11 @@ class FilerMount:
         self._invalidate(src)
         self._invalidate(dst)
         if r.error:
-            return -errno.ENOENT if "not found" in r.error else -errno.EIO
+            if "not found" in r.error:
+                return -errno.ENOENT
+            if "exists" in r.error:
+                return -errno.EEXIST
+            return -errno.EIO
         return 0
 
     # -------------------------------------------------------- POSIX locks

@@ -17,9 +17,101 @@ documents; plus STS roles.
 from __future__ import annotations
 
 import json
+import threading
+import time
 
 from ..iam.sts import Role, StsService
 from .auth import Identity, IdentityStore
+
+# Filer KV key holding the dynamic identity config (written by the
+# shell's s3.* command family, read by every gateway over the filer —
+# the reference keeps the same file at /etc/iam/identity.json).
+S3_IDENTITY_KV = b"s3/identity.json"
+
+
+def identity_from_conf(ident: dict) -> Identity:
+    return Identity(
+        name=ident.get("name", ident["accessKey"]),
+        access_key=ident["accessKey"],
+        secret_key=ident["secretKey"],
+        actions=tuple(ident.get("actions", ())) or (),
+        policies=tuple(ident.get("policies", ())),
+    )
+
+
+class FilerIdentityStore:
+    """IdentityStore facade layering dynamic, filer-persisted
+    credentials (s3/identity.json in the filer KV, maintained by the
+    shell `s3.*` commands) over an optional static base store (CLI
+    flags / config file). The KV is re-read at most every `ttl`
+    seconds, so a key created in the shell authenticates against every
+    gateway within seconds — and creating the FIRST identity flips an
+    open-mode gateway to authenticated mode."""
+
+    def __init__(self, filer, base: IdentityStore | None = None, ttl: float = 2.0):
+        self.base = base or IdentityStore()
+        self._filer = filer
+        self._ttl = ttl
+        self._next = 0.0
+        self._blob: bytes | None = None
+        self._dynamic: dict[str, Identity] = {}
+        self._lock = threading.Lock()
+
+    # --- IdentityStore surface ---
+
+    @property
+    def sts(self):
+        return self.base.sts
+
+    @sts.setter
+    def sts(self, value):
+        self.base.sts = value
+
+    def add(self, ident: Identity) -> None:
+        self.base.add(ident)
+
+    def lookup(self, access_key: str) -> Identity | None:
+        found = self.base.lookup(access_key)
+        if found is not None:
+            return found
+        self._refresh()
+        return self._dynamic.get(access_key)
+
+    @property
+    def empty(self) -> bool:
+        if not self.base.empty or self._dynamic:
+            return False
+        self._refresh()
+        return not self._dynamic
+
+    # --- dynamic reload ---
+
+    def _refresh(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if now < self._next:
+                return
+            self._next = now + self._ttl
+            try:
+                raw = self._filer.store.kv_get(S3_IDENTITY_KV)
+            except Exception:  # noqa: BLE001 — keep serving the last view
+                return
+            if raw == self._blob:
+                return
+            self._blob = raw
+            dyn: dict[str, Identity] = {}
+            if raw:
+                try:
+                    conf = json.loads(raw)
+                except json.JSONDecodeError:
+                    return  # malformed config: keep the previous view
+                for ident in conf.get("identities", []):
+                    try:
+                        i = identity_from_conf(ident)
+                    except KeyError:
+                        continue
+                    dyn[i.access_key] = i
+            self._dynamic = dyn
 
 
 def load_s3_config(path: str) -> tuple[IdentityStore, StsService | None]:
@@ -27,15 +119,7 @@ def load_s3_config(path: str) -> tuple[IdentityStore, StsService | None]:
         conf = json.load(f)
     store = IdentityStore()
     for ident in conf.get("identities", []):
-        store.add(
-            Identity(
-                name=ident.get("name", ident["accessKey"]),
-                access_key=ident["accessKey"],
-                secret_key=ident["secretKey"],
-                actions=tuple(ident.get("actions", ())) or (),
-                policies=tuple(ident.get("policies", ())),
-            )
-        )
+        store.add(identity_from_conf(ident))
     sts = None
     roles = conf.get("roles", [])
     if roles and store.empty:

@@ -88,7 +88,11 @@ class S3Server:
         self.ip = ip
         self.port = port
         self.region = region
-        self.identities = identities or IdentityStore()
+        # Layer filer-persisted dynamic credentials (shell `s3.*`
+        # family writes s3/identity.json) over any static store.
+        from .config import FilerIdentityStore
+
+        self.identities = FilerIdentityStore(filer, base=identities)
         # STS service (iam.StsService): AssumeRole on the service
         # endpoint + temp-credential lookup during SigV4 auth
         self.sts_service = sts

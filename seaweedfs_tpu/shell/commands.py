@@ -548,12 +548,27 @@ def volume_move(env: ShellEnv, args) -> str:
     p = argparse.ArgumentParser(prog="volume.move")
     p.add_argument("-volumeId", type=int, required=True)
     p.add_argument("-target", required=True, help="grpc address host:port")
+    p.add_argument(
+        "-source",
+        default="",
+        help="grpc address of the REPLICA to move (default: first found)",
+    )
     p.add_argument("-collection", default="")
     a = p.parse_args(args)
     locs = env.master.lookup(a.volumeId, refresh=True)
     if not locs:
         return f"volume {a.volumeId} not found"
     src = locs[0]
+    if a.source:
+        # replicated volumes: the caller (e.g. volume.balance) names
+        # WHICH replica moves; defaulting to locs[0] would drain the
+        # wrong node and never converge
+        for loc in locs:
+            if f"{loc.url.split(':')[0]}:{loc.grpc_port}" == a.source:
+                src = loc
+                break
+        else:
+            return f"volume {a.volumeId} has no replica at {a.source}"
     src_grpc = f"{src.url.split(':')[0]}:{src.grpc_port}"
     if src_grpc == a.target:
         return "volume already on target"
@@ -1470,3 +1485,424 @@ def remote_uncache(env: ShellEnv, args) -> str:
     p.add_argument("-path", required=True)
     a = p.parse_args(args)
     return _remote_post(env, "uncache", {"path": a.path})
+
+
+# ------------------------------------------------------------ volume.balance
+
+
+def _balance_plan(topo, collection: str):
+    """Greedy per-disk-type move plan toward equal fullness ratios
+    (reference command_volume_balance.go balanceVolumeServers: ratio =
+    volumes / max_volume_count per disk type; move from the fullest
+    node to the emptiest while the spread shrinks)."""
+    nodes = list(topo.nodes)
+    disk_types = sorted(
+        {(v.disk_type or "hdd") for n in nodes for v in n.volumes} or {"hdd"}
+    )
+    plan: list[tuple[int, str, object, object]] = []  # vid, col, src, dst
+    for dt in disk_types:
+        entries = []
+        for n in nodes:
+            vols = {
+                v.id: v
+                for v in n.volumes
+                if (v.disk_type or "hdd") == dt
+                and (not collection or v.collection == collection)
+            }
+            entries.append(
+                {
+                    "node": n,
+                    "vols": vols,
+                    # replica safety: a volume must never move to a node
+                    # already holding ANY copy of it (regardless of
+                    # collection filter / disk type)
+                    "all_vids": {v.id for v in n.volumes},
+                    "cap": max(int(n.max_volume_count) or 8, 1),
+                }
+            )
+        if len(entries) < 2:
+            continue
+        while True:
+            entries.sort(key=lambda e: len(e["vols"]) / e["cap"])
+            lo, hi = entries[0], entries[-1]
+            # does moving one volume from hi to lo reduce the spread?
+            if (len(hi["vols"]) - 1) / hi["cap"] < (len(lo["vols"]) + 1) / lo[
+                "cap"
+            ] - 1e-9:
+                break
+            cand = next(
+                (
+                    v
+                    for v in hi["vols"].values()
+                    if v.id not in lo["all_vids"] and not v.read_only
+                ),
+                None,
+            )
+            if cand is None:
+                break
+            plan.append((cand.id, cand.collection, hi["node"], lo["node"]))
+            del hi["vols"][cand.id]
+            hi["all_vids"].discard(cand.id)
+            lo["vols"][cand.id] = cand
+            lo["all_vids"].add(cand.id)
+    return plan
+
+
+@command(
+    "volume.balance",
+    "[-collection c] [-apply] (plan/execute moves toward equal fullness per disk type)",
+    mutating=True,
+)
+def volume_balance(env: ShellEnv, args) -> str:
+    p = argparse.ArgumentParser(prog="volume.balance")
+    p.add_argument("-collection", default="")
+    p.add_argument("-apply", action="store_true")
+    a = p.parse_args(args)
+    topo = env.master.topology()
+    plan = _balance_plan(topo, a.collection)
+    if not plan:
+        return "already balanced"
+    lines = [
+        f"move volume {vid} ({col or 'default'}): {src.id} -> {dst.id}"
+        for vid, col, src, dst in plan
+    ]
+    if not a.apply:
+        return "\n".join(lines) + f"\n{len(plan)} move(s) planned (use -apply)"
+    done = []
+    for (vid, col, src, dst), line in zip(plan, lines):
+        dst_grpc = f"{dst.location.url.split(':')[0]}:{dst.location.grpc_port}"
+        src_grpc = f"{src.location.url.split(':')[0]}:{src.location.grpc_port}"
+        cmd = (
+            f"volume.move -volumeId {vid} -target {dst_grpc}"
+            f" -source {src_grpc}"
+        )
+        if col:
+            cmd += f" -collection {col}"
+        out = run_command(env, cmd)
+        done.append(f"{line}: {out}")
+        if out.startswith("error"):
+            done.append("stopping after error")
+            break
+    return "\n".join(done)
+
+
+# ---------------------------------------------------------------- s3 family
+
+
+def _filer_grpc(env: ShellEnv):
+    host, _, port = env.filer_addr.partition(":")
+    ch = grpc.insecure_channel(f"{host}:{int(port or 8888) + 10000}")
+    return ch, rpc.filer_stub(ch)
+
+
+def _s3_conf_load(stub) -> dict:
+    from ..pb import filer_pb2 as fpb
+    from ..s3.config import S3_IDENTITY_KV
+
+    r = stub.KvGet(fpb.FilerKvGetRequest(key=S3_IDENTITY_KV), timeout=10)
+    if not r.found or not r.value:
+        return {"identities": []}
+    import json as _json
+
+    try:
+        return _json.loads(r.value)
+    except _json.JSONDecodeError:
+        return {"identities": []}
+
+
+def _s3_conf_save(stub, conf: dict) -> None:
+    from ..pb import filer_pb2 as fpb
+    from ..s3.config import S3_IDENTITY_KV
+
+    import json as _json
+
+    stub.KvPut(
+        fpb.FilerKvPutRequest(
+            key=S3_IDENTITY_KV, value=_json.dumps(conf, indent=2).encode()
+        ),
+        timeout=10,
+    )
+
+
+@command(
+    "s3.configure",
+    "-user name [-actions A,B] [-access_key K -secret_key S] [-delete] (identity CRUD)",
+)
+def s3_configure(env: ShellEnv, args) -> str:
+    """Reference command_s3_configure.go: maintain the gateway identity
+    config (persisted in the filer; every gateway reloads it live)."""
+    p = argparse.ArgumentParser(prog="s3.configure")
+    p.add_argument("-user", required=True)
+    p.add_argument("-actions", default="")
+    p.add_argument("-access_key", default="")
+    p.add_argument("-secret_key", default="")
+    p.add_argument("-delete", action="store_true")
+    a = p.parse_args(args)
+    ch, stub = _filer_grpc(env)
+    with ch:
+        conf = _s3_conf_load(stub)
+        idents = conf.setdefault("identities", [])
+        if a.delete:
+            before = len(idents)
+            conf["identities"] = [i for i in idents if i.get("name") != a.user]
+            _s3_conf_save(stub, conf)
+            return f"deleted {before - len(conf['identities'])} credential(s) of {a.user}"
+        if bool(a.access_key) != bool(a.secret_key):
+            return "error: -access_key and -secret_key go together"
+        actions = [s for s in a.actions.split(",") if s]
+        existing = [i for i in idents if i.get("name") == a.user]
+        if a.access_key:
+            entry = {
+                "name": a.user,
+                "accessKey": a.access_key,
+                "secretKey": a.secret_key,
+                "actions": actions
+                or (existing[0].get("actions", ["Admin"]) if existing else ["Admin"]),
+            }
+            idents[:] = [
+                i for i in idents if i.get("accessKey") != a.access_key
+            ] + [entry]
+        elif actions:
+            if not existing:
+                return f"error: user {a.user} has no credentials yet (use s3.accesskey.create)"
+            for i in existing:
+                i["actions"] = actions
+        else:
+            return "error: nothing to do (-actions or key pair or -delete)"
+        _s3_conf_save(stub, conf)
+    return f"configured {a.user}"
+
+
+@command("s3.user.list", "list configured S3 identities")
+def s3_user_list(env: ShellEnv, args) -> str:
+    ch, stub = _filer_grpc(env)
+    with ch:
+        conf = _s3_conf_load(stub)
+    rows = [
+        f"{i.get('name', '?'):20s} {i.get('accessKey', ''):24s} "
+        f"{','.join(i.get('actions', [])) or 'policies:' + str(len(i.get('policies', [])))}"
+        for i in conf.get("identities", [])
+    ]
+    return "\n".join(rows) or "no identities configured (gateway is in open mode)"
+
+
+@command("s3.user.delete", "-user name (drop all the user's credentials)")
+def s3_user_delete(env: ShellEnv, args) -> str:
+    p = argparse.ArgumentParser(prog="s3.user.delete")
+    p.add_argument("-user", required=True)
+    a = p.parse_args(args)
+    return s3_configure(env, ["-user", a.user, "-delete"])
+
+
+@command("s3.accesskey.create", "-user name [-actions A,B] (generate a key pair)")
+def s3_accesskey_create(env: ShellEnv, args) -> str:
+    import secrets as _secrets
+
+    p = argparse.ArgumentParser(prog="s3.accesskey.create")
+    p.add_argument("-user", required=True)
+    p.add_argument("-actions", default="")
+    a = p.parse_args(args)
+    access_key = "SW" + _secrets.token_hex(9).upper()
+    secret_key = _secrets.token_urlsafe(30)
+    out = s3_configure(
+        env,
+        [
+            "-user", a.user,
+            "-access_key", access_key,
+            "-secret_key", secret_key,
+        ]
+        + (["-actions", a.actions] if a.actions else []),
+    )
+    if out.startswith("error"):
+        return out
+    return f"user={a.user}\naccess_key={access_key}\nsecret_key={secret_key}"
+
+
+@command("s3.accesskey.delete", "-access_key K")
+def s3_accesskey_delete(env: ShellEnv, args) -> str:
+    p = argparse.ArgumentParser(prog="s3.accesskey.delete")
+    p.add_argument("-access_key", required=True)
+    a = p.parse_args(args)
+    ch, stub = _filer_grpc(env)
+    with ch:
+        conf = _s3_conf_load(stub)
+        before = len(conf.get("identities", []))
+        conf["identities"] = [
+            i for i in conf.get("identities", []) if i.get("accessKey") != a.access_key
+        ]
+        _s3_conf_save(stub, conf)
+    return f"deleted {before - len(conf['identities'])} credential(s)"
+
+
+@command(
+    "s3.policy.put",
+    "-user name -policy '<json document>' (attach an IAM policy, replacing actions)",
+)
+def s3_policy_put(env: ShellEnv, args) -> str:
+    import json as _json
+
+    p = argparse.ArgumentParser(prog="s3.policy.put")
+    p.add_argument("-user", required=True)
+    p.add_argument("-policy", required=True)
+    a = p.parse_args(args)
+    try:
+        doc = _json.loads(a.policy)
+    except _json.JSONDecodeError as e:
+        return f"error: policy is not JSON: {e}"
+    if "Statement" not in doc:
+        return "error: policy has no Statement"
+    ch, stub = _filer_grpc(env)
+    with ch:
+        conf = _s3_conf_load(stub)
+        hit = [i for i in conf.get("identities", []) if i.get("name") == a.user]
+        if not hit:
+            return f"error: user {a.user} has no credentials yet"
+        for i in hit:
+            i["policies"] = [doc]
+            i["actions"] = []
+        _s3_conf_save(stub, conf)
+    return f"policy attached to {a.user} ({len(hit)} credential(s))"
+
+
+@command("s3.policy.get", "-user name")
+def s3_policy_get(env: ShellEnv, args) -> str:
+    import json as _json
+
+    p = argparse.ArgumentParser(prog="s3.policy.get")
+    p.add_argument("-user", required=True)
+    a = p.parse_args(args)
+    ch, stub = _filer_grpc(env)
+    with ch:
+        conf = _s3_conf_load(stub)
+    for i in conf.get("identities", []):
+        if i.get("name") == a.user and i.get("policies"):
+            return _json.dumps(i["policies"], indent=2)
+    return f"user {a.user} has no attached policies"
+
+
+@command("s3.policy.delete", "-user name (detach policies, restoring action-based auth)")
+def s3_policy_delete(env: ShellEnv, args) -> str:
+    p = argparse.ArgumentParser(prog="s3.policy.delete")
+    p.add_argument("-user", required=True)
+    p.add_argument("-actions", default="Admin")
+    a = p.parse_args(args)
+    ch, stub = _filer_grpc(env)
+    with ch:
+        conf = _s3_conf_load(stub)
+        hit = [i for i in conf.get("identities", []) if i.get("name") == a.user]
+        for i in hit:
+            i.pop("policies", None)
+            i["actions"] = [s for s in a.actions.split(",") if s]
+        _s3_conf_save(stub, conf)
+    return f"policies detached from {len(hit)} credential(s)"
+
+
+@command("s3.bucket.list", "list buckets (via the filer)")
+def s3_bucket_list(env: ShellEnv, args) -> str:
+    from ..pb import filer_pb2 as fpb
+
+    ch, stub = _filer_grpc(env)
+    rows = []
+    with ch:
+        for r in stub.ListEntries(
+            fpb.ListEntriesRequest(directory="/buckets", limit=10000),
+            timeout=30,
+        ):
+            if r.entry.is_directory and not r.entry.name.startswith("."):
+                rows.append(r.entry.name)
+    return "\n".join(sorted(rows)) or "no buckets"
+
+
+@command("s3.bucket.create", "-name bucket")
+def s3_bucket_create(env: ShellEnv, args) -> str:
+    from ..pb import filer_pb2 as fpb
+
+    p = argparse.ArgumentParser(prog="s3.bucket.create")
+    p.add_argument("-name", required=True)
+    a = p.parse_args(args)
+    entry = fpb.Entry(name=a.name, is_directory=True)
+    entry.attributes.file_mode = 0o40755
+    ch, stub = _filer_grpc(env)
+    with ch:
+        r = stub.LookupDirectoryEntry(
+            fpb.LookupEntryRequest(directory="/buckets", name=a.name), timeout=10
+        )
+        if not r.error:
+            return f"bucket {a.name} exists"
+        r = stub.CreateEntry(
+            fpb.CreateEntryRequest(directory="/buckets", entry=entry), timeout=10
+        )
+    return r.error or f"created bucket {a.name}"
+
+
+@command("s3.bucket.delete", "-name bucket [-force] (force = delete objects too)", mutating=True)
+def s3_bucket_delete(env: ShellEnv, args) -> str:
+    from ..pb import filer_pb2 as fpb
+
+    p = argparse.ArgumentParser(prog="s3.bucket.delete")
+    p.add_argument("-name", required=True)
+    p.add_argument("-force", action="store_true")
+    a = p.parse_args(args)
+    ch, stub = _filer_grpc(env)
+    with ch:
+        if not a.force:
+            for r in stub.ListEntries(
+                fpb.ListEntriesRequest(directory=f"/buckets/{a.name}", limit=2),
+                timeout=10,
+            ):
+                return f"error: bucket {a.name} not empty (use -force)"
+        r = stub.DeleteEntry(
+            fpb.DeleteEntryRequest(
+                directory="/buckets",
+                name=a.name,
+                is_recursive=True,
+                is_delete_data=True,
+            ),
+            timeout=60,
+        )
+    if r.error:
+        return f"error: {r.error}"
+    with contextlib.suppress(Exception):
+        env.master.collection_delete(a.name)
+    return f"deleted bucket {a.name}"
+
+
+@command("s3.clean.uploads", "[-timeAgo hours] purge stale multipart uploads")
+def s3_clean_uploads(env: ShellEnv, args) -> str:
+    import time as _time
+
+    from ..pb import filer_pb2 as fpb
+
+    p = argparse.ArgumentParser(prog="s3.clean.uploads")
+    p.add_argument("-timeAgo", type=float, default=24.0)
+    a = p.parse_args(args)
+    cutoff = _time.time() - a.timeAgo * 3600
+    ch, stub = _filer_grpc(env)
+    removed = []
+    with ch:
+        buckets = [
+            r.entry.name
+            for r in stub.ListEntries(
+                fpb.ListEntriesRequest(directory="/buckets", limit=10000),
+                timeout=30,
+            )
+            if r.entry.is_directory and not r.entry.name.startswith(".")
+        ]
+        for b in buckets:
+            updir = f"/buckets/{b}/.uploads"
+            for r in stub.ListEntries(
+                fpb.ListEntriesRequest(directory=updir, limit=10000), timeout=30
+            ):
+                if r.entry.attributes.mtime < cutoff:
+                    rr = stub.DeleteEntry(
+                        fpb.DeleteEntryRequest(
+                            directory=updir,
+                            name=r.entry.name,
+                            is_recursive=True,
+                            is_delete_data=True,
+                        ),
+                        timeout=60,
+                    )
+                    if not rr.error:
+                        removed.append(f"{b}/{r.entry.name}")
+    return "\n".join(removed) or "no stale uploads"

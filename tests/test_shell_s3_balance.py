@@ -1,0 +1,205 @@
+"""volume.balance + the s3.* shell family.
+
+Reference: weed/shell/command_volume_balance.go,
+command_s3_configure.go and friends — the gateway reloads the
+filer-persisted identity config live, so credentials minted in the
+shell authenticate within the store's refresh TTL.
+"""
+
+import time
+
+import pytest
+import requests
+
+from conftest import allocate_port as free_port
+from seaweedfs_tpu.client.operations import Operations
+from seaweedfs_tpu.filer import Filer, MemoryStore
+
+from seaweedfs_tpu.s3 import S3Server
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.shell.commands import ShellEnv, run_command
+from test_s3 import sign_request
+
+
+def wait_for(cond, timeout=15.0, msg="condition"):
+    deadline = time.time() + timeout
+    while not cond():
+        if time.time() > deadline:
+            raise TimeoutError(msg)
+        time.sleep(0.05)
+
+
+def test_volume_balance_migrates_to_empty_node(tmp_path):
+    mport = free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vs1 = VolumeServer(
+        directories=[str(tmp_path / "v1")],
+        master=f"localhost:{mport}",
+        ip="localhost",
+        port=free_port(),
+        ec_backend="cpu",
+    )
+    vs1.start()
+    vs2 = None
+    env = ops = None
+    try:
+        wait_for(lambda: master.topo.nodes, msg="node 1 registers")
+        env = ShellEnv(f"localhost:{mport}")
+        ops = Operations(f"localhost:{mport}")
+        # create several volumes, all on node 1
+        out = run_command(env, "volume.grow -count 4")
+        assert "grew" in out or "volume" in out.lower()
+        ops.upload(b"ballast" * 1000)
+
+        # node 2 joins empty
+        vs2 = VolumeServer(
+            directories=[str(tmp_path / "v2")],
+            master=f"localhost:{mport}",
+            ip="localhost",
+            port=free_port(),
+            ec_backend="cpu",
+        )
+        vs2.start()
+        wait_for(lambda: len(master.topo.nodes) >= 2, msg="node 2 registers")
+
+        # dry run first: a plan must exist and execute nothing
+        plan = run_command(env, "volume.balance")
+        assert "planned" in plan and "->" in plan
+        topo = env.master.topology()
+        counts = {n.id: len(n.volumes) for n in topo.nodes}
+        assert min(counts.values()) == 0  # dry run moved nothing
+
+        out = run_command(env, "volume.balance -apply")
+        assert "error" not in out.splitlines()[0], out
+
+        def balanced():
+            topo = env.master.topology()
+            counts = {n.id: len(n.volumes) for n in topo.nodes}
+            return len(counts) == 2 and min(counts.values()) >= 1
+
+        wait_for(balanced, msg="volumes migrated toward balance")
+        # a second run converges
+        assert "already balanced" in run_command(env, "volume.balance")
+    finally:
+        if ops:
+            ops.close()
+        if env:
+            env.close()
+        if vs2:
+            vs2.stop()
+        vs1.stop()
+        master.stop()
+
+
+@pytest.fixture
+def s3_stack(tmp_path):
+    mport = free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path / "v")],
+        master=f"localhost:{mport}",
+        ip="localhost",
+        port=free_port(),
+        ec_backend="cpu",
+    )
+    vs.start()
+    wait_for(lambda: master.topo.nodes, msg="vs registers")
+    filer = Filer(MemoryStore(), master=f"localhost:{mport}")
+    # a REAL FilerServer so the shell reaches the gRPC KV on the
+    # conventional http_port + 10000
+    from seaweedfs_tpu.server.filer_server import FilerServer
+
+    fport = free_port()
+    fsrv = FilerServer(
+        filer, ip="localhost", port=fport, grpc_port=fport + 10000
+    )
+    fsrv.start()
+    s3 = S3Server(filer, ip="localhost", port=free_port())
+    s3.start()
+    yield master, filer, s3, fport
+    s3.stop()
+    fsrv.stop()
+    filer.close()
+    vs.stop()
+    master.stop()
+
+
+def test_s3_accesskey_lifecycle(s3_stack):
+    master, filer, s3, fport = s3_stack
+    url = f"http://localhost:{s3.port}"
+    env = ShellEnv(f"localhost:{master.port}", filer=f"localhost:{fport}")
+    try:
+        # open mode before any identity exists
+        assert requests.put(f"{url}/openbkt").status_code == 200
+
+        out = run_command(env, "s3.accesskey.create -user ops -actions Admin")
+        assert "access_key=" in out, out
+        kv = dict(
+            line.split("=", 1) for line in out.splitlines() if "=" in line
+        )
+        ak, sk = kv["access_key"], kv["secret_key"]
+
+        assert "ops" in run_command(env, "s3.user.list")
+
+        # the identity store refresh TTL is 2s; the gateway flips to
+        # authenticated mode and the new key pair signs requests
+        def auth_enforced():
+            return requests.put(f"{url}/denied").status_code == 403
+
+        wait_for(auth_enforced, msg="gateway leaves open mode")
+        h = sign_request("PUT", f"{url}/shellbkt", ak, sk)
+        assert requests.put(f"{url}/shellbkt", headers=h).status_code == 200
+        body = b"via shell-minted credentials"
+        h = sign_request("PUT", f"{url}/shellbkt/k", ak, sk, body)
+        assert (
+            requests.put(f"{url}/shellbkt/k", data=body, headers=h).status_code
+            == 200
+        )
+        h = sign_request("GET", f"{url}/shellbkt/k", ak, sk)
+        assert requests.get(f"{url}/shellbkt/k", headers=h).content == body
+
+        # attach a read-only policy: writes now denied, reads pass
+        pol = (
+            '{"Version":"2012-10-17","Statement":[{"Effect":"Allow",'
+            '"Action":["s3:GetObject","s3:ListBucket"],'
+            '"Resource":["arn:aws:s3:::*"]}]}'
+        )
+        out = run_command(env, f"s3.policy.put -user ops -policy '{pol}'")
+        assert "attached" in out, out
+        assert "s3:GetObject" in run_command(env, "s3.policy.get -user ops")
+
+        def policy_applied():
+            h = sign_request("PUT", f"{url}/shellbkt/deny", ak, sk, b"x")
+            return (
+                requests.put(
+                    f"{url}/shellbkt/deny", data=b"x", headers=h
+                ).status_code
+                == 403
+            )
+
+        wait_for(policy_applied, msg="policy reload")
+        h = sign_request("GET", f"{url}/shellbkt/k", ak, sk)
+        assert requests.get(f"{url}/shellbkt/k", headers=h).content == body
+
+        # bucket family + key deletion
+        assert "shellbkt" in run_command(env, "s3.bucket.list")
+        run_command(env, "s3.bucket.create -name fromshell")
+        assert "fromshell" in run_command(env, "s3.bucket.list")
+        out = run_command(env, "s3.bucket.delete -name fromshell")
+        assert "deleted" in out
+
+        out = run_command(env, f"s3.accesskey.delete -access_key {ak}")
+        assert "deleted 1" in out
+
+        def key_revoked():
+            h = sign_request("GET", f"{url}/shellbkt/k", ak, sk)
+            return (
+                requests.get(f"{url}/shellbkt/k", headers=h).status_code == 403
+            )
+
+        wait_for(key_revoked, msg="revoked key stops working")
+    finally:
+        env.close()
