@@ -44,8 +44,17 @@ class KafkaClient:
     # ------------------------------------------------------------ framing
 
     def _call(
-        self, api_key: int, api_version: int, body: bytes, oneway: bool = False
+        self,
+        api_key: int,
+        api_version: int,
+        body: bytes,
+        oneway: bool = False,
+        flexible: bool = False,
+        resp_header_tags: bool | None = None,
     ) -> Reader | None:
+        """flexible: request header v2 (tagged fields after client_id).
+        resp_header_tags: response header v1; defaults to `flexible`
+        except for ApiVersions whose response header is always v0."""
         with self._lock:
             self._corr += 1
             corr = self._corr
@@ -55,9 +64,10 @@ class KafkaClient:
                 .i16(api_version)
                 .i32(corr)
                 .nullable_string(self.client_id)
-                .done()
             )
-            frame = head + body
+            if flexible:
+                head.tags()
+            frame = head.done() + body
             self._sock.sendall(struct.pack(">i", len(frame)) + frame)
             if oneway:
                 return None
@@ -67,6 +77,10 @@ class KafkaClient:
         got = r.i32()
         if got != corr:
             raise KafkaError(-1, f"correlation mismatch {got} != {corr}")
+        if resp_header_tags is None:
+            resp_header_tags = flexible and api_key != kp.API_VERSIONS
+        if resp_header_tags:
+            r.tagged_fields()
         return r
 
     def _read_exact(self, n: int) -> bytes:
@@ -78,7 +92,31 @@ class KafkaClient:
             buf += chunk
         return buf
 
-    def _fetch_api_versions(self) -> dict[int, tuple[int, int]]:
+    def _fetch_api_versions(self, version: int = 3) -> dict[int, tuple[int, int]]:
+        if version >= 3:
+            body = (
+                Writer()
+                .compact_string("seaweedfs-tpu")
+                .compact_string("r4")
+                .tags()
+                .done()
+            )
+            r = self._call(kp.API_VERSIONS, 3, body, flexible=True)
+            err = r.i16()
+            if err == kp.UNSUPPORTED_VERSION:
+                return self._fetch_api_versions(version=0)
+            if err:
+                raise KafkaError(err, "ApiVersions")
+            out = {}
+            for _ in range(max(r.uvarint() - 1, 0)):
+                key = r.i16()
+                lo = r.i16()
+                hi = r.i16()
+                r.tagged_fields()
+                out[key] = (lo, hi)
+            r.i32()  # throttle
+            r.tagged_fields()
+            return out
         r = self._call(kp.API_VERSIONS, 0, b"")
         err = r.i16()
         if err:
@@ -160,22 +198,55 @@ class KafkaClient:
         partition: int,
         records: list[Record],
         acks: int = -1,
+        version: int = 9,
+        compression: int = 0,
     ) -> int:
-        """Returns the base offset assigned to the first record."""
-        base = encode_batch(records, base_offset=0)
+        """Returns the base offset assigned to the first record.
+        version 9 uses the flexible (KIP-482) encoding; compression is
+        the batch codec id (0 none, 1 gzip, 2 snappy, 3 lz4, 4 zstd)."""
+        base = encode_batch(records, base_offset=0, compression=compression)
+        flex = version >= 9
         w = Writer()
-        w.nullable_string(None)  # transactional_id
-        w.i16(acks).i32(10_000)
-        w.array(
-            [(topic, partition, base)],
-            lambda ww, tp: ww.string(tp[0]).array(
-                [tp],
-                lambda w3, tp2: w3.i32(tp2[1]).bytes_(tp2[2]),
-            ),
+        if flex:
+            w.compact_nullable_string(None)  # transactional_id
+            w.i16(acks).i32(10_000)
+            w.compact_array(
+                [(topic, partition, base)],
+                lambda ww, tp: ww.compact_string(tp[0])
+                .compact_array(
+                    [tp],
+                    lambda w3, tp2: w3.i32(tp2[1])
+                    .compact_nullable_bytes(tp2[2])
+                    .tags(),
+                )
+                .tags(),
+            )
+            w.tags()
+        else:
+            w.nullable_string(None)  # transactional_id
+            w.i16(acks).i32(10_000)
+            w.array(
+                [(topic, partition, base)],
+                lambda ww, tp: ww.string(tp[0]).array(
+                    [tp],
+                    lambda w3, tp2: w3.i32(tp2[1]).bytes_(tp2[2]),
+                ),
+            )
+        r = self._call(
+            kp.PRODUCE, version, w.done(), oneway=(acks == 0), flexible=flex
         )
-        r = self._call(kp.PRODUCE, 3, w.done(), oneway=(acks == 0))
         if r is None:
             return -1
+        if flex:
+            r.uvarint()  # topics count (compact)
+            r.compact_string()
+            r.uvarint()  # partitions count
+            r.i32()  # index
+            err = r.i16()
+            base_offset = r.i64()
+            if err:
+                raise KafkaError(err, "Produce")
+            return base_offset
         r.i32()  # topics count
         r.string()
         r.i32()  # partitions count
@@ -195,19 +266,39 @@ class KafkaClient:
         offset: int,
         max_wait_ms: int = 100,
         max_bytes: int = 4 * 1024 * 1024,
+        version: int = 11,
     ) -> tuple[int, list[Record]]:
         """Returns (high_watermark, records)."""
         w = Writer()
         w.i32(-1).i32(max_wait_ms).i32(1).i32(max_bytes).i8(0)
+        if version >= 7:
+            w.i32(0)  # session_id
+            w.i32(-1)  # session_epoch (-1 = full fetch, no session)
+
+        def part_fields(w3: Writer, tp2):
+            w3.i32(tp2[1])
+            if version >= 9:
+                w3.i32(-1)  # current_leader_epoch
+            w3.i64(tp2[2])
+            if version >= 5:
+                w3.i64(0)  # log_start_offset
+            w3.i32(max_bytes)
+
         w.array(
             [(topic, partition, offset)],
-            lambda ww, tp: ww.string(tp[0]).array(
-                [tp],
-                lambda w3, tp2: w3.i32(tp2[1]).i64(tp2[2]).i32(max_bytes),
-            ),
+            lambda ww, tp: ww.string(tp[0]).array([tp], part_fields),
         )
-        r = self._call(kp.FETCH, 4, w.done())
+        if version >= 7:
+            w.array([], lambda *_: None)  # forgotten_topics_data
+        if version >= 11:
+            w.nullable_string(None)  # rack_id
+        r = self._call(kp.FETCH, version, w.done())
         r.i32()  # throttle
+        if version >= 7:
+            top_err = r.i16()
+            r.i32()  # session_id
+            if top_err:
+                raise KafkaError(top_err, "Fetch")
         r.i32()  # topics count
         r.string()
         r.i32()  # partitions count
@@ -215,7 +306,11 @@ class KafkaClient:
         err = r.i16()
         hw = r.i64()
         r.i64()  # last_stable
+        if version >= 5:
+            r.i64()  # log_start_offset
         r.array(lambda: (r.i64(), r.i64()))  # aborted txns (pid, first_offset)
+        if version >= 11:
+            r.i32()  # preferred_read_replica
         blob = r.nullable_bytes()
         if err:
             raise KafkaError(err, "Fetch")

@@ -32,22 +32,31 @@ NAMESPACE = "kafka"
 
 # api_key -> (min_version, max_version) actually implemented
 _API_RANGES: dict[int, tuple[int, int]] = {
-    kp.PRODUCE: (3, 7),
-    kp.FETCH: (4, 5),
-    kp.LIST_OFFSETS: (0, 2),
-    kp.METADATA: (0, 5),
-    kp.OFFSET_COMMIT: (0, 3),
-    kp.OFFSET_FETCH: (0, 3),
-    kp.FIND_COORDINATOR: (0, 1),
-    kp.JOIN_GROUP: (0, 2),
-    kp.HEARTBEAT: (0, 1),
-    kp.LEAVE_GROUP: (0, 1),
-    kp.SYNC_GROUP: (0, 1),
-    kp.DESCRIBE_GROUPS: (0, 1),
-    kp.LIST_GROUPS: (0, 1),
-    kp.API_VERSIONS: (0, 2),
-    kp.CREATE_TOPICS: (0, 2),
-    kp.DELETE_TOPICS: (0, 1),
+    kp.PRODUCE: (3, 9),
+    kp.FETCH: (4, 11),
+    kp.LIST_OFFSETS: (0, 5),
+    kp.METADATA: (0, 8),
+    kp.OFFSET_COMMIT: (0, 7),
+    kp.OFFSET_FETCH: (0, 5),
+    kp.FIND_COORDINATOR: (0, 2),
+    kp.JOIN_GROUP: (0, 5),
+    kp.HEARTBEAT: (0, 3),
+    kp.LEAVE_GROUP: (0, 3),
+    kp.SYNC_GROUP: (0, 3),
+    kp.DESCRIBE_GROUPS: (0, 4),
+    kp.LIST_GROUPS: (0, 2),
+    kp.API_VERSIONS: (0, 3),
+    kp.CREATE_TOPICS: (0, 4),
+    kp.DELETE_TOPICS: (0, 3),
+}
+
+# First FLEXIBLE (KIP-482 compact/tagged) version per api. Requests at
+# or above it use request-header v2 (tagged fields after client_id) and
+# response-header v1 — except ApiVersions, whose response header stays
+# v0 so a downgrading client can always parse it.
+_FLEXIBLE: dict[int, int] = {
+    kp.PRODUCE: 9,
+    kp.API_VERSIONS: 3,
 }
 
 NODE_ID = 0
@@ -141,18 +150,25 @@ class KafkaGateway:
         api_key = r.i16()
         api_version = r.i16()
         correlation_id = r.i32()
-        r.nullable_string()  # client_id
+        r.nullable_string()  # client_id (NON-compact even in header v2)
         out = Writer().i32(correlation_id)
         lo_hi = _API_RANGES.get(api_key)
         if lo_hi is None or not lo_hi[0] <= api_version <= lo_hi[1]:
             # KIP-511: answer an out-of-range ApiVersions with a v0 body
             # carrying UNSUPPORTED_VERSION + our ranges so the client
-            # can downgrade; other apis get the error-only body.
+            # can downgrade; other apis get the error-only body. The
+            # body (and any header tags) of an unknown future version
+            # is never parsed — its layout is unknowable.
             if api_key == kp.API_VERSIONS:
                 self._api_versions_body(out, 0, kp.UNSUPPORTED_VERSION)
                 return out.done()
             out.i16(kp.UNSUPPORTED_VERSION)
             return out.done()
+        flexible = api_version >= _FLEXIBLE.get(api_key, 1 << 30)
+        if flexible:
+            r.tagged_fields()  # request header v2
+            if api_key != kp.API_VERSIONS:
+                out.tags()  # response header v1
         handler = {
             kp.API_VERSIONS: self._h_api_versions,
             kp.METADATA: self._h_metadata,
@@ -195,6 +211,18 @@ class KafkaGateway:
 
     def _api_versions_body(self, w: Writer, version: int, error: int) -> None:
         w.i16(error)
+        if version >= 3:
+            # flexible body (compact array + per-entry tags)
+            w.compact_array(
+                sorted(_API_RANGES.items()),
+                lambda ww, kv: ww.i16(kv[0])
+                .i16(kv[1][0])
+                .i16(kv[1][1])
+                .tags(),
+            )
+            w.i32(0)  # throttle_time_ms
+            w.tags()
+            return
         w.array(
             sorted(_API_RANGES.items()),
             lambda ww, kv: ww.i16(kv[0]).i16(kv[1][0]).i16(kv[1][1]),
@@ -203,6 +231,10 @@ class KafkaGateway:
             w.i32(0)  # throttle_time_ms
 
     def _h_api_versions(self, r: Reader, v: int) -> bytes:
+        if v >= 3:
+            r.compact_string()  # client_software_name
+            r.compact_string()  # client_software_version
+            r.tagged_fields()
         w = Writer()
         self._api_versions_body(w, v, kp.NONE)
         return w.done()
@@ -219,6 +251,9 @@ class KafkaGateway:
         allow_auto = True
         if v >= 4:
             allow_auto = r.i8() != 0
+        if v >= 8:
+            r.i8()  # include_cluster_authorized_operations
+            r.i8()  # include_topic_authorized_operations
         existing = {
             name
             for ns, name, _c in self.broker.list_topics()
@@ -262,6 +297,8 @@ class KafkaGateway:
                 if v >= 1:
                     ww.i8(0)  # is_internal
                 ww.i32(0)  # empty partitions
+                if v >= 8:
+                    ww.i32(-2147483648)  # topic_authorized_operations
                 return
             ww.i16(kp.NONE).string(name)
             if v >= 1:
@@ -269,27 +306,43 @@ class KafkaGateway:
 
             def part_entry(w3: Writer, p: int):
                 w3.i16(kp.NONE).i32(p).i32(NODE_ID)
+                if v >= 7:
+                    w3.i32(0)  # leader_epoch
                 w3.array([NODE_ID], lambda w4, nid: w4.i32(nid))  # replicas
                 w3.array([NODE_ID], lambda w4, nid: w4.i32(nid))  # isr
                 if v >= 5:
                     w3.array([], lambda w4, nid: w4.i32(nid))  # offline
 
             ww.array(list(range(count)), part_entry)
+            if v >= 8:
+                ww.i32(-2147483648)  # topic_authorized_operations (unset)
 
         w.array(topics, topic_entry)
+        if v >= 8:
+            w.i32(-2147483648)  # cluster_authorized_operations (unset)
         return w.done()
 
     def _h_produce(self, r: Reader, v: int) -> bytes | None:
-        r.nullable_string()  # transactional_id (v3+)
+        flex = v >= 9
+        if flex:
+            r.compact_nullable_string()  # transactional_id
+        else:
+            r.nullable_string()
         acks = r.i16()
         r.i32()  # timeout_ms
         results: list[tuple[str, list[tuple[int, int, int]]]] = []
-        for _ in range(r.i32()):
-            topic = r.string()
+        ntopics = r.uvarint() - 1 if flex else r.i32()
+        for _ in range(max(ntopics, 0)):
+            topic = r.compact_string() if flex else r.string()
             parts: list[tuple[int, int, int]] = []  # (part, error, base)
-            for _p in range(r.i32()):
+            nparts = r.uvarint() - 1 if flex else r.i32()
+            for _p in range(max(nparts, 0)):
                 part = r.i32()
-                blob = r.nullable_bytes() or b""
+                blob = (
+                    r.compact_nullable_bytes() if flex else r.nullable_bytes()
+                ) or b""
+                if flex:
+                    r.tagged_fields()  # partition-struct tags
                 plog = self._log_for(topic, part)
                 if plog is None:
                     parts.append((part, kp.UNKNOWN_TOPIC_OR_PARTITION, -1))
@@ -324,14 +377,21 @@ class KafkaGateway:
                         ]
                     )
                 parts.append((part, kp.NONE, base))
+            if flex:
+                r.tagged_fields()  # topic-struct tags
             results.append((topic, parts))
+        if flex:
+            r.tagged_fields()  # request tags
         if acks == 0:
             return None
         w = Writer()
 
         def topic_entry(ww: Writer, tp):
             name, parts = tp
-            ww.string(name)
+            if flex:
+                ww.compact_string(name)
+            else:
+                ww.string(name)
 
             def part_entry(w3: Writer, pr):
                 part, err, base = pr
@@ -340,11 +400,28 @@ class KafkaGateway:
                     w3.i64(-1)  # log_append_time
                 if v >= 5:
                     w3.i64(0)  # log_start_offset
+                if v >= 8:
+                    # record_errors + error_message
+                    if flex:
+                        w3.compact_array([], lambda *_: None)
+                        w3.compact_nullable_string(None)
+                        w3.tags()
+                    else:
+                        w3.array([], lambda *_: None)
+                        w3.nullable_string(None)
 
-            ww.array(parts, part_entry)
+            if flex:
+                ww.compact_array(parts, part_entry).tags()
+            else:
+                ww.array(parts, part_entry)
 
-        w.array(results, topic_entry)
-        w.i32(0)  # throttle (v1+)
+        if flex:
+            w.compact_array(results, topic_entry)
+            w.i32(0)  # throttle
+            w.tags()
+        else:
+            w.array(results, topic_entry)
+            w.i32(0)  # throttle (v1+)
         return w.done()
 
     def _h_fetch(self, r: Reader, v: int) -> bytes:
@@ -353,18 +430,32 @@ class KafkaGateway:
         r.i32()  # min_bytes
         r.i32()  # max_bytes (v3+)
         r.i8()  # isolation_level (v4+)
+        if v >= 7:
+            # incremental fetch sessions (KIP-227): not maintained —
+            # responding session_id=0 tells the client "no session",
+            # so it keeps sending full fetches (legal, just uncached)
+            r.i32()  # session_id
+            r.i32()  # session_epoch
         requests: list[tuple[str, list[tuple[int, int, int]]]] = []
         for _ in range(r.i32()):
             topic = r.string()
             parts = []
             for _p in range(r.i32()):
                 part = r.i32()
+                if v >= 9:
+                    r.i32()  # current_leader_epoch
                 fetch_offset = r.i64()
                 if v >= 5:
                     r.i64()  # log_start_offset
                 pmax = r.i32()
                 parts.append((part, fetch_offset, pmax))
             requests.append((topic, parts))
+        if v >= 7:
+            for _ in range(max(r.i32(), 0)):  # forgotten_topics_data
+                r.string()
+                r.array(r.i32)
+        if v >= 11:
+            r.nullable_string()  # rack_id
         # long-poll: when every requested partition is empty, block on
         # the log's condition (single-partition fetch, the common
         # consumer shape) or poll coarsely. Partitions are re-resolved
@@ -393,6 +484,9 @@ class KafkaGateway:
                 time.sleep(min(0.05, remaining))
         w = Writer()
         w.i32(0)  # throttle
+        if v >= 7:
+            w.i16(kp.NONE)  # top-level error
+            w.i32(0)  # session_id (0 = no fetch session)
 
         def topic_entry(ww: Writer, tp):
             name, parts = tp
@@ -407,6 +501,8 @@ class KafkaGateway:
                     if v >= 5:
                         w3.i64(-1)
                     w3.array([], lambda *_: None)
+                    if v >= 11:
+                        w3.i32(-1)  # preferred_read_replica
                     w3.nullable_bytes(None)
                     return
                 hw = plog.next_offset
@@ -416,6 +512,8 @@ class KafkaGateway:
                     if v >= 5:
                         w3.i64(plog.earliest_offset)
                     w3.array([], lambda *_: None)
+                    if v >= 11:
+                        w3.i32(-1)
                     w3.nullable_bytes(None)
                     return
                 recs = plog.read_from(off, max_records=1024)
@@ -449,6 +547,8 @@ class KafkaGateway:
                 if v >= 5:
                     w3.i64(plog.earliest_offset)
                 w3.array([], lambda *_: None)  # aborted_transactions
+                if v >= 11:
+                    w3.i32(-1)  # preferred_read_replica
                 w3.nullable_bytes(batch if batch else None)
 
             ww.array(parts, part_entry)
@@ -466,6 +566,8 @@ class KafkaGateway:
             parts = []
             for _p in range(r.i32()):
                 part = r.i32()
+                if v >= 4:
+                    r.i32()  # current_leader_epoch
                 ts = r.i64()
                 if v == 0:
                     r.i32()  # max_num_offsets
@@ -498,6 +600,8 @@ class KafkaGateway:
                     )
                 else:
                     w3.i64(ts if err == kp.NONE else -1).i64(off)
+                    if v >= 4:
+                        w3.i32(-1)  # leader_epoch
 
             ww.array(parts, part_entry)
 
@@ -589,8 +693,10 @@ class KafkaGateway:
         if v >= 1:
             r.i32()  # generation
             r.string()  # member
-        if v >= 2:
-            r.i64()  # retention_time
+        if 2 <= v <= 4:
+            r.i64()  # retention_time (removed in v5)
+        if v >= 7:
+            r.nullable_string()  # group_instance_id
         results = []
         for _ in range(r.i32()):
             topic = r.string()
@@ -598,6 +704,8 @@ class KafkaGateway:
             for _p in range(r.i32()):
                 part = r.i32()
                 offset = r.i64()
+                if v >= 6:
+                    r.i32()  # committed_leader_epoch
                 if v == 1:
                     r.i64()  # commit timestamp
                 r.nullable_string()  # metadata
@@ -644,7 +752,10 @@ class KafkaGateway:
 
             def part_entry(w3: Writer, part: int):
                 off = self.broker.fetch_offset(NAMESPACE, name, part, group)
-                w3.i32(part).i64(off).nullable_string(None).i16(kp.NONE)
+                w3.i32(part).i64(off)
+                if v >= 5:
+                    w3.i32(-1)  # committed_leader_epoch
+                w3.nullable_string(None).i16(kp.NONE)
 
             ww.array(parts, part_entry)
 
@@ -662,6 +773,8 @@ class KafkaGateway:
         if v >= 1:
             rebalance_timeout = r.i32() / 1000.0
         member_id = r.string()
+        if v >= 5:
+            r.nullable_string()  # group_instance_id
         protocol_type = r.string()
         protocols = [
             (p_name, p_meta)
@@ -687,16 +800,22 @@ class KafkaGateway:
             return w.done()
         w.i16(kp.NONE).i32(resp["generation"]).string(resp["protocol"])
         w.string(resp["leader"]).string(resp["member_id"])
-        w.array(
-            resp["members"],
-            lambda ww, m: ww.string(m[0]).bytes_(m[1]),
-        )
+
+        def member_entry(ww: Writer, m):
+            ww.string(m[0])
+            if v >= 5:
+                ww.nullable_string(None)  # group_instance_id
+            ww.bytes_(m[1])
+
+        w.array(resp["members"], member_entry)
         return w.done()
 
     def _h_sync_group(self, r: Reader, v: int) -> bytes:
         group_id = r.string()
         generation = r.i32()
         member_id = r.string()
+        if v >= 3:
+            r.nullable_string()  # group_instance_id
         assignments = [
             (mid, blob)
             for mid, blob in (
@@ -718,6 +837,8 @@ class KafkaGateway:
         group_id = r.string()
         generation = r.i32()
         member_id = r.string()
+        if v >= 3:
+            r.nullable_string()  # group_instance_id
         g = self.coordinator.lookup(group_id)
         err = (
             kp.UNKNOWN_MEMBER_ID
@@ -732,13 +853,36 @@ class KafkaGateway:
 
     def _h_leave_group(self, r: Reader, v: int) -> bytes:
         group_id = r.string()
-        member_id = r.string()
+        if v >= 3:
+            # batch leave (KIP-345): members array replaces member_id
+            members = [
+                (r.string(), r.nullable_string()) for _ in range(r.i32())
+            ]
+        else:
+            members = [(r.string(), None)]
         g = self.coordinator.lookup(group_id)
-        err = kp.UNKNOWN_MEMBER_ID if g is None else g.leave(member_id)
+        results = [
+            (
+                mid,
+                gid,
+                kp.UNKNOWN_MEMBER_ID if g is None else g.leave(mid),
+            )
+            for mid, gid in members
+        ]
+        top_err = next(
+            (err for _, _, err in results if err != kp.NONE), kp.NONE
+        )
         w = Writer()
         if v >= 1:
             w.i32(0)
-        w.i16(err)
+        w.i16(top_err if v < 3 else kp.NONE)
+        if v >= 3:
+            w.array(
+                results,
+                lambda ww, m: ww.string(m[0])
+                .nullable_string(m[1])
+                .i16(m[2]),
+            )
         return w.done()
 
     def _h_list_groups(self, r: Reader, v: int) -> bytes:
@@ -754,6 +898,8 @@ class KafkaGateway:
 
     def _h_describe_groups(self, r: Reader, v: int) -> bytes:
         names = r.array(r.string)
+        if v >= 3:
+            r.i8()  # include_authorized_operations
         w = Writer()
         if v >= 1:
             w.i32(0)
@@ -764,17 +910,24 @@ class KafkaGateway:
                 ww.i16(kp.NONE).string(name).string("Dead")
                 ww.string("").string("")
                 ww.array([], lambda *_: None)
+                if v >= 3:
+                    ww.i32(-2147483648)  # authorized_operations (unset)
                 return
             with g.lock:
                 ww.i16(kp.NONE).string(name).string(g.state)
                 ww.string(g.protocol_type).string(g.protocol_name)
 
                 def member_entry(w3: Writer, m):
-                    w3.string(m.member_id).string(m.client_id)
+                    w3.string(m.member_id)
+                    if v >= 4:
+                        w3.nullable_string(None)  # group_instance_id
+                    w3.string(m.client_id)
                     w3.string("/127.0.0.1")
                     w3.bytes_(g._metadata_for(m)).bytes_(m.assignment)
 
                 ww.array(list(g.members.values()), member_entry)
+            if v >= 3:
+                ww.i32(-2147483648)
 
         w.array(names, entry)
         return w.done()

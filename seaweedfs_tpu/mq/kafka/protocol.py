@@ -2,9 +2,9 @@
 
 Reference: weed/mq/kafka/protocol — the Kafka binary protocol's
 big-endian primitives: INT8/16/32/64, STRING (i16 length), NULLABLE_
-STRING, BYTES (i32 length), ARRAY (i32 count), plus the zigzag varints
-used inside record batches. Only non-flexible (pre-KIP-482) request
-versions are advertised, so compact/tagged encodings are not needed.
+STRING, BYTES (i32 length), ARRAY (i32 count), the zigzag varints used
+inside record batches, and the KIP-482 flexible (compact/tagged)
+encodings used by Produce v9+ and ApiVersions v3+.
 """
 
 from __future__ import annotations
@@ -93,6 +93,44 @@ class Reader:
     def varlong(self) -> int:
         return self.varint()
 
+    # KIP-482 flexible (compact) encodings: length+1 as uvarint, 0=null
+    def compact_string(self) -> str:
+        n = self.uvarint()
+        if n == 0:
+            raise ValueError("non-nullable compact string was null")
+        return self._take(n - 1).decode("utf-8")
+
+    def compact_nullable_string(self) -> str | None:
+        n = self.uvarint()
+        if n == 0:
+            return None
+        return self._take(n - 1).decode("utf-8")
+
+    def compact_bytes(self) -> bytes:
+        n = self.uvarint()
+        if n == 0:
+            raise ValueError("non-nullable compact bytes was null")
+        return self._take(n - 1)
+
+    def compact_nullable_bytes(self) -> bytes | None:
+        n = self.uvarint()
+        if n == 0:
+            return None
+        return self._take(n - 1)
+
+    def compact_array(self, fn) -> list:
+        n = self.uvarint()
+        if n == 0:
+            return []
+        return [fn() for _ in range(n - 1)]
+
+    def tagged_fields(self) -> None:
+        """Skip a tagged-field section (we define none)."""
+        for _ in range(self.uvarint()):
+            self.uvarint()  # tag
+            size = self.uvarint()
+            self._take(size)
+
 
 class Writer:
     def __init__(self):
@@ -139,6 +177,37 @@ class Writer:
         for it in items:
             fn(self, it)
         return self
+
+    # KIP-482 flexible (compact) encodings
+    def uvarint(self, v: int) -> "Writer":
+        return self.raw(write_uvarint(v))
+
+    def compact_string(self, s: str) -> "Writer":
+        b = s.encode("utf-8")
+        return self.uvarint(len(b) + 1).raw(b)
+
+    def compact_nullable_string(self, s: str | None) -> "Writer":
+        if s is None:
+            return self.uvarint(0)
+        return self.compact_string(s)
+
+    def compact_bytes(self, b: bytes) -> "Writer":
+        return self.uvarint(len(b) + 1).raw(b)
+
+    def compact_nullable_bytes(self, b: bytes | None) -> "Writer":
+        if b is None:
+            return self.uvarint(0)
+        return self.compact_bytes(b)
+
+    def compact_array(self, items, fn) -> "Writer":
+        self.uvarint(len(items) + 1)
+        for it in items:
+            fn(self, it)
+        return self
+
+    def tags(self) -> "Writer":
+        """Empty tagged-field section."""
+        return self.raw(b"\x00")
 
     def done(self) -> bytes:
         return b"".join(self.parts)

@@ -40,6 +40,9 @@ _POST_CRC = struct.Struct(">hiqqqhii")
 
 COMPRESSION_NONE = 0
 COMPRESSION_GZIP = 1
+COMPRESSION_SNAPPY = 2
+COMPRESSION_LZ4 = 3
+COMPRESSION_ZSTD = 4
 
 
 @dataclass
@@ -81,8 +84,11 @@ def _encode_record(
     return write_varint(len(body)) + bytes(body)
 
 
-def encode_batch(records: list[Record], base_offset: int = 0) -> bytes:
-    """Uncompressed record batch v2 for a Fetch response."""
+def encode_batch(
+    records: list[Record], base_offset: int = 0, compression: int = 0
+) -> bytes:
+    """Record batch v2; `compression` is the attributes codec id
+    (0 none, 1 gzip, 2 snappy, 3 lz4, 4 zstd)."""
     if not records:
         return b""
     base_ts = records[0].timestamp_ms or int(time.time() * 1000)
@@ -95,10 +101,19 @@ def encode_batch(records: list[Record], base_offset: int = 0) -> bytes:
         )
         for r in records
     )
+    if compression != COMPRESSION_NONE:
+        from . import codecs as _codecs
+
+        recs = {
+            COMPRESSION_GZIP: gzip.compress,
+            COMPRESSION_SNAPPY: _codecs.snappy_compress,
+            COMPRESSION_LZ4: _codecs.lz4_compress,
+            COMPRESSION_ZSTD: _codecs.zstd_compress,
+        }[compression](recs)
     last_delta = records[-1].offset - base_offset
     post_crc = (
         _POST_CRC.pack(
-            0,  # attributes: no compression
+            compression,  # attributes bits 0-2
             last_delta,
             base_ts,
             max_ts,
@@ -121,7 +136,8 @@ def encode_batch(records: list[Record], base_offset: int = 0) -> bytes:
 def decode_batches(raw: bytes) -> list[Record]:
     """All records from a (possibly multi-batch) records blob; absolute
     offsets and timestamps reconstructed. Raises UnsupportedCompression
-    for codecs other than none/gzip, ValueError on CRC mismatch."""
+    for unknown codec ids (none/gzip/snappy/lz4/zstd supported),
+    ValueError on CRC mismatch or corrupt compressed payloads."""
     out: list[Record] = []
     pos = 0
     while pos + _HEADER.size <= len(raw):
@@ -147,10 +163,29 @@ def decode_batches(raw: bytes) -> list[Record]:
         ) = _POST_CRC.unpack_from(post, 0)
         payload = post[_POST_CRC.size :]
         codec = attributes & 0x07
-        if codec == COMPRESSION_GZIP:
-            payload = gzip.decompress(payload)
-        elif codec != COMPRESSION_NONE:
-            raise UnsupportedCompression(f"compression codec {codec}")
+        if codec != COMPRESSION_NONE:
+            from . import codecs as _codecs
+
+            try:
+                decompress = {
+                    COMPRESSION_GZIP: gzip.decompress,
+                    COMPRESSION_SNAPPY: _codecs.snappy_decompress,
+                    COMPRESSION_LZ4: _codecs.lz4_decompress,
+                    COMPRESSION_ZSTD: _codecs.zstd_decompress,
+                }[codec]
+            except KeyError:
+                raise UnsupportedCompression(
+                    f"compression codec {codec}"
+                ) from None
+            try:
+                payload = decompress(payload)
+            except Exception as e:  # noqa: BLE001 — normalize decoder
+                # errors (IndexError/ZstdError/...) to the ValueError
+                # contract so one corrupt batch fails one partition,
+                # not the connection
+                raise ValueError(
+                    f"batch decompression failed (codec {codec}): {e!r}"
+                ) from None
         r = Reader(payload)
         for _ in range(count):
             _len = r.varint()

@@ -1580,8 +1580,11 @@ def volume_balance(env: ShellEnv, args) -> str:
             cmd += f" -collection {col}"
         out = run_command(env, cmd)
         done.append(f"{line}: {out}")
-        if out.startswith("error"):
-            done.append("stopping after error")
+        # success is ONLY the "moved ..." confirmation; other statuses
+        # ("volume N not found", "has no replica at") mean the plan is
+        # stale — stop rather than keep applying against it
+        if not out.startswith("moved"):
+            done.append("error: stopping after failed move")
             break
     return "\n".join(done)
 
