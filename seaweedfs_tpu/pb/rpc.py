@@ -108,6 +108,12 @@ SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
         "RaftRequestVote": (UNARY, pb.RaftVoteRequest, pb.RaftVoteResponse),
         "RaftAppendEntries": (UNARY, pb.RaftAppendRequest, pb.RaftAppendResponse),
         "RaftStatus": (UNARY, pb.RaftStatusRequest, pb.RaftStatusResponse),
+        "RaftInstallSnapshot": (
+            UNARY,
+            pb.RaftInstallSnapshotRequest,
+            pb.RaftInstallSnapshotResponse,
+        ),
+        "RaftChangeMembership": (UNARY, pb.RaftChangeRequest, pb.RaftChangeResponse),
     },
 }
 

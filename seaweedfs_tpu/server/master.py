@@ -464,6 +464,8 @@ class MasterServer:
             state_dir=meta_dir,
             apply_fn=self._raft_apply,
             election_timeout=election_timeout,
+            snapshot_fn=lambda: {"max_volume_id": self.topo.max_volume_id},
+            restore_fn=self._raft_restore,
         )
         self.raft.on_leader_change = self._on_leader_change
         self.service = MasterService(self.topo, jwt_key=jwt_key, raft=self.raft)
@@ -522,6 +524,13 @@ class MasterServer:
         if kind == "alloc_volume_id":
             return self.topo.apply_allocated_volume_id(value)
         return 0
+
+    def _raft_restore(self, state: dict) -> None:
+        """Reload the raft-snapshot state machine (log compaction /
+        InstallSnapshot): the allocator must never go backwards."""
+        self.topo.max_volume_id = max(
+            self.topo.max_volume_id, int(state.get("max_volume_id", 0))
+        )
 
     def _alloc_volume_id(self) -> int:
         """Volume ids are allocated through the replicated log so a

@@ -1909,3 +1909,71 @@ def s3_clean_uploads(env: ShellEnv, args) -> str:
                     if not rr.error:
                         removed.append(f"{b}/{r.entry.name}")
     return "\n".join(removed) or "no stale uploads"
+
+
+# ------------------------------------------------------------ raft cluster
+
+
+def _raft_stub(env: ShellEnv, master: str | None = None):
+    addr = master or env.master_addr
+    host, _, port = addr.partition(":")
+    ch = grpc.insecure_channel(f"{host}:{int(port or 9333) + 10000}")
+    return ch, rpc.Stub(ch, rpc.RAFT_SERVICE)
+
+
+@command("cluster.raft.ps", "raft membership + roles of every master")
+def cluster_raft_ps(env: ShellEnv, args) -> str:
+    ch, stub = _raft_stub(env)
+    with ch:
+        st = stub.RaftStatus(pb.RaftStatusRequest(), timeout=10)
+    rows = [
+        f"node {st.node_id}: {st.role} term={st.term} "
+        f"commit={st.commit_index} applied={st.applied_index}"
+    ]
+    rows.append(f"leader: {st.leader or '?'}")
+    rows.append(
+        "members: " + ", ".join(sorted({st.node_id, *st.peers}))
+    )
+    return "\n".join(rows)
+
+
+def _raft_change(env: ShellEnv, op: str, server: str) -> str:
+    """Route the change to the LEADER (retrying once on redirect)."""
+    target = None
+    for _ in range(3):
+        ch, stub = _raft_stub(env, target)
+        with ch:
+            r = stub.RaftChangeMembership(
+                pb.RaftChangeRequest(op=op, server=server), timeout=15
+            )
+        if r.error == "not the leader" and r.leader:
+            target = r.leader
+            continue
+        if r.error:
+            return f"error: {r.error}"
+        return f"members now: {', '.join(r.members)}"
+    return "error: could not find the raft leader"
+
+
+@command(
+    "cluster.raft.add",
+    "-server host:port (grow the master raft group by one)",
+    mutating=True,
+)
+def cluster_raft_add(env: ShellEnv, args) -> str:
+    p = argparse.ArgumentParser(prog="cluster.raft.add")
+    p.add_argument("-server", required=True)
+    a = p.parse_args(args)
+    return _raft_change(env, "add", a.server)
+
+
+@command(
+    "cluster.raft.remove",
+    "-server host:port (shrink the master raft group by one)",
+    mutating=True,
+)
+def cluster_raft_remove(env: ShellEnv, args) -> str:
+    p = argparse.ArgumentParser(prog="cluster.raft.remove")
+    p.add_argument("-server", required=True)
+    a = p.parse_args(args)
+    return _raft_change(env, "remove", a.server)
