@@ -49,6 +49,7 @@ class MqBroker:
         self.segment_records = segment_records
         self._topics: dict[tuple[str, str], _TopicState] = {}
         self._offsets: dict[tuple, int] = {}  # (ns, topic, part, group)
+        self._schemas: dict[tuple[str, str], str] = {}  # (ns, topic)
         self._lock = threading.RLock()
         self._http = requests.Session()
         if filer:
@@ -73,6 +74,14 @@ class MqBroker:
 
     def _seg_path(self, ns: str, name: str, part: int, seg: int) -> str:
         return f"{TOPICS_ROOT}/{ns}/{name}/{part:04d}/seg-{seg:08d}.log"
+
+    def topics_root(self) -> str:
+        return TOPICS_ROOT
+
+    def _delete_file(self, path: str) -> None:
+        r = self._http.delete(self._url(path), timeout=60)
+        if r.status_code not in (200, 204, 404):
+            r.raise_for_status()
 
     def _put_file(self, path: str, data: bytes) -> None:
         r = self._http.post(
@@ -136,18 +145,46 @@ class MqBroker:
                 self._put_file(self._seg_path(_ns, _name, _p, seg), raw)
 
             def load(seg: int, _ns=ns, _name=name, _p=part):
-                return self._get_file(self._seg_path(_ns, _name, _p, seg))
+                path = self._seg_path(_ns, _name, _p, seg)
+                raw = self._get_file(path)
+                if raw is not None:
+                    return raw
+                # sealed segment may have been ARCHIVED to parquet
+                # (mq/logstore.py); re-materialize the record stream
+                data = self._get_file(path[: -len(".log")] + ".parquet")
+                if data is None:
+                    return None
+                from .logstore import parquet_to_segment
+
+                return parquet_to_segment(data)
 
         next_offset = earliest = 0
         if recover and self.filer:
-            segs = sorted(
-                e["FullPath"]
-                for e in self._list_dir(f"{TOPICS_ROOT}/{ns}/{name}/{part:04d}")
-                if e["FullPath"].endswith(".log")
-            )
+            # dedupe per segment NUMBER, preferring .log: a stale
+            # .parquet coexisting with a fuller re-sealed .log must
+            # never shadow it (lexicographic sort alone would pick
+            # ".parquet" as last and recover a too-low next_offset)
+            by_stem: dict[str, str] = {}
+            for e in self._list_dir(f"{TOPICS_ROOT}/{ns}/{name}/{part:04d}"):
+                p_full = e["FullPath"]
+                for ext in (".log", ".parquet"):
+                    if p_full.endswith(ext):
+                        stem = p_full[: -len(ext)]
+                        if ext == ".log" or stem not in by_stem:
+                            by_stem[stem] = p_full
+            segs = [by_stem[s] for s in sorted(by_stem)]
+
+            def _read_seg(path: str) -> bytes | None:
+                data = self._get_file(path)
+                if data is None or not path.endswith(".parquet"):
+                    return data
+                from .logstore import parquet_to_segment
+
+                return parquet_to_segment(data)
+
             if segs:
-                first = self._get_file(segs[0])
-                last = self._get_file(segs[-1])
+                first = _read_seg(segs[0])
+                last = _read_seg(segs[-1])
                 if first is not None:
                     for off, *_ in decode_records(first):
                         earliest = off
@@ -222,6 +259,95 @@ class MqBroker:
                 (ns, name, st.partition_count)
                 for (ns, name), st in self._topics.items()
             )
+
+    # ------------------------------------------------------------ schemas
+
+    def set_schema(self, ns: str, name: str, schema_json: str) -> None:
+        """Register (or with "" delete) a topic's schema: a JSON doc
+        {"fields": [{"name": ..., "type": int|float|string|bool}, ...],
+        "enforce": bool} (reference weed/mq/schema, simplified from
+        protobuf descriptors to a JSON field list)."""
+        self.topic(ns, name)  # must exist
+        if schema_json:
+            doc = json.loads(schema_json)
+            if not isinstance(doc.get("fields"), list):
+                raise ValueError("schema needs a 'fields' list")
+            for f in doc["fields"]:
+                if "name" not in f:
+                    raise ValueError(f"schema field without name: {f}")
+        with self._lock:
+            if schema_json:
+                self._schemas[(ns, name)] = schema_json
+            else:
+                self._schemas.pop((ns, name), None)
+        if self.filer:
+            path = f"{TOPICS_ROOT}/{ns}/{name}/schema.json"
+            if schema_json:
+                self._put_file(path, schema_json.encode())
+            else:
+                self._delete_file(path)
+
+    def get_schema(self, ns: str, name: str) -> str:
+        """'' = no schema. Negative lookups are CACHED — Publish calls
+        this on the hot path, and a schema-less topic must not pay a
+        filer round-trip (or fail on a filer hiccup) per message."""
+        with self._lock:
+            s = self._schemas.get((ns, name))
+        if s is not None:
+            return s
+        s = ""
+        if self.filer:
+            try:
+                raw = self._get_file(f"{TOPICS_ROOT}/{ns}/{name}/schema.json")
+            except requests.RequestException:
+                return ""  # transient filer error: fail open, don't cache
+            if raw:
+                s = raw.decode()
+        with self._lock:
+            self._schemas[(ns, name)] = s
+        return s
+
+    def validate_against_schema(self, ns: str, name: str, value: bytes) -> str:
+        """'' when acceptable; an error string when the topic enforces
+        a schema and the payload violates it."""
+        s = self.get_schema(ns, name)
+        if not s:
+            return ""
+        try:
+            doc = json.loads(s)
+        except json.JSONDecodeError:
+            return ""
+        if not doc.get("enforce"):
+            return ""
+        try:
+            payload = json.loads(value)
+        except (ValueError, UnicodeDecodeError):
+            return "payload is not JSON but the topic enforces a schema"
+        if not isinstance(payload, dict):
+            return "payload must be a JSON object"
+        types = {
+            "int": int,
+            "float": (int, float),
+            "string": str,
+            "bool": bool,
+            "bytes": str,
+        }
+        for f in doc.get("fields", []):
+            fname = f.get("name")
+            if fname not in payload:
+                if f.get("required"):
+                    return f"missing required field {fname!r}"
+                continue
+            ftype = f.get("type", "string")
+            want = types.get(ftype)
+            have = payload[fname]
+            # bool is a subclass of int in Python: a JSON true must not
+            # satisfy an int/float field
+            if ftype in ("int", "float") and isinstance(have, bool):
+                return f"field {fname!r} is not a {ftype}"
+            if want and not isinstance(have, want):
+                return f"field {fname!r} is not a {ftype}"
+        return ""
 
     def commit_offset(self, ns, name, part, group, offset) -> None:
         # snapshot under the lock, persist outside it: one slow filer
@@ -363,6 +489,11 @@ class MqService:
             st = self.broker.topic(ns, t.name)
         except KeyError as e:
             return mq.PublishResponse(error=str(e))
+        err = self.broker.validate_against_schema(
+            ns, t.name, bytes(request.message.value)
+        )
+        if err:
+            return mq.PublishResponse(error=f"schema violation: {err}")
         part = self.broker.pick_partition(
             st, request.message.key, request.partition
         )
@@ -576,6 +707,24 @@ class MqService:
             )
         )
 
+    def RegisterSchema(self, request, context):
+        t = request.topic
+        try:
+            self.broker.set_schema(
+                t.namespace or "default", t.name, request.schema_json
+            )
+        except (KeyError, ValueError, json.JSONDecodeError) as e:
+            return mq.RegisterSchemaResponse(error=str(e))
+        return mq.RegisterSchemaResponse()
+
+    def GetSchema(self, request, context):
+        t = request.topic
+        return mq.GetSchemaResponse(
+            schema_json=self.broker.get_schema(
+                t.namespace or "default", t.name
+            )
+        )
+
     def PartitionInfo(self, request, context):
         t = request.topic
         try:
@@ -605,6 +754,7 @@ class MqBrokerServer:
         pg_port: int = -1,
         pg_users: dict[str, str] | None = None,
         peers: list[str] | None = None,
+        archive_interval: float = 300.0,
     ):
         """kafka_port >= 0 also serves the Kafka wire protocol on that
         port; pg_port >= 0 serves PostgreSQL clients a SQL view over
@@ -634,6 +784,26 @@ class MqBrokerServer:
             self.pg = PgServer(
                 QueryEngine(self.broker), ip=ip, port=pg_port, users=pg_users
             )
+        # parquet archival of sealed segments (reference weed/mq/logstore)
+        self.archiver = None
+        self._archive_stop = threading.Event()
+        self._archive_thread = None
+        if filer and archive_interval > 0:
+            from .logstore import SegmentArchiver
+
+            self.archiver = SegmentArchiver(self.broker)
+            self._archive_thread = threading.Thread(
+                target=self._archive_loop,
+                args=(archive_interval,),
+                daemon=True,
+            )
+
+    def _archive_loop(self, interval: float) -> None:
+        while not self._archive_stop.wait(interval):
+            try:
+                self.archiver.run_once()
+            except Exception as e:  # noqa: BLE001 — never kill the broker
+                log.warning(f"segment archival cycle failed: {e!r}")
 
     def start(self) -> None:
         self._grpc.start()
@@ -642,8 +812,11 @@ class MqBrokerServer:
             self.kafka.start()
         if self.pg is not None:
             self.pg.start()
+        if self._archive_thread is not None:
+            self._archive_thread.start()
 
     def stop(self) -> None:
+        self._archive_stop.set()
         self.balancer.stop()
         if self.kafka is not None:
             self.kafka.stop()

@@ -359,6 +359,26 @@ class KafkaGateway:
                     # must fail ONE partition, not the connection
                     parts.append((part, kp.CORRUPT_MESSAGE, -1))
                     continue
+                # enforced topic schemas apply to the Kafka path too —
+                # otherwise any Kafka client could bypass what
+                # MqService.Publish rejects (tombstones exempt: a null
+                # value deletes, it doesn't carry a document)
+                bad = next(
+                    (
+                        err
+                        for rec in records
+                        if rec.value is not None
+                        and (
+                            err := self.broker.validate_against_schema(
+                                NAMESPACE, topic, rec.value
+                            )
+                        )
+                    ),
+                    "",
+                )
+                if bad:
+                    parts.append((part, kp.INVALID_RECORD, -1))
+                    continue
                 base = -1
                 if records:
                     # one lock hold: offsets must be contiguous so the

@@ -283,3 +283,4 @@ TOPIC_ALREADY_EXISTS = 36
 INVALID_REQUEST = 42
 UNSUPPORTED_VERSION = 35
 UNSUPPORTED_COMPRESSION_TYPE = 76
+INVALID_RECORD = 87

@@ -81,6 +81,8 @@ SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
         "BrokerStatus": (UNARY, mq.BrokerStatusRequest, mq.BrokerStatusResponse),
         "LookupTopicBrokers": (UNARY, mq.LookupTopicBrokersRequest, mq.LookupTopicBrokersResponse),
         "FollowAppend": (UNARY, mq.FollowAppendRequest, mq.FollowAppendResponse),
+        "RegisterSchema": (UNARY, mq.RegisterSchemaRequest, mq.RegisterSchemaResponse),
+        "GetSchema": (UNARY, mq.GetSchemaRequest, mq.GetSchemaResponse),
     },
     FILER_SERVICE: {
         "LookupDirectoryEntry": (UNARY, fpb.LookupEntryRequest, fpb.LookupEntryResponse),

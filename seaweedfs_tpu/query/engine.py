@@ -306,11 +306,26 @@ def _cmp(op: str, a: Any, b: Any) -> bool:
 
 
 class QueryEngine:
-    """Executes parsed statements against an MqBroker."""
+    """Executes parsed statements against an MqBroker.
 
-    def __init__(self, broker, scan_limit: int = 1_000_000):
+    scan_limit 0 = UNLIMITED scanning: aggregates fold incrementally
+    over any number of rows and LIMIT-ed SELECTs stop early, so full
+    archived topics are queryable (the pre-r4 1M-row cap silently
+    truncated results). Queries that must MATERIALIZE an unbounded
+    result set (SELECT without LIMIT, or ORDER BY) are bounded by
+    max_result_rows and FAIL LOUDLY when exceeded — an explicit "add a
+    LIMIT" error beats both silent truncation and an OOM'd broker. A
+    positive scan_limit is still honored as an operator guardrail."""
+
+    def __init__(
+        self,
+        broker,
+        scan_limit: int = 0,
+        max_result_rows: int = 1_000_000,
+    ):
         self.broker = broker
         self.scan_limit = scan_limit
+        self.max_result_rows = max_result_rows
 
     # ---- table helpers ----
 
@@ -347,11 +362,13 @@ class QueryEngine:
             if plog is None:
                 continue
             off = plog.earliest_offset
-            while scanned < self.scan_limit:
+            while self.scan_limit <= 0 or scanned < self.scan_limit:
                 recs = plog.read_from(off, max_records=2048)
                 if not recs:
                     break
                 for o, ts_ns, key, value in recs:
+                    if self.scan_limit > 0 and scanned >= self.scan_limit:
+                        return
                     scanned += 1
                     row = {
                         "_key": _maybe_text(unwrap(key)),
@@ -393,11 +410,30 @@ class QueryEngine:
                 "_offset": "bigint",
                 "_partition": "int",
             }
-            for i, row in enumerate(self._scan(ns, name, count)):
-                for k, v in row.items():
-                    cols.setdefault(k, _pg_type(v))
-                if i >= 100:  # column discovery sample
-                    break
+            # a REGISTERED schema is authoritative (reference
+            # weed/mq/schema); otherwise sample rows for discovery
+            schema = ""
+            if hasattr(self.broker, "get_schema"):
+                schema = self.broker.get_schema(ns, name)
+            if schema:
+                type_map = {
+                    "int": "bigint",
+                    "float": "double precision",
+                    "string": "text",
+                    "bool": "boolean",
+                    "bytes": "bytea",
+                }
+                for f in json.loads(schema).get("fields", []):
+                    cols.setdefault(
+                        f.get("name", "?"),
+                        type_map.get(f.get("type", "string"), "text"),
+                    )
+            else:
+                for i, row in enumerate(self._scan(ns, name, count)):
+                    for k, v in row.items():
+                        cols.setdefault(k, _pg_type(v))
+                    if i >= 100:  # column discovery sample
+                        break
             return Result(
                 columns=["column", "type"],
                 rows=[[k, t] for k, t in cols.items()],
@@ -424,7 +460,13 @@ class QueryEngine:
                 if len(out) >= take:
                     break
         else:
-            out = list(rows)
+            for row in rows:
+                out.append(row)
+                if len(out) > self.max_result_rows:
+                    raise QueryError(
+                        f"result exceeds {self.max_result_rows} rows; "
+                        "add a LIMIT or aggregate"
+                    )
         if sel.order_by is not None:
             col, descending = sel.order_by
             out.sort(

@@ -1977,3 +1977,49 @@ def cluster_raft_remove(env: ShellEnv, args) -> str:
     p.add_argument("-server", required=True)
     a = p.parse_args(args)
     return _raft_change(env, "remove", a.server)
+
+
+# -------------------------------------------------------------- mq schemas
+
+
+@command(
+    "mq.schema.set",
+    "-topic name -schema '<json>' [-namespace ns] [-broker host:port]",
+)
+def mq_schema_set(env: ShellEnv, args) -> str:
+    from ..pb import mq_pb2 as mqpb
+
+    p = argparse.ArgumentParser(prog="mq.schema.set")
+    p.add_argument("-topic", required=True)
+    p.add_argument("-schema", required=True)
+    p.add_argument("-namespace", default="default")
+    p.add_argument("-broker", default="localhost:17777")
+    a = p.parse_args(args)
+    with grpc.insecure_channel(a.broker) as ch:
+        r = rpc.Stub(ch, rpc.MQ_SERVICE).RegisterSchema(
+            mqpb.RegisterSchemaRequest(
+                topic=mqpb.Topic(namespace=a.namespace, name=a.topic),
+                schema_json=a.schema,
+            ),
+            timeout=10,
+        )
+    return f"error: {r.error}" if r.error else f"schema registered for {a.topic}"
+
+
+@command("mq.schema.get", "-topic name [-namespace ns] [-broker host:port]")
+def mq_schema_get(env: ShellEnv, args) -> str:
+    from ..pb import mq_pb2 as mqpb
+
+    p = argparse.ArgumentParser(prog="mq.schema.get")
+    p.add_argument("-topic", required=True)
+    p.add_argument("-namespace", default="default")
+    p.add_argument("-broker", default="localhost:17777")
+    a = p.parse_args(args)
+    with grpc.insecure_channel(a.broker) as ch:
+        r = rpc.Stub(ch, rpc.MQ_SERVICE).GetSchema(
+            mqpb.GetSchemaRequest(
+                topic=mqpb.Topic(namespace=a.namespace, name=a.topic)
+            ),
+            timeout=10,
+        )
+    return r.schema_json or f"no schema registered for {a.topic}"
