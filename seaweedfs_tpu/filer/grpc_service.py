@@ -21,9 +21,17 @@ from .notification import json_to_event
 class FilerGrpcService:
     """Servicer for rpc.FILER_SERVICE (hand-rolled table wiring)."""
 
-    def __init__(self, filer: Filer, meta_log=None):
+    def __init__(self, filer: Filer, meta_log=None, lock_ring=None):
         self.filer = filer
         self.meta_log = meta_log
+        # distributed lock ring (filer/lock_ring.py); a ring with no
+        # peers serves single-filer deployments locally
+        self.lock_ring = lock_ring
+
+    def DistributedLock(self, request, context):
+        if self.lock_ring is None:
+            return fpb.DlmResponse(error="lock ring not configured")
+        return self.lock_ring.handle(request)
 
     # ------------------------------------------------------------ metadata
 

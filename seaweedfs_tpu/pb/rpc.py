@@ -97,6 +97,7 @@ SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
         "KvPut": (UNARY, fpb.FilerKvPutRequest, fpb.FilerOpResponse),
         "LockRange": (UNARY, fpb.LockRangeRequest, fpb.LockRangeResponse),
         "HardLink": (UNARY, fpb.HardLinkRequest, fpb.FilerOpResponse),
+        "DistributedLock": (UNARY, fpb.DlmRequest, fpb.DlmResponse),
     },
     WORKER_SERVICE: {
         "WorkerStream": (BIDI, wk.WorkerMessage, wk.ServerMessage),

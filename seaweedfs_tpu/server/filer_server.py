@@ -56,10 +56,18 @@ class FilerServer:
         from ..pb import rpc as _rpc
 
         self._grpc = _grpc.server(_futures.ThreadPoolExecutor(max_workers=16))
-        _rpc.add_service(
-            self._grpc, _rpc.FILER_SERVICE, FilerGrpcService(filer, meta_log)
-        )
+        self._grpc_service = FilerGrpcService(filer, meta_log)
+        _rpc.add_service(self._grpc, _rpc.FILER_SERVICE, self._grpc_service)
         self.grpc_port = self._grpc.add_insecure_port(f"{ip}:{grpc_port}")
+        # distributed lock ring over the filer peer set (reference
+        # weed/cluster/lock_manager); peers are gRPC addresses, same as
+        # the MetaAggregator's
+        from ..filer.lock_ring import LockRing
+
+        self.lock_ring = LockRing(
+            f"{ip}:{self.grpc_port}", list(peers or [])
+        )
+        self._grpc_service.lock_ring = self.lock_ring
         from ..filer.tus import TusManager
 
         self.tus = TusManager(filer)
@@ -451,10 +459,13 @@ class FilerServer:
     def start(self) -> None:
         self._thread.start()
         self._grpc.start()
+        if self.lock_ring.members != [self.lock_ring.self_addr]:
+            self.lock_ring.start()  # probing only matters with peers
         if self.aggregator is not None:
             self.aggregator.start()
 
     def stop(self) -> None:
+        self.lock_ring.stop()
         if self.aggregator is not None:
             self.aggregator.stop()
         self._grpc.stop(grace=0.5)

@@ -41,6 +41,10 @@ class MasterService:
         self.raft = raft  # None = pre-raft single master (tests construct this)
         self._grow_lock = threading.Lock()
         self.locks = LockManager()
+        # set to a filer/lock_ring.DlmClient to ride the filer lock
+        # ring instead of the local lease table (MasterServer wires it
+        # from its dlm_filers parameter)
+        self.dlm = None
         # volume-id allocation goes through raft when HA is on
         self.alloc_volume_id = topo.next_volume_id
 
@@ -129,6 +133,27 @@ class MasterService:
         leader = self._not_leader()
         if leader is not None:
             return pb.LockResponse(error=f"not leader; leader={leader}")
+        if self.dlm is not None:
+            # filer lock ring configured: the master's lease API is a
+            # CLIENT of it (reference: shell/admin locks ride the
+            # cluster lock_manager ring) — locks survive master AND
+            # single-filer failures
+            try:
+                r = self.dlm.lock(
+                    request.name,
+                    request.owner,
+                    request.ttl_seconds or 60.0,
+                    request.token,
+                )
+            except ConnectionError as e:
+                return pb.LockResponse(error=str(e))
+            return pb.LockResponse(
+                ok=r.ok,
+                token=r.token,
+                holder=r.holder,
+                expires_ns=int(r.remaining * 1e9),
+                error=r.error,
+            )
         ok, token, holder, remaining = self.locks.acquire(
             request.name,
             request.owner,
@@ -147,6 +172,12 @@ class MasterService:
         leader = self._not_leader()
         if leader is not None:
             return pb.UnlockResponse(error=f"not leader; leader={leader}")
+        if self.dlm is not None:
+            try:
+                r = self.dlm.unlock(request.name, request.token)
+            except ConnectionError as e:
+                return pb.UnlockResponse(error=str(e))
+            return pb.UnlockResponse(ok=r.ok, error=r.error)
         ok = self.locks.release(request.name, request.token)
         return pb.UnlockResponse(
             ok=ok, error="" if ok else "not held by this token"
@@ -156,10 +187,11 @@ class MasterService:
         # leases live on the leader only: a deposed master's (stale,
         # typically empty) table must not masquerade as cluster state
         self._abort_if_follower(context)
+        rows = self.dlm.status() if self.dlm is not None else self.locks.status()
         return pb.LockStatusResponse(
             locks=[
                 pb.LockRow(name=n, owner=o, expires_ns=int(r * 1e9))
-                for n, o, r in self.locks.status()
+                for n, o, r in rows
             ]
         )
 
@@ -438,6 +470,7 @@ class MasterServer:
         election_timeout: tuple[float, float] = (0.4, 0.8),
         tls=None,
         telemetry_url: str = "",
+        dlm_filers: list[str] | None = None,
     ):
         """ec_auto_fullness > 0 turns on the maintenance scanner: volumes
         at that fraction of the size limit (and write-quiet) get an
@@ -469,6 +502,12 @@ class MasterServer:
         )
         self.raft.on_leader_change = self._on_leader_change
         self.service = MasterService(self.topo, jwt_key=jwt_key, raft=self.raft)
+        if dlm_filers:
+            # lease API rides the filer lock ring (dlm_filers: filer
+            # gRPC addresses) instead of this master's local table
+            from ..filer.lock_ring import DlmClient
+
+            self.service.dlm = DlmClient(list(dlm_filers))
         self.service.alloc_volume_id = self._alloc_volume_id
         self.garbage_threshold = garbage_threshold
         self.vacuum_interval = vacuum_interval
@@ -824,6 +863,8 @@ class MasterServer:
     def stop(self) -> None:
         self.telemetry.stop()
         self.worker_control.stop()
+        if self.service.dlm is not None:
+            self.service.dlm.close()
         self.raft.stop()
         self._vacuum_stop.set()
         self._grpc.stop(grace=0.5)
