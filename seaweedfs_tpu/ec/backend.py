@@ -149,19 +149,56 @@ class CpuBackend(_BackendBase):
 
 
 class JaxBackend(_BackendBase):
-    """Local JAX device (TPU when available) via bit-matrix matmuls."""
+    """Local JAX device(s) via bit-matrix matmuls.
 
-    def __init__(self, ctx: ECContext, impl: str = "auto", interpret: bool = False):
+    With more than one local device the PRODUCTION encode path shards
+    batch columns across a 1-D mesh (parallel.MeshRS): parity is
+    columnwise-independent, so the split is bit-exact and XLA inserts
+    no collectives — each chip encodes its column slice (SURVEY §7
+    stage 2: pjit across chips for large volumes). Single-device
+    behavior is unchanged."""
+
+    def __init__(
+        self,
+        ctx: ECContext,
+        impl: str = "auto",
+        interpret: bool = False,
+        n_devices: int | None = None,
+    ):
         super().__init__(ctx)
         import jax
 
         from ..ops.rs_jax import RSJax
 
-        if impl == "auto":
+        impl_was_auto = impl == "auto"
+        if impl_was_auto:
             impl = "pallas" if jax.devices()[0].platform == "tpu" else "xla"
         self._rs = RSJax(
             ctx.data_shards, ctx.parity_shards, impl=impl, interpret=interpret
         )
+        self._mesh_rs = None
+        # Device counting calls jax.devices(), which HANGS forever on a
+        # dead TPU relay. Only do it when the caller implicitly already
+        # did (impl='auto') or explicitly asked for a mesh; an explicit
+        # single-impl construction keeps the pre-mesh hang-free path.
+        if n_devices == 1:
+            want = 1
+        elif impl_was_auto or n_devices is not None:
+            avail = len(jax.devices())
+            if n_devices is not None and avail < n_devices:
+                # explicit request: fail loudly, never silently shrink
+                raise RuntimeError(
+                    f"need {n_devices} devices, have {avail}"
+                )
+            want = n_devices if n_devices is not None else avail
+        else:
+            want = 1
+        if want > 1:
+            # shard_map wraps the impl's own per-chip encode (XLA or
+            # Pallas) over the column mesh
+            from ..parallel import MeshRS, make_mesh
+
+            self._mesh_rs = MeshRS(self._rs, make_mesh(want))
 
     def encode(self, data: np.ndarray) -> np.ndarray:
         return np.asarray(self._rs.encode(data))
@@ -173,12 +210,24 @@ class JaxBackend(_BackendBase):
     def to_device(self, data: np.ndarray):
         import jax
 
-        return jax.device_put(np.ascontiguousarray(data, dtype=np.uint8))
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        if self._mesh_rs is not None:
+            from ..parallel import pad_cols
+
+            padded, n = pad_cols(data, self._mesh_rs.n_devices)
+            return (self._mesh_rs.put(padded), n)
+        return jax.device_put(data)
 
     def encode_staged(self, staged):
+        if self._mesh_rs is not None:
+            arr, n = staged
+            return (self._mesh_rs.encode(arr), n)
         return self._rs.encode(staged)
 
     def to_host(self, result) -> np.ndarray:
+        if self._mesh_rs is not None:
+            arr, n = result
+            return np.asarray(arr, dtype=np.uint8)[:, :n]
         return np.asarray(result, dtype=np.uint8)
 
     def reconstruct(

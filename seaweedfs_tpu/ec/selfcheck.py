@@ -1,0 +1,64 @@
+"""Shared EC self-check: production encoder on a device mesh vs CPU.
+
+Used by BOTH the driver's `dryrun_multichip` and the test suite, so the
+two stay one implementation: fabricate a small volume, encode it with
+the multi-device JaxBackend through the REAL ec_encode_volume pipeline,
+re-encode with the CPU backend, and require bit-identical .ecsum
+sidecars and shard bytes.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..storage.needle import Needle
+from ..storage.volume import Volume
+from .backend import CpuBackend, JaxBackend
+from .bitrot import BitrotProtection
+from .context import DEFAULT_EC_CONTEXT
+from .encoder import ec_encode_volume
+
+
+def mesh_encode_selfcheck(
+    tmp_dir: str,
+    n_devices: int,
+    batch_size: int = 96 * 1024 + 13,  # odd: exercises column padding
+    payload_size: int = 217_013,
+    needles: int = 5,
+    seed: int = 0,
+) -> None:
+    """Raises on any mismatch; returns None when bit-exact."""
+    rng = np.random.default_rng(seed)
+    vol = Volume(tmp_dir, 1, needle_map_kind="memory")
+    for nid in range(1, needles + 1):
+        data = rng.integers(0, 256, size=payload_size, dtype=np.uint8).tobytes()
+        vol.write_needle(Needle(cookie=9, needle_id=nid, data=data))
+    vol.flush()
+    base = vol.base_file_name(tmp_dir, "", 1)
+    vol.close()
+
+    jb = JaxBackend(DEFAULT_EC_CONTEXT, impl="xla", n_devices=n_devices)
+    if jb._mesh_rs is None or jb._mesh_rs.n_devices != n_devices:
+        raise AssertionError("mesh path did not engage")
+    ec_encode_volume(base, backend=jb, batch_size=batch_size)
+    mesh_prot = BitrotProtection.load(base + ".ecsum")
+    shard_bytes = {}
+    for i in range(DEFAULT_EC_CONTEXT.total):
+        p = base + DEFAULT_EC_CONTEXT.to_ext(i)
+        with open(p, "rb") as f:
+            shard_bytes[i] = f.read()
+        os.unlink(p)
+    os.unlink(base + ".ecsum")
+
+    ec_encode_volume(base, backend=CpuBackend(DEFAULT_EC_CONTEXT))
+    cpu_prot = BitrotProtection.load(base + ".ecsum")
+    if mesh_prot.shard_crcs != cpu_prot.shard_crcs:
+        raise AssertionError("mesh .ecsum CRCs differ from CPU")
+    if mesh_prot.shard_sizes != cpu_prot.shard_sizes:
+        raise AssertionError("mesh shard sizes differ from CPU")
+    for i in range(DEFAULT_EC_CONTEXT.total):
+        with open(base + DEFAULT_EC_CONTEXT.to_ext(i), "rb") as f:
+            if shard_bytes[i] != f.read():
+                raise AssertionError(f"shard {i} bytes differ from CPU")

@@ -1,0 +1,19 @@
+"""Mesh/sharding helpers for the distributed compute path."""
+
+from .mesh import (
+    BLOCK_AXIS,
+    MeshRS,
+    column_sharding,
+    make_mesh,
+    pad_cols,
+    replicated,
+)
+
+__all__ = [
+    "BLOCK_AXIS",
+    "MeshRS",
+    "column_sharding",
+    "make_mesh",
+    "pad_cols",
+    "replicated",
+]

@@ -114,3 +114,43 @@ def test_dryrun_multichip_entrypoint():
         sys.path.insert(0, root)
     mod = importlib.import_module("__graft_entry__")
     mod.dryrun_multichip(8)
+
+
+def test_production_encoder_on_mesh_bit_exact(tmp_path):
+    """r3 verdict #10 done-criterion: the PRODUCTION encoder
+    (ec_encode_volume via JaxBackend) shards batch columns across the
+    virtual 8-device mesh and produces a bit-identical .ecsum to the
+    single-device CPU backend (shared impl with dryrun_multichip)."""
+    from seaweedfs_tpu.ec.selfcheck import mesh_encode_selfcheck
+
+    mesh_encode_selfcheck(str(tmp_path), 8)
+
+
+def test_mesh_backend_rejects_impossible_device_count():
+    import pytest as _pytest
+
+    from seaweedfs_tpu.ec.backend import JaxBackend
+    from seaweedfs_tpu.ec.context import DEFAULT_EC_CONTEXT
+
+    with _pytest.raises(RuntimeError, match="need 64 devices"):
+        JaxBackend(DEFAULT_EC_CONTEXT, impl="xla", n_devices=64)
+
+
+def test_parallel_pkg_mesh_helpers(mesh, rng):
+    """parallel/ helpers: sharded encode + psum checksum round trip."""
+    import numpy as np
+
+    from seaweedfs_tpu.ops import gf256
+    from seaweedfs_tpu.ops.rs_jax import RSJax
+    from seaweedfs_tpu.parallel import MeshRS, pad_cols
+
+    rs = RSJax(10, 4, impl="xla")
+    mrs = MeshRS(rs, mesh)
+    data = rng.integers(0, 256, size=(10, 8 * 1024 + 3), dtype=np.uint8)
+    padded, n = pad_cols(data, mrs.n_devices)
+    handle = mrs.encode(mrs.put(padded))
+    parity = np.asarray(handle)[:, :n]
+    expected = gf256.ReedSolomon(10, 4).encode(data)
+    np.testing.assert_array_equal(parity, expected)
+    cks = mrs.global_checksum(handle)
+    assert cks == int(expected.astype(np.uint64).sum() % (1 << 32))
