@@ -148,3 +148,81 @@ class MqNotifier(_AsyncNotifier):
     def close(self) -> None:
         super().close()
         self.client.close()
+
+
+class KafkaNotifier(_AsyncNotifier):
+    """Publishes events to any Kafka-wire-protocol broker (reference
+    weed/notification/kafka). Rides the framework's own Kafka client —
+    the same wire encoding a Java client produces — so it works against
+    real Kafka clusters AND this framework's Kafka gateway."""
+
+    def __init__(
+        self,
+        broker: str,
+        topic: str = "seaweedfs_filer",
+        partitions: int = 1,
+    ):
+        from ..mq.kafka.client import KafkaClient
+
+        host, _, port = broker.partition(":")
+        self.client = KafkaClient(host, int(port or 9092))
+        self.topic = topic
+        self._partitions = max(partitions, 1)
+        try:
+            self.client.create_topic(topic, partitions=self._partitions)
+        except Exception:  # noqa: BLE001 — exists / auto-create / ACL
+            pass
+        super().__init__()
+
+    def _deliver(self, payload: dict) -> bool:
+        import zlib
+
+        from ..mq.kafka.records import Record
+
+        key = (payload.get("directory") or "").encode()
+        # stable across processes/restarts (builtin hash is seeded):
+        # per-directory ordering needs a deterministic partition
+        part = zlib.crc32(key) % self._partitions
+        self.client.produce(
+            self.topic,
+            part,
+            [Record(key=key, value=json.dumps(payload).encode())],
+        )
+        return True
+
+    def close(self) -> None:
+        super().close()
+        self.client.close()
+
+
+def make_notifier(kind: str, target: str, **kw):
+    """Config-driven sink construction (reference notification
+    configuration.go): kind in webhook|mq|kafka|sqs|pubsub. SQS and
+    Google Pub/Sub need their cloud SDKs, which this image does not
+    ship — they are GATED with an explicit error rather than silently
+    absent."""
+    if kind == "webhook":
+        return WebhookNotifier(target, **kw)
+    if kind == "mq":
+        return MqNotifier(target, **kw)
+    if kind == "kafka":
+        return KafkaNotifier(target, **kw)
+    if kind == "sqs":
+        try:
+            import boto3  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "SQS notification requires boto3, which is not installed "
+                "in this build; use webhook/kafka/mq sinks instead"
+            ) from e
+        raise NotImplementedError("SQS sink: boto3 present but unwired")
+    if kind == "pubsub":
+        try:
+            import google.cloud.pubsub_v1  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "Google Pub/Sub notification requires google-cloud-pubsub, "
+                "which is not installed in this build; use webhook/kafka/mq"
+            ) from e
+        raise NotImplementedError("Pub/Sub sink: SDK present but unwired")
+    raise ValueError(f"unknown notifier kind {kind!r}")

@@ -101,6 +101,13 @@ def main(argv=None) -> int:
     f.add_argument("-peers", default="", help="comma-separated peer filer gRPC addrs for multi-filer")
     _add_tls_flags(f)
 
+    ts = sub.add_parser(
+        "telemetry", help="telemetry collector server (reference telemetry/server)"
+    )
+    ts.add_argument("-ip", default="localhost")
+    ts.add_argument("-port", type=int, default=9999)
+    ts.add_argument("-file", default="", help="JSONL persistence path")
+
     b = sub.add_parser("mq.broker")
     b.add_argument("-ip", default="localhost")
     b.add_argument("-port", type=int, default=17777)
@@ -280,6 +287,18 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, lambda *x: stop.set())
 
     servers = []
+    if a.mode == "telemetry":
+        from ..utils.telemetry_server import TelemetryServer
+
+        tsrv = TelemetryServer(
+            ip=a.ip, port=a.port, persist_path=a.file or None
+        )
+        tsrv.start()
+        log.info("telemetry collector on %s:%s", a.ip, tsrv.port)
+        stop.wait()  # SIGTERM/SIGINT set it (handlers above)
+        tsrv.stop()
+        return 0
+
     if a.mode == "mq.broker":
         from ..mq.broker import MqBrokerServer
 

@@ -1,14 +1,19 @@
 """WebDAV gateway over the filer.
 
 Reference: weed/server/webdav_server.go (x/net/webdav over the filer).
-Class-2-less subset (no LOCK/UNLOCK): OPTIONS, PROPFIND depth 0/1,
-GET/HEAD/PUT/DELETE, MKCOL, MOVE, COPY — enough for davfs/cadaver/
-Finder-style clients.
+Class 1 + 2: OPTIONS, PROPFIND depth 0/1, GET/HEAD/PUT/DELETE, MKCOL,
+MOVE, COPY, and LOCK/UNLOCK (exclusive write locks with timeouts,
+refresh, If-token enforcement on every mutating verb, depth-infinity
+collection locks) — what Windows/macOS mapped drives and Office-style
+clients require before they will save.
 """
 
 from __future__ import annotations
 
+import re
 import threading
+import time
+import uuid
 import xml.etree.ElementTree as ET
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import unquote, urlparse
@@ -19,6 +24,91 @@ from ..filer.filer_store import NotFound
 
 DAV = "DAV:"
 ET.register_namespace("D", DAV)
+
+_DEFAULT_LOCK_TIMEOUT = 600.0
+_MAX_LOCK_TIMEOUT = 3600.0
+
+
+class _DavLocks:
+    """In-memory WebDAV lock table (the reference rides x/net/webdav's
+    memLS — same per-gateway scope). Exclusive write locks only; a
+    `shared` request is granted as exclusive (documented divergence:
+    clients in the wild lock exclusively)."""
+
+    def __init__(self):
+        self._locks: dict[str, dict] = {}  # path -> lock
+        self._mu = threading.Lock()
+
+    def _expire_locked(self) -> None:
+        now = time.monotonic()
+        for p in [p for p, l in self._locks.items() if l["expires"] <= now]:
+            del self._locks[p]
+
+    @staticmethod
+    def _conflicts(lock_path: str, lock: dict, path: str) -> bool:
+        """One predicate for both enforcement and acquisition: the lock
+        covers `path` when it IS the path, is an ancestor with Depth
+        infinity, or sits underneath it (collection delete/move)."""
+        anc = (
+            lock_path == "/"
+            or path == lock_path
+            or path.startswith(lock_path.rstrip("/") + "/")
+        )
+        return (
+            lock_path == path
+            or (anc and lock["depth"] == "infinity")
+            or lock_path.startswith(path.rstrip("/") + "/")
+        )
+
+    def covering(self, path: str) -> list[tuple[str, dict]]:
+        with self._mu:
+            self._expire_locked()
+            return [
+                (p, l)
+                for p, l in self._locks.items()
+                if self._conflicts(p, l, path)
+            ]
+
+    def lock(
+        self, path: str, owner: str, depth: str, timeout: float
+    ) -> dict | None:
+        with self._mu:
+            self._expire_locked()
+            for p, l in self._locks.items():
+                if self._conflicts(p, l, path):
+                    return None  # conflicting lock
+            lock = {
+                "token": f"opaquelocktoken:{uuid.uuid4()}",
+                "owner": owner,
+                "depth": depth,
+                "timeout": timeout,
+                "expires": time.monotonic() + timeout,
+                "path": path,
+            }
+            self._locks[path] = lock
+            return lock
+
+    def refresh(self, token: str, timeout: float, path: str) -> dict | None:
+        """RFC 4918 §9.10.2: the request URI must fall within the
+        lock's scope — a token for an unrelated resource must not be
+        refreshable against this path."""
+        with self._mu:
+            self._expire_locked()
+            for p, l in self._locks.items():
+                if l["token"] == token and self._conflicts(p, l, path):
+                    l["timeout"] = timeout
+                    l["expires"] = time.monotonic() + timeout
+                    return l
+            return None
+
+    def unlock(self, token: str) -> bool:
+        with self._mu:
+            self._expire_locked()
+            for p, l in list(self._locks.items()):
+                if l["token"] == token:
+                    del self._locks[p]
+                    return True
+            return False
 
 
 def _rfc1123(ts: int) -> str:
@@ -34,6 +124,7 @@ class WebDavServer:
         self.filer = filer
         self.ip = ip
         self.port = port
+        self.locks = _DavLocks()
         self._http = ThreadingHTTPServer((ip, port), self._handler_class())
         self.tls = tls
         if tls is not None:
@@ -49,6 +140,7 @@ class WebDavServer:
 
     def _handler_class(self):
         filer = self.filer
+        locks = self.locks
 
         from ..utils.request_id import RequestTracingMixin
 
@@ -101,11 +193,139 @@ class WebDavServer:
                 self._send(
                     200,
                     extra={
-                        "DAV": "1",
-                        "Allow": "OPTIONS, PROPFIND, GET, HEAD, PUT, DELETE, MKCOL, MOVE, COPY",
+                        "DAV": "1, 2",
+                        "Allow": (
+                            "OPTIONS, PROPFIND, GET, HEAD, PUT, DELETE, "
+                            "MKCOL, MOVE, COPY, LOCK, UNLOCK"
+                        ),
                         "MS-Author-Via": "DAV",
                     },
                 )
+
+            # ------------------------------------------------ class 2
+
+            def _if_tokens(self) -> set[str]:
+                return set(
+                    re.findall(
+                        r"<(opaquelocktoken:[^>]+)>",
+                        self.headers.get("If", ""),
+                    )
+                )
+
+            def _locked(self, *paths: str) -> bool:
+                """423 unless every covering lock's token is presented
+                in the If header. Returns True when the request was
+                rejected."""
+                have = self._if_tokens()
+                for path in paths:
+                    if path is None:
+                        continue
+                    for _p, l in locks.covering(path):
+                        if l["token"] not in have:
+                            self._send(423)
+                            return True
+                return False
+
+            @staticmethod
+            def _parse_timeout(header: str | None) -> float:
+                for part in (header or "").split(","):
+                    part = part.strip()
+                    if part.lower().startswith("second-"):
+                        try:
+                            return min(
+                                float(part[7:]), _MAX_LOCK_TIMEOUT
+                            )
+                        except ValueError:
+                            pass
+                return _DEFAULT_LOCK_TIMEOUT
+
+            def _lock_xml(self, lock: dict) -> bytes:
+                prop = ET.Element(f"{{{DAV}}}prop")
+                disc = ET.SubElement(prop, f"{{{DAV}}}lockdiscovery")
+                al = ET.SubElement(disc, f"{{{DAV}}}activelock")
+                lt = ET.SubElement(al, f"{{{DAV}}}locktype")
+                ET.SubElement(lt, f"{{{DAV}}}write")
+                ls = ET.SubElement(al, f"{{{DAV}}}lockscope")
+                ET.SubElement(ls, f"{{{DAV}}}exclusive")
+                ET.SubElement(al, f"{{{DAV}}}depth").text = lock["depth"]
+                ET.SubElement(al, f"{{{DAV}}}owner").text = lock["owner"]
+                ET.SubElement(al, f"{{{DAV}}}timeout").text = (
+                    f"Second-{int(lock['timeout'])}"
+                )
+                tok = ET.SubElement(al, f"{{{DAV}}}locktoken")
+                ET.SubElement(tok, f"{{{DAV}}}href").text = lock["token"]
+                root = ET.SubElement(al, f"{{{DAV}}}lockroot")
+                # .text assignment: ET escapes XML metacharacters in
+                # paths ("Tom & Jerry.docx") on serialization
+                ET.SubElement(root, f"{{{DAV}}}href").text = lock["path"]
+                return (
+                    b'<?xml version="1.0" encoding="utf-8"?>'
+                    + ET.tostring(prop)
+                )
+
+            def do_LOCK(self):
+                body = self._drain()
+                path = self._path()
+                timeout = self._parse_timeout(self.headers.get("Timeout"))
+                if not body:
+                    # refresh: token arrives in the If header
+                    have = self._if_tokens()
+                    lock = None
+                    for t in have:
+                        lock = locks.refresh(t, timeout, path)
+                        if lock:
+                            break
+                    if lock is None:
+                        return self._send(412)
+                    return self._send(
+                        200,
+                        self._lock_xml(lock),
+                        extra={"Lock-Token": f"<{lock['token']}>"},
+                    )
+                owner = ""
+                try:
+                    doc = ET.fromstring(body)
+                    o = doc.find(f"{{{DAV}}}owner")
+                    if o is not None:
+                        owner = "".join(o.itertext()).strip() or (
+                            o[0].text or "" if len(o) else ""
+                        )
+                except ET.ParseError:
+                    return self._send(400)
+                depth = (
+                    "0"
+                    if self.headers.get("Depth", "infinity") == "0"
+                    else "infinity"
+                )
+                lock = locks.lock(path, owner, depth, timeout)
+                if lock is None:
+                    return self._send(423)
+                created = False
+                if not filer.exists(path):
+                    # RFC 4918 §7.3: LOCK on an unmapped URL creates an
+                    # empty lockable resource
+                    try:
+                        filer.write_file(path, b"")
+                        created = True
+                    except FilerError:
+                        locks.unlock(lock["token"])
+                        return self._send(409)
+                self._send(
+                    201 if created else 200,
+                    self._lock_xml(lock),
+                    extra={"Lock-Token": f"<{lock['token']}>"},
+                )
+
+            def do_UNLOCK(self):
+                self._drain()
+                m = re.search(
+                    r"<([^>]+)>", self.headers.get("Lock-Token", "")
+                )
+                if not m:
+                    return self._send(400)
+                if not locks.unlock(m.group(1)):
+                    return self._send(409)
+                self._send(204)
 
             def do_PROPFIND(self):
                 self._drain()
@@ -147,6 +367,23 @@ class WebDavServer:
                     entry.attr.mtime
                 )
                 ET.SubElement(prop, f"{{{DAV}}}displayname").text = entry.name
+                sl = ET.SubElement(prop, f"{{{DAV}}}supportedlock")
+                le = ET.SubElement(sl, f"{{{DAV}}}lockentry")
+                sc = ET.SubElement(le, f"{{{DAV}}}lockscope")
+                ET.SubElement(sc, f"{{{DAV}}}exclusive")
+                lt = ET.SubElement(le, f"{{{DAV}}}locktype")
+                ET.SubElement(lt, f"{{{DAV}}}write")
+                held = [l for p, l in locks.covering(path) if p == path]
+                if held:
+                    disc = ET.SubElement(prop, f"{{{DAV}}}lockdiscovery")
+                    al = ET.SubElement(disc, f"{{{DAV}}}activelock")
+                    alt = ET.SubElement(al, f"{{{DAV}}}locktype")
+                    ET.SubElement(alt, f"{{{DAV}}}write")
+                    als = ET.SubElement(al, f"{{{DAV}}}lockscope")
+                    ET.SubElement(als, f"{{{DAV}}}exclusive")
+                    ET.SubElement(al, f"{{{DAV}}}depth").text = held[0]["depth"]
+                    tok = ET.SubElement(al, f"{{{DAV}}}locktoken")
+                    ET.SubElement(tok, f"{{{DAV}}}href").text = held[0]["token"]
                 ET.SubElement(stat, f"{{{DAV}}}status").text = "HTTP/1.1 200 OK"
 
             def do_GET(self):
@@ -175,6 +412,8 @@ class WebDavServer:
 
             def do_PUT(self):
                 data = self._drain()
+                if self._locked(self._path()):
+                    return
                 try:
                     filer.write_file(
                         self._path(),
@@ -188,6 +427,8 @@ class WebDavServer:
             def do_MKCOL(self):
                 self._drain()
                 path = self._path()
+                if self._locked(path):
+                    return
                 if filer.exists(path):
                     return self._send(405)
                 try:
@@ -198,6 +439,8 @@ class WebDavServer:
 
             def do_DELETE(self):
                 path = self._path()
+                if self._locked(path):
+                    return
                 if not filer.exists(path):
                     return self._send(404)
                 filer.delete_entry(path, recursive=True)
@@ -227,6 +470,8 @@ class WebDavServer:
                 src = self._path()
                 if src == dst:
                     return self._send(403)  # RFC 4918: same resource
+                if self._locked(src, dst):
+                    return
                 if self._overwrite_blocked(dst):
                     return
                 try:
@@ -244,6 +489,8 @@ class WebDavServer:
                     return self._send(400)
                 if self._path() == dst:
                     return self._send(403)
+                if self._locked(dst):
+                    return
                 if self._overwrite_blocked(dst):
                     return
                 try:

@@ -230,3 +230,61 @@ def test_request_id_propagates_client_to_volume(tmp_path):
         request_id.clear()
         vs.stop()
         ms.stop()
+
+
+def test_telemetry_server_roundtrip(tmp_path):
+    """Collector client -> collector server: ingestion, summary,
+    Prometheus gauges, JSONL persistence across restart."""
+    import json
+
+    import requests
+
+    from seaweedfs_tpu.utils.telemetry import TelemetryCollector
+    from seaweedfs_tpu.utils.telemetry_server import TelemetryServer
+
+    persist = str(tmp_path / "telemetry.jsonl")
+    srv = TelemetryServer(ip="localhost", port=0, persist_path=persist)
+    srv.start()
+    try:
+        url = f"http://localhost:{srv.port}/api/collect"
+        col = TelemetryCollector(
+            url,
+            stats_fn=lambda: {"volume_count": 7, "server_count": 2},
+        )
+        assert col.send_once()
+        col2 = TelemetryCollector(
+            url, stats_fn=lambda: {"volume_count": 3, "server_count": 1}
+        )
+        assert col2.send_once()
+        # re-report from the same cluster replaces, not duplicates
+        assert col.send_once()
+
+        stats = requests.get(
+            f"http://localhost:{srv.port}/api/stats"
+        ).json()
+        assert stats["clusters"] == 2
+        assert stats["total_volume_count"] == 10
+        assert stats["total_server_count"] == 3
+
+        metrics = requests.get(f"http://localhost:{srv.port}/metrics").text
+        assert "seaweed_telemetry_clusters 2" in metrics
+        assert "seaweed_telemetry_total_volume_count 10" in metrics
+        assert f'cluster="{col.cluster_id}"' in metrics
+
+        # malformed report -> 400, not a dropped connection
+        r = requests.post(url, data=b"[1,2,3]")
+        assert r.status_code == 400
+    finally:
+        srv.stop()
+
+    # restart from the JSONL: state survives
+    srv2 = TelemetryServer(ip="localhost", port=0, persist_path=persist)
+    srv2.start()
+    try:
+        stats = requests.get(
+            f"http://localhost:{srv2.port}/api/stats"
+        ).json()
+        assert stats["clusters"] == 2
+        assert stats["total_volume_count"] == 10
+    finally:
+        srv2.stop()

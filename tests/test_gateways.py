@@ -190,3 +190,151 @@ def test_benchmark_cli(cluster):
     assert bench_main(
         ["-master", f"localhost:{cluster}", "-n", "40", "-size", "500", "-c", "4"]
     ) == 0
+
+
+def test_webdav_class2_locks(cluster):
+    """RFC 4918 class 2: LOCK/UNLOCK with If-token enforcement,
+    refresh, depth-infinity collection locks, unmapped-URL creation."""
+    from seaweedfs_tpu.server.webdav_server import WebDavServer
+
+    filer = Filer(MemoryStore(), master=f"localhost:{cluster}")
+    dav = WebDavServer(filer, ip="localhost", port=free_port())
+    dav.start()
+    base = f"http://localhost:{dav.port}"
+    try:
+        opts = requests.options(f"{base}/")
+        assert "2" in opts.headers["DAV"]
+        assert "LOCK" in opts.headers["Allow"]
+
+        lockinfo = (
+            '<?xml version="1.0"?><D:lockinfo xmlns:D="DAV:">'
+            "<D:lockscope><D:exclusive/></D:lockscope>"
+            "<D:locktype><D:write/></D:locktype>"
+            "<D:owner>alice</D:owner></D:lockinfo>"
+        )
+        # LOCK on an unmapped URL creates the resource (201)
+        r = requests.request(
+            "LOCK", f"{base}/doc.txt", data=lockinfo,
+            headers={"Timeout": "Second-60"},
+        )
+        assert r.status_code == 201, r.status_code
+        token = r.headers["Lock-Token"].strip("<>")
+        assert token.startswith("opaquelocktoken:")
+        assert "lockdiscovery" in r.text
+
+        # mutations without the token are 423; with it they pass
+        assert requests.put(f"{base}/doc.txt", data=b"x").status_code == 423
+        assert requests.delete(f"{base}/doc.txt").status_code == 423
+        r = requests.put(
+            f"{base}/doc.txt", data=b"locked write",
+            headers={"If": f"(<{token}>)"},
+        )
+        assert r.status_code == 201
+        assert requests.get(f"{base}/doc.txt").content == b"locked write"
+
+        # second LOCK on the same resource conflicts
+        r2 = requests.request("LOCK", f"{base}/doc.txt", data=lockinfo)
+        assert r2.status_code == 423
+
+        # refresh (empty body + If header)
+        r3 = requests.request(
+            "LOCK", f"{base}/doc.txt",
+            headers={"If": f"(<{token}>)", "Timeout": "Second-120"},
+        )
+        assert r3.status_code == 200 and "Second-120" in r3.text
+
+        # PROPFIND shows the active lock
+        pf = requests.request(
+            "PROPFIND", f"{base}/doc.txt", headers={"Depth": "0"}
+        )
+        assert "lockdiscovery" in pf.text and "supportedlock" in pf.text
+
+        # UNLOCK frees it
+        assert (
+            requests.request(
+                "UNLOCK", f"{base}/doc.txt",
+                headers={"Lock-Token": f"<{token}>"},
+            ).status_code
+            == 204
+        )
+        assert requests.put(f"{base}/doc.txt", data=b"free").status_code == 201
+
+        # depth-infinity collection lock protects children
+        requests.request("MKCOL", f"{base}/proj")
+        r = requests.request("LOCK", f"{base}/proj", data=lockinfo)
+        assert r.status_code == 200
+        ctoken = r.headers["Lock-Token"].strip("<>")
+        assert (
+            requests.put(f"{base}/proj/child.txt", data=b"y").status_code
+            == 423
+        )
+        assert (
+            requests.put(
+                f"{base}/proj/child.txt", data=b"y",
+                headers={"If": f"(<{ctoken}>)"},
+            ).status_code
+            == 201
+        )
+        # a MOVE of a locked subtree without the token is refused
+        requests.put(f"{base}/other.txt", data=b"z")
+        assert (
+            requests.request(
+                "MOVE", f"{base}/proj/child.txt",
+                headers={"Destination": f"{base}/elsewhere.txt"},
+            ).status_code
+            == 423
+        )
+    finally:
+        dav.stop()
+        filer.close()
+
+
+def test_kafka_notifier(cluster):
+    """Filer events flow to a Kafka-protocol broker (the reference's
+    weed/notification/kafka sink) and are consumable with any client."""
+    from seaweedfs_tpu.filer.notification import make_notifier
+    from seaweedfs_tpu.mq.broker import MqBrokerServer
+    from seaweedfs_tpu.mq.kafka.client import KafkaClient
+
+    broker = MqBrokerServer(
+        ip="localhost", grpc_port=free_port(), kafka_port=0,
+        archive_interval=0,
+    )
+    broker.start()
+    filer = Filer(MemoryStore(), master=f"localhost:{cluster}")
+    notifier = make_notifier(
+        "kafka", f"localhost:{broker.kafka.port}", topic="filer-ev"
+    )
+    filer.subscribe(notifier)
+    try:
+        filer.write_file("/kn/z.bin", b"kafka event")
+        c = KafkaClient("127.0.0.1", broker.kafka.port)
+        deadline = time.monotonic() + 10
+        found = False
+        while not found and time.monotonic() < deadline:
+            _, recs = c.fetch("filer-ev", 0, 0)
+            for r in recs:
+                ev = json.loads(r.value)
+                if ev.get("newEntry") and ev["newEntry"]["name"] == "z.bin":
+                    found = True
+            if not found:
+                time.sleep(0.05)
+        c.close()
+        assert found
+    finally:
+        notifier.close()
+        filer.close()
+        broker.stop()
+
+
+def test_gated_cloud_sinks_fail_loudly():
+    from seaweedfs_tpu.filer.notification import make_notifier
+
+    import pytest as _pytest
+
+    with _pytest.raises((RuntimeError, NotImplementedError)):
+        make_notifier("sqs", "https://sqs.region.amazonaws.com/q")
+    with _pytest.raises((RuntimeError, NotImplementedError)):
+        make_notifier("pubsub", "projects/p/topics/t")
+    with _pytest.raises(ValueError):
+        make_notifier("bogus", "x")

@@ -103,7 +103,7 @@ def test_leader_failover_and_no_id_reuse(group):
     before = [leader._alloc_volume_id() for _ in range(3)]
     leader.stop()
     survivors = [m for m in masters if m is not leader]
-    new_leader = _wait_leader(survivors, timeout=15)
+    new_leader = _wait_leader(survivors, timeout=30)
     after = [new_leader._alloc_volume_id() for _ in range(3)]
     assert min(after) > max(before), f"id reuse after failover: {before} {after}"
 
@@ -131,7 +131,7 @@ def test_restart_preserves_allocation_state(tmp_path):
         m.start()
         masters2.append(m)
     try:
-        leader2 = _wait_leader(masters2, timeout=15)
+        leader2 = _wait_leader(masters2, timeout=30)
         fresh = leader2._alloc_volume_id()
         assert fresh > max(issued), f"volume id reused after restart: {fresh} <= {max(issued)}"
     finally:
@@ -193,7 +193,7 @@ def test_keepconnected_session_and_failover(group, tmp_path):
         # kill the leader: assigns keep working via the new leader
         leader.stop()
         survivors = [m for m in masters if m is not leader]
-        _wait_leader(survivors, timeout=15)
+        _wait_leader(survivors, timeout=30)
         deadline = time.time() + 20
         last = None
         while time.time() < deadline:
@@ -241,24 +241,24 @@ def test_membership_grow_1_to_3_and_failover(tmp_path):
             )
             m.start()
             masters.append(m)
-            members = _wait_leader(masters, exclude=masters[1:]).raft.add_server(
-                addrs[i]
-            )
+            members = _wait_leader(
+                masters, timeout=30, exclude=masters[1:]
+            ).raft.add_server(addrs[i])
             assert addrs[i] in members
             # the joiner converges (gets the log/snapshot)
-            deadline = time.time() + 10
+            deadline = time.time() + 30
             while time.time() < deadline:
                 if m.raft.last_applied >= masters[0].raft.last_applied:
                     break
                 time.sleep(0.05)
 
-        leader = _wait_leader(masters)
+        leader = _wait_leader(masters, timeout=30)
         assert sorted({leader.raft.node_id, *leader.raft.peers}) == sorted(addrs)
 
         # kill the leader: the grown group elects a new one, ids monotonic
         leader.stop()
         rest = [m for m in masters if m is not leader]
-        new_leader = _wait_leader(rest, timeout=15)
+        new_leader = _wait_leader(rest, timeout=30)
         nid = new_leader.raft.propose("alloc_volume_id", 0)
         assert nid > max(ids)
     finally:

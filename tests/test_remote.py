@@ -273,3 +273,35 @@ def test_s3_sink_mirrors_filer_subtree(stack, tmp_path):
         assert "new.txt" in keys and "a.txt" not in keys
     finally:
         srv.stop()
+
+
+def test_remote_provider_registry():
+    """SPI: s3 + gcs-s3 resolve to the native client; cloud-SDK
+    providers fail loudly; custom providers register."""
+    import pytest as _pytest
+
+    from seaweedfs_tpu.remote.providers import make_remote_client, register
+    from seaweedfs_tpu.remote.s3_client import RemoteS3Client
+
+    c = make_remote_client(
+        "s3", endpoint="http://localhost:1", access_key="a", secret_key="b"
+    )
+    assert isinstance(c, RemoteS3Client)
+    g = make_remote_client("gcs-s3", access_key="a", secret_key="b")
+    assert isinstance(g, RemoteS3Client)
+    assert "storage.googleapis.com" in g.endpoint
+
+    with _pytest.raises((RuntimeError, NotImplementedError)):
+        make_remote_client("gcs")
+    with _pytest.raises((RuntimeError, NotImplementedError)):
+        make_remote_client("azure")
+    with _pytest.raises(ValueError):
+        make_remote_client("dropbox")
+
+    class Fake:
+        def __init__(self, **kw):
+            self.kw = kw
+
+    register("fake", Fake)
+    f = make_remote_client("fake", endpoint="x", access_key="k", secret_key="s")
+    assert isinstance(f, Fake) and f.kw["endpoint"] == "x"
