@@ -443,9 +443,15 @@ class QueryEngine:
 
     def _execute_select(self, sel: Select) -> Result:
         ns, name, count = self._resolve(sel.table)
+        return self.execute_rows(sel, self._scan(ns, name, count))
+
+    def execute_rows(self, sel: Select, source) -> Result:
+        """Run a parsed SELECT over an arbitrary row iterator — the
+        topic scan normally, but also the S3-Select path, which feeds
+        CSV/JSON object rows through the same executor."""
         rows = (
             row
-            for row in self._scan(ns, name, count)
+            for row in source
             if sel.where is None or self._eval(sel.where, row)
         )
         is_agg = any(c[0] == "agg" for c in sel.columns)

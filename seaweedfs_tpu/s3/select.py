@@ -1,0 +1,211 @@
+"""S3 Select (SelectObjectContent): SQL over one object's content.
+
+Reference: weed/s3api query/RPC surface (the reference volume server
+exposes a Query RPC and the s3api a ?select&select-type=2 route). The
+expression engine is the framework's own SQL executor (query/engine):
+the S3-Select dialect's `SELECT ... FROM S3Object s WHERE s.col ...`
+is normalized (alias stripping) and run through QueryEngine.execute_rows
+over rows parsed from the object (CSV with header modes, JSON lines or
+document, optional gzip), then serialized back as CSV/JSON records
+inside the AWS event-stream framing real SDK clients parse.
+"""
+
+from __future__ import annotations
+
+import gzip as _gzip
+import io
+import json
+import re
+import struct
+import zlib
+
+from ..query.engine import QueryEngine, QueryError, Select, parse
+
+# ---------------------------------------------------------------- input
+
+
+def _rows_csv(data: bytes, conf: dict):
+    import csv
+
+    delim = conf.get("FieldDelimiter") or ","
+    quote = conf.get("QuoteCharacter") or '"'
+    header = (conf.get("FileHeaderInfo") or "NONE").upper()
+    text = io.StringIO(data.decode("utf-8", "replace"))
+    reader = csv.reader(text, delimiter=delim, quotechar=quote)
+    names: list[str] | None = None
+    for i, rec in enumerate(reader):
+        if not rec:
+            continue
+        if i == 0 and header in ("USE", "IGNORE"):
+            if header == "USE":
+                names = rec
+            continue
+        if names:
+            yield {names[j]: _coerce(v) for j, v in enumerate(rec) if j < len(names)}
+        else:
+            # positional columns: _1.._N (AWS semantics for NONE/IGNORE)
+            yield {f"_{j + 1}": _coerce(v) for j, v in enumerate(rec)}
+
+
+def _coerce(v: str):
+    """CSV fields are text; numeric-looking values compare numerically
+    (matching the engine's JSON-typed rows)."""
+    try:
+        return int(v)
+    except ValueError:
+        try:
+            return float(v)
+        except ValueError:
+            return v
+
+
+def _rows_json(data: bytes, conf: dict):
+    kind = (conf.get("Type") or "DOCUMENT").upper()
+    if kind == "LINES":
+        for line in data.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            doc = json.loads(line)
+            if isinstance(doc, dict):
+                yield doc
+    else:
+        doc = json.loads(data or b"null")
+        if isinstance(doc, list):
+            for d in doc:
+                if isinstance(d, dict):
+                    yield d
+        elif isinstance(doc, dict):
+            yield doc
+
+
+def parse_rows(data: bytes, input_ser: dict):
+    if (input_ser.get("CompressionType") or "NONE").upper() == "GZIP":
+        data = _gzip.decompress(data)
+    if "JSON" in input_ser:
+        return _rows_json(data, input_ser["JSON"])
+    return _rows_csv(data, input_ser.get("CSV", {}))
+
+
+# ----------------------------------------------------------- expression
+
+_ALIAS_RE = re.compile(r"\bFROM\s+S3Object(?:\s+(?:AS\s+)?(\w+))?", re.I)
+
+
+def normalize_expression(expr: str) -> str:
+    """S3-Select dialect -> the engine's dialect: resolve the S3Object
+    alias and strip its prefix from column references — OUTSIDE string
+    literals only (a literal like 's.local' must survive intact)."""
+    m = _ALIAS_RE.search(expr)
+    alias = None
+    if m:
+        alias = m.group(1)
+        expr = expr[: m.start()] + " FROM s3object " + expr[m.end() :]
+    prefixes = [p for p in {alias, "s3object", "S3Object"} if p]
+    # split on single-quoted spans (SQL escapes quotes by doubling, so
+    # '' stays inside one span); rewrite only even (unquoted) segments
+    parts = re.split(r"('(?:[^']|'')*')", expr)
+    for i in range(0, len(parts), 2):
+        for prefix in prefixes:
+            parts[i] = re.sub(
+                rf"\b{re.escape(prefix)}\.(\w+)", r"\1", parts[i]
+            )
+    return "".join(parts)
+
+
+# ------------------------------------------------------------- output
+
+
+def serialize_rows(result, output_ser: dict) -> bytes:
+    if "JSON" in output_ser:
+        rd = output_ser["JSON"].get("RecordDelimiter") or "\n"
+        out = []
+        for row in result.rows:
+            out.append(
+                json.dumps(
+                    {
+                        c: v
+                        for c, v in zip(result.columns, row)
+                        if v is not None
+                    }
+                )
+            )
+        return (rd.join(out) + (rd if out else "")).encode()
+    conf = output_ser.get("CSV", {})
+    delim = conf.get("FieldDelimiter") or ","
+    rd = conf.get("RecordDelimiter") or "\n"
+    import csv
+
+    buf = io.StringIO()
+    w = csv.writer(buf, delimiter=delim, lineterminator=rd)
+    for row in result.rows:
+        w.writerow(["" if v is None else v for v in row])
+    return buf.getvalue().encode()
+
+
+# --------------------------------------------------------- event stream
+
+
+def _event_message(headers: list[tuple[str, str]], payload: bytes) -> bytes:
+    """AWS event-stream message: [total u32][hdr_len u32][prelude crc]
+    [headers][payload][message crc] — the framing every AWS SDK's
+    SelectObjectContent reader expects."""
+    hdr = b""
+    for name, value in headers:
+        nb = name.encode()
+        vb = value.encode()
+        hdr += struct.pack(">B", len(nb)) + nb
+        hdr += b"\x07" + struct.pack(">H", len(vb)) + vb  # type 7: string
+    total = 12 + len(hdr) + len(payload) + 4
+    prelude = struct.pack(">II", total, len(hdr))
+    prelude_crc = struct.pack(">I", zlib.crc32(prelude))
+    body = prelude + prelude_crc + hdr + payload
+    return body + struct.pack(">I", zlib.crc32(body))
+
+
+def event_stream(records: bytes, scanned: int, processed: int) -> bytes:
+    """Records + Stats + End events."""
+    out = b""
+    if records:
+        out += _event_message(
+            [
+                (":message-type", "event"),
+                (":event-type", "Records"),
+                (":content-type", "application/octet-stream"),
+            ],
+            records,
+        )
+    stats = (
+        "<Stats><BytesScanned>{s}</BytesScanned>"
+        "<BytesProcessed>{s}</BytesProcessed>"
+        "<BytesReturned>{r}</BytesReturned></Stats>"
+    ).format(s=scanned, r=processed)
+    out += _event_message(
+        [
+            (":message-type", "event"),
+            (":event-type", "Stats"),
+            (":content-type", "text/xml"),
+        ],
+        stats.encode(),
+    )
+    out += _event_message(
+        [(":message-type", "event"), (":event-type", "End")], b""
+    )
+    return out
+
+
+# ---------------------------------------------------------------- main
+
+
+def select_object_content(
+    data: bytes, expression: str, input_ser: dict, output_ser: dict
+) -> bytes:
+    """-> the complete event-stream response body. Raises QueryError
+    for unsupported/invalid expressions."""
+    sel = parse(normalize_expression(expression))
+    if not isinstance(sel, Select):
+        raise QueryError("only SELECT is supported")
+    engine = QueryEngine(broker=None)
+    result = engine.execute_rows(sel, parse_rows(data, input_ser))
+    records = serialize_rows(result, output_ser)
+    return event_stream(records, len(data), len(records))

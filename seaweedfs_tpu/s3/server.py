@@ -17,6 +17,7 @@ import time
 import urllib.parse
 import uuid
 import xml.etree.ElementTree as ET
+import zlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..filer.entry import new_entry, normalize_path
@@ -902,6 +903,84 @@ class S3Server:
                 )
                 return self._respond(200)
 
+            def _select_object(self, bucket: str, key: str, path: str):
+                """SelectObjectContent (?select&select-type=2): SQL over
+                one object via the framework's own query engine, with
+                the AWS event-stream response framing (reference: the
+                volume-server Query RPC / s3api select route)."""
+                from ..query.engine import QueryError
+                from . import select as s3sel
+
+                try:
+                    entry = srv.filer.find_entry(path)
+                except NotFound:
+                    return self._error(404, "NoSuchKey", key)
+                try:
+                    doc = ET.fromstring(self._read_body())
+                except ET.ParseError:
+                    return self._error(400, "MalformedXML", "select request")
+                ns = _xml_ns(doc)
+
+                def section(tag: str) -> dict:
+                    el = doc.find(f"{ns}{tag}")
+                    out: dict = {}
+                    if el is None:
+                        return out
+                    for child in el:
+                        cname = child.tag.split("}")[-1]
+                        if len(child):
+                            out[cname] = {
+                                g.tag.split("}")[-1]: (g.text or "")
+                                for g in child
+                            }
+                        elif child.text and child.text.strip():
+                            out[cname] = child.text.strip()
+                        else:
+                            out[cname] = {}  # empty section like <JSON/>
+                    return out
+
+                expression = doc.findtext(f"{ns}Expression") or ""
+                if (
+                    doc.findtext(f"{ns}ExpressionType") or "SQL"
+                ).upper() != "SQL":
+                    return self._error(
+                        400, "InvalidArgument", "ExpressionType must be SQL"
+                    )
+                input_ser = section("InputSerialization")
+                output_ser = section("OutputSerialization")
+                # SSE: decrypt before querying (fail closed like GET)
+                data = srv.filer.read_entry(entry)
+                data_key = sse.decrypt_key_for_entry(
+                    entry,
+                    sse.parse_customer_headers(self.headers),
+                    srv.sse_keyring,
+                )
+                if data_key is not None:
+                    data = sse.decrypt(
+                        data_key,
+                        entry.extended.get(sse.SSE_IV_KEY) or b"",
+                        data,
+                    )
+                try:
+                    body = s3sel.select_object_content(
+                        data, expression, input_ser, output_ser
+                    )
+                except QueryError as e:
+                    return self._error(400, "InvalidQuery", str(e))
+                except (
+                    ValueError,
+                    json.JSONDecodeError,
+                    OSError,
+                    EOFError,  # gzip truncated-stream signal
+                    zlib.error,  # corrupt deflate payload
+                ) as e:
+                    return self._error(
+                        400, "InvalidTextEncoding", repr(e)[:200]
+                    )
+                return self._respond(
+                    200, body, ctype="application/octet-stream"
+                )
+
             # ---- bucket policy / encryption / acl subresources ----
 
             def _bucket_policy_op(self, bucket: str, path: str, q: dict):
@@ -1221,6 +1300,8 @@ class S3Server:
                 if m == "GET" and "uploadId" in q:
                     return self._list_parts(bucket, key, q)
 
+                if m == "POST" and "select" in q:
+                    return self._select_object(bucket, key, path)
                 if "tagging" in q:
                     return self._object_tagging(bucket, key, path)
                 if "retention" in q:

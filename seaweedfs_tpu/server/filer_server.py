@@ -406,8 +406,27 @@ class FilerServer:
 
                     filer.create_entry(new_entry(path, is_directory=True, mode=0o755))
                     return self._json(201, {"path": path})
-                length = int(self.headers.get("Content-Length", "0"))
-                body = self.rfile.read(length)
+                if "chunked" in (
+                    self.headers.get("Transfer-Encoding", "")
+                ).lower():
+                    # streaming clients (curl -T, shell fs.cp) send
+                    # chunked bodies with no Content-Length
+                    parts = []
+                    while True:
+                        line = self.rfile.readline(1024).strip()
+                        try:
+                            size = int(line.split(b";")[0], 16)
+                        except ValueError:
+                            break
+                        if size == 0:
+                            self.rfile.readline(1024)  # trailing CRLF
+                            break
+                        parts.append(self.rfile.read(size))
+                        self.rfile.read(2)  # chunk CRLF
+                    body = b"".join(parts)
+                else:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = self.rfile.read(length)
                 from .volume_server import _parse_upload
 
                 name, mime, data = _parse_upload(self.headers, body)

@@ -45,28 +45,40 @@ class _DavLocks:
             del self._locks[p]
 
     @staticmethod
-    def _conflicts(lock_path: str, lock: dict, path: str) -> bool:
+    def _conflicts(
+        lock_path: str, lock: dict, path: str, member_change: bool = False
+    ) -> bool:
         """One predicate for both enforcement and acquisition: the lock
         covers `path` when it IS the path, is an ancestor with Depth
-        infinity, or sits underneath it (collection delete/move)."""
+        infinity, or sits underneath it (collection delete/move).
+        member_change additionally applies a DEPTH-0 lock on the DIRECT
+        parent (RFC 4918 §7.4: a depth-0 collection lock protects the
+        collection's membership, not members' content)."""
         anc = (
             lock_path == "/"
             or path == lock_path
             or path.startswith(lock_path.rstrip("/") + "/")
         )
-        return (
+        if (
             lock_path == path
             or (anc and lock["depth"] == "infinity")
             or lock_path.startswith(path.rstrip("/") + "/")
-        )
+        ):
+            return True
+        if member_change:
+            parent = path.rstrip("/").rsplit("/", 1)[0] or "/"
+            return lock_path == parent
+        return False
 
-    def covering(self, path: str) -> list[tuple[str, dict]]:
+    def covering(
+        self, path: str, member_change: bool = False
+    ) -> list[tuple[str, dict]]:
         with self._mu:
             self._expire_locked()
             return [
                 (p, l)
                 for p, l in self._locks.items()
-                if self._conflicts(p, l, path)
+                if self._conflicts(p, l, path, member_change)
             ]
 
     def lock(
@@ -212,15 +224,16 @@ class WebDavServer:
                     )
                 )
 
-            def _locked(self, *paths: str) -> bool:
+            def _locked(self, *paths: str, member_change: bool = False) -> bool:
                 """423 unless every covering lock's token is presented
                 in the If header. Returns True when the request was
-                rejected."""
+                rejected. member_change: the op adds/removes a
+                collection member, so a depth-0 parent lock applies."""
                 have = self._if_tokens()
                 for path in paths:
                     if path is None:
                         continue
-                    for _p, l in locks.covering(path):
+                    for _p, l in locks.covering(path, member_change):
                         if l["token"] not in have:
                             self._send(423)
                             return True
@@ -412,7 +425,9 @@ class WebDavServer:
 
             def do_PUT(self):
                 data = self._drain()
-                if self._locked(self._path()):
+                path = self._path()
+                # creating a file changes the parent's membership
+                if self._locked(path, member_change=not filer.exists(path)):
                     return
                 try:
                     filer.write_file(
@@ -427,7 +442,7 @@ class WebDavServer:
             def do_MKCOL(self):
                 self._drain()
                 path = self._path()
-                if self._locked(path):
+                if self._locked(path, member_change=True):
                     return
                 if filer.exists(path):
                     return self._send(405)
@@ -439,7 +454,7 @@ class WebDavServer:
 
             def do_DELETE(self):
                 path = self._path()
-                if self._locked(path):
+                if self._locked(path, member_change=True):
                     return
                 if not filer.exists(path):
                     return self._send(404)
@@ -470,7 +485,7 @@ class WebDavServer:
                 src = self._path()
                 if src == dst:
                     return self._send(403)  # RFC 4918: same resource
-                if self._locked(src, dst):
+                if self._locked(src, dst, member_change=True):
                     return
                 if self._overwrite_blocked(dst):
                     return

@@ -183,7 +183,7 @@ class TelemetryServer:
                 self.wfile.write(body)
 
             def do_POST(self):
-                if self.path.rstrip("/") != "/api/collect":
+                if self.path.split("?")[0].rstrip("/") != "/api/collect":
                     return self._send(404, b"{}", "application/json")
                 try:
                     n = int(self.headers.get("Content-Length", "0") or 0)

@@ -558,3 +558,110 @@ def test_post_policy_rejects_uncovered_fields(s3_two_users):
         extra_fields={"acl": "public-read"},
     )
     assert r.status_code == 204
+
+
+# -------------------------------------------------------------- S3 Select
+
+
+def _parse_event_stream(body: bytes) -> dict:
+    """Minimal AWS event-stream reader: {event_type: payload}."""
+    import struct as _struct
+    import zlib as _zlib
+
+    out = {}
+    pos = 0
+    while pos < len(body):
+        total, hlen = _struct.unpack_from(">II", body, pos)
+        prelude_crc = _struct.unpack_from(">I", body, pos + 8)[0]
+        assert _zlib.crc32(body[pos : pos + 8]) == prelude_crc
+        headers_raw = body[pos + 12 : pos + 12 + hlen]
+        payload = body[pos + 12 + hlen : pos + total - 4]
+        msg_crc = _struct.unpack_from(">I", body, pos + total - 4)[0]
+        assert _zlib.crc32(body[pos : pos + total - 4]) == msg_crc
+        headers = {}
+        hp = 0
+        while hp < len(headers_raw):
+            nlen = headers_raw[hp]
+            name = headers_raw[hp + 1 : hp + 1 + nlen].decode()
+            hp += 1 + nlen
+            assert headers_raw[hp] == 7  # string
+            vlen = _struct.unpack_from(">H", headers_raw, hp + 1)[0]
+            headers[name] = headers_raw[hp + 3 : hp + 3 + vlen].decode()
+            hp += 3 + vlen
+        out[headers.get(":event-type", "?")] = payload
+        pos += total
+    return out
+
+
+def test_s3_select_csv_and_json(s3):
+    url, _ = s3
+    requests.put(f"{url}/sel")
+    csv_data = "city,pop\nparis,2100000\nlyon,520000\nnice,340000\n"
+    requests.put(f"{url}/sel/cities.csv", data=csv_data.encode())
+
+    def select(key, expression, input_xml, output_xml="<JSON/>"):
+        req = (
+            '<?xml version="1.0"?><SelectObjectContentRequest>'
+            f"<Expression>{expression}</Expression>"
+            "<ExpressionType>SQL</ExpressionType>"
+            f"<InputSerialization>{input_xml}</InputSerialization>"
+            f"<OutputSerialization>{output_xml}</OutputSerialization>"
+            "</SelectObjectContentRequest>"
+        )
+        return requests.post(
+            f"{url}/sel/{key}?select&amp;select-type=2".replace("&amp;", "&"),
+            data=req,
+        )
+
+    r = select(
+        "cities.csv",
+        "SELECT s.city FROM S3Object s WHERE s.pop &gt; 500000"
+        .replace("&gt;", ">"),
+        "<CSV><FileHeaderInfo>USE</FileHeaderInfo></CSV>",
+    )
+    assert r.status_code == 200, r.text
+    events = _parse_event_stream(r.content)
+    assert "End" in events and "Stats" in events
+    rows = [json.loads(x) for x in events["Records"].split(b"\n") if x]
+    assert rows == [{"city": "paris"}, {"city": "lyon"}]
+
+    # positional columns (no header), CSV output
+    r = select(
+        "cities.csv",
+        "SELECT s._1 FROM S3Object s WHERE s._2 = 340000",
+        "<CSV><FileHeaderInfo>IGNORE</FileHeaderInfo></CSV>",
+        "<CSV/>",
+    )
+    events = _parse_event_stream(r.content)
+    assert events["Records"].strip() == b"nice"
+
+    # JSON lines + aggregate
+    jl = "\n".join(
+        json.dumps({"n": i, "grp": "a" if i % 2 else "b"}) for i in range(10)
+    )
+    requests.put(f"{url}/sel/data.jsonl", data=jl.encode())
+    r = select(
+        "data.jsonl",
+        "SELECT COUNT(*) AS c, MAX(n) AS m FROM S3Object s WHERE s.grp = 'a'",
+        "<JSON><Type>LINES</Type></JSON>",
+    )
+    events = _parse_event_stream(r.content)
+    row = json.loads(events["Records"].split(b"\n")[0])
+    assert row == {"c": 5, "m": 9}
+
+    # gzip input
+    import gzip as _gz
+
+    requests.put(f"{url}/sel/z.csv.gz", data=_gz.compress(csv_data.encode()))
+    r = select(
+        "z.csv.gz",
+        "SELECT COUNT(*) AS n FROM S3Object",
+        "<CSV><FileHeaderInfo>USE</FileHeaderInfo></CSV>"
+        "<CompressionType>GZIP</CompressionType>",
+    )
+    events = _parse_event_stream(r.content)
+    assert json.loads(events["Records"].split(b"\n")[0]) == {"n": 3}
+
+    # invalid SQL -> clean 400
+    r = select("cities.csv", "DROP TABLE x", "<CSV/>")
+    assert r.status_code == 400

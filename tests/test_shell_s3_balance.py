@@ -203,3 +203,45 @@ def test_s3_accesskey_lifecycle(s3_stack):
         wait_for(key_revoked, msg="revoked key stops working")
     finally:
         env.close()
+
+
+def test_r4_ops_surface_batch(s3_stack):
+    """fs.cp / fs.stat / fs.verify / cluster.lock.ring / volume.deleteEmpty."""
+    import hashlib
+
+    master, filer, s3, fport = s3_stack
+    env = ShellEnv(f"localhost:{master.port}", filer=f"localhost:{fport}")
+    try:
+        data = b"shell surface" * 100
+        filer.write_file("/ops/a.bin", data)
+
+        out = run_command(env, "fs.cp /ops/a.bin /ops/b.bin")
+        assert "copied" in out, out
+        assert filer.read_file("/ops/b.bin") == data
+
+        out = run_command(env, "fs.stat /ops/a.bin")
+        assert f"size:      {len(data)}" in out and "type:      file" in out
+
+        out = run_command(env, "fs.verify /ops/a.bin")
+        assert hashlib.md5(data).hexdigest() in out
+        assert f"{len(data)} bytes readable" in out
+
+        # lock ring listing sees a live lease
+        from seaweedfs_tpu.filer.lock_ring import DlmClient
+
+        c = DlmClient([f"localhost:{fport + 10000}"])
+        r = c.lock("jobs/x", owner="shell-test", ttl=30)
+        assert r.ok
+        out = run_command(env, "cluster.lock.ring")
+        assert "jobs/x" in out and "shell-test" in out
+        c.unlock("jobs/x", r.token)
+        c.close()
+
+        # empty volumes: grow some, then delete them
+        run_command(env, "volume.grow -count 2")
+        plan = run_command(env, "volume.deleteEmpty")
+        assert "would delete" in plan
+        out = run_command(env, "volume.deleteEmpty -force")
+        assert "deleted empty volume" in out
+    finally:
+        env.close()
