@@ -200,6 +200,27 @@ class AdminServer:
                     "backend": w.backend,
                     "active": w.active,
                     "max_concurrent": w.max_concurrent,
+                    # declarative per-job config the dashboard renders
+                    # (reference weed/admin/plugin DESIGN)
+                    "descriptors": [
+                        {
+                            "kind": d.kind,
+                            "display_name": d.display_name,
+                            "description": d.description,
+                            "fields": [
+                                {
+                                    "name": f.name,
+                                    "type": f.type,
+                                    "default": f.default,
+                                    "help": f.help,
+                                    "min": f.min,
+                                    "max": f.max,
+                                }
+                                for f in d.fields
+                            ],
+                        }
+                        for d in w.descriptors
+                    ],
                 }
                 for w in workers.workers
             ],
@@ -223,15 +244,18 @@ class AdminServer:
             volume_id = int(raw_vid)
         except (TypeError, ValueError):
             return {"error": f"volume_id must be an integer, got {raw_vid!r}"}
-        resp = self._worker_stub.SubmitTask(
-            wk.SubmitTaskRequest(
-                kind=str(body.get("kind", "")),
-                volume_id=volume_id,
-                collection=str(body.get("collection", "")),
-                backend=str(body.get("backend", "")),
-            ),
-            timeout=10,
+        req = wk.SubmitTaskRequest(
+            kind=str(body.get("kind", "")),
+            volume_id=volume_id,
+            collection=str(body.get("collection", "")),
+            backend=str(body.get("backend", "")),
         )
+        params = body.get("params") or {}
+        if not isinstance(params, dict):
+            return {"error": "params must be an object"}
+        for k, v in params.items():
+            req.params[str(k)] = str(v)
+        resp = self._worker_stub.SubmitTask(req, timeout=10)
         if resp.error:
             return {"error": resp.error}
         return {"task_id": resp.task_id}

@@ -306,8 +306,11 @@ class VolumeService:
         )
         backend_name = request.backend or self.server.store.ec_backend
         dat_size = os.path.getsize(base + ".dat")
+        from ..ec.encoder import DEFAULT_BATCH
+
+        batch = (request.batch_mb << 20) if request.batch_mb else DEFAULT_BATCH
         with M.request_seconds.time(server="volume", op="ec_encode"):
-            vi = ec_encode_volume(base, ctx, backend)
+            vi = ec_encode_volume(base, ctx, backend, batch_size=batch)
         M.ec_ops_total.inc(op="encode", backend=backend_name)
         M.ec_bytes_total.inc(dat_size, op="encode", backend=backend_name)
         return pb.EcShardsGenerateResponse(generation=vi.encode_ts_ns)

@@ -27,6 +27,9 @@ class _Worker:
     outbox: "queue.Queue" = field(default_factory=queue.Queue)
     active: int = 0
     last_seen: float = field(default_factory=time.time)
+    # declarative per-job config (reference weed/admin/plugin):
+    # kind -> TaskDescriptor proto
+    descriptors: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -36,6 +39,7 @@ class _Task:
     volume_id: int
     collection: str
     backend: str
+    params: dict = field(default_factory=dict)
     state: str = "pending"  # pending|assigned|running|done|failed
     worker_id: str = ""
     progress: float = 0.0
@@ -86,9 +90,26 @@ class WorkerControl:
                     return v.collection
         return ""
 
-    def submit(self, kind: str, volume_id: int, collection: str = "", backend: str = "") -> str:
-        if kind not in KNOWN_KINDS:
-            raise ValueError(f"unknown task kind {kind!r} (want {KNOWN_KINDS})")
+    def submit(
+        self,
+        kind: str,
+        volume_id: int,
+        collection: str = "",
+        backend: str = "",
+        params: dict | None = None,
+    ) -> str:
+        with self._lock:  # _workers mutates under this lock
+            plugin_kinds = sorted(
+                set().union(*(w.capabilities for w in self._workers.values()))
+                if self._workers
+                else set()
+            )
+        if kind not in KNOWN_KINDS and kind not in plugin_kinds:
+            raise ValueError(
+                f"unknown task kind {kind!r} (built-in: {KNOWN_KINDS}; "
+                f"connected plugin kinds: {plugin_kinds or 'none'})"
+            )
+        params = self._validate_params(kind, dict(params or {}))
         if not collection:
             # collection determines on-disk paths; a task executed with
             # the wrong one fails AFTER destructive steps
@@ -96,16 +117,24 @@ class WorkerControl:
         task_id = uuid.uuid4().hex[:12]
         with self._lock:
             self._prune_locked()
-            # dedupe: one live task per (kind, volume)
+            # dedupe: one live task per (kind, volume). A duplicate
+            # with DIFFERENT params must fail loudly, not silently
+            # drop the caller's overrides.
             for t in self._tasks.values():
                 if (
                     t.kind == kind
                     and t.volume_id == volume_id
                     and t.state in ("pending", "assigned", "running")
                 ):
+                    if params and params != t.params:
+                        raise ValueError(
+                            f"task {t.task_id} for {kind}/{volume_id} is "
+                            f"already live with params {t.params}; cancel "
+                            "it before re-submitting with different params"
+                        )
                     return t.task_id
             self._tasks[task_id] = _Task(
-                task_id, kind, volume_id, collection, backend
+                task_id, kind, volume_id, collection, backend, params
             )
             self._pending.append(task_id)
             self._lock.notify_all()
@@ -142,18 +171,71 @@ class WorkerControl:
                     t.state = "assigned"
                     t.worker_id = w.worker_id
                     w.active += 1
-                    w.outbox.put(
-                        wk.ServerMessage(
-                            assign=wk.TaskAssign(
-                                task_id=t.task_id,
-                                kind=t.kind,
-                                volume_id=t.volume_id,
-                                collection=t.collection,
-                                backend=t.backend or w.backend,
-                            )
-                        )
+                    assign = wk.TaskAssign(
+                        task_id=t.task_id,
+                        kind=t.kind,
+                        volume_id=t.volume_id,
+                        collection=t.collection,
+                        backend=t.backend or w.backend,
                     )
+                    for pk, pv in t.params.items():
+                        assign.params[pk] = pv
+                    w.outbox.put(wk.ServerMessage(assign=assign))
                 self._pending = still_pending
+
+    def _validate_params(self, kind: str, params: dict) -> dict:
+        """Validate submitted params against the kind's declarative
+        descriptor (reference weed/admin/plugin DESIGN: per-job config
+        schema declared by the worker at registration). Unknown keys
+        and type/range violations are rejected; declared defaults fill
+        absent fields."""
+        desc = None
+        with self._lock:
+            for w in self._workers.values():
+                if kind in w.descriptors:
+                    desc = w.descriptors[kind]
+                    break
+        if desc is None:
+            if params:
+                raise ValueError(
+                    f"task kind {kind!r} declares no config fields"
+                )
+            return {}
+        fields = {f.name: f for f in desc.fields}
+        for name in params:
+            if name not in fields:
+                raise ValueError(
+                    f"unknown param {name!r} for {kind!r} "
+                    f"(declared: {sorted(fields)})"
+                )
+        out: dict = {}
+        for name, f in fields.items():
+            raw = params.get(name, f.default)
+            if raw == "" and name not in params:
+                continue  # optional, no default
+            if f.type == "int":
+                try:
+                    v = int(raw)
+                except ValueError:
+                    raise ValueError(f"param {name!r} must be an int") from None
+            elif f.type == "float":
+                try:
+                    v = float(raw)
+                except ValueError:
+                    raise ValueError(f"param {name!r} must be a float") from None
+            elif f.type == "bool":
+                if str(raw).lower() not in ("true", "false", "0", "1"):
+                    raise ValueError(f"param {name!r} must be a bool")
+                v = str(raw).lower() in ("true", "1")
+            else:
+                v = str(raw)
+            if f.type in ("int", "float") and not (f.min == f.max == 0):
+                if not (f.min <= float(v) <= f.max):
+                    raise ValueError(
+                        f"param {name!r}={v} outside [{f.min}, {f.max}]"
+                    )
+            out[name] = str(raw)
+        return out
 
     def _pick_worker(self, kind: str):
         best = None
@@ -183,6 +265,9 @@ class WorkerControl:
                                 capabilities=set(r.capabilities),
                                 max_concurrent=r.max_concurrent or 1,
                                 backend=r.backend or "auto",
+                                descriptors={
+                                    d.kind: d for d in r.descriptors
+                                },
                             )
                             self._workers[worker.worker_id] = worker
                             self._lock.notify_all()
@@ -263,7 +348,11 @@ class WorkerControl:
     def SubmitTask(self, request, context):
         try:
             task_id = self.submit(
-                request.kind, request.volume_id, request.collection, request.backend
+                request.kind,
+                request.volume_id,
+                request.collection,
+                request.backend,
+                params=dict(request.params),
             )
         except ValueError as e:
             return wk.SubmitTaskResponse(error=str(e))
@@ -290,6 +379,11 @@ class WorkerControl:
 
     def ListWorkers(self, request, context):
         workers, _ = self.snapshot()
+        with self._lock:
+            descs = {
+                wid: list(w.descriptors.values())
+                for wid, w in self._workers.items()
+            }
         return wk.ListWorkersResponse(
             workers=[
                 wk.WorkerInfo(
@@ -298,6 +392,7 @@ class WorkerControl:
                     backend=w["backend"],
                     active=w["active"],
                     max_concurrent=w["max_concurrent"],
+                    descriptors=descs.get(w["worker_id"], []),
                 )
                 for w in workers
             ]

@@ -37,6 +37,40 @@ class Worker:
         self.capabilities = capabilities
         self.backend = backend
         self.max_concurrent = max_concurrent
+        # declarative per-job config (reference weed/admin/plugin):
+        # built-in kinds ship their tunables; plugin workers may extend
+        self.descriptors: list[wk.TaskDescriptor] = [
+            wk.TaskDescriptor(
+                kind="ec_encode",
+                display_name="Erasure encode",
+                description="RS 10+4 encode a sealed volume into shards",
+                fields=[
+                    wk.ConfigField(
+                        name="batch_mb",
+                        type="int",
+                        default="16",
+                        help="device batch size per shard (MiB)",
+                        min=1,
+                        max=256,
+                    )
+                ],
+            ),
+            wk.TaskDescriptor(
+                kind="vacuum",
+                display_name="Vacuum",
+                description="compact a volume, dropping deleted needles",
+                fields=[
+                    wk.ConfigField(
+                        name="garbage_threshold",
+                        type="float",
+                        default="0.3",
+                        help="minimum reclaimable fraction",
+                        min=0.0,
+                        max=1.0,
+                    )
+                ],
+            ),
+        ]
         self._outbox: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
         self._mc = MasterClient(master)
@@ -51,6 +85,9 @@ class Worker:
                 capabilities=list(self.capabilities),
                 max_concurrent=self.max_concurrent,
                 backend=self.backend,
+                descriptors=[
+                    d for d in self.descriptors if d.kind in self.capabilities
+                ],
             )
         )
         while not self._stop.is_set():
@@ -139,11 +176,16 @@ class Worker:
                 )
             self._report(assign.task_id, "running", 0.2)
             _, _, gen_stub = holders[0]
+            try:
+                batch_mb = int(assign.params.get("batch_mb", "") or 0)
+            except ValueError:
+                batch_mb = 0
             gen_stub.VolumeEcShardsGenerate(
                 pb.EcShardsGenerateRequest(
                     volume_id=vid,
                     collection=assign.collection,
                     backend=assign.backend or self.backend,
+                    batch_mb=batch_mb,
                 ),
                 timeout=3600,
             )
@@ -163,10 +205,22 @@ class Worker:
                 ch.close()
 
     def _task_vacuum(self, assign: wk.TaskAssign) -> None:
+        # declarative per-job config: garbage_threshold from the
+        # validated TaskAssign params. Absent params use the WORKER'S
+        # declared default (0.3) — behavior must not depend on whether
+        # a descriptor-bearing worker was registered at submit time.
+        try:
+            threshold = float(assign.params.get("garbage_threshold", "") or 0.3)
+        except ValueError:
+            threshold = 0.3
         for _, ch, stub in self._holder_stubs(assign.volume_id):
             try:
                 stub.VacuumVolume(
-                    pb.VacuumRequest(volume_id=assign.volume_id), timeout=3600
+                    pb.VacuumRequest(
+                        volume_id=assign.volume_id,
+                        garbage_threshold=threshold,
+                    ),
+                    timeout=3600,
                 )
             finally:
                 ch.close()

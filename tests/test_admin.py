@@ -282,3 +282,73 @@ def test_admin_auth_token(stack, tmp_path):
         assert code == 400  # authenticated, rejected for unknown kind
     finally:
         locked.stop()
+
+
+def test_plugin_task_descriptors(stack):
+    """Declarative per-job config (reference weed/admin/plugin DESIGN):
+    workers register descriptors, the admin API exposes them, submitted
+    params are validated against them and reach the worker."""
+    master, vs, admin, aport = stack
+    w = Worker(master=f"localhost:{master.port}", backend="cpu")
+    threading.Thread(target=w.run, daemon=True).start()
+    try:
+        def worker_rows():
+            return get(aport, "/api/maintenance")["workers"]
+
+        wait_for(lambda: worker_rows(), msg="worker registers")
+        row = worker_rows()[0]
+        kinds = {d["kind"]: d for d in row["descriptors"]}
+        assert "vacuum" in kinds and "ec_encode" in kinds
+        vac = kinds["vacuum"]["fields"][0]
+        assert vac["name"] == "garbage_threshold"
+        assert vac["type"] == "float" and vac["max"] == 1.0
+
+        # invalid param values are rejected with the declared bounds
+        code, out = post(
+            aport,
+            "/api/maintenance/submit",
+            {
+                "kind": "vacuum",
+                "volume_id": 1,
+                "params": {"garbage_threshold": "2.5"},
+            },
+        )
+        assert code == 400 and "outside" in out["error"], out
+        code, out = post(
+            aport,
+            "/api/maintenance/submit",
+            {
+                "kind": "vacuum",
+                "volume_id": 1,
+                "params": {"nope": "1"},
+            },
+        )
+        assert code == 400 and "unknown param" in out["error"], out
+
+        # a valid param flows through to execution
+        ops = Operations(f"localhost:{master.port}")
+        try:
+            fid = ops.upload(b"descriptor config" * 500)
+            vid = FileId.parse(fid).volume_id
+            code, out = post(
+                aport,
+                "/api/maintenance/submit",
+                {
+                    "kind": "vacuum",
+                    "volume_id": vid,
+                    "params": {"garbage_threshold": "0.0"},
+                },
+            )
+            assert code == 200, out
+
+            def task_state():
+                tasks = get(aport, "/api/maintenance")["tasks"]
+                return {t["task_id"]: t["state"] for t in tasks}.get(
+                    out["task_id"]
+                )
+
+            wait_for(lambda: task_state() == "done", msg="vacuum w/ params done")
+        finally:
+            ops.close()
+    finally:
+        w.stop()
