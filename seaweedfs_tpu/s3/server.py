@@ -668,6 +668,10 @@ class S3Server:
                     srv.filer.store.kv_delete(f"policy/{bucket}".encode())
                     srv.filer.store.kv_delete(f"acl/{bucket}".encode())
                     srv.filer.store.kv_delete(f"encryption/{bucket}".encode())
+                    srv.filer.store.kv_delete(f"quota/{bucket}".encode())
+                    srv.filer.store.kv_delete(
+                        f"quota-exceeded/{bucket}".encode()
+                    )
                     # fast space reclaim: drop the bucket's collection
                     # volumes cluster-wide (reference bucket=collection)
                     try:
@@ -1199,6 +1203,12 @@ class S3Server:
                 err = self._authorize(ident, "PUT", bucket, key, {})
                 if err is not None:
                     return self._error(403, "AccessDenied", err)
+                if srv.quota_exceeded(bucket):
+                    return self._error(
+                        403,
+                        "QuotaExceeded",
+                        f"bucket {bucket} is over its storage quota",
+                    )
                 # SSE: explicit form header fields are not standard;
                 # bucket default encryption still applies
                 sse_algo = srv.bucket_default_encryption(bucket)
@@ -1312,6 +1322,12 @@ class S3Server:
                     return self._object_acl_op(bucket, key, path)
 
                 if m == "PUT":
+                    if srv.quota_exceeded(bucket):
+                        return self._error(
+                            403,
+                            "QuotaExceeded",
+                            f"bucket {bucket} is over its storage quota",
+                        )
                     src = self.headers.get("x-amz-copy-source", "")
                     if src:
                         return self._copy_object(bucket, key, src)
@@ -1777,6 +1793,12 @@ class S3Server:
             # ---- multipart ----
 
             def _initiate_multipart(self, bucket: str, key: str):
+                if srv.quota_exceeded(bucket):
+                    return self._error(
+                        403,
+                        "QuotaExceeded",
+                        f"bucket {bucket} is over its storage quota",
+                    )
                 if (
                     sse.parse_customer_headers(self.headers) is not None
                     or self.headers.get("x-amz-server-side-encryption")
@@ -1822,6 +1844,14 @@ class S3Server:
                 self._respond(200, _xml(root))
 
             def _upload_part(self, bucket: str, key: str, q: dict):
+                if srv.quota_exceeded(bucket):
+                    # parts consume storage immediately — an over-quota
+                    # bucket must not grow unbounded via multipart
+                    return self._error(
+                        403,
+                        "QuotaExceeded",
+                        f"bucket {bucket} is over its storage quota",
+                    )
                 upload_id = q["uploadId"]
                 part = int(q["partNumber"])
                 if srv.filer.store.kv_get(f"upload/{upload_id}".encode()) is None:
@@ -1836,6 +1866,12 @@ class S3Server:
                 self._respond(200, extra={"ETag": f'"{entry.attr.md5.hex()}"'})
 
             def _complete_multipart(self, bucket: str, key: str, q: dict):
+                if srv.quota_exceeded(bucket):
+                    return self._error(
+                        403,
+                        "QuotaExceeded",
+                        f"bucket {bucket} is over its storage quota",
+                    )
                 upload_id = q["uploadId"]
                 meta_raw = srv.filer.store.kv_get(f"upload/{upload_id}".encode())
                 if meta_raw is None:
@@ -2003,6 +2039,13 @@ class S3Server:
         return Handler
 
     # -------------------------------------------------------- versioning
+
+    def quota_exceeded(self, bucket: str) -> bool:
+        """Set by the s3.bucket.quota.enforce sweep (reference
+        command_s3_bucketquota.go): over-quota buckets reject writes
+        until usage drops below the quota and a sweep clears the flag."""
+        v = self.filer.store.kv_get(f"quota-exceeded/{bucket}".encode())
+        return bool(v)
 
     def bucket_policy(self, bucket: str) -> dict | None:
         raw = self.filer.store.kv_get(f"policy/{bucket}".encode())

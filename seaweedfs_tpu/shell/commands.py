@@ -2194,3 +2194,139 @@ def cluster_lock_ring(env: ShellEnv, args) -> str:
         "\n".join(f"{n:40s} {o:20s} {r:6.1f}s" for n, o, r in rows)
         or "no live leases"
     )
+
+
+# ------------------------------------------------------------ s3 quotas
+
+
+def _list_all_entries(stub, directory: str):
+    """Full listing with PAGINATION — a flat limit would silently
+    undercount directories beyond it."""
+    from ..pb import filer_pb2 as fpb
+
+    start = ""
+    while True:
+        page = list(
+            stub.ListEntries(
+                fpb.ListEntriesRequest(
+                    directory=directory, limit=10000, start_from=start
+                ),
+                timeout=60,
+            )
+        )
+        for r in page:
+            yield r.entry
+        if len(page) < 10000:
+            return
+        start = page[-1].entry.name
+
+
+def _bucket_usage_bytes(stub, bucket: str) -> int:
+    """Recursive size walk of /buckets/<b> over the filer gRPC."""
+    total = 0
+    stack = [f"/buckets/{bucket}"]
+    while stack:
+        d = stack.pop()
+        for e in _list_all_entries(stub, d):
+            if e.is_directory:
+                stack.append(f"{d}/{e.name}")
+            else:
+                total += e.attributes.file_size or (
+                    len(e.content) + sum(c.size for c in e.chunks)
+                )
+    return total
+
+
+@command(
+    "s3.bucket.quota.set",
+    "-name bucket -bytes N (0 = remove the quota)",
+)
+def s3_bucket_quota_set(env: ShellEnv, args) -> str:
+    from ..pb import filer_pb2 as fpb
+
+    p = argparse.ArgumentParser(prog="s3.bucket.quota.set")
+    p.add_argument("-name", required=True)
+    p.add_argument("-bytes", type=int, required=True)
+    a = p.parse_args(args)
+    ch, stub = _filer_grpc(env)
+    with ch:
+        key = f"quota/{a.name}".encode()
+        if a.bytes > 0:
+            stub.KvPut(
+                fpb.FilerKvPutRequest(key=key, value=str(a.bytes).encode()),
+                timeout=10,
+            )
+            return f"quota for {a.name}: {a.bytes:,} bytes"
+        stub.KvPut(fpb.FilerKvPutRequest(key=key, value=b""), timeout=10)
+        stub.KvPut(
+            fpb.FilerKvPutRequest(
+                key=f"quota-exceeded/{a.name}".encode(), value=b""
+            ),
+            timeout=10,
+        )
+        return f"quota removed for {a.name}"
+
+
+@command("s3.bucket.quota.get", "-name bucket")
+def s3_bucket_quota_get(env: ShellEnv, args) -> str:
+    from ..pb import filer_pb2 as fpb
+
+    p = argparse.ArgumentParser(prog="s3.bucket.quota.get")
+    p.add_argument("-name", required=True)
+    a = p.parse_args(args)
+    ch, stub = _filer_grpc(env)
+    with ch:
+        r = stub.KvGet(
+            fpb.FilerKvGetRequest(key=f"quota/{a.name}".encode()), timeout=10
+        )
+        usage = _bucket_usage_bytes(stub, a.name)
+    if not r.found or not r.value:
+        return f"{a.name}: no quota (usage {usage:,} bytes)"
+    quota = int(r.value)
+    return (
+        f"{a.name}: quota {quota:,} bytes, usage {usage:,} "
+        f"({100.0 * usage / quota:.1f}%)"
+    )
+
+
+@command(
+    "s3.bucket.quota.enforce",
+    "check every quota'd bucket; flag over-quota ones read-only for the gateway",
+    mutating=True,
+)
+def s3_bucket_quota_enforce(env: ShellEnv, args) -> str:
+    """Reference command_s3_bucketquota.go: enforcement is a periodic
+    sweep (cron/worker), not per-request accounting — the gateway just
+    honors the exceeded flag on writes."""
+    from ..pb import filer_pb2 as fpb
+
+    ch, stub = _filer_grpc(env)
+    out = []
+    with ch:
+        buckets = [
+            e.name
+            for e in _list_all_entries(stub, "/buckets")
+            if e.is_directory and not e.name.startswith(".")
+        ]
+        for b in buckets:
+            q = stub.KvGet(
+                fpb.FilerKvGetRequest(key=f"quota/{b}".encode()), timeout=10
+            )
+            if not q.found or not q.value:
+                continue
+            quota = int(q.value)
+            usage = _bucket_usage_bytes(stub, b)
+            flag_key = f"quota-exceeded/{b}".encode()
+            if usage > quota:
+                stub.KvPut(
+                    fpb.FilerKvPutRequest(key=flag_key, value=b"1"), timeout=10
+                )
+                out.append(
+                    f"{b}: OVER quota ({usage:,} > {quota:,}) — writes blocked"
+                )
+            else:
+                stub.KvPut(
+                    fpb.FilerKvPutRequest(key=flag_key, value=b""), timeout=10
+                )
+                out.append(f"{b}: ok ({usage:,} / {quota:,})")
+    return "\n".join(out) or "no buckets carry quotas"

@@ -109,6 +109,9 @@ class WorkerControl:
                 f"unknown task kind {kind!r} (built-in: {KNOWN_KINDS}; "
                 f"connected plugin kinds: {plugin_kinds or 'none'})"
             )
+        # explicit = the CALLER stated params; periodic scanners submit
+        # with none and must never conflict with an operator's task
+        explicit = bool(params)
         params = self._validate_params(kind, dict(params or {}))
         if not collection:
             # collection determines on-disk paths; a task executed with
@@ -126,7 +129,7 @@ class WorkerControl:
                     and t.volume_id == volume_id
                     and t.state in ("pending", "assigned", "running")
                 ):
-                    if params and params != t.params:
+                    if explicit and params != t.params:
                         raise ValueError(
                             f"task {t.task_id} for {kind}/{volume_id} is "
                             f"already live with params {t.params}; cancel "
@@ -234,7 +237,9 @@ class WorkerControl:
                     raise ValueError(
                         f"param {name!r}={v} outside [{f.min}, {f.max}]"
                     )
-            out[name] = str(raw)
+            # NORMALIZED storage ('0.30' == '0.3', 'True' == 'true'):
+            # the duplicate-conflict check compares these strings
+            out[name] = str(v).lower() if f.type == "bool" else str(v)
         return out
 
     def _pick_worker(self, kind: str):
@@ -489,5 +494,11 @@ class WorkerControl:
                     continue  # just started watching; not yet quiet
             elif now - prev[1] < quiet_seconds:
                 continue
-            submitted.append(self.submit("ec_encode", vid, col))
+            try:
+                submitted.append(self.submit("ec_encode", vid, col))
+            except ValueError:
+                # a live operator task for this volume, or a transient
+                # validation issue — the PERIODIC scanner must never
+                # kill its hosting loop over it
+                continue
         return submitted

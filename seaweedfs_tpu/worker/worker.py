@@ -63,8 +63,9 @@ class Worker:
                     wk.ConfigField(
                         name="garbage_threshold",
                         type="float",
-                        default="0.3",
-                        help="minimum reclaimable fraction",
+                        default="0",
+                        help="minimum reclaimable fraction "
+                        "(0 = always compact, the historical behavior)",
                         min=0.0,
                         max=1.0,
                     )
@@ -206,13 +207,13 @@ class Worker:
 
     def _task_vacuum(self, assign: wk.TaskAssign) -> None:
         # declarative per-job config: garbage_threshold from the
-        # validated TaskAssign params. Absent params use the WORKER'S
-        # declared default (0.3) — behavior must not depend on whether
-        # a descriptor-bearing worker was registered at submit time.
+        # validated TaskAssign params. Absent = 0 = ALWAYS compact (the
+        # pre-descriptor behavior; an explicitly submitted vacuum must
+        # not silently become a no-op), matching the declared default.
         try:
-            threshold = float(assign.params.get("garbage_threshold", "") or 0.3)
+            threshold = float(assign.params.get("garbage_threshold", "") or 0.0)
         except ValueError:
-            threshold = 0.3
+            threshold = 0.0
         for _, ch, stub in self._holder_stubs(assign.volume_id):
             try:
                 stub.VacuumVolume(

@@ -245,3 +245,48 @@ def test_r4_ops_surface_batch(s3_stack):
         assert "deleted empty volume" in out
     finally:
         env.close()
+
+
+def test_s3_bucket_quota_flow(s3_stack):
+    """Reference s3.bucket.quota family: set -> write over -> enforce
+    flags the bucket -> gateway rejects writes -> delete + enforce
+    unblocks."""
+    master, filer, s3, fport = s3_stack
+    url = f"http://localhost:{s3.port}"
+    env = ShellEnv(f"localhost:{master.port}", filer=f"localhost:{fport}")
+    try:
+        assert requests.put(f"{url}/quotab").status_code == 200
+        out = run_command(env, "s3.bucket.quota.set -name quotab -bytes 5000")
+        assert "5,000" in out
+        # under quota: writes pass, enforce says ok
+        assert (
+            requests.put(f"{url}/quotab/small", data=b"x" * 1000).status_code
+            == 200
+        )
+        out = run_command(env, "s3.bucket.quota.enforce")
+        assert "quotab: ok" in out, out
+        # push over, enforce flags it
+        assert (
+            requests.put(f"{url}/quotab/big", data=b"y" * 6000).status_code
+            == 200
+        )
+        out = run_command(env, "s3.bucket.quota.enforce")
+        assert "OVER quota" in out, out
+        r = requests.put(f"{url}/quotab/more", data=b"z")
+        assert r.status_code == 403 and "QuotaExceeded" in r.text
+        # reads still fine
+        assert requests.get(f"{url}/quotab/small").status_code == 200
+        # usage report
+        out = run_command(env, "s3.bucket.quota.get -name quotab")
+        assert "quota 5,000 bytes" in out
+        # free space, enforce clears, writes resume
+        assert requests.delete(f"{url}/quotab/big").status_code in (200, 204)
+        out = run_command(env, "s3.bucket.quota.enforce")
+        assert "quotab: ok" in out, out
+        assert requests.put(f"{url}/quotab/more", data=b"z").status_code == 200
+        # remove quota entirely
+        out = run_command(env, "s3.bucket.quota.set -name quotab -bytes 0")
+        assert "removed" in out
+        assert "no quota" in run_command(env, "s3.bucket.quota.get -name quotab")
+    finally:
+        env.close()
