@@ -114,15 +114,21 @@ class FilerIdentityStore:
             self._dynamic = dyn
 
 
-def load_s3_config(path: str) -> tuple[IdentityStore, StsService | None]:
+def load_s3_config(path: str):
+    """-> (IdentityStore, StsService | None, OidcProvider | None)."""
     with open(path) as f:
         conf = json.load(f)
     store = IdentityStore()
     for ident in conf.get("identities", []):
         store.add(identity_from_conf(ident))
+    oidc = None
+    if conf.get("oidc"):
+        from ..iam.oidc import OidcProvider
+
+        oidc = OidcProvider(**conf["oidc"])
     sts = None
     roles = conf.get("roles", [])
-    if roles and store.empty:
+    if roles and store.empty and oidc is None:
         # roles without identities would leave the gateway in open mode
         # (anonymous = admin) with STS credentials never verified —
         # refuse the misconfiguration instead of silently ignoring it
@@ -141,4 +147,4 @@ def load_s3_config(path: str) -> tuple[IdentityStore, StsService | None]:
                 )
             )
         store.sts = sts
-    return store, sts
+    return store, sts, oidc

@@ -84,6 +84,7 @@ class S3Server:
         lifecycle_interval: float = 3600.0,
         sts=None,
         tls=None,
+        oidc=None,
     ):
         self.filer = filer
         self.ip = ip
@@ -99,6 +100,9 @@ class S3Server:
         self.sts_service = sts
         if sts is not None and self.identities.sts is None:
             self.identities.sts = sts
+        # OIDC bearer tokens (iam/oidc.py OidcProvider): an alternative
+        # authentication path beside SigV4
+        self.oidc = oidc
         # SSE-S3 keyring: master key shared via the filer KV store so
         # every gateway over the same filer can decrypt (KMS SPI:
         # replace with an external provider via `sse_keyring=`).
@@ -180,7 +184,26 @@ class S3Server:
                 self._respond(code, _xml(root))
 
             def _auth(self, payload: bytes | None = None) -> Identity | None:
+                auth_hdr = self.headers.get("Authorization", "")
+                if srv.oidc is not None and auth_hdr.startswith("Bearer "):
+                    # OIDC path: an unverifiable bearer is REJECTED,
+                    # never downgraded to anonymous
+                    from ..iam.oidc import OidcError
+
+                    try:
+                        claims = srv.oidc.verify(auth_hdr[len("Bearer ") :])
+                    except OidcError as e:
+                        raise S3AuthError(
+                            "InvalidToken", f"OIDC: {e}"
+                        ) from None
+                    return srv.oidc.identity_for(claims)
                 if srv.identities.empty:
+                    if srv.oidc is not None:
+                        # OIDC-only deployment: an empty SigV4 store
+                        # must NOT mean open mode — tokenless requests
+                        # are ANONYMOUS (bucket policy may still grant)
+                        self._anonymous = True
+                        return None
                     return None  # open mode
                 u = urllib.parse.urlparse(self.path)
                 if "Authorization" not in self.headers and "X-Amz-Signature" not in u.query:

@@ -445,18 +445,18 @@ def main(argv=None) -> int:
         if a.mode == "server" and a.s3:
             from ..s3 import Identity, IdentityStore, S3Server
 
-            sts = None
+            sts = oidc = None
             if getattr(a, "s3Config", ""):
                 from ..s3.config import load_s3_config
 
-                idents, sts = load_s3_config(a.s3Config)
+                idents, sts, oidc = load_s3_config(a.s3Config)
             else:
                 idents = IdentityStore()
             if a.s3AccessKey:
                 idents.add(Identity("admin", a.s3AccessKey, a.s3SecretKey))
             s3srv = S3Server(
                 filer, ip=a.ip, port=a.s3Port, identities=idents, sts=sts,
-                tls=_tls_from(a),
+                tls=_tls_from(a), oidc=oidc,
             )
             s3srv.start()
             servers.append(s3srv)

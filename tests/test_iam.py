@@ -412,3 +412,232 @@ def test_sts_flow_at_gateway(iam_s3):
     # the role policy has no DeleteObject -> denied
     hh = _sign("DELETE", f"{url}/stsbkt/obj", ak, sk, token=token)
     assert requests.delete(f"{url}/stsbkt/obj", headers=hh).status_code == 403
+
+
+def test_oidc_bearer_auth(tmp_path):
+    """OIDC bearer tokens (reference weed/iam OIDC provider): verified
+    claims map to role-scoped identities; bad tokens are rejected, not
+    anonymized."""
+    import base64
+    import hashlib
+    import hmac
+    import json
+    import time as _time
+
+    import requests
+
+    from conftest import allocate_port as free_port
+    from seaweedfs_tpu.filer import Filer, MemoryStore
+    from seaweedfs_tpu.iam.oidc import OidcProvider
+    from seaweedfs_tpu.s3 import Identity, IdentityStore, S3Server
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    mport = free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path / "v")], master=f"localhost:{mport}",
+        ip="localhost", port=free_port(), ec_backend="cpu",
+    )
+    vs.start()
+    while not master.topo.nodes:
+        _time.sleep(0.05)
+
+    secret = "oidc-shared-secret"
+    oidc = OidcProvider(
+        issuer="https://idp.test",
+        audience="seaweed",
+        hs256_secret=secret,
+        roles={
+            "writer": {"actions": ["Admin"]},
+            "reader": {"actions": ["Read", "List"]},
+        },
+    )
+    idents = IdentityStore()
+    idents.add(Identity("sig", "AKSIG", "sigsecret"))
+    filer = Filer(MemoryStore(), master=f"localhost:{mport}")
+    srv = S3Server(
+        filer, ip="localhost", port=free_port(), identities=idents, oidc=oidc
+    )
+    srv.start()
+    url = f"http://localhost:{srv.port}"
+
+    def b64(b):
+        return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+    def token(claims):
+        h = b64(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+        p = b64(json.dumps(claims).encode())
+        sig = hmac.new(
+            secret.encode(), f"{h}.{p}".encode(), hashlib.sha256
+        ).digest()
+        return f"{h}.{p}.{b64(sig)}"
+
+    def bearer(tok):
+        return {"Authorization": f"Bearer {tok}"}
+
+    try:
+        base_claims = {
+            "iss": "https://idp.test", "aud": "seaweed",
+            "sub": "alice", "exp": _time.time() + 300,
+        }
+        # writer role: full access
+        t = token({**base_claims, "roles": ["writer"]})
+        assert requests.put(f"{url}/oidcb", headers=bearer(t)).status_code == 200
+        assert (
+            requests.put(
+                f"{url}/oidcb/k", data=b"v", headers=bearer(t)
+            ).status_code
+            == 200
+        )
+        # reader role: read passes, write denied
+        r = token({**base_claims, "sub": "bob", "roles": ["reader"]})
+        assert (
+            requests.get(f"{url}/oidcb/k", headers=bearer(r)).content == b"v"
+        )
+        assert (
+            requests.put(
+                f"{url}/oidcb/x", data=b"w", headers=bearer(r)
+            ).status_code
+            == 403
+        )
+        # unmapped role: no permissions at all
+        n = token({**base_claims, "sub": "eve", "roles": ["nobody"]})
+        assert (
+            requests.get(f"{url}/oidcb/k", headers=bearer(n)).status_code
+            == 403
+        )
+        # tampered signature -> 403 InvalidToken (never anonymous)
+        bad = t[:-4] + "AAAA"
+        resp = requests.get(f"{url}/oidcb/k", headers=bearer(bad))
+        assert resp.status_code == 403 and "InvalidToken" in resp.text
+        # expired
+        e = token({**base_claims, "exp": _time.time() - 600, "roles": ["writer"]})
+        assert (
+            requests.get(f"{url}/oidcb/k", headers=bearer(e)).status_code
+            == 403
+        )
+        # wrong issuer
+        w = token({**base_claims, "iss": "https://evil", "roles": ["writer"]})
+        assert (
+            requests.get(f"{url}/oidcb/k", headers=bearer(w)).status_code
+            == 403
+        )
+        # SigV4 still works beside OIDC
+        from test_s3 import sign_request
+
+        h = sign_request("GET", f"{url}/oidcb/k", "AKSIG", "sigsecret")
+        assert requests.get(f"{url}/oidcb/k", headers=h).content == b"v"
+    finally:
+        srv.stop()
+        filer.close()
+        vs.stop()
+        master.stop()
+
+
+def test_oidc_rs256_verify():
+    import base64
+    import json
+    import time as _time
+
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+    from cryptography.hazmat.primitives.hashes import SHA256
+
+    import pytest as _pytest
+
+    from seaweedfs_tpu.iam.oidc import OidcError, OidcProvider
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pem = key.public_key().public_bytes(
+        serialization.Encoding.PEM,
+        serialization.PublicFormat.SubjectPublicKeyInfo,
+    ).decode()
+    prov = OidcProvider(issuer="iss", rs256_public_key_pem=pem)
+
+    def b64(b):
+        return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+    h = b64(json.dumps({"alg": "RS256"}).encode())
+    p = b64(json.dumps({"iss": "iss", "exp": _time.time() + 60, "sub": "x"}).encode())
+    sig = key.sign(f"{h}.{p}".encode(), padding.PKCS1v15(), SHA256())
+    claims = prov.verify(f"{h}.{p}.{b64(sig)}")
+    assert claims["sub"] == "x"
+    with _pytest.raises(OidcError):
+        prov.verify(f"{h}.{p}.{b64(sig[:-2] + b'xx')}")
+    # alg confusion: an HS256 token must not pass an RS256-only provider
+    import hashlib
+    import hmac as _hmac
+
+    h2 = b64(json.dumps({"alg": "HS256"}).encode())
+    forged = _hmac.new(pem.encode(), f"{h2}.{p}".encode(), hashlib.sha256).digest()
+    with _pytest.raises(OidcError):
+        prov.verify(f"{h2}.{p}.{b64(forged)}")
+
+
+def test_oidc_only_gateway_is_not_open_mode(tmp_path):
+    """An OIDC-configured gateway with an empty SigV4 store must treat
+    tokenless requests as ANONYMOUS (denied), never open mode."""
+    import time as _time
+
+    import requests
+
+    from conftest import allocate_port as free_port
+    from seaweedfs_tpu.filer import Filer, MemoryStore
+    from seaweedfs_tpu.iam.oidc import OidcProvider
+    from seaweedfs_tpu.s3 import S3Server
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    mport = free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path / "v")], master=f"localhost:{mport}",
+        ip="localhost", port=free_port(), ec_backend="cpu",
+    )
+    vs.start()
+    while not master.topo.nodes:
+        _time.sleep(0.05)
+    filer = Filer(MemoryStore(), master=f"localhost:{mport}")
+    srv = S3Server(
+        filer, ip="localhost", port=free_port(),
+        oidc=OidcProvider(issuer="i", hs256_secret="s"),
+    )
+    srv.start()
+    try:
+        url = f"http://localhost:{srv.port}"
+        assert requests.put(f"{url}/nope", timeout=5).status_code == 403
+        assert requests.get(f"{url}/", timeout=5).status_code == 403
+    finally:
+        srv.stop()
+        filer.close()
+        vs.stop()
+        master.stop()
+
+
+def test_load_s3_config_with_oidc(tmp_path):
+    import json as _json
+
+    from seaweedfs_tpu.iam.oidc import OidcProvider
+    from seaweedfs_tpu.s3.config import load_s3_config
+
+    p = tmp_path / "s3.json"
+    p.write_text(
+        _json.dumps(
+            {
+                "identities": [
+                    {"name": "a", "accessKey": "AK", "secretKey": "SK"}
+                ],
+                "oidc": {
+                    "issuer": "https://idp",
+                    "hs256_secret": "x",
+                    "roles": {"admin": {"actions": ["Admin"]}},
+                },
+            }
+        )
+    )
+    store, sts, oidc = load_s3_config(str(p))
+    assert isinstance(oidc, OidcProvider) and oidc.issuer == "https://idp"
+    assert store.lookup("AK") is not None
