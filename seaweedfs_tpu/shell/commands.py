@@ -2330,3 +2330,56 @@ def s3_bucket_quota_enforce(env: ShellEnv, args) -> str:
                 )
                 out.append(f"{b}: ok ({usage:,} / {quota:,})")
     return "\n".join(out) or "no buckets carry quotas"
+
+
+@command("fs.meta.cat", "fs.meta.cat /path (raw entry metadata as JSON)")
+def fs_meta_cat(env: ShellEnv, args) -> str:
+    import json as _json
+
+    from ..pb import filer_pb2 as fpb
+
+    if not args:
+        return "usage: fs.meta.cat /path"
+    path = args[0]
+    directory, _, name = path.rstrip("/").rpartition("/")
+    ch, stub = _filer_grpc(env)
+    with ch:
+        r = stub.LookupDirectoryEntry(
+            fpb.LookupEntryRequest(directory=directory or "/", name=name),
+            timeout=10,
+        )
+    if r.error:
+        return f"error: {r.error}"
+    e = r.entry
+    a = e.attributes
+    doc = {
+        "name": e.name,
+        "isDirectory": e.is_directory,
+        "attributes": {
+            "mtime": a.mtime,
+            "crtime": a.crtime,
+            "fileMode": a.file_mode,
+            "uid": a.uid,
+            "gid": a.gid,
+            "mime": a.mime,
+            "ttlSec": a.ttl_sec,
+            "symlinkTarget": a.symlink_target,
+            "md5": a.md5.hex(),
+            "fileSize": a.file_size,
+        },
+        "chunks": [
+            {
+                "fid": c.fid,
+                "offset": c.offset,
+                "size": c.size,
+                "etag": c.etag,
+                "isChunkManifest": c.is_chunk_manifest,
+            }
+            for c in e.chunks
+        ],
+        "extended": {k: v.hex() for k, v in e.extended.items()},
+        "hardLinkId": e.hard_link_id.hex(),
+        "hardLinkCounter": e.hard_link_counter,
+        "inlineContentBytes": len(e.content),
+    }
+    return _json.dumps(doc, indent=2)

@@ -40,7 +40,7 @@ def _start_group(tmp_path, n=3):
     return masters, peers
 
 
-def _wait_leader(masters, timeout=10.0, exclude=()):
+def _wait_leader(masters, timeout=30.0, exclude=()):
     deadline = time.time() + timeout
     while time.time() < deadline:
         leaders = [m for m in masters if m.is_leader and m not in exclude]
