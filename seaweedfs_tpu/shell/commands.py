@@ -2114,13 +2114,11 @@ def fs_cp(env: ShellEnv, args) -> str:
     return f"copied {src} -> {dst} ({total} bytes)"
 
 
-@command("fs.stat", "fs.stat /path (full entry metadata)")
-def fs_stat(env: ShellEnv, args) -> str:
+def _lookup_entry(env: ShellEnv, path: str):
+    """-> (entry, None) or (None, error string); one shared
+    parse+lookup for the fs.* metadata commands."""
     from ..pb import filer_pb2 as fpb
 
-    if not args:
-        return "usage: fs.stat /path"
-    path = args[0]
     directory, _, name = path.rstrip("/").rpartition("/")
     ch, stub = _filer_grpc(env)
     with ch:
@@ -2129,8 +2127,18 @@ def fs_stat(env: ShellEnv, args) -> str:
             timeout=10,
         )
     if r.error:
-        return f"error: {r.error}"
-    e = r.entry
+        return None, f"error: {r.error}"
+    return r.entry, None
+
+
+@command("fs.stat", "fs.stat /path (full entry metadata)")
+def fs_stat(env: ShellEnv, args) -> str:
+    if not args:
+        return "usage: fs.stat /path"
+    path = args[0]
+    e, err = _lookup_entry(env, path)
+    if err:
+        return err
     a = e.attributes
     lines = [
         f"path:      {path}",
@@ -2340,17 +2348,9 @@ def fs_meta_cat(env: ShellEnv, args) -> str:
 
     if not args:
         return "usage: fs.meta.cat /path"
-    path = args[0]
-    directory, _, name = path.rstrip("/").rpartition("/")
-    ch, stub = _filer_grpc(env)
-    with ch:
-        r = stub.LookupDirectoryEntry(
-            fpb.LookupEntryRequest(directory=directory or "/", name=name),
-            timeout=10,
-        )
-    if r.error:
-        return f"error: {r.error}"
-    e = r.entry
+    e, err = _lookup_entry(env, args[0])
+    if err:
+        return err
     a = e.attributes
     doc = {
         "name": e.name,
@@ -2363,16 +2363,23 @@ def fs_meta_cat(env: ShellEnv, args) -> str:
             "gid": a.gid,
             "mime": a.mime,
             "ttlSec": a.ttl_sec,
+            "userName": a.user_name,
+            "groupNames": list(a.group_names),
             "symlinkTarget": a.symlink_target,
             "md5": a.md5.hex(),
             "fileSize": a.file_size,
+            "rdev": a.rdev,
+            "inode": a.inode,
         },
         "chunks": [
             {
                 "fid": c.fid,
                 "offset": c.offset,
                 "size": c.size,
+                "modifiedTsNs": c.modified_ts_ns,
                 "etag": c.etag,
+                "cipherKey": c.cipher_key.hex(),
+                "isCompressed": c.is_compressed,
                 "isChunkManifest": c.is_chunk_manifest,
             }
             for c in e.chunks
@@ -2380,6 +2387,7 @@ def fs_meta_cat(env: ShellEnv, args) -> str:
         "extended": {k: v.hex() for k, v in e.extended.items()},
         "hardLinkId": e.hard_link_id.hex(),
         "hardLinkCounter": e.hard_link_counter,
+        "wormEnforcedAtTsNs": e.worm_enforced_at_ts_ns,
         "inlineContentBytes": len(e.content),
     }
     return _json.dumps(doc, indent=2)
