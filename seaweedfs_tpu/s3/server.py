@@ -983,10 +983,12 @@ class S3Server:
                     srv.sse_keyring,
                 )
                 if data_key is not None:
-                    data = sse.decrypt(
+                    data = sse.read_decrypted(
+                        lambda o, n: data[o:] if n < 0 else data[o : o + n],
+                        entry,
                         data_key,
-                        entry.extended.get(sse.SSE_IV_KEY) or b"",
-                        data,
+                        0,
+                        -1,
                     )
                 try:
                     body = s3sel.select_object_content(
@@ -1358,12 +1360,9 @@ class S3Server:
                     ext = self._lock_headers_extended(bucket)
                     # server-side encryption: explicit SSE-C / SSE-S3
                     # headers, else the bucket's default configuration
-                    ssec_key = sse.parse_customer_headers(self.headers)
-                    sse_algo = self.headers.get(
-                        "x-amz-server-side-encryption", ""
+                    ssec_key, sse_algo = sse.resolve_put_encryption(
+                        self.headers, srv.bucket_default_encryption(bucket)
                     )
-                    if ssec_key is None and not sse_algo:
-                        sse_algo = srv.bucket_default_encryption(bucket)
                     data, sse_ext, sse_hdrs = sse.encrypt_for_put(
                         data, ssec_key, sse_algo, srv.sse_keyring
                     )
@@ -1446,18 +1445,15 @@ class S3Server:
                     if sse_data_key is None:
                         data = srv.filer.read_entry(entry, offset, size)
                     else:
-                        # CTR seek: read from the 16-byte-aligned
-                        # offset, decrypt with the counter advanced,
-                        # drop the alignment prefix
-                        aligned = offset - offset % 16
-                        want = size if size < 0 else size + (offset - aligned)
-                        raw = srv.filer.read_entry(entry, aligned, want)
-                        iv = entry.extended.get(sse.SSE_IV_KEY) or b""
-                        data = sse.decrypt_range(
-                            sse_data_key, iv, raw, offset
+                        # unified CTR seek: single-IV objects and
+                        # multipart part-maps (per-part streams)
+                        data = sse.read_decrypted(
+                            lambda o, n: srv.filer.read_entry(entry, o, n),
+                            entry,
+                            sse_data_key,
+                            offset,
+                            size,
                         )
-                        if size >= 0:
-                            data = data[:size]
                     return self._respond(status, data, ctype, headers)
                 if m == "DELETE":
                     return self._delete_object(bucket, key, path, q)
@@ -1784,13 +1780,16 @@ class S3Server:
                     srv.sse_keyring,
                 )
                 if src_key is not None:
-                    data = sse.decrypt(
-                        src_key, entry.extended.get(sse.SSE_IV_KEY) or b"", data
+                    data = sse.read_decrypted(
+                        lambda o, n: data[o:] if n < 0 else data[o : o + n],
+                        entry,
+                        src_key,
+                        0,
+                        -1,
                     )
-                dst_ssec = sse.parse_customer_headers(self.headers)
-                dst_algo = self.headers.get("x-amz-server-side-encryption", "")
-                if dst_ssec is None and not dst_algo:
-                    dst_algo = srv.bucket_default_encryption(bucket)
+                dst_ssec, dst_algo = sse.resolve_put_encryption(
+                    self.headers, srv.bucket_default_encryption(bucket)
+                )
                 data, sse_ext, sse_hdrs = sse.encrypt_for_put(
                     data, dst_ssec, dst_algo, srv.sse_keyring
                 )
@@ -1822,23 +1821,32 @@ class S3Server:
                         "QuotaExceeded",
                         f"bucket {bucket} is over its storage quota",
                     )
-                if (
-                    sse.parse_customer_headers(self.headers) is not None
-                    or self.headers.get("x-amz-server-side-encryption")
-                    or srv.bucket_default_encryption(bucket)
-                ):
-                    # Documented divergence: SSE covers single-PUT,
-                    # POST-policy and copy; multipart would need
-                    # per-part IV tracking through chunk splicing
-                    # (reference SerializeSSECMetadata per chunk).
-                    # Buckets with DEFAULT encryption refuse multipart
-                    # too — silently storing plaintext in a bucket
-                    # configured for SSE would be worse than a 501.
-                    return self._error(
-                        501,
-                        "NotImplemented",
-                        "SSE with multipart upload is not supported",
-                    )
+                # SSE context for the whole upload (reference
+                # SerializeSSECMetadata-per-chunk model): parts become
+                # independent CTR streams under one data key; the
+                # part map lands on the completed object.
+                sse_meta: dict = {}
+                ssec_key, sse_algo = sse.resolve_put_encryption(
+                    self.headers, srv.bucket_default_encryption(bucket)
+                )
+                if ssec_key is not None:
+                    # the key itself is NEVER stored; every UploadPart
+                    # must present it again (AWS SSE-C semantics)
+                    sse_meta = {
+                        "algo": "SSE-C",
+                        "key_md5": sse.key_md5_b64(ssec_key),
+                    }
+                elif sse_algo:
+                    if srv.sse_keyring is None:
+                        return self._error(
+                            501, "NotImplemented", "SSE keyring unavailable"
+                        )
+                    key_id, _dk, wrapped = srv.sse_keyring.generate_data_key()
+                    sse_meta = {
+                        "algo": "AES256",
+                        "key_id": key_id,
+                        "wrapped": wrapped.hex(),
+                    }
                 upload_id = uuid.uuid4().hex
                 meta_path = srv._upload_dir(bucket, upload_id)
                 e = new_entry(meta_path, is_directory=True, mode=0o755)
@@ -1857,6 +1865,7 @@ class S3Server:
                             "key": key,
                             "mime": self.headers.get("Content-Type", ""),
                             "lock_ext": lock_ext,
+                            "sse": sse_meta,
                         }
                     ).encode(),
                 )
@@ -1877,16 +1886,52 @@ class S3Server:
                     )
                 upload_id = q["uploadId"]
                 part = int(q["partNumber"])
-                if srv.filer.store.kv_get(f"upload/{upload_id}".encode()) is None:
+                meta_raw = srv.filer.store.kv_get(f"upload/{upload_id}".encode())
+                if meta_raw is None:
                     return self._error(404, "NoSuchUpload", upload_id)
                 data = self._read_body()
+                part_ext: dict = {}
+                sse_meta = (json.loads(meta_raw) or {}).get("sse") or {}
+                if sse_meta:
+                    dk = self._upload_data_key(sse_meta)
+                    if isinstance(dk, bytes):
+                        iv, data = sse.encrypt(dk, data)
+                        part_ext["s3-sse-part-iv"] = iv
+                    else:
+                        return dk  # an error response was sent
                 entry = srv.filer.write_file(
                     f"{srv._upload_dir(bucket, upload_id)}/{part:05d}.part",
                     data,
                     collection=bucket,
                     inline=False,  # completion splices chunk lists
+                    extended=part_ext,
                 )
                 self._respond(200, extra={"ETag": f'"{entry.attr.md5.hex()}"'})
+
+            def _upload_data_key(self, sse_meta: dict):
+                """Resolve the upload's data key: SSE-C re-presents the
+                customer key on every part request (MD5-bound to the
+                initiate); SSE-S3 unwraps the stored envelope key.
+                Returns bytes, or None after sending an error."""
+                if sse_meta.get("algo") == "SSE-C":
+                    ck = sse.parse_customer_headers(self.headers)
+                    if ck is None:
+                        self._error(
+                            400,
+                            "InvalidRequest",
+                            "upload uses SSE-C; part requests need the key",
+                        )
+                        return None
+                    if sse.key_md5_b64(ck) != sse_meta.get("key_md5"):
+                        self._error(
+                            403, "AccessDenied", "SSE-C key does not match upload"
+                        )
+                        return None
+                    return ck
+                return srv.sse_keyring.decrypt_data_key(
+                    sse_meta.get("key_id", ""),
+                    bytes.fromhex(sse_meta.get("wrapped", "")),
+                )
 
             def _complete_multipart(self, bucket: str, key: str, q: dict):
                 if srv.quota_exceeded(bucket):
@@ -1957,6 +2002,37 @@ class S3Server:
                 final.attr.file_size = offset
                 etag = hashlib.md5(b"".join(md5s)).hexdigest() + f"-{len(parts)}"
                 final.extended["s3-etag"] = etag.encode()
+                sse_meta = meta.get("sse") or {}
+                if sse_meta:
+                    # assemble the per-part CTR map (length + IV per
+                    # part, in splice order); key material mirrors the
+                    # single-PUT layout so the read path is uniform
+                    part_map = []
+                    for p in parts:
+                        iv = p.extended.get("s3-sse-part-iv")
+                        if not iv:
+                            return self._error(
+                                400,
+                                "InvalidPart",
+                                f"part {p.name} missing SSE metadata",
+                            )
+                        part_map.append([p.file_size, iv.hex()])
+                    final.extended[sse.SSE_PART_MAP_KEY] = json.dumps(
+                        part_map
+                    ).encode()
+                    if sse_meta["algo"] == "SSE-C":
+                        final.extended[sse.SSE_ALGO_KEY] = b"SSE-C"
+                        final.extended[sse.SSE_KEY_MD5_KEY] = sse_meta[
+                            "key_md5"
+                        ].encode()
+                    else:
+                        final.extended[sse.SSE_ALGO_KEY] = b"AES256"
+                        final.extended[sse.SSE_KEY_ID_KEY] = sse_meta[
+                            "key_id"
+                        ].encode()
+                        final.extended[sse.SSE_WRAPPED_KEY] = bytes.fromhex(
+                            sse_meta["wrapped"]
+                        )
                 # bucket default retention applies to multipart objects
                 # too — large SDK uploads must not escape WORM
                 for k2, v2 in vtag.default_retention_extended(

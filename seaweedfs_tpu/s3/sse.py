@@ -31,6 +31,11 @@ SSE_IV_KEY = "s3-sse-iv"
 SSE_KEY_MD5_KEY = "s3-sse-c-key-md5"  # base64 MD5 of the customer key
 SSE_WRAPPED_KEY = "s3-sse-wrapped-key"  # keyring-wrapped data key
 SSE_KEY_ID_KEY = "s3-sse-key-id"
+# multipart objects: JSON [[plaintext_len, iv_hex], ...] in part order.
+# Each part is an INDEPENDENT CTR stream under the object's data key
+# with its own random IV (a re-uploaded part gets a fresh IV, so no
+# counter stream is ever reused with different plaintext).
+SSE_PART_MAP_KEY = "s3-sse-parts"
 
 CUSTOMER_PREFIX = "x-amz-server-side-encryption-customer-"
 COPY_CUSTOMER_PREFIX = "x-amz-copy-source-server-side-encryption-customer-"
@@ -224,6 +229,32 @@ def encrypt_for_put(
     return data, {}, {}
 
 
+def resolve_put_encryption(headers, bucket_default: str = ""):
+    """One header triage for EVERY write path (single PUT, copy dest,
+    multipart initiate): -> (ssec_key | None, algo str). Raises
+    SseError for SSE-C/algo conflicts and for aws:kms (honest 501 —
+    silently downgrading to the local keyring would misreport
+    compliance)."""
+    ssec_key = parse_customer_headers(headers)
+    algo = headers.get("x-amz-server-side-encryption", "")
+    if ssec_key is not None and algo:
+        raise SseError(
+            "InvalidArgument", "SSE-C and x-amz-server-side-encryption conflict"
+        )
+    if ssec_key is None and not algo:
+        algo = bucket_default
+    if algo == "aws:kms":
+        raise SseError(
+            "NotImplemented", "aws:kms requires an external KMS provider"
+        )
+    if algo and algo != "AES256":
+        raise SseError(
+            "InvalidArgument",
+            f"unsupported x-amz-server-side-encryption {algo!r}",
+        )
+    return ssec_key, algo
+
+
 def entry_sse_algo(entry) -> str:
     return (entry.extended.get(SSE_ALGO_KEY) or b"").decode()
 
@@ -258,6 +289,44 @@ def decrypt_key_for_entry(
         wrapped = entry.extended.get(SSE_WRAPPED_KEY) or b""
         return keyring.decrypt_data_key(key_id, wrapped)
     raise SseError("InternalError", f"unknown SSE algorithm {algo!r}")
+
+
+def read_decrypted(read_fn, entry, key: bytes, offset: int, size: int) -> bytes:
+    """Decrypt entry bytes [offset, offset+size) (size < 0 = to end).
+    read_fn(off, sz) returns ciphertext from the store. Handles both
+    single-IV objects and multipart part-maps (each part its own CTR
+    stream; range reads seek within the owning part's counter)."""
+    import json as _json
+
+    pm_raw = entry.extended.get(SSE_PART_MAP_KEY)
+    if not pm_raw:
+        iv = entry.extended.get(SSE_IV_KEY) or b""
+        aligned = offset - offset % 16
+        want = size if size < 0 else size + (offset - aligned)
+        ct = read_fn(aligned, want)
+        pt = decrypt_range(key, iv, ct, offset)
+        return pt if size < 0 else pt[:size]
+    parts = _json.loads(pm_raw)
+    total = sum(int(length) for length, _iv in parts)
+    end = total if size < 0 else min(offset + size, total)
+    out = bytearray()
+    part_start = 0
+    for length, iv_hex in parts:
+        length = int(length)
+        lo = max(offset, part_start)
+        hi = min(end, part_start + length)
+        if lo < hi:
+            in_off = lo - part_start
+            aligned_in = in_off - in_off % 16
+            ct = read_fn(
+                part_start + aligned_in, (hi - part_start) - aligned_in
+            )
+            pt = decrypt_range(key, bytes.fromhex(iv_hex), ct, in_off)
+            out += pt[: hi - lo]
+        part_start += length
+        if part_start >= end:
+            break
+    return bytes(out)
 
 
 def response_headers_for_entry(entry) -> dict:

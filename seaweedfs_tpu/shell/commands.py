@@ -2344,8 +2344,6 @@ def s3_bucket_quota_enforce(env: ShellEnv, args) -> str:
 def fs_meta_cat(env: ShellEnv, args) -> str:
     import json as _json
 
-    from ..pb import filer_pb2 as fpb
-
     if not args:
         return "usage: fs.meta.cat /path"
     e, err = _lookup_entry(env, args[0])

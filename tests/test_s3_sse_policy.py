@@ -209,14 +209,107 @@ def test_sse_copy_reencrypts(s3):
     assert r.headers["x-amz-server-side-encryption"] == "AES256"
 
 
-def test_sse_multipart_rejected(s3):
-    url, _ = s3
+def _multipart_upload(url, bucket, key, parts, headers=None):
+    """Run a full multipart upload; returns the complete response."""
+    import xml.etree.ElementTree as _ET
+
+    h = headers or {}
+    r = requests.post(f"{url}/{bucket}/{key}?uploads", headers=h)
+    assert r.status_code == 200, r.text
+    root = _ET.fromstring(r.text)
+    ns = root.tag[: root.tag.index("}") + 1] if root.tag.startswith("{") else ""
+    upload_id = root.findtext(f"{ns}UploadId")
+    etags = []
+    for i, data in enumerate(parts, start=1):
+        pr = requests.put(
+            f"{url}/{bucket}/{key}?partNumber={i}&uploadId={upload_id}",
+            data=data,
+            headers=h,
+        )
+        assert pr.status_code == 200, pr.text
+        etags.append(pr.headers["ETag"])
+    body = "<CompleteMultipartUpload>" + "".join(
+        f"<Part><PartNumber>{i}</PartNumber><ETag>{e}</ETag></Part>"
+        for i, e in enumerate(etags, start=1)
+    ) + "</CompleteMultipartUpload>"
+    return requests.post(
+        f"{url}/{bucket}/{key}?uploadId={upload_id}", data=body
+    )
+
+
+def test_sse_s3_multipart_roundtrip(s3):
+    """Multipart + SSE-S3: parts are independent CTR streams under one
+    envelope key; ranged reads seek across part boundaries."""
+    url, srv = s3
     requests.put(f"{url}/mp")
-    r = requests.post(
-        f"{url}/mp/obj?uploads",
+    # odd part sizes: part boundaries NOT 16-byte aligned
+    parts = [b"A" * 100_003, b"B" * 70_001, b"C" * 33]
+    plain = b"".join(parts)
+    r = _multipart_upload(
+        url, "mp", "big.enc", parts,
         headers={"x-amz-server-side-encryption": "AES256"},
     )
-    assert r.status_code == 501
+    assert r.status_code == 200, r.text
+
+    # transparent full read + SSE header advertised
+    g = requests.get(f"{url}/mp/big.enc")
+    assert g.headers.get("x-amz-server-side-encryption") == "AES256"
+    assert g.content == plain
+    # ciphertext at rest differs
+    entry = srv.filer.find_entry("/buckets/mp/big.enc")
+    assert srv.filer.read_entry(entry) != plain
+    # ranges: inside part 1, spanning parts 1-2, tail crossing 2-3
+    for lo, hi in [(5, 900), (100_000, 100_050), (169_990, 170_036)]:
+        rr = requests.get(
+            f"{url}/mp/big.enc", headers={"Range": f"bytes={lo}-{hi}"}
+        )
+        assert rr.status_code == 206
+        assert rr.content == plain[lo : hi + 1], (lo, hi)
+
+
+def test_ssec_multipart_roundtrip(s3):
+    """Multipart + SSE-C: the customer key rides every part request and
+    every read; a wrong key on a part is rejected."""
+    url, _ = s3
+    requests.put(f"{url}/mpc")
+    key = b"M" * 32
+    parts = [b"x" * 50_001, b"y" * 24_007]
+    plain = b"".join(parts)
+    r = _multipart_upload(url, "mpc", "cust.enc", parts, headers=ssec_headers(key))
+    assert r.status_code == 200, r.text
+    # read requires the key; wrong key denied
+    assert requests.get(f"{url}/mpc/cust.enc").status_code == 400
+    assert (
+        requests.get(
+            f"{url}/mpc/cust.enc", headers=ssec_headers(b"W" * 32)
+        ).status_code
+        == 403
+    )
+    g = requests.get(f"{url}/mpc/cust.enc", headers=ssec_headers(key))
+    assert g.content == plain
+    rr = requests.get(
+        f"{url}/mpc/cust.enc",
+        headers={**ssec_headers(key), "Range": "bytes=49999-50010"},
+    )
+    assert rr.content == plain[49999:50011]
+
+    # a part PUT with the WRONG key is rejected mid-upload
+    import xml.etree.ElementTree as _ET
+
+    r = requests.post(f"{url}/mpc/o2?uploads", headers=ssec_headers(key))
+    root = _ET.fromstring(r.text)
+    ns = root.tag[: root.tag.index("}") + 1]
+    uid = root.findtext(f"{ns}UploadId")
+    bad = requests.put(
+        f"{url}/mpc/o2?partNumber=1&uploadId={uid}",
+        data=b"z",
+        headers=ssec_headers(b"W" * 32),
+    )
+    assert bad.status_code == 403
+    nokey = requests.put(
+        f"{url}/mpc/o2?partNumber=1&uploadId={uid}", data=b"z"
+    )
+    assert nokey.status_code == 400
 
 
 # ----------------------------------------------------------- bucket policy
@@ -527,8 +620,10 @@ def test_post_policy_preserves_trailing_newlines(s3_two_users):
     assert requests.get(f"{url}/nl/text.txt", headers=h).content == data
 
 
-def test_multipart_rejected_on_default_encrypted_bucket(s3):
-    url, _ = s3
+def test_multipart_on_default_encrypted_bucket_encrypts(s3):
+    """Bucket default encryption applies to multipart uploads too —
+    plaintext must never land in an AES256-default bucket."""
+    url, srv = s3
     requests.put(f"{url}/mpenc")
     conf = (
         "<ServerSideEncryptionConfiguration><Rule>"
@@ -537,7 +632,13 @@ def test_multipart_rejected_on_default_encrypted_bucket(s3):
         "</Rule></ServerSideEncryptionConfiguration>"
     )
     requests.put(f"{url}/mpenc?encryption", data=conf)
-    assert requests.post(f"{url}/mpenc/big?uploads").status_code == 501
+    parts = [b"default" * 3000, b"enc" * 5000]
+    plain = b"".join(parts)
+    r = _multipart_upload(url, "mpenc", "auto.enc", parts)
+    assert r.status_code == 200, r.text
+    entry = srv.filer.find_entry("/buckets/mpenc/auto.enc")
+    assert srv.filer.read_entry(entry) != plain  # ciphertext at rest
+    assert requests.get(f"{url}/mpenc/auto.enc").content == plain
 
 
 def test_post_policy_rejects_uncovered_fields(s3_two_users):
