@@ -37,6 +37,7 @@ class FilerStore(Protocol):
     def kv_put(self, key: bytes, value: bytes) -> None: ...
     def kv_get(self, key: bytes) -> Optional[bytes]: ...
     def kv_delete(self, key: bytes) -> None: ...
+    def kv_put_if_absent(self, key: bytes, value: bytes) -> bytes: ...
     def close(self) -> None: ...
 
 
@@ -96,6 +97,12 @@ class MemoryStore:
     def kv_delete(self, key: bytes) -> None:
         with self._lock:
             self._kv.pop(key, None)
+
+    def kv_put_if_absent(self, key: bytes, value: bytes) -> bytes:
+        """Atomic create-if-absent; returns the value that WON (the
+        existing one, or `value` if the key was unset)."""
+        with self._lock:
+            return self._kv.setdefault(key, value)
 
     def close(self) -> None:
         pass
@@ -196,6 +203,13 @@ class SqliteStore:
         con = self._con()
         con.execute("DELETE FROM kv WHERE k=?", (key,))
         con.commit()
+
+    def kv_put_if_absent(self, key: bytes, value: bytes) -> bytes:
+        con = self._con()
+        con.execute("INSERT OR IGNORE INTO kv (k, v) VALUES (?,?)", (key, value))
+        con.commit()
+        row = con.execute("SELECT v FROM kv WHERE k=?", (key,)).fetchone()
+        return row[0] if row else value
 
     def close(self) -> None:
         con = getattr(self._local, "con", None)

@@ -166,8 +166,18 @@ def xxh32(data: bytes, seed: int = 0) -> int:
 _LZ4_MAGIC = 0x184D2204
 
 
-def _lz4_decompress_block(data: bytes) -> bytes:
-    out = bytearray()
+def _lz4_decompress_block(
+    data: bytes, out: bytearray | None = None, window_base: int | None = None
+) -> bytes:
+    """Decode one LZ4 block, appending to `out` in place. Matches may
+    reach back to out[window_base:] — 0 for block-LINKED frames
+    (lz4.frame / librdkafka default), len(out)-at-entry for independent
+    blocks. In-place append avoids re-copying the 64 KiB window per
+    block on large messages."""
+    if out is None:
+        out = bytearray()
+    base = len(out)  # where this block's output starts (return slice)
+    floor = base if window_base is None else window_base
     pos = 0
     n = len(data)
     while pos < n:
@@ -199,14 +209,14 @@ def _lz4_decompress_block(data: bytes) -> bytes:
                     break
         match_len += 4
         start = len(out) - offset
-        if start < 0:
+        if start < floor:
             raise ValueError("lz4: match offset before start")
         if offset >= match_len:
             out += out[start : start + match_len]
         else:  # overlapping (RLE) match
             for i in range(match_len):
                 out.append(out[start + i])
-    return bytes(out)
+    return bytes(out[base:])
 
 
 def lz4_decompress(data: bytes) -> bytes:
@@ -220,6 +230,7 @@ def lz4_decompress(data: bytes) -> bytes:
     has_content_size = bool(flg & 0x08)
     has_content_checksum = bool(flg & 0x04)
     block_checksum = bool(flg & 0x10)
+    block_independent = bool(flg & 0x20)
     has_dict = bool(flg & 0x01)
     pos = 6  # magic + FLG + BD
     if has_content_size:
@@ -240,7 +251,16 @@ def lz4_decompress(data: bytes) -> bytes:
         pos += bsize
         if block_checksum:
             pos += 4
-        out += block if stored else _lz4_decompress_block(block)
+        if stored:
+            out += block
+        else:
+            # linked frames: matches may reach back into previously
+            # produced output (offsets are format-capped at 64 KiB, so
+            # the whole buffer serves as the window with no slicing);
+            # independent blocks may only reference themselves
+            _lz4_decompress_block(
+                block, out, 0 if not block_independent else len(out)
+            )
     if has_content_checksum:
         pos += 4
     return bytes(out)

@@ -108,7 +108,9 @@ class S3Server:
         # replace with an external provider via `sse_keyring=`).
         try:
             self.sse_keyring = sse.load_or_create_keyring(
-                filer.store.kv_get, filer.store.kv_put
+                filer.store.kv_get,
+                filer.store.kv_put,
+                getattr(filer.store, "kv_put_if_absent", None),
             )
         except Exception:
             self.sse_keyring = None
@@ -1214,6 +1216,12 @@ class S3Server:
                         ppol.check_policy_document(
                             fields, len(file_bytes), bucket, key
                         )
+                    elif srv.oidc is not None:
+                        # Mirror _auth: an OIDC-only deployment (empty
+                        # SigV4 store) must NOT mean open mode — an
+                        # unsigned POST-policy form is ANONYMOUS, so
+                        # only a bucket-policy/ACL grant can allow it.
+                        self._anonymous = True
                 except S3AuthError as e:
                     code = 403 if e.code in (
                         "AccessDenied",

@@ -157,20 +157,27 @@ class LocalKeyring(KmsProvider):
             ) from None
 
 
-def load_or_create_keyring(kv_get, kv_put) -> LocalKeyring:
+def load_or_create_keyring(kv_get, kv_put, kv_put_if_absent=None) -> LocalKeyring:
     """Master key persisted in the filer KV store so every gateway
-    instance over the same filer shares it. First-boot creation
-    re-reads after the put and uses the STORED value: two gateways
-    racing the creation both converge on whichever write landed last,
-    instead of each keeping a divergent in-memory key that would make
-    the other's objects undecryptable."""
+    instance over the same filer shares it. First-boot creation uses
+    the store's atomic create-if-absent when available (both embedded
+    stores provide it), so two racing gateways deterministically adopt
+    the ONE stored key — a lost race with plain put/re-read would leave
+    a process holding a divergent in-memory key whose wrapped objects
+    become undecryptable after restart."""
     k = b"s3-sse/master-key"
     raw = kv_get(k)
-    if raw is None or len(raw) != 32:
+    if raw is not None and len(raw) == 32:
+        return LocalKeyring(raw)
+    if raw is None and kv_put_if_absent is not None:
+        raw = kv_put_if_absent(k, os.urandom(32))
+    else:  # no atomic primitive — or a CORRUPT stored value, which
+        #    put-if-absent could never repair (it returns the existing
+        #    bytes): overwrite, then adopt whatever the store holds
         kv_put(k, os.urandom(32))
         raw = kv_get(k)
-        if raw is None or len(raw) != 32:  # pragma: no cover - kv broken
-            raise SseError("InternalError", "could not persist SSE master key")
+    if raw is None or len(raw) != 32:  # pragma: no cover - kv broken
+        raise SseError("InternalError", "could not persist SSE master key")
     return LocalKeyring(raw)
 
 

@@ -88,6 +88,12 @@ class RaftNode:
         # index -> (term, result): the term pins ownership so a deposed
         # leader can never return a foreign entry's result
         self._apply_results: dict[int, tuple[int, int]] = {}
+        # indices a propose() call is still waiting on — eviction of
+        # _apply_results must never cross the smallest of these, or a
+        # slow proposer's committed (term, result) can vanish before it
+        # wakes (spurious NotLeader for a committed write => retry
+        # double-apply).
+        self._propose_waiting: set[int] = set()
         # leader volatile state
         self._next_index: dict[str, int] = {}
         self._match_index: dict[str, int] = {}
@@ -440,23 +446,34 @@ class RaftNode:
                 raise NotLeader(self.leader_id)
             term = self.current_term
             idx = self._append_locked(kind, value, data)
+            # register the waiter BEFORE dropping the lock for the
+            # broadcast: the eviction floor must already see idx, or a
+            # descheduled proposer's committed result can be evicted
+            # during the unlocked window
+            self._propose_waiting.add(idx)
         self._broadcast_append()
         deadline = time.monotonic() + timeout
         with self._applied_cv:
-            while self.last_applied < idx:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    raise TimeoutError(f"raft commit timeout at index {idx}")
-                self._applied_cv.wait(remaining)
-            # the entry at idx must still be OURS (a competing leader
-            # may have overwritten the uncommitted suffix, or an
-            # installed snapshot may have advanced last_applied past an
-            # index we never applied). The recorded (term, result) pins
-            # ownership even after compaction.
-            got = self._apply_results.get(idx)
-            if got is None or got[0] != term:
-                raise NotLeader(self.leader_id)
-            return got[1]
+            try:
+                while self.last_applied < idx:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"raft commit timeout at index {idx}"
+                        )
+                    self._applied_cv.wait(remaining)
+                # the entry at idx must still be OURS (a competing
+                # leader may have overwritten the uncommitted suffix,
+                # or an installed snapshot may have advanced
+                # last_applied past an index we never applied). The
+                # recorded (term, result) pins ownership even after
+                # compaction.
+                got = self._apply_results.get(idx)
+                if got is None or got[0] != term:
+                    raise NotLeader(self.leader_id)
+                return got[1]
+            finally:
+                self._propose_waiting.discard(idx)
 
     def _apply_config_locked(self, e: pb.RaftEntry, at_append: bool = False) -> None:
         try:
@@ -497,7 +514,11 @@ class RaftNode:
                 result = self.apply_fn(e.kind, e.value)
             self._apply_results[e.index] = (e.term, int(result or 0))
             if len(self._apply_results) > 4096:
+                # never evict an index a propose() call still waits on
+                floor = min(self._propose_waiting, default=e.index + 1)
                 for k in sorted(self._apply_results)[:2048]:
+                    if k >= floor:
+                        break
                     del self._apply_results[k]
         self._applied_cv.notify_all()
         self._maybe_compact_locked()
@@ -728,7 +749,15 @@ class RaftNode:
             self.role = FOLLOWER
             self._set_leader_locked(request.leader_id)
             self._last_heard = time.monotonic()
-            if request.last_included_index <= self.snap_index:
+            if request.last_included_index <= max(
+                self.snap_index, self.last_applied
+            ):
+                # Stale snapshot: the state machine has already applied
+                # past last_included_index (a leader conflict-hint walk
+                # can back next_index below a follower's applied point).
+                # Restoring would roll the state machine back while
+                # last_applied stays ahead, silently losing the entries
+                # in (lii, last_applied] — acknowledge without acting.
                 return pb.RaftInstallSnapshotResponse(
                     term=self.current_term, success=True
                 )

@@ -276,3 +276,33 @@ def test_tus_resumable_upload(cluster, filer):
         assert r.status_code == 404
     finally:
         srv.stop()
+
+
+def test_kv_put_if_absent_atomic(tmp_path):
+    """First-boot keyring creation relies on create-if-absent: the
+    first writer wins and every caller adopts the stored value
+    (advisor r4 low: SSE master-key divergence)."""
+    from seaweedfs_tpu.filer.filer_store import MemoryStore, SqliteStore
+
+    for store in (MemoryStore(), SqliteStore(str(tmp_path / "kv.db"))):
+        won = store.kv_put_if_absent(b"k", b"first")
+        assert won == b"first"
+        assert store.kv_put_if_absent(b"k", b"second") == b"first"
+        assert store.kv_get(b"k") == b"first"
+        store.close()
+
+
+def test_sse_keyring_uses_put_if_absent(tmp_path):
+    """Two gateways racing first boot converge on ONE master key."""
+    from seaweedfs_tpu.filer.filer_store import MemoryStore
+    from seaweedfs_tpu.s3 import sse
+
+    store = MemoryStore()
+    k1 = sse.load_or_create_keyring(
+        store.kv_get, store.kv_put, store.kv_put_if_absent
+    )
+    k2 = sse.load_or_create_keyring(
+        store.kv_get, store.kv_put, store.kv_put_if_absent
+    )
+    _, dk, wrapped = k1.generate_data_key()
+    assert k2.decrypt_data_key("local-0", wrapped) == dk

@@ -610,6 +610,29 @@ def test_oidc_only_gateway_is_not_open_mode(tmp_path):
         url = f"http://localhost:{srv.port}"
         assert requests.put(f"{url}/nope", timeout=5).status_code == 403
         assert requests.get(f"{url}/", timeout=5).status_code == 403
+        # POST-policy uploads must also be anonymous (not open mode) on
+        # an OIDC-only gateway: an unsigned multipart form may not
+        # write without a bucket-policy/ACL grant (advisor r4 high).
+        from seaweedfs_tpu.filer.entry import new_entry
+
+        filer.create_entry(new_entry("/buckets/pb", is_directory=True))
+        body = (
+            b"--BB\r\n"
+            b'Content-Disposition: form-data; name="key"\r\n\r\n'
+            b"x.txt\r\n"
+            b"--BB\r\n"
+            b'Content-Disposition: form-data; name="file"; filename="x"\r\n'
+            b"Content-Type: text/plain\r\n\r\n"
+            b"owned\r\n"
+            b"--BB--\r\n"
+        )
+        r = requests.post(
+            f"{url}/pb",
+            data=body,
+            headers={"Content-Type": "multipart/form-data; boundary=BB"},
+            timeout=5,
+        )
+        assert r.status_code == 403, r.text
     finally:
         srv.stop()
         filer.close()
