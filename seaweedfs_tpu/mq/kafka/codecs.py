@@ -168,15 +168,16 @@ _LZ4_MAGIC = 0x184D2204
 
 def _lz4_decompress_block(
     data: bytes, out: bytearray | None = None, window_base: int | None = None
-) -> bytes:
+) -> bytes | None:
     """Decode one LZ4 block, appending to `out` in place. Matches may
     reach back to out[window_base:] — 0 for block-LINKED frames
     (lz4.frame / librdkafka default), len(out)-at-entry for independent
     blocks. In-place append avoids re-copying the 64 KiB window per
     block on large messages."""
+    external = out is not None
     if out is None:
         out = bytearray()
-    base = len(out)  # where this block's output starts (return slice)
+    base = len(out)  # where this block's output starts
     floor = base if window_base is None else window_base
     pos = 0
     n = len(data)
@@ -216,7 +217,9 @@ def _lz4_decompress_block(
         else:  # overlapping (RLE) match
             for i in range(match_len):
                 out.append(out[start + i])
-    return bytes(out[base:])
+    # frame-path callers read `out` in place; only standalone use gets
+    # (and pays for) a materialized copy
+    return None if external else bytes(out)
 
 
 def lz4_decompress(data: bytes) -> bytes:

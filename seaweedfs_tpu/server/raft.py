@@ -451,10 +451,10 @@ class RaftNode:
             # descheduled proposer's committed result can be evicted
             # during the unlocked window
             self._propose_waiting.add(idx)
-        self._broadcast_append()
-        deadline = time.monotonic() + timeout
-        with self._applied_cv:
-            try:
+        try:
+            self._broadcast_append()
+            deadline = time.monotonic() + timeout
+            with self._applied_cv:
                 while self.last_applied < idx:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
@@ -472,7 +472,10 @@ class RaftNode:
                 if got is None or got[0] != term:
                     raise NotLeader(self.leader_id)
                 return got[1]
-            finally:
+        finally:
+            # covers the broadcast too: a leaked waiter would pin the
+            # eviction floor for the life of the process
+            with self._lock:
                 self._propose_waiting.discard(idx)
 
     def _apply_config_locked(self, e: pb.RaftEntry, at_append: bool = False) -> None:
