@@ -348,8 +348,6 @@ def _stage_pipeline_file(workdir: str, nbytes: int) -> tuple[str, str]:
     (path, staging_kind). Deterministic content (seeded chunks)."""
     import errno
 
-    shm = "/dev/shm"
-    staging = "tmpfs"
     chunk = np.random.default_rng(0xF00D).integers(
         0, 256, size=64 << 20, dtype=np.uint8
     ).tobytes()

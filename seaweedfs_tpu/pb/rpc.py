@@ -69,6 +69,10 @@ SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
         "ScrubEcVolume": (UNARY, pb.ScrubRequest, pb.ScrubResponse),
         "VolumeTierUpload": (UNARY, pb.TierRequest, pb.TierResponse),
         "VolumeTierDownload": (UNARY, pb.TierRequest, pb.TierResponse),
+        "VolumeTailSender": (SERVER_STREAM, pb.VolumeTailRequest, pb.VolumeTailChunk),
+        "VolumeTailReceiver": (UNARY, pb.VolumeTailReceiverRequest, pb.VolumeTailReceiverResponse),
+        "VolumeIncrementalCopy": (SERVER_STREAM, pb.VolumeIncrementalCopyRequest, pb.VolumeIncrementalCopyChunk),
+        "ReadVolumeFileStatus": (UNARY, pb.VolumeFileStatusRequest, pb.VolumeFileStatusResponse),
     },
     MQ_SERVICE: {
         "ConfigureTopic": (UNARY, mq.ConfigureTopicRequest, mq.ConfigureTopicResponse),

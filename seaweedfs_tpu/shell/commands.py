@@ -541,6 +541,64 @@ def ec_decode(env: ShellEnv, args) -> str:
     return f"decoded ec volume {a.volumeId} back to a normal volume on {target_url}"
 
 
+@command(
+    "volume.sync",
+    "-volumeId N -target host:grpcPort [-source host:grpcPort] "
+    "(incremental replica catch-up via VolumeTailReceiver)",
+    mutating=True,
+)
+def volume_sync(env: ShellEnv, args) -> str:
+    """Needle-granular catch-up: the TARGET replica pulls every record
+    appended at the source since the target's own last appendAtNs
+    (reference volume_grpc_tail.go VolumeTailReceiver + weed backup's
+    incremental model). A replica that missed writes while down
+    converges without a full re-copy."""
+    p = argparse.ArgumentParser(prog="volume.sync")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-target", required=True, help="replica to heal (grpc)")
+    p.add_argument("-source", default="", help="replica to pull from (grpc)")
+    p.add_argument("-sinceNs", type=int, default=0)
+    p.add_argument("-idleTimeout", type=int, default=3)
+    a = p.parse_args(args)
+    locs = env.master.lookup(a.volumeId, refresh=True)
+    if not locs:
+        return f"volume {a.volumeId} not found"
+    import socket as _socket
+
+    def _resolved(addr: str) -> tuple[str, str]:
+        host, _, port = addr.partition(":")
+        try:
+            return _socket.gethostbyname(host), port
+        except OSError:
+            return host, port
+
+    src_grpc = a.source
+    if not src_grpc:
+        # resolve hostnames before comparing: 'localhost' vs
+        # '127.0.0.1' must not make the target pull from itself
+        for loc in locs:
+            cand = f"{loc.url.split(':')[0]}:{loc.grpc_port}"
+            if _resolved(cand) != _resolved(a.target):
+                src_grpc = cand
+                break
+        if not src_grpc:
+            return f"volume {a.volumeId} has no replica besides the target"
+    from ..client.volume_sync import sync_replica
+
+    try:
+        n = sync_replica(
+            a.target, src_grpc, a.volumeId,
+            since_ns=a.sinceNs, idle_timeout_s=a.idleTimeout,
+        )
+    except (RuntimeError, grpc.RpcError) as e:
+        detail = e.details() if isinstance(e, grpc.RpcError) else str(e)
+        return f"error: {detail}"
+    return (
+        f"synced volume {a.volumeId}: {n} records applied "
+        f"{src_grpc} -> {a.target}"
+    )
+
+
 @command("volume.move", "-volumeId N -target host:grpcPort (move one volume)", mutating=True)
 def volume_move(env: ShellEnv, args) -> str:
     """Copy to target, load there, delete at source (reference

@@ -30,7 +30,9 @@ from .needle_map import MemoryNeedleMap
 from .super_block import SUPER_BLOCK_SIZE, ReplicaPlacement, SuperBlock
 from ..utils.fs import fsync_dir
 from .types import (
+    NEEDLE_CHECKSUM_SIZE,
     NEEDLE_HEADER_SIZE,
+    NEEDLE_MAP_ENTRY_SIZE,
     NEEDLE_PADDING_SIZE,
     NeedleValue,
     actual_offset,
@@ -284,8 +286,12 @@ class Volume:
         self._dat.seek(byte_offset)
         return self._dat.read(self._record_disk_len(body_size))
 
-    def delete_needle(self, needle_id: int) -> int:
-        """Tombstone both .dat (empty needle append) and .idx."""
+    def delete_needle(self, needle_id: int, tombstone: Needle | None = None) -> int:
+        """Tombstone both .dat (empty needle append) and .idx.
+
+        `tombstone` lets a tail follower append the SOURCE's tombstone
+        record verbatim (its appendAtNs included) so a resynced replica
+        stays bit-identical to the source."""
         with self._lock:
             self._check_not_broken()
             if self.read_only:
@@ -293,7 +299,7 @@ class Volume:
             nv = self.needle_map.get(needle_id)
             if nv is None or nv.is_deleted:
                 return 0
-            tomb = Needle(cookie=0, needle_id=needle_id)
+            tomb = tombstone or Needle(cookie=0, needle_id=needle_id)
             raw = tomb.to_bytes(self.version)
             self._dat.seek(self._append_at)
             self._dat.write(raw)
@@ -764,3 +770,143 @@ class Volume:
         return padded_record_size(
             NEEDLE_HEADER_SIZE + body_size + footer_size(self.version)
         )
+
+    # ------------------------------------------- incremental follow/tail
+    # Reference: weed/storage/volume_backup.go (findLastAppendAtNs,
+    # BinarySearchByAppendAtNs) — the .idx is the search array; each
+    # probe reads the record's v3 footer appendAtNs from the .dat.
+    # Divergence from the reference (deliberate): the search pins the
+    # LAST put <= since and then walks .dat records forward, so
+    # tombstones — which live between puts and carry their own ts —
+    # are never skipped; the reference starts at the first put > since
+    # and silently loses any delete not followed by a newer put.
+
+    def _require_v3(self) -> None:
+        if self.version != 3:
+            raise VolumeError(
+                f"volume {self.volume_id} is v{self.version}: "
+                "tail/incremental sync needs the v3 appendAtNs footer"
+            )
+
+    def _read_append_at_ns_at(self, byte_offset: int) -> int:
+        """appendAtNs of the record starting at `byte_offset` (v3)."""
+        header = self._pread_raw(byte_offset, NEEDLE_HEADER_SIZE)
+        _, _, body_size = Needle.parse_header(header)
+        ts_off = (
+            byte_offset + NEEDLE_HEADER_SIZE + body_size + NEEDLE_CHECKSUM_SIZE
+        )
+        raw = self._pread_raw(ts_off, 8)
+        return struct.unpack(">Q", raw)[0]
+
+    def _pread_raw(self, offset: int, length: int) -> bytes:
+        with self._lock:
+            self._dat.seek(offset)
+            got = self._dat.read(length)
+        if len(got) != length:
+            raise VolumeError(
+                f"short read at {offset} ({len(got)}/{length})"
+            )
+        return got
+
+    def _live_idx_entries(self) -> list[NeedleValue]:
+        """All PUT entries of the .idx in append order (tombstone
+        entries have offset 0 — their .dat record is located by the
+        forward walk instead). Flushes the map so the journal is
+        current."""
+        self.needle_map.flush()
+        out: list[NeedleValue] = []
+        with open(self.idx_path, "rb") as f:
+            while True:
+                b = f.read(NEEDLE_MAP_ENTRY_SIZE)
+                if len(b) < NEEDLE_MAP_ENTRY_SIZE:
+                    break
+                nv = NeedleValue.from_bytes(b)
+                if nv.offset != 0 and not nv.is_deleted:
+                    out.append(nv)
+        return out
+
+    def _append_end(self) -> int:
+        with self._lock:
+            self._dat.flush()
+            return self._append_at
+
+    def _walk_start_for(self, since_ns: int) -> int:
+        """.dat offset of the last PUT with appendAtNs <= since_ns (or
+        the superblock end): walking forward from here visits every
+        record — put or tombstone — newer than since_ns."""
+        entries = self._live_idx_entries()
+        lo, hi = 0, len(entries)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            ts = self._read_append_at_ns_at(actual_offset(entries[mid].offset))
+            if ts > since_ns:
+                hi = mid
+            else:
+                lo = mid + 1
+        if lo == 0:
+            return SUPER_BLOCK_SIZE
+        return actual_offset(entries[lo - 1].offset)
+
+    def last_append_at_ns(self) -> int:
+        """appendAtNs of the newest record — tombstones included, so a
+        follower's resume point never re-spans its own trailing
+        deletes; 0 for an empty volume."""
+        self._require_v3()
+        entries = self._live_idx_entries()
+        start = (
+            actual_offset(entries[-1].offset) if entries else SUPER_BLOCK_SIZE
+        )
+        last = 0
+        for _n, _raw, ts in self.scan_records_between(start, self._append_end()):
+            last = max(last, ts)
+        return last
+
+    def offset_after_ns(self, since_ns: int) -> int:
+        """First .dat byte offset whose record has appendAtNs >
+        since_ns (== the append end when nothing is newer). This is the
+        byte-level resume point for VolumeIncrementalCopy."""
+        self._require_v3()
+        end = self._append_end()
+        offset = self._walk_start_for(since_ns)
+        for _n, raw, ts in self.scan_records_between(offset, end):
+            if ts > since_ns:
+                return offset
+            offset += padded_record_size(len(raw))
+        return end
+
+    def scan_records_between(self, start: int, end: int):
+        """Yield (needle, record_without_padding, append_at_ns) for
+        every record in [start, end) — puts AND tombstones. Reads use
+        an independent fd so a concurrent writer can't move this scan's
+        file position; `end` must be a snapshot of _append_end()."""
+        fd = os.open(self.dat_path, os.O_RDONLY)
+        try:
+            offset = start
+            while offset + NEEDLE_HEADER_SIZE <= end:
+                header = os.pread(fd, NEEDLE_HEADER_SIZE, offset)
+                if len(header) < NEEDLE_HEADER_SIZE:
+                    return
+                _, _, body_size = Needle.parse_header(header)
+                rec_len = self._record_disk_len(body_size)
+                if offset + rec_len > end:
+                    return  # racing append: stop at the snapshot
+                raw = os.pread(fd, rec_len, offset)
+                n = Needle.from_bytes(raw, self.version)
+                unpadded = NEEDLE_HEADER_SIZE + body_size + footer_size(
+                    self.version
+                )
+                yield n, raw[:unpadded], n.append_at_ns
+                offset += rec_len
+        finally:
+            os.close(fd)
+
+    def scan_raw_since(self, since_ns: int):
+        """Yield (needle, record_without_padding, append_at_ns) for
+        every record appended after since_ns, up to a stable size
+        snapshot."""
+        self._require_v3()
+        end = self._append_end()
+        start = self._walk_start_for(since_ns)
+        for n, raw, ts in self.scan_records_between(start, end):
+            if ts > since_ns:
+                yield n, raw, ts

@@ -1,0 +1,360 @@
+"""Volume tail / incremental replica catch-up.
+
+Reference: weed/server/volume_grpc_tail.go (VolumeTailSender/Receiver),
+weed/storage/volume_backup.go (BinarySearchByAppendAtNs,
+VolumeIncrementalCopy). The headline test is the verdict-directed one:
+a diverged replica resyncs needle-granularly and ends BIT-IDENTICAL to
+the source volume's .dat.
+"""
+
+from __future__ import annotations
+
+import time
+
+import grpc
+import pytest
+
+from conftest import allocate_port as free_port
+from seaweedfs_tpu.client.volume_sync import (
+    incremental_copy,
+    sync_replica,
+    tail_volume,
+)
+from seaweedfs_tpu.pb import cluster_pb2 as pb
+from seaweedfs_tpu.pb import rpc
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume
+
+
+# ------------------------------------------------------ unit primitives
+
+
+def test_offset_after_ns_and_scan(tmp_path):
+    v = Volume(str(tmp_path), 1)
+    ts = []
+    for i in range(1, 51):
+        v.write_needle(Needle(cookie=7, needle_id=i, data=b"x" * (i % 13 + 1)))
+        ts.append(v.last_append_at_ns())
+    assert ts == sorted(ts)
+
+    # since=0: everything follows
+    ids = [n.needle_id for n, _, _ in v.scan_raw_since(0)]
+    assert ids == list(range(1, 51))
+
+    # middle boundary is exclusive
+    mid = ts[24]
+    ids = [n.needle_id for n, _, _ in v.scan_raw_since(mid)]
+    assert ids == list(range(26, 51))
+
+    # since=last: nothing; byte resume point == append end
+    assert list(v.scan_raw_since(ts[-1])) == []
+    assert v.offset_after_ns(ts[-1]) == v._append_end()
+    assert v.offset_after_ns(0) == 8  # SUPER_BLOCK_SIZE
+    v.close()
+
+
+def test_delete_only_tail_propagates(tmp_path):
+    """A tombstone NOT followed by any newer put must still stream
+    (review r5: the reference's first-put-after-since search silently
+    loses trailing deletes; ours pins the last put <= since and walks
+    forward)."""
+    v = Volume(str(tmp_path), 4)
+    for i in range(1, 6):
+        v.write_needle(Needle(cookie=1, needle_id=i, data=b"d"))
+    synced = v.last_append_at_ns()
+    v.delete_needle(2)  # nothing appended after this tombstone
+    recs = list(v.scan_raw_since(synced))
+    assert [(n.needle_id, n.data) for n, _, _ in recs] == [(2, b"")]
+    # the follower's own resume point includes the tombstone's ts
+    assert v.last_append_at_ns() > synced
+    # byte-level resume also lands exactly at the tombstone record
+    off = v.offset_after_ns(synced)
+    assert off < v._append_end()
+    v.close()
+
+
+def test_scan_raw_since_propagates_tombstones(tmp_path):
+    v = Volume(str(tmp_path), 2)
+    for i in range(1, 11):
+        v.write_needle(Needle(cookie=1, needle_id=i, data=b"d"))
+    mid = v.last_append_at_ns()
+    v.write_needle(Needle(cookie=1, needle_id=11, data=b"d"))
+    v.delete_needle(3)
+    recs = list(v.scan_raw_since(mid))
+    ids = [(n.needle_id, n.data) for n, _, _ in recs]
+    assert ids == [(11, b"d"), (3, b"")]
+    v.close()
+
+
+def test_last_append_at_ns_includes_trailing_tombstone(tmp_path):
+    """The resume point covers tombstones: a replica whose newest
+    record is its own applied delete must not re-span it."""
+    v = Volume(str(tmp_path), 3)
+    v.write_needle(Needle(cookie=1, needle_id=1, data=b"a"))
+    put_ts = v.last_append_at_ns()
+    v.delete_needle(1)
+    assert v.last_append_at_ns() > put_ts
+    assert list(v.scan_raw_since(v.last_append_at_ns())) == []
+    v.close()
+
+
+# --------------------------------------------------- spawned-server sync
+
+
+@pytest.fixture
+def pair(tmp_path):
+    mport = free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vols = []
+    for i in range(2):
+        vs = VolumeServer(
+            directories=[str(tmp_path / f"v{i}")],
+            master=f"localhost:{mport}",
+            ip="localhost",
+            port=free_port(),
+            ec_backend="cpu",
+        )
+        vs.start()
+        vols.append(vs)
+    deadline = time.time() + 10
+    while len(master.topo.nodes) < 2:
+        if time.time() > deadline:
+            raise TimeoutError("volume servers did not register")
+        time.sleep(0.05)
+    yield master, vols
+    for vs in vols:
+        vs.stop()
+    master.stop()
+
+
+def _stub(vs):
+    ch = grpc.insecure_channel(f"localhost:{vs.grpc_port}")
+    return ch, rpc.volume_stub(ch)
+
+
+def _write(stub, vid, nid, data, cookie=0x1234):
+    r = stub.WriteNeedle(
+        pb.WriteNeedleRequest(
+            volume_id=vid,
+            needle_id=nid,
+            cookie=cookie,
+            data=data,
+            is_replicate=True,
+        ),
+        timeout=10,
+    )
+    assert not r.error, r.error
+
+
+def _dat_bytes(vs, vid):
+    v = vs.store.find_volume(vid)
+    v.flush()
+    with open(v.dat_path, "rb") as f:
+        return f.read()
+
+
+def test_replica_catchup_bit_identical(pair):
+    """Verdict-directed: kill a replica (simulated as one replica not
+    receiving the writes), write 1k needles, resync via
+    VolumeTailReceiver, verify bit-identical .dat."""
+    _, (a, b) = pair
+    ca, sa = _stub(a)
+    cb, sb = _stub(b)
+    try:
+        for s in (sa, sb):
+            s.AllocateVolume(
+                pb.AllocateVolumeRequest(volume_id=7, replication="000"),
+                timeout=10,
+            )
+        # both replicas see the first 10 writes
+        for i in range(1, 11):
+            blob = f"seed-{i}".encode() * 3
+            _write(sa, 7, i, blob)
+        n = sync_replica(
+            f"localhost:{b.grpc_port}", f"localhost:{a.grpc_port}", 7,
+            idle_timeout_s=1,
+        )
+        assert n == 10
+        assert _dat_bytes(a, 7) == _dat_bytes(b, 7)
+
+        # replica b "down": a takes 1000 more writes, 5 deletes, 3
+        # overwrites
+        for i in range(11, 1011):
+            _write(sa, 7, i, f"payload-{i}".encode() * (i % 7 + 1))
+        for i in (2, 4, 500, 900, 1000):
+            sa.DeleteNeedle(
+                pb.DeleteNeedleRequest(
+                    volume_id=7, needle_id=i, is_replicate=True
+                ),
+                timeout=10,
+            )
+        for i in (1, 3, 7):
+            _write(sa, 7, i, f"rewrite-{i}".encode())
+
+        n = sync_replica(
+            f"localhost:{b.grpc_port}", f"localhost:{a.grpc_port}", 7,
+            idle_timeout_s=1,
+        )
+        assert n == 1008, n
+        assert _dat_bytes(a, 7) == _dat_bytes(b, 7)
+
+        # the replica serves the synced content (including deletes)
+        vb = b.store.find_volume(7)
+        assert vb.read_needle(500 + 1).data.startswith(b"payload-501")
+        assert vb.read_needle(1).data == b"rewrite-1"
+        from seaweedfs_tpu.storage.volume import NotFoundError
+
+        for i in (2, 4, 500):
+            with pytest.raises(NotFoundError):
+                vb.read_needle(i)
+
+        # delete-only divergence: no put follows the tombstone
+        sa.DeleteNeedle(
+            pb.DeleteNeedleRequest(
+                volume_id=7, needle_id=42, is_replicate=True
+            ),
+            timeout=10,
+        )
+        n = sync_replica(
+            f"localhost:{b.grpc_port}", f"localhost:{a.grpc_port}", 7,
+            idle_timeout_s=1,
+        )
+        assert n == 1, n
+        assert _dat_bytes(a, 7) == _dat_bytes(b, 7)
+        with pytest.raises(NotFoundError):
+            vb.read_needle(42)
+    finally:
+        ca.close()
+        cb.close()
+
+
+def test_tail_volume_client_streams_live_appends(pair):
+    _, (a, _b) = pair
+    ca, sa = _stub(a)
+    try:
+        sa.AllocateVolume(
+            pb.AllocateVolumeRequest(volume_id=9, replication="000"),
+            timeout=10,
+        )
+        _write(sa, 9, 1, b"first")
+        got = []
+
+        import threading
+
+        def consume():
+            for n in tail_volume(
+                f"localhost:{a.grpc_port}", 9, 0, idle_timeout_s=2
+            ):
+                got.append(n.needle_id)
+
+        t = threading.Thread(target=consume)
+        t.start()
+        time.sleep(0.6)  # tail is now past the first scan, following
+        _write(sa, 9, 2, b"live-append" * 100_000)  # multi-chunk body
+        t.join(timeout=30)
+        assert not t.is_alive()
+        assert got == [1, 2]
+    finally:
+        ca.close()
+
+
+def test_incremental_copy_prefix_guard(pair):
+    _, (a, _b) = pair
+    ca, sa = _stub(a)
+    try:
+        sa.AllocateVolume(
+            pb.AllocateVolumeRequest(volume_id=11, replication="000"),
+            timeout=10,
+        )
+        for i in range(1, 6):
+            _write(sa, 11, i, f"n{i}".encode())
+        va = a.store.find_volume(11)
+        mid_ns = va.last_append_at_ns()
+        mid_size = len(_dat_bytes(a, 11))
+        for i in range(6, 11):
+            _write(sa, 11, i, f"n{i}".encode())
+
+        start, chunks = incremental_copy(
+            f"localhost:{a.grpc_port}", 11, mid_ns
+        )
+        tail = b"".join(chunks)
+        assert start == mid_size
+        assert _dat_bytes(a, 11)[start:] == tail
+
+        # nothing newer: start == current size, empty stream
+        start2, chunks2 = incremental_copy(
+            f"localhost:{a.grpc_port}", 11, va.last_append_at_ns()
+        )
+        assert start2 == len(_dat_bytes(a, 11))
+        assert b"".join(chunks2) == b""
+    finally:
+        ca.close()
+
+
+def test_read_volume_file_status(pair):
+    _, (a, _b) = pair
+    ca, sa = _stub(a)
+    try:
+        sa.AllocateVolume(
+            pb.AllocateVolumeRequest(volume_id=13, replication="000"),
+            timeout=10,
+        )
+        _write(sa, 13, 1, b"hello")
+        st = sa.ReadVolumeFileStatus(
+            pb.VolumeFileStatusRequest(volume_id=13), timeout=10
+        )
+        assert not st.error
+        v = a.store.find_volume(13)
+        assert st.dat_size == len(_dat_bytes(a, 13))
+        assert st.last_append_at_ns == v.last_append_at_ns()
+        assert st.version == v.version
+        missing = sa.ReadVolumeFileStatus(
+            pb.VolumeFileStatusRequest(volume_id=99), timeout=10
+        )
+        assert missing.error
+    finally:
+        ca.close()
+
+
+def test_shell_volume_sync_command(pair):
+    from seaweedfs_tpu.shell.commands import ShellEnv, run_command
+
+    master, (a, b) = pair
+    ca, sa = _stub(a)
+    cb, sb = _stub(b)
+    env = ShellEnv(f"localhost:{master.port}")
+    try:
+        for s in (sa, sb):
+            s.AllocateVolume(
+                pb.AllocateVolumeRequest(volume_id=21, replication="000"),
+                timeout=10,
+            )
+        for i in range(1, 31):
+            _write(sa, 21, i, f"rec-{i}".encode())
+        # master must know the volume exists for lookup
+        deadline = time.time() + 10
+        while not env.master.lookup(21, refresh=True):
+            if time.time() > deadline:
+                raise TimeoutError("master never learned volume 21")
+            time.sleep(0.1)
+        out = run_command(
+            env,
+            f"volume.sync -volumeId 21 -target localhost:{b.grpc_port} "
+            f"-source localhost:{a.grpc_port} -idleTimeout 1",
+        )
+        assert "30 records applied" in out, out
+        assert _dat_bytes(a, 21) == _dat_bytes(b, 21)
+        # second run is a no-op (already converged)
+        out = run_command(
+            env,
+            f"volume.sync -volumeId 21 -target localhost:{b.grpc_port} "
+            f"-source localhost:{a.grpc_port} -idleTimeout 1",
+        )
+        assert "0 records applied" in out, out
+    finally:
+        env.close()
+        ca.close()
+        cb.close()
