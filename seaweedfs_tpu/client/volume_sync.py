@@ -23,7 +23,7 @@ def tail_volume(
     idle_timeout_s: int = 3,
     timeout: float = 3600.0,
 ) -> Iterator[Needle]:
-    """Yield needles (puts AND tombstones: empty data + cookie 0)
+    """Yield needles (puts AND tombstones: the 0x40 flag bit)
     appended to `volume_id` on `addr` (host:grpcPort) after since_ns,
     following live appends until the source is idle for
     idle_timeout_s."""

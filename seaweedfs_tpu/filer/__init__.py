@@ -4,3 +4,10 @@ from .chunks import ChunkView, read_chunk_views, total_size, visible_intervals
 from .entry import Entry, new_entry, normalize_path, split_path
 from .filer import DEFAULT_CHUNK_SIZE, Filer, FilerError
 from .filer_store import FilerStore, MemoryStore, NotFound, SqliteStore
+from .abstract_sql_store import (
+    MYSQL_DIALECT,
+    POSTGRES_DIALECT,
+    AbstractSqlStore,
+    SqlDialect,
+)
+from .sstable_store import SSTableStore

@@ -108,111 +108,25 @@ class MemoryStore:
         pass
 
 
-class SqliteStore:
-    """SQLite-backed store (reference weed/filer/sqlite via abstract_sql:
-    one row per entry keyed (directory, name), meta = protobuf blob)."""
+# Imported AFTER NotFound is defined: abstract_sql_store imports it
+# back from this module (deliberate one-way-at-runtime cycle).
+from .abstract_sql_store import AbstractSqlStore  # noqa: E402
+
+
+class SqliteStore(AbstractSqlStore):
+    """SQLite through the abstract-SQL template (reference
+    weed/filer/sqlite riding weed/filer/abstract_sql): one row per
+    entry keyed (directory, name), meta = protobuf blob. Any other
+    PEP-249 driver is the same subclass shape with its dialect."""
 
     def __init__(self, path: str):
         os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
-        self._local = threading.local()
         self.path = path
-        con = self._con()
-        con.execute(
-            "CREATE TABLE IF NOT EXISTS filemeta ("
-            " directory TEXT NOT NULL,"
-            " name TEXT NOT NULL,"
-            " meta BLOB,"
-            " PRIMARY KEY (directory, name))"
-        )
-        con.execute(
-            "CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB)"
-        )
-        con.commit()
 
-    def _con(self) -> sqlite3.Connection:
-        con = getattr(self._local, "con", None)
-        if con is None:
-            con = sqlite3.connect(self.path, timeout=30)
+        def connect() -> sqlite3.Connection:
+            con = sqlite3.connect(path, timeout=30)
             con.execute("PRAGMA journal_mode=WAL")
             con.execute("PRAGMA synchronous=NORMAL")
-            self._local.con = con
-        return con
+            return con
 
-    def insert(self, entry: Entry) -> None:
-        con = self._con()
-        con.execute(
-            "INSERT OR REPLACE INTO filemeta (directory, name, meta) VALUES (?,?,?)",
-            (entry.directory, entry.name, entry.to_bytes()),
-        )
-        con.commit()
-
-    update = insert
-
-    def find(self, directory: str, name: str) -> Entry:
-        row = (
-            self._con()
-            .execute(
-                "SELECT meta FROM filemeta WHERE directory=? AND name=?",
-                (directory, name),
-            )
-            .fetchone()
-        )
-        if row is None:
-            raise NotFound(f"{directory}/{name}")
-        return Entry.from_bytes(directory, row[0])
-
-    def delete(self, directory: str, name: str) -> None:
-        con = self._con()
-        con.execute(
-            "DELETE FROM filemeta WHERE directory=? AND name=?", (directory, name)
-        )
-        con.commit()
-
-    def delete_folder_children(self, directory: str) -> None:
-        con = self._con()
-        prefix = directory if directory.endswith("/") else directory + "/"
-        con.execute(
-            "DELETE FROM filemeta WHERE directory=? OR directory LIKE ?",
-            (directory, prefix + "%"),
-        )
-        con.commit()
-
-    def list(self, directory, start_from="", limit=1024, prefix=""):
-        # prefix as a half-open range (LIKE is case-insensitive for
-        # ASCII and treats %/_ as wildcards — wrong for literal names)
-        sql = "SELECT name, meta FROM filemeta WHERE directory=? AND name>?"
-        params: list = [directory, start_from]
-        if prefix:
-            sql += " AND name>=? AND name<?"
-            params += [prefix, prefix + "\U0010ffff"]
-        sql += " ORDER BY name LIMIT ?"
-        params.append(limit)
-        for name, meta in self._con().execute(sql, params):
-            yield Entry.from_bytes(directory, meta)
-
-    def kv_put(self, key: bytes, value: bytes) -> None:
-        con = self._con()
-        con.execute("INSERT OR REPLACE INTO kv (k, v) VALUES (?,?)", (key, value))
-        con.commit()
-
-    def kv_get(self, key: bytes) -> Optional[bytes]:
-        row = self._con().execute("SELECT v FROM kv WHERE k=?", (key,)).fetchone()
-        return row[0] if row else None
-
-    def kv_delete(self, key: bytes) -> None:
-        con = self._con()
-        con.execute("DELETE FROM kv WHERE k=?", (key,))
-        con.commit()
-
-    def kv_put_if_absent(self, key: bytes, value: bytes) -> bytes:
-        con = self._con()
-        con.execute("INSERT OR IGNORE INTO kv (k, v) VALUES (?,?)", (key, value))
-        con.commit()
-        row = con.execute("SELECT v FROM kv WHERE k=?", (key,)).fetchone()
-        return row[0] if row else value
-
-    def close(self) -> None:
-        con = getattr(self._local, "con", None)
-        if con is not None:
-            con.close()
-            self._local.con = None
+        super().__init__(connect)

@@ -97,6 +97,12 @@ def main(argv=None) -> int:
     f.add_argument("-jwt.key", dest="jwt_key", default="")
     f.add_argument("-notify.webhook", dest="notify_webhook", default="")
     f.add_argument("-notify.mq", dest="notify_mq", default="")
+    f.add_argument(
+        "-store",
+        default="sqlite",
+        choices=["sqlite", "sstable", "memory"],
+        help="metadata backend (sstable = embedded WAL+SSTable engine)",
+    )
     f.add_argument("-grpcPort", type=int, default=0, help="gRPC metadata API port (0 = port+10000)")
     f.add_argument("-peers", default="", help="comma-separated peer filer gRPC addrs for multi-filer")
     _add_tls_flags(f)
@@ -398,8 +404,19 @@ def main(argv=None) -> int:
         else:
             master, fport = f"{a.ip}:{a.masterPort}", a.filerPort
             dbdir = os.path.join(a.dir[0], "filerdb")
+        store_kind = getattr(a, "store", "sqlite")
+        if store_kind == "sstable":
+            from ..filer.sstable_store import SSTableStore
+
+            store = SSTableStore(os.path.join(dbdir, "filer.sst"))
+        elif store_kind == "memory":
+            from ..filer.filer_store import MemoryStore
+
+            store = MemoryStore()
+        else:
+            store = SqliteStore(os.path.join(dbdir, "filer.db"))
         filer = Filer(
-            SqliteStore(os.path.join(dbdir, "filer.db")),
+            store,
             master=master,
             collection=getattr(a, "collection", ""),
             replication=getattr(a, "replication", ""),

@@ -525,6 +525,8 @@ class VolumeService:
                 f"volume {request.volume_id} not found",
             )
         try:
+            v._require_v3()  # v2 has no appendAtNs: refuse, never
+            #                  stream garbage-timestamped silence
             # position once (idx binary search); every later poll just
             # compares the cached .dat position against the append end
             # — O(1) while idle, no idx re-reads
@@ -584,8 +586,10 @@ class VolumeService:
                 since,
                 request.idle_timeout_seconds or 3,
             ):
-                if not n.data and n.cookie == 0:
+                if n.is_tombstone:
                     # propagate the SOURCE's tombstone bytes verbatim
+                    # (the 0x40 flag travels inside the record, so an
+                    # empty-body PUT is never misread as a delete)
                     v.delete_needle(n.needle_id, tombstone=n)
                 else:
                     v.write_needle(n)  # append_at_ns preserved -> same bytes

@@ -42,6 +42,10 @@ FLAG_HAS_MIME = 0x04
 FLAG_HAS_LAST_MODIFIED = 0x08
 FLAG_HAS_TTL = 0x10
 FLAG_HAS_PAIRS = 0x20
+# 0x40: deletion tombstone record (this framework's own marker; the
+# reference leaves the bit unused). Disambiguates a delete from a
+# legitimate empty-body put on the tail/replica-sync path.
+FLAG_IS_TOMBSTONE = 0x40
 FLAG_IS_CHUNK_MANIFEST = 0x80
 
 MAX_NEEDLE_SIZE = MAX_NEEDLE_BODY_SIZE
@@ -86,6 +90,10 @@ class Needle:
     @property
     def is_chunk_manifest(self) -> bool:
         return self._has(FLAG_IS_CHUNK_MANIFEST)
+
+    @property
+    def is_tombstone(self) -> bool:
+        return self._has(FLAG_IS_TOMBSTONE)
 
     def set_name(self, name: bytes) -> None:
         self.name = name[:255]

@@ -299,7 +299,10 @@ class Volume:
             nv = self.needle_map.get(needle_id)
             if nv is None or nv.is_deleted:
                 return 0
+            from .needle import FLAG_IS_TOMBSTONE
+
             tomb = tombstone or Needle(cookie=0, needle_id=needle_id)
+            tomb.flags |= FLAG_IS_TOMBSTONE
             raw = tomb.to_bytes(self.version)
             self._dat.seek(self._append_at)
             self._dat.write(raw)

@@ -25,9 +25,33 @@ def _base(a) -> str:
     return Volume.base_file_name(a.dir, a.collection, a.volumeId)
 
 
+def _is_tombstone_record(dat_fd: int, stored_off: int, body_size: int) -> bool:
+    """Delete marker test: empty body (legacy tombstones / reference
+    fix.go:86 semantics) OR an empty-data body whose flags byte carries
+    FLAG_IS_TOMBSTONE (0x40, this framework's explicit marker). The
+    flags byte sits at header(16) + data_size(4) + data(len) when the
+    body exists."""
+    if body_size == 0:
+        return True
+    if body_size > 64:  # real payloads: skip the pread
+        return False
+    import struct as _struct
+
+    from ..storage.needle import FLAG_IS_TOMBSTONE
+
+    off = stored_off * 8 + 16
+    head = os.pread(dat_fd, min(body_size, 64), off)
+    if len(head) < 5:
+        return False
+    (data_len,) = _struct.unpack_from(">I", head, 0)
+    if data_len != 0 or len(head) < 5 + data_len:
+        return False
+    return bool(head[4] & FLAG_IS_TOMBSTONE)
+
+
 def cmd_fix(a) -> int:
     """Rebuild .idx by replaying the .dat (reference fix.go:86: size>0
-    puts, empty-body appends are delete markers)."""
+    puts, tombstone appends are delete markers)."""
     base = _base(a)
     live: dict[int, NeedleValue] = {}
     records = 0
@@ -48,15 +72,19 @@ def cmd_fix(a) -> int:
             (i.needle.needle_id, i.offset // 8, i.body_size, i.crc_ok)
             for i in items
         )
-    for nid, stored_off, body_size, crc_ok in scan:
-        if not crc_ok:
-            print(f"skip needle {nid:x} at {stored_off * 8}: bad crc")
-            continue
-        records += 1
-        if body_size > 0:
-            live[nid] = NeedleValue(nid, stored_off, body_size)
-        else:
-            live.pop(nid, None)  # delete marker
+    dat_fd = os.open(base + ".dat", os.O_RDONLY)
+    try:
+        for nid, stored_off, body_size, crc_ok in scan:
+            if not crc_ok:
+                print(f"skip needle {nid:x} at {stored_off * 8}: bad crc")
+                continue
+            records += 1
+            if _is_tombstone_record(dat_fd, stored_off, body_size):
+                live.pop(nid, None)  # delete marker
+            else:
+                live[nid] = NeedleValue(nid, stored_off, body_size)
+    finally:
+        os.close(dat_fd)
     # .idx is a replayable journal; a minimal rebuild carries only the
     # surviving entries, ascending
     with open(base + ".idx.tmp", "wb") as f:
@@ -74,7 +102,9 @@ def cmd_export(a) -> int:
     live: dict[int, tuple] = {}
     _, items = scan_volume_file(base + ".dat")
     for item in items:
-        if item.body_size > 0 and item.crc_ok:
+        if item.crc_ok and not (
+            item.body_size == 0 or item.needle.is_tombstone
+        ):
             live[item.needle.needle_id] = item
         else:
             live.pop(item.needle.needle_id, None)
@@ -252,7 +282,7 @@ def cmd_scan(a) -> int:
     print(f"superblock: version={sb.version} rp={sb.replica_placement} rev={sb.compaction_revision}")
     for item in items:
         n = item.needle
-        kind = "DEL" if item.body_size == 0 else "PUT"
+        kind = "DEL" if (n.is_tombstone or item.body_size == 0) else "PUT"
         flag = "" if item.crc_ok else " CRC-BAD"
         print(
             f"{kind} offset={item.offset} id={n.needle_id:x} cookie={n.cookie:08x} "

@@ -214,10 +214,24 @@ def test_small_content_inlining(cluster):
         f.close()
 
 
-def test_filer_http_server(cluster, tmp_path):
+@pytest.mark.parametrize(
+    "store_mk",
+    [
+        pytest.param(
+            lambda p: SqliteStore(str(p / "fdb" / "filer.db")), id="sqlite"
+        ),
+        pytest.param(
+            lambda p: __import__(
+                "seaweedfs_tpu.filer.sstable_store", fromlist=["SSTableStore"]
+            ).SSTableStore(str(p / "fdb" / "filer.sst")),
+            id="sstable",
+        ),
+    ],
+)
+def test_filer_http_server(cluster, tmp_path, store_mk):
     fport = free_port()
     f = Filer(
-        SqliteStore(str(tmp_path / "fdb" / "filer.db")),
+        store_mk(tmp_path),
         master=f"localhost:{cluster}",
         chunk_size=32 * 1024,
     )

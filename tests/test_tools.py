@@ -33,7 +33,9 @@ def test_scan_sees_all_records(tmp_path):
     items = list(items)
     # 20 puts + 1 delete marker + 1 overwrite
     assert len(items) == 22
-    assert sum(1 for i in items if i.body_size == 0) == 1
+    # tombstones now carry the explicit 0x40 flag (body holds the
+    # flags byte, so body_size is 5, not 0)
+    assert sum(1 for i in items if i.needle.is_tombstone) == 1
     assert all(i.crc_ok for i in items)
 
 
