@@ -210,9 +210,12 @@ class SSTableStore:
     # -------------------------------------------------------- memtable
 
     def _mem_apply(self, key: bytes, value: Optional[bytes]) -> None:
-        old = self._mem.get(key)
+        if key not in self._mem:
+            self._mem_bytes += len(key)
+        else:
+            self._mem_bytes -= len(self._mem[key] or b"")
         self._mem[key] = value
-        self._mem_bytes += len(key) + len(value or b"") - len(old or b"")
+        self._mem_bytes += len(value or b"")
 
     def _write(self, key: bytes, value: Optional[bytes]) -> None:
         with self._lock:

@@ -49,6 +49,7 @@ class MqBroker:
         self.segment_records = segment_records
         self._topics: dict[tuple[str, str], _TopicState] = {}
         self._offsets: dict[tuple, int] = {}  # (ns, topic, part, group)
+        self._offset_meta: dict[tuple, str] = {}  # committed metadata
         self._schemas: dict[tuple[str, str], str] = {}  # (ns, topic)
         self._lock = threading.RLock()
         self._http = requests.Session()
@@ -135,7 +136,12 @@ class MqBroker:
                 if off:
                     for k, v in json.loads(off).items():
                         part_s, group = k.split("|", 1)
-                        self._offsets[(ns, name, int(part_s), group)] = v
+                        key = (ns, name, int(part_s), group)
+                        if isinstance(v, list):  # [offset, metadata]
+                            self._offsets[key] = v[0]
+                            self._offset_meta[key] = v[1]
+                        else:
+                            self._offsets[key] = v
 
     def _make_log(self, ns: str, name: str, part: int, recover: bool = False) -> PartitionLog:
         spill = None
@@ -226,6 +232,11 @@ class MqBroker:
             self._offsets = {
                 k: v
                 for k, v in self._offsets.items()
+                if (k[0], k[1]) != (ns, name)
+            }
+            self._offset_meta = {
+                k: v
+                for k, v in self._offset_meta.items()
                 if (k[0], k[1]) != (ns, name)
             }
         if self.filer:
@@ -349,13 +360,21 @@ class MqBroker:
                 return f"field {fname!r} is not a {ftype}"
         return ""
 
-    def commit_offset(self, ns, name, part, group, offset) -> None:
+    def commit_offset(self, ns, name, part, group, offset, metadata: str = "") -> None:
         # snapshot under the lock, persist outside it: one slow filer
         # write must not stall every other MQ RPC
         with self._lock:
             self._offsets[(ns, name, part, group)] = offset
+            if metadata:
+                self._offset_meta[(ns, name, part, group)] = metadata
+            else:
+                self._offset_meta.pop((ns, name, part, group), None)
             grouped = {
-                f"{p}|{g}": o
+                f"{p}|{g}": (
+                    [o, m]
+                    if (m := self._offset_meta.get((n2, t2, p, g), ""))
+                    else o
+                )
                 for (n2, t2, p, g), o in self._offsets.items()
                 if (n2, t2) == (ns, name)
             }
@@ -368,6 +387,15 @@ class MqBroker:
     def fetch_offset(self, ns, name, part, group) -> int:
         with self._lock:
             return self._offsets.get((ns, name, part, group), -1)
+
+    def fetch_offset_meta(self, ns, name, part, group) -> tuple[int, str]:
+        """(offset, committed metadata) — Kafka's OffsetFetch returns
+        the metadata string the committer attached."""
+        with self._lock:
+            return (
+                self._offsets.get((ns, name, part, group), -1),
+                self._offset_meta.get((ns, name, part, group), ""),
+            )
 
     def flush(self) -> None:
         with self._lock:

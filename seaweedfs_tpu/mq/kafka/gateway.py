@@ -76,6 +76,7 @@ class KafkaGateway:
         self.advertised_host = advertised_host or ip
         self.auto_create_partitions = auto_create_partitions
         self.coordinator = GroupCoordinator()
+        self._tl = threading.local()  # per-connection request context
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((ip, port))
@@ -150,7 +151,10 @@ class KafkaGateway:
         api_key = r.i16()
         api_version = r.i16()
         correlation_id = r.i32()
-        r.nullable_string()  # client_id (NON-compact even in header v2)
+        # client_id (NON-compact even in header v2); kept per-thread —
+        # JoinGroup derives generated member ids from it, matching the
+        # broker convention "<client.id>-<uuid>"
+        self._tl.client_id = r.nullable_string() or ""
         out = Writer().i32(correlation_id)
         lo_hi = _API_RANGES.get(api_key)
         if lo_hi is None or not lo_hi[0] <= api_version <= lo_hi[1]:
@@ -604,6 +608,8 @@ class KafkaGateway:
             def part_entry(w3: Writer, pt):
                 part, ts = pt
                 plog = self._log_for(name, part)
+                found_ts = -1  # special queries report -1 (spec: only
+                #                timestamp lookups name a record's ts)
                 if plog is None:
                     err, off = kp.UNKNOWN_TOPIC_OR_PARTITION, -1
                 elif ts == -1:  # latest
@@ -611,7 +617,8 @@ class KafkaGateway:
                 elif ts == -2:  # earliest
                     err, off = kp.NONE, plog.earliest_offset
                 else:
-                    err, off = kp.NONE, _offset_for_time(plog, ts)
+                    err = kp.NONE
+                    off, found_ts = _offset_for_time(plog, ts)
                 w3.i32(part).i16(err)
                 if v == 0:
                     w3.array(
@@ -619,7 +626,7 @@ class KafkaGateway:
                         lambda w4, o: w4.i64(o),
                     )
                 else:
-                    w3.i64(ts if err == kp.NONE else -1).i64(off)
+                    w3.i64(found_ts).i64(off)
                     if v >= 4:
                         w3.i32(-1)  # leader_epoch
 
@@ -728,11 +735,12 @@ class KafkaGateway:
                     r.i32()  # committed_leader_epoch
                 if v == 1:
                     r.i64()  # commit timestamp
-                r.nullable_string()  # metadata
+                metadata = r.nullable_string() or ""
                 known = 0 <= part < max(self._partitions(topic), 0)
                 if known:
                     self.broker.commit_offset(
-                        NAMESPACE, topic, part, group, offset
+                        NAMESPACE, topic, part, group, offset,
+                        metadata=metadata,
                     )
                     parts.append((part, kp.NONE))
                 else:
@@ -771,11 +779,14 @@ class KafkaGateway:
             ww.string(name)
 
             def part_entry(w3: Writer, part: int):
-                off = self.broker.fetch_offset(NAMESPACE, name, part, group)
+                off, meta = self.broker.fetch_offset_meta(
+                    NAMESPACE, name, part, group
+                )
                 w3.i32(part).i64(off)
                 if v >= 5:
                     w3.i32(-1)  # committed_leader_epoch
-                w3.nullable_string(None).i16(kp.NONE)
+                # committed metadata round-trips (null when none)
+                w3.nullable_string(meta or None).i16(kp.NONE)
 
             ww.array(parts, part_entry)
 
@@ -805,7 +816,7 @@ class KafkaGateway:
         g = self.coordinator.group(group_id)
         resp = g.join(
             member_id,
-            client_id="",
+            client_id=getattr(self._tl, "client_id", ""),
             protocol_type=protocol_type,
             protocols=protocols,
             session_timeout=max(session_timeout, 1.0),
@@ -975,19 +986,20 @@ def _valid_topic(name: str) -> bool:
     )
 
 
-def _offset_for_time(plog, ts_ms: int, scan_limit: int = 10_000) -> int:
-    """First offset whose timestamp >= ts_ms (bounded scan), -1 when
-    nothing qualifies."""
+def _offset_for_time(plog, ts_ms: int, scan_limit: int = 10_000) -> tuple[int, int]:
+    """(first offset whose timestamp >= ts_ms, that record's
+    timestamp ms) via bounded scan; (-1, -1) when nothing qualifies —
+    the pair the ListOffsets v1+ response reports."""
     ts_ns = ts_ms * 1_000_000
     off = plog.earliest_offset
     scanned = 0
     while scanned < scan_limit:
         recs = plog.read_from(off, max_records=1024)
         if not recs:
-            return -1
+            return -1, -1
         for o, rts, _k, _v in recs:
             if rts >= ts_ns:
-                return o
+                return o, rts // 1_000_000
         scanned += len(recs)
         off = recs[-1][0] + 1
-    return -1
+    return -1, -1

@@ -586,10 +586,13 @@ class VolumeService:
                 since,
                 request.idle_timeout_seconds or 3,
             ):
-                if n.is_tombstone:
-                    # propagate the SOURCE's tombstone bytes verbatim
-                    # (the 0x40 flag travels inside the record, so an
-                    # empty-body PUT is never misread as a delete)
+                if n.is_tombstone or (
+                    not n.data and not n.flags and n.cookie == 0
+                ):
+                    # propagate the SOURCE's tombstone bytes verbatim:
+                    # the 0x40 flag marks new-format tombstones; the
+                    # flagless empty-record form is the legacy marker
+                    # (same compat the offline tools keep)
                     v.delete_needle(n.needle_id, tombstone=n)
                 else:
                     v.write_needle(n)  # append_at_ns preserved -> same bytes

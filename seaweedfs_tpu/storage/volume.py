@@ -24,7 +24,7 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
-from .needle import CURRENT_VERSION, Needle, footer_size
+from .needle import CURRENT_VERSION, FLAG_IS_TOMBSTONE, Needle, footer_size
 from .ttl import TTL
 from .needle_map import MemoryNeedleMap
 from .super_block import SUPER_BLOCK_SIZE, ReplicaPlacement, SuperBlock
@@ -299,8 +299,6 @@ class Volume:
             nv = self.needle_map.get(needle_id)
             if nv is None or nv.is_deleted:
                 return 0
-            from .needle import FLAG_IS_TOMBSTONE
-
             tomb = tombstone or Needle(cookie=0, needle_id=needle_id)
             tomb.flags |= FLAG_IS_TOMBSTONE
             raw = tomb.to_bytes(self.version)
