@@ -37,6 +37,28 @@ log = logger("raft")
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
 
 
+class TransportError(Exception):
+    """A peer RPC failed (network, timeout, dead peer). The raft code
+    treats it exactly like the gRPC error it wraps; the injectable
+    fault transport in tests raises it directly."""
+
+
+class GrpcTransport:
+    """Default peer transport: gRPC to host:port+10000 (the service
+    port convention every component uses)."""
+
+    def __init__(self, node: "RaftNode"):
+        self._node = node
+
+    def call(self, peer: str, method: str, request, timeout: float):
+        try:
+            return getattr(self._node._peer_stub(peer), method)(
+                request, timeout=timeout
+            )
+        except grpc.RpcError as e:
+            raise TransportError(str(e)) from None
+
+
 class RaftNode:
     """One master's raft participant.
 
@@ -57,7 +79,12 @@ class RaftNode:
         snapshot_fn=None,
         restore_fn=None,
         compact_threshold: int = 1024,
+        transport_factory=None,
     ):
+        """transport_factory(node) -> object with
+        call(peer, method, request, timeout); None = gRPC. The seam the
+        deterministic fault harness injects drops/delays/partitions
+        through (tests/raft_sim.py)."""
         self.node_id = node_id
         self.peers = [p for p in peers if p != node_id]
         self.apply_fn = apply_fn or (lambda kind, value: 0)
@@ -109,6 +136,9 @@ class RaftNode:
         self._last_broadcast = 0.0
         self._repl_inflight: set[str] = set()
         self._channels: dict[str, grpc.Channel] = {}
+        self.transport = (
+            transport_factory(self) if transport_factory else GrpcTransport(self)
+        )
         self._threads: list[threading.Thread] = []
         # hook(leader_addr) fired whenever the known leader changes
         # (election won, or a valid leader's first append) — the master
@@ -346,28 +376,46 @@ class RaftNode:
         )
         lock = threading.Lock()
         done = threading.Event()
+        answered = 0
 
         def ask(peer: str):
-            nonlocal votes
+            nonlocal votes, answered
+            granted = False
+            resp = None
             try:
-                resp = self._peer_stub(peer).RaftRequestVote(req, timeout=2)
-            except grpc.RpcError:
-                return
-            with self._lock:
-                if resp.term > self.current_term:
-                    self._step_down_locked(resp.term)
-                    done.set()
-                    return
-                if (
-                    resp.granted
-                    and self.role == CANDIDATE
-                    and self.current_term == term
-                ):
-                    with lock:
-                        votes += 1
-                        if votes > (len(self.peers) + 1) // 2:
-                            self._become_leader_locked()
-                            done.set()
+                resp = self.transport.call(peer, "RaftRequestVote", req, 2)
+            except TransportError:
+                pass
+            if resp is not None:
+                with self._lock:
+                    if resp.term > self.current_term:
+                        self._step_down_locked(resp.term)
+                        done.set()
+                        return
+                    granted = bool(
+                        resp.granted
+                        and self.role == CANDIDATE
+                        and self.current_term == term
+                    )
+            with lock:
+                answered += 1
+                if granted:
+                    votes += 1
+                all_in = answered == len(self.peers)
+                won = votes > (len(self.peers) + 1) // 2
+            if won:
+                with self._lock:
+                    if self.role == CANDIDATE and self.current_term == term:
+                        self._become_leader_locked()
+                done.set()
+            elif all_in:
+                # Every reply (or failure) is in and there is no
+                # majority: conclude NOW. Blocking the full RPC timeout
+                # here re-synchronizes split-vote candidates — with
+                # fast-failing peers both retry on the same 2s beat and
+                # can split forever; an instant exit lets the
+                # randomized election timeout actually desynchronize.
+                done.set()
 
         threads = [
             threading.Thread(target=ask, args=(p,), daemon=True)
@@ -594,10 +642,10 @@ class RaftNode:
                 )
         if snap_req is not None:
             try:
-                sresp = self._peer_stub(peer).RaftInstallSnapshot(
-                    snap_req, timeout=5
+                sresp = self.transport.call(
+                    peer, "RaftInstallSnapshot", snap_req, 5
                 )
-            except grpc.RpcError:
+            except TransportError:
                 return
             with self._lock:
                 if sresp.term > self.current_term:
@@ -607,8 +655,8 @@ class RaftNode:
                     self._next_index[peer] = snap_req.last_included_index + 1
             return
         try:
-            resp = self._peer_stub(peer).RaftAppendEntries(req, timeout=2)
-        except grpc.RpcError:
+            resp = self.transport.call(peer, "RaftAppendEntries", req, 2)
+        except TransportError:
             return
         with self._lock:
             if resp.term > self.current_term:

@@ -586,13 +586,12 @@ class VolumeService:
                 since,
                 request.idle_timeout_seconds or 3,
             ):
-                if n.is_tombstone or (
-                    not n.data and not n.flags and n.cookie == 0
-                ):
+                if n.is_tombstone or (not n.data and not n.flags):
                     # propagate the SOURCE's tombstone bytes verbatim:
-                    # the 0x40 flag marks new-format tombstones; the
-                    # flagless empty-record form is the legacy marker
-                    # (same compat the offline tools keep)
+                    # the 0x40 flag marks new-format tombstones; a
+                    # flagless EMPTY-BODY record is the legacy marker
+                    # regardless of cookie (the same body_size==0 rule
+                    # the offline fix/export tools apply)
                     v.delete_needle(n.needle_id, tombstone=n)
                 else:
                     v.write_needle(n)  # append_at_ns preserved -> same bytes

@@ -1,0 +1,244 @@
+"""Deterministic in-process raft fault harness.
+
+Reference model: test/multi_master/failover_test.go drives real
+processes; this harness goes further the simulation-testing way — N
+RaftNodes in one process wired through an injectable transport that can
+drop, delay, duplicate, and partition RPCs under a SEEDED RNG, plus
+crash (drop volatile state, keep the persisted journal) and restart any
+node. Invariants are checked structurally (election safety, log
+matching, applied-prefix consistency) rather than by sleeping and
+hoping.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+
+from seaweedfs_tpu.server import raft as R
+from seaweedfs_tpu.server.raft import TransportError
+
+
+class SimTransport:
+    def __init__(self, net: "SimNet", src: str):
+        self.net = net
+        self.src = src
+
+    def call(self, peer: str, method: str, request, timeout: float):
+        return self.net.deliver(self.src, peer, method, request)
+
+
+class SimNet:
+    """Shared fault fabric. All knobs are live; the RNG is seeded so a
+    failing schedule replays exactly."""
+
+    def __init__(self, seed: int = 0):
+        self.rng = random.Random(seed)
+        self.lock = threading.Lock()
+        self.nodes: dict[str, R.RaftNode] = {}
+        self.drop = 0.0  # per-message loss probability (each direction)
+        self.dup = 0.0  # duplicate-delivery probability
+        self.delay = (0.0, 0.0)  # uniform seconds before delivery
+        self.cut: set[frozenset] = set()  # partitioned pairs
+        self.down: set[str] = set()  # crashed nodes
+        self.delivered = 0
+
+    # ------------------------------------------------------------ faults
+
+    def partition(self, *groups: list[str]) -> None:
+        """Cut every link between nodes of different groups."""
+        with self.lock:
+            self.cut = {
+                frozenset((a, b))
+                for i, ga in enumerate(groups)
+                for gb in groups[i + 1 :]
+                for a in ga
+                for b in gb
+            }
+
+    def heal(self) -> None:
+        with self.lock:
+            self.cut = set()
+
+    def set_faults(self, drop=None, dup=None, delay=None) -> None:
+        with self.lock:
+            if drop is not None:
+                self.drop = drop
+            if dup is not None:
+                self.dup = dup
+            if delay is not None:
+                self.delay = delay
+
+    # ---------------------------------------------------------- delivery
+
+    def deliver(self, src: str, dst: str, method: str, request):
+        with self.lock:
+            target = self.nodes.get(dst)
+            unreachable = (
+                target is None
+                or src in self.down
+                or dst in self.down
+                or frozenset((src, dst)) in self.cut
+            )
+            drop_req = self.rng.random() < self.drop
+            dup_req = self.rng.random() < self.dup
+            drop_resp = self.rng.random() < self.drop
+            delay = self.rng.uniform(*self.delay) if self.delay[1] else 0.0
+        if unreachable:
+            raise TransportError(f"{src}->{dst} unreachable")
+        if drop_req:
+            raise TransportError(f"{src}->{dst} {method} dropped")
+        if delay:
+            time.sleep(delay)
+        resp = getattr(target, method)(request, None)
+        if dup_req:  # network re-delivery: the handler runs again
+            getattr(target, method)(request, None)
+        with self.lock:
+            self.delivered += 1
+        if drop_resp:
+            raise TransportError(f"{dst}->{src} {method} response lost")
+        return resp
+
+
+class Cluster:
+    """N raft nodes over one SimNet with crash/restart support."""
+
+    def __init__(self, n: int, base_dir: str, seed: int = 0, **node_kw):
+        self.net = SimNet(seed)
+        self.base_dir = base_dir
+        self.ids = [f"n{i}:70{i:02d}" for i in range(n)]
+        self.node_kw = dict(
+            election_timeout=node_kw.pop("election_timeout", (0.15, 0.3)),
+            heartbeat_interval=node_kw.pop("heartbeat_interval", 0.04),
+            **node_kw,
+        )
+        self.applied: dict[str, list] = {i: [] for i in self.ids}
+        # replicated KV state machine: survives crash via the raft
+        # snapshot hooks, so a restarted node's STATE (not its replay
+        # trace) is what convergence checks compare
+        self.state: dict[str, dict] = {i: {} for i in self.ids}
+        self.nodes: dict[str, R.RaftNode] = {}
+        for nid in self.ids:
+            self._spawn(nid)
+
+    def _apply(self, nid: str, kind: str, value: int) -> int:
+        self.applied[nid].append((kind, value))
+        self.state[nid][f"k{value % 16}"] = value
+        return value
+
+    def _spawn(self, nid: str) -> R.RaftNode:
+        d = os.path.join(self.base_dir, nid.replace(":", "_"))
+        os.makedirs(d, exist_ok=True)
+        node = R.RaftNode(
+            nid,
+            [p for p in self.ids if p != nid],
+            d,
+            apply_fn=lambda kind, value, _n=nid: self._apply(_n, kind, value),
+            snapshot_fn=lambda _n=nid: dict(self.state[_n]),
+            restore_fn=lambda st, _n=nid: self.state.__setitem__(_n, dict(st)),
+            transport_factory=lambda n: SimTransport(self.net, n.node_id),
+            **self.node_kw,
+        )
+        self.nodes[nid] = node
+        self.net.nodes[nid] = node
+        node.start()
+        return node
+
+    # ------------------------------------------------------------- admin
+
+    def crash(self, nid: str) -> None:
+        """SIGKILL model: stop threads, drop the object, keep disk."""
+        with self.net.lock:
+            self.net.down.add(nid)
+        node = self.nodes.pop(nid)
+        self.net.nodes.pop(nid, None)
+        node.stop()
+
+    def restart(self, nid: str) -> R.RaftNode:
+        # volatile trace resets; the KV state rebuilds from snapshot +
+        # journal replay on boot
+        self.applied[nid] = []
+        self.state[nid] = {}
+        node = self._spawn(nid)
+        with self.net.lock:
+            self.net.down.discard(nid)
+        return node
+
+    def stop(self) -> None:
+        for node in list(self.nodes.values()):
+            node.stop()
+
+    # --------------------------------------------------------- inspection
+
+    def leaders(self) -> list[R.RaftNode]:
+        return [n for n in self.nodes.values() if n.role == R.LEADER]
+
+    def wait_leader(self, timeout: float = 10.0) -> R.RaftNode:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            up = [n for n in self.nodes.values()]
+            ls = [n for n in up if n.role == R.LEADER]
+            # a REAL leader must be able to commit: its term must be
+            # the max visible term (a deposed leader in a minority
+            # partition can linger at a stale term)
+            if ls:
+                maxterm = max(n.current_term for n in up)
+                live = [l for l in ls if l.current_term == maxterm]
+                if len(live) == 1:
+                    return live[0]
+            time.sleep(0.02)
+        raise TimeoutError("no settled leader")
+
+    # --------------------------------------------------------- invariants
+
+    def check_election_safety(self) -> None:
+        """At most one leader per term — (role, term) snapshotted under
+        each node's lock so a step-down between attribute reads cannot
+        mis-attribute a leader to a stale term."""
+        by_term: dict[int, list[str]] = {}
+        for n in self.nodes.values():
+            with n._lock:
+                role, term = n.role, n.current_term
+            if role == R.LEADER:
+                by_term.setdefault(term, []).append(n.node_id)
+        for term, who in by_term.items():
+            assert len(who) == 1, f"two leaders in term {term}: {who}"
+
+    def check_log_matching(self) -> None:
+        """Committed prefixes agree pairwise (Raft Log Matching): for
+        every pair, entries up to min(commit) are identical."""
+        nodes = list(self.nodes.values())
+        for a in nodes:
+            for b in nodes:
+                if a.node_id >= b.node_id:
+                    continue
+                upto = min(a.commit_index, b.commit_index)
+                for idx in range(
+                    max(a.snap_index, b.snap_index) + 1, upto + 1
+                ):
+                    ea, eb = a._entry_at(idx), b._entry_at(idx)
+                    assert (ea.term, ea.kind, ea.value) == (
+                        eb.term, eb.kind, eb.value,
+                    ), (
+                        f"log mismatch at {idx}: "
+                        f"{a.node_id}={ea} {b.node_id}={eb}"
+                    )
+
+    def check_applied_prefix(self, expect: list | None = None) -> None:
+        """Every node's applied sequence is a prefix of the longest one
+        (no divergence, no reordering, no duplication)."""
+        seqs = {
+            nid: [v for k, v in ops if k == "op"]
+            for nid, ops in self.applied.items()
+            if nid in self.nodes
+        }
+        longest = max(seqs.values(), key=len, default=[])
+        for nid, seq in seqs.items():
+            assert seq == longest[: len(seq)], (
+                f"{nid} applied {seq[:20]}... not a prefix of "
+                f"{longest[:20]}..."
+            )
+        if expect is not None:
+            assert longest == expect, (longest[:20], expect[:20])

@@ -40,7 +40,11 @@ def _start_group(tmp_path, n=3):
     return masters, peers
 
 
-def _wait_leader(masters, timeout=30.0, exclude=()):
+# Leader waits back to single-digit seconds (round-4 verdict): the
+# timing-sensitivity that needed 30s lives in the deterministic fault
+# harness now (test_raft_faults.py); these spawned-process tests only
+# need a normal election round plus CI scheduling slack.
+def _wait_leader(masters, timeout=10.0, exclude=()):
     deadline = time.time() + timeout
     while time.time() < deadline:
         leaders = [m for m in masters if m.is_leader and m not in exclude]
@@ -103,7 +107,7 @@ def test_leader_failover_and_no_id_reuse(group):
     before = [leader._alloc_volume_id() for _ in range(3)]
     leader.stop()
     survivors = [m for m in masters if m is not leader]
-    new_leader = _wait_leader(survivors, timeout=30)
+    new_leader = _wait_leader(survivors, timeout=10)
     after = [new_leader._alloc_volume_id() for _ in range(3)]
     assert min(after) > max(before), f"id reuse after failover: {before} {after}"
 
@@ -131,7 +135,7 @@ def test_restart_preserves_allocation_state(tmp_path):
         m.start()
         masters2.append(m)
     try:
-        leader2 = _wait_leader(masters2, timeout=30)
+        leader2 = _wait_leader(masters2, timeout=10)
         fresh = leader2._alloc_volume_id()
         assert fresh > max(issued), f"volume id reused after restart: {fresh} <= {max(issued)}"
     finally:
@@ -169,8 +173,9 @@ def test_keepconnected_session_and_failover(group, tmp_path):
     vs.start()
     mc = MasterClient(",".join(peers))
     try:
-        # volume server finds the leader and registers
-        deadline = time.time() + 30
+        # volume server finds the leader and registers (slack is for
+        # full-suite CPU starvation of the spawned threads, not raft)
+        deadline = time.time() + 20
         while time.time() < deadline and not leader.topo.nodes:
             time.sleep(0.05)
         assert leader.topo.nodes, "volume server never registered with leader"
@@ -179,7 +184,7 @@ def test_keepconnected_session_and_failover(group, tmp_path):
         vid = int(r.fid.split(",")[0])
         # the streaming session learns the new volume's location
         # (generous: full-suite runs contend heavily for CPU)
-        deadline = time.time() + 30
+        deadline = time.time() + 20
         locs = []
         while time.time() < deadline:
             if mc._synced.is_set():
@@ -194,8 +199,8 @@ def test_keepconnected_session_and_failover(group, tmp_path):
         # kill the leader: assigns keep working via the new leader
         leader.stop()
         survivors = [m for m in masters if m is not leader]
-        _wait_leader(survivors, timeout=30)
-        deadline = time.time() + 30
+        _wait_leader(survivors, timeout=10)
+        deadline = time.time() + 10
         last = None
         while time.time() < deadline:
             try:
@@ -243,23 +248,23 @@ def test_membership_grow_1_to_3_and_failover(tmp_path):
             m.start()
             masters.append(m)
             members = _wait_leader(
-                masters, timeout=30, exclude=masters[1:]
+                masters, timeout=10, exclude=masters[1:]
             ).raft.add_server(addrs[i])
             assert addrs[i] in members
             # the joiner converges (gets the log/snapshot)
-            deadline = time.time() + 30
+            deadline = time.time() + 10
             while time.time() < deadline:
                 if m.raft.last_applied >= masters[0].raft.last_applied:
                     break
                 time.sleep(0.05)
 
-        leader = _wait_leader(masters, timeout=30)
+        leader = _wait_leader(masters, timeout=10)
         assert sorted({leader.raft.node_id, *leader.raft.peers}) == sorted(addrs)
 
         # kill the leader: the grown group elects a new one, ids monotonic
         leader.stop()
         rest = [m for m in masters if m is not leader]
-        new_leader = _wait_leader(rest, timeout=30)
+        new_leader = _wait_leader(rest, timeout=10)
         nid = new_leader.raft.propose("alloc_volume_id", 0)
         assert nid > max(ids)
     finally:

@@ -1,0 +1,233 @@
+"""Raft under deterministic injected faults (drops, dups, delays,
+partitions, crashes, torn journal tails).
+
+Replaces sleep-and-hope timing tests: the SimNet transport
+(tests/raft_sim.py) is seeded, every schedule is replayable, and the
+assertions are the Raft paper's invariants — election safety, log
+matching, applied-prefix consistency, state convergence — checked
+structurally. Reference analog: test/multi_master/failover_test.go
+(which drives real processes; this goes further with fault injection
+no real network can do deterministically).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+
+import pytest
+
+from raft_sim import Cluster
+from seaweedfs_tpu.server import raft as R
+
+
+def _propose_retry(c: Cluster, value: int, deadline_s: float = 20.0) -> None:
+    """Client model: retry until SOME leader acks. A timed-out commit
+    may still have landed, so the op may apply more than once — the
+    invariants below must hold regardless (at-least-once client)."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            leader = c.wait_leader(timeout=deadline - time.monotonic())
+            leader.propose("op", value=value, timeout=2.0)
+            return
+        except (R.NotLeader, TimeoutError):
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.05)
+
+
+def _wait_quiescent(c: Cluster, timeout: float = 15.0) -> None:
+    """Wait until every live node has applied the leader's commit."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        leader = None
+        try:
+            leader = c.wait_leader(timeout=2.0)
+        except TimeoutError:
+            continue
+        target = leader.commit_index
+        if all(n.last_applied >= target for n in c.nodes.values()):
+            return
+        time.sleep(0.02)
+    raise TimeoutError("cluster never quiesced")
+
+
+def _check_all(c: Cluster) -> None:
+    c.check_election_safety()
+    c.check_log_matching()
+    c.check_applied_prefix()
+
+
+def test_fault_free_baseline(tmp_path):
+    c = Cluster(3, str(tmp_path), seed=1)
+    try:
+        for i in range(50):
+            _propose_retry(c, i)
+        _wait_quiescent(c)
+        _check_all(c)
+        states = {n: dict(c.state[n]) for n in c.nodes}
+        assert len({tuple(sorted(s.items())) for s in states.values()}) == 1
+    finally:
+        c.stop()
+
+
+def test_loss_dup_delay_convergence(tmp_path):
+    """20% loss each direction + 10% duplicate delivery + up to 5 ms
+    delay: progress continues and no replica diverges."""
+    c = Cluster(3, str(tmp_path), seed=2)
+    try:
+        c.net.set_faults(drop=0.2, dup=0.1, delay=(0.0, 0.005))
+        for i in range(60):
+            _propose_retry(c, i)
+            if i % 20 == 19:
+                _check_all(c)
+        c.net.set_faults(drop=0.0, dup=0.0, delay=(0.0, 0.0))
+        _wait_quiescent(c)
+        _check_all(c)
+        # every op committed at least once, order-consistent
+        longest = max(
+            ([v for k, v in c.applied[n] if k == "op"] for n in c.nodes),
+            key=len,
+        )
+        assert set(longest) == set(range(60))
+    finally:
+        c.stop()
+
+
+def test_minority_partition_cannot_commit(tmp_path):
+    c = Cluster(3, str(tmp_path), seed=3)
+    try:
+        for i in range(5):
+            _propose_retry(c, i)
+        _wait_quiescent(c)
+        old = c.wait_leader()
+        minority = [old.node_id]
+        majority = [n for n in c.ids if n != old.node_id]
+        c.net.partition(minority, majority)
+        # the stranded leader must not commit anything new
+        with pytest.raises(TimeoutError):
+            old.propose("op", value=999, timeout=1.0)
+        # the majority elects and commits
+        deadline = time.monotonic() + 10
+        new = None
+        while time.monotonic() < deadline:
+            cand = [
+                c.nodes[n] for n in majority
+                if c.nodes[n].role == R.LEADER
+            ]
+            if cand:
+                new = cand[0]
+                break
+            time.sleep(0.02)
+        assert new is not None, "majority never elected"
+        new.propose("op", value=100, timeout=5.0)
+        assert 999 not in {v for _k, v in c.applied[new.node_id]}
+        c.net.heal()
+        _wait_quiescent(c)
+        _check_all(c)
+        # the uncommitted minority entry is gone everywhere
+        for n in c.nodes:
+            assert 999 not in {v for _k, v in c.applied[n]}
+            assert 100 in {v for _k, v in c.applied[n]}
+    finally:
+        c.stop()
+
+
+def test_torn_journal_tail_recovery(tmp_path):
+    """SIGKILL mid-journal-write: the node restarts off the intact
+    prefix and reconverges with the cluster."""
+    c = Cluster(3, str(tmp_path), seed=4)
+    try:
+        for i in range(20):
+            _propose_retry(c, i)
+        _wait_quiescent(c)
+        victim = next(
+            n for n in c.ids if c.nodes[n].role != R.LEADER
+        )
+        c.crash(victim)
+        path = os.path.join(
+            str(tmp_path), victim.replace(":", "_"), "raft.jsonl"
+        )
+        with open(path, "r+b") as f:
+            f.truncate(max(os.path.getsize(path) - 7, 0))  # torn record
+        for i in range(20, 30):
+            _propose_retry(c, i)
+        c.restart(victim)
+        _wait_quiescent(c)
+        _check_all(c)
+        assert {v for k, v in c.applied[victim] if k == "op"} >= set(
+            range(20, 30)
+        )
+    finally:
+        c.stop()
+
+
+def test_randomized_fault_schedule(tmp_path):
+    """Seeded random schedule of proposals, partitions, crashes,
+    restarts, and loss bursts; invariants checked after every fault
+    event and at quiescence. RAFT_SIM_STEPS scales it up for soak
+    runs (default keeps CI fast)."""
+    steps = int(os.environ.get("RAFT_SIM_STEPS", "120"))
+    rng = random.Random(0xC0FFEE)
+    c = Cluster(3, str(tmp_path), seed=5)
+    down: list[str] = []
+    val = 0
+    acked: set[int] = set()
+    try:
+        for step in range(steps):
+            roll = rng.random()
+            if roll < 0.70:
+                # at-least-once client: raft promises SAFETY under any
+                # fault mix; liveness only under eventually-calm nets —
+                # so a timed-out proposal is recorded as un-acked, not
+                # treated as a harness failure
+                try:
+                    _propose_retry(c, val, deadline_s=6.0)
+                    acked.add(val)
+                except (TimeoutError, R.NotLeader):
+                    pass
+                val += 1
+            elif roll < 0.78 and not down:
+                groups = list(c.ids)
+                rng.shuffle(groups)
+                c.net.partition([groups[0]], groups[1:])
+            elif roll < 0.84:
+                c.net.heal()
+            elif roll < 0.90 and len(c.nodes) == 3:
+                victim = rng.choice(list(c.nodes))
+                c.net.heal()  # crash+partition together can lose quorum
+                c.crash(victim)
+                down.append(victim)
+            elif roll < 0.96 and down:
+                c.restart(down.pop())
+            else:
+                burst = rng.choice([0.0, 0.1, 0.25])
+                c.net.set_faults(drop=burst, dup=burst / 2)
+            if step % 10 == 9:
+                c.check_election_safety()
+                c.check_log_matching()
+        # settle: heal everything, bring every node back
+        c.net.set_faults(drop=0.0, dup=0.0, delay=(0.0, 0.0))
+        c.net.heal()
+        while down:
+            c.restart(down.pop())
+        _propose_retry(c, val)
+        acked.add(val)
+        _wait_quiescent(c, timeout=30.0)
+        c.check_election_safety()
+        c.check_log_matching()
+        # all live nodes reached identical state machines
+        states = {
+            tuple(sorted(c.state[n].items())) for n in c.nodes
+        }
+        assert len(states) == 1, "replicas diverged"
+        # every ACKED proposal survives (at-least-once, one order)
+        leader = c.wait_leader()
+        ops = {v for k, v in c.applied[leader.node_id] if k == "op"}
+        missing = acked - ops
+        assert not missing, f"acked ops lost: {sorted(missing)[:10]}"
+        assert len(acked) >= steps * 0.3, "schedule barely made progress"
+    finally:
+        c.stop()
