@@ -135,6 +135,24 @@ class FilerGrpcService:
             self.filer.store.kv_delete(bytes(request.key))
         return fpb.FilerOpResponse()
 
+    def RunLifecycle(self, request, context):
+        """Apply stored S3 lifecycle rules here, where the metadata
+        lives — the execution half of the worker fleet's s3_lifecycle
+        task kind (reference weed/worker/tasks registry)."""
+        from ..s3.lifecycle import LifecycleScanner
+
+        try:
+            stats = LifecycleScanner(self.filer).run_once(
+                bucket=request.bucket
+            )
+        except Exception as e:  # noqa: BLE001 — surfaced to the worker
+            return fpb.LifecycleRunResponse(error=str(e))
+        return fpb.LifecycleRunResponse(
+            expired=stats.get("expired", 0),
+            noncurrent_expired=stats.get("noncurrent_expired", 0),
+            aborted_uploads=stats.get("aborted_uploads", 0),
+        )
+
     def HardLink(self, request, context):
         """Create another name for src's content (reference
         filer_hardlink.go); FUSE link() rides this. Error strings are

@@ -102,6 +102,7 @@ SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
         "LockRange": (UNARY, fpb.LockRangeRequest, fpb.LockRangeResponse),
         "HardLink": (UNARY, fpb.HardLinkRequest, fpb.FilerOpResponse),
         "DistributedLock": (UNARY, fpb.DlmRequest, fpb.DlmResponse),
+        "RunLifecycle": (UNARY, fpb.LifecycleRunRequest, fpb.LifecycleRunResponse),
     },
     WORKER_SERVICE: {
         "WorkerStream": (BIDI, wk.WorkerMessage, wk.ServerMessage),

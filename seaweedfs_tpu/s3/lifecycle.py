@@ -122,8 +122,9 @@ class LifecycleScanner:
 
     # ------------------------------------------------------------ actions
 
-    def run_once(self, now: float | None = None) -> dict:
-        """One scan of every bucket with rules; returns counters."""
+    def run_once(self, now: float | None = None, bucket: str = "") -> dict:
+        """One scan of every bucket with rules (or just `bucket`);
+        returns counters."""
         now = time.time() if now is None else now
         stats = {"expired": 0, "noncurrent_expired": 0, "aborted_uploads": 0}
         try:
@@ -131,6 +132,7 @@ class LifecycleScanner:
                 e.name
                 for e in self.filer.list_entries(BUCKETS_ROOT, limit=10_000)
                 if e.is_directory and e.name != UPLOADS_DIR
+                and (not bucket or e.name == bucket)
             ]
         except NotFound:
             return stats

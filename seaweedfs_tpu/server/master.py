@@ -513,6 +513,10 @@ class MasterServer:
         self.vacuum_interval = vacuum_interval
         self.ec_auto_fullness = ec_auto_fullness
         self.ec_quiet_seconds = ec_quiet_seconds
+        self.balance_spread = 0.0  # 0 = auto-balance scanner off
+        self.lifecycle_interval = 0.0  # 0 = lifecycle sweeps off
+        self.lifecycle_filer = ""
+        self._lifecycle_last = 0.0
         self._vacuum_stop = threading.Event()
         self._vacuum_thread = threading.Thread(
             target=self._vacuum_loop, daemon=True
@@ -777,6 +781,9 @@ class MasterServer:
             "ec_quiet_seconds": self.ec_quiet_seconds,
             "garbage_threshold": self.garbage_threshold,
             "vacuum_interval_seconds": self.vacuum_interval,
+            "balance_spread": self.balance_spread,
+            "lifecycle_interval_seconds": self.lifecycle_interval,
+            "lifecycle_filer": self.lifecycle_filer,
         }
 
     def _apply_maintenance_config(self, cfg: dict) -> None:
@@ -793,6 +800,8 @@ class MasterServer:
             "ec_quiet_seconds",
             "garbage_threshold",
             "vacuum_interval_seconds",
+            "balance_spread",
+            "lifecycle_interval_seconds",
         ):
             if not math.isfinite(cfg.get(key, 0.0)):
                 raise ValueError(f"{key} must be finite, got {cfg.get(key)}")
@@ -811,10 +820,20 @@ class MasterServer:
                 "ec_quiet_seconds must be >=0 and "
                 f"vacuum_interval_seconds >0 (got {quiet}, {interval})"
             )
+        spread = cfg.get("balance_spread", 0.0)
+        lc_interval = cfg.get("lifecycle_interval_seconds", 0.0)
+        if spread < 0 or lc_interval < 0:
+            raise ValueError(
+                "balance_spread and lifecycle_interval_seconds must be "
+                f">=0 (got {spread}, {lc_interval})"
+            )
         self.ec_auto_fullness = full
         self.ec_quiet_seconds = quiet
         self.garbage_threshold = thresh
         self.vacuum_interval = interval
+        self.balance_spread = spread
+        self.lifecycle_interval = lc_interval
+        self.lifecycle_filer = str(cfg.get("lifecycle_filer", "") or "")
 
     # ----------------------------------------------------------- vacuum
 
@@ -833,6 +852,17 @@ class MasterServer:
                     self.topo.volume_size_limit,
                     quiet_seconds=self.ec_quiet_seconds,
                 )
+            if self.balance_spread > 0:
+                self.worker_control.scan_for_balance_candidates(
+                    self.topo, int(self.balance_spread)
+                )
+            if self.lifecycle_interval > 0 and self.lifecycle_filer:
+                now = time.time()
+                if now - self._lifecycle_last >= self.lifecycle_interval:
+                    self._lifecycle_last = now
+                    self.worker_control.scan_for_lifecycle(
+                        self.lifecycle_filer
+                    )
 
     def vacuum_once(self) -> list[int]:
         vacuumed = []

@@ -586,12 +586,15 @@ class VolumeService:
                 since,
                 request.idle_timeout_seconds or 3,
             ):
-                if n.is_tombstone or (not n.data and not n.flags):
-                    # propagate the SOURCE's tombstone bytes verbatim:
-                    # the 0x40 flag marks new-format tombstones; a
-                    # flagless EMPTY-BODY record is the legacy marker
-                    # regardless of cookie (the same body_size==0 rule
-                    # the offline fix/export tools apply)
+                if n.is_tombstone or (
+                    not n.data and not n.flags and n.cookie == 0
+                ):
+                    # propagate the SOURCE's tombstone bytes verbatim.
+                    # The 0x40 flag marks new-format tombstones; the
+                    # legacy marker this codebase ever wrote is exactly
+                    # Needle(cookie=0, data=b'') — an empty-body put
+                    # with a NONZERO cookie is legitimate data and must
+                    # replicate as a put, not a delete.
                     v.delete_needle(n.needle_id, tombstone=n)
                 else:
                     v.write_needle(n)  # append_at_ns preserved -> same bytes

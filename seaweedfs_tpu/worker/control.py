@@ -48,7 +48,7 @@ class _Task:
     attempts: int = 0
 
 
-KNOWN_KINDS = ("ec_encode", "vacuum")
+KNOWN_KINDS = ("ec_encode", "vacuum", "balance", "s3_lifecycle")
 WORKER_STALE_SECONDS = 30.0
 TASK_RETENTION = 1000  # terminal tasks kept for task.list history
 
@@ -424,6 +424,9 @@ class WorkerControl:
                 "ec_quiet_seconds",
                 "garbage_threshold",
                 "vacuum_interval_seconds",
+                "balance_spread",
+                "lifecycle_interval_seconds",
+                "lifecycle_filer",
             ):
                 if request.HasField(key):
                     cfg[key] = getattr(request, key)
@@ -502,3 +505,58 @@ class WorkerControl:
                 # kill its hosting loop over it
                 continue
         return submitted
+
+    def scan_for_balance_candidates(
+        self, topo, spread: int
+    ) -> list[str]:
+        """Auto-detect imbalance (reference worker balance detection):
+        when the busiest node holds >= `spread` more normal volumes
+        than the idlest, submit ONE move of a volume the idle node does
+        not already replicate. One task per sweep keeps the plane
+        convergent instead of thrashing."""
+        # full snapshot under the lock: heartbeats mutate node.volumes
+        # live, and a KeyError here would kill the hosting scan loop
+        with topo._lock:
+            nodes = [
+                (
+                    f"{n.ip}:{n.grpc_port}",
+                    {vid: v.collection for vid, v in n.volumes.items()},
+                )
+                for n in topo.nodes.values()
+            ]
+        if len(nodes) < 2:
+            return []
+        nodes.sort(key=lambda nv: len(nv[1]))
+        low_addr, low_vols = nodes[0]
+        high_addr, high_vols = nodes[-1]
+        if len(high_vols) - len(low_vols) < max(spread, 1):
+            return []
+        movable = sorted(set(high_vols) - set(low_vols))
+        if not movable:
+            return []
+        vid = movable[0]
+        try:
+            return [
+                self.submit(
+                    "balance",
+                    vid,
+                    high_vols[vid],
+                    params={"source": high_addr, "target": low_addr},
+                )
+            ]
+        except ValueError:
+            return []
+
+    def scan_for_lifecycle(self, filer_addr: str) -> list[str]:
+        """Submit the periodic lifecycle sweep against the configured
+        filer (volume_id 0: the task is filer-scoped)."""
+        if not filer_addr:
+            return []
+        try:
+            return [
+                self.submit(
+                    "s3_lifecycle", 0, params={"filer": filer_addr}
+                )
+            ]
+        except ValueError:
+            return []

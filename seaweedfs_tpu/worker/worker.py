@@ -27,7 +27,7 @@ class Worker:
     def __init__(
         self,
         master: str = "localhost:9333",
-        capabilities: tuple = ("ec_encode", "vacuum"),
+        capabilities: tuple = ("ec_encode", "vacuum", "balance", "s3_lifecycle"),
         backend: str = "auto",
         max_concurrent: int = 2,
         worker_id: str = "",
@@ -69,6 +69,46 @@ class Worker:
                         min=0.0,
                         max=1.0,
                     )
+                ],
+            ),
+            wk.TaskDescriptor(
+                kind="balance",
+                display_name="Volume balance",
+                description="move one volume replica between nodes "
+                "(readonly -> copy -> delete at source)",
+                fields=[
+                    wk.ConfigField(
+                        name="source",
+                        type="string",
+                        default="",
+                        help="grpc host:port of the replica to move",
+                    ),
+                    wk.ConfigField(
+                        name="target",
+                        type="string",
+                        default="",
+                        help="grpc host:port of the receiving node",
+                    ),
+                ],
+            ),
+            wk.TaskDescriptor(
+                kind="s3_lifecycle",
+                display_name="S3 lifecycle sweep",
+                description="apply bucket lifecycle rules (expiration, "
+                "noncurrent cleanup, upload aborts) on a filer",
+                fields=[
+                    wk.ConfigField(
+                        name="filer",
+                        type="string",
+                        default="",
+                        help="grpc host:port of the filer to sweep",
+                    ),
+                    wk.ConfigField(
+                        name="bucket",
+                        type="string",
+                        default="",
+                        help="single bucket to sweep (empty = all)",
+                    ),
                 ],
             ),
         ]
@@ -147,6 +187,10 @@ class Worker:
                 self._task_ec_encode(assign)
             elif assign.kind == "vacuum":
                 self._task_vacuum(assign)
+            elif assign.kind == "balance":
+                self._task_balance(assign)
+            elif assign.kind == "s3_lifecycle":
+                self._task_s3_lifecycle(assign)
             else:
                 raise RuntimeError(f"unknown task kind {assign.kind}")
             self._report(assign.task_id, "done", 1.0)
@@ -204,6 +248,61 @@ class Worker:
         finally:
             for _, ch, _ in holders:
                 ch.close()
+
+    def _task_balance(self, assign: wk.TaskAssign) -> None:
+        """Move one replica: readonly at source -> VolumeCopy into the
+        target -> delete at source (reference worker balance task /
+        shell volume.move). A failed copy restores the source
+        writable so the move never strands the volume."""
+        vid = assign.volume_id
+        source = assign.params.get("source", "")
+        target = assign.params.get("target", "")
+        if not source or not target:
+            raise RuntimeError("balance needs source and target params")
+        with grpc.insecure_channel(source) as src_ch:
+            src = rpc.volume_stub(src_ch)
+            src.VolumeMarkReadonly(
+                pb.VolumeCommandRequest(volume_id=vid), timeout=30
+            )
+            self._report(assign.task_id, "running", 0.2)
+            try:
+                with grpc.insecure_channel(target) as dst_ch:
+                    r = rpc.volume_stub(dst_ch).VolumeCopy(
+                        pb.EcShardsCopyRequest(
+                            volume_id=vid,
+                            collection=assign.collection,
+                            source_url=source,
+                        ),
+                        timeout=3600,
+                    )
+                if r.error:
+                    raise RuntimeError(f"copy failed: {r.error}")
+            except Exception:
+                src.VolumeMarkWritable(
+                    pb.VolumeCommandRequest(volume_id=vid), timeout=30
+                )
+                raise
+            self._report(assign.task_id, "running", 0.8)
+            src.VolumeDelete(
+                pb.VolumeCommandRequest(volume_id=vid), timeout=60
+            )
+
+    def _task_s3_lifecycle(self, assign: wk.TaskAssign) -> None:
+        """Delegate the sweep to the filer that owns the metadata."""
+        from ..pb import filer_pb2 as fpb
+
+        filer = assign.params.get("filer", "")
+        if not filer:
+            raise RuntimeError("s3_lifecycle needs a filer param")
+        with grpc.insecure_channel(filer) as ch:
+            r = rpc.filer_stub(ch).RunLifecycle(
+                fpb.LifecycleRunRequest(
+                    bucket=assign.params.get("bucket", "")
+                ),
+                timeout=3600,
+            )
+        if r.error:
+            raise RuntimeError(r.error)
 
     def _task_vacuum(self, assign: wk.TaskAssign) -> None:
         # declarative per-job config: garbage_threshold from the

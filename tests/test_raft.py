@@ -44,7 +44,7 @@ def _start_group(tmp_path, n=3):
 # timing-sensitivity that needed 30s lives in the deterministic fault
 # harness now (test_raft_faults.py); these spawned-process tests only
 # need a normal election round plus CI scheduling slack.
-def _wait_leader(masters, timeout=10.0, exclude=()):
+def _wait_leader(masters, timeout=15.0, exclude=()):
     deadline = time.time() + timeout
     while time.time() < deadline:
         leaders = [m for m in masters if m.is_leader and m not in exclude]
