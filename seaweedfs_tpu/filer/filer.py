@@ -162,7 +162,14 @@ class Filer:
                 raise FilerError(
                     f"{entry.full_path}: type conflict with existing entry"
                 )
+            if old is not None and old.hard_link_id and not entry.hard_link_id:
+                # a content commit through an open handle doesn't know
+                # about the link identity: inherit it, or the write
+                # would silently sever this name from its siblings
+                entry.hard_link_id = old.hard_link_id
+                entry.hard_link_counter = old.hard_link_counter
             self.store.insert(entry)
+            self._hl_publish(entry)
         self._notify(entry.directory, old, entry, ts_ns=ts)
 
     def mutate_entry(self, full_path: str, fn) -> Entry:
@@ -172,7 +179,11 @@ class Filer:
         that would revert a concurrent content overwrite."""
         directory, name = split_path(full_path)
         with self._mutate_lock:
-            entry = self.store.find(directory, name)
+            # overlay FIRST: for a hardlinked name the per-name record
+            # can hold a stale content snapshot (a sibling may have
+            # written since); republishing it via _hl_publish would
+            # revert the sibling's write across every name
+            entry = self._hl_overlay(self.store.find(directory, name))
             old = Entry(
                 directory=entry.directory,
                 name=entry.name,
@@ -185,6 +196,7 @@ class Filer:
             fn(entry)
             ts = self._stamp(entry)
             self.store.update(entry)
+            self._hl_publish(entry)
         self._notify(directory, old, entry, ts_ns=ts)
         return entry
 
@@ -211,13 +223,52 @@ class Filer:
         except NotFound:
             return None
 
+    def _gc_overwritten(self, old: Optional[Entry]) -> None:
+        """Release the entry an overwrite replaced. For a hardlinked
+        name the NAME survives in its link group (create_entry
+        inherited the id and republished hlmeta), so the shared counter
+        must not move — only the superseded shared chunks (resolved by
+        the caller's pre-republish overlay) are freed."""
+        if old is None:
+            return
+        if old.hard_link_id:
+            if old.chunks:
+                self.gc_chunks(old.chunks)
+            return
+        self._release_entry_chunks(old)
+
+    def _hl_publish(self, entry: Entry) -> None:
+        """Hardlinked names share ONE content/attr record — the inode
+        (reference filer_hardlink.go stores it once, keyed by the link
+        id). Every commit through ANY name republishes the shared
+        record so all the other names observe the write."""
+        if entry.hard_link_id:
+            self.store.kv_put(
+                b"hlmeta:" + entry.hard_link_id, entry.to_bytes()
+            )
+
+    def _hl_overlay(self, entry: Entry) -> Entry:
+        """Resolve a hardlinked name against the shared inode record:
+        chunks/content/attrs come from hlmeta; only directory+name are
+        the entry's own."""
+        if not entry.hard_link_id:
+            return entry
+        raw = self.store.kv_get(b"hlmeta:" + entry.hard_link_id)
+        if raw is None:
+            return entry
+        shadow = Entry.from_bytes(entry.directory, raw)
+        entry.chunks = list(shadow.chunks)
+        entry.content = shadow.content
+        entry.attr.CopyFrom(shadow.attr)
+        return entry
+
     def find_entry(self, full_path: str) -> Entry:
         directory, name = split_path(full_path)
         if name == "":
             root = Entry(directory="/", name="", is_directory=True)
             root.attr.file_mode = 0o755
             return root
-        entry = self.store.find(directory, name)
+        entry = self._hl_overlay(self.store.find(directory, name))
         if self._is_expired(entry):
             # read-triggered expiry (reference filer TTL): the name
             # vanishes and its chunks are reclaimed asynchronously
@@ -259,7 +310,7 @@ class Filer:
                 if self._is_expired(e):
                     self.delete_entry(e.full_path)
                     continue
-                yield e
+                yield self._hl_overlay(e)
                 yielded += 1
                 if yielded >= limit:
                     return
@@ -308,6 +359,11 @@ class Filer:
                     self.store.kv_put(key, str(n).encode())
                     return
                 self.store.kv_delete(key)
+                # last name gone: the SHARED record is authoritative
+                # for which chunks the inode holds (a write through a
+                # sibling may have replaced this entry's snapshot)
+                entry = self._hl_overlay(entry)
+                self.store.kv_delete(b"hlmeta:" + entry.hard_link_id)
         if entry.chunks:
             self.gc_chunks(entry.chunks)
 
@@ -344,6 +400,7 @@ class Filer:
                 self.store.kv_put(b"hl:" + src.hard_link_id, b"1")
                 ts_src = self._stamp(src)
                 self.store.update(src)
+                self._hl_publish(src)  # the shared inode record
                 # peers must learn src's hardlink marker or their
                 # delete path would GC the shared chunks
                 notify.append((src_dir, old_src, src, ts_src))
@@ -445,6 +502,12 @@ class Filer:
                 if local is not None and local.is_directory != entry.is_directory:
                     return False  # type conflict: keep local
                 self.store.insert(entry)
+                if entry.hard_link_id:
+                    # replicated hardlink writes must refresh the local
+                    # shared-inode record too, or the overlay would keep
+                    # serving this peer's stale content over the newer
+                    # replicated chunks
+                    self._hl_publish(entry)
                 applied_old, applied_new = local, entry
             elif has_old:
                 local = self._try_find(directory, old_p.name)
@@ -489,6 +552,11 @@ class Filer:
         if old is not None and old.is_directory:
             # fail BEFORE uploading chunks that create_entry would orphan
             raise FilerError(f"{full_path}: type conflict with existing entry")
+        if old is not None and old.hard_link_id:
+            # resolve the SHARED record now (pre-republish): those are
+            # the chunks this overwrite supersedes, not the per-name
+            # snapshot (which may be stale after a sibling's write)
+            self._hl_overlay(old)
         if inline and len(data) <= INLINE_LIMIT:
             entry = new_entry(full_path, mode=mode, mime=mime)
             entry.attr.ttl_sec = ttl_sec
@@ -498,8 +566,7 @@ class Filer:
             entry.attr.file_size = len(data)
             entry.attr.md5 = hashlib.md5(data).digest()
             self.create_entry(entry)
-            if old is not None:
-                self._release_entry_chunks(old)
+            self._gc_overwritten(old)
             return entry
         chunks = []
         ts = time.time_ns()
@@ -535,8 +602,7 @@ class Filer:
             # a losing race still must not leak the uploaded chunks
             self.gc_chunks(chunks)
             raise
-        if old is not None:
-            self._release_entry_chunks(old)
+        self._gc_overwritten(old)
         return entry
 
     def read_file(

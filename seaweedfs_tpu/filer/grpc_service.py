@@ -42,6 +42,7 @@ class FilerGrpcService:
             )
         except NotFound:
             return fpb.LookupEntryResponse(error="not found")
+        e = self.filer._hl_overlay(e)  # shared-inode content/attrs
         proto = e.to_proto()
         if e.hard_link_id:
             # the per-entry counter is a snapshot from link time; the

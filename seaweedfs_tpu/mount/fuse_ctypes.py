@@ -266,7 +266,10 @@ libfuse.fuse_main_real.argtypes = [
 
 def fuse_main(mountpoint: str, ops: FuseOperations, foreground: bool = True) -> int:
     """Run the libfuse main loop (single-threaded: Python callbacks)."""
-    args = [b"seaweedfs_tpu", mountpoint.encode(), b"-s"]
+    # use_ino: the fs supplies st_ino itself so hardlinked names report
+    # ONE inode number (pjdfstest link semantics); without it the
+    # kernel invents a distinct ino per path node.
+    args = [b"seaweedfs_tpu", mountpoint.encode(), b"-s", b"-o", b"use_ino"]
     if foreground:
         args.append(b"-f")
     argv = (ctypes.c_char_p * len(args))(*args)

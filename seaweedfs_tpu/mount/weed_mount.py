@@ -127,6 +127,7 @@ class FilerMount:
                     "gid": a.gid,
                     "symlink": a.symlink_target,
                     "nlink": max(r.entry.hard_link_counter, 1),
+                    "hlid": bytes(r.entry.hard_link_id),
                     "xattrs": {
                         k[len(XATTR_PREFIX) :]: bytes(v)
                         for k, v in r.entry.extended.items()
@@ -256,7 +257,23 @@ class FilerMount:
         s.st_ctim.tv_sec = info["mtime"]
         s.st_blksize = 4096
         s.st_blocks = (s.st_size + 511) // 512
+        s.st_ino = self._ino_for(path, info)
         return 0
+
+    @staticmethod
+    def _ino_for(path: str, info: dict) -> int:
+        """Stable inode number (the fs runs with -o use_ino). Hardlinked
+        names share their hard_link_id-derived ino; everything else
+        hashes its path."""
+        import hashlib
+
+        hlid = info.get("hlid") or b""
+        key = b"hl:" + hlid if hlid else b"p:" + path.encode()
+        # 63 bits: never 0 (0 means "unknown" to the kernel)
+        return (
+            int.from_bytes(hashlib.blake2b(key, digest_size=8).digest(), "big")
+            & 0x7FFFFFFFFFFFFFFF
+        ) or 1
 
     def readdir(self, path: str, buf, filler) -> int:
         info = self._lookup(path)
@@ -309,6 +326,8 @@ class FilerMount:
         return 0
 
     def create(self, path: str, mode: int, fi) -> int:
+        if self._name_too_long(path):
+            return -errno.ENAMETOOLONG
         # mode 0 is a legal create permission; no `or 0o644` coercion
         fi.contents.fh = self._new_fh(
             _Handle(path, 0, base=False, mode=mode & 0o7777)
@@ -569,6 +588,8 @@ class FilerMount:
         return 0 if r.status_code in (200, 204) else -errno.EIO
 
     def mkdir(self, path: str, mode: int) -> int:
+        if self._name_too_long(path):
+            return -errno.ENAMETOOLONG
         # gRPC CreateEntry (not the HTTP ?mkdir) so the requested mode
         # bits persist. CreateEntry upserts, so existence must be
         # checked first (fresh lookup, not the 1s attr cache, whose
@@ -594,9 +615,54 @@ class FilerMount:
             return -errno.ENOTEMPTY
         return 0 if r.status_code in (200, 204) else -errno.EIO
 
+    def _name_too_long(self, path: str) -> bool:
+        """POSIX NAME_MAX (255 bytes per component): the kernel does
+        not enforce f_namemax for FUSE, the fs must."""
+        return any(
+            len(c.encode()) > 255 for c in path.split("/") if c
+        )
+
     def rename(self, old: str, new: str) -> int:
         import urllib.parse
 
+        if self._name_too_long(new):
+            return -errno.ENAMETOOLONG
+        # POSIX target-exists semantics the filer's generic error can't
+        # express: file->dir EISDIR, dir->file ENOTDIR, dir->nonempty
+        # ENOTEMPTY, dir->EMPTY dir replaces. FRESH lookups (not the 1s
+        # attr cache): existence decisions on a stale cache give wrong
+        # verdicts when a peer client mutates the tree.
+        self._flush_open_handle(old)
+
+        def fresh_isdir(path: str):
+            r = self._grpc_lookup(path)
+            return None if r.error else r.entry.is_directory
+
+        oi, ni = fresh_isdir(old), fresh_isdir(new)
+        if oi is None and self._by_path.get(old) is None:
+            return -errno.ENOENT
+        replaced_dir = False
+        if ni is not None and oi is not None:
+            if ni and not oi:
+                return -errno.EISDIR
+            if not ni and oi:
+                return -errno.ENOTDIR
+            if ni and oi:
+                try:
+                    empty = not any(
+                        True
+                        for _ in list_dir(
+                            self.filer, new, session=self._http
+                        )
+                    )
+                except requests.RequestException:
+                    return -errno.EIO
+                if not empty:
+                    return -errno.ENOTEMPTY
+                rc = self.rmdir(new)
+                if rc != 0:
+                    return rc
+                replaced_dir = True
         r = self._http.post(
             self._url(new) + f"?mv.from={urllib.parse.quote(old, safe='')}",
             timeout=60,
@@ -616,6 +682,12 @@ class FilerMount:
             # created-but-unflushed file: the filer has never seen it;
             # the in-memory retarget IS the rename (flush publishes /new)
             return 0
+        if replaced_dir:
+            # the move failed AFTER we removed the empty destination:
+            # best-effort restore so rename degrades to "nothing
+            # happened" instead of destroying the target (full
+            # atomicity needs a filer-side replace, not client steps)
+            self.mkdir(new, 0o755)
         if r.status_code == 404:
             return -errno.ENOENT
         return -errno.EIO
@@ -751,6 +823,8 @@ class FilerMount:
     # -------------------------------------------------- symlink / hardlink
 
     def symlink(self, target: str, linkpath: str) -> int:
+        if self._name_too_long(linkpath):
+            return -errno.ENAMETOOLONG
         # CreateEntry upserts: without this check a symlink over an
         # existing entry would silently clobber it (orphaning chunks)
         if not self._grpc_lookup(linkpath).error:
@@ -780,6 +854,8 @@ class FilerMount:
         return 0
 
     def link(self, src: str, dst: str) -> int:
+        if self._name_too_long(dst):
+            return -errno.ENAMETOOLONG
         self._flush_open_handle(src)
         r = self._filer_stub().HardLink(
             fpb.HardLinkRequest(src_path=src, dst_path=dst), timeout=30

@@ -283,8 +283,22 @@ class Worker:
                 )
                 raise
             self._report(assign.task_id, "running", 0.8)
-            src.VolumeDelete(
-                pb.VolumeCommandRequest(volume_id=vid), timeout=60
+            last_err: Exception | None = None
+            for _attempt in range(3):
+                try:
+                    src.VolumeDelete(
+                        pb.VolumeCommandRequest(volume_id=vid), timeout=60
+                    )
+                    return
+                except grpc.RpcError as e:
+                    last_err = e
+                    time.sleep(1.0)
+            # copy landed but the source copy survives (readonly, so no
+            # divergence) — fail LOUDLY so an operator finishes the move
+            raise RuntimeError(
+                f"balance: volume {vid} copied to {target} but source "
+                f"delete on {source} failed after retries ({last_err}); "
+                "volume is duplicated and readonly at the source"
             )
 
     def _task_s3_lifecycle(self, assign: wk.TaskAssign) -> None:
