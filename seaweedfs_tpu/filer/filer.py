@@ -223,6 +223,27 @@ class Filer:
         except NotFound:
             return None
 
+    # --------------------------------------------------- path-based rules
+
+    def path_conf(self, full_path: str) -> dict:
+        """Longest-prefix storage rule for a path (reference
+        fs.configure / filer_conf.go): {collection, replication,
+        ttl_sec} chosen by location_prefix."""
+        raw = self.store.kv_get(b"fs.configure")
+        if not raw:
+            return {}
+        try:
+            rules = (__import__("json").loads(raw)).get("locations", [])
+        except ValueError:
+            return {}
+        best: dict = {}
+        best_len = -1
+        for r in rules:
+            p = r.get("location_prefix", "")
+            if p and full_path.startswith(p) and len(p) > best_len:
+                best, best_len = r, len(p)
+        return best
+
     def _gc_overwritten(self, old: Optional[Entry]) -> None:
         """Release the entry an overwrite replaced. For a hardlinked
         name the NAME survives in its link group (create_entry
@@ -548,6 +569,18 @@ class Filer:
         """Slice into chunk_size pieces, assign+upload each, create the
         entry (reference uploadRequestToChunks)."""
         full_path = normalize_path(full_path)
+        # fs.configure path rules fill in what the caller left default
+        rule = self.path_conf(full_path)
+        if rule:
+            if collection is None and rule.get("collection"):
+                collection = rule["collection"]
+            if not ttl_sec and rule.get("ttl_sec"):
+                ttl_sec = int(rule["ttl_sec"])
+        replication = (
+            rule.get("replication") or self.replication
+            if rule
+            else self.replication
+        )
         old = self._try_find(*split_path(full_path))
         if old is not None and old.is_directory:
             # fail BEFORE uploading chunks that create_entry would orphan
@@ -578,7 +611,7 @@ class Filer:
                 piece,
                 name=full_path.rsplit("/", 1)[-1],
                 collection=self.collection if collection is None else collection,
-                replication=self.replication,
+                replication=replication,
             )
             chunks.append(
                 fpb.FileChunk(

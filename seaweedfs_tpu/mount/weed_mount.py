@@ -78,6 +78,22 @@ class FilerMount:
         self._next_fh = 1
         self._lock = threading.Lock()
         self._attr_cache: dict[str, tuple[float, dict | None]] = {}
+        # mount.configure (filer KV "mount.conf"): live-tunable attr
+        # cache TTL and a cluster-enforced readonly flag
+        self.attr_ttl = ATTR_TTL
+        self.readonly = False
+        try:
+            import json as _json
+
+            r = self._filer_stub().KvGet(
+                fpb.FilerKvGetRequest(key=b"mount.conf"), timeout=5
+            )
+            if r.found:
+                conf = _json.loads(r.value)
+                self.attr_ttl = float(conf.get("attr_ttl", ATTR_TTL))
+                self.readonly = bool(conf.get("readonly", False))
+        except Exception:  # noqa: BLE001 — filer may not be up yet
+            pass
 
     def _filer_stub(self):
         with self._grpc_lock:
@@ -103,7 +119,7 @@ class FilerMount:
         used to be silent lies."""
         now = time.time()
         hit = self._attr_cache.get(path)
-        if hit and now - hit[0] < ATTR_TTL:
+        if hit and now - hit[0] < self.attr_ttl:
             return hit[1]
         if path == "/":
             out = {"isDir": True, "size": 0, "mtime": int(now)}
@@ -308,6 +324,8 @@ class FilerMount:
             return fh
 
     def open(self, path: str, fi) -> int:
+        if self.readonly and (fi.contents.flags & 0x3):  # O_WRONLY|O_RDWR
+            return -errno.EROFS
         # second open of a live handle shares it (refcounted): the
         # dirty state is per-path, not per-descriptor
         with self._lock:
@@ -326,6 +344,8 @@ class FilerMount:
         return 0
 
     def create(self, path: str, mode: int, fi) -> int:
+        if self.readonly:
+            return -errno.EROFS
         if self._name_too_long(path):
             return -errno.ENAMETOOLONG
         # mode 0 is a legal create permission; no `or 0o644` coercion
@@ -539,6 +559,8 @@ class FilerMount:
         return self._flush_handle(h) if h else 0
 
     def truncate(self, path: str, length: int) -> int:
+        if self.readonly:
+            return -errno.EROFS
         h = self._by_path.get(path)
         if h is not None:
             return self._ftruncate_handle(h, length)
@@ -574,6 +596,8 @@ class FilerMount:
         return self._ftruncate_handle(h, length)
 
     def unlink(self, path: str) -> int:
+        if self.readonly:
+            return -errno.EROFS
         r = self._http.delete(self._url(path), timeout=60)
         self._invalidate(path)
         # an open handle must not resurrect the path on release
@@ -588,6 +612,8 @@ class FilerMount:
         return 0 if r.status_code in (200, 204) else -errno.EIO
 
     def mkdir(self, path: str, mode: int) -> int:
+        if self.readonly:
+            return -errno.EROFS
         if self._name_too_long(path):
             return -errno.ENAMETOOLONG
         # gRPC CreateEntry (not the HTTP ?mkdir) so the requested mode
@@ -609,6 +635,8 @@ class FilerMount:
         return -errno.EIO if r.error else 0
 
     def rmdir(self, path: str) -> int:
+        if self.readonly:
+            return -errno.EROFS
         r = self._http.delete(self._url(path), timeout=60)
         self._invalidate(path)
         if r.status_code == 409:
@@ -625,6 +653,8 @@ class FilerMount:
     def rename(self, old: str, new: str) -> int:
         import urllib.parse
 
+        if self.readonly:
+            return -errno.EROFS
         if self._name_too_long(new):
             return -errno.ENAMETOOLONG
         # POSIX target-exists semantics the filer's generic error can't
@@ -704,6 +734,8 @@ class FilerMount:
     # ------------------------------------------- POSIX metadata (persisted)
 
     def chmod(self, path: str, mode: int) -> int:
+        if self.readonly:
+            return -errno.EROFS
         """Persisted to the filer entry (reference weedfs_attr.go
         Setattr) — the pre-r4 silent no-op lied to callers."""
 
@@ -715,6 +747,8 @@ class FilerMount:
         return self._mutate_attrs(path, apply)
 
     def chown(self, path: str, uid: int, gid: int) -> int:
+        if self.readonly:
+            return -errno.EROFS
         def apply(e):
             if uid != 0xFFFFFFFF:  # -1 = leave unchanged
                 e.attributes.uid = uid
@@ -727,6 +761,8 @@ class FilerMount:
     _UTIME_OMIT = (1 << 30) - 2
 
     def utimens(self, path: str, ts) -> int:
+        if self.readonly:
+            return -errno.EROFS
         """ts = timespec[2] (atime, mtime); atime is not tracked (the
         reference's filer model has no atime either)."""
         if not ts:
@@ -748,6 +784,8 @@ class FilerMount:
     # ------------------------------------------------------------- xattrs
 
     def setxattr(self, path: str, name: str, value: bytes, flags: int) -> int:
+        if self.readonly:
+            return -errno.EROFS
         if name.startswith(("system.", "security.")):
             # No POSIX-ACL/capability support: accepting
             # system.posix_acl_access as an opaque blob would make
@@ -798,6 +836,8 @@ class FilerMount:
         return len(blob)
 
     def removexattr(self, path: str, name: str) -> int:
+        if self.readonly:
+            return -errno.EROFS
         key = XATTR_PREFIX + name
 
         def apply(e):
@@ -823,6 +863,8 @@ class FilerMount:
     # -------------------------------------------------- symlink / hardlink
 
     def symlink(self, target: str, linkpath: str) -> int:
+        if self.readonly:
+            return -errno.EROFS
         if self._name_too_long(linkpath):
             return -errno.ENAMETOOLONG
         # CreateEntry upserts: without this check a symlink over an
@@ -854,6 +896,8 @@ class FilerMount:
         return 0
 
     def link(self, src: str, dst: str) -> int:
+        if self.readonly:
+            return -errno.EROFS
         if self._name_too_long(dst):
             return -errno.ENAMETOOLONG
         self._flush_open_handle(src)

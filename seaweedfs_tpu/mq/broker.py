@@ -253,6 +253,54 @@ class MqBroker:
             raise KeyError(f"topic {ns}/{name} not configured")
         return st
 
+    def compact_topic(self, ns: str, name: str) -> int:
+        """Archive this topic's sealed raw segments to parquet NOW
+        (mq.topic.compact; the periodic archiver does the same on a
+        timer). Returns segments archived."""
+        from .logstore import SegmentArchiver
+
+        st = self.topic(ns, name)  # KeyError surfaces to the caller
+        if not self.filer:
+            return 0
+        for log_ in st.logs.values():
+            log_.flush()  # seal the tails so they are archivable
+        # min_age_segments=0: an OPERATOR-initiated compact must cover
+        # every sealed segment (the background archiver's 1-segment
+        # grace exists only to keep tail reads on the raw format)
+        arch = SegmentArchiver(self, min_age_segments=0)
+        return sum(
+            arch._archive_partition(ns, name, p)
+            for p in range(st.partition_count)
+        )
+
+    def truncate_topic(
+        self, ns: str, name: str, partition: int = -1, before_offset: int = -1
+    ) -> int:
+        """Drop records below before_offset (-1 = all current records)
+        for one or every partition (mq.topic.truncate). In-memory
+        truncation is record-granular; durable segment files are
+        deleted only when ENTIRELY below the boundary, so a restart may
+        re-expose the partial segment's older records (documented
+        segment-granular durability)."""
+        st = self.topic(ns, name)
+        parts = (
+            range(st.partition_count) if partition < 0 else [partition]
+        )
+        done = 0
+        for p in parts:
+            log_ = st.logs.get(p)
+            if log_ is None:
+                continue
+            boundary = log_.truncate_before(before_offset)
+            if self.filer:
+                full_below = boundary // self.segment_records
+                for seg in range(full_below):
+                    self._delete_file(self._seg_path(ns, name, p, seg))
+                    pq = self._seg_path(ns, name, p, seg)[: -len(".log")]
+                    self._delete_file(pq + ".parquet")
+            done += 1
+        return done
+
     def pick_partition(self, st: _TopicState, key: bytes, requested: int) -> int:
         if requested >= 0:
             return requested % st.partition_count
@@ -476,6 +524,34 @@ class MqService:
             # leader so it backfills before re-sending
             return mq.FollowAppendResponse(error=f"gap:{expected}")
         return mq.FollowAppendResponse()
+
+    def DeleteTopic(self, request, context):
+        try:
+            self.broker.delete_topic(request.ns or "default", request.name)
+        except KeyError as e:
+            return mq.DeleteTopicResponse(error=str(e))
+        return mq.DeleteTopicResponse()
+
+    def CompactTopic(self, request, context):
+        try:
+            n = self.broker.compact_topic(
+                request.ns or "default", request.name
+            )
+        except KeyError as e:
+            return mq.CompactTopicResponse(error=str(e))
+        return mq.CompactTopicResponse(archived_segments=n)
+
+    def TruncateTopic(self, request, context):
+        try:
+            n = self.broker.truncate_topic(
+                request.ns or "default",
+                request.name,
+                partition=request.partition,
+                before_offset=request.before_offset,
+            )
+        except KeyError as e:
+            return mq.TruncateTopicResponse(error=str(e))
+        return mq.TruncateTopicResponse(truncated_partitions=n)
 
     def ConfigureTopic(self, request, context):
         t = request.topic

@@ -147,13 +147,33 @@ class PartitionLog:
         with self._lock:
             self._seal_locked()
 
+    def truncate_before(self, offset: int) -> int:
+        """Drop records below `offset` (-1 = everything): earliest
+        advances, in-memory tail records below it are freed. Durable
+        segment files are the broker's to delete (segment-granular);
+        returns the new earliest offset."""
+        with self._lock:
+            boundary = self.next_offset if offset < 0 else min(
+                offset, self.next_offset
+            )
+            self._tail = [r for r in self._tail if r[0] >= boundary]
+            self._tail_base = (
+                self._tail[0][0] if self._tail else self.next_offset
+            )
+            self.earliest_offset = max(self.earliest_offset, boundary)
+            return self.earliest_offset
+
     # ------------------------------------------------------------- read
 
     def read_from(
         self, offset: int, max_records: int = 1024
     ) -> list[tuple[int, int, bytes, bytes]]:
         """Records with offset >= `offset` (up to max_records); pulls
-        sealed segments through `load` when the tail has rotated past."""
+        sealed segments through `load` when the tail has rotated past.
+        Reads below earliest_offset clamp up to it: after a truncation
+        the deleted whole segments would otherwise read as an
+        empty-break and silently skip the retained partial segment."""
+        offset = max(offset, self.earliest_offset)
         with self._lock:
             if offset >= self._tail_base:
                 start = 0

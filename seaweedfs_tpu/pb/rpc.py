@@ -42,6 +42,7 @@ SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
         "AdminLock": (UNARY, pb.LockRequest, pb.LockResponse),
         "AdminUnlock": (UNARY, pb.UnlockRequest, pb.UnlockResponse),
         "AdminLockStatus": (UNARY, pb.LockStatusRequest, pb.LockStatusResponse),
+        "VacuumControl": (UNARY, pb.VacuumControlRequest, pb.VolumeCommandResponse),
     },
     VOLUME_SERVICE: {
         "AllocateVolume": (UNARY, pb.AllocateVolumeRequest, pb.AllocateVolumeResponse),
@@ -69,6 +70,8 @@ SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
         "ScrubEcVolume": (UNARY, pb.ScrubRequest, pb.ScrubResponse),
         "VolumeTierUpload": (UNARY, pb.TierRequest, pb.TierResponse),
         "VolumeTierDownload": (UNARY, pb.TierRequest, pb.TierResponse),
+        "VolumeUnmount": (UNARY, pb.VolumeCommandRequest, pb.VolumeCommandResponse),
+        "VolumeConfigure": (UNARY, pb.VolumeConfigureRequest, pb.VolumeCommandResponse),
         "VolumeTailSender": (SERVER_STREAM, pb.VolumeTailRequest, pb.VolumeTailChunk),
         "VolumeTailReceiver": (UNARY, pb.VolumeTailReceiverRequest, pb.VolumeTailReceiverResponse),
         "VolumeIncrementalCopy": (SERVER_STREAM, pb.VolumeIncrementalCopyRequest, pb.VolumeIncrementalCopyChunk),
@@ -85,6 +88,9 @@ SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
         "BrokerStatus": (UNARY, mq.BrokerStatusRequest, mq.BrokerStatusResponse),
         "LookupTopicBrokers": (UNARY, mq.LookupTopicBrokersRequest, mq.LookupTopicBrokersResponse),
         "FollowAppend": (UNARY, mq.FollowAppendRequest, mq.FollowAppendResponse),
+        "CompactTopic": (UNARY, mq.CompactTopicRequest, mq.CompactTopicResponse),
+        "DeleteTopic": (UNARY, mq.DeleteTopicRequest, mq.DeleteTopicResponse),
+        "TruncateTopic": (UNARY, mq.TruncateTopicRequest, mq.TruncateTopicResponse),
         "RegisterSchema": (UNARY, mq.RegisterSchemaRequest, mq.RegisterSchemaResponse),
         "GetSchema": (UNARY, mq.GetSchemaRequest, mq.GetSchemaResponse),
     },

@@ -93,6 +93,74 @@ def mount(
     return n
 
 
+def meta_sync(filer: Filer, directory: str) -> tuple[int, int, int]:
+    """Refresh a mount's metadata from the remote listing (reference
+    remote.meta.sync): new objects appear, changed sizes/etags update,
+    objects gone remotely drop their local entries. Returns
+    (added, updated, removed)."""
+    directory = normalize_path(directory)
+    mounts = list_mounts(filer)
+    conf = mounts.get(directory)
+    if conf is None:
+        raise FilerError(f"{directory} is not a remote mount")
+    client = get_client(filer, conf["remote"])
+    prefix = conf.get("prefix", "")
+    remote_objs = {
+        obj.key[len(prefix):].lstrip("/"): obj
+        for obj in client.list_objects(conf["bucket"], prefix)
+        if obj.key[len(prefix):].lstrip("/") and not obj.key.endswith("/")
+    }
+    local: dict[str, Entry] = {}
+
+    def walk(d: str, rel: str = ""):
+        for e in filer.list_entries(d, limit=1_000_000):
+            if e.is_directory:
+                walk(e.full_path, f"{rel}{e.name}/")
+            elif REMOTE_ATTR in e.extended:
+                local[f"{rel}{e.name}"] = e
+
+    walk(directory)
+    added = updated = removed = 0
+    for rel, obj in remote_objs.items():
+        meta = {
+            "remote": conf["remote"],
+            "bucket": conf["bucket"],
+            "key": obj.key,
+            "size": obj.size,
+            "etag": obj.etag,
+        }
+        have = local.get(rel)
+        if have is None:
+            entry = new_entry(f"{directory}/{rel}", mode=0o644)
+            entry.attr.file_size = obj.size
+            entry.extended[REMOTE_ATTR] = json.dumps(meta).encode()
+            filer.create_entry(entry)
+            added += 1
+            continue
+        old_meta = json.loads(have.extended[REMOTE_ATTR])
+        if (old_meta.get("etag"), old_meta.get("size")) != (obj.etag, obj.size):
+            old_chunks: list = []
+
+            def mutate(e, _m=meta, _o=obj, _oc=old_chunks):
+                _oc.extend(e.chunks)
+                e.attr.file_size = _o.size
+                e.chunks = []  # cached bytes are stale: drop them
+                e.extended[REMOTE_ATTR] = json.dumps(_m).encode()
+
+            filer.mutate_entry(have.full_path, mutate)
+            if old_chunks:
+                # the dropped cache chunks must be reclaimed (same
+                # discipline as uncache), or every sync cycle over a
+                # cached mount leaks volume space
+                filer.gc_chunks(old_chunks)
+            updated += 1
+    for rel, e in local.items():
+        if rel not in remote_objs:
+            filer.delete_entry(e.full_path, gc_chunks=True)
+            removed += 1
+    return added, updated, removed
+
+
 def unmount(filer: Filer, directory: str) -> None:
     directory = normalize_path(directory)
     mounts = list_mounts(filer)

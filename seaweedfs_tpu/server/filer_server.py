@@ -350,6 +350,18 @@ class FilerServer:
                     if op == "uncache":
                         rm.uncache(filer, body["path"])
                         return self._json(200, {"uncached": True})
+                    if op == "meta.sync":
+                        added, updated, removed = rm.meta_sync(
+                            filer, body["dir"]
+                        )
+                        return self._json(
+                            200,
+                            {
+                                "added": added,
+                                "updated": updated,
+                                "removed": removed,
+                            },
+                        )
                 except (FilerError, NotFound, KeyError) as e:
                     return self._json(409, {"error": str(e)})
                 except Exception as e:  # remote endpoint failures

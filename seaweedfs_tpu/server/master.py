@@ -183,6 +183,16 @@ class MasterService:
             ok=ok, error="" if ok else "not held by this token"
         )
 
+    def VacuumControl(self, request, context) -> pb.VolumeCommandResponse:
+        """volume.vacuum.enable/disable: per-volume opt-out from the
+        periodic garbage sweep (reference Volume.SkipVacuum)."""
+        with self.topo._lock:
+            if request.disable:
+                self.topo.vacuum_disabled.add(request.volume_id)
+            else:
+                self.topo.vacuum_disabled.discard(request.volume_id)
+        return pb.VolumeCommandResponse()
+
     def AdminLockStatus(self, request, context) -> pb.LockStatusResponse:
         # leases live on the leader only: a deposed master's (stale,
         # typically empty) table must not masquerade as cluster state

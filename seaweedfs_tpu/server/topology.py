@@ -86,6 +86,8 @@ class Topology:
         self.dead_after = dead_after
         self._lock = threading.RLock()
         self.nodes: dict[str, DataNode] = {}
+        # per-volume auto-vacuum opt-out (volume.vacuum.disable)
+        self.vacuum_disabled: set[int] = set()
         # nested tree view (reference Topology: DC -> rack -> node);
         # self.nodes stays the flat id index into the same DataNode
         # objects
@@ -539,7 +541,9 @@ class Topology:
             ]
 
     def garbage_candidates(self, threshold: float) -> list[tuple[int, str, int]]:
-        """(vid, ip, grpc_port) of garbage-heavy writable volumes."""
+        """(vid, ip, grpc_port) of garbage-heavy writable volumes.
+        Volumes an operator disabled via volume.vacuum.disable are
+        skipped (reference topology Volume.SkipVacuum)."""
         with self._lock:
             return [
                 (v.id, n.ip, n.grpc_port)
@@ -547,6 +551,7 @@ class Topology:
                 for v in n.volumes.values()
                 if v.size > 0
                 and not v.read_only
+                and v.id not in self.vacuum_disabled
                 and v.deleted_bytes / max(v.size, 1) > threshold
             ]
 

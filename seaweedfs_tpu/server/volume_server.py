@@ -142,6 +142,29 @@ class VolumeService:
         self.server.notify_new_volume(request.volume_id)
         return pb.VolumeCommandResponse()
 
+    def VolumeUnmount(self, request, context):
+        """Release the volume, keep its files (reference
+        volume_grpc_admin.go VolumeUnmount)."""
+        try:
+            self.store.unmount_volume(request.volume_id)
+        except NotFoundError as e:
+            return pb.VolumeCommandResponse(error=str(e))
+        self.server.notify_deleted_volume(request.volume_id)
+        return pb.VolumeCommandResponse()
+
+    def VolumeConfigure(self, request, context):
+        """Rewrite replica placement in place (reference
+        VolumeConfigure); the next heartbeat reports the new value."""
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            return pb.VolumeCommandResponse(error="volume not found")
+        try:
+            v.set_replica_placement(request.replication)
+        except (ValueError, VolumeError) as e:
+            return pb.VolumeCommandResponse(error=str(e))
+        self.server.notify_new_volume(request.volume_id)
+        return pb.VolumeCommandResponse()
+
     def VolumeMarkReadonly(self, request, context):
         v = self.store.find_volume(request.volume_id)
         if v is None:

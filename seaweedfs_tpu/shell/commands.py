@@ -2447,3 +2447,452 @@ def fs_meta_cat(env: ShellEnv, args) -> str:
         "inlineContentBytes": len(e.content),
     }
     return _json.dumps(doc, indent=2)
+
+
+# ---------------------------------------------- round-5 gap closure
+# (verdict-directed families: volume.copy/mount/unmount/configure,
+# vacuum toggles, tier.move, mq compact/truncate, remote.meta.sync,
+# mount/fs.configure, cluster.ps, worker.list, maintenance.config)
+
+
+@command(
+    "volume.copy",
+    "-volumeId N -target host:grpcPort [-source host:grpcPort] "
+    "(copy a volume; source keeps its replica)",
+    mutating=True,
+)
+def volume_copy(env: ShellEnv, args) -> str:
+    """Reference volume.copy: pull .dat/.idx/.vif onto the target and
+    mount there; unlike volume.move the source keeps serving."""
+    p = argparse.ArgumentParser(prog="volume.copy")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-target", required=True)
+    p.add_argument("-source", default="")
+    p.add_argument("-collection", default="")
+    a = p.parse_args(args)
+    src_grpc = a.source
+    if not src_grpc:
+        loc = _locate_volume(env, a.volumeId)
+        src_grpc = f"{loc.url.split(':')[0]}:{loc.grpc_port}"
+    with grpc.insecure_channel(a.target) as ch:
+        r = rpc.Stub(ch, rpc.VOLUME_SERVICE).VolumeCopy(
+            pb.EcShardsCopyRequest(
+                volume_id=a.volumeId,
+                collection=a.collection,
+                source_url=src_grpc,
+            ),
+            timeout=3600,
+        )
+    if r.error:
+        return f"error: {r.error}"
+    return f"copied volume {a.volumeId} {src_grpc} -> {a.target}"
+
+
+@command(
+    "volume.mount",
+    "-volumeId N -node host:grpcPort [-collection c] (load volume files)",
+    mutating=True,
+)
+def volume_mount(env: ShellEnv, args) -> str:
+    p = argparse.ArgumentParser(prog="volume.mount")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-node", required=True)
+    p.add_argument("-collection", default="")
+    a = p.parse_args(args)
+    with grpc.insecure_channel(a.node) as ch:
+        r = rpc.Stub(ch, rpc.VOLUME_SERVICE).VolumeMount(
+            pb.AllocateVolumeRequest(
+                volume_id=a.volumeId, collection=a.collection
+            ),
+            timeout=60,
+        )
+    return f"error: {r.error}" if r.error else f"mounted volume {a.volumeId} on {a.node}"
+
+
+@command(
+    "volume.unmount",
+    "-volumeId N -node host:grpcPort (release a volume, keep its files)",
+    mutating=True,
+)
+def volume_unmount(env: ShellEnv, args) -> str:
+    p = argparse.ArgumentParser(prog="volume.unmount")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-node", required=True)
+    a = p.parse_args(args)
+    with grpc.insecure_channel(a.node) as ch:
+        r = rpc.Stub(ch, rpc.VOLUME_SERVICE).VolumeUnmount(
+            pb.VolumeCommandRequest(volume_id=a.volumeId), timeout=60
+        )
+    return f"error: {r.error}" if r.error else f"unmounted volume {a.volumeId} on {a.node}"
+
+
+@command(
+    "volume.configure.replication",
+    "-volumeId N -replication xyz (rewrite replica placement in place)",
+    mutating=True,
+)
+def volume_configure_replication(env: ShellEnv, args) -> str:
+    p = argparse.ArgumentParser(prog="volume.configure.replication")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-replication", required=True)
+    a = p.parse_args(args)
+    locs = env.master.lookup(a.volumeId, refresh=True)
+    if not locs:
+        return f"volume {a.volumeId} not found"
+    changed = []
+    for loc in locs:
+        ch, stub = _volume_stub(loc)
+        with ch:
+            r = stub.VolumeConfigure(
+                pb.VolumeConfigureRequest(
+                    volume_id=a.volumeId, replication=a.replication
+                ),
+                timeout=30,
+            )
+        if r.error:
+            return f"error on {loc.url}: {r.error}"
+        changed.append(loc.url)
+    return (
+        f"volume {a.volumeId} replication -> {a.replication} on "
+        + ", ".join(changed)
+    )
+
+
+# not `mutating`: it only reads topology itself and DELEGATES to
+# volume.move, which takes the admin + per-volume leases — taking them
+# here too would deadlock against our own nested invocation
+@command(
+    "volume.tier.move",
+    "-volumeId N -targetDiskType t (move to a node of that disk type)",
+)
+def volume_tier_move(env: ShellEnv, args) -> str:
+    """Reference volume.tier.move: relocate a volume onto a node whose
+    disks match the requested type (readonly -> copy -> delete)."""
+    p = argparse.ArgumentParser(prog="volume.tier.move")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-targetDiskType", required=True)
+    p.add_argument("-collection", default="")
+    a = p.parse_args(args)
+    ch0, mstub = _master_channel(env)
+    with ch0:
+        topo = mstub.Topology(pb.TopologyRequest(), timeout=30)
+    src = _locate_volume(env, a.volumeId)
+    target = None
+    for n in topo.nodes:
+        has_vid = any(v.id == a.volumeId for v in n.volumes)
+        disk_types = {v.disk_type or "hdd" for v in n.volumes}
+        node_addr = f"{n.location.url.split(':')[0]}:{n.location.grpc_port}"
+        src_addr = f"{src.url.split(':')[0]}:{src.grpc_port}"
+        if not has_vid and node_addr != src_addr and (
+            a.targetDiskType in disk_types or not disk_types
+        ):
+            target = node_addr
+            break
+    if target is None:
+        return f"no {a.targetDiskType} node available for volume {a.volumeId}"
+    return run_command(
+        env,
+        f"volume.move -volumeId {a.volumeId} -target {target}"
+        + (f" -collection {a.collection}" if a.collection else ""),
+    )
+
+
+@command("volume.vacuum.disable", "-volumeId N (skip in auto vacuum)", mutating=True)
+def volume_vacuum_disable(env: ShellEnv, args) -> str:
+    return _vacuum_toggle(env, args, disable=True)
+
+
+@command("volume.vacuum.enable", "-volumeId N (re-enable auto vacuum)", mutating=True)
+def volume_vacuum_enable(env: ShellEnv, args) -> str:
+    return _vacuum_toggle(env, args, disable=False)
+
+
+def _vacuum_toggle(env: ShellEnv, args, disable: bool) -> str:
+    p = argparse.ArgumentParser(
+        prog=f"volume.vacuum.{'disable' if disable else 'enable'}"
+    )
+    p.add_argument("-volumeId", type=int, required=True)
+    a = p.parse_args(args)
+    ch, stub = _master_channel(env)
+    with ch:
+        r = stub.VacuumControl(
+            pb.VacuumControlRequest(volume_id=a.volumeId, disable=disable),
+            timeout=30,
+        )
+    if r.error:
+        return f"error: {r.error}"
+    state = "disabled" if disable else "enabled"
+    return f"auto vacuum {state} for volume {a.volumeId}"
+
+
+def _master_channel(env: ShellEnv, service: str = ""):
+    host, _, port = env.master_addr.partition(":")
+    ch = grpc.insecure_channel(f"{host}:{int(port or 9333) + 10000}")
+    return ch, rpc.Stub(ch, service or rpc.MASTER_SERVICE)
+
+
+@command("mq.topic.compact", "-topic name [-broker ...] (archive sealed segments now)")
+def mq_topic_compact(env: ShellEnv, args) -> str:
+    from ..pb import mq_pb2 as mq
+
+    p = argparse.ArgumentParser(prog="mq.topic.compact")
+    p.add_argument("-broker", default="localhost:17777")
+    p.add_argument("-topic", required=True)
+    p.add_argument("-ns", default="default")
+    a = p.parse_args(args)
+    with grpc.insecure_channel(a.broker) as ch:
+        r = rpc.mq_stub(ch).CompactTopic(
+            mq.CompactTopicRequest(ns=a.ns, name=a.topic), timeout=600
+        )
+    if r.error:
+        return f"error: {r.error}"
+    return f"archived {r.archived_segments} segments of {a.ns}/{a.topic}"
+
+
+@command(
+    "mq.topic.truncate",
+    "-topic name [-partition P] [-beforeOffset N] (drop old records)",
+)
+def mq_topic_truncate(env: ShellEnv, args) -> str:
+    from ..pb import mq_pb2 as mq
+
+    p = argparse.ArgumentParser(prog="mq.topic.truncate")
+    p.add_argument("-broker", default="localhost:17777")
+    p.add_argument("-topic", required=True)
+    p.add_argument("-ns", default="default")
+    p.add_argument("-partition", type=int, default=-1)
+    p.add_argument("-beforeOffset", type=int, default=-1)
+    a = p.parse_args(args)
+    with grpc.insecure_channel(a.broker) as ch:
+        r = rpc.mq_stub(ch).TruncateTopic(
+            mq.TruncateTopicRequest(
+                ns=a.ns,
+                name=a.topic,
+                partition=a.partition,
+                before_offset=a.beforeOffset,
+            ),
+            timeout=600,
+        )
+    if r.error:
+        return f"error: {r.error}"
+    return (
+        f"truncated {r.truncated_partitions} partition(s) of "
+        f"{a.ns}/{a.topic}"
+    )
+
+
+@command(
+    "remote.meta.sync",
+    "-dir /path (refresh mounted remote metadata: add/update/remove)",
+    mutating=True,
+)
+def remote_meta_sync(env: ShellEnv, args) -> str:
+    p = argparse.ArgumentParser(prog="remote.meta.sync")
+    p.add_argument("-dir", required=True)
+    a = p.parse_args(args)
+    return _remote_post(env, "meta.sync", {"dir": a.dir})
+
+
+@command(
+    "mount.configure",
+    "[-attrTtl seconds] [-readonly true|false] [-show] "
+    "(cluster-wide mount options, read by mounts at startup)",
+    mutating=True,
+)
+def mount_configure(env: ShellEnv, args) -> str:
+    import json as _json
+
+    from ..pb import filer_pb2 as fpb
+
+    p = argparse.ArgumentParser(prog="mount.configure")
+    p.add_argument("-attrTtl", type=float, default=None)
+    p.add_argument("-readonly", default=None, choices=["true", "false"])
+    p.add_argument("-show", action="store_true")
+    a = p.parse_args(args)
+    ch, stub = _filer_grpc(env)
+    with ch:
+        cur = stub.KvGet(fpb.FilerKvGetRequest(key=b"mount.conf"), timeout=10)
+        conf = _json.loads(cur.value) if cur.found else {}
+        if a.show or (a.attrTtl is None and a.readonly is None):
+            return _json.dumps(conf or {"attr_ttl": 1.0, "readonly": False})
+        if a.attrTtl is not None:
+            conf["attr_ttl"] = a.attrTtl
+        if a.readonly is not None:
+            conf["readonly"] = a.readonly == "true"
+        stub.KvPut(
+            fpb.FilerKvPutRequest(
+                key=b"mount.conf", value=_json.dumps(conf).encode()
+            ),
+            timeout=10,
+        )
+    return f"mount.conf = {_json.dumps(conf)} (applies to newly started mounts)"
+
+
+@command(
+    "fs.configure",
+    "[-locationPrefix /p -collection c -replication xyz -ttlSec n] "
+    "[-delete] [-show] (per-path storage rules)",
+    mutating=True,
+)
+def fs_configure(env: ShellEnv, args) -> str:
+    import json as _json
+
+    from ..pb import filer_pb2 as fpb
+
+    p = argparse.ArgumentParser(prog="fs.configure")
+    p.add_argument("-locationPrefix", default="")
+    p.add_argument("-collection", default="")
+    p.add_argument("-replication", default="")
+    p.add_argument("-ttlSec", type=int, default=0)
+    p.add_argument("-delete", action="store_true")
+    p.add_argument("-show", action="store_true")
+    a = p.parse_args(args)
+    ch, stub = _filer_grpc(env)
+    with ch:
+        cur = stub.KvGet(
+            fpb.FilerKvGetRequest(key=b"fs.configure"), timeout=10
+        )
+        conf = _json.loads(cur.value) if cur.found else {"locations": []}
+        if a.show or not a.locationPrefix:
+            return _json.dumps(conf, indent=2)
+        locs = [
+            r for r in conf.get("locations", [])
+            if r.get("location_prefix") != a.locationPrefix
+        ]
+        if not a.delete:
+            locs.append(
+                {
+                    "location_prefix": a.locationPrefix,
+                    "collection": a.collection,
+                    "replication": a.replication,
+                    "ttl_sec": a.ttlSec,
+                }
+            )
+        conf["locations"] = locs
+        stub.KvPut(
+            fpb.FilerKvPutRequest(
+                key=b"fs.configure", value=_json.dumps(conf).encode()
+            ),
+            timeout=10,
+        )
+    verb = "deleted rule for" if a.delete else "configured"
+    return f"{verb} {a.locationPrefix} ({len(locs)} rule(s) total)"
+
+
+@command("cluster.ps", "list cluster processes (masters, volume servers, workers)")
+def cluster_ps(env: ShellEnv, args) -> str:
+    from ..pb import worker_pb2 as wk
+
+    lines = []
+    ch, _stub = _master_channel(env)
+    with ch:
+        try:
+            rs = rpc.Stub(ch, rpc.RAFT_SERVICE).RaftStatus(
+                pb.RaftStatusRequest(), timeout=10
+            )
+            lines.append(f"master {rs.node_id} role={rs.role} term={rs.term}")
+            for peer in rs.peers:
+                lines.append(f"master {peer} (peer)")
+        except grpc.RpcError:
+            lines.append(f"master {env.master_addr}")
+        topo = rpc.Stub(ch, rpc.MASTER_SERVICE).Topology(
+            pb.TopologyRequest(), timeout=30
+        )
+        for n in topo.nodes:
+            lines.append(
+                f"volumeServer {n.location.url} grpc={n.location.grpc_port} "
+                f"volumes={len(n.volumes)} ec={len(n.ec_shards)} "
+                f"dc={n.data_center or 'default'} rack={n.rack or 'default'}"
+            )
+        try:
+            ws = rpc.Stub(ch, rpc.WORKER_SERVICE).ListWorkers(
+                wk.ListWorkersRequest(), timeout=10
+            )
+            for w in ws.workers:
+                lines.append(
+                    f"worker {w.worker_id} caps={','.join(w.capabilities)} "
+                    f"active={w.active}"
+                )
+        except grpc.RpcError:
+            pass
+    return "\n".join(lines)
+
+
+@command("worker.list", "list registered maintenance workers")
+def worker_list(env: ShellEnv, args) -> str:
+    from ..pb import worker_pb2 as wk
+
+    ch, _ = _master_channel(env)
+    with ch:
+        r = rpc.Stub(ch, rpc.WORKER_SERVICE).ListWorkers(
+            wk.ListWorkersRequest(), timeout=10
+        )
+    if not r.workers:
+        return "no workers connected"
+    return "\n".join(
+        f"{w.worker_id} caps={','.join(w.capabilities)} "
+        f"active={w.active}/{w.max_concurrent} backend={w.backend}"
+        for w in r.workers
+    )
+
+
+@command(
+    "maintenance.config",
+    "[-set key=value ...] show or tune the maintenance policy live",
+    mutating=True,
+)
+def maintenance_config(env: ShellEnv, args) -> str:
+    import json as _json
+
+    from ..pb import worker_pb2 as wk
+
+    p = argparse.ArgumentParser(prog="maintenance.config")
+    p.add_argument("-set", action="append", default=[])
+    a = p.parse_args(args)
+    ch, _ = _master_channel(env)
+    with ch:
+        stub = rpc.Stub(ch, rpc.WORKER_SERVICE)
+        if a.set:
+            req = wk.MaintenanceConfig()
+            for kv in a.set:
+                key, _, val = kv.partition("=")
+                if key == "lifecycle_filer":
+                    req.lifecycle_filer = val
+                else:
+                    try:
+                        setattr(req, key, float(val))
+                    except (AttributeError, ValueError):
+                        return f"unknown or invalid knob {kv!r}"
+            r = stub.SetMaintenanceConfig(req, timeout=10)
+            if r.error:
+                return f"error: {r.error}"
+        cfg = stub.GetMaintenanceConfig(
+            wk.GetMaintenanceConfigRequest(), timeout=10
+        )
+    return _json.dumps(
+        {
+            "ec_auto_fullness": cfg.ec_auto_fullness,
+            "ec_quiet_seconds": cfg.ec_quiet_seconds,
+            "garbage_threshold": cfg.garbage_threshold,
+            "vacuum_interval_seconds": cfg.vacuum_interval_seconds,
+            "balance_spread": cfg.balance_spread,
+            "lifecycle_interval_seconds": cfg.lifecycle_interval_seconds,
+            "lifecycle_filer": cfg.lifecycle_filer,
+        }
+    )
+
+
+@command("mq.topic.delete", "-topic name [-broker ...] (drop a topic and its data)")
+def mq_topic_delete(env: ShellEnv, args) -> str:
+    from ..pb import mq_pb2 as mq
+
+    p = argparse.ArgumentParser(prog="mq.topic.delete")
+    p.add_argument("-broker", default="localhost:17777")
+    p.add_argument("-topic", required=True)
+    p.add_argument("-ns", default="default")
+    a = p.parse_args(args)
+    with grpc.insecure_channel(a.broker) as ch:
+        r = rpc.mq_stub(ch).DeleteTopic(
+            mq.DeleteTopicRequest(ns=a.ns, name=a.topic), timeout=120
+        )
+    return f"error: {r.error}" if r.error else f"deleted topic {a.ns}/{a.topic}"

@@ -216,6 +216,18 @@ class Store:
                     return
         raise NotFoundError(f"volume {vid} not found")
 
+    def unmount_volume(self, vid: int) -> None:
+        """Release a volume WITHOUT touching its files (reference
+        volume.unmount): the inverse of mount_volume, for moving a
+        volume's files or taking them offline for repair."""
+        with self._lock:
+            for loc in self.locations:
+                v = loc.volumes.pop(vid, None)
+                if v is not None:
+                    v.close()
+                    return
+        raise NotFoundError(f"volume {vid} not found")
+
     def mount_volume(self, vid: int, collection: str = "") -> Volume:
         """Load an existing .dat/.idx pair from disk (post-copy/restart)."""
         with self._lock:

@@ -366,6 +366,20 @@ class Volume:
     def content_size(self) -> int:
         return self._append_at - SUPER_BLOCK_SIZE
 
+    def set_replica_placement(self, replication: str) -> None:
+        """Rewrite the superblock's replica placement in place
+        (reference volume_super_block.go MaybeWriteSuperBlock /
+        volume.configure.replication)."""
+        with self._lock:
+            self._check_not_broken()
+            rp = ReplicaPlacement.parse(replication)
+            self.super_block.replica_placement = rp
+            self._dat.seek(0)
+            self._dat.write(self.super_block.to_bytes())
+            self._dat.flush()
+            os.fsync(self._dat.fileno())
+            self._dat.seek(self._append_at)
+
     def set_read_only(self, ro: bool = True) -> None:
         with self._lock:
             if self._remote is not None and not ro:

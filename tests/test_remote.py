@@ -305,3 +305,64 @@ def test_remote_provider_registry():
     register("fake", Fake)
     f = make_remote_client("fake", endpoint="x", access_key="k", secret_key="s")
     assert isinstance(f, Fake) and f.kw["endpoint"] == "x"
+
+
+def test_remote_meta_sync(stack):
+    """remote.meta.sync: new cloud objects appear, changed ones update,
+    deleted ones drop their local entries (through the HTTP op the
+    shell command rides)."""
+    filer = stack["filer"]
+    client = RemoteS3Client(
+        endpoint=f"http://localhost:{stack['s3'].port}",
+        access_key=AK,
+        secret_key=SK,
+    )
+    client.ensure_bucket("syncb")
+    client.put_object("syncb", "keep.txt", b"v1")
+    client.put_object("syncb", "gone.txt", b"bye")
+    srv = FilerServer(filer, ip="localhost", port=allocate_port())
+    srv.start()
+    try:
+        base = f"http://localhost:{srv.port}"
+        requests.post(
+            base + "/~remote/configure",
+            json={
+                "name": "c3",
+                "endpoint": f"http://localhost:{stack['s3'].port}",
+                "access_key": AK,
+                "secret_key": SK,
+            },
+            timeout=10,
+        )
+        r = requests.post(
+            base + "/~remote/mount",
+            json={"dir": "/sync3", "remote": "c3", "bucket": "syncb"},
+            timeout=30,
+        )
+        assert r.json()["mounted"] == 2
+        # cloud mutates behind the mount
+        client.put_object("syncb", "keep.txt", b"v2-new-content")
+        client.put_object("syncb", "new.txt", b"fresh")
+        client.delete_object("syncb", "gone.txt")
+        r = requests.post(
+            base + "/~remote/meta.sync", json={"dir": "/sync3"}, timeout=30
+        )
+        doc = r.json()
+        assert (doc["added"], doc["updated"], doc["removed"]) == (1, 1, 1), doc
+        assert filer.find_entry("/sync3/new.txt").attr.file_size == 5
+        assert filer.find_entry("/sync3/keep.txt").attr.file_size == len(
+            b"v2-new-content"
+        )
+        import pytest as _pytest
+
+        from seaweedfs_tpu.filer.filer_store import NotFound as _NF
+
+        with _pytest.raises(_NF):
+            filer.find_entry("/sync3/gone.txt")
+        # and the refreshed content reads through
+        assert (
+            requests.get(base + "/sync3/keep.txt", timeout=10).content
+            == b"v2-new-content"
+        )
+    finally:
+        srv.stop()
