@@ -127,6 +127,18 @@ class SegmentArchiver:
                 continue
             pq_path = path[: -len(".log")] + ".parquet"
             b._put_file(pq_path, parquet)
+            # stats sidecar BEFORE the raw delete: the query engine's
+            # predicate pushdown prunes whole segments on it without
+            # fetching the parquet bytes
+            import json as _json
+
+            try:
+                b._put_file(
+                    path[: -len(".log")] + ".stats.json",
+                    _json.dumps(parquet_stats(parquet)).encode(),
+                )
+            except Exception as e:  # noqa: BLE001 — stats optional
+                log.warning(f"stats for {path}: {e!r}")
             b._delete_file(path)
             done += 1
             log.v(1, f"archived {path} ({len(raw)} -> {len(parquet)} bytes)")

@@ -8,11 +8,17 @@ _key, _ts (ms), _offset, _partition. Statements:
   SHOW TABLES
   DESCRIBE <topic>
   SELECT <*|cols|aggregates> FROM <topic>
-      [WHERE <expr>] [ORDER BY col [ASC|DESC]] [LIMIT n] [OFFSET n]
+      [WHERE <expr>] [GROUP BY col, ...] [HAVING <expr>]
+      [ORDER BY col [ASC|DESC], ...] [LIMIT n] [OFFSET n]
 
 Aggregates: COUNT(*) COUNT(col) SUM MIN MAX AVG; WHERE supports
 = != <> < <= > >= LIKE, AND/OR/NOT, parentheses, NULL literals.
 Values that are not JSON objects appear as a single _value column.
+
+Predicate pushdown: conjunctive _ts / _offset bounds prune whole
+parquet-archived segments via their .stats.json sidecars WITHOUT
+fetching the data; Result.stats reports segments_scanned /
+segments_skipped / rows_scanned as the audit trail.
 
 The engine is deliberately a hand-rolled recursive-descent parser over
 a small grammar — the reference embeds a full cockroach SQL parser,
@@ -50,7 +56,8 @@ _TOKEN_RE = re.compile(
 _KEYWORDS = {
     "SELECT", "FROM", "WHERE", "LIMIT", "OFFSET", "AND", "OR", "NOT",
     "LIKE", "SHOW", "TABLES", "TOPICS", "DESCRIBE", "DESC", "ASC",
-    "ORDER", "BY", "AS", "NULL", "IS", "TRUE", "FALSE",
+    "ORDER", "BY", "AS", "NULL", "IS", "TRUE", "FALSE", "GROUP",
+    "HAVING",
 }
 
 
@@ -96,7 +103,9 @@ class Select:
     columns: list  # ("col", name, alias) | ("agg", fn, arg, alias) | ("star",)
     table: str
     where: Any = None
-    order_by: tuple[str, bool] | None = None  # (col, descending)
+    group_by: list[str] | None = None
+    having: Any = None  # expr over output aliases
+    order_by: list[tuple[str, bool]] | None = None  # [(col, descending)...]
     limit: int = -1
     offset: int = 0
 
@@ -161,15 +170,26 @@ class _Parser:
         sel = Select(columns=cols, table=table)
         if self.accept_kw("WHERE"):
             sel.where = self.expr()
+        if self.accept_kw("GROUP"):
+            self.expect_kw("BY")
+            sel.group_by = [self.ident()]
+            while self.accept_op(","):
+                sel.group_by.append(self.ident())
+        if self.accept_kw("HAVING"):
+            sel.having = self.expr()
         if self.accept_kw("ORDER"):
             self.expect_kw("BY")
-            col = self.ident()
-            desc = False
-            if self.accept_kw("DESC"):
-                desc = True
-            else:
-                self.accept_kw("ASC")
-            sel.order_by = (col, desc)
+            sel.order_by = []
+            while True:
+                col = self.ident()
+                desc = False
+                if self.accept_kw("DESC"):
+                    desc = True
+                else:
+                    self.accept_kw("ASC")
+                sel.order_by.append((col, desc))
+                if not self.accept_op(","):
+                    break
         if self.accept_kw("LIMIT"):
             sel.limit = int(self._num())
         if self.accept_kw("OFFSET"):
@@ -269,6 +289,63 @@ class Result:
     columns: list[str]
     rows: list[list[Any]]
     tag: str = "SELECT"
+    # scan accounting (predicate pushdown audit): segments_scanned /
+    # segments_skipped / rows_scanned when the source was a topic scan
+    stats: dict = field(default_factory=dict)
+
+
+def _pushdown_bounds(where) -> dict:
+    """Conservative bounds extractable from the WHERE's top-level AND
+    chain: _offset >= / > give off_lo; _ts (ms) comparisons give a ns
+    range. OR/NOT subtrees contribute nothing (they could widen the
+    match set)."""
+    out: dict = {}
+
+    def ms_to_ns(ms):
+        # exact int arithmetic for integral milliseconds: int(x * 1e6)
+        # drifts past 2^53 and can prune boundary-matching segments
+        if isinstance(ms, int) or float(ms).is_integer():
+            return int(ms) * 1_000_000
+        return int(ms * 1_000_000)
+
+    def visit(node):
+        if node is None:
+            return
+        if node[0] == "and":
+            visit(node[1])
+            visit(node[2])
+            return
+        if node[0] != "cmp":
+            return
+        _k, op, col, value = node
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            return
+        if col == "_offset":
+            if op in (">", ">="):
+                lo = int(value) + (1 if op == ">" else 0)
+                out["off_lo"] = max(out.get("off_lo", 0), lo)
+            elif op == "=":
+                out["off_lo"] = max(out.get("off_lo", 0), int(value))
+        elif col == "_ts":  # milliseconds in query space, ns in storage
+            if op in (">", ">="):
+                out["ts_lo_ns"] = max(
+                    out.get("ts_lo_ns", -(1 << 62)), ms_to_ns(value)
+                )
+            elif op in ("<", "<="):
+                out["ts_hi_ns"] = min(
+                    out.get("ts_hi_ns", 1 << 62),
+                    ms_to_ns(value) + 999_999,  # whole-ms granularity
+                )
+            elif op == "=":
+                out["ts_lo_ns"] = max(
+                    out.get("ts_lo_ns", -(1 << 62)), ms_to_ns(value)
+                )
+                out["ts_hi_ns"] = min(
+                    out.get("ts_hi_ns", 1 << 62), ms_to_ns(value) + 999_999
+                )
+
+    visit(where)
+    return out
 
 
 def _like_to_match(pattern: str, s: str) -> bool:
@@ -351,44 +428,68 @@ class QueryEngine:
         )
         return matches[0]
 
-    def _scan(self, ns: str, name: str, count: int) -> Iterator[dict]:
+    def _scan(
+        self,
+        ns: str,
+        name: str,
+        count: int,
+        bounds: dict | None = None,
+        counters: dict | None = None,
+    ) -> Iterator[dict]:
         scanned = 0
         st = self.broker.topic(ns, name)
         # topics written through the Kafka gateway carry its one-byte
         # null framing; native MQ topics store raw bytes
         unwrap = _strip_null if ns == "kafka" else (lambda b: b)
+        bounds = bounds or {}
+        use_pushdown = hasattr(self.broker, "scan_records")
         for p in range(count):
             plog = st.logs.get(p)
             if plog is None:
                 continue
-            off = plog.earliest_offset
-            while self.scan_limit <= 0 or scanned < self.scan_limit:
-                recs = plog.read_from(off, max_records=2048)
-                if not recs:
-                    break
-                for o, ts_ns, key, value in recs:
-                    if self.scan_limit > 0 and scanned >= self.scan_limit:
-                        return
-                    scanned += 1
-                    row = {
-                        "_key": _maybe_text(unwrap(key)),
-                        "_ts": ts_ns // 1_000_000,
-                        "_offset": o,
-                        "_partition": p,
-                    }
-                    payload = unwrap(value)
-                    doc = None
-                    if payload:
-                        try:
-                            doc = json.loads(payload)
-                        except (ValueError, UnicodeDecodeError):
-                            doc = None
-                    if isinstance(doc, dict):
-                        row.update(doc)
-                    else:
-                        row["_value"] = _maybe_text(payload)
-                    yield row
-                off = recs[-1][0] + 1
+            if use_pushdown:
+                recs_iter = self.broker.scan_records(
+                    ns,
+                    name,
+                    p,
+                    off_lo=bounds.get("off_lo", 0),
+                    ts_lo_ns=bounds.get("ts_lo_ns"),
+                    ts_hi_ns=bounds.get("ts_hi_ns"),
+                    counters=counters,
+                )
+            else:
+                def _plain(plog=plog):
+                    off = plog.earliest_offset
+                    while True:
+                        recs = plog.read_from(off, max_records=2048)
+                        if not recs:
+                            return
+                        yield from recs
+                        off = recs[-1][0] + 1
+
+                recs_iter = _plain()
+            for o, ts_ns, key, value in recs_iter:
+                if self.scan_limit > 0 and scanned >= self.scan_limit:
+                    return
+                scanned += 1
+                row = {
+                    "_key": _maybe_text(unwrap(key)),
+                    "_ts": ts_ns // 1_000_000,
+                    "_offset": o,
+                    "_partition": p,
+                }
+                payload = unwrap(value)
+                doc = None
+                if payload:
+                    try:
+                        doc = json.loads(payload)
+                    except (ValueError, UnicodeDecodeError):
+                        doc = None
+                if isinstance(doc, dict):
+                    row.update(doc)
+                else:
+                    row["_value"] = _maybe_text(payload)
+                yield row
 
     # ---- execution ----
 
@@ -443,7 +544,13 @@ class QueryEngine:
 
     def _execute_select(self, sel: Select) -> Result:
         ns, name, count = self._resolve(sel.table)
-        return self.execute_rows(sel, self._scan(ns, name, count))
+        counters: dict = {}
+        bounds = _pushdown_bounds(sel.where)
+        result = self.execute_rows(
+            sel, self._scan(ns, name, count, bounds, counters)
+        )
+        result.stats = counters
+        return result
 
     def execute_rows(self, sel: Select, source) -> Result:
         """Run a parsed SELECT over an arbitrary row iterator — the
@@ -455,8 +562,10 @@ class QueryEngine:
             if sel.where is None or self._eval(sel.where, row)
         )
         is_agg = any(c[0] == "agg" for c in sel.columns)
-        if is_agg:
+        if is_agg or sel.group_by:
             return self._aggregate(sel, rows)
+        if sel.having is not None:
+            raise QueryError("HAVING needs GROUP BY or aggregates")
         out: list[dict] = []
         # ORDER BY needs the full set; otherwise stream until limit
         if sel.order_by is None and sel.limit >= 0:
@@ -473,12 +582,7 @@ class QueryEngine:
                         f"result exceeds {self.max_result_rows} rows; "
                         "add a LIMIT or aggregate"
                     )
-        if sel.order_by is not None:
-            col, descending = sel.order_by
-            out.sort(
-                key=lambda r: (r.get(col) is None, _sort_key(r.get(col))),
-                reverse=descending,
-            )
+        _order_rows(out, sel.order_by)
         if sel.offset:
             out = out[sel.offset :]
         if sel.limit >= 0:
@@ -505,11 +609,39 @@ class QueryEngine:
         return Result(columns=names, rows=data)
 
     def _aggregate(self, sel: Select, rows: Iterator[dict]) -> Result:
-        states: list[dict] = [
-            {"count": 0, "sum": 0.0, "min": None, "max": None}
-            for _ in sel.columns
-        ]
+        """Aggregation, optionally GROUP BY-ed: states fold
+        incrementally per group (one pass, bounded by group count, not
+        row count), then HAVING / ORDER BY / OFFSET / LIMIT apply over
+        the projected {alias: value} rows."""
+        group_cols = sel.group_by or []
+        for c in sel.columns:
+            if c[0] == "star":
+                raise QueryError("* cannot be combined with aggregates")
+            if c[0] == "col" and c[1] not in group_cols:
+                raise QueryError(
+                    f"column {c[1]!r} must appear in GROUP BY or an "
+                    "aggregate"
+                )
+
+        def fresh() -> list[dict]:
+            return [
+                {"count": 0, "sum": 0.0, "min": None, "max": None}
+                for _ in sel.columns
+            ]
+
+        groups: dict[tuple, tuple[tuple, list[dict]]] = {}
         for row in rows:
+            key = tuple(_group_key(row.get(g)) for g in group_cols)
+            hit = groups.get(key)
+            if hit is None:
+                if len(groups) >= self.max_result_rows:
+                    raise QueryError(
+                        f"more than {self.max_result_rows} groups; "
+                        "narrow the GROUP BY"
+                    )
+                hit = (tuple(row.get(g) for g in group_cols), fresh())
+                groups[key] = hit
+            _, states = hit
             for c, st in zip(sel.columns, states):
                 if c[0] != "agg":
                     continue
@@ -536,28 +668,39 @@ class QueryEngine:
                         or _sort_key(v) > _sort_key(st["max"])
                         else st["max"]
                     )
-        out_row = []
-        names = []
-        for c, st in zip(sel.columns, states):
-            if c[0] != "agg":
-                raise QueryError(
-                    "mixing aggregates with plain columns needs GROUP BY"
-                )
-            _k, fname, arg, alias = c
-            names.append(alias)
-            if fname == "COUNT":
-                out_row.append(st["count"])
-            elif fname == "SUM":
-                out_row.append(st["sum"] if st["count"] else None)
-            elif fname == "AVG":
-                out_row.append(
-                    st["sum"] / st["count"] if st["count"] else None
-                )
-            elif fname == "MIN":
-                out_row.append(st["min"])
-            elif fname == "MAX":
-                out_row.append(st["max"])
-        return Result(columns=names, rows=[out_row])
+        if not groups and not group_cols:
+            groups[()] = ((), fresh())  # global aggregate over no rows
+        names = [c[2] if c[0] == "col" else c[3] for c in sel.columns]
+        out: list[dict] = []
+        for _key, (values, states) in groups.items():
+            row_out: dict = {}
+            for c, st in zip(sel.columns, states):
+                if c[0] == "col":
+                    row_out[c[2]] = values[group_cols.index(c[1])]
+                    continue
+                _k, fname, _arg, alias = c
+                if fname == "COUNT":
+                    row_out[alias] = st["count"]
+                elif fname == "SUM":
+                    row_out[alias] = st["sum"] if st["count"] else None
+                elif fname == "AVG":
+                    row_out[alias] = (
+                        st["sum"] / st["count"] if st["count"] else None
+                    )
+                elif fname == "MIN":
+                    row_out[alias] = st["min"]
+                elif fname == "MAX":
+                    row_out[alias] = st["max"]
+            if sel.having is None or self._eval(sel.having, row_out):
+                out.append(row_out)
+        _order_rows(out, sel.order_by)
+        if sel.offset:
+            out = out[sel.offset :]
+        if sel.limit >= 0:
+            out = out[: sel.limit]
+        return Result(
+            columns=names, rows=[[r.get(n) for n in names] for r in out]
+        )
 
     def _eval(self, node, row: dict) -> bool:
         kind = node[0]
@@ -612,6 +755,34 @@ def _sort_key(v: Any):
     if isinstance(v, (int, float)):
         return (0, v)
     return (2, str(v))
+
+
+def _group_key(v: Any):
+    """Hashable, type-discriminating grouping key: NULL is its own
+    group (never folded with the string 'None'); 1 and 1.0 group
+    together per SQL equality."""
+    if v is None:
+        return ("null",)
+    if isinstance(v, bool):
+        return ("b", v)
+    if isinstance(v, (int, float)):
+        return ("n", float(v))
+    if isinstance(v, str):
+        return ("s", v)
+    return ("r", repr(v))
+
+
+def _order_rows(out: list[dict], order_by) -> None:
+    """Multi-column ORDER BY with per-column direction: stable sorts
+    applied least-significant-first (NULLs last ascending, first
+    descending — Postgres default)."""
+    if not order_by:
+        return
+    for col, descending in reversed(order_by):
+        out.sort(
+            key=lambda r: (r.get(col) is None, _sort_key(r.get(col))),
+            reverse=descending,
+        )
 
 
 def _pg_type(v: Any) -> str:

@@ -2583,9 +2583,13 @@ def volume_tier_move(env: ShellEnv, args) -> str:
         disk_types = {v.disk_type or "hdd" for v in n.volumes}
         node_addr = f"{n.location.url.split(':')[0]}:{n.location.grpc_port}"
         src_addr = f"{src.url.split(':')[0]}:{src.grpc_port}"
-        if not has_vid and node_addr != src_addr and (
-            a.targetDiskType in disk_types or not disk_types
-        ):
+        # an EMPTY node's disk type is unknowable from topology: only
+        # the default tier may claim it; never silently call an
+        # unknown disk an ssd
+        matches = a.targetDiskType in disk_types or (
+            not disk_types and a.targetDiskType == "hdd"
+        )
+        if not has_vid and node_addr != src_addr and matches:
             target = node_addr
             break
     if target is None:

@@ -766,3 +766,33 @@ def test_s3_select_csv_and_json(s3):
     # invalid SQL -> clean 400
     r = select("cities.csv", "DROP TABLE x", "<CSV/>")
     assert r.status_code == 400
+
+
+def test_s3_select_group_by(s3):
+    """GROUP BY + HAVING + ORDER BY through SelectObjectContent (the
+    round-5 engine features surface on every SQL entry point)."""
+    url, _ = s3
+    requests.put(f"{url}/selg")
+    csv_data = (
+        "city,pop\nparis,100\nparis,200\nlyon,50\nlyon,60\nnice,10\n"
+    )
+    requests.put(f"{url}/selg/c.csv", data=csv_data.encode())
+    req = (
+        '<?xml version="1.0"?><SelectObjectContentRequest>'
+        "<Expression>SELECT s.city, COUNT(*) AS n, SUM(s.pop) AS total "
+        "FROM S3Object s GROUP BY s.city HAVING n &gt;= 2 "
+        "ORDER BY total DESC</Expression>"
+        "<ExpressionType>SQL</ExpressionType>"
+        "<InputSerialization><CSV><FileHeaderInfo>USE</FileHeaderInfo>"
+        "</CSV></InputSerialization>"
+        "<OutputSerialization><JSON/></OutputSerialization>"
+        "</SelectObjectContentRequest>"
+    ).replace("&gt;", ">")
+    r = requests.post(f"{url}/selg/c.csv?select&select-type=2", data=req)
+    assert r.status_code == 200, r.text
+    events = _parse_event_stream(r.content)
+    rows = [json.loads(x) for x in events["Records"].split(b"\n") if x]
+    assert rows == [
+        {"city": "paris", "n": 2, "total": 300.0},
+        {"city": "lyon", "n": 2, "total": 110.0},
+    ]
