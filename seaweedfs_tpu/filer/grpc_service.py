@@ -136,6 +136,24 @@ class FilerGrpcService:
             self.filer.store.kv_delete(bytes(request.key))
         return fpb.FilerOpResponse()
 
+    def LookupVolume(self, request, context):
+        """Volume-location passthrough (reference filer_grpc_server.go
+        LookupVolume): mounts resolve fids to volume-server URLs here
+        so chunk reads can go DIRECT (and peer-to-peer) instead of
+        proxying every byte through the filer."""
+        from ..pb import cluster_pb2 as cpb
+
+        resp = cpb.LookupVolumeResponse()
+        for vid in request.volume_ids:
+            vl = resp.volume_locations.add()
+            vl.volume_id = vid
+            try:
+                for loc in self.filer.ops.master.lookup(vid):
+                    vl.locations.add().CopyFrom(loc)
+            except Exception as e:  # noqa: BLE001 — per-vid error
+                vl.error = str(e)
+        return resp
+
     def RunLifecycle(self, request, context):
         """Apply stored S3 lifecycle rules here, where the metadata
         lives — the execution half of the worker fleet's s3_lifecycle

@@ -16,11 +16,18 @@ def main(argv=None) -> int:
         help="filer gRPC addr (default: HTTP port + 10000)",
     )
     p.add_argument("-dir", required=True, help="mountpoint")
+    p.add_argument(
+        "-peerCache",
+        action="store_true",
+        help="share the chunk cache with other mounts (HRW peer fetch)",
+    )
     a = p.parse_args(argv)
     from .weed_mount import run_mount
 
     print(f"mounting filer {a.filer} at {a.dir}", flush=True)
-    return run_mount(a.filer, a.dir, filer_grpc=a.filerGrpc)
+    return run_mount(
+        a.filer, a.dir, filer_grpc=a.filerGrpc, peer_cache=a.peerCache
+    )
 
 
 if __name__ == "__main__":

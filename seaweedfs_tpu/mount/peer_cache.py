@@ -1,0 +1,172 @@
+"""Mount-to-mount chunk cache sharing (reference weed/mount/peer_hrw.go
++ pb/mount_peer.proto).
+
+Every participating mount runs a tiny HTTP sidecar serving its local
+chunk cache, announces itself in the filer KV (``mount.peers``), and
+routes each chunk fid to its HRW owner: the peer with the highest
+``blake2(fid, peer_id)``. A read asks the owner's cache BEFORE the
+volume server, so a chunk hot across N mounts is fetched from the
+volume tier once instead of N times. Fids are immutable, so cached
+bytes can never go stale — only evicted.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import requests
+
+from ..pb import filer_pb2 as fpb
+from ..utils.chunk_cache import ChunkCache
+
+PEERS_KEY = b"mount.peers"
+ANNOUNCE_INTERVAL = 5.0
+PEER_TTL = 30.0
+PEER_TIMEOUT = 2.0  # a slow peer must not stall reads; fall through
+
+
+def hrw_owner(fid: str, peer_ids: list[str]) -> str:
+    """Highest-random-weight owner of a fid among peer ids."""
+    return max(
+        peer_ids,
+        key=lambda p: hashlib.blake2b(
+            f"{fid}|{p}".encode(), digest_size=8
+        ).digest(),
+    )
+
+
+class PeerChunkCache:
+    """Cache + sidecar server + announce loop for one mount."""
+
+    def __init__(
+        self,
+        filer_stub_fn,
+        ip: str = "127.0.0.1",
+        capacity_bytes: int = 64 * 1024 * 1024,
+    ):
+        self._stub = filer_stub_fn
+        self.cache = ChunkCache(capacity_bytes)
+        self.peer_id = f"mount-{uuid.uuid4().hex[:10]}"
+        self.stats = {"peer_hits": 0, "peer_misses": 0, "served": 0}
+        self._http = requests.Session()
+        self._stop = threading.Event()
+        self._peers: dict[str, str] = {}  # peer_id -> addr
+        self._peers_ts = 0.0
+
+        cache = self.cache
+        stats = self.stats
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                if not self.path.startswith("/chunk/"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                data = cache.get(self.path[len("/chunk/") :])
+                if data is None:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                stats["served"] += 1
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self._server = ThreadingHTTPServer((ip, 0), _Handler)
+        self.addr = f"{ip}:{self._server.server_port}"
+        threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        ).start()
+        try:
+            self._announce()
+        except Exception:  # noqa: BLE001 — filer may not be up yet; the
+            pass  # announce loop keeps retrying
+        threading.Thread(target=self._announce_loop, daemon=True).start()
+
+    # ---------------------------------------------------------- announce
+
+    def _announce_loop(self) -> None:
+        while not self._stop.wait(ANNOUNCE_INTERVAL):
+            try:
+                self._announce()
+            except Exception:  # noqa: BLE001 — filer may be restarting
+                pass
+
+    def _announce(self) -> None:
+        stub = self._stub()
+        r = stub.KvGet(fpb.FilerKvGetRequest(key=PEERS_KEY), timeout=5)
+        try:
+            peers = json.loads(r.value) if r.found else {}
+        except ValueError:
+            peers = {}
+        now = time.time()
+        peers = {
+            pid: rec
+            for pid, rec in peers.items()
+            if now - rec.get("ts", 0) < PEER_TTL
+        }
+        peers[self.peer_id] = {"addr": self.addr, "ts": now}
+        stub.KvPut(
+            fpb.FilerKvPutRequest(
+                key=PEERS_KEY, value=json.dumps(peers).encode()
+            ),
+            timeout=5,
+        )
+        self._peers = {pid: rec["addr"] for pid, rec in peers.items()}
+        self._peers_ts = now
+
+    def peers(self) -> dict[str, str]:
+        if time.time() - self._peers_ts > ANNOUNCE_INTERVAL * 2:
+            try:
+                self._announce()
+            except Exception:  # noqa: BLE001
+                pass
+        return self._peers
+
+    # ------------------------------------------------------------- fetch
+
+    def get_chunk(self, fid: str, volume_fetch) -> bytes | None:
+        """Chunk bytes via local cache -> HRW owner's cache -> the
+        volume tier (`volume_fetch(fid) -> bytes|None`). Every fetched
+        chunk lands in the local cache (and therefore becomes servable
+        to peers)."""
+        data = self.cache.get(fid)
+        if data is not None:
+            return data
+        peers = self.peers()
+        owner = (
+            hrw_owner(fid, sorted(peers)) if peers else self.peer_id
+        )
+        if owner != self.peer_id:
+            addr = peers.get(owner)
+            if addr:
+                try:
+                    r = self._http.get(
+                        f"http://{addr}/chunk/{fid}", timeout=PEER_TIMEOUT
+                    )
+                    if r.status_code == 200:
+                        self.stats["peer_hits"] += 1
+                        self.cache.put(fid, r.content)
+                        return r.content
+                    self.stats["peer_misses"] += 1
+                except requests.RequestException:
+                    self.stats["peer_misses"] += 1
+        data = volume_fetch(fid)
+        if data is not None:
+            self.cache.put(fid, data)
+        return data
+
+    def close(self) -> None:
+        self._stop.set()
+        self._server.shutdown()
+        self._server.server_close()

@@ -62,7 +62,9 @@ class _Handle:
 
 
 class FilerMount:
-    def __init__(self, filer: str, filer_grpc: str = ""):
+    def __init__(
+        self, filer: str, filer_grpc: str = "", peer_cache: bool = False
+    ):
         self.filer = filer
         host, _, port = filer.partition(":")
         # default matches the server CLI: filer gRPC = HTTP port + 10000
@@ -94,6 +96,15 @@ class FilerMount:
                 self.readonly = bool(conf.get("readonly", False))
         except Exception:  # noqa: BLE001 — filer may not be up yet
             pass
+        # P2P chunk-cache sharing between mounts (reference
+        # weed/mount/peer_hrw.go): each chunk fid routes to its HRW
+        # owner's cache before the volume tier
+        self.peer = None
+        self._vid_urls: dict[int, tuple[float, str]] = {}
+        if peer_cache:
+            from .peer_cache import PeerChunkCache
+
+            self.peer = PeerChunkCache(self._filer_stub)
 
     def _filer_stub(self):
         with self._grpc_lock:
@@ -500,6 +511,10 @@ class FilerMount:
         committed file ends early (caller zero-fills); None only on a
         real IO error — a hole in a never-committed file reads as
         zeros, matching the old whole-file-buffer behavior."""
+        if self.peer is not None:
+            piece = self._read_range_p2p(path, offset, size)
+            if piece is not None:
+                return piece
         r = self._http.get(
             self._url(path),
             headers={"Range": f"bytes={offset}-{offset + size - 1}"},
@@ -721,6 +736,81 @@ class FilerMount:
         if r.status_code == 404:
             return -errno.ENOENT
         return -errno.EIO
+
+    def _read_range_p2p(self, path: str, offset: int, size: int) -> bytes | None:
+        """Chunk-granular read: local cache -> HRW peer cache -> DIRECT
+        volume-server GET (fids resolved via the filer's LookupVolume).
+        Returns None to fall back to the filer HTTP path (inline
+        content, manifests, compressed/ciphered chunks, any error)."""
+        from ..filer.chunks import read_chunk_views, total_size
+
+        try:
+            r = self._grpc_lookup(path)
+        except OSError:
+            return None
+        if r.error:
+            return None
+        e = r.entry
+        if not e.chunks or any(
+            c.is_chunk_manifest or c.is_compressed or c.cipher_key
+            for c in e.chunks
+        ):
+            return None
+        fsize = e.attributes.file_size or total_size(list(e.chunks))
+        end = min(offset + size, fsize)
+        if end <= offset:
+            return b""
+        out = bytearray(end - offset)
+        for v in read_chunk_views(list(e.chunks), offset, end - offset):
+            data = self.peer.get_chunk(v.fid, self._volume_fetch)
+            if data is None or len(data) < v.offset_in_chunk + v.size:
+                # short chunk body (metadata/data skew): fall back to
+                # the filer path — slice-assigning short bytes would
+                # SHRINK the buffer and shift every later view
+                return None
+            out[v.logical_offset - offset : v.logical_offset - offset + v.size] = (
+                data[v.offset_in_chunk : v.offset_in_chunk + v.size]
+            )
+        return bytes(out)
+
+    def _volume_fetch(self, fid: str) -> bytes | None:
+        """Raw chunk bytes straight from a volume server."""
+        try:
+            vid = int(fid.split(",")[0])
+        except ValueError:
+            return None
+        url = self._vid_url(vid)
+        if not url:
+            return None
+        try:
+            r = self._http.get(f"http://{url}/{fid}", timeout=30)
+        except requests.RequestException:
+            return None
+        if self.peer is not None:
+            self.peer.stats["volume_fetches"] = (
+                self.peer.stats.get("volume_fetches", 0) + 1
+            )
+        return r.content if r.status_code == 200 else None
+
+    def _vid_url(self, vid: int) -> str:
+        hit = self._vid_urls.get(vid)
+        if hit and time.time() - hit[0] < 60:
+            return hit[1]
+        from ..pb import cluster_pb2 as cpb
+
+        try:
+            resp = self._filer_stub().LookupVolume(
+                cpb.LookupVolumeRequest(volume_ids=[vid]), timeout=10
+            )
+        except Exception:  # noqa: BLE001 — transport
+            return hit[1] if hit else ""
+        url = ""
+        for vl in resp.volume_locations:
+            if vl.volume_id == vid and vl.locations:
+                url = vl.locations[0].url
+        if url:
+            self._vid_urls[vid] = (time.time(), url)
+        return url
 
     def statfs(self, path: str, sv) -> int:
         ctypes.memset(ctypes.byref(sv.contents), 0, ctypes.sizeof(fc.StatVfs))
@@ -1069,7 +1159,9 @@ def build_operations(mount: FilerMount) -> fc.FuseOperations:
     return ops
 
 
-def run_mount(filer: str, mountpoint: str, filer_grpc: str = "") -> int:
-    mount = FilerMount(filer, filer_grpc=filer_grpc)
+def run_mount(
+    filer: str, mountpoint: str, filer_grpc: str = "", peer_cache: bool = False
+) -> int:
+    mount = FilerMount(filer, filer_grpc=filer_grpc, peer_cache=peer_cache)
     ops = build_operations(mount)
     return fc.fuse_main(mountpoint, ops, foreground=True)

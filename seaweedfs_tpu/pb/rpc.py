@@ -109,6 +109,10 @@ SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
         "HardLink": (UNARY, fpb.HardLinkRequest, fpb.FilerOpResponse),
         "DistributedLock": (UNARY, fpb.DlmRequest, fpb.DlmResponse),
         "RunLifecycle": (UNARY, fpb.LifecycleRunRequest, fpb.LifecycleRunResponse),
+        # volume location passthrough (reference filer LookupVolume):
+        # mounts resolve chunk fids to volume-server URLs through the
+        # filer, so the data plane can go direct + peer-to-peer
+        "LookupVolume": (UNARY, pb.LookupVolumeRequest, pb.LookupVolumeResponse),
     },
     WORKER_SERVICE: {
         "WorkerStream": (BIDI, wk.WorkerMessage, wk.ServerMessage),

@@ -472,12 +472,7 @@ class QueryEngine:
                 if self.scan_limit > 0 and scanned >= self.scan_limit:
                     return
                 scanned += 1
-                row = {
-                    "_key": _maybe_text(unwrap(key)),
-                    "_ts": ts_ns // 1_000_000,
-                    "_offset": o,
-                    "_partition": p,
-                }
+                row = {}
                 payload = unwrap(value)
                 doc = None
                 if payload:
@@ -489,6 +484,14 @@ class QueryEngine:
                     row.update(doc)
                 else:
                     row["_value"] = _maybe_text(payload)
+                # system columns LAST: they must win over payload keys
+                # of the same name, or pushdown (which prunes on the
+                # STORAGE ts/offset) would disagree with WHERE and
+                # silently drop matching rows
+                row["_key"] = _maybe_text(unwrap(key))
+                row["_ts"] = ts_ns // 1_000_000
+                row["_offset"] = o
+                row["_partition"] = p
                 yield row
 
     # ---- execution ----

@@ -1,0 +1,134 @@
+"""Mount P2P chunk-cache sharing (reference weed/mount/peer_hrw.go +
+pb/mount_peer.proto): two mounts over one filer route chunk fetches to
+their HRW owner's cache, measurably reducing volume-server reads.
+
+The FilerMount objects are driven directly (no kernel FUSE needed —
+the P2P path lives in _read_range, below the FUSE layer)."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from conftest import allocate_port as free_port
+from seaweedfs_tpu.filer import Filer, MemoryStore
+from seaweedfs_tpu.mount.peer_cache import hrw_owner
+from seaweedfs_tpu.mount.weed_mount import FilerMount
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+
+@pytest.fixture
+def stack(tmp_path):
+    mport = free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path / "v")],
+        master=f"localhost:{mport}",
+        ip="localhost",
+        port=free_port(),
+        ec_backend="cpu",
+    )
+    vs.start()
+    deadline = time.time() + 10
+    while not master.topo.nodes:
+        assert time.time() < deadline
+        time.sleep(0.05)
+    filer = Filer(
+        MemoryStore(), master=f"localhost:{mport}", chunk_size=64 * 1024
+    )
+    fport = free_port()
+    fsrv = FilerServer(
+        filer, ip="localhost", port=fport, grpc_port=fport + 10000
+    )
+    fsrv.start()
+    yield filer, fsrv
+    fsrv.stop()
+    filer.close()
+    vs.stop()
+    master.stop()
+
+
+def test_hrw_owner_is_stable_and_balanced():
+    peers = ["m-a", "m-b", "m-c"]
+    fids = [f"3,{i:x}00deadbeef" for i in range(300)]
+    owners = [hrw_owner(f, peers) for f in fids]
+    assert owners == [hrw_owner(f, list(reversed(peers))) for f in fids]
+    per = {p: owners.count(p) for p in peers}
+    assert all(40 <= n <= 160 for n in per.values()), per
+
+
+def test_two_mounts_share_chunk_fetches(stack):
+    filer, fsrv = stack
+    # 8 chunks of 64 KiB
+    data = bytes(range(256)) * 2048  # 512 KiB
+    filer.write_file("/p2p/big.bin", data, inline=False)
+
+    a = FilerMount(f"localhost:{fsrv.port}", peer_cache=True)
+    b = FilerMount(f"localhost:{fsrv.port}", peer_cache=True)
+    try:
+        # both mounts see each other's announcements
+        deadline = time.time() + 10
+        while len(a.peer.peers()) < 2 or len(b.peer.peers()) < 2:
+            assert time.time() < deadline, (a.peer.peers(), b.peer.peers())
+            time.sleep(0.2)
+
+        got = a._read_range("/p2p/big.bin", 0, len(data))
+        assert got == data
+        n_chunks = 8
+        a_fetches = a.peer.stats.get("volume_fetches", 0)
+        assert a_fetches == n_chunks  # cold cluster: all from volume tier
+
+        got = b._read_range("/p2p/big.bin", 0, len(data))
+        assert got == data
+        b_stats = b.peer.stats
+        # B pulled the A-owned chunks from A's cache, not the volume tier
+        assert b_stats["peer_hits"] > 0, b_stats
+        assert b_stats.get("volume_fetches", 0) < n_chunks, b_stats
+        total_volume_reads = a_fetches + b_stats.get("volume_fetches", 0)
+        assert total_volume_reads < 2 * n_chunks  # the P2P win, measured
+        assert a.peer.stats["served"] == b_stats["peer_hits"]
+
+        # a re-read on B is now fully local: zero new fetches anywhere
+        before = (
+            b_stats.get("volume_fetches", 0),
+            b_stats["peer_hits"],
+        )
+        assert b._read_range("/p2p/big.bin", 0, len(data)) == data
+        assert (
+            b_stats.get("volume_fetches", 0),
+            b_stats["peer_hits"],
+        ) == before
+
+        # partial range reads assemble correctly through the cache
+        assert (
+            a._read_range("/p2p/big.bin", 100_000, 50_000)
+            == data[100_000:150_000]
+        )
+        # reads past EOF come back short, like the filer path
+        tail = b._read_range("/p2p/big.bin", len(data) - 10, 100)
+        assert tail == data[-10:]
+    finally:
+        a.peer.close()
+        b.peer.close()
+
+
+def test_peer_loss_falls_through_to_volume(stack):
+    filer, fsrv = stack
+    data = b"x" * (3 * 64 * 1024)
+    filer.write_file("/p2p/f2.bin", data, inline=False)
+    a = FilerMount(f"localhost:{fsrv.port}", peer_cache=True)
+    b = FilerMount(f"localhost:{fsrv.port}", peer_cache=True)
+    try:
+        deadline = time.time() + 10
+        while len(b.peer.peers()) < 2:
+            assert time.time() < deadline
+            time.sleep(0.2)
+        a.peer.close()  # peer dies without un-announcing
+        got = b._read_range("/p2p/f2.bin", 0, len(data))
+        assert got == data  # dead-peer timeouts fall through, no EIO
+    finally:
+        b.peer.close()
