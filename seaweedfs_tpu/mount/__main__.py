@@ -21,12 +21,22 @@ def main(argv=None) -> int:
         action="store_true",
         help="share the chunk cache with other mounts (HRW peer fetch)",
     )
+    p.add_argument(
+        "-peerIp",
+        default="127.0.0.1",
+        help="address announced to peer mounts (must be reachable "
+        "cross-host; loopback only shares between mounts on one host)",
+    )
     a = p.parse_args(argv)
     from .weed_mount import run_mount
 
     print(f"mounting filer {a.filer} at {a.dir}", flush=True)
     return run_mount(
-        a.filer, a.dir, filer_grpc=a.filerGrpc, peer_cache=a.peerCache
+        a.filer,
+        a.dir,
+        filer_grpc=a.filerGrpc,
+        peer_cache=a.peerCache,
+        peer_ip=a.peerIp,
     )
 
 

@@ -63,7 +63,11 @@ class _Handle:
 
 class FilerMount:
     def __init__(
-        self, filer: str, filer_grpc: str = "", peer_cache: bool = False
+        self,
+        filer: str,
+        filer_grpc: str = "",
+        peer_cache: bool = False,
+        peer_ip: str = "127.0.0.1",
     ):
         self.filer = filer
         host, _, port = filer.partition(":")
@@ -104,7 +108,10 @@ class FilerMount:
         if peer_cache:
             from .peer_cache import PeerChunkCache
 
-            self.peer = PeerChunkCache(self._filer_stub)
+            # peer_ip is both the sidecar bind address and what gets
+            # ANNOUNCED: cross-host sharing needs the host's reachable
+            # address here (-peerIp), not loopback
+            self.peer = PeerChunkCache(self._filer_stub, ip=peer_ip)
 
     def _filer_stub(self):
         with self._grpc_lock:
@@ -1160,8 +1167,14 @@ def build_operations(mount: FilerMount) -> fc.FuseOperations:
 
 
 def run_mount(
-    filer: str, mountpoint: str, filer_grpc: str = "", peer_cache: bool = False
+    filer: str,
+    mountpoint: str,
+    filer_grpc: str = "",
+    peer_cache: bool = False,
+    peer_ip: str = "127.0.0.1",
 ) -> int:
-    mount = FilerMount(filer, filer_grpc=filer_grpc, peer_cache=peer_cache)
+    mount = FilerMount(
+        filer, filer_grpc=filer_grpc, peer_cache=peer_cache, peer_ip=peer_ip
+    )
     ops = build_operations(mount)
     return fc.fuse_main(mountpoint, ops, foreground=True)

@@ -114,6 +114,9 @@ class S3Server:
             )
         except Exception:
             self.sse_keyring = None
+        from .tables import TablesCatalog
+
+        self.tables_catalog = TablesCatalog(self)
         self._http = ThreadingHTTPServer((ip, port), self._handler_class())
         self.tls = tls
         if tls is not None:
@@ -372,6 +375,43 @@ class S3Server:
                         ident = self._auth()
                     except S3AuthError as e:
                         return self._error(403, e.code, str(e))
+                    u = urllib.parse.urlparse(self.path)
+                    raw_path = urllib.parse.unquote(u.path)
+                    from . import tables as _tables
+
+                    # Precise matchers (no substring hijack of ordinary
+                    # object keys): /iceberg/v1/..., the S3Tables
+                    # X-Amz-Target protocol, or the CLI's ARN-rooted
+                    # REST paths. A user bucket literally named
+                    # 'iceberg'/'buckets' is shadowed, exactly like the
+                    # reference's own route registration.
+                    is_tables = self.headers.get(
+                        "X-Amz-Target", ""
+                    ).startswith("S3Tables.") or _tables.is_s3tables_path(
+                        raw_path
+                    )
+                    if raw_path.startswith("/iceberg/v1/") or is_tables:
+                        # Catalog mutation = admin surface: anonymous
+                        # callers are refused, and configured
+                        # identities must hold the Admin action (the
+                        # normal _authorize path never runs here).
+                        if self._anonymous:
+                            return self._error(
+                                403, "AccessDenied", "catalog requires auth"
+                            )
+                        if ident is not None and not ident.allows("Admin"):
+                            return self._error(
+                                403,
+                                "AccessDenied",
+                                "catalog requires the Admin action",
+                            )
+                        if raw_path.startswith("/iceberg/v1/"):
+                            return _tables.handle_iceberg(
+                                self, srv.tables_catalog, raw_path
+                            )
+                        return _tables.handle_s3tables(
+                            self, srv.tables_catalog
+                        )
                     if bucket == "" and m == "POST":
                         # STS rides the service endpoint (form POST
                         # with Action=AssumeRole, reference weed/iamapi)

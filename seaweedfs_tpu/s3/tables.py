@@ -1,0 +1,685 @@
+"""Iceberg REST catalog + AWS S3-Tables API on the S3 gateway.
+
+Reference: weed/s3api/iceberg/ (REST catalog per the Apache Iceberg
+spec, backed by table-bucket storage) and weed/s3api/s3api_tables.go
+(the AWS S3Tables surface: table buckets -> namespaces -> tables,
+driven either by X-Amz-Target JSON posts or the CLI's REST paths).
+
+Implemented subset:
+- Iceberg REST v1 under /iceberg/v1 (and /iceberg/v1/{prefix} where
+  prefix names a table bucket): config, namespace CRUD + property
+  updates, table list/create/load/exists/drop/rename, and commits that
+  set/remove properties (each commit writes a NEW metadata file and
+  appends to the metadata log, as the spec requires).
+- S3Tables: CreateTableBucket / ListTableBuckets / DeleteTableBucket,
+  Create/List/Get/DeleteNamespace, Create/List/Get/DeleteTable via
+  X-Amz-Target; ARN-path REST aliases for the same ops.
+
+Metadata files are ordinary S3 objects in the table bucket
+(<ns>/<table>/metadata/NNNNN-<uuid>.metadata.json), so any Iceberg
+reader pointed at the gateway can load them; the catalog pointers live
+in the filer KV.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import time
+import urllib.parse
+import uuid
+
+from ..filer.filer_store import NotFound
+
+DEFAULT_BUCKET = "default"  # un-prefixed /v1 routes land here
+_ARN_RE = re.compile(r"arn:aws:s3tables:[^/:]*:[^/:]*:bucket/[^/]+")
+_REST_RE = re.compile(
+    r"^/(buckets(/arn:aws:s3tables:|$|/$)"
+    r"|namespaces/arn:aws:s3tables:"
+    r"|tables/arn:aws:s3tables:)"
+)
+
+
+def is_s3tables_path(path: str) -> bool:
+    """CLI-style S3Tables REST path (ARN-rooted, or the bare /buckets
+    collection) — matched on the path PREFIX so an ordinary object key
+    merely containing an ARN substring is never hijacked."""
+    return bool(_REST_RE.match(path))
+
+
+class TablesError(Exception):
+    def __init__(self, code: int, typ: str, message: str):
+        super().__init__(message)
+        self.code = code
+        self.typ = typ
+
+
+class TablesCatalog:
+    """Catalog state in the filer KV; metadata files in the bucket."""
+
+    def __init__(self, srv):
+        self.srv = srv  # S3Server (filer + put_object access)
+
+    # ------------------------------------------------------------ kv
+
+    def _kv(self, key: str) -> dict:
+        raw = self.srv.filer.store.kv_get(key.encode())
+        if not raw:
+            return {}
+        try:
+            return json.loads(raw)
+        except ValueError:
+            return {}
+
+    def _kv_put(self, key: str, doc: dict) -> None:
+        self.srv.filer.store.kv_put(key.encode(), json.dumps(doc).encode())
+
+    # -------------------------------------------------------- buckets
+
+    def buckets(self) -> dict:
+        return self._kv("s3tables:buckets")
+
+    def create_bucket(self, name: str) -> dict:
+        b = self.buckets()
+        if name in b:
+            raise TablesError(409, "ConflictException", f"bucket {name} exists")
+        arn = f"arn:aws:s3tables:local:000000000000:bucket/{name}"
+        b[name] = {"arn": arn, "createdAt": time.time()}
+        self._kv_put("s3tables:buckets", b)
+        # the table bucket is a REAL s3 bucket: metadata/data objects
+        # live in it and are readable over the ordinary S3 surface
+        from ..filer.entry import new_entry
+
+        if not self.srv.filer.exists(f"/buckets/{name}"):
+            self.srv.filer.create_entry(
+                new_entry(f"/buckets/{name}", is_directory=True, mode=0o755)
+            )
+        return b[name]
+
+    def require_bucket(self, name: str) -> dict:
+        b = self.buckets().get(name)
+        if b is None:
+            raise TablesError(
+                404, "NotFoundException", f"table bucket {name} not found"
+            )
+        return b
+
+    def delete_bucket(self, name: str) -> None:
+        self.require_bucket(name)
+        if self._kv(f"s3tables:ns:{name}"):
+            raise TablesError(
+                409, "ConflictException", "table bucket is not empty"
+            )
+        b = self.buckets()
+        b.pop(name, None)
+        self._kv_put("s3tables:buckets", b)
+
+    # ----------------------------------------------------- namespaces
+
+    def namespaces(self, bucket: str) -> dict:
+        return self._kv(f"s3tables:ns:{bucket}")
+
+    def create_namespace(self, bucket: str, ns: str, props: dict) -> None:
+        self.require_bucket(bucket)
+        all_ns = self.namespaces(bucket)
+        if ns in all_ns:
+            raise TablesError(
+                409, "AlreadyExistsException", f"namespace {ns} exists"
+            )
+        all_ns[ns] = {"properties": props or {}, "createdAt": time.time()}
+        self._kv_put(f"s3tables:ns:{bucket}", all_ns)
+
+    def require_namespace(self, bucket: str, ns: str) -> dict:
+        got = self.namespaces(bucket).get(ns)
+        if got is None:
+            raise TablesError(
+                404, "NoSuchNamespaceException", f"namespace {ns} not found"
+            )
+        return got
+
+    def update_namespace_props(
+        self, bucket: str, ns: str, removals: list, updates: dict
+    ) -> dict:
+        all_ns = self.namespaces(bucket)
+        rec = all_ns.get(ns)
+        if rec is None:
+            raise TablesError(
+                404, "NoSuchNamespaceException", f"namespace {ns} not found"
+            )
+        missing = [r for r in removals or [] if r not in rec["properties"]]
+        for r in removals or []:
+            rec["properties"].pop(r, None)
+        rec["properties"].update(updates or {})
+        self._kv_put(f"s3tables:ns:{bucket}", all_ns)
+        return {
+            "removed": [r for r in removals or [] if r not in missing],
+            "updated": sorted((updates or {}).keys()),
+            "missing": missing,
+        }
+
+    def drop_namespace(self, bucket: str, ns: str) -> None:
+        self.require_namespace(bucket, ns)
+        if self.tables(bucket, ns):
+            raise TablesError(
+                409, "NamespaceNotEmptyException", f"namespace {ns} not empty"
+            )
+        all_ns = self.namespaces(bucket)
+        all_ns.pop(ns, None)
+        self._kv_put(f"s3tables:ns:{bucket}", all_ns)
+
+    # --------------------------------------------------------- tables
+
+    def tables(self, bucket: str, ns: str) -> dict:
+        return self._kv(f"s3tables:tables:{bucket}:{ns}")
+
+    def _write_metadata(
+        self, bucket: str, ns: str, name: str, metadata: dict, version: int
+    ) -> str:
+        body = json.dumps(metadata, indent=2).encode()
+        key = (
+            f"{ns}/{name}/metadata/"
+            f"{version:05d}-{uuid.uuid4().hex}.metadata.json"
+        )
+        self.srv.put_object(
+            bucket, key, body, mime="application/json"
+        )
+        return f"s3://{bucket}/{key}"
+
+    def create_table(
+        self, bucket: str, ns: str, name: str, schema: dict, props: dict
+    ) -> dict:
+        self.require_namespace(bucket, ns)
+        tables = self.tables(bucket, ns)
+        if name in tables:
+            raise TablesError(
+                409, "AlreadyExistsException", f"table {name} exists"
+            )
+        schema = schema or {"type": "struct", "schema-id": 0, "fields": []}
+        schema.setdefault("schema-id", 0)
+        last_col = max(
+            (f.get("id", 0) for f in schema.get("fields", [])), default=0
+        )
+        tuid = str(uuid.uuid4())
+        location = f"s3://{bucket}/{ns}/{name}"
+        metadata = {
+            "format-version": 2,
+            "table-uuid": tuid,
+            "location": location,
+            "last-sequence-number": 0,
+            "last-updated-ms": int(time.time() * 1000),
+            "last-column-id": last_col,
+            "current-schema-id": schema["schema-id"],
+            "schemas": [schema],
+            "default-spec-id": 0,
+            "partition-specs": [{"spec-id": 0, "fields": []}],
+            "last-partition-id": 999,
+            "default-sort-order-id": 0,
+            "sort-orders": [{"order-id": 0, "fields": []}],
+            "properties": props or {},
+            "current-snapshot-id": -1,
+            "snapshots": [],
+            "snapshot-log": [],
+            "metadata-log": [],
+        }
+        loc = self._write_metadata(bucket, ns, name, metadata, 0)
+        tables[name] = {
+            "uuid": tuid,
+            "location": location,
+            "metadata_location": loc,
+            "version": 0,
+            "createdAt": time.time(),
+        }
+        self._kv_put(f"s3tables:tables:{bucket}:{ns}", tables)
+        return {"metadata-location": loc, "metadata": metadata}
+
+    def load_table(self, bucket: str, ns: str, name: str) -> dict:
+        rec = self.tables(bucket, ns).get(name)
+        if rec is None:
+            raise TablesError(
+                404, "NoSuchTableException", f"table {ns}.{name} not found"
+            )
+        loc = rec["metadata_location"]
+        key = loc.split(f"s3://{bucket}/", 1)[1]
+        entry = self.srv.filer.find_entry(f"/buckets/{bucket}/{key}")
+        body = self.srv.filer.read_entry(entry)
+        return {
+            "metadata-location": loc,
+            "metadata": json.loads(body),
+            "config": {},
+        }
+
+    def commit_table(
+        self, bucket: str, ns: str, name: str, updates: list
+    ) -> dict:
+        """Apply a commit's updates. Supported update kinds:
+        set-properties / remove-properties / assign-uuid no-ops; every
+        commit writes a NEW metadata file and logs the old one."""
+        tables = self.tables(bucket, ns)
+        rec = tables.get(name)
+        if rec is None:
+            raise TablesError(
+                404, "NoSuchTableException", f"table {ns}.{name} not found"
+            )
+        loaded = self.load_table(bucket, ns, name)
+        metadata = loaded["metadata"]
+        for u in updates or []:
+            action = u.get("action", "")
+            if action == "set-properties":
+                metadata["properties"].update(u.get("updates", {}))
+            elif action == "remove-properties":
+                for k in u.get("removals", []):
+                    metadata["properties"].pop(k, None)
+            elif action in ("assign-uuid", "upgrade-format-version"):
+                pass
+            else:
+                raise TablesError(
+                    400,
+                    "UnsupportedOperationException",
+                    f"unsupported metadata update {action!r}",
+                )
+        metadata["last-updated-ms"] = int(time.time() * 1000)
+        metadata.setdefault("metadata-log", []).append(
+            {
+                "timestamp-ms": metadata["last-updated-ms"],
+                "metadata-file": rec["metadata_location"],
+            }
+        )
+        version = rec.get("version", 0) + 1
+        loc = self._write_metadata(bucket, ns, name, metadata, version)
+        rec["metadata_location"] = loc
+        rec["version"] = version
+        self._kv_put(f"s3tables:tables:{bucket}:{ns}", tables)
+        return {"metadata-location": loc, "metadata": metadata}
+
+    def drop_table(self, bucket: str, ns: str, name: str) -> None:
+        tables = self.tables(bucket, ns)
+        if name not in tables:
+            raise TablesError(
+                404, "NoSuchTableException", f"table {ns}.{name} not found"
+            )
+        tables.pop(name)
+        self._kv_put(f"s3tables:tables:{bucket}:{ns}", tables)
+
+    def rename_table(
+        self, bucket: str, src_ns: str, src: str, dst_ns: str, dst: str
+    ) -> None:
+        self.require_namespace(bucket, dst_ns)
+        src_tables = self.tables(bucket, src_ns)
+        rec = src_tables.get(src)
+        if rec is None:
+            raise TablesError(
+                404, "NoSuchTableException", f"table {src_ns}.{src} not found"
+            )
+        dst_tables = self.tables(bucket, dst_ns)
+        if dst in dst_tables and not (src_ns == dst_ns and src == dst):
+            raise TablesError(
+                409, "AlreadyExistsException", f"table {dst_ns}.{dst} exists"
+            )
+        src_tables.pop(src)
+        self._kv_put(f"s3tables:tables:{bucket}:{src_ns}", src_tables)
+        dst_tables = self.tables(bucket, dst_ns)
+        dst_tables[dst] = rec
+        self._kv_put(f"s3tables:tables:{bucket}:{dst_ns}", dst_tables)
+
+
+# ------------------------------------------------------------ handlers
+
+
+def _json_resp(h, code: int, doc: dict | list | None = None) -> None:
+    body = b"" if doc is None else json.dumps(doc).encode()
+    h.send_response(code)
+    h.send_header("Content-Type", "application/json")
+    h.send_header("Content-Length", str(len(body)))
+    h.end_headers()
+    if body and h.command != "HEAD":
+        h.wfile.write(body)
+
+
+def _err(h, e: TablesError) -> None:
+    _json_resp(
+        h,
+        e.code,
+        {"error": {"message": str(e), "type": e.typ, "code": e.code}},
+    )
+
+
+def _ns_of(part: str) -> str:
+    # Iceberg multipart namespaces join on the 0x1F unit separator
+    return urllib.parse.unquote(part).replace("\x1f", ".")
+
+
+def handle_iceberg(h, catalog: TablesCatalog, path: str) -> None:
+    """Route /iceberg/v1/... (optionally /iceberg/v1/{prefix}/... where
+    prefix names a table bucket)."""
+    parts = [p for p in path.split("/") if p][2:]  # drop iceberg, v1
+    m = h.command
+    try:
+        if parts == ["config"]:
+            warehouse = urllib.parse.parse_qs(
+                urllib.parse.urlparse(h.path).query
+            ).get("warehouse", [DEFAULT_BUCKET])[0]
+            return _json_resp(
+                h,
+                200,
+                {
+                    "defaults": {"prefix": warehouse},
+                    "overrides": {},
+                },
+            )
+        # optional {prefix} segment = table bucket
+        bucket = DEFAULT_BUCKET
+        if parts and parts[0] not in ("namespaces", "tables", "transactions"):
+            bucket = urllib.parse.unquote(parts[0])
+            parts = parts[1:]
+        body = {}
+        if m == "POST":
+            raw = h._read_body()
+            if raw:
+                body = json.loads(raw)
+        if parts == ["namespaces"]:
+            if m == "GET":
+                return _json_resp(
+                    h,
+                    200,
+                    {
+                        "namespaces": [
+                            ns.split(".")
+                            for ns in sorted(catalog.namespaces(bucket))
+                        ]
+                    },
+                )
+            if m == "POST":
+                ns = ".".join(body.get("namespace", []))
+                if not ns:
+                    raise TablesError(
+                        400, "BadRequestException", "namespace required"
+                    )
+                if bucket == DEFAULT_BUCKET and not catalog.buckets().get(
+                    bucket
+                ):
+                    catalog.create_bucket(bucket)
+                catalog.create_namespace(
+                    bucket, ns, body.get("properties", {})
+                )
+                return _json_resp(
+                    h,
+                    200,
+                    {
+                        "namespace": ns.split("."),
+                        "properties": body.get("properties", {}),
+                    },
+                )
+        if len(parts) == 2 and parts[0] == "namespaces":
+            ns = _ns_of(parts[1])
+            if m in ("GET", "HEAD"):
+                rec = catalog.require_namespace(bucket, ns)
+                if m == "HEAD":
+                    return _json_resp(h, 204)
+                return _json_resp(
+                    h,
+                    200,
+                    {
+                        "namespace": ns.split("."),
+                        "properties": rec["properties"],
+                    },
+                )
+            if m == "DELETE":
+                catalog.drop_namespace(bucket, ns)
+                return _json_resp(h, 204)
+        if (
+            len(parts) == 3
+            and parts[0] == "namespaces"
+            and parts[2] == "properties"
+            and m == "POST"
+        ):
+            ns = _ns_of(parts[1])
+            out = catalog.update_namespace_props(
+                bucket, ns, body.get("removals", []), body.get("updates", {})
+            )
+            return _json_resp(h, 200, out)
+        if len(parts) == 3 and parts[0] == "namespaces" and parts[2] == "tables":
+            ns = _ns_of(parts[1])
+            if m == "GET":
+                catalog.require_namespace(bucket, ns)
+                return _json_resp(
+                    h,
+                    200,
+                    {
+                        "identifiers": [
+                            {"namespace": ns.split("."), "name": t}
+                            for t in sorted(catalog.tables(bucket, ns))
+                        ]
+                    },
+                )
+            if m == "POST":
+                out = catalog.create_table(
+                    bucket,
+                    ns,
+                    body.get("name", ""),
+                    body.get("schema"),
+                    body.get("properties", {}),
+                )
+                return _json_resp(h, 200, out)
+        if len(parts) == 4 and parts[0] == "namespaces" and parts[2] == "tables":
+            ns, table = _ns_of(parts[1]), urllib.parse.unquote(parts[3])
+            if m in ("GET", "HEAD"):
+                out = catalog.load_table(bucket, ns, table)
+                if m == "HEAD":
+                    return _json_resp(h, 204)
+                return _json_resp(h, 200, out)
+            if m == "DELETE":
+                catalog.drop_table(bucket, ns, table)
+                return _json_resp(h, 204)
+            if m == "POST":  # commit
+                out = catalog.commit_table(
+                    bucket, ns, table, body.get("updates", [])
+                )
+                return _json_resp(h, 200, out)
+        if parts == ["tables", "rename"] and m == "POST":
+            src, dst = body.get("source", {}), body.get("destination", {})
+            catalog.rename_table(
+                bucket,
+                ".".join(src.get("namespace", [])),
+                src.get("name", ""),
+                ".".join(dst.get("namespace", [])),
+                dst.get("name", ""),
+            )
+            return _json_resp(h, 204)
+        raise TablesError(404, "NotFoundException", f"no route {m} {path}")
+    except TablesError as e:
+        return _err(h, e)
+    except NotFound as e:
+        return _err(h, TablesError(404, "NotFoundException", str(e)))
+    except (ValueError, KeyError) as e:
+        return _err(h, TablesError(400, "BadRequestException", str(e)))
+
+
+def _arn_bucket(arn: str) -> str:
+    return urllib.parse.unquote(arn).rsplit("/", 1)[-1]
+
+
+def handle_s3tables(h, catalog: TablesCatalog) -> None:
+    """AWS S3Tables ops: X-Amz-Target JSON posts AND the CLI's ARN REST
+    paths (reference s3api_tables.go)."""
+    target = h.headers.get("X-Amz-Target", "")
+    u = urllib.parse.urlparse(h.path)
+    path = urllib.parse.unquote(u.path)
+    m = h.command
+    try:
+        body = {}
+        if m in ("POST", "PUT"):
+            raw = h._read_body()
+            if raw:
+                body = json.loads(raw)
+        op = target[len("S3Tables.") :] if target else ""
+        if not op:  # REST routing; the ARN itself contains a slash, so
+            # split AROUND it with the reference's regex
+            # (s3api_tables.go tableBucketARNRegex)
+            kind = path.split("/", 2)[1] if path.count("/") else ""
+            arn_m = _ARN_RE.search(path)
+            arn = arn_m.group(0) if arn_m else ""
+            rest = (
+                [s for s in path[arn_m.end() :].split("/") if s]
+                if arn_m
+                else []
+            )
+            if kind == "buckets":
+                if m == "PUT" and not arn:
+                    op = "CreateTableBucket"
+                elif m == "GET" and not arn:
+                    op = "ListTableBuckets"
+                elif m == "GET":
+                    op, body = "GetTableBucket", {"tableBucketARN": arn}
+                elif m == "DELETE":
+                    op, body = "DeleteTableBucket", {"tableBucketARN": arn}
+            elif kind == "namespaces" and arn:
+                if m == "PUT":
+                    body = {**body, "tableBucketARN": arn}
+                    op = "CreateNamespace"
+                elif m == "GET" and not rest:
+                    op, body = "ListNamespaces", {"tableBucketARN": arn}
+                elif m == "GET" and rest:
+                    op = "GetNamespace"
+                    body = {"tableBucketARN": arn, "namespace": rest[0]}
+                elif m == "DELETE" and rest:
+                    op = "DeleteNamespace"
+                    body = {"tableBucketARN": arn, "namespace": rest[0]}
+            elif kind == "tables" and arn:
+                if m == "PUT" and rest:
+                    body = {
+                        **body,
+                        "tableBucketARN": arn,
+                        "namespace": rest[0],
+                    }
+                    op = "CreateTable"
+                elif m == "GET" and not rest:
+                    op, body = "ListTables", {"tableBucketARN": arn}
+                elif m == "GET" and len(rest) >= 2:
+                    op = "GetTable"
+                    body = {
+                        "tableBucketARN": arn,
+                        "namespace": rest[0],
+                        "name": rest[1],
+                    }
+                elif m == "DELETE" and len(rest) >= 2:
+                    op = "DeleteTable"
+                    body = {
+                        "tableBucketARN": arn,
+                        "namespace": rest[0],
+                        "name": rest[1],
+                    }
+        if not op:
+            raise TablesError(400, "BadRequestException", "unroutable request")
+
+        if op == "CreateTableBucket":
+            rec = catalog.create_bucket(body.get("name", ""))
+            return _json_resp(h, 200, {"arn": rec["arn"]})
+        if op == "ListTableBuckets":
+            return _json_resp(
+                h,
+                200,
+                {
+                    "tableBuckets": [
+                        {"arn": rec["arn"], "name": name}
+                        for name, rec in sorted(catalog.buckets().items())
+                    ]
+                },
+            )
+        if op == "GetTableBucket":
+            name = _arn_bucket(body["tableBucketARN"])
+            rec = catalog.require_bucket(name)
+            return _json_resp(h, 200, {"arn": rec["arn"], "name": name})
+        if op == "DeleteTableBucket":
+            catalog.delete_bucket(_arn_bucket(body["tableBucketARN"]))
+            return _json_resp(h, 204)
+        if op == "CreateNamespace":
+            bucket = _arn_bucket(body["tableBucketARN"])
+            ns = body.get("namespace", [])
+            ns = ns[0] if isinstance(ns, list) else ns
+            catalog.create_namespace(bucket, ns, {})
+            return _json_resp(
+                h, 200, {"namespace": [ns], "tableBucketARN": body["tableBucketARN"]}
+            )
+        if op == "ListNamespaces":
+            bucket = _arn_bucket(body["tableBucketARN"])
+            catalog.require_bucket(bucket)
+            return _json_resp(
+                h,
+                200,
+                {
+                    "namespaces": [
+                        {"namespace": [ns]}
+                        for ns in sorted(catalog.namespaces(bucket))
+                    ]
+                },
+            )
+        if op == "GetNamespace":
+            bucket = _arn_bucket(body["tableBucketARN"])
+            ns = body["namespace"]
+            catalog.require_namespace(bucket, ns)
+            return _json_resp(h, 200, {"namespace": [ns]})
+        if op == "DeleteNamespace":
+            catalog.drop_namespace(
+                _arn_bucket(body["tableBucketARN"]), body["namespace"]
+            )
+            return _json_resp(h, 204)
+        if op == "CreateTable":
+            bucket = _arn_bucket(body["tableBucketARN"])
+            out = catalog.create_table(
+                bucket,
+                body["namespace"],
+                body.get("name", ""),
+                None,
+                {},
+            )
+            tables = catalog.tables(bucket, body["namespace"])
+            rec = tables[body["name"]]
+            return _json_resp(
+                h,
+                200,
+                {
+                    "tableARN": f"arn:aws:s3tables:local:000000000000:"
+                    f"bucket/{bucket}/table/{rec['uuid']}",
+                    "versionToken": str(rec["version"]),
+                    "metadataLocation": out["metadata-location"],
+                },
+            )
+        if op == "ListTables":
+            bucket = _arn_bucket(body["tableBucketARN"])
+            catalog.require_bucket(bucket)
+            out = []
+            for ns in sorted(catalog.namespaces(bucket)):
+                for t in sorted(catalog.tables(bucket, ns)):
+                    out.append({"namespace": [ns], "name": t})
+            return _json_resp(h, 200, {"tables": out})
+        if op == "GetTable":
+            bucket = _arn_bucket(body["tableBucketARN"])
+            loaded = catalog.load_table(
+                bucket, body["namespace"], body["name"]
+            )
+            return _json_resp(
+                h,
+                200,
+                {
+                    "name": body["name"],
+                    "namespace": [body["namespace"]],
+                    "metadataLocation": loaded["metadata-location"],
+                    "format": "ICEBERG",
+                },
+            )
+        if op == "DeleteTable":
+            catalog.drop_table(
+                _arn_bucket(body["tableBucketARN"]),
+                body["namespace"],
+                body["name"],
+            )
+            return _json_resp(h, 204)
+        raise TablesError(
+            400, "UnsupportedOperationException", f"unsupported op {op}"
+        )
+    except TablesError as e:
+        return _err(h, e)
+    except NotFound as e:
+        return _err(h, TablesError(404, "NotFoundException", str(e)))
+    except (ValueError, KeyError) as e:
+        return _err(h, TablesError(400, "BadRequestException", str(e)))
