@@ -82,7 +82,9 @@ class PeerChunkCache:
             def log_message(self, *a):  # quiet
                 pass
 
-        self._server = ThreadingHTTPServer((ip, 0), _Handler)
+        # bind the wildcard but ANNOUNCE `ip`: a NAT/cloud address is
+        # reachable by peers yet not bindable locally
+        self._server = ThreadingHTTPServer(("0.0.0.0", 0), _Handler)
         self.addr = f"{ip}:{self._server.server_port}"
         threading.Thread(
             target=self._server.serve_forever, daemon=True

@@ -115,7 +115,8 @@ class FilerIdentityStore:
 
 
 def load_s3_config(path: str):
-    """-> (IdentityStore, StsService | None, OidcProvider | None)."""
+    """-> (IdentityStore, StsService | None, OidcProvider | None,
+    LdapProvider | None)."""
     with open(path) as f:
         conf = json.load(f)
     store = IdentityStore()
@@ -126,6 +127,11 @@ def load_s3_config(path: str):
         from ..iam.oidc import OidcProvider
 
         oidc = OidcProvider(**conf["oidc"])
+    ldap = None
+    if conf.get("ldap"):
+        from ..iam.ldap import LdapProvider
+
+        ldap = LdapProvider(**conf["ldap"])
     sts = None
     roles = conf.get("roles", [])
     if roles and store.empty and oidc is None:
@@ -147,4 +153,9 @@ def load_s3_config(path: str):
                 )
             )
         store.sts = sts
-    return store, sts, oidc
+    if ldap is not None and sts is None:
+        raise ValueError(
+            f"{path}: 'ldap' requires 'roles' (LDAP identities assume a "
+            "role for their credentials)"
+        )
+    return store, sts, oidc, ldap

@@ -85,6 +85,7 @@ class S3Server:
         sts=None,
         tls=None,
         oidc=None,
+        ldap=None,
     ):
         self.filer = filer
         self.ip = ip
@@ -103,6 +104,9 @@ class S3Server:
         # OIDC bearer tokens (iam/oidc.py OidcProvider): an alternative
         # authentication path beside SigV4
         self.oidc = oidc
+        # LDAP simple-bind provider (iam/ldap.py): backs the STS action
+        # AssumeRoleWithLdapIdentity
+        self.ldap = ldap
         # SSE-S3 keyring: master key shared via the filer KV store so
         # every gateway over the same filer can decrypt (KMS SPI:
         # replace with an external provider via `sse_keyring=`).
@@ -422,6 +426,11 @@ class S3Server:
                         )
                         if form.get("Action") == "AssumeRole":
                             return self._sts_assume_role(ident, form)
+                        if (
+                            form.get("Action")
+                            == "AssumeRoleWithLdapIdentity"
+                        ):
+                            return self._sts_assume_role_ldap(form)
                         return self._error(405, "MethodNotAllowed", m)
                     err = self._authorize(ident, m, bucket, key, q)
                     if err is not None:
@@ -480,6 +489,60 @@ class S3Server:
             do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = do_OPTIONS = _handle
 
             # ---- sts ----
+
+            def _sts_assume_role_ldap(self, form: dict):
+                """AssumeRoleWithLdapIdentity (reference weed/iam/ldap
+                + sts AssumeRoleWithLdapIdentity): the LDAP bind IS the
+                authentication, so no SigV4 identity is required. The
+                role must trust "*" or "ldap:<username>"."""
+                if srv.sts_service is None or srv.ldap is None:
+                    return self._error(
+                        400, "InvalidAction", "LDAP STS not configured"
+                    )
+                from ..iam.ldap import LdapError
+
+                username = form.get("LdapUsername", "")
+                try:
+                    srv.ldap.authenticate(
+                        username, form.get("LdapPassword", "")
+                    )
+                except LdapError as e:
+                    return self._error(403, "AccessDenied", f"LDAP: {e}")
+                role_name = (
+                    form.get("RoleArn", "").rsplit("/", 1)[-1]
+                    or form.get("RoleName", "")
+                )
+                try:
+                    cred = srv.sts_service.assume_role(
+                        f"ldap:{username}",
+                        None,  # LDAP callers carry no IAM policies
+                        role_name,
+                        int(form.get("DurationSeconds", "3600") or "3600"),
+                    )
+                except PermissionError as e:
+                    return self._error(403, "AccessDenied", str(e))
+                except ValueError:
+                    return self._error(
+                        400, "InvalidParameterValue", "duration"
+                    )
+                root = ET.Element(
+                    "AssumeRoleWithLdapIdentityResponse",
+                    xmlns="https://sts.amazonaws.com/doc/2011-06-15/",
+                )
+                res = _el(root, "AssumeRoleWithLdapIdentityResult")
+                c = _el(res, "Credentials")
+                _el(c, "AccessKeyId", cred.access_key)
+                _el(c, "SecretAccessKey", cred.secret_key)
+                _el(c, "SessionToken", cred.session_token)
+                _el(
+                    c,
+                    "Expiration",
+                    time.strftime(
+                        "%Y-%m-%dT%H:%M:%SZ",
+                        time.gmtime(cred.expires_at),
+                    ),
+                )
+                return self._respond(200, _xml(root))
 
             def _sts_assume_role(self, ident, form: dict):
                 if srv.sts_service is None:

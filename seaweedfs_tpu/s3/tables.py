@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import re
+import threading
 import time
 import urllib.parse
 import uuid
@@ -54,11 +55,30 @@ class TablesError(Exception):
         self.typ = typ
 
 
+_NAME_RE = re.compile(r"^[a-zA-Z0-9][a-zA-Z0-9._\-]{0,254}$")
+
+
+def _check_name(kind: str, name: str) -> str:
+    """Catalog identifiers: no empty names, no KV-separator (:) or
+    path (/) characters — 'a' + ns 'b:c' must never share a KV key
+    with bucket 'a:b' + ns 'c'."""
+    if not _NAME_RE.match(name or "") or ":" in name:
+        raise TablesError(
+            400, "BadRequestException", f"invalid {kind} name {name!r}"
+        )
+    return name
+
+
 class TablesCatalog:
-    """Catalog state in the filer KV; metadata files in the bucket."""
+    """Catalog state in the filer KV; metadata files in the bucket.
+
+    A process-wide lock serializes every read-modify-write of the KV
+    docs: ThreadingHTTPServer handles requests concurrently and a lost
+    update here orphans metadata files."""
 
     def __init__(self, srv):
         self.srv = srv  # S3Server (filer + put_object access)
+        self._lock = threading.RLock()
 
     # ------------------------------------------------------------ kv
 
@@ -80,6 +100,11 @@ class TablesCatalog:
         return self._kv("s3tables:buckets")
 
     def create_bucket(self, name: str) -> dict:
+        _check_name("bucket", name)
+        with self._lock:
+            return self._create_bucket_locked(name)
+
+    def _create_bucket_locked(self, name: str) -> dict:
         b = self.buckets()
         if name in b:
             raise TablesError(409, "ConflictException", f"bucket {name} exists")
@@ -105,6 +130,10 @@ class TablesCatalog:
         return b
 
     def delete_bucket(self, name: str) -> None:
+        with self._lock:
+            self._delete_bucket_locked(name)
+
+    def _delete_bucket_locked(self, name: str) -> None:
         self.require_bucket(name)
         if self._kv(f"s3tables:ns:{name}"):
             raise TablesError(
@@ -120,6 +149,11 @@ class TablesCatalog:
         return self._kv(f"s3tables:ns:{bucket}")
 
     def create_namespace(self, bucket: str, ns: str, props: dict) -> None:
+        _check_name("namespace", ns)
+        with self._lock:
+            self._create_namespace_locked(bucket, ns, props)
+
+    def _create_namespace_locked(self, bucket: str, ns: str, props: dict) -> None:
         self.require_bucket(bucket)
         all_ns = self.namespaces(bucket)
         if ns in all_ns:
@@ -140,6 +174,12 @@ class TablesCatalog:
     def update_namespace_props(
         self, bucket: str, ns: str, removals: list, updates: dict
     ) -> dict:
+        with self._lock:
+            return self._update_ns_props_locked(bucket, ns, removals, updates)
+
+    def _update_ns_props_locked(
+        self, bucket: str, ns: str, removals: list, updates: dict
+    ) -> dict:
         all_ns = self.namespaces(bucket)
         rec = all_ns.get(ns)
         if rec is None:
@@ -158,6 +198,10 @@ class TablesCatalog:
         }
 
     def drop_namespace(self, bucket: str, ns: str) -> None:
+        with self._lock:
+            self._drop_namespace_locked(bucket, ns)
+
+    def _drop_namespace_locked(self, bucket: str, ns: str) -> None:
         self.require_namespace(bucket, ns)
         if self.tables(bucket, ns):
             raise TablesError(
@@ -186,6 +230,13 @@ class TablesCatalog:
         return f"s3://{bucket}/{key}"
 
     def create_table(
+        self, bucket: str, ns: str, name: str, schema: dict, props: dict
+    ) -> dict:
+        _check_name("table", name)
+        with self._lock:
+            return self._create_table_locked(bucket, ns, name, schema, props)
+
+    def _create_table_locked(
         self, bucket: str, ns: str, name: str, schema: dict, props: dict
     ) -> dict:
         self.require_namespace(bucket, ns)
@@ -232,6 +283,9 @@ class TablesCatalog:
         self._kv_put(f"s3tables:tables:{bucket}:{ns}", tables)
         return {"metadata-location": loc, "metadata": metadata}
 
+    def table_exists(self, bucket: str, ns: str, name: str) -> bool:
+        return name in self.tables(bucket, ns)
+
     def load_table(self, bucket: str, ns: str, name: str) -> dict:
         rec = self.tables(bucket, ns).get(name)
         if rec is None:
@@ -249,6 +303,12 @@ class TablesCatalog:
         }
 
     def commit_table(
+        self, bucket: str, ns: str, name: str, updates: list
+    ) -> dict:
+        with self._lock:
+            return self._commit_table_locked(bucket, ns, name, updates)
+
+    def _commit_table_locked(
         self, bucket: str, ns: str, name: str, updates: list
     ) -> dict:
         """Apply a commit's updates. Supported update kinds:
@@ -292,6 +352,10 @@ class TablesCatalog:
         return {"metadata-location": loc, "metadata": metadata}
 
     def drop_table(self, bucket: str, ns: str, name: str) -> None:
+        with self._lock:
+            self._drop_table_locked(bucket, ns, name)
+
+    def _drop_table_locked(self, bucket: str, ns: str, name: str) -> None:
         tables = self.tables(bucket, ns)
         if name not in tables:
             raise TablesError(
@@ -301,6 +365,13 @@ class TablesCatalog:
         self._kv_put(f"s3tables:tables:{bucket}:{ns}", tables)
 
     def rename_table(
+        self, bucket: str, src_ns: str, src: str, dst_ns: str, dst: str
+    ) -> None:
+        _check_name("table", dst)
+        with self._lock:
+            self._rename_table_locked(bucket, src_ns, src, dst_ns, dst)
+
+    def _rename_table_locked(
         self, bucket: str, src_ns: str, src: str, dst_ns: str, dst: str
     ) -> None:
         self.require_namespace(bucket, dst_ns)
@@ -462,11 +533,16 @@ def handle_iceberg(h, catalog: TablesCatalog, path: str) -> None:
                 return _json_resp(h, 200, out)
         if len(parts) == 4 and parts[0] == "namespaces" and parts[2] == "tables":
             ns, table = _ns_of(parts[1]), urllib.parse.unquote(parts[3])
-            if m in ("GET", "HEAD"):
-                out = catalog.load_table(bucket, ns, table)
-                if m == "HEAD":
-                    return _json_resp(h, 204)
-                return _json_resp(h, 200, out)
+            if m == "HEAD":
+                if not catalog.table_exists(bucket, ns, table):
+                    raise TablesError(
+                        404, "NoSuchTableException", f"{ns}.{table}"
+                    )
+                return _json_resp(h, 204)
+            if m == "GET":
+                return _json_resp(
+                    h, 200, catalog.load_table(bucket, ns, table)
+                )
             if m == "DELETE":
                 catalog.drop_table(bucket, ns, table)
                 return _json_resp(h, 204)

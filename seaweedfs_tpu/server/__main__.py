@@ -462,18 +462,18 @@ def main(argv=None) -> int:
         if a.mode == "server" and a.s3:
             from ..s3 import Identity, IdentityStore, S3Server
 
-            sts = oidc = None
+            sts = oidc = ldap = None
             if getattr(a, "s3Config", ""):
                 from ..s3.config import load_s3_config
 
-                idents, sts, oidc = load_s3_config(a.s3Config)
+                idents, sts, oidc, ldap = load_s3_config(a.s3Config)
             else:
                 idents = IdentityStore()
             if a.s3AccessKey:
                 idents.add(Identity("admin", a.s3AccessKey, a.s3SecretKey))
             s3srv = S3Server(
                 filer, ip=a.ip, port=a.s3Port, identities=idents, sts=sts,
-                tls=_tls_from(a), oidc=oidc,
+                tls=_tls_from(a), oidc=oidc, ldap=ldap,
             )
             s3srv.start()
             servers.append(s3srv)

@@ -661,6 +661,128 @@ def test_load_s3_config_with_oidc(tmp_path):
             }
         )
     )
-    store, sts, oidc = load_s3_config(str(p))
+    store, sts, oidc, _ldap = load_s3_config(str(p))
     assert isinstance(oidc, OidcProvider) and oidc.issuer == "https://idp"
     assert store.lookup("AK") is not None
+
+
+# ---------------------------------------------------------------- LDAP
+
+
+def test_ldap_provider_and_mini_server():
+    from seaweedfs_tpu.iam.ldap import LdapError, LdapProvider, MiniLdapServer
+
+    srv = MiniLdapServer(
+        {"uid=alice,ou=users,dc=test": "alicepw"}
+    )
+    try:
+        p = LdapProvider(
+            f"ldap://127.0.0.1:{srv.port}",
+            "uid={username},ou=users,dc=test",
+        )
+        assert p.authenticate("alice", "alicepw") == (
+            "uid=alice,ou=users,dc=test"
+        )
+        with pytest.raises(LdapError):
+            p.authenticate("alice", "wrong")
+        with pytest.raises(LdapError):
+            p.authenticate("nobody", "x")
+        # RFC 4513: empty password must never authenticate (anonymous
+        # bind) — refused client-side AND by the server (code 53)
+        with pytest.raises(LdapError):
+            p.authenticate("alice", "")
+        # DN injection via username is refused before any bind
+        with pytest.raises(LdapError):
+            p.authenticate("alice,ou=admins", "x")
+    finally:
+        srv.close()
+
+
+def test_sts_assume_role_with_ldap_identity(tmp_path):
+    """Full path: LDAP bind -> temp credentials -> SigV4 signed S3
+    request with the minted credentials."""
+    import requests
+
+    from conftest import allocate_port as free_port
+    from seaweedfs_tpu.filer import Filer, MemoryStore
+    from seaweedfs_tpu.iam.ldap import LdapProvider, MiniLdapServer
+    from seaweedfs_tpu.iam.sts import Role, StsService
+    from seaweedfs_tpu.s3 import S3Server
+    from seaweedfs_tpu.s3.auth import Identity, IdentityStore
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    mport = free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path / "v")], master=f"localhost:{mport}",
+        ip="localhost", port=free_port(), ec_backend="cpu",
+    )
+    vs.start()
+    while not master.topo.nodes:
+        time.sleep(0.05)
+    ldap_srv = MiniLdapServer({"uid=bob,ou=users,dc=test": "bobpw"})
+    sts = StsService()
+    sts.put_role(
+        Role(
+            name="ldap-writer",
+            policies=[{
+                "Version": "2012-10-17",
+                "Statement": [{
+                    "Effect": "Allow",
+                    "Action": "s3:*",
+                    "Resource": "*",
+                }],
+            }],
+            trusted=["ldap:bob"],
+        )
+    )
+    idents = IdentityStore()
+    idents.add(Identity("admin", "AKADM", "adminsecret"))
+    filer = Filer(MemoryStore(), master=f"localhost:{mport}")
+    srv = S3Server(
+        filer, ip="localhost", port=free_port(), identities=idents,
+        sts=sts,
+        ldap=LdapProvider(
+            f"ldap://127.0.0.1:{ldap_srv.port}",
+            "uid={username},ou=users,dc=test",
+        ),
+    )
+    srv.start()
+    url = f"http://localhost:{srv.port}"
+    try:
+        # wrong password -> 403
+        r = requests.post(url, data={
+            "Action": "AssumeRoleWithLdapIdentity",
+            "LdapUsername": "bob", "LdapPassword": "nope",
+            "RoleName": "ldap-writer",
+        }, timeout=10)
+        assert r.status_code == 403
+        # untrusted user -> 403 even with... (only bob is trusted)
+        r = requests.post(url, data={
+            "Action": "AssumeRoleWithLdapIdentity",
+            "LdapUsername": "bob", "LdapPassword": "bobpw",
+            "RoleName": "ldap-writer",
+        }, timeout=10)
+        assert r.status_code == 200, r.text
+        import re as _re
+
+        ak = _re.search(r"<AccessKeyId>([^<]+)", r.text).group(1)
+        sk = _re.search(r"<SecretAccessKey>([^<]+)", r.text).group(1)
+        tok = _re.search(r"<SessionToken>([^<]+)", r.text).group(1)
+        # the minted credentials sign real S3 requests
+        from test_s3 import sign_request
+
+        requests.put(f"{url}/ldapbkt", headers=sign_request(
+            "PUT", f"{url}/ldapbkt", "AKADM", "adminsecret"))
+        h = sign_request("PUT", f"{url}/ldapbkt/f.txt", ak, sk, body=b"via-ldap")
+        h["x-amz-security-token"] = tok
+        r = requests.put(f"{url}/ldapbkt/f.txt", data=b"via-ldap", headers=h, timeout=10)
+        assert r.status_code == 200, r.text
+    finally:
+        srv.stop()
+        filer.close()
+        ldap_srv.close()
+        vs.stop()
+        master.stop()
