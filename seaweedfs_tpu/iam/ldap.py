@@ -79,6 +79,13 @@ class LdapProvider:
         bind_dn_template: str,
         timeout: float = 5.0,
     ):
+        if server.startswith("ldaps://"):
+            # this client has no TLS: misparsing the URL would ship a
+            # plaintext bind to host "ldaps" — refuse loudly instead
+            raise LdapError(
+                "ldaps:// is not supported by this client; terminate "
+                "TLS in front of it or use ldap:// on a trusted network"
+            )
         if server.startswith("ldap://"):
             server = server[len("ldap://") :]
         host, _, port = server.partition(":")
@@ -133,7 +140,9 @@ class LdapProvider:
             if op_tag != 0x61:  # [APPLICATION 1] BindResponse
                 raise LdapError(f"unexpected response op {op_tag:#x}")
             code_tag, code, _ = _parse_tlv(op, 0)
-            if code_tag != 0x0A:
+            if code_tag != 0x0A or not code:
+                # an EMPTY resultCode would int() to 0 == success —
+                # fail-open on a malicious/buggy endpoint
                 raise LdapError("malformed BindResponse")
             result = int.from_bytes(code, "big")
             # polite unbind; best effort

@@ -82,9 +82,14 @@ class PeerChunkCache:
             def log_message(self, *a):  # quiet
                 pass
 
-        # bind the wildcard but ANNOUNCE `ip`: a NAT/cloud address is
-        # reachable by peers yet not bindable locally
-        self._server = ThreadingHTTPServer(("0.0.0.0", 0), _Handler)
+        # Bind `ip` when possible (loopback default stays
+        # loopback-only: this sidecar is UNAUTHENTICATED); fall back to
+        # the wildcard only for a NAT/cloud announce address that is
+        # reachable by peers yet not locally bindable.
+        try:
+            self._server = ThreadingHTTPServer((ip, 0), _Handler)
+        except OSError:
+            self._server = ThreadingHTTPServer(("0.0.0.0", 0), _Handler)
         self.addr = f"{ip}:{self._server.server_port}"
         threading.Thread(
             target=self._server.serve_forever, daemon=True

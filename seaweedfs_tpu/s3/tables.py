@@ -62,7 +62,7 @@ def _check_name(kind: str, name: str) -> str:
     """Catalog identifiers: no empty names, no KV-separator (:) or
     path (/) characters — 'a' + ns 'b:c' must never share a KV key
     with bucket 'a:b' + ns 'c'."""
-    if not _NAME_RE.match(name or "") or ":" in name:
+    if not _NAME_RE.match(name or ""):
         raise TablesError(
             400, "BadRequestException", f"invalid {kind} name {name!r}"
         )

@@ -722,7 +722,10 @@ def test_sts_assume_role_with_ldap_identity(tmp_path):
     vs.start()
     while not master.topo.nodes:
         time.sleep(0.05)
-    ldap_srv = MiniLdapServer({"uid=bob,ou=users,dc=test": "bobpw"})
+    ldap_srv = MiniLdapServer({
+        "uid=bob,ou=users,dc=test": "bobpw",
+        "uid=eve,ou=users,dc=test": "evepw",  # valid LDAP, NOT trusted
+    })
     sts = StsService()
     sts.put_role(
         Role(
@@ -759,7 +762,14 @@ def test_sts_assume_role_with_ldap_identity(tmp_path):
             "RoleName": "ldap-writer",
         }, timeout=10)
         assert r.status_code == 403
-        # untrusted user -> 403 even with... (only bob is trusted)
+        # valid LDAP credentials but NOT in the role's trusted list
+        r = requests.post(url, data={
+            "Action": "AssumeRoleWithLdapIdentity",
+            "LdapUsername": "eve", "LdapPassword": "evepw",
+            "RoleName": "ldap-writer",
+        }, timeout=10)
+        assert r.status_code == 403, r.text
+        # trusted user with the right password -> credentials minted
         r = requests.post(url, data={
             "Action": "AssumeRoleWithLdapIdentity",
             "LdapUsername": "bob", "LdapPassword": "bobpw",
