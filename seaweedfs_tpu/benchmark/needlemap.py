@@ -17,8 +17,8 @@ Measured at 10M needles (this image's CPU, round 5):
 
   memory  186 B/needle resident (1.77 GB), lookups 1.3 us p50 /
           20 us p99, reopen 68 s (full .idx replay)
-  sorted  ~23 B/needle resident (229 MB), 3.0 s load, lookups
-          5.5 us p50 / 27 us p99 (binary search + pread)
+  sorted  8 B/needle resident (the id column; 80 MB), 3.0 s load,
+          lookups 5.5 us p50 / 27 us p99 (binary search + pread)
   sqlite  122k inserts/s (at 1M), lookups 5.1 us p50 / 20 us p99,
           reopen ~0 s (O(delta) watermark replay)
 
@@ -60,7 +60,9 @@ def _lookup_lat(get, ids: np.ndarray, samples: int, miss_base: int):
     lat.sort()
     t0 = time.perf_counter()
     for i in range(samples // 10):
-        get(miss_base + i)
+        # i*7+3 is never a multiple of 7: a TRUE miss (probing 1..k
+        # would hit every 7th key and blend hit cost into the number)
+        get(miss_base + i * 7 + 3)
     miss_total = time.perf_counter() - t0
     return {
         "hit_us_avg": round(hit_total / samples * 1e6, 2),
@@ -112,14 +114,18 @@ def bench(n: int, samples: int, workdir: str) -> dict:
     t0 = time.perf_counter()
     db.write_sorted_file(sorted_path)
     build_s = time.perf_counter() - t0
-    rss0 = _rss_kb()
+    del db  # free the builder before measuring the sealed map
     t0 = time.perf_counter()
     sf = SortedFileNeedleMap(sorted_path)
     load_s = time.perf_counter() - t0
     out["sorted"] = {
         "build_s": round(build_s, 2),
         "load_s": round(load_s, 2),
-        "rss_delta_mb": round((_rss_kb() - rss0) / 1024, 1),
+        # ru_maxrss is a PEAK (the memory phase dominates it), so the
+        # resident index is reported exactly: the 8-byte id column is
+        # the only thing held in RAM
+        "resident_mb": round(sf._ids.nbytes / (1 << 20), 1),
+        "bytes_per_needle": round(sf._ids.nbytes / n, 1),
         **_lookup_lat(sf.get, ids, samples, miss_base=1),
     }
     sf.close()

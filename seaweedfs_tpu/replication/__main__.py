@@ -1,8 +1,11 @@
-"""`python -m seaweedfs_tpu.replication` — continuous filer-to-filer sync.
+"""`python -m seaweedfs_tpu.replication` — continuous filer replication.
 
   python -m seaweedfs_tpu.replication -from hostA:8888 -to hostB:8888 \
       [-path /buckets] [-state sync.state]
-"""
+
+Sinks by -to shape: another filer (host:port), a cloud bucket
+(s3://endpoint/bucket[/prefix]), or a LOCAL DIRECTORY (absolute path
+or file:// URL — the reference's `weed filer.backup`)."""
 
 from __future__ import annotations
 
@@ -36,6 +39,23 @@ def main(argv=None) -> int:
     a = p.parse_args(argv)
     if not a.source or not a.target:
         p.error("-from/-to required (or replication.toml source/sink)")
+    if a.target.startswith("file://") or a.target.startswith("/"):
+        from .backup import FilerBackup
+
+        dest = a.target[len("file://") :] if a.target.startswith("file://") else a.target
+        job = FilerBackup(
+            a.source, dest, path=a.path,
+            state_path=a.state
+            if a.state != "filer.sync.state"
+            else "filer.backup.state",
+        )
+        signal.signal(signal.SIGTERM, lambda *_: job.stop())
+        signal.signal(signal.SIGINT, lambda *_: job.stop())
+        print(
+            f"filer.backup {a.source}{a.path} -> {dest}", flush=True
+        )
+        job.run()
+        return 0
     if a.target.startswith("s3://"):
         # cloud sink: -to s3://endpoint-host:port/bucket[/key-prefix]
         from ..remote.s3_client import RemoteS3Client

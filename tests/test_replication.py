@@ -214,3 +214,100 @@ def test_filer_sync_full_and_tail(two_clusters):
     assert requests.get(f"{dbase}/docs/sub/two.txt").status_code == 404
     # idempotent: re-tailing applies nothing new
     assert sync.tail_once(wait_seconds=0.2) == 0
+
+
+def test_filer_backup_to_local_dir(tmp_path):
+    """filer.backup (reference weed/command/filer_backup.go): full copy
+    then live tail into a local tree — adds, updates, renames, deletes
+    — with watermark resume across a restart."""
+    import os
+    import threading
+    import time as _time
+
+    import requests as rq
+
+    from conftest import allocate_port as free_port
+    from seaweedfs_tpu.filer import Filer, MemoryStore
+    from seaweedfs_tpu.replication.backup import FilerBackup
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    mport = free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path / "v")], master=f"localhost:{mport}",
+        ip="localhost", port=free_port(), ec_backend="cpu",
+    )
+    vs.start()
+    while not master.topo.nodes:
+        _time.sleep(0.05)
+    from seaweedfs_tpu.filer.meta_log import MetaLog
+
+    filer = Filer(MemoryStore(), master=f"localhost:{mport}")
+    fsrv = FilerServer(
+        filer, ip="localhost", port=free_port(),
+        meta_log=MetaLog(str(tmp_path / "meta")),
+    )
+    fsrv.start()
+    base = f"http://localhost:{fsrv.port}"
+    dest = str(tmp_path / "backup")
+    state = str(tmp_path / "bk.state")
+    try:
+        # pre-existing content for the full copy
+        rq.post(f"{base}/docs/a.txt", files={"f": ("a.txt", b"alpha")})
+        rq.post(f"{base}/docs/sub/b.txt", files={"f": ("b.txt", b"beta")})
+        bk = FilerBackup(
+            f"localhost:{fsrv.port}", dest, path="/docs",
+            state_path=state,
+        )
+        t = threading.Thread(target=bk.run, daemon=True)
+        t.start()
+
+        def wait_file(rel, content, timeout=15):
+            deadline = _time.time() + timeout
+            p = os.path.join(dest, rel)
+            while _time.time() < deadline:
+                if os.path.exists(p) and open(p, "rb").read() == content:
+                    return
+                _time.sleep(0.1)
+            raise AssertionError(f"{rel} never reached {content!r}")
+
+        wait_file("a.txt", b"alpha")
+        wait_file("sub/b.txt", b"beta")
+
+        # live adds + updates + deletes flow through the tail
+        rq.post(f"{base}/docs/c.txt", files={"f": ("c.txt", b"gamma")})
+        wait_file("c.txt", b"gamma")
+        rq.post(f"{base}/docs/a.txt", files={"f": ("a.txt", b"alpha-2")})
+        wait_file("a.txt", b"alpha-2")
+        rq.delete(f"{base}/docs/sub/b.txt")
+        deadline = _time.time() + 15
+        while os.path.exists(os.path.join(dest, "sub/b.txt")):
+            assert _time.time() < deadline, "delete never propagated"
+            _time.sleep(0.1)
+        # out-of-scope writes never appear
+        rq.post(f"{base}/other/x.txt", files={"f": ("x.txt", b"no")})
+        _time.sleep(1.0)
+        assert not os.path.exists(os.path.join(dest, "x.txt"))
+
+        # restart resumes from the watermark (no full recopy)
+        bk.stop()
+        t.join(timeout=15)
+        rq.post(f"{base}/docs/d.txt", files={"f": ("d.txt", b"delta")})
+        bk2 = FilerBackup(
+            f"localhost:{fsrv.port}", dest, path="/docs",
+            state_path=state,
+        )
+        assert bk2.watermark > 0  # state restored
+        t2 = threading.Thread(target=bk2.run, daemon=True)
+        t2.start()
+        wait_file("d.txt", b"delta")
+        bk2.stop()
+        t2.join(timeout=15)
+    finally:
+        fsrv.stop()
+        filer.close()
+        vs.stop()
+        master.stop()
