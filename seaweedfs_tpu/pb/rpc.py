@@ -23,6 +23,7 @@ BIDI = "stream_stream"
 MASTER_SERVICE = "sw.Seaweed"
 VOLUME_SERVICE = "sw.VolumeServer"
 MQ_SERVICE = "swmq.Messaging"
+MQ_AGENT_SERVICE = "swmqagent.SeaweedMessagingAgent"
 FILER_SERVICE = "swfiler.SeaweedFiler"
 WORKER_SERVICE = "swworker.WorkerControl"
 RAFT_SERVICE = "sw.Raft"
@@ -93,6 +94,12 @@ SERVICES: dict[str, dict[str, tuple[str, type, type]]] = {
         "TruncateTopic": (UNARY, mq.TruncateTopicRequest, mq.TruncateTopicResponse),
         "RegisterSchema": (UNARY, mq.RegisterSchemaRequest, mq.RegisterSchemaResponse),
         "GetSchema": (UNARY, mq.GetSchemaRequest, mq.GetSchemaResponse),
+    },
+    MQ_AGENT_SERVICE: {
+        "StartPublishSession": (UNARY, mq.AgentStartPublishRequest, mq.AgentStartPublishResponse),
+        "ClosePublishSession": (UNARY, mq.AgentClosePublishRequest, mq.AgentClosePublishResponse),
+        "PublishRecord": (BIDI, mq.AgentPublishRequest, mq.AgentPublishResponse),
+        "SubscribeRecord": (BIDI, mq.AgentSubscribeRequest, mq.AgentSubscribeResponse),
     },
     FILER_SERVICE: {
         "LookupDirectoryEntry": (UNARY, fpb.LookupEntryRequest, fpb.LookupEntryResponse),

@@ -140,6 +140,15 @@ def main(argv=None) -> int:
     # security.toml even though it has no HTTP listener of its own
     _add_tls_flags(b)
 
+    ag = sub.add_parser(
+        "mq.agent",
+        help="MQ agent: session facade for thin publish/subscribe "
+        "clients (reference weed mq.agent)",
+    )
+    ag.add_argument("-ip", default="localhost")
+    ag.add_argument("-port", type=int, default=16777)
+    ag.add_argument("-broker", default="localhost:17777")
+
     s = sub.add_parser("server")
     s.add_argument("-ip", default="localhost")
     s.add_argument("-masterPort", type=int, default=9333)
@@ -293,6 +302,16 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, lambda *x: stop.set())
 
     servers = []
+    if a.mode == "mq.agent":
+        from ..mq.agent import MqAgentServer
+
+        agent = MqAgentServer(a.broker, ip=a.ip, port=a.port)
+        agent.start()
+        log.info("mq agent on %s:%s -> broker %s", a.ip, agent.port, a.broker)
+        stop.wait()  # SIGTERM/SIGINT set it (handlers above)
+        agent.stop()
+        return 0
+
     if a.mode == "telemetry":
         from ..utils.telemetry_server import TelemetryServer
 

@@ -223,3 +223,145 @@ def test_broker_persistence_via_filer(tmp_path):
         fsrv.stop()
         vs.stop()
         master.stop()
+
+
+def test_mq_agent_sessions():
+    """MQ agent (reference weed/mq/agent): session facade — start a
+    publish session (auto-configures the topic), stream records with
+    per-record offset acks, stream a subscription, commit acks as
+    group offsets, refuse unknown sessions."""
+    import threading
+
+    import grpc as _grpc
+
+    from conftest import allocate_port
+    from seaweedfs_tpu.mq.agent import MqAgentServer
+    from seaweedfs_tpu.mq.broker import MqBrokerServer
+    from seaweedfs_tpu.pb import mq_pb2 as amq
+    from seaweedfs_tpu.pb import rpc as _rpc
+
+    broker = MqBrokerServer(ip="127.0.0.1", grpc_port=allocate_port())
+    broker.start()
+    agent = MqAgentServer(f"127.0.0.1:{broker.grpc_port}", ip="127.0.0.1")
+    agent.start()
+    try:
+        ch = _grpc.insecure_channel(f"127.0.0.1:{agent.port}")
+        stub = _rpc.Stub(ch, _rpc.MQ_AGENT_SERVICE)
+        r = stub.StartPublishSession(
+            amq.AgentStartPublishRequest(
+                name="agented", partition_count=1, publisher_name="t"
+            ),
+            timeout=10,
+        )
+        assert not r.error and r.session_id > 0
+        sid = r.session_id
+
+        def pubs():
+            for i in range(10):
+                yield amq.AgentPublishRequest(
+                    session_id=sid if i == 0 else 0,
+                    key=b"k%d" % i,
+                    value=b"v%d" % i,
+                )
+
+        acks = list(stub.PublishRecord(pubs(), timeout=30))
+        assert [a.ack_sequence for a in acks] == list(range(1, 11))
+        assert all(not a.error for a in acks)
+        assert [a.offset for a in acks] == list(range(10))
+
+        # subscribe from 0, ack the last offset as the group position
+        import queue as _q
+
+        reqs: "_q.Queue" = _q.Queue()
+        reqs.put(
+            amq.AgentSubscribeRequest(
+                init=amq.AgentSubscribeInit(
+                    consumer_group="g1", name="agented", partition=0,
+                    start_offset=0,
+                )
+            )
+        )
+
+        def req_iter():
+            while True:
+                item = reqs.get()
+                if item is None:
+                    return
+                yield item
+
+        got = []
+        for resp in stub.SubscribeRecord(req_iter(), timeout=30):
+            if resp.is_end_of_stream:
+                break
+            got.append((resp.offset, bytes(resp.value)))
+            if resp.offset == 9:
+                reqs.put(amq.AgentSubscribeRequest(ack_sequence=10))
+        reqs.put(None)
+        assert [o for o, _ in got] == list(range(10))
+        assert got[3][1] == b"v3"
+        # the ack committed the group offset on the broker
+        deadline = time.time() + 10
+        while (
+            broker.broker.fetch_offset("default", "agented", 0, "g1") != 10
+        ):
+            assert time.time() < deadline, "ack never committed"
+            time.sleep(0.1)
+
+        # close + unknown-session refusal
+        assert not stub.ClosePublishSession(
+            amq.AgentClosePublishRequest(session_id=sid), timeout=10
+        ).error
+        bad = list(
+            stub.PublishRecord(
+                iter([amq.AgentPublishRequest(session_id=sid, value=b"x")]),
+                timeout=10,
+            )
+        )
+        assert bad and "unknown session" in bad[0].error
+        ch.close()
+    finally:
+        agent.stop()
+        broker.stop()
+
+
+def test_mq_agent_ackless_half_close():
+    """An ack-less consumer that sends ONLY init and half-closes its
+    request stream must still receive every record (review r5: the ack
+    pump ending is a normal half-close, not a disconnect)."""
+    import grpc as _grpc
+
+    from conftest import allocate_port
+    from seaweedfs_tpu.mq.agent import MqAgentServer
+    from seaweedfs_tpu.mq.broker import MqBrokerServer
+    from seaweedfs_tpu.mq.client import MqClient
+    from seaweedfs_tpu.pb import mq_pb2 as amq
+    from seaweedfs_tpu.pb import rpc as _rpc
+
+    broker = MqBrokerServer(ip="127.0.0.1", grpc_port=allocate_port())
+    broker.start()
+    agent = MqAgentServer(f"127.0.0.1:{broker.grpc_port}", ip="127.0.0.1")
+    agent.start()
+    try:
+        c = MqClient(f"127.0.0.1:{broker.grpc_port}")
+        c.configure_topic("halfclose", partitions=1)
+        for i in range(10):
+            c.publish("halfclose", key=b"", value=b"r%d" % i)
+        ch = _grpc.insecure_channel(f"127.0.0.1:{agent.port}")
+        stub = _rpc.Stub(ch, _rpc.MQ_AGENT_SERVICE)
+        got = []
+        for resp in stub.SubscribeRecord(
+            iter([amq.AgentSubscribeRequest(
+                init=amq.AgentSubscribeInit(
+                    name="halfclose", partition=0, start_offset=0
+                )
+            )]),
+            timeout=30,
+        ):
+            if resp.is_end_of_stream:
+                break
+            got.append(resp.offset)
+        assert got == list(range(10)), got
+        ch.close()
+    finally:
+        agent.stop()
+        broker.stop()
