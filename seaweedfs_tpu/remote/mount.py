@@ -93,6 +93,27 @@ def mount(
     return n
 
 
+def mount_buckets(
+    filer: Filer, directory: str, remote_name: str, prefix_filter: str = ""
+) -> dict[str, int]:
+    """Mount EVERY bucket of a configured remote under
+    directory/<bucket> (reference remote.mount.buckets); returns
+    {bucket: objects_mapped}. Already-mounted buckets are skipped."""
+    directory = normalize_path(directory)
+    client = get_client(filer, remote_name)
+    mounts = list_mounts(filer)
+    out: dict[str, int] = {}
+    for bucket in client.list_buckets():
+        if prefix_filter and not bucket.startswith(prefix_filter):
+            continue
+        target = f"{directory}/{bucket}"
+        if target in mounts:
+            continue
+        out[bucket] = mount(filer, target, remote_name, bucket)
+        mounts = list_mounts(filer)  # mount() persisted a new entry
+    return out
+
+
 def meta_sync(filer: Filer, directory: str) -> tuple[int, int, int]:
     """Refresh a mount's metadata from the remote listing (reference
     remote.meta.sync): new objects appear, changed sizes/etags update,

@@ -214,3 +214,14 @@ class RemoteS3Client:
 
     def ensure_bucket(self, bucket: str) -> None:
         self._request("PUT", f"/{bucket}", ok=(200, 201, 409))
+
+    def list_buckets(self) -> list[str]:
+        """GET / (ListAllMyBuckets) -> bucket names."""
+        r = self._request("GET", "/")
+        root = ET.fromstring(r.content)
+        ns = root.tag[: root.tag.index("}") + 1] if root.tag.startswith("{") else ""
+        return [
+            e.text or ""
+            for e in root.findall(f".//{ns}Bucket/{ns}Name")
+            if e.text
+        ]

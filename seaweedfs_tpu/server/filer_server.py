@@ -350,6 +350,17 @@ class FilerServer:
                     if op == "uncache":
                         rm.uncache(filer, body["path"])
                         return self._json(200, {"uncached": True})
+                    if op == "mount.buckets":
+                        out = rm.mount_buckets(
+                            filer,
+                            body["dir"],
+                            body["remote"],
+                            body.get("prefix", ""),
+                        )
+                        return self._json(
+                            200,
+                            {"mounted": out, "buckets": len(out)},
+                        )
                     if op == "meta.sync":
                         added, updated, removed = rm.meta_sync(
                             filer, body["dir"]

@@ -2686,6 +2686,24 @@ def mq_topic_truncate(env: ShellEnv, args) -> str:
 
 
 @command(
+    "remote.mount.buckets",
+    "-dir /path -remote name [-prefix p] (mount every remote bucket)",
+    mutating=True,
+)
+def remote_mount_buckets(env: ShellEnv, args) -> str:
+    p = argparse.ArgumentParser(prog="remote.mount.buckets")
+    p.add_argument("-dir", required=True)
+    p.add_argument("-remote", required=True)
+    p.add_argument("-prefix", default="")
+    a = p.parse_args(args)
+    return _remote_post(
+        env,
+        "mount.buckets",
+        {"dir": a.dir, "remote": a.remote, "prefix": a.prefix},
+    )
+
+
+@command(
     "remote.meta.sync",
     "-dir /path (refresh mounted remote metadata: add/update/remove)",
     mutating=True,

@@ -366,3 +366,55 @@ def test_remote_meta_sync(stack):
         )
     finally:
         srv.stop()
+
+
+def test_remote_mount_buckets(stack):
+    """remote.mount.buckets: every (prefix-matched) cloud bucket lands
+    under dir/<bucket>, already-mounted ones are skipped."""
+    filer = stack["filer"]
+    client = RemoteS3Client(
+        endpoint=f"http://localhost:{stack['s3'].port}",
+        access_key=AK,
+        secret_key=SK,
+    )
+    for b, key in (("mb-one", "a.txt"), ("mb-two", "b.txt"), ("zz-skip", "c.txt")):
+        client.ensure_bucket(b)
+        client.put_object(b, key, b"data-" + b.encode())
+    assert set(client.list_buckets()) >= {"mb-one", "mb-two", "zz-skip"}
+    srv = FilerServer(filer, ip="localhost", port=allocate_port())
+    srv.start()
+    try:
+        base = f"http://localhost:{srv.port}"
+        requests.post(
+            base + "/~remote/configure",
+            json={
+                "name": "cmb",
+                "endpoint": f"http://localhost:{stack['s3'].port}",
+                "access_key": AK,
+                "secret_key": SK,
+            },
+            timeout=10,
+        )
+        r = requests.post(
+            base + "/~remote/mount.buckets",
+            json={"dir": "/clouds", "remote": "cmb", "prefix": "mb-"},
+            timeout=30,
+        )
+        doc = r.json()
+        assert doc["buckets"] == 2, doc
+        assert (
+            requests.get(base + "/clouds/mb-one/a.txt", timeout=10).content
+            == b"data-mb-one"
+        )
+        # idempotent: a second call mounts nothing new
+        r = requests.post(
+            base + "/~remote/mount.buckets",
+            json={"dir": "/clouds", "remote": "cmb", "prefix": "mb-"},
+            timeout=30,
+        )
+        assert r.json()["buckets"] == 0
+        from seaweedfs_tpu.shell.commands import COMMANDS
+
+        assert "remote.mount.buckets" in COMMANDS
+    finally:
+        srv.stop()
