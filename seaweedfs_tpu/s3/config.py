@@ -29,6 +29,14 @@ from .auth import Identity, IdentityStore
 S3_IDENTITY_KV = b"s3/identity.json"
 
 
+def mint_key_pair() -> tuple[str, str]:
+    """One credential format for every minting surface (shell
+    s3.accesskey.create AND the embedded IAM API)."""
+    import secrets
+
+    return "SW" + secrets.token_hex(9).upper(), secrets.token_urlsafe(30)
+
+
 def identity_from_conf(ident: dict) -> Identity:
     return Identity(
         name=ident.get("name", ident["accessKey"]),
@@ -109,6 +117,10 @@ class FilerIdentityStore:
                     try:
                         i = identity_from_conf(ident)
                     except KeyError:
+                        continue
+                    if not i.access_key:
+                        # keyless placeholder (IAM CreateUser before
+                        # CreateAccessKey): a user, not a credential
                         continue
                     dyn[i.access_key] = i
             self._dynamic = dyn

@@ -426,6 +426,27 @@ class S3Server:
                         )
                         if form.get("Action") == "AssumeRole":
                             return self._sts_assume_role(ident, form)
+                        from . import iamapi as _iam
+
+                        if form.get("Action") in _iam.ACTIONS:
+                            # embedded IAM API (reference weed/iamapi):
+                            # credential management is an Admin surface
+                            if self._anonymous or (
+                                ident is not None
+                                and not ident.allows("Admin")
+                            ):
+                                return self._error(
+                                    403,
+                                    "AccessDenied",
+                                    "IAM requires the Admin action",
+                                )
+                            try:
+                                body = _iam.execute(srv.filer.store, form)
+                            except _iam.IamError as e:
+                                return self._respond(
+                                    e.code, _iam.error_xml(e)
+                                )
+                            return self._respond(200, body)
                         if (
                             form.get("Action")
                             == "AssumeRoleWithLdapIdentity"

@@ -1757,14 +1757,13 @@ def s3_user_delete(env: ShellEnv, args) -> str:
 
 @command("s3.accesskey.create", "-user name [-actions A,B] (generate a key pair)")
 def s3_accesskey_create(env: ShellEnv, args) -> str:
-    import secrets as _secrets
+    from ..s3.config import mint_key_pair
 
     p = argparse.ArgumentParser(prog="s3.accesskey.create")
     p.add_argument("-user", required=True)
     p.add_argument("-actions", default="")
     a = p.parse_args(args)
-    access_key = "SW" + _secrets.token_hex(9).upper()
-    secret_key = _secrets.token_urlsafe(30)
+    access_key, secret_key = mint_key_pair()
     out = s3_configure(
         env,
         [

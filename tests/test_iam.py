@@ -796,3 +796,153 @@ def test_sts_assume_role_with_ldap_identity(tmp_path):
         ldap_srv.close()
         vs.stop()
         master.stop()
+
+
+# ----------------------------------------------------- embedded IAM API
+
+
+def test_embedded_iam_api(tmp_path):
+    """weed/iamapi analog: user + access-key + policy lifecycle over
+    the AWS 2010-05-08 query protocol, with minted keys authenticating
+    real S3 requests within the identity store's reload window."""
+    import json
+    import re as _re
+
+    from conftest import allocate_port as free_port
+    from seaweedfs_tpu.filer import Filer, MemoryStore
+
+    mport = free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path / "v")], master=f"localhost:{mport}",
+        ip="localhost", port=free_port(), ec_backend="cpu",
+    )
+    vs.start()
+    while not master.topo.nodes:
+        time.sleep(0.05)
+    idents = IdentityStore()
+    idents.add(Identity("root", "AKROOT", "rootsecret"))  # admin
+    idents.add(Identity("ro", "AKRO2", "rosecret2", actions=("Read",)))
+    filer = Filer(MemoryStore(), master=f"localhost:{mport}")
+    srv = S3Server(filer, ip="localhost", port=free_port(), identities=idents)
+    # fast identity reload so minted keys work inside the test
+    srv.identities._ttl = 0.1
+    srv.start()
+    url = f"http://localhost:{srv.port}"
+    from test_s3 import sign_request
+
+    def iam(form, ak="AKROOT", sk="rootsecret"):
+        import urllib.parse as _up
+
+        body = _up.urlencode(form).encode()
+        h = sign_request("POST", f"{url}/", ak, sk, body=body)
+        h["Content-Type"] = "application/x-www-form-urlencoded"
+        return requests.post(url, data=body, headers=h, timeout=10)
+
+    try:
+        # non-admin refused
+        assert iam(
+            {"Action": "CreateUser", "UserName": "x"}, "AKRO2", "rosecret2"
+        ).status_code == 403
+        # create user -> key -> authenticate with it
+        r = iam({"Action": "CreateUser", "UserName": "svc"})
+        assert r.status_code == 200 and "<UserName>svc<" in r.text
+        assert iam({"Action": "CreateUser", "UserName": "svc"}).status_code == 409
+        r = iam({"Action": "CreateAccessKey", "UserName": "svc"})
+        assert r.status_code == 200, r.text
+        ak = _re.search(r"<AccessKeyId>([^<]+)", r.text).group(1)
+        sk = _re.search(r"<SecretAccessKey>([^<]+)", r.text).group(1)
+        r = iam({"Action": "ListUsers"})
+        assert "<UserName>svc<" in r.text
+        r = iam({"Action": "ListAccessKeys", "UserName": "svc"})
+        assert ak in r.text
+        # the minted key signs a real S3 request (admin by default)
+        time.sleep(0.3)  # identity reload TTL
+        h = sign_request("PUT", f"{url}/iambkt", ak, sk)
+        assert requests.put(f"{url}/iambkt", headers=h, timeout=10).status_code == 200
+        # attach a read-only policy: writes now refused for that key
+        pol = {
+            "Version": "2012-10-17",
+            "Statement": [{
+                "Effect": "Allow",
+                "Action": ["s3:GetObject", "s3:ListBucket"],
+                "Resource": "*",
+            }],
+        }
+        r = iam({
+            "Action": "PutUserPolicy", "UserName": "svc",
+            "PolicyName": "ro", "PolicyDocument": json.dumps(pol),
+        })
+        assert r.status_code == 200, r.text
+        r = iam({"Action": "GetUserPolicy", "UserName": "svc"})
+        assert "s3:GetObject" in r.text
+        time.sleep(0.3)
+        h = sign_request("PUT", f"{url}/iambkt/f.txt", ak, sk, body=b"x")
+        assert (
+            requests.put(
+                f"{url}/iambkt/f.txt", data=b"x", headers=h, timeout=10
+            ).status_code
+            == 403
+        )
+        # delete the key: authentication stops working
+        r = iam({"Action": "DeleteAccessKey", "AccessKeyId": ak})
+        assert r.status_code == 200
+        time.sleep(0.3)
+        h = sign_request("GET", f"{url}/iambkt", ak, sk)
+        assert requests.get(f"{url}/iambkt", headers=h, timeout=10).status_code == 403
+        # delete the user
+        assert iam({"Action": "DeleteUser", "UserName": "svc"}).status_code == 200
+        assert (
+            iam({"Action": "ListAccessKeys", "UserName": "svc"}).status_code
+            == 404
+        )
+    finally:
+        srv.stop()
+        filer.close()
+        vs.stop()
+        master.stop()
+
+
+def test_iam_api_policy_then_key_never_escalates(tmp_path):
+    """Review r5: CreateAccessKey AFTER PutUserPolicy (and after a
+    delete+recreate cycle) must not default the key to Admin — the
+    policy travels and the coarse actions stay empty."""
+    from seaweedfs_tpu.filer import MemoryStore
+    from seaweedfs_tpu.s3 import iamapi
+
+    store = MemoryStore()
+    pol = {
+        "Version": "2012-10-17",
+        "Statement": [{
+            "Effect": "Allow", "Action": "s3:GetObject", "Resource": "*",
+        }],
+    }
+    iamapi.execute(store, {"Action": "CreateUser", "UserName": "locked"})
+    iamapi.execute(store, {
+        "Action": "PutUserPolicy", "UserName": "locked",
+        "PolicyName": "ro", "PolicyDocument": __import__("json").dumps(pol),
+    })
+    import re as _re
+
+    r = iamapi.execute(
+        store, {"Action": "CreateAccessKey", "UserName": "locked"}
+    ).decode()
+    ak = _re.search(r"<AccessKeyId>([^<]+)", r).group(1)
+    conf = iamapi._load(store)
+    entry = next(i for i in conf["identities"] if i.get("accessKey") == ak)
+    assert entry["actions"] == []  # NOT ["Admin"]
+    assert entry["policies"] == [pol]
+    # delete + recreate keeps the restriction
+    iamapi.execute(store, {"Action": "DeleteAccessKey", "AccessKeyId": ak})
+    r = iamapi.execute(
+        store, {"Action": "GetUserPolicy", "UserName": "locked"}
+    ).decode()
+    assert "s3:GetObject" in r
+    r = iamapi.execute(
+        store, {"Action": "CreateAccessKey", "UserName": "locked"}
+    ).decode()
+    ak2 = _re.search(r"<AccessKeyId>([^<]+)", r).group(1)
+    conf = iamapi._load(store)
+    entry = next(i for i in conf["identities"] if i.get("accessKey") == ak2)
+    assert entry["actions"] == [] and entry["policies"] == [pol]
