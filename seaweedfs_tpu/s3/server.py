@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import base64
 import binascii
+import contextlib
 import hashlib
 import json
 import threading
@@ -121,6 +122,9 @@ class S3Server:
         from .tables import TablesCatalog
 
         self.tables_catalog = TablesCatalog(self)
+        # serializes conditional (If-Match / If-None-Match) PUTs so the
+        # precondition and the write are atomic w.r.t. each other
+        self._cond_put_lock = threading.Lock()
         self._http = ThreadingHTTPServer((ip, port), self._handler_class())
         self.tls = tls
         if tls is not None:
@@ -1450,6 +1454,40 @@ class S3Server:
 
             # ---- object ----
 
+            def _put_object_body(self, bucket: str, key: str):
+                """The shared plain-PUT body (copy, SSE, ACL, store);
+                callers have already evaluated quotas/preconditions."""
+                src = self.headers.get("x-amz-copy-source", "")
+                if src:
+                    return self._copy_object(bucket, key, src)
+                data = self._read_body()
+                ext = self._lock_headers_extended(bucket)
+                # server-side encryption: explicit SSE-C / SSE-S3
+                # headers, else the bucket's default configuration
+                ssec_key, sse_algo = sse.resolve_put_encryption(
+                    self.headers, srv.bucket_default_encryption(bucket)
+                )
+                data, sse_ext, sse_hdrs = sse.encrypt_for_put(
+                    data, ssec_key, sse_algo, srv.sse_keyring
+                )
+                ext.update(sse_ext)
+                acl = self._canned_acl_header()
+                if acl:
+                    ext["s3-acl"] = acl.encode()
+                entry, vid = srv.put_object(
+                    bucket,
+                    key,
+                    data,
+                    mime=self.headers.get("Content-Type", "")
+                    or "application/octet-stream",
+                    extra_extended=ext,
+                )
+                etag = entry.attr.md5.hex()
+                extra = {"ETag": f'"{etag}"', **sse_hdrs}
+                if vid:
+                    extra["x-amz-version-id"] = vid
+                return self._respond(200, extra=extra)
+
             def _object_op(self, bucket: str, key: str, q: dict):
                 bpath = f"{BUCKETS_ROOT}/{bucket}"
                 if not srv.filer.exists(bpath):
@@ -1485,36 +1523,55 @@ class S3Server:
                             "QuotaExceeded",
                             f"bucket {bucket} is over its storage quota",
                         )
-                    src = self.headers.get("x-amz-copy-source", "")
-                    if src:
-                        return self._copy_object(bucket, key, src)
-                    data = self._read_body()
-                    ext = self._lock_headers_extended(bucket)
-                    # server-side encryption: explicit SSE-C / SSE-S3
-                    # headers, else the bucket's default configuration
-                    ssec_key, sse_algo = sse.resolve_put_encryption(
-                        self.headers, srv.bucket_default_encryption(bucket)
+                    # AWS conditional writes: If-None-Match: * =
+                    # create-only; If-Match: <etag> = compare-and-swap.
+                    # The precondition and the write hold one lock so
+                    # two racing CAS PUTs can never both pass the check
+                    # (check-then-act would lose an update silently).
+                    inm = self.headers.get("If-None-Match", "")
+                    im = self.headers.get("If-Match", "")
+                    cond_guard = (
+                        srv._cond_put_lock
+                        if (inm or im)
+                        else contextlib.nullcontext()
                     )
-                    data, sse_ext, sse_hdrs = sse.encrypt_for_put(
-                        data, ssec_key, sse_algo, srv.sse_keyring
-                    )
-                    ext.update(sse_ext)
-                    acl = self._canned_acl_header()
-                    if acl:
-                        ext["s3-acl"] = acl.encode()
-                    entry, vid = srv.put_object(
-                        bucket,
-                        key,
-                        data,
-                        mime=self.headers.get("Content-Type", "")
-                        or "application/octet-stream",
-                        extra_extended=ext,
-                    )
-                    etag = entry.attr.md5.hex()
-                    extra = {"ETag": f'"{etag}"', **sse_hdrs}
-                    if vid:
-                        extra["x-amz-version-id"] = vid
-                    return self._respond(200, extra=extra)
+                    with cond_guard:
+                        if inm or im:
+                            try:
+                                cur = srv.filer.find_entry(path)
+                            except NotFound:
+                                cur = None
+                            if cur is not None and (
+                                cur.is_directory
+                                or vtag.is_delete_marker(cur)
+                            ):
+                                # logically absent: a delete marker or
+                                # a directory placeholder is NOT an
+                                # object (AWS create-only PUT succeeds
+                                # over a deleted key)
+                                cur = None
+                            if inm == "*" and cur is not None:
+                                return self._error(
+                                    412,
+                                    "PreconditionFailed",
+                                    "object already exists "
+                                    "(If-None-Match: *)",
+                                )
+                            if im:
+                                cur_etag = (
+                                    _entry_etag(cur)
+                                    if cur is not None
+                                    else ""
+                                )
+                                if not cur_etag or not _etag_cond_match(
+                                    im, cur_etag
+                                ):
+                                    return self._error(
+                                        412,
+                                        "PreconditionFailed",
+                                        "ETag mismatch (If-Match)",
+                                    )
+                        return self._put_object_body(bucket, key)
                 if m in ("GET", "HEAD"):
                     vid_param = q.get("versionId", "")
                     entry = self._resolve_version(bucket, key, path, vid_param)
@@ -1548,6 +1605,38 @@ class S3Server:
                             until.isoformat()
                         )
                     ctype = entry.attr.mime or "application/octet-stream"
+                    # conditional reads (RFC 9110 semantics, the subset
+                    # S3 documents): If-(None-)Match on the ETag,
+                    # If-(Un)Modified-Since on Last-Modified
+                    etag_now = _entry_etag(entry)
+                    inm = self.headers.get("If-None-Match", "")
+                    ims_ts = _http_date(
+                        self.headers.get("If-Modified-Since", "")
+                    )
+                    if (inm and _etag_cond_match(inm, etag_now)) or (
+                        not inm
+                        and ims_ts is not None
+                        and entry.attr.mtime <= ims_ts
+                    ):
+                        self.send_response(304)
+                        for hk, hv in headers.items():
+                            self.send_header(hk, hv)
+                        self.end_headers()
+                        return
+                    imatch = self.headers.get("If-Match", "")
+                    ius_ts = _http_date(
+                        self.headers.get("If-Unmodified-Since", "")
+                    )
+                    if (
+                        imatch and not _etag_cond_match(imatch, etag_now)
+                    ) or (
+                        not imatch
+                        and ius_ts is not None
+                        and entry.attr.mtime > ius_ts
+                    ):
+                        return self._error(
+                            412, "PreconditionFailed", "precondition failed"
+                        )
                     if m == "HEAD":
                         self.send_response(200)
                         for k, v in headers.items():
@@ -2471,6 +2560,34 @@ def _required_action(method: str, bucket: str, key: str) -> str:
             return "Write"
         return "Admin"  # bucket create/delete
     return "Read" if method in ("GET", "HEAD") else "Write"
+
+
+def _http_date(header: str):
+    """RFC 7231 date -> epoch seconds, or None for malformed input
+    (RFC 9110: an unparseable validator date IGNORES the condition)."""
+    try:
+        import email.utils as _eu
+
+        return _eu.parsedate_to_datetime(header).timestamp()
+    except (TypeError, ValueError):
+        return None
+
+
+def _etag_cond_match(header: str, etag: str) -> bool:
+    """RFC 9110 If-(None-)Match list semantics: '*' matches any
+    existing representation; otherwise EXACT entity-tag comparison per
+    comma-separated member (substring matching would confuse
+    'deadbeef-2' with 'deadbeef-25')."""
+    header = header.strip()
+    if header == "*":
+        return True
+    for member in header.split(","):
+        tag = member.strip()
+        if tag.startswith("W/"):
+            tag = tag[2:]
+        if tag.strip('"') == etag:
+            return True
+    return False
 
 
 def _entry_etag(entry) -> str:

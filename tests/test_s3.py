@@ -531,3 +531,95 @@ def test_sigv4_body_hash_binding(s3_signed):
     # untampered goes through
     r = requests.put(f"{base}/bind/obj", data=body, headers=h)
     assert r.status_code == 200
+
+
+def test_conditional_reads_and_writes(s3):
+    """AWS conditional requests: If-None-Match:* create-only PUT,
+    If-Match compare-and-swap PUT, and 304/412 conditional GETs."""
+    url = s3
+    requests.put(f"{url}/cond")
+    # create-only PUT succeeds once, 412s after
+    r = requests.put(
+        f"{url}/cond/k", data=b"v1", headers={"If-None-Match": "*"}
+    )
+    assert r.status_code == 200, r.text
+    etag1 = r.headers["ETag"]
+    r = requests.put(
+        f"{url}/cond/k", data=b"v2", headers={"If-None-Match": "*"}
+    )
+    assert r.status_code == 412
+    assert requests.get(f"{url}/cond/k").content == b"v1"
+    # CAS: correct ETag swaps, stale ETag 412s
+    r = requests.put(
+        f"{url}/cond/k", data=b"v2", headers={"If-Match": etag1}
+    )
+    assert r.status_code == 200
+    etag2 = r.headers["ETag"]
+    r = requests.put(
+        f"{url}/cond/k", data=b"v3", headers={"If-Match": etag1}
+    )
+    assert r.status_code == 412
+    assert requests.get(f"{url}/cond/k").content == b"v2"
+    # If-Match on a missing key: 412 (nothing to match)
+    r = requests.put(
+        f"{url}/cond/absent", data=b"x", headers={"If-Match": etag1}
+    )
+    assert r.status_code == 412
+
+    # conditional GETs
+    r = requests.get(f"{url}/cond/k", headers={"If-None-Match": etag2})
+    assert r.status_code == 304
+    lm = requests.head(f"{url}/cond/k").headers["Last-Modified"]
+    r = requests.get(f"{url}/cond/k", headers={"If-Modified-Since": lm})
+    assert r.status_code == 304
+    r = requests.get(f"{url}/cond/k", headers={"If-Match": etag1})
+    assert r.status_code == 412
+    r = requests.get(f"{url}/cond/k", headers={"If-Match": etag2})
+    assert r.status_code == 200 and r.content == b"v2"
+    r = requests.get(
+        f"{url}/cond/k",
+        headers={"If-Unmodified-Since": "Thu, 01 Jan 1970 00:00:00 GMT"},
+    )
+    assert r.status_code == 412
+
+
+def test_conditional_edge_semantics(s3):
+    """Review r5: exact entity-tag list matching (no substring traps),
+    If-Match:* on GET succeeds, malformed validator dates are IGNORED,
+    and a versioned delete marker counts as absent for If-None-Match:*."""
+    url = s3
+    requests.put(f"{url}/cond2")
+    r = requests.put(f"{url}/cond2/k", data=b"v1")
+    etag = r.headers["ETag"].strip('"')
+    # If-Match: * on an existing object -> 200 (never 412)
+    r = requests.get(f"{url}/cond2/k", headers={"If-Match": "*"})
+    assert r.status_code == 200
+    # substring trap: a LONGER etag containing ours must NOT match
+    r = requests.get(
+        f"{url}/cond2/k", headers={"If-None-Match": f'"{etag}5"'}
+    )
+    assert r.status_code == 200  # no false 304
+    r = requests.get(
+        f"{url}/cond2/k",
+        headers={"If-None-Match": f'"other", W/"{etag}"'},
+    )
+    assert r.status_code == 304  # list member + weak prefix match
+    # malformed date validators are ignored, not 412
+    r = requests.get(
+        f"{url}/cond2/k", headers={"If-Unmodified-Since": "not-a-date"}
+    )
+    assert r.status_code == 200
+    # versioned bucket: delete marker = logically absent
+    requests.put(
+        f"{url}/cond2?versioning",
+        data=b"<VersioningConfiguration><Status>Enabled</Status>"
+        b"</VersioningConfiguration>",
+    )
+    requests.put(f"{url}/cond2/vk", data=b"x")
+    requests.delete(f"{url}/cond2/vk")
+    assert requests.get(f"{url}/cond2/vk").status_code == 404
+    r = requests.put(
+        f"{url}/cond2/vk", data=b"fresh", headers={"If-None-Match": "*"}
+    )
+    assert r.status_code == 200, r.text
+    assert requests.get(f"{url}/cond2/vk").content == b"fresh"
