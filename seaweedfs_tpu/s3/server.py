@@ -122,9 +122,11 @@ class S3Server:
         from .tables import TablesCatalog
 
         self.tables_catalog = TablesCatalog(self)
-        # serializes conditional (If-Match / If-None-Match) PUTs so the
-        # precondition and the write are atomic w.r.t. each other
-        self._cond_put_lock = threading.Lock()
+        # Striped per-key write locks: a conditional PUT's precondition
+        # must be atomic against EVERY write to that key (a plain PUT
+        # racing a CAS would otherwise be silently lost), and striping
+        # bounds memory while keeping unrelated keys uncontended.
+        self._put_locks = [threading.Lock() for _ in range(64)]
         self._http = ThreadingHTTPServer((ip, port), self._handler_class())
         self.tls = tls
         if tls is not None:
@@ -1530,12 +1532,14 @@ class S3Server:
                     # (check-then-act would lose an update silently).
                     inm = self.headers.get("If-None-Match", "")
                     im = self.headers.get("If-Match", "")
-                    cond_guard = (
-                        srv._cond_put_lock
-                        if (inm or im)
-                        else contextlib.nullcontext()
-                    )
-                    with cond_guard:
+                    if inm and inm != "*":
+                        # AWS: conditional writes only support '*'
+                        return self._error(
+                            501,
+                            "NotImplemented",
+                            "If-None-Match only supports *",
+                        )
+                    with srv.put_lock(path):
                         if inm or im:
                             try:
                                 cur = srv.filer.find_entry(path)
@@ -1989,6 +1993,35 @@ class S3Server:
                         # a versioned key behind a delete marker reads
                         # as absent — copy must 404 like GET does
                         return self._error(404, "NoSuchKey", src)
+                # x-amz-copy-source-if-* preconditions (AWS CopyObject):
+                # same RFC 9110 matching as GET, evaluated against the
+                # SOURCE entry before any bytes move
+                src_etag = _entry_etag(entry)
+                cim = self.headers.get("x-amz-copy-source-if-match", "")
+                cinm = self.headers.get(
+                    "x-amz-copy-source-if-none-match", ""
+                )
+                cims = _http_date(
+                    self.headers.get(
+                        "x-amz-copy-source-if-modified-since", ""
+                    )
+                )
+                cius = _http_date(
+                    self.headers.get(
+                        "x-amz-copy-source-if-unmodified-since", ""
+                    )
+                )
+                if (
+                    (cim and not _etag_cond_match(cim, src_etag))
+                    or (cinm and _etag_cond_match(cinm, src_etag))
+                    or (cims is not None and entry.attr.mtime <= cims)
+                    or (cius is not None and entry.attr.mtime > cius)
+                ):
+                    return self._error(
+                        412,
+                        "PreconditionFailed",
+                        "copy source precondition failed",
+                    )
                 data = srv.filer.read_entry(entry)
                 # decrypt the source (SSE-C via the x-amz-copy-source-*
                 # key headers; SSE-S3 via the keyring), then apply the
@@ -2393,6 +2426,9 @@ class S3Server:
         {"s3:GetObject", "s3:GetObjectVersion", "s3:ListBucket"}
     )
     _ACL_WRITE_ACTIONS = frozenset({"s3:PutObject", "s3:DeleteObject"})
+
+    def put_lock(self, path: str) -> threading.Lock:
+        return self._put_locks[zlib.crc32(path.encode()) % len(self._put_locks)]
 
     def acl_allows_anonymous(self, bucket: str, key: str, action: str) -> bool:
         """Canned-ACL grant check for unauthenticated requests:

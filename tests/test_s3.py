@@ -623,3 +623,57 @@ def test_conditional_edge_semantics(s3):
     )
     assert r.status_code == 200, r.text
     assert requests.get(f"{url}/cond2/vk").content == b"fresh"
+
+
+def test_copy_source_conditionals(s3):
+    """x-amz-copy-source-if-* preconditions on CopyObject."""
+    url = s3
+    requests.put(f"{url}/csrc")
+    r = requests.put(f"{url}/csrc/a", data=b"orig")
+    etag = r.headers["ETag"]
+    # matching if-match copies; stale if-match 412s
+    r = requests.put(
+        f"{url}/csrc/b",
+        headers={
+            "x-amz-copy-source": "/csrc/a",
+            "x-amz-copy-source-if-match": etag,
+        },
+    )
+    assert r.status_code == 200, r.text
+    assert requests.get(f"{url}/csrc/b").content == b"orig"
+    r = requests.put(
+        f"{url}/csrc/c",
+        headers={
+            "x-amz-copy-source": "/csrc/a",
+            "x-amz-copy-source-if-match": '"deadbeef"',
+        },
+    )
+    assert r.status_code == 412
+    assert requests.get(f"{url}/csrc/c").status_code == 404
+    # if-none-match matching -> 412
+    r = requests.put(
+        f"{url}/csrc/d",
+        headers={
+            "x-amz-copy-source": "/csrc/a",
+            "x-amz-copy-source-if-none-match": etag,
+        },
+    )
+    assert r.status_code == 412
+    # unmodified-since in the past -> 412; malformed -> ignored
+    r = requests.put(
+        f"{url}/csrc/e",
+        headers={
+            "x-amz-copy-source": "/csrc/a",
+            "x-amz-copy-source-if-unmodified-since":
+                "Thu, 01 Jan 1970 00:00:00 GMT",
+        },
+    )
+    assert r.status_code == 412
+    r = requests.put(
+        f"{url}/csrc/f",
+        headers={
+            "x-amz-copy-source": "/csrc/a",
+            "x-amz-copy-source-if-unmodified-since": "garbage",
+        },
+    )
+    assert r.status_code == 200
