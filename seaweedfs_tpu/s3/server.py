@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import base64
 import binascii
-import contextlib
 import hashlib
 import json
 import threading
@@ -123,10 +122,12 @@ class S3Server:
 
         self.tables_catalog = TablesCatalog(self)
         # Striped per-key write locks: a conditional PUT's precondition
-        # must be atomic against EVERY write to that key (a plain PUT
-        # racing a CAS would otherwise be silently lost), and striping
-        # bounds memory while keeping unrelated keys uncontended.
-        self._put_locks = [threading.Lock() for _ in range(64)]
+        # must be atomic against EVERY write to that key (a plain PUT,
+        # multipart completion, POST-policy upload, or DELETE racing a
+        # CAS would otherwise be silently lost). REENTRANT because the
+        # conditional-PUT path holds its stripe around put_object,
+        # which takes the same stripe as the common funnel.
+        self._put_locks = [threading.RLock() for _ in range(64)]
         self._http = ThreadingHTTPServer((ip, port), self._handler_class())
         self.tls = tls
         if tls is not None:
@@ -1681,7 +1682,10 @@ class S3Server:
                         )
                     return self._respond(status, data, ctype, headers)
                 if m == "DELETE":
-                    return self._delete_object(bucket, key, path, q)
+                    # same stripe as writes: a DELETE racing an
+                    # If-Match PUT must not resurrect/lose either side
+                    with srv.put_lock(path):
+                        return self._delete_object(bucket, key, path, q)
                 return self._error(405, "MethodNotAllowed", m)
 
             def _lock_headers_extended(self, bucket: str) -> dict:
@@ -2011,11 +2015,21 @@ class S3Server:
                         "x-amz-copy-source-if-unmodified-since", ""
                     )
                 )
+                # RFC 9110 precedence, same as the GET path: an ETag
+                # condition overrides its date counterpart
                 if (
                     (cim and not _etag_cond_match(cim, src_etag))
+                    or (
+                        not cim
+                        and cius is not None
+                        and entry.attr.mtime > cius
+                    )
                     or (cinm and _etag_cond_match(cinm, src_etag))
-                    or (cims is not None and entry.attr.mtime <= cims)
-                    or (cius is not None and entry.attr.mtime > cius)
+                    or (
+                        not cinm
+                        and cims is not None
+                        and entry.attr.mtime <= cims
+                    )
                 ):
                     return self._error(
                         412,
@@ -2295,7 +2309,11 @@ class S3Server:
                     final.extended[k2] = v2
                 for k2, v2 in (meta.get("lock_ext") or {}).items():
                     final.extended[k2] = v2.encode()
-                # versioning-aware finalize (mirrors srv.put_object)
+                # versioning-aware finalize (mirrors srv.put_object);
+                # the key's write stripe makes it atomic vs CAS PUTs
+                # and deletes on the same key
+                final_lock = srv.put_lock(final_path)
+                final_lock.acquire()
                 state = srv.bucket_versioning(bucket)
                 vid = ""
                 old = None
@@ -2320,7 +2338,10 @@ class S3Server:
                         old = srv.filer.find_entry(final_path)
                     except NotFound:
                         old = None
-                srv.filer.create_entry(final)
+                try:
+                    srv.filer.create_entry(final)
+                finally:
+                    final_lock.release()
                 if old is not None and not old.is_directory:
                     srv.filer.gc_chunks(old.chunks)
                 # drop part entries WITHOUT GC'ing chunks (now referenced
@@ -2478,6 +2499,20 @@ class S3Server:
         s3api_object_versioning.go putVersionedObject). Returns
         (entry, version_id-or-None)."""
         path = normalize_path(f"{BUCKETS_ROOT}/{bucket}/{key}")
+        with self.put_lock(path):
+            return self._put_object_locked(
+                bucket, key, path, data, mime, extra_extended
+            )
+
+    def _put_object_locked(
+        self,
+        bucket: str,
+        key: str,
+        path: str,
+        data: bytes,
+        mime: str,
+        extra_extended: dict | None,
+    ):
         state = self.bucket_versioning(bucket)
         ext = dict(extra_extended or {})
         ext.update(vtag.default_retention_extended(self.lock_conf(bucket)))

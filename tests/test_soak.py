@@ -98,6 +98,19 @@ def test_mixed_load_during_maintenance(tmp_path):
         time.sleep(1.0)
         run_command(env, f"volume.vacuum -volumeId {vids[-1]}")
         time.sleep(1.0)
+        # round-5 maintenance verbs under the same live load:
+        # in-place replication rewrite, vacuum opt-out, cluster.ps
+        out = run_command(
+            env, f"volume.configure.replication -volumeId {vids[-1]} "
+            "-replication 000"
+        )
+        assert "replication ->" in out, out
+        out = run_command(env, f"volume.vacuum.disable -volumeId {vids[-1]}")
+        assert "disabled" in out, out
+        run_command(env, f"volume.vacuum.enable -volumeId {vids[-1]}")
+        out = run_command(env, "cluster.ps")
+        assert "volumeServer" in out, out
+        time.sleep(0.5)
     finally:
         stop.set()
         # worst-case in-flight upload (retries + backoff) well under this
