@@ -505,6 +505,34 @@ def test_produce_compressed_codecs_golden(sess):
         )
 
 
+def _join_sync(sess, group: str, topic: str, corr: int) -> str:
+    """JoinGroup (empty member id -> elected leader) + SyncGroup with a
+    range assignment; returns the generated member id. One copy of the
+    wire dance shared by the group-cycle and introspection tests."""
+    meta = i16(0) + i32(1) + s(topic) + i32(0)  # consumer subscription v0
+    member_w = W(2 + 4 + 13, "member id", capture="_js_member")
+    sess.transcript(
+        hdr(11, 0, corr=corr, client="gold")
+        + s(group) + i32(10000) + s("") + s("consumer")
+        + i32(1) + s("range") + i32(len(meta)) + meta,
+        i32(corr) + i16(0) + i32(1) + s("range"),
+        member_w,
+        W(2 + 4 + 13, "member id"),
+        i32(1),
+        W(2 + 4 + 13, "member id"),
+        i32(len(meta)) + meta,
+    )
+    member = sess.captured["_js_member"][2:].decode()
+    assign = i16(0) + i32(1) + s(topic) + i32(1) + i32(0) + i32(0)
+    sess.transcript(
+        hdr(14, 0, corr=corr + 1)
+        + s(group) + i32(1) + s(member)
+        + i32(1) + s(member) + i32(len(assign)) + assign,
+        i32(corr + 1) + i16(0) + i32(len(assign)) + assign,
+    )
+    return member
+
+
 def test_group_cycle_golden(sess):
     _create(sess, "gt", corr=90)
     # T: FindCoordinator v0 (key only)
@@ -512,43 +540,18 @@ def test_group_cycle_golden(sess):
         hdr(10, 0, corr=91) + s("g-gold"),
         i32(91) + i16(0) + i32(0) + HOST, PORT_W,
     )
-    # T: JoinGroup v0 — empty member id; response echoes our protocol
-    # and elects us leader. member_id = "<client_id>-<12 hex>".
-    meta = i16(0) + i32(1) + s("gt") + i32(0)  # consumer subscription v0
-    member_w = W(2 + 4 + 13, "member id", capture="member")
-    sess.transcript(
-        hdr(11, 0, corr=92, client="gold")
-        + s("g-gold")
-        + i32(10000)  # session_timeout
-        + s("")  # member_id
-        + s("consumer")
-        + i32(1) + s("range") + i32(len(meta)) + meta,
-        i32(92) + i16(0) + i32(1)  # error, generation
-        + s("range"),  # protocol
-        member_w,  # leader id (== our member id)
-        W(2 + 4 + 13, "member id"),  # our member id again
-        i32(1),  # members array (leader sees all)
-        W(2 + 4 + 13, "member id"),
-        i32(len(meta)) + meta,
-    )
-    member = sess.captured["member"][2:]  # strip the length prefix
-    # T: SyncGroup v0 — leader ships assignments; everyone gets theirs
-    assign = i16(0) + i32(1) + s("gt") + i32(1) + i32(0) + i32(0)
-    sess.transcript(
-        hdr(14, 0, corr=93)
-        + s("g-gold") + i32(1) + s(member.decode())
-        + i32(1) + s(member.decode()) + i32(len(assign)) + assign,
-        i32(93) + i16(0) + i32(len(assign)) + assign,
-    )
+    # T: JoinGroup v0 + SyncGroup v0 (shared wire dance; member_id =
+    # "<client_id>-<12 hex>")
+    member_s = _join_sync(sess, "g-gold", "gt", corr=92)
     # T: Heartbeat v0
     sess.transcript(
-        hdr(12, 0, corr=94) + s("g-gold") + i32(1) + s(member.decode()),
+        hdr(12, 0, corr=94) + s("g-gold") + i32(1) + s(member_s),
         i32(94) + i16(0),
     )
     # T: OffsetCommit v2
     sess.transcript(
         hdr(8, 2, corr=95)
-        + s("g-gold") + i32(1) + s(member.decode()) + i64(-1)
+        + s("g-gold") + i32(1) + s(member_s) + i64(-1)
         + i32(1) + s("gt") + i32(1) + i32(0) + i64(41) + s("meta"),
         i32(95) + i32(1) + s("gt") + i32(1) + i32(0) + i16(0),
     )
@@ -560,7 +563,7 @@ def test_group_cycle_golden(sess):
     )
     # T: LeaveGroup v0
     sess.transcript(
-        hdr(13, 0, corr=97) + s("g-gold") + s(member.decode()),
+        hdr(13, 0, corr=97) + s("g-gold") + s(member_s),
         i32(97) + i16(0),
     )
 
@@ -605,4 +608,36 @@ def test_error_paths_golden(sess):
         i32(112) + i32(0) + i32(1) + s("oor") + i32(1)
         + i32(0) + i16(1) + i64(0) + i64(0) + i32(0)
         + i32(-1),  # null records
+    )
+
+
+def test_group_introspection_golden(sess):
+    """ListGroups v1 + DescribeGroups v0: the group coordinator's
+    introspection surface, byte-matched after a real join/sync."""
+    _create(sess, "gi", corr=120)
+    meta = i16(0) + i32(1) + s("gi") + i32(0)
+    assign = i16(0) + i32(1) + s("gi") + i32(1) + i32(0) + i32(0)
+    _join_sync(sess, "g-intro", "gi", corr=121)
+    # ListGroups v1: throttle, error, [(group, protocol_type)]
+    sess.transcript(
+        hdr(16, 1, corr=123),
+        i32(123) + i32(0) + i16(0) + i32(1) + s("g-intro") + s("consumer"),
+    )
+    # DescribeGroups v0: Stable group with our member + assignment
+    sess.transcript(
+        hdr(15, 0, corr=124) + i32(1) + s("g-intro"),
+        i32(124) + i32(1)
+        + i16(0) + s("g-intro") + s("Stable") + s("consumer") + s("range")
+        + i32(1),
+        W(2 + 4 + 13, "member id"),
+        s("gold")  # client_id (threaded from the request header)
+        + s("/127.0.0.1")
+        + i32(len(meta)) + meta
+        + i32(len(assign)) + assign,
+    )
+    # unknown group reads as Dead, not an error
+    sess.transcript(
+        hdr(15, 0, corr=125) + i32(1) + s("nope"),
+        i32(125) + i32(1)
+        + i16(0) + s("nope") + s("Dead") + s("") + s("") + i32(0),
     )
