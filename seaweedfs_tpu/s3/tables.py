@@ -311,9 +311,9 @@ class TablesCatalog:
     def _commit_table_locked(
         self, bucket: str, ns: str, name: str, updates: list
     ) -> dict:
-        """Apply a commit's updates. Supported update kinds:
-        set-properties / remove-properties / assign-uuid no-ops; every
-        commit writes a NEW metadata file and logs the old one."""
+        """Apply a commit's updates (the Iceberg spec's
+        TableUpdate kinds — see _apply_metadata_update); every commit
+        writes a NEW metadata file and logs the old one."""
         tables = self.tables(bucket, ns)
         rec = tables.get(name)
         if rec is None:
@@ -323,20 +323,7 @@ class TablesCatalog:
         loaded = self.load_table(bucket, ns, name)
         metadata = loaded["metadata"]
         for u in updates or []:
-            action = u.get("action", "")
-            if action == "set-properties":
-                metadata["properties"].update(u.get("updates", {}))
-            elif action == "remove-properties":
-                for k in u.get("removals", []):
-                    metadata["properties"].pop(k, None)
-            elif action in ("assign-uuid", "upgrade-format-version"):
-                pass
-            else:
-                raise TablesError(
-                    400,
-                    "UnsupportedOperationException",
-                    f"unsupported metadata update {action!r}",
-                )
+            _apply_metadata_update(metadata, u)
         metadata["last-updated-ms"] = int(time.time() * 1000)
         metadata.setdefault("metadata-log", []).append(
             {
@@ -348,6 +335,9 @@ class TablesCatalog:
         loc = self._write_metadata(bucket, ns, name, metadata, version)
         rec["metadata_location"] = loc
         rec["version"] = version
+        # an assign-uuid commit must keep the catalog record (the
+        # source of the S3Tables ARN) in step with the metadata
+        rec["uuid"] = metadata.get("table-uuid", rec.get("uuid"))
         self._kv_put(f"s3tables:tables:{bucket}:{ns}", tables)
         return {"metadata-location": loc, "metadata": metadata}
 
@@ -391,6 +381,163 @@ class TablesCatalog:
         dst_tables = self.tables(bucket, dst_ns)
         dst_tables[dst] = rec
         self._kv_put(f"s3tables:tables:{bucket}:{dst_ns}", dst_tables)
+
+
+def _apply_metadata_update(metadata: dict, u: dict) -> None:
+    """One Iceberg TableUpdate against the v2 metadata JSON (the kinds
+    real writers — pyiceberg, Spark — emit in commits). Unknown kinds
+    fail loudly: silently dropping an update would corrupt the table's
+    history invisibly."""
+    action = u.get("action", "")
+    if action == "set-properties":
+        metadata["properties"].update(u.get("updates", {}))
+    elif action == "remove-properties":
+        for k in u.get("removals", []):
+            metadata["properties"].pop(k, None)
+    elif action == "assign-uuid":
+        metadata["table-uuid"] = u.get("uuid", metadata["table-uuid"])
+    elif action == "upgrade-format-version":
+        fv = int(u.get("format-version", metadata["format-version"]))
+        if fv < metadata["format-version"]:
+            raise TablesError(
+                400, "BadRequestException", "cannot downgrade format-version"
+            )
+        if fv > 2:
+            # this catalog writes v2 metadata; CLAIMING v3 without its
+            # required fields (next-row-id, ...) would persist files
+            # spec-compliant readers reject
+            raise TablesError(
+                400,
+                "UnsupportedOperationException",
+                f"format-version {fv} not supported (v2 catalog)",
+            )
+        metadata["format-version"] = fv
+    elif action == "set-location":
+        metadata["location"] = u.get("location", metadata["location"])
+    elif action == "add-schema":
+        schema = u.get("schema") or {}
+        metadata.setdefault("schemas", []).append(schema)
+        lc = u.get("last-column-id")
+        if lc is None:
+            lc = max(
+                (f.get("id", 0) for f in schema.get("fields", [])),
+                default=metadata.get("last-column-id", 0),
+            )
+        metadata["last-column-id"] = max(
+            metadata.get("last-column-id", 0), int(lc)
+        )
+    elif action == "set-current-schema":
+        sid = int(u.get("schema-id", -1))
+        if sid == -1:  # spec: -1 = the schema added in this commit
+            sid = metadata["schemas"][-1].get("schema-id", 0)
+        if not any(
+            sc.get("schema-id") == sid for sc in metadata.get("schemas", [])
+        ):
+            raise TablesError(
+                400, "BadRequestException", f"unknown schema-id {sid}"
+            )
+        metadata["current-schema-id"] = sid
+    elif action == "add-spec":
+        metadata.setdefault("partition-specs", []).append(u.get("spec") or {})
+        fields = (u.get("spec") or {}).get("fields", [])
+        metadata["last-partition-id"] = max(
+            metadata.get("last-partition-id", 999),
+            max((f.get("field-id", 0) for f in fields), default=0),
+        )
+    elif action == "set-default-spec":
+        sid = int(u.get("spec-id", -1))
+        if sid == -1:
+            sid = metadata["partition-specs"][-1].get("spec-id", 0)
+        if not any(
+            sp.get("spec-id") == sid
+            for sp in metadata.get("partition-specs", [])
+        ):
+            raise TablesError(
+                400, "BadRequestException", f"unknown spec-id {sid}"
+            )
+        metadata["default-spec-id"] = sid
+    elif action == "add-sort-order":
+        metadata.setdefault("sort-orders", []).append(
+            u.get("sort-order") or {}
+        )
+    elif action == "set-default-sort-order":
+        oid = int(u.get("sort-order-id", -1))
+        if oid == -1:
+            oid = metadata["sort-orders"][-1].get("order-id", 0)
+        if not any(
+            so.get("order-id") == oid
+            for so in metadata.get("sort-orders", [])
+        ):
+            raise TablesError(
+                400, "BadRequestException", f"unknown sort-order-id {oid}"
+            )
+        metadata["default-sort-order-id"] = oid
+    elif action == "add-snapshot":
+        snap = u.get("snapshot") or {}
+        if "snapshot-id" not in snap:
+            raise TablesError(
+                400, "BadRequestException", "snapshot needs snapshot-id"
+            )
+        metadata.setdefault("snapshots", []).append(snap)
+        metadata["last-sequence-number"] = max(
+            metadata.get("last-sequence-number", 0),
+            int(snap.get("sequence-number", 0)),
+        )
+    elif action == "set-snapshot-ref":
+        ref = u.get("ref-name", "main")
+        sid = int(u.get("snapshot-id", -1))
+        if not any(
+            sn.get("snapshot-id") == sid
+            for sn in metadata.get("snapshots", [])
+        ):
+            raise TablesError(
+                400, "BadRequestException", f"unknown snapshot-id {sid}"
+            )
+        metadata.setdefault("refs", {})[ref] = {
+            "snapshot-id": sid,
+            "type": u.get("type", "branch"),
+        }
+        if ref == "main":
+            metadata["current-snapshot-id"] = sid
+            metadata.setdefault("snapshot-log", []).append(
+                {
+                    "timestamp-ms": int(time.time() * 1000),
+                    "snapshot-id": sid,
+                }
+            )
+    elif action == "remove-snapshot-ref":
+        ref = u.get("ref-name", "")
+        metadata.get("refs", {}).pop(ref, None)
+        if ref == "main":
+            # removing the main branch leaves no current snapshot
+            metadata["current-snapshot-id"] = -1
+    elif action == "remove-snapshots":
+        gone = set(u.get("snapshot-ids", []))
+        metadata["snapshots"] = [
+            sn
+            for sn in metadata.get("snapshots", [])
+            if sn.get("snapshot-id") not in gone
+        ]
+        # nothing may keep POINTING at an expired snapshot: drop refs,
+        # log entries, and the current pointer with it
+        metadata["refs"] = {
+            rn: rv
+            for rn, rv in metadata.get("refs", {}).items()
+            if rv.get("snapshot-id") not in gone
+        }
+        metadata["snapshot-log"] = [
+            e
+            for e in metadata.get("snapshot-log", [])
+            if e.get("snapshot-id") not in gone
+        ]
+        if metadata.get("current-snapshot-id") in gone:
+            metadata["current-snapshot-id"] = -1
+    else:
+        raise TablesError(
+            400,
+            "UnsupportedOperationException",
+            f"unsupported metadata update {action!r}",
+        )
 
 
 # ------------------------------------------------------------ handlers

@@ -505,10 +505,11 @@ def test_produce_compressed_codecs_golden(sess):
         )
 
 
-def _join_sync(sess, group: str, topic: str, corr: int) -> str:
+def _join_sync(sess, group: str, topic: str, corr: int):
     """JoinGroup (empty member id -> elected leader) + SyncGroup with a
-    range assignment; returns the generated member id. One copy of the
-    wire dance shared by the group-cycle and introspection tests."""
+    range assignment; returns (member_id, meta_bytes, assign_bytes) so
+    callers never re-encode the wire shapes. One copy of the dance
+    shared by the group-cycle and introspection tests."""
     meta = i16(0) + i32(1) + s(topic) + i32(0)  # consumer subscription v0
     member_w = W(2 + 4 + 13, "member id", capture="_js_member")
     sess.transcript(
@@ -530,7 +531,7 @@ def _join_sync(sess, group: str, topic: str, corr: int) -> str:
         + i32(1) + s(member) + i32(len(assign)) + assign,
         i32(corr + 1) + i16(0) + i32(len(assign)) + assign,
     )
-    return member
+    return member, meta, assign
 
 
 def test_group_cycle_golden(sess):
@@ -542,7 +543,7 @@ def test_group_cycle_golden(sess):
     )
     # T: JoinGroup v0 + SyncGroup v0 (shared wire dance; member_id =
     # "<client_id>-<12 hex>")
-    member_s = _join_sync(sess, "g-gold", "gt", corr=92)
+    member_s, _meta, _assign = _join_sync(sess, "g-gold", "gt", corr=92)
     # T: Heartbeat v0
     sess.transcript(
         hdr(12, 0, corr=94) + s("g-gold") + i32(1) + s(member_s),
@@ -615,9 +616,7 @@ def test_group_introspection_golden(sess):
     """ListGroups v1 + DescribeGroups v0: the group coordinator's
     introspection surface, byte-matched after a real join/sync."""
     _create(sess, "gi", corr=120)
-    meta = i16(0) + i32(1) + s("gi") + i32(0)
-    assign = i16(0) + i32(1) + s("gi") + i32(1) + i32(0) + i32(0)
-    _join_sync(sess, "g-intro", "gi", corr=121)
+    _member, meta, assign = _join_sync(sess, "g-intro", "gi", corr=121)
     # ListGroups v1: throttle, error, [(group, protocol_type)]
     sess.transcript(
         hdr(16, 1, corr=123),

@@ -303,3 +303,80 @@ def test_catalog_requires_admin_action(cluster):
     finally:
         srv.stop()
         filer.close()
+
+
+def test_iceberg_snapshot_commit_lifecycle(s3):
+    """The commit kinds real Iceberg writers emit: add-snapshot +
+    set-snapshot-ref advance current-snapshot-id and the snapshot log;
+    schema evolution via add-schema/set-current-schema; refs; snapshot
+    expiry via remove-snapshots."""
+    url, _srv = s3
+    ib = f"{url}/iceberg/v1"
+    requests.post(f"{ib}/namespaces", json={"namespace": ["snapns"]}, timeout=10)
+    r = requests.post(
+        f"{ib}/namespaces/snapns/tables",
+        json={"name": "t", "schema": SCHEMA},
+        timeout=10,
+    )
+    assert r.status_code == 200, r.text
+
+    def commit(updates, expect=200):
+        r = requests.post(
+            f"{ib}/namespaces/snapns/tables/t",
+            json={"updates": updates},
+            timeout=10,
+        )
+        assert r.status_code == expect, r.text
+        return r.json() if expect == 200 else None
+
+    snap = {
+        "snapshot-id": 4242,
+        "sequence-number": 1,
+        "timestamp-ms": 1700000000000,
+        "manifest-list": "s3://default/snapns/t/metadata/snap-4242.avro",
+        "summary": {"operation": "append"},
+    }
+    out = commit([
+        {"action": "add-snapshot", "snapshot": snap},
+        {"action": "set-snapshot-ref", "ref-name": "main",
+         "snapshot-id": 4242, "type": "branch"},
+    ])
+    md = out["metadata"]
+    assert md["current-snapshot-id"] == 4242
+    assert md["snapshots"][0]["snapshot-id"] == 4242
+    assert md["last-sequence-number"] == 1
+    assert md["snapshot-log"][-1]["snapshot-id"] == 4242
+    assert md["refs"]["main"]["snapshot-id"] == 4242
+
+    # schema evolution
+    new_schema = {
+        "type": "struct", "schema-id": 1,
+        "fields": SCHEMA["fields"] + [
+            {"id": 3, "name": "extra", "required": False, "type": "string"}
+        ],
+    }
+    out = commit([
+        {"action": "add-schema", "schema": new_schema},
+        {"action": "set-current-schema", "schema-id": -1},
+    ])
+    md = out["metadata"]
+    assert md["current-schema-id"] == 1
+    assert md["last-column-id"] == 3
+    assert len(md["schemas"]) == 2
+
+    # ref to an unknown snapshot fails loudly
+    commit(
+        [{"action": "set-snapshot-ref", "ref-name": "main",
+          "snapshot-id": 999}],
+        expect=400,
+    )
+    # snapshot expiry also drops every pointer at the gone snapshot
+    out = commit([{"action": "remove-snapshots", "snapshot-ids": [4242]}])
+    md = out["metadata"]
+    assert md["snapshots"] == []
+    assert md["current-snapshot-id"] == -1
+    assert md["refs"] == {}
+    assert all(e["snapshot-id"] != 4242 for e in md["snapshot-log"])
+    # the reloaded table reflects every commit (metadata persisted)
+    r = requests.get(f"{ib}/namespaces/snapns/tables/t", timeout=10)
+    assert r.json()["metadata"]["current-schema-id"] == 1
