@@ -303,17 +303,33 @@ class TablesCatalog:
         }
 
     def commit_table(
-        self, bucket: str, ns: str, name: str, updates: list
+        self,
+        bucket: str,
+        ns: str,
+        name: str,
+        updates: list,
+        requirements: list | None = None,
     ) -> dict:
         with self._lock:
-            return self._commit_table_locked(bucket, ns, name, updates)
+            return self._commit_table_locked(
+                bucket, ns, name, updates, requirements
+            )
 
     def _commit_table_locked(
-        self, bucket: str, ns: str, name: str, updates: list
+        self,
+        bucket: str,
+        ns: str,
+        name: str,
+        updates: list,
+        requirements: list | None = None,
     ) -> dict:
         """Apply a commit's updates (the Iceberg spec's
         TableUpdate kinds — see _apply_metadata_update); every commit
-        writes a NEW metadata file and logs the old one."""
+        writes a NEW metadata file and logs the old one. The
+        `requirements` (TableRequirement) are the writer's optimistic-
+        concurrency preconditions — a failed one MUST 409 so the
+        client rebases and retries instead of silently clobbering a
+        concurrent commit."""
         tables = self.tables(bucket, ns)
         rec = tables.get(name)
         if rec is None:
@@ -322,6 +338,8 @@ class TablesCatalog:
             )
         loaded = self.load_table(bucket, ns, name)
         metadata = loaded["metadata"]
+        for req in requirements or []:
+            _check_table_requirement(metadata, req)
         for u in updates or []:
             _apply_metadata_update(metadata, u)
         metadata["last-updated-ms"] = int(time.time() * 1000)
@@ -383,6 +401,88 @@ class TablesCatalog:
         self._kv_put(f"s3tables:tables:{bucket}:{dst_ns}", dst_tables)
 
 
+def _max_field_id(node) -> int:
+    """Largest field/element/key/value id anywhere in an Iceberg schema
+    tree (struct fields, list element-id, map key-id/value-id)."""
+    best = 0
+    if isinstance(node, dict):
+        for k in ("id", "element-id", "key-id", "value-id"):
+            v = node.get(k)
+            if isinstance(v, int):
+                best = max(best, v)
+        for v in node.values():
+            if isinstance(v, (dict, list)):
+                best = max(best, _max_field_id(v))
+    elif isinstance(node, list):
+        for item in node:
+            best = max(best, _max_field_id(item))
+    return best
+
+
+# requirement type -> (request key, metadata key): all five "assert this
+# id matches" kinds are one compare
+_ID_REQUIREMENTS = {
+    "assert-last-assigned-field-id": (
+        "last-assigned-field-id", "last-column-id",
+    ),
+    "assert-current-schema-id": ("current-schema-id", "current-schema-id"),
+    "assert-last-assigned-partition-id": (
+        "last-assigned-partition-id", "last-partition-id",
+    ),
+    "assert-default-spec-id": ("default-spec-id", "default-spec-id"),
+    "assert-default-sort-order-id": (
+        "default-sort-order-id", "default-sort-order-id",
+    ),
+}
+
+
+def _check_table_requirement(metadata: dict, req: dict) -> None:
+    """One Iceberg TableRequirement (the commit's optimistic-concurrency
+    precondition, reference weed/s3api iceberg catalog + Iceberg REST
+    spec). Violations raise 409 CommitFailedException so the writer
+    rebases; unknown kinds fail loudly like unknown updates do."""
+
+    def fail(what: str) -> None:
+        raise TablesError(409, "CommitFailedException", what)
+
+    typ = req.get("type", "")
+    if typ == "assert-create":
+        # commit of an existing table can never satisfy assert-create
+        fail("requirement assert-create: table already exists")
+    elif typ == "assert-table-uuid":
+        want = req.get("uuid")
+        if metadata.get("table-uuid") != want:
+            fail(
+                f"requirement assert-table-uuid: expected {want}, "
+                f"table has {metadata.get('table-uuid')}"
+            )
+    elif typ == "assert-ref-snapshot-id":
+        ref = req.get("ref", "")
+        want = req.get("snapshot-id")  # null = ref must not exist
+        have = metadata.get("refs", {}).get(ref)
+        have_id = have.get("snapshot-id") if have else None
+        if want is None:
+            if have is not None:
+                fail(f"requirement assert-ref-snapshot-id: ref {ref} exists")
+        elif have is None or have_id != want:
+            fail(
+                f"requirement assert-ref-snapshot-id: ref {ref} is at "
+                f"{have_id}, expected {want}"
+            )
+    elif typ in _ID_REQUIREMENTS:
+        req_key, md_key = _ID_REQUIREMENTS[typ]
+        want = req.get(req_key)
+        if metadata.get(md_key) != want:
+            fail(
+                f"requirement {typ}: table has {metadata.get(md_key)}, "
+                f"expected {want}"
+            )
+    else:
+        raise TablesError(
+            400, "BadRequestException", f"unknown requirement type {typ!r}"
+        )
+
+
 def _apply_metadata_update(metadata: dict, u: dict) -> None:
     """One Iceberg TableUpdate against the v2 metadata JSON (the kinds
     real writers — pyiceberg, Spark — emit in commits). Unknown kinds
@@ -419,9 +519,12 @@ def _apply_metadata_update(metadata: dict, u: dict) -> None:
         metadata.setdefault("schemas", []).append(schema)
         lc = u.get("last-column-id")
         if lc is None:
+            # the highest field id can live inside a nested struct /
+            # list / map — a top-level-only scan would persist a
+            # too-low last-column-id and 409 correct writers later
             lc = max(
-                (f.get("id", 0) for f in schema.get("fields", [])),
-                default=metadata.get("last-column-id", 0),
+                _max_field_id(schema),
+                metadata.get("last-column-id", 0),
             )
         metadata["last-column-id"] = max(
             metadata.get("last-column-id", 0), int(lc)
@@ -695,7 +798,11 @@ def handle_iceberg(h, catalog: TablesCatalog, path: str) -> None:
                 return _json_resp(h, 204)
             if m == "POST":  # commit
                 out = catalog.commit_table(
-                    bucket, ns, table, body.get("updates", [])
+                    bucket,
+                    ns,
+                    table,
+                    body.get("updates", []),
+                    body.get("requirements", []),
                 )
                 return _json_resp(h, 200, out)
         if parts == ["tables", "rename"] and m == "POST":

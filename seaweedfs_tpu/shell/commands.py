@@ -1268,26 +1268,50 @@ def fs_mv(env: ShellEnv, args) -> str:
 # -------------------------------------------------------------------- tasks
 
 
-@command("task.submit", "-kind ec_encode|vacuum -volumeId N [-backend b]")
+@command(
+    "task.submit",
+    "-kind ec_encode|vacuum|balance|ec_balance|s3_lifecycle "
+    "[-volumeId N] [-backend b] [-param k=v ...]",
+)
 def task_submit(env: ShellEnv, args) -> str:
     from ..pb import worker_pb2 as wk
 
     p = argparse.ArgumentParser(prog="task.submit")
     p.add_argument("-kind", required=True)
-    p.add_argument("-volumeId", type=int, required=True)
+    # volume-independent kinds (ec_balance, s3_lifecycle) run with 0;
+    # every other kind acts on ONE volume and a forgotten -volumeId
+    # would submit a doomed volume-0 task that only fails in task.list
+    p.add_argument("-volumeId", type=int, default=None)
     p.add_argument("-collection", default="")
     p.add_argument("-backend", default="")
+    p.add_argument(
+        "-param",
+        action="append",
+        default=[],
+        help="k=v, validated against the kind's descriptor",
+    )
     a = p.parse_args(args)
+    from ..worker.control import VOLUME_INDEPENDENT_KINDS
+
+    volume_independent = a.kind in VOLUME_INDEPENDENT_KINDS
+    if a.volumeId is None and not volume_independent:
+        return f"error: -volumeId is required for kind {a.kind}"
+    params = {}
+    for kv in a.param:
+        k, sep, v = kv.partition("=")
+        if not sep or not k:
+            return f"error: -param wants k=v, got {kv!r}"
+        params[k] = v
+    req = wk.SubmitTaskRequest(
+        kind=a.kind,
+        volume_id=a.volumeId or 0,
+        collection=a.collection,
+        backend=a.backend,
+    )
+    for k, v in params.items():
+        req.params[k] = v
     with grpc.insecure_channel(env.master.grpc_addr) as ch:
-        r = rpc.Stub(ch, rpc.WORKER_SERVICE).SubmitTask(
-            wk.SubmitTaskRequest(
-                kind=a.kind,
-                volume_id=a.volumeId,
-                collection=a.collection,
-                backend=a.backend,
-            ),
-            timeout=30,
-        )
+        r = rpc.Stub(ch, rpc.WORKER_SERVICE).SubmitTask(req, timeout=30)
     if r.error:
         return f"error: {r.error}"
     return f"task {r.task_id} submitted"

@@ -21,13 +21,16 @@ from ..client.master_client import MasterClient, volume_channel
 from ..pb import cluster_pb2 as pb
 from ..pb import rpc
 from ..pb import worker_pb2 as wk
+from .control import VOLUME_INDEPENDENT_KINDS
 
 
 class Worker:
     def __init__(
         self,
         master: str = "localhost:9333",
-        capabilities: tuple = ("ec_encode", "vacuum", "balance", "s3_lifecycle"),
+        capabilities: tuple = (
+            "ec_encode", "vacuum", "balance", "s3_lifecycle", "ec_balance",
+        ),
         backend: str = "auto",
         max_concurrent: int = 2,
         worker_id: str = "",
@@ -88,6 +91,20 @@ class Worker:
                         type="string",
                         default="",
                         help="grpc host:port of the receiving node",
+                    ),
+                ],
+            ),
+            wk.TaskDescriptor(
+                kind="ec_balance",
+                display_name="EC shard balance",
+                description="dedupe + rack-aware spread of EC shards "
+                "(runs the shell planner/executor)",
+                fields=[
+                    wk.ConfigField(
+                        name="collection",
+                        type="string",
+                        default="",
+                        help="restrict to one collection (empty = all)",
                     ),
                 ],
             ),
@@ -174,7 +191,10 @@ class Worker:
 
     def _execute(self, assign: wk.TaskAssign) -> None:
         self._report(assign.task_id, "running", 0.0)
-        lock_name = f"volume/{assign.volume_id}"
+        if assign.kind in VOLUME_INDEPENDENT_KINDS:
+            lock_name = f"task/{assign.kind}"
+        else:
+            lock_name = f"volume/{assign.volume_id}"
         token = ""
         try:
             # per-volume cluster lease: a shell ec.encode on the same
@@ -191,6 +211,8 @@ class Worker:
                 self._task_balance(assign)
             elif assign.kind == "s3_lifecycle":
                 self._task_s3_lifecycle(assign)
+            elif assign.kind == "ec_balance":
+                self._task_ec_balance(assign)
             else:
                 raise RuntimeError(f"unknown task kind {assign.kind}")
             self._report(assign.task_id, "done", 1.0)
@@ -300,6 +322,37 @@ class Worker:
                 f"delete on {source} failed after retries ({last_err}); "
                 "volume is duplicated and readonly at the source"
             )
+
+    def _task_ec_balance(self, assign: wk.TaskAssign) -> None:
+        """Rack-aware EC shard rebalancing: reuses the SHELL's planner
+        and executor (ec/placement.py + ec.balance) so the worker and
+        the operator path cannot drift — per-volume leases are taken
+        inside the command itself."""
+        import re
+        import shlex
+
+        from ..shell.commands import ShellEnv, run_command
+
+        env = ShellEnv(self.master_addr)
+        try:
+            # the param is caller-supplied text headed for a shlex-split
+            # argparse command line: quote it AND reject non-name shapes
+            # (a leading "-" would read as a flag and argparse's
+            # SystemExit is not an Exception — the task would hang in
+            # 'running' instead of failing). -collection on task.submit
+            # arrives in assign.collection, a plugin param override wins.
+            col = assign.params.get("collection", "") or assign.collection
+            if col and not re.fullmatch(r"[A-Za-z0-9_][A-Za-z0-9_.\-]*", col):
+                raise RuntimeError(f"invalid collection name {col!r}")
+            out = run_command(
+                env,
+                "ec.balance"
+                + (f" -collection {shlex.quote(col)}" if col else ""),
+            )
+            if out.startswith("error"):
+                raise RuntimeError(out)
+        finally:
+            env.close()
 
     def _task_s3_lifecycle(self, assign: wk.TaskAssign) -> None:
         """Delegate the sweep to the filer that owns the metadata."""

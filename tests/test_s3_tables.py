@@ -320,14 +320,14 @@ def test_iceberg_snapshot_commit_lifecycle(s3):
     )
     assert r.status_code == 200, r.text
 
-    def commit(updates, expect=200):
+    def commit(updates, expect=200, requirements=None):
         r = requests.post(
             f"{ib}/namespaces/snapns/tables/t",
-            json={"updates": updates},
+            json={"updates": updates, "requirements": requirements or []},
             timeout=10,
         )
         assert r.status_code == expect, r.text
-        return r.json() if expect == 200 else None
+        return r.json() if expect == 200 else r
 
     snap = {
         "snapshot-id": 4242,
@@ -363,6 +363,61 @@ def test_iceberg_snapshot_commit_lifecycle(s3):
     assert md["current-schema-id"] == 1
     assert md["last-column-id"] == 3
     assert len(md["schemas"]) == 2
+
+    # add-schema WITHOUT last-column-id, highest id nested in a struct:
+    # the fallback must recurse (top-level-only would persist 4)
+    nested = {
+        "type": "struct", "schema-id": 2,
+        "fields": new_schema["fields"] + [
+            {"id": 4, "name": "s", "required": False,
+             "type": {"type": "struct", "fields": [
+                 {"id": 5, "name": "inner", "required": False,
+                  "type": "string"}]}},
+        ],
+    }
+    out = commit([{"action": "add-schema", "schema": nested}])
+    assert out["metadata"]["last-column-id"] == 5
+
+    # TableRequirements: the optimistic-concurrency preconditions.
+    # A stale writer (expects main at the pre-commit snapshot) gets 409
+    # CommitFailedException and must NOT clobber the committed state.
+    snap2 = dict(snap, **{"snapshot-id": 4343, "sequence-number": 2})
+    r = commit(
+        [{"action": "add-snapshot", "snapshot": snap2},
+         {"action": "set-snapshot-ref", "ref-name": "main",
+          "snapshot-id": 4343, "type": "branch"}],
+        expect=409,
+        requirements=[{"type": "assert-ref-snapshot-id", "ref": "main",
+                       "snapshot-id": 777}],
+    )
+    assert "CommitFailedException" in r.text
+    md = requests.get(
+        f"{ib}/namespaces/snapns/tables/t", timeout=10
+    ).json()["metadata"]
+    assert md["current-snapshot-id"] == 4242  # rejected commit not applied
+    # the CORRECT precondition passes and advances main
+    out = commit(
+        [{"action": "add-snapshot", "snapshot": snap2},
+         {"action": "set-snapshot-ref", "ref-name": "main",
+          "snapshot-id": 4343, "type": "branch"}],
+        requirements=[
+            {"type": "assert-ref-snapshot-id", "ref": "main",
+             "snapshot-id": 4242},
+            {"type": "assert-table-uuid", "uuid": md["table-uuid"]},
+        ],
+    )
+    assert out["metadata"]["refs"]["main"]["snapshot-id"] == 4343
+    # wrong uuid and unknown requirement kinds fail loudly
+    r = commit([], expect=409,
+               requirements=[{"type": "assert-table-uuid", "uuid": "nope"}])
+    assert "CommitFailedException" in r.text
+    commit([], expect=400, requirements=[{"type": "assert-bogus"}])
+    # roll main back so the expiry checks below see the original state
+    commit([
+        {"action": "set-snapshot-ref", "ref-name": "main",
+         "snapshot-id": 4242, "type": "branch"},
+        {"action": "remove-snapshots", "snapshot-ids": [4343]},
+    ])
 
     # ref to an unknown snapshot fails loudly
     commit(

@@ -292,3 +292,47 @@ def test_s3_lifecycle_task_execution(cluster, tmp_path):
         w.stop()
         fsrv.stop()
         filer.close()
+
+
+def test_ec_balance_task(cluster2, tmp_path):
+    """Worker executes ec_balance end to end: after an EC encode lands
+    every shard on one node, the task spreads them (reference worker
+    tasks/ec_balance)."""
+    master, (a, b) = cluster2
+    _masters[master.port] = master
+    ops = Operations(f"localhost:{master.port}")
+    env = ShellEnv(f"localhost:{master.port}")
+    w = start_worker(master.port)
+    try:
+        fid = ops.upload(b"spread-me" * 4096)
+        vid = FileId.parse(fid).volume_id
+        run_command(env, f"ec.encode -volumeId {vid} -backend cpu")
+        wait_for(
+            lambda: any(
+                n.ec_shards for n in master.topo.nodes.values()
+            ),
+            msg="master sees EC shards",
+        )
+        tid = master.worker_control.submit("ec_balance", 0)
+        task = master.worker_control._tasks[tid]
+        wait_for(
+            lambda: task.state in ("done", "failed"),
+            timeout=120,
+            msg="ec_balance reaches a terminal state",
+        )
+        assert task.state == "done", task.error
+        # the shards now live on BOTH nodes
+        counts = []
+        with master.topo._lock:
+            for n in master.topo.nodes.values():
+                bits = 0
+                for e in getattr(n, "ec_shards", {}).values():
+                    if e.id == vid:
+                        bits += bin(e.shard_bits).count("1")
+                counts.append(bits)
+        assert sorted(counts)[-1] < 14, counts  # no longer all on one node
+        assert sum(counts) >= 14, counts
+    finally:
+        w.stop()
+        ops.close()
+        env.close()
