@@ -359,6 +359,74 @@ class TablesCatalog:
         self._kv_put(f"s3tables:tables:{bucket}:{ns}", tables)
         return {"metadata-location": loc, "metadata": metadata}
 
+    def expire_snapshots(
+        self, older_than_ms: int, bucket: str = "", dry_run: bool = False
+    ) -> dict:
+        """Snapshot expiry across the catalog (reference weed worker
+        `iceberg` maintenance task: expire old table snapshots).
+        Snapshots still reachable from any ref — including the current
+        one — are NEVER expired regardless of age; expiry goes through
+        the same remove-snapshots update path as a client commit, so
+        snapshot-log/refs cleanup and metadata versioning are identical.
+        """
+        # enumerate under the lock, then sweep one table at a time so
+        # API traffic only ever stalls behind ONE table's expiry, not
+        # the whole catalog walk (each commit writes a metadata file)
+        with self._lock:
+            buckets = (
+                [bucket]
+                if bucket
+                else sorted({DEFAULT_BUCKET, *self.buckets()})
+            )
+            idents = [
+                (b, ns, t)
+                for b in buckets
+                for ns in self.namespaces(b)
+                for t in self.tables(b, ns)
+            ]
+        out = {
+            "tables_scanned": 0,
+            "tables_updated": 0,
+            "snapshots_expired": 0,
+        }
+        for b, ns, t in idents:
+            with self._lock:
+                try:
+                    md = self.load_table(b, ns, t)["metadata"]
+                except (TablesError, NotFound):
+                    continue  # dropped since enumeration
+                out["tables_scanned"] += 1
+                keep = {
+                    r.get("snapshot-id")
+                    for r in md.get("refs", {}).values()
+                }
+                cur = md.get("current-snapshot-id", -1)
+                if cur != -1:
+                    keep.add(cur)
+                stale = [
+                    s["snapshot-id"]
+                    for s in md.get("snapshots", [])
+                    if s.get("timestamp-ms", 0) < older_than_ms
+                    and s.get("snapshot-id") not in keep
+                ]
+                if not stale:
+                    continue
+                out["tables_updated"] += 1
+                out["snapshots_expired"] += len(stale)
+                if not dry_run:
+                    self._commit_table_locked(
+                        b,
+                        ns,
+                        t,
+                        [
+                            {
+                                "action": "remove-snapshots",
+                                "snapshot-ids": stale,
+                            }
+                        ],
+                    )
+        return out
+
     def drop_table(self, bucket: str, ns: str, name: str) -> None:
         with self._lock:
             self._drop_table_locked(bucket, ns, name)
@@ -689,7 +757,9 @@ def handle_iceberg(h, catalog: TablesCatalog, path: str) -> None:
             )
         # optional {prefix} segment = table bucket
         bucket = DEFAULT_BUCKET
-        if parts and parts[0] not in ("namespaces", "tables", "transactions"):
+        if parts and parts[0] not in (
+            "namespaces", "tables", "transactions", "maintenance",
+        ):
             bucket = urllib.parse.unquote(parts[0])
             parts = parts[1:]
         body = {}
@@ -697,6 +767,19 @@ def handle_iceberg(h, catalog: TablesCatalog, path: str) -> None:
             raw = h._read_body()
             if raw:
                 body = json.loads(raw)
+        if parts == ["maintenance"] and m == "POST":
+            # catalog maintenance: snapshot expiry (the worker fleet's
+            # `iceberg` task posts here; operators can too)
+            older = body.get("older-than-ms")
+            if older is None:
+                days = float(body.get("older-than-days", 30))
+                older = int(time.time() * 1000) - int(days * 86400_000)
+            out = catalog.expire_snapshots(
+                int(older),
+                bucket="" if body.get("all-buckets") else bucket,
+                dry_run=bool(body.get("dry-run")),
+            )
+            return _json_resp(h, 200, out)
         if parts == ["namespaces"]:
             if m == "GET":
                 return _json_resp(

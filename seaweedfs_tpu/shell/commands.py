@@ -1270,7 +1270,7 @@ def fs_mv(env: ShellEnv, args) -> str:
 
 @command(
     "task.submit",
-    "-kind ec_encode|vacuum|balance|ec_balance|s3_lifecycle "
+    "-kind ec_encode|vacuum|balance|ec_balance|s3_lifecycle|iceberg "
     "[-volumeId N] [-backend b] [-param k=v ...]",
 )
 def task_submit(env: ShellEnv, args) -> str:

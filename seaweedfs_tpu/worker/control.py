@@ -48,11 +48,13 @@ class _Task:
     attempts: int = 0
 
 
-KNOWN_KINDS = ("ec_encode", "vacuum", "balance", "s3_lifecycle", "ec_balance")
+KNOWN_KINDS = (
+    "ec_encode", "vacuum", "balance", "s3_lifecycle", "ec_balance", "iceberg",
+)
 # cluster-wide kinds always submit with volume_id=0: the shell skips the
 # -volumeId requirement for them and the worker scopes their cluster
 # lease by KIND (task/<kind>) instead of the shared volume/0 name
-VOLUME_INDEPENDENT_KINDS = ("ec_balance", "s3_lifecycle")
+VOLUME_INDEPENDENT_KINDS = ("ec_balance", "s3_lifecycle", "iceberg")
 WORKER_STALE_SECONDS = 30.0
 TASK_RETENTION = 1000  # terminal tasks kept for task.list history
 
@@ -131,11 +133,16 @@ class WorkerControl:
                 if (
                     t.kind == kind
                     and t.volume_id == volume_id
-                    # collection is part of the identity: two ec_balance
-                    # submits for different collections are different
-                    # work (for per-volume kinds it is derived from the
-                    # volume, so this never splits their dedupe)
-                    and t.collection == collection
+                    # for cluster-wide kinds the collection is part of
+                    # the identity (ec_balance of A vs B is different
+                    # work); for per-volume kinds it must NOT be — a
+                    # mistyped -collection would split the one-live-
+                    # task-per-volume guarantee and run a destructive
+                    # task under the wrong on-disk paths
+                    and (
+                        kind not in VOLUME_INDEPENDENT_KINDS
+                        or t.collection == collection
+                    )
                     and t.state in ("pending", "assigned", "running")
                 ):
                     if explicit and params != t.params:

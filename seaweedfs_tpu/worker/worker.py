@@ -30,6 +30,7 @@ class Worker:
         master: str = "localhost:9333",
         capabilities: tuple = (
             "ec_encode", "vacuum", "balance", "s3_lifecycle", "ec_balance",
+            "iceberg",
         ),
         backend: str = "auto",
         max_concurrent: int = 2,
@@ -105,6 +106,46 @@ class Worker:
                         type="string",
                         default="",
                         help="restrict to one collection (empty = all)",
+                    ),
+                ],
+            ),
+            wk.TaskDescriptor(
+                kind="iceberg",
+                display_name="Iceberg snapshot expiry",
+                description="expire old unreferenced table snapshots "
+                "via the S3 gateway's catalog maintenance endpoint",
+                fields=[
+                    wk.ConfigField(
+                        name="s3",
+                        type="string",
+                        default="",
+                        help="host:port of the S3 gateway",
+                    ),
+                    wk.ConfigField(
+                        name="access_key",
+                        type="string",
+                        default="",
+                        help="Admin-capable access key",
+                    ),
+                    wk.ConfigField(
+                        name="secret_key",
+                        type="string",
+                        default="",
+                        help="secret for access_key",
+                    ),
+                    wk.ConfigField(
+                        name="older_than_days",
+                        type="float",
+                        default="30",
+                        help="expire snapshots older than this",
+                        min=0.0,
+                        max=36500.0,
+                    ),
+                    wk.ConfigField(
+                        name="bucket",
+                        type="string",
+                        default="",
+                        help="single table bucket (empty = whole catalog)",
                     ),
                 ],
             ),
@@ -213,6 +254,8 @@ class Worker:
                 self._task_s3_lifecycle(assign)
             elif assign.kind == "ec_balance":
                 self._task_ec_balance(assign)
+            elif assign.kind == "iceberg":
+                self._task_iceberg(assign)
             else:
                 raise RuntimeError(f"unknown task kind {assign.kind}")
             self._report(assign.task_id, "done", 1.0)
@@ -353,6 +396,47 @@ class Worker:
                 raise RuntimeError(out)
         finally:
             env.close()
+
+    def _task_iceberg(self, assign: wk.TaskAssign) -> None:
+        """Iceberg snapshot expiry (reference worker tasks: the iceberg
+        maintenance kind). The catalog lives inside the S3 gateway, so
+        the task POSTs its Admin-gated /iceberg/v1/maintenance route
+        with the sigv4 client the remote-storage SPI already ships."""
+        import json as _json
+
+        from ..remote.s3_client import RemoteS3Client
+
+        s3 = assign.params.get("s3", "")
+        if not s3:
+            raise RuntimeError("iceberg needs an s3 (gateway host:port) param")
+        try:
+            days = float(assign.params.get("older_than_days", "") or 30)
+        except ValueError:
+            days = 30.0
+        older = int(time.time() * 1000) - int(days * 86400_000)
+        bucket = assign.params.get("bucket", "")
+        body = {"older-than-ms": older}
+        if not bucket:
+            body["all-buckets"] = True
+        client = RemoteS3Client(
+            f"http://{s3}",
+            assign.params.get("access_key", ""),
+            assign.params.get("secret_key", ""),
+        )
+        path = (
+            f"/iceberg/v1/{bucket}/maintenance"
+            if bucket
+            else "/iceberg/v1/maintenance"
+        )
+        r = client._request(
+            "POST",
+            path,
+            payload=_json.dumps(body).encode(),
+            extra_headers={"Content-Type": "application/json"},
+        )
+        out = r.json()
+        if not isinstance(out, dict) or "tables_scanned" not in out:
+            raise RuntimeError(f"unexpected maintenance response: {out!r}")
 
     def _task_s3_lifecycle(self, assign: wk.TaskAssign) -> None:
         """Delegate the sweep to the filer that owns the metadata."""

@@ -435,3 +435,113 @@ def test_iceberg_snapshot_commit_lifecycle(s3):
     # the reloaded table reflects every commit (metadata persisted)
     r = requests.get(f"{ib}/namespaces/snapns/tables/t", timeout=10)
     assert r.json()["metadata"]["current-schema-id"] == 1
+
+
+def test_iceberg_snapshot_expiry_task(cluster, s3):
+    """The `iceberg` maintenance kind end to end: a worker posts the
+    gateway's /iceberg/v1/maintenance route and old unreferenced
+    snapshots are expired while refs and current stay (reference
+    worker tasks: iceberg)."""
+    import threading
+
+    from seaweedfs_tpu.server.master import MasterServer  # noqa: F401
+    from seaweedfs_tpu.worker import Worker
+
+    url, srv = s3
+    ib = f"{url}/iceberg/v1"
+    requests.post(f"{ib}/namespaces", json={"namespace": ["expns"]}, timeout=10)
+    r = requests.post(
+        f"{ib}/namespaces/expns/tables",
+        json={"name": "t", "schema": SCHEMA},
+        timeout=10,
+    )
+    assert r.status_code == 200, r.text
+
+    def snap(sid, ts):
+        return {
+            "snapshot-id": sid, "sequence-number": sid,
+            "timestamp-ms": ts, "manifest-list": f"s3://x/{sid}",
+            "summary": {"operation": "append"},
+        }
+
+    old_ms = int(time.time() * 1000) - 90 * 86400_000
+    now_ms = int(time.time() * 1000)
+    r = requests.post(
+        f"{ib}/namespaces/expns/tables/t",
+        json={"updates": [
+            {"action": "add-snapshot", "snapshot": snap(1, old_ms)},
+            {"action": "add-snapshot", "snapshot": snap(2, now_ms)},
+            {"action": "set-snapshot-ref", "ref-name": "main",
+             "snapshot-id": 2, "type": "branch"},
+        ]},
+        timeout=10,
+    )
+    assert r.status_code == 200, r.text
+
+    master_addr = f"localhost:{cluster}"
+    w = Worker(master=master_addr, backend="cpu")
+    threading.Thread(target=w.run, daemon=True).start()
+    try:
+        import grpc as _grpc
+
+        from seaweedfs_tpu.pb import rpc as _rpc
+        from seaweedfs_tpu.pb import worker_pb2 as wk
+
+        mhost, mport = master_addr.split(":")
+        gaddr = f"{mhost}:{int(mport) + 10000}"
+        with _grpc.insecure_channel(gaddr) as ch:
+            stub = _rpc.Stub(ch, _rpc.WORKER_SERVICE)
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                if any(
+                    "iceberg" in wi.capabilities
+                    for wi in stub.ListWorkers(
+                        wk.ListWorkersRequest(), timeout=10
+                    ).workers
+                ):
+                    break
+                time.sleep(0.2)
+            r = stub.SubmitTask(
+                wk.SubmitTaskRequest(
+                    kind="iceberg",
+                    params={
+                        "s3": f"localhost:{srv.port}",
+                        "older_than_days": "30",
+                    },
+                ),
+                timeout=10,
+            )
+            assert not r.error, r.error
+            tid = r.task_id
+            deadline = time.time() + 60
+            state, err = "", "timed out waiting for terminal state"
+            while time.time() < deadline:
+                tasks = {
+                    t.task_id: t
+                    for t in stub.ListTasks(
+                        wk.ListTasksRequest(), timeout=10
+                    ).tasks
+                }
+                state = tasks[tid].state
+                if state in ("done", "failed"):
+                    err = tasks[tid].error
+                    break
+                time.sleep(0.3)
+            assert state == "done", err
+    finally:
+        w.stop()
+
+    md = requests.get(
+        f"{ib}/namespaces/expns/tables/t", timeout=10
+    ).json()["metadata"]
+    sids = [s["snapshot-id"] for s in md["snapshots"]]
+    assert sids == [2], sids  # old unreferenced snapshot expired
+    assert md["refs"]["main"]["snapshot-id"] == 2
+    # dry-run via the route directly reports zero further work
+    r = requests.post(
+        f"{ib}/maintenance",
+        json={"older-than-days": 30, "all-buckets": True, "dry-run": True},
+        timeout=10,
+    )
+    assert r.status_code == 200, r.text
+    assert r.json()["snapshots_expired"] == 0
