@@ -47,8 +47,10 @@ DASHBOARD_HTML = """<!doctype html>
   <h2>maintenance queue</h2>
   <div>
     <form id="submitform" style="margin-bottom:8px">
-      kind <select id="taskkind"><option>ec_encode</option><option>vacuum</option></select>
+      kind <select id="taskkind"><option>ec_encode</option><option>vacuum</option><option>balance</option><option>ec_balance</option><option>s3_lifecycle</option><option>iceberg</option></select>
       volume <input id="taskvol" size="6">
+      params (k=v,&hellip;) <input id="taskparams" size="28"
+        placeholder="source=h:p,target=h:p">
       <button type="submit">submit task</button> <span id="submitmsg"></span>
     </form>
   </div>
@@ -168,7 +170,15 @@ $("submitform").addEventListener("submit", async (ev) => {
   ev.preventDefault();
   const r = await fetch("/api/maintenance/submit", {method: "POST",
     headers: {"Content-Type": "application/json"},
-    body: JSON.stringify({kind: $("taskkind").value, volume_id: parseInt($("taskvol").value)})});
+    body: JSON.stringify({
+      kind: $("taskkind").value,
+      volume_id: $("taskvol").value === "" ? null : parseInt($("taskvol").value),
+      params: Object.fromEntries($("taskparams").value.split(",")
+        .filter(kv => kv.includes("=")).map(kv => {
+          const i = kv.indexOf("=");
+          return [kv.slice(0, i).trim(), kv.slice(i + 1).trim()];
+        })),
+    })});
   const out = await r.json();
   $("submitmsg").textContent = out.error ? out.error : ("queued " + out.task_id);
   $("submitmsg").className = out.error ? "err" : "ok";

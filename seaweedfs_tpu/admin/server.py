@@ -236,10 +236,16 @@ class AdminServer:
     def _api_submit(self, body: dict) -> dict:
         # The dashboard form sends volume_id: null for an empty field
         # (parseInt NaN -> JSON null); reject it cleanly instead of
-        # crashing the handler with int(None).
+        # crashing the handler with int(None). Cluster-wide kinds
+        # (ec_balance, s3_lifecycle, iceberg) take no volume.
+        from ..worker.control import VOLUME_INDEPENDENT_KINDS
+
         raw_vid = body.get("volume_id")
         if raw_vid is None:
-            return {"error": "volume_id is required"}
+            if str(body.get("kind", "")) in VOLUME_INDEPENDENT_KINDS:
+                raw_vid = 0
+            else:
+                return {"error": "volume_id is required"}
         try:
             volume_id = int(raw_vid)
         except (TypeError, ValueError):

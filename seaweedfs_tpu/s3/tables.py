@@ -903,7 +903,8 @@ def handle_iceberg(h, catalog: TablesCatalog, path: str) -> None:
         return _err(h, e)
     except NotFound as e:
         return _err(h, TablesError(404, "NotFoundException", str(e)))
-    except (ValueError, KeyError) as e:
+    except (ValueError, KeyError, TypeError) as e:
+        # TypeError: JSON null / wrong-shaped values hitting int()/float()
         return _err(h, TablesError(400, "BadRequestException", str(e)))
 
 
@@ -1094,5 +1095,6 @@ def handle_s3tables(h, catalog: TablesCatalog) -> None:
         return _err(h, e)
     except NotFound as e:
         return _err(h, TablesError(404, "NotFoundException", str(e)))
-    except (ValueError, KeyError) as e:
+    except (ValueError, KeyError, TypeError) as e:
+        # TypeError: JSON null / wrong-shaped values hitting int()/float()
         return _err(h, TablesError(400, "BadRequestException", str(e)))

@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 from concurrent import futures
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
@@ -527,6 +528,8 @@ class MasterServer:
         self.lifecycle_interval = 0.0  # 0 = lifecycle sweeps off
         self.lifecycle_filer = ""
         self._lifecycle_last = 0.0
+        self.ec_balance_interval = 0.0  # 0 = auto ec_balance scanner off
+        self._ec_balance_last = 0.0
         self._vacuum_stop = threading.Event()
         self._vacuum_thread = threading.Thread(
             target=self._vacuum_loop, daemon=True
@@ -794,6 +797,7 @@ class MasterServer:
             "balance_spread": self.balance_spread,
             "lifecycle_interval_seconds": self.lifecycle_interval,
             "lifecycle_filer": self.lifecycle_filer,
+            "ec_balance_interval_seconds": self.ec_balance_interval,
         }
 
     def _apply_maintenance_config(self, cfg: dict) -> None:
@@ -812,6 +816,7 @@ class MasterServer:
             "vacuum_interval_seconds",
             "balance_spread",
             "lifecycle_interval_seconds",
+            "ec_balance_interval_seconds",
         ):
             if not math.isfinite(cfg.get(key, 0.0)):
                 raise ValueError(f"{key} must be finite, got {cfg.get(key)}")
@@ -832,10 +837,12 @@ class MasterServer:
             )
         spread = cfg.get("balance_spread", 0.0)
         lc_interval = cfg.get("lifecycle_interval_seconds", 0.0)
-        if spread < 0 or lc_interval < 0:
+        ecb_interval = cfg.get("ec_balance_interval_seconds", 0.0)
+        if spread < 0 or lc_interval < 0 or ecb_interval < 0:
             raise ValueError(
-                "balance_spread and lifecycle_interval_seconds must be "
-                f">=0 (got {spread}, {lc_interval})"
+                "balance_spread, lifecycle_interval_seconds and "
+                "ec_balance_interval_seconds must be "
+                f">=0 (got {spread}, {lc_interval}, {ecb_interval})"
             )
         self.ec_auto_fullness = full
         self.ec_quiet_seconds = quiet
@@ -844,6 +851,7 @@ class MasterServer:
         self.balance_spread = spread
         self.lifecycle_interval = lc_interval
         self.lifecycle_filer = str(cfg.get("lifecycle_filer", "") or "")
+        self.ec_balance_interval = ecb_interval
 
     # ----------------------------------------------------------- vacuum
 
@@ -852,27 +860,46 @@ class MasterServer:
         every holder of a garbage-heavy volume to compact. Doubles as
         the dead-node sweeper for heartbeat streams that hung without
         breaking (prune_dead was otherwise never invoked)."""
+        from ..utils.glog import logger
+
+        log = logger("master")
         while not self._vacuum_stop.wait(self.vacuum_interval):
-            self.topo.prune_dead()
-            self.vacuum_once()
-            if self.ec_auto_fullness > 0:
-                self.worker_control.scan_for_ec_candidates(
-                    self.topo,
-                    self.ec_auto_fullness,
-                    self.topo.volume_size_limit,
-                    quiet_seconds=self.ec_quiet_seconds,
-                )
-            if self.balance_spread > 0:
-                self.worker_control.scan_for_balance_candidates(
-                    self.topo, int(self.balance_spread)
-                )
-            if self.lifecycle_interval > 0 and self.lifecycle_filer:
-                now = time.time()
-                if now - self._lifecycle_last >= self.lifecycle_interval:
-                    self._lifecycle_last = now
-                    self.worker_control.scan_for_lifecycle(
-                        self.lifecycle_filer
+            # one bad tick must not kill the thread: this loop is ALSO
+            # the garbage sweep and the dead-node pruner — a scanner
+            # exception silently disabling vacuum cluster-wide is far
+            # worse than a skipped scan
+            try:
+                self.topo.prune_dead()
+                self.vacuum_once()
+                if self.ec_auto_fullness > 0:
+                    self.worker_control.scan_for_ec_candidates(
+                        self.topo,
+                        self.ec_auto_fullness,
+                        self.topo.volume_size_limit,
+                        quiet_seconds=self.ec_quiet_seconds,
                     )
+                if self.balance_spread > 0:
+                    self.worker_control.scan_for_balance_candidates(
+                        self.topo, int(self.balance_spread)
+                    )
+                if self.lifecycle_interval > 0 and self.lifecycle_filer:
+                    now = time.time()
+                    if now - self._lifecycle_last >= self.lifecycle_interval:
+                        self._lifecycle_last = now
+                        self.worker_control.scan_for_lifecycle(
+                            self.lifecycle_filer
+                        )
+                if self.ec_balance_interval > 0:
+                    now = time.time()
+                    if now - self._ec_balance_last >= self.ec_balance_interval:
+                        self._ec_balance_last = now
+                        self.worker_control.scan_for_ec_balance(self.topo)
+            except Exception as e:
+                log.error(
+                    "maintenance tick failed (%s: %s); loop continues",
+                    type(e).__name__,
+                    e,
+                )
 
     def vacuum_once(self) -> list[int]:
         vacuumed = []

@@ -2923,6 +2923,7 @@ def maintenance_config(env: ShellEnv, args) -> str:
             "balance_spread": cfg.balance_spread,
             "lifecycle_interval_seconds": cfg.lifecycle_interval_seconds,
             "lifecycle_filer": cfg.lifecycle_filer,
+            "ec_balance_interval_seconds": cfg.ec_balance_interval_seconds,
         }
     )
 

@@ -119,6 +119,11 @@ class WorkerControl:
         # with none and must never conflict with an operator's task
         explicit = bool(params)
         params = self._validate_params(kind, dict(params or {}))
+        if kind in VOLUME_INDEPENDENT_KINDS:
+            # normalize at the ONE choke point: an explicit nonzero vid
+            # for a cluster-wide kind would split its dedupe and run
+            # the same sweep twice back-to-back
+            volume_id = 0
         if not collection:
             # collection determines on-disk paths; a task executed with
             # the wrong one fails AFTER destructive steps
@@ -146,10 +151,18 @@ class WorkerControl:
                     and t.state in ("pending", "assigned", "running")
                 ):
                     if explicit and params != t.params:
+                        # name only the differing KEYS: values can be
+                        # credentials (iceberg carries secret_key) and
+                        # this string goes back to any submit caller
+                        diff = sorted(
+                            k
+                            for k in set(params) | set(t.params)
+                            if params.get(k) != t.params.get(k)
+                        )
                         raise ValueError(
                             f"task {t.task_id} for {kind}/{volume_id} is "
-                            f"already live with params {t.params}; cancel "
-                            "it before re-submitting with different params"
+                            f"already live with different params (keys: "
+                            f"{diff}); cancel it before re-submitting"
                         )
                     return t.task_id
             self._tasks[task_id] = _Task(
@@ -443,6 +456,7 @@ class WorkerControl:
                 "balance_spread",
                 "lifecycle_interval_seconds",
                 "lifecycle_filer",
+                "ec_balance_interval_seconds",
             ):
                 if request.HasField(key):
                     cfg[key] = getattr(request, key)
@@ -560,6 +574,47 @@ class WorkerControl:
                     params={"source": high_addr, "target": low_addr},
                 )
             ]
+        except ValueError:
+            return []
+
+    def scan_for_ec_balance(self, topo) -> list[str]:
+        """Auto-detect EC shard imbalance (reference worker ec_balance
+        detection): run the SAME planner the shell and the worker task
+        use over a topology snapshot; any planned drop or move means
+        the cluster is out of shape, so submit ONE ec_balance task
+        (which re-plans live and executes the full pass)."""
+        from ..ec.placement import NodeView, plan_ec_balance
+
+        with topo._lock:
+            views = []
+            for n in topo.nodes.values():
+                shards = {
+                    e.id: {
+                        i for i in range(32) if e.shard_bits & (1 << i)
+                    }
+                    for e in n.ec_shards.values()
+                }
+                all_shards = sum(len(s) for s in shards.values())
+                views.append(
+                    NodeView(
+                        id=f"{n.ip}:{n.grpc_port}",
+                        rack=n.rack,
+                        data_center=n.data_center,
+                        free_slots=max(
+                            (n.max_volume_count - len(n.volumes)) * 10
+                            - all_shards,
+                            0,
+                        ),
+                        shards=shards,
+                    )
+                )
+        if len(views) < 2:
+            return []
+        drops, moves = plan_ec_balance(views)
+        if not drops and not moves:
+            return []
+        try:
+            return [self.submit("ec_balance", 0)]
         except ValueError:
             return []
 

@@ -249,6 +249,12 @@ def test_malformed_submit_returns_json_400(stack):
         aport, "/api/maintenance/submit", {"kind": "ec_encode", "volume_id": "xyz"}
     )
     assert code == 400 and "volume_id" in out["error"]
+    # cluster-wide kinds need no volume: null volume_id submits fine
+    code, out = post(
+        aport, "/api/maintenance/submit",
+        {"kind": "ec_balance", "volume_id": None},
+    )
+    assert code == 200 and out.get("task_id"), out
 
 
 def test_admin_auth_token(stack, tmp_path):

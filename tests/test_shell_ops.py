@@ -162,14 +162,17 @@ def test_maintenance_config_roundtrip(pair):
     out = run_command(
         env,
         "maintenance.config -set balance_spread=3 "
-        "-set lifecycle_interval_seconds=60 -set lifecycle_filer=f:123",
+        "-set lifecycle_interval_seconds=60 -set lifecycle_filer=f:123 "
+        "-set ec_balance_interval_seconds=45",
     )
     doc = json.loads(out)
     assert doc["balance_spread"] == 3.0
     assert doc["lifecycle_interval_seconds"] == 60.0
     assert doc["lifecycle_filer"] == "f:123"
+    assert doc["ec_balance_interval_seconds"] == 45.0
     assert master.balance_spread == 3.0
     assert master.lifecycle_filer == "f:123"
+    assert master.ec_balance_interval == 45.0
 
 
 # --------------------------------------------------------------- MQ ops

@@ -313,7 +313,12 @@ def test_ec_balance_task(cluster2, tmp_path):
             ),
             msg="master sees EC shards",
         )
-        tid = master.worker_control.submit("ec_balance", 0)
+        # the auto-scanner sees the 14-0 imbalance and submits the task
+        submitted = master.worker_control.scan_for_ec_balance(master.topo)
+        assert len(submitted) == 1
+        tid = submitted[0]
+        # direct submit dedupes onto the live scanner task
+        assert master.worker_control.submit("ec_balance", 0) == tid
         task = master.worker_control._tasks[tid]
         wait_for(
             lambda: task.state in ("done", "failed"),
@@ -332,6 +337,8 @@ def test_ec_balance_task(cluster2, tmp_path):
                 counts.append(bits)
         assert sorted(counts)[-1] < 14, counts  # no longer all on one node
         assert sum(counts) >= 14, counts
+        # balanced cluster: the scanner goes quiet
+        assert master.worker_control.scan_for_ec_balance(master.topo) == []
     finally:
         w.stop()
         ops.close()
