@@ -57,6 +57,42 @@ class Drop:
     node: str
 
 
+def node_view_for(
+    node_id: str,
+    rack: str,
+    data_center: str,
+    max_volume_count: int,
+    num_volumes: int,
+    ec_entries,
+    collection: str = "",
+) -> NodeView:
+    """The ONE topology->NodeView mapping (shard-bit expansion and the
+    slots*10 capacity formula) shared by the shell executor and the
+    master's auto-scanner — a private copy in either would let the
+    detector and the executor disagree about what needs balancing.
+
+    ec_entries: EcShardInfoMsg-shaped objects (.id/.shard_bits/
+    .collection). Every collection counts against capacity; only the
+    selected one (if any) is planned."""
+    shards: dict[int, set[int]] = {}
+    all_shards = 0
+    for e in ec_entries:
+        all_shards += bin(e.shard_bits).count("1")
+        if collection and e.collection != collection:
+            continue
+        shards[e.id] = {i for i in range(32) if e.shard_bits & (1 << i)}
+    return NodeView(
+        id=node_id,
+        rack=rack,
+        data_center=data_center,
+        free_slots=max(
+            (int(max_volume_count or 8) - num_volumes) * 10 - all_shards,
+            0,
+        ),
+        shards=shards,
+    )
+
+
 def plan_ec_balance(
     nodes: list[NodeView], max_moves: int = 10_000
 ) -> tuple[list[Drop], list[Move]]:

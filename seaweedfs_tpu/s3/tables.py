@@ -330,18 +330,37 @@ class TablesCatalog:
         concurrency preconditions — a failed one MUST 409 so the
         client rebases and retries instead of silently clobbering a
         concurrent commit."""
-        tables = self.tables(bucket, ns)
-        rec = tables.get(name)
-        if rec is None:
-            raise TablesError(
-                404, "NoSuchTableException", f"table {ns}.{name} not found"
-            )
-        loaded = self.load_table(bucket, ns, name)
-        metadata = loaded["metadata"]
+        metadata = self._prepare_commit_locked(
+            bucket, ns, name, updates, requirements
+        )
+        return self._persist_commit_locked(bucket, ns, name, metadata)
+
+    def _prepare_commit_locked(
+        self,
+        bucket: str,
+        ns: str,
+        name: str,
+        updates: list,
+        requirements: list | None = None,
+    ) -> dict:
+        """Validate phase: check requirements and apply every update to
+        an in-memory copy. Raises without persisting anything — the
+        split lets commit_transaction validate ALL tables before any
+        metadata file is written."""
+        metadata = self.load_table(bucket, ns, name)["metadata"]
         for req in requirements or []:
             _check_table_requirement(metadata, req)
         for u in updates or []:
             _apply_metadata_update(metadata, u)
+        return metadata
+
+    def _stamp_and_write_locked(
+        self, bucket: str, ns: str, name: str, metadata: dict
+    ) -> tuple[str, int]:
+        """Write the new metadata file; the catalog pointer is NOT
+        moved yet. An orphaned file from a later failure is harmless —
+        nothing references it."""
+        rec = self.tables(bucket, ns)[name]
         metadata["last-updated-ms"] = int(time.time() * 1000)
         metadata.setdefault("metadata-log", []).append(
             {
@@ -351,6 +370,14 @@ class TablesCatalog:
         )
         version = rec.get("version", 0) + 1
         loc = self._write_metadata(bucket, ns, name, metadata, version)
+        return loc, version
+
+    def _swap_pointer_locked(
+        self, bucket: str, ns: str, name: str, metadata: dict,
+        loc: str, version: int,
+    ) -> dict:
+        tables = self.tables(bucket, ns)
+        rec = tables[name]
         rec["metadata_location"] = loc
         rec["version"] = version
         # an assign-uuid commit must keep the catalog record (the
@@ -358,6 +385,63 @@ class TablesCatalog:
         rec["uuid"] = metadata.get("table-uuid", rec.get("uuid"))
         self._kv_put(f"s3tables:tables:{bucket}:{ns}", tables)
         return {"metadata-location": loc, "metadata": metadata}
+
+    def _persist_commit_locked(
+        self, bucket: str, ns: str, name: str, metadata: dict
+    ) -> dict:
+        loc, version = self._stamp_and_write_locked(bucket, ns, name, metadata)
+        return self._swap_pointer_locked(
+            bucket, ns, name, metadata, loc, version
+        )
+
+    def commit_transaction(self, bucket: str, table_changes: list) -> None:
+        """Multi-table transaction (Iceberg REST /v1/transactions/commit):
+        every change's requirements AND updates are validated first;
+        only when the whole set passes is anything persisted, so a 409
+        on table N leaves tables 1..N-1 untouched."""
+        with self._lock:
+            prepared = []
+            seen = set()
+            for ch in table_changes or []:
+                ident = ch.get("identifier") or {}
+                ns = ".".join(ident.get("namespace") or [])
+                name = ident.get("name", "")
+                if (ns, name) in seen:
+                    # each prepare loads the PRE-transaction metadata:
+                    # a second change for the same table would silently
+                    # discard the first one's updates at persist time
+                    raise TablesError(
+                        400,
+                        "BadRequestException",
+                        f"duplicate table {ns}.{name} in transaction",
+                    )
+                seen.add((ns, name))
+                prepared.append(
+                    (
+                        ns,
+                        name,
+                        self._prepare_commit_locked(
+                            bucket,
+                            ns,
+                            name,
+                            ch.get("updates", []),
+                            ch.get("requirements", []),
+                        ),
+                    )
+                )
+            # metadata files first, catalog-pointer swaps last: a file
+            # write failing mid-set leaves every pointer untouched
+            # (orphaned files reference nothing); only the KV swaps —
+            # small, local, far less failure-prone — remain after
+            written = [
+                (ns, name, metadata)
+                + self._stamp_and_write_locked(bucket, ns, name, metadata)
+                for ns, name, metadata in prepared
+            ]
+            for ns, name, metadata, loc, version in written:
+                self._swap_pointer_locked(
+                    bucket, ns, name, metadata, loc, version
+                )
 
     def expire_snapshots(
         self, older_than_ms: int, bucket: str = "", dry_run: bool = False
@@ -767,6 +851,11 @@ def handle_iceberg(h, catalog: TablesCatalog, path: str) -> None:
             raw = h._read_body()
             if raw:
                 body = json.loads(raw)
+        if parts == ["transactions", "commit"] and m == "POST":
+            catalog.commit_transaction(
+                bucket, body.get("table-changes", [])
+            )
+            return _json_resp(h, 204)
         if parts == ["maintenance"] and m == "POST":
             # catalog maintenance: snapshot expiry (the worker fleet's
             # `iceberg` task posts here; operators can too)

@@ -773,7 +773,7 @@ def ec_balance(env: ShellEnv, args) -> str:
     dedupe shard copies, spread each volume across racks, even within
     racks, then flatten per-rack totals — planned by ec/placement.py,
     executed here as copy+mount / unmount+delete pairs."""
-    from ..ec.placement import NodeView, plan_ec_balance
+    from ..ec.placement import node_view_for, plan_ec_balance
 
     p = argparse.ArgumentParser(prog="ec.balance")
     p.add_argument("-collection", default="")
@@ -786,27 +786,18 @@ def ec_balance(env: ShellEnv, args) -> str:
     vol_collection: dict[int, str] = {}
     views = []
     for n in topo.nodes:
-        shards: dict[int, set[int]] = {}
-        all_shards = 0  # every collection counts against capacity
         for e in n.ec_shards:
-            all_shards += bin(e.shard_bits).count("1")
-            if a.collection and e.collection != a.collection:
-                continue
-            shards[e.id] = {i for i in range(32) if e.shard_bits & (1 << i)}
-            vol_collection[e.id] = e.collection
+            if not a.collection or e.collection == a.collection:
+                vol_collection[e.id] = e.collection
         views.append(
-            NodeView(
-                id=n.id,
-                rack=n.rack,
-                data_center=n.data_center,
-                # shard-granular capacity: unused volume slots x 10
-                # minus shards already placed (any collection)
-                free_slots=max(
-                    (int(n.max_volume_count or 8) - len(n.volumes)) * 10
-                    - all_shards,
-                    0,
-                ),
-                shards=shards,
+            node_view_for(
+                n.id,
+                n.rack,
+                n.data_center,
+                n.max_volume_count,
+                len(n.volumes),
+                n.ec_shards,
+                a.collection,
             )
         )
     drops, moves = plan_ec_balance(views)

@@ -583,31 +583,20 @@ class WorkerControl:
         use over a topology snapshot; any planned drop or move means
         the cluster is out of shape, so submit ONE ec_balance task
         (which re-plans live and executes the full pass)."""
-        from ..ec.placement import NodeView, plan_ec_balance
+        from ..ec.placement import node_view_for, plan_ec_balance
 
         with topo._lock:
-            views = []
-            for n in topo.nodes.values():
-                shards = {
-                    e.id: {
-                        i for i in range(32) if e.shard_bits & (1 << i)
-                    }
-                    for e in n.ec_shards.values()
-                }
-                all_shards = sum(len(s) for s in shards.values())
-                views.append(
-                    NodeView(
-                        id=f"{n.ip}:{n.grpc_port}",
-                        rack=n.rack,
-                        data_center=n.data_center,
-                        free_slots=max(
-                            (n.max_volume_count - len(n.volumes)) * 10
-                            - all_shards,
-                            0,
-                        ),
-                        shards=shards,
-                    )
+            views = [
+                node_view_for(
+                    f"{n.ip}:{n.grpc_port}",
+                    n.rack,
+                    n.data_center,
+                    n.max_volume_count,
+                    len(n.volumes),
+                    list(n.ec_shards.values()),
                 )
+                for n in topo.nodes.values()
+            ]
         if len(views) < 2:
             return []
         drops, moves = plan_ec_balance(views)

@@ -545,3 +545,77 @@ def test_iceberg_snapshot_expiry_task(cluster, s3):
     )
     assert r.status_code == 200, r.text
     assert r.json()["snapshots_expired"] == 0
+
+
+def test_iceberg_multi_table_transaction(s3):
+    """POST /v1/transactions/commit applies changes to several tables
+    atomically: a failed requirement on ANY table leaves every table
+    untouched (Iceberg REST spec commitTransaction)."""
+    url, _srv = s3
+    ib = f"{url}/iceberg/v1"
+    requests.post(f"{ib}/namespaces", json={"namespace": ["txn"]}, timeout=10)
+    for name in ("a", "b"):
+        r = requests.post(
+            f"{ib}/namespaces/txn/tables",
+            json={"name": name, "schema": SCHEMA},
+            timeout=10,
+        )
+        assert r.status_code == 200, r.text
+
+    def change(name, props, reqs=None):
+        return {
+            "identifier": {"namespace": ["txn"], "name": name},
+            "updates": [{"action": "set-properties", "updates": props}],
+            "requirements": reqs or [],
+        }
+
+    # both tables commit in one transaction
+    r = requests.post(
+        f"{ib}/transactions/commit",
+        json={"table-changes": [change("a", {"k": "1"}),
+                                change("b", {"k": "2"})]},
+        timeout=10,
+    )
+    assert r.status_code == 204, r.text
+    for name, want in (("a", "1"), ("b", "2")):
+        md = requests.get(
+            f"{ib}/namespaces/txn/tables/{name}", timeout=10
+        ).json()["metadata"]
+        assert md["properties"]["k"] == want
+
+    # failed requirement on b -> NOTHING persists (a keeps k=1)
+    r = requests.post(
+        f"{ib}/transactions/commit",
+        json={"table-changes": [
+            change("a", {"k": "9"}),
+            change("b", {"k": "9"},
+                   reqs=[{"type": "assert-table-uuid", "uuid": "wrong"}]),
+        ]},
+        timeout=10,
+    )
+    assert r.status_code == 409, r.text
+    md = requests.get(
+        f"{ib}/namespaces/txn/tables/a", timeout=10
+    ).json()["metadata"]
+    assert md["properties"]["k"] == "1"
+
+    # duplicate table in one transaction is rejected
+    r = requests.post(
+        f"{ib}/transactions/commit",
+        json={"table-changes": [change("a", {"x": "1"}),
+                                change("a", {"y": "2"})]},
+        timeout=10,
+    )
+    assert r.status_code == 400, r.text
+    # unknown table 404s and persists nothing
+    r = requests.post(
+        f"{ib}/transactions/commit",
+        json={"table-changes": [change("a", {"k": "3"}),
+                                change("ghost", {"k": "3"})]},
+        timeout=10,
+    )
+    assert r.status_code == 404, r.text
+    md = requests.get(
+        f"{ib}/namespaces/txn/tables/a", timeout=10
+    ).json()["metadata"]
+    assert md["properties"]["k"] == "1"
