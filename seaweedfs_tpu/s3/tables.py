@@ -203,7 +203,7 @@ class TablesCatalog:
 
     def _drop_namespace_locked(self, bucket: str, ns: str) -> None:
         self.require_namespace(bucket, ns)
-        if self.tables(bucket, ns):
+        if self.tables(bucket, ns) or self.views(bucket, ns):
             raise TablesError(
                 409, "NamespaceNotEmptyException", f"namespace {ns} not empty"
             )
@@ -240,11 +240,8 @@ class TablesCatalog:
         self, bucket: str, ns: str, name: str, schema: dict, props: dict
     ) -> dict:
         self.require_namespace(bucket, ns)
+        self._check_identifier_free(bucket, ns, name)
         tables = self.tables(bucket, ns)
-        if name in tables:
-            raise TablesError(
-                409, "AlreadyExistsException", f"table {name} exists"
-            )
         schema = schema or {"type": "struct", "schema-id": 0, "fields": []}
         schema.setdefault("schema-id", 0)
         last_col = max(
@@ -287,11 +284,14 @@ class TablesCatalog:
         return name in self.tables(bucket, ns)
 
     def load_table(self, bucket: str, ns: str, name: str) -> dict:
-        rec = self.tables(bucket, ns).get(name)
+        return self._load_metadata_doc("tables", bucket, ns, name)
+
+    def _load_metadata_doc(
+        self, kind: str, bucket: str, ns: str, name: str
+    ) -> dict:
+        rec = self._registry(kind, bucket, ns).get(name)
         if rec is None:
-            raise TablesError(
-                404, "NoSuchTableException", f"table {ns}.{name} not found"
-            )
+            raise self._missing(kind, ns, name)
         loc = rec["metadata_location"]
         key = loc.split(f"s3://{bucket}/", 1)[1]
         entry = self.srv.filer.find_entry(f"/buckets/{bucket}/{key}")
@@ -511,46 +511,244 @@ class TablesCatalog:
                     )
         return out
 
+    # ------------------------------------ kind-generic drop/rename/load
+    # ("tables" | "views": one registry layout, one exception naming
+    # scheme — a private copy per kind is how the cross-kind identifier
+    # invariant gets missed)
+
+    _NOT_FOUND = {
+        "tables": ("table", "NoSuchTableException"),
+        "views": ("view", "NoSuchViewException"),
+    }
+
+    def _registry(self, kind: str, bucket: str, ns: str) -> dict:
+        return self._kv(f"s3tables:{kind}:{bucket}:{ns}")
+
+    def _missing(self, kind: str, ns: str, name: str) -> TablesError:
+        noun, exc = self._NOT_FOUND[kind]
+        return TablesError(404, exc, f"{noun} {ns}.{name} not found")
+
+    def _check_identifier_free(
+        self, bucket: str, ns: str, name: str, skip: tuple = ()
+    ) -> None:
+        """Spec invariant: a table and a view can never share an
+        identifier. skip: (kind, ns, name) of the record being moved,
+        so a same-name rename does not collide with itself."""
+        for kind in ("tables", "views"):
+            if (kind, ns, name) == skip:
+                continue
+            if name in self._registry(kind, bucket, ns):
+                noun, _ = self._NOT_FOUND[kind]
+                raise TablesError(
+                    409,
+                    "AlreadyExistsException",
+                    f"a {noun} named {name} exists in {ns}",
+                )
+
+    def _drop_locked(self, kind: str, bucket: str, ns: str, name: str) -> None:
+        reg = self._registry(kind, bucket, ns)
+        if name not in reg:
+            raise self._missing(kind, ns, name)
+        reg.pop(name)
+        self._kv_put(f"s3tables:{kind}:{bucket}:{ns}", reg)
+
+    def _rename_locked(
+        self, kind: str, bucket: str,
+        src_ns: str, src: str, dst_ns: str, dst: str,
+    ) -> None:
+        self.require_namespace(bucket, dst_ns)
+        src_reg = self._registry(kind, bucket, src_ns)
+        rec = src_reg.get(src)
+        if rec is None:
+            raise self._missing(kind, src_ns, src)
+        self._check_identifier_free(
+            bucket, dst_ns, dst, skip=(kind, src_ns, src)
+        )
+        src_reg.pop(src)
+        self._kv_put(f"s3tables:{kind}:{bucket}:{src_ns}", src_reg)
+        dst_reg = self._registry(kind, bucket, dst_ns)
+        dst_reg[dst] = rec
+        self._kv_put(f"s3tables:{kind}:{bucket}:{dst_ns}", dst_reg)
+
     def drop_table(self, bucket: str, ns: str, name: str) -> None:
         with self._lock:
-            self._drop_table_locked(bucket, ns, name)
-
-    def _drop_table_locked(self, bucket: str, ns: str, name: str) -> None:
-        tables = self.tables(bucket, ns)
-        if name not in tables:
-            raise TablesError(
-                404, "NoSuchTableException", f"table {ns}.{name} not found"
-            )
-        tables.pop(name)
-        self._kv_put(f"s3tables:tables:{bucket}:{ns}", tables)
+            self._drop_locked("tables", bucket, ns, name)
 
     def rename_table(
         self, bucket: str, src_ns: str, src: str, dst_ns: str, dst: str
     ) -> None:
         _check_name("table", dst)
         with self._lock:
-            self._rename_table_locked(bucket, src_ns, src, dst_ns, dst)
+            self._rename_locked("tables", bucket, src_ns, src, dst_ns, dst)
 
-    def _rename_table_locked(
+
+    # ------------------------------------------------------------ views
+
+    def views(self, bucket: str, ns: str) -> dict:
+        return self._kv(f"s3tables:views:{bucket}:{ns}")
+
+    def create_view(
+        self,
+        bucket: str,
+        ns: str,
+        name: str,
+        schema: dict,
+        view_version: dict,
+        props: dict,
+    ) -> dict:
+        """Iceberg view (spec view metadata v1): versions carry the SQL
+        representations; reference weed/s3api/iceberg view routes."""
+        _check_name("view", name)
+        with self._lock:
+            self.require_namespace(bucket, ns)
+            self._check_identifier_free(bucket, ns, name)
+            views = self.views(bucket, ns)
+            schema = schema or {
+                "type": "struct", "schema-id": 0, "fields": [],
+            }
+            schema.setdefault("schema-id", 0)
+            version = dict(view_version or {})
+            version.setdefault("version-id", 1)
+            version.setdefault("timestamp-ms", int(time.time() * 1000))
+            version.setdefault("schema-id", schema["schema-id"])
+            version.setdefault("summary", {})
+            version.setdefault("representations", [])
+            version.setdefault("default-namespace", ns.split("."))
+            vuid = str(uuid.uuid4())
+            metadata = {
+                "view-uuid": vuid,
+                "format-version": 1,
+                "location": f"s3://{bucket}/{ns}/{name}",
+                "schemas": [schema],
+                "current-version-id": version["version-id"],
+                "versions": [version],
+                "version-log": [
+                    {
+                        "timestamp-ms": version["timestamp-ms"],
+                        "version-id": version["version-id"],
+                    }
+                ],
+                "properties": props or {},
+            }
+            loc = self._write_metadata(bucket, ns, name, metadata, 0)
+            views[name] = {
+                "uuid": vuid,
+                "metadata_location": loc,
+                "version": 0,
+                "createdAt": time.time(),
+            }
+            self._kv_put(f"s3tables:views:{bucket}:{ns}", views)
+            return {"metadata-location": loc, "metadata": metadata}
+
+    def view_exists(self, bucket: str, ns: str, name: str) -> bool:
+        return name in self.views(bucket, ns)
+
+    def load_view(self, bucket: str, ns: str, name: str) -> dict:
+        return self._load_metadata_doc("views", bucket, ns, name)
+
+    def drop_view(self, bucket: str, ns: str, name: str) -> None:
+        with self._lock:
+            self._drop_locked("views", bucket, ns, name)
+
+    def rename_view(
         self, bucket: str, src_ns: str, src: str, dst_ns: str, dst: str
     ) -> None:
-        self.require_namespace(bucket, dst_ns)
-        src_tables = self.tables(bucket, src_ns)
-        rec = src_tables.get(src)
-        if rec is None:
+        _check_name("view", dst)
+        with self._lock:
+            self._rename_locked("views", bucket, src_ns, src, dst_ns, dst)
+
+    def commit_view(
+        self,
+        bucket: str,
+        ns: str,
+        name: str,
+        updates: list,
+        requirements: list | None = None,
+    ) -> dict:
+        with self._lock:
+            metadata = self.load_view(bucket, ns, name)["metadata"]
+            for req in requirements or []:
+                typ = req.get("type", "")
+                if typ == "assert-view-uuid":
+                    want = req.get("uuid")
+                    if metadata.get("view-uuid") != want:
+                        raise TablesError(
+                            409,
+                            "CommitFailedException",
+                            f"requirement assert-view-uuid: expected "
+                            f"{want}, view has {metadata.get('view-uuid')}",
+                        )
+                else:
+                    raise TablesError(
+                        400,
+                        "BadRequestException",
+                        f"unknown view requirement type {typ!r}",
+                    )
+            for u in updates or []:
+                _apply_view_update(metadata, u)
+            views = self.views(bucket, ns)
+            rec = views[name]
+            version = rec.get("version", 0) + 1
+            loc = self._write_metadata(bucket, ns, name, metadata, version)
+            rec["metadata_location"] = loc
+            rec["version"] = version
+            rec["uuid"] = metadata.get("view-uuid", rec.get("uuid"))
+            self._kv_put(f"s3tables:views:{bucket}:{ns}", views)
+            return {"metadata-location": loc, "metadata": metadata}
+
+
+def _apply_view_update(metadata: dict, u: dict) -> None:
+    """One Iceberg ViewUpdate (the spec's kinds for view commits).
+    Unknown kinds fail loudly, mirroring _apply_metadata_update."""
+    action = u.get("action", "")
+    if action == "assign-uuid":
+        metadata["view-uuid"] = u.get("uuid", metadata["view-uuid"])
+    elif action == "set-properties":
+        metadata["properties"].update(u.get("updates", {}))
+    elif action == "remove-properties":
+        for k in u.get("removals", []):
+            metadata["properties"].pop(k, None)
+    elif action == "set-location":
+        metadata["location"] = u.get("location", metadata["location"])
+    elif action == "add-schema":
+        schema = u.get("schema") or {}
+        metadata.setdefault("schemas", []).append(schema)
+    elif action == "add-view-version":
+        version = dict(u.get("view-version") or {})
+        if "version-id" not in version:
             raise TablesError(
-                404, "NoSuchTableException", f"table {src_ns}.{src} not found"
+                400, "BadRequestException",
+                "add-view-version needs a version-id",
             )
-        dst_tables = self.tables(bucket, dst_ns)
-        if dst in dst_tables and not (src_ns == dst_ns and src == dst):
+        if any(
+            v.get("version-id") == version["version-id"]
+            for v in metadata.get("versions", [])
+        ):
             raise TablesError(
-                409, "AlreadyExistsException", f"table {dst_ns}.{dst} exists"
+                409, "ConflictException",
+                f"view version {version['version-id']} already exists",
             )
-        src_tables.pop(src)
-        self._kv_put(f"s3tables:tables:{bucket}:{src_ns}", src_tables)
-        dst_tables = self.tables(bucket, dst_ns)
-        dst_tables[dst] = rec
-        self._kv_put(f"s3tables:tables:{bucket}:{dst_ns}", dst_tables)
+        version.setdefault("timestamp-ms", int(time.time() * 1000))
+        metadata.setdefault("versions", []).append(version)
+    elif action == "set-current-view-version":
+        vid = int(u.get("view-version-id", -1))
+        if vid == -1:  # spec: -1 = the version added in this commit
+            vid = metadata["versions"][-1].get("version-id")
+        if not any(
+            v.get("version-id") == vid
+            for v in metadata.get("versions", [])
+        ):
+            raise TablesError(
+                400, "BadRequestException", f"unknown view version {vid}"
+            )
+        metadata["current-version-id"] = vid
+        metadata.setdefault("version-log", []).append(
+            {"timestamp-ms": int(time.time() * 1000), "version-id": vid}
+        )
+    else:
+        raise TablesError(
+            400, "BadRequestException", f"unknown view update {action!r}"
+        )
 
 
 def _max_field_id(node) -> int:
@@ -842,7 +1040,7 @@ def handle_iceberg(h, catalog: TablesCatalog, path: str) -> None:
         # optional {prefix} segment = table bucket
         bucket = DEFAULT_BUCKET
         if parts and parts[0] not in (
-            "namespaces", "tables", "transactions", "maintenance",
+            "namespaces", "tables", "views", "transactions", "maintenance",
         ):
             bucket = urllib.parse.unquote(parts[0])
             parts = parts[1:]
@@ -977,6 +1175,62 @@ def handle_iceberg(h, catalog: TablesCatalog, path: str) -> None:
                     body.get("requirements", []),
                 )
                 return _json_resp(h, 200, out)
+        if len(parts) == 3 and parts[0] == "namespaces" and parts[2] == "views":
+            ns = _ns_of(parts[1])
+            if m == "GET":
+                catalog.require_namespace(bucket, ns)
+                return _json_resp(
+                    h,
+                    200,
+                    {
+                        "identifiers": [
+                            {"namespace": ns.split("."), "name": v}
+                            for v in sorted(catalog.views(bucket, ns))
+                        ]
+                    },
+                )
+            if m == "POST":
+                out = catalog.create_view(
+                    bucket,
+                    ns,
+                    body.get("name", ""),
+                    body.get("schema"),
+                    body.get("view-version"),
+                    body.get("properties", {}),
+                )
+                return _json_resp(h, 200, out)
+        if len(parts) == 4 and parts[0] == "namespaces" and parts[2] == "views":
+            ns, view = _ns_of(parts[1]), urllib.parse.unquote(parts[3])
+            if m == "HEAD":
+                if not catalog.view_exists(bucket, ns, view):
+                    raise TablesError(
+                        404, "NoSuchViewException", f"{ns}.{view}"
+                    )
+                return _json_resp(h, 204)
+            if m == "GET":
+                return _json_resp(h, 200, catalog.load_view(bucket, ns, view))
+            if m == "DELETE":
+                catalog.drop_view(bucket, ns, view)
+                return _json_resp(h, 204)
+            if m == "POST":  # commit (replace view)
+                out = catalog.commit_view(
+                    bucket,
+                    ns,
+                    view,
+                    body.get("updates", []),
+                    body.get("requirements", []),
+                )
+                return _json_resp(h, 200, out)
+        if parts == ["views", "rename"] and m == "POST":
+            src, dst = body.get("source", {}), body.get("destination", {})
+            catalog.rename_view(
+                bucket,
+                ".".join(src.get("namespace", [])),
+                src.get("name", ""),
+                ".".join(dst.get("namespace", [])),
+                dst.get("name", ""),
+            )
+            return _json_resp(h, 204)
         if parts == ["tables", "rename"] and m == "POST":
             src, dst = body.get("source", {}), body.get("destination", {})
             catalog.rename_table(

@@ -619,3 +619,127 @@ def test_iceberg_multi_table_transaction(s3):
         f"{ib}/namespaces/txn/tables/a", timeout=10
     ).json()["metadata"]
     assert md["properties"]["k"] == "1"
+
+
+def test_iceberg_view_lifecycle(s3):
+    """Iceberg REST views: create (version w/ SQL representation), load,
+    list, replace-commit, rename, name-collision with tables, drop."""
+    url, _srv = s3
+    ib = f"{url}/iceberg/v1"
+    requests.post(f"{ib}/namespaces", json={"namespace": ["vws"]}, timeout=10)
+    rep = {"type": "sql", "sql": "SELECT id FROM t", "dialect": "spark"}
+    r = requests.post(
+        f"{ib}/namespaces/vws/views",
+        json={
+            "name": "v1",
+            "schema": SCHEMA,
+            "view-version": {
+                "version-id": 1,
+                "representations": [rep],
+                "summary": {"engine-name": "spark"},
+            },
+        },
+        timeout=10,
+    )
+    assert r.status_code == 200, r.text
+    md = r.json()["metadata"]
+    assert md["format-version"] == 1
+    assert md["current-version-id"] == 1
+    assert md["versions"][0]["representations"] == [rep]
+
+    # load + exists + list
+    r = requests.get(f"{ib}/namespaces/vws/views/v1", timeout=10)
+    assert r.status_code == 200
+    assert r.json()["metadata"]["view-uuid"] == md["view-uuid"]
+    assert requests.head(
+        f"{ib}/namespaces/vws/views/v1", timeout=10
+    ).status_code == 204
+    ids = requests.get(f"{ib}/namespaces/vws/views", timeout=10).json()
+    assert ids["identifiers"] == [{"namespace": ["vws"], "name": "v1"}]
+
+    # replace: add-view-version + set-current (with the uuid guard)
+    rep2 = {"type": "sql", "sql": "SELECT id, data FROM t",
+            "dialect": "spark"}
+    r = requests.post(
+        f"{ib}/namespaces/vws/views/v1",
+        json={
+            "updates": [
+                {"action": "add-view-version",
+                 "view-version": {"version-id": 2,
+                                  "schema-id": 0,
+                                  "representations": [rep2]}},
+                {"action": "set-current-view-version",
+                 "view-version-id": -1},
+            ],
+            "requirements": [
+                {"type": "assert-view-uuid", "uuid": md["view-uuid"]}
+            ],
+        },
+        timeout=10,
+    )
+    assert r.status_code == 200, r.text
+    out = r.json()["metadata"]
+    assert out["current-version-id"] == 2
+    assert out["version-log"][-1]["version-id"] == 2
+    # stale uuid 409s
+    r = requests.post(
+        f"{ib}/namespaces/vws/views/v1",
+        json={"updates": [],
+              "requirements": [{"type": "assert-view-uuid", "uuid": "x"}]},
+        timeout=10,
+    )
+    assert r.status_code == 409
+
+    # a table cannot shadow the view name (and vice versa)
+    r = requests.post(
+        f"{ib}/namespaces/vws/tables",
+        json={"name": "v1", "schema": SCHEMA},
+        timeout=10,
+    )
+    assert r.status_code == 409, r.text
+    requests.post(f"{ib}/namespaces/vws/tables",
+                  json={"name": "t1", "schema": SCHEMA}, timeout=10)
+    r = requests.post(
+        f"{ib}/namespaces/vws/views",
+        json={"name": "t1", "schema": SCHEMA, "view-version": {}},
+        timeout=10,
+    )
+    assert r.status_code == 409, r.text
+
+    # renames cannot cross the table/view identifier invariant either
+    r = requests.post(
+        f"{ib}/views/rename",
+        json={"source": {"namespace": ["vws"], "name": "v1"},
+              "destination": {"namespace": ["vws"], "name": "t1"}},
+        timeout=10,
+    )
+    assert r.status_code == 409, r.text
+    r = requests.post(
+        f"{ib}/tables/rename",
+        json={"source": {"namespace": ["vws"], "name": "t1"},
+              "destination": {"namespace": ["vws"], "name": "v1"}},
+        timeout=10,
+    )
+    assert r.status_code == 409, r.text
+
+    # rename + nonempty-namespace guard + drop
+    r = requests.post(
+        f"{ib}/views/rename",
+        json={"source": {"namespace": ["vws"], "name": "v1"},
+              "destination": {"namespace": ["vws"], "name": "v2"}},
+        timeout=10,
+    )
+    assert r.status_code == 204, r.text
+    assert requests.get(
+        f"{ib}/namespaces/vws/views/v1", timeout=10
+    ).status_code == 404
+    requests.delete(f"{ib}/namespaces/vws/tables/t1", timeout=10)
+    assert requests.delete(
+        f"{ib}/namespaces/vws", timeout=10
+    ).status_code == 409  # view still inside
+    assert requests.delete(
+        f"{ib}/namespaces/vws/views/v2", timeout=10
+    ).status_code == 204
+    assert requests.delete(
+        f"{ib}/namespaces/vws", timeout=10
+    ).status_code == 204
