@@ -63,3 +63,15 @@ def allocate_port() -> int:
         _issued_ports.add(p)
         _issued_ports.add(p + 10000)
         return p
+
+
+def wait_for(cond, timeout=15.0, msg="condition"):
+    """Poll until cond() is true or fail with msg — the one wait loop
+    shared by worker/soak/cluster tests."""
+    import time as _time
+
+    deadline = _time.time() + timeout
+    while not cond():
+        if _time.time() > deadline:
+            raise TimeoutError(msg)
+        _time.sleep(0.05)

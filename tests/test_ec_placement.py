@@ -140,3 +140,37 @@ def test_topology_tree_registration():
     assert "dc2" not in topo.data_centers
     topo.unregister_node("10.0.0.3:8080")
     assert sorted(topo.data_centers["dc1"].racks) == ["ra"]
+
+
+def test_node_view_for_shared_builder():
+    """node_view_for is the ONE topology->NodeView mapping shared by
+    the shell executor and the master auto-scanner; its capacity math
+    and filtering must match what the planner expects."""
+    from types import SimpleNamespace
+
+    from seaweedfs_tpu.ec.placement import node_view_for
+
+    entries = [
+        SimpleNamespace(id=1, shard_bits=0b111, collection=""),
+        SimpleNamespace(id=2, shard_bits=1 << 20, collection="photos"),
+    ]
+    v = node_view_for("n1", "r1", "dc1", 8, 3, entries)
+    # every collection counts against capacity: (8-3)*10 - 4 shards
+    assert v.free_slots == 46
+    assert v.shards == {1: {0, 1, 2}, 2: {20}}  # 32-bit mask decode
+    assert v.rack_key() == ("dc1", "r1")
+
+    # collection filter: unmatched entries still consume capacity but
+    # are not planned
+    v = node_view_for("n1", "r1", "dc1", 8, 3, entries, collection="photos")
+    assert v.shards == {2: {20}}
+    assert v.free_slots == 46
+
+    # max_volume_count=0 uses the historical default of 8: with 7
+    # volumes held, (8-7)*10 - 4 shards = 6 (a removed default would
+    # clamp to 0 and fail here)
+    v = node_view_for("n2", "r1", "dc1", 0, 7, entries)
+    assert v.free_slots == 6
+    # and a genuinely slot-tight node clamps at zero
+    v = node_view_for("n3", "r1", "dc1", 0, 8, entries)
+    assert v.free_slots == 0

@@ -96,6 +96,29 @@ def test_mixed_load_during_maintenance(tmp_path):
         assert "generation" in out, out
         run_command(env, "ec.balance")
         time.sleep(1.0)
+        # the worker fleet executes an ec_balance task under the same
+        # live load (round-5: 6/6 reference task kinds)
+        from conftest import wait_for
+
+        from seaweedfs_tpu.worker import Worker
+
+        w = Worker(master=f"localhost:{mport}", backend="cpu")
+        threading.Thread(target=w.run, daemon=True).start()
+        try:
+            wait_for(
+                lambda: w.worker_id in master.worker_control._workers,
+                msg="worker registers",
+            )
+            tid = master.worker_control.submit("ec_balance", 0)
+            task = master.worker_control._tasks[tid]
+            wait_for(
+                lambda: task.state in ("done", "failed"),
+                timeout=60,
+                msg="ec_balance task reaches a terminal state",
+            )
+            assert task.state == "done", task.error
+        finally:
+            w.stop()
         run_command(env, f"volume.vacuum -volumeId {vids[-1]}")
         time.sleep(1.0)
         # round-5 maintenance verbs under the same live load:

@@ -8,20 +8,13 @@ import time
 import pytest
 
 from conftest import allocate_port as free_port
+from conftest import wait_for
 from seaweedfs_tpu.client.operations import Operations
 from seaweedfs_tpu.server.master import MasterServer
 from seaweedfs_tpu.server.volume_server import VolumeServer
 from seaweedfs_tpu.shell.commands import ShellEnv, run_command
 from seaweedfs_tpu.storage.file_id import FileId
 from seaweedfs_tpu.worker import Worker
-
-
-def wait_for(cond, timeout=15.0, msg="condition"):
-    deadline = time.time() + timeout
-    while not cond():
-        if time.time() > deadline:
-            raise TimeoutError(msg)
-        time.sleep(0.05)
 
 
 @pytest.fixture
