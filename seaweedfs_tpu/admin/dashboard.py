@@ -66,6 +66,10 @@ DASHBOARD_HTML = """<!doctype html>
     <label>EC quiet seconds</label><input name="ec_quiet_seconds"><br>
     <label>vacuum garbage threshold</label><input name="garbage_threshold"><br>
     <label>vacuum interval seconds</label><input name="vacuum_interval_seconds"><br>
+    <label>balance spread (0=off)</label><input name="balance_spread"><br>
+    <label>lifecycle interval seconds (0=off)</label><input name="lifecycle_interval_seconds"><br>
+    <label>lifecycle filer host:grpcPort</label><input name="lifecycle_filer" data-kind="str"><br>
+    <label>ec_balance interval seconds (0=off)</label><input name="ec_balance_interval_seconds"><br>
     <button type="submit">apply &amp; persist</button><span id="cfgmsg"></span>
   </form>
 
@@ -157,7 +161,8 @@ $("cfgform").addEventListener("submit", async (ev) => {
   ev.preventDefault();
   const body = {};
   for (const el of $("cfgform").elements)
-    if (el.name) body[el.name] = parseFloat(el.value);
+    if (el.name)
+      body[el.name] = el.dataset.kind === "str" ? el.value : parseFloat(el.value);
   const r = await fetch("/api/config", {method: "POST",
     headers: {"Content-Type": "application/json"}, body: JSON.stringify(body)});
   const out = await r.json();

@@ -42,7 +42,11 @@ CONFIG_FIELDS = (
     "ec_quiet_seconds",
     "garbage_threshold",
     "vacuum_interval_seconds",
+    "balance_spread",
+    "lifecycle_interval_seconds",
+    "ec_balance_interval_seconds",
 )
+STRING_CONFIG_FIELDS = ("lifecycle_filer",)
 
 
 class AdminServer:
@@ -84,7 +88,15 @@ class AdminServer:
         try:
             with open(self.config_path) as f:
                 cfg = json.load(f)
-            return {k: float(cfg[k]) for k in CONFIG_FIELDS if k in cfg}
+            out = {k: float(cfg[k]) for k in CONFIG_FIELDS if k in cfg}
+            out.update(
+                {
+                    k: str(cfg[k])
+                    for k in STRING_CONFIG_FIELDS
+                    if k in cfg
+                }
+            )
+            return out
         except (OSError, ValueError) as e:
             glog.warning(f"admin: unreadable config {self.config_path}: {e}")
             return None
@@ -177,9 +189,6 @@ class AdminServer:
         workers = self._worker_stub.ListWorkers(
             wk.ListWorkersRequest(), timeout=10
         )
-        cfg = self._worker_stub.GetMaintenanceConfig(
-            wk.GetMaintenanceConfigRequest(), timeout=10
-        )
         return {
             "tasks": [
                 {
@@ -224,14 +233,16 @@ class AdminServer:
                 }
                 for w in workers.workers
             ],
-            "config": {k: getattr(cfg, k) for k in CONFIG_FIELDS},
+            "config": self._api_get_config(),
         }
 
     def _api_get_config(self) -> dict:
         cfg = self._worker_stub.GetMaintenanceConfig(
             wk.GetMaintenanceConfigRequest(), timeout=10
         )
-        return {k: getattr(cfg, k) for k in CONFIG_FIELDS}
+        return {
+            k: getattr(cfg, k) for k in CONFIG_FIELDS + STRING_CONFIG_FIELDS
+        }
 
     def _api_submit(self, body: dict) -> dict:
         # The dashboard form sends volume_id: null for an empty field
@@ -267,16 +278,42 @@ class AdminServer:
         return {"task_id": resp.task_id}
 
     def _api_set_config(self, body: dict) -> dict:
+        # partial update: absent knobs keep their master-side values
+        # (SetMaintenanceConfig merges per-field), so older dashboards
+        # posting only the original four fields still work
         try:
-            cfg = {k: float(body[k]) for k in CONFIG_FIELDS}
-        except (KeyError, TypeError, ValueError) as e:
+            # JSON null = "leave unchanged" (a cleared dashboard input
+            # serializes as null) — same as absent
+            cfg = {
+                k: float(body[k])
+                for k in CONFIG_FIELDS
+                if body.get(k) is not None
+            }
+        except (TypeError, ValueError) as e:
             return {"error": f"config needs numeric {CONFIG_FIELDS}: {e}"}
+        for k in STRING_CONFIG_FIELDS:
+            if body.get(k) is not None:
+                cfg[k] = str(body[k] or "")
+        if not cfg:
+            return {"error": f"no known config fields in {sorted(body)}"}
         err = self._push_config(cfg)
         if err:
             return {"error": err}
-        # persist only what the master accepted
-        self._persist_config(cfg)
-        return {"config": cfg}
+        # persist the master's full post-merge state, not the partial
+        # request — otherwise a one-knob update would shrink the file
+        # and a restart would silently drop every other knob. The push
+        # already succeeded: if this second RPC fails, still persist a
+        # best-effort local merge so the applied change is never lost.
+        try:
+            full = self._api_get_config()
+        except grpc.RpcError as e:
+            full = {**(self._load_config() or {}), **cfg}
+            glog.warning(
+                f"admin: config applied but re-read failed "
+                f"({e.code().name}); persisting local merge"
+            )
+        self._persist_config(full)
+        return {"config": full}
 
     # ------------------------------------------------------------- http
 

@@ -112,6 +112,21 @@ def test_config_roundtrip_persists_and_applies(stack, tmp_path):
     # visible through GET
     assert get(aport, "/api/config")["ec_quiet_seconds"] == pytest.approx(1.5)
 
+    # partial update of the round-5 knobs, incl. the string field;
+    # untouched knobs keep their values (per-field merge)
+    code, out = post(aport, "/api/config", {
+        "ec_balance_interval_seconds": 120,
+        "lifecycle_interval_seconds": 300,
+        "lifecycle_filer": "filer:18888",
+    })
+    assert code == 200, out
+    assert master.ec_balance_interval == pytest.approx(120.0)
+    assert master.lifecycle_filer == "filer:18888"
+    assert master.ec_auto_fullness == pytest.approx(0.77)  # kept
+    got = get(aport, "/api/config")
+    assert got["ec_balance_interval_seconds"] == pytest.approx(120.0)
+    assert got["lifecycle_filer"] == "filer:18888"
+
     # invalid config is rejected wholesale and not persisted
     bad = dict(cfg, garbage_threshold=7.0)
     code, out = post(aport, "/api/config", bad)
