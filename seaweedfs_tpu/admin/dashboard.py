@@ -160,9 +160,20 @@ async function refresh() {
 $("cfgform").addEventListener("submit", async (ev) => {
   ev.preventDefault();
   const body = {};
-  for (const el of $("cfgform").elements)
-    if (el.name)
-      body[el.name] = el.dataset.kind === "str" ? el.value : parseFloat(el.value);
+  for (const el of $("cfgform").elements) {
+    if (!el.name) continue;
+    if (el.dataset.kind === "str") {
+      body[el.name] = el.value || null;  // blank = leave unchanged
+    } else {
+      const v = parseFloat(el.value);
+      if (el.value !== "" && isNaN(v)) {
+        $("cfgmsg").textContent = `${el.name}: not a number`;
+        $("cfgmsg").className = "err";
+        return;
+      }
+      body[el.name] = el.value === "" ? null : v;  // blank = unchanged
+    }
+  }
   const r = await fetch("/api/config", {method: "POST",
     headers: {"Content-Type": "application/json"}, body: JSON.stringify(body)});
   const out = await r.json();

@@ -281,16 +281,16 @@ class AdminServer:
         # partial update: absent knobs keep their master-side values
         # (SetMaintenanceConfig merges per-field), so older dashboards
         # posting only the original four fields still work
-        try:
-            # JSON null = "leave unchanged" (a cleared dashboard input
-            # serializes as null) — same as absent
-            cfg = {
-                k: float(body[k])
-                for k in CONFIG_FIELDS
-                if body.get(k) is not None
-            }
-        except (TypeError, ValueError) as e:
-            return {"error": f"config needs numeric {CONFIG_FIELDS}: {e}"}
+        # JSON null = "leave unchanged" (a cleared dashboard input
+        # serializes as null) — same as absent
+        cfg = {}
+        for k in CONFIG_FIELDS:
+            if body.get(k) is None:
+                continue
+            try:
+                cfg[k] = float(body[k])
+            except (TypeError, ValueError):
+                return {"error": f"{k} must be numeric, got {body[k]!r}"}
         for k in STRING_CONFIG_FIELDS:
             if body.get(k) is not None:
                 cfg[k] = str(body[k] or "")

@@ -126,6 +126,17 @@ class Cluster:
     def _apply(self, nid: str, kind: str, value: int) -> int:
         self.applied[nid].append((kind, value))
         self.state[nid][f"k{value % 16}"] = value
+        if kind == "op":
+            # cumulative op set INSIDE the state machine: ops folded
+            # into a snapshot never re-run through apply_fn after a
+            # restart, so at-least-once must be checked against state,
+            # not the volatile applied trace. Stored as a sorted LIST
+            # (raft snapshots are json.dumps'd — a set would TypeError
+            # inside the commit path once compaction triggers) and
+            # REPLACED, never mutated, so snapshot_fn's shallow dict()
+            # copy cannot alias a list we later append to.
+            cur = self.state[nid].get("ops") or []
+            self.state[nid]["ops"] = sorted(set(cur) | {value})
         return value
 
     def _spawn(self, nid: str) -> R.RaftNode:

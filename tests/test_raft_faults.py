@@ -12,6 +12,7 @@ no real network can do deterministically).
 
 from __future__ import annotations
 
+import json
 import os
 import random
 import time
@@ -67,8 +68,8 @@ def test_fault_free_baseline(tmp_path):
             _propose_retry(c, i)
         _wait_quiescent(c)
         _check_all(c)
-        states = {n: dict(c.state[n]) for n in c.nodes}
-        assert len({tuple(sorted(s.items())) for s in states.values()}) == 1
+        states = {json.dumps(c.state[n], sort_keys=True) for n in c.nodes}
+        assert len(states) == 1
     finally:
         c.stop()
 
@@ -168,7 +169,10 @@ def test_randomized_fault_schedule(tmp_path):
     """Seeded random schedule of proposals, partitions, crashes,
     restarts, and loss bursts; invariants checked after every fault
     event and at quiescence. RAFT_SIM_STEPS scales it up for soak
-    runs (default keeps CI fast)."""
+    runs (default keeps CI fast; 500 is the validated soak scale —
+    beyond that, wall time grows superlinearly because every proposal
+    attempted during a no-quorum window burns its full client
+    deadline)."""
     steps = int(os.environ.get("RAFT_SIM_STEPS", "120"))
     rng = random.Random(0xC0FFEE)
     c = Cluster(3, str(tmp_path), seed=5)
@@ -220,12 +224,16 @@ def test_randomized_fault_schedule(tmp_path):
         c.check_log_matching()
         # all live nodes reached identical state machines
         states = {
-            tuple(sorted(c.state[n].items())) for n in c.nodes
+            json.dumps(c.state[n], sort_keys=True) for n in c.nodes
         }
         assert len(states) == 1, "replicas diverged"
-        # every ACKED proposal survives (at-least-once, one order)
+        # every ACKED proposal survives (at-least-once). Read the op
+        # set from the replicated STATE: a leader that restarted after
+        # a snapshot never re-applies snapshot-covered entries, so the
+        # volatile applied trace under-counts (found by the 2000-step
+        # soak; the state-machine observable is restart-proof).
         leader = c.wait_leader()
-        ops = {v for k, v in c.applied[leader.node_id] if k == "op"}
+        ops = set(c.state[leader.node_id].get("ops") or [])
         missing = acked - ops
         assert not missing, f"acked ops lost: {sorted(missing)[:10]}"
         assert len(acked) >= steps * 0.3, "schedule barely made progress"
