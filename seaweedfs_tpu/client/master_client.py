@@ -20,8 +20,17 @@ import grpc
 
 from ..pb import cluster_pb2 as pb
 from ..pb import rpc
+from ..utils.retry import RetryError, RetryPolicy, retry_call
 
 _CACHE_TTL = 10.0
+
+# Leader-chasing policy: quick retries with mild backoff. The old
+# hand-rolled loop slept a flat 0.1s x4; the unified policy keeps the
+# same attempt budget but backs off under a persistent partition
+# instead of hammering a dead leader at a fixed cadence.
+_LEADER_POLICY = RetryPolicy(
+    max_attempts=4, base_delay=0.05, max_delay=0.5, multiplier=2.0, jitter=0.2
+)
 
 
 @dataclass
@@ -119,22 +128,36 @@ class MasterClient:
     def _leader_stub(self):
         return rpc.master_stub(self._channel(self._leader))
 
-    def _with_leader(self, call, attempts: int = 4):
+    def _with_leader(self, call):
         """Run `call(stub)`; on transport failure or not-leader error,
-        re-resolve and retry."""
-        last: Exception | None = None
-        for _ in range(attempts):
-            try:
-                return call(self._leader_stub())
-            except NotLeaderError as e:
-                last = e
+        re-resolve and retry (unified policy, utils/retry.py)."""
+        policy = _LEADER_POLICY
+
+        def on_retry(e: BaseException, attempt: int) -> None:
+            # recovery differs by failure class: an app-level not-leader
+            # error carries a redirect hint; a transport error means the
+            # node itself is sick and must be skipped during re-resolve
+            if isinstance(e, NotLeaderError):
                 self._note_leader_hint(str(e))
-                time.sleep(0.1)
-            except grpc.RpcError as e:
-                last = e
+            else:
                 self._resolve_leader(skip=self._leader)
-                time.sleep(0.1)
-        raise last
+
+        try:
+            return retry_call(
+                lambda: call(self._leader_stub()),
+                policy,
+                retry_on=(NotLeaderError, grpc.RpcError),
+                on_retry=on_retry,
+                describe="master RPC",
+            )
+        except RetryError as e:
+            # run the recovery once more for the FINAL failure too (the
+            # old loop did), so the NEXT call doesn't start at a leader
+            # we already know is dead
+            on_retry(e.__cause__, policy.max_attempts)
+            # callers (and tests) expect the underlying grpc/leader
+            # error class, not the retry wrapper
+            raise e.__cause__ from None
 
     # ---------------------------------------------------- keepconnected
 
@@ -378,8 +401,6 @@ class MasterClient:
         """Acquire (or renew with `token`) the named cluster lease;
         returns the token. Waits up to `wait` seconds for a busy lock.
         Raises LockHeldError when it stays held."""
-        deadline = time.time() + wait
-
         def call(stub):
             resp = stub.AdminLock(
                 pb.LockRequest(
@@ -391,13 +412,28 @@ class MasterClient:
                 raise NotLeaderError(resp.error)
             return resp
 
-        while True:
+        def attempt() -> str:
             resp = self._with_leader(call)
-            if resp.ok:
-                return resp.token
-            if time.time() >= deadline:
+            if not resp.ok:
                 raise LockHeldError(name, resp.holder)
-            time.sleep(min(0.2, max(deadline - time.time(), 0.01)))
+            return resp.token
+
+        if wait <= 0:
+            return attempt()
+        # busy-lock polling rides the unified policy: short flat-ish
+        # delays (a lease can free at any moment), total budget = wait
+        policy = RetryPolicy(
+            max_attempts=max(2, int(wait / 0.05) + 1),
+            base_delay=0.05, max_delay=0.2, multiplier=1.5, jitter=0.2,
+            deadline=wait,
+        )
+        try:
+            return retry_call(
+                attempt, policy, retry_on=(LockHeldError,),
+                describe=f"lock {name!r}",
+            )
+        except RetryError as e:
+            raise e.__cause__ from None
 
     def unlock(self, name: str, token: str) -> bool:
         def call(stub):

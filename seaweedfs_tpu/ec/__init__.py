@@ -4,7 +4,7 @@ Encode/rebuild/decode/read with pluggable CPU (C++ SIMD) and TPU
 (JAX/Pallas bit-matmul) Reed-Solomon backends, bit-identical outputs.
 """
 
-from .backend import CpuBackend, JaxBackend, get_backend
+from .backend import CpuBackend, FallbackBackend, JaxBackend, get_backend
 from .bitrot import BitrotError, BitrotProtection, ShardChecksumBuilder
 from .context import (
     BITROT_BLOCK_SIZE,
@@ -30,4 +30,12 @@ from .ec_volume import EcCookieMismatch, EcNotFoundError, EcVolume
 from .encoder import ec_encode_volume, write_ec_files, write_sorted_file_from_idx
 from .locate import Interval, locate_data
 from .rebuild import rebuild_ec_files
+from .scrub import (
+    QUARANTINE_SUFFIX,
+    RateLimiter,
+    ScrubCursor,
+    ScrubDaemon,
+    ScrubReport,
+    scrub_ec_volume,
+)
 from .volume_info import VolumeInfo

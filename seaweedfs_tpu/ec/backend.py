@@ -21,7 +21,9 @@ from typing import Protocol
 
 import numpy as np
 
+from .. import faults
 from ..ops import gf256
+from ..utils.glog import logger
 from .context import ECContext, ECError
 
 
@@ -249,9 +251,133 @@ class JaxBackend(_BackendBase):
         return np.asarray(self._rs._apply(bits, jnp.asarray(data), coeffs.shape[0]))
 
 
+class FallbackBackend(_BackendBase):
+    """Device backend with a verified CPU escape hatch, mid-batch.
+
+    Wraps a primary (JaxBackend) and a CpuBackend producing bit-identical
+    outputs by construction. Every staged handle carries the HOST copy of
+    its batch alongside the device handle, so when the device dies
+    between dispatch and drain (the to_host block is where a hung/reset
+    TPU actually surfaces) the batch is re-encoded on CPU and the encode
+    stream continues without data loss — the encoder pipeline never
+    learns a failover happened.
+
+    A circuit breaker (utils/retry.py) stops feeding a repeatedly-failing
+    device: after `failure_threshold` consecutive device errors all
+    batches go straight to CPU until the reset timeout admits a probe.
+    InjectedCrash (a BaseException) is NOT absorbed — a simulated process
+    death must not turn into a graceful failover.
+    """
+
+    def __init__(self, primary: RSBackend, fallback: "CpuBackend", breaker=None):
+        self.ctx = primary.ctx
+        self.primary = primary
+        self.fallback = fallback
+        if breaker is None:
+            from ..utils.retry import CircuitBreaker
+
+            breaker = CircuitBreaker(failure_threshold=3, reset_timeout=60.0)
+        self.breaker = breaker
+        self.fallback_batches = 0  # observability: batches served by CPU
+        self._log = logger("ec.backend")
+
+    # Deterministic caller errors (bad shape/dtype/shard-count): the CPU
+    # would fail identically, so they re-raise untouched — counting them
+    # against the breaker would demote a healthy device on user input.
+    _CALLER_ERRORS = (TypeError, ValueError, ECError)
+
+    def _device_failed(self, stage: str, e: Exception) -> None:
+        if isinstance(e, self._CALLER_ERRORS):
+            raise e
+        self.breaker.record_failure()
+        self._log.warning(
+            "device backend failed in %s (%s); falling back to CPU "
+            "(breaker %s)", stage, e, self.breaker.state,
+        )
+
+    # -- synchronous surface ------------------------------------------------
+
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        if self.breaker.allows():
+            try:
+                faults.fire("ec.backend.device.encode", width=data.shape[1])
+                out = self.primary.encode(data)
+                self.breaker.record_success()
+                return out
+            except Exception as e:
+                self._device_failed("encode", e)
+        self.fallback_batches += 1
+        return self.fallback.encode(data)
+
+    def apply(self, coeffs: np.ndarray, data: np.ndarray) -> np.ndarray:
+        if self.breaker.allows():
+            try:
+                out = self.primary.apply(coeffs, data)
+                self.breaker.record_success()
+                return out
+            except Exception as e:
+                self._device_failed("apply", e)
+        self.fallback_batches += 1
+        return self.fallback.apply(coeffs, data)
+
+    def reconstruct(
+        self, shards: dict[int, np.ndarray], want: list[int] | None = None
+    ) -> dict[int, np.ndarray]:
+        if self.breaker.allows():
+            try:
+                faults.fire("ec.backend.device.reconstruct")
+                out = self.primary.reconstruct(shards, want=want)
+                self.breaker.record_success()
+                return out
+            except Exception as e:
+                self._device_failed("reconstruct", e)
+        self.fallback_batches += 1
+        return self.fallback.reconstruct(shards, want=want)
+
+    # -- staged pipeline: handles are (host_batch, device_handle|None) ------
+
+    def to_device(self, data: np.ndarray):
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        if self.breaker.allows():
+            try:
+                faults.fire("ec.backend.device.to_device", width=data.shape[1])
+                return (data, self.primary.to_device(data))
+            except Exception as e:
+                self._device_failed("to_device", e)
+        return (data, None)
+
+    def encode_staged(self, staged):
+        host, dev = staged
+        if dev is not None:
+            try:
+                faults.fire("ec.backend.device.encode_staged")
+                return (host, self.primary.encode_staged(dev))
+            except Exception as e:
+                self._device_failed("encode_staged", e)
+        return (host, None)
+
+    def to_host(self, result) -> np.ndarray:
+        host, dev = result
+        if dev is not None:
+            try:
+                faults.fire("ec.backend.device.to_host")
+                out = np.asarray(self.primary.to_host(dev), dtype=np.uint8)
+                self.breaker.record_success()
+                return out
+            except Exception as e:
+                self._device_failed("to_host", e)
+        # Mid-batch failover: the host copy re-encodes on CPU,
+        # bit-identical to what the device would have produced.
+        self.fallback_batches += 1
+        return self.fallback.encode(host)
+
+
 @functools.lru_cache(maxsize=16)
 def get_backend(name: str, data_shards: int, parity_shards: int) -> RSBackend:
-    """name: cpu | tpu | auto. 'auto' prefers the TPU when one is attached."""
+    """name: cpu | tpu | auto. 'auto' prefers the TPU when one is
+    attached, wrapped in the CPU-fallback shim so a device that dies
+    mid-stream degrades to the (bit-identical) CPU path instead of
+    failing the encode."""
     ctx = ECContext(data_shards, parity_shards)
     if name == "cpu":
         return CpuBackend(ctx)
@@ -265,7 +391,7 @@ def get_backend(name: str, data_shards: int, parity_shards: int) -> RSBackend:
 
         if accelerator_available():
             try:
-                return JaxBackend(ctx)
+                return FallbackBackend(JaxBackend(ctx), CpuBackend(ctx))
             except Exception:
                 pass
         return CpuBackend(ctx)

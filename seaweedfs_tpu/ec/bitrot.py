@@ -177,11 +177,20 @@ class BitrotProtection:
 
     # ---- verification ----
 
-    def verify_shard_file(self, path: str, shard_id: int) -> list[int]:
+    def verify_shard_file(
+        self,
+        path: str,
+        shard_id: int,
+        on_block=None,
+        stop_early: bool = False,
+    ) -> list[int]:
         """-> list of mismatched block indices ([] = clean).
 
         A size mismatch counts as every expected block mismatching
         (truncation is corruption, reference fail-closed rule).
+        `on_block(n_bytes)` is invoked per block read (rate-limiting
+        hook for the scrubber); `stop_early` returns at the first
+        mismatch when only a yes/no verdict is needed.
         """
         expected = self.shard_crcs[shard_id]
         if os.path.getsize(path) != self.shard_sizes[shard_id]:
@@ -190,6 +199,10 @@ class BitrotProtection:
         with open(path, "rb") as f:
             for i, want in enumerate(expected):
                 block = f.read(self.block_size)
+                if on_block is not None:
+                    on_block(len(block))
                 if crc32c(block) != want:
                     bad.append(i)
+                    if stop_early:
+                        break
         return bad

@@ -19,6 +19,11 @@ SMALL_BLOCK_SIZE = 1024 * 1024  # 1MB
 # Bitrot sidecar granularity (reference ec_bitrot.go BitrotBlockSize).
 BITROT_BLOCK_SIZE = 16 * 1024 * 1024  # 16 MiB
 
+# Quarantined shard suffix: scrub renames corrupt shards to
+# <shard>.bad so they can never be fed to Reed-Solomon (kept for
+# forensics until a verified replacement lands).
+QUARANTINE_SUFFIX = ".bad"
+
 
 class ECError(Exception):
     pass

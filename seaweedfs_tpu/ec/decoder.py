@@ -12,6 +12,7 @@ import os
 import struct
 from typing import Iterator
 
+from .. import faults
 from ..storage.needle import footer_size
 from ..storage.super_block import SUPER_BLOCK_SIZE
 from ..utils.fs import fsync_dir as _fsync_dir
@@ -119,7 +120,9 @@ def write_idx_from_ecx(base: str) -> None:
             for nid in iterate_ecj(base):
                 out.write(NeedleValue(nid, 0, TOMBSTONE_FILE_SIZE).to_bytes())
             out.flush()
+            faults.fire("ec.decode.idx.before_fsync", base=base)
             os.fsync(out.fileno())
+        faults.fire("ec.decode.idx.before_rename", base=base)
         os.replace(tmp, idx_path)
         _fsync_dir(idx_path)
     except BaseException:
@@ -207,7 +210,9 @@ def write_dat_file(
                     remaining -= take
                 row += 1
             out.flush()
+            faults.fire("ec.decode.dat.before_fsync", base=base)
             os.fsync(out.fileno())
+        faults.fire("ec.decode.dat.before_rename", base=base)
         os.replace(tmp, dat_path)
         _fsync_dir(dat_path)
     except BaseException:

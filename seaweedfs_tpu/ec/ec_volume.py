@@ -17,14 +17,20 @@ from typing import Optional
 
 import numpy as np
 
-from ..storage.needle import Needle
+from .. import faults
+from ..storage.needle import CrcError, Needle
 from ..storage.needle_map import SortedFileNeedleMap
 from ..storage.types import actual_offset
+from ..utils.crc import crc32c
+from ..utils.glog import logger
 from .backend import RSBackend, get_backend
-from .context import DEFAULT_EC_CONTEXT, ECContext, ECError
+from .bitrot import BitrotError, BitrotProtection
+from .context import DEFAULT_EC_CONTEXT, QUARANTINE_SUFFIX, ECContext, ECError
 from .decoder import record_actual_size
 from .locate import locate_data
 from .volume_info import VolumeInfo
+
+log = logger("ec.volume")
 
 
 class EcNotFoundError(ECError):
@@ -91,6 +97,11 @@ class EcVolume:
             backend_name, self.ctx.data_shards, self.ctx.parity_shards
         )
         self.remote_reader = remote_reader
+        # Bitrot sidecar, loaded lazily for degraded-read verification.
+        # False = not loaded yet (absence is re-probed per degraded
+        # read; only a successful load is cached).
+        self._prot: BitrotProtection | bool = False
+        self._prot_warned = False
 
     # ------------------------------------------------------------- lookup
 
@@ -116,30 +127,60 @@ class EcVolume:
         # Interval reads run OUTSIDE the volume lock: os.pread is
         # thread-safe and a slow remote shard fetch must not serialize
         # every other read of this volume.
-        raw = self._read_extent(
-            actual_offset(nv.offset), record_actual_size(nv.size, self.version)
-        )
+        off = actual_offset(nv.offset)
+        rec_size = record_actual_size(nv.size, self.version)
+        try:
+            return self._parse(self._read_extent(off, rec_size), cookie, needle_id)
+        except CrcError:
+            # Local bytes are rotten (bitrot / torn shard). Self-heal on
+            # read: re-derive every interval by sidecar-verified
+            # reconstruction, bypassing the local shard copies. Either
+            # the record comes back bit-exact or this raises — a corrupt
+            # needle is never served.
+            log.warning(
+                "needle %x failed CRC from local shards; retrying via "
+                "verified reconstruction", needle_id,
+            )
+            return self._parse(
+                self._read_extent(off, rec_size, prefer_recovery=True),
+                cookie, needle_id,
+            )
+
+    def _parse(self, raw: bytes, cookie: Optional[int], needle_id: int) -> Needle:
         n = Needle.from_bytes(raw, self.version)
         if cookie is not None and n.cookie != cookie:
             raise EcCookieMismatch(f"needle {needle_id:x} cookie mismatch")
         return n
 
-    def _read_extent(self, offset: int, size: int) -> bytes:
+    def _read_extent(
+        self, offset: int, size: int, prefer_recovery: bool = False
+    ) -> bytes:
         parts = []
         for iv in locate_data(
             offset, size, self._locate_shard_size, self.ctx.data_shards
         ):
             shard_id, shard_off = iv.to_shard_and_offset(self.ctx.data_shards)
-            parts.append(self._read_shard_interval(shard_id, shard_off, iv.size))
+            if prefer_recovery:
+                parts.append(self._recover_interval(shard_id, shard_off, iv.size))
+            else:
+                parts.append(self._read_shard_interval(shard_id, shard_off, iv.size))
         return b"".join(parts)
 
     def _read_shard_interval(self, shard_id: int, offset: int, size: int) -> bytes:
         fd = self.shard_fds.get(shard_id)
         if fd is not None:
             try:
+                faults.fire(
+                    "ec.volume.shard_read",
+                    shard=shard_id, offset=offset, size=size,
+                )
                 got = os.pread(fd, size, offset)
-            except OSError:  # racing unmount closed the fd
+            except OSError:  # racing unmount closed the fd (or injected)
                 got = b""
+            got = faults.mutate(
+                "ec.volume.shard_read", got,
+                shard=shard_id, offset=offset, size=size,
+            )
             if len(got) == size:
                 return got
             # short read = truncated shard; fall through to recovery
@@ -149,7 +190,73 @@ class EcVolume:
                 return got
         return self._recover_interval(shard_id, offset, size)
 
+    # ---------------------------------------------------------- recovery
+
+    def _bitrot(self) -> Optional[BitrotProtection]:
+        """Lazy-load the .ecsum sidecar for reconstruction verification.
+        Absent or unreadable -> None for THIS read only: a successful
+        load is cached, but absence is re-probed every time — a sidecar
+        that lands late (crash window between shard publish and sidecar
+        write, shards copied before the sidecar) must re-arm
+        verification, not be disabled for the life of the mount."""
+        if self._prot is False:
+            try:
+                self._prot = BitrotProtection.load(self.base + ".ecsum")
+            except (FileNotFoundError, BitrotError, OSError) as e:
+                if not self._prot_warned:
+                    self._prot_warned = True
+                    log.warning(
+                        "%s.ecsum unavailable (%s); degraded reads are "
+                        "UNVERIFIED until it appears", self.base, e,
+                    )
+                return None
+        return self._prot
+
     def _recover_interval(self, shard_id: int, offset: int, size: int) -> bytes:
+        """Reconstruct [offset, offset+size) of one shard and — when the
+        .ecsum sidecar is available — verify the containing bitrot
+        blocks before returning a byte (the reconstruction itself ran
+        over unverified sibling reads, so its output cannot be trusted
+        unchecked). Fail-closed: a mismatch raises rather than serving.
+        """
+        prot = self._bitrot()
+        if prot is None or not (0 <= shard_id < len(prot.shard_crcs)):
+            return self._reconstruct_range(shard_id, offset, size)
+        bs = prot.block_size
+        ssize = prot.shard_sizes[shard_id]
+        if offset + size > ssize:
+            # extent beyond the sidecar's recorded shard: no ground
+            # truth for the tail — serve unverified rather than refuse
+            # (matches pre-sidecar volumes)
+            return self._reconstruct_range(shard_id, offset, size)
+        lo = (offset // bs) * bs
+        hi = min(-(-(offset + size) // bs) * bs, ssize)
+
+        def range_ok(sid: int, data: bytes) -> bool:
+            """Verify a shard's [lo, hi) bytes against its own block
+            CRCs (blocks align across shards: equal sizes, one layout)."""
+            crcs = prot.shard_crcs[sid]
+            for bi in range(lo // bs, -(-hi // bs)):
+                blk = data[bi * bs - lo : min((bi + 1) * bs, hi) - lo]
+                if bi >= len(crcs) or crc32c(blk) != crcs[bi]:
+                    return False
+            return True
+
+        # Sources are sidecar-verified BEFORE being fed to Reed-Solomon:
+        # a silently-rotten sibling is excluded instead of poisoning the
+        # reconstruction (which would force a refusal even though k
+        # clean shards exist).
+        data = self._reconstruct_range(shard_id, lo, hi - lo, source_ok=range_ok)
+        if not range_ok(shard_id, data):
+            raise ECError(
+                f"reconstructed shard {shard_id} [{lo}:{hi}) fails "
+                f".ecsum verification; refusing to serve"
+            )
+        return data[offset - lo : offset - lo + size]
+
+    def _reconstruct_range(
+        self, shard_id: int, offset: int, size: int, source_ok=None
+    ) -> bytes:
         """On-the-fly RS decode of one interval from >=k sibling shards
         (reference store_ec.go:656-747; like the reference, sibling
         reads fan out in parallel — remote fetches dominate latency)."""
@@ -161,7 +268,7 @@ class EcVolume:
                 got = os.pread(fd, size, offset)
             except OSError:
                 continue
-            if len(got) == size:
+            if len(got) == size and (source_ok is None or source_ok(i, got)):
                 sources[i] = np.frombuffer(got, dtype=np.uint8)
                 if len(sources) == k:
                     break
@@ -186,7 +293,11 @@ class EcVolume:
                     done, futures = wait(futures, return_when=FIRST_COMPLETED)
                     for f in done:
                         i, got = f.result()
-                        if got is not None and len(got) == size:
+                        if (
+                            got is not None
+                            and len(got) == size
+                            and (source_ok is None or source_ok(i, got))
+                        ):
                             sources[i] = np.frombuffer(got, dtype=np.uint8)
             finally:
                 ex.shutdown(wait=False, cancel_futures=True)
@@ -220,6 +331,23 @@ class EcVolume:
     def shard_ids(self) -> list[int]:
         return sorted(self.shard_fds)
 
+    def quarantined_shards(self) -> list[int]:
+        """Shards whose scrub-quarantine file (<shard>.bad) is on disk."""
+        return [
+            i
+            for i in range(self.ctx.total)
+            if os.path.exists(self.base + self.ctx.to_ext(i) + QUARANTINE_SUFFIX)
+        ]
+
+    def legitimate_shards(self) -> list[int]:
+        """Shards this server legitimately owns: currently served PLUS
+        quarantined ones (a shard pulled from service for corruption is
+        still this server's to repair — it must not drop off the repair
+        list just because it was unmounted)."""
+        with self._lock:
+            held = set(self.shard_fds)
+        return sorted(held | set(self.quarantined_shards()))
+
     def shard_size(self) -> int:
         return self._shard_size
 
@@ -227,12 +355,24 @@ class EcVolume:
         """Pick up shard files that appeared on disk since mount (e.g.
         just copied from a peer); returns the current shard ids."""
         with self._lock:
-            for i in range(self.ctx.total):
-                if i in self.shard_fds:
-                    continue
-                p = self.base + self.ctx.to_ext(i)
+            return self.reopen_shards(
+                [i for i in range(self.ctx.total) if i not in self.shard_fds]
+            )
+
+    def reopen_shards(self, shard_ids: Optional[list[int]] = None) -> list[int]:
+        """Re-open shard fds from the current directory entries. After a
+        rebuild atomically replaces a shard file, an fd opened before
+        the rename still reads the OLD inode (the quarantined bytes);
+        serving must swap to the regenerated file. Returns mounted ids."""
+        with self._lock:
+            ids = list(self.shard_fds) if shard_ids is None else shard_ids
+            for sid in ids:
+                p = self.base + self.ctx.to_ext(sid)
+                old = self.shard_fds.pop(sid, None)
+                if old is not None:
+                    os.close(old)
                 if os.path.exists(p):
-                    self.shard_fds[i] = os.open(p, os.O_RDONLY)
+                    self.shard_fds[sid] = os.open(p, os.O_RDONLY)
                     self._shard_size = max(self._shard_size, os.path.getsize(p))
             return sorted(self.shard_fds)
 

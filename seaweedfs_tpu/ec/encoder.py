@@ -21,6 +21,7 @@ import time
 
 import numpy as np
 
+from .. import faults
 from ..storage.needle_map import MemDb
 from .backend import RSBackend, get_backend
 from .bitrot import BitrotProtection, ShardChecksumBuilder
@@ -321,6 +322,9 @@ def write_ec_files(
         if errors:
             raise errors[0]
 
+        # Crash window: shards fully written but not yet durable — a
+        # power cut here may leave any suffix of any shard missing.
+        faults.fire("ec.encode.before_fsync", base=base)
         # Durability barrier. Flushes are issued in parallel: on a real
         # disk array the 14 shard files' dirty pages drain concurrently
         # instead of serializing 14 round-trips.
@@ -358,8 +362,13 @@ def ec_encode_volume(
 
     encode_ts_ns = time.time_ns()
     write_sorted_file_from_idx(base)
+    # Crash window the ecx-first ordering closes: .ecx exists, no shards.
+    faults.fire("ec.encode.after_ecx", base=base)
     prot = write_ec_files(base, ctx, backend, batch_size)
     prot.generation = encode_ts_ns
+    # Crash window: shards durable, sidecar absent — readers must serve,
+    # scrub must refuse (no ground truth), rebuild must still work.
+    faults.fire("ec.encode.before_ecsum", base=base)
     prot.save(base + ".ecsum")
 
     vi = VolumeInfo(

@@ -20,6 +20,7 @@ import os
 
 import numpy as np
 
+from .. import faults
 from .backend import RSBackend, get_backend
 from .bitrot import BitrotError, BitrotProtection, ShardChecksumBuilder
 from .context import DEFAULT_EC_CONTEXT, ECContext, ECError
@@ -34,8 +35,14 @@ def rebuild_ec_files(
     backend: RSBackend | None = None,
     unsafe_ignore_sidecar: bool = False,
     batch_size: int = DEFAULT_BATCH,
+    only_shards: list[int] | None = None,
 ) -> list[int]:
-    """Regenerate missing/corrupt shard files; returns regenerated ids."""
+    """Regenerate missing/corrupt shard files; returns regenerated ids.
+
+    `only_shards` restricts which ABSENT shards are regenerated (a
+    subset-holding server must not mint local copies of shards placed on
+    peers); present-but-corrupt shards are always replaced regardless.
+    """
     # Sidecar first: it records the shard ratio too, which backs up the
     # .vif for config resolution and cross-checks it.
     prot: BitrotProtection | None = None
@@ -76,6 +83,8 @@ def rebuild_ec_files(
     total, k = ctx.total, ctx.data_shards
     present = [i for i in range(total) if os.path.exists(base + ctx.to_ext(i))]
     missing = [i for i in range(total) if i not in present]
+    if only_shards is not None:
+        missing = [i for i in missing if i in only_shards]
 
     # --- bitrot verify-and-exclude ---------------------------------------
     corrupt: list[int] = []
@@ -131,16 +140,29 @@ def rebuild_ec_files(
         for off in range(0, shard_size, batch_size):
             width = min(batch_size, shard_size - off)
             block = {
-                i: np.frombuffer(os.pread(fds[i], width, off), dtype=np.uint8)
+                i: np.frombuffer(
+                    faults.mutate(
+                        "ec.rebuild.read_shard",
+                        os.pread(fds[i], width, off),
+                        base=base, shard=i, offset=off,
+                    ),
+                    dtype=np.uint8,
+                )
                 for i in src
             }
             if any(len(b) != width for b in block.values()):
                 raise ECError(f"short shard read at offset {off}")
             rec = backend.reconstruct(block, want=missing)
             for i in missing:
-                b = np.asarray(rec[i], dtype=np.uint8).tobytes()
+                b = faults.mutate(
+                    "ec.rebuild.shard_bytes",
+                    np.asarray(rec[i], dtype=np.uint8).tobytes(),
+                    base=base, shard=i, offset=off,
+                )
                 outs[i].write(b)
                 builders[i].write(b)
+        # Crash window: temp .rebuilding files written, not yet durable.
+        faults.fire("ec.rebuild.before_fsync", base=base)
         for f in outs.values():
             f.flush()
             os.fsync(f.fileno())
@@ -173,7 +195,12 @@ def rebuild_ec_files(
                     f"verification; refusing to publish"
                 )
 
+    # Crash window: temps durable + sidecar-verified, renames pending. A
+    # crash here (or between renames) leaves a mix of published shards
+    # and .rebuilding temps; a restarted rebuild regenerates the rest.
+    faults.fire("ec.rebuild.before_rename", base=base)
     for i in missing:
         os.replace(tmp_paths[i], base + ctx.to_ext(i))
+        faults.fire("ec.rebuild.after_rename", base=base, shard=i)
     _fsync_dir(base + ".dat")
     return sorted(missing)

@@ -1,0 +1,490 @@
+"""EC scrub daemon: walk EC volumes, verify shard blocks against the
+.ecsum sidecar incrementally, quarantine corrupt shards, trigger
+rebuild — the background self-healing loop the reference runs as
+ec_volume scrubbing plus shell-driven ec.rebuild.
+
+Design points:
+
+- incremental + resumable: verification walks (shard, block) positions
+  with a persisted cursor (<base>.scrubpos), so a restart resumes
+  mid-volume instead of rescanning from zero; a budget (`max_blocks`)
+  lets the daemon time-slice huge volumes across wakeups.
+- rate-limited: a token bucket caps read bandwidth so scrubbing never
+  starves foreground traffic.
+- quarantine, never trust: a corrupt shard file is renamed to
+  <shard>.bad (kept for forensics) so it can NEVER be fed to
+  Reed-Solomon; reads degrade to reconstruction until rebuild lands.
+- fail closed: a malformed sidecar or >parity mismatches stops the
+  self-heal (the sidecar itself is suspect) and reports refusal instead
+  of "repairing" with untrustworthy inputs.
+- rebuild runs under the unified retry policy (utils/retry.py) and an
+  optional circuit breaker shared across volumes, so one dead disk
+  doesn't turn the daemon into a rebuild-retry storm.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+from .. import faults
+from ..utils.crc import crc32c
+from ..utils.fs import atomic_write, fsync_dir
+from ..utils.glog import logger
+from ..utils.retry import CircuitBreaker, CircuitOpenError, RetryError, RetryPolicy, retry_call
+from .bitrot import BitrotError, BitrotProtection
+from .context import QUARANTINE_SUFFIX, ECContext, ECError
+from .rebuild import rebuild_ec_files
+
+log = logger("ec.scrub")
+
+CURSOR_SUFFIX = ".scrubpos"
+
+# Rebuilds are retried gently for TRANSIENT failures only (OSError: I/O
+# flakes). ECError is deterministic (not-enough-shards, sidecar refusal)
+# — retrying it just burns disk and poisons the breaker.
+DEFAULT_REBUILD_POLICY = RetryPolicy(
+    max_attempts=3, base_delay=0.2, max_delay=2.0, retry_on=(OSError,)
+)
+
+
+class RateLimiter:
+    """Token-bucket byte limiter (injectable clock/sleep for tests)."""
+
+    def __init__(
+        self,
+        bytes_per_sec: float,
+        burst: float | None = None,
+        clock=time.monotonic,
+        sleep=time.sleep,
+    ):
+        self.rate = float(bytes_per_sec)
+        self.burst = float(burst if burst is not None else bytes_per_sec)
+        self._tokens = self.burst
+        self._clock = clock
+        self._sleep = sleep
+        self._last = clock()
+        self._lock = threading.Lock()
+
+    def consume(self, n: int) -> None:
+        if self.rate <= 0:  # unlimited
+            return
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(self.burst, self._tokens + (now - self._last) * self.rate)
+            self._last = now
+            self._tokens -= n
+            deficit = -self._tokens
+        if deficit > 0:
+            self._sleep(deficit / self.rate)
+
+
+@dataclass
+class ScrubCursor:
+    """Resumable (shard, block) position, pinned to a sidecar generation
+    so a re-encode restarts the walk. Carries the corrupt shards found
+    in earlier budget slices of the same pass — quarantine only happens
+    once the pass completes, so mid-pass findings must survive a pause
+    (and a process restart)."""
+
+    generation: int = 0
+    shard: int = 0
+    block: int = 0
+    corrupt: list[int] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, base: str) -> "ScrubCursor | None":
+        try:
+            with open(base + CURSOR_SUFFIX) as f:
+                doc = json.load(f)
+            return cls(
+                generation=int(doc["generation"]),
+                shard=int(doc["shard"]),
+                block=int(doc["block"]),
+                corrupt=[int(x) for x in doc.get("corrupt", [])],
+            )
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def save(self, base: str) -> None:
+        atomic_write(
+            base + CURSOR_SUFFIX,
+            json.dumps(
+                {
+                    "generation": self.generation,
+                    "shard": self.shard,
+                    "block": self.block,
+                    "corrupt": self.corrupt,
+                }
+            ).encode(),
+        )
+
+    @staticmethod
+    def drop(base: str) -> None:
+        try:
+            os.unlink(base + CURSOR_SUFFIX)
+        except OSError:
+            pass
+
+
+@dataclass
+class ScrubReport:
+    base: str
+    complete: bool = False  # full pass finished (vs budget-paused)
+    checked_blocks: int = 0
+    checked_bytes: int = 0
+    corrupt_shards: list[int] = field(default_factory=list)
+    missing_shards: list[int] = field(default_factory=list)
+    quarantined: list[str] = field(default_factory=list)
+    rebuilt: list[int] = field(default_factory=list)
+    refused: str = ""  # non-empty = fail-closed, nothing was touched
+
+    @property
+    def healthy(self) -> bool:
+        return (
+            self.complete
+            and not self.corrupt_shards
+            and not self.missing_shards
+            and not self.refused
+        )
+
+
+def _quarantine(path: str) -> str:
+    """Rename a corrupt shard out of Reed-Solomon's reach, atomically.
+    An existing old quarantine of the same shard is replaced — the
+    freshest corrupt bytes are the forensically interesting ones."""
+    dest = path + QUARANTINE_SUFFIX
+    os.replace(path, dest)
+    fsync_dir(path)
+    return dest
+
+
+def scrub_ec_volume(
+    base: str,
+    ctx: ECContext | None = None,
+    *,
+    backend=None,
+    repair: bool = True,
+    rate_limiter: RateLimiter | None = None,
+    resumable: bool = True,
+    max_blocks: int | None = None,
+    rebuild_policy: RetryPolicy = DEFAULT_REBUILD_POLICY,
+    breaker: CircuitBreaker | None = None,
+    expected_shards: list[int] | None = None,
+    on_quarantine=None,
+    on_rebuilt=None,
+) -> ScrubReport:
+    """One scrub pass (possibly budget-sliced) over one EC volume.
+
+    Verifies every present shard's blocks against the .ecsum sidecar,
+    quarantines mismatching shards (rename to .bad), and — when `repair`
+    — regenerates quarantined/missing shards via rebuild_ec_files under
+    the retry policy. `on_quarantine(shard_id, new_path)` and
+    `on_rebuilt(shard_ids)` let a serving layer unmount/remount shards
+    around the repair.
+
+    `expected_shards` bounds which ABSENT shards count as missing (and
+    so get rebuilt): on a balanced cluster a server legitimately holds a
+    subset, and an absent shard usually lives on a peer — rebuilding it
+    here would mint a duplicate copy the master never placed (and, below
+    k local files, fail forever). Default None = all shards expected
+    (single-node / full-set layouts, tests).
+    """
+    report = ScrubReport(base=base)
+    ecsum = base + ".ecsum"
+    if not os.path.exists(ecsum):
+        report.refused = "no .ecsum sidecar; cannot verify shards"
+        return report
+    try:
+        prot = BitrotProtection.load(ecsum)
+    except BitrotError as e:
+        # Fail closed: an unreadable sidecar means no trustworthy ground
+        # truth; rebuilding from unverified shards could launder rot.
+        report.refused = f"sidecar malformed: {e}"
+        return report
+    if ctx is not None and prot.ctx != ctx:
+        report.refused = f"sidecar ratio {prot.ctx} != expected {ctx}"
+        return report
+    ctx = prot.ctx
+
+    cursor = ScrubCursor.load(base) if resumable else None
+    if cursor is None or cursor.generation != prot.generation:
+        cursor = ScrubCursor(generation=prot.generation)
+    # Verdicts carried from earlier budget slices of this pass; they are
+    # re-verified at completion (see below) before any quarantine.
+    carried = set(cursor.corrupt)
+    report.corrupt_shards.extend(cursor.corrupt)
+
+    want_local = (
+        set(range(ctx.total)) if expected_shards is None else set(expected_shards)
+    )
+    budget = max_blocks if max_blocks is not None else float("inf")
+    paused = False
+    present_files = 0
+    for shard_id in range(ctx.total):
+        path = base + ctx.to_ext(shard_id)
+        if not os.path.exists(path):
+            if shard_id in want_local:
+                report.missing_shards.append(shard_id)
+            continue
+        present_files += 1
+        if shard_id < cursor.shard:
+            continue  # verified in an earlier slice of this pass
+        start_block = cursor.block if shard_id == cursor.shard else 0
+        expected = prot.shard_crcs[shard_id]
+        corrupt = False
+        try:
+            if os.path.getsize(path) != prot.shard_sizes[shard_id]:
+                corrupt = True  # truncation/growth is corruption
+            else:
+                with open(path, "rb") as f:
+                    f.seek(start_block * prot.block_size)
+                    for bi in range(start_block, len(expected)):
+                        if budget <= 0:
+                            cursor.shard, cursor.block = shard_id, bi
+                            if resumable:
+                                cursor.save(base)
+                            paused = True
+                            break
+                        block = f.read(prot.block_size)
+                        block = faults.mutate(
+                            "ec.scrub.read_block", block, path=path, shard=shard_id
+                        )
+                        if rate_limiter is not None:
+                            rate_limiter.consume(len(block))
+                        report.checked_blocks += 1
+                        report.checked_bytes += len(block)
+                        budget -= 1
+                        if crc32c(block) != expected[bi]:
+                            corrupt = True
+                            break
+        except OSError:
+            corrupt = True  # unreadable = untrustworthy RS input
+        if paused:
+            break
+        if corrupt:
+            report.corrupt_shards.append(shard_id)
+            cursor.corrupt.append(shard_id)
+        cursor.shard, cursor.block = shard_id + 1, 0
+        # Persist progress only when a mid-pass pause is possible at all
+        # (a block budget is set): an unbounded pass can never resume,
+        # so per-shard fsync'd cursor writes would be pure I/O overhead
+        # on every healthy pass of every volume.
+        if resumable and max_blocks is not None:
+            cursor.save(base)
+
+    if paused:
+        return report
+    report.complete = True
+    if resumable:
+        ScrubCursor.drop(base)
+
+    # Cursor-carried verdicts are stale across slices: the shard may
+    # have been repaired (ec.scrub -repair, ec.rebuild) or removed since
+    # its slice ran. Re-verify before trusting — quarantining a freshly
+    # rebuilt good shard would undo a repair. The re-read honors the
+    # same token bucket as the walk (carried shards can be multi-GB).
+    for sid in [s for s in report.corrupt_shards if s in carried]:
+        path = base + ctx.to_ext(sid)
+        try:
+            still_bad = bool(
+                prot.verify_shard_file(
+                    path,
+                    sid,
+                    on_block=rate_limiter.consume if rate_limiter else None,
+                    stop_early=True,
+                )
+            )
+        except FileNotFoundError:
+            still_bad = False  # gone: nothing to quarantine; it is
+            # already in missing_shards if this server should hold it
+        except OSError:
+            still_bad = True
+        if not still_bad:
+            report.corrupt_shards.remove(sid)
+
+    # ---- fail-closed gates mirror rebuild's verify-and-exclude rules ----
+    if len(report.corrupt_shards) > ctx.parity_shards:
+        # The sidecar is the suspect when "everything" mismatches; do NOT
+        # quarantine good shards on its say-so.
+        report.refused = (
+            f"{len(report.corrupt_shards)} shards mismatch (> parity "
+            f"{ctx.parity_shards}); sidecar suspect, refusing to quarantine"
+        )
+        return report
+    present_good = present_files - len(report.corrupt_shards)
+    if report.corrupt_shards and present_good < ctx.data_shards:
+        report.refused = (
+            f"only {present_good} verified-good shards (need "
+            f"{ctx.data_shards}); refusing to quarantine below rebuild floor"
+        )
+        return report
+
+    for shard_id in report.corrupt_shards:
+        path = base + ctx.to_ext(shard_id)
+        try:
+            dest = _quarantine(path)
+        except FileNotFoundError:
+            continue  # vanished since re-verify; missing-walk owns it now
+        report.quarantined.append(dest)
+        log.warning("quarantined corrupt shard %s -> %s", path, dest)
+        if on_quarantine is not None:
+            on_quarantine(shard_id, dest)
+
+    want_rebuild = sorted(set(report.corrupt_shards) | set(report.missing_shards))
+    if repair and want_rebuild:
+        def attempt() -> list[int]:
+            return rebuild_ec_files(
+                base, ctx, backend=backend, only_shards=want_rebuild
+            )
+
+        try:
+            if breaker is not None:
+                rebuilt = breaker.call(
+                    lambda: retry_call(
+                        attempt, rebuild_policy, describe=f"rebuild {base}"
+                    )
+                )
+            else:
+                rebuilt = retry_call(
+                    attempt, rebuild_policy, describe=f"rebuild {base}"
+                )
+            report.rebuilt = rebuilt
+            if on_rebuilt is not None and rebuilt:
+                on_rebuilt(rebuilt)
+        except CircuitOpenError as e:
+            report.refused = f"rebuild skipped: {e}"
+        except (RetryError, ECError) as e:
+            report.refused = f"rebuild failed: {e}"
+    return report
+
+
+class ScrubDaemon:
+    """Background scrub loop over a Store's mounted EC volumes.
+
+    Walks every EC volume each `interval`, slicing work by `max_blocks`
+    per volume per wakeup. Quarantine/rebuild events unmount and remount
+    the affected shard on the live EcVolume so reads degrade to
+    reconstruction (never a stale fd on a renamed file) and pick the
+    regenerated shard back up once it verifies.
+    """
+
+    def __init__(
+        self,
+        store,
+        interval: float = 3600.0,
+        bytes_per_sec: float = 64 << 20,
+        max_blocks_per_volume: int | None = None,
+        repair: bool = True,
+        breaker: CircuitBreaker | None = None,
+        backend=None,
+    ):
+        self.store = store
+        self.interval = interval
+        self.repair = repair
+        self.backend = backend
+        self.limiter = RateLimiter(bytes_per_sec)
+        self.max_blocks = max_blocks_per_volume
+        # One breaker PER VOLUME: a permanently-unrebuildable volume
+        # (e.g. a subset holder below k local files) must not starve
+        # every other volume's repair on this server. `breaker`, when
+        # given, is the template whose thresholds new ones copy.
+        self._breaker_template = breaker or CircuitBreaker(
+            failure_threshold=3, reset_timeout=300.0
+        )
+        self.breakers: dict[int, CircuitBreaker] = {}
+        self.reports: dict[int, ScrubReport] = {}  # vid -> last report
+        self.passes = 0
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="ec-scrub", daemon=True
+        )
+
+    # ------------------------------------------------------------ control
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)
+
+    def kick(self) -> None:
+        """Request an immediate pass (ops hook / tests)."""
+        self._wake.set()
+
+    def breaker_for(self, vid: int) -> CircuitBreaker:
+        b = self.breakers.get(vid)
+        if b is None:
+            t = self._breaker_template
+            b = CircuitBreaker(
+                failure_threshold=t.failure_threshold,
+                reset_timeout=t.reset_timeout,
+            )
+            self.breakers[vid] = b
+        return b
+
+    # -------------------------------------------------------------- loop
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.scrub_once()
+            except Exception as e:  # pragma: no cover - daemon must survive
+                log.error("scrub pass failed: %s", e)
+            self.passes += 1
+            self._wake.wait(self.interval)
+            self._wake.clear()
+
+    def scrub_once(self) -> dict[int, ScrubReport]:
+        """One pass over every mounted EC volume; returns vid->report."""
+        out: dict[int, ScrubReport] = {}
+        for loc in self.store.locations:
+            for vid, ev in list(loc.ec_volumes.items()):
+                if self._stop.is_set():
+                    return out
+                # This server's legitimate shard set = served + on-disk
+                # quarantined (EcVolume.legitimate_shards): a shard
+                # quarantined+unmounted last pass whose rebuild then
+                # failed stays on the repair list instead of vanishing
+                # from the mounted set and being reported healthy. An
+                # absent shard outside this set lives on a peer; a local
+                # rebuild of it would mint a duplicate copy the master
+                # never placed (and below k local files, fail every
+                # pass and wedge the shared breaker).
+                mounted = set(ev.legitimate_shards())
+                report = scrub_ec_volume(
+                    ev.base,
+                    ev.ctx,
+                    backend=self.backend,
+                    repair=self.repair,
+                    rate_limiter=self.limiter,
+                    max_blocks=self.max_blocks,
+                    breaker=self.breaker_for(vid),
+                    expected_shards=sorted(mounted),
+                    # Unmount BEFORE rebuild: the serving fd still points
+                    # at the renamed .bad inode and would happily serve
+                    # rot; degraded reads reconstruct meanwhile.
+                    on_quarantine=lambda sid, dest, ev=ev: ev.unmount_shards([sid]),
+                    # Remount only what this server served going in —
+                    # rebuild may have regenerated peers' shards too.
+                    on_rebuilt=lambda sids, ev=ev, m=mounted: ev.reopen_shards(
+                        [s for s in sids if s in m]
+                    ),
+                )
+                out[vid] = report
+                self.reports[vid] = report
+                if report.refused:
+                    log.warning("scrub vol %d refused: %s", vid, report.refused)
+                elif report.quarantined or report.rebuilt:
+                    log.warning(
+                        "scrub vol %d: quarantined=%s rebuilt=%s",
+                        vid, report.quarantined, report.rebuilt,
+                    )
+        return out

@@ -1,0 +1,58 @@
+"""Deterministic fault injection for durability testing.
+
+See registry.py for the model: named fault points in production code,
+seeded triggers + actions armed by tests, a single-bool no-op fast path
+when nothing is injected.
+"""
+
+from .registry import (
+    REGISTRY,
+    FaultHandle,
+    FaultRegistry,
+    InjectedCrash,
+    InjectedFault,
+    InjectedIOError,
+    active,
+    always,
+    bit_flip,
+    clear,
+    crash,
+    every,
+    fire,
+    hard_exit,
+    inject,
+    injected,
+    io_error,
+    latency,
+    mutate,
+    nth_call,
+    probability,
+    truncate,
+    zero_fill,
+)
+
+__all__ = [
+    "REGISTRY",
+    "FaultHandle",
+    "FaultRegistry",
+    "InjectedCrash",
+    "InjectedFault",
+    "InjectedIOError",
+    "active",
+    "always",
+    "bit_flip",
+    "clear",
+    "crash",
+    "every",
+    "fire",
+    "hard_exit",
+    "inject",
+    "injected",
+    "io_error",
+    "latency",
+    "mutate",
+    "nth_call",
+    "probability",
+    "truncate",
+    "zero_fill",
+]

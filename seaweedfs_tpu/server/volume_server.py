@@ -352,14 +352,44 @@ class VolumeService:
             ctx.data_shards,
             ctx.parity_shards,
         )
+        # Regenerate absent shards only within this server's legitimate
+        # set (mounted + quarantined) PLUS shards the master knows no
+        # location for (lost cluster-wide — ec.rebuild's restore-
+        # redundancy contract). A shard absent here but alive on a peer
+        # is excluded: minting a local copy would create a duplicate
+        # the master never placed. Present-but-corrupt shards are
+        # always replaced. An unmounted volume (offline repair) or an
+        # unreachable master keeps the unrestricted file-level behavior.
+        ev = self.store.find_ec_volume(request.volume_id)
+        only = None
+        if ev is not None:
+            try:
+                located = self.server._master_client().lookup_ec(
+                    request.volume_id, refresh=True
+                )
+                lost = {
+                    sid
+                    for sid in range(ctx.total)
+                    if not located.get(sid)
+                }
+            except Exception:
+                lost = set(range(ctx.total))  # no topology: old behavior
+            only = sorted(set(ev.legitimate_shards()) | lost)
         try:
             with M.request_seconds.time(server="volume", op="ec_rebuild"):
-                rebuilt = rebuild_ec_files(loc_base, backend=backend)
+                rebuilt = rebuild_ec_files(
+                    loc_base, backend=backend, only_shards=only
+                )
         except ECError as e:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
         M.ec_ops_total.inc(
             op="rebuild", backend=request.backend or self.server.store.ec_backend
         )
+        # swap a mounted volume's fds onto the regenerated inodes — the
+        # pre-rename fds still read the old (possibly corrupt) bytes
+        # (quarantined shards re-enter service here too)
+        if ev is not None and rebuilt:
+            ev.reopen_shards(rebuilt)
         return pb.EcShardsRebuildResponse(rebuilt_shard_ids=rebuilt)
 
     def VolumeEcShardsCopy(self, request, context):
@@ -439,6 +469,8 @@ class VolumeService:
         return pb.EcShardsUnmountResponse()
 
     def VolumeEcShardRead(self, request, context):
+        from .. import faults
+
         ev = self.store.find_ec_volume(request.volume_id)
         if ev is None:
             context.abort(grpc.StatusCode.NOT_FOUND, "ec volume not mounted")
@@ -448,15 +480,35 @@ class VolumeService:
         fd = ev.shard_fds.get(request.shard_id)
         if fd is None:
             context.abort(grpc.StatusCode.NOT_FOUND, "shard not local")
+        try:
+            # Named point for peer-read chaos: a raised IOError aborts
+            # the stream (client falls back to other peers/recovery); a
+            # mutate tears or corrupts the streamed bytes, which the
+            # CLIENT must catch (short-read check / needle CRC /
+            # sidecar-verified reconstruction) — never serve silently.
+            faults.fire(
+                "server.ec_shard_read",
+                volume=request.volume_id, shard=request.shard_id,
+            )
+        except IOError as e:
+            context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
         remaining = request.size
         off = request.offset
         while remaining > 0:
             chunk = os.pread(fd, min(_EC_STREAM_CHUNK, remaining), off)
             if not chunk:
                 break
-            yield pb.EcShardReadChunk(data=chunk)
-            off += len(chunk)
-            remaining -= len(chunk)
+            orig_len = len(chunk)
+            chunk = faults.mutate(
+                "server.ec_shard_read", chunk,
+                volume=request.volume_id, shard=request.shard_id, offset=off,
+            )
+            if chunk:
+                yield pb.EcShardReadChunk(data=chunk)
+            if len(chunk) < orig_len:
+                break  # torn stream: client sees a short read
+            off += orig_len
+            remaining -= orig_len
 
     def VolumeEcBlobDelete(self, request, context):
         # a mutation: on keyed clusters it needs the same peer token the
@@ -821,6 +873,8 @@ class VolumeServer:
         jwt_key: str = "",
         needle_map_kind: str = "memory",
         tls=None,
+        ec_scrub_interval: float = 0.0,
+        ec_scrub_bytes_per_sec: float = 64 << 20,
     ):
         self.jwt_key = jwt_key
         self.ip = ip
@@ -876,6 +930,19 @@ class VolumeServer:
         self._hb_queue: "queue.Queue[pb.Heartbeat]" = queue.Queue()
         self._hb_stop = threading.Event()
         self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
+
+        # Background EC scrub/self-heal loop (ec/scrub.py). Off by
+        # default (interval 0): enabling it is an operator decision —
+        # with it off there is zero new background I/O or behavior.
+        self.scrub_daemon = None
+        if ec_scrub_interval > 0:
+            from ..ec.scrub import ScrubDaemon
+
+            self.scrub_daemon = ScrubDaemon(
+                self.store,
+                interval=ec_scrub_interval,
+                bytes_per_sec=ec_scrub_bytes_per_sec,
+            )
 
     @staticmethod
     def _master_grpc(master: str) -> str:
@@ -1390,9 +1457,13 @@ class VolumeServer:
         self._grpc.start()
         self._http_thread.start()
         self._hb_thread.start()
+        if self.scrub_daemon is not None:
+            self.scrub_daemon.start()
 
     def stop(self) -> None:
         self._hb_stop.set()
+        if self.scrub_daemon is not None:
+            self.scrub_daemon.stop()
         if self.fastread_sockets:
             from ..utils.fastread import stop_server as _fr_stop
 

@@ -916,19 +916,40 @@ def volume_scrub(env: ShellEnv, args) -> str:
     return "\n".join(out)
 
 
-@command("ec.scrub", "-volumeId N [-collection c] (verify shards vs .ecsum)")
+@command(
+    "ec.scrub",
+    "-volumeId N [-collection c] [-repair] (verify shards vs .ecsum; "
+    "-repair rebuilds corrupt/missing shards on the holder)",
+)
 def ec_scrub(env: ShellEnv, args) -> str:
     p = argparse.ArgumentParser(prog="ec.scrub")
     p.add_argument("-volumeId", type=int, required=True)
     p.add_argument("-collection", default="")
+    p.add_argument("-repair", action="store_true")
     a = p.parse_args(args)
     shard_locs = env.master.lookup_ec(a.volumeId, refresh=True)
     if not shard_locs:
         return f"ec volume {a.volumeId} not found"
+    # k from the topology: a holder with fewer than k verified-good
+    # local shards cannot rebuild locally; skip the doomed RPC and point
+    # at ec.rebuild (which picks the biggest holder) instead
+    data_shards = 0
+    for n in env.master.topology().nodes:
+        for e in n.ec_shards:
+            if e.id == a.volumeId:
+                data_shards = e.data_shards
+    if not data_shards:
+        # topology gap (heartbeat lag): fall back to the default ratio
+        # so the guard stays conservative rather than vanishing
+        from ..ec.context import DATA_SHARDS
+
+        data_shards = DATA_SHARDS
     seen = {}
-    for locs in shard_locs.values():
+    holder_sids: dict[str, set] = {}
+    for sid, locs in shard_locs.items():
         for loc in locs:
             seen[loc.url] = loc
+            holder_sids.setdefault(loc.url, set()).add(sid)
     out = []
     for url, loc in sorted(seen.items()):
         ch, stub = _volume_stub(loc)
@@ -937,14 +958,46 @@ def ec_scrub(env: ShellEnv, args) -> str:
                 pb.ScrubRequest(volume_id=a.volumeId, collection=a.collection),
                 timeout=3600,
             )
-        if r.error:
-            out.append(f"{url}: error: {r.error}")
-        else:
+            if r.error:
+                out.append(f"{url}: error: {r.error}")
+                continue
             bad = list(r.bad_shards)
+            # shards the master lists on this holder but whose files the
+            # scrub did not find = deleted out from under the server
+            gone = r.checked < len(holder_sids.get(url, ()))
             out.append(
                 f"{url}: checked {r.checked} shards"
                 + (f", BITROT in shards {bad}" if bad else ", all clean")
+                + (
+                    f" ({len(holder_sids[url]) - r.checked} advertised "
+                    f"shard files MISSING)" if gone else ""
+                )
             )
+            if not (bad or gone) or not a.repair:
+                continue
+            if r.checked - len(bad) < data_shards:
+                out.append(
+                    f"{url}: repair skipped: {r.checked - len(bad)} "
+                    f"verified-good local shards < {data_shards} needed; "
+                    f"use ec.rebuild to rebuild on the biggest holder"
+                )
+                continue
+            # rebuild_ec_files' verify-and-exclude reclassifies the
+            # corrupt shards as missing and regenerates them (and any
+            # locally-lost mounted shards) from the verified-good
+            # remainder (fail-closed on its own)
+            try:
+                rr = stub.VolumeEcShardsRebuild(
+                    pb.EcShardsRebuildRequest(
+                        volume_id=a.volumeId, collection=a.collection
+                    ),
+                    timeout=3600,
+                )
+                out.append(
+                    f"{url}: rebuilt shards {sorted(rr.rebuilt_shard_ids)}"
+                )
+            except grpc.RpcError as e:
+                out.append(f"{url}: rebuild REFUSED: {e.details()}")
     return "\n".join(out)
 
 

@@ -18,6 +18,8 @@ from typing import BinaryIO
 
 import requests
 
+from .. import faults
+
 
 class BackendError(Exception):
     pass
@@ -48,8 +50,14 @@ class DiskFile(BackendStorageFile):
         self._f = open(path, "rb")
 
     def read_at(self, offset: int, size: int) -> bytes:
+        # Fault points: raised IOError / latency, then byte corruption
+        # (bit-flip, torn read) on the payload itself.
+        faults.fire("storage.disk.read_at", path=self.name, offset=offset, size=size)
         self._f.seek(offset)
-        return self._f.read(size)
+        data = self._f.read(size)
+        return faults.mutate(
+            "storage.disk.read_at", data, path=self.name, offset=offset, size=size
+        )
 
     def size(self) -> int:
         return os.fstat(self._f.fileno()).st_size
@@ -70,6 +78,7 @@ class S3RemoteFile(BackendStorageFile):
     def read_at(self, offset: int, size: int) -> bytes:
         if size <= 0:
             return b""
+        faults.fire("storage.remote.read_at", url=self.name, offset=offset, size=size)
         r = self._http.get(
             self.name,
             headers={"Range": f"bytes={offset}-{offset + size - 1}"},
@@ -84,6 +93,9 @@ class S3RemoteFile(BackendStorageFile):
         if r.status_code == 200:
             # endpoint ignored Range: slice locally
             data = data[offset : offset + size]
+        data = faults.mutate(
+            "storage.remote.read_at", data, url=self.name, offset=offset, size=size
+        )
         if len(data) < size:
             raise BackendError(
                 f"cold-tier short read {self.name}: {len(data)} < {size}"
@@ -138,6 +150,10 @@ class _SizedReader:
 
 def put_object(url: str, src: BinaryIO, size: int) -> None:
     """Streaming PUT of `size` bytes from `src` to an S3-style URL."""
+    # Torn-write model: a fault here kills the upload before any byte
+    # moves; mid-stream tears are injected by truncating _SizedReader's
+    # remaining budget so the endpoint sees a short body and rejects it.
+    faults.fire("storage.put_object", url=url, size=size)
     r = requests.put(url, data=_SizedReader(src, size), timeout=3600)
     if r.status_code >= 300:
         raise BackendError(
@@ -160,10 +176,15 @@ def fetch_object(url: str, dest_path: str) -> int:
                 )
             with open(tmp, "wb") as f:
                 for piece in r.iter_content(_CHUNK):
+                    piece = faults.mutate(
+                        "storage.fetch_object.chunk", piece, url=url, offset=n
+                    )
                     f.write(piece)
                     n += len(piece)
                 f.flush()
+                faults.fire("storage.fetch_object.before_fsync", url=url, path=dest_path)
                 os.fsync(f.fileno())
+        faults.fire("storage.fetch_object.before_rename", url=url, path=dest_path)
         os.replace(tmp, dest_path)
     except BaseException:
         # a failed stream must not leak a partial multi-GB temp

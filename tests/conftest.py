@@ -25,6 +25,28 @@ _force_virtual_cpu_mesh(8)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection lifecycle tests "
+        "(fixed-seed subset stays in tier-1; randomized soaks are slow)",
+    )
+    config.addinivalue_line(
+        "markers", "slow: long soak tests excluded from tier-1 (-m 'not slow')"
+    )
+
+
+@pytest.fixture(autouse=True)
+def _fault_registry_hygiene():
+    """A test that armed fault points must never leak them into the next
+    test — chaos determinism depends on a clean registry per test."""
+    yield
+    from seaweedfs_tpu import faults
+
+    if faults.active():
+        faults.clear()
+
+
 @pytest.fixture(scope="session")
 def rng():
     import numpy as np

@@ -1,0 +1,835 @@
+"""Chaos harness: encode → inject faults → scrub → rebuild → read
+lifecycles under seeded, deterministic fault schedules.
+
+Every lifecycle must end in exactly one of two states:
+  - bit-exact recovery (every payload reads back identical), or
+  - clean fail-closed refusal (ECError/CrcError/refused report).
+A read that RETURNS wrong bytes anywhere is a silent-corruption bug and
+fails the suite.
+
+The deterministic fixed-seed subset runs in tier-1; the wide randomized
+soak is marked slow. Crash-window tests (satellite: kill between
+temp-write / fsync / rename) fork a child that os._exit()s at the fault
+point — a faithful power-loss model where no cleanup handler runs.
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+import os
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu import faults
+from seaweedfs_tpu.ec import (
+    BitrotProtection,
+    CpuBackend,
+    ECContext,
+    ECError,
+    EcVolume,
+    FallbackBackend,
+    JaxBackend,
+    ShardChecksumBuilder,
+    ec_decode_volume,
+    ec_encode_volume,
+    rebuild_ec_files,
+    scrub_ec_volume,
+    write_ec_files,
+)
+from seaweedfs_tpu.ec.scrub import (
+    QUARANTINE_SUFFIX,
+    RateLimiter,
+    ScrubCursor,
+    ScrubDaemon,
+)
+from seaweedfs_tpu.storage.needle import CrcError, Needle
+from seaweedfs_tpu.storage.volume import Volume
+from seaweedfs_tpu.utils.retry import CircuitBreaker
+
+CTX = ECContext(10, 4)
+
+pytestmark = pytest.mark.chaos
+
+
+def make_volume(tmp_path, vid=1, needles=40, seed=0):
+    rng = np.random.default_rng(seed)
+    v = Volume(str(tmp_path), vid)
+    payloads = {}
+    for i in range(1, needles + 1):
+        size = int(rng.integers(1, 40_000))
+        data = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+        v.write_needle(Needle(cookie=0x1000 + i, needle_id=i, data=data))
+        payloads[i] = data
+    v.close()
+    return Volume.base_file_name(str(tmp_path), "", vid), payloads
+
+
+def synth_shards(tmp_path, ctx=CTX, shard_size=4 * 4096, block_size=4096, seed=0):
+    """RS-consistent shard files + multi-block .ecsum, no volume needed:
+    lets scrub walk several blocks per shard (the real sidecar block is
+    16 MiB — too big to exercise cursor/budget logic with real data)."""
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 256, (ctx.data_shards, shard_size), dtype=np.uint8)
+    parity = CpuBackend(ctx).encode(data)
+    shards = np.concatenate([data, parity], axis=0)
+    base = str(tmp_path / "1")
+    builders = [ShardChecksumBuilder(block_size) for _ in range(ctx.total)]
+    for i in range(ctx.total):
+        b = shards[i].tobytes()
+        with open(base + ctx.to_ext(i), "wb") as f:
+            f.write(b)
+        builders[i].write(b)
+    prot = BitrotProtection.from_builders(ctx, builders, generation=7)
+    prot.save(base + ".ecsum")
+    return base, shards
+
+
+def flip_byte(path: str, offset: int, mask: int = 0x01) -> None:
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ mask]))
+
+
+def read_all_or_refuse(tmp_path, payloads, vid=1) -> tuple[int, int]:
+    """Read every needle; returns (bit_exact, refused). Any wrong-bytes
+    return raises AssertionError — the zero-silent-corruption gate."""
+    ev = EcVolume(str(tmp_path), vid, backend_name="cpu")
+    exact = refused = 0
+    try:
+        for i, want in payloads.items():
+            try:
+                got = ev.read_needle(i, cookie=0x1000 + i).data
+            except (ECError, CrcError, OSError):
+                refused += 1
+                continue
+            assert got == want, f"SILENT CORRUPTION on needle {i}"
+            exact += 1
+    finally:
+        ev.close()
+    return exact, refused
+
+
+# ------------------------------------------------------- registry basics
+
+
+def test_disabled_registry_is_noop(tmp_path):
+    """Empty registry = no trigger evaluation, no behavior change."""
+    assert not faults.active()
+    faults.fire("some.point", x=1)  # must be a no-op, not a KeyError
+    assert faults.mutate("some.point", b"abc") == b"abc"
+
+    evaluated = []
+
+    def counting_trigger():
+        evaluated.append(1)
+        return False
+
+    h = faults.inject("some.point", faults.io_error(), when=counting_trigger)
+    assert faults.active()
+    h.remove()
+    assert not faults.active()
+    faults.fire("some.point")
+    assert evaluated == [], "disarmed registry must not evaluate triggers"
+
+    # encode byte-identity with the registry empty vs cleared-after-use
+    base, _ = make_volume(tmp_path, needles=8, seed=2)
+    write_ec_files(base, CTX, CpuBackend(CTX))
+    first = {i: open(base + CTX.to_ext(i), "rb").read() for i in range(CTX.total)}
+    with faults.injected("never.hit", faults.io_error()):
+        pass  # armed and removed: must leave zero residue
+    write_ec_files(base, CTX, CpuBackend(CTX))
+    for i in range(CTX.total):
+        assert open(base + CTX.to_ext(i), "rb").read() == first[i]
+
+
+def test_triggers_and_actions_deterministic():
+    fires = []
+    h = faults.inject(
+        "p", lambda ctx: fires.append(1), when=faults.nth_call(3)
+    )
+    for _ in range(6):
+        faults.fire("p")
+    assert len(fires) == 1 and h.fired == 1 and h.hits == 6
+    faults.clear()
+
+    # probability trigger replays identically from its seed
+    def run(seed):
+        out = []
+        h = faults.inject(
+            "q", lambda ctx: out.append(1), when=faults.probability(0.5, seed=seed)
+        )
+        for _ in range(32):
+            faults.fire("q")
+        faults.clear()
+        return h.fired
+
+    assert run(11) == run(11)
+
+    # bit_flip replays identically from its seed
+    a = faults.bit_flip(seed=3, flips=4)({}, b"\x00" * 64)
+    b = faults.bit_flip(seed=3, flips=4)({}, b"\x00" * 64)
+    assert a == b != b"\x00" * 64
+    assert faults.truncate(0.25)({}, b"x" * 100) == b"x" * 25
+    assert faults.zero_fill()({}, b"xyz") == b"\x00\x00\x00"
+
+
+def test_injected_io_error_is_an_io_error():
+    with pytest.raises(IOError):
+        with faults.injected("p", faults.io_error()):
+            faults.fire("p")
+    with pytest.raises(BaseException) as ei:
+        with faults.injected("p", faults.crash()):
+            faults.fire("p")
+    assert not isinstance(ei.value, Exception), "crash must evade except Exception"
+
+
+def test_every_and_count_caps():
+    seen = []
+    faults.inject("p", lambda ctx: seen.append(1), when=faults.every(2), count=2)
+    for _ in range(10):
+        faults.fire("p")
+    assert len(seen) == 2  # fires at calls 2 and 4, then capped
+    faults.clear()
+
+
+# ---------------------------------------------- seeded chaos lifecycles
+
+
+def _apply_schedule(base, rng) -> tuple[list[int], int]:
+    """Seeded fault schedule against on-disk shards: flips, torn
+    truncations, deletions. Returns (damaged shard ids, n_deleted)."""
+    n_damaged = int(rng.integers(1, CTX.parity_shards + 1))  # survivable
+    damaged = sorted(
+        int(x) for x in rng.choice(CTX.total, size=n_damaged, replace=False)
+    )
+    deleted = 0
+    for sid in damaged:
+        path = base + CTX.to_ext(sid)
+        size = os.path.getsize(path)
+        kind = int(rng.integers(0, 3))
+        if kind == 0:  # bit flip(s)
+            for _ in range(int(rng.integers(1, 4))):
+                flip_byte(path, int(rng.integers(0, size)), 1 << int(rng.integers(0, 8)))
+        elif kind == 1:  # torn write: truncate a suffix
+            with open(path, "r+b") as f:
+                f.truncate(int(rng.integers(0, size)))
+        else:  # lost shard
+            os.unlink(path)
+            deleted += 1
+    return damaged, deleted
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+def test_chaos_lifecycle_recovers_bit_exact(tmp_path, seed):
+    """encode → seeded damage (≤ parity shards) → scrub/self-heal →
+    read: every payload must come back bit-exact, shards byte-identical
+    to the originals."""
+    rng = np.random.default_rng(seed)
+    base, payloads = make_volume(tmp_path, needles=30, seed=seed)
+    ec_encode_volume(base, CTX)
+    originals = {
+        i: open(base + CTX.to_ext(i), "rb").read() for i in range(CTX.total)
+    }
+    damaged, _ = _apply_schedule(base, rng)
+
+    report = scrub_ec_volume(base, CTX, backend=CpuBackend(CTX), repair=True)
+    assert report.complete and not report.refused, report
+    assert sorted(
+        set(report.corrupt_shards) | set(report.missing_shards)
+    ) == damaged
+    assert sorted(report.rebuilt) == damaged
+    for dest in report.quarantined:
+        assert dest.endswith(QUARANTINE_SUFFIX) and os.path.exists(dest)
+
+    for i in range(CTX.total):
+        assert (
+            open(base + CTX.to_ext(i), "rb").read() == originals[i]
+        ), f"shard {i} not bit-exact after self-heal"
+    exact, refused = read_all_or_refuse(tmp_path, payloads)
+    assert refused == 0 and exact == len(payloads)
+
+    # the healed volume scrubs clean
+    clean = scrub_ec_volume(base, CTX, backend=CpuBackend(CTX))
+    assert clean.healthy, clean
+
+
+@pytest.mark.parametrize("seed", [6, 7])
+def test_chaos_lifecycle_beyond_parity_fails_closed(tmp_path, seed):
+    """Damage > parity shards: scrub must refuse wholesale quarantine
+    (sidecar-suspect rule) and reads must refuse rather than lie."""
+    rng = np.random.default_rng(seed)
+    base, payloads = make_volume(tmp_path, needles=10, seed=seed)
+    ec_encode_volume(base, CTX)
+    victims = sorted(
+        int(x) for x in rng.choice(CTX.total, size=CTX.parity_shards + 2, replace=False)
+    )
+    for sid in victims:
+        path = base + CTX.to_ext(sid)
+        flip_byte(path, int(rng.integers(0, os.path.getsize(path))))
+    report = scrub_ec_volume(base, CTX, backend=CpuBackend(CTX), repair=True)
+    assert report.refused and "suspect" in report.refused
+    assert not report.quarantined and not report.rebuilt
+    # reads: either bit-exact (undamaged extents) or refused — never wrong
+    read_all_or_refuse(tmp_path, payloads)
+
+
+@pytest.mark.slow
+def test_chaos_lifecycle_randomized_soak(tmp_path):
+    """Wide seed sweep of the same lifecycle (excluded from tier-1)."""
+    for seed in range(100, 140):
+        d = tmp_path / f"s{seed}"
+        d.mkdir()
+        rng = np.random.default_rng(seed)
+        base, payloads = make_volume(d, needles=12, seed=seed)
+        ec_encode_volume(base, CTX)
+        _apply_schedule(base, rng)
+        report = scrub_ec_volume(base, CTX, backend=CpuBackend(CTX), repair=True)
+        assert report.complete and not report.refused, (seed, report)
+        exact, refused = read_all_or_refuse(d, payloads)
+        assert refused == 0 and exact == len(payloads), seed
+
+
+# ------------------------------------------------ scrub daemon mechanics
+
+
+def test_scrub_budget_pause_and_cursor_resume(tmp_path):
+    base, shards = synth_shards(tmp_path)
+    flip_byte(base + CTX.to_ext(3), 9000)  # block 2 of shard 3
+    total_blocks = CTX.total * 4
+    reports = []
+    for _ in range(50):
+        r = scrub_ec_volume(
+            base, CTX, backend=CpuBackend(CTX), repair=True, max_blocks=5
+        )
+        reports.append(r)
+        if r.complete:
+            break
+    assert reports[-1].complete and not reports[-1].refused
+    assert [r.complete for r in reports[:-1]] == [False] * (len(reports) - 1)
+    assert not os.path.exists(base + ".scrubpos")  # cursor dropped on completion
+    assert reports[-1].rebuilt == [3]
+    with open(base + CTX.to_ext(3), "rb") as f:
+        assert f.read() == shards[3].tobytes()
+    # corruption found in an early slice survived the pauses
+    assert 3 in reports[-1].corrupt_shards
+    # budget actually sliced the walk: strictly more than one pass ran
+    assert len(reports) > 2
+    checked = sum(r.checked_blocks for r in reports)
+    assert checked <= total_blocks + 5
+
+
+def test_scrub_cursor_restarts_on_generation_change(tmp_path):
+    base, _ = synth_shards(tmp_path)
+    ScrubCursor(generation=999, shard=12, block=3, corrupt=[2]).save(base)
+    r = scrub_ec_volume(base, CTX, backend=CpuBackend(CTX))
+    # stale-generation cursor is discarded: full walk, no phantom corrupt
+    assert r.complete and r.checked_blocks == CTX.total * 4
+    assert r.corrupt_shards == []
+
+
+def test_scrub_refuses_without_sidecar(tmp_path):
+    base, _ = synth_shards(tmp_path)
+    os.unlink(base + ".ecsum")
+    r = scrub_ec_volume(base, CTX, backend=CpuBackend(CTX), repair=True)
+    assert r.refused and "sidecar" in r.refused
+
+
+def test_scrub_refuses_malformed_sidecar(tmp_path):
+    base, _ = synth_shards(tmp_path)
+    with open(base + ".ecsum", "r+b") as f:
+        f.seek(16)
+        f.write(b"\xde\xad\xbe\xef")
+    r = scrub_ec_volume(base, CTX, backend=CpuBackend(CTX), repair=True)
+    assert r.refused and "malformed" in r.refused
+    # the corrupt sidecar quarantined nothing
+    assert all(os.path.exists(base + CTX.to_ext(i)) for i in range(CTX.total))
+
+
+def test_scrub_below_rebuild_floor_refuses_quarantine(tmp_path):
+    """k-1 shards already gone + 1 corrupt: quarantining would drop the
+    set below reconstruction; scrub must keep its hands off."""
+    ctx = ECContext(4, 2)
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, (4, 4096), dtype=np.uint8)
+    parity = CpuBackend(ctx).encode(data)
+    shards = np.concatenate([data, parity])
+    base = str(tmp_path / "1")
+    builders = [ShardChecksumBuilder(1024) for _ in range(ctx.total)]
+    for i in range(ctx.total):
+        with open(base + ctx.to_ext(i), "wb") as f:
+            f.write(shards[i].tobytes())
+        builders[i].write(shards[i].tobytes())
+    BitrotProtection.from_builders(ctx, builders).save(base + ".ecsum")
+    for i in (0, 1, 2):
+        os.unlink(base + ctx.to_ext(i))
+    flip_byte(base + ctx.to_ext(3), 10)
+    r = scrub_ec_volume(base, ctx, backend=CpuBackend(ctx), repair=True)
+    assert r.refused and "floor" in r.refused
+    assert os.path.exists(base + ctx.to_ext(3))  # NOT quarantined
+
+
+def test_rate_limiter_paces_reads():
+    sleeps = []
+    t = [0.0]
+    rl = RateLimiter(
+        1000.0, burst=1000.0, clock=lambda: t[0], sleep=sleeps.append
+    )
+    rl.consume(1000)  # drains the burst, no sleep yet
+    assert sleeps == []
+    rl.consume(500)  # 500 tokens over: sleep 0.5s at 1000 B/s
+    assert sleeps == [pytest.approx(0.5)]
+    t[0] += 10.0  # bucket refills (capped at burst)
+    rl.consume(800)
+    assert len(sleeps) == 1  # within burst again
+
+
+def test_scrub_daemon_heals_store_volume(tmp_path):
+    from seaweedfs_tpu.storage.store import Store
+
+    d = tmp_path / "v"
+    d.mkdir()
+    base, payloads = make_volume(d, needles=10, seed=4)
+    ec_encode_volume(base, CTX)
+    store = Store([str(d)], ec_backend="cpu")
+    try:
+        ev = store.find_ec_volume(1)
+        assert ev is not None
+        flip_byte(base + CTX.to_ext(5), 777)
+        daemon = ScrubDaemon(store, interval=3600.0, repair=True)
+        reports = daemon.scrub_once()
+        assert reports[1].rebuilt == [5], reports[1]
+        assert os.path.exists(base + CTX.to_ext(5) + QUARANTINE_SUFFIX)
+        # the live EcVolume serves the regenerated shard (fresh fd), and
+        # every payload is bit-exact
+        assert 5 in ev.shard_ids
+        for i, want in payloads.items():
+            assert ev.read_needle(i).data == want
+        # second pass is clean
+        assert daemon.scrub_once()[1].healthy
+    finally:
+        store.close()
+
+
+def test_scrub_subset_holder_skips_peer_shards(tmp_path):
+    """A balanced-cluster server holding 5 of 14 shards: absent peer
+    shards are NOT 'missing', no rebuild storm, no duplicate minting —
+    and a rebuild for a local corrupt shard must not regenerate peers'
+    shards as local files (only_shards)."""
+    base, shards = synth_shards(tmp_path)
+    local = [0, 3, 5, 9, 12]
+    for i in range(CTX.total):
+        if i not in local:
+            os.unlink(base + CTX.to_ext(i))
+    r = scrub_ec_volume(
+        base, CTX, backend=CpuBackend(CTX), repair=True, expected_shards=local
+    )
+    assert r.complete and r.healthy, r
+    assert r.missing_shards == [] and r.rebuilt == []
+
+    # now the subset server loses one of ITS shards: only that one is
+    # rebuilt, peers' shards stay absent
+    full = tmp_path / "full"
+    full.mkdir()
+    base2, _ = synth_shards(full)
+    for i in (1, 2):
+        os.unlink(base2 + CTX.to_ext(i))  # peers' shards, absent here
+    os.unlink(base2 + CTX.to_ext(5))  # OUR shard, lost
+    mine = [i for i in range(CTX.total) if i not in (1, 2)]
+    r2 = scrub_ec_volume(
+        base2, CTX, backend=CpuBackend(CTX), repair=True, expected_shards=mine
+    )
+    assert r2.rebuilt == [5], r2
+    assert os.path.exists(base2 + CTX.to_ext(5))
+    assert not os.path.exists(base2 + CTX.to_ext(1))
+    assert not os.path.exists(base2 + CTX.to_ext(2))
+
+
+def test_scrub_daemon_remembers_quarantined_shard_after_failed_rebuild(tmp_path):
+    """Quarantine unmounts the shard; if the rebuild then fails, the
+    NEXT pass must still treat it as missing (via the on-disk .bad
+    marker) instead of reporting healthy with redundancy silently lost."""
+    from seaweedfs_tpu.storage.store import Store
+
+    d = tmp_path / "v"
+    d.mkdir()
+    base, payloads = make_volume(d, needles=8, seed=20)
+    ec_encode_volume(base, CTX)
+    store = Store([str(d)], ec_backend="cpu")
+    try:
+        ev = store.find_ec_volume(1)
+        flip_byte(base + CTX.to_ext(6), 123)
+        daemon = ScrubDaemon(store, interval=3600.0, repair=True)
+        # wedge vol 1's breaker: pass 1 quarantines but cannot rebuild
+        b = daemon.breaker_for(1)
+        for _ in range(b.failure_threshold):
+            b.record_failure()
+        r1 = daemon.scrub_once()[1]
+        assert r1.quarantined and not r1.rebuilt and "skipped" in r1.refused, r1
+        assert 6 not in ev.shard_ids  # unmounted, serving degraded
+        # pass 2 with the breaker healed: the shard is NOT forgotten
+        b.record_success()
+        r2 = daemon.scrub_once()[1]
+        assert r2.missing_shards == [6] and r2.rebuilt == [6], r2
+        assert 6 in ev.shard_ids  # remounted
+        for i, want in payloads.items():
+            assert ev.read_needle(i).data == want
+    finally:
+        store.close()
+
+
+def test_scrub_daemon_breaker_stops_rebuild_storm(tmp_path):
+    """Rebuild impossible (too few shards): the breaker opens after
+    repeated failures and later passes skip the rebuild attempt."""
+    base, _ = synth_shards(tmp_path)
+    for i in range(CTX.parity_shards + 1):
+        os.unlink(base + CTX.to_ext(i))  # 9 shards left < k=10: rebuild must fail
+    breaker = CircuitBreaker(failure_threshold=1, reset_timeout=9999.0)
+    r1 = scrub_ec_volume(base, CTX, backend=CpuBackend(CTX), repair=True, breaker=breaker)
+    assert r1.refused.startswith("rebuild failed")
+    assert breaker.state == "open"
+    r2 = scrub_ec_volume(base, CTX, backend=CpuBackend(CTX), repair=True, breaker=breaker)
+    assert r2.refused.startswith("rebuild skipped")
+
+
+# -------------------------------------------- crash-window (satellite 3)
+
+
+def _crashing_child(base, point, nth, conn):
+    """Runs rebuild with a hard-exit fault armed; never returns."""
+    faults.inject(point, faults.hard_exit(137), when=faults.nth_call(nth))
+    try:
+        rebuild_ec_files(base, CTX, backend=CpuBackend(CTX))
+    except BaseException as e:  # pragma: no cover - only on fault miss
+        conn.send(repr(e))
+    conn.send("no crash")
+
+
+def _run_crash(base, point, nth=1):
+    mp = multiprocessing.get_context("fork")
+    parent, child = mp.Pipe()
+    p = mp.Process(target=_crashing_child, args=(base, point, nth, child))
+    p.start()
+    p.join(timeout=120)
+    assert not p.is_alive(), "crash child hung"
+    assert p.exitcode == 137, f"expected hard crash, got {p.exitcode}"
+    assert not parent.poll(), "child survived past the crash point"
+
+
+@pytest.mark.parametrize(
+    "point",
+    ["ec.rebuild.before_fsync", "ec.rebuild.before_rename", "ec.rebuild.after_rename"],
+)
+def test_rebuild_crash_window_then_recover(tmp_path, point):
+    """Kill the rebuild between temp-write, fsync and each atomic
+    rename; a restarted rebuild must converge to bit-exact shards."""
+    base, payloads = make_volume(tmp_path, needles=15, seed=8)
+    ec_encode_volume(base, CTX)
+    originals = {
+        i: open(base + CTX.to_ext(i), "rb").read() for i in range(CTX.total)
+    }
+    for sid in (2, 11):
+        os.unlink(base + CTX.to_ext(sid))
+
+    _run_crash(base, point)
+
+    # crash left either nothing, temps, or a partial publish — never a
+    # wrong published shard
+    for sid in (2, 11):
+        p = base + CTX.to_ext(sid)
+        if os.path.exists(p):
+            assert open(p, "rb").read() == originals[sid]
+
+    # restart heals to bit-exact
+    rebuilt = rebuild_ec_files(base, CTX, backend=CpuBackend(CTX))
+    if point == "ec.rebuild.after_rename":
+        # first rename may have landed before the crash
+        assert set(rebuilt) <= {2, 11}
+    else:
+        assert rebuilt == [2, 11]
+    for i in range(CTX.total):
+        assert open(base + CTX.to_ext(i), "rb").read() == originals[i]
+    exact, refused = read_all_or_refuse(tmp_path, payloads)
+    assert refused == 0 and exact == len(payloads)
+
+
+def _crashing_decode_child(base, point):
+    faults.inject(point, faults.hard_exit(137))
+    ec_decode_volume(base)
+
+
+@pytest.mark.parametrize(
+    "point",
+    [
+        "ec.decode.idx.before_rename",
+        "ec.decode.dat.before_fsync",
+        "ec.decode.dat.before_rename",
+    ],
+)
+def test_decode_crash_window_then_recover(tmp_path, point):
+    base, payloads = make_volume(tmp_path, needles=12, seed=9)
+    with open(base + ".dat", "rb") as f:
+        original_dat = f.read()
+    ec_encode_volume(base, CTX)
+    os.unlink(base + ".dat")
+    os.unlink(base + ".idx")
+
+    mp = multiprocessing.get_context("fork")
+    p = mp.Process(target=_crashing_decode_child, args=(base, point))
+    p.start()
+    p.join(timeout=120)
+    assert p.exitcode == 137, f"expected hard crash, got {p.exitcode}"
+    # the published .dat either does not exist yet or is complete —
+    # atomic rename means never a half-written one
+    if os.path.exists(base + ".dat"):
+        assert open(base + ".dat", "rb").read() == original_dat
+
+    assert ec_decode_volume(base) is True
+    assert open(base + ".dat", "rb").read() == original_dat
+    v = Volume(str(tmp_path), 1, create=False)
+    for i, want in payloads.items():
+        assert v.read_needle(i).data == want
+    v.close()
+
+
+def test_encode_crash_before_ecsum_scrub_refuses_reencode_heals(tmp_path):
+    """In-process InjectedCrash between shard publish and sidecar write:
+    shards exist with no .ecsum — reads work, scrub refuses (no ground
+    truth), re-encode writes the sidecar and heals the volume."""
+    base, payloads = make_volume(tmp_path, needles=10, seed=10)
+    with faults.injected("ec.encode.before_ecsum", faults.crash()):
+        with pytest.raises(BaseException) as ei:
+            ec_encode_volume(base, CTX)
+        assert isinstance(ei.value, faults.InjectedCrash)
+    assert os.path.exists(base + CTX.to_ext(0))
+    assert not os.path.exists(base + ".ecsum")
+    r = scrub_ec_volume(base, CTX, backend=CpuBackend(CTX), repair=True)
+    assert r.refused and "sidecar" in r.refused
+    ec_encode_volume(base, CTX)  # heal
+    assert os.path.exists(base + ".ecsum")
+    assert scrub_ec_volume(base, CTX, backend=CpuBackend(CTX)).healthy
+    exact, refused = read_all_or_refuse(tmp_path, payloads)
+    assert refused == 0 and exact == len(payloads)
+
+
+# --------------------------- rebuild fed corrupt inputs must fail closed
+
+
+def test_rebuild_with_corrupt_sibling_read_fails_closed(tmp_path):
+    """Bit-flip a sibling read DURING rebuild (post-sidecar-verify TOCTOU
+    rot): the regenerated shard fails output verification and nothing is
+    published."""
+    base, _ = make_volume(tmp_path, needles=15, seed=11)
+    ec_encode_volume(base, CTX)
+    os.unlink(base + CTX.to_ext(1))
+    with faults.injected(
+        "ec.rebuild.read_shard", faults.bit_flip(seed=5), when=faults.nth_call(3)
+    ):
+        with pytest.raises(ECError, match="sidecar verification"):
+            rebuild_ec_files(base, CTX, backend=CpuBackend(CTX))
+    assert not os.path.exists(base + CTX.to_ext(1)), "corrupt shard published!"
+    assert not os.path.exists(base + CTX.to_ext(1) + ".rebuilding"), "temp leaked"
+    # clean retry succeeds
+    assert rebuild_ec_files(base, CTX, backend=CpuBackend(CTX)) == [1]
+
+
+def test_rebuild_with_corrupt_output_fails_closed(tmp_path):
+    base, _ = make_volume(tmp_path, needles=15, seed=12)
+    ec_encode_volume(base, CTX)
+    os.unlink(base + CTX.to_ext(13))
+    with faults.injected("ec.rebuild.shard_bytes", faults.bit_flip(seed=6)):
+        with pytest.raises(ECError, match="sidecar verification"):
+            rebuild_ec_files(base, CTX, backend=CpuBackend(CTX))
+    assert not os.path.exists(base + CTX.to_ext(13))
+
+
+# ------------------------------------ device-failure fallback (tentpole)
+
+
+def _fallback_backend():
+    return FallbackBackend(
+        JaxBackend(CTX, impl="xla", n_devices=1),
+        CpuBackend(CTX),
+        breaker=CircuitBreaker(failure_threshold=3, reset_timeout=9999.0),
+    )
+
+
+def test_jax_midbatch_failure_falls_back_bit_identical(tmp_path):
+    base, _ = make_volume(tmp_path, needles=25, seed=13)
+    write_ec_files(base, CTX, CpuBackend(CTX), batch_size=100_000)
+    want = {i: open(base + CTX.to_ext(i), "rb").read() for i in range(CTX.total)}
+
+    fb = _fallback_backend()
+    with faults.injected(
+        "ec.backend.device.to_host", faults.io_error("device lost"),
+        when=faults.nth_call(2), count=1,
+    ):
+        write_ec_files(base, CTX, fb, batch_size=100_000)
+    assert fb.fallback_batches >= 1, "fallback path never engaged"
+    for i in range(CTX.total):
+        assert open(base + CTX.to_ext(i), "rb").read() == want[i], (
+            f"shard {i} differs after mid-batch CPU failover"
+        )
+
+
+def test_fallback_breaker_opens_and_cpu_serves(tmp_path):
+    base, _ = make_volume(tmp_path, needles=20, seed=14)
+    write_ec_files(base, CTX, CpuBackend(CTX), batch_size=100_000)
+    want = {i: open(base + CTX.to_ext(i), "rb").read() for i in range(CTX.total)}
+
+    fb = _fallback_backend()
+    with faults.injected(
+        "ec.backend.device.encode_staged", faults.io_error("device dead")
+    ):
+        write_ec_files(base, CTX, fb, batch_size=100_000)
+    assert fb.breaker.state == "open"
+    assert fb.fallback_batches >= 3
+    for i in range(CTX.total):
+        assert open(base + CTX.to_ext(i), "rb").read() == want[i]
+    # device recovery: breaker half-open probe succeeds and closes it
+    fb.breaker.reset_timeout = 0.0
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, (CTX.data_shards, 1024), dtype=np.uint8)
+    assert np.array_equal(fb.encode(data), CpuBackend(CTX).encode(data))
+    assert fb.breaker.state == "closed"
+
+
+def test_fallback_caller_errors_pass_through_without_demotion():
+    """Bad input fails identically on CPU: it must re-raise, not count
+    as a device failure (a healthy TPU must not be demoted by typos)."""
+    fb = _fallback_backend()
+    with pytest.raises((ECError, ValueError, TypeError)):
+        fb.reconstruct({0: np.zeros(8, np.uint8)})  # < k shards
+    assert fb.breaker.state == "closed" and fb.fallback_batches == 0
+
+
+def test_injected_crash_not_absorbed_by_fallback():
+    fb = _fallback_backend()
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, (CTX.data_shards, 512), dtype=np.uint8)
+    with faults.injected("ec.backend.device.encode", faults.crash()):
+        with pytest.raises(faults.InjectedCrash):
+            fb.encode(data)
+
+
+# ----------------------- degraded reads verified against the sidecar
+
+
+def test_degraded_read_excludes_rotten_sibling_and_heals(tmp_path):
+    """Missing shard + a silently-rotten sibling: the sidecar identifies
+    the rotten source, reconstruction uses the clean k, and every read
+    is bit-exact (satellite: backend.reconstruct inputs/outputs were
+    previously trusted unverified)."""
+    base, payloads = make_volume(tmp_path, needles=20, seed=15)
+    ec_encode_volume(base, CTX)
+    os.unlink(base + CTX.to_ext(0))
+    # rot a sibling data shard ON DISK (sidecar knows the truth, the
+    # serving fd does not)
+    path = base + CTX.to_ext(1)
+    for off in range(0, os.path.getsize(path), 997):
+        flip_byte(path, off)
+    exact, refused = read_all_or_refuse(tmp_path, payloads)
+    assert refused == 0 and exact == len(payloads), (
+        "verified recovery should exclude the rotten source and heal"
+    )
+
+
+def test_degraded_read_refuses_below_k_clean_sources(tmp_path):
+    """Missing shard + enough rotten siblings that fewer than k clean
+    sources exist: reads refuse (ECError), never serve garbage."""
+    base, payloads = make_volume(tmp_path, needles=12, seed=19)
+    ec_encode_volume(base, CTX)
+    os.unlink(base + CTX.to_ext(0))
+    for sid in (1, 2, 3, 4, 5):  # 8 clean siblings remain < k=10
+        path = base + CTX.to_ext(sid)
+        for off in range(0, os.path.getsize(path), 991):
+            flip_byte(path, off)
+    read_all_or_refuse(tmp_path, payloads)  # the no-silent-corruption gate
+    ev = EcVolume(str(tmp_path), 1, backend_name="cpu")
+    try:
+        with pytest.raises((ECError, CrcError)):
+            for i in payloads:
+                ev.read_needle(i)
+    finally:
+        ev.close()
+
+
+def test_local_bitflip_self_heals_on_read(tmp_path):
+    """A bit-flipped LOCAL shard read (fault point, disk rot model)
+    trips the needle CRC and the read retries via sidecar-verified
+    reconstruction — the client still gets bit-exact bytes."""
+    base, payloads = make_volume(tmp_path, needles=6, seed=16)
+    ec_encode_volume(base, CTX)
+    ev = EcVolume(str(tmp_path), 1, backend_name="cpu")
+    try:
+        with faults.injected(
+            "ec.volume.shard_read", faults.bit_flip(seed=9), count=1
+        ):
+            for i, want in payloads.items():
+                assert ev.read_needle(i).data == want
+    finally:
+        ev.close()
+
+
+def test_local_io_error_degrades_to_reconstruction(tmp_path):
+    base, payloads = make_volume(tmp_path, needles=6, seed=17)
+    ec_encode_volume(base, CTX)
+    ev = EcVolume(str(tmp_path), 1, backend_name="cpu")
+    try:
+        with faults.injected("ec.volume.shard_read", faults.io_error()):
+            for i, want in payloads.items():
+                assert ev.read_needle(i).data == want
+    finally:
+        ev.close()
+
+
+def test_corrupting_remote_reader_never_serves_rot(tmp_path):
+    """A peer streaming corrupted shard bytes (server.ec_shard_read
+    bit-flip model, exercised here via the remote_reader seam): needle
+    CRC catches it and verified local reconstruction serves truth."""
+    base, payloads = make_volume(tmp_path, needles=6, seed=18)
+    ec_encode_volume(base, CTX)
+    corruptor = faults.bit_flip(seed=4)
+
+    ev = EcVolume(str(tmp_path), 1, backend_name="cpu")
+    try:
+        victim = sorted(ev.shard_ids)[0]
+        orig_fd = ev.shard_fds.pop(victim)  # shard "not local" anymore
+        os.close(orig_fd)
+
+        def evil_remote(shard_id, offset, size, generation):
+            with open(base + CTX.to_ext(shard_id), "rb") as f:
+                f.seek(offset)
+                return corruptor({}, f.read(size))
+
+        ev.remote_reader = evil_remote
+        for i, want in payloads.items():
+            assert ev.read_needle(i).data == want
+    finally:
+        ev.close()
+
+
+# ------------------------------------------- storage backend fault seams
+
+
+def test_disk_file_read_faults(tmp_path):
+    from seaweedfs_tpu.storage.backend import DiskFile
+
+    p = str(tmp_path / "f")
+    with open(p, "wb") as f:
+        f.write(b"0123456789")
+    df = DiskFile(p)
+    try:
+        assert df.read_at(2, 4) == b"2345"
+        with faults.injected("storage.disk.read_at", faults.io_error()):
+            with pytest.raises(IOError):
+                df.read_at(0, 4)
+        with faults.injected("storage.disk.read_at", faults.truncate(0.5)):
+            assert df.read_at(0, 8) == b"0123"  # torn read
+        with faults.injected("storage.disk.read_at", faults.bit_flip(seed=1)):
+            assert df.read_at(0, 4) != b"0123"
+        assert df.read_at(0, 4) == b"0123"  # registry cleared by ctx mgr
+    finally:
+        df.close()

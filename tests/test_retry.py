@@ -1,0 +1,252 @@
+"""Unified retry policy + circuit breaker (utils/retry.py): the one
+backoff implementation every hand-rolled loop migrated onto."""
+
+from __future__ import annotations
+
+import random
+
+import grpc
+import pytest
+
+from seaweedfs_tpu.utils.retry import (
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryError,
+    RetryPolicy,
+    retry_call,
+)
+
+
+class Flaky:
+    def __init__(self, fail_times: int, exc: Exception | None = None):
+        self.calls = 0
+        self.fail_times = fail_times
+        self.exc = exc or ValueError("boom")
+
+    def __call__(self):
+        self.calls += 1
+        if self.calls <= self.fail_times:
+            raise self.exc
+        return "ok"
+
+
+def test_policy_rejects_zero_attempts():
+    with pytest.raises(ValueError, match="max_attempts"):
+        RetryPolicy(max_attempts=0)
+
+
+def test_policy_delay_schedule_no_jitter():
+    p = RetryPolicy(base_delay=0.1, multiplier=2.0, max_delay=0.5, jitter=0.0)
+    assert [p.delay(a) for a in (1, 2, 3, 4, 5)] == [0.1, 0.2, 0.4, 0.5, 0.5]
+
+
+def test_policy_jitter_bounded_and_seeded():
+    p = RetryPolicy(base_delay=1.0, multiplier=1.0, jitter=0.25)
+    rng = random.Random(42)
+    ds = [p.delay(1, rng) for _ in range(100)]
+    assert all(0.75 <= d <= 1.25 for d in ds)
+    assert [p.delay(1, random.Random(7)) for _ in range(5)] == [
+        p.delay(1, random.Random(7)) for _ in range(5)
+    ]
+
+
+def test_retry_succeeds_after_transient_failures():
+    sleeps: list[float] = []
+    fn = Flaky(2)
+    out = retry_call(
+        fn, RetryPolicy(max_attempts=4, base_delay=0.1, jitter=0.0),
+        sleep=sleeps.append,
+    )
+    assert out == "ok" and fn.calls == 3
+    assert sleeps == [0.1, 0.2]
+
+
+def test_retry_exhaustion_wraps_cause():
+    fn = Flaky(10)
+    with pytest.raises(RetryError) as ei:
+        retry_call(
+            fn, RetryPolicy(max_attempts=3, base_delay=0.0, jitter=0.0),
+            sleep=lambda d: None,
+        )
+    assert ei.value.attempts == 3 and fn.calls == 3
+    assert isinstance(ei.value.__cause__, ValueError)
+
+
+def test_non_retryable_propagates_immediately():
+    fn = Flaky(5, exc=KeyError("nope"))
+    with pytest.raises(KeyError):
+        retry_call(
+            fn, RetryPolicy(max_attempts=5, retry_on=(ValueError,)),
+            sleep=lambda d: None,
+        )
+    assert fn.calls == 1
+
+
+def test_on_retry_hook_runs_between_attempts():
+    seen: list[tuple[str, int]] = []
+    fn = Flaky(2)
+    retry_call(
+        fn, RetryPolicy(max_attempts=4, base_delay=0.0, jitter=0.0),
+        on_retry=lambda e, a: seen.append((type(e).__name__, a)),
+        sleep=lambda d: None,
+    )
+    assert seen == [("ValueError", 1), ("ValueError", 2)]
+
+
+def test_deadline_cuts_retries_short():
+    t = [0.0]
+
+    def clock():
+        return t[0]
+
+    def sleep(d):
+        t[0] += d
+
+    fn = Flaky(100)
+    with pytest.raises(RetryError) as ei:
+        retry_call(
+            fn,
+            RetryPolicy(
+                max_attempts=100, base_delay=1.0, multiplier=1.0, jitter=0.0,
+                deadline=2.5,
+            ),
+            sleep=sleep, clock=clock,
+        )
+    # attempts at t=0,1,2, then the backoff is CLAMPED to land a final
+    # attempt exactly at the 2.5s deadline — the full budget is used
+    assert fn.calls == 4
+    assert ei.value.elapsed == pytest.approx(2.5)
+
+
+def test_deadline_final_attempt_can_win():
+    """A resource freed just before the deadline is still acquired."""
+    t = [0.0]
+
+    def clock():
+        return t[0]
+
+    def sleep(d):
+        t[0] += d
+
+    def fn():
+        if t[0] < 1.9:
+            raise ValueError("held")
+        return "acquired"
+
+    out = retry_call(
+        fn,
+        RetryPolicy(max_attempts=50, base_delay=1.0, multiplier=1.0,
+                    jitter=0.0, deadline=2.0),
+        sleep=sleep, clock=clock,
+    )
+    assert out == "acquired" and t[0] == pytest.approx(2.0)
+
+
+def test_breaker_opens_after_threshold_and_half_open_probe():
+    t = [0.0]
+    b = CircuitBreaker(failure_threshold=3, reset_timeout=10.0, clock=lambda: t[0])
+    assert b.state == "closed" and b.allows()
+    for _ in range(3):
+        b.record_failure()
+    assert b.state == "open" and not b.allows()
+    t[0] += 10.0
+    assert b.state == "half-open"
+    assert b.allows()  # the single probe
+    assert not b.allows()  # second caller rejected during the probe
+    b.record_success()
+    assert b.state == "closed" and b.allows()
+
+
+def test_breaker_probe_failure_reopens():
+    t = [0.0]
+    b = CircuitBreaker(failure_threshold=1, reset_timeout=5.0, clock=lambda: t[0])
+    b.record_failure()
+    assert b.state == "open"
+    t[0] += 5.0
+    assert b.allows()
+    b.record_failure()  # probe failed
+    assert b.state == "open" and not b.allows()
+    t[0] += 4.9
+    assert not b.allows()
+
+
+def test_breaker_abandoned_probe_does_not_wedge():
+    """A caller that took the half-open probe slot and died (never
+    recorded an outcome) must not lock the breaker half-open forever."""
+    t = [0.0]
+    b = CircuitBreaker(failure_threshold=1, reset_timeout=5.0, clock=lambda: t[0])
+    b.record_failure()
+    t[0] += 5.0
+    assert b.allows()  # probe taken...
+    # ...and abandoned: no record_success/record_failure ever runs
+    assert not b.allows()
+    t[0] += 5.0  # a further reset window reopens the probe slot
+    assert b.allows()
+    b.record_success()
+    assert b.state == "closed"
+
+
+def test_breaker_call_wrapper():
+    b = CircuitBreaker(failure_threshold=1, reset_timeout=999.0)
+    with pytest.raises(ValueError):
+        b.call(Flaky(5))
+    with pytest.raises(CircuitOpenError):
+        b.call(lambda: "never runs")
+
+
+def test_master_client_with_leader_rides_unified_policy(monkeypatch):
+    """_with_leader migrated onto retry_call: NotLeaderError triggers the
+    hint-following recovery, transport errors re-resolve, and the caller
+    still sees the underlying error class on exhaustion."""
+    from seaweedfs_tpu.client.master_client import MasterClient, NotLeaderError
+
+    mc = MasterClient("localhost:1", keepconnected=False)
+    monkeypatch.setattr(
+        "seaweedfs_tpu.utils.retry.time.sleep", lambda d: None
+    )
+    hints: list[str] = []
+    monkeypatch.setattr(mc, "_note_leader_hint", lambda e: hints.append(e))
+    monkeypatch.setattr(mc, "_resolve_leader", lambda skip=None: "localhost:1")
+    monkeypatch.setattr(mc, "_leader_stub", lambda: object())
+
+    calls = [0]
+
+    def flaky(stub):
+        calls[0] += 1
+        if calls[0] < 3:
+            raise NotLeaderError("not leader; leader=localhost:2")
+        return "answer"
+
+    assert mc._with_leader(flaky) == "answer"
+    assert calls[0] == 3 and len(hints) == 2
+
+    def always_not_leader(stub):
+        raise NotLeaderError("not leader")
+
+    with pytest.raises(NotLeaderError):  # not RetryError: class preserved
+        mc._with_leader(always_not_leader)
+    mc.close()
+
+
+def test_master_client_lock_wait_deadline(monkeypatch):
+    """lock(wait=...) polls a held lock under the policy and raises
+    LockHeldError (not RetryError) at the deadline."""
+    from seaweedfs_tpu.client.master_client import LockHeldError, MasterClient
+
+    mc = MasterClient("localhost:1", keepconnected=False)
+
+    class Resp:
+        ok = False
+        holder = "someone"
+        error = ""
+        token = ""
+
+    monkeypatch.setattr(mc, "_with_leader", lambda call: Resp())
+    monkeypatch.setattr(
+        "seaweedfs_tpu.utils.retry.time.sleep", lambda d: None
+    )
+    with pytest.raises(LockHeldError):
+        mc.lock("job", owner="me", wait=0.3)
+    with pytest.raises(LockHeldError):
+        mc.lock("job", owner="me", wait=0.0)  # immediate, no polling
+    mc.close()
