@@ -5,9 +5,15 @@ Encode/rebuild/decode/read with pluggable CPU (C++ SIMD) and TPU
 """
 
 from .backend import CpuBackend, FallbackBackend, JaxBackend, get_backend
-from .bitrot import BitrotError, BitrotProtection, ShardChecksumBuilder
+from .bitrot import (
+    BitrotError,
+    BitrotProtection,
+    ShardChecksumBuilder,
+    fold_leaf_crcs,
+)
 from .context import (
     BITROT_BLOCK_SIZE,
+    BITROT_LEAF_SIZE,
     DATA_SHARDS,
     DEFAULT_EC_CONTEXT,
     LARGE_BLOCK_SIZE,
@@ -29,6 +35,7 @@ from .decoder import (
 from .ec_volume import EcCookieMismatch, EcNotFoundError, EcVolume
 from .encoder import ec_encode_volume, write_ec_files, write_sorted_file_from_idx
 from .locate import Interval, locate_data
+from .pipeline import FusedShardSink, PyShardSink, make_shard_sink, run_pipeline
 from .rebuild import rebuild_ec_files
 from .scrub import (
     QUARANTINE_SUFFIX,

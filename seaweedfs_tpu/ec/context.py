@@ -19,6 +19,13 @@ SMALL_BLOCK_SIZE = 1024 * 1024  # 1MB
 # Bitrot sidecar granularity (reference ec_bitrot.go BitrotBlockSize).
 BITROT_BLOCK_SIZE = 16 * 1024 * 1024  # 16 MiB
 
+# Sub-block leaf granularity for the v2 .ecsum sidecar: degraded reads
+# verify and reconstruct only the leaves covering the requested extent,
+# cutting the verified-degraded-read amplification by up to
+# BITROT_BLOCK_SIZE / BITROT_LEAF_SIZE (256x at the defaults). 0
+# disables leaves (writes a v1 sidecar).
+BITROT_LEAF_SIZE = 64 * 1024  # 64 KiB
+
 # Quarantined shard suffix: scrub renames corrupt shards to
 # <shard>.bad so they can never be fed to Reed-Solomon (kept for
 # forensics until a verified replacement lands).

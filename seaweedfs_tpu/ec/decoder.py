@@ -182,10 +182,11 @@ def write_dat_file(
             )
 
         large_rows = encoded_dat_file_size // (k * large_block_size)
-        with open(tmp, "wb") as out:
+
+        def read_plan():
+            """(fd, offset, length) pieces in .dat order: large rows,
+            then small rows; within a row, shard order."""
             remaining = dat_file_size
-            shard_off = 0
-            # Large rows, then small rows; within a row, shard order.
             row = 0
             while remaining > 0:
                 if row < large_rows:
@@ -202,13 +203,37 @@ def write_dat_file(
                     take = min(remaining, block)
                     pos = 0
                     while pos < take:
-                        got = os.pread(fd, min(1 << 20, take - pos), off + pos)
-                        if not got:
-                            raise ECError(f"short shard read at {off + pos}")
-                        out.write(got)
-                        pos += len(got)
+                        piece = min(4 << 20, take - pos)
+                        yield fd, off + pos, piece
+                        pos += piece
                     remaining -= take
                 row += 1
+
+        with open(tmp, "wb") as out:
+            # Shared recovery pipeline (ec/pipeline.py): shard preads in
+            # the reader thread overlap the sequential .dat writes in
+            # the writer thread — the serial read→write loop left the
+            # output disk idle during every input read.
+            from .pipeline import run_pipeline
+
+            def produce():
+                for fd, off, want in read_plan():
+                    parts = []
+                    pos = 0
+                    while pos < want:  # regular files may short-read at EOF
+                        got = os.pread(fd, want - pos, off + pos)
+                        if not got:
+                            raise ECError(f"short shard read at {off + pos}")
+                        parts.append(got)
+                        pos += len(got)
+                    yield parts[0] if len(parts) == 1 else b"".join(parts)
+
+            run_pipeline(
+                produce,
+                lambda chunk: chunk,
+                out.write,
+                describe="ec decode pipeline",
+            )
             out.flush()
             faults.fire("ec.decode.dat.before_fsync", base=base)
             os.fsync(out.fileno())

@@ -24,15 +24,16 @@ import numpy as np
 from .. import faults
 from ..storage.needle_map import MemDb
 from .backend import RSBackend, get_backend
-from .bitrot import BitrotProtection, ShardChecksumBuilder
+from .bitrot import BitrotProtection
 from .context import (
-    BITROT_BLOCK_SIZE,
+    BITROT_LEAF_SIZE,
     LARGE_BLOCK_SIZE,
     SMALL_BLOCK_SIZE,
     DEFAULT_EC_CONTEXT,
     ECContext,
     ECError,
 )
+from .pipeline import make_shard_sink, run_pipeline
 from .volume_info import VolumeInfo
 
 DEFAULT_BATCH = 16 * 1024 * 1024
@@ -53,97 +54,6 @@ def _pread_padded(fd: int, buf: np.ndarray, offset: int) -> None:
         buf[filled:] = 0
 
 
-class _FusedShardSink:
-    """Write stage backed by the native fused append+CRC
-    (sn_shard_append): one GIL-releasing C++ call per batch, a worker
-    thread per shard, CRC32C rolled while the bytes are cache-hot,
-    write(2) straight from the source buffers — no tobytes()/slice
-    copies. This is what closes the BENCH_r03 finding that 87% of e2e
-    wall time was host-side overhead (reference equivalent: the single
-    fused encode+CRC loop in weed/storage/erasure_coding/ec_encoder.go)."""
-
-    def __init__(self, files: list, block_size: int = BITROT_BLOCK_SIZE):
-        from ..utils import native
-
-        self._native = native
-        self.fds = [f.fileno() for f in files]
-        n = len(files)
-        self.block_size = block_size
-        self.crc_state = np.zeros(n, np.uint32)
-        self.filled = np.zeros(n, np.uint64)
-        self.crcs: list[list[int]] = [[] for _ in range(n)]
-        self.sizes = [0] * n
-        self._out_counts = np.empty(n, np.int32)
-        self._out_crcs: np.ndarray | None = None
-
-    def append(self, data: np.ndarray, parity: np.ndarray) -> None:
-        # Row-pointer math below requires C-contiguous uint8 (no-op when
-        # already so, which the reader/backends guarantee).
-        data = np.ascontiguousarray(data, dtype=np.uint8)
-        parity = np.ascontiguousarray(parity, dtype=np.uint8)
-        width = data.shape[1]
-        if parity.shape[1] != width:
-            raise ECError(
-                f"parity width {parity.shape[1]} != data width {width}"
-            )
-        max_out = width // self.block_size + 2
-        if self._out_crcs is None or self._out_crcs.shape[1] < max_out:
-            self._out_crcs = np.empty((len(self.fds), max_out), np.uint32)
-        rows = [data.ctypes.data + i * width for i in range(data.shape[0])]
-        rows += [parity.ctypes.data + j * width for j in range(parity.shape[0])]
-        self._native.shard_append(
-            self.fds,
-            rows,
-            width,
-            self.block_size,
-            self.crc_state,
-            self.filled,
-            self._out_crcs,
-            self._out_counts,
-        )
-        for i in range(len(self.fds)):
-            c = int(self._out_counts[i])
-            if c:
-                self.crcs[i].extend(int(x) for x in self._out_crcs[i, :c])
-            self.sizes[i] += width
-
-    def finish(self, ctx: ECContext) -> BitrotProtection:
-        import uuid as _uuid
-
-        for i in range(len(self.fds)):
-            if self.filled[i]:
-                self.crcs[i].append(int(self.crc_state[i]))
-                self.filled[i] = 0
-                self.crc_state[i] = 0
-        return BitrotProtection(
-            ctx=ctx,
-            block_size=self.block_size,
-            uuid=_uuid.uuid4().bytes,
-            shard_sizes=list(self.sizes),
-            shard_crcs=[list(c) for c in self.crcs],
-        )
-
-
-class _PyShardSink:
-    """Pure-Python fallback write stage (native .so unavailable)."""
-
-    def __init__(self, files: list, block_size: int = BITROT_BLOCK_SIZE):
-        self.files = files
-        self.builders = [ShardChecksumBuilder(block_size) for _ in files]
-
-    def append(self, data: np.ndarray, parity: np.ndarray) -> None:
-        k = data.shape[0]
-        for i, f in enumerate(self.files):
-            b = (data[i] if i < k else parity[i - k]).tobytes()
-            mv = memoryview(b)
-            while mv:  # raw FileIO may short-write
-                mv = mv[f.write(mv) :]
-            self.builders[i].write(b)
-
-    def finish(self, ctx: ECContext) -> BitrotProtection:
-        return BitrotProtection.from_builders(ctx, self.builders)
-
-
 def write_sorted_file_from_idx(base: str, ext: str = ".ecx") -> None:
     """Convert write-ordered .idx -> sorted sealed index (reference
     WriteSortedFileFromIdx, ec_encoder.go:32-59)."""
@@ -159,9 +69,12 @@ def write_ec_files(
     batch_size: int = DEFAULT_BATCH,
     large_block_size: int = LARGE_BLOCK_SIZE,
     small_block_size: int = SMALL_BLOCK_SIZE,
+    leaf_size: int = BITROT_LEAF_SIZE,
 ) -> BitrotProtection:
     """Stripe+encode base.dat into base.ec00..; returns bitrot CRCs
-    accumulated during the same pass."""
+    accumulated during the same pass. `leaf_size` > 0 additionally rolls
+    the v2 sidecar's per-leaf CRCs (same pass, same bytes); 0 emits a
+    v1 (block-level only) sidecar."""
     if backend is None:
         backend = get_backend("auto", ctx.data_shards, ctx.parity_shards)
     k, total = ctx.data_shards, ctx.total
@@ -174,18 +87,15 @@ def write_ec_files(
             # Python fallback writes whole >=1MiB batches, where a
             # userspace buffer adds a copy and saves nothing.
             outputs.append(open(base + ctx.to_ext(i), "wb", buffering=0))
-        try:
-            sink: _FusedShardSink | _PyShardSink = _FusedShardSink(outputs)
-        except Exception:
-            sink = _PyShardSink(outputs)
+        sink = make_shard_sink(outputs, leaf_size=leaf_size)
         dat_size = os.fstat(dat_fd).st_size
         large_row = large_block_size * k
         small_row = small_block_size * k
 
         # Row/chunk schedule: the hot loop is disk-bound (SURVEY.md hard
         # part (b)), so reads, H2D staging, device encode, and shard
-        # writes run as a 4-stage pipeline with bounded queues — the
-        # device computes batch N while batch N+1 is read/transferred
+        # writes run as the shared 4-stage pipeline (ec/pipeline.py) —
+        # the device computes batch N while batch N+1 is read/transferred
         # and batch N-1 drains to host and disk.
         def chunk_plan():
             processed = 0
@@ -199,128 +109,49 @@ def write_ec_files(
                 processed += small_row
                 remaining -= small_row
 
-        import queue as _queue
-        import threading as _threading
+        def produce():
+            for row_offset, block_size in chunk_plan():
+                batch = min(batch_size, block_size)
+                for chunk_off in range(0, block_size, batch):
+                    width = min(batch, block_size - chunk_off)
+                    data = np.empty((k, width), dtype=np.uint8)
+                    for i in range(k):
+                        _pread_padded(
+                            dat_fd,
+                            data[i],
+                            row_offset + i * block_size + chunk_off,
+                        )
+                    yield data
 
-        read_q: "_queue.Queue" = _queue.Queue(maxsize=2)
-        write_q: "_queue.Queue" = _queue.Queue(maxsize=2)
-        abort = _threading.Event()
-        errors: list[BaseException] = []
+        def transform(data):
+            # H2D stage + device encode dispatch, both async: device
+            # residency bound is ~4 batches alive at once (one draining
+            # in to_host, two queued, one being dispatched), so peak
+            # device memory is ~4x batch_size of input (+ m/k of that
+            # in outputs); callers raising batch_size must budget
+            # accordingly.
+            return data, backend.encode_staged(backend.to_device(data))
 
-        def _put(q, item) -> bool:
-            """Abort-aware put: never blocks forever on a full queue
-            whose consumer has stopped."""
-            while True:
-                try:
-                    q.put(item, timeout=0.2)
-                    return True
-                except _queue.Full:
-                    if abort.is_set():
-                        return False
+        def consume(item):
+            data, parity_handle = item
+            # Blocks until the device result is ready — while it does,
+            # the main thread keeps dispatching H2D+encode for the
+            # batches queued behind this one.
+            parity = np.ascontiguousarray(
+                backend.to_host(parity_handle), dtype=np.uint8
+            )
+            sink.append_rows([*data, *parity])
 
-        def reader():
-            try:
-                for row_offset, block_size in chunk_plan():
-                    batch = min(batch_size, block_size)
-                    for chunk_off in range(0, block_size, batch):
-                        if abort.is_set():
-                            return
-                        width = min(batch, block_size - chunk_off)
-                        data = np.empty((k, width), dtype=np.uint8)
-                        for i in range(k):
-                            _pread_padded(
-                                dat_fd,
-                                data[i],
-                                row_offset + i * block_size + chunk_off,
-                            )
-                        if not _put(read_q, data):
-                            return
-            except BaseException as e:  # pragma: no cover - disk errors
-                errors.append(e)
-                abort.set()
-            finally:
-                _put(read_q, None)
-
-        def writer():
-            try:
-                while True:
-                    item = write_q.get()
-                    if item is None:
-                        return
-                    data, parity_handle = item
-                    # Blocks until the device result is ready — while it
-                    # does, the main thread keeps dispatching H2D+encode
-                    # for the batches queued behind this one.
-                    parity = np.ascontiguousarray(
-                        backend.to_host(parity_handle), dtype=np.uint8
-                    )
-                    sink.append(data, parity)
-            except BaseException as e:  # pragma: no cover - disk errors
-                errors.append(e)
-                abort.set()
-                while write_q.get() is not None:
-                    pass
-
-        rt = _threading.Thread(target=reader, daemon=True)
-        wt = _threading.Thread(target=writer, daemon=True)
-        rt.start()
-        wt.start()
-        try:
-            # 4 overlapped stages: disk read (reader thread) / H2D stage /
-            # device encode dispatch (both async, this thread) / D2H +
-            # shard write (writer thread, blocks in to_host). Device
-            # residency bound: up to 4 batches alive at once — one
-            # draining in to_host, two queued in write_q, one being
-            # dispatched here — so peak device memory is ~4x batch_size
-            # of input (+ m/k of that in outputs); callers raising
-            # batch_size must budget accordingly.
-            while True:
-                data = read_q.get()
-                if data is None or abort.is_set():
-                    break
-                parity_handle = backend.encode_staged(backend.to_device(data))
-                if not _put(write_q, (data, parity_handle)):
-                    break
-        except BaseException as e:
-            errors.append(e)
-        finally:
-            # Shutdown discipline: JOIN both threads before any fd is
-            # closed — a reader mid-pread on a closed (possibly reused)
-            # fd would read someone else's file. On error, abort stops
-            # the reader (its _put is abort-aware) and draining read_q
-            # unblocks an in-flight put. The writer always drains
-            # write_q until the None sentinel (its error path keeps
-            # consuming), so a BLOCKING put(None) never deadlocks and
-            # never drops queued batches on the happy path.
-            if errors:
-                abort.set()
-                try:
-                    while True:
-                        read_q.get_nowait()
-                except _queue.Empty:
-                    pass
-            write_q.put(None)
+        run_pipeline(
+            produce,
+            transform,
+            consume,
             # Join bound: up to ~4 batches can still be draining (one in
             # to_host, two queued, one dispatched); allow each 16 MiB/s
             # of slow-disk write plus a fixed device-fetch allowance.
-            join_timeout = 60.0 + 4.0 * batch_size / (16 << 20)
-            rt.join(timeout=join_timeout)
-            wt.join(timeout=join_timeout)
-            if rt.is_alive() or wt.is_alive():  # pragma: no cover
-                # A stuck thread (e.g. the writer wedged in a device
-                # to_host against a hung TPU relay) means the shard
-                # files are TRUNCATED but the CRC builders are
-                # self-consistent with the truncation — returning
-                # success here would publish undetectable data loss.
-                # Chain the root cause so it isn't masked.
-                abort.set()
-                raise ECError(
-                    "ec encode pipeline thread did not finish "
-                    f"(reader alive={rt.is_alive()}, writer alive="
-                    f"{wt.is_alive()}); shards are incomplete"
-                ) from (errors[0] if errors else None)
-        if errors:
-            raise errors[0]
+            join_timeout=60.0 + 4.0 * batch_size / (16 << 20),
+            describe="ec encode pipeline",
+        )
 
         # Crash window: shards fully written but not yet durable — a
         # power cut here may leave any suffix of any shard missing.
@@ -341,7 +172,7 @@ def write_ec_files(
     from ..utils.fs import fsync_dir
 
     fsync_dir(base + ".dat")
-    return sink.finish(ctx)
+    return sink.to_protection(ctx)
 
 
 def ec_encode_volume(
@@ -350,6 +181,7 @@ def ec_encode_volume(
     backend: RSBackend | None = None,
     batch_size: int = DEFAULT_BATCH,
     version: int = 3,
+    leaf_size: int = BITROT_LEAF_SIZE,
 ) -> VolumeInfo:
     """Full encode of one volume's files (the server-side work of
     VolumeEcShardsGenerate). Order matters: .ecx first (write-race
@@ -364,7 +196,7 @@ def ec_encode_volume(
     write_sorted_file_from_idx(base)
     # Crash window the ecx-first ordering closes: .ecx exists, no shards.
     faults.fire("ec.encode.after_ecx", base=base)
-    prot = write_ec_files(base, ctx, backend, batch_size)
+    prot = write_ec_files(base, ctx, backend, batch_size, leaf_size=leaf_size)
     prot.generation = encode_ts_ns
     # Crash window: shards durable, sidecar absent — readers must serve,
     # scrub must refuse (no ground truth), rebuild must still work.

@@ -1,0 +1,323 @@
+"""Shared bounded-queue recovery pipeline + fused shard sinks.
+
+Factored out of the encoder (PR 2) so rebuild and decode run the same
+4-stage overlap the encode path already enjoyed: disk read (reader
+thread) / H2D stage + device dispatch (calling thread) / D2H + disk
+write with CRC rolled cache-hot (writer thread), with bounded queues
+between stages. BENCH_r03 measured 87% of encode e2e as host-side
+overhead before the encoder grew this shape; the serial
+read→reconstruct→write loops in rebuild/decode had the same disease.
+
+Shutdown discipline (inherited verbatim from the encoder, where it was
+hardened against hung-device postmortems): both worker threads are
+JOINED before any caller-owned fd may be closed; on error the abort
+event stops the producer (its queue put is abort-aware), the consumer
+always drains to the None sentinel, and a thread that refuses to die
+raises — truncated output with self-consistent CRCs must never be
+reported as success.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading as _threading
+from typing import Callable, Iterator, Sequence
+
+import numpy as np
+
+from .bitrot import (
+    BitrotProtection,
+    ShardChecksumBuilder,
+    fold_leaf_crcs,
+)
+from .context import BITROT_BLOCK_SIZE, ECContext, ECError
+
+
+def run_pipeline(
+    produce: Callable[[], Iterator],
+    transform: Callable,
+    consume: Callable,
+    *,
+    queue_size: int = 2,
+    join_timeout: float = 120.0,
+    describe: str = "ec pipeline",
+) -> None:
+    """Run `produce()` items through `transform` then `consume` as three
+    overlapped stages.
+
+    - `produce()` is a generator, iterated in a reader thread (disk
+      reads happen here, overlapping everything downstream).
+    - `transform(item)` runs in the calling thread — the place for
+      non-blocking device dispatch (H2D + kernel launch). Its return
+      value is handed to `consume`.
+    - `consume(result)` runs in a writer thread — the place that may
+      BLOCK on device results (to_host) and disk writes, while the
+      calling thread keeps dispatching the batches queued behind it.
+
+    Queue residency bound: up to `2*queue_size` items are alive at once
+    (one per stage plus the queues); callers sizing device memory must
+    budget accordingly.
+    """
+    read_q: "_queue.Queue" = _queue.Queue(maxsize=queue_size)
+    write_q: "_queue.Queue" = _queue.Queue(maxsize=queue_size)
+    abort = _threading.Event()
+    errors: list[BaseException] = []
+
+    def _put(q, item) -> bool:
+        """Abort-aware put: never blocks forever on a full queue whose
+        consumer has stopped."""
+        while True:
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except _queue.Full:
+                if abort.is_set():
+                    return False
+
+    def reader():
+        try:
+            for item in produce():
+                if abort.is_set():
+                    return
+                if not _put(read_q, item):
+                    return
+        except BaseException as e:  # pragma: no cover - disk errors
+            errors.append(e)
+            abort.set()
+        finally:
+            _put(read_q, None)
+
+    def writer():
+        try:
+            while True:
+                item = write_q.get()
+                if item is None:
+                    return
+                consume(item)
+        except BaseException as e:  # pragma: no cover - disk errors
+            errors.append(e)
+            abort.set()
+            while write_q.get() is not None:
+                pass
+
+    rt = _threading.Thread(target=reader, daemon=True)
+    wt = _threading.Thread(target=writer, daemon=True)
+    rt.start()
+    wt.start()
+    try:
+        while True:
+            item = read_q.get()
+            if item is None or abort.is_set():
+                break
+            if not _put(write_q, transform(item)):
+                break
+    except BaseException as e:
+        errors.append(e)
+    finally:
+        # JOIN both threads before the caller may close any fd — a
+        # reader mid-pread on a closed (possibly reused) fd would read
+        # someone else's file. The writer always drains write_q until
+        # the None sentinel (its error path keeps consuming), so a
+        # BLOCKING put(None) never deadlocks and never drops queued
+        # batches on the happy path.
+        if errors:
+            abort.set()
+            try:
+                while True:
+                    read_q.get_nowait()
+            except _queue.Empty:
+                pass
+        write_q.put(None)
+        rt.join(timeout=join_timeout)
+        wt.join(timeout=join_timeout)
+        if rt.is_alive() or wt.is_alive():  # pragma: no cover
+            # A stuck thread (e.g. wedged in a device to_host against a
+            # hung TPU relay) means the output files are TRUNCATED but
+            # any CRC builders are self-consistent with the truncation —
+            # returning success here would publish undetectable data
+            # loss. Chain the root cause so it isn't masked.
+            abort.set()
+            raise ECError(
+                f"{describe} thread did not finish (producer alive="
+                f"{rt.is_alive()}, consumer alive={wt.is_alive()}); "
+                f"output is incomplete"
+            ) from (errors[0] if errors else None)
+    if errors:
+        raise errors[0]
+
+
+# --------------------------------------------------------------------------
+# Shard sinks: the write stage shared by encode and rebuild. Both write
+# N parallel byte streams (one per shard file) while rolling the bitrot
+# CRCs in the same pass the bytes are cache-hot.
+# --------------------------------------------------------------------------
+
+
+class FusedShardSink:
+    """Write stage backed by the native fused append+CRC
+    (sn_shard_append): one GIL-releasing C++ call per batch, a worker
+    thread per shard, write(2) straight from the source buffers — no
+    tobytes()/slice copies. This is what closed the BENCH_r03 finding
+    that 87% of encode e2e wall time was host-side overhead (reference
+    equivalent: the single fused encode+CRC loop in
+    weed/storage/erasure_coding/ec_encoder.go).
+
+    With `leaf_size` set, the native CRC rolls at LEAF granularity (the
+    v2 sidecar's sub-block level) and the block-level CRCs are folded
+    from the leaf CRCs via crc32c_combine — both levels from one pass.
+    """
+
+    def __init__(
+        self,
+        files: list,
+        block_size: int = BITROT_BLOCK_SIZE,
+        leaf_size: int = 0,
+    ):
+        from ..utils import native
+
+        if leaf_size and block_size % leaf_size != 0:
+            raise ECError(
+                f"leaf size {leaf_size} does not divide block size {block_size}"
+            )
+        self._native = native
+        self.fds = [f.fileno() for f in files]
+        n = len(files)
+        self.block_size = block_size
+        self.leaf_size = leaf_size
+        self.granule = leaf_size or block_size
+        self.crc_state = np.zeros(n, np.uint32)
+        self.filled = np.zeros(n, np.uint64)
+        self.crcs: list[list[int]] = [[] for _ in range(n)]
+        self.sizes = [0] * n
+        self._out_counts = np.empty(n, np.int32)
+        self._out_crcs: np.ndarray | None = None
+        self._finished = False
+
+    def append_rows(self, rows: Sequence[np.ndarray]) -> None:
+        """Append one equal-width batch to every shard stream; rows[i]
+        goes to fds[i]. Rows must be 1-D C-contiguous uint8 (row views
+        of a contiguous matrix qualify — no copies are made)."""
+        if len(rows) != len(self.fds):
+            raise ECError(f"expected {len(self.fds)} rows, got {len(rows)}")
+        width = len(rows[0])
+        if any(len(r) != width for r in rows):
+            raise ECError("shard sink rows have unequal widths")
+        max_out = width // self.granule + 2
+        if self._out_crcs is None or self._out_crcs.shape[1] < max_out:
+            self._out_crcs = np.empty((len(self.fds), max_out), np.uint32)
+        ptrs = []
+        for r in rows:
+            if not (r.flags.c_contiguous and r.dtype == np.uint8):
+                raise ECError("shard sink rows must be contiguous uint8")
+            ptrs.append(r.ctypes.data)
+        self._native.shard_append(
+            self.fds,
+            ptrs,
+            width,
+            self.granule,
+            self.crc_state,
+            self.filled,
+            self._out_crcs,
+            self._out_counts,
+        )
+        for i in range(len(self.fds)):
+            c = int(self._out_counts[i])
+            if c:
+                self.crcs[i].extend(int(x) for x in self._out_crcs[i, :c])
+            self.sizes[i] += width
+
+    def _finish(self) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        for i in range(len(self.fds)):
+            if self.filled[i]:
+                self.crcs[i].append(int(self.crc_state[i]))
+                self.filled[i] = 0
+                self.crc_state[i] = 0
+
+    def block_crcs(self) -> list[list[int]]:
+        self._finish()
+        if not self.leaf_size:
+            return [list(c) for c in self.crcs]
+        return [
+            fold_leaf_crcs(c, total, self.leaf_size, self.block_size)
+            for c, total in zip(self.crcs, self.sizes)
+        ]
+
+    def leaf_crcs(self) -> list[list[int]]:
+        self._finish()
+        return [list(c) for c in self.crcs] if self.leaf_size else []
+
+    def to_protection(self, ctx: ECContext) -> BitrotProtection:
+        import uuid as _uuid
+
+        return BitrotProtection(
+            ctx=ctx,
+            block_size=self.block_size,
+            uuid=_uuid.uuid4().bytes,
+            shard_sizes=list(self.sizes),
+            shard_crcs=self.block_crcs(),
+            leaf_size=self.leaf_size,
+            shard_leaf_crcs=self.leaf_crcs(),
+        )
+
+
+class PyShardSink:
+    """Pure-Python fallback write stage (native .so unavailable, or a
+    byte-mutating fault point needs materialized bytes)."""
+
+    def __init__(
+        self,
+        files: list,
+        block_size: int = BITROT_BLOCK_SIZE,
+        leaf_size: int = 0,
+    ):
+        self.files = files
+        self.block_size = block_size
+        self.leaf_size = leaf_size
+        self.builders = [
+            ShardChecksumBuilder(block_size, leaf_size) for _ in files
+        ]
+
+    @property
+    def sizes(self) -> list[int]:
+        return [b.total for b in self.builders]
+
+    def append_rows(self, rows: Sequence) -> None:
+        if len(rows) != len(self.files):
+            raise ECError(f"expected {len(self.files)} rows, got {len(rows)}")
+        for i, (f, row) in enumerate(zip(self.files, rows)):
+            b = row if isinstance(row, (bytes, bytearray)) else np.asarray(
+                row, dtype=np.uint8
+            ).tobytes()
+            mv = memoryview(b)
+            while mv:  # raw FileIO may short-write
+                mv = mv[f.write(mv) :]
+            self.builders[i].write(b)
+
+    def block_crcs(self) -> list[list[int]]:
+        return [b.finish() for b in self.builders]
+
+    def leaf_crcs(self) -> list[list[int]]:
+        if not self.leaf_size:
+            return []
+        return [b.finish_leaves() for b in self.builders]
+
+    def to_protection(self, ctx: ECContext) -> BitrotProtection:
+        return BitrotProtection.from_builders(ctx, self.builders)
+
+
+def make_shard_sink(
+    files: list,
+    block_size: int = BITROT_BLOCK_SIZE,
+    leaf_size: int = 0,
+    prefer_fused: bool = True,
+) -> FusedShardSink | PyShardSink:
+    """Fused native sink when the .so is available, Python otherwise."""
+    if prefer_fused:
+        try:
+            return FusedShardSink(files, block_size, leaf_size)
+        except Exception:
+            pass
+    return PyShardSink(files, block_size, leaf_size)

@@ -12,21 +12,86 @@ accumulated bug-fix scar tissue, SURVEY.md hard part (c)):
 - regenerated shards are verified against the sidecar before publish;
 - temp file + fsync + atomic rename (+ dir fsync) publication; corrupt
   originals replaced in place only after their replacement verifies.
+
+Performance (PR 2): the rebuild runs the shared 3-stage pipeline
+(ec/pipeline.py) — surviving-shard reads / Reed-Solomon apply / fused
+native write+CRC — and the k SOURCE shards are sidecar-verified INLINE
+by the read stage (CRC rolled while the batch is cache-hot), deleting
+the separate whole-shard verification read pass the serial
+implementation paid up front. Only the non-source remainder still gets
+a dedicated verify, in parallel. A source whose inline CRC mismatches
+is re-checked from disk: confirmed rot is reclassified corrupt and the
+rebuild restarts without it (the verify-and-exclude envelope); a clean
+disk copy means transient read corruption, which fails closed.
 """
 
 from __future__ import annotations
 
 import os
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
 from .. import faults
-from .backend import RSBackend, get_backend
-from .bitrot import BitrotError, BitrotProtection, ShardChecksumBuilder
-from .context import DEFAULT_EC_CONTEXT, ECContext, ECError
+from ..ops import gf256
+from ..utils.crc import crc32c
+from .backend import RSBackend, _decode_coeffs, get_backend
+from .bitrot import BitrotError, BitrotProtection
+from .context import BITROT_BLOCK_SIZE, DEFAULT_EC_CONTEXT, ECContext, ECError
 from .decoder import _fsync_dir
 from .encoder import DEFAULT_BATCH
+from .pipeline import PyShardSink, make_shard_sink, run_pipeline
 from .volume_info import VolumeInfo
+
+
+class _SourceReadError(Exception):
+    """A source shard failed mid-pipeline (unreadable/short read)."""
+
+    def __init__(self, shards: list[int]):
+        super().__init__(f"source shards {shards} unreadable")
+        self.shards = shards
+
+
+class _BlockCrcRoller:
+    """Rolling per-block CRC32C over numpy rows, zero-copy (the inline
+    source-verification half of the fused read stage)."""
+
+    def __init__(self, block_size: int):
+        self.block_size = block_size
+        self.crcs: list[int] = []
+        self._crc = 0
+        self._filled = 0
+
+    def update(self, arr: np.ndarray) -> None:
+        pos, n = 0, len(arr)
+        while pos < n:
+            take = min(self.block_size - self._filled, n - pos)
+            self._crc = crc32c(arr[pos : pos + take], self._crc)
+            self._filled += take
+            pos += take
+            if self._filled == self.block_size:
+                self.crcs.append(self._crc)
+                self._crc = 0
+                self._filled = 0
+
+    def finish(self) -> list[int]:
+        if self._filled:
+            self.crcs.append(self._crc)
+            self._crc = 0
+            self._filled = 0
+        return self.crcs
+
+
+def _pread_exact(fd: int, buf: np.ndarray, offset: int) -> None:
+    """Fill `buf` from fd at `offset` IN PLACE; short read raises."""
+    mv = memoryview(buf)
+    filled = 0
+    want = len(buf)
+    while filled < want:
+        got = os.preadv(fd, [mv[filled:]], offset + filled)
+        if got == 0:
+            raise OSError(f"short shard read at offset {offset + filled}")
+        filled += got
 
 
 def rebuild_ec_files(
@@ -86,106 +151,336 @@ def rebuild_ec_files(
     if only_shards is not None:
         missing = [i for i in missing if i in only_shards]
 
-    # --- bitrot verify-and-exclude ---------------------------------------
-    corrupt: list[int] = []
-    if prot is not None:
-        for i in present:
+    # An armed fault registry routes through the PR1-faithful byte path:
+    # mutating faults need materialized bytes at the read/write seams,
+    # and the chaos contract (upfront verify of every present shard,
+    # fail-closed on mid-rebuild read corruption) is asserted against
+    # that shape. Disarmed — i.e. production — takes the fused path.
+    chaos = faults.active()
+    present0 = len(present)
+    all_corrupt: list[int] = []
+    verified_ok: set[int] = set()
+
+    def _verify_full(ids: list[int]) -> list[int]:
+        """Whole-shard sidecar verification (parallel across shards —
+        each is an independent read+CRC stream, so N shards drain N
+        queues instead of serializing)."""
+        if prot is None or not ids:
+            return []
+
+        def check(i: int) -> bool:
             try:
-                bad = prot.verify_shard_file(base + ctx.to_ext(i), i)
+                return bool(
+                    prot.verify_shard_file(
+                        base + ctx.to_ext(i), i, stop_early=True
+                    )
+                )
             except OSError:
-                bad = [0]  # unreadable = untrustworthy RS input
-            if bad:
-                corrupt.append(i)
-        if corrupt and not unsafe_ignore_sidecar:
-            if len(corrupt) > ctx.parity_shards:
-                raise ECError(
-                    f"bitrot sidecar suspect for {base}: {len(corrupt)}/"
-                    f"{len(present)} present shards mismatch (> parity "
-                    f"{ctx.parity_shards}); refusing to rebuild"
-                )
-            if len(present) - len(corrupt) < k:
-                raise ECError(
-                    f"bitrot: only {len(present) - len(corrupt)} verified-good "
-                    f"shards for {base}, need {k} data shards"
-                )
-            for i in corrupt:
+                return True  # unreadable = untrustworthy RS input
+
+        if len(ids) == 1:
+            flags = [check(ids[0])]
+        else:
+            with ThreadPoolExecutor(max_workers=min(len(ids), 8)) as ex:
+                flags = list(ex.map(check, ids))
+        bad = [i for i, f in zip(ids, flags) if f]
+        verified_ok.update(i for i in ids if i not in bad)
+        return bad
+
+    def _reclassify(new_bad: list[int]) -> None:
+        """Corrupt bookkeeping + the PR1 fail-closed guards."""
+        for i in new_bad:
+            if i not in all_corrupt:
+                all_corrupt.append(i)
+        if unsafe_ignore_sidecar:
+            return  # tolerate corrupt inputs, as the flag promises
+        if len(all_corrupt) > ctx.parity_shards:
+            raise ECError(
+                f"bitrot sidecar suspect for {base}: {len(all_corrupt)}/"
+                f"{present0} present shards mismatch (> parity "
+                f"{ctx.parity_shards}); refusing to rebuild"
+            )
+        if present0 - len(all_corrupt) < k:
+            raise ECError(
+                f"bitrot: only {present0 - len(all_corrupt)} verified-good "
+                f"shards for {base}, need {k} data shards"
+            )
+        for i in new_bad:
+            if i in present:
                 present.remove(i)
                 missing.append(i)
 
-    if len(present) < k:
-        raise ECError(
-            f"not enough shards to rebuild {base}: found {len(present)}, "
-            f"need {k}, missing {sorted(missing)}"
+    if prot is not None and chaos:
+        # PR1 path: verify-and-exclude every present shard before any
+        # reconstruction input is chosen.
+        _reclassify(_verify_full(list(present)))
+
+    while True:
+        if len(present) < k:
+            raise ECError(
+                f"not enough shards to rebuild {base}: found {len(present)}, "
+                f"need {k}, missing {sorted(missing)}"
+            )
+        if not missing:
+            # Nothing absent — but a present shard may still be rotten
+            # on disk (the verify-and-exclude contract repairs it in
+            # place). With no reconstruction stream to fold the check
+            # into, every still-unverified shard gets the dedicated
+            # parallel verify.
+            if prot is not None and not chaos and not unsafe_ignore_sidecar:
+                bad = _verify_full(
+                    [i for i in present if i not in verified_ok]
+                )
+                if bad:
+                    _reclassify(bad)
+                    continue
+            return []
+
+        sizes = {i: os.path.getsize(base + ctx.to_ext(i)) for i in present}
+        if prot is not None and not chaos and not unsafe_ignore_sidecar:
+            # size-vs-sidecar is the cheap half of verification
+            # (truncation/growth is corruption) — catch it before the
+            # stream even starts.
+            size_bad = [
+                i for i in present if sizes[i] != prot.shard_sizes[i]
+            ]
+            if size_bad:
+                _reclassify(size_bad)
+                continue
+        shard_size = max(sizes.values())
+        if [i for i, s in sizes.items() if s != shard_size]:
+            raise ECError(f"present shards have unequal sizes: {sizes}")
+
+        src = sorted(present)[:k]
+        if prot is not None and not chaos and not unsafe_ignore_sidecar:
+            # Non-source shards don't flow through the pipelined read,
+            # so they get the dedicated (parallel) verify; sources are
+            # verified inline below.
+            bad = _verify_full(
+                [i for i in present if i not in src and i not in verified_ok]
+            )
+            if bad:
+                _reclassify(bad)
+                continue
+
+        targets = sorted(missing)
+        bad_src = _attempt_rebuild(
+            base, ctx, backend, prot, src, targets, shard_size,
+            batch_size, chaos,
+            inline_verify=(
+                prot is not None and not chaos and not unsafe_ignore_sidecar
+            ),
+            verified_ok=verified_ok,
         )
-    if not missing:
-        return []
+        if bad_src:
+            # Confirmed on-disk rot in a source: verify-and-exclude says
+            # reclassify it as missing and rebuild without it.
+            _reclassify(bad_src)
+            continue
+        return targets
 
-    # --- reconstruct in batches ------------------------------------------
-    sizes = {i: os.path.getsize(base + ctx.to_ext(i)) for i in present}
-    shard_size = max(sizes.values())
-    short = [i for i, s in sizes.items() if s != shard_size]
-    if short:
-        raise ECError(f"present shards have unequal sizes: {sizes}")
 
-    src = sorted(present)[:k]
+def _attempt_rebuild(
+    base: str,
+    ctx: ECContext,
+    backend: RSBackend,
+    prot: BitrotProtection | None,
+    src: list[int],
+    targets: list[int],
+    shard_size: int,
+    batch_size: int,
+    chaos: bool,
+    inline_verify: bool,
+    verified_ok: set[int] | None = None,
+) -> list[int]:
+    """One pipelined reconstruction attempt. Publishes and returns []
+    on success; returns confirmed-corrupt source ids for the caller to
+    exclude and retry (inline-clean sources are recorded in
+    `verified_ok` so a retry never re-reads them); raises fail-closed
+    otherwise."""
+    k = ctx.data_shards
     fds = {i: os.open(base + ctx.to_ext(i), os.O_RDONLY) for i in src}
-    tmp_paths = {i: base + ctx.to_ext(i) + ".rebuilding" for i in missing}
-    outs = {i: open(p, "wb") for i, p in tmp_paths.items()}
-    crc_block = prot.block_size if prot is not None else None
-    builders = {
-        i: ShardChecksumBuilder(crc_block) if crc_block else ShardChecksumBuilder()
-        for i in missing
-    }
+    tmp_paths = {i: base + ctx.to_ext(i) + ".rebuilding" for i in targets}
+    # buffering=0: the fused native sink writes via raw fds; the Python
+    # fallback writes whole >=1MiB batches where a userspace buffer
+    # only adds a copy.
+    outs = {i: open(p, "wb", buffering=0) for i, p in tmp_paths.items()}
+    crc_block = prot.block_size if prot is not None else BITROT_BLOCK_SIZE
+    # The fused native sink (shard_append) rolls the sidecar-granularity
+    # CRC while the reconstructed bytes are cache-hot and writes straight
+    # from the backend's output buffers — no per-batch tobytes(). A
+    # byte-mutating fault needs materialized bytes, so an armed registry
+    # routes through the Python sink (the chaos tests' semantic path).
+    sink = make_shard_sink(
+        list(outs.values()), block_size=crc_block, prefer_fused=not chaos
+    )
+    use_bytes_path = isinstance(sink, PyShardSink)
+    rollers = (
+        {i: _BlockCrcRoller(crc_block) for i in src} if inline_verify else None
+    )
+
+    if chaos:
+        # PR1-faithful byte path: per-shard pread -> fault mutate ->
+        # dict reconstruct -> (mutate ->) write.
+        def produce():
+            for off in range(0, shard_size, batch_size):
+                width = min(batch_size, shard_size - off)
+                block = {
+                    i: np.frombuffer(
+                        faults.mutate(
+                            "ec.rebuild.read_shard",
+                            os.pread(fds[i], width, off),
+                            base=base, shard=i, offset=off,
+                        ),
+                        dtype=np.uint8,
+                    )
+                    for i in src
+                }
+                if any(len(b) != width for b in block.values()):
+                    raise ECError(f"short shard read at offset {off}")
+                yield off, block
+
+        def transform(item):
+            off, block = item
+            return off, backend.reconstruct(block, want=targets)
+
+        def consume(item):
+            off, rec = item
+            rows: list = []
+            for i in targets:
+                row = np.ascontiguousarray(rec[i], dtype=np.uint8)
+                if use_bytes_path:
+                    rows.append(
+                        faults.mutate(
+                            "ec.rebuild.shard_bytes", row.tobytes(),
+                            base=base, shard=i, offset=off,
+                        )
+                    )
+                else:
+                    rows.append(row)
+            sink.append_rows(rows)
+
+    else:
+        # Fused path: read all k sources into one (k, width) matrix
+        # (inline CRC rolled while cache-hot), then a single
+        # precomputed-coefficient GF(256) apply per batch — no per-batch
+        # matrix inversion, no stack copy, no dict plumbing.
+        rs = gf256.ReedSolomon(ctx.data_shards, ctx.parity_shards)
+        coeffs = _decode_coeffs(rs.matrix, k, tuple(targets), tuple(src))
+
+        def produce():
+            for off in range(0, shard_size, batch_size):
+                width = min(batch_size, shard_size - off)
+                buf = np.empty((k, width), dtype=np.uint8)
+                for row, i in enumerate(src):
+                    try:
+                        _pread_exact(fds[i], buf[row], off)
+                    except OSError as e:
+                        raise _SourceReadError([i]) from e
+                    if rollers is not None:
+                        rollers[i].update(buf[row])
+                yield buf
+
+        def transform(buf):
+            return backend.apply(coeffs, buf)
+
+        def consume(out):
+            out = np.ascontiguousarray(out, dtype=np.uint8)
+            sink.append_rows([out[p] for p in range(len(targets))])
+
+    def _cleanup_temps() -> None:
+        for f in outs.values():
+            f.close()
+        for p in tmp_paths.values():
+            if os.path.exists(p):
+                os.unlink(p)
+
+    def _confirm_from_disk(suspects: list[int]) -> list[int]:
+        """Re-verify suspect sources from disk: confirmed rot is
+        excludable; a clean disk copy means the PIPELINE's read was
+        transiently corrupted and publishing anything would launder it."""
+        confirmed, transient = [], []
+        for i in suspects:
+            try:
+                still_bad = bool(
+                    prot.verify_shard_file(
+                        base + ctx.to_ext(i), i, stop_early=True
+                    )
+                )
+            except OSError:
+                still_bad = True
+            (confirmed if still_bad else transient).append(i)
+        if transient:
+            raise ECError(
+                f"source shards {transient} for {base} failed read-time "
+                f"sidecar verification but verify clean on disk (transient "
+                f"read corruption); refusing to publish"
+            )
+        return confirmed
+
     try:
-        for off in range(0, shard_size, batch_size):
-            width = min(batch_size, shard_size - off)
-            block = {
-                i: np.frombuffer(
-                    faults.mutate(
-                        "ec.rebuild.read_shard",
-                        os.pread(fds[i], width, off),
-                        base=base, shard=i, offset=off,
-                    ),
-                    dtype=np.uint8,
-                )
-                for i in src
-            }
-            if any(len(b) != width for b in block.values()):
-                raise ECError(f"short shard read at offset {off}")
-            rec = backend.reconstruct(block, want=missing)
-            for i in missing:
-                b = faults.mutate(
-                    "ec.rebuild.shard_bytes",
-                    np.asarray(rec[i], dtype=np.uint8).tobytes(),
-                    base=base, shard=i, offset=off,
-                )
-                outs[i].write(b)
-                builders[i].write(b)
+        # Shared 3-stage overlap (ec/pipeline.py): surviving-shard reads
+        # / Reed-Solomon reconstruct / fused write+CRC of the
+        # regenerated shards — batch N reconstructs while N+1 is read
+        # and N-1 drains to disk, same shape as the encode path.
+        run_pipeline(
+            produce,
+            transform,
+            consume,
+            join_timeout=60.0 + 4.0 * batch_size / (16 << 20),
+            describe="ec rebuild pipeline",
+        )
+    except _SourceReadError as e:
+        _cleanup_temps()
+        if inline_verify:
+            return e.shards  # unreadable = untrustworthy; exclude + retry
+        # No exclusion machinery active (no sidecar, or
+        # unsafe_ignore_sidecar): the caller's _reclassify would not
+        # remove the shard and the identical attempt would spin forever
+        # — propagate instead, like the serial implementation did.
+        raise ECError(str(e)) from e
+    except BaseException:
+        _cleanup_temps()
+        raise
+    finally:
+        for fd in fds.values():
+            os.close(fd)
+
+    # --- inline source verification verdict (fast path) -------------------
+    if rollers is not None:
+        suspects = [
+            i for i in src if rollers[i].finish() != prot.shard_crcs[i]
+        ]
+        if verified_ok is not None:
+            # the inline roller IS the block-CRC check _verify_full
+            # performs — a retry after an exclusion must not re-read
+            # the sources that just verified clean
+            verified_ok.update(i for i in src if i not in suspects)
+        if suspects:
+            _cleanup_temps()
+            return _confirm_from_disk(suspects)
+
+    try:
         # Crash window: temp .rebuilding files written, not yet durable.
         faults.fire("ec.rebuild.before_fsync", base=base)
         for f in outs.values():
             f.flush()
             os.fsync(f.fileno())
     except BaseException:
-        for f in outs.values():
-            f.close()
-        for p in tmp_paths.values():
-            if os.path.exists(p):
-                os.unlink(p)
+        _cleanup_temps()
         raise
-    finally:
-        for fd in fds.values():
-            os.close(fd)
 
     for f in outs.values():
         f.close()
 
     # --- verify regenerated shards against the sidecar (fail closed) -----
     if prot is not None:
-        for i in missing:
+        out_sizes = sink.sizes
+        out_crcs = sink.block_crcs()
+        for pos, i in enumerate(targets):
             if (
-                builders[i].total != prot.shard_sizes[i]
-                or builders[i].finish() != prot.shard_crcs[i]
+                out_sizes[pos] != prot.shard_sizes[i]
+                or out_crcs[pos] != prot.shard_crcs[i]
             ):
                 for p in tmp_paths.values():
                     if os.path.exists(p):
@@ -199,8 +494,8 @@ def rebuild_ec_files(
     # crash here (or between renames) leaves a mix of published shards
     # and .rebuilding temps; a restarted rebuild regenerates the rest.
     faults.fire("ec.rebuild.before_rename", base=base)
-    for i in missing:
+    for i in targets:
         os.replace(tmp_paths[i], base + ctx.to_ext(i))
         faults.fire("ec.rebuild.after_rename", base=base, shard=i)
     _fsync_dir(base + ".dat")
-    return sorted(missing)
+    return []

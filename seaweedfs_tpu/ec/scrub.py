@@ -136,10 +136,12 @@ class ScrubReport:
     complete: bool = False  # full pass finished (vs budget-paused)
     checked_blocks: int = 0
     checked_bytes: int = 0
+    checked_shards: list[int] = field(default_factory=list)  # ids walked
     corrupt_shards: list[int] = field(default_factory=list)
     missing_shards: list[int] = field(default_factory=list)
     quarantined: list[str] = field(default_factory=list)
     rebuilt: list[int] = field(default_factory=list)
+    aged_out: list[str] = field(default_factory=list)  # .bad files retired
     refused: str = ""  # non-empty = fail-closed, nothing was touched
 
     @property
@@ -158,6 +160,12 @@ def _quarantine(path: str) -> str:
     freshest corrupt bytes are the forensically interesting ones."""
     dest = path + QUARANTINE_SUFFIX
     os.replace(path, dest)
+    try:
+        # rename preserves the ORIGINAL shard's mtime; retention aging
+        # must count from the quarantine event, so stamp it.
+        os.utime(dest)
+    except OSError:
+        pass
     fsync_dir(path)
     return dest
 
@@ -176,6 +184,7 @@ def scrub_ec_volume(
     expected_shards: list[int] | None = None,
     on_quarantine=None,
     on_rebuilt=None,
+    bad_retention_s: float | None = None,
 ) -> ScrubReport:
     """One scrub pass (possibly budget-sliced) over one EC volume.
 
@@ -192,6 +201,13 @@ def scrub_ec_volume(
     here would mint a duplicate copy the master never placed (and, below
     k local files, fail forever). Default None = all shards expected
     (single-node / full-set layouts, tests).
+
+    `bad_retention_s` ages out quarantined <shard>.bad forensic copies:
+    once a VERIFIED replacement shard has been published (this pass saw
+    the shard present and clean, or just rebuilt it) and the quarantine
+    file is older than the retention, it is deleted. None (default)
+    keeps quarantines forever — retiring evidence is an operator
+    opt-in.
     """
     report = ScrubReport(base=base)
     ecsum = base + ".ecsum"
@@ -232,6 +248,7 @@ def scrub_ec_volume(
             continue
         present_files += 1
         if shard_id < cursor.shard:
+            report.checked_shards.append(shard_id)
             continue  # verified in an earlier slice of this pass
         start_block = cursor.block if shard_id == cursor.shard else 0
         expected = prot.shard_crcs[shard_id]
@@ -268,6 +285,7 @@ def scrub_ec_volume(
         if corrupt:
             report.corrupt_shards.append(shard_id)
             cursor.corrupt.append(shard_id)
+        report.checked_shards.append(shard_id)
         cursor.shard, cursor.block = shard_id + 1, 0
         # Persist progress only when a mid-pass pause is possible at all
         # (a block budget is set): an unbounded pass can never resume,
@@ -359,6 +377,34 @@ def scrub_ec_volume(
             report.refused = f"rebuild skipped: {e}"
         except (RetryError, ECError) as e:
             report.refused = f"rebuild failed: {e}"
+
+    # ---- age out retired quarantine files -------------------------------
+    # A .bad forensic copy is eligible once a verified replacement is
+    # published: either this pass walked the live shard clean, or the
+    # rebuild above just regenerated it (rebuild_ec_files verifies
+    # against the sidecar before renaming). Eligibility is never
+    # inferred from absence — a shard neither verified nor rebuilt
+    # keeps its quarantine.
+    if bad_retention_s is not None and not report.refused:
+        verified_now = (
+            set(report.checked_shards) - set(report.corrupt_shards)
+        ) | set(report.rebuilt)
+        now = time.time()
+        for sid in sorted(verified_now):
+            bad_path = base + ctx.to_ext(sid) + QUARANTINE_SUFFIX
+            try:
+                age = now - os.path.getmtime(bad_path)
+            except OSError:
+                continue  # no quarantine for this shard
+            if age < bad_retention_s:
+                continue
+            try:
+                os.unlink(bad_path)
+            except OSError:
+                continue
+            fsync_dir(bad_path)
+            report.aged_out.append(bad_path)
+            log.info("retired quarantine %s (age %.0fs)", bad_path, age)
     return report
 
 
@@ -381,11 +427,13 @@ class ScrubDaemon:
         repair: bool = True,
         breaker: CircuitBreaker | None = None,
         backend=None,
+        bad_retention_s: float | None = None,
     ):
         self.store = store
         self.interval = interval
         self.repair = repair
         self.backend = backend
+        self.bad_retention_s = bad_retention_s
         self.limiter = RateLimiter(bytes_per_sec)
         self.max_blocks = max_blocks_per_volume
         # One breaker PER VOLUME: a permanently-unrebuildable volume
@@ -468,6 +516,7 @@ class ScrubDaemon:
                     max_blocks=self.max_blocks,
                     breaker=self.breaker_for(vid),
                     expected_shards=sorted(mounted),
+                    bad_retention_s=self.bad_retention_s,
                     # Unmount BEFORE rebuild: the serving fd still points
                     # at the renamed .bad inode and would happily serve
                     # rot; degraded reads reconstruct meanwhile.

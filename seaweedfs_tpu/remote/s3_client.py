@@ -17,9 +17,32 @@ from dataclasses import dataclass
 
 import requests
 
+from ..utils.retry import RetryError, RetryPolicy, retry_call
+
 
 class RemoteStorageError(Exception):
     pass
+
+
+class TransientRemoteError(RemoteStorageError):
+    """Retryable remote failure: connection reset, timeout, HTTP 5xx or
+    429. Permanent rejections (4xx) stay RemoteStorageError and are
+    never retried."""
+
+
+# Unified policy (utils/retry.py): 3 quick signed attempts. Each
+# attempt re-signs (fresh x-amz-date), so a retry is never rejected for
+# clock skew accumulated while backing off.
+DEFAULT_S3_RETRY_POLICY = RetryPolicy(
+    max_attempts=3,
+    base_delay=0.2,
+    max_delay=2.0,
+    retry_on=(
+        TransientRemoteError,
+        requests.ConnectionError,
+        requests.Timeout,
+    ),
+)
 
 
 @dataclass
@@ -41,12 +64,16 @@ class RemoteS3Client:
         access_key: str = "",
         secret_key: str = "",
         region: str = "us-east-1",
+        retry_policy: RetryPolicy | None = DEFAULT_S3_RETRY_POLICY,
     ):
-        """endpoint: http(s)://host:port (path-style addressing)."""
+        """endpoint: http(s)://host:port (path-style addressing).
+        `retry_policy` governs transient-failure retries per request
+        (None disables)."""
         self.endpoint = endpoint.rstrip("/")
         self.access_key = access_key
         self.secret_key = secret_key
         self.region = region
+        self.retry_policy = retry_policy
         self._http = requests.Session()
 
     # ------------------------------------------------------------ sigv4
@@ -125,20 +152,41 @@ class RemoteS3Client:
         extra_headers: dict | None = None,
         ok=(200,),
     ) -> requests.Response:
-        headers = self._headers(method, path, query, payload)
-        if extra_headers:
-            headers.update(extra_headers)
         url = self.endpoint + urllib.parse.quote(path)
         if query:
             url += "?" + query
-        r = self._http.request(
-            method, url, headers=headers, data=payload or None, timeout=60
-        )
-        if r.status_code not in ok:
-            raise RemoteStorageError(
-                f"{method} {path}: HTTP {r.status_code} {r.text[:200]}"
+
+        def attempt() -> requests.Response:
+            headers = self._headers(method, path, query, payload)
+            if extra_headers:
+                headers.update(extra_headers)
+            r = self._http.request(
+                method, url, headers=headers, data=payload or None, timeout=60
             )
-        return r
+            if r.status_code not in ok:
+                err = (
+                    TransientRemoteError
+                    if r.status_code >= 500 or r.status_code == 429
+                    else RemoteStorageError
+                )
+                raise err(
+                    f"{method} {path}: HTTP {r.status_code} {r.text[:200]}"
+                )
+            return r
+
+        if self.retry_policy is None:
+            return attempt()
+        try:
+            return retry_call(
+                attempt, self.retry_policy, describe=f"s3 {method} {path}"
+            )
+        except RetryError as e:
+            # callers classify on RemoteStorageError — surface the last
+            # underlying failure in that taxonomy, not the retry wrapper
+            cause = e.__cause__
+            if isinstance(cause, RemoteStorageError):
+                raise cause from e
+            raise RemoteStorageError(str(e)) from e
 
     # ------------------------------------------------------- operations
 

@@ -19,7 +19,9 @@ import time
 
 import requests
 
+from ..utils.retry import Backoff
 from ..utils.urls import service_url
+from .sync import TAIL_RETRY_POLICY
 
 
 class FilerBackup:
@@ -194,11 +196,13 @@ class FilerBackup:
             n = self.full_sync()
             print(f"initial backup: {n} files copied", flush=True)
             self._save_state()
+        backoff = Backoff(TAIL_RETRY_POLICY)
         while not self._stop.is_set():
             try:
                 self.tail_once()
+                backoff.reset()
             except requests.RequestException:
-                self._stop.wait(2.0)
+                self._stop.wait(backoff.next_delay())
 
     def stop(self) -> None:
         self._stop.set()

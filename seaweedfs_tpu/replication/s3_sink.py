@@ -19,7 +19,9 @@ import requests
 
 from ..remote.s3_client import RemoteS3Client, RemoteStorageError
 from ..utils.glog import logger
+from ..utils.retry import Backoff
 from ..utils.urls import service_url
+from .sync import TAIL_RETRY_POLICY
 
 log = logger("s3sink")
 
@@ -161,12 +163,14 @@ class S3Sink:
             n = self.full_sync()
             log.info("initial copy: %d files -> s3://%s", n, self.bucket)
             self._save_state()
+        backoff = Backoff(TAIL_RETRY_POLICY)
         while not self._stop.is_set():
             try:
                 self.tail_once()
+                backoff.reset()
             except (requests.RequestException, ValueError) as e:
                 log.warning("tail error: %s", e)
-                self._stop.wait(2.0)
+                self._stop.wait(backoff.next_delay())
 
     def _source_now_ns(self) -> int:
         r = self._http.get(

@@ -15,7 +15,16 @@ import time
 import urllib.parse
 
 import requests
+
+from ..utils.retry import Backoff, RetryPolicy
 from ..utils.urls import service_url
+
+# Unified tail-retry schedule (utils/retry.py): quick first retry while
+# a filer restarts, 30s tail so a long outage doesn't hammer it. Shared
+# by FilerSync / FilerBackup / S3Sink.
+TAIL_RETRY_POLICY = RetryPolicy(
+    max_attempts=7, base_delay=0.5, max_delay=30.0
+)
 
 
 class FilerSync:
@@ -175,11 +184,13 @@ class FilerSync:
             n = self.full_sync()
             print(f"initial sync: {n} files copied", flush=True)
             self._save_state()
+        backoff = Backoff(TAIL_RETRY_POLICY)
         while not self._stop.is_set():
             try:
                 self.tail_once()
+                backoff.reset()
             except requests.RequestException:
-                self._stop.wait(2.0)
+                self._stop.wait(backoff.next_delay())
 
     def stop(self) -> None:
         self._stop.set()

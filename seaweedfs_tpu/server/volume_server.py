@@ -793,19 +793,24 @@ class VolumeService:
             prot = BitrotProtection.load(base + ".ecsum")
         except BitrotError as e:
             return pb.ScrubResponse(error=f"sidecar unreadable: {e}")
-        checked = 0
+        checked: list[int] = []
         bad: list[int] = []
         for i in range(prot.ctx.total):
             p = base + prot.ctx.to_ext(i)
             if not os.path.exists(p):
                 continue
-            checked += 1
+            checked.append(i)
             try:
                 if prot.verify_shard_file(p, i):
                     bad.append(i)
             except OSError:
                 bad.append(i)
-        return pb.ScrubResponse(checked=checked, bad_shards=bad)
+        # checked_shards lets the shell do a real per-sid set difference
+        # against the master's advertised placement; the bare count can
+        # be masked by non-advertised local shard files.
+        return pb.ScrubResponse(
+            checked=len(checked), bad_shards=bad, checked_shards=checked
+        )
 
     def VolumeServerStatus(self, request, context):
         st = self.store.status()
@@ -875,6 +880,8 @@ class VolumeServer:
         tls=None,
         ec_scrub_interval: float = 0.0,
         ec_scrub_bytes_per_sec: float = 64 << 20,
+        ec_scrub_bad_retention: float = 0.0,
+        ec_interval_cache_mb: int | None = None,
     ):
         self.jwt_key = jwt_key
         self.ip = ip
@@ -898,6 +905,12 @@ class VolumeServer:
             ec_backend=ec_backend,
             ec_remote_reader_factory=self._remote_reader_factory,
             needle_map_kind=needle_map_kind,
+            # degraded-read reconstructed-interval cache budget per EC
+            # volume; None keeps EcVolume's default, 0 disables
+            ec_interval_cache_bytes=(
+                None if ec_interval_cache_mb is None
+                else int(ec_interval_cache_mb) << 20
+            ),
         )
         self.service = VolumeService(self)
 
@@ -942,6 +955,8 @@ class VolumeServer:
                 self.store,
                 interval=ec_scrub_interval,
                 bytes_per_sec=ec_scrub_bytes_per_sec,
+                # 0 = keep quarantined .bad files forever (default)
+                bad_retention_s=ec_scrub_bad_retention or None,
             )
 
     @staticmethod

@@ -963,15 +963,30 @@ def ec_scrub(env: ShellEnv, args) -> str:
                 continue
             bad = list(r.bad_shards)
             # shards the master lists on this holder but whose files the
-            # scrub did not find = deleted out from under the server
-            gone = r.checked < len(holder_sids.get(url, ()))
+            # scrub did not find = deleted out from under the server. A
+            # real per-sid set difference: extra non-advertised local
+            # shard files can no longer mask a missing advertised one
+            # (the old count comparison could).
+            advertised = holder_sids.get(url, set())
+            if r.checked_shards:
+                missing_sids = sorted(advertised - set(r.checked_shards))
+                gone = bool(missing_sids)
+                gone_note = (
+                    f" (advertised shards {missing_sids} MISSING locally)"
+                )
+            else:
+                # pre-checked_shards server (field absent deserializes
+                # empty): degrade to the count comparison rather than
+                # declaring every advertised shard missing
+                gone = r.checked < len(advertised)
+                gone_note = (
+                    f" ({len(advertised) - r.checked} advertised "
+                    f"shard files MISSING)"
+                )
             out.append(
                 f"{url}: checked {r.checked} shards"
                 + (f", BITROT in shards {bad}" if bad else ", all clean")
-                + (
-                    f" ({len(holder_sids[url]) - r.checked} advertised "
-                    f"shard files MISSING)" if gone else ""
-                )
+                + (gone_note if gone else "")
             )
             if not (bad or gone) or not a.repair:
                 continue

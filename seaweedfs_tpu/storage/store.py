@@ -35,7 +35,12 @@ class DiskLocation:
     volumes: dict[int, Volume] = field(default_factory=dict)
     ec_volumes: dict[int, EcVolume] = field(default_factory=dict)
 
-    def load_existing(self, ec_backend: str = "auto", remote_reader_factory=None) -> None:
+    def load_existing(
+        self,
+        ec_backend: str = "auto",
+        remote_reader_factory=None,
+        ec_interval_cache_bytes: int | None = None,
+    ) -> None:
         for name in sorted(os.listdir(self.directory)):
             m = _DAT_RE.match(name) or _VIF_RE.match(name)
             # a .vif with no local .dat is a cold-tiered volume: it must
@@ -60,12 +65,16 @@ class DiskLocation:
                     os.path.exists(base + f".ec{i:02d}") for i in range(32)
                 ):
                     try:
+                        kwargs = {}
+                        if ec_interval_cache_bytes is not None:
+                            kwargs["interval_cache_bytes"] = ec_interval_cache_bytes
                         self.ec_volumes[vid] = EcVolume(
                             self.directory, vid, collection=col,
                             backend_name=ec_backend,
                             remote_reader=remote_reader_factory(vid, col)
                             if remote_reader_factory
                             else None,
+                            **kwargs,
                         )
                     except ECError:
                         continue
@@ -81,6 +90,7 @@ class Store:
         ec_backend: str = "auto",
         ec_remote_reader_factory=None,
         needle_map_kind: str = "memory",
+        ec_interval_cache_bytes: int | None = None,
     ):
         self.ip = ip
         self.port = port
@@ -88,6 +98,9 @@ class Store:
         self.ec_backend = ec_backend
         self.ec_remote_reader_factory = ec_remote_reader_factory
         self.needle_map_kind = needle_map_kind
+        # None = EcVolume's default; 0 disables the degraded-read
+        # reconstructed-interval cache entirely.
+        self.ec_interval_cache_bytes = ec_interval_cache_bytes
         self._lock = threading.RLock()
         # a directory spec may carry a type tag: "/data1:ssd"
         # (reference -dir=/d1 -disk=ssd); bare paths default to hdd
@@ -105,7 +118,9 @@ class Store:
             )
         for loc in self.locations:
             os.makedirs(loc.directory, exist_ok=True)
-            loc.load_existing(ec_backend, ec_remote_reader_factory)
+            loc.load_existing(
+                ec_backend, ec_remote_reader_factory, ec_interval_cache_bytes
+            )
 
     # ----------------------------------------------------------- lookup
 
@@ -254,6 +269,9 @@ class Store:
             for loc in self.locations:
                 base = Volume.base_file_name(loc.directory, collection, vid)
                 if os.path.exists(base + ".ecx"):
+                    kwargs = {}
+                    if self.ec_interval_cache_bytes is not None:
+                        kwargs["interval_cache_bytes"] = self.ec_interval_cache_bytes
                     ev = EcVolume(
                         loc.directory,
                         vid,
@@ -262,6 +280,7 @@ class Store:
                         remote_reader=self.ec_remote_reader_factory(vid, collection)
                         if self.ec_remote_reader_factory
                         else None,
+                        **kwargs,
                     )
                     loc.ec_volumes[vid] = ev
                     return ev

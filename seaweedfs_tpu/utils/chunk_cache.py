@@ -46,6 +46,15 @@ class ChunkCache:
             if old is not None:
                 self._bytes -= len(old)
 
+    def clear(self) -> None:
+        """Drop every entry (bulk invalidation — e.g. the EC interval
+        cache on shard remount/rebuild/delete). Hit/miss counters are
+        deliberately kept: they describe the cache's lifetime, not one
+        population of it."""
+        with self._lock:
+            self._data.clear()
+            self._bytes = 0
+
     @property
     def size_bytes(self) -> int:
         return self._bytes

@@ -9,6 +9,8 @@ back to a numpy slice-by-8 table implementation.
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 CASTAGNOLI_POLY = 0x82F63B78  # reflected
@@ -86,3 +88,67 @@ def crc32c(data, crc: int = 0) -> int:
     if fn:
         return fn(data, crc)
     return _crc32c_py(data, crc)
+
+
+# ---------------------------------------------------------------- combine
+#
+# crc32c(A || B) from crc32c(A), crc32c(B), len(B) without touching the
+# bytes (zlib's crc32_combine GF(2) matrix method, Castagnoli polynomial).
+# Lets the .ecsum v2 sidecar derive block-level CRCs from its per-leaf
+# CRCs in one pass: each leaf is checksummed independently while
+# cache-hot, and the 16 MiB block CRC is folded from the leaf CRCs in
+# O(leaves * 32) XORs instead of re-reading the block.
+
+
+def _gf2_matrix_times(mat: list[int], vec: int) -> int:
+    out = 0
+    i = 0
+    while vec:
+        if vec & 1:
+            out ^= mat[i]
+        vec >>= 1
+        i += 1
+    return out
+
+
+def _gf2_matrix_square(mat: list[int]) -> list[int]:
+    return [_gf2_matrix_times(mat, mat[n]) for n in range(32)]
+
+
+def _gf2_matrix_mul(a: list[int], b: list[int]) -> list[int]:
+    """Operator composition: (a∘b)[n] = a * b[n] (columns are uint32)."""
+    return [_gf2_matrix_times(a, b[n]) for n in range(32)]
+
+
+@functools.lru_cache(maxsize=64)
+def _zero_operator(nbytes: int) -> tuple[int, ...]:
+    """32x32 GF(2) matrix advancing a finalized CRC32C over `nbytes`
+    zero bytes. Cached per length: .ecsum leaves are uniform-size, so a
+    whole sidecar's combines reuse one or two cached operators."""
+    odd = [0] * 32
+    odd[0] = CASTAGNOLI_POLY  # one zero BIT, reflected form
+    row = 1
+    for n in range(1, 32):
+        odd[n] = row
+        row <<= 1
+    even = _gf2_matrix_square(odd)  # 2 bits
+    odd = _gf2_matrix_square(even)  # 4 bits
+    mat = odd
+    op: list[int] | None = None
+    n = nbytes
+    while n:
+        mat = _gf2_matrix_square(mat)  # 8 bits = 1 byte, then doubling
+        if n & 1:
+            op = list(mat) if op is None else _gf2_matrix_mul(mat, op)
+        n >>= 1
+    assert op is not None  # nbytes > 0 guaranteed by caller
+    return tuple(op)
+
+
+def crc32c_combine(crc1: int, crc2: int, len2: int) -> int:
+    """crc32c of the concatenation of two streams whose individual
+    (finalized) CRCs are crc1 and crc2, where the second stream is
+    `len2` bytes long."""
+    if len2 <= 0:
+        return crc1
+    return _gf2_matrix_times(list(_zero_operator(len2)), crc1) ^ crc2
