@@ -201,60 +201,96 @@ def _cpu_rebuild_bench(base: str, dat_size: int) -> dict:
     serial_verify_dt = time.perf_counter() - t_verify0
     src = sorted(present)[: ctx.data_shards]
     shard_size = os.path.getsize(base + ctx.to_ext(src[0]))
-    fds = {i: os.open(base + ctx.to_ext(i), os.O_RDONLY) for i in src}
     tmp_paths = {i: base + ctx.to_ext(i) + ".serialbench" for i in missing}
-    outs = {i: open(p, "wb") for i, p in tmp_paths.items()}
-    builders = {i: ShardChecksumBuilder(prot.block_size) for i in missing}
-    t0 = time.perf_counter()
-    try:
-        for off in range(0, shard_size, batch):
-            width = min(batch, shard_size - off)
-            block = {
-                i: np.frombuffer(os.pread(fds[i], width, off), dtype=np.uint8)
-                for i in src
-            }
-            rec = backend.reconstruct(block, want=missing)
-            for i in missing:
-                b = np.asarray(rec[i], dtype=np.uint8).tobytes()
-                outs[i].write(b)
-                builders[i].write(b)
-        for f in outs.values():
-            f.flush()
-            os.fsync(f.fileno())
-    finally:
-        for fd in fds.values():
-            os.close(fd)
-        for f in outs.values():
-            f.close()
-    serial_dt = time.perf_counter() - t0 + serial_verify_dt
-    serial_ok = all(
-        builders[i].total == prot.shard_sizes[i]
-        and builders[i].finish() == prot.shard_crcs[i]
-        for i in missing
-    )
+    serial_ok = True
+
+    def serial_once() -> float:
+        nonlocal serial_ok
+        fds = {i: os.open(base + ctx.to_ext(i), os.O_RDONLY) for i in src}
+        outs = {i: open(p, "wb") for i, p in tmp_paths.items()}
+        builders = {i: ShardChecksumBuilder(prot.block_size) for i in missing}
+        t0 = time.perf_counter()
+        try:
+            for off in range(0, shard_size, batch):
+                width = min(batch, shard_size - off)
+                block = {
+                    i: np.frombuffer(os.pread(fds[i], width, off), dtype=np.uint8)
+                    for i in src
+                }
+                rec = backend.reconstruct(block, want=missing)
+                for i in missing:
+                    b = np.asarray(rec[i], dtype=np.uint8).tobytes()
+                    outs[i].write(b)
+                    builders[i].write(b)
+            for f in outs.values():
+                f.flush()
+                os.fsync(f.fileno())
+        finally:
+            for fd in fds.values():
+                os.close(fd)
+            for f in outs.values():
+                f.close()
+        dt = time.perf_counter() - t0
+        serial_ok = serial_ok and all(
+            builders[i].total == prot.shard_sizes[i]
+            and builders[i].finish() == prot.shard_crcs[i]
+            for i in missing
+        )
+        return dt
+
+    # Best-of-2, matching the warm best-of-N treatment the pipelined and
+    # staged variants get below — all three numbers are page-cache-warm
+    # floors, so the ratios compare algorithms, not cache states.
+    serial_dt = min(serial_once(), serial_once()) + serial_verify_dt
     for p in tmp_paths.values():
         os.unlink(p)
 
-    # --- pipelined: actually lose the shards, rebuild_ec_files them
-    # back (publishes temp+fsync+rename, sidecar-verified), compare
-    # bit-for-bit against the originals.
+    # --- pipelined (PR 2 shape, synchronous apply) vs staged (PR 3,
+    # async H2D/compute/D2H through the backend staging hooks): actually
+    # lose the shards, rebuild_ec_files them back (publishes
+    # temp+fsync+rename, sidecar-verified), compare bit-for-bit against
+    # the originals. Two timed reps per variant, best-of: the variants
+    # do IDENTICAL I/O and GF math on CPU, so min-dt is the honest
+    # comparison (staged must be parity-not-regression here; the
+    # overlap win only exists where D2H actually blocks — on a device).
     originals = {}
     for i in missing:
         with open(base + ctx.to_ext(i), "rb") as f:
             originals[i] = f.read()
-        os.unlink(base + ctx.to_ext(i))
-    t0 = time.perf_counter()
-    rebuilt = rebuild_ec_files(base, backend=backend)
-    pipe_dt = time.perf_counter() - t0
-    identical = sorted(rebuilt) == sorted(missing)
-    for i in missing:
-        with open(base + ctx.to_ext(i), "rb") as f:
-            if f.read() != originals[i]:
-                identical = False
+
+    identical = True
+
+    def one_rebuild(staged: bool) -> float:
+        nonlocal identical
+        for i in missing:
+            if os.path.exists(base + ctx.to_ext(i)):
+                os.unlink(base + ctx.to_ext(i))
+        t0 = time.perf_counter()
+        rebuilt = rebuild_ec_files(base, backend=backend, staged=staged)
+        dt = time.perf_counter() - t0
+        if sorted(rebuilt) != sorted(missing):
+            identical = False
+        for i in missing:
+            with open(base + ctx.to_ext(i), "rb") as f:
+                if f.read() != originals[i]:
+                    identical = False
+        return dt
+
+    # Interleaved best-of-3 after a warmup (page cache + fsync drift
+    # dominate at small volume sizes; interleaving decorrelates it and
+    # min-of-N converges both variants to their I/O floor).
+    one_rebuild(staged=True)
+    times = {False: float("inf"), True: float("inf")}
+    for _ in range(3):
+        for staged in (False, True):
+            times[staged] = min(times[staged], one_rebuild(staged))
+    pipe_dt, staged_dt = times[False], times[True]
     return {
         "rebuild_serial_gbs": round(dat_size / serial_dt / 1e9, 3),
         "rebuild_pipeline_gbs": round(dat_size / pipe_dt / 1e9, 3),
+        "rebuild_staged_gbs": round(dat_size / staged_dt / 1e9, 3),
         "rebuild_vs_serial": round(serial_dt / pipe_dt, 3),
+        "rebuild_staged_vs_sync": round(pipe_dt / staged_dt, 3),
         "rebuild_bit_identical": bool(serial_ok and identical),
     }
 
@@ -780,18 +816,34 @@ def _device_e2e(base: str, expected_crcs: list[list[int]], dat_size: int) -> dic
         "e2e_verified": prot.shard_crcs == expected_crcs,
     }
 
-    # BASELINE config 2: rebuild 2 missing shards (one data, one parity).
+    # BASELINE config 2: rebuild 2 missing shards (one data, one parity),
+    # staged (async H2D/compute/D2H) AND synchronous-apply, so the line
+    # carries the on-device rebuild_staged_vs_sync overlap ratio.
     # rebuild_ec_files verifies regenerated shards against the sidecar
     # and fails closed, so finishing at all means the rebuild is
     # bit-exact; a failure is recorded without discarding the encode.
     try:
         ctx = DEFAULT_EC_CONTEXT
-        for i in (1, K + 1):
-            os.unlink(base + ctx.to_ext(i))
-        t0 = time.perf_counter()
-        rebuilt = rebuild_ec_files(base, backend=backend)
-        rebuild_dt = time.perf_counter() - t0
+
+        def timed_rebuild(staged: bool) -> tuple[float, list[int]]:
+            for i in (1, K + 1):
+                if os.path.exists(base + ctx.to_ext(i)):
+                    os.unlink(base + ctx.to_ext(i))
+            t0 = time.perf_counter()
+            rebuilt = rebuild_ec_files(base, backend=backend, staged=staged)
+            return time.perf_counter() - t0, rebuilt
+
+        # Warmup rebuild first (untimed): the first apply pays XLA jit
+        # compilation + coefficient bit-expansion; both timed variants
+        # hit the same kernel/coeff caches, so the ratio measures
+        # OVERLAP, not who compiled. (Both numbers are therefore warm —
+        # warmer than pre-PR3 rounds' single cold rebuild.)
+        timed_rebuild(staged=True)
+        sync_dt, _ = timed_rebuild(staged=False)
+        rebuild_dt, rebuilt = timed_rebuild(staged=True)
         result["rebuild_volume_gbs"] = dat_size / rebuild_dt / 1e9
+        result["rebuild_sync_volume_gbs"] = dat_size / sync_dt / 1e9
+        result["rebuild_staged_vs_sync"] = round(sync_dt / rebuild_dt, 3)
         result["rebuilt_shards"] = rebuilt
     except Exception as e:  # noqa: BLE001 — partial evidence beats none
         result["rebuild_error"] = repr(e)[:500]
@@ -887,10 +939,17 @@ def _run_stage(
     remaining,
     attempts: int | None = None,
     timeout_cap: float | None = None,
+    stop_on_timeout: bool = False,
 ) -> dict:
     """Run stage `name` in a watchdogged subprocess, retrying with
     backoff. Returns the child's persisted fragment merged with the
-    parent-side attempt trail ({_rc, _s, _attempts})."""
+    parent-side attempt trail ({_rc, _s, _attempts}).
+
+    `stop_on_timeout` gives up after the FIRST watchdog timeout instead
+    of burning every attempt against a hung device (fast in-child
+    failures still retry — a relay refusing connections may wake up,
+    one that HANGS for the full watchdog will not wake within the next
+    backoff either)."""
     import subprocess
 
     path = os.path.join(workdir, f"stage_{name}.json")
@@ -927,12 +986,18 @@ def _run_stage(
             rc = "timeout"
         trail.append({"rc": rc, "s": round(time.perf_counter() - t0, 1)})
         if os.path.exists(path):
+            # A persisted fragment beats the watchdog verdict: the child
+            # may have finished its work and hung in teardown — valid
+            # evidence must not be discarded (nor poison the probe
+            # cache with a false "hung").
             try:
                 with open(path) as f:
                     result = json.load(f)
             except (OSError, json.JSONDecodeError) as e:
                 result = {"error": f"fragment_unreadable: {e!r}"}
-            if "error" in result and attempt + 1 < attempts:
+            if "error" in result and attempt + 1 < attempts and not (
+                rc == "timeout" and stop_on_timeout
+            ):
                 # A fast in-child failure (e.g. relay refusing
                 # connections rather than hanging) deserves the same
                 # retry-with-backoff as a hang — the relay may wake.
@@ -941,6 +1006,8 @@ def _run_stage(
             else:
                 result["_attempts"] = trail
                 return result
+        if rc == "timeout" and stop_on_timeout:
+            return {"error": "device_hung", "_attempts": trail}
         if attempt + 1 < attempts:
             backoff = min(STAGE_BACKOFF * (attempt + 1), max(remaining(), 0))
             time.sleep(backoff)
@@ -1105,7 +1172,13 @@ def main() -> None:
             )
             probe["probe_cache"] = "hung_short_circuit"
         else:
-            probe = _run_stage("probe", workdir, remaining)
+            # Cold (or healthy) verdict cache: fast in-child failures
+            # retry with backoff, but ONE full-watchdog hang is enough
+            # evidence — BENCH_r05 burned 3 x 150 s re-proving a dead
+            # relay before the CPU fallback could land.
+            probe = _run_stage(
+                "probe", workdir, remaining, stop_on_timeout=True
+            )
         # Verdict persistence rules: a budget-skipped probe says nothing
         # (don't erase a valid verdict), and a FAILED short-circuit probe
         # must not refresh the hung timestamp — the reduced-patience
@@ -1173,6 +1246,11 @@ def main() -> None:
                     "e2e_vs_cpu": round(e2e["e2e_gbs"] / cpu_e2e, 3),
                     "rebuild_volume_gbs": round(
                         e2e.get("rebuild_volume_gbs", 0.0), 3
+                    ),
+                    # on-device overlap win (CPU-host parity ratio lives
+                    # in the top-level rebuild_staged_vs_sync key)
+                    "rebuild_staged_vs_sync_device": e2e.get(
+                        "rebuild_staged_vs_sync"
                     ),
                     "rebuild_error": e2e.get("rebuild_error"),
                 }

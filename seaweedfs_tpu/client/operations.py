@@ -6,13 +6,20 @@ DeleteFiles) — HTTP data plane against volume servers, gRPC to master.
 
 from __future__ import annotations
 
-import time
-
 import requests
 
 from ..storage.file_id import FileId
-from .master_client import MasterClient
+from ..utils.retry import RetryError, RetryPolicy, retry_call
 from ..utils.urls import service_url
+from .master_client import MasterClient
+
+
+class _PermanentUploadError(Exception):
+    """Non-retryable upload failure (4xx); carries the HTTPError."""
+
+    def __init__(self, err: Exception):
+        super().__init__(str(err))
+        self.err = err
 
 
 class TracingSession(requests.Session):
@@ -45,7 +52,15 @@ class Operations:
             token = sign_jwt(self.jwt_key, fid)
         return {"Authorization": f"Bearer {token}"} if token else {}
 
-    _UPLOAD_ATTEMPTS = 4
+    # Transient failures only: assign errors, connection errors, 5xx.
+    # 4xx is permanent and escapes via _PermanentUploadError (not in
+    # retry_on), exactly like the old hand-rolled loop's early raise.
+    _UPLOAD_POLICY = RetryPolicy(
+        max_attempts=4,
+        base_delay=0.1,
+        max_delay=1.0,
+        retry_on=(requests.RequestException, RuntimeError),
+    )
 
     def upload(
         self,
@@ -56,42 +71,41 @@ class Operations:
         replication: str = "",
         ttl: str = "",
     ) -> str:
-        """Assign + POST with retry (reference UploadWithRetry,
-        upload_content.go): a write can race a volume going readonly
-        (vacuum, ec.encode) or a momentarily-unassignable master —
-        re-assign and try again. 4xx responses are permanent and raise
-        immediately."""
-        last_exc: Exception | None = None
-        for attempt in range(self._UPLOAD_ATTEMPTS):
-            try:
-                a = self.master.assign(
-                    collection=collection, replication=replication, ttl=ttl
-                )
-                url = service_url(a.url, f"/{a.fid}")
-                files = {
-                    "file": (name or "file", data, mime or "application/octet-stream")
-                }
-                r = self._http.post(
-                    url,
-                    files=files,
-                    timeout=60,
-                    headers=self._auth_headers(a.jwt, a.fid),
-                )
-            except (requests.RequestException, RuntimeError) as e:
-                last_exc = e  # transient: assign failure / connection error
-            else:
-                if r.status_code < 400:
-                    return a.fid
-                if r.status_code < 500:  # permanent (auth, bad request)
-                    raise requests.HTTPError(
-                        f"{r.status_code} for {url}: {r.text[:200]}"
-                    )
-                last_exc = requests.HTTPError(
-                    f"{r.status_code} for {url}: {r.text[:200]}"
-                )
-            if attempt < self._UPLOAD_ATTEMPTS - 1:
-                time.sleep(0.1 * (attempt + 1))
-        raise last_exc if last_exc is not None else RuntimeError("upload failed")
+        """Assign + POST under the unified retry policy (reference
+        UploadWithRetry, upload_content.go): a write can race a volume
+        going readonly (vacuum, ec.encode) or a momentarily-unassignable
+        master — re-assign and try again. 4xx responses are permanent
+        and raise immediately."""
+
+        def attempt() -> str:
+            a = self.master.assign(
+                collection=collection, replication=replication, ttl=ttl
+            )
+            url = service_url(a.url, f"/{a.fid}")
+            files = {
+                "file": (name or "file", data, mime or "application/octet-stream")
+            }
+            r = self._http.post(
+                url,
+                files=files,
+                timeout=60,
+                headers=self._auth_headers(a.jwt, a.fid),
+            )
+            if r.status_code < 400:
+                return a.fid
+            err = requests.HTTPError(f"{r.status_code} for {url}: {r.text[:200]}")
+            if r.status_code < 500:  # permanent (auth, bad request)
+                raise _PermanentUploadError(err)
+            raise err
+
+        try:
+            return retry_call(attempt, self._UPLOAD_POLICY, describe="upload")
+        except _PermanentUploadError as e:
+            raise e.err from None
+        except RetryError as e:
+            # callers match on the underlying transport error, as with
+            # the old loop's `raise last_exc`
+            raise e.__cause__ from None
 
     def read(self, fid: str, fast: bool = True) -> bytes:
         f = FileId.parse(fid)

@@ -47,6 +47,13 @@ class RSBackend(Protocol):
         WITHOUT waiting for completion."""
         ...
 
+    def apply_staged(self, coeffs: np.ndarray, staged):
+        """Dispatch a general GF(256) apply (see `apply`) on staged
+        input; returns a result handle WITHOUT waiting for completion.
+        The staged analog of `apply` — what rebuild/decode/degraded
+        reconstruction use to overlap H2D, compute, and D2H."""
+        ...
+
     def to_host(self, result) -> np.ndarray:
         """Block until `result` is complete and return host uint8."""
         ...
@@ -106,11 +113,17 @@ class _BackendBase:
         return bool(np.array_equal(self.encode(shards[:k]), shards[k:]))
 
     # Default (synchronous) pipeline hooks; device backends override.
+    # apply_staged degenerates to the synchronous apply, so CpuBackend
+    # output through the staged pipeline is bit-identical to apply() by
+    # construction.
     def to_device(self, data: np.ndarray):
         return data
 
     def encode_staged(self, staged):
         return self.encode(staged)
+
+    def apply_staged(self, coeffs: np.ndarray, staged):
+        return self.apply(coeffs, staged)
 
     def to_host(self, result) -> np.ndarray:
         return np.asarray(result, dtype=np.uint8)
@@ -226,6 +239,14 @@ class JaxBackend(_BackendBase):
             return (self._mesh_rs.encode(arr), n)
         return self._rs.encode(staged)
 
+    def apply_staged(self, coeffs: np.ndarray, staged):
+        coeffs = np.ascontiguousarray(coeffs, dtype=np.uint8)
+        if self._mesh_rs is not None:
+            arr, n = staged
+            bits = self._rs.coeff_bits(coeffs)
+            return (self._mesh_rs.apply(bits, arr, coeffs.shape[0]), n)
+        return self._rs.apply(coeffs, staged)
+
     def to_host(self, result) -> np.ndarray:
         if self._mesh_rs is not None:
             arr, n = result
@@ -241,14 +262,7 @@ class JaxBackend(_BackendBase):
         return {i: np.asarray(v) for i, v in out.items()}
 
     def apply(self, coeffs: np.ndarray, data: np.ndarray) -> np.ndarray:
-        import jax.numpy as jnp
-
-        from ..ops.rs_jax import _apply_bits
-
-        bits = jnp.asarray(
-            self._rs._expand(np.asarray(coeffs, np.uint8)), dtype=jnp.float32
-        )
-        return np.asarray(self._rs._apply(bits, jnp.asarray(data), coeffs.shape[0]))
+        return np.asarray(self._rs.apply(coeffs, np.asarray(data, np.uint8)))
 
 
 class FallbackBackend(_BackendBase):
@@ -273,6 +287,10 @@ class FallbackBackend(_BackendBase):
         self.ctx = primary.ctx
         self.primary = primary
         self.fallback = fallback
+        # Both wrapped backends derive from the same ctx, so they share
+        # one encoding matrix; expose it like every other backend does
+        # (degraded reads precompute decode coefficients from it).
+        self.matrix = fallback.matrix
         if breaker is None:
             from ..utils.retry import CircuitBreaker
 
@@ -312,6 +330,7 @@ class FallbackBackend(_BackendBase):
     def apply(self, coeffs: np.ndarray, data: np.ndarray) -> np.ndarray:
         if self.breaker.allows():
             try:
+                faults.fire("ec.backend.device.apply")
                 out = self.primary.apply(coeffs, data)
                 self.breaker.record_success()
                 return out
@@ -334,7 +353,14 @@ class FallbackBackend(_BackendBase):
         self.fallback_batches += 1
         return self.fallback.reconstruct(shards, want=want)
 
-    # -- staged pipeline: handles are (host_batch, device_handle|None) ------
+    # -- staged pipeline --------------------------------------------------
+    #
+    # to_device handles are (host_batch, device_handle|None); dispatched
+    # handles are (kind, host_batch, device_result|None, coeffs|None) so
+    # to_host knows WHICH computation to replay on CPU when the device
+    # dies between dispatch and drain — encode_staged batches re-encode,
+    # apply_staged batches re-apply the same coefficients, both
+    # bit-identical to what the device would have produced.
 
     def to_device(self, data: np.ndarray):
         data = np.ascontiguousarray(data, dtype=np.uint8)
@@ -351,13 +377,26 @@ class FallbackBackend(_BackendBase):
         if dev is not None:
             try:
                 faults.fire("ec.backend.device.encode_staged")
-                return (host, self.primary.encode_staged(dev))
+                return ("encode", host, self.primary.encode_staged(dev), None)
             except Exception as e:
                 self._device_failed("encode_staged", e)
-        return (host, None)
+        return ("encode", host, None, None)
+
+    def apply_staged(self, coeffs: np.ndarray, staged):
+        host, dev = staged
+        coeffs = np.ascontiguousarray(coeffs, dtype=np.uint8)
+        if dev is not None:
+            try:
+                faults.fire("ec.backend.device.apply_staged")
+                return (
+                    "apply", host, self.primary.apply_staged(coeffs, dev), coeffs
+                )
+            except Exception as e:
+                self._device_failed("apply_staged", e)
+        return ("apply", host, None, coeffs)
 
     def to_host(self, result) -> np.ndarray:
-        host, dev = result
+        kind, host, dev, coeffs = result
         if dev is not None:
             try:
                 faults.fire("ec.backend.device.to_host")
@@ -366,9 +405,11 @@ class FallbackBackend(_BackendBase):
                 return out
             except Exception as e:
                 self._device_failed("to_host", e)
-        # Mid-batch failover: the host copy re-encodes on CPU,
+        # Mid-batch failover: the host copy recomputes on CPU,
         # bit-identical to what the device would have produced.
         self.fallback_batches += 1
+        if kind == "apply":
+            return self.fallback.apply(coeffs, host)
         return self.fallback.encode(host)
 
 

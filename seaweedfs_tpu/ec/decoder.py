@@ -210,11 +210,15 @@ def write_dat_file(
                 row += 1
 
         with open(tmp, "wb") as out:
-            # Shared recovery pipeline (ec/pipeline.py): shard preads in
+            # Shared recovery pipeline (ec/pipeline.py, pass-through
+            # configuration of the staged-apply driver): shard preads in
             # the reader thread overlap the sequential .dat writes in
             # the writer thread — the serial read→write loop left the
-            # output disk idle during every input read.
-            from .pipeline import run_pipeline
+            # output disk idle during every input read. There is nothing
+            # to compute here (all k data shards are on disk; a missing
+            # one is regenerated through the staged rebuild before
+            # decode starts, see ec_decode_volume).
+            from .pipeline import run_staged_apply
 
             def produce():
                 for fd, off, want in read_plan():
@@ -226,12 +230,13 @@ def write_dat_file(
                             raise ECError(f"short shard read at {off + pos}")
                         parts.append(got)
                         pos += len(got)
-                    yield parts[0] if len(parts) == 1 else b"".join(parts)
+                    yield None, parts[0] if len(parts) == 1 else b"".join(parts)
 
-            run_pipeline(
+            run_staged_apply(
+                None,
+                None,
                 produce,
-                lambda chunk: chunk,
-                out.write,
+                lambda _tag, chunk: out.write(chunk),
                 describe="ec decode pipeline",
             )
             out.flush()
@@ -249,9 +254,20 @@ def write_dat_file(
             os.close(fd)
 
 
-def ec_decode_volume(base: str, ctx=None) -> bool:
+def ec_decode_volume(base: str, ctx=None, backend=None) -> bool:
     """Shards -> normal volume. Returns False (no-op) when no live
-    needles remain. Layout and version come from the .vif."""
+    needles remain. Layout and version come from the .vif.
+
+    Degraded decode: a missing or corrupt DATA shard no longer refuses
+    or launders rot — the staged rebuild path runs first
+    (sidecar-verified verify-and-exclude, crash-safe temp+rename
+    publish, H2D/compute/D2H overlap on a device), regenerating absent
+    data shards and replacing present-but-rotten ones, healing the
+    shard set as a side effect; the de-stripe then proceeds with k
+    verified data shards on disk. The verification pass reads every
+    present shard once — decode is a maintenance op, and publishing a
+    .dat de-striped from unverified bytes would defeat the sidecar.
+    Fewer than k good shards still fails closed inside rebuild."""
     vi = VolumeInfo.maybe_load(base + ".vif") or VolumeInfo()
     if ctx is None:
         from .context import DEFAULT_EC_CONTEXT
@@ -263,9 +279,20 @@ def ec_decode_volume(base: str, ctx=None) -> bool:
     write_idx_from_ecx(base)
     dat_size = find_dat_file_size(base, vi.version)
     shard_paths = [base + ctx.to_ext(i) for i in range(ctx.data_shards)]
-    missing = [p for p in shard_paths if not os.path.exists(p)]
-    if missing:
-        raise ECError(f"missing data shards for decode: {missing}")
+    missing_ids = [
+        i for i, p in enumerate(shard_paths) if not os.path.exists(p)
+    ]
+    from .rebuild import rebuild_ec_files
+
+    # Always invoked: with nothing missing this is the sidecar
+    # verify(-and-repair-in-place) of every present shard; `only_shards`
+    # keeps absent-shard regeneration scoped to the data shards decode
+    # needs (a parity shard lost on a subset holder is not this op's
+    # business to mint).
+    rebuild_ec_files(base, ctx, backend=backend, only_shards=missing_ids)
+    still = [p for p in shard_paths if not os.path.exists(p)]
+    if still:  # pragma: no cover - rebuild either publishes or raises
+        raise ECError(f"missing data shards for decode: {still}")
     write_dat_file(base, dat_size, vi.dat_file_size, shard_paths)
     return True
 

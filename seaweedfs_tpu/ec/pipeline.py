@@ -146,6 +146,66 @@ def run_pipeline(
         raise errors[0]
 
 
+def run_staged_apply(
+    backend,
+    coeffs,
+    produce: Callable[[], Iterator],
+    consume: Callable,
+    *,
+    queue_size: int = 2,
+    join_timeout: float = 120.0,
+    describe: str = "ec staged apply",
+) -> None:
+    """The staged device `apply` driver shared by rebuild, decode, and
+    degraded reconstruction: run_pipeline where the transform stage is
+    `backend.apply_staged(coeffs, backend.to_device(batch))` — a
+    NON-BLOCKING H2D upload + device dispatch — and the writer stage
+    forces the result with `backend.to_host` before handing the host
+    uint8 matrix to `consume`. Batch N computes on the device while
+    batch N+1 uploads and batch N-1 drains, the same double-buffered
+    window `encode_staged` gave the encoder.
+
+    `produce()` yields `(tag, batch)` pairs; `consume(tag, out)` gets
+    the tag back untouched (offset bookkeeping stays with the caller).
+    `coeffs=None` is the pass-through configuration: no device
+    round-trip, the batch flows to `consume` unchanged (decode's
+    de-stripe, where reads must overlap writes but there is nothing to
+    compute). Device-memory residency bound is the same as run_pipeline:
+    up to ~2*queue_size staged batches alive at once.
+    """
+    if coeffs is None:
+        run_pipeline(
+            produce,
+            lambda item: item,
+            lambda item: consume(item[0], item[1]),
+            queue_size=queue_size,
+            join_timeout=join_timeout,
+            describe=describe,
+        )
+        return
+    coeffs = np.ascontiguousarray(coeffs, dtype=np.uint8)
+
+    def transform(item):
+        tag, batch = item
+        return tag, backend.apply_staged(coeffs, backend.to_device(batch))
+
+    def drain(item):
+        tag, handle = item
+        # Blocks until the device result is ready — while it does, the
+        # calling thread keeps dispatching the batches queued behind it.
+        out = np.ascontiguousarray(backend.to_host(handle), dtype=np.uint8)
+        consume(tag, out)
+
+    run_pipeline(
+        produce,
+        transform,
+        drain,
+        queue_size=queue_size,
+        join_timeout=join_timeout,
+        describe=describe,
+    )
+
+
 # --------------------------------------------------------------------------
 # Shard sinks: the write stage shared by encode and rebuild. Both write
 # N parallel byte streams (one per shard file) while rolling the bitrot

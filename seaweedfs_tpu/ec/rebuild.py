@@ -40,7 +40,7 @@ from .bitrot import BitrotError, BitrotProtection
 from .context import BITROT_BLOCK_SIZE, DEFAULT_EC_CONTEXT, ECContext, ECError
 from .decoder import _fsync_dir
 from .encoder import DEFAULT_BATCH
-from .pipeline import PyShardSink, make_shard_sink, run_pipeline
+from .pipeline import PyShardSink, make_shard_sink, run_pipeline, run_staged_apply
 from .volume_info import VolumeInfo
 
 
@@ -101,12 +101,20 @@ def rebuild_ec_files(
     unsafe_ignore_sidecar: bool = False,
     batch_size: int = DEFAULT_BATCH,
     only_shards: list[int] | None = None,
+    staged: bool = True,
 ) -> list[int]:
     """Regenerate missing/corrupt shard files; returns regenerated ids.
 
     `only_shards` restricts which ABSENT shards are regenerated (a
     subset-holding server must not mint local copies of shards placed on
     peers); present-but-corrupt shards are always replaced regardless.
+
+    `staged` (default) dispatches each batch through the backend's
+    staged apply (async H2D + device compute, D2H forced in the writer
+    thread) so a device rebuild overlaps transfer with compute like
+    `encode_staged`; False keeps the synchronous per-batch `apply` —
+    bit-identical by construction, kept for the bench's staged-vs-sync
+    comparison.
     """
     # Sidecar first: it records the shard ratio too, which backs up the
     # .vif for config resolution and cross-checks it.
@@ -142,8 +150,11 @@ def rebuild_ec_files(
                 f"volume config says {ctx}; refusing to rebuild"
             )
         prot = None
-    if backend is None:
-        backend = get_backend("auto", ctx.data_shards, ctx.parity_shards)
+    # Backend resolution is DEFERRED until a reconstruction target
+    # exists: the common no-op case (scrub of a healthy volume, decode's
+    # verify pass with all shards present) is pure CPU CRC work, and
+    # get_backend("auto") on a TPU host may initialize the device stack
+    # — which on a dead relay hangs (see get_backend's warning).
 
     total, k = ctx.total, ctx.data_shards
     present = [i for i in range(total) if os.path.exists(base + ctx.to_ext(i))]
@@ -264,6 +275,8 @@ def rebuild_ec_files(
                 continue
 
         targets = sorted(missing)
+        if backend is None:
+            backend = get_backend("auto", ctx.data_shards, ctx.parity_shards)
         bad_src = _attempt_rebuild(
             base, ctx, backend, prot, src, targets, shard_size,
             batch_size, chaos,
@@ -271,6 +284,7 @@ def rebuild_ec_files(
                 prot is not None and not chaos and not unsafe_ignore_sidecar
             ),
             verified_ok=verified_ok,
+            staged=staged,
         )
         if bad_src:
             # Confirmed on-disk rot in a source: verify-and-exclude says
@@ -292,6 +306,7 @@ def _attempt_rebuild(
     chaos: bool,
     inline_verify: bool,
     verified_ok: set[int] | None = None,
+    staged: bool = True,
 ) -> list[int]:
     """One pipelined reconstruction attempt. Publishes and returns []
     on success; returns confirmed-corrupt source ids for the caller to
@@ -364,7 +379,10 @@ def _attempt_rebuild(
         # Fused path: read all k sources into one (k, width) matrix
         # (inline CRC rolled while cache-hot), then a single
         # precomputed-coefficient GF(256) apply per batch — no per-batch
-        # matrix inversion, no stack copy, no dict plumbing.
+        # matrix inversion, no stack copy, no dict plumbing. The staged
+        # variant dispatches that apply through the backend's async
+        # hooks (run_staged_apply), so on a device batch N computes
+        # while N+1 uploads and N-1 drains to disk.
         rs = gf256.ReedSolomon(ctx.data_shards, ctx.parity_shards)
         coeffs = _decode_coeffs(rs.matrix, k, tuple(targets), tuple(src))
 
@@ -379,12 +397,13 @@ def _attempt_rebuild(
                         raise _SourceReadError([i]) from e
                     if rollers is not None:
                         rollers[i].update(buf[row])
-                yield buf
+                yield off, buf
 
-        def transform(buf):
-            return backend.apply(coeffs, buf)
+        def transform(item):
+            off, buf = item
+            return off, backend.apply(coeffs, buf)
 
-        def consume(out):
+        def consume(_off, out):
             out = np.ascontiguousarray(out, dtype=np.uint8)
             sink.append_rows([out[p] for p in range(len(targets))])
 
@@ -422,14 +441,28 @@ def _attempt_rebuild(
         # Shared 3-stage overlap (ec/pipeline.py): surviving-shard reads
         # / Reed-Solomon reconstruct / fused write+CRC of the
         # regenerated shards — batch N reconstructs while N+1 is read
-        # and N-1 drains to disk, same shape as the encode path.
-        run_pipeline(
-            produce,
-            transform,
-            consume,
-            join_timeout=60.0 + 4.0 * batch_size / (16 << 20),
-            describe="ec rebuild pipeline",
-        )
+        # and N-1 drains to disk, same shape as the encode path. The
+        # staged fused path additionally overlaps H2D/compute/D2H inside
+        # the reconstruct stage (device dispatch in the calling thread,
+        # result forced in the writer thread).
+        join_timeout = 60.0 + 4.0 * batch_size / (16 << 20)
+        if chaos or not staged:
+            run_pipeline(
+                produce,
+                transform,
+                consume if chaos else (lambda item: consume(*item)),
+                join_timeout=join_timeout,
+                describe="ec rebuild pipeline",
+            )
+        else:
+            run_staged_apply(
+                backend,
+                coeffs,
+                produce,
+                consume,
+                join_timeout=join_timeout,
+                describe="ec rebuild pipeline",
+            )
     except _SourceReadError as e:
         _cleanup_temps()
         if inline_verify:

@@ -8,7 +8,12 @@ Design points:
 - incremental + resumable: verification walks (shard, block) positions
   with a persisted cursor (<base>.scrubpos), so a restart resumes
   mid-volume instead of rescanning from zero; a budget (`max_blocks`)
-  lets the daemon time-slice huge volumes across wakeups.
+  lets the daemon time-slice huge volumes across wakeups. On a v2
+  sidecar the walk runs at 64 KiB LEAF granularity: pauses resume
+  mid-block, and a mismatch is pinned to its leaf (recorded in the
+  report and in a .bad.leaves forensic marker next to the quarantine)
+  instead of condemning an anonymous 16 MiB block. v1 sidecars keep
+  the block walk.
 - rate-limited: a token bucket caps read bandwidth so scrubbing never
   starves foreground traffic.
 - quarantine, never trust: a corrupt shard file is renamed to
@@ -84,16 +89,21 @@ class RateLimiter:
 
 @dataclass
 class ScrubCursor:
-    """Resumable (shard, block) position, pinned to a sidecar generation
-    so a re-encode restarts the walk. Carries the corrupt shards found
-    in earlier budget slices of the same pass — quarantine only happens
+    """Resumable (shard, block[, leaf]) position, pinned to a sidecar
+    generation so a re-encode restarts the walk. Carries the corrupt
+    shards (and, on v2 sidecars, their corrupt leaf indices) found in
+    earlier budget slices of the same pass — quarantine only happens
     once the pass completes, so mid-pass findings must survive a pause
-    (and a process restart)."""
+    (and a process restart). `leaf` is the position WITHIN `block` when
+    the sidecar records leaves, letting a budget pause land mid-block
+    instead of rounding a 16 MiB block down to its start."""
 
     generation: int = 0
     shard: int = 0
     block: int = 0
+    leaf: int = 0
     corrupt: list[int] = field(default_factory=list)
+    corrupt_leaves: dict[int, list[int]] = field(default_factory=dict)
 
     @classmethod
     def load(cls, base: str) -> "ScrubCursor | None":
@@ -104,7 +114,12 @@ class ScrubCursor:
                 generation=int(doc["generation"]),
                 shard=int(doc["shard"]),
                 block=int(doc["block"]),
+                leaf=int(doc.get("leaf", 0)),
                 corrupt=[int(x) for x in doc.get("corrupt", [])],
+                corrupt_leaves={
+                    int(k): [int(x) for x in v]
+                    for k, v in doc.get("corrupt_leaves", {}).items()
+                },
             )
         except (OSError, ValueError, KeyError):
             return None
@@ -117,7 +132,11 @@ class ScrubCursor:
                     "generation": self.generation,
                     "shard": self.shard,
                     "block": self.block,
+                    "leaf": self.leaf,
                     "corrupt": self.corrupt,
+                    "corrupt_leaves": {
+                        str(k): v for k, v in self.corrupt_leaves.items()
+                    },
                 }
             ).encode(),
         )
@@ -135,9 +154,14 @@ class ScrubReport:
     base: str
     complete: bool = False  # full pass finished (vs budget-paused)
     checked_blocks: int = 0
+    checked_leaves: int = 0  # v2 sidecars: 64 KiB granules walked
     checked_bytes: int = 0
     checked_shards: list[int] = field(default_factory=list)  # ids walked
     corrupt_shards: list[int] = field(default_factory=list)
+    # v2 sidecars: shard -> leaf indices that mismatched (the forensic
+    # leaf-granular verdict; quarantine is still whole-shard, but the
+    # .bad marker gains a .leaves sidecar naming the rotten 64 KiB)
+    corrupt_leaves: dict[int, list[int]] = field(default_factory=dict)
     missing_shards: list[int] = field(default_factory=list)
     quarantined: list[str] = field(default_factory=list)
     rebuilt: list[int] = field(default_factory=list)
@@ -178,7 +202,7 @@ def scrub_ec_volume(
     repair: bool = True,
     rate_limiter: RateLimiter | None = None,
     resumable: bool = True,
-    max_blocks: int | None = None,
+    max_blocks: float | None = None,
     rebuild_policy: RetryPolicy = DEFAULT_REBUILD_POLICY,
     breaker: CircuitBreaker | None = None,
     expected_shards: list[int] | None = None,
@@ -230,9 +254,16 @@ def scrub_ec_volume(
     if cursor is None or cursor.generation != prot.generation:
         cursor = ScrubCursor(generation=prot.generation)
     # Verdicts carried from earlier budget slices of this pass; they are
-    # re-verified at completion (see below) before any quarantine.
-    carried = set(cursor.corrupt)
+    # re-verified at completion (see below) before any quarantine. A
+    # shard whose slice PAUSED mid-walk carries only corrupt_leaves (it
+    # never completed, so it is not in cursor.corrupt) — its eventual
+    # condemnation rests on those stale leaves, so it needs the same
+    # completion re-verify as a fully-carried verdict.
+    carried = set(cursor.corrupt) | set(cursor.corrupt_leaves)
     report.corrupt_shards.extend(cursor.corrupt)
+    report.corrupt_leaves.update(
+        {s: list(ls) for s, ls in cursor.corrupt_leaves.items()}
+    )
 
     want_local = (
         set(range(ctx.total)) if expected_shards is None else set(expected_shards)
@@ -250,43 +281,79 @@ def scrub_ec_volume(
         if shard_id < cursor.shard:
             report.checked_shards.append(shard_id)
             continue  # verified in an earlier slice of this pass
-        start_block = cursor.block if shard_id == cursor.shard else 0
-        expected = prot.shard_crcs[shard_id]
+        # Finest granularity the sidecar records: v2 walks its 64 KiB
+        # leaves (so a budget pause resumes MID-block and a mismatch is
+        # pinned to one leaf), v1 keeps today's 16 MiB block walk. The
+        # block budget stays denominated in blocks either way — a leaf
+        # read consumes its byte-proportional fraction.
+        gsize, gcrcs = prot.verify_granularity(shard_id)
+        leafwise = gsize != prot.block_size
+        per_block = prot.block_size // gsize if leafwise else 1
+        granule_cost = gsize / prot.block_size if leafwise else 1
+        start_g = 0
+        if shard_id == cursor.shard:
+            start_g = cursor.block * per_block + (
+                cursor.leaf if leafwise else 0
+            )
         corrupt = False
         try:
             if os.path.getsize(path) != prot.shard_sizes[shard_id]:
                 corrupt = True  # truncation/growth is corruption
             else:
                 with open(path, "rb") as f:
-                    f.seek(start_block * prot.block_size)
-                    for bi in range(start_block, len(expected)):
+                    f.seek(start_g * gsize)
+                    for g in range(start_g, len(gcrcs)):
                         if budget <= 0:
-                            cursor.shard, cursor.block = shard_id, bi
+                            cursor.shard = shard_id
+                            cursor.block, cursor.leaf = divmod(g, per_block)
                             if resumable:
                                 cursor.save(base)
                             paused = True
                             break
-                        block = f.read(prot.block_size)
+                        block = f.read(gsize)
                         block = faults.mutate(
                             "ec.scrub.read_block", block, path=path, shard=shard_id
                         )
                         if rate_limiter is not None:
                             rate_limiter.consume(len(block))
-                        report.checked_blocks += 1
+                        if leafwise:
+                            report.checked_leaves += 1
+                            if (g + 1) % per_block == 0 or g + 1 == len(gcrcs):
+                                report.checked_blocks += 1
+                        else:
+                            report.checked_blocks += 1
                         report.checked_bytes += len(block)
-                        budget -= 1
-                        if crc32c(block) != expected[bi]:
+                        budget -= granule_cost
+                        if crc32c(block) != gcrcs[g]:
                             corrupt = True
-                            break
+                            if not leafwise:
+                                break  # v1: one verdict per shard
+                            # Leafwise walks KEEP SCANNING on a mismatch:
+                            # the .bad.leaves forensic marker (and any
+                            # future partial repair) needs EVERY rotten
+                            # leaf, not just the first — a corrupt shard
+                            # costs one full read, which the v1 upfront
+                            # verify paid anyway.
+                            report.corrupt_leaves.setdefault(
+                                shard_id, []
+                            ).append(g)
+                            cursor.corrupt_leaves.setdefault(
+                                shard_id, []
+                            ).append(g)
         except OSError:
             corrupt = True  # unreadable = untrustworthy RS input
         if paused:
             break
+        if leafwise and cursor.corrupt_leaves.get(shard_id):
+            # Bad leaves found in an EARLIER budget slice of this shard
+            # still condemn it, even if this slice's resumed tail read
+            # clean.
+            corrupt = True
         if corrupt:
             report.corrupt_shards.append(shard_id)
             cursor.corrupt.append(shard_id)
         report.checked_shards.append(shard_id)
-        cursor.shard, cursor.block = shard_id + 1, 0
+        cursor.shard, cursor.block, cursor.leaf = shard_id + 1, 0, 0
         # Persist progress only when a mid-pass pause is possible at all
         # (a block budget is set): an unbounded pass can never resume,
         # so per-shard fsync'd cursor writes would be pure I/O overhead
@@ -304,18 +371,55 @@ def scrub_ec_volume(
     # have been repaired (ec.scrub -repair, ec.rebuild) or removed since
     # its slice ran. Re-verify before trusting — quarantining a freshly
     # rebuilt good shard would undo a repair. The re-read honors the
-    # same token bucket as the walk (carried shards can be multi-GB).
+    # same token bucket as the walk (carried shards can be multi-GB);
+    # a leaf-pinned verdict re-reads ONLY the flagged 64 KiB leaves
+    # instead of streaming the whole shard.
+    def _leaves_still_bad(path: str, sid: int, leaves: list[int]) -> bool:
+        if os.path.getsize(path) != prot.shard_sizes[sid]:
+            return True
+        lsize, lcrcs = prot.verify_granularity(sid)
+        with open(path, "rb") as f:
+            for li in leaves:
+                f.seek(li * lsize)
+                chunk = f.read(lsize)
+                if rate_limiter is not None:
+                    rate_limiter.consume(len(chunk))
+                if li >= len(lcrcs) or crc32c(chunk) != lcrcs[li]:
+                    return True
+        return False
+
     for sid in [s for s in report.corrupt_shards if s in carried]:
         path = base + ctx.to_ext(sid)
+        flagged = report.corrupt_leaves.get(sid)
         try:
-            still_bad = bool(
-                prot.verify_shard_file(
-                    path,
-                    sid,
-                    on_block=rate_limiter.consume if rate_limiter else None,
-                    stop_early=True,
+            if flagged and prot.has_leaves:
+                still_bad = _leaves_still_bad(path, sid, flagged)
+                if not still_bad:
+                    # Flagged leaves read clean = the shard was repaired
+                    # since its slice — but the slice's walk stopped at
+                    # the first bad leaf, so the rest of the shard was
+                    # never seen. Full verify before CLEARING a verdict;
+                    # the leaf fast path only short-circuits confirming
+                    # one (still-rotten shards stay cheap).
+                    still_bad = bool(
+                        prot.verify_shard_file(
+                            path,
+                            sid,
+                            on_block=(
+                                rate_limiter.consume if rate_limiter else None
+                            ),
+                            stop_early=True,
+                        )
+                    )
+            else:
+                still_bad = bool(
+                    prot.verify_shard_file(
+                        path,
+                        sid,
+                        on_block=rate_limiter.consume if rate_limiter else None,
+                        stop_early=True,
+                    )
                 )
-            )
         except FileNotFoundError:
             still_bad = False  # gone: nothing to quarantine; it is
             # already in missing_shards if this server should hold it
@@ -323,6 +427,7 @@ def scrub_ec_volume(
             still_bad = True
         if not still_bad:
             report.corrupt_shards.remove(sid)
+            report.corrupt_leaves.pop(sid, None)
 
     # ---- fail-closed gates mirror rebuild's verify-and-exclude rules ----
     if len(report.corrupt_shards) > ctx.parity_shards:
@@ -348,7 +453,25 @@ def scrub_ec_volume(
         except FileNotFoundError:
             continue  # vanished since re-verify; missing-walk owns it now
         report.quarantined.append(dest)
-        log.warning("quarantined corrupt shard %s -> %s", path, dest)
+        leaves = report.corrupt_leaves.get(shard_id)
+        if leaves and prot.has_leaves:
+            # Leaf-granular quarantine marker: which 64 KiB regions of
+            # the .bad forensic copy actually mismatched — an operator
+            # (or a future partial-repair) inspects those offsets
+            # instead of diffing a multi-GB shard.
+            try:
+                atomic_write(
+                    dest + ".leaves",
+                    json.dumps(
+                        {"leaf_size": prot.leaf_size, "leaves": sorted(leaves)}
+                    ).encode(),
+                )
+            except OSError:  # forensics must not block the repair
+                pass
+        log.warning(
+            "quarantined corrupt shard %s -> %s%s", path, dest,
+            f" (leaves {sorted(leaves)})" if leaves else "",
+        )
         if on_quarantine is not None:
             on_quarantine(shard_id, dest)
 
@@ -402,6 +525,10 @@ def scrub_ec_volume(
                 os.unlink(bad_path)
             except OSError:
                 continue
+            try:  # the leaf forensic marker retires with its .bad
+                os.unlink(bad_path + ".leaves")
+            except OSError:
+                pass
             fsync_dir(bad_path)
             report.aged_out.append(bad_path)
             log.info("retired quarantine %s (age %.0fs)", bad_path, age)
@@ -423,7 +550,7 @@ class ScrubDaemon:
         store,
         interval: float = 3600.0,
         bytes_per_sec: float = 64 << 20,
-        max_blocks_per_volume: int | None = None,
+        max_blocks_per_volume: float | None = None,
         repair: bool = True,
         breaker: CircuitBreaker | None = None,
         backend=None,

@@ -10,6 +10,7 @@ mutation path.
 from __future__ import annotations
 
 import base64
+import dataclasses
 import json
 import queue
 import threading
@@ -18,6 +19,11 @@ from typing import Optional
 import requests
 
 from ..pb import filer_pb2 as fpb
+from ..utils.retry import RetryError, RetryPolicy, retry_call
+
+# Delivery backoff: quick first retry, bounded tail — sinks are remote
+# HTTP/broker endpoints whose blips last milliseconds to seconds.
+DELIVERY_POLICY = RetryPolicy(max_attempts=3, base_delay=0.5, max_delay=5.0)
 
 
 def event_to_json(ev: fpb.FullEventNotification) -> dict:
@@ -60,10 +66,19 @@ def json_to_event(rec: dict) -> Optional[fpb.FullEventNotification]:
 
 class _AsyncNotifier:
     """Bounded queue + delivery thread: the mutation path only ever
-    enqueues; a stalled sink can never block filer writes."""
+    enqueues; a stalled sink can never block filer writes. Delivery
+    retries run under the unified RetryPolicy (utils/retry.py), with
+    the stop event as the sleep so close() aborts a backoff wait."""
 
-    def __init__(self, max_queue: int = 10_000, retries: int = 3):
-        self.retries = retries
+    def __init__(
+        self,
+        max_queue: int = 10_000,
+        retries: int = 3,
+        policy: RetryPolicy | None = None,
+    ):
+        if policy is None:
+            policy = dataclasses.replace(DELIVERY_POLICY, max_attempts=retries)
+        self.policy = policy
         self._q: "queue.Queue" = queue.Queue(maxsize=max_queue)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -89,14 +104,19 @@ class _AsyncNotifier:
                 self.dropped += 1
 
     def _deliver_with_retry(self, payload: dict) -> bool:
-        for attempt in range(self.retries):
-            try:
-                if self._deliver(payload):
-                    return True
-                return False  # permanent rejection: don't retry
-            except Exception:
-                self._stop.wait(0.5 * (attempt + 1))
-        return False
+        # _deliver: True = delivered, False = PERMANENT rejection (no
+        # retry — retry_call just returns it), exception = transient.
+        try:
+            return bool(
+                retry_call(
+                    lambda: self._deliver(payload),
+                    self.policy,
+                    sleep=self._stop.wait,
+                    describe="notification delivery",
+                )
+            )
+        except RetryError:
+            return False
 
     def _deliver(self, payload: dict) -> bool:
         raise NotImplementedError

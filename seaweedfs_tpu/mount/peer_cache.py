@@ -23,11 +23,25 @@ import requests
 
 from ..pb import filer_pb2 as fpb
 from ..utils.chunk_cache import ChunkCache
+from ..utils.retry import Backoff, RetryPolicy
 
 PEERS_KEY = b"mount.peers"
 ANNOUNCE_INTERVAL = 5.0
 PEER_TTL = 30.0
 PEER_TIMEOUT = 2.0  # a slow peer must not stall reads; fall through
+
+# Announce-loop backoff while the filer is down: walk up from the
+# normal cadence instead of hammering a restarting filer every 5 s,
+# but never past the peer TTL — recovery must re-announce before other
+# mounts would have to expire (and re-learn) this one anyway. Jitter
+# is applied ON TOP of max_delay, so the cap is derated to keep the
+# worst-case jittered delay within the TTL.
+ANNOUNCE_POLICY = RetryPolicy(
+    max_attempts=4,
+    base_delay=ANNOUNCE_INTERVAL,
+    max_delay=PEER_TTL / 1.2,
+    jitter=0.2,
+)
 
 
 def hrw_owner(fid: str, peer_ids: list[str]) -> str:
@@ -103,11 +117,16 @@ class PeerChunkCache:
     # ---------------------------------------------------------- announce
 
     def _announce_loop(self) -> None:
-        while not self._stop.wait(ANNOUNCE_INTERVAL):
+        backoff = Backoff(ANNOUNCE_POLICY)
+        delay = ANNOUNCE_INTERVAL
+        while not self._stop.wait(delay):
             try:
                 self._announce()
             except Exception:  # noqa: BLE001 — filer may be restarting
-                pass
+                delay = backoff.next_delay()
+            else:
+                backoff.reset()
+                delay = ANNOUNCE_INTERVAL
 
     def _announce(self) -> None:
         stub = self._stub()

@@ -149,6 +149,13 @@ class RSJax:
             collections.OrderedDict()
         )
         self._decode_cache_limit = 64
+        # Raw-coefficient apply cache (the rebuild/degraded-read path
+        # precomputes its decode coefficients once per shard-loss set and
+        # then applies them to every batch — the expansion must not be
+        # paid per batch).
+        self._coeff_bits_cache: "collections.OrderedDict[bytes, np.ndarray]" = (
+            collections.OrderedDict()
+        )
 
     # -- encode ------------------------------------------------------------
 
@@ -224,6 +231,36 @@ class RSJax:
         data = jnp.stack([jnp.asarray(shards[i], dtype=jnp.uint8) for i in src])
         out = self._apply(bits, data, len(missing))
         return {idx: out[i] for i, idx in enumerate(missing)}
+
+    # -- general apply -----------------------------------------------------
+
+    def coeff_bits(self, coeffs: np.ndarray) -> np.ndarray:
+        """Expanded bit-matrix for an arbitrary (m_out x k) GF(256)
+        coefficient matrix, cached by content (host numpy; converted at
+        call time like _parity_bits so construction stays hang-free)."""
+        coeffs = np.ascontiguousarray(coeffs, dtype=np.uint8)
+        key = coeffs.shape[0].to_bytes(4, "little") + coeffs.tobytes()
+        cached = self._coeff_bits_cache.get(key)
+        if cached is not None:
+            self._coeff_bits_cache.move_to_end(key)
+            return cached
+        bits = np.asarray(self._expand(coeffs), dtype=_ACC_DTYPE)
+        self._coeff_bits_cache[key] = bits
+        if len(self._coeff_bits_cache) > self._decode_cache_limit:
+            self._coeff_bits_cache.popitem(last=False)
+        return bits
+
+    def apply(self, coeffs: np.ndarray, data) -> jax.Array:
+        """out[r] = sum_j coeffs[r,j] * data[j] over GF(256), dispatched
+        on the device WITHOUT blocking (the staged-apply primitive: the
+        caller decides when to force the result with np.asarray)."""
+        coeffs = np.ascontiguousarray(coeffs, dtype=np.uint8)
+        if coeffs.ndim != 2 or coeffs.shape[1] != len(data):
+            raise ValueError(
+                f"coeffs {coeffs.shape} do not match {len(data)} data rows"
+            )
+        bits = jnp.asarray(self.coeff_bits(coeffs))
+        return self._apply(bits, jnp.asarray(data, dtype=jnp.uint8), coeffs.shape[0])
 
     def verify(self, shards) -> bool:
         shards = jnp.asarray(shards, dtype=jnp.uint8)

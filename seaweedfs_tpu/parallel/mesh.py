@@ -78,6 +78,10 @@ class MeshRS:
         self.rs = rs
         self.mesh = mesh
         self.n_devices = mesh.devices.size
+        # jitted shard_map applies, keyed by (m_out, k): the decode
+        # coefficient SHAPE is stable per shard-loss set, so each key
+        # compiles once and the bit-matrix rides in as a replicated arg.
+        self._apply_jits: dict = {}
         self._repl = replicated(mesh)
         self._cols = column_sharding(mesh)
 
@@ -104,6 +108,40 @@ class MeshRS:
     def encode(self, staged):
         """Sharded parity dispatch; returns a device array handle."""
         return self._encode(staged)
+
+    def apply(self, bits: np.ndarray, staged, m_out: int):
+        """General GF(256) apply over the column mesh: `bits` is the
+        expanded (8*m_out x 8k) bit-matrix, replicated on every chip
+        (like the parity matrix in encode), `staged` the column-sharded
+        data. Column-independent like encode, so the split is bit-exact
+        and no collectives appear. Returns a device handle (async)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        try:
+            from jax import shard_map
+        except ImportError:  # pre-0.8 jax
+            from jax.experimental.shard_map import shard_map
+
+        key = (int(m_out), int(staged.shape[0]))
+        fn = self._apply_jits.get(key)
+        if fn is None:
+            rs = self.rs
+
+            def _local(b, d):
+                return rs._apply(b, d, m_out)
+
+            fn = jax.jit(
+                shard_map(
+                    _local,
+                    mesh=self.mesh,
+                    in_specs=(P(), P(None, BLOCK_AXIS)),
+                    out_specs=P(None, BLOCK_AXIS),
+                )
+            )
+            self._apply_jits[key] = fn
+        return fn(jnp.asarray(bits), staged)
 
     def global_checksum(self, sharded) -> int:
         """psum over the mesh of a uint32 sum — the cheap cross-device

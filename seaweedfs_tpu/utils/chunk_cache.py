@@ -46,6 +46,17 @@ class ChunkCache:
             if old is not None:
                 self._bytes -= len(old)
 
+    def drop_prefix(self, prefix: str) -> int:
+        """Drop every entry whose key starts with `prefix` (targeted
+        invalidation — e.g. one shard's extents in the EC interval
+        cache); returns how many were dropped. O(n) over keys, fine for
+        a byte-bounded cache of large values."""
+        with self._lock:
+            doomed = [k for k in self._data if k.startswith(prefix)]
+            for k in doomed:
+                self._bytes -= len(self._data.pop(k))
+            return len(doomed)
+
     def clear(self) -> None:
         """Drop every entry (bulk invalidation — e.g. the EC interval
         cache on shard remount/rebuild/delete). Hit/miss counters are
