@@ -24,6 +24,12 @@ from .context import (
     ECContext,
     ECError,
 )
+from .device_queue import (
+    DeviceQueue,
+    DeviceStream,
+    configure as configure_device_queue,
+    for_backend as device_queue_for_backend,
+)
 from .decoder import (
     ec_decode_volume,
     find_dat_file_size,

@@ -288,8 +288,12 @@ def ec_decode_volume(base: str, ctx=None, backend=None) -> bool:
     # verify(-and-repair-in-place) of every present shard; `only_shards`
     # keeps absent-shard regeneration scoped to the data shards decode
     # needs (a parity shard lost on a subset holder is not this op's
-    # business to mint).
-    rebuild_ec_files(base, ctx, backend=backend, only_shards=missing_ids)
+    # business to mint). The self-heal runs as a RECOVERY stream on the
+    # shared device queue: colocated foreground encode/reads go first.
+    rebuild_ec_files(
+        base, ctx, backend=backend, only_shards=missing_ids,
+        priority="recovery",
+    )
     still = [p for p in shard_paths if not os.path.exists(p)]
     if still:  # pragma: no cover - rebuild either publishes or raises
         raise ECError(f"missing data shards for decode: {still}")

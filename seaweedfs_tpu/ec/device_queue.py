@@ -1,0 +1,458 @@
+"""Shared per-chip device-queue scheduler for the EC compute pipeline.
+
+Before this module every staged-apply call site (encode, rebuild,
+decode self-heal, wide degraded reads) drove its own private in-flight
+window against the device, so a background rebuild and a foreground
+encode on the same chip serialized at the JAX runtime's mercy — or
+fought for HBM with two uncoordinated windows. Haystack-style stores
+avoid exactly this by prioritizing serving traffic over repair; the
+ROADMAP named the shared scheduler as the open perf item from PR 3.
+
+Model
+-----
+
+One `DeviceQueue` per backend instance ("per chip": backends are
+lru_cached singletons per (name, k, m)). Producers open a
+`DeviceStream` tagged with a priority class and submit batches through
+it; the queue admits batch dispatches (the H2D + device-dispatch step)
+one at a time under a policy, and bounds the TOTAL number of in-flight
+device batches across all streams (`window` — the device-memory
+residency bound that used to be per call site).
+
+Priority classes, highest first:
+
+- ``foreground`` — encode, degraded reads (serving traffic);
+- ``recovery``  — rebuild, decode self-heal (restore redundancy);
+- ``scrub``     — scrub-initiated repair (background hygiene).
+
+Admission is strict-priority with a weighted-deficit minimum share for
+the background classes: every byte admitted for a higher class banks
+``share/(1-share)`` bytes of credit for each LOWER class that has work
+waiting; a lower class whose credit covers its head batch is admitted
+ahead of the higher class. Under saturation each background class
+therefore gets ~``share`` of admitted bytes (no starvation), while an
+arriving foreground batch goes ahead of every queued background batch
+that is not yet "due" (batch-granularity preemption: a long rebuild
+window can no longer head-of-line-block an encode — the rebuild yields
+the H2D slot at its next batch boundary). ``share=0`` degrades to
+strict priority for that class.
+
+Fault semantics are unchanged and PER STREAM: the queue never touches
+batch payloads or results, so a FallbackBackend device death between
+dispatch and drain replays only the dying stream's in-flight batches on
+CPU (the carried host copies), other streams keep the device until the
+shared breaker trips, and bit-identity of every stream's output to the
+synchronous apply holds by construction. A stream that dies releases
+its window slots (``DeviceStream.close`` is leak-proof), so one
+aborted producer can never wedge the chip for everyone else.
+
+Knobs ride in through :func:`configure` (server wiring:
+``ec_device_queue``, per-class shares, window) and per-class
+depth/wait/throughput counters surface through :func:`stats_snapshot`
+and the Prometheus registry (``sw_ec_queue_*``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from collections import deque
+
+from ..utils import metrics as _M
+from .context import ECError
+
+# Highest priority first; admission prefers earlier classes.
+PRIORITIES = ("foreground", "recovery", "scrub")
+
+# Minimum admitted-byte share per background class under saturation.
+# Small on purpose: this is a SERVING store — repair proceeds, but
+# foreground keeps ~90% of the chip when it wants it (the bench
+# acceptance bar is foreground >= 85% of isolated throughput with a
+# concurrent rebuild stream still making progress).
+DEFAULT_SHARES = {"recovery": 0.10, "scrub": 0.02}
+
+# Default bound on in-flight device batches across ALL streams of one
+# chip. PR 3's per-call-site windows allowed ~2*queue_size = 4 staged
+# batches each; the shared window keeps the same residency for the chip
+# as one saturated call site used to claim.
+DEFAULT_WINDOW = 4
+
+# Credit never banks more than this many bytes per class: a background
+# class idle through a long foreground burst must not repay itself with
+# an equally long background burst afterwards.
+CREDIT_CAP_BYTES = 256 << 20
+
+# Admission liveness bound. Window slots are freed by OTHER streams'
+# drain threads; a stream wedged in to_host against a hung device holds
+# its slots and (unlike the pre-scheduler private windows) would freeze
+# every other stream's dispatch on the chip, silently and forever —
+# run_pipeline's join_timeout can never fire for a thread stuck INSIDE
+# the transform stage. Past this deadline admission raises instead:
+# a loud per-stream ECError (callers fail/retry/fall back) beats a
+# chip-wide freeze with no error. Generous on purpose — only a truly
+# wedged chip waits minutes for a slot.
+DEFAULT_ADMIT_TIMEOUT = 300.0
+
+_queue_depth = _M.REGISTRY.gauge(
+    "sw_ec_queue_depth", "EC device-queue waiting batches", ("cls",)
+)
+_queue_inflight = _M.REGISTRY.gauge(
+    "sw_ec_queue_inflight", "EC device-queue in-flight batches", ("cls",)
+)
+_queue_admitted = _M.REGISTRY.counter(
+    "sw_ec_queue_admitted_total", "EC device-queue admitted batches", ("cls",)
+)
+_queue_admitted_bytes = _M.REGISTRY.counter(
+    "sw_ec_queue_admitted_bytes_total",
+    "EC device-queue admitted bytes", ("cls",),
+)
+_queue_wait_seconds = _M.REGISTRY.counter(
+    "sw_ec_queue_wait_seconds_total",
+    "EC device-queue admission wait", ("cls",),
+)
+
+
+class _Waiter:
+    __slots__ = ("priority", "nbytes", "t_submit")
+
+    def __init__(self, priority: str, nbytes: int, t_submit: float):
+        self.priority = priority
+        self.nbytes = nbytes
+        self.t_submit = t_submit
+
+
+class Ticket:
+    """One admitted (in-flight) batch; released after to_host drains it
+    (or the stream dies). Idempotent release — close() may race a drain
+    thread's finally."""
+
+    __slots__ = ("priority", "nbytes", "released")
+
+    def __init__(self, priority: str, nbytes: int):
+        self.priority = priority
+        self.nbytes = nbytes
+        self.released = False
+
+
+class ClassStats:
+    __slots__ = (
+        "submitted", "admitted", "admitted_bytes", "drained",
+        "drained_bytes", "wait_s_total", "wait_s_max", "inflight",
+    )
+
+    def __init__(self):
+        self.submitted = 0
+        self.admitted = 0
+        self.admitted_bytes = 0
+        self.drained = 0
+        self.drained_bytes = 0
+        self.wait_s_total = 0.0
+        self.wait_s_max = 0.0
+        self.inflight = 0
+
+    def as_dict(self, depth: int) -> dict:
+        return {
+            "depth": depth,
+            "inflight": self.inflight,
+            "submitted": self.submitted,
+            "admitted": self.admitted,
+            "admitted_bytes": self.admitted_bytes,
+            "drained": self.drained,
+            "drained_bytes": self.drained_bytes,
+            "wait_s_total": round(self.wait_s_total, 6),
+            "wait_s_max": round(self.wait_s_max, 6),
+        }
+
+
+class DeviceStream:
+    """One producer's tagged batch stream into a DeviceQueue. Not
+    thread-safe for concurrent dispatch (each pipeline dispatches from
+    one thread), but release/close may run from the drain thread."""
+
+    def __init__(self, queue: "DeviceQueue", priority: str, label: str = ""):
+        self.queue = queue
+        self.priority = priority
+        self.label = label
+        self._outstanding: set[Ticket] = set()
+        self._lock = threading.Lock()
+
+    def dispatch(self, fn, nbytes: int):
+        """Block until this stream's batch is admitted under the queue
+        policy, then run `fn()` (the caller's H2D upload + non-blocking
+        device dispatch) and return ``(ticket, handle)``. The window
+        slot is held until :meth:`release` — call it after `to_host`
+        completes (success OR failure). If `fn` itself raises (device
+        refused the dispatch; FallbackBackend turns that into a CPU
+        handle instead, so this is the raw-backend path), the slot is
+        released before the exception propagates."""
+        ticket = self.queue._admit(self.priority, nbytes)
+        with self._lock:
+            self._outstanding.add(ticket)
+        ok = False
+        try:
+            handle = fn()
+            ok = True
+        finally:
+            if not ok:
+                self.release(ticket)
+        return ticket, handle
+
+    def release(self, ticket: Ticket) -> None:
+        with self._lock:
+            self._outstanding.discard(ticket)
+        self.queue._release(ticket)
+
+    def close(self) -> None:
+        """Release any slots this stream still holds — the leak-proofing
+        for a pipeline that aborted with batches parked in its write
+        queue (whose drain stage will never run)."""
+        with self._lock:
+            leftover = list(self._outstanding)
+            self._outstanding.clear()
+        for t in leftover:
+            self.queue._release(t)
+
+    def __enter__(self) -> "DeviceStream":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class DeviceQueue:
+    """Priority-multiplexed admission scheduler for one chip (one
+    backend instance). See the module docstring for the policy."""
+
+    def __init__(
+        self,
+        window: int = DEFAULT_WINDOW,
+        shares: dict[str, float] | None = None,
+        clock=time.monotonic,
+        admit_timeout: float = DEFAULT_ADMIT_TIMEOUT,
+    ):
+        self.window = max(1, int(window))
+        self.admit_timeout = float(admit_timeout)
+        self.shares = dict(DEFAULT_SHARES)
+        if shares:
+            for cls, s in shares.items():
+                if cls not in PRIORITIES:
+                    raise ECError(f"unknown priority class {cls!r}")
+                self.shares[cls] = min(max(float(s), 0.0), 0.9)
+        self._cond = threading.Condition()
+        self._waiters: dict[str, deque[_Waiter]] = {
+            c: deque() for c in PRIORITIES
+        }
+        self._credit: dict[str, float] = {c: 0.0 for c in PRIORITIES}
+        self._inflight = 0
+        self._stats: dict[str, ClassStats] = {c: ClassStats() for c in PRIORITIES}
+        self._clock = clock
+        # Liveness signal for the admission deadline: bumped on every
+        # admit AND release. A waiter past its deadline while this keeps
+        # moving is merely bypassed (e.g. share=0 strict priority under
+        # sustained foreground) — that is the configured behavior, not a
+        # wedge; only a chip with NO progress for the whole window
+        # raises.
+        self._last_progress = clock()
+
+    # ------------------------------------------------------------ public
+
+    def stream(self, priority: str, label: str = "") -> DeviceStream:
+        if priority not in PRIORITIES:
+            raise ECError(
+                f"unknown priority class {priority!r} (want one of {PRIORITIES})"
+            )
+        return DeviceStream(self, priority, label)
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {
+                c: self._stats[c].as_dict(len(self._waiters[c]))
+                for c in PRIORITIES
+            }
+
+    @property
+    def inflight(self) -> int:
+        with self._cond:
+            return self._inflight
+
+    # ------------------------------------------------------------ policy
+
+    def _pick(self) -> _Waiter | None:
+        """Next admissible waiter (under self._cond). Only head-of-class
+        waiters are eligible, so per-stream FIFO order is preserved by
+        construction."""
+        if self._inflight >= self.window:
+            return None
+        nonempty = [c for c in PRIORITIES if self._waiters[c]]
+        if not nonempty:
+            return None
+        # A lower class whose banked credit covers its head batch is due
+        # ahead of the best class — the minimum-share guarantee. Among
+        # due classes, the higher-priority one wins (recovery > scrub).
+        for c in nonempty[1:]:
+            if self._credit[c] >= self._waiters[c][0].nbytes:
+                return self._waiters[c][0]
+        return self._waiters[nonempty[0]][0]
+
+    def _admit(self, priority: str, nbytes: int) -> Ticket:
+        nbytes = max(int(nbytes), 1)
+        w = _Waiter(priority, nbytes, self._clock())
+        with self._cond:
+            self._waiters[priority].append(w)
+            st = self._stats[priority]
+            st.submitted += 1
+            _queue_depth.inc(cls=priority)
+            while self._pick() is not w:
+                deadline = (
+                    max(w.t_submit, self._last_progress) + self.admit_timeout
+                )
+                left = deadline - self._clock()
+                if left <= 0 or not self._cond.wait(timeout=left):
+                    if self._pick() is w:  # admitted at the wire
+                        break
+                    if self._clock() - self._last_progress < self.admit_timeout:
+                        continue  # bypassed, not wedged: keep waiting
+                    # Liveness escape: window slots are freed by other
+                    # streams' drains; a full deadline with NO admit or
+                    # release anywhere means the chip is wedged (e.g. a
+                    # stream stuck in to_host against a hung device
+                    # holding every slot). Fail THIS stream loudly
+                    # instead of freezing the whole chip's dispatch
+                    # silently forever.
+                    self._waiters[priority].remove(w)
+                    _queue_depth.dec(cls=priority)
+                    self._cond.notify_all()
+                    raise ECError(
+                        f"device queue admission timed out after "
+                        f"{self.admit_timeout:.0f}s without progress "
+                        f"({priority}, inflight="
+                        f"{self._inflight}/{self.window}): chip wedged?"
+                    )
+            popped = self._waiters[priority].popleft()
+            assert popped is w  # only heads are ever picked
+            _queue_depth.dec(cls=priority)
+            # Bank minimum-share credit for every lower class with work
+            # waiting; spend this class's own credit (floored at 0 so a
+            # work-conserving free ride never becomes debt).
+            idx = PRIORITIES.index(priority)
+            for lower in PRIORITIES[idx + 1 :]:
+                if self._waiters[lower]:
+                    s = self.shares.get(lower, 0.0)
+                    if s > 0.0:
+                        self._credit[lower] = min(
+                            self._credit[lower] + nbytes * s / (1.0 - s),
+                            float(CREDIT_CAP_BYTES),
+                        )
+            self._credit[priority] = max(self._credit[priority] - nbytes, 0.0)
+            self._inflight += 1
+            self._last_progress = self._clock()
+            wait_s = max(self._clock() - w.t_submit, 0.0)
+            st.admitted += 1
+            st.admitted_bytes += nbytes
+            st.inflight += 1
+            st.wait_s_total += wait_s
+            st.wait_s_max = max(st.wait_s_max, wait_s)
+            _queue_inflight.inc(cls=priority)
+            _queue_admitted.inc(cls=priority)
+            _queue_admitted_bytes.inc(nbytes, cls=priority)
+            _queue_wait_seconds.inc(wait_s, cls=priority)
+            # Another slot may still be free for the next waiter.
+            self._cond.notify_all()
+        return Ticket(priority, nbytes)
+
+    def _release(self, ticket: Ticket) -> None:
+        with self._cond:
+            if ticket.released:
+                return
+            ticket.released = True
+            self._inflight -= 1
+            self._last_progress = self._clock()
+            st = self._stats[ticket.priority]
+            st.inflight -= 1
+            st.drained += 1
+            st.drained_bytes += ticket.nbytes
+            _queue_inflight.dec(cls=ticket.priority)
+            self._cond.notify_all()
+
+
+# --------------------------------------------------------------------------
+# Registry: one queue per backend instance ("per chip" — backends are
+# lru_cached singletons per (name, k, m)), plus the process-wide knobs
+# the server wiring sets.
+# --------------------------------------------------------------------------
+
+_registry_lock = threading.Lock()
+_queues: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_config: dict = {
+    "enabled": True,
+    "window": DEFAULT_WINDOW,
+    "shares": dict(DEFAULT_SHARES),
+}
+
+
+def configure(
+    enabled: bool | None = None,
+    window: int | None = None,
+    shares: dict[str, float] | None = None,
+) -> dict:
+    """Process-wide scheduler knobs (server wiring: `ec_device_queue`,
+    per-class shares, window); the LAST caller wins wholesale. A
+    `shares` dict (even empty) REPLACES the whole share map — classes
+    it omits return to DEFAULT_SHARES, so one caller's override can
+    never stick invisibly to the next caller's config; None leaves the
+    current map untouched. Live queues pick the new values up
+    immediately; `enabled=False` makes `for_backend` return None so
+    every producer falls back to its private PR 3 window. Returns the
+    effective config."""
+    with _registry_lock:
+        if enabled is not None:
+            _config["enabled"] = bool(enabled)
+        if window is not None:
+            _config["window"] = max(1, int(window))
+        if shares is not None:
+            merged = dict(DEFAULT_SHARES)
+            for cls, s in shares.items():
+                if cls not in PRIORITIES:
+                    raise ECError(f"unknown priority class {cls!r}")
+                merged[cls] = min(max(float(s), 0.0), 0.9)
+            _config["shares"] = merged
+        live = list(_queues.values())
+        cfg = {
+            "enabled": _config["enabled"],
+            "window": _config["window"],
+            "shares": dict(_config["shares"]),
+        }
+    for q in live:
+        with q._cond:
+            q.window = cfg["window"]
+            q.shares = dict(cfg["shares"])
+            q._cond.notify_all()
+    return cfg
+
+
+def for_backend(backend) -> DeviceQueue | None:
+    """The shared queue for `backend`'s chip, or None when the scheduler
+    is disabled (or there is no backend — the pass-through pipeline)."""
+    if backend is None:
+        return None
+    with _registry_lock:
+        if not _config["enabled"]:
+            return None
+        q = _queues.get(backend)
+        if q is None:
+            q = DeviceQueue(
+                window=_config["window"], shares=_config["shares"]
+            )
+            _queues[backend] = q
+        return q
+
+
+def stats_snapshot() -> list[dict]:
+    """Per-queue per-class counters for /status and ops tooling."""
+    with _registry_lock:
+        items = [(type(b).__name__, q) for b, q in _queues.items()]
+    return [
+        {"backend": name, "window": q.window, "classes": q.stats()}
+        for name, q in items
+    ]

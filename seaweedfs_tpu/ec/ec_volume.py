@@ -68,6 +68,7 @@ class EcVolume:
         backend_name: str = "auto",
         remote_reader=None,
         interval_cache_bytes: int = DEFAULT_INTERVAL_CACHE_BYTES,
+        interval_cache: ChunkCache | None = None,
     ):
         """remote_reader(shard_id, offset, size, generation) -> bytes|None
         lets the cluster layer serve shards held by peer servers
@@ -80,7 +81,14 @@ class EcVolume:
         shard reuse one reconstruction instead of re-running RS + CRC
         per read. Entries are keyed by (shard generation, shard id):
         remount/rebuild/unmount of a shard invalidates only that
-        shard's extents; deletes invalidate wholesale."""
+        shard's extents; deletes invalidate wholesale.
+
+        `interval_cache` (Store wiring) hands in a SHARED ChunkCache:
+        one byte budget across every EC volume on the server, so a
+        degraded hot volume can use the whole allowance instead of
+        being boxed into a per-volume slice while cold volumes' slices
+        sit empty. Keys are volume-namespaced; invalidation and close()
+        drop only this volume's extents."""
         from ..storage.volume import Volume
 
         self.volume_id = volume_id
@@ -129,16 +137,28 @@ class EcVolume:
         self._prot: BitrotProtection | bool = False
         self._prot_warned = False
         # Verified-reconstruction LRU (degraded-read hot path); None =
-        # disabled. Keys are GENERATION-QUALIFIED shard-aligned extents
-        # ("<sid>:<gen>:<lo>:<hi>"), values are bytes that already
-        # passed sidecar verification. Each shard id carries its own
-        # generation counter, bumped on remount/unmount of THAT shard —
-        # an unrelated shard event no longer drops the whole cache, and
-        # an in-flight reconstruction racing an invalidation parks its
-        # result under the stale generation where no new read looks.
-        self.interval_cache: ChunkCache | None = (
-            ChunkCache(interval_cache_bytes) if interval_cache_bytes > 0 else None
+        # disabled. Keys are VOLUME-NAMESPACED, GENERATION-QUALIFIED
+        # shard-aligned extents ("<ns><sid>:<gen>:<lo>:<hi>"), values
+        # are bytes that already passed sidecar verification. Each shard
+        # id carries its own generation counter, bumped on remount/
+        # unmount of THAT shard — an unrelated shard event no longer
+        # drops the whole cache, and an in-flight reconstruction racing
+        # an invalidation parks its result under the stale generation
+        # where no new read looks. The namespace (collection_vid, like
+        # the base file name) lets a Store-level shared cache hold many
+        # volumes under one byte budget.
+        self._cache_ns = (
+            f"{collection}_{volume_id}:" if collection else f"{volume_id}:"
         )
+        self._shared_cache = interval_cache is not None
+        if interval_cache is not None:
+            self.interval_cache: ChunkCache | None = interval_cache
+        else:
+            self.interval_cache = (
+                ChunkCache(interval_cache_bytes)
+                if interval_cache_bytes > 0
+                else None
+            )
         self._shard_gen: dict[int, int] = {}
         # Decode-coefficient rows are tiny but their GF inversion isn't
         # free on a hot read path; memoize per (target, source-set).
@@ -290,7 +310,10 @@ class EcVolume:
         hi = min(-(-(offset + size) // bs) * bs, ssize)
 
         cache = self.interval_cache
-        key = f"{shard_id}:{self._shard_gen.get(shard_id, 0)}:{lo}:{hi}"
+        key = (
+            f"{self._cache_ns}{shard_id}:"
+            f"{self._shard_gen.get(shard_id, 0)}:{lo}:{hi}"
+        )
         if cache is not None:
             hit = cache.get(key)
             if hit is not None:
@@ -418,6 +441,10 @@ class EcVolume:
             run_staged_apply(
                 self.backend, coeffs, produce, consume,
                 describe="ec degraded reconstruction",
+                # Degraded reads ARE serving traffic: they preempt any
+                # colocated recovery/scrub stream at batch granularity
+                # on the shared device queue.
+                priority="foreground",
             )
             return out.tobytes()
         rec = self.backend.reconstruct(sources, want=[shard_id])
@@ -445,18 +472,20 @@ class EcVolume:
         only THOSE shards' entries drop (and their generation counters
         bump, so an in-flight reconstruction cannot repopulate under the
         old key): a remount of one shard no longer costs every other
-        shard's cached reconstructions. None = wholesale (content
-        changes — a tombstone may land inside any cached extent)."""
+        shard's cached reconstructions. None = wholesale for THIS volume
+        (content changes — a tombstone may land inside any cached
+        extent); a shared Store-level cache keeps other volumes'
+        extents either way."""
         if shard_ids is None:
             for sid in range(self.ctx.total):
                 self._shard_gen[sid] = self._shard_gen.get(sid, 0) + 1
             if self.interval_cache is not None:
-                self.interval_cache.clear()
+                self.interval_cache.drop_prefix(self._cache_ns)
             return
         for sid in shard_ids:
             self._shard_gen[sid] = self._shard_gen.get(sid, 0) + 1
             if self.interval_cache is not None:
-                self.interval_cache.drop_prefix(f"{sid}:")
+                self.interval_cache.drop_prefix(f"{self._cache_ns}{sid}:")
 
     @property
     def shard_ids(self) -> list[int]:
@@ -526,3 +555,7 @@ class EcVolume:
             self.shard_fds.clear()
             self._ecj.close()
             self._ecx.close()
+            if self._shared_cache and self.interval_cache is not None:
+                # an unmounted volume must not keep squatting on the
+                # store-wide reconstruction budget
+                self.interval_cache.drop_prefix(self._cache_ns)

@@ -123,35 +123,63 @@ def write_ec_files(
                         )
                     yield data
 
+        # Encode is SERVING traffic: it dispatches as a foreground
+        # stream of the shared per-chip scheduler (ec/device_queue.py),
+        # so a colocated background rebuild yields the H2D slot at
+        # every batch boundary instead of head-of-line-blocking the
+        # encode. Scheduler disabled -> the PR 3 private window.
+        from .device_queue import for_backend
+
+        dq = for_backend(backend)
+        stream = (
+            dq.stream("foreground", label="ec encode") if dq is not None else None
+        )
+
         def transform(data):
             # H2D stage + device encode dispatch, both async: device
             # residency bound is ~4 batches alive at once (one draining
             # in to_host, two queued, one being dispatched), so peak
             # device memory is ~4x batch_size of input (+ m/k of that
             # in outputs); callers raising batch_size must budget
-            # accordingly.
-            return data, backend.encode_staged(backend.to_device(data))
+            # accordingly. With the shared scheduler the chip-wide
+            # bound is the queue's window instead.
+            if stream is None:
+                return data, None, backend.encode_staged(backend.to_device(data))
+            ticket, handle = stream.dispatch(
+                lambda: backend.encode_staged(backend.to_device(data)),
+                int(data.nbytes),
+            )
+            return data, ticket, handle
 
         def consume(item):
-            data, parity_handle = item
+            data, ticket, parity_handle = item
             # Blocks until the device result is ready — while it does,
             # the main thread keeps dispatching H2D+encode for the
             # batches queued behind this one.
-            parity = np.ascontiguousarray(
-                backend.to_host(parity_handle), dtype=np.uint8
-            )
+            try:
+                parity = np.ascontiguousarray(
+                    backend.to_host(parity_handle), dtype=np.uint8
+                )
+            finally:
+                if ticket is not None:
+                    stream.release(ticket)
             sink.append_rows([*data, *parity])
 
-        run_pipeline(
-            produce,
-            transform,
-            consume,
-            # Join bound: up to ~4 batches can still be draining (one in
-            # to_host, two queued, one dispatched); allow each 16 MiB/s
-            # of slow-disk write plus a fixed device-fetch allowance.
-            join_timeout=60.0 + 4.0 * batch_size / (16 << 20),
-            describe="ec encode pipeline",
-        )
+        try:
+            run_pipeline(
+                produce,
+                transform,
+                consume,
+                # Join bound: up to ~4 batches can still be draining (one
+                # in to_host, two queued, one dispatched); allow each
+                # 16 MiB/s of slow-disk write plus a fixed device-fetch
+                # allowance.
+                join_timeout=60.0 + 4.0 * batch_size / (16 << 20),
+                describe="ec encode pipeline",
+            )
+        finally:
+            if stream is not None:
+                stream.close()
 
         # Crash window: shards fully written but not yet durable — a
         # power cut here may leave any suffix of any shard missing.

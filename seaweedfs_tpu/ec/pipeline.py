@@ -155,6 +155,8 @@ def run_staged_apply(
     queue_size: int = 2,
     join_timeout: float = 120.0,
     describe: str = "ec staged apply",
+    priority: str = "recovery",
+    device_queue="auto",
 ) -> None:
     """The staged device `apply` driver shared by rebuild, decode, and
     degraded reconstruction: run_pipeline where the transform stage is
@@ -170,8 +172,16 @@ def run_staged_apply(
     `coeffs=None` is the pass-through configuration: no device
     round-trip, the batch flows to `consume` unchanged (decode's
     de-stripe, where reads must overlap writes but there is nothing to
-    compute). Device-memory residency bound is the same as run_pipeline:
-    up to ~2*queue_size staged batches alive at once.
+    compute).
+
+    The device dispatch is a CLIENT of the shared per-chip scheduler
+    (ec/device_queue.py): `priority` tags this stream's class
+    (foreground|recovery|scrub) and `device_queue` selects the queue —
+    "auto" resolves the backend's shared queue (None when the scheduler
+    is disabled), an explicit DeviceQueue pins one (tests), None keeps
+    the PR 3 private window. With the scheduler on, the chip-wide
+    in-flight bound lives in the queue's window; without it, up to
+    ~2*queue_size staged batches are alive at once per call site.
     """
     if coeffs is None:
         run_pipeline(
@@ -184,26 +194,69 @@ def run_staged_apply(
         )
         return
     coeffs = np.ascontiguousarray(coeffs, dtype=np.uint8)
+    if device_queue == "auto":
+        from .device_queue import for_backend
 
-    def transform(item):
+        device_queue = for_backend(backend)
+
+    if device_queue is None:
+
+        def transform(item):
+            tag, batch = item
+            return tag, backend.apply_staged(coeffs, backend.to_device(batch))
+
+        def drain(item):
+            tag, handle = item
+            # Blocks until the device result is ready — while it does,
+            # the calling thread keeps dispatching the batches queued
+            # behind it.
+            out = np.ascontiguousarray(backend.to_host(handle), dtype=np.uint8)
+            consume(tag, out)
+
+        run_pipeline(
+            produce,
+            transform,
+            drain,
+            queue_size=queue_size,
+            join_timeout=join_timeout,
+            describe=describe,
+        )
+        return
+
+    stream = device_queue.stream(priority, label=describe)
+
+    def transform_q(item):
         tag, batch = item
-        return tag, backend.apply_staged(coeffs, backend.to_device(batch))
+        nbytes = int(getattr(batch, "nbytes", len(batch)))
+        ticket, handle = stream.dispatch(
+            lambda: backend.apply_staged(coeffs, backend.to_device(batch)),
+            nbytes,
+        )
+        return tag, ticket, handle
 
-    def drain(item):
-        tag, handle = item
-        # Blocks until the device result is ready — while it does, the
-        # calling thread keeps dispatching the batches queued behind it.
-        out = np.ascontiguousarray(backend.to_host(handle), dtype=np.uint8)
+    def drain_q(item):
+        tag, ticket, handle = item
+        try:
+            out = np.ascontiguousarray(backend.to_host(handle), dtype=np.uint8)
+        finally:
+            # Success or failure, the window slot frees — a dying stream
+            # must not wedge the chip for the other streams.
+            stream.release(ticket)
         consume(tag, out)
 
-    run_pipeline(
-        produce,
-        transform,
-        drain,
-        queue_size=queue_size,
-        join_timeout=join_timeout,
-        describe=describe,
-    )
+    try:
+        run_pipeline(
+            produce,
+            transform_q,
+            drain_q,
+            queue_size=queue_size,
+            join_timeout=join_timeout,
+            describe=describe,
+        )
+    finally:
+        # Batches parked in an aborted pipeline's write queue never
+        # reach drain_q; their slots are released here.
+        stream.close()
 
 
 # --------------------------------------------------------------------------

@@ -102,6 +102,7 @@ def rebuild_ec_files(
     batch_size: int = DEFAULT_BATCH,
     only_shards: list[int] | None = None,
     staged: bool = True,
+    priority: str = "recovery",
 ) -> list[int]:
     """Regenerate missing/corrupt shard files; returns regenerated ids.
 
@@ -115,6 +116,11 @@ def rebuild_ec_files(
     `encode_staged`; False keeps the synchronous per-batch `apply` —
     bit-identical by construction, kept for the bench's staged-vs-sync
     comparison.
+
+    `priority` tags the staged stream's class on the shared per-chip
+    scheduler (ec/device_queue.py): "recovery" by default (rebuild and
+    decode self-heal restore redundancy behind serving traffic); the
+    scrub daemon passes "scrub" so background hygiene yields to both.
     """
     # Sidecar first: it records the shard ratio too, which backs up the
     # .vif for config resolution and cross-checks it.
@@ -285,6 +291,7 @@ def rebuild_ec_files(
             ),
             verified_ok=verified_ok,
             staged=staged,
+            priority=priority,
         )
         if bad_src:
             # Confirmed on-disk rot in a source: verify-and-exclude says
@@ -307,6 +314,7 @@ def _attempt_rebuild(
     inline_verify: bool,
     verified_ok: set[int] | None = None,
     staged: bool = True,
+    priority: str = "recovery",
 ) -> list[int]:
     """One pipelined reconstruction attempt. Publishes and returns []
     on success; returns confirmed-corrupt source ids for the caller to
@@ -462,6 +470,7 @@ def _attempt_rebuild(
                 consume,
                 join_timeout=join_timeout,
                 describe="ec rebuild pipeline",
+                priority=priority,
             )
     except _SourceReadError as e:
         _cleanup_temps()

@@ -478,8 +478,13 @@ def scrub_ec_volume(
     want_rebuild = sorted(set(report.corrupt_shards) | set(report.missing_shards))
     if repair and want_rebuild:
         def attempt() -> list[int]:
+            # Scrub-initiated repair is the LOWEST class on the shared
+            # device queue: it yields the chip to foreground serving
+            # AND to operator/decode-driven recovery rebuilds, keeping
+            # only its configured minimum share under contention.
             return rebuild_ec_files(
-                base, ctx, backend=backend, only_shards=want_rebuild
+                base, ctx, backend=backend, only_shards=want_rebuild,
+                priority="scrub",
             )
 
         try:

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import collections
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -156,6 +157,11 @@ class RSJax:
         self._coeff_bits_cache: "collections.OrderedDict[bytes, np.ndarray]" = (
             collections.OrderedDict()
         )
+        # The device-queue scheduler multiplexes several streams'
+        # pipeline threads into ONE RSJax; move_to_end/popitem sequences
+        # on the OrderedDict caches are not atomic under concurrent
+        # lookups with different coefficient sets.
+        self._cache_lock = threading.Lock()
 
     # -- encode ------------------------------------------------------------
 
@@ -193,17 +199,19 @@ class RSJax:
     def _rows_bits(self, out_rows: tuple[int, ...], src_rows: tuple[int, ...]) -> np.ndarray:
         """Bit-matrix mapping shards[src_rows] -> shards[out_rows]."""
         key = (out_rows, src_rows)
-        cached = self._decode_bits_cache.get(key)
-        if cached is not None:
-            self._decode_bits_cache.move_to_end(key)
-            return cached
+        with self._cache_lock:
+            cached = self._decode_bits_cache.get(key)
+            if cached is not None:
+                self._decode_bits_cache.move_to_end(key)
+                return cached
         sub = self.matrix[list(src_rows), :]
         inv = gf256.invert(sub)  # (k, k): src shards -> data shards
         want = gf256.matmul(self.matrix[list(out_rows), :], inv)
         bits = np.asarray(self._expand(want), dtype=_ACC_DTYPE)
-        self._decode_bits_cache[key] = bits
-        if len(self._decode_bits_cache) > self._decode_cache_limit:
-            self._decode_bits_cache.popitem(last=False)
+        with self._cache_lock:
+            self._decode_bits_cache[key] = bits
+            if len(self._decode_bits_cache) > self._decode_cache_limit:
+                self._decode_bits_cache.popitem(last=False)
         return bits
 
     def reconstruct(
@@ -240,14 +248,16 @@ class RSJax:
         call time like _parity_bits so construction stays hang-free)."""
         coeffs = np.ascontiguousarray(coeffs, dtype=np.uint8)
         key = coeffs.shape[0].to_bytes(4, "little") + coeffs.tobytes()
-        cached = self._coeff_bits_cache.get(key)
-        if cached is not None:
-            self._coeff_bits_cache.move_to_end(key)
-            return cached
+        with self._cache_lock:
+            cached = self._coeff_bits_cache.get(key)
+            if cached is not None:
+                self._coeff_bits_cache.move_to_end(key)
+                return cached
         bits = np.asarray(self._expand(coeffs), dtype=_ACC_DTYPE)
-        self._coeff_bits_cache[key] = bits
-        if len(self._coeff_bits_cache) > self._decode_cache_limit:
-            self._coeff_bits_cache.popitem(last=False)
+        with self._cache_lock:
+            self._coeff_bits_cache[key] = bits
+            if len(self._coeff_bits_cache) > self._decode_cache_limit:
+                self._coeff_bits_cache.popitem(last=False)
         return bits
 
     def apply(self, coeffs: np.ndarray, data) -> jax.Array:

@@ -15,6 +15,8 @@ encode in ec/backend.py) and the driver's `dryrun_multichip`.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 BLOCK_AXIS = "blocks"
@@ -81,7 +83,11 @@ class MeshRS:
         # jitted shard_map applies, keyed by (m_out, k): the decode
         # coefficient SHAPE is stable per shard-loss set, so each key
         # compiles once and the bit-matrix rides in as a replicated arg.
+        # Locked: the device-queue scheduler dispatches several streams'
+        # threads into one MeshRS, and a get-or-compile race would
+        # compile the same shape twice (wasted minutes on a real mesh).
         self._apply_jits: dict = {}
+        self._apply_jits_lock = threading.Lock()
         self._repl = replicated(mesh)
         self._cols = column_sharding(mesh)
 
@@ -125,8 +131,16 @@ class MeshRS:
             from jax.experimental.shard_map import shard_map
 
         key = (int(m_out), int(staged.shape[0]))
-        fn = self._apply_jits.get(key)
+        with self._apply_jits_lock:
+            fn = self._apply_jits.get(key)
         if fn is None:
+            # Build OUTSIDE the lock: holding it across a minutes-long
+            # mesh compile would block every other stream's already-
+            # compiled applies — priority inversion on the foreground
+            # path the device queue exists to protect. Two streams
+            # racing the same new shape may both build; the insert
+            # below keeps one, and jax.jit defers actual compilation
+            # to first call anyway.
             rs = self.rs
 
             def _local(b, d):
@@ -140,7 +154,8 @@ class MeshRS:
                     out_specs=P(None, BLOCK_AXIS),
                 )
             )
-            self._apply_jits[key] = fn
+            with self._apply_jits_lock:
+                fn = self._apply_jits.setdefault(key, fn)
         return fn(jnp.asarray(bits), staged)
 
     def global_checksum(self, sharded) -> int:

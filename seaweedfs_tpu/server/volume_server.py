@@ -882,7 +882,38 @@ class VolumeServer:
         ec_scrub_bytes_per_sec: float = 64 << 20,
         ec_scrub_bad_retention: float = 0.0,
         ec_interval_cache_mb: int | None = None,
+        ec_device_queue: bool = True,
+        ec_queue_window: int | None = None,
+        ec_queue_recovery_share: float | None = None,
+        ec_queue_scrub_share: float | None = None,
     ):
+        # Shared per-chip device-queue scheduler (ec/device_queue.py):
+        # every EC producer on this server submits priority-tagged batch
+        # streams (foreground encode/degraded reads > recovery rebuild/
+        # decode > scrub) instead of owning a private device window.
+        # `ec_device_queue=False` restores the PR 3 per-call-site
+        # windows; the share knobs set each background class's minimum
+        # fraction of admitted bytes under contention. configure() is
+        # process-wide and last-caller-wins: this construction states
+        # the FULL config (unset knobs = defaults), so the effective
+        # scheduler always matches the most recently constructed
+        # server's arguments — a previous server's overrides never
+        # linger.
+        from ..ec import device_queue as _dq
+
+        shares = {}
+        if ec_queue_recovery_share is not None:
+            shares["recovery"] = ec_queue_recovery_share
+        if ec_queue_scrub_share is not None:
+            shares["scrub"] = ec_queue_scrub_share
+        _dq.configure(
+            enabled=ec_device_queue,
+            window=(
+                _dq.DEFAULT_WINDOW if ec_queue_window is None
+                else ec_queue_window
+            ),
+            shares=shares,
+        )
         self.jwt_key = jwt_key
         self.ip = ip
         self.port = port
@@ -905,8 +936,9 @@ class VolumeServer:
             ec_backend=ec_backend,
             ec_remote_reader_factory=self._remote_reader_factory,
             needle_map_kind=needle_map_kind,
-            # degraded-read reconstructed-interval cache budget per EC
-            # volume; None keeps EcVolume's default, 0 disables
+            # degraded-read reconstructed-interval cache budget shared
+            # across ALL EC volumes on this server (one ChunkCache at
+            # the Store); None keeps the store default, 0 disables
             ec_interval_cache_bytes=(
                 None if ec_interval_cache_mb is None
                 else int(ec_interval_cache_mb) << 20
@@ -1276,7 +1308,13 @@ class VolumeServer:
                     self.wfile.write(body)
                     return
                 if u.path == "/status":
-                    body = json.dumps(server.store.status()).encode()
+                    from ..ec import device_queue as _dq
+
+                    st = server.store.status()
+                    # per-chip per-class scheduler counters (depth /
+                    # wait / throughput) ride along with volume status
+                    st["ec_device_queue"] = _dq.stats_snapshot()
+                    body = json.dumps(st).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))

@@ -15,8 +15,15 @@ from typing import Optional
 
 from ..ec.context import ECError
 from ..ec.ec_volume import EcVolume
+from ..utils.chunk_cache import ChunkCache
 from .needle import Needle
 from .volume import NotFoundError, Volume, VolumeError
+
+# Default byte budget for the STORE-LEVEL reconstructed-interval cache
+# shared by every EC volume on this server (one budget, not one slice
+# per volume): a degraded hot volume can claim the whole allowance
+# while cold volumes cost nothing. 4x the old per-volume default.
+DEFAULT_EC_INTERVAL_CACHE_BYTES = 64 << 20
 
 _DAT_RE = re.compile(r"^(?:(?P<col>[^_]+)_)?(?P<vid>\d+)\.dat$")
 _ECX_RE = re.compile(r"^(?:(?P<col>[^_]+)_)?(?P<vid>\d+)\.ecx$")
@@ -39,8 +46,21 @@ class DiskLocation:
         self,
         ec_backend: str = "auto",
         remote_reader_factory=None,
-        ec_interval_cache_bytes: int | None = None,
+        ec_interval_cache: "ChunkCache | None | str" = "default",
     ) -> None:
+        """`ec_interval_cache`: a ChunkCache = the Store-level shared
+        budget; None = cache disabled (Store budget 0); "default"
+        (direct callers) = each EcVolume keeps its own private default
+        cache, the pre-store-cache behavior."""
+        if ec_interval_cache == "default":
+            cache_kwargs = {}
+        else:
+            # store-managed: share the one budget, or (None) no cache
+            # at all — never a private per-volume slice
+            cache_kwargs = {
+                "interval_cache": ec_interval_cache,
+                "interval_cache_bytes": 0,
+            }
         for name in sorted(os.listdir(self.directory)):
             m = _DAT_RE.match(name) or _VIF_RE.match(name)
             # a .vif with no local .dat is a cold-tiered volume: it must
@@ -65,16 +85,13 @@ class DiskLocation:
                     os.path.exists(base + f".ec{i:02d}") for i in range(32)
                 ):
                     try:
-                        kwargs = {}
-                        if ec_interval_cache_bytes is not None:
-                            kwargs["interval_cache_bytes"] = ec_interval_cache_bytes
                         self.ec_volumes[vid] = EcVolume(
                             self.directory, vid, collection=col,
                             backend_name=ec_backend,
                             remote_reader=remote_reader_factory(vid, col)
                             if remote_reader_factory
                             else None,
-                            **kwargs,
+                            **cache_kwargs,
                         )
                     except ECError:
                         continue
@@ -98,9 +115,18 @@ class Store:
         self.ec_backend = ec_backend
         self.ec_remote_reader_factory = ec_remote_reader_factory
         self.needle_map_kind = needle_map_kind
-        # None = EcVolume's default; 0 disables the degraded-read
-        # reconstructed-interval cache entirely.
+        # ONE reconstructed-interval cache budget for the whole store,
+        # shared by every EC volume (keys are volume-namespaced; see
+        # EcVolume). None = the store default; 0 disables the
+        # degraded-read cache entirely.
+        if ec_interval_cache_bytes is None:
+            ec_interval_cache_bytes = DEFAULT_EC_INTERVAL_CACHE_BYTES
         self.ec_interval_cache_bytes = ec_interval_cache_bytes
+        self.ec_interval_cache: ChunkCache | None = (
+            ChunkCache(ec_interval_cache_bytes)
+            if ec_interval_cache_bytes > 0
+            else None
+        )
         self._lock = threading.RLock()
         # a directory spec may carry a type tag: "/data1:ssd"
         # (reference -dir=/d1 -disk=ssd); bare paths default to hdd
@@ -119,7 +145,7 @@ class Store:
         for loc in self.locations:
             os.makedirs(loc.directory, exist_ok=True)
             loc.load_existing(
-                ec_backend, ec_remote_reader_factory, ec_interval_cache_bytes
+                ec_backend, ec_remote_reader_factory, self.ec_interval_cache
             )
 
     # ----------------------------------------------------------- lookup
@@ -269,9 +295,6 @@ class Store:
             for loc in self.locations:
                 base = Volume.base_file_name(loc.directory, collection, vid)
                 if os.path.exists(base + ".ecx"):
-                    kwargs = {}
-                    if self.ec_interval_cache_bytes is not None:
-                        kwargs["interval_cache_bytes"] = self.ec_interval_cache_bytes
                     ev = EcVolume(
                         loc.directory,
                         vid,
@@ -280,7 +303,8 @@ class Store:
                         remote_reader=self.ec_remote_reader_factory(vid, collection)
                         if self.ec_remote_reader_factory
                         else None,
-                        **kwargs,
+                        interval_cache=self.ec_interval_cache,
+                        interval_cache_bytes=0,
                     )
                     loc.ec_volumes[vid] = ev
                     return ev

@@ -397,14 +397,16 @@ def test_stale_generation_put_is_invisible(tmp_path):
         nid = next(iter(payloads))
         ev.read_needle(nid, cookie=0x1000 + nid)
         keys0 = {k for k in ev.interval_cache._data}
-        assert all(k.split(":")[1] == "0" for k in keys0)
+        # keys are "<ns><sid>:<gen>:<lo>:<hi>" with ns = "<vid>:"
+        assert all(k.split(":")[2] == "0" for k in keys0)
         ev.unmount_shards([0])  # bump shard 0's generation
         # simulate the in-flight put landing late under the old gen
-        ev.interval_cache.put("0:0:0:4096", b"x" * 4096)
+        stale = "1:0:0:0:4096"
+        ev.interval_cache.put(stale, b"x" * 4096)
         h0 = ev.interval_cache.hits
         ev.read_needle(nid, cookie=0x1000 + nid)  # re-reconstructs
-        new_keys = {k for k in ev.interval_cache._data if k != "0:0:0:4096"}
-        assert all(k.split(":")[1] == "1" for k in new_keys)
+        new_keys = {k for k in ev.interval_cache._data if k != stale}
+        assert all(k.split(":")[2] == "1" for k in new_keys)
         assert ev.interval_cache.hits == h0  # stale entry never hit
     finally:
         ev.close()
