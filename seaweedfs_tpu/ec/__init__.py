@@ -24,10 +24,20 @@ from .context import (
     ECContext,
     ECError,
 )
+from .chip_pool import (
+    ChipBackend,
+    ChipPool,
+    Placement,
+    place_stream,
+    pool_for,
+)
 from .device_queue import (
     DeviceQueue,
     DeviceStream,
+    QueueScope,
+    batch_cost,
     configure as configure_device_queue,
+    default_scope as default_device_queue_scope,
     for_backend as device_queue_for_backend,
 )
 from .decoder import (

@@ -297,6 +297,10 @@ class FallbackBackend(_BackendBase):
             breaker = CircuitBreaker(failure_threshold=3, reset_timeout=60.0)
         self.breaker = breaker
         self.fallback_batches = 0  # observability: batches served by CPU
+        # Chip identity when this wraps one chip of a pool
+        # (ec/chip_pool.py): rides into the fault-point context so
+        # chaos tests can kill ONE chip, and into queue stats labels.
+        self.chip_label = getattr(primary, "chip_label", "")
         self._log = logger("ec.backend")
 
     # Deterministic caller errors (bad shape/dtype/shard-count): the CPU
@@ -366,7 +370,10 @@ class FallbackBackend(_BackendBase):
         data = np.ascontiguousarray(data, dtype=np.uint8)
         if self.breaker.allows():
             try:
-                faults.fire("ec.backend.device.to_device", width=data.shape[1])
+                faults.fire(
+                    "ec.backend.device.to_device",
+                    width=data.shape[1], chip=self.chip_label,
+                )
                 return (data, self.primary.to_device(data))
             except Exception as e:
                 self._device_failed("to_device", e)
@@ -376,7 +383,9 @@ class FallbackBackend(_BackendBase):
         host, dev = staged
         if dev is not None:
             try:
-                faults.fire("ec.backend.device.encode_staged")
+                faults.fire(
+                    "ec.backend.device.encode_staged", chip=self.chip_label
+                )
                 return ("encode", host, self.primary.encode_staged(dev), None)
             except Exception as e:
                 self._device_failed("encode_staged", e)
@@ -387,7 +396,9 @@ class FallbackBackend(_BackendBase):
         coeffs = np.ascontiguousarray(coeffs, dtype=np.uint8)
         if dev is not None:
             try:
-                faults.fire("ec.backend.device.apply_staged")
+                faults.fire(
+                    "ec.backend.device.apply_staged", chip=self.chip_label
+                )
                 return (
                     "apply", host, self.primary.apply_staged(coeffs, dev), coeffs
                 )
@@ -399,7 +410,7 @@ class FallbackBackend(_BackendBase):
         kind, host, dev, coeffs = result
         if dev is not None:
             try:
-                faults.fire("ec.backend.device.to_host")
+                faults.fire("ec.backend.device.to_host", chip=self.chip_label)
                 out = np.asarray(self.primary.to_host(dev), dtype=np.uint8)
                 self.breaker.record_success()
                 return out

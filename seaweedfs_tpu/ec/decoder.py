@@ -254,7 +254,7 @@ def write_dat_file(
             os.close(fd)
 
 
-def ec_decode_volume(base: str, ctx=None, backend=None) -> bool:
+def ec_decode_volume(base: str, ctx=None, backend=None, scheduler=None) -> bool:
     """Shards -> normal volume. Returns False (no-op) when no live
     needles remain. Layout and version come from the .vif.
 
@@ -267,7 +267,9 @@ def ec_decode_volume(base: str, ctx=None, backend=None) -> bool:
     verified data shards on disk. The verification pass reads every
     present shard once — decode is a maintenance op, and publishing a
     .dat de-striped from unverified bytes would defeat the sidecar.
-    Fewer than k good shards still fails closed inside rebuild."""
+    Fewer than k good shards still fails closed inside rebuild.
+    `scheduler` is the QueueScope the self-heal stream runs under
+    (server wiring passes the Store's scope)."""
     vi = VolumeInfo.maybe_load(base + ".vif") or VolumeInfo()
     if ctx is None:
         from .context import DEFAULT_EC_CONTEXT
@@ -292,7 +294,7 @@ def ec_decode_volume(base: str, ctx=None, backend=None) -> bool:
     # shared device queue: colocated foreground encode/reads go first.
     rebuild_ec_files(
         base, ctx, backend=backend, only_shards=missing_ids,
-        priority="recovery",
+        priority="recovery", scheduler=scheduler,
     )
     still = [p for p in shard_paths if not os.path.exists(p)]
     if still:  # pragma: no cover - rebuild either publishes or raises

@@ -11,13 +11,16 @@ ROADMAP named the shared scheduler as the open perf item from PR 3.
 Model
 -----
 
-One `DeviceQueue` per backend instance ("per chip": backends are
-lru_cached singletons per (name, k, m)). Producers open a
-`DeviceStream` tagged with a priority class and submit batches through
-it; the queue admits batch dispatches (the H2D + device-dispatch step)
-one at a time under a policy, and bounds the TOTAL number of in-flight
-device batches across all streams (`window` — the device-memory
-residency bound that used to be per call site).
+One `DeviceQueue` per chip. A single-device backend is one chip; a
+column-mesh backend spans several chips but dispatches as a unit, so it
+still gets ONE queue — the pod-level answer is `ec/chip_pool.py`, which
+places whole streams onto per-chip backends (each with its own queue
+from this module) instead of slicing every stream across the mesh.
+Producers open a `DeviceStream` tagged with a priority class and submit
+batches through it; the queue admits batch dispatches (the H2D +
+device-dispatch step) one at a time under a policy, and bounds the
+TOTAL number of in-flight device batches across all streams (`window` —
+the device-memory residency bound that used to be per call site).
 
 Priority classes, highest first:
 
@@ -25,17 +28,31 @@ Priority classes, highest first:
 - ``recovery``  — rebuild, decode self-heal (restore redundancy);
 - ``scrub``     — scrub-initiated repair (background hygiene).
 
+Cost model
+----------
+
+Admission is denominated in COST UNITS, not payload bytes: one unit is
+one output row-byte (``out_rows x batch_width``, see
+:func:`batch_cost`). Device time for a GF(256) apply scales with the
+output rows it computes, so a 1-row degraded reconstruction of a 64 KiB
+leaf (cost 64Ki) no longer counts like a full parity encode of the same
+width (cost m x width = 4 x width at 10+4): under the minimum-share
+policy a recovery stream of single-row repairs gets proportionally MORE
+batches admitted per unit of banked credit than a byte-denominated
+accounting would allow — the heterogeneous-batch fairness the ROADMAP
+recorded after PR 4.
+
 Admission is strict-priority with a weighted-deficit minimum share for
-the background classes: every byte admitted for a higher class banks
-``share/(1-share)`` bytes of credit for each LOWER class that has work
-waiting; a lower class whose credit covers its head batch is admitted
-ahead of the higher class. Under saturation each background class
-therefore gets ~``share`` of admitted bytes (no starvation), while an
-arriving foreground batch goes ahead of every queued background batch
-that is not yet "due" (batch-granularity preemption: a long rebuild
-window can no longer head-of-line-block an encode — the rebuild yields
-the H2D slot at its next batch boundary). ``share=0`` degrades to
-strict priority for that class.
+the background classes: every cost unit admitted for a higher class
+banks ``share/(1-share)`` units of credit for each LOWER class that has
+work waiting; a lower class whose credit covers its head batch is
+admitted ahead of the higher class. Under saturation each background
+class therefore gets ~``share`` of admitted cost (no starvation), while
+an arriving foreground batch goes ahead of every queued background
+batch that is not yet "due" (batch-granularity preemption: a long
+rebuild window can no longer head-of-line-block an encode — the rebuild
+yields the H2D slot at its next batch boundary). ``share=0`` degrades
+to strict priority for that class.
 
 Fault semantics are unchanged and PER STREAM: the queue never touches
 batch payloads or results, so a FallbackBackend device death between
@@ -46,14 +63,26 @@ synchronous apply holds by construction. A stream that dies releases
 its window slots (``DeviceStream.close`` is leak-proof), so one
 aborted producer can never wedge the chip for everyone else.
 
-Knobs ride in through :func:`configure` (server wiring:
-``ec_device_queue``, per-class shares, window) and per-class
-depth/wait/throughput counters surface through :func:`stats_snapshot`
-and the Prometheus registry (``sw_ec_queue_*``).
+Scopes
+------
+
+Knobs live in a :class:`QueueScope` — one config domain with its own
+queue registry. The module-level :func:`configure` / :func:`for_backend`
+/ :func:`stats_snapshot` operate on the process-wide DEFAULT scope
+(kept for embedders and tests; still last-caller-wins there), while a
+`Store` may carry its own scope so two tenants in one process stop
+clobbering each other's shares/window/placement (`storage/store.py`
+threads it exactly like the shared interval cache). Per-class
+depth/wait/throughput counters surface through ``stats_snapshot`` and
+the Prometheus registry (``sw_ec_queue_*``), keyed per chip: each queue
+carries a ``chip`` label (the device id for pool chips, the backend
+class name otherwise), so a second chip's counters land in their own
+gauge set instead of silently aliasing into the first's.
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 import weakref
@@ -65,7 +94,7 @@ from .context import ECError
 # Highest priority first; admission prefers earlier classes.
 PRIORITIES = ("foreground", "recovery", "scrub")
 
-# Minimum admitted-byte share per background class under saturation.
+# Minimum admitted-cost share per background class under saturation.
 # Small on purpose: this is a SERVING store — repair proceeds, but
 # foreground keeps ~90% of the chip when it wants it (the bench
 # acceptance bar is foreground >= 85% of isolated throughput with a
@@ -78,10 +107,17 @@ DEFAULT_SHARES = {"recovery": 0.10, "scrub": 0.02}
 # as one saturated call site used to claim.
 DEFAULT_WINDOW = 4
 
-# Credit never banks more than this many bytes per class: a background
-# class idle through a long foreground burst must not repay itself with
-# an equally long background burst afterwards.
-CREDIT_CAP_BYTES = 256 << 20
+# Stream placement policy for multi-chip (mesh-capable) backends — see
+# ec/chip_pool.py. "auto" routes each new stream to the least-loaded
+# chip unless the stream is explicitly wide and the pod is idle;
+# "chip" always routes; "mesh" always column-slices (the PR 4 shape).
+PLACEMENT_MODES = ("auto", "mesh", "chip")
+DEFAULT_PLACEMENT = "auto"
+
+# Credit never banks more than this many cost units per class: a
+# background class idle through a long foreground burst must not repay
+# itself with an equally long background burst afterwards.
+CREDIT_CAP_COST = 1 << 30
 
 # Admission liveness bound. Window slots are freed by OTHER streams'
 # drain threads; a stream wedged in to_host against a hung device holds
@@ -95,30 +131,40 @@ CREDIT_CAP_BYTES = 256 << 20
 DEFAULT_ADMIT_TIMEOUT = 300.0
 
 _queue_depth = _M.REGISTRY.gauge(
-    "sw_ec_queue_depth", "EC device-queue waiting batches", ("cls",)
+    "sw_ec_queue_depth", "EC device-queue waiting batches", ("cls", "chip")
 )
 _queue_inflight = _M.REGISTRY.gauge(
-    "sw_ec_queue_inflight", "EC device-queue in-flight batches", ("cls",)
+    "sw_ec_queue_inflight", "EC device-queue in-flight batches", ("cls", "chip")
 )
 _queue_admitted = _M.REGISTRY.counter(
-    "sw_ec_queue_admitted_total", "EC device-queue admitted batches", ("cls",)
+    "sw_ec_queue_admitted_total",
+    "EC device-queue admitted batches", ("cls", "chip"),
 )
-_queue_admitted_bytes = _M.REGISTRY.counter(
-    "sw_ec_queue_admitted_bytes_total",
-    "EC device-queue admitted bytes", ("cls",),
+_queue_admitted_cost = _M.REGISTRY.counter(
+    "sw_ec_queue_admitted_cost_total",
+    "EC device-queue admitted cost units (output rows x batch width)",
+    ("cls", "chip"),
 )
 _queue_wait_seconds = _M.REGISTRY.counter(
     "sw_ec_queue_wait_seconds_total",
-    "EC device-queue admission wait", ("cls",),
+    "EC device-queue admission wait", ("cls", "chip"),
 )
 
 
-class _Waiter:
-    __slots__ = ("priority", "nbytes", "t_submit")
+def batch_cost(out_rows: int, width: int) -> int:
+    """Admission cost of one batch: output rows x batch width (bytes per
+    row). Tracks device time — a GF(256) apply computes out_rows x k x
+    width byte-products, and k is fixed per volume — so a 1-row
+    reconstruction is ~1/m the cost of a parity encode at equal width."""
+    return max(int(out_rows), 1) * max(int(width), 1)
 
-    def __init__(self, priority: str, nbytes: int, t_submit: float):
+
+class _Waiter:
+    __slots__ = ("priority", "cost", "t_submit")
+
+    def __init__(self, priority: str, cost: int, t_submit: float):
         self.priority = priority
-        self.nbytes = nbytes
+        self.cost = cost
         self.t_submit = t_submit
 
 
@@ -127,26 +173,26 @@ class Ticket:
     (or the stream dies). Idempotent release — close() may race a drain
     thread's finally."""
 
-    __slots__ = ("priority", "nbytes", "released")
+    __slots__ = ("priority", "cost", "released")
 
-    def __init__(self, priority: str, nbytes: int):
+    def __init__(self, priority: str, cost: int):
         self.priority = priority
-        self.nbytes = nbytes
+        self.cost = cost
         self.released = False
 
 
 class ClassStats:
     __slots__ = (
-        "submitted", "admitted", "admitted_bytes", "drained",
-        "drained_bytes", "wait_s_total", "wait_s_max", "inflight",
+        "submitted", "admitted", "admitted_cost", "drained",
+        "drained_cost", "wait_s_total", "wait_s_max", "inflight",
     )
 
     def __init__(self):
         self.submitted = 0
         self.admitted = 0
-        self.admitted_bytes = 0
+        self.admitted_cost = 0
         self.drained = 0
-        self.drained_bytes = 0
+        self.drained_cost = 0
         self.wait_s_total = 0.0
         self.wait_s_max = 0.0
         self.inflight = 0
@@ -157,9 +203,9 @@ class ClassStats:
             "inflight": self.inflight,
             "submitted": self.submitted,
             "admitted": self.admitted,
-            "admitted_bytes": self.admitted_bytes,
+            "admitted_cost": self.admitted_cost,
             "drained": self.drained,
-            "drained_bytes": self.drained_bytes,
+            "drained_cost": self.drained_cost,
             "wait_s_total": round(self.wait_s_total, 6),
             "wait_s_max": round(self.wait_s_max, 6),
         }
@@ -177,16 +223,17 @@ class DeviceStream:
         self._outstanding: set[Ticket] = set()
         self._lock = threading.Lock()
 
-    def dispatch(self, fn, nbytes: int):
+    def dispatch(self, fn, cost: int):
         """Block until this stream's batch is admitted under the queue
         policy, then run `fn()` (the caller's H2D upload + non-blocking
-        device dispatch) and return ``(ticket, handle)``. The window
-        slot is held until :meth:`release` — call it after `to_host`
-        completes (success OR failure). If `fn` itself raises (device
-        refused the dispatch; FallbackBackend turns that into a CPU
-        handle instead, so this is the raw-backend path), the slot is
-        released before the exception propagates."""
-        ticket = self.queue._admit(self.priority, nbytes)
+        device dispatch) and return ``(ticket, handle)``. `cost` is the
+        batch's admission weight in cost units (see :func:`batch_cost`).
+        The window slot is held until :meth:`release` — call it after
+        `to_host` completes (success OR failure). If `fn` itself raises
+        (device refused the dispatch; FallbackBackend turns that into a
+        CPU handle instead, so this is the raw-backend path), the slot
+        is released before the exception propagates."""
+        ticket = self.queue._admit(self.priority, cost)
         with self._lock:
             self._outstanding.add(ticket)
         ok = False
@@ -221,8 +268,9 @@ class DeviceStream:
 
 
 class DeviceQueue:
-    """Priority-multiplexed admission scheduler for one chip (one
-    backend instance). See the module docstring for the policy."""
+    """Priority-multiplexed admission scheduler for one chip. See the
+    module docstring for the policy. `label` identifies the chip in
+    stats and metrics (device id for pool chips)."""
 
     def __init__(
         self,
@@ -230,9 +278,11 @@ class DeviceQueue:
         shares: dict[str, float] | None = None,
         clock=time.monotonic,
         admit_timeout: float = DEFAULT_ADMIT_TIMEOUT,
+        label: str = "",
     ):
         self.window = max(1, int(window))
         self.admit_timeout = float(admit_timeout)
+        self.label = label
         self.shares = dict(DEFAULT_SHARES)
         if shares:
             for cls, s in shares.items():
@@ -245,6 +295,13 @@ class DeviceQueue:
         }
         self._credit: dict[str, float] = {c: 0.0 for c in PRIORITIES}
         self._inflight = 0
+        # Total un-drained cost (waiting + in-flight): live-load
+        # introspection (accounting asserts, ops tooling). NOTE:
+        # chip_pool routing does NOT read this — it charges each
+        # stream's static cost hint at placement time and drains it at
+        # stream close; wiring routing to live queue load is a recorded
+        # ROADMAP item.
+        self._pending_cost = 0
         self._stats: dict[str, ClassStats] = {c: ClassStats() for c in PRIORITIES}
         self._clock = clock
         # Liveness signal for the admission deadline: bumped on every
@@ -276,6 +333,11 @@ class DeviceQueue:
         with self._cond:
             return self._inflight
 
+    def load(self) -> int:
+        """Queued + in-flight cost units not yet drained."""
+        with self._cond:
+            return self._pending_cost
+
     # ------------------------------------------------------------ policy
 
     def _pick(self) -> _Waiter | None:
@@ -291,18 +353,19 @@ class DeviceQueue:
         # ahead of the best class — the minimum-share guarantee. Among
         # due classes, the higher-priority one wins (recovery > scrub).
         for c in nonempty[1:]:
-            if self._credit[c] >= self._waiters[c][0].nbytes:
+            if self._credit[c] >= self._waiters[c][0].cost:
                 return self._waiters[c][0]
         return self._waiters[nonempty[0]][0]
 
-    def _admit(self, priority: str, nbytes: int) -> Ticket:
-        nbytes = max(int(nbytes), 1)
-        w = _Waiter(priority, nbytes, self._clock())
+    def _admit(self, priority: str, cost: int) -> Ticket:
+        cost = max(int(cost), 1)
+        w = _Waiter(priority, cost, self._clock())
         with self._cond:
             self._waiters[priority].append(w)
+            self._pending_cost += cost
             st = self._stats[priority]
             st.submitted += 1
-            _queue_depth.inc(cls=priority)
+            _queue_depth.inc(cls=priority, chip=self.label)
             while self._pick() is not w:
                 deadline = (
                     max(w.t_submit, self._last_progress) + self.admit_timeout
@@ -321,7 +384,8 @@ class DeviceQueue:
                     # instead of freezing the whole chip's dispatch
                     # silently forever.
                     self._waiters[priority].remove(w)
-                    _queue_depth.dec(cls=priority)
+                    self._pending_cost -= cost
+                    _queue_depth.dec(cls=priority, chip=self.label)
                     self._cond.notify_all()
                     raise ECError(
                         f"device queue admission timed out after "
@@ -331,7 +395,7 @@ class DeviceQueue:
                     )
             popped = self._waiters[priority].popleft()
             assert popped is w  # only heads are ever picked
-            _queue_depth.dec(cls=priority)
+            _queue_depth.dec(cls=priority, chip=self.label)
             # Bank minimum-share credit for every lower class with work
             # waiting; spend this class's own credit (floored at 0 so a
             # work-conserving free ride never becomes debt).
@@ -341,25 +405,25 @@ class DeviceQueue:
                     s = self.shares.get(lower, 0.0)
                     if s > 0.0:
                         self._credit[lower] = min(
-                            self._credit[lower] + nbytes * s / (1.0 - s),
-                            float(CREDIT_CAP_BYTES),
+                            self._credit[lower] + cost * s / (1.0 - s),
+                            float(CREDIT_CAP_COST),
                         )
-            self._credit[priority] = max(self._credit[priority] - nbytes, 0.0)
+            self._credit[priority] = max(self._credit[priority] - cost, 0.0)
             self._inflight += 1
             self._last_progress = self._clock()
             wait_s = max(self._clock() - w.t_submit, 0.0)
             st.admitted += 1
-            st.admitted_bytes += nbytes
+            st.admitted_cost += cost
             st.inflight += 1
             st.wait_s_total += wait_s
             st.wait_s_max = max(st.wait_s_max, wait_s)
-            _queue_inflight.inc(cls=priority)
-            _queue_admitted.inc(cls=priority)
-            _queue_admitted_bytes.inc(nbytes, cls=priority)
-            _queue_wait_seconds.inc(wait_s, cls=priority)
+            _queue_inflight.inc(cls=priority, chip=self.label)
+            _queue_admitted.inc(cls=priority, chip=self.label)
+            _queue_admitted_cost.inc(cost, cls=priority, chip=self.label)
+            _queue_wait_seconds.inc(wait_s, cls=priority, chip=self.label)
             # Another slot may still be free for the next waiter.
             self._cond.notify_all()
-        return Ticket(priority, nbytes)
+        return Ticket(priority, cost)
 
     def _release(self, ticket: Ticket) -> None:
         with self._cond:
@@ -367,92 +431,229 @@ class DeviceQueue:
                 return
             ticket.released = True
             self._inflight -= 1
+            self._pending_cost -= ticket.cost
             self._last_progress = self._clock()
             st = self._stats[ticket.priority]
             st.inflight -= 1
             st.drained += 1
-            st.drained_bytes += ticket.nbytes
-            _queue_inflight.dec(cls=ticket.priority)
+            st.drained_cost += ticket.cost
+            _queue_inflight.dec(cls=ticket.priority, chip=self.label)
             self._cond.notify_all()
 
 
 # --------------------------------------------------------------------------
-# Registry: one queue per backend instance ("per chip" — backends are
-# lru_cached singletons per (name, k, m)), plus the process-wide knobs
-# the server wiring sets.
+# Scopes: one scheduler/placement config domain + its queue registry.
+# The process-wide default scope backs the module-level functions; a
+# Store may carry a private scope (multi-tenant embedding) so one
+# tenant's configure() stops clobbering another's.
 # --------------------------------------------------------------------------
 
-_registry_lock = threading.Lock()
-_queues: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
-_config: dict = {
-    "enabled": True,
-    "window": DEFAULT_WINDOW,
-    "shares": dict(DEFAULT_SHARES),
-}
+
+_label_lock = threading.Lock()
+_label_seq: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_label_next = itertools.count()
 
 
-def configure(
-    enabled: bool | None = None,
-    window: int | None = None,
-    shares: dict[str, float] | None = None,
-) -> dict:
-    """Process-wide scheduler knobs (server wiring: `ec_device_queue`,
-    per-class shares, window); the LAST caller wins wholesale. A
-    `shares` dict (even empty) REPLACES the whole share map — classes
-    it omits return to DEFAULT_SHARES, so one caller's override can
-    never stick invisibly to the next caller's config; None leaves the
-    current map untouched. Live queues pick the new values up
-    immediately; `enabled=False` makes `for_backend` return None so
-    every producer falls back to its private PR 3 window. Returns the
-    effective config."""
-    with _registry_lock:
-        if enabled is not None:
-            _config["enabled"] = bool(enabled)
-        if window is not None:
-            _config["window"] = max(1, int(window))
+def _queue_label(backend) -> str:
+    """Chip identity for stats/metrics: the pool chip's device id when
+    the backend is (or wraps) a pinned ChipBackend, else the backend
+    class name qualified by its shard ratio and an instance tag (one
+    single-device/mesh backend = one chip) — two same-class backends
+    (e.g. volumes at 10+4 and 5+2) must not merge into one label set.
+    The tag is a process-wide monotonic sequence number (id() bits can
+    collide after allocator reuse, silently summing two backends'
+    gauges into one series)."""
+    label = getattr(backend, "chip_label", "")
+    if not label:
+        label = getattr(getattr(backend, "primary", None), "chip_label", "")
+    if label:
+        return label
+    ctx = getattr(backend, "ctx", None)
+    ratio = (
+        f":{ctx.data_shards}+{ctx.parity_shards}"
+        if ctx is not None
+        else ""
+    )
+    with _label_lock:
+        seq = _label_seq.get(backend)
+        if seq is None:
+            seq = _label_seq[backend] = next(_label_next)
+    return f"{type(backend).__name__}{ratio}@{seq}"
+
+
+class QueueScope:
+    """One scheduler/placement configuration domain.
+
+    Holds the enable flag, window, per-class shares, and the stream
+    placement mode (`auto|mesh|chip`, consumed by ec/chip_pool.py),
+    plus the registry of live DeviceQueues created under this scope.
+    Queues are per (scope, backend): two scopes sharing a chip each get
+    their own admission policy — the multi-tenant contract is isolation
+    of CONFIG, while the physical chip pool (ec/chip_pool.py) stays
+    process-wide so placement still sees total chip load."""
+
+    def __init__(
+        self,
+        enabled: bool = True,
+        window: int = DEFAULT_WINDOW,
+        shares: dict[str, float] | None = None,
+        placement: str = DEFAULT_PLACEMENT,
+    ):
+        self._lock = threading.Lock()
+        self._queues: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+        self._config: dict = {
+            "enabled": True,
+            "window": DEFAULT_WINDOW,
+            "shares": dict(DEFAULT_SHARES),
+            "placement": DEFAULT_PLACEMENT,
+        }
+        self.configure(
+            enabled=enabled, window=window, shares=shares or {},
+            placement=placement,
+        )
+
+    def configure(
+        self,
+        enabled: bool | None = None,
+        window: int | None = None,
+        shares: dict[str, float] | None = None,
+        placement: str | None = None,
+    ) -> dict:
+        """Update this scope's scheduler knobs; the LAST caller wins
+        WITHIN the scope. A `shares` dict (even empty) REPLACES the
+        whole share map — classes it omits return to DEFAULT_SHARES, so
+        one caller's override can never stick invisibly to the next
+        caller's config; None leaves the current map untouched.
+        `placement` selects the chip-pool routing mode (auto|mesh|chip).
+        Live queues pick the new values up immediately; `enabled=False`
+        makes `for_backend` return None so every producer falls back to
+        its private PR 3 window. Returns the effective config.
+
+        Multi-tenant embedders should configure a per-Store scope
+        (`Store(ec_queue_window=...)`) instead of the process-wide
+        default this module's bare `configure()` mutates."""
+        # Validate EVERY input before mutating anything: a rejected
+        # call must not leave the scope half-configured (live queues on
+        # the old window while later-created queues get the new one).
+        merged = None
         if shares is not None:
             merged = dict(DEFAULT_SHARES)
             for cls, s in shares.items():
                 if cls not in PRIORITIES:
                     raise ECError(f"unknown priority class {cls!r}")
                 merged[cls] = min(max(float(s), 0.0), 0.9)
-            _config["shares"] = merged
-        live = list(_queues.values())
-        cfg = {
-            "enabled": _config["enabled"],
-            "window": _config["window"],
-            "shares": dict(_config["shares"]),
-        }
-    for q in live:
-        with q._cond:
-            q.window = cfg["window"]
-            q.shares = dict(cfg["shares"])
-            q._cond.notify_all()
-    return cfg
-
-
-def for_backend(backend) -> DeviceQueue | None:
-    """The shared queue for `backend`'s chip, or None when the scheduler
-    is disabled (or there is no backend — the pass-through pipeline)."""
-    if backend is None:
-        return None
-    with _registry_lock:
-        if not _config["enabled"]:
-            return None
-        q = _queues.get(backend)
-        if q is None:
-            q = DeviceQueue(
-                window=_config["window"], shares=_config["shares"]
+        if placement is not None and placement not in PLACEMENT_MODES:
+            raise ECError(
+                f"unknown ec_placement {placement!r} "
+                f"(want one of {PLACEMENT_MODES})"
             )
-            _queues[backend] = q
-        return q
+        if window is not None:
+            window = max(1, int(window))
+        with self._lock:
+            if enabled is not None:
+                self._config["enabled"] = bool(enabled)
+            if window is not None:
+                self._config["window"] = window
+            if merged is not None:
+                self._config["shares"] = merged
+            if placement is not None:
+                self._config["placement"] = placement
+            live = list(self._queues.values())
+            cfg = {
+                "enabled": self._config["enabled"],
+                "window": self._config["window"],
+                "shares": dict(self._config["shares"]),
+                "placement": self._config["placement"],
+            }
+        for q in live:
+            with q._cond:
+                q.window = cfg["window"]
+                q.shares = dict(cfg["shares"])
+                q._cond.notify_all()
+        return cfg
+
+    @property
+    def enabled(self) -> bool:
+        with self._lock:
+            return self._config["enabled"]
+
+    @property
+    def placement(self) -> str:
+        with self._lock:
+            return self._config["placement"]
+
+    def for_backend(self, backend) -> DeviceQueue | None:
+        """The shared queue for `backend`'s chip under this scope, or
+        None when the scheduler is disabled (or there is no backend —
+        the pass-through pipeline)."""
+        if backend is None:
+            return None
+        with self._lock:
+            if not self._config["enabled"]:
+                return None
+            q = self._queues.get(backend)
+            if q is None:
+                q = DeviceQueue(
+                    window=self._config["window"],
+                    shares=self._config["shares"],
+                    label=_queue_label(backend),
+                )
+                self._queues[backend] = q
+            return q
+
+    def stats_snapshot(self) -> list[dict]:
+        """Per-queue per-class counters for /status and ops tooling,
+        keyed per chip (`chip` = device id for pool chips)."""
+        with self._lock:
+            items = [
+                (type(b).__name__, q) for b, q in self._queues.items()
+            ]
+        return [
+            {
+                "backend": name,
+                "chip": q.label,
+                "window": q.window,
+                "classes": q.stats(),
+            }
+            for name, q in items
+        ]
 
 
-def stats_snapshot() -> list[dict]:
+_DEFAULT_SCOPE = QueueScope()
+
+
+def default_scope() -> QueueScope:
+    """The process-wide scope backing the module-level functions."""
+    return _DEFAULT_SCOPE
+
+
+def resolve_scope(scope: QueueScope | None) -> QueueScope:
+    return scope if scope is not None else _DEFAULT_SCOPE
+
+
+def configure(
+    enabled: bool | None = None,
+    window: int | None = None,
+    shares: dict[str, float] | None = None,
+    placement: str | None = None,
+) -> dict:
+    """Process-wide DEFAULT-scope scheduler knobs; the LAST caller wins
+    wholesale within that scope. See QueueScope.configure for the
+    semantics; per-chip stats surface through `stats_snapshot` keyed by
+    the queue's `chip` label (device id once a chip pool exists).
+    Multi-tenant embedders should thread a per-Store scope through
+    `Store(...)` instead of calling this."""
+    return _DEFAULT_SCOPE.configure(
+        enabled=enabled, window=window, shares=shares, placement=placement
+    )
+
+
+def for_backend(backend, scope: QueueScope | None = None) -> DeviceQueue | None:
+    """The shared queue for `backend`'s chip (in `scope`, default the
+    process-wide scope), or None when the scheduler is disabled."""
+    return resolve_scope(scope).for_backend(backend)
+
+
+def stats_snapshot(scope: QueueScope | None = None) -> list[dict]:
     """Per-queue per-class counters for /status and ops tooling."""
-    with _registry_lock:
-        items = [(type(b).__name__, q) for b, q in _queues.items()]
-    return [
-        {"backend": name, "window": q.window, "classes": q.stats()}
-        for name, q in items
-    ]
+    return resolve_scope(scope).stats_snapshot()

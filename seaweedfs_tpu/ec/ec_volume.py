@@ -69,6 +69,7 @@ class EcVolume:
         remote_reader=None,
         interval_cache_bytes: int = DEFAULT_INTERVAL_CACHE_BYTES,
         interval_cache: ChunkCache | None = None,
+        scheduler=None,
     ):
         """remote_reader(shard_id, offset, size, generation) -> bytes|None
         lets the cluster layer serve shards held by peer servers
@@ -88,7 +89,11 @@ class EcVolume:
         degraded hot volume can use the whole allowance instead of
         being boxed into a per-volume slice while cold volumes' slices
         sit empty. Keys are volume-namespaced; invalidation and close()
-        drop only this volume's extents."""
+        drop only this volume's extents.
+
+        `scheduler` (Store wiring) is the QueueScope whose placement/
+        admission config wide degraded reconstructions run under (None
+        = the process-wide default scope)."""
         from ..storage.volume import Volume
 
         self.volume_id = volume_id
@@ -131,6 +136,7 @@ class EcVolume:
             backend_name, self.ctx.data_shards, self.ctx.parity_shards
         )
         self.remote_reader = remote_reader
+        self.scheduler = scheduler
         # Bitrot sidecar, loaded lazily for degraded-read verification.
         # False = not loaded yet (absence is re-probed per degraded
         # read; only a successful load is cached).
@@ -443,8 +449,13 @@ class EcVolume:
                 describe="ec degraded reconstruction",
                 # Degraded reads ARE serving traffic: they preempt any
                 # colocated recovery/scrub stream at batch granularity
-                # on the shared device queue.
+                # on the shared device queue. On a multi-chip backend
+                # the stream lands whole on the least-loaded chip; a
+                # 1-row reconstruction's admission cost is ~1/m of a
+                # parity encode at equal width (cost model).
                 priority="foreground",
+                scheduler=self.scheduler,
+                cost_hint=size,
             )
             return out.tobytes()
         rec = self.backend.reconstruct(sources, want=[shard_id])

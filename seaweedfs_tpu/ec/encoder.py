@@ -38,6 +38,14 @@ from .volume_info import VolumeInfo
 
 DEFAULT_BATCH = 16 * 1024 * 1024
 
+# A stream at least this large (source bytes: the .dat for encode,
+# k x shard extent for rebuild) counts as "wide" for placement: a lone
+# wide stream on an idle pod keeps the column-mesh slicing (all chips
+# on one stream); anything smaller — or any stream with competitors —
+# is placed whole onto the least-loaded chip (ec/chip_pool.py,
+# `ec_placement=auto`).
+WIDE_STREAM_BYTES = 1 << 30
+
 
 def _pread_padded(fd: int, buf: np.ndarray, offset: int) -> None:
     """Fill `buf` from fd at `offset` IN PLACE (no intermediate bytes
@@ -70,11 +78,14 @@ def write_ec_files(
     large_block_size: int = LARGE_BLOCK_SIZE,
     small_block_size: int = SMALL_BLOCK_SIZE,
     leaf_size: int = BITROT_LEAF_SIZE,
+    scheduler=None,
 ) -> BitrotProtection:
     """Stripe+encode base.dat into base.ec00..; returns bitrot CRCs
     accumulated during the same pass. `leaf_size` > 0 additionally rolls
     the v2 sidecar's per-leaf CRCs (same pass, same bytes); 0 emits a
-    v1 (block-level only) sidecar."""
+    v1 (block-level only) sidecar. `scheduler` is the QueueScope whose
+    placement/admission config this encode stream runs under (None =
+    the process-wide default)."""
     if backend is None:
         backend = get_backend("auto", ctx.data_shards, ctx.parity_shards)
     k, total = ctx.data_shards, ctx.total
@@ -127,10 +138,25 @@ def write_ec_files(
         # stream of the shared per-chip scheduler (ec/device_queue.py),
         # so a colocated background rebuild yields the H2D slot at
         # every batch boundary instead of head-of-line-blocking the
-        # encode. Scheduler disabled -> the PR 3 private window.
-        from .device_queue import for_backend
+        # encode. On a multi-chip backend the WHOLE stream is placed
+        # onto the least-loaded chip (ec/chip_pool.py) — only a huge
+        # lone encode on an idle pod keeps the column-mesh slicing.
+        # Scheduler disabled -> the PR 3 private window on the original
+        # backend.
+        from .chip_pool import place_stream
+        from .device_queue import batch_cost
 
-        dq = for_backend(backend)
+        m = ctx.parity_shards
+        placement = place_stream(
+            backend, "foreground",
+            scope=scheduler,
+            # total admission cost this stream will dispatch: m output
+            # rows per column of the per-shard extent
+            cost_hint=batch_cost(m, -(-dat_size // k)),
+            wide=dat_size >= WIDE_STREAM_BYTES,
+        )
+        enc_backend = placement.backend
+        dq = placement.queue
         stream = (
             dq.stream("foreground", label="ec encode") if dq is not None else None
         )
@@ -144,10 +170,12 @@ def write_ec_files(
             # accordingly. With the shared scheduler the chip-wide
             # bound is the queue's window instead.
             if stream is None:
-                return data, None, backend.encode_staged(backend.to_device(data))
+                return data, None, enc_backend.encode_staged(
+                    enc_backend.to_device(data)
+                )
             ticket, handle = stream.dispatch(
-                lambda: backend.encode_staged(backend.to_device(data)),
-                int(data.nbytes),
+                lambda: enc_backend.encode_staged(enc_backend.to_device(data)),
+                batch_cost(m, data.shape[1]),
             )
             return data, ticket, handle
 
@@ -158,7 +186,7 @@ def write_ec_files(
             # batches queued behind this one.
             try:
                 parity = np.ascontiguousarray(
-                    backend.to_host(parity_handle), dtype=np.uint8
+                    enc_backend.to_host(parity_handle), dtype=np.uint8
                 )
             finally:
                 if ticket is not None:
@@ -180,6 +208,7 @@ def write_ec_files(
         finally:
             if stream is not None:
                 stream.close()
+            placement.close()
 
         # Crash window: shards fully written but not yet durable — a
         # power cut here may leave any suffix of any shard missing.
@@ -210,6 +239,7 @@ def ec_encode_volume(
     batch_size: int = DEFAULT_BATCH,
     version: int = 3,
     leaf_size: int = BITROT_LEAF_SIZE,
+    scheduler=None,
 ) -> VolumeInfo:
     """Full encode of one volume's files (the server-side work of
     VolumeEcShardsGenerate). Order matters: .ecx first (write-race
@@ -224,7 +254,10 @@ def ec_encode_volume(
     write_sorted_file_from_idx(base)
     # Crash window the ecx-first ordering closes: .ecx exists, no shards.
     faults.fire("ec.encode.after_ecx", base=base)
-    prot = write_ec_files(base, ctx, backend, batch_size, leaf_size=leaf_size)
+    prot = write_ec_files(
+        base, ctx, backend, batch_size, leaf_size=leaf_size,
+        scheduler=scheduler,
+    )
     prot.generation = encode_ts_ns
     # Crash window: shards durable, sidecar absent — readers must serve,
     # scrub must refuse (no ground truth), rebuild must still work.

@@ -157,6 +157,9 @@ def run_staged_apply(
     describe: str = "ec staged apply",
     priority: str = "recovery",
     device_queue="auto",
+    scheduler=None,
+    cost_hint: int = 0,
+    wide: bool = False,
 ) -> None:
     """The staged device `apply` driver shared by rebuild, decode, and
     degraded reconstruction: run_pipeline where the transform stage is
@@ -177,11 +180,19 @@ def run_staged_apply(
     The device dispatch is a CLIENT of the shared per-chip scheduler
     (ec/device_queue.py): `priority` tags this stream's class
     (foreground|recovery|scrub) and `device_queue` selects the queue —
-    "auto" resolves the backend's shared queue (None when the scheduler
-    is disabled), an explicit DeviceQueue pins one (tests), None keeps
-    the PR 3 private window. With the scheduler on, the chip-wide
-    in-flight bound lives in the queue's window; without it, up to
-    ~2*queue_size staged batches are alive at once per call site.
+    "auto" resolves the stream's PLACEMENT (ec/chip_pool.py: on a
+    multi-chip mesh backend the whole stream is routed to the
+    least-loaded chip's backend+queue unless `wide` and the pod is
+    idle, per `scheduler`'s `ec_placement` mode), an explicit
+    DeviceQueue pins one on the given backend (tests), None keeps the
+    PR 3 private window. `scheduler` is the QueueScope (None = the
+    process-wide default scope); `cost_hint` is the stream's estimated
+    total admission cost (rows x bytes) used for least-loaded routing.
+    Per-batch admission is cost-denominated (out_rows x width, see
+    device_queue.batch_cost), so a 1-row reconstruction stream no
+    longer charges like a parity encode. With the scheduler on, the
+    chip-wide in-flight bound lives in the queue's window; without it,
+    up to ~2*queue_size staged batches are alive at once per call site.
     """
     if coeffs is None:
         run_pipeline(
@@ -194,10 +205,16 @@ def run_staged_apply(
         )
         return
     coeffs = np.ascontiguousarray(coeffs, dtype=np.uint8)
+    placement = None
     if device_queue == "auto":
-        from .device_queue import for_backend
+        from .chip_pool import place_stream
 
-        device_queue = for_backend(backend)
+        placement = place_stream(
+            backend, priority,
+            scope=scheduler, cost_hint=cost_hint, wide=wide,
+        )
+        backend = placement.backend
+        device_queue = placement.queue
 
     if device_queue is None:
 
@@ -213,24 +230,35 @@ def run_staged_apply(
             out = np.ascontiguousarray(backend.to_host(handle), dtype=np.uint8)
             consume(tag, out)
 
-        run_pipeline(
-            produce,
-            transform,
-            drain,
-            queue_size=queue_size,
-            join_timeout=join_timeout,
-            describe=describe,
-        )
+        try:
+            run_pipeline(
+                produce,
+                transform,
+                drain,
+                queue_size=queue_size,
+                join_timeout=join_timeout,
+                describe=describe,
+            )
+        finally:
+            if placement is not None:
+                placement.close()
         return
 
+    from .device_queue import batch_cost
+
+    out_rows = int(coeffs.shape[0])
     stream = device_queue.stream(priority, label=describe)
 
     def transform_q(item):
         tag, batch = item
-        nbytes = int(getattr(batch, "nbytes", len(batch)))
+        width = (
+            int(batch.shape[-1])
+            if getattr(batch, "ndim", 1) > 1
+            else int(getattr(batch, "nbytes", len(batch)))
+        )
         ticket, handle = stream.dispatch(
             lambda: backend.apply_staged(coeffs, backend.to_device(batch)),
-            nbytes,
+            batch_cost(out_rows, width),
         )
         return tag, ticket, handle
 
@@ -255,8 +283,11 @@ def run_staged_apply(
         )
     finally:
         # Batches parked in an aborted pipeline's write queue never
-        # reach drain_q; their slots are released here.
+        # reach drain_q; their slots are released here — and the chip's
+        # placement charge drains with the stream.
         stream.close()
+        if placement is not None:
+            placement.close()
 
 
 # --------------------------------------------------------------------------

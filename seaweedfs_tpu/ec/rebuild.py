@@ -39,7 +39,7 @@ from .backend import RSBackend, _decode_coeffs, get_backend
 from .bitrot import BitrotError, BitrotProtection
 from .context import BITROT_BLOCK_SIZE, DEFAULT_EC_CONTEXT, ECContext, ECError
 from .decoder import _fsync_dir
-from .encoder import DEFAULT_BATCH
+from .encoder import DEFAULT_BATCH, WIDE_STREAM_BYTES
 from .pipeline import PyShardSink, make_shard_sink, run_pipeline, run_staged_apply
 from .volume_info import VolumeInfo
 
@@ -103,6 +103,7 @@ def rebuild_ec_files(
     only_shards: list[int] | None = None,
     staged: bool = True,
     priority: str = "recovery",
+    scheduler=None,
 ) -> list[int]:
     """Regenerate missing/corrupt shard files; returns regenerated ids.
 
@@ -121,6 +122,12 @@ def rebuild_ec_files(
     scheduler (ec/device_queue.py): "recovery" by default (rebuild and
     decode self-heal restore redundancy behind serving traffic); the
     scrub daemon passes "scrub" so background hygiene yields to both.
+
+    `scheduler` is the QueueScope whose placement/admission config the
+    staged stream runs under (None = the process-wide default scope);
+    on a multi-chip backend the rebuild stream is placed whole onto the
+    least-loaded chip (ec/chip_pool.py) instead of column-slicing
+    across the pod.
     """
     # Sidecar first: it records the shard ratio too, which backs up the
     # .vif for config resolution and cross-checks it.
@@ -292,6 +299,7 @@ def rebuild_ec_files(
             verified_ok=verified_ok,
             staged=staged,
             priority=priority,
+            scheduler=scheduler,
         )
         if bad_src:
             # Confirmed on-disk rot in a source: verify-and-exclude says
@@ -315,6 +323,7 @@ def _attempt_rebuild(
     verified_ok: set[int] | None = None,
     staged: bool = True,
     priority: str = "recovery",
+    scheduler=None,
 ) -> list[int]:
     """One pipelined reconstruction attempt. Publishes and returns []
     on success; returns confirmed-corrupt source ids for the caller to
@@ -471,6 +480,15 @@ def _attempt_rebuild(
                 join_timeout=join_timeout,
                 describe="ec rebuild pipeline",
                 priority=priority,
+                scheduler=scheduler,
+                # total stream cost for least-loaded routing: every
+                # target row spans the whole shard extent
+                cost_hint=len(targets) * shard_size,
+                # a lone huge rebuild on an idle pod keeps the mesh
+                # like a wide encode does — pinning it to one chip
+                # would multiply MTTR exactly while redundancy is
+                # reduced; same source-bytes threshold as encode
+                wide=k * shard_size >= WIDE_STREAM_BYTES,
             )
     except _SourceReadError as e:
         _cleanup_temps()

@@ -209,6 +209,7 @@ def scrub_ec_volume(
     on_quarantine=None,
     on_rebuilt=None,
     bad_retention_s: float | None = None,
+    scheduler=None,
 ) -> ScrubReport:
     """One scrub pass (possibly budget-sliced) over one EC volume.
 
@@ -232,6 +233,10 @@ def scrub_ec_volume(
     file is older than the retention, it is deleted. None (default)
     keeps quarantines forever — retiring evidence is an operator
     opt-in.
+
+    `scheduler` is the QueueScope whose placement/admission config the
+    repair rebuild's scrub-class stream runs under (the daemon passes
+    its Store's scope; None = the process-wide default).
     """
     report = ScrubReport(base=base)
     ecsum = base + ".ecsum"
@@ -484,7 +489,7 @@ def scrub_ec_volume(
             # only its configured minimum share under contention.
             return rebuild_ec_files(
                 base, ctx, backend=backend, only_shards=want_rebuild,
-                priority="scrub",
+                priority="scrub", scheduler=scheduler,
             )
 
         try:
@@ -649,6 +654,10 @@ class ScrubDaemon:
                     breaker=self.breaker_for(vid),
                     expected_shards=sorted(mounted),
                     bad_retention_s=self.bad_retention_s,
+                    # the Store's own scheduler scope (per-tenant
+                    # placement/shares); falls back to the process-wide
+                    # default for bare stores
+                    scheduler=getattr(self.store, "ec_scheduler", None),
                     # Unmount BEFORE rebuild: the serving fd still points
                     # at the renamed .bad inode and would happily serve
                     # rot; degraded reads reconstruct meanwhile.

@@ -42,7 +42,15 @@ def mesh_encode_selfcheck(
     jb = JaxBackend(DEFAULT_EC_CONTEXT, impl="xla", n_devices=n_devices)
     if jb._mesh_rs is None or jb._mesh_rs.n_devices != n_devices:
         raise AssertionError("mesh path did not engage")
-    ec_encode_volume(base, backend=jb, batch_size=batch_size)
+    # Pin placement to "mesh": this check exists to prove the COLUMN-
+    # SLICED multi-chip path is bit-exact; the auto placement policy
+    # would route this small lone encode onto a single chip.
+    from .device_queue import QueueScope
+
+    ec_encode_volume(
+        base, backend=jb, batch_size=batch_size,
+        scheduler=QueueScope(placement="mesh"),
+    )
     mesh_prot = BitrotProtection.load(base + ".ecsum")
     shard_bytes = {}
     for i in range(DEFAULT_EC_CONTEXT.total):

@@ -333,7 +333,10 @@ class VolumeService:
 
         batch = (request.batch_mb << 20) if request.batch_mb else DEFAULT_BATCH
         with M.request_seconds.time(server="volume", op="ec_encode"):
-            vi = ec_encode_volume(base, ctx, backend, batch_size=batch)
+            vi = ec_encode_volume(
+                base, ctx, backend, batch_size=batch,
+                scheduler=self.store.ec_scheduler,
+            )
         M.ec_ops_total.inc(op="encode", backend=backend_name)
         M.ec_bytes_total.inc(dat_size, op="encode", backend=backend_name)
         return pb.EcShardsGenerateResponse(generation=vi.encode_ts_ns)
@@ -378,7 +381,8 @@ class VolumeService:
         try:
             with M.request_seconds.time(server="volume", op="ec_rebuild"):
                 rebuilt = rebuild_ec_files(
-                    loc_base, backend=backend, only_shards=only
+                    loc_base, backend=backend, only_shards=only,
+                    scheduler=self.store.ec_scheduler,
                 )
         except ECError as e:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
@@ -527,7 +531,7 @@ class VolumeService:
             context.abort(grpc.StatusCode.NOT_FOUND, "ec volume not found")
         self.store.unmount_ec_volume(request.volume_id)
         try:
-            ec_decode_volume(base)
+            ec_decode_volume(base, scheduler=self.store.ec_scheduler)
         except ECError as e:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
         # register the decoded normal volume
@@ -886,6 +890,7 @@ class VolumeServer:
         ec_queue_window: int | None = None,
         ec_queue_recovery_share: float | None = None,
         ec_queue_scrub_share: float | None = None,
+        ec_placement: str = "auto",
     ):
         # Shared per-chip device-queue scheduler (ec/device_queue.py):
         # every EC producer on this server submits priority-tagged batch
@@ -893,27 +898,20 @@ class VolumeServer:
         # decode > scrub) instead of owning a private device window.
         # `ec_device_queue=False` restores the PR 3 per-call-site
         # windows; the share knobs set each background class's minimum
-        # fraction of admitted bytes under contention. configure() is
-        # process-wide and last-caller-wins: this construction states
-        # the FULL config (unset knobs = defaults), so the effective
-        # scheduler always matches the most recently constructed
-        # server's arguments — a previous server's overrides never
-        # linger.
-        from ..ec import device_queue as _dq
-
+        # fraction of admitted COST (output rows x bytes) under
+        # contention. `ec_placement` picks the multi-chip stream routing
+        # (ec/chip_pool.py): "auto" places whole streams on the
+        # least-loaded chip (mesh only for a lone wide encode), "chip"
+        # always places, "mesh" restores the PR 4 column-sliced shape.
+        # The whole config lives in a PER-STORE QueueScope (threaded to
+        # every producer below, like the interval cache) instead of the
+        # old process-wide configure(): two servers embedded in one
+        # process no longer clobber each other's scheduler knobs.
         shares = {}
         if ec_queue_recovery_share is not None:
             shares["recovery"] = ec_queue_recovery_share
         if ec_queue_scrub_share is not None:
             shares["scrub"] = ec_queue_scrub_share
-        _dq.configure(
-            enabled=ec_device_queue,
-            window=(
-                _dq.DEFAULT_WINDOW if ec_queue_window is None
-                else ec_queue_window
-            ),
-            shares=shares,
-        )
         self.jwt_key = jwt_key
         self.ip = ip
         self.port = port
@@ -943,6 +941,10 @@ class VolumeServer:
                 None if ec_interval_cache_mb is None
                 else int(ec_interval_cache_mb) << 20
             ),
+            ec_device_queue=ec_device_queue,
+            ec_queue_window=ec_queue_window,
+            ec_queue_shares=shares,
+            ec_placement=ec_placement,
         )
         self.service = VolumeService(self)
 
@@ -1308,12 +1310,15 @@ class VolumeServer:
                     self.wfile.write(body)
                     return
                 if u.path == "/status":
-                    from ..ec import device_queue as _dq
-
                     st = server.store.status()
                     # per-chip per-class scheduler counters (depth /
-                    # wait / throughput) ride along with volume status
-                    st["ec_device_queue"] = _dq.stats_snapshot()
+                    # wait / throughput) ride along with volume status,
+                    # keyed by each queue's `chip` device id — THIS
+                    # server's scope, so a second tenant's chips never
+                    # alias into these gauges
+                    st["ec_device_queue"] = (
+                        server.store.ec_scheduler.stats_snapshot()
+                    )
                     body = json.dumps(st).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")

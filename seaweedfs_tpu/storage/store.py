@@ -14,6 +14,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..ec.context import ECError
+from ..ec.device_queue import QueueScope, default_scope
 from ..ec.ec_volume import EcVolume
 from ..utils.chunk_cache import ChunkCache
 from .needle import Needle
@@ -47,11 +48,14 @@ class DiskLocation:
         ec_backend: str = "auto",
         remote_reader_factory=None,
         ec_interval_cache: "ChunkCache | None | str" = "default",
+        ec_scheduler: "QueueScope | None" = None,
     ) -> None:
         """`ec_interval_cache`: a ChunkCache = the Store-level shared
         budget; None = cache disabled (Store budget 0); "default"
         (direct callers) = each EcVolume keeps its own private default
-        cache, the pre-store-cache behavior."""
+        cache, the pre-store-cache behavior. `ec_scheduler` is the
+        Store's device-queue scope (placement + admission config) for
+        the mounted volumes' degraded reads."""
         if ec_interval_cache == "default":
             cache_kwargs = {}
         else:
@@ -61,6 +65,8 @@ class DiskLocation:
                 "interval_cache": ec_interval_cache,
                 "interval_cache_bytes": 0,
             }
+        if ec_scheduler is not None:
+            cache_kwargs["scheduler"] = ec_scheduler
         for name in sorted(os.listdir(self.directory)):
             m = _DAT_RE.match(name) or _VIF_RE.match(name)
             # a .vif with no local .dat is a cold-tiered volume: it must
@@ -108,6 +114,11 @@ class Store:
         ec_remote_reader_factory=None,
         needle_map_kind: str = "memory",
         ec_interval_cache_bytes: int | None = None,
+        ec_device_queue: bool | None = None,
+        ec_queue_window: int | None = None,
+        ec_queue_shares: dict | None = None,
+        ec_placement: str | None = None,
+        ec_scheduler: "QueueScope | None" = None,
     ):
         self.ip = ip
         self.port = port
@@ -115,6 +126,35 @@ class Store:
         self.ec_backend = ec_backend
         self.ec_remote_reader_factory = ec_remote_reader_factory
         self.needle_map_kind = needle_map_kind
+        # Per-STORE device-queue scheduler/placement scope, threaded to
+        # every EC producer touching this store's volumes exactly like
+        # the interval cache is: a multi-tenant process embedding two
+        # Stores no longer has configure() last-caller-wins — each
+        # tenant's knobs live in its own scope. All knobs None (and no
+        # explicit scope) = the process-wide default scope, so a bare
+        # Store keeps today's behavior.
+        if ec_scheduler is not None:
+            self.ec_scheduler = ec_scheduler
+        elif any(
+            v is not None
+            for v in (
+                ec_device_queue, ec_queue_window, ec_queue_shares,
+                ec_placement,
+            )
+        ):
+            from ..ec.device_queue import DEFAULT_WINDOW
+
+            self.ec_scheduler = QueueScope(
+                enabled=True if ec_device_queue is None else ec_device_queue,
+                window=(
+                    DEFAULT_WINDOW if ec_queue_window is None
+                    else ec_queue_window
+                ),
+                shares=ec_queue_shares,
+                placement=ec_placement or "auto",
+            )
+        else:
+            self.ec_scheduler = default_scope()
         # ONE reconstructed-interval cache budget for the whole store,
         # shared by every EC volume (keys are volume-namespaced; see
         # EcVolume). None = the store default; 0 disables the
@@ -145,7 +185,8 @@ class Store:
         for loc in self.locations:
             os.makedirs(loc.directory, exist_ok=True)
             loc.load_existing(
-                ec_backend, ec_remote_reader_factory, self.ec_interval_cache
+                ec_backend, ec_remote_reader_factory, self.ec_interval_cache,
+                ec_scheduler=self.ec_scheduler,
             )
 
     # ----------------------------------------------------------- lookup
@@ -305,6 +346,7 @@ class Store:
                         else None,
                         interval_cache=self.ec_interval_cache,
                         interval_cache_bytes=0,
+                        scheduler=self.ec_scheduler,
                     )
                     loc.ec_volumes[vid] = ev
                     return ev
