@@ -1633,6 +1633,70 @@ def _self_check() -> int:
             rel()
         check("placement_load_drains", pool.idle() and pool.loads() == [0] * 8)
 
+        # ---- peer-fetch rebuild bit-identity (no servers: injected
+        # byte transport) — a shard regenerated from PEER-FETCHED
+        # sources must be byte-equal to one regenerated from local
+        # sources, and both to the original -------------------------
+        from seaweedfs_tpu.ec.bitrot import (
+            BitrotProtection,
+            ShardChecksumBuilder,
+        )
+        from seaweedfs_tpu.ec.context import ECContext
+        from seaweedfs_tpu.ec.peer_rebuild import rebuild_from_peers
+        from seaweedfs_tpu.ec.rebuild import rebuild_ec_files
+
+        pctx = ECContext(4, 2)
+        pbe = CpuBackend(pctx)
+        prng = np.random.default_rng(0x9EE5)
+        pdata = prng.integers(0, 256, (4, 3 * 4096 + 57), dtype=np.uint8)
+        pshards = np.concatenate([pdata, pbe.encode(pdata)], axis=0)
+        builders = [ShardChecksumBuilder(4096) for _ in range(6)]
+        peer_dir = os.path.join(workdir, "peer")
+        local_dir = os.path.join(workdir, "local")
+        ref_dir = os.path.join(workdir, "ref")
+        for d in (peer_dir, local_dir, ref_dir):
+            os.makedirs(d)
+        for i in range(6):
+            b = pshards[i].tobytes()
+            builders[i].write(b)
+            with open(os.path.join(peer_dir, f"1.ec{i:02d}"), "wb") as f:
+                f.write(b)
+        prot = BitrotProtection.from_builders(pctx, builders, generation=1)
+        # local holds 2 of k=4 sources; shard 5 is the rebuild target
+        for d in (local_dir, ref_dir):
+            prot.save(os.path.join(d, "1.ecsum"))
+            for i in (0, 1):
+                with open(os.path.join(d, f"1.ec{i:02d}"), "wb") as f:
+                    f.write(pshards[i].tobytes())
+        # reference: a LOCAL rebuild with all sources on disk
+        for i in (2, 3):
+            with open(os.path.join(ref_dir, f"1.ec{i:02d}"), "wb") as f:
+                f.write(pshards[i].tobytes())
+        rebuild_ec_files(os.path.join(ref_dir, "1"), pctx, backend=pbe)
+
+        def pfetch(peer, sid, off, size):
+            with open(os.path.join(peer_dir, f"1.ec{sid:02d}"), "rb") as f:
+                f.seek(off)
+                return f.read(size)
+
+        rep = rebuild_from_peers(
+            os.path.join(local_dir, "1"),
+            {2: ["p"], 3: ["p"], 4: ["p"]},
+            pfetch,
+            ctx=pctx,
+            targets=[5],
+            backend=pbe,
+        )
+        peer_bytes = open(os.path.join(local_dir, "1.ec05"), "rb").read()
+        ref_bytes = open(os.path.join(ref_dir, "1.ec05"), "rb").read()
+        check(
+            "peer_fetch_bit_identical",
+            rep.rebuilt == [5]
+            and peer_bytes == ref_bytes
+            and peer_bytes == pshards[5].tobytes(),
+            f"rebuilt={rep.rebuilt} equal_ref={peer_bytes == ref_bytes}",
+        )
+
         # queue-cost accounting: admitted/drained cost sums equal the
         # dispatched work, and the load gauge returns to zero
         q2 = DeviceQueue(window=3)

@@ -52,6 +52,12 @@ from .ec_volume import EcCookieMismatch, EcNotFoundError, EcVolume
 from .encoder import ec_encode_volume, write_ec_files, write_sorted_file_from_idx
 from .locate import Interval, locate_data
 from .pipeline import FusedShardSink, PyShardSink, make_shard_sink, run_pipeline
+from .peer_rebuild import (
+    PeerCorruptError,
+    PeerFetchTransient,
+    PeerRebuildReport,
+    rebuild_from_peers,
+)
 from .rebuild import rebuild_ec_files
 from .scrub import (
     QUARANTINE_SUFFIX,

@@ -17,12 +17,15 @@ All backends consume/produce numpy uint8 arrays of shape (rows, n).
 from __future__ import annotations
 
 import functools
+import itertools
+import weakref
 from typing import Protocol
 
 import numpy as np
 
 from .. import faults
 from ..ops import gf256
+from ..utils import metrics as _M
 from ..utils.glog import logger
 from .context import ECContext, ECError
 
@@ -248,6 +251,15 @@ class JaxBackend(_BackendBase):
         return self._rs.apply(coeffs, staged)
 
     def to_host(self, result) -> np.ndarray:
+        # TPU-side chaos hook: the kernel was LAUNCHED (encode_staged/
+        # apply_staged dispatched it non-blocking) and this fetch is
+        # where a reset/hung device actually surfaces. A raised IOError
+        # here models a mid-kernel device reset, so FallbackBackend's
+        # to_host failover (CPU replay of the carried host batch) is
+        # exercisable — not just pre-dispatch death.
+        faults.fire(
+            "ec.device.kernel_fetch", impl=getattr(self._rs, "impl", "")
+        )
         if self._mesh_rs is not None:
             arr, n = result
             return np.asarray(arr, dtype=np.uint8)[:, :n]
@@ -263,6 +275,37 @@ class JaxBackend(_BackendBase):
 
     def apply(self, coeffs: np.ndarray, data: np.ndarray) -> np.ndarray:
         return np.asarray(self._rs.apply(coeffs, np.asarray(data, np.uint8)))
+
+
+# Live FallbackBackend registry for the breaker-health gauge: sampled
+# at /metrics scrape time (callback gauge), so an open breaker shows up
+# without any code path having to remember to publish it. Weak refs —
+# the gauge must never keep a dead backend (and its device state) alive.
+_FALLBACKS: "weakref.WeakSet" = weakref.WeakSet()
+_fallback_seq = itertools.count()
+
+
+def _breaker_samples():
+    # Dedupe by chip label: several live FallbackBackends can wrap the
+    # SAME physical chip (one per pooled backend / Store / EC ratio),
+    # and duplicate series in one exposition are invalid Prometheus —
+    # the whole scrape would fail exactly when the pod is busy. Any
+    # open breaker marks the chip degraded.
+    by_chip: dict[str, float] = {}
+    for be in list(_FALLBACKS):
+        label = be.chip_label or f"{type(be.primary).__name__}@{be._seq}"
+        is_open = 1.0 if be.breaker.state == "open" else 0.0
+        by_chip[label] = max(by_chip.get(label, 0.0), is_open)
+    for label, val in sorted(by_chip.items()):
+        yield {"chip": label}, val
+
+
+_M.REGISTRY.gauge(
+    "sw_ec_chip_breaker_open",
+    "EC device fallback breaker open per chip (1 = streams on CPU)",
+    ("chip",),
+    fn=_breaker_samples,
+)
 
 
 class FallbackBackend(_BackendBase):
@@ -301,6 +344,8 @@ class FallbackBackend(_BackendBase):
         # (ec/chip_pool.py): rides into the fault-point context so
         # chaos tests can kill ONE chip, and into queue stats labels.
         self.chip_label = getattr(primary, "chip_label", "")
+        self._seq = next(_fallback_seq)
+        _FALLBACKS.add(self)
         self._log = logger("ec.backend")
 
     # Deterministic caller errors (bad shape/dtype/shard-count): the CPU

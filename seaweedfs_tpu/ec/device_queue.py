@@ -603,19 +603,24 @@ class QueueScope:
 
     def stats_snapshot(self) -> list[dict]:
         """Per-queue per-class counters for /status and ops tooling,
-        keyed per chip (`chip` = device id for pool chips)."""
+        keyed per chip (`chip` = device id for pool chips). `breaker`
+        carries the chip's fallback-breaker state ("open" = this chip's
+        streams are failing over to CPU; "" = the backend has no
+        breaker) so the server can surface pod health."""
         with self._lock:
             items = [
-                (type(b).__name__, q) for b, q in self._queues.items()
+                (type(b).__name__, getattr(b, "breaker", None), q)
+                for b, q in self._queues.items()
             ]
         return [
             {
                 "backend": name,
                 "chip": q.label,
                 "window": q.window,
+                "breaker": brk.state if brk is not None else "",
                 "classes": q.stats(),
             }
-            for name, q in items
+            for name, brk, q in items
         ]
 
 

@@ -1,0 +1,377 @@
+"""Peer-fetch EC rebuild: recover when no single server holds k shards.
+
+Per-server rebuild (ec/rebuild.py) refuses when fewer than k source
+shards are on local disk — correct, but on a balanced cluster EVERY
+holder is a subset holder, so a quarantined shard could never be
+regenerated anywhere. The reference solves this at the maintenance
+layer (ec.rebuild collects shards onto one node first); this module is
+the streaming equivalent: fetch just enough sibling shards from peer
+holders through the shard-read RPC, rebuild locally on the TPU through
+the staged/scheduled path, and publish only the regenerated targets.
+
+Correctness envelope (the same verify-and-exclude rules as the local
+rebuild, extended across the wire):
+
+- every fetched stream is verified against the .ecsum sidecar at the
+  sidecar's own granularity WHILE it streams — a peer serving corrupt
+  bytes is excluded (after one immediate re-read to rule out transient
+  wire corruption) and the plan re-routes to another holder or another
+  shard; transient failures (RPC errors, torn/short streams) retry
+  under utils/retry.py before the holder is abandoned;
+- fewer than k verified sources reachable = clean refusal: staging is
+  wiped, nothing is published, the canonical files are untouched;
+- fetched sources live ONLY in a staging directory next to the volume
+  (hard links for verified-good local shards, downloads for the rest)
+  so the local server never holds publishable copies of shards the
+  master placed on peers — no duplicate minting, even across crashes;
+- regenerated targets publish with the local rebuild's own machinery
+  (temp + fsync + sidecar re-verify + atomic rename inside staging,
+  then one rename per target into the canonical directory), so a
+  re-run after any crash window converges idempotently.
+
+The actual byte transport is injected (`fetch`), so the core is
+testable without servers; server/volume_server.py wires it to the
+VolumeEcShardRead RPC with the generation fence, and distributes
+regenerated shards the local server does not own to planned holders
+(ec/placement.py).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+from .. import faults
+from ..utils.crc import crc32c
+from ..utils.fs import fsync_dir
+from ..utils.glog import logger
+from ..utils.retry import RetryError, RetryPolicy, retry_call
+from .bitrot import BitrotError, BitrotProtection
+from .context import ECContext, ECError
+from .rebuild import rebuild_ec_files
+from .volume_info import VolumeInfo
+
+log = logger("ec.peer")
+
+# Transient fetch failures (RPC errors, torn/short streams) retry
+# quickly and give up fast: with several candidate holders per shard, a
+# dead peer should cost milliseconds, not a backoff tail. ECError is
+# never retried — refusals are deterministic.
+DEFAULT_FETCH_POLICY = RetryPolicy(
+    max_attempts=3, base_delay=0.05, max_delay=0.5,
+)
+
+# Fetch request size: granule-aligned so the sidecar CRC verdict lands
+# per chunk (bounded memory, early corrupt-peer detection).
+FETCH_CHUNK = 1 << 20
+
+STAGING_PREFIX = ".peerfetch-"
+
+
+class PeerFetchTransient(Exception):
+    """One fetch attempt failed in a retryable way (RPC error, short or
+    torn stream). `fetch` implementations raise this for transport
+    errors; persistent transients abandon the holder, not the plan."""
+
+
+class PeerCorruptError(Exception):
+    """A peer served bytes that fail sidecar verification even on a
+    re-read: the holder is serving rot and is excluded from the plan."""
+
+    def __init__(self, peer: str, shard: int, granule: int):
+        super().__init__(
+            f"peer {peer} serves corrupt bytes for shard {shard} "
+            f"(granule {granule})"
+        )
+        self.peer = peer
+        self.shard = shard
+
+
+@dataclass
+class PeerRebuildReport:
+    """What one peer-fetch rebuild attempt did."""
+
+    rebuilt: list[int] = field(default_factory=list)
+    fetched: dict[int, str] = field(default_factory=dict)  # sid -> peer
+    local_sources: list[int] = field(default_factory=list)
+    corrupt_local: list[int] = field(default_factory=list)
+    excluded_peers: list[str] = field(default_factory=list)
+
+
+def staging_dir(base: str) -> str:
+    """Staging directory for one volume's peer-fetch rebuild (same
+    filesystem as the volume, so hard links and renames work)."""
+    d, name = os.path.split(base)
+    return os.path.join(d, STAGING_PREFIX + name)
+
+
+def _clear_staging(sdir: str) -> None:
+    shutil.rmtree(sdir, ignore_errors=True)
+
+
+def _verify_local(
+    base: str, ctx: ECContext, prot: BitrotProtection, present: list[int]
+) -> tuple[list[int], list[int]]:
+    """(verified-good, corrupt) split of the local present shards. An
+    unreadable or size-mismatched shard counts corrupt — it must never
+    be fed to Reed-Solomon."""
+
+    def check(i: int) -> bool:
+        p = base + ctx.to_ext(i)
+        try:
+            if os.path.getsize(p) != prot.shard_sizes[i]:
+                return True
+            return bool(prot.verify_shard_file(p, i, stop_early=True))
+        except OSError:
+            return True
+
+    if len(present) <= 1:
+        flags = [check(i) for i in present]
+    else:
+        with ThreadPoolExecutor(max_workers=min(len(present), 8)) as ex:
+            flags = list(ex.map(check, present))
+    corrupt = [i for i, bad in zip(present, flags) if bad]
+    return [i for i in present if i not in corrupt], corrupt
+
+
+def _fetch_shard_verified(
+    sbase: str,
+    peer: str,
+    sid: int,
+    prot: BitrotProtection,
+    ctx: ECContext,
+    fetch,
+    policy: RetryPolicy,
+) -> None:
+    """Stream one whole shard from `peer` into staging, rolling the
+    sidecar CRC per granule as the bytes land. Raises PeerCorruptError
+    when a granule mismatches even after one immediate re-read (the
+    transient-wire-corruption escape), PeerFetchTransient/RetryError
+    when the peer stays unreachable. Publishes atomically INSIDE
+    staging; a partial download never looks like a shard."""
+    gsize, gcrcs = prot.verify_granularity(sid)
+    size = prot.shard_sizes[sid]
+    chunk = max(FETCH_CHUNK - FETCH_CHUNK % gsize, gsize)
+    dest = sbase + ctx.to_ext(sid)
+    tmp = dest + ".fetching"
+
+    def get(off: int, n: int) -> bytes:
+        def attempt() -> bytes:
+            try:
+                # Named client-side chaos point: a raised IOError is a
+                # transient fetch failure; a mutate corrupts the stream
+                # the way a rotten peer (or a bad NIC) would, which the
+                # granule CRC below must catch.
+                faults.fire(
+                    "ec.peer_fetch.read", peer=peer, shard=sid, offset=off
+                )
+                data = fetch(peer, sid, off, n)
+            except (PeerFetchTransient, PeerCorruptError):
+                raise
+            except (IOError, OSError) as e:
+                raise PeerFetchTransient(str(e)) from e
+            data = faults.mutate(
+                "ec.peer_fetch.read", data, peer=peer, shard=sid, offset=off
+            )
+            if len(data) != n:
+                raise PeerFetchTransient(
+                    f"short read from {peer} for shard {sid}: "
+                    f"{len(data)}/{n} bytes at {off}"
+                )
+            return data
+
+        return retry_call(
+            attempt, policy, retry_on=(PeerFetchTransient,),
+            describe=f"peer fetch {peer} shard {sid}",
+        )
+
+    try:
+        with open(tmp, "wb") as f:
+            off = 0
+            gi = 0
+            while off < size:
+                n = min(chunk, size - off)
+                data = get(off, n)
+                # granule-level sidecar verdict while the chunk is hot
+                for j in range(0, n, gsize):
+                    g = data[j : j + gsize]
+                    if gi >= len(gcrcs) or crc32c(g) != gcrcs[gi]:
+                        # one immediate re-read rules out transient wire
+                        # corruption; a repeat mismatch is the PEER
+                        # serving rot. Re-read ONLY this granule's byte
+                        # range: the rest of `data` already passed its
+                        # CRCs, and re-pulling the whole chunk would
+                        # cost up to chunk/gsize times the wire traffic
+                        # to splice out one granule.
+                        g2 = get(off + j, len(g))
+                        if gi >= len(gcrcs) or crc32c(g2) != gcrcs[gi]:
+                            raise PeerCorruptError(peer, sid, gi)
+                        data = data[:j] + g2 + data[j + gsize :]
+                    gi += 1
+                f.write(data)
+                off += n
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, dest)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def rebuild_from_peers(
+    base: str,
+    holders: dict[int, list[str]],
+    fetch,
+    *,
+    ctx: ECContext | None = None,
+    targets: list[int] | None = None,
+    backend=None,
+    scheduler=None,
+    priority: str = "recovery",
+    policy: RetryPolicy = DEFAULT_FETCH_POLICY,
+) -> PeerRebuildReport:
+    """Regenerate `targets` for the volume at `base`, fetching sibling
+    source shards from peer holders when fewer than k verified-good
+    shards are on local disk.
+
+    `holders` maps shard id -> peer ids that serve it (the LOCAL server
+    must already be excluded); `fetch(peer, shard_id, offset, size)`
+    returns exactly `size` bytes or raises PeerFetchTransient.
+    `targets=None` regenerates every shard that is not locally
+    verified-good; an explicit list restricts regeneration to those ids
+    (the server passes its legitimate-set union cluster-lost, the same
+    no-duplicate-minting contract as the local rebuild RPC) —
+    present-but-corrupt local shards are always replaced regardless.
+
+    Fail-closed: no (or malformed) .ecsum refuses — peer bytes cannot
+    be trusted unverified; fewer than k reachable verified sources
+    refuses with nothing published and staging wiped.
+    """
+    ecsum = base + ".ecsum"
+    if not os.path.exists(ecsum):
+        raise ECError(
+            f"peer-fetch rebuild for {base} needs the .ecsum sidecar to "
+            f"verify fetched streams; refusing"
+        )
+    try:
+        prot = BitrotProtection.load(ecsum)
+    except BitrotError as e:
+        raise ECError(
+            f"bitrot sidecar for {base} is malformed ({e}); refusing "
+            f"peer-fetch rebuild"
+        ) from e
+    if ctx is None:
+        vif = base + ".vif"
+        if os.path.exists(vif):
+            vi = VolumeInfo.load(vif)
+            ctx = vi.ec_ctx
+        if ctx is None:
+            ctx = prot.ctx
+    if prot.ctx != ctx:
+        raise ECError(
+            f"bitrot sidecar for {base} records ratio {prot.ctx} but the "
+            f"volume config says {ctx}; refusing peer-fetch rebuild"
+        )
+    k = ctx.data_shards
+
+    report = PeerRebuildReport()
+    present = [
+        i for i in range(ctx.total) if os.path.exists(base + ctx.to_ext(i))
+    ]
+    good_local, corrupt_local = _verify_local(base, ctx, prot, present)
+    report.local_sources = list(good_local)
+    report.corrupt_local = list(corrupt_local)
+
+    if targets is None:
+        want = sorted(set(range(ctx.total)) - set(good_local))
+    else:
+        # present-but-corrupt shards are always replaced, like the
+        # local rebuild's verify-and-exclude contract
+        want = sorted(set(targets) | set(corrupt_local))
+        want = [i for i in want if i not in good_local]
+    if not want:
+        return report
+
+    sdir = staging_dir(base)
+    _clear_staging(sdir)  # leftovers from a crashed attempt
+    os.makedirs(sdir, exist_ok=True)
+    sbase = os.path.join(sdir, os.path.basename(base))
+
+    excluded: set[str] = set()
+    try:
+        # ---- assemble k verified sources: local links + peer streams --
+        sources = set(good_local)
+        candidates = sorted(
+            sid
+            for sid, peers in holders.items()
+            if peers and sid not in sources and sid not in want
+            and 0 <= sid < ctx.total
+        )
+        for sid in candidates:
+            if len(sources) >= k:
+                break
+            for peer in holders[sid]:
+                if peer in excluded:
+                    continue
+                try:
+                    _fetch_shard_verified(
+                        sbase, peer, sid, prot, ctx, fetch, policy
+                    )
+                except PeerCorruptError as e:
+                    # verify-and-exclude across the wire: this holder
+                    # serves rot; nothing it sends is trustworthy
+                    log.warning("excluding peer: %s", e)
+                    excluded.add(peer)
+                    continue
+                except (PeerFetchTransient, RetryError) as e:
+                    log.warning(
+                        "peer %s unreachable for shard %d: %s", peer, sid, e
+                    )
+                    continue
+                sources.add(sid)
+                report.fetched[sid] = peer
+                break
+        report.excluded_peers = sorted(excluded)
+        if len(sources) < k:
+            raise ECError(
+                f"peer-fetch rebuild for {base}: only {len(sources)} "
+                f"verified source shards reachable (local "
+                f"{sorted(good_local)}, fetched "
+                f"{sorted(report.fetched)}, excluded peers "
+                f"{sorted(excluded)}); need {k} — refusing, nothing "
+                f"published"
+            )
+
+        # ---- stage local sources + sidecars, rebuild, publish ---------
+        # exactly k staged inputs: linking surplus local shards would
+        # only buy extra verification reads inside the rebuild
+        for sid in sorted(good_local)[: k - len(report.fetched)]:
+            os.link(base + ctx.to_ext(sid), sbase + ctx.to_ext(sid))
+        os.link(ecsum, sbase + ".ecsum")
+        if os.path.exists(base + ".vif"):
+            os.link(base + ".vif", sbase + ".vif")
+
+        rebuilt = rebuild_ec_files(
+            sbase,
+            ctx,
+            backend=backend,
+            only_shards=want,
+            scheduler=scheduler,
+            priority=priority,
+        )
+
+        # Crash window: regenerated targets are durable in staging but
+        # not yet at the canonical paths. A crash here (or between the
+        # per-target renames below) republishes idempotently on re-run:
+        # already-renamed targets verify good and drop out of `want`.
+        faults.fire("ec.peer_rebuild.before_publish", base=base)
+        for sid in sorted(rebuilt):
+            os.replace(sbase + ctx.to_ext(sid), base + ctx.to_ext(sid))
+            faults.fire("ec.peer_rebuild.after_publish", base=base, shard=sid)
+            report.rebuilt.append(sid)
+        fsync_dir(base + ".ecsum")
+    finally:
+        _clear_staging(sdir)
+    return report

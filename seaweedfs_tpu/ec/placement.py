@@ -108,6 +108,27 @@ def plan_ec_balance(
     return drops, moves[:max_moves]
 
 
+def plan_shard_placement(
+    nodes: list[NodeView], vid: int, shard_ids: list[int]
+) -> dict[int, str]:
+    """Pick a destination server for each regenerated shard of `vid`
+    (peer-fetch rebuild's distribute step): the same scoring the
+    balancer uses for a move destination — fewest shards of THIS volume
+    (spread the loss domain), then fewest total shards, then most free
+    slots. Mutates the views as it assigns so successive shards spread
+    instead of stacking on one idle node. Shards no node can take are
+    absent from the result (the caller keeps them local)."""
+    plan: dict[int, str] = {}
+    for sid in sorted(shard_ids):
+        dest = _pick_dest_node(nodes, vid)
+        if dest is None:
+            continue
+        dest.shards.setdefault(vid, set()).add(sid)
+        dest.free_slots -= 1
+        plan[sid] = dest.id
+    return plan
+
+
 # ------------------------------------------------------------------ stages
 
 

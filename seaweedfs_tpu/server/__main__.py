@@ -56,6 +56,11 @@ def main(argv=None) -> int:
         help="auto-submit ec_encode for volumes at this fraction of the size limit (0=off)",
     )
     m.add_argument(
+        "-ec.scrubInterval", dest="ec_scrub_interval", type=float, default=0.0,
+        help="fleet scrub period in seconds: every EC volume verified "
+        "once per period via ec_scrub worker tasks (0=off)",
+    )
+    m.add_argument(
         "-peers", default="",
         help="comma-separated HA master group incl. this node (host:port,...)",
     )
@@ -174,6 +179,10 @@ def main(argv=None) -> int:
     s.add_argument(
         "-ec.autoFullness", dest="ec_auto", type=float, default=None,
         help="auto-submit ec_encode for volumes at this fraction of the size limit (0=off)",
+    )
+    s.add_argument(
+        "-ec.scrubInterval", dest="ec_scrub_interval", type=float, default=0.0,
+        help="fleet scrub period in seconds (0=off)",
     )
     s.add_argument("-webdavPort", type=int, default=7333)
     s.add_argument("-sftp", action="store_true", help="also run the SFTP gateway")
@@ -369,6 +378,7 @@ def main(argv=None) -> int:
             telemetry_url=getattr(a, "telemetry_url", ""),
             garbage_threshold=getattr(a, "garbage_threshold", 0.3),
             vacuum_interval=getattr(a, "vacuum_interval", 60.0),
+            ec_scrub_interval=getattr(a, "ec_scrub_interval", 0.0),
         )
         ms.start()
         servers.append(ms)

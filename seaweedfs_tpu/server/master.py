@@ -476,6 +476,7 @@ class MasterServer:
         vacuum_interval: float = 60.0,
         ec_auto_fullness: float = 0.0,
         ec_quiet_seconds: float = 60.0,
+        ec_scrub_interval: float = 0.0,
         peers: list[str] | str | None = None,
         meta_dir: str | None = None,
         election_timeout: tuple[float, float] = (0.4, 0.8),
@@ -524,6 +525,12 @@ class MasterServer:
         self.vacuum_interval = vacuum_interval
         self.ec_auto_fullness = ec_auto_fullness
         self.ec_quiet_seconds = ec_quiet_seconds
+        # Fleet scrub period (seconds, 0 = off): every EC volume's
+        # shards get sidecar-verified once per period FLEET-WIDE via
+        # ec_scrub worker tasks, staggered one volume per maintenance
+        # tick; unrebuildable holders get peer-fetch rebuilds dispatched
+        # from the aggregated reports (worker/control.py).
+        self.ec_scrub_interval = ec_scrub_interval
         self.balance_spread = 0.0  # 0 = auto-balance scanner off
         self.lifecycle_interval = 0.0  # 0 = lifecycle sweeps off
         self.lifecycle_filer = ""
@@ -695,6 +702,12 @@ class MasterServer:
                                 }
                                 for n in topo.nodes
                             ],
+                            # fleet scrub health: per-holder bitrot /
+                            # quarantine aggregated from ec_scrub task
+                            # reports (worker/control.py)
+                            "EcFleetScrub": (
+                                master.worker_control.scrub_summary()
+                            ),
                         },
                     )
                 else:
@@ -894,6 +907,13 @@ class MasterServer:
                     if now - self._ec_balance_last >= self.ec_balance_interval:
                         self._ec_balance_last = now
                         self.worker_control.scan_for_ec_balance(self.topo)
+                if self.ec_scrub_interval > 0:
+                    # per-volume due-ness lives in the scanner; calling
+                    # it every tick is what staggers volumes across the
+                    # period instead of stampeding at each deadline
+                    self.worker_control.scan_for_ec_scrub(
+                        self.topo, self.ec_scrub_interval
+                    )
             except Exception as e:
                 log.error(
                     "maintenance tick failed (%s: %s); loop continues",

@@ -21,6 +21,7 @@ from urllib.parse import parse_qs, urlparse
 import grpc
 
 from ..ec import context as ec_context
+from ..ec import fleet
 from ..ec.context import ECError
 from ..ec.decoder import ec_decode_volume
 from ..ec.encoder import ec_encode_volume
@@ -345,6 +346,24 @@ class VolumeService:
         loc_base = self._ec_base(request.volume_id, request.collection)
         if loc_base is None:
             context.abort(grpc.StatusCode.NOT_FOUND, "ec volume not found")
+        if request.from_peers:
+            # Cluster-level rebuild: a subset holder (< k local shards)
+            # streams sibling shards from peer holders, rebuilds on the
+            # local device, and distributes regenerated cluster-lost
+            # shards to planned holders (server.peer_fetch_rebuild).
+            try:
+                out = self.server.peer_fetch_rebuild(
+                    request.volume_id,
+                    collection=request.collection,
+                    backend_name=request.backend,
+                )
+            except ECError as e:
+                context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+            return pb.EcShardsRebuildResponse(
+                rebuilt_shard_ids=out["rebuilt"],
+                fetched_shard_ids=out["fetched"],
+                distributed_shard_ids=out["distributed"],
+            )
         from ..ec.backend import get_backend
         from ..ec.volume_info import VolumeInfo
 
@@ -811,9 +830,29 @@ class VolumeService:
                 bad.append(i)
         # checked_shards lets the shell do a real per-sid set difference
         # against the master's advertised placement; the bare count can
-        # be masked by non-advertised local shard files.
+        # be masked by non-advertised local shard files. Quarantined
+        # shards (renamed .bad, unmounted, so never "advertised") ride
+        # along — the fleet scrub loop needs them to spot a holder that
+        # is quarantined-but-unrebuildable and route a peer-fetch
+        # rebuild at it. A quarantine whose canonical shard is back on
+        # disk and verified good THIS pass is healed, not hurt: the
+        # .bad file stays for forensics (bad_retention_s ages it out),
+        # but reporting it would have the fleet loop dispatch a no-op
+        # rebuild at this holder every scrub period forever.
+        healed = set(checked) - set(bad)
+        quarantined = [
+            i
+            for i in range(prot.ctx.total)
+            if i not in healed
+            and os.path.exists(
+                base + prot.ctx.to_ext(i) + ec_context.QUARANTINE_SUFFIX
+            )
+        ]
         return pb.ScrubResponse(
-            checked=len(checked), bad_shards=bad, checked_shards=checked
+            checked=len(checked),
+            bad_shards=bad,
+            checked_shards=checked,
+            quarantined_shards=quarantined,
         )
 
     def VolumeServerStatus(self, request, context):
@@ -927,6 +966,10 @@ class VolumeServer:
         self._mc = None
         self._mc_lock = threading.Lock()
         self._peer_channels: dict[str, grpc.Channel] = {}
+        # vid -> Lock: serializes peer-fetch rebuild per volume (the
+        # staging dir is per-volume; concurrent runs would wipe each
+        # other). dict.setdefault is atomic under the GIL.
+        self._peer_rebuild_busy: dict[int, threading.Lock] = {}
         self.store = Store(
             directories,
             ip=ip,
@@ -1050,6 +1093,287 @@ class VolumeServer:
             return None
 
         return read
+
+    # ---------------------------------------------- peer-fetch rebuild
+
+    def peer_fetch_rebuild(
+        self, vid: int, collection: str = "", backend_name: str = ""
+    ) -> dict:
+        """Cluster-level EC self-heal for one volume on THIS server:
+        when fewer than k verified-good source shards are on local
+        disk, stream siblings from peer holders (VolumeEcShardRead,
+        generation-fenced, sidecar-verified with verify-and-exclude —
+        ec/peer_rebuild.py), rebuild through the staged/scheduled
+        device path, mount the regenerated shards this server owns,
+        and distribute regenerated CLUSTER-LOST shards to planned
+        holders (ec/placement.py) before handing them off. Idempotent:
+        a re-run after any crash window (publish, distribute)
+        converges without minting duplicate copies."""
+        # One peer rebuild per volume at a time on this server: a
+        # concurrent second call (operator shell racing the fleet
+        # dispatcher — the worker-control one-live-task dedupe only
+        # covers tasks) would wipe the first call's staging directory
+        # mid-flight. Refuse, don't queue: the first run heals the
+        # volume and a refused caller re-runs idempotently.
+        busy = self._peer_rebuild_busy.setdefault(vid, threading.Lock())
+        if not busy.acquire(blocking=False):
+            raise ECError(
+                f"peer-fetch rebuild for ec volume {vid} is already "
+                f"running on this server; re-run after it finishes"
+            )
+        try:
+            return self._peer_fetch_rebuild_locked(
+                vid, collection, backend_name
+            )
+        finally:
+            busy.release()
+
+    def _peer_fetch_rebuild_locked(
+        self, vid: int, collection: str, backend_name: str
+    ) -> dict:
+        loc_base = self.service._ec_base(vid, collection)
+        if loc_base is None:
+            raise ECError(f"ec volume {vid} not found on this server")
+        from ..ec.peer_rebuild import PeerFetchTransient, rebuild_from_peers
+        from ..ec.volume_info import VolumeInfo
+
+        vi = VolumeInfo.maybe_load(loc_base + ".vif")
+        ctx = (vi.ec_ctx if vi else None) or ec_context.ECContext()
+        generation = vi.encode_ts_ns if vi else 0
+        ev = self.store.find_ec_volume(vid)
+        if ev is None:
+            # an unmounted volume has no legitimate-set to scope targets
+            # by — distribution would ship this server's own shards
+            # away. Offline repair keeps the local rebuild path.
+            raise ECError(
+                f"ec volume {vid} is not mounted here; peer-fetch "
+                f"rebuild needs the serving mount"
+            )
+        legit = set(ev.legitimate_shards())
+
+        # Fresh holder map (a balance move since the cached lookup would
+        # route fetches at a server that no longer has the shard); the
+        # master is REQUIRED here — without topology there is no safe
+        # notion of "lost" vs "lives on a peer".
+        try:
+            located = self._master_client().lookup_ec(vid, refresh=True)
+        except (LookupError, grpc.RpcError) as e:
+            raise ECError(f"peer-fetch rebuild needs the master: {e}") from e
+        me = f"{self.ip}:{self.port}"
+        holders: dict[int, list[str]] = {}
+        for sid, locs in located.items():
+            peers = [fleet.grpc_addr(l) for l in locs if l.url != me]
+            if peers:
+                holders[sid] = peers
+        lost = {sid for sid in range(ctx.total) if not located.get(sid)}
+        present = {
+            i
+            for i in range(ctx.total)
+            if os.path.exists(loc_base + ctx.to_ext(i))
+        }
+        # Same no-duplicate-minting contract as the local rebuild RPC:
+        # regenerate only this server's legitimate set plus shards the
+        # master knows no location for. Present-but-corrupt locals are
+        # replaced by rebuild_from_peers regardless.
+        targets = sorted((legit | lost) - present)
+
+        def fetch(peer: str, sid: int, off: int, size: int) -> bytes:
+            try:
+                buf = bytearray()
+                for c in self._peer_stub(peer).VolumeEcShardRead(
+                    pb.EcShardReadRequest(
+                        volume_id=vid,
+                        shard_id=sid,
+                        offset=off,
+                        size=size,
+                        generation=generation,
+                    ),
+                    timeout=60,
+                ):
+                    buf += c.data
+            except grpc.RpcError as e:
+                # mid-stream peer death / stale generation / unreachable:
+                # all retry-then-replan material, never a crash
+                raise PeerFetchTransient(
+                    f"{peer}: {e.code().name}: {e.details()}"
+                ) from e
+            return bytes(buf)
+
+        from ..ec.backend import get_backend
+
+        backend = get_backend(
+            backend_name or self.store.ec_backend,
+            ctx.data_shards,
+            ctx.parity_shards,
+        )
+        with M.request_seconds.time(server="volume", op="ec_peer_rebuild"):
+            report = rebuild_from_peers(
+                loc_base,
+                holders,
+                fetch,
+                ctx=ctx,
+                targets=targets,
+                backend=backend,
+                scheduler=self.store.ec_scheduler,
+            )
+        M.ec_ops_total.inc(
+            op="peer_rebuild", backend=backend_name or self.store.ec_backend
+        )
+        # Locally-owned regenerated shards re-enter service: swap the
+        # mounted fds onto the fresh inodes (quarantined shards come
+        # back too) and advertise via heartbeat. legit already covers
+        # every corrupt shard this server may mount — served rot is in
+        # shard_fds, quarantined rot rides legitimate_shards(); a
+        # corrupt NON-legit file is a rotten handoff leftover, and
+        # mounting it here would advertise a holder that the distribute
+        # step below then unlinks.
+        owned = sorted(sid for sid in report.rebuilt if sid in legit)
+        if owned:
+            ev.reopen_shards(owned)
+            self.notify_new_ec_shards(vid, collection)
+        distributed = self._distribute_lost_shards(
+            vid, collection, loc_base, ctx, legit
+        )
+        return {
+            "rebuilt": sorted(report.rebuilt),
+            "fetched": sorted(report.fetched),
+            "distributed": distributed,
+        }
+
+    def _distribute_lost_shards(
+        self, vid: int, collection: str, base: str, ctx, legit
+    ) -> list[int]:
+        """Ship regenerated cluster-lost shards this server does NOT own
+        to planned holders (copy + mount on the destination, then delete
+        the local handoff copy). The inventory is the DISK — every
+        canonical shard file outside this server's legitimate set — not
+        just this run's rebuild output, so a re-run after a
+        crash-during-distribute finishes the handoff instead of leaving
+        limbo files; and the holder map is re-fetched HERE, so a crashed
+        prior run whose destination already mounted the shard resolves
+        by deleting the local duplicate instead of copying it to a
+        second holder. The local copies are never mounted here, so the
+        master never sees a duplicate holder mid-flight."""
+        inventory = [
+            sid
+            for sid in range(ctx.total)
+            if sid not in legit and os.path.exists(base + ctx.to_ext(sid))
+        ]
+        if not inventory:
+            return []
+        from .. import faults
+        from ..ec.placement import node_view_for, plan_shard_placement
+
+        try:
+            located = self._master_client().lookup_ec(vid, refresh=True)
+        except (LookupError, grpc.RpcError) as e:
+            # the rebuild + local mounts above are already durable; a
+            # re-run finishes the handoff. Typed refusal, not an
+            # unhandled RpcError escaping the servicer as UNKNOWN.
+            raise ECError(
+                f"rebuilt shards are mounted, but distributing "
+                f"cluster-lost shards needs the master: {e}; re-run "
+                f"ec.rebuild -fromPeers to finish the handoff"
+            ) from e
+        me = f"{self.ip}:{self.port}"
+        done: list[int] = []
+        pending: list[int] = []
+        for sid in inventory:
+            if any(l.url != me for l in located.get(sid, [])):
+                # a holder already serves it (crash-after-mount, or a
+                # concurrent balance copy): finish the handoff — the
+                # ec.balance dedupe rule — by dropping the local copy
+                os.unlink(base + ctx.to_ext(sid))
+                done.append(sid)
+            else:
+                pending.append(sid)
+        if not pending:
+            return done
+        try:
+            topo = self._master_client().topology()
+        except (LookupError, grpc.RpcError) as e:
+            raise ECError(
+                f"rebuilt shards are mounted, but placing cluster-lost "
+                f"shards needs the master topology: {e}; re-run "
+                f"ec.rebuild -fromPeers to finish the handoff"
+            ) from e
+        nodes = {n.id: n for n in topo.nodes}
+        views = [
+            node_view_for(
+                n.id,
+                n.rack,
+                n.data_center,
+                n.max_volume_count,
+                len(n.volumes),
+                n.ec_shards,
+            )
+            for n in topo.nodes
+        ]
+        plan = plan_shard_placement(views, vid, pending)
+        shard_count = {
+            n.id: {e.id: bin(e.shard_bits).count("1") for e in n.ec_shards}
+            for n in topo.nodes
+        }
+        faults.fire("ec.peer_rebuild.before_distribute", volume=vid)
+        adopted: list[int] = []
+        for sid in pending:
+            node = nodes.get(plan.get(sid, ""))
+            if node is None or node.location.url == me:
+                # no capacity elsewhere (or the planner chose us): adopt
+                # the shard locally rather than leave it in limbo
+                adopted.append(sid)
+                done.append(sid)
+                continue
+            dest = fleet.grpc_addr(node.location)
+            first_on_dst = shard_count.get(node.id, {}).get(vid, 0) == 0
+            try:
+                stub = self._peer_stub(dest)
+                stub.VolumeEcShardsCopy(
+                    pb.EcShardsCopyRequest(
+                        volume_id=vid,
+                        collection=collection,
+                        shard_ids=[sid],
+                        source_url=f"{self.ip}:{self.grpc_port}",
+                        copy_ecx=first_on_dst,
+                        copy_ecj=first_on_dst,
+                        copy_vif=first_on_dst,
+                        copy_ecsum=first_on_dst,
+                    ),
+                    timeout=600,
+                )
+                stub.VolumeEcShardsMount(
+                    pb.EcShardsMountRequest(
+                        volume_id=vid, collection=collection
+                    ),
+                    timeout=60,
+                )
+            except grpc.RpcError as e:
+                # holder died mid-distribute: keep the handoff copy on
+                # disk (unmounted, never advertised) — the next run
+                # re-plans and finishes; never wedge the whole rebuild
+                log.warning(
+                    "distribute ec %d.%02d -> %s failed: %s; will retry "
+                    "on the next rebuild run", vid, sid, dest, e.code().name,
+                )
+                continue
+            faults.fire(
+                "ec.peer_rebuild.after_distribute", volume=vid, shard=sid
+            )
+            os.unlink(base + ctx.to_ext(sid))
+            shard_count.setdefault(node.id, {})[vid] = (
+                shard_count.get(node.id, {}).get(vid, 0) + 1
+            )
+            done.append(sid)
+        if adopted:
+            # mount ONLY the adopted ids: a blanket refresh would also
+            # mount handoff copies whose distribute failed above, and
+            # those must stay unmounted/unadvertised so the next run
+            # retries the handoff instead of this server keeping them
+            ev = self.store.find_ec_volume(vid)
+            if ev is not None:
+                ev.reopen_shards(adopted)
+            self.notify_new_ec_shards(vid, collection)
+        return done
 
     # ------------------------------------------------------- replication
 
@@ -1315,10 +1639,21 @@ class VolumeServer:
                     # wait / throughput) ride along with volume status,
                     # keyed by each queue's `chip` device id — THIS
                     # server's scope, so a second tenant's chips never
-                    # alias into these gauges
-                    st["ec_device_queue"] = (
-                        server.store.ec_scheduler.stats_snapshot()
+                    # alias into these gauges. Pod breaker health rides
+                    # on top: N of the M live chip queues with an OPEN
+                    # fallback breaker (those chips' streams are running
+                    # on CPU) flips `degraded`, the at-a-glance "this
+                    # pod is not serving at device speed" flag.
+                    snap = server.store.ec_scheduler.stats_snapshot()
+                    open_b = sum(
+                        1 for e in snap if e.get("breaker") == "open"
                     )
+                    st["ec_device_queue"] = {
+                        "queues": snap,
+                        "chips": len(snap),
+                        "breakers_open": open_b,
+                        "degraded": open_b > 0,
+                    }
                     body = json.dumps(st).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")

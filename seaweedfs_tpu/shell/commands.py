@@ -22,6 +22,7 @@ from ..client.master_client import (
     MasterClient,
     volume_channel,
 )
+from ..ec import fleet
 from ..pb import cluster_pb2 as pb
 from ..pb import rpc
 from ..utils.urls import service_url
@@ -434,37 +435,71 @@ def cluster_check(env: ShellEnv, args) -> str:
     return "\n".join(lines)
 
 
-@command("ec.rebuild", "-volumeId N [-collection c] [-backend cpu|tpu|auto]", mutating=True)
+@command(
+    "ec.rebuild",
+    "-volumeId N [-collection c] [-backend cpu|tpu|auto] "
+    "[-fromPeers] [-holder host:grpcPort]",
+    mutating=True,
+)
 def ec_rebuild(env: ShellEnv, args) -> str:
+    """Local rebuild picks the BIGGEST holder (most local sources).
+    -fromPeers drives the cluster self-healing path instead: the
+    SMALLEST holder (the subset holder a local rebuild refuses on)
+    streams sibling shards from peers, rebuilds on its device, and
+    distributes regenerated cluster-lost shards to planned holders.
+    -holder pins a specific server either way."""
     p = argparse.ArgumentParser(prog="ec.rebuild")
     p.add_argument("-volumeId", type=int, required=True)
     p.add_argument("-collection", default="")
     p.add_argument("-backend", default="")
+    p.add_argument("-fromPeers", action="store_true")
+    p.add_argument("-holder", default="", help="grpc host:port to rebuild on")
     a = p.parse_args(args)
-    shard_locs = env.master.lookup_ec(a.volumeId)
+    shard_locs = env.master.lookup_ec(a.volumeId, refresh=True)
     if not shard_locs:
         return f"ec volume {a.volumeId} not found"
-    # rebuild on the node holding the most shards
-    by_url: dict[str, list[int]] = {}
-    loc_by_url = {}
-    for sid, locs in shard_locs.items():
-        for loc in locs:
-            by_url.setdefault(loc.url, []).append(sid)
-            loc_by_url[loc.url] = loc
-    url = max(by_url, key=lambda u: len(by_url[u]))
+    by_url, loc_by_url = fleet.holder_maps(shard_locs)
+    if a.holder:
+        url = next(
+            (
+                u
+                for u, loc in loc_by_url.items()
+                if a.holder in (u, fleet.grpc_addr(loc))
+            ),
+            "",
+        )
+        if not url:
+            return f"no holder {a.holder!r} for ec volume {a.volumeId}"
+    else:
+        url = fleet.pick_rebuild_holder(by_url, smallest=a.fromPeers)
     ch, stub = _volume_stub(loc_by_url[url])
     with ch:
         r = stub.VolumeEcShardsRebuild(
             pb.EcShardsRebuildRequest(
-                volume_id=a.volumeId, collection=a.collection, backend=a.backend
+                volume_id=a.volumeId,
+                collection=a.collection,
+                backend=a.backend,
+                from_peers=a.fromPeers,
             ),
             timeout=3600,
         )
-        stub.VolumeEcShardsMount(
-            pb.EcShardsMountRequest(volume_id=a.volumeId, collection=a.collection),
-            timeout=60,
+        if not a.fromPeers:
+            # the peer-fetch path mounts exactly what it owns/adopts;
+            # a blanket mount would also advertise unmounted handoff
+            # copies kept after a failed distribute
+            stub.VolumeEcShardsMount(
+                pb.EcShardsMountRequest(
+                    volume_id=a.volumeId, collection=a.collection
+                ),
+                timeout=60,
+            )
+    extra = ""
+    if a.fromPeers:
+        extra = (
+            f" (fetched {list(r.fetched_shard_ids)} from peers, "
+            f"distributed {list(r.distributed_shard_ids)})"
         )
-    return f"rebuilt shards {list(r.rebuilt_shard_ids)} on {url}"
+    return f"rebuilt shards {list(r.rebuilt_shard_ids)} on {url}{extra}"
 
 
 @command("ec.decode", "-volumeId N [-collection c]", mutating=True)
@@ -944,14 +979,11 @@ def ec_scrub(env: ShellEnv, args) -> str:
         from ..ec.context import DATA_SHARDS
 
         data_shards = DATA_SHARDS
-    seen = {}
-    holder_sids: dict[str, set] = {}
-    for sid, locs in shard_locs.items():
-        for loc in locs:
-            seen[loc.url] = loc
-            holder_sids.setdefault(loc.url, set()).add(sid)
+    holder_sids, loc_by_url = fleet.holder_maps(shard_locs)
     out = []
-    for url, loc in sorted(seen.items()):
+    fleet_checked = fleet_bad = fleet_missing = fleet_quar = 0
+    unrebuildable: list[str] = []
+    for url, loc in sorted(loc_by_url.items()):
         ch, stub = _volume_stub(loc)
         with ch:
             r = stub.ScrubEcVolume(
@@ -961,40 +993,53 @@ def ec_scrub(env: ShellEnv, args) -> str:
             if r.error:
                 out.append(f"{url}: error: {r.error}")
                 continue
-            bad = list(r.bad_shards)
-            # shards the master lists on this holder but whose files the
-            # scrub did not find = deleted out from under the server. A
-            # real per-sid set difference: extra non-advertised local
-            # shard files can no longer mask a missing advertised one
-            # (the old count comparison could).
-            advertised = holder_sids.get(url, set())
-            if r.checked_shards:
-                missing_sids = sorted(advertised - set(r.checked_shards))
-                gone = bool(missing_sids)
+            # the same per-holder verdict kernel the fleet worker uses
+            # (ec/fleet.py): real per-sid missing set difference, with
+            # the count-comparison degrade for pre-checked_shards
+            # servers, and the < k verified-good unrebuildable call
+            facts = fleet.holder_scrub_facts(
+                r, holder_sids.get(url, set()), data_shards
+            )
+            bad = facts["bad"]
+            gone = bool(facts["missing"] or facts["legacy_gone"])
+            if facts["legacy_gone"]:
                 gone_note = (
-                    f" (advertised shards {missing_sids} MISSING locally)"
-                )
-            else:
-                # pre-checked_shards server (field absent deserializes
-                # empty): degrade to the count comparison rather than
-                # declaring every advertised shard missing
-                gone = r.checked < len(advertised)
-                gone_note = (
-                    f" ({len(advertised) - r.checked} advertised "
+                    f" ({facts['legacy_gone']} advertised "
                     f"shard files MISSING)"
                 )
+            else:
+                gone_note = (
+                    f" (advertised shards {facts['missing']} "
+                    f"MISSING locally)"
+                )
+            quarantined = facts["quarantined"]
             out.append(
                 f"{url}: checked {r.checked} shards"
                 + (f", BITROT in shards {bad}" if bad else ", all clean")
                 + (gone_note if gone else "")
+                + (
+                    f" (quarantined: {quarantined})" if quarantined else ""
+                )
             )
-            if not (bad or gone) or not a.repair:
+            fleet_checked += r.checked
+            fleet_bad += len(bad)
+            fleet_quar += len(quarantined)
+            # legacy holders report losses only as a count — still real
+            # shard loss, still in the roll-up the operator alerts on
+            fleet_missing += len(facts["missing"]) + facts["legacy_gone"]
+            if facts["unrebuildable"]:
+                unrebuildable.append(url)
+            # gate on the kernel's `hurt` verdict, exactly like the
+            # fleet worker: a quarantine-only holder (rot pulled from
+            # service, canonical file gone) is repairable too
+            if not facts["hurt"] or not a.repair:
                 continue
-            if r.checked - len(bad) < data_shards:
+            if facts["good"] < data_shards:
                 out.append(
-                    f"{url}: repair skipped: {r.checked - len(bad)} "
+                    f"{url}: repair skipped: {facts['good']} "
                     f"verified-good local shards < {data_shards} needed; "
-                    f"use ec.rebuild to rebuild on the biggest holder"
+                    f"use `ec.rebuild -fromPeers` to stream sibling "
+                    f"shards from peer holders"
                 )
                 continue
             # rebuild_ec_files' verify-and-exclude reclassifies the
@@ -1013,6 +1058,19 @@ def ec_scrub(env: ShellEnv, args) -> str:
                 )
             except grpc.RpcError as e:
                 out.append(f"{url}: rebuild REFUSED: {e.details()}")
+    # fleet roll-up: the one line an operator (or the master's fleet
+    # scrub aggregation) alerts on
+    out.append(
+        f"fleet: {len(loc_by_url)} holders, {fleet_checked} shards checked, "
+        f"{fleet_bad} bitrot, {fleet_missing} missing, "
+        f"{fleet_quar} quarantined"
+        + (
+            f"; unrebuildable holders {unrebuildable} -> "
+            f"ec.rebuild -fromPeers"
+            if unrebuildable
+            else ""
+        )
+    )
     return "\n".join(out)
 
 

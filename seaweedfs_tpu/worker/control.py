@@ -9,6 +9,7 @@ TPU EC sidecar plugs into (BASELINE.json).
 
 from __future__ import annotations
 
+import json
 import queue
 import threading
 import time
@@ -16,6 +17,30 @@ import uuid
 from dataclasses import dataclass, field
 
 from ..pb import worker_pb2 as wk
+from ..utils import metrics as _M
+from ..utils.glog import logger
+
+_log = logger("worker.control")
+
+# Fleet-wide scrub health, aggregated from ec_scrub task reports (the
+# master's own view of bitrot across every holder — per-server scrub
+# daemons only ever see their own disks).
+_fleet_volumes = _M.REGISTRY.gauge(
+    "sw_ec_fleet_scrubbed_volumes",
+    "EC volumes with a completed fleet scrub report",
+)
+_fleet_corrupt = _M.REGISTRY.gauge(
+    "sw_ec_fleet_corrupt_shards",
+    "corrupt EC shards across the fleet (latest scrub reports)",
+)
+_fleet_missing = _M.REGISTRY.gauge(
+    "sw_ec_fleet_missing_shards",
+    "advertised-but-missing EC shards across the fleet",
+)
+_fleet_dispatch = _M.REGISTRY.counter(
+    "sw_ec_fleet_peer_rebuild_dispatch_total",
+    "peer-fetch rebuild tasks dispatched for unrebuildable holders",
+)
 
 
 @dataclass
@@ -50,6 +75,7 @@ class _Task:
 
 KNOWN_KINDS = (
     "ec_encode", "vacuum", "balance", "s3_lifecycle", "ec_balance", "iceberg",
+    "ec_scrub", "ec_rebuild",
 )
 # cluster-wide kinds always submit with volume_id=0: the shell skips the
 # -volumeId requirement for them and the worker scopes their cluster
@@ -80,6 +106,10 @@ class WorkerControl:
         self._pending: list[str] = []
         # (size, since_ts) per volume for the quiet-period check
         self._size_watch: dict[int, tuple[int, float]] = {}
+        # vid -> last fleet-scrub submit ts (the stagger state)
+        self._scrub_watch: dict[int, float] = {}
+        # vid -> latest aggregated ec_scrub report (fleet health view)
+        self.scrub_reports: dict[int, dict] = {}
         self._stop = threading.Event()
         self._dispatcher = threading.Thread(target=self._dispatch_loop, daemon=True)
         self._dispatcher.start()
@@ -353,6 +383,7 @@ class WorkerControl:
                 self._lock.notify_all()
 
     def _apply_update(self, worker: _Worker, u: wk.TaskUpdate) -> None:
+        scrub_done: _Task | None = None
         with self._lock:
             t = self._tasks.get(u.task_id)
             if t is None:
@@ -376,8 +407,14 @@ class WorkerControl:
                 else:
                     t.state = u.state
                     t.error = u.error
+                    if t.kind == "ec_scrub" and u.detail:
+                        scrub_done = t
                 worker.active = max(worker.active - 1, 0)
                 self._lock.notify_all()
+        if scrub_done is not None:
+            # outside the registry lock: aggregation re-enters submit()
+            # when it dispatches a peer-fetch rebuild
+            self._record_scrub_report(scrub_done, u.detail)
 
     def SubmitTask(self, request, context):
         try:
@@ -606,6 +643,164 @@ class WorkerControl:
             return [self.submit("ec_balance", 0)]
         except ValueError:
             return []
+
+    def scan_for_ec_scrub(self, topo, period: float) -> list[str]:
+        """Fleet-coordinated scrub (reference: maintenance workers own
+        hygiene, not each box): every EC volume's shards get verified
+        once per `period` FLEET-WIDE — the ec_scrub task walks every
+        holder of the volume, so spreading VOLUMES across the window
+        spreads the I/O across holders. One submission per sweep (most
+        overdue volume first), the same keep-the-plane-convergent rule
+        as the balance scanners; with a tick interval well under the
+        period, volumes naturally stagger instead of stampeding."""
+        now = time.time()
+        with topo._lock:
+            vols = {
+                e.id: e.collection
+                for n in topo.nodes.values()
+                for e in n.ec_shards.values()
+            }
+        # evict state for volumes that left the topology (deleted /
+        # decoded back to a normal volume): a stale report would hold
+        # the fleet gauges nonzero and list the gone volume as
+        # unrebuildable forever, and the dict would grow unbounded
+        with self._lock:
+            gone = [v for v in self.scrub_reports if v not in vols]
+            for v in gone:
+                del self.scrub_reports[v]
+            for v in [v for v in self._scrub_watch if v not in vols]:
+                del self._scrub_watch[v]
+            reports = list(self.scrub_reports.values())
+        if gone:
+            self._update_fleet_gauges(reports)
+        due = [
+            vid
+            for vid in vols
+            if now - self._scrub_watch.get(vid, 0.0) >= period
+        ]
+        if not due:
+            return []
+        due.sort(key=lambda v: (self._scrub_watch.get(v, 0.0), v))
+        vid = due[0]
+        try:
+            tid = self.submit("ec_scrub", vid, vols[vid])
+        except ValueError:
+            return []  # a live operator task for this volume
+        self._scrub_watch[vid] = now
+        return [tid]
+
+    def _record_scrub_report(self, t: _Task, detail: str) -> None:
+        """Fold one completed ec_scrub task's JSON report into the
+        fleet view (master /cluster/status + Prometheus), and dispatch
+        a peer-fetch rebuild for every holder the report marks
+        quarantined-but-unrebuildable (< k verified-good local shards —
+        the case per-server repair can never fix)."""
+        try:
+            doc = json.loads(detail)
+        except ValueError:
+            return
+        holders = doc.get("holders", {})
+        if not isinstance(holders, dict):
+            return
+        with self._lock:
+            self.scrub_reports[t.volume_id] = {
+                "ts": time.time(),
+                "collection": t.collection,
+                "holders": holders,
+            }
+            reports = list(self.scrub_reports.values())
+        self._update_fleet_gauges(reports)
+        dests = sorted(
+            {
+                h["grpc"]
+                for h in holders.values()
+                if h.get("unrebuildable") and h.get("grpc")
+            }
+        )
+        if not dests:
+            return
+        try:
+            # ONE task carrying every unrebuildable holder (comma-
+            # separated): the worker drives them sequentially, because
+            # two concurrent peer rebuilds of the same volume could
+            # both regenerate a cluster-lost shard and mint duplicates
+            self.submit(
+                "ec_rebuild",
+                t.volume_id,
+                t.collection,
+                params={"fromPeers": "true", "holder": ",".join(dests)},
+            )
+            _fleet_dispatch.inc()
+            _log.info(
+                "dispatched peer-fetch rebuild for ec %d on %s "
+                "(unrebuildable holders)", t.volume_id, dests,
+            )
+        except ValueError as e:
+            # duplicate live task / param conflict: the fleet loop
+            # must never die over a dispatch race
+            _log.warning(
+                "peer-fetch dispatch for ec %d skipped: %s",
+                t.volume_id, e,
+            )
+
+    @staticmethod
+    def _update_fleet_gauges(reports: list[dict]) -> None:
+        _fleet_volumes.set(len(reports))
+        _fleet_corrupt.set(
+            sum(
+                len(h.get("bad", []))
+                for r in reports
+                for h in r["holders"].values()
+            )
+        )
+        _fleet_missing.set(
+            sum(
+                len(h.get("missing", [])) + h.get("legacy_missing", 0)
+                for r in reports
+                for h in r["holders"].values()
+            )
+        )
+
+    def scrub_summary(self) -> dict:
+        """Fleet scrub health for status UIs: per-volume latest report
+        plus roll-up counts."""
+        with self._lock:
+            reports = {
+                vid: {
+                    "ts": r["ts"],
+                    "collection": r["collection"],
+                    "holders": {
+                        url: dict(h) for url, h in r["holders"].items()
+                    },
+                }
+                for vid, r in self.scrub_reports.items()
+            }
+        corrupt = sum(
+            len(h.get("bad", []))
+            for r in reports.values()
+            for h in r["holders"].values()
+        )
+        missing = sum(
+            len(h.get("missing", [])) + h.get("legacy_missing", 0)
+            for r in reports.values()
+            for h in r["holders"].values()
+        )
+        unreb = sorted(
+            {
+                vid
+                for vid, r in reports.items()
+                if any(
+                    h.get("unrebuildable") for h in r["holders"].values()
+                )
+            }
+        )
+        return {
+            "volumes": len(reports),
+            "corrupt_shards": corrupt,
+            "missing_shards": missing,
+            "unrebuildable_volumes": unreb,
+            "reports": reports,
+        }
 
     def scan_for_lifecycle(self, filer_addr: str) -> list[str]:
         """Submit the periodic lifecycle sweep against the configured

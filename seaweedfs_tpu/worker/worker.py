@@ -10,6 +10,7 @@ with -backend tpu makes this process the TPU EC sidecar.
 
 from __future__ import annotations
 
+import json
 import queue
 import threading
 import time
@@ -18,6 +19,7 @@ import uuid
 import grpc
 
 from ..client.master_client import MasterClient, volume_channel
+from ..ec import fleet
 from ..pb import cluster_pb2 as pb
 from ..pb import rpc
 from ..pb import worker_pb2 as wk
@@ -30,7 +32,7 @@ class Worker:
         master: str = "localhost:9333",
         capabilities: tuple = (
             "ec_encode", "vacuum", "balance", "s3_lifecycle", "ec_balance",
-            "iceberg",
+            "iceberg", "ec_scrub", "ec_rebuild",
         ),
         backend: str = "auto",
         max_concurrent: int = 2,
@@ -106,6 +108,46 @@ class Worker:
                         type="string",
                         default="",
                         help="restrict to one collection (empty = all)",
+                    ),
+                ],
+            ),
+            wk.TaskDescriptor(
+                kind="ec_scrub",
+                display_name="Fleet EC scrub",
+                description="verify one EC volume's shards against the "
+                ".ecsum sidecar on EVERY holder; repair locally where "
+                "possible, report unrebuildable holders to the master",
+                fields=[
+                    wk.ConfigField(
+                        name="repair",
+                        type="bool",
+                        default="true",
+                        help="rebuild corrupt/missing shards on holders "
+                        "that still have k verified-good local shards",
+                    ),
+                ],
+            ),
+            wk.TaskDescriptor(
+                kind="ec_rebuild",
+                display_name="EC rebuild",
+                description="regenerate missing/corrupt EC shards on a "
+                "holder; -fromPeers streams sibling shards from peer "
+                "holders when the holder has fewer than k local shards",
+                fields=[
+                    wk.ConfigField(
+                        name="fromPeers",
+                        type="bool",
+                        default="false",
+                        help="peer-fetch rebuild (cluster self-healing)",
+                    ),
+                    wk.ConfigField(
+                        name="holder",
+                        type="string",
+                        default="",
+                        help="grpc host:port of the holder(s) to rebuild "
+                        "on, comma-separated, driven sequentially "
+                        "(empty = biggest holder, or smallest with "
+                        "fromPeers)",
                     ),
                 ],
             ),
@@ -221,11 +263,19 @@ class Worker:
 
     # -------------------------------------------------------------- tasks
 
-    def _report(self, task_id: str, state: str, progress: float = 0.0, error: str = "") -> None:
+    def _report(
+        self,
+        task_id: str,
+        state: str,
+        progress: float = 0.0,
+        error: str = "",
+        detail: str = "",
+    ) -> None:
         self._outbox.put(
             wk.WorkerMessage(
                 update=wk.TaskUpdate(
-                    task_id=task_id, state=state, progress=progress, error=error
+                    task_id=task_id, state=state, progress=progress,
+                    error=error, detail=detail,
                 )
             )
         )
@@ -244,6 +294,7 @@ class Worker:
             token = self._mc.lock(
                 lock_name, self.worker_id, ttl=3600.0, wait=5.0
             )
+            detail = ""
             if assign.kind == "ec_encode":
                 self._task_ec_encode(assign)
             elif assign.kind == "vacuum":
@@ -256,9 +307,13 @@ class Worker:
                 self._task_ec_balance(assign)
             elif assign.kind == "iceberg":
                 self._task_iceberg(assign)
+            elif assign.kind == "ec_scrub":
+                detail = self._task_ec_scrub(assign)
+            elif assign.kind == "ec_rebuild":
+                detail = self._task_ec_rebuild(assign)
             else:
                 raise RuntimeError(f"unknown task kind {assign.kind}")
-            self._report(assign.task_id, "done", 1.0)
+            self._report(assign.task_id, "done", 1.0, detail=detail)
             self.completed.append(assign.task_id)
         except Exception as e:
             self._report(assign.task_id, "failed", 0.0, error=str(e))
@@ -396,6 +451,169 @@ class Worker:
                 raise RuntimeError(out)
         finally:
             env.close()
+
+    def _task_ec_scrub(self, assign: wk.TaskAssign) -> str:
+        """Fleet scrub of ONE EC volume: verify shards vs .ecsum on
+        EVERY holder (the same walk the shell's ec.scrub does), repair
+        in place on holders that still have k verified-good local
+        shards, and report holders that do NOT — the master's control
+        loop turns those into peer-fetch rebuild dispatches. Returns
+        the JSON report the master aggregates (TaskUpdate.detail)."""
+        vid = assign.volume_id
+        shard_locs = self._mc.lookup_ec(vid, refresh=True)
+        if not shard_locs:
+            raise RuntimeError(f"ec volume {vid} has no holders")
+        data_shards = 0
+        try:
+            for n in self._mc.topology().nodes:
+                for e in n.ec_shards:
+                    if e.id == vid and e.data_shards:
+                        data_shards = e.data_shards
+        except grpc.RpcError:
+            pass
+        if not data_shards:
+            from ..ec.context import DATA_SHARDS
+
+            data_shards = DATA_SHARDS
+        repair = str(assign.params.get("repair", "true")).lower() in (
+            "true", "1",
+        )
+        holder_sids, loc_by_url = fleet.holder_maps(shard_locs)
+        holders: dict[str, dict] = {}
+        for url, loc in sorted(loc_by_url.items()):
+            dest = fleet.grpc_addr(loc)
+            entry = {
+                "grpc": dest, "checked": 0, "bad": [], "missing": [],
+                "legacy_missing": 0, "quarantined": [], "rebuilt": [],
+                "unrebuildable": False, "error": "",
+            }
+            holders[url] = entry
+            with grpc.insecure_channel(dest) as ch:
+                stub = rpc.volume_stub(ch)
+                try:
+                    r = stub.ScrubEcVolume(
+                        pb.ScrubRequest(
+                            volume_id=vid, collection=assign.collection
+                        ),
+                        timeout=3600,
+                    )
+                except grpc.RpcError as e:
+                    entry["error"] = e.code().name
+                    continue
+                if r.error:
+                    entry["error"] = r.error
+                    continue
+                facts = fleet.holder_scrub_facts(
+                    r, holder_sids.get(url, set()), data_shards
+                )
+                entry["checked"] = facts["checked"]
+                entry["bad"] = facts["bad"]
+                entry["quarantined"] = facts["quarantined"]
+                entry["missing"] = facts["missing"]
+                # pre-checked_shards holders report losses only as a
+                # count; carried separately so the fleet gauges still
+                # see them (per-sid ids are unknowable)
+                entry["legacy_missing"] = facts["legacy_gone"]
+                if not facts["hurt"]:
+                    continue
+                if facts["unrebuildable"]:
+                    # per-server repair can never fix this holder: the
+                    # master dispatches a peer-fetch rebuild from the
+                    # aggregated report
+                    entry["unrebuildable"] = True
+                    continue
+                if not repair:
+                    continue
+                try:
+                    rr = stub.VolumeEcShardsRebuild(
+                        pb.EcShardsRebuildRequest(
+                            volume_id=vid, collection=assign.collection
+                        ),
+                        timeout=3600,
+                    )
+                    stub.VolumeEcShardsMount(
+                        pb.EcShardsMountRequest(
+                            volume_id=vid, collection=assign.collection
+                        ),
+                        timeout=60,
+                    )
+                    entry["rebuilt"] = sorted(
+                        int(x) for x in rr.rebuilt_shard_ids
+                    )
+                except grpc.RpcError as e:
+                    entry["error"] = f"rebuild: {e.details()}"
+        return json.dumps({"volume_id": vid, "holders": holders})
+
+    def _task_ec_rebuild(self, assign: wk.TaskAssign) -> str:
+        """Rebuild dispatcher: drive VolumeEcShardsRebuild on chosen
+        holders — `fromPeers` selects the cluster-level peer-fetch path
+        (the task the fleet scrub loop submits for unrebuildable
+        holders); `holder` pins the server(s) (comma-separated, driven
+        SEQUENTIALLY: concurrent peer rebuilds of one volume could both
+        regenerate a cluster-lost shard and mint duplicates), default
+        is the shell ec.rebuild heuristic (biggest holder, or the
+        SMALLEST for fromPeers — the subset holder local rebuild
+        refuses on)."""
+        vid = assign.volume_id
+        from_peers = str(assign.params.get("fromPeers", "")).lower() in (
+            "true", "1",
+        )
+        holder = assign.params.get("holder", "")
+        if not holder:
+            shard_locs = self._mc.lookup_ec(vid, refresh=True)
+            if not shard_locs:
+                raise RuntimeError(f"ec volume {vid} has no holders")
+            by_url, loc_by_url = fleet.holder_maps(shard_locs)
+            url = fleet.pick_rebuild_holder(by_url, smallest=from_peers)
+            loc = loc_by_url[url]
+            holder = fleet.grpc_addr(loc)
+        results = []
+        errors = []
+        for dest in [h for h in holder.split(",") if h]:
+            try:
+                with grpc.insecure_channel(dest) as ch:
+                    stub = rpc.volume_stub(ch)
+                    r = stub.VolumeEcShardsRebuild(
+                        pb.EcShardsRebuildRequest(
+                            volume_id=vid,
+                            collection=assign.collection,
+                            backend=assign.backend or self.backend,
+                            from_peers=from_peers,
+                        ),
+                        timeout=3600,
+                    )
+                    if not from_peers:
+                        # the peer-fetch path mounts exactly the shards
+                        # it owns/adopts itself; a blanket mount here
+                        # would also pick up unmounted handoff copies
+                        # kept after a failed distribute and advertise
+                        # a duplicate holder
+                        stub.VolumeEcShardsMount(
+                            pb.EcShardsMountRequest(
+                                volume_id=vid, collection=assign.collection
+                            ),
+                            timeout=60,
+                        )
+            except grpc.RpcError as e:
+                # keep driving the remaining holders: one refused/dead
+                # holder must not strand the rest until the next scrub
+                # period
+                errors.append(f"{dest}: {e.code().name}: {e.details()}")
+                continue
+            results.append(
+                {
+                    "holder": dest,
+                    "from_peers": from_peers,
+                    "rebuilt": sorted(int(x) for x in r.rebuilt_shard_ids),
+                    "fetched": sorted(int(x) for x in r.fetched_shard_ids),
+                    "distributed": sorted(
+                        int(x) for x in r.distributed_shard_ids
+                    ),
+                }
+            )
+        if errors and not results:
+            raise RuntimeError("; ".join(errors))
+        return json.dumps({"results": results, "errors": errors})
 
     def _task_iceberg(self, assign: wk.TaskAssign) -> None:
         """Iceberg snapshot expiry (reference worker tasks: the iceberg

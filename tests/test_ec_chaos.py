@@ -674,6 +674,64 @@ def test_jax_midbatch_failure_falls_back_bit_identical(tmp_path):
         )
 
 
+def test_midkernel_device_reset_falls_back_bit_identical(tmp_path):
+    """TPU-side chaos hook (PR 1 carried item): the fault point sits
+    INSIDE the device backend between kernel launch and result fetch
+    (`ec.device.kernel_fetch` in JaxBackend.to_host), so this exercises
+    a device reset AFTER the kernel was dispatched — the spot a
+    hung/reset TPU actually surfaces — not just pre-dispatch death.
+    FallbackBackend must replay the in-flight batch on CPU
+    bit-identically."""
+    base, _ = make_volume(tmp_path, needles=25, seed=15)
+    write_ec_files(base, CTX, CpuBackend(CTX), batch_size=100_000)
+    want = {i: open(base + CTX.to_ext(i), "rb").read() for i in range(CTX.total)}
+
+    fb = _fallback_backend()
+    with faults.injected(
+        "ec.device.kernel_fetch", faults.io_error("device reset mid-kernel"),
+        when=faults.nth_call(2), count=1,
+    ) as h:
+        write_ec_files(base, CTX, fb, batch_size=100_000)
+    assert h.fired == 1, "mid-kernel fault point never armed"
+    assert fb.fallback_batches >= 1, "mid-kernel failover never engaged"
+    for i in range(CTX.total):
+        assert open(base + CTX.to_ext(i), "rb").read() == want[i], (
+            f"shard {i} differs after mid-kernel CPU failover"
+        )
+
+
+def test_breaker_health_gauge_and_queue_snapshot():
+    """Pod health surface (PR 5 carried item): an open per-chip breaker
+    shows as sw_ec_chip_breaker_open=1 at /metrics scrape time, and the
+    queue stats snapshot carries the breaker state for /status's
+    `degraded` flag."""
+    from seaweedfs_tpu.ec.device_queue import QueueScope
+    from seaweedfs_tpu.utils.metrics import REGISTRY
+
+    fb = _fallback_backend()
+    scope = QueueScope()
+    q = scope.for_backend(fb)
+    assert q is not None
+    snap = scope.stats_snapshot()
+    assert snap and snap[0]["breaker"] == "closed"
+    for _ in range(3):
+        fb.breaker.record_failure()
+    assert fb.breaker.state == "open"
+    snap = scope.stats_snapshot()
+    assert snap[0]["breaker"] == "open"
+    label = f"JaxBackend@{fb._seq}"  # no chip pool: instance-tag label
+
+    def gauge_value() -> str:
+        for l in REGISTRY.render().decode().splitlines():
+            if l.startswith("sw_ec_chip_breaker_open") and label in l:
+                return l.rsplit(" ", 1)[1]
+        return ""
+
+    assert gauge_value() == "1"
+    fb.breaker.record_success()
+    assert gauge_value() == "0"
+
+
 def test_fallback_breaker_opens_and_cpu_serves(tmp_path):
     base, _ = make_volume(tmp_path, needles=20, seed=14)
     write_ec_files(base, CTX, CpuBackend(CTX), batch_size=100_000)

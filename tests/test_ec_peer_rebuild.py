@@ -1,0 +1,310 @@
+"""Peer-fetch rebuild core (ec/peer_rebuild.py) under an injected byte
+transport: verify-and-exclude across the wire, retry/exclusion/replan,
+clean refusal with no partial publish, and idempotent re-runs across
+crash windows. The server/gRPC layer on top is covered by
+tests/test_ec_cluster_chaos.py.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu import faults
+from seaweedfs_tpu.ec import (
+    CpuBackend,
+    ECContext,
+    ECError,
+    PeerCorruptError,
+    PeerFetchTransient,
+    rebuild_from_peers,
+)
+from seaweedfs_tpu.ec.bitrot import BitrotProtection, ShardChecksumBuilder
+from seaweedfs_tpu.ec.peer_rebuild import staging_dir
+from seaweedfs_tpu.utils.retry import RetryPolicy
+
+CTX = ECContext(4, 2)
+BLOCK = 4096
+SHARD_SIZE = 3 * BLOCK + 57  # partial final granule on purpose
+
+# zero-sleep policy: retry schedules run in no wall time
+FAST = RetryPolicy(max_attempts=3, base_delay=0.0, max_delay=0.0, jitter=0.0)
+
+
+def synth(tmp_path, local=(0, 1), seed=0):
+    """RS-consistent shard set + v1 sidecar; only `local` shard files
+    exist on disk. Returns (base, shard_bytes: sid -> bytes)."""
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 256, (CTX.data_shards, SHARD_SIZE), dtype=np.uint8)
+    parity = CpuBackend(CTX).encode(data)
+    shards = np.concatenate([data, parity], axis=0)
+    blobs = {i: shards[i].tobytes() for i in range(CTX.total)}
+    builders = [ShardChecksumBuilder(BLOCK) for _ in range(CTX.total)]
+    for i in range(CTX.total):
+        builders[i].write(blobs[i])
+    base = str(tmp_path / "1")
+    BitrotProtection.from_builders(CTX, builders, generation=3).save(
+        base + ".ecsum"
+    )
+    for i in local:
+        with open(base + CTX.to_ext(i), "wb") as f:
+            f.write(blobs[i])
+    return base, blobs
+
+
+def serving_fetch(blobs, log=None):
+    def fetch(peer, sid, off, size):
+        if log is not None:
+            log.append((peer, sid, off, size))
+        return blobs[sid][off : off + size]
+
+    return fetch
+
+
+ALL_PEERS = {sid: ["peerB"] for sid in range(CTX.total)}
+
+
+def test_peer_fetch_rebuild_bit_identical(tmp_path):
+    base, blobs = synth(tmp_path, local=(0, 1))
+    calls = []
+    rep = rebuild_from_peers(
+        base,
+        {2: ["peerB"], 3: ["peerB"], 4: ["peerB"]},
+        serving_fetch(blobs, calls),
+        targets=[5],
+        backend=CpuBackend(CTX),
+        policy=FAST,
+    )
+    assert rep.rebuilt == [5]
+    assert open(base + CTX.to_ext(5), "rb").read() == blobs[5]
+    # fetched exactly k - local = 2 shards, lowest candidate ids first
+    assert sorted(rep.fetched) == [2, 3]
+    assert rep.local_sources == [0, 1] and not rep.excluded_peers
+    assert not os.path.exists(staging_dir(base)), "staging not cleaned"
+    # sources were never published locally (no duplicate minting)
+    for sid in (2, 3, 4):
+        assert not os.path.exists(base + CTX.to_ext(sid))
+
+
+def test_enough_local_sources_fetches_nothing(tmp_path):
+    base, blobs = synth(tmp_path, local=(0, 1, 2, 3))
+    calls = []
+    rep = rebuild_from_peers(
+        base, ALL_PEERS, serving_fetch(blobs, calls),
+        targets=[4], backend=CpuBackend(CTX), policy=FAST,
+    )
+    assert rep.rebuilt == [4] and not rep.fetched and not calls
+    assert open(base + CTX.to_ext(4), "rb").read() == blobs[4]
+
+
+def test_transient_failure_retries_then_succeeds(tmp_path):
+    base, blobs = synth(tmp_path, local=(0, 1))
+    state = {"failed": 0}
+
+    def flaky(peer, sid, off, size):
+        # first attempt of every (sid, off) dies mid-stream
+        if (sid, off) not in state:
+            state[(sid, off)] = True
+            state["failed"] += 1
+            raise PeerFetchTransient("connection reset mid-stream")
+        return blobs[sid][off : off + size]
+
+    rep = rebuild_from_peers(
+        base, {2: ["peerB"], 3: ["peerB"]}, flaky,
+        targets=[5], backend=CpuBackend(CTX), policy=FAST,
+    )
+    assert rep.rebuilt == [5] and state["failed"] >= 2
+    assert not rep.excluded_peers, "transient failures must not exclude"
+    assert open(base + CTX.to_ext(5), "rb").read() == blobs[5]
+
+
+def test_retry_exhaustion_on_every_sibling_refuses_clean(tmp_path):
+    base, blobs = synth(tmp_path, local=(0, 1))
+
+    def dead(peer, sid, off, size):
+        raise PeerFetchTransient("peer down")
+
+    with pytest.raises(ECError, match="refusing"):
+        rebuild_from_peers(
+            base, ALL_PEERS, dead,
+            targets=[5], backend=CpuBackend(CTX), policy=FAST,
+        )
+    # clean refusal: nothing published, staging wiped, locals untouched
+    assert not os.path.exists(base + CTX.to_ext(5))
+    assert not os.path.exists(staging_dir(base))
+    for sid in (0, 1):
+        assert open(base + CTX.to_ext(sid), "rb").read() == blobs[sid]
+
+
+def test_corrupt_peer_excluded_and_replanned(tmp_path):
+    """A holder serving rot for ONE shard is excluded wholesale; the
+    plan re-routes that shard to another holder of the same sid."""
+    base, blobs = synth(tmp_path, local=(0, 1))
+
+    def fetch(peer, sid, off, size):
+        chunk = blobs[sid][off : off + size]
+        if peer == "rotten" and sid == 2:
+            return bytes([chunk[0] ^ 0xFF]) + chunk[1:]  # persistent rot
+        return chunk
+
+    rep = rebuild_from_peers(
+        base,
+        {2: ["rotten", "clean"], 3: ["clean"]},
+        fetch,
+        targets=[5],
+        backend=CpuBackend(CTX),
+        policy=FAST,
+    )
+    assert rep.rebuilt == [5] and rep.excluded_peers == ["rotten"]
+    assert rep.fetched == {2: "clean", 3: "clean"}
+    assert open(base + CTX.to_ext(5), "rb").read() == blobs[5]
+
+
+def test_corrupt_exclusion_below_k_refuses_no_partial_publish(tmp_path):
+    """Every reachable holder serves rot: exclusion leaves < k sources
+    and the rebuild refuses cleanly instead of publishing anything."""
+    base, blobs = synth(tmp_path, local=(0, 1))
+
+    def rotten(peer, sid, off, size):
+        chunk = blobs[sid][off : off + size]
+        return bytes([chunk[0] ^ 0x01]) + chunk[1:]
+
+    with pytest.raises(ECError, match="refusing"):
+        rebuild_from_peers(
+            base, ALL_PEERS, rotten,
+            targets=[5], backend=CpuBackend(CTX), policy=FAST,
+        )
+    assert not os.path.exists(base + CTX.to_ext(5))
+    assert not os.path.exists(staging_dir(base))
+
+
+def test_transient_wire_corruption_rereads_without_exclusion(tmp_path):
+    """One corrupt read that verifies clean on the immediate re-read is
+    wire noise, not a rotten peer: the holder stays in the plan."""
+    base, blobs = synth(tmp_path, local=(0, 1))
+    state = {"flipped": False}
+
+    def once_flipped(peer, sid, off, size):
+        chunk = blobs[sid][off : off + size]
+        if not state["flipped"]:
+            state["flipped"] = True
+            return bytes([chunk[0] ^ 0x80]) + chunk[1:]
+        return chunk
+
+    rep = rebuild_from_peers(
+        base, {2: ["peerB"], 3: ["peerB"]}, once_flipped,
+        targets=[5], backend=CpuBackend(CTX), policy=FAST,
+    )
+    assert state["flipped"] and rep.rebuilt == [5]
+    assert not rep.excluded_peers
+    assert open(base + CTX.to_ext(5), "rb").read() == blobs[5]
+
+
+def test_reread_fetches_only_the_bad_granule(tmp_path):
+    """Wire corruption in one granule re-reads ONLY that granule's byte
+    range — the already-verified rest of the chunk comes from the first
+    buffer (a whole-chunk redo both wastes wire traffic and used to risk
+    splicing the redo's own unchecked corruption into staging)."""
+    base, blobs = synth(tmp_path, local=(0, 1))
+    state = {"calls": []}
+
+    def flip_at(chunk, pos):
+        return chunk[:pos] + bytes([chunk[pos] ^ 0x80]) + chunk[pos + 1 :]
+
+    def shifty(peer, sid, off, size):
+        chunk = blobs[sid][off : off + size]
+        if sid == 2:
+            state["calls"].append((off, size))
+            if len(state["calls"]) == 1:
+                return flip_at(chunk, BLOCK + 7)  # granule 1 bad
+        return chunk
+
+    rep = rebuild_from_peers(
+        base, {2: ["peerB"], 3: ["peerB"], 4: ["peerB"]}, shifty,
+        targets=[5], backend=CpuBackend(CTX), policy=FAST,
+    )
+    assert len(state["calls"]) == 2, "granule mismatch should force a re-read"
+    redo_off, redo_size = state["calls"][1]
+    assert (redo_off, redo_size) == (BLOCK, BLOCK), (
+        "re-read must cover exactly the failed granule, not the chunk"
+    )
+    assert rep.rebuilt == [5] and not rep.excluded_peers
+    assert open(base + CTX.to_ext(5), "rb").read() == blobs[5]
+
+
+def test_corrupt_local_source_excluded_and_replaced(tmp_path):
+    """A present-but-corrupt local shard is never fed to Reed-Solomon
+    (another peer source covers it) AND is regenerated in place — the
+    verify-and-exclude contract, peer edition."""
+    base, blobs = synth(tmp_path, local=(0, 1, 2))
+    with open(base + CTX.to_ext(2), "r+b") as f:
+        f.seek(100)
+        f.write(b"\xff\xff")
+    rep = rebuild_from_peers(
+        base, {3: ["peerB"], 4: ["peerB"], 5: ["peerB"]},
+        serving_fetch(blobs),
+        targets=[], backend=CpuBackend(CTX), policy=FAST,
+    )
+    assert rep.corrupt_local == [2]
+    assert 2 in rep.rebuilt
+    assert open(base + CTX.to_ext(2), "rb").read() == blobs[2]
+
+
+def test_refuses_without_sidecar(tmp_path):
+    base, blobs = synth(tmp_path, local=(0, 1))
+    os.unlink(base + ".ecsum")
+    with pytest.raises(ECError, match="ecsum"):
+        rebuild_from_peers(
+            base, ALL_PEERS, serving_fetch(blobs),
+            targets=[5], backend=CpuBackend(CTX), policy=FAST,
+        )
+
+
+def test_crash_between_publishes_rerun_converges(tmp_path):
+    """Crash after the first target publish: the re-run regenerates the
+    remaining targets idempotently; already-published ones verify good
+    and are untouched."""
+    base, blobs = synth(tmp_path, local=(0, 1))
+    with faults.injected(
+        "ec.peer_rebuild.after_publish", faults.crash(), when=faults.nth_call(1)
+    ):
+        with pytest.raises(faults.InjectedCrash):
+            rebuild_from_peers(
+                base, ALL_PEERS, serving_fetch(blobs),
+                targets=[4, 5], backend=CpuBackend(CTX), policy=FAST,
+            )
+    published = [
+        sid for sid in (4, 5) if os.path.exists(base + CTX.to_ext(sid))
+    ]
+    assert len(published) == 1, "crash fired after exactly one publish"
+    # stale staging from the crash is swept by the re-run
+    rep = rebuild_from_peers(
+        base, ALL_PEERS, serving_fetch(blobs),
+        targets=[4, 5], backend=CpuBackend(CTX), policy=FAST,
+    )
+    assert rep.rebuilt == [sid for sid in (4, 5) if sid not in published]
+    for sid in (4, 5):
+        assert open(base + CTX.to_ext(sid), "rb").read() == blobs[sid]
+    assert not os.path.exists(staging_dir(base))
+
+
+def test_stale_staging_leftovers_are_swept(tmp_path):
+    base, blobs = synth(tmp_path, local=(0, 1))
+    sdir = staging_dir(base)
+    os.makedirs(sdir)
+    with open(os.path.join(sdir, "1.ec05.fetching"), "wb") as f:
+        f.write(b"junk from a crashed run")
+    rep = rebuild_from_peers(
+        base, ALL_PEERS, serving_fetch(blobs),
+        targets=[5], backend=CpuBackend(CTX), policy=FAST,
+    )
+    assert rep.rebuilt == [5]
+    assert open(base + CTX.to_ext(5), "rb").read() == blobs[5]
+    assert not os.path.exists(sdir)
+
+
+def test_peer_corrupt_error_carries_context():
+    e = PeerCorruptError("p1", 7, 3)
+    assert e.peer == "p1" and e.shard == 7 and "granule 3" in str(e)
