@@ -45,6 +45,7 @@ CONFIG_FIELDS = (
     "balance_spread",
     "lifecycle_interval_seconds",
     "ec_balance_interval_seconds",
+    "ec_scrub_interval_seconds",
 )
 STRING_CONFIG_FIELDS = ("lifecycle_filer",)
 

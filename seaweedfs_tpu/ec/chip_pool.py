@@ -332,6 +332,7 @@ def place_stream(
     scope: QueueScope | None = None,
     cost_hint: int = 0,
     wide: bool = False,
+    span=None,
 ) -> Placement:
     """Resolve where one new EC stream runs.
 
@@ -353,7 +354,12 @@ def place_stream(
     to the original backend + its scope queue — exactly PR 4.
     `priority` does not influence routing (the per-chip queue enforces
     class policy); it is accepted so call sites read naturally and for
-    future affinity policies."""
+    future affinity policies.
+
+    `span` (utils/trace.py; None = tracer disarmed) records the routing
+    decision as a "placement" event carrying the pod load ledger the
+    decision saw — the evidence for "why did this stream land on chip
+    3" when reading a trace."""
     scope = resolve_scope(scope)
     if backend is None or not scope.enabled:
         # Scheduler disabled (or no backend): no pool routing either —
@@ -370,13 +376,27 @@ def place_stream(
         # Pinned mesh still charges the whole pod: another scope's
         # auto-wide placement must see this pod as busy, not stack a
         # second column-sliced stream through an independent window.
+        if span is not None:
+            span.event(
+                "placement", mode=mode, chip="mesh",
+                loads=pool.loads(), cost_hint=cost_hint, wide=wide,
+            )
         _, _, release = pool.acquire(cost_hint, force_mesh=True)
         return Placement(backend, scope.for_backend(backend), None, release)
     if pool is None or pool.n_chips < 2:
         return Placement(backend, scope.for_backend(backend))
+    # Ledger snapshot BEFORE the charge: this is (modulo a racing
+    # placement) the state the routing decision reads.
+    loads_seen = pool.loads() if span is not None else None
     idx, chip_be, release = pool.acquire(
         cost_hint, prefer_mesh=(wide and mode == "auto")
     )
+    if span is not None:
+        span.event(
+            "placement", mode=mode,
+            chip=("mesh" if idx is None else pool.labels[idx]),
+            loads=loads_seen, cost_hint=cost_hint, wide=wide,
+        )
     if idx is None:
         # Lone wide stream on an idle pod: it keeps the whole mesh and
         # the charge on every chip makes the pod read busy, so a second

@@ -13,6 +13,7 @@ import struct
 from typing import Iterator
 
 from .. import faults
+from ..utils import trace
 from ..storage.needle import footer_size
 from ..storage.super_block import SUPER_BLOCK_SIZE
 from ..utils.fs import fsync_dir as _fsync_dir
@@ -232,19 +233,25 @@ def write_dat_file(
                         pos += len(got)
                     yield None, parts[0] if len(parts) == 1 else b"".join(parts)
 
+            sp = trace.current()  # the ec.decode root, when armed
             run_staged_apply(
                 None,
                 None,
                 produce,
                 lambda _tag, chunk: out.write(chunk),
                 describe="ec decode pipeline",
+                span=sp,
+                read_stage="disk_read",
+                write_stage="write_sink",
             )
-            out.flush()
-            faults.fire("ec.decode.dat.before_fsync", base=base)
-            os.fsync(out.fileno())
+            with trace.stage(sp, "fsync_publish"):
+                out.flush()
+                faults.fire("ec.decode.dat.before_fsync", base=base)
+                os.fsync(out.fileno())
         faults.fire("ec.decode.dat.before_rename", base=base)
-        os.replace(tmp, dat_path)
-        _fsync_dir(dat_path)
+        with trace.stage(sp, "fsync_publish"):
+            os.replace(tmp, dat_path)
+            _fsync_dir(dat_path)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)
@@ -275,31 +282,39 @@ def ec_decode_volume(base: str, ctx=None, backend=None, scheduler=None) -> bool:
         from .context import DEFAULT_EC_CONTEXT
 
         ctx = vi.ec_ctx or DEFAULT_EC_CONTEXT
-    rebuild_ecx_file(base)
-    if not has_live_needles(base):
-        return False
-    write_idx_from_ecx(base)
-    dat_size = find_dat_file_size(base, vi.version)
-    shard_paths = [base + ctx.to_ext(i) for i in range(ctx.data_shards)]
-    missing_ids = [
-        i for i, p in enumerate(shard_paths) if not os.path.exists(p)
-    ]
-    from .rebuild import rebuild_ec_files
+    sp = trace.start("ec.decode", name=os.path.basename(base), base=base)
+    try:
+        with trace.activate(sp):
+            rebuild_ecx_file(base)
+            if not has_live_needles(base):
+                return False
+            write_idx_from_ecx(base)
+            dat_size = find_dat_file_size(base, vi.version)
+            shard_paths = [
+                base + ctx.to_ext(i) for i in range(ctx.data_shards)
+            ]
+            missing_ids = [
+                i for i, p in enumerate(shard_paths) if not os.path.exists(p)
+            ]
+            from .rebuild import rebuild_ec_files
 
-    # Always invoked: with nothing missing this is the sidecar
-    # verify(-and-repair-in-place) of every present shard; `only_shards`
-    # keeps absent-shard regeneration scoped to the data shards decode
-    # needs (a parity shard lost on a subset holder is not this op's
-    # business to mint). The self-heal runs as a RECOVERY stream on the
-    # shared device queue: colocated foreground encode/reads go first.
-    rebuild_ec_files(
-        base, ctx, backend=backend, only_shards=missing_ids,
-        priority="recovery", scheduler=scheduler,
-    )
-    still = [p for p in shard_paths if not os.path.exists(p)]
-    if still:  # pragma: no cover - rebuild either publishes or raises
-        raise ECError(f"missing data shards for decode: {still}")
-    write_dat_file(base, dat_size, vi.dat_file_size, shard_paths)
-    return True
+            # Always invoked: with nothing missing this is the sidecar
+            # verify(-and-repair-in-place) of every present shard;
+            # `only_shards` keeps absent-shard regeneration scoped to
+            # the data shards decode needs (a parity shard lost on a
+            # subset holder is not this op's business to mint). The
+            # self-heal runs as a RECOVERY stream on the shared device
+            # queue: colocated foreground encode/reads go first.
+            rebuild_ec_files(
+                base, ctx, backend=backend, only_shards=missing_ids,
+                priority="recovery", scheduler=scheduler,
+            )
+            still = [p for p in shard_paths if not os.path.exists(p)]
+            if still:  # pragma: no cover - rebuild publishes or raises
+                raise ECError(f"missing data shards for decode: {still}")
+            write_dat_file(base, dat_size, vi.dat_file_size, shard_paths)
+            return True
+    finally:
+        trace.finish(sp)
 
 

@@ -171,14 +171,16 @@ class _Waiter:
 class Ticket:
     """One admitted (in-flight) batch; released after to_host drains it
     (or the stream dies). Idempotent release — close() may race a drain
-    thread's finally."""
+    thread's finally. `wait_s` is the admission wait this batch paid
+    (the flight recorder's "admission_wait" stage)."""
 
-    __slots__ = ("priority", "cost", "released")
+    __slots__ = ("priority", "cost", "released", "wait_s")
 
-    def __init__(self, priority: str, cost: int):
+    def __init__(self, priority: str, cost: int, wait_s: float = 0.0):
         self.priority = priority
         self.cost = cost
         self.released = False
+        self.wait_s = wait_s
 
 
 class ClassStats:
@@ -214,12 +216,22 @@ class ClassStats:
 class DeviceStream:
     """One producer's tagged batch stream into a DeviceQueue. Not
     thread-safe for concurrent dispatch (each pipeline dispatches from
-    one thread), but release/close may run from the drain thread."""
+    one thread), but release/close may run from the drain thread.
+    `span` (utils/trace.py, None = tracer disarmed) gets per-batch
+    "admission_wait" and "h2d_dispatch" stages labeled with this
+    queue's chip."""
 
-    def __init__(self, queue: "DeviceQueue", priority: str, label: str = ""):
+    def __init__(
+        self,
+        queue: "DeviceQueue",
+        priority: str,
+        label: str = "",
+        span=None,
+    ):
         self.queue = queue
         self.priority = priority
         self.label = label
+        self.span = span
         self._outstanding: set[Ticket] = set()
         self._lock = threading.Lock()
 
@@ -234,13 +246,25 @@ class DeviceStream:
         CPU handle instead, so this is the raw-backend path), the slot
         is released before the exception propagates."""
         ticket = self.queue._admit(self.priority, cost)
+        span = self.span
+        if span is not None:
+            span.add_stage(
+                "admission_wait", ticket.wait_s, self.queue.label
+            )
         with self._lock:
             self._outstanding.add(ticket)
         ok = False
+        t0 = time.perf_counter() if span is not None else 0.0
         try:
             handle = fn()
             ok = True
         finally:
+            if span is not None:
+                span.add_stage(
+                    "h2d_dispatch",
+                    time.perf_counter() - t0,
+                    self.queue.label,
+                )
             if not ok:
                 self.release(ticket)
         return ticket, handle
@@ -314,12 +338,14 @@ class DeviceQueue:
 
     # ------------------------------------------------------------ public
 
-    def stream(self, priority: str, label: str = "") -> DeviceStream:
+    def stream(
+        self, priority: str, label: str = "", span=None
+    ) -> DeviceStream:
         if priority not in PRIORITIES:
             raise ECError(
                 f"unknown priority class {priority!r} (want one of {PRIORITIES})"
             )
-        return DeviceStream(self, priority, label)
+        return DeviceStream(self, priority, label, span=span)
 
     def stats(self) -> dict:
         with self._cond:
@@ -423,7 +449,7 @@ class DeviceQueue:
             _queue_wait_seconds.inc(wait_s, cls=priority, chip=self.label)
             # Another slot may still be free for the next waiter.
             self._cond.notify_all()
-        return Ticket(priority, cost)
+        return Ticket(priority, cost, wait_s)
 
     def _release(self, ticket: Ticket) -> None:
         with self._cond:

@@ -21,6 +21,7 @@ from .. import faults
 from ..storage.needle import CrcError, Needle
 from ..storage.needle_map import SortedFileNeedleMap
 from ..storage.types import actual_offset
+from ..utils import trace
 from ..utils.chunk_cache import ChunkCache
 from ..utils.crc import crc32c
 from ..utils.glog import logger
@@ -299,6 +300,25 @@ class EcVolume:
         lands in the interval cache so a hot needle on a lost shard
         pays reconstruction once.
         """
+        # Flight-recorder root per degraded-read op (a child when a
+        # server RPC/scrub span is active in this thread).
+        sp = trace.start(
+            "ec.degraded_read",
+            name=f"v{self.volume_id}.{shard_id:02d}",
+            volume=self.volume_id, shard=shard_id,
+            offset=offset, size=size,
+        )
+        try:
+            with trace.activate(sp):
+                return self._recover_interval_traced(
+                    shard_id, offset, size, sp
+                )
+        finally:
+            trace.finish(sp)
+
+    def _recover_interval_traced(
+        self, shard_id: int, offset: int, size: int, sp
+    ) -> bytes:
         prot = self._bitrot()
         if prot is None or not (0 <= shard_id < len(prot.shard_crcs)):
             return self._reconstruct_range(shard_id, offset, size)
@@ -323,6 +343,7 @@ class EcVolume:
         if cache is not None:
             hit = cache.get(key)
             if hit is not None:
+                trace.event(sp, "cache_hit", lo=lo, hi=hi)
                 return hit[offset - lo : offset - lo + size]
 
         def range_ok(sid: int, data: bytes) -> bool:
@@ -330,10 +351,11 @@ class EcVolume:
             CRCs (granules align across shards: equal sizes, one
             layout)."""
             _, crcs = prot.verify_granularity(sid)
-            for bi in range(lo // bs, -(-hi // bs)):
-                blk = data[bi * bs - lo : min((bi + 1) * bs, hi) - lo]
-                if bi >= len(crcs) or crc32c(blk) != crcs[bi]:
-                    return False
+            with trace.stage(sp, "crc_verify"):
+                for bi in range(lo // bs, -(-hi // bs)):
+                    blk = data[bi * bs - lo : min((bi + 1) * bs, hi) - lo]
+                    if bi >= len(crcs) or crc32c(blk) != crcs[bi]:
+                        return False
             return True
 
         # Sources are sidecar-verified BEFORE being fed to Reed-Solomon:
@@ -359,19 +381,24 @@ class EcVolume:
         (reference store_ec.go:656-747; like the reference, sibling
         reads fan out in parallel — remote fetches dominate latency)."""
         k = self.ctx.data_shards
+        sp = trace.current()  # the ec.degraded_read root, when armed
         sources: dict[int, np.ndarray] = {}
         local = [(i, fd) for i, fd in self.shard_fds.items() if i != shard_id]
         for i, fd in local:
             try:
-                got = os.pread(fd, size, offset)
+                with trace.stage(sp, "sibling_read"):
+                    got = os.pread(fd, size, offset)
             except OSError:
                 continue
             self.bytes_read += len(got)
-            if len(got) == size and (source_ok is None or source_ok(i, got)):
+            if len(got) == size and (
+                source_ok is None or source_ok(i, got)
+            ):
                 sources[i] = np.frombuffer(got, dtype=np.uint8)
                 if len(sources) == k:
                     break
         if len(sources) < k and self.remote_reader is not None:
+            import contextvars
             from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 
             missing = [
@@ -383,13 +410,28 @@ class EcVolume:
             def fetch(i):
                 return i, self.remote_reader(i, offset, size, self.encode_ts_ns)
 
+            def submit(ex, i):
+                # Per-task contextvar copy: the fetch thread sees the
+                # caller's request id + active span, so the peer
+                # shard-read RPC hop carries both in its metadata.
+                return ex.submit(contextvars.copy_context().run, fetch, i)
+
             # stop as soon as k sources exist: one hung peer must not
             # stall the read for the full RPC timeout
             ex = ThreadPoolExecutor(max_workers=min(len(missing), 8))
             try:
-                futures = {ex.submit(fetch, i) for i in missing}
+                # "sibling_read" covers only the blocked wait on peer
+                # fetches; the source_ok callbacks below run range_ok,
+                # which tags its own time "crc_verify" — wrapping them
+                # here too would double-count verify seconds into the
+                # wire stage.
+                with trace.stage(sp, "sibling_read"):
+                    futures = {submit(ex, i) for i in missing}
                 while futures and len(sources) < k:
-                    done, futures = wait(futures, return_when=FIRST_COMPLETED)
+                    with trace.stage(sp, "sibling_read"):
+                        done, futures = wait(
+                            futures, return_when=FIRST_COMPLETED
+                        )
                     for f in done:
                         i, got = f.result()
                         if got is not None:
@@ -456,10 +498,14 @@ class EcVolume:
                 priority="foreground",
                 scheduler=self.scheduler,
                 cost_hint=size,
+                span=sp,
+                read_stage="stage_batch",
+                write_stage="write_sink",
             )
             return out.tobytes()
-        rec = self.backend.reconstruct(sources, want=[shard_id])
-        return np.asarray(rec[shard_id], dtype=np.uint8).tobytes()
+        with trace.stage(sp, "reconstruct"):
+            rec = self.backend.reconstruct(sources, want=[shard_id])
+            return np.asarray(rec[shard_id], dtype=np.uint8).tobytes()
 
     # ------------------------------------------------------------- delete
 

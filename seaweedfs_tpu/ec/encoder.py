@@ -23,6 +23,7 @@ import numpy as np
 
 from .. import faults
 from ..storage.needle_map import MemDb
+from ..utils import trace
 from .backend import RSBackend, get_backend
 from .bitrot import BitrotProtection
 from .context import (
@@ -92,6 +93,12 @@ def write_ec_files(
 
     dat_fd = os.open(base + ".dat", os.O_RDONLY)
     outputs: list = []
+    # Flight-recorder span for the encode pipeline (a child when called
+    # under ec_encode_volume's root; its own root for direct callers).
+    sp = trace.start(
+        "ec.encode", name=os.path.basename(base), base=base,
+        batch_size=batch_size,
+    )
     try:
         for i in range(total):
             # buffering=0: the fused native sink writes via raw fds; the
@@ -125,13 +132,14 @@ def write_ec_files(
                 batch = min(batch_size, block_size)
                 for chunk_off in range(0, block_size, batch):
                     width = min(batch, block_size - chunk_off)
-                    data = np.empty((k, width), dtype=np.uint8)
-                    for i in range(k):
-                        _pread_padded(
-                            dat_fd,
-                            data[i],
-                            row_offset + i * block_size + chunk_off,
-                        )
+                    with trace.stage(sp, "disk_read"):
+                        data = np.empty((k, width), dtype=np.uint8)
+                        for i in range(k):
+                            _pread_padded(
+                                dat_fd,
+                                data[i],
+                                row_offset + i * block_size + chunk_off,
+                            )
                     yield data
 
         # Encode is SERVING traffic: it dispatches as a foreground
@@ -154,12 +162,16 @@ def write_ec_files(
             # rows per column of the per-shard extent
             cost_hint=batch_cost(m, -(-dat_size // k)),
             wide=dat_size >= WIDE_STREAM_BYTES,
+            span=sp,
         )
         enc_backend = placement.backend
         dq = placement.queue
         stream = (
-            dq.stream("foreground", label="ec encode") if dq is not None else None
+            dq.stream("foreground", label="ec encode", span=sp)
+            if dq is not None
+            else None
         )
+        chip = getattr(enc_backend, "chip_label", "")
 
         def transform(data):
             # H2D stage + device encode dispatch, both async: device
@@ -170,9 +182,11 @@ def write_ec_files(
             # accordingly. With the shared scheduler the chip-wide
             # bound is the queue's window instead.
             if stream is None:
-                return data, None, enc_backend.encode_staged(
-                    enc_backend.to_device(data)
-                )
+                with trace.stage(sp, "h2d_dispatch", chip):
+                    handle = enc_backend.encode_staged(
+                        enc_backend.to_device(data)
+                    )
+                return data, None, handle
             ticket, handle = stream.dispatch(
                 lambda: enc_backend.encode_staged(enc_backend.to_device(data)),
                 batch_cost(m, data.shape[1]),
@@ -185,13 +199,15 @@ def write_ec_files(
             # the main thread keeps dispatching H2D+encode for the
             # batches queued behind this one.
             try:
-                parity = np.ascontiguousarray(
-                    enc_backend.to_host(parity_handle), dtype=np.uint8
-                )
+                with trace.stage(sp, "device_drain", chip):
+                    parity = np.ascontiguousarray(
+                        enc_backend.to_host(parity_handle), dtype=np.uint8
+                    )
             finally:
                 if ticket is not None:
                     stream.release(ticket)
-            sink.append_rows([*data, *parity])
+            with trace.stage(sp, "write_sink"):
+                sink.append_rows([*data, *parity])
 
         try:
             run_pipeline(
@@ -204,6 +220,7 @@ def write_ec_files(
                 # allowance.
                 join_timeout=60.0 + 4.0 * batch_size / (16 << 20),
                 describe="ec encode pipeline",
+                span=sp,
             )
         finally:
             if stream is not None:
@@ -218,14 +235,16 @@ def write_ec_files(
         # instead of serializing 14 round-trips.
         from concurrent.futures import ThreadPoolExecutor as _TPE
 
-        for f in outputs:
-            f.flush()
-        with _TPE(max_workers=len(outputs)) as ex:
-            list(ex.map(lambda f: os.fsync(f.fileno()), outputs))
+        with trace.stage(sp, "fsync_publish"):
+            for f in outputs:
+                f.flush()
+            with _TPE(max_workers=len(outputs)) as ex:
+                list(ex.map(lambda f: os.fsync(f.fileno()), outputs))
     finally:
         os.close(dat_fd)
         for f in outputs:
             f.close()
+        trace.finish(sp)
     from ..utils.fs import fsync_dir
 
     fsync_dir(base + ".dat")
@@ -251,24 +270,37 @@ def ec_encode_volume(
         raise ECError(f"{base}.idx not found")
 
     encode_ts_ns = time.time_ns()
-    write_sorted_file_from_idx(base)
-    # Crash window the ecx-first ordering closes: .ecx exists, no shards.
-    faults.fire("ec.encode.after_ecx", base=base)
-    prot = write_ec_files(
-        base, ctx, backend, batch_size, leaf_size=leaf_size,
-        scheduler=scheduler,
+    # Root span for the whole volume encode: the pipeline (ec.encode)
+    # nests under it along with index sort and sidecar publication.
+    sp = trace.start(
+        "ec.encode_volume", name=os.path.basename(base), base=base,
     )
-    prot.generation = encode_ts_ns
-    # Crash window: shards durable, sidecar absent — readers must serve,
-    # scrub must refuse (no ground truth), rebuild must still work.
-    faults.fire("ec.encode.before_ecsum", base=base)
-    prot.save(base + ".ecsum")
+    try:
+        with trace.activate(sp):
+            with trace.stage(sp, "index_sort"):
+                write_sorted_file_from_idx(base)
+            # Crash window the ecx-first ordering closes: .ecx exists,
+            # no shards.
+            faults.fire("ec.encode.after_ecx", base=base)
+            prot = write_ec_files(
+                base, ctx, backend, batch_size, leaf_size=leaf_size,
+                scheduler=scheduler,
+            )
+            prot.generation = encode_ts_ns
+            # Crash window: shards durable, sidecar absent — readers
+            # must serve, scrub must refuse (no ground truth), rebuild
+            # must still work.
+            faults.fire("ec.encode.before_ecsum", base=base)
+            with trace.stage(sp, "fsync_publish"):
+                prot.save(base + ".ecsum")
 
-    vi = VolumeInfo(
-        version=version,
-        ec_ctx=ctx,
-        dat_file_size=os.path.getsize(base + ".dat"),
-        encode_ts_ns=encode_ts_ns,
-    )
-    vi.save(base + ".vif")
-    return vi
+                vi = VolumeInfo(
+                    version=version,
+                    ec_ctx=ctx,
+                    dat_file_size=os.path.getsize(base + ".dat"),
+                    encode_ts_ns=encode_ts_ns,
+                )
+                vi.save(base + ".vif")
+            return vi
+    finally:
+        trace.finish(sp)

@@ -44,6 +44,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 from .. import faults
+from ..utils import trace
 from ..utils.crc import crc32c
 from ..utils.fs import fsync_dir
 from ..utils.glog import logger
@@ -156,6 +157,12 @@ def _fetch_shard_verified(
     chunk = max(FETCH_CHUNK - FETCH_CHUNK % gsize, gsize)
     dest = sbase + ctx.to_ext(sid)
     tmp = dest + ".fetching"
+    # Child span per fetched shard stream (parent: the ec.peer_rebuild
+    # root active in this thread) — wire time vs CRC time per peer.
+    sp = trace.start(
+        "ec.peer_fetch", name=f"shard {sid} <- {peer}",
+        peer=peer, shard=sid, bytes=size,
+    )
 
     def get(off: int, n: int) -> bytes:
         def attempt() -> bytes:
@@ -182,10 +189,11 @@ def _fetch_shard_verified(
                 )
             return data
 
-        return retry_call(
-            attempt, policy, retry_on=(PeerFetchTransient,),
-            describe=f"peer fetch {peer} shard {sid}",
-        )
+        with trace.stage(sp, "peer_fetch"):
+            return retry_call(
+                attempt, policy, retry_on=(PeerFetchTransient,),
+                describe=f"peer fetch {peer} shard {sid}",
+            )
 
     try:
         with open(tmp, "wb") as f:
@@ -195,29 +203,34 @@ def _fetch_shard_verified(
                 n = min(chunk, size - off)
                 data = get(off, n)
                 # granule-level sidecar verdict while the chunk is hot
-                for j in range(0, n, gsize):
-                    g = data[j : j + gsize]
-                    if gi >= len(gcrcs) or crc32c(g) != gcrcs[gi]:
-                        # one immediate re-read rules out transient wire
-                        # corruption; a repeat mismatch is the PEER
-                        # serving rot. Re-read ONLY this granule's byte
-                        # range: the rest of `data` already passed its
-                        # CRCs, and re-pulling the whole chunk would
-                        # cost up to chunk/gsize times the wire traffic
-                        # to splice out one granule.
-                        g2 = get(off + j, len(g))
-                        if gi >= len(gcrcs) or crc32c(g2) != gcrcs[gi]:
-                            raise PeerCorruptError(peer, sid, gi)
-                        data = data[:j] + g2 + data[j + gsize :]
-                    gi += 1
-                f.write(data)
+                with trace.stage(sp, "crc_verify"):
+                    for j in range(0, n, gsize):
+                        g = data[j : j + gsize]
+                        if gi >= len(gcrcs) or crc32c(g) != gcrcs[gi]:
+                            # one immediate re-read rules out transient
+                            # wire corruption; a repeat mismatch is the
+                            # PEER serving rot. Re-read ONLY this
+                            # granule's byte range: the rest of `data`
+                            # already passed its CRCs, and re-pulling
+                            # the whole chunk would cost up to
+                            # chunk/gsize times the wire traffic to
+                            # splice out one granule.
+                            g2 = get(off + j, len(g))
+                            if gi >= len(gcrcs) or crc32c(g2) != gcrcs[gi]:
+                                raise PeerCorruptError(peer, sid, gi)
+                            data = data[:j] + g2 + data[j + gsize :]
+                        gi += 1
+                with trace.stage(sp, "write_sink"):
+                    f.write(data)
                 off += n
-            f.flush()
-            os.fsync(f.fileno())
+            with trace.stage(sp, "fsync_publish"):
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, dest)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
+        trace.finish(sp)
 
 
 def rebuild_from_peers(
@@ -276,11 +289,34 @@ def rebuild_from_peers(
         )
     k = ctx.data_shards
 
+    # Flight-recorder root for the whole peer-fetch rebuild (a child
+    # when the holder's RPC span is active in this thread): per-peer
+    # fetch child spans, the nested local rebuild, and the publish
+    # renames all hang off it, so one cluster heal reads as one tree.
+    sp = trace.start(
+        "ec.peer_rebuild", name=os.path.basename(base), base=base,
+        targets=("auto" if targets is None else sorted(targets)),
+    )
+    try:
+        with trace.activate(sp):
+            return _rebuild_from_peers_span(
+                base, holders, fetch, ctx, targets, backend, scheduler,
+                priority, policy, prot, ecsum, k, sp,
+            )
+    finally:
+        trace.finish(sp)
+
+
+def _rebuild_from_peers_span(
+    base, holders, fetch, ctx, targets, backend, scheduler, priority,
+    policy, prot, ecsum, k, sp,
+) -> PeerRebuildReport:
     report = PeerRebuildReport()
     present = [
         i for i in range(ctx.total) if os.path.exists(base + ctx.to_ext(i))
     ]
-    good_local, corrupt_local = _verify_local(base, ctx, prot, present)
+    with trace.stage(sp, "verify"):
+        good_local, corrupt_local = _verify_local(base, ctx, prot, present)
     report.local_sources = list(good_local)
     report.corrupt_local = list(corrupt_local)
 
@@ -323,6 +359,7 @@ def rebuild_from_peers(
                     # verify-and-exclude across the wire: this holder
                     # serves rot; nothing it sends is trustworthy
                     log.warning("excluding peer: %s", e)
+                    trace.event(sp, "peer_excluded", peer=peer, shard=sid)
                     excluded.add(peer)
                     continue
                 except (PeerFetchTransient, RetryError) as e:

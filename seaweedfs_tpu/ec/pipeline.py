@@ -21,16 +21,48 @@ from __future__ import annotations
 
 import queue as _queue
 import threading as _threading
+import time as _time
 from typing import Callable, Iterator, Sequence
 
 import numpy as np
 
+from ..utils import trace
 from .bitrot import (
     BitrotProtection,
     ShardChecksumBuilder,
     fold_leaf_crcs,
 )
 from .context import BITROT_BLOCK_SIZE, ECContext, ECError
+
+
+def _traced_produce(span, stage: str, produce):
+    """Wrap a producer generator so time spent INSIDE it (disk reads)
+    is attributed per batch; time blocked handing batches downstream is
+    the queue's to report."""
+
+    def wrapped():
+        it = produce()
+        while True:
+            t0 = _time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+            span.add_stage(stage, _time.perf_counter() - t0)
+            yield item
+
+    return wrapped
+
+
+def _traced_call(span, stage: str, fn):
+    def wrapped(item):
+        t0 = _time.perf_counter()
+        try:
+            return fn(item)
+        finally:
+            span.add_stage(stage, _time.perf_counter() - t0)
+
+    return wrapped
 
 
 def run_pipeline(
@@ -41,6 +73,8 @@ def run_pipeline(
     queue_size: int = 2,
     join_timeout: float = 120.0,
     describe: str = "ec pipeline",
+    span=None,
+    stage_names: tuple = (None, None, None),
 ) -> None:
     """Run `produce()` items through `transform` then `consume` as three
     overlapped stages.
@@ -57,7 +91,22 @@ def run_pipeline(
     Queue residency bound: up to `2*queue_size` items are alive at once
     (one per stage plus the queues); callers sizing device memory must
     budget accordingly.
+
+    `span` + `stage_names` attribute wall time to the flight recorder
+    (utils/trace.py): stage_names is (produce, transform, consume) —
+    a None name skips tagging that stage (the caller tags finer-grained
+    stages inside its own closure). Time blocked on a FULL bounded
+    queue is tagged "queue_wait" (backpressure from the slower
+    neighbor), measured only when the put actually blocks. span=None
+    (the disarmed tracer) leaves every closure untouched.
     """
+    if span is not None:
+        if stage_names[0]:
+            produce = _traced_produce(span, stage_names[0], produce)
+        if stage_names[1]:
+            transform = _traced_call(span, stage_names[1], transform)
+        if stage_names[2]:
+            consume = _traced_call(span, stage_names[2], consume)
     read_q: "_queue.Queue" = _queue.Queue(maxsize=queue_size)
     write_q: "_queue.Queue" = _queue.Queue(maxsize=queue_size)
     abort = _threading.Event()
@@ -66,13 +115,25 @@ def run_pipeline(
     def _put(q, item) -> bool:
         """Abort-aware put: never blocks forever on a full queue whose
         consumer has stopped."""
-        while True:
-            try:
-                q.put(item, timeout=0.2)
-                return True
-            except _queue.Full:
-                if abort.is_set():
-                    return False
+        try:
+            q.put_nowait(item)
+            return True
+        except _queue.Full:
+            pass
+        t0 = _time.perf_counter() if span is not None else 0.0
+        try:
+            while True:
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except _queue.Full:
+                    if abort.is_set():
+                        return False
+        finally:
+            if span is not None:
+                span.add_stage(
+                    "queue_wait", _time.perf_counter() - t0
+                )
 
     def reader():
         try:
@@ -160,6 +221,9 @@ def run_staged_apply(
     scheduler=None,
     cost_hint: int = 0,
     wide: bool = False,
+    span=None,
+    read_stage: str = "disk_read",
+    write_stage: str = "write_sink",
 ) -> None:
     """The staged device `apply` driver shared by rebuild, decode, and
     degraded reconstruction: run_pipeline where the transform stage is
@@ -193,6 +257,14 @@ def run_staged_apply(
     longer charges like a parity encode. With the scheduler on, the
     chip-wide in-flight bound lives in the queue's window; without it,
     up to ~2*queue_size staged batches are alive at once per call site.
+
+    `span` is the op's flight-recorder span (utils/trace.py; None =
+    disarmed): the produce stage is tagged `read_stage` per batch, the
+    H2D upload + device dispatch "h2d_dispatch", the blocking to_host
+    "device_drain", the consume callback `write_stage`, bounded-queue
+    backpressure "queue_wait", and (on the scheduled path) the
+    admission wait "admission_wait" — all labeled with the chip the
+    stream landed on.
     """
     if coeffs is None:
         run_pipeline(
@@ -202,6 +274,8 @@ def run_staged_apply(
             queue_size=queue_size,
             join_timeout=join_timeout,
             describe=describe,
+            span=span,
+            stage_names=(read_stage, None, write_stage),
         )
         return
     coeffs = np.ascontiguousarray(coeffs, dtype=np.uint8)
@@ -211,24 +285,33 @@ def run_staged_apply(
 
         placement = place_stream(
             backend, priority,
-            scope=scheduler, cost_hint=cost_hint, wide=wide,
+            scope=scheduler, cost_hint=cost_hint, wide=wide, span=span,
         )
         backend = placement.backend
         device_queue = placement.queue
+    chip = getattr(backend, "chip_label", "")
 
     if device_queue is None:
 
         def transform(item):
             tag, batch = item
-            return tag, backend.apply_staged(coeffs, backend.to_device(batch))
+            with trace.stage(span, "h2d_dispatch", chip):
+                handle = backend.apply_staged(
+                    coeffs, backend.to_device(batch)
+                )
+            return tag, handle
 
         def drain(item):
             tag, handle = item
             # Blocks until the device result is ready — while it does,
             # the calling thread keeps dispatching the batches queued
             # behind it.
-            out = np.ascontiguousarray(backend.to_host(handle), dtype=np.uint8)
-            consume(tag, out)
+            with trace.stage(span, "device_drain", chip):
+                out = np.ascontiguousarray(
+                    backend.to_host(handle), dtype=np.uint8
+                )
+            with trace.stage(span, write_stage):
+                consume(tag, out)
 
         try:
             run_pipeline(
@@ -238,6 +321,8 @@ def run_staged_apply(
                 queue_size=queue_size,
                 join_timeout=join_timeout,
                 describe=describe,
+                span=span,
+                stage_names=(read_stage, None, None),
             )
         finally:
             if placement is not None:
@@ -247,7 +332,7 @@ def run_staged_apply(
     from .device_queue import batch_cost
 
     out_rows = int(coeffs.shape[0])
-    stream = device_queue.stream(priority, label=describe)
+    stream = device_queue.stream(priority, label=describe, span=span)
 
     def transform_q(item):
         tag, batch = item
@@ -265,12 +350,16 @@ def run_staged_apply(
     def drain_q(item):
         tag, ticket, handle = item
         try:
-            out = np.ascontiguousarray(backend.to_host(handle), dtype=np.uint8)
+            with trace.stage(span, "device_drain", device_queue.label):
+                out = np.ascontiguousarray(
+                    backend.to_host(handle), dtype=np.uint8
+                )
         finally:
             # Success or failure, the window slot frees — a dying stream
             # must not wedge the chip for the other streams.
             stream.release(ticket)
-        consume(tag, out)
+        with trace.stage(span, write_stage):
+            consume(tag, out)
 
     try:
         run_pipeline(
@@ -280,6 +369,8 @@ def run_staged_apply(
             queue_size=queue_size,
             join_timeout=join_timeout,
             describe=describe,
+            span=span,
+            stage_names=(read_stage, None, None),
         )
     finally:
         # Batches parked in an aborted pipeline's write queue never

@@ -34,6 +34,7 @@ import numpy as np
 
 from .. import faults
 from ..ops import gf256
+from ..utils import trace
 from ..utils.crc import crc32c
 from .backend import RSBackend, _decode_coeffs, get_backend
 from .bitrot import BitrotError, BitrotProtection
@@ -175,6 +176,27 @@ def rebuild_ec_files(
     if only_shards is not None:
         missing = [i for i in missing if i in only_shards]
 
+    # Flight-recorder root for the whole rebuild op (a child when a
+    # decode/peer-rebuild/RPC span is active in this thread).
+    sp = trace.start(
+        "ec.rebuild", name=os.path.basename(base), base=base,
+        present=len(present), missing=sorted(missing), priority=priority,
+    )
+    try:
+        return _rebuild_ec_files_traced(
+            base, ctx, backend, unsafe_ignore_sidecar, batch_size,
+            prot, present, missing, staged, priority, scheduler, sp,
+        )
+    finally:
+        trace.finish(sp)
+
+
+def _rebuild_ec_files_traced(
+    base, ctx, backend, unsafe_ignore_sidecar, batch_size,
+    prot, present, missing, staged, priority, scheduler, sp,
+) -> list[int]:
+    total, k = ctx.total, ctx.data_shards
+
     # An armed fault registry routes through the PR1-faithful byte path:
     # mutating faults need materialized bytes at the read/write seams,
     # and the chaos contract (upfront verify of every present shard,
@@ -202,11 +224,12 @@ def rebuild_ec_files(
             except OSError:
                 return True  # unreadable = untrustworthy RS input
 
-        if len(ids) == 1:
-            flags = [check(ids[0])]
-        else:
-            with ThreadPoolExecutor(max_workers=min(len(ids), 8)) as ex:
-                flags = list(ex.map(check, ids))
+        with trace.stage(sp, "verify"):
+            if len(ids) == 1:
+                flags = [check(ids[0])]
+            else:
+                with ThreadPoolExecutor(max_workers=min(len(ids), 8)) as ex:
+                    flags = list(ex.map(check, ids))
         bad = [i for i, f in zip(ids, flags) if f]
         verified_ok.update(i for i in ids if i not in bad)
         return bad
@@ -300,6 +323,7 @@ def rebuild_ec_files(
             staged=staged,
             priority=priority,
             scheduler=scheduler,
+            span=sp,
         )
         if bad_src:
             # Confirmed on-disk rot in a source: verify-and-exclude says
@@ -324,6 +348,7 @@ def _attempt_rebuild(
     staged: bool = True,
     priority: str = "recovery",
     scheduler=None,
+    span=None,
 ) -> list[int]:
     """One pipelined reconstruction attempt. Publishes and returns []
     on success; returns confirmed-corrupt source ids for the caller to
@@ -436,16 +461,17 @@ def _attempt_rebuild(
         excludable; a clean disk copy means the PIPELINE's read was
         transiently corrupted and publishing anything would launder it."""
         confirmed, transient = [], []
-        for i in suspects:
-            try:
-                still_bad = bool(
-                    prot.verify_shard_file(
-                        base + ctx.to_ext(i), i, stop_early=True
+        with trace.stage(span, "verify"):
+            for i in suspects:
+                try:
+                    still_bad = bool(
+                        prot.verify_shard_file(
+                            base + ctx.to_ext(i), i, stop_early=True
+                        )
                     )
-                )
-            except OSError:
-                still_bad = True
-            (confirmed if still_bad else transient).append(i)
+                except OSError:
+                    still_bad = True
+                (confirmed if still_bad else transient).append(i)
         if transient:
             raise ECError(
                 f"source shards {transient} for {base} failed read-time "
@@ -470,6 +496,8 @@ def _attempt_rebuild(
                 consume if chaos else (lambda item: consume(*item)),
                 join_timeout=join_timeout,
                 describe="ec rebuild pipeline",
+                span=span,
+                stage_names=("disk_read", "reconstruct", "write_sink"),
             )
         else:
             run_staged_apply(
@@ -481,6 +509,7 @@ def _attempt_rebuild(
                 describe="ec rebuild pipeline",
                 priority=priority,
                 scheduler=scheduler,
+                span=span,
                 # total stream cost for least-loaded routing: every
                 # target row spans the whole shard extent
                 cost_hint=len(targets) * shard_size,
@@ -523,9 +552,10 @@ def _attempt_rebuild(
     try:
         # Crash window: temp .rebuilding files written, not yet durable.
         faults.fire("ec.rebuild.before_fsync", base=base)
-        for f in outs.values():
-            f.flush()
-            os.fsync(f.fileno())
+        with trace.stage(span, "fsync_publish"):
+            for f in outs.values():
+                f.flush()
+                os.fsync(f.fileno())
     except BaseException:
         _cleanup_temps()
         raise
@@ -554,8 +584,9 @@ def _attempt_rebuild(
     # crash here (or between renames) leaves a mix of published shards
     # and .rebuilding temps; a restarted rebuild regenerates the rest.
     faults.fire("ec.rebuild.before_rename", base=base)
-    for i in targets:
-        os.replace(tmp_paths[i], base + ctx.to_ext(i))
-        faults.fire("ec.rebuild.after_rename", base=base, shard=i)
-    _fsync_dir(base + ".dat")
+    with trace.stage(span, "fsync_publish"):
+        for i in targets:
+            os.replace(tmp_paths[i], base + ctx.to_ext(i))
+            faults.fire("ec.rebuild.after_rename", base=base, shard=i)
+        _fsync_dir(base + ".dat")
     return []

@@ -42,6 +42,24 @@ def _tls_from(a):
     )
 
 
+def _add_ec_trace_flags(sp) -> None:
+    sp.add_argument(
+        "-ec.trace", dest="ec_trace", action="store_true",
+        help="arm the EC flight recorder (per-stage spans, "
+        "/debug/traces ring, sw_ec_stage_seconds histograms)",
+    )
+    sp.add_argument(
+        "-ec.traceRing", dest="ec_trace_ring", type=int, default=0,
+        help="completed traces kept in the /debug/traces ring "
+        "(0 = default 256)",
+    )
+    sp.add_argument(
+        "-ec.slowOpSeconds", dest="ec_slow_op_s", type=float, default=0.0,
+        help="log the full span tree of any EC op slower than this "
+        "(arms the flight recorder; 0 = off)",
+    )
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="seaweedfs_tpu.server")
     sub = p.add_subparsers(dest="mode", required=True)
@@ -90,6 +108,7 @@ def main(argv=None) -> int:
     v.add_argument("-dataCenter", default="")
     v.add_argument("-rack", default="")
     v.add_argument("-jwt.key", dest="jwt_key", default="")
+    _add_ec_trace_flags(v)
     _add_tls_flags(v)
 
     f = sub.add_parser("filer")
@@ -205,6 +224,7 @@ def main(argv=None) -> int:
         "-adminSecret", default="",
         help="require X-Admin-Token on admin POSTs (reference adminPassword)",
     )
+    _add_ec_trace_flags(s)
     _add_tls_flags(s)
 
     sc = sub.add_parser(
@@ -402,6 +422,9 @@ def main(argv=None) -> int:
             jwt_key=getattr(a, "jwt_key", ""),
             needle_map_kind=getattr(a, "index", "memory"),
             tls=_tls_from(a),
+            ec_trace=getattr(a, "ec_trace", False),
+            ec_trace_ring=getattr(a, "ec_trace_ring", 0),
+            ec_slow_op_s=getattr(a, "ec_slow_op_s", 0.0),
         )
         vs.start()
         servers.append(vs)

@@ -811,6 +811,7 @@ class MasterServer:
             "lifecycle_interval_seconds": self.lifecycle_interval,
             "lifecycle_filer": self.lifecycle_filer,
             "ec_balance_interval_seconds": self.ec_balance_interval,
+            "ec_scrub_interval_seconds": self.ec_scrub_interval,
         }
 
     def _apply_maintenance_config(self, cfg: dict) -> None:
@@ -830,6 +831,7 @@ class MasterServer:
             "balance_spread",
             "lifecycle_interval_seconds",
             "ec_balance_interval_seconds",
+            "ec_scrub_interval_seconds",
         ):
             if not math.isfinite(cfg.get(key, 0.0)):
                 raise ValueError(f"{key} must be finite, got {cfg.get(key)}")
@@ -851,11 +853,16 @@ class MasterServer:
         spread = cfg.get("balance_spread", 0.0)
         lc_interval = cfg.get("lifecycle_interval_seconds", 0.0)
         ecb_interval = cfg.get("ec_balance_interval_seconds", 0.0)
-        if spread < 0 or lc_interval < 0 or ecb_interval < 0:
+        scrub_interval = cfg.get("ec_scrub_interval_seconds", 0.0)
+        if (
+            spread < 0 or lc_interval < 0 or ecb_interval < 0
+            or scrub_interval < 0
+        ):
             raise ValueError(
-                "balance_spread, lifecycle_interval_seconds and "
-                "ec_balance_interval_seconds must be "
-                f">=0 (got {spread}, {lc_interval}, {ecb_interval})"
+                "balance_spread, lifecycle_interval_seconds, "
+                "ec_balance_interval_seconds and ec_scrub_interval_seconds "
+                f"must be >=0 (got {spread}, {lc_interval}, "
+                f"{ecb_interval}, {scrub_interval})"
             )
         self.ec_auto_fullness = full
         self.ec_quiet_seconds = quiet
@@ -865,6 +872,9 @@ class MasterServer:
         self.lifecycle_interval = lc_interval
         self.lifecycle_filer = str(cfg.get("lifecycle_filer", "") or "")
         self.ec_balance_interval = ecb_interval
+        # the scrub scanner re-reads this every vacuum tick, so a live
+        # update takes effect without restart (0 turns fleet scrub off)
+        self.ec_scrub_interval = scrub_interval
 
     # ----------------------------------------------------------- vacuum
 

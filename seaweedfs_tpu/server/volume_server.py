@@ -39,6 +39,8 @@ from ..storage.volume import (
 from ..pb import cluster_pb2 as pb
 from ..pb import rpc
 from ..utils import metrics as M
+from ..utils import request_id as _rid
+from ..utils import trace
 from ..utils.glog import logger
 
 log = logger("volume")
@@ -59,6 +61,23 @@ class VolumeService:
     def __init__(self, server: "VolumeServer"):
         self.server = server
         self.store = server.store
+
+    def _rpc_span(self, op: str, request, context, **attrs):
+        """Server-side end of cross-RPC tracing for the EC RPCs: adopt
+        the caller's X-Request-ID (minting one at chain start) and —
+        when the flight recorder is armed — continue the caller's trace
+        as a local root, so a fleet-dispatched rebuild and every peer
+        shard-read it triggers share ONE trace id. Returns None when
+        the tracer is disarmed; request-id adoption always runs (it is
+        one contextvar set)."""
+        md = trace.metadata_dict(context)
+        _rid.ensure(md.get(trace.REQUEST_ID_KEY))
+        return trace.start_from_metadata(
+            op, md,
+            server=f"{self.server.ip}:{self.server.port}",
+            volume=request.volume_id,
+            **attrs,
+        )
 
     # ------------------------------------------------------------ admin
 
@@ -305,6 +324,14 @@ class VolumeService:
         """Reference volume_grpc_erasure_coding.go:45 — wipe stale EC
         artifacts, mark the volume readonly, encode (ecx first), persist
         sidecars."""
+        sp = self._rpc_span("rpc.ec_shards_generate", request, context)
+        try:
+            with trace.activate(sp):
+                return self._ec_shards_generate(request, context)
+        finally:
+            trace.finish(sp)
+
+    def _ec_shards_generate(self, request, context):
         v = self.store.find_volume(request.volume_id)
         if v is None:
             context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
@@ -343,6 +370,17 @@ class VolumeService:
         return pb.EcShardsGenerateResponse(generation=vi.encode_ts_ns)
 
     def VolumeEcShardsRebuild(self, request, context):
+        sp = self._rpc_span(
+            "rpc.ec_shards_rebuild", request, context,
+            from_peers=bool(request.from_peers),
+        )
+        try:
+            with trace.activate(sp):
+                return self._ec_shards_rebuild(request, context)
+        finally:
+            trace.finish(sp)
+
+    def _ec_shards_rebuild(self, request, context):
         loc_base = self._ec_base(request.volume_id, request.collection)
         if loc_base is None:
             context.abort(grpc.StatusCode.NOT_FOUND, "ec volume not found")
@@ -417,6 +455,7 @@ class VolumeService:
 
     def VolumeEcShardsCopy(self, request, context):
         """Pull shards (and index files) from a peer via CopyFile."""
+        _rid.ensure(trace.metadata_dict(context).get(trace.REQUEST_ID_KEY))
         loc = self.store._pick_location()
         base = Volume.base_file_name(
             loc.directory, request.collection, request.volume_id
@@ -441,7 +480,8 @@ class VolumeService:
                                 volume_id=request.volume_id,
                                 collection=request.collection,
                                 ext=ext,
-                            )
+                            ),
+                            metadata=trace.grpc_metadata(),
                         ):
                             f.write(chunk.data)
                         f.flush()
@@ -494,44 +534,59 @@ class VolumeService:
     def VolumeEcShardRead(self, request, context):
         from .. import faults
 
-        ev = self.store.find_ec_volume(request.volume_id)
-        if ev is None:
-            context.abort(grpc.StatusCode.NOT_FOUND, "ec volume not mounted")
-        if request.generation and ev.encode_ts_ns != request.generation:
-            # generation fence (reference store_ec.go:627)
-            context.abort(grpc.StatusCode.FAILED_PRECONDITION, "stale generation")
-        fd = ev.shard_fds.get(request.shard_id)
-        if fd is None:
-            context.abort(grpc.StatusCode.NOT_FOUND, "shard not local")
+        # Streaming RPC: the span covers the whole response stream (the
+        # "stream" stage includes time blocked on a slow consumer) and,
+        # because the trace id arrives in metadata, a peer-fetch
+        # rebuild's every shard-read stream lands in the DISPATCHER's
+        # trace — one id from master task to this peer.
+        sp = self._rpc_span(
+            "rpc.ec_shard_read", request, context,
+            shard=request.shard_id, offset=request.offset,
+            size=request.size,
+        )
+        t0 = time.perf_counter()
         try:
-            # Named point for peer-read chaos: a raised IOError aborts
-            # the stream (client falls back to other peers/recovery); a
-            # mutate tears or corrupts the streamed bytes, which the
-            # CLIENT must catch (short-read check / needle CRC /
-            # sidecar-verified reconstruction) — never serve silently.
-            faults.fire(
-                "server.ec_shard_read",
-                volume=request.volume_id, shard=request.shard_id,
-            )
-        except IOError as e:
-            context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
-        remaining = request.size
-        off = request.offset
-        while remaining > 0:
-            chunk = os.pread(fd, min(_EC_STREAM_CHUNK, remaining), off)
-            if not chunk:
-                break
-            orig_len = len(chunk)
-            chunk = faults.mutate(
-                "server.ec_shard_read", chunk,
-                volume=request.volume_id, shard=request.shard_id, offset=off,
-            )
-            if chunk:
-                yield pb.EcShardReadChunk(data=chunk)
-            if len(chunk) < orig_len:
-                break  # torn stream: client sees a short read
-            off += orig_len
-            remaining -= orig_len
+            ev = self.store.find_ec_volume(request.volume_id)
+            if ev is None:
+                context.abort(grpc.StatusCode.NOT_FOUND, "ec volume not mounted")
+            if request.generation and ev.encode_ts_ns != request.generation:
+                # generation fence (reference store_ec.go:627)
+                context.abort(grpc.StatusCode.FAILED_PRECONDITION, "stale generation")
+            fd = ev.shard_fds.get(request.shard_id)
+            if fd is None:
+                context.abort(grpc.StatusCode.NOT_FOUND, "shard not local")
+            try:
+                # Named point for peer-read chaos: a raised IOError aborts
+                # the stream (client falls back to other peers/recovery); a
+                # mutate tears or corrupts the streamed bytes, which the
+                # CLIENT must catch (short-read check / needle CRC /
+                # sidecar-verified reconstruction) — never serve silently.
+                faults.fire(
+                    "server.ec_shard_read",
+                    volume=request.volume_id, shard=request.shard_id,
+                )
+            except IOError as e:
+                context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+            remaining = request.size
+            off = request.offset
+            while remaining > 0:
+                chunk = os.pread(fd, min(_EC_STREAM_CHUNK, remaining), off)
+                if not chunk:
+                    break
+                orig_len = len(chunk)
+                chunk = faults.mutate(
+                    "server.ec_shard_read", chunk,
+                    volume=request.volume_id, shard=request.shard_id, offset=off,
+                )
+                if chunk:
+                    yield pb.EcShardReadChunk(data=chunk)
+                if len(chunk) < orig_len:
+                    break  # torn stream: client sees a short read
+                off += orig_len
+                remaining -= orig_len
+        finally:
+            trace.add_stage(sp, "stream", time.perf_counter() - t0)
+            trace.finish(sp)
 
     def VolumeEcBlobDelete(self, request, context):
         # a mutation: on keyed clusters it needs the same peer token the
@@ -545,6 +600,14 @@ class VolumeService:
         return pb.EcBlobDeleteResponse()
 
     def VolumeEcShardsToVolume(self, request, context):
+        sp = self._rpc_span("rpc.ec_shards_to_volume", request, context)
+        try:
+            with trace.activate(sp):
+                return self._ec_shards_to_volume(request, context)
+        finally:
+            trace.finish(sp)
+
+    def _ec_shards_to_volume(self, request, context):
         base = self._ec_base(request.volume_id, request.collection)
         if base is None:
             context.abort(grpc.StatusCode.NOT_FOUND, "ec volume not found")
@@ -763,6 +826,9 @@ class VolumeService:
         """CRC-verify every live needle (reference volume_grpc_scrub.go).
         Reads go through the lock-free scan of the sealed portion; the
         volume stays online."""
+        # task RPC: adopt the dispatcher's request id so this holder's
+        # scrub log lines correlate with the fleet task that drove them
+        _rid.ensure(trace.metadata_dict(context).get(trace.REQUEST_ID_KEY))
         v = self.store.find_volume(request.volume_id)
         if v is None:
             return pb.ScrubResponse(error="volume not found")
@@ -805,6 +871,14 @@ class VolumeService:
     def ScrubEcVolume(self, request, context):
         """Verify local shards against the .ecsum bitrot sidecar
         (reference ec_volume_scrub.go / store_ec_scrub.go)."""
+        sp = self._rpc_span("rpc.scrub_ec_volume", request, context)
+        try:
+            with trace.activate(sp):
+                return self._scrub_ec_volume(request, context)
+        finally:
+            trace.finish(sp)
+
+    def _scrub_ec_volume(self, request, context):
         base = self._ec_base(request.volume_id, request.collection)
         if base is None:
             return pb.ScrubResponse(error="ec volume not found")
@@ -930,6 +1004,9 @@ class VolumeServer:
         ec_queue_recovery_share: float | None = None,
         ec_queue_scrub_share: float | None = None,
         ec_placement: str = "auto",
+        ec_trace: bool = False,
+        ec_trace_ring: int = 0,
+        ec_slow_op_s: float = 0.0,
     ):
         # Shared per-chip device-queue scheduler (ec/device_queue.py):
         # every EC producer on this server submits priority-tagged batch
@@ -951,6 +1028,18 @@ class VolumeServer:
             shares["recovery"] = ec_queue_recovery_share
         if ec_queue_scrub_share is not None:
             shares["scrub"] = ec_queue_scrub_share
+        # Flight recorder (utils/trace.py): the tracer/ring/slow-op
+        # threshold are process-wide (spans cross server objects in
+        # embedded tests), so arming is strictly OPT-IN here — a second
+        # server constructed with the defaults must not disarm the
+        # first's recorder.
+        if ec_trace or ec_trace_ring > 0 or ec_slow_op_s > 0:
+            trace.configure(
+                # slow-op logging needs spans recorded, so it arms too
+                enabled=True if (ec_trace or ec_slow_op_s > 0) else None,
+                ring_size=ec_trace_ring if ec_trace_ring > 0 else None,
+                slow_op_s=ec_slow_op_s if ec_slow_op_s > 0 else None,
+            )
         self.jwt_key = jwt_key
         self.ip = ip
         self.port = port
@@ -1084,6 +1173,10 @@ class VolumeServer:
                                 generation=generation,
                             ),
                             timeout=30,
+                            # request id + trace context ride to the
+                            # peer: a degraded read's remote sibling
+                            # fetches join the reader's trace
+                            metadata=trace.grpc_metadata(),
                         )
                     )
                     if len(buf) == size:
@@ -1189,6 +1282,10 @@ class VolumeServer:
                         generation=generation,
                     ),
                     timeout=60,
+                    # one trace id across the whole cluster heal: the
+                    # rebuild's span context rides to every peer's
+                    # shard-read stream
+                    metadata=trace.grpc_metadata(),
                 ):
                     buf += c.data
             except grpc.RpcError as e:
@@ -1340,12 +1437,14 @@ class VolumeServer:
                         copy_ecsum=first_on_dst,
                     ),
                     timeout=600,
+                    metadata=trace.grpc_metadata(),
                 )
                 stub.VolumeEcShardsMount(
                     pb.EcShardsMountRequest(
                         volume_id=vid, collection=collection
                     ),
                     timeout=60,
+                    metadata=trace.grpc_metadata(),
                 )
             except grpc.RpcError as e:
                 # holder died mid-distribute: keep the handoff copy on
@@ -1622,6 +1721,29 @@ class VolumeServer:
                 from ..utils.pprof import handle_debug_endpoint
 
                 if handle_debug_endpoint(self, u):
+                    return
+                if u.path == "/debug/traces":
+                    # Flight-recorder ring as Chrome trace_event JSON
+                    # (load in Perfetto / chrome://tracing); ?trace_id=
+                    # narrows to one cross-server trace, ?format=spans
+                    # returns the raw span-tree docs instead. Loopback-
+                    # only, same operator gate as /debug/pprof.
+                    from ..utils.pprof import require_loopback
+
+                    if not require_loopback(self, "trace"):
+                        return
+                    q = parse_qs(u.query)
+                    tid = q.get("trace_id", [""])[0]
+                    if q.get("format", [""])[0] == "spans":
+                        payload = trace.traces(tid)
+                    else:
+                        payload = trace.chrome_trace(tid)
+                    body = json.dumps(payload).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                     return
                 if u.path == "/metrics":
                     from ..utils.metrics import REGISTRY

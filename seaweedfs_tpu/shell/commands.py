@@ -25,6 +25,7 @@ from ..client.master_client import (
 from ..ec import fleet
 from ..pb import cluster_pb2 as pb
 from ..pb import rpc
+from ..utils import trace
 from ..utils.urls import service_url
 
 
@@ -164,6 +165,11 @@ def run_command(env: ShellEnv, line: str) -> str:
     entry = COMMANDS.get(name)
     if entry is None:
         return f"unknown command {name!r} (try `help`)"
+    # one request id per shell command: every server an `ec.rebuild`
+    # or `ec.scrub` touches logs the same id (utils/request_id.py)
+    from ..utils.request_id import ensure as _rid_ensure
+
+    _rid_ensure()
     try:
         return entry[0](env, args)
     except grpc.RpcError as e:
@@ -482,6 +488,7 @@ def ec_rebuild(env: ShellEnv, args) -> str:
                 from_peers=a.fromPeers,
             ),
             timeout=3600,
+            metadata=trace.grpc_metadata(),
         )
         if not a.fromPeers:
             # the peer-fetch path mounts exactly what it owns/adopts;
@@ -492,6 +499,7 @@ def ec_rebuild(env: ShellEnv, args) -> str:
                     volume_id=a.volumeId, collection=a.collection
                 ),
                 timeout=60,
+                metadata=trace.grpc_metadata(),
             )
     extra = ""
     if a.fromPeers:
@@ -938,7 +946,8 @@ def volume_scrub(env: ShellEnv, args) -> str:
         ch, stub = _volume_stub(loc)
         with ch:
             r = stub.ScrubVolume(
-                pb.ScrubRequest(volume_id=a.volumeId), timeout=3600
+                pb.ScrubRequest(volume_id=a.volumeId), timeout=3600,
+                metadata=trace.grpc_metadata(),
             )
         if r.error:
             out.append(f"{loc.url}: error: {r.error}")
@@ -989,6 +998,7 @@ def ec_scrub(env: ShellEnv, args) -> str:
             r = stub.ScrubEcVolume(
                 pb.ScrubRequest(volume_id=a.volumeId, collection=a.collection),
                 timeout=3600,
+                metadata=trace.grpc_metadata(),
             )
             if r.error:
                 out.append(f"{url}: error: {r.error}")
@@ -1052,6 +1062,7 @@ def ec_scrub(env: ShellEnv, args) -> str:
                         volume_id=a.volumeId, collection=a.collection
                     ),
                     timeout=3600,
+                    metadata=trace.grpc_metadata(),
                 )
                 out.append(
                     f"{url}: rebuilt shards {sorted(rr.rebuilt_shard_ids)}"
@@ -3041,6 +3052,7 @@ def maintenance_config(env: ShellEnv, args) -> str:
             "lifecycle_interval_seconds": cfg.lifecycle_interval_seconds,
             "lifecycle_filer": cfg.lifecycle_filer,
             "ec_balance_interval_seconds": cfg.ec_balance_interval_seconds,
+            "ec_scrub_interval_seconds": cfg.ec_scrub_interval_seconds,
         }
     )
 

@@ -31,7 +31,7 @@ class Counter(_Metric):
             self._values[key] = self._values.get(key, 0.0) + amount
 
     def collect(self):
-        yield f"# HELP {self.name} {self.help}"
+        yield f"# HELP {self.name} {_escape_help(self.help)}"
         yield f"# TYPE {self.name} counter"
         with self._lock:
             for key, v in sorted(self._values.items()):
@@ -58,7 +58,7 @@ class Gauge(_Metric):
         self.inc(-amount, **labels)
 
     def collect(self):
-        yield f"# HELP {self.name} {self.help}"
+        yield f"# HELP {self.name} {_escape_help(self.help)}"
         yield f"# TYPE {self.name} gauge"
         if self._fn is not None:
             try:
@@ -100,7 +100,7 @@ class Histogram(_Metric):
         return _Timer(self, labels)
 
     def collect(self):
-        yield f"# HELP {self.name} {self.help}"
+        yield f"# HELP {self.name} {_escape_help(self.help)}"
         yield f"# TYPE {self.name} histogram"
         with self._lock:
             for key in sorted(self._counts):
@@ -132,10 +132,22 @@ class _Timer:
 class Registry:
     def __init__(self):
         self._metrics: list = []
+        self._names: set[str] = set()
         self._lock = threading.Lock()
 
     def register(self, metric):
+        # Duplicate names invalidate the whole exposition (Prometheus
+        # rejects a scrape with two metric families of one name), so a
+        # second registration is a programming error worth a loud,
+        # immediate failure — not a silently corrupt /metrics page.
         with self._lock:
+            if metric.name in self._names:
+                raise ValueError(
+                    f"metric {metric.name!r} is already registered; "
+                    f"re-use the existing collector instead of "
+                    f"registering a second one"
+                )
+            self._names.add(metric.name)
             self._metrics.append(metric)
         return metric
 
@@ -165,7 +177,17 @@ def _fmt_labels(names: Iterable[str], values: Iterable[str]) -> str:
 
 
 def _escape(v: str) -> str:
+    """Label-value escaping per the text exposition format: backslash,
+    double-quote, and line feed (in that order — escaping the escape
+    character first keeps the transform reversible)."""
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    """HELP-text escaping: backslash and line feed only (quotes are
+    legal in help text; a raw newline would terminate the comment line
+    and corrupt the exposition)."""
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _num(v: float) -> str:

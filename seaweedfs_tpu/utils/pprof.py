@@ -31,6 +31,23 @@ def dump_stacks() -> str:
     return "\n".join(out)
 
 
+def require_loopback(handler, what: str = "debug") -> bool:
+    """Shared operator gate for /debug/* surfaces (pprof, traces):
+    True when the caller is local; otherwise a 403 has been sent.
+    One implementation so a future hardening change cannot leave the
+    debug endpoints with inconsistent exposure."""
+    peer = handler.client_address[0]
+    if peer in ("127.0.0.1", "::1", "localhost"):
+        return True
+    body = f"{what} endpoints are loopback-only\n".encode()
+    handler.send_response(403)
+    handler.send_header("Content-Type", "text/plain")
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+    return False
+
+
 def handle_debug_endpoint(handler, parsed) -> bool:
     """Serve /debug/pprof/* on any BaseHTTPRequestHandler; True when
     the path was one of ours.
@@ -42,14 +59,7 @@ def handle_debug_endpoint(handler, parsed) -> bool:
 
     if not parsed.path.startswith("/debug/pprof"):
         return False
-    peer = handler.client_address[0]
-    if peer not in ("127.0.0.1", "::1", "localhost"):
-        body = b"pprof endpoints are loopback-only\n"
-        handler.send_response(403)
-        handler.send_header("Content-Type", "text/plain")
-        handler.send_header("Content-Length", str(len(body)))
-        handler.end_headers()
-        handler.wfile.write(body)
+    if not require_loopback(handler, "pprof"):
         return True
     q = parse_qs(parsed.query)
     if parsed.path.endswith("/profile"):

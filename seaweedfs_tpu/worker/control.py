@@ -494,6 +494,7 @@ class WorkerControl:
                 "lifecycle_interval_seconds",
                 "lifecycle_filer",
                 "ec_balance_interval_seconds",
+                "ec_scrub_interval_seconds",
             ):
                 if request.HasField(key):
                     cfg[key] = getattr(request, key)

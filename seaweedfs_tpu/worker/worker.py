@@ -23,6 +23,8 @@ from ..ec import fleet
 from ..pb import cluster_pb2 as pb
 from ..pb import rpc
 from ..pb import worker_pb2 as wk
+from ..utils import request_id as _rid
+from ..utils import trace
 from .control import VOLUME_INDEPENDENT_KINDS
 
 
@@ -281,6 +283,24 @@ class Worker:
         )
 
     def _execute(self, assign: wk.TaskAssign) -> None:
+        # One request id per task, seeded from the task id: every
+        # holder this task drives (scrub, rebuild, mount RPCs) logs the
+        # SAME id, so grepping one fleet task across servers works.
+        # When the flight recorder is armed the task is the trace root
+        # — a dispatched peer-fetch rebuild and every peer shard-read
+        # it triggers share this trace id.
+        _rid.ensure(assign.task_id or None)
+        sp = trace.start(
+            f"task.{assign.kind}", name=assign.task_id,
+            volume=assign.volume_id, worker=self.worker_id,
+        )
+        try:
+            with trace.activate(sp):
+                self._execute_task(assign)
+        finally:
+            trace.finish(sp)
+
+    def _execute_task(self, assign: wk.TaskAssign) -> None:
         self._report(assign.task_id, "running", 0.0)
         if assign.kind in VOLUME_INDEPENDENT_KINDS:
             lock_name = f"task/{assign.kind}"
@@ -353,6 +373,7 @@ class Worker:
                     batch_mb=batch_mb,
                 ),
                 timeout=3600,
+                metadata=trace.grpc_metadata(),
             )
             self._report(assign.task_id, "running", 0.8)
             gen_stub.VolumeEcShardsMount(
@@ -360,6 +381,7 @@ class Worker:
                     volume_id=vid, collection=assign.collection
                 ),
                 timeout=60,
+                metadata=trace.grpc_metadata(),
             )
             for _, _, stub in holders:
                 stub.VolumeDelete(
@@ -496,6 +518,7 @@ class Worker:
                             volume_id=vid, collection=assign.collection
                         ),
                         timeout=3600,
+                        metadata=trace.grpc_metadata(),
                     )
                 except grpc.RpcError as e:
                     entry["error"] = e.code().name
@@ -530,12 +553,14 @@ class Worker:
                             volume_id=vid, collection=assign.collection
                         ),
                         timeout=3600,
+                        metadata=trace.grpc_metadata(),
                     )
                     stub.VolumeEcShardsMount(
                         pb.EcShardsMountRequest(
                             volume_id=vid, collection=assign.collection
                         ),
                         timeout=60,
+                        metadata=trace.grpc_metadata(),
                     )
                     entry["rebuilt"] = sorted(
                         int(x) for x in rr.rebuilt_shard_ids
@@ -581,6 +606,7 @@ class Worker:
                             from_peers=from_peers,
                         ),
                         timeout=3600,
+                        metadata=trace.grpc_metadata(),
                     )
                     if not from_peers:
                         # the peer-fetch path mounts exactly the shards
@@ -593,6 +619,7 @@ class Worker:
                                 volume_id=vid, collection=assign.collection
                             ),
                             timeout=60,
+                            metadata=trace.grpc_metadata(),
                         )
             except grpc.RpcError as e:
                 # keep driving the remaining holders: one refused/dead

@@ -191,6 +191,105 @@ def test_peer_fetch_restores_subset_holder_bit_identical(cluster):
     assert got == payload
 
 
+def test_flight_recorder_one_trace_spans_cluster_heal(cluster):
+    """ISSUE-7 acceptance: with the flight recorder armed, one
+    `ec.rebuild -fromPeers` run over REAL gRPC yields a single trace id
+    spanning the rebuilding holder's RPC root and every peer shard-read
+    stream, with the X-Request-ID continuous across servers; the holder
+    dumps it from /debug/traces as valid Chrome trace_event JSON, and
+    the per-stage histograms + overlap gauge populate for encode,
+    rebuild, and degraded read."""
+    from seaweedfs_tpu.utils import trace
+    from seaweedfs_tpu.utils.metrics import REGISTRY
+
+    trace.configure(enabled=True, ring_size=512)
+    try:
+        trace.reset()
+        vid, fid, payload, holder, other, ground = split_ec_volume(cluster)
+        quarantine(holder, vid, holder.service._ec_base(vid, ""), 0)
+        wait_for(
+            lambda: not cluster.locs(vid).get(0),
+            msg="quarantine did not reach the master",
+        )
+        # shard 0 now has NO holder anywhere: reading the blob forces
+        # sidecar-verified reconstruction from remote siblings — the
+        # degraded-read op class populates its stage histograms
+        got = requests.get(f"http://localhost:{holder.port}/{fid}").content
+        assert got == payload
+
+        trace.reset()  # isolate the heal: it must mint ONE fresh trace
+        r = rebuild_from_peers_rpc(cluster, holder, vid)
+        assert list(r.rebuilt_shard_ids) == [0]
+
+        docs = trace.traces()
+        rebuild_roots = [
+            d for d in docs if d["op"] == "rpc.ec_shards_rebuild"
+        ]
+        assert len(rebuild_roots) == 1
+        root = rebuild_roots[0]
+        tid = root["trace_id"]
+        assert root["server"] == f"localhost:{holder.port}"
+        assert root["attrs"]["from_peers"] is True
+        # the whole heal hangs off the RPC root on the holder side
+        child_ops = {ch["op"] for ch in root["children"]}
+        assert "ec.peer_rebuild" in child_ops
+
+        # every peer shard-read stream adopted the SAME trace id and
+        # landed on the OTHER server — k(10) - 3 good local = 7 fetches
+        reads = [
+            d for d in docs
+            if d["op"] == "rpc.ec_shard_read" and d["trace_id"] == tid
+        ]
+        assert len(reads) >= 7
+        assert {d["server"] for d in reads} == {
+            f"localhost:{other.port}"
+        }
+        assert all("stream" in d["stages"] for d in reads)
+        # parent linkage points back into the holder's span tree
+        holder_span_ids = set()
+        def _collect(d):
+            holder_span_ids.add(d["span_id"])
+            for ch in d["children"]:
+                _collect(ch)
+        _collect(root)
+        assert all(d["parent_span_id"] in holder_span_ids for d in reads)
+        # request id minted once, continuous across both servers
+        rids = {root["request_id"]} | {d["request_id"] for d in reads}
+        assert len(rids) == 1 and "" not in rids
+
+        # /debug/traces: valid Chrome trace_event JSON with both
+        # servers as process rows for this one trace id
+        resp = requests.get(
+            f"http://localhost:{holder.port}/debug/traces",
+            params={"trace_id": tid},
+        )
+        assert resp.status_code == 200
+        evs = resp.json()["traceEvents"]
+        xs = [e for e in evs if e["ph"] == "X"]
+        assert any(e["cat"] == "rpc.ec_shards_rebuild" for e in xs)
+        assert any(e["cat"] == "rpc.ec_shard_read" for e in xs)
+        assert len({e["pid"] for e in xs}) >= 2  # holder + peer rows
+        for e in xs:
+            assert e["dur"] > 0 and e["args"]["trace_id"] == tid
+        spans = requests.get(
+            f"http://localhost:{holder.port}/debug/traces",
+            params={"trace_id": tid, "format": "spans"},
+        ).json()
+        assert {d["op"] for d in spans} >= {
+            "rpc.ec_shards_rebuild", "rpc.ec_shard_read",
+        }
+
+        # per-stage histograms + overlap gauge for the three op classes
+        text = REGISTRY.render().decode()
+        for op in ("ec.encode", "ec.rebuild", "ec.degraded_read"):
+            assert f'op="{op}"' in text, op
+        assert 'sw_ec_overlap_efficiency{op="ec.encode"}' in text
+        assert 'sw_ec_overlap_efficiency{op="ec.rebuild"}' in text
+    finally:
+        trace.configure(enabled=False)
+        trace.reset()
+
+
 # ------------------------------------------- armed RPC faults (tier-1)
 
 

@@ -163,16 +163,28 @@ def test_maintenance_config_roundtrip(pair):
         env,
         "maintenance.config -set balance_spread=3 "
         "-set lifecycle_interval_seconds=60 -set lifecycle_filer=f:123 "
-        "-set ec_balance_interval_seconds=45",
+        "-set ec_balance_interval_seconds=45 "
+        "-set ec_scrub_interval_seconds=3600",
     )
     doc = json.loads(out)
     assert doc["balance_spread"] == 3.0
     assert doc["lifecycle_interval_seconds"] == 60.0
     assert doc["lifecycle_filer"] == "f:123"
     assert doc["ec_balance_interval_seconds"] == 45.0
+    assert doc["ec_scrub_interval_seconds"] == 3600.0
     assert master.balance_spread == 3.0
     assert master.lifecycle_filer == "f:123"
     assert master.ec_balance_interval == 45.0
+    # the carried ROADMAP knob: fleet scrub period is now runtime-
+    # settable over the RPC, not constructor-only — and 0 turns the
+    # scanner back off without touching the other knobs
+    assert master.ec_scrub_interval == 3600.0
+    out = run_command(env, "maintenance.config -set ec_scrub_interval_seconds=0")
+    assert json.loads(out)["ec_scrub_interval_seconds"] == 0.0
+    assert master.ec_scrub_interval == 0.0
+    assert master.ec_balance_interval == 45.0  # partial update untouched
+    out = run_command(env, "maintenance.config -set ec_scrub_interval_seconds=-5")
+    assert "error" in out
 
 
 # --------------------------------------------------------------- MQ ops

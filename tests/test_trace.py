@@ -1,0 +1,374 @@
+"""Flight-recorder (utils/trace.py) correctness + metrics-registry
+hardening.
+
+Covers the ISSUE-7 trace contracts: span-tree invariants over real EC
+ops (children nested in the root's wall time, per-stage totals bounded
+by the op duration), the disarmed no-allocation fast path, overlap-
+efficiency math, Chrome trace_event export, the slow-op log, gRPC
+metadata continuity, and the Prometheus text-format hardening
+(label escaping roundtrip, duplicate-registration guard, package-wide
+metric naming lint).
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import pkgutil
+import re
+import time
+
+import os
+
+import pytest
+
+from seaweedfs_tpu.ec import CpuBackend, EcVolume, ec_encode_volume, rebuild_ec_files
+from seaweedfs_tpu.utils import metrics as M
+from seaweedfs_tpu.utils import request_id as rid
+from seaweedfs_tpu.utils import trace
+
+from test_ec_pipeline import CTX, make_volume
+
+
+@pytest.fixture
+def recorder():
+    trace.configure(enabled=True, ring_size=256, slow_op_s=0.0)
+    trace.reset()
+    yield trace
+    trace.configure(enabled=False, slow_op_s=0.0)
+    trace.reset()
+
+
+def walk(doc):
+    yield doc
+    for ch in doc["children"]:
+        yield from walk(ch)
+
+
+# ---------------------------------------------------------------- disarmed
+
+
+def test_disarmed_fast_path_is_noop_singleton():
+    """Span-enter/exit when disarmed must be one flag/is-None check and
+    ZERO allocations: every helper returns the same singleton or None."""
+    assert not trace.armed
+    assert trace.start("ec.encode") is None
+    assert trace.current() is None
+    noop = trace.stage(None, "disk_read")
+    assert noop is trace.stage(None, "h2d_dispatch")
+    assert noop is trace.activate(None)
+    with noop:
+        pass
+    # plain no-ops, no exceptions, nothing recorded
+    trace.add_stage(None, "disk_read", 1.0)
+    trace.event(None, "x", a=1)
+    trace.finish(None)
+    assert trace.traces() == []
+    # disarmed + no active request id: nothing to carry on the wire
+    rid.clear()
+    assert trace.grpc_metadata() is None
+    # ...but an active request id still rides (id propagation is not
+    # gated on the tracer)
+    rid.ensure("req-123")
+    md = dict(trace.grpc_metadata())
+    assert md == {trace.REQUEST_ID_KEY: "req-123"}
+    rid.clear()
+
+
+# ------------------------------------------------------- span invariants
+
+
+def test_span_tree_invariants_on_real_ec_ops(recorder, tmp_path):
+    """Encode + degraded read + rebuild under the armed recorder: every
+    child span nests inside its root's wall time, every stage total is
+    bounded by its span's duration, and the per-op histograms/gauges
+    populate."""
+    TOL = 0.25  # clock-read ordering slack, generous for slow CI boxes
+
+    base, payloads = make_volume(tmp_path, needles=20)
+    ec_encode_volume(base, CTX)
+
+    for i in (0, 3):
+        os.unlink(base + CTX.to_ext(i))
+    ev = EcVolume(str(tmp_path), 1, backend_name="cpu")
+    try:
+        for i in list(payloads)[:3]:
+            assert ev.read_needle(i).data == payloads[i]
+    finally:
+        ev.close()
+
+    assert rebuild_ec_files(base, CTX, backend=CpuBackend(CTX)) == [0, 3]
+
+    docs = trace.traces()
+    by_op = {}
+    for d in docs:
+        by_op.setdefault(d["op"], []).append(d)
+    assert "ec.encode_volume" in by_op
+    assert "ec.degraded_read" in by_op
+    assert "ec.rebuild" in by_op
+
+    for root in docs:
+        r_lo = root["start_ts"] - TOL
+        r_hi = root["start_ts"] + root["duration_s"] + TOL
+        for node in walk(root):
+            assert node["trace_id"] == root["trace_id"]
+            assert node["duration_s"] >= 0.0
+            assert node["start_ts"] >= r_lo
+            assert node["start_ts"] + node["duration_s"] <= r_hi
+            for name, acc in node["stages"].items():
+                assert acc["count"] >= 1, (root["op"], name)
+                if name == "queue_wait":
+                    # accumulated from BOTH pipeline threads (reader's
+                    # read_q put + dispatcher's write_q put) — under
+                    # two-sided backpressure its total may legitimately
+                    # exceed the op wall
+                    continue
+                # every other stage accumulates non-overlapping timed
+                # sections of one thread: total bounded by the op wall
+                assert acc["seconds"] <= node["duration_s"] + TOL, (
+                    root["op"], name, acc,
+                )
+
+    # encode: the volume root carries the pipeline child with the
+    # canonical stage set
+    enc = by_op["ec.encode_volume"][0]
+    pipe = [n for n in walk(enc) if n["op"] == "ec.encode"]
+    assert pipe and {"disk_read", "write_sink"} <= set(pipe[0]["stages"])
+    # degraded read: sibling reads + sidecar verification attributed
+    dr_stages = set()
+    for d in by_op["ec.degraded_read"]:
+        dr_stages |= set(d["stages"])
+    assert "sibling_read" in dr_stages
+    # rebuild: published via fsync/rename windows
+    rb = by_op["ec.rebuild"][0]
+    assert "fsync_publish" in rb["stages"]
+
+    text = M.REGISTRY.render().decode()
+    for op in ("ec.encode", "ec.degraded_read", "ec.rebuild"):
+        assert f'op="{op}"' in text
+    assert "sw_ec_stage_seconds_count" in text
+    assert "sw_ec_overlap_efficiency" in text
+    assert 'sw_ec_traces_total{op="ec.rebuild"}' in text
+
+
+def test_ring_is_bounded(recorder):
+    trace.configure(ring_size=4)
+    for i in range(10):
+        trace.finish(trace.start("ec.encode", name=f"op{i}"))
+    docs = trace.traces()
+    assert len(docs) == 4
+    assert docs[-1]["name"] == "op9"  # newest kept, oldest dropped
+
+
+# --------------------------------------------------------------- overlap
+
+
+def _doc(dur, stages):
+    return {
+        "duration_s": dur,
+        "stages": {
+            k: {"seconds": v, "count": 1, "chip": ""}
+            for k, v in stages.items()
+        },
+        "children": [],
+    }
+
+
+def test_overlap_efficiency_math():
+    # fully serial: wall = host + device, every device second exposed
+    assert trace.overlap_efficiency(_doc(2.0, {
+        "disk_read": 1.0, "h2d_dispatch": 0.5, "device_drain": 0.5,
+    })) == 0.0
+    # fully overlapped: wall = host alone and the drain never blocked
+    assert trace.overlap_efficiency(_doc(1.0, {
+        "disk_read": 1.0, "h2d_dispatch": 0.5, "device_drain": 0.0,
+    })) == 1.0
+    # half hidden: residue and measured drain agree at device/2
+    assert trace.overlap_efficiency(_doc(1.25, {
+        "disk_read": 1.0, "h2d_dispatch": 0.25, "device_drain": 0.25,
+    })) == pytest.approx(0.5)
+    # host stages overlapping EACH OTHER (reader + sink threads): their
+    # sum exceeds wall, zeroing the residue — but a 0.9s measured drain
+    # is exposed by definition, so the gauge must NOT saturate at 1.0
+    assert trace.overlap_efficiency(_doc(1.1, {
+        "disk_read": 1.0, "write_sink": 1.0,
+        "h2d_dispatch": 0.1, "device_drain": 0.9,
+    })) == pytest.approx(0.1)
+    # no device work: undefined, not 0 (an op class with no device time
+    # must not drag the gauge)
+    assert trace.overlap_efficiency(_doc(1.0, {"disk_read": 1.0})) is None
+
+
+# ---------------------------------------------------------------- export
+
+
+def test_chrome_trace_export_structure(recorder):
+    sp = trace.start("ec.encode", name="vol1", base="/x/1")
+    with trace.activate(sp):
+        with trace.stage(sp, "disk_read"):
+            pass
+        child = trace.start("ec.peer_fetch", name="shard 2")
+        trace.event(child, "placement", chip="chip0")
+        trace.finish(child)
+    trace.finish(sp)
+
+    doc = trace.chrome_trace()
+    json.loads(json.dumps(doc))  # serializable
+    evs = doc["traceEvents"]
+    metas = [e for e in evs if e["ph"] == "M"]
+    assert any(e["name"] == "process_name" for e in metas)
+    assert any(e["name"] == "thread_name" for e in metas)
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"vol1", "shard 2"}
+    for e in xs:
+        assert e["dur"] > 0 and e["ts"] > 0
+        assert {"pid", "tid", "cat", "args"} <= set(e)
+    root_ev = next(e for e in xs if e["name"] == "vol1")
+    assert root_ev["args"]["trace_id"] == sp.trace_id
+    assert "disk_read" in root_ev["args"]["stages_ms"]
+    assert any(e["ph"] == "i" and e["name"] == "placement" for e in evs)
+    # filtering by an unknown trace id yields an empty event list
+    assert trace.chrome_trace("feedfeedfeedfeed")["traceEvents"] == []
+
+
+def test_grpc_metadata_continuity(recorder):
+    """Client-side metadata -> server-side adoption keeps ONE trace id
+    with parent/child linkage, the wire-format contract behind the
+    cross-server tests in test_ec_cluster_chaos.py."""
+    rid.ensure("req-xyz")
+    try:
+        sp = trace.start("ec.peer_rebuild", name="v7")
+        with trace.activate(sp):
+            md = dict(trace.grpc_metadata())
+        assert md[trace.TRACE_ID_KEY] == sp.trace_id
+        assert md[trace.PARENT_SPAN_KEY] == sp.span_id
+        assert md[trace.REQUEST_ID_KEY] == "req-xyz"
+        adopted = trace.start_from_metadata(
+            "rpc.ec_shard_read", md, server="peer:8080"
+        )
+        assert adopted.trace_id == sp.trace_id
+        assert adopted.parent_id == sp.span_id
+        assert adopted.server == "peer:8080"
+        trace.finish(adopted)
+        trace.finish(sp)
+        tid_docs = trace.traces(sp.trace_id)
+        assert len(tid_docs) == 2  # two local roots, one logical trace
+    finally:
+        rid.clear()
+
+
+def test_slow_op_log_fires_and_counts(recorder, capfd):
+    trace.configure(slow_op_s=0.001)
+    before = M.REGISTRY.render().decode()
+    sp = trace.start("ec.rebuild", name="slowpoke")
+    with trace.stage(sp, "disk_read"):
+        time.sleep(0.01)
+    trace.finish(sp)
+    err = capfd.readouterr().err
+    assert "slow op ec.rebuild" in err
+    assert "slowpoke" in err and "disk_read" in err
+    after = M.REGISTRY.render().decode()
+    line = 'sw_ec_slow_ops_total{op="ec.rebuild"}'
+    def count(text):
+        for ln in text.splitlines():
+            if ln.startswith(line):
+                return float(ln.rsplit(" ", 1)[1])
+        return 0.0
+    assert count(after) == count(before) + 1
+    # below threshold: quiet
+    trace.finish(trace.start("ec.rebuild", name="fast"))
+    assert count(M.REGISTRY.render().decode()) == count(after)
+
+
+# ------------------------------------------------- metrics hardening
+
+
+def test_duplicate_metric_registration_raises():
+    reg = M.Registry()
+    reg.counter("sw_dup_total", "first", ("a",))
+    with pytest.raises(ValueError, match="already registered"):
+        reg.counter("sw_dup_total", "second")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("sw_dup_total", "third")
+
+
+_SAMPLE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})? (\S+)$'
+)
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(v: str) -> str:
+    return (
+        v.replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+    )
+
+
+def test_exposition_escaping_roundtrip_adversarial_labels():
+    """Scrape a registry holding hostile label values / help text and
+    re-parse the text format: every line must lex, and the decoded
+    label values must round-trip bit-exact."""
+    evil = 'quote:" backslash:\\ newline:\nend'
+    reg = M.Registry()
+    c = reg.counter(
+        "sw_esc_total", 'help with "quotes", \\slashes\n and newline',
+        ("lbl",),
+    )
+    c.inc(lbl=evil)
+    c.inc(2, lbl="plain")
+    g = reg.gauge("sw_esc_gauge", "g", ("a", "b"))
+    g.set(1.5, a="x\\", b='"\n"')
+    text = reg.render().decode()
+
+    parsed = {}
+    for ln in text.splitlines():
+        assert ln.strip(), "blank line inside exposition"
+        if ln.startswith("#"):
+            # comment lines must stay single-line comments
+            assert ln.startswith("# HELP") or ln.startswith("# TYPE")
+            continue
+        m = _SAMPLE.match(ln)
+        assert m, f"unparseable sample line: {ln!r}"
+        labels = {
+            k: _unescape(v) for k, v in _LABEL.findall(m.group(2) or "")
+        }
+        parsed[(m.group(1), tuple(sorted(labels.items())))] = float(
+            m.group(3)
+        )
+
+    assert parsed[("sw_esc_total", (("lbl", evil),))] == 1.0
+    assert parsed[("sw_esc_total", (("lbl", "plain"),))] == 2.0
+    assert parsed[("sw_esc_gauge", (("a", "x\\"), ("b", '"\n"')))] == 1.5
+
+
+def test_metrics_lint_package_wide():
+    """Walk the package, import every module best-effort (optional deps
+    may be absent in this container), then lint EVERY sw_* registration:
+    unique names, `sw_<subsystem>_<name>` convention, non-empty help,
+    counters end in _total, timing histograms in _seconds."""
+    import seaweedfs_tpu
+
+    for mod in pkgutil.walk_packages(
+        seaweedfs_tpu.__path__, "seaweedfs_tpu."
+    ):
+        try:
+            importlib.import_module(mod.name)
+        except Exception:
+            continue  # same tolerance as tier-1 collection
+
+    metrics = list(M.REGISTRY._metrics)
+    assert len(metrics) >= 15  # the walk actually registered the fleet
+    names = [m.name for m in metrics]
+    assert len(names) == len(set(names)), "duplicate metric names"
+    pat = re.compile(r"^sw(_[a-z0-9]+)+$")
+    for m in metrics:
+        assert pat.match(m.name), f"bad metric name {m.name!r}"
+        assert m.help and m.help.strip(), f"{m.name} has no help text"
+        if isinstance(m, M.Counter):
+            assert m.name.endswith("_total"), (
+                f"counter {m.name} must end in _total"
+            )
+        if isinstance(m, M.Histogram):
+            assert m.name.endswith("_seconds"), (
+                f"timing histogram {m.name} must end in _seconds"
+            )
