@@ -636,6 +636,84 @@ def _placement_bench(
     }
 
 
+def _leaf_repair_bench(base: str) -> dict:
+    """Leaf repair vs full-shard rebuild (ISSUE 8 acceptance metric):
+    one rotten 64 KiB leaf in one shard, fixed two ways against the
+    same volume — (a) leaf-granular in-place repair under the repair
+    journal (~k leaves of sibling I/O), (b) whole-shard rebuild (~k
+    shards). Reports bytes moved + wall time for both, asserts both
+    outcomes are byte-identical to the original shard."""
+    from seaweedfs_tpu.ec.bitrot import BitrotProtection
+    from seaweedfs_tpu.ec.backend import CpuBackend
+    from seaweedfs_tpu.ec.context import DEFAULT_EC_CONTEXT
+    from seaweedfs_tpu.ec.rebuild import rebuild_ec_files
+    from seaweedfs_tpu.ec.repair_journal import (
+        apply_leaf_repair,
+        leaf_verdict,
+        reconstruct_leaves,
+    )
+
+    ctx = DEFAULT_EC_CONTEXT
+    be = CpuBackend(ctx)
+    prot = BitrotProtection.load(base + ".ecsum")
+    victim = 1
+    path = base + ctx.to_ext(victim)
+    with open(path, "rb") as f:
+        original = f.read()
+
+    # rot one leaf in the middle of the shard
+    leaf = min(len(prot.shard_leaf_crcs[victim]) - 1, 3)
+    with open(path, "r+b") as f:
+        f.seek(leaf * prot.leaf_size + 17)
+        f.write(b"\x5a\xa5\x5a")
+
+    moved = [0]
+
+    def read_range(sid: int, lo: int, size: int) -> bytes | None:
+        try:
+            with open(base + ctx.to_ext(sid), "rb") as f:
+                f.seek(lo)
+                return f.read(size)
+        except OSError:
+            return None
+
+    candidates = [i for i in range(ctx.total) if i != victim]
+    t0 = time.perf_counter()
+    bad = leaf_verdict(path, victim, prot)
+    patches = reconstruct_leaves(
+        prot, ctx, victim, bad, read_range, candidates, backend=be,
+        on_bytes=lambda n: moved.__setitem__(0, moved[0] + n),
+    )
+    apply_leaf_repair(path, victim, prot, patches)
+    leaf_repair_s = time.perf_counter() - t0
+    leaf_repair_bytes = moved[0] + sum(len(p.data) for p in patches)
+    with open(path, "rb") as f:
+        repaired = f.read()
+
+    # whole-shard rebuild of the same shard (bytes moved: k source
+    # shards read + the regenerated shard written)
+    os.unlink(path)
+    t0 = time.perf_counter()
+    rebuilt = rebuild_ec_files(base, ctx, backend=be)
+    full_rebuild_s = time.perf_counter() - t0
+    full_rebuild_bytes = (ctx.data_shards + 1) * len(original)
+    with open(path, "rb") as f:
+        rebuilt_bytes_disk = f.read()
+
+    assert rebuilt == [victim]
+    bit_identical = repaired == original and rebuilt_bytes_disk == original
+    return {
+        "leaf_repair_vs_full_rebuild": round(
+            full_rebuild_bytes / max(leaf_repair_bytes, 1), 1
+        ),
+        "leaf_repair_bytes": leaf_repair_bytes,
+        "full_rebuild_bytes": full_rebuild_bytes,
+        "leaf_repair_s": round(leaf_repair_s, 4),
+        "full_rebuild_s": round(full_rebuild_s, 4),
+        "leaf_repair_bit_identical": bool(bit_identical),
+    }
+
+
 def _degraded_read_bench(base: str, n_reads: int = 12) -> dict:
     """BASELINE config 4: random needle reads with one data shard lost.
     Measures VERIFIED bytes-read amplification (sibling bytes fetched /
@@ -1717,6 +1795,70 @@ def _self_check() -> int:
             f"rebuilt={rep.rebuilt} equal_ref={peer_bytes == ref_bytes}",
         )
 
+        # ---- leaf-repair bit-identity (no servers): a shard healed by
+        # the journal-backed IN-PLACE leaf patch must be byte-equal to
+        # one healed by a full rebuild, and both to the original ------
+        from seaweedfs_tpu.ec.repair_journal import (
+            apply_leaf_repair,
+            journal_path,
+            leaf_verdict,
+            reconstruct_leaves,
+        )
+
+        lctx = ECContext(4, 2)
+        lbe = CpuBackend(lctx)
+        lrng = np.random.default_rng(0x1EAF)
+        LEAF, LBLOCK = 1024, 4096
+        ldata = lrng.integers(0, 256, (4, 3 * 4096 + 57), dtype=np.uint8)
+        lshards = np.concatenate([ldata, lbe.encode(ldata)], axis=0)
+        lbuilders = [
+            ShardChecksumBuilder(LBLOCK, leaf_size=LEAF) for _ in range(6)
+        ]
+        repair_dir = os.path.join(workdir, "leafrepair")
+        rebuild_dir = os.path.join(workdir, "leafrebuild")
+        for d in (repair_dir, rebuild_dir):
+            os.makedirs(d)
+        for i in range(6):
+            b = lshards[i].tobytes()
+            lbuilders[i].write(b)
+            for d in (repair_dir, rebuild_dir):
+                with open(os.path.join(d, f"1.ec{i:02d}"), "wb") as f:
+                    f.write(b)
+        lprot = BitrotProtection.from_builders(lctx, lbuilders, generation=1)
+        for d in (repair_dir, rebuild_dir):
+            lprot.save(os.path.join(d, "1.ecsum"))
+        # same rot both ways: flip bytes inside leaf 2 of shard 3
+        for d in (repair_dir, rebuild_dir):
+            with open(os.path.join(d, "1.ec03"), "r+b") as f:
+                f.seek(2 * LEAF + 31)
+                f.write(b"\xba\xad")
+        lbase = os.path.join(repair_dir, "1")
+        lpath = lbase + ".ec03"
+        lbad = leaf_verdict(lpath, 3, lprot)
+        lpatches = reconstruct_leaves(
+            lprot, lctx, 3, lbad,
+            lambda sid, lo, size: open(
+                lbase + f".ec{sid:02d}", "rb"
+            ).read()[lo : lo + size],
+            [i for i in range(6) if i != 3],
+            backend=lbe,
+        )
+        apply_leaf_repair(lpath, 3, lprot, lpatches)
+        # full rebuild path on the twin copy (verify-and-exclude
+        # replaces the corrupt shard wholesale)
+        rebuild_ec_files(os.path.join(rebuild_dir, "1"), lctx, backend=lbe)
+        lrepaired = open(lpath, "rb").read()
+        lrebuilt = open(os.path.join(rebuild_dir, "1.ec03"), "rb").read()
+        check(
+            "leaf_repair_bit_identical",
+            lbad == [2]
+            and lrepaired == lshards[3].tobytes()
+            and lrepaired == lrebuilt
+            and not os.path.exists(journal_path(lpath)),
+            f"bad={lbad} equal_orig={lrepaired == lshards[3].tobytes()} "
+            f"equal_rebuild={lrepaired == lrebuilt}",
+        )
+
         # ---- flight recorder: the DISARMED tracer must never tax the
         # hot path (its per-batch touches are a single is-None check +
         # singleton no-op), and the ARMED tracer must actually record
@@ -1884,6 +2026,10 @@ def main() -> None:
         # volume bit-exactly before the device phase clears it.
         rebuild_stats = _cpu_rebuild_bench(base, dat_size)
         degraded_stats = _degraded_read_bench(base)
+        # Leaf repair vs full rebuild (ISSUE 8): bytes moved + wall
+        # time to fix one rotten 64 KiB leaf both ways, bit-identity
+        # asserted; restores the volume before the device phase.
+        leaf_repair_stats = _leaf_repair_bench(base)
         # Shared device-queue scheduler: foreground encode vs colocated
         # recovery stream on one queue (PR 4 acceptance metric).
         colocated_stats = _colocated_bench()
@@ -1937,6 +2083,7 @@ def main() -> None:
             "pipeline_gib": round((pipe_mb << 20) / (1 << 30), 3),
             **rebuild_stats,
             **degraded_stats,
+            **leaf_repair_stats,
             **colocated_stats,
         }
         best.update(

@@ -59,6 +59,17 @@ from .peer_rebuild import (
     rebuild_from_peers,
 )
 from .rebuild import rebuild_ec_files
+from .repair_journal import (
+    JOURNAL_SUFFIX,
+    JournalError,
+    LeafPatch,
+    RepairJournal,
+    apply_leaf_repair,
+    leaf_verdict,
+    reconstruct_leaves,
+    recover_volume_journals,
+    sweep_stale_journals,
+)
 from .scrub import (
     QUARANTINE_SUFFIX,
     RateLimiter,

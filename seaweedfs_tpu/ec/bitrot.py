@@ -286,6 +286,21 @@ class BitrotProtection:
             ctx, block_size, generation, uid, sizes, crcs, leaf_size, leaf_crcs
         )
 
+    def verify_range(self, shard_id: int, lo: int, data: bytes) -> bool:
+        """Verify `data` as the bytes of shard `shard_id` at [lo,
+        lo+len(data)) against the finest granule CRCs the sidecar
+        records. `lo` must be granule-aligned; the final granule may be
+        the shard's partial tail. The ONE range-vs-granule check shared
+        by degraded reads, leaf reconstruction, and ranged peer fetch —
+        offset/tail arithmetic lives here exactly once."""
+        gsize, crcs = self.verify_granularity(shard_id)
+        hi = lo + len(data)
+        for gi in range(lo // gsize, -(-hi // gsize)):
+            blk = data[gi * gsize - lo : min((gi + 1) * gsize, hi) - lo]
+            if gi >= len(crcs) or crc32c(blk) != crcs[gi]:
+                return False
+        return True
+
     # ---- file io ----
 
     def save(self, path: str) -> None:

@@ -23,7 +23,6 @@ from ..storage.needle_map import SortedFileNeedleMap
 from ..storage.types import actual_offset
 from ..utils import trace
 from ..utils.chunk_cache import ChunkCache
-from ..utils.crc import crc32c
 from ..utils.glog import logger
 from ..ops import gf256
 from .backend import RSBackend, _decode_coeffs, get_backend
@@ -116,6 +115,17 @@ class EcVolume:
             if len(b) < 8:
                 break
             self._deleted.add(struct.unpack(">Q", b)[0])
+
+        # Crash recovery BEFORE serving: a pending <shard>.repair
+        # journal means a leaf repair was interrupted mid-protocol —
+        # replay (or roll back) it now so no fd ever opens over a
+        # half-applied patch (ec/repair_journal.py window table).
+        try:
+            from .repair_journal import recover_volume_journals
+
+            recover_volume_journals(self.base, self.ctx)
+        except Exception as e:  # recovery must never block a mount
+            log.error("repair-journal recovery for %s failed: %s", self.base, e)
 
         self.shard_fds: dict[int, int] = {}
         self._shard_size = 0
@@ -350,13 +360,8 @@ class EcVolume:
             """Verify a shard's [lo, hi) bytes against its own granule
             CRCs (granules align across shards: equal sizes, one
             layout)."""
-            _, crcs = prot.verify_granularity(sid)
             with trace.stage(sp, "crc_verify"):
-                for bi in range(lo // bs, -(-hi // bs)):
-                    blk = data[bi * bs - lo : min((bi + 1) * bs, hi) - lo]
-                    if bi >= len(crcs) or crc32c(blk) != crcs[bi]:
-                        return False
-            return True
+                return prot.verify_range(sid, lo, data)
 
         # Sources are sidecar-verified BEFORE being fed to Reed-Solomon:
         # a silently-rotten sibling is excluded instead of poisoning the
@@ -543,6 +548,31 @@ class EcVolume:
             self._shard_gen[sid] = self._shard_gen.get(sid, 0) + 1
             if self.interval_cache is not None:
                 self.interval_cache.drop_prefix(f"{self._cache_ns}{sid}:")
+
+    def invalidate_shard_ranges(
+        self, shard_id: int, ranges: list[tuple[int, int]]
+    ) -> None:
+        """Drop cached reconstructed extents overlapping the given byte
+        ranges of one shard (a leaf repair just patched those bytes in
+        place — same inode, so no fd swap, but any cached extent built
+        over the old bytes is stale). Finer than a whole-shard
+        generation bump: the shard's other cached extents stay hot."""
+        if self.interval_cache is None or not ranges:
+            return
+        prefix = (
+            f"{self._cache_ns}{shard_id}:{self._shard_gen.get(shard_id, 0)}:"
+        )
+
+        def overlaps(key: str) -> bool:
+            try:
+                lo, hi = key[len(prefix):].split(":")
+                lo, hi = int(lo), int(hi)
+            except ValueError:
+                return True  # unparseable = assume stale
+            return any(lo < rhi and rlo < hi for rlo, rhi in ranges)
+
+        with self._lock:
+            self.interval_cache.drop_matching(prefix, overlaps)
 
     @property
     def shard_ids(self) -> list[int]:

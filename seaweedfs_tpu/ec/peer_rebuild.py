@@ -9,6 +9,15 @@ the streaming equivalent: fetch just enough sibling shards from peer
 holders through the shard-read RPC, rebuild locally on the TPU through
 the staged/scheduled path, and publish only the regenerated targets.
 
+Present-but-corrupt local shards whose rot is pinned to specific 64 KiB
+leaves (v2 sidecar) are repaired at LEAF granularity first: only the
+rotten leaves' byte ranges are fetched from k range-verified sources
+(local good shards from disk, the rest over the ranged shard-read RPC)
+and patched in place under the crash-consistent repair journal
+(ec/repair_journal.py) — ~k·64 KiB of wire per rotten leaf instead of
+~k·shard. Only what leaf repair cannot fix takes the whole-shard
+fetch/rebuild/publish path below.
+
 Correctness envelope (the same verify-and-exclude rules as the local
 rebuild, extended across the wire):
 
@@ -44,6 +53,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 from .. import faults
+from ..utils import metrics as M
 from ..utils import trace
 from ..utils.crc import crc32c
 from ..utils.fs import fsync_dir
@@ -52,6 +62,12 @@ from ..utils.retry import RetryError, RetryPolicy, retry_call
 from .bitrot import BitrotError, BitrotProtection
 from .context import ECContext, ECError
 from .rebuild import rebuild_ec_files
+from .repair_journal import (
+    apply_leaf_repair,
+    leaf_verdict,
+    patched_byte_ranges,
+    reconstruct_leaves,
+)
 from .volume_info import VolumeInfo
 
 log = logger("ec.peer")
@@ -99,6 +115,20 @@ class PeerRebuildReport:
     local_sources: list[int] = field(default_factory=list)
     corrupt_local: list[int] = field(default_factory=list)
     excluded_peers: list[str] = field(default_factory=list)
+    # Present-but-corrupt local shards whose rot was leaf-localized and
+    # repaired IN PLACE under the repair journal, fetching only the
+    # rotten leaves' byte ranges from peers (shard -> patched leaves).
+    # These never enter the whole-shard rebuild.
+    leaf_repaired: dict[int, list[int]] = field(default_factory=dict)
+    # Bytes actually pulled over the wire for those ranged repairs
+    # (including granule re-reads) — the ~k·64 KiB-per-leaf acceptance
+    # number, vs ~k·shard for a full peer-fetch rebuild.
+    repair_wire_bytes: int = 0
+    # In-place patches applied this run (shard -> [(lo, hi), ...]): the
+    # serving layer drops cached reconstructions over exactly these.
+    patched_ranges: dict[int, list[tuple[int, int]]] = field(
+        default_factory=dict
+    )
 
 
 def staging_dir(base: str) -> str:
@@ -233,6 +263,72 @@ def _fetch_shard_verified(
         trace.finish(sp)
 
 
+def _fetch_range_verified(
+    peer: str,
+    sid: int,
+    lo: int,
+    size: int,
+    prot: BitrotProtection,
+    fetch,
+    policy: RetryPolicy,
+    counter: list,
+    sp=None,
+) -> bytes:
+    """Fetch ONE leaf-aligned byte range [lo, lo+size) of a sibling
+    shard from `peer`, verifying every granule against the sidecar as
+    it lands — the ranged analog of `_fetch_shard_verified`. A granule
+    that mismatches gets one immediate re-read (transient wire
+    corruption); a repeat mismatch raises PeerCorruptError so the
+    caller excludes the holder. `counter[0]` accumulates the bytes
+    actually pulled over the wire (re-reads included)."""
+    gsize, _ = prot.verify_granularity(sid)
+
+    def get(off: int, n: int) -> bytes:
+        def attempt() -> bytes:
+            try:
+                faults.fire(
+                    "ec.peer_fetch.read", peer=peer, shard=sid, offset=off
+                )
+                data = fetch(peer, sid, off, n)
+            except (PeerFetchTransient, PeerCorruptError):
+                raise
+            except (IOError, OSError) as e:
+                raise PeerFetchTransient(str(e)) from e
+            data = faults.mutate(
+                "ec.peer_fetch.read", data, peer=peer, shard=sid, offset=off
+            )
+            if len(data) != n:
+                raise PeerFetchTransient(
+                    f"short read from {peer} for shard {sid}: "
+                    f"{len(data)}/{n} bytes at {off}"
+                )
+            return data
+
+        with trace.stage(sp, "repair_fetch"):
+            got = retry_call(
+                attempt, policy, retry_on=(PeerFetchTransient,),
+                describe=f"peer range fetch {peer} shard {sid}",
+            )
+        counter[0] += len(got)
+        return got
+
+    data = get(lo, size)
+    with trace.stage(sp, "crc_verify"):
+        if not prot.verify_range(sid, lo, data):
+            # pin the mismatch to its granule(s): one immediate re-read
+            # each (transient wire corruption); a repeat mismatch is
+            # the peer serving rot
+            for j in range(0, size, gsize):
+                g = data[j : j + gsize]
+                if prot.verify_range(sid, lo + j, g):
+                    continue
+                g2 = get(lo + j, len(g))
+                if not prot.verify_range(sid, lo + j, g2):
+                    raise PeerCorruptError(peer, sid, (lo + j) // gsize)
+                data = data[:j] + g2 + data[j + len(g) :]
+    return data
+
+
 def rebuild_from_peers(
     base: str,
     holders: dict[int, list[str]],
@@ -319,6 +415,105 @@ def _rebuild_from_peers_span(
         good_local, corrupt_local = _verify_local(base, ctx, prot, present)
     report.local_sources = list(good_local)
     report.corrupt_local = list(corrupt_local)
+    excluded: set[str] = set()
+
+    # ---- leaf-granular ranged repair of present-but-corrupt locals ----
+    # When the rot is pinned to specific leaves (v2 sidecar, full-length
+    # file), fetch ONLY those leaves' byte ranges from k verified
+    # sources — local good shards read from disk, the remainder pulled
+    # from peers through the ranged shard-read RPC — and patch the
+    # canonical file in place under the repair journal. Wire cost:
+    # ~k·64 KiB per rotten leaf instead of ~k·shard. Anything this
+    # cannot fix stays in corrupt_local and takes the whole-shard path.
+    if prot.has_leaves and corrupt_local:
+        for sid in list(corrupt_local):
+            path = base + ctx.to_ext(sid)
+            bad = leaf_verdict(path, sid, prot)
+            if bad is None:
+                continue  # size rot / unreadable: whole-shard replacement
+            if not bad:
+                # whole-shard verify failed but every leaf now verifies:
+                # repaired between the two walks — treat as good
+                corrupt_local.remove(sid)
+                good_local.append(sid)
+                report.corrupt_local.remove(sid)
+                report.local_sources = sorted(
+                    set(report.local_sources) | {sid}
+                )
+                continue
+            wire = [0]
+
+            def read_range(src: int, lo: int, size: int) -> bytes | None:
+                if src in good_local:
+                    try:
+                        faults.fire(
+                            "ec.repair.source_read", shard=src, offset=lo
+                        )
+                        with open(base + ctx.to_ext(src), "rb") as f:
+                            f.seek(lo)
+                            got = f.read(size)
+                        if len(got) == size:
+                            return faults.mutate(
+                                "ec.repair.source_read", got,
+                                shard=src, offset=lo,
+                            )
+                    except (OSError, IOError):
+                        pass  # transient local I/O: the same shard may
+                        # still be servable by a peer holder below —
+                        # don't forfeit the cheap ranged path over it
+                for peer in holders.get(src, []):
+                    if peer in excluded:
+                        continue
+                    try:
+                        return _fetch_range_verified(
+                            peer, src, lo, size, prot, fetch, policy,
+                            wire, sp,
+                        )
+                    except PeerCorruptError as e:
+                        log.warning("excluding peer: %s", e)
+                        trace.event(
+                            sp, "peer_excluded", peer=peer, shard=src
+                        )
+                        excluded.add(peer)
+                        continue
+                    except (PeerFetchTransient, RetryError) as e:
+                        log.warning(
+                            "peer %s unreachable for shard %d range "
+                            "[%d:+%d): %s", peer, src, lo, size, e,
+                        )
+                        continue
+                return None
+
+            candidates = sorted(good_local) + sorted(
+                s for s in holders
+                if s not in good_local and s != sid and 0 <= s < ctx.total
+            )
+            try:
+                patches = reconstruct_leaves(
+                    prot, ctx, sid, bad, read_range, candidates,
+                    backend=backend, span=sp,
+                )
+                apply_leaf_repair(path, sid, prot, patches, span=sp)
+            except (ECError, OSError) as e:
+                M.ec_leaf_repairs_total.inc(outcome="failed")
+                log.warning(
+                    "ranged leaf repair of shard %d failed (%s); falling "
+                    "back to whole-shard peer rebuild", sid, e,
+                )
+                continue
+            corrupt_local.remove(sid)
+            good_local.append(sid)
+            report.corrupt_local.remove(sid)
+            report.local_sources = sorted(set(report.local_sources) | {sid})
+            report.leaf_repaired[sid] = sorted(bad)
+            report.repair_wire_bytes += wire[0]
+            report.patched_ranges[sid] = patched_byte_ranges(prot, sid, bad)
+            M.ec_leaf_repairs_total.inc(outcome="repaired")
+            log.warning(
+                "leaf-repaired shard %d in place (leaves %s, %d wire "
+                "bytes)", sid, sorted(bad), wire[0],
+            )
+        report.excluded_peers = sorted(excluded)
 
     if targets is None:
         want = sorted(set(range(ctx.total)) - set(good_local))
@@ -335,7 +530,8 @@ def _rebuild_from_peers_span(
     os.makedirs(sdir, exist_ok=True)
     sbase = os.path.join(sdir, os.path.basename(base))
 
-    excluded: set[str] = set()
+    # `excluded` carries over from the ranged-repair stage: a holder
+    # that served rot for a 64 KiB range serves rot, full stop.
     try:
         # ---- assemble k verified sources: local links + peer streams --
         sources = set(good_local)

@@ -29,6 +29,12 @@ class NodeView:
     rack: str = ""
     data_center: str = ""
     free_slots: int = 100
+    # Disk headroom in bytes; -1 = unknown (callers without byte-level
+    # topology keep slot-only planning). Known headroom both GATES a
+    # destination (a shard must physically fit) and breaks scoring ties
+    # toward the roomiest node, so sustained holder loss doesn't pile
+    # regenerated shards onto a nearly-full survivor.
+    free_bytes: int = -1
     # vid -> set of shard ids held
     shards: dict[int, set[int]] = field(default_factory=dict)
 
@@ -65,6 +71,8 @@ def node_view_for(
     num_volumes: int,
     ec_entries,
     collection: str = "",
+    used_bytes: int = -1,
+    capacity_bytes: int = -1,
 ) -> NodeView:
     """The ONE topology->NodeView mapping (shard-bit expansion and the
     slots*10 capacity formula) shared by the shell executor and the
@@ -73,7 +81,11 @@ def node_view_for(
 
     ec_entries: EcShardInfoMsg-shaped objects (.id/.shard_bits/
     .collection). Every collection counts against capacity; only the
-    selected one (if any) is planned."""
+    selected one (if any) is planned.
+
+    `used_bytes`/`capacity_bytes` (both >= 0) derive the node's disk
+    headroom (`NodeView.free_bytes`); either unknown keeps headroom
+    unknown (-1, slot-only planning)."""
     shards: dict[int, set[int]] = {}
     all_shards = 0
     for e in ec_entries:
@@ -88,6 +100,11 @@ def node_view_for(
         free_slots=max(
             (int(max_volume_count or 8) - num_volumes) * 10 - all_shards,
             0,
+        ),
+        free_bytes=(
+            max(capacity_bytes - used_bytes, 0)
+            if capacity_bytes >= 0 and used_bytes >= 0
+            else -1
         ),
         shards=shards,
     )
@@ -109,22 +126,28 @@ def plan_ec_balance(
 
 
 def plan_shard_placement(
-    nodes: list[NodeView], vid: int, shard_ids: list[int]
+    nodes: list[NodeView], vid: int, shard_ids: list[int],
+    shard_bytes: int = 0,
 ) -> dict[int, str]:
     """Pick a destination server for each regenerated shard of `vid`
     (peer-fetch rebuild's distribute step): the same scoring the
     balancer uses for a move destination — fewest shards of THIS volume
     (spread the loss domain), then fewest total shards, then most free
-    slots. Mutates the views as it assigns so successive shards spread
-    instead of stacking on one idle node. Shards no node can take are
-    absent from the result (the caller keeps them local)."""
+    slots, then most disk headroom. Mutates the views as it assigns
+    (slots AND headroom) so successive shards spread instead of
+    stacking on one idle node. `shard_bytes` (when > 0) additionally
+    gates destinations on known headroom: a shard is never planned onto
+    a node it cannot physically fit. Shards no node can take are absent
+    from the result (the caller keeps them local)."""
     plan: dict[int, str] = {}
     for sid in sorted(shard_ids):
-        dest = _pick_dest_node(nodes, vid)
+        dest = _pick_dest_node(nodes, vid, shard_bytes=shard_bytes)
         if dest is None:
             continue
         dest.shards.setdefault(vid, set()).add(sid)
         dest.free_slots -= 1
+        if dest.free_bytes >= 0:
+            dest.free_bytes = max(dest.free_bytes - shard_bytes, 0)
         plan[sid] = dest.id
     return plan
 
@@ -160,16 +183,26 @@ def _racks(nodes: list[NodeView]) -> dict[tuple[str, str], list[NodeView]]:
 
 
 def _pick_dest_node(
-    candidates: list[NodeView], vid: int
+    candidates: list[NodeView], vid: int, shard_bytes: int = 0
 ) -> NodeView | None:
     """Score a destination server: fewest shards of THIS volume first
     (spread the loss domain), then fewest total shards, then most free
-    slots (pickEcNodeToBalanceShardsInto)."""
+    slots, then most known disk headroom
+    (pickEcNodeToBalanceShardsInto, capacity-aware). A node with known
+    headroom below `shard_bytes` is not a candidate at all."""
     best = None
     for n in candidates:
         if n.free_slots <= 0:
             continue
-        key = (len(n.shards.get(vid, ())), n.shard_count(), -n.free_slots, n.id)
+        if shard_bytes > 0 and 0 <= n.free_bytes < shard_bytes:
+            continue
+        key = (
+            len(n.shards.get(vid, ())),
+            n.shard_count(),
+            -n.free_slots,
+            -max(n.free_bytes, 0),
+            n.id,
+        )
         if best is None or key < best[0]:
             best = (key, n)
     return best[1] if best else None

@@ -16,7 +16,15 @@ Design points:
   the block walk.
 - rate-limited: a token bucket caps read bandwidth so scrubbing never
   starves foreground traffic.
-- quarantine, never trust: a corrupt shard file is renamed to
+- repair at the finest granularity the evidence allows: rot pinned to
+  specific 64 KiB leaves (v2 sidecar) with k verified-good local
+  sources is reconstructed and patched IN PLACE under the write-ahead
+  repair journal (ec/repair_journal.py — crash at any point leaves the
+  shard fully-old or fully-new verified, never a mix); pending
+  journals from a crashed repair are replayed/rolled back at pass
+  start, and stale journal litter is TTL-swept at pass end.
+- quarantine, never trust: a corrupt shard file that leaf repair
+  cannot fix (size rot, v1 sidecar, too few sources) is renamed to
   <shard>.bad (kept for forensics) so it can NEVER be fed to
   Reed-Solomon; reads degrade to reconstruction until rebuild lands.
 - fail closed: a malformed sidecar or >parity mismatches stops the
@@ -36,6 +44,8 @@ import time
 from dataclasses import dataclass, field
 
 from .. import faults
+from ..utils import metrics as M
+from ..utils import trace
 from ..utils.crc import crc32c
 from ..utils.fs import atomic_write, fsync_dir
 from ..utils.glog import logger
@@ -43,6 +53,14 @@ from ..utils.retry import CircuitBreaker, CircuitOpenError, RetryError, RetryPol
 from .bitrot import BitrotError, BitrotProtection
 from .context import QUARANTINE_SUFFIX, ECContext, ECError
 from .rebuild import rebuild_ec_files
+from .repair_journal import (
+    apply_leaf_repair,
+    leaf_verdict,
+    patched_byte_ranges,
+    reconstruct_leaves,
+    recover_volume_journals,
+    sweep_stale_journals,
+)
 
 log = logger("ec.scrub")
 
@@ -166,6 +184,16 @@ class ScrubReport:
     quarantined: list[str] = field(default_factory=list)
     rebuilt: list[int] = field(default_factory=list)
     aged_out: list[str] = field(default_factory=list)  # .bad files retired
+    # Leaf-granular in-place repairs this pass (shard -> patched leaf
+    # indices): the shard was NEVER quarantined — its rotten 64 KiB
+    # leaves were reconstructed from k verified siblings and patched
+    # under the repair journal (ec/repair_journal.py).
+    leaf_repaired: dict[int, list[int]] = field(default_factory=dict)
+    # Crash recovery at pass start: journals replayed (shard -> leaves)
+    # and torn journals rolled back.
+    journal_replayed: dict[int, list[int]] = field(default_factory=dict)
+    journal_rolled_back: list[str] = field(default_factory=list)
+    swept_journals: list[str] = field(default_factory=list)  # TTL litter
     refused: str = ""  # non-empty = fail-closed, nothing was touched
 
     @property
@@ -208,7 +236,9 @@ def scrub_ec_volume(
     expected_shards: list[int] | None = None,
     on_quarantine=None,
     on_rebuilt=None,
+    on_leaf_patched=None,
     bad_retention_s: float | None = None,
+    journal_ttl_s: float | None = 86400.0,
     scheduler=None,
 ) -> ScrubReport:
     """One scrub pass (possibly budget-sliced) over one EC volume.
@@ -234,6 +264,16 @@ def scrub_ec_volume(
     keeps quarantines forever — retiring evidence is an operator
     opt-in.
 
+    `on_leaf_patched(shard_id, byte_ranges)` fires whenever this pass
+    changes a shard's bytes IN PLACE — a replayed crash journal at pass
+    start, or a leaf-granular repair — so a serving layer can drop
+    cached reconstructions over exactly those ranges (the fd itself
+    stays valid: in-place patching never swaps the inode).
+
+    `journal_ttl_s` retires stale/orphaned `<shard>.repair` journals
+    older than the TTL at pass completion (valid pending journals are
+    replayed at pass START, never swept); None disables the sweep.
+
     `scheduler` is the QueueScope whose placement/admission config the
     repair rebuild's scrub-class stream runs under (the daemon passes
     its Store's scope; None = the process-wide default).
@@ -254,6 +294,17 @@ def scrub_ec_volume(
         report.refused = f"sidecar ratio {prot.ctx} != expected {ctx}"
         return report
     ctx = prot.ctx
+
+    # Crash recovery BEFORE any verification: a pending repair journal
+    # is replayed (or a torn one rolled back) so the walk below sees
+    # fully-old or fully-new bytes, never a half-applied patch. The
+    # replay may flip sidecar leaf CRCs — `prot` is updated in place.
+    rec = recover_volume_journals(base, ctx, prot)
+    report.journal_replayed = rec["replayed"]
+    report.journal_rolled_back = rec["rolled_back"]
+    if on_leaf_patched is not None and prot.has_leaves:
+        for sid, leaves in rec["replayed"].items():
+            on_leaf_patched(sid, patched_byte_ranges(prot, sid, leaves))
 
     cursor = ScrubCursor.load(base) if resumable else None
     if cursor is None or cursor.generation != prot.generation:
@@ -443,6 +494,98 @@ def scrub_ec_volume(
             f"{ctx.parity_shards}); sidecar suspect, refusing to quarantine"
         )
         return report
+
+    # ---- leaf-granular in-place repair ----------------------------------
+    # A shard whose rot is pinned to specific 64 KiB leaves (v2 sidecar)
+    # and whose siblings still muster k verified-good sources is patched
+    # IN PLACE under the repair journal instead of being quarantined:
+    # ~k leaves of sibling I/O instead of a whole-shard rebuild, no
+    # unmount/remount, no .bad forensic copy. Anything leaf repair
+    # cannot fix (size rot, v1 sidecar, <k sources, reconstruction
+    # refusal) falls through to the quarantine + rebuild path below.
+    if repair and prot.has_leaves and report.corrupt_shards:
+        good_sids = sorted(
+            sid
+            for sid in range(ctx.total)
+            if sid not in report.corrupt_shards
+            and os.path.exists(base + ctx.to_ext(sid))
+        )
+        for sid in [s for s in report.corrupt_shards if s in report.corrupt_leaves]:
+            path = base + ctx.to_ext(sid)
+            if len(good_sids) < ctx.data_shards:
+                M.ec_leaf_repairs_total.inc(outcome="refused")
+                break  # below the floor for every remaining shard
+            # The walk's leaf set may be a stale slice verdict; pin the
+            # repair to a FRESH full-leaf verdict (same cost as the
+            # carried-verdict re-verify, and it also catches leaves that
+            # rotted after the slice ran).
+            fresh = leaf_verdict(
+                path, sid, prot,
+                on_block=rate_limiter.consume if rate_limiter else None,
+            )
+            if fresh is None:
+                continue  # size rot / unreadable: not patchable in place
+            if not fresh:
+                # the shard verifies clean now (repaired since its
+                # slice): clear the verdict rather than quarantine
+                report.corrupt_shards.remove(sid)
+                report.corrupt_leaves.pop(sid, None)
+                continue
+
+            def read_range(src: int, lo: int, size: int) -> bytes | None:
+                try:
+                    faults.fire(
+                        "ec.repair.source_read", shard=src, offset=lo
+                    )
+                    with open(base + ctx.to_ext(src), "rb") as f:
+                        f.seek(lo)
+                        got = f.read(size)
+                except (OSError, IOError):
+                    return None
+                return faults.mutate(
+                    "ec.repair.source_read", got, shard=src, offset=lo
+                )
+
+            # Flight-recorder root per repair op (repair_fetch/
+            # crc_verify/repair_patch stages land under it).
+            sp = trace.start(
+                "ec.leaf_repair",
+                name=f"{os.path.basename(base)}.{sid:02d}",
+                shard=sid, leaves=sorted(fresh),
+            )
+            try:
+                with trace.activate(sp):
+                    patches = reconstruct_leaves(
+                        prot, ctx, sid, fresh, read_range, good_sids,
+                        backend=backend,
+                        span=sp,
+                        on_bytes=(
+                            rate_limiter.consume if rate_limiter else None
+                        ),
+                    )
+                    apply_leaf_repair(
+                        path, sid, prot, patches, ecsum_path=ecsum, span=sp
+                    )
+            except (ECError, OSError) as e:
+                M.ec_leaf_repairs_total.inc(outcome="failed")
+                log.warning(
+                    "leaf repair of shard %d failed (%s); falling back to "
+                    "quarantine", sid, e,
+                )
+                continue
+            finally:
+                trace.finish(sp)
+            report.corrupt_shards.remove(sid)
+            report.corrupt_leaves.pop(sid, None)
+            report.leaf_repaired[sid] = sorted(fresh)
+            M.ec_leaf_repairs_total.inc(outcome="repaired")
+            log.warning(
+                "leaf-repaired shard %d in place (leaves %s); quarantine "
+                "avoided", sid, sorted(fresh),
+            )
+            if on_leaf_patched is not None:
+                on_leaf_patched(sid, patched_byte_ranges(prot, sid, fresh))
+
     present_good = present_files - len(report.corrupt_shards)
     if report.corrupt_shards and present_good < ctx.data_shards:
         report.refused = (
@@ -519,16 +662,39 @@ def scrub_ec_volume(
     # inferred from absence — a shard neither verified nor rebuilt
     # keeps its quarantine.
     if bad_retention_s is not None and not report.refused:
+        # A leaf repair IS a verified replacement (the patched leaves
+        # were CRC-verified before publish), so a stale quarantine of
+        # the same shard — left by an earlier whole-shard pass — ages
+        # out exactly like one retired by a rebuild.
         verified_now = (
-            set(report.checked_shards) - set(report.corrupt_shards)
-        ) | set(report.rebuilt)
+            (set(report.checked_shards) - set(report.corrupt_shards))
+            | set(report.rebuilt)
+            | set(report.leaf_repaired)
+        )
         now = time.time()
         for sid in sorted(verified_now):
             bad_path = base + ctx.to_ext(sid) + QUARANTINE_SUFFIX
             try:
                 age = now - os.path.getmtime(bad_path)
             except OSError:
-                continue  # no quarantine for this shard
+                # no .bad — but an ORPHANED .bad.leaves forensic marker
+                # (its .bad already retired or manually removed) must
+                # not outlive the retention either
+                lpath = bad_path + ".leaves"
+                try:
+                    lage = now - os.path.getmtime(lpath)
+                except OSError:
+                    continue  # no quarantine artifacts for this shard
+                if lage < bad_retention_s:
+                    continue
+                try:
+                    os.unlink(lpath)
+                except OSError:
+                    continue
+                fsync_dir(lpath)
+                report.aged_out.append(lpath)
+                log.info("retired orphaned leaf marker %s", lpath)
+                continue
             if age < bad_retention_s:
                 continue
             try:
@@ -542,6 +708,13 @@ def scrub_ec_volume(
             fsync_dir(bad_path)
             report.aged_out.append(bad_path)
             log.info("retired quarantine %s (age %.0fs)", bad_path, age)
+
+    # ---- sweep stale repair-journal litter ------------------------------
+    # Valid pending journals were replayed at pass start; what's left is
+    # stale intents (volume re-encoded) or orphans (shard gone) — kept
+    # for forensics until the TTL, like PR 6's stale-staging sweep.
+    if journal_ttl_s is not None:
+        report.swept_journals = sweep_stale_journals(base, ctx, journal_ttl_s)
     return report
 
 
@@ -565,12 +738,14 @@ class ScrubDaemon:
         breaker: CircuitBreaker | None = None,
         backend=None,
         bad_retention_s: float | None = None,
+        journal_ttl_s: float | None = 86400.0,
     ):
         self.store = store
         self.interval = interval
         self.repair = repair
         self.backend = backend
         self.bad_retention_s = bad_retention_s
+        self.journal_ttl_s = journal_ttl_s
         self.limiter = RateLimiter(bytes_per_sec)
         self.max_blocks = max_blocks_per_volume
         # One breaker PER VOLUME: a permanently-unrebuildable volume
@@ -654,6 +829,7 @@ class ScrubDaemon:
                     breaker=self.breaker_for(vid),
                     expected_shards=sorted(mounted),
                     bad_retention_s=self.bad_retention_s,
+                    journal_ttl_s=self.journal_ttl_s,
                     # the Store's own scheduler scope (per-tenant
                     # placement/shares); falls back to the process-wide
                     # default for bare stores
@@ -667,14 +843,22 @@ class ScrubDaemon:
                     on_rebuilt=lambda sids, ev=ev, m=mounted: ev.reopen_shards(
                         [s for s in sids if s in m]
                     ),
+                    # In-place patches (journal replay, leaf repair)
+                    # keep the inode — no fd swap, but any cached
+                    # reconstruction over the patched bytes is stale.
+                    on_leaf_patched=lambda sid, ranges, ev=ev: (
+                        ev.invalidate_shard_ranges(sid, ranges)
+                    ),
                 )
                 out[vid] = report
                 self.reports[vid] = report
                 if report.refused:
                     log.warning("scrub vol %d refused: %s", vid, report.refused)
-                elif report.quarantined or report.rebuilt:
+                elif report.quarantined or report.rebuilt or report.leaf_repaired:
                     log.warning(
-                        "scrub vol %d: quarantined=%s rebuilt=%s",
+                        "scrub vol %d: quarantined=%s rebuilt=%s "
+                        "leaf_repaired=%s",
                         vid, report.quarantined, report.rebuilt,
+                        report.leaf_repaired,
                     )
         return out

@@ -401,6 +401,7 @@ class VolumeService:
                 rebuilt_shard_ids=out["rebuilt"],
                 fetched_shard_ids=out["fetched"],
                 distributed_shard_ids=out["distributed"],
+                repaired_shard_ids=out["repaired"],
             )
         from ..ec.backend import get_backend
         from ..ec.volume_info import VolumeInfo
@@ -890,6 +891,26 @@ class VolumeService:
             prot = BitrotProtection.load(base + ".ecsum")
         except BitrotError as e:
             return pb.ScrubResponse(error=f"sidecar unreadable: {e}")
+        # Crash recovery BEFORE verification: replay (or roll back) any
+        # pending <shard>.repair journal so this pass judges fully-old
+        # or fully-new bytes, never a half-applied leaf patch — the
+        # fleet scrub's recovery hook for holders with no local daemon.
+        from ..ec.repair_journal import (
+            patched_byte_ranges,
+            recover_volume_journals,
+        )
+
+        rec = recover_volume_journals(base, prot.ctx, prot)
+        journal_recovered = len(rec["replayed"]) + len(rec["rolled_back"])
+        if rec["replayed"]:
+            ev = self.store.find_ec_volume(request.volume_id)
+            if ev is not None and prot.has_leaves:
+                # in-place patches keep the inode: no fd swap, but any
+                # cached reconstruction over the patched bytes is stale
+                for sid, leaves in rec["replayed"].items():
+                    ev.invalidate_shard_ranges(
+                        sid, patched_byte_ranges(prot, sid, leaves)
+                    )
         checked: list[int] = []
         bad: list[int] = []
         for i in range(prot.ctx.total):
@@ -927,6 +948,7 @@ class VolumeService:
             bad_shards=bad,
             checked_shards=checked,
             quarantined_shards=quarantined,
+            repair_journal_recovered=journal_recovered,
         )
 
     def VolumeServerStatus(self, request, context):
@@ -1059,6 +1081,10 @@ class VolumeServer:
         # staging dir is per-volume; concurrent runs would wipe each
         # other). dict.setdefault is atomic under the GIL.
         self._peer_rebuild_busy: dict[int, threading.Lock] = {}
+        # Learned from HeartbeatResponse: the master's per-volume size
+        # limit, the denominator for capacity-aware shard placement
+        # (0 = not yet known -> slot-only planning).
+        self.volume_size_limit = 0
         self.store = Store(
             directories,
             ip=ip,
@@ -1328,6 +1354,11 @@ class VolumeServer:
         if owned:
             ev.reopen_shards(owned)
             self.notify_new_ec_shards(vid, collection)
+        # Leaf-repaired shards were patched IN PLACE on the canonical
+        # inode: the serving fd stays valid, but cached reconstructions
+        # over the patched byte ranges are stale — drop exactly those.
+        for sid, ranges in report.patched_ranges.items():
+            ev.invalidate_shard_ranges(sid, ranges)
         distributed = self._distribute_lost_shards(
             vid, collection, loc_base, ctx, legit
         )
@@ -1335,6 +1366,7 @@ class VolumeServer:
             "rebuilt": sorted(report.rebuilt),
             "fetched": sorted(report.fetched),
             "distributed": distributed,
+            "repaired": sorted(report.leaf_repaired),
         }
 
     def _distribute_lost_shards(
@@ -1395,6 +1427,10 @@ class VolumeServer:
                 f"ec.rebuild -fromPeers to finish the handoff"
             ) from e
         nodes = {n.id: n for n in topo.nodes}
+        # Capacity-aware views: used bytes straight from the topology
+        # (volume sizes + EC shard bytes); the denominator is the
+        # master's own volume size limit, learned via heartbeat. Either
+        # side unknown -> headroom unknown -> slot-only planning.
         views = [
             node_view_for(
                 n.id,
@@ -1403,66 +1439,149 @@ class VolumeServer:
                 n.max_volume_count,
                 len(n.volumes),
                 n.ec_shards,
+                used_bytes=(
+                    sum(int(v.size) for v in n.volumes)
+                    + sum(
+                        int(e.shard_size) * bin(e.shard_bits).count("1")
+                        for e in n.ec_shards
+                    )
+                ),
+                capacity_bytes=(
+                    int(n.max_volume_count or 8) * self.volume_size_limit
+                    if self.volume_size_limit > 0
+                    else -1
+                ),
             )
             for n in topo.nodes
         ]
-        plan = plan_shard_placement(views, vid, pending)
+        try:
+            shard_bytes = os.path.getsize(base + ctx.to_ext(pending[0]))
+        except OSError:
+            shard_bytes = 0
         shard_count = {
             n.id: {e.id: bin(e.shard_bits).count("1") for e in n.ec_shards}
             for n in topo.nodes
         }
         faults.fire("ec.peer_rebuild.before_distribute", volume=vid)
         adopted: list[int] = []
-        for sid in pending:
-            node = nodes.get(plan.get(sid, ""))
-            if node is None or node.location.url == me:
-                # no capacity elsewhere (or the planner chose us): adopt
-                # the shard locally rather than leave it in limbo
-                adopted.append(sid)
-                done.append(sid)
-                continue
-            dest = fleet.grpc_addr(node.location)
-            first_on_dst = shard_count.get(node.id, {}).get(vid, 0) == 0
-            try:
-                stub = self._peer_stub(dest)
-                stub.VolumeEcShardsCopy(
-                    pb.EcShardsCopyRequest(
-                        volume_id=vid,
-                        collection=collection,
-                        shard_ids=[sid],
-                        source_url=f"{self.ip}:{self.grpc_port}",
-                        copy_ecx=first_on_dst,
-                        copy_ecj=first_on_dst,
-                        copy_vif=first_on_dst,
-                        copy_ecsum=first_on_dst,
-                    ),
-                    timeout=600,
-                    metadata=trace.grpc_metadata(),
-                )
-                stub.VolumeEcShardsMount(
-                    pb.EcShardsMountRequest(
-                        volume_id=vid, collection=collection
-                    ),
-                    timeout=60,
-                    metadata=trace.grpc_metadata(),
-                )
-            except grpc.RpcError as e:
-                # holder died mid-distribute: keep the handoff copy on
-                # disk (unmounted, never advertised) — the next run
-                # re-plans and finishes; never wedge the whole rebuild
+        # In-pass re-planning: a destination that dies (or refuses) is
+        # EXCLUDED and the remaining shards are re-planned against the
+        # surviving candidates inside this same run — a dead holder no
+        # longer defers the handoff to the next rebuild pass. Each
+        # failed round excludes at least one node, so the loop is
+        # bounded by the topology size.
+        remaining = list(pending)
+        dead_nodes: set[str] = set()
+        for _round in range(max(len(views), 1) + 1):
+            if not remaining:
+                break
+            candidates = [v for v in views if v.id not in dead_nodes]
+            plan = plan_shard_placement(
+                candidates, vid, remaining, shard_bytes=shard_bytes
+            )
+            if _round and plan:
                 log.warning(
-                    "distribute ec %d.%02d -> %s failed: %s; will retry "
-                    "on the next rebuild run", vid, sid, dest, e.code().name,
+                    "re-planned ec %d distribution for shards %s after "
+                    "excluding dead destinations %s",
+                    vid, remaining, sorted(dead_nodes),
                 )
-                continue
-            faults.fire(
-                "ec.peer_rebuild.after_distribute", volume=vid, shard=sid
-            )
-            os.unlink(base + ctx.to_ext(sid))
-            shard_count.setdefault(node.id, {})[vid] = (
-                shard_count.get(node.id, {}).get(vid, 0) + 1
-            )
-            done.append(sid)
+            next_round: list[int] = []
+            for sid in remaining:
+                node = nodes.get(plan.get(sid, ""))
+                if node is not None and node.id in dead_nodes:
+                    # planned in THIS round before the node died on an
+                    # earlier shard: don't burn another copy timeout on
+                    # it — straight to the next round's re-plan
+                    next_round.append(sid)
+                    continue
+                if node is None or node.location.url == me:
+                    if _round:
+                        # re-plan round after a destination death: no
+                        # SURVIVING alternate can take it. Keep the
+                        # handoff copy on disk (unmounted, never
+                        # advertised) for the next rebuild run instead
+                        # of adopting — a dead peer must not silently
+                        # re-home the shard onto the rebuilder.
+                        log.warning(
+                            "ec %d.%02d: no surviving alternate "
+                            "destination; handoff deferred to the next "
+                            "run", vid, sid,
+                        )
+                        continue
+                    # first plan: no capacity anywhere (or the planner
+                    # chose us) — adopt the shard locally rather than
+                    # leave it in limbo
+                    adopted.append(sid)
+                    done.append(sid)
+                    continue
+                dest = fleet.grpc_addr(node.location)
+                first_on_dst = shard_count.get(node.id, {}).get(vid, 0) == 0
+                try:
+                    stub = self._peer_stub(dest)
+                    stub.VolumeEcShardsCopy(
+                        pb.EcShardsCopyRequest(
+                            volume_id=vid,
+                            collection=collection,
+                            shard_ids=[sid],
+                            source_url=f"{self.ip}:{self.grpc_port}",
+                            copy_ecx=first_on_dst,
+                            copy_ecj=first_on_dst,
+                            copy_vif=first_on_dst,
+                            copy_ecsum=first_on_dst,
+                        ),
+                        timeout=600,
+                        metadata=trace.grpc_metadata(),
+                    )
+                    stub.VolumeEcShardsMount(
+                        pb.EcShardsMountRequest(
+                            volume_id=vid, collection=collection
+                        ),
+                        timeout=60,
+                        metadata=trace.grpc_metadata(),
+                    )
+                except grpc.RpcError as e:
+                    # destination died mid-distribute: exclude it and
+                    # re-plan THIS shard against the survivors in the
+                    # next round; the handoff copy stays on disk
+                    # (unmounted, never advertised) either way, so a
+                    # crash mid-re-plan still converges on re-run.
+                    # Best-effort delete of whatever the COPY landed at
+                    # the failed destination first: a copy-succeeded/
+                    # mount-failed node keeps the shard at its canonical
+                    # path, and once the shard is re-homed elsewhere a
+                    # later mount on that node would advertise a
+                    # duplicate holder. A dead node ignores the delete;
+                    # a merely-slow one is cleaned.
+                    log.warning(
+                        "distribute ec %d.%02d -> %s failed: %s; "
+                        "excluding the destination and re-planning",
+                        vid, sid, dest, e.code().name,
+                    )
+                    try:
+                        self._peer_stub(dest).VolumeEcShardsDelete(
+                            pb.EcShardsDeleteRequest(
+                                volume_id=vid,
+                                collection=collection,
+                                shard_ids=[sid],
+                            ),
+                            timeout=15,
+                            metadata=trace.grpc_metadata(),
+                        )
+                    except grpc.RpcError:
+                        pass  # node truly unreachable: nothing landed,
+                        # or its disk state is beyond reach either way
+                    dead_nodes.add(node.id)
+                    next_round.append(sid)
+                    continue
+                faults.fire(
+                    "ec.peer_rebuild.after_distribute", volume=vid, shard=sid
+                )
+                os.unlink(base + ctx.to_ext(sid))
+                shard_count.setdefault(node.id, {})[vid] = (
+                    shard_count.get(node.id, {}).get(vid, 0) + 1
+                )
+                done.append(sid)
+            remaining = next_round
         if adopted:
             # mount ONLY the adopted ids: a blanket refresh would also
             # mount handoff copies whose distribute failed above, and
@@ -1655,6 +1774,10 @@ class VolumeServer:
                     for resp in stream:
                         if self._hb_stop.is_set():
                             return
+                        if resp.volume_size_limit:
+                            self.volume_size_limit = int(
+                                resp.volume_size_limit
+                            )
                         if resp.leader and resp.leader != target:
                             # a follower answered: re-home to the leader
                             redirect = resp.leader

@@ -507,6 +507,10 @@ def ec_rebuild(env: ShellEnv, args) -> str:
             f" (fetched {list(r.fetched_shard_ids)} from peers, "
             f"distributed {list(r.distributed_shard_ids)})"
         )
+    if r.repaired_shard_ids:
+        # rot was leaf-localized: patched in place under the repair
+        # journal instead of a whole-shard rebuild
+        extra += f", leaf-repaired {list(r.repaired_shard_ids)} in place"
     return f"rebuilt shards {list(r.rebuilt_shard_ids)} on {url}{extra}"
 
 
@@ -1029,6 +1033,12 @@ def ec_scrub(env: ShellEnv, args) -> str:
                 + (gone_note if gone else "")
                 + (
                     f" (quarantined: {quarantined})" if quarantined else ""
+                )
+                + (
+                    f" ({r.repair_journal_recovered} repair journal(s) "
+                    f"recovered)"
+                    if r.repair_journal_recovered
+                    else ""
                 )
             )
             fleet_checked += r.checked

@@ -57,6 +57,19 @@ class ChunkCache:
                 self._bytes -= len(self._data.pop(k))
             return len(doomed)
 
+    def drop_matching(self, prefix: str, pred) -> int:
+        """Drop entries whose key starts with `prefix` AND satisfies
+        `pred(key)` — finer than drop_prefix when only part of a
+        namespace went stale (e.g. the byte ranges a leaf repair just
+        patched, leaving the shard's other cached extents hot)."""
+        with self._lock:
+            doomed = [
+                k for k in self._data if k.startswith(prefix) and pred(k)
+            ]
+            for k in doomed:
+                self._bytes -= len(self._data.pop(k))
+            return len(doomed)
+
     def clear(self) -> None:
         """Drop every entry (bulk invalidation — e.g. the EC interval
         cache on shard remount/rebuild/delete). Hit/miss counters are

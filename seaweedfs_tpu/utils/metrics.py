@@ -217,3 +217,14 @@ ec_ops_total = REGISTRY.counter(
 ec_bytes_total = REGISTRY.counter(
     "sw_ec_bytes_total", "bytes through the EC pipeline", ("op", "backend")
 )
+ec_leaf_repairs_total = REGISTRY.counter(
+    "sw_ec_leaf_repairs_total",
+    "leaf-granular in-place EC shard repairs by outcome "
+    "(repaired/refused/failed)",
+    ("outcome",),
+)
+ec_repair_journal_total = REGISTRY.counter(
+    "sw_ec_repair_journal_total",
+    "repair-journal recovery actions (replayed/rolled_back/kept/swept)",
+    ("action",),
+)

@@ -507,6 +507,7 @@ class Worker:
             entry = {
                 "grpc": dest, "checked": 0, "bad": [], "missing": [],
                 "legacy_missing": 0, "quarantined": [], "rebuilt": [],
+                "repaired": [], "journal_recovered": 0,
                 "unrebuildable": False, "error": "",
             }
             holders[url] = entry
@@ -530,6 +531,9 @@ class Worker:
                     r, holder_sids.get(url, set()), data_shards
                 )
                 entry["checked"] = facts["checked"]
+                # crash-recovery evidence: pending repair journals the
+                # holder replayed/rolled back before this verify pass
+                entry["journal_recovered"] = int(r.repair_journal_recovered)
                 entry["bad"] = facts["bad"]
                 entry["quarantined"] = facts["quarantined"]
                 entry["missing"] = facts["missing"]
@@ -564,6 +568,9 @@ class Worker:
                     )
                     entry["rebuilt"] = sorted(
                         int(x) for x in rr.rebuilt_shard_ids
+                    )
+                    entry["repaired"] = sorted(
+                        int(x) for x in rr.repaired_shard_ids
                     )
                 except grpc.RpcError as e:
                     entry["error"] = f"rebuild: {e.details()}"
@@ -635,6 +642,11 @@ class Worker:
                     "fetched": sorted(int(x) for x in r.fetched_shard_ids),
                     "distributed": sorted(
                         int(x) for x in r.distributed_shard_ids
+                    ),
+                    # leaf-granular in-place repairs: healed without a
+                    # whole-shard rebuild (~k·64 KiB wire per leaf)
+                    "repaired": sorted(
+                        int(x) for x in r.repaired_shard_ids
                     ),
                 }
             )

@@ -237,10 +237,18 @@ def test_chaos_lifecycle_recovers_bit_exact(tmp_path, seed):
 
     report = scrub_ec_volume(base, CTX, backend=CpuBackend(CTX), repair=True)
     assert report.complete and not report.refused, report
+    # every damaged shard is accounted for: leaf-localized bitrot is
+    # patched IN PLACE (leaf_repaired, no quarantine), anything else
+    # (deleted/truncated shards) goes corrupt/missing -> rebuild
     assert sorted(
-        set(report.corrupt_shards) | set(report.missing_shards)
+        set(report.corrupt_shards)
+        | set(report.missing_shards)
+        | set(report.leaf_repaired)
     ) == damaged
-    assert sorted(report.rebuilt) == damaged
+    assert sorted(set(report.rebuilt) | set(report.leaf_repaired)) == damaged
+    for sid in report.leaf_repaired:
+        # in-place repair never quarantines
+        assert not os.path.exists(base + CTX.to_ext(sid) + QUARANTINE_SUFFIX)
     for dest in report.quarantined:
         assert dest.endswith(QUARANTINE_SUFFIX) and os.path.exists(dest)
 
@@ -397,13 +405,18 @@ def test_scrub_daemon_heals_store_volume(tmp_path):
     try:
         ev = store.find_ec_volume(1)
         assert ev is not None
+        original = open(base + CTX.to_ext(5), "rb").read()
         flip_byte(base + CTX.to_ext(5), 777)
         daemon = ScrubDaemon(store, interval=3600.0, repair=True)
         reports = daemon.scrub_once()
-        assert reports[1].rebuilt == [5], reports[1]
-        assert os.path.exists(base + CTX.to_ext(5) + QUARANTINE_SUFFIX)
-        # the live EcVolume serves the regenerated shard (fresh fd), and
-        # every payload is bit-exact
+        # leaf-localized bitrot is patched IN PLACE under the repair
+        # journal: no quarantine, no whole-shard rebuild, no unmount
+        assert 5 in reports[1].leaf_repaired, reports[1]
+        assert not reports[1].rebuilt and not reports[1].quarantined
+        assert not os.path.exists(base + CTX.to_ext(5) + QUARANTINE_SUFFIX)
+        assert open(base + CTX.to_ext(5), "rb").read() == original
+        # the live EcVolume keeps serving (same inode — the fd never
+        # went stale), and every payload is bit-exact
         assert 5 in ev.shard_ids
         for i, want in payloads.items():
             assert ev.read_needle(i).data == want
@@ -460,7 +473,11 @@ def test_scrub_daemon_remembers_quarantined_shard_after_failed_rebuild(tmp_path)
     store = Store([str(d)], ec_backend="cpu")
     try:
         ev = store.find_ec_volume(1)
-        flip_byte(base + CTX.to_ext(6), 123)
+        # SIZE rot (truncation), not a bit flip: leaf repair cannot
+        # patch a resized file in place, so this still exercises the
+        # quarantine + rebuild path
+        path6 = base + CTX.to_ext(6)
+        os.truncate(path6, os.path.getsize(path6) - 100)
         daemon = ScrubDaemon(store, interval=3600.0, repair=True)
         # wedge vol 1's breaker: pass 1 quarantines but cannot rebuild
         b = daemon.breaker_for(1)

@@ -41,7 +41,7 @@ MOVED = list(range(4, TOTAL))
 
 
 class Cluster:
-    def __init__(self, tmp_path):
+    def __init__(self, tmp_path, n=2):
         self.mport = free_port()
         self.master = MasterServer(ip="localhost", port=self.mport)
         self.master.start()
@@ -53,12 +53,12 @@ class Cluster:
                 port=free_port(),
                 ec_backend="cpu",
             )
-            for i in range(2)
+            for i in range(n)
         ]
         for vs in self.vols:
             vs.start()
         wait_for(
-            lambda: len(self.master.topo.nodes) >= 2,
+            lambda: len(self.master.topo.nodes) >= n,
             msg="volume servers did not register",
         )
         self._channels = []
@@ -85,6 +85,13 @@ class Cluster:
 @pytest.fixture
 def cluster(tmp_path):
     c = Cluster(tmp_path)
+    yield c
+    c.stop()
+
+
+@pytest.fixture
+def cluster3(tmp_path):
+    c = Cluster(tmp_path, n=3)
     yield c
     c.stop()
 
@@ -608,6 +615,150 @@ def test_failed_distribute_leftover_not_mounted_by_task_driver(cluster):
     finally:
         other._peer_stub = real_stub
         w.stop()
+
+
+def test_distribute_replans_to_surviving_holder_in_pass(cluster3):
+    """ISSUE-8 satellite: the FIRST planned destination dies mid-copy
+    and the distribute step re-plans IN THE SAME RUN — the regenerated
+    cluster-lost shard lands on exactly one SURVIVING alternate holder,
+    no deferred handoff, and a re-run is an idempotent no-op."""
+    c = cluster3
+    vid, fid, payload, holder, other, ground = split_ec_volume(c)
+    third = next(v for v in c.vols if v is not holder and v is not other)
+    # lose shard 13 cluster-wide (it lived on `other`, the big holder)
+    st_o = c.stub(other)
+    st_o.VolumeEcShardsUnmount(
+        pb.EcShardsUnmountRequest(volume_id=vid, shard_ids=[13]), timeout=30
+    )
+    st_o.VolumeEcShardsDelete(
+        pb.EcShardsDeleteRequest(volume_id=vid, shard_ids=[13]), timeout=30
+    )
+    wait_for(lambda: not cluster3.locs(vid).get(13), msg="shard13 not lost")
+
+    # `third` holds ZERO shards of this volume, so the planner picks it
+    # first; every copy to it fails as if it died mid-distribute
+    third_grpc = f"localhost:{third.grpc_port}"
+    failed = {"n": 0}
+
+    class _CopyDown(grpc.RpcError):
+        def code(self):
+            return grpc.StatusCode.UNAVAILABLE
+
+        def details(self):
+            return "injected: destination died mid-copy"
+
+    real_stub = other._peer_stub
+
+    def picky_stub(dest):
+        stub = real_stub(dest)
+        if dest != third_grpc:
+            return stub
+
+        class _Proxy:
+            def __getattr__(self, name):
+                if name == "VolumeEcShardsCopy":
+                    def _boom(*a, **k):
+                        failed["n"] += 1
+                        raise _CopyDown()
+                    return _boom
+                return getattr(stub, name)
+
+        return _Proxy()
+
+    other._peer_stub = picky_stub
+    try:
+        out = other.peer_fetch_rebuild(vid)
+    finally:
+        other._peer_stub = real_stub
+    assert failed["n"] == 1, "first destination never tried"
+    # the SAME run re-planned and finished the handoff elsewhere
+    assert out["distributed"] == [13], out
+    wait_for(
+        lambda: len(c.locs(vid).get(13, [])) == 1,
+        msg="shard 13 not at exactly one holder after in-pass re-plan",
+    )
+    assert c.locs(vid)[13] == [f"localhost:{holder.port}"], (
+        "re-plan must land on the surviving subset holder"
+    )
+    copies = 0
+    for vs in c.vols:
+        b = vs.service._ec_base(vid, "")
+        if b and os.path.exists(b + ".ec13"):
+            assert open(b + ".ec13", "rb").read() == ground[13]
+            copies += 1
+    assert copies == 1, f"{copies} on-disk copies of shard 13 (want 1)"
+    # idempotent re-run: nothing left to regenerate or distribute
+    out2 = other.peer_fetch_rebuild(vid)
+    assert 13 not in out2["rebuilt"] and not out2["distributed"]
+
+
+def test_distribute_mount_failure_cleans_dest_copy(cluster3):
+    """Copy SUCCEEDS but the mount fails: the re-plan must not leave a
+    latent duplicate on the failed destination — the distribute step
+    issues a best-effort delete before excluding it, so the shard ends
+    at exactly one holder with exactly one on-disk copy cluster-wide."""
+    c = cluster3
+    vid, fid, payload, holder, other, ground = split_ec_volume(c)
+    third = next(v for v in c.vols if v is not holder and v is not other)
+    st_o = c.stub(other)
+    st_o.VolumeEcShardsUnmount(
+        pb.EcShardsUnmountRequest(volume_id=vid, shard_ids=[13]), timeout=30
+    )
+    st_o.VolumeEcShardsDelete(
+        pb.EcShardsDeleteRequest(volume_id=vid, shard_ids=[13]), timeout=30
+    )
+    wait_for(lambda: not cluster3.locs(vid).get(13), msg="shard13 not lost")
+
+    third_grpc = f"localhost:{third.grpc_port}"
+
+    class _MountDown(grpc.RpcError):
+        def code(self):
+            return grpc.StatusCode.DEADLINE_EXCEEDED
+
+        def details(self):
+            return "injected: mount timed out"
+
+    real_stub = other._peer_stub
+
+    def picky_stub(dest):
+        stub = real_stub(dest)
+        if dest != third_grpc:
+            return stub
+
+        class _Proxy:
+            def __getattr__(self, name):
+                if name == "VolumeEcShardsMount":
+                    def _boom(*a, **k):
+                        raise _MountDown()
+                    return _boom
+                return getattr(stub, name)
+
+        return _Proxy()
+
+    other._peer_stub = picky_stub
+    try:
+        out = other.peer_fetch_rebuild(vid)
+    finally:
+        other._peer_stub = real_stub
+    assert out["distributed"] == [13], out
+    wait_for(
+        lambda: len(c.locs(vid).get(13, [])) == 1,
+        msg="shard 13 not at exactly one holder",
+    )
+    # the failed destination's copied files were cleaned: exactly one
+    # on-disk copy anywhere (a later mount on `third` can no longer
+    # resurrect a duplicate holder)
+    tbase = third.service._ec_base(vid, "")
+    assert tbase is None or not os.path.exists(tbase + ".ec13"), (
+        "copy left on the mount-failed destination"
+    )
+    copies = 0
+    for vs in c.vols:
+        b = vs.service._ec_base(vid, "")
+        if b and os.path.exists(b + ".ec13"):
+            assert open(b + ".ec13", "rb").read() == ground[13]
+            copies += 1
+    assert copies == 1, f"{copies} on-disk copies of shard 13 (want 1)"
 
 
 def test_concurrent_peer_rebuild_refuses_cleanly(cluster):

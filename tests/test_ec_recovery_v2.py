@@ -349,7 +349,10 @@ def _corrupt_shard(base, sid, at=2048):
 def test_scrub_ages_out_bad_after_verified_replacement(tmp_path):
     base, _ = make_volume(tmp_path, needles=15)
     ec_encode_volume(base, CTX, backend=CpuBackend(CTX))
-    _corrupt_shard(base, 4)
+    # SIZE rot: a truncated shard cannot be leaf-repaired in place, so
+    # this still mints the .bad quarantine whose aging is under test
+    path4 = base + CTX.to_ext(4)
+    os.truncate(path4, os.path.getsize(path4) - 64)
     r = scrub_ec_volume(base, CTX, backend=CpuBackend(CTX), repair=True)
     bad_path = base + CTX.to_ext(4) + ".bad"
     assert r.rebuilt == [4] and os.path.exists(bad_path)

@@ -10,7 +10,6 @@ every backend, for every batch shape — including ragged tails — and
 through a mid-stream device failure.
 """
 
-import json
 import os
 
 import numpy as np
@@ -453,15 +452,13 @@ def test_scrub_walks_leaves_and_pins_corrupt_leaf(tmp_path):
     flip_byte(base + CTX.to_ext(2), 5 * 4096 + 17)
     r = scrub_ec_volume(base, CTX, backend=CpuBackend(CTX), repair=True)
     assert r.complete and not r.refused
-    assert r.corrupt_shards == [2] or r.rebuilt == [2]
-    assert r.corrupt_leaves.get(2) == [5]
+    # the walk pinned the rot to its leaf; with k verified sources the
+    # shard is LEAF-REPAIRED in place (PR 8) — no quarantine, no
+    # forensic copy, no whole-shard rebuild
+    assert r.leaf_repaired == {2: [5]}, r
     assert r.checked_leaves > 0
-    # leaf forensic marker sits next to the quarantine
-    bad = base + CTX.to_ext(2) + ".bad"
-    assert os.path.exists(bad) and os.path.exists(bad + ".leaves")
-    with open(bad + ".leaves") as f:
-        doc = json.load(f)
-    assert doc == {"leaf_size": 4096, "leaves": [5]}
+    assert not r.quarantined and not r.rebuilt
+    assert not os.path.exists(base + CTX.to_ext(2) + ".bad")
     # repair landed bit-exact
     with open(base + CTX.to_ext(2), "rb") as f:
         assert f.read() == shards[2].tobytes()
@@ -486,7 +483,11 @@ def test_scrub_budget_resumes_mid_block(tmp_path):
         if r.complete:
             break
     assert r.complete and not r.refused
-    assert r.corrupt_leaves.get(3) == [6] or r.rebuilt == [3]
+    assert (
+        r.corrupt_leaves.get(3) == [6]
+        or r.rebuilt == [3]
+        or r.leaf_repaired.get(3) == [6]
+    )
     with open(base + CTX.to_ext(3), "rb") as f:
         assert f.read() == shards[3].tobytes()
     assert not os.path.exists(base + ".scrubpos")
@@ -515,7 +516,9 @@ def test_scrub_reverify_catches_new_rot_after_repair(tmp_path):
     # so the completion re-verify must full-scan and find leaf 7's rot
     r = scrub_ec_volume(base, CTX, backend=CpuBackend(CTX), repair=True)
     assert r.complete and not r.refused
-    assert 1 in set(r.corrupt_shards) | set(r.rebuilt)
+    assert 1 in (
+        set(r.corrupt_shards) | set(r.rebuilt) | set(r.leaf_repaired)
+    )
     with open(base + CTX.to_ext(1), "rb") as f:
         assert f.read() == shards[1].tobytes()
 
