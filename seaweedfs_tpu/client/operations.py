@@ -23,15 +23,17 @@ class _PermanentUploadError(Exception):
 
 
 class TracingSession(requests.Session):
-    """requests.Session that stamps the active X-Request-ID onto every
-    outgoing call, so one id follows client → filer → volume hops
-    (reference weed/util/request_id)."""
+    """requests.Session that stamps the active X-Request-ID AND (when
+    the flight recorder is armed) the ambient span's trace context onto
+    every outgoing call, so one id/trace follows
+    client → filer → volume hops (reference weed/util/request_id)."""
 
     def request(self, method, url, **kw):  # type: ignore[override]
-        from ..utils import request_id
+        from ..utils import request_id, trace
 
         headers = dict(kw.get("headers") or {})
         request_id.inject(headers)
+        trace.http_headers(headers=headers)
         kw["headers"] = headers
         return super().request(method, url, **kw)
 

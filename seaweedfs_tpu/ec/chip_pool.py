@@ -325,6 +325,20 @@ class Placement:
         self.close()
 
 
+def chip_load_hint(scope: QueueScope | None = None) -> dict[str, dict]:
+    """Read-only per-chip load/breaker hint for placement consumers and
+    the heartbeat telemetry plane: {chip_label: {"load": outstanding
+    cost units, "breaker": ""|"closed"|"open"|...}}.
+
+    OBSERVABILITY FIRST: today the hint is recorded as a span event at
+    placement decisions and shipped to the master via heartbeats
+    (/cluster/status, sw_ec_queue_load); feeding it back into live
+    routing is direction 3's work, not this function's. Reads only the
+    scope's existing DeviceQueues — no queue is created and no jax/
+    device state is touched (dead-relay safe)."""
+    return resolve_scope(scope).queue_loads()
+
+
 def place_stream(
     backend,
     priority: str,
@@ -380,6 +394,7 @@ def place_stream(
             span.event(
                 "placement", mode=mode, chip="mesh",
                 loads=pool.loads(), cost_hint=cost_hint, wide=wide,
+                queue_load_hint=chip_load_hint(scope),
             )
         _, _, release = pool.acquire(cost_hint, force_mesh=True)
         return Placement(backend, scope.for_backend(backend), None, release)
@@ -392,10 +407,14 @@ def place_stream(
         cost_hint, prefer_mesh=(wide and mode == "auto")
     )
     if span is not None:
+        # the heartbeat telemetry hint the decision COULD have read —
+        # recorded beside the pod ledger it DID read, the evidence for
+        # direction 3's live load routing
         span.event(
             "placement", mode=mode,
             chip=("mesh" if idx is None else pool.labels[idx]),
             loads=loads_seen, cost_hint=cost_hint, wide=wide,
+            queue_load_hint=chip_load_hint(scope),
         )
     if idx is None:
         # Lone wide stream on an idle pod: it keeps the whole mesh and

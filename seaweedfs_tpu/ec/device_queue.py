@@ -644,10 +644,28 @@ class QueueScope:
                 "chip": q.label,
                 "window": q.window,
                 "breaker": brk.state if brk is not None else "",
+                "load": q.load(),
                 "classes": q.stats(),
             }
             for name, brk, q in items
         ]
+
+    def queue_loads(self) -> dict[str, dict]:
+        """Read-only per-chip load view: {chip_label: {"load": cost
+        units queued+in-flight, "breaker": state}} — the cheap form of
+        stats_snapshot for routing hints and heartbeat telemetry."""
+        with self._lock:
+            items = [
+                (getattr(b, "breaker", None), q)
+                for b, q in self._queues.items()
+            ]
+        return {
+            q.label: {
+                "load": q.load(),
+                "breaker": brk.state if brk is not None else "",
+            }
+            for brk, q in items
+        }
 
 
 _DEFAULT_SCOPE = QueueScope()

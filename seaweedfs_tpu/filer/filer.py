@@ -17,6 +17,7 @@ from typing import Iterator, Optional
 from ..client.operations import Operations
 from ..filer.chunks import read_chunk_views, total_size
 from ..pb import filer_pb2 as fpb
+from ..utils import trace
 from .entry import Entry, new_entry, normalize_path, split_path
 from .filer_store import FilerStore, NotFound
 
@@ -289,7 +290,10 @@ class Filer:
             root = Entry(directory="/", name="", is_directory=True)
             root.attr.file_mode = 0o755
             return root
-        entry = self._hl_overlay(self.store.find(directory, name))
+        # gateway read-path stage: where a slow GET's metadata-lookup
+        # time shows up (ambient span = the server's HTTP root span)
+        with trace.stage(trace.current(), "filer.lookup"):
+            entry = self._hl_overlay(self.store.find(directory, name))
         if self._is_expired(entry):
             # read-triggered expiry (reference filer TTL): the name
             # vanishes and its chunks are reclaimed asynchronously
@@ -645,6 +649,28 @@ class Filer:
         return self.read_entry(entry, offset, size)
 
     def read_entry(self, entry: Entry, offset: int = 0, size: int = -1) -> bytes:
+        # Flight-recorder child span for the filer data-plane layer:
+        # inside an S3/filer HTTP root span this is where lookup-vs-
+        # chunk-fetch time splits; the chunk fetches propagate the trace
+        # over HTTP to the volume servers (TracingSession).
+        sp = trace.start(
+            "filer.read", name=entry.full_path,
+            offset=offset, size=size,
+        )
+        if sp is not None:
+            # the filer data-plane layer is its own logical server even
+            # when embedded (S3/WebDAV gateways construct a Filer
+            # in-process): label it so a trace shows the layer hop
+            sp.server = "filer"
+        try:
+            with trace.activate(sp):
+                return self._read_entry_traced(entry, offset, size, sp)
+        finally:
+            trace.finish(sp)
+
+    def _read_entry_traced(
+        self, entry: Entry, offset: int, size: int, sp
+    ) -> bytes:
         if entry.is_directory:
             raise FilerError(f"{entry.full_path} is a directory")
         if entry.content:
@@ -671,11 +697,14 @@ class Filer:
         for view in read_chunk_views(chunks, offset, size):
             chunk_data = self.chunk_cache.get(view.fid)
             if chunk_data is None:
-                chunk_data = self.ops.read(view.fid)
+                with trace.stage(sp, "chunk.fetch"):
+                    chunk_data = self.ops.read(view.fid)
                 # admit only modest chunks: one large streaming read must
                 # not flush the whole hot set out of the LRU
                 if len(chunk_data) <= self.chunk_cache.capacity // 8:
                     self.chunk_cache.put(view.fid, chunk_data)
+            elif sp is not None:
+                sp.event("chunk_cache_hit", fid=view.fid)
             piece = chunk_data[view.offset_in_chunk : view.offset_in_chunk + view.size]
             lo = view.logical_offset - offset
             buf[lo : lo + len(piece)] = piece
@@ -684,7 +713,8 @@ class Filer:
     def _read_chunk_cached(self, fid: str) -> bytes:
         data = self.chunk_cache.get(fid)
         if data is None:
-            data = self.ops.read(fid)
+            with trace.stage(trace.current(), "chunk.fetch"):
+                data = self.ops.read(fid)
             if len(data) <= self.chunk_cache.capacity // 8:
                 self.chunk_cache.put(fid, data)
         return data

@@ -172,6 +172,7 @@ class S3Server:
 
         class Handler(RequestTracingMixin, BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            trace_server_kind = "s3"
 
             def log_message(self, *a):
                 pass
@@ -361,6 +362,17 @@ class S3Server:
                 try:
                     bucket, key, q = self._bucket_key()
                     m = self.command
+                    # SLO op class (sw_request_seconds{server="s3",op})
+                    if key:
+                        self._sw_op = {
+                            "GET": "get_object",
+                            "HEAD": "head_object",
+                            "PUT": "put_object",
+                            "POST": "post_object",
+                            "DELETE": "delete_object",
+                        }.get(m, m.lower())
+                    elif bucket:
+                        self._sw_op = f"bucket_{m.lower()}"
                     if m == "OPTIONS":
                         # browser preflights carry no Authorization by
                         # spec: they must be evaluated BEFORE auth
@@ -383,7 +395,13 @@ class S3Server:
                         # the form itself, not the Authorization header
                         return self._post_policy_upload(bucket)
                     try:
-                        ident = self._auth()
+                        # gateway stage: SigV4/OIDC verification cost of
+                        # this request (trace.current() = the HTTP root
+                        # span the mixin opened; no-op disarmed)
+                        from ..utils import trace as _trace
+
+                        with _trace.stage(_trace.current(), "s3.auth"):
+                            ident = self._auth()
                     except S3AuthError as e:
                         return self._error(403, e.code, str(e))
                     u = urllib.parse.urlparse(self.path)

@@ -22,8 +22,19 @@ import base64
 import hashlib
 import os
 
-from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+# `cryptography` is an optional dependency: the S3 gateway itself (and
+# the read-path bench/tests) must import without it — only the SSE
+# features need the cipher, and they raise NotImplemented when it is
+# absent instead of poisoning the whole gateway import.
+try:
+    from cryptography.hazmat.primitives.ciphers import (
+        Cipher,
+        algorithms,
+        modes,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:  # pragma: no cover - exercised in slim containers
+    Cipher = algorithms = modes = AESGCM = None
 
 # entry.extended attribute keys
 SSE_ALGO_KEY = "s3-sse"  # b"SSE-C" | b"AES256"
@@ -49,9 +60,18 @@ class SseError(Exception):
         self.code = code
 
 
+def _require_crypto() -> None:
+    if Cipher is None:
+        raise SseError(
+            "NotImplemented",
+            "SSE requires the 'cryptography' package (not installed)",
+        )
+
+
 def _ctr_apply(key: bytes, iv: bytes, data: bytes, block_offset: int = 0) -> bytes:
     """AES-256-CTR transform (encrypt == decrypt). block_offset seeks
     the counter forward for range reads (units of 16-byte blocks)."""
+    _require_crypto()
     if block_offset:
         ctr = (int.from_bytes(iv, "big") + block_offset) % (1 << 128)
         iv = ctr.to_bytes(16, "big")
@@ -135,16 +155,22 @@ class LocalKeyring(KmsProvider):
     def __init__(self, master_key: bytes, key_id: str = "local-0"):
         if len(master_key) != 32:
             raise ValueError("master key must be 256 bits")
-        self._master = AESGCM(master_key)
+        # without `cryptography` the keyring still constructs (the
+        # gateway boots); only actually wrapping/unwrapping keys raises
+        self._master = AESGCM(master_key) if AESGCM is not None else None
         self.key_id = key_id
 
     def generate_data_key(self) -> tuple[str, bytes, bytes]:
+        if self._master is None:
+            _require_crypto()
         dk = os.urandom(32)
         nonce = os.urandom(12)
         wrapped = nonce + self._master.encrypt(nonce, dk, self.key_id.encode())
         return self.key_id, dk, wrapped
 
     def decrypt_data_key(self, key_id: str, wrapped: bytes) -> bytes:
+        if self._master is None:
+            _require_crypto()
         if key_id != self.key_id:
             raise SseError("InvalidArgument", f"unknown SSE-S3 key id {key_id!r}")
         try:

@@ -87,6 +87,7 @@ class FilerServer:
 
         class Handler(RequestTracingMixin, BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            trace_server_kind = "filer"
 
             def log_message(self, *a):
                 pass
@@ -110,8 +111,11 @@ class FilerServer:
 
             def do_GET(self):
                 q = parse_qs(urlparse(self.path).query)
+                if self.serve_slo_endpoint(urlparse(self.path).path):
+                    return
                 if urlparse(self.path).path == "/~meta/tail":
                     return self._meta_tail(q)
+                self._sw_op = "read"
                 path = self._path()
                 try:
                     entry = filer.find_entry(path)

@@ -616,6 +616,8 @@ class MasterServer:
         from ..utils.request_id import RequestTracingMixin
 
         class Handler(RequestTracingMixin, BaseHTTPRequestHandler):
+            trace_server_kind = "master"
+
             def log_message(self, *a):
                 pass
 
@@ -633,6 +635,8 @@ class MasterServer:
                 from ..utils.pprof import handle_debug_endpoint
 
                 if handle_debug_endpoint(self, u):
+                    return
+                if self.serve_slo_endpoint(u.path):
                     return
                 if u.path == "/dir/assign":
                     resp = master.service.Assign(
@@ -689,6 +693,15 @@ class MasterServer:
                     self._ui()
                 elif u.path in ("/cluster/status", "/dir/status"):
                     topo = master.topo.to_proto()
+                    # heartbeat-learned device telemetry per host: the
+                    # master never probes volume servers for this —
+                    # chips/breakers/stage-EWMAs arrive ONLY on the
+                    # heartbeat stream (Heartbeat.ec_telemetry_json)
+                    tele = {
+                        node.node_id: node.ec_telemetry
+                        for node in list(master.topo.nodes.values())
+                        if node.ec_telemetry
+                    }
                     self._json(
                         200,
                         {
@@ -702,6 +715,7 @@ class MasterServer:
                                 }
                                 for n in topo.nodes
                             ],
+                            "EcTelemetry": tele,
                             # fleet scrub health: per-holder bitrot /
                             # quarantine aggregated from ec_scrub task
                             # reports (worker/control.py)

@@ -11,14 +11,17 @@ ec/placement.py.
 
 from __future__ import annotations
 
+import json
 import queue
 import random
 import threading
 import time
+import weakref
 from dataclasses import dataclass, field
 from typing import Optional
 
 from ..pb import cluster_pb2 as pb
+from ..utils import metrics as _M
 
 
 @dataclass
@@ -37,6 +40,11 @@ class DataNode:
     # identity of the heartbeat stream currently feeding this node; a
     # stale stream's cleanup must not unregister a node a newer stream owns
     owner_token: object = None
+    # device-telemetry blob learned ONLY from heartbeats
+    # (Heartbeat.ec_telemetry_json): per-chip queue load + breaker
+    # state + stage EWMAs. Surfaced in /cluster/status and the
+    # sw_ec_queue_load fleet gauges; {} until the node reports.
+    ec_telemetry: dict = field(default_factory=dict)
 
     def location(self) -> pb.Location:
         return pb.Location(
@@ -103,6 +111,9 @@ class Topology:
         # KeepConnected subscribers: queues fed a VolumeLocationUpdate
         # per topology change (reference master KeepConnected streaming)
         self._subscribers: list[queue.Queue] = []
+        # fleet telemetry gauges sample every live topology at scrape
+        # time (weak: a test's dead master must not pin stale series)
+        _topologies.add(self)
 
     # ----------------------------------------------------- keepconnected
 
@@ -174,6 +185,21 @@ class Topology:
 
     # -------------------------------------------------------- heartbeats
 
+    @staticmethod
+    def _absorb_telemetry(node: DataNode, hb: pb.Heartbeat) -> None:
+        """Adopt the heartbeat's device-telemetry blob (best-effort: a
+        malformed blob from a skewed-version server must never poison
+        registration)."""
+        if not hb.ec_telemetry_json:
+            return
+        try:
+            tele = json.loads(hb.ec_telemetry_json)
+        except ValueError:
+            return
+        if isinstance(tele, dict):
+            tele["received_at"] = time.time()
+            node.ec_telemetry = tele
+
     def sync_registration(self, node: DataNode, hb: pb.Heartbeat) -> None:
         """Full-list registration (first heartbeat / periodic refresh)."""
         with self._lock:
@@ -188,6 +214,7 @@ class Topology:
             for v in node.volumes.values():
                 self.max_volume_id = max(self.max_volume_id, v.id)
             node.last_seen = time.time()
+            self._absorb_telemetry(node, hb)
             self._node_delta_locked(
                 node,
                 new_vids=set(node.volumes) - old_vids,
@@ -227,6 +254,7 @@ class Topology:
                     node.ec_shards.pop(e.id, None)
                     removed_ec.add(e.id)
             node.last_seen = time.time()
+            self._absorb_telemetry(node, hb)
             self._node_delta_locked(
                 node,
                 new_vids=added_vids,
@@ -592,6 +620,67 @@ class Topology:
                     for n in sorted(self.nodes.values(), key=lambda n: n.node_id)
                 ],
             )
+
+
+# --------------------------------------------------------------------------
+# Fleet telemetry gauges: heartbeat-learned per-chip queue load and pod
+# breaker health across every live Topology (scrape-time callbacks over
+# a weak registry — the PR 6 sw_ec_chip_breaker_open pattern). These are
+# the MASTER-side series; the per-server sw_ec_queue_* counters come
+# from each volume server's own scheduler.
+# --------------------------------------------------------------------------
+
+_topologies: "weakref.WeakSet[Topology]" = weakref.WeakSet()
+
+
+def _iter_chip_loads():
+    seen = set()
+    for topo in list(_topologies):
+        for node in list(topo.nodes.values()):
+            chips = node.ec_telemetry.get("chips")
+            if not isinstance(chips, dict):
+                continue
+            for chip, c in chips.items():
+                key = (node.node_id, chip)
+                if key in seen:  # two topologies tracking one node
+                    continue
+                seen.add(key)
+                try:
+                    load = float(c.get("load", 0))
+                except (TypeError, AttributeError, ValueError):
+                    continue
+                yield {"node": node.node_id, "chip": chip}, load
+
+
+def _iter_breakers_open():
+    seen = set()
+    for topo in list(_topologies):
+        for node in list(topo.nodes.values()):
+            tele = node.ec_telemetry
+            if not tele or node.node_id in seen:
+                continue
+            seen.add(node.node_id)
+            try:
+                n_open = float(tele.get("breakers_open", 0))
+            except (TypeError, ValueError):
+                continue
+            yield {"node": node.node_id}, n_open
+
+
+_M.REGISTRY.gauge(
+    "sw_ec_queue_load",
+    "per-chip device-queue load (cost units queued + in flight), "
+    "heartbeat-learned per node",
+    ("node", "chip"),
+    fn=_iter_chip_loads,
+)
+_M.REGISTRY.gauge(
+    "sw_ec_fleet_breakers_open",
+    "open per-chip fallback breakers per node (heartbeat-learned): "
+    ">0 = that host's chips are failing over to CPU",
+    ("node",),
+    fn=_iter_breakers_open,
+)
 
 
 def _replica_copies(replication: str) -> int:

@@ -1680,6 +1680,34 @@ class VolumeServer:
 
     # -------------------------------------------------------- heartbeats
 
+    def _ec_telemetry_json(self) -> str:
+        """Device-telemetry blob riding every full heartbeat: per-chip
+        queue load + breaker state (ec/chip_pool.chip_load_hint over
+        this server's OWN scheduler scope) and the flight recorder's
+        per-op/stage EWMAs. The master is the only consumer — it
+        aggregates into /cluster/status and the sw_ec_queue_load fleet
+        gauges; nothing here feeds live routing (direction 3)."""
+        from ..ec.chip_pool import chip_load_hint
+
+        try:
+            chips = chip_load_hint(self.store.ec_scheduler)
+        except Exception:  # telemetry must never break the heartbeat
+            chips = {}
+        breakers_open = sum(
+            1 for c in chips.values() if c.get("breaker") == "open"
+        )
+        return json.dumps(
+            {
+                "chips": chips,
+                "breakers_open": breakers_open,
+                "degraded": breakers_open > 0,
+                "stage_ewma_s": {
+                    k: round(v, 6) for k, v in trace.stage_ewmas().items()
+                },
+                "ts": time.time(),
+            }
+        )
+
     def _full_heartbeat(self) -> pb.Heartbeat:
         st = self.store.status()
         # addr label keeps multi-server processes from clobbering each
@@ -1733,6 +1761,7 @@ class VolumeServer:
             ],
             has_no_volumes=not st["volumes"],
             has_no_ec_shards=not st["ec_volumes"],
+            ec_telemetry_json=self._ec_telemetry_json(),
         )
 
     def notify_new_volume(self, vid: int) -> None:
@@ -1805,6 +1834,7 @@ class VolumeServer:
 
         class Handler(RequestTracingMixin, BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            trace_server_kind = "volume"
 
             def log_message(self, *a):
                 pass
@@ -1845,22 +1875,33 @@ class VolumeServer:
 
                 if handle_debug_endpoint(self, u):
                     return
+                if self.serve_slo_endpoint(u.path):
+                    return
                 if u.path == "/debug/traces":
                     # Flight-recorder ring as Chrome trace_event JSON
                     # (load in Perfetto / chrome://tracing); ?trace_id=
-                    # narrows to one cross-server trace, ?format=spans
-                    # returns the raw span-tree docs instead. Loopback-
-                    # only, same operator gate as /debug/pprof.
+                    # narrows to one cross-server trace, ?op= to one
+                    # root op class, ?min_ms= to slow ops only;
+                    # ?format=spans returns the raw span-tree docs
+                    # instead. Loopback-only, same operator gate as
+                    # /debug/pprof.
                     from ..utils.pprof import require_loopback
 
                     if not require_loopback(self, "trace"):
                         return
                     q = parse_qs(u.query)
                     tid = q.get("trace_id", [""])[0]
+                    try:
+                        min_ms = float(q.get("min_ms", ["0"])[0] or 0.0)
+                    except ValueError:
+                        min_ms = 0.0
+                    docs = trace.traces(
+                        tid, op=q.get("op", [""])[0], min_ms=min_ms
+                    )
                     if q.get("format", [""])[0] == "spans":
-                        payload = trace.traces(tid)
+                        payload = docs
                     else:
-                        payload = trace.chrome_trace(tid)
+                        payload = trace.chrome_trace(docs=docs)
                     body = json.dumps(payload).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
@@ -1946,10 +1987,16 @@ class VolumeServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
+                self._sw_op = "read"
                 try:
-                    n = server.store.read_needle(
-                        fid.volume_id, fid.needle_id, fid.cookie
-                    )
+                    # gateway stage: needle read (an EC degraded read
+                    # below this opens its own ec.degraded_read child
+                    # span under the same HTTP root via the ambient
+                    # span, down to the chip)
+                    with trace.stage(trace.current(), "volume.read"):
+                        n = server.store.read_needle(
+                            fid.volume_id, fid.needle_id, fid.cookie
+                        )
                 except (NotFoundError, ECError) as e:
                     return self._error(404, str(e))
                 except (CookieMismatch, CrcError) as e:

@@ -158,6 +158,7 @@ class WebDavServer:
 
         class Handler(RequestTracingMixin, BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            trace_server_kind = "webdav"
 
             def log_message(self, *a):
                 pass

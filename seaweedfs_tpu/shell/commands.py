@@ -205,7 +205,7 @@ def _volume_holders(topo):
 # ----------------------------------------------------------------- cluster
 
 
-@command("cluster.status", "show nodes and volume/EC counts")
+@command("cluster.status", "show nodes, volume/EC counts, chip telemetry, SLOs")
 def cluster_status(env: ShellEnv, args) -> str:
     topo = env.master.topology()
     lines = [f"max volume id: {topo.max_volume_id}"]
@@ -214,6 +214,40 @@ def cluster_status(env: ShellEnv, args) -> str:
             f"  node {n.id} rack={n.rack or '-'} "
             f"volumes={len(n.volumes)} ec={len(n.ec_shards)}"
         )
+    # heartbeat-learned chip telemetry + master-side SLO surface ride
+    # the master's HTTP status endpoints (best-effort: a master built
+    # before PR 9, or an unreachable HTTP port, degrades to the
+    # gRPC-only listing above)
+    try:
+        import requests as _rq
+
+        st = _rq.get(
+            f"http://{env.master_addr}/cluster/status", timeout=5
+        ).json()
+        for node_id, tele in sorted(st.get("EcTelemetry", {}).items()):
+            chips = tele.get("chips", {}) or {}
+            flag = " DEGRADED" if tele.get("degraded") else ""
+            lines.append(
+                f"  chips {node_id}: {len(chips)} chip(s), "
+                f"breakers_open={tele.get('breakers_open', 0)}{flag}"
+            )
+            for chip, c in sorted(chips.items()):
+                lines.append(
+                    f"    {chip} load={c.get('load', 0)} "
+                    f"breaker={c.get('breaker') or '-'}"
+                )
+        slo = _rq.get(
+            f"http://{env.master_addr}/debug/slo", timeout=5
+        ).json()
+        if slo:
+            lines.append("  slo (master, ms):")
+            for op, s in sorted(slo.items()):
+                lines.append(
+                    f"    {op}: n={s['count']} p50={s['p50_ms']} "
+                    f"p99={s['p99_ms']}"
+                )
+    except Exception as e:  # noqa: BLE001 — status must stay best-effort
+        lines.append(f"  (telemetry unavailable: {e})")
     return "\n".join(lines)
 
 

@@ -99,6 +99,28 @@ class Histogram(_Metric):
     def time(self, **labels):
         return _Timer(self, labels)
 
+    def snapshot(self) -> dict:
+        """{label_values_tuple: (cumulative_bucket_counts, total, sum)}
+        — the quantile-derivation input (bucket counts are cumulative
+        by construction of observe())."""
+        with self._lock:
+            return {
+                key: (list(counts), self._totals[key], self._sums[key])
+                for key, counts in self._counts.items()
+            }
+
+    def quantile(self, q: float, key: tuple) -> float:
+        """Prometheus histogram_quantile-style estimate for one label
+        set: linear interpolation inside the first bucket whose
+        cumulative count covers rank q*total. Values beyond the last
+        finite bucket clamp to it (same caveat as PromQL's +Inf)."""
+        with self._lock:
+            counts = list(self._counts.get(key) or ())
+            total = self._totals.get(key, 0)
+        if not counts or total <= 0:
+            return 0.0
+        return bucket_quantile(self.buckets, counts, total, q)
+
     def collect(self):
         yield f"# HELP {self.name} {_escape_help(self.help)}"
         yield f"# TYPE {self.name} histogram"
@@ -188,6 +210,52 @@ def _escape_help(v: str) -> str:
     legal in help text; a raw newline would terminate the comment line
     and corrupt the exposition)."""
     return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def bucket_quantile(
+    buckets: tuple, counts: list, total: int, q: float
+) -> float:
+    """Quantile from cumulative bucket counts (see Histogram.quantile).
+    Pure function so the shell/SLO surfaces can derive p50/p99 from a
+    scraped snapshot without a live Histogram."""
+    q = min(max(q, 0.0), 1.0)
+    rank = q * total
+    prev_count = 0
+    prev_le = 0.0
+    for le, c in zip(buckets, counts):
+        if c >= rank and c > prev_count:
+            span = c - prev_count
+            frac = (rank - prev_count) / span if span else 1.0
+            return prev_le + (le - prev_le) * min(max(frac, 0.0), 1.0)
+        # the interpolation base is the PREVIOUS bucket's bound even
+        # when that bucket is empty (Prometheus histogram_quantile
+        # semantics) — advancing only on non-empty buckets would bias
+        # every quantile low when the low buckets are empty
+        prev_count = c
+        prev_le = le
+    return buckets[-1] if buckets else 0.0
+
+
+def slo_summary() -> dict:
+    """Per-``server.op`` request-latency SLO snapshot derived from
+    ``sw_request_seconds``: count, mean, p50/p90/p99 (ms). The payload
+    of ``/debug/slo`` and the shell ``cluster.status`` SLO block."""
+    out: dict[str, dict] = {}
+    for key, (counts, total, s) in request_seconds.snapshot().items():
+        labels = dict(zip(request_seconds.label_names, key))
+        name = f"{labels.get('server', '')}.{labels.get('op', '')}"
+        buckets = request_seconds.buckets
+        out[name] = {
+            "count": total,
+            "mean_ms": round(s / total * 1000.0, 3) if total else 0.0,
+            **{
+                f"p{int(q * 100)}_ms": round(
+                    bucket_quantile(buckets, counts, total, q) * 1000.0, 3
+                )
+                for q in (0.5, 0.9, 0.99)
+            },
+        }
+    return out
 
 
 def _num(v: float) -> str:

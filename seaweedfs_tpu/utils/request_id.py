@@ -1,14 +1,26 @@
-"""X-Request-ID propagation.
+"""X-Request-ID propagation + per-request HTTP tracing.
 
 Reference: weed/util/request_id — every HTTP hop carries the id; the
 first server in the chain mints one. Stored in a contextvar so log
 lines and downstream client calls inside one request see it without
 threading it through signatures.
+
+:class:`RequestTracingMixin` is also the HTTP end of the flight
+recorder (utils/trace.py): when the tracer is armed, every request gets
+a ROOT SPAN that adopts the trace id / parent span carried in the
+``X-Sw-Trace-Id`` / ``X-Sw-Parent-Span`` request headers (minting a
+fresh trace when absent), activates it as the ambient span for the
+handler thread (downstream client calls and EC spans nest under it),
+echoes the trace id on the response, and finishes it when the response
+completes. Armed or not, every request lands in the
+``sw_request_seconds{server,op}`` latency histogram — the per-op-class
+SLO surface served at ``/debug/slo``.
 """
 
 from __future__ import annotations
 
 import contextvars
+import time
 import uuid
 
 HEADER = "X-Request-ID"
@@ -46,12 +58,39 @@ class RequestTracingMixin:
     """Mix into a BaseHTTPRequestHandler (before it in the MRO): adopts
     or mints the request id when headers are parsed and echoes it on
     every response, so one id follows a request through
-    client → filer → volume hops and appears in each server's logs."""
+    client → filer → volume hops and appears in each server's logs.
+
+    Per-request tracing rides the same hooks: ``parse_request`` opens
+    (or adopts, via the ``X-Sw-*`` headers) a root span and installs it
+    as the thread's ambient span; ``handle_one_request`` finishes it
+    after the response and records the request into the
+    ``sw_request_seconds{server,op}`` SLO histogram. Subclasses set
+    ``trace_server_kind`` ("s3", "filer", "volume", "master",
+    "webdav") and may refine the op class per request by assigning
+    ``self._sw_op`` (defaults to the lowercased HTTP method)."""
+
+    trace_server_kind = "http"
 
     def parse_request(self):  # type: ignore[override]
         ok = super().parse_request()
         if ok:
             ensure(self.headers.get(HEADER))
+            self._sw_t0 = time.perf_counter()
+            self._sw_code = 0
+            self._sw_op = ""
+            self._sw_span = None
+            self._sw_token = None
+            from . import trace
+
+            if trace.armed:
+                sp = trace.start_from_headers(
+                    f"http.{self.trace_server_kind}",
+                    self.headers,
+                    name=f"{self.command} {self.path.split('?', 1)[0]}",
+                    server=self.trace_server_kind,
+                )
+                self._sw_span = sp
+                self._sw_token = trace.set_current(sp)
         return ok
 
     def send_response(self, code, message=None):  # type: ignore[override]
@@ -59,3 +98,66 @@ class RequestTracingMixin:
         rid = get()
         if rid:
             self.send_header(HEADER, rid)
+        if not getattr(self, "_sw_code", 0):
+            self._sw_code = code
+        sp = getattr(self, "_sw_span", None)
+        if sp is not None:
+            from . import trace
+
+            self.send_header(trace.TRACE_ID_HEADER, sp.trace_id)
+
+    def handle_one_request(self):  # type: ignore[override]
+        try:
+            super().handle_one_request()
+        finally:
+            self._sw_finish_request()
+
+    def _sw_finish_request(self) -> None:
+        t0 = self.__dict__.pop("_sw_t0", None)
+        if t0 is None:
+            return  # parse failed / idle keep-alive close: no request
+        from . import metrics
+        from . import trace
+
+        op = getattr(self, "_sw_op", "") or (self.command or "?").lower()
+        dur = time.perf_counter() - t0
+        metrics.request_seconds.observe(
+            dur, server=self.trace_server_kind, op=op
+        )
+        metrics.request_total.inc(
+            server=self.trace_server_kind,
+            op=op,
+            code=str(getattr(self, "_sw_code", 0) or 0),
+        )
+        sp = self.__dict__.pop("_sw_span", None)
+        token = self.__dict__.pop("_sw_token", None)
+        if sp is not None:
+            sp.attrs["http_code"] = getattr(self, "_sw_code", 0)
+            sp.attrs["op_class"] = op
+            trace.finish(sp)
+        trace.reset_current(token)
+
+    def serve_slo_endpoint(self, path: str) -> bool:
+        """Serve ``/debug/slo`` (this process's per-op-class p50/p99
+        from ``sw_request_seconds``); True when the request was
+        handled. Open like /metrics — it holds latency stats only.
+
+        Status/control-plane servers only (master, volume, filer): the
+        S3 and WebDAV DATA planes deliberately do not call this — a
+        bucket literally named ``debug`` must stay addressable, and an
+        unauthenticated status response would bypass SigV4. Their op
+        classes still appear in ``/metrics`` and in any co-resident
+        server's ``/debug/slo`` (the registry is process-wide)."""
+        if path != "/debug/slo":
+            return False
+        import json
+
+        from . import metrics
+
+        body = json.dumps(metrics.slo_summary(), sort_keys=True).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+        return True

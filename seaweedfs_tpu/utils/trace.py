@@ -98,7 +98,35 @@ TRACE_ID_KEY = "x-sw-trace-id"
 PARENT_SPAN_KEY = "x-sw-parent-span"
 REQUEST_ID_KEY = "x-request-id"
 
+# The SAME trace identity over HTTP: the gateway hops (client → S3 →
+# filer → volume) carry these beside X-Request-ID, so one S3 GET yields
+# ONE trace id across every server it crosses. Canonical casing for
+# send; HTTP header lookup is case-insensitive on receive.
+TRACE_ID_HEADER = "X-Sw-Trace-Id"
+PARENT_SPAN_HEADER = "X-Sw-Parent-Span"
+
 DEFAULT_RING = 256
+# Ring is additionally bounded by TOTAL SPAN COUNT across all retained
+# trace docs: one span-heavy op class (a wide gateway fan-out op can
+# carry hundreds of child spans) must not pin an unbounded share of
+# memory behind a trace-count-only bound.
+DEFAULT_RING_SPANS = 20_000
+
+# Canonical stage names — the ONLY values legal as the `stage` label of
+# ``sw_ec_stage_seconds``. tests/test_trace.py lints every stage literal
+# in the package against this registry, so a typo'd label fails tier-1
+# instead of silently forking a histogram series.
+STAGES = frozenset({
+    # device-queue / pipeline (PR 4-7)
+    "admission_wait", "queue_wait", "disk_read", "stage_batch",
+    "sibling_read", "h2d_dispatch", "device_drain", "write_sink",
+    "crc_verify", "verify", "reconstruct", "fsync_publish", "stream",
+    "index_sort", "peer_fetch",
+    # leaf repair (PR 8)
+    "repair_patch", "repair_fetch",
+    # gateway read path (PR 9): where a slow S3 GET burned its budget
+    "s3.auth", "filer.lookup", "chunk.fetch", "volume.read",
+})
 
 # Stages that count as device time for the overlap-efficiency gauge.
 DEVICE_STAGES = frozenset({"h2d_dispatch", "device_drain"})
@@ -130,7 +158,18 @@ armed = False
 
 _lock = threading.Lock()
 _ring: deque = deque(maxlen=DEFAULT_RING)
+_ring_spans = 0  # total span count across the retained docs
+_max_ring_spans = DEFAULT_RING_SPANS
 _slow_op_s = 0.0
+
+# Per-(op, stage) exponentially-weighted moving averages of stage
+# seconds (armed only — fed by Span.add_stage). These ride volume-server
+# heartbeats to the master as part of the telemetry plane, giving the
+# fleet a "where does this host's op time go" signal without shipping
+# whole traces.
+EWMA_ALPHA = 0.2
+_ewma_lock = threading.Lock()
+_stage_ewma: dict[tuple[str, str], float] = {}
 
 _current: ContextVar["Span | None"] = ContextVar("sw_trace_span", default=None)
 
@@ -261,6 +300,14 @@ class Span:
                 if chip:
                     acc[2] = chip
         _stage_seconds.observe(seconds, op=self.op, stage=stage, chip=chip)
+        with _ewma_lock:
+            key = (self.op, stage)
+            prev = _stage_ewma.get(key)
+            _stage_ewma[key] = (
+                seconds
+                if prev is None
+                else prev + EWMA_ALPHA * (seconds - prev)
+            )
 
     def stage(self, name: str, chip: str = "") -> _StageTimer:
         return _StageTimer(self, name, chip)
@@ -351,7 +398,18 @@ def overlap_efficiency(doc: dict) -> float | None:
     return (device - exposed) / device
 
 
+def _doc_span_count(doc: dict) -> int:
+    n = 0
+    stack = [doc]
+    while stack:
+        d = stack.pop()
+        n += 1
+        stack.extend(d["children"])
+    return n
+
+
 def _complete_root(span: Span) -> None:
+    global _ring_spans
     doc = span.to_dict()
     _traces_total.inc(op=span.op)
     eff = overlap_efficiency(doc)
@@ -369,8 +427,18 @@ def _complete_root(span: Span) -> None:
             if e is not None:
                 _overlap_eff.set(e, op=d["op"])
         stack.extend(d["children"])
+    doc["span_count"] = _doc_span_count(doc)
     with _lock:
+        # manual maxlen handling so the span-count budget stays exact:
+        # deque's own eviction on append would bypass the accounting
+        while len(_ring) >= (_ring.maxlen or DEFAULT_RING):
+            _ring_spans -= _ring.popleft().get("span_count", 1)
         _ring.append(doc)
+        _ring_spans += doc["span_count"]
+        # byte-bound analog: a span-heavy op class evicts oldest docs
+        # beyond the trace-count bound too (always keep the newest)
+        while _ring_spans > _max_ring_spans and len(_ring) > 1:
+            _ring_spans -= _ring.popleft().get("span_count", 1)
         slow = _slow_op_s
     if 0.0 < slow <= doc["duration_s"]:
         _slow_ops_total.inc(op=span.op)
@@ -383,7 +451,9 @@ def _complete_root(span: Span) -> None:
 
 def format_tree(doc: dict, indent: int = 0) -> str:
     """Human-readable span tree with per-stage durations (the slow-op
-    log body)."""
+    log body). The root line carries the request id and root op so a
+    logged tree can be joined against gateway access logs even when the
+    surrounding log prefix is stripped."""
     pad = "  " * indent
     stages = " ".join(
         f"{s}={a['seconds'] * 1000:.1f}ms/{a['count']}"
@@ -394,6 +464,12 @@ def format_tree(doc: dict, indent: int = 0) -> str:
         f"{' [' + doc['name'] + ']' if doc['name'] != doc['op'] else ''}"
         f" {doc['duration_s'] * 1000:.1f}ms"
     )
+    if indent == 0:
+        line += (
+            f" root={doc['op']}"
+            f" rid={doc.get('request_id') or '-'}"
+            f" trace={doc.get('trace_id', '')}"
+        )
     if doc.get("server"):
         line += f" @{doc['server']}"
     if stages:
@@ -415,15 +491,24 @@ def configure(
     enabled: bool | None = None,
     ring_size: int | None = None,
     slow_op_s: float | None = None,
+    ring_spans: int | None = None,
 ) -> dict:
     """Arm/disarm the tracer and tune the ring / slow-op threshold.
-    ``slow_op_s`` <= 0 disables the slow-op log. Returns the effective
-    config."""
-    global armed, _ring, _slow_op_s
+    ``slow_op_s`` <= 0 disables the slow-op log. ``ring_spans`` bounds
+    the TOTAL span count retained across the ring (memory bound for
+    span-heavy op classes). Returns the effective config."""
+    global armed, _ring, _ring_spans, _max_ring_spans, _slow_op_s
     with _lock:
         if ring_size is not None and ring_size > 0:
             if _ring.maxlen != ring_size:
                 _ring = deque(_ring, maxlen=int(ring_size))
+                _ring_spans = sum(
+                    d.get("span_count", 1) for d in _ring
+                )
+        if ring_spans is not None and ring_spans > 0:
+            _max_ring_spans = int(ring_spans)
+            while _ring_spans > _max_ring_spans and len(_ring) > 1:
+                _ring_spans -= _ring.popleft().get("span_count", 1)
         if slow_op_s is not None:
             _slow_op_s = max(float(slow_op_s), 0.0)
         if enabled is not None:
@@ -431,14 +516,26 @@ def configure(
         return {
             "enabled": armed,
             "ring_size": _ring.maxlen,
+            "ring_spans": _max_ring_spans,
             "slow_op_s": _slow_op_s,
         }
 
 
 def reset() -> None:
     """Drop recorded traces (tests)."""
+    global _ring_spans
     with _lock:
         _ring.clear()
+        _ring_spans = 0
+    with _ewma_lock:
+        _stage_ewma.clear()
+
+
+def stage_ewmas() -> dict[str, float]:
+    """Per-``op/stage`` EWMA of stage seconds (armed runs only) — the
+    heartbeat telemetry payload."""
+    with _ewma_lock:
+        return {f"{op}/{st}": v for (op, st), v in _stage_ewma.items()}
 
 
 def start(op: str, name: str = "", parent: "Span | None" = None, **attrs):
@@ -470,6 +567,56 @@ def start_from_metadata(
         server=server,
         attrs=attrs,
     )
+
+
+def start_from_headers(op: str, headers, name: str = "", server: str = "",
+                       **attrs):
+    """HTTP-side span adoption: continue the trace carried in request
+    headers (a LOCAL root here — its parent span lives on the calling
+    server/client). ``headers`` is any case-insensitive mapping with
+    ``.get`` (http.client/BaseHTTPRequestHandler message objects
+    qualify). None when disarmed."""
+    if not armed:
+        return None
+    return Span(
+        op,
+        name=name,
+        trace_id=headers.get(TRACE_ID_HEADER) or "",
+        parent_id=headers.get(PARENT_SPAN_HEADER) or "",
+        server=server,
+        attrs=attrs,
+    )
+
+
+def http_headers(span=None, headers: dict | None = None) -> dict | None:
+    """Outgoing HTTP headers carrying the trace context of ``span`` (or
+    the ambient span). Returns ``headers`` with the two trace headers
+    merged in, or None when there is nothing to carry (the request id
+    rides separately via request_id.inject)."""
+    sp = span
+    if sp is None and armed:
+        sp = _current.get()
+    if sp is None:
+        return headers
+    h = headers if headers is not None else {}
+    h[TRACE_ID_HEADER] = sp.trace_id
+    h[PARENT_SPAN_HEADER] = sp.span_id
+    return h
+
+
+def set_current(span):
+    """Install ``span`` as the ambient span; returns a token for
+    :func:`reset_current` (the non-with-block form of :func:`activate`,
+    for request handlers whose enter/exit live in different methods).
+    None-safe: returns None when ``span`` is None."""
+    if span is None:
+        return None
+    return _current.set(span)
+
+
+def reset_current(token) -> None:
+    if token is not None:
+        _current.reset(token)
 
 
 def current():
@@ -548,13 +695,21 @@ def metadata_dict(context) -> dict:
 # --------------------------------------------------------------------------
 
 
-def traces(trace_id: str = "") -> list[dict]:
-    """Completed root spans, oldest first (optionally one trace id —
-    a cross-server trace is several roots sharing it)."""
+def traces(
+    trace_id: str = "", op: str = "", min_ms: float = 0.0
+) -> list[dict]:
+    """Completed root spans, oldest first. Filters: one trace id (a
+    cross-server trace is several roots sharing it), a root ``op``
+    class, and/or a minimum root duration in milliseconds — the
+    ``/debug/traces?op=&min_ms=`` query surface."""
     with _lock:
         docs = list(_ring)
     if trace_id:
         docs = [d for d in docs if d["trace_id"] == trace_id]
+    if op:
+        docs = [d for d in docs if d["op"] == op]
+    if min_ms > 0.0:
+        docs = [d for d in docs if d["duration_s"] * 1000.0 >= min_ms]
     return docs
 
 

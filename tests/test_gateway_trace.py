@@ -1,0 +1,383 @@
+"""ISSUE 9: gateway-to-chip observability.
+
+Cross-protocol trace continuity over REAL spawned HTTP servers (S3
+gateway + filer server + volume server on ephemeral ports): one S3 GET
+against a degraded EC volume must yield a SINGLE trace id spanning the
+s3/filer/volume layers down to the EC reconstruction, and the response
+must echo the id. Plus: the heartbeat telemetry plane (master
+/cluster/status + sw_ec_queue_load learned only from heartbeats), the
+/debug/slo surface, /debug/traces op/min_ms filters, and the
+span-budget ring bound.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+import requests
+
+from conftest import allocate_port as free_port
+
+from seaweedfs_tpu.filer import Filer, MemoryStore
+from seaweedfs_tpu.pb import cluster_pb2 as pb
+from seaweedfs_tpu.pb import rpc as _rpc
+from seaweedfs_tpu.s3 import S3Server
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.shell.commands import ShellEnv, run_command
+from seaweedfs_tpu.storage.file_id import FileId
+from seaweedfs_tpu.utils import metrics as M
+from seaweedfs_tpu.utils import trace
+
+
+def _wait(cond, timeout=15.0, msg="condition"):
+    deadline = time.time() + timeout
+    while not cond():
+        assert time.time() < deadline, f"timed out: {msg}"
+        time.sleep(0.05)
+
+
+@pytest.fixture(scope="module")
+def gateway(tmp_path_factory):
+    """Master + volume + filer + S3 servers (real HTTP/gRPC, ephemeral
+    ports) over ONE object on a DEGRADED EC volume (shard 0 unmounted).
+    Yields a dict of the live pieces."""
+    tmp = tmp_path_factory.mktemp("gwtrace")
+    mport = free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp / "v")],
+        master=f"localhost:{mport}",
+        ip="localhost",
+        port=free_port(),
+        ec_backend="cpu",
+    )
+    vs.start()
+    _wait(lambda: master.topo.nodes, msg="volume registration")
+
+    filer = Filer(
+        MemoryStore(), master=f"localhost:{mport}", chunk_size=64 * 1024
+    )
+    fsrv = FilerServer(filer, ip="localhost", port=free_port())
+    fsrv.start()
+    s3 = S3Server(filer, ip="localhost", port=free_port())
+    s3.start()
+    base = f"http://localhost:{s3.port}"
+
+    assert requests.put(f"{base}/b1").status_code == 200
+    data = os.urandom(150_000)
+    assert requests.put(f"{base}/b1/obj", data=data).status_code == 200
+    entry = filer.find_entry("/buckets/b1/obj")
+    vid = FileId.parse(entry.chunks[0].fid).volume_id
+    env = ShellEnv(f"localhost:{mport}")
+    try:
+        out = run_command(env, f"ec.encode -volumeId {vid} -backend cpu")
+        assert "generation" in out, out
+    finally:
+        env.close()
+    _wait(
+        lambda: any(
+            vid in n.ec_shards for n in master.topo.nodes.values()
+        ),
+        msg="ec shards via heartbeat",
+    )
+    # degrade: unmount one data shard — reads of its stripe must now
+    # run a verified RS reconstruction on the volume server
+    import grpc
+
+    with grpc.insecure_channel(f"localhost:{vs.grpc_port}") as ch:
+        _rpc.volume_stub(ch).VolumeEcShardsUnmount(
+            pb.EcShardsUnmountRequest(volume_id=vid, shard_ids=[0])
+        )
+
+    yield {
+        "master": master,
+        "mport": mport,
+        "vs": vs,
+        "filer": filer,
+        "fsrv": fsrv,
+        "s3_base": base,
+        "filer_base": f"http://localhost:{fsrv.port}",
+        "data": data,
+        "vid": vid,
+    }
+
+    s3.stop()
+    fsrv.stop()
+    filer.close()
+    vs.stop()
+    master.stop()
+
+
+@pytest.fixture
+def recorder():
+    trace.configure(
+        enabled=True, ring_size=512,
+        ring_spans=trace.DEFAULT_RING_SPANS, slow_op_s=0.0,
+    )
+    trace.reset()
+    yield trace
+    trace.configure(
+        enabled=False, slow_op_s=0.0,
+        ring_spans=trace.DEFAULT_RING_SPANS,
+    )
+    trace.reset()
+
+
+def _walk(doc):
+    yield doc
+    for c in doc["children"]:
+        yield from _walk(c)
+
+
+# ------------------------------------------------- cross-protocol trace
+
+
+def test_degraded_s3_get_yields_one_trace(gateway, recorder):
+    """THE acceptance path: one S3 GET on a degraded EC volume -> one
+    trace id across the s3 / filer / volume layers, an
+    ec.degraded_read span below the volume server, the gateway stages
+    attributed, and the trace id echoed on the response."""
+    gw = gateway
+    # drop the filer chunk cache so the GET actually crosses to the
+    # volume server instead of serving from the gateway's LRU
+    gw["filer"].chunk_cache.clear()
+
+    r = requests.get(f"{gw['s3_base']}/b1/obj")
+    assert r.status_code == 200 and r.content == gw["data"]
+    tid = r.headers.get(trace.TRACE_ID_HEADER)
+    assert tid, "response must echo the trace id"
+    assert r.headers.get("X-Request-ID")
+
+    docs = trace.traces(tid)
+    assert docs, "trace ring must hold the roots for the echoed id"
+    servers, ops, stages = set(), set(), set()
+    for d in docs:
+        for node in _walk(d):
+            assert node["trace_id"] == tid
+            servers.add(node.get("server") or "")
+            ops.add(node["op"])
+            stages.update(node["stages"])
+    # all three layers in ONE trace
+    assert {"s3", "filer", "volume"} <= servers, servers
+    # gateway handler -> chip: the degraded reconstruction is in-trace
+    assert "ec.degraded_read" in ops, ops
+    assert {"http.s3", "http.volume", "filer.read"} <= ops, ops
+    # the budget split the issue names
+    assert {
+        "s3.auth", "filer.lookup", "chunk.fetch", "volume.read",
+    } <= stages, stages
+    # every stage label is canonical (the registry the lint enforces)
+    assert stages <= trace.STAGES, stages - trace.STAGES
+    # the volume-server roots are children of the filer's chunk fetch:
+    # adopted parents must be spans of the SAME trace
+    vol_roots = [d for d in docs if d["op"] == "http.volume"]
+    assert vol_roots
+    all_span_ids = {
+        n["span_id"] for d in docs for n in _walk(d)
+    }
+    for d in vol_roots:
+        assert d["parent_span_id"] in all_span_ids, (
+            "volume root must link to a filer-side parent span"
+        )
+
+
+def test_client_supplied_trace_id_is_adopted(gateway, recorder):
+    """A caller-minted trace id (header) is adopted by the filer HTTP
+    server and propagated to the volume server — client-side tracing
+    joins server-side rings."""
+    gw = gateway
+    gw["filer"].chunk_cache.clear()
+    tid = "feedc0de12345678"
+    r = requests.get(
+        f"{gw['filer_base']}/buckets/b1/obj",
+        headers={trace.TRACE_ID_HEADER: tid},
+    )
+    assert r.status_code == 200 and r.content == gw["data"]
+    assert r.headers.get(trace.TRACE_ID_HEADER) == tid
+    docs = trace.traces(tid)
+    servers = {
+        n.get("server") for d in docs for n in _walk(d)
+    }
+    assert {"filer", "volume"} <= servers, servers
+
+
+def test_request_id_still_rides_disarmed(gateway):
+    """Tracer OFF: no trace header, no spans, but X-Request-ID still
+    propagates and echoes (the PR 7 contract is not regressed)."""
+    assert not trace.armed
+    gw = gateway
+    r = requests.get(
+        f"{gw['s3_base']}/b1/obj", headers={"X-Request-ID": "req-42"}
+    )
+    assert r.status_code == 200
+    assert r.headers.get("X-Request-ID") == "req-42"
+    assert trace.TRACE_ID_HEADER not in r.headers
+
+
+# ------------------------------------------------------ debug surfaces
+
+
+def test_debug_traces_op_and_min_ms_filters(gateway, recorder):
+    gw = gateway
+    gw["filer"].chunk_cache.clear()
+    assert requests.get(f"{gw['s3_base']}/b1/obj").status_code == 200
+    vbase = f"http://localhost:{gw['vs'].port}"
+    docs = requests.get(
+        f"{vbase}/debug/traces?format=spans&op=http.volume"
+    ).json()
+    assert docs and all(d["op"] == "http.volume" for d in docs)
+    assert requests.get(
+        f"{vbase}/debug/traces?format=spans&min_ms=9999999"
+    ).json() == []
+    # chrome export respects the same filters
+    chrome = requests.get(
+        f"{vbase}/debug/traces?op=http.volume"
+    ).json()
+    assert chrome["traceEvents"]
+
+
+def test_slo_endpoint_all_servers(gateway):
+    gw = gateway
+    # prime each server with at least one completed request
+    requests.get(f"http://localhost:{gw['mport']}/cluster/status")
+    requests.get(f"{gw['s3_base']}/b1/obj")
+    for base, kind in (
+        (gw["filer_base"], "filer."),
+        (f"http://localhost:{gw['vs'].port}", "volume."),
+        (f"http://localhost:{gw['mport']}", "master."),
+    ):
+        slo = requests.get(f"{base}/debug/slo").json()
+        assert any(k.startswith(kind) for k in slo), (kind, list(slo))
+        for s in slo.values():
+            assert {"count", "mean_ms", "p50_ms", "p90_ms", "p99_ms"} <= set(s)
+            assert s["p50_ms"] <= s["p99_ms"] + 1e-9
+    # the S3 DATA plane does not expose /debug/slo (a bucket named
+    # "debug" stays addressable; status must not bypass SigV4) — its op
+    # classes surface through co-resident servers' endpoints instead
+    slo = requests.get(
+        f"http://localhost:{gw['vs'].port}/debug/slo"
+    ).json()
+    assert any(k.startswith("s3.") for k in slo)
+    r = requests.get(f"{gw['s3_base']}/debug/slo")
+    assert r.status_code != 200 or r.headers.get(
+        "Content-Type", ""
+    ).startswith("application/xml")
+
+
+def test_request_seconds_histogram_populated(gateway):
+    text = M.REGISTRY.render().decode()
+    assert 'sw_request_seconds_count{server="s3",op="get_object"}' in text
+    assert 'server="volume",op="read"' in text
+
+
+# -------------------------------------------------- telemetry plane
+
+
+def test_heartbeat_telemetry_reaches_master(gateway):
+    """Per-host chip load / breaker state appears in /cluster/status
+    and the sw_ec_queue_load gauge, learned ONLY from heartbeats (the
+    master never probes the volume server)."""
+    gw = gateway
+    node_id = f"localhost:{gw['vs'].port}"
+
+    def master_has_tele():
+        st = requests.get(
+            f"http://localhost:{gw['mport']}/cluster/status"
+        ).json()
+        tele = st.get("EcTelemetry", {})
+        return node_id in tele and tele[node_id].get("chips")
+
+    _wait(master_has_tele, timeout=10, msg="telemetry via heartbeat")
+    st = requests.get(
+        f"http://localhost:{gw['mport']}/cluster/status"
+    ).json()
+    tele = st["EcTelemetry"][node_id]
+    assert {"chips", "breakers_open", "degraded"} <= set(tele)
+    for chip, c in tele["chips"].items():
+        assert "load" in c and "breaker" in c
+    # matches what the node itself would report (single source)
+    local = json.loads(gw["vs"]._ec_telemetry_json())
+    assert set(local["chips"]) == set(tele["chips"])
+    # fleet gauge renders per node+chip
+    mtx = requests.get(
+        f"http://localhost:{gw['mport']}/metrics"
+    ).text
+    assert f'sw_ec_queue_load{{node="{node_id}"' in mtx
+    assert f'sw_ec_fleet_breakers_open{{node="{node_id}"}}' in mtx
+
+
+def test_chip_load_hint_read_only(gateway):
+    """chip_load_hint reads the scope's existing queues without
+    creating any; shape = {chip: {load, breaker}}."""
+    from seaweedfs_tpu.ec.chip_pool import chip_load_hint
+
+    scope = gateway["vs"].store.ec_scheduler
+    before = len(scope._queues)
+    hint = chip_load_hint(scope)
+    assert len(scope._queues) == before
+    for chip, c in hint.items():
+        assert isinstance(c["load"], int) and "breaker" in c
+
+
+def test_shell_cluster_status_shows_telemetry(gateway):
+    env = ShellEnv(f"localhost:{gateway['mport']}")
+    try:
+        out = run_command(env, "cluster.status")
+    finally:
+        env.close()
+    assert "chips localhost" in out, out
+    assert "slo (master, ms):" in out, out
+
+
+# ------------------------------------------------- span-budget ring
+
+
+def test_ring_is_span_budget_bounded(recorder):
+    """A span-heavy op class cannot pin an unbounded share of memory:
+    the ring evicts oldest docs once the TOTAL retained span count
+    exceeds the budget, trace-count bound notwithstanding."""
+    trace.configure(ring_size=256, ring_spans=50)
+    for i in range(20):
+        sp = trace.Span("ec.encode", name=f"heavy{i}")
+        for _ in range(9):
+            sp.child("ec.peer_fetch")
+        sp.finish()
+    docs = trace.traces()
+    total = sum(d["span_count"] for d in docs)
+    assert total <= 50, total
+    assert len(docs) == 5  # 10 spans per doc -> the 5 newest fit
+    assert docs[-1]["name"] == "heavy19"
+    # the newest doc is always kept even if alone it exceeds the budget
+    trace.configure(ring_spans=3)
+    sp = trace.Span("ec.encode", name="huge")
+    for _ in range(9):
+        sp.child("ec.peer_fetch")
+    sp.finish()
+    docs = trace.traces()
+    assert [d["name"] for d in docs] == ["huge"]
+
+
+def test_slow_op_tree_carries_rid_and_root_op(recorder, capfd):
+    """Slow-op log satellite: the logged span tree itself carries the
+    request id and root op, so a tree separated from its log prefix
+    still joins against gateway access logs."""
+    from seaweedfs_tpu.utils import request_id as rid
+
+    trace.configure(slow_op_s=0.001)
+    rid.ensure("rid-join-1")
+    try:
+        sp = trace.start("ec.rebuild", name="slowtree")
+        with trace.stage(sp, "disk_read"):
+            time.sleep(0.01)
+        trace.finish(sp)
+    finally:
+        rid.clear()
+    err = capfd.readouterr().err
+    assert "rid=rid-join-1" in err
+    assert "root=ec.rebuild" in err

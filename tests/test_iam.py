@@ -541,6 +541,11 @@ def test_oidc_rs256_verify():
     import json
     import time as _time
 
+    import pytest
+
+    pytest.importorskip(
+        "cryptography", reason="RS256 verify needs 'cryptography'"
+    )
     from cryptography.hazmat.primitives import serialization
     from cryptography.hazmat.primitives.asymmetric import padding, rsa
     from cryptography.hazmat.primitives.hashes import SHA256

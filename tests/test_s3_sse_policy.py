@@ -23,6 +23,21 @@ from seaweedfs_tpu.server.master import MasterServer
 from seaweedfs_tpu.server.volume_server import VolumeServer
 from test_s3 import sign_request
 
+# the gateway imports without `cryptography` (sse.py gates it); the SSE
+# ciphers themselves still need it — skip only those tests in slim
+# containers instead of failing the whole module's policy/ACL coverage
+try:
+    import cryptography  # noqa: F401
+
+    _HAS_CRYPTO = True
+except ImportError:
+    _HAS_CRYPTO = False
+
+needs_crypto = pytest.mark.skipif(
+    not _HAS_CRYPTO,
+    reason="SSE ciphers require the optional 'cryptography' package",
+)
+
 
 @pytest.fixture(scope="module")
 def cluster(tmp_path_factory):
@@ -82,6 +97,7 @@ def ssec_headers(key: bytes, prefix="x-amz-server-side-encryption-customer-"):
 # ------------------------------------------------------------------ SSE-C
 
 
+@needs_crypto
 def test_ssec_roundtrip_and_key_enforcement(s3):
     url, srv = s3
     requests.put(f"{url}/sec")
@@ -137,6 +153,7 @@ def test_ssec_bad_key_md5_rejected(s3):
 # ------------------------------------------------------------------ SSE-S3
 
 
+@needs_crypto
 def test_sse_s3_roundtrip(s3):
     url, srv = s3
     requests.put(f"{url}/managed")
@@ -160,6 +177,7 @@ def test_sse_s3_roundtrip(s3):
     assert r.status_code == 206 and r.content == data[7:100]
 
 
+@needs_crypto
 def test_bucket_default_encryption(s3):
     url, srv = s3
     requests.put(f"{url}/dflt")
@@ -186,6 +204,7 @@ def test_bucket_default_encryption(s3):
     assert srv.filer.read_entry(e2) == data
 
 
+@needs_crypto
 def test_sse_copy_reencrypts(s3):
     url, srv = s3
     requests.put(f"{url}/cpy")
@@ -237,6 +256,7 @@ def _multipart_upload(url, bucket, key, parts, headers=None):
     )
 
 
+@needs_crypto
 def test_sse_s3_multipart_roundtrip(s3):
     """Multipart + SSE-S3: parts are independent CTR streams under one
     envelope key; ranged reads seek across part boundaries."""
@@ -267,6 +287,7 @@ def test_sse_s3_multipart_roundtrip(s3):
         assert rr.content == plain[lo : hi + 1], (lo, hi)
 
 
+@needs_crypto
 def test_ssec_multipart_roundtrip(s3):
     """Multipart + SSE-C: the customer key rides every part request and
     every read; a wrong key on a part is rejected."""
@@ -620,6 +641,7 @@ def test_post_policy_preserves_trailing_newlines(s3_two_users):
     assert requests.get(f"{url}/nl/text.txt", headers=h).content == data
 
 
+@needs_crypto
 def test_multipart_on_default_encrypted_bucket_encrypts(s3):
     """Bucket default encryption applies to multipart uploads too —
     plaintext must never land in an AES256-default bucket."""

@@ -341,6 +341,69 @@ def test_exposition_escaping_roundtrip_adversarial_labels():
     assert parsed[("sw_esc_gauge", (("a", "x\\"), ("b", '"\n"')))] == 1.5
 
 
+_STAGE_PATTERNS = [
+    # trace.stage(sp, "name") — the first arg may be a call like
+    # trace.current()
+    re.compile(
+        r'\bstage\(\s*[A-Za-z_][\w.\[\]]*(?:\(\))?\s*,\s*"([a-z0-9_.]+)"'
+    ),
+    # span.stage("name")
+    re.compile(r'\.stage\(\s*"([a-z0-9_.]+)"'),
+    # span.add_stage("name", secs) — possibly split across lines
+    re.compile(r'add_stage\(\s*"([a-z0-9_.]+)"'),
+    # trace.add_stage(span, "name", secs)
+    re.compile(r'add_stage\(\s*[A-Za-z_][\w.]*\s*,\s*"([a-z0-9_.]+)"'),
+    # pipeline stage-name kwargs
+    re.compile(r'(?:read_stage|write_stage)\s*=\s*"([a-z0-9_.]+)"'),
+]
+_STAGE_TUPLE = re.compile(r"stage_names\s*=\s*\(([^)]*)\)")
+
+
+def test_stage_name_registry_lint():
+    """Every stage-name literal in the package must be in trace.STAGES:
+    a typo'd label would silently fork a sw_ec_stage_seconds series
+    (and vanish from the heartbeat EWMAs) instead of failing here."""
+    import seaweedfs_tpu
+
+    pkg_root = seaweedfs_tpu.__path__[0]
+    found: dict[str, set] = {}
+    for dirpath, _dirnames, filenames in os.walk(pkg_root):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path) as f:
+                src = f.read()
+            names = set()
+            for pat in _STAGE_PATTERNS:
+                names.update(pat.findall(src))
+            for tup in _STAGE_TUPLE.findall(src):
+                names.update(re.findall(r'"([a-z0-9_.]+)"', tup))
+            for n in names:
+                found.setdefault(n, set()).add(
+                    os.path.relpath(path, pkg_root)
+                )
+    unknown = {
+        n: sorted(files)
+        for n, files in found.items()
+        if n not in trace.STAGES
+    }
+    assert not unknown, (
+        f"stage literals outside trace.STAGES (typo'd histogram "
+        f"label?): {unknown}"
+    )
+    # the scan actually sees the fleet — a broken regex must not pass
+    # vacuously (gateway + pipeline stages at minimum)
+    assert len(found) >= 12, sorted(found)
+    for required in (
+        "s3.auth", "filer.lookup", "chunk.fetch", "volume.read",
+        "disk_read", "h2d_dispatch", "admission_wait",
+    ):
+        assert required in found, required
+
+
 def test_metrics_lint_package_wide():
     """Walk the package, import every module best-effort (optional deps
     may be absent in this container), then lint EVERY sw_* registration:
