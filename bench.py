@@ -6,13 +6,14 @@ framework's device path (XLA/Pallas bit-matmul encode, seaweedfs_tpu/ops),
 and BASELINE.json configs 1-2 end-to-end: `ec.encode` of a fabricated
 volume disk->shards+.ecsum, and a 2-shard `ec.rebuild`.
 
-Headline (round 5): the DISK-INDEPENDENT device pipeline — striped reads
-from a tmpfs-staged volume -> H2D -> encode -> D2H -> rolling CRC32C of
-all 14 shard streams, double-buffered via the backend staging hooks.
-The host's measured disk ceiling (~0.07 GB/s) is ~300x below the compute
-rates, so the on-disk e2e can never show a compute win; the pipeline
-number is the full device e2e minus that bottleneck and is verified the
-same way (bit-exact shard CRCs vs the identical CPU pipeline).
+Headline (ISSUE 10 / ROADMAP direction 1): ec_encode_e2e — the
+end-to-end disk->shards encode on the zero-copy NATIVE data plane
+(native batched reads + fused write+CRC sink, ec/native_io.py), with
+the pure-Python byte path re-measured on the same volume as the
+vs_baseline denominator and bit-identity (shard + v2 leaf CRCs)
+asserted in-line. The kernel-only and disk-independent-pipeline
+figures remain as sub-fields (kernel_gbs / pipeline_gbs) — context,
+never the headline.
 
 Self-verification (every device number is evidence, not vibes):
 - the kernel loop encodes a DIFFERENT pre-staged buffer each rep, and every
@@ -155,17 +156,32 @@ def _clear_shards(base: str) -> None:
             os.unlink(base + ext)
 
 
-def _cpu_e2e(base: str) -> tuple[float, list[list[int]], int]:
-    """Timed CPU disk->shards encode; returns (gbs, shard_crcs, dat_size)."""
+def _cpu_e2e(
+    base: str, force_python: bool = False
+) -> tuple[float, list[list[int]], int]:
+    """Timed CPU disk->shards encode; returns (gbs, shard_crcs, dat_size).
+    `force_python` pins the pure-Python source/sink plane
+    (SEAWEED_EC_NATIVE=0) so the headline native-plane number ships with
+    its own bit-identity evidence and speedup ratio."""
     from seaweedfs_tpu.ec.backend import CpuBackend
     from seaweedfs_tpu.ec.bitrot import BitrotProtection
     from seaweedfs_tpu.ec.context import DEFAULT_EC_CONTEXT
     from seaweedfs_tpu.ec.encoder import ec_encode_volume
 
     dat_size = os.path.getsize(base + ".dat")
-    t0 = time.perf_counter()
-    ec_encode_volume(base, backend=CpuBackend(DEFAULT_EC_CONTEXT))
-    dt = time.perf_counter() - t0
+    prev = os.environ.get("SEAWEED_EC_NATIVE")
+    if force_python:
+        os.environ["SEAWEED_EC_NATIVE"] = "0"
+    try:
+        t0 = time.perf_counter()
+        ec_encode_volume(base, backend=CpuBackend(DEFAULT_EC_CONTEXT))
+        dt = time.perf_counter() - t0
+    finally:
+        if force_python:
+            if prev is None:
+                os.environ.pop("SEAWEED_EC_NATIVE", None)
+            else:
+                os.environ["SEAWEED_EC_NATIVE"] = prev
     prot = BitrotProtection.load(base + ".ecsum")
     return dat_size / dt / 1e9, prot.shard_crcs, dat_size
 
@@ -1513,9 +1529,20 @@ def _stage_child(name: str, workdir: str) -> None:
 
 
 def _probe_cache_path() -> str:
+    # Default lives NEXT TO bench.py, not in $TMPDIR: the BENCH_r05
+    # regression was a fresh-container /tmp discarding the hung verdict
+    # between harness rounds, so every plain `python bench.py` re-paid
+    # the full probe watchdog (3 x 150 s before the single-attempt fix,
+    # 1 x 150 s after). The repo checkout is the one thing that
+    # persists across rounds — with the verdict parked here, the
+    # default no-flag invocation short-circuits to the <=30 s probe and
+    # the off-path re-probe daemon owns full-patience retries.
     return os.environ.get(
         "SEAWEED_BENCH_PROBE_CACHE",
-        os.path.join(tempfile.gettempdir(), "seaweed_bench_probe_verdict.json"),
+        os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            ".bench_probe_verdict.json",
+        ),
     )
 
 
@@ -2181,7 +2208,21 @@ def main() -> None:
         kernel_crcs = _expected_kernel_crcs(coeffs)
         base = _fabricate_volume(workdir, volume_mb << 20)
         disk_gbs = _disk_write_gbs(workdir)
+        # Python-plane reference first (its shards are cleared), then
+        # the NATIVE-plane encode — the headline e2e — whose shards and
+        # sidecar stay on disk for the recovery benches AND must match
+        # the Python run bit-for-bit (shard CRCs + v2 leaf CRCs): the
+        # zero-copy plane's identity evidence ships in the line itself.
+        from seaweedfs_tpu.ec.bitrot import BitrotProtection as _BP
+
+        cpu_e2e_py, shard_crcs_py, _ = _cpu_e2e(base, force_python=True)
+        leaf_crcs_py = _BP.load(base + ".ecsum").shard_leaf_crcs
+        _clear_shards(base)
         cpu_e2e, shard_crcs, dat_size = _cpu_e2e(base)
+        native_identical = bool(
+            shard_crcs == shard_crcs_py
+            and _BP.load(base + ".ecsum").shard_leaf_crcs == leaf_crcs_py
+        )
 
         # Recovery-path benches (BASELINE configs 2 and 4) on the CPU
         # backend, against the just-encoded volume; both restore the
@@ -2239,6 +2280,11 @@ def main() -> None:
             "threads": threads,
             "volume_gib": round(dat_size / (1 << 30), 3),
             "cpu_e2e_gbs": round(cpu_e2e, 3),
+            # native data plane vs pure-Python source/sink, same volume,
+            # bit-identity asserted (ISSUE 10 acceptance evidence)
+            "cpu_e2e_python_gbs": round(cpu_e2e_py, 3),
+            "e2e_native_vs_python": round(cpu_e2e / max(cpu_e2e_py, 1e-9), 3),
+            "e2e_native_identical": native_identical,
             "cpu_kernel_gbs": round(cpu_kernel, 3),
             # Honest derating context (north-star baseline is a 16-core
             # host; this one has `threads`): linear-scaling estimate.
@@ -2419,28 +2465,14 @@ def main() -> None:
                 }
             )
 
-        if (
-            pipeline.get("pipeline_gbs") is not None
-            and pipeline.get("pipeline_verified")
-            and on_tpu
-        ):
-            # Headline: the disk-independent device pipeline — the full
-            # e2e minus the disk bottleneck; bit-exact (14 shard CRCs
-            # vs the CPU pipeline), HBM-guarded, D2H-forced.
-            best.update(
-                {
-                    "metric": (
-                        f"ec_encode_pipeline_10p4[{kind}"
-                        f" vs {threads}-thread avx2 cpu pipeline,"
-                        f" bit-exact]"
-                    ),
-                    "value": round(pipeline["pipeline_gbs"], 3),
-                    "vs_baseline": round(
-                        pipeline["pipeline_gbs"] / cpu_pipe["gbs"], 3
-                    ),
-                }
-            )
-        elif e2e.get("e2e_gbs") is not None and on_tpu:
+        # ---- headline: ec_encode_e2e (ROADMAP direction 1) ---------------
+        # End-to-end disk->shards encode is THE metric now that the byte
+        # path rides the native data plane; the kernel-only and
+        # disk-independent pipeline figures are context sub-fields
+        # (kernel_gbs / pipeline_gbs above), never the headline. The CPU
+        # headline's vs_baseline is native-plane / pure-Python-plane on
+        # the same volume (bit-identity in e2e_native_identical).
+        if e2e.get("e2e_gbs") is not None and on_tpu:
             impl = (kernel or {}).get("kernel_impl")
             if not e2e.get("e2e_verified", False):
                 best.update(
@@ -2461,28 +2493,35 @@ def main() -> None:
                         "vs_baseline": round(e2e["e2e_gbs"] / cpu_e2e, 3),
                     }
                 )
-        elif kernel is not None and on_tpu:
-            reason = e2e.get("error", e2e.get("skipped", "unavailable"))
+        elif not native_identical:
+            # Same demotion the device path applies on e2e_verified:
+            # a native plane that stopped matching the Python plane
+            # byte-for-byte must not publish a headline speedup.
             best.update(
                 {
-                    "metric": (
-                        f"rs_10p4_kernel_only[{kind}/"
-                        f"{kernel.get('kernel_impl')}]"
-                        f"(e2e_unavailable: {str(reason)[:120]})"
-                    ),
-                    "value": round(kernel.get("kernel_gbs", 0.0), 3),
-                    "vs_baseline": round(
-                        kernel.get("kernel_gbs", 0.0) / cpu_kernel, 3
-                    ),
+                    "metric": "ec_encode_e2e_10p4_cpu_native_MISMATCH",
+                    "value": 0.0,
+                    "vs_baseline": 0.0,
                 }
             )
         else:
-            reason = probe.get("error", probe.get("platform", "unknown"))
+            if kernel is not None and on_tpu:
+                reason = "device_e2e_unavailable: " + str(
+                    e2e.get("error", e2e.get("skipped", "unavailable"))
+                )[:120]
+            else:
+                reason = str(
+                    probe.get("error", probe.get("platform", "unknown"))
+                )
             best.update(
                 {
-                    "metric": f"ec_encode_e2e_10p4_cpu_fallback({reason})",
+                    "metric": (
+                        f"ec_encode_e2e_10p4_cpu_native_plane({reason})"
+                    ),
                     "value": round(cpu_e2e, 3),
-                    "vs_baseline": 1.0,
+                    "vs_baseline": round(
+                        cpu_e2e / max(cpu_e2e_py, 1e-9), 3
+                    ),
                 }
             )
         _emit()

@@ -101,6 +101,75 @@ uint32_t sn_crc32c(uint32_t crc, const uint8_t* data, size_t len) {
     return crc32c_sw(crc, data, len);
 }
 
+// --- CRC32C combine (zlib crc32_combine technique, Castagnoli poly) ---
+// crc(A++B) = shift(crc(A), len(B)) ^ crc(B), with the shift operator
+// represented as a GF(2) 32x32 matrix raised to the bit-length. Lets
+// the sink fold leaf CRCs into block CRCs WITHOUT a second byte pass.
+
+static uint32_t gf2_matrix_times(const uint32_t* mat, uint32_t vec) {
+    uint32_t sum = 0;
+    while (vec) {
+        if (vec & 1) sum ^= *mat;
+        vec >>= 1;
+        mat++;
+    }
+    return sum;
+}
+
+static void gf2_matrix_square(uint32_t* square, const uint32_t* mat) {
+    for (int n = 0; n < 32; n++) square[n] = gf2_matrix_times(mat, mat[n]);
+}
+
+// Fill `op` (32 words) with the matrix advancing a CRC by len2 bytes,
+// by square-and-multiply over the shift-by-1-byte operator: acc holds
+// the product of cur = base^(2^k) for each set bit k of len2.
+static void crc32c_shift_op(uint32_t* op, uint64_t len2) {
+    uint32_t even[32], odd[32];
+    // one-zero-bit operator for the reflected Castagnoli polynomial
+    odd[0] = 0x82F63B78u;
+    uint32_t row = 1;
+    for (int n = 1; n < 32; n++) {
+        odd[n] = row;
+        row <<= 1;
+    }
+    gf2_matrix_square(even, odd);  // 2 bits
+    gf2_matrix_square(odd, even);  // 4 bits
+    uint32_t cur[32], nxt[32];
+    gf2_matrix_square(cur, odd);   // 8 bits = shift-by-1-byte operator
+    bool have = false;
+    uint32_t acc[32];
+    while (len2) {
+        if (len2 & 1) {
+            if (!have) {
+                memcpy(acc, cur, sizeof(acc));
+                have = true;
+            } else {
+                // compose: powers of one base matrix commute
+                for (int n = 0; n < 32; n++)
+                    nxt[n] = gf2_matrix_times(cur, acc[n]);
+                memcpy(acc, nxt, sizeof(acc));
+            }
+        }
+        len2 >>= 1;
+        if (len2) {
+            gf2_matrix_square(nxt, cur);
+            memcpy(cur, nxt, sizeof(cur));
+        }
+    }
+    if (!have) {
+        // len2 == 0: identity operator
+        for (int n = 0; n < 32; n++) acc[n] = 1u << n;
+    }
+    memcpy(op, acc, sizeof(acc));
+}
+
+uint32_t sn_crc32c_combine(uint32_t crc1, uint32_t crc2, uint64_t len2) {
+    if (len2 == 0) return crc1;
+    uint32_t op[32];
+    crc32c_shift_op(op, len2);
+    return gf2_matrix_times(op, crc1) ^ crc2;
+}
+
 // ---------------------------------------------------------------------------
 // GF(2^8) Reed-Solomon matrix apply
 // ---------------------------------------------------------------------------
@@ -342,6 +411,290 @@ int sn_shard_append(const int* fds, const uint8_t* const* rows, int nrows,
     for (int i = 0; i < nrows; i++)
         if (status[i] != 0) return -(i + 1);
     return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Native read source: batched positioned reads landing directly in
+// caller-owned (optionally O_DIRECT-aligned) buffers, one worker thread
+// per row, with an optional fused rolling granule-CRC32C — the read half
+// of the zero-copy data plane. One GIL-releasing call per batch replaces
+// k Python preadv loops (and, on the rebuild path, k Python-side CRC
+// rollers) per batch.
+// ---------------------------------------------------------------------------
+
+#include <fcntl.h>
+
+// Read `width` bytes from fds[i] at offsets[i] into dst + i*stride.
+// pad_eof!=0 zero-fills past EOF (the encoder's ragged tail); pad_eof==0
+// treats a short read as that row's failure (the rebuild contract).
+// With granule>0, each row's rolling CRC state (crc_state/filled_state,
+// persisting across calls) is advanced over the bytes READ (not the
+// zero padding); completed granule CRCs land at out_crcs[i*max_out..],
+// counts in out_counts[i] (-1 = out_crcs overflow).
+// Returns 0, or -(i+1) for the first failed row.
+int sn_batch_pread(const int* fds, const uint64_t* offsets, int nrows,
+                   uint8_t* dst, size_t width, size_t stride, int pad_eof,
+                   uint32_t granule, uint32_t* crc_state,
+                   uint64_t* filled_state, uint32_t* out_crcs,
+                   int32_t* out_counts, int32_t max_out) {
+    crc32c_table_init();
+    std::vector<int> status((size_t)nrows, 0);
+    auto work = [&](int i) {
+        uint8_t* p = dst + (size_t)i * stride;
+        size_t filled = 0;
+        while (filled < width) {
+            ssize_t got = pread(fds[i], p + filled, width - filled,
+                                (off_t)(offsets[i] + filled));
+            if (got < 0) {
+                if (errno == EINTR) continue;
+                status[i] = -1;
+                return;
+            }
+            if (got == 0) break;  // EOF
+            filled += (size_t)got;
+        }
+        if (filled < width) {
+            if (!pad_eof) {
+                status[i] = -1;
+                return;
+            }
+            memset(p + filled, 0, width - filled);
+        }
+        if (granule > 0) {
+            int added = roll_crc_blocks(&crc_state[i], &filled_state[i],
+                                        granule, p, filled,
+                                        out_crcs + (size_t)i * (size_t)max_out,
+                                        max_out);
+            if (added < 0) {
+                out_counts[i] = -1;
+                status[i] = -1;
+                return;
+            }
+            out_counts[i] = added;
+        } else if (out_counts) {
+            out_counts[i] = 0;
+        }
+    };
+    // Page-cache-warm rows are memcpy-bound: more workers than cores
+    // just thrash. Cold rows are I/O-bound and still overlap fine at
+    // core count (each worker drains rows in a strided loop).
+    unsigned hw = std::thread::hardware_concurrency();
+    int nworkers = (int)(hw ? hw : 1);
+    if (nworkers > nrows) nworkers = nrows;
+    if (nworkers > 1) {
+        std::vector<std::thread> ts;
+        ts.reserve((size_t)nworkers);
+        for (int w = 0; w < nworkers; w++)
+            ts.emplace_back([&, w]() {
+                for (int i = w; i < nrows; i += nworkers) work(i);
+            });
+        for (auto& t : ts) t.join();
+    } else {
+        for (int i = 0; i < nrows; i++) work(i);
+    }
+    for (int i = 0; i < nrows; i++)
+        if (status[i] != 0) return -(i + 1);
+    return 0;
+}
+
+// Best-effort readahead hint for the NEXT batch's extent; the producer
+// issues it before reading the current batch so the kernel can overlap
+// the next window's page-in with this batch's compute+write.
+int sn_fadvise_willneed(int fd, uint64_t off, uint64_t len) {
+#if defined(POSIX_FADV_WILLNEED)
+    return posix_fadvise(fd, (off_t)off, (off_t)len, POSIX_FADV_WILLNEED);
+#else
+    (void)fd; (void)off; (void)len;
+    return 0;
+#endif
+}
+
+// ---------------------------------------------------------------------------
+// Stateful fused shard sink: the write half of the zero-copy data plane.
+// One handle per encode/rebuild stream; each append pwrite(2)s every
+// shard's row straight from the source buffer at an internally-tracked
+// offset (the Python file object's position is never moved) and rolls
+// BOTH sidecar CRC levels — per-leaf and per-block — in the same
+// cache-hot pass, so the v2 .ecsum needs no Python-side folding.
+// SN_SINK_EARLY_WB additionally kicks off background writeback
+// (sync_file_range) for the just-written extent so the final fsync
+// drains an already-flushing page range instead of the whole file.
+// ---------------------------------------------------------------------------
+
+#define SN_SINK_EARLY_WB 1u
+
+struct SnSink {
+    std::vector<int> fds;
+    std::vector<uint64_t> off;    // next pwrite offset per shard
+    uint32_t block_size;
+    uint32_t leaf_size;           // 0 = v1 sidecar (block level only)
+    uint32_t flags;
+    // leaf_size == 0: direct byte-rolled block CRC (bcrc/bfill).
+    // leaf_size > 0: the block level is FOLDED from completed leaf
+    // CRCs via the cached shift-by-leaf operator (leaf_op) — one byte
+    // pass total for both sidecar levels.
+    std::vector<uint32_t> bcrc;   // rolling block-CRC state / folded acc
+    std::vector<uint64_t> bfill;  // bytes (v1) or completed leaves (v2)
+    std::vector<uint32_t> lcrc;   // rolling leaf-CRC state
+    std::vector<uint64_t> lfill;
+    uint32_t leaf_op[32];         // CRC shift operator for leaf_size bytes
+};
+
+void* sn_sink_create(const int* fds, int n, uint32_t block_size,
+                     uint32_t leaf_size, uint32_t flags) {
+    if (n <= 0 || block_size == 0) return nullptr;
+    if (leaf_size != 0 && block_size % leaf_size != 0) return nullptr;
+    crc32c_table_init();
+    SnSink* s = new SnSink();
+    s->fds.assign(fds, fds + n);
+    s->off.assign((size_t)n, 0);
+    s->block_size = block_size;
+    s->leaf_size = leaf_size;
+    s->flags = flags;
+    s->bcrc.assign((size_t)n, 0);
+    s->bfill.assign((size_t)n, 0);
+    s->lcrc.assign((size_t)n, 0);
+    s->lfill.assign((size_t)n, 0);
+    if (leaf_size) crc32c_shift_op(s->leaf_op, leaf_size);
+    return s;
+}
+
+static int pwrite_full(int fd, const uint8_t* p, size_t len, uint64_t off) {
+    while (len) {
+        ssize_t w = pwrite(fd, p, len, (off_t)off);
+        if (w < 0) {
+            if (errno == EINTR) continue;
+            return -1;
+        }
+        p += w;
+        off += (uint64_t)w;
+        len -= (size_t)w;
+    }
+    return 0;
+}
+
+// Append `width` bytes from rows[i] to shard i for all shards, one
+// worker thread per shard. Completed block CRCs land at
+// out_block_crcs[i*max_out..] (counts in out_block_counts[i]); with a
+// leaf level, completed leaf CRCs likewise in out_leaf_*. A -1 count
+// reports out-array overflow. Returns 0 or -(i+1) for the first failed
+// shard.
+int sn_sink_append(void* handle, const uint8_t* const* rows, size_t width,
+                   uint32_t* out_block_crcs, int32_t* out_block_counts,
+                   uint32_t* out_leaf_crcs, int32_t* out_leaf_counts,
+                   int32_t max_out) {
+    SnSink* s = (SnSink*)handle;
+    int n = (int)s->fds.size();
+    uint32_t leaves_per_block =
+        s->leaf_size ? s->block_size / s->leaf_size : 0;
+    std::vector<int> status((size_t)n, 0);
+    auto work = [&](int i) {
+        // CRC first, while the bytes are cache-hot from the encode
+        if (s->leaf_size) {
+            // ONE byte pass (leaf granularity); the block level folds
+            // from the completed leaf CRCs via the cached operator.
+            uint32_t* leaf_out =
+                out_leaf_crcs + (size_t)i * (size_t)max_out;
+            int added = roll_crc_blocks(&s->lcrc[i], &s->lfill[i],
+                                        s->leaf_size, rows[i], width,
+                                        leaf_out, max_out);
+            if (added < 0) {
+                out_leaf_counts[i] = -1;
+                status[i] = -1;
+                return;
+            }
+            out_leaf_counts[i] = added;
+            uint32_t* block_out =
+                out_block_crcs + (size_t)i * (size_t)max_out;
+            int nblocks = 0;
+            for (int l = 0; l < added; l++) {
+                s->bcrc[i] =
+                    gf2_matrix_times(s->leaf_op, s->bcrc[i]) ^ leaf_out[l];
+                if (++s->bfill[i] == leaves_per_block) {
+                    if (nblocks >= max_out) {
+                        out_block_counts[i] = -1;
+                        status[i] = -1;
+                        return;
+                    }
+                    block_out[nblocks++] = s->bcrc[i];
+                    s->bcrc[i] = 0;
+                    s->bfill[i] = 0;
+                }
+            }
+            out_block_counts[i] = nblocks;
+        } else {
+            int added = roll_crc_blocks(
+                &s->bcrc[i], &s->bfill[i], s->block_size, rows[i], width,
+                out_block_crcs + (size_t)i * (size_t)max_out, max_out);
+            if (added < 0) {
+                out_block_counts[i] = -1;
+                status[i] = -1;
+                return;
+            }
+            out_block_counts[i] = added;
+            if (out_leaf_counts) out_leaf_counts[i] = 0;
+        }
+        uint64_t at = s->off[i];
+        if (pwrite_full(s->fds[i], rows[i], width, at) != 0) {
+            status[i] = -1;
+            return;
+        }
+        s->off[i] = at + width;
+#if defined(__linux__) && defined(SYNC_FILE_RANGE_WRITE)
+        if (s->flags & SN_SINK_EARLY_WB) {
+            // best-effort: some filesystems reject it (EINVAL/ESPIPE);
+            // writeback then simply waits for the caller's fsync
+            (void)sync_file_range(s->fds[i], (off_t)at, (off_t)width,
+                                  SYNC_FILE_RANGE_WRITE);
+        }
+#endif
+    };
+    if (n > 1 && std::thread::hardware_concurrency() > 1) {
+        std::vector<std::thread> ts;
+        ts.reserve((size_t)n);
+        for (int i = 0; i < n; i++) ts.emplace_back(work, i);
+        for (auto& t : ts) t.join();
+    } else {
+        for (int i = 0; i < n; i++) work(i);
+    }
+    for (int i = 0; i < n; i++)
+        if (status[i] != 0) return -(i + 1);
+    return 0;
+}
+
+// Flush the partial-tail CRC of each level (valid flag per shard) and
+// report per-shard appended sizes. The sink stays usable only for
+// destroy after this.
+int sn_sink_finish(void* handle, uint32_t* tail_block_crc,
+                   uint8_t* tail_block_valid, uint32_t* tail_leaf_crc,
+                   uint8_t* tail_leaf_valid, uint64_t* sizes) {
+    SnSink* s = (SnSink*)handle;
+    int n = (int)s->fds.size();
+    for (int i = 0; i < n; i++) {
+        if (s->leaf_size) {
+            // partial block = folded completed leaves + partial leaf
+            tail_block_valid[i] = (s->bfill[i] || s->lfill[i]) ? 1 : 0;
+            tail_block_crc[i] =
+                sn_crc32c_combine(s->bcrc[i], s->lcrc[i], s->lfill[i]);
+        } else {
+            tail_block_valid[i] = s->bfill[i] ? 1 : 0;
+            tail_block_crc[i] = s->bcrc[i];
+        }
+        if (tail_leaf_valid) {
+            tail_leaf_valid[i] = (s->leaf_size && s->lfill[i]) ? 1 : 0;
+            tail_leaf_crc[i] = s->lcrc[i];
+        }
+        sizes[i] = s->off[i];
+        s->bfill[i] = 0;
+        s->bcrc[i] = 0;
+        s->lfill[i] = 0;
+        s->lcrc[i] = 0;
+    }
+    return 0;
+}
+
+void sn_sink_destroy(void* handle) {
+    delete (SnSink*)handle;
 }
 
 // ---------------------------------------------------------------------------

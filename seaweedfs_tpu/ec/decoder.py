@@ -12,6 +12,8 @@ import os
 import struct
 from typing import Iterator
 
+import numpy as np
+
 from .. import faults
 from ..utils import trace
 from ..storage.needle import footer_size
@@ -219,19 +221,23 @@ def write_dat_file(
             # to compute here (all k data shards are on disk; a missing
             # one is regenerated through the staged rebuild before
             # decode starts, see ec_decode_volume).
+            from . import native_io
             from .pipeline import run_staged_apply
 
             def produce():
+                # Zero-copy plane: each piece lands in a numpy buffer
+                # (native batched pread when available, preadv loop
+                # otherwise) and is handed to the writer as-is — no
+                # bytes objects, no b"".join of short-read fragments.
                 for fd, off, want in read_plan():
-                    parts = []
-                    pos = 0
-                    while pos < want:  # regular files may short-read at EOF
-                        got = os.pread(fd, want - pos, off + pos)
-                        if not got:
-                            raise ECError(f"short shard read at {off + pos}")
-                        parts.append(got)
-                        pos += len(got)
-                    yield None, parts[0] if len(parts) == 1 else b"".join(parts)
+                    buf = np.empty(want, dtype=np.uint8)
+                    try:
+                        native_io.read_exact_into(fd, buf, off)
+                    except OSError as e:
+                        raise ECError(
+                            f"short shard read at {off}: {e}"
+                        ) from e
+                    yield None, buf
 
             sp = trace.current()  # the ec.decode root, when armed
             run_staged_apply(

@@ -388,18 +388,33 @@ class EcVolume:
         k = self.ctx.data_shards
         sp = trace.current()  # the ec.degraded_read root, when armed
         sources: dict[int, np.ndarray] = {}
+        # Local sibling reads ride the native zero-copy plane when it's
+        # up (and no fault registry is armed — the chaos seams want
+        # bytes): each sibling's extent lands in a numpy buffer via one
+        # positioned native read instead of an os.pread bytes copy. The
+        # downstream stack/verify path takes either representation.
+        from . import native_io
+
+        use_native = native_io.enabled() and not faults.active()
         local = [(i, fd) for i, fd in self.shard_fds.items() if i != shard_id]
         for i, fd in local:
             try:
                 with trace.stage(sp, "sibling_read"):
-                    got = os.pread(fd, size, offset)
+                    if use_native:
+                        arr = np.empty(size, dtype=np.uint8)
+                        native_io.read_exact_into(fd, arr, offset)
+                        got = arr
+                    else:
+                        got = os.pread(fd, size, offset)
             except OSError:
                 continue
             self.bytes_read += len(got)
             if len(got) == size and (
                 source_ok is None or source_ok(i, got)
             ):
-                sources[i] = np.frombuffer(got, dtype=np.uint8)
+                sources[i] = (
+                    got if use_native else np.frombuffer(got, dtype=np.uint8)
+                )
                 if len(sources) == k:
                     break
         if len(sources) < k and self.remote_reader is not None:

@@ -127,20 +127,53 @@ def write_ec_files(
                 processed += small_row
                 remaining -= small_row
 
-        def produce():
+        def batch_plan():
+            """(row_offset, block_size, chunk_off, width) per batch."""
             for row_offset, block_size in chunk_plan():
                 batch = min(batch_size, block_size)
                 for chunk_off in range(0, block_size, batch):
-                    width = min(batch, block_size - chunk_off)
-                    with trace.stage(sp, "disk_read"):
+                    yield (
+                        row_offset, block_size, chunk_off,
+                        min(batch, block_size - chunk_off),
+                    )
+
+        # Native read source (ec/native_io.py): one GIL-releasing
+        # batched pread per batch straight into a pooled aligned matrix
+        # that flows read -> device -> sink untouched (the zero-copy
+        # plane), with the NEXT batch's extents readahead-hinted before
+        # this one reads. An armed fault registry or SEAWEED_EC_NATIVE=0
+        # keeps the bit-identical Python preadv loop.
+        from . import native_io
+
+        use_native = native_io.enabled() and not faults.active()
+        pool = native_io.BufferPool(k) if use_native else None
+
+        def produce():
+            plan = list(batch_plan())
+            for n_batch, (row_offset, block_size, chunk_off, width) in (
+                enumerate(plan)
+            ):
+                with trace.stage(sp, "disk_read"):
+                    offsets = [
+                        row_offset + i * block_size + chunk_off
+                        for i in range(k)
+                    ]
+                    if use_native:
+                        if n_batch + 1 < len(plan):
+                            nro, nbs, nco, nw = plan[n_batch + 1]
+                            for i in range(k):
+                                native_io.prefetch(
+                                    dat_fd, nro + i * nbs + nco, nw
+                                )
+                        data = pool.get(width)
+                        native_io.read_batch(
+                            [dat_fd] * k, offsets, data, pad_eof=True
+                        )
+                    else:
                         data = np.empty((k, width), dtype=np.uint8)
                         for i in range(k):
-                            _pread_padded(
-                                dat_fd,
-                                data[i],
-                                row_offset + i * block_size + chunk_off,
-                            )
-                    yield data
+                            _pread_padded(dat_fd, data[i], offsets[i])
+                yield data
 
         # Encode is SERVING traffic: it dispatches as a foreground
         # stream of the shared per-chip scheduler (ec/device_queue.py),
@@ -208,6 +241,10 @@ def write_ec_files(
                     stream.release(ticket)
             with trace.stage(sp, "write_sink"):
                 sink.append_rows([*data, *parity])
+            if pool is not None:
+                # the batch's bytes are on disk (or in the sink's write
+                # path) — its pooled matrix is free to carry batch N+2
+                pool.put(data)
 
         try:
             run_pipeline(

@@ -1,0 +1,157 @@
+"""Zero-copy native read plane for the EC byte path (ISSUE 10).
+
+The Python layer ORCHESTRATES buffers here instead of copying them:
+batches land via one GIL-releasing `sn_batch_pread` call per batch into
+caller-owned aligned numpy matrices that flow produce -> transform ->
+consume untouched (numpy views over one allocation — no `bytes`
+objects, no per-batch malloc/page-fault churn), then return to a small
+pool. The write half is the stateful native sink (utils/native.py
+NativeSink, used by pipeline.FusedShardSink).
+
+Buffer-ownership rules (README "Native data plane" has the long form):
+
+- A pooled matrix belongs to exactly one in-flight batch from the
+  moment `BufferPool.get` returns it until its release callback runs in
+  the consume stage. The pipeline's bounded queues cap in-flight
+  batches, and the pool is sized to that cap, so `get` never blocks on
+  the happy path.
+- Rows handed to the native sink must stay alive until the append call
+  returns (the C side pwrite(2)s straight from them; it stores no
+  pointers).
+- Pool matrices are 4096-aligned so the same buffers satisfy O_DIRECT
+  alignment when a caller opens shard fds with it (offsets and widths
+  must then also be 512/4096-multiples; the ragged tail batch is not,
+  which is why O_DIRECT stays an opt-in for aligned workloads).
+
+Fallback semantics: `enabled()` is False when the native core failed to
+import (no C++ toolchain — utils/native.py raises ImportError by
+contract) or when SEAWEED_EC_NATIVE=0 forces the pure-Python plane;
+callers must keep their Python source/sink paths as the bit-identical
+fallback. An ARMED fault registry also routes callers to the Python
+plane: byte-mutating fault points need materialized bytes at the
+read/write seams (see ec/rebuild.py).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import numpy as np
+
+_ALIGN = 4096
+
+
+def _native_mod():
+    try:
+        from ..utils import native
+
+        return native
+    except ImportError:
+        return None
+
+
+def enabled() -> bool:
+    """True when the native data plane should carry reads/writes:
+    the .so loaded and SEAWEED_EC_NATIVE != 0 (checked live so tests
+    and benches can flip the env per call)."""
+    if os.environ.get("SEAWEED_EC_NATIVE", "1") == "0":
+        return False
+    return _native_mod() is not None
+
+
+def aligned_matrix(rows: int, width: int, align: int = _ALIGN) -> np.ndarray:
+    """(rows, width) C-contiguous uint8 matrix whose base address is
+    `align`-aligned (over-allocate + offset; plain numpy, no custom
+    allocator to keep GC ownership trivial)."""
+    raw = np.empty(rows * width + align, dtype=np.uint8)
+    off = (-raw.ctypes.data) % align
+    return raw[off : off + rows * width].reshape(rows, width)
+
+
+class BufferPool:
+    """Reusable aligned (rows, width) matrices cycling through the
+    pipeline, free-listed by exact width (the encode plan yields at
+    most a few width classes: full batches, the small-block phase, and
+    ragged tails). Allocation happens on demand; the population is
+    naturally bounded by the pipeline's in-flight batch cap
+    (~2*queue_size + one per stage), so steady state is allocate-once,
+    reuse-forever — no per-batch malloc or page-fault churn. Release is
+    cooperative: the consume stage calls `put` when the batch's bytes
+    have been written; a batch dropped by an aborting pipeline simply
+    strands its matrix for the GC (the pool holds no global list)."""
+
+    def __init__(self, rows: int):
+        import threading as _t
+
+        self.rows = rows
+        self._free: dict[int, list[np.ndarray]] = {}
+        self._lock = _t.Lock()
+
+    def get(self, width: int) -> np.ndarray:
+        with self._lock:
+            lst = self._free.get(width)
+            if lst:
+                return lst.pop()
+        return aligned_matrix(self.rows, width)
+
+    def put(self, buf: np.ndarray) -> None:
+        with self._lock:
+            self._free.setdefault(buf.shape[1], []).append(buf)
+
+
+def read_batch(
+    fds: Sequence[int],
+    offsets: Sequence[int],
+    dst: np.ndarray,
+    *,
+    width: int | None = None,
+    pad_eof: bool = True,
+    granule: int = 0,
+    crc_state: np.ndarray | None = None,
+    filled_state: np.ndarray | None = None,
+    out_crcs: np.ndarray | None = None,
+    out_counts: np.ndarray | None = None,
+) -> None:
+    """One native batched positioned read into `dst` rows (see
+    utils/native.batch_pread for the contract). Caller must have
+    checked `enabled()`."""
+    native = _native_mod()
+    native.batch_pread(
+        list(fds),
+        list(offsets),
+        dst,
+        width=width,
+        pad_eof=pad_eof,
+        granule=granule,
+        crc_state=crc_state,
+        filled_state=filled_state,
+        out_crcs=out_crcs,
+        out_counts=out_counts,
+    )
+
+
+def read_exact_into(fd: int, buf: np.ndarray, offset: int) -> None:
+    """Fill 1-D `buf` from fd at offset; short read raises. Native
+    single-row read when available, preadv loop otherwise — same
+    in-place no-bytes contract either way."""
+    if enabled():
+        read_batch([fd], [offset], buf.reshape(1, -1), pad_eof=False)
+        return
+    mv = memoryview(buf)
+    filled = 0
+    want = len(buf)
+    while filled < want:
+        got = os.preadv(fd, [mv[filled:]], offset + filled)
+        if got == 0:
+            raise OSError(f"short read at offset {offset + filled}")
+        filled += got
+
+
+def prefetch(fd: int, offset: int, length: int) -> None:
+    """Best-effort readahead for the NEXT batch window: issued before
+    reading the current batch so the kernel pages in batch N+1 while
+    batch N computes and N-1 drains."""
+    native = _native_mod()
+    if native is not None and length > 0:
+        native.fadvise_willneed(fd, offset, length)

@@ -30,7 +30,6 @@ from ..utils import trace
 from .bitrot import (
     BitrotProtection,
     ShardChecksumBuilder,
-    fold_leaf_crcs,
 )
 from .context import BITROT_BLOCK_SIZE, ECContext, ECError
 
@@ -389,17 +388,27 @@ def run_staged_apply(
 
 
 class FusedShardSink:
-    """Write stage backed by the native fused append+CRC
-    (sn_shard_append): one GIL-releasing C++ call per batch, a worker
-    thread per shard, write(2) straight from the source buffers — no
-    tobytes()/slice copies. This is what closed the BENCH_r03 finding
-    that 87% of encode e2e wall time was host-side overhead (reference
-    equivalent: the single fused encode+CRC loop in
-    weed/storage/erasure_coding/ec_encoder.go).
+    """Write stage backed by the STATEFUL native sink (sn_sink_*): one
+    GIL-releasing C++ call per batch, a worker thread per shard,
+    pwrite(2) at internally-tracked offsets straight from the source
+    buffers — no tobytes()/slice copies, and the Python file objects'
+    positions are never moved. This is what closed the BENCH_r03
+    finding that 87% of encode e2e wall time was host-side overhead
+    (reference equivalent: the single fused encode+CRC loop in
+    weed/storage/erasure_coding/ec_encoder.go, and the native volume
+    server's byte path the reference grew for the same reason).
 
-    With `leaf_size` set, the native CRC rolls at LEAF granularity (the
-    v2 sidecar's sub-block level) and the block-level CRCs are folded
-    from the leaf CRCs via crc32c_combine — both levels from one pass.
+    With `leaf_size` set, BOTH sidecar CRC levels come out of ONE
+    cache-hot byte pass on the C++ side: leaves are byte-rolled and the
+    block level is folded from completed leaf CRCs via the cached
+    CRC-shift operator (sn_crc32c_combine) — no Python-side folding,
+    no second pass over the bytes.
+    `early_writeback` starts background writeback for each just-written
+    extent (sync_file_range) so the publish-time fsync drains an
+    already-flushing range instead of the whole file — a win on slow
+    disks with deep page caches, a loss on filesystems whose write(2)
+    is already synchronous (measured -15% on 9p), so it defaults to the
+    SEAWEED_EC_EARLY_WB env knob (off unless "1").
     """
 
     def __init__(
@@ -407,82 +416,96 @@ class FusedShardSink:
         files: list,
         block_size: int = BITROT_BLOCK_SIZE,
         leaf_size: int = 0,
+        early_writeback: bool | None = None,
     ):
+        import os as _os
+
         from ..utils import native
 
+        if early_writeback is None:
+            early_writeback = (
+                _os.environ.get("SEAWEED_EC_EARLY_WB", "0") == "1"
+            )
         if leaf_size and block_size % leaf_size != 0:
             raise ECError(
                 f"leaf size {leaf_size} does not divide block size {block_size}"
             )
-        self._native = native
         self.fds = [f.fileno() for f in files]
         n = len(files)
         self.block_size = block_size
         self.leaf_size = leaf_size
-        self.granule = leaf_size or block_size
-        self.crc_state = np.zeros(n, np.uint32)
-        self.filled = np.zeros(n, np.uint64)
+        self._sink = native.NativeSink(
+            self.fds, block_size, leaf_size, early_writeback=early_writeback
+        )
         self.crcs: list[list[int]] = [[] for _ in range(n)]
+        self._leaf_crcs: list[list[int]] = [[] for _ in range(n)]
         self.sizes = [0] * n
-        self._out_counts = np.empty(n, np.int32)
-        self._out_crcs: np.ndarray | None = None
+        self._out: tuple | None = None
         self._finished = False
 
     def append_rows(self, rows: Sequence[np.ndarray]) -> None:
         """Append one equal-width batch to every shard stream; rows[i]
         goes to fds[i]. Rows must be 1-D C-contiguous uint8 (row views
-        of a contiguous matrix qualify — no copies are made)."""
-        if len(rows) != len(self.fds):
-            raise ECError(f"expected {len(self.fds)} rows, got {len(rows)}")
+        of a contiguous matrix qualify — no copies are made), and must
+        stay alive until this call returns (the C side writes straight
+        from them)."""
+        n = len(self.fds)
+        if len(rows) != n:
+            raise ECError(f"expected {n} rows, got {len(rows)}")
+        if self._finished:
+            raise ECError("shard sink already finished")
         width = len(rows[0])
         if any(len(r) != width for r in rows):
             raise ECError("shard sink rows have unequal widths")
-        max_out = width // self.granule + 2
-        if self._out_crcs is None or self._out_crcs.shape[1] < max_out:
-            self._out_crcs = np.empty((len(self.fds), max_out), np.uint32)
+        granule = self.leaf_size or self.block_size
+        max_out = width // granule + 2
+        out = self._out
+        if out is None or out[0].shape[1] < max_out:
+            out = (
+                np.empty((n, max_out), np.uint32),  # block crcs
+                np.empty(n, np.int32),
+                np.empty((n, max_out), np.uint32),  # leaf crcs
+                np.empty(n, np.int32),
+            )
+            self._out = out
         ptrs = []
         for r in rows:
             if not (r.flags.c_contiguous and r.dtype == np.uint8):
                 raise ECError("shard sink rows must be contiguous uint8")
             ptrs.append(r.ctypes.data)
-        self._native.shard_append(
-            self.fds,
-            ptrs,
-            width,
-            self.granule,
-            self.crc_state,
-            self.filled,
-            self._out_crcs,
-            self._out_counts,
-        )
-        for i in range(len(self.fds)):
-            c = int(self._out_counts[i])
+        obc, obn, olc, oln = out
+        # overflow (count -1) cannot reach here: the C side flags the
+        # shard failed and NativeSink.append raises OSError first
+        self._sink.append(ptrs, width, obc, obn, olc, oln)
+        for i in range(n):
+            c = int(obn[i])
             if c:
-                self.crcs[i].extend(int(x) for x in self._out_crcs[i, :c])
+                self.crcs[i].extend(int(x) for x in obc[i, :c])
+            if self.leaf_size:
+                c = int(oln[i])
+                if c:
+                    self._leaf_crcs[i].extend(int(x) for x in olc[i, :c])
             self.sizes[i] += width
 
     def _finish(self) -> None:
         if self._finished:
             return
         self._finished = True
+        tb, tbv, tl, tlv, _sizes = self._sink.finish()
         for i in range(len(self.fds)):
-            if self.filled[i]:
-                self.crcs[i].append(int(self.crc_state[i]))
-                self.filled[i] = 0
-                self.crc_state[i] = 0
+            if tbv[i]:
+                self.crcs[i].append(int(tb[i]))
+            if self.leaf_size and tlv[i]:
+                self._leaf_crcs[i].append(int(tl[i]))
+        self._sink.destroy()
 
     def block_crcs(self) -> list[list[int]]:
         self._finish()
-        if not self.leaf_size:
-            return [list(c) for c in self.crcs]
-        return [
-            fold_leaf_crcs(c, total, self.leaf_size, self.block_size)
-            for c, total in zip(self.crcs, self.sizes)
-        ]
+        return [list(c) for c in self.crcs]
 
     def leaf_crcs(self) -> list[list[int]]:
         self._finish()
-        return [list(c) for c in self.crcs] if self.leaf_size else []
+        return [list(c) for c in self._leaf_crcs] if self.leaf_size else []
 
     def to_protection(self, ctx: ECContext) -> BitrotProtection:
         import uuid as _uuid
@@ -549,8 +572,11 @@ def make_shard_sink(
     leaf_size: int = 0,
     prefer_fused: bool = True,
 ) -> FusedShardSink | PyShardSink:
-    """Fused native sink when the .so is available, Python otherwise."""
-    if prefer_fused:
+    """Fused native sink when the .so is available (and the native
+    plane isn't disabled via SEAWEED_EC_NATIVE=0), Python otherwise."""
+    from . import native_io
+
+    if prefer_fused and native_io.enabled():
         try:
             return FusedShardSink(files, block_size, leaf_size)
         except Exception:

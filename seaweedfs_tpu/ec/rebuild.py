@@ -363,7 +363,7 @@ def _attempt_rebuild(
     # only adds a copy.
     outs = {i: open(p, "wb", buffering=0) for i, p in tmp_paths.items()}
     crc_block = prot.block_size if prot is not None else BITROT_BLOCK_SIZE
-    # The fused native sink (shard_append) rolls the sidecar-granularity
+    # The fused native sink (sn_sink_append) rolls the sidecar-granularity
     # CRC while the reconstructed bytes are cache-hot and writes straight
     # from the backend's output buffers — no per-batch tobytes(). A
     # byte-mutating fault needs materialized bytes, so an armed registry
@@ -372,9 +372,24 @@ def _attempt_rebuild(
         list(outs.values()), block_size=crc_block, prefer_fused=not chaos
     )
     use_bytes_path = isinstance(sink, PyShardSink)
-    rollers = (
-        {i: _BlockCrcRoller(crc_block) for i in src} if inline_verify else None
-    )
+    # Native read plane (ec/native_io.py): the k source rows land via
+    # one batched pread per batch, and the inline source verification
+    # CRC rolls on the C++ side in the same cache-hot pass — the Python
+    # _BlockCrcRoller stays as the bit-identical fallback (and the
+    # chaos path keeps its byte seams below).
+    from . import native_io
+
+    use_native = not chaos and native_io.enabled()
+    rollers = None
+    ncrc_state = ncrc_filled = None
+    ncrc_lists: list[list[int]] | None = None
+    if inline_verify:
+        if use_native:
+            ncrc_state = np.zeros(k, np.uint32)
+            ncrc_filled = np.zeros(k, np.uint64)
+            ncrc_lists = [[] for _ in range(k)]
+        else:
+            rollers = {i: _BlockCrcRoller(crc_block) for i in src}
 
     if chaos:
         # PR1-faithful byte path: per-shard pread -> fault mutate ->
@@ -429,16 +444,47 @@ def _attempt_rebuild(
         coeffs = _decode_coeffs(rs.matrix, k, tuple(targets), tuple(src))
 
         def produce():
+            src_fds = [fds[i] for i in src]
+            out_crcs = out_counts = None
+            if ncrc_lists is not None:
+                out_crcs = np.empty(
+                    (k, batch_size // crc_block + 2), np.uint32
+                )
+                out_counts = np.empty(k, np.int32)
             for off in range(0, shard_size, batch_size):
                 width = min(batch_size, shard_size - off)
                 buf = np.empty((k, width), dtype=np.uint8)
-                for row, i in enumerate(src):
+                if use_native:
+                    nxt = off + width
+                    if nxt < shard_size:
+                        nw = min(batch_size, shard_size - nxt)
+                        for fd in src_fds:
+                            native_io.prefetch(fd, nxt, nw)
                     try:
-                        _pread_exact(fds[i], buf[row], off)
+                        native_io.read_batch(
+                            src_fds, [off] * k, buf, pad_eof=False,
+                            granule=crc_block if ncrc_lists is not None else 0,
+                            crc_state=ncrc_state, filled_state=ncrc_filled,
+                            out_crcs=out_crcs, out_counts=out_counts,
+                        )
                     except OSError as e:
-                        raise _SourceReadError([i]) from e
-                    if rollers is not None:
-                        rollers[i].update(buf[row])
+                        raise _SourceReadError(
+                            [src[getattr(e, "sn_row", 0)]]
+                        ) from e
+                    if ncrc_lists is not None:
+                        for row in range(k):
+                            c = int(out_counts[row])
+                            ncrc_lists[row].extend(
+                                int(x) for x in out_crcs[row, :c]
+                            )
+                else:
+                    for row, i in enumerate(src):
+                        try:
+                            _pread_exact(fds[i], buf[row], off)
+                        except OSError as e:
+                            raise _SourceReadError([i]) from e
+                        if rollers is not None:
+                            rollers[i].update(buf[row])
                 yield off, buf
 
         def transform(item):
@@ -536,10 +582,17 @@ def _attempt_rebuild(
             os.close(fd)
 
     # --- inline source verification verdict (fast path) -------------------
-    if rollers is not None:
-        suspects = [
-            i for i in src if rollers[i].finish() != prot.shard_crcs[i]
-        ]
+    if rollers is not None or ncrc_lists is not None:
+        if ncrc_lists is not None:
+            # flush partial-tail CRC state (the native roller's finish)
+            for row in range(k):
+                if ncrc_filled[row]:
+                    ncrc_lists[row].append(int(ncrc_state[row]))
+                    ncrc_filled[row] = 0
+            got = {i: ncrc_lists[row] for row, i in enumerate(src)}
+        else:
+            got = {i: rollers[i].finish() for i in src}
+        suspects = [i for i in src if got[i] != prot.shard_crcs[i]]
         if verified_ok is not None:
             # the inline roller IS the block-CRC check _verify_full
             # performs — a retry after an exclusion must not re-read

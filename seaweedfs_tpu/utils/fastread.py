@@ -28,14 +28,24 @@ class FastReadError(Exception):
 
 
 def _load_lib():
+    # Same load contract as utils/native.py: a missing toolchain or a
+    # bad .so surfaces as ImportError so callers' documented
+    # `except ImportError` fallback (HTTP-only data plane) engages,
+    # instead of a CalledProcessError escaping at first use.
     so = os.path.abspath(os.path.join(_NATIVE_DIR, _SO_NAME))
-    if not os.path.exists(so):
-        subprocess.run(
-            ["make", "-C", os.path.abspath(_NATIVE_DIR), _SO_NAME],
-            check=True,
-            capture_output=True,
-        )
-    lib = ctypes.CDLL(so)
+    try:
+        if not os.path.exists(so):
+            subprocess.run(
+                ["make", "-C", os.path.abspath(_NATIVE_DIR), _SO_NAME],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(so)
+    except (OSError, subprocess.CalledProcessError) as e:
+        raise ImportError(
+            f"fastread native core unavailable (build or load of {so} "
+            f"failed): {e}"
+        ) from e
     lib.sn_fastread_serve.restype = ctypes.c_int
     lib.sn_fastread_serve.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
     return lib

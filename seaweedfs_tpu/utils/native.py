@@ -8,14 +8,20 @@ the native core is an accelerator, not a dependency.
 from __future__ import annotations
 
 import ctypes
+import glob as _glob
 import os
 import subprocess
 
 import numpy as np
 
-_NATIVE_DIR = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-    "native",
+_NATIVE_DIR = os.environ.get(
+    "SEAWEED_NATIVE_DIR",
+    os.path.join(
+        os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        ),
+        "native",
+    ),
 )
 _SO_PATH = os.path.join(_NATIVE_DIR, "libseaweed_native.so")
 
@@ -29,21 +35,39 @@ def _build() -> None:
 def _stale() -> bool:
     """Rebuild when sources are newer than the .so — a stale library
     missing newly-added symbols would otherwise fail the whole module
-    import and silently disable ALL native acceleration."""
+    import and silently disable ALL native acceleration. The source set
+    is derived from the directory (every .cpp/.h plus the Makefile), not
+    a hardcoded list, so adding a source file triggers rebuilds too."""
     if not os.path.exists(_SO_PATH):
         return True
     so_mtime = os.path.getmtime(_SO_PATH)
-    for src in ("seaweed_native.cpp", "Makefile"):
-        p = os.path.join(_NATIVE_DIR, src)
+    sources = [os.path.join(_NATIVE_DIR, "Makefile")]
+    for pat in ("*.cpp", "*.cc", "*.h", "*.hpp"):
+        sources.extend(_glob.glob(os.path.join(_NATIVE_DIR, pat)))
+    for p in sources:
         if os.path.exists(p) and os.path.getmtime(p) > so_mtime:
             return True
     return False
 
 
-if _stale():
-    _build()
-
-_lib = ctypes.CDLL(_SO_PATH)
+# Load contract: every caller is documented to tolerate ImportError and
+# fall back to pure Python. A missing C++ toolchain surfaces as
+# subprocess.CalledProcessError from make, a bad .so as OSError from
+# CDLL — both would otherwise escape import and crash callers that
+# correctly guard with `except ImportError`. Wrap them so the fallback
+# actually engages; the original failure rides along as __cause__.
+try:
+    if _stale():
+        _build()
+    _lib = ctypes.CDLL(_SO_PATH)
+except (OSError, subprocess.CalledProcessError) as e:
+    detail = e
+    if isinstance(e, subprocess.CalledProcessError) and e.stderr:
+        detail = e.stderr.decode(errors="replace")[-500:]
+    raise ImportError(
+        f"native core unavailable (build or load of {_SO_PATH} failed): "
+        f"{detail}"
+    ) from e
 
 _lib.sn_crc32c.restype = ctypes.c_uint32
 _lib.sn_crc32c.argtypes = [ctypes.c_uint32, ctypes.c_void_p, ctypes.c_size_t]
@@ -81,6 +105,60 @@ _lib.sn_shard_append.argtypes = [
     ctypes.c_void_p,
     ctypes.c_int32,
 ]
+_lib.sn_batch_pread.restype = ctypes.c_int
+_lib.sn_batch_pread.argtypes = [
+    ctypes.POINTER(ctypes.c_int),     # fds
+    ctypes.POINTER(ctypes.c_uint64),  # offsets
+    ctypes.c_int,                     # nrows
+    ctypes.c_void_p,                  # dst
+    ctypes.c_size_t,                  # width
+    ctypes.c_size_t,                  # stride
+    ctypes.c_int,                     # pad_eof
+    ctypes.c_uint32,                  # granule
+    ctypes.c_void_p,                  # crc_state
+    ctypes.c_void_p,                  # filled_state
+    ctypes.c_void_p,                  # out_crcs
+    ctypes.c_void_p,                  # out_counts
+    ctypes.c_int32,                   # max_out
+]
+_lib.sn_fadvise_willneed.restype = ctypes.c_int
+_lib.sn_fadvise_willneed.argtypes = [
+    ctypes.c_int, ctypes.c_uint64, ctypes.c_uint64,
+]
+_lib.sn_crc32c_combine.restype = ctypes.c_uint32
+_lib.sn_crc32c_combine.argtypes = [
+    ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint64,
+]
+_lib.sn_sink_create.restype = ctypes.c_void_p
+_lib.sn_sink_create.argtypes = [
+    ctypes.POINTER(ctypes.c_int),
+    ctypes.c_int,
+    ctypes.c_uint32,
+    ctypes.c_uint32,
+    ctypes.c_uint32,
+]
+_lib.sn_sink_append.restype = ctypes.c_int
+_lib.sn_sink_append.argtypes = [
+    ctypes.c_void_p,                   # handle
+    ctypes.POINTER(ctypes.c_void_p),   # rows
+    ctypes.c_size_t,                   # width
+    ctypes.c_void_p,                   # out_block_crcs
+    ctypes.c_void_p,                   # out_block_counts
+    ctypes.c_void_p,                   # out_leaf_crcs
+    ctypes.c_void_p,                   # out_leaf_counts
+    ctypes.c_int32,                    # max_out
+]
+_lib.sn_sink_finish.restype = ctypes.c_int
+_lib.sn_sink_finish.argtypes = [
+    ctypes.c_void_p,
+    ctypes.c_void_p,  # tail_block_crc (u32[n])
+    ctypes.c_void_p,  # tail_block_valid (u8[n])
+    ctypes.c_void_p,  # tail_leaf_crc (u32[n])
+    ctypes.c_void_p,  # tail_leaf_valid (u8[n])
+    ctypes.c_void_p,  # sizes (u64[n])
+]
+_lib.sn_sink_destroy.restype = None
+_lib.sn_sink_destroy.argtypes = [ctypes.c_void_p]
 _lib.sn_has_avx2.restype = ctypes.c_int
 _lib.sn_scan_dat.restype = ctypes.c_int64
 _lib.sn_scan_dat.argtypes = [
@@ -186,6 +264,183 @@ def shard_append(
     )
     if rc != 0:
         raise OSError(f"sn_shard_append failed on shard {-rc - 1}")
+
+
+def batch_pread(
+    fds: list[int],
+    offsets: list[int],
+    dst: np.ndarray,
+    *,
+    width: int | None = None,
+    pad_eof: bool = True,
+    granule: int = 0,
+    crc_state: np.ndarray | None = None,
+    filled_state: np.ndarray | None = None,
+    out_crcs: np.ndarray | None = None,
+    out_counts: np.ndarray | None = None,
+) -> None:
+    """Fill row i of `dst` (2-D C-contiguous uint8, or 1-D for n=1) with
+    `width` bytes read from fds[i] at offsets[i] — one GIL-releasing
+    call, a worker thread per row, no intermediate bytes objects.
+
+    `dst` is CALLER-OWNED: rows land in place (the buffer-protocol /
+    numpy-view contract of the zero-copy plane). `width` defaults to the
+    full row; a narrower width fills a left-aligned slice of each row
+    (the pool-backed ragged tail), leaving the remainder untouched.
+    pad_eof zero-fills past EOF (encode semantics); pad_eof=False raises
+    OSError on any short row (rebuild semantics).
+
+    With granule > 0, each row's rolling CRC32C state
+    (crc_state u32[n] / filled_state u64[n], persisting across calls)
+    advances over the bytes read, completed granule CRCs landing in
+    out_crcs (u32[n, max_out]) with counts in out_counts (i32[n]) — the
+    fused read+verify used by the rebuild source path.
+    """
+    n = len(fds)
+    assert len(offsets) == n
+    if dst.ndim == 1:
+        dst = dst.reshape(1, -1)
+    assert dst.dtype == np.uint8 and dst.flags.c_contiguous
+    assert dst.shape[0] == n
+    stride = dst.shape[1]
+    if width is None:
+        width = stride
+    assert 0 < width <= stride
+    max_out = 0
+    if granule:
+        assert crc_state is not None and filled_state is not None
+        assert out_crcs is not None and out_counts is not None
+        assert crc_state.dtype == np.uint32
+        assert filled_state.dtype == np.uint64
+        assert out_crcs.dtype == np.uint32 and out_crcs.flags.c_contiguous
+        assert out_counts.dtype == np.int32
+        max_out = out_crcs.shape[1]
+    rc = _lib.sn_batch_pread(
+        (ctypes.c_int * n)(*fds),
+        (ctypes.c_uint64 * n)(*offsets),
+        n,
+        ctypes.c_void_p(dst.ctypes.data),
+        width,
+        stride,
+        1 if pad_eof else 0,
+        granule,
+        ctypes.c_void_p(crc_state.ctypes.data) if granule else None,
+        ctypes.c_void_p(filled_state.ctypes.data) if granule else None,
+        ctypes.c_void_p(out_crcs.ctypes.data) if granule else None,
+        ctypes.c_void_p(out_counts.ctypes.data) if granule else None,
+        max_out,
+    )
+    if rc != 0:
+        err = OSError(
+            f"sn_batch_pread failed on row {-rc - 1} "
+            f"(fd {fds[-rc - 1]} offset {offsets[-rc - 1]})"
+        )
+        err.sn_row = -rc - 1  # callers map the row back to a shard id
+        raise err
+
+
+def fadvise_willneed(fd: int, offset: int, length: int) -> None:
+    """Best-effort readahead hint (errors ignored — a filesystem that
+    rejects the advice just loses the prefetch)."""
+    try:
+        _lib.sn_fadvise_willneed(fd, offset, length)
+    except Exception:  # pragma: no cover - defensive
+        pass
+
+
+class NativeSink:
+    """Stateful fused write+CRC sink handle (sn_sink_*): pwrite-
+    positioned appends straight from caller buffers, leaf AND block
+    sidecar CRC levels rolled in the same cache-hot pass, optional
+    early-writeback. Callers own the fds (and their lifetime: destroy
+    the sink BEFORE closing them); the sink owns only its offsets and
+    CRC state."""
+
+    EARLY_WB = 1
+
+    def __init__(
+        self,
+        fds: list[int],
+        block_size: int,
+        leaf_size: int = 0,
+        # Off by default: sync_file_range measured -15% on filesystems
+        # whose write(2) is already synchronous (9p); the env-gated
+        # policy lives in pipeline.FusedShardSink.
+        early_writeback: bool = False,
+    ):
+        n = len(fds)
+        self.n = n
+        self.block_size = block_size
+        self.leaf_size = leaf_size
+        flags = self.EARLY_WB if early_writeback else 0
+        self._h = _lib.sn_sink_create(
+            (ctypes.c_int * n)(*fds), n, block_size, leaf_size, flags
+        )
+        if not self._h:
+            raise OSError("sn_sink_create failed (bad block/leaf sizes?)")
+
+    def append(
+        self,
+        row_ptrs: list[int],
+        width: int,
+        out_block_crcs: np.ndarray,
+        out_block_counts: np.ndarray,
+        out_leaf_crcs: np.ndarray,
+        out_leaf_counts: np.ndarray,
+    ) -> None:
+        if self._h is None:
+            raise OSError("sink already destroyed")
+        assert len(row_ptrs) == self.n
+        rc = _lib.sn_sink_append(
+            self._h,
+            (ctypes.c_void_p * self.n)(*row_ptrs),
+            width,
+            ctypes.c_void_p(out_block_crcs.ctypes.data),
+            ctypes.c_void_p(out_block_counts.ctypes.data),
+            ctypes.c_void_p(out_leaf_crcs.ctypes.data),
+            ctypes.c_void_p(out_leaf_counts.ctypes.data),
+            out_block_crcs.shape[1],
+        )
+        if rc != 0:
+            raise OSError(f"sn_sink_append failed on shard {-rc - 1}")
+
+    def finish(self) -> tuple:
+        """-> (tail_block_crc, tail_block_valid, tail_leaf_crc,
+        tail_leaf_valid, sizes) arrays; flushes partial-tail CRC state."""
+        if self._h is None:
+            raise OSError("sink already destroyed")
+        n = self.n
+        tb = np.zeros(n, np.uint32)
+        tbv = np.zeros(n, np.uint8)
+        tl = np.zeros(n, np.uint32)
+        tlv = np.zeros(n, np.uint8)
+        sizes = np.zeros(n, np.uint64)
+        _lib.sn_sink_finish(
+            self._h,
+            ctypes.c_void_p(tb.ctypes.data),
+            ctypes.c_void_p(tbv.ctypes.data),
+            ctypes.c_void_p(tl.ctypes.data),
+            ctypes.c_void_p(tlv.ctypes.data),
+            ctypes.c_void_p(sizes.ctypes.data),
+        )
+        return tb, tbv, tl, tlv, sizes
+
+    def destroy(self) -> None:
+        if self._h is not None:
+            _lib.sn_sink_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover - GC backstop
+        try:
+            self.destroy()
+        except Exception:
+            pass
+
+
+def crc32c_combine(crc1: int, crc2: int, len2: int) -> int:
+    """CRC of A++B from crc(A), crc(B), len(B) — the C twin of
+    utils/crc.crc32c_combine (used by the sink's leaf->block fold)."""
+    return _lib.sn_crc32c_combine(crc1, crc2, len2)
 
 
 def gf_mul(a: int, b: int) -> int:
