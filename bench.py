@@ -858,22 +858,96 @@ def _bench_free_port() -> int:
     return port
 
 
-def _gateway_bench(
-    workdir: str,
-    clients: int = 8,
-    reads_per_client: int = 25,
-    obj_bytes: int = 256 << 10,
+def _gateway_client_phase(
+    base: str,
+    data: bytes,
+    clients: int,
+    reads_per_client: int,
 ) -> dict:
-    """ISSUE 9 / ROADMAP direction 5 seed metric: p50/p99 S3 GET
-    latency under `clients` concurrent clients against a DEGRADED EC
-    volume (one shard unmounted, so every read of its stripe runs a
-    verified RS reconstruction) — a real in-process cluster (master +
-    volume + S3 gateway over real HTTP/gRPC on ephemeral ports), real
-    SigV4-less GETs, every payload byte-checked. The number direction
-    5's serving work is judged by; published in BENCH json as
-    gateway_degraded_get_{p50,p99}_ms."""
+    """Fire `clients` concurrent keep-alive sessions, each doing
+    `reads_per_client` byte-verified GETs; a threading.Barrier aligns
+    the first wave so cold-cache misses genuinely collide. 503s are
+    counted separately (clean backpressure, not corruption)."""
     import threading
 
+    import requests as _rq
+
+    lat_lock = threading.Lock()
+    latencies: list[float] = []
+    errors = [0]
+    rejected = [0]
+    barrier = threading.Barrier(clients)
+
+    def client() -> None:
+        sess = _rq.Session()
+        try:
+            barrier.wait(timeout=30)
+        except threading.BrokenBarrierError:
+            pass
+        for _ in range(reads_per_client):
+            t0 = time.perf_counter()
+            try:
+                rr = sess.get(f"{base}/bench/obj", timeout=120)
+                if rr.status_code == 503:
+                    with lat_lock:
+                        rejected[0] += 1
+                    continue
+                ok = rr.status_code == 200 and rr.content == data
+            except Exception:
+                ok = False
+            dt = time.perf_counter() - t0
+            with lat_lock:
+                if ok:
+                    latencies.append(dt)
+                else:
+                    errors[0] += 1
+
+    threads = [threading.Thread(target=client) for _ in range(clients)]
+    t_all = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_all
+    if not latencies:
+        return {"error": "no successful GETs", "errors": errors[0]}
+    lat_ms = np.array(sorted(latencies)) * 1e3
+    return {
+        "p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+        "p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
+        "mean_ms": round(float(lat_ms.mean()), 2),
+        "requests": len(latencies),
+        "errors": errors[0],
+        "rejected_503": rejected[0],
+        "gets_per_s": round(len(latencies) / wall, 1),
+    }
+
+
+def _gateway_bench(
+    workdir: str,
+    clients: int = 100,
+    reads_per_client: int = 5,
+    naive_reads_per_client: int = 2,
+    obj_bytes: int = 256 << 10,
+) -> dict:
+    """ISSUE 11 headline: p50/p99 S3 GET latency under `clients` (>=100)
+    concurrent clients against a DEGRADED EC volume (one shard
+    unmounted) over a real in-process cluster — real HTTP/gRPC on
+    ephemeral ports, every payload byte-checked. TWO configurations in
+    the same run:
+
+    - NAIVE (the PR 9 baseline shape): unbounded one-thread-per-
+      connection S3 front end, hot caches DISABLED (capacity 0 = no
+      storage, no singleflight) — every GET pays the full
+      reconstruction miss path;
+    - TUNED: bounded worker-pool front ends + the tiered hot-chunk
+      cache with singleflight collapse (first wave of misses collides
+      on purpose via a start barrier and must collapse to one load per
+      chunk, proven by the emitted singleflight counter).
+
+    Published as gateway_degraded_get_{p50,p99,mean}_ms (tuned, the
+    trended headline), gateway_naive_* (same-run baseline), and the
+    gateway_singleflight_waits / gateway_hot_cache_* evidence."""
     import requests as _rq
 
     from seaweedfs_tpu.filer import Filer, MemoryStore
@@ -900,7 +974,7 @@ def _gateway_bench(
         ec_backend="cpu",
     )
     vs.start()
-    filer = srv = env = None
+    filer = srv = srv_naive = env = None
     try:
         deadline = time.time() + 20
         while not master.topo.nodes:
@@ -911,9 +985,17 @@ def _gateway_bench(
             MemoryStore(), master=f"localhost:{mport}",
             chunk_size=64 * 1024,
         )
+        # tuned front end: bounded worker pool (the production shape)
         srv = S3Server(filer, ip="localhost", port=_bench_free_port())
         srv.start()
+        # naive front end: the unbounded ThreadingHTTPServer baseline,
+        # same filer/volume underneath
+        srv_naive = S3Server(
+            filer, ip="localhost", port=_bench_free_port(), http_workers=0
+        )
+        srv_naive.start()
         base = f"http://localhost:{srv.port}"
+        base_naive = f"http://localhost:{srv_naive.port}"
         rng = np.random.default_rng(0x6A7E)
         data = rng.integers(0, 256, obj_bytes, dtype=np.uint8).tobytes()
         assert _rq.put(f"{base}/bench").status_code == 200
@@ -938,66 +1020,97 @@ def _gateway_bench(
             _brpc.volume_stub(ch).VolumeEcShardsUnmount(
                 _cpb.EcShardsUnmountRequest(volume_id=vid, shard_ids=[0])
             )
-        # warmup (chunk-cache admission + first reconstruction) — the
-        # measured run below still reconstructs: the filer chunk cache
-        # is shared, so drop it to keep every request on the data plane
-        r = _rq.get(f"{base}/bench/obj", timeout=60)
+        r = _rq.get(f"{base}/bench/obj", timeout=120)
         if r.status_code != 200 or r.content != data:
             raise RuntimeError(
                 f"warmup degraded GET failed: {r.status_code}"
             )
 
-        lat_lock = threading.Lock()
-        latencies: list[float] = []
-        errors = [0]
+        chunk_cache = filer.chunk_cache
+        interval_cache = vs.store.ec_interval_cache
+        tuned_caps = (
+            chunk_cache.capacity,
+            interval_cache.capacity if interval_cache is not None else 0,
+        )
 
-        def client(seed: int) -> None:
-            sess = _rq.Session()
-            for i in range(reads_per_client):
-                filer.chunk_cache.clear()
-                t0 = time.perf_counter()
-                try:
-                    rr = sess.get(f"{base}/bench/obj", timeout=60)
-                    ok = rr.status_code == 200 and rr.content == data
-                except Exception:
-                    ok = False
-                dt = time.perf_counter() - t0
-                with lat_lock:
-                    if ok:
-                        latencies.append(dt)
-                    else:
-                        errors[0] += 1
+        def set_caches(enabled: bool) -> None:
+            chunk_cache.capacity = tuned_caps[0] if enabled else 0
+            chunk_cache.clear()
+            if interval_cache is not None:
+                interval_cache.capacity = tuned_caps[1] if enabled else 0
+                interval_cache.clear()
 
-        threads = [
-            threading.Thread(target=client, args=(i,)) for i in range(clients)
-        ]
-        t_all = time.perf_counter()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        wall = time.perf_counter() - t_all
-        if not latencies:
-            return {"gateway_error": "no successful GETs"}
-        lat_ms = np.array(sorted(latencies)) * 1e3
-        return {
-            "gateway_degraded_get_p50_ms": round(
-                float(np.percentile(lat_ms, 50)), 2
-            ),
-            "gateway_degraded_get_p99_ms": round(
-                float(np.percentile(lat_ms, 99)), 2
-            ),
-            "gateway_degraded_get_mean_ms": round(float(lat_ms.mean()), 2),
+        # ---- NAIVE: caches off (capacity 0 = pass-through, no
+        # singleflight), unbounded-thread front end — the miss path the
+        # tiered cache exists to kill. Fewer reads per client: every
+        # one pays a reconstruction.
+        set_caches(False)
+        naive = _gateway_client_phase(
+            base_naive, data, clients, naive_reads_per_client
+        )
+
+        # ---- TUNED: caches restored and dropped ONCE, so the barrier-
+        # aligned first wave is `clients` concurrent misses that must
+        # singleflight-collapse; the rest ride the hot tier.
+        set_caches(True)
+        sf_before = (
+            chunk_cache.singleflight_waits
+            + (interval_cache.singleflight_waits if interval_cache else 0)
+        )
+        loads_before = chunk_cache.loads
+        hits_before = chunk_cache.hits
+        tuned = _gateway_client_phase(base, data, clients, reads_per_client)
+        sf_waits = (
+            chunk_cache.singleflight_waits
+            + (interval_cache.singleflight_waits if interval_cache else 0)
+            - sf_before
+        )
+        if "error" in tuned:
+            return {"gateway_error": tuned["error"]}
+        out = {
+            "gateway_degraded_get_p50_ms": tuned["p50_ms"],
+            "gateway_degraded_get_p99_ms": tuned["p99_ms"],
+            "gateway_degraded_get_mean_ms": tuned["mean_ms"],
             "gateway_clients": clients,
-            "gateway_requests": len(latencies),
-            "gateway_errors": errors[0],
+            "gateway_requests": tuned["requests"],
+            "gateway_errors": tuned["errors"],
+            "gateway_rejected_503": tuned["rejected_503"],
             "gateway_object_kb": obj_bytes >> 10,
-            "gateway_gets_per_s": round(len(latencies) / wall, 1),
+            "gateway_gets_per_s": tuned["gets_per_s"],
+            # singleflight proof: the first wave's concurrent misses
+            # joined in-flight loads instead of re-running them; the
+            # chunk-load count stays ~#chunks, not #clients x #chunks
+            "gateway_singleflight_waits": int(sf_waits),
+            "gateway_hot_cache_loads": int(
+                chunk_cache.loads - loads_before
+            ),
+            "gateway_hot_cache_hits": int(chunk_cache.hits - hits_before),
+            "gateway_front_end": getattr(
+                srv._http, "pool_status", lambda: {"kind": "threading"}
+            )(),
         }
+        if "error" not in naive:
+            out.update(
+                {
+                    "gateway_naive_p50_ms": naive["p50_ms"],
+                    "gateway_naive_p99_ms": naive["p99_ms"],
+                    "gateway_naive_mean_ms": naive["mean_ms"],
+                    "gateway_naive_gets_per_s": naive["gets_per_s"],
+                    "gateway_naive_errors": naive["errors"],
+                    "gateway_naive_requests": naive["requests"],
+                    "gateway_p99_speedup_vs_naive": round(
+                        naive["p99_ms"] / max(tuned["p99_ms"], 1e-9), 2
+                    ),
+                }
+            )
+        else:
+            out["gateway_naive_error"] = naive["error"]
+        return out
     finally:
         for closer in (
             (lambda: env.close()) if env is not None else None,
             (lambda: srv.stop()) if srv is not None else None,
+            (lambda: srv_naive.stop()) if srv_naive is not None else None,
             (lambda: filer.close()) if filer is not None else None,
             vs.stop,
             master.stop,
@@ -2138,6 +2251,98 @@ def _self_check() -> int:
             and q2.load() == 0,
             f"{st2}",
         )
+
+        # ---- hot-cache bit-identity (ISSUE 11): the same degraded
+        # read with the cache ENABLED vs DISABLED returns identical
+        # bytes (and a cache HIT equals the read that populated it) ---
+        from seaweedfs_tpu.ec import EcVolume, ec_encode_volume
+        from seaweedfs_tpu.storage.needle import Needle
+        from seaweedfs_tpu.storage.volume import Volume
+
+        cctx = ECContext(4, 2)
+        cdir = os.path.join(workdir, "cachebit")
+        os.makedirs(cdir)
+        cvol = Volume(cdir, 1)
+        crng = np.random.default_rng(0xCACE)
+        cpayloads = {}
+        for i in range(1, 9):
+            dd = crng.integers(0, 256, 20_000, dtype=np.uint8).tobytes()
+            cvol.write_needle(
+                Needle(cookie=0x100 + i, needle_id=i, data=dd)
+            )
+            cpayloads[i] = dd
+        cvol.close()
+        cbase = Volume.base_file_name(cdir, "", 1)
+        ec_encode_volume(cbase, cctx, backend=CpuBackend(cctx))
+        vol_cached = EcVolume(cdir, 1, backend_name="cpu")
+        vol_raw = EcVolume(cdir, 1, backend_name="cpu",
+                           interval_cache_bytes=0)
+        vol_cached.unmount_shards([0])
+        vol_raw.unmount_shards([0])
+        cache_ok = True
+        for i in range(1, 9):
+            a = vol_cached.read_needle(i).data  # populates the cache
+            b = vol_cached.read_needle(i).data  # hot-tier hit
+            c = vol_raw.read_needle(i).data  # cache-off reconstruction
+            if not (a == b == c == cpayloads[i]):
+                cache_ok = False
+                break
+        hc = vol_cached.interval_cache
+        check(
+            "hot_cache_bit_identical",
+            cache_ok and hc is not None and hc.hits > 0 and hc.loads > 0,
+            f"ok={cache_ok} stats={hc.stats() if hc else None}",
+        )
+        vol_cached.close()
+        vol_raw.close()
+
+        # ---- saturated-gateway 503 is a WELL-FORMED S3 error document
+        # (Code=SlowDown + Retry-After): SDK clients must parse and
+        # back off, not choke on a bare connection close --------------
+        import socket as _socket
+        import xml.etree.ElementTree as _ET
+
+        import requests as _rq
+
+        from seaweedfs_tpu.filer import Filer as _Filer
+        from seaweedfs_tpu.filer import MemoryStore as _MemStore
+        from seaweedfs_tpu.s3 import S3Server as _S3Server
+
+        sat_filer = _Filer(_MemStore(), master="localhost:1")
+        sat_srv = _S3Server(
+            sat_filer, ip="127.0.0.1", port=_bench_free_port(),
+            lifecycle_interval=0, http_workers=1, http_queue=0,
+        )
+        sat_srv.start()
+        held = None
+        try:
+            held = _socket.create_connection(("127.0.0.1", sat_srv.port))
+            time.sleep(0.3)  # let the acceptor admit the held conn
+            rr = _rq.get(f"http://127.0.0.1:{sat_srv.port}/", timeout=10)
+            doc_ok = False
+            try:
+                doc = _ET.fromstring(rr.content)
+                doc_ok = (
+                    doc.tag == "Error"
+                    and doc.findtext("Code") == "SlowDown"
+                    and bool(doc.findtext("Message"))
+                )
+            except _ET.ParseError:
+                pass
+            check(
+                "saturation_503_s3_error_doc",
+                rr.status_code == 503
+                and bool(rr.headers.get("Retry-After"))
+                and doc_ok,
+                f"code={rr.status_code} "
+                f"retry_after={rr.headers.get('Retry-After')} "
+                f"body={rr.content[:120]!r}",
+            )
+        finally:
+            if held is not None:
+                held.close()
+            sat_srv.stop()
+            sat_filer.close()
     finally:
         if prev_cache_env is None:
             os.environ.pop("SEAWEED_BENCH_PROBE_CACHE", None)

@@ -82,6 +82,7 @@ gauge set instead of silently aliasing into the first's.
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import threading
 import time
@@ -346,6 +347,29 @@ class DeviceQueue:
                 f"unknown priority class {priority!r} (want one of {PRIORITIES})"
             )
         return DeviceStream(self, priority, label, span=span)
+
+    @contextlib.contextmanager
+    def admission(self, priority: str, cost: int, span=None):
+        """One-shot admission for work that is not a staged batch
+        stream — e.g. a single-shot degraded-read reconstruction on the
+        gateway serving path. Blocks until this queue admits `cost`
+        units in `priority`'s class, holds ONE window slot for the body
+        of the ``with``, and releases it on exit (success or raise).
+        The admission wait is recorded on `span` as the
+        "admission_wait" stage labeled with this queue's chip, exactly
+        like the staged path's, so per-stage attribution shows where a
+        scheduled read waited."""
+        if priority not in PRIORITIES:
+            raise ECError(
+                f"unknown priority class {priority!r} (want one of {PRIORITIES})"
+            )
+        ticket = self._admit(priority, cost)
+        if span is not None:
+            span.add_stage("admission_wait", ticket.wait_s, self.label)
+        try:
+            yield ticket
+        finally:
+            self._release(ticket)
 
     def stats(self) -> dict:
         with self._cond:

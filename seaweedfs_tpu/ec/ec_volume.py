@@ -172,7 +172,7 @@ class EcVolume:
             self.interval_cache: ChunkCache | None = interval_cache
         else:
             self.interval_cache = (
-                ChunkCache(interval_cache_bytes)
+                ChunkCache(interval_cache_bytes, tier="ec_interval")
                 if interval_cache_bytes > 0
                 else None
             )
@@ -350,11 +350,6 @@ class EcVolume:
             f"{self._cache_ns}{shard_id}:"
             f"{self._shard_gen.get(shard_id, 0)}:{lo}:{hi}"
         )
-        if cache is not None:
-            hit = cache.get(key)
-            if hit is not None:
-                trace.event(sp, "cache_hit", lo=lo, hi=hi)
-                return hit[offset - lo : offset - lo + size]
 
         def range_ok(sid: int, data: bytes) -> bool:
             """Verify a shard's [lo, hi) bytes against its own granule
@@ -363,20 +358,39 @@ class EcVolume:
             with trace.stage(sp, "crc_verify"):
                 return prot.verify_range(sid, lo, data)
 
-        # Sources are sidecar-verified BEFORE being fed to Reed-Solomon:
-        # a silently-rotten sibling is excluded instead of poisoning the
-        # reconstruction (which would force a refusal even though k
-        # clean shards exist).
-        data = self._reconstruct_range(shard_id, lo, hi - lo, source_ok=range_ok)
-        if not range_ok(shard_id, data):
-            raise ECError(
-                f"reconstructed shard {shard_id} [{lo}:{hi}) fails "
-                f".ecsum verification; refusing to serve"
+        def build() -> bytes:
+            # Sources are sidecar-verified BEFORE being fed to
+            # Reed-Solomon: a silently-rotten sibling is excluded
+            # instead of poisoning the reconstruction (which would
+            # force a refusal even though k clean shards exist).
+            data = self._reconstruct_range(
+                shard_id, lo, hi - lo, source_ok=range_ok
             )
-        if cache is not None:
-            # Only VERIFIED reconstruction output is ever cached, so a
-            # hit is as trustworthy as the read that populated it.
-            cache.put(key, data)
+            if not range_ok(shard_id, data):
+                raise ECError(
+                    f"reconstructed shard {shard_id} [{lo}:{hi}) fails "
+                    f".ecsum verification; refusing to serve"
+                )
+            return data
+
+        if cache is None:
+            return build()[offset - lo : offset - lo + size]
+        # Read-through with singleflight collapse: N concurrent misses
+        # on one degraded extent run build() ONCE — everyone gets the
+        # leader's verified bytes (the leader's refusal propagates to
+        # every waiter too; nobody retries a reconstruction that just
+        # failed verification). Only VERIFIED output is ever cached, so
+        # a hit is as trustworthy as the read that populated it.
+        # Invalidation is race-free both ways it happens: remount/
+        # rebuild bump the shard GENERATION (a stale in-flight build
+        # parks its bytes under the old key where no new reader looks),
+        # and a leaf patch's ranged drop_matching FENCES matching
+        # in-flight builds (returned to their callers, never admitted).
+        data, src = cache.get_or_load(key, build)
+        if src == "hit":
+            trace.event(sp, "cache_hit", lo=lo, hi=hi)
+        elif src == "wait":
+            trace.event(sp, "singleflight_wait", lo=lo, hi=hi)
         return data[offset - lo : offset - lo + size]
 
     def _reconstruct_range(
@@ -523,9 +537,25 @@ class EcVolume:
                 write_stage="write_sink",
             )
             return out.tobytes()
-        with trace.stage(sp, "reconstruct"):
-            rec = self.backend.reconstruct(sources, want=[shard_id])
-            return np.asarray(rec[shard_id], dtype=np.uint8).tobytes()
+        # Single-shot path (the latency-sensitive needle-read shape):
+        # still a CLIENT of the shared per-chip scheduler — serving
+        # traffic takes a FOREGROUND window slot with a cost hint, so a
+        # gateway read preempts colocated recovery/scrub admission
+        # instead of racing it unscheduled (ISSUE 11). The wait lands on
+        # the span as "admission_wait", like the staged path's.
+        from .device_queue import batch_cost, resolve_scope
+
+        queue = resolve_scope(self.scheduler).for_backend(self.backend)
+        if queue is not None:
+            with queue.admission(
+                "foreground", batch_cost(1, size), span=sp
+            ):
+                with trace.stage(sp, "reconstruct"):
+                    rec = self.backend.reconstruct(sources, want=[shard_id])
+        else:
+            with trace.stage(sp, "reconstruct"):
+                rec = self.backend.reconstruct(sources, want=[shard_id])
+        return np.asarray(rec[shard_id], dtype=np.uint8).tobytes()
 
     # ------------------------------------------------------------- delete
 

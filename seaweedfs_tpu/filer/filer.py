@@ -46,8 +46,11 @@ class Filer:
         from ..utils.chunk_cache import ChunkCache
 
         # read-path LRU (reference chunk_cache memory tier); fids are
-        # immutable so cached bytes can never go stale
-        self.chunk_cache = ChunkCache(chunk_cache_bytes)
+        # immutable so cached bytes can never go stale. The hot tier of
+        # the gateway read path: misses are singleflight-collapsed, so
+        # N concurrent GETs of one cold (possibly degraded) chunk cost
+        # ONE volume fetch/reconstruction (ISSUE 11).
+        self.chunk_cache = ChunkCache(chunk_cache_bytes, tier="filer_chunk")
         self.collection = collection
         self.replication = replication
         self.chunk_size = chunk_size
@@ -695,28 +698,34 @@ class Filer:
             chunks = resolve_manifests(self._read_chunk_cached, chunks)
         buf = bytearray(size)
         for view in read_chunk_views(chunks, offset, size):
-            chunk_data = self.chunk_cache.get(view.fid)
-            if chunk_data is None:
-                with trace.stage(sp, "chunk.fetch"):
-                    chunk_data = self.ops.read(view.fid)
-                # admit only modest chunks: one large streaming read must
-                # not flush the whole hot set out of the LRU
-                if len(chunk_data) <= self.chunk_cache.capacity // 8:
-                    self.chunk_cache.put(view.fid, chunk_data)
-            elif sp is not None:
-                sp.event("chunk_cache_hit", fid=view.fid)
+            chunk_data, src = self.chunk_cache.get_or_load(
+                view.fid,
+                lambda fid=view.fid: self._fetch_chunk_traced(fid, sp),
+                # admit only modest chunks: one large streaming read
+                # must not flush the whole hot set out of the LRU
+                admit=lambda d: len(d) <= self.chunk_cache.capacity // 8,
+            )
+            if src != "load" and sp is not None:
+                sp.event(
+                    "chunk_cache_hit" if src == "hit"
+                    else "chunk_singleflight_wait",
+                    fid=view.fid,
+                )
             piece = chunk_data[view.offset_in_chunk : view.offset_in_chunk + view.size]
             lo = view.logical_offset - offset
             buf[lo : lo + len(piece)] = piece
         return bytes(buf)
 
+    def _fetch_chunk_traced(self, fid: str, sp) -> bytes:
+        with trace.stage(sp, "chunk.fetch"):
+            return self.ops.read(fid)
+
     def _read_chunk_cached(self, fid: str) -> bytes:
-        data = self.chunk_cache.get(fid)
-        if data is None:
-            with trace.stage(trace.current(), "chunk.fetch"):
-                data = self.ops.read(fid)
-            if len(data) <= self.chunk_cache.capacity // 8:
-                self.chunk_cache.put(fid, data)
+        data, _src = self.chunk_cache.get_or_load(
+            fid,
+            lambda: self._fetch_chunk_traced(fid, trace.current()),
+            admit=lambda d: len(d) <= self.chunk_cache.capacity // 8,
+        )
         return data
 
     def resolve_chunks(self, entry: Entry):

@@ -166,10 +166,22 @@ class MqAgentService:
             )
             return
         yield mq.AgentSubscribeResponse(is_end_of_stream=True)
-        # grace for the FINAL cumulative ack: the client typically acks
+        # Grace for the FINAL cumulative ack: the client typically acks
         # after the end marker, then half-closes; returning immediately
-        # would discard that ack mid-flight
-        reqs_done.wait(2.0)
+        # would cancel the RPC and discard that ack mid-flight. The
+        # grace must be LOAD-TOLERANT: under a loaded host the client's
+        # ack + half-close and the pump's CommitOffset RPC can take
+        # well over the old fixed 2 s, and an expired grace silently
+        # dropped the committed offset ("ack never committed" flake).
+        # reqs_done is set the moment the pump drains the half-closed
+        # request stream (ack-less consumers half-close immediately, so
+        # the common case returns without waiting), and a DISCONNECTED
+        # client stops the wait early — only a consumer that keeps its
+        # request stream open without acking pays the full grace.
+        deadline = time.monotonic() + 30.0
+        while not reqs_done.wait(0.25):
+            if time.monotonic() > deadline or not context.is_active():
+                break
 
     def close(self) -> None:
         self._client.close()

@@ -73,6 +73,22 @@ def _iso(ts: int) -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(ts or 0))
 
 
+def _saturation_error_doc() -> tuple[str, bytes]:
+    """503 body for a saturated gateway: a well-formed S3 error
+    document (Code=SlowDown, AWS's throttle code) so SDK clients parse
+    and back off instead of choking on a bare close."""
+    root = ET.Element("Error")
+    _el(root, "Code", "SlowDown")
+    _el(
+        root,
+        "Message",
+        "gateway saturated: worker pool and accept queue are full; "
+        "reduce your request rate",
+    )
+    _el(root, "Resource", "/")
+    return "application/xml", _xml(root)
+
+
 class S3Server:
     def __init__(
         self,
@@ -86,7 +102,16 @@ class S3Server:
         tls=None,
         oidc=None,
         ldap=None,
+        http_workers: int = 32,
+        http_queue: int = 128,
     ):
+        """`http_workers`/`http_queue`: the bounded worker-pool front
+        end (utils/http_pool.py) — `http_workers` request workers plus
+        an `http_queue`-deep connection budget; past it new connections
+        get an immediate 503 SlowDown XML error document with
+        Retry-After. `http_workers=0` restores the unbounded
+        one-thread-per-connection stdlib server (also used when `tls`
+        is configured)."""
         self.filer = filer
         self.ip = ip
         self.port = port
@@ -128,7 +153,17 @@ class S3Server:
         # conditional-PUT path holds its stripe around put_object,
         # which takes the same stripe as the common funnel.
         self._put_locks = [threading.RLock() for _ in range(64)]
-        self._http = ThreadingHTTPServer((ip, port), self._handler_class())
+        from ..utils.http_pool import build_http_server
+
+        self._http = build_http_server(
+            (ip, port),
+            self._handler_class(),
+            server_kind="s3",
+            workers=http_workers,
+            accept_queue=http_queue,
+            tls=tls,
+            reject_body=_saturation_error_doc,
+        )
         self.tls = tls
         if tls is not None:
             tls.wrap_server(self._http)

@@ -27,6 +27,8 @@ class FilerServer:
         grpc_port: int = 0,
         peers: list[str] | None = None,
         tls=None,
+        http_workers: int = 32,
+        http_queue: int = 128,
     ):
         """meta_log: a filer.meta_log.MetaLog; when present it is
         subscribed to the filer, served at GET /~meta/tail (long-poll
@@ -35,14 +37,43 @@ class FilerServer:
         grpc_port: port for the SeaweedFiler gRPC service (0 = pick an
         ephemeral port; exposed as .grpc_port).
         peers: other filers' gRPC addresses — starts a MetaAggregator
-        that converges this store with theirs."""
+        that converges this store with theirs.
+        http_workers/http_queue: bounded worker-pool HTTP front end
+        (utils/http_pool.py); saturation answers 503 + Retry-After with
+        a JSON error body. 0 workers = unbounded stdlib threading
+        server (also the TLS path)."""
         self.filer = filer
         self.ip = ip
         self.port = port
         self.meta_log = meta_log
         if meta_log is not None:
             filer.subscribe(meta_log)
-        self._http = ThreadingHTTPServer((ip, port), self._handler_class())
+        from ..utils.http_pool import build_http_server
+
+        self._http = build_http_server(
+            (ip, port),
+            self._handler_class(),
+            server_kind="filer",
+            workers=http_workers,
+            accept_queue=http_queue,
+            tls=tls,
+            reject_body=lambda: (
+                "application/json",
+                b'{"error": "filer saturated: worker pool and accept '
+                b'queue are full"}',
+            ),
+        )
+        # Long-poll budget for /~meta/tail on the POOLED front end: a
+        # full-length wait pins a worker, so only a quarter of the pool
+        # may sit in long-polls at once — excess subscribers get their
+        # wait clamped short (an early empty batch is legal long-poll
+        # protocol; they re-poll) instead of starving the data plane.
+        # The unbounded threaded server needs no budget (None).
+        self._tail_slots = (
+            threading.BoundedSemaphore(max(1, http_workers // 4))
+            if http_workers and tls is None
+            else None
+        )
         self.tls = tls
         if tls is not None:
             tls.wrap_server(self._http)
@@ -302,7 +333,21 @@ class FilerServer:
                     return self._json(400, {"error": "bad parameters"})
                 events = srv_log.read_since(since, limit)
                 if not events and wait_s > 0:
-                    srv_log.wait_for_events(since, timeout=wait_s)
+                    slots = server_ref._tail_slots
+                    got_slot = (
+                        True if slots is None
+                        else slots.acquire(blocking=False)
+                    )
+                    try:
+                        if not got_slot:
+                            # long-poll budget exhausted: answer fast
+                            # with an empty batch rather than pinning
+                            # another pool worker for up to a minute
+                            wait_s = min(wait_s, 0.5)
+                        srv_log.wait_for_events(since, timeout=wait_s)
+                    finally:
+                        if slots is not None and got_slot:
+                            slots.release()
                     events = srv_log.read_since(since, limit)
                 last = events[-1]["tsNs"] if events else since
                 import time as _time

@@ -1029,6 +1029,8 @@ class VolumeServer:
         ec_trace: bool = False,
         ec_trace_ring: int = 0,
         ec_slow_op_s: float = 0.0,
+        http_workers: int = 32,
+        http_queue: int = 128,
     ):
         # Shared per-chip device-queue scheduler (ec/device_queue.py):
         # every EC producer on this server submits priority-tagged batch
@@ -1125,7 +1127,26 @@ class VolumeServer:
         self._grpc = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
         rpc.add_service(self._grpc, rpc.VOLUME_SERVICE, self.service)
         self._grpc.add_insecure_port(f"{ip}:{self.grpc_port}")
-        self._http = ThreadingHTTPServer((ip, port), self._handler_class())
+        # Bounded worker-pool HTTP data plane (utils/http_pool.py):
+        # `http_workers` request workers + an `http_queue`-deep
+        # connection budget; saturation answers an explicit 503 +
+        # Retry-After instead of spawning unbounded threads.
+        # `http_workers=0` (or TLS) restores ThreadingHTTPServer.
+        from ..utils.http_pool import build_http_server
+
+        self._http = build_http_server(
+            (ip, port),
+            self._handler_class(),
+            server_kind="volume",
+            workers=http_workers,
+            accept_queue=http_queue,
+            tls=tls,
+            reject_body=lambda: (
+                "application/json",
+                b'{"error": "volume server saturated: worker pool and '
+                b'accept queue are full"}',
+            ),
+        )
         self.tls = tls
         if tls is not None:
             tls.wrap_server(self._http)

@@ -163,7 +163,7 @@ class Store:
             ec_interval_cache_bytes = DEFAULT_EC_INTERVAL_CACHE_BYTES
         self.ec_interval_cache_bytes = ec_interval_cache_bytes
         self.ec_interval_cache: ChunkCache | None = (
-            ChunkCache(ec_interval_cache_bytes)
+            ChunkCache(ec_interval_cache_bytes, tier="ec_interval")
             if ec_interval_cache_bytes > 0
             else None
         )

@@ -3,21 +3,175 @@
 Reference: weed/util/config.go:35-41 — viper loads `<name>.toml` from
 the working directory, `~/.seaweedfs/`, and `/etc/seaweedfs/` (first
 hit wins); `weed scaffold` emits commented templates
-(weed/command/scaffold/*.toml). Here the same search order is applied
-with stdlib tomllib, and `python -m seaweedfs_tpu.server scaffold`
-emits the templates in utils/scaffold.py.
+(weed/command/scaffold/*.toml). Here the same search order is applied,
+and `python -m seaweedfs_tpu.server scaffold` emits the templates in
+utils/scaffold.py.
 
 Flags still win: launchers consult the config only for keys whose flag
 was left at its default, mirroring the reference's precedence.
+
+The TOML parser is stdlib ``tomllib`` WHEN PRESENT (Python >= 3.11) and
+a minimal fallback otherwise: on a 3.10 interpreter an unconditional
+``import tomllib`` crashed every spawned ``python -m
+seaweedfs_tpu.server`` at import time — taking the whole server down
+over an OPTIONAL config feature. The fallback covers the dialect the
+scaffold templates use (tables, dotted tables, strings, ints, floats,
+booleans, flat arrays, comments); anything fancier should ride a
+3.11+ interpreter or stay in flags.
 """
 
 from __future__ import annotations
 
 import os
-import tomllib
+import re
 from typing import Any
 
+try:  # Python >= 3.11
+    import tomllib as _tomllib
+except ModuleNotFoundError:  # gated: 3.10 containers must still boot
+    try:
+        import tomli as _tomllib  # type: ignore[no-redef]
+    except ModuleNotFoundError:
+        _tomllib = None
+
 CONFIG_DIRS = (".", "~/.seaweedfs_tpu", "/etc/seaweedfs_tpu")
+
+
+class TomlDecodeError(ValueError):
+    """Raised by the fallback parser; aliases tomllib.TOMLDecodeError
+    when the stdlib parser is present so callers catch one type."""
+
+
+if _tomllib is not None:
+    TomlDecodeError = _tomllib.TOMLDecodeError  # type: ignore[misc]
+
+
+_KEY_RE = re.compile(r"^[A-Za-z0-9_\-]+$")
+
+
+def _parse_scalar(raw: str, lineno: int) -> Any:
+    raw = raw.strip()
+    if not raw:
+        raise TomlDecodeError(f"line {lineno}: empty value")
+    if raw.startswith('"') or raw.startswith("'"):
+        quote = raw[0]
+        if len(raw) < 2 or not raw.endswith(quote):
+            raise TomlDecodeError(f"line {lineno}: unterminated string")
+        body = raw[1:-1]
+        if quote == '"':
+            body = (
+                body.replace("\\\\", "\x00")
+                .replace('\\"', '"')
+                .replace("\\n", "\n")
+                .replace("\\t", "\t")
+                .replace("\x00", "\\")
+            )
+        return body
+    if raw == "true":
+        return True
+    if raw == "false":
+        return False
+    if raw.startswith("[") and raw.endswith("]"):
+        inner = raw[1:-1].strip()
+        if not inner:
+            return []
+        return [
+            _parse_scalar(part.strip(), lineno)
+            for part in _split_array(inner, lineno)
+        ]
+    try:
+        return int(raw, 0)
+    except ValueError:
+        pass
+    try:
+        return float(raw)
+    except ValueError:
+        pass
+    raise TomlDecodeError(f"line {lineno}: cannot parse value {raw!r}")
+
+
+def _split_array(inner: str, lineno: int) -> list[str]:
+    """Split a flat array body on commas OUTSIDE quotes."""
+    parts, buf, quote = [], [], ""
+    for ch in inner:
+        if quote:
+            buf.append(ch)
+            if ch == quote:
+                quote = ""
+        elif ch in "\"'":
+            quote = ch
+            buf.append(ch)
+        elif ch == ",":
+            parts.append("".join(buf))
+            buf = []
+        else:
+            buf.append(ch)
+    if quote:
+        raise TomlDecodeError(f"line {lineno}: unterminated string in array")
+    if "".join(buf).strip():
+        parts.append("".join(buf))
+    return parts
+
+
+def _strip_comment(line: str) -> str:
+    """Drop a trailing # comment (outside quotes)."""
+    quote = ""
+    for i, ch in enumerate(line):
+        if quote:
+            if ch == quote:
+                quote = ""
+        elif ch in "\"'":
+            quote = ch
+        elif ch == "#":
+            return line[:i]
+    return line
+
+
+def _fallback_loads(text: str) -> dict:
+    root: dict = {}
+    table = root
+    for lineno, raw_line in enumerate(text.splitlines(), 1):
+        line = _strip_comment(raw_line).strip()
+        if not line:
+            continue
+        if line.startswith("["):
+            if not line.endswith("]") or line.startswith("[["):
+                raise TomlDecodeError(
+                    f"line {lineno}: unsupported table header {line!r}"
+                )
+            table = root
+            for part in line[1:-1].split("."):
+                part = part.strip()
+                if not _KEY_RE.match(part):
+                    raise TomlDecodeError(
+                        f"line {lineno}: bad table name {part!r}"
+                    )
+                nxt = table.setdefault(part, {})
+                if not isinstance(nxt, dict):
+                    raise TomlDecodeError(
+                        f"line {lineno}: {part!r} is not a table"
+                    )
+                table = nxt
+            continue
+        key, sep, val = line.partition("=")
+        key = key.strip()
+        if not sep or not _KEY_RE.match(key):
+            raise TomlDecodeError(f"line {lineno}: cannot parse {line!r}")
+        table[key] = _parse_scalar(val, lineno)
+    return root
+
+
+def toml_loads(text: str) -> dict:
+    """Parse TOML text: stdlib tomllib when available, else the
+    fallback dialect. Raises :data:`TomlDecodeError` on bad input."""
+    if _tomllib is not None:
+        return _tomllib.loads(text)
+    return _fallback_loads(text)
+
+
+def toml_load(fp) -> dict:
+    """Parse a binary file object (tomllib.load signature)."""
+    return toml_loads(fp.read().decode("utf-8"))
 
 
 class Config:
@@ -60,8 +214,8 @@ def load_config(name: str, dirs=CONFIG_DIRS) -> Config:
         return Config(None)
     try:
         with open(path, "rb") as f:
-            return Config(tomllib.load(f), path)
-    except (OSError, tomllib.TOMLDecodeError) as e:
+            return Config(toml_load(f), path)
+    except (OSError, TomlDecodeError, ValueError, UnicodeDecodeError) as e:
         from .glog import logger
 
         logger("config").warning("ignoring %s: %s", path, e)

@@ -19,6 +19,11 @@ class _Metric:
         self.label_names = label_names
         self._lock = threading.Lock()
 
+    def snapshot(self) -> dict:
+        """{label_values_tuple: value} copy (status surfaces)."""
+        with self._lock:
+            return dict(self._values)
+
 
 class Counter(_Metric):
     def __init__(self, name, help_text="", label_names=()):
@@ -56,6 +61,19 @@ class Gauge(_Metric):
 
     def dec(self, amount: float = 1.0, **labels) -> None:
         self.inc(-amount, **labels)
+
+    def snapshot(self) -> dict:
+        """Like _Metric.snapshot, but a callback gauge samples its fn
+        (matching collect) instead of returning stale set() state."""
+        if self._fn is not None:
+            try:
+                return {
+                    tuple(labels.get(n, "") for n in self.label_names): v
+                    for labels, v in self._fn()
+                }
+            except Exception:
+                return {}
+        return super().snapshot()
 
     def collect(self):
         yield f"# HELP {self.name} {_escape_help(self.help)}"
@@ -258,6 +276,30 @@ def slo_summary() -> dict:
     return out
 
 
+def gateway_summary() -> dict:
+    """Serving-path pressure snapshot for ``/debug/gateway``: per-tier
+    hot-cache counters and per-server front-end inflight/rejected —
+    the SLO-adjacent "why is p99 moving" surface next to /debug/slo."""
+    hot: dict[str, dict] = {}
+    for counter, kind in (
+        (gateway_hot_cache_hits_total, "hits"),
+        (gateway_hot_cache_misses_total, "misses"),
+        (gateway_hot_cache_singleflight_waits_total, "singleflight_waits"),
+    ):
+        for (tier,), v in counter.snapshot().items():
+            hot.setdefault(tier, {})[kind] = int(v)
+    return {
+        "hot_cache": hot,
+        "inflight": {
+            srv: int(v) for (srv,), v in gateway_inflight.snapshot().items()
+        },
+        "rejected": {
+            srv: int(v)
+            for (srv,), v in gateway_rejected_total.snapshot().items()
+        },
+    }
+
+
 def _num(v: float) -> str:
     if v == int(v) and abs(v) < 1e15:
         return str(int(v))
@@ -295,4 +337,33 @@ ec_repair_journal_total = REGISTRY.counter(
     "sw_ec_repair_journal_total",
     "repair-journal recovery actions (replayed/rolled_back/kept/swept)",
     ("action",),
+)
+
+# Gateway serving path (ISSUE 11): the hot-object/chunk read-through
+# cache tiers (tier = filer_chunk | ec_interval) and the bounded
+# worker-pool HTTP front ends (server = s3 | filer | volume).
+gateway_hot_cache_hits_total = REGISTRY.counter(
+    "sw_gateway_hot_cache_hits_total",
+    "hot-cache hits on the gateway read path", ("tier",)
+)
+gateway_hot_cache_misses_total = REGISTRY.counter(
+    "sw_gateway_hot_cache_misses_total",
+    "hot-cache misses on the gateway read path", ("tier",)
+)
+gateway_hot_cache_singleflight_waits_total = REGISTRY.counter(
+    "sw_gateway_hot_cache_singleflight_waits_total",
+    "concurrent misses that joined another caller's in-flight load "
+    "instead of re-running it",
+    ("tier",),
+)
+gateway_inflight = REGISTRY.gauge(
+    "sw_gateway_inflight",
+    "HTTP requests currently being handled by the worker pool",
+    ("server",),
+)
+gateway_rejected_total = REGISTRY.counter(
+    "sw_gateway_rejected_total",
+    "connections refused with 503 because the worker pool + accept "
+    "queue were saturated",
+    ("server",),
 )

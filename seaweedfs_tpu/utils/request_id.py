@@ -148,13 +148,30 @@ class RequestTracingMixin:
         unauthenticated status response would bypass SigV4. Their op
         classes still appear in ``/metrics`` and in any co-resident
         server's ``/debug/slo`` (the registry is process-wide)."""
+        if path == "/debug/gateway":
+            return self._serve_debug_json(self._gateway_doc())
         if path != "/debug/slo":
             return False
-        import json
-
         from . import metrics
 
-        body = json.dumps(metrics.slo_summary(), sort_keys=True).encode()
+        return self._serve_debug_json(metrics.slo_summary())
+
+    def _gateway_doc(self) -> dict:
+        """``/debug/gateway``: the serving-path pressure surface beside
+        /debug/slo — this server's HTTP front-end state (worker pool /
+        accept budget / rejects) plus the process-wide hot-cache and
+        inflight counters (sw_gateway_*)."""
+        from . import metrics
+        from .http_pool import status_of
+
+        doc = metrics.gateway_summary()
+        doc["front_end"] = status_of(self.server)
+        return doc
+
+    def _serve_debug_json(self, obj) -> bool:
+        import json
+
+        body = json.dumps(obj, sort_keys=True).encode()
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))

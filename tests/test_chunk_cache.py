@@ -1,10 +1,12 @@
 """Chunk cache tests + filer read-path integration + auto-EC scanner
-wiring (reference weed/util/chunk_cache, admin maintenance loop)."""
+wiring (reference weed/util/chunk_cache, admin maintenance loop), plus
+the ISSUE 11 read-through/singleflight layer (get_or_load)."""
 
+import threading
 import time
 
 from conftest import allocate_port as free_port
-from seaweedfs_tpu.utils.chunk_cache import ChunkCache
+from seaweedfs_tpu.utils.chunk_cache import ChunkCache, SingleFlight
 
 
 def test_lru_eviction_and_bounds():
@@ -24,6 +26,185 @@ def test_lru_eviction_and_bounds():
     assert c.get("a") == b"small"
     c.drop("a")
     assert c.get("a") is None
+
+
+def test_get_or_load_hit_load_and_admission():
+    c = ChunkCache(capacity_bytes=1000)
+    calls = []
+
+    def loader():
+        calls.append(1)
+        return b"v" * 10
+
+    data, src = c.get_or_load("k", loader)
+    assert (data, src, len(calls)) == (b"v" * 10, "load", 1)
+    data, src = c.get_or_load("k", loader)
+    assert (data, src, len(calls)) == (b"v" * 10, "hit", 1)
+    # admit=False keeps the result OUT of the cache: next call loads
+    data, src = c.get_or_load("big", loader, admit=lambda d: False)
+    assert src == "load"
+    data, src = c.get_or_load("big", loader)
+    assert src == "load" and len(calls) == 3
+
+
+def test_singleflight_collapses_concurrent_misses():
+    """K concurrent misses on ONE key -> exactly one loader call, every
+    caller byte-identical (the tentpole's reconstruction-collapse
+    contract, unit-level)."""
+    c = ChunkCache(capacity_bytes=1 << 20)
+    gate = threading.Event()
+    loads = []
+    load_lock = threading.Lock()
+
+    def loader():
+        with load_lock:
+            loads.append(threading.get_ident())
+        gate.wait(5)  # hold every concurrent caller in the same flight
+        return b"payload-bytes"
+
+    results = []
+    res_lock = threading.Lock()
+
+    def reader():
+        data, src = c.get_or_load("hot", loader)
+        with res_lock:
+            results.append((data, src))
+
+    threads = [threading.Thread(target=reader) for _ in range(8)]
+    for t in threads:
+        t.start()
+    # let everyone pile onto the flight, then release the leader
+    deadline = time.time() + 5
+    while len(loads) == 0 and time.time() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.1)
+    gate.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(loads) == 1, "concurrent misses must collapse to ONE load"
+    assert len(results) == 8
+    assert all(d == b"payload-bytes" for d, _ in results)
+    srcs = [s for _, s in results]
+    assert srcs.count("load") == 1 and srcs.count("wait") == 7
+    assert c.singleflight_waits == 7
+    # after the flight lands, it's a plain hit
+    assert c.get_or_load("hot", loader)[1] == "hit"
+
+
+def test_singleflight_leader_exception_propagates_to_waiters():
+    c = ChunkCache(capacity_bytes=1 << 20)
+    gate = threading.Event()
+
+    def loader():
+        gate.wait(5)
+        raise RuntimeError("reconstruction refused")
+
+    failures = []
+
+    def reader():
+        try:
+            c.get_or_load("bad", loader)
+        except RuntimeError as e:
+            failures.append(str(e))
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)
+    gate.set()
+    for t in threads:
+        t.join(timeout=10)
+    # everyone saw the leader's refusal; NOBODY retried the loader
+    # inside the flight (a failed verified reconstruction must not be
+    # re-run by each waiter in turn)
+    assert len(failures) == 4
+    # the key is not poisoned: a later call runs a fresh loader
+    assert c.get_or_load("bad", lambda: b"ok")[0] == b"ok"
+
+
+def test_invalidation_fences_inflight_load():
+    """A drop_matching/drop_prefix/clear racing an in-flight load must
+    win: the leader's result goes to its callers but is NOT admitted —
+    otherwise a reconstruction started over pre-patch bytes would
+    repopulate the just-invalidated key with stale data."""
+    c = ChunkCache(capacity_bytes=1 << 20)
+    in_loader = threading.Event()
+    release = threading.Event()
+
+    def loader():
+        in_loader.set()
+        release.wait(5)
+        return b"pre-patch-bytes"
+
+    out = {}
+
+    def reader():
+        out["result"] = c.get_or_load("ns:2:0:0:1024", loader)
+
+    t = threading.Thread(target=reader)
+    t.start()
+    assert in_loader.wait(5)
+    # invalidation lands while the load is in flight
+    dropped = c.drop_matching("ns:2:0:", lambda k: True)
+    assert dropped == 0  # nothing cached yet — the fence is the point
+    # a reader that begins strictly AFTER the invalidation must NOT
+    # join the doomed flight: it runs its own (post-patch) loader and
+    # its result IS cached
+    data, src = c.get_or_load("ns:2:0:0:1024", lambda: b"post-patch")
+    assert (data, src) == (b"post-patch", "load")
+    assert c.get("ns:2:0:0:1024") == b"post-patch"
+    release.set()
+    t.join(timeout=10)
+    data, src = out["result"]
+    assert data == b"pre-patch-bytes" and src == "load"
+    # the doomed leader's result went to ITS caller but must not have
+    # clobbered the fresh post-invalidation entry
+    assert c.get("ns:2:0:0:1024") == b"post-patch"
+
+
+def test_get_or_load_zero_capacity_is_passthrough():
+    """The cache-off (naive) configuration: no storage, no collapsing —
+    every caller pays its own loader call."""
+    c = ChunkCache(capacity_bytes=0)
+    calls = []
+    for _ in range(3):
+        data, src = c.get_or_load("k", lambda: calls.append(1) or b"x")
+        assert src == "load"
+    assert len(calls) == 3
+
+
+def test_singleflight_distinct_keys_run_concurrently():
+    sf = SingleFlight()
+    order = []
+    gate = threading.Event()
+
+    def slow(fl):
+        order.append("slow-start")
+        gate.wait(5)
+        return "slow"
+
+    t = threading.Thread(target=lambda: sf.do("a", slow))
+    t.start()
+    deadline = time.time() + 5
+    while not order and time.time() < deadline:
+        time.sleep(0.01)
+    # a DIFFERENT key must not queue behind key "a"
+    val, waited = sf.do("b", lambda fl: "fast")
+    assert (val, waited) == ("fast", False)
+    gate.set()
+    t.join(timeout=10)
+
+
+def test_eviction_under_get_or_load_budget():
+    """The byte budget holds under read-through population: older keys
+    fall out, the hot key stays."""
+    c = ChunkCache(capacity_bytes=1000)
+    for i in range(10):
+        c.get_or_load(f"k{i}", lambda i=i: bytes([i]) * 300)
+        c.get_or_load("k0", lambda: b"\x00" * 300)  # keep k0 hot
+    assert c.size_bytes <= 1000
+    assert c.get("k0") is not None, "hot key must survive the budget"
+    assert c.get("k1") is None, "cold keys must be evicted"
 
 
 def test_filer_read_path_uses_cache(tmp_path):

@@ -42,16 +42,18 @@ def test_config_search_order_and_dotted_get(tmp_path):
 
 
 def test_scaffold_emits_parseable_toml(tmp_path):
-    import tomllib
-
+    # toml_loads is tomllib on >=3.11 and the gated fallback parser on
+    # 3.10 containers (where a bare `import tomllib` used to crash
+    # every spawned server at import time)
     from seaweedfs_tpu.server.__main__ import main
+    from seaweedfs_tpu.utils.config import toml_load, toml_loads
     from seaweedfs_tpu.utils.scaffold import TEMPLATES, scaffold
 
     for name in TEMPLATES:
-        tomllib.loads(scaffold(name))  # every template must parse
+        toml_loads(scaffold(name))  # every template must parse
     rc = main(["scaffold", "-config", "security", "-output", str(tmp_path)])
     assert rc == 0
-    data = tomllib.load(open(tmp_path / "security.toml", "rb"))
+    data = toml_load(open(tmp_path / "security.toml", "rb"))
     assert "jwt" in data
     with pytest.raises(KeyError):
         scaffold("nonsense")
