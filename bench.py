@@ -1123,6 +1123,288 @@ def _gateway_bench(
                 pass
 
 
+def _net_counter_delta(before: dict, after: dict, plane: str) -> float:
+    return float(after.get((plane,), 0.0) - before.get((plane,), 0.0))
+
+
+def _peer_rebuild_bench(workdir: str, shard_mb: int = 8, reps: int = 2) -> dict:
+    """ISSUE 12 headline: peer-fetch rebuild throughput, NATIVE vs
+    PYTHON network planes over the SAME loopback TCP wire in one run.
+
+    The native plane is a real ShardNetPlane server (sendfile(2) shard
+    egress) with `fetch_into` ingress landing streams straight into
+    pooled aligned buffers, the granule CRC fused into the copy-in; the
+    Python plane (SEAWEED_EC_NATIVE=0 for the whole run, so source,
+    sink, AND wire are Python) moves the same bytes over the same
+    socket through `bytes` materialization at every seam. Interleaved
+    best-of-`reps`; the regenerated shard is asserted byte-identical
+    across planes AND to the original (peer_rebuild_identical in the
+    line). bytes_copied_per_byte_served per plane is derived from the
+    sw_net_bytes_{copied,received}_total counters around each run —
+    ~0.0 for the native plane is the zero-copy evidence."""
+    import numpy as _np
+
+    from seaweedfs_tpu.ec import net_plane as _netp
+    from seaweedfs_tpu.ec.backend import CpuBackend as _Cpu
+    from seaweedfs_tpu.ec.bitrot import (
+        BitrotProtection as _BP,
+        ShardChecksumBuilder as _Builder,
+    )
+    from seaweedfs_tpu.ec.context import ECContext as _Ctx
+    from seaweedfs_tpu.ec.peer_rebuild import (
+        PeerFetchTransient as _Transient,
+        rebuild_from_peers as _rebuild,
+    )
+    from seaweedfs_tpu.utils import metrics as _M
+
+    # tmpfs when available: the ≥1.2x native-vs-python target is a
+    # byte-path number, not a disk benchmark
+    root = "/dev/shm" if os.access("/dev/shm", os.W_OK) else workdir
+    bdir = tempfile.mkdtemp(prefix="sw_peer_bench_", dir=root)
+    ctx = _Ctx(4, 2)
+    shard_bytes = shard_mb << 20
+    generation = 7
+    fds: dict = {}
+    try:
+        rng = _np.random.default_rng(0xBEEF)
+        data = rng.integers(
+            0, 256, (ctx.data_shards, shard_bytes), dtype=_np.uint8
+        )
+        shards = _np.concatenate([data, _Cpu(ctx).encode(data)], axis=0)
+        builders = [
+            _Builder(1 << 22, 64 * 1024) for _ in range(ctx.total)
+        ]
+        peer_dir = os.path.join(bdir, "peer")
+        os.makedirs(peer_dir)
+        fds = {}
+        for i in range(ctx.total):
+            blob = shards[i].tobytes()
+            builders[i].write(blob)
+            p = os.path.join(peer_dir, f"1{ctx.to_ext(i)}")
+            with open(p, "wb") as f:
+                f.write(blob)
+            fds[i] = os.open(p, os.O_RDONLY)
+        prot = _BP.from_builders(ctx, builders, generation=generation)
+
+        def resolve(vid, sid, gen):
+            if gen and gen != generation:
+                raise _netp.NetPlaneError("stale generation")
+            if sid not in fds:
+                raise _netp.NetPlaneError("shard not local")
+            return fds[sid], shard_bytes
+
+        srv = _netp.ShardNetPlane(
+            "127.0.0.1", 0, resolve, server_label="bench-peer"
+        )
+        srv.start()
+        addr = ("127.0.0.1", srv.port)
+        client = _netp.NetPlaneClient()
+
+        def fetch(peer, sid, off, size):
+            try:
+                return client.read_bytes(addr, 1, sid, generation, off, size)
+            except (_netp.NetPlaneError, _netp.NetPlaneUnavailable) as e:
+                raise _Transient(str(e)) from e
+
+        fetch_into = _netp.make_fetch_into(
+            client, 1, generation, addr_of=lambda peer: addr
+        )
+        backend = _Cpu(ctx)
+        # cluster-lost-holder bootstrap shape: NOTHING local but the
+        # sidecar, every source crosses the wire — the configuration
+        # this plane exists for (wire-dominated, k fetched streams).
+        holders = {sid: ["peer"] for sid in range(ctx.data_shards + 1)}
+
+        walls = {"native": [], "python": []}
+        copied_per_served = {}
+        rebuilt = {}
+        prev_env = os.environ.get("SEAWEED_EC_NATIVE")
+        try:
+            for rep in range(reps):
+                for plane in ("native", "python"):
+                    ldir = os.path.join(bdir, f"{plane}{rep}")
+                    os.makedirs(ldir)
+                    base = os.path.join(ldir, "1")
+                    prot.save(base + ".ecsum")
+                    if plane == "python":
+                        os.environ["SEAWEED_EC_NATIVE"] = "0"
+                    else:
+                        os.environ.pop("SEAWEED_EC_NATIVE", None)
+                    cop0 = _M.net_bytes_copied_total.snapshot()
+                    rec0 = _M.net_bytes_received_total.snapshot()
+                    t0 = time.perf_counter()
+                    rep_out = _rebuild(
+                        base, holders, fetch, ctx=ctx, targets=[5],
+                        backend=backend,
+                        fetch_into=(
+                            fetch_into if plane == "native" else None
+                        ),
+                    )
+                    walls[plane].append(time.perf_counter() - t0)
+                    cop1 = _M.net_bytes_copied_total.snapshot()
+                    rec1 = _M.net_bytes_received_total.snapshot()
+                    served = _net_counter_delta(rec0, rec1, plane)
+                    copied = _net_counter_delta(cop0, cop1, plane)
+                    if served > 0:
+                        copied_per_served[plane] = round(copied / served, 2)
+                    fetched_count = len(rep_out.fetched)
+                    if rep_out.rebuilt != [5] or set(
+                        rep_out.fetched_plane.values()
+                    ) != {plane}:
+                        return {
+                            "peer_rebuild_error": (
+                                f"{plane}: rebuilt={rep_out.rebuilt} "
+                                f"planes={rep_out.fetched_plane}"
+                            )
+                        }
+                    with open(base + ctx.to_ext(5), "rb") as f:
+                        rebuilt[plane] = f.read()
+        finally:
+            if prev_env is None:
+                os.environ.pop("SEAWEED_EC_NATIVE", None)
+            else:
+                os.environ["SEAWEED_EC_NATIVE"] = prev_env
+            client.close()
+            srv.stop()
+
+        identical = (
+            rebuilt["native"] == rebuilt["python"] == shards[5].tobytes()
+        )
+        # throughput denominator: sibling bytes moved over the wire
+        wire = fetched_count * shard_bytes
+        native_gbs = wire / min(walls["native"]) / 1e9
+        python_gbs = wire / min(walls["python"]) / 1e9
+        return {
+            "peer_rebuild_gbs": round(native_gbs, 3),
+            "peer_rebuild_python_gbs": round(python_gbs, 3),
+            "peer_rebuild_native_vs_python": round(
+                native_gbs / max(python_gbs, 1e-9), 2
+            ),
+            "peer_rebuild_identical": bool(identical),
+            "peer_rebuild_wire_mb": wire >> 20,
+            "peer_rebuild_staging": root,
+            "bytes_copied_per_byte_served_native": copied_per_served.get(
+                "native", 0.0
+            ),
+            "bytes_copied_per_byte_served_python": copied_per_served.get(
+                "python", 0.0
+            ),
+        }
+    finally:
+        for fd in fds.values():
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        shutil.rmtree(bdir, ignore_errors=True)
+
+
+def _gateway_warm_bench(
+    workdir: str,
+    clients: int = 16,
+    reads_per_client: int = 25,
+    obj_bytes: int = 256 << 10,
+) -> dict:
+    """Warm-path gateway GETs (no degradation, caches hot): the PR 11
+    ceiling was ~180 GETs/s on 2 cores with the bottleneck in Python
+    HTTP byte handling under the GIL. Measures the SAME warm loop with
+    the native body egress on (sendfile/writev via
+    utils/http_pool.send_body, GIL released per response) vs off
+    (SEAWEED_EC_NATIVE=0 -> wfile.write) in one run, byte-verified by
+    the client phase either way."""
+    import requests as _rq
+
+    from seaweedfs_tpu.filer import Filer, MemoryStore
+    from seaweedfs_tpu.s3 import S3Server
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    gdir = os.path.join(workdir, "gateway_warm")
+    os.makedirs(gdir, exist_ok=True)
+    mport = _bench_free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vs = VolumeServer(
+        directories=[os.path.join(gdir, "v")],
+        master=f"localhost:{mport}",
+        ip="localhost",
+        port=_bench_free_port(),
+        ec_backend="cpu",
+    )
+    vs.start()
+    filer = srv = None
+    prev_env = os.environ.get("SEAWEED_EC_NATIVE")
+    try:
+        deadline = time.time() + 20
+        while not master.topo.nodes:
+            if time.time() > deadline:
+                raise TimeoutError("volume server never registered")
+            time.sleep(0.05)
+        filer = Filer(
+            MemoryStore(), master=f"localhost:{mport}",
+            chunk_size=256 * 1024,
+        )
+        srv = S3Server(filer, ip="localhost", port=_bench_free_port())
+        srv.start()
+        base = f"http://localhost:{srv.port}"
+        rng = np.random.default_rng(0x3A3A)
+        data = rng.integers(0, 256, obj_bytes, dtype=np.uint8).tobytes()
+        assert _rq.put(f"{base}/bench").status_code == 200
+        assert _rq.put(f"{base}/bench/obj", data=data).status_code == 200
+        # warm both byte paths once (page cache + chunk cache + conns)
+        for _ in range(2):
+            r = _rq.get(f"{base}/bench/obj", timeout=30)
+            assert r.status_code == 200 and r.content == data
+
+        os.environ["SEAWEED_EC_NATIVE"] = "0"
+        python_phase = _gateway_client_phase(
+            base, data, clients, reads_per_client
+        )
+        os.environ.pop("SEAWEED_EC_NATIVE", None)
+        native_phase = _gateway_client_phase(
+            base, data, clients, reads_per_client
+        )
+        if "error" in native_phase or "error" in python_phase:
+            return {
+                "gateway_warm_error": (
+                    f"native={native_phase.get('error')} "
+                    f"python={python_phase.get('error')}"
+                )
+            }
+        return {
+            "gateway_warm_get_gets_per_s": native_phase["gets_per_s"],
+            "gateway_warm_get_p50_ms": native_phase["p50_ms"],
+            "gateway_warm_get_python_gets_per_s": python_phase["gets_per_s"],
+            "gateway_warm_get_python_p50_ms": python_phase["p50_ms"],
+            "gateway_warm_native_vs_python": round(
+                native_phase["gets_per_s"]
+                / max(python_phase["gets_per_s"], 1e-9),
+                2,
+            ),
+            "gateway_warm_clients": clients,
+            "gateway_warm_object_kb": obj_bytes >> 10,
+            "gateway_warm_errors": native_phase["errors"]
+            + python_phase["errors"],
+        }
+    finally:
+        if prev_env is None:
+            os.environ.pop("SEAWEED_EC_NATIVE", None)
+        else:
+            os.environ["SEAWEED_EC_NATIVE"] = prev_env
+        for closer in (
+            (lambda: srv.stop()) if srv is not None else None,
+            (lambda: filer.close()) if filer is not None else None,
+            vs.stop,
+            master.stop,
+        ):
+            if closer is None:
+                continue
+            try:
+                closer()
+            except Exception:
+                pass
+
+
 # --------------------------------------------------------------------------
 # Device phase: INDEPENDENTLY WATCHDOGGED STAGES, each in its own
 # subprocess, each persisting its JSON fragment to disk the moment it
@@ -2296,6 +2578,28 @@ def _self_check() -> int:
         vol_cached.close()
         vol_raw.close()
 
+        # ---- network-plane bit identity (ISSUE 12): a shard rebuilt
+        # from NATIVE-plane peer fetches (real loopback ShardNetPlane,
+        # sendfile egress, recv-into-pooled-buffer ingress with fused
+        # copy-in CRC) must be byte-equal to the Python-plane rebuild
+        # over the same wire, and both to the original; the sw_net_*
+        # counters must attribute bytes to both planes ---------------
+        net_stats = _peer_rebuild_bench(workdir, shard_mb=1, reps=1)
+        check(
+            "net_plane_bit_identical",
+            net_stats.get("peer_rebuild_identical") is True,
+            f"stats={net_stats}",
+        )
+        check(
+            "net_plane_zero_copy_evidence",
+            "peer_rebuild_error" not in net_stats
+            and net_stats.get("bytes_copied_per_byte_served_native", 1.0)
+            < 0.01
+            and net_stats.get("bytes_copied_per_byte_served_python", 0.0)
+            >= 1.0,
+            f"stats={net_stats}",
+        )
+
         # ---- saturated-gateway 503 is a WELL-FORMED S3 error document
         # (Code=SlowDown + Retry-After): SDK clients must parse and
         # back off, not choke on a bare connection close --------------
@@ -2448,6 +2752,23 @@ def main() -> None:
             gateway_stats = _gateway_bench(workdir)
         except Exception as e:  # noqa: BLE001
             gateway_stats = {"gateway_error": f"{type(e).__name__}: {e}"}
+        # Network byte plane (ISSUE 12): peer-fetch rebuild GB/s over a
+        # real loopback ShardNetPlane, native vs Python planes with bit
+        # identity asserted, + bytes-copied-per-byte-served per plane.
+        try:
+            peer_rebuild_stats = _peer_rebuild_bench(workdir)
+        except Exception as e:  # noqa: BLE001
+            peer_rebuild_stats = {
+                "peer_rebuild_error": f"{type(e).__name__}: {e}"
+            }
+        # Warm gateway GETs with the native body egress on vs off — the
+        # PR 11 warm-path GIL ceiling is the target.
+        try:
+            gateway_warm_stats = _gateway_warm_bench(workdir)
+        except Exception as e:  # noqa: BLE001
+            gateway_warm_stats = {
+                "gateway_warm_error": f"{type(e).__name__}: {e}"
+            }
 
         _clear_shards(base)  # device phase re-encodes the same volume
 
@@ -2506,6 +2827,8 @@ def main() -> None:
             **leaf_repair_stats,
             **colocated_stats,
             **gateway_stats,
+            **peer_rebuild_stats,
+            **gateway_warm_stats,
         }
         best.update(
             {

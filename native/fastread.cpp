@@ -24,40 +24,25 @@
 #include <fcntl.h>
 #include <limits.h>
 #include <stdlib.h>
-#include <sys/sendfile.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
 #include <sys/un.h>
 #include <unistd.h>
 
+// Shared byte-plane helpers (sendfile loop with portable fallback,
+// exact read/write) — the same header the native core's network plane
+// uses, so both .so's move bytes with identical semantics.
+#include "sn_net.h"
+
 namespace {
 
 bool read_exact(int fd, void* buf, size_t n) {
-  auto* p = static_cast<char*>(buf);
-  while (n > 0) {
-    ssize_t r = read(fd, p, n);
-    if (r <= 0) {
-      if (r < 0 && errno == EINTR) continue;
-      return false;
-    }
-    p += r;
-    n -= static_cast<size_t>(r);
-  }
-  return true;
+  return sn_net::read_full(fd, static_cast<uint8_t*>(buf), n, -1) ==
+         static_cast<int64_t>(n);
 }
 
 bool write_exact(int fd, const void* buf, size_t n) {
-  auto* p = static_cast<const char*>(buf);
-  while (n > 0) {
-    ssize_t r = write(fd, p, n);
-    if (r <= 0) {
-      if (r < 0 && errno == EINTR) continue;
-      return false;
-    }
-    p += r;
-    n -= static_cast<size_t>(r);
-  }
-  return true;
+  return sn_net::write_full(fd, static_cast<const uint8_t*>(buf), n, -1) == 0;
 }
 
 void send_error(int fd, const std::string& msg) {
@@ -118,32 +103,11 @@ void serve_conn(int fd, std::string root) {
       close(file);
       break;
     }
-    off_t off = static_cast<off_t>(offset);
-    uint64_t remaining = size;
-    bool ok = true;
-    while (remaining > 0) {
-      ssize_t sent = sendfile(fd, file, &off, remaining);
-      if (sent <= 0) {
-        if (sent < 0 && errno == EINTR) continue;
-        ok = false;  // kernel path failed: fall back to read+write
-        break;
-      }
-      remaining -= static_cast<uint64_t>(sent);
-    }
-    if (!ok && remaining > 0) {
-      // portable fallback (e.g. FUSE-backed files refusing sendfile)
-      std::string buf(1 << 20, '\0');
-      while (remaining > 0) {
-        size_t want = remaining < buf.size() ? remaining : buf.size();
-        ssize_t r = pread(file, buf.data(), want, off);
-        if (r <= 0) break;
-        if (!write_exact(fd, buf.data(), static_cast<size_t>(r))) break;
-        off += r;
-        remaining -= static_cast<uint64_t>(r);
-      }
-    }
+    // kernel-to-kernel, with the shared pread+write fallback (e.g.
+    // FUSE-backed files refusing sendfile) and its reusable buffer
+    int64_t sent = sn_net::send_file(fd, file, offset, size, -1);
     close(file);
-    if (remaining > 0) break;  // short transfer: connection is dead
+    if (sent != static_cast<int64_t>(size)) break;  // connection is dead
   }
   close(fd);
 }

@@ -22,7 +22,11 @@
 #include <thread>
 #include <vector>
 
+#include <fcntl.h>
+#include <sys/uio.h>
 #include <unistd.h>
+
+#include "sn_net.h"
 
 #if defined(__x86_64__)
 #include <immintrin.h>
@@ -510,6 +514,214 @@ int sn_fadvise_willneed(int fd, uint64_t off, uint64_t len) {
 }
 
 // ---------------------------------------------------------------------------
+// Network byte plane (ISSUE 12): socket egress/ingress primitives so a
+// byte served or rebuilt over the wire is copied (close to) once.
+//
+//   sn_send_file  - sendfile(2) a shard fd range straight into a socket
+//                   (kernel-to-kernel; transparent pread+write fallback
+//                   where the kernel path is unsupported);
+//   sn_sendv      - scatter-gather writev from caller buffers (pooled
+//                   aligned matrices, HTTP response bodies) without a
+//                   Python-side join or per-chunk GIL round trips;
+//   sn_recv_into  - land a socket stream DIRECTLY in a caller-owned
+//                   buffer (a pooled rebuild matrix row), rolling the
+//                   fused granule-CRC32C during the copy-in so sidecar
+//                   verify costs no extra byte pass.
+//
+// ctypes releases the GIL for each whole call; timeouts follow the
+// sn_net.h convention (Python settimeout sockets are O_NONBLOCK, so
+// EAGAIN polls instead of failing).
+// ---------------------------------------------------------------------------
+
+int64_t sn_send_file(int out_fd, int in_fd, uint64_t offset, uint64_t len,
+                     int timeout_ms) {
+    return sn_net::send_file(out_fd, in_fd, offset, len, timeout_ms);
+}
+
+// Scatter-gather send of n buffers. Returns total bytes sent (== sum of
+// lens on success) or -errno; a peer that dies mid-stream surfaces as
+// -EPIPE/-ECONNRESET, a stalled peer as -ETIMEDOUT.
+int64_t sn_sendv(int fd, const uint8_t* const* bufs, const uint64_t* lens,
+                 int n, int timeout_ms) {
+    int64_t total = 0;
+    int i = 0;
+    uint64_t off = 0;  // progress within bufs[i]
+    for (;;) {
+        while (i < n && off >= lens[i]) {
+            i++;
+            off = 0;
+        }
+        if (i >= n) return total;
+        struct iovec iov[64];
+        int cnt = 0;
+        for (int j = i; j < n && cnt < 64; j++) {
+            uint64_t skip = (j == i) ? off : 0;
+            if (lens[j] <= skip) continue;
+            iov[cnt].iov_base = const_cast<uint8_t*>(bufs[j]) + skip;
+            iov[cnt].iov_len = (size_t)(lens[j] - skip);
+            cnt++;
+        }
+        ssize_t w = writev(fd, iov, cnt);
+        if (w < 0) {
+            if (errno == EINTR) continue;
+            if (errno == EAGAIN || errno == EWOULDBLOCK) {
+                int rc = sn_net::wait_fd(fd, POLLOUT, timeout_ms);
+                if (rc != 0) return (int64_t)rc;
+                continue;
+            }
+            return -(int64_t)errno;
+        }
+        total += w;
+        uint64_t adv = (uint64_t)w;
+        while (i < n && adv) {
+            uint64_t rem = lens[i] - off;
+            if (adv >= rem) {
+                adv -= rem;
+                off = 0;
+                i++;
+            } else {
+                off += adv;
+                adv = 0;
+            }
+        }
+    }
+}
+
+// Receive up to `len` bytes from fd straight into dst. With granule>0
+// the rolling granule-CRC32C state (crc_state/filled_state, single-row
+// arrays persisting across calls if the caller chooses) advances over
+// the bytes WHILE they are cache-hot from the kernel copy-in; completed
+// granule CRCs append to out_crcs (*out_count total, -1 on overflow of
+// max_out). For large fused transfers the socket reads run on a helper
+// thread with the CRC chasing the landed bytes from the calling thread
+// — the verify OVERLAPS the wire instead of serializing behind it
+// (CRC32C is ~5 GB/s on small hosts; inline it would cap ingress well
+// below loopback/NIC speed). Returns bytes received — short means the
+// peer closed mid-stream (the caller's torn-stream contract) — or
+// -errno.
+
+static int64_t recv_plain(int fd, uint8_t* dst, uint64_t len,
+                          int timeout_ms, uint64_t* progress) {
+    uint64_t got = 0;
+    while (got < len) {
+        ssize_t r = read(fd, dst + got, (size_t)(len - got));
+        if (r < 0) {
+            if (errno == EINTR) continue;
+            if (errno == EAGAIN || errno == EWOULDBLOCK) {
+                int rc = sn_net::wait_fd(fd, POLLIN, timeout_ms);
+                if (rc != 0) return (int64_t)rc;
+                continue;
+            }
+            return -(int64_t)errno;
+        }
+        if (r == 0) break;  // peer closed
+        got += (uint64_t)r;
+        if (progress)
+            __atomic_store_n(progress, got, __ATOMIC_RELEASE);
+    }
+    return (int64_t)got;
+}
+
+// Transfers below this run the serial recv+CRC loop: a thread spawn
+// costs more than it buys on small ranges (leaf repairs, tails). The
+// overlap also needs spare cores: with fewer than 4 hardware threads
+// the CRC helper just steals CPU from the socket copy (and, on
+// loopback, from the peer's sendfile), measured slower than serial on
+// a 2-core host — those run serial too.
+#define SN_RECV_OVERLAP_MIN (256u * 1024u)
+#define SN_RECV_OVERLAP_MIN_CORES 4u
+
+int64_t sn_recv_into(int fd, uint8_t* dst, uint64_t len, int timeout_ms,
+                     uint32_t granule, uint32_t* crc_state,
+                     uint64_t* filled_state, uint32_t* out_crcs,
+                     int32_t* out_count, int32_t max_out) {
+    crc32c_table_init();
+    if (out_count) *out_count = 0;
+    if (granule == 0)
+        return recv_plain(fd, dst, len, timeout_ms, nullptr);
+    if (len < SN_RECV_OVERLAP_MIN ||
+        std::thread::hardware_concurrency() < SN_RECV_OVERLAP_MIN_CORES) {
+        // serial: recv then CRC the fresh bytes, chunk by chunk
+        uint64_t got = 0;
+        while (got < len) {
+            uint64_t before = got;
+            ssize_t r = read(fd, dst + got, (size_t)(len - got));
+            if (r < 0) {
+                if (errno == EINTR) continue;
+                if (errno == EAGAIN || errno == EWOULDBLOCK) {
+                    int rc = sn_net::wait_fd(fd, POLLIN, timeout_ms);
+                    if (rc != 0) return (int64_t)rc;
+                    continue;
+                }
+                return -(int64_t)errno;
+            }
+            if (r == 0) break;
+            got += (uint64_t)r;
+            int added = roll_crc_blocks(crc_state, filled_state, granule,
+                                        dst + before, (size_t)r,
+                                        out_crcs + *out_count,
+                                        max_out - *out_count);
+            if (added < 0) {
+                *out_count = -1;
+                return -EOVERFLOW;
+            }
+            *out_count += added;
+        }
+        return (int64_t)got;
+    }
+    // Overlapped: helper thread fills dst, this thread CRCs behind it.
+    uint64_t progress = 0;
+    int64_t recv_rc = 0;
+    bool done = false;
+    std::thread reader([&]() {
+        recv_rc = recv_plain(fd, dst, len, timeout_ms, &progress);
+        __atomic_store_n(&done, true, __ATOMIC_RELEASE);
+    });
+    uint64_t crc_done = 0;
+    bool overflow = false;
+    for (;;) {
+        uint64_t avail = __atomic_load_n(&progress, __ATOMIC_ACQUIRE);
+        bool finished = __atomic_load_n(&done, __ATOMIC_ACQUIRE);
+        if (avail > crc_done) {
+            int added = roll_crc_blocks(
+                crc_state, filled_state, granule, dst + crc_done,
+                (size_t)(avail - crc_done), out_crcs + *out_count,
+                max_out - *out_count);
+            if (added < 0) {
+                overflow = true;
+                break;
+            }
+            *out_count += added;
+            crc_done = avail;
+        } else if (finished) {
+            break;
+        } else {
+            std::this_thread::yield();
+        }
+    }
+    reader.join();
+    if (overflow) {
+        *out_count = -1;
+        return -EOVERFLOW;
+    }
+    if (recv_rc < 0) return recv_rc;
+    // CRC whatever landed after the last loop pass
+    uint64_t got = (uint64_t)recv_rc;
+    if (got > crc_done) {
+        int added = roll_crc_blocks(crc_state, filled_state, granule,
+                                    dst + crc_done, (size_t)(got - crc_done),
+                                    out_crcs + *out_count,
+                                    max_out - *out_count);
+        if (added < 0) {
+            *out_count = -1;
+            return -EOVERFLOW;
+        }
+        *out_count += added;
+    }
+    return recv_rc;
+}
+
+// ---------------------------------------------------------------------------
 // Stateful fused shard sink: the write half of the zero-copy data plane.
 // One handle per encode/rebuild stream; each append pwrite(2)s every
 // shard's row straight from the source buffer at an internally-tracked
@@ -522,9 +734,32 @@ int sn_fadvise_willneed(int fd, uint64_t off, uint64_t len) {
 // ---------------------------------------------------------------------------
 
 #define SN_SINK_EARLY_WB 1u
+// Opt-in O_DIRECT write path: bypass the page cache when (and only
+// while) every append stays 4096-aligned — pointer, width, and file
+// offset. The pooled matrices are 4096-aligned by construction, so
+// full batches qualify; the ragged tail (or a filesystem that accepts
+// the flag but rejects the write, e.g. 9p) transparently drops THAT
+// shard fd back to buffered and the stream continues bit-identically.
+#define SN_SINK_DIRECT 2u
+#define SN_DIRECT_ALIGN 4096u
+
+static int set_fd_direct(int fd, bool on) {
+#if defined(O_DIRECT)
+    int fl = fcntl(fd, F_GETFL);
+    if (fl < 0) return -1;
+    int nfl = on ? (fl | O_DIRECT) : (fl & ~O_DIRECT);
+    if (fl == nfl) return 0;
+    return fcntl(fd, F_SETFL, nfl) == 0 ? 0 : -1;
+#else
+    (void)fd;
+    (void)on;
+    return -1;
+#endif
+}
 
 struct SnSink {
     std::vector<int> fds;
+    std::vector<char> direct;     // shard currently writing O_DIRECT
     std::vector<uint64_t> off;    // next pwrite offset per shard
     uint32_t block_size;
     uint32_t leaf_size;           // 0 = v1 sidecar (block level only)
@@ -547,6 +782,11 @@ void* sn_sink_create(const int* fds, int n, uint32_t block_size,
     crc32c_table_init();
     SnSink* s = new SnSink();
     s->fds.assign(fds, fds + n);
+    s->direct.assign((size_t)n, 0);
+    if (flags & SN_SINK_DIRECT) {
+        for (int i = 0; i < n; i++)
+            s->direct[(size_t)i] = set_fd_direct(fds[i], true) == 0 ? 1 : 0;
+    }
     s->off.assign((size_t)n, 0);
     s->block_size = block_size;
     s->leaf_size = leaf_size;
@@ -559,11 +799,31 @@ void* sn_sink_create(const int* fds, int n, uint32_t block_size,
     return s;
 }
 
-static int pwrite_full(int fd, const uint8_t* p, size_t len, uint64_t off) {
+// Direct-aware shard write: while shard i is in O_DIRECT mode, keep it
+// there only for fully aligned appends; a misaligned append (the
+// ragged tail) or a write the filesystem rejects (EINVAL despite
+// accepting the flag) drops THAT fd back to buffered — transparently,
+// with the same bytes landing at the same offset.
+static int sink_pwrite(SnSink* s, int i, const uint8_t* p, size_t len,
+                       uint64_t off) {
+    if (s->direct[(size_t)i]) {
+        bool aligned = ((uintptr_t)p % SN_DIRECT_ALIGN == 0) &&
+                       (len % SN_DIRECT_ALIGN == 0) &&
+                       (off % SN_DIRECT_ALIGN == 0);
+        if (!aligned) {
+            set_fd_direct(s->fds[(size_t)i], false);
+            s->direct[(size_t)i] = 0;
+        }
+    }
     while (len) {
-        ssize_t w = pwrite(fd, p, len, (off_t)off);
+        ssize_t w = pwrite(s->fds[(size_t)i], p, len, (off_t)off);
         if (w < 0) {
             if (errno == EINTR) continue;
+            if (errno == EINVAL && s->direct[(size_t)i]) {
+                set_fd_direct(s->fds[(size_t)i], false);
+                s->direct[(size_t)i] = 0;
+                continue;  // retry buffered
+            }
             return -1;
         }
         p += w;
@@ -635,7 +895,7 @@ int sn_sink_append(void* handle, const uint8_t* const* rows, size_t width,
             if (out_leaf_counts) out_leaf_counts[i] = 0;
         }
         uint64_t at = s->off[i];
-        if (pwrite_full(s->fds[i], rows[i], width, at) != 0) {
+        if (sink_pwrite(s, i, rows[i], width, at) != 0) {
             status[i] = -1;
             return;
         }
@@ -691,6 +951,14 @@ int sn_sink_finish(void* handle, uint32_t* tail_block_crc,
         s->lcrc[i] = 0;
     }
     return 0;
+}
+
+// Per-shard O_DIRECT state (1 = still writing O_DIRECT): lets callers
+// and tests observe whether the direct path engaged or fell back.
+int sn_sink_direct_flags(void* handle, uint8_t* out) {
+    SnSink* s = (SnSink*)handle;
+    for (size_t i = 0; i < s->fds.size(); i++) out[i] = (uint8_t)s->direct[i];
+    return (int)s->fds.size();
 }
 
 void sn_sink_destroy(void* handle) {

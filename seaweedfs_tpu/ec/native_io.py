@@ -6,7 +6,11 @@ caller-owned aligned numpy matrices that flow produce -> transform ->
 consume untouched (numpy views over one allocation — no `bytes`
 objects, no per-batch malloc/page-fault churn), then return to a small
 pool. The write half is the stateful native sink (utils/native.py
-NativeSink, used by pipeline.FusedShardSink).
+NativeSink, used by pipeline.FusedShardSink). The NETWORK half lives
+in ec/net_plane.py (ISSUE 12): the same BufferPool class backs the
+peer-fetch ingress landings and the fastread client, and `enabled()`
+below is the single gate every plane (local, wire, HTTP egress)
+checks.
 
 Buffer-ownership rules (README "Native data plane" has the long form):
 
@@ -67,6 +71,26 @@ def aligned_matrix(rows: int, width: int, align: int = _ALIGN) -> np.ndarray:
     raw = np.empty(rows * width + align, dtype=np.uint8)
     off = (-raw.ctypes.data) % align
     return raw[off : off + rows * width].reshape(rows, width)
+
+
+_landing_pool_singleton = None
+_landing_pool_lock = None
+
+
+def landing_pool() -> "BufferPool":
+    """Process-wide width-keyed pool of 1-row aligned landing buffers,
+    shared by every single-stream ingress (peer-fetch net-plane
+    landings, the fastread client) so steady state allocates once per
+    width and reuses forever."""
+    global _landing_pool_singleton, _landing_pool_lock
+    if _landing_pool_lock is None:
+        import threading as _t
+
+        _landing_pool_lock = _t.Lock()
+    with _landing_pool_lock:
+        if _landing_pool_singleton is None:
+            _landing_pool_singleton = BufferPool(rows=1)
+        return _landing_pool_singleton
 
 
 class BufferPool:

@@ -1,0 +1,568 @@
+"""Native shard byte plane: the network half of the zero-copy EC path.
+
+PR 10 made the LOCAL byte path native; every network byte still
+round-tripped through Python — `VolumeEcShardRead` serializes pooled
+buffers into Python gRPC messages, and peer-fetch rebuild re-buffers
+fetched ranges through `bytes`. This module is the wire twin of that
+RPC (the analog of the reference architecture's native RDMA data-plane
+engine, PAPER.md layer map): a tiny TCP sidecar next to each volume
+server's gRPC port that serves EC shard byte ranges with
+
+- **native egress**: `sn_send_file` splices the shard fd straight into
+  the socket (sendfile(2), kernel-to-kernel, GIL released) — Python
+  touches only the 38-byte request header (+ trace metadata);
+- **native ingress**: the client lands streams DIRECTLY in caller-owned
+  pooled 4096-aligned buffers (`sn_recv_into`) with the fused
+  granule-CRC32C rolling during the copy-in, so the sidecar verify in
+  ec/peer_rebuild.py costs no extra byte pass.
+
+The plane is an ACCELERATOR, not a dependency: gRPC `VolumeEcShardRead`
+remains the canonical, generation-fenced transport and the
+bit-identical fallback. Fallback routing (the same contract as PR 10's
+local plane):
+
+- `SEAWEED_EC_NATIVE=0` or a missing .so: callers never take this path
+  (ec/native_io.enabled() is the single gate);
+- an ARMED fault registry: the server answers through the Python
+  pread/sendall path so byte-mutating chaos has materialized bytes to
+  chew on, and peer_rebuild routes its client side to the Python fetch
+  — the PR 6/8/11 chaos contracts hold unchanged;
+- a peer without the sidecar (older build, port collision): the client
+  memoizes the refusal and raises :class:`NetPlaneUnavailable`, which
+  peer_rebuild turns into a per-stream fallback to the gRPC fetch.
+
+Protocol (little-endian, persistent connection, one in-flight request
+per connection):
+
+    request:  b"SWNP" | u32 volume_id | u32 shard_id | u64 generation
+              | u64 offset | u64 size | u16 meta_len       (38 bytes)
+              | meta_len bytes of "key\\tvalue" lines — the SAME
+              x-sw-trace-id / x-sw-parent-span / x-request-id metadata
+              the gRPC stream carries, so a peer-fetch over the native
+              plane still lands in the dispatcher's ONE trace (the
+              PR 7 cross-RPC contract holds transport-independently)
+    response: u8 status | u64 n | n bytes
+              status 0 = ok (n = payload length, may be < size at EOF);
+              status 1 = error (n = UTF-8 message length)
+
+The sidecar listens on ``grpc_port + NET_PLANE_PORT_OFFSET`` so peers
+derive its address from the holder map's gRPC address without any new
+topology plumbing; a dead port is just a memoized fallback.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+from .. import faults
+from ..utils import metrics as M
+from ..utils import request_id as _rid
+from ..utils import trace
+from ..utils.glog import logger
+
+log = logger("ec.netplane")
+
+MAGIC = b"SWNP"
+# magic, volume, shard, gen, offset, size, meta_len
+_REQ = struct.Struct("<4sIIQQQH")
+_RESP = struct.Struct("<BQ")      # status, n
+NET_PLANE_PORT_OFFSET = 10000     # net plane port = grpc port + this
+
+_SEND_CHUNK = 1 << 20             # python-plane egress chunking
+_MAX_REQUEST = 1 << 32
+_MAX_META = 4096
+
+
+def _encode_meta() -> bytes:
+    """The active request-id / trace context as a metadata blob —
+    exactly what trace.grpc_metadata() would put on the RPC."""
+    md = trace.grpc_metadata()
+    if not md:
+        return b""
+    blob = "\n".join(f"{k}\t{v}" for k, v in md).encode()
+    return blob[:_MAX_META]
+
+
+def _decode_meta(blob: bytes) -> dict:
+    md: dict = {}
+    for line in blob.decode(errors="replace").splitlines():
+        k, _, v = line.partition("\t")
+        if k and v:
+            md[k.lower()] = v
+    return md
+
+
+class NetPlaneError(Exception):
+    """Transport/protocol failure on an established plane connection —
+    transient from the caller's point of view (retry or fall back)."""
+
+
+class NetPlaneUnavailable(Exception):
+    """The peer serves no shard net plane (connect refused / bad
+    protocol greeting). Memoized per peer; callers route the stream to
+    the gRPC fetch instead."""
+
+
+def derive_port(grpc_port: int) -> int:
+    """Net-plane port derived from a gRPC port — the SAME pure function
+    on the serving and connecting side, so no topology plumbing is
+    needed. High ephemeral gRPC ports wrap back into the valid range
+    deterministically; a collision there just fails the bind (server:
+    plane disabled with one warning) or the connect (client: memoized
+    gRPC fallback)."""
+    p = grpc_port + NET_PLANE_PORT_OFFSET
+    if p > 65535:
+        p = 1024 + (p % 64512)
+    return p
+
+
+def net_addr(grpc_peer: str) -> tuple[str, int]:
+    """Net-plane (host, port) derived from a holder-map gRPC address."""
+    host, _, port = grpc_peer.rpartition(":")
+    return host, derive_port(int(port))
+
+
+def _native_mod():
+    try:
+        from ..utils import native
+
+        return native
+    except ImportError:
+        return None
+
+
+def egress_native() -> bool:
+    """True when the server side should splice with sendfile: native
+    plane on AND the fault registry disarmed (byte-mutating chaos needs
+    materialized bytes — the armed registry routes to the Python
+    egress, same contract as the local plane)."""
+    from . import native_io
+
+    return native_io.enabled() and not faults.active()
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise NetPlaneError("connection closed mid-message")
+        got += r
+    return bytes(buf)
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+
+class ShardNetPlane:
+    """TCP sidecar serving EC shard byte ranges — the native twin of the
+    ``VolumeEcShardRead`` gRPC stream, sharing its semantics (generation
+    fence, short-read-at-EOF torn-stream contract, the
+    ``server.ec_shard_read`` chaos point) but not its byte path.
+
+    ``resolve(volume_id, shard_id, generation) -> (fd, size)`` supplies
+    the shard fd and its byte size; it raises :class:`NetPlaneError`
+    with the refusal message (not mounted / stale generation / shard
+    not local). The server never closes resolved fds — they belong to
+    the store's mounted EC volume, exactly like the gRPC servicer.
+    """
+
+    def __init__(self, ip: str, port: int, resolve,
+                 request_timeout: float = 60.0, server_label: str = ""):
+        self.resolve = resolve
+        self.request_timeout = request_timeout
+        self.server_label = server_label
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((ip, port))
+        self._sock.listen(128)
+        self.ip, self.port = self._sock.getsockname()[:2]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="shard-net-plane"
+        )
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
+        self.requests = 0
+        self.sendfile_bytes = 0
+        self.python_bytes = 0
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._thread.join(timeout=2.0)
+
+    # ------------------------------------------------------------ serving
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # listener closed
+            with self._conns_lock:
+                self._conns.add(conn)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(self.request_timeout)
+            while not self._stop.is_set():
+                try:
+                    hdr = _recv_exact(conn, _REQ.size)
+                except (NetPlaneError, OSError):
+                    return  # client went away between requests
+                magic, vid, sid, gen, off, size, mlen = _REQ.unpack(hdr)
+                if magic != MAGIC or size > _MAX_REQUEST or mlen > _MAX_META:
+                    return  # not our protocol: drop the connection
+                try:
+                    md = _decode_meta(_recv_exact(conn, mlen)) if mlen else {}
+                except (NetPlaneError, OSError):
+                    return
+                self.requests += 1
+                # Observability parity with the gRPC stream: adopt the
+                # caller's request id + trace context and open the SAME
+                # rpc.ec_shard_read span — a peer-fetch heal stays ONE
+                # trace whichever transport carried the bytes.
+                _rid.ensure(md.get(trace.REQUEST_ID_KEY))
+                sp = trace.start_from_metadata(
+                    "rpc.ec_shard_read", md, server=self.server_label,
+                    volume=vid, shard=sid, offset=off, size=size,
+                    plane="native",
+                )
+                t0 = time.perf_counter()
+                try:
+                    ok = self._serve_one(conn, vid, sid, gen, off, size)
+                finally:
+                    trace.add_stage(sp, "stream", time.perf_counter() - t0)
+                    trace.finish(sp)
+                if not ok:
+                    return
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _error(self, conn, msg: str) -> bool:
+        body = msg.encode(errors="replace")
+        try:
+            conn.sendall(_RESP.pack(1, len(body)) + body)
+            return True
+        except OSError:
+            return False
+
+    def _serve_one(self, conn, vid, sid, gen, off, size) -> bool:
+        """Serve one range request; False = connection must close."""
+        try:
+            # Same named chaos point as the gRPC servicer: a raised
+            # IOError is a refused stream (client replans); a mutate is
+            # applied on the PYTHON egress below — the armed registry
+            # routes there, never through sendfile.
+            faults.fire("server.ec_shard_read", volume=vid, shard=sid)
+        except IOError as e:
+            return self._error(conn, str(e))
+        try:
+            fd, fsize = self.resolve(vid, sid, gen)
+        except NetPlaneError as e:
+            return self._error(conn, str(e))
+        n = max(0, min(size, fsize - off)) if off < fsize else 0
+        try:
+            conn.sendall(_RESP.pack(0, n))
+        except OSError:
+            return False
+        if n == 0:
+            return True
+        native = _native_mod() if egress_native() else None
+        if native is not None:
+            try:
+                sent = native.send_file(
+                    conn.fileno(), fd, off, n,
+                    timeout_ms=int(self.request_timeout * 1000),
+                )
+            except OSError:
+                return False  # peer died mid-splice: header already out
+            self.sendfile_bytes += sent
+            M.net_bytes_sent_total.inc(sent, plane="native")
+            return sent == n
+        # Python egress (fallback plane / armed registry): pread ->
+        # mutate -> sendall, byte-identical to the gRPC stream's
+        # chunking. A mutate that shrinks the chunk tears the stream,
+        # which the client must catch — never served silently.
+        remaining, o = n, off
+        while remaining > 0:
+            chunk = os.pread(fd, min(_SEND_CHUNK, remaining), o)
+            if not chunk:
+                break
+            orig = len(chunk)
+            chunk = faults.mutate(
+                "server.ec_shard_read", chunk, volume=vid, shard=sid, offset=o
+            )
+            M.net_bytes_copied_total.inc(orig, plane="python")
+            try:
+                if chunk:
+                    conn.sendall(chunk)
+            except OSError:
+                return False
+            self.python_bytes += len(chunk)
+            M.net_bytes_sent_total.inc(len(chunk), plane="python")
+            if len(chunk) < orig:
+                return False  # torn stream: connection is dead
+            o += orig
+            remaining -= orig
+        return remaining == 0
+
+    def status(self) -> dict:
+        """Sidecar state for /status and /debug/gateway surfaces."""
+        return {
+            "port": self.port,
+            "requests": self.requests,
+            "sendfile_bytes": self.sendfile_bytes,
+            "python_bytes": self.python_bytes,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+
+
+class NetPlaneClient:
+    """Pooled client connections to peers' shard net planes, landing
+    payload bytes straight in caller buffers (``sn_recv_into``) with the
+    fused granule CRC rolled during the copy-in.
+
+    One cached connection per peer address (requests on one address are
+    serialized — peer-fetch streams one shard from a given holder at a
+    time, so the lock is uncontended on the rebuild path). A peer whose
+    plane port refuses the connect is memoized and every later call
+    raises :class:`NetPlaneUnavailable` immediately.
+    """
+
+    def __init__(self, timeout: float = 30.0, connect_timeout: float = 2.0):
+        self.timeout = timeout
+        self.connect_timeout = connect_timeout
+        self._conns: dict[tuple[str, int], socket.socket] = {}
+        self._locks: dict[tuple[str, int], threading.Lock] = {}
+        self._no_plane: set[tuple[str, int]] = set()
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def _addr_lock(self, addr) -> threading.Lock:
+        with self._lock:
+            return self._locks.setdefault(addr, threading.Lock())
+
+    def _conn(self, addr) -> socket.socket:
+        with self._lock:
+            if addr in self._no_plane:
+                raise NetPlaneUnavailable(f"{addr[0]}:{addr[1]}")
+            s = self._conns.get(addr)
+        if s is not None:
+            return s
+        try:
+            s = socket.create_connection(addr, timeout=self.connect_timeout)
+        except OSError as e:
+            with self._lock:
+                self._no_plane.add(addr)
+            raise NetPlaneUnavailable(f"{addr[0]}:{addr[1]}: {e}") from e
+        s.settimeout(self.timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with self._lock:
+            self._conns[addr] = s
+        return s
+
+    def _drop(self, addr) -> None:
+        with self._lock:
+            s = self._conns.pop(addr, None)
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _request(self, addr, vid, sid, gen, off, size) -> socket.socket:
+        """Send one range request, parse the response header, return the
+        connection positioned at the payload (exactly `size` bytes —
+        a server-side clamp or refusal raises)."""
+        s = self._conn(addr)
+        meta = _encode_meta()
+        try:
+            s.sendall(
+                _REQ.pack(MAGIC, vid, sid, gen, off, size, len(meta)) + meta
+            )
+            head = _recv_exact(s, _RESP.size)
+        except (OSError, NetPlaneError) as e:
+            self._drop(addr)
+            raise NetPlaneError(f"{addr}: {e}") from e
+        status, n = _RESP.unpack(head)
+        if status != 0:
+            try:
+                msg = _recv_exact(s, n).decode(errors="replace")
+            except (OSError, NetPlaneError):
+                self._drop(addr)
+                msg = "(error body lost)"
+            raise NetPlaneError(f"{addr}: {msg}")
+        if n != size:
+            # EOF clamp — the gRPC stream's short read. The connection
+            # still holds n payload bytes; cheaper to drop it than to
+            # drain and resync.
+            self._drop(addr)
+            raise NetPlaneError(f"{addr}: short stream {n}/{size}")
+        return s
+
+    def read_into(
+        self,
+        addr: tuple[str, int],
+        vid: int,
+        sid: int,
+        gen: int,
+        off: int,
+        size: int,
+        dst: np.ndarray,
+        *,
+        granule: int = 0,
+    ) -> np.ndarray | None:
+        """Land `size` bytes of shard `sid` @`off` DIRECTLY in `dst`
+        (1-D C-contiguous uint8 view of a pooled aligned buffer). With
+        granule > 0 returns the granule CRCs rolled during the copy-in
+        (completed granules plus the partial tail) as a u32 ndarray —
+        the caller compares them against the .ecsum sidecar with no
+        extra pass over the bytes."""
+        native = _native_mod()
+        with self._addr_lock(addr):
+            return self._read_into_locked(
+                addr, vid, sid, gen, off, size, dst,
+                granule=granule, native=native,
+            )
+
+    def _read_into_locked(
+        self, addr, vid, sid, gen, off, size, dst, *, granule, native
+    ):
+        s = self._request(addr, vid, sid, gen, off, size)
+        try:
+            if native is not None:
+                crc_state = np.zeros(1, np.uint32)
+                filled = np.zeros(1, np.uint64)
+                max_out = (size // granule + 2) if granule else 1
+                out_crcs = np.zeros(max_out, np.uint32)
+                out_counts = np.zeros(1, np.int32)
+                got = native.recv_into(
+                    s.fileno(), dst, size,
+                    timeout_ms=int(self.timeout * 1000),
+                    granule=granule, crc_state=crc_state,
+                    filled_state=filled, out_crcs=out_crcs,
+                    out_counts=out_counts,
+                )
+                if got != size:
+                    raise NetPlaneError(
+                        f"{addr}: torn stream {got}/{size}"
+                    )
+                M.net_bytes_received_total.inc(got, plane="native")
+                if not granule:
+                    return None
+                crcs = list(out_crcs[: int(out_counts[0])])
+                if size % granule:
+                    crcs.append(int(crc_state[0]))
+                return np.asarray(crcs, dtype=np.uint32)
+            # Python landing (no .so): same buffer, Python recv loop.
+            view = memoryview(dst)[:size]
+            got = 0
+            while got < size:
+                r = s.recv_into(view[got:], size - got)
+                if r == 0:
+                    raise NetPlaneError(f"{addr}: torn stream {got}/{size}")
+                got += r
+            M.net_bytes_received_total.inc(got, plane="python")
+            if not granule:
+                return None
+            from ..utils.crc import crc32c as _crc
+
+            return np.array(
+                [
+                    _crc(dst[i : min(i + granule, size)])
+                    for i in range(0, size, granule)
+                ],
+                dtype=np.uint32,
+            )
+        except (OSError, NetPlaneError) as e:
+            self._drop(addr)
+            if isinstance(e, NetPlaneError):
+                raise
+            raise NetPlaneError(f"{addr}: {e}") from e
+
+    def read_bytes(
+        self, addr, vid, sid, gen, off, size
+    ) -> bytes:
+        """Python-plane fetch over the same wire: materializes the
+        payload as `bytes` (counted against the python plane's
+        copied/received totals). Used by granule re-reads and by the
+        bench's same-transport Python-plane comparison."""
+        with self._addr_lock(addr):
+            s = self._request(addr, vid, sid, gen, off, size)
+            try:
+                data = _recv_exact(s, size)
+            except (OSError, NetPlaneError) as e:
+                self._drop(addr)
+                raise NetPlaneError(f"{addr}: {e}") from e
+        M.net_bytes_received_total.inc(size, plane="python")
+        M.net_bytes_copied_total.inc(size, plane="python")
+        return data
+
+
+def make_fetch_into(client: NetPlaneClient, vid: int, generation: int,
+                    addr_of=net_addr):
+    """Adapt a :class:`NetPlaneClient` to peer_rebuild's injected
+    ``fetch_into(peer, sid, off, size, dst, granule)`` transport,
+    translating plane exceptions into the rebuild's retry/fallback
+    vocabulary (NetPlaneError -> PeerFetchTransient, NetPlaneUnavailable
+    -> PeerPlaneUnavailable)."""
+    from .peer_rebuild import PeerFetchTransient, PeerPlaneUnavailable
+
+    def fetch_into(peer, sid, off, size, dst, granule):
+        try:
+            return client.read_into(
+                addr_of(peer), vid, sid, generation, off, size, dst,
+                granule=granule,
+            )
+        except NetPlaneUnavailable as e:
+            raise PeerPlaneUnavailable(str(e)) from e
+        except NetPlaneError as e:
+            raise PeerFetchTransient(str(e)) from e
+
+    return fetch_into

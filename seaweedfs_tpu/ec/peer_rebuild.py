@@ -52,6 +52,8 @@ import shutil
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
+import numpy as np
+
 from .. import faults
 from ..utils import metrics as M
 from ..utils import trace
@@ -84,6 +86,12 @@ DEFAULT_FETCH_POLICY = RetryPolicy(
 # per chunk (bounded memory, early corrupt-peer detection).
 FETCH_CHUNK = 1 << 20
 
+# The native ingress lands in pooled reusable buffers, so it can afford
+# a wider window per request: fewer header round trips and Python-level
+# chunk turnarounds on the zero-copy path (memory cost is one pooled
+# matrix per in-flight stream, reused forever).
+NATIVE_FETCH_CHUNK = 4 << 20
+
 STAGING_PREFIX = ".peerfetch-"
 
 
@@ -91,6 +99,13 @@ class PeerFetchTransient(Exception):
     """One fetch attempt failed in a retryable way (RPC error, short or
     torn stream). `fetch` implementations raise this for transport
     errors; persistent transients abandon the holder, not the plan."""
+
+
+class PeerPlaneUnavailable(Exception):
+    """The peer serves no native shard byte plane (ec/net_plane.py):
+    `fetch_into` implementations raise this so the stream falls back to
+    the Python `fetch` transport — a capability miss, not a failure,
+    so it is never retried and never excludes the holder."""
 
 
 class PeerCorruptError(Exception):
@@ -112,6 +127,10 @@ class PeerRebuildReport:
 
     rebuilt: list[int] = field(default_factory=list)
     fetched: dict[int, str] = field(default_factory=dict)  # sid -> peer
+    # Which byte plane carried each fetched stream ("native" = zero-copy
+    # net-plane ingress straight into pooled aligned buffers, "python" =
+    # the bit-identical gRPC/bytes fallback).
+    fetched_plane: dict[int, str] = field(default_factory=dict)
     local_sources: list[int] = field(default_factory=list)
     corrupt_local: list[int] = field(default_factory=list)
     excluded_peers: list[str] = field(default_factory=list)
@@ -175,13 +194,143 @@ def _fetch_shard_verified(
     ctx: ECContext,
     fetch,
     policy: RetryPolicy,
-) -> None:
-    """Stream one whole shard from `peer` into staging, rolling the
-    sidecar CRC per granule as the bytes land. Raises PeerCorruptError
-    when a granule mismatches even after one immediate re-read (the
+    fetch_into=None,
+) -> str:
+    """Stream one whole shard from `peer` into staging, verifying the
+    sidecar CRC per granule as the bytes land, and return the plane
+    that carried it ("native" | "python"). Raises PeerCorruptError when
+    a granule mismatches even after one immediate re-read (the
     transient-wire-corruption escape), PeerFetchTransient/RetryError
     when the peer stays unreachable. Publishes atomically INSIDE
-    staging; a partial download never looks like a shard."""
+    staging; a partial download never looks like a shard.
+
+    The native plane (`fetch_into` provided, native_io enabled, fault
+    registry disarmed) lands each chunk DIRECTLY in a pooled aligned
+    buffer with the granule CRC fused into the copy-in; the Python
+    plane is the bit-identical `fetch`-based fallback, which also
+    carries every stream whenever chaos is armed (byte-mutating fault
+    points need materialized bytes)."""
+    if fetch_into is not None:
+        from . import native_io
+
+        if native_io.enabled() and not faults.active():
+            try:
+                _fetch_shard_stream_native(
+                    sbase, peer, sid, prot, ctx, fetch, fetch_into, policy
+                )
+                return "native"
+            except PeerPlaneUnavailable as e:
+                log.info(
+                    "peer %s has no native shard plane (%s); falling back "
+                    "to the python fetch", peer, e,
+                )
+    _fetch_shard_stream_python(sbase, peer, sid, prot, ctx, fetch, policy)
+    return "python"
+
+
+def _fetch_shard_stream_native(
+    sbase: str,
+    peer: str,
+    sid: int,
+    prot: BitrotProtection,
+    ctx: ECContext,
+    fetch,
+    fetch_into,
+    policy: RetryPolicy,
+) -> None:
+    """Native ingress: `fetch_into(peer, sid, off, size, dst, granule)`
+    lands each granule-aligned chunk straight into a pooled 4096-aligned
+    buffer and returns the granule CRCs rolled DURING the copy-in, so
+    the verify-and-exclude pass below compares integers against the
+    sidecar instead of re-reading bytes. A mismatched granule gets one
+    immediate byte-level re-read through `fetch` (the transient-wire-
+    corruption escape); a repeat mismatch excludes the holder. The
+    staging file is written with raw unbuffered I/O straight from the
+    landing buffer — socket to matrix to disk, one userspace copy
+    total."""
+    gsize, gcrcs = prot.verify_granularity(sid)
+    size = prot.shard_sizes[sid]
+    chunk = max(NATIVE_FETCH_CHUNK - NATIVE_FETCH_CHUNK % gsize, gsize)
+    dest = sbase + ctx.to_ext(sid)
+    tmp = dest + ".fetching"
+    from .native_io import landing_pool
+
+    pool = landing_pool()
+    buf = pool.get(chunk)
+    sp = trace.start(
+        "ec.peer_fetch", name=f"shard {sid} <- {peer}",
+        peer=peer, shard=sid, bytes=size, plane="native",
+    )
+    try:
+        with open(tmp, "wb", buffering=0) as f:
+            off = 0
+            gi = 0
+            while off < size:
+                n = min(chunk, size - off)
+                row = buf[0, :n]
+
+                def attempt(off=off, n=n, row=row):
+                    return fetch_into(peer, sid, off, n, row, gsize)
+
+                with trace.stage(sp, "peer_fetch"):
+                    crcs = retry_call(
+                        attempt, policy, retry_on=(PeerFetchTransient,),
+                        describe=f"peer fetch {peer} shard {sid}",
+                    )
+                with trace.stage(sp, "crc_verify"):
+                    ngr = (n + gsize - 1) // gsize
+                    if crcs is None or len(crcs) != ngr:
+                        raise PeerFetchTransient(
+                            f"native ingress returned {0 if crcs is None else len(crcs)} "
+                            f"granule CRCs for {ngr} granules"
+                        )
+                    for j in range(ngr):
+                        if gi + j < len(gcrcs) and int(crcs[j]) == gcrcs[gi + j]:
+                            continue
+                        # one immediate byte-level re-read of ONLY this
+                        # granule rules out transient wire corruption; a
+                        # repeat mismatch is the peer serving rot
+                        lo = j * gsize
+                        glen = min(gsize, n - lo)
+
+                        def reread(off=off, lo=lo, glen=glen):
+                            return fetch(peer, sid, off + lo, glen)
+
+                        g2 = retry_call(
+                            reread, policy, retry_on=(PeerFetchTransient,),
+                            describe=f"peer fetch {peer} shard {sid}",
+                        )
+                        if gi + j >= len(gcrcs) or crc32c(g2) != gcrcs[gi + j]:
+                            raise PeerCorruptError(peer, sid, gi + j)
+                        row[lo : lo + glen] = np.frombuffer(g2, dtype=np.uint8)
+                    gi += ngr
+                with trace.stage(sp, "write_sink"):
+                    mv = memoryview(row)
+                    while mv:
+                        mv = mv[f.write(mv):]
+                off += n
+            with trace.stage(sp, "fsync_publish"):
+                os.fsync(f.fileno())
+        os.replace(tmp, dest)
+    finally:
+        pool.put(buf)
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        trace.finish(sp)
+
+
+def _fetch_shard_stream_python(
+    sbase: str,
+    peer: str,
+    sid: int,
+    prot: BitrotProtection,
+    ctx: ECContext,
+    fetch,
+    policy: RetryPolicy,
+) -> None:
+    """Python-plane whole-shard stream (the PR 6 byte path, unchanged):
+    `fetch` materializes bytes, the granule CRC is rolled over them as
+    they land, and byte-mutating chaos points apply at the seams."""
     gsize, gcrcs = prot.verify_granularity(sid)
     size = prot.shard_sizes[sid]
     chunk = max(FETCH_CHUNK - FETCH_CHUNK % gsize, gsize)
@@ -340,6 +489,7 @@ def rebuild_from_peers(
     scheduler=None,
     priority: str = "recovery",
     policy: RetryPolicy = DEFAULT_FETCH_POLICY,
+    fetch_into=None,
 ) -> PeerRebuildReport:
     """Regenerate `targets` for the volume at `base`, fetching sibling
     source shards from peer holders when fewer than k verified-good
@@ -348,6 +498,13 @@ def rebuild_from_peers(
     `holders` maps shard id -> peer ids that serve it (the LOCAL server
     must already be excluded); `fetch(peer, shard_id, offset, size)`
     returns exactly `size` bytes or raises PeerFetchTransient.
+    `fetch_into(peer, shard_id, offset, size, dst, granule)` is the
+    OPTIONAL native-plane transport (ec/net_plane.make_fetch_into):
+    lands the range directly in `dst` and returns the granule CRCs
+    rolled during the copy-in, raises PeerPlaneUnavailable for peers
+    without the plane — whole-shard streams then ride it whenever the
+    native plane is enabled and the fault registry is disarmed, with
+    the `fetch` path as the bit-identical fallback.
     `targets=None` regenerates every shard that is not locally
     verified-good; an explicit list restricts regeneration to those ids
     (the server passes its legitimate-set union cluster-lost, the same
@@ -397,7 +554,7 @@ def rebuild_from_peers(
         with trace.activate(sp):
             return _rebuild_from_peers_span(
                 base, holders, fetch, ctx, targets, backend, scheduler,
-                priority, policy, prot, ecsum, k, sp,
+                priority, policy, prot, ecsum, k, sp, fetch_into,
             )
     finally:
         trace.finish(sp)
@@ -405,7 +562,7 @@ def rebuild_from_peers(
 
 def _rebuild_from_peers_span(
     base, holders, fetch, ctx, targets, backend, scheduler, priority,
-    policy, prot, ecsum, k, sp,
+    policy, prot, ecsum, k, sp, fetch_into=None,
 ) -> PeerRebuildReport:
     report = PeerRebuildReport()
     present = [
@@ -548,8 +705,9 @@ def _rebuild_from_peers_span(
                 if peer in excluded:
                     continue
                 try:
-                    _fetch_shard_verified(
-                        sbase, peer, sid, prot, ctx, fetch, policy
+                    plane = _fetch_shard_verified(
+                        sbase, peer, sid, prot, ctx, fetch, policy,
+                        fetch_into=fetch_into,
                     )
                 except PeerCorruptError as e:
                     # verify-and-exclude across the wire: this holder
@@ -565,6 +723,7 @@ def _rebuild_from_peers_span(
                     continue
                 sources.add(sid)
                 report.fetched[sid] = peer
+                report.fetched_plane[sid] = plane
                 break
         report.excluded_peers = sorted(excluded)
         if len(sources) < k:

@@ -409,6 +409,16 @@ class FusedShardSink:
     disks with deep page caches, a loss on filesystems whose write(2)
     is already synchronous (measured -15% on 9p), so it defaults to the
     SEAWEED_EC_EARLY_WB env knob (off unless "1").
+
+    `direct` opts the shard fds into O_DIRECT (page-cache-bypassing)
+    writes WHILE every append stays 4096-aligned: the pooled matrices
+    are 4096-aligned by construction, so full batches qualify, and the
+    ragged tail (or a filesystem that rejects the flag/write — 9p)
+    drops that fd back to buffered transparently, bit-identically.
+    Defaults to the SEAWEED_EC_ODIRECT env knob (off unless "1"): a win
+    for encode/rebuild streams larger than RAM (no page-cache
+    eviction storm at fsync), pointless when the page cache absorbs
+    the volume anyway.
     """
 
     def __init__(
@@ -417,6 +427,7 @@ class FusedShardSink:
         block_size: int = BITROT_BLOCK_SIZE,
         leaf_size: int = 0,
         early_writeback: bool | None = None,
+        direct: bool | None = None,
     ):
         import os as _os
 
@@ -426,6 +437,8 @@ class FusedShardSink:
             early_writeback = (
                 _os.environ.get("SEAWEED_EC_EARLY_WB", "0") == "1"
             )
+        if direct is None:
+            direct = _os.environ.get("SEAWEED_EC_ODIRECT", "0") == "1"
         if leaf_size and block_size % leaf_size != 0:
             raise ECError(
                 f"leaf size {leaf_size} does not divide block size {block_size}"
@@ -435,13 +448,24 @@ class FusedShardSink:
         self.block_size = block_size
         self.leaf_size = leaf_size
         self._sink = native.NativeSink(
-            self.fds, block_size, leaf_size, early_writeback=early_writeback
+            self.fds, block_size, leaf_size,
+            early_writeback=early_writeback, direct=direct,
         )
         self.crcs: list[list[int]] = [[] for _ in range(n)]
         self._leaf_crcs: list[list[int]] = [[] for _ in range(n)]
         self.sizes = [0] * n
         self._out: tuple | None = None
         self._finished = False
+        self._direct_flags = None
+
+    def direct_flags(self):
+        """Per-shard O_DIRECT engagement (u8[n], 1 = still direct) —
+        whether the page-cache bypass survived this stream's alignment;
+        all-zero when SEAWEED_EC_ODIRECT is off or the fs refused.
+        Snapshotted at finish (the native handle is freed there)."""
+        if self._direct_flags is not None:
+            return self._direct_flags
+        return self._sink.direct_flags()
 
     def append_rows(self, rows: Sequence[np.ndarray]) -> None:
         """Append one equal-width batch to every shard stream; rows[i]
@@ -491,6 +515,7 @@ class FusedShardSink:
         if self._finished:
             return
         self._finished = True
+        self._direct_flags = self._sink.direct_flags()
         tb, tbv, tl, tlv, _sizes = self._sink.finish()
         for i in range(len(self.fds)):
             if tbv[i]:

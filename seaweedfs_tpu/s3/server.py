@@ -226,7 +226,13 @@ class S3Server:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 if self.command != "HEAD" and body:
-                    self.wfile.write(body)
+                    # Warm-path GET bodies leave through the native
+                    # scatter-gather sender when the pooled front end +
+                    # native plane are on (GIL released for the whole
+                    # send); bit-identical wfile fallback otherwise.
+                    from ..utils.http_pool import send_body
+
+                    send_body(self, body)
 
             def _error(self, code: int, s3code: str, msg: str):
                 root = ET.Element("Error")

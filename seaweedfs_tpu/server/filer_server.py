@@ -252,7 +252,11 @@ class FilerServer:
                     self.send_header("ETag", f'"{entry.attr.md5.hex()}"')
                 self.end_headers()
                 if self.command != "HEAD":
-                    self.wfile.write(data)
+                    # native body egress on the pooled front end
+                    # (utils/http_pool.send_body), wfile fallback
+                    from ..utils.http_pool import send_body
+
+                    send_body(self, data)
 
             def do_HEAD(self):
                 # TUS (resumable upload) offset probe

@@ -571,16 +571,24 @@ class VolumeService:
             remaining = request.size
             off = request.offset
             while remaining > 0:
+                # The Python-plane stream: every chunk is materialized
+                # as bytes for the protobuf message (counted against
+                # bytes_copied_per_byte_served). The native twin of
+                # this loop is ec/net_plane.ShardNetPlane, which
+                # sendfile(2)s the same fd range with zero Python-side
+                # byte handling — clients prefer it and fall back here.
                 chunk = os.pread(fd, min(_EC_STREAM_CHUNK, remaining), off)
                 if not chunk:
                     break
                 orig_len = len(chunk)
+                M.net_bytes_copied_total.inc(orig_len, plane="python")
                 chunk = faults.mutate(
                     "server.ec_shard_read", chunk,
                     volume=request.volume_id, shard=request.shard_id, offset=off,
                 )
                 if chunk:
                     yield pb.EcShardReadChunk(data=chunk)
+                    M.net_bytes_sent_total.inc(len(chunk), plane="python")
                 if len(chunk) < orig_len:
                     break  # torn stream: client sees a short read
                 off += orig_len
@@ -1124,6 +1132,24 @@ class VolumeServer:
         except Exception as e:  # native toolchain absent: HTTP only
             logger("volume").warning("fastread sidecar disabled: %s", e)
 
+        # Native shard byte plane (ec/net_plane.py): a TCP sidecar on
+        # grpc_port + 10000 serving EC shard ranges with sendfile
+        # egress — peers derive the address from the holder map's gRPC
+        # address and fall back to the VolumeEcShardRead stream when
+        # the port refuses. Runs even without the native .so (Python
+        # egress), so the wire protocol is capability-stable.
+        self.net_plane = None
+        try:
+            from ..ec import net_plane as _netp
+
+            self.net_plane = _netp.ShardNetPlane(
+                ip, _netp.derive_port(self.grpc_port),
+                self._net_plane_resolve,
+                server_label=f"{ip}:{port}",
+            )
+        except Exception as e:  # port collision etc: gRPC-only peer
+            logger("volume").warning("shard net plane disabled: %s", e)
+
         self._grpc = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
         rpc.add_service(self._grpc, rpc.VOLUME_SERVICE, self.service)
         self._grpc.add_insecure_port(f"{ip}:{self.grpc_port}")
@@ -1176,6 +1202,22 @@ class VolumeServer:
     def _master_grpc(master: str) -> str:
         host, _, port = master.partition(":")
         return f"{host}:{int(port) + 10000}"
+
+    def _net_plane_resolve(self, vid: int, sid: int, generation: int):
+        """Shard fd + size for the native byte plane — the same checks
+        (mounted, generation fence, shard local) as the gRPC servicer,
+        refusals surfacing as protocol error messages."""
+        from ..ec.net_plane import NetPlaneError
+
+        ev = self.store.find_ec_volume(vid)
+        if ev is None:
+            raise NetPlaneError("ec volume not mounted")
+        if generation and ev.encode_ts_ns != generation:
+            raise NetPlaneError("stale generation")
+        fd = ev.shard_fds.get(sid)
+        if fd is None:
+            raise NetPlaneError("shard not local")
+        return fd, os.fstat(fd).st_size
 
     # ----------------------------------------------------- remote shards
 
@@ -1335,13 +1377,30 @@ class VolumeServer:
                     metadata=trace.grpc_metadata(),
                 ):
                     buf += c.data
+                    M.net_bytes_copied_total.inc(len(c.data), plane="python")
             except grpc.RpcError as e:
                 # mid-stream peer death / stale generation / unreachable:
                 # all retry-then-replan material, never a crash
                 raise PeerFetchTransient(
                     f"{peer}: {e.code().name}: {e.details()}"
                 ) from e
+            M.net_bytes_received_total.inc(len(buf), plane="python")
+            M.net_bytes_copied_total.inc(len(buf), plane="python")
             return bytes(buf)
+
+        # Native ingress (ec/net_plane.py): sibling streams land
+        # directly in pooled aligned buffers on the peer's shard byte
+        # plane (grpc addr + port offset); peers without the plane are
+        # memoized and their streams ride the gRPC fetch above.
+        np_client = None
+        fetch_into = None
+        try:
+            from ..ec import net_plane as _netp
+
+            np_client = _netp.NetPlaneClient()
+            fetch_into = _netp.make_fetch_into(np_client, vid, generation)
+        except Exception:  # pragma: no cover - defensive
+            np_client = None
 
         from ..ec.backend import get_backend
 
@@ -1350,16 +1409,21 @@ class VolumeServer:
             ctx.data_shards,
             ctx.parity_shards,
         )
-        with M.request_seconds.time(server="volume", op="ec_peer_rebuild"):
-            report = rebuild_from_peers(
-                loc_base,
-                holders,
-                fetch,
-                ctx=ctx,
-                targets=targets,
-                backend=backend,
-                scheduler=self.store.ec_scheduler,
-            )
+        try:
+            with M.request_seconds.time(server="volume", op="ec_peer_rebuild"):
+                report = rebuild_from_peers(
+                    loc_base,
+                    holders,
+                    fetch,
+                    ctx=ctx,
+                    targets=targets,
+                    backend=backend,
+                    scheduler=self.store.ec_scheduler,
+                    fetch_into=fetch_into,
+                )
+        finally:
+            if np_client is not None:
+                np_client.close()
         M.ec_ops_total.inc(
             op="peer_rebuild", backend=backend_name or self.store.ec_backend
         )
@@ -1961,6 +2025,10 @@ class VolumeServer:
                         "breakers_open": open_b,
                         "degraded": open_b > 0,
                     }
+                    if server.net_plane is not None:
+                        # native shard byte plane sidecar health:
+                        # sendfile vs python egress byte split
+                        st["ec_net_plane"] = server.net_plane.status()
                     body = json.dumps(st).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
@@ -2085,7 +2153,11 @@ class VolumeServer:
                 self.send_header("ETag", f'"{etag}"')
                 self.end_headers()
                 if self.command != "HEAD":
-                    self.wfile.write(data)
+                    # needle payloads leave through the native
+                    # scatter-gather sender on the pooled front end
+                    from ..utils.http_pool import send_body
+
+                    send_body(self, data)
 
             do_HEAD = do_GET
 
@@ -2163,11 +2235,15 @@ class VolumeServer:
         self._grpc.start()
         self._http_thread.start()
         self._hb_thread.start()
+        if self.net_plane is not None:
+            self.net_plane.start()
         if self.scrub_daemon is not None:
             self.scrub_daemon.start()
 
     def stop(self) -> None:
         self._hb_stop.set()
+        if self.net_plane is not None:
+            self.net_plane.stop()
         if self.scrub_daemon is not None:
             self.scrub_daemon.stop()
         if self.fastread_sockets:

@@ -27,6 +27,25 @@ class FastReadError(Exception):
     pass
 
 
+def _stale(so: str) -> bool:
+    """Rebuild when the sidecar's sources are newer than the .so. The
+    sidecar shares native/sn_net.h with the core library (the sendfile
+    loop and its fallback live there), so a header edit must rebuild
+    this .so too — derive the source set from the directory like
+    utils/native._stale, not from a hardcoded list."""
+    import glob as _glob
+
+    if not os.path.exists(so):
+        return True
+    so_mtime = os.path.getmtime(so)
+    sources = [os.path.join(os.path.abspath(_NATIVE_DIR), "Makefile")]
+    for pat in ("*.cpp", "*.cc", "*.h", "*.hpp"):
+        sources.extend(_glob.glob(os.path.join(os.path.abspath(_NATIVE_DIR), pat)))
+    return any(
+        os.path.exists(p) and os.path.getmtime(p) > so_mtime for p in sources
+    )
+
+
 def _load_lib():
     # Same load contract as utils/native.py: a missing toolchain or a
     # bad .so surfaces as ImportError so callers' documented
@@ -34,7 +53,7 @@ def _load_lib():
     # instead of a CalledProcessError escaping at first use.
     so = os.path.abspath(os.path.join(_NATIVE_DIR, _SO_NAME))
     try:
-        if not os.path.exists(so):
+        if _stale(so):
             subprocess.run(
                 ["make", "-C", os.path.abspath(_NATIVE_DIR), _SO_NAME],
                 check=True,
@@ -52,14 +71,32 @@ def _load_lib():
 
 
 _lib = None
+_lib_err: ImportError | None = None
 _lib_lock = threading.Lock()
 
 
 def lib():
-    global _lib
+    """Load (building if stale) the sidecar library ONCE. A failed
+    build/load is cached and re-raised: every later call degrades to
+    the caller's documented Python/HTTP read path immediately instead
+    of re-running `make` (and logging) per call — one warning total."""
+    global _lib, _lib_err
     with _lib_lock:
-        if _lib is None:
+        if _lib is not None:
+            return _lib
+        if _lib_err is not None:
+            raise _lib_err
+        try:
             _lib = _load_lib()
+        except ImportError as e:
+            _lib_err = e
+            from .glog import logger
+
+            logger("fastread").warning(
+                "native sidecar unavailable, HTTP read path only "
+                "(cached for this process): %s", e,
+            )
+            raise
         return _lib
 
 
@@ -105,6 +142,16 @@ def stop_server(socket_path: str) -> None:
         pass
 
 
+# Width-keyed pool of 4096-aligned landing buffers shared by every
+# FastReadClient in the process — the same pool the peer-fetch ingress
+# lands in (ec/native_io.landing_pool), so steady-state bulk reads
+# allocate once and reuse forever instead of a bytearray per call.
+def _landing_pool():
+    from ..ec.native_io import landing_pool
+
+    return landing_pool()
+
+
 class FastReadClient:
     """Persistent connection to a fast-read socket."""
 
@@ -116,19 +163,102 @@ class FastReadClient:
         self._lock = threading.Lock()
 
     def read(self, path: str, offset: int, size: int) -> bytes:
+        body, _ = self._request(path, offset, size)
+        return body
+
+    def read_into(self, path: str, offset: int, size: int, dst, *,
+                  granule: int = 0):
+        """Land the payload DIRECTLY in caller-owned `dst` (1-D uint8
+        ndarray, e.g. a pooled aligned buffer) via the native
+        recv-into path — no intermediate bytes object. With granule>0,
+        returns the fused granule CRCs rolled during the copy-in
+        (ndarray u32; granule == size gives the whole-payload CRC the
+        ?locate contract demands, for free). Raises FastReadError on
+        any server-side error or torn stream."""
+        pb = path.encode()
+        req = struct.pack("<H", len(pb)) + pb + struct.pack(
+            "<QQ", offset, size
+        )
+        with self._lock:
+            self._sock.sendall(req)
+            head = self._recv_exact_py(9)
+            status = head[0]
+            (n,) = struct.unpack("<Q", head[1:])
+            if status != 0:
+                raise FastReadError(
+                    self._recv_exact_py(n).decode(errors="replace")
+                )
+            if n != size:
+                # n payload bytes are in flight on this persistent
+                # connection; close rather than desync the framing for
+                # the next request
+                self.close()
+                raise FastReadError(f"short response: {n}/{size} bytes")
+            try:
+                from . import native
+            except ImportError:
+                # python landing: recv_into the caller buffer directly
+                view = memoryview(dst)[:size]
+                got = 0
+                while got < size:
+                    r = self._sock.recv_into(view[got:], size - got)
+                    if r == 0:
+                        raise FastReadError(
+                            "fastread server closed connection"
+                        )
+                    got += r
+                if granule:
+                    from .crc import crc32c as _crc
+
+                    import numpy as _np
+
+                    return _np.array(
+                        [
+                            _crc(dst[i : min(i + granule, size)])
+                            for i in range(0, size, granule)
+                        ],
+                        dtype=_np.uint32,
+                    )
+                return None
+            import numpy as _np
+
+            crc_state = _np.zeros(1, _np.uint32)
+            filled = _np.zeros(1, _np.uint64)
+            max_out = (size // granule + 2) if granule else 1
+            out_crcs = _np.zeros(max_out, _np.uint32)
+            out_counts = _np.zeros(1, _np.int32)
+            got = native.recv_into(
+                self._sock.fileno(), dst, size,
+                timeout_ms=int((self._sock.gettimeout() or 30.0) * 1000),
+                granule=granule, crc_state=crc_state, filled_state=filled,
+                out_crcs=out_crcs, out_counts=out_counts,
+            )
+            if got != size:
+                self.close()  # mid-payload: the framing is gone
+                raise FastReadError(
+                    f"fastread server closed connection ({got}/{size})"
+                )
+            if not granule:
+                return None
+            crcs = list(out_crcs[: int(out_counts[0])])
+            if size % granule:
+                crcs.append(int(crc_state[0]))  # partial tail granule
+            return _np.array(crcs, dtype=_np.uint32)
+
+    def _request(self, path: str, offset: int, size: int):
         pb = path.encode()
         req = struct.pack("<H", len(pb)) + pb + struct.pack("<QQ", offset, size)
         with self._lock:
             self._sock.sendall(req)
-            head = self._read_exact(9)
+            head = self._recv_exact_py(9)
             status = head[0]
             (n,) = struct.unpack("<Q", head[1:])
-            body = self._read_exact(n)
+            body = self._recv_exact_py(n)
         if status != 0:
             raise FastReadError(body.decode(errors="replace"))
-        return body
+        return body, n
 
-    def _read_exact(self, n: int) -> bytes:
+    def _recv_exact_py(self, n: int) -> bytes:
         # recv_into a preallocated buffer: bytes-concatenation would be
         # quadratic on multi-MB bodies and defeat the fast path
         buf = bytearray(n)
@@ -153,16 +283,36 @@ def read_fid_fast(locate: dict) -> bytes:
     ?locate=true JSON ({path, offset, size, crc32c, socket}). The CRC
     is MANDATORY validation: the sidecar serves raw unlocked ranges, so
     a vacuum racing the read — or a stale locate replayed against the
-    wrong host's sidecar — must fail loudly, never return wrong
-    bytes."""
+    wrong host's sidecar — must fail loudly, never return wrong bytes.
+    The payload lands in a pooled aligned buffer with the CRC rolled
+    DURING the copy-in (granule = whole payload), so the mandatory
+    verify costs no second byte pass."""
+    size = int(locate["size"])
     c = FastReadClient(locate["socket"])
+    buf = None
     try:
-        data = c.read(locate["path"], locate["offset"], locate["size"])
+        if size > 0:
+            pool = _landing_pool()
+            buf = pool.get(size)
+            try:
+                crcs = c.read_into(
+                    locate["path"], locate["offset"], size, buf[0],
+                    granule=size,
+                )
+                if crcs is None:
+                    from .crc import crc32c as _crc
+
+                    got_crc = _crc(buf[0])
+                else:
+                    got_crc = int(crcs[0])
+                if got_crc != locate.get("crc32c", -1):
+                    raise FastReadError(
+                        "payload checksum mismatch (stale locate?)"
+                    )
+                return buf[0].tobytes()
+            finally:
+                pool.put(buf)
+        data = c.read(locate["path"], locate["offset"], size)
+        return data
     finally:
         c.close()
-    if locate["size"] > 0:
-        from .crc import crc32c
-
-        if crc32c(data) != locate.get("crc32c", -1):
-            raise FastReadError("payload checksum mismatch (stale locate?)")
-    return data

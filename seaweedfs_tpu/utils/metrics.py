@@ -367,3 +367,29 @@ gateway_rejected_total = REGISTRY.counter(
     "queue were saturated",
     ("server",),
 )
+
+# Network byte plane (ISSUE 12): payload bytes over the wire per plane
+# (native = sendfile/writev/recv-into with the GIL released; python =
+# the bit-identical fallback through Python buffers). The copied
+# counter tracks payload bytes MATERIALIZED into Python-level buffers
+# at the instrumented seams (gRPC chunk joins, wfile writes, pread
+# bytes) — bytes_copied_per_byte_served in bench.py is
+# copied(plane) / served(plane), ~0 for the native plane.
+net_bytes_sent_total = REGISTRY.counter(
+    "sw_net_bytes_sent_total",
+    "payload bytes sent on the network byte path (shard net plane, "
+    "EC shard-read RPC, gateway HTTP body egress)",
+    ("plane",),
+)
+net_bytes_received_total = REGISTRY.counter(
+    "sw_net_bytes_received_total",
+    "payload bytes landed from the network byte path (peer-fetch "
+    "ingress)",
+    ("plane",),
+)
+net_bytes_copied_total = REGISTRY.counter(
+    "sw_net_bytes_copied_total",
+    "payload bytes materialized into Python-level buffers on the "
+    "network byte path (the bytes-copied-per-byte-served numerator)",
+    ("plane",),
+)

@@ -159,6 +159,42 @@ _lib.sn_sink_finish.argtypes = [
 ]
 _lib.sn_sink_destroy.restype = None
 _lib.sn_sink_destroy.argtypes = [ctypes.c_void_p]
+# Network byte plane (ISSUE 12): socket egress/ingress with the GIL
+# released for the whole transfer. A stale .so missing these symbols
+# fails HERE at import (AttributeError -> ImportError below would not
+# catch it, which is deliberate: _stale() rebuilds first, and the
+# tier-1 symbol gate in tests/test_native_plane.py asserts the ABI).
+_lib.sn_send_file.restype = ctypes.c_int64
+_lib.sn_send_file.argtypes = [
+    ctypes.c_int,     # out_fd (socket)
+    ctypes.c_int,     # in_fd (file)
+    ctypes.c_uint64,  # offset
+    ctypes.c_uint64,  # len
+    ctypes.c_int,     # timeout_ms (-1 = block)
+]
+_lib.sn_sendv.restype = ctypes.c_int64
+_lib.sn_sendv.argtypes = [
+    ctypes.c_int,
+    ctypes.POINTER(ctypes.c_void_p),  # bufs
+    ctypes.POINTER(ctypes.c_uint64),  # lens
+    ctypes.c_int,                     # n
+    ctypes.c_int,                     # timeout_ms
+]
+_lib.sn_recv_into.restype = ctypes.c_int64
+_lib.sn_recv_into.argtypes = [
+    ctypes.c_int,     # fd
+    ctypes.c_void_p,  # dst
+    ctypes.c_uint64,  # len
+    ctypes.c_int,     # timeout_ms
+    ctypes.c_uint32,  # granule
+    ctypes.c_void_p,  # crc_state (u32[1])
+    ctypes.c_void_p,  # filled_state (u64[1])
+    ctypes.c_void_p,  # out_crcs (u32[max_out])
+    ctypes.c_void_p,  # out_count (i32[1])
+    ctypes.c_int32,   # max_out
+]
+_lib.sn_sink_direct_flags.restype = ctypes.c_int
+_lib.sn_sink_direct_flags.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
 _lib.sn_has_avx2.restype = ctypes.c_int
 _lib.sn_scan_dat.restype = ctypes.c_int64
 _lib.sn_scan_dat.argtypes = [
@@ -348,6 +384,118 @@ def fadvise_willneed(fd: int, offset: int, length: int) -> None:
         pass
 
 
+# ---------------------------------------------------------------- network
+# Socket egress/ingress (ISSUE 12). All three release the GIL for the
+# whole transfer; `timeout_ms` bounds each poll() wait on a
+# Python-timeout (O_NONBLOCK) socket, -1 blocks forever.
+
+
+def send_file(
+    out_fd: int, in_fd: int, offset: int, length: int, timeout_ms: int = -1
+) -> int:
+    """sendfile(2) `length` bytes of in_fd@offset into out_fd — kernel
+    to kernel, zero userspace copies (one, via the C-side fallback
+    buffer, where the kernel path is unsupported). Returns bytes sent;
+    SHORT only when in_fd hits EOF. Raises OSError on socket errors or
+    timeout."""
+    sent = _lib.sn_send_file(out_fd, in_fd, offset, length, timeout_ms)
+    if sent < 0:
+        raise OSError(-sent, f"sn_send_file: {os.strerror(-sent)}")
+    return int(sent)
+
+
+def _part_ptr_len(part, keepalive: list):
+    """(address, nbytes) of a bytes-like without copying it; appends
+    whatever must outlive the call to `keepalive`."""
+    if isinstance(part, np.ndarray):
+        assert part.dtype == np.uint8 and part.flags.c_contiguous
+        keepalive.append(part)
+        return part.ctypes.data, part.nbytes
+    if isinstance(part, bytes):
+        p = ctypes.cast(ctypes.c_char_p(part), ctypes.c_void_p)
+        keepalive.append((part, p))
+        return p.value or 0, len(part)
+    a = np.frombuffer(part, dtype=np.uint8)  # zero-copy view
+    keepalive.append((part, a))
+    return a.ctypes.data, a.nbytes
+
+
+def sendv(out_fd: int, parts, timeout_ms: int = -1) -> int:
+    """Scatter-gather write of `parts` (bytes / memoryview / uint8
+    ndarray) to out_fd via writev — no Python-side join, no per-chunk
+    GIL round trips. Returns total bytes sent (== sum of lengths);
+    raises OSError on failure, ETIMEDOUT included, because a partial
+    HTTP body is a broken connection, not a result."""
+    n = len(parts)
+    keep: list = []
+    ptrs = (ctypes.c_void_p * n)()
+    lens = (ctypes.c_uint64 * n)()
+    total = 0
+    for i, part in enumerate(parts):
+        addr, ln = _part_ptr_len(part, keep)
+        ptrs[i] = addr
+        lens[i] = ln
+        total += ln
+    sent = _lib.sn_sendv(out_fd, ptrs, lens, n, timeout_ms)
+    if sent < 0:
+        raise OSError(-sent, f"sn_sendv: {os.strerror(-sent)}")
+    if sent != total:  # pragma: no cover - C side only shorts on error
+        raise OSError(f"sn_sendv short write: {sent}/{total}")
+    return int(sent)
+
+
+def recv_into(
+    fd: int,
+    dst: np.ndarray,
+    length: int | None = None,
+    *,
+    timeout_ms: int = -1,
+    granule: int = 0,
+    crc_state: np.ndarray | None = None,
+    filled_state: np.ndarray | None = None,
+    out_crcs: np.ndarray | None = None,
+    out_counts: np.ndarray | None = None,
+) -> int:
+    """Land up to `length` bytes from fd DIRECTLY in `dst` (1-D
+    C-contiguous uint8, e.g. a pooled rebuild-matrix row) — the ingress
+    half of the zero-copy network plane. Returns bytes received; SHORT
+    means the peer closed mid-stream (the caller's torn-stream
+    contract). With granule > 0, the rolling granule-CRC32C
+    (crc_state u32[1] / filled_state u64[1]) advances over the bytes
+    during the copy-in, completed granule CRCs landing in out_crcs with
+    the count in out_counts[0] — fused sidecar verify, no extra byte
+    pass."""
+    assert dst.dtype == np.uint8 and dst.ndim == 1
+    assert dst.flags.c_contiguous
+    if length is None:
+        length = dst.nbytes
+    assert 0 <= length <= dst.nbytes
+    max_out = 0
+    if granule:
+        assert crc_state is not None and filled_state is not None
+        assert out_crcs is not None and out_counts is not None
+        assert crc_state.dtype == np.uint32
+        assert filled_state.dtype == np.uint64
+        assert out_crcs.dtype == np.uint32 and out_crcs.flags.c_contiguous
+        assert out_counts.dtype == np.int32
+        max_out = out_crcs.shape[-1]
+    got = _lib.sn_recv_into(
+        fd,
+        ctypes.c_void_p(dst.ctypes.data),
+        length,
+        timeout_ms,
+        granule,
+        ctypes.c_void_p(crc_state.ctypes.data) if granule else None,
+        ctypes.c_void_p(filled_state.ctypes.data) if granule else None,
+        ctypes.c_void_p(out_crcs.ctypes.data) if granule else None,
+        ctypes.c_void_p(out_counts.ctypes.data) if granule else None,
+        max_out,
+    )
+    if got < 0:
+        raise OSError(-got, f"sn_recv_into: {os.strerror(-got)}")
+    return int(got)
+
+
 class NativeSink:
     """Stateful fused write+CRC sink handle (sn_sink_*): pwrite-
     positioned appends straight from caller buffers, leaf AND block
@@ -357,6 +505,7 @@ class NativeSink:
     CRC state."""
 
     EARLY_WB = 1
+    DIRECT = 2
 
     def __init__(
         self,
@@ -367,17 +516,34 @@ class NativeSink:
         # whose write(2) is already synchronous (9p); the env-gated
         # policy lives in pipeline.FusedShardSink.
         early_writeback: bool = False,
+        # Opt-in O_DIRECT writes while every append stays 4096-aligned
+        # (pointer, width, file offset); a misaligned append (the
+        # ragged tail) or a write the filesystem rejects drops that fd
+        # back to buffered transparently — same bytes, same offsets.
+        # Gated by SEAWEED_EC_ODIRECT in pipeline.FusedShardSink.
+        direct: bool = False,
     ):
         n = len(fds)
         self.n = n
         self.block_size = block_size
         self.leaf_size = leaf_size
         flags = self.EARLY_WB if early_writeback else 0
+        if direct:
+            flags |= self.DIRECT
         self._h = _lib.sn_sink_create(
             (ctypes.c_int * n)(*fds), n, block_size, leaf_size, flags
         )
         if not self._h:
             raise OSError("sn_sink_create failed (bad block/leaf sizes?)")
+
+    def direct_flags(self) -> np.ndarray:
+        """Per-shard O_DIRECT state (u8[n], 1 = still direct): whether
+        the page-cache-bypassing path engaged and survived alignment."""
+        if self._h is None:
+            raise OSError("sink already destroyed")
+        out = np.zeros(self.n, np.uint8)
+        _lib.sn_sink_direct_flags(self._h, ctypes.c_void_p(out.ctypes.data))
+        return out
 
     def append(
         self,

@@ -37,6 +37,14 @@ NEW_SYMBOLS = [
     "sn_sink_append",
     "sn_sink_finish",
     "sn_sink_destroy",
+    # ISSUE 12: network byte plane + O_DIRECT sink observability. Same
+    # contract — a stale .so missing these silently disables the whole
+    # native plane (the bindings in utils/native.py resolve at import),
+    # so the gate fails loudly here instead.
+    "sn_send_file",
+    "sn_sendv",
+    "sn_recv_into",
+    "sn_sink_direct_flags",
 ]
 
 
