@@ -863,11 +863,13 @@ def _gateway_client_phase(
     data: bytes,
     clients: int,
     reads_per_client: int,
+    headers: dict | None = None,
 ) -> dict:
     """Fire `clients` concurrent keep-alive sessions, each doing
     `reads_per_client` byte-verified GETs; a threading.Barrier aligns
     the first wave so cold-cache misses genuinely collide. 503s are
-    counted separately (clean backpressure, not corruption)."""
+    counted separately (clean backpressure, not corruption).
+    `headers` (e.g. a SigV4 Authorization set) rides on every GET."""
     import threading
 
     import requests as _rq
@@ -887,7 +889,9 @@ def _gateway_client_phase(
         for _ in range(reads_per_client):
             t0 = time.perf_counter()
             try:
-                rr = sess.get(f"{base}/bench/obj", timeout=120)
+                rr = sess.get(
+                    f"{base}/bench/obj", timeout=120, headers=headers
+                )
                 if rr.status_code == 503:
                     with lat_lock:
                         rejected[0] += 1
@@ -1299,25 +1303,99 @@ def _peer_rebuild_bench(workdir: str, shard_mb: int = 8, reps: int = 2) -> dict:
         shutil.rmtree(bdir, ignore_errors=True)
 
 
+def _bench_sign_v4(
+    method: str, netloc: str, path: str, access: str, secret: str,
+    region: str = "us-east-1",
+) -> dict:
+    """Header-auth SigV4 signature for the warm bench's client phases
+    (UNSIGNED-PAYLOAD, host+date+content-sha signed) — what an SDK
+    sends, so the server's s3.auth stage does real verification work.
+    Rides the shared signer next to the verifier (s3/auth.sign_v4) so
+    canonicalization lives in one place."""
+    from seaweedfs_tpu.s3.auth import sign_v4
+
+    return sign_v4(
+        method, path,
+        access_key=access, secret_key=secret,
+        headers={"host": netloc},
+        payload_hash="UNSIGNED-PAYLOAD",
+        region=region,
+    )
+
+
+# response headers that legitimately differ per request (ids, clocks) —
+# everything else must be bit-identical across the fast/off/hit phases
+_WARM_VOLATILE_HEADERS = {
+    "date", "x-request-id", "x-sw-trace-id", "x-sw-parent-span",
+}
+
+
+def _warm_capture_get(base: str, headers: dict):
+    """(status, stable-headers, body) of one GET — the bit-identity
+    unit the warm bench compares across fast-paths on/off/hit."""
+    import requests as _rq
+
+    r = _rq.get(f"{base}/bench/obj", timeout=60, headers=headers)
+    stable = tuple(sorted(
+        (k.lower(), v) for k, v in r.headers.items()
+        if k.lower() not in _WARM_VOLATILE_HEADERS
+    ))
+    return r.status_code, stable, r.content
+
+
+_WARM_STAGES = ("s3.auth", "filer.lookup", "chunk.fetch")
+
+
+def _warm_stage_ms(snap0: dict, snap1: dict, requests_n: int) -> dict:
+    """Per-request mean milliseconds of each gateway stage between two
+    sw_ec_stage_seconds snapshots (summed across op/chip labels)."""
+    out: dict[str, float] = {}
+    for key, (_c, _t, ssum) in snap1.items():
+        stage = key[1] if len(key) >= 2 else ""
+        if stage not in _WARM_STAGES:
+            continue
+        prev = snap0.get(key)
+        out[stage] = out.get(stage, 0.0) + ssum - (prev[2] if prev else 0.0)
+    return {
+        k: round(v * 1000.0 / max(requests_n, 1), 3)
+        for k, v in out.items()
+    }
+
+
 def _gateway_warm_bench(
     workdir: str,
     clients: int = 16,
     reads_per_client: int = 25,
     obj_bytes: int = 256 << 10,
 ) -> dict:
-    """Warm-path gateway GETs (no degradation, caches hot): the PR 11
-    ceiling was ~180 GETs/s on 2 cores with the bottleneck in Python
-    HTTP byte handling under the GIL. Measures the SAME warm loop with
-    the native body egress on (sendfile/writev via
-    utils/http_pool.send_body, GIL released per response) vs off
-    (SEAWEED_EC_NATIVE=0 -> wfile.write) in one run, byte-verified by
-    the client phase either way."""
+    """Warm-path gateway GETs, fast paths ON vs OFF in ONE run
+    (ISSUE 13). After PR 12 the residual warm ceiling was the control
+    plane: SigV4 auth + filer lookup in Python per request, plus the
+    filer->volume chunk fetch re-buffering through `requests`. The
+    fast configuration turns on the SigV4 verdict memo, the
+    entry-lookup cache, the chunk fetch over the shard net plane, and
+    the native body egress; the off configuration disables all four
+    (SEAWEED_EC_NATIVE=0, SEAWEED_S3_AUTH_MEMO=0, chunk plane off,
+    entry cache capacity 0). The filer CHUNK cache is off in BOTH
+    phases so every GET pays the real lookup+fetch path — the line
+    measures this PR's stages, not PR 11's hot cache. Requests are
+    SigV4-signed so s3.auth does real verification work; every body is
+    byte-verified in the client phase AND one (status, headers, body)
+    capture per configuration — off, fast-miss, fast-hit — is asserted
+    bit-identical in the emitted line. The per-request
+    s3.auth/filer.lookup/chunk.fetch stage budget (PR 9 trace stages)
+    and the counter evidence (memo/entry-cache hits, chunk bytes on
+    the native plane) ride along."""
     import requests as _rq
 
     from seaweedfs_tpu.filer import Filer, MemoryStore
     from seaweedfs_tpu.s3 import S3Server
+    from seaweedfs_tpu.s3 import auth as _s3auth
+    from seaweedfs_tpu.s3.auth import Identity, IdentityStore
     from seaweedfs_tpu.server.master import MasterServer
     from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.utils import metrics as _M
+    from seaweedfs_tpu.utils import trace as _tr
 
     gdir = os.path.join(workdir, "gateway_warm")
     os.makedirs(gdir, exist_ok=True)
@@ -1333,64 +1411,162 @@ def _gateway_warm_bench(
     )
     vs.start()
     filer = srv = None
-    prev_env = os.environ.get("SEAWEED_EC_NATIVE")
+    _ENV_KEYS = (
+        "SEAWEED_EC_NATIVE", "SEAWEED_S3_AUTH_MEMO",
+        "SEAWEED_CHUNK_NET_PLANE",
+    )
+    prev_env = {k: os.environ.get(k) for k in _ENV_KEYS}
+    was_armed = _tr.armed
     try:
         deadline = time.time() + 20
         while not master.topo.nodes:
             if time.time() > deadline:
                 raise TimeoutError("volume server never registered")
             time.sleep(0.05)
+        # chunk cache OFF: every GET pays lookup + volume fetch — the
+        # stages this PR targets (the hot-chunk tier is PR 11's win,
+        # measured by gateway_degraded_get). SQLITE store, not
+        # MemoryStore: the entry cache's claim is "stop hitting
+        # store.find", which only means something against a store
+        # whose find costs something (a dict-backed MemoryStore would
+        # flatter the off phase).
+        from seaweedfs_tpu.filer.filer_store import SqliteStore
+
         filer = Filer(
-            MemoryStore(), master=f"localhost:{mport}",
-            chunk_size=256 * 1024,
+            SqliteStore(os.path.join(gdir, "filer.db")),
+            master=f"localhost:{mport}",
+            chunk_size=256 * 1024, chunk_cache_bytes=0,
         )
-        srv = S3Server(filer, ip="localhost", port=_bench_free_port())
+        idents = IdentityStore()
+        idents.add(Identity("bench", "AKIDBENCH", "bench-secret-13"))
+        srv = S3Server(
+            filer, ip="localhost", port=_bench_free_port(),
+            identities=idents,
+        )
         srv.start()
         base = f"http://localhost:{srv.port}"
+        netloc = f"localhost:{srv.port}"
+
+        def sign(method, path):
+            return _bench_sign_v4(
+                method, netloc, path, "AKIDBENCH", "bench-secret-13"
+            )
+
         rng = np.random.default_rng(0x3A3A)
         data = rng.integers(0, 256, obj_bytes, dtype=np.uint8).tobytes()
-        assert _rq.put(f"{base}/bench").status_code == 200
-        assert _rq.put(f"{base}/bench/obj", data=data).status_code == 200
-        # warm both byte paths once (page cache + chunk cache + conns)
+        assert _rq.put(
+            f"{base}/bench", headers=sign("PUT", "/bench")
+        ).status_code == 200
+        assert _rq.put(
+            f"{base}/bench/obj", data=data,
+            headers=sign("PUT", "/bench/obj"),
+        ).status_code == 200
+        get_headers = sign("GET", "/bench/obj")
+        # warm both byte paths once (page cache + conns)
         for _ in range(2):
-            r = _rq.get(f"{base}/bench/obj", timeout=30)
+            r = _rq.get(f"{base}/bench/obj", timeout=30,
+                        headers=get_headers)
             assert r.status_code == 200 and r.content == data
+        _tr.configure(enabled=True)  # stage budget needs the recorder
+        ecap = filer.entry_cache.capacity
 
+        # ---------------- OFF: every fast path disabled -------------
         os.environ["SEAWEED_EC_NATIVE"] = "0"
+        os.environ["SEAWEED_S3_AUTH_MEMO"] = "0"
+        os.environ["SEAWEED_CHUNK_NET_PLANE"] = "0"
+        filer.entry_cache.capacity = 0
+        filer.entry_cache.clear()
+        _s3auth.auth_cache_clear()
+        cap_off = _warm_capture_get(base, get_headers)
+        s0 = _tr._stage_seconds.snapshot()
         python_phase = _gateway_client_phase(
-            base, data, clients, reads_per_client
+            base, data, clients, reads_per_client, headers=get_headers
         )
-        os.environ.pop("SEAWEED_EC_NATIVE", None)
+        s1 = _tr._stage_seconds.snapshot()
+        stage_python = _warm_stage_ms(
+            s0, s1, python_phase.get("requests", 0)
+        )
+
+        # ---------------- FAST: memo + entry cache + net plane ------
+        for k in _ENV_KEYS:
+            os.environ.pop(k, None)
+        filer.entry_cache.capacity = ecap
+        filer.entry_cache.clear()
+        _s3auth.auth_cache_clear()
+        cap_miss = _warm_capture_get(base, get_headers)  # cold caches
+        cap_hit = _warm_capture_get(base, get_headers)   # memo+entry hit
+        memo0 = _M.s3_auth_memo_total.snapshot()
+        e0 = filer.entry_cache.stats()
+        n0 = _M.net_bytes_received_total.snapshot()
+        s0 = _tr._stage_seconds.snapshot()
         native_phase = _gateway_client_phase(
-            base, data, clients, reads_per_client
+            base, data, clients, reads_per_client, headers=get_headers
         )
+        s1 = _tr._stage_seconds.snapshot()
+        stage_fast = _warm_stage_ms(s0, s1, native_phase.get("requests", 0))
+        memo1 = _M.s3_auth_memo_total.snapshot()
+        e1 = filer.entry_cache.stats()
+        n1 = _M.net_bytes_received_total.snapshot()
+
         if "error" in native_phase or "error" in python_phase:
             return {
                 "gateway_warm_error": (
-                    f"native={native_phase.get('error')} "
+                    f"fast={native_phase.get('error')} "
                     f"python={python_phase.get('error')}"
                 )
             }
+        identical = cap_off == cap_miss == cap_hit
+        auth_lookup_fast = (
+            stage_fast.get("s3.auth", 0.0)
+            + stage_fast.get("filer.lookup", 0.0)
+        )
+        auth_lookup_python = (
+            stage_python.get("s3.auth", 0.0)
+            + stage_python.get("filer.lookup", 0.0)
+        )
+        chunk_native = n1.get(("native",), 0) - n0.get(("native",), 0)
         return {
             "gateway_warm_get_gets_per_s": native_phase["gets_per_s"],
             "gateway_warm_get_p50_ms": native_phase["p50_ms"],
             "gateway_warm_get_python_gets_per_s": python_phase["gets_per_s"],
             "gateway_warm_get_python_p50_ms": python_phase["p50_ms"],
-            "gateway_warm_native_vs_python": round(
+            "gateway_warm_fast_vs_python": round(
                 native_phase["gets_per_s"]
                 / max(python_phase["gets_per_s"], 1e-9),
                 2,
             ),
+            # bit identity across off / fast-miss / fast-hit, headers
+            # included (volatile ids/clocks excluded) — IN THE LINE
+            "gateway_warm_identical": bool(identical),
+            # per-request stage budget, ms (the ISSUE 13 acceptance
+            # metric: auth+lookup share drops >=2x fast vs python)
+            "gateway_warm_stage_ms_fast": stage_fast,
+            "gateway_warm_stage_ms_python": stage_python,
+            "gateway_warm_auth_lookup_speedup": round(
+                auth_lookup_python / max(auth_lookup_fast, 1e-6), 2
+            ),
+            # counter evidence that the fast paths actually engaged
+            "gateway_warm_auth_memo_hits": int(
+                memo1.get(("hit",), 0) - memo0.get(("hit",), 0)
+            ),
+            "gateway_warm_entry_cache_hits": int(e1["hits"] - e0["hits"]),
+            "gateway_warm_entry_cache_loads": int(
+                e1["loads"] - e0["loads"]
+            ),
+            "gateway_warm_chunk_native_mb": round(chunk_native / 1e6, 1),
             "gateway_warm_clients": clients,
             "gateway_warm_object_kb": obj_bytes >> 10,
             "gateway_warm_errors": native_phase["errors"]
             + python_phase["errors"],
         }
     finally:
-        if prev_env is None:
-            os.environ.pop("SEAWEED_EC_NATIVE", None)
-        else:
-            os.environ["SEAWEED_EC_NATIVE"] = prev_env
+        for k, v in prev_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        if not was_armed:
+            _tr.configure(enabled=False)
         for closer in (
             (lambda: srv.stop()) if srv is not None else None,
             (lambda: filer.close()) if filer is not None else None,
@@ -2599,6 +2775,69 @@ def _self_check() -> int:
             >= 1.0,
             f"stats={net_stats}",
         )
+
+        # ---- warm-path fast-path bit identity (ISSUE 13): one run of
+        # the warm bench with fast paths ON vs OFF vs HIT — status,
+        # stable headers, and body must be byte-equal across all three,
+        # and the counter evidence must show the fast paths actually
+        # engaged (memo hits, entry-cache hits, chunk bytes native) ---
+        warm = _gateway_warm_bench(workdir, clients=2, reads_per_client=4)
+        check(
+            "warm_path_bit_identical",
+            warm.get("gateway_warm_identical") is True
+            and warm.get("gateway_warm_errors", 1) == 0,
+            f"stats={ {k: v for k, v in warm.items() if 'stage' not in k} }",
+        )
+        check(
+            "warm_path_fast_paths_engaged",
+            warm.get("gateway_warm_auth_memo_hits", 0) > 0
+            and warm.get("gateway_warm_entry_cache_hits", 0) > 0
+            and warm.get("gateway_warm_chunk_native_mb", 0.0) > 0,
+            f"memo={warm.get('gateway_warm_auth_memo_hits')} "
+            f"entry={warm.get('gateway_warm_entry_cache_hits')} "
+            f"native_mb={warm.get('gateway_warm_chunk_native_mb')}",
+        )
+
+        # ---- entry-lookup singleflight: concurrent warm misses on ONE
+        # entry collapse to ONE store.find --------------------------
+        import threading as _th
+
+        from seaweedfs_tpu.filer import Filer as _WFiler
+        from seaweedfs_tpu.filer import MemoryStore as _WMemStore
+
+        wf = _WFiler(_WMemStore(), master="localhost:1")
+        try:
+            wf.write_file("/sf/obj", b"collapse")
+            wf.entry_cache.clear()
+            finds = [0]
+            flock = _th.Lock()
+            real_find = wf.store.find
+
+            def counting_find(directory, name):
+                with flock:
+                    finds[0] += 1
+                time.sleep(0.05)  # hold the flight open so misses pile up
+                return real_find(directory, name)
+
+            wf.store.find = counting_find
+            bodies = []
+
+            def rd():
+                bodies.append(wf.find_entry("/sf/obj").to_bytes())
+
+            ts = [_th.Thread(target=rd) for _ in range(8)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            wf.store.find = real_find
+            check(
+                "warm_path_lookup_collapse",
+                finds[0] == 1 and len(set(bodies)) == 1 and len(bodies) == 8,
+                f"store_finds={finds[0]} distinct={len(set(bodies))}",
+            )
+        finally:
+            wf.close()
 
         # ---- saturated-gateway 503 is a WELL-FORMED S3 error document
         # (Code=SlowDown + Retry-After): SDK clients must parse and

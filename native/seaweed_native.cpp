@@ -18,6 +18,7 @@
 #include <cstdint>
 #include <cstring>
 #include <cstddef>
+#include <cstdlib>
 #include <cerrno>
 #include <thread>
 #include <vector>
@@ -627,20 +628,48 @@ static int64_t recv_plain(int fd, uint8_t* dst, uint64_t len,
 // overlap also needs spare cores: with fewer than 4 hardware threads
 // the CRC helper just steals CPU from the socket copy (and, on
 // loopback, from the peer's sendfile), measured slower than serial on
-// a 2-core host — those run serial too.
+// a 2-core host — those run serial too. SEAWEED_EC_NET_OVERLAP
+// overrides the CORE gate ("1" = force the overlapped core on, "0" =
+// force serial, anything else/unset = the >=4-hardware-threads auto
+// heuristic); the size floor always applies — overlapping a leaf-sized
+// transfer never pays regardless of cores. The hot path takes the mode
+// as a PARAMETER (computed Python-side under the GIL): getenv here
+// would race a concurrent setenv from Python's os.environ, which is
+// undefined behavior in glibc.
 #define SN_RECV_OVERLAP_MIN (256u * 1024u)
 #define SN_RECV_OVERLAP_MIN_CORES 4u
+
+// mode: 0 = force serial, 1 = force overlapped, anything else = auto.
+static bool recv_overlap_wanted(uint64_t len, int32_t mode) {
+    if (len < SN_RECV_OVERLAP_MIN) return false;
+    if (mode == 0) return false;
+    if (mode == 1) return true;
+    return std::thread::hardware_concurrency() >= SN_RECV_OVERLAP_MIN_CORES;
+}
+
+// Observability/test hook: whether a fused recv of `len` bytes would
+// take the overlapped core under the current env/host. Cold path only
+// — callers probe it sequentially, so the getenv here doesn't race.
+int sn_recv_overlap_active(uint64_t len) {
+    const char* env = getenv("SEAWEED_EC_NET_OVERLAP");
+    int32_t mode = -1;
+    // check env[0] BEFORE env[1]: an empty value is a 1-byte string
+    // and reading past its terminator is out of bounds
+    if (env && (env[0] == '0' || env[0] == '1') && env[1] == 0)
+        mode = env[0] - '0';
+    return recv_overlap_wanted(len, mode) ? 1 : 0;
+}
 
 int64_t sn_recv_into(int fd, uint8_t* dst, uint64_t len, int timeout_ms,
                      uint32_t granule, uint32_t* crc_state,
                      uint64_t* filled_state, uint32_t* out_crcs,
-                     int32_t* out_count, int32_t max_out) {
+                     int32_t* out_count, int32_t max_out,
+                     int32_t overlap_mode) {
     crc32c_table_init();
     if (out_count) *out_count = 0;
     if (granule == 0)
         return recv_plain(fd, dst, len, timeout_ms, nullptr);
-    if (len < SN_RECV_OVERLAP_MIN ||
-        std::thread::hardware_concurrency() < SN_RECV_OVERLAP_MIN_CORES) {
+    if (!recv_overlap_wanted(len, overlap_mode)) {
         // serial: recv then CRC the fresh bytes, chunk by chunk
         uint64_t got = 0;
         while (got < len) {

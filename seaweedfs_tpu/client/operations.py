@@ -6,8 +6,14 @@ DeleteFiles) — HTTP data plane against volume servers, gRPC to master.
 
 from __future__ import annotations
 
+import os
+import time
+
 import requests
 
+from .. import faults
+from ..ec import native_io
+from ..ec import net_plane as _netp
 from ..storage.file_id import FileId
 from ..utils.retry import RetryError, RetryPolicy, retry_call
 from ..utils.urls import service_url
@@ -46,6 +52,14 @@ class Operations:
         self.master = MasterClient(master)
         self.jwt_key = jwt_key
         self._http = TracingSession()
+        # chunk fetches over the shard net plane (ISSUE 13): the client
+        # is connection-lazy (construction makes no sockets), so build
+        # it eagerly — no init race to reason about. `_plane_refused`
+        # negative-caches volumes the plane can never serve (EC/TTL'd/
+        # tiered: the server refuses on EVERY read), TTL'd because a
+        # volume's tier can change.
+        self._plane_client = _netp.NetPlaneClient()
+        self._plane_refused: dict[int, float] = {}
 
     def _auth_headers(self, token: str, fid: str) -> dict:
         if not token and self.jwt_key:
@@ -113,6 +127,14 @@ class Operations:
         f = FileId.parse(fid)
         for loc in self.master.lookup(f.volume_id):
             if fast:
+                # net plane first: one 38-byte request on a persistent
+                # TCP connection (locate resolved server-side) beats
+                # the fastread sidecar's per-read HTTP ?locate round
+                # trip; fastread remains the local bulk-read path when
+                # the plane is absent.
+                data = self._try_plane_read(loc, f)
+                if data is not None:
+                    return data
                 data = self._try_fast_read(loc.url, fid)
                 if data is not None:
                     return data
@@ -120,6 +142,45 @@ class Operations:
             if r.status_code == 200:
                 return r.content
         raise LookupError(f"fid {fid} unreadable on all locations")
+
+    # how long a VOLUME-level plane refusal (status 2: EC/TTL'd/tiered)
+    # is negative-cached per vid — a volume's tier can change, so the
+    # plane is re-probed after this instead of never
+    _PLANE_REFUSAL_TTL_S = 60.0
+
+    def _try_plane_read(self, loc, f: FileId) -> bytes | None:
+        """Warm-path chunk fetch over the volume server's shard net
+        plane (ISSUE 13): the needle payload lands straight in a pooled
+        aligned buffer (sendfile -> sn_recv_into, CRC fused into the
+        copy-in) instead of re-buffering through Python HTTP. None =
+        fall back to the bit-identical `requests` path (plane disabled,
+        sidecar absent, EC/TTL'd volume, CRC mismatch, armed faults —
+        chaos belongs to the HTTP path's fault points)."""
+        if (
+            not native_io.enabled()
+            or faults.active()
+            or os.environ.get("SEAWEED_CHUNK_NET_PLANE", "1") == "0"
+        ):
+            return None
+        gport = getattr(loc, "grpc_port", 0)
+        if not gport:
+            return None
+        refused_at = self._plane_refused.get(f.volume_id)
+        if refused_at is not None:
+            if time.monotonic() - refused_at < self._PLANE_REFUSAL_TTL_S:
+                return None
+            self._plane_refused.pop(f.volume_id, None)
+        addr = (loc.url.split(":")[0], _netp.derive_port(gport))
+        try:
+            return self._plane_client.read_needle(
+                addr, f.volume_id, f.needle_id, f.cookie
+            )
+        except _netp.NetPlaneUnavailable:
+            return None
+        except _netp.NetPlaneError as e:
+            if getattr(e, "volume_refusal", False):
+                self._plane_refused[f.volume_id] = time.monotonic()
+            return None
 
     _LOCAL_HOSTS = None  # lazily-computed set of this machine's names
 
@@ -187,4 +248,5 @@ class Operations:
             return
 
     def close(self) -> None:
+        self._plane_client.close()
         self.master.close()

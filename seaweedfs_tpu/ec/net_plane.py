@@ -43,7 +43,11 @@ per connection):
               PR 7 cross-RPC contract holds transport-independently)
     response: u8 status | u64 n | n bytes
               status 0 = ok (n = payload length, may be < size at EOF);
-              status 1 = error (n = UTF-8 message length)
+              status 1 = error (n = UTF-8 message length);
+              status 2 = VOLUME-level refusal (needle opcode: the whole
+              volume can never be served here — EC/TTL'd/tiered — so
+              clients negative-cache the vid instead of paying a
+              refusal round trip per chunk; same frame shape as 1)
 
 The sidecar listens on ``grpc_port + NET_PLANE_PORT_OFFSET`` so peers
 derive its address from the holder map's gRPC address without any new
@@ -69,14 +73,33 @@ from ..utils.glog import logger
 log = logger("ec.netplane")
 
 MAGIC = b"SWNP"
+# Needle/chunk-read opcode (ISSUE 13): the warm gateway path's
+# filer->volume chunk fetch over the SAME sidecar and framing. The
+# 38-byte header shape is reused with reinterpreted fields —
+# shard -> cookie, generation -> needle id, offset/size unused — and
+# the OK response carries the needle's stored CRC32C between the
+# length and the payload, so the client's fused copy-in CRC verifies
+# with no extra byte pass.
+MAGIC_NEEDLE = b"SWNR"
 # magic, volume, shard, gen, offset, size, meta_len
 _REQ = struct.Struct("<4sIIQQQH")
 _RESP = struct.Struct("<BQ")      # status, n
+_NEEDLE_CRC = struct.Struct("<I")  # appended to an OK needle response
 NET_PLANE_PORT_OFFSET = 10000     # net plane port = grpc port + this
 
 _SEND_CHUNK = 1 << 20             # python-plane egress chunking
 _MAX_REQUEST = 1 << 32
 _MAX_META = 4096
+# error-response bodies are short refusal strings; a length beyond this
+# means the stream desynced (or a hostile peer) — allocating it blindly
+# would raise MemoryError past the callers' NetPlaneError fallback
+_MAX_ERROR = 1 << 16
+# needle payloads beyond this ride the HTTP path: chunks are filer
+# chunk_size (MiBs), so a bigger OK-frame length is a desynced/hostile
+# response — landing it would pin an immortal pooled buffer that size
+_MAX_NEEDLE = 64 << 20
+# never park landing buffers wider than this in the process-wide pool
+_POOL_MAX_WIDTH = 8 << 20
 
 
 def _encode_meta() -> bytes:
@@ -101,6 +124,14 @@ def _decode_meta(blob: bytes) -> dict:
 class NetPlaneError(Exception):
     """Transport/protocol failure on an established plane connection —
     transient from the caller's point of view (retry or fall back)."""
+
+
+class NetPlaneVolumeRefusal(NetPlaneError):
+    """Needle-opcode refusal that applies to the WHOLE volume (not
+    mounted here / EC / TTL'd / tiered): the server answers status 2 so
+    clients can negative-cache the vid. Raised by resolve_needle
+    implementations server-side; surfaces client-side as a
+    NetPlaneError with ``volume_refusal=True``."""
 
 
 class NetPlaneUnavailable(Exception):
@@ -175,11 +206,21 @@ class ShardNetPlane:
     with the refusal message (not mounted / stale generation / shard
     not local). The server never closes resolved fds — they belong to
     the store's mounted EC volume, exactly like the gRPC servicer.
+
+    ``resolve_needle(volume_id, needle_id, cookie) -> (fd, offset,
+    size, crc32c, close_after)`` (optional) supplies a needle payload's
+    location for the chunk-read opcode — the net-plane twin of the
+    ``?locate=true`` control plane; ``close_after`` marks fds the
+    server must close once the response is sent (per-request opens).
+    Raising :class:`NetPlaneError` refuses the request (not here / EC /
+    TTL'd / cookie mismatch) and the client falls back to HTTP.
     """
 
     def __init__(self, ip: str, port: int, resolve,
-                 request_timeout: float = 60.0, server_label: str = ""):
+                 request_timeout: float = 60.0, server_label: str = "",
+                 resolve_needle=None):
         self.resolve = resolve
+        self.resolve_needle = resolve_needle
         self.request_timeout = request_timeout
         self.server_label = server_label
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -194,6 +235,7 @@ class ShardNetPlane:
         self._conns: set[socket.socket] = set()
         self._conns_lock = threading.Lock()
         self.requests = 0
+        self.needle_requests = 0
         self.sendfile_bytes = 0
         self.python_bytes = 0
 
@@ -238,7 +280,11 @@ class ShardNetPlane:
                 except (NetPlaneError, OSError):
                     return  # client went away between requests
                 magic, vid, sid, gen, off, size, mlen = _REQ.unpack(hdr)
-                if magic != MAGIC or size > _MAX_REQUEST or mlen > _MAX_META:
+                if (
+                    magic not in (MAGIC, MAGIC_NEEDLE)
+                    or size > _MAX_REQUEST
+                    or mlen > _MAX_META
+                ):
                     return  # not our protocol: drop the connection
                 try:
                     md = _decode_meta(_recv_exact(conn, mlen)) if mlen else {}
@@ -248,8 +294,29 @@ class ShardNetPlane:
                 # Observability parity with the gRPC stream: adopt the
                 # caller's request id + trace context and open the SAME
                 # rpc.ec_shard_read span — a peer-fetch heal stays ONE
-                # trace whichever transport carried the bytes.
+                # trace whichever transport carried the bytes. Needle
+                # reads open rpc.needle_read instead, joined to the
+                # gateway's trace the same way — one warm GET stays
+                # ONE trace across the chunk-fetch hop.
                 _rid.ensure(md.get(trace.REQUEST_ID_KEY))
+                if magic == MAGIC_NEEDLE:
+                    # field reinterpretation: sid slot = cookie,
+                    # gen slot = needle id
+                    sp = trace.start_from_metadata(
+                        "rpc.needle_read", md, server=self.server_label,
+                        volume=vid, needle=gen, plane="native",
+                    )
+                    t0 = time.perf_counter()
+                    try:
+                        ok = self._serve_needle(conn, vid, gen, sid)
+                    finally:
+                        trace.add_stage(
+                            sp, "stream", time.perf_counter() - t0
+                        )
+                        trace.finish(sp)
+                    if not ok:
+                        return
+                    continue
                 sp = trace.start_from_metadata(
                     "rpc.ec_shard_read", md, server=self.server_label,
                     volume=vid, shard=sid, offset=off, size=size,
@@ -271,10 +338,10 @@ class ShardNetPlane:
             except OSError:
                 pass
 
-    def _error(self, conn, msg: str) -> bool:
+    def _error(self, conn, msg: str, status: int = 1) -> bool:
         body = msg.encode(errors="replace")
         try:
-            conn.sendall(_RESP.pack(1, len(body)) + body)
+            conn.sendall(_RESP.pack(status, len(body)) + body)
             return True
         except OSError:
             return False
@@ -339,11 +406,78 @@ class ShardNetPlane:
             remaining -= orig
         return remaining == 0
 
+    def _serve_needle(self, conn, vid, nid, cookie) -> bool:
+        """Serve one whole-needle payload (the warm gateway chunk
+        fetch); False = connection must close. Refused outright when
+        the fault registry is ARMED: byte-mutating chaos belongs to the
+        Python-HTTP path, which carries the storage-layer fault points
+        — the client's fallback is the chaos surface, same contract as
+        the peer-fetch plane."""
+        if self.resolve_needle is None:
+            return self._error(conn, "needle reads not served here")
+        if faults.active():
+            return self._error(conn, "fault registry armed: use HTTP")
+        try:
+            fd, off, size, crc, close_after = self.resolve_needle(
+                vid, nid, cookie
+            )
+        except NetPlaneVolumeRefusal as e:
+            # the whole volume can never be served here: status 2 lets
+            # the client negative-cache the vid
+            return self._error(conn, str(e), status=2)
+        except NetPlaneError as e:
+            return self._error(conn, str(e))
+        self.needle_requests += 1
+        try:
+            try:
+                conn.sendall(
+                    _RESP.pack(0, size) + _NEEDLE_CRC.pack(crc & 0xFFFFFFFF)
+                )
+            except OSError:
+                return False
+            if size == 0:
+                return True
+            native = _native_mod() if egress_native() else None
+            if native is not None:
+                try:
+                    sent = native.send_file(
+                        conn.fileno(), fd, off, size,
+                        timeout_ms=int(self.request_timeout * 1000),
+                    )
+                except OSError:
+                    return False
+                self.sendfile_bytes += sent
+                M.net_bytes_sent_total.inc(sent, plane="native")
+                return sent == size
+            # Python egress (no .so): pread -> sendall, the same bytes.
+            remaining, o = size, off
+            while remaining > 0:
+                chunk = os.pread(fd, min(_SEND_CHUNK, remaining), o)
+                if not chunk:
+                    return False  # short file: torn stream
+                M.net_bytes_copied_total.inc(len(chunk), plane="python")
+                try:
+                    conn.sendall(chunk)
+                except OSError:
+                    return False
+                self.python_bytes += len(chunk)
+                M.net_bytes_sent_total.inc(len(chunk), plane="python")
+                o += len(chunk)
+                remaining -= len(chunk)
+            return True
+        finally:
+            if close_after:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+
     def status(self) -> dict:
         """Sidecar state for /status and /debug/gateway surfaces."""
         return {
             "port": self.port,
             "requests": self.requests,
+            "needle_requests": self.needle_requests,
             "sendfile_bytes": self.sendfile_bytes,
             "python_bytes": self.python_bytes,
         }
@@ -362,50 +496,160 @@ class NetPlaneClient:
     One cached connection per peer address (requests on one address are
     serialized — peer-fetch streams one shard from a given holder at a
     time, so the lock is uncontended on the rebuild path). A peer whose
-    plane port refuses the connect is memoized and every later call
-    raises :class:`NetPlaneUnavailable` immediately.
+    plane port refuses the connect is memoized and later calls raise
+    :class:`NetPlaneUnavailable` immediately — but only for
+    ``unavailable_ttl`` seconds (``SEAWEED_EC_NET_PLANE_RETRY_S``,
+    default 30): a sidecar that comes up later (rolling restart, late
+    boot) is re-probed and re-adopted instead of being written off for
+    the life of the process. :meth:`reset` drops the memo immediately
+    (operator hook — e.g. right after healing a peer).
     """
 
-    def __init__(self, timeout: float = 30.0, connect_timeout: float = 2.0):
+    def __init__(self, timeout: float = 30.0, connect_timeout: float = 2.0,
+                 unavailable_ttl: float | None = None):
         self.timeout = timeout
         self.connect_timeout = connect_timeout
+        if unavailable_ttl is None:
+            try:
+                unavailable_ttl = float(
+                    os.environ.get("SEAWEED_EC_NET_PLANE_RETRY_S", "30")
+                )
+            except ValueError:
+                unavailable_ttl = 30.0
+        self.unavailable_ttl = unavailable_ttl
         self._conns: dict[tuple[str, int], socket.socket] = {}
         self._locks: dict[tuple[str, int], threading.Lock] = {}
-        self._no_plane: set[tuple[str, int]] = set()
+        # needle-read connection pool: warm GETs arrive from N HTTP
+        # workers concurrently, so chunk fetches check OUT a connection
+        # per request (creating one on empty) instead of serializing on
+        # the shard paths' one-conn-per-addr lock. Entries are
+        # (socket, checkin-time): the server reaps idle connections at
+        # its request_timeout (60 s), so anything parked longer than
+        # _npool_idle_s is discarded at checkout instead of burning a
+        # request on a dead socket (which would silently demote that
+        # GET to the HTTP path).
+        self._npool: dict[
+            tuple[str, int], list[tuple[socket.socket, float]]
+        ] = {}
+        self._npool_max = 16
+        self._npool_idle_s = 30.0
+        # addr -> monotonic time of the refused connect (TTL'd memo)
+        self._no_plane: dict[tuple[str, int], float] = {}
         self._lock = threading.Lock()
 
     def close(self) -> None:
         with self._lock:
             conns = list(self._conns.values())
             self._conns.clear()
+            for lst in self._npool.values():
+                conns.extend(s for s, _t in lst)
+            self._npool.clear()
         for c in conns:
             try:
                 c.close()
             except OSError:
                 pass
 
+    def reset(self, addr: tuple[str, int] | None = None) -> None:
+        """Forget the no-plane memo for `addr` (or every peer): the
+        next call re-probes the connect instead of waiting out the
+        TTL."""
+        with self._lock:
+            if addr is None:
+                self._no_plane.clear()
+            else:
+                self._no_plane.pop(addr, None)
+
     def _addr_lock(self, addr) -> threading.Lock:
         with self._lock:
             return self._locks.setdefault(addr, threading.Lock())
 
-    def _conn(self, addr) -> socket.socket:
-        with self._lock:
-            if addr in self._no_plane:
+    def _check_memo(self, addr) -> None:
+        """Raise if `addr` is inside its no-plane TTL; forget an
+        expired refusal so the next connect re-probes (a sidecar that
+        has since come up gets re-adopted). Caller holds self._lock."""
+        refused_at = self._no_plane.get(addr)
+        if refused_at is not None:
+            if time.monotonic() - refused_at < self.unavailable_ttl:
                 raise NetPlaneUnavailable(f"{addr[0]}:{addr[1]}")
-            s = self._conns.get(addr)
-        if s is not None:
-            return s
+            del self._no_plane[addr]
+
+    def _connect(self, addr) -> socket.socket:
+        """Fresh plane connection (no caching); a refused connect is
+        memoized for `unavailable_ttl` seconds."""
         try:
             s = socket.create_connection(addr, timeout=self.connect_timeout)
         except OSError as e:
             with self._lock:
-                self._no_plane.add(addr)
+                self._no_plane[addr] = time.monotonic()
             raise NetPlaneUnavailable(f"{addr[0]}:{addr[1]}: {e}") from e
         s.settimeout(self.timeout)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def _conn(self, addr) -> socket.socket:
+        with self._lock:
+            self._check_memo(addr)
+            s = self._conns.get(addr)
+        if s is not None:
+            return s
+        s = self._connect(addr)
         with self._lock:
             self._conns[addr] = s
         return s
+
+    def _checkout(self, addr) -> socket.socket:
+        """Take a pooled needle-read connection (or dial a new one):
+        one connection per IN-FLIGHT request, so concurrent warm GETs
+        fan out instead of serializing on one socket. Connections
+        parked longer than `_npool_idle_s` are discarded — the server
+        side reaps idle peers, and a dead pooled socket would cost the
+        next GET its fast path."""
+        stale: list[socket.socket] = []
+        fresh = None
+        with self._lock:
+            self._check_memo(addr)
+            lst = self._npool.get(addr)
+            now = time.monotonic()
+            while lst:
+                s, t = lst.pop()
+                if now - t < self._npool_idle_s:
+                    fresh = s
+                    break
+                stale.append(s)
+        for s in stale:
+            try:
+                s.close()
+            except OSError:
+                pass
+        if fresh is not None:
+            return fresh
+        return self._connect(addr)
+
+    def _checkin(self, addr, s: socket.socket) -> None:
+        now = time.monotonic()
+        expired: list[socket.socket] = []
+        with self._lock:
+            lst = self._npool.setdefault(addr, [])
+            # reap expired entries from the FRONT (oldest): checkout
+            # pops LIFO and stops at the first fresh socket, so without
+            # this sweep the old ones below it would pin dead fds (and
+            # pool slots) for the life of the process
+            while lst and now - lst[0][1] >= self._npool_idle_s:
+                expired.append(lst.pop(0)[0])
+            if len(lst) < self._npool_max:
+                lst.append((s, now))
+                s = None  # type: ignore[assignment]
+        for dead in expired:
+            try:
+                dead.close()
+            except OSError:
+                pass
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
 
     def _drop(self, addr) -> None:
         with self._lock:
@@ -433,10 +677,10 @@ class NetPlaneClient:
         status, n = _RESP.unpack(head)
         if status != 0:
             try:
-                msg = _recv_exact(s, n).decode(errors="replace")
-            except (OSError, NetPlaneError):
+                msg = self._read_refusal(addr, s, n)
+            except NetPlaneError:
                 self._drop(addr)
-                msg = "(error body lost)"
+                raise
             raise NetPlaneError(f"{addr}: {msg}")
         if n != size:
             # EOF clamp — the gRPC stream's short read. The connection
@@ -543,6 +787,157 @@ class NetPlaneClient:
         M.net_bytes_received_total.inc(size, plane="python")
         M.net_bytes_copied_total.inc(size, plane="python")
         return data
+
+
+    # ------------------------------------------------------- needle reads
+
+    @staticmethod
+    def _read_refusal(addr, s, n: int) -> str:
+        """Decode a status!=0 error frame's body (shared by the shard
+        and needle paths so the protocol-error handling can't drift).
+        Raises NetPlaneError when the frame is desynced (length beyond
+        any real refusal string) or the body can't be read — the
+        connection is then unusable and the caller must discard it."""
+        if n > _MAX_ERROR:
+            raise NetPlaneError(f"{addr}: desynced error frame ({n})")
+        try:
+            return _recv_exact(s, n).decode(errors="replace")
+        except (OSError, NetPlaneError) as e:
+            raise NetPlaneError(f"{addr}: error body lost ({e})") from e
+
+    def read_needle(
+        self, addr: tuple[str, int], vid: int, nid: int, cookie: int
+    ) -> bytes:
+        """Whole-needle payload over the chunk-read opcode (the warm
+        gateway path's filer->volume fetch): the server resolves
+        (fd, offset, size, crc) from its needle map and splices the
+        payload with sendfile; this side lands it DIRECTLY in a pooled
+        4096-aligned buffer via ``sn_recv_into`` with the CRC32C fused
+        into the copy-in and verified against the needle's stored CRC —
+        a vacuum racing the read, or a stale location, surfaces as a
+        mismatch (raise -> caller falls back to HTTP), never as silent
+        wrong bytes. Raises :class:`NetPlaneUnavailable` for peers
+        without the sidecar (memoized with TTL). Connections come from
+        a per-address checkout pool — concurrent warm GETs fan out
+        over parallel sockets instead of serializing."""
+        s = self._checkout(addr)
+        healthy = False
+        try:
+            meta = _encode_meta()
+            try:
+                s.sendall(
+                    _REQ.pack(
+                        MAGIC_NEEDLE, vid, cookie & 0xFFFFFFFF, nid,
+                        0, 0, len(meta),
+                    )
+                    + meta
+                )
+                head = _recv_exact(s, _RESP.size)
+            except (OSError, NetPlaneError) as e:
+                raise NetPlaneError(f"{addr}: {e}") from e
+            status, n = _RESP.unpack(head)
+            if status != 0:
+                msg = self._read_refusal(addr, s, n)
+                healthy = True  # refusal leaves the stream in sync
+                err = NetPlaneError(f"{addr}: {msg}")
+                # status 2 = volume-level refusal: callers negative-
+                # cache the vid instead of re-probing per chunk
+                err.volume_refusal = status == 2
+                raise err
+            if n > _MAX_NEEDLE:
+                raise NetPlaneError(f"{addr}: oversized needle {n}")
+            try:
+                (want_crc,) = _NEEDLE_CRC.unpack(
+                    _recv_exact(s, _NEEDLE_CRC.size)
+                )
+            except (OSError, NetPlaneError) as e:
+                raise NetPlaneError(f"{addr}: {e}") from e
+            if n == 0:
+                healthy = True
+                return b""
+            data = self._land_needle(addr, s, int(n), want_crc)
+            healthy = True
+            return data
+        finally:
+            if healthy:
+                self._checkin(addr, s)
+            else:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    @staticmethod
+    def _landing_width(n: int) -> int:
+        """Pool width class for an n-byte needle payload. The landing
+        pool free-lists by EXACT width and retains forever — pooling
+        raw payload sizes (objects/tail chunks take arbitrary sizes)
+        would grow one immortal buffer per distinct size. Rounding up
+        to the next power of two (floor 64 KiB) bounds the class count
+        to ~a dozen regardless of object-size mix."""
+        return max(64 * 1024, 1 << (n - 1).bit_length())
+
+    def _land_needle(self, addr, s, n: int, want_crc: int) -> bytes:
+        from . import native_io
+
+        native = _native_mod() if native_io.enabled() else None
+        pool = native_io.landing_pool()
+        buf = pool.get(self._landing_width(n))
+        row = buf[0]
+        try:
+            try:
+                if native is not None:
+                    crc_state = np.zeros(1, np.uint32)
+                    filled = np.zeros(1, np.uint64)
+                    out_crcs = np.zeros(2, np.uint32)
+                    out_counts = np.zeros(1, np.int32)
+                    got = native.recv_into(
+                        s.fileno(), row, n,
+                        timeout_ms=int(self.timeout * 1000),
+                        granule=n, crc_state=crc_state,
+                        filled_state=filled, out_crcs=out_crcs,
+                        out_counts=out_counts,
+                    )
+                    if got != n:
+                        raise NetPlaneError(
+                            f"{addr}: torn needle stream {got}/{n}"
+                        )
+                    landed_crc = (
+                        int(out_crcs[0]) if int(out_counts[0]) > 0
+                        else int(crc_state[0])
+                    )
+                    M.net_bytes_received_total.inc(got, plane="native")
+                else:
+                    view = memoryview(row)[:n]
+                    got = 0
+                    while got < n:
+                        r = s.recv_into(view[got:], n - got)
+                        if r == 0:
+                            raise NetPlaneError(
+                                f"{addr}: torn needle stream {got}/{n}"
+                            )
+                        got += r
+                    from ..utils.crc import crc32c as _crc
+
+                    landed_crc = _crc(row[:n])
+                    M.net_bytes_received_total.inc(n, plane="python")
+            except OSError as e:
+                raise NetPlaneError(f"{addr}: {e}") from e
+            if landed_crc != (want_crc & 0xFFFFFFFF):
+                raise NetPlaneError(f"{addr}: needle CRC mismatch")
+            # the one Python-level materialization on this path: pooled
+            # landing buffer -> the bytes object the chunk cache keeps
+            data = row[:n].tobytes()
+            M.net_bytes_copied_total.inc(
+                n, plane="native" if native is not None else "python"
+            )
+            return data
+        finally:
+            # a raise out of here (torn stream, CRC mismatch) leaves
+            # the caller to close the checked-out socket. Oversized
+            # landings never park in the immortal pool.
+            if buf.shape[1] <= _POOL_MAX_WIDTH:
+                pool.put(buf)
 
 
 def make_fetch_into(client: NetPlaneClient, vid: int, generation: int,

@@ -9,6 +9,7 @@ filer_server_handlers_write_upload.go:32 (chunked upload path).
 from __future__ import annotations
 
 import hashlib
+import os
 import queue
 import threading
 import time
@@ -40,6 +41,7 @@ class Filer:
         chunk_size: int = DEFAULT_CHUNK_SIZE,
         jwt_key: str = "",
         chunk_cache_bytes: int = 64 * 1024 * 1024,
+        entry_cache_bytes: int | None = None,
     ):
         self.store = store
         self.ops = Operations(master, jwt_key=jwt_key)
@@ -51,6 +53,26 @@ class Filer:
         # N concurrent GETs of one cold (possibly degraded) chunk cost
         # ONE volume fetch/reconstruction (ISSUE 11).
         self.chunk_cache = ChunkCache(chunk_cache_bytes, tier="filer_chunk")
+        # Entry-lookup cache (ISSUE 13): path -> serialized Entry proto,
+        # so a warm GET's `filer.lookup` stage stops hitting store.find
+        # (and the hardlink KV overlay) on every request. Values are
+        # PROTO BYTES, decoded per hit — callers mutate their Entry
+        # copies freely without corrupting the cache, and a hit is
+        # bit-identical to a fresh store read by construction.
+        # Singleflight via get_or_load: N concurrent warm misses on one
+        # path collapse to ONE store.find. Invalidated by every local
+        # mutator and by replicated meta-log events (_entry_cache_drop
+        # call sites); hardlinked entries are NEVER admitted — a
+        # sibling name's write changes their content without touching
+        # this path. 0 disables (pass-through, no collapsing).
+        if entry_cache_bytes is None:
+            try:
+                entry_cache_bytes = int(
+                    os.environ.get("SEAWEED_FILER_ENTRY_CACHE_MB", "8")
+                ) << 20
+            except ValueError:
+                entry_cache_bytes = 8 << 20
+        self.entry_cache = ChunkCache(entry_cache_bytes, tier="filer_entry")
         self.collection = collection
         self.replication = replication
         self.chunk_size = chunk_size
@@ -174,6 +196,7 @@ class Filer:
                 entry.hard_link_counter = old.hard_link_counter
             self.store.insert(entry)
             self._hl_publish(entry)
+            self._entry_cache_drop(entry.directory, entry.name)
         self._notify(entry.directory, old, entry, ts_ns=ts)
 
     def mutate_entry(self, full_path: str, fn) -> Entry:
@@ -201,6 +224,7 @@ class Filer:
             ts = self._stamp(entry)
             self.store.update(entry)
             self._hl_publish(entry)
+            self._entry_cache_drop(directory, name)
         self._notify(directory, old, entry, ts_ns=ts)
         return entry
 
@@ -217,6 +241,7 @@ class Filer:
             if existing is None:
                 made = new_entry(path, is_directory=True, mode=0o755)
                 self.store.insert(made)
+                self._entry_cache_drop(parent, part)
                 self._notify(parent, None, made)
             elif not existing.is_directory:
                 raise FilerError(f"{path} exists and is not a directory")
@@ -296,13 +321,55 @@ class Filer:
         # gateway read-path stage: where a slow GET's metadata-lookup
         # time shows up (ambient span = the server's HTTP root span)
         with trace.stage(trace.current(), "filer.lookup"):
-            entry = self._hl_overlay(self.store.find(directory, name))
+            entry = self._lookup_cached(directory, name)
         if self._is_expired(entry):
             # read-triggered expiry (reference filer TTL): the name
-            # vanishes and its chunks are reclaimed asynchronously
+            # vanishes and its chunks are reclaimed asynchronously.
+            # Expiry is evaluated on EVERY return (hits included), so a
+            # cached entry can never outlive its TTL.
             self.delete_entry(entry.full_path)
             raise NotFound(entry.full_path)
         return entry
+
+    @staticmethod
+    def _entry_key(directory: str, name: str) -> str:
+        return f"{directory}\x00{name}"
+
+    def _lookup_cached(self, directory: str, name: str) -> Entry:
+        """store.find + hardlink overlay through the entry cache.
+        Misses singleflight-collapse; a NotFound raised by the loader
+        propagates to every collapsed waiter and caches nothing."""
+        cache = self.entry_cache
+        if cache.capacity <= 0:
+            return self._hl_overlay(self.store.find(directory, name))
+        hardlinked = [False]
+
+        def load() -> bytes:
+            e = self._hl_overlay(self.store.find(directory, name))
+            hardlinked[0] = bool(e.hard_link_id)
+            return e.to_bytes()
+
+        raw, _src = cache.get_or_load(
+            self._entry_key(directory, name),
+            load,
+            # never admit hardlinked entries (sibling writes mutate
+            # them without touching this path), nor entries big enough
+            # to flush the hot set (huge inlined content/chunk lists)
+            admit=lambda b: not hardlinked[0]
+            and len(b) <= cache.capacity // 8,
+        )
+        return Entry.from_bytes(directory, raw)
+
+    def _entry_cache_drop(self, directory: str, name: str) -> None:
+        """Invalidate one path's cached entry. Called by every mutator
+        (local writes, renames, deletes, hardlinks, replicated meta-log
+        events); an in-flight load for the path is fenced by the cache
+        (doomed, never admitted), so a lookup racing the write cannot
+        repopulate the stale entry."""
+        if name:
+            self.entry_cache.drop(
+                self._entry_key(normalize_path(directory), name)
+            )
 
     @staticmethod
     def _is_expired(entry: Entry) -> bool:
@@ -369,6 +436,7 @@ class Filer:
                     )
                 self.store.delete_folder_children(entry.full_path)
             self.store.delete(directory, name)
+            self._entry_cache_drop(directory, name)
             if gc_chunks:
                 self._release_entry_chunks(entry)
         self._notify(directory, entry, None, delete_chunks=gc_chunks)
@@ -429,6 +497,9 @@ class Filer:
                 ts_src = self._stamp(src)
                 self.store.update(src)
                 self._hl_publish(src)  # the shared inode record
+                # src just BECAME hardlinked: its cached (cacheable,
+                # pre-link) entry is now stale and must not be served
+                self._entry_cache_drop(src_dir, src_name)
                 # peers must learn src's hardlink marker or their
                 # delete path would GC the shared chunks
                 notify.append((src_dir, old_src, src, ts_src))
@@ -454,6 +525,7 @@ class Filer:
             except BaseException:
                 self.store.kv_put(key, str(n - 1).encode())
                 raise
+            self._entry_cache_drop(dst_dir, dst_name)
             notify.append((dst_dir, None, dst, ts_dst))
         for d, old, new, ts in notify:
             self._notify(d, old, new, ts_ns=ts)
@@ -502,6 +574,8 @@ class Filer:
         ts_cre = self._stamp(moved)
         self.store.insert(moved)
         self.store.delete(old_dir, old_name)
+        self._entry_cache_drop(old_dir, old_name)
+        self._entry_cache_drop(new_dir, new_name)
         self._notify(old_dir, entry, None, ts_ns=ts_del)
         self._notify(new_dir, None, moved, ts_ns=ts_cre)
 
@@ -536,6 +610,7 @@ class Filer:
                     # serving this peer's stale content over the newer
                     # replicated chunks
                     self._hl_publish(entry)
+                self._entry_cache_drop(directory, entry.name)
                 applied_old, applied_new = local, entry
             elif has_old:
                 local = self._try_find(directory, old_p.name)
@@ -548,6 +623,7 @@ class Filer:
                     if list(self.store.list(local.full_path, limit=1)):
                         return False
                 self.store.delete(directory, old_p.name)
+                self._entry_cache_drop(directory, old_p.name)
                 applied_old, applied_new = local, None
             else:
                 return False

@@ -1146,6 +1146,7 @@ class VolumeServer:
                 ip, _netp.derive_port(self.grpc_port),
                 self._net_plane_resolve,
                 server_label=f"{ip}:{port}",
+                resolve_needle=self._net_plane_resolve_needle,
             )
         except Exception as e:  # port collision etc: gRPC-only peer
             logger("volume").warning("shard net plane disabled: %s", e)
@@ -1218,6 +1219,36 @@ class VolumeServer:
         if fd is None:
             raise NetPlaneError("shard not local")
         return fd, os.fstat(fd).st_size
+
+    def _net_plane_resolve_needle(self, vid: int, nid: int, cookie: int):
+        """Needle payload location for the net plane's chunk-read
+        opcode (ISSUE 13) — the same control-plane checks as
+        ``?locate=true`` (replicated volumes only; TTL'd/tiered/EC
+        volumes refuse so those reads keep the locked, validated HTTP
+        path). The fd is opened per request against the CURRENT .dat
+        path — a vacuum commit mid-flight surfaces as the client's CRC
+        mismatch, exactly like the fastread sidecar."""
+        from ..ec.net_plane import NetPlaneError, NetPlaneVolumeRefusal
+
+        vol = self.store.find_volume(vid)
+        if vol is None:
+            # EC or not mounted here: no needle on this volume will
+            # ever serve — status 2 lets clients negative-cache the vid
+            raise NetPlaneVolumeRefusal("volume not here (or EC)")
+        try:
+            path, off, size, crc = vol.locate_payload(nid, cookie)
+        except VolumeError as e:
+            # TTL'd/tiered/broken: volume-level, clients stop probing
+            raise NetPlaneVolumeRefusal(str(e)) from None
+        except Exception as e:
+            # needle-level (not found, cookie mismatch): other needles
+            # on the volume may still serve
+            raise NetPlaneError(str(e)) from None
+        try:
+            fd = os.open(path, os.O_RDONLY)
+        except OSError as e:
+            raise NetPlaneError(str(e)) from None
+        return fd, off, size, crc, True
 
     # ----------------------------------------------------- remote shards
 

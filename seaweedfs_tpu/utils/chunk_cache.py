@@ -213,10 +213,16 @@ class ChunkCache:
             self._bytes -= len(evicted)
 
     def drop(self, fid: str) -> None:
+        """Drop one key. A load already in flight for it is fenced
+        exactly like drop_prefix: its result goes to the callers that
+        joined, but it is never admitted — so an invalidation racing a
+        read-through (the filer entry cache's write-vs-lookup race)
+        cannot be repopulated by the pre-invalidation load."""
         with self._lock:
             old = self._data.pop(fid, None)
             if old is not None:
                 self._bytes -= len(old)
+            self._sf.doom(fid)
 
     def _doom_inflight_locked(self, match) -> None:
         """Fence in-flight loads whose key satisfies `match`: each

@@ -393,3 +393,13 @@ net_bytes_copied_total = REGISTRY.counter(
     "network byte path (the bytes-copied-per-byte-served numerator)",
     ("plane",),
 )
+
+# Warm-path control plane (ISSUE 13): SigV4 verdict-memo outcomes on
+# header-auth requests. hit = the full canonical-request + HMAC chain
+# was skipped (freshness/identity/session-token still re-checked);
+# bypass = presigned or streaming auth, or the memo is disabled.
+s3_auth_memo_total = REGISTRY.counter(
+    "sw_s3_auth_memo_total",
+    "SigV4 verdict-memo outcomes (hit/miss/bypass) on the S3 auth path",
+    ("result",),
+)

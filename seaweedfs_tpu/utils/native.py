@@ -192,7 +192,10 @@ _lib.sn_recv_into.argtypes = [
     ctypes.c_void_p,  # out_crcs (u32[max_out])
     ctypes.c_void_p,  # out_count (i32[1])
     ctypes.c_int32,   # max_out
+    ctypes.c_int32,   # overlap_mode (0 serial / 1 overlap / -1 auto)
 ]
+_lib.sn_recv_overlap_active.restype = ctypes.c_int
+_lib.sn_recv_overlap_active.argtypes = [ctypes.c_uint64]
 _lib.sn_sink_direct_flags.restype = ctypes.c_int
 _lib.sn_sink_direct_flags.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
 _lib.sn_has_avx2.restype = ctypes.c_int
@@ -444,6 +447,29 @@ def sendv(out_fd: int, parts, timeout_ms: int = -1) -> int:
     return int(sent)
 
 
+def recv_overlap_active(length: int) -> bool:
+    """Whether a fused recv+CRC of `length` bytes would run the
+    OVERLAPPED core (socket reads on a helper thread, CRC chasing the
+    landed bytes) under the current host/env. Auto: >=4 hardware
+    threads AND >=256 KiB; ``SEAWEED_EC_NET_OVERLAP=1|0`` forces the
+    core gate on/off (the size floor always applies). Read live, so
+    the multi-core re-measure recipe can flip it per run."""
+    return bool(_lib.sn_recv_overlap_active(length))
+
+
+def _overlap_mode() -> int:
+    """SEAWEED_EC_NET_OVERLAP -> the overlap_mode parameter of
+    sn_recv_into. Read HERE (under the GIL, where os.environ mutation
+    also happens) and passed down — a getenv on the C hot path would
+    race a concurrent setenv, which is undefined behavior."""
+    env = os.environ.get("SEAWEED_EC_NET_OVERLAP", "")
+    if env == "1":
+        return 1
+    if env == "0":
+        return 0
+    return -1
+
+
 def recv_into(
     fd: int,
     dst: np.ndarray,
@@ -490,6 +516,7 @@ def recv_into(
         ctypes.c_void_p(out_crcs.ctypes.data) if granule else None,
         ctypes.c_void_p(out_counts.ctypes.data) if granule else None,
         max_out,
+        _overlap_mode(),
     )
     if got < 0:
         raise OSError(-got, f"sn_recv_into: {os.strerror(-got)}")

@@ -45,6 +45,8 @@ NEW_SYMBOLS = [
     "sn_sendv",
     "sn_recv_into",
     "sn_sink_direct_flags",
+    # ISSUE 13: env-tunable overlapped-recv core gate probe
+    "sn_recv_overlap_active",
 ]
 
 

@@ -1,0 +1,522 @@
+"""Warm-path control-plane fast paths (ISSUE 13).
+
+Coherence contracts for the two caches the warm S3 GET now rides —
+the SigV4 verdict memo (s3/auth.py) and the filer entry-lookup cache
+(tier="filer_entry") — plus the end-to-end identity of the
+chunk-fetch-over-net-plane byte path:
+
+- a memo/cache HIT must be bit-identical to a full recomputation;
+- key rotation, permanent 403s, deletes, renames, and replicated
+  meta-log events must NEVER be served stale;
+- presigned/streaming auth bypasses the memo untouched;
+- concurrent warm misses on one entry collapse to ONE store.find.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import threading
+import time
+import urllib.parse
+
+import pytest
+
+from seaweedfs_tpu.filer import Filer, MemoryStore
+from seaweedfs_tpu.filer.filer_store import NotFound
+from seaweedfs_tpu.s3 import auth as s3auth
+from seaweedfs_tpu.s3.auth import (
+    Identity,
+    IdentityStore,
+    S3AuthError,
+    verify_v4_ex,
+)
+from seaweedfs_tpu.utils import metrics as M
+
+ACCESS = "AKIDWARM"
+SECRET = "warm-secret-1"
+
+
+@pytest.fixture(autouse=True)
+def _clean_auth_caches():
+    s3auth.auth_cache_clear()
+    yield
+    s3auth.auth_cache_clear()
+
+
+def _sign(
+    method: str,
+    path: str,
+    query: str = "",
+    secret: str = SECRET,
+    access: str = ACCESS,
+    payload: bytes = b"",
+    payload_hash: str | None = None,
+    extra_headers: dict | None = None,
+    sign_extra: bool = True,
+    region: str = "us-east-1",
+    amz_date: str | None = None,
+):
+    """Build (headers, payload_hash) for a header-auth SigV4 request
+    via the shared signer next to the verifier (s3/auth.sign_v4) —
+    tests/test_s3.py keeps an independent hand-rolled signer as the
+    cross-implementation check."""
+    if payload_hash is None:
+        payload_hash = hashlib.sha256(payload).hexdigest()
+    base = {"host": "localhost:8333"}
+    if extra_headers and sign_extra:
+        base.update(extra_headers)
+    headers = s3auth.sign_v4(
+        method, path, query,
+        access_key=access, secret_key=secret,
+        headers=base, payload_hash=payload_hash,
+        region=region, amz_date=amz_date,
+    )
+    if extra_headers and not sign_extra:
+        # header present on the request but NOT part of the signature
+        headers.update(extra_headers)
+    return headers, payload_hash
+
+
+def _store(ident: Identity | None = None) -> IdentityStore:
+    s = IdentityStore()
+    s.add(ident or Identity("warm", ACCESS, SECRET))
+    return s
+
+
+def _memo_counts() -> dict:
+    return {
+        k[0]: int(v) for k, v in M.s3_auth_memo_total.snapshot().items()
+    }
+
+
+# ------------------------------------------------------------- auth memo
+
+
+def test_auth_memo_hit_bit_identical():
+    """The second identical request is a memo HIT and returns the same
+    identity and a SigningContext equal field-for-field to the full
+    verification's."""
+    store = _store()
+    hdrs, ph = _sign("GET", "/bench/obj")
+    c0 = _memo_counts()
+    id1, ctx1 = verify_v4_ex(store, "GET", "/bench/obj", "", hdrs, ph)
+    id2, ctx2 = verify_v4_ex(store, "GET", "/bench/obj", "", hdrs, ph)
+    c1 = _memo_counts()
+    assert c1.get("miss", 0) - c0.get("miss", 0) == 1
+    assert c1.get("hit", 0) - c0.get("hit", 0) == 1
+    assert id1 is id2  # same stored Identity from a fresh lookup
+    assert ctx1 == ctx2  # dataclass equality: key, date, scope, seed sig
+    assert s3auth.auth_cache_stats()["verdicts"] == 1
+
+
+def test_auth_memo_key_rotation_never_served():
+    """Rotating the secret invalidates BY CONSTRUCTION (the secret is
+    part of the memo digest): the old signed request must 403, never
+    replay from the memo."""
+    store = _store()
+    hdrs, ph = _sign("GET", "/bench/obj")
+    verify_v4_ex(store, "GET", "/bench/obj", "", hdrs, ph)  # memoized
+    store.add(Identity("warm", ACCESS, "rotated-secret-2"))
+    with pytest.raises(S3AuthError) as ei:
+        verify_v4_ex(store, "GET", "/bench/obj", "", hdrs, ph)
+    assert ei.value.code == "SignatureDoesNotMatch"
+    # re-signed with the new secret: verifies and memoizes separately
+    hdrs2, ph2 = _sign("GET", "/bench/obj", secret="rotated-secret-2")
+    ident, _ = verify_v4_ex(store, "GET", "/bench/obj", "", hdrs2, ph2)
+    assert ident.secret_key == "rotated-secret-2"
+
+
+def test_auth_permanent_403_never_cached():
+    """Failed verifications are recomputed every time — only successes
+    are admitted to the memo."""
+    store = _store()
+    hdrs, ph = _sign("GET", "/bench/obj", secret="wrong-secret")
+    for _ in range(2):
+        with pytest.raises(S3AuthError) as ei:
+            verify_v4_ex(store, "GET", "/bench/obj", "", hdrs, ph)
+        assert ei.value.code == "SignatureDoesNotMatch"
+    assert s3auth.auth_cache_stats()["verdicts"] == 0
+
+
+def test_auth_memo_tamper_is_a_miss():
+    """Any changed verification input (here: the path) is a different
+    digest — the memo can never validate a tampered request."""
+    store = _store()
+    hdrs, ph = _sign("GET", "/bench/obj")
+    verify_v4_ex(store, "GET", "/bench/obj", "", hdrs, ph)
+    with pytest.raises(S3AuthError) as ei:
+        verify_v4_ex(store, "GET", "/bench/OTHER", "", hdrs, ph)
+    assert ei.value.code == "SignatureDoesNotMatch"
+
+
+def test_auth_streaming_bypasses_memo():
+    store = _store()
+    ph = "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"
+    hdrs, _ = _sign("PUT", "/bench/obj", payload_hash=ph)
+    c0 = _memo_counts()
+    _, ctx = verify_v4_ex(store, "PUT", "/bench/obj", "", hdrs, ph)
+    assert ctx is not None  # streaming auth still yields the seed ctx
+    c1 = _memo_counts()
+    assert c1.get("bypass", 0) - c0.get("bypass", 0) == 1
+    assert s3auth.auth_cache_stats()["verdicts"] == 0
+
+
+def test_auth_presigned_bypasses_memo():
+    """Presigned-URL auth never touches the memo (its own code path,
+    byte-for-byte untouched)."""
+    store = _store()
+    now = datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    date = amz_date[:8]
+    scope = f"{date}/us-east-1/s3/aws4_request"
+    q = {
+        "X-Amz-Algorithm": "AWS4-HMAC-SHA256",
+        "X-Amz-Credential": f"{ACCESS}/{scope}",
+        "X-Amz-Date": amz_date,
+        "X-Amz-Expires": "3600",
+        "X-Amz-SignedHeaders": "host",
+    }
+    cq = "&".join(
+        f"{urllib.parse.quote(k, safe='-_.~')}="
+        f"{urllib.parse.quote(v, safe='-_.~')}"
+        for k, v in sorted(q.items())
+    )
+    creq = "\n".join(
+        ["GET", "/bench/obj", cq, "host:localhost:8333\n", "host",
+         "UNSIGNED-PAYLOAD"]
+    )
+    sts = "\n".join(
+        ["AWS4-HMAC-SHA256", amz_date, scope,
+         hashlib.sha256(creq.encode()).hexdigest()]
+    )
+
+    def h(key, msg):
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+    k = h(
+        h(h(h(("AWS4" + SECRET).encode(), date), "us-east-1"), "s3"),
+        "aws4_request",
+    )
+    sig = hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()
+    query = f"{cq}&X-Amz-Signature={sig}"
+    headers = {"host": "localhost:8333"}
+    c0 = _memo_counts()
+    ident, ctx = verify_v4_ex(
+        store, "GET", "/bench/obj", query, headers, "UNSIGNED-PAYLOAD"
+    )
+    assert ident.access_key == ACCESS and ctx is None
+    c1 = _memo_counts()
+    assert c1.get("hit", 0) == c0.get("hit", 0)
+    assert c1.get("miss", 0) == c0.get("miss", 0)
+    assert s3auth.auth_cache_stats()["verdicts"] == 0
+
+
+def test_auth_memo_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("SEAWEED_S3_AUTH_MEMO", "0")
+    store = _store()
+    hdrs, ph = _sign("GET", "/bench/obj")
+    c0 = _memo_counts()
+    verify_v4_ex(store, "GET", "/bench/obj", "", hdrs, ph)
+    verify_v4_ex(store, "GET", "/bench/obj", "", hdrs, ph)
+    c1 = _memo_counts()
+    assert c1.get("hit", 0) == c0.get("hit", 0)
+    assert c1.get("bypass", 0) - c0.get("bypass", 0) == 2
+    assert s3auth.auth_cache_stats()["verdicts"] == 0
+
+
+def test_auth_memo_session_token_rechecked_on_hit():
+    """The session token may ride an UNSIGNED header (outside the memo
+    digest): a hit must still re-compare it — a revoked/garbled token
+    is refused even when the signature memo matches."""
+    ident = Identity(
+        "sts", ACCESS, SECRET, actions=("Admin",), session_token="tok-1"
+    )
+    store = _store(ident)
+    hdrs, ph = _sign(
+        "GET", "/bench/obj",
+        extra_headers={"x-amz-security-token": "tok-1"},
+        sign_extra=False,
+    )
+    id1, _ = verify_v4_ex(store, "GET", "/bench/obj", "", hdrs, ph)
+    assert id1.session_token == "tok-1"
+    bad = dict(hdrs)
+    bad["x-amz-security-token"] = "tok-FORGED"
+    with pytest.raises(S3AuthError) as ei:
+        verify_v4_ex(store, "GET", "/bench/obj", "", bad, ph)
+    assert ei.value.code == "InvalidToken"
+
+
+def test_signing_key_cache_pure():
+    """signing_key is memoized but stays a pure function of its
+    arguments — distinct scopes derive distinct keys."""
+    k1 = s3auth.signing_key("s", "20260804", "us-east-1")
+    k2 = s3auth.signing_key("s", "20260804", "us-east-1")
+    k3 = s3auth.signing_key("s", "20260805", "us-east-1")
+    k4 = s3auth.signing_key("OTHER", "20260804", "us-east-1")
+    assert k1 == k2 and k1 != k3 and k1 != k4
+    assert s3auth.auth_cache_stats()["signing_keys"] == 3
+
+
+# ------------------------------------------------------ entry-lookup cache
+
+
+@pytest.fixture
+def filer():
+    f = Filer(MemoryStore(), master="localhost:1")
+    yield f
+    f.close()
+
+
+def test_entry_cache_hit_bit_identical(filer):
+    filer.write_file("/dir/a.txt", b"hello")  # inlined: no volume I/O
+    e1 = filer.find_entry("/dir/a.txt")
+    s0 = filer.entry_cache.stats()
+    e2 = filer.find_entry("/dir/a.txt")
+    s1 = filer.entry_cache.stats()
+    assert s1["hits"] - s0["hits"] == 1
+    assert e1.to_bytes() == e2.to_bytes()
+    assert e2.content == b"hello"
+    assert e1 is not e2  # decoded per hit: callers may mutate freely
+
+
+def test_entry_cache_invalidated_on_overwrite(filer):
+    filer.write_file("/dir/a.txt", b"v1")
+    assert filer.find_entry("/dir/a.txt").content == b"v1"
+    filer.write_file("/dir/a.txt", b"v2-new")
+    assert filer.find_entry("/dir/a.txt").content == b"v2-new"
+
+
+def test_entry_cache_invalidated_on_mutate(filer):
+    filer.write_file("/dir/a.txt", b"x")
+    filer.find_entry("/dir/a.txt")
+
+    def set_mime(e):
+        e.attr.mime = "text/warm"
+
+    filer.mutate_entry("/dir/a.txt", set_mime)
+    assert filer.find_entry("/dir/a.txt").attr.mime == "text/warm"
+
+
+def test_entry_cache_stale_never_served_after_delete(filer):
+    filer.write_file("/dir/a.txt", b"gone soon")
+    filer.find_entry("/dir/a.txt")  # cached
+    filer.delete_entry("/dir/a.txt")
+    with pytest.raises(NotFound):
+        filer.find_entry("/dir/a.txt")
+
+
+def test_entry_cache_invalidated_on_rename(filer):
+    filer.write_file("/dir/a.txt", b"moving")
+    filer.find_entry("/dir/a.txt")  # cache the old path
+    with pytest.raises(NotFound):
+        filer.find_entry("/dir/b.txt")  # NotFound is not cached
+    filer.rename("/dir/a.txt", "/dir/b.txt")
+    with pytest.raises(NotFound):
+        filer.find_entry("/dir/a.txt")
+    assert filer.find_entry("/dir/b.txt").content == b"moving"
+
+
+def test_entry_cache_invalidated_by_remote_meta_event():
+    """A replicated meta-log event (multi-filer aggregation) must
+    invalidate like a local write: the follower filer serves the
+    replicated content, not its cached pre-event entry."""
+    origin = Filer(MemoryStore(), master="localhost:1")
+    follower = Filer(MemoryStore(), master="localhost:1")
+    events = []
+    origin.subscribe(events.append)
+    try:
+        origin.write_file("/r/x", b"v1")
+        for ev in list(events):
+            follower.apply_remote_event(ev)
+        assert follower.find_entry("/r/x").content == b"v1"  # cached
+        events.clear()
+        origin.write_file("/r/x", b"v2-replicated")
+        for ev in list(events):
+            follower.apply_remote_event(ev)
+        assert follower.find_entry("/r/x").content == b"v2-replicated"
+    finally:
+        origin.close()
+        follower.close()
+
+
+def test_entry_cache_hardlinked_names_never_stale(filer):
+    """Hardlinked entries are never admitted: a write through one name
+    is visible through every sibling immediately."""
+    filer.write_file("/hl/a", b"shared-v1")
+    filer.hard_link("/hl/a", "/hl/b")
+    assert filer.find_entry("/hl/a").content == b"shared-v1"
+    assert filer.find_entry("/hl/b").content == b"shared-v1"
+    # write through b; a must observe it (no cached pre-link snapshot)
+    filer.write_file("/hl/b", b"shared-v2!")
+    assert filer.find_entry("/hl/a").content == b"shared-v2!"
+    assert filer.find_entry("/hl/b").content == b"shared-v2!"
+
+
+def test_entry_cache_respects_ttl_expiry(filer):
+    filer.write_file("/ttl/x", b"short-lived", ttl_sec=1)
+    assert filer.find_entry("/ttl/x").content == b"short-lived"
+
+    def age(e):
+        e.attr.crtime = int(time.time()) - 10
+
+    filer.mutate_entry("/ttl/x", age)
+    # cached or not, the TTL check runs on every return
+    with pytest.raises(NotFound):
+        filer.find_entry("/ttl/x")
+
+
+def test_entry_lookup_singleflight_one_store_find(filer):
+    """ISSUE 13 acceptance: N concurrent warm misses on one entry
+    collapse to ONE store.find."""
+    filer.write_file("/sf/obj", b"collapse me")
+    filer.entry_cache.clear()
+    finds = [0]
+    lock = threading.Lock()
+    real_find = filer.store.find
+
+    def slow_counting_find(directory, name):
+        with lock:
+            finds[0] += 1
+        time.sleep(0.05)  # hold the flight open so others join
+        return real_find(directory, name)
+
+    filer.store.find = slow_counting_find
+    try:
+        results = []
+        errs = []
+
+        def reader():
+            try:
+                results.append(filer.find_entry("/sf/obj").to_bytes())
+            except Exception as e:  # pragma: no cover - fail the assert
+                errs.append(e)
+
+        threads = [threading.Thread(target=reader) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        assert finds[0] == 1, f"{finds[0]} store.find calls for 8 readers"
+        assert len(set(results)) == 1  # everyone got the leader's bytes
+        s = filer.entry_cache.stats()
+        assert s["singleflight_waits"] >= 1
+    finally:
+        filer.store.find = real_find
+
+
+def test_entry_cache_disabled_is_passthrough():
+    f = Filer(MemoryStore(), master="localhost:1", entry_cache_bytes=0)
+    try:
+        f.write_file("/p/x", b"no cache")
+        assert f.find_entry("/p/x").content == b"no cache"
+        assert f.entry_cache.stats()["entries"] == 0
+    finally:
+        f.close()
+
+
+# ----------------------------------------- chunk fetch over the net plane
+
+
+def test_warm_gateway_chunk_fetch_rides_native_plane(tmp_path):
+    """End to end: a warm S3 GET with the filer chunk cache OFF moves
+    its volume chunk bytes over the shard net plane's needle opcode
+    (sw_net_bytes_received{plane=native} grows by the body size), and
+    the body is bit-identical with the plane disabled."""
+    import os
+
+    import requests
+
+    from conftest import allocate_port as free_port
+    from seaweedfs_tpu.s3 import S3Server
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    mport = free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path / "v")],
+        master=f"localhost:{mport}",
+        ip="localhost",
+        port=free_port(),
+        ec_backend="cpu",
+    )
+    vs.start()
+    filer = srv = None
+    try:
+        deadline = time.time() + 20
+        while not master.topo.nodes:
+            assert time.time() < deadline, "volume never registered"
+            time.sleep(0.05)
+        # chunk cache off: every GET pays the filer->volume fetch —
+        # exactly the path ISSUE 13 moves onto the net plane
+        filer = Filer(
+            MemoryStore(), master=f"localhost:{mport}",
+            chunk_size=128 * 1024, chunk_cache_bytes=0,
+        )
+        srv = S3Server(filer, ip="localhost", port=free_port())
+        srv.start()
+        base = f"http://localhost:{srv.port}"
+        data = os.urandom(300 * 1024)  # 3 chunks
+        assert requests.put(f"{base}/warm").status_code == 200
+        assert requests.put(
+            f"{base}/warm/obj", data=data
+        ).status_code == 200
+        r0 = {
+            k[0]: v
+            for k, v in M.net_bytes_received_total.snapshot().items()
+        }
+        r = requests.get(f"{base}/warm/obj", timeout=30)
+        assert r.status_code == 200 and r.content == data
+        r1 = {
+            k[0]: v
+            for k, v in M.net_bytes_received_total.snapshot().items()
+        }
+        native_delta = r1.get("native", 0) - r0.get("native", 0)
+        assert native_delta >= len(data), (
+            f"chunk bytes did not ride the native plane: {native_delta}"
+        )
+        assert vs.net_plane.needle_requests >= 3
+        # plane off: the Python-HTTP fallback serves identical bytes
+        os.environ["SEAWEED_CHUNK_NET_PLANE"] = "0"
+        try:
+            r = requests.get(f"{base}/warm/obj", timeout=30)
+            assert r.status_code == 200 and r.content == data
+        finally:
+            os.environ.pop("SEAWEED_CHUNK_NET_PLANE", None)
+    finally:
+        for closer in (
+            (lambda: srv.stop()) if srv is not None else None,
+            (lambda: filer.close()) if filer is not None else None,
+            vs.stop,
+            master.stop,
+        ):
+            if closer is None:
+                continue
+            try:
+                closer()
+            except Exception:
+                pass
+
+
+def test_amz_date_parse_stays_strict():
+    """The fast fixed-layout date parse must refuse everything strptime
+    refused: signs, padding, non-ASCII digits, wrong separators."""
+    ok = s3auth._parse_amz_date("20260804T120000Z")
+    assert (ok.year, ok.hour) == (2026, 12)
+    for bad in (
+        "2026080aT120000Z",      # non-digit
+        "20260804 120000Z",      # wrong separator
+        "20260804T120000z",      # wrong terminator
+        "20260804T1200007",      # no Z
+        " 0260804T120000Z",      # padding int() would accept
+        "+026080,T120000Z",      # sign int() would accept
+        "２０２６０８０４T120000Z",  # full-width digits
+        "20260804T120000ZZ",     # wrong length
+        "20261304T120000Z",      # month 13: range check
+    ):
+        with pytest.raises(ValueError):
+            s3auth._parse_amz_date(bad)
