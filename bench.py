@@ -652,6 +652,154 @@ def _placement_bench(
     }
 
 
+def _streaming_encode_bench(
+    workdir: str,
+    n_appends: int = 3000,
+    append_bytes: int = 8192,
+    flush_kib: int = 256,
+    naive_segment_mb: int = 4,
+) -> dict:
+    """streaming_encode (ISSUE 14 acceptance metric): sustained append
+    load through the online EC encoder vs the naive seal-then-batch-
+    encode baseline IN THE SAME RUN, on the same bytes.
+
+    Streaming: every append buffers into an `EcStreamEncoder`; a flush
+    (pending >= flush threshold, plus a final one) runs the incremental
+    parity math, pwrites, fsyncs, and advances the stripe-cursor
+    journal — each append's time-to-durable-parity is the wall time
+    from its append() to the flush that covered it.
+
+    Naive: the same appends accumulate in a plain segment file; at
+    every `naive_segment_mb` boundary the segment SEALS and
+    `write_ec_files` batch-encodes it (fsync'd) — each append's
+    time-to-durable-parity is the wall time to the END of its
+    segment's encode, the seal-then-encode lag this PR removes.
+
+    stream_vs_batch_identical: the streaming encoder's finalized
+    shards + sidecar CRCs must be byte-equal to ONE batch encode over
+    the concatenation (the RS-linearity identity, asserted in the
+    line)."""
+    from seaweedfs_tpu.ec.backend import CpuBackend
+    from seaweedfs_tpu.ec.context import ECContext
+    from seaweedfs_tpu.ec.encoder import write_ec_files
+    from seaweedfs_tpu.ec.stream_encode import EcStreamEncoder
+
+    ctx = ECContext(10, 4)
+    be = CpuBackend(ctx)
+    block = 256 * 1024
+    small = 64 * 1024
+    flush_bytes = flush_kib << 10
+    rng = np.random.default_rng(0x57E4)
+    payload = rng.integers(
+        0, 256, n_appends * append_bytes, dtype=np.uint8
+    ).tobytes()
+
+    sdir = os.path.join(workdir, "stream_bench")
+    os.makedirs(sdir, exist_ok=True)
+
+    def quantiles(lags_ms: list[float]) -> tuple[float, float]:
+        s = sorted(lags_ms)
+        return (
+            s[int(0.50 * (len(s) - 1))],
+            s[int(0.99 * (len(s) - 1))],
+        )
+
+    # ---- streaming phase ------------------------------------------------
+    sbase = os.path.join(sdir, "stream")
+    enc = EcStreamEncoder(
+        sbase, ctx, backend=be, block_size=block, small_block_size=small
+    )
+    t_append: list[float] = [0.0] * n_appends
+    lags_ms: list[float] = []
+    covered = 0
+    t0 = time.perf_counter()
+    for i in range(n_appends):
+        t_append[i] = time.perf_counter()
+        enc.append(payload[i * append_bytes : (i + 1) * append_bytes])
+        if enc.pending_bytes >= flush_bytes:
+            durable = enc.flush()
+            now = time.perf_counter()
+            while (covered + 1) * append_bytes <= durable:
+                lags_ms.append((now - t_append[covered]) * 1e3)
+                covered += 1
+    durable = enc.flush()
+    now = time.perf_counter()
+    while covered < n_appends and (covered + 1) * append_bytes <= durable:
+        lags_ms.append((now - t_append[covered]) * 1e3)
+        covered += 1
+    stream_wall = time.perf_counter() - t0
+    prot_stream = enc.close()
+    p50, p99 = quantiles(lags_ms)
+
+    # ---- naive seal-then-encode phase ----------------------------------
+    seg_bytes = naive_segment_mb << 20
+    nbase_dir = os.path.join(sdir, "naive")
+    os.makedirs(nbase_dir, exist_ok=True)
+    naive_lags_ms: list[float] = []
+    t0 = time.perf_counter()
+    seg_start = 0  # first append index of the open segment
+    seg_file = None
+    seg = 0
+    nt_append: list[float] = [0.0] * n_appends
+    for i in range(n_appends):
+        if seg_file is None:
+            seg_file = open(
+                os.path.join(nbase_dir, f"seg{seg:04d}.dat"), "wb"
+            )
+        nt_append[i] = time.perf_counter()
+        seg_file.write(payload[i * append_bytes : (i + 1) * append_bytes])
+        if seg_file.tell() >= seg_bytes or i == n_appends - 1:
+            seg_file.flush()
+            os.fsync(seg_file.fileno())
+            seg_file.close()
+            write_ec_files(
+                os.path.join(nbase_dir, f"seg{seg:04d}"), ctx, be,
+                large_block_size=block, small_block_size=small,
+            )
+            now = time.perf_counter()
+            naive_lags_ms.extend(
+                (now - nt_append[j]) * 1e3 for j in range(seg_start, i + 1)
+            )
+            seg_start = i + 1
+            seg += 1
+            seg_file = None
+    naive_wall = time.perf_counter() - t0
+    np50, np99 = quantiles(naive_lags_ms)
+
+    # ---- identity: stream shards == ONE batch encode over the concat ---
+    bbase = os.path.join(sdir, "batch")
+    with open(bbase + ".dat", "wb") as f:
+        f.write(payload)
+    prot_batch = write_ec_files(
+        bbase, ctx, be, large_block_size=block, small_block_size=small
+    )
+    identical = bool(
+        prot_stream is not None
+        and prot_stream.shard_crcs == prot_batch.shard_crcs
+        and prot_stream.shard_leaf_crcs == prot_batch.shard_leaf_crcs
+        and prot_stream.shard_sizes == prot_batch.shard_sizes
+        and all(
+            open(sbase + ctx.to_ext(i), "rb").read()
+            == open(bbase + ctx.to_ext(i), "rb").read()
+            for i in range(ctx.total)
+        )
+    )
+    return {
+        "time_to_durable_parity_p50_ms": round(p50, 3),
+        "time_to_durable_parity_p99_ms": round(p99, 3),
+        "streaming_appends_per_s": round(n_appends / stream_wall, 1),
+        "streaming_parity_covered": covered,
+        "naive_parity_p50_ms": round(np50, 3),
+        "naive_parity_p99_ms": round(np99, 3),
+        "naive_appends_per_s": round(n_appends / naive_wall, 1),
+        "streaming_vs_naive_p99": round(np99 / max(p99, 1e-9), 2),
+        "stream_vs_batch_identical": identical,
+        "streaming_append_kib": append_bytes >> 10,
+        "streaming_flush_kib": flush_kib,
+        "naive_segment_mb": naive_segment_mb,
+    }
+
+
 def _leaf_repair_bench(base: str) -> dict:
     """Leaf repair vs full-shard rebuild (ISSUE 8 acceptance metric):
     one rotten 64 KiB leaf in one shard, fixed two ways against the
@@ -2798,6 +2946,28 @@ def _self_check() -> int:
             f"native_mb={warm.get('gateway_warm_chunk_native_mb')}",
         )
 
+        # ---- streaming-EC bit identity (ISSUE 14): N appends through
+        # the online encoder == ONE batch encode over the concat, and
+        # the streaming path's p99 time-to-durable-parity beats the
+        # naive seal-then-encode baseline in the same run ------------
+        stream_stats = _streaming_encode_bench(
+            workdir, n_appends=400, append_bytes=4096,
+            flush_kib=64, naive_segment_mb=1,
+        )
+        check(
+            "stream_vs_batch_bit_identical",
+            stream_stats.get("stream_vs_batch_identical") is True
+            and stream_stats.get("streaming_parity_covered") == 400,
+            f"stats={stream_stats}",
+        )
+        check(
+            "streaming_parity_beats_seal_then_encode",
+            stream_stats.get("time_to_durable_parity_p99_ms", 1e9)
+            < stream_stats.get("naive_parity_p99_ms", 0.0),
+            f"stream p99={stream_stats.get('time_to_durable_parity_p99_ms')}"
+            f" naive p99={stream_stats.get('naive_parity_p99_ms')}",
+        )
+
         # ---- entry-lookup singleflight: concurrent warm misses on ONE
         # entry collapse to ONE store.find --------------------------
         import threading as _th
@@ -3008,6 +3178,15 @@ def main() -> None:
             gateway_warm_stats = {
                 "gateway_warm_error": f"{type(e).__name__}: {e}"
             }
+        # Streaming EC (ISSUE 14): time-to-durable-parity under a
+        # sustained append load vs the naive seal-then-batch-encode
+        # baseline, with stream-vs-batch bit identity in the line.
+        try:
+            streaming_stats = _streaming_encode_bench(workdir)
+        except Exception as e:  # noqa: BLE001
+            streaming_stats = {
+                "streaming_encode_error": f"{type(e).__name__}: {e}"
+            }
 
         _clear_shards(base)  # device phase re-encodes the same volume
 
@@ -3068,6 +3247,7 @@ def main() -> None:
             **gateway_stats,
             **peer_rebuild_stats,
             **gateway_warm_stats,
+            **streaming_stats,
         }
         best.update(
             {

@@ -52,6 +52,13 @@ from .ec_volume import EcCookieMismatch, EcNotFoundError, EcVolume
 from .encoder import ec_encode_volume, write_ec_files, write_sorted_file_from_idx
 from .locate import Interval, locate_data
 from .pipeline import FusedShardSink, PyShardSink, make_shard_sink, run_pipeline
+from .stream_encode import (
+    EcStreamEncoder,
+    StreamJournal,
+    load_stream_journal,
+    recover_stream,
+    stream_summary,
+)
 from .peer_rebuild import (
     PeerCorruptError,
     PeerFetchTransient,

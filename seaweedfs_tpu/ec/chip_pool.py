@@ -53,7 +53,22 @@ import numpy as np
 
 from .device_queue import QueueScope, resolve_scope
 from .backend import CpuBackend, FallbackBackend, JaxBackend
+from ..utils import metrics as _M
 from ..utils.retry import CircuitBreaker
+
+# An open breaker means this chip's streams are failing over to CPU:
+# routing treats it as carrying this much extra outstanding cost, so a
+# healthy sibling wins any remotely close call while a dead pod (all
+# breakers open) still degrades gracefully instead of refusing.
+BREAKER_OPEN_PENALTY = 1 << 40
+
+_placement_decisions = _M.REGISTRY.counter(
+    "sw_ec_placement_decisions_total",
+    "EC stream placement decisions by the load signal that drove them "
+    "(live = per-chip DeviceQueue.load() moved the pick, ledger = "
+    "static stream cost hints alone, mesh = column-sliced)",
+    ("signal",),
+)
 
 
 class ChipBackend(JaxBackend):
@@ -191,10 +206,25 @@ class ChipPool:
         cost_hint: int = 0,
         prefer_mesh: bool = False,
         force_mesh: bool = False,
+        live_loads: "list[int] | None" = None,
     ):
         """Place one stream: returns (chip_index, backend, release).
         `release()` is idempotent and must fire when the stream closes
         (success or death) so the chip's load drains.
+
+        `live_loads` (per chip index, same order as `devices`) is the
+        LIVE routing signal: each chip's DeviceQueue cost units
+        queued+in-flight right now (plus breaker penalties), ADDED to
+        the ledger's static placed-cost charges when ranking chips —
+        the ROADMAP "routing reads live load" loop. The sum is
+        deliberately conservative: a chip busy with work the ledger
+        never saw (one-shot gateway admissions, another scope's
+        dispatches) now repels new streams, while a placed stream
+        keeps its ledger charge until it closes, so its own in-flight
+        batches count twice while it is actively dispatching — routing
+        prefers a chip that is merely RESERVED over one that is
+        reserved AND busy, which is the right bias even though it
+        overstates absolute load.
 
         `prefer_mesh` takes the whole-pod mesh IFF the pod is idle,
         decided under the SAME lock as the charge (no
@@ -209,6 +239,9 @@ class ChipPool:
         routing and idle checks)."""
         hint = max(int(cost_hint), 1)
         led = self._ledger
+        live = live_loads if live_loads is not None else [0] * len(
+            self.devices
+        )
         with self._lock:
             if force_mesh or (prefer_mesh and not any(led.streams)):
                 indices = range(len(led.load))
@@ -216,7 +249,7 @@ class ChipPool:
             else:
                 i = min(
                     range(len(led.load)),
-                    key=lambda j: (led.load[j], j),
+                    key=lambda j: (led.load[j] + live[j], j),
                 )
                 indices = (i,)
             for j in indices:
@@ -326,17 +359,35 @@ class Placement:
 
 
 def chip_load_hint(scope: QueueScope | None = None) -> dict[str, dict]:
-    """Read-only per-chip load/breaker hint for placement consumers and
-    the heartbeat telemetry plane: {chip_label: {"load": outstanding
-    cost units, "breaker": ""|"closed"|"open"|...}}.
+    """Read-only per-chip load/breaker hint: {chip_label: {"load":
+    outstanding cost units queued+in-flight, "breaker":
+    ""|"closed"|"open"|...}}.
 
-    OBSERVABILITY FIRST: today the hint is recorded as a span event at
-    placement decisions and shipped to the master via heartbeats
-    (/cluster/status, sw_ec_queue_load); feeding it back into live
-    routing is direction 3's work, not this function's. Reads only the
+    This is the LIVE routing signal: `place_stream` ranks chips by
+    ledger charge PLUS this load (and ships it to the master via
+    heartbeats for cluster-wide placement — /cluster/status,
+    sw_ec_queue_load, `placement.NodeView.ec_load`). Reads only the
     scope's existing DeviceQueues — no queue is created and no jax/
     device state is touched (dead-relay safe)."""
     return resolve_scope(scope).queue_loads()
+
+
+def _live_loads_for(pool: ChipPool, scope: QueueScope) -> list[int]:
+    """Per-chip-index live load (DeviceQueue.load() + breaker penalty)
+    aligned with `pool.labels`. Chips whose queue does not exist yet
+    read 0 — never create a queue just to ask its load."""
+    hint = scope.queue_loads()
+    out = []
+    for label in pool.labels:
+        h = hint.get(label)
+        if h is None:
+            out.append(0)
+            continue
+        load = int(h.get("load", 0))
+        if h.get("breaker") == "open":
+            load += BREAKER_OPEN_PENALTY
+        out.append(load)
+    return out
 
 
 def place_stream(
@@ -392,30 +443,41 @@ def place_stream(
         # second column-sliced stream through an independent window.
         if span is not None:
             span.event(
-                "placement", mode=mode, chip="mesh",
+                "placement", mode=mode, chip="mesh", signal="mesh",
                 loads=pool.loads(), cost_hint=cost_hint, wide=wide,
                 queue_load_hint=chip_load_hint(scope),
             )
+        _placement_decisions.inc(signal="mesh")
         _, _, release = pool.acquire(cost_hint, force_mesh=True)
         return Placement(backend, scope.for_backend(backend), None, release)
     if pool is None or pool.n_chips < 2:
         return Placement(backend, scope.for_backend(backend))
-    # Ledger snapshot BEFORE the charge: this is (modulo a racing
-    # placement) the state the routing decision reads.
+    # Routing inputs, snapshotted BEFORE the charge: the pod ledger
+    # (static per-stream cost hints) PLUS the live per-chip queue load
+    # (cost units queued+in-flight right now, breaker-penalized) — the
+    # decision follows their SUM, so a chip busy with work the ledger
+    # never saw repels new streams and a hinted-but-drained stream
+    # stops repelling them.
+    live = _live_loads_for(pool, scope)
+    signal = "live" if any(live) else "ledger"
     loads_seen = pool.loads() if span is not None else None
     idx, chip_be, release = pool.acquire(
-        cost_hint, prefer_mesh=(wide and mode == "auto")
+        cost_hint, prefer_mesh=(wide and mode == "auto"),
+        live_loads=live,
     )
     if span is not None:
-        # the heartbeat telemetry hint the decision COULD have read —
-        # recorded beside the pod ledger it DID read, the evidence for
-        # direction 3's live load routing
+        # the evidence for "why did this stream land on chip 3": the
+        # ledger AND the live queue loads the decision read, and which
+        # signal source was decisive
         span.event(
             "placement", mode=mode,
             chip=("mesh" if idx is None else pool.labels[idx]),
-            loads=loads_seen, cost_hint=cost_hint, wide=wide,
+            signal=("mesh" if idx is None else signal),
+            loads=loads_seen, live_loads=live,
+            cost_hint=cost_hint, wide=wide,
             queue_load_hint=chip_load_hint(scope),
         )
+    _placement_decisions.inc(signal=("mesh" if idx is None else signal))
     if idx is None:
         # Lone wide stream on an idle pod: it keeps the whole mesh and
         # the charge on every chip makes the pod read busy, so a second

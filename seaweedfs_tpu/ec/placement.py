@@ -35,6 +35,20 @@ class NodeView:
     # toward the roomiest node, so sustained holder loss doesn't pile
     # regenerated shards onto a nearly-full survivor.
     free_bytes: int = -1
+    # LIVE compute-load signal, heartbeat-learned (DataNode.ec_telemetry
+    # -> per-chip DeviceQueue.load() cost units summed across the
+    # node's chips). -1 = unknown (no telemetry: scored as idle, the
+    # pre-live behavior). Used as a scoring tiebreak so a long-lived EC
+    # stream lands on the host with compute headroom, not just disk
+    # headroom.
+    ec_load: float = -1.0
+    # open fallback breakers on the node (its chips are failing over to
+    # CPU): such a node loses any remotely close placement call.
+    ec_breakers_open: int = 0
+    # per-op/stage EWMA seconds from the node's flight recorder —
+    # recorded for span-event evidence; device-stage pressure breaks
+    # final ties.
+    ec_stage_ewma_s: float = -1.0
     # vid -> set of shard ids held
     shards: dict[int, set[int]] = field(default_factory=dict)
 
@@ -73,6 +87,7 @@ def node_view_for(
     collection: str = "",
     used_bytes: int = -1,
     capacity_bytes: int = -1,
+    ec_telemetry: dict | None = None,
 ) -> NodeView:
     """The ONE topology->NodeView mapping (shard-bit expansion and the
     slots*10 capacity formula) shared by the shell executor and the
@@ -85,7 +100,14 @@ def node_view_for(
 
     `used_bytes`/`capacity_bytes` (both >= 0) derive the node's disk
     headroom (`NodeView.free_bytes`); either unknown keeps headroom
-    unknown (-1, slot-only planning)."""
+    unknown (-1, slot-only planning).
+
+    `ec_telemetry` is the node's heartbeat-learned device-telemetry
+    blob (`DataNode.ec_telemetry` / the volume server's
+    `_ec_telemetry_json`): per-chip queue loads sum into the LIVE
+    `ec_load` scoring signal, open breakers into `ec_breakers_open`,
+    and the device-stage EWMAs into `ec_stage_ewma_s`. None/{} keeps
+    the signals unknown — planning degrades to the static scoring."""
     shards: dict[int, set[int]] = {}
     all_shards = 0
     for e in ec_entries:
@@ -93,6 +115,34 @@ def node_view_for(
         if collection and e.collection != collection:
             continue
         shards[e.id] = {i for i in range(32) if e.shard_bits & (1 << i)}
+    ec_load = -1.0
+    breakers = 0
+    stage_ewma = -1.0
+    if ec_telemetry:
+        chips = ec_telemetry.get("chips")
+        if isinstance(chips, dict):
+            try:
+                ec_load = float(
+                    sum(c.get("load", 0) for c in chips.values())
+                )
+            except (TypeError, AttributeError):
+                ec_load = -1.0
+        try:
+            breakers = int(ec_telemetry.get("breakers_open", 0))
+        except (TypeError, ValueError):
+            breakers = 0
+        ewmas = ec_telemetry.get("stage_ewma_s")
+        if isinstance(ewmas, dict):
+            try:
+                stage_ewma = float(
+                    sum(
+                        v
+                        for k2, v in ewmas.items()
+                        if k2.endswith(("h2d_dispatch", "device_drain"))
+                    )
+                )
+            except (TypeError, ValueError):
+                stage_ewma = -1.0
     return NodeView(
         id=node_id,
         rack=rack,
@@ -106,6 +156,9 @@ def node_view_for(
             if capacity_bytes >= 0 and used_bytes >= 0
             else -1
         ),
+        ec_load=ec_load,
+        ec_breakers_open=breakers,
+        ec_stage_ewma_s=stage_ewma,
         shards=shards,
     )
 
@@ -186,10 +239,19 @@ def _pick_dest_node(
     candidates: list[NodeView], vid: int, shard_bytes: int = 0
 ) -> NodeView | None:
     """Score a destination server: fewest shards of THIS volume first
-    (spread the loss domain), then fewest total shards, then most free
-    slots, then most known disk headroom
-    (pickEcNodeToBalanceShardsInto, capacity-aware). A node with known
-    headroom below `shard_bytes` is not a candidate at all."""
+    (spread the loss domain), then fewest total shards, then no open
+    chip breakers before open ones (a node whose chips are failing
+    over to CPU loses any close call), then most free slots, then —
+    the LIVE compute signal, heartbeat-learned — lower
+    `NodeView.ec_load` (summed per-chip DeviceQueue.load()) before
+    higher, then most known disk headroom, then lower device-stage
+    EWMA pressure (pickEcNodeToBalanceShardsInto, capacity- and
+    compute-aware). Live load ranks AFTER the slot capacity signal on
+    purpose: a mixed fleet where some nodes don't report telemetry
+    (older builds score as idle, 0.0) must not funnel every shard onto
+    the non-reporting nodes — load only splits capacity ties, it never
+    overrides them. A node with known headroom below `shard_bytes` is
+    not a candidate at all."""
     best = None
     for n in candidates:
         if n.free_slots <= 0:
@@ -199,8 +261,11 @@ def _pick_dest_node(
         key = (
             len(n.shards.get(vid, ())),
             n.shard_count(),
+            n.ec_breakers_open > 0,
             -n.free_slots,
+            max(n.ec_load, 0.0),
             -max(n.free_bytes, 0),
+            max(n.ec_stage_ewma_s, 0.0),
             n.id,
         )
         if best is None or key < best[0]:

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 import threading
 import time
 from concurrent import futures
@@ -30,9 +31,14 @@ TOPICS_ROOT = "/topics"
 
 
 class _TopicState:
-    def __init__(self, partition_count: int):
+    def __init__(self, partition_count: int, durable_parity: bool = False):
         self.partition_count = partition_count
+        self.durable_parity = durable_parity
         self.logs: dict[int, PartitionLog] = {}
+        # partition -> durable-parity stream (mq/stream_parity.py);
+        # populated only when durable_parity is on and the broker has a
+        # parity_dir
+        self.parity: dict[int, "object"] = {}
 
 
 class MqBroker:
@@ -42,11 +48,29 @@ class MqBroker:
         self,
         filer: str = "",
         segment_records: int = 4096,
+        parity_dir: str = "",
+        durable_parity_default: bool | None = None,
     ):
         """filer: host:port of a filer for durable segments/offsets;
-        empty = memory-only broker (bounded tails, no recovery)."""
+        empty = memory-only broker (bounded tails, no recovery — unless
+        `parity_dir` gives it a durable-parity stream to replay from).
+
+        parity_dir: local directory for streaming-EC log parity
+        (ec/stream_encode.py). Topics configured with durable parity
+        get per-partition EC streams whose parity trails the append
+        head by a bounded lag; on restart the unsealed tail (records
+        the filer segments never saw) is replayed from the stream.
+        `durable_parity_default` is what `configure_topic` uses when
+        the caller doesn't say (default: on iff parity_dir is set)."""
         self.filer = filer
         self.segment_records = segment_records
+        self.parity_dir = parity_dir
+        self.durable_parity_default = (
+            bool(parity_dir)
+            if durable_parity_default is None
+            else durable_parity_default
+        )
+        self._parity_flusher = None
         self._topics: dict[tuple[str, str], _TopicState] = {}
         self._offsets: dict[tuple, int] = {}  # (ns, topic, part, group)
         self._offset_meta: dict[tuple, str] = {}  # committed metadata
@@ -67,6 +91,11 @@ class MqBroker:
                 raise RuntimeError(
                     f"mq broker: filer {filer} unreachable during recovery: {last_err}"
                 )
+        elif parity_dir:
+            # memory-only broker with a parity dir: the EC streams are
+            # the ONLY durability — topics and their unsealed tails are
+            # recovered from parity_dir alone
+            self._recover_parity_only()
 
     # ------------------------------------------------------------ filer io
 
@@ -128,10 +157,16 @@ class MqBroker:
                 if conf is None:
                     continue
                 cfg = json.loads(conf)
-                st = _TopicState(int(cfg["partitionCount"]))
+                st = _TopicState(
+                    int(cfg["partitionCount"]),
+                    durable_parity=bool(cfg.get("durableParity"))
+                    and bool(self.parity_dir),
+                )
                 self._topics[(ns, name)] = st
                 for p in range(st.partition_count):
                     st.logs[p] = self._make_log(ns, name, p, recover=True)
+                    if st.durable_parity:
+                        self._attach_parity(ns, name, st, p, recover=True)
                 off = self._get_file(f"{TOPICS_ROOT}/{ns}/{name}/offsets.json")
                 if off:
                     for k, v in json.loads(off).items():
@@ -211,20 +246,188 @@ class MqBroker:
             earliest_offset=earliest,
         )
 
+    # ----------------------------------------------------- durable parity
+
+    def _attach_parity(
+        self, ns: str, name: str, st: _TopicState, p: int,
+        recover: bool = False,
+    ) -> None:
+        """Give partition `p` its streaming-EC parity: recover+replay
+        the unsealed tail first (records the durable segments never
+        saw), then hook the log's append observer so every new record
+        enters the live stream."""
+        from .stream_parity import PartitionParity
+
+        parity = PartitionParity(self.parity_dir, ns, name, p)
+        plog = st.logs[p]
+        if recover:
+            replayed = 0
+            for off, ts, key, value in parity.recover():
+                if off < plog.next_offset:
+                    continue  # already durable in a sealed segment
+                if off > plog.next_offset:
+                    # hole vs the durable cut. On a virgin log this is
+                    # just the retention window starting past 0 (the
+                    # bounded tail dropped earlier records by design):
+                    # fast-forward and replay from there. Otherwise
+                    # stop — dense numbering must never skip.
+                    if not plog.fast_forward(off):
+                        break
+                plog.append_at(off, ts, key, value)
+                replayed += 1
+            if replayed:
+                mlog.info(
+                    "mq parity: replayed %d unsealed records for "
+                    "%s/%s[%d]", replayed, ns, name, p,
+                )
+        st.parity[p] = parity
+        plog.on_append = parity.append_record
+        self._ensure_parity_flusher()
+
+    def _parity_topic_conf(self, ns: str, name: str) -> str:
+        return os.path.join(self.parity_dir, ns, name, "topic.json")
+
+    def _recover_parity_only(self) -> None:
+        """Memory-only broker + parity_dir: rebuild topics (and their
+        recoverable tails) from the parity directory alone."""
+        import glob as _glob
+
+        for conf in sorted(
+            _glob.glob(os.path.join(self.parity_dir, "*", "*", "topic.json"))
+        ):
+            name = os.path.basename(os.path.dirname(conf))
+            ns = os.path.basename(os.path.dirname(os.path.dirname(conf)))
+            try:
+                with open(conf) as f:
+                    cfg = json.load(f)
+            except (OSError, ValueError) as e:
+                # loud: an unreadable topic.json strands intact stream
+                # generations — never skip one silently
+                mlog.warning(
+                    "mq parity: unreadable %s (%s); topic %s/%s NOT "
+                    "recovered, stream generations left on disk",
+                    conf, e, ns, name,
+                )
+                continue
+            st = _TopicState(
+                int(cfg.get("partitionCount", 1)), durable_parity=True
+            )
+            self._topics[(ns, name)] = st
+            for p in range(st.partition_count):
+                st.logs[p] = self._make_log(ns, name, p)
+                self._attach_parity(ns, name, st, p, recover=True)
+
+    def _ensure_parity_flusher(self) -> None:
+        if self._parity_flusher is None:
+            from .stream_parity import ParityFlusher
+
+            self._parity_flusher = ParityFlusher(self)
+            self._parity_flusher.start()
+
+    def parity_sweep(self) -> None:
+        """One flusher pass: bound every partition's parity lag, then
+        prune stream generations below the durability floor (sealed
+        into filer segments, or — memory-only — fallen out of the
+        bounded tail)."""
+        with self._lock:
+            items = [
+                (st, dict(st.parity)) for st in self._topics.values()
+            ]
+        for st, parts in items:
+            for p, parity in parts.items():
+                if parity.needs_flush():
+                    parity.flush()
+                plog = st.logs.get(p)
+                if plog is None:
+                    continue
+                with plog._lock:
+                    floor = (
+                        plog._tail_base if self.filer
+                        else plog.earliest_offset
+                    )
+                parity.prune(floor)
+
+    def parity_status(self) -> dict:
+        """Per-topic durable-parity roll-up (shell/status surfaces)."""
+        out = {}
+        with self._lock:
+            items = list(self._topics.items())
+        for (ns, name), st in items:
+            if not st.parity:
+                continue
+            out[f"{ns}/{name}"] = {
+                p: {
+                    "pending_bytes": parity.pending_bytes(),
+                    "parity_lag_ms": round(
+                        parity.parity_lag_s() * 1000.0, 3
+                    ),
+                }
+                for p, parity in sorted(st.parity.items())
+            }
+        return out
+
+    def close(self) -> None:
+        """Stop the parity flusher and close every stream (flushes
+        first: a clean shutdown leaves nothing to replay)."""
+        if self._parity_flusher is not None:
+            self._parity_flusher.stop()
+            self._parity_flusher = None
+        self.flush()
+        with self._lock:
+            for st in self._topics.values():
+                for parity in st.parity.values():
+                    parity.close()
+
     # ------------------------------------------------------------- topics
 
-    def configure_topic(self, ns: str, name: str, partitions: int) -> None:
+    def configure_topic(
+        self,
+        ns: str,
+        name: str,
+        partitions: int,
+        durable_parity: bool | None = None,
+    ) -> None:
+        """`durable_parity` (None = the broker default: on when it has
+        a parity_dir) gives every partition a streaming-EC parity
+        stream — parity trails the append head by a bounded lag instead
+        of waiting for segment seal."""
         with self._lock:
             if (ns, name) in self._topics:
                 return
-            st = _TopicState(max(partitions, 1))
+            want_parity = bool(self.parity_dir) and (
+                self.durable_parity_default
+                if durable_parity is None
+                else durable_parity
+            )
+            st = _TopicState(max(partitions, 1), durable_parity=want_parity)
             for p in range(st.partition_count):
                 st.logs[p] = self._make_log(ns, name, p)
+                if want_parity:
+                    self._attach_parity(ns, name, st, p)
             self._topics[(ns, name)] = st
+            if want_parity:
+                # atomic + fsynced: on a memory-only broker this file
+                # is the only way a restart learns the topic exists —
+                # a torn write would orphan every intact stream gen
+                from ..utils.fs import atomic_write
+
+                conf = self._parity_topic_conf(ns, name)
+                os.makedirs(os.path.dirname(conf), exist_ok=True)
+                atomic_write(
+                    conf,
+                    json.dumps(
+                        {"partitionCount": st.partition_count}
+                    ).encode(),
+                )
             if self.filer:
                 self._put_file(
                     f"{TOPICS_ROOT}/{ns}/{name}/topic.conf",
-                    json.dumps({"partitionCount": st.partition_count}).encode(),
+                    json.dumps(
+                        {
+                            "partitionCount": st.partition_count,
+                            "durableParity": want_parity,
+                        }
+                    ).encode(),
                 )
 
     def delete_topic(self, ns: str, name: str) -> None:
@@ -233,7 +436,19 @@ class MqBroker:
         resurrects the topic, and a re-created topic's offsets would
         collide with stale segments."""
         with self._lock:
-            self._topics.pop((ns, name), None)
+            st = self._topics.pop((ns, name), None)
+            if st is not None:
+                for parity in st.parity.values():
+                    parity.delete()
+                if st.parity and self.parity_dir:
+                    # the per-partition deletes leave the topic dir +
+                    # topic.json; a restart must not resurrect the topic
+                    import shutil as _shutil
+
+                    _shutil.rmtree(
+                        os.path.join(self.parity_dir, ns, name),
+                        ignore_errors=True,
+                    )
             self._offsets = {
                 k: v
                 for k, v in self._offsets.items()
@@ -568,6 +783,8 @@ class MqBroker:
             for st in self._topics.values():
                 for log in st.logs.values():
                     log.flush()
+                for parity in st.parity.values():
+                    parity.flush()
 
 
 class MqService:
@@ -977,15 +1194,22 @@ class MqBrokerServer:
         pg_users: dict[str, str] | None = None,
         peers: list[str] | None = None,
         archive_interval: float = 300.0,
+        parity_dir: str = "",
+        durable_parity_default: bool | None = None,
     ):
         """kafka_port >= 0 also serves the Kafka wire protocol on that
         port; pg_port >= 0 serves PostgreSQL clients a SQL view over
         the topics (0 = ephemeral; see .kafka.port / .pg.port).
         peers: every broker's grpc host:port for multi-broker partition
-        balancing + follower replication."""
+        balancing + follower replication. parity_dir: local dir for
+        streaming-EC durable-parity log streams (see MqBroker)."""
         self.ip = ip
         self.grpc_port = grpc_port
-        self.broker = MqBroker(filer=filer, segment_records=segment_records)
+        self.broker = MqBroker(
+            filer=filer, segment_records=segment_records,
+            parity_dir=parity_dir,
+            durable_parity_default=durable_parity_default,
+        )
         self.balancer = balancer_mod.BrokerBalancer(
             f"{ip}:{grpc_port}", list(peers or [])
         )
@@ -1044,5 +1268,5 @@ class MqBrokerServer:
             self.kafka.stop()
         if self.pg is not None:
             self.pg.stop()
-        self.broker.flush()
+        self.broker.close()  # parity flusher + streams, then flush
         self._grpc.stop(grace=0.5)

@@ -58,6 +58,14 @@ class PartitionLog:
         # live (unsealed) tail records: list of (offset, ts, key, value)
         self._tail: list[tuple[int, int, bytes, bytes]] = []
         self._tail_base = next_offset
+        # observer fed every accepted append UNDER the partition lock
+        # (offset order guaranteed) — the durable-parity stream
+        # (mq/stream_parity.py) buffers the record's wire bytes here;
+        # parity math/fsync run on the flusher's schedule, not the
+        # append path. None = no parity for this partition.
+        self.on_append: Optional[
+            Callable[[int, int, bytes, bytes], None]
+        ] = None
 
     # ------------------------------------------------------------ write
 
@@ -66,6 +74,8 @@ class PartitionLog:
             off = self.next_offset
             self._tail.append((off, ts_ns, key, value))
             self.next_offset = off + 1
+            if self.on_append is not None:
+                self.on_append(off, ts_ns, key, value)
             if len(self._tail) >= self.segment_records:
                 self._seal_locked()
             self._lock.notify_all()
@@ -84,6 +94,8 @@ class PartitionLog:
                 return self.next_offset  # refuse: leader must backfill
             self._tail.append((offset, ts_ns, key, value))
             self.next_offset = offset + 1
+            if self.on_append is not None:
+                self.on_append(offset, ts_ns, key, value)
             if len(self._tail) >= self.segment_records:
                 self._seal_locked()
             self._lock.notify_all()
@@ -101,6 +113,9 @@ class PartitionLog:
             for i, (ts_ns, key, value) in enumerate(records):
                 self._tail.append((base + i, ts_ns, key, value))
             self.next_offset = base + len(records)
+            if self.on_append is not None:
+                for i, (ts_ns, key, value) in enumerate(records):
+                    self.on_append(base + i, ts_ns, key, value)
             if len(self._tail) >= self.segment_records:
                 self._seal_locked()
             self._lock.notify_all()
@@ -142,6 +157,22 @@ class PartitionLog:
             self._spill(seg, raw)
         self._tail_base = self.next_offset
         self._tail = []
+
+    def fast_forward(self, offset: int) -> bool:
+        """Advance an EMPTY log to start at `offset` (parity-stream
+        recovery whose retention window begins past 0: the records
+        below it fell out of a bounded tail and are gone by design).
+        Refused on a log that holds or held records — dense numbering
+        must never skip over live state."""
+        with self._lock:
+            if self._tail or self.next_offset != self.earliest_offset:
+                return False
+            if offset <= self.next_offset:
+                return False
+            self.next_offset = offset
+            self.earliest_offset = offset
+            self._tail_base = offset
+            return True
 
     def flush(self) -> None:
         with self._lock:

@@ -1,0 +1,400 @@
+"""Durable-parity MQ log segments: the broker side of streaming EC.
+
+A topic configured with ``durable_parity`` feeds every appended record
+(the same `[len|offset|ts|key|value]` wire bytes the segment files use,
+`mq/log_buffer.py`) into an :class:`~seaweedfs_tpu.ec.stream_encode.
+EcStreamEncoder` per partition, so parity trails the append head by a
+bounded lag (the flusher's bytes/deadline policy) instead of waiting
+for segment seal. On a crash, the unsealed tail — records the filer
+segments never saw — is replayed from the EC stream: the stripe-cursor
+journal fences what was durable, a dense-offset frame scan finds the
+true head, and parity that disagrees with the data is re-derived before
+anything is published (see `ec/stream_encode.recover_stream`).
+
+Stream generations: one encoder writes one `gen-%08d` directory in the
+LARGE-stripe layout (never finalized — recoverability is the point);
+when a generation reaches ``rotate_bytes`` it is flushed, closed, and a
+fresh one started at the current record offset. Generations entirely
+below the prune floor (records already durable in filer segments, or
+fallen out of a memory-only broker's bounded tail) are deleted.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import struct
+import threading
+import time
+
+from ..ec.context import ECContext, ECError
+from ..ec.stream_encode import (
+    EcStreamEncoder,
+    load_stream_journal,
+    recover_stream,
+    stream_block_size,
+    stream_small_block_size,
+)
+from ..utils.glog import logger
+from .log_buffer import _REC, encode_record
+
+log = logger("mq.parity")
+
+GEN_PREFIX = "gen-"
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def flush_bytes_default() -> int:
+    """SEAWEED_EC_STREAM_FLUSH_KB: pending bytes that trigger a parity
+    flush ahead of the lag deadline (default 256 KiB)."""
+    return max(_env_int("SEAWEED_EC_STREAM_FLUSH_KB", 256), 1) << 10
+
+
+def max_lag_s_default() -> float:
+    """SEAWEED_EC_STREAM_MAX_LAG_MS: the bounded parity lag — no
+    appended record waits longer than this for durable parity while the
+    flusher runs (default 200 ms)."""
+    return max(_env_int("SEAWEED_EC_STREAM_MAX_LAG_MS", 200), 1) / 1000.0
+
+
+def rotate_bytes_default() -> int:
+    """SEAWEED_EC_STREAM_ROTATE_MB: stream-generation rotation size
+    (default 64 MiB) — bounds recovery work and prune granularity."""
+    return max(_env_int("SEAWEED_EC_STREAM_ROTATE_MB", 64), 1) << 20
+
+
+def parity_context() -> ECContext:
+    """SEAWEED_EC_STREAM_SHARDS ("k+m", default 4+2): the EC geometry
+    for broker log streams — smaller k than volume EC keeps the stripe
+    (k x block) and therefore the seal cadence small."""
+    spec = os.environ.get("SEAWEED_EC_STREAM_SHARDS", "4+2")
+    try:
+        k_s, m_s = spec.split("+", 1)
+        return ECContext(int(k_s), int(m_s))
+    except (ValueError, ECError):
+        log.warning("bad SEAWEED_EC_STREAM_SHARDS %r; using 4+2", spec)
+        return ECContext(4, 2)
+
+
+def _iter_dense(raw: bytes, base_offset: int):
+    """THE dense-frame parser (one acceptance rule for scan AND
+    decode): yield (end_pos, offset, ts_ns, key, value) for the
+    longest prefix of COMPLETE record frames whose offsets are dense
+    from `base_offset`. A torn tail write fails the frame bound or the
+    density check and everything after it is excluded."""
+    pos = 0
+    want = base_offset
+    n = len(raw)
+    while pos + _REC.size <= n:
+        body_len, offset, ts_ns, key_len = _REC.unpack_from(raw, pos)
+        end = pos + 4 + body_len
+        if end > n or body_len < _REC.size - 4 + key_len:
+            return
+        if offset != want:
+            return
+        p = pos + _REC.size
+        yield end, offset, ts_ns, raw[p : p + key_len], raw[p + key_len : end]
+        want += 1
+        pos = end
+
+
+def dense_frame_scan(base_offset: int):
+    """frame_scan for `recover_stream`: the byte length of the dense
+    record prefix — everything past it is rolled back."""
+
+    def scan(raw: bytes) -> int:
+        pos = 0
+        for end, *_rec in _iter_dense(raw, base_offset):
+            pos = end
+        return pos
+
+    return scan
+
+
+def decode_dense(raw: bytes, base_offset: int):
+    """Yield (offset, ts_ns, key, value) for the dense prefix (the
+    SAME parser `dense_frame_scan` measures with)."""
+    for _end, off, ts_ns, key, value in _iter_dense(raw, base_offset):
+        yield off, ts_ns, key, value
+
+
+class PartitionParity:
+    """One partition's durable-parity stream (rotating generations)."""
+
+    def __init__(
+        self,
+        root: str,
+        ns: str,
+        name: str,
+        partition: int,
+        ctx: ECContext | None = None,
+        backend=None,
+        scheduler=None,
+        block_size: int | None = None,
+        small_block_size: int | None = None,
+        flush_bytes: int | None = None,
+        max_lag_s: float | None = None,
+        rotate_bytes: int | None = None,
+    ):
+        self.dir = os.path.join(root, ns, name, f"{partition:04d}")
+        os.makedirs(self.dir, exist_ok=True)
+        self.ctx = ctx or parity_context()
+        self.backend = backend
+        self.scheduler = scheduler
+        self.block_size = int(block_size or stream_block_size())
+        # tail blocks can never exceed the stripe row block
+        self.small_block_size = min(
+            int(small_block_size or stream_small_block_size()),
+            self.block_size,
+        )
+        self.flush_bytes = int(flush_bytes or flush_bytes_default())
+        self.max_lag_s = float(max_lag_s or max_lag_s_default())
+        self.rotate_bytes = int(rotate_bytes or rotate_bytes_default())
+        self._lock = threading.RLock()
+        self._enc: EcStreamEncoder | None = None
+        self._gen = self._max_gen() + 1
+        self._gen_base = -1  # first record offset of the open gen
+        self.closed = False
+
+    # --------------------------------------------------------- gen layout
+
+    def _gen_base_path(self, gen: int) -> str:
+        return os.path.join(self.dir, f"{GEN_PREFIX}{gen:08d}")
+
+    def _gens(self) -> list[int]:
+        out = []
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return out
+        for n in names:
+            if n.startswith(GEN_PREFIX):
+                stem = n[len(GEN_PREFIX) :].split(".", 1)[0]
+                try:
+                    out.append(int(stem))
+                except ValueError:
+                    continue
+        return sorted(set(out))
+
+    def _max_gen(self) -> int:
+        gens = self._gens()
+        return gens[-1] if gens else -1
+
+    def _backend_resolved(self):
+        if self.backend is None:
+            from ..ec.backend import get_backend
+
+            name = os.environ.get("SEAWEED_EC_STREAM_BACKEND", "auto")
+            self.backend = get_backend(
+                name, self.ctx.data_shards, self.ctx.parity_shards
+            )
+        return self.backend
+
+    # ------------------------------------------------------------ append
+
+    def append_record(
+        self, offset: int, ts_ns: int, key: bytes, value: bytes
+    ) -> None:
+        """Feed one appended record's wire bytes to the open stream.
+        Called under the partition lock: buffering only — the parity
+        math and fsync run on the flusher's schedule, outside both
+        this lock and the encoder's buffer lock. Exception: the FIRST
+        record of a generation pays the stream construction (shard
+        file opens + placement + initial journal) inline — once per
+        rotation, not per record."""
+        with self._lock:
+            if self.closed:
+                return
+            if self._enc is None:
+                self._open_gen(offset)
+            elif offset != self._gen_base + self._gen_records:
+                # non-dense feed (e.g. a replayed follower gap): the
+                # stream's recovery contract is dense offsets, so cut a
+                # fresh generation at the new base
+                self._rotate_locked(offset)
+            self._enc.append(encode_record(offset, ts_ns, key, value))
+            self._gen_records += 1
+
+    def _open_gen(self, base_offset: int) -> None:
+        self._gen_base = base_offset
+        self._gen_records = 0
+        self._enc = EcStreamEncoder(
+            self._gen_base_path(self._gen),
+            self.ctx,
+            backend=self._backend_resolved(),
+            block_size=self.block_size,
+            small_block_size=self.small_block_size,
+            scheduler=self.scheduler,
+            meta=base_offset,
+        )
+
+    def _rotate_locked(self, next_base: int) -> None:
+        if self._enc is not None:
+            self._enc.close(finalize=False)
+            self._enc = None
+        self._gen += 1
+        self._open_gen(next_base)
+
+    # ------------------------------------------------------------- flush
+
+    def pending_bytes(self) -> int:
+        with self._lock:
+            return self._enc.pending_bytes if self._enc else 0
+
+    def parity_lag_s(self) -> float:
+        with self._lock:
+            return self._enc.parity_lag_s() if self._enc else 0.0
+
+    def needs_flush(self) -> bool:
+        with self._lock:
+            if self._enc is None:
+                return False
+            if self._enc.pending_bytes >= self.flush_bytes:
+                return True
+            return (
+                self._enc.pending_bytes > 0
+                and self._enc.parity_lag_s() >= self.max_lag_s
+            )
+
+    def flush(self) -> None:
+        # The slow half (parity math + fsync) runs OUTSIDE this
+        # object's lock: append_record holds the partition lock when it
+        # lands here, so holding _lock through enc.flush() would stall
+        # every publish on the partition behind the fsync. The encoder
+        # itself serializes flush vs flush; appends ride its separate
+        # buffer lock.
+        with self._lock:
+            enc = self._enc
+        if enc is None:
+            return
+        enc.flush()
+        with self._lock:
+            if self._enc is enc and enc.head >= self.rotate_bytes:
+                # rotate at a flush boundary so the closed gen's
+                # journal covers its whole extent; the next gen opens
+                # lazily at the next appended record's offset. Appends
+                # that raced in since the flush above land in the
+                # CLOSING generation — close() flushes them, so
+                # nothing is lost, but a generation may exceed
+                # rotate_bytes by whatever arrived during one flush.
+                self._enc.close(finalize=False)
+                self._enc = None
+                self._gen += 1
+
+    def prune(self, keep_from_offset: int) -> int:
+        """Delete closed generations whose records are ALL below
+        `keep_from_offset` (already durable elsewhere / out of the
+        retention window). A gen's coverage ends where the next gen
+        begins (its journal `meta`)."""
+        removed = 0
+        with self._lock:
+            gens = self._gens()
+            open_gen = self._gen if self._enc is not None else None
+            for g, nxt in zip(gens, gens[1:]):
+                if g == open_gen:
+                    continue
+                nj = load_stream_journal(self._gen_base_path(nxt))
+                if nj is None or nj.meta > keep_from_offset:
+                    break
+                self._remove_gen(g)
+                removed += 1
+        return removed
+
+    def _remove_gen(self, gen: int) -> None:
+        base = self._gen_base_path(gen)
+        for i in range(self.ctx.total):
+            _unlink_quiet(base + self.ctx.to_ext(i))
+        _unlink_quiet(base + ".stream")
+        _unlink_quiet(base + ".ecsum")
+
+    # ---------------------------------------------------------- recovery
+
+    def recover(self) -> list[tuple[int, int, bytes, bytes]]:
+        """Replay every recoverable record from the on-disk stream
+        generations, in offset order, verifying/repairing parity as it
+        goes. Leaves the partition on a FRESH generation (recovered
+        records re-enter the live stream as the broker re-appends
+        them); old generations stay until pruned."""
+        records: list[tuple[int, int, bytes, bytes]] = []
+        backend = self._backend_resolved()
+        with self._lock:
+            for g in self._gens():
+                base = self._gen_base_path(g)
+                j = load_stream_journal(base)
+                if j is None:
+                    continue
+                rec = recover_stream(
+                    base, self.ctx, backend,
+                    frame_scan=dense_frame_scan(j.meta),
+                )
+                if rec is None:
+                    continue
+                for r in decode_dense(rec.data, j.meta):
+                    records.append(r)
+            self._gen = self._max_gen() + 1
+        records.sort(key=lambda r: r[0])
+        # enforce global density across gens: a hole (unrecoverable
+        # gen) ends the replay — the log cannot skip offsets
+        dense: list[tuple[int, int, bytes, bytes]] = []
+        for r in records:
+            if dense and r[0] > dense[-1][0] + 1:
+                break
+            if dense and r[0] <= dense[-1][0]:
+                continue
+            dense.append(r)
+        return dense
+
+    # ------------------------------------------------------------- close
+
+    def close(self) -> None:
+        with self._lock:
+            if self.closed:
+                return
+            self.closed = True
+            if self._enc is not None:
+                self._enc.close(finalize=False)
+                self._enc = None
+
+    def delete(self) -> None:
+        self.close()
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+
+def _unlink_quiet(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+class ParityFlusher(threading.Thread):
+    """One broker-wide daemon bounding every partition's parity lag:
+    wakes at half the lag deadline, flushes partitions over their
+    bytes/age policy, rotates full generations, prunes generations
+    below the broker's durability floor."""
+
+    def __init__(self, broker, interval: float | None = None):
+        super().__init__(daemon=True, name="mq-parity-flusher")
+        self.broker = broker
+        self.interval = (
+            interval
+            if interval is not None
+            else max(max_lag_s_default() / 2.0, 0.01)
+        )
+        self._stop = threading.Event()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.broker.parity_sweep()
+            except Exception as e:  # noqa: BLE001 — never kill the broker
+                log.warning("parity sweep failed: %r", e)

@@ -160,6 +160,13 @@ def main(argv=None) -> int:
         help="comma-separated broker group (grpc host:port,...) for "
         "partition balancing + follower replication",
     )
+    b.add_argument(
+        "-parityDir", default="",
+        help="local dir for streaming-EC durable-parity log streams: "
+        "topics get parity trailing the append head by a bounded lag "
+        "(SEAWEED_EC_STREAM_* knobs) instead of waiting for segment "
+        "seal, and the unsealed tail is crash-recoverable",
+    )
     # broker dials the filer: it needs the https switch from
     # security.toml even though it has no HTTP listener of its own
     _add_tls_flags(b)
@@ -369,6 +376,7 @@ def main(argv=None) -> int:
             pg_port=a.pgPort,
             pg_users=pg_users,
             peers=[p.strip() for p in a.peers.split(",") if p.strip()],
+            parity_dir=a.parityDir,
         )
         bs.start()
         servers.append(bs)

@@ -31,6 +31,18 @@ from .topology import DataNode, Topology
 _COLLECTION_RE = re.compile(r"^[A-Za-z0-9_.\-]*$")
 
 
+def _ec_stream_summary() -> dict:
+    """Streaming-EC roll-up for /cluster/status (open encode-on-write
+    streams + parity-lag/sealed counters). Import is lazy and failures
+    degrade to {} — status must never depend on the EC stack."""
+    try:
+        from ..ec.stream_encode import stream_summary
+
+        return stream_summary()
+    except Exception:  # noqa: BLE001
+        return {}
+
+
 class MasterService:
     """gRPC servicer (method-per-RPC, see pb/rpc.py)."""
 
@@ -722,6 +734,11 @@ class MasterServer:
                             "EcFleetScrub": (
                                 master.worker_control.scrub_summary()
                             ),
+                            # streaming-EC roll-up (sw_ec_stream_*):
+                            # open encode-on-write streams in THIS
+                            # process (combined deployments / tests)
+                            # with live parity lag + lifetime counters
+                            "EcStreams": _ec_stream_summary(),
                         },
                     )
                 else:

@@ -1262,6 +1262,30 @@ class VolumeServer:
                 self._mc = MasterClient(self.master_addr)
             return self._mc
 
+    def _cluster_ec_telemetry(self) -> dict:
+        """Heartbeat-learned per-node device telemetry from the
+        master's /cluster/status (`EcTelemetry`: node_id -> chips/
+        breakers/stage EWMAs) — the LIVE signal shard placement scores
+        beside slots and disk headroom. Best-effort: any failure
+        returns {} and planning degrades to the static scoring."""
+        try:
+            import requests as _requests
+
+            mc = self._master_client()
+            addr = getattr(mc, "_leader", "") or getattr(
+                mc, "http_addr", ""
+            )
+            if not addr:
+                return {}
+            r = _requests.get(
+                f"http://{addr}/cluster/status", timeout=2
+            )
+            r.raise_for_status()
+            tele = r.json().get("EcTelemetry")
+            return tele if isinstance(tele, dict) else {}
+        except Exception:  # noqa: BLE001 — telemetry is advisory
+            return {}
+
     def _peer_stub(self, peer: str):
         with self._mc_lock:
             ch = self._peer_channels.get(peer)
@@ -1543,6 +1567,26 @@ class VolumeServer:
                 f"ec.rebuild -fromPeers to finish the handoff"
             ) from e
         nodes = {n.id: n for n in topo.nodes}
+        # Live compute signal beside the capacity signal: the master's
+        # heartbeat-learned per-node chip loads (EcTelemetry) rank
+        # otherwise-equal destinations by queue headroom, so a
+        # regenerated shard lands where there is compute slack for its
+        # future degraded reads — the routing loop closed cluster-wide.
+        cluster_tele = self._cluster_ec_telemetry()
+        sp = trace.current()
+        if sp is not None:
+            sp.event(
+                "placement_signals",
+                source=("live" if cluster_tele else "static"),
+                node_loads={
+                    nid: t.get("chips", {})
+                    and sum(
+                        c.get("load", 0)
+                        for c in t.get("chips", {}).values()
+                    )
+                    for nid, t in cluster_tele.items()
+                },
+            )
         # Capacity-aware views: used bytes straight from the topology
         # (volume sizes + EC shard bytes); the denominator is the
         # master's own volume size limit, learned via heartbeat. Either
@@ -1555,6 +1599,7 @@ class VolumeServer:
                 n.max_volume_count,
                 len(n.volumes),
                 n.ec_shards,
+                ec_telemetry=cluster_tele.get(n.id),
                 used_bytes=(
                     sum(int(v.size) for v in n.volumes)
                     + sum(
@@ -2060,6 +2105,15 @@ class VolumeServer:
                         # native shard byte plane sidecar health:
                         # sendfile vs python egress byte split
                         st["ec_net_plane"] = server.net_plane.status()
+                    try:
+                        from ..ec.stream_encode import stream_summary
+
+                        # streaming-EC (encode-on-write) health: open
+                        # streams in this process + parity-lag/sealed
+                        # counters (sw_ec_stream_*)
+                        st["ec_streams"] = stream_summary()
+                    except Exception:  # noqa: BLE001
+                        pass
                     body = json.dumps(st).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")

@@ -124,6 +124,8 @@ STAGES = frozenset({
     "index_sort", "peer_fetch",
     # leaf repair (PR 8)
     "repair_patch", "repair_fetch",
+    # streaming EC (PR 14): incremental parity math + delta pwrites
+    "parity_update",
     # gateway read path (PR 9): where a slow S3 GET burned its budget
     "s3.auth", "filer.lookup", "chunk.fetch", "volume.read",
 })

@@ -632,6 +632,10 @@ class WorkerControl:
                     n.max_volume_count,
                     len(n.volumes),
                     list(n.ec_shards.values()),
+                    # heartbeat-learned live chip load: the balance
+                    # detector sees compute pressure the same way the
+                    # executor's placement scoring will
+                    ec_telemetry=n.ec_telemetry,
                 )
                 for n in topo.nodes.values()
             ]

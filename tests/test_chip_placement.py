@@ -477,3 +477,92 @@ def test_per_store_scheduler_scope(tmp_path):
         s1.close()
         s2.close()
         s3.close()
+
+
+# ----------------------------------------------- live load-feedback routing
+
+
+def test_live_queue_load_steers_routing_and_is_recorded():
+    """PR 14 acceptance: routing reads LIVE DeviceQueue.load(), not just
+    the static placed-cost ledger — skew one chip's queue and watch the
+    next stream land elsewhere, with the decision's signal source and
+    live loads recorded as a span event."""
+    from seaweedfs_tpu.utils import trace
+
+    be = JaxBackend(CTX)
+    pool = pool_for(be)
+    scope = QueueScope(placement="chip")
+    # ledger idle: with no live signal the deterministic pick is chip 0
+    p0 = place_stream(be, "foreground", scope=scope, cost_hint=1)
+    assert p0.chip == 0
+    p0.close()
+    # skew chip 0's LIVE queue load (an admission the ledger never saw:
+    # the one-shot gateway-read shape) and the next stream must follow
+    # the live signal to chip 1 even though the ledger reads all-zero
+    q0 = scope.for_backend(pool.chip_backend(0))
+    trace.configure(enabled=True, ring_size=64, slow_op_s=0.0)
+    try:
+        with q0.admission("foreground", 50_000):
+            assert q0.load() == 50_000
+            sp = trace.start("ec.encode", name="live-routing-test")
+            p1 = place_stream(be, "foreground", scope=scope,
+                              cost_hint=1, span=sp)
+            trace.finish(sp)
+            assert p1.chip is not None and p1.chip != 0
+            p1.close()
+            ev = [e for e in sp.to_dict()["events"]
+                  if e["name"] == "placement"]
+            assert ev, "placement decision must be recorded"
+            attrs = ev[-1]["attrs"]
+            assert attrs["signal"] == "live"
+            assert attrs["live_loads"][0] == 50_000
+            assert attrs["chip"] == pool.labels[p1.chip]
+        # queue drained: the live signal is gone, chip 0 wins again
+        p2 = place_stream(be, "foreground", scope=scope, cost_hint=1)
+        assert p2.chip == 0
+        p2.close()
+    finally:
+        trace.configure(enabled=False, slow_op_s=0.0)
+        trace.reset()
+
+
+def test_open_breaker_repels_placement():
+    """A chip whose fallback breaker is OPEN (its streams are running
+    on CPU) loses routing to any healthy sibling, however the ledger
+    and queue loads compare."""
+    base = FallbackBackend(JaxBackend(CTX), CpuBackend(CTX))
+    pool = pool_for(base)
+    scope = QueueScope(placement="chip")
+    chip0 = pool.chip_backend(0)
+    assert isinstance(chip0, FallbackBackend)
+    scope.for_backend(chip0)  # materialize the queue (its label carries
+    # the breaker state into queue_loads)
+    for _ in range(chip0.breaker.failure_threshold):
+        chip0.breaker.record_failure()
+    assert chip0.breaker.state == "open"
+    try:
+        p = place_stream(base, "foreground", scope=scope, cost_hint=1)
+        assert p.chip is not None and p.chip != 0
+        p.close()
+    finally:
+        chip0.breaker.record_success()
+
+
+def test_placement_decision_counter_by_signal():
+    from seaweedfs_tpu.ec.chip_pool import _placement_decisions
+
+    be = JaxBackend(CTX)
+    pool = pool_for(be)
+    scope = QueueScope(placement="chip")
+    before = dict(_placement_decisions.snapshot())
+    p = place_stream(be, "foreground", scope=scope, cost_hint=1)
+    p.close()
+    after = _placement_decisions.snapshot()
+    assert after.get(("ledger",), 0) == before.get(("ledger",), 0) + 1
+    q0 = scope.for_backend(pool.chip_backend(0))
+    with q0.admission("foreground", 999):
+        p = place_stream(be, "foreground", scope=scope, cost_hint=1)
+        p.close()
+    assert _placement_decisions.snapshot().get(("live",), 0) == (
+        before.get(("live",), 0) + 1
+    )

@@ -174,3 +174,76 @@ def test_node_view_for_shared_builder():
     # and a genuinely slot-tight node clamps at zero
     v = node_view_for("n3", "r1", "dc1", 0, 8, entries)
     assert v.free_slots == 0
+
+
+# ----------------------------------------------- live load-feedback scoring
+
+
+def test_plan_shard_placement_follows_live_chip_load():
+    """PR 14: heartbeat-learned DeviceQueue load ranks otherwise-equal
+    destinations — shards land on the host with compute headroom."""
+    from seaweedfs_tpu.ec.placement import plan_shard_placement
+
+    def views(a_load, b_load):
+        return [
+            NodeView(id="a", free_slots=50, ec_load=a_load),
+            NodeView(id="b", free_slots=50, ec_load=b_load),
+        ]
+
+    # static scoring ties (same shard counts/slots): live load decides
+    assert plan_shard_placement(views(90_000, 0.0), 7, [0]) == {0: "b"}
+    assert plan_shard_placement(views(0.0, 90_000), 7, [0]) == {0: "a"}
+    # shard-count spread still outranks load: two shards of ONE volume
+    # spread across both nodes (loss domain beats compute headroom)
+    plan = plan_shard_placement(views(90_000, 0.0), 7, [0, 1])
+    assert set(plan.values()) == {"a", "b"}
+    # unknown telemetry (-1) scores as idle: static tie, lowest id wins
+    # and the planner's mutate-as-you-assign still spreads by count
+    nv = [
+        NodeView(id="a", free_slots=50),
+        NodeView(id="b", free_slots=50),
+    ]
+    plan = plan_shard_placement(nv, 7, [0, 1])
+    assert set(plan.values()) == {"a", "b"}
+
+
+def test_plan_shard_placement_shuns_open_breakers():
+    from seaweedfs_tpu.ec.placement import plan_shard_placement
+
+    nv = [
+        NodeView(id="degraded", free_slots=50, ec_load=0.0,
+                 ec_breakers_open=1),
+        NodeView(id="healthy", free_slots=50, ec_load=70_000.0),
+    ]
+    # the degraded node is idle-by-load but its chips are failing over
+    # to CPU: the loaded-but-healthy node wins
+    plan = plan_shard_placement(nv, 3, [4])
+    assert plan == {4: "healthy"}
+
+
+def test_node_view_for_parses_ec_telemetry():
+    from seaweedfs_tpu.ec.placement import node_view_for
+
+    tele = {
+        "chips": {
+            "cpu:0": {"load": 1000, "breaker": "closed"},
+            "cpu:1": {"load": 234, "breaker": "open"},
+        },
+        "breakers_open": 1,
+        "stage_ewma_s": {
+            "ec.encode/h2d_dispatch": 0.25,
+            "ec.encode/device_drain": 0.5,
+            "ec.encode/disk_read": 99.0,  # host stage: not device load
+        },
+    }
+    v = node_view_for("n1", "r", "dc", 8, 0, [], ec_telemetry=tele)
+    assert v.ec_load == 1234.0
+    assert v.ec_breakers_open == 1
+    assert v.ec_stage_ewma_s == 0.75
+    # absent/malformed telemetry stays unknown
+    v2 = node_view_for("n2", "r", "dc", 8, 0, [], ec_telemetry=None)
+    assert v2.ec_load == -1.0 and v2.ec_breakers_open == 0
+    v3 = node_view_for(
+        "n3", "r", "dc", 8, 0, [], ec_telemetry={"chips": "garbage"}
+    )
+    assert v3.ec_load == -1.0

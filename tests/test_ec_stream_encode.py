@@ -1,0 +1,300 @@
+"""PR 14 streaming EC core: encode-on-write with incremental parity
+(`ec/stream_encode.py`).
+
+Load-bearing properties:
+
+- RS-linearity bit identity: N appends of arbitrary sizes through
+  `EcStreamEncoder` produce byte-identical shard files AND sidecar
+  CRCs to ONE `write_ec_files` over the concatenation — across
+  CPU / single-device JAX / 8-chip mesh / FallbackBackend, with ragged
+  tails, exact stripe multiples, and the empty stream;
+- the stripe-cursor journal is self-checksummed (torn -> ignored) and
+  only ever advances AFTER the fsync it describes;
+- recovery replays the verified prefix, re-derives parity that
+  disagrees with the data (data is ground truth), rolls back past the
+  verified head, and is idempotent;
+- time-to-durable-parity is observable: the lag histogram drains on
+  flush and `parity_lag_s()` tracks the oldest un-flushed append.
+"""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ec import (
+    CpuBackend,
+    ECContext,
+    EcStreamEncoder,
+    FallbackBackend,
+    JaxBackend,
+    load_stream_journal,
+    recover_stream,
+    write_ec_files,
+)
+from seaweedfs_tpu.ec.stream_encode import (
+    StreamJournal,
+    read_stream_data,
+    stream_summary,
+)
+
+CTX = ECContext(10, 4)
+SMALL_CTX = ECContext(4, 2)
+BLOCK = 64 * 1024
+SMALL = 4 * 1024
+
+
+def _payload(n: int, seed: int = 0) -> bytes:
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8
+    ).tobytes()
+
+
+def _stream_encode(base, payload, ctx=CTX, backend=None, seed=1,
+                   flush_p=0.3, block=BLOCK, small=SMALL):
+    rng = random.Random(seed)
+    enc = EcStreamEncoder(
+        base, ctx, backend=backend, block_size=block, small_block_size=small
+    )
+    pos = 0
+    while pos < len(payload):
+        n = rng.randrange(1, 48 * 1024)
+        enc.append(payload[pos : pos + n])
+        pos += n
+        if rng.random() < flush_p:
+            enc.flush()
+    return enc.close()
+
+
+def _batch_encode(base, payload, ctx=CTX, backend=None,
+                  block=BLOCK, small=SMALL):
+    with open(base + ".dat", "wb") as f:
+        f.write(payload)
+    return write_ec_files(
+        base, ctx, backend or CpuBackend(ctx),
+        large_block_size=block, small_block_size=small,
+    )
+
+
+def _assert_identical(b1, b2, ctx, prot1, prot2):
+    for i in range(ctx.total):
+        a = open(b1 + ctx.to_ext(i), "rb").read()
+        b = open(b2 + ctx.to_ext(i), "rb").read()
+        assert a == b, f"shard {i} differs ({len(a)} vs {len(b)} bytes)"
+    assert prot1.shard_sizes == prot2.shard_sizes
+    assert prot1.shard_crcs == prot2.shard_crcs
+    assert prot1.shard_leaf_crcs == prot2.shard_leaf_crcs
+
+
+# ------------------------------------------------------------- identity
+
+
+def test_stream_vs_batch_bit_identity_cpu_ragged(tmp_path):
+    """The RS-linearity identity: incremental parity over arbitrary
+    append boundaries == one-shot batch encode, ragged tail included."""
+    payload = _payload(3 * 10 * BLOCK + 12345)
+    be = CpuBackend(CTX)
+    p1 = _stream_encode(str(tmp_path / "s"), payload, backend=be)
+    p2 = _batch_encode(str(tmp_path / "b"), payload, backend=be)
+    _assert_identical(str(tmp_path / "s"), str(tmp_path / "b"), CTX, p1, p2)
+    # finalize retires the journal: the artifact is a sealed EC layout
+    assert load_stream_journal(str(tmp_path / "s")) is None
+    assert os.path.exists(str(tmp_path / "s") + ".ecsum")
+
+
+@pytest.mark.parametrize(
+    "total",
+    [
+        0,  # empty stream
+        10 * BLOCK,  # exactly one large stripe
+        3 * 4 * SMALL,  # sub-stripe: small blocks only
+        100,  # sub-small-row: one zero-padded small stripe
+        2 * 10 * BLOCK + 10 * SMALL * 4 + 7,  # stripes + small + ragged
+    ],
+)
+def test_stream_vs_batch_identity_shapes(tmp_path, total):
+    payload = _payload(total, seed=total % 97)
+    be = CpuBackend(CTX)
+    p1 = _stream_encode(str(tmp_path / "s"), payload, backend=be)
+    p2 = _batch_encode(str(tmp_path / "b"), payload, backend=be)
+    _assert_identical(str(tmp_path / "s"), str(tmp_path / "b"), CTX, p1, p2)
+
+
+def test_stream_identity_cross_backends(tmp_path):
+    """CPU, single-device JAX, the 8-chip column mesh, and the
+    CPU-fallback wrapper all stream to the SAME bytes as the batch CPU
+    encode — placement/backend choice is scheduling only."""
+    payload = _payload(10 * BLOCK + 3 * 4096 + 11, seed=5)
+    ref = _batch_encode(str(tmp_path / "ref"), payload, backend=CpuBackend(CTX))
+    backends = {
+        "cpu": CpuBackend(CTX),
+        "jax1": JaxBackend(CTX, impl="xla", n_devices=1),
+        "mesh": JaxBackend(CTX),  # 8 virtual devices -> chip pool
+        "fallback": FallbackBackend(
+            JaxBackend(CTX, impl="xla", n_devices=1), CpuBackend(CTX)
+        ),
+    }
+    for name, be in backends.items():
+        base = str(tmp_path / name)
+        prot = _stream_encode(base, payload, backend=be, seed=hash(name) % 999)
+        _assert_identical(base, str(tmp_path / "ref"), CTX, prot, ref)
+
+
+# -------------------------------------------------------------- journal
+
+
+def test_journal_roundtrip_and_torn(tmp_path):
+    base = str(tmp_path / "j")
+    j = StreamJournal(
+        uuid=b"u" * 16, meta=77, durable=1234, sealed=2, head=2222,
+        block_size=BLOCK, small_block_size=SMALL,
+        data_shards=4, parity_shards=2,
+    )
+    from seaweedfs_tpu.utils.fs import atomic_write
+
+    atomic_write(base + ".stream", j.to_bytes())
+    j2 = load_stream_journal(base)
+    assert (j2.meta, j2.durable, j2.sealed, j2.head) == (77, 1234, 2, 2222)
+    assert (j2.data_shards, j2.parity_shards) == (4, 2)
+    # torn journal (any flipped byte) fails its checksum -> None
+    raw = bytearray(j.to_bytes())
+    raw[7] ^= 0xFF
+    with open(base + ".stream", "wb") as f:
+        f.write(bytes(raw))
+    assert load_stream_journal(base) is None
+    # short file -> None
+    with open(base + ".stream", "wb") as f:
+        f.write(b"xx")
+    assert load_stream_journal(base) is None
+    assert load_stream_journal(str(tmp_path / "absent")) is None
+
+
+def test_journal_advances_only_on_flush(tmp_path):
+    base = str(tmp_path / "s")
+    enc = EcStreamEncoder(
+        base, SMALL_CTX, backend=CpuBackend(SMALL_CTX),
+        block_size=8192, small_block_size=1024,
+    )
+    enc.append(b"x" * 5000)
+    j = load_stream_journal(base)
+    assert j.durable == 0  # appended, not durable
+    enc.flush()
+    j = load_stream_journal(base)
+    assert j.durable == 5000 and j.meta == 0
+    enc.close(finalize=False)
+    # non-finalized close keeps the journal (broker rotation path)
+    assert load_stream_journal(base) is not None
+
+
+# ------------------------------------------------------------- recovery
+
+
+def test_recovery_replays_verified_prefix_and_rewrites_parity(tmp_path):
+    base = str(tmp_path / "s")
+    be = CpuBackend(SMALL_CTX)
+    payload = _payload(100_000, seed=9)
+    enc = EcStreamEncoder(
+        base, SMALL_CTX, backend=be, block_size=8192, small_block_size=1024,
+    )
+    enc.append(payload[:60_000])
+    enc.flush()
+    enc.append(payload[60_000:])
+    enc.process()  # data pwritten, parity in memory only — then "crash"
+    for fd in enc._fds:
+        os.close(fd)
+    enc._fds = []
+    enc.closed = True
+
+    rec = recover_stream(base, SMALL_CTX, be)
+    assert rec is not None
+    assert rec.journal.durable == 60_000
+    # data on disk extends past the cursor; recovery trusts the data
+    # (ground truth) and re-derives the parity that never flushed
+    assert rec.head >= 60_000
+    assert rec.data == payload[: rec.head]
+    assert rec.parity_rewritten >= 1
+    # idempotent: a second pass verifies clean and rewrites nothing
+    rec2 = recover_stream(base, SMALL_CTX, be)
+    assert rec2.head == rec.head and rec2.parity_rewritten == 0
+    # linear read-back serves the recovered region
+    assert read_stream_data(base, SMALL_CTX, 8192, 0, rec.head) == rec.data
+
+
+def test_recovery_rolls_back_past_frame_scan(tmp_path):
+    """The embedder's frame scan is the head authority: bytes past it
+    are rolled back (truncated) so they can never resurface."""
+    base = str(tmp_path / "s")
+    be = CpuBackend(SMALL_CTX)
+    payload = _payload(50_000, seed=11)
+    enc = EcStreamEncoder(
+        base, SMALL_CTX, backend=be, block_size=8192, small_block_size=1024,
+    )
+    enc.append(payload)
+    enc.flush()
+    enc.close(finalize=False)
+
+    cut = 30_000
+    rec = recover_stream(
+        base, SMALL_CTX, be, frame_scan=lambda raw: min(len(raw), cut)
+    )
+    assert rec.head == cut
+    assert rec.data == payload[:cut]
+    assert rec.rolled_back == 50_000 - cut
+    # the rollback is durable: a frame-scan-free second recovery sees
+    # only the trimmed extent
+    rec2 = recover_stream(base, SMALL_CTX, be)
+    assert rec2.head == cut and rec2.parity_rewritten == 0
+
+
+def test_recovery_without_journal_recovers_nothing(tmp_path):
+    base = str(tmp_path / "s")
+    be = CpuBackend(SMALL_CTX)
+    enc = EcStreamEncoder(
+        base, SMALL_CTX, backend=be, block_size=8192, small_block_size=1024,
+    )
+    enc.append(b"y" * 10_000)
+    enc.flush()
+    enc.close(finalize=False)
+    os.unlink(base + ".stream")
+    assert recover_stream(base, SMALL_CTX, be) is None
+
+
+# ---------------------------------------------------- lag + observability
+
+
+def test_parity_lag_and_stream_summary(tmp_path):
+    from seaweedfs_tpu.ec.stream_encode import _parity_lag
+
+    base = str(tmp_path / "s")
+    enc = EcStreamEncoder(
+        base, SMALL_CTX, backend=CpuBackend(SMALL_CTX),
+        block_size=8192, small_block_size=1024,
+    )
+    assert enc.parity_lag_s() == 0.0
+    enc.append(b"z" * 1000)
+    assert enc.parity_lag_s() > 0.0  # oldest un-durable append ages
+    before = sum(t for _c, t, _s in _parity_lag.snapshot().values())
+    summ = stream_summary()
+    assert summ["open"] >= 1
+    assert any(s["base"] == "s" for s in summ["streams"])
+    enc.flush()
+    assert enc.parity_lag_s() == 0.0
+    after = sum(t for _c, t, _s in _parity_lag.snapshot().values())
+    assert after == before + 1  # one append -> one lag observation
+    enc.close()
+    assert all(s["base"] != "s" for s in stream_summary()["streams"])
+
+
+def test_append_after_close_refused(tmp_path):
+    from seaweedfs_tpu.ec.context import ECError
+
+    enc = EcStreamEncoder(
+        str(tmp_path / "s"), SMALL_CTX, backend=CpuBackend(SMALL_CTX),
+        block_size=8192, small_block_size=1024,
+    )
+    enc.append(b"a")
+    enc.close()
+    with pytest.raises(ECError):
+        enc.append(b"b")
+    assert enc.close() is None  # idempotent
