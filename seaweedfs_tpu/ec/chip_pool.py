@@ -33,6 +33,15 @@ Pieces
   (mode "auto"), or always ("mesh"), or never ("chip") — the
   `ec_placement` knob, per QueueScope.
 
+The wide/mesh path a stream keeps here is the POD-SHARDED encode since
+the data-gravity PR: `parallel.MeshRS` lowers the XLA impl through one
+explicit `NamedSharding`/pjit computation over the full device mesh
+with the stripe (column) axis constrained (`SEAWEED_EC_POD_PJIT`),
+which on multi-process TPU pods spans every process's devices — the
+per-process shard_map wrapper remains for the Pallas impls. Placement
+span events record which lowering the mesh decision landed on
+(`pod_sharded`).
+
 The pool itself is process-wide (chips are physical; two tenant scopes
 sharing a host should see each other's load), while each scope gets its
 own per-chip DeviceQueues (config isolation, `device_queue.QueueScope`).
@@ -372,6 +381,15 @@ def chip_load_hint(scope: QueueScope | None = None) -> dict[str, dict]:
     return resolve_scope(scope).queue_loads()
 
 
+def _pod_sharded(backend) -> bool:
+    """True when a mesh-kept stream's encode runs the explicit
+    NamedSharding/pjit pod lowering (parallel.MeshRS.pod_sharded)."""
+    primary = getattr(backend, "primary", backend)
+    return bool(
+        getattr(getattr(primary, "_mesh_rs", None), "pod_sharded", False)
+    )
+
+
 def _live_loads_for(pool: ChipPool, scope: QueueScope) -> list[int]:
     """Per-chip-index live load (DeviceQueue.load() + breaker penalty)
     aligned with `pool.labels`. Chips whose queue does not exist yet
@@ -446,6 +464,7 @@ def place_stream(
                 "placement", mode=mode, chip="mesh", signal="mesh",
                 loads=pool.loads(), cost_hint=cost_hint, wide=wide,
                 queue_load_hint=chip_load_hint(scope),
+                pod_sharded=_pod_sharded(backend),
             )
         _placement_decisions.inc(signal="mesh")
         _, _, release = pool.acquire(cost_hint, force_mesh=True)
@@ -476,6 +495,7 @@ def place_stream(
             loads=loads_seen, live_loads=live,
             cost_hint=cost_hint, wide=wide,
             queue_load_hint=chip_load_hint(scope),
+            pod_sharded=(idx is None and _pod_sharded(backend)),
         )
     _placement_decisions.inc(signal=("mesh" if idx is None else signal))
     if idx is None:

@@ -184,6 +184,13 @@ class EcVolume:
         # (sibling reads during recovery dominate under degraded
         # serving — the bench derives read amplification from this).
         self.bytes_read = 0
+        # Bytes of shard content produced by RS reconstruction (the
+        # degraded-read work). Rides the heartbeat telemetry blob as
+        # per-volume HEAT: the rebalance scanner (ec/rebalance.py)
+        # weighs reconstruction double when ranking hot volumes —
+        # moving a reconstructing volume toward chips is exactly what
+        # data gravity exists for.
+        self.bytes_reconstructed = 0
 
     # ------------------------------------------------------------- lookup
 
@@ -536,6 +543,7 @@ class EcVolume:
                 read_stage="stage_batch",
                 write_stage="write_sink",
             )
+            self.bytes_reconstructed += size
             return out.tobytes()
         # Single-shot path (the latency-sensitive needle-read shape):
         # still a CLIENT of the shared per-chip scheduler — serving
@@ -555,6 +563,7 @@ class EcVolume:
         else:
             with trace.stage(sp, "reconstruct"):
                 rec = self.backend.reconstruct(sources, want=[shard_id])
+        self.bytes_reconstructed += size
         return np.asarray(rec[shard_id], dtype=np.uint8).tobytes()
 
     # ------------------------------------------------------------- delete

@@ -660,10 +660,14 @@ class NetPlaneClient:
             except OSError:
                 pass
 
-    def _request(self, addr, vid, sid, gen, off, size) -> socket.socket:
-        """Send one range request, parse the response header, return the
-        connection positioned at the payload (exactly `size` bytes —
-        a server-side clamp or refusal raises)."""
+    def _request(
+        self, addr, vid, sid, gen, off, size, exact: bool = True
+    ) -> tuple[socket.socket, int]:
+        """Send one range request, parse the response header, return
+        (connection positioned at the payload, payload length). With
+        `exact` (the default) a server-side EOF clamp raises — range
+        callers sized their landing buffer; `exact=False` accepts the
+        clamp (whole-shard fetches discover the size this way)."""
         s = self._conn(addr)
         meta = _encode_meta()
         try:
@@ -682,13 +686,19 @@ class NetPlaneClient:
                 self._drop(addr)
                 raise
             raise NetPlaneError(f"{addr}: {msg}")
-        if n != size:
+        if n > size:
+            # the server only ever clamps DOWN (n = min(size, fsize));
+            # a longer claim is a desynced or hostile peer — honoring
+            # it would stream garbage past the caller's sizing
+            self._drop(addr)
+            raise NetPlaneError(f"{addr}: oversized frame {n}/{size}")
+        if exact and n != size:
             # EOF clamp — the gRPC stream's short read. The connection
             # still holds n payload bytes; cheaper to drop it than to
             # drain and resync.
             self._drop(addr)
             raise NetPlaneError(f"{addr}: short stream {n}/{size}")
-        return s
+        return s, n
 
     def read_into(
         self,
@@ -718,7 +728,7 @@ class NetPlaneClient:
     def _read_into_locked(
         self, addr, vid, sid, gen, off, size, dst, *, granule, native
     ):
-        s = self._request(addr, vid, sid, gen, off, size)
+        s, _n = self._request(addr, vid, sid, gen, off, size)
         try:
             if native is not None:
                 crc_state = np.zeros(1, np.uint32)
@@ -778,7 +788,7 @@ class NetPlaneClient:
         copied/received totals). Used by granule re-reads and by the
         bench's same-transport Python-plane comparison."""
         with self._addr_lock(addr):
-            s = self._request(addr, vid, sid, gen, off, size)
+            s, _n = self._request(addr, vid, sid, gen, off, size)
             try:
                 data = _recv_exact(s, size)
             except (OSError, NetPlaneError) as e:
@@ -787,6 +797,80 @@ class NetPlaneClient:
         M.net_bytes_received_total.inc(size, plane="python")
         M.net_bytes_copied_total.inc(size, plane="python")
         return data
+
+    def fetch_shard_to_file(
+        self, addr, vid, sid, gen, fobj, *, chunk: int = 4 << 20
+    ) -> int:
+        """Fetch one WHOLE shard (size discovered from the server's EOF
+        clamp) into an open binary file object — the migration copy
+        path (ec/rebalance.py): the source splices the shard file with
+        sendfile(2) and this side lands it through a pooled aligned
+        buffer in `chunk`-sized pieces. Returns bytes written. The wire
+        bytes are attributed to the native plane
+        (`sw_net_bytes_received_total{plane=native}` — or python when
+        the .so is absent), which is the bench's migration evidence.
+        Raises :class:`NetPlaneUnavailable` (memoized) for peers
+        without the sidecar and :class:`NetPlaneError` for refusals
+        (stale generation, shard not local) — callers fall back to the
+        gRPC CopyFile stream."""
+        from . import native_io
+
+        native = _native_mod() if native_io.enabled() else None
+        plane = "native" if native is not None else "python"
+        pool = native_io.landing_pool()
+        buf = pool.get(chunk)
+        row = buf[0]
+        total = 0
+        try:
+            with self._addr_lock(addr):
+                # one request for the whole file: ask for the 4 GiB
+                # protocol max and let the server clamp to the size
+                s, n = self._request(
+                    addr, vid, sid, gen, 0, _MAX_REQUEST, exact=False
+                )
+                try:
+                    remaining = n
+                    while remaining > 0:
+                        want = min(chunk, remaining)
+                        if native is not None:
+                            got = native.recv_into(
+                                s.fileno(), row, want,
+                                timeout_ms=int(self.timeout * 1000),
+                                granule=0,
+                                crc_state=np.zeros(1, np.uint32),
+                                filled_state=np.zeros(1, np.uint64),
+                                out_crcs=np.zeros(1, np.uint32),
+                                out_counts=np.zeros(1, np.int32),
+                            )
+                            if got != want:
+                                raise NetPlaneError(
+                                    f"{addr}: torn stream "
+                                    f"{total + got}/{n}"
+                                )
+                        else:
+                            view = memoryview(row)[:want]
+                            got = 0
+                            while got < want:
+                                r = s.recv_into(view[got:], want - got)
+                                if r == 0:
+                                    raise NetPlaneError(
+                                        f"{addr}: torn stream "
+                                        f"{total + got}/{n}"
+                                    )
+                                got += r
+                        M.net_bytes_received_total.inc(want, plane=plane)
+                        fobj.write(row[:want])
+                        total += want
+                        remaining -= want
+                except (OSError, NetPlaneError) as e:
+                    self._drop(addr)
+                    if isinstance(e, NetPlaneError):
+                        raise
+                    raise NetPlaneError(f"{addr}: {e}") from e
+        finally:
+            if buf.shape[1] <= _POOL_MAX_WIDTH:
+                pool.put(buf)
+        return total
 
 
     # ------------------------------------------------------- needle reads

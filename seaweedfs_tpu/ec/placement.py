@@ -17,8 +17,26 @@ topology dumps.
 
 from __future__ import annotations
 
+import os
+import time
 from collections import defaultdict
 from dataclasses import dataclass, field
+
+# Load normalizer for the scalar gravity score: one typical encode
+# batch's cost units (4 parity rows x 16 MiB = batch_cost(4, 16 MiB)),
+# so `ec_load / GRAVITY_LOAD_NORM` reads as "batches outstanding".
+GRAVITY_LOAD_NORM = float(4 << 24)
+
+
+def telemetry_stale_after() -> float:
+    """SEAWEED_EC_TELEMETRY_STALE_S: heartbeat telemetry older than
+    this stops steering placement/gravity (default 30 s ~ 15 missed
+    2 s heartbeats). A dead node's last-reported idle chips must not
+    keep attracting bytes."""
+    try:
+        return float(os.environ.get("SEAWEED_EC_TELEMETRY_STALE_S", "30"))
+    except ValueError:
+        return 30.0
 
 
 @dataclass
@@ -49,6 +67,15 @@ class NodeView:
     # recorded for span-event evidence; device-stage pressure breaks
     # final ties.
     ec_stage_ewma_s: float = -1.0
+    # heartbeat-learned chip count (len of the telemetry chips map):
+    # the hardware half of the gravity score. 0 = unknown/none —
+    # non-reporting nodes neither attract nor repel on chips alone.
+    ec_chips: int = 0
+    # seconds since the master last absorbed this node's telemetry
+    # blob; -1 = never reported. Signals past `telemetry_stale_after()`
+    # are aged out in node_view_for, but the age itself survives for
+    # status surfaces.
+    telemetry_age_s: float = -1.0
     # vid -> set of shard ids held
     shards: dict[int, set[int]] = field(default_factory=dict)
 
@@ -57,6 +84,26 @@ class NodeView:
 
     def rack_key(self) -> tuple[str, str]:
         return (self.data_center, self.rack)
+
+    def gravity_score(self) -> float:
+        """Scalar data-gravity attractiveness — chips discounted by
+        live load, open breakers, and device-stage pressure. Higher =
+        more compute headroom where the bytes would land; 0 for a
+        non-reporting (or chip-less, or stale-telemetry) node. Used by
+        the hot-volume rebalance planner (ec/rebalance.py) to rank
+        holder chip-deficit, and by the status surfaces; destination
+        SCORING inside `_pick_dest_node` uses the equivalent tuple so
+        ordering stays exact, not float-rounded."""
+        if self.ec_chips <= 0:
+            return 0.0
+        score = self.ec_chips / (
+            1.0 + max(self.ec_load, 0.0) / GRAVITY_LOAD_NORM
+        )
+        if self.ec_breakers_open > 0:
+            score /= 1.0 + self.ec_breakers_open
+        if self.ec_stage_ewma_s > 0:
+            score /= 1.0 + self.ec_stage_ewma_s
+        return score
 
 
 @dataclass(frozen=True)
@@ -88,6 +135,8 @@ def node_view_for(
     used_bytes: int = -1,
     capacity_bytes: int = -1,
     ec_telemetry: dict | None = None,
+    now: float | None = None,
+    stale_after: float | None = None,
 ) -> NodeView:
     """The ONE topology->NodeView mapping (shard-bit expansion and the
     slots*10 capacity formula) shared by the shell executor and the
@@ -105,9 +154,17 @@ def node_view_for(
     `ec_telemetry` is the node's heartbeat-learned device-telemetry
     blob (`DataNode.ec_telemetry` / the volume server's
     `_ec_telemetry_json`): per-chip queue loads sum into the LIVE
-    `ec_load` scoring signal, open breakers into `ec_breakers_open`,
+    `ec_load` scoring signal, the chip map's size into `ec_chips` (the
+    gravity hardware signal), open breakers into `ec_breakers_open`,
     and the device-stage EWMAs into `ec_stage_ewma_s`. None/{} keeps
-    the signals unknown — planning degrades to the static scoring."""
+    the signals unknown — planning degrades to the static scoring.
+
+    Stale-telemetry aging: a blob whose `received_at` (stamped by the
+    master at absorb time; falls back to the sender's `ts`) is older
+    than `stale_after` seconds (default `telemetry_stale_after()`)
+    contributes NO steering signals — a dead node's last-reported idle
+    chips must not keep attracting bytes — but `telemetry_age_s`
+    still carries the age for status surfaces."""
     shards: dict[int, set[int]] = {}
     all_shards = 0
     for e in ec_entries:
@@ -118,9 +175,28 @@ def node_view_for(
     ec_load = -1.0
     breakers = 0
     stage_ewma = -1.0
+    n_chips = 0
+    age_s = -1.0
+    if ec_telemetry:
+        try:
+            stamped = float(
+                ec_telemetry.get("received_at")
+                or ec_telemetry.get("ts")
+                or 0.0
+            )
+        except (TypeError, ValueError):
+            stamped = 0.0
+        if stamped > 0:
+            age_s = max((now if now is not None else time.time()) - stamped, 0.0)
+        if stale_after is None:
+            stale_after = telemetry_stale_after()
+        if age_s >= 0 and age_s > stale_after:
+            # aged out: keep only the age; every signal reads unknown
+            ec_telemetry = None
     if ec_telemetry:
         chips = ec_telemetry.get("chips")
         if isinstance(chips, dict):
+            n_chips = len(chips)
             try:
                 ec_load = float(
                     sum(c.get("load", 0) for c in chips.values())
@@ -159,22 +235,38 @@ def node_view_for(
         ec_load=ec_load,
         ec_breakers_open=breakers,
         ec_stage_ewma_s=stage_ewma,
+        ec_chips=n_chips,
+        telemetry_age_s=age_s,
         shards=shards,
     )
 
 
 def plan_ec_balance(
-    nodes: list[NodeView], max_moves: int = 10_000
+    nodes: list[NodeView], max_moves: int = 10_000,
+    data_gravity: bool = False, max_gravity_moves: int = 4,
 ) -> tuple[list[Drop], list[Move]]:
     """Full balance pass: dedupe -> across racks -> within racks ->
     per-rack total flattening. Mutates the NodeViews to reflect planned
-    operations so later stages see earlier decisions."""
+    operations so later stages see earlier decisions.
+
+    `data_gravity=True` (the `ec.balance -dataGravity` flag) appends a
+    final stage that drifts shards from chip-poor/loaded nodes toward
+    chip-rich low-load nodes — bounded by `max_gravity_moves`, and
+    strictly BEHIND the spread invariants: a gravity move never makes
+    per-volume spread worse on any node or rack, never exceeds the
+    slot gate, and shuns destinations with no known byte headroom
+    (like every balance stage, per-shard byte sizes are not in the
+    topology snapshot the balancer plans over — the byte-exact fit
+    gate lives in `plan_shard_placement(shard_bytes=)` and the
+    rebalance planner, which do know shard sizes)."""
     by_id = {n.id: n for n in nodes}
     drops = _plan_dedupe(nodes)
     moves: list[Move] = []
     moves += _plan_across_racks(nodes, by_id)
     moves += _plan_within_racks(nodes, by_id)
     moves += _plan_rack_totals(nodes, by_id)
+    if data_gravity:
+        moves += _plan_gravity(nodes, by_id, max_gravity_moves)
     return drops, moves[:max_moves]
 
 
@@ -235,23 +327,36 @@ def _racks(nodes: list[NodeView]) -> dict[tuple[str, str], list[NodeView]]:
     return racks
 
 
+def gravity_key(n: NodeView) -> tuple:
+    """The GRAVITY half of destination scoring: no open chip breakers
+    before open ones (a node whose chips are failing over to CPU loses
+    any close call), then MORE heartbeat-learned chips before fewer
+    (bytes drift toward hardware), then lower live `NodeView.ec_load`
+    (summed per-chip DeviceQueue.load()) before higher. Tuple-exact so
+    ordering never depends on float rounding; `gravity_score()` is the
+    scalar rendering of the same signals for ranking/display."""
+    return (
+        n.ec_breakers_open > 0,
+        -n.ec_chips,
+        max(n.ec_load, 0.0),
+    )
+
+
 def _pick_dest_node(
     candidates: list[NodeView], vid: int, shard_bytes: int = 0
 ) -> NodeView | None:
     """Score a destination server: fewest shards of THIS volume first
-    (spread the loss domain), then fewest total shards, then no open
-    chip breakers before open ones (a node whose chips are failing
-    over to CPU loses any close call), then most free slots, then —
-    the LIVE compute signal, heartbeat-learned — lower
-    `NodeView.ec_load` (summed per-chip DeviceQueue.load()) before
-    higher, then most known disk headroom, then lower device-stage
-    EWMA pressure (pickEcNodeToBalanceShardsInto, capacity- and
-    compute-aware). Live load ranks AFTER the slot capacity signal on
-    purpose: a mixed fleet where some nodes don't report telemetry
-    (older builds score as idle, 0.0) must not funnel every shard onto
-    the non-reporting nodes — load only splits capacity ties, it never
-    overrides them. A node with known headroom below `shard_bytes` is
-    not a candidate at all."""
+    (spread the loss domain), then fewest total shards, then most free
+    slots, then the GRAVITY score (`gravity_key`: breakers, chip
+    count, live load — heartbeat-learned), then most known disk
+    headroom, then lower device-stage EWMA pressure
+    (pickEcNodeToBalanceShardsInto, capacity- and compute-aware).
+    Gravity ranks BEHIND the rack-spread/slot invariants on purpose: a
+    mixed fleet where some nodes don't report telemetry (older builds
+    score as 0 chips / idle) must not have gravity override capacity —
+    compute headroom only splits capacity ties, it never overrides
+    them and never violates spread. A node with known headroom below
+    `shard_bytes` is not a candidate at all (the free-bytes GATE)."""
     best = None
     for n in candidates:
         if n.free_slots <= 0:
@@ -261,9 +366,8 @@ def _pick_dest_node(
         key = (
             len(n.shards.get(vid, ())),
             n.shard_count(),
-            n.ec_breakers_open > 0,
             -n.free_slots,
-            max(n.ec_load, 0.0),
+            *gravity_key(n),
             -max(n.free_bytes, 0),
             max(n.ec_stage_ewma_s, 0.0),
             n.id,
@@ -359,6 +463,72 @@ def _plan_within_racks(
                     m = Move(vid, sid, n.id, dest.id, "within-rack")
                     _apply_move(m, by_id)
                     moves.append(m)
+    return moves
+
+
+def _plan_gravity(
+    nodes: list[NodeView], by_id: dict[str, NodeView], max_moves: int
+) -> list[Move]:
+    """Data-gravity drift (ec.balance -dataGravity): move shards off
+    the WORST-gravity holders (chip-poor, loaded, breaker-open) onto
+    strictly better-gravity nodes — without ever disturbing what the
+    spread stages just established. A move is legal only when
+
+    - the destination's gravity is STRICTLY better (`gravity_key`),
+    - per-volume per-node spread does not get worse
+      (dst_count + 1 <= src_count), and
+    - with >= 2 racks, the destination rack stays within the
+      ceil(total/racks) across-rack ceiling for that volume,
+    - the destination has slot capacity and is not known to be out of
+      byte headroom (free_bytes == 0; headroom also breaks destination
+      ties — per-shard byte sizes are not in the balance snapshot, so
+      the byte-exact fit gate belongs to the callers that have them).
+
+    Bounded by `max_moves` per pass (migration I/O is real); the
+    balance scanner converges over successive passes like every other
+    stage."""
+    moves: list[Move] = []
+    racks = _racks(nodes)
+    multi_rack = len(racks) >= 2
+
+    def rack_count(rk: tuple[str, str], vid: int) -> int:
+        return sum(len(n.shards.get(vid, ())) for n in racks[rk])
+
+    # worst gravity first: their shards want to leave
+    for src in sorted(nodes, key=lambda n: gravity_key(n), reverse=True):
+        for vid in sorted(src.shards):
+            total = sum(len(n.shards.get(vid, ())) for n in nodes)
+            ceiling = -(-total // len(racks)) if multi_rack else total
+            for sid in sorted(src.shards.get(vid, set())):
+                if len(moves) >= max_moves:
+                    return moves
+                candidates = [
+                    d
+                    for d in nodes
+                    if d is not src
+                    and d.free_slots > 0
+                    and d.free_bytes != 0
+                    and gravity_key(d) < gravity_key(src)
+                    and len(d.shards.get(vid, ()))
+                    + 1 <= len(src.shards.get(vid, ()))
+                    and (
+                        not multi_rack
+                        or d.rack_key() == src.rack_key()
+                        or rack_count(d.rack_key(), vid) + 1 <= ceiling
+                    )
+                ]
+                if not candidates:
+                    break  # no better-gravity home for this volume here
+                dest = min(
+                    candidates,
+                    key=lambda d: (
+                        *gravity_key(d), -d.free_slots,
+                        -max(d.free_bytes, 0), d.id,
+                    ),
+                )
+                m = Move(vid, sid, src.id, dest.id, "gravity")
+                _apply_move(m, by_id)
+                moves.append(m)
     return moves
 
 

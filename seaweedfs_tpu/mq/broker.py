@@ -890,8 +890,13 @@ class MqService:
 
     def ConfigureTopic(self, request, context):
         t = request.topic
+        # durable_parity rides the wire as a tri-state int32 (proto3
+        # scalar presence is unknowable): 0 = broker default, 1 = on,
+        # 2 = off — the gRPC twin of the Python API's None/True/False.
+        dp = {1: True, 2: False}.get(int(request.durable_parity))
         self.broker.configure_topic(
-            t.namespace or "default", t.name, request.partition_count
+            t.namespace or "default", t.name, request.partition_count,
+            durable_parity=dp,
         )
         # broadcast: every broker needs the topic state (any of them
         # may lead or follow any partition)

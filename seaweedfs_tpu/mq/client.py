@@ -17,11 +17,25 @@ class MqClient:
         self._channel = grpc.insecure_channel(broker)
         self.stub = rpc.mq_stub(self._channel)
 
-    def configure_topic(self, name: str, partitions: int = 4, namespace: str = "default") -> None:
+    def configure_topic(
+        self,
+        name: str,
+        partitions: int = 4,
+        namespace: str = "default",
+        durable_parity: bool | None = None,
+    ) -> None:
+        """`durable_parity` mirrors the broker's Python API over the
+        wire (tri-state int32 field 3: 0 = broker default, 1 = on,
+        2 = off): a REMOTE client can now opt a topic's partitions in
+        or out of the streaming-EC parity stream."""
         self.stub.ConfigureTopic(
             mq.ConfigureTopicRequest(
                 topic=mq.Topic(namespace=namespace, name=name),
                 partition_count=partitions,
+                durable_parity=(
+                    0 if durable_parity is None
+                    else (1 if durable_parity else 2)
+                ),
             ),
             timeout=30,
         )

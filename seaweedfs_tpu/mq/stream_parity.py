@@ -69,6 +69,35 @@ def rotate_bytes_default() -> int:
     return max(_env_int("SEAWEED_EC_STREAM_ROTATE_MB", 64), 1) << 20
 
 
+def remote_roots() -> dict[str, str]:
+    """SEAWEED_EC_STREAM_REMOTE_ROOTS ("name=/path[,name=/path...]"):
+    remote-host roots (mounted paths — NFS/bind mounts of other hosts'
+    disks) that a durable-parity partition's stream SHARDS may be
+    placed on, spread by the same `plan_shard_placement` scoring the
+    cluster uses, gated on each root's real byte headroom (statvfs).
+    Unset (the default) keeps every shard in the local parity dir.
+    Losing the local host then still leaves the remotely-placed shards
+    of every unsealed tail recoverable — the scoped ISSUE 14 carry."""
+    spec = os.environ.get("SEAWEED_EC_STREAM_REMOTE_ROOTS", "")
+    roots: dict[str, str] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        name, _, path = part.partition("=")
+        if name.strip() and path.strip():
+            roots[name.strip()] = path.strip()
+    return roots
+
+
+def _statvfs_free(path: str) -> int:
+    try:
+        st = os.statvfs(path)
+        return int(st.f_bavail) * int(st.f_frsize)
+    except OSError:
+        return -1
+
+
 def parity_context() -> ECContext:
     """SEAWEED_EC_STREAM_SHARDS ("k+m", default 4+2): the EC geometry
     for broker log streams — smaller k than volume EC keeps the stripe
@@ -142,8 +171,12 @@ class PartitionParity:
         max_lag_s: float | None = None,
         rotate_bytes: int | None = None,
     ):
+        self.ns, self.topic_name, self.partition = ns, name, partition
         self.dir = os.path.join(root, ns, name, f"{partition:04d}")
         os.makedirs(self.dir, exist_ok=True)
+        # env-gated remote shard placement (see remote_roots): snapshot
+        # at construction so one partition's gens place consistently
+        self.remote_roots = remote_roots()
         self.ctx = ctx or parity_context()
         self.backend = backend
         self.scheduler = scheduler
@@ -224,6 +257,7 @@ class PartitionParity:
     def _open_gen(self, base_offset: int) -> None:
         self._gen_base = base_offset
         self._gen_records = 0
+        self._place_gen_shards(self._gen_base_path(self._gen))
         self._enc = EcStreamEncoder(
             self._gen_base_path(self._gen),
             self.ctx,
@@ -233,6 +267,71 @@ class PartitionParity:
             scheduler=self.scheduler,
             meta=base_offset,
         )
+
+    def _place_gen_shards(self, base: str) -> None:
+        """Plan this generation's shard files across the local parity
+        dir and the configured remote roots with the SAME scoring the
+        cluster's shard placement uses (`plan_shard_placement`:
+        spread-by-count, headroom-gated) — a shard planned remote
+        becomes a symlink the encoder's O_CREAT follows, so the
+        encoder/recovery byte paths are untouched. No roots configured
+        (the default) = no-op; a root without headroom for its share of
+        `rotate_bytes` is never chosen. Idempotent: existing links/
+        files are left alone (re-opening a gen after recovery must not
+        re-home bytes)."""
+        if not self.remote_roots:
+            return
+        from ..ec.placement import NodeView, plan_shard_placement
+
+        views = [
+            NodeView(
+                id="", free_slots=1 << 20,
+                free_bytes=_statvfs_free(self.dir),
+            )
+        ]
+        targets: dict[str, str] = {}
+        for name, root in sorted(self.remote_roots.items()):
+            # absolute: the symlink target must resolve the same from
+            # the parity dir (link resolution) and from the process cwd
+            # (makedirs/prune) — a relative root would split the two
+            tdir = os.path.abspath(
+                os.path.join(
+                    root, self.ns, self.topic_name, f"{self.partition:04d}"
+                )
+            )
+            try:
+                os.makedirs(tdir, exist_ok=True)
+            except OSError as e:
+                log.warning("remote parity root %s unusable: %s", root, e)
+                continue
+            targets[name] = tdir
+            views.append(
+                NodeView(
+                    id=name, free_slots=1 << 20,
+                    free_bytes=_statvfs_free(tdir),
+                )
+            )
+        if len(views) < 2:
+            return
+        shard_b = max(self.rotate_bytes // self.ctx.data_shards, 1)
+        plan = plan_shard_placement(
+            views, self._gen, list(range(self.ctx.total)),
+            shard_bytes=shard_b,
+        )
+        for sid, node_id in sorted(plan.items()):
+            if not node_id:
+                continue  # planned local: a plain file
+            path = base + self.ctx.to_ext(sid)
+            if os.path.lexists(path):
+                continue
+            target = os.path.join(targets[node_id], os.path.basename(path))
+            try:
+                os.symlink(target, path)
+            except OSError as e:
+                log.warning(
+                    "remote shard link %s -> %s failed: %s (local file "
+                    "instead)", path, target, e,
+                )
 
     def _rotate_locked(self, next_base: int) -> None:
         if self._enc is not None:
@@ -309,7 +408,15 @@ class PartitionParity:
     def _remove_gen(self, gen: int) -> None:
         base = self._gen_base_path(gen)
         for i in range(self.ctx.total):
-            _unlink_quiet(base + self.ctx.to_ext(i))
+            path = base + self.ctx.to_ext(i)
+            try:
+                # remotely-placed shard: drop the TARGET bytes too, or
+                # pruning would orphan them on the remote root forever
+                if os.path.islink(path):
+                    _unlink_quiet(os.readlink(path))
+            except OSError:
+                pass
+            _unlink_quiet(path)
         _unlink_quiet(base + ".stream")
         _unlink_quiet(base + ".ecsum")
 
@@ -363,6 +470,10 @@ class PartitionParity:
 
     def delete(self) -> None:
         self.close()
+        # remote-placed shard targets die with their gens; rmtree alone
+        # would only remove the symlinks
+        for g in self._gens():
+            self._remove_gen(g)
         shutil.rmtree(self.dir, ignore_errors=True)
 
 

@@ -15,6 +15,7 @@ encode in ec/backend.py) and the driver's `dryrun_multichip`.
 
 from __future__ import annotations
 
+import os
 import threading
 
 import numpy as np
@@ -63,10 +64,36 @@ def pad_cols(data: np.ndarray, multiple: int) -> tuple[np.ndarray, int]:
     return padded, n
 
 
+def pod_pjit_mode() -> str:
+    """SEAWEED_EC_POD_PJIT: "auto" (default — the explicit
+    NamedSharding/pjit pod encode for the XLA impl, shard_map for the
+    Pallas impls whose kernels GSPMD cannot partition), "1" (force
+    pjit where traceable), "0" (always shard_map — the pre-gravity
+    shape)."""
+    return os.environ.get("SEAWEED_EC_POD_PJIT", "auto").strip().lower()
+
+
 class MeshRS:
-    """Reed-Solomon encode/reconstruct jitted over a device mesh with
-    column sharding. Bit-exact vs the single-device path: the column
-    split is exact and the bit-matrix is replicated."""
+    """Reed-Solomon encode/reconstruct over a device mesh with column
+    sharding. Bit-exact vs the single-device path: the column split is
+    exact and the bit-matrix is replicated.
+
+    Two encode lowerings, selected at construction:
+
+    - **pod-sharded pjit** (XLA impl, the default via
+      ``SEAWEED_EC_POD_PJIT=auto``): one ``jax.jit`` over the WHOLE
+      mesh with explicit ``NamedSharding`` in/out shardings and a
+      ``with_sharding_constraint`` pinning the stripe (block/column)
+      axis — GSPMD partitions the bit-matmul itself, which on a
+      multi-process TPU pod runs across every process's devices from
+      one traced computation (SNIPPETS.md [2]: pjit on multi-process
+      platforms), where per-process ``shard_map`` would stop at the
+      process boundary. The matmul is columnwise-independent, so the
+      partitioner inserts no collectives and the output is bit-exact.
+    - **shard_map** (Pallas impls, or ``SEAWEED_EC_POD_PJIT=0``): each
+      device runs the FULL single-chip path (fused Pallas kernel) on
+      its column slice — the wrapper that works for every impl.
+    """
 
     def __init__(self, rs, mesh):
         import jax
@@ -91,18 +118,40 @@ class MeshRS:
         self._repl = replicated(mesh)
         self._cols = column_sharding(mesh)
 
-        # shard_map over the impl's own encode: each device runs the
-        # FULL single-chip path (XLA bit-matmul or the fused Pallas
-        # kernel) on its column slice — the mesh wrapper works for
-        # every impl, not just the plain XLA one.
-        self._encode = jax.jit(
-            shard_map(
-                rs.encode,
-                mesh=mesh,
-                in_specs=P(None, BLOCK_AXIS),
-                out_specs=P(None, BLOCK_AXIS),
-            )
+        mode = pod_pjit_mode()
+        # pjit needs the encode traceable as ordinary jnp ops so GSPMD
+        # can partition it; the XLA bit-matmul is, the Pallas kernels
+        # are opaque calls — those keep the per-device shard_map.
+        self.pod_sharded = mode != "0" and (
+            getattr(rs, "impl", "xla") == "xla" or mode == "1"
         )
+        if self.pod_sharded:
+            cols = self._cols
+
+            def _pod_encode(d):
+                # explicit stripe-axis constraint INSIDE the jit: even
+                # if XLA would re-layout intermediates, the output
+                # parity stays column-sharded exactly like the input —
+                # the next pipeline stage (D2H drain) reads each chip's
+                # slice without a gather.
+                d = jax.lax.with_sharding_constraint(d, cols)
+                return jax.lax.with_sharding_constraint(rs.encode(d), cols)
+
+            self._encode = jax.jit(
+                _pod_encode, in_shardings=cols, out_shardings=cols
+            )
+        else:
+            # shard_map over the impl's own encode: each device runs
+            # the FULL single-chip path (XLA bit-matmul or the fused
+            # Pallas kernel) on its column slice.
+            self._encode = jax.jit(
+                shard_map(
+                    rs.encode,
+                    mesh=mesh,
+                    in_specs=P(None, BLOCK_AXIS),
+                    out_specs=P(None, BLOCK_AXIS),
+                )
+            )
 
     def put(self, data: np.ndarray):
         """H2D with column sharding (async). Caller pads columns to a

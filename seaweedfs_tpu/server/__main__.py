@@ -79,6 +79,14 @@ def main(argv=None) -> int:
         "once per period via ec_scrub worker tasks (0=off)",
     )
     m.add_argument(
+        "-ec.rebalanceInterval", dest="ec_rebalance_interval", type=float,
+        default=0.0,
+        help="data-gravity period in seconds: rank hot EC volumes vs "
+        "holder chip-deficit and dispatch bounded ec_migrate worker "
+        "tasks toward chip-rich low-load nodes (0=off; "
+        "SEAWEED_EC_REBALANCE_* knobs bound each sweep)",
+    )
+    m.add_argument(
         "-peers", default="",
         help="comma-separated HA master group incl. this node (host:port,...)",
     )
@@ -407,6 +415,7 @@ def main(argv=None) -> int:
             garbage_threshold=getattr(a, "garbage_threshold", 0.3),
             vacuum_interval=getattr(a, "vacuum_interval", 60.0),
             ec_scrub_interval=getattr(a, "ec_scrub_interval", 0.0),
+            ec_rebalance_interval=getattr(a, "ec_rebalance_interval", 0.0),
         )
         ms.start()
         servers.append(ms)

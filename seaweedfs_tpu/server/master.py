@@ -489,6 +489,7 @@ class MasterServer:
         ec_auto_fullness: float = 0.0,
         ec_quiet_seconds: float = 60.0,
         ec_scrub_interval: float = 0.0,
+        ec_rebalance_interval: float = 0.0,
         peers: list[str] | str | None = None,
         meta_dir: str | None = None,
         election_timeout: tuple[float, float] = (0.4, 0.8),
@@ -543,6 +544,12 @@ class MasterServer:
         # tick; unrebuildable holders get peer-fetch rebuilds dispatched
         # from the aggregated reports (worker/control.py).
         self.ec_scrub_interval = ec_scrub_interval
+        # Data-gravity period (seconds, 0 = off): every tick past the
+        # period, the rebalance scanner ranks per-volume heat deltas
+        # against holder chip-deficit and dispatches bounded ec_migrate
+        # tasks (ec/rebalance.py; knobs SEAWEED_EC_REBALANCE_*).
+        self.ec_rebalance_interval = ec_rebalance_interval
+        self._ec_rebalance_last = 0.0
         self.balance_spread = 0.0  # 0 = auto-balance scanner off
         self.lifecycle_interval = 0.0  # 0 = lifecycle sweeps off
         self.lifecycle_filer = ""
@@ -708,12 +715,28 @@ class MasterServer:
                     # heartbeat-learned device telemetry per host: the
                     # master never probes volume servers for this —
                     # chips/breakers/stage-EWMAs arrive ONLY on the
-                    # heartbeat stream (Heartbeat.ec_telemetry_json)
-                    tele = {
-                        node.node_id: node.ec_telemetry
-                        for node in list(master.topo.nodes.values())
-                        if node.ec_telemetry
-                    }
+                    # heartbeat stream (Heartbeat.ec_telemetry_json).
+                    # Each entry carries its AGE (seconds since the
+                    # master absorbed it) and whether the stale-aging
+                    # gate (SEAWEED_EC_TELEMETRY_STALE_S) has stopped
+                    # it from steering placement/gravity.
+                    from ..ec.placement import telemetry_stale_after
+
+                    stale_after = telemetry_stale_after()
+                    now = time.time()
+                    tele = {}
+                    for node in list(master.topo.nodes.values()):
+                        if not node.ec_telemetry:
+                            continue
+                        blob = dict(node.ec_telemetry)
+                        stamped = blob.get("received_at") or blob.get("ts")
+                        try:
+                            age = max(now - float(stamped), 0.0)
+                        except (TypeError, ValueError):
+                            age = -1.0
+                        blob["age_s"] = round(age, 1)
+                        blob["stale"] = bool(age > stale_after >= 0)
+                        tele[node.node_id] = blob
                     self._json(
                         200,
                         {
@@ -733,6 +756,12 @@ class MasterServer:
                             # reports (worker/control.py)
                             "EcFleetScrub": (
                                 master.worker_control.scrub_summary()
+                            ),
+                            # data-gravity evidence: the most recent
+                            # ec_migrate dispatches (volume, src->dst,
+                            # heat, gravity scores) from the scanner
+                            "EcMigrations": (
+                                master.worker_control.last_migrations
                             ),
                             # streaming-EC roll-up (sw_ec_stream_*):
                             # open encode-on-write streams in THIS
@@ -955,6 +984,14 @@ class MasterServer:
                     self.worker_control.scan_for_ec_scrub(
                         self.topo, self.ec_scrub_interval
                     )
+                if self.ec_rebalance_interval > 0:
+                    now = time.time()
+                    if (
+                        now - self._ec_rebalance_last
+                        >= self.ec_rebalance_interval
+                    ):
+                        self._ec_rebalance_last = now
+                        self.worker_control.scan_for_ec_rebalance(self.topo)
             except Exception as e:
                 log.error(
                     "maintenance tick failed (%s: %s); loop continues",

@@ -26,6 +26,7 @@ from ..ec.context import ECError
 from ..ec.decoder import ec_decode_volume
 from ..ec.encoder import ec_encode_volume
 from ..ec.rebuild import rebuild_ec_files
+from ..ec.volume_info import VolumeInfo
 from ..storage.file_id import FileId, FileIdError
 from ..storage.needle import CrcError, Needle
 from ..storage.store import Store
@@ -455,24 +456,38 @@ class VolumeService:
         return pb.EcShardsRebuildResponse(rebuilt_shard_ids=rebuilt)
 
     def VolumeEcShardsCopy(self, request, context):
-        """Pull shards (and index files) from a peer via CopyFile."""
+        """Pull shards (and index files) from a peer.
+
+        Metadata files (.ecx/.ecj/.vif/.ecsum) land FIRST over the
+        gRPC CopyFile stream, so the generation fence and the bitrot
+        sidecar exist locally before any shard byte moves. Shard files
+        then prefer the source's native shard plane
+        (ec/net_plane.ShardNetPlane: sendfile egress, generation-fenced
+        by the .vif's encode_ts_ns, bytes attributed
+        plane=native) with CopyFile as the bit-identical fallback —
+        this is the byte path `ec.balance` moves and `ec_migrate`
+        hot-volume migrations ride. Every landed shard is verified
+        against the local .ecsum sidecar when one covers this
+        generation: a mismatch unlinks the file and aborts the copy
+        (DATA_LOSS) — a migration can never mount rot."""
         _rid.ensure(trace.metadata_dict(context).get(trace.REQUEST_ID_KEY))
         loc = self.store._pick_location()
         base = Volume.base_file_name(
             loc.directory, request.collection, request.volume_id
         )
-        exts = [f".ec{sid:02d}" for sid in request.shard_ids]
+        meta_exts = []
         if request.copy_ecx:
-            exts.append(".ecx")
+            meta_exts.append(".ecx")
         if request.copy_ecj:
-            exts.append(".ecj")
+            meta_exts.append(".ecj")
         if request.copy_vif:
-            exts.append(".vif")
+            meta_exts.append(".vif")
         if request.copy_ecsum:
-            exts.append(".ecsum")
+            meta_exts.append(".ecsum")
         with grpc.insecure_channel(request.source_url) as ch:
             stub = rpc.volume_stub(ch)
-            for ext in exts:
+
+            def copy_file(ext: str) -> None:
                 tmp = base + ext + ".copying"
                 try:
                     with open(tmp, "wb") as f:
@@ -492,11 +507,84 @@ class VolumeService:
                     if os.path.exists(tmp):
                         os.unlink(tmp)
                     if ext == ".ecj":  # journal may legitimately not exist
-                        continue
+                        return
                     context.abort(
-                        grpc.StatusCode.UNAVAILABLE, f"copy {ext}: {e.details()}"
+                        grpc.StatusCode.UNAVAILABLE,
+                        f"copy {ext}: {e.details()}",
                     )
+
+            for ext in meta_exts:
+                copy_file(ext)
+            # Generation fence + sidecar, from whatever .vif/.ecsum is
+            # now local (just copied, or already here from an earlier
+            # shard of this volume).
+            generation = 0
+            vi = VolumeInfo.maybe_load(base + ".vif")
+            if vi is not None:
+                generation = vi.encode_ts_ns
+            prot = None
+            try:
+                from ..ec.bitrot import BitrotProtection
+
+                prot = BitrotProtection.load(base + ".ecsum")
+                if generation and prot.generation not in (0, generation):
+                    prot = None  # stale sidecar: no ground truth
+            except Exception:  # absent/unreadable: verification off
+                prot = None
+            for sid in request.shard_ids:
+                ext = f".ec{sid:02d}"
+                if not self._copy_shard_native(
+                    request, base, ext, sid, generation
+                ):
+                    copy_file(ext)
+                if prot is not None and 0 <= sid < len(prot.shard_crcs):
+                    bad = prot.verify_shard_file(
+                        base + ext, sid, stop_early=True
+                    )
+                    if bad:
+                        os.unlink(base + ext)
+                        context.abort(
+                            grpc.StatusCode.DATA_LOSS,
+                            f"shard {sid} from {request.source_url} "
+                            f"fails .ecsum verification; copy refused",
+                        )
         return pb.EcShardsCopyResponse()
+
+    def _copy_shard_native(
+        self, request, base: str, ext: str, sid: int, generation: int
+    ) -> bool:
+        """Try to land one shard file over the source's shard net
+        plane (sendfile -> pooled buffer -> local file, atomic
+        replace). False = caller takes the gRPC CopyFile path (plane
+        disabled, armed faults, peer without a sidecar, refusal)."""
+        from .. import faults
+        from ..ec import native_io
+        from ..ec import net_plane as _netp
+
+        if not native_io.enabled() or faults.active():
+            return False
+        tmp = base + ext + ".copying"
+        try:
+            client = self.server._net_plane_client()
+            with open(tmp, "wb") as f:
+                n = client.fetch_shard_to_file(
+                    _netp.net_addr(request.source_url),
+                    request.volume_id, sid, generation, f,
+                )
+                f.flush()
+                os.fsync(f.fileno())
+            if n <= 0:
+                os.unlink(tmp)
+                return False
+            os.replace(tmp, base + ext)
+            return True
+        except (_netp.NetPlaneError, _netp.NetPlaneUnavailable, OSError):
+            try:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+            except OSError:
+                pass
+            return False
 
     def VolumeEcShardsDelete(self, request, context):
         for loc in self.store.locations:
@@ -1086,6 +1174,7 @@ class VolumeServer:
         self.rack = rack
         self._mc = None
         self._mc_lock = threading.Lock()
+        self._np_client = None
         self._peer_channels: dict[str, grpc.Channel] = {}
         # vid -> Lock: serializes peer-fetch rebuild per volume (the
         # staging dir is per-volume; concurrent runs would wipe each
@@ -1261,6 +1350,17 @@ class VolumeServer:
 
                 self._mc = MasterClient(self.master_addr)
             return self._mc
+
+    def _net_plane_client(self):
+        """Lazy shared NetPlaneClient for pull-side shard copies
+        (VolumeEcShardsCopy / ec_migrate): pooled connections to peer
+        sidecars, no-plane refusals memoized with TTL."""
+        with self._mc_lock:
+            if self._np_client is None:
+                from ..ec.net_plane import NetPlaneClient
+
+                self._np_client = NetPlaneClient()
+            return self._np_client
 
     def _cluster_ec_telemetry(self) -> dict:
         """Heartbeat-learned per-node device telemetry from the
@@ -1844,10 +1944,14 @@ class VolumeServer:
     def _ec_telemetry_json(self) -> str:
         """Device-telemetry blob riding every full heartbeat: per-chip
         queue load + breaker state (ec/chip_pool.chip_load_hint over
-        this server's OWN scheduler scope) and the flight recorder's
-        per-op/stage EWMAs. The master is the only consumer — it
-        aggregates into /cluster/status and the sw_ec_queue_load fleet
-        gauges; nothing here feeds live routing (direction 3)."""
+        this server's OWN scheduler scope), the flight recorder's
+        per-op/stage EWMAs, and per-EC-volume HEAT counters (lifetime
+        read/reconstruction bytes — the master's rebalance scanner
+        diffs them per sweep to rank hot volumes, ec/rebalance.py).
+        The master is the only consumer — it aggregates into
+        /cluster/status, the sw_ec_queue_load fleet gauges, and the
+        gravity/heat planners; placement readers age the blob out via
+        `received_at`/`ts` (SEAWEED_EC_TELEMETRY_STALE_S)."""
         from ..ec.chip_pool import chip_load_hint
 
         try:
@@ -1857,6 +1961,16 @@ class VolumeServer:
         breakers_open = sum(
             1 for c in chips.values() if c.get("breaker") == "open"
         )
+        ec_volumes: dict[str, dict] = {}
+        try:
+            for dloc in self.store.locations:
+                for vid, ev in dloc.ec_volumes.items():
+                    ec_volumes[str(vid)] = {
+                        "read_bytes": int(ev.bytes_read),
+                        "reconstructed_bytes": int(ev.bytes_reconstructed),
+                    }
+        except Exception:  # heat is advisory; never break the heartbeat
+            ec_volumes = {}
         return json.dumps(
             {
                 "chips": chips,
@@ -1865,6 +1979,7 @@ class VolumeServer:
                 "stage_ewma_s": {
                     k: round(v, 6) for k, v in trace.stage_ewmas().items()
                 },
+                "ec_volumes": ec_volumes,
                 "ts": time.time(),
             }
         )
@@ -2342,6 +2457,8 @@ class VolumeServer:
         with self._mc_lock:
             if self._mc is not None:
                 self._mc.close()
+            if self._np_client is not None:
+                self._np_client.close()
             for ch in self._peer_channels.values():
                 ch.close()
             self._peer_channels.clear()

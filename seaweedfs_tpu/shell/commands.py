@@ -224,18 +224,45 @@ def cluster_status(env: ShellEnv, args) -> str:
         st = _rq.get(
             f"http://{env.master_addr}/cluster/status", timeout=5
         ).json()
+        from ..ec.placement import node_view_for
+        from ..ec.rebalance import volume_heat
+
         for node_id, tele in sorted(st.get("EcTelemetry", {}).items()):
             chips = tele.get("chips", {}) or {}
             flag = " DEGRADED" if tele.get("degraded") else ""
+            if tele.get("stale"):
+                flag += " STALE"
+            # gravity column: the same score placement/rebalance rank
+            # with (ec/placement.NodeView.gravity_score), so the
+            # operator sees where bytes want to drift
+            gv = node_view_for(
+                node_id, "", "", 8, 0, [], ec_telemetry=tele
+            )
+            heat = volume_heat(tele)
             lines.append(
                 f"  chips {node_id}: {len(chips)} chip(s), "
-                f"breakers_open={tele.get('breakers_open', 0)}{flag}"
+                f"breakers_open={tele.get('breakers_open', 0)} "
+                f"gravity={gv.gravity_score():.2f} "
+                f"age={tele.get('age_s', '-')}s "
+                f"heat={sum(heat.values())}B{flag}"
             )
             for chip, c in sorted(chips.items()):
                 lines.append(
                     f"    {chip} load={c.get('load', 0)} "
                     f"breaker={c.get('breaker') or '-'}"
                 )
+            for vid, hb in sorted(
+                heat.items(), key=lambda kv: -kv[1]
+            )[:5]:
+                lines.append(f"    ec {vid} heat={hb}B")
+        for mig in st.get("EcMigrations", [])[:5]:
+            lines.append(
+                f"  migration: ec {mig.get('volume_id')} "
+                f"{mig.get('src')} -> {mig.get('dst')} "
+                f"shards={mig.get('shards')} heat={mig.get('heat')}B "
+                f"gravity {mig.get('src_gravity')} -> "
+                f"{mig.get('dst_gravity')}"
+            )
         slo = _rq.get(
             f"http://{env.master_addr}/debug/slo", timeout=5
         ).json()
@@ -848,22 +875,44 @@ def volume_fix_replication(env: ShellEnv, args) -> str:
     return "\n".join(fixed) or "all volumes sufficiently replicated"
 
 
-@command("ec.balance", "spread EC shards evenly across racks and nodes", mutating=True)
+@command(
+    "ec.balance",
+    "spread EC shards evenly across racks and nodes "
+    "[-dataGravity drifts shards toward chip-rich low-load hosts]",
+    mutating=True,
+)
 def ec_balance(env: ShellEnv, args) -> str:
     """Rack-aware balance (reference command_ec_common.go:60 EcBalance):
     dedupe shard copies, spread each volume across racks, even within
     racks, then flatten per-rack totals — planned by ec/placement.py,
-    executed here as copy+mount / unmount+delete pairs."""
+    executed here as copy+mount / unmount+delete pairs. `-dataGravity`
+    appends the gravity stage: bounded moves from chip-poor/loaded
+    nodes toward chip-rich low-load ones (heartbeat telemetry), never
+    violating the spread/slot invariants."""
     from ..ec.placement import node_view_for, plan_ec_balance
 
     p = argparse.ArgumentParser(prog="ec.balance")
     p.add_argument("-collection", default="")
     p.add_argument("-dryRun", action="store_true")
+    p.add_argument("-dataGravity", action="store_true")
+    p.add_argument("-maxGravityMoves", type=int, default=4)
     a = p.parse_args(args)
     topo = env.master.topology()
     nodes = {n.id: n for n in topo.nodes}
     if len(nodes) < 2:
         return "nothing to balance (fewer than 2 nodes)"
+    # gravity needs the heartbeat telemetry, which rides the master's
+    # HTTP status plane (best-effort: absent telemetry = static plan)
+    tele: dict = {}
+    if a.dataGravity:
+        try:
+            import requests as _rq
+
+            tele = _rq.get(
+                f"http://{env.master_addr}/cluster/status", timeout=5
+            ).json().get("EcTelemetry", {}) or {}
+        except Exception:  # noqa: BLE001 — gravity degrades to static
+            tele = {}
     vol_collection: dict[int, str] = {}
     views = []
     for n in topo.nodes:
@@ -879,9 +928,13 @@ def ec_balance(env: ShellEnv, args) -> str:
                 len(n.volumes),
                 n.ec_shards,
                 a.collection,
+                ec_telemetry=tele.get(n.id),
             )
         )
-    drops, moves = plan_ec_balance(views)
+    drops, moves = plan_ec_balance(
+        views, data_gravity=a.dataGravity,
+        max_gravity_moves=a.maxGravityMoves,
+    )
     if a.dryRun:
         return "\n".join(
             [f"drop ec {d.vid}.{d.shard_id:02d} on {d.node}" for d in drops]

@@ -41,6 +41,10 @@ _fleet_dispatch = _M.REGISTRY.counter(
     "sw_ec_fleet_peer_rebuild_dispatch_total",
     "peer-fetch rebuild tasks dispatched for unrebuildable holders",
 )
+_migrate_dispatch = _M.REGISTRY.counter(
+    "sw_ec_fleet_migration_dispatch_total",
+    "hot-volume ec_migrate tasks dispatched by the gravity scanner",
+)
 
 
 @dataclass
@@ -75,7 +79,7 @@ class _Task:
 
 KNOWN_KINDS = (
     "ec_encode", "vacuum", "balance", "s3_lifecycle", "ec_balance", "iceberg",
-    "ec_scrub", "ec_rebuild",
+    "ec_scrub", "ec_rebuild", "ec_migrate",
 )
 # cluster-wide kinds always submit with volume_id=0: the shell skips the
 # -volumeId requirement for them and the worker scopes their cluster
@@ -108,6 +112,11 @@ class WorkerControl:
         self._size_watch: dict[int, tuple[int, float]] = {}
         # vid -> last fleet-scrub submit ts (the stagger state)
         self._scrub_watch: dict[int, float] = {}
+        # (node_id, vid) -> last-seen lifetime heat counter, so the
+        # gravity scanner ranks per-sweep heat DELTAS, not totals
+        self._heat_prev: dict[tuple[str, int], int] = {}
+        # last sweep's planned migrations (status surfaces)
+        self.last_migrations: list[dict] = []
         # vid -> latest aggregated ec_scrub report (fleet health view)
         self.scrub_reports: dict[int, dict] = {}
         self._stop = threading.Event()
@@ -693,6 +702,131 @@ class WorkerControl:
             return []  # a live operator task for this volume
         self._scrub_watch[vid] = now
         return [tid]
+
+    def scan_for_ec_rebalance(
+        self,
+        topo,
+        min_heat: int | None = None,
+        max_moves: int | None = None,
+        min_gain: float | None = None,
+    ) -> list[str]:
+        """Data-gravity sweep (ec/rebalance.py): rank every EC volume's
+        per-holder heat (read/reconstruction byte DELTAS since the last
+        sweep, heartbeat-learned) against the holder's chip-deficit and
+        dispatch bounded `ec_migrate` worker tasks moving whole shard
+        sets toward chip-rich low-load nodes. One planner drives the
+        scanner AND the shell's dry-run so they cannot drift; the same
+        keep-the-plane-convergent discipline as the other scanners
+        (default one migration per sweep)."""
+        from ..ec.placement import node_view_for
+        from ..ec.rebalance import plan_hot_migrations, volume_heat
+
+        with topo._lock:
+            nodes = [
+                (
+                    f"{n.ip}:{n.grpc_port}",
+                    n.rack,
+                    n.data_center,
+                    n.max_volume_count,
+                    len(n.volumes),
+                    list(n.ec_shards.values()),
+                    dict(n.ec_telemetry),
+                )
+                for n in topo.nodes.values()
+            ]
+        if len(nodes) < 2:
+            return []
+        views = []
+        heat: dict[str, dict[int, int]] = {}
+        shard_bytes: dict[int, int] = {}
+        collections: dict[int, str] = {}
+        with self._lock:
+            for nid, rack, dc, maxvol, nvol, ecs, tele in nodes:
+                views.append(
+                    node_view_for(
+                        nid, rack, dc, maxvol, nvol, ecs,
+                        ec_telemetry=tele,
+                    )
+                )
+                for e in ecs:
+                    if e.shard_size:
+                        shard_bytes[e.id] = int(e.shard_size)
+                    collections.setdefault(e.id, e.collection)
+                deltas: dict[int, int] = {}
+                for vid, total in volume_heat(tele).items():
+                    prev = self._heat_prev.get((nid, vid))
+                    self._heat_prev[(nid, vid)] = total
+                    if prev is None:
+                        continue  # first sighting: no window yet
+                    # counter reset (restart) reads as the full value
+                    deltas[vid] = total - prev if total >= prev else total
+                if deltas:
+                    heat[nid] = deltas
+            # evict state for (node, vid) pairs that left the topology
+            live = {
+                (nid, e.id)
+                for nid, _r, _d, _m, _v, ecs, _t in nodes
+                for e in ecs
+            }
+            for key in [k for k in self._heat_prev if k not in live]:
+                del self._heat_prev[key]
+        plans = plan_hot_migrations(
+            views, heat, shard_bytes=shard_bytes,
+            min_heat=min_heat, max_migrations=max_moves, min_gain=min_gain,
+        )
+        submitted = []
+        records = []
+        with self._lock:
+            live_before = set(self._tasks)
+        for mig in plans:
+            rec = {
+                "volume_id": mig.vid,
+                "src": mig.src,
+                "dst": mig.dst,
+                "shards": list(mig.shard_ids),
+                "heat": mig.heat,
+                "src_gravity": round(mig.src_gravity, 3),
+                "dst_gravity": round(mig.dst_gravity, 3),
+                "ts": time.time(),
+            }
+            try:
+                tid = self.submit(
+                    "ec_migrate",
+                    mig.vid,
+                    collections.get(mig.vid, ""),
+                    params={
+                        "source": mig.src,
+                        "target": mig.dst,
+                        "shards": ",".join(str(s) for s in mig.shard_ids),
+                    },
+                )
+            except ValueError as e:
+                # a live operator task for this volume / param conflict:
+                # the gravity loop must never die over a dispatch race
+                _log.warning(
+                    "ec_migrate dispatch for %d skipped: %s", mig.vid, e
+                )
+                continue
+            if tid in live_before:
+                # submit() deduped onto a migration already in flight
+                # (one that outlives a sweep period): not a fresh
+                # dispatch — counting/logging it would inflate the
+                # counter and fill EcMigrations with duplicates
+                continue
+            _migrate_dispatch.inc()
+            rec["task_id"] = tid
+            records.append(rec)
+            submitted.append(tid)
+            _log.info(
+                "dispatched ec_migrate: volume %d (%s -> %s, shards %s, "
+                "heat %d B, gravity %.2f -> %.2f)",
+                mig.vid, mig.src, mig.dst, list(mig.shard_ids),
+                mig.heat, mig.src_gravity, mig.dst_gravity,
+            )
+        if records:
+            with self._lock:
+                self.last_migrations = (records + self.last_migrations)[:20]
+        return submitted
 
     def _record_scrub_report(self, t: _Task, detail: str) -> None:
         """Fold one completed ec_scrub task's JSON report into the

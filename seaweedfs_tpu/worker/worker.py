@@ -34,7 +34,7 @@ class Worker:
         master: str = "localhost:9333",
         capabilities: tuple = (
             "ec_encode", "vacuum", "balance", "s3_lifecycle", "ec_balance",
-            "iceberg", "ec_scrub", "ec_rebuild",
+            "iceberg", "ec_scrub", "ec_rebuild", "ec_migrate",
         ),
         backend: str = "auto",
         max_concurrent: int = 2,
@@ -150,6 +150,35 @@ class Worker:
                         "on, comma-separated, driven sequentially "
                         "(empty = biggest holder, or smallest with "
                         "fromPeers)",
+                    ),
+                ],
+            ),
+            wk.TaskDescriptor(
+                kind="ec_migrate",
+                display_name="EC hot-volume migration",
+                description="move one holder's whole EC shard set to a "
+                "chip-rich low-load node (data gravity): copy over the "
+                "native shard plane, verify vs .ecsum, unmount source, "
+                "mount destination — never two mounted holders",
+                fields=[
+                    wk.ConfigField(
+                        name="source",
+                        type="string",
+                        default="",
+                        help="grpc host:port of the holder to drain",
+                    ),
+                    wk.ConfigField(
+                        name="target",
+                        type="string",
+                        default="",
+                        help="grpc host:port of the receiving node",
+                    ),
+                    wk.ConfigField(
+                        name="shards",
+                        type="string",
+                        default="",
+                        help="comma-separated shard ids to move (empty "
+                        "= every shard the source currently holds)",
                     ),
                 ],
             ),
@@ -331,6 +360,8 @@ class Worker:
                 detail = self._task_ec_scrub(assign)
             elif assign.kind == "ec_rebuild":
                 detail = self._task_ec_rebuild(assign)
+            elif assign.kind == "ec_migrate":
+                detail = self._task_ec_migrate(assign)
             else:
                 raise RuntimeError(f"unknown task kind {assign.kind}")
             self._report(assign.task_id, "done", 1.0, detail=detail)
@@ -653,6 +684,65 @@ class Worker:
         if errors and not results:
             raise RuntimeError("; ".join(errors))
         return json.dumps({"results": results, "errors": errors})
+
+    def _task_ec_migrate(self, assign: wk.TaskAssign) -> str:
+        """Hot-volume migration (data gravity, ec/rebalance.py): move
+        the source holder's shard set of this volume to the target
+        node. Runs under the volume lease the task framework already
+        took, so it cannot interleave with an ec.balance of the same
+        volume. Idempotent: a crash-rerun converges to exactly one
+        mounted holder."""
+        from ..ec.rebalance import drive_migration
+
+        vid = assign.volume_id
+        source = assign.params.get("source", "")
+        target = assign.params.get("target", "")
+        if not source or not target:
+            raise RuntimeError("ec_migrate needs source and target params")
+        shards = [
+            int(s) for s in assign.params.get("shards", "").split(",") if s
+        ]
+        if not shards:
+            # every shard the source currently advertises
+            by_url, loc_by_url = fleet.holder_maps(
+                self._mc.lookup_ec(vid, refresh=True)
+            )
+            for url, sids in by_url.items():
+                if fleet.grpc_addr(loc_by_url[url]) == source:
+                    shards = sorted(sids)
+            if not shards:
+                raise RuntimeError(
+                    f"source {source} holds no shards of ec volume {vid}"
+                )
+        channels: dict[str, grpc.Channel] = {}
+
+        def stub_for(addr: str):
+            ch = channels.get(addr)
+            if ch is None:
+                ch = channels[addr] = grpc.insecure_channel(addr)
+            return rpc.volume_stub(ch)
+
+        def lookup_ec():
+            located = self._mc.lookup_ec(vid, refresh=True)
+            return {
+                sid: [fleet.grpc_addr(l) for l in locs]
+                for sid, locs in located.items()
+            }
+
+        try:
+            out = drive_migration(
+                vid, assign.collection, source, target, shards,
+                stub_for=stub_for, lookup_ec=lookup_ec,
+            )
+        except grpc.RpcError as e:
+            raise RuntimeError(
+                f"migrate {source} -> {target}: {e.code().name}: "
+                f"{e.details()}"
+            ) from e
+        finally:
+            for ch in channels.values():
+                ch.close()
+        return json.dumps(out)
 
     def _task_iceberg(self, assign: wk.TaskAssign) -> None:
         """Iceberg snapshot expiry (reference worker tasks: the iceberg

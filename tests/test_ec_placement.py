@@ -221,6 +221,42 @@ def test_plan_shard_placement_shuns_open_breakers():
     assert plan == {4: "healthy"}
 
 
+def test_gravity_chips_split_ties_never_override_capacity():
+    """ISSUE 15: heartbeat-learned chip count splits capacity ties
+    (bytes drift toward hardware) but NEVER overrides the slot
+    gradient — the PR 14 mixed-fleet rule extended to gravity."""
+    from seaweedfs_tpu.ec.placement import plan_shard_placement
+
+    # static tie: the chip-rich node wins
+    nv = [
+        NodeView(id="bare", free_slots=50, ec_chips=0),
+        NodeView(id="chips", free_slots=50, ec_chips=8),
+    ]
+    assert plan_shard_placement(nv, 7, [0]) == {0: "chips"}
+    # slots outrank chips: a chip-rich nearly-full node still loses
+    nv = [
+        NodeView(id="roomy", free_slots=50, ec_chips=0),
+        NodeView(id="chips", free_slots=5, ec_chips=8),
+    ]
+    assert plan_shard_placement(nv, 7, [0]) == {0: "roomy"}
+    # within equal chips, live load still decides (PR 14 behavior)
+    nv = [
+        NodeView(id="busy", free_slots=50, ec_chips=4, ec_load=9e6),
+        NodeView(id="idle", free_slots=50, ec_chips=4, ec_load=0.0),
+    ]
+    assert plan_shard_placement(nv, 7, [0]) == {0: "idle"}
+
+
+def test_gravity_score_shape():
+    idle8 = NodeView(id="a", ec_chips=8)
+    busy8 = NodeView(id="b", ec_chips=8, ec_load=1e9)
+    broken8 = NodeView(id="c", ec_chips=8, ec_breakers_open=2)
+    none0 = NodeView(id="d")
+    assert idle8.gravity_score() > busy8.gravity_score() > 0
+    assert idle8.gravity_score() > broken8.gravity_score()
+    assert none0.gravity_score() == 0.0
+
+
 def test_node_view_for_parses_ec_telemetry():
     from seaweedfs_tpu.ec.placement import node_view_for
 

@@ -1,0 +1,532 @@
+"""Data-gravity tests (ISSUE 15): hot-volume rebalance planning,
+stale-telemetry aging, gravity-vs-spread invariants, and whole-shard-set
+migration over REAL gRPC — including the crash-rerun windows (kill
+between copy/mount/unmount -> re-run converges to exactly one mounted
+holder, bit-identical bytes).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+
+import grpc
+import numpy as np
+import pytest
+import requests
+
+from seaweedfs_tpu import faults
+from seaweedfs_tpu.ec.placement import (
+    NodeView,
+    node_view_for,
+    plan_ec_balance,
+    plan_shard_placement,
+)
+from seaweedfs_tpu.ec.rebalance import (
+    drive_migration,
+    plan_hot_migrations,
+    volume_heat,
+)
+from seaweedfs_tpu.pb import cluster_pb2 as pb
+from seaweedfs_tpu.pb import rpc
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+from conftest import allocate_port as free_port
+from conftest import wait_for
+
+TOTAL = 14
+KEEP_LOCAL = [0, 1, 2, 3]
+MOVED = list(range(4, TOTAL))
+
+
+def _tele(chips=0, load=0.0, breakers=0, vols=None, ts=None):
+    blob = {
+        "chips": {
+            f"tpu:{i}": {"load": load / max(chips, 1), "breaker": "closed"}
+            for i in range(chips)
+        },
+        "breakers_open": breakers,
+        "ts": ts if ts is not None else time.time(),
+        "received_at": ts if ts is not None else time.time(),
+    }
+    if vols:
+        blob["ec_volumes"] = {
+            str(v): {"read_bytes": rb, "reconstructed_bytes": xb}
+            for v, (rb, xb) in vols.items()
+        }
+    return blob
+
+
+# ------------------------------------------------------------- planner
+
+
+def test_plan_hot_migrations_targets_chip_rich_node():
+    views = [
+        NodeView(id="poor", rack="r1", free_slots=50, ec_chips=0,
+                 shards={7: set(range(5))}),
+        NodeView(id="rich", rack="r1", free_slots=50, ec_chips=8,
+                 ec_load=0.0),
+    ]
+    heat = {"poor": {7: 50 << 20}}
+    plans = plan_hot_migrations(views, heat, min_heat=1 << 20)
+    assert len(plans) == 1
+    m = plans[0]
+    assert (m.vid, m.src, m.dst) == (7, "poor", "rich")
+    assert m.shard_ids == (0, 1, 2, 3, 4)
+    assert m.dst_gravity > m.src_gravity
+
+
+def test_plan_hot_migrations_deterministic_under_seeded_skew():
+    """Same skewed snapshot in -> byte-identical plan out, every time."""
+    rng = random.Random(0x5EED)
+    def build():
+        views, heat = [], {}
+        for i in range(8):
+            nid = f"n{i}"
+            views.append(
+                NodeView(
+                    id=nid, rack=f"r{i % 3}", free_slots=40,
+                    ec_chips=rng.choice([0, 0, 2, 4, 8]),
+                    ec_load=rng.random() * 1e8,
+                    shards={
+                        v: set(range(rng.randint(1, 4)))
+                        for v in rng.sample(range(20), 3)
+                    },
+                )
+            )
+            heat[nid] = {
+                v: rng.randint(0, 200) << 20 for v in range(20)
+            }
+        return views, heat
+
+    rng = random.Random(0x5EED)
+    v1, h1 = build()
+    rng = random.Random(0x5EED)
+    v2, h2 = build()
+    p1 = plan_hot_migrations(v1, h1, min_heat=1 << 20, max_migrations=4)
+    p2 = plan_hot_migrations(v2, h2, min_heat=1 << 20, max_migrations=4)
+    assert p1 == p2
+    assert p1, "seeded skew must produce at least one migration"
+    for m in p1:
+        src = next(v for v in v1 if v.id == m.src)
+        dst = next(v for v in v1 if v.id == m.dst)
+        assert dst.gravity_score() > src.gravity_score()
+        assert not dst.shards.get(m.vid), "dest already held the volume"
+
+
+def test_plan_hot_migrations_respects_capacity_and_rack_ceiling():
+    # dest rack already at the ceiling for vid 3: 2 racks, 4 shards ->
+    # ceil(4/2)=2 per rack; moving 2 more into r2 would breach it
+    views = [
+        NodeView(id="src", rack="r1", free_slots=50, ec_chips=0,
+                 shards={3: {0, 1}}),
+        NodeView(id="richfull", rack="r2", free_slots=50, ec_chips=8,
+                 shards={}),
+        NodeView(id="r2holder", rack="r2", free_slots=50,
+                 shards={3: {2, 3}}),
+    ]
+    heat = {"src": {3: 100 << 20}}
+    plans = plan_hot_migrations(views, heat, min_heat=1)
+    assert plans == [], "rack ceiling must veto the only candidate"
+    # byte headroom gate: known-too-small destination is never chosen
+    views = [
+        NodeView(id="src", rack="r1", free_slots=50, ec_chips=0,
+                 shards={3: {0, 1}}),
+        NodeView(id="tiny", rack="r1", free_slots=50, ec_chips=8,
+                 free_bytes=10),
+    ]
+    plans = plan_hot_migrations(
+        views, {"src": {3: 100 << 20}}, shard_bytes={3: 1 << 20},
+        min_heat=1,
+    )
+    assert plans == []
+
+
+def test_gravity_balance_never_breaks_spread_or_capacity():
+    """Property, seeded: plan_ec_balance(data_gravity=True) may add
+    gravity moves, but the post-state never violates the across-rack
+    ceiling, per-node free slots, or worsen the per-volume per-node
+    maximum."""
+    rng = random.Random(0xDA7A)
+    for trial in range(20):
+        views = []
+        for i in range(6):
+            views.append(
+                NodeView(
+                    id=f"n{i}", rack=f"r{i % 3}",
+                    free_slots=rng.randint(0, 30),
+                    ec_chips=rng.choice([0, 0, 4, 8]),
+                    ec_load=rng.random() * 1e8,
+                    shards={
+                        v: set(rng.sample(range(14), rng.randint(1, 6)))
+                        for v in rng.sample(range(8), rng.randint(1, 3))
+                    },
+                )
+            )
+        drops, moves = plan_ec_balance(views, data_gravity=True)
+        # capacity: no node overdrawn
+        for n in views:
+            assert n.free_slots >= 0, f"trial {trial}: {n.id} overdrawn"
+        # across-rack ceiling per volume
+        racks = {}
+        for n in views:
+            racks.setdefault(n.rack_key(), []).append(n)
+        vids = {v for n in views for v in n.shards}
+        for vid in vids:
+            total = sum(len(n.shards.get(vid, ())) for n in views)
+            if total == 0 or len(racks) < 2:
+                continue
+            ceiling = -(-total // len(racks))
+            for rk, members in racks.items():
+                got = sum(len(n.shards.get(vid, ())) for n in members)
+                assert got <= ceiling, (
+                    f"trial {trial}: vid {vid} rack {rk} {got} > "
+                    f"{ceiling} after gravity balance"
+                )
+        # gravity moves flow toward strictly better gravity
+        for m in moves:
+            if m.reason != "gravity":
+                continue
+            src = next(v for v in views if v.id == m.src)
+            dst = next(v for v in views if v.id == m.dst)
+            from seaweedfs_tpu.ec.placement import gravity_key
+
+            assert gravity_key(dst) < gravity_key(src)
+
+
+# --------------------------------------------------- telemetry aging
+
+
+def test_stale_telemetry_stops_steering_but_keeps_age():
+    fresh = _tele(chips=8, load=5.0, ts=time.time())
+    stale = _tele(chips=8, load=5.0, ts=time.time() - 3600)
+    v_fresh = node_view_for("a", "r", "dc", 8, 0, [], ec_telemetry=fresh)
+    v_stale = node_view_for("b", "r", "dc", 8, 0, [], ec_telemetry=stale)
+    assert v_fresh.ec_chips == 8 and v_fresh.ec_load > 0
+    assert v_stale.ec_chips == 0 and v_stale.ec_load == -1.0
+    assert v_stale.telemetry_age_s > 3000
+    assert v_stale.gravity_score() == 0.0
+    # a dead node's idle chips must not attract placement: both nodes
+    # static-tie, so the STALE one no longer wins on its ghost chips
+    plan = plan_shard_placement([v_stale, v_fresh], 5, [0])
+    assert plan == {0: "b"} or plan == {0: "a"}
+    # explicit knob: widen the window and the same blob steers again
+    v_ok = node_view_for(
+        "c", "r", "dc", 8, 0, [], ec_telemetry=stale, stale_after=7200.0
+    )
+    assert v_ok.ec_chips == 8
+
+
+def test_volume_heat_parses_and_weighs_reconstruction():
+    t = _tele(vols={7: (100, 50), 9: (10, 0)})
+    heat = volume_heat(t)
+    assert heat == {7: 200, 9: 10}  # read + 2x reconstructed
+    assert volume_heat(None) == {}
+    assert volume_heat({"ec_volumes": "garbage"}) == {}
+
+
+# ------------------------------------------------ scanner (unit level)
+
+
+def test_scan_for_ec_rebalance_dispatches_on_heat_delta():
+    from seaweedfs_tpu.server.topology import DataNode, Topology
+    from seaweedfs_tpu.worker.control import WorkerControl, _Worker
+    from seaweedfs_tpu.worker.worker import Worker
+
+    topo = Topology()
+    wc = WorkerControl(topo=topo)
+    try:
+        # a connected worker declaring the ec_migrate descriptor (param
+        # validation needs it)
+        w = _Worker(
+            worker_id="w1",
+            capabilities={"ec_migrate"},
+            max_concurrent=1,
+            backend="cpu",
+            descriptors={
+                d.kind: d
+                for d in Worker().descriptors
+                if d.kind == "ec_migrate"
+            },
+        )
+        with wc._lock:
+            wc._workers["w1"] = w
+
+        def node(nid, port, chips, vols):
+            n = DataNode(
+                node_id=nid, ip="h", port=port, public_url=nid,
+                grpc_port=port + 10000, rack="r1",
+            )
+            n.ec_shards = {
+                vid: pb.EcShardInfoMsg(
+                    id=vid, shard_bits=bits, shard_size=1 << 20,
+                    data_shards=10, parity_shards=4,
+                )
+                for vid, bits in vols.items()
+            }
+            n.ec_telemetry = _tele(
+                chips=chips,
+                vols={vid: (0, 0) for vid in vols},
+            )
+            return n
+
+        a = node("h:1", 1, 0, {7: 0b11111})  # chip-poor holder of vid 7
+        b = node("h:2", 2, 8, {})            # chip-rich idle
+        topo.nodes = {a.node_id: a, b.node_id: b}
+        # sweep 1: first sighting -> baseline only, nothing dispatched
+        assert wc.scan_for_ec_rebalance(topo, min_heat=1 << 20) == []
+        # heat arrives: 64 MiB of reads on vid 7 at the poor holder
+        a.ec_telemetry = _tele(chips=0, vols={7: (64 << 20, 0)})
+        tids = wc.scan_for_ec_rebalance(topo, min_heat=1 << 20)
+        assert len(tids) == 1
+        _, tasks = wc.snapshot()
+        t = next(t for t in tasks if t["task_id"] == tids[0])
+        assert t["kind"] == "ec_migrate" and t["volume_id"] == 7
+        with wc._lock:
+            params = wc._tasks[tids[0]].params
+        assert params["source"] == "h:10001"
+        assert params["target"] == "h:10002"
+        assert params["shards"] == "0,1,2,3,4"
+        assert wc.last_migrations[0]["volume_id"] == 7
+        # same counters again -> zero delta -> nothing new
+        assert wc.scan_for_ec_rebalance(topo, min_heat=1 << 20) == []
+    finally:
+        wc.stop()
+
+
+# ------------------------------------------- migration over real gRPC
+
+
+class Cluster:
+    def __init__(self, tmp_path, n=2):
+        self.mport = free_port()
+        self.master = MasterServer(ip="localhost", port=self.mport)
+        self.master.start()
+        self.vols = [
+            VolumeServer(
+                directories=[str(tmp_path / f"v{i}")],
+                master=f"localhost:{self.mport}",
+                ip="localhost",
+                port=free_port(),
+                ec_backend="cpu",
+            )
+            for i in range(n)
+        ]
+        for vs in self.vols:
+            vs.start()
+        wait_for(
+            lambda: len(self.master.topo.nodes) >= n,
+            msg="volume servers did not register",
+        )
+        self._channels = []
+
+    def stub_addr(self, addr):
+        ch = grpc.insecure_channel(addr)
+        self._channels.append(ch)
+        return rpc.volume_stub(ch)
+
+    def stub(self, vs):
+        return self.stub_addr(f"localhost:{vs.grpc_port}")
+
+    def locs(self, vid):
+        return {
+            sid: [l.url for l in locs]
+            for sid, locs in self.master.topo.lookup_ec(vid).items()
+        }
+
+    def grpc_locs(self, vid):
+        return {
+            sid: [
+                f"{l.url.split(':')[0]}:{l.grpc_port}" for l in locs
+            ]
+            for sid, locs in self.master.topo.lookup_ec(vid).items()
+        }
+
+    def stop(self):
+        for ch in self._channels:
+            ch.close()
+        for vs in self.vols:
+            vs.stop()
+        self.master.stop()
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = Cluster(tmp_path)
+    yield c
+    c.stop()
+
+
+def split_ec_volume(c: Cluster):
+    a = requests.get(f"http://localhost:{c.mport}/dir/assign").json()
+    fid = a["fid"]
+    vid = int(fid.split(",")[0])
+    payload = np.random.default_rng(0x9A7E).integers(
+        0, 256, 100_000, dtype=np.uint8
+    ).tobytes()
+    r = requests.post(
+        f"http://{a['url']}/{fid}", files={"file": ("x.bin", payload)}
+    )
+    assert r.status_code == 201, r.text
+    holder = next(v for v in c.vols if a["url"] == f"localhost:{v.port}")
+    other = next(v for v in c.vols if v is not holder)
+    st_h, st_o = c.stub(holder), c.stub(other)
+    st_h.VolumeEcShardsGenerate(
+        pb.EcShardsGenerateRequest(volume_id=vid, backend="cpu"), timeout=120
+    )
+    st_h.VolumeEcShardsMount(
+        pb.EcShardsMountRequest(volume_id=vid), timeout=30
+    )
+    st_h.VolumeDelete(pb.VolumeCommandRequest(volume_id=vid), timeout=30)
+    base = holder.service._ec_base(vid, "")
+    ground = {
+        i: open(base + f".ec{i:02d}", "rb").read() for i in range(TOTAL)
+    }
+    st_o.VolumeEcShardsCopy(
+        pb.EcShardsCopyRequest(
+            volume_id=vid,
+            shard_ids=MOVED,
+            source_url=f"localhost:{holder.grpc_port}",
+            copy_ecx=True, copy_ecj=True, copy_vif=True, copy_ecsum=True,
+        ),
+        timeout=120,
+    )
+    st_o.VolumeEcShardsMount(
+        pb.EcShardsMountRequest(volume_id=vid), timeout=30
+    )
+    st_h.VolumeEcShardsUnmount(
+        pb.EcShardsUnmountRequest(volume_id=vid, shard_ids=MOVED), timeout=30
+    )
+    st_h.VolumeEcShardsDelete(
+        pb.EcShardsDeleteRequest(volume_id=vid, shard_ids=MOVED), timeout=30
+    )
+    wait_for(
+        lambda: len(c.locs(vid)) == TOTAL
+        and all(len(v) == 1 for v in c.locs(vid).values()),
+        msg="shard split did not reach the master",
+    )
+    return vid, fid, payload, holder, other, ground
+
+
+def _migrate(c, vid, src_vs, dst_vs, sids):
+    src_addr = f"localhost:{src_vs.grpc_port}"
+    dst_addr = f"localhost:{dst_vs.grpc_port}"
+    return drive_migration(
+        vid, "", src_addr, dst_addr, sids,
+        stub_for=c.stub_addr,
+        lookup_ec=lambda: c.grpc_locs(vid),
+    )
+
+
+def _one_mounted_holder(c, vid, sids, dst_vs):
+    """Every sid in `sids` is advertised by exactly the destination."""
+    locs = c.locs(vid)
+    want = [f"localhost:{dst_vs.port}"]
+    return all(locs.get(s) == want for s in sids)
+
+
+def _mount_counts(c, vid, sids):
+    """GROUND-TRUTH mounts per sid, read from the stores themselves
+    (the master map lags mounts/unmounts by a heartbeat)."""
+    counts = {s: 0 for s in sids}
+    for vs in c.vols:
+        ev = vs.store.find_ec_volume(vid)
+        if ev is None:
+            continue
+        for s in sids:
+            if s in ev.shard_fds:
+                counts[s] += 1
+    return counts
+
+
+def test_migration_moves_shard_set_bit_identical(cluster):
+    from seaweedfs_tpu.ec import native_io
+    from seaweedfs_tpu.utils import metrics as M
+
+    vid, fid, payload, holder, other, ground = split_ec_volume(cluster)
+    hbase = holder.service._ec_base(vid, "")
+    rec0 = M.net_bytes_received_total.snapshot()
+    out = _migrate(cluster, vid, holder, other, KEEP_LOCAL)
+    assert out["migrated"] == KEEP_LOCAL
+    wait_for(
+        lambda: _one_mounted_holder(cluster, vid, KEEP_LOCAL, other),
+        msg="migration did not converge to the destination",
+    )
+    obase = other.service._ec_base(vid, "")
+    for s in KEEP_LOCAL:
+        assert open(obase + f".ec{s:02d}", "rb").read() == ground[s]
+    for s in KEEP_LOCAL:
+        assert not os.path.exists(hbase + f".ec{s:02d}"), "source kept files"
+    # the object still reads back (now served by `other` alone)
+    got = requests.get(f"http://localhost:{other.port}/{fid}").content
+    assert got == payload
+    if native_io.enabled():
+        rec1 = M.net_bytes_received_total.snapshot()
+        moved = sum(len(ground[s]) for s in KEEP_LOCAL)
+        native_delta = rec1.get(("native",), 0) - rec0.get(("native",), 0)
+        assert native_delta >= moved, (
+            "migration bytes did not ride the native plane"
+        )
+
+
+@pytest.mark.parametrize(
+    "window",
+    ["ec.migrate.after_copy", "ec.migrate.after_unmount",
+     "ec.migrate.after_mount"],
+)
+def test_migration_crash_rerun_exactly_one_holder(cluster, window):
+    """Kill the driver in each crash window; re-run converges to
+    EXACTLY ONE mounted holder with bit-identical bytes, and at no
+    point were two holders mounted for a migrated shard."""
+    vid, fid, payload, holder, other, ground = split_ec_volume(cluster)
+    hbase = holder.service._ec_base(vid, "")
+    with faults.injected(window, faults.crash(), when=faults.nth_call(1)) as h:
+        with pytest.raises(faults.InjectedCrash):
+            _migrate(cluster, vid, holder, other, KEEP_LOCAL)
+    assert h.fired == 1
+    # never two mounted holders, even inside the crash window —
+    # GROUND TRUTH from the stores (the master map lags by a heartbeat)
+    for s, n in _mount_counts(cluster, vid, KEEP_LOCAL).items():
+        assert n <= 1, f"shard {s} mounted on {n} holders in {window}"
+    # re-run: idempotent convergence
+    out = _migrate(cluster, vid, holder, other, KEEP_LOCAL)
+    assert out["migrated"] == KEEP_LOCAL
+    for s, n in _mount_counts(cluster, vid, KEEP_LOCAL).items():
+        assert n == 1, f"shard {s} mounted on {n} holders after re-run"
+    wait_for(
+        lambda: _one_mounted_holder(cluster, vid, KEEP_LOCAL, other),
+        msg=f"re-run after {window} did not converge",
+    )
+    obase = other.service._ec_base(vid, "")
+    for s in KEEP_LOCAL:
+        assert open(obase + f".ec{s:02d}", "rb").read() == ground[s]
+    for s in KEEP_LOCAL:
+        assert not os.path.exists(hbase + f".ec{s:02d}")
+    got = requests.get(f"http://localhost:{other.port}/{fid}").content
+    assert got == payload
+
+
+def test_copy_refuses_corrupt_source_shard(cluster):
+    """The migration copy path verifies landed shards against the
+    .ecsum sidecar: a rotten source byte -> DATA_LOSS, nothing kept."""
+    vid, fid, payload, holder, other, ground = split_ec_volume(cluster)
+    hbase = holder.service._ec_base(vid, "")
+    with open(hbase + ".ec01", "r+b") as f:
+        f.seek(100)
+        b = f.read(1)
+        f.seek(100)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(grpc.RpcError) as ei:
+        cluster.stub(other).VolumeEcShardsCopy(
+            pb.EcShardsCopyRequest(
+                volume_id=vid,
+                shard_ids=[1],
+                source_url=f"localhost:{holder.grpc_port}",
+            ),
+            timeout=120,
+        )
+    assert ei.value.code() == grpc.StatusCode.DATA_LOSS
+    obase = other.service._ec_base(vid, "")
+    assert not os.path.exists(obase + ".ec01"), "rotten copy kept on disk"

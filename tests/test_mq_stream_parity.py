@@ -281,3 +281,97 @@ def test_partition_parity_direct_recover_roundtrip(tmp_path):
     pp3 = PartitionParity(str(tmp_path), "ns", "t", 0)
     assert pp3.recover()[-1] == (50, 60, b"k", b"v")
     pp3.close()
+
+
+# ---------------------------------------------- ISSUE 15 satellites
+
+
+def test_configure_topic_grpc_durable_parity_field(tmp_path):
+    """PR 14 carried (c): the ConfigureTopic RPC carries durable_parity
+    (tri-state int32, descriptor surgery) so a REMOTE client gets the
+    same opt-in/out the Python API has."""
+    from conftest import allocate_port as free_port
+
+    from seaweedfs_tpu.mq import MqBrokerServer, MqClient
+
+    srv = MqBrokerServer(
+        ip="localhost", grpc_port=free_port(),
+        parity_dir=str(tmp_path / "parity"),
+    )
+    srv.start()
+    c = MqClient(f"localhost:{srv.grpc_port}")
+    try:
+        c.configure_topic("on-default", partitions=1)          # 0 = default
+        c.configure_topic("forced-off", partitions=1,
+                          durable_parity=False)                 # 2 = off
+        c.configure_topic("forced-on", partitions=1,
+                          durable_parity=True)                  # 1 = on
+        topics = srv.broker._topics
+        assert topics[("default", "on-default")].durable_parity is True
+        assert topics[("default", "forced-off")].durable_parity is False
+        assert topics[("default", "forced-on")].durable_parity is True
+        # parity actually engages only where configured
+        c.publish("forced-off", b"v", key=b"k")
+        c.publish("forced-on", b"v", key=b"k")
+        srv.broker.parity_sweep()
+        assert "default/forced-on" in srv.broker.parity_status()
+        assert "default/forced-off" not in srv.broker.parity_status()
+    finally:
+        c.close()
+        srv.stop()
+
+
+def test_remote_roots_place_stream_shards_and_recover(tmp_path, monkeypatch):
+    """PR 14 carried (b), scoped: with SEAWEED_EC_STREAM_REMOTE_ROOTS
+    set, a durable-parity partition's stream shards spread across the
+    remote roots via plan_shard_placement headroom (symlinked targets);
+    recovery reads through them, pruning removes the remote bytes, and
+    a root without headroom is never chosen. Default (unset) keeps
+    every shard local."""
+    r1 = tmp_path / "hostA"
+    r2 = tmp_path / "hostB"
+    monkeypatch.setenv(
+        "SEAWEED_EC_STREAM_REMOTE_ROOTS", f"hostA={r1},hostB={r2}"
+    )
+    pp = PartitionParity(str(tmp_path / "local"), "ns", "t", 0)
+    msgs = [(i, 10 + i, *_msg(i)) for i in range(40)]
+    for off, ts, k, v in msgs:
+        pp.append_record(off, ts, k, v)
+    pp.flush()
+    pp.close()
+    links = [
+        n
+        for n in os.listdir(pp.dir)
+        if n.startswith(GEN_PREFIX) and os.path.islink(
+            os.path.join(pp.dir, n)
+        )
+    ]
+    assert links, "no shard was placed on a remote root"
+    remote_files = [
+        p
+        for root in (r1, r2)
+        for dirpath, _d, names in os.walk(root)
+        for p in [os.path.join(dirpath, n) for n in names]
+    ]
+    assert remote_files, "remote roots hold no shard bytes"
+    # recovery reads through the symlinks bit-exactly
+    pp2 = PartitionParity(str(tmp_path / "local"), "ns", "t", 0)
+    assert pp2.recover() == msgs
+    pp2.delete()
+    assert not [
+        p
+        for root in (r1, r2)
+        for dirpath, _d, names in os.walk(root)
+        for p in [os.path.join(dirpath, n) for n in names]
+    ], "delete left orphaned remote shard bytes"
+    # unset (the default) = all-local
+    monkeypatch.delenv("SEAWEED_EC_STREAM_REMOTE_ROOTS")
+    pp3 = PartitionParity(str(tmp_path / "plain"), "ns", "t", 0)
+    for off, ts, k, v in msgs:
+        pp3.append_record(off, ts, k, v)
+    pp3.flush()
+    pp3.close()
+    assert not any(
+        os.path.islink(os.path.join(pp3.dir, n))
+        for n in os.listdir(pp3.dir)
+    )
