@@ -46,6 +46,7 @@ CONFIG_FIELDS = (
     "lifecycle_interval_seconds",
     "ec_balance_interval_seconds",
     "ec_scrub_interval_seconds",
+    "ec_rebalance_interval_seconds",
 )
 STRING_CONFIG_FIELDS = ("lifecycle_filer",)
 

@@ -46,11 +46,16 @@ The pool itself is process-wide (chips are physical; two tenant scopes
 sharing a host should see each other's load), while each scope gets its
 own per-chip DeviceQueues (config isolation, `device_queue.QueueScope`).
 
-Known residency nuance (ROADMAP): a wide MESH stream admits through
-the mesh backend's own queue while chip-placed streams admit through
-per-chip queues, so a chip serving both can transiently hold up to two
-windows of in-flight batches; a physical residency budget spanning
-queues is a recorded open item, not this layer's job.
+The residency nuance recorded here since PR 5 — a chip serving a wide
+MESH stream beside chip-placed streams could transiently hold two
+windows of in-flight batches — is closed by the process-wide
+ResidencyLedger (ec/device_queue.py): every queue charges the physical
+chip(s) in a second admission phase, and a mesh-wide batch charges a
+slot on EVERY chip it spans, so the per-chip budget holds across
+queues and scopes. Routing reads the ledger too: `_live_loads_for`
+adds each chip's CROSS-SCOPE in-flight cost on top of the scope's own
+queue view, so another tenant's load repels placement (the PR 14
+carried item).
 """
 
 from __future__ import annotations
@@ -391,18 +396,25 @@ def _pod_sharded(backend) -> bool:
 
 
 def _live_loads_for(pool: ChipPool, scope: QueueScope) -> list[int]:
-    """Per-chip-index live load (DeviceQueue.load() + breaker penalty)
-    aligned with `pool.labels`. Chips whose queue does not exist yet
-    read 0 — never create a queue just to ask its load."""
+    """Per-chip-index live load aligned with `pool.labels`: the scope's
+    own DeviceQueue.load() (queued + in-flight) plus the residency
+    ledger's CROSS-SCOPE share (every other scope's — and the mesh
+    path's — in-flight cost on the chip) plus the breaker penalty.
+    The scope's own in-flight cost is subtracted from the ledger view
+    so it is never counted twice. Chips with no state anywhere read
+    0 — never create a queue just to ask its load."""
     hint = scope.queue_loads()
+    shared = scope.residency_loads()
     out = []
     for label in pool.labels:
         h = hint.get(label)
-        if h is None:
-            out.append(0)
-            continue
-        load = int(h.get("load", 0))
-        if h.get("breaker") == "open":
+        load = 0
+        own_inflight = 0
+        if h is not None:
+            load = int(h.get("load", 0))
+            own_inflight = int(h.get("inflight_cost", 0))
+        load += max(int(shared.get(label, 0)) - own_inflight, 0)
+        if h is not None and h.get("breaker") == "open":
             load += BREAKER_OPEN_PENALTY
         out.append(load)
     return out

@@ -78,17 +78,53 @@ the Prometheus registry (``sw_ec_queue_*``), keyed per chip: each queue
 carries a ``chip`` label (the device id for pool chips, the backend
 class name otherwise), so a second chip's counters land in their own
 gauge set instead of silently aliasing into the first's.
+
+Residency: the physical layer under the scopes
+----------------------------------------------
+
+Scopes isolate CONFIG, not HARDWARE: two scopes sharing one chip each
+used to get a full in-flight window, and a wide mesh stream admitted
+through the mesh backend's own queue beside every per-chip queue — a
+pod could be driven to ~2x physical oversubscription with nothing
+stopping it. The :class:`ResidencyLedger` is the process-wide answer:
+ONE ledger, one slot budget per PHYSICAL chip, charged by every
+scope's queue in a second admission phase after the scope's own
+window. Per-scope windows are thereby sub-budgets — N scopes on one
+chip can never hold more in-flight batches than the chip's bound, and
+a mesh-wide stream charges a slot on EVERY chip it spans
+(`_residency_keys`). The ledger is also where cross-scope behavior
+lives:
+
+- **Tenant fairness** — each scope carries a ``tenant`` name; grants
+  under contention order by (starvation bound, priority class, the
+  tenant's windowed admitted cost). A storm tenant's backlog cannot
+  push a quiet tenant's foreground wait unbounded, and any waiter
+  older than ``SEAWEED_EC_TENANT_STARVE_S`` goes first regardless.
+- **Graceful shedding** — sustained saturation raises a per-chip
+  pressure level (an open chip breaker raises it further): level 1
+  defers scrub grants, level 2 defers recovery too, level 3 makes
+  :func:`shed_advice` tell front ends to 503/SlowDown the tenants
+  whose windowed share exceeds their fair share (per-tenant, never
+  per-server). Background classes throttle first; foreground last.
+
+``sw_ec_residency_*`` metrics, :func:`residency_snapshot` (heartbeat
+telemetry + /status + /cluster/status) and per-tenant shed counters
+surface the whole state. ``SEAWEED_EC_RESIDENCY_WINDOW=0`` disables
+the global ledger (each scope back to its private window only);
+tests/bench inject private ledgers via ``QueueScope(residency=...)``.
 """
 
 from __future__ import annotations
 
 import contextlib
 import itertools
+import os
 import threading
 import time
 import weakref
 from collections import deque
 
+from .. import faults
 from ..utils import metrics as _M
 from .context import ECError
 
@@ -151,6 +187,79 @@ _queue_wait_seconds = _M.REGISTRY.counter(
     "EC device-queue admission wait", ("cls", "chip"),
 )
 
+# ---- residency defaults (env-tunable; see README env-knob registry) ----
+
+# Per-physical-chip in-flight slot budget of the process-wide ledger.
+# Defaults to DEFAULT_WINDOW so a single scope per chip behaves exactly
+# as before — the ledger only binds once a SECOND scope (or a mesh-wide
+# stream) shows up on the chip. 0 disables the global ledger.
+DEFAULT_RESIDENCY_BUDGET = DEFAULT_WINDOW
+
+# Starvation bound: a waiter older than this goes ahead of every
+# fairness/shed consideration — the hard ceiling on how long tenant
+# weighting or background deferral may hold anyone back.
+DEFAULT_STARVE_S = 30.0
+
+# Sustained-saturation threshold: a chip full with waiters queued for
+# this long enters shed level 1 (scrub deferred); 3x = level 2
+# (recovery deferred too); 6x = level 3 (over-share tenants shed at
+# the front ends).
+DEFAULT_SHED_AFTER_S = 5.0
+
+# Base Retry-After (seconds) handed to shed tenants.
+DEFAULT_SHED_RETRY_S = 2.0
+
+# Tenant fairness accounting window: admitted cost is summed over a
+# sliding ~2x this span (two rotating buckets) — recent behavior, not
+# lifetime totals, decides who the storm tenant is.
+DEFAULT_TENANT_WINDOW_S = 10.0
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+_res_budget_g = _M.REGISTRY.gauge(
+    "sw_ec_residency_budget",
+    "EC residency-ledger in-flight slot budget per physical chip",
+    ("chip",),
+)
+_res_inflight_g = _M.REGISTRY.gauge(
+    "sw_ec_residency_inflight",
+    "EC residency-ledger in-flight batches per physical chip "
+    "(all scopes + mesh streams combined)",
+    ("chip",),
+)
+_res_pressure_g = _M.REGISTRY.gauge(
+    "sw_ec_residency_pressure",
+    "EC residency shed level per chip (0 ok, 1 scrub deferred, "
+    "2 recovery deferred, 3 over-share tenants shed)",
+    ("chip",),
+)
+_res_admitted = _M.REGISTRY.counter(
+    "sw_ec_residency_admitted_total",
+    "EC residency-ledger admitted batches", ("tenant", "chip"),
+)
+_res_admitted_cost = _M.REGISTRY.counter(
+    "sw_ec_residency_admitted_cost_total",
+    "EC residency-ledger admitted cost units", ("tenant", "chip"),
+)
+_res_wait_seconds = _M.REGISTRY.counter(
+    "sw_ec_residency_wait_seconds_total",
+    "EC residency-ledger acquire wait (the second admission phase, "
+    "charged on top of the scope queue's own wait)",
+    ("tenant", "chip"),
+)
+_res_shed = _M.REGISTRY.counter(
+    "sw_ec_residency_shed_total",
+    "front-end requests shed (503 SlowDown) per tenant by the "
+    "residency pressure policy",
+    ("tenant",),
+)
+
 
 def batch_cost(out_rows: int, width: int) -> int:
     """Admission cost of one batch: output rows x batch width (bytes per
@@ -175,13 +284,16 @@ class Ticket:
     thread's finally. `wait_s` is the admission wait this batch paid
     (the flight recorder's "admission_wait" stage)."""
 
-    __slots__ = ("priority", "cost", "released", "wait_s")
+    __slots__ = ("priority", "cost", "released", "wait_s", "res")
 
     def __init__(self, priority: str, cost: int, wait_s: float = 0.0):
         self.priority = priority
         self.cost = cost
         self.released = False
         self.wait_s = wait_s
+        # (ledger, _ResTicket) once the residency phase charged the
+        # physical chip; None for ledger-less queues
+        self.res = None
 
 
 class ClassStats:
@@ -304,10 +416,20 @@ class DeviceQueue:
         clock=time.monotonic,
         admit_timeout: float = DEFAULT_ADMIT_TIMEOUT,
         label: str = "",
+        residency: "ResidencyLedger | None" = None,
+        res_keys: tuple[str, ...] = (),
+        tenant: str = "default",
     ):
         self.window = max(1, int(window))
         self.admit_timeout = float(admit_timeout)
         self.label = label
+        # Second admission phase: the process-wide physical ledger this
+        # queue charges per batch (None = logical window only), the
+        # chip keys one batch occupies, and the tenant the charge is
+        # accounted to (QueueScope wiring).
+        self.residency = residency
+        self.res_keys = tuple(res_keys) or (label or "unlabeled",)
+        self.tenant = tenant
         self.shares = dict(DEFAULT_SHARES)
         if shares:
             for cls, s in shares.items():
@@ -327,6 +449,10 @@ class DeviceQueue:
         # stream close; wiring routing to live queue load is a recorded
         # ROADMAP item.
         self._pending_cost = 0
+        # In-flight cost alone (no queued waiters): lets chip_pool
+        # subtract THIS scope's share from the shared ledger's per-chip
+        # cost so cross-scope load is added exactly once.
+        self._inflight_cost = 0
         self._stats: dict[str, ClassStats] = {c: ClassStats() for c in PRIORITIES}
         self._clock = clock
         # Liveness signal for the admission deadline: bumped on every
@@ -471,17 +597,49 @@ class DeviceQueue:
             _queue_admitted.inc(cls=priority, chip=self.label)
             _queue_admitted_cost.inc(cost, cls=priority, chip=self.label)
             _queue_wait_seconds.inc(wait_s, cls=priority, chip=self.label)
+            self._inflight_cost += cost
             # Another slot may still be free for the next waiter.
             self._cond.notify_all()
-        return Ticket(priority, cost, wait_s)
+        ticket = Ticket(priority, cost, wait_s)
+        # Phase 2, OUTSIDE self._cond (the ledger has its own lock —
+        # never nested): charge the physical chip(s). The local slot is
+        # held while we wait here, which is exactly the sub-budget
+        # semantics — this scope's window counts against the chip's
+        # physical bound, it does not add to it. On failure the local
+        # slot is returned before the error propagates.
+        if self.residency is not None:
+            t0 = self._clock()
+            try:
+                res = self.residency.acquire(
+                    self.res_keys, self.tenant, priority, cost,
+                    timeout=self.admit_timeout,
+                )
+            except BaseException:
+                self._release(ticket)
+                raise
+            ticket.res = (self.residency, res)
+            rwait = max(self._clock() - t0, 0.0)
+            if rwait > 0.0:
+                # the residency wait is part of this batch's admission
+                # wait: fold it into the ticket (span stage) and stats
+                ticket.wait_s += rwait
+                with self._cond:
+                    st = self._stats[priority]
+                    st.wait_s_total += rwait
+                    st.wait_s_max = max(st.wait_s_max, ticket.wait_s)
+                _queue_wait_seconds.inc(rwait, cls=priority, chip=self.label)
+        return ticket
 
     def _release(self, ticket: Ticket) -> None:
+        res = None
         with self._cond:
             if ticket.released:
                 return
             ticket.released = True
+            res, ticket.res = ticket.res, None
             self._inflight -= 1
             self._pending_cost -= ticket.cost
+            self._inflight_cost -= ticket.cost
             self._last_progress = self._clock()
             st = self._stats[ticket.priority]
             st.inflight -= 1
@@ -489,6 +647,531 @@ class DeviceQueue:
             st.drained_cost += ticket.cost
             _queue_inflight.dec(cls=ticket.priority, chip=self.label)
             self._cond.notify_all()
+        if res is not None:
+            ledger, rt = res
+            ledger.release(rt)
+
+
+# --------------------------------------------------------------------------
+# Residency: the physical admission layer under the scopes. ONE ledger
+# per process (or one injected per test/bench), ONE lock for all chips
+# — a mesh-wide stream acquires every chip it spans atomically, with no
+# per-chip lock ordering to deadlock on.
+# --------------------------------------------------------------------------
+
+
+class _ResTicket:
+    """One granted residency charge: `keys` are the physical chips
+    holding a slot each until release. Idempotent release."""
+
+    __slots__ = ("keys", "tenant", "priority", "cost", "released", "wait_s")
+
+    def __init__(self, keys, tenant, priority, cost, wait_s):
+        self.keys = keys
+        self.tenant = tenant
+        self.priority = priority
+        self.cost = cost
+        self.released = False
+        self.wait_s = wait_s
+
+
+class _ResWaiter:
+    __slots__ = ("keys", "tenant", "priority", "cost", "t_submit", "seq")
+
+    def __init__(self, keys, tenant, priority, cost, t_submit, seq):
+        self.keys = keys
+        self.tenant = tenant
+        self.priority = priority
+        self.cost = cost
+        self.t_submit = t_submit
+        self.seq = seq
+
+
+class _ChipState:
+    __slots__ = (
+        "key", "budget", "inflight", "inflight_cost", "max_inflight",
+        "max_inflight_cost", "admitted", "admitted_cost", "over_since",
+        "breakers",
+    )
+
+    def __init__(self, key: str, budget: int):
+        self.key = key
+        self.budget = budget
+        self.inflight = 0
+        self.inflight_cost = 0
+        # Watermarks are the chaos tests' GROUND TRUTH for the
+        # invariant "N scopes on one chip never exceed the budget":
+        # they record the worst concurrency the ledger ever granted,
+        # not a sample that a racing reader could miss.
+        self.max_inflight = 0
+        self.max_inflight_cost = 0
+        self.admitted = 0
+        self.admitted_cost = 0
+        # Wall time when the chip went full WITH waiters queued; None
+        # while it has headroom. Sustained over_since drives the shed
+        # level.
+        self.over_since = None
+        # weakrefs to this chip's fallback breakers (chip_pool wires
+        # one per chip): an OPEN breaker means the chip's streams run
+        # on CPU — degraded capacity feeds the shed level directly.
+        self.breakers: list = []
+
+    def breaker_open(self) -> bool:
+        alive = []
+        opened = False
+        for ref in self.breakers:
+            brk = ref()
+            if brk is None:
+                continue
+            alive.append(ref)
+            if getattr(brk, "state", "") == "open":
+                opened = True
+        self.breakers = alive
+        return opened
+
+
+class ResidencyLedger:
+    """Process-wide per-physical-chip slot budget + tenant fairness +
+    shed policy. Every DeviceQueue charges it in a second admission
+    phase (after its own scope window), so the per-scope windows become
+    sub-budgets of the chip's physical bound. See the module docstring
+    for the policy; `budget`/`clock` are injectable for tests/bench."""
+
+    def __init__(
+        self,
+        budget: int | None = None,
+        starve_s: float | None = None,
+        shed_after_s: float | None = None,
+        shed_retry_s: float | None = None,
+        tenant_window_s: float | None = None,
+        clock=time.monotonic,
+    ):
+        if budget is None:
+            budget = int(_env_float(
+                "SEAWEED_EC_RESIDENCY_WINDOW", DEFAULT_RESIDENCY_BUDGET
+            ))
+        self.budget = max(1, int(budget))
+        self.starve_s = float(
+            starve_s if starve_s is not None
+            else _env_float("SEAWEED_EC_TENANT_STARVE_S", DEFAULT_STARVE_S)
+        )
+        self.shed_after_s = float(
+            shed_after_s if shed_after_s is not None
+            else _env_float("SEAWEED_EC_SHED_AFTER_S", DEFAULT_SHED_AFTER_S)
+        )
+        self.shed_retry_s = float(
+            shed_retry_s if shed_retry_s is not None
+            else _env_float("SEAWEED_EC_SHED_RETRY_S", DEFAULT_SHED_RETRY_S)
+        )
+        self.tenant_window_s = max(float(
+            tenant_window_s if tenant_window_s is not None
+            else _env_float(
+                "SEAWEED_EC_TENANT_WINDOW_S", DEFAULT_TENANT_WINDOW_S
+            )
+        ), 0.001)
+        self._clock = clock
+        self._cond = threading.Condition()
+        self._chips: dict[str, _ChipState] = {}
+        self._waiters: list[_ResWaiter] = []
+        self._seq = itertools.count()
+        self._last_progress = clock()
+        # Tenant fairness accounting: admitted cost in two rotating
+        # buckets (~2x tenant_window_s of history) — the virtual-time
+        # signal that ranks a storm tenant behind a quiet one.
+        self._tcost_cur: dict[str, float] = {}
+        self._tcost_prev: dict[str, float] = {}
+        self._bucket_start = clock()
+        self._shed_counts: dict[str, int] = {}
+
+    # ------------------------------------------------------------ internals
+
+    def _chip(self, key: str) -> _ChipState:
+        ch = self._chips.get(key)
+        if ch is None:
+            ch = self._chips[key] = _ChipState(key, self.budget)
+            _res_budget_g.set(ch.budget, chip=key)
+        return ch
+
+    def _rotate_buckets(self, now: float) -> None:
+        if now - self._bucket_start >= self.tenant_window_s:
+            if now - self._bucket_start >= 2 * self.tenant_window_s:
+                self._tcost_prev = {}
+            else:
+                self._tcost_prev = self._tcost_cur
+            self._tcost_cur = {}
+            self._bucket_start = now
+
+    def _tenant_cost(self, tenant: str) -> float:
+        return self._tcost_cur.get(tenant, 0.0) + self._tcost_prev.get(
+            tenant, 0.0
+        )
+
+    def _update_pressure(self, now: float) -> None:
+        waiting = set()
+        for w in self._waiters:
+            waiting.update(w.keys)
+        for key, ch in self._chips.items():
+            if ch.inflight >= ch.budget and key in waiting:
+                if ch.over_since is None:
+                    ch.over_since = now
+            else:
+                ch.over_since = None
+
+    def _level(self, ch: _ChipState, now: float) -> int:
+        lvl = 0
+        if ch.over_since is not None:
+            dur = now - ch.over_since
+            if dur >= self.shed_after_s:
+                lvl = 1
+            if dur >= 3 * self.shed_after_s:
+                lvl = 2
+            if dur >= 6 * self.shed_after_s:
+                lvl = 3
+        if ch.breakers and ch.breaker_open():
+            # a breaker-open chip is already degraded to CPU fallback:
+            # escalate one level so background work yields sooner
+            lvl = min(lvl + 1, 3)
+        return lvl
+
+    def _deferred(self, w: _ResWaiter, now: float) -> bool:
+        """Graceful shedding, background first: scrub yields at level
+        1+, recovery at level 2+. Foreground is never deferred here —
+        its relief valve is shed_advice at the front ends. The
+        starvation bound trumps deferral so a background class is
+        slowed, never starved."""
+        if w.priority == "foreground":
+            return False
+        if now - w.t_submit > self.starve_s:
+            return False
+        threshold = 1 if w.priority == "scrub" else 2
+        return any(
+            self._level(self._chip(k), now) >= threshold for k in w.keys
+        )
+
+    def _rank(self, w: _ResWaiter, now: float):
+        starving = 0 if (now - w.t_submit > self.starve_s) else 1
+        return (
+            starving,
+            PRIORITIES.index(w.priority),
+            self._tenant_cost(w.tenant),
+            w.seq,
+        )
+
+    def _fits(self, w: _ResWaiter) -> bool:
+        return all(
+            self._chip(k).inflight < self._chip(k).budget for k in w.keys
+        )
+
+    def _grantable(self, w: _ResWaiter, now: float) -> bool:
+        if not self._fits(w) or self._deferred(w, now):
+            return False
+        # No better-ranked live contender on any shared chip: a wide
+        # mesh waiter spanning this chip blocks a chip-local grant (it
+        # must win eventually — head-of-line by design, so wide streams
+        # cannot be starved by a trickle of single-chip admits).
+        mine = self._rank(w, now)
+        keys = set(w.keys)
+        for other in self._waiters:
+            if other is w or not (keys & set(other.keys)):
+                continue
+            if self._deferred(other, now):
+                continue
+            if self._rank(other, now) < mine:
+                return False
+        return True
+
+    # ------------------------------------------------------------ public
+
+    def acquire(
+        self,
+        keys,
+        tenant: str,
+        priority: str,
+        cost: int,
+        timeout: float = DEFAULT_ADMIT_TIMEOUT,
+    ) -> _ResTicket:
+        """Block until every chip in `keys` has a free slot AND this
+        waiter is first under the fairness policy, then charge one slot
+        per chip. Multi-chip acquire is atomic (one lock). Raises
+        ECError past `timeout` with NO ledger progress anywhere (the
+        same liveness contract as DeviceQueue._admit: merely being
+        bypassed by the policy keeps waiting)."""
+        faults.fire(
+            "ec.residency.acquire", tenant=tenant, priority=priority,
+        )
+        keys = tuple(dict.fromkeys(keys))
+        if not keys:
+            raise ECError("residency acquire with no chip keys")
+        cost = max(int(cost), 1)
+        with self._cond:
+            now = self._clock()
+            self._rotate_buckets(now)
+            w = _ResWaiter(keys, tenant, priority, cost, now, next(self._seq))
+            self._waiters.append(w)
+            try:
+                self._update_pressure(now)
+                while not self._grantable(w, self._clock()):
+                    now = self._clock()
+                    self._update_pressure(now)
+                    deadline = (
+                        max(w.t_submit, self._last_progress) + timeout
+                    )
+                    left = deadline - now
+                    if left <= 0 or not self._cond.wait(
+                        timeout=min(left, 1.0)
+                    ):
+                        now = self._clock()
+                        if self._grantable(w, now):
+                            break
+                        if now - self._last_progress < timeout:
+                            # bypassed (fairness/deferral), not wedged:
+                            # pressure levels and starvation age change
+                            # with TIME, so re-check at least once a
+                            # second even with no release to notify us
+                            continue
+                        raise ECError(
+                            f"residency acquire timed out after "
+                            f"{timeout:.0f}s without progress "
+                            f"(tenant={tenant}, {priority}, "
+                            f"chips={','.join(keys)}): pod wedged?"
+                        )
+            finally:
+                self._waiters.remove(w)
+                # grant or abort, the next waiter may now be eligible
+                self._cond.notify_all()
+            now = self._clock()
+            self._rotate_buckets(now)
+            for k in keys:
+                ch = self._chip(k)
+                ch.inflight += 1
+                ch.inflight_cost += cost
+                ch.max_inflight = max(ch.max_inflight, ch.inflight)
+                ch.max_inflight_cost = max(
+                    ch.max_inflight_cost, ch.inflight_cost
+                )
+                ch.admitted += 1
+                ch.admitted_cost += cost
+                _res_inflight_g.set(ch.inflight, chip=k)
+                _res_admitted.inc(tenant=tenant, chip=k)
+                _res_admitted_cost.inc(cost, tenant=tenant, chip=k)
+            # fairness is denominated in WORK, charged once per batch
+            # (a wide stream does one batch of work, not one per chip)
+            self._tcost_cur[tenant] = (
+                self._tcost_cur.get(tenant, 0.0) + cost
+            )
+            self._last_progress = now
+            self._update_pressure(now)
+            wait_s = max(now - w.t_submit, 0.0)
+            _res_wait_seconds.inc(wait_s, tenant=tenant, chip=keys[0])
+        return _ResTicket(keys, tenant, priority, cost, wait_s)
+
+    def release(self, ticket: _ResTicket) -> None:
+        with self._cond:
+            if ticket.released:
+                return
+            ticket.released = True
+            for k in ticket.keys:
+                ch = self._chip(k)
+                ch.inflight -= 1
+                ch.inflight_cost -= ticket.cost
+                _res_inflight_g.set(ch.inflight, chip=k)
+            now = self._clock()
+            self._last_progress = now
+            self._update_pressure(now)
+            self._cond.notify_all()
+
+    def register_breaker(self, key: str, breaker) -> None:
+        """Attach a chip's fallback breaker so its OPEN state feeds the
+        shed level. Weakly held; duplicates are fine."""
+        if breaker is None:
+            return
+        with self._cond:
+            ch = self._chip(key)
+            if not any(ref() is breaker for ref in ch.breakers):
+                try:
+                    ch.breakers.append(weakref.ref(breaker))
+                except TypeError:
+                    pass  # unweakrefable test double: skip the feed
+
+    def loads(self) -> dict[str, int]:
+        """Per-chip in-flight COST across every scope — the cross-scope
+        live-load signal chip_pool routing adds to each scope's own
+        queue view (the PR 14 carried item)."""
+        with self._cond:
+            return {
+                k: ch.inflight_cost for k, ch in self._chips.items()
+            }
+
+    def shed_level(self) -> int:
+        """Worst per-chip shed level right now (0 = no pressure)."""
+        with self._cond:
+            now = self._clock()
+            self._update_pressure(now)
+            return max(
+                (self._level(ch, now) for ch in self._chips.values()),
+                default=0,
+            )
+
+    def shed_advice(self, tenant: str) -> float | None:
+        """Should the front ends 503 this tenant right now? Returns the
+        Retry-After seconds to send, or None to serve. Only tenants
+        whose windowed admitted-cost share EXCEEDS their fair share are
+        shed (per-tenant, never per-server): the storm pays, the
+        well-behaved tenant keeps serving through the overload."""
+        with self._cond:
+            now = self._clock()
+            self._rotate_buckets(now)
+            self._update_pressure(now)
+            worst = max(
+                (self._level(ch, now) for ch in self._chips.values()),
+                default=0,
+            )
+            if worst < 3:
+                return None
+            mine = self._tenant_cost(tenant)
+            if mine <= 0.0:
+                return None  # no recent device work: not the storm
+            # Fair share is over every tenant CONTENDING — admitted
+            # cost or queued waiters. A storm tenant holding 100% while
+            # the victim is still stuck waiting must read as over-share
+            # even though the victim has no admitted cost yet.
+            tenants = set(self._tcost_cur) | set(self._tcost_prev)
+            tenants.update(w.tenant for w in self._waiters)
+            total = sum(self._tenant_cost(t) for t in tenants)
+            fair = total / max(len(tenants), 1)
+            if mine <= fair * 1.05:  # hysteresis: at-share is served
+                return None
+            self._shed_counts[tenant] = self._shed_counts.get(tenant, 0) + 1
+            _res_shed.inc(tenant=tenant)
+            return self.shed_retry_s
+
+    def snapshot(self) -> dict:
+        """Full observable state: per-chip budget/inflight/watermarks/
+        pressure and per-tenant windowed cost + shed counts. The chaos
+        tests' ground truth and the telemetry/status payload."""
+        with self._cond:
+            now = self._clock()
+            self._rotate_buckets(now)
+            self._update_pressure(now)
+            chips = {}
+            for k, ch in self._chips.items():
+                lvl = self._level(ch, now)
+                _res_pressure_g.set(lvl, chip=k)
+                chips[k] = {
+                    "budget": ch.budget,
+                    "inflight": ch.inflight,
+                    "inflight_cost": ch.inflight_cost,
+                    "max_inflight": ch.max_inflight,
+                    "max_inflight_cost": ch.max_inflight_cost,
+                    "admitted": ch.admitted,
+                    "admitted_cost": ch.admitted_cost,
+                    "pressure": lvl,
+                    "over_s": (
+                        round(now - ch.over_since, 3)
+                        if ch.over_since is not None
+                        else 0.0
+                    ),
+                    "breaker_open": (
+                        ch.breaker_open() if ch.breakers else False
+                    ),
+                }
+            tenants = {
+                t: {
+                    "windowed_cost": round(self._tenant_cost(t), 1),
+                    "shed": self._shed_counts.get(t, 0),
+                }
+                for t in (
+                    set(self._tcost_cur)
+                    | set(self._tcost_prev)
+                    | set(self._shed_counts)
+                )
+            }
+            return {
+                "budget": self.budget,
+                "chips": chips,
+                "tenants": tenants,
+                "waiters": len(self._waiters),
+            }
+
+
+def _residency_keys(backend) -> tuple[str, ...]:
+    """The physical chip identities one batch of `backend` occupies.
+    A (possibly fallback-wrapped) pinned chip is one key; a MESH
+    backend dispatches one batch across EVERY device it spans, so it
+    charges them all — this is exactly how the wide-stream path stops
+    admitting past the per-chip queues. Backends with no device
+    identity (pure NumPy) get their synthetic queue label: a private
+    chip nobody else can collide with."""
+    label = getattr(backend, "chip_label", "") or getattr(
+        getattr(backend, "primary", None), "chip_label", ""
+    )
+    if label:
+        return (label,)
+    for obj in (backend, getattr(backend, "primary", None)):
+        mesh_rs = getattr(obj, "_mesh_rs", None)
+        if mesh_rs is None:
+            continue
+        labels = getattr(mesh_rs, "device_labels", None)
+        if callable(labels):
+            try:
+                keys = tuple(labels())
+            except Exception:
+                keys = ()
+            if keys:
+                return keys
+    return (_queue_label(backend),)
+
+
+_residency_lock = threading.Lock()
+_residency_default: "ResidencyLedger | None" = None
+_residency_init = False
+
+
+def default_residency() -> ResidencyLedger | None:
+    """The process-wide ledger (lazily built from the SEAWEED_EC_*
+    knobs), or None when SEAWEED_EC_RESIDENCY_WINDOW=0 disabled it."""
+    global _residency_default, _residency_init
+    with _residency_lock:
+        if not _residency_init:
+            budget = int(_env_float(
+                "SEAWEED_EC_RESIDENCY_WINDOW", DEFAULT_RESIDENCY_BUDGET
+            ))
+            _residency_default = (
+                ResidencyLedger(budget=budget) if budget > 0 else None
+            )
+            _residency_init = True
+        return _residency_default
+
+
+def shed_advice(tenant: str) -> float | None:
+    """Front-end hook: Retry-After seconds if `tenant` should be shed
+    under current pod pressure, else None. Never raises."""
+    try:
+        led = default_residency()
+        return led.shed_advice(tenant) if led is not None else None
+    except Exception:
+        return None
+
+
+def shed_level() -> int:
+    """Worst chip shed level of the process ledger (0 when off/idle) —
+    background daemons (e.g. the MQ parity flusher) stretch their
+    cadence by this."""
+    try:
+        led = default_residency()
+        return led.shed_level() if led is not None else 0
+    except Exception:
+        return 0
+
+
+def residency_snapshot() -> dict:
+    """The process ledger's snapshot() for /status, heartbeats and
+    /debug/gateway; {} when the ledger is disabled."""
+    try:
+        led = default_residency()
+        return led.snapshot() if led is not None else {}
+    except Exception:
+        return {}
 
 
 # --------------------------------------------------------------------------
@@ -540,7 +1223,15 @@ class QueueScope:
     Queues are per (scope, backend): two scopes sharing a chip each get
     their own admission policy — the multi-tenant contract is isolation
     of CONFIG, while the physical chip pool (ec/chip_pool.py) stays
-    process-wide so placement still sees total chip load."""
+    process-wide so placement still sees total chip load.
+
+    `tenant` names this scope's fairness/shed accounting domain on the
+    shared ResidencyLedger (default "default": unnamed scopes pool
+    their accounting, named Stores get per-tenant QoS). `residency`
+    selects the physical ledger the scope's queues charge: None = the
+    process-wide default (env-gated), False = no physical ledger (the
+    pre-PR 16 logical-window-only behavior), or an injected
+    ResidencyLedger (tests/bench)."""
 
     def __init__(
         self,
@@ -548,7 +1239,11 @@ class QueueScope:
         window: int = DEFAULT_WINDOW,
         shares: dict[str, float] | None = None,
         placement: str = DEFAULT_PLACEMENT,
+        tenant: str | None = None,
+        residency: "ResidencyLedger | None | bool" = None,
     ):
+        self.tenant = tenant or "default"
+        self._residency_cfg = residency
         self._lock = threading.Lock()
         self._queues: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
         self._config: dict = {
@@ -632,6 +1327,15 @@ class QueueScope:
         with self._lock:
             return self._config["placement"]
 
+    def residency(self) -> "ResidencyLedger | None":
+        """This scope's physical ledger (None = logical windows only)."""
+        cfg = self._residency_cfg
+        if cfg is False:
+            return None
+        if cfg is None:
+            return default_residency()
+        return cfg
+
     def for_backend(self, backend) -> DeviceQueue | None:
         """The shared queue for `backend`'s chip under this scope, or
         None when the scheduler is disabled (or there is no backend —
@@ -643,12 +1347,24 @@ class QueueScope:
                 return None
             q = self._queues.get(backend)
             if q is None:
+                ledger = self.residency()
+                keys = _residency_keys(backend)
                 q = DeviceQueue(
                     window=self._config["window"],
                     shares=self._config["shares"],
                     label=_queue_label(backend),
+                    residency=ledger,
+                    res_keys=keys,
+                    tenant=self.tenant,
                 )
                 self._queues[backend] = q
+                if ledger is not None:
+                    # breaker-state feed for the shed policy: a pinned
+                    # chip's fallback breaker flapping open escalates
+                    # that chip's pressure level
+                    brk = getattr(backend, "breaker", None)
+                    if brk is not None and len(keys) == 1:
+                        ledger.register_breaker(keys[0], brk)
             return q
 
     def stats_snapshot(self) -> list[dict]:
@@ -683,13 +1399,23 @@ class QueueScope:
                 (getattr(b, "breaker", None), q)
                 for b, q in self._queues.items()
             ]
-        return {
-            q.label: {
-                "load": q.load(),
+        out = {}
+        for brk, q in items:
+            with q._cond:
+                load, infl = q._pending_cost, q._inflight_cost
+            out[q.label] = {
+                "load": load,
+                "inflight_cost": infl,
                 "breaker": brk.state if brk is not None else "",
             }
-            for brk, q in items
-        }
+        return out
+
+    def residency_loads(self) -> dict[str, int]:
+        """Per-chip in-flight cost on this scope's PHYSICAL ledger —
+        all scopes combined ({} when the ledger is off). chip_pool adds
+        the cross-scope share of this on top of queue_loads()."""
+        ledger = self.residency()
+        return ledger.loads() if ledger is not None else {}
 
 
 _DEFAULT_SCOPE = QueueScope()

@@ -10,6 +10,7 @@ shards are on local disk.
 
 from __future__ import annotations
 
+import json
 import os
 import struct
 import threading
@@ -191,6 +192,24 @@ class EcVolume:
         # moving a reconstructing volume toward chips is exactly what
         # data gravity exists for.
         self.bytes_reconstructed = 0
+        # Heat counters survive a clean restart: without the sidecar a
+        # restart resets them to zero, the master's per-sweep delta
+        # logic sees a counter regression, and the first post-restart
+        # window is clamped to zero (worker/control.py) — a whole
+        # gravity sweep of real heat lost per restart. The sidecar is
+        # generation-fenced on encode_ts_ns so counters from a volume
+        # that was re-encoded (same id, new data) are never resurrected.
+        self._heat_path = self.base + ".heat"
+        try:
+            with open(self._heat_path, encoding="utf-8") as f:
+                blob = json.load(f)
+            if blob.get("gen") == self.encode_ts_ns:
+                self.bytes_read = int(blob.get("read_bytes", 0))
+                self.bytes_reconstructed = int(
+                    blob.get("reconstructed_bytes", 0)
+                )
+        except (OSError, ValueError):  # absent/corrupt: start cold
+            pass
 
     # ------------------------------------------------------------- lookup
 
@@ -689,8 +708,29 @@ class EcVolume:
                     os.close(fd)
             return len(self.shard_fds)
 
+    def _save_heat(self) -> None:
+        """Persist the heat counters beside the volume (atomic tmp +
+        rename, best-effort): a clean unmount/restart then resumes the
+        monotonic counter stream instead of resetting to zero and
+        blanking the master's first post-restart gravity window."""
+        try:
+            tmp = self._heat_path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(
+                    {
+                        "gen": self.encode_ts_ns,
+                        "read_bytes": int(self.bytes_read),
+                        "reconstructed_bytes": int(self.bytes_reconstructed),
+                    },
+                    f,
+                )
+            os.replace(tmp, self._heat_path)
+        except OSError:  # advisory; never fail a close over heat
+            pass
+
     def close(self) -> None:
         with self._lock:
+            self._save_heat()
             for fd in self.shard_fds.values():
                 os.close(fd)
             self.shard_fds.clear()

@@ -504,8 +504,23 @@ class ParityFlusher(threading.Thread):
         self._stop.set()
 
     def run(self) -> None:
-        while not self._stop.wait(self.interval):
+        while not self._stop.wait(self._next_interval()):
             try:
                 self.broker.parity_sweep()
             except Exception as e:  # noqa: BLE001 — never kill the broker
                 log.warning("parity sweep failed: %r", e)
+
+    def _next_interval(self) -> float:
+        """Graceful-shed hook: under sustained device oversubscription
+        (residency shed level > 0) the flusher stretches its cadence —
+        stream-parity flush is BACKGROUND device work and must throttle
+        before any foreground admission is shed. Bounded stretch: the
+        lag deadline still holds eventually, it just stops compounding
+        an overload."""
+        try:
+            from ..ec.device_queue import shed_level
+
+            lvl = shed_level()
+        except Exception:  # the shed signal must never stall flushing
+            lvl = 0
+        return self.interval * (1 + lvl) if lvl > 0 else self.interval

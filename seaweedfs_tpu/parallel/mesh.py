@@ -107,6 +107,14 @@ class MeshRS:
         self.rs = rs
         self.mesh = mesh
         self.n_devices = mesh.devices.size
+        # Physical identity of every chip one wide batch occupies, in
+        # the same "<platform>:<id>" form chip_pool labels per-chip
+        # backends with: the residency ledger (ec/device_queue.py)
+        # charges a mesh-wide stream one slot on EACH of these, so a
+        # wide stream can no longer admit past the per-chip budgets.
+        self._device_labels = tuple(
+            f"{d.platform}:{d.id}" for d in np.ravel(mesh.devices)
+        )
         # jitted shard_map applies, keyed by (m_out, k): the decode
         # coefficient SHAPE is stable per shard-loss set, so each key
         # compiles once and the bit-matrix rides in as a replicated arg.
@@ -152,6 +160,11 @@ class MeshRS:
                     out_specs=P(None, BLOCK_AXIS),
                 )
             )
+
+    def device_labels(self) -> tuple[str, ...]:
+        """Per-chip "<platform>:<id>" labels this mesh spans (residency
+        charging keys — see ec/device_queue._residency_keys)."""
+        return self._device_labels
 
     def put(self, data: np.ndarray):
         """H2D with column sharding (async). Caller pads columns to a

@@ -89,6 +89,22 @@ def _saturation_error_doc() -> tuple[str, bytes]:
     return "application/xml", _xml(root)
 
 
+def _shed_error_doc(tenant: str) -> tuple[str, bytes]:
+    """503 body for per-tenant residency shedding: same SlowDown code
+    SDKs already back off on, but the message says WHY this tenant
+    (and not the server) is being told to slow down."""
+    root = ET.Element("Error")
+    _el(root, "Code", "SlowDown")
+    _el(
+        root,
+        "Message",
+        f"tenant {tenant!r} exceeds its fair device share during pod "
+        "overload; reduce your request rate and retry",
+    )
+    _el(root, "Resource", "/")
+    return "application/xml", _xml(root)
+
+
 class S3Server:
     def __init__(
         self,
@@ -104,6 +120,7 @@ class S3Server:
         ldap=None,
         http_workers: int = 32,
         http_queue: int = 128,
+        tenant: str = "default",
     ):
         """`http_workers`/`http_queue`: the bounded worker-pool front
         end (utils/http_pool.py) — `http_workers` request workers plus
@@ -111,7 +128,15 @@ class S3Server:
         get an immediate 503 SlowDown XML error document with
         Retry-After. `http_workers=0` restores the unbounded
         one-thread-per-connection stdlib server (also used when `tls`
-        is configured)."""
+        is configured).
+
+        `tenant` names this gateway's accounting domain on the EC
+        residency ledger: when the pod is in sustained device
+        oversubscription AND this tenant's device usage exceeds its
+        fair share, object data-plane requests get an early 503
+        SlowDown + Retry-After (per-tenant shedding — a well-behaved
+        tenant on the same pod keeps serving)."""
+        self.tenant = tenant
         self.filer = filer
         self.ip = ip
         self.port = port
@@ -197,6 +222,14 @@ class S3Server:
                 self.lifecycle.run_once()
             except Exception:
                 pass
+
+    def _shed_retry_after(self) -> float | None:
+        """Retry-After seconds when the residency shed policy wants
+        THIS tenant backed off right now, else None. Never raises —
+        overload safety must not add a failure mode to serving."""
+        from ..ec.device_queue import shed_advice
+
+        return shed_advice(self.tenant)
 
     # ------------------------------------------------------------ handler
 
@@ -422,6 +455,25 @@ class S3Server:
                         # every response (incl. errors and writes) needs
                         # the allow-origin header or browsers block it
                         self._cors = self._cors_response_headers(bucket)
+                    if key and m in ("GET", "HEAD", "PUT", "POST", "DELETE"):
+                        # Per-tenant graceful shedding: when the EC
+                        # residency ledger says THIS gateway's tenant
+                        # is over its fair device share during pod
+                        # overload, the object data plane backs off
+                        # here — before auth, before any device work —
+                        # with the same SlowDown+Retry-After contract
+                        # the saturated accept path already speaks.
+                        # Bucket/control ops stay up so operators can
+                        # still inspect and reconfigure mid-storm.
+                        ra = srv._shed_retry_after()
+                        if ra is not None:
+                            ctype, body = _shed_error_doc(srv.tenant)
+                            return self._respond(
+                                503,
+                                body,
+                                ctype=ctype,
+                                extra={"Retry-After": str(max(1, int(ra)))},
+                            )
                     if (
                         m == "POST"
                         and bucket

@@ -43,6 +43,18 @@ def _ec_stream_summary() -> dict:
         return {}
 
 
+def _ec_residency_summary() -> dict:
+    """Chip residency-ledger roll-up for /cluster/status (per-chip
+    budget/inflight/watermarks + per-tenant shed counters). Lazy and
+    failure-tolerant for the same reason as _ec_stream_summary."""
+    try:
+        from ..ec.device_queue import residency_snapshot
+
+        return residency_snapshot()
+    except Exception:  # noqa: BLE001
+        return {}
+
+
 class MasterService:
     """gRPC servicer (method-per-RPC, see pb/rpc.py)."""
 
@@ -768,6 +780,18 @@ class MasterServer:
                             # process (combined deployments / tests)
                             # with live parity lag + lifetime counters
                             "EcStreams": _ec_stream_summary(),
+                            # multi-tenant overload safety: the local
+                            # chip residency ledger (combined deploys)
+                            # plus each volume server's ledger snapshot
+                            # as it rode in on the heartbeat telemetry
+                            "EcResidency": {
+                                "local": _ec_residency_summary(),
+                                "nodes": {
+                                    nid: blob.get("residency")
+                                    for nid, blob in tele.items()
+                                    if blob.get("residency")
+                                },
+                            },
                         },
                     )
                 else:
@@ -872,6 +896,7 @@ class MasterServer:
             "lifecycle_filer": self.lifecycle_filer,
             "ec_balance_interval_seconds": self.ec_balance_interval,
             "ec_scrub_interval_seconds": self.ec_scrub_interval,
+            "ec_rebalance_interval_seconds": self.ec_rebalance_interval,
         }
 
     def _apply_maintenance_config(self, cfg: dict) -> None:
@@ -892,6 +917,7 @@ class MasterServer:
             "lifecycle_interval_seconds",
             "ec_balance_interval_seconds",
             "ec_scrub_interval_seconds",
+            "ec_rebalance_interval_seconds",
         ):
             if not math.isfinite(cfg.get(key, 0.0)):
                 raise ValueError(f"{key} must be finite, got {cfg.get(key)}")
@@ -914,15 +940,17 @@ class MasterServer:
         lc_interval = cfg.get("lifecycle_interval_seconds", 0.0)
         ecb_interval = cfg.get("ec_balance_interval_seconds", 0.0)
         scrub_interval = cfg.get("ec_scrub_interval_seconds", 0.0)
+        rebal_interval = cfg.get("ec_rebalance_interval_seconds", 0.0)
         if (
             spread < 0 or lc_interval < 0 or ecb_interval < 0
-            or scrub_interval < 0
+            or scrub_interval < 0 or rebal_interval < 0
         ):
             raise ValueError(
                 "balance_spread, lifecycle_interval_seconds, "
-                "ec_balance_interval_seconds and ec_scrub_interval_seconds "
+                "ec_balance_interval_seconds, ec_scrub_interval_seconds "
+                "and ec_rebalance_interval_seconds "
                 f"must be >=0 (got {spread}, {lc_interval}, "
-                f"{ecb_interval}, {scrub_interval})"
+                f"{ecb_interval}, {scrub_interval}, {rebal_interval})"
             )
         self.ec_auto_fullness = full
         self.ec_quiet_seconds = quiet
@@ -935,6 +963,9 @@ class MasterServer:
         # the scrub scanner re-reads this every vacuum tick, so a live
         # update takes effect without restart (0 turns fleet scrub off)
         self.ec_scrub_interval = scrub_interval
+        # gravity/heat rebalance cadence — same live-reload contract as
+        # scrub above (0 disables the heat-driven migration scanner)
+        self.ec_rebalance_interval = rebal_interval
 
     # ----------------------------------------------------------- vacuum
 

@@ -600,7 +600,7 @@ class VolumeService:
                 os.path.exists(base + f".ec{i:02d}")
                 for i in range(ec_context.MAX_SHARD_COUNT)
             ):
-                for ext in (".ecx", ".ecj", ".ecsum"):
+                for ext in (".ecx", ".ecj", ".ecsum", ".heat"):
                     if os.path.exists(base + ext):
                         os.unlink(base + ext)
         self.server.notify_deleted_ec_shards(
@@ -1971,11 +1971,18 @@ class VolumeServer:
                     }
         except Exception:  # heat is advisory; never break the heartbeat
             ec_volumes = {}
+        try:
+            from ..ec.device_queue import residency_snapshot
+
+            residency = residency_snapshot()
+        except Exception:  # advisory; never break the heartbeat
+            residency = {}
         return json.dumps(
             {
                 "chips": chips,
                 "breakers_open": breakers_open,
                 "degraded": breakers_open > 0,
+                "residency": residency,
                 "stage_ewma_s": {
                     k: round(v, 6) for k, v in trace.stage_ewmas().items()
                 },
@@ -2229,8 +2236,42 @@ class VolumeServer:
                         st["ec_streams"] = stream_summary()
                     except Exception:  # noqa: BLE001
                         pass
+                    try:
+                        from ..ec.device_queue import residency_snapshot
+
+                        # process-wide per-chip residency ledger:
+                        # budget/inflight/high-watermarks + per-tenant
+                        # shed counters (multi-tenant overload safety)
+                        st["ec_residency"] = residency_snapshot()
+                    except Exception:  # noqa: BLE001
+                        pass
                     body = json.dumps(st).encode()
                     self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                try:
+                    # Per-tenant shedding on the needle data plane:
+                    # reads against an over-share tenant's scope back
+                    # off before any parse/lookup work when the
+                    # residency ledger is at full shed (level 3) —
+                    # the HTTP analogue of the S3 gateway's SlowDown,
+                    # so direct volume readers see backpressure too.
+                    from ..ec.device_queue import shed_advice
+
+                    ra = shed_advice(
+                        getattr(server.store.ec_scheduler, "tenant", "default")
+                    )
+                except Exception:  # shed is advisory; never block reads
+                    ra = None
+                if ra is not None:
+                    body = json.dumps(
+                        {"error": "tenant over fair device share"}
+                    ).encode()
+                    self.send_response(503)
+                    self.send_header("Retry-After", str(max(1, int(ra))))
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()

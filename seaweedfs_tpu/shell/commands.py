@@ -3150,6 +3150,9 @@ def maintenance_config(env: ShellEnv, args) -> str:
             "lifecycle_filer": cfg.lifecycle_filer,
             "ec_balance_interval_seconds": cfg.ec_balance_interval_seconds,
             "ec_scrub_interval_seconds": cfg.ec_scrub_interval_seconds,
+            "ec_rebalance_interval_seconds": (
+                cfg.ec_rebalance_interval_seconds
+            ),
         }
     )
 

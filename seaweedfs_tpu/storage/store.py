@@ -119,6 +119,7 @@ class Store:
         ec_queue_shares: dict | None = None,
         ec_placement: str | None = None,
         ec_scheduler: "QueueScope | None" = None,
+        ec_tenant: str | None = None,
     ):
         self.ip = ip
         self.port = port
@@ -132,14 +133,18 @@ class Store:
         # Stores no longer has configure() last-caller-wins — each
         # tenant's knobs live in its own scope. All knobs None (and no
         # explicit scope) = the process-wide default scope, so a bare
-        # Store keeps today's behavior.
+        # Store keeps today's behavior. `ec_tenant` names the scope's
+        # fairness/shed accounting domain on the shared residency
+        # ledger: config isolation stays per scope, while the PHYSICAL
+        # per-chip budget spans every tenant (ec/device_queue.py
+        # ResidencyLedger).
         if ec_scheduler is not None:
             self.ec_scheduler = ec_scheduler
         elif any(
             v is not None
             for v in (
                 ec_device_queue, ec_queue_window, ec_queue_shares,
-                ec_placement,
+                ec_placement, ec_tenant,
             )
         ):
             from ..ec.device_queue import DEFAULT_WINDOW
@@ -152,6 +157,7 @@ class Store:
                 ),
                 shares=ec_queue_shares,
                 placement=ec_placement or "auto",
+                tenant=ec_tenant,
             )
         else:
             self.ec_scheduler = default_scope()

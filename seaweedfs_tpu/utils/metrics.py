@@ -288,6 +288,14 @@ def gateway_summary() -> dict:
     ):
         for (tier,), v in counter.snapshot().items():
             hot.setdefault(tier, {})[kind] = int(v)
+    try:
+        # chip residency ledger (budget/inflight/shed per tenant) —
+        # lazy import: metrics must not pull the EC package at startup
+        from ..ec.device_queue import residency_snapshot
+
+        residency = residency_snapshot()
+    except Exception:  # advisory; the debug page must never 500
+        residency = {}
     return {
         "hot_cache": hot,
         "inflight": {
@@ -297,6 +305,7 @@ def gateway_summary() -> dict:
             srv: int(v)
             for (srv,), v in gateway_rejected_total.snapshot().items()
         },
+        "residency": residency,
     }
 
 

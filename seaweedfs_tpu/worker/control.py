@@ -504,6 +504,7 @@ class WorkerControl:
                 "lifecycle_filer",
                 "ec_balance_interval_seconds",
                 "ec_scrub_interval_seconds",
+                "ec_rebalance_interval_seconds",
             ):
                 if request.HasField(key):
                     cfg[key] = getattr(request, key)
@@ -758,8 +759,15 @@ class WorkerControl:
                     self._heat_prev[(nid, vid)] = total
                     if prev is None:
                         continue  # first sighting: no window yet
-                    # counter reset (restart) reads as the full value
-                    deltas[vid] = total - prev if total >= prev else total
+                    # counter reset (volume-server restart without
+                    # persisted heat): re-baseline with a ZERO window
+                    # instead of crediting the full lifetime value —
+                    # one restart must not read as a sudden hot spot
+                    # and trigger spurious migrations. Servers that DO
+                    # persist heat across restart (ec_volume .heat
+                    # sidecar) never hit this branch: their counters
+                    # resume monotonically.
+                    deltas[vid] = total - prev if total >= prev else 0
                 if deltas:
                     heat[nid] = deltas
             # evict state for (node, vid) pairs that left the topology

@@ -1,0 +1,69 @@
+"""Fault-point registry lint: the README's canonical fault-point table
+and the `ec.*` / `mq.*` point literals in the code must agree exactly,
+in both directions. A new seam can't ship undocumented; a renamed or
+deleted point can't leave a stale README row behind."""
+
+import pathlib
+import re
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# fire()/inject()/injected()/mutate() all take the point literal as
+# their first argument; the literal may start on the next line (black
+# wraps long calls), so the regex tolerates one newline after the
+# paren. Only ec.* / mq.* namespaces are governed by the registry —
+# local test-only namespaces (e.g. "storage.*") are out of scope.
+POINT_RE = re.compile(
+    r'(?:fire|inject|injected|mutate)\(\s*\n?\s*"((?:ec|mq)\.[a-z0-9_.]+)"'
+)
+
+ROW_RE = re.compile(r"^\|\s*`((?:ec|mq)\.[a-z0-9_.]+)`\s*\|", re.MULTILINE)
+
+
+def _code_points() -> set[str]:
+    pts: set[str] = set()
+    for root in ("seaweedfs_tpu", "tests"):
+        for f in (REPO / root).rglob("*.py"):
+            pts |= set(POINT_RE.findall(f.read_text(encoding="utf-8")))
+    return pts
+
+
+def _readme_points() -> set[str]:
+    return set(ROW_RE.findall((REPO / "README.md").read_text("utf-8")))
+
+
+def test_every_code_fault_point_is_documented():
+    code, readme = _code_points(), _readme_points()
+    missing = code - readme
+    assert not missing, (
+        "fault points used in code but absent from the README "
+        f"fault-point registry table: {sorted(missing)}"
+    )
+
+
+def test_every_documented_fault_point_exists_in_code():
+    code, readme = _code_points(), _readme_points()
+    stale = readme - code
+    assert not stale, (
+        "README fault-point registry rows with no matching point in "
+        f"code (renamed or removed?): {sorted(stale)}"
+    )
+
+
+def test_registry_is_not_vacuous():
+    """Guard the lint itself: if the regexes rot, both sets go empty
+    and the equality tests pass trivially. Pin a floor and known
+    points, including multi-line call sites."""
+    code = _code_points()
+    assert len(code) >= 30, sorted(code)
+    # ec.residency.acquire's fire() call spans lines — a single-line
+    # regex would drop it silently
+    for required in (
+        "ec.residency.acquire",
+        "ec.encode.before_fsync",
+        "ec.scrub.read_block",
+        "ec.stream.seal",
+        "ec.volume.shard_read",
+    ):
+        assert required in code, required
+    assert _readme_points() == code

@@ -1,0 +1,557 @@
+"""Multi-tenant overload safety (PR 16): the process-wide per-chip
+residency ledger, tenant fairness, and graceful shedding.
+
+Load-bearing properties:
+
+- residency invariant: N scopes sharing one physical chip never hold
+  more concurrent device slots than the chip budget — proven from the
+  ledger's own high-watermark ground truth AND an independent
+  occupancy counter, including under the chaos matrix (breaker flap,
+  scope churn, armed fault points);
+- wide streams charge every chip: a mesh backend's batch holds a slot
+  on EACH device it spans, atomically;
+- fairness: deficit-weighted ranking bounds the well-behaved tenant's
+  wait under a storm, and the starvation bound guarantees background
+  classes are slowed, never starved;
+- graceful shedding: background defers first (scrub before recovery),
+  foreground is never deferred at the ledger, and shed_advice names
+  ONLY the over-share tenant (per-tenant, not per-server) — with open
+  breakers escalating the shed level;
+- front-end propagation: the S3 gateway turns shed advice into the
+  SlowDown + Retry-After contract before auth;
+- heat persistence: per-volume heat counters survive a clean restart
+  behind a generation fence (the PR 15 carried item).
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu import faults
+from seaweedfs_tpu.ec import EcVolume, ECContext, ec_encode_volume
+from seaweedfs_tpu.ec.device_queue import (
+    DEFAULT_WINDOW,
+    QueueScope,
+    ResidencyLedger,
+    _residency_keys,
+)
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume
+
+
+class FakeChip:
+    """Pinned-backend stand-in: instances sharing a label share one
+    physical residency key, exactly like two scopes' queues on one
+    pooled chip."""
+
+    def __init__(self, label="chip:0", breaker=None):
+        self.chip_label = label
+        if breaker is not None:
+            self.breaker = breaker
+
+
+class FakeBreaker:
+    def __init__(self, state="closed"):
+        self.state = state
+
+
+class FakeMeshRS:
+    def __init__(self, labels):
+        self._labels = tuple(labels)
+
+    def device_labels(self):
+        return self._labels
+
+
+class FakeMeshBackend:
+    """Mesh-backend stand-in: no chip_label, a _mesh_rs spanning many
+    devices — _residency_keys must charge them all."""
+
+    chip_label = ""
+
+    def __init__(self, labels):
+        self._mesh_rs = FakeMeshRS(labels)
+
+
+def _storm(scopes, stop, device_work, errors):
+    """One storm worker: dispatch foreground batches on a fresh queue
+    under each scope until stopped."""
+    for scope in scopes:
+        if stop.is_set():
+            break
+        q = scope.for_backend(FakeChip())
+        s = q.stream("foreground")
+        try:
+            while not stop.is_set():
+                try:
+                    t, _ = s.dispatch(device_work, 1)
+                except faults.InjectedIOError:
+                    continue  # armed chaos fault: retry like a caller
+                s.release(t)
+        except Exception as e:  # pragma: no cover - surfaced by assert
+            errors.append(e)
+        finally:
+            s.close()
+
+
+# ------------------------------------------------------------ invariant
+
+
+def test_two_scopes_one_chip_respect_shared_budget():
+    """The tentpole contract: per-scope windows become SUB-budgets —
+    two scopes with window 4 each on one chip never exceed the chip's
+    physical budget of 2, proven by the ledger watermark and an
+    independent occupancy counter."""
+    ledger = ResidencyLedger(budget=2)
+    occ = {"now": 0, "peak": 0}
+    occ_lock = threading.Lock()
+
+    def device_work():
+        with occ_lock:
+            occ["now"] += 1
+            occ["peak"] = max(occ["peak"], occ["now"])
+        time.sleep(0.002)
+        with occ_lock:
+            occ["now"] -= 1
+
+    scopes = [
+        QueueScope(window=4, tenant=t, residency=ledger)
+        for t in ("a", "b")
+    ]
+    streams = []
+    for scope in scopes:
+        q = scope.for_backend(FakeChip())
+        assert q.res_keys == ("chip:0",)
+        streams.append(q.stream("foreground"))
+    threads = []
+    for s in streams:
+        def run(s=s):
+            for _ in range(15):
+                t, _ = s.dispatch(device_work, 1)
+                s.release(t)
+        for _ in range(4):
+            th = threading.Thread(target=run)
+            th.start()
+            threads.append(th)
+    for th in threads:
+        th.join(timeout=30)
+    for s in streams:
+        s.close()
+    snap = ledger.snapshot()
+    chip = snap["chips"]["chip:0"]
+    assert chip["max_inflight"] <= 2, snap
+    assert occ["peak"] <= 2, occ
+    assert chip["inflight"] == 0 and snap["waiters"] == 0  # no leak
+    assert chip["admitted"] == 2 * 4 * 15
+    assert set(snap["tenants"]) >= {"a", "b"}
+
+
+def test_mesh_backend_charges_every_chip_atomically():
+    """A wide (mesh) stream's batch holds a slot on EVERY chip it
+    spans: it cannot admit while any spanned chip is full, and while
+    in flight it counts against each chip's budget."""
+    ledger = ResidencyLedger(budget=1)
+    scope = QueueScope(window=4, tenant="wide", residency=ledger)
+    mesh = FakeMeshBackend(["c0", "c1"])
+    assert _residency_keys(mesh) == ("c0", "c1")
+    q = scope.for_backend(mesh)
+    assert q.res_keys == ("c0", "c1")
+
+    # pin c1: the mesh admit must block even though c0 is free
+    pin = ledger.acquire(("c1",), "other", "foreground", 1)
+    s = q.stream("foreground")
+    admitted = threading.Event()
+    holder = {}
+
+    def wide():
+        t, _ = s.dispatch(lambda: None, 3)
+        holder["t"] = t
+        admitted.set()
+
+    th = threading.Thread(target=wide, daemon=True)
+    th.start()
+    assert not admitted.wait(timeout=0.3), "admitted past a full chip"
+    ledger.release(pin)
+    assert admitted.wait(timeout=10), "mesh admit never granted"
+    loads = ledger.loads()
+    assert loads["c0"] == 3 and loads["c1"] == 3  # charged on BOTH
+    s.release(holder["t"])
+    th.join(timeout=5)
+    assert all(v == 0 for v in ledger.loads().values())
+    s.close()
+
+
+@pytest.mark.parametrize("seed", [0x16A, 0x16B, 0x16C])
+def test_property_seeded_arrivals_budget_and_no_starvation(seed):
+    """Property over seeded multi-tenant arrival orders: for random
+    tenants/priorities/costs/chips, (1) per-chip in-flight never
+    exceeds the budget and (2) no tenant starves — every tenant's
+    batches all complete, none waiting past the fairness bound."""
+    rng = np.random.default_rng(seed)
+    budget = int(rng.integers(1, 4))
+    ledger = ResidencyLedger(budget=budget, starve_s=5.0)
+    tenants = [f"t{i}" for i in range(int(rng.integers(2, 5)))]
+    chips = [f"chip:{i}" for i in range(int(rng.integers(1, 3)))]
+    priorities = ["foreground", "recovery", "scrub"]
+    scopes = {
+        t: QueueScope(window=DEFAULT_WINDOW, tenant=t, residency=ledger)
+        for t in tenants
+    }
+    ops = [
+        (
+            tenants[int(rng.integers(len(tenants)))],
+            chips[int(rng.integers(len(chips)))],
+            priorities[int(rng.integers(len(priorities)))],
+            int(rng.integers(1, 50)),
+        )
+        for _ in range(60)
+    ]
+    waits: dict[str, list[float]] = {t: [] for t in tenants}
+    waits_lock = threading.Lock()
+    errors: list[Exception] = []
+
+    def run_op(tenant, chip, priority, cost):
+        try:
+            q = scopes[tenant].for_backend(FakeChip(chip))
+            s = q.stream(priority)
+            try:
+                t0 = time.perf_counter()
+                t, _ = s.dispatch(lambda: time.sleep(0.001), cost)
+                with waits_lock:
+                    waits[tenant].append(time.perf_counter() - t0)
+                s.release(t)
+            finally:
+                s.close()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = []
+    for op in ops:
+        th = threading.Thread(target=run_op, args=op)
+        th.start()
+        threads.append(th)
+        if rng.random() < 0.3:
+            time.sleep(0.001)  # jittered arrival order
+    for th in threads:
+        th.join(timeout=60)
+    assert not errors, errors
+    snap = ledger.snapshot()
+    for chip, st in snap["chips"].items():
+        assert st["max_inflight"] <= budget, (chip, st)
+        assert st["inflight"] == 0, (chip, st)  # no leak
+    done = {t for t, ws in waits.items() if ws}
+    submitted = {t for t, _c, _p, _cost in ops}
+    assert done == submitted  # every tenant's work completed
+    worst = max(w for ws in waits.values() for w in ws)
+    # the starvation bound (plus scheduling slack) caps every wait
+    assert worst < ledger.starve_s + 10.0, worst
+
+
+# ------------------------------------------------------------- shedding
+
+
+def test_background_defers_before_foreground_and_never_starves():
+    """Graceful shedding order: at shed level 1+ a scrub waiter yields
+    the freed slot to a LATER foreground waiter; the starvation bound
+    then gets scrub in anyway."""
+    ledger = ResidencyLedger(
+        budget=1, shed_after_s=0.05, starve_s=1.0, tenant_window_s=10.0
+    )
+    hold = ledger.acquire(("c0",), "fg", "foreground", 1)
+    got: list[str] = []
+    lock = threading.Lock()
+
+    def take(priority, tag):
+        t = ledger.acquire(("c0",), tag, priority, 1, timeout=30.0)
+        with lock:
+            got.append(tag)
+        ledger.release(t)
+
+    scrub_th = threading.Thread(target=take, args=("scrub", "scrub"))
+    scrub_th.start()
+    time.sleep(0.2)  # chip full + waiter: level reaches 1 (scrub defers)
+    assert ledger.shed_level() >= 1
+    fg_th = threading.Thread(target=take, args=("foreground", "fg2"))
+    fg_th.start()
+    time.sleep(0.05)
+    ledger.release(hold)
+    fg_th.join(timeout=10)
+    scrub_th.join(timeout=10)
+    # foreground (arrived later) got the slot first; scrub still ran
+    assert got == ["fg2", "scrub"], got
+
+
+def test_open_breaker_escalates_and_starvation_bound_escapes():
+    """A chip whose fallback breaker is OPEN is already degraded:
+    background admission defers there even with free slots, until the
+    starvation bound lets it through; foreground is untouched."""
+    ledger = ResidencyLedger(budget=4, starve_s=0.15)
+    brk = FakeBreaker("open")
+    ledger.register_breaker("c0", brk)
+    t_fg = ledger.acquire(("c0",), "t", "foreground", 1)
+    assert t_fg.wait_s < 0.1  # foreground admits immediately
+    ledger.release(t_fg)
+    t0 = time.perf_counter()
+    t_scrub = ledger.acquire(("c0",), "t", "scrub", 1, timeout=30.0)
+    waited = time.perf_counter() - t0
+    ledger.release(t_scrub)
+    # deferred by the open breaker, released by the starvation bound
+    assert 0.1 <= waited < 5.0, waited
+    brk.state = "closed"
+    t2 = ledger.acquire(("c0",), "t", "scrub", 1)
+    assert t2.wait_s < 0.1  # breaker closed: no deferral
+    ledger.release(t2)
+
+
+def test_shed_advice_names_only_the_overshare_tenant():
+    """Per-tenant, not per-server: at full shed the storm tenant gets
+    Retry-After advice while the victim keeps serving, and the shed
+    counter lands in the snapshot."""
+    ledger = ResidencyLedger(
+        budget=1, shed_after_s=0.02, shed_retry_s=3.0,
+        tenant_window_s=30.0, starve_s=60.0,
+    )
+    # storm builds windowed admitted cost; victim a sliver
+    for _ in range(5):
+        ledger.release(ledger.acquire(("c0",), "storm", "foreground", 100))
+    ledger.release(ledger.acquire(("c0",), "victim", "foreground", 1))
+    # chip full + a queued waiter long enough for level 3
+    hold = ledger.acquire(("c0",), "storm", "foreground", 100)
+    waiter_done = threading.Event()
+
+    def waiter():
+        t = ledger.acquire(("c0",), "storm", "foreground", 1, timeout=30.0)
+        ledger.release(t)
+        waiter_done.set()
+
+    th = threading.Thread(target=waiter, daemon=True)
+    th.start()
+    deadline = time.time() + 5.0
+    while ledger.shed_level() < 3:
+        assert time.time() < deadline, "never reached full shed"
+        time.sleep(0.01)
+    assert ledger.shed_advice("storm") == 3.0
+    assert ledger.shed_advice("victim") is None
+    assert ledger.shed_advice("idle-tenant") is None
+    snap = ledger.snapshot()
+    assert snap["tenants"]["storm"]["shed"] >= 1
+    assert snap["chips"]["c0"]["pressure"] == 3
+    ledger.release(hold)
+    assert waiter_done.wait(timeout=10)
+
+
+# ---------------------------------------------------------- chaos matrix
+
+
+def test_tenant_storm_chaos_matrix():
+    """The fault-injected tier-1 storm: a storm tenant saturates one
+    chip through churning scopes (created/destroyed mid-storm), the
+    chip's breaker flaps, and the `ec.residency.acquire` fault point
+    is armed with injected IOErrors. Afterwards the ledger's own stats
+    must prove the residency invariant, the victim's p99 must be
+    bounded, and no slot may leak."""
+    ledger = ResidencyLedger(budget=3, shed_after_s=0.05)
+    brk = FakeBreaker("closed")
+    ledger.register_breaker("chip:0", brk)
+    stop = threading.Event()
+    errors: list[Exception] = []
+
+    def device_work():
+        time.sleep(0.001)
+
+    # chaos 1: armed fault point on the acquire seam (every 13th admit
+    # anywhere raises before any charge — callers retry, nothing leaks)
+    h = faults.inject(
+        "ec.residency.acquire", faults.io_error(), when=faults.every(13)
+    )
+    # chaos 2: breaker flap
+    def flap():
+        while not stop.is_set():
+            brk.state = "open" if brk.state == "closed" else "closed"
+            time.sleep(0.02)
+
+    flapper = threading.Thread(target=flap, daemon=True)
+    flapper.start()
+    # chaos 3: scope churn — each storm worker walks a list of scopes,
+    # and fresh scopes keep being created (old ones dropped) mid-storm
+    storm_scopes = [
+        QueueScope(window=4, tenant="storm", residency=ledger)
+        for _ in range(20)
+    ]
+    storm_threads = [
+        threading.Thread(
+            target=_storm,
+            args=(storm_scopes[i::4], stop, device_work, errors),
+            daemon=True,
+        )
+        for i in range(4)
+    ]
+    try:
+        for th in storm_threads:
+            th.start()
+        victim_scope = QueueScope(
+            window=4, tenant="victim", residency=ledger
+        )
+        vq = victim_scope.for_backend(FakeChip())
+        vs = vq.stream("foreground")
+        lat = []
+        try:
+            for _ in range(40):
+                t0 = time.perf_counter()
+                try:
+                    t, _ = vs.dispatch(device_work, 1)
+                except faults.InjectedIOError:
+                    continue
+                lat.append(time.perf_counter() - t0)
+                vs.release(t)
+        finally:
+            vs.close()
+    finally:
+        stop.set()
+        h.remove()
+        for th in storm_threads:
+            th.join(timeout=15)
+        flapper.join(timeout=5)
+    assert not errors, errors
+    assert h.fired > 0, "chaos fault point never fired"
+    snap = ledger.snapshot()
+    chip = snap["chips"]["chip:0"]
+    # the invariant, from ledger-stats ground truth
+    assert chip["max_inflight"] <= 3, snap
+    assert chip["inflight"] == 0 and snap["waiters"] == 0, snap
+    assert len(lat) >= 30
+    p99 = sorted(lat)[max(int(len(lat) * 0.99) - 1, 0)]
+    assert p99 < 2.0, f"victim p99 {p99:.3f}s unbounded under storm"
+
+
+# ----------------------------------------------------- front-end + obs
+
+
+def test_s3_gateway_sheds_per_tenant(monkeypatch, tmp_path):
+    """Foreground backpressure reaches the PR 11 front end: when shed
+    advice names THIS gateway's tenant, object data-plane requests get
+    503 SlowDown + Retry-After before auth; bucket/control ops and
+    other tenants keep serving."""
+    import requests
+
+    from seaweedfs_tpu.filer import Filer, MemoryStore
+    from seaweedfs_tpu.s3.server import S3Server
+    from conftest import allocate_port
+
+    from seaweedfs_tpu.ec import device_queue as dq
+
+    filer = Filer(MemoryStore(), master="localhost:1")
+    srv = S3Server(
+        filer, ip="localhost", port=allocate_port(), tenant="tester"
+    )
+    srv.start()
+    base = f"http://localhost:{srv.port}"
+    try:
+        monkeypatch.setattr(
+            dq, "shed_advice", lambda t: 2.5 if t == "tester" else None
+        )
+        r = requests.get(f"{base}/b/obj", timeout=10)
+        assert r.status_code == 503
+        assert r.headers.get("Retry-After") == "2"
+        assert "SlowDown" in r.text and "tester" in r.text
+        # control plane stays up mid-storm
+        r = requests.get(f"{base}/", timeout=10)
+        assert r.status_code != 503
+        # advice cleared: the object plane serves again (404: no data)
+        monkeypatch.setattr(dq, "shed_advice", lambda t: None)
+        r = requests.get(f"{base}/b/obj", timeout=10)
+        assert r.status_code == 404
+    finally:
+        srv.stop()
+        filer.close()
+
+
+def test_residency_observability_surfaces():
+    """residency_snapshot() is wired into the gateway debug summary,
+    and the sw_ec_residency_* metrics exist in the registry (the
+    metrics lint covers naming; this covers presence)."""
+    from seaweedfs_tpu.ec.device_queue import residency_snapshot
+    from seaweedfs_tpu.utils import metrics as M
+
+    snap = residency_snapshot()
+    assert isinstance(snap, dict)
+    assert "residency" in M.gateway_summary()
+    rendered = M.REGISTRY.render().decode()
+    for name in (
+        "sw_ec_residency_budget",
+        "sw_ec_residency_inflight",
+        "sw_ec_residency_pressure",
+        "sw_ec_residency_admitted_total",
+        "sw_ec_residency_shed_total",
+        "sw_ec_residency_wait_seconds_total",
+    ):
+        assert name in rendered, name
+
+
+# ------------------------------------------------------ heat persistence
+
+
+CTX = ECContext(4, 2)
+
+
+def _make_ec_volume(tmp_path, vid=1):
+    rng = np.random.default_rng(0x4EA7)
+    v = Volume(str(tmp_path), vid)
+    for i in range(1, 6):
+        data = rng.integers(0, 256, 20_000, dtype=np.uint8).tobytes()
+        v.write_needle(Needle(cookie=0x100 + i, needle_id=i, data=data))
+    v.close()
+    base = Volume.base_file_name(str(tmp_path), "", vid)
+    ec_encode_volume(base, CTX)
+    return base
+
+
+def test_heat_counters_survive_restart(tmp_path):
+    """PR 15 carried item (b): lifetime heat counters persist across a
+    clean close/reopen, so the master's first post-restart delta window
+    sees a monotonic counter instead of a reset."""
+    base = _make_ec_volume(tmp_path)
+    ev = EcVolume(str(tmp_path), 1, backend_name="cpu")
+    ev.bytes_read = 123_456
+    ev.bytes_reconstructed = 7_890
+    ev.close()
+    assert os.path.exists(base + ".heat")
+    ev2 = EcVolume(str(tmp_path), 1, backend_name="cpu")
+    try:
+        assert ev2.bytes_read == 123_456
+        assert ev2.bytes_reconstructed == 7_890
+    finally:
+        ev2.close()
+
+
+def test_heat_sidecar_generation_fence(tmp_path):
+    """A .heat blob from a different encode generation (re-created
+    volume) must never resurrect: counters start cold."""
+    base = _make_ec_volume(tmp_path)
+    ev = EcVolume(str(tmp_path), 1, backend_name="cpu")
+    ev.bytes_read = 999
+    ev.close()
+    blob = json.load(open(base + ".heat"))
+    blob["gen"] = (blob.get("gen") or 0) + 1
+    with open(base + ".heat", "w") as f:
+        json.dump(blob, f)
+    ev2 = EcVolume(str(tmp_path), 1, backend_name="cpu")
+    try:
+        assert ev2.bytes_read == 0 and ev2.bytes_reconstructed == 0
+    finally:
+        ev2.close()
+
+
+def test_heat_sidecar_corrupt_is_cold_start(tmp_path):
+    base = _make_ec_volume(tmp_path)
+    with open(base + ".heat", "w") as f:
+        f.write("{not json")
+    ev = EcVolume(str(tmp_path), 1, backend_name="cpu")
+    try:
+        assert ev.bytes_read == 0
+    finally:
+        ev.close()

@@ -164,7 +164,8 @@ def test_maintenance_config_roundtrip(pair):
         "maintenance.config -set balance_spread=3 "
         "-set lifecycle_interval_seconds=60 -set lifecycle_filer=f:123 "
         "-set ec_balance_interval_seconds=45 "
-        "-set ec_scrub_interval_seconds=3600",
+        "-set ec_scrub_interval_seconds=3600 "
+        "-set ec_rebalance_interval_seconds=120",
     )
     doc = json.loads(out)
     assert doc["balance_spread"] == 3.0
@@ -172,6 +173,7 @@ def test_maintenance_config_roundtrip(pair):
     assert doc["lifecycle_filer"] == "f:123"
     assert doc["ec_balance_interval_seconds"] == 45.0
     assert doc["ec_scrub_interval_seconds"] == 3600.0
+    assert doc["ec_rebalance_interval_seconds"] == 120.0
     assert master.balance_spread == 3.0
     assert master.lifecycle_filer == "f:123"
     assert master.ec_balance_interval == 45.0
@@ -179,11 +181,19 @@ def test_maintenance_config_roundtrip(pair):
     # settable over the RPC, not constructor-only — and 0 turns the
     # scanner back off without touching the other knobs
     assert master.ec_scrub_interval == 3600.0
+    # the PR 15 carried knob: gravity-rebalance cadence is runtime-
+    # settable too (proto3-optional field, read-modify-write semantics)
+    assert master.ec_rebalance_interval == 120.0
     out = run_command(env, "maintenance.config -set ec_scrub_interval_seconds=0")
     assert json.loads(out)["ec_scrub_interval_seconds"] == 0.0
     assert master.ec_scrub_interval == 0.0
     assert master.ec_balance_interval == 45.0  # partial update untouched
+    assert master.ec_rebalance_interval == 120.0  # partial update untouched
     out = run_command(env, "maintenance.config -set ec_scrub_interval_seconds=-5")
+    assert "error" in out
+    out = run_command(
+        env, "maintenance.config -set ec_rebalance_interval_seconds=-1"
+    )
     assert "error" in out
 
 
