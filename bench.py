@@ -1276,8 +1276,21 @@ def _gateway_bench(
                 pass
 
 
-def _net_counter_delta(before: dict, after: dict, plane: str) -> float:
-    return float(after.get((plane,), 0.0) - before.get((plane,), 0.0))
+def _net_counter_delta(
+    before: dict, after: dict, plane: str, direction: str | None = None
+) -> float:
+    """Delta of one sw_net_bytes_* family for `plane`, summed across
+    directions (or one direction when given) — keys are
+    (plane, direction) label tuples."""
+
+    def total(snap: dict) -> float:
+        return sum(
+            v for k, v in snap.items()
+            if k and k[0] == plane
+            and (direction is None or (len(k) > 1 and k[1] == direction))
+        )
+
+    return float(total(after) - total(before))
 
 
 def _peer_rebuild_bench(workdir: str, shard_mb: int = 8, reps: int = 2) -> dict:
@@ -1662,8 +1675,8 @@ def _ec_rebalance_bench(
                 return {"ec_rebalance_error": "ec_migrate never finished"}
             time.sleep(0.1)
         rec1 = _M.net_bytes_received_total.snapshot()
-        wire_native = rec1.get(("native",), 0) - rec0.get(("native",), 0)
-        wire_python = rec1.get(("python",), 0) - rec0.get(("python",), 0)
+        wire_native = _net_counter_delta(rec0, rec1, "native")
+        wire_python = _net_counter_delta(rec0, rec1, "python")
 
         # convergence + the exactly-one-mounted-holder invariant
         deadline = time.time() + 20
@@ -2160,7 +2173,7 @@ def _gateway_warm_bench(
             stage_python.get("s3.auth", 0.0)
             + stage_python.get("filer.lookup", 0.0)
         )
-        chunk_native = n1.get(("native",), 0) - n0.get(("native",), 0)
+        chunk_native = _net_counter_delta(n0, n1, "native")
         return {
             "gateway_warm_get_gets_per_s": native_phase["gets_per_s"],
             "gateway_warm_get_p50_ms": native_phase["p50_ms"],
@@ -2215,6 +2228,304 @@ def _gateway_warm_bench(
                 closer()
             except Exception:
                 pass
+
+
+def _canon_needle(raw: bytes) -> bytes:
+    """A needle record's bytes with the append timestamp normalized —
+    the only field two write transports may legitimately disagree on."""
+    from seaweedfs_tpu.storage.needle import Needle
+
+    n = Needle.from_bytes(bytes(raw))
+    n.append_at_ns = 1
+    return n.to_bytes()
+
+
+def _write_bit_identity_probe(vols, ops, payload: bytes) -> bool:
+    """The SAME fid written over the native write opcode, the HTTP
+    multipart POST, and in-process gRPC WriteNeedle must land
+    byte-identical records on disk (name/mime defaulting, flags, CRC)."""
+    import requests as _rq
+
+    from seaweedfs_tpu.pb import cluster_pb2 as pb
+    from seaweedfs_tpu.storage.file_id import FileId
+    from seaweedfs_tpu.storage.types import actual_offset
+
+    os.environ["SEAWEED_CHUNK_NET_PLANE_WRITE"] = "1"
+    before = sum(v.net_plane.write_requests for v in vols)
+    fid = ops.upload(payload, name="ident.bin", mime="application/x-b")
+    # 1 on a bare volume, 2 when the assign lands on a replicated one
+    # (the fan-out leg also rides the plane)
+    if sum(v.net_plane.write_requests for v in vols) < before + 1:
+        return False  # the probe write did not ride the plane
+    f = FileId.parse(fid)
+    vs = next(v for v in vols if v.store.find_volume(f.volume_id))
+
+    def record() -> bytes:
+        vol = vs.store.find_volume(f.volume_id)
+        nv = vol.needle_map.get(f.needle_id)
+        return vol._pread_record(actual_offset(nv.offset), nv.size)
+
+    raw_plane = record()
+    os.environ["SEAWEED_CHUNK_NET_PLANE_WRITE"] = "0"
+    loc = ops.master.lookup(f.volume_id)[0]
+    rr = _rq.post(
+        f"http://{loc.url}/{fid}",
+        files={"file": ("ident.bin", payload, "application/x-b")},
+        timeout=60,
+    )
+    if rr.status_code != 201:
+        return False
+    raw_http = record()
+    resp = vs.service.WriteNeedle(
+        pb.WriteNeedleRequest(
+            volume_id=f.volume_id, needle_id=f.needle_id, cookie=f.cookie,
+            data=payload, name="ident.bin", mime="application/x-b",
+            is_replicate=True,
+        ),
+        None,
+    )
+    if resp.error:
+        return False
+    raw_grpc = record()
+    return (
+        _canon_needle(raw_plane) == _canon_needle(raw_http)
+        == _canon_needle(raw_grpc)
+        and ops.read(fid) == payload
+    )
+
+
+def _group_commit_crash_check(workdir: str) -> bool:
+    """SIGKILL between the group-commit durability step and the ack:
+    every ACKED needle must replay from the on-disk volume (the bench's
+    in-process restatement of tests/test_group_commit.py's matrix)."""
+    import multiprocessing
+
+    from seaweedfs_tpu import faults
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    d = os.path.join(workdir, "gc_crash")
+    os.makedirs(d, exist_ok=True)
+    data = b"acked-then-killed-" * 100
+
+    def child(conn):
+        os.environ["SEAWEED_VOLUME_GROUP_COMMIT_MS"] = "10"
+        v = Volume(d, 1, create=True)
+        v.write_needle(Needle(cookie=0x77, needle_id=1, data=data), fsync=True)
+        conn.send("acked")
+        faults.inject("volume.write.before_ack", faults.hard_exit(137))
+        v.write_needle(Needle(cookie=0x78, needle_id=2, data=data), fsync=True)
+        os._exit(0)  # pragma: no cover - the fault kills us first
+
+    mp = multiprocessing.get_context("fork")
+    parent, cchild = mp.Pipe()
+    p = mp.Process(target=child, args=(cchild,))
+    p.start()
+    p.join(timeout=60)
+    if p.is_alive():
+        p.kill()
+        return False
+    if p.exitcode != 137 or not parent.poll() or parent.recv() != "acked":
+        return False
+    v = Volume(d, 1, create=False)
+    try:
+        # needle 1 was acked; needle 2 passed its durability step
+        # (before_ack fires after it) — both must replay
+        return (
+            v.read_needle(1).data == data and v.read_needle(2).data == data
+        )
+    except Exception:
+        return False
+    finally:
+        v.close()
+
+
+def _mixed_rw_bench(
+    workdir: str,
+    clients: int = 48,
+    ops_per_client: int = 10,
+    obj_bytes: int = 64 << 10,
+) -> dict:
+    """Mixed 70/30 GET/PUT at high client concurrency, write fast
+    paths ON vs OFF in ONE run (ISSUE 18). 48 clients on this 2-core
+    box is deep oversubscription (the group-commit batching win is in
+    full effect) without the 100-thread scheduler floor that flattens
+    the fast phase's p99 tail into pure thread-wakeup jitter. Both
+    phases run with
+    durable writes (SEAWEED_VOLUME_FSYNC=1) and replication 001, so
+    every PUT latency IS time-to-replicated-durable; the fast phase
+    turns on the native write opcode (client→primary AND the
+    primary→replica fan-out leg) and an 8 ms group-commit window,
+    the off phase pins PUTs to HTTP multipart with fsync-per-needle —
+    the seed write path. Every GET is byte-verified; the write-side
+    native-plane engagement rides in the line from
+    sw_net_bytes_received_total{plane=native,direction=write}, and the
+    three-transport bit-identity probe runs against the same cluster."""
+    import threading
+
+    from seaweedfs_tpu.client.operations import Operations
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.utils import metrics as _M
+
+    gdir = os.path.join(workdir, "mixed_rw")
+    os.makedirs(gdir, exist_ok=True)
+    mport = _bench_free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vols = []
+    knobs = (
+        "SEAWEED_CHUNK_NET_PLANE_WRITE",
+        "SEAWEED_VOLUME_FSYNC",
+        "SEAWEED_VOLUME_GROUP_COMMIT_MS",
+    )
+    prev_env = {k: os.environ.get(k) for k in knobs}
+    payload = np.random.default_rng(0x18).integers(
+        0, 256, obj_bytes, dtype=np.uint8
+    ).tobytes()
+    try:
+        for i in range(2):
+            vs = VolumeServer(
+                directories=[os.path.join(gdir, f"v{i}")],
+                master=f"localhost:{mport}",
+                ip="localhost",
+                port=_bench_free_port(),
+                ec_backend="cpu",
+            )
+            vs.start()
+            vols.append(vs)
+        deadline = time.time() + 15
+        while len(master.topo.nodes) < 2:
+            if time.time() > deadline:
+                return {"mixed_rw_error": "volume servers never registered"}
+            time.sleep(0.05)
+
+        def phase(fast: bool) -> dict:
+            os.environ["SEAWEED_CHUNK_NET_PLANE_WRITE"] = "1" if fast else "0"
+            os.environ["SEAWEED_VOLUME_FSYNC"] = "1"
+            os.environ["SEAWEED_VOLUME_GROUP_COMMIT_MS"] = (
+                "8" if fast else "0"
+            )
+            ops = Operations(f"localhost:{mport}")
+            lock = threading.Lock()
+            put_lat: list[float] = []
+            get_lat: list[float] = []
+            errors = [0]
+            barrier = threading.Barrier(clients)
+
+            def client(c: int) -> None:
+                fids: list[str] = []
+                try:
+                    barrier.wait(timeout=60)
+                except threading.BrokenBarrierError:
+                    pass
+                for i in range(ops_per_client):
+                    # deterministic 30% writes; the first op seeds the
+                    # client's GET target
+                    is_put = not fids or (c * 31 + i) % 10 < 3
+                    t0 = time.perf_counter()
+                    try:
+                        if is_put:
+                            fids.append(
+                                ops.upload(
+                                    payload, name="m.bin", replication="001"
+                                )
+                            )
+                            with lock:
+                                put_lat.append(time.perf_counter() - t0)
+                        else:
+                            ok = ops.read(fids[-1]) == payload
+                            with lock:
+                                if ok:
+                                    get_lat.append(time.perf_counter() - t0)
+                                else:
+                                    errors[0] += 1
+                    except Exception:
+                        with lock:
+                            errors[0] += 1
+
+            threads = [
+                threading.Thread(target=client, args=(c,))
+                for c in range(clients)
+            ]
+            t_all = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t_all
+            ops.close()
+            if not put_lat or not get_lat:
+                return {"error": f"no completed ops (errors={errors[0]})"}
+            puts = np.array(sorted(put_lat)) * 1e3
+            gets = np.array(sorted(get_lat)) * 1e3
+            return {
+                "write_p50_ms": round(float(np.percentile(puts, 50)), 2),
+                "write_p99_ms": round(float(np.percentile(puts, 99)), 2),
+                "read_p99_ms": round(float(np.percentile(gets, 99)), 2),
+                "puts": len(put_lat),
+                "gets": len(get_lat),
+                "errors": errors[0],
+                "ops_per_s": round(
+                    (len(put_lat) + len(get_lat)) / wall, 1
+                ),
+            }
+
+        python_phase = phase(fast=False)
+        n0 = _M.net_bytes_received_total.snapshot()
+        fast_phase = phase(fast=True)
+        n1 = _M.net_bytes_received_total.snapshot()
+        if "error" in python_phase or "error" in fast_phase:
+            return {
+                "mixed_rw_error": (
+                    f"python={python_phase.get('error')} "
+                    f"fast={fast_phase.get('error')}"
+                )
+            }
+        write_native = _net_counter_delta(n0, n1, "native", "write")
+        ident_ops = Operations(f"localhost:{mport}")
+        try:
+            identical = _write_bit_identity_probe(vols, ident_ops, payload)
+        finally:
+            ident_ops.close()
+        acked_durable = _group_commit_crash_check(gdir)
+        return {
+            "mixed_rw_write_p99_ms_fast": fast_phase["write_p99_ms"],
+            "mixed_rw_write_p99_ms_python": python_phase["write_p99_ms"],
+            "mixed_rw_write_speedup": round(
+                python_phase["write_p99_ms"]
+                / max(fast_phase["write_p99_ms"], 1e-9),
+                2,
+            ),
+            # durable+replicated ack latency under the fast config
+            "mixed_rw_durable_ms": fast_phase["write_p50_ms"],
+            "mixed_rw_read_p99_ms_fast": fast_phase["read_p99_ms"],
+            "mixed_rw_read_p99_ms_python": python_phase["read_p99_ms"],
+            "mixed_rw_ops_per_s_fast": fast_phase["ops_per_s"],
+            "mixed_rw_ops_per_s_python": python_phase["ops_per_s"],
+            "mixed_rw_write_native_mb": round(write_native / 1e6, 1),
+            "mixed_rw_identical": bool(identical),
+            "mixed_rw_acked_durable": bool(acked_durable),
+            "mixed_rw_clients": clients,
+            "mixed_rw_object_kb": obj_bytes >> 10,
+            "mixed_rw_errors": fast_phase["errors"]
+            + python_phase["errors"],
+        }
+    finally:
+        for k, v in prev_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        for vs in vols:
+            try:
+                vs.stop()
+            except Exception:
+                pass
+        try:
+            master.stop()
+        except Exception:
+            pass
 
 
 # --------------------------------------------------------------------------
@@ -3467,6 +3778,25 @@ def _self_check() -> int:
             f"native_mb={warm.get('gateway_warm_chunk_native_mb')}",
         )
 
+        # ---- write-path bit identity + acked-durable (ISSUE 18): one
+        # small mixed_rw run — the native write opcode, HTTP multipart,
+        # and gRPC WriteNeedle land byte-identical records (and the
+        # fast phase's writes actually rode the plane); a SIGKILL
+        # between the group-commit fsync and the ack must leave every
+        # acked needle replayable from disk ---------------------------
+        mixed = _mixed_rw_bench(workdir, clients=4, ops_per_client=4)
+        check(
+            "write_path_bit_identical",
+            mixed.get("mixed_rw_identical") is True
+            and mixed.get("mixed_rw_errors", 1) == 0
+            and mixed.get("mixed_rw_write_native_mb", 0.0) > 0,
+            f"stats={mixed}",
+        )
+        check(
+            "group_commit_acked_is_durable",
+            _group_commit_crash_check(workdir),
+        )
+
         # ---- streaming-EC bit identity (ISSUE 14): N appends through
         # the online encoder == ONE batch encode over the concat, and
         # the streaming path's p99 time-to-durable-parity beats the
@@ -3737,6 +4067,15 @@ def main() -> None:
             rebalance_stats = {
                 "ec_rebalance_error": f"{type(e).__name__}: {e}"
             }
+        # Write path at line rate (ISSUE 18): mixed 70/30 GET/PUT,
+        # native write plane + group commit vs HTTP + fsync-per-needle
+        # in one run, with the three-transport bit-identity probe.
+        try:
+            mixed_rw_stats = _mixed_rw_bench(workdir)
+        except Exception as e:  # noqa: BLE001
+            mixed_rw_stats = {
+                "mixed_rw_error": f"{type(e).__name__}: {e}"
+            }
         # Multi-tenant overload safety (ISSUE 16): victim-tenant p99
         # under a tenant storm with the residency budget on vs off,
         # plus the ledger-ground-truth residency invariant.
@@ -3808,6 +4147,7 @@ def main() -> None:
             **gateway_warm_stats,
             **streaming_stats,
             **rebalance_stats,
+            **mixed_rw_stats,
             **tenant_storm_stats,
         }
         best.update(

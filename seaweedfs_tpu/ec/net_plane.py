@@ -56,6 +56,7 @@ topology plumbing; a dead port is just a memoized fallback.
 
 from __future__ import annotations
 
+import base64
 import os
 import socket
 import struct
@@ -81,6 +82,20 @@ MAGIC = b"SWNP"
 # length and the payload, so the client's fused copy-in CRC verifies
 # with no extra byte pass.
 MAGIC_NEEDLE = b"SWNR"
+# Needle/blob WRITE opcode (ISSUE 18): the same 38-byte header frames a
+# PUT — for kind=needle the fields are reinterpreted shard -> cookie,
+# generation -> needle id, offset -> the CLIENT-computed CRC32C of the
+# payload (so the server's fused copy-in CRC verifies transit with no
+# extra byte pass); for kind=blob (remote stream-shard extents) offset
+# is the real file offset and the CRC rides the metadata. The payload
+# (`size` bytes) follows the metadata. An OK response carries
+# n = stored size and the _NEEDLE_CRC trailer = the CRC as STORED, which
+# the client compares against what it sent — an ack therefore certifies
+# the exact bytes that hit the disk, end to end. Refusals (status 1/2)
+# are sent only after the payload is drained, so the persistent
+# connection stays in frame sync and pooled connections survive
+# refusals.
+MAGIC_WRITE = b"SWNW"
 # magic, volume, shard, gen, offset, size, meta_len
 _REQ = struct.Struct("<4sIIQQQH")
 _RESP = struct.Struct("<BQ")      # status, n
@@ -100,16 +115,68 @@ _MAX_ERROR = 1 << 16
 _MAX_NEEDLE = 64 << 20
 # never park landing buffers wider than this in the process-wide pool
 _POOL_MAX_WIDTH = 8 << 20
+# blob writes (stream-shard extents pushed at flush boundaries) may be
+# wider than a needle; anything beyond this is a desynced/hostile frame
+_MAX_BLOB = 256 << 20
+
+# Write-opcode chaos routing: the write plane keeps serving while the
+# ONLY armed fault points live on the write path's own seams (the
+# net-plane pwrite window and the volume append/fsync window) — that is
+# exactly the crash matrix that must ride the native path. Any OTHER
+# armed point (byte-mutating storage chaos, read-path faults) refuses
+# write service so the Python/gRPC fallback — which carries those
+# points — stays the chaos surface, same contract as the read opcodes.
+_WRITE_CHAOS_NS = ("ec.net.write.", "volume.write.")
 
 
-def _encode_meta() -> bytes:
+def write_plane_admissible() -> bool:
+    """True when the write opcode may serve despite an armed registry:
+    every armed point lives in the write path's own chaos namespaces
+    (or nothing is armed at all)."""
+    return all(
+        p.startswith(_WRITE_CHAOS_NS) for p in faults.armed_points()
+    )
+
+
+def _pool_width(n: int) -> int:
+    """Pool width class for an n-byte payload. The landing pool
+    free-lists by EXACT width and retains forever — pooling raw payload
+    sizes (objects/tail chunks take arbitrary sizes) would grow one
+    immortal buffer per distinct size. Rounding up to the next power of
+    two (floor 64 KiB) bounds the class count to ~a dozen regardless of
+    object-size mix."""
+    return max(64 * 1024, 1 << (max(1, n) - 1).bit_length())
+
+
+def _encode_meta(extra: dict | None = None) -> bytes:
     """The active request-id / trace context as a metadata blob —
-    exactly what trace.grpc_metadata() would put on the RPC."""
-    md = trace.grpc_metadata()
+    exactly what trace.grpc_metadata() would put on the RPC — plus any
+    opcode-specific key/value pairs (the write opcode's kind / flags /
+    name / jwt lines). Values must not contain tab or newline; binary
+    fields ride urlsafe base64 (see _b64)."""
+    md = list(trace.grpc_metadata() or [])
+    if extra:
+        md.extend(
+            (k, str(v)) for k, v in extra.items()
+            if v is not None and str(v) != ""
+        )
     if not md:
         return b""
     blob = "\n".join(f"{k}\t{v}" for k, v in md).encode()
     return blob[:_MAX_META]
+
+
+def _b64(value: bytes | str) -> str:
+    if isinstance(value, str):
+        value = value.encode()
+    return base64.urlsafe_b64encode(value).decode()
+
+
+def _unb64(value: str) -> bytes:
+    try:
+        return base64.urlsafe_b64decode(value.encode())
+    except (ValueError, TypeError):
+        return b""
 
 
 def _decode_meta(blob: bytes) -> dict:
@@ -214,13 +281,32 @@ class ShardNetPlane:
     server must close once the response is sent (per-request opens).
     Raising :class:`NetPlaneError` refuses the request (not here / EC /
     TTL'd / cookie mismatch) and the client falls back to HTTP.
+
+    ``resolve_write(volume_id, needle_id, cookie, data, md) ->
+    (stored_size, stored_crc)`` (optional) lands one needle append for
+    the write opcode — the net-plane twin of the ``WriteNeedle`` gRPC —
+    building the SAME needle record the gRPC/HTTP paths build (bit
+    identity on disk) and triggering replica fan-out unless the request
+    is itself a replica. :class:`NetPlaneVolumeRefusal` means the whole
+    volume can never take plane writes here; :class:`NetPlaneError` /
+    ``IOError`` / ``ValueError`` refuse this one write (client retries
+    over the fallback transport).
+
+    ``resolve_blob(path, op, md) -> fd | None`` (optional) serves
+    kind=blob writes — remote durable-parity stream-shard extents. It
+    validates `path` against the server's blob root, returning an fd
+    the server pwrites into and closes (``op == "write"``), or handling
+    the operation itself and returning None (``op == "unlink"``).
     """
 
     def __init__(self, ip: str, port: int, resolve,
                  request_timeout: float = 60.0, server_label: str = "",
-                 resolve_needle=None):
+                 resolve_needle=None, resolve_write=None,
+                 resolve_blob=None):
         self.resolve = resolve
         self.resolve_needle = resolve_needle
+        self.resolve_write = resolve_write
+        self.resolve_blob = resolve_blob
         self.request_timeout = request_timeout
         self.server_label = server_label
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -238,6 +324,9 @@ class ShardNetPlane:
         self.needle_requests = 0
         self.sendfile_bytes = 0
         self.python_bytes = 0
+        self.write_requests = 0
+        self.write_native_bytes = 0
+        self.write_python_bytes = 0
 
     def start(self) -> None:
         self._thread.start()
@@ -281,7 +370,7 @@ class ShardNetPlane:
                     return  # client went away between requests
                 magic, vid, sid, gen, off, size, mlen = _REQ.unpack(hdr)
                 if (
-                    magic not in (MAGIC, MAGIC_NEEDLE)
+                    magic not in (MAGIC, MAGIC_NEEDLE, MAGIC_WRITE)
                     or size > _MAX_REQUEST
                     or mlen > _MAX_META
                 ):
@@ -299,6 +388,26 @@ class ShardNetPlane:
                 # gateway's trace the same way — one warm GET stays
                 # ONE trace across the chunk-fetch hop.
                 _rid.ensure(md.get(trace.REQUEST_ID_KEY))
+                if magic == MAGIC_WRITE:
+                    # field reinterpretation (kind=needle): sid slot =
+                    # cookie, gen slot = needle id, off slot = the
+                    # client's payload CRC32C
+                    sp = trace.start_from_metadata(
+                        "rpc.needle_write", md, server=self.server_label,
+                        volume=vid, needle=gen, size=size, plane="native",
+                    )
+                    t0 = time.perf_counter()
+                    try:
+                        ok = self._serve_write(conn, vid, sid, gen, off,
+                                               size, md)
+                    finally:
+                        trace.add_stage(
+                            sp, "stream", time.perf_counter() - t0
+                        )
+                        trace.finish(sp)
+                    if not ok:
+                        return
+                    continue
                 if magic == MAGIC_NEEDLE:
                     # field reinterpretation: sid slot = cookie,
                     # gen slot = needle id
@@ -377,7 +486,7 @@ class ShardNetPlane:
             except OSError:
                 return False  # peer died mid-splice: header already out
             self.sendfile_bytes += sent
-            M.net_bytes_sent_total.inc(sent, plane="native")
+            M.net_bytes_sent_total.inc(sent, plane="native", direction="read")
             return sent == n
         # Python egress (fallback plane / armed registry): pread ->
         # mutate -> sendall, byte-identical to the gRPC stream's
@@ -392,14 +501,14 @@ class ShardNetPlane:
             chunk = faults.mutate(
                 "server.ec_shard_read", chunk, volume=vid, shard=sid, offset=o
             )
-            M.net_bytes_copied_total.inc(orig, plane="python")
+            M.net_bytes_copied_total.inc(orig, plane="python", direction="read")
             try:
                 if chunk:
                     conn.sendall(chunk)
             except OSError:
                 return False
             self.python_bytes += len(chunk)
-            M.net_bytes_sent_total.inc(len(chunk), plane="python")
+            M.net_bytes_sent_total.inc(len(chunk), plane="python", direction="read")
             if len(chunk) < orig:
                 return False  # torn stream: connection is dead
             o += orig
@@ -447,7 +556,7 @@ class ShardNetPlane:
                 except OSError:
                     return False
                 self.sendfile_bytes += sent
-                M.net_bytes_sent_total.inc(sent, plane="native")
+                M.net_bytes_sent_total.inc(sent, plane="native", direction="read")
                 return sent == size
             # Python egress (no .so): pread -> sendall, the same bytes.
             remaining, o = size, off
@@ -455,13 +564,13 @@ class ShardNetPlane:
                 chunk = os.pread(fd, min(_SEND_CHUNK, remaining), o)
                 if not chunk:
                     return False  # short file: torn stream
-                M.net_bytes_copied_total.inc(len(chunk), plane="python")
+                M.net_bytes_copied_total.inc(len(chunk), plane="python", direction="read")
                 try:
                     conn.sendall(chunk)
                 except OSError:
                     return False
                 self.python_bytes += len(chunk)
-                M.net_bytes_sent_total.inc(len(chunk), plane="python")
+                M.net_bytes_sent_total.inc(len(chunk), plane="python", direction="read")
                 o += len(chunk)
                 remaining -= len(chunk)
             return True
@@ -472,6 +581,265 @@ class ShardNetPlane:
                 except OSError:
                     pass
 
+    # ------------------------------------------------------------- writes
+
+    @staticmethod
+    def _drain(conn, n: int) -> bool:
+        """Consume `n` unread payload bytes so a refusal sent AFTER the
+        header leaves the persistent connection in frame sync — pooled
+        client connections survive refusals instead of desyncing."""
+        if n <= 0:
+            return True
+        buf = bytearray(min(n, _SEND_CHUNK))
+        view = memoryview(buf)
+        left = n
+        try:
+            while left > 0:
+                r = conn.recv_into(view[: min(left, len(buf))])
+                if r == 0:
+                    return False
+                left -= r
+        except OSError:
+            return False
+        return True
+
+    def _land_payload(self, conn, row, size: int, native) -> int:
+        """Land `size` payload bytes into pooled-buffer `row`, rolling
+        the CRC32C during the copy-in (fused in `sn_recv_into` when the
+        .so is present). Returns the landed CRC; raises NetPlaneError /
+        OSError on a torn ingress (connection is then dead)."""
+        if size == 0:
+            return 0
+        if native is not None:
+            crc_state = np.zeros(1, np.uint32)
+            filled = np.zeros(1, np.uint64)
+            out_crcs = np.zeros(2, np.uint32)
+            out_counts = np.zeros(1, np.int32)
+            got = native.recv_into(
+                conn.fileno(), row, size,
+                timeout_ms=int(self.request_timeout * 1000),
+                granule=size, crc_state=crc_state, filled_state=filled,
+                out_crcs=out_crcs, out_counts=out_counts,
+            )
+            if got != size:
+                raise NetPlaneError(f"torn write payload {got}/{size}")
+            self.write_native_bytes += got
+            M.net_bytes_received_total.inc(
+                got, plane="native", direction="write"
+            )
+            return (
+                int(out_crcs[0]) if int(out_counts[0]) > 0
+                else int(crc_state[0])
+            )
+        view = memoryview(row)[:size]
+        got = 0
+        while got < size:
+            r = conn.recv_into(view[got:], size - got)
+            if r == 0:
+                raise NetPlaneError(f"torn write payload {got}/{size}")
+            got += r
+        from ..utils.crc import crc32c as _crc
+
+        self.write_python_bytes += size
+        M.net_bytes_received_total.inc(
+            size, plane="python", direction="write"
+        )
+        return _crc(row[:size])
+
+    def _serve_write(self, conn, vid, cookie, nid, off_or_crc, size,
+                     md) -> bool:
+        """Serve one write request; False = connection must close.
+        Refused while the fault registry holds points OUTSIDE the write
+        path's own chaos namespaces (see write_plane_admissible) — the
+        gRPC/HTTP fallback carries that chaos, while the write-path
+        crash matrix rides through here."""
+        kind = md.get("x-sw-w-kind", "")
+        op = md.get("x-sw-w-op", "write")
+        refusal = None
+        if kind == "needle":
+            if size > _MAX_NEEDLE:
+                return False  # desynced/hostile frame: drop
+            if self.resolve_write is None:
+                refusal = "needle writes not served here"
+        elif kind == "blob":
+            if size > _MAX_BLOB:
+                return False
+            if self.resolve_blob is None:
+                refusal = "blob writes not served here"
+        else:
+            return False  # unknown kind: protocol desync
+        if refusal is None and not write_plane_admissible():
+            refusal = "fault registry armed: use the fallback transport"
+        if refusal is not None:
+            if not self._drain(conn, size):
+                return False
+            return self._error(conn, refusal)
+        self.write_requests += 1
+        if kind == "blob":
+            return self._serve_blob_write(conn, op, md, off_or_crc, size)
+        return self._serve_needle_write(
+            conn, vid, cookie, nid, off_or_crc, size, md
+        )
+
+    def _serve_needle_write(self, conn, vid, cookie, nid, want_crc,
+                            size, md) -> bool:
+        from . import native_io
+
+        native = _native_mod() if native_io.enabled() else None
+        pool = native_io.landing_pool()
+        buf = pool.get(_pool_width(size))
+        row = buf[0]
+        try:
+            try:
+                landed_crc = self._land_payload(conn, row, size, native)
+            except (OSError, NetPlaneError):
+                return False
+            if size and landed_crc != (want_crc & 0xFFFFFFFF):
+                # payload fully consumed — the stream is in sync, so a
+                # refusal (not a drop) lets the client retry/fall back
+                return self._error(conn, "write payload CRC mismatch")
+            # the one Python-level materialization on this path: the
+            # needle record wants bytes it can keep
+            data = row[:size].tobytes()
+            M.net_bytes_copied_total.inc(
+                size, plane="native" if native is not None else "python",
+                direction="write",
+            )
+        finally:
+            if buf.shape[1] <= _POOL_MAX_WIDTH:
+                pool.put(buf)
+        try:
+            faults.fire(
+                "ec.net.write.before_pwrite",
+                volume=vid, needle=nid, size=size,
+            )
+            stored_size, stored_crc = self.resolve_write(
+                vid, nid, cookie, data, md
+            )
+            faults.fire("ec.net.write.after_pwrite", volume=vid, needle=nid)
+        except NetPlaneVolumeRefusal as e:
+            return self._error(conn, str(e), status=2)
+        except (NetPlaneError, OSError, ValueError) as e:
+            return self._error(conn, str(e))
+        try:
+            conn.sendall(
+                _RESP.pack(0, stored_size)
+                + _NEEDLE_CRC.pack(stored_crc & 0xFFFFFFFF)
+            )
+        except OSError:
+            return False
+        return True
+
+    def _serve_blob_write(self, conn, op, md, off, size) -> bool:
+        try:
+            path = _unb64(md.get("x-sw-w-path", "")).decode()
+        except (ValueError, UnicodeDecodeError):
+            path = ""
+        try:
+            want_crc = int(md.get("x-sw-w-crc", "0"))
+        except ValueError:
+            want_crc = 0
+        do_fsync = md.get("x-sw-w-fsync", "0") == "1"
+        try:
+            fd = self.resolve_blob(path, op, md)
+        except NetPlaneVolumeRefusal as e:
+            if not self._drain(conn, size):
+                return False
+            return self._error(conn, str(e), status=2)
+        except (NetPlaneError, OSError) as e:
+            if not self._drain(conn, size):
+                return False
+            return self._error(conn, str(e))
+        if fd is None:
+            # op handled entirely by the resolver (unlink)
+            if not self._drain(conn, size):
+                return False
+            try:
+                conn.sendall(_RESP.pack(0, 0) + _NEEDLE_CRC.pack(0))
+            except OSError:
+                return False
+            return True
+        try:
+            try:
+                faults.fire(
+                    "ec.net.write.before_pwrite", path=path, size=size
+                )
+            except IOError as e:
+                if not self._drain(conn, size):
+                    return False
+                return self._error(conn, str(e))
+            from . import native_io
+
+            native = _native_mod() if native_io.enabled() else None
+            landed_crc = 0
+            if size:
+                if native is not None and native.has_recv_file():
+                    # socket -> disk with the CRC fused into the landing
+                    # loop: Python never touches a payload byte
+                    try:
+                        got, landed_crc = native.recv_file(
+                            conn.fileno(), fd, off, size,
+                            timeout_ms=int(self.request_timeout * 1000),
+                        )
+                    except OSError:
+                        return False
+                    if got != size:
+                        return False
+                    self.write_native_bytes += got
+                    M.net_bytes_received_total.inc(
+                        got, plane="native", direction="write"
+                    )
+                else:
+                    from ..utils.crc import crc32c as _crc
+
+                    chunk = bytearray(min(size, _SEND_CHUNK))
+                    view = memoryview(chunk)
+                    remaining, o, crc = size, off, 0
+                    try:
+                        while remaining > 0:
+                            want = min(len(chunk), remaining)
+                            got = conn.recv_into(view[:want], want)
+                            if got == 0:
+                                return False
+                            crc = _crc(view[:got], crc)
+                            os.pwrite(fd, view[:got], o)
+                            o += got
+                            remaining -= got
+                    except OSError:
+                        return False
+                    landed_crc = crc
+                    self.write_python_bytes += size
+                    M.net_bytes_received_total.inc(
+                        size, plane="python", direction="write"
+                    )
+                    M.net_bytes_copied_total.inc(
+                        size, plane="python", direction="write"
+                    )
+            if want_crc and landed_crc != (want_crc & 0xFFFFFFFF):
+                # corrupt extent is already on disk, but the pushed
+                # watermark only advances on an ACK — the client retries
+                # the same extent at the same offset
+                return self._error(conn, "blob payload CRC mismatch")
+            try:
+                faults.fire("ec.net.write.after_pwrite", path=path)
+                if do_fsync:
+                    os.fsync(fd)
+            except (IOError, OSError) as e:
+                return self._error(conn, str(e))
+            try:
+                conn.sendall(
+                    _RESP.pack(0, size)
+                    + _NEEDLE_CRC.pack(landed_crc & 0xFFFFFFFF)
+                )
+            except OSError:
+                return False
+            return True
+        finally:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+
     def status(self) -> dict:
         """Sidecar state for /status and /debug/gateway surfaces."""
         return {
@@ -480,6 +848,9 @@ class ShardNetPlane:
             "needle_requests": self.needle_requests,
             "sendfile_bytes": self.sendfile_bytes,
             "python_bytes": self.python_bytes,
+            "write_requests": self.write_requests,
+            "write_native_bytes": self.write_native_bytes,
+            "write_python_bytes": self.write_python_bytes,
         }
 
 
@@ -747,7 +1118,7 @@ class NetPlaneClient:
                     raise NetPlaneError(
                         f"{addr}: torn stream {got}/{size}"
                     )
-                M.net_bytes_received_total.inc(got, plane="native")
+                M.net_bytes_received_total.inc(got, plane="native", direction="read")
                 if not granule:
                     return None
                 crcs = list(out_crcs[: int(out_counts[0])])
@@ -762,7 +1133,7 @@ class NetPlaneClient:
                 if r == 0:
                     raise NetPlaneError(f"{addr}: torn stream {got}/{size}")
                 got += r
-            M.net_bytes_received_total.inc(got, plane="python")
+            M.net_bytes_received_total.inc(got, plane="python", direction="read")
             if not granule:
                 return None
             from ..utils.crc import crc32c as _crc
@@ -794,8 +1165,8 @@ class NetPlaneClient:
             except (OSError, NetPlaneError) as e:
                 self._drop(addr)
                 raise NetPlaneError(f"{addr}: {e}") from e
-        M.net_bytes_received_total.inc(size, plane="python")
-        M.net_bytes_copied_total.inc(size, plane="python")
+        M.net_bytes_received_total.inc(size, plane="python", direction="read")
+        M.net_bytes_copied_total.inc(size, plane="python", direction="read")
         return data
 
     def fetch_shard_to_file(
@@ -858,7 +1229,7 @@ class NetPlaneClient:
                                         f"{total + got}/{n}"
                                     )
                                 got += r
-                        M.net_bytes_received_total.inc(want, plane=plane)
+                        M.net_bytes_received_total.inc(want, plane=plane, direction="read")
                         fobj.write(row[:want])
                         total += want
                         remaining -= want
@@ -951,15 +1322,9 @@ class NetPlaneClient:
                 except OSError:
                     pass
 
-    @staticmethod
-    def _landing_width(n: int) -> int:
-        """Pool width class for an n-byte needle payload. The landing
-        pool free-lists by EXACT width and retains forever — pooling
-        raw payload sizes (objects/tail chunks take arbitrary sizes)
-        would grow one immortal buffer per distinct size. Rounding up
-        to the next power of two (floor 64 KiB) bounds the class count
-        to ~a dozen regardless of object-size mix."""
-        return max(64 * 1024, 1 << (n - 1).bit_length())
+    # pool width class for an n-byte needle payload (see _pool_width —
+    # shared with the server's write landing so the classes can't drift)
+    _landing_width = staticmethod(_pool_width)
 
     def _land_needle(self, addr, s, n: int, want_crc: int) -> bytes:
         from . import native_io
@@ -990,7 +1355,7 @@ class NetPlaneClient:
                         int(out_crcs[0]) if int(out_counts[0]) > 0
                         else int(crc_state[0])
                     )
-                    M.net_bytes_received_total.inc(got, plane="native")
+                    M.net_bytes_received_total.inc(got, plane="native", direction="read")
                 else:
                     view = memoryview(row)[:n]
                     got = 0
@@ -1004,7 +1369,7 @@ class NetPlaneClient:
                     from ..utils.crc import crc32c as _crc
 
                     landed_crc = _crc(row[:n])
-                    M.net_bytes_received_total.inc(n, plane="python")
+                    M.net_bytes_received_total.inc(n, plane="python", direction="read")
             except OSError as e:
                 raise NetPlaneError(f"{addr}: {e}") from e
             if landed_crc != (want_crc & 0xFFFFFFFF):
@@ -1013,7 +1378,8 @@ class NetPlaneClient:
             # landing buffer -> the bytes object the chunk cache keeps
             data = row[:n].tobytes()
             M.net_bytes_copied_total.inc(
-                n, plane="native" if native is not None else "python"
+                n, plane="native" if native is not None else "python",
+                direction="read",
             )
             return data
         finally:
@@ -1022,6 +1388,147 @@ class NetPlaneClient:
             # landings never park in the immortal pool.
             if buf.shape[1] <= _POOL_MAX_WIDTH:
                 pool.put(buf)
+
+    # ------------------------------------------------------ needle writes
+
+    def _write_request(
+        self, addr, vid, sid, gen, off, payload, extra_meta
+    ) -> tuple[int, int]:
+        """One write-opcode round trip on a pooled connection: header +
+        meta + payload out, (status, n [, stored CRC]) back. Returns
+        (stored_size, stored_crc). Refusals leave the stream in sync
+        (the server drains the payload first), so the connection goes
+        back to the pool even on a refusal."""
+        s = self._checkout(addr)
+        healthy = False
+        try:
+            meta = _encode_meta(extra_meta)
+            try:
+                s.sendall(
+                    _REQ.pack(
+                        MAGIC_WRITE, vid, sid, gen, off,
+                        len(payload), len(meta),
+                    )
+                    + meta
+                )
+                if payload:
+                    s.sendall(payload)
+                head = _recv_exact(s, _RESP.size)
+            except (OSError, NetPlaneError) as e:
+                raise NetPlaneError(f"{addr}: {e}") from e
+            status, n = _RESP.unpack(head)
+            if status != 0:
+                msg = self._read_refusal(addr, s, n)
+                healthy = True
+                err = NetPlaneError(f"{addr}: {msg}")
+                err.volume_refusal = status == 2
+                raise err
+            try:
+                (stored_crc,) = _NEEDLE_CRC.unpack(
+                    _recv_exact(s, _NEEDLE_CRC.size)
+                )
+            except (OSError, NetPlaneError) as e:
+                raise NetPlaneError(f"{addr}: {e}") from e
+            healthy = True
+            from . import native_io
+
+            M.net_bytes_sent_total.inc(
+                len(payload),
+                plane="native" if native_io.enabled() else "python",
+                direction="write",
+            )
+            return int(n), int(stored_crc)
+        finally:
+            if healthy:
+                self._checkin(addr, s)
+            else:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    def write_needle(
+        self, addr: tuple[str, int], vid: int, nid: int, cookie: int,
+        data: bytes, *, flags: int = 0, name: bytes | str = b"",
+        mime: bytes | str = b"", jwt: str = "", fsync: bool = False,
+        replicate: bool = True,
+    ) -> tuple[int, int]:
+        """Append one needle over the write opcode (the PUT path's
+        native twin of the ``WriteNeedle`` gRPC / HTTP upload). The
+        payload CRC32C rides the header; the server's fused copy-in CRC
+        verifies transit, and the ACK's STORED CRC is verified here
+        against what was sent — an accepted write certifies the exact
+        bytes on disk end to end. Returns (stored_size, stored_crc).
+        Raises :class:`NetPlaneUnavailable` (memoized, TTL'd) for peers
+        without the sidecar; a refusal with ``volume_refusal=True``
+        means the whole volume can never take plane writes here."""
+        from ..utils.crc import crc32c as _crc
+
+        crc = _crc(data) if data else 0
+        extra = {
+            "x-sw-w-kind": "needle",
+            "x-sw-w-flags": str(int(flags)),
+        }
+        if name:
+            extra["x-sw-w-name"] = _b64(name)
+        if mime:
+            extra["x-sw-w-mime"] = _b64(mime)
+        if jwt:
+            extra["x-sw-w-jwt"] = jwt
+        if fsync:
+            extra["x-sw-w-fsync"] = "1"
+        if not replicate:
+            extra["x-sw-w-replicate"] = "0"
+        stored_size, stored_crc = self._write_request(
+            addr, vid, cookie & 0xFFFFFFFF, nid, crc, data, extra
+        )
+        if data and stored_crc != crc:
+            raise NetPlaneError(
+                f"{addr}: stored CRC mismatch "
+                f"(ack {stored_crc:#010x} != sent {crc:#010x})"
+            )
+        return stored_size, stored_crc
+
+    def write_blob(
+        self, addr: tuple[str, int], path: str, off: int, data, *,
+        fsync: bool = True, jwt: str = "",
+    ) -> int:
+        """Write one extent of a remote stream-shard blob at `off`
+        (kind=blob): the true network transport behind `net:` remote
+        roots, replacing the shared-mount assumption. The server lands
+        socket->disk (``sn_recv_file``, CRC fused) and fsyncs before
+        ACKing when `fsync` — the remote extent is DURABLE once this
+        returns. Returns bytes stored."""
+        from ..utils.crc import crc32c as _crc
+
+        data = bytes(data)
+        extra = {
+            "x-sw-w-kind": "blob",
+            "x-sw-w-path": _b64(path),
+            "x-sw-w-crc": str(_crc(data) if data else 0),
+        }
+        if fsync:
+            extra["x-sw-w-fsync"] = "1"
+        if jwt:
+            extra["x-sw-w-jwt"] = jwt
+        stored, _crc_ack = self._write_request(
+            addr, 0, 0, 0, off, data, extra
+        )
+        return stored
+
+    def unlink_blob(
+        self, addr: tuple[str, int], path: str, *, jwt: str = ""
+    ) -> None:
+        """Remove a remote stream-shard blob (best-effort GC of
+        superseded generations)."""
+        extra = {
+            "x-sw-w-kind": "blob",
+            "x-sw-w-op": "unlink",
+            "x-sw-w-path": _b64(path),
+        }
+        if jwt:
+            extra["x-sw-w-jwt"] = jwt
+        self._write_request(addr, 0, 0, 0, 0, b"", extra)
 
 
 def make_fetch_into(client: NetPlaneClient, vid: int, generation: int,

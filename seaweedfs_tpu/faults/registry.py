@@ -292,6 +292,16 @@ class FaultRegistry:
                 out[point] = sum(f.fired for f in lst)
             return out
 
+    def armed_points(self) -> frozenset[str]:
+        """The point names currently armed. Lets transport routers make
+        NAMESPACE decisions instead of the all-or-nothing `armed` bool:
+        the net plane refuses service while chaos targets storage-layer
+        points (the Python fallback carries those), but keeps serving
+        when the armed points live on the plane's own seams — otherwise
+        its crash windows could never be exercised."""
+        with self._lock:
+            return frozenset(self._faults)
+
 
 # Module-level singleton + free functions: the production call sites use
 # these, so the disabled fast path is one global-bool check deep.
@@ -327,6 +337,12 @@ def clear() -> None:
 
 def active() -> bool:
     return REGISTRY.armed
+
+
+def armed_points() -> frozenset[str]:
+    if not REGISTRY.armed:
+        return frozenset()
+    return REGISTRY.armed_points()
 
 
 @contextmanager

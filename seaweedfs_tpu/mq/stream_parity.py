@@ -71,10 +71,20 @@ def rotate_bytes_default() -> int:
 
 def remote_roots() -> dict[str, str]:
     """SEAWEED_EC_STREAM_REMOTE_ROOTS ("name=/path[,name=/path...]"):
-    remote-host roots (mounted paths — NFS/bind mounts of other hosts'
-    disks) that a durable-parity partition's stream SHARDS may be
-    placed on, spread by the same `plan_shard_placement` scoring the
-    cluster uses, gated on each root's real byte headroom (statvfs).
+    remote-host roots that a durable-parity partition's stream SHARDS
+    may be placed on, spread by the same `plan_shard_placement` scoring
+    the cluster uses. Two root forms:
+
+    - ``name=/path`` — a MOUNTED path (NFS/bind mount of another
+      host's disk): the planned shard becomes a symlink the encoder's
+      O_CREAT follows, headroom-gated by statvfs.
+    - ``name=net:host:grpcport[/subdir]`` — a volume server's native
+      write plane (ISSUE 18), replacing the shared-mount assumption:
+      the shard stays a local file and every flush PUSHES its newly-
+      durable extent over the plane's kind=blob opcode
+      (``write_blob``, fsync-before-ACK), landing under the peer's
+      stream-blob root. Pruned generations unlink their remote blobs.
+
     Unset (the default) keeps every shard in the local parity dir.
     Losing the local host then still leaves the remotely-placed shards
     of every unsealed tail recoverable — the scoped ISSUE 14 carry."""
@@ -96,6 +106,46 @@ def _statvfs_free(path: str) -> int:
         return int(st.f_bavail) * int(st.f_frsize)
     except OSError:
         return -1
+
+
+def _parse_net_root(spec: str):
+    """``net:host:grpcport[/subdir]`` -> ((host, plane_port), subdir).
+    Raises ValueError on a malformed spec."""
+    from ..ec.net_plane import derive_port
+
+    rest = spec[len("net:"):]
+    hostport, _, sub = rest.partition("/")
+    host, _, port = hostport.rpartition(":")
+    if not host or not port:
+        raise ValueError(f"malformed net root {spec!r}")
+    return (host, derive_port(int(port))), sub.strip("/")
+
+
+_NET_CLIENT = None
+_NET_CLIENT_LOCK = threading.Lock()
+
+
+def _net_client():
+    """Lazy shared NetPlaneClient for net: shard pushes — pooled
+    connections to peer sidecars, shared by every partition."""
+    global _NET_CLIENT
+    with _NET_CLIENT_LOCK:
+        if _NET_CLIENT is None:
+            from ..ec.net_plane import NetPlaneClient
+
+            _NET_CLIENT = NetPlaneClient()
+        return _NET_CLIENT
+
+
+def _net_jwt() -> str:
+    """Blob-scoped token for keyed clusters (SEAWEED_JWT_KEY): the
+    receiving volume server's blob resolver verifies scope "blob"."""
+    key = os.environ.get("SEAWEED_JWT_KEY", "")
+    if not key:
+        return ""
+    from ..utils.security import sign_jwt
+
+    return sign_jwt(key, "blob", ttl_seconds=60)
 
 
 def parity_context() -> ECContext:
@@ -194,6 +244,11 @@ class PartitionParity:
         self._gen = self._max_gen() + 1
         self._gen_base = -1  # first record offset of the open gen
         self.closed = False
+        # net: roots (write-plane pushed shards): per-gen plan of
+        # local shard path -> ((host, port), remote path), and the
+        # per-path byte watermark already pushed+fsynced remotely
+        self._net_shards: dict[int, dict[str, tuple]] = {}
+        self._net_pushed: dict[str, int] = {}
 
     # --------------------------------------------------------- gen layout
 
@@ -290,7 +345,27 @@ class PartitionParity:
             )
         ]
         targets: dict[str, str] = {}
+        net_targets: dict[str, tuple] = {}  # name -> (addr, remote dir)
         for name, root in sorted(self.remote_roots.items()):
+            if root.startswith("net:"):
+                # write-plane push target: no mount to probe, headroom
+                # unknowable here — the peer refuses when full
+                try:
+                    addr, sub = _parse_net_root(root)
+                except ValueError as e:
+                    log.warning("remote parity root %s unusable: %s", root, e)
+                    continue
+                rdir = "/".join(
+                    p for p in (
+                        sub, self.ns, self.topic_name,
+                        f"{self.partition:04d}",
+                    ) if p
+                )
+                net_targets[name] = (addr, rdir)
+                views.append(
+                    NodeView(id=name, free_slots=1 << 20, free_bytes=1 << 50)
+                )
+                continue
             # absolute: the symlink target must resolve the same from
             # the parity dir (link resolution) and from the process cwd
             # (makedirs/prune) — a relative root would split the two
@@ -318,10 +393,19 @@ class PartitionParity:
             views, self._gen, list(range(self.ctx.total)),
             shard_bytes=shard_b,
         )
+        net_plan: dict[str, tuple] = {}
         for sid, node_id in sorted(plan.items()):
             if not node_id:
                 continue  # planned local: a plain file
             path = base + self.ctx.to_ext(sid)
+            if node_id in net_targets:
+                # stays a local file the encoder appends to; flushes
+                # push its durable extents over the write plane
+                addr, rdir = net_targets[node_id]
+                net_plan[path] = (
+                    addr, "/".join((rdir, os.path.basename(path))),
+                )
+                continue
             if os.path.lexists(path):
                 continue
             target = os.path.join(targets[node_id], os.path.basename(path))
@@ -332,6 +416,57 @@ class PartitionParity:
                     "remote shard link %s -> %s failed: %s (local file "
                     "instead)", path, target, e,
                 )
+        if net_plan:
+            self._net_shards[self._gen] = net_plan
+
+    # one kind=blob write per extent chunk: bounds the peer's pooled
+    # landing buffer and keeps a slow peer from stalling flush forever
+    _NET_PUSH_CHUNK = 4 << 20
+
+    def _push_net_shards(self) -> None:
+        """Push every net-planned shard's newly-durable extent
+        [watermark, size) over the write plane (kind=blob,
+        fsync-before-ACK): once this returns, the pushed bytes are
+        durable ON THE PEER. Best-effort: a failed push keeps the
+        watermark so the next flush retries from the same offset; the
+        local shard file remains authoritative either way."""
+        with self._lock:
+            work = [
+                (path, addr, rpath)
+                for plan in self._net_shards.values()
+                for path, (addr, rpath) in sorted(plan.items())
+            ]
+        if not work:
+            return
+        from ..ec.net_plane import NetPlaneError, NetPlaneUnavailable
+
+        jwt = _net_jwt()
+        client = _net_client()
+        for path, addr, rpath in work:
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            off = self._net_pushed.get(path, 0)
+            if off >= size:
+                continue
+            try:
+                with open(path, "rb") as f:
+                    while off < size:
+                        f.seek(off)
+                        data = f.read(min(size - off, self._NET_PUSH_CHUNK))
+                        if not data:
+                            break
+                        client.write_blob(
+                            addr, rpath, off, data, fsync=True, jwt=jwt
+                        )
+                        off += len(data)
+            except (NetPlaneUnavailable, NetPlaneError, OSError) as e:
+                log.warning(
+                    "net shard push %s -> %s stalled at %d: %s",
+                    path, rpath, off, e,
+                )
+            self._net_pushed[path] = off
 
     def _rotate_locked(self, next_base: int) -> None:
         if self._enc is not None:
@@ -373,6 +508,9 @@ class PartitionParity:
         if enc is None:
             return
         enc.flush()
+        # remote durability trails local: net-planned shards push their
+        # newly-flushed extents before this flush returns
+        self._push_net_shards()
         with self._lock:
             if self._enc is enc and enc.head >= self.rotate_bytes:
                 # rotate at a flush boundary so the closed gen's
@@ -407,6 +545,38 @@ class PartitionParity:
 
     def _remove_gen(self, gen: int) -> None:
         base = self._gen_base_path(gen)
+        net_plan = self._net_shards.pop(gen, None) or {}
+        for path in net_plan:
+            self._net_pushed.pop(path, None)
+        targets = set(net_plan.values())
+        # a restarted partition has no in-memory plan for pre-restart
+        # gens: derive every possible remote blob path from the net:
+        # roots config so pruning never leaks peer bytes
+        for _name, root in sorted(self.remote_roots.items()):
+            if not root.startswith("net:"):
+                continue
+            try:
+                addr, sub = _parse_net_root(root)
+            except ValueError:
+                continue
+            rdir = "/".join(
+                p for p in (
+                    sub, self.ns, self.topic_name, f"{self.partition:04d}"
+                ) if p
+            )
+            for i in range(self.ctx.total):
+                bn = os.path.basename(base + self.ctx.to_ext(i))
+                targets.add((addr, rdir + "/" + bn))
+        if targets:
+            from ..ec.net_plane import NetPlaneError, NetPlaneUnavailable
+
+            jwt = _net_jwt()
+            client = _net_client()
+            for addr, rpath in sorted(targets):
+                try:
+                    client.unlink_blob(addr, rpath, jwt=jwt)
+                except (NetPlaneUnavailable, NetPlaneError, OSError):
+                    pass  # orphaned remote blob: GC'd with the root
         for i in range(self.ctx.total):
             path = base + self.ctx.to_ext(i)
             try:
@@ -467,6 +637,8 @@ class PartitionParity:
             if self._enc is not None:
                 self._enc.close(finalize=False)
                 self._enc = None
+        # final tail extents (bytes the closing flush landed locally)
+        self._push_net_shards()
 
     def delete(self) -> None:
         self.close()

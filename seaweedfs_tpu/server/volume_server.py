@@ -669,14 +669,14 @@ class VolumeService:
                 if not chunk:
                     break
                 orig_len = len(chunk)
-                M.net_bytes_copied_total.inc(orig_len, plane="python")
+                M.net_bytes_copied_total.inc(orig_len, plane="python", direction="read")
                 chunk = faults.mutate(
                     "server.ec_shard_read", chunk,
                     volume=request.volume_id, shard=request.shard_id, offset=off,
                 )
                 if chunk:
                     yield pb.EcShardReadChunk(data=chunk)
-                    M.net_bytes_sent_total.inc(len(chunk), plane="python")
+                    M.net_bytes_sent_total.inc(len(chunk), plane="python", direction="read")
                 if len(chunk) < orig_len:
                     break  # torn stream: client sees a short read
                 off += orig_len
@@ -1236,6 +1236,8 @@ class VolumeServer:
                 self._net_plane_resolve,
                 server_label=f"{ip}:{port}",
                 resolve_needle=self._net_plane_resolve_needle,
+                resolve_write=self._net_plane_resolve_write,
+                resolve_blob=self._net_plane_resolve_blob,
             )
         except Exception as e:  # port collision etc: gRPC-only peer
             logger("volume").warning("shard net plane disabled: %s", e)
@@ -1338,6 +1340,116 @@ class VolumeServer:
         except OSError as e:
             raise NetPlaneError(str(e)) from None
         return fd, off, size, crc, True
+
+    def _net_plane_resolve_write(
+        self, vid: int, nid: int, cookie: int, data: bytes, md: dict
+    ) -> tuple[int, int]:
+        """Land one needle for the net plane's write opcode (ISSUE 18)
+        — the exact Needle construction as the gRPC ``WriteNeedle``
+        servicer so a plane write and a gRPC/HTTP write produce
+        bit-identical records. JWT: keyed clusters require a
+        volume-scoped token in ``x-sw-w-jwt`` (the same tokens peers
+        sign for gRPC replication). Replica fan-out runs here unless
+        the client marked the write ``x-sw-w-replicate: 0`` (it IS a
+        replication leg)."""
+        from ..ec.net_plane import (
+            NetPlaneError,
+            NetPlaneVolumeRefusal,
+            _unb64,
+        )
+
+        if self.jwt_key:
+            from ..utils.security import JwtError, verify_jwt
+
+            try:
+                # same scope rule as the HTTP gate: fid-scoped assign
+                # tokens and volume-scoped peer tokens both pass
+                verify_jwt(
+                    self.jwt_key,
+                    md.get("x-sw-w-jwt", ""),
+                    str(FileId(vid, nid, cookie)),
+                )
+            except JwtError:
+                raise NetPlaneError("unauthorized") from None
+        try:
+            flags = int(md.get("x-sw-w-flags", "0") or "0")
+        except ValueError:
+            flags = 0
+        n = Needle(cookie=cookie, needle_id=nid, data=data, flags=flags)
+        name = _unb64(md.get("x-sw-w-name", ""))
+        if name:
+            n.set_name(name)
+        mime = _unb64(md.get("x-sw-w-mime", ""))
+        if mime:
+            n.set_mime(mime)
+        fsync = True if md.get("x-sw-w-fsync") == "1" else None
+        with M.request_seconds.time(server="volume", op="write"):
+            try:
+                size = self.store.write_needle(vid, n, fsync=fsync)
+            except NotFoundError as e:
+                # volume not mounted here: no needle will ever land —
+                # status 2 lets clients negative-cache the vid
+                raise NetPlaneVolumeRefusal(str(e)) from None
+            except (ReadOnlyError, VolumeError, ValueError, OSError) as e:
+                raise NetPlaneError(str(e)) from None
+        M.request_total.inc(server="volume", op="write", code="ok")
+        if md.get("x-sw-w-replicate") != "0":
+            req = pb.WriteNeedleRequest(
+                volume_id=vid,
+                needle_id=nid,
+                cookie=cookie,
+                data=data,
+                flags=flags,
+                name=name.decode(errors="replace") if name else "",
+                mime=mime.decode(errors="replace") if mime else "",
+            )
+            err = self.replicate_write(req)
+            if err:
+                raise NetPlaneError(f"replication: {err}")
+        return size, n.checksum
+
+    def _blob_root(self) -> str:
+        root = os.environ.get("SEAWEED_EC_STREAM_BLOB_ROOT", "")
+        if not root:
+            root = os.path.join(
+                self.store.locations[0].directory, "stream_shards"
+            )
+        return root
+
+    def _net_plane_resolve_blob(self, path: str, op: str, md: dict):
+        """Remote stream-shard blob landing for kind=blob writes — the
+        transport behind ``net:`` durable-parity remote roots. Paths
+        are confined to the blob root (env
+        ``SEAWEED_EC_STREAM_BLOB_ROOT``, default
+        ``<dir0>/stream_shards``); a path that escapes refuses. Returns
+        an fd the plane pwrites+closes, or None when the op was handled
+        here (unlink)."""
+        from ..ec.net_plane import NetPlaneError
+
+        if self.jwt_key:
+            from ..utils.security import JwtError, verify_jwt
+
+            try:
+                verify_jwt(self.jwt_key, md.get("x-sw-w-jwt", ""), "blob")
+            except JwtError:
+                raise NetPlaneError("unauthorized") from None
+        root = os.path.realpath(self._blob_root())
+        full = os.path.realpath(os.path.join(root, path))
+        if full != root and not full.startswith(root + os.sep):
+            raise NetPlaneError("blob path escapes stream root")
+        if op == "unlink":
+            try:
+                os.unlink(full)
+            except FileNotFoundError:
+                pass
+            except OSError as e:
+                raise NetPlaneError(str(e)) from None
+            return None
+        try:
+            os.makedirs(os.path.dirname(full), exist_ok=True)
+            return os.open(full, os.O_CREAT | os.O_RDWR, 0o644)
+        except OSError as e:
+            raise NetPlaneError(str(e)) from None
 
     # ----------------------------------------------------- remote shards
 
@@ -1532,15 +1644,15 @@ class VolumeServer:
                     metadata=trace.grpc_metadata(),
                 ):
                     buf += c.data
-                    M.net_bytes_copied_total.inc(len(c.data), plane="python")
+                    M.net_bytes_copied_total.inc(len(c.data), plane="python", direction="read")
             except grpc.RpcError as e:
                 # mid-stream peer death / stale generation / unreachable:
                 # all retry-then-replan material, never a crash
                 raise PeerFetchTransient(
                     f"{peer}: {e.code().name}: {e.details()}"
                 ) from e
-            M.net_bytes_received_total.inc(len(buf), plane="python")
-            M.net_bytes_copied_total.inc(len(buf), plane="python")
+            M.net_bytes_received_total.inc(len(buf), plane="python", direction="read")
+            M.net_bytes_copied_total.inc(len(buf), plane="python", direction="read")
             return bytes(buf)
 
         # Native ingress (ec/net_plane.py): sibling streams land
@@ -1872,18 +1984,62 @@ class VolumeServer:
 
         return (("authorization", f"Bearer {sign_jwt(self.jwt_key, str(vid))}"),)
 
+    def _plane_replicate(self, host: str, grpc_port: int,
+                         request: pb.WriteNeedleRequest) -> bool:
+        """One replication leg over the native write plane: a pooled
+        sidecar connection instead of a per-write gRPC round trip.
+        Returns False (caller falls back to gRPC) when the plane is
+        off, chaos other than write-path chaos is armed, the peer has
+        no sidecar (memoized with TTL), or the write errs — the gRPC
+        leg is the correctness path, the plane leg only the fast one."""
+        try:
+            from ..ec import net_plane as _netp
+            from ..ec import native_io
+
+            if not native_io.enabled():
+                return False
+            if not _netp.write_plane_admissible():
+                return False
+            jwt = ""
+            if self.jwt_key:
+                from ..utils.security import sign_jwt
+
+                jwt = sign_jwt(self.jwt_key, str(request.volume_id))
+            self._net_plane_client().write_needle(
+                (host, _netp.derive_port(grpc_port)),
+                request.volume_id,
+                request.needle_id,
+                request.cookie,
+                bytes(request.data),
+                flags=request.flags,
+                name=request.name.encode() if request.name else b"",
+                mime=request.mime.encode() if request.mime else b"",
+                jwt=jwt,
+                replicate=False,
+            )
+            return True
+        except Exception:  # noqa: BLE001 — any plane failure => gRPC
+            return False
+
     def replicate_write(self, request: pb.WriteNeedleRequest) -> str:
         """Synchronous fan-out to replica holders (reference
-        store_replicate.go:32 DistributedOperation)."""
+        store_replicate.go:32 DistributedOperation). Each leg tries
+        the native write plane first (pooled connection, fused-CRC
+        landing), falling back to the per-write gRPC ``WriteNeedle``
+        when the peer has no sidecar — both legs produce bit-identical
+        needle records on the replica."""
         errors = []
         md = self._peer_metadata(request.volume_id)
         for loc in self._replica_locations(request.volume_id):
+            host = loc.url.split(":")[0]
+            if self._plane_replicate(host, loc.grpc_port, request):
+                continue
             rep = pb.WriteNeedleRequest()
             rep.CopyFrom(request)
             rep.is_replicate = True
             try:
                 r = self._peer_stub(
-                    f"{loc.url.split(':')[0]}:{loc.grpc_port}"
+                    f"{host}:{loc.grpc_port}"
                 ).WriteNeedle(rep, timeout=30, metadata=md)
                 if r.error:
                     errors.append(f"{loc.url}: {r.error}")

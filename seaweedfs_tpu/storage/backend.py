@@ -128,7 +128,12 @@ class _SizedReader:
     """File-like wrapper with a known length: requests sends a plain
     Content-Length body (a bare generator would make it emit
     Transfer-Encoding: chunked ALONGSIDE the manual Content-Length —
-    a malformed request strict S3 endpoints reject)."""
+    a malformed request strict S3 endpoints reject). Every read is
+    clamped to ``_CHUNK`` — a multi-GiB PUT never materializes more
+    than one bounded chunk in memory regardless of what the HTTP
+    stack asks for — and a source that runs dry before `size` bytes
+    raises instead of silently sending a short body the endpoint
+    would stall on (Content-Length already promised more)."""
 
     def __init__(self, f: BinaryIO, size: int):
         self._f = f
@@ -144,6 +149,11 @@ class _SizedReader:
         if n is None or n < 0:
             n = self._remaining
         piece = self._f.read(min(n, self._remaining, _CHUNK))
+        if not piece:
+            raise BackendError(
+                f"upload source truncated: {self._remaining} of "
+                f"{self._size} bytes still owed"
+            )
         self._remaining -= len(piece)
         return piece
 

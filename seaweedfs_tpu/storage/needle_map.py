@@ -64,6 +64,10 @@ class MemoryNeedleMap:
         self.file_counter = 0
         self.deleted_counter = 0
         self.deleted_bytes = 0
+        # journal appends since the last fsync: flush() is a no-op on a
+        # clean map, so a group-commit window with no index traffic (or
+        # back-to-back flushes) costs zero extra fsyncs
+        self._dirty = False
         self._idx_file = None
         if os.path.exists(idx_path):
             heal_torn_tail(idx_path)
@@ -98,6 +102,7 @@ class MemoryNeedleMap:
         # to the kernel with every journal append: an acked write's index
         # entry must survive SIGKILL (fsync is the caller's power-loss knob)
         self._idx_file.flush()
+        self._dirty = True
 
     def delete(self, needle_id: int) -> int:
         """Append a tombstone; returns freed byte count (0 if absent)."""
@@ -106,6 +111,7 @@ class MemoryNeedleMap:
             NeedleValue(needle_id, 0, TOMBSTONE_FILE_SIZE).to_bytes()
         )
         self._idx_file.flush()
+        self._dirty = True
         if old is None:
             return 0
         self.deleted_counter += 1
@@ -123,7 +129,8 @@ class MemoryNeedleMap:
             yield self._map[nid]
 
     def flush(self) -> None:
-        if self._idx_file:
+        if self._idx_file and self._dirty:
+            self._dirty = False
             self._idx_file.flush()
             os.fsync(self._idx_file.fileno())
 
@@ -161,6 +168,7 @@ class SqliteNeedleMap:
         self.file_counter = 0
         self.deleted_counter = 0
         self.deleted_bytes = 0
+        self._dirty = False  # journal appends since the last fsync
         self._generation = generation
         self._idx_file = None
         heal_torn_tail(idx_path)
@@ -257,6 +265,7 @@ class SqliteNeedleMap:
         self._apply_put(NeedleValue(needle_id, offset, size))
         self._idx_file.write(NeedleValue(needle_id, offset, size).to_bytes())
         self._idx_file.flush()
+        self._dirty = True
         self._maybe_commit()
 
     def delete(self, needle_id: int) -> int:
@@ -265,6 +274,7 @@ class SqliteNeedleMap:
             NeedleValue(needle_id, 0, TOMBSTONE_FILE_SIZE).to_bytes()
         )
         self._idx_file.flush()
+        self._dirty = True
         self._maybe_commit()
         return freed
 
@@ -356,7 +366,8 @@ class SqliteNeedleMap:
         # the .idx journal IS the durability contract; a sqlite commit
         # per fsync'd write would defeat the FLUSH_EVERY batching (a
         # crash before commit is the watermark-tail-replay case)
-        if getattr(self, "_idx_file", None):
+        if getattr(self, "_idx_file", None) and self._dirty:
+            self._dirty = False
             self._idx_file.flush()
             os.fsync(self._idx_file.fileno())
 

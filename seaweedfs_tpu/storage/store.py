@@ -26,6 +26,16 @@ from .volume import NotFoundError, Volume, VolumeError
 # while cold volumes cost nothing. 4x the old per-volume default.
 DEFAULT_EC_INTERVAL_CACHE_BYTES = 64 << 20
 
+def durable_writes_default() -> bool:
+    """SEAWEED_VOLUME_FSYNC=1 makes every needle append power-loss
+    durable before it is acked (fsync — per needle, or amortized over a
+    group-commit window when SEAWEED_VOLUME_GROUP_COMMIT_MS > 0).
+    Default 0 keeps the historical contract: an acked write survives
+    SIGKILL (kernel flush) but not power loss. Read live per write so
+    the bench's phases flip it without restarting servers."""
+    return os.environ.get("SEAWEED_VOLUME_FSYNC", "0") == "1"
+
+
 _DAT_RE = re.compile(r"^(?:(?P<col>[^_]+)_)?(?P<vid>\d+)\.dat$")
 _ECX_RE = re.compile(r"^(?:(?P<col>[^_]+)_)?(?P<vid>\d+)\.ecx$")
 _VIF_RE = re.compile(r"^(?:(?P<col>[^_]+)_)?(?P<vid>\d+)\.vif$")
@@ -383,10 +393,18 @@ class Store:
 
     # --------------------------------------------------------------- io
 
-    def write_needle(self, vid: int, n: Needle, fsync: bool = False) -> int:
+    def write_needle(
+        self, vid: int, n: Needle, fsync: bool | None = None
+    ) -> int:
+        """Append `n` to volume `vid`. `fsync=None` (the transports'
+        default — neither the gRPC proto nor the HTTP upload carries a
+        per-write durability flag) resolves to the store-wide
+        :func:`durable_writes_default`; an explicit bool wins."""
         v = self.find_volume(vid)
         if v is None:
             raise NotFoundError(f"volume {vid} not found")
+        if fsync is None:
+            fsync = durable_writes_default()
         _, size = v.write_needle(n, fsync=fsync)
         return size
 

@@ -26,6 +26,7 @@ from typing import Optional
 
 from .needle import CURRENT_VERSION, FLAG_IS_TOMBSTONE, Needle, footer_size
 from .ttl import TTL
+from .. import faults
 from .needle_map import MemoryNeedleMap
 from .super_block import SUPER_BLOCK_SIZE, ReplicaPlacement, SuperBlock
 from ..utils.fs import fsync_dir
@@ -39,6 +40,113 @@ from .types import (
     padded_record_size,
     to_stored_offset,
 )
+
+
+def _group_commit_window_s() -> float:
+    """SEAWEED_VOLUME_GROUP_COMMIT_MS as seconds (0 = fsync-per-needle,
+    the default). Read live per write so the bench's on/off phases flip
+    it without reopening volumes."""
+    try:
+        ms = float(os.environ.get("SEAWEED_VOLUME_GROUP_COMMIT_MS", "0"))
+    except ValueError:
+        ms = 0.0
+    return max(0.0, ms) / 1000.0
+
+
+class _GroupCommitter:
+    """Amortizes fsync over a bounded window of concurrent durable
+    appends: writers append + kernel-flush under the volume lock, take
+    a WINDOW TICKET, and block until one fsync covering their window
+    completes — N writers inside one window cost one .dat fsync plus
+    one needle-map flush instead of N of each.
+
+    Ordering argument (why a ticket-w writer's bytes are always covered
+    by window w's fsync): the ticket is read under the condition lock
+    BEFORE the committer bumps ``_open_window`` (also under it), and the
+    bump happens-before the fsync starts — so any append that took
+    ticket w was handed to the kernel before window w's fsync began.
+    The durability contract is unchanged from fsync-per-needle: an
+    acked write has survived power loss; only the LATENCY of the ack is
+    traded against fsync amortization (bounded by the window).
+
+    A failed fsync fails every writer waiting on that window (and the
+    error names the window, not a single needle — none of the cohort's
+    bytes are certified durable)."""
+
+    def __init__(self, volume: "Volume", window_s: float):
+        self._volume = volume
+        self._window_s = window_s
+        self._cv = threading.Condition()
+        self._open_window = 0
+        self._completed = -1
+        self._error_upto = -1
+        self._last_error: BaseException | None = None
+        self._pending = 0
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"group-commit-{volume.volume_id}",
+        )
+        self._thread.start()
+
+    @property
+    def window_s(self) -> float:
+        return self._window_s
+
+    def wait_durable(self) -> None:
+        """Block the calling writer (which has already appended and
+        kernel-flushed) until an fsync covering its bytes completes;
+        raise if that fsync failed."""
+        with self._cv:
+            w = self._open_window
+            self._pending += 1
+            self._cv.notify_all()
+            while self._completed < w:
+                if self._stop and not self._thread.is_alive():
+                    raise OSError(
+                        f"volume {self._volume.volume_id} group "
+                        "committer stopped with writes in flight"
+                    )
+                self._cv.wait(timeout=0.5)
+            failed = self._error_upto >= w
+            err = self._last_error if failed else None
+        if failed:
+            raise OSError(f"group commit fsync failed: {err!r}") from err
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while self._pending == 0 and not self._stop:
+                    self._cv.wait(timeout=0.5)
+                if self._pending == 0 and self._stop:
+                    return
+                stopping = self._stop
+            # accumulate the window OUTSIDE any lock: appends keep
+            # landing and taking tickets for this window meanwhile
+            if not stopping and self._window_s > 0:
+                time.sleep(self._window_s)
+            with self._cv:
+                w = self._open_window
+                self._open_window += 1
+                self._pending = 0
+            err: BaseException | None = None
+            try:
+                self._volume._fsync_all()
+            except OSError as e:
+                err = e
+            with self._cv:
+                self._completed = w
+                if err is not None:
+                    self._error_upto = w
+                    self._last_error = err
+                self._cv.notify_all()
+
+    def stop(self) -> None:
+        """Drain pending writers with a final commit, then exit."""
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._thread.join(timeout=5.0)
 
 
 class VolumeError(Exception):
@@ -140,6 +248,7 @@ class Volume:
         self._dat = open(self.dat_path, "r+b")
         self._dat.seek(0, os.SEEK_END)
         self._append_at = self._pad_tail()
+        self._committer: _GroupCommitter | None = None
 
     def _open_remote(self, vif) -> None:
         """Cold-tier mode: reads ride ranged GETs against the backend
@@ -156,6 +265,7 @@ class Volume:
         self.needle_map = self._new_map()
         self._dat = None
         self._append_at = vif.tier_size
+        self._committer = None
         self.read_only = True  # tiered volumes are sealed
 
     @property
@@ -213,12 +323,53 @@ class Volume:
 
     # ------------------------------------------------------------------ io
 
+    def _group_committer(self) -> "_GroupCommitter | None":
+        """The active group committer, (re)built lazily from the live
+        SEAWEED_VOLUME_GROUP_COMMIT_MS value — a window change mid-life
+        (the bench's on/off phases) swaps the committer instead of
+        freezing the open-time value. None when the window is 0
+        (fsync-per-needle)."""
+        w = _group_commit_window_s()
+        c = self._committer
+        if c is not None and c.window_s == w:
+            return c
+        with self._lock:
+            c = self._committer
+            if w <= 0:
+                if c is not None:
+                    self._committer = None
+                    c.stop()
+                return None
+            if c is None or c.window_s != w:
+                if c is not None:
+                    c.stop()
+                c = _GroupCommitter(self, w)
+                self._committer = c
+            return c
+
+    def _fsync_all(self) -> None:
+        """One fsync covering every append already handed to the
+        kernel, with the needle-map idx flush riding the same window —
+        the group committer's commit step."""
+        with self._lock:
+            if self._dat is not None:
+                os.fsync(self._dat.fileno())
+            self.needle_map.flush()
+
     def write_needle(self, n: Needle, fsync: bool = False) -> tuple[int, int]:
         """Append; returns (byte_offset, body_size).
 
         Reference behavior: volume_write.go:167 writeNeedle2 — dedupe
         identical overwrites is NOT done; every write appends.
-        """
+
+        With fsync, the write is power-loss durable before returning:
+        either its own fsync (window 0) or a group-commit window fsync
+        covering it (SEAWEED_VOLUME_GROUP_COMMIT_MS > 0). The chaos
+        kill points volume.write.{before_fsync,after_fsync,before_ack}
+        bracket the durability step — a SIGKILL at any of them must
+        leave the needle fully-acked-durable or clean-unacked, never
+        acked-but-lost (tests/test_group_commit.py)."""
+        committer = self._group_committer() if fsync else None
         with self._lock:
             self._check_not_broken()
             if self.read_only:
@@ -229,21 +380,37 @@ class Volume:
             offset = self._append_at
             self._dat.seek(offset)
             self._dat.write(raw)
+            faults.fire(
+                "volume.write.before_fsync",
+                volume=self.volume_id, needle=n.needle_id,
+            )
             # ALWAYS hand the bytes to the kernel before acknowledging:
             # an acked write must survive SIGKILL of this process (page
             # cache). fsync additionally survives power loss.
             self._dat.flush()
-            if fsync:
+            if fsync and committer is None:
                 os.fsync(self._dat.fileno())
             self._append_at = offset + len(raw)
             self._last_write_ts = time.time()
             _, _, size = Needle.parse_header(raw)
             self.needle_map.put(n.needle_id, to_stored_offset(offset), size)
-            if fsync:
+            if fsync and committer is None:
                 # power-loss durability covers the INDEX entry too:
                 # recovery replays only the .idx
                 self.needle_map.flush()
-            return offset, size
+        if fsync and committer is not None:
+            # ticket wait OUTSIDE the volume lock: the window
+            # accumulates sibling appends while this writer blocks
+            committer.wait_durable()
+        faults.fire(
+            "volume.write.after_fsync",
+            volume=self.volume_id, needle=n.needle_id,
+        )
+        faults.fire(
+            "volume.write.before_ack",
+            volume=self.volume_id, needle=n.needle_id,
+        )
+        return offset, size
 
     def _check_not_broken(self) -> None:
         if self.broken:
@@ -436,6 +603,12 @@ class Volume:
             self.needle_map.flush()
 
     def close(self) -> None:
+        # stop the committer BEFORE taking the volume lock: its commit
+        # step takes that lock, and a stop() under it would deadlock
+        c = self._committer
+        if c is not None:
+            self._committer = None
+            c.stop()
         with self._lock:
             self.flush()
             if self._dat is not None:

@@ -529,12 +529,12 @@ def send_body(handler, *parts) -> int:
                 # connection, and surface like a stdlib write error
                 handler.close_connection = True
                 raise
-            metrics.net_bytes_sent_total.inc(total, plane="native")
+            metrics.net_bytes_sent_total.inc(total, plane="native", direction="read")
             return total
     for p in parts:
         handler.wfile.write(p)
-    metrics.net_bytes_sent_total.inc(total, plane="python")
-    metrics.net_bytes_copied_total.inc(total, plane="python")
+    metrics.net_bytes_sent_total.inc(total, plane="python", direction="read")
+    metrics.net_bytes_copied_total.inc(total, plane="python", direction="read")
     return total
 
 

@@ -384,23 +384,26 @@ gateway_rejected_total = REGISTRY.counter(
 # at the instrumented seams (gRPC chunk joins, wfile writes, pread
 # bytes) — bytes_copied_per_byte_served in bench.py is
 # copied(plane) / served(plane), ~0 for the native plane.
+# `direction` (ISSUE 18) splits the read-serving path from the write
+# path (needle/blob WRITE opcode, replica fan-out, stream-shard push)
+# so the copies-per-byte derivation covers PUTs too.
 net_bytes_sent_total = REGISTRY.counter(
     "sw_net_bytes_sent_total",
     "payload bytes sent on the network byte path (shard net plane, "
-    "EC shard-read RPC, gateway HTTP body egress)",
-    ("plane",),
+    "EC shard-read RPC, gateway HTTP body egress, write-opcode egress)",
+    ("plane", "direction"),
 )
 net_bytes_received_total = REGISTRY.counter(
     "sw_net_bytes_received_total",
     "payload bytes landed from the network byte path (peer-fetch "
-    "ingress)",
-    ("plane",),
+    "ingress, write-opcode landing)",
+    ("plane", "direction"),
 )
 net_bytes_copied_total = REGISTRY.counter(
     "sw_net_bytes_copied_total",
     "payload bytes materialized into Python-level buffers on the "
     "network byte path (the bytes-copied-per-byte-served numerator)",
-    ("plane",),
+    ("plane", "direction"),
 )
 
 # Warm-path control plane (ISSUE 13): SigV4 verdict-memo outcomes on

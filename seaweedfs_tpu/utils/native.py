@@ -196,6 +196,23 @@ _lib.sn_recv_into.argtypes = [
 ]
 _lib.sn_recv_overlap_active.restype = ctypes.c_int
 _lib.sn_recv_overlap_active.argtypes = [ctypes.c_uint64]
+# Write-opcode blob landing (ISSUE 18): socket -> disk with the CRC
+# fused into the bounce-buffer loop. Guarded so a prebuilt .so from an
+# older tree (no toolchain to rebuild) degrades to the Python landing
+# instead of failing the whole module import.
+try:
+    _lib.sn_recv_file.restype = ctypes.c_int64
+    _lib.sn_recv_file.argtypes = [
+        ctypes.c_int,     # fd (socket)
+        ctypes.c_int,     # out_fd (file)
+        ctypes.c_uint64,  # offset
+        ctypes.c_uint64,  # len
+        ctypes.c_int,     # timeout_ms
+        ctypes.c_void_p,  # crc_out (u32[1])
+    ]
+    _HAS_RECV_FILE = True
+except AttributeError:  # pragma: no cover - stale prebuilt .so
+    _HAS_RECV_FILE = False
 _lib.sn_sink_direct_flags.restype = ctypes.c_int
 _lib.sn_sink_direct_flags.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
 _lib.sn_has_avx2.restype = ctypes.c_int
@@ -521,6 +538,35 @@ def recv_into(
     if got < 0:
         raise OSError(-got, f"sn_recv_into: {os.strerror(-got)}")
     return int(got)
+
+
+def has_recv_file() -> bool:
+    """Whether the loaded .so exports sn_recv_file (older prebuilt
+    libraries may not; callers then land blob writes in Python)."""
+    return _HAS_RECV_FILE
+
+
+def recv_file(
+    fd: int, out_fd: int, offset: int, length: int, *,
+    timeout_ms: int = -1,
+) -> tuple[int, int]:
+    """Land `length` bytes from socket `fd` straight into file `out_fd`
+    at `offset` — the write-opcode blob ingress: socket -> bounce
+    buffer -> pwrite(2) with one CRC32C rolled over the payload while
+    each chunk is cache-hot, no Python-side byte handling. Returns
+    (bytes_landed, crc32c); SHORT means the peer closed mid-stream (the
+    partial extent is on disk but callers must not ACK it). Raises
+    OSError on socket or pwrite failure."""
+    if not _HAS_RECV_FILE:
+        raise OSError("sn_recv_file not available in loaded .so")
+    crc_out = np.zeros(1, np.uint32)
+    got = _lib.sn_recv_file(
+        fd, out_fd, offset, length, timeout_ms,
+        ctypes.c_void_p(crc_out.ctypes.data),
+    )
+    if got < 0:
+        raise OSError(-got, f"sn_recv_file: {os.strerror(-got)}")
+    return int(got), int(crc_out[0])
 
 
 class NativeSink:

@@ -465,7 +465,9 @@ def test_migration_moves_shard_set_bit_identical(cluster):
     if native_io.enabled():
         rec1 = M.net_bytes_received_total.snapshot()
         moved = sum(len(ground[s]) for s in KEEP_LOCAL)
-        native_delta = rec1.get(("native",), 0) - rec0.get(("native",), 0)
+        native_delta = rec1.get(("native", "read"), 0) - rec0.get(
+            ("native", "read"), 0
+        )
         assert native_delta >= moved, (
             "migration bytes did not ride the native plane"
         )

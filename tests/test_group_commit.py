@@ -1,0 +1,265 @@
+"""Group commit + write-path crash windows (ISSUE 18 satellite).
+
+The durability contract under test: a write acked with fsync=True has
+survived SIGKILL at every kill point — covered either by its own fsync
+(window 0, the default) or by a group-commit window fsync
+(SEAWEED_VOLUME_GROUP_COMMIT_MS > 0) — and a kill BEFORE the ack
+leaves the volume cleanly replayable, the unacked needle either fully
+present or absent, never acked-but-lost. The forked children mirror
+tests/test_ec_chaos.py's crash-window idiom: `hard_exit` armed at one
+fault point, the parent asserting on the replayed on-disk state.
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+import os
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu import faults
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import NotFoundError, Volume
+
+DATA1 = b"first-acked-" * 200
+DATA2 = b"second-dies-" * 200
+
+
+# ------------------------------------------------------- group commit
+
+
+def test_group_commit_batches_fsyncs(tmp_path, monkeypatch):
+    """N concurrent durable writers inside one window cost a handful
+    of fsyncs, not 2N (.dat + .idx per needle) — and every acked write
+    reads back."""
+    monkeypatch.setenv("SEAWEED_VOLUME_GROUP_COMMIT_MS", "30")
+    v = Volume(str(tmp_path), 1, create=True)
+    real_fsync = os.fsync
+    count = [0]
+
+    def counting_fsync(fd):
+        count[0] += 1
+        real_fsync(fd)
+
+    monkeypatch.setattr(os, "fsync", counting_fsync)
+    n_writers = 16
+    errs = []
+
+    def write(i):
+        try:
+            v.write_needle(
+                Needle(cookie=0x10 + i, needle_id=100 + i, data=DATA1),
+                fsync=True,
+            )
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=write, args=(i,)) for i in range(n_writers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errs, errs
+    batched = count[0]
+    # fsync-per-needle would cost 2 * n_writers syncs; a 30ms window
+    # over near-simultaneous writers covers them in a few commits
+    assert batched < 2 * n_writers, f"no batching: {batched} fsyncs"
+    for i in range(n_writers):
+        assert v.read_needle(100 + i).data == DATA1
+    # window -> 0 mid-life: the committer is torn down and the next
+    # durable write fsyncs inline (the bench's off phase)
+    monkeypatch.setenv("SEAWEED_VOLUME_GROUP_COMMIT_MS", "0")
+    before = count[0]
+    v.write_needle(Needle(cookie=1, needle_id=999, data=DATA1), fsync=True)
+    assert v._committer is None
+    assert count[0] >= before + 1
+    v.close()
+
+
+def test_group_commit_fsync_error_fails_whole_window(tmp_path, monkeypatch):
+    """A failed window fsync certifies NOTHING: every writer waiting on
+    that window gets the error instead of a false durability ack."""
+    monkeypatch.setenv("SEAWEED_VOLUME_GROUP_COMMIT_MS", "20")
+    v = Volume(str(tmp_path), 1, create=True)
+    real = Volume._fsync_all
+    monkeypatch.setattr(
+        Volume, "_fsync_all",
+        lambda self: (_ for _ in ()).throw(OSError("disk gone")),
+    )
+    errs = []
+
+    def write(i):
+        try:
+            v.write_needle(
+                Needle(cookie=i, needle_id=200 + i, data=DATA1), fsync=True
+            )
+        except OSError as e:
+            errs.append(str(e))
+
+    threads = [threading.Thread(target=write, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert len(errs) == 3
+    assert all("group commit fsync failed" in e for e in errs)
+    # healed disk: the same committer serves the next window
+    monkeypatch.setattr(Volume, "_fsync_all", real)
+    v.write_needle(Needle(cookie=9, needle_id=300, data=DATA1), fsync=True)
+    assert v.read_needle(300).data == DATA1
+    v.close()
+
+
+def test_window_zero_is_fsync_per_needle(tmp_path, monkeypatch):
+    """The default (window 0) keeps the old contract exactly: each
+    durable write fsyncs .dat and flushes the idx inline, no committer
+    thread exists."""
+    monkeypatch.delenv("SEAWEED_VOLUME_GROUP_COMMIT_MS", raising=False)
+    v = Volume(str(tmp_path), 1, create=True)
+    real_fsync = os.fsync
+    count = [0]
+    monkeypatch.setattr(
+        os, "fsync", lambda fd: (count.__setitem__(0, count[0] + 1),
+                                 real_fsync(fd))[1]
+    )
+    v.write_needle(Needle(cookie=1, needle_id=1, data=DATA1), fsync=True)
+    assert v._committer is None
+    assert count[0] >= 2  # .dat + .idx
+    v.close()
+
+
+# ------------------------------------------- volume write crash matrix
+
+
+def _volume_crash_child(dirpath, point, window_ms, conn):
+    os.environ["SEAWEED_VOLUME_GROUP_COMMIT_MS"] = str(window_ms)
+    v = Volume(dirpath, 1, create=True)
+    v.write_needle(Needle(cookie=0x11, needle_id=1, data=DATA1), fsync=True)
+    conn.send(("acked", 1))
+    faults.inject(point, faults.hard_exit(137))
+    v.write_needle(Needle(cookie=0x22, needle_id=2, data=DATA2), fsync=True)
+    conn.send(("acked", 2))  # pragma: no cover - only on fault miss
+    os._exit(0)  # pragma: no cover
+
+
+@pytest.mark.parametrize("window_ms", [0, 15])
+@pytest.mark.parametrize(
+    "point",
+    [
+        "volume.write.before_fsync",
+        "volume.write.after_fsync",
+        "volume.write.before_ack",
+    ],
+)
+def test_volume_write_crash_acked_is_durable(tmp_path, point, window_ms):
+    """SIGKILL at each write-path kill point, per fsync mode: the
+    acked needle replays intact; the mid-write needle is fully present
+    or cleanly absent; a kill AFTER the durability step (but before
+    the ack) still finds the bytes on disk."""
+    mp = multiprocessing.get_context("fork")
+    parent, child = mp.Pipe()
+    p = mp.Process(
+        target=_volume_crash_child,
+        args=(str(tmp_path), point, window_ms, child),
+    )
+    p.start()
+    p.join(timeout=120)
+    assert not p.is_alive(), "crash child hung"
+    assert p.exitcode == 137, f"expected hard crash, got {p.exitcode}"
+    msgs = []
+    while parent.poll():
+        msgs.append(parent.recv())
+    assert ("acked", 1) in msgs
+    assert ("acked", 2) not in msgs, "child survived past the crash point"
+    v = Volume(str(tmp_path), 1, create=False)
+    try:
+        assert v.read_needle(1).data == DATA1, "ACKED write lost"
+        if point in ("volume.write.after_fsync", "volume.write.before_ack"):
+            # the durability step completed before the kill
+            assert v.read_needle(2).data == DATA2
+        else:
+            # unacked: fully there or absent — never torn, never wrong
+            try:
+                assert v.read_needle(2).data == DATA2
+            except NotFoundError:
+                pass
+    finally:
+        v.close()
+
+
+# -------------------------------------- net-plane write crash matrix
+
+
+def _refuse_shards(vid, sid, gen):
+    from seaweedfs_tpu.ec import net_plane
+
+    raise net_plane.NetPlaneError("no shards here")
+
+
+def _plane_crash_child(dirpath, point, conn):
+    from seaweedfs_tpu.ec import net_plane
+
+    v = Volume(dirpath, 1, create=True)
+
+    def resolve_write(vid, nid, cookie, data, md):
+        n = Needle(cookie=cookie, needle_id=nid, data=data)
+        _, size = v.write_needle(n, fsync=True)
+        return size, n.checksum
+
+    srv = net_plane.ShardNetPlane(
+        "127.0.0.1", 0, _refuse_shards, resolve_write=resolve_write
+    )
+    srv.start()
+    # second write dies at the armed point; the first must serve
+    # normally even though write-path chaos is armed (the write plane
+    # stays admissible under its own namespaces)
+    faults.inject(point, faults.hard_exit(137), when=faults.nth_call(2))
+    conn.send(srv.port)
+    time.sleep(120)  # pragma: no cover - killed by the fault
+    os._exit(1)  # pragma: no cover
+
+
+@pytest.mark.parametrize(
+    "point", ["ec.net.write.before_pwrite", "ec.net.write.after_pwrite"]
+)
+def test_net_plane_write_crash_acked_is_durable(tmp_path, point):
+    """SIGKILL the volume-server side of a native-plane write: the
+    previously ACKED needle replays intact; the in-flight one is on
+    disk iff the kill came after the pwrite+fsync — and the client saw
+    no ack either way."""
+    from seaweedfs_tpu.ec import net_plane
+
+    mp = multiprocessing.get_context("fork")
+    parent, child = mp.Pipe()
+    p = mp.Process(
+        target=_plane_crash_child, args=(str(tmp_path), point, child)
+    )
+    p.start()
+    assert parent.poll(30), "child never published its port"
+    port = parent.recv()
+    client = net_plane.NetPlaneClient()
+    try:
+        addr = ("127.0.0.1", port)
+        size, crc = client.write_needle(addr, 1, 1, 0x11, DATA1)
+        assert size > 0
+        with pytest.raises(net_plane.NetPlaneError):
+            client.write_needle(addr, 1, 2, 0x22, DATA2)
+    finally:
+        client.close()
+    p.join(timeout=120)
+    assert not p.is_alive(), "crash child hung"
+    assert p.exitcode == 137, f"expected hard crash, got {p.exitcode}"
+    v = Volume(str(tmp_path), 1, create=False)
+    try:
+        assert v.read_needle(1).data == DATA1, "ACKED plane write lost"
+        if point == "ec.net.write.after_pwrite":
+            assert v.read_needle(2).data == DATA2
+        else:
+            with pytest.raises(NotFoundError):
+                v.read_needle(2)
+    finally:
+        v.close()

@@ -375,3 +375,76 @@ def test_remote_roots_place_stream_shards_and_recover(tmp_path, monkeypatch):
         os.path.islink(os.path.join(pp3.dir, n))
         for n in os.listdir(pp3.dir)
     )
+
+
+def test_net_remote_roots_push_shards_over_write_plane(tmp_path, monkeypatch):
+    """ISSUE 18: a ``net:host:grpcport/sub`` remote root replaces the
+    shared-mount assumption — planned shards stay LOCAL files and every
+    flush pushes the newly-durable extent to the peer's write plane
+    (kind=blob, fsync-before-ACK). Recovery stays purely local; delete
+    unlinks the remote replicas."""
+    from conftest import allocate_port as free_port
+
+    from seaweedfs_tpu.ec import net_plane
+    from seaweedfs_tpu.mq.stream_parity import PartitionParity as PP
+
+    remote_root = tmp_path / "peer_blobs"
+    served: list[tuple] = []
+
+    def resolve_blob(path, op, md):
+        served.append((op, path))
+        full = os.path.join(str(remote_root), path)
+        if op == "unlink":
+            try:
+                os.unlink(full)
+            except FileNotFoundError:
+                pass
+            return None
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        return os.open(full, os.O_CREAT | os.O_RDWR, 0o644)
+
+    def _refuse(vid, sid, gen):
+        raise net_plane.NetPlaneError("no shards here")
+
+    gport = free_port()
+    srv = net_plane.ShardNetPlane(
+        "127.0.0.1", net_plane.derive_port(gport), _refuse,
+        resolve_blob=resolve_blob,
+    )
+    srv.start()
+    monkeypatch.setenv(
+        "SEAWEED_EC_STREAM_REMOTE_ROOTS", f"peer=net:127.0.0.1:{gport}/sub"
+    )
+    try:
+        pp = PP(str(tmp_path / "local"), "ns", "t", 0)
+        msgs = [(i, 10 + i, *_msg(i)) for i in range(40)]
+        for off, ts, k, v in msgs:
+            pp.append_record(off, ts, k, v)
+        pp.flush()
+        plans = {
+            path: rpath
+            for plan in pp._net_shards.values()
+            for path, (addr, rpath) in plan.items()
+        }
+        assert plans, "no shard was planned onto the net: root"
+        # shards stay plain local files — no symlinks involved
+        assert all(not os.path.islink(p) for p in plans)
+        pp.close()
+        for path, rpath in plans.items():
+            assert rpath.startswith("sub/ns/t/0000/")
+            with open(path, "rb") as f:
+                local = f.read()
+            with open(os.path.join(str(remote_root), rpath), "rb") as f:
+                assert f.read() == local, f"remote replica diverged: {rpath}"
+        assert any(op == "write" for op, _ in served)
+        # recovery is purely local (the peer could be down)
+        pp2 = PP(str(tmp_path / "local"), "ns", "t", 0)
+        assert pp2.recover() == msgs
+        pp2.delete()
+        for rpath in plans.values():
+            assert not os.path.exists(
+                os.path.join(str(remote_root), rpath)
+            ), "delete left remote shard bytes"
+        assert any(op == "unlink" for op, _ in served)
+    finally:
+        srv.stop()

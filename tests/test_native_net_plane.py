@@ -556,19 +556,20 @@ def test_pooled_http_get_native_vs_buffered_byte_identity(monkeypatch):
     try:
         port = srv.socket.getsockname()[1]
         url = f"http://127.0.0.1:{port}/x"
+        def delta(snap0, plane_name):
+            cur = dict(M.net_bytes_sent_total.snapshot())
+            return cur.get((plane_name, "read"), 0) - snap0.get(
+                (plane_name, "read"), 0
+            )
+
         before = dict(M.net_bytes_sent_total.snapshot())
         got_native = urllib.request.urlopen(url, timeout=10).read()
+        assert _settle(lambda: delta(before, "native") == len(body))
         mid = dict(M.net_bytes_sent_total.snapshot())
         monkeypatch.setenv("SEAWEED_EC_NATIVE", "0")
         got_python = urllib.request.urlopen(url, timeout=10).read()
-        after = dict(M.net_bytes_sent_total.snapshot())
         assert got_native == got_python == body
-        assert mid.get(("native",), 0) - before.get(("native",), 0) == len(
-            body
-        )
-        assert after.get(("python",), 0) - mid.get(("python",), 0) == len(
-            body
-        )
+        assert _settle(lambda: delta(mid, "python") == len(body))
     finally:
         srv.shutdown()
         srv.server_close()
@@ -634,6 +635,18 @@ def test_fastread_stale_on_shared_header_change(tmp_path, monkeypatch):
 # pooled aligned buffers with the CRC fused into the copy-in.
 
 
+def _settle(fn, timeout=5.0):
+    """Egress byte counters land AFTER the last payload byte is on the
+    wire, so a fast client can observe the full body before the serving
+    thread runs its bookkeeping — poll briefly instead of racing it."""
+    import time as _time
+
+    deadline = _time.monotonic() + timeout
+    while not fn() and _time.monotonic() < deadline:
+        _time.sleep(0.005)
+    return fn()
+
+
 def _refuse_shards(vid, sid, gen):
     raise net_plane.NetPlaneError("no shards here")
 
@@ -676,10 +689,10 @@ def test_needle_read_roundtrip(tmp_path, monkeypatch, plane):
         assert got == payload
         assert srv.needle_requests == 1
         if plane == "native":
-            assert srv.sendfile_bytes == len(payload)
+            assert _settle(lambda: srv.sendfile_bytes == len(payload))
             assert srv.python_bytes == 0
         else:
-            assert srv.python_bytes == len(payload)
+            assert _settle(lambda: srv.python_bytes == len(payload))
             assert srv.sendfile_bytes == 0
         # second read reuses the pooled connection
         assert client.read_needle(
@@ -1089,3 +1102,424 @@ def test_needle_level_refusal_not_negative_cached(tmp_path):
     finally:
         ops.close()
         srv.stop()
+
+
+# ------------------------------------------ needle write opcode (ISSUE 18)
+# The PUT path's native twin: client header + payload on a pooled
+# connection, server lands into pooled buffers (CRC fused into the
+# copy-in), resolver appends to the volume, ACK carries the STORED CRC.
+
+
+def _write_plane(resolve_write=None, resolve_blob=None):
+    srv = net_plane.ShardNetPlane(
+        "127.0.0.1", 0, _refuse_shards,
+        resolve_write=resolve_write, resolve_blob=resolve_blob,
+        server_label="write-test",
+    )
+    srv.start()
+    return srv
+
+
+@pytest.mark.parametrize("plane", ["native", "python"])
+def test_needle_write_roundtrip(monkeypatch, plane):
+    """One needle over the write opcode on both landing planes: the
+    resolver sees the exact payload + meta, the ACK certifies the
+    stored CRC, and the server counts ingress on the right plane.
+    Ragged payload (not a granule multiple) exercises the fused CRC's
+    tail path."""
+    if plane == "python":
+        monkeypatch.setenv("SEAWEED_EC_NATIVE", "0")
+    payload = np.random.default_rng(7).integers(
+        0, 256, 300_001, dtype=np.uint8
+    ).tobytes()
+    stored = {}
+
+    def resolve_write(vid, nid, cookie, data, md):
+        stored[(vid, nid)] = (cookie, data, dict(md))
+        return len(data), crc32c(data)
+
+    srv = _write_plane(resolve_write)
+    client = net_plane.NetPlaneClient()
+    try:
+        size, crc = client.write_needle(
+            ("127.0.0.1", srv.port), 7, 0xABC, 0x55, payload,
+            name=b"f.bin", mime=b"application/x-test", fsync=True,
+        )
+        assert size == len(payload) and crc == crc32c(payload)
+        cookie, data, md = stored[(7, 0xABC)]
+        assert cookie == 0x55 and data == payload
+        assert md["x-sw-w-fsync"] == "1"
+        assert net_plane._unb64(md["x-sw-w-name"]) == b"f.bin"
+        assert net_plane._unb64(md["x-sw-w-mime"]) == b"application/x-test"
+        assert srv.write_requests == 1
+        if plane == "native":
+            assert srv.write_native_bytes == len(payload)
+            assert srv.write_python_bytes == 0
+        else:
+            assert srv.write_python_bytes == len(payload)
+            assert srv.write_native_bytes == 0
+        # second write reuses the pooled connection
+        client.write_needle(("127.0.0.1", srv.port), 7, 0xDEF, 0x66, b"x")
+        assert srv.write_requests == 2
+    finally:
+        client.close()
+        srv.stop()
+
+
+def test_needle_write_volume_refusal_negative_cachable():
+    """A volume-level write refusal (status 2) surfaces with
+    volume_refusal=True — clients negative-cache the vid — and the
+    pooled connection SURVIVES (the server drains the payload before
+    refusing)."""
+
+    def refuse(vid, nid, cookie, data, md):
+        raise net_plane.NetPlaneVolumeRefusal("volume not here")
+
+    srv = _write_plane(refuse)
+    client = net_plane.NetPlaneClient()
+    try:
+        with pytest.raises(net_plane.NetPlaneError, match="not here") as ei:
+            client.write_needle(
+                ("127.0.0.1", srv.port), 1, 2, 3, b"zz" * 5000
+            )
+        assert getattr(ei.value, "volume_refusal", False)
+        with pytest.raises(net_plane.NetPlaneError, match="not here"):
+            client.write_needle(("127.0.0.1", srv.port), 1, 9, 3, b"y")
+        assert srv.write_requests == 2, "refusal killed the connection"
+    finally:
+        client.close()
+        srv.stop()
+
+
+def test_needle_write_without_resolver_refused():
+    """A read-only sidecar (no resolve_write wired) refuses write
+    frames in-protocol instead of dropping the connection."""
+    srv = _write_plane(resolve_write=None)
+    client = net_plane.NetPlaneClient()
+    try:
+        with pytest.raises(
+            net_plane.NetPlaneError, match="not served here"
+        ):
+            client.write_needle(("127.0.0.1", srv.port), 1, 2, 3, b"data")
+        assert srv.write_requests == 0
+    finally:
+        client.close()
+        srv.stop()
+
+
+def test_needle_write_stored_crc_mismatch_raises():
+    """An ACK whose stored CRC disagrees with what the client sent is
+    an error, not a silent accept — end-to-end bit certification."""
+
+    def liar(vid, nid, cookie, data, md):
+        return len(data), crc32c(data) ^ 0xBAD
+
+    srv = _write_plane(liar)
+    client = net_plane.NetPlaneClient()
+    try:
+        with pytest.raises(
+            net_plane.NetPlaneError, match="stored CRC mismatch"
+        ):
+            client.write_needle(("127.0.0.1", srv.port), 1, 2, 3, b"abc")
+    finally:
+        client.close()
+        srv.stop()
+
+
+def test_write_plane_admissible_namespaces():
+    """Write-path chaos (ec.net.write.*, volume.write.*) leaves the
+    write plane admissible — the crash matrix rides the native path —
+    while any OTHER armed point routes writes to the fallback."""
+    assert net_plane.write_plane_admissible()
+    with faults.injected(
+        "ec.net.write.before_pwrite", faults.latency(0.0),
+        when=faults.always(),
+    ):
+        assert net_plane.write_plane_admissible()
+    with faults.injected(
+        "volume.write.before_fsync", faults.latency(0.0),
+        when=faults.always(),
+    ):
+        assert net_plane.write_plane_admissible()
+    with faults.injected(
+        "storage.disk.read_at", faults.latency(0.0), when=faults.always()
+    ):
+        assert not net_plane.write_plane_admissible()
+
+
+def test_needle_write_refused_when_foreign_chaos_armed():
+    """Server-side: an armed non-write fault registry refuses write
+    frames (drained, in-protocol) so chaos runs against the gRPC/HTTP
+    fallback; write-namespace chaos is served."""
+    stored = {}
+
+    def resolve_write(vid, nid, cookie, data, md):
+        stored[nid] = data
+        return len(data), crc32c(data)
+
+    srv = _write_plane(resolve_write)
+    client = net_plane.NetPlaneClient()
+    try:
+        with faults.injected(
+            "unrelated.point", faults.latency(0.0), when=faults.always()
+        ):
+            with pytest.raises(
+                net_plane.NetPlaneError, match="registry armed"
+            ):
+                client.write_needle(
+                    ("127.0.0.1", srv.port), 1, 2, 3, b"k" * 100
+                )
+        with faults.injected(
+            "ec.net.write.before_pwrite", faults.latency(0.0),
+            when=faults.always(),
+        ):
+            client.write_needle(("127.0.0.1", srv.port), 1, 2, 3, b"served")
+        assert stored[2] == b"served"
+    finally:
+        client.close()
+        srv.stop()
+
+
+@pytest.mark.parametrize("plane", ["native", "python"])
+def test_blob_write_roundtrip_and_unlink(tmp_path, monkeypatch, plane):
+    """kind=blob: extents land at their file offset (sn_recv_file on
+    the native plane — socket to disk, CRC fused, zero Python byte
+    handling), the ACK CRC matches the payload, and op=unlink removes
+    the blob via the resolver."""
+    if plane == "python":
+        monkeypatch.setenv("SEAWEED_EC_NATIVE", "0")
+    root = tmp_path / "blobs"
+
+    def resolve_blob(path, op, md):
+        p = root / path
+        if op == "unlink":
+            try:
+                os.unlink(p)
+            except FileNotFoundError:
+                pass
+            return None
+        p.parent.mkdir(parents=True, exist_ok=True)
+        return os.open(p, os.O_CREAT | os.O_RDWR, 0o644)
+
+    srv = _write_plane(resolve_blob=resolve_blob)
+    client = net_plane.NetPlaneClient()
+    addr = ("127.0.0.1", srv.port)
+    data = np.random.default_rng(5).integers(
+        0, 256, 123_457, dtype=np.uint8
+    ).tobytes()
+    try:
+        assert client.write_blob(addr, "sub/s.ec00", 8, data) == len(data)
+        raw = (root / "sub/s.ec00").read_bytes()
+        assert raw[:8] == b"\0" * 8 and raw[8:] == data
+        # append-extend the same blob at the watermark
+        client.write_blob(addr, "sub/s.ec00", 8 + len(data), b"tail")
+        assert (root / "sub/s.ec00").read_bytes()[8 + len(data):] == b"tail"
+        if plane == "native":
+            assert srv.write_native_bytes == len(data) + 4
+        else:
+            assert srv.write_python_bytes == len(data) + 4
+        client.unlink_blob(addr, "sub/s.ec00")
+        assert not (root / "sub/s.ec00").exists()
+    finally:
+        client.close()
+        srv.stop()
+
+
+# ------------------------------- write path end to end (cluster level)
+# Bit identity across transports, sidecar-death fallback, and replica
+# fan-out riding the plane — against real master + volume servers.
+
+
+@pytest.fixture
+def write_cluster(tmp_path):
+    import time as _time
+
+    from conftest import allocate_port as free_port
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    mport = free_port()
+    master = MasterServer(ip="localhost", port=mport)
+    master.start()
+    vols = []
+    for i in range(2):
+        vs = VolumeServer(
+            directories=[str(tmp_path / f"v{i}")],
+            master=f"localhost:{mport}",
+            ip="localhost",
+            port=free_port(),
+            ec_backend="cpu",
+        )
+        vs.start()
+        vols.append(vs)
+    deadline = _time.time() + 10
+    while len(master.topo.nodes) < 2:
+        assert _time.time() < deadline, "volume servers did not register"
+        _time.sleep(0.05)
+    yield master, vols
+    for vs in vols:
+        vs.stop()
+    master.stop()
+
+
+def _canon_record(raw: bytes) -> bytes:
+    """Needle record bytes with the append timestamp normalized — the
+    only field two transports may legitimately disagree on."""
+    from seaweedfs_tpu.storage.needle import Needle
+
+    n = Needle.from_bytes(bytes(raw))
+    n.append_at_ns = 1
+    return n.to_bytes()
+
+
+def _latest_record(vs, vid: int, nid: int) -> bytes:
+    from seaweedfs_tpu.storage.types import actual_offset
+
+    vol = vs.store.find_volume(vid)
+    assert vol is not None
+    nv = vol.needle_map.get(nid)
+    assert nv is not None
+    return vol._pread_record(actual_offset(nv.offset), nv.size)
+
+
+def _holder(vols, vid):
+    for vs in vols:
+        if vs.store.find_volume(vid) is not None:
+            return vs
+    raise AssertionError(f"volume {vid} on no server")
+
+
+def test_write_bit_identity_plane_vs_http_vs_grpc(write_cluster):
+    """ISSUE 18 satellite: the SAME fid written over the native write
+    opcode, the HTTP multipart POST, and the gRPC WriteNeedle lands
+    byte-identical needle records on disk (timestamp normalized) —
+    ragged payload so the fused CRC's tail path is in the loop."""
+    import requests as _requests
+
+    from seaweedfs_tpu.client.operations import Operations
+    from seaweedfs_tpu.pb import cluster_pb2 as pb
+    from seaweedfs_tpu.storage.file_id import FileId
+
+    master, vols = write_cluster
+    ops = Operations(f"localhost:{master.port}")
+    payload = np.random.default_rng(11).integers(
+        0, 256, 123_457, dtype=np.uint8
+    ).tobytes()
+    try:
+        before = sum(v.net_plane.write_requests for v in vols)
+        fid = ops.upload(payload, name="same.bin", mime="application/x-test")
+        assert sum(v.net_plane.write_requests for v in vols) == before + 1, (
+            "upload did not ride the native write plane"
+        )
+        f = FileId.parse(fid)
+        vs = _holder(vols, f.volume_id)
+        raw_plane = _latest_record(vs, f.volume_id, f.needle_id)
+
+        # HTTP multipart to the same fid (the bit-identical fallback)
+        loc = ops.master.lookup(f.volume_id)[0]
+        r = _requests.post(
+            f"http://{loc.url}/{fid}",
+            files={"file": ("same.bin", payload, "application/x-test")},
+        )
+        assert r.status_code == 201, r.text
+        raw_http = _latest_record(vs, f.volume_id, f.needle_id)
+
+        # in-process gRPC servicer call
+        resp = vs.service.WriteNeedle(
+            pb.WriteNeedleRequest(
+                volume_id=f.volume_id, needle_id=f.needle_id,
+                cookie=f.cookie, data=payload, name="same.bin",
+                mime="application/x-test", is_replicate=True,
+            ),
+            None,
+        )
+        assert not resp.error
+        raw_grpc = _latest_record(vs, f.volume_id, f.needle_id)
+
+        assert _canon_record(raw_plane) == _canon_record(raw_http)
+        assert _canon_record(raw_http) == _canon_record(raw_grpc)
+        assert len(raw_plane) == len(raw_http) == len(raw_grpc)
+        assert ops.read(fid) == payload
+    finally:
+        ops.close()
+
+
+def test_write_dead_sidecar_falls_back_to_http(write_cluster):
+    """Sidecar down (crashed, old binary): the PUT rides HTTP with the
+    plane probe memoized — uploads keep succeeding, bytes unchanged."""
+    from seaweedfs_tpu.client.operations import Operations
+
+    master, vols = write_cluster
+    for vs in vols:
+        vs.net_plane.stop()
+    ops = Operations(f"localhost:{master.port}")
+    try:
+        data = b"no-sidecar-today" * 500
+        fid = ops.upload(data, name="f.bin")
+        assert ops.read(fid) == data
+        assert all(v.net_plane.write_requests == 0 for v in vols)
+        # second upload: memoized no-plane peer, still fine
+        fid2 = ops.upload(data)
+        assert ops.read(fid2) == data
+    finally:
+        ops.close()
+
+
+def test_write_chaos_routes_to_http_unless_write_namespace(write_cluster):
+    """Armed non-write chaos routes PUTs to the HTTP path (where the
+    storage fault points live); armed write-path chaos stays on the
+    plane so the crash matrix exercises the native path."""
+    from seaweedfs_tpu.client.operations import Operations
+
+    master, vols = write_cluster
+    ops = Operations(f"localhost:{master.port}")
+    data = b"routed-write" * 300
+    try:
+        with faults.injected(
+            "storage.disk.read_at", faults.latency(0.0),
+            when=faults.always(),
+        ):
+            fid = ops.upload(data)
+        assert sum(v.net_plane.write_requests for v in vols) == 0
+        assert ops.read(fid) == data
+        with faults.injected(
+            "ec.net.write.before_pwrite", faults.latency(0.0),
+            when=faults.always(),
+        ):
+            fid2 = ops.upload(data)
+        assert sum(v.net_plane.write_requests for v in vols) == 1
+        assert ops.read(fid2) == data
+    finally:
+        ops.close()
+
+
+def test_replica_fanout_rides_plane_bit_identical(write_cluster):
+    """replication=001: the primary fans out to its replica over the
+    native plane (pooled connection, replicate=False leg) and both
+    copies are byte-identical on disk."""
+    import requests as _requests
+
+    from seaweedfs_tpu.client.operations import Operations
+    from seaweedfs_tpu.storage.file_id import FileId
+
+    master, vols = write_cluster
+    ops = Operations(f"localhost:{master.port}")
+    payload = np.random.default_rng(13).integers(
+        0, 256, 90_001, dtype=np.uint8
+    ).tobytes()
+    try:
+        fid = ops.upload(payload, name="rep.bin", replication="001")
+        f = FileId.parse(fid)
+        locs = ops.master.lookup(f.volume_id)
+        assert len(locs) == 2, "001 => 2 copies"
+        # client->primary leg + primary->replica leg, both on the plane
+        assert sum(v.net_plane.write_requests for v in vols) == 2
+        raws = [
+            _latest_record(vs, f.volume_id, f.needle_id) for vs in vols
+        ]
+        assert _canon_record(raws[0]) == _canon_record(raws[1])
+        for loc in locs:
+            r = _requests.get(f"http://{loc.url}/{fid}")
+            assert r.status_code == 200 and r.content == payload
+    finally:
+        ops.close()

@@ -184,3 +184,43 @@ def test_tier_rpc_and_cluster_read(cold_tier, tmp_path):
     )
     ops.close()
     mc.close()
+
+
+# ------------------------------------------- streaming PUT regression
+
+
+def test_sized_reader_bounds_every_chunk():
+    """_SizedReader never materializes more than _CHUNK bytes per read,
+    even when the HTTP stack asks for the whole body at once — the
+    memory bound a multi-GiB tier upload relies on."""
+    import io
+
+    from seaweedfs_tpu.storage import backend as B
+
+    body = os.urandom(3 * B._CHUNK // 2)
+    r = B._SizedReader(io.BytesIO(body), len(body))
+    assert len(r) == len(body)
+    pieces = []
+    while True:
+        piece = r.read(-1)  # "give me everything"
+        if not piece:
+            break
+        assert len(piece) <= B._CHUNK
+        pieces.append(piece)
+    assert b"".join(pieces) == body
+    assert len(pieces) >= 2  # the bound actually split the body
+    assert r.read() == b""  # drained reader stays drained
+
+
+def test_sized_reader_truncated_source_raises():
+    """A source that runs dry before the promised size raises instead
+    of silently sending a short Content-Length body the endpoint would
+    stall on."""
+    import io
+
+    from seaweedfs_tpu.storage import backend as B
+
+    r = B._SizedReader(io.BytesIO(b"only-ten-b"), 1000)
+    assert r.read(10) == b"only-ten-b"
+    with pytest.raises(B.BackendError, match="truncated: 990 of 1000"):
+        r.read(10)

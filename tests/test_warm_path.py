@@ -465,16 +465,16 @@ def test_warm_gateway_chunk_fetch_rides_native_plane(tmp_path):
         assert requests.put(
             f"{base}/warm/obj", data=data
         ).status_code == 200
-        r0 = {
-            k[0]: v
-            for k, v in M.net_bytes_received_total.snapshot().items()
-        }
+        def by_plane() -> dict:
+            out: dict = {}
+            for k, v in M.net_bytes_received_total.snapshot().items():
+                out[k[0]] = out.get(k[0], 0) + v
+            return out
+
+        r0 = by_plane()
         r = requests.get(f"{base}/warm/obj", timeout=30)
         assert r.status_code == 200 and r.content == data
-        r1 = {
-            k[0]: v
-            for k, v in M.net_bytes_received_total.snapshot().items()
-        }
+        r1 = by_plane()
         native_delta = r1.get("native", 0) - r0.get("native", 0)
         assert native_delta >= len(data), (
             f"chunk bytes did not ride the native plane: {native_delta}"
