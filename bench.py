@@ -38,6 +38,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import struct
 import sys
 import tempfile
 import threading
@@ -2528,6 +2529,431 @@ def _mixed_rw_bench(
             pass
 
 
+def _mq_attach_spill(broker, topic: str) -> None:
+    """Give every partition log a dict-backed spill store so segments
+    seal out of the memory tail like a filer-backed deployment — the
+    precondition for the fetch spool's zero-copy sealed-segment path.
+    Content-identical to filer spill; only the storage location of the
+    sealed bytes differs (the spool re-materializes them on disk)."""
+    st = broker.topic("kafka", topic)
+    for plog in st.logs.values():
+        segs: dict[int, bytes] = {}
+        plog._spill = segs.__setitem__
+        plog._load = segs.get
+
+
+def _mq_crash_child(pdir: str, grpc_port: int, kill_window: int) -> None:
+    from seaweedfs_tpu import faults
+    from seaweedfs_tpu.mq.broker import MqBrokerServer
+    from seaweedfs_tpu.mq.kafka.client import KafkaClient
+    from seaweedfs_tpu.mq.kafka.records import Record
+
+    os.environ["SEAWEED_MQ_GROUP_COMMIT_MS"] = "10"
+    faults.inject(
+        "mq.produce.before_flush",
+        faults.hard_exit(137),
+        when=faults.nth_call(kill_window),
+    )
+    srv = MqBrokerServer(
+        ip="localhost", grpc_port=grpc_port, kafka_port=0, parity_dir=pdir
+    )
+    srv.start()
+    c = KafkaClient("localhost", srv.kafka.port)
+    c.create_topic("gc", partitions=1)
+    acked = open(os.path.join(pdir, "..", "acked"), "w")
+    for i in range(500):
+        c.produce(
+            "gc", 0,
+            [Record(key=b"k%06d" % i, value=b"v%06d-" % i * 16)],
+            acks=-1,
+        )
+        acked.write(f"{i}\n")
+        acked.flush()
+        os.fsync(acked.fileno())
+    os._exit(0)  # pragma: no cover - the armed window kills us first
+
+
+def _mq_group_commit_crash_check(workdir: str) -> bool:
+    """Hard-kill the MQ broker inside a produce group-commit window:
+    every Kafka produce acked before the crash must replay from the
+    parity streams after restart, dense and byte-exact (the MQ
+    restatement of _group_commit_crash_check)."""
+    import multiprocessing
+
+    from seaweedfs_tpu.mq.broker import MqBroker
+    from seaweedfs_tpu.mq.kafka.gateway import _unpack_null
+
+    d = os.path.join(workdir, "mq_gc_crash")
+    pdir = os.path.join(d, "parity")
+    os.makedirs(pdir, exist_ok=True)
+    prev = os.environ.get("SEAWEED_MQ_GROUP_COMMIT_MS")
+    mp = multiprocessing.get_context("fork")
+    p = mp.Process(
+        target=_mq_crash_child, args=(pdir, _bench_free_port(), 3)
+    )
+    p.start()
+    p.join(timeout=120)
+    if prev is None:
+        os.environ.pop("SEAWEED_MQ_GROUP_COMMIT_MS", None)
+    else:
+        os.environ["SEAWEED_MQ_GROUP_COMMIT_MS"] = prev
+    if p.is_alive():
+        p.kill()
+        return False
+    if p.exitcode != 137:
+        return False
+    acked = -1
+    acked_path = os.path.join(d, "acked")
+    if os.path.exists(acked_path):
+        lines = open(acked_path).read().split()
+        if lines:
+            acked = int(lines[-1])
+    br = MqBroker(parity_dir=pdir)
+    try:
+        recs = br.topic("kafka", "gc").logs[0].read_from(
+            0, max_records=10_000
+        )
+        for n, (off, _ts, k, v) in enumerate(recs):
+            if off != n:
+                return False  # replay not dense
+            if (_unpack_null(k), _unpack_null(v)) != (
+                b"k%06d" % n, b"v%06d-" % n * 16
+            ):
+                return False  # replay not byte-exact
+        return len(recs) >= acked + 1  # acked => replayable
+    except Exception:
+        return False
+    finally:
+        br.close()
+
+
+def _mq_fetch_bit_identity_probe(workdir: str) -> tuple[bool, float]:
+    """Fetch the same sealed segments over the native (sn_send_file)
+    and Python egress planes: the decoded records must be identical.
+    Returns (identical, native_mb)."""
+    from seaweedfs_tpu.mq.broker import MqBrokerServer
+    from seaweedfs_tpu.mq.kafka.client import KafkaClient
+    from seaweedfs_tpu.mq.kafka.records import Record
+    from seaweedfs_tpu.utils import metrics as _M
+
+    def native_bytes() -> float:
+        return dict(_M.mq_fetch_bytes_total.snapshot()).get(
+            ("native",), 0
+        )
+
+    srv = MqBrokerServer(
+        ip="localhost", grpc_port=_bench_free_port(), kafka_port=0,
+        segment_records=64,
+    )
+    srv.start()
+    prev = os.environ.get("SEAWEED_EC_NATIVE")
+    try:
+        c = KafkaClient("localhost", srv.kafka.port)
+        c.create_topic("ident", partitions=1)
+        _mq_attach_spill(srv.broker, "ident")
+        payload = bytes(range(256))
+        for i in range(200):
+            c.produce("ident", 0, [Record(key=b"k%03d" % i, value=payload)])
+
+        def drain(client):
+            out, off = [], 0
+            while off < 200:
+                _hw, recs = client.fetch(
+                    "ident", 0, off, max_wait_ms=0, max_bytes=1 << 22
+                )
+                if not recs:
+                    break
+                out.extend((r.offset, r.key, r.value) for r in recs)
+                off = out[-1][0] + 1
+            return out
+
+        os.environ["SEAWEED_EC_NATIVE"] = "0"
+        py_recs = drain(c)
+        os.environ["SEAWEED_EC_NATIVE"] = "1"
+        n0 = native_bytes()
+        c2 = KafkaClient("localhost", srv.kafka.port)
+        nat_recs = drain(c2)
+        native_mb = (native_bytes() - n0) / 1e6
+        c2.close()
+        c.close()
+        return (
+            len(py_recs) == 200 and py_recs == nat_recs,
+            round(native_mb, 2),
+        )
+    finally:
+        if prev is None:
+            os.environ.pop("SEAWEED_EC_NATIVE", None)
+        else:
+            os.environ["SEAWEED_EC_NATIVE"] = prev
+        srv.stop()
+
+
+def _mq_sustained_bench(
+    workdir: str,
+    producers: int = 4,
+    consumers: int = 2,
+    records_per_producer: int = 400,
+    value_bytes: int = 2048,
+) -> dict:
+    """Sustained Kafka produce/consume at line rate (ISSUE 20): the
+    pooled frame server + group commit + zero-copy fetch spool vs the
+    naive thread-per-connection/no-group-commit/Python-egress baseline,
+    in ONE run. Every record carries its producer-side timestamp, so
+    delivery latency is true produce-call-to-consumer-decode; parity
+    lag is sampled live during traffic (the durable-parity bound the
+    group committer exists to hold). The mid-traffic broker hard-kill +
+    dense byte-exact replay assertion rides in the same line."""
+    import threading
+
+    from seaweedfs_tpu.mq.broker import MqBrokerServer
+    from seaweedfs_tpu.mq.kafka.client import KafkaClient
+    from seaweedfs_tpu.mq.kafka.records import Record
+    from seaweedfs_tpu.utils import metrics as _M
+
+    gdir = os.path.join(workdir, "mq_sustained")
+    os.makedirs(gdir, exist_ok=True)
+    knobs = (
+        "SEAWEED_MQ_KAFKA_WORKERS",
+        "SEAWEED_MQ_GROUP_COMMIT_MS",
+        "SEAWEED_EC_NATIVE",
+    )
+    prev_env = {k: os.environ.get(k) for k in knobs}
+    pad = b"\x5a" * max(value_bytes - 8, 0)
+
+    def phase(tuned: bool) -> dict:
+        os.environ["SEAWEED_MQ_KAFKA_WORKERS"] = "16" if tuned else "0"
+        os.environ["SEAWEED_MQ_GROUP_COMMIT_MS"] = "8" if tuned else "0"
+        os.environ["SEAWEED_EC_NATIVE"] = "1" if tuned else "0"
+        srv = MqBrokerServer(
+            ip="localhost",
+            grpc_port=_bench_free_port(),
+            kafka_port=0,
+            segment_records=64,
+            parity_dir=os.path.join(
+                gdir, "parity_" + ("tuned" if tuned else "naive")
+            ),
+        )
+        srv.start()
+        try:
+            setup = KafkaClient("localhost", srv.kafka.port)
+            setup.create_topic("wire", partitions=producers)
+            setup.close()
+            _mq_attach_spill(srv.broker, "wire")
+            parities = list(
+                srv.broker.topic("kafka", "wire").parity.values()
+            )
+            lock = threading.Lock()
+            deliver_s: list[float] = []
+            lag_s: list[float] = []
+            consumed = [0]  # bytes
+            errors = [0]
+            prod_done = threading.Event()
+
+            def producer(idx: int) -> None:
+                try:
+                    c = KafkaClient(
+                        "localhost", srv.kafka.port, client_id=f"p{idx}"
+                    )
+                    for _i in range(records_per_producer):
+                        val = struct.pack(">d", time.perf_counter()) + pad
+                        c.produce(
+                            "wire", idx, [Record(key=b"k", value=val)],
+                            acks=-1,
+                        )
+                    c.close()
+                except Exception:
+                    with lock:
+                        errors[0] += 1
+
+            def consumer(idx: int) -> None:
+                try:
+                    c = KafkaClient(
+                        "localhost", srv.kafka.port, client_id=f"c{idx}"
+                    )
+                    mine = list(range(idx, producers, consumers))
+                    nxt = {p: 0 for p in mine}
+                    idle = 0
+                    while any(
+                        nxt[p] < records_per_producer for p in mine
+                    ):
+                        progressed = False
+                        for p in mine:
+                            if nxt[p] >= records_per_producer:
+                                continue
+                            _hw, recs = c.fetch(
+                                "wire", p, nxt[p],
+                                max_wait_ms=50, max_bytes=1 << 22,
+                            )
+                            now = time.perf_counter()
+                            fresh = [
+                                r for r in recs if r.offset >= nxt[p]
+                            ]
+                            if not fresh:
+                                continue
+                            progressed = True
+                            nxt[p] = fresh[-1].offset + 1
+                            with lock:
+                                for r in fresh:
+                                    (t0,) = struct.unpack(
+                                        ">d", r.value[:8]
+                                    )
+                                    deliver_s.append(now - t0)
+                                    consumed[0] += len(r.value)
+                        if progressed:
+                            idle = 0
+                        elif prod_done.is_set():
+                            # a couple of empty passes once producers
+                            # are done = genuinely drained (or wedged)
+                            idle += 1
+                            if idle >= 3:
+                                break
+                    c.close()
+                except Exception:
+                    with lock:
+                        errors[0] += 1
+
+            def lag_sampler() -> None:
+                while not prod_done.is_set():
+                    with lock:
+                        lag_s.extend(
+                            p.parity_lag_s() for p in parities
+                        )
+                    time.sleep(0.02)
+
+            pthreads = [
+                threading.Thread(target=producer, args=(i,))
+                for i in range(producers)
+            ]
+            cthreads = [
+                threading.Thread(target=consumer, args=(i,))
+                for i in range(consumers)
+            ]
+            sampler = threading.Thread(target=lag_sampler)
+            t0 = time.perf_counter()
+            for t in pthreads + cthreads:
+                t.start()
+            sampler.start()
+            for t in pthreads:
+                t.join(timeout=300)
+            produce_wall = time.perf_counter() - t0
+            prod_done.set()
+            for t in cthreads:
+                t.join(timeout=300)
+            wall = time.perf_counter() - t0
+            sampler.join(timeout=10)
+            total = producers * records_per_producer
+            if errors[0] or len(deliver_s) < total:
+                return {
+                    "error": (
+                        f"errors={errors[0]} "
+                        f"delivered={len(deliver_s)}/{total}"
+                    )
+                }
+            # cold replay: a catch-up consumer re-reads every
+            # partition from offset 0 — sealed segments egress through
+            # the fetch spool (zero-copy native plane when enabled),
+            # the backfill/replay case the spool exists for
+            rc = KafkaClient(
+                "localhost", srv.kafka.port, client_id="replay"
+            )
+
+            def replay_pass() -> tuple[int, float]:
+                t0 = time.perf_counter()
+                nbytes = 0
+                for p in range(producers):
+                    off = 0
+                    while off < records_per_producer:
+                        _hw, recs = rc.fetch(
+                            "wire", p, off,
+                            max_wait_ms=0, max_bytes=1 << 22,
+                        )
+                        fresh = [r for r in recs if r.offset >= off]
+                        if not fresh:
+                            raise RuntimeError(
+                                f"replay wedged at wire[{p}]@{off}"
+                            )
+                        off = fresh[-1].offset + 1
+                        nbytes += sum(len(r.value) for r in fresh)
+                return nbytes, time.perf_counter() - t0
+
+            replay_pass()  # cold: populates the spool (builds)
+            replay_bytes, replay_wall = replay_pass()  # warm: egress
+            rc.close()
+            del_ms = np.array(sorted(deliver_s)) * 1e3
+            lag_ms = np.array(sorted(lag_s or [0.0])) * 1e3
+            pool = srv.kafka.pool_status()
+            return {
+                "replay_mb_per_s": round(
+                    replay_bytes / 1e6 / max(replay_wall, 1e-9), 2
+                ),
+                "produce_recs_per_s": round(total / produce_wall, 1),
+                "consume_mb_per_s": round(
+                    consumed[0] / 1e6 / wall, 2
+                ),
+                "delivery_p50_ms": round(
+                    float(np.percentile(del_ms, 50)), 2
+                ),
+                "delivery_p99_ms": round(
+                    float(np.percentile(del_ms, 99)), 2
+                ),
+                "parity_lag_p99_ms": round(
+                    float(np.percentile(lag_ms, 99)), 2
+                ),
+                "spool_builds": pool["fetch_spool"]["builds"],
+                "kind": pool["kind"],
+            }
+        finally:
+            srv.stop()
+
+    try:
+        n0 = dict(_M.mq_fetch_bytes_total.snapshot()).get(("native",), 0)
+        naive = phase(tuned=False)
+        tuned = phase(tuned=True)
+        native_mb = (
+            dict(_M.mq_fetch_bytes_total.snapshot()).get(("native",), 0)
+            - n0
+        ) / 1e6
+        if "error" in naive or "error" in tuned:
+            return {
+                "mq_sustained_error": (
+                    f"naive={naive.get('error')} "
+                    f"tuned={tuned.get('error')}"
+                )
+            }
+        replay_ok = _mq_group_commit_crash_check(gdir)
+        return {
+            "mq_produce_recs_per_s_tuned": tuned["produce_recs_per_s"],
+            "mq_produce_recs_per_s_naive": naive["produce_recs_per_s"],
+            "mq_consume_mb_per_s_tuned": tuned["consume_mb_per_s"],
+            "mq_consume_mb_per_s_naive": naive["consume_mb_per_s"],
+            "mq_delivery_p99_ms_tuned": tuned["delivery_p99_ms"],
+            "mq_delivery_p99_ms_naive": naive["delivery_p99_ms"],
+            "mq_delivery_speedup": round(
+                naive["delivery_p99_ms"]
+                / max(tuned["delivery_p99_ms"], 1e-9),
+                2,
+            ),
+            "mq_replay_mb_per_s_tuned": tuned["replay_mb_per_s"],
+            "mq_replay_mb_per_s_naive": naive["replay_mb_per_s"],
+            # the group committer's whole job: durable-parity lag stays
+            # bounded while the tuned phase runs at full tilt
+            "mq_parity_lag_p99_ms_tuned": tuned["parity_lag_p99_ms"],
+            "mq_parity_lag_p99_ms_naive": naive["parity_lag_p99_ms"],
+            "mq_fetch_native_mb": round(native_mb, 1),
+            "mq_spool_builds": tuned["spool_builds"],
+            "mq_replay_after_kill_identical": bool(replay_ok),
+            "mq_producers": producers,
+            "mq_consumers": consumers,
+            "mq_value_bytes": value_bytes,
+        }
+    finally:
+        for k, v in prev_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 # --------------------------------------------------------------------------
 # Device phase: INDEPENDENTLY WATCHDOGGED STAGES, each in its own
 # subprocess, each persisting its JSON fragment to disk the moment it
@@ -3926,6 +4352,23 @@ def _self_check() -> int:
             reb.get("ec_rebalance_exactly_one_holder") is True,
             f"stats={reb}",
         )
+
+        # ---- MQ data plane (ISSUE 20): the zero-copy fetch spool must
+        # be invisible on the wire (native plane == Python plane, byte
+        # for byte), and a broker hard-killed mid-group-commit-window
+        # must replay every acked Kafka produce dense and byte-exact --
+        ident, native_mb = _mq_fetch_bit_identity_probe(workdir)
+        check(
+            "mq_fetch_bit_identical",
+            ident,
+            f"native_mb={native_mb}",
+        )
+        check(
+            "mq_group_commit_acked_is_durable",
+            _mq_group_commit_crash_check(
+                os.path.join(workdir, "mq_sc")
+            ),
+        )
     finally:
         if prev_cache_env is None:
             os.environ.pop("SEAWEED_BENCH_PROBE_CACHE", None)
@@ -4085,6 +4528,16 @@ def main() -> None:
             tenant_storm_stats = {
                 "tenant_storm_error": f"{type(e).__name__}: {e}"
             }
+        # Streaming at line rate (ISSUE 20): sustained Kafka
+        # produce/consume, pooled gateway + group commit + zero-copy
+        # fetch vs the naive baseline in one run, with the mid-traffic
+        # hard-kill replay assertion.
+        try:
+            mq_sustained_stats = _mq_sustained_bench(workdir)
+        except Exception as e:  # noqa: BLE001
+            mq_sustained_stats = {
+                "mq_sustained_error": f"{type(e).__name__}: {e}"
+            }
 
         _clear_shards(base)  # device phase re-encodes the same volume
 
@@ -4149,6 +4602,7 @@ def main() -> None:
             **rebalance_stats,
             **mixed_rw_stats,
             **tenant_storm_stats,
+            **mq_sustained_stats,
         }
         best.update(
             {

@@ -334,6 +334,13 @@ class ShardNetPlane:
     def stop(self) -> None:
         self._stop.set()
         try:
+            # close() alone does not wake a thread blocked in accept();
+            # shutdown() does, so the join below returns immediately
+            # instead of eating its full timeout
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._sock.close()
         except OSError:
             pass

@@ -7,11 +7,20 @@ rendezvous (HRW) hashing over the LIVE broker set: every broker ranks
 runner-up follows. HRW gives the failover property for free: when a
 leader dies, the new top-ranked broker IS the old follower, which holds
 the replica fed by FollowAppend — so promotion loses nothing.
+
+Gravity (ISSUE 20): BrokerStatus pings now carry each peer's live
+load_score (parity backlog + Kafka gateway pool pressure). Assignment
+keeps the HRW ranking for stability but demotes the top-ranked broker
+to follower when it is hotter than the runner-up by more than
+SEAWEED_MQ_GRAVITY_HYSTERESIS — load noise inside the margin cannot
+flap leadership, and brokers with divergent load views are absorbed by
+the is_forwarded single-hop rule.
 """
 
 from __future__ import annotations
 
 import hashlib
+import os
 import threading
 import time
 
@@ -28,6 +37,16 @@ FORWARDED_KEY = "sw-forwarded"
 
 def _score(broker: str, ns: str, name: str, part: int) -> bytes:
     return hashlib.md5(f"{broker}|{ns}|{name}|{part}".encode()).digest()
+
+
+def gravity_hysteresis() -> float:
+    """SEAWEED_MQ_GRAVITY_HYSTERESIS: how much hotter (in load-score
+    units) the HRW leader must be than the runner-up before assignment
+    swaps them. Read live per call."""
+    try:
+        return float(os.environ.get("SEAWEED_MQ_GRAVITY_HYSTERESIS", "1.5"))
+    except ValueError:
+        return 1.5
 
 
 def is_forwarded(context) -> bool:
@@ -62,6 +81,8 @@ class BrokerBalancer:
         self.ping_interval = ping_interval
         self.ping_timeout = ping_timeout
         self._live = set(self.peers)  # optimistic until pings say otherwise
+        self._loads: dict[str, float] = {}  # addr -> last load_score
+        self.load_fn = None  # server-injected: this broker's own load
         self._lock = threading.Lock()
         self._channels: dict[str, grpc.Channel] = {}
         self._stop = threading.Event()
@@ -104,14 +125,21 @@ class BrokerBalancer:
     def _ping_loop(self) -> None:
         while not self._stop.wait(self.ping_interval):
             live = {self.self_addr}
+            loads: dict[str, float] = {}
+            if self.load_fn is not None:
+                try:
+                    loads[self.self_addr] = float(self.load_fn())
+                except Exception:  # noqa: BLE001 — telemetry only
+                    pass
             for peer in self.peers:
                 if peer == self.self_addr:
                     continue
                 try:
-                    self.stub(peer).BrokerStatus(
+                    resp = self.stub(peer).BrokerStatus(
                         mq.BrokerStatusRequest(), timeout=self.ping_timeout
                     )
                     live.add(peer)
+                    loads[peer] = float(getattr(resp, "load_score", 0.0))
                 except grpc.RpcError:
                     pass
             with self._lock:
@@ -122,13 +150,26 @@ class BrokerBalancer:
                         sorted(live),
                     )
                 self._live = live
+                self._loads = loads
+
+    def loads(self) -> dict[str, float]:
+        """Last observed load_score per broker (missing = no telemetry
+        yet — gravity then leaves the HRW ranking alone)."""
+        with self._lock:
+            return dict(self._loads)
 
     # ------------------------------------------------------- assignment
 
     def assignment(
         self, ns: str, name: str, part: int
     ) -> tuple[str, str]:
-        """(leader, follower) for one partition over the live set."""
+        """(leader, follower) for one partition over the live set.
+
+        Gravity: when both the HRW leader and runner-up have load
+        telemetry and the leader is hotter by more than the hysteresis
+        margin, the pair swaps — the partition lands on the cooler
+        broker while the HRW winner keeps the replica, so failover
+        still loses nothing."""
         live = self.live()
         if not live:
             return self.self_addr, ""
@@ -137,6 +178,15 @@ class BrokerBalancer:
         )
         leader = ranked[0]
         follower = ranked[1] if len(ranked) > 1 else ""
+        if follower:
+            loads = self.loads()
+            hot, cool = loads.get(leader), loads.get(follower)
+            if (
+                hot is not None
+                and cool is not None
+                and hot > cool + gravity_hysteresis()
+            ):
+                leader, follower = follower, leader
         return leader, follower
 
     def assignments(

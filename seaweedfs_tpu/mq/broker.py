@@ -71,6 +71,7 @@ class MqBroker:
             else durable_parity_default
         )
         self._parity_flusher = None
+        self._mq_committer = None
         self._topics: dict[tuple[str, str], _TopicState] = {}
         self._offsets: dict[tuple, int] = {}  # (ns, topic, part, group)
         self._offset_meta: dict[tuple, str] = {}  # committed metadata
@@ -366,9 +367,54 @@ class MqBroker:
             }
         return out
 
+    def load_score(self) -> float:
+        """Parity-backlog component of the gravity load signal: pending
+        parity bytes across every partition, in units of the flush
+        threshold (1.0 ≈ one full flush window behind)."""
+        from .stream_parity import flush_bytes_default
+
+        pending = 0
+        with self._lock:
+            items = [dict(st.parity) for st in self._topics.values()]
+        for parts in items:
+            for parity in parts.values():
+                try:
+                    pending += parity.pending_bytes()
+                except Exception:  # noqa: BLE001 — telemetry only
+                    pass
+        return pending / float(max(1, flush_bytes_default()))
+
+    def group_committer(self):
+        """The broker group committer covering durable-parity produce
+        acks, or None when SEAWEED_MQ_GROUP_COMMIT_MS is 0. The knob is
+        read live per call and the committer swapped when it changes
+        (mirrors Volume._group_committer)."""
+        from .group_commit import MqGroupCommitter, group_commit_window_s
+
+        w = group_commit_window_s()
+        c = self._mq_committer
+        if c is not None and c.window_s == w:
+            return c
+        with self._lock:
+            c = self._mq_committer
+            if w <= 0:
+                if c is not None:
+                    self._mq_committer = None
+                    c.stop()
+                return None
+            if c is None or c.window_s != w:
+                if c is not None:
+                    c.stop()
+                c = MqGroupCommitter(w)
+                self._mq_committer = c
+            return c
+
     def close(self) -> None:
         """Stop the parity flusher and close every stream (flushes
         first: a clean shutdown leaves nothing to replay)."""
+        if self._mq_committer is not None:
+            self._mq_committer.stop()
+            self._mq_committer = None
         if self._parity_flusher is not None:
             self._parity_flusher.stop()
             self._parity_flusher = None
@@ -790,18 +836,25 @@ class MqBroker:
 class MqService:
     """gRPC servicer (method table in pb/rpc.py MQ_SERVICE)."""
 
-    def __init__(self, broker: MqBroker, balancer=None):
+    def __init__(self, broker: MqBroker, balancer=None, load_fn=None):
         self.broker = broker
         self.balancer = balancer
+        self.load_fn = load_fn  # gravity telemetry source (server-level)
 
     # ------------------------------------------------------ multi-broker
 
     def BrokerStatus(self, request, context):
         bal = self.balancer
+        fn = self.load_fn or self.broker.load_score
+        try:
+            load = float(fn())
+        except Exception:  # noqa: BLE001 — telemetry must not fail pings
+            load = 0.0
         return mq.BrokerStatusResponse(
             address=bal.self_addr if bal else "",
             peers=bal.peers if bal else [],
             uptime_seconds=int(time.time() - bal.started_at) if bal else 0,
+            load_score=load,
         )
 
     def LookupTopicBrokers(self, request, context):
@@ -1201,13 +1254,17 @@ class MqBrokerServer:
         archive_interval: float = 300.0,
         parity_dir: str = "",
         durable_parity_default: bool | None = None,
+        status_port: int = -1,
     ):
         """kafka_port >= 0 also serves the Kafka wire protocol on that
         port; pg_port >= 0 serves PostgreSQL clients a SQL view over
         the topics (0 = ephemeral; see .kafka.port / .pg.port).
         peers: every broker's grpc host:port for multi-broker partition
         balancing + follower replication. parity_dir: local dir for
-        streaming-EC durable-parity log streams (see MqBroker)."""
+        streaming-EC durable-parity log streams (see MqBroker).
+        status_port >= 0 serves /status (JSON roll-up incl. the Kafka
+        gateway pool) and /metrics (sw_mq_*) over HTTP (0 =
+        ephemeral; see .status_port after start)."""
         self.ip = ip
         self.grpc_port = grpc_port
         self.broker = MqBroker(
@@ -1218,7 +1275,10 @@ class MqBrokerServer:
         self.balancer = balancer_mod.BrokerBalancer(
             f"{ip}:{grpc_port}", list(peers or [])
         )
-        self.service = MqService(self.broker, balancer=self.balancer)
+        self.balancer.load_fn = self.load_score
+        self.service = MqService(
+            self.broker, balancer=self.balancer, load_fn=self.load_score
+        )
         self._grpc = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
         rpc.add_service(self._grpc, rpc.MQ_SERVICE, self.service)
         self._grpc.add_insecure_port(f"{ip}:{grpc_port}")
@@ -1248,6 +1308,13 @@ class MqBrokerServer:
                 args=(archive_interval,),
                 daemon=True,
             )
+        # operator HTTP plane: /status + /metrics (mirrors the volume
+        # server's listener; advisory sections never fail the endpoint)
+        self._status_httpd = None
+        self.status_port = status_port
+        if status_port >= 0:
+            self._status_httpd = self._build_status_httpd(ip, status_port)
+            self.status_port = self._status_httpd.server_address[1]
 
     def _archive_loop(self, interval: float) -> None:
         while not self._archive_stop.wait(interval):
@@ -1255,6 +1322,82 @@ class MqBrokerServer:
                 self.archiver.run_once()
             except Exception as e:  # noqa: BLE001 — never kill the broker
                 log.warning(f"segment archival cycle failed: {e!r}")
+
+    def load_score(self) -> float:
+        """Gravity telemetry shipped on BrokerStatus pings: parity
+        backlog (flush-threshold units) + Kafka gateway pool pressure
+        (ready backlog per worker + connection-slot occupancy). 0 when
+        idle; ~1 per saturated dimension."""
+        score = self.broker.load_score()
+        if self.kafka is not None:
+            try:
+                ps = self.kafka.pool_status()
+                workers = max(1, int(ps.get("workers") or 1))
+                score += float(ps.get("ready_backlog", 0)) / workers
+                slots = max(1, int(ps.get("max_connections") or 1))
+                score += float(ps.get("open_connections", 0)) / slots
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
+        return score
+
+    def status(self) -> dict:
+        """Operator JSON roll-up served at /status."""
+        st = {
+            "address": self.balancer.self_addr,
+            "peers": self.balancer.peers,
+            "live_brokers": self.balancer.live(),
+            "broker_loads": self.balancer.loads(),
+            "load_score": self.load_score(),
+            "topics": [
+                {"namespace": ns, "name": name, "partitions": count}
+                for ns, name, count in self.broker.list_topics()
+            ],
+        }
+        try:
+            st["parity"] = self.broker.parity_status()
+        except Exception:  # noqa: BLE001 — advisory
+            pass
+        if self.kafka is not None:
+            try:
+                st["kafka_pool"] = self.kafka.pool_status()
+            except Exception:  # noqa: BLE001 — advisory
+                pass
+        return st
+
+    def _build_status_httpd(self, ip: str, port: int):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _send(self, code, body, ctype):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.split("?", 1)[0] == "/metrics":
+                    from ..utils.metrics import REGISTRY
+
+                    self._send(
+                        200, REGISTRY.render(),
+                        "text/plain; version=0.0.4",
+                    )
+                    return
+                if self.path.split("?", 1)[0] == "/status":
+                    body = json.dumps(server.status()).encode()
+                    self._send(200, body, "application/json")
+                    return
+                self._send(404, b"not found", "text/plain")
+
+        httpd = ThreadingHTTPServer((ip, port), Handler)
+        httpd.daemon_threads = True
+        return httpd
 
     def start(self) -> None:
         self._grpc.start()
@@ -1265,9 +1408,16 @@ class MqBrokerServer:
             self.pg.start()
         if self._archive_thread is not None:
             self._archive_thread.start()
+        if self._status_httpd is not None:
+            threading.Thread(
+                target=self._status_httpd.serve_forever, daemon=True
+            ).start()
 
     def stop(self) -> None:
         self._archive_stop.set()
+        if self._status_httpd is not None:
+            self._status_httpd.shutdown()
+            self._status_httpd.server_close()
         self.balancer.stop()
         if self.kafka is not None:
             self.kafka.stop()

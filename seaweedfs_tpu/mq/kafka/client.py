@@ -314,7 +314,15 @@ class KafkaClient:
         blob = r.nullable_bytes()
         if err:
             raise KafkaError(err, "Fetch")
-        return hw, decode_batches(blob or b"")
+        # a broker may legally return a whole batch that STARTS BEFORE
+        # the fetch offset (disk-backed serving — our sealed-segment
+        # spool does exactly this); skipping records below the
+        # requested offset is the client's job
+        return hw, [
+            rec
+            for rec in decode_batches(blob or b"")
+            if rec.offset >= offset
+        ]
 
     def list_offset(self, topic: str, partition: int, ts: int = -1) -> int:
         """ts -1 = latest, -2 = earliest, >=0 = first offset at/after."""

@@ -11,6 +11,14 @@ correlation_id i32, client_id nullable-string) | body. Responses:
 i32 length | correlation_id i32 | body. Only non-flexible request
 versions are advertised (see _API_RANGES), so tagged fields never
 appear on the wire.
+
+Front end (ISSUE 20): connections are served by the bounded
+worker-pool frame server (``frame_pool.PooledFrameServer``) instead of
+one thread per connection. Saturation is answered with well-formed
+per-api error/throttle responses (``_handle_reject``), pool pressure
+surfaces as ``throttle_time_ms`` in every response, fetches of sealed
+segments egress zero-copy via the batch spool, and durable-parity
+produces ride the broker group committer.
 """
 
 from __future__ import annotations
@@ -20,8 +28,11 @@ import struct
 import threading
 import time
 
+from ...faults import registry as faults
 from ...utils.glog import logger
 from . import protocol as kp
+from .fetch_spool import FetchSpool
+from .frame_pool import Parts, build_frame_server
 from .groups import GroupCoordinator
 from .protocol import Reader, Writer
 from .records import Record, UnsupportedCompression, decode_batches, encode_batch
@@ -70,83 +81,82 @@ class KafkaGateway:
         port: int = 9092,
         advertised_host: str | None = None,
         auto_create_partitions: int = 1,
+        workers: int | None = None,
     ):
         self.broker = broker
         self.ip = ip
         self.advertised_host = advertised_host or ip
         self.auto_create_partitions = auto_create_partitions
         self.coordinator = GroupCoordinator()
-        self._tl = threading.local()  # per-connection request context
+        # Per-REQUEST context: every frame carries its own header, and a
+        # frame is handled start-to-finish on one worker thread, so a
+        # thread-local set at frame entry stays correct under the pool.
+        self._tl = threading.local()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((ip, port))
         self.port = self._sock.getsockname()[1]
         self._sock.listen(64)
-        self._stop = threading.Event()
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, daemon=True
+        self.spool = FetchSpool()
+        self.server = build_frame_server(
+            self._sock,
+            self._handle,
+            reject_handler=self._handle_reject,
+            workers=workers,
+            request_timeout=30.0,
+            server_kind="kafka",
         )
 
     # ---------------------------------------------------------- lifecycle
 
     def start(self) -> None:
-        self._accept_thread.start()
+        self.server.start()
 
     def stop(self) -> None:
-        self._stop.set()
         self.coordinator.stop()
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self.server.stop()
+        self.spool.close()
 
-    def _accept_loop(self) -> None:
-        while not self._stop.is_set():
-            try:
-                conn, addr = self._sock.accept()
-            except OSError:
-                return
-            threading.Thread(
-                target=self._serve_conn, args=(conn,), daemon=True
-            ).start()
+    def pool_status(self) -> dict:
+        st = self.server.pool_status()
+        st["fetch_spool"] = self.spool.status()
+        return st
 
     # --------------------------------------------------------- connection
 
-    def _serve_conn(self, conn: socket.socket) -> None:
-        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    def _handle(self, state: dict, frame: bytes) -> "bytes | Parts | None":
+        self._tl.reject = False
+        return self._handle_frame(frame)
+
+    def _handle_reject(self, state: dict, frame: bytes) -> "bytes | Parts | None":
+        """Saturation path: the frame server is over its admission
+        budget, so this (first and only) frame is answered with the
+        api's normal response shape carrying a retriable error and a
+        non-zero throttle_time — explicit, parseable backpressure —
+        and the connection is closed. Data-plane work is skipped
+        (produce appends nothing, fetch reads nothing)."""
+        self._tl.reject = True
         try:
-            while not self._stop.is_set():
-                head = self._read_exact(conn, 4)
-                if head is None:
-                    return
-                (size,) = struct.unpack(">i", head)
-                if size <= 0 or size > 64 * 1024 * 1024:
-                    return
-                frame = self._read_exact(conn, size)
-                if frame is None:
-                    return
-                resp = self._handle_frame(frame)
-                if resp is not None:
-                    conn.sendall(struct.pack(">i", len(resp)) + resp)
-        except (OSError, EOFError, ValueError) as e:
-            log.v(1, "connection dropped: %s", e)
+            return self._handle_frame(frame)
         finally:
-            try:
-                conn.close()
-            except OSError:
-                pass
+            self._tl.reject = False
 
-    @staticmethod
-    def _read_exact(conn: socket.socket, n: int) -> bytes | None:
-        buf = b""
-        while len(buf) < n:
-            chunk = conn.recv(n - len(buf))
-            if not chunk:
-                return None
-            buf += chunk
-        return buf
+    def _rejecting(self) -> bool:
+        return bool(getattr(self._tl, "reject", False))
 
-    def _handle_frame(self, frame: bytes) -> bytes | None:
+    def _throttle_ms(self) -> int:
+        """throttle_time_ms for the current response: the reject hint
+        when saturated, else the pool's live backpressure suggestion
+        (0 while there is headroom — the common case, and the value
+        golden tests pin)."""
+        if self._rejecting():
+            return 1000
+        try:
+            return self.server.suggested_throttle_ms()
+        except Exception:
+            return 0
+
+    def _handle_frame(self, frame: bytes) -> "bytes | Parts | None":
         r = Reader(frame)
         api_key = r.i16()
         api_version = r.i16()
@@ -194,6 +204,9 @@ class KafkaGateway:
         body = handler(r, api_version)
         if body is None:  # acks=0 produce: no response frame at all
             return None
+        if isinstance(body, Parts):  # zero-copy fetch: header + spans
+            body.parts.insert(0, out.done())
+            return body
         return out.raw(body).done()
 
     # ------------------------------------------------------- topic helpers
@@ -211,6 +224,13 @@ class KafkaGateway:
         except KeyError:
             return -1
 
+    def _parity_for(self, topic: str, part: int):
+        try:
+            st = self.broker.topic(NAMESPACE, topic)
+        except KeyError:
+            return None
+        return st.parity.get(part)
+
     # ----------------------------------------------------------- handlers
 
     def _api_versions_body(self, w: Writer, version: int, error: int) -> None:
@@ -224,7 +244,7 @@ class KafkaGateway:
                 .i16(kv[1][1])
                 .tags(),
             )
-            w.i32(0)  # throttle_time_ms
+            w.i32(self._throttle_ms())  # throttle_time_ms
             w.tags()
             return
         w.array(
@@ -232,7 +252,7 @@ class KafkaGateway:
             lambda ww, kv: ww.i16(kv[0]).i16(kv[1][0]).i16(kv[1][1]),
         )
         if version >= 1:
-            w.i32(0)  # throttle_time_ms
+            w.i32(self._throttle_ms())  # throttle_time_ms
 
     def _h_api_versions(self, r: Reader, v: int) -> bytes:
         if v >= 3:
@@ -276,7 +296,7 @@ class KafkaGateway:
                         existing.add(t)
         w = Writer()
         if v >= 3:
-            w.i32(0)  # throttle
+            w.i32(self._throttle_ms())  # throttle
         # brokers: just us
         def broker_entry(ww: Writer, _):
             ww.i32(NODE_ID).string(self.advertised_host).i32(self.port)
@@ -334,7 +354,11 @@ class KafkaGateway:
             r.nullable_string()
         acks = r.i16()
         r.i32()  # timeout_ms
+        from ...utils import metrics
+
+        rejecting = self._rejecting()
         results: list[tuple[str, list[tuple[int, int, int]]]] = []
+        dirty_parities: list = []
         ntopics = r.uvarint() - 1 if flex else r.i32()
         for _ in range(max(ntopics, 0)):
             topic = r.compact_string() if flex else r.string()
@@ -347,6 +371,15 @@ class KafkaGateway:
                 ) or b""
                 if flex:
                     r.tagged_fields()  # partition-struct tags
+                if rejecting:
+                    # saturation: parse (the reader must stay in sync)
+                    # but append NOTHING — the retriable error + the
+                    # throttle are the whole answer
+                    parts.append((part, kp.REQUEST_TIMED_OUT, -1))
+                    continue
+                metrics.mq_produce_bytes_total.inc(
+                    len(blob), plane="python"
+                )
                 plog = self._log_for(topic, part)
                 if plog is None:
                     parts.append((part, kp.UNKNOWN_TOPIC_OR_PARTITION, -1))
@@ -400,6 +433,9 @@ class KafkaGateway:
                             for rec in records
                         ]
                     )
+                    parity = self._parity_for(topic, part)
+                    if parity is not None:
+                        dirty_parities.append(parity)
                 parts.append((part, kp.NONE, base))
             if flex:
                 r.tagged_fields()  # topic-struct tags
@@ -408,6 +444,34 @@ class KafkaGateway:
             r.tagged_fields()  # request tags
         if acks == 0:
             return None
+        if dirty_parities:
+            # durable-parity topics: the ack certifies replayability.
+            # One group-commit window covers this produce's cohort; a
+            # failed window fails every producer in it (none of the
+            # cohort's records are certified durable).
+            committer = self.broker.group_committer()
+            if committer is not None:
+                for parity in dirty_parities:
+                    committer.mark_dirty(parity)
+                try:
+                    committer.wait_durable()
+                except OSError:
+                    results = [
+                        (
+                            topic,
+                            [
+                                (
+                                    part,
+                                    kp.KAFKA_STORAGE_ERROR
+                                    if err == kp.NONE and base >= 0
+                                    else err,
+                                    -1 if err == kp.NONE and base >= 0 else base,
+                                )
+                                for part, err, base in parts
+                            ],
+                        )
+                        for topic, parts in results
+                    ]
         w = Writer()
 
         def topic_entry(ww: Writer, tp):
@@ -441,11 +505,11 @@ class KafkaGateway:
 
         if flex:
             w.compact_array(results, topic_entry)
-            w.i32(0)  # throttle
+            w.i32(self._throttle_ms())  # throttle
             w.tags()
         else:
             w.array(results, topic_entry)
-            w.i32(0)  # throttle (v1+)
+            w.i32(self._throttle_ms())  # throttle (v1+)
         return w.done()
 
     def _h_fetch(self, r: Reader, v: int) -> bytes:
@@ -480,18 +544,26 @@ class KafkaGateway:
                 r.array(r.i32)
         if v >= 11:
             r.nullable_string()  # rack_id
+        rejecting = self._rejecting()
         # long-poll: when every requested partition is empty, block on
         # the log's condition (single-partition fetch, the common
         # consumer shape) or poll coarsely. Partitions are re-resolved
         # each round: a fetch may race the topic's auto-creation, and
-        # returning early would make the client spin.
-        deadline = time.monotonic() + max(max_wait_ms, 0) / 1000.0
+        # returning early would make the client spin. Under pool
+        # pressure (frames queueing behind busy workers) the wait is
+        # skipped entirely: parking a worker on an empty partition is
+        # exactly the wrong move when workers are the scarce resource —
+        # the empty response carries the throttle hint instead.
+        wait_s = max(max_wait_ms, 0) / 1000.0
+        if rejecting or self._throttle_ms() > 0:
+            wait_s = 0.0
+        deadline = time.monotonic() + wait_s
         wanted = [
             (topic, part, off)
             for topic, parts in requests
             for part, off, _m in parts
         ]
-        while True:
+        while not rejecting:
             live = [
                 (plog, off)
                 for topic, part, off in wanted
@@ -506,40 +578,79 @@ class KafkaGateway:
                 live[0][0].wait_for(live[0][1], timeout=remaining)
             else:
                 time.sleep(min(0.05, remaining))
+        # Response assembly: manual field walk (no Writer.array
+        # callbacks) so a sealed-segment spool hit can CUT the byte
+        # stream and splice in a FileExtent — the zero-copy span the
+        # frame server ships via sn_send_file, bit-identically on the
+        # Python fallback.
+        resp = Parts(api="fetch")
         w = Writer()
-        w.i32(0)  # throttle
+
+        def cut(extent) -> None:
+            nonlocal w
+            resp.append(w.done())
+            resp.append(extent)
+            w = Writer()
+
+        w.i32(self._throttle_ms())  # throttle
         if v >= 7:
             w.i16(kp.NONE)  # top-level error
             w.i32(0)  # session_id (0 = no fetch session)
-
-        def topic_entry(ww: Writer, tp):
-            name, parts = tp
-            ww.string(name)
-
-            def part_entry(w3: Writer, pr):
-                part, off, pmax = pr
+        w.i32(len(requests))
+        for name, parts in requests:
+            w.string(name)
+            w.i32(len(parts))
+            for part, off, pmax in parts:
+                if rejecting:
+                    w.i32(part).i16(kp.REQUEST_TIMED_OUT)
+                    w.i64(-1).i64(-1)
+                    if v >= 5:
+                        w.i64(-1)
+                    w.i32(0)  # aborted_transactions (empty)
+                    if v >= 11:
+                        w.i32(-1)  # preferred_read_replica
+                    w.i32(-1)  # null records
+                    continue
                 plog = self._log_for(name, part)
                 if plog is None:
-                    w3.i32(part).i16(kp.UNKNOWN_TOPIC_OR_PARTITION)
-                    w3.i64(-1).i64(-1)
+                    w.i32(part).i16(kp.UNKNOWN_TOPIC_OR_PARTITION)
+                    w.i64(-1).i64(-1)
                     if v >= 5:
-                        w3.i64(-1)
-                    w3.array([], lambda *_: None)
+                        w.i64(-1)
+                    w.i32(0)
                     if v >= 11:
-                        w3.i32(-1)  # preferred_read_replica
-                    w3.nullable_bytes(None)
-                    return
+                        w.i32(-1)
+                    w.i32(-1)
+                    continue
                 hw = plog.next_offset
                 if off > hw or (off < plog.earliest_offset):
-                    w3.i32(part).i16(kp.OFFSET_OUT_OF_RANGE)
-                    w3.i64(hw).i64(hw)
+                    w.i32(part).i16(kp.OFFSET_OUT_OF_RANGE)
+                    w.i64(hw).i64(hw)
                     if v >= 5:
-                        w3.i64(plog.earliest_offset)
-                    w3.array([], lambda *_: None)
+                        w.i64(plog.earliest_offset)
+                    w.i32(0)
                     if v >= 11:
-                        w3.i32(-1)
-                    w3.nullable_bytes(None)
-                    return
+                        w.i32(-1)
+                    w.i32(-1)
+                    continue
+                spooled = self.spool.extent_for(name, part, plog, off)
+                if spooled is not None:
+                    # whole sealed segment as ONE on-disk batch; it may
+                    # start before `off` (protocol-legal — the client
+                    # skips below its requested offset) and it ships
+                    # regardless of pmax (the oversized-first-batch
+                    # rule: it is the first batch)
+                    extent, _base, _next_off = spooled
+                    w.i32(part).i16(kp.NONE)
+                    w.i64(hw).i64(hw)  # high_watermark, last_stable
+                    if v >= 5:
+                        w.i64(plog.earliest_offset)
+                    w.i32(0)  # aborted_transactions
+                    if v >= 11:
+                        w.i32(-1)  # preferred_read_replica
+                    w.i32(extent.length)  # records blob length
+                    cut(extent)
+                    continue
                 recs = plog.read_from(off, max_records=1024)
                 batch = b""
                 if recs:
@@ -566,19 +677,20 @@ class KafkaGateway:
                         ],
                         base_offset=recs[0][0],
                     )
-                w3.i32(part).i16(kp.NONE)
-                w3.i64(hw).i64(hw)  # high_watermark, last_stable
+                w.i32(part).i16(kp.NONE)
+                w.i64(hw).i64(hw)  # high_watermark, last_stable
                 if v >= 5:
-                    w3.i64(plog.earliest_offset)
-                w3.array([], lambda *_: None)  # aborted_transactions
+                    w.i64(plog.earliest_offset)
+                w.i32(0)  # aborted_transactions
                 if v >= 11:
-                    w3.i32(-1)  # preferred_read_replica
-                w3.nullable_bytes(batch if batch else None)
-
-            ww.array(parts, part_entry)
-
-        w.array(requests, topic_entry)
-        return w.done()
+                    w.i32(-1)  # preferred_read_replica
+                if batch:
+                    w.i32(len(batch)).raw(batch)
+                else:
+                    w.i32(-1)  # null records
+        resp.append(w.done())
+        faults.fire("mq.fetch.before_send", bytes=resp.total())
+        return resp
 
     def _h_list_offsets(self, r: Reader, v: int) -> bytes:
         r.i32()  # replica_id
@@ -599,7 +711,7 @@ class KafkaGateway:
             req.append((topic, parts))
         w = Writer()
         if v >= 2:
-            w.i32(0)  # throttle
+            w.i32(self._throttle_ms())  # throttle
 
         def topic_entry(ww: Writer, tp):
             name, parts = tp
@@ -657,7 +769,7 @@ class KafkaGateway:
         }
         w = Writer()
         if v >= 2:
-            w.i32(0)  # throttle
+            w.i32(self._throttle_ms())  # throttle
 
         def entry(ww: Writer, tp):
             name, count = tp
@@ -688,7 +800,7 @@ class KafkaGateway:
         }
         w = Writer()
         if v >= 1:
-            w.i32(0)
+            w.i32(self._throttle_ms())
 
         def entry(ww: Writer, name: str):
             if name in existing:
@@ -706,7 +818,7 @@ class KafkaGateway:
             r.i8()  # key_type
         w = Writer()
         if v >= 1:
-            w.i32(0)  # throttle
+            w.i32(self._throttle_ms())  # throttle
         w.i16(kp.NONE)
         if v >= 1:
             w.nullable_string(None)  # error_message
@@ -748,7 +860,7 @@ class KafkaGateway:
             results.append((topic, parts))
         w = Writer()
         if v >= 3:
-            w.i32(0)
+            w.i32(self._throttle_ms())
         w.array(
             results,
             lambda ww, tp: ww.string(tp[0]).array(
@@ -772,7 +884,7 @@ class KafkaGateway:
                     req.append((name, list(range(count))))
         w = Writer()
         if v >= 3:
-            w.i32(0)
+            w.i32(self._throttle_ms())
 
         def topic_entry(ww: Writer, tp):
             name, parts = tp
@@ -824,7 +936,7 @@ class KafkaGateway:
         )
         w = Writer()
         if v >= 2:
-            w.i32(0)  # throttle
+            w.i32(self._throttle_ms())  # throttle
         if resp["error"] != kp.NONE:
             w.i16(resp["error"]).i32(-1).string("").string("").string("")
             w.array([], lambda *_: None)
@@ -860,7 +972,7 @@ class KafkaGateway:
             err, blob = g.sync(member_id, generation, assignments)
         w = Writer()
         if v >= 1:
-            w.i32(0)
+            w.i32(self._throttle_ms())
         w.i16(err).bytes_(blob)
         return w.done()
 
@@ -878,7 +990,7 @@ class KafkaGateway:
         )
         w = Writer()
         if v >= 1:
-            w.i32(0)
+            w.i32(self._throttle_ms())
         w.i16(err)
         return w.done()
 
@@ -905,7 +1017,7 @@ class KafkaGateway:
         )
         w = Writer()
         if v >= 1:
-            w.i32(0)
+            w.i32(self._throttle_ms())
         w.i16(top_err if v < 3 else kp.NONE)
         if v >= 3:
             w.array(
@@ -919,7 +1031,7 @@ class KafkaGateway:
     def _h_list_groups(self, r: Reader, v: int) -> bytes:
         w = Writer()
         if v >= 1:
-            w.i32(0)
+            w.i32(self._throttle_ms())
         w.i16(kp.NONE)
         w.array(
             self.coordinator.list_groups(),
@@ -933,7 +1045,7 @@ class KafkaGateway:
             r.i8()  # include_authorized_operations
         w = Writer()
         if v >= 1:
-            w.i32(0)
+            w.i32(self._throttle_ms())
 
         def entry(ww: Writer, name: str):
             g = self.coordinator.lookup(name)

@@ -169,6 +169,11 @@ def main(argv=None) -> int:
         "partition balancing + follower replication",
     )
     b.add_argument(
+        "-statusPort", type=int, default=-1,
+        help="HTTP operator plane: /status JSON (gateway pool, parity "
+        "lag, broker loads) + /metrics prometheus text (-1 = off)",
+    )
+    b.add_argument(
         "-parityDir", default="",
         help="local dir for streaming-EC durable-parity log streams: "
         "topics get parity trailing the append head by a bounded lag "
@@ -385,14 +390,17 @@ def main(argv=None) -> int:
             pg_users=pg_users,
             peers=[p.strip() for p in a.peers.split(",") if p.strip()],
             parity_dir=a.parityDir,
+            status_port=a.statusPort,
         )
         bs.start()
         servers.append(bs)
         log.info(
-            "mq broker on %s:%s (filer=%s%s%s)",
+            "mq broker on %s:%s (filer=%s%s%s%s)",
             a.ip, a.port, a.filer or "memory-only",
             f", kafka on :{bs.kafka.port}" if bs.kafka else "",
             f", pg on :{bs.pg.port}" if bs.pg else "",
+            f", status on :{bs.status_port}"
+            if bs._status_httpd is not None else "",
         )
 
     if a.mode in ("master", "server"):

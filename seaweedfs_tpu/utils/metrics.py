@@ -406,6 +406,22 @@ net_bytes_copied_total = REGISTRY.counter(
     ("plane", "direction"),
 )
 
+mq_produce_bytes_total = REGISTRY.counter(
+    "sw_mq_produce_bytes_total",
+    "record-batch bytes accepted by the Kafka gateway produce path",
+    ("plane",),
+)
+mq_fetch_bytes_total = REGISTRY.counter(
+    "sw_mq_fetch_bytes_total",
+    "fetch-response payload bytes served by the Kafka gateway, by "
+    "egress plane (native = sn_sendv/sn_send_file, python = fallback)",
+    ("plane",),
+)
+mq_group_commit_windows_total = REGISTRY.counter(
+    "sw_mq_group_commit_windows_total",
+    "broker group-commit flush windows completed",
+)
+
 # Warm-path control plane (ISSUE 13): SigV4 verdict-memo outcomes on
 # header-auth requests. hit = the full canonical-request + HMAC chain
 # was skipped (freshness/identity/session-token still re-checked);

@@ -1,0 +1,562 @@
+"""Kafka data-plane fleet machinery (ISSUE 20): the bounded worker-pool
+frame server, saturation backpressure, broker group commit over
+durable parity, the zero-copy fetch spool, gravity-aware partition
+assignment, and SQL scans racing live Kafka produce.
+
+The pool tests drive the gateway over real sockets — well-formedness
+of saturation responses is asserted byte-by-byte with a raw framing
+helper, because the whole point is that a stock client parser must
+never choke on a reject."""
+
+import json
+import multiprocessing
+import os
+import socket
+import struct
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from conftest import allocate_port
+from seaweedfs_tpu.faults import registry as faults
+from seaweedfs_tpu.mq.broker import MqBroker, MqBrokerServer, MqService
+from seaweedfs_tpu.mq.kafka import protocol as kp
+from seaweedfs_tpu.mq.kafka.client import KafkaClient, KafkaError
+from seaweedfs_tpu.mq.kafka.frame_pool import _native_mod
+from seaweedfs_tpu.mq.kafka.gateway import KafkaGateway
+from seaweedfs_tpu.mq.kafka.protocol import Reader, Writer
+from seaweedfs_tpu.mq.kafka.records import Record, encode_batch
+
+# ------------------------------------------------------------- helpers
+
+
+def _raw_call(port: int, api_key: int, version: int, body: bytes):
+    """One request frame on a fresh connection; returns (Reader past
+    the correlation id, sock) — the caller closes the sock."""
+    s = socket.create_connection(("localhost", port), timeout=10)
+    frame = (
+        Writer()
+        .i16(api_key)
+        .i16(version)
+        .i32(7)
+        .nullable_string("raw")
+        .done()
+        + body
+    )
+    s.sendall(struct.pack(">i", len(frame)) + frame)
+    head = _recv_exact(s, 4)
+    (size,) = struct.unpack(">i", head)
+    r = Reader(_recv_exact(s, size))
+    assert r.i32() == 7  # correlation id
+    return r, s
+
+
+def _recv_exact(s: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = s.recv(n - len(buf))
+        assert chunk, f"peer closed mid-read ({len(buf)}/{n})"
+        buf += chunk
+    return buf
+
+
+def _produce_v3_body(topic: str, part: int, blob: bytes) -> bytes:
+    return (
+        Writer()
+        .nullable_string(None)  # transactional_id
+        .i16(-1)  # acks
+        .i32(10_000)  # timeout_ms
+        .array(
+            [topic],
+            lambda w, t: w.string(t).array(
+                [part], lambda w2, p: w2.i32(p).bytes_(blob)
+            ),
+        )
+        .done()
+    )
+
+
+def _fetch_v4_body(topic: str, part: int, offset: int) -> bytes:
+    return (
+        Writer()
+        .i32(-1)  # replica_id
+        .i32(0)  # max_wait_ms
+        .i32(1)  # min_bytes
+        .i32(1 << 20)  # max_bytes
+        .i8(0)  # isolation_level
+        .array(
+            [topic],
+            lambda w, t: w.string(t).array(
+                [part],
+                lambda w2, p: w2.i32(p).i64(offset).i32(1 << 20),
+            ),
+        )
+        .done()
+    )
+
+
+@pytest.fixture
+def kafka_broker():
+    srv = MqBrokerServer(
+        ip="localhost", grpc_port=allocate_port(), kafka_port=0
+    )
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+# ------------------------------------------------- connection hygiene
+
+
+def test_oversized_length_prefix_closes_before_allocation(kafka_broker):
+    """An adversarial 1 GiB frame prefix must cost the server 4 bytes
+    of reading — the connection closes without the payload ever being
+    allocated, and the pool keeps serving others."""
+    port = kafka_broker.kafka.port
+    for prefix in (1 << 30, -5, 0):
+        s = socket.create_connection(("localhost", port), timeout=5)
+        s.sendall(struct.pack(">i", prefix))
+        s.settimeout(5)
+        assert s.recv(1) == b"", f"prefix {prefix} not rejected"
+        s.close()
+    # the server survived all three
+    c = KafkaClient("localhost", port)
+    assert kp.PRODUCE in c.api_versions
+    c.close()
+
+
+def test_mid_frame_death_is_bounded(kafka_broker):
+    """A peer dying mid-frame (prefix promised more than it sent) must
+    cost one read timeout on one worker, not a wedged thread."""
+    port = kafka_broker.kafka.port
+    s = socket.create_connection(("localhost", port), timeout=5)
+    s.sendall(struct.pack(">i", 100) + b"short")
+    s.close()  # die mid-frame
+    # pool still serves a full round trip afterwards
+    c = KafkaClient("localhost", port)
+    c.create_topic("hygiene", partitions=1)
+    base = c.produce("hygiene", 0, [Record(key=b"k", value=b"v")])
+    assert base == 0
+    _hw, recs = c.fetch("hygiene", 0, 0)
+    assert [r.value for r in recs] == [b"v"]
+    c.close()
+
+
+# --------------------------------------------------------- saturation
+
+
+def test_saturation_rejects_are_well_formed(monkeypatch):
+    """Past the admission budget, produce and fetch get their NORMAL
+    response shape carrying a retriable REQUEST_TIMED_OUT plus a
+    non-zero throttle — then the connection closes. No partial frames,
+    no silent thread growth, and the broker state is untouched."""
+    monkeypatch.setenv("SEAWEED_MQ_KAFKA_QUEUE", "0")
+    broker = MqBroker()
+    broker.configure_topic("kafka", "sat", 1)
+    gw = KafkaGateway(broker, port=0, workers=1)  # budget: 1 connection
+    gw.start()
+    holder = None
+    try:
+        holder = KafkaClient("localhost", gw.port)  # occupies the slot
+        deadline = time.monotonic() + 5
+        while gw.pool_status()["open_connections"] < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+
+        blob = encode_batch([Record(key=b"k", value=b"v")], base_offset=0)
+        r, s = _raw_call(
+            gw.port, kp.PRODUCE, 3, _produce_v3_body("sat", 0, blob)
+        )
+        assert r.i32() == 1  # one topic
+        assert r.string() == "sat"
+        assert r.i32() == 1  # one partition
+        assert r.i32() == 0  # partition index
+        assert r.i16() == kp.REQUEST_TIMED_OUT
+        assert r.i64() == -1  # no base offset assigned
+        r.i64()  # log_append_time (v2+)
+        assert r.i32() == 1000  # throttle_time_ms: explicit backpressure
+        assert r.remaining() == 0
+        assert s.recv(1) == b"", "reject connection must close"
+        s.close()
+        # nothing was appended
+        assert broker.topic("kafka", "sat").logs[0].next_offset == 0
+
+        r, s = _raw_call(
+            gw.port, kp.FETCH, 4, _fetch_v4_body("sat", 0, 0)
+        )
+        assert r.i32() == 1000  # throttle
+        assert r.i32() == 1  # one topic
+        assert r.string() == "sat"
+        assert r.i32() == 1  # one partition
+        assert r.i32() == 0  # index
+        assert r.i16() == kp.REQUEST_TIMED_OUT
+        r.i64()  # high watermark
+        r.i64()  # last stable
+        assert r.i32() == 0  # aborted_transactions
+        assert r.i32() == -1  # null records
+        assert r.remaining() == 0
+        assert s.recv(1) == b""
+        s.close()
+
+        st = gw.pool_status()
+        assert st["rejected_total"] >= 2
+        assert st["max_connections"] == 1
+        # the admitted client still works end to end
+        assert holder.produce("sat", 0, [Record(key=b"a", value=b"b")]) == 0
+    finally:
+        if holder is not None:
+            holder.close()
+        gw.stop()
+        broker.close()
+
+
+def test_32_clients_cross_connection_correctness(kafka_broker):
+    """32 concurrent clients over a 16-worker pool: every client's
+    records land on its own partition, dense and byte-exact — parking/
+    dispatch never bleeds one connection's state into another's."""
+    port = kafka_broker.kafka.port
+    nclients, per = 32, 20
+    setup = KafkaClient("localhost", port)
+    setup.create_topic("fleet", partitions=nclients)
+    setup.close()
+    errors: list[BaseException] = []
+
+    def run(idx: int) -> None:
+        try:
+            c = KafkaClient("localhost", port, client_id=f"c{idx}")
+            for i in range(per):
+                base = c.produce(
+                    "fleet",
+                    idx,
+                    [Record(key=b"k%d" % i, value=b"c%d-%d" % (idx, i))],
+                )
+                assert base == i, (idx, i, base)
+            _hw, recs = c.fetch("fleet", idx, 0, max_bytes=1 << 22)
+            assert [r.value for r in recs] == [
+                b"c%d-%d" % (idx, i) for i in range(per)
+            ]
+            c.close()
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=run, args=(i,)) for i in range(nclients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors[:3]
+    st = kafka_broker.kafka.pool_status()
+    assert st["frames_served"] >= nclients * (per + 1)
+
+
+# ------------------------------------------------------- group commit
+
+
+def _msg(i: int) -> tuple[bytes, bytes]:
+    return b"key-%06d" % i, (b"val-%06d-" % i) * 8
+
+
+def _gc_crash_child(pdir: str, port_file: str, acked_file: str,
+                    grpc_port: int, kill_window: int) -> None:
+    os.environ["SEAWEED_MQ_GROUP_COMMIT_MS"] = "10"
+    faults.inject(
+        "mq.produce.before_flush",
+        faults.hard_exit(137),
+        when=faults.nth_call(kill_window),
+    )
+    srv = MqBrokerServer(
+        ip="localhost", grpc_port=grpc_port, kafka_port=0, parity_dir=pdir
+    )
+    srv.start()
+    with open(port_file, "w") as f:
+        f.write(str(srv.kafka.port))
+    c = KafkaClient("localhost", srv.kafka.port)
+    c.create_topic("gc", partitions=1)
+    acked = open(acked_file, "w")
+    for i in range(500):
+        k, v = _msg(i)
+        c.produce("gc", 0, [Record(key=k, value=v)], acks=-1)
+        # the ack CERTIFIED durability — record it crash-consistently
+        acked.write(f"{i}\n")
+        acked.flush()
+        os.fsync(acked.fileno())
+    os._exit(0)  # not reached: the armed window kills us first
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("kill_window", [1, 4])
+def test_group_commit_acked_replayable_unacked_clean(tmp_path, kill_window):
+    """Hard-kill the broker inside a group-commit window: every
+    produce acked before the crash replays byte-exactly after restart
+    (acked ⇒ durable), and whatever else survives is a dense prefix —
+    unacked records never leave a torn or reordered tail."""
+    pdir = str(tmp_path / "parity")
+    port_file = str(tmp_path / "port")
+    acked_file = str(tmp_path / "acked")
+    mp = multiprocessing.get_context("fork")
+    p = mp.Process(
+        target=_gc_crash_child,
+        args=(pdir, port_file, acked_file, allocate_port(), kill_window),
+    )
+    p.start()
+    p.join(timeout=120)
+    assert p.exitcode == 137, f"expected hard crash, got {p.exitcode}"
+    acked = -1
+    if os.path.exists(acked_file):
+        lines = open(acked_file).read().split()
+        if lines:
+            acked = int(lines[-1])
+    br = MqBroker(parity_dir=pdir)
+    try:
+        recs = br.topic("kafka", "gc").logs[0].read_from(
+            0, max_records=10_000
+        )
+        # dense prefix from 0, byte-exact (the gateway stores keys and
+        # values with its nullability marker — unwrap before comparing)
+        from seaweedfs_tpu.mq.kafka.gateway import _unpack_null
+
+        for n, (off, _ts, k, v) in enumerate(recs):
+            assert off == n, f"replay not dense: offset {off} at {n}"
+            assert (_unpack_null(k), _unpack_null(v)) == _msg(n), (
+                f"record {n} corrupted"
+            )
+        # acked => replayable (the crash window certified nothing past
+        # `acked`, and everything up to it)
+        assert len(recs) >= acked + 1, (
+            f"acked {acked + 1} records but only {len(recs)} replayed"
+        )
+    finally:
+        br.close()
+
+
+def test_group_commit_failed_window_fails_cohort(tmp_path, monkeypatch):
+    """An I/O error inside the commit window must fail EVERY producer
+    whose ack rode on that window (KAFKA_STORAGE_ERROR, retriable) —
+    and the next window heals."""
+    monkeypatch.setenv("SEAWEED_MQ_GROUP_COMMIT_MS", "20")
+    srv = MqBrokerServer(
+        ip="localhost",
+        grpc_port=allocate_port(),
+        kafka_port=0,
+        parity_dir=str(tmp_path / "parity"),
+    )
+    srv.start()
+    try:
+        c = KafkaClient("localhost", srv.kafka.port)
+        c.create_topic("cohort", partitions=1)
+        c.produce("cohort", 0, [Record(key=b"warm", value=b"up")])
+        with faults.injected(
+            "mq.produce.before_flush", faults.io_error(), count=1
+        ):
+            with pytest.raises(KafkaError) as ei:
+                c.produce("cohort", 0, [Record(key=b"k", value=b"v")])
+            assert ei.value.code == kp.KAFKA_STORAGE_ERROR
+        # the window after the failed one commits cleanly, offsets dense
+        base = c.produce("cohort", 0, [Record(key=b"k2", value=b"v2")])
+        _hw, recs = c.fetch("cohort", 0, 0)
+        assert recs[-1].offset == base
+        c.close()
+    finally:
+        srv.stop()
+
+
+# --------------------------------------------------- zero-copy fetch
+
+
+def _metric_value(name: str, **labels) -> float:
+    from seaweedfs_tpu.utils.metrics import REGISTRY
+
+    want = name
+    if labels:
+        inner = ",".join(
+            f'{k}="{v}"' for k, v in sorted(labels.items())
+        )
+        want = f"{name}{{{inner}}}"
+    for line in REGISTRY.render().decode().splitlines():
+        if line.startswith(want + " "):
+            return float(line.split()[-1])
+    return 0.0
+
+
+def test_fetch_spool_bit_identical_across_planes(monkeypatch):
+    """Sealed segments egress through the fetch spool — via
+    sn_send_file on the native plane, plain writes on the fallback —
+    and the records a client decodes are IDENTICAL either way."""
+    srv = MqBrokerServer(
+        ip="localhost",
+        grpc_port=allocate_port(),
+        kafka_port=0,
+        segment_records=64,
+    )
+    srv.start()
+    try:
+        c = KafkaClient("localhost", srv.kafka.port)
+        c.create_topic("sealed", partitions=1)
+        # memory-only brokers never seal; give the partition log a
+        # spill store so segments rotate out of the tail like a
+        # filer-backed deployment (dict-backed: content-identical)
+        plog = srv.broker.topic("kafka", "sealed").logs[0]
+        segs: dict[int, bytes] = {}
+        plog._spill = segs.__setitem__
+        plog._load = segs.get
+        payload = bytes(range(256))
+        for i in range(200):  # 3 sealed segments + live tail
+            c.produce(
+                "sealed", 0, [Record(key=b"k%03d" % i, value=payload)]
+            )
+        assert plog._tail_base >= 192 and segs
+
+        def drain(client):
+            out, off = [], 0
+            while True:
+                hw, recs = client.fetch(
+                    "sealed", 0, off, max_wait_ms=0, max_bytes=1 << 22
+                )
+                if not recs:
+                    break
+                out.extend(recs)
+                off = recs[-1].offset + 1
+                if off >= 200:
+                    break
+            return [(r.offset, r.key, r.value) for r in out]
+
+        monkeypatch.setenv("SEAWEED_EC_NATIVE", "0")
+        py_recs = drain(c)
+        monkeypatch.delenv("SEAWEED_EC_NATIVE")
+        native_before = _metric_value(
+            "sw_mq_fetch_bytes_total", plane="native"
+        )
+        c2 = KafkaClient("localhost", srv.kafka.port)
+        nat_recs = drain(c2)
+        c2.close()
+        c.close()
+        assert len(py_recs) == 200
+        assert py_recs == nat_recs  # bit-identical across planes
+        spool = srv.kafka.pool_status()["fetch_spool"]
+        assert spool["builds"] >= 3  # the sealed segments went via spool
+        if _native_mod() is not None:
+            assert (
+                _metric_value("sw_mq_fetch_bytes_total", plane="native")
+                > native_before
+            ), "native plane available but no native fetch bytes"
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------------ gravity
+
+
+def test_gravity_assignment_swaps_only_past_hysteresis(monkeypatch):
+    from seaweedfs_tpu.mq import balancer as bal
+
+    b = bal.BrokerBalancer("a:1", ["a:1", "b:2"])
+    try:
+        lead, fol = b.assignment("ns", "t", 0)  # pure HRW, no telemetry
+        # hotter leader within the margin: HRW ranking stands
+        b._loads = {lead: 1.0, fol: 0.2}
+        assert b.assignment("ns", "t", 0) == (lead, fol)
+        # past the margin: the cooler broker takes the partition, the
+        # HRW winner keeps the replica
+        b._loads = {lead: 2.0, fol: 0.2}
+        assert b.assignment("ns", "t", 0) == (fol, lead)
+        # the margin is a live knob
+        monkeypatch.setenv("SEAWEED_MQ_GRAVITY_HYSTERESIS", "5.0")
+        assert b.assignment("ns", "t", 0) == (lead, fol)
+        # missing telemetry on either side: never swap on a guess
+        b._loads = {lead: 99.0}
+        monkeypatch.delenv("SEAWEED_MQ_GRAVITY_HYSTERESIS")
+        assert b.assignment("ns", "t", 0) == (lead, fol)
+    finally:
+        b.stop()
+
+
+def test_broker_status_carries_load_score():
+    broker = MqBroker()
+    try:
+        from seaweedfs_tpu.mq import balancer as bal
+
+        b = bal.BrokerBalancer("a:1", ["a:1"])
+        svc = MqService(broker, balancer=b, load_fn=lambda: 3.25)
+        resp = svc.BrokerStatus(None, None)
+        assert resp.load_score == 3.25
+        # a broken load_fn degrades to 0, never fails the ping
+        svc.load_fn = lambda: 1 / 0
+        assert svc.BrokerStatus(None, None).load_score == 0.0
+        b.stop()
+    finally:
+        broker.close()
+
+
+# ------------------------------------------------------- status plane
+
+
+def test_status_http_plane(kafka_broker):
+    srv = MqBrokerServer(
+        ip="localhost",
+        grpc_port=allocate_port(),
+        kafka_port=0,
+        status_port=0,
+    )
+    srv.start()
+    try:
+        c = KafkaClient("localhost", srv.kafka.port)
+        c.create_topic("obs", partitions=2)
+        c.produce("obs", 0, [Record(key=b"k", value=b"v")])
+        c.close()
+        url = f"http://localhost:{srv.status_port}"
+        st = json.load(urllib.request.urlopen(url + "/status"))
+        assert st["kafka_pool"]["kind"] == "pooled"
+        assert st["kafka_pool"]["workers"] >= 1
+        assert {"namespace": "kafka", "name": "obs", "partitions": 2} in (
+            st["topics"]
+        )
+        assert "load_score" in st and "broker_loads" in st
+        body = urllib.request.urlopen(url + "/metrics").read().decode()
+        assert "sw_mq_produce_bytes_total" in body
+        assert "sw_mq_fetch_bytes_total" in body
+        assert "sw_mq_group_commit_windows_total" in body
+    finally:
+        srv.stop()
+
+
+# ------------------------------------- SQL scans vs. live Kafka produce
+
+
+def test_sql_scan_under_concurrent_produce(kafka_broker):
+    """A SQL consumer over a topic being produced to at full tilt:
+    every scan sees a consistent count (monotone, never torn rows),
+    and the final scan sees everything."""
+    from seaweedfs_tpu.query.engine import QueryEngine
+
+    port = kafka_broker.kafka.port
+    c = KafkaClient("localhost", port)
+    c.create_topic("events", partitions=2)
+    engine = QueryEngine(kafka_broker.broker)
+    total = 300
+    done = threading.Event()
+
+    def produce():
+        try:
+            for i in range(total):
+                row = json.dumps({"seq": i, "by": "writer"}).encode()
+                c.produce("events", i % 2, [Record(key=b"k", value=row)])
+        finally:
+            done.set()
+
+    t = threading.Thread(target=produce)
+    t.start()
+    last = 0
+    while not done.is_set():
+        res = engine.execute("SELECT COUNT(*) FROM events")
+        n = res.rows[0][0]
+        assert n >= last, f"count went backwards: {last} -> {n}"
+        last = n
+    t.join(timeout=30)
+    res = engine.execute("SELECT COUNT(*), MAX(seq) FROM events")
+    assert res.rows[0][0] == total
+    assert res.rows[0][1] == total - 1
+    c.close()

@@ -418,9 +418,16 @@ def test_volume_scrub_and_ec_scrub_repair_smoke(pair, tmp_path):
 
     out = run_command(env, "ec.encode -volumeId 61 -backend cpu -keepSource")
     assert "encoded" in out or "ec" in out, out
-    wait_for(
-        lambda: env.master.lookup_ec(61, refresh=True), msg="ec shards visible"
-    )
+
+    def ec_visible():
+        # lookup_ec raises (rather than returning empty) until the
+        # heartbeat registers the shards — treat that as "not yet"
+        try:
+            return env.master.lookup_ec(61, refresh=True)
+        except LookupError:
+            return False
+
+    wait_for(ec_visible, msg="ec shards visible")
     out = run_command(env, "ec.scrub -volumeId 61")
     assert "all clean" in out, out
 
